@@ -1,0 +1,254 @@
+"""Version-key encoder tests.
+
+Two layers:
+1. curated ordering vectors per ecosystem (corner cases from the documented
+   algorithms: dpkg tilde/epoch, rpmvercmp caret/alpha-vs-num, apk suffix
+   ranks and fractional components, semver prerelease, pep440 dev/post);
+2. property fuzz: random versions from per-ecosystem grammars — the token
+   vectors' lexicographic order must equal the exact comparator's order.
+"""
+
+import itertools
+import random
+
+import pytest
+
+from trivy_tpu import version as V
+from trivy_tpu.version import encode as E
+
+
+def sign(x):
+    return (x > 0) - (x < 0)
+
+
+def check_order(eco, ordered):
+    """Assert strictly ascending order pairwise, via both comparators."""
+    for a, b in itertools.combinations(ordered, 2):
+        assert V.compare(eco, a, b) == -1, f"{eco}: want {a} < {b} (host)"
+        assert V.compare(eco, b, a) == 1
+        ka, kb = V.encode_version(eco, a), V.encode_version(eco, b)
+        assert ka.exact and kb.exact, (a, b)
+        assert V.lex_cmp(ka.tokens, kb.tokens) == -1, \
+            f"{eco}: want {a} < {b} (tokens)"
+
+
+def check_equal(eco, a, b):
+    assert V.compare(eco, a, b) == 0
+    ka, kb = V.encode_version(eco, a), V.encode_version(eco, b)
+    assert V.lex_cmp(ka.tokens, kb.tokens) == 0, f"{eco}: want {a} == {b}"
+
+
+class TestDeb:
+    def test_basic_order(self):
+        check_order("debian", [
+            "1.0", "1.0-1", "1.0-1+deb11u1", "1.0-2", "1.0.1", "1.1",
+            "1.2~rc1", "1.2", "2.0", "10.0", "1:0.1",
+        ])
+
+    def test_tilde(self):
+        check_order("debian", ["1.0~~", "1.0~~a", "1.0~1", "1.0", "1.0a"])
+
+    def test_epoch(self):
+        check_order("debian", ["0.9", "1:0.1", "2:0.0.1"])
+        check_equal("debian", "0:1.0", "1.0")
+
+    def test_letters_before_nonletters(self):
+        # deb modified alphabet: letters < '+' even though ASCII says otherwise
+        check_order("debian", ["1.0z", "1.0+b1"])
+
+    def test_numeric_chunks(self):
+        check_order("debian", ["1.9", "1.10", "1.0.100"][0:2])
+        check_order("debian", ["1.2.3", "1.2.10"])
+
+    def test_real_debian_versions(self):
+        check_order("debian", [
+            "2.28-10", "2.28-10+deb10u1", "2.28-10+deb10u2",
+            "2.31-13", "2.31-13+deb11u3", "2.36-9",
+        ])
+
+
+class TestRpm:
+    def test_basic(self):
+        check_order("redhat", ["1.0", "1.0.1", "1.1", "2.0"])
+        check_equal("redhat", "1.0", "1..0")
+        check_equal("redhat", "1.a", "1a")
+
+    def test_num_beats_alpha(self):
+        check_order("redhat", ["1.abc", "1.1"])
+
+    def test_tilde_caret(self):
+        check_order("redhat", ["1.0~rc1", "1.0", "1.0^git1", "1.0.1"])
+        check_order("redhat", ["1.0^git1", "1.0^git1.1"])
+
+    def test_release_and_epoch(self):
+        check_order("redhat", ["4.18.0-80.el8", "4.18.0-147.el8",
+                               "4.18.0-147.el8_1", "1:1.0-1"])
+
+    def test_prefix_longer_newer(self):
+        check_order("redhat", ["1.0", "1.0.a", "1.0.1"])
+
+
+class TestApk:
+    def test_basic(self):
+        check_order("alpine", ["1.1.1", "1.1.1a", "1.1.1b", "1.1.2"])
+
+    def test_suffixes(self):
+        check_order("alpine", [
+            "1.0_alpha", "1.0_alpha1", "1.0_beta", "1.0_pre", "1.0_rc1",
+            "1.0", "1.0_cvs", "1.0_svn", "1.0_git", "1.0_hg", "1.0_p1",
+        ])
+
+    def test_revision(self):
+        check_order("alpine", ["1.1.1q-r0", "1.1.1q-r1", "1.1.1q-r2"])
+        check_order("alpine", ["1.1.1d-r0", "1.1.1q-r0"])
+
+    def test_fractional(self):
+        # leading-zero components compare string-fraction-wise
+        check_order("alpine", ["1.001", "1.009", "1.01", "1.1", "1.2"])
+        check_equal("alpine", "1.010", "1.01")
+
+    def test_multi_suffix(self):
+        check_order("alpine", ["1.0_p1", "1.0_p1_p2"])
+        check_order("alpine", ["1.0_p1_alpha", "1.0_p1"])
+
+    def test_real_alpine(self):
+        check_order("alpine", [
+            "1.1.1b-r1", "1.1.1d-r0", "1.1.1d-r2", "1.1.1q-r0",
+        ])
+        check_order("alpine", ["2.9.7-r0", "2.9.9-r1", "2.9.9-r2"])
+
+
+class TestSemver:
+    def test_basic(self):
+        check_order("npm", ["1.0.0", "1.0.1", "1.1.0", "2.0.0", "10.0.0"])
+
+    def test_prerelease(self):
+        check_order("npm", [
+            "1.0.0-alpha", "1.0.0-alpha.1", "1.0.0-alpha.beta",
+            "1.0.0-beta", "1.0.0-beta.2", "1.0.0-beta.11",
+            "1.0.0-rc.1", "1.0.0",
+        ])
+
+    def test_build_metadata_ignored(self):
+        check_equal("npm", "1.0.0+build1", "1.0.0+build2")
+        check_equal("npm", "1.0.0", "1.0.0+x")
+
+    def test_loose(self):
+        check_equal("npm", "1.0", "1.0.0")
+        check_order("npm", ["1", "1.0.1"])
+
+
+class TestPep440:
+    def test_basic(self):
+        check_order("pip", ["1.0", "1.0.1", "1.1", "2.0"])
+        check_equal("pip", "1.0", "1.0.0")
+        check_equal("pip", "1.0", "v1.0")
+
+    def test_pre_post_dev(self):
+        check_order("pip", [
+            "1.0.dev1", "1.0a1.dev1", "1.0a1", "1.0a2", "1.0b1",
+            "1.0rc1", "1.0", "1.0.post1", "1.1.dev1", "1.1",
+        ])
+
+    def test_normalization(self):
+        check_equal("pip", "1.0alpha1", "1.0a1")
+        check_equal("pip", "1.0-post1", "1.0.post1")
+        check_equal("pip", "1.0-1", "1.0.post1")
+        check_equal("pip", "1.0RC1", "1.0rc1")
+
+    def test_epoch(self):
+        check_order("pip", ["2.0", "1!0.1"])
+
+    def test_local(self):
+        check_order("pip", ["1.0", "1.0+abc", "1.0+abc.1", "1.0+5"])
+
+
+# --- property fuzz: token order == exact comparator order ---
+
+def _gen_deb(rng):
+    parts = [str(rng.randint(0, 30)) for _ in range(rng.randint(1, 3))]
+    v = ".".join(parts)
+    if rng.random() < 0.3:
+        v += rng.choice(["~rc1", "~beta", "a", "b", "+dfsg"])
+    if rng.random() < 0.5:
+        v += "-" + str(rng.randint(0, 10))
+        if rng.random() < 0.3:
+            v += "+deb11u" + str(rng.randint(1, 5))
+    if rng.random() < 0.15:
+        v = f"{rng.randint(1, 3)}:{v}"
+    return v
+
+
+def _gen_rpm(rng):
+    v = ".".join(str(rng.randint(0, 20)) for _ in range(rng.randint(1, 4)))
+    if rng.random() < 0.25:
+        v += rng.choice(["~rc1", "^git1", "a", ".fc35"])
+    if rng.random() < 0.5:
+        v += "-" + rng.choice(["1", "2.el8", "80.el8_1", "0.1.rc2"])
+    if rng.random() < 0.15:
+        v = f"{rng.randint(1, 2)}:{v}"
+    return v
+
+
+def _gen_apk(rng):
+    v = ".".join(str(rng.randint(0, 20)) for _ in range(rng.randint(1, 3)))
+    if rng.random() < 0.2:
+        v += rng.choice("abcq")
+    if rng.random() < 0.3:
+        v += rng.choice(["_alpha", "_beta2", "_rc1", "_p1", "_git"])
+    if rng.random() < 0.5:
+        v += f"-r{rng.randint(0, 12)}"
+    return v
+
+
+def _gen_semver(rng):
+    v = ".".join(str(rng.randint(0, 20)) for _ in range(3))
+    if rng.random() < 0.3:
+        v += "-" + rng.choice(["alpha", "alpha.1", "beta.2", "rc.1", "1", "x.7.z.92"])
+    if rng.random() < 0.2:
+        v += "+build" + str(rng.randint(0, 9))
+    return v
+
+
+def _gen_pep440(rng):
+    v = ".".join(str(rng.randint(0, 20)) for _ in range(rng.randint(1, 3)))
+    if rng.random() < 0.25:
+        v += rng.choice(["a1", "b2", "rc1", ".post1", ".dev2", "a1.dev1"])
+    if rng.random() < 0.1:
+        v += "+local" + str(rng.randint(0, 5))
+    if rng.random() < 0.1:
+        v = f"{rng.randint(1, 2)}!{v}"
+    return v
+
+
+@pytest.mark.parametrize("eco,gen", [
+    ("debian", _gen_deb), ("redhat", _gen_rpm), ("alpine", _gen_apk),
+    ("npm", _gen_semver), ("pip", _gen_pep440),
+])
+def test_fuzz_token_order_matches_exact(eco, gen):
+    rng = random.Random(20260729)
+    versions = [gen(rng) for _ in range(300)]
+    keys = {}
+    for v in versions:
+        k = V.encode_version(eco, v)
+        assert k.exact, f"{eco}: {v!r} unexpectedly inexact"
+        keys[v] = k
+    for _ in range(3000):
+        a, b = rng.choice(versions), rng.choice(versions)
+        want = sign(V.compare(eco, a, b))
+        got = V.lex_cmp(keys[a].tokens, keys[b].tokens)
+        assert got == want, f"{eco}: {a!r} vs {b!r}: host={want} tokens={got}"
+
+
+def test_inexact_flag_on_overflow():
+    k = V.encode_version("npm", "1.0.{}".format(E.NUM_CAP + 5))
+    assert not k.exact
+
+
+def test_unparseable_raises():
+    with pytest.raises(ValueError):
+        V.encode_version("alpine", "not a version !!")
+    with pytest.raises(ValueError):
+        V.encode_version("debian", "x:1.0")  # non-numeric epoch
+    with pytest.raises(ValueError):
+        V.encode_version("debian", "1:")  # empty upstream

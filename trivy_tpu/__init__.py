@@ -1,0 +1,25 @@
+"""trivy_tpu — a TPU-native security-scanning framework.
+
+Capability-parity rebuild of Trivy (reference: fwereade/trivy, mounted at
+/root/reference) designed TPU-first:
+
+- the advisory database is flattened once into columnar device arrays
+  (`trivy_tpu.db`),
+- vulnerability detection is a batched hash-join plus vectorized
+  version-range comparison over all (package, advisory) pairs
+  (`trivy_tpu.ops.join`), jit-compiled and sharded over a
+  `jax.sharding.Mesh`,
+- secret scanning runs a device Aho-Corasick keyword prefilter over
+  chunked byte tensors (`trivy_tpu.ops.ac`) with host-side regex
+  confirmation for exact parity with the reference rule semantics,
+- artifact acquisition / parsing / report assembly stay on the host
+  (`trivy_tpu.fanal`, `trivy_tpu.report`).
+
+Layer map mirrors the reference (see SURVEY.md §1); the scan Driver
+boundary (reference pkg/scanner/scan.go:131) is preserved so a TPU
+service can slot behind the same client/server RPC surface.
+"""
+
+__version__ = "0.1.0"
+
+SCHEMA_VERSION = 2  # report schema version, reference pkg/types/report.go

@@ -1,0 +1,89 @@
+"""Version parsing and encoding for the TPU detection path.
+
+``encode(eco, v)`` turns a version string into a fixed-width int32 token
+vector (see encode.py for the invariant); ``compare(eco, a, b)`` is the
+exact host-side comparison used for ground-truth tests and as fallback for
+keys flagged inexact.
+
+Ecosystem scheme registry mirrors the reference's comparer tables:
+- OS families → pkg/detector/ospkg/detect.go:32-48 driver table
+- language ecosystems → pkg/detector/library/driver.go:25-95
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import apk, deb, encode, pep440, rpm, semver
+
+# scheme name -> module with tokenize()/cmp()
+_SCHEMES = {
+    "apk": apk,
+    "deb": deb,
+    "rpm": rpm,
+    "semver": semver,
+    "pep440": pep440,
+}
+
+# ecosystem/OS-family -> scheme (reference comparer tables)
+ECOSYSTEM_SCHEME = {
+    # OS families (pkg/detector/ospkg/detect.go:32-48)
+    "alpine": "apk", "wolfi": "apk", "chainguard": "apk",
+    "debian": "deb", "ubuntu": "deb",
+    "redhat": "rpm", "centos": "rpm", "rocky": "rpm", "alma": "rpm",
+    "oracle": "rpm", "amazon": "rpm", "fedora": "rpm",
+    "suse": "rpm", "opensuse": "rpm", "opensuse-leap": "rpm",
+    "opensuse-tumbleweed": "rpm", "suse linux enterprise server": "rpm",
+    "photon": "rpm", "cbl-mariner": "rpm", "azurelinux": "rpm",
+    # language ecosystems (pkg/detector/library/driver.go:25-95)
+    "npm": "semver", "yarn": "semver", "pnpm": "semver",
+    "gomod": "semver", "gobinary": "semver",
+    "cargo": "semver", "rust-binary": "semver",
+    "composer": "semver",
+    "nuget": "semver", "dotnet-core": "semver",
+    "conan": "semver", "swift": "semver", "cocoapods": "semver",
+    "pub": "semver", "hex": "semver", "mix": "semver",
+    "pip": "pep440", "pipenv": "pep440", "poetry": "pep440",
+    "python-pkg": "pep440", "conda-pkg": "pep440",
+}
+
+KEY_WIDTH = encode.KEY_WIDTH
+
+
+@dataclass
+class VersionKey:
+    tokens: np.ndarray  # int32[KEY_WIDTH]
+    exact: bool
+    raw: str
+
+
+def scheme_for(ecosystem: str):
+    name = ECOSYSTEM_SCHEME.get(ecosystem, ecosystem)
+    mod = _SCHEMES.get(name)
+    if mod is None:
+        raise KeyError(f"no version scheme for ecosystem {ecosystem!r}")
+    return mod
+
+
+def encode_version(ecosystem: str, v: str,
+                   width: int = KEY_WIDTH) -> VersionKey:
+    """Encode; raises ValueError if the version doesn't parse at all."""
+    mod = scheme_for(ecosystem)
+    try:
+        toks = mod.tokenize(v)
+    except encode.Inexact:
+        # representable structure, numeric overflow: emit best-effort prefix
+        vec = np.full(width, encode.PAD, dtype=np.int32)
+        return VersionKey(vec, exact=False, raw=v)
+    vec, exact = encode.pack(toks, width)
+    return VersionKey(vec, exact=exact, raw=v)
+
+
+def compare(ecosystem: str, a: str, b: str) -> int:
+    return scheme_for(ecosystem).cmp(a, b)
+
+
+def lex_cmp(a, b) -> int:
+    return encode.lex_cmp(a, b)

@@ -1,0 +1,121 @@
+"""Alpine (apk-tools) version ordering.
+
+Semantics follow apk-tools src/version.c (the reference consumes it through
+knqyf263/go-apk-version; driver: /root/reference/pkg/detector/ospkg/alpine/
+alpine.go:96-152, which compares installed source version against advisory
+FixedVersion/AffectedVersion).
+
+Grammar: ``digits{.digits}[letter]{_suffix[digits]}[-r digits]``.
+Suffix order: _alpha < _beta < _pre < _rc < (none) < _cvs < _svn < _git
+< _hg < _p. Numeric components after the first compare numerically unless
+either side has a leading zero, in which case they compare as decimal
+fractions (string-wise), per the Gentoo-style rule apk inherits.
+
+Token layout (positions align because later fields are reached only when
+all earlier fields tokenized identically):
+
+    [N(first)] [N|FRAC(part)...] EOC letter_slot (sfx_rank N(sfxnum))* SFXEND N(rev)
+
+Leading-zero parts use a FRAC zone below NUM: FRAC_BASE + part scaled to 6
+digits; parts longer than 6 digits are flagged inexact.
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import encode as E
+
+FRAC_BASE = 1 << 14
+
+SFX_ALPHA, SFX_BETA, SFX_PRE, SFX_RC = 4, 5, 6, 7
+SFX_END = 8
+SFX_CVS, SFX_SVN, SFX_GIT, SFX_HG, SFX_P = 9, 10, 11, 12, 13
+
+_SUFFIX_RANK = {
+    "alpha": SFX_ALPHA, "beta": SFX_BETA, "pre": SFX_PRE, "rc": SFX_RC,
+    "cvs": SFX_CVS, "svn": SFX_SVN, "git": SFX_GIT, "hg": SFX_HG, "p": SFX_P,
+}
+
+_RE = re.compile(
+    r"^(?P<parts>\d+(?:\.\d+)*)"
+    r"(?P<letter>[a-z])?"
+    r"(?P<suffixes>(?:_(?:alpha|beta|pre|rc|cvs|svn|git|hg|p)\d*)*)"
+    r"(?:-r(?P<rev>\d+))?$"
+)
+
+
+def _parse(v: str):
+    m = _RE.match(v)
+    if not m:
+        raise ValueError(f"invalid apk version: {v!r}")
+    parts = m.group("parts").split(".")
+    letter = m.group("letter") or ""
+    suffixes = []
+    sfx = m.group("suffixes")
+    if sfx:
+        for piece in sfx.split("_")[1:]:
+            mm = re.match(r"([a-z]+)(\d*)", piece)
+            suffixes.append((mm.group(1), int(mm.group(2) or 0)))
+    rev = int(m.group("rev") or 0)
+    return parts, letter, suffixes, rev
+
+
+def _part_tok(part: str, first: bool) -> int:
+    if first or part[0] != "0" or part == "0":
+        return E.num_tok(int(part))
+    # fractional (leading-zero) component: string-wise decimal fraction
+    if len(part) > 6:
+        raise E.Inexact(f"fractional component too long: {part!r}")
+    return FRAC_BASE + int((part + "000000")[:6])
+
+
+def tokenize(v: str) -> list[int]:
+    parts, letter, suffixes, rev = _parse(v)
+    toks = [_part_tok(parts[0], True)]
+    toks += [_part_tok(p, False) for p in parts[1:]]
+    toks.append(E.EOC)
+    toks.append(E.letter_tok(letter) if letter else E.EOC)
+    for name, num in suffixes:
+        toks.append(_SUFFIX_RANK[name])
+        toks.append(E.num_tok(num))
+    toks.append(SFX_END)
+    toks.append(E.num_tok(rev))
+    return toks
+
+
+# --- exact host comparator ---
+
+def _part_key(part: str, first: bool):
+    if first or part[0] != "0" or part == "0":
+        return (1, int(part), "")
+    # fractional: compare string-wise ("01" < "1", "09" > "0123")
+    return (0, 0, part.rstrip("0"))
+
+
+def cmp(a: str, b: str) -> int:
+    pa, la, sa, ra = _parse(a)
+    pb, lb, sb, rb = _parse(b)
+    for i in range(max(len(pa), len(pb))):
+        if i >= len(pa):
+            return -1
+        if i >= len(pb):
+            return 1
+        ka = _part_key(pa[i], i == 0)
+        kb = _part_key(pb[i], i == 0)
+        if ka != kb:
+            return -1 if ka < kb else 1
+    if la != lb:
+        return -1 if la < lb else 1
+    for i in range(max(len(sa), len(sb))):
+        ta = _SUFFIX_RANK[sa[i][0]] if i < len(sa) else SFX_END
+        tb = _SUFFIX_RANK[sb[i][0]] if i < len(sb) else SFX_END
+        if ta != tb:
+            return -1 if ta < tb else 1
+        na = sa[i][1] if i < len(sa) else 0
+        nb = sb[i][1] if i < len(sb) else 0
+        if na != nb:
+            return -1 if na < nb else 1
+    if ra != rb:
+        return -1 if ra < rb else 1
+    return 0

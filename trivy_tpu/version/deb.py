@@ -1,0 +1,126 @@
+"""Debian package version ordering (dpkg algorithm).
+
+Semantics follow Debian Policy §5.6.12 / dpkg lib/dpkg/version.c
+(the reference consumes it through knqyf263/go-deb-version; driver:
+/root/reference/pkg/detector/ospkg/debian/debian.go).
+
+A version is ``[epoch:]upstream[-revision]`` (revision split at the LAST
+hyphen). Upstream/revision compare with verrevcmp: alternate non-digit and
+digit chunks; non-digit chunks compare char-by-char in a modified alphabet
+(``~`` < end-of-chunk < letters < non-letters, each zone by ASCII); digit
+chunks compare numerically.
+
+Token layout: ``[N(epoch)] + verrev(upstream) + verrev(revision)`` where
+verrev emits, per alternating chunk: each non-digit char's token then EOC,
+then the digit chunk's NUM token. Positional alignment across versions is
+guaranteed because later fields are only reached when all earlier fields
+compare equal (hence tokenized identically).
+"""
+
+from __future__ import annotations
+
+from . import encode as E
+
+
+def _split(v: str) -> tuple[int, str, str]:
+    epoch = 0
+    rest = v
+    if ":" in rest:
+        e, rest = rest.split(":", 1)
+        if e.isdigit():
+            epoch = int(e)
+        else:
+            raise ValueError(f"invalid epoch in {v!r}")
+    upstream, revision = rest, ""
+    if "-" in rest:
+        upstream, revision = rest.rsplit("-", 1)
+    return epoch, upstream, revision
+
+
+def _chunks(s: str):
+    """Yield alternating (nondigit, digit) chunk pairs, starting non-digit."""
+    i, n = 0, len(s)
+    while i < n or i == 0:
+        j = i
+        while j < n and not s[j].isdigit():
+            j += 1
+        nondigit = s[i:j]
+        i = j
+        while j < n and s[j].isdigit():
+            j += 1
+        digit = s[i:j]
+        i = j
+        yield nondigit, digit
+        if i >= n:
+            break
+
+
+def _verrev_tokens(s: str) -> list[int]:
+    toks: list[int] = []
+    for nondigit, digit in _chunks(s):
+        for c in nondigit:
+            toks.append(E.deb_char_tok(c))
+        toks.append(E.EOC)
+        if digit:
+            toks.append(E.num_tok(int(digit)))
+    return toks
+
+
+def tokenize(v: str) -> list[int]:
+    epoch, upstream, revision = _split(v)
+    if not upstream:
+        raise ValueError(f"empty upstream version: {v!r}")
+    toks = [E.num_tok(epoch)]
+    toks += _verrev_tokens(upstream)
+    toks += _verrev_tokens(revision)
+    return toks
+
+
+# --- exact host comparator (ground truth / overflow fallback) ---
+
+def _order(c: str) -> int:
+    if c == "~":
+        return -1
+    if c.isalpha():
+        return ord(c)
+    return ord(c) + 256
+
+
+def _verrevcmp(a: str, b: str) -> int:
+    ia = ib = 0
+    while ia < len(a) or ib < len(b):
+        # non-digit part
+        while (ia < len(a) and not a[ia].isdigit()) or \
+              (ib < len(b) and not b[ib].isdigit()):
+            ca = _order(a[ia]) if ia < len(a) and not a[ia].isdigit() else 0
+            cb = _order(b[ib]) if ib < len(b) and not b[ib].isdigit() else 0
+            if ca != cb:
+                return -1 if ca < cb else 1
+            if ia < len(a) and not a[ia].isdigit():
+                ia += 1
+            if ib < len(b) and not b[ib].isdigit():
+                ib += 1
+        # digit part
+        ja = ia
+        while ja < len(a) and a[ja].isdigit():
+            ja += 1
+        jb = ib
+        while jb < len(b) and b[jb].isdigit():
+            jb += 1
+        na = int(a[ia:ja]) if ja > ia else 0
+        nb = int(b[ib:jb]) if jb > ib else 0
+        if na != nb:
+            return -1 if na < nb else 1
+        ia, ib = ja, jb
+    return 0
+
+
+def cmp(a: str, b: str) -> int:
+    ea, ua, ra = _split(a)
+    eb, ub, rb = _split(b)
+    if ea != eb:
+        return -1 if ea < eb else 1
+    c = _verrevcmp(ua, ub)
+    if c:
+        return c
+    return _verrevcmp(ra, rb)

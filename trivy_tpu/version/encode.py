@@ -1,0 +1,106 @@
+"""Version-key encoding: version strings → fixed-width int32 token vectors.
+
+The central invariant of the TPU detection path: for every ecosystem E and
+versions a, b parseable by E,
+
+    lex_cmp(tokens_E(a), tokens_E(b)) == cmp_E(a, b)
+
+where lex_cmp is plain elementwise-lexicographic comparison over the padded
+int32 vectors. This lets the device compare any (installed, fixed/affected)
+pair with a vectorized first-difference scan — no string work on device.
+
+Token value zones (shared across ecosystems; each tokenizer chooses how to
+use them but never mixes orderings within one ecosystem):
+
+    0           TILDE     sorts below absence (deb/rpm `~`)
+    1           PAD       absence / end-of-vector filler
+    2           EOC       end of an alpha chunk / generic low separator
+    3           CARET     rpm `^`: above base version (EOC/PAD), below any
+                          other addition
+    4..55       LETTER    deb-modified alphabet: A-Z → 4..29, a-z → 30..55
+    56..311     CHAR      56 + ord(c): raw ASCII zone (non-letters for deb,
+                          full ASCII for semver identifiers)
+    1<<20..     NUM       NUM_BASE + value, numeric components
+    RELEASE     (1<<30)   semver "no prerelease" marker
+
+Numeric components are capped at NUM_CAP; versions exceeding the cap or the
+vector width are flagged inexact and re-checked host-side with the exact
+comparator (see trivy_tpu.version.compare) — the device result is a superset
+filter for those rare rows.
+
+Reference semantics being reproduced (Go libs used by the reference,
+/root/reference/go.mod:14-18): go-deb-version, go-rpm-version,
+go-apk-version, go-npm-version, go-pep440-version.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TILDE = 0
+PAD = 1
+EOC = 2
+CARET = 3
+LETTER_BASE = 4          # A..Z -> 4..29, a..z -> 30..55
+CHAR_BASE = 56           # 56 + ord(c), raw ASCII zone
+NUM_BASE = 1 << 20
+NUM_CAP = (1 << 30) - NUM_BASE - 1
+RELEASE = 1 << 30        # semver: absence of prerelease
+
+KEY_WIDTH = 40           # default token-vector width
+
+
+class Inexact(Exception):
+    """Raised by tokenizers when a version cannot be represented exactly
+    (numeric overflow); the caller flags the key for host fallback."""
+
+
+def letter_tok(c: str) -> int:
+    """deb-modified alphabet: all letters sort before all non-letters."""
+    o = ord(c)
+    if 65 <= o <= 90:
+        return LETTER_BASE + (o - 65)
+    if 97 <= o <= 122:
+        return LETTER_BASE + 26 + (o - 97)
+    raise ValueError(f"not a letter: {c!r}")
+
+
+def deb_char_tok(c: str) -> int:
+    """deb order(): ~ < end < letters < non-letters (by ASCII)."""
+    if c == "~":
+        return TILDE
+    o = ord(c)
+    if (65 <= o <= 90) or (97 <= o <= 122):
+        return letter_tok(c)
+    return CHAR_BASE + o
+
+
+def ascii_char_tok(c: str) -> int:
+    """Raw ASCII ordering (semver alphanumeric identifiers)."""
+    return CHAR_BASE + ord(c)
+
+
+def num_tok(value: int) -> int:
+    if value > NUM_CAP:
+        raise Inexact(f"numeric component {value} exceeds device cap")
+    return NUM_BASE + value
+
+
+def pack(tokens: list[int], width: int = KEY_WIDTH) -> tuple[np.ndarray, bool]:
+    """Pad/truncate a token list to `width`; returns (vector, exact)."""
+    exact = len(tokens) <= width
+    out = np.full(width, PAD, dtype=np.int32)
+    n = min(len(tokens), width)
+    out[:n] = tokens[:n]
+    return out, exact
+
+
+def lex_cmp(a, b) -> int:
+    """Host-side reference of the device comparison (first difference wins)."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    neq = a != b
+    if not neq.any():
+        return 0
+    i = int(np.argmax(neq))
+    return -1 if a[i] < b[i] else 1

@@ -1,0 +1,122 @@
+"""PEP 440 ordering (pip/poetry/pipenv ecosystems).
+
+Semantics follow PEP 440 / pypa-packaging ``_cmpkey`` (the reference
+consumes it through aquasecurity/go-pep440-version; used by
+pkg/detector/library/compare/pep440/compare.go).
+
+Sort key: (epoch, release[trailing zeros stripped], pre, post, dev, local)
+with: dev-only < aN < bN < rcN < final < postN; a ``.devM`` sub-release
+sorts just below its base; local versions sort above their base, segments
+numeric > alphanumeric.
+
+Token layout:
+    [N(epoch)] [N(release part)...] EOC
+    pre_slot N(pre_num)      pre_slot: dev-only→4, a→5, b→6, rc→7, none→8
+    post_slot N(post_num)    post_slot: none→4, post→5
+    dev_slot N(dev_num)      dev_slot: dev→4, none→5
+    local_slot [segments]    local_slot: none→4, present→5; segment:
+                             alnum→[4, ascii..., EOC], num→[5, N(v)]; EOC ends
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import encode as E
+
+_RE = re.compile(
+    r"^v?(?:(?P<epoch>\d+)!)?"
+    r"(?P<release>\d+(?:\.\d+)*)"
+    r"(?:[-_.]?(?P<pre_l>a|alpha|b|beta|c|rc|pre|preview)[-_.]?(?P<pre_n>\d+)?)?"
+    r"(?:(?:-(?P<post_n1>\d+))|(?:[-_.]?(?P<post_l>post|rev|r)[-_.]?(?P<post_n2>\d+)?))?"
+    r"(?:[-_.]?(?P<dev_l>dev)[-_.]?(?P<dev_n>\d+)?)?"
+    r"(?:\+(?P<local>[a-z0-9]+(?:[-_.][a-z0-9]+)*))?$",
+    re.IGNORECASE,
+)
+
+_PRE_NORM = {"a": "a", "alpha": "a", "b": "b", "beta": "b",
+             "c": "rc", "rc": "rc", "pre": "rc", "preview": "rc"}
+_PRE_TOK = {"a": 5, "b": 6, "rc": 7}
+PRE_DEVONLY, PRE_NONE = 4, 8
+POST_NONE, POST = 4, 5
+DEV, DEV_NONE = 4, 5
+LOCAL_NONE, LOCAL = 4, 5
+SEG_ALNUM, SEG_NUM = 4, 5
+
+
+def _parse(v: str):
+    m = _RE.match(v.strip().lower())
+    if not m:
+        raise ValueError(f"invalid pep440 version: {v!r}")
+    epoch = int(m.group("epoch") or 0)
+    release = [int(x) for x in m.group("release").split(".")]
+    while len(release) > 1 and release[-1] == 0:
+        release.pop()
+    pre = None
+    if m.group("pre_l"):
+        pre = (_PRE_NORM[m.group("pre_l")], int(m.group("pre_n") or 0))
+    post = None
+    if m.group("post_n1"):
+        post = int(m.group("post_n1"))
+    elif m.group("post_l"):
+        post = int(m.group("post_n2") or 0)
+    dev = int(m.group("dev_n") or 0) if m.group("dev_l") else None
+    local = m.group("local")
+    segments = re.split(r"[-_.]", local) if local else []
+    return epoch, release, pre, post, dev, segments
+
+
+def tokenize(v: str) -> list[int]:
+    epoch, release, pre, post, dev, local = _parse(v)
+    toks = [E.num_tok(epoch)]
+    toks += [E.num_tok(p) for p in release]
+    toks.append(E.EOC)
+    if pre is not None:
+        toks += [_PRE_TOK[pre[0]], E.num_tok(pre[1])]
+    elif post is None and dev is not None:
+        toks += [PRE_DEVONLY, E.num_tok(0)]
+    else:
+        toks += [PRE_NONE, E.num_tok(0)]
+    if post is None:
+        toks += [POST_NONE, E.num_tok(0)]
+    else:
+        toks += [POST, E.num_tok(post)]
+    if dev is None:
+        toks += [DEV_NONE, E.num_tok(0)]
+    else:
+        toks += [DEV, E.num_tok(dev)]
+    if not local:
+        toks.append(LOCAL_NONE)
+    else:
+        toks.append(LOCAL)
+        for seg in local:
+            if seg.isdigit():
+                toks += [SEG_NUM, E.num_tok(int(seg))]
+            else:
+                toks.append(SEG_ALNUM)
+                toks.extend(E.ascii_char_tok(c) for c in seg)
+                toks.append(E.EOC)
+        toks.append(E.EOC)
+    return toks
+
+
+def _key(v: str):
+    epoch, release, pre, post, dev, local = _parse(v)
+    if pre is None and post is None and dev is not None:
+        kpre = (-2, 0)
+    elif pre is None:
+        kpre = (1, 0)
+    else:
+        kpre = (0, {"a": 0, "b": 1, "rc": 2}[pre[0]], pre[1])
+    kpost = (-1,) if post is None else (0, post)
+    kdev = (1,) if dev is None else (0, dev)
+    klocal = ((-1,),) if not local else tuple(
+        (1, int(s)) if s.isdigit() else (0, s) for s in local)
+    return (epoch, tuple(release), kpre, kpost, kdev, klocal)
+
+
+def cmp(a: str, b: str) -> int:
+    ka, kb = _key(a), _key(b)
+    if ka == kb:
+        return 0
+    return -1 if ka < kb else 1

@@ -1,0 +1,143 @@
+"""RPM version ordering (rpmvercmp algorithm).
+
+Semantics follow rpm's lib/rpmvercmp.c (the reference consumes it through
+knqyf263/go-rpm-version; drivers: redhat/oracle/amazon/suse/photon under
+/root/reference/pkg/detector/ospkg/).
+
+A label is ``[epoch:]version[-release]``. Each of version/release is walked
+as segments of digits or letters (every other byte is a separator, except
+``~`` — sorts before everything — and ``^`` — sorts after the base but
+before any further addition). Digit segments compare numerically; letter
+segments compare by strcmp; a digit segment beats a letter segment; if one
+label is a prefix of the other, the longer one is newer (unless the next
+token is ``~``).
+
+Token layout: ``[N(epoch)] + seg(version) + [EOC] + seg(release)``. A digit
+segment emits one NUM token; a letter segment emits letter tokens then EOC;
+``~`` emits TILDE and ``^`` emits CARET inline. The EOC between version and
+release only matters when versions are token-identical, so alignment holds.
+"""
+
+from __future__ import annotations
+
+from . import encode as E
+
+
+def _split(v: str) -> tuple[int, str, str]:
+    epoch = 0
+    rest = v
+    if ":" in rest:
+        e, rest = rest.split(":", 1)
+        epoch = int(e) if e.isdigit() else 0
+    version, release = rest, ""
+    if "-" in rest:
+        version, release = rest.split("-", 1)
+    return epoch, version, release
+
+
+def _segments(s: str):
+    """Yield ('num', int) / ('alpha', str) / ('tilde',) / ('caret',)."""
+    i, n = 0, len(s)
+    while i < n:
+        c = s[i]
+        if c == "~":
+            yield ("tilde",)
+            i += 1
+        elif c == "^":
+            yield ("caret",)
+            i += 1
+        elif c.isdigit():
+            j = i
+            while j < n and s[j].isdigit():
+                j += 1
+            yield ("num", int(s[i:j]))
+            i = j
+        elif c.isalpha():
+            j = i
+            while j < n and s[j].isalpha():
+                j += 1
+            yield ("alpha", s[i:j])
+            i = j
+        else:
+            i += 1  # separator
+
+
+def _seg_tokens(s: str) -> list[int]:
+    toks: list[int] = []
+    for seg in _segments(s):
+        kind = seg[0]
+        if kind == "tilde":
+            toks.append(E.TILDE)
+        elif kind == "caret":
+            toks.append(E.CARET)
+        elif kind == "num":
+            toks.append(E.num_tok(seg[1]))
+        else:
+            toks.extend(E.letter_tok(c) for c in seg[1])
+            toks.append(E.EOC)
+    return toks
+
+
+def tokenize(v: str) -> list[int]:
+    epoch, version, release = _split(v)
+    toks = [E.num_tok(epoch)]
+    toks += _seg_tokens(version)
+    toks.append(E.EOC)
+    toks += _seg_tokens(release)
+    return toks
+
+
+# --- exact host comparator ---
+
+def _rpmvercmp(a: str, b: str) -> int:
+    sa = list(_segments(a))
+    sb = list(_segments(b))
+    i = 0
+    while True:
+        ta = sa[i] if i < len(sa) else None
+        tb = sb[i] if i < len(sb) else None
+        if ta is None and tb is None:
+            return 0
+        # tilde sorts before everything, including end
+        a_tilde = ta is not None and ta[0] == "tilde"
+        b_tilde = tb is not None and tb[0] == "tilde"
+        if a_tilde or b_tilde:
+            if a_tilde and b_tilde:
+                i += 1
+                continue
+            return -1 if a_tilde else 1
+        # caret: above base, below any addition
+        a_caret = ta is not None and ta[0] == "caret"
+        b_caret = tb is not None and tb[0] == "caret"
+        if a_caret or b_caret:
+            if a_caret and b_caret:
+                i += 1
+                continue
+            if ta is None:
+                return -1  # b has caret addition -> b newer
+            if tb is None:
+                return 1
+            return -1 if a_caret else 1
+        if ta is None:
+            return -1
+        if tb is None:
+            return 1
+        if ta[0] != tb[0]:
+            # numeric segment beats alpha segment
+            return 1 if ta[0] == "num" else -1
+        if ta[1] != tb[1]:
+            if ta[0] == "num":
+                return -1 if ta[1] < tb[1] else 1
+            return -1 if ta[1] < tb[1] else 1
+        i += 1
+
+
+def cmp(a: str, b: str) -> int:
+    ea, va, ra = _split(a)
+    eb, vb, rb = _split(b)
+    if ea != eb:
+        return -1 if ea < eb else 1
+    c = _rpmvercmp(va, vb)
+    if c:
+        return c
+    return _rpmvercmp(ra, rb)

@@ -1,0 +1,85 @@
+"""SemVer 2.0 ordering (npm and most GHSA ecosystems).
+
+Semantics follow semver.org §11 (the reference consumes it through
+masahiro331/go-mvn-version siblings and aquasecurity/go-npm-version; used by
+pkg/detector/library/compare/npm/compare.go).
+
+Token layout: ``[N(major) N(minor) N(patch)] + prerelease`` where
+prerelease is RELEASE (1<<30) when absent, else per dot-separated
+identifier: numeric → ``[4, N(value)]``, alphanumeric → ``[5, ascii
+chars..., EOC]``, with a trailing EOC ending the identifier list (so
+``1.0.0-alpha < 1.0.0-alpha.1``). Build metadata (``+...``) is ignored.
+
+Accepts loose 1-3 part cores (``1.0`` ≙ ``1.0.0``) since advisory ranges
+use them.
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import encode as E
+
+IDENT_NUM = 4
+IDENT_ALNUM = 5
+
+_RE = re.compile(
+    r"^v?(?P<core>\d+(?:\.\d+){0,2})"
+    r"(?:-(?P<pre>[0-9A-Za-z.-]+))?"
+    r"(?:\+(?P<build>[0-9A-Za-z.-]+))?$"
+)
+
+
+def _parse(v: str):
+    m = _RE.match(v.strip())
+    if not m:
+        raise ValueError(f"invalid semver: {v!r}")
+    nums = [int(x) for x in m.group("core").split(".")]
+    while len(nums) < 3:
+        nums.append(0)
+    pre = m.group("pre")
+    idents = pre.split(".") if pre else []
+    return nums, idents
+
+
+def tokenize(v: str) -> list[int]:
+    nums, idents = _parse(v)
+    toks = [E.num_tok(n) for n in nums]
+    if not idents:
+        toks.append(E.RELEASE)
+        return toks
+    for ident in idents:
+        if ident.isdigit():
+            toks.append(IDENT_NUM)
+            toks.append(E.num_tok(int(ident)))
+        else:
+            toks.append(IDENT_ALNUM)
+            toks.extend(E.ascii_char_tok(c) for c in ident)
+            toks.append(E.EOC)
+    toks.append(E.EOC)
+    return toks
+
+
+def cmp(a: str, b: str) -> int:
+    na, ia = _parse(a)
+    nb, ib = _parse(b)
+    if na != nb:
+        return -1 if na < nb else 1
+    if not ia and not ib:
+        return 0
+    if not ia:
+        return 1
+    if not ib:
+        return -1
+    for x, y in zip(ia, ib):
+        xd, yd = x.isdigit(), y.isdigit()
+        if xd and yd:
+            if int(x) != int(y):
+                return -1 if int(x) < int(y) else 1
+        elif xd != yd:
+            return -1 if xd else 1  # numeric identifiers sort lower
+        elif x != y:
+            return -1 if x < y else 1
+    if len(ia) != len(ib):
+        return -1 if len(ia) < len(ib) else 1
+    return 0
