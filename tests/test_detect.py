@@ -1,0 +1,180 @@
+"""Batched detection tests against authored DB fixtures (tier-2 analogue of
+the reference's pkg/detector/ospkg/* fixture tests)."""
+
+import glob
+import os
+
+import pytest
+
+from trivy_tpu import types as T
+from trivy_tpu.db import build_table
+from trivy_tpu.db.fixtures import load_fixture_files
+from trivy_tpu.detect import BatchDetector, PkgQuery
+from trivy_tpu.detect.ospkg import OspkgScanner
+
+FIXTURES = sorted(glob.glob(
+    os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")))
+
+
+@pytest.fixture(scope="module")
+def table():
+    advisories, details, _ = load_fixture_files(FIXTURES)
+    t = build_table(advisories, details)
+    assert len(t) > 0
+    return t
+
+
+@pytest.fixture(scope="module")
+def detector(table):
+    return BatchDetector(table)
+
+
+def vuln_ids(vulns):
+    return sorted(v.vulnerability_id for v in vulns)
+
+
+class TestAlpine:
+    def scan(self, detector, pkgs, os_name="3.17.3"):
+        scanner = OspkgScanner(detector)
+        vulns, _ = scanner.scan(T.OS(family="alpine", name=os_name), None, pkgs)
+        return vulns
+
+    def test_vulnerable_and_fixed(self, detector):
+        pkgs = [
+            T.Package(name="openssl", src_name="openssl", version="3.0.7-r0"),
+            T.Package(name="musl", src_name="musl", version="1.2.3-r4"),
+            T.Package(name="zlib", src_name="zlib", version="1.2.12-r2"),
+        ]
+        vulns = self.scan(detector, pkgs)
+        # openssl 3.0.7-r0 < 3.0.8-r0 and < 3.0.9-r0 → both CVEs
+        # musl 1.2.3-r4 < 1.2.3_git20230424-r5? _git suffix > none → vulnerable
+        # zlib 1.2.12-r2 == fixed → NOT vulnerable
+        assert vuln_ids(vulns) == ["CVE-2023-0286", "CVE-2023-2650",
+                                   "CVE-2025-26519"]
+
+    def test_boundary_exact_fix(self, detector):
+        pkgs = [T.Package(name="openssl", src_name="openssl",
+                          version="3.0.8-r0")]
+        vulns = self.scan(detector, pkgs)
+        assert vuln_ids(vulns) == ["CVE-2023-2650"]  # only < 3.0.9-r0
+
+    def test_stream_selection(self, detector):
+        pkgs = [T.Package(name="openssl", src_name="openssl",
+                          version="3.0.8-r0")]
+        vulns = self.scan(detector, pkgs, os_name="3.18.2")
+        assert vuln_ids(vulns) == ["CVE-2023-2650"]
+
+    def test_src_name_join(self, detector):
+        # subpackage joins via SrcName (alpine.go:87-90)
+        pkgs = [T.Package(name="libcrypto3", src_name="openssl",
+                          version="3.0.7-r0")]
+        vulns = self.scan(detector, pkgs)
+        assert vuln_ids(vulns) == ["CVE-2023-0286", "CVE-2023-2650"]
+        assert vulns[0].pkg_name == "libcrypto3"
+
+    def test_edge_repository_override(self, detector):
+        scanner = OspkgScanner(detector)
+        vulns, _ = scanner.scan(
+            T.OS(family="alpine", name="3.17.0"),
+            T.Repository(family="alpine", release="edge"),
+            [T.Package(name="busybox", src_name="busybox",
+                       version="1.36.0-r0")])
+        assert vuln_ids(vulns) == ["CVE-2022-48174"]
+
+    def test_fill_fields(self, detector):
+        pkgs = [T.Package(id="openssl@3.0.7-r0", name="openssl",
+                          src_name="openssl", version="3.0.7-r0",
+                          layer=T.Layer(diff_id="sha256:abc"))]
+        vulns = self.scan(detector, pkgs)
+        v = next(x for x in vulns if x.vulnerability_id == "CVE-2023-0286")
+        assert v.fixed_version == "3.0.8-r0"
+        assert v.installed_version == "3.0.7-r0"
+        assert v.pkg_id == "openssl@3.0.7-r0"
+        assert v.layer.diff_id == "sha256:abc"
+        assert v.data_source.id == "alpine"
+
+
+class TestDebian:
+    def scan(self, detector, pkgs, os_name="11.6"):
+        scanner = OspkgScanner(detector)
+        vulns, _ = scanner.scan(T.OS(family="debian", name=os_name), None, pkgs)
+        return vulns
+
+    def test_fixed_and_unfixed(self, detector):
+        pkgs = [
+            T.Package(name="openssl", src_name="openssl",
+                      version="1.1.1n", release="0+deb11u3"),
+            T.Package(name="bash", src_name="bash", version="5.1-2+deb11u1"),
+        ]
+        vulns = self.scan(detector, pkgs)
+        ids = vuln_ids(vulns)
+        # openssl: fixed CVE-2022-4450 (installed < 1.1.1n-0+deb11u4) +
+        #          unfixed CVE-2023-0464; bash: unfixed CVE-2022-3715
+        assert ids == ["CVE-2022-3715", "CVE-2022-4450", "CVE-2023-0464"]
+
+    def test_unfixed_severity_and_status(self, detector):
+        vulns = self.scan(detector, [
+            T.Package(name="bash", src_name="bash", version="5.1-2+deb11u1")])
+        v = vulns[0]
+        assert v.status == "fix_deferred"
+        assert v.vulnerability.severity == "LOW"
+        assert v.severity_source == "debian"
+
+    def test_epoch_version(self, detector):
+        # installed 1:1.1.1n-0+deb11u4 has epoch 1 > fixed (epoch 0) → not vuln
+        vulns = self.scan(detector, [
+            T.Package(name="openssl", src_name="openssl", epoch=1,
+                      version="1.1.1n", release="0+deb11u4")])
+        assert vuln_ids(vulns) == ["CVE-2023-0464"]
+
+    def test_vendor_ids(self, detector):
+        vulns = self.scan(detector, [
+            T.Package(name="glibc", src_name="glibc",
+                      version="2.31-13+deb11u5")])
+        assert vulns[0].vendor_ids == ["DSA-5514-1"]
+
+
+class TestLibrary:
+    def test_pip_ranges(self, detector):
+        qs = [
+            PkgQuery(source="pip::GitHub Security Advisory Pip",
+                     ecosystem="pip", name="flask", version="2.3.1", ref=0),
+            PkgQuery(source="pip::GitHub Security Advisory Pip",
+                     ecosystem="pip", name="flask", version="2.2.5", ref=1),
+            PkgQuery(source="pip::GitHub Security Advisory Pip",
+                     ecosystem="pip", name="flask", version="2.2.2", ref=2),
+            PkgQuery(source="pip::GitHub Security Advisory Pip",
+                     ecosystem="pip", name="requests", version="2.30.0", ref=3),
+        ]
+        hits = detector.detect(qs)
+        got = sorted((h.query.ref, h.vuln_id) for h in hits)
+        assert got == [(0, "CVE-2023-30861"), (2, "CVE-2023-30861"),
+                       (3, "CVE-2023-32681")]
+
+    def test_npm(self, detector):
+        qs = [PkgQuery(source="npm::GitHub Security Advisory Npm",
+                       ecosystem="npm", name="lodash", version="4.17.20")]
+        hits = detector.detect(qs)
+        assert [h.vuln_id for h in hits] == ["CVE-2021-23337"]
+        assert hits[0].fixed_version == "4.17.21"
+
+    def test_unknown_package(self, detector):
+        qs = [PkgQuery(source="pip::GitHub Security Advisory Pip",
+                       ecosystem="pip", name="nonexistent", version="1.0")]
+        assert detector.detect(qs) == []
+
+
+class TestTableRoundtrip:
+    def test_save_load(self, table, tmp_path):
+        from trivy_tpu.db import AdvisoryTable
+        p = tmp_path / "db.npz"
+        table.save(str(p))
+        t2 = AdvisoryTable.load(str(p))
+        assert len(t2) == len(table)
+        assert t2.window == table.window
+        d = BatchDetector(t2)
+        hits = d.detect([PkgQuery(
+            source="alpine 3.17", ecosystem="alpine",
+            name="openssl", version="3.0.7-r0")])
+        assert sorted(h.vuln_id for h in hits) == \
+            ["CVE-2023-0286", "CVE-2023-2650"]

@@ -1,0 +1,5 @@
+"""Advisory database: flattening trivy-db's nested BoltDB buckets
+(source → package → CVE, see reference integration/testdata/fixtures/db/)
+into hash-sorted columnar arrays resident in device HBM."""
+
+from .table import AdvisoryTable, RawAdvisory, build_table  # noqa: F401

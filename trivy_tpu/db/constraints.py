@@ -1,0 +1,78 @@
+"""Version-range constraint parsing → interval rows.
+
+The reference's generic comparer (pkg/detector/library/compare/compare.go:
+21-55) joins constraint sets with "||" (OR); each branch is a
+comma/space-separated conjunction of ``(op, version)`` terms. OS advisories
+are a special case: FixedVersion ⇒ ``< fixed``, AffectedVersion ⇒
+``>= affected``.
+
+Intervals are half-open/closed bounds: (lo, lo_incl, hi, hi_incl) with None
+meaning unbounded. An OR of conjunctions maps to one interval row per
+branch; rows for "patched"/"unaffected" sets are emitted with negative
+polarity and subtracted host-side during assembly.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class Interval:
+    lo: Optional[str] = None
+    lo_incl: bool = False
+    hi: Optional[str] = None
+    hi_incl: bool = False
+
+
+_TERM = re.compile(r"^(>=|<=|==|!=|>|<|=|\^|~>?)?\s*(.+)$")
+
+
+def parse_constraint(spec: str) -> list[Interval]:
+    """Parse a constraint-set string into OR'd intervals.
+
+    Supports the operator grammar trivy-db data uses: ``>=``, ``>``, ``<=``,
+    ``<``, ``=``/``==``, bare version (equality). ``^``/``~`` (caret/tilde
+    ranges) and ``!=`` are not representable as a single interval and raise.
+    """
+    out = []
+    for branch in spec.split("||"):
+        branch = branch.strip()
+        if not branch:
+            continue
+        iv = Interval()
+        # conjunction terms separated by commas and/or whitespace, but
+        # versions may contain spaces only when quoted (they don't in trivy-db)
+        terms = [t for t in re.split(r"[,\s]+", branch) if t]
+        # re-join operator split from its version ("< 1.2" → "<", "1.2")
+        merged, i = [], 0
+        while i < len(terms):
+            t = terms[i]
+            if t in (">=", "<=", ">", "<", "=", "==", "!="):
+                if i + 1 >= len(terms):
+                    raise ValueError(f"dangling operator in {spec!r}")
+                merged.append(t + terms[i + 1])
+                i += 2
+            else:
+                merged.append(t)
+                i += 1
+        for term in merged:
+            m = _TERM.match(term)
+            op, ver = m.group(1) or "=", m.group(2).strip()
+            if op in ("^", "~", "~>", "!="):
+                raise ValueError(f"unsupported operator {op!r} in {spec!r}")
+            if op == ">":
+                iv.lo, iv.lo_incl = ver, False
+            elif op == ">=":
+                iv.lo, iv.lo_incl = ver, True
+            elif op == "<":
+                iv.hi, iv.hi_incl = ver, False
+            elif op == "<=":
+                iv.hi, iv.hi_incl = ver, True
+            else:  # = / ==
+                iv.lo, iv.lo_incl = ver, True
+                iv.hi, iv.hi_incl = ver, True
+        out.append(iv)
+    return out

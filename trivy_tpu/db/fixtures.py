@@ -1,0 +1,123 @@
+"""Loader for bolt-fixtures-shaped advisory YAML.
+
+The reference's tier-2 tests build a real BoltDB from YAML fixtures
+(pkg/dbtest/db.go:17-36, fixture shape integration/testdata/fixtures/db/).
+We load the same document shape straight into RawAdvisory rows + the
+vulnerability-detail dict — the YAML *is* our DB interchange format until
+the OCI trivy-db download path lands.
+
+Document shape:
+    - bucket: <source>            # "alpine 3.9", "debian 9", "pip::GHSA..."
+      pairs:
+        - bucket: <package name>
+          pairs:
+            - key: <vuln id>
+              value: {FixedVersion | VulnerableVersions/PatchedVersions |
+                      Severity | Status | VendorIDs ...}
+Special top-level buckets: "vulnerability" (detail rows), "data-source".
+"""
+
+from __future__ import annotations
+
+import yaml
+
+from .table import RawAdvisory
+
+# trivy-db pkg/types/status.go enum order
+STATUSES = ["unknown", "not_affected", "affected", "fixed",
+            "under_investigation", "will_not_fix", "fix_deferred",
+            "end_of_life"]
+SEVERITIES = ["UNKNOWN", "LOW", "MEDIUM", "HIGH", "CRITICAL"]
+
+
+def _severity_name(v) -> str:
+    if v in (None, ""):
+        return ""
+    try:
+        return SEVERITIES[int(float(v))]
+    except (ValueError, IndexError):
+        return str(v)
+
+
+def ecosystem_for_source(source: str) -> str:
+    """Map a bucket name to a version scheme key."""
+    if "::" in source:
+        return source.split("::", 1)[0]  # "pip::GHSA Pip" → "pip"
+    family = source.rsplit(" ", 1)[0].lower() if " " in source else source.lower()
+    return family
+
+
+def load_fixture_docs(docs: list) -> tuple[list[RawAdvisory], dict, dict]:
+    """→ (advisories, details{vuln_id: value}, data_sources{key: value})."""
+    advisories: list[RawAdvisory] = []
+    details: dict = {}
+    sources: dict = {}
+    # pass 1: detail + data-source buckets (keyed by source bucket name,
+    # attached to each advisory at query time in trivy-db)
+    for top in docs:
+        if top["bucket"] == "vulnerability":
+            for pair in top.get("pairs", []):
+                details[pair["key"]] = pair.get("value", {})
+        elif top["bucket"] == "data-source":
+            for pair in top.get("pairs", []):
+                sources[pair["key"]] = pair.get("value", {})
+    for top in docs:
+        bucket = top["bucket"]
+        if bucket in ("vulnerability", "data-source"):
+            continue
+        data_source = sources.get(bucket)
+        eco = ecosystem_for_source(bucket)
+        for pkg in top.get("pairs", []):
+            name = pkg["bucket"]
+            for pair in pkg.get("pairs", []):
+                v = pair.get("value") or {}
+                if "Entries" in v:
+                    continue  # Red Hat content-set schema: later round
+                status = ""
+                if "Status" in v:
+                    try:
+                        status = STATUSES[int(v["Status"])]
+                    except (ValueError, IndexError):
+                        status = ""
+                vuln_ranges = ""
+                patched = ""
+                unaffected = ""
+                if v.get("VulnerableVersions"):
+                    vuln_ranges = " || ".join(v["VulnerableVersions"])
+                if v.get("PatchedVersions"):
+                    patched = " || ".join(v["PatchedVersions"])
+                if v.get("UnaffectedVersions"):
+                    unaffected = " || ".join(v["UnaffectedVersions"])
+                advisories.append(RawAdvisory(
+                    source=bucket,
+                    ecosystem=eco,
+                    pkg_name=name,
+                    vuln_id=pair["key"],
+                    fixed_version=v.get("FixedVersion", "") or "",
+                    affected_version=v.get("AffectedVersion", "") or "",
+                    vulnerable_ranges=vuln_ranges,
+                    patched_versions=patched,
+                    unaffected_versions=unaffected,
+                    status=status,
+                    severity=_severity_name(v.get("Severity")),
+                    data_source=_ds_fields(data_source),
+                    vendor_ids=tuple(v.get("VendorIDs") or ()),
+                ))
+    return advisories, details, sources
+
+
+def _ds_fields(ds: dict | None) -> dict | None:
+    if not ds:
+        return None
+    return {"id": ds.get("ID", ""), "name": ds.get("Name", ""),
+            "url": ds.get("URL", "")}
+
+
+def load_fixture_files(paths: list[str]):
+    docs = []
+    for p in paths:
+        with open(p) as f:
+            loaded = yaml.safe_load(f)
+            if loaded:
+                docs.extend(loaded)
+    return load_fixture_docs(docs)

@@ -1,0 +1,245 @@
+"""Columnar advisory table: the device-resident flattening of trivy-db.
+
+The reference keeps advisories in nested BoltDB buckets and does random
+access per package (trivy-db pkg/vulnsrc; fixture shape:
+integration/testdata/fixtures/db/alpine.yaml). Here the whole DB is
+flattened once at load time into hash-sorted arrays (SURVEY.md §7 step 2):
+
+    hash[A, 2]      fnv1a64(source + "\\0" + pkg_name) as (hi, lo) int32
+    lo_tok[A, K]    lower-bound version tokens
+    hi_tok[A, K]    upper-bound version tokens
+    flags[A]        interval shape + polarity + inexact bits (ops.join)
+    group[A]        advisory group id (one advisory may span several rows)
+
+plus host-side metadata per group (vuln id, package name for collision
+verification, report strings) and a vulnerability-detail dict for FillInfo
+(reference pkg/vulnerability/vulnerability.go:60).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .. import version as V
+from ..ops import join as J
+from ..ops.hashing import key_hash, split_u64
+from .constraints import Interval, parse_constraint
+
+KEY_WIDTH = V.KEY_WIDTH
+
+
+@dataclass
+class RawAdvisory:
+    """One advisory as found in the source DB (one per (source, pkg, vuln))."""
+    source: str                 # bucket, e.g. "alpine 3.9" or "pip::"
+    ecosystem: str              # version scheme key, e.g. "alpine", "pip"
+    pkg_name: str
+    vuln_id: str
+    fixed_version: str = ""     # OS style
+    affected_version: str = ""  # OS style
+    vulnerable_ranges: str = ""  # language style constraint set ("||" OR)
+    patched_versions: str = ""   # language style
+    unaffected_versions: str = ""
+    status: str = ""
+    severity: str = ""           # source-provided severity (e.g. distro)
+    data_source: Optional[dict] = None
+    vendor_ids: tuple = ()
+
+
+@dataclass
+class AdvisoryGroup:
+    """Host metadata for one advisory (row group)."""
+    source: str
+    ecosystem: str
+    pkg_name: str
+    vuln_id: str
+    fixed_version: str
+    status: str
+    severity: str
+    data_source: Optional[dict]
+    vendor_ids: tuple
+    # raw bound strings per row for exact host recheck of inexact rows
+    rows: list = field(default_factory=list)  # [(polarity, Interval)]
+
+
+class AdvisoryTable:
+    def __init__(self, hash_: np.ndarray, lo_tok, hi_tok, flags, group,
+                 groups: list[AdvisoryGroup], window: int,
+                 details: dict | None = None):
+        self.hash = hash_
+        self.lo_tok = lo_tok
+        self.hi_tok = hi_tok
+        self.flags = flags
+        self.group = group
+        self.groups = groups
+        self.window = max(window, 1)
+        self.details = details or {}
+        self._device = None
+
+    def __len__(self):
+        return self.hash.shape[0]
+
+    def device_arrays(self):
+        """device_put once, reuse across batches (double-buffer swap point
+        for DB hot reload, reference pkg/rpc/server/listen.go:129-192)."""
+        if self._device is None:
+            import jax
+            self._device = tuple(jax.device_put(x) for x in
+                                 (self.hash, self.lo_tok, self.hi_tok,
+                                  self.flags))
+        return self._device
+
+    def save(self, path: str):
+        np.savez_compressed(
+            path,
+            hash=self.hash, lo_tok=self.lo_tok, hi_tok=self.hi_tok,
+            flags=self.flags, group=self.group,
+            meta=np.frombuffer(json.dumps({
+                "window": self.window,
+                "groups": [
+                    {"source": g.source, "ecosystem": g.ecosystem,
+                     "pkg_name": g.pkg_name, "vuln_id": g.vuln_id,
+                     "fixed_version": g.fixed_version, "status": g.status,
+                     "severity": g.severity, "data_source": g.data_source,
+                     "vendor_ids": list(g.vendor_ids),
+                     "rows": [[p, iv.lo, iv.lo_incl, iv.hi, iv.hi_incl]
+                              for p, iv in g.rows]}
+                    for g in self.groups
+                ],
+                "details": self.details,
+            }).encode(), dtype=np.uint8),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "AdvisoryTable":
+        z = np.load(path, allow_pickle=False)
+        meta = json.loads(bytes(z["meta"]).decode())
+        groups = [
+            AdvisoryGroup(
+                source=g["source"], ecosystem=g["ecosystem"],
+                pkg_name=g["pkg_name"], vuln_id=g["vuln_id"],
+                fixed_version=g["fixed_version"], status=g["status"],
+                severity=g["severity"], data_source=g["data_source"],
+                vendor_ids=tuple(g["vendor_ids"]),
+                rows=[(p, Interval(lo, li, hi, hi_i))
+                      for p, lo, li, hi, hi_i in g["rows"]],
+            )
+            for g in meta["groups"]
+        ]
+        return cls(z["hash"], z["lo_tok"], z["hi_tok"], z["flags"],
+                   z["group"], groups, meta["window"],
+                   meta.get("details", {}))
+
+
+def _encode_bound(ecosystem: str, v: Optional[str]):
+    """→ (tokens or None, exact). None tokens means unparseable (drop row,
+    matching the reference's skip-on-parse-failure)."""
+    if not v:
+        return None, True
+    try:
+        k = V.encode_version(ecosystem, v)
+    except (ValueError, KeyError):
+        return None, False
+    return k.tokens, k.exact
+
+
+def build_table(raw: list[RawAdvisory], details: dict | None = None,
+                key_width: int = KEY_WIDTH) -> AdvisoryTable:
+    """Flatten raw advisories into the sorted columnar table."""
+    hash_vals: list[int] = []
+    lo_rows: list[np.ndarray] = []
+    hi_rows: list[np.ndarray] = []
+    flag_rows: list[int] = []
+    group_rows: list[int] = []
+    groups: list[AdvisoryGroup] = []
+    pad_row = np.full(key_width, 1, dtype=np.int32)  # PAD
+
+    for adv in raw:
+        g = AdvisoryGroup(
+            source=adv.source, ecosystem=adv.ecosystem,
+            pkg_name=adv.pkg_name, vuln_id=adv.vuln_id,
+            fixed_version=adv.fixed_version or _first_fixed(adv),
+            status=adv.status, severity=adv.severity,
+            data_source=adv.data_source, vendor_ids=adv.vendor_ids,
+        )
+        gid = len(groups)
+        intervals: list[tuple[bool, Interval]] = []
+        if adv.vulnerable_ranges:
+            try:
+                for iv in parse_constraint(adv.vulnerable_ranges):
+                    intervals.append((True, iv))
+            except ValueError:
+                continue  # constraint we can't express: skip advisory
+        else:
+            # OS-style: [affected, fixed) — unfixed when fixed_version == ""
+            intervals.append((True, Interval(
+                lo=adv.affected_version or None, lo_incl=True,
+                hi=adv.fixed_version or None, hi_incl=False)))
+        for spec in (adv.patched_versions, adv.unaffected_versions):
+            if spec:
+                try:
+                    for iv in parse_constraint(spec):
+                        intervals.append((False, iv))
+                except ValueError:
+                    pass  # unsubtractable secure range: conservative (keep)
+
+        h = key_hash(adv.source, adv.pkg_name)
+        emitted = False
+        for positive, iv in intervals:
+            lo_tok, lo_exact = _encode_bound(adv.ecosystem, iv.lo)
+            hi_tok, hi_exact = _encode_bound(adv.ecosystem, iv.hi)
+            if (iv.lo and lo_tok is None) or (iv.hi and hi_tok is None):
+                continue  # unparseable bound: reference skips the advisory
+            flags = 0
+            if iv.lo:
+                flags |= J.HAS_LO | (J.LO_INCL if iv.lo_incl else 0)
+            if iv.hi:
+                flags |= J.HAS_HI | (J.HI_INCL if iv.hi_incl else 0)
+            if not (lo_exact and hi_exact):
+                flags |= J.INEXACT
+            if not positive:
+                flags |= J.NEGATIVE
+            hash_vals.append(h)
+            lo_rows.append(lo_tok if lo_tok is not None else pad_row)
+            hi_rows.append(hi_tok if hi_tok is not None else pad_row)
+            flag_rows.append(flags)
+            group_rows.append(gid)
+            g.rows.append((positive, iv))
+            emitted = True
+        if emitted:
+            groups.append(g)
+
+    if not hash_vals:
+        empty = np.zeros((0, 2), dtype=np.int32)
+        return AdvisoryTable(empty, np.zeros((0, key_width), np.int32),
+                             np.zeros((0, key_width), np.int32),
+                             np.zeros(0, np.int32), np.zeros(0, np.int32),
+                             [], 1, details)
+
+    hashes = split_u64(hash_vals)                       # [A, 2]
+    order = np.lexsort((hashes[:, 1], hashes[:, 0]))
+    hashes = hashes[order]
+    lo_tok = np.stack(lo_rows)[order]
+    hi_tok = np.stack(hi_rows)[order]
+    flags = np.asarray(flag_rows, np.int32)[order]
+    group = np.asarray(group_rows, np.int32)[order]
+
+    # window = max rows sharing one hash (bucket size)
+    _, counts = np.unique(hashes.view([("hi", np.int32), ("lo", np.int32)]),
+                          return_counts=True)
+    window = int(counts.max())
+
+    return AdvisoryTable(hashes, lo_tok, hi_tok, flags, group,
+                         groups, window, details)
+
+
+def _first_fixed(adv: RawAdvisory) -> str:
+    """Language advisories report the patched floor as FixedVersion."""
+    if adv.patched_versions:
+        vers = [t.lstrip(">=<~^ ") for t in adv.patched_versions.split(",")]
+        return ", ".join(v for v in vers if v)
+    return ""

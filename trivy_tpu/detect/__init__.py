@@ -1,0 +1,6 @@
+"""Detection layer: batched device join + per-family/per-ecosystem drivers.
+
+Replaces the reference's pkg/detector/{ospkg,library} per-package loops
+with one device program over the whole package batch."""
+
+from .engine import BatchDetector, PkgQuery  # noqa: F401

@@ -1,0 +1,166 @@
+"""BatchDetector: host orchestration around ops.join.advisory_join.
+
+Pipeline per batch (SURVEY.md §7 step 3):
+  host: encode (source, name, version) → hash pairs + version keys,
+        pad the batch to a power-of-two bucket (avoids recompile storms);
+  device: one advisory_join call → hash-match / satisfied masks;
+  host: for the few matched rows — verify the package name against the
+        advisory group (hash-collision guard), group rows into advisories
+        (positive minus negative polarity), re-check rows flagged INEXACT
+        with the exact comparator.
+
+The reference evaluates the same predicate one package at a time
+(pkg/detector/ospkg/alpine/alpine.go:86-117, library/driver.go:111-136).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from .. import version as V
+from ..db.table import AdvisoryTable
+from ..ops import join as J
+from ..ops.hashing import key_hash, split_u64
+
+
+@dataclass
+class PkgQuery:
+    source: str      # advisory bucket, e.g. "alpine 3.9"
+    ecosystem: str   # version scheme key
+    name: str        # join name (src package name for OS pkgs)
+    version: str     # installed version (formatted, e.g. epoch:ver-rel)
+    ref: Any = None  # caller's package object
+
+
+@dataclass
+class Hit:
+    query: PkgQuery
+    vuln_id: str
+    fixed_version: str
+    status: str
+    severity: str
+    data_source: Optional[dict]
+    vendor_ids: tuple
+
+
+def _next_pow2(n: int, floor: int = 128) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class BatchDetector:
+    def __init__(self, table: AdvisoryTable):
+        self.table = table
+        self._key_cache: dict[tuple[str, str], Optional[V.VersionKey]] = {}
+
+    def _encode(self, eco: str, ver: str) -> Optional[V.VersionKey]:
+        ck = (eco, ver)
+        if ck not in self._key_cache:
+            try:
+                self._key_cache[ck] = V.encode_version(eco, ver)
+            except (ValueError, KeyError):
+                # Reference skips packages whose installed version doesn't
+                # parse (alpine.go:96-100 logs debug and continues).
+                self._key_cache[ck] = None
+        return self._key_cache[ck]
+
+    def detect(self, queries: list[PkgQuery]) -> list[Hit]:
+        import jax.numpy as jnp
+        t = self.table
+        if len(t) == 0 or not queries:
+            return []
+
+        usable: list[tuple[PkgQuery, V.VersionKey]] = []
+        for q in queries:
+            k = self._encode(q.ecosystem, q.version)
+            if k is not None:
+                usable.append((q, k))
+        if not usable:
+            return []
+
+        b = _next_pow2(len(usable))
+        kw = t.lo_tok.shape[1]
+        pkg_hash = np.zeros((b, 2), np.int32)
+        pkg_tok = np.zeros((b, kw), np.int32)
+        pkg_valid = np.zeros(b, bool)
+        hashes = [key_hash(q.source, q.name) for q, _ in usable]
+        pkg_hash[:len(usable)] = split_u64(hashes)
+        for i, (_, k) in enumerate(usable):
+            pkg_tok[i] = k.tokens
+        pkg_valid[:len(usable)] = True
+
+        adv_hash, adv_lo, adv_hi, adv_flags = t.device_arrays()
+        hmatch, sat, idx = J.advisory_join(
+            adv_hash, adv_lo, adv_hi, adv_flags,
+            jnp.asarray(pkg_hash), jnp.asarray(pkg_tok),
+            jnp.asarray(pkg_valid), window=t.window)
+        hmatch = np.asarray(hmatch)
+        sat = np.asarray(sat)
+        idx = np.asarray(idx)
+
+        return self._assemble(usable, hmatch, sat, idx)
+
+    def _assemble(self, usable, hmatch, sat, idx) -> list[Hit]:
+        t = self.table
+        hits: list[Hit] = []
+        rows_i, rows_j = np.nonzero(hmatch[:len(usable)])
+        # group candidate rows per (pkg, advisory group)
+        per_group: dict[tuple[int, int], dict] = {}
+        for i, j in zip(rows_i.tolist(), rows_j.tolist()):
+            row = int(idx[i, j])
+            gid = int(t.group[row])
+            g = t.groups[gid]
+            q, k = usable[i]
+            if g.pkg_name != q.name or g.source != q.source:
+                continue  # 64-bit hash collision: reject
+            st = per_group.setdefault((i, gid), {
+                "pos_any": False, "neg_any": False, "inexact": False})
+            flags = int(t.flags[row])
+            satisfied = bool(sat[i, j])
+            if (flags & J.INEXACT) or not k.exact:
+                st["inexact"] = True
+            if flags & J.NEGATIVE:
+                st["neg_any"] = st["neg_any"] or satisfied
+            else:
+                st["pos_any"] = st["pos_any"] or satisfied
+
+        for (i, gid), st in per_group.items():
+            q, k = usable[i]
+            g = t.groups[gid]
+            if st["inexact"]:
+                pos, neg = self._exact_eval(g, q)
+            else:
+                pos, neg = st["pos_any"], st["neg_any"]
+            if pos and not neg:
+                hits.append(Hit(
+                    query=q, vuln_id=g.vuln_id,
+                    fixed_version=g.fixed_version, status=g.status,
+                    severity=g.severity, data_source=g.data_source,
+                    vendor_ids=g.vendor_ids))
+        return hits
+
+    def _exact_eval(self, g, q: PkgQuery) -> tuple[bool, bool]:
+        """Host fallback: evaluate the group's intervals with the exact
+        comparator (used for inexact-keyed rows/packages)."""
+        pos = neg = False
+        for positive, iv in g.rows:
+            ok = True
+            try:
+                if iv.lo is not None:
+                    c = V.compare(q.ecosystem, iv.lo, q.version)
+                    ok &= c < 0 or (iv.lo_incl and c == 0)
+                if ok and iv.hi is not None:
+                    c = V.compare(q.ecosystem, q.version, iv.hi)
+                    ok &= c < 0 or (iv.hi_incl and c == 0)
+            except (ValueError, KeyError):
+                ok = False
+            if positive:
+                pos = pos or ok
+            else:
+                neg = neg or ok
+        return pos, neg

@@ -1,0 +1,181 @@
+"""OS-package vulnerability detection — per-family drivers over the
+batched join engine.
+
+Mirrors the reference driver table (pkg/detector/ospkg/detect.go:32-48) and
+each family's stream naming / version-formatting / severity rules:
+- alpine (alpine/alpine.go): stream = Minor(osVer), repo release preferred,
+  join on SrcName with FormatSrcVersion;
+- debian (debian/debian.go): stream = Major(osVer), advisory severity →
+  SeveritySource "debian", unfixed advisories reported with Status;
+- ubuntu (ubuntu/ubuntu.go): stream = osVer (xx.yy), ESM later;
+- wolfi/chainguard: flat stream.
+
+EOL tables reproduce each driver's eolDates; EOSL flags the report like
+osver.Supported (version/version.go:31).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .. import types as T
+from .engine import BatchDetector, Hit, PkgQuery
+
+_FAR_FUTURE = dt.datetime(9999, 1, 1, tzinfo=dt.timezone.utc)
+
+
+def _d(y, m, d):
+    return dt.datetime(y, m, d, 23, 59, 59, tzinfo=dt.timezone.utc)
+
+
+ALPINE_EOL = {
+    "2.0": _d(2012, 4, 1), "2.1": _d(2012, 11, 1), "2.2": _d(2013, 5, 1),
+    "2.3": _d(2013, 11, 1), "2.4": _d(2014, 5, 1), "2.5": _d(2014, 11, 1),
+    "2.6": _d(2015, 5, 1), "2.7": _d(2015, 11, 1), "3.0": _d(2016, 5, 1),
+    "3.1": _d(2016, 11, 1), "3.2": _d(2017, 5, 1), "3.3": _d(2017, 11, 1),
+    "3.4": _d(2018, 5, 1), "3.5": _d(2018, 11, 1), "3.6": _d(2019, 5, 1),
+    "3.7": _d(2019, 11, 1), "3.8": _d(2020, 5, 1), "3.9": _d(2020, 11, 1),
+    "3.10": _d(2021, 5, 1), "3.11": _d(2021, 11, 1), "3.12": _d(2022, 5, 1),
+    "3.13": _d(2022, 11, 1), "3.14": _d(2023, 5, 1), "3.15": _d(2023, 11, 1),
+    "3.16": _d(2024, 5, 23), "3.17": _d(2024, 11, 22), "3.18": _d(2025, 5, 9),
+    "3.19": _d(2025, 11, 1), "edge": _FAR_FUTURE,
+}
+
+DEBIAN_EOL = {
+    "7": _d(2018, 5, 31), "8": _d(2020, 6, 30), "9": _d(2022, 6, 30),
+    "10": _d(2024, 6, 30), "11": _d(2026, 6, 30), "12": _d(2028, 6, 30),
+}
+
+UBUNTU_EOL = {
+    "14.04": _d(2019, 4, 25), "16.04": _d(2021, 4, 21),
+    "18.04": _d(2023, 5, 31), "20.04": _d(2025, 4, 23),
+    "21.04": _d(2022, 1, 20), "21.10": _d(2022, 7, 14),
+    "22.04": _d(2027, 4, 23), "22.10": _d(2023, 7, 20),
+    "23.04": _d(2024, 1, 20), "23.10": _d(2024, 7, 11),
+    "24.04": _d(2029, 4, 25),
+}
+
+
+def minor(os_ver: str) -> str:
+    parts = os_ver.split(".")
+    return ".".join(parts[:2])
+
+
+def major(os_ver: str) -> str:
+    return os_ver.split(".", 1)[0]
+
+
+@dataclass
+class FamilyDriver:
+    family: str
+    ecosystem: str
+    stream: Callable[[str, Optional[T.Repository]], str]     # → version key
+    bucket: Callable[[str], str]                             # stream → bucket
+    severity_source: str = ""   # SeveritySource when advisory has severity
+    eol: Optional[dict] = None
+    eol_key: Callable[[str], str] = staticmethod(lambda v: v)
+
+
+def _alpine_stream(os_ver: str, repo: Optional[T.Repository]) -> str:
+    v = minor(os_ver)
+    if repo and repo.release:
+        rel = repo.release
+        if rel.count(".") > 1:
+            rel = rel[:rel.rindex(".")]
+        if rel and v != rel:
+            v = rel  # repository release preferred (alpine.go:76-83)
+    return v
+
+
+DRIVERS: dict[str, FamilyDriver] = {
+    "alpine": FamilyDriver(
+        family="alpine", ecosystem="alpine",
+        stream=_alpine_stream,
+        bucket=lambda s: f"alpine {s}",
+        eol=ALPINE_EOL, eol_key=minor),
+    "wolfi": FamilyDriver(
+        family="wolfi", ecosystem="alpine",
+        stream=lambda v, r: "",
+        bucket=lambda s: "wolfi"),
+    "chainguard": FamilyDriver(
+        family="chainguard", ecosystem="alpine",
+        stream=lambda v, r: "",
+        bucket=lambda s: "chainguard"),
+    "debian": FamilyDriver(
+        family="debian", ecosystem="debian",
+        stream=lambda v, r: major(v),
+        bucket=lambda s: f"debian {s}",
+        severity_source="debian",
+        eol=DEBIAN_EOL, eol_key=major),
+    "ubuntu": FamilyDriver(
+        family="ubuntu", ecosystem="ubuntu",
+        stream=lambda v, r: v,
+        bucket=lambda s: f"ubuntu {s}",
+        eol=UBUNTU_EOL),
+}
+
+
+def supported_families() -> list[str]:
+    return sorted(DRIVERS)
+
+
+class OspkgScanner:
+    """Batched equivalent of ospkgDetector.Detect (detect.go:63-82)."""
+
+    def __init__(self, detector: BatchDetector):
+        self.detector = detector
+
+    def scan(self, os_info: T.OS, repo: Optional[T.Repository],
+             packages: list[T.Package],
+             now: Optional[dt.datetime] = None
+             ) -> tuple[list[T.DetectedVulnerability], bool]:
+        """→ (vulns, eosl). Skips gpg-pubkey pseudo packages like
+        detect.go:73."""
+        driver = DRIVERS.get(os_info.family)
+        if driver is None:
+            return [], False
+        stream = driver.stream(os_info.name, repo)
+        bucket = driver.bucket(stream)
+
+        queries = []
+        for pkg in packages:
+            if pkg.name == "gpg-pubkey":
+                continue
+            name = pkg.src_name or pkg.name
+            ver = pkg.format_src_version() or pkg.format_version()
+            if not ver:
+                continue
+            queries.append(PkgQuery(source=bucket, ecosystem=driver.ecosystem,
+                                    name=name, version=ver, ref=pkg))
+
+        hits = self.detector.detect(queries)
+        vulns = [self._to_vuln(h, driver) for h in hits]
+
+        eosl = False
+        if driver.eol is not None:
+            now = now or dt.datetime.now(dt.timezone.utc)
+            eol = driver.eol.get(driver.eol_key(os_info.name))
+            eosl = eol is not None and now > eol
+        return vulns, eosl
+
+    @staticmethod
+    def _to_vuln(h: Hit, driver: FamilyDriver) -> T.DetectedVulnerability:
+        pkg: T.Package = h.query.ref
+        v = T.DetectedVulnerability(
+            vulnerability_id=h.vuln_id,
+            vendor_ids=list(h.vendor_ids),
+            pkg_id=pkg.id,
+            pkg_name=pkg.name,
+            pkg_identifier=pkg.identifier,
+            installed_version=pkg.format_version(),
+            fixed_version=h.fixed_version,
+            status=h.status,
+            layer=pkg.layer,
+            data_source=T.DataSource(**h.data_source) if h.data_source else None,
+        )
+        if h.severity and h.severity != "UNKNOWN":
+            v.severity_source = driver.severity_source or driver.family
+            v.vulnerability.severity = h.severity
+        return v

@@ -1,0 +1,2 @@
+"""Device-side primitives: vectorized version compare, hashing, the
+batched advisory join, and the Aho-Corasick secret prefilter."""

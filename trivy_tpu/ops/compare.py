@@ -1,0 +1,31 @@
+"""Vectorized lexicographic comparison over version-token vectors.
+
+Replaces the per-(package, advisory) version.LessThan calls of the
+reference's inner loop (e.g. pkg/detector/ospkg/alpine/alpine.go:122-153)
+with elementwise masks + a reduction over the token axis — no gathers, no
+data-dependent control flow, so XLA fuses the whole predicate into the
+join kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def lex_less(a, b):
+    """a < b lexicographically. a, b: int32[..., K] → bool[...]."""
+    neq = a != b
+    seen = jnp.cumsum(neq.astype(jnp.int32), axis=-1)
+    first = neq & (seen == 1)  # True only at the first differing position
+    return jnp.any(first & (a < b), axis=-1)
+
+
+def lex_eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def lex_leq(a, b):
+    neq = a != b
+    seen = jnp.cumsum(neq.astype(jnp.int32), axis=-1)
+    first = neq & (seen == 1)
+    return ~jnp.any(first & (a > b), axis=-1)

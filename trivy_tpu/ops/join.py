@@ -1,0 +1,104 @@
+"""The batched advisory join — the TPU replacement for the reference's
+per-package detect loops.
+
+Reference inner loop (pkg/detector/ospkg/alpine/alpine.go:86-117,
+pkg/detector/library/driver.go:111-136): for each package, a BoltDB bucket
+lookup by (stream, name), then a per-advisory version-range check. Here the
+whole batch is one device program:
+
+  1. packages and advisory rows are keyed by fnv1a64(source + name), stored
+     as (hi, lo) int32 pairs (TPUs have no native int64);
+  2. a vectorized 32-step binary search finds each package's bucket start in
+     the hash-sorted advisory table;
+  3. a static window of W consecutive rows (W = max bucket size, computed at
+     flatten time) is gathered and every (package, row) pair evaluates the
+     interval predicate  has_lo → lo ≤/< installed  ∧  has_hi → installed </≤ hi
+     with the vectorized lexicographic compare.
+
+Outputs are two bool masks [B, W]: hash-match and interval-satisfied, plus
+the row indices. Grouping rows into advisories (vulnerable-range rows vs
+patched-range rows) and hash-collision verification happen host-side on the
+few matched rows (trivy_tpu.detect).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .compare import lex_eq, lex_less
+
+# flag bits (must match db.flatten)
+HAS_LO = 1
+LO_INCL = 2
+HAS_HI = 4
+HI_INCL = 8
+INEXACT = 16
+NEGATIVE = 32  # row describes a patched/unaffected range, not a vulnerable one
+
+
+def pair_less(ah, al, bh, bl):
+    return (ah < bh) | ((ah == bh) & (al < bl))
+
+
+def searchsorted_pair(table_hi, table_lo, qh, ql):
+    """Left insertion point of each (qh, ql) in the sorted (hi, lo) table.
+
+    32-iteration vectorized binary search (supports tables up to 2^32 rows);
+    static trip count keeps XLA control flow trivial.
+    """
+    n = table_hi.shape[0]
+    lo = jnp.zeros(qh.shape, dtype=jnp.int32)
+    hi = jnp.full(qh.shape, n, dtype=jnp.int32)
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = (lo + hi) // 2
+        midc = jnp.clip(mid, 0, n - 1)
+        go_right = pair_less(table_hi[midc], table_lo[midc], qh, ql)
+        return jnp.where(go_right, mid + 1, lo), jnp.where(go_right, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+    return lo
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def advisory_join(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
+                  pkg_hash, pkg_tok, pkg_valid, *, window: int):
+    """Batched hash-join + interval predicate.
+
+    adv_hash:   int32[A, 2] hash-sorted (hi, lo)
+    adv_lo_tok: int32[A, K] lower-bound version tokens
+    adv_hi_tok: int32[A, K] upper-bound version tokens
+    adv_flags:  int32[A]    flag bits (HAS_LO | LO_INCL | HAS_HI | HI_INCL | ...)
+    pkg_hash:   int32[B, 2]
+    pkg_tok:    int32[B, K] installed-version tokens
+    pkg_valid:  bool[B]     padding mask
+
+    Returns (hash_match bool[B, W], satisfied bool[B, W], row_idx int32[B, W]).
+    """
+    a = adv_hash.shape[0]
+    start = searchsorted_pair(adv_hash[:, 0], adv_hash[:, 1],
+                              pkg_hash[:, 0], pkg_hash[:, 1])
+    idx = jnp.clip(start[:, None] + jnp.arange(window, dtype=jnp.int32)[None, :],
+                   0, a - 1)                               # [B, W]
+    hmatch = ((adv_hash[idx, 0] == pkg_hash[:, None, 0])
+              & (adv_hash[idx, 1] == pkg_hash[:, None, 1])
+              & pkg_valid[:, None])                        # [B, W]
+
+    flags = adv_flags[idx]                                 # [B, W]
+    lo_t = adv_lo_tok[idx]                                 # [B, W, K]
+    hi_t = adv_hi_tok[idx]
+    inst = pkg_tok[:, None, :]                             # [B, 1, K]
+
+    has_lo = (flags & HAS_LO) != 0
+    lo_incl = (flags & LO_INCL) != 0
+    has_hi = (flags & HAS_HI) != 0
+    hi_incl = (flags & HI_INCL) != 0
+
+    ok_lo = (~has_lo) | lex_less(lo_t, inst) | (lo_incl & lex_eq(lo_t, inst))
+    ok_hi = (~has_hi) | lex_less(inst, hi_t) | (hi_incl & lex_eq(inst, hi_t))
+    satisfied = hmatch & ok_lo & ok_hi
+    return hmatch, satisfied, idx
