@@ -1,0 +1,111 @@
+"""Test helpers: build synthetic docker-save image tarballs in memory."""
+
+import hashlib
+import io
+import json
+import tarfile
+
+
+def make_layer(files: dict[str, bytes]) -> bytes:
+    """files: path → content; a path ending in '/' creates a directory."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for path, content in files.items():
+            if path.endswith("/"):
+                ti = tarfile.TarInfo(path.rstrip("/"))
+                ti.type = tarfile.DIRTYPE
+                tf.addfile(ti)
+                continue
+            ti = tarfile.TarInfo(path)
+            ti.size = len(content)
+            tf.addfile(ti, io.BytesIO(content))
+    return buf.getvalue()
+
+
+def make_image(path: str, layers: list[dict[str, bytes]],
+               repo_tags=("test/image:latest",),
+               created_by=None) -> list[str]:
+    """Write a docker-save tarball; returns layer diff_ids."""
+    layer_blobs = [make_layer(files) for files in layers]
+    diff_ids = ["sha256:" + hashlib.sha256(b).hexdigest()
+                for b in layer_blobs]
+    config = {
+        "architecture": "amd64",
+        "os": "linux",
+        "rootfs": {"type": "layers", "diff_ids": diff_ids},
+        "history": [{"created_by": (created_by[i] if created_by else
+                                    f"layer-{i}")}
+                    for i in range(len(layers))],
+    }
+    config_bytes = json.dumps(config).encode()
+    config_name = hashlib.sha256(config_bytes).hexdigest() + ".json"
+    manifest = [{
+        "Config": config_name,
+        "RepoTags": list(repo_tags),
+        "Layers": [f"layer{i}/layer.tar" for i in range(len(layers))],
+    }]
+    with tarfile.open(path, "w") as tf:
+        mb = json.dumps(manifest).encode()
+        ti = tarfile.TarInfo("manifest.json")
+        ti.size = len(mb)
+        tf.addfile(ti, io.BytesIO(mb))
+        ti = tarfile.TarInfo(config_name)
+        ti.size = len(config_bytes)
+        tf.addfile(ti, io.BytesIO(config_bytes))
+        for i, blob in enumerate(layer_blobs):
+            ti = tarfile.TarInfo(f"layer{i}/layer.tar")
+            ti.size = len(blob)
+            tf.addfile(ti, io.BytesIO(blob))
+    return diff_ids
+
+
+ALPINE_OS_RELEASE = b"""\
+NAME="Alpine Linux"
+ID=alpine
+VERSION_ID=3.17.3
+PRETTY_NAME="Alpine Linux v3.17"
+"""
+
+APK_INSTALLED = b"""\
+C:Q1pSXsQcqlY5clcXDHVqZBBIfPzg4=
+P:musl
+V:1.2.3-r4
+A:x86_64
+T:the musl c library (libc) implementation
+o:musl
+m:Timo Teras <timo.teras@iki.fi>
+L:MIT
+
+C:Q1poBWwSMyhbfAgVmGAgSqd1bYKTA=
+P:libcrypto3
+V:3.0.7-r0
+A:x86_64
+o:openssl
+m:Ariadne Conill <ariadne@dereferenced.org>
+L:Apache-2.0
+D:so:libc.musl-x86_64.so.1
+
+C:Q1QKYkcqhL4XqhVFQnyFyyFyQ5EJo=
+P:libssl3
+V:3.0.7-r0
+A:x86_64
+o:openssl
+L:Apache-2.0
+
+C:Q1apkZXhAbeCZgOlWTACfe9eCM8Co=
+P:zlib
+V:1.2.13-r0
+A:x86_64
+o:zlib
+L:Zlib
+"""
+
+FLASK_METADATA = b"""\
+Metadata-Version: 2.1
+Name: Flask
+Version: 2.2.2
+Summary: A simple framework for building complex web applications.
+License: BSD-3-Clause
+
+Flask body text.
+"""

@@ -1,0 +1,161 @@
+"""End-to-end: synthetic alpine image archive → analyzers → cache →
+applier → batched detection → report (the 3.1 call stack of SURVEY.md,
+compressed)."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from helpers import (ALPINE_OS_RELEASE, APK_INSTALLED, FLASK_METADATA,
+                     make_image)
+from trivy_tpu import types as T
+from trivy_tpu.db import build_table
+from trivy_tpu.db.fixtures import load_fixture_files
+from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+from trivy_tpu.fanal.cache import MemoryCache
+from trivy_tpu.report import build_report, to_json
+from trivy_tpu.scanner import LocalScanner
+
+FIXTURES = sorted(glob.glob(
+    os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")))
+
+
+@pytest.fixture(scope="module")
+def table():
+    advisories, details, _ = load_fixture_files(FIXTURES)
+    return build_table(advisories, details)
+
+
+@pytest.fixture()
+def image_path(tmp_path):
+    p = str(tmp_path / "alpine.tar")
+    make_image(p, [
+        {
+            "etc/os-release": ALPINE_OS_RELEASE,
+            "etc/alpine-release": b"3.17.3\n",
+            "lib/apk/db/installed": APK_INSTALLED,
+        },
+        {
+            "usr/lib/python3.10/site-packages/Flask-2.2.2.dist-info/METADATA":
+                FLASK_METADATA,
+        },
+    ])
+    return p
+
+
+def scan_image(path, table, scanners=("vuln",), list_all=False):
+    cache = MemoryCache()
+    art = ImageArchiveArtifact(path, cache, scanners=scanners)
+    ref = art.inspect()
+    scanner = LocalScanner(cache, table)
+    opts = T.ScanOptions(scanners=scanners, list_all_packages=list_all)
+    results, os_info = scanner.scan(ref.name, ref.id, ref.blob_ids, opts)
+    return ref, results, os_info
+
+
+class TestImageScan:
+    def test_os_detection_and_vulns(self, image_path, table):
+        ref, results, os_info = scan_image(image_path, table)
+        assert os_info.family == "alpine"
+        assert os_info.name == "3.17.3"
+        os_res = results[0]
+        assert os_res.target == "test/image:latest (alpine 3.17.3)"
+        assert os_res.clazz == "os-pkgs"
+        ids = [(v.pkg_name, v.vulnerability_id)
+               for v in os_res.vulnerabilities]
+        # libcrypto3/libssl3 join via SrcName=openssl; musl 1.2.3-r4
+        # < 1.2.3_git20230424-r5; zlib 1.2.13-r0 ≥ fix → absent
+        assert ids == [
+            ("libcrypto3", "CVE-2023-0286"), ("libcrypto3", "CVE-2023-2650"),
+            ("libssl3", "CVE-2023-0286"), ("libssl3", "CVE-2023-2650"),
+            ("musl", "CVE-2025-26519"),
+        ]
+
+    def test_lang_pkgs(self, image_path, table):
+        _, results, _ = scan_image(image_path, table)
+        lang = [r for r in results if r.clazz == "lang-pkgs"]
+        assert len(lang) == 1
+        assert lang[0].type == "python-pkg"
+        v = lang[0].vulnerabilities[0]
+        assert v.vulnerability_id == "CVE-2023-30861"
+        assert v.pkg_name == "Flask"
+        assert v.fixed_version == "2.3.2, 2.2.5"
+
+    def test_fill_info(self, image_path, table):
+        _, results, _ = scan_image(image_path, table)
+        v = results[0].vulnerabilities[0]
+        assert v.vulnerability.severity == "HIGH"
+        assert v.severity_source == "alpine"
+        assert v.status == "fixed"
+        assert v.primary_url == "https://avd.aquasec.com/nvd/cve-2023-0286"
+        assert v.vulnerability.title.startswith("openssl:")
+        # layer attribution: packages came from layer 0
+        assert v.layer.diff_id.startswith("sha256:")
+
+    def test_report_json_shape(self, image_path, table):
+        ref, results, os_info = scan_image(image_path, table)
+        report = build_report(ref.name, ref.type, results, os_info,
+                              metadata=ref.image_metadata,
+                              created_at="2026-07-29T00:00:00Z")
+        j = json.loads(to_json(report))
+        assert j["SchemaVersion"] == 2
+        assert j["ArtifactName"] == "test/image:latest"
+        assert j["ArtifactType"] == "container_image"
+        # alpine 3.17 is past EOL at the fake scan date → EOSL flagged
+        assert j["Metadata"]["OS"] == {"Family": "alpine", "Name": "3.17.3",
+                                       "EOSL": True}
+        r0 = j["Results"][0]
+        assert r0["Class"] == "os-pkgs"
+        v0 = r0["Vulnerabilities"][0]
+        assert v0["VulnerabilityID"] == "CVE-2023-0286"
+        assert v0["Severity"] == "HIGH"
+        assert v0["FixedVersion"] == "3.0.8-r0"
+        assert v0["InstalledVersion"] == "3.0.7-r0"
+        assert "CVSS" in v0 and "nvd" in v0["CVSS"]
+
+    def test_cache_hit_skips_analysis(self, image_path, table):
+        cache = MemoryCache()
+        art = ImageArchiveArtifact(image_path, cache)
+        ref1 = art.inspect()
+        blobs_before = dict(cache.blobs)
+        ref2 = art.inspect()
+        assert ref1.blob_ids == ref2.blob_ids
+        assert cache.blobs == blobs_before
+
+    def test_list_all_packages(self, image_path, table):
+        _, results, _ = scan_image(image_path, table, list_all=True)
+        names = [p.name for p in results[0].packages]
+        assert names == ["libcrypto3", "libssl3", "musl", "zlib"]
+
+
+class TestWhiteout:
+    def test_whiteout_removes_package_file(self, tmp_path, table):
+        p = str(tmp_path / "wh.tar")
+        make_image(p, [
+            {
+                "etc/os-release": ALPINE_OS_RELEASE,
+                "lib/apk/db/installed": APK_INSTALLED,
+                "usr/lib/python3.10/site-packages/"
+                "Flask-2.2.2.dist-info/METADATA": FLASK_METADATA,
+            },
+            {"usr/lib/python3.10/site-packages/Flask-2.2.2.dist-info/"
+             ".wh.METADATA": b""},
+        ])
+        _, results, _ = scan_image(p, table)
+        assert not any(r.clazz == "lang-pkgs" for r in results)
+
+    def test_opaque_dir(self, tmp_path, table):
+        p = str(tmp_path / "opq.tar")
+        make_image(p, [
+            {
+                "etc/os-release": ALPINE_OS_RELEASE,
+                "lib/apk/db/installed": APK_INSTALLED,
+                "usr/lib/python3.10/site-packages/"
+                "Flask-2.2.2.dist-info/METADATA": FLASK_METADATA,
+            },
+            {"usr/lib/python3.10/site-packages/.wh..wh..opq": b""},
+        ])
+        _, results, _ = scan_image(p, table)
+        assert not any(r.clazz == "lang-pkgs" for r in results)

@@ -78,7 +78,14 @@ class AdvisoryTable:
         self.groups = groups
         self.window = max(window, 1)
         self.details = details or {}
+        self.sources = sorted({g.source for g in groups})
         self._device = None
+
+    def sources_for_prefix(self, prefix: str) -> list[str]:
+        """Buckets matching an ecosystem prefix — the columnar equivalent of
+        the reference's prefix bucket scan (library/driver.go:111
+        GetAdvisories("pip::", name))."""
+        return [s for s in self.sources if s.startswith(prefix)]
 
     def __len__(self):
         return self.hash.shape[0]
@@ -238,8 +245,11 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
 
 
 def _first_fixed(adv: RawAdvisory) -> str:
-    """Language advisories report the patched floor as FixedVersion."""
+    """Language advisories format PatchedVersions as the report
+    FixedVersion, comma-joined (reference pkg/detector/library/driver.go
+    createFixedVersions)."""
     if adv.patched_versions:
-        vers = [t.lstrip(">=<~^ ") for t in adv.patched_versions.split(",")]
+        vers = [t.strip().lstrip(">=<~^ ")
+                for t in adv.patched_versions.split("||")]
         return ", ".join(v for v in vers if v)
     return ""

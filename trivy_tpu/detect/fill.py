@@ -1,0 +1,109 @@
+"""Vulnerability detail enrichment (FillInfo).
+
+Mirrors pkg/vulnerability/vulnerability.go:60-157: status defaulting,
+severity selection by source precedence (source → GHSA → NVD → detail
+severity), primary URL rules, and merging the detail record into the
+detected vulnerability."""
+
+from __future__ import annotations
+
+from .. import types as T
+
+SEVERITY_NAMES = ["UNKNOWN", "LOW", "MEDIUM", "HIGH", "CRITICAL"]
+
+PRIMARY_URL_PREFIXES = {
+    "debian": ["http://www.debian.org", "https://www.debian.org"],
+    "ubuntu": ["http://www.ubuntu.com", "https://usn.ubuntu.com"],
+    "redhat": ["https://access.redhat.com"],
+    "suse-cvrf": ["http://lists.opensuse.org", "https://lists.opensuse.org"],
+    "oracle-oval": ["http://linux.oracle.com/errata",
+                    "https://linux.oracle.com/errata"],
+    "nodejs-security-wg": ["https://www.npmjs.com", "https://hackerone.com"],
+    "ruby-advisory-db": ["https://groups.google.com"],
+}
+
+
+def _sev_name(v) -> str:
+    try:
+        return SEVERITY_NAMES[int(float(v))]
+    except (TypeError, ValueError, IndexError):
+        return str(v) if v else "UNKNOWN"
+
+
+def _detail_to_vulnerability(detail: dict) -> T.Vulnerability:
+    cvss = {}
+    for src, c in (detail.get("CVSS") or {}).items():
+        cvss[src] = T.CVSS(
+            v2_vector=c.get("V2Vector", ""), v3_vector=c.get("V3Vector", ""),
+            v40_vector=c.get("V40Vector", ""),
+            v2_score=c.get("V2Score", 0.0), v3_score=c.get("V3Score", 0.0),
+            v40_score=c.get("V40Score", 0.0))
+    return T.Vulnerability(
+        title=detail.get("Title", ""),
+        description=detail.get("Description", ""),
+        severity=detail.get("Severity", ""),
+        cwe_ids=detail.get("CweIDs", []),
+        vendor_severity={k: int(float(v)) for k, v in
+                         (detail.get("VendorSeverity") or {}).items()},
+        cvss=cvss,
+        references=detail.get("References", []),
+        published_date=str(detail.get("PublishedDate", "")),
+        last_modified_date=str(detail.get("LastModifiedDate", "")),
+    )
+
+
+def fill_info(vulns: list[T.DetectedVulnerability], details: dict) -> None:
+    for v in vulns:
+        if v.fixed_version:
+            v.status = "fixed"
+        elif not v.status or v.status == "unknown":
+            v.status = "affected"
+
+        detail = details.get(v.vulnerability_id)
+        if detail is None:
+            continue
+        source = v.data_source.id if v.data_source else ""
+        severity, sev_source = _vendor_severity(v.vulnerability_id, detail,
+                                                source)
+        if v.severity_source:
+            # package-specific severity (e.g. Debian) wins (fill:88-100)
+            severity = v.vulnerability.severity
+            sev_source = v.severity_source
+
+        v.vulnerability = _detail_to_vulnerability(detail)
+        if v.severity_source and sev_source:
+            v.vulnerability.vendor_severity[sev_source] = \
+                SEVERITY_NAMES.index(severity) if severity in SEVERITY_NAMES \
+                else 0
+        v.vulnerability.severity = severity
+        v.severity_source = sev_source
+        v.primary_url = _primary_url(v.vulnerability_id,
+                                     v.vulnerability.references, source)
+
+
+def _vendor_severity(vuln_id: str, detail: dict, source: str):
+    vs = detail.get("VendorSeverity") or {}
+    if source in vs:
+        return _sev_name(vs[source]), source
+    if vuln_id.startswith("GHSA-") and "ghsa" in vs:
+        return _sev_name(vs["ghsa"]), "ghsa"
+    if "nvd" in vs:
+        return _sev_name(vs["nvd"]), "nvd"
+    sev = detail.get("Severity", "")
+    return (sev if sev else "UNKNOWN"), ""
+
+
+def _primary_url(vuln_id: str, references: list, source: str) -> str:
+    if vuln_id.startswith("CVE-"):
+        return "https://avd.aquasec.com/nvd/" + vuln_id.lower()
+    if vuln_id.startswith("RUSTSEC-"):
+        return "https://osv.dev/vulnerability/" + vuln_id
+    if vuln_id.startswith("GHSA-"):
+        return "https://github.com/advisories/" + vuln_id
+    if vuln_id.startswith("TEMP-"):
+        return "https://security-tracker.debian.org/tracker/" + vuln_id
+    for pre in PRIMARY_URL_PREFIXES.get(source, []):
+        for ref in references:
+            if ref.startswith(pre):
+                return ref
+    return ""

@@ -1,0 +1,14 @@
+"""fanal — artifact acquisition & per-layer analysis (host side).
+
+The TPU framework keeps the reference's artifact/blob model
+(pkg/fanal/artifact, pkg/fanal/analyzer): an artifact (image archive,
+filesystem, SBOM) is decomposed into blobs (layers); each blob is walked
+and analyzed once, memoized in the cache keyed by content digest +
+analyzer versions; the applier squashes blob results into one
+ArtifactDetail for detection. Analysis is parsing-dominated and stays on
+host CPU; its outputs are the columnar package batches the device joins
+consume."""
+
+from .analyzers import AnalyzerGroup  # noqa: F401
+from .applier import apply_layers  # noqa: F401
+from .cache import FSCache, MemoryCache  # noqa: F401

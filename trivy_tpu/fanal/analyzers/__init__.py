@@ -1,0 +1,91 @@
+"""Analyzer registry & dispatch.
+
+Mirrors pkg/fanal/analyzer/analyzer.go: each analyzer declares the paths
+it needs (`required`) and produces a partial AnalysisResult; the group
+merges results. Analyzer versions participate in cache keys so cached
+blobs invalidate when an analyzer changes
+(pkg/fanal/cache/key.go:18-60)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ... import types as T
+
+
+@dataclass
+class AnalysisResult:
+    os: Optional[T.OS] = None
+    repository: Optional[T.Repository] = None
+    package_infos: list = field(default_factory=list)
+    applications: list = field(default_factory=list)
+    secrets: list = field(default_factory=list)
+    licenses: list = field(default_factory=list)
+
+    def merge(self, other: "AnalysisResult"):
+        if other is None:
+            return
+        if other.os is not None:
+            if self.os is None:
+                self.os = other.os
+            else:
+                self.os.merge(other.os)
+        if other.repository is not None:
+            self.repository = other.repository
+        self.package_infos.extend(other.package_infos)
+        self.applications.extend(other.applications)
+        self.secrets.extend(other.secrets)
+        self.licenses.extend(other.licenses)
+
+
+class Analyzer:
+    """Base: subclasses set `name` and `version` and implement
+    required(path) / analyze(path, content)."""
+    name = "base"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        raise NotImplementedError
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(cls):
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_analyzers() -> dict[str, type]:
+    _ensure_loaded()
+    return dict(_REGISTRY)
+
+
+def _ensure_loaded():
+    from . import apk, dpkg, os_release, python  # noqa: F401
+
+
+class AnalyzerGroup:
+    def __init__(self, disabled: tuple = ()):
+        _ensure_loaded()
+        self.analyzers = [cls() for name, cls in sorted(_REGISTRY.items())
+                          if name not in disabled]
+
+    def versions(self) -> dict[str, int]:
+        """name → version, for cache keys."""
+        return {a.name: a.version for a in self.analyzers}
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return any(a.required(path, size) for a in self.analyzers)
+
+    def analyze_file(self, path: str, content: bytes,
+                     result: AnalysisResult) -> None:
+        for a in self.analyzers:
+            if a.required(path, len(content)):
+                r = a.analyze(path, content)
+                if r is not None:
+                    result.merge(r)

@@ -1,0 +1,99 @@
+"""Alpine installed-package DB parser (lib/apk/db/installed).
+
+Mirrors pkg/fanal/analyzer/pkg/apk/apk.go: stanza-per-package key:value
+lines — P name, V version, o origin (source package), A arch, L license,
+m maintainer, D dependencies, F/R installed files, C checksum."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import types as T
+from . import AnalysisResult, Analyzer, register
+
+INSTALLED_DB = "lib/apk/db/installed"
+
+
+@register
+class ApkAnalyzer(Analyzer):
+    name = "apk"
+    version = 2
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path == INSTALLED_DB
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        pkgs: list[T.Package] = []
+        pkg = T.Package()
+        cur_dir = ""
+        for raw in content.decode(errors="replace").splitlines():
+            if raw == "":
+                self._flush(pkg, pkgs)
+                pkg = T.Package()
+                continue
+            if len(raw) < 2 or raw[1] != ":":
+                continue
+            key, val = raw[0], raw[2:]
+            if key == "P":
+                pkg.name = val
+            elif key == "V":
+                pkg.version = val
+            elif key == "o":
+                pkg.src_name = val
+            elif key == "A":
+                pkg.arch = val
+            elif key == "L" and val:
+                pkg.licenses = _parse_license(val)
+            elif key == "m":
+                pkg.maintainer = val
+            elif key == "D":
+                pkg.depends_on = [
+                    _strip_constraint(d) for d in val.split()
+                    if not d.startswith("!")]
+            elif key == "F":
+                cur_dir = val
+            elif key == "R":
+                pkg.installed_files.append(f"{cur_dir}/{val}")
+            elif key == "C":
+                pkg.digest = _checksum_digest(val)
+        self._flush(pkg, pkgs)
+        if not pkgs:
+            return None
+        return AnalysisResult(package_infos=[
+            T.PackageInfo(file_path=path, packages=pkgs)])
+
+    @staticmethod
+    def _flush(pkg: T.Package, pkgs: list):
+        if pkg.name and pkg.version:
+            pkg.id = f"{pkg.name}@{pkg.version}"
+            # origin carries only the source name; source version equals
+            # the binary version in apk
+            pkg.src_name = pkg.src_name or pkg.name
+            pkg.src_version = pkg.version
+            pkgs.append(pkg)
+
+
+def _strip_constraint(dep: str) -> str:
+    for op in ("><", ">=", "<=", "=", ">", "<", "~"):
+        if op in dep:
+            dep = dep.split(op[0], 1)[0]
+            break
+    return dep.split(":", 1)[-1] if dep.startswith("so:") else dep
+
+
+def _parse_license(val: str) -> list[str]:
+    # apk licenses are space-separated SPDX-ish tokens, AND/OR noise dropped
+    return [tok for tok in val.replace("(", " ").replace(")", " ").split()
+            if tok not in ("AND", "OR", "and", "or")]
+
+
+def _checksum_digest(val: str) -> str:
+    # C:Q1<base64> → sha1 digest form used by the reference jar matching
+    if val.startswith("Q1"):
+        import base64
+        try:
+            raw = base64.b64decode(val[2:] + "=" * (-len(val[2:]) % 4))
+            return "sha1:" + raw.hex()
+        except Exception:
+            return ""
+    return ""

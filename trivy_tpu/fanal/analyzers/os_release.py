@@ -1,0 +1,144 @@
+"""OS-detection analyzers.
+
+Mirrors pkg/fanal/analyzer/os/{release,alpine,debian,ubuntu} and
+pkg/fanal/analyzer/repo/apk (repository stream detection)."""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ... import types as T
+from . import AnalysisResult, Analyzer, register
+
+_OS_RELEASE_FAMILY = {
+    "alpine": T.OSFamily.ALPINE,
+    "opensuse-tumbleweed": T.OSFamily.OPENSUSE_TUMBLEWEED,
+    "opensuse-leap": T.OSFamily.OPENSUSE_LEAP,
+    "opensuse": T.OSFamily.OPENSUSE_LEAP,
+    "sles": T.OSFamily.SLES,
+    "photon": T.OSFamily.PHOTON,
+    "wolfi": T.OSFamily.WOLFI,
+    "chainguard": T.OSFamily.CHAINGUARD,
+}
+
+
+@register
+class OSReleaseAnalyzer(Analyzer):
+    name = "os-release"
+    version = 1
+    paths = ("etc/os-release", "usr/lib/os-release")
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path in self.paths
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        id_ = version_id = ""
+        for line in content.decode(errors="replace").splitlines():
+            if "=" not in line:
+                continue
+            key, value = (s.strip() for s in line.split("=", 1))
+            value = value.strip("\"'")
+            if key == "ID":
+                id_ = value
+            elif key == "VERSION_ID":
+                version_id = value
+            else:
+                continue
+            family = _OS_RELEASE_FAMILY.get(id_, "")
+            if family and version_id:
+                return AnalysisResult(os=T.OS(family=family, name=version_id))
+        return None
+
+
+@register
+class AlpineReleaseAnalyzer(Analyzer):
+    name = "alpine"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path == "etc/alpine-release"
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        line = content.decode(errors="replace").splitlines()
+        if not line:
+            return None
+        return AnalysisResult(os=T.OS(family=T.OSFamily.ALPINE,
+                                      name=line[0].strip()))
+
+
+@register
+class DebianVersionAnalyzer(Analyzer):
+    name = "debian"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path == "etc/debian_version"
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        lines = content.decode(errors="replace").splitlines()
+        if not lines:
+            return None
+        return AnalysisResult(os=T.OS(family=T.OSFamily.DEBIAN,
+                                      name=lines[0].strip()))
+
+
+@register
+class UbuntuAnalyzer(Analyzer):
+    name = "ubuntu"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path == "etc/lsb-release"
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        for line in content.decode(errors="replace").splitlines():
+            if line.startswith("DISTRIB_RELEASE="):
+                return AnalysisResult(os=T.OS(
+                    family=T.OSFamily.UBUNTU,
+                    name=line[len("DISTRIB_RELEASE="):].strip()))
+        return None
+
+
+_APK_REPO_RE = re.compile(
+    r"(https*|ftp)://[0-9A-Za-z.-]+/([A-Za-z]+)/v?([0-9A-Za-z_.-]+)/")
+
+
+@register
+class ApkRepoAnalyzer(Analyzer):
+    """Detects the configured Alpine repository release stream
+    (pkg/fanal/analyzer/repo/apk/apk.go) — it overrides the OS version in
+    the alpine detector when they disagree."""
+    name = "apk-repo"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path == "etc/apk/repositories"
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        family = ""
+        repo_ver = ""
+        for line in content.decode(errors="replace").splitlines():
+            m = _APK_REPO_RE.search(line)
+            if not m:
+                continue
+            new_family, new_ver = m.group(2), m.group(3)
+            if family and family != new_family:
+                return None  # mixed distributions: bail like the reference
+            family = new_family
+            # prefer "edge"; otherwise keep the highest version seen
+            if repo_ver != "edge":
+                if new_ver == "edge" or not repo_ver or \
+                        _ver_tuple(new_ver) > _ver_tuple(repo_ver):
+                    repo_ver = new_ver
+        if not family or not repo_ver:
+            return None
+        return AnalysisResult(repository=T.Repository(family=family,
+                                                      release=repo_ver))
+
+
+def _ver_tuple(v: str):
+    out = []
+    for p in v.split("."):
+        out.append(int(p) if p.isdigit() else 0)
+    return tuple(out)

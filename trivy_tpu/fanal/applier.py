@@ -1,0 +1,80 @@
+"""Layer squashing — overlay semantics over per-layer BlobInfos.
+
+Mirrors pkg/fanal/applier/docker.go ApplyLayers:91: iterate layers in
+order; whiteout files delete the shadowed path, opaque dirs wipe the
+accumulated subtree (docker.go:96-104); later OS detections win; package
+and application files replace by path; every final element is attributed
+to its origin layer — the FIRST layer that contained the same package
+(lookupOriginLayerForPkg, docker.go:40)."""
+
+from __future__ import annotations
+
+from .. import types as T
+
+
+def _delete_path(store: dict, path: str):
+    for key in [k for k in store
+                if k == path or k.startswith(path + "/")]:
+        del store[key]
+
+
+def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
+    detail = T.ArtifactDetail()
+    pkg_files: dict[str, tuple[T.PackageInfo, T.Layer]] = {}
+    app_files: dict[str, tuple[T.Application, T.Layer]] = {}
+    secret_files: dict[str, tuple[T.Secret, T.Layer]] = {}
+
+    for blob in blobs:
+        layer = T.Layer(digest=blob.digest, diff_id=blob.diff_id,
+                        created_by=blob.created_by)
+        for wh in blob.whiteout_files:
+            for store in (pkg_files, app_files, secret_files):
+                _delete_path(store, wh)
+        for od in blob.opaque_dirs:
+            for store in (pkg_files, app_files, secret_files):
+                _delete_path(store, od)
+        if blob.os.detected:
+            detail.os.merge(blob.os)
+        if blob.repository is not None:
+            detail.repository = blob.repository
+        for pi in blob.package_infos:
+            pkg_files[pi.file_path] = (pi, layer)
+        for app in blob.applications:
+            app_files[app.file_path] = (app, layer)
+        for sec in blob.secrets:
+            secret_files[sec.file_path] = (sec, layer)
+
+    origin = _origin_index(blobs)
+    for path in sorted(pkg_files):
+        pi, layer = pkg_files[path]
+        for pkg in pi.packages:
+            pkg.layer = origin.get((pkg.name, pkg.version, pkg.release), layer)
+            detail.packages.append(pkg)
+    for path in sorted(app_files):
+        app, layer = app_files[path]
+        for pkg in app.packages:
+            pkg.layer = origin.get((pkg.name, pkg.version, pkg.release), layer)
+        detail.applications.append(app)
+    for path in sorted(secret_files):
+        sec, layer = secret_files[path]
+        for finding in sec.findings:
+            finding.layer = layer
+        detail.secrets.append(sec)
+
+    detail.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
+    return detail
+
+
+def _origin_index(blobs) -> dict:
+    """(name, version, release) → first layer containing that package."""
+    origin: dict = {}
+    for blob in blobs:
+        layer = T.Layer(digest=blob.digest, diff_id=blob.diff_id,
+                        created_by=blob.created_by)
+        for pi in blob.package_infos:
+            for p in pi.packages:
+                origin.setdefault((p.name, p.version, p.release), layer)
+        for app in blob.applications:
+            for p in app.packages:
+                origin.setdefault((p.name, p.version, p.release), layer)
+    return origin

@@ -1,0 +1,195 @@
+"""Artifacts: things that can be inspected into (artifact_id, blob_ids)
+with per-blob analysis memoized in the cache.
+
+Mirrors pkg/fanal/artifact: image archives (docker-save tarballs,
+artifact/image/archive path), local filesystems (artifact/local/fs.go).
+Daemon/registry image sources are host-IO plumbing added later; archives
+are the benchmarkable ingest path (BASELINE.md config 3 uses tarballs)."""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import types as T
+from .analyzers import AnalyzerGroup
+from .cache import cache_key
+from .walker import blob_info, walk_fs, walk_layer_tar
+
+
+@dataclass
+class ArtifactReference:
+    name: str
+    type: str
+    id: str
+    blob_ids: list
+    image_metadata: Optional[T.Metadata] = None
+    secret_files: dict = field(default_factory=dict)  # blob_id → [(path, bytes)]
+
+
+class ImageArchiveArtifact:
+    """docker-save / OCI-archive tarball."""
+
+    def __init__(self, path: str, cache, group: Optional[AnalyzerGroup] = None,
+                 scanners: tuple = ("vuln",)):
+        self.path = path
+        self.cache = cache
+        self.group = group or AnalyzerGroup()
+        self.scanners = scanners
+
+    def inspect(self) -> ArtifactReference:
+        with tarfile.open(self.path) as tf:
+            names = tf.getnames()
+            if "manifest.json" in names:
+                return self._inspect_docker_archive(tf)
+            if "index.json" in names:
+                return self._inspect_oci_layout(tf)
+            raise ValueError(f"{self.path}: not a docker/oci image archive")
+
+    # --- docker-save format ---
+
+    def _inspect_docker_archive(self, tf: tarfile.TarFile):
+        manifest = json.load(tf.extractfile("manifest.json"))[0]
+        config = json.load(tf.extractfile(manifest["Config"]))
+        diff_ids = config.get("rootfs", {}).get("diff_ids", [])
+        layer_paths = manifest.get("Layers", [])
+        history = [h for h in config.get("history", [])
+                   if not h.get("empty_layer")]
+        created_by = [h.get("created_by", "") for h in history]
+        created_by += [""] * (len(diff_ids) - len(created_by))
+
+        image_id = "sha256:" + hashlib.sha256(
+            json.dumps(config, sort_keys=True).encode()).hexdigest()
+        versions = self.group.versions()
+        opts = {"scanners": sorted(self.scanners)}
+        artifact_id = cache_key(image_id, versions, opts)
+        blob_ids = [cache_key(d, versions, opts) for d in diff_ids]
+
+        missing_artifact, missing = self.cache.missing_blobs(artifact_id,
+                                                             blob_ids)
+        secret_files: dict = {}
+        want_secrets = "secret" in self.scanners
+        for diff_id, layer_path, blob_id, cb in zip(
+                diff_ids, layer_paths, blob_ids, created_by):
+            if blob_id not in missing:
+                continue
+            f = tf.extractfile(layer_path)
+            data = f.read()
+            if data[:2] == b"\x1f\x8b":
+                data = gzip.decompress(data)
+            with tarfile.open(fileobj=io.BytesIO(data)) as layer_tf:
+                scan = walk_layer_tar(layer_tf, self.group,
+                                      collect_secrets=want_secrets)
+            bi = blob_info(scan, diff_id=diff_id, created_by=cb)
+            if want_secrets and scan.secret_files:
+                secret_files[blob_id] = scan.secret_files
+            self.cache.put_blob(blob_id, bi)
+
+        metadata = T.Metadata(
+            image_id=image_id,
+            diff_ids=diff_ids,
+            repo_tags=manifest.get("RepoTags") or [],
+            image_config=config,
+        )
+        if missing_artifact:
+            self.cache.put_artifact(artifact_id, {
+                "SchemaVersion": 2,
+                "Architecture": config.get("architecture", ""),
+                "Created": config.get("created", ""),
+                "OS": config.get("os", ""),
+            })
+        name = self.path
+        if metadata.repo_tags:
+            name = metadata.repo_tags[0]
+        return ArtifactReference(
+            name=name, type=T.ArtifactType.CONTAINER_IMAGE, id=artifact_id,
+            blob_ids=blob_ids, image_metadata=metadata,
+            secret_files=secret_files)
+
+    # --- OCI image layout ---
+
+    def _inspect_oci_layout(self, tf: tarfile.TarFile):
+        index = json.load(tf.extractfile("index.json"))
+        mdesc = index["manifests"][0]
+        manifest = json.load(tf.extractfile(_blob_path(mdesc["digest"])))
+        config = json.load(tf.extractfile(
+            _blob_path(manifest["config"]["digest"])))
+        diff_ids = config.get("rootfs", {}).get("diff_ids", [])
+        history = [h for h in config.get("history", [])
+                   if not h.get("empty_layer")]
+        created_by = [h.get("created_by", "") for h in history]
+        created_by += [""] * (len(diff_ids) - len(created_by))
+
+        image_id = manifest["config"]["digest"]
+        versions = self.group.versions()
+        opts = {"scanners": sorted(self.scanners)}
+        artifact_id = cache_key(image_id, versions, opts)
+        blob_ids = [cache_key(d, versions, opts) for d in diff_ids]
+        _, missing = self.cache.missing_blobs(artifact_id, blob_ids)
+
+        secret_files: dict = {}
+        want_secrets = "secret" in self.scanners
+        for diff_id, ldesc, blob_id, cb in zip(diff_ids, manifest["layers"],
+                                               blob_ids, created_by):
+            if blob_id not in missing:
+                continue
+            data = tf.extractfile(_blob_path(ldesc["digest"])).read()
+            if data[:2] == b"\x1f\x8b":
+                data = gzip.decompress(data)
+            with tarfile.open(fileobj=io.BytesIO(data)) as layer_tf:
+                scan = walk_layer_tar(layer_tf, self.group,
+                                      collect_secrets=want_secrets)
+            bi = blob_info(scan, diff_id=diff_id, created_by=cb)
+            bi.digest = ldesc["digest"]
+            if want_secrets and scan.secret_files:
+                secret_files[blob_id] = scan.secret_files
+            self.cache.put_blob(blob_id, bi)
+
+        metadata = T.Metadata(image_id=image_id, diff_ids=diff_ids,
+                              image_config=config)
+        return ArtifactReference(
+            name=self.path, type=T.ArtifactType.CONTAINER_IMAGE,
+            id=artifact_id, blob_ids=blob_ids, image_metadata=metadata,
+            secret_files=secret_files)
+
+
+def _blob_path(digest: str) -> str:
+    algo, hexd = digest.split(":", 1)
+    return f"blobs/{algo}/{hexd}"
+
+
+class FilesystemArtifact:
+    """A directory tree as one synthetic blob
+    (pkg/fanal/artifact/local/fs.go:114)."""
+
+    def __init__(self, root: str, cache, group: Optional[AnalyzerGroup] = None,
+                 scanners: tuple = ("vuln",)):
+        self.root = root
+        self.cache = cache
+        self.group = group or AnalyzerGroup()
+        self.scanners = scanners
+
+    def inspect(self) -> ArtifactReference:
+        want_secrets = "secret" in self.scanners
+        scan = walk_fs(self.root, self.group, collect_secrets=want_secrets)
+        bi = blob_info(scan)
+        blob_id = cache_key(self._content_id(bi), self.group.versions(),
+                            {"scanners": sorted(self.scanners)})
+        self.cache.put_blob(blob_id, bi)
+        self.cache.put_artifact(blob_id, {"SchemaVersion": 2})
+        secret_files = {blob_id: scan.secret_files} if scan.secret_files else {}
+        return ArtifactReference(
+            name=os.path.abspath(self.root).rstrip("/"),
+            type=T.ArtifactType.FILESYSTEM,
+            id=blob_id, blob_ids=[blob_id], secret_files=secret_files)
+
+    @staticmethod
+    def _content_id(bi: T.BlobInfo) -> str:
+        return "sha256:" + hashlib.sha256(
+            json.dumps(bi.to_json(), sort_keys=True).encode()).hexdigest()

@@ -1,0 +1,127 @@
+"""Walkers: enumerate files of a layer tar or a directory tree and feed
+them to the analyzer group.
+
+Mirrors pkg/fanal/walker/tar.go (whiteout handling: a basename prefix
+``.wh.`` marks a deletion, ``.wh..wh..opq`` marks the directory opaque)
+and walker/fs.go. Also collects secret-scan candidate bytes so the secret
+engine can run once, batched, per blob instead of per file."""
+
+from __future__ import annotations
+
+import os
+import tarfile
+from dataclasses import dataclass, field
+
+from .. import types as T
+from .analyzers import AnalysisResult, AnalyzerGroup
+
+WH_PREFIX = ".wh."
+OPAQUE_MARKER = ".wh..wh..opq"
+
+# secret-candidate gates (pkg/fanal/analyzer/secret/secret.go:27-41,115-119)
+MAX_SECRET_SIZE = 10 * 1024 * 1024
+_SKIP_EXTS = {
+    ".jpg", ".png", ".gif", ".doc", ".pdf", ".bin", ".svg", ".socket",
+    ".deb", ".rpm", ".zip", ".gz", ".gzip", ".tar", ".pyc",
+}
+
+
+def secret_candidate(path: str, size: int) -> bool:
+    if size < 0 or size > MAX_SECRET_SIZE:
+        return False
+    base = os.path.basename(path)
+    _, ext = os.path.splitext(base)
+    return ext.lower() not in _SKIP_EXTS
+
+
+def looks_binary(content: bytes) -> bool:
+    probe = content[:8000]
+    return b"\x00" in probe
+
+
+@dataclass
+class BlobScan:
+    """Result of walking one blob (layer or filesystem snapshot)."""
+    result: AnalysisResult
+    whiteout_files: list = field(default_factory=list)
+    opaque_dirs: list = field(default_factory=list)
+    secret_files: list = field(default_factory=list)  # [(path, bytes)]
+
+
+def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
+                   collect_secrets: bool = False) -> BlobScan:
+    scan = BlobScan(result=AnalysisResult())
+    for member in tf:
+        path = member.name.lstrip("./").lstrip("/")
+        if not path:
+            continue
+        dirname, base = os.path.split(path)
+        if base == OPAQUE_MARKER:
+            scan.opaque_dirs.append(dirname)
+            continue
+        if base.startswith(WH_PREFIX):
+            scan.whiteout_files.append(os.path.join(dirname,
+                                                    base[len(WH_PREFIX):]))
+            continue
+        if not (member.isfile() or member.islnk()):
+            continue
+        wants = group.required(path, member.size)
+        wants_secret = collect_secrets and secret_candidate(path, member.size)
+        if not (wants or wants_secret):
+            continue
+        f = tf.extractfile(member)
+        if f is None:
+            continue
+        content = f.read()
+        if wants:
+            group.analyze_file(path, content, scan.result)
+        if wants_secret and not looks_binary(content):
+            scan.secret_files.append((path, content))
+    return scan
+
+
+def walk_fs(root: str, group: AnalyzerGroup,
+            collect_secrets: bool = False,
+            skip_dirs: tuple = (".git",)) -> BlobScan:
+    scan = BlobScan(result=AnalysisResult())
+    root = os.path.abspath(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+        for fn in sorted(filenames):
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            try:
+                size = os.path.getsize(full)
+            except OSError:
+                continue
+            wants = group.required(rel, size)
+            wants_secret = collect_secrets and secret_candidate(rel, size)
+            if not (wants or wants_secret):
+                continue
+            try:
+                with open(full, "rb") as f:
+                    content = f.read()
+            except OSError:
+                continue  # permission errors are skipped (walker/fs.go:24-33)
+            if wants:
+                group.analyze_file(rel, content, scan.result)
+            if wants_secret and not looks_binary(content):
+                scan.secret_files.append((rel, content))
+    return scan
+
+
+def blob_info(scan: BlobScan, diff_id: str = "",
+              created_by: str = "") -> T.BlobInfo:
+    r = scan.result
+    return T.BlobInfo(
+        diff_id=diff_id,
+        created_by=created_by,
+        opaque_dirs=sorted(scan.opaque_dirs),
+        whiteout_files=sorted(scan.whiteout_files),
+        os=r.os or T.OS(),
+        repository=r.repository,
+        package_infos=sorted(r.package_infos, key=lambda p: p.file_path),
+        applications=sorted(r.applications, key=lambda a: a.file_path),
+        secrets=r.secrets,
+        licenses=r.licenses,
+    )

@@ -1,0 +1,80 @@
+"""Report assembly and writers.
+
+JSON matches the reference schema (pkg/types/report.go SchemaVersion 2,
+Go PascalCase field names with omitempty) so outputs are diffable against
+the reference CLI — the zero-CVE-diff acceptance gate (BASELINE.md).
+Table output mirrors pkg/report/table for human use."""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import Counter
+
+from .. import types as T
+
+
+def build_report(artifact_name: str, artifact_type: str,
+                 results: list[T.Result], os_info=None,
+                 metadata: T.Metadata | None = None,
+                 created_at: str = "") -> T.Report:
+    metadata = metadata or T.Metadata()
+    if os_info is not None and os_info.detected:
+        metadata.os = os_info
+    return T.Report(
+        schema_version=2,
+        created_at=created_at,
+        artifact_name=artifact_name,
+        artifact_type=artifact_type,
+        metadata=metadata,
+        results=results,
+    )
+
+
+def to_json(report: T.Report) -> str:
+    return json.dumps(report.to_json(), indent=2, ensure_ascii=False)
+
+
+_SEV_ORDER = {s: i for i, s in enumerate(T.SEVERITIES)}
+
+
+def to_table(report: T.Report) -> str:
+    lines = []
+    for res in report.results:
+        if not (res.vulnerabilities or res.secrets):
+            continue
+        counts = Counter(v.severity for v in res.vulnerabilities)
+        total = sum(counts.values())
+        summary = ", ".join(
+            f"{s}: {counts.get(s, 0)}"
+            for s in reversed(T.SEVERITIES) if counts.get(s))
+        lines.append("")
+        lines.append(res.target)
+        lines.append("=" * len(res.target))
+        lines.append(f"Total: {total}" + (f" ({summary})" if summary else ""))
+        lines.append("")
+        if res.vulnerabilities:
+            rows = [("Library", "Vulnerability", "Severity", "Installed",
+                     "Fixed In", "Title")]
+            for v in sorted(res.vulnerabilities,
+                            key=lambda v: -_SEV_ORDER.get(v.severity, 0)):
+                rows.append((v.pkg_name, v.vulnerability_id, v.severity,
+                             v.installed_version, v.fixed_version,
+                             (v.vulnerability.title or "")[:60]))
+            widths = [max(len(r[i]) for r in rows) for i in range(6)]
+            for r in rows:
+                lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+        for finding in res.secrets:
+            lines.append(f"{finding.severity}: {finding.title} "
+                         f"(line {finding.start_line})")
+    return "\n".join(lines) + "\n"
+
+
+def write_report(report: T.Report, fmt: str = "json", output=None) -> None:
+    out = output or sys.stdout
+    if fmt == "json":
+        out.write(to_json(report) + "\n")
+    elif fmt == "table":
+        out.write(to_table(report))
+    else:
+        raise ValueError(f"unsupported format {fmt!r}")

@@ -1,0 +1,100 @@
+"""Local scan driver: the orchestration the reference runs per target in
+pkg/scanner/local/scan.go — ApplyLayers → OS/lang-package detection →
+FillInfo → result assembly. Detection runs as batched device joins.
+
+This object is the third `scanner.Driver` implementation the survey calls
+for (pkg/scanner/scan.go:131-134): same (target, artifact_id, blob_ids,
+options) → (results, os) contract, but the inner loops are TPU programs.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Optional
+
+from . import types as T
+from .db.table import AdvisoryTable
+from .detect.engine import BatchDetector
+from .detect.fill import fill_info
+from .detect.langpkg import LangpkgScanner
+from .detect.ospkg import OspkgScanner
+from .fanal.applier import apply_layers
+
+
+class LocalScanner:
+    def __init__(self, cache, table: AdvisoryTable):
+        self.cache = cache
+        self.table = table
+        self.detector = BatchDetector(table)
+        self.ospkg = OspkgScanner(self.detector)
+        self.langpkg = LangpkgScanner(self.detector)
+
+    def scan(self, target: str, artifact_id: str, blob_ids: list[str],
+             options: Optional[T.ScanOptions] = None,
+             now: Optional[dt.datetime] = None
+             ) -> tuple[list[T.Result], T.OS]:
+        options = options or T.ScanOptions()
+        blobs = []
+        for bid in blob_ids:
+            blob = self.cache.get_blob(bid)
+            if blob is None:
+                raise KeyError(f"missing blob {bid} in cache "
+                               f"(artifact {artifact_id})")
+            blobs.append(blob)
+        detail = apply_layers(blobs)
+        results: list[T.Result] = []
+        os_info = detail.os
+
+        if T.Scanner.VULN in options.scanners:
+            if detail.os.detected and "os" in options.pkg_types:
+                vulns, eosl = self.ospkg.scan(detail.os, detail.repository,
+                                              detail.packages, now=now)
+                fill_info(vulns, self.table.details)
+                vulns.sort(key=_vuln_sort_key)
+                if eosl:
+                    os_info.eosl = True
+                if detail.packages or vulns:
+                    res = T.Result(
+                        target=f"{target} ({detail.os.family} "
+                               f"{detail.os.name})",
+                        clazz=T.ResultClass.OS_PKGS,
+                        type=detail.os.family,
+                        vulnerabilities=vulns,
+                    )
+                    if options.list_all_packages:
+                        res.packages = sorted(
+                            detail.packages,
+                            key=lambda p: (p.name, p.version))
+                    results.append(res)
+            if "library" in options.pkg_types:
+                for app in sorted(detail.applications,
+                                  key=lambda a: (a.file_path, a.type)):
+                    vulns = self.langpkg.scan_app(app)
+                    fill_info(vulns, self.table.details)
+                    vulns.sort(key=_vuln_sort_key)
+                    if not vulns and not options.list_all_packages:
+                        continue
+                    res = T.Result(
+                        target=app.file_path or app.type,
+                        clazz=T.ResultClass.LANG_PKGS,
+                        type=app.type,
+                        vulnerabilities=vulns,
+                    )
+                    if options.list_all_packages:
+                        res.packages = sorted(
+                            app.packages, key=lambda p: (p.name, p.version))
+                    results.append(res)
+
+        if T.Scanner.SECRET in options.scanners:
+            for sec in detail.secrets:
+                results.append(T.Result(
+                    target=sec.file_path,
+                    clazz=T.ResultClass.SECRET,
+                    secrets=sec.findings,
+                ))
+
+        return results, os_info
+
+
+def _vuln_sort_key(v: T.DetectedVulnerability):
+    return (v.pkg_name, v.pkg_path, v.vulnerability_id, v.installed_version)
