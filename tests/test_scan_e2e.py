@@ -159,3 +159,45 @@ class TestWhiteout:
         ])
         _, results, _ = scan_image(p, table)
         assert not any(r.clazz == "lang-pkgs" for r in results)
+
+
+class TestSecretScan:
+    def test_image_secret_scan(self, tmp_path, table):
+        ghp = "ghp_" + "c" * 36
+        p = str(tmp_path / "sec.tar")
+        make_image(p, [
+            {
+                "etc/os-release": ALPINE_OS_RELEASE,
+                "app/config.env": f"TOKEN={ghp}\n".encode(),
+            },
+        ])
+        _, results, _ = scan_image(p, table, scanners=("vuln", "secret"))
+        sec = [r for r in results if r.clazz == "secret"]
+        assert len(sec) == 1
+        assert sec[0].target == "app/config.env"
+        f = sec[0].secrets[0]
+        assert f.rule_id == "github-pat"
+        # layer attribution survives the applier
+        assert f.layer.diff_id.startswith("sha256:")
+
+    def test_fs_secret_scan(self, tmp_path, table):
+        from trivy_tpu.fanal.artifact import FilesystemArtifact
+        from trivy_tpu.fanal.cache import MemoryCache
+        root = tmp_path / "proj"
+        root.mkdir()
+        (root / "creds.txt").write_text("key = sk_live_abcdef1234567890\n")
+        (root / "requirements.txt").write_text("flask==2.2.2\nrequests==2.31.0\n")
+        cache = MemoryCache()
+        art = FilesystemArtifact(str(root), cache,
+                                 scanners=("vuln", "secret"))
+        ref = art.inspect()
+        scanner = LocalScanner(cache, table)
+        opts = T.ScanOptions(scanners=("vuln", "secret"))
+        results, _ = scanner.scan(ref.name, ref.id, ref.blob_ids, opts)
+        classes = sorted(r.clazz for r in results)
+        assert classes == ["lang-pkgs", "secret"]
+        lang = next(r for r in results if r.clazz == "lang-pkgs")
+        assert [v.vulnerability_id for v in lang.vulnerabilities] == \
+            ["CVE-2023-30861"]
+        sec = next(r for r in results if r.clazz == "secret")
+        assert sec.secrets[0].rule_id == "stripe-secret-token"

@@ -37,11 +37,15 @@ class ImageArchiveArtifact:
     """docker-save / OCI-archive tarball."""
 
     def __init__(self, path: str, cache, group: Optional[AnalyzerGroup] = None,
-                 scanners: tuple = ("vuln",)):
+                 scanners: tuple = ("vuln",), secret_scanner=None):
         self.path = path
         self.cache = cache
         self.group = group or AnalyzerGroup()
         self.scanners = scanners
+        self.secret_scanner = secret_scanner
+        if "secret" in scanners and secret_scanner is None:
+            from ..secret import SecretScanner
+            self.secret_scanner = SecretScanner()
 
     def inspect(self) -> ArtifactReference:
         with tarfile.open(self.path) as tf:
@@ -89,6 +93,8 @@ class ImageArchiveArtifact:
             bi = blob_info(scan, diff_id=diff_id, created_by=cb)
             if want_secrets and scan.secret_files:
                 secret_files[blob_id] = scan.secret_files
+                bi.secrets = self.secret_scanner.scan_files(
+                    scan.secret_files)
             self.cache.put_blob(blob_id, bi)
 
         metadata = T.Metadata(
@@ -149,6 +155,8 @@ class ImageArchiveArtifact:
             bi.digest = ldesc["digest"]
             if want_secrets and scan.secret_files:
                 secret_files[blob_id] = scan.secret_files
+                bi.secrets = self.secret_scanner.scan_files(
+                    scan.secret_files)
             self.cache.put_blob(blob_id, bi)
 
         metadata = T.Metadata(image_id=image_id, diff_ids=diff_ids,
@@ -169,16 +177,22 @@ class FilesystemArtifact:
     (pkg/fanal/artifact/local/fs.go:114)."""
 
     def __init__(self, root: str, cache, group: Optional[AnalyzerGroup] = None,
-                 scanners: tuple = ("vuln",)):
+                 scanners: tuple = ("vuln",), secret_scanner=None):
         self.root = root
         self.cache = cache
         self.group = group or AnalyzerGroup()
         self.scanners = scanners
+        self.secret_scanner = secret_scanner
+        if "secret" in scanners and secret_scanner is None:
+            from ..secret import SecretScanner
+            self.secret_scanner = SecretScanner()
 
     def inspect(self) -> ArtifactReference:
         want_secrets = "secret" in self.scanners
         scan = walk_fs(self.root, self.group, collect_secrets=want_secrets)
         bi = blob_info(scan)
+        if want_secrets and scan.secret_files:
+            bi.secrets = self.secret_scanner.scan_files(scan.secret_files)
         blob_id = cache_key(self._content_id(bi), self.group.versions(),
                             {"scanners": sorted(self.scanners)})
         self.cache.put_blob(blob_id, bi)

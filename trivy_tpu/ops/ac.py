@@ -1,0 +1,136 @@
+"""Aho-Corasick keyword prefilter on device.
+
+The reference gates each of its 86 secret rules on a bytes.Contains
+keyword check before running the rule regex
+(pkg/fanal/secret/scanner.go:363-371) — that prefilter is the bulk of the
+scan cost over a filesystem. Here all rules' keywords become ONE automaton:
+
+  host:   build trans[S, 256] + per-state keyword bitmask out_bits[S, W]
+          (failure links folded in, so the DFA needs no fallback loop);
+  device: lax.scan over chunk byte columns — one gather per byte per chunk
+          batch, OR-accumulating the keyword bitmask per chunk.
+
+Files are packed into fixed [B, L] uint8 chunk tensors with an overlap of
+max keyword length - 1 so boundary-straddling keywords are still seen.
+Regex confirmation of gated (file, rule) pairs runs host-side for exact
+parity (SURVEY.md §7 step 6).
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_LOWER = np.arange(256, dtype=np.uint8)
+_LOWER[65:91] += 32  # A-Z → a-z
+
+
+def lower_bytes(data: bytes) -> np.ndarray:
+    return _LOWER[np.frombuffer(data, dtype=np.uint8)]
+
+
+@dataclass
+class Automaton:
+    trans: np.ndarray      # int32[S, 256] DFA transitions
+    out_bits: np.ndarray   # int32[S, W] keyword bitmask reachable at state
+    n_keywords: int
+    max_kw_len: int
+
+    @property
+    def words(self) -> int:
+        return self.out_bits.shape[1]
+
+
+def build_automaton(keywords: list[bytes]) -> Automaton:
+    """Keywords are matched case-insensitively (lowercased here; input
+    tensors must be lowercased with lower_bytes)."""
+    kws = [bytes(_LOWER[np.frombuffer(k, np.uint8)]) for k in keywords]
+    # trie
+    children: list[dict[int, int]] = [{}]
+    out: list[set[int]] = [set()]
+    for ki, kw in enumerate(kws):
+        node = 0
+        for b in kw:
+            nxt = children[node].get(b)
+            if nxt is None:
+                nxt = len(children)
+                children[node][b] = nxt
+                children.append({})
+                out.append(set())
+            node = nxt
+        out[node].add(ki)
+    # BFS failure links → DFA
+    s = len(children)
+    trans = np.zeros((s, 256), dtype=np.int32)
+    fail = np.zeros(s, dtype=np.int32)
+    q = deque()
+    for b, nxt in children[0].items():
+        trans[0, b] = nxt
+        q.append(nxt)
+    while q:
+        node = q.popleft()
+        out[node] |= out[fail[node]]
+        for b in range(256):
+            nxt = children[node].get(b)
+            if nxt is None:
+                trans[node, b] = trans[fail[node], b]
+            else:
+                fail[nxt] = trans[fail[node], b]
+                trans[node, b] = nxt
+                q.append(nxt)
+    words = max(1, (len(kws) + 31) // 32)
+    out_bits = np.zeros((s, words), dtype=np.int32)
+    for node, kset in enumerate(out):
+        for ki in kset:
+            out_bits[node, ki // 32] |= np.int32(
+                (1 << (ki % 32)) - (1 << 32 if ki % 32 == 31 else 0))
+    return Automaton(trans=trans, out_bits=out_bits, n_keywords=len(kws),
+                     max_kw_len=max((len(k) for k in kws), default=1))
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def ac_scan(trans, out_bits, chunks):
+    """chunks: uint8[B, L] (lowercased) → int32[B, W] keyword bitmask."""
+    b = chunks.shape[0]
+
+    def step(carry, byte_col):
+        state, acc = carry
+        state = trans[state, byte_col]
+        acc = acc | out_bits[state]
+        return (state, acc), None
+
+    init = (jnp.zeros(b, dtype=jnp.int32),
+            jnp.zeros((b, out_bits.shape[1]), dtype=jnp.int32))
+    (_, acc), _ = jax.lax.scan(step, init, chunks.T.astype(jnp.int32))
+    return acc
+
+
+def pack_chunks(files: list[bytes], chunk_len: int,
+                overlap: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pack lowercased file bytes into [B, chunk_len] with per-chunk file
+    index map [B]. Stride = chunk_len - overlap."""
+    stride = max(1, chunk_len - overlap)
+    rows, owner = [], []
+    for fi, data in enumerate(files):
+        arr = lower_bytes(data) if data else np.zeros(0, np.uint8)
+        if len(arr) == 0:
+            continue
+        for off in range(0, len(arr), stride):
+            piece = arr[off:off + chunk_len]
+            if off > 0 and len(piece) <= overlap:
+                break  # fully covered by the previous chunk
+            row = np.zeros(chunk_len, dtype=np.uint8)
+            row[:len(piece)] = piece
+            rows.append(row)
+            owner.append(fi)
+            if off + chunk_len >= len(arr):
+                break
+    if not rows:
+        return (np.zeros((0, chunk_len), np.uint8), np.zeros(0, np.int64))
+    return np.stack(rows), np.asarray(owner)

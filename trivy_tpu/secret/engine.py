@@ -1,0 +1,248 @@
+"""SecretScanner: batched keyword prefilter on device, exact rule
+confirmation on host.
+
+Parity contract with the reference scanner (pkg/fanal/secret/scanner.go
+Scan:341-418): per file — global allow paths, per-rule path gates, keyword
+prefilter (here: one device Aho-Corasick pass over all files × all rules
+instead of bytes.Contains per rule per file), regex locations with optional
+secret-group submatch, allow regexes, exclude blocks, censoring, line/
+context extraction (findLocation:447-504), finding sort.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import numpy as np
+
+from .. import types as T
+from ..ops import ac
+from .rules import BUILTIN_RULES, GLOBAL_ALLOW_RULES, Rule
+
+CHUNK_LEN = 16384
+
+
+class SecretScanner:
+    def __init__(self, rules: Optional[list[Rule]] = None,
+                 allow_rules: Optional[list] = None,
+                 use_device: bool = True):
+        self.rules = rules if rules is not None else BUILTIN_RULES
+        self.global_allow = (allow_rules if allow_rules is not None
+                             else GLOBAL_ALLOW_RULES)
+        self.use_device = use_device
+        # keyword → rule bitset mapping for the shared automaton
+        self._keywords: list[bytes] = []
+        self._kw_rules: list[list[int]] = []
+        kw_index: dict[bytes, int] = {}
+        self._no_keyword_rules = []
+        for ri, rule in enumerate(self.rules):
+            if not rule.keywords:
+                self._no_keyword_rules.append(ri)
+                continue
+            for kw in rule.keywords:
+                k = kw.lower().encode()
+                if k not in kw_index:
+                    kw_index[k] = len(self._keywords)
+                    self._keywords.append(k)
+                    self._kw_rules.append([])
+                self._kw_rules[kw_index[k]].append(ri)
+        self._automaton = ac.build_automaton(self._keywords) \
+            if self._keywords else None
+        self._device_arrays = None
+
+    # --- device prefilter ---
+
+    def _keyword_masks(self, files: list[bytes]) -> list[set[int]]:
+        """→ per-file set of rule indices whose keywords appear."""
+        if self._automaton is None:
+            return [set() for _ in files]
+        if self.use_device:
+            try:
+                return self._keyword_masks_device(files)
+            except Exception:  # device unavailable: host fallback
+                pass
+        return self._keyword_masks_host(files)
+
+    def _keyword_masks_host(self, files: list[bytes]) -> list[set[int]]:
+        out = []
+        for data in files:
+            low = bytes(ac.lower_bytes(data)) if data else b""
+            hit = set()
+            for ki, kw in enumerate(self._keywords):
+                if kw in low:
+                    hit.update(self._kw_rules[ki])
+            out.append(hit)
+        return out
+
+    def _keyword_masks_device(self, files: list[bytes]) -> list[set[int]]:
+        import jax.numpy as jnp
+        auto = self._automaton
+        overlap = auto.max_kw_len - 1
+        chunks, owner = ac.pack_chunks(files, CHUNK_LEN, overlap)
+        out: list[set[int]] = [set() for _ in files]
+        if chunks.shape[0] == 0:
+            return out
+        if self._device_arrays is None:
+            import jax
+            self._device_arrays = (jax.device_put(auto.trans),
+                                   jax.device_put(auto.out_bits))
+        trans, out_bits = self._device_arrays
+        masks = np.asarray(ac.ac_scan(trans, out_bits, jnp.asarray(chunks)))
+        for row, fi in zip(masks, owner):
+            for w, word in enumerate(row):
+                word = int(word) & 0xFFFFFFFF
+                while word:
+                    b = (word & -word).bit_length() - 1
+                    ki = w * 32 + b
+                    out[fi].update(self._kw_rules[ki])
+                    word &= word - 1
+        return out
+
+    # --- host confirmation (exact reference semantics) ---
+
+    def scan_files(self, files: list[tuple[str, bytes]]) -> list[T.Secret]:
+        """files: [(path, content)] → per-file Secret results (empty
+        findings omitted)."""
+        paths = [p for p, _ in files]
+        contents = [c for _, c in files]
+        masks = self._keyword_masks(contents)
+        results = []
+        for (path, content), rule_idx in zip(files, masks):
+            rule_idx = set(rule_idx) | set(self._no_keyword_rules)
+            sec = self.scan_file(path, content, candidate_rules=rule_idx)
+            if sec.findings:
+                results.append(sec)
+        return results
+
+    def scan_file(self, path: str, content: bytes,
+                  candidate_rules: Optional[set] = None) -> T.Secret:
+        if any(a.path and a.path.search(path) for a in self.global_allow):
+            return T.Secret(file_path=path)
+        text = content.decode("utf-8", errors="surrogateescape")
+        censored = None
+        matched = []
+        if candidate_rules is None:
+            low = bytes(ac.lower_bytes(content)) if content else b""
+        for ri, rule in enumerate(self.rules):
+            if candidate_rules is not None and ri not in candidate_rules:
+                continue
+            if not rule.match_path(path):
+                continue
+            if rule.allow_path(path):
+                continue
+            if candidate_rules is None and not rule.match_keywords(low):
+                continue
+            locs = self._find_locations(rule, text)
+            if not locs:
+                continue
+            exb = _blocks(text, rule.exclude_regexes)
+            for start, end in locs:
+                if _in_blocks(start, end, exb):
+                    continue
+                matched.append((rule, start, end))
+                if censored is None:
+                    censored = list(text)
+                for i in range(start, end):
+                    censored[i] = "*"
+        if not matched:
+            return T.Secret(file_path=path)
+        censored_text = "".join(censored)
+        findings = [self._to_finding(rule, s, e, censored_text)
+                    for rule, s, e in matched]
+        findings.sort(key=lambda f: (f.rule_id, f.match))
+        return T.Secret(file_path=path, findings=findings)
+
+    def _find_locations(self, rule: Rule, text: str):
+        locs = []
+        if rule.secret_group:
+            for m in rule.regex.finditer(text):
+                if self._allowed(rule, m.group(0)):
+                    continue
+                try:
+                    s, e = m.span(rule.secret_group)
+                except (IndexError, re.error):
+                    continue
+                if s >= 0:
+                    locs.append((s, e))
+        else:
+            for m in rule.regex.finditer(text):
+                if self._allowed(rule, m.group(0)):
+                    continue
+                locs.append(m.span())
+        return locs
+
+    def _allowed(self, rule: Rule, match: str) -> bool:
+        if any(a.regex and a.regex.search(match) for a in self.global_allow):
+            return True
+        return rule.allow_match(match)
+
+    @staticmethod
+    def _to_finding(rule: Rule, start: int, end: int,
+                    content: str) -> T.SecretFinding:
+        start_line, end_line, code, match_line = _find_location(
+            start, end, content)
+        return T.SecretFinding(
+            rule_id=rule.id,
+            category=rule.category,
+            severity=rule.severity or "UNKNOWN",
+            title=rule.title,
+            start_line=start_line,
+            end_line=end_line,
+            code=code,
+            match=match_line,
+        )
+
+
+def _blocks(text: str, regexes) -> list[tuple[int, int]]:
+    out = []
+    for rx in regexes:
+        for m in rx.finditer(text):
+            out.append(m.span())
+    return out
+
+
+def _in_blocks(start: int, end: int, blocks) -> bool:
+    return any(bs <= start and end <= be for bs, be in blocks)
+
+
+_RADIUS = 2  # context lines above/below (scanner.go secretHighlightRadius)
+
+
+def _find_location(start: int, end: int, content: str):
+    """Line numbers, context code window, and the censored match line —
+    reference findLocation (scanner.go:447-504)."""
+    start_line_num = content.count("\n", 0, start)
+    line_start = content.rfind("\n", 0, start)
+    line_start = 0 if line_start == -1 else line_start + 1
+    line_end = content.find("\n", start)
+    line_end = len(content) if line_end == -1 else line_end
+    if line_end - line_start > 100:
+        line_start = max(start - 30, 0)
+        line_end = min(end + 20, len(content))
+    match_line = content[line_start:line_end]
+    end_line_num = start_line_num + content.count("\n", start, end)
+
+    lines = content.split("\n")
+    code_start = max(start_line_num - _RADIUS, 0)
+    code_end = min(end_line_num + _RADIUS, len(lines))
+    code_lines = []
+    found_first = False
+    for i, raw in enumerate(lines[code_start:code_end]):
+        real = code_start + i
+        in_cause = start_line_num <= real <= end_line_num
+        code_lines.append(T.CodeLine(
+            number=code_start + i + 1,
+            content=raw,
+            is_cause=in_cause,
+            highlighted=raw,
+            first_cause=in_cause and not found_first,
+            last_cause=False,
+        ))
+        found_first = found_first or in_cause
+    for cl in reversed(code_lines):
+        if cl.is_cause:
+            cl.last_cause = True
+            break
+    return (start_line_num + 1, end_line_num + 1,
+            T.Code(lines=code_lines), match_line)
