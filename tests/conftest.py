@@ -1,11 +1,18 @@
 """Test harness: force an 8-device virtual CPU platform so mesh/sharding
-tests run without TPU hardware (the driver separately dry-runs multichip)."""
+tests run hermetically without TPU hardware (the driver separately
+dry-runs the multichip path; bench.py uses the real chip).
+
+Note: the axon sitecustomize pins jax_platforms to the TPU tunnel, so a
+config update after import — not just the env var — is required."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

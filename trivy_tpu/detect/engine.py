@@ -1,13 +1,13 @@
-"""BatchDetector: host orchestration around ops.join.advisory_join.
+"""BatchDetector: host orchestration around ops.join.
 
 Pipeline per batch (SURVEY.md §7 step 3):
-  host: encode (source, name, version) → hash pairs + version keys,
-        pad the batch to a power-of-two bucket (avoids recompile storms);
-  device: one advisory_join call → hash-match / satisfied masks;
-  host: for the few matched rows — verify the package name against the
-        advisory group (hash-collision guard), group rows into advisories
-        (positive minus negative polarity), re-check rows flagged INEXACT
-        with the exact comparator.
+  host: encode (source, name, version) → hash pairs + version keys
+        (both memoized — registry sweeps reuse versions heavily), pad the
+        batch to a power-of-two bucket (avoids recompile storms);
+  device: one advisory_join_packed call → 2-bit report mask + row idx;
+  host: numpy group-by over the few reported rows — package-name
+        verification (hash-collision guard), positive minus negative
+        polarity per advisory group, exact re-check of INEXACT rows.
 
 The reference evaluates the same predicate one package at a time
 (pkg/detector/ospkg/alpine/alpine.go:86-117, library/driver.go:111-136).
@@ -57,6 +57,7 @@ class BatchDetector:
     def __init__(self, table: AdvisoryTable):
         self.table = table
         self._key_cache: dict[tuple[str, str], Optional[V.VersionKey]] = {}
+        self._hash_cache: dict[tuple[str, str], np.ndarray] = {}
 
     def _encode(self, eco: str, ver: str) -> Optional[V.VersionKey]:
         ck = (eco, ver)
@@ -69,74 +70,109 @@ class BatchDetector:
                 self._key_cache[ck] = None
         return self._key_cache[ck]
 
-    def detect(self, queries: list[PkgQuery]) -> list[Hit]:
-        import jax.numpy as jnp
-        t = self.table
-        if len(t) == 0 or not queries:
-            return []
+    def _hash(self, source: str, name: str) -> np.ndarray:
+        ck = (source, name)
+        h = self._hash_cache.get(ck)
+        if h is None:
+            h = split_u64([key_hash(source, name)])[0]
+            self._hash_cache[ck] = h
+        return h
 
+    def _prepare(self, queries: list[PkgQuery]):
+        """→ (usable, packed int32 [B, K+3]) or (.., None) if empty.
+        Versions and (source, name) hashes are memoized separately — they
+        recur independently across a sweep even when their combination is
+        unique per image."""
+        t = self.table
         usable: list[tuple[PkgQuery, V.VersionKey]] = []
         for q in queries:
             k = self._encode(q.ecosystem, q.version)
             if k is not None:
                 usable.append((q, k))
         if not usable:
-            return []
-
+            return usable, None
         b = _next_pow2(len(usable))
         kw = t.lo_tok.shape[1]
-        pkg_hash = np.zeros((b, 2), np.int32)
-        pkg_tok = np.zeros((b, kw), np.int32)
-        pkg_valid = np.zeros(b, bool)
-        hashes = [key_hash(q.source, q.name) for q, _ in usable]
-        pkg_hash[:len(usable)] = split_u64(hashes)
-        for i, (_, k) in enumerate(usable):
-            pkg_tok[i] = k.tokens
-        pkg_valid[:len(usable)] = True
+        packed = np.zeros((b, kw + 3), np.int32)
+        for i, (q, k) in enumerate(usable):
+            packed[i, 0:2] = self._hash(q.source, q.name)
+            packed[i, 3:] = k.tokens
+        packed[:len(usable), 2] = 1
+        return usable, packed
 
-        adv_hash, adv_lo, adv_hi, adv_flags = t.device_arrays()
-        hmatch, sat, idx = J.advisory_join(
-            adv_hash, adv_lo, adv_hi, adv_flags,
-            jnp.asarray(pkg_hash), jnp.asarray(pkg_tok),
-            jnp.asarray(pkg_valid), window=t.window)
-        hmatch = np.asarray(hmatch)
-        sat = np.asarray(sat)
-        idx = np.asarray(idx)
+    def _dispatch(self, packed):
+        """Launch the join; returns the device array (async)."""
+        import jax.numpy as jnp
+        adv = self.table.device_arrays()
+        return J.advisory_join_io(*adv, jnp.asarray(packed),
+                                  window=self.table.window)
 
-        return self._assemble(usable, hmatch, sat, idx)
+    def detect(self, queries: list[PkgQuery]) -> list[Hit]:
+        if len(self.table) == 0 or not queries:
+            return []
+        usable, packed = self._prepare(queries)
+        if packed is None:
+            return []
+        out = np.asarray(self._dispatch(packed))
+        return self._assemble(usable, out & 3, out >> 2)
 
-    def _assemble(self, usable, hmatch, sat, idx) -> list[Hit]:
+    def detect_many(self, batches: list[list[PkgQuery]]) -> list[list[Hit]]:
+        """Pipelined variant: all batches are dispatched before any result
+        is pulled back, overlapping host prep, device compute, and
+        transfers (replaces the reference's worker-pool overlap,
+        pkg/parallel/pipeline.go)."""
+        prepped = [self._prepare(qs) for qs in batches]
+        futures = [None if packed is None else self._dispatch(packed)
+                   for _, packed in prepped]
+        results = []
+        for (usable, _), fut in zip(prepped, futures):
+            if fut is None:
+                results.append([])
+                continue
+            out = np.asarray(fut)
+            results.append(self._assemble(usable, out & 3, out >> 2))
+        return results
+
+    def _assemble(self, usable, report, idx) -> list[Hit]:
         t = self.table
+        rows_i, rows_j = np.nonzero(report)
+        if rows_i.size == 0:
+            return []
+        bits = report[rows_i, rows_j]
+        rowids = idx[rows_i, rows_j]
+        gids = t.group[rowids]
+        flags = t.flags[rowids]
+        sat = (bits & 1) != 0
+        neg = (flags & J.NEGATIVE) != 0
+        inexact = (bits & 2) != 0
+
+        # group-by (pkg, advisory group) in numpy
+        key = rows_i.astype(np.int64) * (len(t.groups) + 1) + gids
+        order = np.argsort(key, kind="stable")
+        key_s = key[order]
+        uniq, starts = np.unique(key_s, return_index=True)
+        pos_any = np.zeros(uniq.shape[0], bool)
+        neg_any = np.zeros(uniq.shape[0], bool)
+        inex_any = np.zeros(uniq.shape[0], bool)
+        seg = np.searchsorted(uniq, key_s)
+        np.logical_or.at(pos_any, seg, sat[order] & ~neg[order])
+        np.logical_or.at(neg_any, seg, sat[order] & neg[order])
+        np.logical_or.at(inex_any, seg, inexact[order])
+
         hits: list[Hit] = []
-        rows_i, rows_j = np.nonzero(hmatch[:len(usable)])
-        # group candidate rows per (pkg, advisory group)
-        per_group: dict[tuple[int, int], dict] = {}
-        for i, j in zip(rows_i.tolist(), rows_j.tolist()):
-            row = int(idx[i, j])
-            gid = int(t.group[row])
-            g = t.groups[gid]
+        pkg_of = (uniq // (len(t.groups) + 1)).astype(np.int64)
+        gid_of = (uniq % (len(t.groups) + 1)).astype(np.int64)
+        for u in range(uniq.shape[0]):
+            i = int(pkg_of[u])
+            g = t.groups[int(gid_of[u])]
             q, k = usable[i]
             if g.pkg_name != q.name or g.source != q.source:
                 continue  # 64-bit hash collision: reject
-            st = per_group.setdefault((i, gid), {
-                "pos_any": False, "neg_any": False, "inexact": False})
-            flags = int(t.flags[row])
-            satisfied = bool(sat[i, j])
-            if (flags & J.INEXACT) or not k.exact:
-                st["inexact"] = True
-            if flags & J.NEGATIVE:
-                st["neg_any"] = st["neg_any"] or satisfied
+            if inex_any[u] or not k.exact:
+                pos, negv = self._exact_eval(g, q)
             else:
-                st["pos_any"] = st["pos_any"] or satisfied
-
-        for (i, gid), st in per_group.items():
-            q, k = usable[i]
-            g = t.groups[gid]
-            if st["inexact"]:
-                pos, neg = self._exact_eval(g, q)
-            else:
-                pos, neg = st["pos_any"], st["neg_any"]
-            if pos and not neg:
+                pos, negv = bool(pos_any[u]), bool(neg_any[u])
+            if pos and not negv:
                 hits.append(Hit(
                     query=q, vuln_id=g.vuln_id,
                     fixed_version=g.fixed_version, status=g.status,

@@ -50,8 +50,10 @@ def searchsorted_pair(table_hi, table_lo, qh, ql):
     static trip count keeps XLA control flow trivial.
     """
     n = table_hi.shape[0]
-    lo = jnp.zeros(qh.shape, dtype=jnp.int32)
-    hi = jnp.full(qh.shape, n, dtype=jnp.int32)
+    # derive the carry from the query so its varying-axes type matches
+    # under shard_map (zeros_like/full would be unvarying)
+    lo = qh * 0
+    hi = qh * 0 + n
 
     def body(_, carry):
         lo, hi = carry
@@ -64,9 +66,8 @@ def searchsorted_pair(table_hi, table_lo, qh, ql):
     return lo
 
 
-@functools.partial(jax.jit, static_argnames=("window",))
-def advisory_join(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
-                  pkg_hash, pkg_tok, pkg_valid, *, window: int):
+def _join_core(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
+               pkg_hash, pkg_tok, pkg_valid, window: int):
     """Batched hash-join + interval predicate.
 
     adv_hash:   int32[A, 2] hash-sorted (hi, lo)
@@ -101,4 +102,58 @@ def advisory_join(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
     ok_lo = (~has_lo) | lex_less(lo_t, inst) | (lo_incl & lex_eq(lo_t, inst))
     ok_hi = (~has_hi) | lex_less(inst, hi_t) | (hi_incl & lex_eq(inst, hi_t))
     satisfied = hmatch & ok_lo & ok_hi
+    return hmatch, satisfied, idx, flags
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def advisory_join(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
+                  pkg_hash, pkg_tok, pkg_valid, *, window: int):
+    hmatch, satisfied, idx, _ = _join_core(
+        adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
+        pkg_hash, pkg_tok, pkg_valid, window)
     return hmatch, satisfied, idx
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def advisory_join_packed(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
+                         pkg_hash, pkg_tok, pkg_valid, *, window: int):
+    """Transfer-lean variant: one int8 mask [B, W] with
+    bit0 = interval satisfied, bit1 = inexact candidate (hash-matched row
+    flagged INEXACT — needs host recheck), plus the row indices. Rows with
+    neither bit never affect results, so only this mask needs to leave the
+    device."""
+    hmatch, satisfied, idx, flags = _join_core(
+        adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
+        pkg_hash, pkg_tok, pkg_valid, window)
+    inexact = hmatch & ((flags & INEXACT) != 0)
+    report = satisfied.astype(jnp.int8) | (inexact.astype(jnp.int8) << 1)
+    return report, idx
+
+
+def pack_queries(pkg_hash, pkg_tok, pkg_valid):
+    """One int32 [B, K+3] input tensor: cols 0-1 hash (hi, lo), col 2
+    valid, cols 3.. version tokens — a single host→device transfer per
+    batch (the tunnel's per-transfer latency dominates the join cost)."""
+    import numpy as np
+    b = pkg_hash.shape[0]
+    out = np.empty((b, pkg_tok.shape[1] + 3), dtype=np.int32)
+    out[:, 0:2] = pkg_hash
+    out[:, 2] = pkg_valid
+    out[:, 3:] = pkg_tok
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def advisory_join_io(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
+                     pkgs_packed, *, window: int):
+    """Single-tensor-in / single-tensor-out join: returns int32 [B, W] of
+    (global_row_idx << 2) | report_bits."""
+    pkg_hash = pkgs_packed[:, 0:2]
+    pkg_valid = pkgs_packed[:, 2] != 0
+    pkg_tok = pkgs_packed[:, 3:]
+    hmatch, satisfied, idx, flags = _join_core(
+        adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
+        pkg_hash, pkg_tok, pkg_valid, window)
+    inexact = hmatch & ((flags & INEXACT) != 0)
+    report = satisfied.astype(jnp.int32) | (inexact.astype(jnp.int32) << 1)
+    return (idx << 2) | report

@@ -1,0 +1,142 @@
+"""Device-mesh sharding of the scan step.
+
+Replaces the reference's worker-pool distribution (SURVEY.md §2.7 P1/P4:
+errgroup pipelines + client/server sharding) with a 2-D
+`jax.sharding.Mesh`:
+
+  axis "dp"  — data parallel over the package/image batch;
+  axis "db"  — the advisory table sharded by contiguous hash range (the
+               framework's tensor-parallel dimension; SURVEY.md §5 "TP
+               over the DB dimension" for tables larger than one chip's
+               HBM).
+
+Table shards are split at bucket boundaries (no hash bucket straddles a
+shard) and padded to equal length, so each shard's local searchsorted is
+exact and no cross-shard halo exchange is needed; a package's hits are
+simply the union over "db" shards, produced as a per-shard output axis.
+
+Everything runs under one jit(shard_map(...)) — XLA inserts the
+all-gathers implied by the output spec over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..db.table import AdvisoryTable
+from ..ops import join as J
+
+PAD_HASH = np.int32(2**31 - 1)  # sorts after every real (hi, lo) pair
+
+
+def make_mesh(n_devices: int | None = None, db_shards: int = 1,
+              devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % db_shards != 0:
+        raise ValueError(f"{n} devices not divisible by db={db_shards}")
+    dev_array = np.asarray(devices).reshape(n // db_shards, db_shards)
+    return Mesh(dev_array, axis_names=("dp", "db"))
+
+
+@dataclass
+class ShardedTable:
+    """Advisory arrays with a leading shard axis [S, A_pad, ...]."""
+    hash: np.ndarray
+    lo_tok: np.ndarray
+    hi_tok: np.ndarray
+    flags: np.ndarray
+    window: int
+    row_offset: np.ndarray  # int32[S]: global row index of each shard start
+
+
+def shard_table(table: AdvisoryTable, n_shards: int) -> ShardedTable:
+    a = len(table)
+    h = table.hash
+    # choose split points at bucket boundaries (hash change points)
+    bounds = [0]
+    target = max(1, a // n_shards)
+    i = target
+    for _ in range(n_shards - 1):
+        i = min(i, a)
+        while 0 < i < a and (h[i] == h[i - 1]).all():
+            i += 1  # advance to a bucket boundary
+        bounds.append(min(i, a))
+        i += target
+    bounds.append(a)
+    starts = bounds[:-1]
+    ends = bounds[1:]
+    pad = max((e - s) for s, e in zip(starts, ends)) if a else 1
+    kw = table.lo_tok.shape[1]
+
+    def _piece(arr, s, e, fill):
+        out = np.full((pad,) + arr.shape[1:], fill, dtype=arr.dtype)
+        out[:e - s] = arr[s:e]
+        return out
+
+    return ShardedTable(
+        hash=np.stack([_piece(h, s, e, PAD_HASH) for s, e in
+                       zip(starts, ends)]),
+        lo_tok=np.stack([_piece(table.lo_tok, s, e, 1) for s, e in
+                         zip(starts, ends)]),
+        hi_tok=np.stack([_piece(table.hi_tok, s, e, 1) for s, e in
+                         zip(starts, ends)]),
+        flags=np.stack([_piece(table.flags, s, e, 0) for s, e in
+                        zip(starts, ends)]),
+        window=table.window,
+        row_offset=np.asarray(starts, dtype=np.int32),
+    )
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "window"))
+def _sharded_join(mesh, window, adv_hash, adv_lo, adv_hi, adv_flags,
+                  row_offset, pkg_hash, pkg_tok, pkg_valid):
+    from jax.experimental.shard_map import shard_map
+
+    def local(adv_hash, adv_lo, adv_hi, adv_flags, row_offset,
+              pkg_hash, pkg_tok, pkg_valid):
+        # inside: adv_* [1, A_pad, ...] (this db shard), pkg_* [B/dp, ...].
+        # Packages are replicated over "db"; mark them varying so the
+        # join's loop carries type-check under shard_map.
+        pkg_hash = jax.lax.pcast(pkg_hash, ("db",), to="varying")
+        pkg_tok = jax.lax.pcast(pkg_tok, ("db",), to="varying")
+        pkg_valid = jax.lax.pcast(pkg_valid, ("db",), to="varying")
+        hmatch, sat, idx = J.advisory_join(
+            adv_hash[0], adv_lo[0], adv_hi[0], adv_flags[0],
+            pkg_hash, pkg_tok, pkg_valid, window=window)
+        gidx = idx + row_offset[0]
+        return (hmatch[None], sat[None], gidx[None])
+
+    f = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("db"), P("db"), P("db"), P("db"), P("db"),
+                  P("dp"), P("dp"), P("dp")),
+        out_specs=(P("db", "dp"), P("db", "dp"), P("db", "dp")),
+    )
+    return f(adv_hash, adv_lo, adv_hi, adv_flags, row_offset,
+             pkg_hash, pkg_tok, pkg_valid)
+
+
+def sharded_scan_step(mesh: Mesh, st: ShardedTable,
+                      pkg_hash, pkg_tok, pkg_valid):
+    """Run the batched join across the mesh.
+
+    pkg_hash [B, 2] / pkg_tok [B, K] / pkg_valid [B] with B divisible by
+    the dp axis size. Returns (hash_match, satisfied, global_row_idx),
+    each [n_db_shards, B, W] on host.
+    """
+    hm, sat, idx = _sharded_join(
+        mesh, st.window,
+        st.hash, st.lo_tok, st.hi_tok, st.flags, st.row_offset,
+        pkg_hash, pkg_tok, pkg_valid)
+    return np.asarray(hm), np.asarray(sat), np.asarray(idx)
