@@ -1,0 +1,200 @@
+"""CLI, client/server, SBOM codec, and result-filter tests."""
+
+import io
+import json
+import os
+import socket
+import sys
+
+import pytest
+
+from helpers import ALPINE_OS_RELEASE, APK_INSTALLED, make_image
+from trivy_tpu import cli, types as T
+from trivy_tpu.db import build_table
+from trivy_tpu.db.fixtures import load_fixture_files
+from trivy_tpu.result import FilterOptions, filter_results
+from trivy_tpu.result.ignore import parse_ignore_file
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "db")
+FIXGLOB = os.path.join(FIXDIR, "*.yaml")
+
+
+@pytest.fixture()
+def image_path(tmp_path):
+    p = str(tmp_path / "img.tar")
+    make_image(p, [{
+        "etc/os-release": ALPINE_OS_RELEASE,
+        "etc/alpine-release": b"3.17.3\n",
+        "lib/apk/db/installed": APK_INSTALLED,
+    }])
+    return p
+
+
+def run_cli(argv, capsys):
+    code = cli.main(argv)
+    out = capsys.readouterr().out
+    return code, out
+
+
+class TestCLI:
+    def test_image_json(self, image_path, tmp_path, capsys):
+        code, out = run_cli([
+            "image", "--input", image_path, "--db", FIXGLOB,
+            "--cache-dir", str(tmp_path / "cache")], capsys)
+        assert code == 0
+        j = json.loads(out)
+        assert j["ArtifactType"] == "container_image"
+        ids = [v["VulnerabilityID"]
+               for v in j["Results"][0]["Vulnerabilities"]]
+        assert "CVE-2023-0286" in ids
+
+    def test_severity_filter(self, image_path, tmp_path, capsys):
+        code, out = run_cli([
+            "image", "--input", image_path, "--db", FIXGLOB,
+            "--cache-dir", str(tmp_path / "c"), "--severity", "HIGH"],
+            capsys)
+        j = json.loads(out)
+        sevs = {v["Severity"] for r in j["Results"]
+                for v in r.get("Vulnerabilities", [])}
+        assert sevs == {"HIGH"}
+
+    def test_exit_code(self, image_path, tmp_path, capsys):
+        code, _ = run_cli([
+            "image", "--input", image_path, "--db", FIXGLOB,
+            "--cache-dir", str(tmp_path / "c"), "--exit-code", "5"], capsys)
+        assert code == 5
+
+    def test_table_format(self, image_path, tmp_path, capsys):
+        code, out = run_cli([
+            "image", "--input", image_path, "--db", FIXGLOB,
+            "--cache-dir", str(tmp_path / "c"), "--format", "table"], capsys)
+        assert code == 0
+        assert "CVE-2023-0286" in out
+
+    def test_fs_scan(self, tmp_path, capsys):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "requirements.txt").write_text("flask==2.2.2\n")
+        code, out = run_cli(["fs", str(proj), "--db", FIXGLOB], capsys)
+        j = json.loads(out)
+        assert j["Results"][0]["Vulnerabilities"][0]["VulnerabilityID"] == \
+            "CVE-2023-30861"
+
+    def test_cyclonedx_roundtrip(self, image_path, tmp_path, capsys):
+        code, out = run_cli([
+            "image", "--input", image_path, "--db", FIXGLOB,
+            "--cache-dir", str(tmp_path / "c"), "--format", "cyclonedx",
+            "--list-all-pkgs"], capsys)
+        bom = json.loads(out)
+        assert bom["bomFormat"] == "CycloneDX"
+        names = {c["name"] for c in bom["components"]}
+        assert {"libcrypto3", "musl", "zlib"} <= names
+        # scan the emitted SBOM: same vulnerable set via sbom path
+        sbom_path = tmp_path / "bom.json"
+        sbom_path.write_text(out)
+        code, out2 = run_cli(["sbom", str(sbom_path), "--db", FIXGLOB],
+                             capsys)
+        j = json.loads(out2)
+        ids = {v["VulnerabilityID"] for r in j["Results"]
+               for v in r.get("Vulnerabilities", [])}
+        assert "CVE-2023-0286" in ids
+
+    def test_convert(self, image_path, tmp_path, capsys):
+        code, out = run_cli([
+            "image", "--input", image_path, "--db", FIXGLOB,
+            "--cache-dir", str(tmp_path / "c")], capsys)
+        rp = tmp_path / "report.json"
+        rp.write_text(out)
+        code, out2 = run_cli(["convert", str(rp), "--format", "table"],
+                             capsys)
+        assert code == 0
+        assert "CVE-2023-0286" in out2
+
+    def test_ignorefile(self, image_path, tmp_path, capsys):
+        ig = tmp_path / "ignore.txt"
+        ig.write_text("CVE-2023-0286\n# comment\n")
+        code, out = run_cli([
+            "image", "--input", image_path, "--db", FIXGLOB,
+            "--cache-dir", str(tmp_path / "c"),
+            "--ignorefile", str(ig)], capsys)
+        j = json.loads(out)
+        ids = {v["VulnerabilityID"] for r in j["Results"]
+               for v in r.get("Vulnerabilities", [])}
+        assert "CVE-2023-0286" not in ids
+        assert "CVE-2023-2650" in ids
+
+
+class TestServer:
+    @pytest.fixture(scope="class")
+    def server(self, tmp_path_factory):
+        from trivy_tpu.server.listen import serve_background
+        advisories, details, _ = load_fixture_files(
+            sorted(__import__("glob").glob(FIXGLOB)))
+        table = build_table(advisories, details)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        httpd, state = serve_background(
+            "127.0.0.1", port, table,
+            cache_dir=str(tmp_path_factory.mktemp("srvcache")),
+            token="secret-token")
+        yield f"http://127.0.0.1:{port}"
+        httpd.shutdown()
+
+    def test_healthz_version(self, server):
+        import urllib.request
+        assert urllib.request.urlopen(server + "/healthz").read() == b"ok"
+        v = json.loads(urllib.request.urlopen(server + "/version").read())
+        assert "Version" in v
+
+    def test_client_server_scan(self, server, tmp_path, image_path):
+        from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+        from trivy_tpu.server.client import RemoteCache, RemoteScanner
+        cache = RemoteCache(server, token="secret-token")
+        art = ImageArchiveArtifact(image_path, cache)
+        ref = art.inspect()
+        scanner = RemoteScanner(server, token="secret-token")
+        results, os_info = scanner.scan(ref.name, ref.id, ref.blob_ids)
+        assert os_info.family == "alpine"
+        ids = [v.vulnerability_id for v in results[0].vulnerabilities]
+        assert "CVE-2023-0286" in ids
+        # second client scan hits the server cache (no re-push needed)
+        missing_artifact, missing = cache.missing_blobs(ref.id, ref.blob_ids)
+        assert missing == []
+
+    def test_token_auth(self, server):
+        from trivy_tpu.server.client import RemoteScanner, TwirpError
+        bad = RemoteScanner(server, token="wrong")
+        with pytest.raises(TwirpError) as e:
+            bad.scan("t", "a", [])
+        assert e.value.code == "unauthenticated"
+
+
+class TestFilter:
+    def _vuln(self, vid, sev, fixed="1.0"):
+        v = T.DetectedVulnerability(vulnerability_id=vid,
+                                    fixed_version=fixed)
+        v.vulnerability.severity = sev
+        return v
+
+    def test_severity_and_unfixed(self):
+        res = T.Result(target="t", clazz="os-pkgs", vulnerabilities=[
+            self._vuln("CVE-1", "HIGH"),
+            self._vuln("CVE-2", "LOW"),
+            self._vuln("CVE-3", "CRITICAL", fixed=""),
+        ])
+        out = filter_results([res], FilterOptions(
+            severities=["HIGH", "CRITICAL"], ignore_unfixed=True))
+        assert [v.vulnerability_id for v in out[0].vulnerabilities] == \
+            ["CVE-1"]
+
+    def test_ignore_file_expiry(self, tmp_path):
+        p = tmp_path / ".trivyignore"
+        p.write_text("CVE-1 exp:2020-01-01\nCVE-2\n")
+        ig = parse_ignore_file(str(p))
+        res = T.Result(target="t", clazz="os-pkgs", vulnerabilities=[
+            self._vuln("CVE-1", "HIGH"), self._vuln("CVE-2", "HIGH")])
+        out = filter_results([res], FilterOptions(ignore_file=ig))
+        # CVE-1's ignore entry expired in 2020 → finding stays
+        assert [v.vulnerability_id for v in out[0].vulnerabilities] == \
+            ["CVE-1"]

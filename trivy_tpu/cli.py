@@ -1,0 +1,230 @@
+"""CLI — mirrors the reference command tree (pkg/commands/app.go):
+image / filesystem / rootfs / repository / sbom / convert / server /
+version, with the shared scan flags (pkg/flag). The DB comes from
+advisory fixture YAML or a prebuilt columnar .npz (the OCI trivy-db
+download path needs network egress and slots in behind --db-repository
+later)."""
+
+from __future__ import annotations
+
+import argparse
+import datetime as dt
+import glob
+import json
+import os
+import sys
+
+from . import __version__, types as T
+from .db import AdvisoryTable, build_table
+from .db.fixtures import load_fixture_files
+from .report import build_report, write_report
+from .result import FilterOptions, filter_results, parse_ignore_file
+from .scanner import LocalScanner
+
+
+def _add_scan_flags(p: argparse.ArgumentParser):
+    p.add_argument("--scanners", default="vuln",
+                   help="comma-separated: vuln,secret")
+    p.add_argument("--format", "-f", default="json",
+                   choices=["json", "table", "cyclonedx", "spdx-json"])
+    p.add_argument("--output", "-o", default="")
+    p.add_argument("--severity", "-s", default=",".join(T.SEVERITIES))
+    p.add_argument("--ignore-unfixed", action="store_true")
+    p.add_argument("--ignore-status", default="",
+                   help="comma-separated statuses to hide")
+    p.add_argument("--ignorefile", default="")
+    p.add_argument("--list-all-pkgs", action="store_true")
+    p.add_argument("--exit-code", type=int, default=0)
+    p.add_argument("--cache-dir",
+                   default=os.path.join(os.path.expanduser("~"), ".cache",
+                                        "trivy-tpu"))
+    p.add_argument("--db", default="",
+                   help="columnar advisory DB (.npz) or fixture YAML glob")
+    p.add_argument("--pkg-types", default="os,library")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="trivy-tpu",
+        description="TPU-native security scanner (Trivy-compatible)")
+    ap.add_argument("--version", action="version",
+                    version=f"trivy-tpu {__version__}")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("image", help="scan a container image archive")
+    p.add_argument("image_name", nargs="?", default="")
+    p.add_argument("--input", default="",
+                   help="docker-save/OCI archive path")
+    _add_scan_flags(p)
+
+    for name, aliases in (("filesystem", ["fs"]), ("rootfs", [])):
+        p = sub.add_parser(name, aliases=aliases,
+                           help=f"scan a {name} target")
+        p.add_argument("target")
+        _add_scan_flags(p)
+
+    p = sub.add_parser("repository", aliases=["repo"],
+                       help="scan a (local) git repository")
+    p.add_argument("target")
+    _add_scan_flags(p)
+
+    p = sub.add_parser("sbom", help="scan an SBOM (CycloneDX/SPDX JSON)")
+    p.add_argument("target")
+    _add_scan_flags(p)
+
+    p = sub.add_parser("convert", help="re-render a saved JSON report")
+    p.add_argument("report")
+    p.add_argument("--format", "-f", default="table",
+                   choices=["json", "table"])
+    p.add_argument("--output", "-o", default="")
+
+    p = sub.add_parser("server", help="run the scan server")
+    p.add_argument("--listen", default="0.0.0.0:4954")
+    p.add_argument("--db", default="")
+    p.add_argument("--cache-dir",
+                   default=os.path.join(os.path.expanduser("~"), ".cache",
+                                        "trivy-tpu"))
+    p.add_argument("--token", default="")
+
+    sub.add_parser("version", help="print version")
+    return ap
+
+
+def load_table(spec: str) -> AdvisoryTable:
+    if not spec:
+        raise SystemExit(
+            "--db required (fixture YAML glob or columnar .npz); "
+            "the OCI download path needs egress")
+    if spec.endswith(".npz"):
+        return AdvisoryTable.load(spec)
+    paths = sorted(glob.glob(spec)) or [spec]
+    advisories, details, _ = load_fixture_files(paths)
+    return build_table(advisories, details)
+
+
+def _scan_common(args, ref, cache, artifact_type: str) -> int:
+    table = load_table(args.db)
+    scanner = LocalScanner(cache, table)
+    scanners = tuple(s.strip() for s in args.scanners.split(",") if s.strip())
+    opts = T.ScanOptions(
+        scanners=scanners,
+        list_all_packages=args.list_all_pkgs,
+        pkg_types=tuple(args.pkg_types.split(",")),
+    )
+    results, os_info = scanner.scan(ref.name, ref.id, ref.blob_ids, opts)
+
+    fopts = FilterOptions(
+        severities=[s.strip().upper() for s in args.severity.split(",")],
+        ignore_unfixed=args.ignore_unfixed,
+        ignore_statuses=[s for s in args.ignore_status.split(",") if s],
+        ignore_file=parse_ignore_file(args.ignorefile)
+        if args.ignorefile else _auto_ignore_file(),
+    )
+    results = filter_results(results, fopts)
+
+    report = build_report(
+        ref.name, artifact_type, results, os_info,
+        metadata=ref.image_metadata or T.Metadata(),
+        created_at=dt.datetime.now(dt.timezone.utc).isoformat())
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        if args.format in ("cyclonedx", "spdx-json"):
+            from .sbom import write_sbom
+            write_sbom(report, args.format, out)
+        else:
+            write_report(report, args.format, out)
+    finally:
+        if args.output:
+            out.close()
+
+    if args.exit_code and any(
+            r.vulnerabilities or r.secrets or r.misconfigurations
+            for r in results):
+        return args.exit_code
+    return 0
+
+
+def _auto_ignore_file():
+    for cand in (".trivyignore.yaml", ".trivyignore"):
+        if os.path.exists(cand):
+            return parse_ignore_file(cand)
+    return None
+
+
+def cmd_image(args) -> int:
+    from .fanal.artifact import ImageArchiveArtifact
+    from .fanal.cache import FSCache
+    if not args.input:
+        raise SystemExit("--input <archive> required (daemon/registry "
+                         "sources need docker/network access)")
+    cache = FSCache(args.cache_dir)
+    scanners = tuple(s.strip() for s in args.scanners.split(","))
+    art = ImageArchiveArtifact(args.input, cache, scanners=scanners)
+    ref = art.inspect()
+    if args.image_name:
+        ref.name = args.image_name
+    return _scan_common(args, ref, cache, T.ArtifactType.CONTAINER_IMAGE)
+
+
+def cmd_fs(args) -> int:
+    from .fanal.artifact import FilesystemArtifact
+    from .fanal.cache import MemoryCache
+    cache = MemoryCache()
+    scanners = tuple(s.strip() for s in args.scanners.split(","))
+    art = FilesystemArtifact(args.target, cache, scanners=scanners)
+    ref = art.inspect()
+    return _scan_common(args, ref, cache, T.ArtifactType.FILESYSTEM)
+
+
+def cmd_sbom(args) -> int:
+    from .fanal.cache import MemoryCache
+    from .sbom import decode_sbom_file
+    cache = MemoryCache()
+    ref = decode_sbom_file(args.target, cache)
+    return _scan_common(args, ref, cache, ref.type)
+
+
+def cmd_convert(args) -> int:
+    with open(args.report) as f:
+        json.load(f)  # validate
+    # re-render via raw JSON (table rendering from raw dict)
+    from .report.writer import render_json_report
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        render_json_report(args.report, args.format, out)
+    finally:
+        if args.output:
+            out.close()
+    return 0
+
+
+def cmd_server(args) -> int:
+    from .server.listen import serve
+    table = load_table(args.db)
+    host, _, port = args.listen.rpartition(":")
+    serve(host or "0.0.0.0", int(port), table, cache_dir=args.cache_dir,
+          token=args.token)
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = args.command
+    if cmd == "version":
+        print(f"trivy-tpu {__version__}")
+        return 0
+    if cmd == "image":
+        return cmd_image(args)
+    if cmd in ("filesystem", "fs", "rootfs", "repository", "repo"):
+        return cmd_fs(args)
+    if cmd == "sbom":
+        return cmd_sbom(args)
+    if cmd == "convert":
+        return cmd_convert(args)
+    if cmd == "server":
+        return cmd_server(args)
+    raise SystemExit(f"unknown command {cmd}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

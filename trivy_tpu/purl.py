@@ -1,0 +1,62 @@
+"""package-url construction (reference pkg/purl/purl.go): maps internal
+package type + fields to pkg:<type>/<namespace>/<name>@<version>."""
+
+from __future__ import annotations
+
+from urllib.parse import quote
+
+from . import types as T
+
+_OS_DISTROS = {"alpine", "wolfi", "chainguard", "debian", "ubuntu",
+               "redhat", "centos", "rocky", "alma", "amazon", "oracle",
+               "fedora", "suse", "opensuse", "photon", "cbl-mariner"}
+
+_TYPE_MAP = {
+    "alpine": "apk", "wolfi": "apk", "chainguard": "apk",
+    "debian": "deb", "ubuntu": "deb",
+    "redhat": "rpm", "centos": "rpm", "rocky": "rpm", "alma": "rpm",
+    "amazon": "rpm", "oracle": "rpm", "fedora": "rpm", "suse": "rpm",
+    "opensuse": "rpm", "photon": "rpm", "cbl-mariner": "rpm",
+    "python-pkg": "pypi", "pip": "pypi", "pipenv": "pypi", "poetry": "pypi",
+    "npm": "npm", "node-pkg": "npm", "yarn": "npm", "pnpm": "npm",
+    "gomod": "golang", "gobinary": "golang",
+    "cargo": "cargo", "rustbinary": "cargo",
+    "composer": "composer", "bundler": "gem", "gemspec": "gem",
+    "jar": "maven", "pom": "maven", "gradle-lockfile": "maven",
+    "nuget": "nuget", "dotnet-core": "nuget",
+    "conan": "conan", "swift": "swift", "cocoa-pods": "cocoapods",
+    "pub": "pub", "mix-lock": "hex", "conda-pkg": "conda",
+}
+
+
+def purl_for_package(pkg_type: str, pkg: T.Package) -> str:
+    ptype = _TYPE_MAP.get(pkg_type, "")
+    if not ptype:
+        return ""
+    name = pkg.name
+    namespace = ""
+    if ptype == "deb":
+        namespace = pkg_type  # debian/ubuntu
+    elif ptype == "apk":
+        namespace = "alpine" if pkg_type == "alpine" else pkg_type
+    elif ptype == "rpm":
+        namespace = pkg_type
+    elif ptype in ("golang", "npm", "composer") and "/" in name:
+        namespace, name = name.rsplit("/", 1)
+    elif ptype == "maven" and ":" in name:
+        namespace, name = name.split(":", 1)
+    version = pkg.format_version() or pkg.version
+    parts = ["pkg:", ptype, "/"]
+    if namespace:
+        parts.append(quote(namespace, safe="/") + "/")
+    parts.append(quote(name, safe=""))
+    if version:
+        parts.append("@" + quote(version, safe=""))
+    quals = []
+    if pkg.arch:
+        quals.append(f"arch={pkg.arch}")
+    if pkg.epoch:
+        quals.append(f"epoch={pkg.epoch}")
+    if quals:
+        parts.append("?" + "&".join(quals))
+    return "".join(parts)

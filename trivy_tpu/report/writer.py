@@ -70,6 +70,59 @@ def to_table(report: T.Report) -> str:
     return "\n".join(lines) + "\n"
 
 
+def report_from_json(j: dict) -> T.Report:
+    """Decode a saved JSON report (for `convert`,
+    reference pkg/commands/convert/run.go)."""
+    results = []
+    for rj in j.get("Results", []):
+        res = T.Result(
+            target=rj.get("Target", ""),
+            clazz=rj.get("Class", ""),
+            type=rj.get("Type", ""),
+        )
+        for vj in rj.get("Vulnerabilities", []):
+            v = T.DetectedVulnerability(
+                vulnerability_id=vj.get("VulnerabilityID", ""),
+                pkg_name=vj.get("PkgName", ""),
+                pkg_path=vj.get("PkgPath", ""),
+                installed_version=vj.get("InstalledVersion", ""),
+                fixed_version=vj.get("FixedVersion", ""),
+                status=vj.get("Status", ""),
+                primary_url=vj.get("PrimaryURL", ""),
+            )
+            v.vulnerability.severity = vj.get("Severity", "UNKNOWN")
+            v.vulnerability.title = vj.get("Title", "")
+            res.vulnerabilities.append(v)
+        for sj in rj.get("Secrets", []):
+            res.secrets.append(T.SecretFinding(
+                rule_id=sj.get("RuleID", ""), category=sj.get("Category", ""),
+                severity=sj.get("Severity", ""), title=sj.get("Title", ""),
+                start_line=sj.get("StartLine", 0),
+                end_line=sj.get("EndLine", 0), match=sj.get("Match", "")))
+        results.append(res)
+    meta = j.get("Metadata") or {}
+    os_j = meta.get("OS") or {}
+    return T.Report(
+        schema_version=j.get("SchemaVersion", 2),
+        created_at=j.get("CreatedAt", ""),
+        artifact_name=j.get("ArtifactName", ""),
+        artifact_type=j.get("ArtifactType", ""),
+        metadata=T.Metadata(
+            os=T.OS(family=os_j.get("Family", ""),
+                    name=os_j.get("Name", "")) if os_j else None,
+            image_id=meta.get("ImageID", ""),
+            repo_tags=meta.get("RepoTags", []),
+        ),
+        results=results,
+    )
+
+
+def render_json_report(path: str, fmt: str, out) -> None:
+    with open(path) as f:
+        report = report_from_json(json.load(f))
+    write_report(report, fmt, out)
+
+
 def write_report(report: T.Report, fmt: str = "json", output=None) -> None:
     out = output or sys.stdout
     if fmt == "json":

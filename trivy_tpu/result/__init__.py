@@ -1,0 +1,4 @@
+"""Result post-processing (reference pkg/result)."""
+
+from .filter import FilterOptions, filter_results  # noqa: F401
+from .ignore import IgnoreFile, parse_ignore_file  # noqa: F401

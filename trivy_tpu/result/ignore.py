@@ -1,0 +1,92 @@
+""".trivyignore / .trivyignore.yaml parsing (reference
+pkg/result/ignore.go): plain files list one finding ID per line (comments
+with #, optional `exp:YYYY-MM-DD` expiry and path globs after the ID);
+YAML files carry sections per finding class with ids/paths/statements."""
+
+from __future__ import annotations
+
+import datetime as dt
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class IgnoreEntry:
+    id: str
+    paths: list = field(default_factory=list)
+    expired_at: Optional[dt.date] = None
+    statement: str = ""
+
+    def matches(self, finding_id: str, path: str = "",
+                today: Optional[dt.date] = None) -> bool:
+        if self.id != finding_id:
+            return False
+        if self.expired_at is not None:
+            today = today or dt.date.today()
+            if today > self.expired_at:
+                return False
+        if self.paths:
+            return any(fnmatch.fnmatch(path, p) for p in self.paths)
+        return True
+
+
+@dataclass
+class IgnoreFile:
+    vulnerabilities: list = field(default_factory=list)
+    misconfigurations: list = field(default_factory=list)
+    secrets: list = field(default_factory=list)
+    licenses: list = field(default_factory=list)
+
+    def match(self, section: str, finding_id: str, path: str = "") -> bool:
+        return any(e.matches(finding_id, path)
+                   for e in getattr(self, section))
+
+
+def parse_ignore_file(path: str) -> IgnoreFile:
+    if path.endswith((".yaml", ".yml")):
+        return _parse_yaml(path)
+    return _parse_plain(path)
+
+
+def _parse_plain(path: str) -> IgnoreFile:
+    out = IgnoreFile()
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            entry = IgnoreEntry(id=fields[0])
+            for tok in fields[1:]:
+                if tok.startswith("exp:"):
+                    entry.expired_at = dt.date.fromisoformat(tok[4:])
+                else:
+                    entry.paths.append(tok)
+            # plain files apply to every finding class (ignore.go)
+            out.vulnerabilities.append(entry)
+            out.misconfigurations.append(entry)
+            out.secrets.append(entry)
+            out.licenses.append(entry)
+    return out
+
+
+def _parse_yaml(path: str) -> IgnoreFile:
+    import yaml
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    out = IgnoreFile()
+    for section, attr in (("vulnerabilities", "vulnerabilities"),
+                          ("misconfigurations", "misconfigurations"),
+                          ("secrets", "secrets"),
+                          ("licenses", "licenses")):
+        for item in doc.get(section) or []:
+            entry = IgnoreEntry(
+                id=item.get("id", ""),
+                paths=item.get("paths") or [],
+                statement=item.get("statement", ""))
+            if item.get("expired_at"):
+                entry.expired_at = dt.date.fromisoformat(
+                    str(item["expired_at"]))
+            getattr(out, attr).append(entry)
+    return out

@@ -1,0 +1,9 @@
+"""SBOM encode/decode (reference pkg/sbom): CycloneDX and SPDX JSON.
+
+Decoding an SBOM is the fastest ingest path — it skips analysis entirely
+and feeds packages straight into the batched detector
+(pkg/fanal/artifact/sbom/sbom.go)."""
+
+from .cyclonedx import decode_cyclonedx, encode_cyclonedx  # noqa: F401
+from .io import decode_sbom_file, detect_format, write_sbom  # noqa: F401
+from .spdx import encode_spdx  # noqa: F401

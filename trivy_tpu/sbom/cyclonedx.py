@@ -1,0 +1,162 @@
+"""CycloneDX JSON encode/decode.
+
+Mirrors pkg/sbom/cyclonedx: Trivy-flavored CycloneDX marks each
+component with `aquasecurity:trivy:*` properties (Type, SrcName,
+SrcVersion, PkgID, PkgType...) and an operating_system component for the
+OS; decode reverses that into OS + Packages + Applications."""
+
+from __future__ import annotations
+
+import uuid
+
+from .. import types as T
+from ..purl import purl_for_package
+
+PROP_PREFIX = "aquasecurity:trivy:"
+
+
+def _props(component: dict) -> dict:
+    out = {}
+    for p in component.get("properties", []):
+        name = p.get("name", "")
+        if name.startswith(PROP_PREFIX):
+            out[name[len(PROP_PREFIX):]] = p.get("value", "")
+    return out
+
+
+def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
+    detail = T.ArtifactDetail()
+    apps: dict[str, T.Application] = {}
+    os_pkgs: list[T.Package] = []
+    os_type = ""
+
+    components = list(doc.get("components", []))
+    meta_comp = (doc.get("metadata") or {}).get("component")
+    if meta_comp:
+        components.append(meta_comp)
+
+    for comp in components:
+        ctype = comp.get("type", "")
+        props = _props(comp)
+        if ctype == "operating_system":
+            detail.os = T.OS(family=comp.get("name", ""),
+                             name=comp.get("version", ""))
+            continue
+        if ctype == "application":
+            app_type = props.get("Type", "")
+            path = comp.get("name", "")
+            if app_type:
+                apps[comp.get("bom-ref", path)] = T.Application(
+                    type=app_type, file_path=path)
+            continue
+        if ctype != "library":
+            continue
+        pkg = T.Package(
+            name=comp.get("name", ""),
+            version=comp.get("version", ""),
+            src_name=props.get("SrcName", ""),
+            src_version=props.get("SrcVersion", ""),
+            src_release=props.get("SrcRelease", ""),
+            src_epoch=int(props.get("SrcEpoch", "0") or 0),
+            release=props.get("PkgRelease", ""),
+            file_path=props.get("FilePath", ""),
+            identifier=T.PkgIdentifier(purl=comp.get("purl", "")),
+        )
+        if comp.get("group"):
+            pkg.name = f"{comp['group']}/{pkg.name}" \
+                if props.get("PkgType") in ("npm", "composer", "gomod") \
+                else f"{comp['group']}:{pkg.name}"
+        pkg.id = f"{pkg.name}@{pkg.version}"
+        ptype = props.get("PkgType", "")
+        if ptype in OS_PKG_TYPES:
+            os_type = os_type or ptype
+            os_pkgs.append(pkg)
+        else:
+            key = props.get("FilePath", "") or ptype
+            app = apps.setdefault(key, T.Application(
+                type=ptype or "unknown", file_path=props.get("FilePath", "")))
+            app.packages.append(pkg)
+
+    detail.packages = os_pkgs
+    detail.applications = [a for a in apps.values() if a.packages]
+    return detail
+
+
+OS_PKG_TYPES = {"alpine", "apk", "debian", "ubuntu", "redhat", "centos",
+                "rocky", "alma", "amazon", "oracle", "fedora", "suse",
+                "opensuse", "photon", "wolfi", "chainguard", "cbl-mariner",
+                "dpkg", "rpm"}
+
+
+def encode_cyclonedx(report: T.Report) -> dict:
+    components = []
+    vulnerabilities = {}
+    os_info = report.metadata.os
+    if os_info and os_info.detected:
+        components.append({
+            "bom-ref": f"{os_info.family}@{os_info.name}",
+            "type": "operating_system",
+            "name": os_info.family,
+            "version": os_info.name,
+        })
+    for res in report.results:
+        for pkg in res.packages:
+            components.append(_component(res, pkg))
+        for v in res.vulnerabilities:
+            entry = vulnerabilities.setdefault(v.vulnerability_id, {
+                "id": v.vulnerability_id,
+                "source": ({"name": v.data_source.id}
+                           if v.data_source else {}),
+                "ratings": [{
+                    "severity": (v.severity or "unknown").lower(),
+                }],
+                "description": v.vulnerability.description,
+                "affects": [],
+            })
+            entry["affects"].append({
+                "ref": f"{v.pkg_name}@{v.installed_version}",
+            })
+    return {
+        "bomFormat": "CycloneDX",
+        "specVersion": "1.5",
+        "serialNumber": f"urn:uuid:{uuid.uuid4()}",
+        "version": 1,
+        "metadata": {
+            "timestamp": report.created_at,
+            "component": {
+                "type": "container"
+                if report.artifact_type == T.ArtifactType.CONTAINER_IMAGE
+                else "application",
+                "name": report.artifact_name,
+            },
+            "tools": [{"vendor": "trivy-tpu", "name": "trivy-tpu"}],
+        },
+        "components": components,
+        "vulnerabilities": list(vulnerabilities.values()),
+    }
+
+
+def _component(res: T.Result, pkg: T.Package) -> dict:
+    props = [{"name": PROP_PREFIX + "PkgType", "value": res.type}]
+    if pkg.src_name:
+        props.append({"name": PROP_PREFIX + "SrcName", "value": pkg.src_name})
+    if pkg.src_version:
+        props.append({"name": PROP_PREFIX + "SrcVersion",
+                      "value": pkg.src_version})
+    if pkg.file_path:
+        props.append({"name": PROP_PREFIX + "FilePath",
+                      "value": pkg.file_path})
+    comp = {
+        "bom-ref": f"{pkg.name}@{pkg.version}",
+        "type": "library",
+        "name": pkg.name,
+        "version": pkg.format_version() or pkg.version,
+        "properties": props,
+    }
+    purl = pkg.identifier.purl or purl_for_package(res.type, pkg)
+    if purl:
+        comp["purl"] = purl
+    if pkg.licenses:
+        comp["licenses"] = [{"license": {"name": li}}
+                            for li in pkg.licenses]
+    return comp

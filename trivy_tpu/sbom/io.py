@@ -1,0 +1,54 @@
+"""SBOM format detection + artifact bridge (reference pkg/sbom/sbom.go
+DetectFormat:111 and pkg/fanal/artifact/sbom/sbom.go)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from .. import types as T
+from ..fanal.cache import cache_key
+from .cyclonedx import decode_cyclonedx, encode_cyclonedx
+from .spdx import decode_spdx, encode_spdx
+
+
+def detect_format(doc: dict) -> str:
+    if doc.get("bomFormat") == "CycloneDX":
+        return "cyclonedx"
+    if str(doc.get("spdxVersion", "")).startswith("SPDX-"):
+        return "spdx-json"
+    raise ValueError("unknown SBOM format (want CycloneDX or SPDX JSON)")
+
+
+def decode_sbom_file(path: str, cache):
+    """→ ArtifactReference whose single blob carries the decoded detail."""
+    from ..fanal.artifact import ArtifactReference
+
+    with open(path) as f:
+        doc = json.load(f)
+    fmt = detect_format(doc)
+    detail = decode_cyclonedx(doc) if fmt == "cyclonedx" else decode_spdx(doc)
+
+    blob = T.BlobInfo(
+        os=detail.os,
+        package_infos=[T.PackageInfo(packages=detail.packages)]
+        if detail.packages else [],
+        applications=detail.applications,
+    )
+    content_id = "sha256:" + hashlib.sha256(
+        json.dumps(blob.to_json(), sort_keys=True).encode()).hexdigest()
+    blob_id = cache_key(content_id, {"sbom": 1}, {})
+    cache.put_blob(blob_id, blob)
+    cache.put_artifact(blob_id, {"SchemaVersion": 2})
+    return ArtifactReference(
+        name=path,
+        type=(T.ArtifactType.CYCLONEDX if fmt == "cyclonedx"
+              else T.ArtifactType.SPDX),
+        id=blob_id, blob_ids=[blob_id])
+
+
+def write_sbom(report: T.Report, fmt: str, out) -> None:
+    doc = encode_cyclonedx(report) if fmt == "cyclonedx" \
+        else encode_spdx(report)
+    json.dump(doc, out, indent=2)
+    out.write("\n")

@@ -1,0 +1,112 @@
+"""SPDX 2.3 JSON encode + minimal decode (reference pkg/sbom/spdx)."""
+
+from __future__ import annotations
+
+import hashlib
+import uuid
+
+from .. import types as T
+from ..purl import purl_for_package
+
+
+def _spdx_id(kind: str, name: str) -> str:
+    h = hashlib.sha1(name.encode()).hexdigest()[:16]
+    return f"SPDXRef-{kind}-{h}"
+
+
+def encode_spdx(report: T.Report) -> dict:
+    packages = []
+    relationships = []
+    root_id = "SPDXRef-DOCUMENT"
+    art_id = _spdx_id("Artifact", report.artifact_name)
+    packages.append({
+        "SPDXID": art_id,
+        "name": report.artifact_name,
+        "downloadLocation": "NONE",
+        "primaryPackagePurpose":
+            "CONTAINER" if report.artifact_type ==
+            T.ArtifactType.CONTAINER_IMAGE else "APPLICATION",
+    })
+    relationships.append({
+        "spdxElementId": root_id,
+        "relatedSpdxElement": art_id,
+        "relationshipType": "DESCRIBES",
+    })
+    for res in report.results:
+        for pkg in res.packages:
+            pid = _spdx_id("Package", f"{res.target}/{pkg.name}@{pkg.version}")
+            entry = {
+                "SPDXID": pid,
+                "name": pkg.name,
+                "versionInfo": pkg.format_version() or pkg.version,
+                "downloadLocation": "NONE",
+                "licenseConcluded": " AND ".join(pkg.licenses) or "NOASSERTION",
+                "licenseDeclared": " AND ".join(pkg.licenses) or "NOASSERTION",
+            }
+            purl = pkg.identifier.purl or purl_for_package(res.type, pkg)
+            if purl:
+                entry["externalRefs"] = [{
+                    "referenceCategory": "PACKAGE-MANAGER",
+                    "referenceType": "purl",
+                    "referenceLocator": purl,
+                }]
+            packages.append(entry)
+            relationships.append({
+                "spdxElementId": art_id,
+                "relatedSpdxElement": pid,
+                "relationshipType": "CONTAINS",
+            })
+    return {
+        "spdxVersion": "SPDX-2.3",
+        "dataLicense": "CC0-1.0",
+        "SPDXID": root_id,
+        "name": report.artifact_name,
+        "documentNamespace":
+            f"https://trivy-tpu/{uuid.uuid4()}",
+        "creationInfo": {
+            "creators": ["Tool: trivy-tpu"],
+            "created": report.created_at,
+        },
+        "packages": packages,
+        "relationships": relationships,
+    }
+
+
+def decode_spdx(doc: dict) -> T.ArtifactDetail:
+    """Best-effort decode: packages with purls → typed applications."""
+    from .cyclonedx import OS_PKG_TYPES
+    detail = T.ArtifactDetail()
+    apps: dict[str, T.Application] = {}
+    for p in doc.get("packages", []):
+        purl = ""
+        for ref in p.get("externalRefs", []):
+            if ref.get("referenceType") == "purl":
+                purl = ref.get("referenceLocator", "")
+        if not purl or not purl.startswith("pkg:"):
+            continue
+        body = purl[4:].split("?", 1)[0]
+        ptype, _, rest = body.partition("/")
+        name_ver = rest.rsplit("@", 1)
+        name = name_ver[0]
+        version = name_ver[1] if len(name_ver) > 1 else \
+            p.get("versionInfo", "")
+        if ptype in ("deb", "apk", "rpm"):
+            ns_name = name.split("/")
+            pkg = T.Package(name=ns_name[-1], version=version.split("?")[0],
+                            src_name=ns_name[-1])
+            pkg.id = f"{pkg.name}@{pkg.version}"
+            detail.packages.append(pkg)
+            fam = ns_name[0] if len(ns_name) > 1 else ""
+            if fam in OS_PKG_TYPES and not detail.os.detected:
+                detail.os = T.OS(family=fam)
+        else:
+            eco = {"pypi": "python-pkg", "golang": "gobinary",
+                   "gem": "gemspec", "maven": "jar"}.get(ptype, ptype)
+            app = apps.setdefault(eco, T.Application(type=eco))
+            pkg = T.Package(name=name.replace("/", ":", 1)
+                            if ptype == "maven" else name.split("/")[-1],
+                            version=version)
+            pkg.id = f"{pkg.name}@{pkg.version}"
+            app.packages.append(pkg)
+    detail.applications = list(apps.values())
+    return detail

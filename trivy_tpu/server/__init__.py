@@ -1,0 +1,3 @@
+"""Client/server mode (reference rpc/ + pkg/rpc): a Twirp-shaped HTTP
+boundary between analysis (client side) and batched TPU detection
+(server side)."""

@@ -1,0 +1,107 @@
+"""Remote scan client (reference pkg/rpc/client + pkg/cache/remote.go):
+the client analyzes locally, pushes blobs to the server's cache, and
+asks the server — which owns the device-resident advisory table — to
+detect. Retries transient failures like pkg/rpc/retry.go."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+from .. import types as T
+from ..report.writer import report_from_json
+from .listen import TOKEN_HEADER
+
+RETRIES = 3
+
+
+class TwirpError(RuntimeError):
+    def __init__(self, code: str, msg: str):
+        super().__init__(f"{code}: {msg}")
+        self.code = code
+
+
+class _Base:
+    def __init__(self, base_url: str, token: str = "", timeout: float = 60):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    def _call(self, service: str, method: str, payload: dict) -> dict:
+        url = f"{self.base_url}/twirp/{service}/{method}"
+        body = json.dumps(payload).encode()
+        last = None
+        for attempt in range(RETRIES):
+            req = urllib.request.Request(
+                url, data=body, method="POST",
+                headers={"Content-Type": "application/json",
+                         **({TOKEN_HEADER: self.token} if self.token else {})})
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")
+                try:
+                    j = json.loads(detail)
+                    raise TwirpError(j.get("code", str(e.code)),
+                                     j.get("msg", detail)) from None
+                except (ValueError, json.JSONDecodeError):
+                    raise TwirpError(str(e.code), detail) from None
+            except urllib.error.URLError as e:
+                last = e
+                time.sleep(0.2 * (attempt + 1))
+        raise TwirpError("unavailable", str(last))
+
+
+class RemoteCache(_Base):
+    """cache.ArtifactCache over the wire — the client half of the split
+    that makes client/server mode work (SURVEY.md §1)."""
+
+    SERVICE = "trivy.cache.v1.Cache"
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list):
+        r = self._call(self.SERVICE, "MissingBlobs",
+                       {"artifact_id": artifact_id, "blob_ids": blob_ids})
+        return bool(r.get("missing_artifact")), r.get("missing_blob_ids") or []
+
+    def put_artifact(self, artifact_id: str, info: dict):
+        self._call(self.SERVICE, "PutArtifact",
+                   {"artifact_id": artifact_id, "artifact_info": info})
+
+    def put_blob(self, blob_id: str, blob: T.BlobInfo):
+        self._call(self.SERVICE, "PutBlob",
+                   {"diff_id": blob_id, "blob_info": blob.to_json()})
+
+    def get_blob(self, blob_id: str):
+        return None  # client mode holds no local blobs (run.go:352-353)
+
+    def get_artifact(self, artifact_id: str):
+        return None
+
+
+class RemoteScanner(_Base):
+    """scanner.Driver over the wire (pkg/rpc/client/client.go:67)."""
+
+    SERVICE = "trivy.scanner.v1.Scanner"
+
+    def scan(self, target: str, artifact_id: str, blob_ids: list,
+             options: T.ScanOptions | None = None):
+        options = options or T.ScanOptions()
+        r = self._call(self.SERVICE, "Scan", {
+            "target": target,
+            "artifact_id": artifact_id,
+            "blob_ids": blob_ids,
+            "options": {
+                "scanners": list(options.scanners),
+                "vuln_type": list(options.pkg_types),
+                "list_all_packages": options.list_all_packages,
+            },
+        })
+        os_j = r.get("os") or {}
+        os_info = T.OS(family=os_j.get("family", ""),
+                       name=os_j.get("name", ""),
+                       eosl=bool(os_j.get("eosl")))
+        report = report_from_json({"Results": r.get("results") or []})
+        return report.results, os_info

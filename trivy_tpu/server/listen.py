@@ -1,0 +1,156 @@
+"""Scan server.
+
+Mirrors pkg/rpc/server/listen.go: one HTTP mux exposing the Scanner and
+Cache services plus /healthz and /version, with optional token auth
+(Trivy-Token header) and a hot-swappable advisory table (the reference
+drains in-flight requests around a DB reload, listen.go:129-192; here a
+lock swap suffices because the table is immutable once built).
+
+Routes speak Twirp's JSON encoding (POST /twirp/<svc>/<Method> with JSON
+bodies using proto field names — rpc/scanner/service.proto,
+rpc/cache/service.proto). The protobuf-binary encoding for drop-in Go
+clients is a later round. Batches accumulate per request; every Scan
+request runs the batched device join over all its target's packages at
+once (SURVEY.md §2.7 P4/P5)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import __version__, types as T
+from ..fanal.cache import FSCache, blob_from_json
+from ..scanner import LocalScanner
+
+TOKEN_HEADER = "Trivy-Token"
+
+
+class ServerState:
+    def __init__(self, table, cache_dir: str, token: str = ""):
+        self.cache = FSCache(cache_dir)
+        self.token = token
+        self._lock = threading.Lock()
+        self._scanner = LocalScanner(self.cache, table)
+
+    @property
+    def scanner(self) -> LocalScanner:
+        with self._lock:
+            return self._scanner
+
+    def swap_table(self, table) -> None:
+        """DB hot swap (reference listen.go dbWorker)."""
+        with self._lock:
+            self._scanner = LocalScanner(self.cache, table)
+
+
+def _result_to_json(res: T.Result) -> dict:
+    return res.to_json()
+
+
+class Handler(BaseHTTPRequestHandler):
+    state: ServerState = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _twirp_error(self, code: int, twirp_code: str, msg: str):
+        self._json(code, {"code": twirp_code, "msg": msg})
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/version":
+            self._json(200, {"Version": __version__})
+        else:
+            self._twirp_error(404, "not_found", self.path)
+
+    def do_POST(self):
+        st = self.state
+        if st.token and self.headers.get(TOKEN_HEADER) != st.token:
+            return self._twirp_error(401, "unauthenticated", "invalid token")
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return self._twirp_error(400, "malformed", "bad JSON body")
+
+        route = self.path
+        try:
+            if route == "/twirp/trivy.scanner.v1.Scanner/Scan":
+                return self._scan(req)
+            if route == "/twirp/trivy.cache.v1.Cache/PutArtifact":
+                st.cache.put_artifact(req.get("artifact_id", ""),
+                                      req.get("artifact_info") or {})
+                return self._json(200, {})
+            if route == "/twirp/trivy.cache.v1.Cache/PutBlob":
+                blob = blob_from_json(req.get("blob_info") or {})
+                st.cache.put_blob(req.get("diff_id", ""), blob)
+                return self._json(200, {})
+            if route == "/twirp/trivy.cache.v1.Cache/MissingBlobs":
+                missing_artifact, missing = st.cache.missing_blobs(
+                    req.get("artifact_id", ""), req.get("blob_ids") or [])
+                return self._json(200, {
+                    "missing_artifact": missing_artifact,
+                    "missing_blob_ids": missing,
+                })
+            if route == "/twirp/trivy.cache.v1.Cache/DeleteBlobs":
+                return self._json(200, {})
+            return self._twirp_error(404, "bad_route", route)
+        except KeyError as e:
+            return self._twirp_error(400, "invalid_argument", str(e))
+        except Exception as e:  # noqa: BLE001 — server must not die
+            return self._twirp_error(500, "internal", f"{type(e).__name__}: {e}")
+
+    def _scan(self, req: dict):
+        opts_j = req.get("options") or {}
+        opts = T.ScanOptions(
+            scanners=tuple(opts_j.get("scanners") or ("vuln",)),
+            pkg_types=tuple(opts_j.get("vuln_type") or ("os", "library")),
+            list_all_packages=bool(opts_j.get("list_all_packages")),
+        )
+        results, os_info = self.state.scanner.scan(
+            req.get("target", ""), req.get("artifact_id", ""),
+            req.get("blob_ids") or [], opts)
+        self._json(200, {
+            "os": {"family": os_info.family, "name": os_info.name,
+                   "eosl": os_info.eosl},
+            "results": [_result_to_json(r) for r in results],
+        })
+
+
+def serve(host: str, port: int, table, cache_dir: str, token: str = "",
+          ready_event: threading.Event | None = None):
+    Handler.state = ServerState(table, cache_dir, token)
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+    return httpd
+
+
+def serve_background(host: str, port: int, table, cache_dir: str,
+                     token: str = ""):
+    """Start in a daemon thread; returns (httpd, state) once listening."""
+    Handler.state = ServerState(table, cache_dir, token)
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, Handler.state
