@@ -1,0 +1,167 @@
+"""Lockfile analyzer tests (tier-1 analogue of pkg/dependency/parser
+tests, with authored fixtures)."""
+
+import json
+
+from trivy_tpu.fanal.analyzers import AnalyzerGroup, AnalysisResult
+
+
+def analyze(path: str, content: bytes):
+    group = AnalyzerGroup()
+    result = AnalysisResult()
+    group.analyze_file(path, content, result)
+    return result
+
+
+def pkgs_of(result, app_type):
+    for app in result.applications:
+        if app.type == app_type:
+            return {(p.name, p.version, p.dev) for p in app.packages}
+    return set()
+
+
+def test_package_lock_v3():
+    doc = {
+        "name": "demo", "lockfileVersion": 3,
+        "packages": {
+            "": {"name": "demo", "version": "1.0.0"},
+            "node_modules/lodash": {"version": "4.17.20"},
+            "node_modules/jest": {"version": "29.0.0", "dev": True},
+            "node_modules/@scope/pkg": {"version": "2.0.0"},
+        },
+    }
+    r = analyze("app/package-lock.json", json.dumps(doc).encode())
+    assert pkgs_of(r, "npm") == {
+        ("lodash", "4.17.20", False),
+        ("jest", "29.0.0", True),
+        ("@scope/pkg", "2.0.0", False),
+    }
+
+
+def test_package_lock_v1():
+    doc = {
+        "dependencies": {
+            "lodash": {"version": "4.17.11"},
+            "express": {"version": "4.18.0",
+                        "dependencies": {"qs": {"version": "6.10.0"}}},
+        },
+    }
+    r = analyze("package-lock.json", json.dumps(doc).encode())
+    assert ("lodash", "4.17.11", False) in pkgs_of(r, "npm")
+    assert ("qs", "6.10.0", False) in pkgs_of(r, "npm")
+
+
+def test_yarn_lock():
+    content = b'''# yarn lockfile v1
+
+lodash@^4.17.0:
+  version "4.17.19"
+  resolved "https://registry.example/lodash"
+
+"@babel/core@^7.0.0":
+  version "7.20.0"
+'''
+    r = analyze("yarn.lock", content)
+    assert pkgs_of(r, "yarn") == {("lodash", "4.17.19", False),
+                                  ("@babel/core", "7.20.0", False)}
+
+
+def test_pnpm_lock():
+    content = b'''lockfileVersion: '6.0'
+packages:
+  /lodash@4.17.21:
+    resolution: {integrity: sha512-x}
+  /@scope/a@1.2.3(react@18.0.0):
+    resolution: {integrity: sha512-y}
+'''
+    r = analyze("pnpm-lock.yaml", content)
+    assert pkgs_of(r, "pnpm") == {("lodash", "4.17.21", False),
+                                  ("@scope/a", "1.2.3", False)}
+
+
+def test_go_mod():
+    content = b'''module example.com/app
+
+go 1.21
+
+require (
+\tgolang.org/x/text v0.3.7
+\tgithub.com/pkg/errors v0.9.1 // indirect
+)
+
+require github.com/stretchr/testify v1.8.0
+'''
+    r = analyze("go.mod", content)
+    got = pkgs_of(r, "gomod")
+    assert ("golang.org/x/text", "0.3.7", False) in got
+    assert ("github.com/pkg/errors", "0.9.1", False) in got
+    assert ("github.com/stretchr/testify", "1.8.0", False) in got
+
+
+def test_cargo_lock():
+    content = b'''version = 3
+
+[[package]]
+name = "serde"
+version = "1.0.150"
+
+[[package]]
+name = "tokio"
+version = "1.21.2"
+'''
+    r = analyze("Cargo.lock", content)
+    assert pkgs_of(r, "cargo") == {("serde", "1.0.150", False),
+                                   ("tokio", "1.21.2", False)}
+
+
+def test_poetry_lock():
+    content = b'''[[package]]
+name = "flask"
+version = "2.2.2"
+category = "main"
+
+[[package]]
+name = "pytest"
+version = "7.2.0"
+category = "dev"
+'''
+    r = analyze("poetry.lock", content)
+    assert pkgs_of(r, "poetry") == {("flask", "2.2.2", False),
+                                    ("pytest", "7.2.0", True)}
+
+
+def test_pipfile_lock():
+    doc = {"default": {"requests": {"version": "==2.28.1"}},
+           "develop": {"black": {"version": "==22.10.0"}}}
+    r = analyze("Pipfile.lock", json.dumps(doc).encode())
+    assert pkgs_of(r, "pipenv") == {("requests", "2.28.1", False),
+                                    ("black", "22.10.0", True)}
+
+
+def test_gemfile_lock():
+    content = b'''GEM
+  remote: https://rubygems.org/
+  specs:
+    rails (7.0.4)
+      actionpack (= 7.0.4)
+    nokogiri (1.13.9)
+
+PLATFORMS
+  ruby
+
+DEPENDENCIES
+  rails
+'''
+    r = analyze("Gemfile.lock", content)
+    assert pkgs_of(r, "bundler") == {("rails", "7.0.4", False),
+                                     ("nokogiri", "1.13.9", False)}
+
+
+def test_composer_lock():
+    doc = {
+        "packages": [{"name": "monolog/monolog", "version": "v2.8.0"}],
+        "packages-dev": [{"name": "phpunit/phpunit", "version": "9.5.0"}],
+    }
+    r = analyze("composer.lock", json.dumps(doc).encode())
+    assert pkgs_of(r, "composer") == {("monolog/monolog", "2.8.0", False),
+                                      ("phpunit/phpunit", "9.5.0", True)}

@@ -252,3 +252,74 @@ def test_unparseable_raises():
         V.encode_version("debian", "x:1.0")  # non-numeric epoch
     with pytest.raises(ValueError):
         V.encode_version("debian", "1:")  # empty upstream
+
+
+class TestGem:
+    def test_basic(self):
+        check_order("rubygems", ["1.0", "1.0.1", "1.1", "2.0", "10.0"])
+        check_equal("rubygems", "1.0", "1.0.0")
+        check_equal("rubygems", "1", "1.0")
+
+    def test_prerelease(self):
+        check_order("rubygems", ["1.0.a", "1.0.b1", "1.0"])
+        check_order("rubygems", ["1.0.0.a", "1.0.0.rc1", "1.0.0"])
+        check_equal("rubygems", "1.0-rc1", "1.0.pre.rc1")
+
+    def test_alpha_lexical(self):
+        check_order("rubygems", ["1.0.a", "1.0.ab", "1.0.b"])
+        check_order("rubygems", ["5.3.a2", "5.3.b1"])
+
+    def test_mixed_segment_split(self):
+        check_equal("rubygems", "1.0.a1", "1.0.a.1")
+
+
+class TestMaven:
+    def test_basic(self):
+        check_order("maven", ["1.0", "1.0.1", "1.1", "2.0"])
+        check_equal("maven", "1.0", "1.0.0")
+        check_equal("maven", "1.0", "1.0-final")
+        check_equal("maven", "1.0", "1.0-ga")
+
+    def test_qualifiers(self):
+        check_order("maven", [
+            "1.0-alpha1", "1.0-beta1", "1.0-milestone1", "1.0-rc1",
+            "1.0-snapshot", "1.0", "1.0-sp1", "1.0.1",
+        ])
+        check_equal("maven", "1.0-a1", "1.0-alpha1")
+        check_equal("maven", "1.0-cr1", "1.0-rc1")
+
+    def test_unknown_qualifiers(self):
+        check_order("maven", ["1.0", "1.0-abc", "1.0-xyz"])
+        check_order("maven", ["1.0-sp1", "1.0-abc"])
+
+    def test_case_insensitive(self):
+        check_equal("maven", "1.0-RC1", "1.0-rc1")
+
+
+def _gen_gem(rng):
+    v = ".".join(str(rng.randint(0, 20)) for _ in range(rng.randint(1, 4)))
+    if rng.random() < 0.3:
+        v += "." + rng.choice(["a", "b1", "rc2", "pre", "beta3"])
+    return v
+
+
+def _gen_maven(rng):
+    v = ".".join(str(rng.randint(0, 20)) for _ in range(rng.randint(1, 4)))
+    if rng.random() < 0.35:
+        v += "-" + rng.choice(["alpha1", "beta2", "rc1", "snapshot",
+                               "sp1", "final", "jre8", "android"])
+    return v
+
+
+@pytest.mark.parametrize("eco,gen", [
+    ("rubygems", _gen_gem), ("maven", _gen_maven),
+])
+def test_fuzz_gem_maven(eco, gen):
+    rng = random.Random(99)
+    versions = [gen(rng) for _ in range(200)]
+    keys = {v: V.encode_version(eco, v) for v in versions}
+    for _ in range(2000):
+        a, b = rng.choice(versions), rng.choice(versions)
+        want = sign(V.compare(eco, a, b))
+        got = V.lex_cmp(keys[a].tokens, keys[b].tokens)
+        assert got == want, f"{eco}: {a!r} vs {b!r}: host={want} tokens={got}"

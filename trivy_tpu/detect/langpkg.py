@@ -31,13 +31,6 @@ APP_ECOSYSTEM = {
     "k8s": "k8s",
 }
 
-# ecosystem prefix → version scheme (trivy_tpu.version.ECOSYSTEM_SCHEME
-# covers most; extras here)
-_SCHEME_OVERRIDE = {
-    "go": "npm",        # go modules use semver ordering
-    "conda": "pip",     # conda versions are pep440-compatible enough
-}
-
 # Application types whose results keep per-package file paths
 PKG_PATH_TYPES = {"python-pkg", "node-pkg", "gemspec", "jar", "rustbinary"}
 
@@ -50,7 +43,7 @@ class LangpkgScanner:
         eco = APP_ECOSYSTEM.get(app.type)
         if eco is None:
             return []
-        scheme = _SCHEME_OVERRIDE.get(eco, eco)
+        scheme = eco  # version scheme resolves via ECOSYSTEM_SCHEME
         buckets = self.detector.table.sources_for_prefix(f"{eco}::")
         queries = []
         for pkg in app.packages:

@@ -66,7 +66,7 @@ def all_analyzers() -> dict[str, type]:
 
 
 def _ensure_loaded():
-    from . import apk, dpkg, os_release, python  # noqa: F401
+    from . import apk, dpkg, lockfiles, os_release, python  # noqa: F401
 
 
 class AnalyzerGroup:

@@ -1,0 +1,278 @@
+"""Language lockfile analyzers.
+
+Mirrors the reference's post-analyzers under pkg/fanal/analyzer/language
+and parsers under pkg/dependency/parser: each lockfile type maps to an
+Application with its resolved package set. Dev dependencies are flagged
+(reference filters them unless --include-dev-deps)."""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Optional
+
+from ... import types as T
+from . import AnalysisResult, Analyzer, register
+
+
+def _app(app_type: str, path: str, pkgs: list) -> Optional[AnalysisResult]:
+    if not pkgs:
+        return None
+    pkgs.sort(key=lambda p: (p.name, p.version))
+    return AnalysisResult(applications=[
+        T.Application(type=app_type, file_path=path, packages=pkgs)])
+
+
+def _pkg(name: str, version: str, dev: bool = False,
+         indirect: bool = False) -> T.Package:
+    return T.Package(id=f"{name}@{version}", name=name, version=version,
+                     dev=dev, indirect=indirect)
+
+
+@register
+class NpmLockAnalyzer(Analyzer):
+    """package-lock.json v1/v2/v3 (pkg/dependency/parser/nodejs/npm)."""
+    name = "npm"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith("package-lock.json")
+
+    def analyze(self, path, content):
+        try:
+            doc = json.loads(content)
+        except json.JSONDecodeError:
+            return None
+        pkgs = []
+        if "packages" in doc:  # v2/v3
+            for loc, info in doc["packages"].items():
+                if not loc.startswith("node_modules/"):
+                    continue
+                name = info.get("name") or loc.split("node_modules/")[-1]
+                if not info.get("version"):
+                    continue
+                pkgs.append(_pkg(name, info["version"],
+                                 dev=bool(info.get("dev"))))
+        else:  # v1
+            def walk(deps, indirect=False):
+                for name, info in (deps or {}).items():
+                    if info.get("version"):
+                        pkgs.append(_pkg(name, info["version"],
+                                         dev=bool(info.get("dev")),
+                                         indirect=indirect))
+                    walk(info.get("dependencies"), indirect=True)
+            walk(doc.get("dependencies"))
+        return _app("npm", path, pkgs)
+
+
+_YARN_VER = re.compile(r'^\s{2}version:?\s+"?([^"\s]+)"?')
+_YARN_HEAD = re.compile(r'^"?((?:@[^@/"]+\/)?[^@/"]+)@')
+
+
+@register
+class YarnLockAnalyzer(Analyzer):
+    """yarn.lock (classic + berry), pkg/dependency/parser/nodejs/yarn."""
+    name = "yarn"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith("yarn.lock")
+
+    def analyze(self, path, content):
+        pkgs, seen = [], set()
+        cur_name = None
+        for line in content.decode(errors="replace").splitlines():
+            if line and not line.startswith((" ", "#")):
+                m = _YARN_HEAD.match(line.strip().rstrip(":"))
+                cur_name = m.group(1) if m else None
+            elif cur_name:
+                m = _YARN_VER.match(line)
+                if m:
+                    key = (cur_name, m.group(1))
+                    if key not in seen:
+                        seen.add(key)
+                        pkgs.append(_pkg(*key))
+        return _app("yarn", path, pkgs)
+
+
+@register
+class PnpmLockAnalyzer(Analyzer):
+    """pnpm-lock.yaml, pkg/dependency/parser/nodejs/pnpm."""
+    name = "pnpm"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith("pnpm-lock.yaml")
+
+    def analyze(self, path, content):
+        import yaml
+        try:
+            doc = yaml.safe_load(content)
+        except yaml.YAMLError:
+            return None
+        if not isinstance(doc, dict):
+            return None
+        pkgs = []
+        for key, info in (doc.get("packages") or {}).items():
+            key = key.lstrip("/").split("(", 1)[0]  # drop peer-dep suffix
+            # "name@version" (v6+) or "name/version" (v5)
+            if "@" in key[1:]:
+                name, _, ver = key.rpartition("@")
+            else:
+                name, _, ver = key.rpartition("/")
+            if name and ver:
+                pkgs.append(_pkg(name, ver,
+                                 dev=bool((info or {}).get("dev"))))
+        return _app("pnpm", path, pkgs)
+
+
+_GOMOD_REQ = re.compile(
+    r"^\s*(?:require\s+)?([\w./~\-]+\.[\w./~\-]+)\s+v(\S+)(\s*//\s*indirect)?")
+
+
+@register
+class GoModAnalyzer(Analyzer):
+    """go.mod (pkg/dependency/parser/golang/mod)."""
+    name = "gomod"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith("go.mod")
+
+    def analyze(self, path, content):
+        pkgs = []
+        in_block = False
+        for line in content.decode(errors="replace").splitlines():
+            s = line.strip()
+            if s.startswith("require ("):
+                in_block = True
+                continue
+            if in_block and s == ")":
+                in_block = False
+                continue
+            if in_block or s.startswith("require "):
+                m = _GOMOD_REQ.match(line)
+                if m:
+                    pkgs.append(_pkg(m.group(1), m.group(2),
+                                     indirect=bool(m.group(3))))
+        return _app("gomod", path, pkgs)
+
+
+@register
+class CargoLockAnalyzer(Analyzer):
+    """Cargo.lock (pkg/dependency/parser/rust/cargo)."""
+    name = "cargo"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith("Cargo.lock")
+
+    def analyze(self, path, content):
+        import tomllib
+        try:
+            doc = tomllib.loads(content.decode(errors="replace"))
+        except tomllib.TOMLDecodeError:
+            return None
+        pkgs = [_pkg(p["name"], p["version"])
+                for p in doc.get("package", [])
+                if p.get("name") and p.get("version")]
+        return _app("cargo", path, pkgs)
+
+
+@register
+class PoetryLockAnalyzer(Analyzer):
+    """poetry.lock (pkg/dependency/parser/python/poetry)."""
+    name = "poetry"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith("poetry.lock")
+
+    def analyze(self, path, content):
+        import tomllib
+        try:
+            doc = tomllib.loads(content.decode(errors="replace"))
+        except tomllib.TOMLDecodeError:
+            return None
+        pkgs = []
+        for p in doc.get("package", []):
+            if not (p.get("name") and p.get("version")):
+                continue
+            dev = p.get("category") == "dev"
+            pkgs.append(_pkg(p["name"], p["version"], dev=dev))
+        return _app("poetry", path, pkgs)
+
+
+@register
+class PipenvLockAnalyzer(Analyzer):
+    """Pipfile.lock (pkg/dependency/parser/python/pipenv)."""
+    name = "pipenv"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith("Pipfile.lock")
+
+    def analyze(self, path, content):
+        try:
+            doc = json.loads(content)
+        except json.JSONDecodeError:
+            return None
+        pkgs = []
+        for section, dev in (("default", False), ("develop", True)):
+            for name, info in (doc.get(section) or {}).items():
+                ver = (info or {}).get("version", "")
+                if ver.startswith("=="):
+                    pkgs.append(_pkg(name, ver[2:], dev=dev))
+        return _app("pipenv", path, pkgs)
+
+
+_GEMLOCK_SPEC = re.compile(r"^    ([^\s(]+) \(([^)]+)\)$")
+
+
+@register
+class GemfileLockAnalyzer(Analyzer):
+    """Gemfile.lock (pkg/dependency/parser/ruby/bundler)."""
+    name = "bundler"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith("Gemfile.lock")
+
+    def analyze(self, path, content):
+        pkgs = []
+        in_gem = False
+        for line in content.decode(errors="replace").splitlines():
+            if line in ("GEM", "GIT", "PATH"):
+                in_gem = line == "GEM"
+                continue
+            if line and not line.startswith(" "):
+                in_gem = False
+                continue
+            if in_gem:
+                m = _GEMLOCK_SPEC.match(line)
+                if m:
+                    pkgs.append(_pkg(m.group(1), m.group(2)))
+        return _app("bundler", path, pkgs)
+
+
+@register
+class ComposerLockAnalyzer(Analyzer):
+    """composer.lock (pkg/dependency/parser/php/composer)."""
+    name = "composer"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith("composer.lock")
+
+    def analyze(self, path, content):
+        try:
+            doc = json.loads(content)
+        except json.JSONDecodeError:
+            return None
+        pkgs = []
+        for section, dev in (("packages", False), ("packages-dev", True)):
+            for p in doc.get(section) or []:
+                if p.get("name") and p.get("version"):
+                    pkgs.append(_pkg(p["name"],
+                                     p["version"].lstrip("v"), dev=dev))
+        return _app("composer", path, pkgs)

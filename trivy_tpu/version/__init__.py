@@ -16,15 +16,17 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from . import apk, deb, encode, pep440, rpm, semver
+from . import apk, deb, encode, gem, maven, pep440, rpm, semver
 
-# scheme name -> module with tokenize()/cmp()
+# scheme name -> module with tokenize()/cmp() (+ optional PAD_TOKEN)
 _SCHEMES = {
     "apk": apk,
     "deb": deb,
     "rpm": rpm,
     "semver": semver,
     "pep440": pep440,
+    "gem": gem,
+    "maven": maven,
 }
 
 # ecosystem/OS-family -> scheme (reference comparer tables)
@@ -46,7 +48,10 @@ ECOSYSTEM_SCHEME = {
     "conan": "semver", "swift": "semver", "cocoapods": "semver",
     "pub": "semver", "hex": "semver", "mix": "semver",
     "pip": "pep440", "pipenv": "pep440", "poetry": "pep440",
-    "python-pkg": "pep440", "conda-pkg": "pep440",
+    "python-pkg": "pep440", "conda-pkg": "pep440", "conda": "pep440",
+    "rubygems": "gem", "bundler": "gem", "gemspec": "gem",
+    "maven": "maven", "jar": "maven", "pom": "maven", "gradle": "maven",
+    "go": "semver", "k8s": "semver", "julia": "semver",
 }
 
 KEY_WIDTH = encode.KEY_WIDTH
@@ -77,7 +82,8 @@ def encode_version(ecosystem: str, v: str,
         # representable structure, numeric overflow: emit best-effort prefix
         vec = np.full(width, encode.PAD, dtype=np.int32)
         return VersionKey(vec, exact=False, raw=v)
-    vec, exact = encode.pack(toks, width)
+    pad = getattr(mod, "PAD_TOKEN", encode.PAD)
+    vec, exact = encode.pack(toks, width, pad=pad)
     return VersionKey(vec, exact=exact, raw=v)
 
 

@@ -86,10 +86,13 @@ def num_tok(value: int) -> int:
     return NUM_BASE + value
 
 
-def pack(tokens: list[int], width: int = KEY_WIDTH) -> tuple[np.ndarray, bool]:
-    """Pad/truncate a token list to `width`; returns (vector, exact)."""
+def pack(tokens: list[int], width: int = KEY_WIDTH,
+         pad: int = PAD) -> tuple[np.ndarray, bool]:
+    """Pad/truncate a token list to `width`; returns (vector, exact).
+    `pad` is scheme-specific: most schemes use PAD (absence sorts lowest),
+    gem pads with NUM_BASE because its missing segments equal zero."""
     exact = len(tokens) <= width
-    out = np.full(width, PAD, dtype=np.int32)
+    out = np.full(width, pad, dtype=np.int32)
     n = min(len(tokens), width)
     out[:n] = tokens[:n]
     return out, exact

@@ -1,0 +1,71 @@
+"""RubyGems version ordering (Gem::Version semantics).
+
+Used by the rubygems comparer (reference
+pkg/detector/library/compare/rubygems/compare.go via go-gem-version).
+
+A version splits into segments on dots and digit/letter transitions
+(Gem::Version segments on /[0-9]+|[a-z]+/i); "-" reads as ".pre.".
+Numeric segments compare numerically; string segments compare lexically
+and sort BEFORE numeric zero (1.0.a < 1.0 — prerelease), and a missing
+segment equals zero (1.0 == 1.0.0).
+
+Token layout: numeric → NUM zone; alpha chars → a NEGATIVE zone
+(ALPHA_BASE + ord, all < 0) terminated by AEOC, so any string segment
+sorts below every number; the vector pads with NUM_BASE (i.e. zero), not
+PAD, because gem's missing segments are zeros.
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import encode as E
+
+AEOC = -2000          # end-of-alpha-segment; < every alpha char ('a' < 'ab')
+ALPHA_BASE = -1000    # + ord(char); whole alpha zone < 0 < NUM zone
+PAD_TOKEN = E.NUM_BASE  # missing segment == 0
+
+_SEG = re.compile(r"[0-9]+|[a-z]+", re.IGNORECASE)
+_VALID = re.compile(r'^\s*([0-9]+(\.[0-9a-zA-Z]+)*(-[0-9A-Za-z-]+)?)?\s*$')
+
+
+def _segments(v: str):
+    if not _VALID.match(v):
+        raise ValueError(f"invalid gem version: {v!r}")
+    v = v.strip().replace("-", ".pre.")
+    if not v:
+        v = "0"
+    segs: list = []
+    for part in v.split("."):
+        for m in _SEG.finditer(part):
+            tok = m.group(0)
+            segs.append(int(tok) if tok.isdigit() else tok.lower())
+    return segs
+
+
+def tokenize(v: str) -> list[int]:
+    toks = []
+    for seg in _segments(v):
+        if isinstance(seg, int):
+            toks.append(E.num_tok(seg))
+        else:
+            toks.extend(ALPHA_BASE + ord(c) for c in seg)
+            toks.append(AEOC)
+    return toks
+
+
+def cmp(a: str, b: str) -> int:
+    sa, sb = _segments(a), _segments(b)
+    for i in range(max(len(sa), len(sb))):
+        # missing segments compare as 0 (Gem::Version <=>)
+        xa = sa[i] if i < len(sa) else 0
+        xb = sb[i] if i < len(sb) else 0
+        if xa == xb:
+            continue
+        a_str, b_str = isinstance(xa, str), isinstance(xb, str)
+        if a_str and not b_str:
+            return -1
+        if b_str and not a_str:
+            return 1
+        return -1 if xa < xb else 1
+    return 0

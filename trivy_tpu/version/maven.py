@@ -1,0 +1,92 @@
+"""Maven version ordering (ComparableVersion, simplified).
+
+Used by the maven comparer (reference
+pkg/detector/library/compare/maven/compare.go via go-mvn-version).
+
+Versions split on '.', '-', and digit/letter transitions. Numeric tokens
+compare numerically; qualifier ranks: alpha/a < beta/b < milestone/m <
+rc/cr < snapshot < '' (release) < sp < other qualifiers (lexical). A
+number always beats a qualifier; trailing null tokens ("", 0, "final",
+"ga", "release") are trimmed.
+
+This is the flat-token subset of ComparableVersion — the nested ListItem
+semantics for '-' sub-lists (e.g. 1-1.foo vs 1-1.0.foo corner cases) are
+approximated; advisory data overwhelmingly uses flat numeric+qualifier
+forms. Exact nesting is a later-round refinement.
+
+Token zones: alpha/beta/milestone/rc/snapshot → negative ranks (below
+PAD, which stands for release); sp → 4; unknown qualifiers → char tokens
+(+EOC); numbers → NUM zone.
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import encode as E
+
+_Q_NEG = {"alpha": -9, "a": -9, "beta": -8, "b": -8,
+          "milestone": -7, "m": -7, "rc": -6, "cr": -6, "snapshot": -5}
+_SP_TOK = 4
+_NULLS = {"", "final", "ga", "release"}
+
+_SEG = re.compile(r"[0-9]+|[a-z]+", re.IGNORECASE)
+
+
+def _tokens(v: str):
+    v = v.strip().lower()
+    if not v or not re.match(r"^[0-9a-z]", v):
+        raise ValueError(f"invalid maven version: {v!r}")
+    toks: list = []
+    for part in re.split(r"[.\-_]", v):
+        for m in _SEG.finditer(part):
+            s = m.group(0)
+            if s.isdigit():
+                toks.append(int(s))
+            else:
+                # ComparableVersion trims nulls at each '-' / transition
+                # boundary: "1.0-alpha1" ≡ [1, alpha, 1]
+                while toks and toks[-1] == 0:
+                    toks.pop()
+                toks.append(s)
+    # trim trailing nulls (release markers / zeros)
+    while toks and (toks[-1] == 0 or toks[-1] in _NULLS):
+        toks.pop()
+    return toks
+
+
+def _rank(tok):
+    """→ sortable tuple for the host comparator."""
+    if isinstance(tok, int):
+        return (2, tok, "")
+    if tok in _Q_NEG:
+        return (0, _Q_NEG[tok], "")
+    if tok == "sp":
+        return (1, 1, "")
+    return (1, 2, tok)  # unknown qualifier: above sp, lexical
+
+
+def tokenize(v: str) -> list[int]:
+    out = []
+    for tok in _tokens(v):
+        if isinstance(tok, int):
+            out.append(E.num_tok(tok))
+        elif tok in _Q_NEG:
+            out.append(_Q_NEG[tok])
+        elif tok == "sp":
+            out.append(_SP_TOK)
+        else:
+            out.extend(E.ascii_char_tok(c) for c in tok)
+            out.append(E.EOC)
+    return out
+
+
+def cmp(a: str, b: str) -> int:
+    ta, tb = _tokens(a), _tokens(b)
+    for i in range(max(len(ta), len(tb))):
+        # missing tokens rank as release ('' → between snapshot and sp)
+        ra = _rank(ta[i]) if i < len(ta) else (1, 0, "")
+        rb = _rank(tb[i]) if i < len(tb) else (1, 0, "")
+        if ra != rb:
+            return -1 if ra < rb else 1
+    return 0
