@@ -1,0 +1,178 @@
+"""RPM analyzer + rpm-family driver tests.
+
+Builds a real rpmdb.sqlite with hand-constructed rpm header blobs (the
+inverse of the header-image parser) — the tier-2 analogue of the
+reference's go-rpmdb fixtures."""
+
+import glob
+import os
+import sqlite3
+import struct
+import tempfile
+
+import pytest
+
+from trivy_tpu import types as T
+from trivy_tpu.db import build_table
+from trivy_tpu.db.fixtures import load_fixture_files
+from trivy_tpu.detect import BatchDetector
+from trivy_tpu.detect.ospkg import OspkgScanner
+from trivy_tpu.fanal.analyzers import AnalysisResult, AnalyzerGroup
+from trivy_tpu.fanal.analyzers import rpm as rpm_mod
+
+FIXTURES = sorted(glob.glob(
+    os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")))
+
+
+def build_header(tags: dict) -> bytes:
+    """tags: {tag: (type, value)} → rpm header image."""
+    entries = []
+    store = b""
+    for tag, (typ, value) in sorted(tags.items()):
+        if typ == 6:  # string
+            off = len(store)
+            store += value.encode() + b"\x00"
+            cnt = 1
+        elif typ == 4:  # int32
+            while len(store) % 4:
+                store += b"\x00"
+            off = len(store)
+            store += struct.pack(">i", value)
+            cnt = 1
+        else:
+            raise NotImplementedError(typ)
+        entries.append(struct.pack(">iiii", tag, typ, off, cnt))
+    blob = struct.pack(">ii", len(entries), len(store))
+    return blob + b"".join(entries) + store
+
+
+def build_rpmdb(pkgs: list[dict]) -> bytes:
+    with tempfile.NamedTemporaryFile(suffix=".sqlite") as f:
+        conn = sqlite3.connect(f.name)
+        conn.execute("CREATE TABLE Packages (hnum INTEGER PRIMARY KEY, "
+                     "blob BLOB NOT NULL)")
+        for i, p in enumerate(pkgs):
+            tags = {
+                rpm_mod.TAG_NAME: (6, p["name"]),
+                rpm_mod.TAG_VERSION: (6, p["version"]),
+                rpm_mod.TAG_RELEASE: (6, p["release"]),
+                rpm_mod.TAG_ARCH: (6, p.get("arch", "x86_64")),
+            }
+            if "epoch" in p:
+                tags[rpm_mod.TAG_EPOCH] = (4, p["epoch"])
+            if "sourcerpm" in p:
+                tags[rpm_mod.TAG_SOURCERPM] = (6, p["sourcerpm"])
+            if "license" in p:
+                tags[rpm_mod.TAG_LICENSE] = (6, p["license"])
+            conn.execute("INSERT INTO Packages VALUES (?, ?)",
+                         (i + 1, build_header(tags)))
+        conn.commit()
+        conn.close()
+        f.seek(0)
+        return open(f.name, "rb").read()
+
+
+RPM_PKGS = [
+    {"name": "openssl-libs", "version": "3.0.1", "release": "43.el9",
+     "epoch": 1, "sourcerpm": "openssl-3.0.1-43.el9.src.rpm",
+     "license": "ASL 2.0"},
+    {"name": "curl", "version": "7.76.1", "release": "14.el9",
+     "sourcerpm": "curl-7.76.1-14.el9.src.rpm"},
+]
+
+
+class TestRpmAnalyzer:
+    def test_parse_rpmdb_sqlite(self):
+        content = build_rpmdb(RPM_PKGS)
+        group = AnalyzerGroup()
+        result = AnalysisResult()
+        group.analyze_file("var/lib/rpm/rpmdb.sqlite", content, result)
+        pkgs = result.package_infos[0].packages
+        assert [(p.name, p.version, p.release, p.epoch) for p in pkgs] == [
+            ("curl", "7.76.1", "14.el9", 0),
+            ("openssl-libs", "3.0.1", "43.el9", 1),
+        ]
+        ossl = pkgs[1]
+        assert ossl.src_name == "openssl"
+        assert ossl.src_version == "3.0.1"
+        assert ossl.src_release == "43.el9"
+        assert ossl.licenses == ["ASL 2.0"]
+
+    def test_rpmqa_manifest(self):
+        line = ("vim\t8.2.4082-1.cm1\t0\t0\tVMware\t(none)\t100\tx86_64\t0\t"
+                "vim-8.2.4082-1.cm1.src.rpm\n")
+        group = AnalyzerGroup()
+        result = AnalysisResult()
+        group.analyze_file("var/lib/rpmmanifest/container-manifest-2",
+                           line.encode(), result)
+        p = result.package_infos[0].packages[0]
+        assert (p.name, p.version, p.release) == ("vim", "8.2.4082", "1.cm1")
+
+    def test_redhat_release(self):
+        group = AnalyzerGroup()
+        for content, family, ver in (
+                (b"Rocky Linux release 9.1 (Blue Onyx)\n", "rocky", "9.1"),
+                (b"CentOS Linux release 8.4.2105\n", "centos", "8.4.2105"),
+                (b"AlmaLinux release 9.0 (Emerald Puma)\n", "alma", "9.0"),
+                (b"Red Hat Enterprise Linux release 8.7 (Ootpa)\n",
+                 "redhat", "8.7")):
+            result = AnalysisResult()
+            group.analyze_file("etc/redhat-release", content, result)
+            assert (result.os.family, result.os.name) == (family, ver)
+
+    def test_amazon_release(self):
+        group = AnalyzerGroup()
+        result = AnalysisResult()
+        group.analyze_file("etc/system-release",
+                           b"Amazon Linux release 2 (Karoo)\n", result)
+        assert result.os.family == "amazon"
+        assert result.os.name.startswith("2")
+
+
+@pytest.fixture(scope="module")
+def detector():
+    advisories, details, _ = load_fixture_files(FIXTURES)
+    return BatchDetector(build_table(advisories, details))
+
+
+class TestRpmDrivers:
+    def scan(self, detector, family, os_name, pkgs):
+        scanner = OspkgScanner(detector)
+        vulns, _ = scanner.scan(T.OS(family=family, name=os_name), None, pkgs)
+        return sorted(v.vulnerability_id for v in vulns)
+
+    def test_rocky_arch_aware(self, detector):
+        pkg = T.Package(name="openssl-libs", src_name="openssl",
+                        version="3.0.1", release="43.el9", epoch=1,
+                        arch="x86_64")
+        assert self.scan(detector, "rocky", "9.1", [pkg]) == \
+            ["CVE-2023-0286"]
+        # aarch64 not in the advisory's arches → no finding
+        pkg_arm = T.Package(name="openssl-libs", src_name="openssl",
+                            version="3.0.1", release="43.el9", epoch=1,
+                            arch="aarch64")
+        assert self.scan(detector, "rocky", "9.1", [pkg_arm]) == []
+
+    def test_amazon(self, detector):
+        pkg = T.Package(name="curl", src_name="curl",
+                        version="8.0.0", release="1.amzn2")
+        assert self.scan(detector, "amazon", "2 (Karoo)", [pkg]) == \
+            ["CVE-2023-27533"]
+
+    def test_oracle(self, detector):
+        pkg = T.Package(name="glibc", src_name="glibc",
+                        version="2.34", release="28.el9")
+        assert self.scan(detector, "oracle", "9.2", [pkg]) == \
+            ["CVE-2023-4911"]
+
+    def test_photon(self, detector):
+        pkg = T.Package(name="openssl", src_name="openssl",
+                        version="3.0.3", release="1.ph4")
+        assert self.scan(detector, "photon", "4.0", [pkg]) == \
+            ["CVE-2023-0464"]
+
+    def test_epoch_compare(self, detector):
+        # installed 1:3.0.1-47.el9_1 == fixed → not vulnerable
+        pkg = T.Package(name="openssl-libs", version="3.0.1",
+                        release="47.el9_1", epoch=1, arch="x86_64")
+        assert self.scan(detector, "rocky", "9.1", [pkg]) == []

@@ -39,12 +39,28 @@ def _severity_name(v) -> str:
         return str(v)
 
 
+_BUCKET_PREFIXES = [
+    # multi-word OS bucket prefixes (trivy-db vulnsrc bucket naming)
+    ("amazon linux", "amazon"),
+    ("oracle linux", "oracle"),
+    ("photon os", "photon"),
+    ("cbl-mariner", "cbl-mariner"),
+    ("opensuse leap", "opensuse-leap"),
+    ("opensuse tumbleweed", "opensuse-tumbleweed"),
+    ("suse linux enterprise", "suse linux enterprise server"),
+    ("red hat", "redhat"),
+]
+
+
 def ecosystem_for_source(source: str) -> str:
     """Map a bucket name to a version scheme key."""
     if "::" in source:
         return source.split("::", 1)[0]  # "pip::GHSA Pip" → "pip"
-    family = source.rsplit(" ", 1)[0].lower() if " " in source else source.lower()
-    return family
+    low = source.lower()
+    for prefix, eco in _BUCKET_PREFIXES:
+        if low.startswith(prefix):
+            return eco
+    return low.rsplit(" ", 1)[0] if " " in low else low
 
 
 def load_fixture_docs(docs: list) -> tuple[list[RawAdvisory], dict, dict]:
@@ -71,8 +87,14 @@ def load_fixture_docs(docs: list) -> tuple[list[RawAdvisory], dict, dict]:
             name = pkg["bucket"]
             for pair in pkg.get("pairs", []):
                 v = pair.get("value") or {}
-                if "Entries" in v:
+                arches: tuple = ()
+                if "Entries" in v and not v.get("FixedVersion"):
                     continue  # Red Hat content-set schema: later round
+                if "Entries" in v:
+                    # Rocky/Alma style: entries carry per-arch fix info
+                    arches = tuple(sorted({
+                        a for e in v["Entries"]
+                        for a in (e.get("Arches") or [])}))
                 status = ""
                 if "Status" in v:
                     try:
@@ -102,6 +124,7 @@ def load_fixture_docs(docs: list) -> tuple[list[RawAdvisory], dict, dict]:
                     severity=_severity_name(v.get("Severity")),
                     data_source=_ds_fields(data_source),
                     vendor_ids=tuple(v.get("VendorIDs") or ()),
+                    arches=arches,
                 ))
     return advisories, details, sources
 

@@ -48,6 +48,7 @@ class RawAdvisory:
     severity: str = ""           # source-provided severity (e.g. distro)
     data_source: Optional[dict] = None
     vendor_ids: tuple = ()
+    arches: tuple = ()           # Rocky/Alma: advisory applies per-arch
 
 
 @dataclass
@@ -62,6 +63,7 @@ class AdvisoryGroup:
     severity: str
     data_source: Optional[dict]
     vendor_ids: tuple
+    arches: tuple = ()
     # raw bound strings per row for exact host recheck of inexact rows
     rows: list = field(default_factory=list)  # [(polarity, Interval)]
 
@@ -113,6 +115,7 @@ class AdvisoryTable:
                      "fixed_version": g.fixed_version, "status": g.status,
                      "severity": g.severity, "data_source": g.data_source,
                      "vendor_ids": list(g.vendor_ids),
+                     "arches": list(g.arches),
                      "rows": [[p, iv.lo, iv.lo_incl, iv.hi, iv.hi_incl]
                               for p, iv in g.rows]}
                     for g in self.groups
@@ -132,6 +135,7 @@ class AdvisoryTable:
                 fixed_version=g["fixed_version"], status=g["status"],
                 severity=g["severity"], data_source=g["data_source"],
                 vendor_ids=tuple(g["vendor_ids"]),
+                arches=tuple(g.get("arches") or ()),
                 rows=[(p, Interval(lo, li, hi, hi_i))
                       for p, lo, li, hi, hi_i in g["rows"]],
             )
@@ -172,6 +176,7 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
             fixed_version=adv.fixed_version or _first_fixed(adv),
             status=adv.status, severity=adv.severity,
             data_source=adv.data_source, vendor_ids=adv.vendor_ids,
+            arches=adv.arches,
         )
         gid = len(groups)
         intervals: list[tuple[bool, Interval]] = []

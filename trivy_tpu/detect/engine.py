@@ -32,6 +32,7 @@ class PkgQuery:
     ecosystem: str   # version scheme key
     name: str        # join name (src package name for OS pkgs)
     version: str     # installed version (formatted, e.g. epoch:ver-rel)
+    arch: str = ""   # for arch-scoped advisories (Rocky/Alma entries)
     ref: Any = None  # caller's package object
 
 
@@ -168,6 +169,8 @@ class BatchDetector:
             q, k = usable[i]
             if g.pkg_name != q.name or g.source != q.source:
                 continue  # 64-bit hash collision: reject
+            if g.arches and q.arch and q.arch not in g.arches:
+                continue  # advisory scoped to other architectures
             if inex_any[u] or not k.exact:
                 pos, negv = self._exact_eval(g, q)
             else:

@@ -76,6 +76,8 @@ class FamilyDriver:
     severity_source: str = ""   # SeveritySource when advisory has severity
     eol: Optional[dict] = None
     eol_key: Callable[[str], str] = staticmethod(lambda v: v)
+    use_src: bool = True        # join on SrcName (False: binary pkg name)
+    arch_aware: bool = False    # advisories scoped per-arch (Rocky/Alma)
 
 
 def _alpine_stream(os_ver: str, repo: Optional[T.Repository]) -> str:
@@ -87,6 +89,27 @@ def _alpine_stream(os_ver: str, repo: Optional[T.Repository]) -> str:
         if rel and v != rel:
             v = rel  # repository release preferred (alpine.go:76-83)
     return v
+
+
+def _amazon_stream(v: str) -> str:
+    v = major(v.split()[0]) if v.strip() else v
+    return v if v in ("2", "2022", "2023") else "1"
+
+
+AMAZON_EOL = {
+    "1": _d(2023, 12, 31), "2": _d(2025, 6, 30),
+    "2022": _d(2024, 6, 30), "2023": _d(2028, 3, 15),
+}
+ORACLE_EOL = {
+    "5": _d(2017, 12, 31), "6": _d(2021, 3, 21), "7": _d(2024, 12, 31),
+    "8": _d(2029, 7, 31), "9": _d(2032, 6, 30),
+}
+ROCKY_EOL = {"8": _d(2029, 5, 31), "9": _d(2032, 5, 31)}
+ALMA_EOL = {"8": _d(2029, 3, 1), "9": _d(2032, 5, 31)}
+PHOTON_EOL = {
+    "1.0": _d(2022, 2, 28), "2.0": _d(2022, 12, 31),
+    "3.0": _d(2024, 3, 1), "4.0": _d(2026, 3, 1), "5.0": _d(2028, 3, 1),
+}
 
 
 DRIVERS: dict[str, FamilyDriver] = {
@@ -114,7 +137,45 @@ DRIVERS: dict[str, FamilyDriver] = {
         stream=lambda v, r: v,
         bucket=lambda s: f"ubuntu {s}",
         eol=UBUNTU_EOL),
+    # rpm families (pkg/detector/ospkg/{amazon,oracle,rocky,alma,photon,
+    # mariner,suse}); join-name and stream rules follow each driver
+    "amazon": FamilyDriver(
+        family="amazon", ecosystem="amazon",
+        stream=lambda v, r: _amazon_stream(v),
+        bucket=lambda s: f"amazon linux {s}",
+        eol=AMAZON_EOL, eol_key=_amazon_stream, use_src=False),
+    "oracle": FamilyDriver(
+        family="oracle", ecosystem="oracle",
+        stream=lambda v, r: major(v),
+        bucket=lambda s: f"Oracle Linux {s}",
+        eol=ORACLE_EOL, eol_key=major, use_src=False),
+    "rocky": FamilyDriver(
+        family="rocky", ecosystem="rocky",
+        stream=lambda v, r: major(v),
+        bucket=lambda s: f"rocky {s}",
+        eol=ROCKY_EOL, eol_key=major, use_src=False, arch_aware=True),
+    "alma": FamilyDriver(
+        family="alma", ecosystem="alma",
+        stream=lambda v, r: major(v),
+        bucket=lambda s: f"alma {s}",
+        eol=ALMA_EOL, eol_key=major, use_src=False, arch_aware=True),
+    "photon": FamilyDriver(
+        family="photon", ecosystem="photon",
+        stream=lambda v, r: v,
+        bucket=lambda s: f"Photon OS {s}",
+        eol=PHOTON_EOL),
+    "cbl-mariner": FamilyDriver(
+        family="cbl-mariner", ecosystem="cbl-mariner",
+        stream=lambda v, r: minor(v),
+        bucket=lambda s: f"CBL-Mariner {s}",
+        eol_key=minor),
+    "opensuse-leap": FamilyDriver(
+        family="opensuse-leap", ecosystem="opensuse-leap",
+        stream=lambda v, r: v,
+        bucket=lambda s: f"openSUSE Leap {s}"),
 }
+
+
 
 
 def supported_families() -> list[str]:
@@ -143,12 +204,18 @@ class OspkgScanner:
         for pkg in packages:
             if pkg.name == "gpg-pubkey":
                 continue
-            name = pkg.src_name or pkg.name
-            ver = pkg.format_src_version() or pkg.format_version()
+            if driver.use_src:
+                name = pkg.src_name or pkg.name
+                ver = pkg.format_src_version() or pkg.format_version()
+            else:
+                name = pkg.name
+                ver = pkg.format_version()
             if not ver:
                 continue
-            queries.append(PkgQuery(source=bucket, ecosystem=driver.ecosystem,
-                                    name=name, version=ver, ref=pkg))
+            queries.append(PkgQuery(
+                source=bucket, ecosystem=driver.ecosystem,
+                name=name, version=ver,
+                arch=pkg.arch if driver.arch_aware else "", ref=pkg))
 
         hits = self.detector.detect(queries)
         vulns = [self._to_vuln(h, driver) for h in hits]

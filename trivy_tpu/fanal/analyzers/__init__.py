@@ -66,7 +66,8 @@ def all_analyzers() -> dict[str, type]:
 
 
 def _ensure_loaded():
-    from . import apk, dpkg, lockfiles, os_release, python  # noqa: F401
+    from . import (apk, dpkg, lockfiles, os_release,  # noqa: F401
+                   python, redhat, rpm)
 
 
 class AnalyzerGroup:

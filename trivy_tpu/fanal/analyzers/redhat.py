@@ -1,0 +1,96 @@
+"""Red Hat family OS analyzers.
+
+Mirrors pkg/fanal/analyzer/os/{redhatbase,amazonlinux,mariner}:
+- etc/redhat-release: "<distro> release <version>" → centos/rocky/alma/
+  oracle/fedora/redhat family;
+- etc/system-release + usr/lib/system-release: Amazon Linux;
+- etc/mariner-release: CBL-Mariner.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ... import types as T
+from . import AnalysisResult, Analyzer, register
+
+_REDHAT_RE = re.compile(r"(.*) release (\d[\d.]*)")
+
+_FAMILY = {
+    "centos": T.OSFamily.CENTOS, "centos linux": T.OSFamily.CENTOS,
+    "centos stream": T.OSFamily.CENTOS,
+    "rocky": T.OSFamily.ROCKY, "rocky linux": T.OSFamily.ROCKY,
+    "alma": T.OSFamily.ALMA, "almalinux": T.OSFamily.ALMA,
+    "alma linux": T.OSFamily.ALMA,
+    "oracle": T.OSFamily.ORACLE, "oracle linux": T.OSFamily.ORACLE,
+    "oracle linux server": T.OSFamily.ORACLE,
+    "fedora": T.OSFamily.FEDORA, "fedora linux": T.OSFamily.FEDORA,
+}
+
+
+@register
+class RedHatBaseAnalyzer(Analyzer):
+    name = "redhatbase"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path == "etc/redhat-release"
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        for line in content.decode(errors="replace").splitlines():
+            m = _REDHAT_RE.search(line.strip())
+            if not m:
+                continue
+            distro = m.group(1).lower()
+            for key, family in _FAMILY.items():
+                if distro.startswith(key):
+                    return AnalysisResult(os=T.OS(family=family,
+                                                  name=m.group(2)))
+            return AnalysisResult(os=T.OS(family=T.OSFamily.REDHAT,
+                                          name=m.group(2)))
+        return None
+
+
+@register
+class AmazonLinuxAnalyzer(Analyzer):
+    name = "amazonlinux"
+    version = 1
+    paths = ("etc/system-release", "usr/lib/system-release")
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path in self.paths
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        for line in content.decode(errors="replace").splitlines():
+            fields = line.split()
+            if line.startswith("Amazon Linux release 2"):
+                if len(fields) < 5:
+                    continue
+                return AnalysisResult(os=T.OS(
+                    family=T.OSFamily.AMAZON,
+                    name=" ".join(fields[3:])))
+            if line.startswith("Amazon Linux"):
+                return AnalysisResult(os=T.OS(
+                    family=T.OSFamily.AMAZON,
+                    name=" ".join(fields[2:])))
+        return None
+
+
+@register
+class MarinerAnalyzer(Analyzer):
+    name = "mariner"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path == "etc/mariner-release"
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        # "CBL-Mariner 2.0.20220226"
+        for line in content.decode(errors="replace").splitlines():
+            if "CBL-Mariner" in line:
+                ver = line.split("CBL-Mariner")[-1].strip()
+                if ver:
+                    return AnalysisResult(os=T.OS(
+                        family=T.OSFamily.MARINER, name=ver))
+        return None
