@@ -1,0 +1,59 @@
+"""Native C++ helper tests: build, and bit-equality with the Python
+fallbacks."""
+
+import numpy as np
+import pytest
+
+from trivy_tpu import native
+from trivy_tpu.ops import ac
+from trivy_tpu.ops.hashing import fnv1a64
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    return True
+
+
+def test_fnv_batch_matches_python(lib_available):
+    keys = [b"alpine 3.17\x00openssl", b"", b"x" * 1000,
+            "unicode-β".encode()]
+    got = native.fnv1a64_batch(keys)
+    want = np.asarray([fnv1a64(k) for k in keys], dtype=np.uint64)
+    assert (got == want).all()
+
+
+def test_pack_chunks_matches_python(lib_available):
+    files = [b"Hello WORLD " * 100, b"", b"short", b"A" * 5000]
+    chunk_len, overlap = 256, 31
+    native_rows, native_owner = ac.pack_chunks(files, chunk_len, overlap)
+    py_blocks, py_owner = [], []
+    for fi, data in enumerate(files):
+        if not data:
+            continue
+        b = ac._pack_one_py(data, chunk_len, overlap)
+        py_blocks.append(b)
+        py_owner.extend([fi] * b.shape[0])
+    py_rows = np.concatenate(py_blocks, axis=0)
+    assert native_rows.shape == py_rows.shape
+    assert (native_rows == py_rows).all()
+    assert (native_owner == np.asarray(py_owner)).all()
+
+
+def test_contains_lower(lib_available):
+    import ctypes
+    lib = native._build_and_load()
+    hay = b"The QUICK brown Fox"
+    hb = np.frombuffer(hay, np.uint8)
+
+    def contains(needle: bytes) -> bool:
+        nb = np.frombuffer(needle, np.uint8)
+        return bool(lib.contains_lower(
+            hb.ctypes.data, ctypes.c_int64(len(hay)),
+            nb.ctypes.data, ctypes.c_int64(len(needle))))
+
+    assert contains(b"quick")
+    assert contains(b"fox")
+    assert contains(b"the quick")
+    assert not contains(b"wolf")

@@ -92,6 +92,16 @@ class BatchDetector:
                 usable.append((q, k))
         if not usable:
             return usable, None
+        # batch-hash cold (source, name) keys via the native helper
+        cold = [(q.source, q.name) for q, _ in usable
+                if (q.source, q.name) not in self._hash_cache]
+        if len(cold) > 64:
+            from ..native import fnv1a64_batch
+            cold = list(dict.fromkeys(cold))
+            hashes = split_u64(fnv1a64_batch(
+                [s.encode() + b"\x00" + n.encode() for s, n in cold]))
+            for ck, h in zip(cold, hashes):
+                self._hash_cache[ck] = h
         b = _next_pow2(len(usable))
         kw = t.lo_tok.shape[1]
         packed = np.zeros((b, kw + 3), np.int32)

@@ -1,0 +1,105 @@
+"""Native (C++) host helpers, compiled on first use and loaded via
+ctypes; every entry point has a pure-numpy/Python fallback so the
+framework works without a toolchain.
+
+Source: native/trivy_native.cpp at the repo root. The compiled object is
+cached next to the source keyed by its content hash."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "trivy_native.cpp")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SRC):
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        cache_dir = os.path.join(tempfile.gettempdir(), "trivy_tpu_native")
+        os.makedirs(cache_dir, exist_ok=True)
+        so_path = os.path.join(cache_dir, f"trivy_native_{digest}.so")
+        if not os.path.exists(so_path):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 _SRC, "-o", so_path + ".tmp"],
+                check=True, capture_output=True)
+            os.replace(so_path + ".tmp", so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.fnv1a64_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p]
+        lib.lower_pack_chunks.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p]
+        lib.contains_lower.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64]
+        lib.contains_lower.restype = ctypes.c_int32
+        _lib = lib
+    except (OSError, subprocess.CalledProcessError):
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _build_and_load() is not None
+
+
+def fnv1a64_batch(keys: list[bytes]) -> np.ndarray:
+    """Hash a batch of byte strings → uint64[N]."""
+    lib = _build_and_load()
+    if lib is None or not keys:
+        from ..ops.hashing import fnv1a64
+        return np.asarray([fnv1a64(k) for k in keys], dtype=np.uint64)
+    data = b"".join(keys)
+    offsets = np.zeros(len(keys) + 1, dtype=np.int64)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    buf = np.frombuffer(data, dtype=np.uint8) if data else \
+        np.zeros(1, np.uint8)
+    out = np.empty(len(keys), dtype=np.uint64)
+    lib.fnv1a64_batch(
+        buf.ctypes.data, offsets.ctypes.data,
+        ctypes.c_int64(len(keys)), out.ctypes.data)
+    return out
+
+
+def lower_pack_chunks(data: bytes, chunk_len: int,
+                      overlap: int) -> Optional[np.ndarray]:
+    """Lowercase + chunk one file → uint8[n_chunks, chunk_len]; None if
+    the native library is unavailable (caller falls back)."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    if not data:
+        return np.zeros((0, chunk_len), np.uint8)
+    stride = max(1, chunk_len - overlap)
+    max_chunks = (len(data) + stride - 1) // stride + 1
+    out = np.zeros((max_chunks, chunk_len), dtype=np.uint8)
+    n = ctypes.c_int32(0)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    lib.lower_pack_chunks(
+        buf.ctypes.data, ctypes.c_int64(len(data)),
+        ctypes.c_int32(chunk_len), ctypes.c_int32(overlap),
+        out.ctypes.data, ctypes.c_int32(max_chunks),
+        ctypes.byref(n))
+    return out[:n.value]

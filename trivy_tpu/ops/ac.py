@@ -114,23 +114,40 @@ def ac_scan(trans, out_bits, chunks):
 def pack_chunks(files: list[bytes], chunk_len: int,
                 overlap: int) -> tuple[np.ndarray, np.ndarray]:
     """Pack lowercased file bytes into [B, chunk_len] with per-chunk file
-    index map [B]. Stride = chunk_len - overlap."""
-    stride = max(1, chunk_len - overlap)
-    rows, owner = [], []
+    index map [B]. Stride = chunk_len - overlap. Uses the native C++
+    packer when available (trivy_tpu.native)."""
+    from ..native import lower_pack_chunks
+    blocks, owner = [], []
+    native_ok = True
     for fi, data in enumerate(files):
-        arr = lower_bytes(data) if data else np.zeros(0, np.uint8)
-        if len(arr) == 0:
+        if not data:
             continue
-        for off in range(0, len(arr), stride):
-            piece = arr[off:off + chunk_len]
-            if off > 0 and len(piece) <= overlap:
-                break  # fully covered by the previous chunk
-            row = np.zeros(chunk_len, dtype=np.uint8)
-            row[:len(piece)] = piece
-            rows.append(row)
-            owner.append(fi)
-            if off + chunk_len >= len(arr):
-                break
-    if not rows:
+        block = lower_pack_chunks(data, chunk_len, overlap) \
+            if native_ok else None
+        if block is None:
+            native_ok = False
+            block = _pack_one_py(data, chunk_len, overlap)
+        if block.shape[0]:
+            blocks.append(block)
+            owner.extend([fi] * block.shape[0])
+    if not blocks:
         return (np.zeros((0, chunk_len), np.uint8), np.zeros(0, np.int64))
-    return np.stack(rows), np.asarray(owner)
+    return np.concatenate(blocks, axis=0), np.asarray(owner)
+
+
+def _pack_one_py(data: bytes, chunk_len: int, overlap: int) -> np.ndarray:
+    stride = max(1, chunk_len - overlap)
+    arr = lower_bytes(data)
+    rows = []
+    for off in range(0, len(arr), stride):
+        piece = arr[off:off + chunk_len]
+        if off > 0 and len(piece) <= overlap:
+            break  # fully covered by the previous chunk
+        row = np.zeros(chunk_len, dtype=np.uint8)
+        row[:len(piece)] = piece
+        rows.append(row)
+        if off + chunk_len >= len(arr):
+            break
+    if not rows:
+        return np.zeros((0, chunk_len), np.uint8)
+    return np.stack(rows)
