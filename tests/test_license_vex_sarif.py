@@ -1,0 +1,103 @@
+"""License scanning, VEX suppression, SARIF output tests."""
+
+import json
+
+from trivy_tpu import types as T
+from trivy_tpu.licensing import categorize, normalize, scan_packages
+from trivy_tpu.report.sarif import to_sarif
+from trivy_tpu.vex import VexStatement, apply_vex, load_vex_file
+
+
+class TestLicensing:
+    def test_categorize(self):
+        assert categorize("MIT") == "notice"
+        assert categorize("GPL-3.0-only") == "restricted"
+        assert categorize("AGPL-3.0") == "forbidden"
+        assert categorize("MPL-2.0") == "reciprocal"
+        assert categorize("CC0-1.0") == "unencumbered"
+        assert categorize("SomethingWeird-1.0") == "unknown"
+
+    def test_normalize(self):
+        assert normalize("Apache 2.0") == "Apache-2.0"
+        assert normalize("GPLv2") == "GPL-2.0"
+        assert normalize("MIT License") == "MIT"
+
+    def test_scan_packages(self):
+        pkgs = [T.Package(name="musl", licenses=["MIT"]),
+                T.Package(name="readline", licenses=["GPLv3"])]
+        apps = [T.Application(type="python-pkg", file_path="app/x",
+                              packages=[T.Package(name="flask",
+                                                  licenses=["BSD-3-Clause"])])]
+        out = scan_packages(pkgs, apps)
+        by_name = {(li.pkg_name, li.name): li for li in out}
+        assert by_name[("musl", "MIT")].severity == "LOW"
+        assert by_name[("readline", "GPL-3.0")].category == "restricted"
+        assert by_name[("readline", "GPL-3.0")].severity == "HIGH"
+        assert by_name[("flask", "BSD-3-Clause")].file_path == "app/x"
+
+
+class TestVex:
+    def _vuln(self, vid, purl=""):
+        return T.DetectedVulnerability(
+            vulnerability_id=vid, pkg_name="openssl",
+            installed_version="3.0.7",
+            pkg_identifier=T.PkgIdentifier(purl=purl))
+
+    def test_openvex_suppression(self, tmp_path):
+        doc = {
+            "@context": "https://openvex.dev/ns/v0.2.0",
+            "statements": [
+                {"vulnerability": {"name": "CVE-2023-0286"},
+                 "products": [{"@id": "pkg:apk/alpine/openssl@3.0.7-r0"}],
+                 "status": "not_affected",
+                 "justification": "vulnerable_code_not_in_execute_path"},
+                {"vulnerability": {"name": "CVE-2023-9999"},
+                 "status": "affected"},
+            ],
+        }
+        p = tmp_path / "vex.json"
+        p.write_text(json.dumps(doc))
+        statements = load_vex_file(str(p))
+        res = T.Result(target="t", clazz="os-pkgs", vulnerabilities=[
+            self._vuln("CVE-2023-0286",
+                       purl="pkg:apk/alpine/openssl@3.0.7-r0?arch=x86"),
+            self._vuln("CVE-2023-9999"),
+        ])
+        apply_vex([res], statements)
+        assert [v.vulnerability_id for v in res.vulnerabilities] == \
+            ["CVE-2023-9999"]
+
+    def test_wildcard_product(self):
+        res = T.Result(target="t", vulnerabilities=[self._vuln("CVE-1")])
+        apply_vex([res], [VexStatement(vuln_id="CVE-1",
+                                       status="not_affected")])
+        assert res.vulnerabilities == []
+
+
+class TestSarif:
+    def test_shape(self):
+        v = T.DetectedVulnerability(
+            vulnerability_id="CVE-2023-0286", pkg_name="openssl",
+            installed_version="3.0.7-r0", fixed_version="3.0.8-r0",
+            primary_url="https://avd.aquasec.com/nvd/cve-2023-0286")
+        v.vulnerability.severity = "HIGH"
+        v.vulnerability.title = "openssl: X.400 type confusion"
+        sec = T.SecretFinding(rule_id="github-pat", severity="CRITICAL",
+                              title="GitHub PAT", start_line=3, end_line=3,
+                              match="t = ****")
+        report = T.Report(
+            artifact_name="img", artifact_type="container_image",
+            results=[
+                T.Result(target="img (alpine 3.17)", clazz="os-pkgs",
+                         vulnerabilities=[v]),
+                T.Result(target="cfg.txt", clazz="secret", secrets=[sec]),
+            ])
+        doc = to_sarif(report)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+        assert rule_ids == ["CVE-2023-0286", "github-pat"]
+        assert len(run["results"]) == 2
+        assert run["results"][0]["level"] == "error"
+        assert run["results"][1]["locations"][0]["physicalLocation"][
+            "region"]["startLine"] == 3

@@ -26,13 +26,15 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--scanners", default="vuln",
                    help="comma-separated: vuln,secret")
     p.add_argument("--format", "-f", default="json",
-                   choices=["json", "table", "cyclonedx", "spdx-json"])
+                   choices=["json", "table", "sarif", "cyclonedx",
+                            "spdx-json"])
     p.add_argument("--output", "-o", default="")
     p.add_argument("--severity", "-s", default=",".join(T.SEVERITIES))
     p.add_argument("--ignore-unfixed", action="store_true")
     p.add_argument("--ignore-status", default="",
                    help="comma-separated statuses to hide")
     p.add_argument("--ignorefile", default="")
+    p.add_argument("--vex", default="", help="OpenVEX/CycloneDX VEX file")
     p.add_argument("--list-all-pkgs", action="store_true")
     p.add_argument("--exit-code", type=int, default=0)
     p.add_argument("--cache-dir",
@@ -112,6 +114,10 @@ def _scan_common(args, ref, cache, artifact_type: str) -> int:
         pkg_types=tuple(args.pkg_types.split(",")),
     )
     results, os_info = scanner.scan(ref.name, ref.id, ref.blob_ids, opts)
+
+    if getattr(args, "vex", ""):
+        from .vex import apply_vex, load_vex_file
+        apply_vex(results, load_vex_file(args.vex))
 
     fopts = FilterOptions(
         severities=[s.strip().upper() for s in args.severity.split(",")],

@@ -1,0 +1,117 @@
+"""License scanning (reference pkg/licensing).
+
+Round-1 scope matches the reference's default mode: package-declared
+licenses are categorized and reported (scanLicenses,
+pkg/scanner/local/scan.go:280); full-text file classification
+(--license-full, google/licenseclassifier) is the expensive opt-in path
+and lands later.
+
+Category → severity mapping follows pkg/licensing/scanner.go:23."""
+
+from __future__ import annotations
+
+from . import types as T
+
+CATEGORY_SEVERITY = {
+    "forbidden": "CRITICAL",
+    "restricted": "HIGH",
+    "reciprocal": "MEDIUM",
+    "notice": "LOW",
+    "permissive": "LOW",
+    "unencumbered": "LOW",
+    "unknown": "UNKNOWN",
+}
+
+# Classification of common SPDX ids into google/licenseclassifier-style
+# categories (pkg/licensing/category data).
+_CATEGORIES = {
+    "forbidden": {"AGPL-1.0", "AGPL-3.0", "AGPL-3.0-only",
+                  "AGPL-3.0-or-later", "CC-BY-NC-1.0", "CC-BY-NC-2.0",
+                  "CC-BY-NC-3.0", "CC-BY-NC-4.0", "CC-BY-NC-ND-4.0",
+                  "CC-BY-NC-SA-4.0", "Commons-Clause", "WTFPL"},
+    "restricted": {"GPL-1.0", "GPL-2.0", "GPL-2.0-only", "GPL-2.0+",
+                   "GPL-2.0-or-later", "GPL-3.0", "GPL-3.0-only",
+                   "GPL-3.0-or-later", "LGPL-2.0", "LGPL-2.1",
+                   "LGPL-2.1-only", "LGPL-2.1-or-later", "LGPL-3.0",
+                   "LGPL-3.0-only", "LGPL-3.0-or-later", "CC-BY-ND-4.0",
+                   "CC-BY-SA-4.0", "NPL-1.0", "NPL-1.1", "OSL-3.0",
+                   "QPL-1.0", "Sleepycat"},
+    "reciprocal": {"MPL-1.0", "MPL-1.1", "MPL-2.0", "EPL-1.0", "EPL-2.0",
+                   "CDDL-1.0", "CDDL-1.1", "CPL-1.0", "APSL-2.0",
+                   "Ruby", "OSL-1.0", "IPL-1.0", "ErlPL-1.1"},
+    "notice": {"Apache-2.0", "Apache-1.1", "Apache-1.0", "MIT", "BSD-2-Clause",
+               "BSD-3-Clause", "BSD-4-Clause", "ISC", "Artistic-1.0",
+               "Artistic-2.0", "Zlib", "PSF-2.0", "Python-2.0", "NCSA",
+               "OpenSSL", "PHP-3.0", "PHP-3.01", "W3C", "X11", "Xnet",
+               "AFL-3.0", "BSL-1.0", "CC-BY-4.0", "FTL", "LPL-1.02",
+               "MS-PL", "Unicode-DFS-2015", "Unicode-DFS-2016",
+               "UPL-1.0"},
+    "unencumbered": {"CC0-1.0", "Unlicense", "0BSD", "blessing"},
+    "permissive": set(),
+}
+
+_NORMALIZE = {
+    "apache 2.0": "Apache-2.0", "apache2": "Apache-2.0",
+    "apache-2": "Apache-2.0", "apache license 2.0": "Apache-2.0",
+    "asl 2.0": "Apache-2.0", "apache software license": "Apache-2.0",
+    "mit license": "MIT", "the mit license": "MIT",
+    "bsd": "BSD-3-Clause", "new bsd license": "BSD-3-Clause",
+    "bsd license": "BSD-3-Clause", "bsd-3": "BSD-3-Clause",
+    "gplv2": "GPL-2.0", "gplv2+": "GPL-2.0-or-later",
+    "gplv3": "GPL-3.0", "gplv3+": "GPL-3.0-or-later",
+    "lgplv2": "LGPL-2.0", "lgplv2+": "LGPL-2.1-or-later",
+    "lgplv3": "LGPL-3.0",
+    "public domain": "Unlicense", "zlib/libpng license": "Zlib",
+    "mpl 2.0": "MPL-2.0",
+}
+
+
+def normalize(name: str) -> str:
+    return _NORMALIZE.get(name.strip().lower(), name.strip())
+
+
+def categorize(name: str) -> str:
+    n = normalize(name)
+    for cat, names in _CATEGORIES.items():
+        if n in names:
+            return cat
+    return "unknown"
+
+
+def scan_packages(detail_packages: list, applications: list,
+                  categories: dict | None = None) -> list[T.DetectedLicense]:
+    """Declared-license scan over OS packages + applications.
+
+    `categories` optionally overrides category membership per the
+    --license-* flags (reference pkg/flag/license_flags.go)."""
+    out: list[T.DetectedLicense] = []
+
+    def _emit(pkg: T.Package, file_path: str = ""):
+        for lic in pkg.licenses:
+            name = normalize(lic)
+            cat = _custom_category(name, categories) or categorize(name)
+            out.append(T.DetectedLicense(
+                severity=CATEGORY_SEVERITY.get(cat, "UNKNOWN"),
+                category=cat,
+                pkg_name=pkg.name,
+                file_path=file_path or pkg.file_path,
+                name=name,
+                link=f"https://spdx.org/licenses/{name}.html"
+                if categorize(name) != "unknown" else "",
+            ))
+
+    for pkg in detail_packages:
+        _emit(pkg)
+    for app in applications:
+        for pkg in app.packages:
+            _emit(pkg, app.file_path)
+    return out
+
+
+def _custom_category(name: str, categories: dict | None):
+    if not categories:
+        return None
+    for cat, names in categories.items():
+        if name in names:
+            return cat
+    return None

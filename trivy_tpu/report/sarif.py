@@ -1,0 +1,93 @@
+"""SARIF 2.1.0 writer (reference pkg/report/sarif.go): one run with a
+rule per distinct finding id, a result per finding, locations pointing at
+the scanned target."""
+
+from __future__ import annotations
+
+from .. import types as T
+
+_LEVEL = {"CRITICAL": "error", "HIGH": "error", "MEDIUM": "warning",
+          "LOW": "note", "UNKNOWN": "note"}
+
+
+def to_sarif(report: T.Report) -> dict:
+    rules: dict[str, dict] = {}
+    results = []
+
+    def add(rule_id: str, severity: str, short: str, full: str,
+            message: str, target: str, start_line: int = 1,
+            end_line: int = 1, help_uri: str = ""):
+        if rule_id not in rules:
+            rule = {
+                "id": rule_id,
+                "name": short.replace(" ", ""),
+                "shortDescription": {"text": short},
+                "fullDescription": {"text": full or short},
+                "defaultConfiguration": {
+                    "level": _LEVEL.get(severity, "note")},
+                "properties": {"tags": ["security", severity]},
+            }
+            if help_uri:
+                rule["helpUri"] = help_uri
+            rules[rule_id] = rule
+        results.append({
+            "ruleId": rule_id,
+            "ruleIndex": list(rules).index(rule_id),
+            "level": _LEVEL.get(severity, "note"),
+            "message": {"text": message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": target,
+                        "uriBaseId": "ROOTPATH",
+                    },
+                    "region": {
+                        "startLine": max(start_line, 1),
+                        "startColumn": 1,
+                        "endLine": max(end_line, 1),
+                        "endColumn": 1,
+                    },
+                },
+            }],
+        })
+
+    for res in report.results:
+        for v in res.vulnerabilities:
+            add(v.vulnerability_id, v.severity,
+                v.vulnerability.title or v.vulnerability_id,
+                v.vulnerability.description,
+                f"Package: {v.pkg_name}\nInstalled Version: "
+                f"{v.installed_version}\nVulnerability {v.vulnerability_id}"
+                f"\nSeverity: {v.severity}\nFixed Version: "
+                f"{v.fixed_version or 'none'}",
+                res.target, help_uri=v.primary_url)
+        for s in res.secrets:
+            add(s.rule_id, s.severity, s.title, s.title,
+                f"Artifact: {res.target}\nType: secret\nSecret {s.title}\n"
+                f"Severity: {s.severity}\nMatch: {s.match}",
+                res.target, s.start_line, s.end_line)
+        for m in res.misconfigurations:
+            add(m.id, m.severity, m.title, m.description, m.message,
+                res.target, m.cause_metadata.start_line,
+                m.cause_metadata.end_line, m.primary_url)
+
+    return {
+        "version": "2.1.0",
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "fullName": "trivy-tpu Vulnerability Scanner",
+                    "informationUri": "https://github.com/trivy-tpu",
+                    "name": "trivy-tpu",
+                    "rules": list(rules.values()),
+                },
+            },
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+            "originalUriBaseIds": {
+                "ROOTPATH": {"uri": "file:///"},
+            },
+        }],
+    }

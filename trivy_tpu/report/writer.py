@@ -129,5 +129,9 @@ def write_report(report: T.Report, fmt: str = "json", output=None) -> None:
         out.write(to_json(report) + "\n")
     elif fmt == "table":
         out.write(to_table(report))
+    elif fmt == "sarif":
+        from .sarif import to_sarif
+        json.dump(to_sarif(report), out, indent=2)
+        out.write("\n")
     else:
         raise ValueError(f"unsupported format {fmt!r}")

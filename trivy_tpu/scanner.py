@@ -93,6 +93,16 @@ class LocalScanner:
                     secrets=sec.findings,
                 ))
 
+        if T.Scanner.LICENSE in options.scanners:
+            from .licensing import scan_packages
+            licenses = scan_packages(detail.packages, detail.applications)
+            if licenses:
+                results.append(T.Result(
+                    target="OS Packages" if detail.os.detected else "Licenses",
+                    clazz=T.ResultClass.LICENSE,
+                    licenses=licenses,
+                ))
+
         return results, os_info
 
 

@@ -401,6 +401,54 @@ class DetectedVulnerability(JsonMixin):
         return self.vulnerability.severity or "UNKNOWN"
 
 
+@dataclass
+class DetectedLicense(JsonMixin):
+    """Reference pkg/types/license.go."""
+    severity: str = ""
+    category: str = ""
+    pkg_name: str = ""
+    file_path: str = ""
+    name: str = ""
+    text: str = ""
+    confidence: float = 1.0
+    link: str = ""
+    _json_names = {"pkg_name": "PkgName", "file_path": "FilePath"}
+    _keep_zero = ("severity", "category", "pkg_name", "file_path", "name",
+                  "confidence")
+
+
+@dataclass
+class CauseMetadata(JsonMixin):
+    provider: str = ""
+    service: str = ""
+    start_line: int = 0
+    end_line: int = 0
+    code: "Code" = field(default_factory=lambda: Code())
+
+
+@dataclass
+class DetectedMisconfiguration(JsonMixin):
+    """Reference pkg/types/misconfiguration.go."""
+    type: str = ""
+    id: str = ""
+    avd_id: str = ""
+    title: str = ""
+    description: str = ""
+    message: str = ""
+    namespace: str = ""
+    query: str = ""
+    resolution: str = ""
+    severity: str = ""
+    primary_url: str = ""
+    references: list = field(default_factory=list)
+    status: str = ""
+    layer: Layer = field(default_factory=Layer)
+    cause_metadata: CauseMetadata = field(default_factory=CauseMetadata)
+    _json_names = {"id": "ID", "avd_id": "AVDID", "primary_url": "PrimaryURL"}
+    _keep_zero = ("type", "id", "title", "description", "message",
+                  "namespace", "query", "resolution", "severity", "status")
+
+
 # --- result / report ---
 
 @dataclass

@@ -1,0 +1,99 @@
+"""VEX-based suppression (reference pkg/vex): OpenVEX and CycloneDX VEX
+statements mark findings as not_affected/fixed so they drop from results.
+
+Format sniffing mirrors pkg/vex/vex.go:28-60; matching is by
+vulnerability id + (optionally) product purl."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from . import types as T
+
+SUPPRESS_STATUSES = {"not_affected", "fixed"}
+
+
+@dataclass
+class VexStatement:
+    vuln_id: str
+    status: str
+    justification: str = ""
+    products: tuple = ()  # purls; empty = applies to everything
+
+
+def load_vex_file(path: str) -> list[VexStatement]:
+    with open(path) as f:
+        doc = json.load(f)
+    if "statements" in doc:  # OpenVEX
+        return _openvex(doc)
+    if doc.get("bomFormat") == "CycloneDX":
+        return _cyclonedx_vex(doc)
+    raise ValueError("unrecognized VEX format (want OpenVEX or CycloneDX)")
+
+
+def _openvex(doc: dict) -> list[VexStatement]:
+    out = []
+    for st in doc.get("statements", []):
+        vuln = st.get("vulnerability")
+        if isinstance(vuln, dict):
+            vuln = vuln.get("name", "")
+        products = []
+        for p in st.get("products", []):
+            if isinstance(p, str):
+                products.append(p)
+            elif isinstance(p, dict):
+                pid = p.get("@id") or ""
+                ids = p.get("identifiers") or {}
+                products.append(ids.get("purl") or pid)
+        out.append(VexStatement(
+            vuln_id=vuln or "",
+            status=st.get("status", ""),
+            justification=st.get("justification", ""),
+            products=tuple(x for x in products if x)))
+    return out
+
+
+def _cyclonedx_vex(doc: dict) -> list[VexStatement]:
+    out = []
+    for v in doc.get("vulnerabilities", []):
+        analysis = v.get("analysis") or {}
+        state = analysis.get("state", "")
+        status = {"not_affected": "not_affected", "resolved": "fixed",
+                  "false_positive": "not_affected"}.get(state, state)
+        out.append(VexStatement(
+            vuln_id=v.get("id", ""),
+            status=status,
+            justification=analysis.get("justification", ""),
+            products=tuple(a.get("ref", "") for a in v.get("affects", []))))
+    return out
+
+
+def apply_vex(results: list[T.Result],
+              statements: list[VexStatement]) -> None:
+    """Drop suppressed findings in place (reference pkg/result/filter.go:84
+    runs VEX before other filters)."""
+    by_vuln: dict[str, list[VexStatement]] = {}
+    for st in statements:
+        if st.status in SUPPRESS_STATUSES:
+            by_vuln.setdefault(st.vuln_id, []).append(st)
+    for res in results:
+        kept = []
+        for v in res.vulnerabilities:
+            if not _suppressed(v, by_vuln.get(v.vulnerability_id, [])):
+                kept.append(v)
+        res.vulnerabilities = kept
+
+
+def _suppressed(v: T.DetectedVulnerability,
+                statements: list[VexStatement]) -> bool:
+    for st in statements:
+        if not st.products:
+            return True
+        purl = v.pkg_identifier.purl
+        for product in st.products:
+            if product and purl and product.split("?")[0] == purl.split("?")[0]:
+                return True
+            if product == f"{v.pkg_name}@{v.installed_version}":
+                return True
+    return False
