@@ -1,0 +1,102 @@
+"""Dockerfile misconfiguration checks + end-to-end config scan."""
+
+import glob
+import os
+
+import pytest
+
+from trivy_tpu import types as T
+from trivy_tpu.misconf.dockerfile import parse_dockerfile, scan_dockerfile
+
+FIXGLOB = os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")
+
+BAD_DOCKERFILE = b"""\
+FROM alpine:latest
+RUN apk add curl
+RUN apt-get update
+ADD app.py /app/
+EXPOSE 22 8080
+USER root
+"""
+
+GOOD_DOCKERFILE = b"""\
+FROM alpine:3.17
+RUN apk add --no-cache curl
+COPY app.py /app/
+EXPOSE 8080
+USER app
+HEALTHCHECK CMD wget -q localhost:8080 || exit 1
+"""
+
+
+class TestParser:
+    def test_basic(self):
+        insts = parse_dockerfile(BAD_DOCKERFILE.decode())
+        assert [i.cmd for i in insts] == ["FROM", "RUN", "RUN", "ADD",
+                                         "EXPOSE", "USER"]
+        assert insts[0].start_line == 1
+
+    def test_continuation(self):
+        insts = parse_dockerfile("RUN apk update && \\\n    apk add curl\n")
+        assert len(insts) == 1
+        assert "apk add curl" in insts[0].args
+        assert (insts[0].start_line, insts[0].end_line) == (1, 2)
+
+
+class TestChecks:
+    def test_bad_dockerfile(self):
+        failures, successes = scan_dockerfile("Dockerfile", BAD_DOCKERFILE)
+        ids = sorted({f.id for f in failures})
+        assert ids == ["DS001", "DS002", "DS004", "DS005", "DS017",
+                       "DS025", "DS026"]
+        ds002 = next(f for f in failures if f.id == "DS002")
+        assert ds002.severity == "HIGH"
+        assert ds002.cause_metadata.start_line == 6
+
+    def test_good_dockerfile(self):
+        failures, successes = scan_dockerfile("Dockerfile", GOOD_DOCKERFILE)
+        assert failures == []
+        assert successes == len(__import__(
+            "trivy_tpu.misconf.dockerfile", fromlist=["CHECKS"]).CHECKS)
+
+
+class TestEndToEnd:
+    def test_fs_config_scan(self, tmp_path):
+        from trivy_tpu.db import build_table
+        from trivy_tpu.db.fixtures import load_fixture_files
+        from trivy_tpu.fanal.artifact import FilesystemArtifact
+        from trivy_tpu.fanal.cache import MemoryCache
+        from trivy_tpu.scanner import LocalScanner
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "Dockerfile").write_bytes(BAD_DOCKERFILE)
+        cache = MemoryCache()
+        art = FilesystemArtifact(str(proj), cache, scanners=("misconfig",))
+        ref = art.inspect()
+        advisories, details, _ = load_fixture_files(
+            sorted(glob.glob(FIXGLOB)))
+        scanner = LocalScanner(cache, build_table(advisories, details))
+        results, _ = scanner.scan(
+            ref.name, ref.id, ref.blob_ids,
+            T.ScanOptions(scanners=("misconfig",)))
+        cfg = [r for r in results if r.clazz == "config"]
+        assert len(cfg) == 1
+        assert cfg[0].target == "Dockerfile"
+        assert cfg[0].type == "dockerfile"
+        assert cfg[0].misconf_summary.failures == len(
+            cfg[0].misconfigurations)
+        assert any(m.id == "DS002" for m in cfg[0].misconfigurations)
+
+    def test_cache_roundtrip(self):
+        from trivy_tpu.fanal.cache import blob_from_json
+        failures, successes = scan_dockerfile("Dockerfile", BAD_DOCKERFILE)
+        blob = T.BlobInfo(misconfigurations=[T.Misconfiguration(
+            file_type="dockerfile", file_path="Dockerfile",
+            successes=successes, failures=failures)])
+        decoded = blob_from_json(blob.to_json())
+        mc = decoded.misconfigurations[0]
+        assert mc.file_path == "Dockerfile"
+        assert len(mc.failures) == len(failures)
+        assert mc.failures[0].id == failures[0].id
+        assert mc.failures[0].cause_metadata.start_line == \
+            failures[0].cause_metadata.start_line

@@ -20,6 +20,7 @@ class AnalysisResult:
     repository: Optional[T.Repository] = None
     package_infos: list = field(default_factory=list)
     applications: list = field(default_factory=list)
+    misconfigurations: list = field(default_factory=list)
     secrets: list = field(default_factory=list)
     licenses: list = field(default_factory=list)
 
@@ -35,6 +36,7 @@ class AnalysisResult:
             self.repository = other.repository
         self.package_infos.extend(other.package_infos)
         self.applications.extend(other.applications)
+        self.misconfigurations.extend(other.misconfigurations)
         self.secrets.extend(other.secrets)
         self.licenses.extend(other.licenses)
 
@@ -66,8 +68,8 @@ def all_analyzers() -> dict[str, type]:
 
 
 def _ensure_loaded():
-    from . import (apk, dpkg, lockfiles, os_release,  # noqa: F401
-                   python, redhat, rpm)
+    from . import (apk, dpkg, lockfiles, misconf,  # noqa: F401
+                   os_release, python, redhat, rpm)
 
 
 class AnalyzerGroup:

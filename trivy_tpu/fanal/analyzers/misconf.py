@@ -1,0 +1,33 @@
+"""Config-file analyzers routing IaC files to the misconfiguration
+scanners (reference pkg/fanal/analyzer/config + pkg/misconf bridge)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import types as T
+from ...misconf import FILE_TYPES, detect_file_type
+from . import AnalysisResult, Analyzer, register
+
+
+@register
+class MisconfAnalyzer(Analyzer):
+    name = "misconf"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return detect_file_type(path) != ""
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        ftype = detect_file_type(path)
+        scanner = FILE_TYPES.get(ftype)
+        if scanner is None:
+            return None
+        failures, successes = scanner(path, content)
+        if not failures and not successes:
+            return None
+        result = AnalysisResult()
+        result.misconfigurations = [T.Misconfiguration(
+            file_type=ftype, file_path=path,
+            successes=successes, failures=failures)]
+        return result

@@ -23,15 +23,16 @@ def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
     pkg_files: dict[str, tuple[T.PackageInfo, T.Layer]] = {}
     app_files: dict[str, tuple[T.Application, T.Layer]] = {}
     secret_files: dict[str, tuple[T.Secret, T.Layer]] = {}
+    misconf_files: dict[str, tuple[T.Misconfiguration, T.Layer]] = {}
 
     for blob in blobs:
         layer = T.Layer(digest=blob.digest, diff_id=blob.diff_id,
                         created_by=blob.created_by)
         for wh in blob.whiteout_files:
-            for store in (pkg_files, app_files, secret_files):
+            for store in (pkg_files, app_files, secret_files, misconf_files):
                 _delete_path(store, wh)
         for od in blob.opaque_dirs:
-            for store in (pkg_files, app_files, secret_files):
+            for store in (pkg_files, app_files, secret_files, misconf_files):
                 _delete_path(store, od)
         if blob.os.detected:
             detail.os.merge(blob.os)
@@ -43,6 +44,8 @@ def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
             app_files[app.file_path] = (app, layer)
         for sec in blob.secrets:
             secret_files[sec.file_path] = (sec, layer)
+        for mc in blob.misconfigurations:
+            misconf_files[mc.file_path] = (mc, layer)
 
     origin = _origin_index(blobs)
     for path in sorted(pkg_files):
@@ -60,6 +63,12 @@ def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
         for finding in sec.findings:
             finding.layer = layer
         detail.secrets.append(sec)
+    for path in sorted(misconf_files):
+        mc, layer = misconf_files[path]
+        mc.layer = layer
+        for f in mc.failures:
+            f.layer = layer
+        detail.misconfigurations.append(mc)
 
     detail.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
     return detail

@@ -1,0 +1,22 @@
+"""Misconfiguration scanning (reference pkg/misconf + pkg/iac).
+
+The reference's IaC stack is a 47k-LoC OPA/rego engine (SURVEY.md §2.4)
+scheduled last in the build plan; this package establishes the pipeline —
+file-type detection, per-type scanners, DetectedMisconfiguration results
+with cause locations — with native Python checks for Dockerfiles first.
+Terraform/CloudFormation/K8s scanners slot in behind the same interface.
+"""
+
+from .dockerfile import scan_dockerfile  # noqa: F401
+
+FILE_TYPES = {
+    "dockerfile": scan_dockerfile,
+}
+
+
+def detect_file_type(path: str) -> str:
+    base = path.rsplit("/", 1)[-1].lower()
+    if base == "dockerfile" or base.startswith("dockerfile.") or \
+            base.endswith(".dockerfile"):
+        return "dockerfile"
+    return ""

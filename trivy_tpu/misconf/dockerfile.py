@@ -1,0 +1,236 @@
+"""Dockerfile misconfiguration checks.
+
+Native reimplementation of the trivy-checks dockerfile policies the
+reference evaluates through rego (pkg/iac/scanners/dockerfile); check IDs
+and severities follow the published AVD DS-series so findings line up."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from .. import types as T
+
+
+@dataclass
+class Instruction:
+    cmd: str
+    args: str
+    start_line: int
+    end_line: int
+
+
+def parse_dockerfile(content: str) -> list[Instruction]:
+    out = []
+    cont = None
+    for i, raw in enumerate(content.splitlines(), 1):
+        line = raw.strip()
+        if cont is not None:
+            cont.args += " " + line.rstrip("\\").strip()
+            cont.end_line = i
+            if not line.endswith("\\"):
+                out.append(cont)
+                cont = None
+            continue
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split(None, 1)
+        cmd = parts[0].upper()
+        args = parts[1] if len(parts) > 1 else ""
+        inst = Instruction(cmd=cmd, args=args.rstrip("\\").strip(),
+                           start_line=i, end_line=i)
+        if args.endswith("\\"):
+            cont = inst
+        else:
+            out.append(inst)
+    if cont is not None:
+        out.append(cont)
+    return out
+
+
+@dataclass
+class Check:
+    id: str
+    avd_id: str
+    title: str
+    severity: str
+    description: str
+    resolution: str
+    fn: object = None
+
+
+def _mk(id_, title, severity, description, resolution):
+    def deco(fn):
+        CHECKS.append(Check(id=id_, avd_id=f"AVD-{id_}", title=title,
+                            severity=severity, description=description,
+                            resolution=resolution, fn=fn))
+        return fn
+    return deco
+
+
+CHECKS: list[Check] = []
+
+
+@_mk("DS001", "':latest' tag used", "MEDIUM",
+     "When using a 'FROM' statement you should use a specific tag.",
+     "Add a tag to the image in the 'FROM' statement")
+def _latest_tag(insts):
+    for inst in insts:
+        if inst.cmd != "FROM":
+            continue
+        image = inst.args.split()[0]
+        if image.lower() == "scratch" or "$" in image:
+            continue
+        if "@" in image:
+            continue  # digest-pinned
+        tag = image.rsplit(":", 1)[1] if ":" in image.split("/")[-1] else ""
+        if tag == "latest" or (not tag and ":" not in image.split("/")[-1]):
+            if not tag:
+                continue  # bare name without tag → DS001 flags only :latest
+            yield inst, f"Specify a tag in the 'FROM' statement for image " \
+                        f"'{image.rsplit(':', 1)[0]}'"
+
+
+@_mk("DS002", "Image user should not be 'root'", "HIGH",
+     "Running containers with 'root' user can lead to a container escape "
+     "situation.",
+     "Add 'USER <non root user name>' line to the Dockerfile")
+def _root_user(insts):
+    users = [i for i in insts if i.cmd == "USER"]
+    if not users:
+        last_from = next((i for i in reversed(insts) if i.cmd == "FROM"),
+                         None)
+        if last_from is not None:
+            yield last_from, "Specify at least 1 USER command in " \
+                             "Dockerfile with non-root user as argument"
+        return
+    last = users[-1]
+    if last.args.strip().split(":")[0] in ("root", "0"):
+        yield last, "Last USER command in Dockerfile should not be 'root'"
+
+
+@_mk("DS004", "Port 22 exposed", "MEDIUM",
+     "Exposing port 22 might allow users to SSH into the container.",
+     "Remove 'EXPOSE 22' statement from the Dockerfile")
+def _ssh_port(insts):
+    for inst in insts:
+        if inst.cmd == "EXPOSE":
+            for port in inst.args.split():
+                if port.split("/")[0] == "22":
+                    yield inst, "Port 22 should not be exposed in Dockerfile"
+
+
+@_mk("DS005", "ADD instead of COPY", "LOW",
+     "You should use COPY instead of ADD unless you want to extract a "
+     "tar file.",
+     "Use COPY instead of ADD")
+def _add_instead_of_copy(insts):
+    for inst in insts:
+        if inst.cmd != "ADD":
+            continue
+        src = inst.args.split()[0] if inst.args.split() else ""
+        if re.search(r"\.(tar|tar\.gz|tgz|tar\.bz2|tar\.xz)$", src) or \
+                src.startswith(("http://", "https://")):
+            continue
+        yield inst, f"Consider using 'COPY {inst.args}' command instead"
+
+
+@_mk("DS013", "'RUN cd ...' to change directory", "MEDIUM",
+     "Use WORKDIR instead of proliferating instructions like "
+     "'RUN cd … && do-something'.",
+     "Use WORKDIR to change directory")
+def _run_cd(insts):
+    for inst in insts:
+        if inst.cmd == "RUN" and re.match(r"^cd\s+\S+\s*$", inst.args):
+            yield inst, f"RUN should not be used to change directory: " \
+                        f"'{inst.args}'. Use 'WORKDIR' statement instead."
+
+
+@_mk("DS017", "'RUN <package-manager> update' instruction alone", "HIGH",
+     "The instruction 'RUN <package-manager> update' should always be "
+     "followed by '<package-manager> install' in the same RUN statement.",
+     "Combine '<package-manager> update' and '<package-manager> install' "
+     "instructions")
+def _update_alone(insts):
+    for inst in insts:
+        if inst.cmd != "RUN":
+            continue
+        args = inst.args
+        if re.search(r"\b(apt-get|apt|yum|apk)\s+update\b", args) and \
+                not re.search(r"\b(install|add|upgrade)\b", args):
+            yield inst, "The instruction 'RUN <package-manager> update' " \
+                        "should always be followed by " \
+                        "'<package-manager> install' in the same RUN " \
+                        "statement."
+
+
+@_mk("DS025", "'apk add' without '--no-cache'", "HIGH",
+     "You should use 'apk add' with '--no-cache' to clean package cached "
+     "data and reduce image size.",
+     "Add '--no-cache' to 'apk add' in Dockerfile")
+def _apk_cache(insts):
+    for inst in insts:
+        if inst.cmd == "RUN" and re.search(r"\bapk\s+(\S+\s+)*add\b",
+                                           inst.args) and \
+                "--no-cache" not in inst.args:
+            yield inst, f"'--no-cache' is missed: 'apk add' in " \
+                        f"'{inst.args}'"
+
+
+@_mk("DS026", "No HEALTHCHECK defined", "LOW",
+     "You should add HEALTHCHECK instruction in your docker container "
+     "images to perform the health check on running containers.",
+     "Add HEALTHCHECK instruction in Dockerfile")
+def _healthcheck(insts):
+    if not any(i.cmd == "HEALTHCHECK" for i in insts):
+        first = insts[0] if insts else None
+        if first is not None:
+            yield None, "Add HEALTHCHECK instruction in your Dockerfile"
+
+
+def scan_dockerfile(path: str, content: bytes,
+                    lines: list[str] | None = None
+                    ) -> tuple[list[T.DetectedMisconfiguration], int]:
+    """→ (failures, successes_count)."""
+    text = content.decode(errors="replace")
+    insts = parse_dockerfile(text)
+    if not insts:
+        return [], 0
+    src_lines = text.splitlines()
+    failures = []
+    successes = 0
+    for check in CHECKS:
+        found = list(check.fn(insts))
+        if not found:
+            successes += 1
+            continue
+        for inst, message in found:
+            m = T.DetectedMisconfiguration(
+                type="dockerfile",
+                id=check.id,
+                avd_id=check.avd_id,
+                title=check.title,
+                description=check.description,
+                message=message,
+                namespace=f"builtin.dockerfile.{check.id}",
+                resolution=check.resolution,
+                severity=check.severity,
+                primary_url=f"https://avd.aquasec.com/misconfig/"
+                            f"{check.id.lower()}",
+                status="FAIL",
+            )
+            if inst is not None:
+                m.cause_metadata = T.CauseMetadata(
+                    provider="Dockerfile", service="general",
+                    start_line=inst.start_line, end_line=inst.end_line,
+                    code=T.Code(lines=[
+                        T.CodeLine(number=n + 1, content=src_lines[n],
+                                   is_cause=True, first_cause=(
+                                       n + 1 == inst.start_line),
+                                   last_cause=(n + 1 == inst.end_line),
+                                   highlighted=src_lines[n])
+                        for n in range(inst.start_line - 1,
+                                       min(inst.end_line, len(src_lines)))
+                    ]))
+            failures.append(m)
+    return failures, successes

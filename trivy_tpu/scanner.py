@@ -85,6 +85,21 @@ class LocalScanner:
                             app.packages, key=lambda p: (p.name, p.version))
                     results.append(res)
 
+        if T.Scanner.MISCONF in options.scanners or \
+                "config" in options.scanners:
+            for mc in detail.misconfigurations:
+                if not mc.failures and not mc.successes:
+                    continue
+                results.append(T.Result(
+                    target=mc.file_path,
+                    clazz=T.ResultClass.CONFIG,
+                    type=mc.file_type,
+                    misconf_summary=T.MisconfSummary(
+                        successes=mc.successes, failures=len(mc.failures)),
+                    misconfigurations=sorted(
+                        mc.failures, key=lambda f: (f.id, f.message)),
+                ))
+
         if T.Scanner.SECRET in options.scanners:
             for sec in detail.secrets:
                 results.append(T.Result(

@@ -287,6 +287,17 @@ class Secret(JsonMixin):
 
 
 @dataclass
+class Misconfiguration(JsonMixin):
+    """Per-file misconfiguration record inside a blob
+    (reference pkg/fanal/types/misconf.go)."""
+    file_type: str = ""
+    file_path: str = ""
+    successes: int = 0
+    failures: list = field(default_factory=list)  # [DetectedMisconfiguration]
+    layer: "Layer" = field(default_factory=lambda: Layer())
+
+
+@dataclass
 class BlobInfo(JsonMixin):
     """Per-layer analysis result (reference pkg/fanal/types/artifact.go:311)."""
     schema_version: int = 2
@@ -299,6 +310,7 @@ class BlobInfo(JsonMixin):
     repository: Optional[Repository] = None
     package_infos: list = field(default_factory=list)   # [PackageInfo]
     applications: list = field(default_factory=list)    # [Application]
+    misconfigurations: list = field(default_factory=list)  # [Misconfiguration]
     secrets: list = field(default_factory=list)         # [Secret]
     licenses: list = field(default_factory=list)
     custom_resources: list = field(default_factory=list)
@@ -322,6 +334,7 @@ class ArtifactDetail(JsonMixin):
     repository: Optional[Repository] = None
     packages: list = field(default_factory=list)      # [Package]
     applications: list = field(default_factory=list)  # [Application]
+    misconfigurations: list = field(default_factory=list)  # [Misconfiguration]
     secrets: list = field(default_factory=list)       # [Secret]
     licenses: list = field(default_factory=list)
     custom_resources: list = field(default_factory=list)
