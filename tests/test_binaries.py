@@ -1,0 +1,131 @@
+"""Go-binary, JAR, node-pkg, gemspec analyzer tests."""
+
+import io
+import json
+import zipfile
+
+from trivy_tpu.fanal.analyzers import AnalysisResult, AnalyzerGroup
+from trivy_tpu.fanal.analyzers.binaries import parse_go_buildinfo
+
+
+def analyze(path, content):
+    group = AnalyzerGroup()
+    result = AnalysisResult()
+    group.analyze_file(path, content, result)
+    return result
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def make_go_binary() -> bytes:
+    modinfo = "\n".join([
+        "path\texample.com/app",
+        "mod\texample.com/app\t(devel)\t",
+        "dep\tgolang.org/x/text\tv0.3.7\th1:abc=",
+        "dep\tgithub.com/gin-gonic/gin\tv1.7.7\th1:def=",
+    ])
+    version = "go1.21.5"
+    info = (b"\xff Go buildinf:" + b"\x08" + b"\x02" +
+            b"\x00" * 16 +
+            _varint(len(version)) + version.encode() +
+            _varint(len(modinfo)) + modinfo.encode())
+    return b"\x7fELF" + b"\x00" * 100 + info + b"\x00" * 50
+
+
+class TestGoBinary:
+    def test_parse_buildinfo(self):
+        go_version, deps = parse_go_buildinfo(make_go_binary())
+        assert go_version == "go1.21.5"
+        assert ("golang.org/x/text", "0.3.7") in deps
+        assert ("github.com/gin-gonic/gin", "1.7.7") in deps
+
+    def test_analyzer(self):
+        r = analyze("usr/local/bin/app", make_go_binary())
+        apps = [a for a in r.applications if a.type == "gobinary"]
+        assert len(apps) == 1
+        names = {p.name for p in apps[0].packages}
+        assert "golang.org/x/text" in names
+
+    def test_non_go_elf_skipped(self):
+        r = analyze("usr/bin/tool", b"\x7fELF" + b"\x00" * 200)
+        assert r.applications == []
+
+
+def make_jar(with_pom=True) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("META-INF/MANIFEST.MF", "Manifest-Version: 1.0\n")
+        if with_pom:
+            zf.writestr(
+                "META-INF/maven/org.apache.logging.log4j/log4j-core/"
+                "pom.properties",
+                "groupId=org.apache.logging.log4j\n"
+                "artifactId=log4j-core\nversion=2.14.1\n")
+    return buf.getvalue()
+
+
+class TestJar:
+    def test_pom_properties(self):
+        r = analyze("app/lib/log4j-core-2.14.1.jar", make_jar())
+        pkg = r.applications[0].packages[0]
+        assert pkg.name == "org.apache.logging.log4j:log4j-core"
+        assert pkg.version == "2.14.1"
+
+    def test_filename_fallback(self):
+        r = analyze("lib/commons-io-2.8.0.jar", make_jar(with_pom=False))
+        pkg = r.applications[0].packages[0]
+        assert (pkg.name, pkg.version) == ("commons-io", "2.8.0")
+
+
+class TestNodePkg:
+    def test_package_json(self):
+        doc = {"name": "lodash", "version": "4.17.19", "license": "MIT"}
+        r = analyze("app/node_modules/lodash/package.json",
+                    json.dumps(doc).encode())
+        pkg = r.applications[0].packages[0]
+        assert (pkg.name, pkg.version) == ("lodash", "4.17.19")
+        assert pkg.licenses == ["MIT"]
+
+    def test_non_module_package_json_skipped(self):
+        r = analyze("app/package.json", b'{"name": "x", "version": "1.0"}')
+        assert all(a.type != "node-pkg" for a in r.applications)
+
+
+class TestGemspec:
+    def test_gemspec(self):
+        content = b'''Gem::Specification.new do |s|
+  s.name = "rails".freeze
+  s.version = "7.0.4"
+end
+'''
+        r = analyze(
+            "usr/local/bundle/specifications/rails-7.0.4.gemspec", content)
+        pkg = r.applications[0].packages[0]
+        assert (pkg.name, pkg.version) == ("rails", "7.0.4")
+
+
+class TestAggregation:
+    def test_individual_types_merge(self):
+        from trivy_tpu import types as T
+        from trivy_tpu.fanal.applier import apply_layers
+        blob = T.BlobInfo(applications=[
+            T.Application(type="node-pkg", file_path="a/package.json",
+                          packages=[T.Package(name="a", version="1")]),
+            T.Application(type="node-pkg", file_path="b/package.json",
+                          packages=[T.Package(name="b", version="2")]),
+            T.Application(type="npm", file_path="package-lock.json",
+                          packages=[T.Package(name="c", version="3")]),
+        ])
+        detail = apply_layers([blob])
+        types_ = sorted((a.type, len(a.packages))
+                        for a in detail.applications)
+        assert types_ == [("node-pkg", 2), ("npm", 1)]

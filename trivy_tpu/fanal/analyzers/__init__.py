@@ -68,8 +68,8 @@ def all_analyzers() -> dict[str, type]:
 
 
 def _ensure_loaded():
-    from . import (apk, dpkg, lockfiles, misconf,  # noqa: F401
-                   os_release, python, redhat, rpm)
+    from . import (apk, binaries, dpkg, lockfiles,  # noqa: F401
+                   misconf, os_release, python, redhat, rpm)
 
 
 class AnalyzerGroup:

@@ -71,7 +71,29 @@ def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
         detail.misconfigurations.append(mc)
 
     detail.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
+    _aggregate_individual_apps(detail)
     return detail
+
+
+# "individual package" app types merge into one application per type,
+# reported under a friendly target (reference pkg/scanner/langpkg/scan.go
+# PkgTargets + fanal aggregation, analyzer.go:185-242)
+INDIVIDUAL_TYPES = ("python-pkg", "conda-pkg", "gemspec", "node-pkg",
+                    "jar", "gobinary", "rustbinary")
+
+
+def _aggregate_individual_apps(detail: T.ArtifactDetail) -> None:
+    merged: dict[str, T.Application] = {}
+    keep = []
+    for app in detail.applications:
+        if app.type in INDIVIDUAL_TYPES:
+            agg = merged.setdefault(app.type, T.Application(type=app.type))
+            agg.packages.extend(app.packages)
+        else:
+            keep.append(app)
+    for app in merged.values():
+        app.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
+    detail.applications = keep + [merged[t] for t in sorted(merged)]
 
 
 def _origin_index(blobs) -> dict:

@@ -75,7 +75,8 @@ class LocalScanner:
                     if not vulns and not options.list_all_packages:
                         continue
                     res = T.Result(
-                        target=app.file_path or app.type,
+                        target=app.file_path or
+                        PKG_TARGETS.get(app.type, app.type),
                         clazz=T.ResultClass.LANG_PKGS,
                         type=app.type,
                         vulnerabilities=vulns,
@@ -119,6 +120,15 @@ class LocalScanner:
                 ))
 
         return results, os_info
+
+
+# friendly targets for aggregated individual-package results
+# (reference pkg/scanner/langpkg/scan.go:15-23)
+PKG_TARGETS = {
+    "python-pkg": "Python", "conda-pkg": "Conda", "gemspec": "Ruby",
+    "node-pkg": "Node.js", "jar": "Java", "gobinary": "",
+    "k8s": "Kubernetes",
+}
 
 
 def _vuln_sort_key(v: T.DetectedVulnerability):
