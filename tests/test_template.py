@@ -1,0 +1,220 @@
+"""Go-template subset engine + template/github/cosign-vuln writers."""
+
+import datetime as dt
+import io
+import json
+import os
+
+import pytest
+
+from trivy_tpu import types as T
+from trivy_tpu.report import build_report
+from trivy_tpu.report.gotemplate import Template, TemplateError
+from trivy_tpu.report.github import to_github
+from trivy_tpu.report.predicate import to_cosign_vuln
+from trivy_tpu.report.template import write_template
+
+REF_CONTRIB = "/root/reference/contrib"
+
+
+def render(tpl, data, **funcs):
+    return Template(tpl, funcs=funcs or None).render(data)
+
+
+# ---------------------------------------------------------- language core
+
+def test_text_and_field():
+    assert render("hello {{ .Name }}!", {"Name": "world"}) == "hello world!"
+
+
+def test_nested_fields_and_dot():
+    assert render("{{ .A.B.C }}", {"A": {"B": {"C": 7}}}) == "7"
+    assert render("{{ . }}", "x") == "x"
+
+
+def test_trim_markers():
+    assert render("a  {{- /* c */ -}}  b", {}) == "ab"
+    assert render("x\n{{- .V }}", {"V": 1}) == "x1"
+
+
+def test_if_else_elseif():
+    tpl = "{{ if .A }}a{{ else if .B }}b{{ else }}c{{ end }}"
+    assert render(tpl, {"A": True}) == "a"
+    assert render(tpl, {"A": False, "B": 1}) == "b"
+    assert render(tpl, {}) == "c"
+
+
+def test_range_and_else():
+    assert render("{{ range . }}[{{ . }}]{{ end }}", [1, 2]) == "[1][2]"
+    assert render("{{ range . }}x{{ else }}empty{{ end }}", []) == "empty"
+
+
+def test_range_kv_vars():
+    out = render("{{ range $i, $v := . }}{{ $i }}={{ $v }};{{ end }}",
+                 ["a", "b"])
+    assert out == "0=a;1=b;"
+
+
+def test_variables_declare_assign():
+    tpl = ("{{ $first := true }}{{ range . }}"
+           "{{ if $first }}{{ $first = false }}{{ else }},{{ end }}"
+           "{{ . }}{{ end }}")
+    assert render(tpl, [1, 2, 3]) == "1,2,3"
+
+
+def test_with():
+    assert render("{{ with .A }}<{{ .B }}>{{ end }}",
+                  {"A": {"B": 5}}) == "<5>"
+    assert render("{{ with .Z }}x{{ else }}none{{ end }}", {}) == "none"
+
+
+def test_pipeline_and_parens():
+    assert render('{{ .N | printf "%03d" }}', {"N": 7}) == "007"
+    assert render('{{ (index . 1) }}', ["a", "b"]) == "b"
+    assert render('{{ if not (eq .T "") }}y{{ end }}', {"T": "x"}) == "y"
+
+
+def test_dollar_root():
+    assert render("{{ range .L }}{{ $.Tag }}{{ . }}{{ end }}",
+                  {"Tag": "#", "L": [1, 2]}) == "#1#2"
+
+
+# ------------------------------------------------------------- functions
+
+def test_eq_multi_and_compare():
+    assert render('{{ if eq .S "a" "b" }}y{{ end }}', {"S": "b"}) == "y"
+    assert render("{{ if gt .N 3 }}big{{ end }}", {"N": 5}) == "big"
+
+
+def test_printf_verbs():
+    assert render('{{ printf "%s=%d" "x" 3 }}', {}) == "x=3"
+    assert render('{{ printf "%q" .S }}', {"S": 'a"b'}) == '"a\\"b"'
+    assert render('{{ printf "%v" true }}', {}) == "true"
+
+
+def test_escape_xml_and_string():
+    assert render("{{ escapeXML .S }}", {"S": '<&"'}) == "&lt;&amp;&#34;"
+    assert render("{{ escapeString .S }}", {"S": "<b>"}) == "&lt;b&gt;"
+
+
+def test_end_with_period():
+    assert render("{{ endWithPeriod .S }}", {"S": "hi"}) == "hi."
+    assert render("{{ endWithPeriod .S }}", {"S": "hi."}) == "hi."
+
+
+def test_sprig_misc():
+    assert render('{{ list "a" "b" | join "," }}', {}) == "a,b"
+    assert render("{{ add 1 2 3 }}", {}) == "6"
+    assert render("{{ len .L }}", {"L": [1, 2]}) == "2"
+    assert render('{{ regexFind "[0-9]+" "ab12cd" }}', {}) == "12"
+    assert render('{{ if regexMatch "^a" "abc" }}m{{ end }}', {}) == "m"
+    assert render('{{ "ABC" | lower }}', {}) == "abc"
+    assert render('{{ sha1sum "abc" }}',
+                  {}) == "a9993e364706816aba3e25717850c26c9cd0d89d"
+
+
+def test_date_go_layout():
+    d = dt.datetime(2026, 7, 29, 13, 5, 9, tzinfo=dt.timezone.utc)
+    out = render('{{ now | date "2006-01-02T15:04:05Z07:00" }}', {},
+                 now=lambda: d)
+    assert out == "2026-07-29T13:05:09Z"
+    out2 = render('{{ now | date "2006-01-02 15:04:05 -07:00" }}', {},
+                  now=lambda: d)
+    assert out2 == "2026-07-29 13:05:09 +00:00"
+
+
+def test_env_function(monkeypatch):
+    monkeypatch.setenv("AWS_REGION", "eu-west-1")
+    assert render('{{ env "AWS_REGION" }}', {}) == "eu-west-1"
+
+
+def test_embedded_vulnerability_promotion():
+    v = {"VulnerabilityID": "CVE-1", "Severity": "HIGH"}
+    assert render("{{ .Vulnerability.Severity }}", v) == "HIGH"
+
+
+def test_unknown_function_raises():
+    with pytest.raises(TemplateError):
+        Template("{{ nosuchfn . }}").render({})
+
+
+def test_unclosed_block_raises():
+    with pytest.raises(TemplateError):
+        Template("{{ if .A }}x")
+
+
+# ------------------------------------------------------- report writers
+
+def _sample_report():
+    v = T.DetectedVulnerability(
+        vulnerability_id="CVE-2023-1111", pkg_name="musl",
+        installed_version="1.2.2-r0", fixed_version="1.2.2-r1",
+        primary_url="https://avd.aquasec.com/nvd/cve-2023-1111")
+    v.vulnerability.severity = "CRITICAL"
+    v.vulnerability.title = "musl: oob write"
+    v.vulnerability.description = "Bad <thing> happened"
+    res = T.Result(target="img (alpine 3.19)", clazz="os-pkgs",
+                   type="alpine", vulnerabilities=[v])
+    pkg = T.Package(id="musl@1.2.2-r0", name="musl", version="1.2.2",
+                    release="r0")
+    res.packages = [pkg]
+    return build_report("img", "container_image", [res],
+                        created_at="2026-07-29T00:00:00Z")
+
+
+def test_write_template_inline():
+    rep = _sample_report()
+    buf = io.StringIO()
+    write_template(
+        rep, '{{ range . }}{{ range .Vulnerabilities }}'
+             '{{ .VulnerabilityID }}:{{ .Vulnerability.Severity }}'
+             '{{ end }}{{ end }}', buf)
+    assert buf.getvalue() == "CVE-2023-1111:CRITICAL"
+
+
+def test_write_template_from_file(tmp_path):
+    p = tmp_path / "t.tpl"
+    p.write_text("n={{ len . }}")
+    rep = _sample_report()
+    buf = io.StringIO()
+    write_template(rep, f"@{p}", buf)
+    assert buf.getvalue() == "n=1"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_CONTRIB),
+                    reason="reference checkout not present")
+@pytest.mark.parametrize("name", ["junit.tpl", "gitlab.tpl", "html.tpl",
+                                  "gitlab-codequality.tpl", "asff.tpl"])
+def test_contrib_templates_render(name):
+    rep = _sample_report()
+    buf = io.StringIO()
+    write_template(rep, f"@{REF_CONTRIB}/{name}", buf)
+    out = buf.getvalue()
+    assert out.strip()
+    if name == "junit.tpl":
+        assert '<testcase classname="musl-1.2.2-r0"' in out
+        assert "[CRITICAL] CVE-2023-1111" in out
+    if name in ("gitlab.tpl", "gitlab-codequality.tpl", "asff.tpl"):
+        json.loads(out)  # must be valid JSON
+
+
+def test_github_snapshot():
+    rep = _sample_report()
+    snap = to_github(rep, version="0.1")
+    assert snap["detector"]["name"] == "trivy"
+    m = snap["manifests"]["img (alpine 3.19)"]
+    assert m["name"] == "alpine"
+    entry = m["resolved"]["musl"]
+    assert entry["package_url"].startswith("pkg:apk/alpine/musl@1.2.2-r0")
+    assert entry["relationship"] == "direct"
+    assert entry["scope"] == "runtime"
+
+
+def test_cosign_vuln_predicate():
+    rep = _sample_report()
+    pred = to_cosign_vuln(rep, version="0.1")
+    assert pred["scanner"]["uri"] == "pkg:github/aquasecurity/trivy@0.1"
+    emb = pred["scanner"]["result"]
+    assert emb["Results"][0]["Vulnerabilities"][0]["VulnerabilityID"] \
+        == "CVE-2023-1111"
+    assert pred["metadata"]["scanStartedOn"] == "2026-07-29T00:00:00Z"

@@ -27,7 +27,10 @@ def _add_scan_flags(p: argparse.ArgumentParser):
                    help="comma-separated: vuln,secret")
     p.add_argument("--format", "-f", default="json",
                    choices=["json", "table", "sarif", "cyclonedx",
-                            "spdx-json"])
+                            "spdx-json", "template", "github",
+                            "cosign-vuln"])
+    p.add_argument("--template", "-t", default="",
+                   help="output template ('...' inline or @path)")
     p.add_argument("--output", "-o", default="")
     p.add_argument("--severity", "-s", default=",".join(T.SEVERITIES))
     p.add_argument("--ignore-unfixed", action="store_true")
@@ -77,7 +80,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("convert", help="re-render a saved JSON report")
     p.add_argument("report")
     p.add_argument("--format", "-f", default="table",
-                   choices=["json", "table"])
+                   choices=["json", "table", "sarif", "template",
+                            "github", "cosign-vuln"])
+    p.add_argument("--template", "-t", default="")
     p.add_argument("--output", "-o", default="")
 
     p = sub.add_parser("server", help="run the scan server")
@@ -134,11 +139,9 @@ def _scan_common(args, ref, cache, artifact_type: str) -> int:
         created_at=dt.datetime.now(dt.timezone.utc).isoformat())
     out = open(args.output, "w") if args.output else sys.stdout
     try:
-        if args.format in ("cyclonedx", "spdx-json"):
-            from .sbom import write_sbom
-            write_sbom(report, args.format, out)
-        else:
-            write_report(report, args.format, out)
+        write_report(report, args.format, out,
+                     template=getattr(args, "template", ""),
+                     app_version=__version__)
     finally:
         if args.output:
             out.close()
@@ -197,7 +200,8 @@ def cmd_convert(args) -> int:
     from .report.writer import render_json_report
     out = open(args.output, "w") if args.output else sys.stdout
     try:
-        render_json_report(args.report, args.format, out)
+        render_json_report(args.report, args.format, out,
+                           template=getattr(args, "template", ""))
     finally:
         if args.output:
             out.close()

@@ -95,7 +95,7 @@ class GoBinaryAnalyzer(Analyzer):
 
 _JAR_NAME = re.compile(r"^(?P<name>[A-Za-z0-9._-]+?)-"
                        r"(?P<version>\d[A-Za-z0-9._-]*?)"
-                       r"(?:-(?:sources|javadoc|tests))?\.(jar|war|ear)$")
+                       r"(?:-(?:sources|javadoc|tests))?\.(jar|war|ear|par)$")
 
 
 @register
@@ -162,7 +162,8 @@ class NodePkgAnalyzer(Analyzer):
         except json.JSONDecodeError:
             return None
         name, version = doc.get("name"), doc.get("version")
-        if not name or not version or not isinstance(name, str):
+        if (not name or not version or not isinstance(name, str)
+                or not isinstance(version, str)):
             return None
         lic = doc.get("license")
         if isinstance(lic, dict):
@@ -177,8 +178,7 @@ class NodePkgAnalyzer(Analyzer):
 
 _GEMSPEC_ATTR = re.compile(
     r"\.\s*(?P<key>name|version)\s*=\s*"
-    r"(?:\"(?P<dq>[^\"]+)\"|'(?P<sq>[^']+)'|"
-    r"\"(?P<fdq>[^\"]+)\"\.freeze|'(?P<fsq>[^']+)'\.freeze)")
+    r"(?:\"(?P<dq>[^\"]+)\"|'(?P<sq>[^']+)')")
 
 
 @register
@@ -196,9 +196,8 @@ class GemspecAnalyzer(Analyzer):
             m = _GEMSPEC_ATTR.search(line)
             if not m:
                 continue
-            val = m.group("dq") or m.group("sq") or m.group("fdq") or \
-                m.group("fsq") or ""
-            val = val.removesuffix(".freeze")
+            val = (m.group("dq") or m.group("sq") or "").removesuffix(
+                ".freeze")
             if m.group("key") == "name" and not name:
                 name = val
             elif m.group("key") == "version" and not version:

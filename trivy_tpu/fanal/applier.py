@@ -79,7 +79,7 @@ def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
 # reported under a friendly target (reference pkg/scanner/langpkg/scan.go
 # PkgTargets + fanal aggregation, analyzer.go:185-242)
 INDIVIDUAL_TYPES = ("python-pkg", "conda-pkg", "gemspec", "node-pkg",
-                    "jar", "gobinary", "rustbinary")
+                    "jar")  # ftypes.AggregatingTypes (const.go:84-90)
 
 
 def _aggregate_individual_apps(detail: T.ArtifactDetail) -> None:

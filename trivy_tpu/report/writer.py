@@ -117,13 +117,14 @@ def report_from_json(j: dict) -> T.Report:
     )
 
 
-def render_json_report(path: str, fmt: str, out) -> None:
+def render_json_report(path: str, fmt: str, out, template: str = "") -> None:
     with open(path) as f:
         report = report_from_json(json.load(f))
-    write_report(report, fmt, out)
+    write_report(report, fmt, out, template=template)
 
 
-def write_report(report: T.Report, fmt: str = "json", output=None) -> None:
+def write_report(report: T.Report, fmt: str = "json", output=None,
+                 template: str = "", app_version: str = "dev") -> None:
     out = output or sys.stdout
     if fmt == "json":
         out.write(to_json(report) + "\n")
@@ -133,5 +134,19 @@ def write_report(report: T.Report, fmt: str = "json", output=None) -> None:
         from .sarif import to_sarif
         json.dump(to_sarif(report), out, indent=2)
         out.write("\n")
+    elif fmt == "template":
+        from .template import write_template
+        if not template:
+            raise ValueError("--format template requires --template")
+        write_template(report, template, out, app_version=app_version)
+    elif fmt == "github":
+        from .github import write_github
+        write_github(report, out, version=app_version)
+    elif fmt == "cosign-vuln":
+        from .predicate import write_cosign_vuln
+        write_cosign_vuln(report, out, version=app_version)
+    elif fmt in ("cyclonedx", "spdx-json", "spdx"):
+        from ..sbom.io import write_sbom
+        write_sbom(report, fmt, out)
     else:
         raise ValueError(f"unsupported format {fmt!r}")

@@ -126,8 +126,7 @@ class LocalScanner:
 # (reference pkg/scanner/langpkg/scan.go:15-23)
 PKG_TARGETS = {
     "python-pkg": "Python", "conda-pkg": "Conda", "gemspec": "Ruby",
-    "node-pkg": "Node.js", "jar": "Java", "gobinary": "",
-    "k8s": "Kubernetes",
+    "node-pkg": "Node.js", "jar": "Java", "k8s": "Kubernetes",
 }
 
 
