@@ -129,3 +129,50 @@ class TestAggregation:
         types_ = sorted((a.type, len(a.packages))
                         for a in detail.applications)
         assert types_ == [("node-pkg", 2), ("npm", 1)]
+
+
+# ---------------------------------------------------------- post-handlers
+
+def test_sysfile_filter_drops_os_owned_packages():
+    from trivy_tpu.fanal.analyzers import AnalysisResult
+    from trivy_tpu.fanal.handlers import post_handle
+    from trivy_tpu import types as T
+    result = AnalysisResult(system_installed_files=[
+        "/usr/lib/python3/dist-packages/six-1.16.0.egg-info/PKG-INFO",
+    ])
+    owned = T.Application(
+        type="python-pkg",
+        file_path="usr/lib/python3/dist-packages/six-1.16.0.egg-info/PKG-INFO",
+        packages=[T.Package(name="six", version="1.16.0")])
+    kept = T.Application(
+        type="python-pkg",
+        file_path="opt/app/site-packages/flask-2.0.dist-info/METADATA",
+        packages=[T.Package(name="flask", version="2.0")])
+    blob = T.BlobInfo(applications=[owned, kept])
+    post_handle(result, blob)
+    assert [a.file_path for a in blob.applications] == [kept.file_path]
+
+
+def test_sysfile_filter_prunes_member_packages_only():
+    from trivy_tpu.fanal.analyzers import AnalysisResult
+    from trivy_tpu.fanal.handlers import post_handle
+    from trivy_tpu import types as T
+    result = AnalysisResult(
+        system_installed_files=["/usr/share/a/pkg.json"])
+    app = T.Application(type="node-pkg", file_path="", packages=[
+        T.Package(name="a", version="1", file_path="usr/share/a/pkg.json"),
+        T.Package(name="b", version="2", file_path="opt/b/pkg.json"),
+    ])
+    blob = T.BlobInfo(applications=[app])
+    post_handle(result, blob)
+    assert [p.name for p in blob.applications[0].packages] == ["b"]
+
+
+def test_dpkg_info_list_feeds_sysfiles():
+    from trivy_tpu.fanal.analyzers.dpkg import DpkgAnalyzer
+    a = DpkgAnalyzer()
+    assert a.required("var/lib/dpkg/info/libssl3.list")
+    res = a.analyze("var/lib/dpkg/info/libssl3.list",
+                    b"/.\n/usr/lib/libssl.so.3\n/usr/share/doc/libssl3\n")
+    assert res.system_installed_files == [
+        "/usr/lib/libssl.so.3", "/usr/share/doc/libssl3"]

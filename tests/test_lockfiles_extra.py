@@ -1,0 +1,285 @@
+"""Second-wave language analyzers: pom/gradle/.NET/conda/conan/hex/
+swift/cocoapods/pub/julia/rust-binary."""
+
+import json
+import struct
+import zlib
+
+from trivy_tpu.fanal.analyzers.lockfiles_extra import (
+    CocoaPodsAnalyzer, CondaMetaAnalyzer, ConanLockAnalyzer,
+    DotNetDepsAnalyzer, GradleLockAnalyzer, JuliaManifestAnalyzer,
+    MixLockAnalyzer, NuGetLockAnalyzer, PackagesPropsAnalyzer,
+    PomAnalyzer, PubAnalyzer, RustBinaryAnalyzer, SwiftAnalyzer,
+    parse_rust_audit)
+
+
+def apps(analyzer, path, content):
+    res = analyzer.analyze(path, content)
+    return res.applications if res else []
+
+
+def names(app):
+    return [(p.name, p.version) for p in app.packages]
+
+
+def test_pom_properties_and_scopes():
+    pom = b"""<?xml version="1.0"?>
+    <project xmlns="http://maven.apache.org/POM/4.0.0">
+      <groupId>com.example</groupId>
+      <artifactId>app</artifactId>
+      <version>1.0.0</version>
+      <properties><guava.ver>31.1-jre</guava.ver></properties>
+      <dependencies>
+        <dependency>
+          <groupId>com.google.guava</groupId>
+          <artifactId>guava</artifactId>
+          <version>${guava.ver}</version>
+        </dependency>
+        <dependency>
+          <groupId>junit</groupId><artifactId>junit</artifactId>
+          <version>4.13</version><scope>test</scope>
+        </dependency>
+        <dependency>
+          <groupId>org.x</groupId><artifactId>unresolved</artifactId>
+          <version>${missing.prop}</version>
+        </dependency>
+      </dependencies>
+    </project>"""
+    a = PomAnalyzer()
+    assert a.required("app/pom.xml")
+    (app,) = apps(a, "app/pom.xml", pom)
+    assert app.type == "pom"
+    assert names(app) == [("com.example:app", "1.0.0"),
+                          ("com.google.guava:guava", "31.1-jre")]
+
+
+def test_pom_parent_version_inheritance():
+    pom = b"""<project>
+      <parent><groupId>org.p</groupId><artifactId>parent</artifactId>
+        <version>2.5</version></parent>
+      <artifactId>child</artifactId>
+      <dependencies>
+        <dependency><groupId>org.p</groupId><artifactId>sib</artifactId>
+          <version>${project.version}</version></dependency>
+      </dependencies>
+    </project>"""
+    (app,) = apps(PomAnalyzer(), "pom.xml", pom)
+    assert ("org.p:sib", "2.5") in names(app)
+    assert ("org.p:child", "2.5") in names(app)
+
+
+def test_gradle_lockfile():
+    content = (b"# comment\n"
+               b"org.springframework:spring-core:5.3.21=classpath\n"
+               b"empty=\n")
+    a = GradleLockAnalyzer()
+    assert a.required("proj/gradle.lockfile")
+    (app,) = apps(a, "proj/gradle.lockfile", content)
+    assert app.type == "gradle"
+    assert names(app) == [("org.springframework:spring-core", "5.3.21")]
+    assert app.packages[0].indirect
+
+
+def test_nuget_lock_and_config():
+    lock = json.dumps({"version": 1, "dependencies": {
+        "net6.0": {
+            "Newtonsoft.Json": {"type": "Direct", "resolved": "13.0.1",
+                                "dependencies": {"X": "1.0"}},
+            "X": {"type": "Transitive", "resolved": "1.0.0"},
+            "MyProj": {"type": "Project"},
+        }}}).encode()
+    a = NuGetLockAnalyzer()
+    (app,) = apps(a, "obj/packages.lock.json", lock)
+    got = dict(names(app))
+    assert got == {"Newtonsoft.Json": "13.0.1", "X": "1.0.0"}
+    direct = [p for p in app.packages if p.name == "Newtonsoft.Json"][0]
+    assert not direct.indirect
+
+    cfg = (b'<?xml version="1.0"?><packages>'
+           b'<package id="A" version="2.1" />'
+           b'<package id="Dev" version="1.0" developmentDependency="true"/>'
+           b'</packages>')
+    (app2,) = apps(a, "packages.config", cfg)
+    assert names(app2) == [("A", "2.1")]
+
+
+def test_dotnet_deps():
+    deps = json.dumps({"libraries": {
+        "App/1.0.0": {"type": "project"},
+        "Serilog/2.10.0": {"type": "package"},
+    }}).encode()
+    (app,) = apps(DotNetDepsAnalyzer(), "app/App.deps.json", deps)
+    assert app.type == "dotnet-core"
+    assert names(app) == [("Serilog", "2.10.0")]
+
+
+def test_packages_props():
+    props = (b"<Project><ItemGroup>"
+             b'<PackageVersion Include="PkgA" Version="3.2.1" />'
+             b'<PackageVersion Include="Var" Version="$(VersionProp)" />'
+             b'<PackageReference Update="PkgB" Version="1.0" />'
+             b"</ItemGroup></Project>")
+    a = PackagesPropsAnalyzer()
+    assert a.required("src/Directory.Packages.props")
+    (app,) = apps(a, "src/Directory.Packages.props", props)
+    assert dict(names(app)) == {"PkgA": "3.2.1", "PkgB": "1.0"}
+
+
+def test_conda_meta():
+    doc = json.dumps({"name": "numpy", "version": "1.24.0",
+                      "license": "BSD-3-Clause"}).encode()
+    a = CondaMetaAnalyzer()
+    assert a.required("opt/conda/conda-meta/numpy-1.24.0-py39.json")
+    (app,) = apps(a, "opt/conda/conda-meta/numpy-1.24.0-py39.json", doc)
+    assert app.type == "conda-pkg"
+    assert names(app) == [("numpy", "1.24.0")]
+    assert app.packages[0].licenses == ["BSD-3-Clause"]
+
+
+def test_conan_lock_v1_and_v2():
+    v1 = json.dumps({"graph_lock": {"nodes": {
+        "0": {"ref": "root/0.1", "requires": ["1"]},
+        "1": {"ref": "zlib/1.2.13#rev"},
+        "2": {"ref": "bzip2/1.0.8"},
+    }}}).encode()
+    (app,) = apps(ConanLockAnalyzer(), "conan.lock", v1)
+    got = {p.name: p.indirect for p in app.packages}
+    assert got == {"zlib": False, "bzip2": True}
+
+    v2 = json.dumps({"version": "0.5",
+                     "requires": ["openssl/3.1.0#abc%123"]}).encode()
+    (app2,) = apps(ConanLockAnalyzer(), "conan.lock", v2)
+    assert names(app2) == [("openssl", "3.1.0")]
+
+
+def test_mix_lock():
+    content = b'''%{
+  "phoenix": {:hex, :phoenix, "1.7.2", "cafe", [:mix], [], "hexpm", "sum"},
+  "gitdep": {:git, "https://github.com/x/y.git", "abcdef", []},
+}
+'''
+    (app,) = apps(MixLockAnalyzer(), "mix.lock", content)
+    assert app.type == "hex"
+    assert names(app) == [("phoenix", "1.7.2")]
+
+
+def test_swift_v1_v2():
+    v1 = json.dumps({"version": 1, "object": {"pins": [
+        {"package": "NIO",
+         "repositoryURL": "https://github.com/apple/swift-nio.git",
+         "state": {"version": "2.41.0"}},
+    ]}}).encode()
+    (app,) = apps(SwiftAnalyzer(), "Package.resolved", v1)
+    assert names(app) == [("github.com/apple/swift-nio", "2.41.0")]
+
+    v2 = json.dumps({"version": 2, "pins": [
+        {"identity": "vapor",
+         "location": "https://github.com/vapor/vapor.git",
+         "state": {"branch": "main"}},
+    ]}).encode()
+    (app2,) = apps(SwiftAnalyzer(), "Package.resolved", v2)
+    assert names(app2) == [("github.com/vapor/vapor", "main")]
+
+
+def test_cocoapods():
+    content = b"""PODS:
+  - Alamofire (5.6.2)
+  - Moya/Core (15.0.0):
+    - Alamofire (~> 5.6)
+DEPENDENCIES:
+  - Moya (~> 15.0)
+"""
+    (app,) = apps(CocoaPodsAnalyzer(), "Podfile.lock", content)
+    got = dict(names(app))
+    assert got == {"Alamofire": "5.6.2", "Moya/Core": "15.0.0"}
+    moya = [p for p in app.packages if p.name == "Moya/Core"][0]
+    assert moya.depends_on == ["Alamofire@5.6.2"]
+
+
+def test_pubspec_lock():
+    content = b"""packages:
+  http:
+    dependency: "direct main"
+    version: "0.13.5"
+  path:
+    dependency: transitive
+    version: "1.8.2"
+"""
+    (app,) = apps(PubAnalyzer(), "pubspec.lock", content)
+    got = {p.name: p.indirect for p in app.packages}
+    assert got == {"http": False, "path": True}
+
+
+def test_julia_manifest():
+    content = b"""julia_version = "1.9.0"
+manifest_format = "2.0"
+
+[[deps.JSON]]
+uuid = "682c06a0-de6a-54ab-a142-c8b1cf79cde6"
+version = "0.21.4"
+
+[[deps.Unicode]]
+uuid = "4ec0a83e-493e-50e2-b9ac-8f72acf5a8f5"
+"""
+    (app,) = apps(JuliaManifestAnalyzer(), "Manifest.toml", content)
+    got = dict(names(app))
+    assert got == {"JSON": "0.21.4", "Unicode": "1.9.0"}
+    json_pkg = [p for p in app.packages if p.name == "JSON"][0]
+    assert json_pkg.id == "682c06a0-de6a-54ab-a142-c8b1cf79cde6@0.21.4"
+
+
+def _tiny_elf_with_depv0(payload: bytes) -> bytes:
+    """ELF64 with 2 sections: shstrtab + .dep-v0."""
+    names = b"\x00.shstrtab\x00.dep-v0\x00"
+    # layout: ehdr(64) + names + payload + shdrs
+    names_off = 64
+    payload_off = names_off + len(names)
+    shoff = payload_off + len(payload)
+    ehdr = bytearray(64)
+    ehdr[:4] = b"\x7fELF"
+    ehdr[4] = 2  # 64-bit
+    ehdr[5] = 1  # little-endian
+    struct.pack_into("<Q", ehdr, 0x28, shoff)
+    struct.pack_into("<HHH", ehdr, 0x3A, 64, 3, 1)  # entsize, num, strndx
+    def shdr(name, off, size):
+        b = bytearray(64)
+        struct.pack_into("<IIQQQQ", b, 0, name, 0, 0, 0, off, size)
+        return bytes(b)
+    null = shdr(0, 0, 0)
+    strtab = shdr(1, names_off, len(names))
+    depv0 = shdr(11, payload_off, len(payload))
+    return bytes(ehdr) + names + payload + null + strtab + depv0
+
+
+def test_rust_binary_audit():
+    audit = {"packages": [
+        {"name": "myapp", "version": "0.1.0", "source": "local",
+         "kind": "runtime"},
+        {"name": "serde", "version": "1.0.160", "source": "crates.io",
+         "kind": "runtime"},
+        {"name": "cc", "version": "1.0.0", "source": "crates.io",
+         "kind": "build"},
+    ]}
+    elf = _tiny_elf_with_depv0(zlib.compress(json.dumps(audit).encode()))
+    assert parse_rust_audit(elf) == [("myapp", "0.1.0", True),
+                                     ("serde", "1.0.160", False)]
+    (app,) = apps(RustBinaryAnalyzer(), "usr/local/bin/myapp", elf)
+    assert app.type == "rustbinary"
+    assert names(app) == [("serde", "1.0.160")]
+
+
+def test_sbom_analyzer_cyclonedx():
+    from trivy_tpu.fanal.analyzers.sbom import SbomAnalyzer
+    bom = json.dumps({
+        "bomFormat": "CycloneDX", "specVersion": "1.4",
+        "components": [
+            {"type": "library", "name": "lodash", "version": "4.17.21",
+             "purl": "pkg:npm/lodash@4.17.21"},
+        ],
+    }).encode()
+    a = SbomAnalyzer()
+    assert a.required("opt/app/bom.cdx.json")
+    res = a.analyze("opt/app/bom.cdx.json", bom)
+    assert res is not None
+    all_pkgs = [p.name for app in res.applications for p in app.packages]
+    assert "lodash" in all_pkgs

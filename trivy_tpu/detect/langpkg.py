@@ -17,18 +17,21 @@ APP_ECOSYSTEM = {
     "bundler": "rubygems", "gemspec": "rubygems",
     "rustbinary": "cargo", "cargo": "cargo",
     "composer": "composer", "composer-vendor": "composer",
-    "jar": "maven", "pom": "maven", "gradle-lockfile": "maven", "sbt-lockfile": "maven",
+    "jar": "maven", "pom": "maven", "gradle": "maven",
+    "sbt-lockfile": "maven",
     "npm": "npm", "node-pkg": "npm", "yarn": "npm", "pnpm": "npm",
+    "javascript": "npm",
     "nuget": "nuget", "dotnet-core": "nuget", "packages-props": "nuget",
-    "conda-pkg": "conda",
     "python-pkg": "pip", "pip": "pip", "pipenv": "pip", "poetry": "pip",
     "gobinary": "go", "gomod": "go",
     "conan": "conan",
-    "mix-lock": "hex",
-    "swift": "swift", "cocoa-pods": "cocoapods",
+    "hex": "erlang",
+    "swift": "swift", "cocoapods": "cocoapods",
     "pub": "pub",
     "julia": "julia",
     "k8s": "k8s",
+    # conda-pkg intentionally absent: SBOM-only, no vuln scanning
+    # (driver.go:77-79)
 }
 
 # Application types whose results keep per-package file paths

@@ -23,6 +23,10 @@ class AnalysisResult:
     misconfigurations: list = field(default_factory=list)
     secrets: list = field(default_factory=list)
     licenses: list = field(default_factory=list)
+    # files owned by the OS package manager; consumed by the system-file
+    # filter post-handler (reference analyzer.AnalysisResult
+    # SystemInstalledFiles)
+    system_installed_files: list = field(default_factory=list)
 
     def merge(self, other: "AnalysisResult"):
         if other is None:
@@ -39,6 +43,7 @@ class AnalysisResult:
         self.misconfigurations.extend(other.misconfigurations)
         self.secrets.extend(other.secrets)
         self.licenses.extend(other.licenses)
+        self.system_installed_files.extend(other.system_installed_files)
 
 
 class Analyzer:
@@ -69,7 +74,8 @@ def all_analyzers() -> dict[str, type]:
 
 def _ensure_loaded():
     from . import (apk, binaries, dpkg, lockfiles,  # noqa: F401
-                   misconf, os_release, python, redhat, rpm)
+                   lockfiles_extra, misconf, os_release, python,
+                   redhat, rpm, sbom)
 
 
 class AnalyzerGroup:

@@ -59,8 +59,10 @@ class ApkAnalyzer(Analyzer):
         self._flush(pkg, pkgs)
         if not pkgs:
             return None
-        return AnalysisResult(package_infos=[
-            T.PackageInfo(file_path=path, packages=pkgs)])
+        sysfiles = [f for p in pkgs for f in p.installed_files]
+        return AnalysisResult(
+            package_infos=[T.PackageInfo(file_path=path, packages=pkgs)],
+            system_installed_files=sysfiles)
 
     @staticmethod
     def _flush(pkg: T.Package, pkgs: list):

@@ -17,6 +17,7 @@ from . import AnalysisResult, Analyzer, register
 
 STATUS_FILE = "var/lib/dpkg/status"
 STATUS_DIR = "var/lib/dpkg/status.d/"
+INFO_DIR = "var/lib/dpkg/info/"
 
 _SRC_RE = re.compile(r"^(?P<name>[^\s(]+)(?:\s+\((?P<version>.+)\))?$")
 
@@ -29,9 +30,18 @@ class DpkgAnalyzer(Analyzer):
     def required(self, path: str, size: int = -1) -> bool:
         if path == STATUS_FILE:
             return True
+        if path.startswith(INFO_DIR) and path.endswith(".list"):
+            return True
         return path.startswith(STATUS_DIR) and not path.endswith(".md5sums")
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        if path.startswith(INFO_DIR) and path.endswith(".list"):
+            # package file list (dpkg.go parseDpkgInfoList): every line
+            # except the "/." root entry is a file owned by dpkg
+            files = [ln for ln in content.decode(errors="replace")
+                     .splitlines() if ln and ln != "/."]
+            return AnalysisResult(system_installed_files=files) \
+                if files else None
         pkgs = []
         for stanza in re.split(r"\n\s*\n",
                                content.decode(errors="replace")):

@@ -45,6 +45,9 @@ TAG_VENDOR = 1011
 TAG_ARCH = 1022
 TAG_SOURCERPM = 1044
 TAG_MODULARITYLABEL = 5096
+TAG_DIRINDEXES = 1116
+TAG_BASENAMES = 1117
+TAG_DIRNAMES = 1118
 
 _T_CHAR, _T_INT8, _T_INT16, _T_INT32, _T_INT64 = 1, 2, 3, 4, 5
 _T_STRING, _T_BIN, _T_STRING_ARRAY, _T_I18NSTRING = 6, 7, 8, 9
@@ -141,7 +144,26 @@ def _header_to_pkg(h: dict) -> Optional[T.Package]:
     lic = h.get(TAG_LICENSE, "")
     if lic:
         pkg.licenses = [lic]
+    pkg.installed_files = _header_files(h)
     return pkg
+
+
+def _header_files(h: dict) -> list:
+    """Reassemble installed file paths from the dirnames/basenames/
+    dirindexes triple (rpm.go:188-200 via go-rpmdb InstalledFiles)."""
+    basenames = h.get(TAG_BASENAMES) or []
+    dirnames = h.get(TAG_DIRNAMES) or []
+    dirindexes = h.get(TAG_DIRINDEXES)
+    if isinstance(dirindexes, int):
+        dirindexes = [dirindexes]
+    dirindexes = dirindexes or []
+    if not (basenames and dirnames) or len(dirindexes) != len(basenames):
+        return []
+    out = []
+    for base, di in zip(basenames, dirindexes):
+        if 0 <= di < len(dirnames):
+            out.append(dirnames[di] + base)
+    return out
 
 
 @register
@@ -176,8 +198,10 @@ class RpmDBAnalyzer(Analyzer):
         if not pkgs:
             return None
         pkgs.sort(key=lambda p: p.name)
-        return AnalysisResult(package_infos=[
-            T.PackageInfo(file_path=path, packages=pkgs)])
+        sysfiles = [f for p in pkgs for f in p.installed_files]
+        return AnalysisResult(
+            package_infos=[T.PackageInfo(file_path=path, packages=pkgs)],
+            system_installed_files=sysfiles)
 
 
 @register

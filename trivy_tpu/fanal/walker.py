@@ -113,7 +113,7 @@ def walk_fs(root: str, group: AnalyzerGroup,
 def blob_info(scan: BlobScan, diff_id: str = "",
               created_by: str = "") -> T.BlobInfo:
     r = scan.result
-    return T.BlobInfo(
+    bi = T.BlobInfo(
         diff_id=diff_id,
         created_by=created_by,
         opaque_dirs=sorted(scan.opaque_dirs),
@@ -127,3 +127,6 @@ def blob_info(scan: BlobScan, diff_id: str = "",
         secrets=r.secrets,
         licenses=r.licenses,
     )
+    from .handlers import post_handle
+    post_handle(r, bi)
+    return bi

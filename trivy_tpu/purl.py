@@ -22,10 +22,11 @@ _TYPE_MAP = {
     "gomod": "golang", "gobinary": "golang",
     "cargo": "cargo", "rustbinary": "cargo",
     "composer": "composer", "bundler": "gem", "gemspec": "gem",
-    "jar": "maven", "pom": "maven", "gradle-lockfile": "maven",
-    "nuget": "nuget", "dotnet-core": "nuget",
-    "conan": "conan", "swift": "swift", "cocoa-pods": "cocoapods",
-    "pub": "pub", "mix-lock": "hex", "conda-pkg": "conda",
+    "jar": "maven", "pom": "maven", "gradle": "maven",
+    "nuget": "nuget", "dotnet-core": "nuget", "packages-props": "nuget",
+    "conan": "conan", "swift": "swift", "cocoapods": "cocoapods",
+    "pub": "pub", "hex": "hex", "conda-pkg": "conda",
+    "julia": "julia",
 }
 
 

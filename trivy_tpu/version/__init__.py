@@ -45,8 +45,11 @@ ECOSYSTEM_SCHEME = {
     "cargo": "semver", "rust-binary": "semver",
     "composer": "semver",
     "nuget": "semver", "dotnet-core": "semver",
-    "conan": "semver", "swift": "semver", "cocoapods": "semver",
+    "conan": "semver", "swift": "semver",
+    # CocoaPods uses RubyGems version specifiers (driver.go:69-73)
+    "cocoapods": "gem",
     "pub": "semver", "hex": "semver", "mix": "semver",
+    "erlang": "semver",
     "pip": "pep440", "pipenv": "pep440", "poetry": "pep440",
     "python-pkg": "pep440", "conda-pkg": "pep440", "conda": "pep440",
     "rubygems": "gem", "bundler": "gem", "gemspec": "gem",
