@@ -1,0 +1,160 @@
+"""Red Hat content-set-scoped detection, SUSE enterprise, Ubuntu ESM."""
+
+import datetime as dt
+import glob
+import os
+
+import pytest
+
+from trivy_tpu import types as T
+from trivy_tpu.db import build_table
+from trivy_tpu.db.fixtures import load_fixture_files
+from trivy_tpu.detect.engine import BatchDetector
+from trivy_tpu.detect.ospkg import OspkgScanner, _ubuntu_stream
+
+FIXTURES = sorted(glob.glob(
+    os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")))
+
+
+@pytest.fixture(scope="module")
+def scanner():
+    advisories, details, sources = load_fixture_files(FIXTURES)
+    table = build_table(
+        advisories, details,
+        aux={"Red Hat CPE": sources["Red Hat CPE"]})
+    return OspkgScanner(BatchDetector(table))
+
+
+def _rh_pkg(**kw):
+    kw.setdefault("arch", "x86_64")
+    kw.setdefault("release", "26.el7_9")
+    p = T.Package(**kw)
+    p.id = f"{p.name}@{p.version}"
+    return p
+
+
+def test_redhat_default_content_sets_hit(scanner):
+    # no build info → rhel-7 default content sets map to CPE 869/870
+    pkg = _rh_pkg(name="openssl-libs", version="1.0.2k", release="16.el7",
+                  epoch=1)
+    vulns, eosl = scanner.scan(
+        T.OS(family="redhat", name="7.9"), None, [pkg],
+        now=dt.datetime(2023, 1, 1, tzinfo=dt.timezone.utc))
+    ids = {(v.vulnerability_id, v.fixed_version) for v in vulns}
+    assert ("CVE-2023-0286", "1:1.0.2k-26.el7_9") in ids
+    # unfixed advisory also reported, with its will_not_fix status
+    unfixed = [v for v in vulns if v.vulnerability_id == "CVE-2022-9999"]
+    assert unfixed and unfixed[0].status == "will_not_fix"
+    assert unfixed[0].severity_source == "redhat"
+    assert unfixed[0].vulnerability.severity == "MEDIUM"
+    assert not eosl
+
+
+def test_redhat_content_sets_exclude(scanner):
+    # build info scoping the package to rhel-8 repos: CPE 900/901 do not
+    # intersect the openssl entry's {869, 870} → no hit
+    pkg = _rh_pkg(name="openssl-libs", version="1.0.2k", release="16.el7",
+                  epoch=1)
+    pkg.build_info = T.BuildInfo(
+        content_sets=["rhel-8-for-x86_64-baseos-rpms"])
+    vulns, _ = scanner.scan(T.OS(family="redhat", name="8.6"), None, [pkg])
+    assert vulns == []
+
+
+def test_redhat_nvr_scope(scanner):
+    pkg = _rh_pkg(name="openssl-libs", version="1.0.2k", release="16.el7",
+                  epoch=1)
+    pkg.build_info = T.BuildInfo(nvr="ubi7-container-7.7-140",
+                                 arch="x86_64")
+    vulns, _ = scanner.scan(T.OS(family="redhat", name="7.9"), None, [pkg])
+    assert any(v.vulnerability_id == "CVE-2023-0286" for v in vulns)
+
+
+def test_redhat_modular_package(scanner):
+    pkg = _rh_pkg(name="npm", version="6.14.10",
+                  release="1.module+el8.3.0", epoch=1,
+                  modularitylabel="nodejs:12:8030020201124152102:229f0a1c")
+    pkg.build_info = T.BuildInfo(
+        content_sets=["rhel-8-for-x86_64-appstream-rpms"])
+    vulns, _ = scanner.scan(T.OS(family="redhat", name="8.3"), None, [pkg])
+    assert any(v.vulnerability_id == "CVE-2021-22883" for v in vulns)
+
+
+def test_redhat_arch_scope(scanner):
+    pkg = _rh_pkg(name="openssl-libs", version="1.0.2k", release="16.el7",
+                  epoch=1, arch="s390x")
+    vulns, _ = scanner.scan(T.OS(family="redhat", name="7.9"), None, [pkg])
+    assert vulns == []
+    # noarch bypasses the arch filter (redhat.go:126)
+    pkg2 = _rh_pkg(name="openssl-libs", version="1.0.2k",
+                   release="16.el7", epoch=1, arch="noarch")
+    vulns2, _ = scanner.scan(T.OS(family="redhat", name="7.9"), None,
+                             [pkg2])
+    assert vulns2
+
+
+def test_centos_eosl_flag(scanner):
+    pkg = _rh_pkg(name="openssl-libs", version="1.0.2k", release="16.el7",
+                  epoch=1)
+    _, eosl = scanner.scan(
+        T.OS(family="centos", name="7.9"), None, [pkg],
+        now=dt.datetime(2025, 1, 1, tzinfo=dt.timezone.utc))
+    assert eosl
+
+
+def test_remi_vendor_skipped(scanner):
+    pkg = _rh_pkg(name="openssl-libs", version="1.0.2k",
+                  release="16.el7.remi", epoch=1)
+    vulns, _ = scanner.scan(T.OS(family="redhat", name="7.9"), None, [pkg])
+    assert vulns == []
+
+
+def test_suse_enterprise(scanner):
+    pkg = T.Package(id="libopenssl1_1@1.1.1l", name="libopenssl1_1",
+                    version="1.1.1l", release="150400.7.10.1")
+    vulns, _ = scanner.scan(
+        T.OS(family="suse linux enterprise server", name="15.4"),
+        None, [pkg])
+    assert [v.vulnerability_id for v in vulns] == ["SUSE-SU-2023:0311-1"]
+    assert vulns[0].fixed_version == "1.1.1l-150400.7.22.1"
+
+
+def test_ubuntu_esm_stream():
+    now = dt.datetime(2026, 7, 1, tzinfo=dt.timezone.utc)
+    assert _ubuntu_stream("16.04", now) == "16.04-ESM"
+    assert _ubuntu_stream("22.04", now) == "22.04"
+    early = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+    assert _ubuntu_stream("16.04", early) == "16.04"
+
+
+def test_buildinfo_analyzers():
+    from trivy_tpu.fanal.analyzers.redhat import (
+        BuildInfoDockerfileAnalyzer, ContentManifestAnalyzer)
+    cm = ContentManifestAnalyzer()
+    assert cm.required(
+        "root/buildinfo/content_manifests/ubi8-container-8.6-941.json")
+    res = cm.analyze("root/buildinfo/content_manifests/x.json",
+                     b'{"content_sets": ["rhel-8-for-x86_64-baseos-rpms"]}')
+    assert res.build_info.content_sets == ["rhel-8-for-x86_64-baseos-rpms"]
+
+    df = BuildInfoDockerfileAnalyzer()
+    path = "root/buildinfo/Dockerfile-ubi8-8.6-941"
+    assert df.required(path)
+    content = (b'FROM x\n'
+               b'LABEL com.redhat.component="ubi8-container" \\\n'
+               b'      architecture="x86_64"\n')
+    res = df.analyze(path, content)
+    assert res.build_info.nvr == "ubi8-container-8.6-941"
+    assert res.build_info.arch == "x86_64"
+
+
+def test_applier_buildinfo_inheritance():
+    from trivy_tpu.fanal.applier import apply_layers
+    bi = T.BuildInfo(content_sets=["rhel-8-for-x86_64-baseos-rpms"])
+    base = T.BlobInfo(diff_id="sha256:base", package_infos=[T.PackageInfo(
+        file_path="var/lib/rpm/rpmdb.sqlite",
+        packages=[T.Package(name="bash", version="5.1", release="2.el8")])])
+    redhat_layer = T.BlobInfo(diff_id="sha256:rh", build_info=bi)
+    customer = T.BlobInfo(diff_id="sha256:user")
+    detail = apply_layers([base, redhat_layer, customer])
+    assert detail.packages[0].build_info is bi

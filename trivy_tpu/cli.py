@@ -105,8 +105,10 @@ def load_table(spec: str) -> AdvisoryTable:
     if spec.endswith(".npz"):
         return AdvisoryTable.load(spec)
     paths = sorted(glob.glob(spec)) or [spec]
-    advisories, details, _ = load_fixture_files(paths)
-    return build_table(advisories, details)
+    advisories, details, sources = load_fixture_files(paths)
+    return build_table(advisories, details,
+                       aux={"Red Hat CPE": sources["Red Hat CPE"]}
+                       if "Red Hat CPE" in sources else None)
 
 
 def _scan_common(args, ref, cache, artifact_type: str) -> int:

@@ -81,15 +81,21 @@ def load_fixture_docs(docs: list) -> tuple[list[RawAdvisory], dict, dict]:
         bucket = top["bucket"]
         if bucket in ("vulnerability", "data-source"):
             continue
+        if bucket == "Red Hat CPE":
+            sources["Red Hat CPE"] = _load_cpe_maps(top)
+            continue
         data_source = sources.get(bucket)
         eco = ecosystem_for_source(bucket)
+        if bucket == "Red Hat":
+            advisories.extend(_load_redhat(top, data_source))
+            continue
         for pkg in top.get("pairs", []):
             name = pkg["bucket"]
             for pair in pkg.get("pairs", []):
                 v = pair.get("value") or {}
                 arches: tuple = ()
                 if "Entries" in v and not v.get("FixedVersion"):
-                    continue  # Red Hat content-set schema: later round
+                    continue  # rocky/alma entries without fix info
                 if "Entries" in v:
                     # Rocky/Alma style: entries carry per-arch fix info
                     arches = tuple(sorted({
@@ -127,6 +133,56 @@ def load_fixture_docs(docs: list) -> tuple[list[RawAdvisory], dict, dict]:
                     arches=arches,
                 ))
     return advisories, details, sources
+
+
+def _load_cpe_maps(top: dict) -> dict:
+    """'Red Hat CPE' bucket → {"repository": {name: [idx]},
+    "nvr": {name: [idx]}, "cpe": {idx: uri}} (trivy-db redhat-oval
+    vulnsrc; fixture integration/testdata/fixtures/db/cpe.yaml)."""
+    out: dict = {"repository": {}, "nvr": {}, "cpe": {}}
+    for sub in top.get("pairs", []):
+        kind = sub.get("bucket")
+        if kind not in out:
+            continue
+        for pair in sub.get("pairs", []):
+            out[kind][str(pair["key"])] = pair.get("value")
+    return out
+
+
+def _load_redhat(top: dict, data_source) -> list[RawAdvisory]:
+    """'Red Hat' bucket: advisory key (CVE-* or RH[SBE]A-*) → Entries,
+    each scoped by Affected CPE indices + Arches, carrying per-CVE
+    severity (redhat-oval schema; detector pkg/detector/ospkg/redhat)."""
+    out = []
+    for pkg in top.get("pairs", []):
+        name = pkg["bucket"]
+        for pair in pkg.get("pairs", []):
+            key = pair["key"]
+            v = pair.get("value") or {}
+            for entry in v.get("Entries") or []:
+                fixed = entry.get("FixedVersion", "") or ""
+                status = ""
+                if "Status" in entry:
+                    try:
+                        status = STATUSES[int(entry["Status"])]
+                    except (ValueError, IndexError):
+                        status = ""
+                arches = tuple(entry.get("Arches") or ())
+                cpes = tuple(int(i) for i in entry.get("Affected") or ())
+                cves = entry.get("Cves") or [{}]
+                for cve in cves:
+                    vuln_id = cve.get("ID") or key
+                    out.append(RawAdvisory(
+                        source="Red Hat", ecosystem="redhat",
+                        pkg_name=name, vuln_id=vuln_id,
+                        fixed_version=fixed,
+                        status=status,
+                        severity=_severity_name(cve.get("Severity")),
+                        data_source=_ds_fields(data_source),
+                        vendor_ids=(key,) if key != vuln_id else (),
+                        arches=arches, cpe_indices=cpes,
+                    ))
+    return out
 
 
 def _ds_fields(ds: dict | None) -> dict | None:

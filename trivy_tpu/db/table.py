@@ -49,6 +49,7 @@ class RawAdvisory:
     data_source: Optional[dict] = None
     vendor_ids: tuple = ()
     arches: tuple = ()           # Rocky/Alma: advisory applies per-arch
+    cpe_indices: tuple = ()      # Red Hat: affected CPE index scope
 
 
 @dataclass
@@ -64,6 +65,7 @@ class AdvisoryGroup:
     data_source: Optional[dict]
     vendor_ids: tuple
     arches: tuple = ()
+    cpe_indices: tuple = ()
     # raw bound strings per row for exact host recheck of inexact rows
     rows: list = field(default_factory=list)  # [(polarity, Interval)]
 
@@ -71,7 +73,8 @@ class AdvisoryGroup:
 class AdvisoryTable:
     def __init__(self, hash_: np.ndarray, lo_tok, hi_tok, flags, group,
                  groups: list[AdvisoryGroup], window: int,
-                 details: dict | None = None):
+                 details: dict | None = None,
+                 aux: dict | None = None):
         self.hash = hash_
         self.lo_tok = lo_tok
         self.hi_tok = hi_tok
@@ -80,6 +83,9 @@ class AdvisoryTable:
         self.groups = groups
         self.window = max(window, 1)
         self.details = details or {}
+        # side tables that scope advisories at query time, e.g.
+        # "Red Hat CPE" {repository/nvr → cpe indices}
+        self.aux = aux or {}
         self.sources = sorted({g.source for g in groups})
         self._device = None
 
@@ -116,11 +122,13 @@ class AdvisoryTable:
                      "severity": g.severity, "data_source": g.data_source,
                      "vendor_ids": list(g.vendor_ids),
                      "arches": list(g.arches),
+                     "cpe_indices": list(g.cpe_indices),
                      "rows": [[p, iv.lo, iv.lo_incl, iv.hi, iv.hi_incl]
                               for p, iv in g.rows]}
                     for g in self.groups
                 ],
                 "details": self.details,
+                "aux": self.aux,
             }).encode(), dtype=np.uint8),
         )
 
@@ -136,6 +144,7 @@ class AdvisoryTable:
                 severity=g["severity"], data_source=g["data_source"],
                 vendor_ids=tuple(g["vendor_ids"]),
                 arches=tuple(g.get("arches") or ()),
+                cpe_indices=tuple(g.get("cpe_indices") or ()),
                 rows=[(p, Interval(lo, li, hi, hi_i))
                       for p, lo, li, hi, hi_i in g["rows"]],
             )
@@ -143,7 +152,7 @@ class AdvisoryTable:
         ]
         return cls(z["hash"], z["lo_tok"], z["hi_tok"], z["flags"],
                    z["group"], groups, meta["window"],
-                   meta.get("details", {}))
+                   meta.get("details", {}), meta.get("aux", {}))
 
 
 def _encode_bound(ecosystem: str, v: Optional[str]):
@@ -159,7 +168,8 @@ def _encode_bound(ecosystem: str, v: Optional[str]):
 
 
 def build_table(raw: list[RawAdvisory], details: dict | None = None,
-                key_width: int = KEY_WIDTH) -> AdvisoryTable:
+                key_width: int = KEY_WIDTH,
+                aux: dict | None = None) -> AdvisoryTable:
     """Flatten raw advisories into the sorted columnar table."""
     hash_vals: list[int] = []
     lo_rows: list[np.ndarray] = []
@@ -176,7 +186,7 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
             fixed_version=adv.fixed_version or _first_fixed(adv),
             status=adv.status, severity=adv.severity,
             data_source=adv.data_source, vendor_ids=adv.vendor_ids,
-            arches=adv.arches,
+            arches=adv.arches, cpe_indices=adv.cpe_indices,
         )
         gid = len(groups)
         intervals: list[tuple[bool, Interval]] = []
@@ -230,7 +240,7 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
         return AdvisoryTable(empty, np.zeros((0, key_width), np.int32),
                              np.zeros((0, key_width), np.int32),
                              np.zeros(0, np.int32), np.zeros(0, np.int32),
-                             [], 1, details)
+                             [], 1, details, aux)
 
     hashes = split_u64(hash_vals)                       # [A, 2]
     order = np.lexsort((hashes[:, 1], hashes[:, 0]))
@@ -246,7 +256,7 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
     window = int(counts.max())
 
     return AdvisoryTable(hashes, lo_tok, hi_tok, flags, group,
-                         groups, window, details)
+                         groups, window, details, aux)
 
 
 def _first_fixed(adv: RawAdvisory) -> str:

@@ -33,6 +33,7 @@ class PkgQuery:
     name: str        # join name (src package name for OS pkgs)
     version: str     # installed version (formatted, e.g. epoch:ver-rel)
     arch: str = ""   # for arch-scoped advisories (Rocky/Alma entries)
+    cpe_indices: frozenset = frozenset()  # Red Hat content-set scope
     ref: Any = None  # caller's package object
 
 
@@ -181,6 +182,9 @@ class BatchDetector:
                 continue  # 64-bit hash collision: reject
             if g.arches and q.arch and q.arch not in g.arches:
                 continue  # advisory scoped to other architectures
+            if g.cpe_indices and not \
+                    q.cpe_indices.intersection(g.cpe_indices):
+                continue  # Red Hat: entry's CPEs outside content sets
             if inex_any[u] or not k.exact:
                 pos, negv = self._exact_eval(g, q)
             else:

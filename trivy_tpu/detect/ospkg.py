@@ -49,13 +49,30 @@ DEBIAN_EOL = {
 }
 
 UBUNTU_EOL = {
-    "14.04": _d(2019, 4, 25), "16.04": _d(2021, 4, 21),
-    "18.04": _d(2023, 5, 31), "20.04": _d(2025, 4, 23),
+    "12.04": _d(2019, 4, 26), "12.04-ESM": _d(2019, 4, 28),
+    "14.04": _d(2022, 4, 25), "14.04-ESM": _d(2024, 4, 25),
+    "16.04": _d(2021, 4, 21), "16.04-ESM": _d(2026, 4, 29),
+    "18.04": _d(2023, 5, 31), "18.04-ESM": _d(2028, 3, 31),
+    "20.04": _d(2025, 4, 23),
     "21.04": _d(2022, 1, 20), "21.10": _d(2022, 7, 14),
     "22.04": _d(2027, 4, 23), "22.10": _d(2023, 7, 20),
     "23.04": _d(2024, 1, 20), "23.10": _d(2024, 7, 11),
     "24.04": _d(2029, 4, 25),
 }
+
+
+def _ubuntu_stream(os_ver: str,
+                   now: Optional[dt.datetime] = None) -> str:
+    """Once the base release is EOL, fall over to the '<ver>-ESM'
+    advisory stream when one exists (ubuntu.go versionFromEolDates)."""
+    now = now or dt.datetime.now(dt.timezone.utc)
+    eol = UBUNTU_EOL.get(os_ver)
+    if eol is not None and now <= eol:
+        return os_ver
+    esm = os_ver + "-ESM"
+    if esm in UBUNTU_EOL:
+        return esm
+    return os_ver
 
 
 def minor(os_ver: str) -> str:
@@ -106,6 +123,19 @@ ORACLE_EOL = {
 }
 ROCKY_EOL = {"8": _d(2029, 5, 31), "9": _d(2032, 5, 31)}
 ALMA_EOL = {"8": _d(2029, 3, 1), "9": _d(2032, 5, 31)}
+SUSE_SLES_EOL = {
+    "12": _d(2016, 6, 30), "12.1": _d(2017, 5, 31),
+    "12.2": _d(2018, 3, 31), "12.3": _d(2019, 1, 30),
+    "12.4": _d(2020, 6, 30), "12.5": _d(2024, 10, 31),
+    "15": _d(2019, 12, 31), "15.1": _d(2021, 1, 31),
+    "15.2": _d(2021, 12, 31), "15.3": _d(2022, 12, 31),
+    "15.4": _d(2023, 12, 31), "15.5": _d(2028, 12, 31),
+}
+SUSE_OPENSUSE_EOL = {
+    "15.0": _d(2019, 12, 3), "15.1": _d(2020, 11, 30),
+    "15.2": _d(2021, 11, 30), "15.3": _d(2022, 11, 30),
+    "15.4": _d(2023, 11, 30), "15.5": _d(2024, 12, 31),
+}
 PHOTON_EOL = {
     "1.0": _d(2022, 2, 28), "2.0": _d(2022, 12, 31),
     "3.0": _d(2024, 3, 1), "4.0": _d(2026, 3, 1), "5.0": _d(2028, 3, 1),
@@ -134,7 +164,7 @@ DRIVERS: dict[str, FamilyDriver] = {
         eol=DEBIAN_EOL, eol_key=major),
     "ubuntu": FamilyDriver(
         family="ubuntu", ecosystem="ubuntu",
-        stream=lambda v, r: v,
+        stream=lambda v, r: _ubuntu_stream(v),
         bucket=lambda s: f"ubuntu {s}",
         eol=UBUNTU_EOL),
     # rpm families (pkg/detector/ospkg/{amazon,oracle,rocky,alma,photon,
@@ -169,11 +199,49 @@ DRIVERS: dict[str, FamilyDriver] = {
         stream=lambda v, r: minor(v),
         bucket=lambda s: f"CBL-Mariner {s}",
         eol_key=minor),
+    # suse.go joins on the BINARY package name (suse.go:99)
     "opensuse-leap": FamilyDriver(
         family="opensuse-leap", ecosystem="opensuse-leap",
         stream=lambda v, r: v,
-        bucket=lambda s: f"openSUSE Leap {s}"),
+        bucket=lambda s: f"openSUSE Leap {s}", use_src=False,
+        eol=SUSE_OPENSUSE_EOL),
+    # suse.go NewScanner(SUSEEnterpriseLinux): susecvrf bucket
+    # "SUSE Linux Enterprise <ver>"
+    "suse linux enterprise server": FamilyDriver(
+        family="suse linux enterprise server",
+        ecosystem="suse linux enterprise server",
+        stream=lambda v, r: v,
+        bucket=lambda s: f"SUSE Linux Enterprise {s}", use_src=False,
+        eol=SUSE_SLES_EOL),
 }
+
+# ----- Red Hat / CentOS (content-set scoped OVAL v2) -----
+
+REDHAT_DEFAULT_CONTENT_SETS = {
+    "6": ["rhel-6-server-rpms", "rhel-6-server-extras-rpms"],
+    "7": ["rhel-7-server-rpms", "rhel-7-server-extras-rpms"],
+    "8": ["rhel-8-for-x86_64-baseos-rpms",
+          "rhel-8-for-x86_64-appstream-rpms"],
+    "9": ["rhel-9-for-x86_64-baseos-rpms",
+          "rhel-9-for-x86_64-appstream-rpms"],
+}
+REDHAT_EOL = {
+    "4": _d(2017, 5, 31), "5": _d(2020, 11, 30), "6": _d(2024, 6, 30),
+    "7": _FAR_FUTURE, "8": _FAR_FUTURE, "9": _FAR_FUTURE,
+}
+CENTOS_EOL = {
+    "3": _d(2010, 10, 31), "4": _d(2012, 2, 29), "5": _d(2017, 3, 31),
+    "6": _d(2020, 11, 30), "7": _d(2024, 6, 30), "8": _d(2021, 12, 31),
+}
+
+
+def add_modular_namespace(name: str, label: str) -> str:
+    """'nodejs:12:8030020201124152102:229f0a1c' + 'npm' →
+    'nodejs:12::npm' (redhat.go addModularNamespace)."""
+    parts = label.split(":")
+    if len(parts) >= 2:
+        return f"{parts[0]}:{parts[1]}::{name}"
+    return name
 
 
 
@@ -194,10 +262,18 @@ class OspkgScanner:
              ) -> tuple[list[T.DetectedVulnerability], bool]:
         """→ (vulns, eosl). Skips gpg-pubkey pseudo packages like
         detect.go:73."""
+        if os_info.family in ("redhat", "centos"):
+            return self._scan_redhat(os_info, packages, now)
         driver = DRIVERS.get(os_info.family)
         if driver is None:
             return [], False
-        stream = driver.stream(os_info.name, repo)
+        now = now or dt.datetime.now(dt.timezone.utc)
+        if driver.family == "ubuntu":
+            # stream selection shares the scan clock so the ESM
+            # fallover and the EOSL flag agree
+            stream = _ubuntu_stream(os_info.name, now)
+        else:
+            stream = driver.stream(os_info.name, repo)
         bucket = driver.bucket(stream)
 
         queries = []
@@ -226,6 +302,100 @@ class OspkgScanner:
             eol = driver.eol.get(driver.eol_key(os_info.name))
             eosl = eol is not None and now > eol
         return vulns, eosl
+
+    def _scan_redhat(self, os_info: T.OS, packages: list[T.Package],
+                     now: Optional[dt.datetime] = None
+                     ) -> tuple[list[T.DetectedVulnerability], bool]:
+        """RHEL/CentOS: advisories are scoped by CPE indices resolved
+        from each package's content sets / NVR (redhat.go detect)."""
+        from .. import version as V
+
+        maj = major(os_info.name)
+        cpe_maps = self.table_aux().get("Red Hat CPE") or {}
+        repo_map = cpe_maps.get("repository") or {}
+        nvr_map = cpe_maps.get("nvr") or {}
+
+        queries = []
+        for pkg in packages:
+            if pkg.name == "gpg-pubkey":
+                continue
+            if pkg.release.endswith(".remi"):
+                continue  # unsupported vendor (redhat.go:64-66)
+            name = pkg.name
+            if pkg.modularitylabel:
+                name = add_modular_namespace(name, pkg.modularitylabel)
+            bi = pkg.build_info
+            if bi is None:
+                content_sets = REDHAT_DEFAULT_CONTENT_SETS.get(maj, [])
+                nvrs = []
+            else:
+                content_sets = bi.content_sets
+                nvrs = [f"{bi.nvr}-{bi.arch}"] if bi.nvr else []
+            allowed: set = set()
+            for cs in content_sets:
+                allowed.update(repo_map.get(cs) or ())
+            for nvr in nvrs:
+                allowed.update(nvr_map.get(nvr) or ())
+            ver = pkg.format_version()
+            if not ver:
+                continue
+            queries.append(PkgQuery(
+                source="Red Hat", ecosystem="redhat",
+                name=name, version=ver,
+                arch="" if pkg.arch == "noarch" else pkg.arch,
+                cpe_indices=frozenset(allowed), ref=pkg))
+
+        hits = self.detector.detect(queries)
+        # per (pkg, vuln): unfixed never overwrite; fixed take the max
+        # fixed version and merged vendor ids (redhat.go:148-179)
+        merged: dict[tuple, Hit] = {}
+        for h in hits:
+            k = (id(h.query.ref), h.vuln_id)
+            prev = merged.get(k)
+            if h.fixed_version == "":
+                if prev is None:
+                    merged[k] = h
+                continue
+            if prev is None or prev.fixed_version == "":
+                merged[k] = h
+                continue
+            prev.vendor_ids = tuple(dict.fromkeys(
+                prev.vendor_ids + h.vendor_ids))
+            try:
+                if V.compare("redhat", prev.fixed_version,
+                             h.fixed_version) < 0:
+                    prev.fixed_version = h.fixed_version
+            except (ValueError, KeyError):
+                pass
+
+        vulns = []
+        for h in merged.values():
+            pkg: T.Package = h.query.ref
+            v = T.DetectedVulnerability(
+                vulnerability_id=h.vuln_id,
+                vendor_ids=list(h.vendor_ids),
+                pkg_id=pkg.id, pkg_name=pkg.name,
+                pkg_identifier=pkg.identifier,
+                installed_version=pkg.format_version(),
+                fixed_version=h.fixed_version,
+                status=h.status, layer=pkg.layer,
+                data_source=T.DataSource(**h.data_source)
+                if h.data_source else None,
+            )
+            v.severity_source = "redhat"
+            v.vulnerability.severity = h.severity or "UNKNOWN"
+            vulns.append(v)
+        vulns.sort(key=lambda v: (v.pkg_name, v.vulnerability_id))
+
+        eol_table = CENTOS_EOL if os_info.family == "centos" \
+            else REDHAT_EOL
+        now = now or dt.datetime.now(dt.timezone.utc)
+        eol = eol_table.get(maj)
+        eosl = eol is not None and now > eol
+        return vulns, eosl
+
+    def table_aux(self) -> dict:
+        return getattr(self.detector.table, "aux", {}) or {}
 
     @staticmethod
     def _to_vuln(h: Hit, driver: FamilyDriver) -> T.DetectedVulnerability:

@@ -27,6 +27,7 @@ class AnalysisResult:
     # filter post-handler (reference analyzer.AnalysisResult
     # SystemInstalledFiles)
     system_installed_files: list = field(default_factory=list)
+    build_info: object = None  # Red Hat content sets / nvr+arch
 
     def merge(self, other: "AnalysisResult"):
         if other is None:
@@ -44,6 +45,14 @@ class AnalysisResult:
         self.secrets.extend(other.secrets)
         self.licenses.extend(other.licenses)
         self.system_installed_files.extend(other.system_installed_files)
+        if other.build_info is not None:
+            if self.build_info is None:
+                self.build_info = other.build_info
+            else:  # merge content sets with nvr/arch (analyzer.go Merge)
+                bi, obi = self.build_info, other.build_info
+                bi.content_sets = bi.content_sets or obi.content_sets
+                bi.nvr = bi.nvr or obi.nvr
+                bi.arch = bi.arch or obi.arch
 
 
 class Analyzer:

@@ -65,20 +65,26 @@ def parse_go_buildinfo(content: bytes):
     return go_version, pkgs
 
 
+def executable_candidate(path: str) -> bool:
+    """Extension-less-executable heuristic shared by the Go and Rust
+    binary analyzers (the reference gates on the file mode's exec bit,
+    which tar walking does surface but directory walking may not)."""
+    base = path.rsplit("/", 1)[-1]
+    if "." in base and not base.endswith((".bin", ".exe")):
+        return False
+    return any(seg in path for seg in
+               ("bin/", "sbin/", "usr/local/", "app/", "opt/")) or \
+        "/" not in path
+
+
 @register
 class GoBinaryAnalyzer(Analyzer):
     name = "gobinary"
     version = 1
 
     def required(self, path: str, size: int = -1) -> bool:
-        # executables without extension, like the reference's mode check;
-        # we sniff ELF magic in analyze
-        base = path.rsplit("/", 1)[-1]
-        if "." in base and not base.endswith((".bin", ".exe")):
-            return False
-        return any(seg in path for seg in
-                   ("bin/", "sbin/", "usr/local/", "app/", "opt/")) or \
-            "/" not in path
+        # executables without extension; ELF magic is sniffed in analyze
+        return executable_candidate(path)
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
         if content[:4] not in (b"\x7fELF", b"MZ\x90\x00") and \

@@ -575,12 +575,8 @@ class RustBinaryAnalyzer(Analyzer):
     version = 1
 
     def required(self, path: str, size: int = -1) -> bool:
-        base = path.rsplit("/", 1)[-1]
-        if "." in base and not base.endswith((".bin", ".exe")):
-            return False
-        return any(seg in path for seg in
-                   ("bin/", "sbin/", "usr/local/", "app/", "opt/")) or \
-            "/" not in path
+        from .binaries import executable_candidate
+        return executable_candidate(path)
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
         deps = parse_rust_audit(content)

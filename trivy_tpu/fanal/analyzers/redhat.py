@@ -94,3 +94,77 @@ class MarinerAnalyzer(Analyzer):
                     return AnalysisResult(os=T.OS(
                         family=T.OSFamily.MARINER, name=ver))
         return None
+
+
+# --- Red Hat build metadata (pkg/fanal/analyzer/buildinfo) ---
+
+_LABEL_RE = re.compile(
+    r'^\s*LABEL\s+(.*)$', re.IGNORECASE)
+_KV_RE = re.compile(
+    r'([\w.\-]+)\s*=\s*(?:"((?:[^"\\]|\\.)*)"|(\S+))')
+
+
+@register
+class ContentManifestAnalyzer(Analyzer):
+    """root/buildinfo/content_manifests/*.json → content sets that scope
+    Red Hat OVAL v2 advisories (buildinfo/content_manifest.go)."""
+    name = "redhat-content-manifest"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return (path.startswith("root/buildinfo/content_manifests/")
+                and path.endswith(".json"))
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        import json as _json
+        try:
+            doc = _json.loads(content)
+        except _json.JSONDecodeError:
+            return None
+        sets = doc.get("content_sets") or []
+        if not sets:
+            return None
+        return AnalysisResult(build_info=T.BuildInfo(content_sets=sets))
+
+
+@register
+class BuildInfoDockerfileAnalyzer(Analyzer):
+    """root/buildinfo/Dockerfile-<name>-<ver>-<rel>: LABEL
+    com.redhat.component + architecture → NVR-arch for advisory scoping
+    (buildinfo/dockerfile.go; literal-label subset of the buildkit
+    parse)."""
+    name = "redhat-dockerfile"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        dirname, _, base = path.rpartition("/")
+        return dirname == "root/buildinfo" and base.startswith("Dockerfile")
+
+    def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        component = arch = ""
+        text = content.decode(errors="replace")
+        # join line continuations
+        text = re.sub(r"\\\r?\n", " ", text)
+        for line in text.splitlines():
+            m = _LABEL_RE.match(line)
+            if not m:
+                continue
+            for key, dq, bare in _KV_RE.findall(m.group(1)):
+                val = dq if dq else bare
+                k = key.lower().strip('"')
+                if k in ("com.redhat.component", "bzcomponent"):
+                    component = val
+                elif k == "architecture":
+                    arch = val
+        if not component or not arch:
+            return None
+        base = path.rpartition("/")[2]
+        # version-release comes from the file name's last two dashes
+        # (dockerfile.go parseVersion)
+        nvr_tail = base.split("Dockerfile-", 1)[-1]
+        ri = nvr_tail.rfind("-")
+        vi = nvr_tail[:ri].rfind("-") if ri > 0 else -1
+        version = nvr_tail[vi + 1:] if ri > 0 else ""
+        return AnalysisResult(build_info=T.BuildInfo(
+            nvr=f"{component}-{version}" if version else component,
+            arch=arch))

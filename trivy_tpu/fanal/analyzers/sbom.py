@@ -30,10 +30,12 @@ class SbomAnalyzer(Analyzer):
         try:
             doc = json.loads(content)
             fmt = detect_format(doc)
-        except (json.JSONDecodeError, UnicodeDecodeError, ValueError):
+            detail = decode_cyclonedx(doc) if fmt == "cyclonedx" \
+                else decode_spdx(doc)
+        except Exception:
+            # malformed in-image SBOMs are skipped like any other
+            # analyzer parse failure, never abort the scan
             return None
-        detail = decode_cyclonedx(doc) if fmt == "cyclonedx" \
-            else decode_spdx(doc)
         apps = detail.applications
         # bitnami SPDX files describe the component dir they sit in
         # (sbom.go:44-51): point file paths there

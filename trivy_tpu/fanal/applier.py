@@ -48,10 +48,13 @@ def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
             misconf_files[mc.file_path] = (mc, layer)
 
     origin = _origin_index(blobs)
+    diff_index = {b.diff_id: i for i, b in enumerate(blobs) if b.diff_id}
     for path in sorted(pkg_files):
         pi, layer = pkg_files[path]
         for pkg in pi.packages:
             pkg.layer = origin.get((pkg.name, pkg.version, pkg.release), layer)
+            li = diff_index.get(pkg.layer.diff_id, len(blobs) - 1)
+            pkg.build_info = _lookup_build_info(li, blobs)
             detail.packages.append(pkg)
     for path in sorted(app_files):
         app, layer = app_files[path]
@@ -94,6 +97,20 @@ def _aggregate_individual_apps(detail: T.ArtifactDetail) -> None:
     for app in merged.values():
         app.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
     detail.applications = keep + [merged[t] for t in sorted(merged)]
+
+
+def _lookup_build_info(index: int, blobs) -> T.BuildInfo | None:
+    """Red Hat content sets for the layer a package came from
+    (docker.go:52-75): the base layer (0) and customer layers inherit
+    the nearest Red Hat layer's build info."""
+    if index < len(blobs) and blobs[index].build_info is not None:
+        return blobs[index].build_info
+    if index == 0:
+        return blobs[1].build_info if len(blobs) > 1 else None
+    for i in range(min(index, len(blobs)) - 1, 0, -1):
+        if blobs[i].build_info is not None:
+            return blobs[i].build_info
+    return None
 
 
 def _origin_index(blobs) -> dict:

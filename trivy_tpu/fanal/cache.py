@@ -176,6 +176,11 @@ def blob_from_json(j: dict) -> T.BlobInfo:
                            for m in j.get("Misconfigurations", [])],
         secrets=[_secret_from_json(s) for s in j.get("Secrets", [])],
         licenses=j.get("Licenses", []),
+        build_info=T.BuildInfo(
+            content_sets=j["BuildInfo"].get("ContentSets", []),
+            nvr=j["BuildInfo"].get("Nvr", ""),
+            arch=j["BuildInfo"].get("Arch", ""))
+        if j.get("BuildInfo") else None,
     )
 
 

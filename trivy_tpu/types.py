@@ -104,10 +104,13 @@ def _strip_empty(v: Any) -> Any:
 class JsonMixin:
     _json_names: dict = {}
     _keep_zero: tuple = ()  # fields serialized even when zero (no omitempty)
+    _json_skip: tuple = ()  # fields never serialized (Go `json:"-"`)
 
     def to_json(self) -> dict:
         out = {}
         for f in dataclasses.fields(self):
+            if f.name in self._json_skip:
+                continue
             v = getattr(self, f.name)
             name = self._json_names.get(f.name, _pascal(f.name))
             sv = _strip_empty(v)
@@ -206,6 +209,11 @@ class Package(JsonMixin):
     digest: str = ""
     locations: list = field(default_factory=list)
     installed_files: list = field(default_factory=list)
+    # attached by the applier from the origin layer's Red Hat build
+    # metadata (docker.go lookupBuildInfo); never serialized to reports
+    # (reference Package has BuildInfo `json:"-"`)
+    build_info: Optional["BuildInfo"] = None
+    _json_skip = ("build_info",)
     _json_names = {"id": "ID", "src_name": "SrcName", "src_version": "SrcVersion",
                    "src_release": "SrcRelease", "src_epoch": "SrcEpoch"}
 
@@ -298,6 +306,16 @@ class Misconfiguration(JsonMixin):
 
 
 @dataclass
+class BuildInfo(JsonMixin):
+    """Red Hat build metadata (reference pkg/fanal/types/artifact.go
+    BuildInfo): content sets scope which advisories apply."""
+    content_sets: list = field(default_factory=list)
+    nvr: str = ""
+    arch: str = ""
+    _json_names = {"nvr": "Nvr"}
+
+
+@dataclass
 class BlobInfo(JsonMixin):
     """Per-layer analysis result (reference pkg/fanal/types/artifact.go:311)."""
     schema_version: int = 2
@@ -314,6 +332,7 @@ class BlobInfo(JsonMixin):
     secrets: list = field(default_factory=list)         # [Secret]
     licenses: list = field(default_factory=list)
     custom_resources: list = field(default_factory=list)
+    build_info: Optional[BuildInfo] = None
     _json_names = {"diff_id": "DiffID", "os": "OS"}
 
 
