@@ -1,0 +1,355 @@
+"""IaC engine: kubernetes + cloudformation scanners, detection,
+inline ignores (reference pkg/iac/scanners/{kubernetes,cloudformation},
+pkg/iac/detection, pkg/iac/ignore)."""
+
+import textwrap
+
+from trivy_tpu.iac.cloudformation import scan_cloudformation
+from trivy_tpu.iac.core import ignored_ids_by_line
+from trivy_tpu.iac.detection import detect_config_type
+from trivy_tpu.iac.kubernetes import scan_kubernetes
+
+POD = textwrap.dedent("""\
+    apiVersion: v1
+    kind: Pod
+    metadata:
+      name: hello
+    spec:
+      containers:
+      - name: app
+        image: nginx:latest
+        securityContext:
+          privileged: true
+""").encode()
+
+GOOD_POD = textwrap.dedent("""\
+    apiVersion: v1
+    kind: Pod
+    metadata:
+      name: good
+    spec:
+      securityContext:
+        seccompProfile:
+          type: RuntimeDefault
+      containers:
+      - name: app
+        image: nginx:1.25@sha256:abc
+        resources:
+          limits: {cpu: 250m, memory: 64Mi}
+          requests: {cpu: 250m, memory: 64Mi}
+        securityContext:
+          allowPrivilegeEscalation: false
+          runAsNonRoot: true
+          runAsUser: 10100
+          runAsGroup: 10100
+          readOnlyRootFilesystem: true
+          capabilities:
+            drop: [ALL]
+""").encode()
+
+
+def _ids(fails):
+    return {f.id for f in fails}
+
+
+class TestKubernetes:
+    def test_bad_pod_flags_core_checks(self):
+        fails, succ = scan_kubernetes("pod.yaml", POD)
+        ids = _ids(fails)
+        for want in ("KSV001", "KSV003", "KSV012", "KSV013", "KSV014",
+                     "KSV017", "KSV030"):
+            assert want in ids, want
+        assert succ > 0
+
+    def test_privileged_line_attribution(self):
+        fails, _ = scan_kubernetes("pod.yaml", POD)
+        priv = next(f for f in fails if f.id == "KSV017")
+        # securityContext block is lines 9-10
+        assert priv.cause_metadata.start_line in (9, 10)
+        assert priv.cause_metadata.provider == "Kubernetes"
+        assert priv.avd_id == "AVD-KSV-0017"
+        assert any(ln.is_cause for ln in priv.cause_metadata.code.lines)
+
+    def test_good_pod_is_mostly_clean(self):
+        fails, succ = scan_kubernetes("pod.yaml", GOOD_POD)
+        ids = _ids(fails)
+        for clean in ("KSV001", "KSV003", "KSV011", "KSV012", "KSV013",
+                      "KSV014", "KSV015", "KSV016", "KSV017", "KSV018",
+                      "KSV020", "KSV021", "KSV030"):
+            assert clean not in ids, clean
+        assert succ >= 13
+
+    def test_deployment_template_walked(self):
+        dep = textwrap.dedent("""\
+            apiVersion: apps/v1
+            kind: Deployment
+            metadata: {name: web}
+            spec:
+              template:
+                spec:
+                  hostNetwork: true
+                  containers:
+                  - name: c
+                    image: app:1.0
+        """).encode()
+        fails, _ = scan_kubernetes("dep.yaml", dep)
+        assert "KSV009" in _ids(fails)
+
+    def test_cronjob_nested_template(self):
+        cj = textwrap.dedent("""\
+            apiVersion: batch/v1
+            kind: CronJob
+            metadata: {name: tick}
+            spec:
+              jobTemplate:
+                spec:
+                  template:
+                    spec:
+                      hostPID: true
+                      containers:
+                      - name: c
+                        image: app:1.0
+        """).encode()
+        fails, _ = scan_kubernetes("cj.yaml", cj)
+        assert "KSV010" in _ids(fails)
+
+    def test_multi_doc_and_non_workload_skipped(self):
+        text = POD + b"---\napiVersion: v1\nkind: Service\n" \
+            b"metadata: {name: svc}\nspec: {ports: []}\n"
+        fails, _ = scan_kubernetes("all.yaml", text)
+        assert "KSV017" in _ids(fails)
+
+    def test_inline_ignore(self):
+        y = POD.replace(
+            b"      privileged: true",
+            b"      #trivy:ignore:KSV017\n      privileged: true")
+        assert b"ignore" in y
+        fails, _ = scan_kubernetes("pod.yaml", y)
+        assert "KSV017" not in _ids(fails)
+
+
+CFN = textwrap.dedent("""\
+    AWSTemplateFormatVersion: "2010-09-09"
+    Parameters:
+      Name:
+        Type: String
+        Default: data
+    Resources:
+      Bucket:
+        Type: AWS::S3::Bucket
+        Properties:
+          BucketName: !Sub "${Name}-bucket"
+          AccessControl: PublicRead
+      SG:
+        Type: AWS::EC2::SecurityGroup
+        Properties:
+          GroupDescription: web
+          SecurityGroupIngress:
+          - CidrIp: 0.0.0.0/0
+            IpProtocol: tcp
+          SecurityGroupEgress:
+          - CidrIp: 10.0.0.0/8
+            Description: internal
+      Trail:
+        Type: AWS::CloudTrail::Trail
+        Properties:
+          IsLogging: true
+          S3BucketName: !Ref Bucket
+""").encode()
+
+
+class TestCloudFormation:
+    def test_findings(self):
+        fails, succ = scan_cloudformation("t.yaml", CFN)
+        ids = {f.avd_id for f in fails}
+        assert "AVD-AWS-0092" in ids       # public ACL
+        assert "AVD-AWS-0107" in ids       # public ingress
+        assert "AVD-AWS-0014" in ids       # single-region trail
+        assert "AVD-AWS-0016" in ids       # no log validation
+        assert "AVD-AWS-0104" not in ids   # egress is internal-only
+        assert succ > 0
+
+    def test_intrinsics_resolution(self):
+        fails, _ = scan_cloudformation("t.yaml", CFN)
+        acl = next(f for f in fails if f.avd_id == "AVD-AWS-0092")
+        assert "public-read" in acl.message
+        assert acl.cause_metadata.start_line == 11
+
+    def test_json_template(self):
+        import json
+        tmpl = {
+            "Resources": {"V": {"Type": "AWS::EC2::Volume",
+                                "Properties": {"Size": 10}}}}
+        fails, _ = scan_cloudformation(
+            "t.json", json.dumps(tmpl).encode())
+        assert "AVD-AWS-0026" in {f.avd_id for f in fails}
+
+    def test_clean_bucket(self):
+        good = textwrap.dedent("""\
+            Resources:
+              B:
+                Type: AWS::S3::Bucket
+                Properties:
+                  BucketEncryption:
+                    ServerSideEncryptionConfiguration: []
+                  VersioningConfiguration: {Status: Enabled}
+                  LoggingConfiguration: {}
+                  PublicAccessBlockConfiguration:
+                    BlockPublicAcls: true
+                    BlockPublicPolicy: true
+                    IgnorePublicAcls: true
+                    RestrictPublicBuckets: true
+        """).encode()
+        fails, _ = scan_cloudformation("t.yaml", good)
+        assert not [f for f in fails
+                    if f.cause_metadata.service == "s3"]
+
+
+class TestUnknownSemantics:
+    """Unresolvable values must pass checks like rego undefined."""
+
+    def test_if_intrinsic_on_sequence_is_unknown(self):
+        t = textwrap.dedent("""\
+            Resources:
+              V:
+                Type: AWS::EC2::Volume
+                Properties:
+                  Encrypted: !If [C, true, true]
+        """).encode()
+        fails, _ = scan_cloudformation("t.yaml", t)
+        assert "AVD-AWS-0026" not in {f.avd_id for f in fails}
+
+    def test_unresolved_ref_in_public_access_block(self):
+        t = textwrap.dedent("""\
+            Parameters:
+              P: {Type: String}
+            Resources:
+              B:
+                Type: AWS::S3::Bucket
+                Properties:
+                  PublicAccessBlockConfiguration:
+                    BlockPublicAcls: !Ref P
+                    BlockPublicPolicy: true
+                    IgnorePublicAcls: true
+                    RestrictPublicBuckets: true
+        """).encode()
+        fails, _ = scan_cloudformation("t.yaml", t)
+        assert "AVD-AWS-0086" not in {f.avd_id for f in fails}
+
+    def test_imds_tokens_required_passes(self):
+        t = textwrap.dedent("""\
+            Resources:
+              I:
+                Type: AWS::EC2::Instance
+                Properties:
+                  MetadataOptions: {HttpTokens: required}
+        """).encode()
+        fails, _ = scan_cloudformation("t.yaml", t)
+        assert "AVD-AWS-0028" not in {f.avd_id for f in fails}
+
+    def test_imds_tokens_missing_fails(self):
+        t = textwrap.dedent("""\
+            Resources:
+              I:
+                Type: AWS::EC2::Instance
+                Properties:
+                  ImageId: ami-123
+        """).encode()
+        fails, _ = scan_cloudformation("t.yaml", t)
+        assert "AVD-AWS-0028" in {f.avd_id for f in fails}
+
+
+class TestMalformedManifests:
+    def test_null_spec_does_not_crash(self):
+        for y in (b"apiVersion: apps/v1\nkind: Deployment\n"
+                  b"metadata: {name: x}\nspec:\n",
+                  b"apiVersion: apps/v1\nkind: Deployment\n"
+                  b"metadata: {name: x}\nspec: {template: null}\n",
+                  b"apiVersion: batch/v1\nkind: CronJob\n"
+                  b"metadata: {name: x}\nspec: {jobTemplate: 3}\n",
+                  b"kind: Pod\napiVersion: v1\nspec: [1,2]\n"):
+            fails, succ = scan_kubernetes("d.yaml", y)
+            assert fails == [] and succ == 0
+
+    def test_unknown_pab_passes(self):
+        t = textwrap.dedent("""\
+            Resources:
+              B:
+                Type: AWS::S3::Bucket
+                Properties:
+                  PublicAccessBlockConfiguration: !If [C, {}, {}]
+        """).encode()
+        fails, _ = scan_cloudformation("t.yaml", t)
+        ids = {f.avd_id for f in fails}
+        for pab_id in ("AVD-AWS-0086", "AVD-AWS-0087", "AVD-AWS-0091",
+                       "AVD-AWS-0093"):
+            assert pab_id not in ids, pab_id
+
+
+class TestKSV012Override:
+    def test_container_false_overrides_pod_true(self):
+        y = textwrap.dedent("""\
+            apiVersion: v1
+            kind: Pod
+            metadata: {name: p}
+            spec:
+              securityContext: {runAsNonRoot: true}
+              containers:
+              - name: c
+                image: a:1
+                securityContext: {runAsNonRoot: false}
+        """).encode()
+        fails, _ = scan_kubernetes("p.yaml", y)
+        assert "KSV012" in {f.id for f in fails}
+
+    def test_pod_level_true_inherited(self):
+        y = textwrap.dedent("""\
+            apiVersion: v1
+            kind: Pod
+            metadata: {name: p}
+            spec:
+              securityContext: {runAsNonRoot: true}
+              containers:
+              - name: c
+                image: a:1
+        """).encode()
+        fails, _ = scan_kubernetes("p.yaml", y)
+        assert "KSV012" not in {f.id for f in fails}
+
+
+class TestDetection:
+    def test_k8s(self):
+        assert detect_config_type("pod.yaml", POD) == "kubernetes"
+
+    def test_cfn(self):
+        assert detect_config_type("t.yaml", CFN) == "cloudformation"
+
+    def test_dockerfile(self):
+        assert detect_config_type("Dockerfile", b"FROM x") == "dockerfile"
+
+    def test_terraform_ext(self):
+        assert detect_config_type("main.tf", b"") == "terraform"
+
+    def test_plain_yaml_unmatched(self):
+        assert detect_config_type("vals.yaml", b"a: 1\n") == ""
+
+
+class TestIgnores:
+    def test_same_line_and_next_line(self):
+        text = "resource x {  # trivy:ignore:AVD-AWS-0107\n" \
+               "#trivy:ignore:KSV017\nprivileged: true\n"
+        ig = ignored_ids_by_line(text)
+        assert "AVD-AWS-0107" in ig[1]
+        assert "KSV017" in ig[3]
+
+
+class TestAnalyzerRouting:
+    def test_misconf_analyzer_routes_k8s(self):
+        from trivy_tpu.fanal.analyzers.misconf import MisconfAnalyzer
+        a = MisconfAnalyzer()
+        assert a.required("deploy.yaml")
+        res = a.analyze("deploy.yaml", POD)
+        assert res is not None
+        mc = res.misconfigurations[0]
+        assert mc.file_type == "kubernetes"
+        assert any(f.id == "KSV017" for f in mc.failures)

@@ -1,11 +1,17 @@
 """Config-file analyzers routing IaC files to the misconfiguration
-scanners (reference pkg/fanal/analyzer/config + pkg/misconf bridge)."""
+scanners (reference pkg/fanal/analyzer/config + pkg/misconf bridge).
+
+Terraform is directory-scoped (a module is evaluated as a whole, like
+the reference's post-analyzer over a composite FS) and handled by the
+filesystem artifact; this per-file analyzer covers dockerfile,
+kubernetes, and cloudformation."""
 
 from __future__ import annotations
 
 from typing import Optional
 
 from ... import types as T
+from ...iac.detection import sniff
 from ...misconf import FILE_TYPES, detect_file_type
 from . import AnalysisResult, Analyzer, register
 
@@ -13,17 +19,17 @@ from . import AnalysisResult, Analyzer, register
 @register
 class MisconfAnalyzer(Analyzer):
     name = "misconf"
-    version = 1
+    version = 2
 
     def required(self, path: str, size: int = -1) -> bool:
         return detect_file_type(path) != ""
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
-        ftype = detect_file_type(path)
+        ftype, docs = sniff(path, content)
         scanner = FILE_TYPES.get(ftype)
         if scanner is None:
             return None
-        failures, successes = scanner(path, content)
+        failures, successes = scanner(path, content, docs=docs)
         if not failures and not successes:
             return None
         result = AnalysisResult()

@@ -1,0 +1,19 @@
+"""IaC misconfiguration engine (reference pkg/iac, 47k LoC of Go).
+
+The reference evaluates rego policies (trivy-checks) over typed cloud
+state adapted from parsed IaC files (pkg/iac/{scanners,adapters,
+providers,rego}).  This package is the native redesign: per-format
+parsers that retain source positions, adapters into a lightweight
+position-carrying cloud-state model, and Python check functions keyed by
+the published AVD IDs so findings line up with the reference's output.
+
+Scanners (reference pkg/iac/scanners/*):
+  kubernetes  — manifest checks (KSV series)
+  cloudformation — YAML/JSON templates with intrinsics (AVD-AWS series)
+  terraform   — HCL2 parse + eval (AVD-AWS series, shared checks)
+  dockerfile  — lives in trivy_tpu.misconf.dockerfile (DS series)
+File-type detection mirrors pkg/iac/detection/detect.go.
+"""
+
+from . import detection  # noqa: F401
+from .detection import detect_config_type  # noqa: F401

@@ -1,0 +1,488 @@
+"""Adapted cloud state + AWS checks shared by the CloudFormation and
+Terraform scanners.
+
+The reference parses each IaC dialect into one typed cloud-state model
+(pkg/iac/adapters → pkg/iac/providers) and evaluates the same rego
+policies against it; this module is that shared half.  Resources are
+normalized to Terraform resource-type names as the lingua franca, with
+each attribute carrying its source range for cause metadata.  Check IDs
+and severities follow the published AVD-AWS series (trivy-checks
+avd.aquasec.com) so findings line up with the reference."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from .core import Check
+
+
+@dataclass
+class Attr:
+    value: object = None
+    rng: tuple = (0, 0)
+
+
+class Unknown:
+    """A value the adapter could not resolve statically (cross-resource
+    reference, runtime input).  Checks treat unknowns as passing, the
+    same way the reference's rego sees undefined."""
+
+    def __repr__(self):
+        return "<unknown>"
+
+
+UNKNOWN = Unknown()
+
+
+@dataclass
+class CloudResource:
+    kind: str                 # terraform-style type, e.g. aws_s3_bucket
+    name: str = ""
+    attrs: dict = field(default_factory=dict)   # str -> Attr
+    rng: tuple = (0, 0)
+
+    def get(self, key, default=None):
+        a = self.attrs.get(key)
+        if a is None or isinstance(a.value, Unknown):
+            return default
+        return a.value
+
+    def val(self, key, default=None):
+        """Raw attribute value — may be Unknown (missing → default)."""
+        a = self.attrs.get(key)
+        return default if a is None else a.value
+
+    def attr_rng(self, key):
+        a = self.attrs.get(key)
+        return a.rng if a is not None and a.rng != (0, 0) else self.rng
+
+    def known(self, key) -> bool:
+        a = self.attrs.get(key)
+        return a is not None and not isinstance(a.value, Unknown)
+
+    def unknown(self, key) -> bool:
+        a = self.attrs.get(key)
+        return a is not None and isinstance(a.value, Unknown)
+
+
+AWS_CHECKS: list[Check] = []
+
+
+def _aws(id_, title, severity, service, description="", resolution=""):
+    def deco(fn):
+        AWS_CHECKS.append(Check(
+            id=id_, avd_id=id_, title=title, severity=severity,
+            description=description, resolution=resolution,
+            provider="AWS", service=service,
+            namespace=f"builtin.aws.{service}.{id_}", fn=fn))
+        return fn
+    return deco
+
+
+def _of(resources, kind):
+    return [r for r in resources if r.kind == kind]
+
+
+def _truthy(v):
+    """Fires only on a KNOWN true — Unknown never satisfies a check."""
+    if isinstance(v, Unknown):
+        return False
+    return v is True or v == "true" or v == "True" or v == 1
+
+
+def _falsy(v):
+    """Fires only on a KNOWN false/missing — Unknown passes, the way the
+    reference's rego treats undefined values."""
+    if isinstance(v, Unknown):
+        return False
+    return not _truthy(v)
+
+
+# --- S3 -------------------------------------------------------------
+
+def _pab_check(id_, title, description, resolution, pab_key, fragment):
+    """The four S3 public-access-block checks share one body shape."""
+    @_aws(id_, title, "HIGH", "s3", description, resolution)
+    def check(resources):
+        for r in _of(resources, "aws_s3_bucket"):
+            if r.unknown("public_access_block"):
+                continue
+            pab = r.get("public_access_block")
+            if pab is None:
+                yield (f"Bucket '{r.name}' does not have a corresponding"
+                       f" public access block.", r.rng)
+            elif _falsy(pab.get(pab_key)):
+                yield (f"Public access block for bucket '{r.name}' does "
+                       f"not {fragment}",
+                       r.attr_rng("public_access_block"))
+    return check
+
+
+_pab_check(
+    "AVD-AWS-0086", "S3 Access block should block public ACLs",
+    "S3 buckets should block public ACLs on buckets and any objects "
+    "they contain.",
+    "Enable blocking any PUT calls with a public ACL specified",
+    "block_public_acls", "block public ACLs")
+_pab_check(
+    "AVD-AWS-0087", "S3 Access block should block public policy",
+    "S3 bucket policy should have block public policy to prevent users "
+    "from putting a policy that enable public access.",
+    "Prevent policies that allow public access being PUT",
+    "block_public_policy", "block public policies")
+_pab_check(
+    "AVD-AWS-0091", "S3 Access Block should Ignore Public Acl",
+    "S3 buckets should ignore public ACLs on buckets and any objects "
+    "they contain.",
+    "Enable ignoring the application of public ACLs in PUT calls",
+    "ignore_public_acls", "ignore public ACLs")
+_pab_check(
+    "AVD-AWS-0093", "S3 Access block should restrict public bucket to "
+    "limit access",
+    "S3 buckets should restrict public policies for the bucket.",
+    "Limit the access to public buckets to only the owner or AWS "
+    "Services (eg; CloudFront)",
+    "restrict_public_buckets", "restrict public buckets")
+
+
+@_aws("AVD-AWS-0088", "Unencrypted S3 bucket", "HIGH", "s3",
+      "S3 Buckets should be encrypted to protect the data that is "
+      "stored within them if access is compromised.",
+      "Configure bucket encryption")
+def _s3_encryption(resources):
+    for r in _of(resources, "aws_s3_bucket"):
+        if _falsy(r.val("encryption_enabled")):
+            yield (f"Bucket '{r.name}' does not have encryption enabled",
+                   r.attr_rng("encryption_enabled"))
+
+
+@_aws("AVD-AWS-0089", "S3 Bucket Logging", "LOW", "s3",
+      "Ensures S3 bucket logging is enabled for S3 buckets",
+      "Add a logging block to the resource to enable access logging")
+def _s3_logging(resources):
+    for r in _of(resources, "aws_s3_bucket"):
+        if _falsy(r.val("logging_enabled")) and \
+                r.get("acl") != "log-delivery-write":
+            yield (f"Bucket '{r.name}' does not have logging enabled",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0090", "S3 Data should be versioned", "MEDIUM", "s3",
+      "Versioning in Amazon S3 is a means of keeping multiple variants "
+      "of an object in the same bucket.",
+      "Enable versioning to protect against accidental/malicious "
+      "removal or modification")
+def _s3_versioning(resources):
+    for r in _of(resources, "aws_s3_bucket"):
+        if _falsy(r.val("versioning_enabled")):
+            yield (f"Bucket '{r.name}' does not have versioning enabled",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0092", "S3 Buckets not publicly accessible through ACL.",
+      "HIGH", "s3",
+      "Buckets should not have ACLs that allow public access",
+      "Don't use canned ACLs or switch to private acl")
+def _s3_public_acl(resources):
+    for r in _of(resources, "aws_s3_bucket"):
+        acl = r.get("acl")
+        if acl in ("public-read", "public-read-write",
+                   "website", "authenticated-read"):
+            yield (f"Bucket '{r.name}' has a public ACL: '{acl}'.",
+                   r.attr_rng("acl"))
+
+
+# --- EC2 / VPC ------------------------------------------------------
+
+def _cidr_public(c):
+    c = str(c)
+    return c in ("0.0.0.0/0", "::/0", "*")
+
+
+@_aws("AVD-AWS-0107", "An ingress security group rule allows traffic "
+      "from /0", "CRITICAL", "ec2",
+      "Opening up ports to connect out to the public internet is "
+      "generally to be avoided. You should restrict access to IP "
+      "addresses or ranges that are explicitly required where possible.",
+      "Set a more restrictive CIDR range")
+def _sg_public_ingress(resources):
+    for r in _of(resources, "aws_security_group"):
+        for rule in r.get("ingress", []):
+            for cidr in rule.get("cidrs", []):
+                if _cidr_public(cidr):
+                    yield (f"Security group rule allows ingress from "
+                           f"public internet.", rule.get("rng", r.rng))
+
+
+@_aws("AVD-AWS-0104", "An egress security group rule allows traffic "
+      "to /0", "CRITICAL", "ec2",
+      "Opening up ports to connect out to the public internet is "
+      "generally to be avoided. You should restrict access to IP "
+      "addresses or ranges that are explicitly required where possible.",
+      "Set a more restrictive CIDR range")
+def _sg_public_egress(resources):
+    for r in _of(resources, "aws_security_group"):
+        for rule in r.get("egress", []):
+            for cidr in rule.get("cidrs", []):
+                if _cidr_public(cidr):
+                    yield (f"Security group rule allows egress to "
+                           f"public internet.", rule.get("rng", r.rng))
+
+
+@_aws("AVD-AWS-0099", "Missing description for security group.",
+      "LOW", "ec2",
+      "Security groups should include a description for auditing "
+      "purposes.",
+      "Add descriptions for all security groups")
+def _sg_description(resources):
+    for r in _of(resources, "aws_security_group"):
+        if not r.get("description"):
+            yield (f"Security group '{r.name}' does not have a "
+                   f"description.", r.rng)
+
+
+@_aws("AVD-AWS-0124", "Missing description for security group rule.",
+      "LOW", "ec2",
+      "Security group rules should include a description for auditing "
+      "purposes.",
+      "Add descriptions for all security groups rules")
+def _sg_rule_description(resources):
+    for r in _of(resources, "aws_security_group"):
+        for key in ("ingress", "egress"):
+            for rule in r.get(key, []):
+                if not rule.get("description"):
+                    yield ("Security group rule does not have a "
+                           "description.", rule.get("rng", r.rng))
+
+
+@_aws("AVD-AWS-0028", "aws_instance should activate session tokens "
+      "for Instance Metadata Service.", "HIGH", "ec2",
+      "IMDS v2 (Instance Metadata Service) introduced session "
+      "authentication tokens which improve security when talking to "
+      "IMDS.",
+      "Enable HTTP token requirement for IMDS")
+def _imds_tokens(resources):
+    for r in _of(resources, "aws_instance"):
+        if r.unknown("metadata_options"):
+            continue
+        mo = r.get("metadata_options")
+        if mo is not None:
+            tokens = mo.get("http_tokens")
+            if isinstance(tokens, Unknown) or tokens == "required" or \
+                    mo.get("http_endpoint") == "disabled":
+                continue
+        yield (f"Instance '{r.name}' does not require IMDS access "
+               f"to require a token",
+               r.attr_rng("metadata_options"))
+
+
+@_aws("AVD-AWS-0131", "Instance with unencrypted block device.",
+      "HIGH", "ec2",
+      "Block devices should be encrypted to ensure sensitive data is "
+      "held securely at rest.",
+      "Turn on encryption for all block devices")
+def _instance_block_device(resources):
+    for r in _of(resources, "aws_instance"):
+        rbd = r.get("root_block_device")
+        if rbd is not None and _falsy(rbd.get("encrypted")):
+            yield (f"Instance '{r.name}' root block device is not "
+                   f"encrypted.", r.attr_rng("root_block_device"))
+        for ebd in r.get("ebs_block_device", []):
+            if _falsy(ebd.get("encrypted")):
+                yield (f"Instance '{r.name}' EBS block device is not "
+                       f"encrypted.", ebd.get("rng", r.rng))
+
+
+@_aws("AVD-AWS-0026", "EBS volumes must be encrypted", "HIGH", "ebs",
+      "By enabling encryption on EBS volumes you protect the volume, "
+      "the disk I/O and any derived snapshots from compromise if "
+      "intercepted.",
+      "Enable encryption of EBS volumes")
+def _ebs_encryption(resources):
+    for r in _of(resources, "aws_ebs_volume"):
+        if _falsy(r.val("encrypted")):
+            yield (f"EBS volume '{r.name}' is not encrypted.", r.rng)
+
+
+# --- RDS ------------------------------------------------------------
+
+@_aws("AVD-AWS-0080", "RDS encryption has not been enabled at a DB "
+      "Instance level.", "HIGH", "rds",
+      "Encryption should be enabled for an RDS Database instances.",
+      "Enable encryption for RDS instances")
+def _rds_encryption(resources):
+    for r in _of(resources, "aws_db_instance"):
+        if _falsy(r.val("storage_encrypted")):
+            yield (f"Instance '{r.name}' does not have storage "
+                   f"encryption enabled.", r.rng)
+
+
+@_aws("AVD-AWS-0077", "RDS Cluster and RDS instance should have backup "
+      "retention longer than default 1 day", "MEDIUM", "rds",
+      "RDS backup retention for clusters defaults to 1 day, this may "
+      "not be enough to identify and respond to an issue.",
+      "Explicitly set the retention period to greater than the default")
+def _rds_backup_retention(resources):
+    for kind in ("aws_db_instance", "aws_rds_cluster"):
+        for r in _of(resources, kind):
+            if r.known("replicate_source_db") or \
+                    r.unknown("backup_retention_period"):
+                continue
+            period = r.get("backup_retention_period", 1)
+            try:
+                period = int(period)
+            except (TypeError, ValueError):
+                continue
+            if period <= 1:
+                yield (f"Instance '{r.name}' has very low backup "
+                       f"retention period.",
+                       r.attr_rng("backup_retention_period"))
+
+
+@_aws("AVD-AWS-0180", "RDS Publicly Accessible", "HIGH", "rds",
+      "Database resources should not publicly available. You should "
+      "limit all access to the minimum that is required for your "
+      "application to function.",
+      "Set the database to not be publicly accessible")
+def _rds_public(resources):
+    for r in _of(resources, "aws_db_instance"):
+        if _truthy(r.get("publicly_accessible")):
+            yield (f"Instance '{r.name}' is exposed publicly.",
+                   r.attr_rng("publicly_accessible"))
+
+
+# --- CloudTrail / EFS / ELB ----------------------------------------
+
+@_aws("AVD-AWS-0014", "Cloudtrail should be enabled in all regions "
+      "when managing a trail", "MEDIUM", "cloudtrail",
+      "When creating Cloudtrail in the AWS Management Console the trail "
+      "is configured by default to be multi-region.",
+      "Enable Cloudtrail in all regions")
+def _trail_multiregion(resources):
+    for r in _of(resources, "aws_cloudtrail"):
+        if _falsy(r.val("is_multi_region_trail")):
+            yield (f"Trail '{r.name}' is not enabled across all regions.",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0016", "Cloudtrail log validation should be enabled to "
+      "prevent tampering of log data", "HIGH", "cloudtrail",
+      "Log validation should be activated on Cloudtrail logs to "
+      "prevent the tampering of the underlying data in the S3 bucket.",
+      "Turn on log validation for Cloudtrail")
+def _trail_validation(resources):
+    for r in _of(resources, "aws_cloudtrail"):
+        if _falsy(r.val("enable_log_file_validation")):
+            yield (f"Trail '{r.name}' does not have log validation "
+                   f"enabled.", r.rng)
+
+
+@_aws("AVD-AWS-0015", "Cloudtrail should be encrypted at rest to "
+      "secure access to sensitive trail data", "HIGH", "cloudtrail",
+      "Cloudtrail logs should be encrypted at rest to secure the "
+      "sensitive data. Cloudtrail logs record all activity that occurs "
+      "in the the account through API calls.",
+      "Enable encryption at rest")
+def _trail_cmk(resources):
+    for r in _of(resources, "aws_cloudtrail"):
+        if not r.unknown("kms_key_id") and \
+                not r.get("kms_key_id"):
+            yield (f"Trail '{r.name}' does not have a cmk set.", r.rng)
+
+
+@_aws("AVD-AWS-0037", "EFS Encryption has not been enabled", "HIGH",
+      "efs",
+      "If your organization is subject to corporate or regulatory "
+      "policies that require encryption of data and metadata at rest, "
+      "we recommend creating a file system that is encrypted at rest.",
+      "Enable encryption for EFS")
+def _efs_encryption(resources):
+    for r in _of(resources, "aws_efs_file_system"):
+        if _falsy(r.val("encrypted")):
+            yield (f"File system '{r.name}' is not encrypted.", r.rng)
+
+
+@_aws("AVD-AWS-0053", "Load balancer is exposed to the internet.",
+      "HIGH", "elb",
+      "There are many scenarios in which you would want to expose a "
+      "load balancer to the wider internet, but this check exists as a "
+      "warning to prevent accidental exposure of internal assets.",
+      "Switch to an internal load balancer or add a tfsec ignore")
+def _elb_public(resources):
+    for r in _of(resources, "aws_lb"):
+        if r.get("load_balancer_type", "application") == "gateway":
+            continue
+        if _falsy(r.val("internal")):
+            yield (f"Load balancer '{r.name}' is exposed publicly.",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0052", "Load balancers should drop invalid headers",
+      "HIGH", "elb",
+      "Passing unknown or invalid headers through to the target poses "
+      "a potential risk of compromise.",
+      "Set drop_invalid_header_fields to true")
+def _elb_invalid_headers(resources):
+    for r in _of(resources, "aws_lb"):
+        if r.get("load_balancer_type", "application") != "application":
+            continue
+        if _falsy(r.val("drop_invalid_header_fields")):
+            yield (f"Application load balancer '{r.name}' is not set to "
+                   f"drop invalid headers.", r.rng)
+
+
+# --- IAM ------------------------------------------------------------
+
+def _policy_docs(r):
+    doc = r.get("policy_document")
+    if isinstance(doc, str):
+        try:
+            doc = json.loads(doc)
+        except Exception:
+            return []
+    return [doc] if isinstance(doc, dict) else []
+
+
+@_aws("AVD-AWS-0057", "IAM policy should avoid use of wildcards and "
+      "instead apply the principle of least privilege", "HIGH", "iam",
+      "You should use the principle of least privilege when defining "
+      "your IAM policies. This means you should specify each exact "
+      "permission required without using wildcards, as this could "
+      "cause the granting of access to certain undesired actions, "
+      "resources and principals.",
+      "Specify the exact permissions required, and to which resources "
+      "they should apply instead of using wildcards.")
+def _iam_wildcards(resources):
+    for kind in ("aws_iam_policy", "aws_iam_role_policy",
+                 "aws_iam_user_policy", "aws_iam_group_policy"):
+        for r in _of(resources, kind):
+            for doc in _policy_docs(r):
+                stmts = doc.get("Statement", [])
+                if isinstance(stmts, dict):
+                    stmts = [stmts]
+                for stmt in stmts:
+                    if not isinstance(stmt, dict) or \
+                            stmt.get("Effect", "Allow") != "Allow":
+                        continue
+                    actions = stmt.get("Action", [])
+                    if isinstance(actions, str):
+                        actions = [actions]
+                    for a in actions:
+                        if str(a).strip() == "*" or \
+                                str(a).endswith(":*"):
+                            yield (f"IAM policy document uses sensitive "
+                                   f"action '{a}' on wildcarded resource"
+                                   f" '{stmt.get('Resource', '*')}'",
+                                   r.attr_rng("policy_document"))
+                            break
+
+
+def run_aws_checks(resources, file_type, text):
+    """→ (failures, successes) for adapted AWS resources."""
+    from .core import run_checks
+
+    def call(check):
+        yield from check.fn(resources)
+
+    return run_checks(AWS_CHECKS, file_type, text, call)

@@ -1,0 +1,440 @@
+"""Kubernetes manifest checks (reference pkg/iac/scanners/kubernetes +
+trivy-checks kubernetes KSV-series policies).
+
+Parses multi-document YAML/JSON manifests with source positions, walks
+pod specs out of every workload kind, and evaluates native
+reimplementations of the published KSV checks — IDs, severities, and
+message shapes follow avd.aquasec.com so output lines up with the
+reference's rego results."""
+
+from __future__ import annotations
+
+import json
+
+from .. import types as T
+from .core import Check, run_checks
+from .yamlpos import PosDict, PosList, load_documents, value_range
+
+_WORKLOAD_KINDS = {
+    "Pod", "Deployment", "StatefulSet", "DaemonSet", "ReplicaSet",
+    "ReplicationController", "Job", "CronJob",
+}
+
+
+def _dig(d, *keys):
+    """Safe nested lookup: any non-dict along the path → None."""
+    for k in keys:
+        if not isinstance(d, dict):
+            return None
+        d = d.get(k)
+    return d
+
+
+def _pod_spec(doc):
+    kind = doc.get("kind")
+    if kind == "Pod":
+        return doc.get("spec")
+    if kind == "CronJob":
+        return _dig(doc, "spec", "jobTemplate", "spec", "template",
+                    "spec")
+    if kind in _WORKLOAD_KINDS:
+        return _dig(doc, "spec", "template", "spec")
+    return None
+
+
+def _containers(spec):
+    """→ [(container_dict, range)] over containers + initContainers."""
+    out = []
+    for key in ("containers", "initContainers"):
+        lst = spec.get(key)
+        if isinstance(lst, PosList):
+            for i, c in enumerate(lst):
+                if isinstance(c, dict):
+                    out.append((c, value_range(lst, i)))
+        elif isinstance(lst, list):
+            out.extend((c, (0, 0)) for c in lst if isinstance(c, dict))
+    return out
+
+
+def _rng(container, key, fallback):
+    r = value_range(container, key)
+    return r if r != (0, 0) else fallback
+
+
+def _sec_ctx(c):
+    sc = c.get("securityContext")
+    return sc if isinstance(sc, dict) else {}
+
+
+def _name(doc):
+    md = doc.get("metadata")
+    if isinstance(md, dict):
+        return md.get("name", "")
+    return ""
+
+
+def _cname(c):
+    return c.get("name", "")
+
+
+class _Ctx:
+    def __init__(self, doc):
+        self.doc = doc
+        self.kind = doc.get("kind", "")
+        self.name = _name(doc)
+        self.spec = _pod_spec(doc) if isinstance(doc, dict) else None
+        self.containers = _containers(self.spec) \
+            if isinstance(self.spec, dict) else []
+
+
+CHECKS: list[Check] = []
+
+
+def _k(id_, title, severity, description="", resolution=""):
+    def deco(fn):
+        CHECKS.append(Check(
+            id=id_, avd_id=f"AVD-{id_[:3]}-{int(id_[3:]):04d}",
+            title=title, severity=severity, description=description,
+            resolution=resolution, provider="Kubernetes",
+            service="general",
+            namespace=f"builtin.kubernetes.{id_}", fn=fn))
+        return fn
+    return deco
+
+
+@_k("KSV001", "Process can elevate its own privileges", "MEDIUM",
+    "A program inside the container can elevate its own privileges and "
+    "run as root.",
+    "Set 'set containers[].securityContext.allowPrivilegeEscalation' "
+    "to 'false'.")
+def _priv_escalation(ctx):
+    for c, crng in ctx.containers:
+        sc = _sec_ctx(c)
+        if sc.get("allowPrivilegeEscalation") is not False:
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'securityContext.allowPrivilegeEscalation'"
+                   f" to false", _rng(c, "securityContext", crng))
+
+
+@_k("KSV003", "Default capabilities not dropped", "LOW",
+    "The container should drop all default capabilities and add only "
+    "those that are needed for its execution.",
+    "Add 'ALL' to containers[].securityContext.capabilities.drop.")
+def _drop_caps(ctx):
+    for c, crng in ctx.containers:
+        caps = _sec_ctx(c).get("capabilities")
+        drop = caps.get("drop") if isinstance(caps, dict) else None
+        names = {str(x).upper() for x in drop} if isinstance(drop, list) \
+            else set()
+        if not ({"ALL", "NET_RAW"} & names):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should add 'ALL' to 'securityContext.capabilities."
+                   f"drop'", crng)
+
+
+@_k("KSV008", "Access to host IPC namespace", "HIGH",
+    "Sharing the host's IPC namespace allows container processes to "
+    "communicate with processes on the host.",
+    "Do not set 'spec.template.spec.hostIPC' to true.")
+def _host_ipc(ctx):
+    if ctx.spec.get("hostIPC") is True:
+        yield (f"{ctx.kind} '{ctx.name}' should not set "
+               f"'spec.template.spec.hostIPC' to true",
+               value_range(ctx.spec, "hostIPC"))
+
+
+@_k("KSV009", "Access to host network", "HIGH",
+    "Sharing the host's network namespace permits processes in the pod "
+    "to communicate with processes bound to the host's loopback adapter.",
+    "Do not set 'spec.template.spec.hostNetwork' to true.")
+def _host_network(ctx):
+    if ctx.spec.get("hostNetwork") is True:
+        yield (f"{ctx.kind} '{ctx.name}' should not set "
+               f"'spec.template.spec.hostNetwork' to true",
+               value_range(ctx.spec, "hostNetwork"))
+
+
+@_k("KSV010", "Access to host PID", "HIGH",
+    "Sharing the host's PID namespace allows visibility on host "
+    "processes, potentially leaking information such as environment "
+    "variables and configuration.",
+    "Do not set 'spec.template.spec.hostPID' to true.")
+def _host_pid(ctx):
+    if ctx.spec.get("hostPID") is True:
+        yield (f"{ctx.kind} '{ctx.name}' should not set "
+               f"'spec.template.spec.hostPID' to true",
+               value_range(ctx.spec, "hostPID"))
+
+
+@_k("KSV011", "CPU not limited", "LOW",
+    "Enforcing CPU limits prevents DoS via resource exhaustion.",
+    "Add a cpu limitation to 'spec.resources.limits.cpu'.")
+def _cpu_limit(ctx):
+    for c, crng in ctx.containers:
+        limits = (c.get("resources") or {}).get("limits") \
+            if isinstance(c.get("resources"), dict) else None
+        if not (isinstance(limits, dict) and limits.get("cpu")):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'resources.limits.cpu'",
+                   _rng(c, "resources", crng))
+
+
+@_k("KSV012", "Runs as root user", "MEDIUM",
+    "Force the running image to run as a non-root user to ensure least "
+    "privileges.",
+    "Set 'containers[].securityContext.runAsNonRoot' to true.")
+def _run_as_non_root(ctx):
+    pod_sc = ctx.spec.get("securityContext")
+    pod_val = pod_sc.get("runAsNonRoot") \
+        if isinstance(pod_sc, dict) else None
+    for c, crng in ctx.containers:
+        c_val = _sec_ctx(c).get("runAsNonRoot")
+        # container-level setting overrides the pod-level one
+        effective = c_val if c_val is not None else pod_val
+        if effective is not True:
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'securityContext.runAsNonRoot' to true",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV013", "Image tag ':latest' used", "MEDIUM",
+    "It is best to avoid using the ':latest' image tag when deploying "
+    "containers in production, as it is harder to track which version "
+    "of the image is running.",
+    "Use a specific container image tag that is not 'latest'.")
+def _latest_tag(ctx):
+    for c, crng in ctx.containers:
+        image = str(c.get("image", ""))
+        if not image:
+            continue
+        last = image.split("/")[-1]
+        if "@" in last:
+            continue
+        tag = last.rsplit(":", 1)[1] if ":" in last else ""
+        if tag in ("", "latest"):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should specify an image tag", _rng(c, "image", crng))
+
+
+@_k("KSV014", "Root file system is not read-only", "HIGH",
+    "An immutable root file system prevents applications from writing "
+    "to their local disk.",
+    "Change 'containers[].securityContext.readOnlyRootFilesystem' to "
+    "true.")
+def _readonly_rootfs(ctx):
+    for c, crng in ctx.containers:
+        if _sec_ctx(c).get("readOnlyRootFilesystem") is not True:
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'securityContext.readOnlyRootFilesystem'"
+                   f" to true", _rng(c, "securityContext", crng))
+
+
+@_k("KSV015", "CPU requests not specified", "LOW",
+    "When containers have resource requests specified, the scheduler "
+    "can make better decisions about which nodes to place pods on.",
+    "Set 'containers[].resources.requests.cpu'.")
+def _cpu_request(ctx):
+    for c, crng in ctx.containers:
+        req = (c.get("resources") or {}).get("requests") \
+            if isinstance(c.get("resources"), dict) else None
+        if not (isinstance(req, dict) and req.get("cpu")):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'resources.requests.cpu'",
+                   _rng(c, "resources", crng))
+
+
+@_k("KSV016", "Memory requests not specified", "LOW",
+    "When containers have memory requests specified, the scheduler can "
+    "make better decisions about which nodes to place pods on.",
+    "Set 'containers[].resources.requests.memory'.")
+def _mem_request(ctx):
+    for c, crng in ctx.containers:
+        req = (c.get("resources") or {}).get("requests") \
+            if isinstance(c.get("resources"), dict) else None
+        if not (isinstance(req, dict) and req.get("memory")):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'resources.requests.memory'",
+                   _rng(c, "resources", crng))
+
+
+@_k("KSV017", "Privileged container", "HIGH",
+    "Privileged containers share namespaces with the host system and "
+    "do not offer any security isolation.",
+    "Change 'containers[].securityContext.privileged' to false.")
+def _privileged(ctx):
+    for c, crng in ctx.containers:
+        if _sec_ctx(c).get("privileged") is True:
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'securityContext.privileged' to false",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV018", "Memory not limited", "LOW",
+    "Enforcing memory limits prevents DoS via resource exhaustion.",
+    "Set a limit value under 'containers[].resources.limits.memory'.")
+def _mem_limit(ctx):
+    for c, crng in ctx.containers:
+        limits = (c.get("resources") or {}).get("limits") \
+            if isinstance(c.get("resources"), dict) else None
+        if not (isinstance(limits, dict) and limits.get("memory")):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'resources.limits.memory'",
+                   _rng(c, "resources", crng))
+
+
+@_k("KSV020", "Runs with UID <= 10000", "LOW",
+    "Force the container to run with user ID > 10000 to avoid "
+    "conflicts with the host's user table.",
+    "Set 'containers[].securityContext.runAsUser' to an integer > "
+    "10000.")
+def _low_uid(ctx):
+    pod_sc = ctx.spec.get("securityContext")
+    pod_uid = pod_sc.get("runAsUser") if isinstance(pod_sc, dict) else None
+    for c, crng in ctx.containers:
+        uid = _sec_ctx(c).get("runAsUser", pod_uid)
+        if uid is None or (isinstance(uid, int) and uid <= 10000):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'securityContext.runAsUser' > 10000",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV021", "Runs with GID <= 10000", "LOW",
+    "Force the container to run with group ID > 10000 to avoid "
+    "conflicts with the host's user table.",
+    "Set 'containers[].securityContext.runAsGroup' to an integer > "
+    "10000.")
+def _low_gid(ctx):
+    pod_sc = ctx.spec.get("securityContext")
+    pod_gid = pod_sc.get("runAsGroup") if isinstance(pod_sc, dict) else None
+    for c, crng in ctx.containers:
+        gid = _sec_ctx(c).get("runAsGroup", pod_gid)
+        if gid is None or (isinstance(gid, int) and gid <= 10000):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'securityContext.runAsGroup' > 10000",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV022", "Non-default capabilities added", "MEDIUM",
+    "Adding capabilities beyond the default set increases the risk of "
+    "container breakout.",
+    "Do not set 'spec.containers[].securityContext.capabilities.add'.")
+def _added_caps(ctx):
+    for c, crng in ctx.containers:
+        caps = _sec_ctx(c).get("capabilities")
+        add = caps.get("add") if isinstance(caps, dict) else None
+        if isinstance(add, list) and add:
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should not set 'securityContext.capabilities.add'",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV023", "hostPath volumes mounted", "MEDIUM",
+    "HostPath volumes must be forbidden.",
+    "Do not set 'spec.volumes[*].hostPath'.")
+def _hostpath(ctx):
+    vols = ctx.spec.get("volumes")
+    if not isinstance(vols, list):
+        return
+    for i, v in enumerate(vols):
+        if isinstance(v, dict) and "hostPath" in v:
+            yield (f"{ctx.kind} '{ctx.name}' should not set "
+                   f"'spec.template.volumes.hostPath'",
+                   value_range(vols, i) if isinstance(vols, PosList)
+                   else (0, 0))
+
+
+@_k("KSV025", "SELinux custom options set", "MEDIUM",
+    "Setting a custom SELinux user or role option should be forbidden.",
+    "Do not set 'spec.securityContext.seLinuxOptions', "
+    "'spec.containers[*].securityContext.seLinuxOptions'.")
+def _selinux(ctx):
+    pod_sc = ctx.spec.get("securityContext")
+    if isinstance(pod_sc, dict) and "seLinuxOptions" in pod_sc:
+        opts = pod_sc["seLinuxOptions"]
+        if isinstance(opts, dict) and (opts.get("user") or
+                                       opts.get("role")):
+            yield (f"{ctx.kind} '{ctx.name}' should not set a custom "
+                   f"SELinux user or role",
+                   value_range(pod_sc, "seLinuxOptions"))
+    for c, crng in ctx.containers:
+        opts = _sec_ctx(c).get("seLinuxOptions")
+        if isinstance(opts, dict) and (opts.get("user") or
+                                       opts.get("role")):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should not set a custom SELinux user or role",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV030", "Runtime/default seccomp profile not set", "LOW",
+    "The runtime default seccomp profile must be required, or allow "
+    "specific additional profiles.",
+    "Set 'spec.securityContext.seccompProfile.type' to 'RuntimeDefault'"
+    " or 'Localhost'.")
+def _seccomp(ctx):
+    pod_sc = ctx.spec.get("securityContext")
+    pod_type = ""
+    if isinstance(pod_sc, dict):
+        prof = pod_sc.get("seccompProfile")
+        if isinstance(prof, dict):
+            pod_type = str(prof.get("type", ""))
+    for c, crng in ctx.containers:
+        prof = _sec_ctx(c).get("seccompProfile")
+        ctype = str(prof.get("type", "")) if isinstance(prof, dict) else ""
+        eff = ctype or pod_type
+        if eff not in ("RuntimeDefault", "Localhost"):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} '{ctx.name}' "
+                   f"should set 'securityContext.seccompProfile.type' to"
+                   f" 'RuntimeDefault'", _rng(c, "securityContext", crng))
+
+
+@_k("KSV104", "Seccomp profile unconfined", "MEDIUM",
+    "Seccomp profile must not be explicitly set to 'Unconfined'.",
+    "Do not set seccomp profile to 'Unconfined'.")
+def _seccomp_unconfined(ctx):
+    scopes = [(ctx.spec.get("securityContext"), ctx.spec, "securityContext")]
+    scopes += [(_sec_ctx(c), c, "securityContext")
+               for c, _ in ctx.containers]
+    for sc, holder, key in scopes:
+        if not isinstance(sc, dict):
+            continue
+        prof = sc.get("seccompProfile")
+        if isinstance(prof, dict) and \
+                str(prof.get("type", "")) == "Unconfined":
+            yield (f"{ctx.kind} '{ctx.name}' should not set seccomp "
+                   f"profile to 'Unconfined'", value_range(holder, key))
+
+
+def scan_kubernetes(path: str, content: bytes, lines=None,
+                    docs=None) -> tuple[list, int]:
+    """→ (failures, successes) over all workload documents in the file.
+    `docs` carries pre-parsed documents from detection.sniff."""
+    text = content.decode("utf-8", errors="replace")
+    if docs is None:
+        if path.endswith(".json"):
+            try:
+                raw = json.loads(text)
+            except Exception:
+                return [], 0
+            docs = raw if isinstance(raw, list) else [raw]
+        else:
+            docs = load_documents(text)
+    contexts = []
+    for doc in docs:
+        if not isinstance(doc, dict) or doc.get("kind") is None:
+            continue
+        items = doc.get("items")
+        subdocs = items if doc.get("kind") == "List" and \
+            isinstance(items, list) else [doc]
+        for d in subdocs:
+            if isinstance(d, dict) and d.get("kind") in _WORKLOAD_KINDS:
+                ctx = _Ctx(d)
+                if isinstance(ctx.spec, dict):
+                    contexts.append(ctx)
+    if not contexts:
+        return [], 0
+
+    def call(check):
+        for ctx in contexts:
+            yield from check.fn(ctx)
+
+    return run_checks(CHECKS, "kubernetes", text, call)

@@ -1,22 +1,41 @@
 """Misconfiguration scanning (reference pkg/misconf + pkg/iac).
 
-The reference's IaC stack is a 47k-LoC OPA/rego engine (SURVEY.md §2.4)
-scheduled last in the build plan; this package establishes the pipeline —
-file-type detection, per-type scanners, DetectedMisconfiguration results
-with cause locations — with native Python checks for Dockerfiles first.
-Terraform/CloudFormation/K8s scanners slot in behind the same interface.
-"""
+Bridges fanal config analyzers to the IaC engine: file-type detection
+(pkg/iac/detection), per-type scanners (dockerfile native checks here;
+kubernetes/cloudformation/terraform in trivy_tpu.iac), and
+DetectedMisconfiguration results with cause locations."""
 
-from .dockerfile import scan_dockerfile  # noqa: F401
+from .dockerfile import scan_dockerfile as _scan_dockerfile
+
+
+def scan_dockerfile(path, content, lines=None, docs=None):
+    return _scan_dockerfile(path, content, lines)
+
+
+def _scan_kubernetes(path, content, lines=None, docs=None):
+    from ..iac.kubernetes import scan_kubernetes
+    return scan_kubernetes(path, content, lines, docs=docs)
+
+
+def _scan_cloudformation(path, content, lines=None, docs=None):
+    from ..iac.cloudformation import scan_cloudformation
+    return scan_cloudformation(path, content, lines, docs=docs)
+
 
 FILE_TYPES = {
     "dockerfile": scan_dockerfile,
+    "kubernetes": _scan_kubernetes,
+    "cloudformation": _scan_cloudformation,
 }
 
 
 def detect_file_type(path: str) -> str:
+    """Path-only pre-gate; content sniffing happens in the analyzer
+    (detection.sniff)."""
     base = path.rsplit("/", 1)[-1].lower()
     if base == "dockerfile" or base.startswith("dockerfile.") or \
             base.endswith(".dockerfile"):
         return "dockerfile"
+    if base.endswith((".yaml", ".yml", ".json", ".tf", ".tf.json")):
+        return "candidate"
     return ""
