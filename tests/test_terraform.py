@@ -1,0 +1,293 @@
+"""HCL parser/evaluator + terraform module scanner
+(reference pkg/iac/scanners/terraform)."""
+
+import textwrap
+
+from trivy_tpu.iac.cloud import Unknown
+from trivy_tpu.iac.hcl import HclError, Scope, evaluate, parse
+from trivy_tpu.iac.terraform import (TfModule, adapt_terraform,
+                                     scan_terraform_files,
+                                     scan_terraform_module)
+
+
+def ev(src, variables=None, locals_=None):
+    body = parse(f"x = {src}")
+    return evaluate(body.attrs[0].expr,
+                    Scope(variables=variables, locals_=locals_))
+
+
+class TestHclExpressions:
+    def test_arithmetic_precedence(self):
+        assert ev("2 + 3 * 4") == 14
+        assert ev("(2 + 3) * 4") == 20
+        assert ev("10 / 4") == 2.5
+        assert ev("7 % 3") == 1
+
+    def test_comparison_and_logic(self):
+        assert ev("1 < 2 && 3 >= 3") is True
+        assert ev('"a" == "a" || false') is True
+        assert ev("!true") is False
+
+    def test_conditional(self):
+        assert ev('true ? "y" : "n"') == "y"
+        assert ev('1 > 2 ? "y" : "n"') == "n"
+
+    def test_string_interpolation(self):
+        assert ev('"a-${1 + 1}-b"') == "a-2-b"
+        assert ev('"v=${var.env}"', {"env": "prod"}) == "v=prod"
+        # escaped interpolation stays literal
+        assert ev('"lit-$${x}"') == "lit-${x}"
+
+    def test_unknown_propagates(self):
+        assert isinstance(ev("var.missing"), Unknown)
+        assert isinstance(ev("var.missing + 1"), Unknown)
+        assert isinstance(ev('"x-${var.missing}"'), Unknown)
+
+    def test_functions(self):
+        assert ev('lower("ABC")') == "abc"
+        assert ev('length([1, 2, 3])') == 3
+        assert ev('join("-", ["a", "b"])') == "a-b"
+        assert ev('concat([1], [2, 3])') == [1, 2, 3]
+        assert ev('merge({a = 1}, {b = 2})') == {"a": 1, "b": 2}
+        assert ev('lookup({a = 1}, "a", 0)') == 1
+        assert ev('lookup({a = 1}, "z", 0)') == 0
+        assert ev('jsonencode({x = true})') == '{"x":true}'
+        assert ev('contains(["a"], "a")') is True
+        assert ev('coalesce("", "b")') == "b"
+        assert ev('element(["a", "b"], 1)') == "b"
+
+    def test_try_and_can(self):
+        assert ev('try(var.missing, "fallback")') == "fallback"
+        assert ev('try("first", "second")') == "first"
+
+    def test_heredoc(self):
+        body = parse('x = <<EOF\nline1\nline2\nEOF\n')
+        assert evaluate(body.attrs[0].expr, Scope()) == "line1\nline2"
+
+    def test_list_and_map_literals(self):
+        assert ev('[1, "two", true]') == [1, "two", True]
+        assert ev('{a = 1, "b" = 2}') == {"a": 1, "b": 2}
+
+    def test_for_expression_is_unknown(self):
+        assert isinstance(ev("[for x in var.xs : x]"), Unknown)
+
+    def test_unterminated_string_raises(self):
+        import pytest
+        with pytest.raises(HclError):
+            parse('x = "unterminated')
+
+
+class TestHclStructure:
+    def test_blocks_and_lines(self):
+        body = parse(textwrap.dedent("""\
+            resource "aws_s3_bucket" "b" {
+              acl = "private"
+              versioning {
+                enabled = true
+              }
+            }
+        """))
+        blk = body.blocks[0]
+        assert blk.type == "resource"
+        assert blk.labels == ["aws_s3_bucket", "b"]
+        assert (blk.start, blk.end) == (1, 6)
+        assert blk.body.attrs[0].name == "acl"
+        assert blk.body.attrs[0].start == 2
+        assert blk.body.blocks[0].type == "versioning"
+
+    def test_comments_ignored(self):
+        body = parse("# c1\n// c2\n/* c3 */\na = 1\n")
+        assert body.attrs[0].name == "a"
+
+
+class TestTfModule:
+    def test_locals_fixpoint_and_tfvars(self):
+        m = TfModule({
+            "main.tf": 'variable "env" { default = "dev" }\n'
+                       'locals {\n'
+                       '  a = "x-${local.b}"\n'
+                       '  b = var.env\n'
+                       '}\n',
+            "terraform.tfvars": 'env = "prod"\n',
+        })
+        assert m.variables["env"] == "prod"
+        assert m.locals["b"] == "prod"
+        assert m.locals["a"] == "x-prod"
+
+    def test_resource_attrs_evaluated(self):
+        m = TfModule({"main.tf": (
+            'resource "aws_db_instance" "d" {\n'
+            '  storage_encrypted = true\n'
+            '  backup_retention_period = 7 + 7\n'
+            '}\n')})
+        res = m.resources[0]
+        assert res.value("storage_encrypted") is True
+        assert res.value("backup_retention_period") == 14
+
+
+TF_BAD = {
+    "main.tf": textwrap.dedent("""\
+        resource "aws_s3_bucket" "logs" {
+          acl = "public-read-write"
+        }
+
+        resource "aws_security_group" "open" {
+          ingress {
+            cidr_blocks = ["0.0.0.0/0"]
+          }
+        }
+
+        resource "aws_instance" "i" {
+          ami = "ami-1234"
+        }
+    """).encode(),
+}
+
+
+class TestTerraformScan:
+    def test_failures_reported(self):
+        recs = scan_terraform_files(TF_BAD)
+        assert len(recs) == 1
+        ids = {f.avd_id for f in recs[0].failures}
+        assert "AVD-AWS-0092" in ids    # public ACL
+        assert "AVD-AWS-0107" in ids    # open ingress
+        assert "AVD-AWS-0099" in ids    # sg missing description
+        assert "AVD-AWS-0124" in ids    # rule missing description
+        assert "AVD-AWS-0028" in ids    # no IMDSv2
+        assert recs[0].successes > 0
+
+    def test_companion_resources_joined(self):
+        files = {"main.tf": textwrap.dedent("""\
+            resource "aws_s3_bucket" "b" {
+              bucket = "b"
+            }
+            resource "aws_s3_bucket_public_access_block" "b" {
+              bucket                  = aws_s3_bucket.b.id
+              block_public_acls       = true
+              block_public_policy     = true
+              ignore_public_acls      = true
+              restrict_public_buckets = true
+            }
+            resource "aws_s3_bucket_server_side_encryption_configuration" "b" {
+              bucket = aws_s3_bucket.b.id
+              rule {}
+            }
+            resource "aws_s3_bucket_versioning" "b" {
+              bucket = aws_s3_bucket.b.id
+              versioning_configuration {
+                status = "Enabled"
+              }
+            }
+        """)}
+        per_file = scan_terraform_module(files)
+        fails, succ = per_file["main.tf"]
+        ids = {f.avd_id for f in fails}
+        for clean in ("AVD-AWS-0086", "AVD-AWS-0087", "AVD-AWS-0091",
+                      "AVD-AWS-0093", "AVD-AWS-0088", "AVD-AWS-0090"):
+            assert clean not in ids, clean
+
+    def test_sg_rule_resource_joined(self):
+        files = {"main.tf": textwrap.dedent("""\
+            resource "aws_security_group" "g" {
+              description = "g"
+            }
+            resource "aws_security_group_rule" "r" {
+              type              = "ingress"
+              security_group_id = aws_security_group.g.id
+              cidr_blocks       = ["0.0.0.0/0"]
+              description       = "open"
+            }
+        """)}
+        per_file = scan_terraform_module(files)
+        fails, _ = per_file["main.tf"]
+        assert "AVD-AWS-0107" in {f.avd_id for f in fails}
+
+    def test_unknown_variable_passes(self):
+        files = {"main.tf": (
+            'variable "enc" {}\n'
+            'resource "aws_ebs_volume" "v" {\n'
+            '  encrypted = var.enc\n'
+            '}\n').encode()}
+        recs = scan_terraform_files(files)
+        ids = {f.avd_id for r in recs for f in r.failures}
+        assert "AVD-AWS-0026" not in ids
+
+    def test_inline_ignore(self):
+        files = {"main.tf": (
+            '#trivy:ignore:AVD-AWS-0092\n'
+            'resource "aws_s3_bucket" "b" {\n'
+            '  acl = "public-read"\n'
+            '}\n').encode()}
+        recs = scan_terraform_files(files)
+        ids = {f.avd_id for r in recs for f in r.failures}
+        # ignore targets the resource line; acl finding anchors there?
+        # the acl attr is line 3, the ignore covers line 2 — expect
+        # the finding to remain (anchored at attr line), so ignore on
+        # the attr line itself must suppress:
+        files2 = {"main.tf": (
+            'resource "aws_s3_bucket" "b" {\n'
+            '  #trivy:ignore:AVD-AWS-0092\n'
+            '  acl = "public-read"\n'
+            '}\n').encode()}
+        recs2 = scan_terraform_files(files2)
+        ids2 = {f.avd_id for r in recs2 for f in r.failures}
+        assert "AVD-AWS-0092" not in ids2
+
+    def test_multi_module_directories(self):
+        files = {
+            "a/main.tf": b'resource "aws_ebs_volume" "v" {}\n',
+            "b/main.tf": b'resource "aws_ebs_volume" "w" '
+                         b'{ encrypted = true }\n',
+        }
+        recs = scan_terraform_files(files)
+        by_path = {r.file_path: r for r in recs}
+        assert any(f.avd_id == "AVD-AWS-0026"
+                   for f in by_path["a/main.tf"].failures)
+        assert not any(f.avd_id == "AVD-AWS-0026"
+                       for f in by_path.get(
+                           "b/main.tf",
+                           type("R", (), {"failures": []})).failures)
+
+
+class TestAdapter:
+    def test_alb_and_cloudtrail(self):
+        m = TfModule({"main.tf": (
+            'resource "aws_lb" "l" {\n'
+            '  internal = false\n'
+            '  load_balancer_type = "application"\n'
+            '}\n'
+            'resource "aws_cloudtrail" "t" {\n'
+            '  is_multi_region_trail = true\n'
+            '  enable_log_file_validation = true\n'
+            '  kms_key_id = "arn:aws:kms:::key/1"\n'
+            '}\n')})
+        rs = {r.kind: r for r in adapt_terraform(m)}
+        assert rs["aws_lb"].get("internal") is False
+        assert rs["aws_cloudtrail"].get("kms_key_id")
+
+    def test_instance_metadata_options(self):
+        m = TfModule({"main.tf": (
+            'resource "aws_instance" "i" {\n'
+            '  metadata_options {\n'
+            '    http_tokens = "required"\n'
+            '  }\n'
+            '  root_block_device {\n'
+            '    encrypted = true\n'
+            '  }\n'
+            '}\n')})
+        r = adapt_terraform(m)[0]
+        assert r.get("metadata_options")["http_tokens"] == "required"
+        assert r.get("root_block_device")["encrypted"] is True
+
+
+class TestPostAnalyzerWiring:
+    def test_fs_walk_runs_terraform(self, tmp_path):
+        (tmp_path / "main.tf").write_text(
+            'resource "aws_s3_bucket" "b" {\n  acl = "public-read"\n}\n')
+        from trivy_tpu.fanal.analyzers import AnalyzerGroup
+        from trivy_tpu.fanal.walker import walk_fs
+        scan = walk_fs(str(tmp_path), AnalyzerGroup())
+        mcs = scan.result.misconfigurations
+        assert any(m.file_type == "terraform" and
+                   any(f.avd_id == "AVD-AWS-0092" for f in m.failures)
+                   for m in mcs)

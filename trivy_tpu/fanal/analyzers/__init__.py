@@ -68,11 +68,32 @@ class Analyzer:
         raise NotImplementedError
 
 
+class PostAnalyzer:
+    """Multi-file analyzer run after the walk over all collected files
+    (reference pkg/fanal/analyzer PostAnalyzer over a composite FS) —
+    used where one result needs several files, e.g. a terraform
+    module."""
+    name = "base-post"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        raise NotImplementedError
+
+    def post_analyze(self, files: dict) -> Optional[AnalysisResult]:
+        raise NotImplementedError
+
+
 _REGISTRY: dict[str, type] = {}
+_POST_REGISTRY: dict[str, type] = {}
 
 
 def register(cls):
     _REGISTRY[cls.name] = cls
+    return cls
+
+
+def register_post(cls):
+    _POST_REGISTRY[cls.name] = cls
     return cls
 
 
@@ -92,18 +113,37 @@ class AnalyzerGroup:
         _ensure_loaded()
         self.analyzers = [cls() for name, cls in sorted(_REGISTRY.items())
                           if name not in disabled]
+        self.post_analyzers = [
+            cls() for name, cls in sorted(_POST_REGISTRY.items())
+            if name not in disabled]
 
     def versions(self) -> dict[str, int]:
         """name → version, for cache keys."""
-        return {a.name: a.version for a in self.analyzers}
+        out = {a.name: a.version for a in self.analyzers}
+        out.update({a.name: a.version for a in self.post_analyzers})
+        return out
 
     def required(self, path: str, size: int = -1) -> bool:
         return any(a.required(path, size) for a in self.analyzers)
+
+    def post_required(self, path: str, size: int = -1) -> bool:
+        return any(a.required(path, size) for a in self.post_analyzers)
 
     def analyze_file(self, path: str, content: bytes,
                      result: AnalysisResult) -> None:
         for a in self.analyzers:
             if a.required(path, len(content)):
                 r = a.analyze(path, content)
+                if r is not None:
+                    result.merge(r)
+
+    def post_analyze(self, files: dict,
+                     result: AnalysisResult) -> None:
+        if not files:
+            return
+        for a in self.post_analyzers:
+            subset = {p: c for p, c in files.items() if a.required(p)}
+            if subset:
+                r = a.post_analyze(subset)
                 if r is not None:
                     result.merge(r)

@@ -13,7 +13,8 @@ from typing import Optional
 from ... import types as T
 from ...iac.detection import sniff
 from ...misconf import FILE_TYPES, detect_file_type
-from . import AnalysisResult, Analyzer, register
+from . import AnalysisResult, Analyzer, PostAnalyzer, register, \
+    register_post
 
 
 @register
@@ -36,4 +37,25 @@ class MisconfAnalyzer(Analyzer):
         result.misconfigurations = [T.Misconfiguration(
             file_type=ftype, file_path=path,
             successes=successes, failures=failures)]
+        return result
+
+
+@register_post
+class TerraformPostAnalyzer(PostAnalyzer):
+    """Module-scoped terraform scanning: all .tf/.tfvars of a directory
+    evaluated together (reference terraform scanner operates on the
+    whole module, not per file)."""
+    name = "terraform"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        return path.endswith((".tf", ".tfvars"))
+
+    def post_analyze(self, files: dict) -> Optional[AnalysisResult]:
+        from ...iac.terraform import scan_terraform_files
+        records = scan_terraform_files(files)
+        if not records:
+            return None
+        result = AnalysisResult()
+        result.misconfigurations = records
         return result

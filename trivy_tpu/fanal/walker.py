@@ -46,6 +46,7 @@ class BlobScan:
     whiteout_files: list = field(default_factory=list)
     opaque_dirs: list = field(default_factory=list)
     secret_files: list = field(default_factory=list)  # [(path, bytes)]
+    post_files: dict = field(default_factory=dict)    # path -> bytes
 
 
 def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
@@ -66,8 +67,9 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
         if not (member.isfile() or member.islnk()):
             continue
         wants = group.required(path, member.size)
+        wants_post = group.post_required(path, member.size)
         wants_secret = collect_secrets and secret_candidate(path, member.size)
-        if not (wants or wants_secret):
+        if not (wants or wants_post or wants_secret):
             continue
         f = tf.extractfile(member)
         if f is None:
@@ -75,8 +77,11 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
         content = f.read()
         if wants:
             group.analyze_file(path, content, scan.result)
+        if wants_post:
+            scan.post_files[path] = content
         if wants_secret and not looks_binary(content):
             scan.secret_files.append((path, content))
+    group.post_analyze(scan.post_files, scan.result)
     return scan
 
 
@@ -95,8 +100,9 @@ def walk_fs(root: str, group: AnalyzerGroup,
             except OSError:
                 continue
             wants = group.required(rel, size)
+            wants_post = group.post_required(rel, size)
             wants_secret = collect_secrets and secret_candidate(rel, size)
-            if not (wants or wants_secret):
+            if not (wants or wants_post or wants_secret):
                 continue
             try:
                 with open(full, "rb") as f:
@@ -105,8 +111,11 @@ def walk_fs(root: str, group: AnalyzerGroup,
                 continue  # permission errors are skipped (walker/fs.go:24-33)
             if wants:
                 group.analyze_file(rel, content, scan.result)
+            if wants_post:
+                scan.post_files[rel] = content
             if wants_secret and not looks_binary(content):
                 scan.secret_files.append((rel, content))
+    group.post_analyze(scan.post_files, scan.result)
     return scan
 
 

@@ -41,6 +41,7 @@ class CloudResource:
     name: str = ""
     attrs: dict = field(default_factory=dict)   # str -> Attr
     rng: tuple = (0, 0)
+    path: str = ""            # source file (multi-file terraform modules)
 
     def get(self, key, default=None):
         a = self.attrs.get(key)

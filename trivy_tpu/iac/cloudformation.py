@@ -27,13 +27,16 @@ def _params(template):
 _SUB_RE = re.compile(r"\$\{([^}]+)\}")
 
 
-def _resolve(value, params):
+def _resolve(value, params, depth=0):
     """Evaluate CFN intrinsics to a concrete value or UNKNOWN."""
+    if depth > 20:
+        # self-referential parameter defaults (P: {Default: !Ref P})
+        return UNKNOWN
     if isinstance(value, dict) and len(value) == 1:
         (key, arg), = value.items()
         if key == "Ref":
             if arg in params:
-                return _resolve(params[arg], params)
+                return _resolve(params[arg], params, depth + 1)
             if isinstance(arg, str) and arg.startswith("AWS::"):
                 return {"AWS::Region": "us-east-1",
                         "AWS::Partition": "aws",
@@ -47,7 +50,7 @@ def _resolve(value, params):
 
             def rep(m):
                 nonlocal ok
-                v = _resolve({"Ref": m.group(1)}, params)
+                v = _resolve({"Ref": m.group(1)}, params, depth + 1)
                 if isinstance(v, Unknown):
                     ok = False
                     return ""
@@ -57,7 +60,7 @@ def _resolve(value, params):
         if key == "Fn::Join":
             if isinstance(arg, list) and len(arg) == 2 and \
                     isinstance(arg[1], list):
-                parts = [_resolve(p, params) for p in arg[1]]
+                parts = [_resolve(p, params, depth + 1) for p in arg[1]]
                 if all(not isinstance(p, Unknown) for p in parts):
                     return str(arg[0]).join(str(p) for p in parts)
             return UNKNOWN
@@ -66,9 +69,10 @@ def _resolve(value, params):
             # resolvable here; unknown passes checks like rego undefined
             return UNKNOWN
     if isinstance(value, dict):
-        return {k: _resolve(v, params) for k, v in value.items()}
+        return {k: _resolve(v, params, depth + 1)
+                for k, v in value.items()}
     if isinstance(value, list):
-        return [_resolve(v, params) for v in value]
+        return [_resolve(v, params, depth + 1) for v in value]
     return value
 
 

@@ -28,6 +28,13 @@ def _is_k8s(data) -> bool:
         "kind" in data
 
 
+# full YAML/JSON composition is paid only for files that could be IaC:
+# bounded size and containing a dialect marker somewhere in the bytes
+# (a cheap substring scan, vs. the full position-aware parse)
+MAX_SNIFF_SIZE = 3 * 1024 * 1024
+_MARKERS = (b"apiVersion", b"AWSTemplateFormatVersion", b"Resources")
+
+
 def sniff(path: str, content: bytes):
     """→ (file_type, parsed_docs | None).  The parsed documents are
     forwarded to the scanner so YAML/JSON is composed only once per file
@@ -39,6 +46,9 @@ def sniff(path: str, content: bytes):
     if base.endswith((".tf", ".tf.json")) or \
             base.endswith("terraform.tfvars"):
         return "terraform", None
+    if len(content) > MAX_SNIFF_SIZE or \
+            not any(m in content for m in _MARKERS):
+        return "", None
     if base.endswith((".yaml", ".yml")):
         text = content.decode("utf-8", errors="replace")
         from .yamlpos import load_documents

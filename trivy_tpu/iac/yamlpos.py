@@ -54,16 +54,22 @@ def _intrinsic_key(tag: str) -> str:
     return _CFN_SHORT.get(tag, "Ref" if name == "Ref" else f"Fn::{name}")
 
 
-def _construct(node):
+_MAX_DEPTH = 200
+
+
+def _construct(node, depth=0):
+    if depth > _MAX_DEPTH:
+        # cyclic alias graph (a: &x [*x]) or absurd nesting — bail out
+        raise yaml.YAMLError("document too deep or cyclic")
     tag = node.tag
     if isinstance(node, yaml.MappingNode):
         out = PosDict()
         out.start, out.end = _node_range(node)
         for knode, vnode in node.value:
-            key = _construct(knode)
+            key = _construct(knode, depth + 1)
             if isinstance(key, (PosDict, PosList)):
                 key = str(key)
-            out[key] = _construct(vnode)
+            out[key] = _construct(vnode, depth + 1)
             out.key_lines[key] = _node_range(vnode)
         if tag.startswith("!"):
             # short-form intrinsic over a mapping body (e.g. !If {...})
@@ -73,7 +79,7 @@ def _construct(node):
         out = PosList()
         out.start, out.end = _node_range(node)
         for item in node.value:
-            out.append(_construct(item))
+            out.append(_construct(item, depth + 1))
             out.item_lines.append(_node_range(item))
         if tag.startswith("!"):
             # short-form intrinsic over a sequence (e.g. !Join [..])
@@ -112,7 +118,7 @@ def load_documents(text: str):
             if node is None:
                 continue
             docs.append(_construct(node))
-    except yaml.YAMLError:
+    except (yaml.YAMLError, RecursionError):
         return []
     return docs
 
