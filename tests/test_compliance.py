@@ -1,0 +1,192 @@
+"""Compliance specs + report assembly (reference pkg/compliance)."""
+
+import json
+import textwrap
+
+from trivy_tpu import types as T
+from trivy_tpu.compliance import (SPECS, build_compliance_report,
+                                  get_spec)
+from trivy_tpu.compliance.report import (to_json_report,
+                                         to_summary_table)
+from trivy_tpu.iac.kubernetes import scan_kubernetes
+
+
+def _misconf_result(mid, avd, sev="HIGH", status="FAIL"):
+    return T.Result(
+        target="deploy.yaml", clazz=T.ResultClass.CONFIG,
+        type="kubernetes",
+        misconfigurations=[T.DetectedMisconfiguration(
+            id=mid, avd_id=avd, severity=sev, status=status,
+            title=mid)])
+
+
+class TestSpecs:
+    def test_builtin_specs_present(self):
+        for sid in ("k8s-cis", "k8s-nsa", "k8s-pss-baseline",
+                    "k8s-pss-restricted", "docker-cis-1.6.0",
+                    "aws-cis-1.4"):
+            assert sid in SPECS
+            assert SPECS[sid].controls
+
+    def test_unknown_spec_raises(self):
+        import pytest
+        with pytest.raises(KeyError):
+            get_spec("nope")
+
+    def test_spec_checks_are_implemented(self):
+        """Every automated KSV/DS/AWS check referenced by a builtin
+        spec must exist in the corresponding scanner."""
+        from trivy_tpu.iac.cloud import AWS_CHECKS
+        from trivy_tpu.iac.kubernetes import CHECKS as K8S
+        from trivy_tpu.misconf.dockerfile import CHECKS as DS
+        known = {c.avd_id for c in K8S} | {c.avd_id for c in AWS_CHECKS} \
+            | {c.avd_id for c in DS}
+        for spec in SPECS.values():
+            for control in spec.controls:
+                for chk in control.checks:
+                    if chk.startswith(("VULN-", "SECRET-")):
+                        continue
+                    assert chk in known, (spec.id, control.id, chk)
+
+
+class TestReport:
+    def test_fail_and_pass_controls(self):
+        spec = get_spec("k8s-nsa")
+        results = [_misconf_result("KSV017", "AVD-KSV-0017")]
+        rep = build_compliance_report(spec, results)
+        by_id = {cr.control.id: cr for cr in rep.results}
+        assert by_id["1.2"].status == "FAIL"
+        assert len(by_id["1.2"].failures) == 1
+        assert by_id["1.0"].status == "PASS"
+
+    def test_manual_controls(self):
+        spec = get_spec("docker-cis-1.6.0")
+        rep = build_compliance_report(spec, [])
+        by_id = {cr.control.id: cr for cr in rep.results}
+        assert by_id["4.2"].status == "MANUAL"
+
+    def test_vuln_pseudo_check(self):
+        spec = get_spec("docker-cis-1.6.0")
+        res = T.Result(
+            target="img", clazz=T.ResultClass.OS_PKGS,
+            vulnerabilities=[T.DetectedVulnerability(
+                vulnerability_id="CVE-1", pkg_name="p",
+                installed_version="1",
+                vulnerability=T.Vulnerability(severity="CRITICAL"))])
+        rep = build_compliance_report(spec, [res])
+        by_id = {cr.control.id: cr for cr in rep.results}
+        assert by_id["4.4"].status == "FAIL"
+
+    def test_summary_table_renders(self):
+        spec = get_spec("k8s-nsa")
+        rep = build_compliance_report(
+            spec, [_misconf_result("KSV017", "AVD-KSV-0017")])
+        table = to_summary_table(rep)
+        assert "1.2" in table and "FAIL" in table and "PASS" in table
+
+    def test_json_report(self):
+        spec = get_spec("aws-cis-1.4")
+        res = _misconf_result("AVD-AWS-0107", "AVD-AWS-0107")
+        doc = json.loads(to_json_report(
+            build_compliance_report(spec, [res])))
+        assert doc["ID"] == "aws-cis-1.4"
+        by_id = {c["ID"]: c for c in doc["Results"]}
+        assert by_id["5.2"]["Status"] == "FAIL"
+        assert by_id["5.2"]["Findings"][0]["ID"] == "AVD-AWS-0107"
+
+
+class TestNewKsvChecks:
+    def test_ksv029_root_gid(self):
+        y = textwrap.dedent("""\
+            apiVersion: v1
+            kind: Pod
+            metadata: {name: p}
+            spec:
+              securityContext: {runAsGroup: 0}
+              containers:
+              - name: c
+                image: a:1
+        """).encode()
+        fails, _ = scan_kubernetes("p.yaml", y)
+        assert "KSV029" in {f.id for f in fails}
+
+    def test_ksv036_sa_token(self):
+        y = textwrap.dedent("""\
+            apiVersion: v1
+            kind: Pod
+            metadata: {name: p}
+            spec:
+              automountServiceAccountToken: false
+              containers:
+              - name: c
+                image: a:1
+        """).encode()
+        fails, _ = scan_kubernetes("p.yaml", y)
+        assert "KSV036" not in {f.id for f in fails}
+
+    def test_ksv103_host_process(self):
+        y = textwrap.dedent("""\
+            apiVersion: v1
+            kind: Pod
+            metadata: {name: p}
+            spec:
+              containers:
+              - name: c
+                image: a:1
+                securityContext:
+                  windowsOptions: {hostProcess: true}
+        """).encode()
+        fails, _ = scan_kubernetes("p.yaml", y)
+        assert "KSV103" in {f.id for f in fails}
+
+    def test_ksv028_volume_types(self):
+        y = textwrap.dedent("""\
+            apiVersion: v1
+            kind: Pod
+            metadata: {name: p}
+            spec:
+              volumes:
+              - name: v
+                nfs: {server: s, path: /x}
+              containers:
+              - name: c
+                image: a:1
+        """).encode()
+        fails, _ = scan_kubernetes("p.yaml", y)
+        assert "KSV028" in {f.id for f in fails}
+
+    def test_ksv002_apparmor_unconfined(self):
+        y = textwrap.dedent("""\
+            apiVersion: v1
+            kind: Pod
+            metadata:
+              name: p
+              annotations:
+                container.apparmor.security.beta.kubernetes.io/c: unconfined
+            spec:
+              containers:
+              - name: c
+                image: a:1
+        """).encode()
+        fails, _ = scan_kubernetes("p.yaml", y)
+        assert "KSV002" in {f.id for f in fails}
+
+
+class TestCustomSpecFile:
+    def test_load_spec_yaml(self, tmp_path):
+        spec_file = tmp_path / "spec.yaml"
+        spec_file.write_text(textwrap.dedent("""\
+            spec:
+              id: my-spec
+              title: Mine
+              version: "1.0"
+              controls:
+              - id: "1"
+                name: no privileged
+                severity: HIGH
+                checks:
+                - id: AVD-KSV-0017
+        """))
+        spec = get_spec(f"@{spec_file}")
+        assert spec.id == "my-spec"
+        assert spec.controls[0].checks == ["AVD-KSV-0017"]

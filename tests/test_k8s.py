@@ -1,0 +1,194 @@
+"""K8s cluster scanning against an in-process fake API server
+(reference pattern: integration client_server tests boot real halves on
+localhost; k8s tests use kind — here a canned-JSON API server)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from trivy_tpu.k8s import KubeClient, load_kubeconfig, scan_cluster
+from trivy_tpu.k8s.kubeconfig import KubeConfig
+from trivy_tpu.k8s.scanner import build_kbom, scan_resource_doc, \
+    summary_table
+
+DEPLOYMENT = {
+    "metadata": {"name": "web", "namespace": "default"},
+    "spec": {"template": {"spec": {
+        "hostNetwork": True,
+        "containers": [{
+            "name": "app", "image": "nginx:latest",
+            "securityContext": {"privileged": True}}],
+    }}},
+}
+
+OWNED_POD = {
+    "metadata": {"name": "web-abc", "namespace": "default",
+                 "ownerReferences": [{"kind": "ReplicaSet",
+                                      "name": "web-1"}]},
+    "spec": {"containers": [{"name": "app", "image": "nginx"}]},
+}
+
+ROUTES = {
+    "/version": {"gitVersion": "v1.28.2"},
+    "/api/v1/namespaces": {"items": [
+        {"metadata": {"name": "default"}}]},
+    "/api/v1/nodes": {"items": [{
+        "metadata": {"name": "node-1"},
+        "status": {"nodeInfo": {
+            "architecture": "amd64", "kernelVersion": "6.1.0",
+            "osImage": "Ubuntu 22.04", "kubeletVersion": "v1.28.2"}},
+    }]},
+    "/apis/apps/v1/deployments": {"items": [DEPLOYMENT]},
+    "/api/v1/pods": {"items": [OWNED_POD]},
+}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        doc = ROUTES.get(self.path.split("?")[0])
+        if doc is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = json.dumps(doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def api_server():
+    srv = HTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+@pytest.fixture
+def client(api_server):
+    return KubeClient(KubeConfig(server=api_server, token="tok"))
+
+
+class TestClient:
+    def test_version_and_namespaces(self, client):
+        assert client.version()["gitVersion"] == "v1.28.2"
+        assert client.namespaces() == ["default"]
+
+    def test_list_workloads_restores_kind(self, client):
+        items = client.list_workloads("Deployment")
+        assert items[0]["kind"] == "Deployment"
+        assert items[0]["apiVersion"] == "apps/v1"
+
+    def test_missing_api_group_raises(self, client):
+        from trivy_tpu.k8s.client import KubeError
+        with pytest.raises(KubeError):
+            client.list_workloads("StatefulSet")
+
+
+class TestScan:
+    def test_scan_cluster_flags_deployment(self, client):
+        results = scan_cluster(client)
+        assert len(results) == 1          # owned pod skipped
+        res = results[0]
+        assert res.target == "default/Deployment/web"
+        ids = {m.id for m in res.misconfigurations}
+        assert "KSV009" in ids and "KSV017" in ids
+
+    def test_resource_doc_result_shape(self):
+        doc = dict(DEPLOYMENT, kind="Deployment",
+                   apiVersion="apps/v1")
+        res = scan_resource_doc(doc)
+        assert res.clazz == "config"
+        assert res.misconf_summary.failures == len(
+            res.misconfigurations)
+
+    def test_summary_table(self, client):
+        results = scan_cluster(client)
+        table = summary_table(results)
+        assert "Deployment/web" in table
+        assert "default" in table
+
+    def test_kbom(self, client):
+        bom = build_kbom(client)
+        assert bom["metadata"]["component"]["version"] == "v1.28.2"
+        node = bom["components"][0]
+        props = {p["name"]: p["value"] for p in node["properties"]}
+        assert props["kubelet_version"] == "v1.28.2"
+
+
+class TestErrorPropagation:
+    def test_auth_failure_raises_not_clean(self, api_server):
+        """401 must not read as an empty, compliant cluster."""
+        from trivy_tpu.k8s.client import KubeError
+
+        class Denying(KubeClient):
+            def get(self, path):
+                raise KubeError(f"GET {path}: HTTP 401", code=401)
+        with pytest.raises(KubeError):
+            scan_cluster(Denying(KubeConfig(server=api_server)))
+
+    def test_404_api_group_skipped(self, client):
+        # StatefulSet route is absent (404) → kind skipped, scan ok
+        results = scan_cluster(client,
+                               kinds=["StatefulSet", "Deployment"])
+        assert len(results) == 1
+
+
+class TestApparmorTemplate:
+    def test_ksv002_in_pod_template(self):
+        doc = {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web"},
+            "spec": {"template": {
+                "metadata": {"annotations": {
+                    "container.apparmor.security.beta.kubernetes.io/"
+                    "app": "unconfined"}},
+                "spec": {"containers": [
+                    {"name": "app", "image": "a:1"}]},
+            }},
+        }
+        res = scan_resource_doc(doc)
+        assert "KSV002" in {m.id for m in res.misconfigurations}
+
+
+class TestKubeconfig:
+    def test_load(self, tmp_path, api_server):
+        cfg_file = tmp_path / "config"
+        cfg_file.write_text(json.dumps({
+            "current-context": "c1",
+            "contexts": [{"name": "c1", "context": {
+                "cluster": "k", "user": "u",
+                "namespace": "prod"}}],
+            "clusters": [{"name": "k", "cluster": {
+                "server": api_server}}],
+            "users": [{"name": "u", "user": {"token": "secret"}}],
+        }))
+        cfg = load_kubeconfig(str(cfg_file))
+        assert cfg.server == api_server
+        assert cfg.token == "secret"
+        assert cfg.namespace == "prod"
+
+    def test_missing_context_raises(self, tmp_path):
+        cfg_file = tmp_path / "config"
+        cfg_file.write_text("clusters: []\ncontexts: []\nusers: []\n")
+        with pytest.raises(ValueError):
+            load_kubeconfig(str(cfg_file))
+
+
+class TestComplianceIntegration:
+    def test_k8s_nsa_over_cluster(self, client):
+        from trivy_tpu.compliance import (build_compliance_report,
+                                          get_spec)
+        results = scan_cluster(client)
+        rep = build_compliance_report(get_spec("k8s-nsa"), results)
+        by_id = {cr.control.id: cr for cr in rep.results}
+        assert by_id["1.2"].status == "FAIL"   # privileged
+        assert by_id["1.5"].status == "FAIL"   # host network

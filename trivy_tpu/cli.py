@@ -46,6 +46,13 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--db", default="",
                    help="columnar advisory DB (.npz) or fixture YAML glob")
     p.add_argument("--pkg-types", default="os,library")
+    p.add_argument("--compliance", default="",
+                   help="compliance spec id (k8s-cis, k8s-nsa, "
+                        "docker-cis-1.6.0, aws-cis-1.4, ...) or "
+                        "@path/to/spec.yaml")
+    p.add_argument("--report", default="summary",
+                   choices=["summary", "all"],
+                   help="compliance report mode")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -93,6 +100,21 @@ def build_parser() -> argparse.ArgumentParser:
                                         "trivy-tpu"))
     p.add_argument("--token", default="")
 
+    p = sub.add_parser("k8s", aliases=["kubernetes"],
+                       help="scan a kubernetes cluster")
+    p.add_argument("target", nargs="?", default="cluster",
+                   help="cluster | all")
+    p.add_argument("--kubeconfig", default="")
+    p.add_argument("--context", default="")
+    p.add_argument("--namespace", "-n", default="")
+    p.add_argument("--report", default="summary",
+                   choices=["summary", "all"])
+    p.add_argument("--format", "-f", default="table",
+                   choices=["table", "json", "cyclonedx"])
+    p.add_argument("--compliance", default="")
+    p.add_argument("--output", "-o", default="")
+    p.add_argument("--exit-code", type=int, default=0)
+
     sub.add_parser("version", help="print version")
     return ap
 
@@ -135,15 +157,28 @@ def _scan_common(args, ref, cache, artifact_type: str) -> int:
     )
     results = filter_results(results, fopts)
 
-    report = build_report(
-        ref.name, artifact_type, results, os_info,
-        metadata=ref.image_metadata or T.Metadata(),
-        created_at=dt.datetime.now(dt.timezone.utc).isoformat())
     out = open(args.output, "w") if args.output else sys.stdout
     try:
-        write_report(report, args.format, out,
-                     template=getattr(args, "template", ""),
-                     app_version=__version__)
+        if getattr(args, "compliance", ""):
+            if args.format not in ("json", "table"):
+                raise SystemExit(
+                    f"--compliance supports --format json/table, "
+                    f"not {args.format}")
+            from .compliance import (build_compliance_report, get_spec,
+                                     write_compliance)
+            spec = get_spec(args.compliance)
+            creport = build_compliance_report(spec, results)
+            write_compliance(creport, mode=args.report,
+                             fmt="json" if args.format == "json"
+                             else "table", output=out)
+        else:
+            report = build_report(
+                ref.name, artifact_type, results, os_info,
+                metadata=ref.image_metadata or T.Metadata(),
+                created_at=dt.datetime.now(dt.timezone.utc).isoformat())
+            write_report(report, args.format, out,
+                         template=getattr(args, "template", ""),
+                         app_version=__version__)
     finally:
         if args.output:
             out.close()
@@ -219,6 +254,48 @@ def cmd_server(args) -> int:
     return 0
 
 
+def cmd_k8s(args) -> int:
+    from .k8s import KubeClient, load_kubeconfig, scan_cluster
+    from .k8s.scanner import build_kbom, summary_table
+    try:
+        cfg = load_kubeconfig(args.kubeconfig, args.context)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"kubeconfig: {e}")
+    client = KubeClient(cfg)
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        if args.format == "cyclonedx":
+            json.dump(build_kbom(client), out, indent=2)
+            out.write("\n")
+            return 0
+        results = scan_cluster(client,
+                               args.namespace or cfg.namespace)
+        if args.compliance:
+            from .compliance import (build_compliance_report, get_spec,
+                                     write_compliance)
+            spec = get_spec(args.compliance)
+            creport = build_compliance_report(spec, results)
+            write_compliance(creport, mode=args.report,
+                             fmt="json" if args.format == "json"
+                             else "table", output=out)
+        elif args.format == "json" or args.report == "all":
+            report = build_report(
+                "k8s cluster", "kubernetes", results, T.OS(),
+                created_at=dt.datetime.now(
+                    dt.timezone.utc).isoformat())
+            write_report(report, "json", out, app_version=__version__)
+        else:
+            out.write(summary_table(results))
+        if args.exit_code and any(r.misconfigurations
+                                  for r in results):
+            return args.exit_code
+        return 0
+    finally:
+        cfg.cleanup()  # inline key material must not outlive the scan
+        if args.output:
+            out.close()
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     cmd = args.command
@@ -235,6 +312,8 @@ def main(argv=None) -> int:
         return cmd_convert(args)
     if cmd == "server":
         return cmd_server(args)
+    if cmd in ("k8s", "kubernetes"):
+        return cmd_k8s(args)
     raise SystemExit(f"unknown command {cmd}")
 
 

@@ -1,0 +1,336 @@
+"""Built-in compliance specs.
+
+Control numbering follows the public CIS/NSA framework documents the
+reference's embedded specs encode (trivy-checks specs/ compliance
+bundle); each control lists the check IDs our scanners emit
+(AVD-KSV-*/AVD-DS-*/AVD-AWS-*), so coverage maps 1:1 onto the misconf
+engine.  Controls whose framework requirement has no automated check
+carry default_status MANUAL, the way the reference surfaces them."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Control:
+    id: str
+    name: str
+    description: str = ""
+    severity: str = "MEDIUM"
+    checks: list = field(default_factory=list)   # check IDs
+    default_status: str = ""                     # "" | MANUAL | FAIL
+
+
+@dataclass
+class Spec:
+    id: str
+    title: str
+    description: str
+    version: str
+    related_resources: list = field(default_factory=list)
+    controls: list = field(default_factory=list)
+
+
+_K8S_CIS = Spec(
+    id="k8s-cis", title="CIS Kubernetes Benchmarks",
+    description="CIS Kubernetes Benchmarks",
+    version="1.23",
+    related_resources=["https://www.cisecurity.org/benchmark/kubernetes"],
+    controls=[
+        Control("5.1.1", "Ensure that the cluster-admin role is only "
+                "used where required", severity="HIGH",
+                default_status="MANUAL"),
+        Control("5.2.1", "Minimize the admission of privileged "
+                "containers",
+                "Do not generally permit containers to be run with "
+                "the securityContext.privileged flag set to true.",
+                "HIGH", ["AVD-KSV-0017"]),
+        Control("5.2.2", "Minimize the admission of containers wishing "
+                "to share the host process ID namespace",
+                "Do not generally permit containers to be run with the "
+                "hostPID flag set to true.",
+                "HIGH", ["AVD-KSV-0010"]),
+        Control("5.2.3", "Minimize the admission of containers wishing "
+                "to share the host IPC namespace",
+                "Do not generally permit containers to be run with the "
+                "hostIPC flag set to true.",
+                "HIGH", ["AVD-KSV-0008"]),
+        Control("5.2.4", "Minimize the admission of containers wishing "
+                "to share the host network namespace",
+                "Do not generally permit containers to be run with the "
+                "hostNetwork flag set to true.",
+                "HIGH", ["AVD-KSV-0009"]),
+        Control("5.2.5", "Minimize the admission of containers with "
+                "allowPrivilegeEscalation",
+                "Do not generally permit containers to be run with the "
+                "allowPrivilegeEscalation flag set to true.",
+                "HIGH", ["AVD-KSV-0001"]),
+        Control("5.2.6", "Minimize the admission of root containers",
+                "Do not generally permit containers to be run as the "
+                "root user.",
+                "MEDIUM", ["AVD-KSV-0012"]),
+        Control("5.2.7", "Minimize the admission of containers with "
+                "added capabilities",
+                "Do not generally permit containers with capabilities "
+                "assigned beyond the default set.",
+                "LOW", ["AVD-KSV-0022"]),
+        Control("5.2.8", "Minimize the admission of containers with "
+                "capabilities assigned",
+                "Do not generally permit containers with capabilities.",
+                "LOW", ["AVD-KSV-0003"]),
+        Control("5.7.3", "Apply Security Context to Your Pods and "
+                "Containers",
+                "Apply Security Context to Your Pods and Containers.",
+                "HIGH", ["AVD-KSV-0021", "AVD-KSV-0020",
+                         "AVD-KSV-0030", "AVD-KSV-0104",
+                         "AVD-KSV-0014"]),
+    ])
+
+_K8S_NSA = Spec(
+    id="k8s-nsa", title="National Security Agency - Kubernetes "
+    "Hardening Guidance v1.0",
+    description="National Security Agency - Kubernetes Hardening "
+    "Guidance",
+    version="1.0",
+    related_resources=[
+        "https://www.nsa.gov/Press-Room/News-Highlights/Article/"
+        "Article/2716980/nsa-cisa-release-kubernetes-hardening-"
+        "guidance/"],
+    controls=[
+        Control("1.0", "Non-root containers",
+                "Check that container is not running as root",
+                "MEDIUM", ["AVD-KSV-0012"]),
+        Control("1.1", "Immutable container file systems",
+                "Check that container root file system is immutable",
+                "LOW", ["AVD-KSV-0014"]),
+        Control("1.2", "Preventing privileged containers",
+                "Controls whether Pods can run privileged containers",
+                "HIGH", ["AVD-KSV-0017"]),
+        Control("1.3", "Share containers process namespaces",
+                "Controls whether containers can share process "
+                "namespaces",
+                "HIGH", ["AVD-KSV-0010"]),
+        Control("1.4", "Share host process namespaces",
+                "Controls whether share host process namespaces",
+                "HIGH", ["AVD-KSV-0008"]),
+        Control("1.5", "Use the host network",
+                "Controls whether containers can use the host network",
+                "HIGH", ["AVD-KSV-0009"]),
+        Control("1.6", "Run with root privileges or with root group "
+                "membership",
+                "Controls whether container applications can run with "
+                "root privileges or with root group membership",
+                "LOW", ["AVD-KSV-0029"]),
+        Control("1.7", "Restricts escalation to root privileges",
+                "Control check restrictions escalation to root "
+                "privileges",
+                "MEDIUM", ["AVD-KSV-0001"]),
+        Control("1.8", "Sets the SELinux context of the container",
+                "Control checks if pod sets the SELinux context of "
+                "the container",
+                "MEDIUM", ["AVD-KSV-0025"]),
+        Control("1.9", "Restrict a container's access to resources "
+                "with AppArmor",
+                "Control checks the restriction of containers access "
+                "to resources with AppArmor",
+                "MEDIUM", ["AVD-KSV-0002"]),
+        Control("1.10", "Sets the seccomp profile used to sandbox "
+                "containers",
+                "Control checks the sets the seccomp profile used to "
+                "sandbox containers",
+                "LOW", ["AVD-KSV-0030"]),
+        Control("1.11", "Protecting Pod service account tokens",
+                "Control check whether disable secret token been "
+                "mount, automountServiceAccountToken: false",
+                "MEDIUM", ["AVD-KSV-0036"]),
+    ])
+
+_K8S_PSS_BASELINE = Spec(
+    id="k8s-pss-baseline", title="Kubernetes Pod Security Standards - "
+    "Baseline",
+    description="Kubernetes Pod Security Standards - Baseline",
+    version="0.1",
+    related_resources=[
+        "https://kubernetes.io/docs/concepts/security/"
+        "pod-security-standards/#baseline"],
+    controls=[
+        Control("1", "HostProcess",
+                "Windows pods offer the ability to run HostProcess "
+                "containers which enables privileged access to the "
+                "Windows node.",
+                "HIGH", ["AVD-KSV-0103"]),
+        Control("2", "Host Namespaces",
+                "Sharing the host namespaces must be disallowed.",
+                "HIGH", ["AVD-KSV-0008", "AVD-KSV-0009",
+                         "AVD-KSV-0010"]),
+        Control("3", "Privileged Containers",
+                "Privileged Pods disable most security mechanisms and "
+                "must be disallowed.",
+                "HIGH", ["AVD-KSV-0017"]),
+        Control("4", "Capabilities",
+                "Adding additional capabilities beyond the default set "
+                "must be disallowed.",
+                "MEDIUM", ["AVD-KSV-0022"]),
+        Control("5", "HostPath Volumes",
+                "HostPath volumes must be forbidden.",
+                "MEDIUM", ["AVD-KSV-0023"]),
+        Control("7", "SELinux",
+                "Setting the SELinux type is restricted, and setting a "
+                "custom SELinux user or role option is forbidden.",
+                "MEDIUM", ["AVD-KSV-0025"]),
+        Control("10", "Seccomp",
+                "Seccomp profile must not be explicitly set to "
+                "Unconfined.",
+                "MEDIUM", ["AVD-KSV-0104"]),
+    ])
+
+_K8S_PSS_RESTRICTED = Spec(
+    id="k8s-pss-restricted", title="Kubernetes Pod Security Standards "
+    "- Restricted",
+    description="Kubernetes Pod Security Standards - Restricted",
+    version="0.1",
+    related_resources=[
+        "https://kubernetes.io/docs/concepts/security/"
+        "pod-security-standards/#restricted"],
+    controls=list(_K8S_PSS_BASELINE.controls) + [
+        Control("11", "Volume Types",
+                "The restricted policy only permits specific volume "
+                "types.",
+                "LOW", ["AVD-KSV-0028"]),
+        Control("12", "Privilege Escalation",
+                "Privilege escalation (such as via set-user-ID or "
+                "set-group-ID file mode) should not be allowed.",
+                "MEDIUM", ["AVD-KSV-0001"]),
+        Control("13", "Running as Non-root",
+                "Containers must be required to run as non-root users.",
+                "MEDIUM", ["AVD-KSV-0012"]),
+        Control("14", "Seccomp v2",
+                "Seccomp profile must be explicitly set to one of the "
+                "allowed values.",
+                "LOW", ["AVD-KSV-0030"]),
+        Control("15", "Capabilities v2",
+                "Containers must drop ALL capabilities, and are only "
+                "permitted to add back the NET_BIND_SERVICE "
+                "capability.",
+                "LOW", ["AVD-KSV-0003"]),
+    ])
+
+_DOCKER_CIS = Spec(
+    id="docker-cis-1.6.0", title="CIS Docker Community Edition "
+    "Benchmark v1.6.0",
+    description="CIS Docker Community Edition Benchmark",
+    version="1.6.0",
+    related_resources=["https://www.cisecurity.org/benchmark/docker"],
+    controls=[
+        Control("4.1", "Ensure that a user for the container has been "
+                "created",
+                "Create a non-root user for the container in the "
+                "Dockerfile for the container image.",
+                "HIGH", ["AVD-DS-0002"]),
+        Control("4.2", "Ensure that containers use only trusted base "
+                "images", severity="MEDIUM", default_status="MANUAL"),
+        Control("4.3", "Ensure that unnecessary packages are not "
+                "installed in the container",
+                severity="MEDIUM", default_status="MANUAL"),
+        Control("4.4", "Ensure images are scanned and rebuilt to "
+                "include security patches",
+                "Images should be scanned frequently for any "
+                "vulnerabilities.",
+                "CRITICAL", ["VULN-CRITICAL"]),
+        Control("4.6", "Ensure that HEALTHCHECK instructions have been "
+                "added to container images",
+                "Add the HEALTHCHECK instruction to your docker "
+                "container images.",
+                "LOW", ["AVD-DS-0026"]),
+        Control("4.7", "Ensure update instructions are not used alone "
+                "in the Dockerfile",
+                "Do not use update instructions such as apt-get "
+                "update alone or in a single line in the Dockerfile.",
+                "HIGH", ["AVD-DS-0017"]),
+        Control("4.8", "Ensure setuid and setgid permissions are "
+                "removed",
+                severity="MEDIUM", default_status="MANUAL"),
+        Control("4.9", "Ensure that COPY is used instead of ADD",
+                "Use COPY instruction instead of ADD instruction in "
+                "the Dockerfile.",
+                "LOW", ["AVD-DS-0005"]),
+        Control("4.10", "Ensure secrets are not stored in Dockerfiles",
+                "Do not store any kind of secrets within Dockerfiles.",
+                "CRITICAL", ["SECRET-CRITICAL"]),
+    ])
+
+_AWS_CIS_14 = Spec(
+    id="aws-cis-1.4", title="AWS CIS Foundations v1.4",
+    description="AWS CIS Foundations",
+    version="1.4",
+    related_resources=["https://www.cisecurity.org/benchmark/"
+                       "amazon_web_services"],
+    controls=[
+        Control("2.1.1", "Ensure all S3 buckets employ "
+                "encryption-at-rest",
+                severity="MEDIUM", checks=["AVD-AWS-0088"]),
+        Control("2.1.3", "Ensure MFA Delete is enabled on S3 buckets",
+                severity="MEDIUM", default_status="MANUAL"),
+        Control("2.1.5", "Ensure that S3 Buckets are configured with "
+                "'Block public access'",
+                severity="HIGH",
+                checks=["AVD-AWS-0086", "AVD-AWS-0087",
+                        "AVD-AWS-0091", "AVD-AWS-0093"]),
+        Control("2.2.1", "Ensure EBS volume encryption is enabled",
+                severity="HIGH", checks=["AVD-AWS-0026"]),
+        Control("2.3.1", "Ensure that encryption is enabled for RDS "
+                "Instances",
+                severity="HIGH", checks=["AVD-AWS-0080"]),
+        Control("3.1", "Ensure CloudTrail is enabled in all regions",
+                severity="MEDIUM", checks=["AVD-AWS-0014"]),
+        Control("3.2", "Ensure CloudTrail log file validation is "
+                "enabled",
+                severity="MEDIUM", checks=["AVD-AWS-0016"]),
+        Control("3.7", "Ensure CloudTrail logs are encrypted at rest "
+                "using KMS CMKs",
+                severity="HIGH", checks=["AVD-AWS-0015"]),
+        Control("5.2", "Ensure no security groups allow ingress from "
+                "0.0.0.0/0 to remote server administration ports",
+                severity="HIGH", checks=["AVD-AWS-0107"]),
+    ])
+
+SPECS = {s.id: s for s in (_K8S_CIS, _K8S_NSA, _K8S_PSS_BASELINE,
+                           _K8S_PSS_RESTRICTED, _DOCKER_CIS,
+                           _AWS_CIS_14)}
+
+
+def get_spec(name: str) -> Spec:
+    """Accepts a builtin id ('@'-prefixed paths load YAML specs the way
+    the reference accepts --compliance @spec.yaml)."""
+    if name.startswith("@"):
+        return load_spec_file(name[1:])
+    spec = SPECS.get(name)
+    if spec is None:
+        raise KeyError(
+            f"unknown compliance spec {name!r}; builtin: "
+            f"{', '.join(sorted(SPECS))}")
+    return spec
+
+
+def load_spec_file(path: str) -> Spec:
+    """Custom spec YAML, same document shape the reference accepts."""
+    import yaml
+    with open(path, encoding="utf-8") as f:
+        doc = yaml.safe_load(f)
+    body = doc.get("spec", doc)
+    controls = []
+    for c in body.get("controls", []):
+        controls.append(Control(
+            id=str(c.get("id", "")), name=c.get("name", ""),
+            description=c.get("description", ""),
+            severity=c.get("severity", "MEDIUM"),
+            checks=[chk["id"] if isinstance(chk, dict) else str(chk)
+                    for chk in c.get("checks") or []],
+            default_status=c.get("defaultStatus", "")))
+    return Spec(
+        id=body.get("id", path), title=body.get("title", ""),
+        description=body.get("description", ""),
+        version=str(body.get("version", "")),
+        related_resources=body.get("relatedResources", []) or [],
+        controls=controls)

@@ -404,6 +404,109 @@ def _seccomp_unconfined(ctx):
                    f"profile to 'Unconfined'", value_range(holder, key))
 
 
+@_k("KSV002", "Default AppArmor profile not set", "MEDIUM",
+    "A program inside the container can bypass AppArmor protection "
+    "policies.",
+    "Remove 'container.apparmor.security.beta.kubernetes.io' "
+    "annotation or set it to 'runtime/default'.")
+def _apparmor(ctx):
+    # AppArmor annotations live on the Pod metadata — for controllers
+    # that is the pod-template metadata, not the workload's own
+    sources = [ctx.doc.get("metadata")]
+    if ctx.kind == "CronJob":
+        sources.append(_dig(ctx.doc, "spec", "jobTemplate", "spec",
+                            "template", "metadata"))
+    elif ctx.kind != "Pod":
+        sources.append(_dig(ctx.doc, "spec", "template", "metadata"))
+    for md in sources:
+        annotations = md.get("annotations") \
+            if isinstance(md, dict) else None
+        if not isinstance(annotations, dict):
+            continue
+        for key, val in annotations.items():
+            if str(key).startswith(
+                    "container.apparmor.security.beta.kubernetes.io/") \
+                    and str(val) == "unconfined":
+                yield (f"{ctx.kind} '{ctx.name}' should specify an "
+                       f"AppArmor profile",
+                       value_range(annotations, key))
+
+
+@_k("KSV028", "Non-ephemeral volume types used", "LOW",
+    "According to pod security standard 'Volume types', non-ephemeral "
+    "volume types must not be used.",
+    "Do not Set 'spec.volumes[*]' to any of the disallowed volume "
+    "types.")
+def _volume_types(ctx):
+    allowed = {"configMap", "csi", "downwardAPI", "emptyDir",
+               "ephemeral", "persistentVolumeClaim", "projected",
+               "secret", "name"}
+    vols = ctx.spec.get("volumes")
+    if not isinstance(vols, list):
+        return
+    for i, v in enumerate(vols):
+        if not isinstance(v, dict):
+            continue
+        bad = [k for k in v if k not in allowed]
+        if bad:
+            yield (f"{ctx.kind} '{ctx.name}' should not use volume type "
+                   f"'{bad[0]}'",
+                   value_range(vols, i) if isinstance(vols, PosList)
+                   else (0, 0))
+
+
+@_k("KSV029", "A root primary or supplementary GID set", "LOW",
+    "Containers should be forbidden from running with a root primary "
+    "or supplementary GID.",
+    "Set 'containers[].securityContext.runAsGroup' to a non-zero "
+    "integer or leave it unset.")
+def _root_gid(ctx):
+    scopes = [(ctx.spec.get("securityContext"), ctx.spec,
+               "securityContext")]
+    scopes += [(_sec_ctx(c), c, "securityContext")
+               for c, _ in ctx.containers]
+    for sc, holder, key in scopes:
+        if not isinstance(sc, dict):
+            continue
+        if sc.get("runAsGroup") == 0 or sc.get("fsGroup") == 0 or \
+                (isinstance(sc.get("supplementalGroups"), list) and
+                 0 in sc["supplementalGroups"]):
+            yield (f"{ctx.kind} '{ctx.name}' should not set a root "
+                   f"group ID", value_range(holder, key))
+
+
+@_k("KSV036", "Protecting Pod service account tokens", "MEDIUM",
+    "Ensure that Pod specifications disable the secret token being "
+    "mounted by setting automountServiceAccountToken: false.",
+    "Set 'spec.automountServiceAccountToken' to false.")
+def _sa_token(ctx):
+    if ctx.spec.get("automountServiceAccountToken") is not False:
+        yield (f"{ctx.kind} '{ctx.name}' should set "
+               f"'spec.automountServiceAccountToken' to false",
+               value_range(ctx.spec, "automountServiceAccountToken",
+                           (ctx.spec.start, ctx.spec.start)
+                           if isinstance(ctx.spec, PosDict) else (0, 0)))
+
+
+@_k("KSV103", "HostProcess container defined", "HIGH",
+    "Windows pods offer the ability to run HostProcess containers "
+    "which enables privileged access to the Windows node.",
+    "Do not enable 'hostProcess' on any securityContext.")
+def _host_process(ctx):
+    scopes = [(ctx.spec.get("securityContext"), ctx.spec,
+               "securityContext")]
+    scopes += [(_sec_ctx(c), c, "securityContext")
+               for c, _ in ctx.containers]
+    for sc, holder, key in scopes:
+        if not isinstance(sc, dict):
+            continue
+        wo = sc.get("windowsOptions")
+        if isinstance(wo, dict) and wo.get("hostProcess") is True:
+            yield (f"{ctx.kind} '{ctx.name}' should not set "
+                   f"'windowsOptions.hostProcess' to true",
+                   value_range(holder, key))
+
+
 def scan_kubernetes(path: str, content: bytes, lines=None,
                     docs=None) -> tuple[list, int]:
     """→ (failures, successes) over all workload documents in the file.

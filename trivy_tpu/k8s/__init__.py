@@ -1,0 +1,16 @@
+"""Kubernetes cluster scanning (reference pkg/k8s, 1.8k LoC +
+aquasecurity/trivy-kubernetes client library).
+
+The reference connects to a live cluster via kubeconfig, enumerates
+workloads (+infra resources), scans each workload's spec for
+misconfigurations and its images for vulnerabilities, and renders
+namespace/resource summary tables or a KBOM.  This package implements
+the same flow on a minimal REST client: kubeconfig parsing, workload
+enumeration over the API groups, conversion of live resources into the
+kubernetes misconfiguration scanner, and the summary/all/KBOM outputs.
+Workload *image* vulnerability scanning needs registry access and is
+gated the same way the image command gates daemon/registry sources."""
+
+from .client import KubeClient  # noqa: F401
+from .kubeconfig import KubeConfig, load_kubeconfig  # noqa: F401
+from .scanner import scan_cluster  # noqa: F401

@@ -1,0 +1,89 @@
+"""Minimal Kubernetes REST client (stdlib urllib over the kubeconfig
+credentials).  Covers what cluster scanning needs: version, node list,
+namespace list, and workload enumeration across the core + apps +
+batch API groups (reference pkg/k8s via trivy-kubernetes)."""
+
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.error
+import urllib.request
+
+from .kubeconfig import KubeConfig
+
+# kind → (api_prefix, plural); namespaced workloads the scanner walks
+WORKLOAD_KINDS = {
+    "Pod": ("api/v1", "pods"),
+    "Deployment": ("apis/apps/v1", "deployments"),
+    "StatefulSet": ("apis/apps/v1", "statefulsets"),
+    "DaemonSet": ("apis/apps/v1", "daemonsets"),
+    "ReplicaSet": ("apis/apps/v1", "replicasets"),
+    "Job": ("apis/batch/v1", "jobs"),
+    "CronJob": ("apis/batch/v1", "cronjobs"),
+}
+
+
+class KubeError(RuntimeError):
+    def __init__(self, msg: str, code: int = 0):
+        super().__init__(msg)
+        self.code = code
+
+
+class KubeClient:
+    def __init__(self, cfg: KubeConfig, timeout: float = 20.0):
+        self.cfg = cfg
+        self.timeout = timeout
+        self._ctx = None
+        if cfg.server.startswith("https"):
+            ctx = ssl.create_default_context(
+                cafile=cfg.ca_file or None)
+            if cfg.insecure:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if cfg.client_cert_file:
+                ctx.load_cert_chain(cfg.client_cert_file,
+                                    cfg.client_key_file or None)
+            self._ctx = ctx
+
+    def get(self, path: str):
+        url = self.cfg.server.rstrip("/") + "/" + path.lstrip("/")
+        req = urllib.request.Request(url)
+        if self.cfg.token:
+            req.add_header("Authorization", f"Bearer {self.cfg.token}")
+        req.add_header("Accept", "application/json")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout,
+                    context=self._ctx) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise KubeError(f"GET {path}: HTTP {e.code}",
+                            code=e.code) from e
+        except (urllib.error.URLError, OSError) as e:
+            raise KubeError(f"GET {path}: {e}") from e
+
+    def version(self) -> dict:
+        return self.get("/version")
+
+    def namespaces(self) -> list[str]:
+        doc = self.get("/api/v1/namespaces")
+        return [item["metadata"]["name"]
+                for item in doc.get("items", [])]
+
+    def nodes(self) -> list[dict]:
+        return self.get("/api/v1/nodes").get("items", [])
+
+    def list_workloads(self, kind: str, namespace: str = "") -> list[dict]:
+        prefix, plural = WORKLOAD_KINDS[kind]
+        path = f"/{prefix}/namespaces/{namespace}/{plural}" \
+            if namespace else f"/{prefix}/{plural}"
+        items = self.get(path).get("items", [])
+        for item in items:
+            # list items lack apiVersion/kind; restore for the scanner
+            item.setdefault("kind", kind)
+            item.setdefault(
+                "apiVersion",
+                "v1" if prefix == "api/v1" else
+                prefix.split("/", 1)[1])
+        return items

@@ -1,0 +1,124 @@
+"""Cluster scan orchestration (reference pkg/k8s/scanner/scanner.go).
+
+Enumerates workloads, skips controller-owned duplicates (a Pod owned by
+a ReplicaSet is represented by its Deployment, the way trivy-kubernetes
+collapses owners), runs each resource through the kubernetes
+misconfiguration checks, and assembles per-resource Results compatible
+with the report/compliance layers."""
+
+from __future__ import annotations
+
+import json
+
+from .. import types as T
+from ..iac.kubernetes import scan_kubernetes
+from .client import WORKLOAD_KINDS, KubeClient, KubeError
+
+
+def _owned(item: dict) -> bool:
+    md = item.get("metadata", {})
+    return bool(md.get("ownerReferences"))
+
+
+def scan_resource_doc(doc: dict, namespace: str = "") -> T.Result:
+    kind = doc.get("kind", "")
+    name = doc.get("metadata", {}).get("name", "")
+    ns = doc.get("metadata", {}).get("namespace", namespace)
+    text = json.dumps(doc, indent=1).encode()
+    failures, successes = scan_kubernetes(
+        f"{name}.json", text, docs=[doc])
+    return T.Result(
+        target=f"{ns}/{kind}/{name}" if ns else f"{kind}/{name}",
+        clazz=T.ResultClass.CONFIG,
+        type="kubernetes",
+        misconf_summary=T.MisconfSummary(
+            successes=successes, failures=len(failures)),
+        misconfigurations=sorted(
+            failures, key=lambda f: (f.id, f.message)),
+    )
+
+
+def scan_cluster(client: KubeClient, namespace: str = "",
+                 kinds=None) -> list[T.Result]:
+    results = []
+    for kind in (kinds or WORKLOAD_KINDS):
+        try:
+            items = client.list_workloads(kind, namespace)
+        except KubeError as e:
+            if e.code == 404:
+                continue  # API group absent (old clusters) — skip kind
+            raise  # auth/connection failures must NOT read as clean
+        for item in items:
+            if kind in ("Pod", "ReplicaSet", "Job") and _owned(item):
+                continue
+            res = scan_resource_doc(item)
+            if res.misconfigurations or \
+                    (res.misconf_summary and
+                     res.misconf_summary.successes):
+                results.append(res)
+    return sorted(results, key=lambda r: r.target)
+
+
+def build_kbom(client: KubeClient) -> dict:
+    """KBOM: cluster + node components as CycloneDX JSON (reference
+    pkg/k8s/scanner/scanner.go clusterInfoToReportResources →
+    cyclonedx KBOM)."""
+    version = {}
+    try:
+        version = client.version()
+    except Exception:
+        pass
+    components = []
+    try:
+        for node in client.nodes():
+            info = node.get("status", {}).get("nodeInfo", {})
+            name = node.get("metadata", {}).get("name", "")
+            components.append({
+                "bom-ref": f"node:{name}",
+                "type": "container",
+                "name": name,
+                "properties": [
+                    {"name": "node-role", "value": "worker"},
+                    {"name": "architecture",
+                     "value": info.get("architecture", "")},
+                    {"name": "kernel_version",
+                     "value": info.get("kernelVersion", "")},
+                    {"name": "operating_system",
+                     "value": info.get("osImage", "")},
+                    {"name": "kubelet_version",
+                     "value": info.get("kubeletVersion", "")},
+                ]})
+    except Exception:
+        pass
+    return {
+        "bomFormat": "CycloneDX",
+        "specVersion": "1.5",
+        "version": 1,
+        "metadata": {
+            "component": {
+                "bom-ref": "cluster",
+                "type": "platform",
+                "name": "k8s.io/kubernetes",
+                "version": version.get("gitVersion", ""),
+            },
+        },
+        "components": components,
+    }
+
+
+def summary_table(results: list) -> str:
+    """Namespace/resource misconfiguration summary (reference
+    pkg/k8s/report summary writer)."""
+    from ..report.tables import render_table
+    sev_cols = ["CRITICAL", "HIGH", "MEDIUM", "LOW", "UNKNOWN"]
+    head = ["Namespace", "Resource"] + [s[0] for s in sev_cols]
+    rows = []
+    for r in results:
+        ns, _, rest = r.target.partition("/")
+        counts = {s: 0 for s in sev_cols}
+        for m in r.misconfigurations:
+            counts[m.severity if m.severity in counts
+                   else "UNKNOWN"] += 1
+        rows.append([ns, rest] + [str(counts[s]) for s in sev_cols])
+    return render_table("Summary Report (Misconfigurations)", head,
+                        rows)

@@ -61,7 +61,9 @@ class Check:
 
 def _mk(id_, title, severity, description, resolution):
     def deco(fn):
-        CHECKS.append(Check(id=id_, avd_id=f"AVD-{id_}", title=title,
+        CHECKS.append(Check(id=id_,
+                            avd_id=f"AVD-DS-{int(id_[2:]):04d}",
+                            title=title,
                             severity=severity, description=description,
                             resolution=resolution, fn=fn))
         return fn
