@@ -1,0 +1,357 @@
+"""Rego engine tests (reference pkg/iac/rego scanner_test.go shapes)."""
+
+import textwrap
+
+from trivy_tpu.iac.rego import RegoChecksScanner, retrieve_metadata
+from trivy_tpu.iac.rego.builtins import RSet, UNDEF
+from trivy_tpu.iac.rego.eval import Interpreter
+from trivy_tpu.iac.rego.parser import parse_module
+
+
+def interp(*srcs, data=None):
+    return Interpreter([parse_module(textwrap.dedent(s))
+                        for s in srcs], data=data)
+
+
+def q(src, path, input_doc=UNDEF, data=None):
+    return interp(src, data=data).query(path, input_doc)
+
+
+def test_complete_rule_and_default():
+    src = """
+    package test
+
+    default allow = false
+
+    allow = true {
+        input.user == "admin"
+    }
+    """
+    assert q(src, "test.allow", {"user": "admin"}) is True
+    assert q(src, "test.allow", {"user": "bob"}) is False
+
+
+def test_partial_set_rule_legacy_and_contains():
+    src = """
+    package test
+
+    deny[msg] {
+        input.x > 3
+        msg := sprintf("x is %d", [input.x])
+    }
+
+    deny contains msg if {
+        input.y == "bad"
+        msg := "y is bad"
+    }
+    """
+    v = q(src, "test.deny", {"x": 5, "y": "bad"})
+    assert sorted(v.to_list()) == ["x is 5", "y is bad"]
+    v2 = q(src, "test.deny", {"x": 1, "y": "ok"})
+    assert len(v2) == 0
+
+
+def test_iteration_unbound_var_and_wildcard():
+    src = """
+    package test
+
+    names[n] {
+        n := input.items[_].name
+    }
+
+    indexed[i] {
+        input.items[i].name == "b"
+    }
+    """
+    doc = {"items": [{"name": "a"}, {"name": "b"}]}
+    assert sorted(q(src, "test.names", doc).to_list()) == ["a", "b"]
+    assert q(src, "test.indexed", doc).to_list() == [1]
+
+
+def test_some_in_every_not():
+    src = """
+    package test
+    import rego.v1
+
+    has_bad if {
+        some item in input.items
+        item.bad
+    }
+
+    all_good if {
+        every item in input.items {
+            not item.bad
+        }
+    }
+
+    kv_pairs contains s if {
+        some k, v in input.m
+        s := sprintf("%s=%s", [k, v])
+    }
+    """
+    assert q(src, "test.has_bad",
+             {"items": [{"bad": False}, {"bad": True}]}) is True
+    assert q(src, "test.all_good", {"items": [{"bad": False}]}) is True
+    assert q(src, "test.all_good",
+             {"items": [{"bad": True}]}) is UNDEF
+    got = q(src, "test.kv_pairs", {"m": {"a": "1", "b": "2"}})
+    assert sorted(got.to_list()) == ["a=1", "b=2"]
+
+
+def test_comprehensions():
+    src = """
+    package test
+
+    arr := [x | x := input.nums[_]; x > 2]
+    st := {x | x := input.nums[_]}
+    obj := {k: v | v := input.m[k]}
+    """
+    doc = {"nums": [1, 3, 4, 3], "m": {"a": 1}}
+    assert q(src, "test.arr", doc) == [3, 4, 3]
+    assert sorted(q(src, "test.st", doc).to_list()) == [1, 3, 4]
+    assert q(src, "test.obj", doc) == {"a": 1}
+
+
+def test_functions_and_else():
+    src = """
+    package test
+
+    double(x) = y {
+        y := x * 2
+    }
+
+    classify(n) = "big" {
+        n > 100
+    } else = "small" {
+        n >= 0
+    } else = "negative" {
+        true
+    }
+
+    result := double(21)
+    cls := classify(input.n)
+    """
+    assert q(src, "test.result") == 42
+    assert q(src, "test.cls", {"n": 500}) == "big"
+    assert q(src, "test.cls", {"n": 5}) == "small"
+    assert q(src, "test.cls", {"n": -1}) == "negative"
+
+
+def test_cross_package_and_data():
+    lib = """
+    package lib.k8s
+
+    is_pod {
+        input.kind == "Pod"
+    }
+
+    name = input.metadata.name
+    """
+    check = """
+    package user.mycheck
+
+    import data.lib.k8s
+
+    deny[msg] {
+        k8s.is_pod
+        msg := sprintf("pod %s", [k8s.name])
+    }
+    """
+    i = interp(lib, check)
+    v = i.query("user.mycheck.deny",
+                {"kind": "Pod", "metadata": {"name": "x"}})
+    assert v.to_list() == ["pod x"]
+    # base data documents
+    src = """
+    package test
+    deny[msg] {
+        banned := data.banned[_]
+        input.name == banned
+        msg := "banned"
+    }
+    """
+    v = q(src, "test.deny", {"name": "evil"},
+          data={"banned": ["evil", "bad"]})
+    assert v.to_list() == ["banned"]
+
+
+def test_builtins():
+    src = """
+    package test
+
+    r1 := count(input.xs)
+    r2 := concat(",", ["a", "b"])
+    r3 := contains("hello", "ell")
+    r4 := lower("ABC")
+    r5 := split("a/b/c", "/")
+    r6 := regex.match("^ab+$", "abbb")
+    r7 := object.get(input, "missing", "dflt")
+    r8 := to_number("42")
+    r9 := trim_prefix("foo.bar", "foo.")
+    r10 := union({{1, 2}, {2, 3}})
+    r11 := startswith("hello", "he")
+    r12 := sprintf("%s:%d", ["x", 7])
+    r13 := array.concat([1], [2])
+    r14 := max([3, 9, 1])
+    """
+    i = interp(src)
+    doc = {"xs": [1, 2, 3]}
+    assert i.query("test.r1", doc) == 3
+    assert i.query("test.r2", doc) == "a,b"
+    assert i.query("test.r3", doc) is True
+    assert i.query("test.r4", doc) == "abc"
+    assert i.query("test.r5", doc) == ["a", "b", "c"]
+    assert i.query("test.r6", doc) is True
+    assert i.query("test.r7", doc) == "dflt"
+    assert i.query("test.r8", doc) == 42
+    assert i.query("test.r9", doc) == "bar"
+    assert sorted(i.query("test.r10", doc).to_list()) == [1, 2, 3]
+    assert i.query("test.r11", doc) is True
+    assert i.query("test.r12", doc) == "x:7"
+    assert i.query("test.r13", doc) == [1, 2]
+    assert i.query("test.r14", doc) == 9
+
+
+def test_walk_and_unification():
+    src = """
+    package test
+
+    privileged[path] {
+        [path, value] := walk(input)
+        value == true
+        path[count(path) - 1] == "privileged"
+    }
+    """
+    doc = {"spec": {"containers": [
+        {"name": "a", "securityContext": {"privileged": True}},
+        {"name": "b", "securityContext": {"privileged": False}},
+    ]}}
+    got = q(src, "test.privileged", doc)
+    assert len(got) == 1
+    assert got.to_list()[0][-1] == "privileged"
+
+
+def test_negation_and_arith():
+    src = """
+    package test
+
+    deny[msg] {
+        not input.spec.limits
+        msg := "no limits"
+    }
+
+    calc := (input.a + 2) * 3 - 1
+    """
+    assert q(src, "test.deny", {"spec": {}}).to_list() == ["no limits"]
+    assert len(q(src, "test.deny",
+                 {"spec": {"limits": 1}})) == 0
+    assert q(src, "test.calc", {"a": 4}) == 17
+
+
+def test_metadata_retrieval():
+    src = """\
+# METADATA
+# title: Custom check title
+# description: Something bad
+# custom:
+#   id: ID001
+#   avd_id: AVD-USR-0001
+#   severity: CRITICAL
+#   recommended_actions: Fix it
+#   input:
+#     selector:
+#     - type: kubernetes
+package user.example
+
+deny[msg] {
+    input.kind == "Pod"
+    msg := "found a pod"
+}
+"""
+    mod = parse_module(src)
+    i = Interpreter([mod])
+    sm = retrieve_metadata(i, mod)
+    assert sm.id == "ID001"
+    assert sm.avd_id == "AVD-USR-0001"
+    assert sm.severity == "CRITICAL"
+    assert sm.title == "Custom check title"
+    assert sm.selectors == ["kubernetes"]
+
+
+def test_legacy_rego_metadata_rule():
+    src = """
+    package user.legacy
+
+    __rego_metadata__ := {
+        "id": "LEG001",
+        "title": "Legacy",
+        "severity": "LOW",
+    }
+
+    deny[msg] {
+        input.bad
+        msg := "bad"
+    }
+    """
+    mod = parse_module(textwrap.dedent(src))
+    i = Interpreter([mod])
+    sm = retrieve_metadata(i, mod)
+    assert sm.id == "LEG001"
+    assert sm.severity == "LOW"
+
+
+def test_checks_scanner_end_to_end(tmp_path):
+    check = tmp_path / "check.rego"
+    check.write_text("""\
+# METADATA
+# title: No privileged pods
+# custom:
+#   id: USR-001
+#   severity: HIGH
+#   input:
+#     selector:
+#     - type: kubernetes
+package user.privileged
+
+deny[msg] {
+    c := input.spec.containers[_]
+    c.securityContext.privileged == true
+    msg := sprintf("container %s is privileged", [c.name])
+}
+""")
+    s = RegoChecksScanner.from_paths([str(tmp_path)])
+    doc = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p"},
+           "spec": {"containers": [
+               {"name": "app",
+                "securityContext": {"privileged": True}}]}}
+    failures, successes = s.scan_docs("kubernetes", "pod.yaml", [doc])
+    assert len(failures) == 1
+    f = failures[0]
+    assert f.id == "USR-001"
+    assert f.severity == "HIGH"
+    assert "app is privileged" in f.message
+    # clean doc → success
+    doc2 = {"kind": "Pod", "spec": {"containers": [{"name": "a"}]}}
+    failures2, successes2 = s.scan_docs("kubernetes", "p.yaml", [doc2])
+    assert not failures2
+    assert successes2 == 1
+    # selector excludes dockerfile inputs
+    f3, s3 = s.scan_docs("dockerfile", "Dockerfile", [{"x": 1}])
+    assert not f3 and s3 == 0
+
+
+def test_string_results_and_warn_rules(tmp_path):
+    check = tmp_path / "warny.rego"
+    check.write_text("""\
+package custom.warny
+
+warn[msg] {
+    input.replicas < 2
+    msg := "too few replicas"
+}
+""")
+    s = RegoChecksScanner.from_paths([str(tmp_path)])
+    failures, _ = s.scan_docs("yaml", "deploy.yaml", [{"replicas": 1}])
+    assert len(failures) == 1
+    assert failures[0].message == "too few replicas"

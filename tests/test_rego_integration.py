@@ -1,0 +1,131 @@
+"""Custom rego checks through the fs-scan pipeline + ignore policy
+(reference integration config_test.go custom-policy cases)."""
+
+import json
+
+import os
+
+from trivy_tpu import cli
+
+FIXGLOB = os.path.join(os.path.dirname(__file__), "fixtures", "db",
+                       "*.yaml")
+
+
+def run_cli(argv, capsys):
+    code = cli.main(argv)
+    return code, capsys.readouterr().out
+
+CHECK = """\
+# METADATA
+# title: Deployments must not use latest tag
+# custom:
+#   id: USR-0100
+#   severity: CRITICAL
+#   input:
+#     selector:
+#     - type: kubernetes
+package user.latest_tag
+
+deny[res] {
+    input.kind == "Deployment"
+    c := input.spec.template.spec.containers[_]
+    endswith(c.image, ":latest")
+    res := sprintf("container '%s' uses latest tag", [c.name])
+}
+"""
+
+MANIFEST = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+spec:
+  template:
+    spec:
+      containers:
+      - name: app
+        image: nginx:latest
+"""
+
+
+def _write_fixture(tmp_path):
+    checks = tmp_path / "checks"
+    checks.mkdir()
+    (checks / "latest.rego").write_text(CHECK)
+    target = tmp_path / "target"
+    target.mkdir()
+    (target / "deploy.yaml").write_text(MANIFEST)
+    return checks, target
+
+
+def test_custom_check_cli(tmp_path, capsys):
+    checks, target = _write_fixture(tmp_path)
+    code, out = run_cli(
+        ["fs", "--scanners", "misconfig", "--format", "json",
+         "--db", FIXGLOB, "--config-check", str(checks),
+         str(target)], capsys)
+    rep = json.loads(out)
+    mcs = [m for r in rep.get("Results", [])
+           for m in r.get("Misconfigurations", [])
+           if m["ID"] == "USR-0100"]
+    assert len(mcs) == 1
+    assert mcs[0]["Severity"] == "CRITICAL"
+    assert "latest tag" in mcs[0]["Message"]
+    assert mcs[0]["Namespace"] == "user.latest_tag"
+
+
+def test_custom_check_plain_yaml(tmp_path, capsys):
+    checks = tmp_path / "checks"
+    checks.mkdir()
+    (checks / "c.rego").write_text("""\
+# METADATA
+# title: replicas too low
+# custom:
+#   id: USR-0200
+#   severity: LOW
+package user.replicas
+
+deny[msg] {
+    input.replicas < 2
+    msg := "need at least 2 replicas"
+}
+""")
+    target = tmp_path / "t"
+    target.mkdir()
+    (target / "app.yaml").write_text("replicas: 1\nname: app\n")
+    code, out = run_cli(
+        ["fs", "--scanners", "misconfig", "--format", "json",
+         "--db", FIXGLOB, "--config-check", str(checks),
+         str(target)], capsys)
+    rep = json.loads(out)
+    mcs = [m for r in rep.get("Results", [])
+           for m in r.get("Misconfigurations", [])]
+    assert any(m["ID"] == "USR-0200" for m in mcs)
+
+
+def test_ignore_policy_suppresses(tmp_path, capsys):
+    checks, target = _write_fixture(tmp_path)
+    policy = tmp_path / "ignore.rego"
+    policy.write_text("""\
+package trivy
+
+default ignore = false
+
+ignore {
+    input.ID == "USR-0100"
+}
+""")
+    code, out = run_cli(
+        ["fs", "--scanners", "misconfig", "--format", "json",
+         "--db", FIXGLOB, "--config-check", str(checks),
+         "--ignore-policy", str(policy), str(target)], capsys)
+    rep = json.loads(out)
+    mcs = [m for r in rep.get("Results", [])
+           for m in r.get("Misconfigurations", [])
+           if m["ID"] == "USR-0100"]
+    assert not mcs
+
+
+def teardown_module(module):
+    from trivy_tpu.misconf import set_custom_checks
+    set_custom_checks(None)

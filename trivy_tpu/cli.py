@@ -53,6 +53,14 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--report", default="summary",
                    choices=["summary", "all"],
                    help="compliance report mode")
+    p.add_argument("--config-check", action="append", default=[],
+                   help="custom rego check file/dir (repeatable)")
+    p.add_argument("--config-data", action="append", default=[],
+                   help="rego data file/dir (repeatable)")
+    p.add_argument("--check-namespaces", default="",
+                   help="extra rego namespaces to evaluate (comma-sep)")
+    p.add_argument("--ignore-policy", default="",
+                   help="OPA rego file deciding per-finding suppression")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -154,6 +162,7 @@ def _scan_common(args, ref, cache, artifact_type: str) -> int:
         ignore_statuses=[s for s in args.ignore_status.split(",") if s],
         ignore_file=parse_ignore_file(args.ignorefile)
         if args.ignorefile else _auto_ignore_file(),
+        policy_file=getattr(args, "ignore_policy", ""),
     )
     results = filter_results(results, fopts)
 
@@ -197,9 +206,23 @@ def _auto_ignore_file():
     return None
 
 
+def _configure_misconf(args) -> None:
+    """Install user rego checks before analysis runs (reference wires
+    PolicyPaths through misconf.ScannerOption at initScannerConfig)."""
+    paths = getattr(args, "config_check", None)
+    if paths:
+        from .misconf import set_custom_checks
+        ns = [s.strip() for s in
+              getattr(args, "check_namespaces", "").split(",") if s.strip()]
+        set_custom_checks(paths,
+                          data_paths=getattr(args, "config_data", []),
+                          namespaces=ns)
+
+
 def cmd_image(args) -> int:
     from .fanal.artifact import ImageArchiveArtifact
     from .fanal.cache import FSCache
+    _configure_misconf(args)
     if not args.input:
         raise SystemExit("--input <archive> required (daemon/registry "
                          "sources need docker/network access)")
@@ -215,6 +238,7 @@ def cmd_image(args) -> int:
 def cmd_fs(args) -> int:
     from .fanal.artifact import FilesystemArtifact
     from .fanal.cache import MemoryCache
+    _configure_misconf(args)
     cache = MemoryCache()
     scanners = tuple(s.strip() for s in args.scanners.split(","))
     art = FilesystemArtifact(args.target, cache, scanners=scanners)

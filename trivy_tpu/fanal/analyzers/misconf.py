@@ -26,11 +26,28 @@ class MisconfAnalyzer(Analyzer):
         return detect_file_type(path) != ""
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
+        from ...misconf import custom_checks_scanner, run_custom_checks
         ftype, docs = sniff(path, content)
+        failures: list = []
+        successes = 0
         scanner = FILE_TYPES.get(ftype)
-        if scanner is None:
-            return None
-        failures, successes = scanner(path, content, docs=docs)
+        if scanner is not None:
+            failures, successes = scanner(path, content, docs=docs)
+        if custom_checks_scanner() is not None:
+            eff_type = ftype
+            if not eff_type:
+                base = path.lower()
+                if base.endswith((".yaml", ".yml")):
+                    eff_type = "yaml"
+                elif base.endswith(".json"):
+                    eff_type = "json"
+                elif base.endswith(".toml"):
+                    eff_type = "toml"
+            if eff_type:
+                cf, cs = run_custom_checks(eff_type, path, content, docs)
+                failures = failures + cf
+                successes += cs
+                ftype = ftype or eff_type
         if not failures and not successes:
             return None
         result = AnalysisResult()

@@ -28,6 +28,92 @@ FILE_TYPES = {
     "cloudformation": _scan_cloudformation,
 }
 
+# ---- custom rego checks (reference pkg/misconf ScannerOption
+# PolicyPaths/DataPaths/Namespaces → pkg/iac/rego) -------------------
+
+_custom_scanner = None
+
+
+def set_custom_checks(check_paths, data_paths=None, namespaces=None):
+    """Configure user .rego checks for all subsequent misconf scans.
+    Pass empty/None paths to clear."""
+    global _custom_scanner
+    if not check_paths:
+        _custom_scanner = None
+        return None
+    from ..iac.rego import RegoChecksScanner
+    _custom_scanner = RegoChecksScanner.from_paths(
+        check_paths, data_paths=data_paths, namespaces=namespaces)
+    return _custom_scanner
+
+
+def custom_checks_scanner():
+    return _custom_scanner
+
+
+def dockerfile_rego_input(content: bytes) -> dict:
+    """Build the mixed-case rego input document for dockerfiles
+    (reference pkg/iac/providers/dockerfile/dockerfile.go ToRego)."""
+    from .dockerfile import parse_dockerfile
+    text = content.decode(errors="replace")
+    stages = []
+    cur = {"Name": "", "Commands": []}
+    stage_idx = -1
+    for inst in parse_dockerfile(text):
+        if inst.cmd == "FROM":
+            if cur["Commands"]:
+                stages.append(cur)
+            stage_idx += 1
+            cur = {"Name": inst.args, "Commands": []}
+        value = inst.args
+        cur["Commands"].append({
+            "Cmd": inst.cmd.lower(),
+            "SubCmd": "",
+            "Flags": [],
+            "Value": [value],
+            "Original": f"{inst.cmd} {inst.args}",
+            "JSON": False,
+            "Stage": max(stage_idx, 0),
+            "StartLine": inst.start_line,
+            "EndLine": inst.end_line,
+        })
+    stages.append(cur)
+    return {"Stages": [s for s in stages if s["Commands"]]}
+
+
+def run_custom_checks(ftype: str, path: str, content: bytes, docs):
+    """→ (failures, successes) from user rego checks, or ([], 0)."""
+    if _custom_scanner is None:
+        return [], 0
+    text = content.decode(errors="replace")
+    if ftype == "dockerfile":
+        inputs = [dockerfile_rego_input(content)]
+    elif docs is not None:
+        inputs = [d for d in docs if d is not None]
+    else:
+        inputs = _parse_plain_docs(path, text)
+    if not inputs:
+        return [], 0
+    return _custom_scanner.scan_docs(ftype, path, inputs, text)
+
+
+def _parse_plain_docs(path: str, text: str):
+    base = path.lower()
+    try:
+        if base.endswith((".yaml", ".yml")):
+            import yaml
+            return [d for d in yaml.safe_load_all(text) if d is not None]
+        if base.endswith(".json"):
+            import json
+            data = json.loads(text)
+            return data if isinstance(data, list) else [data]
+        if base.endswith(".toml"):
+            import tomllib
+            return [tomllib.loads(text)]
+    except Exception:
+        return []
+    return []
+
 
 def detect_file_type(path: str) -> str:
     """Path-only pre-gate; content sniffing happens in the analyzer
@@ -37,5 +123,7 @@ def detect_file_type(path: str) -> str:
             base.endswith(".dockerfile"):
         return "dockerfile"
     if base.endswith((".yaml", ".yml", ".json", ".tf", ".tf.json")):
+        return "candidate"
+    if base.endswith(".toml") and _custom_scanner is not None:
         return "candidate"
     return ""

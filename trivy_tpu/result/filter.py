@@ -17,23 +17,50 @@ class FilterOptions:
     ignore_statuses: list = field(default_factory=list)
     ignore_unfixed: bool = False
     ignore_file: Optional[IgnoreFile] = None
+    policy_file: str = ""   # OPA ignore policy (reference applyPolicy)
+
+
+class IgnorePolicy:
+    """`--ignore-policy policy.rego` — a rego module in `package trivy`
+    whose `ignore` rule decides per-finding suppression (reference
+    pkg/result/filter.go:242 applyPolicy querying data.trivy.ignore)."""
+
+    def __init__(self, path: str):
+        from ..iac.rego.eval import Interpreter
+        from ..iac.rego.parser import parse_module
+        with open(path, encoding="utf-8") as f:
+            mod = parse_module(f.read(), path=path)
+        self.interp = Interpreter([mod])
+        self.pkg = ".".join(mod.package)
+
+    def ignore(self, finding_doc: dict) -> bool:
+        try:
+            v = self.interp.query(f"{self.pkg}.ignore", finding_doc)
+        except Exception:
+            return False
+        return v is True
 
 
 def filter_results(results: list[T.Result],
                    opts: FilterOptions) -> list[T.Result]:
     sev = set(opts.severities)
+    policy = IgnorePolicy(opts.policy_file) if opts.policy_file else None
     for res in results:
         res.vulnerabilities = [
             v for v in res.vulnerabilities
-            if _keep_vuln(v, res, sev, opts)]
+            if _keep_vuln(v, res, sev, opts) and not (
+                policy and policy.ignore(v.to_json()))]
         res.secrets = [
             s for s in res.secrets
             if s.severity in sev and not _ignored(
-                opts, "secrets", s.rule_id, res.target)]
+                opts, "secrets", s.rule_id, res.target) and not (
+                policy and policy.ignore(s.to_json()))]
         res.misconfigurations = [
             m for m in res.misconfigurations
             if getattr(m, "severity", "UNKNOWN") in sev and not _ignored(
-                opts, "misconfigurations", getattr(m, "id", ""), res.target)]
+                opts, "misconfigurations", getattr(m, "id", ""),
+                res.target) and not (
+                policy and policy.ignore(m.to_json()))]
     return [r for r in results if not r.is_empty() or r.clazz in
             (T.ResultClass.OS_PKGS, T.ResultClass.LANG_PKGS)]
 
