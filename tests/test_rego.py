@@ -355,3 +355,77 @@ warn[msg] {
     failures, _ = s.scan_docs("yaml", "deploy.yaml", [{"replicas": 1}])
     assert len(failures) == 1
     assert failures[0].message == "too few replicas"
+
+
+def test_function_called_with_enumerating_ref():
+    # review regression: f(input.nums[_]) must try every element
+    src = """
+    package test
+
+    big(x) = true {
+        x > 5
+    }
+
+    deny[msg] {
+        big(input.nums[_])
+        msg := "has big"
+    }
+    """
+    assert q(src, "test.deny", {"nums": [1, 10]}).to_list() == \
+        ["has big"]
+    assert len(q(src, "test.deny", {"nums": [1, 2]})) == 0
+
+
+def test_same_package_two_modules_no_duplicates(tmp_path):
+    (tmp_path / "a.rego").write_text("""\
+# METADATA
+# title: shared package a
+# custom:
+#   id: USR-A
+#   severity: LOW
+package user.shared
+
+deny[msg] {
+    input.a
+    msg := "a bad"
+}
+""")
+    (tmp_path / "b.rego").write_text("""\
+package user.shared
+
+deny[msg] {
+    input.b
+    msg := "b bad"
+}
+""")
+    s = RegoChecksScanner.from_paths([str(tmp_path)])
+    failures, _ = s.scan_docs("yaml", "x.yaml",
+                              [{"a": True, "b": True}])
+    assert sorted(f.message for f in failures) == ["a bad", "b bad"]
+
+
+def test_glob_match_empty_delimiters():
+    from trivy_tpu.iac.rego.builtins import BUILTINS
+    gm = BUILTINS["glob.match"]
+    assert gm("*dev*", [], "my.dev.env") is True      # no delimiters
+    assert gm("*dev*", None, "my.dev.env") is False   # default "."
+    assert gm("a.*", None, "a.b") is True
+    assert gm("a.*", None, "a.b.c") is False
+
+
+def test_with_data_override():
+    src = """
+    package test
+
+    allowed {
+        input.name == data.settings.allowed_name
+    }
+
+    check1 {
+        allowed with data.settings.allowed_name as "bob"
+    }
+    """
+    assert q(src, "test.check1", {"name": "bob"},
+             data={"settings": {"allowed_name": "alice"}}) is True
+    assert q(src, "test.allowed", {"name": "bob"},
+             data={"settings": {"allowed_name": "alice"}}) is UNDEF

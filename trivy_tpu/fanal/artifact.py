@@ -72,6 +72,10 @@ class ImageArchiveArtifact:
             json.dumps(config, sort_keys=True).encode()).hexdigest()
         versions = self.group.versions()
         opts = {"scanners": sorted(self.scanners)}
+        from ..misconf import custom_checks_fingerprint
+        fp = custom_checks_fingerprint()
+        if fp:
+            opts["config_checks"] = fp
         artifact_id = cache_key(image_id, versions, opts)
         blob_ids = [cache_key(d, versions, opts) for d in diff_ids]
 
@@ -135,6 +139,10 @@ class ImageArchiveArtifact:
         image_id = manifest["config"]["digest"]
         versions = self.group.versions()
         opts = {"scanners": sorted(self.scanners)}
+        from ..misconf import custom_checks_fingerprint
+        fp = custom_checks_fingerprint()
+        if fp:
+            opts["config_checks"] = fp
         artifact_id = cache_key(image_id, versions, opts)
         blob_ids = [cache_key(d, versions, opts) for d in diff_ids]
         _, missing = self.cache.missing_blobs(artifact_id, blob_ids)

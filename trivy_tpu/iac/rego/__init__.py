@@ -195,8 +195,14 @@ class RegoChecksScanner:
         successes = 0
         src_lines = text.splitlines() if text else []
         ignores = ignored_ids_by_line(text) if text else {}
+        seen_pkgs = set()
         for mod in self.check_modules():
-            sm = retrieve_metadata(self.interp, mod)
+            # one evaluation per package: rules merge across modules
+            # sharing a package (OPA compiles them into one document)
+            if mod.package in seen_pkgs:
+                continue
+            seen_pkgs.add(mod.package)
+            sm = self._package_metadata(mod)
             if not _applicable(sm, file_type):
                 continue
             check = Check(
@@ -222,6 +228,20 @@ class RegoChecksScanner:
             if not module_failed and rule_names:
                 successes += 1
         return failures, successes
+
+    def _package_metadata(self, mod: Module) -> StaticMetadata:
+        """Metadata for a package: the annotated module wins when several
+        modules share the package."""
+        best = None
+        for m in self.all_modules:
+            if m.package != mod.package:
+                continue
+            sm = retrieve_metadata(self.interp, m)
+            if sm.id != "N/A":
+                return sm
+            if best is None:
+                best = sm
+        return best or retrieve_metadata(self.interp, mod)
 
     def _apply_rule(self, mod: Module, rname: str, doc):
         path = ".".join(mod.package) + "." + rname

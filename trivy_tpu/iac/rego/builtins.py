@@ -387,11 +387,20 @@ def _regex_split(pat, s):
 
 
 def _glob_match(pattern, delimiters, match):
-    # translate glob to regex; ** crosses delimiters, * does not
-    delims = delimiters if delimiters else ["."]
-    if not isinstance(delims, list):
+    # translate glob to regex; ** crosses delimiters, * does not.
+    # OPA semantics: null/unspecified delimiters default to ["."];
+    # an EMPTY array means no delimiters (so * crosses everything).
+    if isinstance(delimiters, RSet):
+        delimiters = delimiters.to_list()
+    if delimiters is None:
         delims = ["."]
-    d = re.escape(delims[0] if delims else ".")
+    elif isinstance(delimiters, list):
+        delims = [str(d) for d in delimiters]
+    else:
+        delims = ["."]
+    d = "".join(re.escape(x) for x in delims)
+    star = f"[^{d}]*" if d else ".*"
+    qmark = f"[^{d}]" if d else "."
     rx = ""
     i = 0
     while i < len(pattern):
@@ -401,9 +410,9 @@ def _glob_match(pattern, delimiters, match):
                 rx += ".*"
                 i += 2
                 continue
-            rx += f"[^{d}]*"
+            rx += star
         elif c == "?":
-            rx += f"[^{d}]"
+            rx += qmark
         elif c in ".^$+{}[]()|\\":
             rx += "\\" + c
         else:

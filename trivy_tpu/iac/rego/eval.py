@@ -200,6 +200,7 @@ class Interpreter:
         if withs:
             import copy
             saved = self.input
+            saved_data = self.base_data
             try:
                 for tgt, val_t in withs:
                     if tgt == ("var", "input") or (
@@ -212,20 +213,15 @@ class Interpreter:
                     elif tgt[0] == "ref" and tgt[1] == ("var", "input"):
                         base = copy.deepcopy(self.input) \
                             if isinstance(self.input, (dict, list)) else {}
-                        cur = base
-                        ops = tgt[2]
-                        for j, op in enumerate(ops):
-                            k = op[1] if op[0] == "dot" else None
-                            if k is None:
-                                break
-                            if j == len(ops) - 1:
-                                for v, env in self.eval_term(
-                                        val_t, env, mod):
-                                    cur[k] = v
-                                    break
-                            else:
-                                cur = cur.setdefault(k, {})
-                        self.input = base
+                        self.input = _override_path(
+                            base, tgt[2], val_t, self, env, mod)
+                    elif tgt[0] == "ref" and tgt[1] == ("var", "data"):
+                        base = copy.deepcopy(self.base_data)
+                        self.base_data = _override_path(
+                            base, tgt[2], val_t, self, env, mod)
+                    else:
+                        raise RegoEvalError(
+                            "unsupported with-modifier target")
                 # materialize while the override is active; rule results
                 # computed under `with` must not leak into the cache
                 saved_cache = self.rule_cache
@@ -234,6 +230,7 @@ class Interpreter:
                 self.rule_cache = saved_cache
             finally:
                 self.input = saved
+                self.base_data = saved_data
             for e2 in solutions:
                 yield from self._eval_exprs(body, i + 1, e2, mod)
             return
@@ -638,8 +635,10 @@ class _UserFunction:
                 if v is UNDEF:
                     continue
                 yield from rec(idx + 1, acc + [v], e2)
-        produced = False
+        # each argument-enumeration solution is an independent call;
+        # yield at most one value per solution but keep enumerating
         for argvals, env_out in rec(0, [], env):
+            produced = False
             for m, r in self.defs:
                 if len(r.args) != len(argvals):
                     continue
@@ -669,10 +668,6 @@ class _UserFunction:
                         break
                 if produced:
                     break
-            if produced:
-                return
-            yield UNDEF, env_out
-            return
 
 
 class _DataDoc:
@@ -680,6 +675,28 @@ class _DataDoc:
 
     def __init__(self, interp):
         self.interp = interp
+
+
+def _override_path(base, ops, val_t, interp, env, mod):
+    """Set a dotted path inside a deep-copied document (with-modifier)."""
+    if not isinstance(base, dict):
+        base = {}
+    cur = base
+    for j, op in enumerate(ops):
+        if op[0] != "dot":
+            raise RegoEvalError("with: only dotted paths supported")
+        k = op[1]
+        if j == len(ops) - 1:
+            for v, _ in interp.eval_term(val_t, env, mod):
+                cur[k] = v
+                break
+        else:
+            nxt = cur.get(k)
+            if not isinstance(nxt, dict):
+                nxt = {}
+                cur[k] = nxt
+            cur = nxt
+    return base
 
 
 def _bind_pattern(pat, value, env):

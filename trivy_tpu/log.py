@@ -1,0 +1,29 @@
+"""Logging (reference pkg/log/logger.go — zap SugaredLogger to stderr).
+
+One process-wide logger writing WARN+ to stderr by default; --debug
+drops the threshold. Import `logger` or call `get(name)` for a child.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_root = logging.getLogger("trivy_tpu")
+if not _root.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s\t%(levelname)s\t%(message)s", "%Y-%m-%dT%H:%M:%S"))
+    _root.addHandler(h)
+    _root.setLevel(logging.WARNING)
+    _root.propagate = False
+
+logger = _root
+
+
+def get(name: str) -> logging.Logger:
+    return _root.getChild(name)
+
+
+def set_debug(on: bool = True) -> None:
+    _root.setLevel(logging.DEBUG if on else logging.WARNING)

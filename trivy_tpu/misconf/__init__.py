@@ -44,7 +44,45 @@ def set_custom_checks(check_paths, data_paths=None, namespaces=None):
     from ..iac.rego import RegoChecksScanner
     _custom_scanner = RegoChecksScanner.from_paths(
         check_paths, data_paths=data_paths, namespaces=namespaces)
+    _custom_scanner.fingerprint = _fingerprint_paths(
+        check_paths, data_paths, namespaces)
     return _custom_scanner
+
+
+def _fingerprint_paths(check_paths, data_paths, namespaces) -> str:
+    """Stable hash of check/data file contents + namespaces, mixed into
+    the layer cache key so cached blobs are invalidated when the policy
+    set changes (reference pkg/fanal/cache/key.go hashes policy
+    contents the same way)."""
+    import hashlib
+    import os
+    h = hashlib.sha256()
+    for group in (check_paths or []), (data_paths or []):
+        for p in group:
+            files = []
+            if os.path.isdir(p):
+                for root, _, names in os.walk(p):
+                    files.extend(os.path.join(root, n)
+                                 for n in sorted(names))
+            elif os.path.exists(p):
+                files = [p]
+            for fp in files:
+                h.update(fp.encode())
+                try:
+                    with open(fp, "rb") as f:
+                        h.update(f.read())
+                except OSError:
+                    pass
+        h.update(b"|")
+    h.update(",".join(sorted(namespaces or [])).encode())
+    return h.hexdigest()
+
+
+def custom_checks_fingerprint() -> str:
+    """'' when no custom checks are configured."""
+    if _custom_scanner is None:
+        return ""
+    return getattr(_custom_scanner, "fingerprint", "")
 
 
 def custom_checks_scanner():

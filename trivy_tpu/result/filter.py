@@ -33,10 +33,18 @@ class IgnorePolicy:
         self.interp = Interpreter([mod])
         self.pkg = ".".join(mod.package)
 
+    _warned = False
+
     def ignore(self, finding_doc: dict) -> bool:
         try:
             v = self.interp.query(f"{self.pkg}.ignore", finding_doc)
-        except Exception:
+        except Exception as e:
+            if not self._warned:
+                from ..log import logger
+                logger.warning(
+                    "ignore policy evaluation failed (policy has no "
+                    "effect): %s", e)
+                self._warned = True
             return False
         return v is True
 
