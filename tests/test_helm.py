@@ -1,0 +1,178 @@
+"""Helm chart rendering + scanning (reference pkg/iac/scanners/helm
+scanner_test.go: render chart → k8s checks over manifests)."""
+
+import io
+import gzip
+import tarfile
+
+from trivy_tpu.iac.helm import (Chart, find_charts, load_chart_dir,
+                                load_chart_tgz, render_chart,
+                                scan_chart_files)
+
+CHART_YAML = b"""\
+apiVersion: v2
+name: testchart
+version: 0.1.0
+appVersion: "1.16.0"
+"""
+
+VALUES_YAML = b"""\
+replicaCount: 2
+image:
+  repository: nginx
+  tag: "1.25"
+securityContext: {}
+"""
+
+HELPERS_TPL = b"""\
+{{- define "testchart.fullname" -}}
+{{ .Release.Name }}-{{ .Chart.Name }}
+{{- end }}
+{{- define "testchart.labels" -}}
+app: {{ .Chart.Name }}
+version: {{ .Chart.Version | quote }}
+{{- end }}
+"""
+
+DEPLOY_TPL = b"""\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {{ include "testchart.fullname" . }}
+  labels:
+    {{- include "testchart.labels" . | nindent 4 }}
+spec:
+  replicas: {{ .Values.replicaCount }}
+  template:
+    spec:
+      containers:
+        - name: {{ .Chart.Name }}
+          image: "{{ .Values.image.repository }}:{{ .Values.image.tag }}"
+          securityContext:
+            {{- toYaml .Values.securityContext | nindent 12 }}
+"""
+
+
+def chart_files():
+    return {
+        "Chart.yaml": CHART_YAML,
+        "values.yaml": VALUES_YAML,
+        "templates/_helpers.tpl": HELPERS_TPL,
+        "templates/deployment.yaml": DEPLOY_TPL,
+    }
+
+
+def test_render_basic_chart():
+    chart = load_chart_dir(chart_files())
+    assert chart.name == "testchart"
+    rendered = render_chart(chart)
+    assert list(rendered) == ["testchart/templates/deployment.yaml"]
+    text = rendered["testchart/templates/deployment.yaml"]
+    assert "name: testchart-testchart" in text
+    assert "replicas: 2" in text
+    assert 'image: "nginx:1.25"' in text
+    assert 'version: "0.1.0"' in text
+    assert "app: testchart" in text
+
+
+def test_values_override_and_conditionals():
+    files = dict(chart_files())
+    files["templates/service.yaml"] = b"""\
+{{- if .Values.service.enabled }}
+apiVersion: v1
+kind: Service
+metadata:
+  name: {{ .Release.Name }}-svc
+spec:
+  type: {{ .Values.service.type | default "ClusterIP" }}
+{{- end }}
+"""
+    files["values.yaml"] = VALUES_YAML + b"service:\n  enabled: false\n"
+    chart = load_chart_dir(files)
+    rendered = render_chart(chart)
+    assert "testchart/templates/service.yaml" not in rendered
+    rendered2 = render_chart(
+        chart, values_override={"service": {"enabled": True}})
+    assert "type: ClusterIP" in \
+        rendered2["testchart/templates/service.yaml"]
+
+
+def test_scan_chart_produces_k8s_findings():
+    records = scan_chart_files(chart_files())
+    assert len(records) == 1
+    rec = records[0]
+    assert rec.file_type == "helm"
+    assert rec.file_path == "testchart/templates/deployment.yaml"
+    ids = {f.id for f in rec.failures}
+    # rendered deployment has no runAsNonRoot etc. → KSV findings
+    assert "KSV012" in ids
+    assert all(f.type == "helm" for f in rec.failures)
+
+
+def test_chart_tgz_roundtrip():
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, content in chart_files().items():
+            ti = tarfile.TarInfo("testchart/" + name)
+            ti.size = len(content)
+            tf.addfile(ti, io.BytesIO(content))
+    tgz = gzip.compress(buf.getvalue())
+    chart = load_chart_tgz(tgz)
+    rendered = render_chart(chart)
+    assert "testchart/templates/deployment.yaml" in rendered
+
+
+def test_subchart_rendering():
+    files = dict(chart_files())
+    files["charts/sub/Chart.yaml"] = b"name: sub\nversion: 0.0.1\n"
+    files["charts/sub/values.yaml"] = b"port: 8080\n"
+    files["charts/sub/templates/cm.yaml"] = b"""\
+apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: {{ .Release.Name }}-sub
+data:
+  port: {{ .Values.port | quote }}
+"""
+    chart = load_chart_dir(files)
+    rendered = render_chart(chart)
+    sub = rendered["testchart/charts/sub/templates/cm.yaml"]
+    assert 'port: "8080"' in sub
+    # parent values override subchart defaults under its key
+    chart2 = load_chart_dir({
+        **files,
+        "values.yaml": VALUES_YAML + b"sub:\n  port: 9999\n"})
+    rendered2 = render_chart(chart2)
+    assert 'port: "9999"' in \
+        rendered2["testchart/charts/sub/templates/cm.yaml"]
+
+
+def test_find_charts_groups_by_root():
+    paths = [
+        "app/Chart.yaml", "app/values.yaml",
+        "app/templates/d.yaml", "app/charts/sub/Chart.yaml",
+        "other/file.txt",
+    ]
+    roots = find_charts(paths)
+    assert list(roots) == ["app"]
+    assert "app/charts/sub/Chart.yaml" in roots["app"]
+
+
+def test_fs_scan_picks_up_chart(tmp_path):
+    import os
+    from trivy_tpu.fanal.artifact import FilesystemArtifact
+    from trivy_tpu.fanal.cache import MemoryCache
+    root = tmp_path / "repo" / "mychart"
+    (root / "templates").mkdir(parents=True)
+    for name, content in chart_files().items():
+        p = root / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(content)
+    cache = MemoryCache()
+    art = FilesystemArtifact(str(tmp_path / "repo"), cache,
+                             scanners=("misconfig",))
+    ref = art.inspect()
+    blob = cache.blobs[ref.blob_ids[0]]
+    mcs = blob.get("Misconfigurations", [])
+    helm_records = [m for m in mcs if m.get("FileType") == "helm"]
+    assert helm_records, f"no helm records in {[m.get('FileType') for m in mcs]}"

@@ -58,6 +58,50 @@ class MisconfAnalyzer(Analyzer):
 
 
 @register_post
+class HelmPostAnalyzer(PostAnalyzer):
+    """Chart-scoped helm scanning: whole chart trees rendered with the
+    template engine then run through the kubernetes checks (reference
+    pkg/iac/scanners/helm renders via helm's engine)."""
+    name = "helm"
+    version = 1
+
+    def required(self, path: str, size: int = -1) -> bool:
+        base = path.rsplit("/", 1)[-1]
+        if base in ("Chart.yaml", "values.yaml", ".helmignore") or \
+                path.endswith((".tpl", ".tgz")):
+            return True
+        return "/templates/" in path or path.startswith("templates/")
+
+    def post_analyze(self, files: dict) -> Optional[AnalysisResult]:
+        from ...iac.helm import (find_charts, load_chart_tgz,
+                                 scan_chart_files, scan_rendered_chart)
+        records = []
+        # packaged charts (.tgz archives)
+        for path, content in files.items():
+            if not path.endswith(".tgz"):
+                continue
+            try:
+                chart = load_chart_tgz(content)
+            except Exception:
+                continue
+            if chart.templates:
+                records.extend(
+                    scan_rendered_chart(chart, prefix=path + ":"))
+        # chart directories
+        for root, paths in find_charts(list(files)).items():
+            rel = {p[len(root) + 1 if root else 0:]: files[p]
+                   for p in paths}
+            if "Chart.yaml" not in rel:
+                continue
+            records.extend(scan_chart_files(rel))
+        if not records:
+            return None
+        result = AnalysisResult()
+        result.misconfigurations = records
+        return result
+
+
+@register_post
 class TerraformPostAnalyzer(PostAnalyzer):
     """Module-scoped terraform scanning: all .tf/.tfvars of a directory
     evaluated together (reference terraform scanner operates on the

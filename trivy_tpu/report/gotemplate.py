@@ -103,6 +103,14 @@ class _Range:
         self.body, self.els = body, els
 
 
+class _TemplateCall:
+    __slots__ = ("name", "pipe")
+
+    def __init__(self, name, pipe):
+        self.name = name
+        self.pipe = pipe
+
+
 class _With:
     __slots__ = ("pipe", "body", "els")
 
@@ -115,10 +123,18 @@ class _With:
 # | ("call", name, args, fields) | ("paren", pipeline, fields)
 
 
+def _unquote_name(s: str) -> str:
+    s = s.strip()
+    if len(s) >= 2 and s[0] in "\"`" and s[-1] == s[0]:
+        return s[1:-1]
+    return s
+
+
 class _Parser:
     def __init__(self, text: str):
         self.items = self._split(text)
         self.i = 0
+        self.defines: dict[str, list] = {}
 
     @staticmethod
     def _split(text):
@@ -176,8 +192,32 @@ class _Parser:
                 nodes.append(self._parse_range(src[5:].strip()))
             elif word == "with":
                 nodes.append(self._parse_with(src[4:].strip()))
-            elif word in ("define", "template", "block"):
-                raise TemplateError(f"{word} is not supported")
+            elif word == "define":
+                name = _unquote_name(src[6:].strip())
+                body, term = self._parse_list()
+                if term != "end":
+                    raise TemplateError("define: missing {{end}}")
+                self.defines[name] = body
+            elif word == "block":
+                toks = _tokenize_action(src[5:].strip())
+                if not toks or toks[0][0] != "lit":
+                    raise TemplateError("block: expected name")
+                name = toks[0][1]
+                pipe = _parse_pipeline(toks[1:]) if len(toks) > 1 \
+                    else (None, [[("dot", [])]])
+                body, term = self._parse_list()
+                if term != "end":
+                    raise TemplateError("block: missing {{end}}")
+                self.defines[name] = body
+                nodes.append(_TemplateCall(name, pipe))
+            elif word == "template":
+                toks = _tokenize_action(src[8:].strip())
+                if not toks or toks[0][0] != "lit":
+                    raise TemplateError("template: expected name")
+                name = toks[0][1]
+                pipe = _parse_pipeline(toks[1:]) if len(toks) > 1 \
+                    else None
+                nodes.append(_TemplateCall(name, pipe))
             elif src:
                 nodes.append(_Action(_parse_pipeline(_tokenize_action(src))))
         if top:
@@ -646,16 +686,36 @@ class Template:
     (e.g. {"now": frozen_clock, "appVersion": lambda: version})."""
 
     def __init__(self, text: str, funcs: dict | None = None):
-        self.nodes = _Parser(text).parse()
+        p = _Parser(text)
+        self.nodes = p.parse()
+        self.defines = p.defines
         self.funcs = _builtin_funcs()
         if funcs:
             self.funcs.update(funcs)
+
+    def add_associated(self, text: str) -> None:
+        """Parse another file in the same template namespace (its
+        {{define}}s become callable here — helm's _helpers.tpl)."""
+        p = _Parser(text)
+        p.parse()
+        self.defines.update(p.defines)
 
     def render(self, data) -> str:
         out = []
         scope = _Scope()
         scope.declare("$", data)
         self._exec(self.nodes, data, scope, out)
+        return "".join(out)
+
+    def execute_template(self, name: str, data) -> str:
+        """Render a named {{define}} (backs helm's `include`)."""
+        nodes = self.defines.get(name)
+        if nodes is None:
+            raise TemplateError(f"undefined template {name!r}")
+        out = []
+        scope = _Scope()
+        scope.declare("$", data)
+        self._exec(nodes, data, scope, out)
         return "".join(out)
 
     def _exec(self, nodes, dot, scope, out):
@@ -672,6 +732,16 @@ class Template:
                     self._exec(n.body, dot, _Scope(scope), out)
                 else:
                     self._exec(n.els, dot, _Scope(scope), out)
+            elif isinstance(n, _TemplateCall):
+                sub = self.defines.get(n.name)
+                if sub is None:
+                    raise TemplateError(
+                        f"undefined template {n.name!r}")
+                sub_dot = self._pipe_value(n.pipe, dot, scope) \
+                    if n.pipe is not None else None
+                s = _Scope()
+                s.declare("$", sub_dot)
+                self._exec(sub, sub_dot, s, out)
             elif isinstance(n, _With):
                 v = self._pipe_value(n.pipe, dot, scope)
                 if _truthy(v):
