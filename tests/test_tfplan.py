@@ -1,0 +1,123 @@
+"""Terraform plan JSON scanning (reference
+pkg/iac/scanners/terraformplan/tfjson scanner_test.go)."""
+
+import json
+
+from trivy_tpu.iac.detection import sniff
+from trivy_tpu.iac.tfplan import plan_to_hcl, scan_plan_file
+
+PLAN = {
+    "format_version": "1.2",
+    "terraform_version": "1.7.0",
+    "planned_values": {
+        "root_module": {
+            "resources": [
+                {
+                    "address": "aws_s3_bucket.logs",
+                    "mode": "managed",
+                    "type": "aws_s3_bucket",
+                    "name": "logs",
+                    "provider_name":
+                        "registry.terraform.io/hashicorp/aws",
+                },
+                {
+                    "address": "aws_security_group.open",
+                    "mode": "managed",
+                    "type": "aws_security_group",
+                    "name": "open",
+                    "provider_name":
+                        "registry.terraform.io/hashicorp/aws",
+                },
+            ]
+        }
+    },
+    "resource_changes": [
+        {
+            "address": "aws_s3_bucket.logs",
+            "mode": "managed",
+            "type": "aws_s3_bucket",
+            "name": "logs",
+            "change": {
+                "actions": ["create"],
+                "before": None,
+                "after": {
+                    "bucket": "logs",
+                    "acl": "public-read-write",
+                    "tags": {"env": "dev"},
+                },
+            },
+        },
+        {
+            "address": "aws_security_group.open",
+            "mode": "managed",
+            "type": "aws_security_group",
+            "name": "open",
+            "change": {
+                "actions": ["create"],
+                "before": None,
+                "after": {
+                    "name": "open",
+                    "ingress": [{
+                        "from_port": 22, "to_port": 22,
+                        "protocol": "tcp",
+                        "cidr_blocks": ["0.0.0.0/0"],
+                    }],
+                },
+            },
+        },
+    ],
+    "configuration": {
+        "root_module": {
+            "resources": [{
+                "address": "aws_s3_bucket.logs",
+                "mode": "managed",
+                "type": "aws_s3_bucket",
+                "name": "logs",
+                "expressions": {
+                    "bucket": {"constant_value": "logs"},
+                },
+            }]
+        }
+    },
+}
+
+
+def test_plan_to_hcl():
+    hcl = plan_to_hcl(PLAN)
+    assert 'resource "aws_s3_bucket" "logs" {' in hcl
+    assert 'acl = "public-read-write"' in hcl
+    assert "ingress {" in hcl
+    assert 'cidr_blocks = ["0.0.0.0/0"]' in hcl
+    assert "from_port = 22" in hcl
+    # plain maps render as attribute maps, not blocks
+    assert 'tags = { "env" = "dev" }' in hcl
+
+
+def test_scan_plan_findings():
+    content = json.dumps(PLAN).encode()
+    records = scan_plan_file("tfplan.json", content)
+    assert records
+    assert all(r.file_type == "terraformplan" for r in records)
+    assert all(r.file_path == "tfplan.json" for r in records)
+    ids = {f.id for r in records for f in r.failures}
+    assert "AVD-AWS-0092" in ids   # public ACL
+    assert "AVD-AWS-0107" in ids   # open ingress
+
+
+def test_sniff_detects_plan():
+    content = json.dumps(PLAN).encode()
+    ftype, docs = sniff("tfplan.json", content)
+    assert ftype == "terraformplan"
+
+
+def test_analyzer_pipeline(tmp_path):
+    from trivy_tpu.fanal.artifact import FilesystemArtifact
+    from trivy_tpu.fanal.cache import MemoryCache
+    (tmp_path / "tfplan.json").write_text(json.dumps(PLAN))
+    cache = MemoryCache()
+    art = FilesystemArtifact(str(tmp_path), cache,
+                             scanners=("misconfig",))
+    ref = art.inspect()
+    blob = cache.blobs[ref.blob_ids[0]]
+    mcs = blob.get("Misconfigurations", [])
+    assert any(m.get("FileType") == "terraformplan" for m in mcs)

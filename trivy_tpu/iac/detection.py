@@ -32,7 +32,8 @@ def _is_k8s(data) -> bool:
 # bounded size and containing a dialect marker somewhere in the bytes
 # (a cheap substring scan, vs. the full position-aware parse)
 MAX_SNIFF_SIZE = 3 * 1024 * 1024
-_MARKERS = (b"apiVersion", b"AWSTemplateFormatVersion", b"Resources")
+_MARKERS = (b"apiVersion", b"AWSTemplateFormatVersion", b"Resources",
+            b"planned_values")
 
 
 def sniff(path: str, content: bytes):
@@ -70,8 +71,18 @@ def sniff(path: str, content: bytes):
                 return "cloudformation", docs
             if _is_k8s(doc):
                 return "kubernetes", docs
+            if _is_tfplan(doc):
+                return "terraformplan", docs
         return "", None
     return "", None
+
+
+def _is_tfplan(doc) -> bool:
+    """terraform show -json output (reference pkg/iac/detection
+    FileTypeTerraformPlanJSON: format_version + planned values)."""
+    return isinstance(doc, dict) and "format_version" in doc and \
+        ("planned_values" in doc or "resource_changes" in doc) and \
+        "terraform_version" in doc
 
 
 def detect_config_type(path: str, content: bytes) -> str:

@@ -22,10 +22,19 @@ def _scan_cloudformation(path, content, lines=None, docs=None):
     return scan_cloudformation(path, content, lines, docs=docs)
 
 
+def _scan_tfplan(path, content, lines=None, docs=None):
+    from ..iac.tfplan import scan_plan_file
+    records = scan_plan_file(path, content)
+    failures = [f for r in records for f in r.failures]
+    successes = sum(r.successes for r in records)
+    return failures, successes
+
+
 FILE_TYPES = {
     "dockerfile": scan_dockerfile,
     "kubernetes": _scan_kubernetes,
     "cloudformation": _scan_cloudformation,
+    "terraformplan": _scan_tfplan,
 }
 
 # ---- custom rego checks (reference pkg/misconf ScannerOption
