@@ -1,0 +1,189 @@
+"""Azure ARM template scanning (reference pkg/iac/scanners/azure/arm
+scanner_test.go + adapters/arm adapt_test.go shapes)."""
+
+import json
+
+from trivy_tpu.iac.azure import (ArmEvaluator, adapt_arm,
+                                 parse_deployment, scan_arm)
+from trivy_tpu.iac.cloud import UNKNOWN, Unknown
+from trivy_tpu.iac.detection import sniff
+
+SCHEMA = ("https://schema.management.azure.com/schemas/2019-04-01/"
+          "deploymentTemplate.json#")
+
+
+def template(resources, parameters=None, variables=None):
+    return json.dumps({
+        "$schema": SCHEMA,
+        "contentVersion": "1.0.0.0",
+        "parameters": parameters or {},
+        "variables": variables or {},
+        "resources": resources,
+    }, indent=2).encode()
+
+
+class TestExpressions:
+    def ev(self, params=None, variables=None):
+        return ArmEvaluator(params or {}, variables or {})
+
+    def test_literals_and_concat(self):
+        ev = self.ev()
+        assert ev.resolve_string("[concat('a', 'b', 'c')]") == "abc"
+        assert ev.resolve_string("plain") == "plain"
+        assert ev.resolve_string("[[escaped]") == "[escaped]"
+
+    def test_parameters_default_and_missing(self):
+        ev = self.ev({"env": {"type": "string",
+                              "defaultValue": "prod"}})
+        assert ev.resolve_string("[parameters('env')]") == "prod"
+        assert isinstance(
+            ev.resolve_string("[parameters('nope')]"), Unknown)
+
+    def test_variables_recursive(self):
+        ev = self.ev(
+            {"name": {"defaultValue": "x"}},
+            {"full": "[concat(parameters('name'), '-store')]"})
+        assert ev.resolve_string("[variables('full')]") == "x-store"
+
+    def test_functions(self):
+        ev = self.ev()
+        assert ev.resolve_string("[toLower('ABC')]") == "abc"
+        assert ev.resolve_string("[format('{0}-{1}', 'a', 1)]") == "a-1"
+        assert ev.resolve_string("[if(equals(1, 1), 'y', 'n')]") == "y"
+        assert ev.resolve_string("[length(createArray(1, 2, 3))]") == 3
+        assert ev.resolve_string("[union(createObject('a', 1), "
+                                 "createObject('b', 2))]") == \
+            {"a": 1, "b": 2}
+        assert isinstance(ev.resolve_string("[reference('x').y]"),
+                          Unknown)
+        # uniqueString is deterministic
+        a = ev.resolve_string("[uniqueString('seed')]")
+        assert a == ev.resolve_string("[uniqueString('seed')]")
+        assert len(a) == 13
+
+    def test_property_access(self):
+        ev = self.ev()
+        assert ev.resolve_string("[resourceGroup().location]") == \
+            "eastus"
+
+
+def test_parse_and_adapt_storage():
+    content = template([{
+        "type": "Microsoft.Storage/storageAccounts",
+        "apiVersion": "2022-09-01",
+        "name": "[concat('store', uniqueString('x'))]",
+        "properties": {
+            "supportsHttpsTrafficOnly": False,
+            "minimumTlsVersion": "TLS1_0",
+        },
+    }])
+    resources, _ = parse_deployment(content)
+    assert len(resources) == 1
+    adapted = adapt_arm(resources)
+    assert adapted[0].kind == "azurerm_storage_account"
+    assert adapted[0].val("enable_https_traffic_only") is False
+
+
+def test_scan_arm_findings():
+    content = template([
+        {
+            "type": "Microsoft.Storage/storageAccounts",
+            "name": "badstore",
+            "properties": {
+                "supportsHttpsTrafficOnly": False,
+                "minimumTlsVersion": "TLS1_0",
+            },
+        },
+        {
+            "type": "Microsoft.Network/networkSecurityGroups",
+            "name": "nsg",
+            "properties": {
+                "securityRules": [{
+                    "name": "ssh",
+                    "properties": {
+                        "access": "Allow",
+                        "direction": "Inbound",
+                        "sourceAddressPrefix": "*",
+                        "destinationPortRange": "22",
+                        "protocol": "Tcp",
+                    },
+                }],
+            },
+        },
+        {
+            "type": "Microsoft.KeyVault/vaults",
+            "name": "kv",
+            "properties": {},
+        },
+    ])
+    failures, successes = scan_arm("deploy.json", content)
+    ids = {f.id for f in failures}
+    assert "AVD-AZU-0008" in ids    # https off
+    assert "AVD-AZU-0011" in ids    # TLS1_0
+    assert "AVD-AZU-0047" in ids    # public ingress
+    assert "AVD-AZU-0050" in ids    # ssh open
+    assert "AVD-AZU-0016" in ids    # no purge protection
+    assert "AVD-AZU-0013" in ids    # no network acl
+    assert successes > 0
+    f = next(f for f in failures if f.id == "AVD-AZU-0008")
+    assert f.cause_metadata.provider == "Azure"
+    assert f.cause_metadata.start_line > 0
+
+
+def test_unknown_expression_passes():
+    content = template([{
+        "type": "Microsoft.Storage/storageAccounts",
+        "name": "s",
+        "properties": {
+            "supportsHttpsTrafficOnly":
+                "[reference('other').httpsOnly]",
+        },
+    }])
+    failures, _ = scan_arm("deploy.json", content)
+    assert not any(f.id == "AVD-AZU-0008" for f in failures)
+
+
+def test_nested_child_resources():
+    content = template([{
+        "type": "Microsoft.Sql/servers",
+        "name": "db",
+        "properties": {"minimalTlsVersion": "1.0"},
+        "resources": [{
+            "type": "firewallRules",
+            "name": "open",
+            "properties": {
+                "startIpAddress": "0.0.0.0",
+                "endIpAddress": "255.255.255.255",
+            },
+        }],
+    }])
+    failures, _ = scan_arm("deploy.json", content)
+    ids = {f.id for f in failures}
+    assert "AVD-AZU-0026" in ids
+    assert "AVD-AZU-0027" in ids
+
+
+def test_sniff_detects_arm():
+    content = template([])
+    ftype, docs = sniff("deploy.json", content)
+    assert ftype == "azure-arm"
+
+
+def test_analyzer_pipeline(tmp_path):
+    from trivy_tpu.fanal.artifact import FilesystemArtifact
+    from trivy_tpu.fanal.cache import MemoryCache
+    (tmp_path / "azuredeploy.json").write_bytes(template([{
+        "type": "Microsoft.Web/sites",
+        "name": "app",
+        "properties": {"httpsOnly": False},
+    }]))
+    cache = MemoryCache()
+    art = FilesystemArtifact(str(tmp_path), cache,
+                             scanners=("misconfig",))
+    ref = art.inspect()
+    blob = cache.blobs[ref.blob_ids[0]]
+    mcs = blob.get("Misconfigurations", [])
+    arm = [m for m in mcs if m.get("FileType") == "azure-arm"]
+    assert arm
+    assert any(f["ID"] == "AVD-AZU-0002"
+               for f in arm[0].get("Failures", []))

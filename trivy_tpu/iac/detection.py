@@ -33,7 +33,7 @@ def _is_k8s(data) -> bool:
 # (a cheap substring scan, vs. the full position-aware parse)
 MAX_SNIFF_SIZE = 3 * 1024 * 1024
 _MARKERS = (b"apiVersion", b"AWSTemplateFormatVersion", b"Resources",
-            b"planned_values")
+            b"planned_values", b"deploymentTemplate.json")
 
 
 def sniff(path: str, content: bytes):
@@ -73,8 +73,17 @@ def sniff(path: str, content: bytes):
                 return "kubernetes", docs
             if _is_tfplan(doc):
                 return "terraformplan", docs
+            if _is_arm(doc):
+                return "azure-arm", docs
         return "", None
     return "", None
+
+
+def _is_arm(doc) -> bool:
+    """ARM deployment template (reference pkg/iac/detection
+    FileTypeAzureARM: $schema …/deploymentTemplate.json)."""
+    return isinstance(doc, dict) and \
+        "deploymentTemplate.json" in str(doc.get("$schema", ""))
 
 
 def _is_tfplan(doc) -> bool:

@@ -30,11 +30,17 @@ def _scan_tfplan(path, content, lines=None, docs=None):
     return failures, successes
 
 
+def _scan_arm(path, content, lines=None, docs=None):
+    from ..iac.azure import scan_arm
+    return scan_arm(path, content, lines, docs)
+
+
 FILE_TYPES = {
     "dockerfile": scan_dockerfile,
     "kubernetes": _scan_kubernetes,
     "cloudformation": _scan_cloudformation,
     "terraformplan": _scan_tfplan,
+    "azure-arm": _scan_arm,
 }
 
 # ---- custom rego checks (reference pkg/misconf ScannerOption
