@@ -1,0 +1,159 @@
+"""Plugin subsystem (reference pkg/plugin) + extension modules
+(reference pkg/module WASM analog)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from trivy_tpu import cli, plugin
+from trivy_tpu import module as tmod
+
+
+@pytest.fixture(autouse=True)
+def home(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_HOME", str(tmp_path / "home"))
+    yield tmp_path / "home"
+    tmod.clear_modules()
+
+
+def make_plugin_dir(tmp_path, name="echo-plugin"):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "plugin.yaml").write_text(f"""\
+name: {name}
+version: 0.1.0
+usage: echoes args
+platforms:
+  - selector:
+      os: linux
+    uri: ./echo.sh
+    bin: ./echo.sh
+""")
+    (d / "echo.sh").write_text("#!/bin/sh\necho plugin-ran \"$@\"\n")
+    os.chmod(d / "echo.sh", 0o755)
+    return d
+
+
+class TestPlugin:
+    def test_install_from_dir_and_run(self, tmp_path, capfd):
+        src = make_plugin_dir(tmp_path)
+        p = plugin.install(str(src))
+        assert p.name == "echo-plugin"
+        assert plugin.exists("echo-plugin")
+        code = plugin.run("echo-plugin", ["hello"])
+        assert code == 0
+        out = capfd.readouterr().out
+        assert "plugin-ran hello" in out
+
+    def test_install_from_archive(self, tmp_path):
+        import tarfile
+        src = make_plugin_dir(tmp_path, "tar-plugin")
+        arc = tmp_path / "p.tar.gz"
+        with tarfile.open(arc, "w:gz") as tf:
+            tf.add(src, arcname="tar-plugin")
+        p = plugin.install(str(arc))
+        assert p.name == "tar-plugin"
+
+    def test_platform_selection(self, tmp_path):
+        d = tmp_path / "never"
+        d.mkdir()
+        (d / "plugin.yaml").write_text("""\
+name: never
+version: 1.0.0
+platforms:
+  - selector:
+      os: windows
+    bin: ./x.exe
+""")
+        p = plugin.install(str(d))
+        with pytest.raises(plugin.PluginError):
+            p.select_platform()
+
+    def test_uninstall_and_list(self, tmp_path):
+        plugin.install(str(make_plugin_dir(tmp_path)))
+        assert [p.name for p in plugin.load_all()] == ["echo-plugin"]
+        plugin.uninstall("echo-plugin")
+        assert plugin.load_all() == []
+
+    def test_cli_passthrough(self, tmp_path, capfd):
+        plugin.install(str(make_plugin_dir(tmp_path)))
+        code = cli.main(["echo-plugin", "a", "b"])
+        assert code == 0
+        assert "plugin-ran a b" in capfd.readouterr().out
+
+
+MODULE_SRC = textwrap.dedent('''\
+    name = "marker"
+    version = 1
+    required_files = [r"marker\\.txt$"]
+
+    def analyze(path, content):
+        return {"content": content.decode().strip()}
+
+    post_scan_spec = {"action": "insert"}
+
+    def post_scan(results):
+        return results
+''')
+
+
+class TestModule:
+    def test_load_and_analyze(self, home, tmp_path):
+        mdir = home / "modules"
+        mdir.mkdir(parents=True)
+        (mdir / "marker.py").write_text(MODULE_SRC)
+        mods = tmod.load_modules()
+        assert [m.name for m in mods] == ["marker"]
+
+        from trivy_tpu.fanal.artifact import FilesystemArtifact
+        from trivy_tpu.fanal.cache import MemoryCache
+        target = tmp_path / "t"
+        target.mkdir()
+        (target / "marker.txt").write_text("found-me")
+        cache = MemoryCache()
+        art = FilesystemArtifact(str(target), cache,
+                                 scanners=("vuln",))
+        ref = art.inspect()
+        blob = cache.blobs[ref.blob_ids[0]]
+        crs = blob.get("CustomResources", [])
+        assert crs and crs[0]["Type"] == "marker"
+        assert crs[0]["Data"]["content"] == "found-me"
+
+    def test_post_scan_delete(self, home):
+        mdir = home / "modules"
+        mdir.mkdir(parents=True)
+        (mdir / "dropper.py").write_text(textwrap.dedent('''\
+            name = "dropper"
+            version = 1
+            post_scan_spec = {"action": "delete",
+                              "ids": ["CVE-2023-0286"]}
+
+            def post_scan(results):
+                return results
+        '''))
+        tmod.load_modules()
+        from trivy_tpu import types as T
+        results = [T.Result(
+            target="t", clazz=T.ResultClass.OS_PKGS,
+            vulnerabilities=[
+                T.DetectedVulnerability(
+                    vulnerability_id="CVE-2023-0286", pkg_name="ssl"),
+                T.DetectedVulnerability(
+                    vulnerability_id="CVE-2025-26519", pkg_name="musl"),
+            ])]
+        out = tmod.apply_post_scan(results)
+        ids = [v.vulnerability_id for v in out[0].vulnerabilities]
+        assert ids == ["CVE-2025-26519"]
+
+    def test_module_versions_in_cache_key(self, home):
+        mdir = home / "modules"
+        mdir.mkdir(parents=True)
+        (mdir / "marker.py").write_text(MODULE_SRC)
+        tmod.load_modules()
+        from trivy_tpu.fanal.analyzers import AnalyzerGroup
+        versions = AnalyzerGroup().versions()
+        assert versions.get("module:marker") == 1
+        tmod.clear_modules()
+        assert "module:marker" not in AnalyzerGroup().versions()

@@ -123,6 +123,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", "-o", default="")
     p.add_argument("--exit-code", type=int, default=0)
 
+    p = sub.add_parser("plugin", help="manage subprocess plugins")
+    p.add_argument("plugin_action",
+                   choices=["install", "uninstall", "list", "info",
+                            "run"])
+    p.add_argument("plugin_arg", nargs="?", default="")
+    p.add_argument("plugin_args", nargs="*", default=[])
+
+    p = sub.add_parser("module", help="manage extension modules")
+    p.add_argument("module_action",
+                   choices=["install", "uninstall", "list"])
+    p.add_argument("module_arg", nargs="?", default="")
+
     sub.add_parser("version", help="print version")
     return ap
 
@@ -320,8 +332,71 @@ def cmd_k8s(args) -> int:
             out.close()
 
 
+def cmd_plugin(args) -> int:
+    from . import plugin
+    action = args.plugin_action
+    if action == "install":
+        plugin.install(args.plugin_arg)
+        return 0
+    if action == "uninstall":
+        plugin.uninstall(args.plugin_arg)
+        return 0
+    if action == "list":
+        for p in plugin.load_all():
+            print(f"{p.name}\t{p.version}\t{p.usage}")
+        return 0
+    if action == "info":
+        p = plugin.load(args.plugin_arg)
+        print(f"name: {p.name}\nversion: {p.version}\n"
+              f"usage: {p.usage}\ndescription: {p.description}")
+        return 0
+    if action == "run":
+        return plugin.run(args.plugin_arg, args.plugin_args)
+    raise SystemExit(f"unknown plugin action {action}")
+
+
+def cmd_module(args) -> int:
+    import shutil as _shutil
+    from .module import load_modules, modules_dir
+    action = args.module_action
+    if action == "install":
+        os.makedirs(modules_dir(), exist_ok=True)
+        _shutil.copy(args.module_arg, modules_dir())
+        print(f"installed module "
+              f"{os.path.basename(args.module_arg)}")
+        return 0
+    if action == "uninstall":
+        target = os.path.join(modules_dir(),
+                              os.path.basename(args.module_arg))
+        if os.path.exists(target):
+            os.unlink(target)
+        return 0
+    if action == "list":
+        for m in load_modules():
+            print(f"{m.name}\t{m.version}\t{m.path}")
+        return 0
+    raise SystemExit(f"unknown module action {action}")
+
+
 def main(argv=None) -> int:
+    import sys as _sys
+    argv = list(_sys.argv[1:]) if argv is None else list(argv)
+    # `trivy-tpu <plugin-name> args...` passthrough (reference
+    # cmd/trivy main.go TRIVY_RUN_AS_PLUGIN + plugin.Run:104)
+    if argv:
+        from . import plugin as _plugin
+        known = {"image", "filesystem", "fs", "rootfs", "repository",
+                 "repo", "sbom", "convert", "server", "k8s",
+                 "kubernetes", "version", "plugin", "module",
+                 "-h", "--help", "--version"}
+        if argv[0] not in known and _plugin.exists(argv[0]):
+            return _plugin.run(argv[0], argv[1:])
     args = build_parser().parse_args(argv)
+    # extension modules load for every scan command (reference
+    # initializes the WASM module manager in the runner lifecycle)
+    if args.command not in ("version", "plugin", "module"):
+        from .module import load_modules
+        load_modules()
     cmd = args.command
     if cmd == "version":
         print(f"trivy-tpu {__version__}")
@@ -338,6 +413,10 @@ def main(argv=None) -> int:
         return cmd_server(args)
     if cmd in ("k8s", "kubernetes"):
         return cmd_k8s(args)
+    if cmd == "plugin":
+        return cmd_plugin(args)
+    if cmd == "module":
+        return cmd_module(args)
     raise SystemExit(f"unknown command {cmd}")
 
 

@@ -28,6 +28,7 @@ class AnalysisResult:
     # SystemInstalledFiles)
     system_installed_files: list = field(default_factory=list)
     build_info: object = None  # Red Hat content sets / nvr+arch
+    custom_resources: list = field(default_factory=list)  # module output
 
     def merge(self, other: "AnalysisResult"):
         if other is None:
@@ -45,6 +46,7 @@ class AnalysisResult:
         self.secrets.extend(other.secrets)
         self.licenses.extend(other.licenses)
         self.system_installed_files.extend(other.system_installed_files)
+        self.custom_resources.extend(other.custom_resources)
         if other.build_info is not None:
             if self.build_info is None:
                 self.build_info = other.build_info
@@ -102,6 +104,18 @@ def all_analyzers() -> dict[str, type]:
     return dict(_REGISTRY)
 
 
+# extension modules (trivy_tpu.module) — WASM-analyzer analog; the
+# loaded set participates in dispatch and cache-key versions exactly
+# like built-in analyzers (reference pkg/module Register hooks into the
+# analyzer registry)
+_MODULE_ANALYZERS: list = []
+
+
+def set_module_analyzers(mods: list) -> None:
+    global _MODULE_ANALYZERS
+    _MODULE_ANALYZERS = list(mods)
+
+
 def _ensure_loaded():
     from . import (apk, binaries, dpkg, lockfiles,  # noqa: F401
                    lockfiles_extra, misconf, os_release, python,
@@ -121,10 +135,13 @@ class AnalyzerGroup:
         """name → version, for cache keys."""
         out = {a.name: a.version for a in self.analyzers}
         out.update({a.name: a.version for a in self.post_analyzers})
+        out.update({f"module:{m.name}": m.version
+                    for m in _MODULE_ANALYZERS})
         return out
 
     def required(self, path: str, size: int = -1) -> bool:
-        return any(a.required(path, size) for a in self.analyzers)
+        return any(a.required(path, size) for a in self.analyzers) or \
+            any(m.required(path) for m in _MODULE_ANALYZERS)
 
     def post_required(self, path: str, size: int = -1) -> bool:
         return any(a.required(path, size) for a in self.post_analyzers)
@@ -136,6 +153,16 @@ class AnalyzerGroup:
                 r = a.analyze(path, content)
                 if r is not None:
                     result.merge(r)
+        for m in _MODULE_ANALYZERS:
+            if m.required(path):
+                try:
+                    data = m.analyze(path, content)
+                except Exception:
+                    continue
+                if data:
+                    result.custom_resources.append({
+                        "Type": m.name, "FilePath": path,
+                        "Data": data})
 
     def post_analyze(self, files: dict,
                      result: AnalysisResult) -> None:

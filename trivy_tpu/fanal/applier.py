@@ -72,6 +72,8 @@ def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
         for f in mc.failures:
             f.layer = layer
         detail.misconfigurations.append(mc)
+    for blob in blobs:
+        detail.custom_resources.extend(blob.custom_resources)
 
     detail.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
     _aggregate_individual_apps(detail)

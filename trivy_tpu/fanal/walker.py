@@ -135,6 +135,7 @@ def blob_info(scan: BlobScan, diff_id: str = "",
                                  key=lambda m: m.file_path),
         secrets=r.secrets,
         licenses=r.licenses,
+        custom_resources=r.custom_resources,
         build_info=r.build_info,
     )
     from .handlers import post_handle

@@ -119,6 +119,19 @@ class LocalScanner:
                     licenses=licenses,
                 ))
 
+        # extension-module post-scan hooks (reference post.Scan at
+        # pkg/scanner/local/scan.go:162; custom resources travel as a
+        # ClassCustom result like module.go PostScan:478)
+        from .module import apply_post_scan, loaded_modules
+        if loaded_modules():
+            if detail.custom_resources:
+                results.append(T.Result(
+                    target="Custom",
+                    clazz=T.ResultClass.CUSTOM,
+                    custom_resources=detail.custom_resources,
+                ))
+            results = apply_post_scan(results)
+
         return results, os_info
 
 
