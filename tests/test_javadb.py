@@ -1,0 +1,91 @@
+"""Java DB sha1→GAV lookups (reference pkg/javadb/client_test.go)."""
+
+import hashlib
+import io
+import zipfile
+
+import pytest
+
+from trivy_tpu import javadb
+
+
+@pytest.fixture(autouse=True)
+def reset():
+    yield
+    javadb.set_db(None)
+
+
+def make_jar(entries=None) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("META-INF/MANIFEST.MF", "Manifest-Version: 1.0\n")
+        for name, content in (entries or {}).items():
+            z.writestr(name, content)
+    return buf.getvalue()
+
+
+def test_search_by_sha1(tmp_path):
+    jar = make_jar()
+    digest = hashlib.sha1(jar).hexdigest()
+    db = javadb.build_db(str(tmp_path / "j.db"), [
+        ("org.springframework", "spring-core", "5.3.0", digest, "jar"),
+    ])
+    assert db.search_by_sha1(digest) == \
+        ("org.springframework", "spring-core", "5.3.0")
+    assert db.search_by_sha1("00" * 20) is None
+
+
+def test_search_by_artifact_id_majority(tmp_path):
+    db = javadb.build_db(str(tmp_path / "j.db"), [
+        ("javax.servlet", "jstl", "1.2", "11" * 20, "jar"),
+        ("javax.servlet", "jstl", "1.2", "22" * 20, "jar"),
+        ("jstl", "jstl", "1.2", "33" * 20, "jar"),
+    ])
+    assert db.search_by_artifact_id("jstl", "1.2") == "javax.servlet"
+    assert db.search_by_artifact_id("nope", "1.0") == ""
+    assert db.exists("jstl", "jstl")
+    assert not db.exists("a", "b")
+
+
+def test_jar_analyzer_uses_sha1(tmp_path):
+    from trivy_tpu.fanal.analyzers.binaries import JarAnalyzer
+    jar = make_jar()
+    digest = hashlib.sha1(jar).hexdigest()
+    javadb.set_db(javadb.build_db(str(tmp_path / "j.db"), [
+        ("com.example", "lib", "2.0.1", digest, "jar"),
+    ]))
+    result = JarAnalyzer().analyze("app/lib.jar", jar)
+    pkg = result.applications[0].packages[0]
+    assert pkg.name == "com.example:lib"
+    assert pkg.version == "2.0.1"
+
+
+def test_jar_analyzer_filename_group_vote(tmp_path):
+    from trivy_tpu.fanal.analyzers.binaries import JarAnalyzer
+    jar = make_jar()
+    javadb.set_db(javadb.build_db(str(tmp_path / "j.db"), [
+        ("org.apache.logging.log4j", "log4j-core", "2.14.1",
+         "44" * 20, "jar"),
+    ]))
+    result = JarAnalyzer().analyze("lib/log4j-core-2.14.1.jar", jar)
+    pkg = result.applications[0].packages[0]
+    assert pkg.name == "org.apache.logging.log4j:log4j-core"
+
+
+def test_jar_analyzer_without_db_falls_back():
+    from trivy_tpu.fanal.analyzers.binaries import JarAnalyzer
+    javadb.set_db(None)
+    jar = make_jar({
+        "META-INF/maven/g/a/pom.properties":
+            "groupId=g\nartifactId=a\nversion=1.0\n"})
+    result = JarAnalyzer().analyze("a-1.0.jar", jar)
+    assert result.applications[0].packages[0].name == "g:a"
+
+
+def test_init_from_path(tmp_path):
+    p = str(tmp_path / "cache" / "javadb" / "trivy-java.db")
+    javadb.build_db(p, [("g", "a", "1", "55" * 20, "jar")]).close()
+    db = javadb.init(cache_dir=str(tmp_path / "cache"))
+    assert db is not None
+    assert javadb.get_db() is db
+    assert javadb.init(cache_dir=str(tmp_path / "nope")) is None

@@ -61,6 +61,9 @@ def _add_scan_flags(p: argparse.ArgumentParser):
                    help="extra rego namespaces to evaluate (comma-sep)")
     p.add_argument("--ignore-policy", default="",
                    help="OPA rego file deciding per-finding suppression")
+    p.add_argument("--java-db", default="",
+                   help="prebuilt trivy-java.db (sha1→GAV); defaults to "
+                        "<cache-dir>/javadb/trivy-java.db when present")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -218,6 +221,12 @@ def _auto_ignore_file():
     return None
 
 
+def _configure_javadb(args) -> None:
+    from . import javadb
+    javadb.init(cache_dir=getattr(args, "cache_dir", ""),
+                path=getattr(args, "java_db", ""))
+
+
 def _configure_misconf(args) -> None:
     """Install user rego checks before analysis runs (reference wires
     PolicyPaths through misconf.ScannerOption at initScannerConfig)."""
@@ -235,6 +244,7 @@ def cmd_image(args) -> int:
     from .fanal.artifact import ImageArchiveArtifact
     from .fanal.cache import FSCache
     _configure_misconf(args)
+    _configure_javadb(args)
     if not args.input:
         raise SystemExit("--input <archive> required (daemon/registry "
                          "sources need docker/network access)")
@@ -251,6 +261,7 @@ def cmd_fs(args) -> int:
     from .fanal.artifact import FilesystemArtifact
     from .fanal.cache import MemoryCache
     _configure_misconf(args)
+    _configure_javadb(args)
     cache = MemoryCache()
     scanners = tuple(s.strip() for s in args.scanners.split(","))
     art = FilesystemArtifact(args.target, cache, scanners=scanners)

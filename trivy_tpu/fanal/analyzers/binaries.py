@@ -106,11 +106,12 @@ _JAR_NAME = re.compile(r"^(?P<name>[A-Za-z0-9._-]+?)-"
 
 @register
 class JarAnalyzer(Analyzer):
-    """JAR/WAR/EAR: pom.properties (groupId:artifactId) → manifest →
-    filename heuristic. The sha1→GAV Java DB lookup lands with the
-    javadb port."""
+    """JAR/WAR/EAR identification order mirrors the reference jar
+    parser (pkg/dependency/parser/java/jar): Java-DB sha1 → GAV first
+    (exact), then pom.properties, then filename heuristic with Java-DB
+    group_id majority vote."""
     name = "jar"
-    version = 1
+    version = 2
 
     def required(self, path: str, size: int = -1) -> bool:
         return path.endswith((".jar", ".war", ".ear", ".par"))
@@ -121,6 +122,19 @@ class JarAnalyzer(Analyzer):
             zf = zipfile.ZipFile(io.BytesIO(content))
         except (zipfile.BadZipFile, OSError):
             return None
+        from ...javadb import get_db
+        jdb = get_db()
+        if jdb is not None:
+            import hashlib
+            digest = hashlib.sha1(content).hexdigest()  # noqa: S324
+            hit = jdb.search_by_sha1(digest)
+            if hit:
+                gid, aid, ver = hit
+                full = f"{gid}:{aid}"
+                return AnalysisResult(applications=[T.Application(
+                    type="jar", file_path=path,
+                    packages=[T.Package(id=f"{full}@{ver}", name=full,
+                                        version=ver, file_path=path)])])
         props = [n for n in zf.namelist()
                  if n.endswith("pom.properties")]
         for name in props:
@@ -143,9 +157,14 @@ class JarAnalyzer(Analyzer):
             base = path.rsplit("/", 1)[-1]
             m = _JAR_NAME.match(base)
             if m:
+                name, version = m.group("name"), m.group("version")
+                if jdb is not None:
+                    gid = jdb.search_by_artifact_id(name, version)
+                    if gid:
+                        name = f"{gid}:{name}"
                 pkgs.append(T.Package(
-                    id=f"{m.group('name')}@{m.group('version')}",
-                    name=m.group("name"), version=m.group("version"),
+                    id=f"{name}@{version}",
+                    name=name, version=version,
                     file_path=path))
         if not pkgs:
             return None

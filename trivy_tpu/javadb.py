@@ -1,0 +1,129 @@
+"""Java DB — jar sha1 → Maven GAV lookup (reference pkg/javadb).
+
+The reference downloads `trivy-java-db` (an sqlite database) as an OCI
+artifact with a 3-day update gate (client.go Update:39-80) and queries
+it from the jar analyzer: SearchBySHA1 resolves a whole-file digest to
+group:artifact:version; SearchByArtifactID picks the most common
+group_id for an artifact name (client.go:151-180).
+
+Schema (trivy-java-db): table `indices`
+(group_id, artifact_id, version, sha1 BLOB, archive_type).
+
+Zero-egress environments initialize from a prebuilt db file or fixture
+entries (`build_db`); `init()` wires the singleton the jar analyzer
+consults.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import time
+
+from .log import logger
+
+UPDATE_INTERVAL_S = 3 * 24 * 3600  # client.go: 3-day refresh gate
+
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS indices (
+    group_id TEXT,
+    artifact_id TEXT,
+    version TEXT,
+    sha1 BLOB,
+    archive_type TEXT
+);
+CREATE INDEX IF NOT EXISTS indices_sha1 ON indices (sha1);
+CREATE INDEX IF NOT EXISTS indices_artifact
+    ON indices (artifact_id, version);
+"""
+
+
+class JavaDB:
+    def __init__(self, path: str):
+        self.path = path
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+
+    def close(self):
+        self.conn.close()
+
+    def search_by_sha1(self, sha1_hex: str):
+        """→ (group_id, artifact_id, version) or None."""
+        cur = self.conn.execute(
+            "SELECT group_id, artifact_id, version FROM indices "
+            "WHERE sha1 = ? LIMIT 1", (bytes.fromhex(sha1_hex),))
+        row = cur.fetchone()
+        return tuple(row) if row else None
+
+    def search_by_artifact_id(self, artifact_id: str,
+                              version: str) -> str:
+        """Most frequent group_id among rows with this artifact id
+        (client.go SearchByArtifactID majority vote)."""
+        cur = self.conn.execute(
+            "SELECT group_id, COUNT(*) AS n FROM indices "
+            "WHERE artifact_id = ? AND version = ? "
+            "AND archive_type = 'jar' "
+            "GROUP BY group_id ORDER BY n DESC, group_id ASC LIMIT 1",
+            (artifact_id, version))
+        row = cur.fetchone()
+        return row[0] if row else ""
+
+    def exists(self, group_id: str, artifact_id: str) -> bool:
+        cur = self.conn.execute(
+            "SELECT 1 FROM indices WHERE group_id = ? AND "
+            "artifact_id = ? LIMIT 1", (group_id, artifact_id))
+        return cur.fetchone() is not None
+
+
+def build_db(path: str, entries) -> JavaDB:
+    """entries: iterable of (group_id, artifact_id, version, sha1_hex,
+    archive_type) — fixture builder (reference pkg/dbtest InitJavaDB)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    conn = sqlite3.connect(path)
+    conn.executescript(SCHEMA)
+    conn.executemany(
+        "INSERT INTO indices VALUES (?, ?, ?, ?, ?)",
+        [(g, a, v, bytes.fromhex(s), t) for g, a, v, s, t in entries])
+    conn.commit()
+    conn.close()
+    return JavaDB(path)
+
+
+_db: JavaDB | None = None
+
+
+def db_path(cache_dir: str) -> str:
+    return os.path.join(cache_dir, "javadb", "trivy-java.db")
+
+
+def init(cache_dir: str = "", path: str = "") -> JavaDB | None:
+    """Open the Java DB if present; None (with one warning) otherwise.
+    The OCI download path of the reference needs egress — here a
+    prebuilt file is supplied out of band."""
+    global _db
+    p = path or (db_path(cache_dir) if cache_dir else "")
+    if not p or not os.path.exists(p):
+        _db = None
+        return None
+    meta = os.path.join(os.path.dirname(p), "metadata.json")
+    if os.path.exists(meta):
+        try:
+            with open(meta, encoding="utf-8") as f:
+                downloaded_at = json.load(f).get("DownloadedAt", 0)
+            if isinstance(downloaded_at, (int, float)) and \
+                    time.time() - downloaded_at > UPDATE_INTERVAL_S:
+                logger.warning(
+                    "java db is older than 3 days; refresh it")
+        except (OSError, json.JSONDecodeError):
+            pass
+    _db = JavaDB(p)
+    return _db
+
+
+def set_db(db: JavaDB | None) -> None:
+    global _db
+    _db = db
+
+
+def get_db() -> JavaDB | None:
+    return _db
