@@ -1,0 +1,185 @@
+"""Redis cache backend against an in-process fake redis (the reference
+tests use testcontainers; our fake speaks enough RESP2 —
+integration/client_server_test.go setupRedis)."""
+
+import socket
+import threading
+
+import pytest
+
+from trivy_tpu import types as T
+from trivy_tpu.fanal.redis_cache import RedisCache, RespClient
+
+
+class FakeRedis:
+    """Tiny RESP2 server: SET/GET/EXISTS/DEL/SCAN/AUTH/SELECT/EX."""
+
+    def __init__(self, password=""):
+        self.data = {}
+        self.password = password
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        buf = b""
+        authed = not self.password
+        while True:
+            try:
+                chunk = conn.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while True:
+                cmd, buf2 = self._parse(buf)
+                if cmd is None:
+                    break
+                buf = buf2
+                reply, authed = self._dispatch(cmd, authed)
+                try:
+                    conn.sendall(reply)
+                except OSError:
+                    return
+
+    @staticmethod
+    def _parse(buf):
+        if not buf.startswith(b"*"):
+            return None, buf
+        try:
+            head, rest = buf.split(b"\r\n", 1)
+            n = int(head[1:])
+            args = []
+            for _ in range(n):
+                if not rest.startswith(b"$"):
+                    return None, buf
+                lhead, rest2 = rest.split(b"\r\n", 1)
+                ln = int(lhead[1:])
+                if len(rest2) < ln + 2:
+                    return None, buf
+                args.append(rest2[:ln])
+                rest = rest2[ln + 2:]
+            return args, rest
+        except (ValueError, IndexError):
+            return None, buf
+
+    def _dispatch(self, args, authed):
+        cmd = args[0].decode().upper()
+        if cmd == "AUTH":
+            if args[1].decode() == self.password:
+                return b"+OK\r\n", True
+            return b"-ERR invalid password\r\n", authed
+        if not authed:
+            return b"-NOAUTH Authentication required.\r\n", authed
+        if cmd == "SELECT":
+            return b"+OK\r\n", authed
+        if cmd == "SET":
+            self.data[args[1]] = args[2]
+            return b"+OK\r\n", authed
+        if cmd == "GET":
+            v = self.data.get(args[1])
+            if v is None:
+                return b"$-1\r\n", authed
+            return b"$%d\r\n%s\r\n" % (len(v), v), authed
+        if cmd == "EXISTS":
+            return b":%d\r\n" % (1 if args[1] in self.data else 0), \
+                authed
+        if cmd == "DEL":
+            n = 1 if self.data.pop(args[1], None) is not None else 0
+            return b":%d\r\n" % n, authed
+        if cmd == "SCAN":
+            import fnmatch
+            pat = b"*"
+            for i, a in enumerate(args):
+                if a.upper() == b"MATCH":
+                    pat = args[i + 1]
+            keys = [k for k in self.data
+                    if fnmatch.fnmatch(k.decode(), pat.decode())]
+            out = b"*2\r\n$1\r\n0\r\n*%d\r\n" % len(keys)
+            for k in keys:
+                out += b"$%d\r\n%s\r\n" % (len(k), k)
+            return out, authed
+        return b"-ERR unknown command\r\n", authed
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture()
+def fake():
+    srv = FakeRedis()
+    yield srv
+    srv.close()
+
+
+def test_roundtrip(fake):
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    blob = T.BlobInfo(diff_id="sha256:abc", os=T.OS(
+        family="alpine", name="3.17.3"))
+    cache.put_blob("blob1", blob)
+    cache.put_artifact("art1", {"SchemaVersion": 2})
+    got = cache.get_blob("blob1")
+    assert got.os.family == "alpine"
+    assert cache.get_artifact("art1") == {"SchemaVersion": 2}
+    assert cache.get_blob("nope") is None
+
+    missing_artifact, missing = cache.missing_blobs(
+        "art1", ["blob1", "blob2"])
+    assert not missing_artifact
+    assert missing == ["blob2"]
+
+    cache.delete_blobs(["blob1"])
+    assert cache.get_blob("blob1") is None
+
+
+def test_key_scheme(fake):
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    cache.put_artifact("sha256:xyz", {"A": 1})
+    assert b"fanal::artifact::sha256:xyz" in fake.data
+
+
+def test_clear_only_fanal_keys(fake):
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    cache.put_artifact("a", {})
+    fake.data[b"other::key"] = b"1"
+    cache.clear()
+    assert b"other::key" in fake.data
+    assert not any(k.startswith(b"fanal::") for k in fake.data)
+
+
+def test_auth():
+    srv = FakeRedis(password="s3cret")
+    try:
+        cache = RedisCache(f"redis://:s3cret@127.0.0.1:{srv.port}")
+        cache.put_artifact("a", {"ok": True})
+        assert cache.get_artifact("a") == {"ok": True}
+        with pytest.raises(Exception):
+            RespClient("127.0.0.1", srv.port,
+                       password="wrong").command("GET", "x")
+    finally:
+        srv.close()
+
+
+def test_fs_scan_with_redis_cache(fake, tmp_path):
+    from trivy_tpu.fanal.artifact import FilesystemArtifact
+    (tmp_path / "requirements.txt").write_text("flask==0.5\n")
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    art = FilesystemArtifact(str(tmp_path), cache, scanners=("vuln",))
+    ref = art.inspect()
+    blob = cache.get_blob(ref.blob_ids[0])
+    assert blob is not None
+    assert blob.applications

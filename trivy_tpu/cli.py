@@ -61,6 +61,8 @@ def _add_scan_flags(p: argparse.ArgumentParser):
                    help="extra rego namespaces to evaluate (comma-sep)")
     p.add_argument("--ignore-policy", default="",
                    help="OPA rego file deciding per-finding suppression")
+    p.add_argument("--cache-backend", default="fs",
+                   help="fs | memory | redis://host:port[/db]")
     p.add_argument("--java-db", default="",
                    help="prebuilt trivy-java.db (sha1→GAV); defaults to "
                         "<cache-dir>/javadb/trivy-java.db when present")
@@ -110,6 +112,8 @@ def build_parser() -> argparse.ArgumentParser:
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "trivy-tpu"))
     p.add_argument("--token", default="")
+    p.add_argument("--cache-backend", default="fs",
+                   help="fs | redis://host:port[/db]")
 
     p = sub.add_parser("k8s", aliases=["kubernetes"],
                        help="scan a kubernetes cluster")
@@ -240,15 +244,28 @@ def _configure_misconf(args) -> None:
                           namespaces=ns)
 
 
+def _open_cache(args):
+    """Cache backend selection (reference initCache run.go:344:
+    fs / redis / memory)."""
+    backend = getattr(args, "cache_backend", "fs")
+    if backend.startswith("redis://"):
+        from .fanal.redis_cache import RedisCache
+        return RedisCache(backend)
+    if backend == "memory":
+        from .fanal.cache import MemoryCache
+        return MemoryCache()
+    from .fanal.cache import FSCache
+    return FSCache(args.cache_dir)
+
+
 def cmd_image(args) -> int:
     from .fanal.artifact import ImageArchiveArtifact
-    from .fanal.cache import FSCache
     _configure_misconf(args)
     _configure_javadb(args)
     if not args.input:
         raise SystemExit("--input <archive> required (daemon/registry "
                          "sources need docker/network access)")
-    cache = FSCache(args.cache_dir)
+    cache = _open_cache(args)
     scanners = tuple(s.strip() for s in args.scanners.split(","))
     art = ImageArchiveArtifact(args.input, cache, scanners=scanners)
     ref = art.inspect()
@@ -297,7 +314,8 @@ def cmd_server(args) -> int:
     table = load_table(args.db)
     host, _, port = args.listen.rpartition(":")
     serve(host or "0.0.0.0", int(port), table, cache_dir=args.cache_dir,
-          token=args.token)
+          token=args.token,
+          cache_backend=getattr(args, "cache_backend", "fs"))
     return 0
 
 

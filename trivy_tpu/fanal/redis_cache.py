@@ -1,0 +1,172 @@
+"""Redis cache backend (reference pkg/fanal/cache/redis.go).
+
+A dependency-free RESP2 client over a TCP socket implements the same
+key scheme as the reference (`fanal::artifact::<id>`,
+`fanal::blob::<id>`, JSON values, optional TTL). The shared Redis
+instance is the coordination plane for client/server fleets —
+SURVEY.md §2.7 P4.
+
+URL format: redis://[:password@]host:port[/db].
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Optional
+from urllib.parse import urlparse
+
+from .. import types as T
+from .cache import blob_from_json
+
+PREFIX = "fanal"
+
+
+class RedisError(Exception):
+    pass
+
+
+class RespClient:
+    """Minimal RESP2 protocol client (SET/GET/EXISTS/DEL/AUTH/SELECT)."""
+
+    def __init__(self, host: str, port: int, password: str = "",
+                 db: int = 0, timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self.buf = b""
+        if password:
+            self.command("AUTH", password)
+        if db:
+            self.command("SELECT", str(db))
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def command(self, *args):
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            if isinstance(a, str):
+                a = a.encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+        self.sock.sendall(b"".join(out))
+        return self._read_reply()
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise RedisError("connection closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise RedisError("connection closed")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n + 2:]
+        return data
+
+    def _read_reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RedisError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            return self._read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise RedisError(f"bad reply {line!r}")
+
+
+class RedisCache:
+    """ArtifactCache + LocalArtifactCache over Redis (redis.go:19-120)."""
+
+    def __init__(self, url: str, ttl_seconds: int = 0):
+        u = urlparse(url)
+        if u.scheme != "redis":
+            raise RedisError(f"unsupported scheme {u.scheme!r}")
+        db = 0
+        if u.path and u.path.strip("/").isdigit():
+            db = int(u.path.strip("/"))
+        self.client = RespClient(u.hostname or "localhost",
+                                 u.port or 6379,
+                                 password=u.password or "", db=db)
+        self.ttl = ttl_seconds
+
+    def close(self):
+        self.client.close()
+
+    @staticmethod
+    def _akey(artifact_id: str) -> str:
+        return f"{PREFIX}::artifact::{artifact_id}"
+
+    @staticmethod
+    def _bkey(blob_id: str) -> str:
+        return f"{PREFIX}::blob::{blob_id}"
+
+    def _set(self, key: str, value: dict):
+        data = json.dumps(value)
+        if self.ttl > 0:
+            self.client.command("SET", key, data, "EX", str(self.ttl))
+        else:
+            self.client.command("SET", key, data)
+
+    def put_artifact(self, artifact_id: str, info: dict):
+        self._set(self._akey(artifact_id), info)
+
+    def put_blob(self, blob_id: str, blob: T.BlobInfo):
+        self._set(self._bkey(blob_id), blob.to_json())
+
+    def get_artifact(self, artifact_id: str) -> Optional[dict]:
+        raw = self.client.command("GET", self._akey(artifact_id))
+        return json.loads(raw) if raw is not None else None
+
+    def get_blob(self, blob_id: str) -> Optional[T.BlobInfo]:
+        raw = self.client.command("GET", self._bkey(blob_id))
+        return blob_from_json(json.loads(raw)) if raw is not None \
+            else None
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list[str]
+                      ) -> tuple[bool, list[str]]:
+        missing = [b for b in blob_ids
+                   if not self.client.command("EXISTS", self._bkey(b))]
+        missing_artifact = not self.client.command(
+            "EXISTS", self._akey(artifact_id))
+        return missing_artifact, missing
+
+    def delete_blobs(self, blob_ids: list[str]):
+        for b in blob_ids:
+            self.client.command("DEL", self._bkey(b))
+
+    def clear(self):
+        # only our keys, like redis.go Clear (SCAN+DEL on fanal::*)
+        cursor = "0"
+        while True:
+            reply = self.client.command("SCAN", cursor, "MATCH",
+                                        f"{PREFIX}::*", "COUNT", "100")
+            cursor = reply[0].decode() if isinstance(reply[0], bytes) \
+                else str(reply[0])
+            for key in reply[1] or []:
+                self.client.command("DEL", key)
+            if cursor == "0":
+                break
+
+
+def open_cache(url: str, ttl_seconds: int = 0) -> RedisCache:
+    return RedisCache(url, ttl_seconds)

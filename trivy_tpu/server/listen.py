@@ -27,8 +27,13 @@ TOKEN_HEADER = "Trivy-Token"
 
 
 class ServerState:
-    def __init__(self, table, cache_dir: str, token: str = ""):
-        self.cache = FSCache(cache_dir)
+    def __init__(self, table, cache_dir: str, token: str = "",
+                 cache_backend: str = "fs"):
+        if cache_backend.startswith("redis://"):
+            from ..fanal.redis_cache import RedisCache
+            self.cache = RedisCache(cache_backend)
+        else:
+            self.cache = FSCache(cache_dir)
         self.token = token
         self._lock = threading.Lock()
         self._scanner = LocalScanner(self.cache, table)
@@ -134,8 +139,9 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def serve(host: str, port: int, table, cache_dir: str, token: str = "",
-          ready_event: threading.Event | None = None):
-    Handler.state = ServerState(table, cache_dir, token)
+          ready_event: threading.Event | None = None,
+          cache_backend: str = "fs"):
+    Handler.state = ServerState(table, cache_dir, token, cache_backend)
     httpd = ThreadingHTTPServer((host, port), Handler)
     if ready_event is not None:
         ready_event.set()
