@@ -1,0 +1,175 @@
+"""Protobuf binary Twirp wire compat (reference rpc/*.proto field
+numbers; the Go client's default encoding)."""
+
+import json
+import os
+import socket
+import urllib.request
+
+import pytest
+
+from helpers import ALPINE_OS_RELEASE, APK_INSTALLED, make_image
+from trivy_tpu.server.protowire import decode_msg, encode_msg
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "db")
+FIXGLOB = os.path.join(FIXDIR, "*.yaml")
+
+
+class TestCodec:
+    def test_scalar_roundtrip(self):
+        msg = {"family": "alpine", "name": "3.17.3", "eosl": True}
+        data = encode_msg(msg, "OS")
+        assert decode_msg(data, "OS") == msg
+
+    def test_nested_and_repeated(self):
+        msg = {
+            "target": "img:latest",
+            "artifact_id": "sha256:a",
+            "blob_ids": ["sha256:b1", "sha256:b2"],
+            "options": {"scanners": ["vuln", "secret"],
+                        "list_all_packages": True},
+        }
+        data = encode_msg(msg, "ScanRequest")
+        out = decode_msg(data, "ScanRequest")
+        assert out == msg
+
+    def test_map_and_enum(self):
+        msg = {
+            "vulnerability_id": "CVE-2023-0286",
+            "severity": 4,
+            "cvss": {"nvd": {"v3_vector": "AV:N", "v3_score": 9.8}},
+            "vendor_severity": {"nvd": 3},
+        }
+        data = encode_msg(msg, "Vulnerability")
+        out = decode_msg(data, "Vulnerability")
+        assert out["severity"] == 4
+        assert out["cvss"]["nvd"]["v3_score"] == 9.8
+        assert out["vendor_severity"] == {"nvd": 3}
+
+    def test_timestamp_and_value(self):
+        msg = {"type": "custom", "file_path": "f",
+               "data": {"k": [1, "two", True, None]}}
+        data = encode_msg(msg, "CustomResource")
+        out = decode_msg(data, "CustomResource")
+        assert out["data"] == {"k": [1.0, "two", True, None]}
+
+    def test_unknown_fields_skipped(self):
+        # encode a Package, decode as OS: unknown tags are skipped
+        data = encode_msg({"name": "musl", "version": "1.2"}, "Package")
+        out = decode_msg(data, "OS")
+        assert out.get("family", "") in ("", "musl")
+
+    def test_blob_info_roundtrip(self):
+        msg = {
+            "schema_version": 2,
+            "os": {"family": "alpine", "name": "3.17.3"},
+            "diff_id": "sha256:x",
+            "package_infos": [{
+                "file_path": "lib/apk/db/installed",
+                "packages": [{"name": "musl", "version": "1.2.3-r4",
+                              "src_name": "musl"}],
+            }],
+            "opaque_dirs": ["a/", "b/"],
+        }
+        out = decode_msg(encode_msg(msg, "BlobInfo"), "BlobInfo")
+        assert out == msg
+
+
+@pytest.fixture()
+def proto_server(tmp_path):
+    from trivy_tpu.cli import load_table
+    from trivy_tpu.server.listen import serve_background
+    table = load_table(FIXGLOB)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    httpd, state = serve_background("127.0.0.1", port, table,
+                                    str(tmp_path / "cache"))
+    yield f"http://127.0.0.1:{port}", state
+    httpd.shutdown()
+
+
+def _post(url, body, ctype="application/protobuf"):
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.headers.get("Content-Type"), r.read()
+
+
+def test_proto_end_to_end(proto_server, tmp_path):
+    base, state = proto_server
+    # analyze locally (like the reference client), put blob via proto
+    from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+    from trivy_tpu.fanal.cache import MemoryCache
+    img = str(tmp_path / "img.tar")
+    make_image(img, [{
+        "etc/os-release": ALPINE_OS_RELEASE,
+        "etc/alpine-release": b"3.17.3\n",
+        "lib/apk/db/installed": APK_INSTALLED,
+    }])
+    local = MemoryCache()
+    art = ImageArchiveArtifact(img, local, scanners=("vuln",))
+    ref = art.inspect()
+
+    # convert our stored blob JSON into a proto BlobInfo
+    blob_j = local.blobs[ref.blob_ids[0]]
+    os_j = blob_j.get("OS", {})
+    proto_blob = {
+        "schema_version": 2,
+        "os": {"family": os_j.get("Family", ""),
+               "name": os_j.get("Name", "")},
+        "diff_id": blob_j.get("DiffID", ""),
+        "package_infos": [{
+            "file_path": pi.get("FilePath", ""),
+            "packages": [{
+                "name": p.get("Name", ""),
+                "version": p.get("Version", ""),
+                "release": p.get("Release", ""),
+                "src_name": p.get("SrcName", ""),
+                "src_version": p.get("SrcVersion", ""),
+                "src_release": p.get("SrcRelease", ""),
+                "licenses": p.get("Licenses", []),
+            } for p in pi.get("Packages", [])],
+        } for pi in blob_j.get("PackageInfos", [])],
+    }
+    body = encode_msg({"diff_id": ref.blob_ids[0],
+                       "blob_info": proto_blob}, "PutBlobRequest")
+    ctype, raw = _post(f"{base}/twirp/trivy.cache.v1.Cache/PutBlob",
+                       body)
+    assert ctype == "application/protobuf"
+
+    # MissingBlobs over proto
+    body = encode_msg({"artifact_id": ref.id,
+                       "blob_ids": ref.blob_ids},
+                      "MissingBlobsRequest")
+    _, raw = _post(f"{base}/twirp/trivy.cache.v1.Cache/MissingBlobs",
+                   body)
+    out = decode_msg(raw, "MissingBlobsResponse")
+    assert out.get("missing_blob_ids") is None or \
+        out.get("missing_blob_ids") == []
+
+    # Scan over proto
+    body = encode_msg({
+        "target": "test/image:latest",
+        "artifact_id": ref.id,
+        "blob_ids": ref.blob_ids,
+        "options": {"scanners": ["vuln"]},
+    }, "ScanRequest")
+    _, raw = _post(f"{base}/twirp/trivy.scanner.v1.Scanner/Scan", body)
+    resp = decode_msg(raw, "ScanResponse")
+    assert resp["os"]["family"] == "alpine"
+    vulns = resp["results"][0]["vulnerabilities"]
+    ids = {v["vulnerability_id"] for v in vulns}
+    assert "CVE-2023-0286" in ids
+    sev = next(v for v in vulns
+               if v["vulnerability_id"] == "CVE-2023-0286")
+    assert sev["severity"] in (1, 2, 3, 4)
+    assert sev["pkg_name"]
+
+    # JSON on the same server still works
+    jbody = json.dumps({"artifact_id": ref.id,
+                        "blob_ids": ref.blob_ids}).encode()
+    ctype, raw = _post(f"{base}/twirp/trivy.cache.v1.Cache/MissingBlobs",
+                       jbody, ctype="application/json")
+    assert "json" in ctype
+    assert json.loads(raw)["missing_blob_ids"] == []

@@ -6,10 +6,11 @@ Cache services plus /healthz and /version, with optional token auth
 drains in-flight requests around a DB reload, listen.go:129-192; here a
 lock swap suffices because the table is immutable once built).
 
-Routes speak Twirp's JSON encoding (POST /twirp/<svc>/<Method> with JSON
-bodies using proto field names — rpc/scanner/service.proto,
-rpc/cache/service.proto). The protobuf-binary encoding for drop-in Go
-clients is a later round. Batches accumulate per request; every Scan
+Routes speak both Twirp encodings (POST /twirp/<svc>/<Method>): JSON
+bodies with proto field names, and application/protobuf binary for
+drop-in Go clients (rpc/scanner/service.proto, rpc/cache/service.proto,
+handwritten codec in protowire.py). Batches accumulate per request;
+every Scan
 request runs the batched device join over all its target's packages at
 once (SURVEY.md §2.7 P4/P5)."""
 
@@ -84,37 +85,78 @@ class Handler(BaseHTTPRequestHandler):
         else:
             self._twirp_error(404, "not_found", self.path)
 
+    def _proto(self, code: int, payload: dict, desc: str):
+        from .protowire import encode_msg
+        body = encode_msg(payload, desc)
+        self.send_response(code)
+        self.send_header("Content-Type", "application/protobuf")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply(self, payload: dict, desc: str):
+        """Encode the response in the request's encoding (Twirp
+        requires responses to match the request content type)."""
+        if self._is_proto:
+            return self._proto(200, payload, desc)
+        return self._json(200, payload)
+
+    # request-message descriptor per route (binary Twirp)
+    _ROUTES = {
+        "/twirp/trivy.scanner.v1.Scanner/Scan": "ScanRequest",
+        "/twirp/trivy.cache.v1.Cache/PutArtifact": "PutArtifactRequest",
+        "/twirp/trivy.cache.v1.Cache/PutBlob": "PutBlobRequest",
+        "/twirp/trivy.cache.v1.Cache/MissingBlobs":
+            "MissingBlobsRequest",
+        "/twirp/trivy.cache.v1.Cache/DeleteBlobs": "DeleteBlobsRequest",
+    }
+
     def do_POST(self):
         st = self.state
         if st.token and self.headers.get(TOKEN_HEADER) != st.token:
             return self._twirp_error(401, "unauthenticated", "invalid token")
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        self._is_proto = ctype in ("application/protobuf",
+                                   "application/x-protobuf")
+        route = self.path
         try:
             length = int(self.headers.get("Content-Length", "0"))
-            req = json.loads(self.rfile.read(length) or b"{}")
+            body = self.rfile.read(length)
+            if self._is_proto:
+                from .protowire import decode_msg
+                desc = self._ROUTES.get(route)
+                if desc is None:
+                    return self._twirp_error(404, "bad_route", route)
+                req = decode_msg(body, desc)
+            else:
+                req = json.loads(body or b"{}")
         except (ValueError, json.JSONDecodeError):
-            return self._twirp_error(400, "malformed", "bad JSON body")
+            return self._twirp_error(400, "malformed", "bad body")
 
-        route = self.path
         try:
             if route == "/twirp/trivy.scanner.v1.Scanner/Scan":
                 return self._scan(req)
             if route == "/twirp/trivy.cache.v1.Cache/PutArtifact":
                 st.cache.put_artifact(req.get("artifact_id", ""),
                                       req.get("artifact_info") or {})
-                return self._json(200, {})
+                return self._reply({}, "Empty")
             if route == "/twirp/trivy.cache.v1.Cache/PutBlob":
-                blob = blob_from_json(req.get("blob_info") or {})
+                blob_j = req.get("blob_info") or {}
+                if self._is_proto:
+                    from .convert import proto_blob_to_json
+                    blob_j = proto_blob_to_json(blob_j)
+                blob = blob_from_json(blob_j)
                 st.cache.put_blob(req.get("diff_id", ""), blob)
-                return self._json(200, {})
+                return self._reply({}, "Empty")
             if route == "/twirp/trivy.cache.v1.Cache/MissingBlobs":
                 missing_artifact, missing = st.cache.missing_blobs(
                     req.get("artifact_id", ""), req.get("blob_ids") or [])
-                return self._json(200, {
+                return self._reply({
                     "missing_artifact": missing_artifact,
                     "missing_blob_ids": missing,
-                })
+                }, "MissingBlobsResponse")
             if route == "/twirp/trivy.cache.v1.Cache/DeleteBlobs":
-                return self._json(200, {})
+                return self._reply({}, "Empty")
             return self._twirp_error(404, "bad_route", route)
         except KeyError as e:
             return self._twirp_error(400, "invalid_argument", str(e))
@@ -131,6 +173,10 @@ class Handler(BaseHTTPRequestHandler):
         results, os_info = self.state.scanner.scan(
             req.get("target", ""), req.get("artifact_id", ""),
             req.get("blob_ids") or [], opts)
+        if self._is_proto:
+            from .convert import results_to_proto
+            return self._proto(200, results_to_proto(results, os_info),
+                               "ScanResponse")
         self._json(200, {
             "os": {"family": os_info.family, "name": os_info.name,
                    "eosl": os_info.eosl},
