@@ -1,0 +1,162 @@
+"""Attestation decode + rekor client against a fake server (reference
+pkg/attestation/attestation_test.go + pkg/rekortest fake)."""
+
+import base64
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.attestation import (AttestationError, Statement,
+                                   decode_any, is_envelope)
+from trivy_tpu.rekor import Client, EntryID, fetch_sbom_statement
+
+CDX = {
+    "bomFormat": "CycloneDX", "specVersion": "1.5",
+    "components": [{
+        "type": "library", "name": "musl", "version": "1.2.3-r0",
+        "purl": "pkg:apk/alpine/musl@1.2.3-r0",
+    }],
+}
+
+
+def make_envelope(predicate, ptype="https://cyclonedx.org/bom"):
+    st = {
+        "_type": "https://in-toto.io/Statement/v0.1",
+        "predicateType": ptype,
+        "subject": [{"name": "img",
+                     "digest": {"sha256": "ab" * 32}}],
+        "predicate": predicate,
+    }
+    return {
+        "payloadType": "application/vnd.in-toto+json",
+        "payload": base64.b64encode(json.dumps(st).encode()).decode(),
+        "signatures": [{"keyid": "", "sig": "ZmFrZQ=="}],
+    }
+
+
+class TestAttestation:
+    def test_envelope_roundtrip(self):
+        env = make_envelope(CDX)
+        assert is_envelope(env)
+        st = decode_any(env)
+        assert st.predicate_type == "https://cyclonedx.org/bom"
+        assert st.sbom_document()["bomFormat"] == "CycloneDX"
+
+    def test_legacy_cosign_predicate(self):
+        env = make_envelope({"Data": CDX},
+                            ptype="cosign.sigstore.dev/attestation/v1")
+        st = decode_any(env)
+        assert st.sbom_document()["bomFormat"] == "CycloneDX"
+
+    def test_bad_payload_type(self):
+        env = make_envelope(CDX)
+        env["payloadType"] = "application/json"
+        with pytest.raises(AttestationError):
+            Statement.from_envelope(env)
+
+    def test_bare_statement(self):
+        st = decode_any({
+            "_type": "https://in-toto.io/Statement/v0.1",
+            "predicateType": "x", "predicate": CDX})
+        assert st.sbom_document() == CDX
+
+
+ENTRY_ID = "1" * 16 + "a" * 64
+
+
+class FakeRekor(BaseHTTPRequestHandler):
+    statement = make_envelope(CDX)
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        ln = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(ln))
+        if self.path == "/api/v1/index/retrieve":
+            body = json.dumps([ENTRY_ID]).encode()
+        elif self.path == "/api/v1/log/entries/retrieve":
+            att = base64.b64encode(
+                json.dumps(self.statement).encode()).decode()
+            body = json.dumps([{
+                ENTRY_ID: {"attestation": {"data": att},
+                           "body": "..."},
+            }]).encode()
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def rekor_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeRekor)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+class TestRekor:
+    def test_entry_id(self):
+        e = EntryID(ENTRY_ID)
+        assert e.tree_id == "1" * 16
+        assert e.uuid == "a" * 64
+        with pytest.raises(Exception):
+            EntryID("short")
+
+    def test_search_and_get(self, rekor_server):
+        c = Client(rekor_server)
+        ids = c.search("sha256:" + "ab" * 32)
+        assert len(ids) == 1
+        entries = c.get_entries(ids)
+        assert len(entries) == 1
+        doc = json.loads(entries[0])
+        assert doc["payloadType"] == "application/vnd.in-toto+json"
+
+    def test_fetch_sbom_statement(self, rekor_server):
+        st = fetch_sbom_statement(rekor_server, "sha256:" + "ab" * 32)
+        assert st is not None
+        assert st.sbom_document()["bomFormat"] == "CycloneDX"
+
+
+def test_sbom_command_accepts_attestation(tmp_path, capsys):
+    from trivy_tpu import cli
+    import os
+    env = make_envelope(CDX)
+    p = tmp_path / "att.json"
+    p.write_text(json.dumps(env))
+    fix = os.path.join(os.path.dirname(__file__), "fixtures", "db",
+                       "*.yaml")
+    code = cli.main(["sbom", str(p), "--db", fix, "--format", "json",
+                     "--list-all-pkgs"])
+    out = json.loads(capsys.readouterr().out)
+    pkgs = [pk for r in out.get("Results", [])
+            for pk in r.get("Packages", [])]
+    assert any(pk["Name"] == "musl" for pk in pkgs)
+
+
+def test_image_rekor_sbom_source(tmp_path, rekor_server, capsys):
+    from trivy_tpu import cli
+    import os
+    from helpers import ALPINE_OS_RELEASE, make_image
+    img = str(tmp_path / "img.tar")
+    make_image(img, [{"etc/os-release": ALPINE_OS_RELEASE}])
+    fix = os.path.join(os.path.dirname(__file__), "fixtures", "db",
+                       "*.yaml")
+    code = cli.main(["image", "--input", img, "--db", fix,
+                     "--format", "json", "--list-all-pkgs",
+                     "--sbom-sources", "rekor",
+                     "--rekor-url", rekor_server])
+    out = json.loads(capsys.readouterr().out)
+    assert out["ArtifactType"] in ("cyclonedx", "spdx")
+    pkgs = [pk for r in out.get("Results", [])
+            for pk in r.get("Packages", [])]
+    assert any(pk["Name"] == "musl" for pk in pkgs)

@@ -80,6 +80,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("image_name", nargs="?", default="")
     p.add_argument("--input", default="",
                    help="docker-save/OCI archive path")
+    p.add_argument("--sbom-sources", default="",
+                   help="comma-separated external SBOM sources (rekor)")
+    p.add_argument("--rekor-url", default="https://rekor.sigstore.dev")
     _add_scan_flags(p)
 
     for name, aliases in (("filesystem", ["fs"]), ("rootfs", [])):
@@ -268,10 +271,32 @@ def cmd_image(args) -> int:
     cache = _open_cache(args)
     scanners = tuple(s.strip() for s in args.scanners.split(","))
     art = ImageArchiveArtifact(args.input, cache, scanners=scanners)
-    ref = art.inspect()
+    ref = None
+    if "rekor" in getattr(args, "sbom_sources", ""):
+        # remote-SBOM shortcut: a published SBOM attestation replaces
+        # local analysis (reference remote_sbom.go:92)
+        from .log import logger
+        from .rekor import RekorError, fetch_sbom_statement
+        from .sbom.io import decode_sbom_doc
+        try:
+            st = fetch_sbom_statement(args.rekor_url,
+                                      art.image_digest())
+            if st is not None:
+                sbom_doc = st.sbom_document()
+                if isinstance(sbom_doc, dict):
+                    ref = decode_sbom_doc(sbom_doc, cache,
+                                          name=args.input)
+        except (RekorError, ValueError) as e:
+            logger.warning("rekor SBOM lookup failed, falling back "
+                           "to analysis: %s", e)
+    if ref is None:
+        ref = art.inspect()
+        artifact_type = T.ArtifactType.CONTAINER_IMAGE
+    else:
+        artifact_type = ref.type
     if args.image_name:
         ref.name = args.image_name
-    return _scan_common(args, ref, cache, T.ArtifactType.CONTAINER_IMAGE)
+    return _scan_common(args, ref, cache, artifact_type)
 
 
 def cmd_fs(args) -> int:

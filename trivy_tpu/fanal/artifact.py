@@ -56,6 +56,22 @@ class ImageArchiveArtifact:
                 return self._inspect_oci_layout(tf)
             raise ValueError(f"{self.path}: not a docker/oci image archive")
 
+    def image_digest(self) -> str:
+        """sha256 of the raw image config — the digest cosign signs
+        attestations against (used by the remote-SBOM rekor shortcut,
+        reference pkg/fanal/artifact/image/remote_sbom.go)."""
+        with tarfile.open(self.path) as tf:
+            names = tf.getnames()
+            if "manifest.json" in names:
+                manifest = json.load(tf.extractfile("manifest.json"))[0]
+                raw = tf.extractfile(manifest["Config"]).read()
+                return "sha256:" + hashlib.sha256(raw).hexdigest()
+            if "index.json" in names:
+                index = json.load(tf.extractfile("index.json"))
+                digest = index["manifests"][0]["digest"]
+                return digest
+        raise ValueError(f"{self.path}: not an image archive")
+
     # --- docker-save format ---
 
     def _inspect_docker_archive(self, tf: tarfile.TarFile):

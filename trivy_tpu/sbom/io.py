@@ -20,12 +20,35 @@ def detect_format(doc: dict) -> str:
     raise ValueError("unknown SBOM format (want CycloneDX or SPDX JSON)")
 
 
+def unwrap_attestation(doc: dict) -> dict:
+    """DSSE envelope / in-toto statement → the wrapped SBOM document
+    (reference sbom.go FormatAttestCycloneDXJSON +
+    FormatLegacyCosignAttestCycloneDXJSON decode paths); non-attestation
+    documents pass through unchanged."""
+    from ..attestation import AttestationError, decode_any
+    try:
+        st = decode_any(doc)
+    except AttestationError:
+        return doc
+    sbom = st.sbom_document()
+    if isinstance(sbom, dict):
+        return sbom
+    return doc
+
+
 def decode_sbom_file(path: str, cache):
     """→ ArtifactReference whose single blob carries the decoded detail."""
-    from ..fanal.artifact import ArtifactReference
-
     with open(path) as f:
         doc = json.load(f)
+    return decode_sbom_doc(doc, cache, name=path)
+
+
+def decode_sbom_doc(doc: dict, cache, name: str = ""):
+    """Decode an (optionally attestation-wrapped) SBOM document into a
+    cached blob → ArtifactReference."""
+    from ..fanal.artifact import ArtifactReference
+
+    doc = unwrap_attestation(doc)
     fmt = detect_format(doc)
     detail = decode_cyclonedx(doc) if fmt == "cyclonedx" else decode_spdx(doc)
 
@@ -41,7 +64,7 @@ def decode_sbom_file(path: str, cache):
     cache.put_blob(blob_id, blob)
     cache.put_artifact(blob_id, {"SchemaVersion": 2})
     return ArtifactReference(
-        name=path,
+        name=name,
         type=(T.ArtifactType.CYCLONEDX if fmt == "cyclonedx"
               else T.ArtifactType.SPDX),
         id=blob_id, blob_ids=[blob_id])
