@@ -1,0 +1,158 @@
+"""AWS account scanning against a fake sigv4-checked endpoint
+(reference integration aws_cloud_test.go uses LocalStack the same way)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.cloud.aws import (AWSClient, AWSError, load_state,
+                                 save_state, scan_account)
+from trivy_tpu.cloud.sigv4 import sign
+
+LIST_BUCKETS = """<?xml version="1.0"?>
+<ListAllMyBucketsResult>
+  <Buckets><Bucket><Name>bad-bucket</Name></Bucket></Buckets>
+</ListAllMyBucketsResult>"""
+
+EMPTY_VERSIONING = "<VersioningConfiguration></VersioningConfiguration>"
+EMPTY_LOGGING = "<BucketLoggingStatus></BucketLoggingStatus>"
+PUBLIC_ACL = """<AccessControlPolicy>
+  <AccessControlList><Grant>
+    <Grantee><URI>http://acs.amazonaws.com/groups/global/AllUsers</URI></Grantee>
+    <Permission>READ</Permission>
+  </Grant></AccessControlList>
+</AccessControlPolicy>"""
+
+DESCRIBE_SGS = """<?xml version="1.0"?>
+<DescribeSecurityGroupsResponse>
+  <securityGroupInfo><item>
+    <groupName>open-sg</groupName>
+    <groupDescription></groupDescription>
+    <ipPermissions><item>
+      <fromPort>22</fromPort><toPort>22</toPort>
+      <ipRanges><item><cidrIp>0.0.0.0/0</cidrIp></item></ipRanges>
+    </item></ipPermissions>
+  </item></securityGroupInfo>
+</DescribeSecurityGroupsResponse>"""
+
+CALLER_IDENTITY = """<GetCallerIdentityResponse>
+  <GetCallerIdentityResult><Account>123456789012</Account>
+  </GetCallerIdentityResult>
+</GetCallerIdentityResponse>"""
+
+
+class FakeAWS(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, body: str, code=200):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/xml")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if "AWS4-HMAC-SHA256" not in \
+                (self.headers.get("Authorization") or ""):
+            return self._reply("<Error>unsigned</Error>", 403)
+        if self.path == "/":
+            return self._reply(LIST_BUCKETS)
+        if "versioning" in self.path:
+            return self._reply(EMPTY_VERSIONING)
+        if "logging" in self.path:
+            return self._reply(EMPTY_LOGGING)
+        if "encryption" in self.path:
+            return self._reply("<Error/>", 404)
+        if "publicAccessBlock" in self.path:
+            return self._reply("<Error/>", 404)
+        if "acl" in self.path:
+            return self._reply(PUBLIC_ACL)
+        return self._reply("<Error/>", 404)
+
+    def do_POST(self):
+        ln = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(ln).decode()
+        if "DescribeSecurityGroups" in body:
+            return self._reply(DESCRIBE_SGS)
+        if "GetCallerIdentity" in body:
+            return self._reply(CALLER_IDENTITY)
+        return self._reply("<Error/>", 400)
+
+
+@pytest.fixture()
+def fake_aws(monkeypatch):
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIATEST")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeAWS)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_sigv4_deterministic():
+    import datetime as dt
+    t = dt.datetime(2026, 7, 29, 12, 0, 0, tzinfo=dt.timezone.utc)
+    h1 = sign("GET", "s3.us-east-1.amazonaws.com", "/", {}, {}, b"",
+              "s3", "us-east-1", "AKIA", "secret", now=t)
+    h2 = sign("GET", "s3.us-east-1.amazonaws.com", "/", {}, {}, b"",
+              "s3", "us-east-1", "AKIA", "secret", now=t)
+    assert h1["Authorization"] == h2["Authorization"]
+    assert "AWS4-HMAC-SHA256 Credential=AKIA/20260729/us-east-1/s3/" \
+        in h1["Authorization"]
+
+
+def test_scan_account(fake_aws, tmp_path):
+    results, account = scan_account(
+        ["s3", "ec2"], endpoint=fake_aws,
+        cache_dir=str(tmp_path), update_cache=True)
+    assert account == "123456789012"
+    ids = {m.id for r in results for m in r.misconfigurations}
+    assert "AVD-AWS-0092" in ids    # public ACL
+    assert "AVD-AWS-0090" in ids    # no versioning
+    assert "AVD-AWS-0107" in ids    # sg open ingress
+    assert "AVD-AWS-0099" in ids    # sg no description
+    svcs = {r.target.split(":")[2] for r in results}
+    assert {"s3", "ec2"} <= svcs
+
+
+def test_account_cache_roundtrip(fake_aws, tmp_path):
+    results1, account = scan_account(
+        ["s3"], endpoint=fake_aws, cache_dir=str(tmp_path),
+        update_cache=True)
+    # second scan must come from the cache (break the endpoint)
+    results2, _ = scan_account(
+        ["s3"], endpoint="http://127.0.0.1:1", account=account,
+        cache_dir=str(tmp_path))
+    ids1 = sorted(m.id for r in results1 for m in r.misconfigurations
+                  if r.target.split(":")[2] == "s3")
+    ids2 = sorted(m.id for r in results2 for m in r.misconfigurations
+                  if r.target.split(":")[2] == "s3")
+    assert ids1 == ids2
+
+
+def test_unsupported_service(fake_aws, tmp_path):
+    with pytest.raises(AWSError):
+        scan_account(["lambda"], endpoint=fake_aws,
+                     cache_dir=str(tmp_path))
+
+
+def test_missing_credentials(monkeypatch):
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+    with pytest.raises(AWSError):
+        AWSClient()
+
+
+def test_cli_aws_json(fake_aws, tmp_path, capsys):
+    from trivy_tpu import cli
+    code = cli.main(["aws", "--endpoint", fake_aws, "--format", "json",
+                     "--cache-dir", str(tmp_path), "--update-cache"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["ArtifactName"] == "AWS account 123456789012"
+    mcs = [m for r in out.get("Results", [])
+           for m in r.get("Misconfigurations", [])]
+    assert any(m["ID"] == "AVD-AWS-0107" for m in mcs)

@@ -133,6 +133,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", "-o", default="")
     p.add_argument("--exit-code", type=int, default=0)
 
+    p = sub.add_parser("aws", help="scan an AWS account")
+    p.add_argument("--region", default="us-east-1")
+    p.add_argument("--endpoint", default="",
+                   help="API endpoint override (e.g. LocalStack)")
+    p.add_argument("--services", default="",
+                   help="comma-separated services (s3,ec2); default all")
+    p.add_argument("--account", default="")
+    p.add_argument("--update-cache", action="store_true")
+    p.add_argument("--max-cache-age", default="24h",
+                   help="cached account state TTL (e.g. 24h, 30m)")
+    p.add_argument("--format", "-f", default="table",
+                   choices=["table", "json"])
+    p.add_argument("--compliance", default="")
+    p.add_argument("--report", default="summary",
+                   choices=["summary", "all"])
+    p.add_argument("--severity", "-s", default=",".join(T.SEVERITIES))
+    p.add_argument("--output", "-o", default="")
+    p.add_argument("--cache-dir",
+                   default=os.path.join(os.path.expanduser("~"),
+                                        ".cache", "trivy-tpu"))
+    p.add_argument("--exit-code", type=int, default=0)
+
     p = sub.add_parser("plugin", help="manage subprocess plugins")
     p.add_argument("plugin_action",
                    choices=["install", "uninstall", "list", "info",
@@ -386,6 +408,70 @@ def cmd_k8s(args) -> int:
             out.close()
 
 
+def _parse_duration(s: str) -> float:
+    s = s.strip().lower()
+    mult = 1.0
+    if s.endswith("h"):
+        mult, s = 3600.0, s[:-1]
+    elif s.endswith("m"):
+        mult, s = 60.0, s[:-1]
+    elif s.endswith("s"):
+        s = s[:-1]
+    try:
+        return float(s) * mult
+    except ValueError:
+        return 24 * 3600.0
+
+
+def cmd_aws(args) -> int:
+    from .cloud.aws import AWSError, scan_account
+    services = [s.strip() for s in args.services.split(",") if s.strip()]
+    try:
+        results, account = scan_account(
+            services, region=args.region, endpoint=args.endpoint,
+            cache_dir=args.cache_dir, account=args.account,
+            update_cache=args.update_cache,
+            max_cache_age_s=_parse_duration(args.max_cache_age))
+    except AWSError as e:
+        raise SystemExit(f"aws scan failed: {e}")
+    sev = set(s.strip().upper() for s in args.severity.split(","))
+    for r in results:
+        r.misconfigurations = [m for m in r.misconfigurations
+                               if m.severity in sev]
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        if args.compliance:
+            from .compliance import (build_compliance_report, get_spec,
+                                     write_compliance)
+            spec = get_spec(args.compliance)
+            creport = build_compliance_report(spec, results)
+            write_compliance(creport, mode=args.report,
+                             fmt=args.format, output=out)
+        elif args.format == "json":
+            report = build_report(
+                f"AWS account {account}", "aws_account", results,
+                T.OS(),
+                created_at=dt.datetime.now(
+                    dt.timezone.utc).isoformat())
+            json.dump(report.to_json(), out, indent=2)
+            out.write("\n")
+        else:
+            from .report.tables import render_table
+            for r in results:
+                rows = [[m.id, m.severity, m.title, m.message]
+                        for m in r.misconfigurations]
+                out.write(f"\n{r.target}\n")
+                out.write(render_table(
+                    r.target, ["ID", "Severity", "Title", "Message"],
+                    rows))
+    finally:
+        if args.output:
+            out.close()
+    if args.exit_code and any(r.misconfigurations for r in results):
+        return args.exit_code
+    return 0
+
+
 def cmd_plugin(args) -> int:
     from . import plugin
     action = args.plugin_action
@@ -441,7 +527,7 @@ def main(argv=None) -> int:
         from . import plugin as _plugin
         known = {"image", "filesystem", "fs", "rootfs", "repository",
                  "repo", "sbom", "convert", "server", "k8s",
-                 "kubernetes", "version", "plugin", "module",
+                 "kubernetes", "aws", "version", "plugin", "module",
                  "-h", "--help", "--version"}
         if argv[0] not in known and _plugin.exists(argv[0]):
             return _plugin.run(argv[0], argv[1:])
@@ -467,6 +553,8 @@ def main(argv=None) -> int:
         return cmd_server(args)
     if cmd in ("k8s", "kubernetes"):
         return cmd_k8s(args)
+    if cmd == "aws":
+        return cmd_aws(args)
     if cmd == "plugin":
         return cmd_plugin(args)
     if cmd == "module":

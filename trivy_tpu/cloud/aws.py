@@ -1,0 +1,297 @@
+"""AWS account scanning (reference pkg/cloud/aws).
+
+Walks live AWS APIs (sigv4-signed; endpoint overridable for
+LocalStack-style emulators), adapts the responses into the shared
+cloud-state model, caches the adapted state per account/region
+(pkg/cloud/aws/cache/cache.go), and evaluates the AVD-AWS check set —
+the same checks the terraform/cloudformation scanners use, which is
+exactly how the reference reuses its iac rules over live accounts
+(pkg/cloud/aws/scanner/scanner.go:29).
+
+Services covered: s3, ec2 (security groups), sts (account discovery).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+from .. import types as T
+from ..iac.cloud import Attr, AWS_CHECKS, CloudResource
+from ..iac.core import build_misconf
+from ..log import logger
+from .sigv4 import sign
+
+SUPPORTED_SERVICES = ["s3", "ec2"]
+CACHE_VERSION = 1
+
+
+class AWSError(Exception):
+    pass
+
+
+class AWSClient:
+    def __init__(self, region: str = "us-east-1", endpoint: str = "",
+                 access_key: str = "", secret_key: str = "",
+                 session_token: str = "", timeout: float = 30.0):
+        self.region = region or "us-east-1"
+        self.endpoint = endpoint.rstrip("/")
+        self.access_key = access_key or os.environ.get(
+            "AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get(
+            "AWS_SECRET_ACCESS_KEY", "")
+        self.session_token = session_token or os.environ.get(
+            "AWS_SESSION_TOKEN", "")
+        self.timeout = timeout
+        if not self.access_key or not self.secret_key:
+            raise AWSError(
+                "AWS credentials not found (AWS_ACCESS_KEY_ID / "
+                "AWS_SECRET_ACCESS_KEY)")
+
+    def _service_url(self, service: str) -> str:
+        if self.endpoint:
+            return self.endpoint
+        if service == "s3":
+            return f"https://s3.{self.region}.amazonaws.com"
+        return f"https://{service}.{self.region}.amazonaws.com"
+
+    def request(self, service: str, method: str = "GET", path: str = "/",
+                query: dict | None = None, body: bytes = b"",
+                headers: dict | None = None) -> bytes:
+        query = query or {}
+        url = self._service_url(service)
+        parsed = urllib.parse.urlparse(url)
+        signed = sign(method, parsed.netloc, path, query,
+                      headers or {}, body, service, self.region,
+                      self.access_key, self.secret_key,
+                      self.session_token)
+        qs = urllib.parse.urlencode(sorted(query.items()))
+        full = f"{url}{path}" + (f"?{qs}" if qs else "")
+        req = urllib.request.Request(full, data=body or None,
+                                     method=method, headers=signed)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            raise AWSError(
+                f"{service} {path}: HTTP {e.code}: "
+                f"{e.read()[:200]!r}") from e
+        except Exception as e:
+            raise AWSError(f"{service} request failed: {e}") from e
+
+
+def _xml(data: bytes) -> ET.Element:
+    root = ET.fromstring(data)
+    # strip namespaces for painless findall
+    for el in root.iter():
+        if "}" in el.tag:
+            el.tag = el.tag.split("}", 1)[1]
+    return root
+
+
+def _txt(el, path, default=""):
+    found = el.find(path)
+    return found.text if found is not None and found.text else default
+
+
+# ---- service walkers → CloudResource state ---------------------------
+
+def walk_s3(client: AWSClient) -> list[CloudResource]:
+    out = []
+    root = _xml(client.request("s3"))
+    for b in root.findall(".//Bucket"):
+        name = _txt(b, "Name")
+        if not name:
+            continue
+        r = CloudResource("aws_s3_bucket", name)
+        r.attrs["arn"] = Attr(f"arn:aws:s3:::{name}")
+        for call, key in (
+                ("versioning", "versioning"),
+                ("logging", "logging"),
+                ("encryption", "encryption"),
+                ("publicAccessBlock", "public_access_block"),
+                ("acl", "acl")):
+            try:
+                data = client.request("s3", path=f"/{name}",
+                                      query={call: ""})
+            except AWSError:
+                continue
+            doc = _xml(data)
+            if call == "versioning":
+                r.attrs["versioning_enabled"] = Attr(
+                    _txt(doc, "Status") == "Enabled")
+            elif call == "logging":
+                r.attrs["logging_enabled"] = Attr(
+                    doc.find(".//LoggingEnabled") is not None)
+            elif call == "encryption":
+                algo = _txt(doc, ".//SSEAlgorithm")
+                r.attrs["encryption_enabled"] = Attr(bool(algo))
+                r.attrs["sse_algorithm"] = Attr(algo)
+            elif call == "publicAccessBlock":
+                r.attrs["public_access_block"] = Attr({
+                    "block_public_acls":
+                        _txt(doc, ".//BlockPublicAcls") == "true",
+                    "block_public_policy":
+                        _txt(doc, ".//BlockPublicPolicy") == "true",
+                    "ignore_public_acls":
+                        _txt(doc, ".//IgnorePublicAcls") == "true",
+                    "restrict_public_buckets":
+                        _txt(doc, ".//RestrictPublicBuckets") == "true",
+                })
+            elif call == "acl":
+                grants = []
+                for g in doc.findall(".//Grant"):
+                    uri = _txt(g, ".//URI")
+                    perm = _txt(g, "Permission")
+                    grants.append({"uri": uri, "permission": perm})
+                public = any("AllUsers" in g["uri"] for g in grants)
+                r.attrs["acl"] = Attr(
+                    "public-read" if public else "private")
+        out.append(r)
+    return out
+
+
+def walk_ec2(client: AWSClient) -> list[CloudResource]:
+    out = []
+    body = urllib.parse.urlencode({
+        "Action": "DescribeSecurityGroups",
+        "Version": "2016-11-15"}).encode()
+    doc = _xml(client.request(
+        "ec2", method="POST", body=body,
+        headers={"content-type":
+                 "application/x-www-form-urlencoded; charset=utf-8"}))
+    for item in doc.findall(".//securityGroupInfo/item"):
+        name = _txt(item, "groupName")
+        r = CloudResource("aws_security_group", name)
+        r.attrs["description"] = Attr(_txt(item, "groupDescription"))
+        ingress = []
+        for perm in item.findall("ipPermissions/item"):
+            for ip in perm.findall("ipRanges/item"):
+                ingress.append({
+                    "cidrs": [_txt(ip, "cidrIp")],
+                    "description": _txt(ip, "description"),
+                    "from_port": int(_txt(perm, "fromPort", "0") or 0),
+                    "to_port": int(_txt(perm, "toPort", "0") or 0),
+                })
+        egress = []
+        for perm in item.findall("ipPermissionsEgress/item"):
+            for ip in perm.findall("ipRanges/item"):
+                egress.append({
+                    "cidrs": [_txt(ip, "cidrIp")],
+                    "description": _txt(ip, "description"),
+                })
+        r.attrs["ingress"] = Attr(ingress)
+        r.attrs["egress"] = Attr(egress)
+        out.append(r)
+    return out
+
+
+def get_account_id(client: AWSClient) -> str:
+    body = urllib.parse.urlencode({
+        "Action": "GetCallerIdentity", "Version": "2011-06-15"}).encode()
+    try:
+        doc = _xml(client.request(
+            "sts", method="POST", body=body,
+            headers={"content-type":
+                     "application/x-www-form-urlencoded; "
+                     "charset=utf-8"}))
+        return _txt(doc, ".//Account", "unknown")
+    except AWSError:
+        return "unknown"
+
+
+WALKERS = {"s3": walk_s3, "ec2": walk_ec2}
+
+
+# ---- account-state cache (pkg/cloud/aws/cache) ------------------------
+
+def cache_path(cache_dir: str, provider: str, account: str,
+               region: str) -> str:
+    return os.path.join(cache_dir, "cloud", provider, account, region,
+                        "data.json")
+
+
+def save_state(path: str, resources: list[CloudResource]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = {"schema_version": CACHE_VERSION, "updated": time.time(),
+           "resources": [{
+               "kind": r.kind, "name": r.name,
+               "attrs": {k: a.value for k, a in r.attrs.items()},
+           } for r in resources]}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+
+
+def load_state(path: str, max_age_s: float) -> list[CloudResource] | None:
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if doc.get("schema_version") != CACHE_VERSION:
+        return None
+    if max_age_s > 0 and time.time() - doc.get("updated", 0) > max_age_s:
+        return None
+    out = []
+    for rj in doc.get("resources", []):
+        r = CloudResource(rj.get("kind", ""), rj.get("name", ""))
+        for k, v in (rj.get("attrs") or {}).items():
+            r.attrs[k] = Attr(v)
+        out.append(r)
+    return out
+
+
+# ---- scan entry -------------------------------------------------------
+
+def scan_account(services: list[str], region: str = "us-east-1",
+                 endpoint: str = "", cache_dir: str = "",
+                 account: str = "", update_cache: bool = False,
+                 max_cache_age_s: float = 24 * 3600,
+                 ) -> tuple[list[T.Result], str]:
+    """→ (results grouped per service, account_id)."""
+    services = services or list(SUPPORTED_SERVICES)
+    for s in services:
+        if s not in WALKERS:
+            raise AWSError(
+                f"unsupported service {s!r} "
+                f"(supported: {', '.join(SUPPORTED_SERVICES)})")
+    client = AWSClient(region=region, endpoint=endpoint)
+    if not account:
+        account = get_account_id(client)
+    cpath = cache_path(cache_dir or ".", "aws", account, region)
+    resources = None
+    if not update_cache:
+        resources = load_state(cpath, max_cache_age_s)
+    if resources is None:
+        resources = []
+        for s in services:
+            try:
+                resources.extend(WALKERS[s](client))
+            except AWSError as e:
+                logger.warning("aws %s walk failed: %s", s, e)
+        save_state(cpath, resources)
+
+    results: list[T.Result] = []
+    by_service: dict[str, list] = {}
+    for check in AWS_CHECKS:
+        for item in check.fn(resources):
+            msg, _rng = item
+            m = build_misconf(check, "cloud", msg, (0, 0), [])
+            by_service.setdefault(check.service, []).append(m)
+    for svc in sorted(by_service):
+        results.append(T.Result(
+            target=f"arn:aws:{svc}:{region}:{account}",
+            clazz=T.ResultClass.CONFIG, type="cloud",
+            misconf_summary=T.MisconfSummary(
+                failures=len(by_service[svc])),
+            misconfigurations=sorted(by_service[svc],
+                                     key=lambda m: (m.id, m.message)),
+        ))
+    return results, account
