@@ -60,6 +60,23 @@ def test_jar_analyzer_uses_sha1(tmp_path):
     assert pkg.version == "2.0.1"
 
 
+def test_jar_analyzer_shaded_jar_keeps_inner_poms(tmp_path):
+    """A sha1 hit identifies the outer jar but must not drop bundled
+    dependencies found via nested pom.properties (reference
+    pkg/dependency/parser/java/jar parseArtifact appends, not replaces)."""
+    from trivy_tpu.fanal.analyzers.binaries import JarAnalyzer
+    jar = make_jar({
+        "META-INF/maven/com.dep/inner/pom.properties":
+            "groupId=com.dep\nartifactId=inner\nversion=3.1\n"})
+    digest = hashlib.sha1(jar).hexdigest()
+    javadb.set_db(javadb.build_db(str(tmp_path / "j.db"), [
+        ("com.example", "uber", "2.0.1", digest, "jar"),
+    ]))
+    result = JarAnalyzer().analyze("app/uber.jar", jar)
+    names = {p.name for p in result.applications[0].packages}
+    assert names == {"com.dep:inner", "com.example:uber"}
+
+
 def test_jar_analyzer_filename_group_vote(tmp_path):
     from trivy_tpu.fanal.analyzers.binaries import JarAnalyzer
     jar = make_jar()

@@ -271,12 +271,17 @@ def scan_account(services: list[str], region: str = "us-east-1",
         resources = load_state(cpath, max_cache_age_s)
     if resources is None:
         resources = []
+        failed = False
         for s in services:
             try:
                 resources.extend(WALKERS[s](client))
             except AWSError as e:
+                failed = True
                 logger.warning("aws %s walk failed: %s", s, e)
-        save_state(cpath, resources)
+        # caching a partial walk would silently report no findings for the
+        # failed service until the TTL expires — only cache complete state
+        if not failed:
+            save_state(cpath, resources)
 
     results: list[T.Result] = []
     by_service: dict[str, list] = {}

@@ -106,12 +106,16 @@ _JAR_NAME = re.compile(r"^(?P<name>[A-Za-z0-9._-]+?)-"
 
 @register
 class JarAnalyzer(Analyzer):
-    """JAR/WAR/EAR identification order mirrors the reference jar
-    parser (pkg/dependency/parser/java/jar): Java-DB sha1 → GAV first
-    (exact), then pom.properties, then filename heuristic with Java-DB
-    group_id majority vote."""
+    """JAR/WAR/EAR identification mirrors the reference jar parser
+    (pkg/dependency/parser/java/jar parseArtifact/traverseZip): nested
+    pom.properties packages are always collected; if one of them matches
+    the filename-derived (artifactId, version) it already names the outer
+    jar, otherwise the outer jar is identified by Java-DB sha1 → GAV
+    (appended to, not replacing, the nested set) and finally by filename
+    heuristic with Java-DB group_id lookup; duplicates are removed at the
+    end (removeLibraryDuplicates)."""
     name = "jar"
-    version = 2
+    version = 3
 
     def required(self, path: str, size: int = -1) -> bool:
         return path.endswith((".jar", ".war", ".ear", ".par"))
@@ -122,19 +126,11 @@ class JarAnalyzer(Analyzer):
             zf = zipfile.ZipFile(io.BytesIO(content))
         except (zipfile.BadZipFile, OSError):
             return None
-        from ...javadb import get_db
-        jdb = get_db()
-        if jdb is not None:
-            import hashlib
-            digest = hashlib.sha1(content).hexdigest()  # noqa: S324
-            hit = jdb.search_by_sha1(digest)
-            if hit:
-                gid, aid, ver = hit
-                full = f"{gid}:{aid}"
-                return AnalysisResult(applications=[T.Application(
-                    type="jar", file_path=path,
-                    packages=[T.Package(id=f"{full}@{ver}", name=full,
-                                        version=ver, file_path=path)])])
+        base = path.rsplit("/", 1)[-1]
+        m = _JAR_NAME.match(base)
+        fname_aid, fname_ver = (m.group("name"), m.group("version")) \
+            if m else ("", "")
+        found_pom_props = False
         props = [n for n in zf.namelist()
                  if n.endswith("pom.properties")]
         for name in props:
@@ -153,11 +149,23 @@ class JarAnalyzer(Analyzer):
                 full = f"{gid}:{aid}"
                 pkgs.append(T.Package(id=f"{full}@{ver}", name=full,
                                       version=ver, file_path=path))
-        if not pkgs:
-            base = path.rsplit("/", 1)[-1]
-            m = _JAR_NAME.match(base)
-            if m:
-                name, version = m.group("name"), m.group("version")
+                if aid == fname_aid and ver == fname_ver:
+                    found_pom_props = True
+        from ...javadb import get_db
+        jdb = get_db()
+        if not found_pom_props:
+            hit = None
+            if jdb is not None:
+                import hashlib
+                digest = hashlib.sha1(content).hexdigest()  # noqa: S324
+                hit = jdb.search_by_sha1(digest)
+            if hit:
+                gid, aid, ver = hit
+                full = f"{gid}:{aid}"
+                pkgs.append(T.Package(id=f"{full}@{ver}", name=full,
+                                      version=ver, file_path=path))
+            elif fname_aid and fname_ver:
+                name, version = fname_aid, fname_ver
                 if jdb is not None:
                     gid = jdb.search_by_artifact_id(name, version)
                     if gid:
@@ -166,6 +174,9 @@ class JarAnalyzer(Analyzer):
                     id=f"{name}@{version}",
                     name=name, version=version,
                     file_path=path))
+        seen = set()
+        pkgs = [p for p in pkgs
+                if p.id not in seen and not seen.add(p.id)]
         if not pkgs:
             return None
         return AnalysisResult(applications=[

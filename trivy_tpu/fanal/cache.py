@@ -105,6 +105,7 @@ def _pkg_from_json(j: dict) -> T.Package:
     return T.Package(
         id=j.get("ID", ""), name=j.get("Name", ""),
         identifier=T.PkgIdentifier(purl=(j.get("Identifier") or {}).get("PURL", ""),
+                                   bom_ref=(j.get("Identifier") or {}).get("BOMRef", ""),
                                    uid=(j.get("Identifier") or {}).get("UID", "")),
         version=j.get("Version", ""), release=j.get("Release", ""),
         epoch=j.get("Epoch", 0), arch=j.get("Arch", ""),

@@ -28,9 +28,13 @@ def _render_primitive(v) -> str:
     if v is None:
         return "null"
     if isinstance(v, str):
-        if "\n" in v:
-            return "<<EOF\n%s\nEOF" % v
-        return json.dumps(v)
+        # always a quoted string (escapes handled by the HCL lexer):
+        # heredocs break inside single-line map/list values and terminate
+        # early when the content contains a bare delimiter line; plan
+        # values are literal, so interpolation markers must be escaped
+        # ($${ / %%{ round-trip through the lexer back to ${ / %{)
+        v = v.replace("${", "$${").replace("%{", "%%{")
+        return json.dumps(v, ensure_ascii=False)
     if isinstance(v, (int, float)):
         return json.dumps(v)
     if isinstance(v, dict):

@@ -40,8 +40,10 @@ def _pkg_json(p: dict) -> dict:
     }
     ident = p.get("identifier")
     if ident:
+        # reference pkg/rpc/convert.go:239 maps BomRef<->BOMRef; UID is not
+        # carried on the wire (proto PkgIdentifier has only purl+bom_ref)
         out["Identifier"] = {"PURL": ident.get("purl", ""),
-                             "UID": ident.get("bom_ref", "")}
+                             "BOMRef": ident.get("bom_ref", "")}
     locs = p.get("locations")
     if locs:
         out["Locations"] = [{"StartLine": l.get("start_line", 0),
@@ -175,6 +177,10 @@ def _vuln_proto(v: T.DetectedVulnerability) -> dict:
         "cwe_ids": list(det.cwe_ids or []),
         "layer": _layer_proto(v.layer),
     }
+    if v.pkg_identifier and (v.pkg_identifier.purl
+                             or v.pkg_identifier.bom_ref):
+        out["pkg_identifier"] = {"purl": v.pkg_identifier.purl,
+                                 "bom_ref": v.pkg_identifier.bom_ref}
     if det.cvss:
         cvss = {}
         for src, c in det.cvss.items():
@@ -256,6 +262,10 @@ def _pkg_proto(p: T.Package) -> dict:
         "depends_on": list(p.depends_on or []),
         "digest": p.digest, "dev": p.dev, "indirect": p.indirect,
         "layer": _layer_proto(p.layer),
+        "identifier": {"purl": p.identifier.purl,
+                       "bom_ref": p.identifier.bom_ref}
+        if p.identifier and (p.identifier.purl or p.identifier.bom_ref)
+        else None,
     }
 
 
