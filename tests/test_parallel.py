@@ -1,5 +1,5 @@
 """Mesh-sharding tests on the virtual 8-device CPU platform: the sharded
-join must be bit-identical to the single-device join, for every mesh
+pair join must be bit-identical to the single-device path, for every mesh
 factorization."""
 
 import glob
@@ -9,14 +9,12 @@ import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 
 from trivy_tpu.db import build_table
 from trivy_tpu.db.fixtures import load_fixture_files
-from trivy_tpu.ops.hashing import key_hash, split_u64
-from trivy_tpu.ops.join import advisory_join
-from trivy_tpu.parallel.mesh import make_mesh, shard_table, sharded_scan_step
-from trivy_tpu.version import encode_version
+from trivy_tpu.detect.engine import BatchDetector, PkgQuery
+from trivy_tpu.parallel.mesh import (MeshDetector, make_mesh,
+                                     partition_pairs, shard_table)
 
 FIXTURES = sorted(glob.glob(
     os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")))
@@ -28,69 +26,93 @@ def table():
     return build_table(advisories, details)
 
 
-def _batch(table, b=32):
-    kw = table.lo_tok.shape[1]
-    pkg_hash = np.zeros((b, 2), np.int32)
-    pkg_tok = np.zeros((b, kw), np.int32)
-    pkg_valid = np.zeros(b, bool)
-    queries = [
-        ("alpine 3.17", "alpine", "openssl", "3.0.7-r0"),
-        ("alpine 3.17", "alpine", "musl", "1.2.3-r4"),
-        ("alpine 3.17", "alpine", "zlib", "1.2.12-r1"),
-        ("debian 11", "debian", "openssl", "1.1.1n-0+deb11u3"),
-        ("debian 11", "debian", "bash", "5.1-2+deb11u1"),
-        ("pip::GitHub Security Advisory Pip", "pip", "flask", "2.2.2"),
-        ("npm::GitHub Security Advisory Npm", "npm", "lodash", "4.17.20"),
-    ]
-    hashes = []
-    for i in range(b):
-        src, eco, name, ver = queries[i % len(queries)]
-        hashes.append(key_hash(src, name))
-        pkg_tok[i] = encode_version(eco, ver).tokens
-        pkg_valid[i] = True
-    pkg_hash[:] = split_u64(hashes)
-    return pkg_hash, pkg_tok, pkg_valid
+QUERIES = [
+    ("alpine 3.17", "alpine", "openssl", "3.0.7-r0"),
+    ("alpine 3.17", "alpine", "musl", "1.2.3-r4"),
+    ("alpine 3.17", "alpine", "zlib", "1.2.12-r1"),
+    ("debian 11", "debian", "openssl", "1.1.1n-0+deb11u3"),
+    ("debian 11", "debian", "bash", "5.1-2+deb11u1"),
+    ("pip::GitHub Security Advisory Pip", "pip", "flask", "2.2.2"),
+    ("npm::GitHub Security Advisory Npm", "npm", "lodash", "4.17.20"),
+    ("alpine 3.17", "alpine", "no-such-pkg", "1.0-r0"),
+]
+
+
+def _queries(b=32):
+    return [PkgQuery(source=src, ecosystem=eco, name=name, version=ver)
+            for src, eco, name, ver in
+            (QUERIES[i % len(QUERIES)] for i in range(b))]
+
+
+def _hit_set(hits):
+    return {(h.query.source, h.query.name, h.query.version, h.vuln_id)
+            for h in hits}
 
 
 @pytest.mark.parametrize("db_shards", [1, 2, 4])
 def test_sharded_join_matches_single(table, db_shards):
     mesh = make_mesh(8, db_shards=db_shards)
-    st = shard_table(table, db_shards)
-    pkg_hash, pkg_tok, pkg_valid = _batch(table)
-    hm, sat, idx = sharded_scan_step(mesh, st, pkg_hash, pkg_tok, pkg_valid)
+    single = BatchDetector(table)
+    sharded = MeshDetector(table, mesh, db_shards=db_shards)
+    qs = _queries()
+    want = _hit_set(single.detect(qs))
+    got = _hit_set(sharded.detect(qs))
+    assert want, "expected non-empty hit set"
+    assert got == want
 
-    hm1, sat1, idx1 = advisory_join(
-        jnp.asarray(table.hash), jnp.asarray(table.lo_tok),
-        jnp.asarray(table.hi_tok), jnp.asarray(table.flags),
-        jnp.asarray(pkg_hash), jnp.asarray(pkg_tok), jnp.asarray(pkg_valid),
-        window=table.window)
-    hm1, sat1, idx1 = (np.asarray(x) for x in (hm1, sat1, idx1))
 
-    # same satisfied (pkg, global row) pairs regardless of sharding
-    def pairs(hmm, satm, idxm):
-        out = set()
-        it = np.nonzero(satm)
-        if satm.ndim == 3:
-            for s, i, j in zip(*it):
-                out.add((int(i), int(idxm[s, i, j])))
-        else:
-            for i, j in zip(*it):
-                out.add((int(i), int(idxm[i, j])))
-        return out
+def test_sharded_join_skewed_buckets(table):
+    """A bucket with far more rows than the others must still route and
+    evaluate correctly across shards (the real trivy-db skew shape)."""
+    from trivy_tpu.db.table import RawAdvisory
+    raw = [RawAdvisory(source="debian 11", ecosystem="debian",
+                       pkg_name="linux", vuln_id=f"CVE-2020-{i:05d}",
+                       fixed_version=f"5.{i % 200}.{i % 7}-1")
+           for i in range(1000)]
+    raw += [RawAdvisory(source="debian 11", ecosystem="debian",
+                        pkg_name=f"pkg{i}", vuln_id=f"CVE-2021-{i:04d}",
+                        fixed_version="2.0-1") for i in range(50)]
+    t = build_table(raw)
+    qs = [PkgQuery(source="debian 11", ecosystem="debian", name="linux",
+                   version="4.0-1"),
+          PkgQuery(source="debian 11", ecosystem="debian", name="pkg7",
+                   version="1.0-1"),
+          PkgQuery(source="debian 11", ecosystem="debian", name="pkg7",
+                   version="3.0-1")]
+    single = _hit_set(BatchDetector(t).detect(qs))
+    mesh = make_mesh(8, db_shards=4)
+    sharded = _hit_set(MeshDetector(t, mesh, db_shards=4).detect(qs))
+    assert len([h for h in single if h[1] == "linux"]) == 1000
+    assert ("debian 11", "pkg7", "1.0-1", "CVE-2021-0007") in single
+    assert ("debian 11", "pkg7", "3.0-1", "CVE-2021-0007") not in single
+    assert sharded == single
 
-    assert pairs(hm, sat, idx) == pairs(hm1, sat1, idx1)
-    assert pairs(hm, sat, idx), "expected non-empty hit set"
+
+def test_partition_pairs_covers_all(table):
+    st = shard_table(table, 4)
+    det = BatchDetector(table)
+    prep = det._prepare(_queries())
+    part = partition_pairs(st, prep.pair_row, prep.pair_ver,
+                           prep.n_pairs, dp=2)
+    # every real pair appears exactly once across the partition
+    assert int(part.valid.sum()) == prep.n_pairs
+    assert sorted(part.perm[part.valid].tolist()) == \
+        list(range(prep.n_pairs))
+    # localized rows stay inside their shard's real length
+    for s in range(st.row_offset.shape[0]):
+        v = part.valid[:, s]
+        assert (part.pair_row[:, s][v] < st.row_len[s]).all()
 
 
 def test_shard_table_bucket_boundaries(table):
     st = shard_table(table, 4)
+    h64 = table.hash_u64
     # no hash bucket may span two shards
-    for s in range(st.hash.shape[0] - 1):
-        last = st.hash[s][-1]
-        nxt = st.hash[s + 1][0]
-        if (last == 2**31 - 1).all() or (nxt == 2**31 - 1).all():
-            continue  # padding
-        assert not (last == nxt).all()
+    for s in range(st.row_offset.shape[0] - 1):
+        end = st.row_offset[s] + st.row_len[s]
+        if st.row_len[s] == 0 or end >= h64.shape[0]:
+            continue
+        assert h64[end - 1] != h64[end]
 
 
 def test_mesh_shapes():
@@ -103,4 +125,4 @@ def test_graft_entry_importable():
     import __graft_entry__ as g
     fn, args = g.entry()
     out = jax.jit(fn)(*args)
-    assert len(out) == 4
+    assert len(out) == 2

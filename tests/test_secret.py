@@ -101,13 +101,18 @@ class TestRules:
             ["github-oauth", "github-pat"]
 
 
-class TestAutomaton:
-    def test_build_and_host_scan(self):
-        auto = ac.build_automaton([b"AKIA", b"ghp_", b"key"])
-        assert auto.n_keywords == 3
+class TestPrefixScan:
+    @staticmethod
+    def _scan(bank, chunks):
+        return np.asarray(ac.prefix_scan(
+            bank.kw_word4, bank.kw_mask4, chunks, n_words=bank.words))
+
+    def test_build_and_scan(self):
+        bank = ac.build_literal_bank([b"AKIA", b"ghp_", b"key"])
+        assert bank.n_keywords == 3
         chunks, owner = ac.pack_chunks(
             [b"my ghp_ token", b"nothing here", b"AKIA and KEY"], 64, 8)
-        masks = np.asarray(ac.ac_scan(auto.trans, auto.out_bits, chunks))
+        masks = self._scan(bank, chunks)
         hit_sets = {}
         for row, fi in zip(masks, owner):
             bits = int(row[0]) & 0xFFFFFFFF
@@ -118,11 +123,35 @@ class TestAutomaton:
         assert hit_sets[2] == 0b101           # AKIA + key (case-insensitive)
 
     def test_chunk_overlap_catches_straddle(self):
-        auto = ac.build_automaton([b"SECRETWORD"])
+        bank = ac.build_literal_bank([b"SECRETWORD"])
         data = b"x" * 60 + b"SECRETWORD" + b"y" * 60
-        chunks, owner = ac.pack_chunks([data], 64, auto.max_kw_len - 1)
-        masks = np.asarray(ac.ac_scan(auto.trans, auto.out_bits, chunks))
+        chunks, owner = ac.pack_chunks([data], 64, bank.max_kw_len - 1)
+        masks = self._scan(bank, chunks)
         assert (masks != 0).any()
+
+    def test_prefix_superset_never_misses(self):
+        """The device mask is a superset filter on the 4-byte prefix: a
+        prefix-only occurrence may set the bit (host confirms), but a
+        full occurrence must always set it."""
+        bank = ac.build_literal_bank([b"heroku", b"key"])
+        chunks, _ = ac.pack_chunks(
+            [b"has herok-prefix only: herox", b"real heroku here"], 64, 8)
+        masks = self._scan(bank, chunks)
+        assert int(masks[0, 0]) & 0b01 == 0b01  # prefix "hero" → candidate
+        assert int(masks[1, 0]) & 0b01 == 0b01  # true occurrence
+
+    def test_word_boundary_bit_33(self):
+        """More than 32 keywords → second mask word used correctly."""
+        kws = [f"unique{i:02d}q".encode() for i in range(40)]
+        bank = ac.build_literal_bank(kws)
+        chunks, _ = ac.pack_chunks([b"xx unique37q xx"], 64, 16)
+        masks = self._scan(bank, chunks)
+        acc = 0
+        for w in range(masks.shape[1]):
+            acc |= (int(masks[0, w]) & 0xFFFFFFFF) << (32 * w)
+        # all 40 keywords share the 4-byte prefix "uniq" → all candidates;
+        # bit 37 must be among them (exactness restored by host confirm)
+        assert acc & (1 << 37)
 
     def test_device_prefilter_equals_host(self, device_scanner, scanner):
         files = [

@@ -81,6 +81,9 @@ class AdvisoryTable:
         self.flags = flags
         self.group = group
         self.groups = groups
+        # max rows sharing one hash — diagnostic only (real trivy-db is
+        # violently skewed: the CSR pair join is sized per query, so this
+        # no longer bounds any device shape)
         self.window = max(window, 1)
         self.details = details or {}
         # side tables that scope advisories at query time, e.g.
@@ -88,6 +91,7 @@ class AdvisoryTable:
         self.aux = aux or {}
         self.sources = sorted({g.source for g in groups})
         self._device = None
+        self._hash_u64 = None
 
     def sources_for_prefix(self, prefix: str) -> list[str]:
         """Buckets matching an ecosystem prefix — the columnar equivalent of
@@ -98,14 +102,28 @@ class AdvisoryTable:
     def __len__(self):
         return self.hash.shape[0]
 
+    @property
+    def hash_u64(self) -> np.ndarray:
+        """Sorted uint64 view of the (hi, lo) hash pairs for the host-side
+        vectorized bucket lookup (np.searchsorted). The biased int32
+        halves (ops.hashing.split_u64) are unbiased back here."""
+        if self._hash_u64 is None:
+            hi = (self.hash[:, 0].astype(np.int64) + (1 << 31)).astype(
+                np.uint64)
+            lo = (self.hash[:, 1].astype(np.int64) + (1 << 31)).astype(
+                np.uint64)
+            self._hash_u64 = (hi << np.uint64(32)) | lo
+        return self._hash_u64
+
     def device_arrays(self):
         """device_put once, reuse across batches (double-buffer swap point
-        for DB hot reload, reference pkg/rpc/server/listen.go:129-192)."""
+        for DB hot reload, reference pkg/rpc/server/listen.go:129-192).
+        Hashes stay host-side — the bucket lookup is a host searchsorted;
+        the device only sees version tokens and flags."""
         if self._device is None:
             import jax
             self._device = tuple(jax.device_put(x) for x in
-                                 (self.hash, self.lo_tok, self.hi_tok,
-                                  self.flags))
+                                 (self.lo_tok, self.hi_tok, self.flags))
         return self._device
 
     def save(self, path: str):

@@ -1,13 +1,18 @@
-"""BatchDetector: host orchestration around ops.join.
+"""BatchDetector: host orchestration around ops.join.pair_join.
 
 Pipeline per batch (SURVEY.md §7 step 3):
-  host: encode (source, name, version) → hash pairs + version keys
-        (both memoized — registry sweeps reuse versions heavily), pad the
-        batch to a power-of-two bucket (avoids recompile storms);
-  device: one advisory_join_packed call → 2-bit report mask + row idx;
-  host: numpy group-by over the few reported rows — package-name
-        verification (hash-collision guard), positive minus negative
-        polarity per advisory group, exact re-check of INEXACT rows.
+  host:  queries are encoded against two memo pools — unique
+         (ecosystem, version) → token-vector row, unique (source, name) →
+         fnv1a64 — so a registry sweep re-encodes nothing; the bucket of
+         every query is located with one vectorized np.searchsorted pair
+         over the table's sorted uint64 hashes, and queries with empty
+         buckets (most packages) are dropped before any device work. The
+         remaining buckets expand to a flat candidate-pair list
+         (np.repeat — no per-query Python loop anywhere on the hot path);
+  device: one pair_join call → 2-bit report per candidate pair;
+  host:  numpy group-by over the reported pairs — package-name
+         verification (hash-collision guard), positive minus negative
+         polarity per advisory group, exact re-check of INEXACT rows.
 
 The reference evaluates the same predicate one package at a time
 (pkg/detector/ospkg/alpine/alpine.go:86-117, library/driver.go:111-136).
@@ -23,7 +28,7 @@ import numpy as np
 from .. import version as V
 from ..db.table import AdvisoryTable
 from ..ops import join as J
-from ..ops.hashing import key_hash, split_u64
+from ..ops import next_pow2 as _next_pow2
 
 
 @dataclass
@@ -48,121 +53,180 @@ class Hit:
     vendor_ids: tuple
 
 
-def _next_pow2(n: int, floor: int = 128) -> int:
-    b = floor
-    while b < n:
-        b *= 2
-    return b
+@dataclass
+class _Prepared:
+    """Host-side product of _prepare: the candidate-pair list."""
+    usable: list          # [(PkgQuery, exact_version: bool)]
+    pair_q: np.ndarray    # int64[T] index into usable per pair
+    pair_row: np.ndarray  # int32[T_pad] advisory row per pair
+    pair_ver: np.ndarray  # int32[T_pad] version-pool row per pair
+    n_pairs: int          # T (pairs beyond are padding)
+    u_pad: int            # version-pool rows to ship (power of two)
 
 
 class BatchDetector:
-    def __init__(self, table: AdvisoryTable):
+    def __init__(self, table: AdvisoryTable, pair_floor: int = 256):
         self.table = table
-        self._key_cache: dict[tuple[str, str], Optional[V.VersionKey]] = {}
-        self._hash_cache: dict[tuple[str, str], np.ndarray] = {}
+        self.pair_floor = pair_floor
+        kw = table.lo_tok.shape[1] if len(table) else V.KEY_WIDTH
+        # version pool: unique (eco, version) → row in _ver_mat
+        self._ver_idx: dict[tuple[str, str], int] = {}
+        self._ver_mat = np.zeros((256, kw), np.int32)
+        self._ver_exact: list[bool] = []
+        self._ver_count = 0
+        self._ver_dev = None       # device snapshot of the pool
+        self._ver_dev_rows = 0     # pool rows covered by the snapshot
+        # hash pool: unique (source, name) → uint64
+        self._hash_cache: dict[tuple[str, str], int] = {}
 
-    def _encode(self, eco: str, ver: str) -> Optional[V.VersionKey]:
+    # ---- memo pools ---------------------------------------------------
+
+    def _ver_index(self, eco: str, ver: str) -> Optional[int]:
         ck = (eco, ver)
-        if ck not in self._key_cache:
-            try:
-                self._key_cache[ck] = V.encode_version(eco, ver)
-            except (ValueError, KeyError):
-                # Reference skips packages whose installed version doesn't
-                # parse (alpine.go:96-100 logs debug and continues).
-                self._key_cache[ck] = None
-        return self._key_cache[ck]
+        idx = self._ver_idx.get(ck, -1)
+        if idx != -1:
+            return idx if idx is not None else None
+        try:
+            k = V.encode_version(eco, ver)
+        except (ValueError, KeyError):
+            # Reference skips packages whose installed version doesn't
+            # parse (alpine.go:96-100 logs debug and continues).
+            self._ver_idx[ck] = None
+            return None
+        i = self._ver_count
+        if i == self._ver_mat.shape[0]:
+            self._ver_mat = np.concatenate(
+                [self._ver_mat, np.zeros_like(self._ver_mat)])
+        self._ver_mat[i] = k.tokens
+        self._ver_exact.append(k.exact)
+        self._ver_count = i + 1
+        self._ver_idx[ck] = i
+        return i
 
-    def _hash(self, source: str, name: str) -> np.ndarray:
-        ck = (source, name)
-        h = self._hash_cache.get(ck)
-        if h is None:
-            h = split_u64([key_hash(source, name)])[0]
-            self._hash_cache[ck] = h
-        return h
-
-    def _prepare(self, queries: list[PkgQuery]):
-        """→ (usable, packed int32 [B, K+3]) or (.., None) if empty.
-        Versions and (source, name) hashes are memoized separately — they
-        recur independently across a sweep even when their combination is
-        unique per image."""
-        t = self.table
-        usable: list[tuple[PkgQuery, V.VersionKey]] = []
-        for q in queries:
-            k = self._encode(q.ecosystem, q.version)
-            if k is not None:
-                usable.append((q, k))
-        if not usable:
-            return usable, None
-        # batch-hash cold (source, name) keys via the native helper
-        cold = [(q.source, q.name) for q, _ in usable
-                if (q.source, q.name) not in self._hash_cache]
-        if len(cold) > 64:
+    def _hashes(self, keys: list[tuple[str, str]]) -> np.ndarray:
+        """→ uint64[len(keys)], batch-hashing cold keys natively."""
+        cache = self._hash_cache
+        cold = list({ck for ck in keys if ck not in cache})
+        if cold:
             from ..native import fnv1a64_batch
-            cold = list(dict.fromkeys(cold))
-            hashes = split_u64(fnv1a64_batch(
-                [s.encode() + b"\x00" + n.encode() for s, n in cold]))
-            for ck, h in zip(cold, hashes):
-                self._hash_cache[ck] = h
-        b = _next_pow2(len(usable))
-        kw = t.lo_tok.shape[1]
-        packed = np.zeros((b, kw + 3), np.int32)
-        for i, (q, k) in enumerate(usable):
-            packed[i, 0:2] = self._hash(q.source, q.name)
-            packed[i, 3:] = k.tokens
-        packed[:len(usable), 2] = 1
-        return usable, packed
+            hv = fnv1a64_batch(
+                [s.encode() + b"\x00" + n.encode() for s, n in cold])
+            for ck, h in zip(cold, hv):
+                cache[ck] = int(h)
+        return np.fromiter((cache[ck] for ck in keys),
+                           dtype=np.uint64, count=len(keys))
 
-    def _dispatch(self, packed):
-        """Launch the join; returns the device array (async)."""
-        import jax.numpy as jnp
-        adv = self.table.device_arrays()
-        return J.advisory_join_io(*adv, jnp.asarray(packed),
-                                  window=self.table.window)
+    def ver_snapshot(self, u_pad: int | None = None) -> np.ndarray:
+        """Padded host snapshot of the version pool (rows beyond the pool
+        are zero and never referenced by pair_ver)."""
+        rows = max(u_pad or 0, _next_pow2(self._ver_count))
+        snap = np.zeros((rows, self._ver_mat.shape[1]), np.int32)
+        snap[:self._ver_count] = self._ver_mat[:self._ver_count]
+        return snap
+
+    def _ver_device(self, u_pad: int):
+        """Device snapshot of the version pool, re-shipped only when the
+        pool outgrew the last upload."""
+        import jax
+        if self._ver_dev is None or self._ver_dev_rows < self._ver_count \
+                or self._ver_dev.shape[0] < u_pad:
+            self._ver_dev = jax.device_put(self.ver_snapshot(u_pad))
+            self._ver_dev_rows = self._ver_count
+        return self._ver_dev
+
+    # ---- batch pipeline -----------------------------------------------
+
+    def _prepare(self, queries: list[PkgQuery]) -> Optional[_Prepared]:
+        t = self.table
+        usable: list[tuple[PkgQuery, bool]] = []
+        ver_rows: list[int] = []
+        for q in queries:
+            vi = self._ver_index(q.ecosystem, q.version)
+            if vi is not None:
+                usable.append((q, self._ver_exact[vi]))
+                ver_rows.append(vi)
+        if not usable:
+            return None
+        hashes = self._hashes([(q.source, q.name) for q, _ in usable])
+        start = np.searchsorted(t.hash_u64, hashes, side="left")
+        end = np.searchsorted(t.hash_u64, hashes, side="right")
+        counts = end - start
+        nz = np.nonzero(counts)[0]
+        if nz.size == 0:
+            return _Prepared(usable, np.zeros(0, np.int64),
+                             np.zeros(0, np.int32), np.zeros(0, np.int32),
+                             0, 0)
+        counts_nz = counts[nz]
+        offsets = np.zeros(nz.size + 1, np.int64)
+        np.cumsum(counts_nz, out=offsets[1:])
+        n_pairs = int(offsets[-1])
+        pair_q = np.repeat(nz, counts_nz)
+        pair_row = (np.arange(n_pairs, dtype=np.int64)
+                    - np.repeat(offsets[:-1], counts_nz)
+                    + np.repeat(start[nz], counts_nz)).astype(np.int32)
+        ver_arr = np.asarray(ver_rows, np.int32)
+        t_pad = _next_pow2(n_pairs, self.pair_floor)
+        row_p = np.zeros(t_pad, np.int32)
+        row_p[:n_pairs] = pair_row
+        ver_p = np.zeros(t_pad, np.int32)
+        ver_p[:n_pairs] = ver_arr[pair_q]
+        return _Prepared(usable, pair_q, row_p, ver_p, n_pairs,
+                         _next_pow2(self._ver_count))
+
+    def _dispatch(self, prep: _Prepared):
+        """Launch the pair join; returns the device array (async)."""
+        import jax
+        adv_lo, adv_hi, adv_flags = self.table.device_arrays()
+        valid = np.zeros(prep.pair_row.shape[0], bool)
+        valid[:prep.n_pairs] = True
+        return J.pair_join(adv_lo, adv_hi, adv_flags,
+                           self._ver_device(prep.u_pad),
+                           jax.device_put(prep.pair_row),
+                           jax.device_put(prep.pair_ver),
+                           jax.device_put(valid))
 
     def detect(self, queries: list[PkgQuery]) -> list[Hit]:
         if len(self.table) == 0 or not queries:
             return []
-        usable, packed = self._prepare(queries)
-        if packed is None:
+        prep = self._prepare(queries)
+        if prep is None or prep.n_pairs == 0:
             return []
-        out = np.asarray(self._dispatch(packed))
-        return self._assemble(usable, out & 3, out >> 2)
+        return self._assemble(prep, np.asarray(self._dispatch(prep)))
 
     def detect_many(self, batches: list[list[PkgQuery]]) -> list[list[Hit]]:
         """Pipelined variant: all batches are dispatched before any result
         is pulled back, overlapping host prep, device compute, and
         transfers (replaces the reference's worker-pool overlap,
         pkg/parallel/pipeline.go)."""
-        prepped = [self._prepare(qs) for qs in batches]
-        futures = [None if packed is None else self._dispatch(packed)
-                   for _, packed in prepped]
-        results = []
-        for (usable, _), fut in zip(prepped, futures):
-            if fut is None:
-                results.append([])
-                continue
-            out = np.asarray(fut)
-            results.append(self._assemble(usable, out & 3, out >> 2))
-        return results
+        if len(self.table) == 0:
+            return [[] for _ in batches]
+        prepped = [self._prepare(qs) if qs else None for qs in batches]
+        futures = [None if p is None or p.n_pairs == 0
+                   else self._dispatch(p) for p in prepped]
+        return [[] if fut is None
+                else self._assemble(prep, np.asarray(fut))
+                for prep, fut in zip(prepped, futures)]
 
-    def _assemble(self, usable, report, idx) -> list[Hit]:
+    def _assemble(self, prep: _Prepared, bits: np.ndarray) -> list[Hit]:
         t = self.table
-        rows_i, rows_j = np.nonzero(report)
-        if rows_i.size == 0:
+        bits = bits[:prep.n_pairs]
+        keep = np.nonzero(bits)[0]
+        if keep.size == 0:
             return []
-        bits = report[rows_i, rows_j]
-        rowids = idx[rows_i, rows_j]
-        gids = t.group[rowids]
-        flags = t.flags[rowids]
-        sat = (bits & 1) != 0
+        rows = prep.pair_row[keep]
+        qidx = prep.pair_q[keep]
+        b = bits[keep]
+        gids = t.group[rows]
+        flags = t.flags[rows]
+        sat = (b & J.SATISFIED) != 0
         neg = (flags & J.NEGATIVE) != 0
-        inexact = (bits & 2) != 0
+        inexact = (b & J.NEEDS_RECHECK) != 0
 
-        # group-by (pkg, advisory group) in numpy
-        key = rows_i.astype(np.int64) * (len(t.groups) + 1) + gids
+        # group-by (pkg query, advisory group) in numpy
+        key = qidx.astype(np.int64) * (len(t.groups) + 1) + gids
         order = np.argsort(key, kind="stable")
         key_s = key[order]
-        uniq, starts = np.unique(key_s, return_index=True)
+        uniq, seg_start = np.unique(key_s, return_index=True)
         pos_any = np.zeros(uniq.shape[0], bool)
         neg_any = np.zeros(uniq.shape[0], bool)
         inex_any = np.zeros(uniq.shape[0], bool)
@@ -177,7 +241,7 @@ class BatchDetector:
         for u in range(uniq.shape[0]):
             i = int(pkg_of[u])
             g = t.groups[int(gid_of[u])]
-            q, k = usable[i]
+            q, ver_exact = prep.usable[i]
             if g.pkg_name != q.name or g.source != q.source:
                 continue  # 64-bit hash collision: reject
             if g.arches and q.arch and q.arch not in g.arches:
@@ -185,7 +249,7 @@ class BatchDetector:
             if g.cpe_indices and not \
                     q.cpe_indices.intersection(g.cpe_indices):
                 continue  # Red Hat: entry's CPEs outside content sets
-            if inex_any[u] or not k.exact:
+            if inex_any[u] or not ver_exact:
                 pos, negv = self._exact_eval(g, q)
             else:
                 pos, negv = bool(pos_any[u]), bool(neg_any[u])

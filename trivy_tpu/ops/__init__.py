@@ -1,2 +1,11 @@
 """Device-side primitives: vectorized version compare, hashing, the
-batched advisory join, and the Aho-Corasick secret prefilter."""
+candidate-pair advisory join, and the secret keyword prefilter."""
+
+
+def next_pow2(n: int, floor: int = 128) -> int:
+    """Smallest power of two ≥ max(n, floor) — the shared padding-bucket
+    policy that bounds recompilation across batch shapes."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b

@@ -3,27 +3,33 @@ per-package detect loops.
 
 Reference inner loop (pkg/detector/ospkg/alpine/alpine.go:86-117,
 pkg/detector/library/driver.go:111-136): for each package, a BoltDB bucket
-lookup by (stream, name), then a per-advisory version-range check. Here the
-whole batch is one device program:
+lookup by (stream, name), then a per-advisory version-range check.
 
-  1. packages and advisory rows are keyed by fnv1a64(source + name), stored
-     as (hi, lo) int32 pairs (TPUs have no native int64);
-  2. a vectorized 32-step binary search finds each package's bucket start in
-     the hash-sorted advisory table;
-  3. a static window of W consecutive rows (W = max bucket size, computed at
-     flatten time) is gathered and every (package, row) pair evaluates the
-     interval predicate  has_lo → lo ≤/< installed  ∧  has_hi → installed </≤ hi
-     with the vectorized lexicographic compare.
+Here the join is evaluated as a flat **candidate-pair list** (CSR
+expansion), sized by the actual number of (package, advisory-row)
+candidates rather than a padded window:
 
-Outputs are two bool masks [B, W]: hash-match and interval-satisfied, plus
-the row indices. Grouping rows into advisories (vulnerable-range rows vs
-patched-range rows) and hash-collision verification happen host-side on the
-few matched rows (trivy_tpu.detect).
+  host:   queries are hashed (fnv1a64 of source+"\\0"+name) and located in
+          the hash-sorted table with one vectorized np.searchsorted pair —
+          each query's bucket is [start, start+count). Buckets expand to a
+          flat pair list (np.repeat); queries with empty buckets (the vast
+          majority of packages in a real image) never reach the device.
+  device: pure gathers + the vectorized interval predicate
+          has_lo → lo ≤/< installed  ∧  has_hi → installed </≤ hi
+          over int32[T, K] token vectors. No hashes, no searches, no
+          data-dependent control flow on device.
+
+This shape survives the real trivy-db's bucket skew: a source package
+with 4,000 advisories (debian `linux`) contributes 4,000 pairs *only when
+queried*, instead of inflating a global window that every package pays
+for. Device work and transfer are O(sum of queried bucket sizes).
+
+Grouping rows into advisories (vulnerable-range rows vs patched-range
+rows) and hash-collision verification happen host-side on the few matched
+rows (trivy_tpu.detect.engine).
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -38,61 +44,29 @@ HI_INCL = 8
 INEXACT = 16
 NEGATIVE = 32  # row describes a patched/unaffected range, not a vulnerable one
 
-
-def pair_less(ah, al, bh, bl):
-    return (ah < bh) | ((ah == bh) & (al < bl))
-
-
-def searchsorted_pair(table_hi, table_lo, qh, ql):
-    """Left insertion point of each (qh, ql) in the sorted (hi, lo) table.
-
-    32-iteration vectorized binary search (supports tables up to 2^32 rows);
-    static trip count keeps XLA control flow trivial.
-    """
-    n = table_hi.shape[0]
-    # derive the carry from the query so its varying-axes type matches
-    # under shard_map (zeros_like/full would be unvarying)
-    lo = qh * 0
-    hi = qh * 0 + n
-
-    def body(_, carry):
-        lo, hi = carry
-        mid = (lo + hi) // 2
-        midc = jnp.clip(mid, 0, n - 1)
-        go_right = pair_less(table_hi[midc], table_lo[midc], qh, ql)
-        return jnp.where(go_right, mid + 1, lo), jnp.where(go_right, hi, mid)
-
-    lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
-    return lo
+# report bits returned per pair
+SATISFIED = 1
+NEEDS_RECHECK = 2
 
 
-def _join_core(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
-               pkg_hash, pkg_tok, pkg_valid, window: int):
-    """Batched hash-join + interval predicate.
+def _pair_core(adv_lo_tok, adv_hi_tok, adv_flags,
+               ver_tok, pair_row, pair_ver, pair_valid):
+    """Evaluate the interval predicate for every candidate pair.
 
-    adv_hash:   int32[A, 2] hash-sorted (hi, lo)
-    adv_lo_tok: int32[A, K] lower-bound version tokens
+    adv_lo_tok: int32[A, K] lower-bound version tokens (hash-sorted table)
     adv_hi_tok: int32[A, K] upper-bound version tokens
-    adv_flags:  int32[A]    flag bits (HAS_LO | LO_INCL | HAS_HI | HI_INCL | ...)
-    pkg_hash:   int32[B, 2]
-    pkg_tok:    int32[B, K] installed-version tokens
-    pkg_valid:  bool[B]     padding mask
+    adv_flags:  int32[A]    flag bits (HAS_LO | LO_INCL | HAS_HI | ...)
+    ver_tok:    int32[U, K] unique installed-version token vectors
+    pair_row:   int32[T]    advisory row index per pair
+    pair_ver:   int32[T]    ver_tok row per pair
+    pair_valid: bool[T]     padding mask
 
-    Returns (hash_match bool[B, W], satisfied bool[B, W], row_idx int32[B, W]).
+    Returns int8[T]: SATISFIED | NEEDS_RECHECK bits.
     """
-    a = adv_hash.shape[0]
-    start = searchsorted_pair(adv_hash[:, 0], adv_hash[:, 1],
-                              pkg_hash[:, 0], pkg_hash[:, 1])
-    idx = jnp.clip(start[:, None] + jnp.arange(window, dtype=jnp.int32)[None, :],
-                   0, a - 1)                               # [B, W]
-    hmatch = ((adv_hash[idx, 0] == pkg_hash[:, None, 0])
-              & (adv_hash[idx, 1] == pkg_hash[:, None, 1])
-              & pkg_valid[:, None])                        # [B, W]
-
-    flags = adv_flags[idx]                                 # [B, W]
-    lo_t = adv_lo_tok[idx]                                 # [B, W, K]
-    hi_t = adv_hi_tok[idx]
-    inst = pkg_tok[:, None, :]                             # [B, 1, K]
+    flags = adv_flags[pair_row]                       # [T]
+    lo_t = adv_lo_tok[pair_row]                       # [T, K]
+    hi_t = adv_hi_tok[pair_row]
+    inst = ver_tok[pair_ver]                          # [T, K]
 
     has_lo = (flags & HAS_LO) != 0
     lo_incl = (flags & LO_INCL) != 0
@@ -101,59 +75,10 @@ def _join_core(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
 
     ok_lo = (~has_lo) | lex_less(lo_t, inst) | (lo_incl & lex_eq(lo_t, inst))
     ok_hi = (~has_hi) | lex_less(inst, hi_t) | (hi_incl & lex_eq(inst, hi_t))
-    satisfied = hmatch & ok_lo & ok_hi
-    return hmatch, satisfied, idx, flags
+    satisfied = pair_valid & ok_lo & ok_hi
+    inexact = pair_valid & ((flags & INEXACT) != 0)
+    return (satisfied.astype(jnp.int8)
+            | (inexact.astype(jnp.int8) << 1))
 
 
-@functools.partial(jax.jit, static_argnames=("window",))
-def advisory_join(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
-                  pkg_hash, pkg_tok, pkg_valid, *, window: int):
-    hmatch, satisfied, idx, _ = _join_core(
-        adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
-        pkg_hash, pkg_tok, pkg_valid, window)
-    return hmatch, satisfied, idx
-
-
-@functools.partial(jax.jit, static_argnames=("window",))
-def advisory_join_packed(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
-                         pkg_hash, pkg_tok, pkg_valid, *, window: int):
-    """Transfer-lean variant: one int8 mask [B, W] with
-    bit0 = interval satisfied, bit1 = inexact candidate (hash-matched row
-    flagged INEXACT — needs host recheck), plus the row indices. Rows with
-    neither bit never affect results, so only this mask needs to leave the
-    device."""
-    hmatch, satisfied, idx, flags = _join_core(
-        adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
-        pkg_hash, pkg_tok, pkg_valid, window)
-    inexact = hmatch & ((flags & INEXACT) != 0)
-    report = satisfied.astype(jnp.int8) | (inexact.astype(jnp.int8) << 1)
-    return report, idx
-
-
-def pack_queries(pkg_hash, pkg_tok, pkg_valid):
-    """One int32 [B, K+3] input tensor: cols 0-1 hash (hi, lo), col 2
-    valid, cols 3.. version tokens — a single host→device transfer per
-    batch (the tunnel's per-transfer latency dominates the join cost)."""
-    import numpy as np
-    b = pkg_hash.shape[0]
-    out = np.empty((b, pkg_tok.shape[1] + 3), dtype=np.int32)
-    out[:, 0:2] = pkg_hash
-    out[:, 2] = pkg_valid
-    out[:, 3:] = pkg_tok
-    return out
-
-
-@functools.partial(jax.jit, static_argnames=("window",))
-def advisory_join_io(adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
-                     pkgs_packed, *, window: int):
-    """Single-tensor-in / single-tensor-out join: returns int32 [B, W] of
-    (global_row_idx << 2) | report_bits."""
-    pkg_hash = pkgs_packed[:, 0:2]
-    pkg_valid = pkgs_packed[:, 2] != 0
-    pkg_tok = pkgs_packed[:, 3:]
-    hmatch, satisfied, idx, flags = _join_core(
-        adv_hash, adv_lo_tok, adv_hi_tok, adv_flags,
-        pkg_hash, pkg_tok, pkg_valid, window)
-    inexact = hmatch & ((flags & INEXACT) != 0)
-    report = satisfied.astype(jnp.int32) | (inexact.astype(jnp.int32) << 1)
-    return (idx << 2) | report
+pair_join = jax.jit(_pair_core)

@@ -1,8 +1,9 @@
 """Mesh-parallel execution: the TPU-native replacement for the
 reference's goroutine fan-out (pkg/parallel/pipeline.go) per SURVEY.md
-§2.7 — image batches shard over `dp`, the advisory table shards over
+§2.7 — candidate pairs shard over `dp`, the advisory table shards over
 `db` (the framework's tensor-parallel axis), secret byte-chunks shard
 over `dp` as the sequence axis."""
 
-from .mesh import (ShardedTable, make_mesh, shard_table,  # noqa: F401
-                   sharded_scan_step)
+from .mesh import (MeshDetector, PairPartition,  # noqa: F401
+                   ShardedTable, make_mesh, partition_pairs,
+                   shard_table, sharded_pair_join)

@@ -4,19 +4,21 @@ Replaces the reference's worker-pool distribution (SURVEY.md §2.7 P1/P4:
 errgroup pipelines + client/server sharding) with a 2-D
 `jax.sharding.Mesh`:
 
-  axis "dp"  — data parallel over the package/image batch;
+  axis "dp"  — data parallel over the candidate-pair batch (each pair is
+               one (package, advisory-row) predicate evaluation);
   axis "db"  — the advisory table sharded by contiguous hash range (the
                framework's tensor-parallel dimension; SURVEY.md §5 "TP
                over the DB dimension" for tables larger than one chip's
                HBM).
 
 Table shards are split at bucket boundaries (no hash bucket straddles a
-shard) and padded to equal length, so each shard's local searchsorted is
-exact and no cross-shard halo exchange is needed; a package's hits are
-simply the union over "db" shards, produced as a per-shard output axis.
+shard), so every candidate pair's advisory row lives in exactly one
+shard; the host routes each pair to its shard and splits each shard's
+pairs dp ways. No collectives are needed inside the step — each device
+evaluates its local pairs against its local table slice, and the output
+spec reassembles the bits.
 
-Everything runs under one jit(shard_map(...)) — XLA inserts the
-all-gathers implied by the output spec over ICI.
+Everything runs under one jit(shard_map(...)).
 """
 
 from __future__ import annotations
@@ -32,8 +34,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..db.table import AdvisoryTable
 from ..ops import join as J
+from ..ops import next_pow2 as _next_pow2
 
-PAD_HASH = np.int32(2**31 - 1)  # sorts after every real (hi, lo) pair
+try:  # jax ≥ 0.8 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
 
 
 def make_mesh(n_devices: int | None = None, db_shards: int = 1,
@@ -51,12 +57,11 @@ def make_mesh(n_devices: int | None = None, db_shards: int = 1,
 @dataclass
 class ShardedTable:
     """Advisory arrays with a leading shard axis [S, A_pad, ...]."""
-    hash: np.ndarray
     lo_tok: np.ndarray
     hi_tok: np.ndarray
     flags: np.ndarray
-    window: int
-    row_offset: np.ndarray  # int32[S]: global row index of each shard start
+    row_offset: np.ndarray  # int64[S]: global row index of each shard start
+    row_len: np.ndarray     # int64[S]: real (unpadded) rows per shard
 
 
 def shard_table(table: AdvisoryTable, n_shards: int) -> ShardedTable:
@@ -76,7 +81,6 @@ def shard_table(table: AdvisoryTable, n_shards: int) -> ShardedTable:
     starts = bounds[:-1]
     ends = bounds[1:]
     pad = max((e - s) for s, e in zip(starts, ends)) if a else 1
-    kw = table.lo_tok.shape[1]
 
     def _piece(arr, s, e, fill):
         out = np.full((pad,) + arr.shape[1:], fill, dtype=arr.dtype)
@@ -84,59 +88,128 @@ def shard_table(table: AdvisoryTable, n_shards: int) -> ShardedTable:
         return out
 
     return ShardedTable(
-        hash=np.stack([_piece(h, s, e, PAD_HASH) for s, e in
-                       zip(starts, ends)]),
         lo_tok=np.stack([_piece(table.lo_tok, s, e, 1) for s, e in
                          zip(starts, ends)]),
         hi_tok=np.stack([_piece(table.hi_tok, s, e, 1) for s, e in
                          zip(starts, ends)]),
         flags=np.stack([_piece(table.flags, s, e, 0) for s, e in
                         zip(starts, ends)]),
-        window=table.window,
-        row_offset=np.asarray(starts, dtype=np.int32),
+        row_offset=np.asarray(starts, dtype=np.int64),
+        row_len=np.asarray([e - s for s, e in zip(starts, ends)],
+                           dtype=np.int64),
     )
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("mesh", "window"))
-def _sharded_join(mesh, window, adv_hash, adv_lo, adv_hi, adv_flags,
-                  row_offset, pkg_hash, pkg_tok, pkg_valid):
-    from jax.experimental.shard_map import shard_map
+@dataclass
+class PairPartition:
+    """Candidate pairs routed to (dp, db) devices, plus the permutation
+    to scatter device bits back into the caller's pair order."""
+    pair_row: np.ndarray  # int32[DP, S, T_loc] shard-local advisory rows
+    pair_ver: np.ndarray  # int32[DP, S, T_loc]
+    valid: np.ndarray     # bool [DP, S, T_loc]
+    perm: np.ndarray      # int64[DP, S, T_loc] original pair index (0 pad)
 
-    def local(adv_hash, adv_lo, adv_hi, adv_flags, row_offset,
-              pkg_hash, pkg_tok, pkg_valid):
-        # inside: adv_* [1, A_pad, ...] (this db shard), pkg_* [B/dp, ...].
-        # Packages are replicated over "db"; mark them varying so the
-        # join's loop carries type-check under shard_map.
-        pkg_hash = jax.lax.pcast(pkg_hash, ("db",), to="varying")
-        pkg_tok = jax.lax.pcast(pkg_tok, ("db",), to="varying")
-        pkg_valid = jax.lax.pcast(pkg_valid, ("db",), to="varying")
-        hmatch, sat, idx = J.advisory_join(
-            adv_hash[0], adv_lo[0], adv_hi[0], adv_flags[0],
-            pkg_hash, pkg_tok, pkg_valid, window=window)
-        gidx = idx + row_offset[0]
-        return (hmatch[None], sat[None], gidx[None])
+
+def partition_pairs(st: ShardedTable, pair_row: np.ndarray,
+                    pair_ver: np.ndarray, n_pairs: int, dp: int,
+                    floor: int = 128) -> PairPartition:
+    """Route global candidate pairs to their table shard and balance each
+    shard's pairs across the dp axis."""
+    s_count = st.row_offset.shape[0]
+    rows = pair_row[:n_pairs].astype(np.int64)
+    vers = pair_ver[:n_pairs]
+    shard = np.searchsorted(st.row_offset, rows, side="right") - 1
+    chunks = {}
+    t_loc = floor
+    for s in range(s_count):
+        idx_s = np.nonzero(shard == s)[0]
+        parts = np.array_split(idx_s, dp)
+        chunks[s] = parts
+        for p in parts:
+            t_loc = max(t_loc, _next_pow2(p.size, floor))
+    prow = np.zeros((dp, s_count, t_loc), np.int32)
+    pver = np.zeros((dp, s_count, t_loc), np.int32)
+    valid = np.zeros((dp, s_count, t_loc), bool)
+    perm = np.zeros((dp, s_count, t_loc), np.int64)
+    for s in range(s_count):
+        for d, idx in enumerate(chunks[s]):
+            k = idx.size
+            if not k:
+                continue
+            prow[d, s, :k] = rows[idx] - st.row_offset[s]
+            pver[d, s, :k] = vers[idx]
+            valid[d, s, :k] = True
+            perm[d, s, :k] = idx
+    return PairPartition(prow, pver, valid, perm)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _sharded_pair_join(mesh, adv_lo, adv_hi, adv_flags, ver_tok,
+                       prow, pver, pvalid):
+    def local(adv_lo, adv_hi, adv_flags, ver_tok, prow, pver, pvalid):
+        # inside: adv_* [1, A_pad, ...] (this db shard),
+        # pairs [1, 1, T_loc]; ver_tok replicated — mark varying so the
+        # gathers type-check under shard_map.
+        ver_tok = jax.lax.pcast(ver_tok, ("dp", "db"), to="varying")
+        bits = J._pair_core(adv_lo[0], adv_hi[0], adv_flags[0], ver_tok,
+                            prow[0, 0], pver[0, 0], pvalid[0, 0])
+        return bits[None, None]
 
     f = shard_map(
         local, mesh=mesh,
-        in_specs=(P("db"), P("db"), P("db"), P("db"), P("db"),
-                  P("dp"), P("dp"), P("dp")),
-        out_specs=(P("db", "dp"), P("db", "dp"), P("db", "dp")),
+        in_specs=(P("db"), P("db"), P("db"), P(),
+                  P("dp", "db"), P("dp", "db"), P("dp", "db")),
+        out_specs=P("dp", "db"),
     )
-    return f(adv_hash, adv_lo, adv_hi, adv_flags, row_offset,
-             pkg_hash, pkg_tok, pkg_valid)
+    return f(adv_lo, adv_hi, adv_flags, ver_tok, prow, pver, pvalid)
 
 
-def sharded_scan_step(mesh: Mesh, st: ShardedTable,
-                      pkg_hash, pkg_tok, pkg_valid):
-    """Run the batched join across the mesh.
+def sharded_pair_join(mesh: Mesh, st, ver_tok, part: PairPartition,
+                      n_pairs: int) -> np.ndarray:
+    """Run the pair join across the mesh; → int8[n_pairs] report bits in
+    the caller's original pair order. `st` arrays and `ver_tok` may be
+    host numpy or already-uploaded device arrays."""
+    bits = np.asarray(_sharded_pair_join(
+        mesh, jnp.asarray(st.lo_tok), jnp.asarray(st.hi_tok),
+        jnp.asarray(st.flags), jnp.asarray(ver_tok),
+        jax.device_put(part.pair_row), jax.device_put(part.pair_ver),
+        jax.device_put(part.valid)))
+    out = np.zeros(n_pairs, np.int8)
+    v = part.valid
+    out[part.perm[v]] = bits[v]
+    return out
 
-    pkg_hash [B, 2] / pkg_tok [B, K] / pkg_valid [B] with B divisible by
-    the dp axis size. Returns (hash_match, satisfied, global_row_idx),
-    each [n_db_shards, B, W] on host.
-    """
-    hm, sat, idx = _sharded_join(
-        mesh, st.window,
-        st.hash, st.lo_tok, st.hi_tok, st.flags, st.row_offset,
-        pkg_hash, pkg_tok, pkg_valid)
-    return np.asarray(hm), np.asarray(sat), np.asarray(idx)
+
+class MeshDetector:
+    """BatchDetector whose device step runs sharded over a mesh — the
+    server-side scale-out path (SURVEY.md §2.7 P4)."""
+
+    def __init__(self, table: AdvisoryTable, mesh: Mesh,
+                 db_shards: int | None = None):
+        from ..detect.engine import BatchDetector
+        self.mesh = mesh
+        self.dp = mesh.devices.shape[0]
+        db = db_shards if db_shards is not None else mesh.devices.shape[1]
+        self.st = shard_table(table, db)
+        # upload the sharded table once; every detect() reuses the
+        # device copies (table.device_arrays() analog for the mesh path)
+        self._st_dev = ShardedTable(
+            lo_tok=jax.device_put(self.st.lo_tok),
+            hi_tok=jax.device_put(self.st.hi_tok),
+            flags=jax.device_put(self.st.flags),
+            row_offset=self.st.row_offset, row_len=self.st.row_len)
+        self._inner = BatchDetector(table)
+
+    def detect(self, queries) -> list:
+        inner = self._inner
+        if len(inner.table) == 0 or not queries:
+            return []
+        prep = inner._prepare(queries)
+        if prep is None or prep.n_pairs == 0:
+            return []
+        part = partition_pairs(self.st, prep.pair_row, prep.pair_ver,
+                               prep.n_pairs, self.dp)
+        bits = sharded_pair_join(self.mesh, self._st_dev,
+                                 inner.ver_snapshot(prep.u_pad), part,
+                                 prep.n_pairs)
+        return inner._assemble(prep, bits)

@@ -21,6 +21,11 @@ from ..ops import ac
 from .rules import BUILTIN_RULES, GLOBAL_ALLOW_RULES, Rule
 
 CHUNK_LEN = 16384
+# Max chunk rows per prefix_scan call. Large on purpose: the dominant
+# cost of a device call is per-call (tunnel/dispatch) latency, so rows
+# are batched up to 4096 (64 MiB of chunk bytes) and padded to a power
+# of two so each bucket shape compiles exactly once.
+DEVICE_ROWS = 4096
 
 
 class SecretScanner:
@@ -47,7 +52,7 @@ class SecretScanner:
                     self._keywords.append(k)
                     self._kw_rules.append([])
                 self._kw_rules[kw_index[k]].append(ri)
-        self._automaton = ac.build_automaton(self._keywords) \
+        self._bank = ac.build_literal_bank(self._keywords) \
             if self._keywords else None
         self._device_arrays = None
 
@@ -55,7 +60,7 @@ class SecretScanner:
 
     def _keyword_masks(self, files: list[bytes]) -> list[set[int]]:
         """→ per-file set of rule indices whose keywords appear."""
-        if self._automaton is None:
+        if self._bank is None:
             return [set() for _ in files]
         if self.use_device:
             try:
@@ -76,27 +81,56 @@ class SecretScanner:
         return out
 
     def _keyword_masks_device(self, files: list[bytes]) -> list[set[int]]:
-        import jax.numpy as jnp
-        auto = self._automaton
-        overlap = auto.max_kw_len - 1
+        import jax
+        bank = self._bank
+        overlap = bank.max_kw_len - 1
         chunks, owner = ac.pack_chunks(files, CHUNK_LEN, overlap)
         out: list[set[int]] = [set() for _ in files]
         if chunks.shape[0] == 0:
             return out
         if self._device_arrays is None:
-            import jax
-            self._device_arrays = (jax.device_put(auto.trans),
-                                   jax.device_put(auto.out_bits))
-        trans, out_bits = self._device_arrays
-        masks = np.asarray(ac.ac_scan(trans, out_bits, jnp.asarray(chunks)))
-        for row, fi in zip(masks, owner):
+            self._device_arrays = (jax.device_put(bank.kw_word4),
+                                   jax.device_put(bank.kw_mask4))
+        kw_word4, kw_mask4 = self._device_arrays
+        # bounded rows per device call (O(B·L) working set), padded to a
+        # power of two so each bucket shape compiles once; calls pipeline
+        from ..ops import next_pow2
+        futures = []
+        for off in range(0, chunks.shape[0], DEVICE_ROWS):
+            piece = chunks[off:off + DEVICE_ROWS]
+            b = next_pow2(piece.shape[0], floor=64)
+            if piece.shape[0] < b:
+                pad = np.zeros((b, piece.shape[1]), np.uint8)
+                pad[:piece.shape[0]] = piece
+                piece = pad
+            # device_put, not jnp.asarray — the latter is an order of
+            # magnitude slower for large host arrays on remote backends
+            futures.append(ac.prefix_scan(
+                kw_word4, kw_mask4, jax.device_put(piece),
+                n_words=bank.words))
+        masks = np.concatenate([np.asarray(f) for f in futures],
+                               axis=0)[:chunks.shape[0]]
+        # confirm the (rare) device candidates exactly: the device tests
+        # only the packed 4-byte keyword prefix, so confirm the full
+        # keyword in the chunk's (lowercased, overlap-including) bytes
+        # before gating any rule — parity with bytes.Contains
+        confirmed: dict[tuple[int, int], bool] = {}
+        for ci, (row, fi) in enumerate(zip(masks, owner)):
+            row_bytes = None
             for w, word in enumerate(row):
                 word = int(word) & 0xFFFFFFFF
                 while word:
                     b = (word & -word).bit_length() - 1
                     ki = w * 32 + b
-                    out[fi].update(self._kw_rules[ki])
                     word &= word - 1
+                    ck = (int(fi), ki)
+                    if confirmed.get(ck):
+                        continue
+                    if row_bytes is None:
+                        row_bytes = chunks[ci].tobytes()
+                    if bank.kw_bytes[ki] in row_bytes:
+                        confirmed[ck] = True
+                        out[fi].update(self._kw_rules[ki])
         return out
 
     # --- host confirmation (exact reference semantics) ---
