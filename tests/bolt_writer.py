@@ -1,0 +1,150 @@
+"""Minimal bbolt file writer — TEST FIXTURE ONLY.
+
+Produces real bolt page layouts (meta pair, freelist, branch/leaf pages,
+overflow chains, inline buckets) so the read-only parser in
+trivy_tpu.db.boltdb is exercised against the genuine format, the same
+role bolt-fixtures plays for the reference (pkg/dbtest/db.go). Not a
+general-purpose writer: no freelist accounting, no rebalancing."""
+
+from __future__ import annotations
+
+import struct
+
+from trivy_tpu.db.boltdb import (BRANCH_ELEM, BUCKET_HDR, LEAF_ELEM, MAGIC,
+                                 META, PAGE_HDR, VERSION, _fnv64)
+
+FLAG_BRANCH = 0x01
+FLAG_LEAF = 0x02
+FLAG_META = 0x04
+FLAG_FREELIST = 0x10
+LEAF_BUCKET = 0x01
+
+
+class _Writer:
+    def __init__(self, page_size: int, leaf_cap: int):
+        self.page_size = page_size
+        self.leaf_cap = leaf_cap  # max entries per leaf (forces branches)
+        self.pages: dict[int, bytes] = {}
+        self.next_pgid = 3  # 0,1 meta; 2 freelist
+
+    def alloc(self, n: int) -> int:
+        pgid = self.next_pgid
+        self.next_pgid += n
+        return pgid
+
+    def _pad(self, img: bytes, n_pages: int) -> bytes:
+        return img + b"\0" * (n_pages * self.page_size - len(img))
+
+    def put_leaf(self, entries) -> int:
+        """entries: [(key, value, flags)] sorted by key → pgid."""
+        n = len(entries)
+        body = bytearray()
+        elems = bytearray()
+        data_off = 16 + n * LEAF_ELEM.size
+        cur = data_off
+        for i, (k, v, fl) in enumerate(entries):
+            pos = cur - (16 + i * LEAF_ELEM.size)
+            elems += LEAF_ELEM.pack(fl, pos, len(k), len(v))
+            body += k + v
+            cur += len(k) + len(v)
+        total = data_off + len(body)
+        n_pages = (total + self.page_size - 1) // self.page_size
+        pgid = self.alloc(n_pages)
+        img = PAGE_HDR.pack(pgid, FLAG_LEAF, n, n_pages - 1) + \
+            bytes(elems) + bytes(body)
+        self.pages[pgid] = self._pad(img, n_pages)
+        return pgid
+
+    def put_branch(self, children) -> int:
+        """children: [(first_key, child_pgid)] → pgid."""
+        n = len(children)
+        elems = bytearray()
+        body = bytearray()
+        data_off = 16 + n * BRANCH_ELEM.size
+        cur = data_off
+        for i, (k, child) in enumerate(children):
+            pos = cur - (16 + i * BRANCH_ELEM.size)
+            elems += BRANCH_ELEM.pack(pos, len(k), child)
+            body += k
+            cur += len(k)
+        total = data_off + len(body)
+        n_pages = (total + self.page_size - 1) // self.page_size
+        pgid = self.alloc(n_pages)
+        img = PAGE_HDR.pack(pgid, FLAG_BRANCH, n, n_pages - 1) + \
+            bytes(elems) + bytes(body)
+        self.pages[pgid] = self._pad(img, n_pages)
+        return pgid
+
+    def build_bucket(self, tree: dict, inline_threshold: int = 0) -> bytes:
+        """→ the bucket's leaf VALUE (16-byte header [+ inline page])."""
+        entries = []
+        for key in sorted(tree):
+            val = tree[key]
+            k = key.encode() if isinstance(key, str) else key
+            if isinstance(val, dict):
+                entries.append((k, self.build_bucket(val, inline_threshold),
+                                LEAF_BUCKET))
+            else:
+                v = val.encode() if isinstance(val, str) else val
+                entries.append((k, v, 0))
+        payload = sum(len(k) + len(v) for k, v, _ in entries) + \
+            len(entries) * LEAF_ELEM.size + 16
+        if inline_threshold and payload <= inline_threshold and \
+                all(fl == 0 for _, _, fl in entries):
+            # inline bucket: header with root=0 + private page image
+            n = len(entries)
+            elems = bytearray()
+            body = bytearray()
+            cur = 16 + n * LEAF_ELEM.size
+            for i, (k, v, fl) in enumerate(entries):
+                pos = cur - (16 + i * LEAF_ELEM.size)
+                elems += LEAF_ELEM.pack(fl, pos, len(k), len(v))
+                body += k + v
+                cur += len(k) + len(v)
+            page_img = PAGE_HDR.pack(0, FLAG_LEAF, n, 0) + \
+                bytes(elems) + bytes(body)
+            return BUCKET_HDR.pack(0, 0) + page_img
+        # split into leaves of ≤ leaf_cap entries, branch if > 1 leaf
+        leaves = [entries[i:i + self.leaf_cap]
+                  for i in range(0, max(len(entries), 1), self.leaf_cap)]
+        pgids = [self.put_leaf(chunk) for chunk in leaves]
+        if len(pgids) == 1:
+            root = pgids[0]
+        else:
+            root = self.put_branch(
+                [(chunk[0][0], pgid)
+                 for chunk, pgid in zip(leaves, pgids)])
+        return BUCKET_HDR.pack(root, 0)
+
+
+def write_bolt(path: str, tree: dict, page_size: int = 4096,
+               leaf_cap: int = 64, inline_threshold: int = 0) -> str:
+    """tree: {name: subdict | bytes | str} nested buckets/values."""
+    w = _Writer(page_size, leaf_cap)
+    root_val = w.build_bucket(tree, inline_threshold)
+    root_pgid, _ = BUCKET_HDR.unpack_from(root_val, 0)
+    if root_pgid == 0:
+        # root may not be inline: force a real page
+        w2 = _Writer(page_size, leaf_cap)
+        root_val = w2.build_bucket(tree, 0)
+        root_pgid, _ = BUCKET_HDR.unpack_from(root_val, 0)
+        w = w2
+
+    freelist = PAGE_HDR.pack(2, FLAG_FREELIST, 0, 0)
+    n_pages = w.next_pgid
+    buf = bytearray(n_pages * page_size)
+
+    for pgid in (0, 1):
+        meta = struct.pack("<IIII", MAGIC, VERSION, page_size, 0)
+        meta += struct.pack("<QQ", root_pgid, 0)      # root bucket
+        meta += struct.pack("<QQQ", 2, n_pages, pgid)  # freelist, pgid, txid
+        checksum = _fnv64(meta)
+        hdr = PAGE_HDR.pack(pgid, FLAG_META, 0, 0)
+        img = hdr + meta + struct.pack("<Q", checksum)
+        buf[pgid * page_size:pgid * page_size + len(img)] = img
+    buf[2 * page_size:2 * page_size + len(freelist)] = freelist
+    for pgid, img in w.pages.items():
+        buf[pgid * page_size:pgid * page_size + len(img)] = img
+    with open(path, "wb") as f:
+        f.write(bytes(buf))
+    return path

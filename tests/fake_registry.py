@@ -1,0 +1,183 @@
+"""In-process OCI distribution registry for tests (the counterpart of
+the reference's registry testcontainer, integration/registry_test.go).
+
+Serves /v2 manifests and blobs from an in-memory store, with optional
+Bearer-token auth (401 challenge → /token → token check)."""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import tarfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from trivy_tpu.oci import MT_OCI_MANIFEST
+
+
+class FakeRegistry:
+    def __init__(self, require_token: bool = False,
+                 username: str = "", password: str = ""):
+        self.blobs: dict[str, bytes] = {}
+        # (repo, reference) → (media_type, manifest bytes)
+        self.manifests: dict[tuple[str, str], tuple[str, bytes]] = {}
+        self.require_token = require_token
+        self.username = username
+        self.password = password
+        self.token = "fake-token-123"
+        self.requests: list[str] = []
+        self._srv = None
+        self._thread = None
+        self.port = 0
+
+    # ---- store builders -------------------------------------------------
+
+    def put_blob(self, data: bytes) -> str:
+        digest = "sha256:" + hashlib.sha256(data).hexdigest()
+        self.blobs[digest] = data
+        return digest
+
+    def put_manifest(self, repo: str, reference: str, manifest: dict,
+                     media_type: str = MT_OCI_MANIFEST) -> str:
+        raw = json.dumps(manifest).encode()
+        digest = "sha256:" + hashlib.sha256(raw).hexdigest()
+        self.manifests[(repo, reference)] = (media_type, raw)
+        self.manifests[(repo, digest)] = (media_type, raw)
+        return digest
+
+    def put_artifact(self, repo: str, tag: str, layers: list,
+                     config: bytes = b"{}") -> str:
+        """layers: [(media_type, bytes)] → manifest digest."""
+        cfg_digest = self.put_blob(config)
+        entries = []
+        for mt, data in layers:
+            d = self.put_blob(data)
+            entries.append({"mediaType": mt, "digest": d,
+                            "size": len(data)})
+        manifest = {
+            "schemaVersion": 2,
+            "mediaType": MT_OCI_MANIFEST,
+            "config": {"mediaType": "application/vnd.oci.image.config.v1+json",
+                       "digest": cfg_digest, "size": len(config)},
+            "layers": entries,
+        }
+        return self.put_manifest(repo, tag, manifest)
+
+    def put_image(self, repo: str, tag: str,
+                  layer_tars: list[bytes], config: dict) -> str:
+        """A runnable container image: gzipped layer tars + config."""
+        cfg_raw = json.dumps(config).encode()
+        cfg_digest = self.put_blob(cfg_raw)
+        entries = []
+        for data in layer_tars:
+            gz = gzip.compress(data)
+            d = self.put_blob(gz)
+            entries.append({
+                "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                "digest": d, "size": len(gz)})
+        manifest = {
+            "schemaVersion": 2,
+            "mediaType": MT_OCI_MANIFEST,
+            "config": {"mediaType": "application/vnd.oci.image.config.v1+json",
+                       "digest": cfg_digest, "size": len(cfg_raw)},
+            "layers": entries,
+        }
+        return self.put_manifest(repo, tag, manifest)
+
+    # ---- server ---------------------------------------------------------
+
+    def start(self) -> str:
+        reg = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _authorized(self) -> bool:
+                if not reg.require_token:
+                    return True
+                auth = self.headers.get("Authorization", "")
+                return auth == f"Bearer {reg.token}"
+
+            def do_GET(self):
+                reg.requests.append(self.path)
+                if self.path.startswith("/token"):
+                    body = json.dumps({"token": reg.token}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if not self._authorized():
+                    self.send_response(401)
+                    self.send_header(
+                        "WWW-Authenticate",
+                        f'Bearer realm="http://127.0.0.1:{reg.port}/token",'
+                        f'service="fake",scope="repository:x:pull"')
+                    self.end_headers()
+                    return
+                parts = self.path.split("/")
+                if "/manifests/" in self.path:
+                    i = parts.index("manifests")
+                    repo = "/".join(parts[2:i])
+                    ref = parts[i + 1]
+                    entry = reg.manifests.get((repo, ref))
+                    if entry is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    mt, raw = entry
+                    self.send_response(200)
+                    self.send_header("Content-Type", mt)
+                    self.end_headers()
+                    self.wfile.write(raw)
+                    return
+                if "/blobs/" in self.path:
+                    digest = parts[-1]
+                    data = reg.blobs.get(digest)
+                    if data is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+
+def tar_gz_of(members: dict[str, bytes]) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name, data in members.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def tar_of(files: dict[str, bytes]) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return buf.getvalue()

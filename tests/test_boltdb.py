@@ -1,0 +1,136 @@
+"""BoltDB read-only parser tests: the parser must walk genuine bolt page
+layouts (meta selection, branch fan-out, overflow chains, inline
+buckets) and feed the same flatten path as the YAML fixtures."""
+
+import json
+
+import pytest
+
+from bolt_writer import write_bolt
+from trivy_tpu.db.boltdb import BoltDB, BoltError, load_boltdb, to_docs
+
+
+def test_roundtrip_simple(tmp_path):
+    p = write_bolt(str(tmp_path / "t.db"), {
+        "alpha": {"k1": b"v1", "k2": b"v2"},
+        "beta": {"inner": {"deep": b"x"}},
+    })
+    docs = to_docs(p, decode_json=False)
+    assert [d["bucket"] for d in docs] == ["alpha", "beta"]
+    assert docs[0]["pairs"] == [{"key": "k1", "value": b"v1"},
+                                {"key": "k2", "value": b"v2"}]
+    assert docs[1]["pairs"][0]["bucket"] == "inner"
+    assert docs[1]["pairs"][0]["pairs"] == [{"key": "deep", "value": b"x"}]
+
+
+def test_branch_pages(tmp_path):
+    """>leaf_cap entries force a branch page above multiple leaves."""
+    tree = {"big": {f"key{i:04d}": f"val{i}".encode() for i in range(500)}}
+    p = write_bolt(str(tmp_path / "t.db"), tree, leaf_cap=32)
+    docs = to_docs(p, decode_json=False)
+    pairs = docs[0]["pairs"]
+    assert len(pairs) == 500
+    assert pairs[0] == {"key": "key0000", "value": b"val0"}
+    assert pairs[-1] == {"key": "key0499", "value": b"val499"}
+    # sorted order preserved
+    assert [x["key"] for x in pairs] == sorted(x["key"] for x in pairs)
+
+
+def test_overflow_value(tmp_path):
+    """A value larger than one page spills into overflow pages."""
+    big = bytes(range(256)) * 40  # 10240 bytes > 4096 page
+    p = write_bolt(str(tmp_path / "t.db"), {"b": {"huge": big}})
+    with BoltDB(p) as db:
+        (name, val), = list(db.buckets())
+        (key, value, is_b), = list(db.walk_bucket(val))
+    assert key == b"huge"
+    assert value == big
+    assert not is_b
+
+
+def test_inline_bucket(tmp_path):
+    p = write_bolt(str(tmp_path / "t.db"),
+                   {"outer": {"small": {"a": b"1", "b": b"2"}}},
+                   inline_threshold=512)
+    docs = to_docs(p, decode_json=False)
+    inner = docs[0]["pairs"][0]
+    assert inner["bucket"] == "small"
+    assert inner["pairs"] == [{"key": "a", "value": b"1"},
+                              {"key": "b", "value": b"2"}]
+
+
+def test_non_default_page_size(tmp_path):
+    p = write_bolt(str(tmp_path / "t.db"), {"b": {"k": b"v"}},
+                   page_size=8192)
+    with BoltDB(p) as db:
+        assert db.page_size == 8192
+        assert len(list(db.buckets())) == 1
+
+
+def test_invalid_file_rejected(tmp_path):
+    bad = tmp_path / "bad.db"
+    bad.write_bytes(b"\0" * 8192)
+    with pytest.raises(BoltError):
+        BoltDB(str(bad))
+
+
+def _advisory(**kw):
+    return json.dumps(kw).encode()
+
+
+def test_load_trivy_db_shape(tmp_path):
+    """A trivy-db-shaped bolt file flattens through the same path as the
+    YAML fixtures and detects CVEs end-to-end."""
+    from trivy_tpu.db import build_table
+    from trivy_tpu.detect.engine import BatchDetector, PkgQuery
+
+    tree = {
+        "alpine 3.17": {
+            "musl": {
+                "CVE-2025-26519": _advisory(FixedVersion="1.2.3-r9"),
+            },
+            "openssl": {
+                "CVE-2023-0286": _advisory(FixedVersion="3.0.8-r0",
+                                           Severity=4),
+            },
+        },
+        "pip::GitHub Security Advisory Pip": {
+            "flask": {
+                "CVE-2023-30861": _advisory(
+                    VulnerableVersions=["<2.2.5"],
+                    PatchedVersions=["2.2.5"]),
+            },
+        },
+        "vulnerability": {
+            "CVE-2023-0286": json.dumps(
+                {"Title": "X.400 confusion",
+                 "Severity": "HIGH"}).encode(),
+        },
+        "data-source": {
+            "alpine 3.17": json.dumps(
+                {"ID": "alpine", "Name": "Alpine Secdb",
+                 "URL": "https://secdb.alpinelinux.org/"}).encode(),
+        },
+    }
+    p = write_bolt(str(tmp_path / "trivy.db"), tree)
+    advisories, details, sources = load_boltdb(p)
+    assert {a.vuln_id for a in advisories} == \
+        {"CVE-2025-26519", "CVE-2023-0286", "CVE-2023-30861"}
+    assert details["CVE-2023-0286"]["Title"] == "X.400 confusion"
+    alp = next(a for a in advisories if a.vuln_id == "CVE-2023-0286")
+    assert alp.data_source["id"] == "alpine"
+    assert alp.severity == "CRITICAL"  # Severity=4 enum
+
+    table = build_table(advisories, details)
+    det = BatchDetector(table)
+    hits = det.detect([
+        PkgQuery(source="alpine 3.17", ecosystem="alpine",
+                 name="musl", version="1.2.3-r4"),
+        PkgQuery(source="pip::GitHub Security Advisory Pip",
+                 ecosystem="pip", name="flask", version="2.2.2"),
+        PkgQuery(source="pip::GitHub Security Advisory Pip",
+                 ecosystem="pip", name="flask", version="2.2.5"),
+    ])
+    got = {(h.query.name, h.query.version, h.vuln_id) for h in hits}
+    assert got == {("musl", "1.2.3-r4", "CVE-2025-26519"),
+                   ("flask", "2.2.2", "CVE-2023-30861")}

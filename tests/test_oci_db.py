@@ -1,0 +1,166 @@
+"""OCI distribution client + trivy-db download/flatten lifecycle,
+against the in-process fake registry (reference integration pattern:
+registry testcontainer + pkg/db/db_test.go)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from bolt_writer import write_bolt
+from fake_registry import FakeRegistry, tar_gz_of
+from trivy_tpu.db.download import (DBError, SCHEMA_VERSION, download_db,
+                                   db_path, ensure_db, flatten_db,
+                                   needs_update, read_metadata)
+from trivy_tpu.oci import (MT_TRIVY_DB, OCIError, RegistryClient,
+                           parse_ref)
+
+
+class TestParseRef:
+    def test_full(self):
+        r = parse_ref("ghcr.io/aquasecurity/trivy-db:2")
+        assert (r.host, r.repository, r.tag) == \
+            ("ghcr.io", "aquasecurity/trivy-db", "2")
+        assert r.scheme == "https"
+
+    def test_http_endpoint_override(self):
+        r = parse_ref("http://127.0.0.1:5000/my/db:latest")
+        assert r.scheme == "http"
+        assert r.host == "127.0.0.1:5000"
+        assert r.repository == "my/db"
+
+    def test_digest(self):
+        r = parse_ref("reg.io/a/b@sha256:" + "ab" * 32)
+        assert r.digest.startswith("sha256:")
+        assert r.reference == r.digest
+
+    def test_dockerhub_library(self):
+        r = parse_ref("alpine:3.17")
+        assert r.host == "registry-1.docker.io"
+        assert r.repository == "library/alpine"
+        assert r.tag == "3.17"
+
+    def test_port_is_not_tag(self):
+        r = parse_ref("localhost:5000/img")
+        assert r.host == "localhost:5000"
+        assert (r.repository, r.tag) == ("img", "latest")
+
+
+def _db_tree():
+    return {
+        "alpine 3.17": {
+            "musl": {"CVE-2025-26519": json.dumps(
+                {"FixedVersion": "1.2.3-r9"}).encode()},
+        },
+        "vulnerability": {
+            "CVE-2025-26519": json.dumps({"Severity": "HIGH"}).encode(),
+        },
+    }
+
+
+def _serve_db(tmp_path, require_token=False) -> tuple[FakeRegistry, str]:
+    bolt = write_bolt(str(tmp_path / "src.db"), _db_tree())
+    meta = json.dumps({"Version": SCHEMA_VERSION,
+                       "NextUpdate": "2999-01-01T00:00:00Z",
+                       "UpdatedAt": "2026-01-01T00:00:00Z"}).encode()
+    layer = tar_gz_of({"trivy.db": open(bolt, "rb").read(),
+                       "metadata.json": meta})
+    reg = FakeRegistry(require_token=require_token)
+    base = reg.start()
+    reg.put_artifact("aquasecurity/trivy-db", "2", [(MT_TRIVY_DB, layer)])
+    return reg, f"{base}/aquasecurity/trivy-db:2"
+
+
+def test_download_and_flatten(tmp_path):
+    reg, repo = _serve_db(tmp_path)
+    try:
+        cache = str(tmp_path / "cache")
+        p = download_db(cache, repository=repo)
+        assert os.path.exists(p)
+        meta = read_metadata(cache)
+        assert meta["Version"] == SCHEMA_VERSION
+        table, stats = flatten_db(p)
+        assert stats["rows"] == 1
+        assert not stats["cached"]
+        # flatten memoized on second call
+        _, stats2 = flatten_db(p)
+        assert stats2["cached"]
+    finally:
+        reg.stop()
+
+
+def test_token_auth_flow(tmp_path):
+    reg, repo = _serve_db(tmp_path, require_token=True)
+    try:
+        cache = str(tmp_path / "cache")
+        download_db(cache, repository=repo)
+        assert any("/token" in r for r in reg.requests)
+    finally:
+        reg.stop()
+
+
+def test_ensure_db_end_to_end(tmp_path):
+    """download → flatten → detect, and no re-download within NextUpdate."""
+    from trivy_tpu.detect.engine import BatchDetector, PkgQuery
+    reg, repo = _serve_db(tmp_path)
+    try:
+        cache = str(tmp_path / "cache")
+        table, stats = ensure_db(cache, repository=repo)
+        det = BatchDetector(table)
+        hits = det.detect([PkgQuery(source="alpine 3.17",
+                                    ecosystem="alpine", name="musl",
+                                    version="1.2.3-r4")])
+        assert [h.vuln_id for h in hits] == ["CVE-2025-26519"]
+        n_requests = len(reg.requests)
+        ensure_db(cache, repository=repo)  # fresh → no new requests
+        assert len(reg.requests) == n_requests
+    finally:
+        reg.stop()
+
+
+def test_needs_update_gates(tmp_path):
+    cache = str(tmp_path / "cache")
+    assert needs_update(cache)  # never downloaded
+    with pytest.raises(DBError):
+        needs_update(cache, skip=True)
+    reg, repo = _serve_db(tmp_path)
+    try:
+        download_db(cache, repository=repo)
+    finally:
+        reg.stop()
+    assert not needs_update(cache)          # NextUpdate in 2999
+    assert not needs_update(cache, skip=True)
+    # schema mismatch forces update
+    mp = os.path.join(cache, "db", "metadata.json")
+    with open(mp, "w") as f:
+        json.dump({"Version": 1}, f)
+    assert needs_update(cache)
+    with pytest.raises(DBError):
+        needs_update(cache, skip=True)
+
+
+def test_missing_layer_media_type(tmp_path):
+    reg = FakeRegistry()
+    base = reg.start()
+    try:
+        reg.put_artifact("x/y", "1", [("application/wrong", b"data")])
+        client = RegistryClient()
+        with pytest.raises(OCIError):
+            client.download_artifact_layer(
+                parse_ref(f"{base}/x/y:1"), MT_TRIVY_DB)
+    finally:
+        reg.stop()
+
+
+def test_blob_digest_verified(tmp_path):
+    reg = FakeRegistry()
+    base = reg.start()
+    try:
+        digest = reg.put_blob(b"good")
+        reg.blobs[digest] = b"evil"  # corrupt after hashing
+        client = RegistryClient()
+        with pytest.raises(OCIError, match="digest mismatch"):
+            client.blob(parse_ref(f"{base}/a/b:1"), digest)
+    finally:
+        reg.stop()

@@ -1,0 +1,126 @@
+"""Registry image source: pull by name from an (in-process) OCI
+registry and scan — the reference's remote source
+(pkg/fanal/image/remote.go, integration/registry_test.go)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from fake_registry import FakeRegistry, tar_of
+from helpers import ALPINE_OS_RELEASE, APK_INSTALLED
+from trivy_tpu.oci import RegistryClient, parse_ref
+
+FIXTURE_DB = "tests/fixtures/db/*.yaml"
+
+
+def _serve_alpine(require_token=False, username="", password=""):
+    layer = tar_of({
+        "etc/os-release": ALPINE_OS_RELEASE,
+        "lib/apk/db/installed": APK_INSTALLED,
+    })
+    config = {
+        "architecture": "amd64", "os": "linux",
+        "rootfs": {"type": "layers", "diff_ids": ["sha256:" + "0" * 64]},
+        "history": [{"created_by": "ADD rootfs"}],
+    }
+    reg = FakeRegistry(require_token=require_token, username=username,
+                       password=password)
+    base = reg.start()
+    reg.put_image("library/alpine", "3.17", [layer], config)
+    return reg, base
+
+
+def test_pull_to_oci_tar_and_inspect(tmp_path):
+    from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+    from trivy_tpu.fanal.cache import MemoryCache
+    reg, base = _serve_alpine()
+    try:
+        dest = str(tmp_path / "img.tar")
+        client = RegistryClient()
+        man = client.pull_to_oci_tar(
+            parse_ref(f"{base}/library/alpine:3.17"), dest)
+        assert man["layers"]
+        art = ImageArchiveArtifact(dest, MemoryCache())
+        ref = art.inspect()
+        blob = art.cache.get_blob(ref.blob_ids[0])
+        assert blob.os.family == "alpine"
+        names = {p.name for pi in blob.package_infos for p in pi.packages}
+        assert "musl" in names
+    finally:
+        reg.stop()
+
+
+def test_pull_with_token_auth(tmp_path):
+    reg, base = _serve_alpine(require_token=True)
+    try:
+        dest = str(tmp_path / "img.tar")
+        RegistryClient().pull_to_oci_tar(
+            parse_ref(f"{base}/library/alpine:3.17"), dest)
+        assert any("/token" in r for r in reg.requests)
+    finally:
+        reg.stop()
+
+
+def test_cli_image_by_name_e2e(tmp_path):
+    """`image http://host:port/repo:tag` end to end through the CLI."""
+    reg, base = _serve_alpine()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "trivy_tpu.cli", "image",
+             f"{base}/library/alpine:3.17",
+             "--db", FIXTURE_DB, "--cache-dir", str(tmp_path / "cache"),
+             "--format", "json"],
+            capture_output=True, text=True, timeout=300,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": "."},
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        rep = json.loads(out.stdout)
+        vulns = {v["VulnerabilityID"] for res in rep.get("Results", [])
+                 for v in res.get("Vulnerabilities", [])}
+        assert "CVE-2025-26519" in vulns  # musl 1.2.3-r4 fixture hit
+        assert rep["ArtifactName"].endswith("library/alpine:3.17")
+    finally:
+        reg.stop()
+
+
+def test_index_platform_selection(tmp_path):
+    """A manifest index resolves to the requested platform's manifest."""
+    from trivy_tpu.oci import MT_OCI_INDEX
+    reg, base = _serve_alpine()
+    try:
+        amd = reg.manifests[("library/alpine", "3.17")]
+        # digest of platform manifest
+        import hashlib
+        digest = "sha256:" + hashlib.sha256(amd[1]).hexdigest()
+        index = {
+            "schemaVersion": 2,
+            "mediaType": MT_OCI_INDEX,
+            "manifests": [
+                {"mediaType": amd[0], "digest": "sha256:" + "1" * 64,
+                 "platform": {"os": "linux", "architecture": "arm64"}},
+                {"mediaType": amd[0], "digest": digest,
+                 "platform": {"os": "linux", "architecture": "amd64"}},
+            ],
+        }
+        reg.put_manifest("library/alpine", "multi", index,
+                         media_type=MT_OCI_INDEX)
+        man = RegistryClient().manifest(
+            parse_ref(f"{base}/library/alpine:multi"), "linux/amd64")
+        assert man.get("layers"), "resolved to a real manifest"
+    finally:
+        reg.stop()
+
+
+def test_pull_nonexistent_fails(tmp_path):
+    from trivy_tpu.oci import OCIError
+    reg, base = _serve_alpine()
+    try:
+        with pytest.raises(OCIError):
+            RegistryClient().pull_to_oci_tar(
+                parse_ref(f"{base}/library/nope:1"),
+                str(tmp_path / "x.tar"))
+    finally:
+        reg.stop()

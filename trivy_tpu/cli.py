@@ -44,7 +44,15 @@ def _add_scan_flags(p: argparse.ArgumentParser):
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "trivy-tpu"))
     p.add_argument("--db", default="",
-                   help="columnar advisory DB (.npz) or fixture YAML glob")
+                   help="advisory DB: columnar .npz, a trivy.db (BoltDB) "
+                        "file, or fixture YAML glob; when omitted the DB "
+                        "is downloaded from --db-repository into the "
+                        "cache and flattened once")
+    p.add_argument("--db-repository",
+                   default="ghcr.io/aquasecurity/trivy-db:2",
+                   help="OCI repository for the vulnerability DB")
+    p.add_argument("--skip-db-update", action="store_true",
+                   help="use the cached DB without checking freshness")
     p.add_argument("--pkg-types", default="os,library")
     p.add_argument("--compliance", default="",
                    help="compliance spec id (k8s-cis, k8s-nsa, "
@@ -83,6 +91,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sbom-sources", default="",
                    help="comma-separated external SBOM sources (rekor)")
     p.add_argument("--rekor-url", default="https://rekor.sigstore.dev")
+    p.add_argument("--platform", default="",
+                   help="os/arch for registry pulls (default linux/amd64)")
     _add_scan_flags(p)
 
     for name, aliases in (("filesystem", ["fs"]), ("rootfs", [])):
@@ -111,6 +121,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("server", help="run the scan server")
     p.add_argument("--listen", default="0.0.0.0:4954")
     p.add_argument("--db", default="")
+    p.add_argument("--db-repository",
+                   default="ghcr.io/aquasecurity/trivy-db:2")
+    p.add_argument("--skip-db-update", action="store_true")
     p.add_argument("--cache-dir",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
                                         "trivy-tpu"))
@@ -171,13 +184,28 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
-def load_table(spec: str) -> AdvisoryTable:
+def load_table(spec: str, cache_dir: str = "",
+               repository: str = "", skip_update: bool = False
+               ) -> AdvisoryTable:
     if not spec:
-        raise SystemExit(
-            "--db required (fixture YAML glob or columnar .npz); "
-            "the OCI download path needs egress")
+        from .db.download import DBError, ensure_db
+        try:
+            table, _stats = ensure_db(
+                cache_dir or ".",
+                repository or "ghcr.io/aquasecurity/trivy-db:2",
+                skip_update=skip_update)
+            return table
+        except DBError as e:
+            raise SystemExit(
+                f"DB unavailable: {e}\n"
+                "(pass --db with a trivy.db file, columnar .npz, or "
+                "fixture YAML glob when the registry is unreachable)") \
+                from None
     if spec.endswith(".npz"):
         return AdvisoryTable.load(spec)
+    if spec.endswith(".db"):
+        from .db.download import flatten_db
+        return flatten_db(spec)[0]
     paths = sorted(glob.glob(spec)) or [spec]
     advisories, details, sources = load_fixture_files(paths)
     return build_table(advisories, details,
@@ -185,8 +213,14 @@ def load_table(spec: str) -> AdvisoryTable:
                        if "Red Hat CPE" in sources else None)
 
 
+def _load_table_args(args) -> AdvisoryTable:
+    return load_table(args.db, cache_dir=args.cache_dir,
+                      repository=getattr(args, "db_repository", ""),
+                      skip_update=getattr(args, "skip_db_update", False))
+
+
 def _scan_common(args, ref, cache, artifact_type: str) -> int:
-    table = load_table(args.db)
+    table = _load_table_args(args)
     scanner = LocalScanner(cache, table)
     scanners = tuple(s.strip() for s in args.scanners.split(",") if s.strip())
     opts = T.ScanOptions(
@@ -287,38 +321,62 @@ def cmd_image(args) -> int:
     from .fanal.artifact import ImageArchiveArtifact
     _configure_misconf(args)
     _configure_javadb(args)
-    if not args.input:
-        raise SystemExit("--input <archive> required (daemon/registry "
-                         "sources need docker/network access)")
-    cache = _open_cache(args)
-    scanners = tuple(s.strip() for s in args.scanners.split(","))
-    art = ImageArchiveArtifact(args.input, cache, scanners=scanners)
-    ref = None
-    if "rekor" in getattr(args, "sbom_sources", ""):
-        # remote-SBOM shortcut: a published SBOM attestation replaces
-        # local analysis (reference remote_sbom.go:92)
+    input_path = args.input
+    tmp = None
+    if not input_path:
+        if not args.image_name:
+            raise SystemExit("image name or --input <archive> required")
+        # registry pull (reference pkg/fanal/image/remote.go; daemon
+        # sources would precede this in the source fallback chain,
+        # image.go:42-56, but need a docker socket)
+        import tempfile
         from .log import logger
-        from .rekor import RekorError, fetch_sbom_statement
-        from .sbom.io import decode_sbom_doc
+        from .oci import OCIError, default_client, parse_ref
+        tmp = tempfile.NamedTemporaryFile(suffix=".tar", delete=False)
+        tmp.close()
         try:
-            st = fetch_sbom_statement(args.rekor_url,
-                                      art.image_digest())
-            if st is not None:
-                sbom_doc = st.sbom_document()
-                if isinstance(sbom_doc, dict):
-                    ref = decode_sbom_doc(sbom_doc, cache,
-                                          name=args.input)
-        except (RekorError, ValueError) as e:
-            logger.warning("rekor SBOM lookup failed, falling back "
-                           "to analysis: %s", e)
-    if ref is None:
-        ref = art.inspect()
-        artifact_type = T.ArtifactType.CONTAINER_IMAGE
-    else:
-        artifact_type = ref.type
-    if args.image_name:
-        ref.name = args.image_name
-    return _scan_common(args, ref, cache, artifact_type)
+            client = default_client()
+            client.pull_to_oci_tar(parse_ref(args.image_name), tmp.name,
+                                   platform=getattr(args, "platform", "")
+                                   or "linux/amd64")
+        except OCIError as e:
+            os.unlink(tmp.name)
+            raise SystemExit(f"registry pull failed: {e}") from None
+        logger.info("pulled %s from registry", args.image_name)
+        input_path = tmp.name
+    try:
+        cache = _open_cache(args)
+        scanners = tuple(s.strip() for s in args.scanners.split(","))
+        art = ImageArchiveArtifact(input_path, cache, scanners=scanners)
+        ref = None
+        if "rekor" in getattr(args, "sbom_sources", ""):
+            # remote-SBOM shortcut: a published SBOM attestation replaces
+            # local analysis (reference remote_sbom.go:92)
+            from .log import logger
+            from .rekor import RekorError, fetch_sbom_statement
+            from .sbom.io import decode_sbom_doc
+            try:
+                st = fetch_sbom_statement(args.rekor_url,
+                                          art.image_digest())
+                if st is not None:
+                    sbom_doc = st.sbom_document()
+                    if isinstance(sbom_doc, dict):
+                        ref = decode_sbom_doc(sbom_doc, cache,
+                                              name=args.input)
+            except (RekorError, ValueError) as e:
+                logger.warning("rekor SBOM lookup failed, falling back "
+                               "to analysis: %s", e)
+        if ref is None:
+            ref = art.inspect()
+            artifact_type = T.ArtifactType.CONTAINER_IMAGE
+        else:
+            artifact_type = ref.type
+        if args.image_name:
+            ref.name = args.image_name
+        return _scan_common(args, ref, cache, artifact_type)
+    finally:
+        if tmp is not None:
+            os.unlink(tmp.name)
 
 
 def cmd_fs(args) -> int:
@@ -358,7 +416,7 @@ def cmd_convert(args) -> int:
 
 def cmd_server(args) -> int:
     from .server.listen import serve
-    table = load_table(args.db)
+    table = _load_table_args(args)
     host, _, port = args.listen.rpartition(":")
     serve(host or "0.0.0.0", int(port), table, cache_dir=args.cache_dir,
           token=args.token,

@@ -1,0 +1,166 @@
+"""trivy-db lifecycle: OCI download, staleness gate, flatten cache.
+
+Reference pkg/db/db.go: `NeedsUpdate` (:96) gates on schema version,
+never-downloaded, and metadata NextUpdate (with a 1h debounce);
+`Download` (:153) pulls the OCI artifact (ghcr.io/aquasecurity/trivy-db,
+media type application/vnd.aquasec.trivy.db.layer.v1.tar+gzip via
+pkg/oci/artifact.go:103) and untars trivy.db + metadata.json into
+<cache>/db.
+
+Our addition is the flatten step the reference doesn't need (it mmaps
+BoltDB for random access; we run batched device joins): trivy.db →
+columnar AdvisoryTable, persisted as trivy.npz next to the bolt file and
+keyed by the bolt file's sha256, so each downloaded DB flattens exactly
+once (SURVEY.md §3.5 "TPU equivalent init").
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+DEFAULT_REPO = "ghcr.io/aquasecurity/trivy-db:2"
+SCHEMA_VERSION = 2
+
+
+class DBError(RuntimeError):
+    pass
+
+
+def db_dir(cache_dir: str) -> str:
+    return os.path.join(cache_dir, "db")
+
+
+def db_path(cache_dir: str) -> str:
+    return os.path.join(db_dir(cache_dir), "trivy.db")
+
+
+def metadata_path(cache_dir: str) -> str:
+    return os.path.join(db_dir(cache_dir), "metadata.json")
+
+
+def read_metadata(cache_dir: str) -> Optional[dict]:
+    try:
+        with open(metadata_path(cache_dir)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def needs_update(cache_dir: str, skip: bool = False,
+                 now: Optional[dt.datetime] = None) -> bool:
+    """Reference db.Client.NeedsUpdate(:96-150) gate."""
+    meta = read_metadata(cache_dir)
+    if skip:
+        if meta is None or not os.path.exists(db_path(cache_dir)):
+            raise DBError("--skip-db-update requested but no DB in cache")
+        if meta.get("Version") != SCHEMA_VERSION:
+            raise DBError(f"cached DB schema {meta.get('Version')} != "
+                          f"{SCHEMA_VERSION}; update required")
+        return False
+    if meta is None or not os.path.exists(db_path(cache_dir)):
+        return True
+    if meta.get("Version") != SCHEMA_VERSION:
+        return True
+    now = now or dt.datetime.now(dt.timezone.utc)
+    nxt = meta.get("NextUpdate")
+    if nxt:
+        try:
+            nxt_t = dt.datetime.fromisoformat(nxt.replace("Z", "+00:00"))
+            if now < nxt_t:
+                return False
+        except ValueError:
+            pass
+    # 1h debounce on the file itself (reference db.go:139-147)
+    try:
+        age = time.time() - os.path.getmtime(metadata_path(cache_dir))
+        if age < 3600:
+            return False
+    except OSError:
+        pass
+    return True
+
+
+def download_db(cache_dir: str, repository: str = DEFAULT_REPO,
+                client=None) -> str:
+    """Pull the trivy-db OCI artifact into <cache>/db → trivy.db path."""
+    from ..oci import (MT_TRIVY_DB, OCIError, default_client, parse_ref,
+                       untar_gz_members)
+    client = client or default_client()
+    ref = parse_ref(repository)
+    try:
+        blob = client.download_artifact_layer(ref, MT_TRIVY_DB)
+        members = untar_gz_members(blob)
+    except OCIError as e:
+        raise DBError(f"trivy-db download from {ref} failed: {e}") from None
+    if "trivy.db" not in members:
+        raise DBError(f"{ref}: layer does not contain trivy.db "
+                      f"(members: {sorted(members)})")
+    os.makedirs(db_dir(cache_dir), exist_ok=True)
+    with open(db_path(cache_dir), "wb") as f:
+        f.write(members["trivy.db"])
+    meta = members.get("metadata.json", b"{}")
+    with open(metadata_path(cache_dir), "wb") as f:
+        f.write(meta)
+    return db_path(cache_dir)
+
+
+def flatten_db(bolt_path: str, npz_path: Optional[str] = None,
+               verbose: bool = False):
+    """trivy.db → AdvisoryTable, memoized as an .npz keyed by the bolt
+    file's content hash. → (table, stats dict)."""
+    from .boltdb import load_boltdb
+    from .table import build_table
+
+    npz_path = npz_path or bolt_path + ".npz"
+    h = hashlib.sha256()
+    with open(bolt_path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    digest = h.hexdigest()
+    stamp_path = npz_path + ".src"
+    if os.path.exists(npz_path) and os.path.exists(stamp_path):
+        with open(stamp_path) as f:
+            if f.read().strip() == digest:
+                from .table import AdvisoryTable
+                t0 = time.time()
+                table = AdvisoryTable.load(npz_path)
+                return table, {"flatten_s": 0.0,
+                               "load_s": round(time.time() - t0, 2),
+                               "rows": len(table), "cached": True}
+    t0 = time.time()
+    advisories, details, sources = load_boltdb(bolt_path)
+    t1 = time.time()
+    table = build_table(advisories, details,
+                        aux={"Red Hat CPE": sources["Red Hat CPE"]}
+                        if "Red Hat CPE" in sources else None)
+    t2 = time.time()
+    table.save(npz_path)
+    with open(stamp_path, "w") as f:
+        f.write(digest)
+    stats = {
+        "walk_s": round(t1 - t0, 2),
+        "build_s": round(t2 - t1, 2),
+        "flatten_s": round(t2 - t0, 2),
+        "rows": len(table),
+        "advisories": len(advisories),
+        "hbm_bytes": int(table.lo_tok.nbytes + table.hi_tok.nbytes
+                         + table.flags.nbytes + table.hash.nbytes),
+        "cached": False,
+    }
+    if verbose:
+        import sys
+        print(f"# flattened {bolt_path}: {stats}", file=sys.stderr)
+    return table, stats
+
+
+def ensure_db(cache_dir: str, repository: str = DEFAULT_REPO,
+              skip_update: bool = False, client=None):
+    """Download-if-stale + flatten → (AdvisoryTable, stats)."""
+    if needs_update(cache_dir, skip=skip_update):
+        download_db(cache_dir, repository, client)
+    return flatten_db(db_path(cache_dir))

@@ -66,6 +66,7 @@ class _Prepared:
 
 class BatchDetector:
     def __init__(self, table: AdvisoryTable, pair_floor: int = 256):
+        import threading
         self.table = table
         self.pair_floor = pair_floor
         kw = table.lo_tok.shape[1] if len(table) else V.KEY_WIDTH
@@ -78,6 +79,10 @@ class BatchDetector:
         self._ver_dev_rows = 0     # pool rows covered by the snapshot
         # hash pool: unique (source, name) → uint64
         self._hash_cache: dict[tuple[str, str], int] = {}
+        # the detector is shared across server handler threads
+        # (server/listen.py ThreadingHTTPServer): slot allocation and
+        # pool growth are check-then-act and need the lock
+        self._lock = threading.Lock()
 
     # ---- memo pools ---------------------------------------------------
 
@@ -93,14 +98,18 @@ class BatchDetector:
             # parse (alpine.go:96-100 logs debug and continues).
             self._ver_idx[ck] = None
             return None
-        i = self._ver_count
-        if i == self._ver_mat.shape[0]:
-            self._ver_mat = np.concatenate(
-                [self._ver_mat, np.zeros_like(self._ver_mat)])
-        self._ver_mat[i] = k.tokens
-        self._ver_exact.append(k.exact)
-        self._ver_count = i + 1
-        self._ver_idx[ck] = i
+        with self._lock:
+            idx = self._ver_idx.get(ck, -1)
+            if idx != -1:  # another thread won the slot
+                return idx if idx is not None else None
+            i = self._ver_count
+            if i == self._ver_mat.shape[0]:
+                self._ver_mat = np.concatenate(
+                    [self._ver_mat, np.zeros_like(self._ver_mat)])
+            self._ver_mat[i] = k.tokens
+            self._ver_exact.append(k.exact)
+            self._ver_count = i + 1
+            self._ver_idx[ck] = i
         return i
 
     def _hashes(self, keys: list[tuple[str, str]]) -> np.ndarray:
@@ -128,11 +137,13 @@ class BatchDetector:
         """Device snapshot of the version pool, re-shipped only when the
         pool outgrew the last upload."""
         import jax
-        if self._ver_dev is None or self._ver_dev_rows < self._ver_count \
-                or self._ver_dev.shape[0] < u_pad:
-            self._ver_dev = jax.device_put(self.ver_snapshot(u_pad))
-            self._ver_dev_rows = self._ver_count
-        return self._ver_dev
+        with self._lock:
+            if self._ver_dev is None \
+                    or self._ver_dev_rows < self._ver_count \
+                    or self._ver_dev.shape[0] < u_pad:
+                self._ver_dev = jax.device_put(self.ver_snapshot(u_pad))
+                self._ver_dev_rows = self._ver_count
+            return self._ver_dev
 
     # ---- batch pipeline -----------------------------------------------
 

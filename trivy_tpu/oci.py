@@ -1,0 +1,289 @@
+"""OCI distribution client (registry API v2), dependency-free.
+
+The host-IO half of two flows the reference delegates to
+go-containerregistry:
+  - trivy-db / artifact download (pkg/oci/artifact.go:103 Download,
+    pkg/db/db.go:153): manifest → layer blob by media type;
+  - registry image pull (pkg/fanal/image/remote.go): manifest (with
+    index → platform selection) → config + layer blobs, materialized
+    here as an OCI-layout tarball that ImageArchiveArtifact already
+    understands.
+
+Auth: anonymous Bearer token flow (401 → WWW-Authenticate: Bearer
+realm/service/scope → token endpoint), optional static basic auth
+(TRIVY_USERNAME/TRIVY_PASSWORD in the reference's flag set). Endpoints
+are overridable and may be plain http (`http://host:port/repo:tag`) so
+tests run against an in-process fake registry — the same pattern as the
+sigv4/redis clients.
+"""
+
+from __future__ import annotations
+
+import base64
+import gzip
+import hashlib
+import io
+import json
+import re
+import tarfile
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Optional
+
+MT_OCI_INDEX = "application/vnd.oci.image.index.v1+json"
+MT_OCI_MANIFEST = "application/vnd.oci.image.manifest.v1+json"
+MT_DOCKER_LIST = "application/vnd.docker.distribution.manifest.list.v2+json"
+MT_DOCKER_MANIFEST = "application/vnd.docker.distribution.manifest.v2+json"
+ACCEPT = ", ".join([MT_OCI_INDEX, MT_OCI_MANIFEST, MT_DOCKER_LIST,
+                    MT_DOCKER_MANIFEST])
+
+# trivy-db layer media type (pkg/db/db.go:22)
+MT_TRIVY_DB = "application/vnd.aquasec.trivy.db.layer.v1.tar+gzip"
+# trivy-java-db layer media type (pkg/javadb/client.go)
+MT_JAVA_DB = "application/vnd.aquasec.trivy.javadb.layer.v1.tar+gzip"
+
+
+class OCIError(RuntimeError):
+    pass
+
+
+@dataclass
+class ImageRef:
+    host: str
+    repository: str
+    tag: str = "latest"
+    digest: str = ""
+    scheme: str = "https"
+
+    @property
+    def reference(self) -> str:
+        return self.digest or self.tag
+
+    @property
+    def base(self) -> str:
+        return f"{self.scheme}://{self.host}/v2/{self.repository}"
+
+    def __str__(self):
+        s = f"{self.host}/{self.repository}"
+        if self.tag:
+            s += f":{self.tag}"
+        if self.digest:
+            s += f"@{self.digest}"
+        return s
+
+
+def parse_ref(ref: str) -> ImageRef:
+    """'host/repo:tag', 'host/repo@sha256:..', 'http://host:5000/r:t',
+    bare 'repo:tag' (→ Docker Hub library/ convention)."""
+    scheme = "https"
+    if ref.startswith("http://"):
+        scheme = "http"
+        ref = ref[len("http://"):]
+    elif ref.startswith("https://"):
+        ref = ref[len("https://"):]
+    digest = ""
+    if "@" in ref:
+        ref, digest = ref.split("@", 1)
+    head, sep, rest = ref.partition("/")
+    if sep and (("." in head) or (":" in head) or head == "localhost"):
+        host, path = head, rest
+    else:
+        host, path = "registry-1.docker.io", ref
+    tag = "latest"
+    m = re.match(r"^(.+?):([\w][\w.-]{0,127})$", path)
+    if m:
+        path, tag = m.group(1), m.group(2)
+    if host == "registry-1.docker.io" and "/" not in path:
+        path = f"library/{path}"
+    return ImageRef(host=host, repository=path, tag=tag,
+                    digest=digest, scheme=scheme)
+
+
+@dataclass
+class RegistryClient:
+    username: str = ""
+    password: str = ""
+    timeout: float = 60.0
+    _tokens: dict = field(default_factory=dict)
+
+    # ---- http -----------------------------------------------------------
+
+    def _request(self, url: str, headers: dict, ref: ImageRef,
+                 _retried: bool = False):
+        req = urllib.request.Request(url, headers=headers)
+        tok = self._tokens.get(ref.repository)
+        if tok:
+            req.add_header("Authorization", f"Bearer {tok}")
+        elif self.username:
+            cred = base64.b64encode(
+                f"{self.username}:{self.password}".encode()).decode()
+            req.add_header("Authorization", f"Basic {cred}")
+        try:
+            return urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and not _retried:
+                # no token yet, or the cached token expired mid-pull
+                # (registry bearer tokens live ~5 min): re-run the
+                # challenge once
+                self._tokens.pop(ref.repository, None)
+                challenge = e.headers.get("WWW-Authenticate", "")
+                tok = self._fetch_token(challenge)
+                if tok:
+                    self._tokens[ref.repository] = tok
+                    return self._request(url, headers, ref, _retried=True)
+            raise OCIError(f"{url}: HTTP {e.code} "
+                           f"{e.read(200).decode(errors='replace')}") \
+                from None
+        except urllib.error.URLError as e:
+            raise OCIError(f"{url}: {e.reason}") from None
+
+    def _fetch_token(self, challenge: str) -> str:
+        """WWW-Authenticate: Bearer realm=...,service=...,scope=... →
+        anonymous (or basic-auth'd) token."""
+        if not challenge.lower().startswith("bearer "):
+            return ""
+        fields = dict(re.findall(r'(\w+)="([^"]*)"', challenge))
+        realm = fields.get("realm")
+        if not realm:
+            return ""
+        q = {k: v for k, v in fields.items() if k in ("service", "scope")}
+        url = realm + ("?" + urllib.parse.urlencode(q) if q else "")
+        req = urllib.request.Request(url)
+        if self.username:
+            cred = base64.b64encode(
+                f"{self.username}:{self.password}".encode()).decode()
+            req.add_header("Authorization", f"Basic {cred}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                doc = json.loads(r.read())
+            return doc.get("token") or doc.get("access_token") or ""
+        except (urllib.error.URLError, json.JSONDecodeError):
+            return ""
+
+    # ---- manifests / blobs ---------------------------------------------
+
+    def manifest(self, ref: ImageRef,
+                 platform: str = "linux/amd64") -> dict:
+        """→ resolved (platform-selected) image/artifact manifest."""
+        url = f"{ref.base}/manifests/{ref.reference}"
+        with self._request(url, {"Accept": ACCEPT}, ref) as r:
+            doc = json.loads(r.read())
+        mt = doc.get("mediaType", "")
+        if mt in (MT_OCI_INDEX, MT_DOCKER_LIST) or "manifests" in doc:
+            entry = self._select_platform(doc.get("manifests", []),
+                                          platform)
+            sub = ImageRef(host=ref.host, repository=ref.repository,
+                           tag="", digest=entry["digest"],
+                           scheme=ref.scheme)
+            return self.manifest(sub, platform)
+        return doc
+
+    @staticmethod
+    def _select_platform(manifests: list, platform: str) -> dict:
+        want_os, _, want_arch = platform.partition("/")
+        for m in manifests:
+            p = m.get("platform") or {}
+            if p.get("os") == want_os and \
+                    p.get("architecture") == want_arch:
+                return m
+        # entries without platform info (single-manifest artifact
+        # indexes) are acceptable; a wrong-platform silent fallback is
+        # not (go-containerregistry errors "no child with platform")
+        for m in manifests:
+            p = m.get("platform") or {}
+            if not p.get("os") and not p.get("architecture"):
+                return m
+        have = ", ".join(
+            f"{(m.get('platform') or {}).get('os', '?')}/"
+            f"{(m.get('platform') or {}).get('architecture', '?')}"
+            for m in manifests) or "none"
+        raise OCIError(f"no manifest for platform {platform} "
+                       f"(available: {have})")
+
+    def blob(self, ref: ImageRef, digest: str, verify: bool = True) -> bytes:
+        url = f"{ref.base}/blobs/{digest}"
+        with self._request(url, {}, ref) as r:
+            data = r.read()
+        if verify and digest.startswith("sha256:"):
+            actual = hashlib.sha256(data).hexdigest()
+            if actual != digest.split(":", 1)[1]:
+                raise OCIError(f"blob digest mismatch for {digest}: "
+                               f"got sha256:{actual}")
+        return data
+
+    # ---- high level ------------------------------------------------------
+
+    def download_artifact_layer(self, ref: ImageRef,
+                                media_type: str) -> bytes:
+        """First layer blob with the given media type (pkg/oci/
+        artifact.go:103 downloads trivy-db this way)."""
+        man = self.manifest(ref)
+        for layer in man.get("layers", []):
+            if layer.get("mediaType") == media_type:
+                return self.blob(ref, layer["digest"])
+        raise OCIError(f"{ref}: no layer with media type {media_type}")
+
+    def pull_to_oci_tar(self, ref: ImageRef, dest_path: str,
+                        platform: str = "linux/amd64") -> dict:
+        """Pull an image into an OCI-layout tarball at dest_path
+        (index.json + oci-layout + blobs/sha256/*) — the format
+        ImageArchiveArtifact consumes. → the resolved manifest.
+
+        Blobs are fetched and written one at a time so peak memory is
+        one layer, not the whole image."""
+        man = self.manifest(ref, platform)
+        man_raw = json.dumps(man, separators=(",", ":")).encode()
+        man_digest = "sha256:" + hashlib.sha256(man_raw).hexdigest()
+
+        index = {
+            "schemaVersion": 2,
+            "manifests": [{
+                "mediaType": man.get("mediaType", MT_OCI_MANIFEST),
+                "digest": man_digest,
+                "size": len(man_raw),
+                "annotations": {
+                    "org.opencontainers.image.ref.name": str(ref)},
+            }],
+        }
+        layout = {"imageLayoutVersion": "1.0.0"}
+        digests = [man.get("config", {}).get("digest")] + \
+            [layer["digest"] for layer in man.get("layers", [])]
+        with tarfile.open(dest_path, "w") as tf:
+            def add(name: str, data: bytes):
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+            add("oci-layout", json.dumps(layout).encode())
+            add("index.json", json.dumps(index).encode())
+            algo, hexd = man_digest.split(":", 1)
+            add(f"blobs/{algo}/{hexd}", man_raw)
+            seen = {man_digest}
+            for digest in digests:
+                if not digest or digest in seen:
+                    continue
+                seen.add(digest)
+                algo, hexd = digest.split(":", 1)
+                add(f"blobs/{algo}/{hexd}", self.blob(ref, digest))
+        return man
+
+
+def untar_gz_members(data: bytes) -> dict[str, bytes]:
+    """tar+gzip blob → {member name: bytes} (flat; trivy-db layers carry
+    trivy.db + metadata.json)."""
+    if data[:2] == b"\x1f\x8b":
+        data = gzip.decompress(data)
+    out = {}
+    with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+        for member in tf.getmembers():
+            if member.isfile():
+                f = tf.extractfile(member)
+                out[member.name.lstrip("./")] = f.read() if f else b""
+    return out
+
+
+def default_client() -> RegistryClient:
+    import os
+    return RegistryClient(username=os.environ.get("TRIVY_USERNAME", ""),
+                          password=os.environ.get("TRIVY_PASSWORD", ""))
