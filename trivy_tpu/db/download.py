@@ -101,11 +101,18 @@ def download_db(cache_dir: str, repository: str = DEFAULT_REPO,
         raise DBError(f"{ref}: layer does not contain trivy.db "
                       f"(members: {sorted(members)})")
     os.makedirs(db_dir(cache_dir), exist_ok=True)
-    with open(db_path(cache_dir), "wb") as f:
+    # write-temp + rename so a crash mid-download can't leave a truncated
+    # trivy.db gated by an already-fresh metadata.json (db first,
+    # metadata last: metadata only ever vouches for a complete db)
+    tmp_db = db_path(cache_dir) + ".tmp"
+    with open(tmp_db, "wb") as f:
         f.write(members["trivy.db"])
+    os.replace(tmp_db, db_path(cache_dir))
     meta = members.get("metadata.json", b"{}")
-    with open(metadata_path(cache_dir), "wb") as f:
+    tmp_meta = metadata_path(cache_dir) + ".tmp"
+    with open(tmp_meta, "wb") as f:
         f.write(meta)
+    os.replace(tmp_meta, metadata_path(cache_dir))
     return db_path(cache_dir)
 
 

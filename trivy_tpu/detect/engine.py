@@ -127,7 +127,12 @@ class BatchDetector:
 
     def ver_snapshot(self, u_pad: int | None = None) -> np.ndarray:
         """Padded host snapshot of the version pool (rows beyond the pool
-        are zero and never referenced by pair_ver)."""
+        are zero and never referenced by pair_ver). Thread-safe: callers
+        outside the lock (MeshDetector) get a consistent count/matrix."""
+        with self._lock:
+            return self._ver_snapshot_locked(u_pad)
+
+    def _ver_snapshot_locked(self, u_pad: int | None = None) -> np.ndarray:
         rows = max(u_pad or 0, _next_pow2(self._ver_count))
         snap = np.zeros((rows, self._ver_mat.shape[1]), np.int32)
         snap[:self._ver_count] = self._ver_mat[:self._ver_count]
@@ -141,7 +146,8 @@ class BatchDetector:
             if self._ver_dev is None \
                     or self._ver_dev_rows < self._ver_count \
                     or self._ver_dev.shape[0] < u_pad:
-                self._ver_dev = jax.device_put(self.ver_snapshot(u_pad))
+                self._ver_dev = jax.device_put(
+                    self._ver_snapshot_locked(u_pad))
                 self._ver_dev_rows = self._ver_count
             return self._ver_dev
 

@@ -113,7 +113,7 @@ class RegistryClient:
     def _request(self, url: str, headers: dict, ref: ImageRef,
                  _retried: bool = False):
         req = urllib.request.Request(url, headers=headers)
-        tok = self._tokens.get(ref.repository)
+        tok = self._tokens.get((ref.host, ref.repository))
         if tok:
             req.add_header("Authorization", f"Bearer {tok}")
         elif self.username:
@@ -127,11 +127,11 @@ class RegistryClient:
                 # no token yet, or the cached token expired mid-pull
                 # (registry bearer tokens live ~5 min): re-run the
                 # challenge once
-                self._tokens.pop(ref.repository, None)
+                self._tokens.pop((ref.host, ref.repository), None)
                 challenge = e.headers.get("WWW-Authenticate", "")
                 tok = self._fetch_token(challenge)
                 if tok:
-                    self._tokens[ref.repository] = tok
+                    self._tokens[(ref.host, ref.repository)] = tok
                     return self._request(url, headers, ref, _retried=True)
             raise OCIError(f"{url}: HTTP {e.code} "
                            f"{e.read(200).decode(errors='replace')}") \
@@ -279,7 +279,10 @@ def untar_gz_members(data: bytes) -> dict[str, bytes]:
         for member in tf.getmembers():
             if member.isfile():
                 f = tf.extractfile(member)
-                out[member.name.lstrip("./")] = f.read() if f else b""
+                name = member.name
+                while name.startswith("./"):
+                    name = name[2:]
+                out[name] = f.read() if f else b""
     return out
 
 

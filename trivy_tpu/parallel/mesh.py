@@ -209,7 +209,9 @@ class MeshDetector:
             return []
         part = partition_pairs(self.st, prep.pair_row, prep.pair_ver,
                                prep.n_pairs, self.dp)
+        # the inner detector's cached device pool (re-shipped only on
+        # growth) doubles as the replicated mesh operand
         bits = sharded_pair_join(self.mesh, self._st_dev,
-                                 inner.ver_snapshot(prep.u_pad), part,
+                                 inner._ver_device(prep.u_pad), part,
                                  prep.n_pairs)
         return inner._assemble(prep, bits)
