@@ -12,6 +12,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# The driver shell exports JAX_PLATFORMS=axon (the TPU tunnel); tests
+# must never touch it. cli.main() re-pins jax config from this env var,
+# so the override has to happen at the env level, not just jax.config.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 

@@ -13,12 +13,21 @@ from trivy_tpu.attestation import (AttestationError, Statement,
                                    decode_any, is_envelope)
 from trivy_tpu.rekor import Client, EntryID, fetch_sbom_statement
 
+# apk purls classify as OS packages; without the operating-system
+# component the reference drops them (ospkg/scan.go:28-30 requires a
+# detected OS), so the BOM carries one like real trivy output does.
 CDX = {
     "bomFormat": "CycloneDX", "specVersion": "1.5",
-    "components": [{
-        "type": "library", "name": "musl", "version": "1.2.3-r0",
-        "purl": "pkg:apk/alpine/musl@1.2.3-r0",
-    }],
+    "components": [
+        {"type": "operating-system", "name": "alpine",
+         "version": "3.17.0",
+         "properties": [{"name": "aquasecurity:trivy:Type",
+                         "value": "alpine"},
+                        {"name": "aquasecurity:trivy:Class",
+                         "value": "os-pkgs"}]},
+        {"type": "library", "name": "musl", "version": "1.2.3-r0",
+         "purl": "pkg:apk/alpine/musl@1.2.3-r0"},
+    ],
 }
 
 

@@ -1,0 +1,168 @@
+"""Golden zero-diff gate against the reference's own integration
+outputs.
+
+The reference ships golden JSON reports produced by its CLI
+(/root/reference/integration/testdata/*.golden) together with the exact
+inputs (fixtures/repo/*, fixtures/sbom/*) and the advisory fixture DB
+(fixtures/db/*.yaml). Those are vendored under tests/golden/ and every
+config here runs OUR CLI over the SAME input with the SAME DB and
+asserts the normalized reports are identical — the BASELINE.md
+acceptance gate ("byte-identical findings, golden JSON comparison, same
+harness as integration/*_test.go").
+
+Normalization mirrors the reference harness exactly:
+- readReport (integration_test.go:105-138): drop ImageConfig.History,
+  RepoTags/RepoDigests, vulnerability Layer.Digest.
+- CreatedAt/ArtifactName: the reference injects a fake clock and scans
+  from the repo root; we normalize both (and pin TRIVY_TPU_FAKE_NOW for
+  EOL-table determinism).
+- compareSBOMReports (sbom_test.go:208-240): zero ImageID/DiffIDs/
+  ImageConfig, clear vuln Layer.DiffID, override Target/BOMRef.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLD = os.path.join(HERE, "golden")
+DB_GLOB = os.path.join(GOLD, "db", "*.yaml")
+FAKE_NOW = "2021-08-25T12:20:30Z"
+
+ZERO_IMAGE_CONFIG = {
+    "architecture": "", "created": "0001-01-01T00:00:00Z", "os": "",
+    "rootfs": {"type": "", "diff_ids": None}, "config": {},
+}
+
+
+def run_cli(argv, tmp_path):
+    from trivy_tpu.cli import main
+    out_path = str(tmp_path / "report.json")
+    os.environ["TRIVY_TPU_FAKE_NOW"] = FAKE_NOW
+    try:
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc = main(argv + ["--output", out_path])
+    finally:
+        os.environ.pop("TRIVY_TPU_FAKE_NOW", None)
+        # reset secret-config global set by _secret_scanner
+        from trivy_tpu.fanal.walker import set_secret_config_base
+        set_secret_config_base("trivy-secret.yaml")
+    assert rc == 0
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def read_golden(name):
+    with open(os.path.join(GOLD, "reports", name)) as f:
+        return json.load(f)
+
+
+def normalize(report):
+    """The reference readReport normalization + harness-level fields."""
+    r = json.loads(json.dumps(report))
+    r.pop("CreatedAt", None)
+    r.pop("ArtifactName", None)
+    md = r.get("Metadata") or {}
+    (md.get("ImageConfig") or {}).pop("history", None)
+    md.pop("RepoTags", None)
+    md.pop("RepoDigests", None)
+    for res in r.get("Results", []):
+        for v in res.get("Vulnerabilities", []) or []:
+            (v.get("Layer") or {}).pop("Digest", None)
+    return r
+
+
+def assert_zero_diff(got, want):
+    g, w = normalize(got), normalize(want)
+    if g != w:
+        import difflib
+        gs = json.dumps(g, indent=1, sort_keys=True).splitlines()
+        ws = json.dumps(w, indent=1, sort_keys=True).splitlines()
+        diff = "\n".join(difflib.unified_diff(ws, gs, "want", "got",
+                                              lineterm="", n=2))
+        pytest.fail(f"golden diff is non-zero:\n{diff[:8000]}")
+
+
+# ---- configs -----------------------------------------------------------
+
+def test_golden_npm_repo(tmp_path):
+    """repo scan of the npm fixture == npm.json.golden
+    (reference repo_test.go "npm": --list-all-pkgs)."""
+    got = run_cli(["repo", os.path.join(GOLD, "inputs", "npm"),
+                   "--db", DB_GLOB, "--format", "json",
+                   "--list-all-pkgs", "--cache-dir", str(tmp_path)],
+                  tmp_path)
+    assert_zero_diff(got, read_golden("npm.json.golden"))
+
+
+def test_golden_npm_with_dev_deps(tmp_path):
+    """repo_test.go "npm with dev deps": --include-dev-deps keeps the
+    dev-only z-lock package."""
+    got = run_cli(["repo", os.path.join(GOLD, "inputs", "npm"),
+                   "--db", DB_GLOB, "--format", "json",
+                   "--list-all-pkgs", "--include-dev-deps",
+                   "--cache-dir", str(tmp_path)], tmp_path)
+    assert_zero_diff(got, read_golden("npm-with-dev.json.golden"))
+
+
+def test_golden_pip_repo(tmp_path):
+    got = run_cli(["repo", os.path.join(GOLD, "inputs", "pip"),
+                   "--db", DB_GLOB, "--format", "json",
+                   "--list-all-pkgs", "--cache-dir", str(tmp_path)],
+                  tmp_path)
+    assert_zero_diff(got, read_golden("pip.json.golden"))
+
+
+def test_golden_gomod_repo(tmp_path):
+    """go.mod + pre-1.17 go.sum merge (submod2) == gomod.json.golden."""
+    got = run_cli(["repo", os.path.join(GOLD, "inputs", "gomod"),
+                   "--db", DB_GLOB, "--format", "json",
+                   "--cache-dir", str(tmp_path)], tmp_path)
+    assert_zero_diff(got, read_golden("gomod.json.golden"))
+
+
+def test_golden_secrets_repo(tmp_path):
+    """custom + disabled rules via --secret-config == secrets.json.golden."""
+    got = run_cli(["repo", os.path.join(GOLD, "inputs", "secrets"),
+                   "--scanners", "vuln,secret",
+                   "--secret-config",
+                   os.path.join(GOLD, "inputs", "secrets",
+                                "trivy-secret.yaml"),
+                   "--db", DB_GLOB, "--format", "json",
+                   "--cache-dir", str(tmp_path)], tmp_path)
+    assert_zero_diff(got, read_golden("secrets.json.golden"))
+
+
+def test_golden_sbom_cyclonedx(tmp_path):
+    """trivy-flavored CycloneDX decode → centos-7.json.golden with the
+    reference's compareSBOMReports overrides (sbom_test.go:33-64)."""
+    input_path = os.path.join(GOLD, "inputs", "centos-7-cyclonedx.json")
+    got = run_cli(["sbom", input_path, "--db", DB_GLOB,
+                   "--format", "json", "--cache-dir", str(tmp_path)],
+                  tmp_path)
+    want = read_golden("centos-7.json.golden")
+    want["ArtifactType"] = "cyclonedx"
+    md = want.get("Metadata", {})
+    md.pop("ImageID", None)
+    md.pop("DiffIDs", None)
+    md["ImageConfig"] = dict(ZERO_IMAGE_CONFIG)
+    bomrefs = {
+        "CVE-2019-18276": "pkg:rpm/centos/bash@4.2.46-31.el7"
+                          "?arch=x86_64&distro=centos-7.6.1810",
+        "CVE-2019-1559": "pkg:rpm/centos/openssl-libs@1.0.2k-16.el7"
+                         "?arch=x86_64&epoch=1&distro=centos-7.6.1810",
+        "CVE-2018-0734": "pkg:rpm/centos/openssl-libs@1.0.2k-16.el7"
+                         "?arch=x86_64&epoch=1&distro=centos-7.6.1810",
+    }
+    for res in want.get("Results", []):
+        res["Target"] = f"{input_path} (centos 7.6.1810)"
+        for v in res.get("Vulnerabilities", []):
+            (v.get("Layer") or {}).pop("DiffID", None)
+            v.setdefault("PkgIdentifier", {})["BOMRef"] = \
+                bomrefs[v["VulnerabilityID"]]
+    assert_zero_diff(got, want)

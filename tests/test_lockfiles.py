@@ -10,6 +10,10 @@ def analyze(path: str, content: bytes):
     group = AnalyzerGroup()
     result = AnalysisResult()
     group.analyze_file(path, content, result)
+    # npm/gomod moved to post-analyzers (multi-file: license lookup,
+    # go.sum merge); feed the same single file through that stage too
+    if group.post_required(path, len(content)):
+        group.post_analyze({path: content}, result)
     return result
 
 

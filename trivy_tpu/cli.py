@@ -39,6 +39,8 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--ignorefile", default="")
     p.add_argument("--vex", default="", help="OpenVEX/CycloneDX VEX file")
     p.add_argument("--list-all-pkgs", action="store_true")
+    p.add_argument("--include-dev-deps", action="store_true")
+    p.add_argument("--secret-config", default="trivy-secret.yaml")
     p.add_argument("--exit-code", type=int, default=0)
     p.add_argument("--cache-dir",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
@@ -226,9 +228,17 @@ def _scan_common(args, ref, cache, artifact_type: str) -> int:
     opts = T.ScanOptions(
         scanners=scanners,
         list_all_packages=args.list_all_pkgs,
+        include_dev_deps=getattr(args, "include_dev_deps", False),
         pkg_types=tuple(args.pkg_types.split(",")),
     )
-    results, os_info = scanner.scan(ref.name, ref.id, ref.blob_ids, opts)
+    # deterministic clock for golden/diff testing (the reference injects
+    # a fake clock in its integration harness, pkg/clock)
+    now = None
+    fake_now = os.environ.get("TRIVY_TPU_FAKE_NOW", "")
+    if fake_now:
+        now = dt.datetime.fromisoformat(fake_now.replace("Z", "+00:00"))
+    results, os_info = scanner.scan(ref.name, ref.id, ref.blob_ids, opts,
+                                    now=now)
 
     if getattr(args, "vex", ""):
         from .vex import apply_vex, load_vex_file
@@ -347,7 +357,11 @@ def cmd_image(args) -> int:
     try:
         cache = _open_cache(args)
         scanners = tuple(s.strip() for s in args.scanners.split(","))
-        art = ImageArchiveArtifact(input_path, cache, scanners=scanners)
+        from .fanal.analyzers import AnalyzerGroup
+        # image scans disable lockfile analyzers (run.go:167-169)
+        art = ImageArchiveArtifact(
+            input_path, cache, scanners=scanners,
+            group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS))
         ref = None
         if "rekor" in getattr(args, "sbom_sources", ""):
             # remote-SBOM shortcut: a published SBOM attestation replaces
@@ -379,16 +393,62 @@ def cmd_image(args) -> int:
             os.unlink(tmp.name)
 
 
+# analyzer groups disabled per target kind (reference run.go:167-224:
+# image disables lockfiles; fs disables individual-package + SBOM;
+# rootfs disables lockfiles; repo disables OS + individual + SBOM;
+# const.go TypeIndividualPkgs / TypeLockfiles / TypeOSes)
+INDIVIDUAL_PKG_ANALYZERS = ("gemspec", "node-pkg", "conda-pkg",
+                            "python-pkg", "gobinary", "jar", "rustbinary")
+LOCKFILE_ANALYZERS = ("bundler", "npm", "yarn", "pnpm", "pip", "pipenv",
+                      "poetry", "gomod", "pom", "conan",
+                      "gradle-lockfile", "cocoapods", "swift", "pub",
+                      "mix-lock")
+OS_ANALYZERS = ("os-release", "alpine", "amazonlinux", "mariner",
+                "debian", "redhatbase", "ubuntu", "apk", "dpkg", "rpm",
+                "rpmqa", "apk-repo", "redhat-content-manifest",
+                "redhat-dockerfile")
+
+
 def cmd_fs(args) -> int:
+    from .fanal.analyzers import AnalyzerGroup
     from .fanal.artifact import FilesystemArtifact
     from .fanal.cache import MemoryCache
     _configure_misconf(args)
     _configure_javadb(args)
     cache = MemoryCache()
     scanners = tuple(s.strip() for s in args.scanners.split(","))
-    art = FilesystemArtifact(args.target, cache, scanners=scanners)
+    if args.command == "rootfs":
+        disabled = LOCKFILE_ANALYZERS
+        artifact_type = T.ArtifactType.FILESYSTEM
+    elif args.command in ("repo", "repository"):
+        disabled = INDIVIDUAL_PKG_ANALYZERS + OS_ANALYZERS + ("sbom",)
+        artifact_type = T.ArtifactType.REPOSITORY
+        args.pkg_types = "library"  # repo scans only language packages
+    else:
+        disabled = INDIVIDUAL_PKG_ANALYZERS + ("sbom",)
+        artifact_type = T.ArtifactType.FILESYSTEM
+    art = FilesystemArtifact(args.target, cache, scanners=scanners,
+                             group=AnalyzerGroup(disabled=disabled),
+                             secret_scanner=_secret_scanner(args, scanners))
     ref = art.inspect()
-    return _scan_common(args, ref, cache, T.ArtifactType.FILESYSTEM)
+    return _scan_common(args, ref, cache, artifact_type)
+
+
+def _secret_scanner(args, scanners):
+    """Custom secret rules from --secret-config (reference
+    pkg/fanal/secret/scanner.go ParseConfig; the config file itself is
+    excluded from scanning)."""
+    if "secret" not in scanners:
+        return None
+    cfg = getattr(args, "secret_config", "") or ""
+    from .fanal.walker import set_secret_config_base
+    set_secret_config_base(cfg)
+    if not cfg or not os.path.exists(cfg):
+        return None
+    from .secret import SecretScanner
+    from .secret.rules import load_secret_config
+    rules, allow = load_secret_config(cfg)
+    return SecretScanner(rules=rules, allow_rules=allow)
 
 
 def cmd_sbom(args) -> int:
@@ -579,6 +639,14 @@ def cmd_module(args) -> int:
 def main(argv=None) -> int:
     import sys as _sys
     argv = list(_sys.argv[1:]) if argv is None else list(argv)
+    # Honor JAX_PLATFORMS even when a sitecustomize pinned the platform
+    # in jax config after env-var processing (the axon site does this;
+    # without the re-pin, JAX_PLATFORMS=cpu still initializes the TPU
+    # tunnel and hangs when the chip is unreachable).
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
     # `trivy-tpu <plugin-name> args...` passthrough (reference
     # cmd/trivy main.go TRIVY_RUN_AS_PLUGIN + plugin.Run:104)
     if argv:

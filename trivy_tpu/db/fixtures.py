@@ -192,11 +192,20 @@ def _ds_fields(ds: dict | None) -> dict | None:
             "url": ds.get("URL", "")}
 
 
+import re
+
+# The reference's own fixture corpus contains sequence items with a stray
+# trailing comma after the closing quote (vulnerability.yaml
+# `- "https://...",`) that strict YAML rejects; drop it.
+_TRAILING_COMMA = re.compile(r'^(\s*- ".*")\s*,\s*$', re.M)
+
+
 def load_fixture_files(paths: list[str]):
     docs = []
     for p in paths:
         with open(p) as f:
-            loaded = yaml.safe_load(f)
-            if loaded:
-                docs.extend(loaded)
+            text = _TRAILING_COMMA.sub(r"\1", f.read())
+        loaded = yaml.safe_load(text)
+        if loaded:
+            docs.extend(loaded)
     return load_fixture_docs(docs)

@@ -47,9 +47,20 @@ def _detail_to_vulnerability(detail: dict) -> T.Vulnerability:
                          (detail.get("VendorSeverity") or {}).items()},
         cvss=cvss,
         references=detail.get("References", []),
-        published_date=str(detail.get("PublishedDate", "")),
-        last_modified_date=str(detail.get("LastModifiedDate", "")),
+        published_date=_rfc3339(detail.get("PublishedDate", "")),
+        last_modified_date=_rfc3339(detail.get("LastModifiedDate", "")),
     )
+
+
+def _rfc3339(v) -> str:
+    """Dates arrive as strings (bolt path) or datetimes (YAML fixture
+    path); Go marshals time.Time as RFC3339 with a literal Z for UTC."""
+    import datetime as _dt
+    if isinstance(v, _dt.datetime):
+        s = v.isoformat()
+        return s.replace("+00:00", "Z") if v.tzinfo \
+            else s + "Z"
+    return str(v) if v else ""
 
 
 def fill_info(vulns: list[T.DetectedVulnerability], details: dict) -> None:

@@ -12,7 +12,9 @@ import re
 from typing import Optional
 
 from ... import types as T
-from . import AnalysisResult, Analyzer, register
+from ...jsonpos import JSONPosError, SpanDict
+from ...jsonpos import parse as json_parse
+from . import AnalysisResult, Analyzer, PostAnalyzer, register, register_post
 
 
 def _app(app_type: str, path: str, pkgs: list) -> Optional[AnalysisResult]:
@@ -29,40 +31,191 @@ def _pkg(name: str, version: str, dev: bool = False,
                      dev=dev, indirect=indirect)
 
 
-@register
-class NpmLockAnalyzer(Analyzer):
-    """package-lock.json v1/v2/v3 (pkg/dependency/parser/nodejs/npm)."""
+def _pkgjson_license(doc: dict):
+    """license field of a package.json: string, {type}, or legacy
+    licenses array (pkg/dependency/parser/nodejs/packagejson)."""
+    lic = doc.get("license")
+    if isinstance(lic, dict):
+        lic = lic.get("type")
+    if not lic and isinstance(doc.get("licenses"), list):
+        types_ = [entry.get("type") for entry in doc["licenses"]
+                  if isinstance(entry, dict) and entry.get("type")]
+        lic = ", ".join(types_) if types_ else None
+    return lic
+
+
+@register_post
+class NpmLockAnalyzer(PostAnalyzer):
+    """package-lock.json v1/v2/v3 with line locations, dependency graph,
+    dev flags, and license lookup from node_modules package.json files
+    (pkg/fanal/analyzer/language/nodejs/npm/npm.go PostAnalyze +
+    pkg/dependency/parser/nodejs/npm/parse.go)."""
     name = "npm"
-    version = 1
+    version = 2
 
     def required(self, path: str, size: int = -1) -> bool:
-        return path.endswith("package-lock.json")
+        parts = path.split("/")
+        base = parts[-1]
+        # lockfiles inside node_modules are vendored copies (npm.go:90-99)
+        if base == "package-lock.json" and "node_modules" not in parts:
+            return True
+        # package.json only from node_modules — the license source
+        if base == "package.json" and "node_modules" in parts:
+            return True
+        return False
 
-    def analyze(self, path, content):
+    def post_analyze(self, files: dict) -> Optional[AnalysisResult]:
+        licenses: dict[str, str] = {}
+        for path, content in files.items():
+            if path.split("/")[-1] != "package.json":
+                continue
+            try:
+                doc = json.loads(content)
+            except json.JSONDecodeError:
+                continue
+            lic = _pkgjson_license(doc)
+            if lic and doc.get("name") and doc.get("version"):
+                licenses[f"{doc['name']}@{doc['version']}"] = lic
+        apps = []
+        for path in sorted(files):
+            if path.split("/")[-1] != "package-lock.json":
+                continue
+            app = self._parse_lock(path, files[path], licenses)
+            if app is not None:
+                apps.append(app)
+        return AnalysisResult(applications=apps) if apps else None
+
+    def _parse_lock(self, path: str, content: bytes,
+                    licenses: dict) -> Optional[T.Application]:
         try:
-            doc = json.loads(content)
-        except json.JSONDecodeError:
+            doc = json_parse(content)
+        except (JSONPosError, ValueError):
             return None
+        if not isinstance(doc, dict):
+            return None
+        if doc.get("lockfileVersion") == 1 or \
+                ("packages" not in doc and "dependencies" in doc):
+            entries = self._parse_v1(doc)
+        else:
+            entries = self._parse_v2(doc)
+        # UniqueLibraries merge: first entry wins; a non-dev duplicate
+        # clears Dev; locations accumulate sorted (parser/utils.go)
+        merged: dict[str, T.Package] = {}
+        deps_of: dict[str, list] = {}
+        for e in entries:
+            pid = e.id
+            got = merged.get(pid)
+            if got is None:
+                merged[pid] = e
+            else:
+                got.dev = got.dev and e.dev
+                got.indirect = got.indirect and e.indirect
+                got.locations = sorted(
+                    got.locations + e.locations,
+                    key=lambda l: (l["StartLine"], l["EndLine"]))
+            if e.depends_on and pid not in deps_of:
+                deps_of[pid] = sorted(set(e.depends_on))
         pkgs = []
-        if "packages" in doc:  # v2/v3
-            for loc, info in doc["packages"].items():
-                if not loc.startswith("node_modules/"):
+        for pid, p in merged.items():
+            p.depends_on = deps_of.get(pid, [])
+            if pid in licenses:
+                p.licenses = [licenses[pid]]
+            pkgs.append(p)
+        if not pkgs:
+            return None
+        pkgs.sort(key=lambda p: (p.name, p.version))
+        return T.Application(type="npm", file_path=path, packages=pkgs)
+
+    def _entry(self, name, version, span, dev, indirect, depends):
+        p = _pkg(name, version, dev=dev, indirect=indirect)
+        p.locations = [{"StartLine": span[0], "EndLine": span[1]}]
+        p.depends_on = depends
+        return p
+
+    def _parse_v1(self, doc) -> list:
+        """Nested `dependencies` tree; every package Indirect (the v1
+        schema can't distinguish direct deps; parse.go parseV1)."""
+        out = []
+
+        def walk(deps, versions):
+            versions = dict(versions)
+            for name, info in deps.items():
+                if isinstance(info, dict) and info.get("version"):
+                    versions[name] = info["version"]
+            for name, info in deps.items():
+                if not isinstance(info, dict):
                     continue
-                name = info.get("name") or loc.split("node_modules/")[-1]
-                if not info.get("version"):
+                ver = info.get("version")
+                if not ver:
                     continue
-                pkgs.append(_pkg(name, info["version"],
-                                 dev=bool(info.get("dev"))))
-        else:  # v1
-            def walk(deps, indirect=False):
-                for name, info in (deps or {}).items():
-                    if info.get("version"):
-                        pkgs.append(_pkg(name, info["version"],
-                                         dev=bool(info.get("dev")),
-                                         indirect=indirect))
-                    walk(info.get("dependencies"), indirect=True)
-            walk(doc.get("dependencies"))
-        return _app("npm", path, pkgs)
+                span = deps.spans.get(name, (0, 0)) \
+                    if isinstance(deps, SpanDict) else (0, 0)
+                depends = []
+                nested = info.get("dependencies") or {}
+                for req_name in (info.get("requires") or {}):
+                    if isinstance(nested.get(req_name), dict) and \
+                            nested[req_name].get("version"):
+                        depends.append(
+                            f"{req_name}@{nested[req_name]['version']}")
+                    elif req_name in versions:
+                        depends.append(f"{req_name}@{versions[req_name]}")
+                out.append(self._entry(name, ver, span,
+                                       dev=bool(info.get("dev")),
+                                       indirect=True, depends=depends))
+                if nested:
+                    walk(nested, versions)
+
+        walk(doc.get("dependencies") or SpanDict(), {})
+        return out
+
+    def _parse_v2(self, doc) -> list:
+        """Flat `packages` map keyed by install path (parse.go parseV2)."""
+        packages = doc.get("packages") or {}
+        root = packages.get("") or {}
+        direct = set()
+        for name in list(root.get("dependencies") or []) + \
+                list(root.get("optionalDependencies") or []) + \
+                list(root.get("devDependencies") or []):
+            p = f"node_modules/{name}"
+            if p in packages:
+                direct.add(p)
+        out = []
+        for pkg_path, info in packages.items():
+            if not pkg_path.startswith("node_modules") or \
+                    not isinstance(info, dict):
+                continue
+            version = info.get("version")
+            if not version:
+                continue
+            name = info.get("name") or \
+                pkg_path.rsplit("node_modules/", 1)[-1]
+            span = packages.spans.get(pkg_path, (0, 0)) \
+                if isinstance(packages, SpanDict) else (0, 0)
+            depends = []
+            wants = dict(info.get("dependencies") or {})
+            wants.update(info.get("optionalDependencies") or {})
+            for dep_name in wants:
+                dep_id = self._resolve_v2(pkg_path, dep_name, packages)
+                if dep_id:
+                    depends.append(dep_id)
+            out.append(self._entry(
+                name, version, span, dev=bool(info.get("dev")),
+                indirect=pkg_path not in direct, depends=depends))
+        return out
+
+    @staticmethod
+    def _resolve_v2(pkg_path: str, dep_name: str, packages) -> str:
+        """Nearest-node_modules version resolution (parse.go
+        findDependsOn)."""
+        parts = (pkg_path + "/node_modules").split("/")
+        for i in range(len(parts) - 1, -1, -1):
+            if parts[i] != "node_modules":
+                continue
+            cand = "/".join(parts[:i + 1] + [dep_name])
+            info = packages.get(cand)
+            if isinstance(info, dict) and info.get("version"):
+                return f"{dep_name}@{info['version']}"
+        return ""
 
 
 _YARN_VER = re.compile(r'^\s{2}version:?\s+"?([^"\s]+)"?')
@@ -130,20 +283,51 @@ _GOMOD_REQ = re.compile(
     r"^\s*(?:require\s+)?([\w./~\-]+\.[\w./~\-]+)\s+v(\S+)(\s*//\s*indirect)?")
 
 
-@register
-class GoModAnalyzer(Analyzer):
-    """go.mod (pkg/dependency/parser/golang/mod)."""
+@register_post
+class GoModAnalyzer(PostAnalyzer):
+    """go.mod (+ go.sum for pre-1.17 modules) —
+    pkg/fanal/analyzer/language/golang/mod/mod.go: modules below Go 1.17
+    don't record the full graph in go.mod, so the sibling go.sum's
+    entries are merged in as indirect deps (mergeGoSum:238-261). Package
+    IDs keep the Go-style v prefix (dependency/id.go:21-27) while the
+    Version field drops it."""
     name = "gomod"
-    version = 1
+    version = 2
 
     def required(self, path: str, size: int = -1) -> bool:
-        return path.endswith("go.mod")
+        return path.endswith(("go.mod", "go.sum"))
 
-    def analyze(self, path, content):
-        pkgs = []
+    def post_analyze(self, files: dict) -> Optional[AnalysisResult]:
+        apps = []
+        for path in sorted(files):
+            if not path.endswith("go.mod"):
+                continue
+            pkgs, go_version = self._parse_mod(files[path])
+            if go_version and _go_below_117(go_version):
+                sum_path = path[:-len("go.mod")] + "go.sum"
+                if sum_path in files:
+                    self._merge_sum(pkgs, files[sum_path])
+            if pkgs:
+                plist = sorted(pkgs.values(),
+                               key=lambda p: (p.name, p.version))
+                apps.append(T.Application(type="gomod", file_path=path,
+                                          packages=plist))
+        return AnalysisResult(applications=apps) if apps else None
+
+    @staticmethod
+    def _gopkg(name: str, version: str, indirect: bool) -> T.Package:
+        return T.Package(id=f"{name}@v{version}", name=name,
+                         version=version, indirect=indirect)
+
+    def _parse_mod(self, content: bytes):
+        pkgs: dict[str, T.Package] = {}
+        go_version = ""
         in_block = False
         for line in content.decode(errors="replace").splitlines():
             s = line.strip()
+            if s.startswith("go "):
+                go_version = s.split()[1] if len(s.split()) > 1 else ""
+                continue
             if s.startswith("require ("):
                 in_block = True
                 continue
@@ -153,9 +337,35 @@ class GoModAnalyzer(Analyzer):
             if in_block or s.startswith("require "):
                 m = _GOMOD_REQ.match(line)
                 if m:
-                    pkgs.append(_pkg(m.group(1), m.group(2),
-                                     indirect=bool(m.group(3))))
-        return _app("gomod", path, pkgs)
+                    pkgs[m.group(1)] = self._gopkg(
+                        m.group(1), m.group(2), bool(m.group(3)))
+        return pkgs, go_version
+
+    def _merge_sum(self, pkgs: dict, content: bytes) -> None:
+        """go.sum lines: `module vVERSION[/go.mod] hash`; sorted, so the
+        last non-/go.mod entry per module wins (sum/parse.go)."""
+        sums: dict[str, str] = {}
+        for line in content.decode(errors="replace").splitlines():
+            f = line.split()
+            if len(f) < 2:
+                continue
+            ver = f[1]
+            if ver.startswith("v"):
+                ver = ver[1:]
+            ver = ver.removesuffix("/go.mod")
+            sums[f[0]] = ver
+        for name, ver in sums.items():
+            if name not in pkgs:
+                pkgs[name] = self._gopkg(name, ver, indirect=True)
+
+
+def _go_below_117(version: str) -> bool:
+    parts = version.split(".")
+    try:
+        major, minor = int(parts[0]), int(parts[1]) if len(parts) > 1 else 0
+    except ValueError:
+        return False
+    return major <= 1 and minor < 17
 
 
 @register

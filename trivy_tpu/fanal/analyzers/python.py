@@ -61,8 +61,10 @@ class PipRequirementsAnalyzer(Analyzer):
             m = _REQ_LINE.match(line.strip())
             if m:
                 name, ver = m.group(1), m.group(2)
-                pkgs.append(T.Package(id=f"{name}@{ver}", name=name,
-                                      version=ver))
+                # requirements.txt entries carry no lockfile identity:
+                # the reference pip parser leaves ID empty
+                # (pip.json.golden packages have no "ID")
+                pkgs.append(T.Package(name=name, version=ver))
         if not pkgs:
             return None
         return AnalysisResult(applications=[

@@ -76,8 +76,25 @@ def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
         detail.custom_resources.extend(blob.custom_resources)
 
     detail.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
+    _fill_identifiers(detail)
     _aggregate_individual_apps(detail)
     return detail
+
+
+def _fill_identifiers(detail: T.ArtifactDetail) -> None:
+    """PURL attachment (docker.go:219-244: OS packages get the distro
+    qualifier from the detected OS, app packages get their ecosystem
+    type)."""
+    from ..purl import purl_for_package
+    if detail.os.detected:
+        for pkg in detail.packages:
+            if not pkg.identifier.purl:
+                pkg.identifier.purl = purl_for_package(
+                    detail.os.family, pkg, os_info=detail.os)
+    for app in detail.applications:
+        for pkg in app.packages:
+            if not pkg.identifier.purl:
+                pkg.identifier.purl = purl_for_package(app.type, pkg)
 
 
 # "individual package" app types merge into one application per type,

@@ -112,9 +112,12 @@ def _pkg_from_json(j: dict) -> T.Package:
         src_name=j.get("SrcName", ""), src_version=j.get("SrcVersion", ""),
         src_release=j.get("SrcRelease", ""), src_epoch=j.get("SrcEpoch", 0),
         licenses=j.get("Licenses", []), maintainer=j.get("Maintainer", ""),
+        modularitylabel=j.get("Modularitylabel", ""),
+        dev=j.get("Dev", False), indirect=j.get("Indirect", False),
         depends_on=j.get("DependsOn", []),
         layer=_layer_from_json(j.get("Layer")),
         file_path=j.get("FilePath", ""), digest=j.get("Digest", ""),
+        locations=j.get("Locations", []),
         installed_files=j.get("InstalledFiles", []),
     )
 

@@ -18,18 +18,37 @@ from .analyzers import AnalysisResult, AnalyzerGroup
 WH_PREFIX = ".wh."
 OPAQUE_MARKER = ".wh..wh..opq"
 
-# secret-candidate gates (pkg/fanal/analyzer/secret/secret.go:27-41,115-119)
+# secret-candidate gates (pkg/fanal/analyzer/secret/secret.go:27-41,115-140)
 MAX_SECRET_SIZE = 10 * 1024 * 1024
+MIN_SECRET_SIZE = 10
 _SKIP_EXTS = {
     ".jpg", ".png", ".gif", ".doc", ".pdf", ".bin", ".svg", ".socket",
     ".deb", ".rpm", ".zip", ".gz", ".gzip", ".tar", ".pyc",
 }
+_SKIP_FILES = {"go.mod", "go.sum", "package-lock.json", "yarn.lock",
+               "pnpm-lock.yaml", "Pipfile.lock", "Gemfile.lock"}
+_SKIP_DIRS = {".git", "node_modules"}
+
+# basename of the active --secret-config: the rule file itself is never
+# scanned (secret.go:137-140)
+_secret_config_base = "trivy-secret.yaml"
+
+
+def set_secret_config_base(name: str) -> None:
+    global _secret_config_base
+    _secret_config_base = os.path.basename(name) if name else ""
 
 
 def secret_candidate(path: str, size: int) -> bool:
-    if size < 0 or size > MAX_SECRET_SIZE:
+    if size < MIN_SECRET_SIZE or size > MAX_SECRET_SIZE:
         return False
-    base = os.path.basename(path)
+    parts = path.split("/")
+    if any(d in _SKIP_DIRS for d in parts[:-1]):
+        return False
+    base = parts[-1]
+    if base in _SKIP_FILES or \
+            (_secret_config_base and base == _secret_config_base):
+        return False
     _, ext = os.path.splitext(base)
     return ext.lower() not in _SKIP_EXTS
 

@@ -30,7 +30,8 @@ _TYPE_MAP = {
 }
 
 
-def purl_for_package(pkg_type: str, pkg: T.Package) -> str:
+def purl_for_package(pkg_type: str, pkg: T.Package,
+                     os_info: T.OS | None = None) -> str:
     ptype = _TYPE_MAP.get(pkg_type, "")
     if not ptype:
         return ""
@@ -46,16 +47,33 @@ def purl_for_package(pkg_type: str, pkg: T.Package) -> str:
         namespace, name = name.rsplit("/", 1)
     elif ptype == "maven" and ":" in name:
         namespace, name = name.split(":", 1)
-    version = pkg.format_version() or pkg.version
+    if ptype == "pypi":
+        # purl spec: PyPI names lowercase with '_' replaced by '-'
+        # (reference purl.go purlType TypePyPi handling)
+        name = name.lower().replace("_", "-")
+    if ptype in ("deb", "rpm", "apk"):
+        # OS purl versions carry epoch as a qualifier, not a prefix
+        # (purl.go: version-release; e.g. openssl-libs@1.0.2k-16.el7
+        # ?epoch=1 in centos-7.json.golden)
+        version = pkg.version + (f"-{pkg.release}" if pkg.release else "")
+    else:
+        version = pkg.version
     parts = ["pkg:", ptype, "/"]
     if namespace:
         parts.append(quote(namespace, safe="/") + "/")
     parts.append(quote(name, safe=""))
     if version:
         parts.append("@" + quote(version, safe=""))
+    # qualifiers in purl canonical (alphabetical) order:
+    # arch < distro < epoch
     quals = []
     if pkg.arch:
         quals.append(f"arch={pkg.arch}")
+    if os_info is not None and os_info.detected and os_info.name:
+        if ptype == "apk":
+            quals.append(f"distro={os_info.name}")
+        else:
+            quals.append(f"distro={os_info.family}-{os_info.name}")
     if pkg.epoch:
         quals.append(f"epoch={pkg.epoch}")
     if quals:

@@ -21,6 +21,10 @@ def build_report(artifact_name: str, artifact_type: str,
     metadata = metadata or T.Metadata()
     if os_info is not None and os_info.detected:
         metadata.os = os_info
+    if not metadata.image_config:
+        # non-image artifacts still carry the zero v1.ConfigFile
+        # (Go struct marshal; see types.ZERO_IMAGE_CONFIG)
+        metadata.image_config = dict(T.ZERO_IMAGE_CONFIG)
     return T.Report(
         schema_version=2,
         created_at=created_at,

@@ -38,7 +38,8 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
     for comp in components:
         ctype = comp.get("type", "")
         props = _props(comp)
-        if ctype == "operating_system":
+        if ctype in ("operating_system", "operating-system"):
+            # CycloneDX JSON spells the type with a hyphen
             detail.os = T.OS(family=comp.get("name", ""),
                              name=comp.get("version", ""))
             continue
@@ -51,6 +52,8 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
             continue
         if ctype != "library":
             continue
+        purl = comp.get("purl", "")
+        purl_type, purl_quals = _purl_parts(purl)
         pkg = T.Package(
             name=comp.get("name", ""),
             version=comp.get("version", ""),
@@ -60,18 +63,33 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
             src_epoch=int(props.get("SrcEpoch", "0") or 0),
             release=props.get("PkgRelease", ""),
             file_path=props.get("FilePath", ""),
-            identifier=T.PkgIdentifier(purl=comp.get("purl", "")),
+            arch=purl_quals.get("arch", ""),
+            epoch=int(purl_quals.get("epoch", "0") or 0),
+            identifier=T.PkgIdentifier(purl=_canonical_purl(purl),
+                                       bom_ref=comp.get("bom-ref", "")),
         )
+        ptype = props.get("PkgType", "")
+        if not ptype:
+            # trivy BOMs for OS packages carry no PkgType property — the
+            # purl type + the operating-system component determine it
+            # (reference pkg/sbom/cyclonedx/unmarshal.go pkgType via
+            # purl; apps fall back to the purl's lang type)
+            ptype = _PURL_TO_TYPE.get(purl_type, purl_type)
         if comp.get("group"):
             pkg.name = f"{comp['group']}/{pkg.name}" \
-                if props.get("PkgType") in ("npm", "composer", "gomod") \
+                if ptype in ("npm", "composer", "gomod", "node-pkg",
+                             "gobinary") \
                 else f"{comp['group']}:{pkg.name}"
-        pkg.id = f"{pkg.name}@{pkg.version}"
-        ptype = props.get("PkgType", "")
         if ptype in OS_PKG_TYPES:
+            if ptype in ("rpm", "deb", "apk") and "-" in pkg.version \
+                    and not pkg.release:
+                # OS purl versions are version-release joined
+                pkg.version, pkg.release = pkg.version.rsplit("-", 1)
+            pkg.id = props.get("PkgID") or f"{pkg.name}@{pkg.version}"
             os_type = os_type or ptype
             os_pkgs.append(pkg)
         else:
+            pkg.id = props.get("PkgID") or f"{pkg.name}@{pkg.version}"
             key = props.get("FilePath", "") or ptype
             app = apps.setdefault(key, T.Application(
                 type=ptype or "unknown", file_path=props.get("FilePath", "")))
@@ -80,6 +98,42 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
     detail.packages = os_pkgs
     detail.applications = [a for a in apps.values() if a.packages]
     return detail
+
+
+def _canonical_purl(purl: str) -> str:
+    """Re-emit a purl with qualifiers in canonical (sorted) order — the
+    reference parses BOM purls into packageurl structs and re-marshals
+    them, which sorts qualifiers (packageurl-go ToString)."""
+    if "?" not in purl:
+        return purl
+    body, q = purl.split("?", 1)
+    quals = sorted(kv.partition("=")[::2] for kv in q.split("&") if kv)
+    return body + "?" + "&".join(f"{k}={v}" for k, v in quals)
+
+
+def _purl_parts(purl: str) -> tuple[str, dict]:
+    """→ (purl type, qualifiers dict)."""
+    if not purl.startswith("pkg:"):
+        return "", {}
+    body = purl[4:]
+    quals: dict = {}
+    if "?" in body:
+        body, q = body.split("?", 1)
+        for kv in q.split("&"):
+            k, _, v = kv.partition("=")
+            quals[k] = v
+    return body.split("/", 1)[0], quals
+
+
+# purl type → package type when no explicit property exists; OS purls
+# (rpm/deb/apk) resolve to the concrete distro via the purl namespace
+# handled by OS_PKG_TYPES membership, lang purls to individual-package
+# analyzers (reference pkg/purl/purl.go Class + LangType)
+_PURL_TO_TYPE = {
+    "pypi": "python-pkg", "npm": "node-pkg", "gem": "gemspec",
+    "golang": "gobinary", "maven": "jar", "cargo": "rustbinary",
+    "conda": "conda-pkg", "nuget": "nuget", "composer": "composer",
+}
 
 
 OS_PKG_TYPES = {"alpine", "apk", "debian", "ubuntu", "redhat", "centos",

@@ -42,6 +42,11 @@ class LocalScanner:
                                f"(artifact {artifact_id})")
             blobs.append(blob)
         detail = apply_layers(blobs)
+        # dev dependencies are removed unless --include-dev-deps
+        # (reference local/scan.go:109-111 excludeDevDeps)
+        if not options.include_dev_deps:
+            for app in detail.applications:
+                app.packages = [p for p in app.packages if not p.dev]
         results: list[T.Result] = []
         os_info = detail.os
 
@@ -144,4 +149,8 @@ PKG_TARGETS = {
 
 
 def _vuln_sort_key(v: T.DetectedVulnerability):
-    return (v.pkg_name, v.pkg_path, v.vulnerability_id, v.installed_version)
+    """(pkg name, installed version, severity desc, vuln id, pkg path) —
+    reference types.BySeverity (pkg/types/vulnerability.go:42-58)."""
+    sev = T.SEVERITIES.index(v.severity) if v.severity in T.SEVERITIES else 0
+    return (v.pkg_name, v.installed_version, -sev, v.vulnerability_id,
+            v.pkg_path)

@@ -330,3 +330,44 @@ def _build() -> list[Rule]:
 
 
 BUILTIN_RULES: list[Rule] = _build()
+
+
+def load_secret_config(path: str):
+    """trivy-secret.yaml → (rules, global_allow_rules). Schema mirrors
+    the reference secret.Config (pkg/fanal/secret/scanner.go:27-40):
+    enable-builtin-rules restricts the builtin set, disable-rules and
+    disable-allow-rules remove by id, `rules` / `allow-rules` append
+    custom entries."""
+    import yaml
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    rules = list(BUILTIN_RULES)
+    enable = doc.get("enable-builtin-rules") or []
+    if enable:
+        keep = set(enable)
+        rules = [r for r in rules if r.id in keep]
+    disable = set(doc.get("disable-rules") or [])
+    rules = [r for r in rules if r.id not in disable]
+    for rd in doc.get("rules") or []:
+        rules.append(Rule(
+            id=rd.get("id", ""), category=rd.get("category", ""),
+            title=rd.get("title", ""), severity=rd.get("severity", ""),
+            regex=re.compile(_scope_flags(rd.get("regex", ""))),
+            keywords=list(rd.get("keywords") or []),
+            secret_group=rd.get("secret-group-name", ""),
+            path=re.compile(rd["path"]) if rd.get("path") else None,
+            allow_rules=[_allow_from_dict(a)
+                         for a in rd.get("allow-rules") or []],
+        ))
+    allow = list(GLOBAL_ALLOW_RULES)
+    disable_allow = set(doc.get("disable-allow-rules") or [])
+    allow = [a for a in allow if a.id not in disable_allow]
+    allow.extend(_allow_from_dict(a) for a in doc.get("allow-rules") or [])
+    return rules, allow
+
+
+def _allow_from_dict(a: dict) -> AllowRule:
+    return AllowRule(
+        a.get("id", ""), a.get("description", ""),
+        regex=re.compile(a["regex"]) if a.get("regex") else None,
+        path=re.compile(a["path"]) if a.get("path") else None)

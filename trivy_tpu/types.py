@@ -98,6 +98,8 @@ def _strip_empty(v: Any) -> Any:
         return out
     if isinstance(v, (list, tuple)):
         return [_strip_empty(x) for x in v]
+    if isinstance(v, float) and v.is_integer():
+        return int(v)  # Go marshals float64(5) as "5", not "5.0"
     return v
 
 
@@ -105,6 +107,7 @@ class JsonMixin:
     _json_names: dict = {}
     _keep_zero: tuple = ()  # fields serialized even when zero (no omitempty)
     _json_skip: tuple = ()  # fields never serialized (Go `json:"-"`)
+    _json_raw: tuple = ()   # fields emitted verbatim (no zero-stripping)
 
     def to_json(self) -> dict:
         out = {}
@@ -113,7 +116,7 @@ class JsonMixin:
                 continue
             v = getattr(self, f.name)
             name = self._json_names.get(f.name, _pascal(f.name))
-            sv = _strip_empty(v)
+            sv = v if f.name in self._json_raw else _strip_empty(v)
             if f.name in self._keep_zero:
                 out[name] = sv
                 continue
@@ -216,6 +219,8 @@ class Package(JsonMixin):
     _json_skip = ("build_info",)
     _json_names = {"id": "ID", "src_name": "SrcName", "src_version": "SrcVersion",
                    "src_release": "SrcRelease", "src_epoch": "SrcEpoch"}
+    # non-pointer structs: always marshaled by Go (see DetectedVulnerability)
+    _keep_zero = ("identifier", "layer")
 
     def format_version(self) -> str:
         """epoch:version-release (reference pkg/scanner/utils/util.go FormatVersion)."""
@@ -268,8 +273,8 @@ class CodeLine(JsonMixin):
     last_cause: bool = False
     _json_names = {"is_cause": "IsCause", "first_cause": "FirstCause",
                    "last_cause": "LastCause"}
-    _keep_zero = ("number", "content", "is_cause", "truncated",
-                  "first_cause", "last_cause")
+    _keep_zero = ("number", "content", "is_cause", "annotation",
+                  "truncated", "first_cause", "last_cause")
 
 
 @dataclass
@@ -285,7 +290,7 @@ class SecretFinding(JsonMixin):
     layer: Layer = field(default_factory=Layer)
     _json_names = {"rule_id": "RuleID"}
     _keep_zero = ("rule_id", "category", "severity", "title",
-                  "start_line", "end_line", "code", "match")
+                  "start_line", "end_line", "code", "match", "layer")
 
 
 @dataclass
@@ -420,6 +425,9 @@ class DetectedVulnerability(JsonMixin):
     _json_names = {"vulnerability_id": "VulnerabilityID", "vendor_ids": "VendorIDs",
                    "pkg_id": "PkgID", "pkg_name": "PkgName", "pkg_path": "PkgPath",
                    "primary_url": "PrimaryURL", "severity_source": "SeveritySource"}
+    # PkgIdentifier/Layer are non-pointer structs in the reference: Go
+    # omitempty never elides them (npm.json.golden shows "Layer": {})
+    _keep_zero = ("pkg_identifier", "layer")
 
     def to_json(self) -> dict:
         out = super().to_json()
@@ -520,6 +528,24 @@ class Metadata(JsonMixin):
     repo_digests: list = field(default_factory=list)
     image_config: dict = field(default_factory=dict)
     _json_names = {"os": "OS", "image_id": "ImageID", "diff_ids": "DiffIDs"}
+    # ImageConfig is a non-pointer struct in the reference
+    # (types.Metadata → v1.ConfigFile): Go's omitempty never drops it,
+    # so every report carries at least the zero config. Raw passthrough:
+    # the stored dict is the image's own config JSON.
+    _keep_zero = ("image_config",)
+    _json_raw = ("image_config",)
+
+
+# Marshal of the go-containerregistry v1.ConfigFile zero value — what
+# the reference emits as Metadata.ImageConfig for non-image artifacts
+# (fs/repo/sbom reports; see integration/testdata/npm.json.golden).
+ZERO_IMAGE_CONFIG = {
+    "architecture": "",
+    "created": "0001-01-01T00:00:00Z",
+    "os": "",
+    "rootfs": {"type": "", "diff_ids": None},
+    "config": {},
+}
 
 
 @dataclass
@@ -540,6 +566,7 @@ class ScanOptions:
     scanners: tuple = (Scanner.VULN,)
     scan_removed_packages: bool = False
     list_all_packages: bool = False
+    include_dev_deps: bool = False
 
 
 @dataclass
