@@ -1,0 +1,276 @@
+"""Constraint grammar: interval parsing, maven bracket ranges, the
+full-grammar host evaluator, and the no-silent-misparse guarantee.
+
+The round-3 verdict proved a missed CVE (CVE-2021-20190) caused by the
+maven range "[2.9.0,2.9.10.7)" being silently split on commas into a
+garbage exact match. These tests pin the fixed behavior: every grammar
+is either parsed exactly into intervals or raises ConstraintError (→
+catch-all INEXACT row + raw host evaluation); nothing is ever silently
+mangled or dropped.
+"""
+
+import glob
+import os
+
+import pytest
+
+from trivy_tpu.db.constraints import (
+    ConstraintError, Interval, eval_constraint, parse_constraint)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+# ---- interval grammar --------------------------------------------------
+
+def test_operator_conjunction():
+    (iv,) = parse_constraint(">=1.2.0, <2.0.0")
+    assert iv == Interval("1.2.0", True, "2.0.0", False)
+
+
+def test_operator_space_separated():
+    (iv,) = parse_constraint(">= 1.2 < 2.0")
+    assert iv == Interval("1.2", True, "2.0", False)
+
+
+def test_or_branches():
+    ivs = parse_constraint("<1.0 || >=2.0, <2.5")
+    assert ivs == [Interval(None, False, "1.0", False),
+                   Interval("2.0", True, "2.5", False)]
+
+
+def test_bare_version_equality():
+    (iv,) = parse_constraint("1.2.3")
+    assert iv == Interval("1.2.3", True, "1.2.3", True)
+
+
+def test_maven_halfopen_range():
+    """The CVE-2021-20190 grammar: [2.9.0,2.9.10.7)."""
+    (iv,) = parse_constraint("[2.9.0,2.9.10.7)")
+    assert iv == Interval("2.9.0", True, "2.9.10.7", False)
+
+
+def test_maven_open_low_range():
+    (iv,) = parse_constraint("(,1.9.5]")
+    assert iv == Interval(None, False, "1.9.5", True)
+
+
+def test_maven_range_list_is_union():
+    """(,1.0],[1.2,) — every bracket group is one OR'd interval
+    (go-mvn-version range lists, maven/compare.go:20-31)."""
+    ivs = parse_constraint("(,1.0],[1.2,)")
+    assert ivs == [Interval(None, False, "1.0", True),
+                   Interval("1.2", True, None, False)]
+
+
+def test_maven_exact_bracket():
+    (iv,) = parse_constraint("[1.0.2]")
+    assert iv == Interval("1.0.2", True, "1.0.2", True)
+
+
+def test_maven_unbounded_high():
+    (iv,) = parse_constraint("[3.0.0,)")
+    assert iv == Interval("3.0.0", True, None, False)
+
+
+# ---- everything else must RAISE, never mangle --------------------------
+
+@pytest.mark.parametrize("spec", [
+    "^1.2.3",            # caret
+    "~1.2.3",            # tilde
+    "~>1.2.3",           # pessimistic
+    "~=1.4.2",           # pep440 compatible release
+    "!=1.5.0",           # exclusion
+    "1.2.x",             # wildcard segment
+    "*",                 # match-all wildcard
+    ">=1.0, !=1.5",      # mixed with exclusion
+    "[1.0",              # unterminated bracket
+    "(1.0)",             # exclusive exact (empty range)
+    "1.0 ]",             # stray bracket
+    ">=",                # dangling operator
+    "< > 1.0",           # doubled operator
+    "a b c d",           # not a version list
+    "1.0 || || 2.0",     # empty member in multi-branch list
+])
+def test_unrepresentable_raises(spec):
+    with pytest.raises(ConstraintError):
+        parse_constraint(spec)
+
+
+def test_constrainterror_is_valueerror():
+    assert issubclass(ConstraintError, ValueError)
+
+
+# ---- host evaluator (full grammar) -------------------------------------
+
+@pytest.mark.parametrize("spec,version,want", [
+    ("[2.9.0,2.9.10.7)", "2.9.1", True),
+    ("[2.9.0,2.9.10.7)", "2.9.10.7", False),
+    ("[2.9.0,2.9.10.7)", "2.8.9", False),
+    ("(,1.0],[1.2,)", "0.5", True),
+    ("(,1.0],[1.2,)", "1.1", False),
+    ("(,1.0],[1.2,)", "1.3", True),
+    ("^1.2.3", "1.4.0", True),
+    ("^1.2.3", "2.0.0", False),
+    ("^0.2.3", "0.2.9", True),
+    ("^0.2.3", "0.3.0", False),
+    ("~1.2.3", "1.2.9", True),
+    ("~1.2.3", "1.3.0", False),
+    ("~>2.2.0", "2.2.5", True),
+    ("~>2.2.0", "2.3.0", False),
+    ("~=1.4.2", "1.4.9", True),
+    ("~=1.4.2", "1.5.0", False),
+    ("!=1.5.0", "1.5.0", False),
+    ("!=1.5.0", "1.5.1", True),
+    (">=1.0, !=1.5.0, <2.0", "1.4", True),
+    (">=1.0, !=1.5.0, <2.0", "1.5.0", False),
+    ("1.2.x", "1.2.9", True),
+    ("1.2.x", "1.3.0", False),
+    ("*", "0.0.1", True),
+    ("<1.0 || >=2.0", "2.1", True),
+    ("<1.0 || >=2.0", "1.5", False),
+])
+def test_eval_constraint(spec, version, want):
+    assert eval_constraint("maven", spec, version) is want
+
+
+def test_eval_constraint_empty_member_always_detects():
+    """compare.go:23-27: an empty member in the version list ⇒ detect."""
+    assert eval_constraint("npm", " || >=9.9.9", "1.0.0") is True
+
+
+# ---- fixture sweep: zero silently-dropped constraint forms -------------
+
+def _all_fixture_constraints():
+    """Every VulnerableVersions/PatchedVersions/UnaffectedVersions string
+    in every vendored fixture YAML."""
+    from trivy_tpu.db.fixtures import load_fixture_files
+    paths = sorted(glob.glob(os.path.join(HERE, "golden", "db", "*.yaml")))
+    assert len(paths) >= 28
+    advs, _, _ = load_fixture_files(paths)
+    specs = set()
+    for a in advs:
+        for s in (a.vulnerable_ranges, a.patched_versions,
+                  a.unaffected_versions):
+            if s:
+                specs.add((a.ecosystem, s))
+    assert specs
+    return sorted(specs)
+
+
+def test_fixture_constraints_roundtrip():
+    """Every constraint string in the vendored fixture corpus either
+    parses into intervals or raises ConstraintError AND is then
+    evaluable by the full host evaluator — no third state."""
+    for eco, spec in _all_fixture_constraints():
+        try:
+            ivs = parse_constraint(spec)
+        except ConstraintError:
+            # must still be evaluable host-side (any version will do;
+            # version-compare errors are fine, grammar errors are not)
+            try:
+                eval_constraint(eco, spec, "1.0.0")
+            except ConstraintError as e:  # pragma: no cover
+                raise AssertionError(
+                    f"{spec!r} ({eco}): rejected by BOTH the interval "
+                    f"parser and the host evaluator: {e}")
+            continue
+        # interval path: bounds must be clean version literals
+        for iv in ivs:
+            for bound in (iv.lo, iv.hi):
+                assert bound is None or not any(
+                    c in bound for c in "[]()<>=!, "), \
+                    f"{spec!r} ({eco}): mangled bound {bound!r}"
+
+
+def test_fixture_constraints_device_vs_host_agree():
+    """For every interval-representable fixture constraint, the interval
+    semantics and the full host evaluator agree on the fixture corpus's
+    own boundary versions (lo, hi, and the bounds themselves)."""
+    from trivy_tpu import version as V
+
+    checked = 0
+    for eco, spec in _all_fixture_constraints():
+        try:
+            ivs = parse_constraint(spec)
+        except ConstraintError:
+            continue
+        probes = {b for iv in ivs for b in (iv.lo, iv.hi) if b}
+        for probe in probes:
+            def in_iv(iv):
+                ok = True
+                try:
+                    if iv.lo is not None:
+                        c = V.compare(eco, iv.lo, probe)
+                        ok &= c < 0 or (iv.lo_incl and c == 0)
+                    if ok and iv.hi is not None:
+                        c = V.compare(eco, probe, iv.hi)
+                        ok &= c < 0 or (iv.hi_incl and c == 0)
+                except (ValueError, KeyError):
+                    return None
+                return ok
+            states = [in_iv(iv) for iv in ivs]
+            if None in states:
+                continue
+            want = any(states)
+            try:
+                got = eval_constraint(eco, spec, probe)
+            except (ValueError, KeyError):
+                continue
+            assert got == want, (spec, eco, probe)
+            checked += 1
+    assert checked > 50
+
+
+# ---- end-to-end: raw fallback path through the detector ----------------
+
+def _detect_one(eco, source, spec, version, patched=""):
+    from trivy_tpu.db.table import RawAdvisory, build_table
+    from trivy_tpu.detect.engine import BatchDetector, PkgQuery
+    table = build_table([RawAdvisory(
+        source=source, ecosystem=eco, pkg_name="libfoo",
+        vuln_id="CVE-2099-0001", vulnerable_ranges=spec,
+        patched_versions=patched)])
+    det = BatchDetector(table)
+    return det.detect([PkgQuery(source=source, ecosystem=eco,
+                                name="libfoo", version=version)])
+
+
+def test_detector_maven_bracket_range_hits():
+    hits = _detect_one("maven", "maven::GitLab Advisory Database",
+                       "[2.9.0,2.9.10.7)", "2.9.1")
+    assert [h.vuln_id for h in hits] == ["CVE-2099-0001"]
+
+
+def test_detector_maven_bracket_range_fixed_version_misses():
+    assert _detect_one("maven", "maven::GitLab Advisory Database",
+                       "[2.9.0,2.9.10.7)", "2.9.10.7") == []
+
+
+def test_detector_caret_goes_through_raw_fallback():
+    """^-ranges aren't interval-representable: the advisory must still
+    be detected via the catch-all INEXACT row + raw host evaluation."""
+    from trivy_tpu.db.table import RawAdvisory, build_table
+    table = build_table([RawAdvisory(
+        source="npm::x", ecosystem="npm", pkg_name="libfoo",
+        vuln_id="CVE-2099-0001", vulnerable_ranges="^1.2.0")])
+    assert table.groups[0].raw_specs is not None
+    hits = _detect_one("npm", "npm::x", "^1.2.0", "1.5.0")
+    assert [h.vuln_id for h in hits] == ["CVE-2099-0001"]
+    assert _detect_one("npm", "npm::x", "^1.2.0", "2.0.0") == []
+
+
+def test_detector_raw_fallback_respects_patched():
+    hits = _detect_one("npm", "npm::x", "^1.2.0", "1.5.0",
+                       patched="^1.4.9")
+    assert hits == []
+
+
+def test_raw_specs_survive_save_load(tmp_path):
+    from trivy_tpu.db.table import RawAdvisory, build_table, AdvisoryTable
+    table = build_table([RawAdvisory(
+        source="npm::x", ecosystem="npm", pkg_name="libfoo",
+        vuln_id="CVE-2099-0001", vulnerable_ranges="~1.2.0")])
+    p = str(tmp_path / "t.npz")
+    table.save(p)
+    loaded = AdvisoryTable.load(p)
+    assert loaded.groups[0].raw_specs == ("~1.2.0", "", "")

@@ -126,6 +126,16 @@ def test_golden_gomod_repo(tmp_path):
     assert_zero_diff(got, read_golden("gomod.json.golden"))
 
 
+def test_golden_pom(tmp_path):
+    """repo scan of the maven pom fixture == pom.json.golden
+    (reference repo_test.go "pom"; exercises the maven interval-range
+    grammar "[2.9.0,2.9.10.7)" → CVE-2021-20190 on jackson-databind)."""
+    got = run_cli(["repo", os.path.join(GOLD, "inputs", "pom"),
+                   "--db", DB_GLOB, "--format", "json",
+                   "--cache-dir", str(tmp_path)], tmp_path)
+    assert_zero_diff(got, read_golden("pom.json.golden"))
+
+
 def test_golden_secrets_repo(tmp_path):
     """custom + disabled rules via --secret-config == secrets.json.golden."""
     got = run_cli(["repo", os.path.join(GOLD, "inputs", "secrets"),

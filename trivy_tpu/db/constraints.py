@@ -1,15 +1,25 @@
-"""Version-range constraint parsing → interval rows.
+"""Version-range constraint parsing → interval rows + host evaluator.
 
 The reference's generic comparer (pkg/detector/library/compare/compare.go:
 21-55) joins constraint sets with "||" (OR); each branch is a
-comma/space-separated conjunction of ``(op, version)`` terms. OS advisories
-are a special case: FixedVersion ⇒ ``< fixed``, AffectedVersion ⇒
-``>= affected``.
+comma/space-separated conjunction of ``(op, version)`` terms. Maven
+advisories instead use bracket *range lists* — ``[2.9.0,2.9.10.7)`` or
+``(,1.0],[1.2,)`` — where every bracket group is a union member
+(pkg/detector/library/compare/maven/compare.go:20-31 via go-mvn-version).
+OS advisories are a special case: FixedVersion ⇒ ``< fixed``,
+AffectedVersion ⇒ ``>= affected``.
 
 Intervals are half-open/closed bounds: (lo, lo_incl, hi, hi_incl) with None
-meaning unbounded. An OR of conjunctions maps to one interval row per
-branch; rows for "patched"/"unaffected" sets are emitted with negative
-polarity and subtracted host-side during assembly.
+meaning unbounded. An OR of branches maps to one interval row per branch
+(bracket ranges contribute one row each).
+
+Anything the interval grammar does not recognise — caret/tilde/pessimistic
+operators, ``!=``, wildcard segments (``1.2.x``), malformed syntax —
+raises :class:`ConstraintError`. Callers (db.table.build_table) turn that
+into a catch-all INEXACT row so the pair is host-rechecked through
+:func:`eval_constraint`, which implements the full grammar. A constraint
+is therefore either represented exactly on device or evaluated exactly on
+host — never silently mangled.
 """
 
 from __future__ import annotations
@@ -17,6 +27,10 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass
 from typing import Optional
+
+
+class ConstraintError(ValueError):
+    """Constraint grammar not representable (or not recognised at all)."""
 
 
 @dataclass
@@ -27,52 +41,303 @@ class Interval:
     hi_incl: bool = False
 
 
-_TERM = re.compile(r"^(>=|<=|==|!=|>|<|=|\^|~>?)?\s*(.+)$")
+# operators the interval grammar accepts directly; order matters (longest
+# first). =< / => are go-version aliases (aquasecurity/go-version
+# constraint.go operator table).
+_OPS_INTERVAL = (">=", "=>", "<=", "=<", "==", ">", "<", "=")
+# operators recognised by the host evaluator only
+_OPS_EVAL = ("!=", "~>", "~=", "~", "^")
+_OP_RE = re.compile(
+    "^(" + "|".join(re.escape(o) for o in _OPS_EVAL + _OPS_INTERVAL) + ")"
+)
+
+# a version literal: no brackets/braces/comparators/commas/whitespace.
+# Letters, digits, dot, dash, underscore, plus, tilde (deb), colon
+# (epoch), bang (pep440 epoch), star (wildcard — screened separately).
+_VERSION_RE = re.compile(r"^[0-9A-Za-z*][0-9A-Za-z._+~:!*-]*$")
+
+# one maven bracket group: "[lo,hi)" / "(,hi]" / "[exact]"
+_BRACKET_RE = re.compile(
+    r"\s*([\[\(])\s*([^,\[\]\(\)\s]*)\s*"
+    r"(?:(,)\s*([^,\[\]\(\)\s]*)\s*)?([\]\)])\s*(,?)"
+)
+
+
+def _is_wildcard_version(ver: str) -> bool:
+    """go-version wildcard segments: a release segment that is exactly
+    ``x``/``X``/``*`` (constraint grammar, not a literal version)."""
+    if "*" in ver:
+        return True
+    release = re.split(r"[-+]", ver, 1)[0]
+    return any(seg in ("x", "X") for seg in release.split("."))
+
+
+def _check_version(ver: str, spec: str) -> str:
+    if not _VERSION_RE.match(ver):
+        raise ConstraintError(f"malformed version {ver!r} in {spec!r}")
+    return ver
+
+
+def _parse_bracket_branch(branch: str, spec: str) -> list[Interval]:
+    """Maven range list: every bracket group is one OR'd interval."""
+    out: list[Interval] = []
+    pos = 0
+    while pos < len(branch):
+        m = _BRACKET_RE.match(branch, pos)
+        if not m:
+            raise ConstraintError(f"malformed range syntax in {spec!r}")
+        open_b, lo, comma, hi, close_b, _sep = m.groups()
+        if not comma:
+            # single-version form "[1.0]": exact match; "(1.0)" is empty
+            if open_b != "[" or close_b != "]" or not lo:
+                raise ConstraintError(f"malformed range in {spec!r}")
+            v = _check_version(lo, spec)
+            out.append(Interval(lo=v, lo_incl=True, hi=v, hi_incl=True))
+        else:
+            iv = Interval()
+            if lo:
+                iv.lo = _check_version(lo, spec)
+                iv.lo_incl = open_b == "["
+            if hi:
+                iv.hi = _check_version(hi, spec)
+                iv.hi_incl = close_b == "]"
+            out.append(iv)
+        pos = m.end()
+    if not out:
+        raise ConstraintError(f"empty range list in {spec!r}")
+    return out
+
+
+def _split_terms(branch: str, spec: str) -> list[tuple[str, str]]:
+    """Split an operator branch into (op, version) terms.
+
+    Terms are separated by commas and/or whitespace; an operator may be
+    separated from its version by whitespace ("< 1.2")."""
+    raw = [t for t in re.split(r"[,\s]+", branch) if t]
+    terms: list[tuple[str, str]] = []
+    i = 0
+    while i < len(raw):
+        t = raw[i]
+        m = _OP_RE.match(t)
+        op = m.group(1) if m else "="
+        ver = t[m.end():].strip() if m else t
+        if not ver:
+            if i + 1 >= len(raw):
+                raise ConstraintError(f"dangling operator in {spec!r}")
+            ver = raw[i + 1]
+            i += 1
+        if _OP_RE.match(ver):
+            raise ConstraintError(f"doubled operator in {spec!r}")
+        terms.append((op, _check_version(ver, spec)))
+        i += 1
+    return terms
 
 
 def parse_constraint(spec: str) -> list[Interval]:
     """Parse a constraint-set string into OR'd intervals.
 
-    Supports the operator grammar trivy-db data uses: ``>=``, ``>``, ``<=``,
-    ``<``, ``=``/``==``, bare version (equality). ``^``/``~`` (caret/tilde
-    ranges) and ``!=`` are not representable as a single interval and raise.
+    Supports the operator grammar trivy-db data uses — ``>=``, ``>``,
+    ``<=``, ``<``, ``=``/``==``, bare version (equality) — plus maven
+    bracket range lists (``[a,b)``, ``(,b]``, ``[exact]``; each group one
+    OR'd interval). ``^``/``~``/``~>``/``~=``/``!=`` and wildcard
+    segments are not representable as plain intervals and raise
+    :class:`ConstraintError` (host fallback via :func:`eval_constraint`).
     """
-    out = []
-    for branch in spec.split("||"):
+    out: list[Interval] = []
+    branches = spec.split("||")
+    for branch in branches:
         branch = branch.strip()
         if not branch:
+            if len(branches) == 1:
+                continue
+            # reference IsVulnerable (compare.go:23-27): an empty member
+            # in a version list means "always detect", bypassing the
+            # patched subtraction — not interval-representable
+            raise ConstraintError(f"empty member in {spec!r}")
+        if branch[0] in "[(" and (")" in branch or "]" in branch):
+            out.extend(_parse_bracket_branch(branch, spec))
             continue
+        if any(c in branch for c in "[]()|"):
+            raise ConstraintError(f"malformed constraint {spec!r}")
         iv = Interval()
-        # conjunction terms separated by commas and/or whitespace, but
-        # versions may contain spaces only when quoted (they don't in trivy-db)
-        terms = [t for t in re.split(r"[,\s]+", branch) if t]
-        # re-join operator split from its version ("< 1.2" → "<", "1.2")
-        merged, i = [], 0
-        while i < len(terms):
-            t = terms[i]
-            if t in (">=", "<=", ">", "<", "=", "==", "!="):
-                if i + 1 >= len(terms):
-                    raise ValueError(f"dangling operator in {spec!r}")
-                merged.append(t + terms[i + 1])
-                i += 2
-            else:
-                merged.append(t)
-                i += 1
-        for term in merged:
-            m = _TERM.match(term)
-            op, ver = m.group(1) or "=", m.group(2).strip()
-            if op in ("^", "~", "~>", "!="):
-                raise ValueError(f"unsupported operator {op!r} in {spec!r}")
+        for op, ver in _split_terms(branch, spec):
+            if op in _OPS_EVAL or _is_wildcard_version(ver):
+                raise ConstraintError(
+                    f"operator {op!r} / wildcard not interval-representable"
+                    f" in {spec!r}")
+            # a second bound on the same side would silently overwrite
+            # (">=1.5, >=1.0" must intersect, not last-write-win): the
+            # host evaluator handles term-by-term conjunctions exactly
             if op == ">":
+                if iv.lo is not None:
+                    raise ConstraintError(f"duplicate lower bound {spec!r}")
                 iv.lo, iv.lo_incl = ver, False
-            elif op == ">=":
+            elif op in (">=", "=>"):
+                if iv.lo is not None:
+                    raise ConstraintError(f"duplicate lower bound {spec!r}")
                 iv.lo, iv.lo_incl = ver, True
             elif op == "<":
+                if iv.hi is not None:
+                    raise ConstraintError(f"duplicate upper bound {spec!r}")
                 iv.hi, iv.hi_incl = ver, False
-            elif op == "<=":
+            elif op in ("<=", "=<"):
+                if iv.hi is not None:
+                    raise ConstraintError(f"duplicate upper bound {spec!r}")
                 iv.hi, iv.hi_incl = ver, True
             else:  # = / ==
+                if iv.lo is not None or iv.hi is not None:
+                    raise ConstraintError(f"equality conflict in {spec!r}")
                 iv.lo, iv.lo_incl = ver, True
                 iv.hi, iv.hi_incl = ver, True
         out.append(iv)
     return out
+
+
+# ---- host evaluator (full grammar) -----------------------------------
+
+
+def _bump_release(ver: str, index: int) -> str:
+    """Version with release segment ``index`` incremented and the rest
+    dropped: _bump_release("1.2.3", 1) == "1.3"."""
+    release = re.split(r"[-+]", ver, 1)[0]
+    segs = release.split(".")
+    while len(segs) <= index:
+        segs.append("0")
+    try:
+        segs[index] = str(int(segs[index]) + 1)
+    except ValueError:
+        raise ConstraintError(f"non-numeric segment in {ver!r}")
+    return ".".join(segs[: index + 1])
+
+
+def _wildcard_interval(ver: str) -> Interval:
+    """``1.2.x`` / ``1.2.*`` → [1.2, 1.3). A bare ``*`` matches all."""
+    release = re.split(r"[-+]", ver, 1)[0]
+    segs = release.split(".")
+    fixed = []
+    for seg in segs:
+        if seg in ("x", "X", "*"):
+            break
+        fixed.append(seg)
+    if not fixed:
+        return Interval()
+    lo = ".".join(fixed)
+    return Interval(lo=lo, lo_incl=True,
+                    hi=_bump_release(lo, len(fixed) - 1), hi_incl=False)
+
+
+def _caret_interval(ver: str) -> Interval:
+    """npm caret: bump at the leftmost non-zero release segment
+    (go-npm-version / node-semver ^): ^1.2.3→<2.0.0, ^0.2.3→<0.3.0."""
+    release = re.split(r"[-+]", ver, 1)[0]
+    segs = release.split(".")
+    idx = 0
+    for i, seg in enumerate(segs):
+        try:
+            n = int(seg)
+        except ValueError:
+            break
+        if n != 0:
+            idx = i
+            break
+    else:
+        idx = len(segs) - 1
+    return Interval(lo=ver, lo_incl=True,
+                    hi=_bump_release(ver, idx), hi_incl=False)
+
+
+def _tilde_interval(op: str, ver: str) -> Interval:
+    """``~1.2.3``→[1.2.3,1.3); ``~1``→[1,2); ``~>``/``~=`` (pessimistic /
+    pep440 compatible-release): bump the second-to-last given segment."""
+    release = re.split(r"[-+]", ver, 1)[0]
+    segs = release.split(".")
+    if op == "~":
+        idx = 1 if len(segs) >= 2 else 0
+    else:
+        if len(segs) < 2:
+            raise ConstraintError(f"{op}{ver}: needs two segments")
+        idx = len(segs) - 2
+    return Interval(lo=ver, lo_incl=True,
+                    hi=_bump_release(ver, idx), hi_incl=False)
+
+
+def _in_interval(eco: str, iv: Interval, version: str, compare) -> bool:
+    ok = True
+    if iv.lo is not None:
+        c = compare(eco, iv.lo, version)
+        ok &= c < 0 or (iv.lo_incl and c == 0)
+    if ok and iv.hi is not None:
+        c = compare(eco, version, iv.hi)
+        ok &= c < 0 or (iv.hi_incl and c == 0)
+    return ok
+
+
+def eval_constraint(ecosystem: str, spec: str, version: str) -> bool:
+    """Evaluate the FULL constraint grammar against ``version`` host-side.
+
+    Covers everything :func:`parse_constraint` does plus ``!=``, caret,
+    tilde/pessimistic/compatible-release operators and wildcard segments.
+    Raises :class:`ConstraintError` on grammar it cannot interpret and
+    ValueError on unparseable versions — callers mirror the reference's
+    warn-and-no-match (compare.go:33-38).
+    """
+    from .. import version as V
+    compare = V.compare
+    branches = spec.split("||")
+    for branch in branches:
+        branch = branch.strip()
+        if not branch:
+            if len(branches) == 1:
+                continue
+            return True  # empty member ⇒ always detect (compare.go:23-27)
+        if branch[0] in "[(" and (")" in branch or "]" in branch):
+            if any(_in_interval(ecosystem, iv, version, compare)
+                   for iv in _parse_bracket_branch(branch, spec)):
+                return True
+            continue
+        if any(c in branch for c in "[]()|"):
+            raise ConstraintError(f"malformed constraint {spec!r}")
+        ok = True
+        for op, ver in _split_terms(branch, spec):
+            if not ok:
+                break
+            if op == "!=":
+                ok &= compare(ecosystem, ver, version) != 0
+            elif op == "^":
+                ok &= _in_interval(ecosystem, _caret_interval(ver),
+                                   version, compare)
+            elif op in ("~", "~>", "~="):
+                ok &= _in_interval(ecosystem, _tilde_interval(op, ver),
+                                   version, compare)
+            elif _is_wildcard_version(ver):
+                if op in ("=", "=="):
+                    ok &= _in_interval(ecosystem, _wildcard_interval(ver),
+                                       version, compare)
+                else:
+                    # ">= 1.x" etc.: strip wildcard tail, compare release
+                    base = _wildcard_interval(ver).lo
+                    if base is None:
+                        continue  # "* " — no bound
+                    iv = Interval()
+                    if op in (">", ">=", "=>"):
+                        iv.lo, iv.lo_incl = base, op != ">"
+                    else:
+                        iv.hi, iv.hi_incl = base, op in ("<=", "=<")
+                    ok &= _in_interval(ecosystem, iv, version, compare)
+            else:
+                iv = Interval()
+                if op == ">":
+                    iv.lo, iv.lo_incl = ver, False
+                elif op in (">=", "=>"):
+                    iv.lo, iv.lo_incl = ver, True
+                elif op == "<":
+                    iv.hi, iv.hi_incl = ver, False
+                elif op in ("<=", "=<"):
+                    iv.hi, iv.hi_incl = ver, True
+                else:
+                    iv = Interval(lo=ver, lo_incl=True,
+                                  hi=ver, hi_incl=True)
+                ok &= _in_interval(ecosystem, iv, version, compare)
+        if ok:
+            return True
+    return False

@@ -27,7 +27,7 @@ import numpy as np
 from .. import version as V
 from ..ops import join as J
 from ..ops.hashing import key_hash, split_u64
-from .constraints import Interval, parse_constraint
+from .constraints import ConstraintError, Interval, parse_constraint
 
 KEY_WIDTH = V.KEY_WIDTH
 
@@ -68,6 +68,11 @@ class AdvisoryGroup:
     cpe_indices: tuple = ()
     # raw bound strings per row for exact host recheck of inexact rows
     rows: list = field(default_factory=list)  # [(polarity, Interval)]
+    # set when the constraint grammar wasn't interval-representable:
+    # (vulnerable_ranges, patched_versions, unaffected_versions) raw
+    # strings, evaluated host-side via constraints.eval_constraint with
+    # the reference's IsVulnerable semantics (compare.go:21-55)
+    raw_specs: Optional[tuple] = None
 
 
 class AdvisoryTable:
@@ -141,6 +146,7 @@ class AdvisoryTable:
                      "vendor_ids": list(g.vendor_ids),
                      "arches": list(g.arches),
                      "cpe_indices": list(g.cpe_indices),
+                     "raw_specs": list(g.raw_specs) if g.raw_specs else None,
                      "rows": [[p, iv.lo, iv.lo_incl, iv.hi, iv.hi_incl]
                               for p, iv in g.rows]}
                     for g in self.groups
@@ -163,6 +169,8 @@ class AdvisoryTable:
                 vendor_ids=tuple(g["vendor_ids"]),
                 arches=tuple(g.get("arches") or ()),
                 cpe_indices=tuple(g.get("cpe_indices") or ()),
+                raw_specs=(tuple(g["raw_specs"])
+                           if g.get("raw_specs") else None),
                 rows=[(p, Interval(lo, li, hi, hi_i))
                       for p, lo, li, hi, hi_i in g["rows"]],
             )
@@ -208,32 +216,42 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
         )
         gid = len(groups)
         intervals: list[tuple[bool, Interval]] = []
+        raw_fallback = False
         if adv.vulnerable_ranges:
             try:
                 for iv in parse_constraint(adv.vulnerable_ranges):
                     intervals.append((True, iv))
-            except ValueError:
-                continue  # constraint we can't express: skip advisory
+                for spec in (adv.patched_versions,
+                             adv.unaffected_versions):
+                    if spec:
+                        for iv in parse_constraint(spec):
+                            intervals.append((False, iv))
+            except ConstraintError:
+                # grammar not interval-representable (caret/tilde/!=/
+                # wildcards/empty member): one catch-all row, exact
+                # host evaluation of the raw spec per pair — NEVER a
+                # silent drop or mangled parse
+                raw_fallback = True
         else:
             # OS-style: [affected, fixed) — unfixed when fixed_version == ""
             intervals.append((True, Interval(
                 lo=adv.affected_version or None, lo_incl=True,
                 hi=adv.fixed_version or None, hi_incl=False)))
-        for spec in (adv.patched_versions, adv.unaffected_versions):
-            if spec:
-                try:
-                    for iv in parse_constraint(spec):
-                        intervals.append((False, iv))
-                except ValueError:
-                    pass  # unsubtractable secure range: conservative (keep)
 
         h = key_hash(adv.source, adv.pkg_name)
-        emitted = False
-        for positive, iv in intervals:
+        rows_out: list[tuple[np.ndarray, np.ndarray, int]] = []
+        for positive, iv in ([] if raw_fallback else intervals):
             lo_tok, lo_exact = _encode_bound(adv.ecosystem, iv.lo)
             hi_tok, hi_exact = _encode_bound(adv.ecosystem, iv.hi)
             if (iv.lo and lo_tok is None) or (iv.hi and hi_tok is None):
-                continue  # unparseable bound: reference skips the advisory
+                # bound string parsed but isn't token-encodable: the
+                # whole advisory goes through the exact host path
+                raw_fallback = bool(adv.vulnerable_ranges)
+                if not raw_fallback:
+                    # OS-style: catch-all row, host recheck over g.rows
+                    g.rows = [(p, v) for p, v in intervals]
+                    rows_out = [(pad_row, pad_row, J.INEXACT)]
+                break
             flags = 0
             if iv.lo:
                 flags |= J.HAS_LO | (J.LO_INCL if iv.lo_incl else 0)
@@ -243,14 +261,22 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
                 flags |= J.INEXACT
             if not positive:
                 flags |= J.NEGATIVE
+            rows_out.append((lo_tok if lo_tok is not None else pad_row,
+                             hi_tok if hi_tok is not None else pad_row,
+                             flags))
+            g.rows.append((positive, iv))
+        if raw_fallback:
+            g.raw_specs = (adv.vulnerable_ranges, adv.patched_versions,
+                           adv.unaffected_versions)
+            g.rows = []
+            rows_out = [(pad_row, pad_row, J.INEXACT)]
+        for lo_tok, hi_tok, flags in rows_out:
             hash_vals.append(h)
-            lo_rows.append(lo_tok if lo_tok is not None else pad_row)
-            hi_rows.append(hi_tok if hi_tok is not None else pad_row)
+            lo_rows.append(lo_tok)
+            hi_rows.append(hi_tok)
             flag_rows.append(flags)
             group_rows.append(gid)
-            g.rows.append((positive, iv))
-            emitted = True
-        if emitted:
+        if rows_out:
             groups.append(g)
 
     if not hash_vals:

@@ -280,7 +280,12 @@ class BatchDetector:
 
     def _exact_eval(self, g, q: PkgQuery) -> tuple[bool, bool]:
         """Host fallback: evaluate the group's intervals with the exact
-        comparator (used for inexact-keyed rows/packages)."""
+        comparator (used for inexact-keyed rows/packages). Groups whose
+        constraint grammar wasn't interval-representable carry the raw
+        spec strings instead and get the reference's full IsVulnerable
+        semantics (compare.go:21-55)."""
+        if g.raw_specs is not None:
+            return self._raw_eval(g, q)
         pos = neg = False
         for positive, iv in g.rows:
             ok = True
@@ -298,3 +303,26 @@ class BatchDetector:
             else:
                 neg = neg or ok
         return pos, neg
+
+    def _raw_eval(self, g, q: PkgQuery) -> tuple[bool, bool]:
+        """Reference IsVulnerable (compare.go:21-55) over raw constraint
+        strings: empty member in vulnerable/patched lists ⇒ always
+        detect; constraint errors ⇒ warn-equivalent no-match."""
+        from ..db.constraints import eval_constraint
+        vuln, patched, unaffected = g.raw_specs
+        for spec in (vuln, patched):
+            if spec and any(not b.strip() for b in spec.split("||")):
+                return True, False
+        if vuln:
+            try:
+                if not eval_constraint(q.ecosystem, vuln, q.version):
+                    return False, False
+            except (ValueError, KeyError):
+                return False, False  # compare.go:33-38 warn → no match
+        secure = " || ".join(s for s in (patched, unaffected) if s)
+        if not secure:
+            return bool(vuln), False
+        try:
+            return True, eval_constraint(q.ecosystem, secure, q.version)
+        except (ValueError, KeyError):
+            return False, False

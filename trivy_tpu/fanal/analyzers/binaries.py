@@ -93,7 +93,9 @@ class GoBinaryAnalyzer(Analyzer):
         _, deps = parse_go_buildinfo(content)
         if not deps:
             return None
-        pkgs = [T.Package(id=f"{n}@{v}", name=n, version=v, file_path=path)
+        from .lockfiles import dep_id
+        pkgs = [T.Package(id=dep_id("gobinary", n, v), name=n, version=v,
+                          file_path=path)
                 for n, v in sorted(set(deps))]
         return AnalysisResult(applications=[
             T.Application(type="gobinary", file_path=path, packages=pkgs)])
@@ -147,7 +149,7 @@ class JarAnalyzer(Analyzer):
                              kv.get("version", "").strip())
             if gid and aid and ver:
                 full = f"{gid}:{aid}"
-                pkgs.append(T.Package(id=f"{full}@{ver}", name=full,
+                pkgs.append(T.Package(id=f"{full}:{ver}", name=full,
                                       version=ver, file_path=path))
                 if aid == fname_aid and ver == fname_ver:
                     found_pom_props = True
@@ -162,7 +164,7 @@ class JarAnalyzer(Analyzer):
             if hit:
                 gid, aid, ver = hit
                 full = f"{gid}:{aid}"
-                pkgs.append(T.Package(id=f"{full}@{ver}", name=full,
+                pkgs.append(T.Package(id=f"{full}:{ver}", name=full,
                                       version=ver, file_path=path))
             elif fname_aid and fname_ver:
                 name, version = fname_aid, fname_ver
@@ -171,7 +173,7 @@ class JarAnalyzer(Analyzer):
                     if gid:
                         name = f"{gid}:{name}"
                 pkgs.append(T.Package(
-                    id=f"{name}@{version}",
+                    id=f"{name}:{version}",
                     name=name, version=version,
                     file_path=path))
         seen = set()

@@ -25,10 +25,25 @@ def _app(app_type: str, path: str, pkgs: list) -> Optional[AnalysisResult]:
         T.Application(type=app_type, file_path=path, packages=pkgs)])
 
 
+def dep_id(ltype: str, name: str, version: str) -> str:
+    """Package ID with the per-language separator (reference
+    pkg/dependency/id.go:12-36: ':' for jar/pom/gradle, '/' for conan,
+    'v'-prefixed for go modules, '@' otherwise)."""
+    if not version:
+        return name
+    if ltype in ("jar", "pom", "gradle", "sbt"):
+        return f"{name}:{version}"
+    if ltype == "conan":
+        return f"{name}/{version}"
+    if ltype in ("gomod", "gobinary") and not version.startswith("v"):
+        return f"{name}@v{version}"
+    return f"{name}@{version}"
+
+
 def _pkg(name: str, version: str, dev: bool = False,
-         indirect: bool = False) -> T.Package:
-    return T.Package(id=f"{name}@{version}", name=name, version=version,
-                     dev=dev, indirect=indirect)
+         indirect: bool = False, ltype: str = "") -> T.Package:
+    return T.Package(id=dep_id(ltype, name, version), name=name,
+                     version=version, dev=dev, indirect=indirect)
 
 
 def _pkgjson_license(doc: dict):

@@ -106,13 +106,13 @@ class PomAnalyzer(Analyzer):
                 v = managed.get(name, "")
             if not g or not a or not v or "${" in v or "[" in v:
                 continue  # unresolved property or version range
-            pkgs.append(_pkg(name, v))
+            pkgs.append(_pkg(name, v, ltype="pom"))
         # the module itself is also reported when fully resolved
         g = resolve(props["project.groupId"])
         a = resolve(props["project.artifactId"])
         v = resolve(props["project.version"])
         if g and a and v and "${" not in v:
-            pkgs.insert(0, _pkg(f"{g}:{a}", v))
+            pkgs.insert(0, _pkg(f"{g}:{a}", v, ltype="pom"))
         return _app("pom", path, pkgs)
 
 
@@ -138,7 +138,7 @@ class GradleLockAnalyzer(Analyzer):
                 continue
             version = parts[2].split("=")[0]
             pkgs.append(_pkg(f"{parts[0]}:{parts[1]}", version,
-                             indirect=True))
+                             indirect=True, ltype="gradle"))
         return _app("gradle", path, pkgs)
 
 
@@ -312,7 +312,8 @@ class ConanLockAnalyzer(Analyzer):
                 if not m or idx == "0":
                     continue
                 pkgs.append(_pkg(m.group("name"), m.group("version"),
-                                 indirect=idx not in direct))
+                                 indirect=idx not in direct,
+                                 ltype="conan"))
         else:  # v2: all entries indirect-unknown, kept as direct
             for section in ("requires", "build_requires",
                             "python_requires"):
@@ -320,7 +321,8 @@ class ConanLockAnalyzer(Analyzer):
                     m = _CONAN_REF.match(ref)
                     if m:
                         pkgs.append(_pkg(m.group("name"),
-                                         m.group("version")))
+                                         m.group("version"),
+                                         ltype="conan"))
         return _app("conan", path, pkgs)
 
 
