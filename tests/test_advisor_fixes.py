@@ -1,0 +1,125 @@
+"""Regression tests for advisor findings (rounds 3-4): jsonpos string
+robustness, secret-config path comparison, go.sum merge heuristic,
+fixture trailing-comma repair scope."""
+
+import pytest
+
+from trivy_tpu.jsonpos import JSONPosError, parse
+
+
+# ---- jsonpos: malformed strings must raise JSONPosError, not crash ----
+
+def test_lone_trailing_backslash_raises_not_indexerror():
+    with pytest.raises(JSONPosError):
+        parse('{"a": "oops\\')
+
+
+def test_invalid_unicode_escape_raises():
+    with pytest.raises(JSONPosError):
+        parse('{"a": "\\uZZZZ"}')
+
+
+def test_truncated_unicode_escape_raises():
+    with pytest.raises(JSONPosError):
+        parse('{"a": "\\u12')
+
+
+def test_surrogate_pair_decodes_to_astral_char():
+    assert parse('{"a": "\\ud83d\\ude00"}')["a"] == "\U0001f600"
+
+
+def test_lone_high_surrogate_kept_as_is():
+    # json.loads also tolerates lone surrogates
+    assert len(parse('{"a": "\\ud83d x"}')["a"]) == 3
+
+
+def test_npm_lock_with_trailing_backslash_skipped_not_fatal():
+    """A malformed package-lock.json must not abort the scan
+    (NpmLockAnalyzer catches JSONPosError and skips the file)."""
+    from trivy_tpu.fanal.analyzers.lockfiles import NpmLockAnalyzer
+    a = NpmLockAnalyzer()
+    res = a.post_analyze({"package-lock.json": b'{"lockfileVersion": "oops\\'})
+    assert res is None or not res.applications
+
+
+# ---- walker: secret-config compared by path, not basename -------------
+
+def test_secret_candidate_excludes_only_configured_path():
+    from trivy_tpu.fanal.walker import secret_candidate
+    # the configured file itself is skipped
+    assert not secret_candidate("conf/trivy-secret.yaml", 100,
+                                config_path="conf/trivy-secret.yaml")
+    # an unrelated file with the same basename elsewhere IS scanned
+    assert secret_candidate("other/trivy-secret.yaml", 100,
+                            config_path="conf/trivy-secret.yaml")
+    # default: root-level trivy-secret.yaml skipped, nested not
+    assert not secret_candidate("trivy-secret.yaml", 100)
+    assert secret_candidate("sub/trivy-secret.yaml", 100)
+
+
+# ---- gomod: go.sum merge keyed on indirect-mark absence ---------------
+
+def _gomod_apps(files):
+    from trivy_tpu.fanal.analyzers.lockfiles import GoModAnalyzer
+    res = GoModAnalyzer().post_analyze(files)
+    return {a.file_path: a.packages for a in (res.applications if res else [])}
+
+
+def test_gosum_merged_when_no_indirect_marks():
+    """No `// indirect` anywhere ⇒ pre-1.17 heuristic fires even when
+    the go directive says 1.16 or is missing (mod.go:228-236)."""
+    mod = b"module m\nrequire github.com/aa/bb v1.0.0\n"
+    gosum = b"github.com/cc/dd v2.0.0 h1:xx\n"
+    apps = _gomod_apps({"go.mod": mod, "go.sum": gosum})
+    names = {p.name for p in apps["go.mod"]}
+    assert names == {"github.com/aa/bb", "github.com/cc/dd"}
+
+
+def test_gosum_not_merged_when_indirect_marked():
+    """Any indirect-marked dep ⇒ go.mod is 1.17+ and already complete,
+    regardless of the go directive."""
+    mod = (b"module m\ngo 1.16\n"
+           b"require (\n\tgithub.com/aa/bb v1.0.0\n"
+           b"\tgithub.com/ee/ff v3.0.0 // indirect\n)\n")
+    gosum = b"github.com/cc/dd v2.0.0 h1:xx\n"
+    apps = _gomod_apps({"go.mod": mod, "go.sum": gosum})
+    names = {p.name for p in apps["go.mod"]}
+    assert "github.com/cc/dd" not in names
+
+
+# ---- fixtures: trailing-comma repair only after strict parse fails ----
+
+def test_block_scalar_comma_line_not_rewritten(tmp_path):
+    """A line matching `- "...",` inside a valid YAML block scalar must
+    survive verbatim (the repair regex must not run on valid files)."""
+    p = tmp_path / "f.yaml"
+    p.write_text(
+        '- bucket: vulnerability\n'
+        '  pairs:\n'
+        '  - key: CVE-1\n'
+        '    value:\n'
+        '      Description: |\n'
+        '        - "kept-exactly",\n')
+    from trivy_tpu.db.fixtures import load_fixture_files
+    _, details, _ = load_fixture_files([str(p)])
+    assert details["CVE-1"]["Description"] == '- "kept-exactly",\n'
+
+
+def test_stray_comma_corpus_defect_still_repaired(tmp_path):
+    """The reference corpus's actual defect — a stray comma after a
+    quoted sequence item that breaks strict YAML — is still repaired."""
+    p = tmp_path / "f.yaml"
+    p.write_text(
+        '- bucket: vulnerability\n'
+        '  pairs:\n'
+        '  - key: CVE-1\n'
+        '    value:\n'
+        '      References:\n'
+        '      - "https://example.com/a",\n'
+        '      - "https://example.com/b"\n')
+    from trivy_tpu.db.fixtures import load_fixture_files
+    try:
+        _, details, _ = load_fixture_files([str(p)])
+    except Exception as e:  # pragma: no cover
+        raise AssertionError(f"repair path failed: {e}")
+    assert "CVE-1" in details

@@ -49,9 +49,6 @@ def run_cli(argv, tmp_path):
             rc = main(argv + ["--output", out_path])
     finally:
         os.environ.pop("TRIVY_TPU_FAKE_NOW", None)
-        # reset secret-config global set by _secret_scanner
-        from trivy_tpu.fanal.walker import set_secret_config_base
-        set_secret_config_base("trivy-secret.yaml")
     assert rc == 0
     with open(out_path) as f:
         return json.load(f)

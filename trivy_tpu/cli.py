@@ -222,9 +222,12 @@ def _load_table_args(args) -> AdvisoryTable:
 
 
 def _scan_common(args, ref, cache, artifact_type: str) -> int:
-    table = _load_table_args(args)
-    scanner = LocalScanner(cache, table)
     scanners = tuple(s.strip() for s in args.scanners.split(",") if s.strip())
+    # the DB is only initialized when vulnerability scanning is on
+    # (reference run.go initScannerConfig: vuln scanner gates DB init)
+    table = _load_table_args(args) if "vuln" in scanners \
+        else build_table([])
+    scanner = LocalScanner(cache, table)
     opts = T.ScanOptions(
         scanners=scanners,
         list_all_packages=args.list_all_pkgs,
@@ -359,9 +362,11 @@ def cmd_image(args) -> int:
         scanners = tuple(s.strip() for s in args.scanners.split(","))
         from .fanal.analyzers import AnalyzerGroup
         # image scans disable lockfile analyzers (run.go:167-169)
+        sec_scanner, sec_cfg = _secret_scanner(args, scanners)
         art = ImageArchiveArtifact(
             input_path, cache, scanners=scanners,
-            group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS))
+            group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS),
+            secret_scanner=sec_scanner, secret_config_path=sec_cfg)
         ref = None
         if "rekor" in getattr(args, "sbom_sources", ""):
             # remote-SBOM shortcut: a published SBOM attestation replaces
@@ -427,28 +432,38 @@ def cmd_fs(args) -> int:
     else:
         disabled = INDIVIDUAL_PKG_ANALYZERS + ("sbom",)
         artifact_type = T.ArtifactType.FILESYSTEM
+    sec_scanner, sec_cfg = _secret_scanner(args, scanners,
+                                           root=args.target)
     art = FilesystemArtifact(args.target, cache, scanners=scanners,
                              group=AnalyzerGroup(disabled=disabled),
-                             secret_scanner=_secret_scanner(args, scanners))
+                             secret_scanner=sec_scanner,
+                             secret_config_path=sec_cfg)
     ref = art.inspect()
     return _scan_common(args, ref, cache, artifact_type)
 
 
-def _secret_scanner(args, scanners):
-    """Custom secret rules from --secret-config (reference
-    pkg/fanal/secret/scanner.go ParseConfig; the config file itself is
-    excluded from scanning)."""
+def _secret_scanner(args, scanners, root: str = ""):
+    """→ (scanner | None, walker-relative config path). Custom secret
+    rules from --secret-config (reference pkg/fanal/secret/scanner.go
+    ParseConfig); the configured file itself — compared by PATH, not
+    basename (secret.go:137) — is excluded from scanning."""
+    from .fanal.walker import DEFAULT_SECRET_CONFIG
     if "secret" not in scanners:
-        return None
+        return None, DEFAULT_SECRET_CONFIG
     cfg = getattr(args, "secret_config", "") or ""
-    from .fanal.walker import set_secret_config_base
-    set_secret_config_base(cfg)
-    if not cfg or not os.path.exists(cfg):
-        return None
+    if not cfg:
+        return None, DEFAULT_SECRET_CONFIG
+    # exclusion happens on walked (root-relative) paths
+    walk_cfg = cfg
+    if root:
+        rel = os.path.relpath(os.path.abspath(cfg), os.path.abspath(root))
+        walk_cfg = "" if rel.startswith("..") else rel.replace(os.sep, "/")
+    if not os.path.exists(cfg):
+        return None, walk_cfg
     from .secret import SecretScanner
     from .secret.rules import load_secret_config
     rules, allow = load_secret_config(cfg)
-    return SecretScanner(rules=rules, allow_rules=allow)
+    return SecretScanner(rules=rules, allow_rules=allow), walk_cfg
 
 
 def cmd_sbom(args) -> int:

@@ -204,8 +204,14 @@ def load_fixture_files(paths: list[str]):
     docs = []
     for p in paths:
         with open(p) as f:
-            text = _TRAILING_COMMA.sub(r"\1", f.read())
-        loaded = yaml.safe_load(text)
+            text = f.read()
+        try:
+            loaded = yaml.safe_load(text)
+        except yaml.YAMLError:
+            # only then repair the known stray-comma corpus defect, so a
+            # line that merely LOOKS like `- "...",` inside a legitimate
+            # block scalar is never rewritten
+            loaded = yaml.safe_load(_TRAILING_COMMA.sub(r"\1", text))
         if loaded:
             docs.extend(loaded)
     return load_fixture_docs(docs)

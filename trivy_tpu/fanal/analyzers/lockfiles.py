@@ -317,8 +317,8 @@ class GoModAnalyzer(PostAnalyzer):
         for path in sorted(files):
             if not path.endswith("go.mod"):
                 continue
-            pkgs, go_version = self._parse_mod(files[path])
-            if go_version and _go_below_117(go_version):
+            pkgs, _go_version = self._parse_mod(files[path])
+            if _go_below_117(pkgs):
                 sum_path = path[:-len("go.mod")] + "go.sum"
                 if sum_path in files:
                     self._merge_sum(pkgs, files[sum_path])
@@ -374,13 +374,11 @@ class GoModAnalyzer(PostAnalyzer):
                 pkgs[name] = self._gopkg(name, ver, indirect=True)
 
 
-def _go_below_117(version: str) -> bool:
-    parts = version.split(".")
-    try:
-        major, minor = int(parts[0]), int(parts[1]) if len(parts) > 1 else 0
-    except ValueError:
-        return False
-    return major <= 1 and minor < 17
+def _go_below_117(pkgs: dict) -> bool:
+    """Pre-1.17 go.mod files don't carry `// indirect` marks, so the
+    absence of any indirect-marked dep is the signal to merge go.sum
+    (reference mod.go:228-236 lessThanGo117 — NOT the `go` directive)."""
+    return not any(p.indirect for p in pkgs.values())
 
 
 @register

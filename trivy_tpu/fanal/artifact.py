@@ -20,7 +20,7 @@ from typing import Optional
 from .. import types as T
 from .analyzers import AnalyzerGroup
 from .cache import cache_key
-from .walker import blob_info, walk_fs, walk_layer_tar
+from .walker import DEFAULT_SECRET_CONFIG, blob_info, walk_fs, walk_layer_tar
 
 
 @dataclass
@@ -37,12 +37,14 @@ class ImageArchiveArtifact:
     """docker-save / OCI-archive tarball."""
 
     def __init__(self, path: str, cache, group: Optional[AnalyzerGroup] = None,
-                 scanners: tuple = ("vuln",), secret_scanner=None):
+                 scanners: tuple = ("vuln",), secret_scanner=None,
+                 secret_config_path: str = DEFAULT_SECRET_CONFIG):
         self.path = path
         self.cache = cache
         self.group = group or AnalyzerGroup()
         self.scanners = scanners
         self.secret_scanner = secret_scanner
+        self.secret_config_path = secret_config_path
         if "secret" in scanners and secret_scanner is None:
             from ..secret import SecretScanner
             self.secret_scanner = SecretScanner()
@@ -108,8 +110,9 @@ class ImageArchiveArtifact:
             if data[:2] == b"\x1f\x8b":
                 data = gzip.decompress(data)
             with tarfile.open(fileobj=io.BytesIO(data)) as layer_tf:
-                scan = walk_layer_tar(layer_tf, self.group,
-                                      collect_secrets=want_secrets)
+                scan = walk_layer_tar(
+                    layer_tf, self.group, collect_secrets=want_secrets,
+                    secret_config_path=self.secret_config_path)
             bi = blob_info(scan, diff_id=diff_id, created_by=cb)
             if want_secrets and scan.secret_files:
                 secret_files[blob_id] = scan.secret_files
@@ -173,8 +176,9 @@ class ImageArchiveArtifact:
             if data[:2] == b"\x1f\x8b":
                 data = gzip.decompress(data)
             with tarfile.open(fileobj=io.BytesIO(data)) as layer_tf:
-                scan = walk_layer_tar(layer_tf, self.group,
-                                      collect_secrets=want_secrets)
+                scan = walk_layer_tar(
+                    layer_tf, self.group, collect_secrets=want_secrets,
+                    secret_config_path=self.secret_config_path)
             bi = blob_info(scan, diff_id=diff_id, created_by=cb)
             bi.digest = ldesc["digest"]
             if want_secrets and scan.secret_files:
@@ -201,19 +205,22 @@ class FilesystemArtifact:
     (pkg/fanal/artifact/local/fs.go:114)."""
 
     def __init__(self, root: str, cache, group: Optional[AnalyzerGroup] = None,
-                 scanners: tuple = ("vuln",), secret_scanner=None):
+                 scanners: tuple = ("vuln",), secret_scanner=None,
+                 secret_config_path: str = DEFAULT_SECRET_CONFIG):
         self.root = root
         self.cache = cache
         self.group = group or AnalyzerGroup()
         self.scanners = scanners
         self.secret_scanner = secret_scanner
+        self.secret_config_path = secret_config_path
         if "secret" in scanners and secret_scanner is None:
             from ..secret import SecretScanner
             self.secret_scanner = SecretScanner()
 
     def inspect(self) -> ArtifactReference:
         want_secrets = "secret" in self.scanners
-        scan = walk_fs(self.root, self.group, collect_secrets=want_secrets)
+        scan = walk_fs(self.root, self.group, collect_secrets=want_secrets,
+                       secret_config_path=self.secret_config_path)
         bi = blob_info(scan)
         if want_secrets and scan.secret_files:
             bi.secrets = self.secret_scanner.scan_files(scan.secret_files)

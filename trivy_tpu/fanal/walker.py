@@ -29,25 +29,22 @@ _SKIP_FILES = {"go.mod", "go.sum", "package-lock.json", "yarn.lock",
                "pnpm-lock.yaml", "Pipfile.lock", "Gemfile.lock"}
 _SKIP_DIRS = {".git", "node_modules"}
 
-# basename of the active --secret-config: the rule file itself is never
-# scanned (secret.go:137-140)
-_secret_config_base = "trivy-secret.yaml"
+# default --secret-config location: the rule file itself is never
+# scanned (reference secret.go:137-140 compares the walked path against
+# the CONFIGURED path, not basenames — an unrelated file that happens to
+# be called trivy-secret.yaml elsewhere in the tree IS scanned)
+DEFAULT_SECRET_CONFIG = "trivy-secret.yaml"
 
 
-def set_secret_config_base(name: str) -> None:
-    global _secret_config_base
-    _secret_config_base = os.path.basename(name) if name else ""
-
-
-def secret_candidate(path: str, size: int) -> bool:
+def secret_candidate(path: str, size: int,
+                     config_path: str = DEFAULT_SECRET_CONFIG) -> bool:
     if size < MIN_SECRET_SIZE or size > MAX_SECRET_SIZE:
         return False
     parts = path.split("/")
     if any(d in _SKIP_DIRS for d in parts[:-1]):
         return False
     base = parts[-1]
-    if base in _SKIP_FILES or \
-            (_secret_config_base and base == _secret_config_base):
+    if base in _SKIP_FILES or (config_path and path == config_path):
         return False
     _, ext = os.path.splitext(base)
     return ext.lower() not in _SKIP_EXTS
@@ -69,7 +66,9 @@ class BlobScan:
 
 
 def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
-                   collect_secrets: bool = False) -> BlobScan:
+                   collect_secrets: bool = False,
+                   secret_config_path: str = DEFAULT_SECRET_CONFIG
+                   ) -> BlobScan:
     scan = BlobScan(result=AnalysisResult())
     for member in tf:
         path = member.name.lstrip("./").lstrip("/")
@@ -87,7 +86,8 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
             continue
         wants = group.required(path, member.size)
         wants_post = group.post_required(path, member.size)
-        wants_secret = collect_secrets and secret_candidate(path, member.size)
+        wants_secret = collect_secrets and secret_candidate(
+            path, member.size, secret_config_path)
         if not (wants or wants_post or wants_secret):
             continue
         f = tf.extractfile(member)
@@ -106,7 +106,8 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
 
 def walk_fs(root: str, group: AnalyzerGroup,
             collect_secrets: bool = False,
-            skip_dirs: tuple = (".git",)) -> BlobScan:
+            skip_dirs: tuple = (".git",),
+            secret_config_path: str = DEFAULT_SECRET_CONFIG) -> BlobScan:
     scan = BlobScan(result=AnalysisResult())
     root = os.path.abspath(root)
     for dirpath, dirnames, filenames in os.walk(root):
@@ -120,7 +121,8 @@ def walk_fs(root: str, group: AnalyzerGroup,
                 continue
             wants = group.required(rel, size)
             wants_post = group.post_required(rel, size)
-            wants_secret = collect_secrets and secret_candidate(rel, size)
+            wants_secret = collect_secrets and secret_candidate(
+                rel, size, secret_config_path)
             if not (wants or wants_post or wants_secret):
                 continue
             try:

@@ -124,6 +124,19 @@ class _Parser:
                 return out
             raise self.error("expected ',' or ']'")
 
+    _HEX = set("0123456789abcdefABCDEF")
+
+    def _hex4(self, at: int, strict: bool = True) -> int:
+        """Four hex digits at ``at`` (\\uXXXX payload). strict=False
+        returns -1 on malformed input instead of raising (used when
+        probing for a low surrogate)."""
+        hx = self.s[at:at + 4]
+        if len(hx) == 4 and all(c in self._HEX for c in hx):
+            return int(hx, 16)
+        if strict:
+            raise self.error("invalid \\u escape")
+        return -1
+
     def string(self) -> str:
         # JSON strings cannot contain raw newlines, so no line tracking
         s = self.s
@@ -135,10 +148,20 @@ class _Parser:
                 self.i = j + 1
                 return "".join(buf)
             if c == "\\":
+                if j + 1 >= self.n:
+                    raise self.error("unterminated string")
                 esc = s[j + 1]
                 if esc == "u":
-                    buf.append(chr(int(s[j + 2:j + 6], 16)))
+                    cp = self._hex4(j + 2)
                     j += 6
+                    # UTF-16 surrogate pair → one astral char
+                    if 0xD800 <= cp <= 0xDBFF and s[j:j + 2] == "\\u":
+                        lo = self._hex4(j + 2, strict=False)
+                        if 0xDC00 <= lo <= 0xDFFF:
+                            cp = 0x10000 + ((cp - 0xD800) << 10) \
+                                + (lo - 0xDC00)
+                            j += 6
+                    buf.append(chr(cp))
                     continue
                 buf.append({"n": "\n", "t": "\t", "r": "\r", "b": "\b",
                             "f": "\f"}.get(esc, esc))
