@@ -47,7 +47,7 @@ sys.path.insert(0, REPO)
 N_PKG_NAMES = 30_000
 N_IMAGES = 2048
 PKGS_PER_IMAGE = 80
-BASELINE_IMAGES = 24
+BASELINE_IMAGES = 256  # large enough to preserve the Zipf-skew density
 BATCH_IMAGES = 256
 SOURCE = "alpine 3.19"
 SKEW_PKG = "linux-lts"
@@ -58,6 +58,17 @@ PROBE_TIMEOUTS = (60, 90, 120)   # per-attempt backend-init bound
 PROBE_BACKOFF = (5, 15)          # sleep between failed probes
 DEVICE_TIMEOUT = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
 DEVICE_ATTEMPTS = 2
+
+# Chip availability is intermittent (r02/r03 probes all failed while
+# r01 succeeded): a long-running `--opportunistic` loop probes every
+# PROBE_INTERVAL seconds for up to PROBE_MAX_HOURS, runs the device
+# child on the first success, and persists the payload here. main()
+# falls back to this artifact whenever its own live probe fails, so one
+# short availability window anywhere in the round yields a device
+# number at round end.
+DEVICE_ARTIFACT = os.path.join(REPO, "BENCH_device_probe.json")
+PROBE_INTERVAL = int(os.environ.get("BENCH_PROBE_INTERVAL", "240"))
+PROBE_MAX_HOURS = float(os.environ.get("BENCH_PROBE_MAX_HOURS", "11"))
 
 
 def synth_versions(rng, n=2000, major_lo=0, major_hi=9):
@@ -357,6 +368,96 @@ def _run_device_child(env):
     return None
 
 
+def _workload_fingerprint() -> str:
+    """Artifacts are only comparable to this process's CPU points when
+    the seeded workload parameters match."""
+    return (f"v2|imgs={N_IMAGES}|base={BASELINE_IMAGES}"
+            f"|batch={BATCH_IMAGES}|pkgs={N_PKG_NAMES}"
+            f"|skew={SKEW_ROWS}/{SKEW_IMAGE_FRAC}")
+
+
+def _save_device_artifact(payload: dict):
+    payload = dict(payload)
+    payload["probed_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())
+    payload["probed_at_unix"] = time.time()
+    payload["workload"] = _workload_fingerprint()
+    tmp = DEVICE_ARTIFACT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, DEVICE_ARTIFACT)
+
+
+def _load_device_artifact(max_age_s: float = 12 * 3600):
+    """Reject artifacts from another round (too old) or another
+    workload definition — stale numbers are worse than none."""
+    try:
+        with open(DEVICE_ARTIFACT) as f:
+            payload = json.load(f)
+        if not payload.get("images_per_sec"):
+            return None
+        if payload.get("workload") != _workload_fingerprint():
+            return None
+        age = time.time() - float(payload.get("probed_at_unix", 0))
+        if age > max_age_s:
+            return None
+        return payload
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def opportunistic_main():
+    """Long-running probe loop: try the chip every PROBE_INTERVAL
+    seconds; on the first healthy probe run the device child, persist
+    its payload, and exit."""
+    child_env = dict(os.environ)
+    deadline = time.time() + PROBE_MAX_HOURS * 3600
+    existing = _load_device_artifact()
+    if existing is not None:
+        print(f"# fresh artifact already present "
+              f"({existing.get('images_per_sec'):.1f} img/s); exiting",
+              file=sys.stderr)
+        return 0
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        code = ("import jax; d = jax.devices()[0]; "
+                "print(d.platform + '|' + str(d))")
+        name = None
+        try:
+            r = subprocess.run([sys.executable, "-c", code], env=child_env,
+                               timeout=PROBE_TIMEOUTS[0],
+                               capture_output=True, text=True)
+            if r.returncode == 0 and r.stdout.strip():
+                platform, _, nm = \
+                    r.stdout.strip().splitlines()[-1].partition("|")
+                if platform != "cpu":
+                    name = nm
+        except subprocess.TimeoutExpired:
+            pass
+        now = time.strftime("%H:%M:%S")
+        if name is None:
+            print(f"# [{now}] probe {attempt}: chip unavailable; "
+                  f"sleeping {PROBE_INTERVAL}s", file=sys.stderr, flush=True)
+            time.sleep(PROBE_INTERVAL)
+            continue
+        print(f"# [{now}] probe {attempt}: {name} — running device child",
+              file=sys.stderr, flush=True)
+        dev = _run_device_child(child_env)
+        if dev is not None:
+            _save_device_artifact(dev)
+            print(f"# device artifact saved: "
+                  f"{dev['images_per_sec']:.1f} img/s on {dev['device']}",
+                  file=sys.stderr, flush=True)
+            return 0
+        # child failed despite healthy probe — back off and retry
+        time.sleep(PROBE_INTERVAL)
+    print("# probe window exhausted without a device number",
+          file=sys.stderr)
+    return 1
+
+
 def main():
     # The orchestrator never initializes the real backend: every CPU
     # point below survives chip unavailability (the BENCH_r02 failure).
@@ -394,9 +495,20 @@ def main():
         result["secrets_host_find_mb_s"] = round(bench_secrets_host(), 1)
 
         dev = None
+        dev_source = "live"
         if _probe_backend(child_env) is not None:
             dev = _run_device_child(child_env)
+        if dev is None:
+            # the opportunistic probe loop may have caught an earlier
+            # availability window this round — use its artifact
+            dev = _load_device_artifact()
+            if dev is not None:
+                dev_source = "opportunistic_probe"
+                result["device_probed_at"] = dev.get("probed_at", "")
+                diag.append(f"device point from {DEVICE_ARTIFACT} "
+                            f"({dev.get('probed_at')})")
         if dev is not None:
+            result["device_source"] = dev_source
             result["value"] = round(dev["images_per_sec"], 2)
             result["vs_baseline"] = round(dev["images_per_sec"] / base_ips, 2)
             result["device"] = dev["device"]
@@ -407,6 +519,8 @@ def main():
             result["assemble_ms"] = round(dev["assemble_ms"], 1)
             result["n_pairs"] = dev["n_pairs"]
             # parity across the three paths, recorded rather than fatal
+            # (the workload is seeded, so a cached artifact's hit counts
+            # are comparable to this process's CPU hit counts)
             result["parity_ok"] = bool(
                 dev["dev_hits"] == np_hits and dev["sub_hits"] == base_hits)
             diag.append(f"hits={dev['dev_hits']} scan_s={dev['scan_s']:.2f}")
@@ -430,5 +544,7 @@ def main():
 if __name__ == "__main__":
     if "--device-child" in sys.argv:
         device_child_main()
+    elif "--opportunistic" in sys.argv:
+        sys.exit(opportunistic_main())
     else:
         sys.exit(main())

@@ -457,7 +457,8 @@ def _secret_scanner(args, scanners, root: str = ""):
     walk_cfg = cfg
     if root:
         rel = os.path.relpath(os.path.abspath(cfg), os.path.abspath(root))
-        walk_cfg = "" if rel.startswith("..") else rel.replace(os.sep, "/")
+        outside = rel == ".." or rel.startswith(".." + os.sep)
+        walk_cfg = "" if outside else rel.replace(os.sep, "/")
     if not os.path.exists(cfg):
         return None, walk_cfg
     from .secret import SecretScanner
