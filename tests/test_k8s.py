@@ -192,3 +192,123 @@ class TestComplianceIntegration:
         by_id = {cr.control.id: cr for cr in rep.results}
         assert by_id["1.2"].status == "FAIL"   # privileged
         assert by_id["1.5"].status == "FAIL"   # host network
+
+
+class TestWorkloadImageScan:
+    """Workload-image vulnerability scanning: fake API server + fake
+    registry → one batched detect_many over all cluster images
+    (reference pkg/k8s/scanner/scanner.go:104-121,163-175)."""
+
+    @pytest.fixture()
+    def cluster(self):
+        from fake_registry import FakeRegistry, tar_of
+        from helpers import ALPINE_OS_RELEASE, APK_INSTALLED
+        layer = tar_of({
+            "etc/os-release": ALPINE_OS_RELEASE,
+            "lib/apk/db/installed": APK_INSTALLED,
+        })
+        config = {
+            "architecture": "amd64", "os": "linux",
+            "rootfs": {"type": "layers",
+                       "diff_ids": ["sha256:" + "0" * 64]},
+            "history": [{"created_by": "ADD rootfs"}],
+        }
+        reg = FakeRegistry()
+        base = reg.start()
+        reg.put_image("library/alpine", "3.17", [layer], config)
+        image = f"{base}/library/alpine:3.17"
+
+        deployment = {
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"template": {"spec": {
+                "containers": [{"name": "app", "image": image}],
+                "initContainers": [{"name": "ini", "image": image}],
+            }}},
+        }
+        cronjob = {
+            "metadata": {"name": "tick", "namespace": "jobs"},
+            "spec": {"jobTemplate": {"spec": {"template": {"spec": {
+                "containers": [{"name": "job", "image": image}],
+            }}}}},
+        }
+        owned = {
+            "metadata": {"name": "web-abc", "namespace": "default",
+                         "ownerReferences": [{"kind": "ReplicaSet"}]},
+            "spec": {"containers": [{"name": "app", "image": image}]},
+        }
+        routes = {
+            "/apis/apps/v1/deployments": {"items": [deployment]},
+            "/apis/batch/v1/cronjobs": {"items": [cronjob]},
+            "/api/v1/pods": {"items": [owned]},
+        }
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                doc = routes.get(self.path.split("?")[0])
+                if doc is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        api = f"http://127.0.0.1:{srv.server_address[1]}"
+        yield api, image
+        srv.shutdown()
+        reg.stop()
+
+    def test_workload_images_extraction(self):
+        doc = {
+            "kind": "CronJob",
+            "spec": {"jobTemplate": {"spec": {"template": {"spec": {
+                "containers": [{"image": "a:1"}, {"image": "b:2"}],
+                "initContainers": [{"image": "a:1"}],
+            }}}}},
+        }
+        from trivy_tpu.k8s.scanner import workload_images
+        assert workload_images(doc) == ["a:1", "b:2"]
+
+    def test_cluster_image_vulns(self, cluster):
+        import glob as _glob
+        api, image = cluster
+        from trivy_tpu.db.fixtures import load_fixture_files
+        from trivy_tpu.db.table import build_table
+        from trivy_tpu.fanal.cache import MemoryCache
+        from trivy_tpu.k8s.scanner import scan_cluster_vulns
+        advs, details, _ = load_fixture_files(
+            sorted(_glob.glob("tests/fixtures/db/*.yaml")))
+        table = build_table(advs, details)
+        kube = KubeClient(KubeConfig(server=api, token="tok"))
+        results = scan_cluster_vulns(kube, MemoryCache(), table)
+        # the deployment and the cronjob each get the image's results;
+        # the owned pod is collapsed into its controller
+        targets = {r.target for r in results}
+        assert any(t.startswith("default/Deployment/web/") for t in targets)
+        assert any(t.startswith("jobs/CronJob/tick/") for t in targets)
+        assert not any("Pod/web-abc" in t for t in targets)
+        cves = {v.vulnerability_id for r in results
+                for v in r.vulnerabilities}
+        assert "CVE-2023-0286" in cves and "CVE-2025-26519" in cves
+
+    def test_failed_pull_degrades_to_warning(self, cluster):
+        from trivy_tpu.db.table import build_table
+        from trivy_tpu.fanal.cache import MemoryCache
+        from trivy_tpu.k8s.scanner import scan_cluster_vulns
+        api, _ = cluster
+
+        def bad_pull(image, dest):
+            raise OSError("registry gone")
+
+        kube = KubeClient(KubeConfig(server=api, token="tok"))
+        results = scan_cluster_vulns(kube, MemoryCache(),
+                                     build_table([]), pull=bad_pull)
+        assert results == []

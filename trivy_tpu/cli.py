@@ -140,6 +140,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kubeconfig", default="")
     p.add_argument("--context", default="")
     p.add_argument("--namespace", "-n", default="")
+    p.add_argument("--scanners", default="misconfig",
+                   help="comma-separated: misconfig,vuln,secret")
+    p.add_argument("--db", default="",
+                   help="advisory DB (.npz, trivy.db, or YAML glob)")
+    p.add_argument("--db-repository",
+                   default="ghcr.io/aquasecurity/trivy-db:2")
+    p.add_argument("--skip-db-update", action="store_true")
+    p.add_argument("--list-all-pkgs", action="store_true")
+    p.add_argument("--cache-dir",
+                   default=os.path.join(os.path.expanduser("~"), ".cache",
+                                        "trivy-tpu"))
     p.add_argument("--report", default="summary",
                    choices=["summary", "all"])
     p.add_argument("--format", "-f", default="table",
@@ -398,20 +409,8 @@ def cmd_image(args) -> int:
             os.unlink(tmp.name)
 
 
-# analyzer groups disabled per target kind (reference run.go:167-224:
-# image disables lockfiles; fs disables individual-package + SBOM;
-# rootfs disables lockfiles; repo disables OS + individual + SBOM;
-# const.go TypeIndividualPkgs / TypeLockfiles / TypeOSes)
-INDIVIDUAL_PKG_ANALYZERS = ("gemspec", "node-pkg", "conda-pkg",
-                            "python-pkg", "gobinary", "jar", "rustbinary")
-LOCKFILE_ANALYZERS = ("bundler", "npm", "yarn", "pnpm", "pip", "pipenv",
-                      "poetry", "gomod", "pom", "conan",
-                      "gradle-lockfile", "cocoapods", "swift", "pub",
-                      "mix-lock")
-OS_ANALYZERS = ("os-release", "alpine", "amazonlinux", "mariner",
-                "debian", "redhatbase", "ubuntu", "apk", "dpkg", "rpm",
-                "rpmqa", "apk-repo", "redhat-content-manifest",
-                "redhat-dockerfile")
+from .fanal.analyzers import (INDIVIDUAL_PKG_ANALYZERS,
+                              LOCKFILE_ANALYZERS, OS_ANALYZERS)
 
 
 def cmd_fs(args) -> int:
@@ -514,8 +513,23 @@ def cmd_k8s(args) -> int:
             json.dump(build_kbom(client), out, indent=2)
             out.write("\n")
             return 0
-        results = scan_cluster(client,
-                               args.namespace or cfg.namespace)
+        scanners = tuple(s.strip() for s in args.scanners.split(",")
+                         if s.strip())
+        results = []
+        if "misconfig" in scanners or "config" in scanners:
+            results += scan_cluster(client,
+                                    args.namespace or cfg.namespace)
+        if "vuln" in scanners or "secret" in scanners:
+            from .fanal.cache import MemoryCache
+            from .k8s.scanner import scan_cluster_vulns
+            table = _load_table_args(args) if "vuln" in scanners \
+                else build_table([])
+            results += scan_cluster_vulns(
+                client, MemoryCache(), table,
+                namespace=args.namespace or cfg.namespace,
+                scanners=[s for s in scanners
+                          if s not in ("misconfig", "config")],
+                list_all_packages=args.list_all_pkgs)
         if args.compliance:
             from .compliance import (build_compliance_report, get_spec,
                                      write_compliance)
@@ -532,7 +546,8 @@ def cmd_k8s(args) -> int:
             write_report(report, "json", out, app_version=__version__)
         else:
             out.write(summary_table(results))
-        if args.exit_code and any(r.misconfigurations
+        if args.exit_code and any(r.misconfigurations or
+                                  r.vulnerabilities or r.secrets
                                   for r in results):
             return args.exit_code
         return 0

@@ -43,9 +43,15 @@ class LangpkgScanner:
         self.detector = detector
 
     def scan_app(self, app: T.Application) -> list[T.DetectedVulnerability]:
+        queries, finish = self.prepare_app(app)
+        return finish(self.detector.detect(queries))
+
+    def prepare_app(self, app: T.Application):
+        """→ (queries, finish) — see OspkgScanner.prepare for why the
+        two halves are split (cross-target detect_many batching)."""
         eco = APP_ECOSYSTEM.get(app.type)
         if eco is None:
-            return []
+            return [], lambda hits: []
         scheme = eco  # version scheme resolves via ECOSYSTEM_SCHEME
         buckets = self.detector.table.sources_for_prefix(f"{eco}::")
         queries = []
@@ -57,11 +63,14 @@ class LangpkgScanner:
                     source=bucket, ecosystem=scheme,
                     name=normalize_pkg_name(eco, pkg.name),
                     version=pkg.version, ref=pkg))
-        hits = self.detector.detect(queries)
-        uniq: dict[tuple, Hit] = {}
-        for h in hits:
-            uniq.setdefault((id(h.query.ref), h.vuln_id), h)
-        return [self._to_vuln(h, app) for h in uniq.values()]
+
+        def finish(hits):
+            uniq: dict[tuple, Hit] = {}
+            for h in hits:
+                uniq.setdefault((id(h.query.ref), h.vuln_id), h)
+            return [self._to_vuln(h, app) for h in uniq.values()]
+
+        return queries, finish
 
     @staticmethod
     def _to_vuln(h: Hit, app: T.Application) -> T.DetectedVulnerability:

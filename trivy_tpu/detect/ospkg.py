@@ -262,11 +262,23 @@ class OspkgScanner:
              ) -> tuple[list[T.DetectedVulnerability], bool]:
         """→ (vulns, eosl). Skips gpg-pubkey pseudo packages like
         detect.go:73."""
+        queries, finish = self.prepare(os_info, repo, packages, now)
+        return finish(self.detector.detect(queries))
+
+    def prepare(self, os_info: T.OS, repo: Optional[T.Repository],
+                packages: list[T.Package],
+                now: Optional[dt.datetime] = None):
+        """→ (queries, finish) with finish(hits) → (vulns, eosl).
+
+        Splitting query construction from hit assembly lets callers fan
+        many targets into ONE pipelined detect_many dispatch (the k8s
+        cluster sweep batches every workload image this way) instead of
+        the reference's per-image runner loop (scanner.go:163-175)."""
         if os_info.family in ("redhat", "centos"):
-            return self._scan_redhat(os_info, packages, now)
+            return self._prepare_redhat(os_info, packages, now)
         driver = DRIVERS.get(os_info.family)
         if driver is None:
-            return [], False
+            return [], lambda hits: ([], False)
         now = now or dt.datetime.now(dt.timezone.utc)
         if driver.family == "ubuntu":
             # stream selection shares the scan clock so the ESM
@@ -293,19 +305,19 @@ class OspkgScanner:
                 name=name, version=ver,
                 arch=pkg.arch if driver.arch_aware else "", ref=pkg))
 
-        hits = self.detector.detect(queries)
-        vulns = [self._to_vuln(h, driver) for h in hits]
+        def finish(hits):
+            vulns = [self._to_vuln(h, driver) for h in hits]
+            eosl = False
+            if driver.eol is not None:
+                at = now or dt.datetime.now(dt.timezone.utc)
+                eol = driver.eol.get(driver.eol_key(os_info.name))
+                eosl = eol is not None and at > eol
+            return vulns, eosl
 
-        eosl = False
-        if driver.eol is not None:
-            now = now or dt.datetime.now(dt.timezone.utc)
-            eol = driver.eol.get(driver.eol_key(os_info.name))
-            eosl = eol is not None and now > eol
-        return vulns, eosl
+        return queries, finish
 
-    def _scan_redhat(self, os_info: T.OS, packages: list[T.Package],
-                     now: Optional[dt.datetime] = None
-                     ) -> tuple[list[T.DetectedVulnerability], bool]:
+    def _prepare_redhat(self, os_info: T.OS, packages: list[T.Package],
+                        now: Optional[dt.datetime] = None):
         """RHEL/CentOS: advisories are scoped by CPE indices resolved
         from each package's content sets / NVR (redhat.go detect)."""
         from .. import version as V
@@ -345,7 +357,15 @@ class OspkgScanner:
                 arch="" if pkg.arch == "noarch" else pkg.arch,
                 cpe_indices=frozenset(allowed), ref=pkg))
 
-        hits = self.detector.detect(queries)
+        def finish(hits):
+            return self._finish_redhat(hits, os_info, now)
+
+        return queries, finish
+
+    def _finish_redhat(self, hits, os_info: T.OS,
+                       now: Optional[dt.datetime]):
+        from .. import version as V
+        maj = major(os_info.name)
         # per (pkg, vuln): unfixed never overwrite; fixed take the max
         # fixed version and merged vendor ids (redhat.go:148-179)
         merged: dict[tuple, Hit] = {}

@@ -174,3 +174,19 @@ class AnalyzerGroup:
                 r = a.post_analyze(subset)
                 if r is not None:
                     result.merge(r)
+
+
+# analyzer groups disabled per target kind (reference run.go:167-224:
+# image disables lockfiles; fs disables individual-package + SBOM;
+# rootfs disables lockfiles; repo disables OS + individual + SBOM;
+# const.go TypeIndividualPkgs / TypeLockfiles / TypeOSes)
+INDIVIDUAL_PKG_ANALYZERS = ("gemspec", "node-pkg", "conda-pkg",
+                            "python-pkg", "gobinary", "jar", "rustbinary")
+LOCKFILE_ANALYZERS = ("bundler", "npm", "yarn", "pnpm", "pip", "pipenv",
+                      "poetry", "gomod", "pom", "conan",
+                      "gradle-lockfile", "cocoapods", "swift", "pub",
+                      "mix-lock")
+OS_ANALYZERS = ("os-release", "alpine", "amazonlinux", "mariner",
+                "debian", "redhatbase", "ubuntu", "apk", "dpkg", "rpm",
+                "rpmqa", "apk-repo", "redhat-content-manifest",
+                "redhat-dockerfile")

@@ -59,6 +59,117 @@ def scan_cluster(client: KubeClient, namespace: str = "",
     return sorted(results, key=lambda r: r.target)
 
 
+def _pod_spec(doc: dict) -> dict:
+    """The pod template spec of any workload kind (trivy-kubernetes
+    artifacts.FromResource navigates the same paths)."""
+    kind = doc.get("kind", "")
+    spec = doc.get("spec") or {}
+    if kind == "Pod":
+        return spec
+    if kind == "CronJob":
+        spec = ((spec.get("jobTemplate") or {}).get("spec")) or {}
+    return ((spec.get("template") or {}).get("spec")) or {}
+
+
+def workload_images(doc: dict) -> list[str]:
+    """Unique container images of one workload (containers, init and
+    ephemeral containers — reference pkg/k8s/scanner collects the same
+    sets via trivy-kubernetes artifacts)."""
+    spec = _pod_spec(doc)
+    out = []
+    for key in ("containers", "initContainers", "ephemeralContainers"):
+        for c in spec.get(key) or []:
+            img = c.get("image")
+            if img:
+                out.append(img)
+    return list(dict.fromkeys(out))
+
+
+def _default_pull(image: str, dest: str):
+    from ..oci import default_client, parse_ref
+    default_client().pull_to_oci_tar(parse_ref(image), dest)
+
+
+def scan_cluster_vulns(client: KubeClient, cache, table,
+                       namespace: str = "", kinds=None, pull=None,
+                       scanners: tuple = ("vuln",), now=None,
+                       list_all_packages: bool = False
+                       ) -> list[T.Result]:
+    """Workload-image vulnerability scanning (reference
+    pkg/k8s/scanner/scanner.go:104-121,163-175).
+
+    The reference loops runner.ScanImage once per workload image. Here
+    every unique cluster image is pulled and analyzed host-side first,
+    then ALL images' package queries go through one pipelined
+    detect_many dispatch (LocalScanner.scan_many) — a cluster of N
+    images costs one device program's worth of launches, not N scans.
+    Per-image results are then fanned back out to every workload that
+    references the image. Failed pulls/scans degrade to a warning per
+    image, like the reference's per-image error resource."""
+    import dataclasses
+    import os as _os
+    import tempfile
+
+    from ..fanal.analyzers import AnalyzerGroup
+    from ..fanal.artifact import ImageArchiveArtifact
+    from ..log import logger
+    from ..scanner import LocalScanner
+
+    pull = pull or _default_pull
+    resources: list[tuple[str, str]] = []   # (resource path, image)
+    for kind in (kinds or WORKLOAD_KINDS):
+        try:
+            items = client.list_workloads(kind, namespace)
+        except KubeError as e:
+            if e.code == 404:
+                continue
+            raise
+        for item in items:
+            if kind in ("Pod", "ReplicaSet", "Job") and _owned(item):
+                continue
+            md = item.get("metadata", {})
+            ns = md.get("namespace", namespace)
+            name = md.get("name", "")
+            path = f"{ns}/{kind}/{name}" if ns else f"{kind}/{name}"
+            for img in workload_images(item):
+                resources.append((path, img))
+
+    images = list(dict.fromkeys(img for _, img in resources))
+    # lockfile analyzers are disabled for images (run.go:464-523)
+    from ..fanal.analyzers import LOCKFILE_ANALYZERS
+    refs = {}
+    for img in images:
+        tmp = tempfile.NamedTemporaryFile(suffix=".tar", delete=False)
+        tmp.close()
+        try:
+            pull(img, tmp.name)
+            art = ImageArchiveArtifact(
+                tmp.name, cache, scanners=scanners,
+                group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS))
+            refs[img] = art.inspect()
+        except Exception as e:  # per-image failure is non-fatal
+            logger.warning("failed to scan image %s: %s", img, e)
+        finally:
+            _os.unlink(tmp.name)
+
+    ok_images = [img for img in images if img in refs]
+    scanner = LocalScanner(cache, table)
+    opts = T.ScanOptions(scanners=tuple(scanners),
+                         list_all_packages=list_all_packages)
+    scanned = scanner.scan_many(
+        [(img, refs[img].id, refs[img].blob_ids) for img in ok_images],
+        opts, now=now)
+    per_image = {img: res for img, (res, _os_info)
+                 in zip(ok_images, scanned)}
+
+    out: list[T.Result] = []
+    for path, img in resources:
+        for res in per_image.get(img, []):
+            out.append(dataclasses.replace(
+                res, target=f"{path}/{res.target}"))
+    return sorted(out, key=lambda r: r.target)
+
+
 def build_kbom(client: KubeClient) -> dict:
     """KBOM: cluster + node components as CycloneDX JSON (reference
     pkg/k8s/scanner/scanner.go clusterInfoToReportResources →
@@ -107,18 +218,37 @@ def build_kbom(client: KubeClient) -> dict:
 
 
 def summary_table(results: list) -> str:
-    """Namespace/resource misconfiguration summary (reference
-    pkg/k8s/report summary writer)."""
+    """Namespace/resource summaries, one table per scanner with
+    findings (reference pkg/k8s/report summary writer renders separate
+    Misconfigurations / Vulnerabilities / Secrets sections)."""
     from ..report.tables import render_table
     sev_cols = ["CRITICAL", "HIGH", "MEDIUM", "LOW", "UNKNOWN"]
     head = ["Namespace", "Resource"] + [s[0] for s in sev_cols]
-    rows = []
-    for r in results:
-        ns, _, rest = r.target.partition("/")
-        counts = {s: 0 for s in sev_cols}
-        for m in r.misconfigurations:
-            counts[m.severity if m.severity in counts
-                   else "UNKNOWN"] += 1
-        rows.append([ns, rest] + [str(counts[s]) for s in sev_cols])
-    return render_table("Summary Report (Misconfigurations)", head,
-                        rows)
+
+    def section(title, rows_of):
+        rows = []
+        for r in results:
+            found = rows_of(r)
+            if found is None:
+                continue
+            ns, _, rest = r.target.partition("/")
+            counts = {s: 0 for s in sev_cols}
+            for sev in found:
+                counts[sev if sev in counts else "UNKNOWN"] += 1
+            rows.append([ns, rest] + [str(counts[s]) for s in sev_cols])
+        if not rows:
+            return ""
+        return render_table(f"Summary Report ({title})", head, rows)
+
+    parts = [
+        section("Misconfigurations",
+                lambda r: [m.severity for m in r.misconfigurations]
+                if r.misconfigurations or r.misconf_summary else None),
+        section("Vulnerabilities",
+                lambda r: [v.severity for v in r.vulnerabilities]
+                if r.vulnerabilities else None),
+        section("Secrets",
+                lambda r: [s.severity for s in r.secrets]
+                if r.secrets else None),
+    ]
+    return "\n".join(p for p in parts if p)

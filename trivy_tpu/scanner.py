@@ -33,63 +33,112 @@ class LocalScanner:
              options: Optional[T.ScanOptions] = None,
              now: Optional[dt.datetime] = None
              ) -> tuple[list[T.Result], T.OS]:
-        options = options or T.ScanOptions()
-        blobs = []
-        for bid in blob_ids:
-            blob = self.cache.get_blob(bid)
-            if blob is None:
-                raise KeyError(f"missing blob {bid} in cache "
-                               f"(artifact {artifact_id})")
-            blobs.append(blob)
-        detail = apply_layers(blobs)
-        # dev dependencies are removed unless --include-dev-deps
-        # (reference local/scan.go:109-111 excludeDevDeps)
-        if not options.include_dev_deps:
-            for app in detail.applications:
-                app.packages = [p for p in app.packages if not p.dev]
-        results: list[T.Result] = []
-        os_info = detail.os
+        return self.scan_many([(target, artifact_id, blob_ids)],
+                              options, now)[0]
 
+    def scan_many(self, items: list[tuple[str, str, list[str]]],
+                  options: Optional[T.ScanOptions] = None,
+                  now: Optional[dt.datetime] = None
+                  ) -> list[tuple[list[T.Result], T.OS]]:
+        """Scan many targets with ONE pipelined device dispatch.
+
+        Every target's OS-package and per-application query batches are
+        prepared host-side first, then a single detect_many call
+        overlaps host prep, device joins, and transfers across ALL
+        targets — the cross-image batching the k8s cluster sweep uses
+        where the reference loops runner.ScanImage per image
+        (pkg/k8s/scanner/scanner.go:163-175)."""
+        options = options or T.ScanOptions()
+        details = []
+        for target, artifact_id, blob_ids in items:
+            blobs = []
+            for bid in blob_ids:
+                blob = self.cache.get_blob(bid)
+                if blob is None:
+                    raise KeyError(f"missing blob {bid} in cache "
+                                   f"(artifact {artifact_id})")
+                blobs.append(blob)
+            detail = apply_layers(blobs)
+            # dev dependencies are removed unless --include-dev-deps
+            # (reference local/scan.go:109-111 excludeDevDeps)
+            if not options.include_dev_deps:
+                for app in detail.applications:
+                    app.packages = [p for p in app.packages if not p.dev]
+            details.append(detail)
+
+        # phase 1: build every query batch (host)
+        units = []    # (item_idx, "os" | app, finish)
+        batches = []
         if T.Scanner.VULN in options.scanners:
-            if detail.os.detected and "os" in options.pkg_types:
-                vulns, eosl = self.ospkg.scan(detail.os, detail.repository,
-                                              detail.packages, now=now)
-                fill_info(vulns, self.table.details)
-                vulns.sort(key=_vuln_sort_key)
+            for idx, detail in enumerate(details):
+                if detail.os.detected and "os" in options.pkg_types:
+                    qs, fin = self.ospkg.prepare(
+                        detail.os, detail.repository, detail.packages,
+                        now=now)
+                    units.append((idx, "os", fin))
+                    batches.append(qs)
+                if "library" in options.pkg_types:
+                    for app in sorted(detail.applications,
+                                      key=lambda a: (a.file_path, a.type)):
+                        qs, fin = self.langpkg.prepare_app(app)
+                        units.append((idx, app, fin))
+                        batches.append(qs)
+
+        # phase 2: one pipelined dispatch across all targets (device)
+        hit_lists = self.detector.detect_many(batches) if batches else []
+
+        # phase 3: assemble per-target results (host)
+        vuln_results: dict[int, list[T.Result]] = {}
+        for (idx, unit, finish), hits in zip(units, hit_lists):
+            target = items[idx][0]
+            detail = details[idx]
+            if unit == "os":
+                vulns, eosl = finish(hits)
                 if eosl:
-                    os_info.eosl = True
-                if detail.packages or vulns:
-                    res = T.Result(
-                        target=f"{target} ({detail.os.family} "
-                               f"{detail.os.name})",
-                        clazz=T.ResultClass.OS_PKGS,
-                        type=detail.os.family,
-                        vulnerabilities=vulns,
-                    )
-                    if options.list_all_packages:
-                        res.packages = sorted(
-                            detail.packages,
-                            key=lambda p: (p.name, p.version))
-                    results.append(res)
-            if "library" in options.pkg_types:
-                for app in sorted(detail.applications,
-                                  key=lambda a: (a.file_path, a.type)):
-                    vulns = self.langpkg.scan_app(app)
-                    fill_info(vulns, self.table.details)
-                    vulns.sort(key=_vuln_sort_key)
-                    if not vulns and not options.list_all_packages:
-                        continue
-                    res = T.Result(
-                        target=app.file_path or
-                        PKG_TARGETS.get(app.type, app.type),
-                        clazz=T.ResultClass.LANG_PKGS,
-                        type=app.type,
-                        vulnerabilities=vulns,
-                    )
-                    if options.list_all_packages:
-                        res.packages = sorted(
-                            app.packages, key=lambda p: (p.name, p.version))
-                    results.append(res)
+                    detail.os.eosl = True
+                keep = bool(detail.packages) or bool(vulns)
+                res = self._vuln_result(
+                    vulns,
+                    target=f"{target} ({detail.os.family} "
+                           f"{detail.os.name})",
+                    clazz=T.ResultClass.OS_PKGS, rtype=detail.os.family,
+                    packages=detail.packages, options=options)
+            else:
+                app = unit
+                vulns = finish(hits)
+                keep = bool(vulns) or options.list_all_packages
+                res = self._vuln_result(
+                    vulns,
+                    target=app.file_path or
+                    PKG_TARGETS.get(app.type, app.type),
+                    clazz=T.ResultClass.LANG_PKGS, rtype=app.type,
+                    packages=app.packages, options=options)
+            if keep:
+                vuln_results.setdefault(idx, []).append(res)
+
+        return [
+            self._finish_item(items[idx][0], details[idx],
+                              vuln_results.get(idx, []), options)
+            for idx in range(len(items))
+        ]
+
+    def _vuln_result(self, vulns, target: str, clazz, rtype,
+                     packages, options: T.ScanOptions) -> T.Result:
+        """Shared result assembly: FillInfo enrichment, severity sort,
+        optional package listing."""
+        fill_info(vulns, self.table.details)
+        vulns.sort(key=_vuln_sort_key)
+        res = T.Result(target=target, clazz=clazz, type=rtype,
+                       vulnerabilities=vulns)
+        if options.list_all_packages:
+            res.packages = sorted(packages,
+                                  key=lambda p: (p.name, p.version))
+        return res
+
+    def _finish_item(self, target: str, detail, results: list[T.Result],
+                     options: T.ScanOptions
+                     ) -> tuple[list[T.Result], T.OS]:
+        os_info = detail.os
 
         if T.Scanner.MISCONF in options.scanners or \
                 "config" in options.scanners:
