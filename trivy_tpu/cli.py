@@ -142,6 +142,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--namespace", "-n", default="")
     p.add_argument("--scanners", default="misconfig",
                    help="comma-separated: misconfig,vuln,secret")
+    p.add_argument("--secret-config", default="trivy-secret.yaml")
     p.add_argument("--db", default="",
                    help="advisory DB (.npz, trivy.db, or YAML glob)")
     p.add_argument("--db-repository",
@@ -462,8 +463,9 @@ def _secret_scanner(args, scanners, root: str = ""):
         return None, walk_cfg
     from .secret import SecretScanner
     from .secret.rules import load_secret_config
-    rules, allow = load_secret_config(cfg)
-    return SecretScanner(rules=rules, allow_rules=allow), walk_cfg
+    rules, allow, exclude = load_secret_config(cfg)
+    return SecretScanner(rules=rules, allow_rules=allow,
+                         exclude_regexes=exclude), walk_cfg
 
 
 def cmd_sbom(args) -> int:
@@ -524,12 +526,15 @@ def cmd_k8s(args) -> int:
             from .k8s.scanner import scan_cluster_vulns
             table = _load_table_args(args) if "vuln" in scanners \
                 else build_table([])
+            sec_scanner, _sec_cfg = _secret_scanner(args, scanners)
             results += scan_cluster_vulns(
                 client, MemoryCache(), table,
                 namespace=args.namespace or cfg.namespace,
                 scanners=[s for s in scanners
                           if s not in ("misconfig", "config")],
-                list_all_packages=args.list_all_pkgs)
+                list_all_packages=args.list_all_pkgs,
+                secret_scanner=sec_scanner,
+                secret_config_path=_sec_cfg)
         if args.compliance:
             from .compliance import (build_compliance_report, get_spec,
                                      write_compliance)

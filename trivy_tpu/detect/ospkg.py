@@ -320,8 +320,6 @@ class OspkgScanner:
                         now: Optional[dt.datetime] = None):
         """RHEL/CentOS: advisories are scoped by CPE indices resolved
         from each package's content sets / NVR (redhat.go detect)."""
-        from .. import version as V
-
         maj = major(os_info.name)
         cpe_maps = self.table_aux().get("Red Hat CPE") or {}
         repo_map = cpe_maps.get("repository") or {}

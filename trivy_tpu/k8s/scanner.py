@@ -38,24 +38,37 @@ def scan_resource_doc(doc: dict, namespace: str = "") -> T.Result:
     )
 
 
-def scan_cluster(client: KubeClient, namespace: str = "",
-                 kinds=None) -> list[T.Result]:
-    results = []
+def _workloads(client: KubeClient, namespace: str = "", kinds=None):
+    """Yield (resource path, doc) per scannable workload: missing API
+    groups (404) are skipped, auth/connection failures raised (they
+    must NOT read as clean), controller-owned Pods/ReplicaSets/Jobs
+    collapsed into their controllers."""
     for kind in (kinds or WORKLOAD_KINDS):
         try:
             items = client.list_workloads(kind, namespace)
         except KubeError as e:
             if e.code == 404:
                 continue  # API group absent (old clusters) — skip kind
-            raise  # auth/connection failures must NOT read as clean
+            raise
         for item in items:
             if kind in ("Pod", "ReplicaSet", "Job") and _owned(item):
                 continue
-            res = scan_resource_doc(item)
-            if res.misconfigurations or \
-                    (res.misconf_summary and
-                     res.misconf_summary.successes):
-                results.append(res)
+            md = item.get("metadata", {})
+            ns = md.get("namespace", namespace)
+            name = md.get("name", "")
+            path = f"{ns}/{kind}/{name}" if ns else f"{kind}/{name}"
+            yield path, item
+
+
+def scan_cluster(client: KubeClient, namespace: str = "",
+                 kinds=None) -> list[T.Result]:
+    results = []
+    for _path, item in _workloads(client, namespace, kinds):
+        res = scan_resource_doc(item)
+        if res.misconfigurations or \
+                (res.misconf_summary and
+                 res.misconf_summary.successes):
+            results.append(res)
     return sorted(results, key=lambda r: r.target)
 
 
@@ -93,7 +106,9 @@ def _default_pull(image: str, dest: str):
 def scan_cluster_vulns(client: KubeClient, cache, table,
                        namespace: str = "", kinds=None, pull=None,
                        scanners: tuple = ("vuln",), now=None,
-                       list_all_packages: bool = False
+                       list_all_packages: bool = False,
+                       secret_scanner=None,
+                       secret_config_path: str = "trivy-secret.yaml"
                        ) -> list[T.Result]:
     """Workload-image vulnerability scanning (reference
     pkg/k8s/scanner/scanner.go:104-121,163-175).
@@ -117,26 +132,16 @@ def scan_cluster_vulns(client: KubeClient, cache, table,
 
     pull = pull or _default_pull
     resources: list[tuple[str, str]] = []   # (resource path, image)
-    for kind in (kinds or WORKLOAD_KINDS):
-        try:
-            items = client.list_workloads(kind, namespace)
-        except KubeError as e:
-            if e.code == 404:
-                continue
-            raise
-        for item in items:
-            if kind in ("Pod", "ReplicaSet", "Job") and _owned(item):
-                continue
-            md = item.get("metadata", {})
-            ns = md.get("namespace", namespace)
-            name = md.get("name", "")
-            path = f"{ns}/{kind}/{name}" if ns else f"{kind}/{name}"
-            for img in workload_images(item):
-                resources.append((path, img))
+    for path, item in _workloads(client, namespace, kinds):
+        for img in workload_images(item):
+            resources.append((path, img))
 
     images = list(dict.fromkeys(img for _, img in resources))
     # lockfile analyzers are disabled for images (run.go:464-523)
     from ..fanal.analyzers import LOCKFILE_ANALYZERS
+    if "secret" in scanners and secret_scanner is None:
+        from ..secret import SecretScanner
+        secret_scanner = SecretScanner()  # share the keyword automaton
     refs = {}
     for img in images:
         tmp = tempfile.NamedTemporaryFile(suffix=".tar", delete=False)
@@ -145,7 +150,9 @@ def scan_cluster_vulns(client: KubeClient, cache, table,
             pull(img, tmp.name)
             art = ImageArchiveArtifact(
                 tmp.name, cache, scanners=scanners,
-                group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS))
+                group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS),
+                secret_scanner=secret_scanner,
+                secret_config_path=secret_config_path)
             refs[img] = art.inspect()
         except Exception as e:  # per-image failure is non-fatal
             logger.warning("failed to scan image %s: %s", img, e)

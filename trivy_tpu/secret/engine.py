@@ -31,10 +31,13 @@ DEVICE_ROWS = 4096
 class SecretScanner:
     def __init__(self, rules: Optional[list[Rule]] = None,
                  allow_rules: Optional[list] = None,
-                 use_device: bool = True):
+                 use_device: bool = True,
+                 exclude_regexes: Optional[list] = None):
         self.rules = rules if rules is not None else BUILTIN_RULES
         self.global_allow = (allow_rules if allow_rules is not None
                              else GLOBAL_ALLOW_RULES)
+        # global exclude-block regexes (scanner.go:27-41 Config)
+        self.global_exclude = exclude_regexes or []
         self.use_device = use_device
         # keyword → rule bitset mapping for the shared automaton
         self._keywords: list[bytes] = []
@@ -156,6 +159,8 @@ class SecretScanner:
         text = content.decode("utf-8", errors="surrogateescape")
         censored = None
         matched = []
+        global_exb = _blocks(text, self.global_exclude) \
+            if self.global_exclude else []
         if candidate_rules is None:
             low = bytes(ac.lower_bytes(content)) if content else b""
         for ri, rule in enumerate(self.rules):
@@ -170,7 +175,7 @@ class SecretScanner:
             locs = self._find_locations(rule, text)
             if not locs:
                 continue
-            exb = _blocks(text, rule.exclude_regexes)
+            exb = _blocks(text, rule.exclude_regexes) + global_exb
             for start, end in locs:
                 if _in_blocks(start, end, exb):
                     continue
@@ -190,15 +195,20 @@ class SecretScanner:
     def _find_locations(self, rule: Rule, text: str):
         locs = []
         if rule.secret_group:
+            # a Go regex may bind the group name more than once
+            # (renamed name__N at compile); each occurrence is a finding
+            groups = (rule.secret_group,) + tuple(
+                getattr(rule, "secret_aliases", ()))
             for m in rule.regex.finditer(text):
                 if self._allowed(rule, m.group(0)):
                     continue
-                try:
-                    s, e = m.span(rule.secret_group)
-                except (IndexError, re.error):
-                    continue
-                if s >= 0:
-                    locs.append((s, e))
+                for g in groups:
+                    try:
+                        s, e = m.span(g)
+                    except (IndexError, re.error):
+                        continue
+                    if s >= 0:
+                        locs.append((s, e))
         else:
             for m in rule.regex.finditer(text):
                 if self._allowed(rule, m.group(0)):

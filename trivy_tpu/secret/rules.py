@@ -39,6 +39,9 @@ class Rule:
     regex: re.Pattern
     keywords: list
     secret_group: str = ""
+    # duplicate-name aliases of secret_group (Go regexps may bind one
+    # name twice; each occurrence yields its own finding)
+    secret_aliases: tuple = ()
     path: Optional[re.Pattern] = None
     allow_rules: list = field(default_factory=list)
     exclude_regexes: list = field(default_factory=list)
@@ -164,7 +167,8 @@ _TABLE = [
     ("adobe-client-secret", "Adobe", "Adobe Client Secret", "LOW",
      r"(p8e-)(?i)[a-z0-9]{32}", ["p8e-"], ""),
     ("alibaba-access-key-id", "Alibaba", "Alibaba AccessKey ID", "HIGH",
-     QUOTE + r"(?P<secret>(LTAI)(?i)[a-z0-9]{20})" + QUOTE + END,
+     r"([^0-9A-Za-z]|^)(?P<secret>(LTAI)(?i)[a-z0-9]{20})"
+     r"([^0-9A-Za-z]|$)",
      ["LTAI"], "secret"),
     ("alibaba-secret-key", "Alibaba", "Alibaba Secret Key", "HIGH",
      _assign("alibaba", r"[a-z0-9]{30}"), ["alibaba"], "secret"),
@@ -309,23 +313,83 @@ _TABLE = [
 ]
 
 
-def _scope_flags(pattern: str) -> str:
-    """Go regex allows `(?i)` mid-pattern (applies to the rest); Python
-    requires global flags at position 0 — rewrite as a scoped group."""
-    idx = pattern.find("(?i)")
-    if idx <= 0:
+def _goflags(pattern: str, top: bool = True) -> str:
+    """Translate Go's mid-pattern `(?i)` into Python syntax.
+
+    In Go a bare flag group applies from its position to the END OF THE
+    ENCLOSING GROUP (e.g. `(?P<secret>(LTAI)(?i)[a-z0-9]{20})` leaves
+    `LTAI` case-sensitive). Python only allows bare flags at position 0,
+    so the scoped remainder is wrapped in `(?i:...)`."""
+    i = pattern.find("(?i)")
+    if i == -1 or (top and i == 0):
         return pattern
-    head, tail = pattern[:idx], pattern[idx + 4:].replace("(?i)", "")
-    return head + "(?i:" + tail + ")"
+    j = i + 4
+    # scan to the end of the enclosing group (unmatched ')') honoring
+    # escapes and character classes
+    depth = 0
+    in_class = False
+    k = j
+    while k < len(pattern):
+        c = pattern[k]
+        if c == "\\":
+            k += 2
+            continue
+        if in_class:
+            if c == "]":
+                in_class = False
+        elif c == "[":
+            in_class = True
+        elif c == "(":
+            depth += 1
+        elif c == ")":
+            if depth == 0:
+                break
+            depth -= 1
+        k += 1
+    inner = _goflags(pattern[j:k], top=False)
+    rest = _goflags(pattern[k:], top=False)
+    return pattern[:i] + "(?i:" + inner + ")" + rest
+
+
+_NAMED_GROUP = re.compile(r"\(\?P<([A-Za-z_]\w*)>")
+
+
+def _dedup_groups(pattern: str):
+    """Go regexps may reuse a group name; Python forbids it. Rename
+    later occurrences name → name__N and report the alias map so
+    secret-group extraction can follow every occurrence."""
+    seen: dict[str, int] = {}
+    aliases: dict[str, list[str]] = {}
+    out = []
+    last = 0
+    for m in _NAMED_GROUP.finditer(pattern):
+        name = m.group(1)
+        n = seen.get(name, 0) + 1
+        seen[name] = n
+        if n > 1:
+            new = f"{name}__{n}"
+            out.append(pattern[last:m.start()] + f"(?P<{new}>")
+            last = m.end()
+            aliases.setdefault(name, []).append(new)
+    out.append(pattern[last:])
+    return "".join(out), aliases
+
+
+def compile_rule_regex(pattern: str):
+    """→ (compiled regex, group alias map) with Go-compat fixups."""
+    pattern, aliases = _dedup_groups(pattern)
+    return re.compile(_goflags(pattern)), aliases
 
 
 def _build() -> list[Rule]:
     rules = []
     for rid, cat, title, sev, pattern, keywords, group in _TABLE:
+        rx, aliases = compile_rule_regex(pattern)
         rules.append(Rule(
             id=rid, category=cat, title=title, severity=sev,
-            regex=re.compile(_scope_flags(pattern)), keywords=list(keywords),
-            secret_group=group))
+            regex=rx, keywords=list(keywords),
+            secret_group=group,
+            secret_aliases=tuple(aliases.get(group, ()))))
     return rules
 
 
@@ -333,11 +397,15 @@ BUILTIN_RULES: list[Rule] = _build()
 
 
 def load_secret_config(path: str):
-    """trivy-secret.yaml → (rules, global_allow_rules). Schema mirrors
-    the reference secret.Config (pkg/fanal/secret/scanner.go:27-40):
-    enable-builtin-rules restricts the builtin set, disable-rules and
-    disable-allow-rules remove by id, `rules` / `allow-rules` append
-    custom entries."""
+    """trivy-secret.yaml → (rules, global_allow_rules,
+    global_exclude_regexes). Schema mirrors the reference secret.Config
+    (pkg/fanal/secret/scanner.go:27-41): enable-builtin-rules restricts
+    the builtin set, disable-rules and disable-allow-rules remove by id
+    (from the global AND per-rule allow sets), `rules` / `allow-rules`
+    append custom entries, `exclude-block` (global and per-rule) strips
+    matching text regions before reporting."""
+    import dataclasses
+
     import yaml
     with open(path) as f:
         doc = yaml.safe_load(f) or {}
@@ -346,24 +414,41 @@ def load_secret_config(path: str):
     if enable:
         keep = set(enable)
         rules = [r for r in rules if r.id in keep]
-    disable = set(doc.get("disable-rules") or [])
-    rules = [r for r in rules if r.id not in disable]
     for rd in doc.get("rules") or []:
+        rx, aliases = compile_rule_regex(rd.get("regex", ""))
         rules.append(Rule(
             id=rd.get("id", ""), category=rd.get("category", ""),
             title=rd.get("title", ""), severity=rd.get("severity", ""),
-            regex=re.compile(_scope_flags(rd.get("regex", ""))),
+            regex=rx,
             keywords=list(rd.get("keywords") or []),
             secret_group=rd.get("secret-group-name", ""),
+            secret_aliases=tuple(
+                aliases.get(rd.get("secret-group-name", ""), ())),
             path=re.compile(rd["path"]) if rd.get("path") else None,
             allow_rules=[_allow_from_dict(a)
                          for a in rd.get("allow-rules") or []],
+            exclude_regexes=[
+                re.compile(rx) for rx in
+                (rd.get("exclude-block") or {}).get("regexes") or []],
         ))
-    allow = list(GLOBAL_ALLOW_RULES)
+    # disable-rules applies to builtin AND custom ids (the reference
+    # filters after merging, scanner.go NewScanner)
+    disable = set(doc.get("disable-rules") or [])
+    rules = [r for r in rules if r.id not in disable]
     disable_allow = set(doc.get("disable-allow-rules") or [])
-    allow = [a for a in allow if a.id not in disable_allow]
+    if disable_allow:
+        # applies to per-rule allow sets too (scanner.go NewScanner)
+        rules = [
+            dataclasses.replace(r, allow_rules=[
+                a for a in r.allow_rules if a.id not in disable_allow])
+            if any(a.id in disable_allow for a in r.allow_rules) else r
+            for r in rules
+        ]
+    allow = [a for a in GLOBAL_ALLOW_RULES if a.id not in disable_allow]
     allow.extend(_allow_from_dict(a) for a in doc.get("allow-rules") or [])
-    return rules, allow
+    exclude = [re.compile(rx) for rx in
+               (doc.get("exclude-block") or {}).get("regexes") or []]
+    return rules, allow, exclude
 
 
 def _allow_from_dict(a: dict) -> AllowRule:
