@@ -198,3 +198,48 @@ class TestFilter:
         # CVE-1's ignore entry expired in 2020 → finding stays
         assert [v.vulnerability_id for v in out[0].vulnerabilities] == \
             ["CVE-1"]
+
+
+class TestMetrics:
+    def test_metrics_endpoint_counts_scans(self, tmp_path):
+        import socket as _socket
+        import urllib.request
+
+        from trivy_tpu.metrics import METRICS
+        from trivy_tpu.server.listen import serve_background
+        advisories, details, _ = load_fixture_files(
+            sorted(__import__("glob").glob(FIXGLOB)))
+        table = build_table(advisories, details)
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        httpd, state = serve_background(
+            "127.0.0.1", port, table, cache_dir=str(tmp_path))
+        base = f"http://127.0.0.1:{port}"
+        assert METRICS is not None
+        try:
+            from helpers import ALPINE_OS_RELEASE, APK_INSTALLED, make_image
+            img = str(tmp_path / "img.tar")
+            make_image(img, [{
+                "etc/os-release": ALPINE_OS_RELEASE,
+                "lib/apk/db/installed": APK_INSTALLED,
+            }])
+            from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+            from trivy_tpu.server.client import RemoteCache, RemoteScanner
+            cache = RemoteCache(base)
+            ref = ImageArchiveArtifact(img, cache).inspect()
+            RemoteScanner(base).scan(ref.name, ref.id, ref.blob_ids)
+
+            body = urllib.request.urlopen(base + "/metrics").read().decode()
+            assert "# TYPE trivy_tpu_scans_total counter" in body
+            import re as _re
+
+            def val(name):
+                m = _re.search(rf"^{name} (\S+)$", body, _re.M)
+                return float(m.group(1)) if m else 0.0
+            assert val("trivy_tpu_scans_total") >= 1
+            assert val("trivy_tpu_detect_queries_total") >= 1
+            assert val("trivy_tpu_detect_pairs_total") >= 1
+            assert val("trivy_tpu_scan_seconds_total") > 0
+        finally:
+            httpd.shutdown()

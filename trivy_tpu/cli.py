@@ -492,7 +492,12 @@ def cmd_convert(args) -> int:
 
 
 def cmd_server(args) -> int:
+    from .parallel.multihost import maybe_init_distributed, process_info
     from .server.listen import serve
+    if maybe_init_distributed():
+        from .log import logger
+        idx, count = process_info()
+        logger.info("joined multi-host job: process %d/%d", idx, count)
     table = _load_table_args(args)
     host, _, port = args.listen.rpartition(":")
     serve(host or "0.0.0.0", int(port), table, cache_dir=args.cache_dir,

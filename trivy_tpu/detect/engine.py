@@ -203,26 +203,36 @@ class BatchDetector:
                            jax.device_put(valid))
 
     def detect(self, queries: list[PkgQuery]) -> list[Hit]:
-        if len(self.table) == 0 or not queries:
-            return []
-        prep = self._prepare(queries)
-        if prep is None or prep.n_pairs == 0:
-            return []
-        return self._assemble(prep, np.asarray(self._dispatch(prep)))
+        return self.detect_many([queries])[0]
 
     def detect_many(self, batches: list[list[PkgQuery]]) -> list[list[Hit]]:
         """Pipelined variant: all batches are dispatched before any result
         is pulled back, overlapping host prep, device compute, and
         transfers (replaces the reference's worker-pool overlap,
         pkg/parallel/pipeline.go)."""
+        import time
+
+        from ..metrics import METRICS
         if len(self.table) == 0:
             return [[] for _ in batches]
         prepped = [self._prepare(qs) if qs else None for qs in batches]
         futures = [None if p is None or p.n_pairs == 0
                    else self._dispatch(p) for p in prepped]
-        return [[] if fut is None
-                else self._assemble(prep, np.asarray(fut))
-                for prep, fut in zip(prepped, futures)]
+        METRICS.inc("trivy_tpu_detect_batches_total",
+                    sum(1 for f in futures if f is not None))
+        METRICS.inc("trivy_tpu_detect_queries_total",
+                    sum(len(qs) for qs in batches))
+        METRICS.inc("trivy_tpu_detect_pairs_total",
+                    sum(p.n_pairs for p in prepped if p is not None))
+        t0 = time.perf_counter()
+        out = [[] if fut is None
+               else self._assemble(prep, np.asarray(fut))
+               for prep, fut in zip(prepped, futures)]
+        METRICS.inc("trivy_tpu_detect_wait_assemble_seconds_total",
+                    time.perf_counter() - t0)
+        METRICS.inc("trivy_tpu_detect_hits_total",
+                    sum(len(h) for h in out))
+        return out
 
     def _assemble(self, prep: _Prepared, bits: np.ndarray) -> list[Hit]:
         t = self.table

@@ -1,0 +1,67 @@
+"""Process-wide metrics registry, rendered as Prometheus text.
+
+The reference exposes no metrics endpoint; its observability is logs.
+For a long-lived scan server sharding work over a device mesh, the
+operational questions are different — is the device busy, how big are
+the batches, how many candidate pairs per dispatch — so the server
+publishes counters in the Prometheus text exposition format at
+/metrics (server/listen.py), fed from the detect and secret engines.
+
+Counters only (monotonic); gauges derive host-side from rate() in the
+scraper. Thread-safe: the detect engine is shared across server handler
+threads.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, tuple], float] = {}
+
+    def inc(self, name: str, value: float = 1.0, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, name: str, **labels) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def reset(self):
+        with self._lock:
+            self._values.clear()
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            items = sorted(self._values.items())
+        out = []
+        last_name = None
+        for (name, labels), value in items:
+            if name != last_name:
+                out.append(f"# TYPE {name} counter")
+                last_name = name
+            if labels:
+                lbl = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+                out.append(f"{name}{{{lbl}}} {_fmt(value)}")
+            else:
+                out.append(f"{name} {_fmt(value)}")
+        return "\n".join(out) + "\n" if out else ""
+
+
+def _escape(v) -> str:
+    """Label-value escaping per the text exposition format."""
+    return str(v).replace("\\", r"\\").replace('"', r"\"") \
+        .replace("\n", r"\n")
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if v == int(v) else repr(v)
+
+
+METRICS = Registry()

@@ -180,6 +180,37 @@ def sharded_pair_join(mesh: Mesh, st, ver_tok, part: PairPartition,
     return out
 
 
+def sharded_prefix_scan(mesh: Mesh, kw_word4, kw_mask4,
+                        chunks: np.ndarray, n_words: int) -> np.ndarray:
+    """Secret keyword prefilter sharded over EVERY mesh device: chunk
+    rows split across the flattened dp×db axes, the (tiny) keyword bank
+    replicated. The scan is embarrassingly parallel over rows, so GSPMD
+    partitions the already-jitted ac.prefix_scan from the input
+    shardings alone — no collectives, no shard_map. → int32[rows,
+    n_words] candidate masks in row order (SURVEY.md §2.7 P2)."""
+    from jax.sharding import NamedSharding
+
+    from ..ops import ac
+    n = int(mesh.devices.size)
+    rows = chunks.shape[0]
+    pad_rows = -(-rows // n) * n
+    if pad_rows != rows:
+        padded = np.zeros((pad_rows, chunks.shape[1]), chunks.dtype)
+        padded[:rows] = chunks
+        chunks = padded
+    row_sharded = NamedSharding(mesh, P(("dp", "db")))
+    replicated = NamedSharding(mesh, P())
+    if isinstance(kw_word4, np.ndarray):  # callers may pre-replicate
+        kw_word4 = jax.device_put(kw_word4, replicated)
+    if isinstance(kw_mask4, np.ndarray):
+        kw_mask4 = jax.device_put(kw_mask4, replicated)
+    out = ac.prefix_scan(
+        kw_word4, kw_mask4, jax.device_put(chunks, row_sharded),
+        n_words=n_words)
+    # lazy slice: stays on device so per-piece calls keep pipelining
+    return out[:rows]
+
+
 class MeshDetector:
     """BatchDetector whose device step runs sharded over a mesh — the
     server-side scale-out path (SURVEY.md §2.7 P4)."""

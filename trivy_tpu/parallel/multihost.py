@@ -1,0 +1,156 @@
+"""Multi-host scale-out: jax.distributed init + per-host ingest queue.
+
+The reference scales out by running many independent trivy client
+processes against one server (SURVEY.md §2.7 P4/P7, NCCL/MPI in the
+training-framework analogy). The TPU-native shape is one SPMD program
+over a multi-host device mesh: every host runs this same process,
+`maybe_init_distributed` wires them into one jax.distributed job (XLA
+collectives ride ICI within a pod slice and DCN across), and
+`global_mesh` builds a dp×db mesh over ALL hosts' devices.
+
+Per-host work distribution is the ingest queue: scan requests land on
+whichever host the load balancer picked, accumulate briefly, and flush
+into ONE pipelined detect_many dispatch — converting many small RPC
+payloads into the large device batches the MXU wants (SURVEY.md §2.7
+P1 pipeline → device batching).
+
+Env contract (all three required to opt in; absent ⇒ single-host):
+    TRIVY_TPU_DIST_COORDINATOR  host:port of process 0
+    TRIVY_TPU_DIST_NPROC        total process count
+    TRIVY_TPU_DIST_PROC_ID      this process's rank
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+_initialized = False
+
+
+def maybe_init_distributed(env=None) -> bool:
+    """Env-guarded jax.distributed.initialize; returns True when this
+    process joined a multi-host job. Safe to call more than once. All
+    three vars are required — a partial set is a config error, not a
+    silent single-host fallback (a worker defaulting to rank 0 would
+    fight the real coordinator)."""
+    global _initialized
+    env = env if env is not None else os.environ
+    keys = ("TRIVY_TPU_DIST_COORDINATOR", "TRIVY_TPU_DIST_NPROC",
+            "TRIVY_TPU_DIST_PROC_ID")
+    present = [k for k in keys if env.get(k)]
+    if not present:
+        return False
+    if len(present) != len(keys):
+        missing = sorted(set(keys) - set(present))
+        raise RuntimeError(
+            f"partial multi-host config: {missing} unset "
+            f"(all of {keys} are required)")
+    if _initialized:
+        return True
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=env["TRIVY_TPU_DIST_COORDINATOR"],
+        num_processes=int(env["TRIVY_TPU_DIST_NPROC"]),
+        process_id=int(env["TRIVY_TPU_DIST_PROC_ID"]),
+    )
+    _initialized = True
+    return True
+
+
+def process_info() -> tuple[int, int]:
+    """→ (process_index, process_count) — (0, 1) when single-host."""
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def global_mesh(db_shards: int = 1):
+    """dp×db mesh over every device of every host in the job (falls
+    back to the local devices when not distributed)."""
+    import jax
+
+    from .mesh import make_mesh
+    return make_mesh(len(jax.devices()), db_shards=db_shards,
+                     devices=jax.devices())
+
+
+class IngestQueue:
+    """Per-host request coalescing in front of a BatchDetector.
+
+    submit() returns a Future; a worker thread drains the queue and
+    flushes up to `max_batches` pending requests as ONE detect_many
+    call after at most `max_wait_s` of accumulation. Many concurrent
+    small scan RPCs therefore share single large device dispatches
+    instead of each paying a launch."""
+
+    def __init__(self, detector, max_batches: int = 64,
+                 max_wait_s: float = 0.005):
+        self.detector = detector
+        self.max_batches = max_batches
+        self.max_wait_s = max_wait_s
+        self._q: queue.Queue = queue.Queue()
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def submit(self, queries: list) -> Future:
+        fut: Future = Future()
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError("ingest queue closed")
+            self._q.put((queries, fut))
+        return fut
+
+    def close(self):
+        with self._close_lock:
+            self._closed = True
+            self._q.put(None)
+        self._worker.join(timeout=5)
+        # nothing can enqueue after the flag flips under the lock, so
+        # anything still queued (raced in before close) is failed here
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and not item[1].cancelled():
+                item[1].set_exception(RuntimeError("ingest queue closed"))
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            pending = [item]
+            # accumulate briefly so concurrent requests share a dispatch
+            deadline = _now() + self.max_wait_s
+            while len(pending) < self.max_batches:
+                try:
+                    nxt = self._q.get(timeout=max(0.0, deadline - _now()))
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._q.put(None)  # re-post the sentinel, then flush
+                    break
+                pending.append(nxt)
+            batches = [qs for qs, _ in pending]
+            try:
+                results = self.detector.detect_many(batches)
+                for (_qs, fut), hits in zip(pending, results):
+                    # a caller may have cancelled while we computed;
+                    # never let that poison its flush-mates
+                    if not fut.cancelled():
+                        fut.set_result(hits)
+            except Exception as e:  # noqa: BLE001 — fail the waiters
+                for _qs, fut in pending:
+                    if not fut.cancelled() and not fut.done():
+                        fut.set_exception(e)
+
+
+def _now() -> float:
+    import time
+    return time.monotonic()

@@ -32,13 +32,17 @@ class SecretScanner:
     def __init__(self, rules: Optional[list[Rule]] = None,
                  allow_rules: Optional[list] = None,
                  use_device: bool = True,
-                 exclude_regexes: Optional[list] = None):
+                 exclude_regexes: Optional[list] = None,
+                 mesh=None):
         self.rules = rules if rules is not None else BUILTIN_RULES
         self.global_allow = (allow_rules if allow_rules is not None
                              else GLOBAL_ALLOW_RULES)
         # global exclude-block regexes (scanner.go:27-41 Config)
         self.global_exclude = exclude_regexes or []
         self.use_device = use_device
+        # when set, the keyword prefilter shards chunk rows over every
+        # device of the dp×db mesh (parallel.mesh.sharded_prefix_scan)
+        self.mesh = mesh
         # keyword → rule bitset mapping for the shared automaton
         self._keywords: list[bytes] = []
         self._kw_rules: list[list[int]] = []
@@ -92,8 +96,16 @@ class SecretScanner:
         if chunks.shape[0] == 0:
             return out
         if self._device_arrays is None:
-            self._device_arrays = (jax.device_put(bank.kw_word4),
-                                   jax.device_put(bank.kw_mask4))
+            if self.mesh is not None:
+                # replicate the (tiny) bank across the mesh once
+                from jax.sharding import NamedSharding, PartitionSpec
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                self._device_arrays = (
+                    jax.device_put(bank.kw_word4, rep),
+                    jax.device_put(bank.kw_mask4, rep))
+            else:
+                self._device_arrays = (jax.device_put(bank.kw_word4),
+                                       jax.device_put(bank.kw_mask4))
         kw_word4, kw_mask4 = self._device_arrays
         # bounded rows per device call (O(B·L) working set), padded to a
         # power of two so each bucket shape compiles once; calls pipeline
@@ -108,9 +120,15 @@ class SecretScanner:
                 piece = pad
             # device_put, not jnp.asarray — the latter is an order of
             # magnitude slower for large host arrays on remote backends
-            futures.append(ac.prefix_scan(
-                kw_word4, kw_mask4, jax.device_put(piece),
-                n_words=bank.words))
+            if self.mesh is not None:
+                from ..parallel.mesh import sharded_prefix_scan
+                futures.append(sharded_prefix_scan(
+                    self.mesh, kw_word4, kw_mask4, piece,
+                    n_words=bank.words))
+            else:
+                futures.append(ac.prefix_scan(
+                    kw_word4, kw_mask4, jax.device_put(piece),
+                    n_words=bank.words))
         masks = np.concatenate([np.asarray(f) for f in futures],
                                axis=0)[:chunks.shape[0]]
         # confirm the (rare) device candidates exactly: the device tests
@@ -141,7 +159,7 @@ class SecretScanner:
     def scan_files(self, files: list[tuple[str, bytes]]) -> list[T.Secret]:
         """files: [(path, content)] → per-file Secret results (empty
         findings omitted)."""
-        paths = [p for p, _ in files]
+        from ..metrics import METRICS
         contents = [c for _, c in files]
         masks = self._keyword_masks(contents)
         results = []
@@ -150,6 +168,11 @@ class SecretScanner:
             sec = self.scan_file(path, content, candidate_rules=rule_idx)
             if sec.findings:
                 results.append(sec)
+        METRICS.inc("trivy_tpu_secret_files_total", len(files))
+        METRICS.inc("trivy_tpu_secret_bytes_total",
+                    sum(len(c) for c in contents))
+        METRICS.inc("trivy_tpu_secret_findings_total",
+                    sum(len(s.findings) for s in results))
         return results
 
     def scan_file(self, path: str, content: bytes,

@@ -82,6 +82,15 @@ class Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif self.path == "/version":
             self._json(200, {"Version": __version__})
+        elif self.path == "/metrics":
+            from ..metrics import METRICS
+            body = METRICS.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._twirp_error(404, "not_found", self.path)
 
@@ -164,15 +173,22 @@ class Handler(BaseHTTPRequestHandler):
             return self._twirp_error(500, "internal", f"{type(e).__name__}: {e}")
 
     def _scan(self, req: dict):
+        import time
+
+        from ..metrics import METRICS
         opts_j = req.get("options") or {}
         opts = T.ScanOptions(
             scanners=tuple(opts_j.get("scanners") or ("vuln",)),
             pkg_types=tuple(opts_j.get("vuln_type") or ("os", "library")),
             list_all_packages=bool(opts_j.get("list_all_packages")),
         )
+        t0 = time.perf_counter()
         results, os_info = self.state.scanner.scan(
             req.get("target", ""), req.get("artifact_id", ""),
             req.get("blob_ids") or [], opts)
+        METRICS.inc("trivy_tpu_scans_total")
+        METRICS.inc("trivy_tpu_scan_seconds_total",
+                    time.perf_counter() - t0)
         if self._is_proto:
             from .convert import results_to_proto
             return self._proto(200, results_to_proto(results, os_info),
