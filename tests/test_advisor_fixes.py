@@ -105,9 +105,12 @@ def test_block_scalar_comma_line_not_rewritten(tmp_path):
     assert details["CVE-1"]["Description"] == '- "kept-exactly",\n'
 
 
-def test_stray_comma_corpus_defect_still_repaired(tmp_path):
+def test_stray_comma_corpus_defect_drops_entry_like_reference(tmp_path):
     """The reference corpus's actual defect — a stray comma after a
-    quoted sequence item that breaks strict YAML — is still repaired."""
+    quoted sequence item that breaks strict YAML — drops the whole
+    enclosing entry, matching the reference loader's observable
+    behavior (its conan.json.golden leaves CVE-2020-14155 unfilled
+    although vulnerability.yaml contains a defective detail entry)."""
     p = tmp_path / "f.yaml"
     p.write_text(
         '- bucket: vulnerability\n'
@@ -116,10 +119,11 @@ def test_stray_comma_corpus_defect_still_repaired(tmp_path):
         '    value:\n'
         '      References:\n'
         '      - "https://example.com/a",\n'
-        '      - "https://example.com/b"\n')
+        '      - "https://example.com/b"\n'
+        '  - key: CVE-2\n'
+        '    value:\n'
+        '      Severity: HIGH\n')
     from trivy_tpu.db.fixtures import load_fixture_files
-    try:
-        _, details, _ = load_fixture_files([str(p)])
-    except Exception as e:  # pragma: no cover
-        raise AssertionError(f"repair path failed: {e}")
-    assert "CVE-1" in details
+    _, details, _ = load_fixture_files([str(p)])
+    assert "CVE-1" not in details      # defective entry dropped
+    assert details["CVE-2"]["Severity"] == "HIGH"  # clean entry kept

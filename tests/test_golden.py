@@ -145,6 +145,41 @@ def test_golden_secrets_repo(tmp_path):
     assert_zero_diff(got, read_golden("secrets.json.golden"))
 
 
+# lockfile-ecosystem repo configs, one per analyzer+comparer pair
+# (reference repo_test.go case table; listAllPkgs per its args)
+LOCKFILE_CONFIGS = [
+    ("yarn", "yarn", "yarn.json.golden", True),
+    ("pnpm", "pnpm", "pnpm.json.golden", False),
+    ("pipenv", "pipenv", "pipenv.json.golden", True),
+    ("poetry", "poetry", "poetry.json.golden", True),
+    ("gradle", "gradle", "gradle.json.golden", False),
+    ("conan", "conan", "conan.json.golden", True),
+    ("nuget", "nuget", "nuget.json.golden", True),
+    ("dotnet", "dotnet", "dotnet.json.golden", True),
+    ("packages-props", "packagesprops",
+     "packagesprops.json.golden", True),
+    ("swift", "swift", "swift.json.golden", True),
+    ("cocoapods", "cocoapods", "cocoapods.json.golden", True),
+    ("pubspec.lock", "pubspec", "pubspec.lock.json.golden", True),
+    ("mix.lock", "mixlock", "mix.lock.json.golden", True),
+    ("composer.lock", "composer", "composer.lock.json.golden", True),
+]
+
+
+@pytest.mark.parametrize(
+    "name,input_dir,golden,list_all",
+    LOCKFILE_CONFIGS, ids=[c[0] for c in LOCKFILE_CONFIGS])
+def test_golden_lockfile_repo(name, input_dir, golden, list_all,
+                              tmp_path):
+    argv = ["repo", os.path.join(GOLD, "inputs", input_dir),
+            "--db", DB_GLOB, "--format", "json",
+            "--cache-dir", str(tmp_path)]
+    if list_all:
+        argv.append("--list-all-pkgs")
+    got = run_cli(argv, tmp_path)
+    assert_zero_diff(got, read_golden(golden))
+
+
 def test_golden_sbom_cyclonedx(tmp_path):
     """trivy-flavored CycloneDX decode → centos-7.json.golden with the
     reference's compareSBOMReports overrides (sbom_test.go:33-64)."""

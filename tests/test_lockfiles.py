@@ -169,3 +169,30 @@ def test_composer_lock():
     r = analyze("composer.lock", json.dumps(doc).encode())
     assert pkgs_of(r, "composer") == {("monolog/monolog", "2.8.0", False),
                                       ("phpunit/phpunit", "9.5.0", True)}
+
+
+def test_yarn_berry_classification():
+    """Berry pins protocols into lock patterns ("p@npm:^8.0.3") and
+    uses `name: range` dep lines; classification and the graph must
+    still resolve (yarn.go handles both formats)."""
+    from trivy_tpu.fanal.analyzers.lockfiles import YarnLockAnalyzer
+    lock = b"""\
+# This file is generated by running "yarn install"
+
+"asap@npm:~2.0.6":
+  version: 2.0.6
+  resolution: "asap@npm:2.0.6"
+
+"promise@npm:^8.0.3":
+  version: 8.0.3
+  resolution: "promise@npm:8.0.3"
+  dependencies:
+    asap: ~2.0.6
+"""
+    pj = b'{"devDependencies": {"promise": "^8.0.3"}}'
+    res = YarnLockAnalyzer().post_analyze(
+        {"yarn.lock": lock, "package.json": pj})
+    pkgs = {p.name: p for p in res.applications[0].packages}
+    assert pkgs["promise"].dev and not pkgs["promise"].indirect
+    assert pkgs["asap"].dev and pkgs["asap"].indirect
+    assert pkgs["promise"].depends_on == ["asap@2.0.6"]

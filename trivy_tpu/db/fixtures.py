@@ -194,10 +194,40 @@ def _ds_fields(ds: dict | None) -> dict | None:
 
 import re
 
-# The reference's own fixture corpus contains sequence items with a stray
-# trailing comma after the closing quote (vulnerability.yaml
-# `- "https://...",`) that strict YAML rejects; drop it.
-_TRAILING_COMMA = re.compile(r'^(\s*- ".*")\s*,\s*$', re.M)
+# The reference's own fixture corpus contains sequence items with a
+# stray trailing comma after the closing quote (vulnerability.yaml
+# `- "https://...",`) that strict YAML rejects. The reference's Go
+# fixture loader observably DROPS exactly those entries — its own
+# conan.json.golden reports CVE-2020-14155 with no detail (Severity
+# UNKNOWN) although vulnerability.yaml contains one, because that
+# entry carries the defect. Parity therefore requires dropping the
+# whole enclosing `- key:` entry, not repairing it.
+_DEFECT_LINE = re.compile(r'^\s*- ".*",\s*$')
+
+
+def _strip_defective_entries(text: str) -> str:
+    lines = text.split("\n")
+    drop: set = set()
+    for b, line in enumerate(lines):
+        if not _DEFECT_LINE.match(line) or b in drop:
+            continue
+        start = None
+        for i in range(b, -1, -1):
+            if re.match(r"^\s*- key:", lines[i]):
+                start = i
+                break
+        if start is None:
+            drop.add(b)
+            continue
+        indent = len(lines[start]) - len(lines[start].lstrip())
+        end = len(lines)
+        for j in range(start + 1, len(lines)):
+            cur = lines[j]
+            if cur.strip() and len(cur) - len(cur.lstrip()) <= indent:
+                end = j
+                break
+        drop.update(range(start, end))
+    return "\n".join(l for i, l in enumerate(lines) if i not in drop)
 
 
 def load_fixture_files(paths: list[str]):
@@ -208,10 +238,10 @@ def load_fixture_files(paths: list[str]):
         try:
             loaded = yaml.safe_load(text)
         except yaml.YAMLError:
-            # only then repair the known stray-comma corpus defect, so a
-            # line that merely LOOKS like `- "...",` inside a legitimate
-            # block scalar is never rewritten
-            loaded = yaml.safe_load(_TRAILING_COMMA.sub(r"\1", text))
+            # only on strict-parse failure (so a line that merely LOOKS
+            # like `- "...",` inside a legitimate block scalar is never
+            # touched): drop the defective entries like the reference
+            loaded = yaml.safe_load(_strip_defective_entries(text))
         if loaded:
             docs.extend(loaded)
     return load_fixture_docs(docs)

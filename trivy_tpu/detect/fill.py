@@ -72,14 +72,12 @@ def fill_info(vulns: list[T.DetectedVulnerability], details: dict) -> None:
 
         detail = details.get(v.vulnerability_id)
         if detail is None:
-            # no detail row: severity still normalizes to UNKNOWN in the
-            # report (dbTypes.SeverityUnknown via getVendorSeverity)
+            # no detail row: the reference WARNS AND SKIPS the whole
+            # enrichment (vulnerability.go:73-77 GetVulnerability error
+            # → continue), so no PrimaryURL either; severity still
+            # normalizes to UNKNOWN in the report
             if not v.vulnerability.severity:
                 v.vulnerability.severity = "UNKNOWN"
-            if not v.primary_url:
-                v.primary_url = _primary_url(
-                    v.vulnerability_id, [],
-                    v.data_source.id if v.data_source else "")
             continue
         source = v.data_source.id if v.data_source else ""
         severity, sev_source = _vendor_severity(v.vulnerability_id, detail,

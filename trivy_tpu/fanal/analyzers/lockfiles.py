@@ -237,29 +237,157 @@ _YARN_VER = re.compile(r'^\s{2}version:?\s+"?([^"\s]+)"?')
 _YARN_HEAD = re.compile(r'^"?((?:@[^@/"]+\/)?[^@/"]+)@')
 
 
-@register
-class YarnLockAnalyzer(Analyzer):
-    """yarn.lock (classic + berry), pkg/dependency/parser/nodejs/yarn."""
+def _yarn_entries(text: str):
+    """Parse yarn.lock (classic + berry) into entries:
+    {patterns, name, version, deps{name: range}, span (start, end)}."""
+    entries = []
+    cur = None
+    in_deps = False
+    for ln, line in enumerate(text.splitlines(), start=1):
+        if not line.strip() or line.lstrip().startswith("#"):
+            continue
+        if not line.startswith(" "):  # entry head
+            cur = {"patterns": [], "name": "", "version": "",
+                   "deps": {}, "start": ln, "end": ln}
+            entries.append(cur)
+            in_deps = False
+            for raw in line.rstrip().rstrip(":").split(","):
+                pat = raw.strip().strip('"')
+                m = _YARN_HEAD.match(pat)
+                if m:
+                    cur["patterns"].append(pat)
+                    cur["name"] = m.group(1)
+            continue
+        if cur is None:
+            continue
+        cur["end"] = ln
+        s = line.strip()
+        if _YARN_VER.match(line):
+            cur["version"] = _YARN_VER.match(line).group(1)
+            in_deps = False
+        elif s.startswith("dependencies:"):
+            in_deps = True
+        elif in_deps and line.startswith("    "):
+            # classic `name "range"` / berry `name: range`
+            m = re.match(
+                r'^\s+"?([^"\s:]+)"?:?\s+"?([^"]+?)"?\s*$', line)
+            if m:
+                cur["deps"][m.group(1)] = m.group(2)
+        elif not line.startswith("    "):
+            in_deps = False
+    return [e for e in entries if e["name"] and e["version"]]
+
+
+@register_post
+class YarnLockAnalyzer(PostAnalyzer):
+    """yarn.lock + root package.json + node_modules licenses
+    (pkg/fanal/analyzer/language/nodejs/yarn/yarn.go PostAnalyze):
+    package.json's dependencies/devDependencies classify the lock
+    entries by walking the graph — packages reachable only from
+    devDependencies are Dev (excluded unless --include-dev-deps),
+    non-direct packages are Indirect; entries carry their lock line
+    spans and licenses resolved from node_modules package.json files."""
     name = "yarn"
-    version = 1
+    version = 2
 
     def required(self, path: str, size: int = -1) -> bool:
-        return path.endswith("yarn.lock")
+        parts = path.split("/")
+        base = parts[-1]
+        if base == "yarn.lock" and "node_modules" not in parts:
+            return True
+        # package.json both at the root (dep classification) and in
+        # node_modules (license source)
+        return base == "package.json"
 
-    def analyze(self, path, content):
-        pkgs, seen = [], set()
-        cur_name = None
-        for line in content.decode(errors="replace").splitlines():
-            if line and not line.startswith((" ", "#")):
-                m = _YARN_HEAD.match(line.strip().rstrip(":"))
-                cur_name = m.group(1) if m else None
-            elif cur_name:
-                m = _YARN_VER.match(line)
-                if m:
-                    key = (cur_name, m.group(1))
-                    if key not in seen:
-                        seen.add(key)
-                        pkgs.append(_pkg(*key))
+    def post_analyze(self, files: dict) -> Optional[AnalysisResult]:
+        licenses: dict[str, str] = {}
+        for path, content in files.items():
+            parts = path.split("/")
+            if parts[-1] != "package.json" or "node_modules" not in parts:
+                continue
+            try:
+                doc = json.loads(content)
+            except json.JSONDecodeError:
+                continue
+            lic = _pkgjson_license(doc)
+            if lic and doc.get("name") and doc.get("version"):
+                licenses[f"{doc['name']}@{doc['version']}"] = lic
+        apps = []
+        for path in sorted(files):
+            if not path.endswith("yarn.lock") or \
+                    "node_modules" in path.split("/"):
+                continue
+            app = self._parse_lock(path, files[path], files, licenses)
+            if app is not None:
+                apps.extend(app.applications)
+        return AnalysisResult(applications=apps) if apps else None
+
+    def _parse_lock(self, path: str, content: bytes, files: dict,
+                    licenses: dict) -> Optional[AnalysisResult]:
+        entries = _yarn_entries(content.decode(errors="replace"))
+        by_pattern = {}
+        for e in entries:
+            for pat in e["patterns"]:
+                by_pattern[pat] = e
+                # berry pins protocols into patterns ("p@npm:^8.0.3");
+                # package.json and classic dep lines use bare ranges
+                if "@npm:" in pat:
+                    by_pattern.setdefault(pat.replace("@npm:", "@", 1), e)
+        # root package.json next to the lock classifies the graph
+        pj = path[:-len("yarn.lock")] + "package.json"
+        prod_roots, dev_roots = [], []
+        if pj in files:
+            try:
+                doc = json.loads(files[pj])
+                prod_roots = [f"{n}@{r}" for n, r in
+                              (doc.get("dependencies") or {}).items()]
+                dev_roots = [f"{n}@{r}" for n, r in
+                             (doc.get("devDependencies") or {}).items()]
+            except json.JSONDecodeError:
+                pass
+
+        def walk(roots):
+            seen = set()
+            stack = [by_pattern[p] for p in roots if p in by_pattern]
+            while stack:
+                e = stack.pop()
+                key = id(e)
+                if key in seen:
+                    continue
+                seen.add(key)
+                for dn, dr in e["deps"].items():
+                    nxt = by_pattern.get(f"{dn}@{dr}") or \
+                        by_pattern.get(f"{dn}@npm:{dr}")
+                    if nxt is not None:
+                        stack.append(nxt)
+            return seen
+
+        prod = walk(prod_roots)
+        dev = walk(dev_roots) - prod
+        direct = {id(by_pattern[p]) for p in prod_roots + dev_roots
+                  if p in by_pattern}
+        classify = bool(prod_roots or dev_roots)
+
+        pkgs, seen_ids = [], set()
+        for e in entries:
+            pid = f"{e['name']}@{e['version']}"
+            if pid in seen_ids:
+                continue
+            seen_ids.add(pid)
+            p = _pkg(e["name"], e["version"],
+                     dev=classify and id(e) in dev,
+                     indirect=classify and id(e) not in direct)
+            p.locations = [{"StartLine": e["start"],
+                            "EndLine": e["end"]}]
+            if pid in licenses:
+                p.licenses = [licenses[pid]]
+            p.depends_on = sorted(
+                f"{d['name']}@{d['version']}"
+                for d in (by_pattern.get(f"{dn}@{dr}")
+                          or by_pattern.get(f"{dn}@npm:{dr}")
+                          for dn, dr in e["deps"].items())
+                if d is not None)
+            pkgs.append(p)
         return _app("yarn", path, pkgs)
 
 
@@ -402,28 +530,74 @@ class CargoLockAnalyzer(Analyzer):
         return _app("cargo", path, pkgs)
 
 
-@register
-class PoetryLockAnalyzer(Analyzer):
-    """poetry.lock (pkg/dependency/parser/python/poetry)."""
+@register_post
+class PoetryLockAnalyzer(PostAnalyzer):
+    """poetry.lock + sibling pyproject.toml
+    (pkg/fanal/analyzer/language/python/poetry/poetry.go PostAnalyze +
+    pkg/dependency/parser/python/poetry): the lock's per-package
+    [package.dependencies] build the DependsOn graph; pyproject's
+    [tool.poetry.dependencies] mark direct packages (everything else
+    is Indirect)."""
     name = "poetry"
-    version = 1
+    version = 2
 
     def required(self, path: str, size: int = -1) -> bool:
-        return path.endswith("poetry.lock")
+        return path.endswith(("poetry.lock", "pyproject.toml"))
 
-    def analyze(self, path, content):
+    def post_analyze(self, files: dict) -> Optional[AnalysisResult]:
         import tomllib
-        try:
-            doc = tomllib.loads(content.decode(errors="replace"))
-        except tomllib.TOMLDecodeError:
-            return None
-        pkgs = []
-        for p in doc.get("package", []):
-            if not (p.get("name") and p.get("version")):
+        apps = []
+        for path in sorted(files):
+            if not path.endswith("poetry.lock"):
                 continue
-            dev = p.get("category") == "dev"
-            pkgs.append(_pkg(p["name"], p["version"], dev=dev))
-        return _app("poetry", path, pkgs)
+            try:
+                doc = tomllib.loads(files[path].decode(errors="replace"))
+            except tomllib.TOMLDecodeError:
+                continue
+            direct = None
+            pyproject = path[:-len("poetry.lock")] + "pyproject.toml"
+            if pyproject in files:
+                try:
+                    pp = tomllib.loads(
+                        files[pyproject].decode(errors="replace"))
+                    deps = ((pp.get("tool") or {}).get("poetry") or {}) \
+                        .get("dependencies") or {}
+                    direct = {_normalize_pep503(n) for n in deps
+                              if n.lower() != "python"}
+                except tomllib.TOMLDecodeError:
+                    pass
+            # installed version per (normalized) name for graph edges
+            installed = {}
+            for p in doc.get("package", []):
+                if p.get("name") and p.get("version"):
+                    installed[_normalize_pep503(p["name"])] = \
+                        (p["name"], p["version"])
+            pkgs = []
+            for p in doc.get("package", []):
+                if not (p.get("name") and p.get("version")):
+                    continue
+                dev = p.get("category") == "dev"
+                pkg = _pkg(p["name"], p["version"], dev=dev)
+                norm = _normalize_pep503(p["name"])
+                if direct is not None:
+                    pkg.indirect = norm not in direct
+                dep_ids = []
+                for dn in (p.get("dependencies") or {}):
+                    hit = installed.get(_normalize_pep503(dn))
+                    if hit:
+                        dep_ids.append(f"{hit[0]}@{hit[1]}")
+                pkg.depends_on = sorted(dep_ids)
+                pkgs.append(pkg)
+            app = _app("poetry", path, pkgs)
+            if app is not None:
+                apps.extend(app.applications)
+        return AnalysisResult(applications=apps) if apps else None
+
+
+def _normalize_pep503(name: str) -> str:
+    """PEP 503 name normalization (python/poetry/parse.go uses the
+    packaging normalization for graph edges)."""
+    return re.sub(r"[-_.]+", "-", name).lower()
 
 
 @register
@@ -437,15 +611,25 @@ class PipenvLockAnalyzer(Analyzer):
 
     def analyze(self, path, content):
         try:
-            doc = json.loads(content)
-        except json.JSONDecodeError:
+            doc = json_parse(content)
+        except (JSONPosError, ValueError):
+            return None
+        if not isinstance(doc, dict):
             return None
         pkgs = []
         for section, dev in (("default", False), ("develop", True)):
-            for name, info in (doc.get(section) or {}).items():
+            members = doc.get(section) or {}
+            spans = getattr(members, "spans", {})
+            for name, info in members.items():
                 ver = (info or {}).get("version", "")
                 if ver.startswith("=="):
-                    pkgs.append(_pkg(name, ver[2:], dev=dev))
+                    # the reference pipenv parser leaves ID empty
+                    # (python/pipenv/parse.go — no dependency.ID)
+                    p = T.Package(name=name, version=ver[2:], dev=dev)
+                    if name in spans:
+                        p.locations = [{"StartLine": spans[name][0],
+                                        "EndLine": spans[name][1]}]
+                    pkgs.append(p)
         return _app("pipenv", path, pkgs)
 
 
@@ -478,24 +662,75 @@ class GemfileLockAnalyzer(Analyzer):
         return _app("bundler", path, pkgs)
 
 
-@register
-class ComposerLockAnalyzer(Analyzer):
-    """composer.lock (pkg/dependency/parser/php/composer)."""
+@register_post
+class ComposerLockAnalyzer(PostAnalyzer):
+    """composer.lock + sibling composer.json
+    (pkg/fanal/analyzer/language/php/composer/composer.go PostAnalyze +
+    pkg/dependency/parser/php/composer): per-package line spans,
+    licenses, a DependsOn graph from each package's `require` (edges
+    only to packages present in the lock), and Indirect for packages
+    outside composer.json's require."""
     name = "composer"
-    version = 1
+    version = 2
 
     def required(self, path: str, size: int = -1) -> bool:
-        return path.endswith("composer.lock")
+        base = path.rsplit("/", 1)[-1]
+        # vendored composer files describe other projects
+        # (composer.go:27-33 skips vendor/)
+        if "/vendor/" in f"/{path}":
+            return False
+        return base in ("composer.lock", "composer.json")
 
-    def analyze(self, path, content):
-        try:
-            doc = json.loads(content)
-        except json.JSONDecodeError:
-            return None
-        pkgs = []
-        for section, dev in (("packages", False), ("packages-dev", True)):
-            for p in doc.get(section) or []:
-                if p.get("name") and p.get("version"):
-                    pkgs.append(_pkg(p["name"],
-                                     p["version"].lstrip("v"), dev=dev))
-        return _app("composer", path, pkgs)
+    def post_analyze(self, files: dict) -> Optional[AnalysisResult]:
+        apps = []
+        for path in sorted(files):
+            if not path.endswith("composer.lock"):
+                continue
+            try:
+                doc = json_parse(files[path])
+            except (JSONPosError, ValueError):
+                continue
+            if not isinstance(doc, dict):
+                continue
+            direct = None
+            cj = path[:-len("composer.lock")] + "composer.json"
+            if cj in files:
+                try:
+                    direct = set(json.loads(files[cj]).get("require")
+                                 or {})
+                except (json.JSONDecodeError, AttributeError):
+                    pass
+            installed = {}
+            for section in ("packages", "packages-dev"):
+                for p in doc.get(section) or []:
+                    if p.get("name") and p.get("version"):
+                        installed[p["name"]] = \
+                            f'{p["name"]}@{p["version"].lstrip("v")}'
+            pkgs = []
+            for section, dev in (("packages", False),
+                                 ("packages-dev", True)):
+                plist = doc.get(section) or []
+                spans = getattr(plist, "spans", [])
+                for i, p in enumerate(plist):
+                    if not (p.get("name") and p.get("version")):
+                        continue
+                    pkg = _pkg(p["name"], p["version"].lstrip("v"),
+                               dev=dev)
+                    if direct is not None:
+                        pkg.indirect = p["name"] not in direct
+                    lic = p.get("license")
+                    if isinstance(lic, list):
+                        pkg.licenses = list(lic)
+                    elif isinstance(lic, str) and lic:
+                        pkg.licenses = [lic]
+                    pkg.depends_on = sorted(
+                        installed[dn] for dn in (p.get("require") or {})
+                        if dn in installed and dn != p["name"])
+                    if i < len(spans):
+                        pkg.locations = [{"StartLine": spans[i][0],
+                                          "EndLine": spans[i][1]}]
+                    pkgs.append(pkg)
+            app = _app("composer", path, pkgs)
+            if app is not None:
+                apps.extend(app.applications)
+        return AnalysisResult(applications=apps) if apps else None

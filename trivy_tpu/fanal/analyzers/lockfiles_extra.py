@@ -20,8 +20,15 @@ import xml.etree.ElementTree as ET
 from typing import Optional
 
 from ... import types as T
+from ...jsonpos import JSONPosError
+from ...jsonpos import parse as json_parse
 from . import AnalysisResult, Analyzer, register
-from .lockfiles import _app, _pkg
+
+
+def _loc(span) -> list:
+    """(start_line, end_line) → Locations list (report shape)."""
+    return [{"StartLine": span[0], "EndLine": span[1]}]
+from .lockfiles import _app, _pkg, dep_id
 
 
 # ----------------------------------------------------------------- Java
@@ -160,13 +167,16 @@ class NuGetLockAnalyzer(Analyzer):
         if path.endswith("packages.config"):
             return self._config(path, content)
         try:
-            doc = json.loads(content)
-        except json.JSONDecodeError:
+            doc = json_parse(content)
+        except (JSONPosError, ValueError):
+            return None
+        if not isinstance(doc, dict):
             return None
         seen = {}
         for target in (doc.get("dependencies") or {}).values():
             if not isinstance(target, dict):
                 continue
+            spans = getattr(target, "spans", {})
             for name, entry in target.items():
                 if not isinstance(entry, dict) or \
                         entry.get("type") == "Project":
@@ -178,6 +188,8 @@ class NuGetLockAnalyzer(Analyzer):
                          indirect=entry.get("type") != "Direct")
                 p.depends_on = [f"{d}@{v}" for d, v in sorted(
                     (entry.get("dependencies") or {}).items())]
+                if name in spans:
+                    p.locations = _loc(spans[name])
                 seen[(name, version)] = p
         return _app("nuget", path, list(seen.values()))
 
@@ -208,18 +220,27 @@ class DotNetDepsAnalyzer(Analyzer):
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
         try:
-            doc = json.loads(content)
-        except json.JSONDecodeError:
+            doc = json_parse(content)
+        except (JSONPosError, ValueError):
             return None
+        if not isinstance(doc, dict):
+            return None
+        libs = doc.get("libraries") or {}
+        spans = getattr(libs, "spans", {})
         pkgs = []
-        for name_ver, lib in (doc.get("libraries") or {}).items():
+        for name_ver, lib in libs.items():
             if not isinstance(lib, dict) or \
                     (lib.get("type") or "").lower() != "package":
                 continue
             parts = name_ver.split("/")
             if len(parts) != 2:
                 continue
-            pkgs.append(_pkg(parts[0], parts[1]))
+            # the reference core-deps parser leaves ID empty
+            # (dotnet/core_deps/parse.go — no dependency.ID call)
+            pkgs.append(T.Package(
+                name=parts[0], version=parts[1],
+                locations=_loc(spans[name_ver])
+                if name_ver in spans else []))
         return _app("dotnet-core", path, pkgs)
 
 
@@ -300,20 +321,36 @@ class ConanLockAnalyzer(Analyzer):
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
         try:
-            doc = json.loads(content)
-        except json.JSONDecodeError:
+            doc = json_parse(content)
+        except (JSONPosError, ValueError):
+            return None
+        if not isinstance(doc, dict):
             return None
         pkgs = []
         graph = (doc.get("graph_lock") or {}).get("nodes")
         if graph:  # v1
+            spans = getattr(graph, "spans", {})
             direct = set((graph.get("0") or {}).get("requires") or [])
+            # node index → package id, for the dependency graph
+            ids = {}
+            for idx, node in graph.items():
+                m = _CONAN_REF.match(node.get("ref") or "")
+                if m and idx != "0":
+                    ids[idx] = dep_id("conan", m.group("name"),
+                                      m.group("version"))
             for idx, node in graph.items():
                 m = _CONAN_REF.match(node.get("ref") or "")
                 if not m or idx == "0":
                     continue
-                pkgs.append(_pkg(m.group("name"), m.group("version"),
-                                 indirect=idx not in direct,
-                                 ltype="conan"))
+                p = _pkg(m.group("name"), m.group("version"),
+                         indirect=idx not in direct,
+                         ltype="conan")
+                p.depends_on = [
+                    ids[r] for r in (node.get("requires") or [])
+                    if r in ids]
+                if idx in spans:
+                    p.locations = _loc(spans[idx])
+                pkgs.append(p)
         else:  # v2: all entries indirect-unknown, kept as direct
             for section in ("requires", "build_requires",
                             "python_requires"):
@@ -345,10 +382,13 @@ class MixLockAnalyzer(Analyzer):
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
         pkgs = []
-        for line in content.decode(errors="replace").splitlines():
+        for ln, line in enumerate(
+                content.decode(errors="replace").splitlines(), start=1):
             m = _MIX_LINE.match(line.strip())
             if m and m.group("mgr") == "hex":
-                pkgs.append(_pkg(m.group("name"), m.group("version")))
+                p = _pkg(m.group("name"), m.group("version"))
+                p.locations = _loc((ln, ln))
+                pkgs.append(p)
         return _app("hex", path, pkgs)
 
 
@@ -366,14 +406,17 @@ class SwiftAnalyzer(Analyzer):
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
         try:
-            doc = json.loads(content)
-        except json.JSONDecodeError:
+            doc = json_parse(content)
+        except (JSONPosError, ValueError):
+            return None
+        if not isinstance(doc, dict):
             return None
         ver = doc.get("version", 1)
         pins = (doc.get("object") or {}).get("pins") \
             if ver == 1 else doc.get("pins")
+        spans = getattr(pins, "spans", [])
         pkgs = []
-        for pin in pins or []:
+        for i, pin in enumerate(pins or []):
             loc = pin.get("repositoryURL") if ver == 1 \
                 else pin.get("location")
             name = (loc or "").removeprefix("https://").removesuffix(
@@ -381,7 +424,10 @@ class SwiftAnalyzer(Analyzer):
             state = pin.get("state") or {}
             version = state.get("version") or state.get("branch") or ""
             if name and version:
-                pkgs.append(_pkg(name, version))
+                p = _pkg(name, version)
+                if i < len(spans):
+                    p.locations = _loc(spans[i])
+                pkgs.append(p)
         return _app("swift", path, pkgs)
 
 

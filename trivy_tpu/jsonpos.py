@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import re
 
-__all__ = ["SpanDict", "parse"]
+__all__ = ["SpanDict", "SpanList", "parse"]
 
 
 class SpanDict(dict):
@@ -22,6 +22,15 @@ class SpanDict(dict):
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
         self.spans: dict = {}
+
+
+class SpanList(list):
+    """list with .spans: index → (start_line, end_line) of the element
+    (composer.lock / Package.resolved report per-array-entry spans)."""
+
+    def __init__(self, *a):
+        super().__init__(*a)
+        self.spans: list = []
 
 
 _NUM = re.compile(r"-?(?:0|[1-9]\d*)(?:\.\d+)?(?:[eE][-+]?\d+)?")
@@ -106,15 +115,18 @@ class _Parser:
                 return out
             raise self.error("expected ',' or '}'")
 
-    def arr(self) -> list:
-        out = []
+    def arr(self) -> "SpanList":
+        out = SpanList()
         self.i += 1  # [
         self.ws()
         if self.i < self.n and self.s[self.i] == "]":
             self.i += 1
             return out
         while True:
+            self.ws()
+            start = self.line
             out.append(self.value())
+            out.spans.append((start, self.line))
             self.ws()
             if self.i < self.n and self.s[self.i] == ",":
                 self.i += 1

@@ -112,13 +112,21 @@ class IngestQueue:
         self._worker.join(timeout=5)
         # nothing can enqueue after the flag flips under the lock, so
         # anything still queued (raced in before close) is failed here
+        saw_sentinel = False
         while True:
             try:
                 item = self._q.get_nowait()
             except queue.Empty:
                 break
-            if item is not None and not item[1].cancelled():
+            if item is None:
+                saw_sentinel = True
+            elif not item[1].cancelled():
                 item[1].set_exception(RuntimeError("ingest queue closed"))
+        if saw_sentinel and self._worker.is_alive():
+            # a long in-flight flush outlived the join timeout and we
+            # consumed its shutdown signal — re-post it so the worker
+            # exits instead of blocking on an empty queue forever
+            self._q.put(None)
 
     def _run(self):
         while True:

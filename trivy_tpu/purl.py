@@ -43,7 +43,9 @@ def purl_for_package(pkg_type: str, pkg: T.Package,
         namespace = "alpine" if pkg_type == "alpine" else pkg_type
     elif ptype == "rpm":
         namespace = pkg_type
-    elif ptype in ("golang", "npm", "composer") and "/" in name:
+    elif ptype in ("golang", "npm", "composer", "swift") and "/" in name:
+        # swift names are repo URLs: host/org/repo → namespace host/org
+        # (reference purl.go TypeSwift via swiftNamespace)
         namespace, name = name.rsplit("/", 1)
     elif ptype == "maven" and ":" in name:
         namespace, name = name.split(":", 1)
