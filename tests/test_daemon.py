@@ -1,0 +1,153 @@
+"""Daemon image source: docker/podman unix-socket image save feeding
+the archive scan path (reference pkg/fanal/image/daemon/docker.go),
+tested against a fake Engine-API socket server."""
+
+import json
+import os
+import socketserver
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from helpers import ALPINE_OS_RELEASE, APK_INSTALLED, make_image
+from trivy_tpu.fanal.daemon import (DaemonError, docker_socket_candidates,
+                                    save_from_any_daemon, save_image)
+
+FIXTURE_DB = "tests/fixtures/db/*.yaml"
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    pass
+
+
+@pytest.fixture()
+def fake_daemon(tmp_path):
+    """A docker-compat daemon serving GET /images/{name}/get for
+    `alpine:3.17` with a synthetic docker-save tarball."""
+    img = str(tmp_path / "served.tar")
+    make_image(img, [{
+        "etc/os-release": ALPINE_OS_RELEASE,
+        "lib/apk/db/installed": APK_INSTALLED,
+    }])
+    with open(img, "rb") as f:
+        payload = f.read()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            name = urllib.parse.unquote(
+                self.path.removeprefix("/images/").removesuffix("/get"))
+            if name != "alpine:3.17":
+                self.send_response(404)
+                body = json.dumps({"message": "No such image"}).encode()
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-tar")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    sock_path = str(tmp_path / "docker.sock")
+    srv = _UnixHTTPServer(sock_path, Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield sock_path
+    srv.shutdown()
+
+
+def test_save_image_streams_tarball(fake_daemon, tmp_path):
+    dest = str(tmp_path / "out.tar")
+    save_image("alpine:3.17", dest, fake_daemon)
+    from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+    from trivy_tpu.fanal.cache import MemoryCache
+    ref = ImageArchiveArtifact(dest, MemoryCache()).inspect()
+    assert ref.blob_ids
+
+
+def test_save_image_missing_image_raises(fake_daemon, tmp_path):
+    with pytest.raises(DaemonError, match="not found"):
+        save_image("missing:latest", str(tmp_path / "o.tar"), fake_daemon)
+
+
+def test_socket_candidates_order():
+    env = {"DOCKER_HOST": "unix:///custom.sock",
+           "XDG_RUNTIME_DIR": "/run/user/1"}
+    assert docker_socket_candidates(env) == [
+        "/custom.sock", "/var/run/docker.sock",
+        "/run/user/1/podman/podman.sock", "/run/podman/podman.sock"]
+    # tcp DOCKER_HOST is not a unix socket source
+    assert docker_socket_candidates(
+        {"DOCKER_HOST": "tcp://1.2.3.4:2375"})[0] == \
+        "/var/run/docker.sock"
+
+
+def test_save_from_any_daemon_uses_env_socket(fake_daemon, tmp_path,
+                                              monkeypatch):
+    monkeypatch.setenv("DOCKER_HOST", f"unix://{fake_daemon}")
+    dest = str(tmp_path / "out.tar")
+    assert save_from_any_daemon("alpine:3.17", dest) == fake_daemon
+    assert os.path.getsize(dest) > 0
+
+
+def test_cli_image_scans_from_daemon(fake_daemon, tmp_path, monkeypatch,
+                                     capsys):
+    """e2e: `image alpine:3.17` with only the daemon source enabled
+    produces the fixture CVEs without any --input archive."""
+    monkeypatch.setenv("DOCKER_HOST", f"unix://{fake_daemon}")
+    from trivy_tpu.cli import main
+    out_path = str(tmp_path / "report.json")
+    rc = main(["image", "alpine:3.17", "--image-src", "docker",
+               "--db", FIXTURE_DB, "--format", "json",
+               "--cache-dir", str(tmp_path / "cache"),
+               "--output", out_path])
+    assert rc == 0
+    with open(out_path) as f:
+        report = json.load(f)
+    cves = {v["VulnerabilityID"] for r in report["Results"]
+            for v in r.get("Vulnerabilities") or []}
+    assert "CVE-2023-0286" in cves and "CVE-2025-26519" in cves
+
+
+def test_cli_image_daemon_fallback_to_remote_error(tmp_path, monkeypatch):
+    """No daemon socket and no registry: acquisition fails with both
+    errors reported, not a traceback."""
+    monkeypatch.setenv("DOCKER_HOST", "unix:///nonexistent/daemon.sock")
+    from trivy_tpu.cli import main
+    with pytest.raises(SystemExit, match="image acquisition failed"):
+        main(["image", "no-such-registry.invalid/app:1",
+              "--image-src", "docker",
+              "--db", FIXTURE_DB, "--cache-dir", str(tmp_path)])
+
+
+def test_socket_candidates_per_source():
+    env = {"DOCKER_HOST": "unix:///custom.sock",
+           "XDG_RUNTIME_DIR": "/run/user/1"}
+    assert docker_socket_candidates(env, sources=("podman",)) == [
+        "/run/user/1/podman/podman.sock", "/run/podman/podman.sock"]
+    assert docker_socket_candidates(env, sources=("docker",)) == [
+        "/custom.sock", "/var/run/docker.sock"]
+
+
+def test_cli_image_src_unknown_token(tmp_path):
+    from trivy_tpu.cli import main
+    with pytest.raises(SystemExit, match="unknown --image-src"):
+        main(["image", "a:1", "--image-src", "dokcer",
+              "--db", FIXTURE_DB, "--cache-dir", str(tmp_path)])
+
+
+def test_cli_image_src_podman_skips_docker_socket(fake_daemon, tmp_path,
+                                                  monkeypatch):
+    """--image-src podman must not consult docker's sockets."""
+    monkeypatch.setenv("DOCKER_HOST", f"unix://{fake_daemon}")
+    monkeypatch.setenv("XDG_RUNTIME_DIR", str(tmp_path / "xdg"))
+    from trivy_tpu.cli import main
+    with pytest.raises(SystemExit, match="image acquisition failed"):
+        main(["image", "alpine:3.17", "--image-src", "podman",
+              "--db", FIXTURE_DB, "--cache-dir", str(tmp_path)])

@@ -95,6 +95,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rekor-url", default="https://rekor.sigstore.dev")
     p.add_argument("--platform", default="",
                    help="os/arch for registry pulls (default linux/amd64)")
+    p.add_argument("--image-src", default="docker,podman,remote",
+                   help="image source fallback order "
+                        "(docker,podman,remote)")
     _add_scan_flags(p)
 
     for name, aliases in (("filesystem", ["fs"]), ("rootfs", [])):
@@ -351,23 +354,54 @@ def cmd_image(args) -> int:
     if not input_path:
         if not args.image_name:
             raise SystemExit("image name or --input <archive> required")
-        # registry pull (reference pkg/fanal/image/remote.go; daemon
-        # sources would precede this in the source fallback chain,
-        # image.go:42-56, but need a docker socket)
+        # image source fallback chain (reference image.go:42-56):
+        # docker/podman daemon sockets first, then the registry
         import tempfile
         from .log import logger
-        from .oci import OCIError, default_client, parse_ref
         tmp = tempfile.NamedTemporaryFile(suffix=".tar", delete=False)
         tmp.close()
-        try:
-            client = default_client()
-            client.pull_to_oci_tar(parse_ref(args.image_name), tmp.name,
-                                   platform=getattr(args, "platform", "")
-                                   or "linux/amd64")
-        except OCIError as e:
+        sources = [s.strip() for s in
+                   getattr(args, "image_src",
+                           "docker,podman,remote").split(",") if s.strip()]
+        unknown = [s for s in sources
+                   if s not in ("docker", "podman", "remote")]
+        if unknown:
             os.unlink(tmp.name)
-            raise SystemExit(f"registry pull failed: {e}") from None
-        logger.info("pulled %s from registry", args.image_name)
+            raise SystemExit(
+                f"unknown --image-src {','.join(unknown)!r} "
+                "(valid: docker, podman, remote)")
+        got = ""
+        errors = []
+        for src in sources:  # strictly in the user's order
+            if src in ("docker", "podman"):
+                from .fanal.daemon import (DaemonError,
+                                           save_from_any_daemon)
+                try:
+                    sock = save_from_any_daemon(
+                        args.image_name, tmp.name, sources=(src,))
+                    logger.info("saved %s from %s daemon %s",
+                                args.image_name, src, sock)
+                    got = src
+                except DaemonError as e:
+                    errors.append(f"{src}: {e}")
+            else:
+                from .oci import OCIError, default_client, parse_ref
+                try:
+                    default_client().pull_to_oci_tar(
+                        parse_ref(args.image_name), tmp.name,
+                        platform=getattr(args, "platform", "")
+                        or "linux/amd64")
+                    logger.info("pulled %s from registry",
+                                args.image_name)
+                    got = src
+                except OCIError as e:
+                    errors.append(f"remote: {e}")
+            if got:
+                break
+        if not got:
+            os.unlink(tmp.name)
+            raise SystemExit(
+                "image acquisition failed: " + "; ".join(errors))
         input_path = tmp.name
     try:
         cache = _open_cache(args)
