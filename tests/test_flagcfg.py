@@ -1,0 +1,141 @@
+"""Flag binding precedence: CLI > TRIVY_* env > trivy.yaml > default
+(reference pkg/flag viper binding)."""
+
+import json
+import os
+
+import pytest
+
+from trivy_tpu.cli import build_parser
+from trivy_tpu.flagcfg import (ConfigError, apply_flag_sources,
+                               generate_default_config)
+
+
+def _resolve(argv, env=None, cwd_config=None, tmp_path=None):
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if cwd_config is not None:
+        cfg = tmp_path / "trivy.yaml"
+        cfg.write_text(cwd_config)
+        args.config = str(cfg)
+    return apply_flag_sources(args, parser, argv, env=env or {})
+
+
+def test_default_wins_when_nothing_set():
+    args = _resolve(["repo", "x"], env={})
+    assert args.severity == "UNKNOWN,LOW,MEDIUM,HIGH,CRITICAL"
+    assert args.ignore_unfixed is False
+
+
+def test_env_overrides_default():
+    args = _resolve(["repo", "x"],
+                    env={"TRIVY_SEVERITY": "HIGH,CRITICAL",
+                         "TRIVY_IGNORE_UNFIXED": "true",
+                         "TRIVY_EXIT_CODE": "3"})
+    assert args.severity == "HIGH,CRITICAL"
+    assert args.ignore_unfixed is True
+    assert args.exit_code == 3
+
+
+def test_config_file_overrides_default(tmp_path):
+    args = _resolve(
+        ["repo", "x"], tmp_path=tmp_path,
+        cwd_config=("severity: CRITICAL\n"
+                    "vulnerability:\n  ignore-unfixed: true\n"
+                    "db:\n  repository: example.com/db:2\n"
+                    "scan:\n  scanners:\n    - vuln\n    - secret\n"))
+    assert args.severity == "CRITICAL"
+    assert args.ignore_unfixed is True
+    assert args.db_repository == "example.com/db:2"
+    assert args.scanners == "vuln,secret"  # YAML list → comma flag
+
+
+def test_env_beats_config_file(tmp_path):
+    args = _resolve(["repo", "x"],
+                    env={"TRIVY_SEVERITY": "HIGH"},
+                    tmp_path=tmp_path,
+                    cwd_config="severity: LOW\n")
+    assert args.severity == "HIGH"
+
+
+def test_flag_beats_env_and_file(tmp_path):
+    args = _resolve(["repo", "x", "--severity", "MEDIUM"],
+                    env={"TRIVY_SEVERITY": "HIGH"},
+                    tmp_path=tmp_path,
+                    cwd_config="severity: LOW\n")
+    assert args.severity == "MEDIUM"
+
+
+def test_flat_key_accepted(tmp_path):
+    args = _resolve(["repo", "x"], tmp_path=tmp_path,
+                    cwd_config="ignore-unfixed: true\n")
+    assert args.ignore_unfixed is True
+
+
+def test_missing_explicit_config_errors(tmp_path):
+    parser = build_parser()
+    argv = ["repo", "x", "--config", str(tmp_path / "absent.yaml")]
+    args = parser.parse_args(argv)
+    with pytest.raises(ConfigError, match="not found"):
+        apply_flag_sources(args, parser, argv, env={})
+
+
+def test_invalid_boolean_errors(tmp_path):
+    with pytest.raises(ConfigError, match="invalid boolean"):
+        _resolve(["repo", "x"], env={"TRIVY_IGNORE_UNFIXED": "maybe"})
+
+
+def test_generate_default_config(tmp_path, monkeypatch):
+    out = generate_default_config(build_parser(),
+                                  str(tmp_path / "trivy.yaml"))
+    import yaml
+    doc = yaml.safe_load(open(out))
+    assert doc["severity"] == "UNKNOWN,LOW,MEDIUM,HIGH,CRITICAL"
+    assert doc["vulnerability"]["ignore-unfixed"] is False
+    assert doc["db"]["repository"] == "ghcr.io/aquasecurity/trivy-db:2"
+    # the generated file round-trips through the loader
+    parser = build_parser()
+    argv = ["repo", "x", "--config", out]
+    args = parser.parse_args(argv)
+    apply_flag_sources(args, parser, argv, env={})
+
+
+def test_cli_e2e_env_binding(tmp_path):
+    """Full CLI: TRIVY_SEVERITY filters the report."""
+    from trivy_tpu.cli import main
+    target = tmp_path / "proj"
+    target.mkdir()
+    (target / "requirements.txt").write_text("werkzeug==0.11\n")
+    out = tmp_path / "r.json"
+    os.environ["TRIVY_SEVERITY"] = "CRITICAL"
+    try:
+        rc = main(["repo", str(target), "--db",
+                   "tests/golden/db/*.yaml", "--format", "json",
+                   "--cache-dir", str(tmp_path / "c"),
+                   "--output", str(out)])
+    finally:
+        os.environ.pop("TRIVY_SEVERITY", None)
+    assert rc == 0
+    d = json.load(open(out))
+    sevs = {v["Severity"] for r in d.get("Results") or []
+            for v in r.get("Vulnerabilities") or []}
+    assert sevs <= {"CRITICAL"}
+
+
+def test_abbreviated_long_option_rejected():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["repo", "x", "--sever", "MEDIUM"])
+
+
+def test_joined_short_option_is_explicit(tmp_path):
+    args = _resolve(["repo", "x", "-ftable"],
+                    env={"TRIVY_FORMAT": "json"})
+    assert args.format == "table"
+
+
+def test_config_section_name_never_feeds_same_named_flag(tmp_path):
+    """`db:` is a config SECTION; it must not stringify into --db."""
+    args = _resolve(["repo", "x"], tmp_path=tmp_path,
+                    cwd_config="db:\n  repository: example.com/db:2\n")
+    assert args.db == ""
+    assert args.db_repository == "example.com/db:2"

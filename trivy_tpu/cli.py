@@ -23,6 +23,9 @@ from .scanner import LocalScanner
 
 
 def _add_scan_flags(p: argparse.ArgumentParser):
+    p.add_argument("--config", "-c", default="",
+                   help="trivy.yaml config file (flag > TRIVY_* env > "
+                        "file > default)")
     p.add_argument("--scanners", default="vuln",
                    help="comma-separated: vuln,secret")
     p.add_argument("--format", "-f", default="json",
@@ -79,8 +82,11 @@ def _add_scan_flags(p: argparse.ArgumentParser):
 
 
 def build_parser() -> argparse.ArgumentParser:
+    # allow_abbrev=False: the env/config flag binding decides CLI
+    # explicitness by exact option match (flagcfg._explicit), so
+    # prefix abbreviations must not parse
     ap = argparse.ArgumentParser(
-        prog="trivy-tpu",
+        prog="trivy-tpu", allow_abbrev=False,
         description="TPU-native security scanner (Trivy-compatible)")
     ap.add_argument("--version", action="version",
                     version=f"trivy-tpu {__version__}")
@@ -198,6 +204,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("module_arg", nargs="?", default="")
 
     sub.add_parser("version", help="print version")
+    # subparsers don't inherit allow_abbrev — disable it on each so
+    # flagcfg._explicit's exact matching stays sound
+    for action in ap._actions:
+        if isinstance(action, argparse._SubParsersAction):
+            for sp in action.choices.values():
+                sp.allow_abbrev = False
     return ap
 
 
@@ -365,11 +377,11 @@ def cmd_image(args) -> int:
                            "docker,podman,remote").split(",") if s.strip()]
         unknown = [s for s in sources
                    if s not in ("docker", "podman", "remote")]
-        if unknown:
+        if unknown or not sources:
             os.unlink(tmp.name)
             raise SystemExit(
-                f"unknown --image-src {','.join(unknown)!r} "
-                "(valid: docker, podman, remote)")
+                f"unknown --image-src {','.join(unknown or ['(empty)'])!r}"
+                " (valid: docker, podman, remote)")
         got = ""
         errors = []
         for src in sources:  # strictly in the user's order
@@ -732,7 +744,15 @@ def main(argv=None) -> int:
                  "-h", "--help", "--version"}
         if argv[0] not in known and _plugin.exists(argv[0]):
             return _plugin.run(argv[0], argv[1:])
-    args = build_parser().parse_args(argv)
+    if argv and argv[0] == "--generate-default-config":
+        from .flagcfg import generate_default_config
+        print(generate_default_config(build_parser()))
+        return 0
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    # flag > TRIVY_* env > trivy.yaml > default (reference pkg/flag)
+    from .flagcfg import apply_flag_sources
+    args = apply_flag_sources(args, parser, argv)
     # extension modules load for every scan command (reference
     # initializes the WASM module manager in the runner lifecycle)
     if args.command not in ("version", "plugin", "module"):

@@ -1,0 +1,205 @@
+"""Flag binding: CLI > TRIVY_* env > trivy.yaml > defaults.
+
+Mirrors the reference's flag system (pkg/flag/flag.go Bind: every flag
+binds a viper key fed by the command line, a TRIVY_-prefixed env var,
+and the config file, in that precedence). argparse has no layered
+sources, so this module post-processes a parsed namespace: any flag
+NOT explicitly present on the command line is re-resolved from the
+environment, then from the config file, before the argparse default
+stands.
+
+Config keys follow the reference's trivy.yaml layout (nested viper
+paths like `vulnerability.ignore-unfixed`, `db.repository`,
+`scan.scanners` — pkg/flag/*_flags.go ConfigName fields); a flat
+top-level key equal to the flag name is accepted too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Optional
+
+# flag dest → reference trivy.yaml config path (flat names always work)
+CONFIG_PATHS = {
+    "scanners": "scan.scanners",
+    "ignore_unfixed": "vulnerability.ignore-unfixed",
+    "ignore_status": "vulnerability.ignore-status",
+    "ignorefile": "ignorefile",
+    "cache_dir": "cache.dir",
+    "db": "db.path",
+    "db_repository": "db.repository",
+    "skip_db_update": "db.skip-update",
+    "java_db": "javadb.path",
+    "secret_config": "secret.config",
+    "platform": "image.platform",
+    "image_src": "image.source",
+    "pkg_types": "pkg-types",
+    "config_check": "misconfiguration.check-paths",
+    "check_namespaces": "misconfiguration.namespaces",
+}
+
+_TRUE = {"1", "t", "true", "yes", "on"}
+_FALSE = {"0", "f", "false", "no", "off"}
+
+
+class ConfigError(SystemExit):
+    pass
+
+
+def _flag_name(action: argparse.Action) -> str:
+    longs = [o for o in action.option_strings if o.startswith("--")]
+    return (longs[0] if longs else action.option_strings[0]).lstrip("-")
+
+
+def _env_key(action: argparse.Action) -> str:
+    return "TRIVY_" + _flag_name(action).upper().replace("-", "_")
+
+
+def _explicit(action: argparse.Action, argv: list[str]) -> bool:
+    """Was the flag given on the command line? Handles --opt, --opt=v,
+    and joined short options (-ftable). Long-option prefix
+    abbreviations are disabled at the parser (build_parser sets
+    allow_abbrev=False) so exact matching is sound."""
+    for opt in action.option_strings:
+        short = len(opt) == 2 and not opt.startswith("--")
+        for a in argv:
+            if a == opt or a.startswith(opt + "=") or \
+                    (short and a.startswith(opt)):
+                return True
+    return False
+
+
+def _coerce(action: argparse.Action, raw: Any, origin: str) -> Any:
+    """Convert an env string / YAML value to the action's value type."""
+    if isinstance(action, (argparse._StoreTrueAction,
+                           argparse._StoreFalseAction)):
+        if isinstance(raw, bool):
+            return raw
+        s = str(raw).strip().lower()
+        if s in _TRUE:
+            return True
+        if s in _FALSE:
+            return False
+        raise ConfigError(
+            f"{origin}: invalid boolean {raw!r} for --{_flag_name(action)}")
+    if isinstance(action, argparse._AppendAction):
+        if isinstance(raw, list):
+            return [str(v) for v in raw]
+        return [s.strip() for s in str(raw).split(",") if s.strip()]
+    if isinstance(raw, list):  # YAML list for a comma-joined flag
+        raw = ",".join(str(v) for v in raw)
+    if action.type is int or isinstance(action.default, int) and \
+            not isinstance(action.default, bool):
+        try:
+            return int(raw)
+        except (TypeError, ValueError):
+            raise ConfigError(
+                f"{origin}: invalid integer {raw!r} for "
+                f"--{_flag_name(action)}")
+    return str(raw)
+
+
+def _config_lookup(doc: dict, action: argparse.Action):
+    """→ (found, value): dotted reference path first, then flat key."""
+    path = CONFIG_PATHS.get(action.dest)
+    if path:
+        node: Any = doc
+        for part in path.split("."):
+            if not isinstance(node, dict) or part not in node:
+                node = None
+                break
+            node = node[part]
+        if node is not None:
+            return True, node
+    flat = _flag_name(action)
+    # a mapping here is a config SECTION that happens to share the
+    # flag's name (e.g. `db:` vs --db), never a flag value
+    if flat in doc and not isinstance(doc[flat], dict):
+        return True, doc[flat]
+    return False, None
+
+
+def load_config_file(path: str, explicit: bool) -> Optional[dict]:
+    """trivy.yaml; a missing DEFAULT config is fine, a missing
+    explicitly-requested one is an error (pkg/commands/app.go)."""
+    if not os.path.exists(path):
+        if explicit:
+            raise ConfigError(f"config file {path!r} not found")
+        return None
+    import yaml
+    try:
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+    except (OSError, yaml.YAMLError) as e:
+        raise ConfigError(f"config file {path}: {e}")
+    if doc is None:
+        return {}
+    if not isinstance(doc, dict):
+        raise ConfigError(f"config file {path}: not a mapping")
+    return doc
+
+
+def apply_flag_sources(args: argparse.Namespace,
+                       parser: argparse.ArgumentParser,
+                       argv: list[str], env=None) -> argparse.Namespace:
+    """Re-resolve every non-explicit flag: env, then config file."""
+    env = env if env is not None else os.environ
+    cfg_path = getattr(args, "config", "") or "trivy.yaml"
+    doc = load_config_file(cfg_path,
+                           explicit=bool(getattr(args, "config", "")))
+    for action in _leaf_actions(parser):
+        if action.dest in ("help", "command", "config") or \
+                not action.option_strings:
+            continue
+        if not hasattr(args, action.dest) or _explicit(action, argv):
+            continue
+        ek = _env_key(action)
+        if ek in env:
+            setattr(args, action.dest,
+                    _coerce(action, env[ek], f"${ek}"))
+            continue
+        if doc is not None:
+            found, raw = _config_lookup(doc, action)
+            if found:
+                setattr(args, action.dest,
+                        _coerce(action, raw, cfg_path))
+    return args
+
+
+def _leaf_actions(parser: argparse.ArgumentParser):
+    """All actions, including each subcommand's."""
+    for action in parser._actions:
+        if isinstance(action, argparse._SubParsersAction):
+            for sub in action.choices.values():
+                yield from sub._actions
+        else:
+            yield action
+
+
+def generate_default_config(parser: argparse.ArgumentParser,
+                            out_path: str = "trivy.yaml") -> str:
+    """--generate-default-config: write every scan flag's default in
+    the reference's nested layout (flag.go writeConfig analog)."""
+    doc: dict = {}
+    seen = set()
+    for action in _leaf_actions(parser):
+        if action.dest in ("help", "command", "config") or \
+                not action.option_strings or action.dest in seen or \
+                action.default in (None, argparse.SUPPRESS):
+            continue
+        seen.add(action.dest)
+        path = CONFIG_PATHS.get(action.dest, _flag_name(action))
+        node = doc
+        parts = path.split(".")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+            if not isinstance(node, dict):  # flat/nested name clash
+                node = None
+                break
+        if node is not None:
+            node[parts[-1]] = action.default
+    import yaml
+    with open(out_path, "w") as f:
+        yaml.safe_dump(doc, f, sort_keys=True)
+    return out_path
