@@ -1,0 +1,198 @@
+"""BoltDB validation loop (round-2/3 ask, closed in round 4).
+
+Two independent paths must agree for EVERY vendored fixture:
+    YAML → load_fixture_files → build_table
+    YAML → bolt_writer (real bbolt page layouts) → BoltDB reader →
+        load_fixture_docs → build_table
+A shared format misunderstanding between tests/bolt_writer.py and
+trivy_tpu/db/boltdb.py cannot hide here: the left side never touches
+the bolt format at all, so any disagreement is a real reader/writer
+defect. The fuzz matrix varies page size, branch depth (leaf_cap),
+inline-bucket thresholds, and value sizes (overflow chains).
+"""
+
+import glob
+import json
+import os
+import random
+
+import pytest
+
+from bolt_writer import write_bolt
+from trivy_tpu.db.boltdb import BoltDB, to_docs
+from trivy_tpu.db.fixtures import load_fixture_docs, load_fixture_file_docs
+from trivy_tpu.db.table import build_table
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = sorted(glob.glob(os.path.join(HERE, "golden", "db", "*.yaml")))
+
+# the SAME loader the production fixture path uses — the left side of
+# the equality must be the exact docs the golden gate scans with
+_load_yaml_docs = load_fixture_file_docs
+
+
+def _docs_to_tree(docs: list) -> dict:
+    """Fixture documents → nested bolt bucket tree (what the
+    reference's bolt-fixtures loader writes, pkg/dbtest/db.go)."""
+    def convert(pairs, out=None):
+        out = {} if out is None else out
+        for p in pairs:
+            if "bucket" in p:
+                name = str(p["bucket"])
+                if isinstance(out.get(name), dict):
+                    # duplicate bucket: bolt CreateBucketIfNotExists
+                    # merges into the existing one
+                    convert(p.get("pairs") or [], out[name])
+                else:
+                    out[name] = convert(p.get("pairs") or [])
+            else:
+                out[str(p["key"])] = json.dumps(
+                    p.get("value"), sort_keys=True,
+                    default=_json_datetime).encode()
+        return out
+
+    tree = {}
+    for doc in docs:
+        name = str(doc["bucket"])
+        if isinstance(tree.get(name), dict):
+            convert(doc.get("pairs") or [], tree[name])
+        else:
+            tree[name] = convert(doc.get("pairs") or [])
+    return tree
+
+
+def _json_datetime(v):
+    """Unquoted YAML timestamps parse as datetime; bolt JSON carries
+    them as ISO strings (the same conversion the Go loader applies)."""
+    s = v.isoformat()
+    return s.replace("+00:00", "Z") if getattr(v, "tzinfo", None) \
+        else s + "Z"
+
+
+def _norm_details(details: dict):
+    return json.loads(json.dumps(details, sort_keys=True,
+                                 default=_json_datetime))
+
+
+def _canonical(table):
+    """Order-independent table content: every group with all metadata,
+    interval rows, and raw specs, plus details and aux."""
+    groups = sorted(
+        (g.source, g.ecosystem, g.pkg_name, g.vuln_id, g.fixed_version,
+         g.status, g.severity,
+         json.dumps(g.data_source, sort_keys=True),
+         tuple(g.vendor_ids), tuple(g.arches), tuple(g.cpe_indices),
+         g.raw_specs,
+         tuple(sorted(((p, iv.lo, iv.lo_incl, iv.hi, iv.hi_incl)
+                       for p, iv in g.rows),
+                      key=lambda r: tuple(map(str, r)))))
+        for g in table.groups)
+    return groups
+
+
+@pytest.mark.parametrize(
+    "path", FIXTURES, ids=[os.path.basename(p) for p in FIXTURES])
+def test_yaml_vs_bolt_table_equality(path, tmp_path):
+    docs = _load_yaml_docs(path)
+    advs_a, details_a, sources_a = load_fixture_docs(docs)
+    table_a = build_table(advs_a, details_a)
+
+    bolt = str(tmp_path / "trivy.db")
+    write_bolt(bolt, _docs_to_tree(docs))
+    advs_b, details_b, sources_b = load_fixture_docs(to_docs(bolt))
+    table_b = build_table(advs_b, details_b)
+
+    assert len(table_a) == len(table_b)
+    assert _canonical(table_a) == _canonical(table_b)
+    assert _norm_details(details_a) == _norm_details(details_b)
+    assert sources_a.get("Red Hat CPE") == sources_b.get("Red Hat CPE")
+
+
+def test_all_fixtures_combined_equality(tmp_path):
+    """The full merged corpus through both paths — the exact table the
+    golden gate scans with."""
+    docs = []
+    for p in FIXTURES:
+        docs.extend(_load_yaml_docs(p))
+    advs_a, details_a, _ = load_fixture_docs(docs)
+    table_a = build_table(advs_a, details_a)
+
+    bolt = str(tmp_path / "trivy.db")
+    write_bolt(bolt, _docs_to_tree(docs))
+    advs_b, details_b, _ = load_fixture_docs(to_docs(bolt))
+    table_b = build_table(advs_b, details_b)
+    assert len(table_a) == len(table_b) > 100
+    assert _canonical(table_a) == _canonical(table_b)
+    assert _norm_details(details_a) == _norm_details(details_b)
+
+
+@pytest.mark.parametrize("page_size", [512, 1024, 4096, 16384])
+@pytest.mark.parametrize("leaf_cap", [2, 5, 64])
+@pytest.mark.parametrize("inline_threshold", [0, 256])
+def test_fuzz_matrix_roundtrip(page_size, leaf_cap, inline_threshold,
+                               tmp_path):
+    """Random trees across the page-size × branch-depth × inline-bucket
+    matrix: the reader must reproduce the exact tree (raw bytes mode),
+    including values long enough to need overflow pages."""
+    rng = random.Random(page_size * 1000 + leaf_cap * 10
+                        + inline_threshold)
+
+    def rand_tree(depth):
+        out = {}
+        for _ in range(rng.randint(1, 12)):
+            key = "".join(rng.choices("abcdefghij:/.-_ 0123456789",
+                                      k=rng.randint(1, 24)))
+            # the root of a real trivy.db holds only buckets
+            if depth == 0 or (depth < 3 and rng.random() < 0.3):
+                out[key] = rand_tree(depth + 1)
+            else:
+                # include values larger than a page → overflow chains
+                size = rng.choice([0, 3, 40, 700, page_size + 37,
+                                   3 * page_size])
+                out[key] = bytes(rng.getrandbits(8)
+                                 for _ in range(size))
+        return out
+
+    tree = rand_tree(0)
+    bolt = str(tmp_path / "f.db")
+    write_bolt(bolt, tree, page_size=page_size, leaf_cap=leaf_cap,
+               inline_threshold=inline_threshold)
+
+    def docs_to_plain(pairs):
+        out = {}
+        for p in pairs:
+            if "bucket" in p:
+                out[p["bucket"]] = docs_to_plain(p.get("pairs") or [])
+            else:
+                out[p["key"]] = p["value"]
+        return out
+
+    got = {d["bucket"]: docs_to_plain(d.get("pairs") or [])
+           for d in to_docs(bolt, decode_json=False)}
+    assert got == tree
+
+
+def test_fuzz_deep_branch_pages(tmp_path):
+    """Hundreds of keys at leaf_cap=2 force multi-level branch pages."""
+    tree = {"bucket": {f"key{i:05d}": f"v{i}".encode()
+                       for i in range(400)}}
+    bolt = str(tmp_path / "deep.db")
+    write_bolt(bolt, tree, page_size=512, leaf_cap=2)
+    docs = to_docs(bolt, decode_json=False)
+    got = {p["key"]: p["value"] for p in docs[0]["pairs"]}
+    assert got == tree["bucket"]
+
+
+def test_bolt_reader_rejects_truncated_file(tmp_path):
+    from trivy_tpu.db.boltdb import BoltError
+    tree = {"b": {"k": b"v"}}
+    bolt = str(tmp_path / "t.db")
+    write_bolt(bolt, tree)
+    with open(bolt, "rb") as f:
+        head = f.read(3000)
+    trunc = str(tmp_path / "trunc.db")
+    with open(trunc, "wb") as f:
+        f.write(head)
+    with pytest.raises((BoltError, ValueError, OSError)):
+        with BoltDB(trunc) as db:
+            list(db.buckets())

@@ -230,18 +230,22 @@ def _strip_defective_entries(text: str) -> str:
     return "\n".join(l for i, l in enumerate(lines) if i not in drop)
 
 
+def load_fixture_file_docs(path: str) -> list:
+    """One fixture file → raw document list, with the defective-entry
+    drop applied only when strict YAML fails (so a line that merely
+    LOOKS like `- "...",` inside a legitimate block scalar is never
+    touched)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        loaded = yaml.safe_load(text)
+    except yaml.YAMLError:
+        loaded = yaml.safe_load(_strip_defective_entries(text))
+    return loaded or []
+
+
 def load_fixture_files(paths: list[str]):
     docs = []
     for p in paths:
-        with open(p) as f:
-            text = f.read()
-        try:
-            loaded = yaml.safe_load(text)
-        except yaml.YAMLError:
-            # only on strict-parse failure (so a line that merely LOOKS
-            # like `- "...",` inside a legitimate block scalar is never
-            # touched): drop the defective entries like the reference
-            loaded = yaml.safe_load(_strip_defective_entries(text))
-        if loaded:
-            docs.extend(loaded)
+        docs.extend(load_fixture_file_docs(p))
     return load_fixture_docs(docs)
