@@ -274,3 +274,94 @@ def test_raw_specs_survive_save_load(tmp_path):
     table.save(p)
     loaded = AdvisoryTable.load(p)
     assert loaded.groups[0].raw_specs == ("~1.2.0", "", "")
+
+
+# ---- npm range semantics (round 4: npm comparer parity) ----------------
+
+@pytest.mark.parametrize("spec,want", [
+    ("1.2.3 - 2.3.4", [Interval("1.2.3", True, "2.3.4", True)]),
+    ("1.2.3 - 2.3", [Interval("1.2.3", True, "2.4", False)]),
+    ("1.2.3 - 2", [Interval("1.2.3", True, "3", False)]),
+])
+def test_npm_hyphen_ranges_parse_to_intervals(spec, want):
+    assert parse_constraint(spec) == want
+
+
+@pytest.mark.parametrize("spec,version,want", [
+    ("1.2.3 - 2.3.4", "2.0.0", True),
+    ("1.2.3 - 2.3.4", "2.3.5", False),
+    ("1.2.3 - 2.3", "2.3.9", True),
+    ("1.2.3 - 2.3", "2.4.0", False),
+])
+def test_npm_hyphen_ranges_eval(spec, version, want):
+    assert eval_constraint("npm", spec, version) is want
+
+
+@pytest.mark.parametrize("spec,version,want", [
+    # prerelease matches only with a same-tuple prerelease comparator
+    ("<1.2.3", "1.2.3-alpha", False),
+    (">=1.2.3-alpha", "1.2.3-beta", True),
+    (">=1.2.3-alpha", "1.2.4-alpha", False),
+    (">1.2.3-alpha, <2.0.0", "1.2.3-beta", True),
+    ("<1.2.3 || >=1.2.3-alpha", "1.2.3-alpha.2", True),
+])
+def test_npm_prerelease_rule(spec, version, want):
+    assert eval_constraint("npm", spec, version) is want
+
+
+def test_non_npm_ecosystems_skip_prerelease_rule():
+    # maven/pip etc. keep plain interval semantics for prereleases
+    assert eval_constraint("pip", "<1.2.3", "1.2.3-alpha") in (True, False)
+    assert eval_constraint("maven", "(,1.2.3)", "1.2.3-alpha") is True
+
+
+def test_detector_npm_prerelease_no_false_positive():
+    """Interval tokens would match 1.2.3-alpha against <1.2.3; the npm
+    host recheck must reject it (node-semver rule)."""
+    hits = _detect_one("npm", "npm::x", "<1.2.3", "1.2.3-alpha")
+    assert hits == []
+    assert _detect_one("npm", "npm::x", "<1.2.3", "1.2.2") != []
+
+
+# ---- bitnami comparer --------------------------------------------------
+
+def test_bitnami_revision_orders_after_release():
+    from trivy_tpu import version as V
+    assert V.compare("bitnami", "1.2.3", "1.2.3-4") < 0
+    assert V.compare("bitnami", "1.2.3-4", "1.2.3-10") < 0
+    assert V.compare("bitnami", "1.2.3-0", "1.2.3") == 0
+    assert V.compare("bitnami", "1.2.3-9", "1.2.4") < 0
+
+
+def test_bitnami_tokens_order_on_device_path():
+    from trivy_tpu import version as V
+    a = V.encode_version("bitnami", "1.2.3").tokens
+    b = V.encode_version("bitnami", "1.2.3-4").tokens
+    assert list(a) != list(b)
+    # lexicographic token order must agree with cmp
+    assert (list(a) < list(b)) == (V.compare("bitnami",
+                                             "1.2.3", "1.2.3-4") < 0)
+
+
+def test_detector_bitnami_ecosystem():
+    hits = _detect_one("bitnami", "bitnami::Bitnami Vulnerability Database",
+                       ">=1.0.0, <1.2.3-2", "1.2.3-1")
+    assert [h.vuln_id for h in hits] == ["CVE-2099-0001"]
+    assert _detect_one("bitnami",
+                       "bitnami::Bitnami Vulnerability Database",
+                       ">=1.0.0, <1.2.3-2", "1.2.3-2") == []
+
+
+def test_npm_hyphen_wildcard_upper_bound():
+    """'1.2.3 - 2.x' ⇒ >=1.2.3 <3 (node-semver); must not error."""
+    assert eval_constraint("npm", "1.2.3 - 2.x", "1.5.0") is True
+    assert eval_constraint("npm", "1.2.3 - 2.x", "3.0.0") is False
+    (iv,) = parse_constraint("1.2.3 - 2.x")
+    assert iv.lo == "1.2.3" and iv.hi == "3"
+    assert eval_constraint("npm", "1.2.3 - *", "99.0.0") is True
+
+
+def test_bitnami_four_segment_core():
+    from trivy_tpu import version as V
+    assert V.compare("bitnami", "2.4.56.1", "2.4.56.2") < 0
+    assert V.compare("bitnami", "2.4.56.2", "2.4.56.2-1") < 0

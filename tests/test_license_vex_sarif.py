@@ -101,3 +101,147 @@ class TestSarif:
         assert run["results"][0]["level"] == "error"
         assert run["results"][1]["locations"][0]["physicalLocation"][
             "region"]["startLine"] == 3
+
+
+class TestCSAFVex:
+    DOC = {
+        "document": {"category": "csaf_vex", "csaf_version": "2.0"},
+        "product_tree": {
+            "branches": [{
+                "branches": [{
+                    "product": {
+                        "product_id": "PKG-1",
+                        "product_identification_helper": {
+                            "purl": "pkg:pypi/werkzeug@0.11"},
+                    },
+                }],
+            }],
+            "relationships": [{
+                "category": "default_component_of",
+                "product_reference": "PKG-1",
+                "full_product_name": {"product_id": "APP-PKG-1"},
+            }],
+        },
+        "vulnerabilities": [{
+            "cve": "CVE-2019-14806",
+            "product_status": {"known_not_affected": ["APP-PKG-1"]},
+        }],
+    }
+
+    def _result(self):
+        v = T.DetectedVulnerability(
+            vulnerability_id="CVE-2019-14806", pkg_name="werkzeug",
+            installed_version="0.11",
+            pkg_identifier=T.PkgIdentifier(
+                purl="pkg:pypi/werkzeug@0.11"))
+        return T.Result(target="t", vulnerabilities=[v])
+
+    def test_csaf_suppresses_matching_purl(self, tmp_path):
+        import json as _json
+
+        from trivy_tpu.vex import apply_vex, load_vex_file
+        p = tmp_path / "csaf.json"
+        p.write_text(_json.dumps(self.DOC))
+        sts = load_vex_file(str(p))
+        assert sts and sts[0].status == "not_affected"
+        res = self._result()
+        apply_vex([res], sts)
+        assert res.vulnerabilities == []
+
+    def test_csaf_other_package_kept(self, tmp_path):
+        import json as _json
+
+        from trivy_tpu.vex import apply_vex, load_vex_file
+        p = tmp_path / "csaf.json"
+        p.write_text(_json.dumps(self.DOC))
+        res = self._result()
+        res.vulnerabilities[0].pkg_identifier.purl = \
+            "pkg:pypi/flask@2.0"
+        apply_vex([res], load_vex_file(str(p)))
+        assert len(res.vulnerabilities) == 1
+
+    def test_csaf_without_purls_never_applies(self, tmp_path):
+        import json as _json
+
+        from trivy_tpu.vex import apply_vex, load_vex_file
+        doc = {"document": {}, "product_tree": {},
+               "vulnerabilities": [{
+                   "cve": "CVE-2019-14806",
+                   "product_status": {
+                       "known_not_affected": ["UNRESOLVED"]}}]}
+        p = tmp_path / "csaf.json"
+        p.write_text(_json.dumps(doc))
+        res = self._result()
+        apply_vex([res], load_vex_file(str(p)))
+        assert len(res.vulnerabilities) == 1
+
+
+class TestLicenseClassifier:
+    APACHE = """
+        Apache License
+        Version 2.0, January 2004
+        ... 2. Grant of Copyright License. ...
+        ... 3. Grant of Patent License. ...
+        Unless required by applicable law or agreed to in writing,
+        software distributed under the License is distributed on an
+        "AS IS" BASIS ... limitations under the License.
+    """
+
+    def test_classify_apache(self):
+        from trivy_tpu.licensing import classify_text
+        name, conf = classify_text(self.APACHE)
+        assert name == "Apache-2.0" and conf >= 0.8
+
+    def test_classify_bsd3_beats_bsd2(self):
+        from trivy_tpu.licensing import classify_text
+        bsd3 = """Redistribution and use in source and binary forms,
+        with or without modification, are permitted provided that:
+        1. Redistributions of source code must retain the above
+        copyright notice ... 2. Redistributions in binary form must
+        reproduce the above copyright notice ... 3. Neither the name
+        of the copyright holder nor the names of its contributors ...
+        THIS SOFTWARE IS PROVIDED BY THE COPYRIGHT HOLDERS AND
+        CONTRIBUTORS "AS IS" ..."""
+        name, _conf = classify_text(bsd3)
+        assert name == "BSD-3-Clause"
+
+    def test_below_threshold_is_none(self):
+        from trivy_tpu.licensing import classify_text
+        assert classify_text("just some readme text") is None
+
+    def test_classify_license_file_gate(self):
+        from trivy_tpu.licensing import classify_license_file
+        findings = classify_license_file("pkg/LICENSE",
+                                         self.APACHE.encode())
+        assert findings and findings[0].name == "Apache-2.0"
+        assert findings[0].category in ("notice", "permissive")
+        assert classify_license_file("pkg/main.py",
+                                     self.APACHE.encode()) == []
+
+    def test_license_full_cli_e2e(self, tmp_path):
+        """--license-full reports a Loose File License(s) result; the
+        default scan does not."""
+        import json as _json
+
+        from trivy_tpu.cli import main
+        proj = tmp_path / "p"
+        proj.mkdir()
+        (proj / "LICENSE").write_text(self.APACHE)
+        out = tmp_path / "r.json"
+        rc = main(["fs", str(proj), "--scanners", "vuln,license",
+                   "--license-full", "--db", "tests/fixtures/db/*.yaml",
+                   "--format", "json", "--cache-dir",
+                   str(tmp_path / "c"), "--output", str(out)])
+        assert rc == 0
+        d = _json.load(open(out))
+        loose = [r for r in d.get("Results") or []
+                 if r.get("Class") == "license-file"]
+        assert loose and loose[0]["Licenses"][0]["Name"] == "Apache-2.0"
+
+        rc = main(["fs", str(proj), "--scanners", "vuln,license",
+                   "--db", "tests/fixtures/db/*.yaml",
+                   "--format", "json", "--cache-dir",
+                   str(tmp_path / "c2"), "--output", str(out)])
+        d = _json.load(open(out))
+        assert not [r for r in d.get("Results") or []
+                    if r.get("Class") == "license-file"]

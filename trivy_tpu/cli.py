@@ -44,6 +44,9 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--list-all-pkgs", action="store_true")
     p.add_argument("--include-dev-deps", action="store_true")
     p.add_argument("--secret-config", default="trivy-secret.yaml")
+    p.add_argument("--license-full", action="store_true",
+                   help="also classify license FILES by full text "
+                        "(LICENSE/COPYING/NOTICE)")
     p.add_argument("--exit-code", type=int, default=0)
     p.add_argument("--cache-dir",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
@@ -350,6 +353,9 @@ def _open_cache(args):
     if backend.startswith("redis://"):
         from .fanal.redis_cache import RedisCache
         return RedisCache(backend)
+    if backend.startswith("s3://"):
+        from .fanal.s3_cache import S3Cache
+        return S3Cache(backend)
     if backend == "memory":
         from .fanal.cache import MemoryCache
         return MemoryCache()
@@ -421,9 +427,12 @@ def cmd_image(args) -> int:
         from .fanal.analyzers import AnalyzerGroup
         # image scans disable lockfile analyzers (run.go:167-169)
         sec_scanner, sec_cfg = _secret_scanner(args, scanners)
+        img_disabled = LOCKFILE_ANALYZERS
+        if not getattr(args, "license_full", False):
+            img_disabled = img_disabled + ("license-file",)
         art = ImageArchiveArtifact(
             input_path, cache, scanners=scanners,
-            group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS),
+            group=AnalyzerGroup(disabled=img_disabled),
             secret_scanner=sec_scanner, secret_config_path=sec_cfg)
         ref = None
         if "rekor" in getattr(args, "sbom_sources", ""):
@@ -478,6 +487,8 @@ def cmd_fs(args) -> int:
     else:
         disabled = INDIVIDUAL_PKG_ANALYZERS + ("sbom",)
         artifact_type = T.ArtifactType.FILESYSTEM
+    if not getattr(args, "license_full", False):
+        disabled = disabled + ("license-file",)
     sec_scanner, sec_cfg = _secret_scanner(args, scanners,
                                            root=args.target)
     art = FilesystemArtifact(args.target, cache, scanners=scanners,

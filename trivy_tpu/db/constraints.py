@@ -62,6 +62,32 @@ _BRACKET_RE = re.compile(
     r"(?:(,)\s*([^,\[\]\(\)\s]*)\s*)?([\]\)])\s*(,?)"
 )
 
+# npm hyphen range: "1.2.3 - 2.3.4" (whitespace-delimited dash, so
+# in-version hyphens like 1.0.0-alpha never match)
+_HYPHEN_RE = re.compile(r"(\S+)\s+-\s+(\S+)")
+
+
+def _expand_hyphen(branch: str) -> str:
+    """node-semver hyphen ranges → operator terms: full upper bound is
+    inclusive; a partial one excludes the next release (npm semantics:
+    `1.2.3 - 2.3` ⇒ >=1.2.3 <2.4.0)."""
+    def repl(m):
+        lo, hi = m.group(1), m.group(2)
+        if _is_wildcard_version(hi):
+            # "1.2.3 - 2.x" ⇒ >=1.2.3 <3.0.0; a bare "*" upper bound
+            # leaves the range unbounded above
+            base = _wildcard_interval(hi)
+            if base.hi is None:
+                return f">={lo}"
+            return f">={lo} <{base.hi}"
+        release = re.split(r"[-+]", hi, 1)[0]
+        parts = release.split(".")
+        if len(parts) >= 3:
+            return f">={lo} <={hi}"
+        return f">={lo} <{_bump_release(hi, len(parts) - 1)}"
+
+    return _HYPHEN_RE.sub(repl, branch)
+
 
 def _is_wildcard_version(ver: str) -> bool:
     """go-version wildcard segments: a release segment that is exactly
@@ -159,6 +185,8 @@ def parse_constraint(spec: str) -> list[Interval]:
             continue
         if any(c in branch for c in "[]()|"):
             raise ConstraintError(f"malformed constraint {spec!r}")
+        if " - " in branch:
+            branch = _expand_hyphen(branch)
         iv = Interval()
         for op, ver in _split_terms(branch, spec):
             if op in _OPS_EVAL or _is_wildcard_version(ver):
@@ -272,17 +300,38 @@ def _in_interval(eco: str, iv: Interval, version: str, compare) -> bool:
     return ok
 
 
+_NPM_ECOS = ("npm", "node", "yarn", "pnpm")
+
+
+def _semver_tuple(v: str):
+    """(major, minor, patch) release tuple, or None if not semver-ish."""
+    m = re.match(r"^v?(\d+)(?:\.(\d+))?(?:\.(\d+))?", v.strip())
+    if not m:
+        return None
+    return tuple(int(x or 0) for x in m.groups())
+
+
+def _has_prerelease(v: str) -> bool:
+    return "-" in v.split("+", 1)[0]
+
+
 def eval_constraint(ecosystem: str, spec: str, version: str) -> bool:
     """Evaluate the FULL constraint grammar against ``version`` host-side.
 
     Covers everything :func:`parse_constraint` does plus ``!=``, caret,
-    tilde/pessimistic/compatible-release operators and wildcard segments.
+    tilde/pessimistic/compatible-release operators, wildcard segments,
+    and npm hyphen ranges. For npm-family ecosystems the node-semver
+    prerelease rule applies: a prerelease version only satisfies a
+    branch whose terms include a prerelease comparator on the same
+    [major, minor, patch] tuple (go-npm-version Check semantics).
     Raises :class:`ConstraintError` on grammar it cannot interpret and
     ValueError on unparseable versions — callers mirror the reference's
     warn-and-no-match (compare.go:33-38).
     """
     from .. import version as V
     compare = V.compare
+    npm_gate = ecosystem in _NPM_ECOS and _has_prerelease(version)
+    ver_tuple = _semver_tuple(version) if npm_gate else None
     branches = spec.split("||")
     for branch in branches:
         branch = branch.strip()
@@ -297,8 +346,15 @@ def eval_constraint(ecosystem: str, spec: str, version: str) -> bool:
             continue
         if any(c in branch for c in "[]()|"):
             raise ConstraintError(f"malformed constraint {spec!r}")
+        if " - " in branch:
+            branch = _expand_hyphen(branch)
+        terms = _split_terms(branch, spec)
+        if npm_gate and not any(
+                _has_prerelease(tv) and _semver_tuple(tv) == ver_tuple
+                for _op, tv in terms):
+            continue  # no same-tuple prerelease comparator in branch
         ok = True
-        for op, ver in _split_terms(branch, spec):
+        for op, ver in terms:
             if not ok:
                 break
             if op == "!=":

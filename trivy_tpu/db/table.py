@@ -265,9 +265,14 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
                              hi_tok if hi_tok is not None else pad_row,
                              flags))
             g.rows.append((positive, iv))
-        if raw_fallback:
+        if adv.vulnerable_ranges:
+            # language advisories always carry their raw constraint
+            # strings: host rechecks (inexact tokens, npm prerelease
+            # queries) evaluate the reference's IsVulnerable semantics
+            # directly instead of the interval approximation
             g.raw_specs = (adv.vulnerable_ranges, adv.patched_versions,
                            adv.unaffected_versions)
+        if raw_fallback:
             g.rows = []
             rows_out = [(pad_row, pad_row, J.INEXACT)]
         for lo_tok, hi_tok, flags in rows_out:

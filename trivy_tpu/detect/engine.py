@@ -98,6 +98,12 @@ class BatchDetector:
             # parse (alpine.go:96-100 logs debug and continues).
             self._ver_idx[ck] = None
             return None
+        from ..db.constraints import _NPM_ECOS, _has_prerelease
+        if eco in _NPM_ECOS and _has_prerelease(ver):
+            # node-semver prerelease rule: range satisfaction depends
+            # on the constraint's comparators, which interval tokens
+            # can't express — force the exact host recheck
+            k.exact = False
         with self._lock:
             idx = self._ver_idx.get(ck, -1)
             if idx != -1:  # another thread won the slot

@@ -117,9 +117,9 @@ def set_module_analyzers(mods: list) -> None:
 
 
 def _ensure_loaded():
-    from . import (apk, binaries, dpkg, lockfiles,  # noqa: F401
-                   lockfiles_extra, misconf, os_release, python,
-                   redhat, rpm, sbom)
+    from . import (apk, binaries, dpkg, license_file,  # noqa: F401
+                   lockfiles, lockfiles_extra, misconf, os_release,
+                   python, redhat, rpm, sbom)
 
 
 class AnalyzerGroup:

@@ -74,6 +74,7 @@ def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
         detail.misconfigurations.append(mc)
     for blob in blobs:
         detail.custom_resources.extend(blob.custom_resources)
+        detail.licenses.extend(blob.licenses)
 
     detail.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
     _fill_identifiers(detail)

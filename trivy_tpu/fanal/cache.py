@@ -179,7 +179,15 @@ def blob_from_json(j: dict) -> T.BlobInfo:
         misconfigurations=[_misconf_from_json(m)
                            for m in j.get("Misconfigurations", [])],
         secrets=[_secret_from_json(s) for s in j.get("Secrets", [])],
-        licenses=j.get("Licenses", []),
+        licenses=[T.DetectedLicense(
+            severity=li.get("Severity", ""),
+            category=li.get("Category", ""),
+            pkg_name=li.get("PkgName", ""),
+            file_path=li.get("FilePath", ""),
+            name=li.get("Name", ""), text=li.get("Text", ""),
+            confidence=li.get("Confidence", 1.0),
+            link=li.get("Link", ""))
+            for li in j.get("Licenses", [])],
         build_info=T.BuildInfo(
             content_sets=j["BuildInfo"].get("ContentSets", []),
             nvr=j["BuildInfo"].get("Nvr", ""),

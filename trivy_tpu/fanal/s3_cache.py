@@ -1,0 +1,108 @@
+"""S3 cache backend (reference pkg/fanal/cache/s3.go).
+
+Same key scheme as the reference: ``<prefix>fanal/artifact/<id>`` and
+``<prefix>fanal/blob/<id>`` objects holding JSON, existence checked
+with HEAD. Speaks the S3 REST API through the existing sigv4 signer
+(cloud/aws.py) — no SDK. URL format::
+
+    s3://bucket[/prefix]?region=us-east-1[&endpoint=http://host:9000]
+
+A custom ``endpoint`` supports MinIO/localstack and the fake server in
+tests.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+from typing import Optional
+
+from .. import types as T
+from ..cloud.aws import AWSClient, AWSError
+from .cache import blob_from_json
+
+ARTIFACT_DIR = "fanal/artifact"
+BLOB_DIR = "fanal/blob"
+
+
+class S3CacheError(Exception):
+    pass
+
+
+class S3Cache:
+    def __init__(self, url: str, access_key: str = "",
+                 secret_key: str = ""):
+        parsed = urllib.parse.urlparse(url)
+        if parsed.scheme != "s3" or not parsed.netloc:
+            raise S3CacheError(f"invalid s3 cache url: {url!r}")
+        self.bucket = parsed.netloc
+        self.prefix = parsed.path.strip("/")
+        q = urllib.parse.parse_qs(parsed.query)
+        region = (q.get("region") or ["us-east-1"])[0]
+        endpoint = (q.get("endpoint") or [""])[0]
+        try:
+            self.client = AWSClient(region=region, endpoint=endpoint,
+                                    access_key=access_key,
+                                    secret_key=secret_key)
+        except AWSError as e:
+            raise S3CacheError(str(e)) from None
+
+    def _key(self, kind: str, ident: str) -> str:
+        # raw path — the sigv4 signer canonical-encodes it exactly once
+        # (pre-quoting here would double-encode and break the signature
+        # against any verifying endpoint); cache ids ("sha256:...") are
+        # URL-path-safe as-is
+        parts = [p for p in (self.prefix, kind, ident) if p]
+        return "/" + self.bucket + "/" + "/".join(parts)
+
+    def _put(self, kind: str, ident: str, doc: dict):
+        body = json.dumps(doc, sort_keys=True).encode()
+        try:
+            self.client.request("s3", "PUT", self._key(kind, ident),
+                                body=body)
+        except AWSError as e:
+            raise S3CacheError(f"put {kind}/{ident}: {e}") from None
+
+    def _get(self, kind: str, ident: str) -> Optional[dict]:
+        try:
+            raw = self.client.request("s3", "GET",
+                                      self._key(kind, ident))
+        except AWSError as e:
+            if "HTTP 404" in str(e):
+                return None
+            raise S3CacheError(f"get {kind}/{ident}: {e}") from None
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return None
+
+    def _exists(self, kind: str, ident: str) -> bool:
+        try:
+            self.client.request("s3", "HEAD", self._key(kind, ident))
+            return True
+        except AWSError as e:
+            if "HTTP 404" in str(e):
+                return False
+            raise S3CacheError(f"head {kind}/{ident}: {e}") from None
+
+    # ---- cache interface (fanal/cache.py contract) --------------------
+
+    def put_artifact(self, artifact_id: str, info: dict):
+        self._put(ARTIFACT_DIR, artifact_id, info)
+
+    def put_blob(self, blob_id: str, blob: T.BlobInfo):
+        self._put(BLOB_DIR, blob_id, blob.to_json())
+
+    def get_artifact(self, artifact_id: str) -> Optional[dict]:
+        return self._get(ARTIFACT_DIR, artifact_id)
+
+    def get_blob(self, blob_id: str) -> Optional[T.BlobInfo]:
+        doc = self._get(BLOB_DIR, blob_id)
+        return blob_from_json(doc) if doc is not None else None
+
+    def missing_blobs(self, artifact_id: str,
+                      blob_ids: list[str]) -> tuple[bool, list[str]]:
+        missing = [bid for bid in blob_ids
+                   if not self._exists(BLOB_DIR, bid)]
+        return not self._exists(ARTIFACT_DIR, artifact_id), missing

@@ -115,3 +115,133 @@ def _custom_category(name: str, categories: dict | None):
         if name in names:
             return cat
     return None
+
+
+# ---- full-text classification (reference pkg/licensing/classifier.go
+# via google/licenseclassifier; here: distinctive-phrase scoring) ------
+
+# Distinctive phrases per license, drawn from the canonical public
+# texts. A phrase "hits" when present in the normalized input; the
+# confidence is the hit fraction. Phrases are chosen to be mutually
+# discriminative (e.g. only Apache-2.0 contains "grant of patent
+# license"; only GPL-3.0 has "basic permissions").
+_CLASSIFY_PHRASES = {
+    "MIT": [
+        "permission is hereby granted free of charge",
+        "to deal in the software without restriction",
+        "the above copyright notice and this permission notice shall "
+        "be included in all copies",
+        "the software is provided as is without warranty of any kind",
+    ],
+    "Apache-2.0": [
+        "apache license",
+        "grant of patent license",
+        "grant of copyright license",
+        "unless required by applicable law or agreed to in writing",
+        "limitations under the license",
+    ],
+    "GPL-3.0": [
+        "gnu general public license",
+        "version 3",
+        "basic permissions",
+        "protecting users legal rights from anti circumvention law",
+        "conveying non source forms",
+    ],
+    "GPL-2.0": [
+        "gnu general public license",
+        "version 2",
+        "the licenses for most software are designed to take away",
+        "we protect your rights with two steps",
+    ],
+    "LGPL-2.1": [
+        "gnu lesser general public license",
+        "version 2 1",
+        "when we speak of free software we are referring to freedom",
+    ],
+    "BSD-3-Clause": [
+        "redistribution and use in source and binary forms",
+        "redistributions of source code must retain the above "
+        "copyright notice",
+        "neither the name of",
+        "this software is provided by the copyright holders and "
+        "contributors as is",
+    ],
+    "BSD-2-Clause": [
+        "redistribution and use in source and binary forms",
+        "redistributions in binary form must reproduce the above "
+        "copyright notice",
+        "this software is provided by the copyright holders and "
+        "contributors as is",
+    ],
+    "ISC": [
+        "permission to use copy modify and or distribute this "
+        "software for any purpose",
+        "the software is provided as is and the author disclaims all "
+        "warranties",
+    ],
+    "MPL-2.0": [
+        "mozilla public license",
+        "version 2 0",
+        "means covered software of that particular contributor",
+        "source code form",
+    ],
+    "Unlicense": [
+        "this is free and unencumbered software released into the "
+        "public domain",
+        "anyone is free to copy modify publish use compile sell or "
+        "distribute this software",
+    ],
+}
+
+import re as _re
+
+_NORM_RE = _re.compile(r"[^a-z0-9]+")
+
+
+def _normalize_text(text: str) -> str:
+    return " " + _NORM_RE.sub(" ", text.lower()).strip() + " "
+
+
+def classify_text(text: str, confidence_level: float = 0.6):
+    """→ (license name, confidence) of the best-scoring license, or
+    None below the threshold (Classify's confidenceLevel gate,
+    classifier.go:35-58)."""
+    norm = _normalize_text(text)
+    best = None
+    for name, phrases in _CLASSIFY_PHRASES.items():
+        hits = sum(1 for p in phrases if " " + p + " " in norm)
+        conf = hits / len(phrases)
+        # tie-break: BSD-3 over BSD-2 and GPL-3 over GPL-2 need full
+        # distinctive coverage, so strictly-greater keeps the more
+        # specific match when it scores higher
+        if conf > confidence_level and \
+                (best is None or conf > best[1]):
+            best = (name, conf)
+    return best
+
+
+LICENSE_FILE_NAMES = {
+    "license", "license.txt", "license.md", "licence", "licence.txt",
+    "copying", "copying.txt", "notice", "copyright",
+}
+
+
+def classify_license_file(path: str, content: bytes,
+                          confidence_level: float = 0.6
+                          ) -> list[T.DetectedLicense]:
+    """File-level classification for --license-full → DetectedLicense
+    findings (reference pkg/fanal/analyzer/licensing → Classify)."""
+    base = path.rsplit("/", 1)[-1].lower()
+    if base not in LICENSE_FILE_NAMES:
+        return []
+    text = content.decode("utf-8", errors="replace")
+    hit = classify_text(text, confidence_level)
+    if hit is None:
+        return []
+    name, conf = hit
+    cat = categorize(name)
+    return [T.DetectedLicense(
+        severity=CATEGORY_SEVERITY.get(cat, "UNKNOWN"),
+        category=cat, file_path=path, name=name,
+        confidence=round(conf, 2),
+        link=f"https://spdx.org/licenses/{name}.html")]

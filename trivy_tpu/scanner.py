@@ -172,6 +172,16 @@ class LocalScanner:
                     clazz=T.ResultClass.LICENSE,
                     licenses=licenses,
                 ))
+            if detail.licenses:
+                # full-text classified license FILES (--license-full,
+                # reference pkg/scanner/local/scan.go scanLicenses
+                # "Loose File License(s)" result)
+                results.append(T.Result(
+                    target="Loose File License(s)",
+                    clazz=T.ResultClass.LICENSE_FILE,
+                    licenses=sorted(detail.licenses,
+                                    key=lambda l: (l.file_path, l.name)),
+                ))
 
         # extension-module post-scan hooks (reference post.Scan at
         # pkg/scanner/local/scan.go:162; custom resources travel as a

@@ -33,6 +33,9 @@ class ServerState:
         if cache_backend.startswith("redis://"):
             from ..fanal.redis_cache import RedisCache
             self.cache = RedisCache(cache_backend)
+        elif cache_backend.startswith("s3://"):
+            from ..fanal.s3_cache import S3Cache
+            self.cache = S3Cache(cache_backend)
         else:
             self.cache = FSCache(cache_dir)
         self.token = token

@@ -16,7 +16,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from . import apk, deb, encode, gem, maven, pep440, rpm, semver
+from . import apk, bitnami, deb, encode, gem, maven, pep440, rpm, semver
 
 # scheme name -> module with tokenize()/cmp() (+ optional PAD_TOKEN)
 _SCHEMES = {
@@ -27,6 +27,7 @@ _SCHEMES = {
     "pep440": pep440,
     "gem": gem,
     "maven": maven,
+    "bitnami": bitnami,
 }
 
 # ecosystem/OS-family -> scheme (reference comparer tables)
@@ -55,6 +56,9 @@ ECOSYSTEM_SCHEME = {
     "rubygems": "gem", "bundler": "gem", "gemspec": "gem",
     "maven": "maven", "jar": "maven", "pom": "maven", "gradle": "maven",
     "go": "semver", "k8s": "semver", "julia": "semver",
+    # Bitnami repackaged apps: numeric -N revision AFTER the upstream
+    # version (driver.go:78-80, compare/bitnami)
+    "bitnami": "bitnami",
 }
 
 KEY_WIDTH = encode.KEY_WIDTH

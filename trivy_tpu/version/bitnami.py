@@ -1,0 +1,44 @@
+"""Bitnami version ordering.
+
+Bitnami packages version as ``<upstream>-<revision>`` where the suffix
+is a NUMERIC repackaging revision — ``1.2.3-4`` is four revisions
+AFTER 1.2.3, not a prerelease before it (the opposite of semver's
+``-`` semantics). Mirrors the reference's bitnami comparer
+(pkg/detector/library/compare/bitnami/compare.go via
+bitnami/go-version: Version{major, minor, patch, revision}).
+
+Token layout: ``[N(major) N(minor) N(patch) RELEASE N(revision)]`` —
+RELEASE keeps any hypothetical prerelease-style encoding ordered
+before every revision, and revision 0 (absent) compares equal to an
+explicit ``-0``.
+"""
+
+from __future__ import annotations
+
+import re
+
+from . import encode as E
+
+_RE = re.compile(
+    r"^v?(?P<core>\d+(?:\.\d+){0,3})(?:-(?P<rev>\d+))?$"
+)
+
+
+def _parse(v: str):
+    m = _RE.match(v.strip())
+    if not m:
+        raise ValueError(f"invalid bitnami version: {v!r}")
+    nums = [int(x) for x in m.group("core").split(".")]
+    while len(nums) < 4:  # 4-segment cores occur (e.g. apache 2.4.56.1)
+        nums.append(0)
+    return nums, int(m.group("rev") or 0)
+
+
+def tokenize(v: str) -> list[int]:
+    nums, rev = _parse(v)
+    return [E.num_tok(n) for n in nums] + [E.RELEASE, E.num_tok(rev)]
+
+
+def cmp(a: str, b: str) -> int:
+    ka, kb = _parse(a), _parse(b)
+    return (ka > kb) - (ka < kb)

@@ -29,7 +29,10 @@ def load_vex_file(path: str) -> list[VexStatement]:
         return _openvex(doc)
     if doc.get("bomFormat") == "CycloneDX":
         return _cyclonedx_vex(doc)
-    raise ValueError("unrecognized VEX format (want OpenVEX or CycloneDX)")
+    if "document" in doc and "vulnerabilities" in doc:  # CSAF VEX
+        return _csaf(doc)
+    raise ValueError(
+        "unrecognized VEX format (want OpenVEX, CycloneDX, or CSAF)")
 
 
 def _openvex(doc: dict) -> list[VexStatement]:
@@ -66,6 +69,54 @@ def _cyclonedx_vex(doc: dict) -> list[VexStatement]:
             status=status,
             justification=analysis.get("justification", ""),
             products=tuple(a.get("ref", "") for a in v.get("affects", []))))
+    return out
+
+
+def _csaf(doc: dict) -> list[VexStatement]:
+    """CSAF VEX (reference pkg/vex/csaf.go): per-vulnerability
+    product_status lists product ids; the product tree (branches +
+    relationships) resolves each id to purls."""
+    purls: dict[str, list[str]] = {}
+
+    def walk_branches(node):
+        for br in node.get("branches") or []:
+            prod = br.get("product") or {}
+            pid = prod.get("product_id")
+            p = (prod.get("product_identification_helper") or {}) \
+                .get("purl")
+            if pid and p:
+                purls.setdefault(pid, []).append(p)
+            walk_branches(br)
+
+    tree = doc.get("product_tree") or {}
+    walk_branches(tree)
+    # relationships: "pkg as a component of product" — the combined
+    # product id inherits the referenced package's purls
+    # (csaf.go inspectProductRelationships)
+    for rel in tree.get("relationships") or []:
+        full = (rel.get("full_product_name") or {}).get("product_id")
+        ref = rel.get("product_reference")
+        if full and ref and ref in purls:
+            purls.setdefault(full, []).extend(purls[ref])
+
+    out = []
+    for v in doc.get("vulnerabilities") or []:
+        cve = v.get("cve", "")
+        status_map = {"known_not_affected": "not_affected",
+                      "fixed": "fixed"}
+        for key, status in status_map.items():
+            pids = (v.get("product_status") or {}).get(key) or []
+            products = tuple(p for pid in pids
+                             for p in purls.get(pid, ()))
+            if not cve or not pids:
+                continue
+            # CSAF statements never apply to everything: without a
+            # resolvable purl the statement cannot match (csaf.go
+            # match returns "" on nil purl)
+            if not products:
+                continue
+            out.append(VexStatement(vuln_id=cve, status=status,
+                                    products=products))
     return out
 
 
