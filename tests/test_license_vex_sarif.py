@@ -245,3 +245,43 @@ class TestLicenseClassifier:
         d = _json.load(open(out))
         assert not [r for r in d.get("Results") or []
                     if r.get("Class") == "license-file"]
+
+    def test_license_file_analyzer_optin_everywhere(self):
+        """A default AnalyzerGroup (k8s image scans, artifact
+        defaults) must NOT run the full-text classifier."""
+        from trivy_tpu.fanal.analyzers import AnalyzerGroup
+        default_names = {a.name for a in AnalyzerGroup().analyzers}
+        assert "license-file" not in default_names
+        on = {a.name for a in
+              AnalyzerGroup(enabled=("license-file",)).analyzers}
+        assert "license-file" in on
+
+    def test_csaf_chained_relationships_parent_first(self, tmp_path):
+        import json as _json
+
+        from trivy_tpu.vex import load_vex_file
+        doc = {
+            "document": {},
+            "product_tree": {
+                "branches": [{"branches": [{"product": {
+                    "product_id": "PKG-1",
+                    "product_identification_helper": {
+                        "purl": "pkg:pypi/werkzeug@0.11"}}}]}],
+                # parent listed BEFORE the relationship that defines
+                # its reference — needs fixed-point resolution
+                "relationships": [
+                    {"product_reference": "APP-PKG-1",
+                     "full_product_name": {"product_id": "HOST-APP"}},
+                    {"product_reference": "PKG-1",
+                     "full_product_name": {"product_id": "APP-PKG-1"}},
+                ],
+            },
+            "vulnerabilities": [{
+                "cve": "CVE-2019-14806",
+                "product_status": {"known_not_affected": ["HOST-APP"]},
+            }],
+        }
+        p = tmp_path / "c.json"
+        p.write_text(_json.dumps(doc))
+        sts = load_vex_file(str(p))
+        assert sts and "pkg:pypi/werkzeug@0.11" in sts[0].products

@@ -427,12 +427,12 @@ def cmd_image(args) -> int:
         from .fanal.analyzers import AnalyzerGroup
         # image scans disable lockfile analyzers (run.go:167-169)
         sec_scanner, sec_cfg = _secret_scanner(args, scanners)
-        img_disabled = LOCKFILE_ANALYZERS
-        if not getattr(args, "license_full", False):
-            img_disabled = img_disabled + ("license-file",)
+        optin = ("license-file",) if getattr(args, "license_full",
+                                             False) else ()
         art = ImageArchiveArtifact(
             input_path, cache, scanners=scanners,
-            group=AnalyzerGroup(disabled=img_disabled),
+            group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS,
+                                enabled=optin),
             secret_scanner=sec_scanner, secret_config_path=sec_cfg)
         ref = None
         if "rekor" in getattr(args, "sbom_sources", ""):
@@ -487,12 +487,13 @@ def cmd_fs(args) -> int:
     else:
         disabled = INDIVIDUAL_PKG_ANALYZERS + ("sbom",)
         artifact_type = T.ArtifactType.FILESYSTEM
-    if not getattr(args, "license_full", False):
-        disabled = disabled + ("license-file",)
+    optin = ("license-file",) if getattr(args, "license_full",
+                                         False) else ()
     sec_scanner, sec_cfg = _secret_scanner(args, scanners,
                                            root=args.target)
     art = FilesystemArtifact(args.target, cache, scanners=scanners,
-                             group=AnalyzerGroup(disabled=disabled),
+                             group=AnalyzerGroup(disabled=disabled,
+                                                 enabled=optin),
                              secret_scanner=sec_scanner,
                              secret_config_path=sec_cfg)
     ref = art.inspect()

@@ -83,6 +83,8 @@ class BatchDetector:
         # (server/listen.py ThreadingHTTPServer): slot allocation and
         # pool growth are check-then-act and need the lock
         self._lock = threading.Lock()
+        self._g_arrays = None
+        self._g_arrays_len = -1
 
     # ---- memo pools ---------------------------------------------------
 
@@ -268,15 +270,42 @@ class BatchDetector:
         np.logical_or.at(neg_any, seg, sat[order] & neg[order])
         np.logical_or.at(inex_any, seg, inexact[order])
 
-        hits: list[Hit] = []
         pkg_of = (uniq // (len(t.groups) + 1)).astype(np.int64)
         gid_of = (uniq % (len(t.groups) + 1)).astype(np.int64)
-        for u in range(uniq.shape[0]):
+
+        # vectorized verification: the collision guard (name+source
+        # equality) runs as two numpy object-array compares instead of
+        # a Python loop over every (query, group) pair; only scoped
+        # (arch/CPE) or inexact pairs take the slow per-item path.
+        # On dense workloads (~45k reported groups per 256-image batch)
+        # this is the difference between the assembly dominating the
+        # device time and not.
+        g_name, g_source, g_scoped = self._group_arrays()
+        q_name = np.array([q.name for q, _ in prep.usable], dtype=object)
+        q_source = np.array([q.source for q, _ in prep.usable],
+                            dtype=object)
+        q_exact = np.fromiter((e for _, e in prep.usable), bool,
+                              count=len(prep.usable))
+
+        ok = (g_name[gid_of] == q_name[pkg_of]) & \
+            (g_source[gid_of] == q_source[pkg_of])
+        slow = ok & (g_scoped[gid_of] | inex_any | ~q_exact[pkg_of])
+        fast = ok & ~slow & pos_any & ~neg_any
+
+        usable = prep.usable
+        groups = t.groups
+        hits: list[Hit] = [
+            Hit(query=usable[i][0], vuln_id=g.vuln_id,
+                fixed_version=g.fixed_version, status=g.status,
+                severity=g.severity, data_source=g.data_source,
+                vendor_ids=g.vendor_ids)
+            for i, g in ((int(pkg_of[u]), groups[int(gid_of[u])])
+                         for u in np.nonzero(fast)[0])
+        ]
+        for u in np.nonzero(slow)[0]:
             i = int(pkg_of[u])
-            g = t.groups[int(gid_of[u])]
-            q, ver_exact = prep.usable[i]
-            if g.pkg_name != q.name or g.source != q.source:
-                continue  # 64-bit hash collision: reject
+            g = groups[int(gid_of[u])]
+            q, ver_exact = usable[i]
             if g.arches and q.arch and q.arch not in g.arches:
                 continue  # advisory scoped to other architectures
             if g.cpe_indices and not \
@@ -293,6 +322,26 @@ class BatchDetector:
                     severity=g.severity, data_source=g.data_source,
                     vendor_ids=g.vendor_ids))
         return hits
+
+    def _group_arrays(self):
+        """Cached per-table verification arrays (names, sources, and a
+        scoped flag for arch/CPE-gated groups). Built under the lock —
+        the detector is shared across server handler threads."""
+        if self._g_arrays is None or \
+                self._g_arrays_len != len(self.table.groups):
+            with self._lock:
+                if self._g_arrays is None or \
+                        self._g_arrays_len != len(self.table.groups):
+                    gs = self.table.groups
+                    arrays = (
+                        np.array([g.pkg_name for g in gs], dtype=object),
+                        np.array([g.source for g in gs], dtype=object),
+                        np.fromiter((bool(g.arches or g.cpe_indices)
+                                     for g in gs), bool, count=len(gs)),
+                    )
+                    self._g_arrays_len = len(gs)
+                    self._g_arrays = arrays
+        return self._g_arrays
 
     def _exact_eval(self, g, q: PkgQuery) -> tuple[bool, bool]:
         """Host fallback: evaluate the group's intervals with the exact

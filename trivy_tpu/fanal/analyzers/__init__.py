@@ -122,14 +122,21 @@ def _ensure_loaded():
                    python, redhat, rpm, sbom)
 
 
+# analyzers that are opt-in everywhere (reference: license scanning is
+# behind --license-full); excluded from EVERY AnalyzerGroup unless the
+# caller lists them in `enabled`
+OPTIN_ANALYZERS = ("license-file",)
+
+
 class AnalyzerGroup:
-    def __init__(self, disabled: tuple = ()):
+    def __init__(self, disabled: tuple = (), enabled: tuple = ()):
         _ensure_loaded()
+        off = set(disabled) | (set(OPTIN_ANALYZERS) - set(enabled))
         self.analyzers = [cls() for name, cls in sorted(_REGISTRY.items())
-                          if name not in disabled]
+                          if name not in off]
         self.post_analyzers = [
             cls() for name, cls in sorted(_POST_REGISTRY.items())
-            if name not in disabled]
+            if name not in off]
 
     def versions(self) -> dict[str, int]:
         """name → version, for cache keys."""

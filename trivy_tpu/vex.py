@@ -92,12 +92,22 @@ def _csaf(doc: dict) -> list[VexStatement]:
     walk_branches(tree)
     # relationships: "pkg as a component of product" — the combined
     # product id inherits the referenced package's purls
-    # (csaf.go inspectProductRelationships)
-    for rel in tree.get("relationships") or []:
-        full = (rel.get("full_product_name") or {}).get("product_id")
-        ref = rel.get("product_reference")
-        if full and ref and ref in purls:
-            purls.setdefault(full, []).extend(purls[ref])
+    # (csaf.go inspectProductRelationships). Iterated to a fixed point:
+    # chained relationships may be listed parent-first.
+    rels = [(r.get("full_product_name") or {}, r.get("product_reference"))
+            for r in tree.get("relationships") or []]
+    changed = True
+    while changed:
+        changed = False
+        for full_name, ref in rels:
+            full = full_name.get("product_id")
+            if not (full and ref and ref in purls):
+                continue
+            have = purls.setdefault(full, [])
+            new = [p for p in purls[ref] if p not in have]
+            if new:
+                have.extend(new)
+                changed = True
 
     out = []
     for v in doc.get("vulnerabilities") or []:
