@@ -124,6 +124,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("target")
     _add_scan_flags(p)
 
+    p = sub.add_parser("vm", help="scan a VM disk image (raw/ebs:snap-id)")
+    p.add_argument("target", help="disk image path or ebs:<snapshot-id>")
+    _add_scan_flags(p)
+
     p = sub.add_parser("convert", help="re-render a saved JSON report")
     p.add_argument("report")
     p.add_argument("--format", "-f", default="table",
@@ -526,6 +530,29 @@ def _secret_scanner(args, scanners, root: str = ""):
                          exclude_regexes=exclude), walk_cfg
 
 
+def cmd_vm(args) -> int:
+    """VM disk image scan (reference pkg/commands/artifact vm)."""
+    from .fanal.analyzers import AnalyzerGroup
+    from .fanal.artifact import VMArtifact
+    from .fanal.cache import MemoryCache
+    _configure_misconf(args)
+    _configure_javadb(args)
+    cache = MemoryCache()
+    scanners = tuple(s.strip() for s in args.scanners.split(","))
+    optin = ("license-file",) if getattr(args, "license_full",
+                                         False) else ()
+    sec_scanner, sec_cfg = _secret_scanner(args, scanners)
+    art = VMArtifact(
+        args.target, cache, scanners=scanners,
+        # VM scans disable lockfile analyzers like image/rootfs scans
+        # (reference run.go:252 ScanVM)
+        group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS + ("sbom",),
+                            enabled=optin),
+        secret_scanner=sec_scanner, secret_config_path=sec_cfg)
+    ref = art.inspect()
+    return _scan_common(args, ref, cache, T.ArtifactType.VM)
+
+
 def cmd_sbom(args) -> int:
     from .fanal.cache import MemoryCache
     from .sbom import decode_sbom_file
@@ -751,7 +778,7 @@ def main(argv=None) -> int:
     if argv:
         from . import plugin as _plugin
         known = {"image", "filesystem", "fs", "rootfs", "repository",
-                 "repo", "sbom", "convert", "server", "k8s",
+                 "repo", "sbom", "vm", "convert", "server", "k8s",
                  "kubernetes", "aws", "version", "plugin", "module",
                  "-h", "--help", "--version"}
         if argv[0] not in known and _plugin.exists(argv[0]):
@@ -780,6 +807,8 @@ def main(argv=None) -> int:
         return cmd_fs(args)
     if cmd == "sbom":
         return cmd_sbom(args)
+    if cmd == "vm":
+        return cmd_vm(args)
     if cmd == "convert":
         return cmd_convert(args)
     if cmd == "server":

@@ -339,8 +339,8 @@ class BatchDetector:
                         np.fromiter((bool(g.arches or g.cpe_indices)
                                      for g in gs), bool, count=len(gs)),
                     )
-                    self._g_arrays_len = len(gs)
                     self._g_arrays = arrays
+                    self._g_arrays_len = len(gs)
         return self._g_arrays
 
     def _exact_eval(self, g, q: PkgQuery) -> tuple[bool, bool]:

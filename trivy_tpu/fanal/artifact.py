@@ -200,14 +200,16 @@ def _blob_path(digest: str) -> str:
     return f"blobs/{algo}/{hexd}"
 
 
-class FilesystemArtifact:
-    """A directory tree as one synthetic blob
-    (pkg/fanal/artifact/local/fs.go:114)."""
+class _SingleBlobArtifact:
+    """Shared assembly for sources that squash to ONE synthetic blob
+    (filesystem trees and VM disk images): walk → blob info → secret
+    scan → content-addressed cache key → cache put."""
 
-    def __init__(self, root: str, cache, group: Optional[AnalyzerGroup] = None,
+    def __init__(self, target: str, cache,
+                 group: Optional[AnalyzerGroup] = None,
                  scanners: tuple = ("vuln",), secret_scanner=None,
                  secret_config_path: str = DEFAULT_SECRET_CONFIG):
-        self.root = root
+        self.target = target
         self.cache = cache
         self.group = group or AnalyzerGroup()
         self.scanners = scanners
@@ -217,24 +219,65 @@ class FilesystemArtifact:
             from ..secret import SecretScanner
             self.secret_scanner = SecretScanner()
 
+    def _walk(self):  # pragma: no cover — subclasses implement
+        raise NotImplementedError
+
+    def _name(self) -> str:
+        return self.target
+
+    ARTIFACT_TYPE = T.ArtifactType.FILESYSTEM
+
     def inspect(self) -> ArtifactReference:
-        want_secrets = "secret" in self.scanners
-        scan = walk_fs(self.root, self.group, collect_secrets=want_secrets,
-                       secret_config_path=self.secret_config_path)
+        scan = self._walk()
         bi = blob_info(scan)
-        if want_secrets and scan.secret_files:
+        if "secret" in self.scanners and scan.secret_files:
             bi.secrets = self.secret_scanner.scan_files(scan.secret_files)
         blob_id = cache_key(self._content_id(bi), self.group.versions(),
                             {"scanners": sorted(self.scanners)})
         self.cache.put_blob(blob_id, bi)
         self.cache.put_artifact(blob_id, {"SchemaVersion": 2})
-        secret_files = {blob_id: scan.secret_files} if scan.secret_files else {}
+        secret_files = {blob_id: scan.secret_files} \
+            if scan.secret_files else {}
         return ArtifactReference(
-            name=os.path.abspath(self.root).rstrip("/"),
-            type=T.ArtifactType.FILESYSTEM,
+            name=self._name(), type=self.ARTIFACT_TYPE,
             id=blob_id, blob_ids=[blob_id], secret_files=secret_files)
 
     @staticmethod
     def _content_id(bi: T.BlobInfo) -> str:
         return "sha256:" + hashlib.sha256(
             json.dumps(bi.to_json(), sort_keys=True).encode()).hexdigest()
+
+
+class FilesystemArtifact(_SingleBlobArtifact):
+    """A directory tree as one synthetic blob
+    (pkg/fanal/artifact/local/fs.go:114)."""
+
+    def __init__(self, root: str, cache, **kw):
+        super().__init__(root, cache, **kw)
+        self.root = root
+
+    def _walk(self):
+        return walk_fs(self.root, self.group,
+                       collect_secrets="secret" in self.scanners,
+                       secret_config_path=self.secret_config_path)
+
+    def _name(self) -> str:
+        return os.path.abspath(self.root).rstrip("/")
+
+
+class VMArtifact(_SingleBlobArtifact):
+    """Raw disk image / EBS snapshot as one synthetic blob (reference
+    pkg/fanal/artifact/vm/vm.go): partition walk + read-only ext4
+    through the same analyzer pipeline as the filesystem artifact."""
+
+    ARTIFACT_TYPE = T.ArtifactType.VM
+
+    def _walk(self):
+        from .vm import open_device, walk_vm
+        dev = open_device(self.target)
+        try:
+            return walk_vm(dev, self.group,
+                           collect_secrets="secret" in self.scanners,
+                           secret_config_path=self.secret_config_path)
+        finally:
+            dev.close()
