@@ -429,3 +429,87 @@ def test_with_data_override():
              data={"settings": {"allowed_name": "alice"}}) is True
     assert q(src, "test.allowed", {"name": "bob"},
              data={"settings": {"allowed_name": "alice"}}) is UNDEF
+
+
+def test_rego_trace_sink_fires():
+    """--trace analog: the process-wide sink sees rule evaluations
+    (reference rego.WithTrace / trivy --trace)."""
+    from trivy_tpu.iac.rego import RegoChecksScanner, set_rego_trace
+    from trivy_tpu.iac.rego.parser import parse_module
+    events = []
+    set_rego_trace(lambda ev, path, depth: events.append((ev, path)))
+    try:
+        mods = [parse_module("""
+package user.test.T1
+
+deny[res] {
+  input.bad == true
+  res := "bad"
+}
+""")]
+        scanner = RegoChecksScanner(mods, namespaces=["user"])
+        scanner.interp.query("user.test.T1.deny", {"bad": True})
+    finally:
+        set_rego_trace(None)
+    assert ("enter", "user.test.T1.deny") in events
+
+
+def test_rego_trace_depth_nesting():
+    """Nested rule references trace with increasing depth and matching
+    exit events."""
+    from trivy_tpu.iac.rego import set_rego_trace
+    from trivy_tpu.iac.rego.eval import Interpreter
+    from trivy_tpu.iac.rego.parser import parse_module
+    events = []
+    mod = parse_module("""
+package user.t
+
+helper {
+  input.x == 1
+}
+
+deny[res] {
+  helper
+  res := "hit"
+}
+""")
+    interp = Interpreter([mod],
+                         trace=lambda e, p, d: events.append((e, p, d)))
+    interp.query("user.t.deny", {"x": 1})
+    assert ("enter", "user.t.deny", 0) in events
+    assert ("enter", "user.t.helper", 1) in events
+    assert ("exit", "user.t.deny", 0) in events
+
+
+def test_interpreter_query_thread_safe():
+    """Concurrent queries on one shared Interpreter (the --parallel
+    walker's custom-checks scanner) must not cross inputs."""
+    import threading
+    from trivy_tpu.iac.rego.eval import Interpreter
+    from trivy_tpu.iac.rego.parser import parse_module
+    mod = parse_module("""
+package user.t
+
+deny[res] {
+  input.bad == true
+  res := "bad"
+}
+""")
+    interp = Interpreter([mod])
+    errors = []
+
+    def work(bad):
+        from trivy_tpu.iac.rego.eval import UNDEF
+        for _ in range(200):
+            out = interp.query("user.t.deny", {"bad": bad})
+            hit = out is not UNDEF and bool(out)
+            if hit != bad:
+                errors.append((bad, out))
+
+    ts = [threading.Thread(target=work, args=(b,))
+          for b in (True, False, True, False)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors

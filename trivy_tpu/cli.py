@@ -47,6 +47,15 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--license-full", action="store_true",
                    help="also classify license FILES by full text "
                         "(LICENSE/COPYING/NOTICE)")
+    p.add_argument("--parallel", type=int, default=1,
+                   help="parallel file readers for fs/repo walks "
+                        "(reference walker --parallel)")
+    p.add_argument("--trace", action="store_true",
+                   help="print rego rule-evaluation traces to stderr "
+                        "(reference --trace)")
+    p.add_argument("--profile-dir", default="",
+                   help="write a jax.profiler trace of the scan to "
+                        "this directory (TensorBoard format)")
     p.add_argument("--exit-code", type=int, default=0)
     p.add_argument("--cache-dir",
                    default=os.path.join(os.path.expanduser("~"), ".cache",
@@ -256,6 +265,20 @@ def _load_table_args(args) -> AdvisoryTable:
 
 
 def _scan_common(args, ref, cache, artifact_type: str) -> int:
+    profile_dir = getattr(args, "profile_dir", "")
+    if profile_dir:
+        # device-level tracing for the whole detect phase (reference
+        # has no device profiler; SURVEY §5 tracing row)
+        import jax
+        jax.profiler.start_trace(profile_dir)
+        try:
+            return _scan_common_inner(args, ref, cache, artifact_type)
+        finally:
+            jax.profiler.stop_trace()
+    return _scan_common_inner(args, ref, cache, artifact_type)
+
+
+def _scan_common_inner(args, ref, cache, artifact_type: str) -> int:
     scanners = tuple(s.strip() for s in args.scanners.split(",") if s.strip())
     # the DB is only initialized when vulnerability scanning is on
     # (reference run.go initScannerConfig: vuln scanner gates DB init)
@@ -340,6 +363,14 @@ def _configure_javadb(args) -> None:
 def _configure_misconf(args) -> None:
     """Install user rego checks before analysis runs (reference wires
     PolicyPaths through misconf.ScannerOption at initScannerConfig)."""
+    if getattr(args, "trace", False):
+        from .iac.rego import set_rego_trace
+
+        def _sink(event, rule_path, depth):
+            print(f"TRACE {'  ' * depth}{event} {rule_path}",
+                  file=sys.stderr)
+
+        set_rego_trace(_sink)
     paths = getattr(args, "config_check", None)
     if paths:
         from .misconf import set_custom_checks
@@ -499,7 +530,8 @@ def cmd_fs(args) -> int:
                              group=AnalyzerGroup(disabled=disabled,
                                                  enabled=optin),
                              secret_scanner=sec_scanner,
-                             secret_config_path=sec_cfg)
+                             secret_config_path=sec_cfg,
+                             parallel=getattr(args, "parallel", 1))
     ref = art.inspect()
     return _scan_common(args, ref, cache, artifact_type)
 

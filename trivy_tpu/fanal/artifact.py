@@ -252,14 +252,16 @@ class FilesystemArtifact(_SingleBlobArtifact):
     """A directory tree as one synthetic blob
     (pkg/fanal/artifact/local/fs.go:114)."""
 
-    def __init__(self, root: str, cache, **kw):
+    def __init__(self, root: str, cache, parallel: int = 1, **kw):
         super().__init__(root, cache, **kw)
         self.root = root
+        self.parallel = parallel
 
     def _walk(self):
         return walk_fs(self.root, self.group,
                        collect_secrets="secret" in self.scanners,
-                       secret_config_path=self.secret_config_path)
+                       secret_config_path=self.secret_config_path,
+                       parallel=self.parallel)
 
     def _name(self) -> str:
         return os.path.abspath(self.root).rstrip("/")

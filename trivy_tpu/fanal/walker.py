@@ -107,9 +107,15 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
 def walk_fs(root: str, group: AnalyzerGroup,
             collect_secrets: bool = False,
             skip_dirs: tuple = (".git",),
-            secret_config_path: str = DEFAULT_SECRET_CONFIG) -> BlobScan:
+            secret_config_path: str = DEFAULT_SECRET_CONFIG,
+            parallel: int = 1) -> BlobScan:
+    """Walk a directory tree through the analyzers. ``parallel`` > 1
+    reads and analyzes candidate files on a thread pool (reference
+    walker/fs.go:73-80 --parallel); per-file results merge back in
+    sorted path order so output is deterministic either way."""
     scan = BlobScan(result=AnalysisResult())
     root = os.path.abspath(root)
+    candidates: list[tuple[str, str, bool, bool, bool]] = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d not in skip_dirs]
         for fn in sorted(filenames):
@@ -123,19 +129,42 @@ def walk_fs(root: str, group: AnalyzerGroup,
             wants_post = group.post_required(rel, size)
             wants_secret = collect_secrets and secret_candidate(
                 rel, size, secret_config_path)
-            if not (wants or wants_post or wants_secret):
-                continue
-            try:
-                with open(full, "rb") as f:
-                    content = f.read()
-            except OSError:
-                continue  # permission errors are skipped (walker/fs.go:24-33)
-            if wants:
-                group.analyze_file(rel, content, scan.result)
-            if wants_post:
-                scan.post_files[rel] = content
-            if wants_secret and not looks_binary(content):
-                scan.secret_files.append((rel, content))
+            if wants or wants_post or wants_secret:
+                candidates.append((rel, full, wants, wants_post,
+                                   wants_secret))
+
+    def process(task):
+        rel, full, wants, wants_post, wants_secret = task
+        try:
+            with open(full, "rb") as f:
+                content = f.read()
+        except OSError:
+            return None  # permission errors skipped (walker/fs.go:24-33)
+        result = None
+        if wants:
+            result = AnalysisResult()
+            group.analyze_file(rel, content, result)
+        return (rel, result,
+                content if wants_post else None,
+                content if wants_secret and not looks_binary(content)
+                else None)
+
+    if parallel > 1 and len(candidates) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=parallel) as ex:
+            outputs = list(ex.map(process, candidates))
+    else:
+        outputs = [process(t) for t in candidates]
+
+    for out in sorted((o for o in outputs if o is not None),
+                      key=lambda o: o[0]):
+        rel, result, post_content, secret_content = out
+        if result is not None:
+            scan.result.merge(result)
+        if post_content is not None:
+            scan.post_files[rel] = post_content
+        if secret_content is not None:
+            scan.secret_files.append((rel, secret_content))
     group.post_analyze(scan.post_files, scan.result)
     return scan
 

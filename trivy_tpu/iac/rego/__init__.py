@@ -165,6 +165,22 @@ def _applicable(sm: StaticMetadata, file_type: str) -> bool:
     return bool(aliases & set(sm.selectors))
 
 
+# process-wide rego evaluation trace sink (reference --trace /
+# rego.WithTrace); set via set_rego_trace, consumed by every
+# Interpreter this package builds
+_TRACE_SINK = None
+
+
+def set_rego_trace(sink) -> None:
+    """sink(event, rule_path, depth) or None to disable."""
+    global _TRACE_SINK
+    _TRACE_SINK = sink
+
+
+def rego_trace():
+    return _TRACE_SINK
+
+
 class RegoChecksScanner:
     """Holds user modules + data and scans parsed config docs."""
 
@@ -172,7 +188,8 @@ class RegoChecksScanner:
                  namespaces=None):
         self.all_modules = modules
         self.namespaces = set(namespaces or []) | DEFAULT_USER_NAMESPACES
-        self.interp = Interpreter(modules, data=data)
+        self.interp = Interpreter(modules, data=data,
+                                  trace=_TRACE_SINK)
 
     @classmethod
     def from_paths(cls, check_paths, data_paths=None, namespaces=None):

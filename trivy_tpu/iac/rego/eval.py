@@ -59,17 +59,24 @@ class Interpreter:
         self.input = UNDEF
         self.trace = trace
         self._depth = 0
+        # query() mutates input/rule_cache for the whole evaluation —
+        # a shared Interpreter (custom-checks scanner under --parallel
+        # walks) must serialize queries
+        import threading
+        self._query_lock = threading.Lock()
 
     # -- public API ----------------------------------------------------
     def query(self, path: str, input_doc=UNDEF):
-        """Evaluate `data.<path>` → value or UNDEF."""
-        self.input = input_doc
-        self.rule_cache = {}
-        parts = tuple(path.split("."))
-        try:
-            return self._data_path(parts)
-        finally:
-            self.input = UNDEF
+        """Evaluate `data.<path>` → value or UNDEF. Thread-safe: the
+        evaluation state (input, rule cache) is per-query."""
+        with self._query_lock:
+            self.input = input_doc
+            self.rule_cache = {}
+            parts = tuple(path.split("."))
+            try:
+                return self._data_path(parts)
+            finally:
+                self.input = UNDEF
 
     def rule_names(self, pkg: tuple) -> list[str]:
         names = []
@@ -119,6 +126,17 @@ class Interpreter:
         key = (pkg, name)
         if key in self.rule_cache:
             return self.rule_cache[key]
+        if self.trace is not None:
+            self.trace("enter", ".".join(pkg + (name,)), self._depth)
+        self._depth += 1
+        try:
+            return self._eval_rule_inner(key, pkg, name)
+        finally:
+            self._depth -= 1
+            if self.trace is not None:
+                self.trace("exit", ".".join(pkg + (name,)), self._depth)
+
+    def _eval_rule_inner(self, key, pkg: tuple, name: str):
         self.rule_cache[key] = UNDEF  # cycle guard
         defs = []
         for m in self.pkg_index.get(pkg, []):

@@ -30,7 +30,8 @@ class IgnorePolicy:
         from ..iac.rego.parser import parse_module
         with open(path, encoding="utf-8") as f:
             mod = parse_module(f.read(), path=path)
-        self.interp = Interpreter([mod])
+        from ..iac.rego import rego_trace
+        self.interp = Interpreter([mod], trace=rego_trace())
         self.pkg = ".".join(mod.package)
 
     _warned = False
