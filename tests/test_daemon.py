@@ -151,3 +151,67 @@ def test_cli_image_src_podman_skips_docker_socket(fake_daemon, tmp_path,
     with pytest.raises(SystemExit, match="image acquisition failed"):
         main(["image", "alpine:3.17", "--image-src", "podman",
               "--db", FIXTURE_DB, "--cache-dir", str(tmp_path)])
+
+
+class TestGitRepoSource:
+    def _make_repo(self, tmp_path):
+        import subprocess
+        src = tmp_path / "src"
+        src.mkdir()
+        subprocess.run(["git", "init", "-q"], cwd=src, check=True)
+        (src / "requirements.txt").write_text("flask==2.2.2\n")
+        env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+               "PATH": os.environ["PATH"]}
+        subprocess.run(["git", "add", "-A"], cwd=src, check=True)
+        subprocess.run(["git", "commit", "-qm", "init"], cwd=src,
+                       check=True, env=env)
+        subprocess.run(["git", "branch", "-q", "feature"], cwd=src,
+                       check=True)
+        (src / "requirements.txt").write_text("flask==2.3.9\n")
+        subprocess.run(["git", "add", "-A"], cwd=src, check=True)
+        subprocess.run(["git", "commit", "-qm", "bump"], cwd=src,
+                       check=True, env=env)
+        return src
+
+    def test_clone_and_scan(self, tmp_path):
+        from trivy_tpu.cli import main
+        src = self._make_repo(tmp_path)
+        out = tmp_path / "r.json"
+        rc = main(["repo", f"file://{src}", "--db", FIXTURE_DB,
+                   "--format", "json", "--cache-dir",
+                   str(tmp_path / "c"), "--output", str(out)])
+        assert rc == 0
+        d = json.load(open(out))
+        assert d["ArtifactName"] == f"file://{src}"
+        cves = {v["VulnerabilityID"] for r in d.get("Results") or []
+                for v in r.get("Vulnerabilities") or []}
+        assert cves == set()  # HEAD has the fixed version
+
+    def test_clone_branch(self, tmp_path):
+        from trivy_tpu.cli import main
+        src = self._make_repo(tmp_path)
+        out = tmp_path / "r.json"
+        rc = main(["repo", f"file://{src}", "--branch", "feature",
+                   "--db", FIXTURE_DB, "--format", "json",
+                   "--cache-dir", str(tmp_path / "c"),
+                   "--output", str(out)])
+        assert rc == 0
+        d = json.load(open(out))
+        cves = {v["VulnerabilityID"] for r in d["Results"]
+                for v in r.get("Vulnerabilities") or []}
+        assert "CVE-2023-30861" in cves  # branch still vulnerable
+
+    def test_missing_local_path_errors(self, tmp_path):
+        from trivy_tpu.cli import main
+        with pytest.raises(SystemExit, match="no such path"):
+            main(["repo", str(tmp_path / "absent"), "--db", FIXTURE_DB,
+                  "--cache-dir", str(tmp_path / "c")])
+
+    def test_refs_rejected_for_local_paths(self, tmp_path):
+        from trivy_tpu.cli import main
+        src = self._make_repo(tmp_path)
+        with pytest.raises(SystemExit, match="remote repository URLs"):
+            main(["repo", str(src), "--branch", "feature",
+                  "--db", FIXTURE_DB, "--cache-dir",
+                  str(tmp_path / "c")])

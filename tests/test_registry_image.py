@@ -124,3 +124,55 @@ def test_pull_nonexistent_fails(tmp_path):
                 str(tmp_path / "x.tar"))
     finally:
         reg.stop()
+
+
+class TestECRAuth:
+    def test_non_ecr_host_is_none(self):
+        from trivy_tpu.oci import ecr_credentials
+        assert ecr_credentials("ghcr.io") is None
+        assert ecr_credentials("123.dkr.ecr.us-east-1.amazonaws.com") \
+            is None  # 12-digit account ids only
+
+    def test_ecr_token_fetch(self, monkeypatch):
+        import base64
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIATEST")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                assert self.headers["X-Amz-Target"].endswith(
+                    "GetAuthorizationToken")
+                assert self.headers["Authorization"].startswith(
+                    "AWS4-HMAC-SHA256")
+                token = base64.b64encode(b"AWS:ecr-password").decode()
+                body = json.dumps({"authorizationData": [
+                    {"authorizationToken": token}]}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        monkeypatch.setenv(
+            "TRIVY_TPU_ECR_ENDPOINT",
+            f"http://127.0.0.1:{srv.server_address[1]}")
+        try:
+            from trivy_tpu.oci import ecr_credentials
+            creds = ecr_credentials(
+                "123456789012.dkr.ecr.us-east-1.amazonaws.com")
+            assert creds == ("AWS", "ecr-password")
+        finally:
+            srv.shutdown()
+
+    def test_no_aws_credentials_is_none(self, monkeypatch):
+        monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+        monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+        from trivy_tpu.oci import ecr_credentials
+        assert ecr_credentials(
+            "123456789012.dkr.ecr.us-east-1.amazonaws.com") is None

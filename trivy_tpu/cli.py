@@ -125,8 +125,13 @@ def build_parser() -> argparse.ArgumentParser:
         _add_scan_flags(p)
 
     p = sub.add_parser("repository", aliases=["repo"],
-                       help="scan a (local) git repository")
+                       help="scan a local or remote git repository")
     p.add_argument("target")
+    p.add_argument("--branch", default="",
+                   help="remote branch to clone")
+    p.add_argument("--tag", default="", help="remote tag to clone")
+    p.add_argument("--commit", default="",
+                   help="remote commit to check out (full clone)")
     _add_scan_flags(p)
 
     p = sub.add_parser("sbom", help="scan an SBOM (CycloneDX/SPDX JSON)")
@@ -524,16 +529,48 @@ def cmd_fs(args) -> int:
         artifact_type = T.ArtifactType.FILESYSTEM
     optin = ("license-file",) if getattr(args, "license_full",
                                          False) else ()
-    sec_scanner, sec_cfg = _secret_scanner(args, scanners,
-                                           root=args.target)
-    art = FilesystemArtifact(args.target, cache, scanners=scanners,
-                             group=AnalyzerGroup(disabled=disabled,
-                                                 enabled=optin),
-                             secret_scanner=sec_scanner,
-                             secret_config_path=sec_cfg,
-                             parallel=getattr(args, "parallel", 1))
-    ref = art.inspect()
-    return _scan_common(args, ref, cache, artifact_type)
+    # remote repository: clone like the reference's repo artifact
+    # (git.go tryRemoteRepo) when the target is not a local path
+    target = args.target
+    repo_name = ""
+    cleanup = None
+    repo_refs = [getattr(args, k, "") for k in
+                 ("branch", "tag", "commit")]
+    if args.command in ("repo", "repository") and \
+            os.path.exists(target) and any(repo_refs):
+        raise SystemExit(
+            "--branch/--tag/--commit apply to remote repository URLs, "
+            "not local paths (check out the ref locally instead)")
+    if args.command in ("repo", "repository") and \
+            not os.path.exists(target):
+        from .fanal.gitrepo import GitError, clone_repo, looks_like_url
+        if not looks_like_url(target):
+            raise SystemExit(f"no such path: {target}")
+        try:
+            target, cleanup = clone_repo(
+                target,
+                branch=getattr(args, "branch", ""),
+                tag=getattr(args, "tag", ""),
+                commit=getattr(args, "commit", ""))
+        except GitError as e:
+            raise SystemExit(str(e)) from None
+        repo_name = args.target
+    try:
+        sec_scanner, sec_cfg = _secret_scanner(args, scanners,
+                                               root=target)
+        art = FilesystemArtifact(target, cache, scanners=scanners,
+                                 group=AnalyzerGroup(disabled=disabled,
+                                                     enabled=optin),
+                                 secret_scanner=sec_scanner,
+                                 secret_config_path=sec_cfg,
+                                 parallel=getattr(args, "parallel", 1))
+        ref = art.inspect()
+        if repo_name:
+            ref.name = repo_name
+        return _scan_common(args, ref, cache, artifact_type)
+    finally:
+        if cleanup is not None:
+            cleanup()
 
 
 def _secret_scanner(args, scanners, root: str = ""):

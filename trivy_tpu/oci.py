@@ -24,6 +24,7 @@ import gzip
 import hashlib
 import io
 import json
+import os
 import re
 import tarfile
 import urllib.error
@@ -107,6 +108,8 @@ class RegistryClient:
     password: str = ""
     timeout: float = 60.0
     _tokens: dict = field(default_factory=dict)
+    # host → (user, password, refresh_deadline) from ECR auth
+    _ecr_creds: dict = field(default_factory=dict)
 
     # ---- http -----------------------------------------------------------
 
@@ -114,11 +117,13 @@ class RegistryClient:
                  _retried: bool = False):
         req = urllib.request.Request(url, headers=headers)
         tok = self._tokens.get((ref.host, ref.repository))
+        basic = (self.username, self.password) if self.username else \
+            self._ecr_basic(ref.host)
         if tok:
             req.add_header("Authorization", f"Bearer {tok}")
-        elif self.username:
+        elif basic is not None:
             cred = base64.b64encode(
-                f"{self.username}:{self.password}".encode()).decode()
+                f"{basic[0]}:{basic[1]}".encode()).decode()
             req.add_header("Authorization", f"Basic {cred}")
         try:
             return urllib.request.urlopen(req, timeout=self.timeout)
@@ -138,6 +143,21 @@ class RegistryClient:
                 from None
         except urllib.error.URLError as e:
             raise OCIError(f"{url}: {e.reason}") from None
+
+    def _ecr_basic(self, host: str):
+        """Per-host ECR basic credentials, refreshed before the 12h
+        token lifetime runs out; None for non-ECR hosts — static creds
+        never leak across hosts and expired tokens re-fetch."""
+        import time
+        cached = self._ecr_creds.get(host)
+        if cached is not None and time.time() < cached[2]:
+            return cached[0], cached[1]
+        creds = ecr_credentials(host)
+        if creds is None:
+            return None
+        self._ecr_creds[host] = (creds[0], creds[1],
+                                 time.time() + 11 * 3600)
+        return creds
 
     def _fetch_token(self, challenge: str) -> str:
         """WWW-Authenticate: Bearer realm=...,service=...,scope=... →
@@ -287,6 +307,47 @@ def untar_gz_members(data: bytes) -> dict[str, bytes]:
 
 
 def default_client() -> RegistryClient:
-    import os
     return RegistryClient(username=os.environ.get("TRIVY_USERNAME", ""),
                           password=os.environ.get("TRIVY_PASSWORD", ""))
+
+
+# commercial/GovCloud partitions only: China-partition hosts
+# (.amazonaws.com.cn) need the aws-cn endpoint + partition and are
+# not supported here
+_ECR_HOST = re.compile(
+    r"^\d{12}\.dkr\.ecr(?:-fips)?\.([a-z0-9-]+)\.amazonaws\.com$")
+
+
+def ecr_credentials(host: str) -> "tuple[str, str] | None":
+    """Amazon ECR auth helper (reference fanal/image/registry/ecr):
+    registries named <acct>.dkr.ecr.<region>.amazonaws.com get basic
+    credentials from ECR GetAuthorizationToken (sigv4, so plain AWS
+    env credentials work) — the token decodes to 'AWS:<password>'.
+    → (username, password) or None when the host isn't ECR or no AWS
+    credentials are configured."""
+    m = _ECR_HOST.match(host)
+    if not m:
+        return None
+    from .cloud.aws import AWSClient, AWSError
+    try:
+        client = AWSClient(
+            region=m.group(1),
+            endpoint=os.environ.get("TRIVY_TPU_ECR_ENDPOINT", ""))
+        raw = client.request(
+            "ecr", "POST", "/", body=b"{}",
+            headers={
+                "Content-Type": "application/x-amz-json-1.1",
+                "X-Amz-Target":
+                    "AmazonEC2ContainerRegistry_V20150921"
+                    ".GetAuthorizationToken",
+            })
+    except AWSError:
+        return None
+    try:
+        doc = json.loads(raw)
+        token = doc["authorizationData"][0]["authorizationToken"]
+        user, _, password = base64.b64decode(token).decode() \
+            .partition(":")
+        return user, password
+    except (ValueError, KeyError, IndexError):
+        return None
