@@ -208,3 +208,125 @@ def test_golden_sbom_cyclonedx(tmp_path):
             v.setdefault("PkgIdentifier", {})["BOMRef"] = \
                 bomrefs[v["VulnerabilityID"]]
     assert_zero_diff(got, want)
+
+
+# ---- SBOM generation goldens (repo_test.go cyclonedx/spdx cases) -------
+
+def _norm_cdx(doc):
+    """The reference's readCycloneDX normalization
+    (integration_test.go:140-167: sort components by name, clear their
+    bom-refs, sort properties, sort vulnerabilities by id) plus
+    tool-identity and root-name normalization (we are not the trivy
+    binary and scan from a different path)."""
+    d = json.loads(json.dumps(doc))
+    for c in d.get("components") or []:
+        c["bom-ref"] = ""
+        if c.get("properties"):
+            c["properties"] = sorted(c["properties"],
+                                     key=lambda p: p["name"])
+    if d.get("components"):
+        d["components"] = sorted(d["components"],
+                                 key=lambda c: c.get("name", ""))
+    if d.get("vulnerabilities"):
+        d["vulnerabilities"] = sorted(d["vulnerabilities"],
+                                      key=lambda v: v["id"])
+    md = d.get("metadata") or {}
+    md.pop("tools", None)
+    (md.get("component") or {}).pop("name", None)
+    return d
+
+
+def run_cli_sbom(argv, tmp_path):
+    from trivy_tpu.cli import main
+    out_path = str(tmp_path / "sbom.json")
+    os.environ["TRIVY_TPU_FAKE_NOW"] = FAKE_NOW
+    os.environ["TRIVY_TPU_FAKE_UUID"] = "3ff14136-e09f-4df9-80ea-%012d"
+    try:
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc = main(argv + ["--output", out_path])
+    finally:
+        os.environ.pop("TRIVY_TPU_FAKE_NOW", None)
+        os.environ.pop("TRIVY_TPU_FAKE_UUID", None)
+    assert rc == 0
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def test_golden_conda_cyclonedx(tmp_path):
+    """repo_test.go "conda generating CycloneDX SBOM"."""
+    got = run_cli_sbom(["rootfs", os.path.join(GOLD, "inputs", "conda"),
+                        "--db", DB_GLOB, "--format", "cyclonedx",
+                        "--cache-dir", str(tmp_path)], tmp_path)
+    want = read_golden("conda-cyclonedx.json.golden")
+    assert _norm_cdx(got) == _norm_cdx(want)
+
+
+def test_golden_pom_cyclonedx(tmp_path):
+    """repo_test.go "pom.xml generating CycloneDX SBOM (with
+    vulnerabilities)"."""
+    got = run_cli_sbom(["fs", os.path.join(GOLD, "inputs", "pom"),
+                        "--db", DB_GLOB, "--format", "cyclonedx",
+                        "--cache-dir", str(tmp_path)], tmp_path)
+    want = read_golden("pom-cyclonedx.json.golden")
+    assert _norm_cdx(got) == _norm_cdx(want)
+
+
+def _norm_spdx(doc):
+    """readSpdxJson normalization (integration_test.go:169-193: sort
+    relationships and files, clear created/namespace) plus opaque-id
+    canonicalization — the reference derives SPDXIDs from a Go
+    hashstructure digest we cannot reproduce, so ids are rewritten to
+    content-based names on both sides before comparison — and creator
+    tool-identity normalization."""
+    d = json.loads(json.dumps(doc))
+    mapping = {}
+    for p in d.get("packages") or []:
+        # the root artifact package carries the scan path as its name
+        canon = "id:ROOT" if p["name"] == d.get("name") \
+            else f"id:{p['name']}@{p.get('versionInfo', '')}"
+        mapping[p["SPDXID"]] = canon
+        p["SPDXID"] = canon
+        if canon == "id:ROOT":
+            p["name"] = "ROOT"
+    for f in d.get("files") or []:
+        canon = f"id:{f['fileName']}"
+        mapping[f["SPDXID"]] = canon
+        f["SPDXID"] = canon
+    for r in d.get("relationships") or []:
+        r["spdxElementId"] = mapping.get(r["spdxElementId"],
+                                         r["spdxElementId"])
+        r["relatedSpdxElement"] = mapping.get(r["relatedSpdxElement"],
+                                              r["relatedSpdxElement"])
+    d["relationships"] = sorted(
+        d.get("relationships") or [],
+        key=lambda r: (r["spdxElementId"], r["relatedSpdxElement"]))
+    d["files"] = sorted(d.get("files") or [],
+                        key=lambda f: f["SPDXID"])
+    d["packages"] = sorted(d.get("packages") or [],
+                           key=lambda p: p["SPDXID"])
+    d.pop("documentNamespace", None)
+    (d.get("creationInfo") or {}).pop("created", None)
+    (d.get("creationInfo") or {}).pop("creators", None)
+    d.pop("name", None)  # artifact path differs
+    return d
+
+
+def test_golden_conda_spdx(tmp_path):
+    """repo_test.go "conda generating SPDX SBOM"."""
+    got = run_cli_sbom(["rootfs", os.path.join(GOLD, "inputs", "conda"),
+                        "--db", DB_GLOB, "--format", "spdx-json",
+                        "--cache-dir", str(tmp_path)], tmp_path)
+    want = read_golden("conda-spdx.json.golden")
+    assert _norm_spdx(got) == _norm_spdx(want)
+
+
+def test_golden_gomod_skip_files(tmp_path):
+    """repo_test.go "gomod with skip files": --skip-files drops
+    submod2/go.mod from the scan."""
+    got = run_cli(["repo", os.path.join(GOLD, "inputs", "gomod"),
+                   "--db", DB_GLOB, "--format", "json",
+                   "--skip-files",
+                   os.path.join(GOLD, "inputs", "gomod", "submod2",
+                                "go.mod"),
+                   "--cache-dir", str(tmp_path)], tmp_path)
+    assert_zero_diff(got, read_golden("gomod-skip.json.golden"))

@@ -50,6 +50,10 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--parallel", type=int, default=1,
                    help="parallel file readers for fs/repo walks "
                         "(reference walker --parallel)")
+    p.add_argument("--skip-files", action="append", default=[],
+                   help="glob of files to skip (repeatable)")
+    p.add_argument("--skip-dirs", action="append", default=[],
+                   help="glob of directories to skip (repeatable)")
     p.add_argument("--trace", action="store_true",
                    help="print rego rule-evaluation traces to stderr "
                         "(reference --trace)")
@@ -296,6 +300,10 @@ def _scan_common_inner(args, ref, cache, artifact_type: str) -> int:
         include_dev_deps=getattr(args, "include_dev_deps", False),
         pkg_types=tuple(args.pkg_types.split(",")),
     )
+    # SBOM formats list every package (reference run.go: ListAllPkgs
+    # is forced for SBOM output formats)
+    if args.format in ("cyclonedx", "spdx-json", "spdx"):
+        opts.list_all_packages = True
     # deterministic clock for golden/diff testing (the reference injects
     # a fake clock in its integration harness, pkg/clock)
     now = None
@@ -337,7 +345,8 @@ def _scan_common_inner(args, ref, cache, artifact_type: str) -> int:
             report = build_report(
                 ref.name, artifact_type, results, os_info,
                 metadata=ref.image_metadata or T.Metadata(),
-                created_at=dt.datetime.now(dt.timezone.utc).isoformat())
+                created_at=(now or dt.datetime.now(
+                    dt.timezone.utc)).isoformat())
             write_report(report, args.format, out,
                          template=getattr(args, "template", ""),
                          app_version=__version__)
@@ -563,7 +572,14 @@ def cmd_fs(args) -> int:
                                                      enabled=optin),
                                  secret_scanner=sec_scanner,
                                  secret_config_path=sec_cfg,
-                                 parallel=getattr(args, "parallel", 1))
+                                 parallel=getattr(args, "parallel", 1),
+                                 file_checksum=args.format in ("spdx-json", "spdx"),
+                                 skip_files=_rel_globs(
+                                     getattr(args, "skip_files", []),
+                                     target),
+                                 skip_dirs=_rel_globs(
+                                     getattr(args, "skip_dirs", []),
+                                     target))
         ref = art.inspect()
         if repo_name:
             ref.name = repo_name
@@ -571,6 +587,24 @@ def cmd_fs(args) -> int:
     finally:
         if cleanup is not None:
             cleanup()
+
+
+def _rel_globs(globs, root: str) -> tuple:
+    """--skip-files/--skip-dirs accept paths relative to cwd OR to the
+    scan root (the reference's repo_test passes cwd-relative paths);
+    normalize to root-relative globs."""
+    out = []
+    root_abs = os.path.abspath(root)
+    for g in globs or []:
+        rel = g
+        g_abs = os.path.abspath(g)
+        # only rewrite cwd-relative args that actually resolve inside
+        # the root — a root-relative glob passed from a subdirectory
+        # cwd must survive untouched
+        if g_abs.startswith(root_abs + os.sep) and os.path.exists(g_abs):
+            rel = os.path.relpath(g_abs, root_abs).replace(os.sep, "/")
+        out.append(rel)
+    return tuple(out)
 
 
 def _secret_scanner(args, scanners, root: str = ""):

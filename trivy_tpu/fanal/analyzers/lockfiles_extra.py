@@ -114,12 +114,15 @@ class PomAnalyzer(Analyzer):
             if not g or not a or not v or "${" in v or "[" in v:
                 continue  # unresolved property or version range
             pkgs.append(_pkg(name, v, ltype="pom"))
-        # the module itself is also reported when fully resolved
+        # the module itself is also reported when fully resolved, with
+        # its direct dependencies as graph edges (java/pom parse.go)
         g = resolve(props["project.groupId"])
         a = resolve(props["project.artifactId"])
         v = resolve(props["project.version"])
         if g and a and v and "${" not in v:
-            pkgs.insert(0, _pkg(f"{g}:{a}", v, ltype="pom"))
+            module = _pkg(f"{g}:{a}", v, ltype="pom")
+            module.depends_on = sorted(p.id for p in pkgs)
+            pkgs.insert(0, module)
         return _app("pom", path, pkgs)
 
 
@@ -296,7 +299,8 @@ class CondaMetaAnalyzer(Analyzer):
         if not name or not version or not isinstance(name, str) \
                 or not isinstance(version, str):
             return None
-        pkg = _pkg(name, version)
+        # the reference conda meta parser leaves ID empty
+        pkg = T.Package(name=name, version=version)
         pkg.file_path = path
         lic = doc.get("license")
         if isinstance(lic, str) and lic:

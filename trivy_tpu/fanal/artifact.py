@@ -252,16 +252,24 @@ class FilesystemArtifact(_SingleBlobArtifact):
     """A directory tree as one synthetic blob
     (pkg/fanal/artifact/local/fs.go:114)."""
 
-    def __init__(self, root: str, cache, parallel: int = 1, **kw):
+    def __init__(self, root: str, cache, parallel: int = 1,
+                 file_checksum: bool = False, skip_files: tuple = (),
+                 skip_dirs: tuple = (), **kw):
         super().__init__(root, cache, **kw)
         self.root = root
         self.parallel = parallel
+        self.file_checksum = file_checksum
+        self.skip_files = skip_files
+        self.skip_dir_globs = skip_dirs
 
     def _walk(self):
         return walk_fs(self.root, self.group,
                        collect_secrets="secret" in self.scanners,
                        secret_config_path=self.secret_config_path,
-                       parallel=self.parallel)
+                       parallel=self.parallel,
+                       file_checksum=self.file_checksum,
+                       skip_files=self.skip_files,
+                       skip_dir_globs=self.skip_dir_globs)
 
     def _name(self) -> str:
         return os.path.abspath(self.root).rstrip("/")

@@ -104,11 +104,19 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
     return scan
 
 
+def _norm_rel(path: str) -> str:
+    """strip one leading './' exactly (lstrip would eat leading dots
+    of dot-prefixed names like .cache)."""
+    return path[2:] if path.startswith("./") else path
+
+
 def walk_fs(root: str, group: AnalyzerGroup,
             collect_secrets: bool = False,
             skip_dirs: tuple = (".git",),
             secret_config_path: str = DEFAULT_SECRET_CONFIG,
-            parallel: int = 1) -> BlobScan:
+            parallel: int = 1, file_checksum: bool = False,
+            skip_files: tuple = (), skip_dir_globs: tuple = ()
+            ) -> BlobScan:
     """Walk a directory tree through the analyzers. ``parallel`` > 1
     reads and analyzes candidate files on a thread pool (reference
     walker/fs.go:73-80 --parallel); per-file results merge back in
@@ -116,11 +124,23 @@ def walk_fs(root: str, group: AnalyzerGroup,
     scan = BlobScan(result=AnalysisResult())
     root = os.path.abspath(root)
     candidates: list[tuple[str, str, bool, bool, bool]] = []
+    import fnmatch
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+        reldir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+        if skip_dir_globs:
+            # --skip-dirs matches walked relative paths (walker.go)
+            dirnames[:] = [
+                d for d in dirnames
+                if not any(fnmatch.fnmatch(
+                    _norm_rel(f"{reldir}/{d}"), g)
+                    for g in skip_dir_globs)]
         for fn in sorted(filenames):
             full = os.path.join(dirpath, fn)
             rel = os.path.relpath(full, root).replace(os.sep, "/")
+            if skip_files and any(fnmatch.fnmatch(rel, g)
+                                  for g in skip_files):
+                continue
             try:
                 size = os.path.getsize(full)
             except OSError:
@@ -144,6 +164,16 @@ def walk_fs(root: str, group: AnalyzerGroup,
         if wants:
             result = AnalysisResult()
             group.analyze_file(rel, content, result)
+            if file_checksum:
+                # SPDX output records file SHA1s (reference artifact
+                # option FileChecksum, enabled for SPDX formats)
+                import hashlib
+                digest = "sha1:" + hashlib.sha1(content).hexdigest()
+                for app in result.applications:
+                    if app.file_path == rel:
+                        for pkg in app.packages:
+                            if not pkg.digest:
+                                pkg.digest = digest
         return (rel, result,
                 content if wants_post else None,
                 content if wants_secret and not looks_binary(content)

@@ -151,6 +151,6 @@ def write_report(report: T.Report, fmt: str = "json", output=None,
         write_cosign_vuln(report, out, version=app_version)
     elif fmt in ("cyclonedx", "spdx-json", "spdx"):
         from ..sbom.io import write_sbom
-        write_sbom(report, fmt, out)
+        write_sbom(report, fmt, out, app_version=app_version)
     else:
         raise ValueError(f"unsupported format {fmt!r}")

@@ -142,75 +142,283 @@ OS_PKG_TYPES = {"alpine", "apk", "debian", "ubuntu", "redhat", "centos",
                 "dpkg", "rpm"}
 
 
-def encode_cyclonedx(report: T.Report) -> dict:
-    components = []
-    vulnerabilities = {}
+def _fake_uuid_counter():
+    return {"n": 0}
+
+
+_UUID_STATE = _fake_uuid_counter()
+
+
+def _next_uuid() -> str:
+    """uuid4, or the deterministic TRIVY_TPU_FAKE_UUID pattern (e.g.
+    "3ff14136-e09f-4df9-80ea-%012d") — the reference's uuid.SetFakeUUID
+    test knob, needed for byte-identical SBOM goldens."""
+    import os
+    pat = os.environ.get("TRIVY_TPU_FAKE_UUID", "")
+    if pat:
+        _UUID_STATE["n"] += 1
+        return pat % _UUID_STATE["n"]
+    return str(uuid.uuid4())
+
+
+def _reset_uuid_counter():
+    _UUID_STATE["n"] = 0
+
+
+# aggregated individual-package result types attach their libraries
+# directly under the root component (reference pkg/sbom/core/bom.go —
+# no file-path application component exists for them)
+_AGGREGATED_TYPES = {"python-pkg", "conda-pkg", "gemspec", "node-pkg",
+                     "jar", "k8s"}
+
+
+def _cvss_severity(score: float) -> str:
+    if score >= 9.0:
+        return "critical"
+    if score >= 7.0:
+        return "high"
+    if score >= 4.0:
+        return "medium"
+    if score > 0.0:
+        return "low"
+    return "none"
+
+
+def _iso_tz(ts: str) -> str:
+    return ts.replace("Z", "+00:00") if ts else ""
+
+
+def _maven_split(pkg: T.Package) -> tuple[str, str]:
+    """maven names are group:artifact — CycloneDX wants them split
+    (marshal.go Component Group/Name)."""
+    if ":" in pkg.name:
+        group, _, name = pkg.name.partition(":")
+        return group, name
+    return "", pkg.name
+
+
+def encode_cyclonedx(report: T.Report, app_version: str = "dev") -> dict:
+    """Report → CycloneDX 1.5 JSON in the reference's core-BOM shape
+    (pkg/sbom/cyclonedx/marshal.go): root component + per-lockfile
+    application components + purl-ref'd libraries, a full dependency
+    graph, and enriched vulnerability entries."""
+    _reset_uuid_counter()
+    root_ref = _next_uuid()
+    components: list = []
+    deps: dict[str, list] = {root_ref: []}
+    vulnerabilities: dict[str, dict] = {}
+    pkg_refs: dict[tuple, str] = {}  # (result idx, pkg id/name@ver) → ref
+
     os_info = report.metadata.os
+    os_ref = ""
     if os_info and os_info.detected:
+        os_ref = _next_uuid()
         components.append({
-            "bom-ref": f"{os_info.family}@{os_info.name}",
+            "bom-ref": os_ref,
             "type": "operating_system",
             "name": os_info.family,
             "version": os_info.name,
+            "properties": [
+                {"name": PROP_PREFIX + "Class", "value": "os-pkgs"},
+                {"name": PROP_PREFIX + "Type", "value": os_info.family},
+            ],
         })
-    for res in report.results:
+        deps[root_ref].append(os_ref)
+        deps[os_ref] = []
+
+    for ri, res in enumerate(report.results):
+        if not res.packages and not res.vulnerabilities:
+            continue
+        if res.clazz == T.ResultClass.OS_PKGS and os_ref:
+            parent = os_ref
+        elif res.clazz == T.ResultClass.LANG_PKGS and \
+                res.type not in _AGGREGATED_TYPES:
+            parent = _next_uuid()
+            components.append({
+                "bom-ref": parent,
+                "type": "application",
+                "name": res.target,
+                "properties": [
+                    {"name": PROP_PREFIX + "Class", "value": res.clazz},
+                    {"name": PROP_PREFIX + "Type", "value": res.type},
+                ],
+            })
+            deps[root_ref].append(parent)
+            deps[parent] = []
+        else:
+            parent = root_ref
+
+        id_to_ref: dict[str, str] = {}
         for pkg in res.packages:
-            components.append(_component(res, pkg))
+            purl = pkg.identifier.purl or purl_for_package(res.type, pkg)
+            ref = purl or f"{pkg.name}@{pkg.version}"
+            id_to_ref[pkg.id or f"{pkg.name}@{pkg.version}"] = ref
+            # vulnerabilities carry installed_version =
+            # format_version() (epoch/release included) — key both
+            pkg_refs[(ri, pkg.name, pkg.version)] = ref
+            pkg_refs[(ri, pkg.name,
+                      pkg.format_version() or pkg.version)] = ref
+        for pkg in res.packages:
+            purl = pkg.identifier.purl or purl_for_package(res.type, pkg)
+            ref = purl or f"{pkg.name}@{pkg.version}"
+            # the reference's core BOM allocates an internal uuid per
+            # component even when the bom-ref is the purl — consume one
+            # so fake-uuid sequences (and thus serial numbers) align
+            _next_uuid()
+            comp = {"bom-ref": ref, "type": "library"}
+            if res.type in ("pom", "jar", "gradle"):
+                group, name = _maven_split(pkg)
+                if group:
+                    comp["group"] = group
+                comp["name"] = name
+            else:
+                comp["name"] = pkg.name
+            comp["version"] = pkg.format_version() or pkg.version
+            if pkg.licenses:
+                comp["licenses"] = [{"license": {"name": li}}
+                                    for li in pkg.licenses]
+            if purl:
+                comp["purl"] = purl
+            props = []
+            if pkg.file_path:
+                props.append({"name": PROP_PREFIX + "FilePath",
+                              "value": pkg.file_path})
+            if pkg.id:
+                props.append({"name": PROP_PREFIX + "PkgID",
+                              "value": pkg.id})
+            props.append({"name": PROP_PREFIX + "PkgType",
+                          "value": res.type})
+            if pkg.src_name:
+                props.append({"name": PROP_PREFIX + "SrcName",
+                              "value": pkg.src_name})
+            if pkg.src_version:
+                props.append({"name": PROP_PREFIX + "SrcVersion",
+                              "value": pkg.src_version})
+            comp["properties"] = sorted(props, key=lambda p: p["name"])
+            deps[parent].append(ref)
+            edges = sorted(
+                id_to_ref[d] for d in pkg.depends_on if d in id_to_ref)
+            if ref in deps:
+                # same purl seen in another result: one component,
+                # merged dependency edges (bom-refs must be unique)
+                deps[ref] = sorted(set(deps[ref]) | set(edges))
+            else:
+                components.append(comp)
+                deps[ref] = edges
+
         for v in res.vulnerabilities:
-            entry = vulnerabilities.setdefault(v.vulnerability_id, {
-                "id": v.vulnerability_id,
-                "source": ({"name": v.data_source.id}
-                           if v.data_source else {}),
-                "ratings": [{
-                    "severity": (v.severity or "unknown").lower(),
-                }],
-                "description": v.vulnerability.description,
-                "affects": [],
-            })
-            entry["affects"].append({
-                "ref": f"{v.pkg_name}@{v.installed_version}",
-            })
+            entry = vulnerabilities.get(v.vulnerability_id)
+            if entry is None:
+                entry = _vuln_entry(v)
+                vulnerabilities[v.vulnerability_id] = entry
+            ref = pkg_refs.get((ri, v.pkg_name, v.installed_version),
+                               f"{v.pkg_name}@{v.installed_version}")
+            aff = {"ref": ref,
+                   "versions": [{"version": v.installed_version,
+                                 "status": "affected"}]}
+            if aff not in entry["affects"]:
+                entry["affects"].append(aff)
+
+    dependencies = [{"ref": ref, "dependsOn": sorted(set(d))}
+                    for ref, d in deps.items()]
+    dependencies.sort(key=lambda d: d["ref"])
     return {
+        "$schema": "http://cyclonedx.org/schema/bom-1.5.schema.json",
         "bomFormat": "CycloneDX",
         "specVersion": "1.5",
-        "serialNumber": f"urn:uuid:{uuid.uuid4()}",
+        "serialNumber": f"urn:uuid:{_next_uuid()}",
         "version": 1,
         "metadata": {
-            "timestamp": report.created_at,
+            "timestamp": _iso_tz(report.created_at),
+            "tools": {"components": [{
+                "type": "application",
+                "group": "aquasecurity",
+                "name": "trivy",
+                "version": app_version,
+            }]},
             "component": {
+                "bom-ref": root_ref,
                 "type": "container"
                 if report.artifact_type == T.ArtifactType.CONTAINER_IMAGE
                 else "application",
                 "name": report.artifact_name,
+                "properties": [{
+                    "name": PROP_PREFIX + "SchemaVersion",
+                    "value": str(report.schema_version),
+                }],
             },
-            "tools": [{"vendor": "trivy-tpu", "name": "trivy-tpu"}],
         },
         "components": components,
-        "vulnerabilities": list(vulnerabilities.values()),
+        "dependencies": dependencies,
+        "vulnerabilities": sorted(vulnerabilities.values(),
+                                  key=lambda v: v["id"]),
     }
 
 
-def _component(res: T.Result, pkg: T.Package) -> dict:
-    props = [{"name": PROP_PREFIX + "PkgType", "value": res.type}]
-    if pkg.src_name:
-        props.append({"name": PROP_PREFIX + "SrcName", "value": pkg.src_name})
-    if pkg.src_version:
-        props.append({"name": PROP_PREFIX + "SrcVersion",
-                      "value": pkg.src_version})
-    if pkg.file_path:
-        props.append({"name": PROP_PREFIX + "FilePath",
-                      "value": pkg.file_path})
-    comp = {
-        "bom-ref": f"{pkg.name}@{pkg.version}",
-        "type": "library",
-        "name": pkg.name,
-        "version": pkg.format_version() or pkg.version,
-        "properties": props,
+def _vuln_entry(v: T.DetectedVulnerability) -> dict:
+    detail = v.vulnerability
+    ratings = []
+    sources = sorted(set(detail.vendor_severity) | set(detail.cvss))
+    for src in sources:
+        c = detail.cvss.get(src)
+        emitted = False
+        if c is not None:
+            if getattr(c, "v2_score", 0):
+                ratings.append({
+                    "source": {"name": src},
+                    "score": c.v2_score,
+                    "severity": _cvss_severity(c.v2_score),
+                    "method": "CVSSv2",
+                    "vector": c.v2_vector,
+                })
+                emitted = True
+            if getattr(c, "v3_score", 0):
+                method = "CVSSv31" if str(c.v3_vector).startswith(
+                    "CVSS:3.1") else "CVSSv3"
+                ratings.append({
+                    "source": {"name": src},
+                    "score": c.v3_score,
+                    "severity": _cvss_severity(c.v3_score),
+                    "method": method,
+                    "vector": c.v3_vector,
+                })
+                emitted = True
+        if not emitted and src in detail.vendor_severity:
+            sev = detail.vendor_severity[src]
+            sev_name = T.SEVERITIES[sev].lower() \
+                if isinstance(sev, int) and sev < len(T.SEVERITIES) \
+                else str(sev).lower()
+            ratings.append({"source": {"name": src},
+                            "severity": sev_name})
+    entry = {
+        "id": v.vulnerability_id,
+        "source": ({"name": v.data_source.id, "url": v.data_source.url}
+                   if v.data_source else {}),
+        "ratings": ratings,
     }
-    purl = pkg.identifier.purl or purl_for_package(res.type, pkg)
-    if purl:
-        comp["purl"] = purl
-    if pkg.licenses:
-        comp["licenses"] = [{"license": {"name": li}}
-                            for li in pkg.licenses]
-    return comp
+    cwes = []
+    for cw in detail.cwe_ids:
+        m = str(cw).rsplit("-", 1)[-1]
+        if m.isdigit():
+            cwes.append(int(m))
+    if cwes:
+        entry["cwes"] = cwes
+    if detail.description:
+        entry["description"] = detail.description
+    if v.fixed_version:
+        entry["recommendation"] = (f"Upgrade {v.pkg_name} to version "
+                                   f"{v.fixed_version}")
+    advisories = []
+    if v.primary_url:
+        advisories.append({"url": v.primary_url})
+    for r in detail.references:
+        if r and r != v.primary_url:
+            advisories.append({"url": r})
+    if advisories:
+        entry["advisories"] = advisories
+    if detail.published_date:
+        entry["published"] = _iso_tz(detail.published_date)
+    if detail.last_modified_date:
+        entry["updated"] = _iso_tz(detail.last_modified_date)
+    entry["affects"] = []
+    return entry

@@ -70,8 +70,10 @@ def decode_sbom_doc(doc: dict, cache, name: str = ""):
         id=blob_id, blob_ids=[blob_id])
 
 
-def write_sbom(report: T.Report, fmt: str, out) -> None:
-    doc = encode_cyclonedx(report) if fmt == "cyclonedx" \
-        else encode_spdx(report)
+def write_sbom(report: T.Report, fmt: str, out,
+               app_version: str = "dev") -> None:
+    doc = encode_cyclonedx(report, app_version=app_version) \
+        if fmt == "cyclonedx" \
+        else encode_spdx(report, app_version=app_version)
     json.dump(doc, out, indent=2)
     out.write("\n")

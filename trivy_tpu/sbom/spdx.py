@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import hashlib
-import uuid
 
 from .. import types as T
 from ..purl import purl_for_package
@@ -14,34 +13,55 @@ def _spdx_id(kind: str, name: str) -> str:
     return f"SPDXRef-{kind}-{h}"
 
 
-def encode_spdx(report: T.Report) -> dict:
+ARTIFACT_KIND = {
+    "container_image": ("ContainerImage", "CONTAINER"),
+    "filesystem": ("Filesystem", "SOURCE"),
+    "repository": ("Repository", "SOURCE"),
+    "vm": ("VM", "SOURCE"),
+}
+
+
+def encode_spdx(report: T.Report, app_version: str = "dev") -> dict:
+    """Report → SPDX 2.3 JSON in the reference's shape
+    (pkg/sbom/spdx/marshal.go): root artifact package, per-package
+    entries with purl externalRefs and PkgType attribution, File
+    entries with SHA1 checksums when file digests were recorded
+    (--format spdx-json turns them on in the walker), and
+    packageVerificationCode = SHA1 over the files' hex digests."""
     packages = []
+    files = []
     relationships = []
-    root_id = "SPDXRef-DOCUMENT"
-    art_id = _spdx_id("Artifact", report.artifact_name)
+    doc_id = "SPDXRef-DOCUMENT"
+    kind, purpose = ARTIFACT_KIND.get(report.artifact_type,
+                                      ("Artifact", "APPLICATION"))
+    art_id = _spdx_id(kind, report.artifact_name)
     packages.append({
-        "SPDXID": art_id,
         "name": report.artifact_name,
+        "SPDXID": art_id,
         "downloadLocation": "NONE",
-        "primaryPackagePurpose":
-            "CONTAINER" if report.artifact_type ==
-            T.ArtifactType.CONTAINER_IMAGE else "APPLICATION",
+        "filesAnalyzed": False,
+        "attributionTexts": [f"SchemaVersion: {report.schema_version}"],
+        "primaryPackagePurpose": purpose,
     })
     relationships.append({
-        "spdxElementId": root_id,
+        "spdxElementId": doc_id,
         "relatedSpdxElement": art_id,
         "relationshipType": "DESCRIBES",
     })
     for res in report.results:
         for pkg in res.packages:
-            pid = _spdx_id("Package", f"{res.target}/{pkg.name}@{pkg.version}")
+            pid = _spdx_id(
+                "Package", f"{res.target}/{pkg.name}@{pkg.version}")
+            lic = " AND ".join(pkg.licenses) or "NOASSERTION"
             entry = {
-                "SPDXID": pid,
                 "name": pkg.name,
+                "SPDXID": pid,
                 "versionInfo": pkg.format_version() or pkg.version,
+                "supplier": "NOASSERTION",
                 "downloadLocation": "NONE",
-                "licenseConcluded": " AND ".join(pkg.licenses) or "NOASSERTION",
-                "licenseDeclared": " AND ".join(pkg.licenses) or "NOASSERTION",
+                "filesAnalyzed": False,
+                "licenseConcluded": lic,
+                "licenseDeclared": lic,
             }
             purl = pkg.identifier.purl or purl_for_package(res.type, pkg)
             if purl:
@@ -50,24 +70,59 @@ def encode_spdx(report: T.Report) -> dict:
                     "referenceType": "purl",
                     "referenceLocator": purl,
                 }]
+            entry["attributionTexts"] = [f"PkgType: {res.type}"]
+            entry["primaryPackagePurpose"] = "LIBRARY"
+            if pkg.file_path and pkg.digest.startswith("sha1:"):
+                sha1 = pkg.digest[len("sha1:"):]
+                fid = _spdx_id("File", f"{res.target}/{pkg.file_path}")
+                files.append({
+                    "fileName": pkg.file_path,
+                    "SPDXID": fid,
+                    "checksums": [{"algorithm": "SHA1",
+                                   "checksumValue": sha1}],
+                    "copyrightText": "",
+                })
+                relationships.append({
+                    "spdxElementId": pid,
+                    "relatedSpdxElement": fid,
+                    "relationshipType": "CONTAINS",
+                })
+                entry["filesAnalyzed"] = True
+                entry["packageVerificationCode"] = {
+                    "packageVerificationCodeValue":
+                        hashlib.sha1(sha1.encode()).hexdigest(),
+                }
             packages.append(entry)
             relationships.append({
                 "spdxElementId": art_id,
                 "relatedSpdxElement": pid,
                 "relationshipType": "CONTAINS",
             })
+
+    # root artifact package sorts last (marshal.go output order)
+    packages.sort(key=lambda p: (p["SPDXID"] == art_id, p["name"],
+                                 p.get("versionInfo", "")))
+    files.sort(key=lambda f: f["SPDXID"])
+    relationships.sort(key=lambda r: (r["spdxElementId"],
+                                      r["relatedSpdxElement"]))
+    from .cyclonedx import _next_uuid
+    prefix = report.artifact_type or "artifact"
     return {
         "spdxVersion": "SPDX-2.3",
         "dataLicense": "CC0-1.0",
-        "SPDXID": root_id,
+        "SPDXID": doc_id,
         "name": report.artifact_name,
         "documentNamespace":
-            f"https://trivy-tpu/{uuid.uuid4()}",
+            f"http://aquasecurity.github.io/trivy/{prefix}/"
+            f"{report.artifact_name}-{_next_uuid()}",
         "creationInfo": {
-            "creators": ["Tool: trivy-tpu"],
-            "created": report.created_at,
+            "creators": ["Organization: aquasecurity",
+                         f"Tool: trivy-tpu-{app_version}"],
+            "created": report.created_at.replace("+00:00", "Z")
+            if report.created_at else "",
         },
         "packages": packages,
+        "files": files,
         "relationships": relationships,
     }
 
