@@ -176,3 +176,35 @@ def test_fs_scan_picks_up_chart(tmp_path):
     mcs = blob.get("Misconfigurations", [])
     helm_records = [m for m in mcs if m.get("FileType") == "helm"]
     assert helm_records, f"no helm records in {[m.get('FileType') for m in mcs]}"
+
+
+def test_helm_set_override_changes_findings(tmp_path):
+    """--helm-set flows into the render (reference helmSet repo_test
+    case: securityContext.runAsUser=0 flips KSV checks)."""
+    from trivy_tpu.iac.helm import (Chart, scan_rendered_chart,
+                                    set_helm_overrides)
+    chart = Chart(
+        metadata={"name": "t"},
+        values={"runAsNonRoot": True},
+        templates={"templates/pod.yaml": """
+apiVersion: v1
+kind: Pod
+metadata: {name: p}
+spec:
+  containers:
+  - name: c
+    image: nginx
+    securityContext:
+      runAsNonRoot: {{ .Values.runAsNonRoot }}
+"""},
+        helpers={}, subcharts=[])
+    base = scan_rendered_chart(chart)
+    set_helm_overrides(sets=["runAsNonRoot=false"])
+    try:
+        overridden = scan_rendered_chart(chart)
+    finally:
+        set_helm_overrides()
+    def ids(records):
+        return {f.id for r in records for f in r.failures}
+    assert "KSV012" not in ids(base)
+    assert "KSV012" in ids(overridden)

@@ -50,6 +50,10 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--parallel", type=int, default=1,
                    help="parallel file readers for fs/repo walks "
                         "(reference walker --parallel)")
+    p.add_argument("--helm-set", action="append", default=[],
+                   help="helm value override key=value (repeatable)")
+    p.add_argument("--helm-values", action="append", default=[],
+                   help="helm values file override (repeatable)")
     p.add_argument("--skip-files", action="append", default=[],
                    help="glob of files to skip (repeatable)")
     p.add_argument("--skip-dirs", action="append", default=[],
@@ -385,6 +389,11 @@ def _configure_misconf(args) -> None:
                   file=sys.stderr)
 
         set_rego_trace(_sink)
+    if getattr(args, "helm_set", None) or \
+            getattr(args, "helm_values", None):
+        from .iac.helm import set_helm_overrides
+        set_helm_overrides(sets=args.helm_set,
+                           values_files=args.helm_values)
     paths = getattr(args, "config_check", None)
     if paths:
         from .misconf import set_custom_checks

@@ -446,12 +446,42 @@ def scan_chart_files(files: dict[str, bytes],
     return scan_rendered_chart(chart, values_override=values_override)
 
 
+# process-wide value overrides (reference --set / --values,
+# pkg/fanal/analyzer/config ScannerOption HelmValueOverrides): applied
+# on top of every scanned chart's values
+_OVERRIDES: dict = {"sets": [], "files": []}
+
+
+def set_helm_overrides(sets=None, values_files=None) -> None:
+    _OVERRIDES["sets"] = list(sets or [])
+    _OVERRIDES["files"] = list(values_files or [])
+
+
+def _apply_overrides(base: dict | None) -> dict | None:
+    if not _OVERRIDES["sets"] and not _OVERRIDES["files"]:
+        return base
+    merged = dict(base or {})
+    for vf in _OVERRIDES["files"]:
+        try:
+            with open(vf) as f:
+                doc = yaml.safe_load(f) or {}
+        except (OSError, yaml.YAMLError):
+            continue
+        merged = _deep_merge(merged, doc)
+    for raw in _OVERRIDES["sets"]:
+        key, _, val = raw.partition("=")
+        if key:
+            _set_path(merged, key, _parse_set_value(val))
+    return merged
+
+
 def scan_rendered_chart(chart: Chart,
                         values_override: dict | None = None,
                         prefix: str = ""):
     from .. import types as T
     from .kubernetes import scan_kubernetes
-    rendered = render_chart(chart, values_override=values_override)
+    rendered = render_chart(
+        chart, values_override=_apply_overrides(values_override))
     records = []
     for rpath, text in rendered.items():
         try:
