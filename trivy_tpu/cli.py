@@ -391,9 +391,12 @@ def _configure_misconf(args) -> None:
         set_rego_trace(_sink)
     if getattr(args, "helm_set", None) or \
             getattr(args, "helm_values", None):
-        from .iac.helm import set_helm_overrides
-        set_helm_overrides(sets=args.helm_set,
-                           values_files=args.helm_values)
+        from .iac.helm import HelmRenderError, set_helm_overrides
+        try:
+            set_helm_overrides(sets=args.helm_set,
+                               values_files=args.helm_values)
+        except HelmRenderError as e:
+            raise SystemExit(str(e)) from None
     paths = getattr(args, "config_check", None)
     if paths:
         from .misconf import set_custom_checks
@@ -427,22 +430,22 @@ def cmd_image(args) -> int:
     _configure_javadb(args)
     input_path = args.input
     tmp = None
+    remote_stream = False
     if not input_path:
         if not args.image_name:
             raise SystemExit("image name or --input <archive> required")
         # image source fallback chain (reference image.go:42-56):
-        # docker/podman daemon sockets first, then the registry
+        # docker/podman daemon sockets first, then the registry.
+        # Daemons export a docker-save tarball; the registry source
+        # STREAMS layers (RegistryArtifact) with no temp file.
         import tempfile
         from .log import logger
-        tmp = tempfile.NamedTemporaryFile(suffix=".tar", delete=False)
-        tmp.close()
         sources = [s.strip() for s in
                    getattr(args, "image_src",
                            "docker,podman,remote").split(",") if s.strip()]
         unknown = [s for s in sources
                    if s not in ("docker", "podman", "remote")]
         if unknown or not sources:
-            os.unlink(tmp.name)
             raise SystemExit(
                 f"unknown --image-src {','.join(unknown or ['(empty)'])!r}"
                 " (valid: docker, podman, remote)")
@@ -452,33 +455,38 @@ def cmd_image(args) -> int:
             if src in ("docker", "podman"):
                 from .fanal.daemon import (DaemonError,
                                            save_from_any_daemon)
+                tmp = tempfile.NamedTemporaryFile(suffix=".tar",
+                                                  delete=False)
+                tmp.close()
                 try:
                     sock = save_from_any_daemon(
                         args.image_name, tmp.name, sources=(src,))
                     logger.info("saved %s from %s daemon %s",
                                 args.image_name, src, sock)
                     got = src
+                    input_path = tmp.name
                 except DaemonError as e:
                     errors.append(f"{src}: {e}")
+                    os.unlink(tmp.name)
+                    tmp = None
             else:
                 from .oci import OCIError, default_client, parse_ref
                 try:
-                    default_client().pull_to_oci_tar(
-                        parse_ref(args.image_name), tmp.name,
-                        platform=getattr(args, "platform", "")
-                        or "linux/amd64")
-                    logger.info("pulled %s from registry",
-                                args.image_name)
+                    # reachability probe; client + manifest are reused
+                    # by the streaming artifact (one token handshake)
+                    remote_client = default_client()
+                    remote_manifest = remote_client.manifest(
+                        parse_ref(args.image_name),
+                        getattr(args, "platform", "") or "linux/amd64")
                     got = src
+                    remote_stream = True
                 except OCIError as e:
                     errors.append(f"remote: {e}")
             if got:
                 break
         if not got:
-            os.unlink(tmp.name)
             raise SystemExit(
                 "image acquisition failed: " + "; ".join(errors))
-        input_path = tmp.name
     try:
         cache = _open_cache(args)
         scanners = tuple(s.strip() for s in args.scanners.split(","))
@@ -487,11 +495,21 @@ def cmd_image(args) -> int:
         sec_scanner, sec_cfg = _secret_scanner(args, scanners)
         optin = ("license-file",) if getattr(args, "license_full",
                                              False) else ()
-        art = ImageArchiveArtifact(
-            input_path, cache, scanners=scanners,
-            group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS,
-                                enabled=optin),
-            secret_scanner=sec_scanner, secret_config_path=sec_cfg)
+        group = AnalyzerGroup(disabled=LOCKFILE_ANALYZERS,
+                              enabled=optin)
+        if remote_stream:
+            from .fanal.artifact import RegistryArtifact
+            art = RegistryArtifact(
+                args.image_name, cache, scanners=scanners, group=group,
+                secret_scanner=sec_scanner, secret_config_path=sec_cfg,
+                platform=getattr(args, "platform", "") or "linux/amd64",
+                client=remote_client)
+            art._manifest = remote_manifest
+        else:
+            art = ImageArchiveArtifact(
+                input_path, cache, scanners=scanners, group=group,
+                secret_scanner=sec_scanner,
+                secret_config_path=sec_cfg)
         ref = None
         if "rekor" in getattr(args, "sbom_sources", ""):
             # remote-SBOM shortcut: a published SBOM attestation replaces
@@ -511,7 +529,15 @@ def cmd_image(args) -> int:
                 logger.warning("rekor SBOM lookup failed, falling back "
                                "to analysis: %s", e)
         if ref is None:
-            ref = art.inspect()
+            try:
+                ref = art.inspect()
+            except Exception as e:
+                from .oci import OCIError
+                if remote_stream and isinstance(e, OCIError):
+                    raise SystemExit(
+                        f"image acquisition failed: remote: {e}") \
+                        from None
+                raise
             artifact_type = T.ArtifactType.CONTAINER_IMAGE
         else:
             artifact_type = ref.type

@@ -33,7 +33,63 @@ class ArtifactReference:
     secret_files: dict = field(default_factory=dict)  # blob_id → [(path, bytes)]
 
 
-class ImageArchiveArtifact:
+class _ImageInspectMixin:
+    """Shared image-source assembly: cache keys (analyzer versions +
+    custom-check fingerprints), the missing-layer walk, and metadata —
+    used by docker-archive, OCI-layout, and streaming-registry paths
+    so cache/secret handling cannot drift between them."""
+
+    @staticmethod
+    def _created_by(config: dict, diff_ids: list) -> list:
+        history = [h for h in config.get("history", [])
+                   if not h.get("empty_layer")]
+        created_by = [h.get("created_by", "") for h in history]
+        return created_by + [""] * (len(diff_ids) - len(created_by))
+
+    def _image_keys(self, image_id: str, diff_ids: list):
+        versions = self.group.versions()
+        opts = {"scanners": sorted(self.scanners)}
+        from ..misconf import custom_checks_fingerprint
+        fp = custom_checks_fingerprint()
+        if fp:
+            opts["config_checks"] = fp
+        return (cache_key(image_id, versions, opts),
+                [cache_key(d, versions, opts) for d in diff_ids])
+
+    def _walk_missing_layers(self, diff_ids, blob_ids, created_by,
+                             missing, open_layer,
+                             layer_digests=None) -> dict:
+        """open_layer(i) → context manager yielding a layer tarfile."""
+        secret_files: dict = {}
+        want_secrets = "secret" in self.scanners
+        for i, (diff_id, blob_id, cb) in enumerate(
+                zip(diff_ids, blob_ids, created_by)):
+            if blob_id not in missing:
+                continue
+            with open_layer(i) as layer_tf:
+                scan = walk_layer_tar(
+                    layer_tf, self.group, collect_secrets=want_secrets,
+                    secret_config_path=self.secret_config_path)
+            bi = blob_info(scan, diff_id=diff_id, created_by=cb)
+            if layer_digests:
+                bi.digest = layer_digests[i]
+            if want_secrets and scan.secret_files:
+                secret_files[blob_id] = scan.secret_files
+                bi.secrets = self.secret_scanner.scan_files(
+                    scan.secret_files)
+            self.cache.put_blob(blob_id, bi)
+        return secret_files
+
+    def _put_artifact_info(self, artifact_id: str, config: dict):
+        self.cache.put_artifact(artifact_id, {
+            "SchemaVersion": 2,
+            "Architecture": config.get("architecture", ""),
+            "Created": config.get("created", ""),
+            "OS": config.get("os", ""),
+        })
+
+
+class ImageArchiveArtifact(_ImageInspectMixin):
     """docker-save / OCI-archive tarball."""
 
     def __init__(self, path: str, cache, group: Optional[AnalyzerGroup] = None,
@@ -77,48 +133,29 @@ class ImageArchiveArtifact:
     # --- docker-save format ---
 
     def _inspect_docker_archive(self, tf: tarfile.TarFile):
+        import contextlib
+
         manifest = json.load(tf.extractfile("manifest.json"))[0]
         config = json.load(tf.extractfile(manifest["Config"]))
         diff_ids = config.get("rootfs", {}).get("diff_ids", [])
         layer_paths = manifest.get("Layers", [])
-        history = [h for h in config.get("history", [])
-                   if not h.get("empty_layer")]
-        created_by = [h.get("created_by", "") for h in history]
-        created_by += [""] * (len(diff_ids) - len(created_by))
-
+        created_by = self._created_by(config, diff_ids)
         image_id = "sha256:" + hashlib.sha256(
             json.dumps(config, sort_keys=True).encode()).hexdigest()
-        versions = self.group.versions()
-        opts = {"scanners": sorted(self.scanners)}
-        from ..misconf import custom_checks_fingerprint
-        fp = custom_checks_fingerprint()
-        if fp:
-            opts["config_checks"] = fp
-        artifact_id = cache_key(image_id, versions, opts)
-        blob_ids = [cache_key(d, versions, opts) for d in diff_ids]
+        artifact_id, blob_ids = self._image_keys(image_id, diff_ids)
+        missing_artifact, missing = self.cache.missing_blobs(
+            artifact_id, blob_ids)
 
-        missing_artifact, missing = self.cache.missing_blobs(artifact_id,
-                                                             blob_ids)
-        secret_files: dict = {}
-        want_secrets = "secret" in self.scanners
-        for diff_id, layer_path, blob_id, cb in zip(
-                diff_ids, layer_paths, blob_ids, created_by):
-            if blob_id not in missing:
-                continue
-            f = tf.extractfile(layer_path)
-            data = f.read()
+        @contextlib.contextmanager
+        def open_layer(i):
+            data = tf.extractfile(layer_paths[i]).read()
             if data[:2] == b"\x1f\x8b":
                 data = gzip.decompress(data)
             with tarfile.open(fileobj=io.BytesIO(data)) as layer_tf:
-                scan = walk_layer_tar(
-                    layer_tf, self.group, collect_secrets=want_secrets,
-                    secret_config_path=self.secret_config_path)
-            bi = blob_info(scan, diff_id=diff_id, created_by=cb)
-            if want_secrets and scan.secret_files:
-                secret_files[blob_id] = scan.secret_files
-                bi.secrets = self.secret_scanner.scan_files(
-                    scan.secret_files)
-            self.cache.put_blob(blob_id, bi)
+                yield layer_tf
+
+        secret_files = self._walk_missing_layers(
+            diff_ids, blob_ids, created_by, missing, open_layer)
 
         metadata = T.Metadata(
             image_id=image_id,
@@ -127,12 +164,7 @@ class ImageArchiveArtifact:
             image_config=config,
         )
         if missing_artifact:
-            self.cache.put_artifact(artifact_id, {
-                "SchemaVersion": 2,
-                "Architecture": config.get("architecture", ""),
-                "Created": config.get("created", ""),
-                "OS": config.get("os", ""),
-            })
+            self._put_artifact_info(artifact_id, config)
         name = self.path
         if metadata.repo_tags:
             name = metadata.repo_tags[0]
@@ -144,51 +176,37 @@ class ImageArchiveArtifact:
     # --- OCI image layout ---
 
     def _inspect_oci_layout(self, tf: tarfile.TarFile):
+        import contextlib
+
         index = json.load(tf.extractfile("index.json"))
         mdesc = index["manifests"][0]
         manifest = json.load(tf.extractfile(_blob_path(mdesc["digest"])))
         config = json.load(tf.extractfile(
             _blob_path(manifest["config"]["digest"])))
         diff_ids = config.get("rootfs", {}).get("diff_ids", [])
-        history = [h for h in config.get("history", [])
-                   if not h.get("empty_layer")]
-        created_by = [h.get("created_by", "") for h in history]
-        created_by += [""] * (len(diff_ids) - len(created_by))
-
+        created_by = self._created_by(config, diff_ids)
         image_id = manifest["config"]["digest"]
-        versions = self.group.versions()
-        opts = {"scanners": sorted(self.scanners)}
-        from ..misconf import custom_checks_fingerprint
-        fp = custom_checks_fingerprint()
-        if fp:
-            opts["config_checks"] = fp
-        artifact_id = cache_key(image_id, versions, opts)
-        blob_ids = [cache_key(d, versions, opts) for d in diff_ids]
-        _, missing = self.cache.missing_blobs(artifact_id, blob_ids)
+        artifact_id, blob_ids = self._image_keys(image_id, diff_ids)
+        missing_artifact, missing = self.cache.missing_blobs(
+            artifact_id, blob_ids)
+        layer_digests = [ld["digest"] for ld in manifest["layers"]]
 
-        secret_files: dict = {}
-        want_secrets = "secret" in self.scanners
-        for diff_id, ldesc, blob_id, cb in zip(diff_ids, manifest["layers"],
-                                               blob_ids, created_by):
-            if blob_id not in missing:
-                continue
-            data = tf.extractfile(_blob_path(ldesc["digest"])).read()
+        @contextlib.contextmanager
+        def open_layer(i):
+            data = tf.extractfile(_blob_path(layer_digests[i])).read()
             if data[:2] == b"\x1f\x8b":
                 data = gzip.decompress(data)
             with tarfile.open(fileobj=io.BytesIO(data)) as layer_tf:
-                scan = walk_layer_tar(
-                    layer_tf, self.group, collect_secrets=want_secrets,
-                    secret_config_path=self.secret_config_path)
-            bi = blob_info(scan, diff_id=diff_id, created_by=cb)
-            bi.digest = ldesc["digest"]
-            if want_secrets and scan.secret_files:
-                secret_files[blob_id] = scan.secret_files
-                bi.secrets = self.secret_scanner.scan_files(
-                    scan.secret_files)
-            self.cache.put_blob(blob_id, bi)
+                yield layer_tf
+
+        secret_files = self._walk_missing_layers(
+            diff_ids, blob_ids, created_by, missing, open_layer,
+            layer_digests=layer_digests)
 
         metadata = T.Metadata(image_id=image_id, diff_ids=diff_ids,
                               image_config=config)
+        if missing_artifact:
+            self._put_artifact_info(artifact_id, config)
         return ArtifactReference(
             name=self.path, type=T.ArtifactType.CONTAINER_IMAGE,
             id=artifact_id, blob_ids=blob_ids, image_metadata=metadata,
@@ -291,3 +309,84 @@ class VMArtifact(_SingleBlobArtifact):
                            secret_config_path=self.secret_config_path)
         finally:
             dev.close()
+
+
+class RegistryArtifact(_ImageInspectMixin):
+    """Registry-pulled image, layers STREAMED straight from blob
+    responses into the analyzer walk (reference
+    pkg/fanal/artifact/image/image.go:241-330) — no intermediate
+    tarball, no double disk I/O on registry sweeps."""
+
+    def __init__(self, image: str, cache,
+                 group: Optional[AnalyzerGroup] = None,
+                 scanners: tuple = ("vuln",), secret_scanner=None,
+                 secret_config_path: str = DEFAULT_SECRET_CONFIG,
+                 platform: str = "linux/amd64", client=None):
+        from ..oci import default_client, parse_ref
+        self.image = image
+        self.ref = parse_ref(image)
+        self.client = client or default_client()
+        self.platform = platform or "linux/amd64"
+        self.cache = cache
+        self.group = group or AnalyzerGroup()
+        self.scanners = scanners
+        self.secret_scanner = secret_scanner
+        self.secret_config_path = secret_config_path
+        if "secret" in scanners and secret_scanner is None:
+            from ..secret import SecretScanner
+            self.secret_scanner = SecretScanner()
+        self._manifest = None
+
+    def manifest(self) -> dict:
+        if self._manifest is None:
+            self._manifest = self.client.manifest(self.ref,
+                                                  self.platform)
+        return self._manifest
+
+    def image_digest(self) -> str:
+        return self.manifest()["config"]["digest"]
+
+    def inspect(self) -> ArtifactReference:
+        import contextlib
+
+        man = self.manifest()
+        config = json.loads(self.client.blob(
+            self.ref, man["config"]["digest"]))
+        diff_ids = config.get("rootfs", {}).get("diff_ids", [])
+        layers = man.get("layers", [])
+        created_by = self._created_by(config, diff_ids)
+        image_id = man["config"]["digest"]
+        artifact_id, blob_ids = self._image_keys(image_id, diff_ids)
+        missing_artifact, missing = self.cache.missing_blobs(
+            artifact_id, blob_ids)
+        layer_digests = [ld["digest"] for ld in layers]
+
+        @contextlib.contextmanager
+        def open_layer(i):
+            layer = layers[i]
+            mode = "r|gz" if layer.get("mediaType", "").endswith(
+                ("+gzip", ".gzip")) else "r|*"
+            with self.client.blob_stream(self.ref,
+                                         layer["digest"]) as stream:
+                with tarfile.open(fileobj=stream, mode=mode) as ltf:
+                    yield ltf
+                # digest check AFTER the walk, BEFORE caching: a
+                # corrupted/tampered blob must never populate the cache
+                stream.verify()
+
+        secret_files = self._walk_missing_layers(
+            diff_ids, blob_ids, created_by, missing, open_layer,
+            layer_digests=layer_digests)
+
+        metadata = T.Metadata(
+            image_id=image_id,
+            diff_ids=diff_ids,
+            repo_tags=[self.image],
+            image_config=config,
+        )
+        if missing_artifact:
+            self._put_artifact_info(artifact_id, config)
+        return ArtifactReference(
+            name=self.image, type=T.ArtifactType.CONTAINER_IMAGE,
+            id=artifact_id, blob_ids=blob_ids, image_metadata=metadata,
+            secret_files=secret_files)

@@ -90,7 +90,13 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
             path, member.size, secret_config_path)
         if not (wants or wants_post or wants_secret):
             continue
-        f = tf.extractfile(member)
+        try:
+            f = tf.extractfile(member)
+        except tarfile.StreamError:
+            # stream-mode tars (registry layer responses) cannot seek
+            # back to a hardlink's target; skip it — the target file
+            # itself is analyzed when its own member arrives
+            continue
         if f is None:
             continue
         content = f.read()

@@ -453,20 +453,24 @@ _OVERRIDES: dict = {"sets": [], "files": []}
 
 
 def set_helm_overrides(sets=None, values_files=None) -> None:
+    """Loads --helm-values files EAGERLY: a typo'd or malformed file
+    must fail the run, not silently render default values."""
+    docs = []
+    for vf in values_files or []:
+        try:
+            with open(vf) as f:
+                docs.append(yaml.safe_load(f) or {})
+        except (OSError, yaml.YAMLError) as e:
+            raise HelmRenderError(f"--helm-values {vf}: {e}") from None
     _OVERRIDES["sets"] = list(sets or [])
-    _OVERRIDES["files"] = list(values_files or [])
+    _OVERRIDES["files"] = docs
 
 
 def _apply_overrides(base: dict | None) -> dict | None:
     if not _OVERRIDES["sets"] and not _OVERRIDES["files"]:
         return base
     merged = dict(base or {})
-    for vf in _OVERRIDES["files"]:
-        try:
-            with open(vf) as f:
-                doc = yaml.safe_load(f) or {}
-        except (OSError, yaml.YAMLError):
-            continue
+    for doc in _OVERRIDES["files"]:
         merged = _deep_merge(merged, doc)
     for raw in _OVERRIDES["sets"]:
         key, _, val = raw.partition("=")

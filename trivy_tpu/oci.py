@@ -233,6 +233,14 @@ class RegistryClient:
                                f"got sha256:{actual}")
         return data
 
+    def blob_stream(self, ref: ImageRef, digest: str):
+        """→ file-like verifying stream for a blob — callers stream it
+        (registry image layers walk straight out of the socket, never
+        touching disk; reference image.go:241-330) and call .verify()
+        when done to enforce the manifest digest."""
+        url = f"{ref.base}/blobs/{digest}"
+        return _VerifyingStream(self._request(url, {}, ref), digest)
+
     # ---- high level ------------------------------------------------------
 
     def download_artifact_layer(self, ref: ImageRef,
@@ -304,6 +312,46 @@ def untar_gz_members(data: bytes) -> dict[str, bytes]:
                     name = name[2:]
                 out[name] = f.read() if f else b""
     return out
+
+
+class _VerifyingStream:
+    """Wraps a blob response, hashing bytes as they stream; verify()
+    drains the remainder and raises OCIError on a digest mismatch —
+    the streaming path keeps the integrity check the buffered blob()
+    fetch has."""
+
+    def __init__(self, resp, digest: str):
+        self._resp = resp
+        self._digest = digest
+        self._hash = hashlib.sha256()
+
+    def read(self, n: int = -1) -> bytes:
+        data = self._resp.read(n)
+        if data:
+            self._hash.update(data)
+        return data
+
+    def verify(self):
+        while True:
+            chunk = self._resp.read(1 << 20)
+            if not chunk:
+                break
+            self._hash.update(chunk)
+        if self._digest.startswith("sha256:"):
+            actual = self._hash.hexdigest()
+            if actual != self._digest.split(":", 1)[1]:
+                raise OCIError(
+                    f"blob digest mismatch for {self._digest}: "
+                    f"got sha256:{actual}")
+
+    def close(self):
+        self._resp.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def default_client() -> RegistryClient:
