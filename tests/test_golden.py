@@ -330,3 +330,157 @@ def test_golden_gomod_skip_files(tmp_path):
                                 "go.mod"),
                    "--cache-dir", str(tmp_path)], tmp_path)
     assert_zero_diff(got, read_golden("gomod-skip.json.golden"))
+
+
+# ---- SBOM decode configs (sbom_test.go) --------------------------------
+
+def _sbom_compare(got, want, input_path, artifact_type,
+                  target_overrides=None, bomref_overrides=None):
+    """compareSBOMReports (sbom_test.go:213-250): artifact name/type +
+    Target overrides, zero image metadata, clear vuln Layer.DiffID,
+    BOMRef overrides."""
+    want = json.loads(json.dumps(want))
+    want["ArtifactName"] = input_path
+    want["ArtifactType"] = artifact_type
+    md = want.get("Metadata", {})
+    md.pop("ImageID", None)
+    md.pop("DiffIDs", None)
+    md["ImageConfig"] = dict(ZERO_IMAGE_CONFIG)
+    for i, res in enumerate(want.get("Results", [])):
+        if target_overrides and i < len(target_overrides) and \
+                target_overrides[i]:
+            res["Target"] = target_overrides[i]
+        for j, v in enumerate(res.get("Vulnerabilities") or []):
+            (v.get("Layer") or {}).pop("DiffID", None)
+            if bomref_overrides and (i, j) in bomref_overrides:
+                v.setdefault("PkgIdentifier", {})["BOMRef"] = \
+                    bomref_overrides[(i, j)]
+    assert_zero_diff(got, want)
+
+
+def test_golden_sbom_fluentd_cyclonedx(tmp_path):
+    """sbom_test.go "fluentd-multiple-lockfiles cyclonedx"."""
+    input_path = os.path.join(GOLD, "inputs",
+                              "fluentd-multiple-lockfiles-cyclonedx.json")
+    got = run_cli(["sbom", input_path, "--db", DB_GLOB,
+                   "--format", "json", "--cache-dir", str(tmp_path)],
+                  tmp_path)
+    want = read_golden("fluentd-multiple-lockfiles.json.golden")
+    want["ArtifactName"] = input_path
+    md = want.get("Metadata", {})
+    md.pop("ImageID", None)
+    md.pop("DiffIDs", None)
+    md["ImageConfig"] = dict(ZERO_IMAGE_CONFIG)
+    tgt = f"{input_path} (debian 10.2)"
+    want["Results"][0]["Target"] = tgt
+    for res in want["Results"]:
+        for v in res.get("Vulnerabilities") or []:
+            (v.get("Layer") or {}).pop("DiffID", None)
+    assert_zero_diff(got, want)
+
+
+def test_golden_sbom_minikube_kbom(tmp_path):
+    """sbom_test.go "minikube KBOM": k8s core components detected from
+    a KBOM (kubernetes ecosystem advisories)."""
+    input_path = os.path.join(GOLD, "inputs", "minikube-kbom.json")
+    got = run_cli(["sbom", input_path, "--db", DB_GLOB,
+                   "--format", "json", "--cache-dir", str(tmp_path)],
+                  tmp_path)
+    want = read_golden("minikube-kbom.json.golden")
+    want["ArtifactName"] = input_path
+    md = want.get("Metadata", {})
+    md.pop("ImageID", None)
+    md.pop("DiffIDs", None)
+    md["ImageConfig"] = dict(ZERO_IMAGE_CONFIG)
+    want["Results"][0]["Target"] = f"{input_path} (ubuntu 22.04.2)"
+    assert_zero_diff(got, want)
+
+
+def test_golden_sbom_intoto_attestation(tmp_path):
+    """sbom_test.go "centos7 in in-toto attestation": DSSE envelope
+    with a base64 CycloneDX payload."""
+    input_path = os.path.join(GOLD, "inputs",
+                              "centos-7-cyclonedx.intoto.jsonl")
+    got = run_cli(["sbom", input_path, "--db", DB_GLOB,
+                   "--format", "json", "--cache-dir", str(tmp_path)],
+                  tmp_path)
+    want = read_golden("centos-7.json.golden")
+    want["ArtifactType"] = "cyclonedx"
+    md = want.get("Metadata", {})
+    md.pop("ImageID", None)
+    md.pop("DiffIDs", None)
+    md["ImageConfig"] = dict(ZERO_IMAGE_CONFIG)
+    bomrefs = {
+        "CVE-2019-18276": "pkg:rpm/centos/bash@4.2.46-31.el7"
+                          "?arch=x86_64&distro=centos-7.6.1810",
+        "CVE-2019-1559": "pkg:rpm/centos/openssl-libs@1.0.2k-16.el7"
+                         "?arch=x86_64&epoch=1&distro=centos-7.6.1810",
+        "CVE-2018-0734": "pkg:rpm/centos/openssl-libs@1.0.2k-16.el7"
+                         "?arch=x86_64&epoch=1&distro=centos-7.6.1810",
+    }
+    for res in want.get("Results", []):
+        res["Target"] = f"{input_path} (centos 7.6.1810)"
+        for v in res.get("Vulnerabilities", []):
+            (v.get("Layer") or {}).pop("DiffID", None)
+            v.setdefault("PkgIdentifier", {})["BOMRef"] = \
+                bomrefs[v["VulnerabilityID"]]
+    assert_zero_diff(got, want)
+
+
+@pytest.mark.parametrize("fixture,atype", [
+    ("centos-7-spdx.json", "spdx"),
+    ("centos-7-spdx.txt", "spdx"),
+])
+def test_golden_sbom_spdx_decode(fixture, atype, tmp_path):
+    """sbom_test.go "centos7 spdx json" / "centos7 spdx tag-value"."""
+    input_path = os.path.join(GOLD, "inputs", fixture)
+    got = run_cli(["sbom", input_path, "--db", DB_GLOB,
+                   "--format", "json", "--cache-dir", str(tmp_path)],
+                  tmp_path)
+    want = read_golden("centos-7.json.golden")
+    want["ArtifactType"] = atype
+    md = want.get("Metadata", {})
+    md.pop("ImageID", None)
+    md.pop("DiffIDs", None)
+    md["ImageConfig"] = dict(ZERO_IMAGE_CONFIG)
+    for res in want.get("Results", []):
+        res["Target"] = f"{input_path} (centos 7.6.1810)"
+        for v in res.get("Vulnerabilities", []):
+            (v.get("Layer") or {}).pop("DiffID", None)
+            v.get("PkgIdentifier", {}).pop("BOMRef", None)
+    assert_zero_diff(got, want)
+
+
+def test_golden_sbom_license_check(tmp_path):
+    """sbom_test.go "license check cyclonedx json"."""
+    input_path = os.path.join(GOLD, "inputs", "license-cyclonedx.json")
+    got = run_cli(["sbom", input_path, "--db", DB_GLOB,
+                   "--scanners", "license",
+                   "--format", "json", "--cache-dir", str(tmp_path)],
+                  tmp_path)
+    want = read_golden("license-cyclonedx.json.golden")
+    want["ArtifactName"] = input_path
+    md = want.get("Metadata", {})
+    md.pop("ImageID", None)
+    md.pop("DiffIDs", None)
+    md["ImageConfig"] = dict(ZERO_IMAGE_CONFIG)
+    assert_zero_diff(got, want)
+
+
+def test_spdx_golang_purl_names_full_module_path():
+    from trivy_tpu.sbom.spdx import _purl_package
+    _, pkg, _ = _purl_package(
+        "pkg:golang/github.com/opencontainers/runc@v1.0.0")
+    assert pkg.name == "github.com/opencontainers/runc"
+
+
+def test_spdx_tag_value_files_section_does_not_eat_last_package():
+    from trivy_tpu.sbom.spdx import parse_tag_value
+    doc = parse_tag_value(
+        "SPDXVersion: SPDX-2.3\n"
+        "PackageName: bash\n"
+        "SPDXID: SPDXRef-Package-1\n"
+        "PackageVersion: 4.2\n"
+        "FileName: ./etc/x\n"
+        "SPDXID: SPDXRef-File-1\n")
+    assert doc["packages"][0]["SPDXID"] == "SPDXRef-Package-1"

@@ -29,7 +29,7 @@ APP_ECOSYSTEM = {
     "swift": "swift", "cocoapods": "cocoapods",
     "pub": "pub",
     "julia": "julia",
-    "k8s": "k8s",
+    "k8s": "k8s", "kubernetes": "k8s",
     # conda-pkg intentionally absent: SBOM-only, no vuln scanning
     # (driver.go:77-79)
 }

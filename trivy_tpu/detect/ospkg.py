@@ -263,6 +263,8 @@ class OspkgScanner:
         """→ (vulns, eosl). Skips gpg-pubkey pseudo packages like
         detect.go:73."""
         queries, finish = self.prepare(os_info, repo, packages, now)
+        if finish is None:
+            return [], False
         return finish(self.detector.detect(queries))
 
     def prepare(self, os_info: T.OS, repo: Optional[T.Repository],
@@ -278,7 +280,9 @@ class OspkgScanner:
             return self._prepare_redhat(os_info, packages, now)
         driver = DRIVERS.get(os_info.family)
         if driver is None:
-            return [], lambda hits: ([], False)
+            # unsupported family: the caller emits NO result
+            # (ospkg/scan.go ErrUnsupportedOS → empty Result)
+            return [], None
         now = now or dt.datetime.now(dt.timezone.utc)
         if driver.family == "ubuntu":
             # stream selection shares the scan clock so the ESM

@@ -78,6 +78,21 @@ def categorize(name: str) -> str:
     return "unknown"
 
 
+def scan_license_name(name: str, categories: dict | None = None
+                      ) -> tuple[str, str]:
+    """→ (category, severity) for a RAW license name — the reference's
+    licensing.Scanner.Scan does no normalization ("MIT License" is
+    unknown, "MIT" is notice; scan.go:292)."""
+    cat = _custom_category(name, categories)
+    if cat is None:
+        cat = "unknown"
+        for c, names in _CATEGORIES.items():
+            if name in names:
+                cat = c
+                break
+    return cat, CATEGORY_SEVERITY.get(cat, "UNKNOWN")
+
+
 def scan_packages(detail_packages: list, applications: list,
                   categories: dict | None = None) -> list[T.DetectedLicense]:
     """Declared-license scan over OS packages + applications.
@@ -88,16 +103,11 @@ def scan_packages(detail_packages: list, applications: list,
 
     def _emit(pkg: T.Package, file_path: str = ""):
         for lic in pkg.licenses:
-            name = normalize(lic)
-            cat = _custom_category(name, categories) or categorize(name)
+            cat, sev = scan_license_name(lic, categories)
             out.append(T.DetectedLicense(
-                severity=CATEGORY_SEVERITY.get(cat, "UNKNOWN"),
-                category=cat,
-                pkg_name=pkg.name,
+                severity=sev, category=cat, pkg_name=pkg.name,
                 file_path=file_path or pkg.file_path,
-                name=name,
-                link=f"https://spdx.org/licenses/{name}.html"
-                if categorize(name) != "unknown" else "",
+                name=lic, confidence=1.0,
             ))
 
     for pkg in detail_packages:

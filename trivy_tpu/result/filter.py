@@ -70,8 +70,12 @@ def filter_results(results: list[T.Result],
                 opts, "misconfigurations", getattr(m, "id", ""),
                 res.target) and not (
                 policy and policy.ignore(m.to_json()))]
+    # empty license results survive: the reference emits the
+    # OS Packages / per-app / Loose File License(s) groups even when
+    # they hold nothing (scan.go:302-360)
     return [r for r in results if not r.is_empty() or r.clazz in
-            (T.ResultClass.OS_PKGS, T.ResultClass.LANG_PKGS)]
+            (T.ResultClass.OS_PKGS, T.ResultClass.LANG_PKGS,
+             T.ResultClass.LICENSE, T.ResultClass.LICENSE_FILE)]
 
 
 def _keep_vuln(v: T.DetectedVulnerability, res: T.Result, sev: set,

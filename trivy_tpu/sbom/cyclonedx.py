@@ -27,6 +27,7 @@ def _props(component: dict) -> dict:
 def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
     detail = T.ArtifactDetail()
     apps: dict[str, T.Application] = {}
+    explicit_apps: list[T.Application] = []
     os_pkgs: list[T.Package] = []
     os_type = ""
 
@@ -43,15 +44,18 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
             detail.os = T.OS(family=comp.get("name", ""),
                              name=comp.get("version", ""))
             continue
-        if ctype == "application":
+        if ctype == "application" and not comp.get("purl"):
             app_type = props.get("Type", "")
             path = comp.get("name", "")
             if app_type:
-                apps[comp.get("bom-ref", path)] = T.Application(
-                    type=app_type, file_path=path)
+                app = T.Application(type=app_type, file_path=path)
+                apps[comp.get("bom-ref", path)] = app
+                explicit_apps.append(app)
             continue
-        if ctype != "library":
+        if ctype not in ("library", "application", "platform"):
             continue
+        if ctype == "platform" and not comp.get("purl"):
+            continue  # KBOM nodes/groupings without package identity
         purl = comp.get("purl", "")
         purl_type, purl_quals = _purl_parts(purl)
         pkg = T.Package(
@@ -68,6 +72,12 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
             identifier=T.PkgIdentifier(purl=_canonical_purl(purl),
                                        bom_ref=comp.get("bom-ref", "")),
         )
+        for lic in comp.get("licenses") or []:
+            name = (lic.get("license") or {}).get("name") or \
+                (lic.get("license") or {}).get("id") or \
+                lic.get("expression") or ""
+            if name:
+                pkg.licenses.append(name)
         ptype = props.get("PkgType", "")
         if not ptype:
             # trivy BOMs for OS packages carry no PkgType property — the
@@ -81,22 +91,39 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
                              "gobinary") \
                 else f"{comp['group']}:{pkg.name}"
         if ptype in OS_PKG_TYPES:
+            # PkgID carries the FULL version string (before any
+            # version-release split)
+            pkg.id = props.get("PkgID") or f"{pkg.name}@{pkg.version}"
             if ptype in ("rpm", "deb", "apk") and "-" in pkg.version \
                     and not pkg.release:
                 # OS purl versions are version-release joined
                 pkg.version, pkg.release = pkg.version.rsplit("-", 1)
-            pkg.id = props.get("PkgID") or f"{pkg.name}@{pkg.version}"
+            if ptype in ("rpm", "deb", "apk") and \
+                    "-" in pkg.src_version and not pkg.src_release:
+                pkg.src_version, pkg.src_release = \
+                    pkg.src_version.rsplit("-", 1)
             os_type = os_type or ptype
             os_pkgs.append(pkg)
         else:
             pkg.id = props.get("PkgID") or f"{pkg.name}@{pkg.version}"
-            key = props.get("FilePath", "") or ptype
-            app = apps.setdefault(key, T.Application(
-                type=ptype or "unknown", file_path=props.get("FilePath", "")))
+            path = props.get("FilePath", "")
+            app_type = ptype or "unknown"
+            if not path and purl:
+                # a library with no file path and no application link
+                # aggregates by its PURL class, not its PkgType prop
+                # (unmarshal.go: orphan maven components → Jar → the
+                # "Java" aggregated target)
+                app_type = _PURL_TO_TYPE.get(purl_type, ptype) \
+                    or "unknown"
+            app = apps.setdefault(path or app_type, T.Application(
+                type=app_type, file_path=path))
             app.packages.append(pkg)
 
     detail.packages = os_pkgs
-    detail.applications = [a for a in apps.values() if a.packages]
+    # explicit application components survive even when empty — the
+    # reference emits their (empty) license groups (scan.go:332-336)
+    detail.applications = [a for a in apps.values()
+                           if a.packages or a in explicit_apps]
     return detail
 
 
@@ -133,13 +160,15 @@ _PURL_TO_TYPE = {
     "pypi": "python-pkg", "npm": "node-pkg", "gem": "gemspec",
     "golang": "gobinary", "maven": "jar", "cargo": "rustbinary",
     "conda": "conda-pkg", "nuget": "nuget", "composer": "composer",
+    # KBOM core components (unmarshal.go: purl k8s → K8sUpstream)
+    "k8s": "kubernetes",
 }
 
 
-OS_PKG_TYPES = {"alpine", "apk", "debian", "ubuntu", "redhat", "centos",
-                "rocky", "alma", "amazon", "oracle", "fedora", "suse",
-                "opensuse", "photon", "wolfi", "chainguard", "cbl-mariner",
-                "dpkg", "rpm"}
+OS_PKG_TYPES = {"alpine", "apk", "deb", "debian", "ubuntu", "redhat",
+                "centos", "rocky", "alma", "amazon", "oracle", "fedora",
+                "suse", "opensuse", "photon", "wolfi", "chainguard",
+                "cbl-mariner", "dpkg", "rpm"}
 
 
 def _fake_uuid_counter():

@@ -37,9 +37,20 @@ def unwrap_attestation(doc: dict) -> dict:
 
 
 def decode_sbom_file(path: str, cache):
-    """→ ArtifactReference whose single blob carries the decoded detail."""
+    """→ ArtifactReference whose single blob carries the decoded detail.
+    Accepts JSON documents (CycloneDX/SPDX, optionally attestation-
+    wrapped) and SPDX tag-value text (FormatSPDXTV, sbom.go:111)."""
     with open(path) as f:
-        doc = json.load(f)
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        if "SPDXVersion:" in text:
+            from .spdx import parse_tag_value
+            doc = parse_tag_value(text)
+        else:
+            raise ValueError(
+                f"{path}: neither JSON SBOM nor SPDX tag-value")
     return decode_sbom_doc(doc, cache, name=path)
 
 

@@ -127,41 +127,165 @@ def encode_spdx(report: T.Report, app_version: str = "dev") -> dict:
     }
 
 
+def _attrs(p: dict) -> dict:
+    out = {}
+    for t in p.get("attributionTexts") or []:
+        key, _, val = t.partition(": ")
+        if key:
+            out[key] = val
+    return out
+
+
+def _purl_package(purl: str) -> tuple[str, T.Package, dict]:
+    """purl → (purl type, Package with name/version/epoch/arch, quals).
+
+    The trivy SPDX flavor carries package identity in the purl
+    external ref (pkg/sbom/spdx/unmarshal.go), not in versionInfo."""
+    import urllib.parse
+    body = purl[len("pkg:"):]
+    path, _, qs = body.partition("?")
+    quals = dict(q.split("=", 1) for q in qs.split("&") if "=" in q)
+    ptype, _, rest = path.partition("/")
+    ver = ""
+    if "@" in rest:
+        rest, _, ver = rest.rpartition("@")
+    segs = [urllib.parse.unquote(x) for x in rest.split("/")]
+    if ptype in ("deb", "rpm", "apk"):
+        name = segs[-1]
+    elif ptype == "maven":
+        name = ":".join(segs[-2:]) if len(segs) >= 2 else segs[-1]
+    else:
+        # golang/k8s names span namespace+name (full module path)
+        name = "/".join(segs) if ptype in ("golang", "k8s") and \
+            len(segs) > 1 else segs[-1]
+    ver = urllib.parse.unquote(ver)
+    from .cyclonedx import _canonical_purl
+    pkg = T.Package(name=name, version=ver,
+                    arch=quals.get("arch", ""),
+                    epoch=int(quals.get("epoch", "0") or 0),
+                    identifier=T.PkgIdentifier(
+                        purl=_canonical_purl(purl)))
+    return ptype, pkg, quals
+
+
 def decode_spdx(doc: dict) -> T.ArtifactDetail:
-    """Best-effort decode: packages with purls → typed applications."""
-    from .cyclonedx import OS_PKG_TYPES
+    """Trivy-flavored SPDX decode (pkg/sbom/spdx/unmarshal.go):
+    OperatingSystem package → OS, Application packages → app
+    groupings via CONTAINS relationships, library packages built from
+    their purl external refs with PkgID attribution."""
+    from .cyclonedx import OS_PKG_TYPES, _PURL_TO_TYPE
+
     detail = T.ArtifactDetail()
     apps: dict[str, T.Application] = {}
-    for p in doc.get("packages", []):
+    owner: dict[str, str] = {}  # package SPDXID → application SPDXID
+    for rel in doc.get("relationships") or []:
+        if rel.get("relationshipType") == "CONTAINS" and \
+                str(rel.get("spdxElementId", "")).startswith(
+                    "SPDXRef-Application"):
+            owner[rel["relatedSpdxElement"]] = rel["spdxElementId"]
+
+    os_pkgs: list[T.Package] = []
+    for p in doc.get("packages") or []:
+        sid = str(p.get("SPDXID", ""))
+        attrs = _attrs(p)
+        if sid.startswith("SPDXRef-OperatingSystem"):
+            detail.os = T.OS(family=p.get("name", ""),
+                             name=p.get("versionInfo", ""))
+            continue
+        if sid.startswith("SPDXRef-Application"):
+            apps[sid] = T.Application(
+                type=attrs.get("Type", ""), file_path=p.get("name", ""))
+            continue
+        if not sid.startswith("SPDXRef-Package"):
+            continue  # root artifact / files
         purl = ""
-        for ref in p.get("externalRefs", []):
+        for ref in p.get("externalRefs") or []:
             if ref.get("referenceType") == "purl":
                 purl = ref.get("referenceLocator", "")
         if not purl or not purl.startswith("pkg:"):
             continue
-        body = purl[4:].split("?", 1)[0]
-        ptype, _, rest = body.partition("/")
-        name_ver = rest.rsplit("@", 1)
-        name = name_ver[0]
-        version = name_ver[1] if len(name_ver) > 1 else \
-            p.get("versionInfo", "")
-        if ptype in ("deb", "apk", "rpm"):
-            ns_name = name.split("/")
-            pkg = T.Package(name=ns_name[-1], version=version.split("?")[0],
-                            src_name=ns_name[-1])
-            pkg.id = f"{pkg.name}@{pkg.version}"
-            detail.packages.append(pkg)
-            fam = ns_name[0] if len(ns_name) > 1 else ""
-            if fam in OS_PKG_TYPES and not detail.os.detected:
-                detail.os = T.OS(family=fam)
+        ptype, pkg, _quals = _purl_package(purl)
+        lic = p.get("licenseDeclared") or p.get("licenseConcluded")
+        if lic and lic != "NOASSERTION":
+            pkg.licenses = [lic]
+        if ptype in OS_PKG_TYPES:
+            pkg.id = attrs.get("PkgID") or f"{pkg.name}@{pkg.version}"
+            if "-" in pkg.version and not pkg.release:
+                pkg.version, pkg.release = pkg.version.rsplit("-", 1)
+            pkg.src_name = pkg.src_name or pkg.name
+            os_pkgs.append(pkg)
         else:
-            eco = {"pypi": "python-pkg", "golang": "gobinary",
-                   "gem": "gemspec", "maven": "jar"}.get(ptype, ptype)
-            app = apps.setdefault(eco, T.Application(type=eco))
-            pkg = T.Package(name=name.replace("/", ":", 1)
-                            if ptype == "maven" else name.split("/")[-1],
-                            version=version)
-            pkg.id = f"{pkg.name}@{pkg.version}"
-            app.packages.append(pkg)
-    detail.applications = list(apps.values())
+            app_type = _PURL_TO_TYPE.get(ptype, ptype)
+            pkg.id = attrs.get("PkgID") or f"{pkg.name}@{pkg.version}"
+            if sid in owner and owner[sid] in apps:
+                apps[owner[sid]].packages.append(pkg)
+            else:
+                key = f"type:{app_type}"
+                app = apps.setdefault(
+                    key, T.Application(type=app_type))
+                app.packages.append(pkg)
+
+    detail.packages = os_pkgs
+    detail.applications = [a for a in apps.values() if a.packages]
     return detail
+
+
+def parse_tag_value(text: str) -> dict:
+    """SPDX tag-value → the JSON-document shape decode_spdx consumes
+    (reference supports FormatSPDXTV, sbom.go:111)."""
+    packages: list[dict] = []
+    rels: list[dict] = []
+    cur: dict = {}
+    doc_info: dict = {}
+
+    def flush():
+        nonlocal cur
+        if cur:
+            packages.append(cur)
+            cur = {}
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.partition(":")
+        val = val.strip()
+        if key == "PackageName":
+            flush()
+            cur = {"name": val}
+        elif key in ("FileName", "DocumentName", "LicenseID"):
+            # a new non-package section starts: stop attributing tags
+            # (its SPDXID etc.) to the previous package
+            flush()
+        elif key == "SPDXID":
+            if cur:
+                cur["SPDXID"] = val
+            else:
+                doc_info["SPDXID"] = val
+        elif key == "SPDXVersion":
+            doc_info["spdxVersion"] = val
+        elif key == "PackageVersion":
+            cur["versionInfo"] = val
+        elif key == "ExternalRef":
+            parts = val.split()
+            if len(parts) == 3 and parts[1] == "purl":
+                cur.setdefault("externalRefs", []).append({
+                    "referenceCategory": parts[0],
+                    "referenceType": "purl",
+                    "referenceLocator": parts[2],
+                })
+        elif key == "PackageAttributionText":
+            if val.startswith("<text>"):
+                val = val.removeprefix("<text>").removesuffix("</text>")
+            cur.setdefault("attributionTexts", []).append(val)
+        elif key == "Relationship":
+            parts = val.split()
+            if len(parts) == 3:
+                rels.append({"spdxElementId": parts[0],
+                             "relationshipType": parts[1],
+                             "relatedSpdxElement": parts[2]})
+    flush()
+    return {"spdxVersion": doc_info.get("spdxVersion", "SPDX-2.3"),
+            "packages": packages, "relationships": rels}
+
+

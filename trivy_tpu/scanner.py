@@ -75,8 +75,9 @@ class LocalScanner:
                     qs, fin = self.ospkg.prepare(
                         detail.os, detail.repository, detail.packages,
                         now=now)
-                    units.append((idx, "os", fin))
-                    batches.append(qs)
+                    if fin is not None:  # family supported
+                        units.append((idx, "os", fin))
+                        batches.append(qs)
                 if "library" in options.pkg_types:
                     for app in sorted(detail.applications,
                                       key=lambda a: (a.file_path, a.type)):
@@ -96,7 +97,9 @@ class LocalScanner:
                 vulns, eosl = finish(hits)
                 if eosl:
                     detail.os.eosl = True
-                keep = bool(detail.packages) or bool(vulns)
+                # a supported, detected OS always yields a result —
+                # even with zero packages (ospkg/scan.go:42-69)
+                keep = True
                 res = self._vuln_result(
                     vulns,
                     target=f"{target} ({detail.os.family} "
@@ -164,24 +167,39 @@ class LocalScanner:
                 ))
 
         if T.Scanner.LICENSE in options.scanners:
-            from .licensing import scan_packages
-            licenses = scan_packages(detail.packages, detail.applications)
-            if licenses:
+            # reference scanLicenses (local/scan.go:280-360): one
+            # result per group, emitted even when empty
+            from .licensing import scan_license_name
+            os_lics = []
+            for pkg in detail.packages:
+                for lic in pkg.licenses:
+                    cat, sev = scan_license_name(lic)
+                    os_lics.append(T.DetectedLicense(
+                        severity=sev, category=cat, pkg_name=pkg.name,
+                        name=lic, confidence=1.0))
+            results.append(T.Result(
+                target="OS Packages", clazz=T.ResultClass.LICENSE,
+                licenses=os_lics))
+            for app in detail.applications:
+                lang = []
+                for lib in app.packages:
+                    for lic in lib.licenses:
+                        cat, sev = scan_license_name(lic)
+                        lang.append(T.DetectedLicense(
+                            severity=sev, category=cat,
+                            pkg_name=lib.name, name=lic,
+                            file_path=lib.file_path or app.file_path,
+                            confidence=1.0))
                 results.append(T.Result(
-                    target="OS Packages" if detail.os.detected else "Licenses",
-                    clazz=T.ResultClass.LICENSE,
-                    licenses=licenses,
-                ))
-            if detail.licenses:
-                # full-text classified license FILES (--license-full,
-                # reference pkg/scanner/local/scan.go scanLicenses
-                # "Loose File License(s)" result)
-                results.append(T.Result(
-                    target="Loose File License(s)",
-                    clazz=T.ResultClass.LICENSE_FILE,
-                    licenses=sorted(detail.licenses,
-                                    key=lambda l: (l.file_path, l.name)),
-                ))
+                    target=app.file_path or
+                    PKG_TARGETS.get(app.type, app.type),
+                    clazz=T.ResultClass.LICENSE, licenses=lang))
+            results.append(T.Result(
+                target="Loose File License(s)",
+                clazz=T.ResultClass.LICENSE_FILE,
+                licenses=sorted(detail.licenses,
+                                key=lambda l: (l.file_path, l.name)),
+            ))
 
         # extension-module post-scan hooks (reference post.Scan at
         # pkg/scanner/local/scan.go:162; custom resources travel as a
@@ -204,6 +222,7 @@ class LocalScanner:
 PKG_TARGETS = {
     "python-pkg": "Python", "conda-pkg": "Conda", "gemspec": "Ruby",
     "node-pkg": "Node.js", "jar": "Java", "k8s": "Kubernetes",
+    "kubernetes": "Kubernetes",
 }
 
 

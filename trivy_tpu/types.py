@@ -454,7 +454,7 @@ class DetectedLicense(JsonMixin):
     link: str = ""
     _json_names = {"pkg_name": "PkgName", "file_path": "FilePath"}
     _keep_zero = ("severity", "category", "pkg_name", "file_path", "name",
-                  "confidence")
+                  "confidence", "link")
 
 
 @dataclass
