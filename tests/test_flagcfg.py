@@ -139,3 +139,69 @@ def test_config_section_name_never_feeds_same_named_flag(tmp_path):
                     cwd_config="db:\n  repository: example.com/db:2\n")
     assert args.db == ""
     assert args.db_repository == "example.com/db:2"
+
+
+def test_security_checks_alias_flag_and_env(tmp_path):
+    """config_test.go "key alias": --security-checks ≡ --scanners,
+    TRIVY_SECURITY_CHECKS binds too."""
+    args = _resolve(["repo", "x", "--security-checks", "vuln"], env={})
+    assert args.scanners == "vuln"
+    args = _resolve(["repo", "x"],
+                    env={"TRIVY_SECURITY_CHECKS": "secret"})
+    assert args.scanners == "secret"
+
+
+def test_scanner_value_aliases():
+    """config_test.go "value alias": vulnerability ≡ vuln."""
+    from trivy_tpu.cli import normalize_scanners
+    assert normalize_scanners("vulnerability,misconfiguration") == \
+        ("vuln", "misconfig")
+
+
+def test_golden_skip_files_via_env_and_config(tmp_path, monkeypatch):
+    """config_test.go "skip files": the same gomod-skip golden result
+    through TRIVY_SKIP_FILES and through scan.skip-files in
+    trivy.yaml (in-process, reusing the golden harness)."""
+    import test_golden as tg
+    gold = os.path.join(os.path.dirname(__file__), "golden")
+    target = os.path.join(gold, "inputs", "gomod")
+    db = os.path.join(gold, "db", "*.yaml")
+    want = json.load(open(os.path.join(gold, "reports",
+                                       "gomod-skip.json.golden")))
+
+    monkeypatch.setenv(
+        "TRIVY_SKIP_FILES",
+        f"path/to/dummy,{target}/submod2/go.mod")
+    got_env = tg.run_cli(["repo", target, "--db", db,
+                          "--format", "json",
+                          "--cache-dir", str(tmp_path / "c1")],
+                         tmp_path)
+    monkeypatch.delenv("TRIVY_SKIP_FILES")
+    tg.assert_zero_diff(got_env, json.loads(json.dumps(want)))
+
+    cfg = tmp_path / "trivy.yaml"
+    cfg.write_text(
+        "scan:\n  skip-files:\n    - path/to/dummy\n"
+        f"    - {target}/submod2/go.mod\n")
+    got_cfg = tg.run_cli(["repo", target, "--config", str(cfg),
+                          "--db", db, "--format", "json",
+                          "--cache-dir", str(tmp_path / "c2")],
+                         tmp_path)
+    tg.assert_zero_diff(got_cfg, want)
+
+
+def test_explicit_flag_beats_env_despite_other_subparsers(tmp_path):
+    """A duplicate same-dest action on another subcommand must not let
+    env override an explicitly-given flag."""
+    args = _resolve(["repo", "x", "--security-checks", "vuln"],
+                    env={"TRIVY_SCANNERS": "secret"})
+    assert args.scanners == "vuln"
+
+
+def test_legacy_security_checks_config_key(tmp_path):
+    """scan.security-checks in trivy.yaml binds --scanners (viper
+    alias)."""
+    args = _resolve(["repo", "x"], tmp_path=tmp_path,
+                    cwd_config="scan:\n  security-checks:\n"
+                               "    - secret\n")
+    assert args.scanners == "secret"

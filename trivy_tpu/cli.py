@@ -26,8 +26,9 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--config", "-c", default="",
                    help="trivy.yaml config file (flag > TRIVY_* env > "
                         "file > default)")
-    p.add_argument("--scanners", default="vuln",
-                   help="comma-separated: vuln,secret")
+    p.add_argument("--scanners", "--security-checks", default="vuln",
+                   help="comma-separated: vuln,secret (--security-checks"
+                        " is the reference's deprecated alias)")
     p.add_argument("--format", "-f", default="json",
                    choices=["json", "table", "sarif", "cyclonedx",
                             "spdx-json", "template", "github",
@@ -178,7 +179,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kubeconfig", default="")
     p.add_argument("--context", default="")
     p.add_argument("--namespace", "-n", default="")
-    p.add_argument("--scanners", default="misconfig",
+    p.add_argument("--scanners", "--security-checks",
+                   default="misconfig",
                    help="comma-separated: misconfig,vuln,secret")
     p.add_argument("--secret-config", default="trivy-secret.yaml")
     p.add_argument("--db", default="",
@@ -277,6 +279,26 @@ def _load_table_args(args) -> AdvisoryTable:
                       skip_update=getattr(args, "skip_db_update", False))
 
 
+_SCANNER_ALIASES = {
+    "vulnerability": "vuln",
+    "misconfiguration": "misconfig",
+    "config": "misconfig",
+    "secrets": "secret",
+    "licenses": "license",
+}
+
+
+def normalize_scanners(spec: str) -> tuple:
+    """--scanners value aliases (reference flag value normalization:
+    'vulnerability' ≡ 'vuln', 'misconfiguration' ≡ 'misconfig')."""
+    out = []
+    for s_ in spec.split(","):
+        s_ = s_.strip()
+        if s_:
+            out.append(_SCANNER_ALIASES.get(s_, s_))
+    return tuple(out)
+
+
 def _scan_common(args, ref, cache, artifact_type: str) -> int:
     profile_dir = getattr(args, "profile_dir", "")
     if profile_dir:
@@ -292,7 +314,7 @@ def _scan_common(args, ref, cache, artifact_type: str) -> int:
 
 
 def _scan_common_inner(args, ref, cache, artifact_type: str) -> int:
-    scanners = tuple(s.strip() for s in args.scanners.split(",") if s.strip())
+    scanners = normalize_scanners(args.scanners)
     # the DB is only initialized when vulnerability scanning is on
     # (reference run.go initScannerConfig: vuln scanner gates DB init)
     table = _load_table_args(args) if "vuln" in scanners \
@@ -489,7 +511,7 @@ def cmd_image(args) -> int:
                 "image acquisition failed: " + "; ".join(errors))
     try:
         cache = _open_cache(args)
-        scanners = tuple(s.strip() for s in args.scanners.split(","))
+        scanners = normalize_scanners(args.scanners)
         from .fanal.analyzers import AnalyzerGroup
         # image scans disable lockfile analyzers (run.go:167-169)
         sec_scanner, sec_cfg = _secret_scanner(args, scanners)
@@ -560,7 +582,7 @@ def cmd_fs(args) -> int:
     _configure_misconf(args)
     _configure_javadb(args)
     cache = MemoryCache()
-    scanners = tuple(s.strip() for s in args.scanners.split(","))
+    scanners = normalize_scanners(args.scanners)
     if args.command == "rootfs":
         disabled = LOCKFILE_ANALYZERS
         artifact_type = T.ArtifactType.FILESYSTEM
@@ -676,7 +698,7 @@ def cmd_vm(args) -> int:
     _configure_misconf(args)
     _configure_javadb(args)
     cache = MemoryCache()
-    scanners = tuple(s.strip() for s in args.scanners.split(","))
+    scanners = normalize_scanners(args.scanners)
     optin = ("license-file",) if getattr(args, "license_full",
                                          False) else ()
     sec_scanner, sec_cfg = _secret_scanner(args, scanners)
@@ -743,10 +765,9 @@ def cmd_k8s(args) -> int:
             json.dump(build_kbom(client), out, indent=2)
             out.write("\n")
             return 0
-        scanners = tuple(s.strip() for s in args.scanners.split(",")
-                         if s.strip())
+        scanners = normalize_scanners(args.scanners)
         results = []
-        if "misconfig" in scanners or "config" in scanners:
+        if "misconfig" in scanners:
             results += scan_cluster(client,
                                     args.namespace or cfg.namespace)
         if "vuln" in scanners or "secret" in scanners:
@@ -758,8 +779,7 @@ def cmd_k8s(args) -> int:
             results += scan_cluster_vulns(
                 client, MemoryCache(), table,
                 namespace=args.namespace or cfg.namespace,
-                scanners=[s for s in scanners
-                          if s not in ("misconfig", "config")],
+                scanners=[s for s in scanners if s != "misconfig"],
                 list_all_packages=args.list_all_pkgs,
                 secret_scanner=sec_scanner,
                 secret_config_path=_sec_cfg)

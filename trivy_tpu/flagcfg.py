@@ -21,8 +21,13 @@ import os
 from typing import Any, Optional
 
 # flag dest → reference trivy.yaml config path (flat names always work)
+# values may be a list: the first present path wins (viper aliases
+# bind several config keys to one flag)
 CONFIG_PATHS = {
-    "scanners": "scan.scanners",
+    "scanners": ["scan.scanners", "scan.security-checks"],
+    "skip_files": "scan.skip-files",
+    "skip_dirs": "scan.skip-dirs",
+    "parallel": "scan.parallel",
     "ignore_unfixed": "vulnerability.ignore-unfixed",
     "ignore_status": "vulnerability.ignore-status",
     "ignorefile": "ignorefile",
@@ -52,8 +57,11 @@ def _flag_name(action: argparse.Action) -> str:
     return (longs[0] if longs else action.option_strings[0]).lstrip("-")
 
 
-def _env_key(action: argparse.Action) -> str:
-    return "TRIVY_" + _flag_name(action).upper().replace("-", "_")
+def _env_keys(action: argparse.Action) -> list[str]:
+    """One env var per long option — alias flags (--security-checks)
+    bind their own TRIVY_* names like the reference's viper aliases."""
+    return ["TRIVY_" + o.lstrip("-").upper().replace("-", "_")
+            for o in action.option_strings if o.startswith("--")]
 
 
 def _explicit(action: argparse.Action, argv: list[str]) -> bool:
@@ -101,9 +109,12 @@ def _coerce(action: argparse.Action, raw: Any, origin: str) -> Any:
 
 
 def _config_lookup(doc: dict, action: argparse.Action):
-    """→ (found, value): dotted reference path first, then flat key."""
-    path = CONFIG_PATHS.get(action.dest)
-    if path:
+    """→ (found, value): dotted reference paths first, then flat
+    keys (one per long option, covering alias flags)."""
+    paths = CONFIG_PATHS.get(action.dest) or []
+    if isinstance(paths, str):
+        paths = [paths]
+    for path in paths:
         node: Any = doc
         for part in path.split("."):
             if not isinstance(node, dict) or part not in node:
@@ -112,11 +123,14 @@ def _config_lookup(doc: dict, action: argparse.Action):
             node = node[part]
         if node is not None:
             return True, node
-    flat = _flag_name(action)
-    # a mapping here is a config SECTION that happens to share the
-    # flag's name (e.g. `db:` vs --db), never a flag value
-    if flat in doc and not isinstance(doc[flat], dict):
-        return True, doc[flat]
+    for opt in action.option_strings:
+        if not opt.startswith("--"):
+            continue
+        flat = opt.lstrip("-")
+        # a mapping here is a config SECTION that happens to share the
+        # flag's name (e.g. `db:` vs --db), never a flag value
+        if flat in doc and not isinstance(doc[flat], dict):
+            return True, doc[flat]
     return False, None
 
 
@@ -143,19 +157,26 @@ def load_config_file(path: str, explicit: bool) -> Optional[dict]:
 def apply_flag_sources(args: argparse.Namespace,
                        parser: argparse.ArgumentParser,
                        argv: list[str], env=None) -> argparse.Namespace:
-    """Re-resolve every non-explicit flag: env, then config file."""
+    """Re-resolve every non-explicit flag: env, then config file.
+    Only the ACTIVE subcommand's actions are consulted — another
+    subparser's same-dest action must not overrule a flag the user
+    gave explicitly."""
     env = env if env is not None else os.environ
     cfg_path = getattr(args, "config", "") or "trivy.yaml"
     doc = load_config_file(cfg_path,
                            explicit=bool(getattr(args, "config", "")))
-    for action in _leaf_actions(parser):
+    seen_dests: set = set()
+    for action in _leaf_actions(parser, getattr(args, "command", None)):
+        if action.dest in seen_dests:
+            continue
+        seen_dests.add(action.dest)
         if action.dest in ("help", "command", "config") or \
                 not action.option_strings:
             continue
         if not hasattr(args, action.dest) or _explicit(action, argv):
             continue
-        ek = _env_key(action)
-        if ek in env:
+        ek = next((k for k in _env_keys(action) if k in env), None)
+        if ek is not None:
             setattr(args, action.dest,
                     _coerce(action, env[ek], f"${ek}"))
             continue
@@ -167,12 +188,15 @@ def apply_flag_sources(args: argparse.Namespace,
     return args
 
 
-def _leaf_actions(parser: argparse.ArgumentParser):
-    """All actions, including each subcommand's."""
+def _leaf_actions(parser: argparse.ArgumentParser,
+                  command: str | None = None):
+    """Top-level actions plus subcommand actions; when ``command`` is
+    given, only that subcommand's."""
     for action in parser._actions:
         if isinstance(action, argparse._SubParsersAction):
-            for sub in action.choices.values():
-                yield from sub._actions
+            for name, sub in action.choices.items():
+                if command is None or name == command:
+                    yield from sub._actions
         else:
             yield action
 
@@ -190,6 +214,8 @@ def generate_default_config(parser: argparse.ArgumentParser,
             continue
         seen.add(action.dest)
         path = CONFIG_PATHS.get(action.dest, _flag_name(action))
+        if isinstance(path, list):
+            path = path[0]  # canonical key only in generated config
         node = doc
         parts = path.split(".")
         for part in parts[:-1]:

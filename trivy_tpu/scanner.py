@@ -144,7 +144,8 @@ class LocalScanner:
         os_info = detail.os
 
         if T.Scanner.MISCONF in options.scanners or \
-                "config" in options.scanners:
+                "config" in options.scanners:  # raw "config" kept for
+            # callers bypassing cli.normalize_scanners (server RPC)
             for mc in detail.misconfigurations:
                 if not mc.failures and not mc.successes:
                     continue
