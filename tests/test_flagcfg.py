@@ -205,3 +205,36 @@ def test_legacy_security_checks_config_key(tmp_path):
                     cwd_config="scan:\n  security-checks:\n"
                                "    - secret\n")
     assert args.scanners == "secret"
+
+
+def test_file_patterns_route_to_analyzer(tmp_path, monkeypatch):
+    """--file-patterns "pip:custom-reqs" makes a non-standard filename
+    feed the pip analyzer (reference --file-patterns,
+    analyzer.go:508-515)."""
+    import test_golden as tg
+    proj = tmp_path / "p"
+    proj.mkdir()
+    (proj / "custom-reqs.txt").write_text("flask==2.2.2\n")
+    db = os.path.join(os.path.dirname(__file__), "fixtures", "db",
+                      "*.yaml")
+    got = tg.run_cli(["fs", proj.as_posix(), "--db", db,
+                      "--file-patterns", "pip:custom-reqs",
+                      "--format", "json",
+                      "--cache-dir", str(tmp_path / "c")], tmp_path)
+    cves = {v["VulnerabilityID"] for r in got.get("Results") or []
+            for v in r.get("Vulnerabilities") or []}
+    assert "CVE-2023-30861" in cves
+    # without the pattern the file is ignored
+    got2 = tg.run_cli(["fs", proj.as_posix(), "--db", db,
+                       "--format", "json",
+                       "--cache-dir", str(tmp_path / "c2")], tmp_path)
+    assert not [r for r in got2.get("Results") or []
+                if r.get("Vulnerabilities")]
+
+
+def test_file_patterns_invalid_errors(tmp_path):
+    from trivy_tpu.cli import main
+    with pytest.raises(SystemExit, match="file pattern"):
+        main(["fs", str(tmp_path), "--file-patterns", "no-colon",
+              "--db", "tests/golden/db/*.yaml",
+              "--cache-dir", str(tmp_path / "c")])

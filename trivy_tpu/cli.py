@@ -55,6 +55,9 @@ def _add_scan_flags(p: argparse.ArgumentParser):
                    help="helm value override key=value (repeatable)")
     p.add_argument("--helm-values", action="append", default=[],
                    help="helm values file override (repeatable)")
+    p.add_argument("--file-patterns", action="append", default=[],
+                   help='route files to an analyzer: "type:regex" '
+                        "(repeatable; reference --file-patterns)")
     p.add_argument("--skip-files", action="append", default=[],
                    help="glob of files to skip (repeatable)")
     p.add_argument("--skip-dirs", action="append", default=[],
@@ -624,9 +627,15 @@ def cmd_fs(args) -> int:
     try:
         sec_scanner, sec_cfg = _secret_scanner(args, scanners,
                                                root=target)
+        try:
+            group = AnalyzerGroup(
+                disabled=disabled, enabled=optin,
+                file_patterns=tuple(
+                    getattr(args, "file_patterns", ()) or ()))
+        except ValueError as e:  # bad "type:regex" spec
+            raise SystemExit(f"--file-patterns: {e}") from None
         art = FilesystemArtifact(target, cache, scanners=scanners,
-                                 group=AnalyzerGroup(disabled=disabled,
-                                                     enabled=optin),
+                                 group=group,
                                  secret_scanner=sec_scanner,
                                  secret_config_path=sec_cfg,
                                  parallel=getattr(args, "parallel", 1),

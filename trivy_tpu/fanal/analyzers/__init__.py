@@ -129,7 +129,8 @@ OPTIN_ANALYZERS = ("license-file",)
 
 
 class AnalyzerGroup:
-    def __init__(self, disabled: tuple = (), enabled: tuple = ()):
+    def __init__(self, disabled: tuple = (), enabled: tuple = (),
+                 file_patterns: tuple = ()):
         _ensure_loaded()
         off = set(disabled) | (set(OPTIN_ANALYZERS) - set(enabled))
         self.analyzers = [cls() for name, cls in sorted(_REGISTRY.items())
@@ -137,6 +138,25 @@ class AnalyzerGroup:
         self.post_analyzers = [
             cls() for name, cls in sorted(_POST_REGISTRY.items())
             if name not in off]
+        # --file-patterns "analyzer:regex": a matching path is routed
+        # to that analyzer even when its own required() declines
+        # (reference analyzer.go:321-341, filePatternMatch:508-515)
+        import re as _re
+        self._patterns: dict[str, list] = {}
+        for raw in file_patterns or ():
+            name, sep, pattern = str(raw).partition(":")
+            if not sep:
+                raise ValueError(
+                    f"invalid file pattern {raw!r} "
+                    '(expected "analyzerType:regex")')
+            self._patterns.setdefault(name, []).append(
+                _re.compile(pattern))
+
+    def _wants(self, a, path: str, size: int) -> bool:
+        if any(rx.search(path) for rx in
+               self._patterns.get(a.name, ())):
+            return True
+        return a.required(path, size)
 
     def versions(self) -> dict[str, int]:
         """name → version, for cache keys."""
@@ -147,16 +167,17 @@ class AnalyzerGroup:
         return out
 
     def required(self, path: str, size: int = -1) -> bool:
-        return any(a.required(path, size) for a in self.analyzers) or \
-            any(m.required(path) for m in _MODULE_ANALYZERS)
+        return any(self._wants(a, path, size) for a in self.analyzers) \
+            or any(m.required(path) for m in _MODULE_ANALYZERS)
 
     def post_required(self, path: str, size: int = -1) -> bool:
-        return any(a.required(path, size) for a in self.post_analyzers)
+        return any(self._wants(a, path, size)
+                   for a in self.post_analyzers)
 
     def analyze_file(self, path: str, content: bytes,
                      result: AnalysisResult) -> None:
         for a in self.analyzers:
-            if a.required(path, len(content)):
+            if self._wants(a, path, len(content)):
                 r = a.analyze(path, content)
                 if r is not None:
                     result.merge(r)
@@ -176,7 +197,8 @@ class AnalyzerGroup:
         if not files:
             return
         for a in self.post_analyzers:
-            subset = {p: c for p, c in files.items() if a.required(p)}
+            subset = {p: c for p, c in files.items()
+                      if self._wants(a, p, -1)}
             if subset:
                 r = a.post_analyze(subset)
                 if r is not None:
