@@ -388,7 +388,7 @@ def _save_device_artifact(payload: dict):
     os.replace(tmp, DEVICE_ARTIFACT)
 
 
-def _load_device_artifact(max_age_s: float = 12 * 3600):
+def _load_device_artifact(max_age_s: float = 24 * 3600):
     """Reject artifacts from another round (too old) or another
     workload definition — stale numbers are worse than none."""
     try:

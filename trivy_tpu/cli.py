@@ -520,8 +520,8 @@ def cmd_image(args) -> int:
         sec_scanner, sec_cfg = _secret_scanner(args, scanners)
         optin = ("license-file",) if getattr(args, "license_full",
                                              False) else ()
-        group = AnalyzerGroup(disabled=LOCKFILE_ANALYZERS,
-                              enabled=optin)
+        group = _analyzer_group(args, disabled=LOCKFILE_ANALYZERS,
+                                enabled=optin)
         if remote_stream:
             from .fanal.artifact import RegistryArtifact
             art = RegistryArtifact(
@@ -627,13 +627,7 @@ def cmd_fs(args) -> int:
     try:
         sec_scanner, sec_cfg = _secret_scanner(args, scanners,
                                                root=target)
-        try:
-            group = AnalyzerGroup(
-                disabled=disabled, enabled=optin,
-                file_patterns=tuple(
-                    getattr(args, "file_patterns", ()) or ()))
-        except ValueError as e:  # bad "type:regex" spec
-            raise SystemExit(f"--file-patterns: {e}") from None
+        group = _analyzer_group(args, disabled=disabled, enabled=optin)
         art = FilesystemArtifact(target, cache, scanners=scanners,
                                  group=group,
                                  secret_scanner=sec_scanner,
@@ -671,6 +665,19 @@ def _rel_globs(globs, root: str) -> tuple:
             rel = os.path.relpath(g_abs, root_abs).replace(os.sep, "/")
         out.append(rel)
     return tuple(out)
+
+
+def _analyzer_group(args, disabled=(), enabled=()):
+    """Build an AnalyzerGroup honoring --file-patterns on every target
+    kind (the reference binds the flag globally, run.go:648-692)."""
+    from .fanal.analyzers import AnalyzerGroup
+    try:
+        return AnalyzerGroup(
+            disabled=disabled, enabled=enabled,
+            file_patterns=tuple(
+                getattr(args, "file_patterns", ()) or ()))
+    except ValueError as e:  # bad "type:regex" spec
+        raise SystemExit(f"--file-patterns: {e}") from None
 
 
 def _secret_scanner(args, scanners, root: str = ""):
@@ -715,8 +722,9 @@ def cmd_vm(args) -> int:
         args.target, cache, scanners=scanners,
         # VM scans disable lockfile analyzers like image/rootfs scans
         # (reference run.go:252 ScanVM)
-        group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS + ("sbom",),
-                            enabled=optin),
+        group=_analyzer_group(args,
+                              disabled=LOCKFILE_ANALYZERS + ("sbom",),
+                              enabled=optin),
         secret_scanner=sec_scanner, secret_config_path=sec_cfg)
     ref = art.inspect()
     return _scan_common(args, ref, cache, T.ArtifactType.VM)
@@ -791,7 +799,9 @@ def cmd_k8s(args) -> int:
                 scanners=[s for s in scanners if s != "misconfig"],
                 list_all_packages=args.list_all_pkgs,
                 secret_scanner=sec_scanner,
-                secret_config_path=_sec_cfg)
+                secret_config_path=_sec_cfg,
+                file_patterns=tuple(
+                    getattr(args, "file_patterns", ()) or ()))
         if args.compliance:
             from .compliance import (build_compliance_report, get_spec,
                                      write_compliance)

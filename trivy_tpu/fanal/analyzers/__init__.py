@@ -149,8 +149,13 @@ class AnalyzerGroup:
                 raise ValueError(
                     f"invalid file pattern {raw!r} "
                     '(expected "analyzerType:regex")')
-            self._patterns.setdefault(name, []).append(
-                _re.compile(pattern))
+            try:
+                rx = _re.compile(pattern)
+            except _re.error as e:
+                raise ValueError(
+                    f"invalid file pattern regex {pattern!r}: {e}") \
+                    from e
+            self._patterns.setdefault(name, []).append(rx)
 
     def _wants(self, a, path: str, size: int) -> bool:
         if any(rx.search(path) for rx in

@@ -67,6 +67,21 @@ class CloudResource:
         return a is not None and isinstance(a.value, Unknown)
 
 
+def sub_blocks(block, btype):
+    """Nested blocks of `btype` inside an HCL block body."""
+    return [b for b in block.body.blocks if b.type == btype]
+
+
+def block_attr(module, block, key, default=None):
+    """Evaluate one attribute of a nested block → (value, range).
+    Unknown values pass through untouched so checks can treat them the
+    way the reference's rego treats undefined — never firing."""
+    attrs = module.eval_block_attrs(block)
+    if key in attrs:
+        return attrs[key]
+    return default, (block.start, block.end)
+
+
 AWS_CHECKS: list[Check] = []
 
 

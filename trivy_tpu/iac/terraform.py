@@ -343,32 +343,51 @@ def adapt_terraform(module: TfModule) -> list[CloudResource]:
     return out
 
 
+def _tf_providers():
+    """Provider registry: (adapter, check list) pairs.  Each adapter
+    yields CloudResources only for its own resource-type prefixes, so a
+    provider's checks run (and count successes) only when the module
+    actually uses that provider — absent state passes trivially, the
+    way the reference's rego sees empty input documents."""
+    from .gcp import GCP_CHECKS, adapt_google
+    from .providers_extra import EXTRA_CHECKS, adapt_extra
+    return [(adapt_terraform, AWS_CHECKS),
+            (adapt_google, GCP_CHECKS),
+            (adapt_extra, EXTRA_CHECKS)]
+
+
 def scan_terraform_module(files: dict[str, str]
                           ) -> dict[str, tuple[list, int]]:
     """files: path → text (one module).  → per-file (failures,
     successes); module-wide passes are attributed to the first file."""
     module = TfModule(files)
-    resources = adapt_terraform(module)
-    if not resources:
+    provider_work = []
+    for adapt, checks in _tf_providers():
+        resources = adapt(module)
+        if resources:
+            provider_work.append((resources, checks))
+    if not provider_work:
         return {}
     ignores = {path: ignored_ids_by_line(text)
                for path, text in files.items()}
     lines = {path: text.splitlines() for path, text in files.items()}
     by_file: dict[str, list] = {}
     successes = 0
-    for check in AWS_CHECKS:
-        found = []
-        for r in resources:
-            for msg, rng in check.fn([r]):
-                if is_ignored(ignores.get(r.path, {}), check, rng[0]):
-                    continue
-                found.append((r.path, msg, rng))
-        if not found:
-            successes += 1
-            continue
-        for path, msg, rng in found:
-            by_file.setdefault(path, []).append(build_misconf(
-                check, "terraform", msg, rng, lines.get(path, [])))
+    for resources, checks in provider_work:
+        for check in checks:
+            found = []
+            for r in resources:
+                for msg, rng in check.fn([r]):
+                    if is_ignored(ignores.get(r.path, {}), check,
+                                  rng[0]):
+                        continue
+                    found.append((r.path, msg, rng))
+            if not found:
+                successes += 1
+                continue
+            for path, msg, rng in found:
+                by_file.setdefault(path, []).append(build_misconf(
+                    check, "terraform", msg, rng, lines.get(path, [])))
     out = {}
     tf_paths = sorted(p for p in files if p.endswith((".tf",
                                                       ".tf.json")))

@@ -108,7 +108,8 @@ def scan_cluster_vulns(client: KubeClient, cache, table,
                        scanners: tuple = ("vuln",), now=None,
                        list_all_packages: bool = False,
                        secret_scanner=None,
-                       secret_config_path: str = "trivy-secret.yaml"
+                       secret_config_path: str = "trivy-secret.yaml",
+                       file_patterns: tuple = ()
                        ) -> list[T.Result]:
     """Workload-image vulnerability scanning (reference
     pkg/k8s/scanner/scanner.go:104-121,163-175).
@@ -150,7 +151,8 @@ def scan_cluster_vulns(client: KubeClient, cache, table,
             pull(img, tmp.name)
             art = ImageArchiveArtifact(
                 tmp.name, cache, scanners=scanners,
-                group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS),
+                group=AnalyzerGroup(disabled=LOCKFILE_ANALYZERS,
+                                    file_patterns=file_patterns),
                 secret_scanner=secret_scanner,
                 secret_config_path=secret_config_path)
             refs[img] = art.inspect()
