@@ -157,7 +157,7 @@ def split_timings(detector, images):
     out = detector._dispatch(prep)
     jax.block_until_ready(out)
     t2 = time.perf_counter()
-    detector._assemble(prep, np.asarray(out))
+    detector._assemble(prep, jax.device_get(out))
     t3 = time.perf_counter()
     return t1 - t0, t2 - t1, t3 - t2, prep.n_pairs
 
@@ -284,8 +284,17 @@ def device_child_main():
     table, detector, images = build_workload()
     build_s = time.time() - t0
 
-    # warmup/compile at the batched shapes used in the timed run
-    run_device(detector, images[:BATCH_IMAGES])
+    # warmup/compile over the FULL image set: batches land in different
+    # pow2 pair-capacity buckets, and each distinct bucket is its own
+    # XLA compilation — a serve-many deployment compiles each once, so
+    # the timed pass measures the warm path, not the compiler
+    run_device(detector, images)
+    # the table's ~1M advisory/interval objects are immutable from here
+    # on; freeze them out of the collector so gen2 passes triggered by
+    # per-batch Hit allocation don't stall a timed batch (~400ms each)
+    import gc
+    gc.collect()
+    gc.freeze()
 
     t1 = time.time()
     dev_hits = run_device(detector, images)

@@ -793,6 +793,10 @@ def cmd_k8s(args) -> int:
             table = _load_table_args(args) if "vuln" in scanners \
                 else build_table([])
             sec_scanner, _sec_cfg = _secret_scanner(args, scanners)
+            # validate --file-patterns up front: failing inside
+            # scan_cluster_vulns would waste the image pulls already
+            # made and surface as a raw ValueError
+            _analyzer_group(args)
             results += scan_cluster_vulns(
                 client, MemoryCache(), table,
                 namespace=args.namespace or cfg.namespace,

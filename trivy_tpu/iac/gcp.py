@@ -187,13 +187,19 @@ def _adapt_instance(module, res, cr):
     cr.attrs["vtpm"] = Attr(vtpm, sh_rng)
     md = res.value("metadata")
     md = md if isinstance(md, dict) else {}
-    cr.attrs["oslogin"] = Attr(
-        _known_true(md["enable-oslogin"]) if "enable-oslogin" in md
-        else True, res.rng("metadata"))
+
+    def _md_bool(key, absent):
+        v = md.get(key)
+        if isinstance(v, Unknown):
+            return v          # unresolvable: checks must not fire
+        return _known_true(v) if key in md else absent
+
+    cr.attrs["oslogin"] = Attr(_md_bool("enable-oslogin", True),
+                               res.rng("metadata"))
     cr.attrs["block_project_ssh_keys"] = Attr(
-        _known_true(md.get("block-project-ssh-keys")), res.rng("metadata"))
+        _md_bool("block-project-ssh-keys", False), res.rng("metadata"))
     cr.attrs["serial_port"] = Attr(
-        _known_true(md.get("serial-port-enable")), res.rng("metadata"))
+        _md_bool("serial-port-enable", False), res.rng("metadata"))
     # service account: empty email or *-compute@developer... is default
     sa_default, sa_email, sa_rng = None, "", cr.rng
     for b in res.blocks("service_account"):

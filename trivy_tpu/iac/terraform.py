@@ -121,9 +121,20 @@ class TfModule:
         return Scope(variables=self.variables, locals_=self.locals)
 
     def eval_block_attrs(self, block: Block):
+        """Evaluate a nested block's attributes, memoized per block:
+        adapters fetch several keys from the same block and variables/
+        locals are fixed after _load, so one evaluation suffices."""
+        cache = getattr(self, "_block_attr_cache", None)
+        if cache is None:
+            cache = self._block_attr_cache = {}
+        hit = cache.get(id(block))
+        if hit is not None:
+            return hit
         scope = self._scope()
-        return {a.name: (evaluate(a.expr, scope), (a.start, a.end))
-                for a in block.body.attrs}
+        out = {a.name: (evaluate(a.expr, scope), (a.start, a.end))
+               for a in block.body.attrs}
+        cache[id(block)] = out
+        return out
 
 
 def _same(a, b):

@@ -82,3 +82,41 @@ def _pair_core(adv_lo_tok, adv_hi_tok, adv_flags,
 
 
 pair_join = jax.jit(_pair_core)
+
+
+def _csr_core(adv_lo_tok, adv_hi_tok, adv_flags, ver_tok,
+              q_start, q_count, q_ver, total, t_pad: int):
+    """CSR variant: expand (bucket start, count, version) per QUERY into
+    the flat pair list on device, then run the interval predicate.
+
+    The host's expansion (np.repeat in detect.engine._prepare) stays for
+    hit assembly, but shipping it is ~T_pad*9 bytes per batch — an order
+    of magnitude more transfer than the [Q] descriptors, and transfer is
+    the scan bottleneck on a tunneled chip.  Expansion here is two O(T)
+    primitives: scatter segment marks at each query's end offset, then
+    cumsum to recover the owning query per pair slot.
+
+    q_start: int32[Q] first advisory row of each query's bucket
+    q_count: int32[Q] bucket length (>0; empty queries pre-filtered)
+    q_ver:   int32[Q] ver_tok row per query
+    total:   int32[]  true pair count (= sum q_count, <= t_pad)
+    t_pad:   static pair capacity (power of two)
+    """
+    q_n = q_count.shape[0]
+    offsets = jnp.cumsum(q_count)                      # inclusive ends
+    idx = jnp.arange(t_pad, dtype=jnp.int32)
+    # owning query per pair slot: binary search over the offsets —
+    # compiles to a log2(Q)-step vectorized gather loop, far cheaper to
+    # build and run than a scatter/cumsum segment expansion
+    seg = jnp.minimum(
+        jnp.searchsorted(offsets, idx, side="right"), q_n - 1)
+    within = idx - (offsets - q_count)[seg]
+    n_rows = adv_flags.shape[0]
+    pair_row = jnp.clip(q_start[seg] + within, 0, n_rows - 1)
+    pair_ver = q_ver[seg]
+    pair_valid = idx < total
+    return _pair_core(adv_lo_tok, adv_hi_tok, adv_flags, ver_tok,
+                      pair_row, pair_ver, pair_valid)
+
+
+csr_pair_join = jax.jit(_csr_core, static_argnums=(8,))

@@ -169,7 +169,7 @@ def sharded_pair_join(mesh: Mesh, st, ver_tok, part: PairPartition,
     """Run the pair join across the mesh; → int8[n_pairs] report bits in
     the caller's original pair order. `st` arrays and `ver_tok` may be
     host numpy or already-uploaded device arrays."""
-    bits = np.asarray(_sharded_pair_join(
+    bits = jax.device_get(_sharded_pair_join(
         mesh, jnp.asarray(st.lo_tok), jnp.asarray(st.hi_tok),
         jnp.asarray(st.flags), jnp.asarray(ver_tok),
         jax.device_put(part.pair_row), jax.device_put(part.pair_ver),

@@ -129,8 +129,9 @@ class SecretScanner:
                 futures.append(ac.prefix_scan(
                     kw_word4, kw_mask4, jax.device_put(piece),
                     n_words=bank.words))
-        masks = np.concatenate([np.asarray(f) for f in futures],
-                               axis=0)[:chunks.shape[0]]
+        masks = np.concatenate(
+            [jax.device_get(f) for f in futures],
+            axis=0)[:chunks.shape[0]]
         # confirm the (rare) device candidates exactly: the device tests
         # only the packed 4-byte keyword prefix, so confirm the full
         # keyword in the chunk's (lowercased, overlap-including) bytes

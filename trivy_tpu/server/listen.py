@@ -51,6 +51,16 @@ class ServerState:
         """DB hot swap (reference listen.go dbWorker)."""
         with self._lock:
             self._scanner = LocalScanner(self.cache, table)
+        # the swapped-in table's object graph (~1M small objects for a
+        # full trivy-db) is immutable; freezing it out of the cyclic
+        # collector keeps gen2 passes from stalling in-flight scans.
+        # unfreeze first: the PREVIOUS swap's frozen set (old table,
+        # old request state) must rejoin the collector or every swap
+        # would leak one table's worth of uncollectable objects
+        import gc
+        gc.unfreeze()
+        gc.collect()
+        gc.freeze()
 
 
 def _result_to_json(res: T.Result) -> dict:
