@@ -1,0 +1,153 @@
+"""containerd on-disk store image source.
+
+Mirrors the reference's containerd daemon tests
+(pkg/fanal/image/daemon/containerd_test.go) at the store level: a
+fabricated containerd root (bolt metadata DB + content-addressed
+blobs) is resolved and scanned through the shared image stack."""
+
+import gzip
+import hashlib
+import json
+import os
+
+import pytest
+
+from bolt_writer import write_bolt
+from helpers import ALPINE_OS_RELEASE, APK_INSTALLED, make_layer
+from trivy_tpu.fanal.cache import MemoryCache
+from trivy_tpu.fanal.containerd import (ContainerdArtifact,
+                                        ContainerdError,
+                                        ContainerdStore, name_candidates)
+
+
+def _digest(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def _write_blob(root: str, data: bytes) -> str:
+    d = _digest(data)
+    blob_dir = os.path.join(root, "io.containerd.content.v1.content",
+                            "blobs", "sha256")
+    os.makedirs(blob_dir, exist_ok=True)
+    with open(os.path.join(blob_dir, d.split(":", 1)[1]), "wb") as f:
+        f.write(data)
+    return d
+
+
+def _make_store(tmp_path, image_name="docker.io/library/alpine:3.17",
+                index=False):
+    root = str(tmp_path / "containerd")
+    layer = make_layer({
+        "etc/os-release": ALPINE_OS_RELEASE,
+        "lib/apk/db/installed": APK_INSTALLED,
+    })
+    layer_gz = gzip.compress(layer)
+    layer_digest = _write_blob(root, layer_gz)
+    diff_id = _digest(layer)
+    config = json.dumps({
+        "architecture": "amd64", "os": "linux",
+        "rootfs": {"type": "layers", "diff_ids": [diff_id]},
+        "history": [{"created_by": "ADD rootfs.tar /"}],
+    }).encode()
+    config_digest = _write_blob(root, config)
+    manifest = json.dumps({
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+        "config": {"mediaType":
+                   "application/vnd.oci.image.config.v1+json",
+                   "digest": config_digest, "size": len(config)},
+        "layers": [{"mediaType":
+                    "application/vnd.oci.image.layer.v1.tar+gzip",
+                    "digest": layer_digest, "size": len(layer_gz)}],
+    }).encode()
+    manifest_digest = _write_blob(root, manifest)
+    target = manifest_digest
+    if index:
+        idx = json.dumps({
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.oci.image.index.v1+json",
+            "manifests": [
+                {"mediaType":
+                 "application/vnd.oci.image.manifest.v1+json",
+                 "digest": manifest_digest, "size": len(manifest),
+                 "platform": {"os": "linux",
+                              "architecture": "amd64"}},
+            ],
+        }).encode()
+        target = _write_blob(root, idx)
+    meta_dir = os.path.join(root, "io.containerd.metadata.v1.bolt")
+    os.makedirs(meta_dir, exist_ok=True)
+    write_bolt(os.path.join(meta_dir, "meta.db"), {
+        "v1": {"default": {"image": {image_name: {"target": {
+            "digest": target,
+            "mediatype": "application/vnd.oci.image.manifest.v1+json",
+        }}}}},
+    })
+    return ContainerdStore(root=root, namespace="default")
+
+
+def test_name_candidates():
+    assert name_candidates("alpine") == [
+        "docker.io/library/alpine:latest", "alpine:latest"]
+    assert name_candidates("alpine:3.17") == [
+        "docker.io/library/alpine:3.17", "alpine:3.17"]
+    assert name_candidates("myorg/app:1") == [
+        "docker.io/myorg/app:1", "myorg/app:1"]
+    assert name_candidates("ghcr.io/a/b:1") == ["ghcr.io/a/b:1"]
+    assert name_candidates("localhost:5000/x") == [
+        "localhost:5000/x:latest"]
+    # explicit docker.io single-component refs get library/ expansion
+    assert name_candidates("docker.io/alpine:3.17") == [
+        "docker.io/library/alpine:3.17", "docker.io/alpine:3.17"]
+
+
+def test_resolve_familiar_name(tmp_path):
+    store = _make_store(tmp_path)
+    name, digest = store.resolve("alpine:3.17")
+    assert name == "docker.io/library/alpine:3.17"
+    assert digest.startswith("sha256:")
+
+
+def test_resolve_missing_image(tmp_path):
+    store = _make_store(tmp_path)
+    with pytest.raises(ContainerdError, match="not found"):
+        store.resolve("debian:12")
+
+
+def test_unavailable_store(tmp_path):
+    store = ContainerdStore(root=str(tmp_path / "nope"))
+    assert not store.available()
+    with pytest.raises(ContainerdError, match="no containerd store"):
+        store.resolve("alpine")
+
+
+def _scan(store):
+    art = ContainerdArtifact("alpine:3.17", MemoryCache(),
+                             scanners=("vuln",), store=store)
+    ref = art.inspect()
+    blob = art.cache.get_blob(ref.blob_ids[0])
+    return ref, blob
+
+
+def test_inspect_produces_packages(tmp_path):
+    store = _make_store(tmp_path)
+    ref, blob = _scan(store)
+    assert ref.image_metadata.repo_tags == \
+        ["docker.io/library/alpine:3.17"]
+    assert blob.os.family == "alpine"
+    names = {p.name for p in blob.package_infos[0].packages}
+    assert "musl" in names
+
+
+def test_inspect_platform_index(tmp_path):
+    store = _make_store(tmp_path, index=True)
+    ref, blob = _scan(store)
+    assert blob.os.family == "alpine"
+
+
+def test_cli_source_chain_falls_through(tmp_path, monkeypatch):
+    """containerd source missing → error recorded, chain continues."""
+    monkeypatch.setenv("CONTAINERD_ROOT", str(tmp_path / "absent"))
+    from trivy_tpu.fanal.containerd import ContainerdStore as CS
+    store = CS()
+    assert not store.available()

@@ -1,0 +1,151 @@
+"""GCR / ACR registry auth helpers (reference
+pkg/fanal/image/registry/{google,azure}) against fake token servers."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.oci import acr_credentials, gcr_credentials
+
+
+class _TokenServer:
+    """Records form POSTs; answers each path with a canned JSON doc."""
+
+    def __init__(self, routes: dict):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length).decode()
+                outer.posts.append((self.path, body))
+                doc = None
+                for prefix, payload in routes.items():
+                    if self.path.startswith(prefix):
+                        doc = payload
+                        break
+                if doc is None:
+                    self.send_error(404)
+                    return
+                data = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.posts = []
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._srv.server_port}"
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self._srv.shutdown()
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for var in ("CLOUDSDK_AUTH_ACCESS_TOKEN", "GOOGLE_OAUTH_ACCESS_TOKEN",
+                "GOOGLE_APPLICATION_CREDENTIALS", "AZURE_TENANT_ID",
+                "AZURE_CLIENT_ID", "AZURE_CLIENT_SECRET",
+                "AZURE_ACCESS_TOKEN"):
+        monkeypatch.delenv(var, raising=False)
+    # no metadata-server fallback in tests
+    monkeypatch.setenv("TRIVY_TPU_GCE_METADATA",
+                       "http://127.0.0.1:1/unreachable")
+    return monkeypatch
+
+
+def test_gcr_ignores_foreign_hosts(clean_env):
+    assert gcr_credentials("registry-1.docker.io") is None
+    assert gcr_credentials("example.com") is None
+
+
+def test_gcr_env_token(clean_env):
+    clean_env.setenv("GOOGLE_OAUTH_ACCESS_TOKEN", "tok123")
+    assert gcr_credentials("gcr.io") == ("oauth2accesstoken", "tok123")
+    assert gcr_credentials("eu.gcr.io") == ("oauth2accesstoken",
+                                            "tok123")
+    assert gcr_credentials("us-docker.pkg.dev") == \
+        ("oauth2accesstoken", "tok123")
+
+
+def test_gcr_adc_refresh_flow(clean_env, tmp_path):
+    srv = _TokenServer({"/": {"access_token": "adc-token",
+                              "expires_in": 3599}})
+    try:
+        adc = tmp_path / "adc.json"
+        adc.write_text(json.dumps({
+            "type": "authorized_user",
+            "client_id": "cid", "client_secret": "cs",
+            "refresh_token": "rt",
+        }))
+        clean_env.setenv("GOOGLE_APPLICATION_CREDENTIALS", str(adc))
+        clean_env.setenv("TRIVY_TPU_GOOGLE_TOKEN_URL", srv.url)
+        assert gcr_credentials("gcr.io") == ("oauth2accesstoken",
+                                             "adc-token")
+        path, body = srv.posts[0]
+        assert "grant_type=refresh_token" in body
+        assert "refresh_token=rt" in body
+    finally:
+        srv.close()
+
+
+def test_gcr_service_account_key_unsupported(clean_env, tmp_path):
+    """service_account keys need RS256 signing — must not crash, just
+    fall through to None (metadata server unreachable here)."""
+    adc = tmp_path / "sa.json"
+    adc.write_text(json.dumps({"type": "service_account",
+                               "private_key": "-----BEGIN..."}))
+    clean_env.setenv("GOOGLE_APPLICATION_CREDENTIALS", str(adc))
+    assert gcr_credentials("gcr.io") is None
+
+
+def test_acr_client_credentials_exchange(clean_env):
+    srv = _TokenServer({
+        "/tenant1/oauth2/v2.0/token": {"access_token": "aad-tok"},
+        "/oauth2/exchange": {"refresh_token": "acr-refresh"},
+    })
+    try:
+        clean_env.setenv("AZURE_TENANT_ID", "tenant1")
+        clean_env.setenv("AZURE_CLIENT_ID", "client")
+        clean_env.setenv("AZURE_CLIENT_SECRET", "secret")
+        clean_env.setenv("TRIVY_TPU_AZURE_LOGIN_ENDPOINT", srv.url)
+        clean_env.setenv("TRIVY_TPU_ACR_EXCHANGE_ENDPOINT", srv.url)
+        creds = acr_credentials("myreg.azurecr.io")
+        assert creds == ("00000000-0000-0000-0000-000000000000",
+                         "acr-refresh")
+        # the AAD token from step 1 is exchanged in step 2
+        assert "client_credentials" in srv.posts[0][1]
+        assert "access_token=aad-tok" in srv.posts[1][1]
+        assert "service=myreg.azurecr.io" in srv.posts[1][1]
+    finally:
+        srv.close()
+
+
+def test_acr_direct_access_token(clean_env):
+    srv = _TokenServer({
+        "/oauth2/exchange": {"refresh_token": "acr-refresh2"},
+    })
+    try:
+        clean_env.setenv("AZURE_TENANT_ID", "tenant1")
+        clean_env.setenv("AZURE_ACCESS_TOKEN", "direct-aad")
+        clean_env.setenv("TRIVY_TPU_ACR_EXCHANGE_ENDPOINT", srv.url)
+        creds = acr_credentials("myreg.azurecr.io")
+        assert creds[1] == "acr-refresh2"
+        assert "access_token=direct-aad" in srv.posts[0][1]
+    finally:
+        srv.close()
+
+
+def test_acr_requires_tenant_and_creds(clean_env):
+    assert acr_credentials("myreg.azurecr.io") is None
+    clean_env.setenv("AZURE_TENANT_ID", "tenant1")
+    assert acr_credentials("myreg.azurecr.io") is None
+    assert acr_credentials("registry-1.docker.io") is None

@@ -456,28 +456,48 @@ def cmd_image(args) -> int:
     input_path = args.input
     tmp = None
     remote_stream = False
+    containerd_store = None
     if not input_path:
         if not args.image_name:
             raise SystemExit("image name or --input <archive> required")
-        # image source fallback chain (reference image.go:42-56):
-        # docker/podman daemon sockets first, then the registry.
-        # Daemons export a docker-save tarball; the registry source
-        # STREAMS layers (RegistryArtifact) with no temp file.
+        # image source fallback chain (reference image.go:42-56,
+        # default order types/image.go:22 AllImageSources):
+        # docker/podman daemon sockets export a docker-save tarball;
+        # containerd is read from the daemon's on-disk store; the
+        # registry source STREAMS layers (RegistryArtifact).
         import tempfile
         from .log import logger
         sources = [s.strip() for s in
                    getattr(args, "image_src",
-                           "docker,podman,remote").split(",") if s.strip()]
+                           "docker,containerd,podman,remote"
+                           ).split(",") if s.strip()]
         unknown = [s for s in sources
-                   if s not in ("docker", "podman", "remote")]
+                   if s not in ("docker", "containerd", "podman",
+                                "remote")]
         if unknown or not sources:
             raise SystemExit(
                 f"unknown --image-src {','.join(unknown or ['(empty)'])!r}"
-                " (valid: docker, podman, remote)")
+                " (valid: docker, containerd, podman, remote)")
         got = ""
+        containerd_target = None
         errors = []
         for src in sources:  # strictly in the user's order
-            if src in ("docker", "podman"):
+            if src == "containerd":
+                from .fanal.containerd import (ContainerdError,
+                                               ContainerdStore)
+                store = ContainerdStore()
+                try:
+                    if not store.available():
+                        raise ContainerdError(
+                            f"no containerd store at {store.root}")
+                    # keep the resolution: the artifact reuses it
+                    # instead of re-walking meta.db
+                    containerd_target = store.resolve(args.image_name)
+                    containerd_store = store
+                    got = src
+                except ContainerdError as e:
+                    errors.append(f"containerd: {e}")
+            elif src in ("docker", "podman"):
                 from .fanal.daemon import (DaemonError,
                                            save_from_any_daemon)
                 tmp = tempfile.NamedTemporaryFile(suffix=".tar",
@@ -530,6 +550,14 @@ def cmd_image(args) -> int:
                 platform=getattr(args, "platform", "") or "linux/amd64",
                 client=remote_client)
             art._manifest = remote_manifest
+        elif containerd_store is not None:
+            from .fanal.containerd import ContainerdArtifact
+            art = ContainerdArtifact(
+                args.image_name, cache, scanners=scanners, group=group,
+                secret_scanner=sec_scanner, secret_config_path=sec_cfg,
+                platform=getattr(args, "platform", "") or "linux/amd64",
+                store=containerd_store)
+            art._target = containerd_target
         else:
             art = ImageArchiveArtifact(
                 input_path, cache, scanners=scanners, group=group,

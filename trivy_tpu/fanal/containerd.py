@@ -1,0 +1,251 @@
+"""containerd image source: the daemon's on-disk store, read directly.
+
+Reference counterpart: pkg/fanal/image/daemon/containerd.go, which
+dials the containerd gRPC socket and asks the daemon to export an OCI
+archive.  gRPC-over-HTTP/2 has no stdlib client, so this build reads
+the same data the daemon would serve from its content-addressed store:
+
+  <root>/io.containerd.metadata.v1.bolt/meta.db
+      bolt DB; images live at v1/<namespace>/image/<name>/target
+      ({digest, mediatype, size}) — resolved with the same BoltDB
+      reader that parses trivy-db (trivy_tpu/db/boltdb.py)
+  <root>/io.containerd.content.v1.content/blobs/<alg>/<hex>
+      manifest/config/layer blobs, content-addressed
+
+Layers feed the shared image mixin (fanal/artifact.py) without an
+intermediate tarball, like the streaming registry source.  Name
+resolution follows containerd's stored form (fully-qualified
+docker.io/library/... references), trying the familiar-name expansions
+the reference's reference/docker package applies.  Namespace defaults
+to "default" and honors $CONTAINERD_NAMESPACE; the store root honors
+$CONTAINERD_ROOT (the daemon's --root, default /var/lib/containerd).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import tarfile
+
+from .. import types as T
+from ..db.boltdb import BoltDB, BoltError
+from .artifact import ArtifactReference, _ImageInspectMixin
+
+DEFAULT_ROOT = "/var/lib/containerd"
+
+_INDEX_TYPES = (
+    "application/vnd.oci.image.index.v1+json",
+    "application/vnd.docker.distribution.manifest.list.v2+json",
+)
+
+
+class ContainerdError(RuntimeError):
+    pass
+
+
+def name_candidates(image: str) -> list[str]:
+    """Familiar-name expansions, most-qualified first (containerd
+    stores fully-qualified references)."""
+    ref = image
+    # split off a digest suffix untouched; add :latest if untagged
+    base = ref.split("@", 1)[0]
+    tail = ref[len(base):]
+    host = base.split("/", 1)[0]
+    # the first path component is a registry host only when a path
+    # follows it (a lone "name:tag" has no host; the ":" is the tag)
+    has_host = "/" in base and ("." in host or ":" in host
+                                or host == "localhost")
+    if ":" not in base.rsplit("/", 1)[-1] and not tail:
+        base += ":latest"
+    out = [base + tail]
+    if not has_host:
+        if "/" not in base:
+            out.insert(0, f"docker.io/library/{base}{tail}")
+        else:
+            out.insert(0, f"docker.io/{base}{tail}")
+    elif base.startswith("docker.io/") and \
+            "/" not in base[len("docker.io/"):]:
+        # explicit docker.io/<name> is stored as docker.io/library/<name>
+        out.insert(0, "docker.io/library/" + base[len("docker.io/"):]
+                   + tail)
+    return list(dict.fromkeys(out))
+
+
+class ContainerdStore:
+    """Read-only view of a containerd root directory."""
+
+    def __init__(self, root: str = "", namespace: str = ""):
+        env = os.environ
+        self.root = root or env.get("CONTAINERD_ROOT", DEFAULT_ROOT)
+        self.namespace = namespace or env.get("CONTAINERD_NAMESPACE",
+                                              "default")
+        self.meta_path = os.path.join(
+            self.root, "io.containerd.metadata.v1.bolt", "meta.db")
+        self.blob_root = os.path.join(
+            self.root, "io.containerd.content.v1.content", "blobs")
+
+    def available(self) -> bool:
+        return os.path.exists(self.meta_path)
+
+    # ---- metadata ----------------------------------------------------
+
+    def _descend(self, db: BoltDB, path: list[bytes]):
+        """Navigate nested buckets; → bucket value or None."""
+        entries = db.buckets()
+        val = None
+        for want in path:
+            found = None
+            for key, v, *rest in entries:
+                is_bucket = rest[0] if rest else True
+                if key == want and is_bucket:
+                    found = v
+                    break
+            if found is None:
+                return None
+            val = found
+            entries = db.walk_bucket(val)
+        return val
+
+    def resolve(self, image: str) -> tuple[str, str]:
+        """image name → (stored name, target manifest digest)."""
+        if not self.available():
+            raise ContainerdError(
+                f"no containerd store at {self.root}")
+        try:
+            with BoltDB(self.meta_path) as db:
+                for cand in name_candidates(image):
+                    # schema: v1/<ns>/image/<name> bucket with a
+                    # target sub-bucket {digest, mediatype, size}
+                    for img_bucket in (b"image", b"images"):
+                        val = self._descend(db, [
+                            b"v1", self.namespace.encode(), img_bucket,
+                            cand.encode(), b"target"])
+                        if val is None:
+                            continue
+                        for key, v, is_b in db.walk_bucket(val):
+                            if key == b"digest" and not is_b:
+                                return cand, v.decode()
+        except BoltError as e:
+            raise ContainerdError(
+                f"containerd metadata unreadable: {e}") from None
+        raise ContainerdError(
+            f"image {image!r} not found in containerd namespace "
+            f"{self.namespace!r}")
+
+    # ---- content -----------------------------------------------------
+
+    def blob_path(self, digest: str) -> str:
+        alg, _, hexd = digest.partition(":")
+        p = os.path.join(self.blob_root, alg, hexd)
+        if not os.path.exists(p):
+            raise ContainerdError(f"blob {digest} missing from store")
+        return p
+
+    def read_json(self, digest: str) -> dict:
+        with open(self.blob_path(digest), "rb") as f:
+            return json.load(f)
+
+
+def _select_platform(entries: list[dict], platform: str) -> dict:
+    want_os, _, want_arch = platform.partition("/")
+    for e in entries:
+        p = e.get("platform") or {}
+        if p.get("os") == want_os and \
+                p.get("architecture") == want_arch:
+            return e
+    # a silent wrong-platform fallback would report another arch's
+    # vulnerabilities (same contract as oci.RegistryClient)
+    raise ContainerdError(f"no manifest for platform {platform}")
+
+
+class ContainerdArtifact(_ImageInspectMixin):
+    """Image artifact backed by a containerd content store."""
+
+    def __init__(self, image: str, cache, group=None,
+                 scanners: tuple = ("vuln",), secret_scanner=None,
+                 secret_config_path: str = "trivy-secret.yaml",
+                 platform: str = "linux/amd64",
+                 store: ContainerdStore | None = None):
+        from .analyzers import AnalyzerGroup
+        self.image = image
+        self.store = store or ContainerdStore()
+        self.platform = platform or "linux/amd64"
+        self.cache = cache
+        self.group = group or AnalyzerGroup()
+        self.scanners = scanners
+        self.secret_scanner = secret_scanner
+        self.secret_config_path = secret_config_path
+        if "secret" in scanners and secret_scanner is None:
+            from ..secret import SecretScanner
+            self.secret_scanner = SecretScanner()
+        self._resolved = None
+        self._target = None   # (stored name, digest), pre-seedable
+
+    def image_digest(self) -> str:
+        """Config digest — what cosign attestations key on (same
+        contract as RegistryArtifact.image_digest)."""
+        return self.manifest()[1]["config"]["digest"]
+
+    def manifest(self) -> tuple[str, dict]:
+        """→ (stored name, platform manifest)."""
+        if self._resolved is None:
+            name, digest = self._target or \
+                self.store.resolve(self.image)
+            man = self.store.read_json(digest)
+            if man.get("mediaType") in _INDEX_TYPES or \
+                    "manifests" in man and "layers" not in man:
+                entry = _select_platform(man.get("manifests", []),
+                                         self.platform)
+                man = self.store.read_json(entry["digest"])
+            self._resolved = (name, man)
+        return self._resolved
+
+    def inspect(self) -> ArtifactReference:
+        import contextlib
+
+        name, man = self.manifest()
+        config = self.store.read_json(man["config"]["digest"])
+        diff_ids = config.get("rootfs", {}).get("diff_ids", [])
+        layers = man.get("layers", [])
+        created_by = self._created_by(config, diff_ids)
+        image_id = man["config"]["digest"]
+        artifact_id, blob_ids = self._image_keys(image_id, diff_ids)
+        missing_artifact, missing = self.cache.missing_blobs(
+            artifact_id, blob_ids)
+
+        @contextlib.contextmanager
+        def open_layer(i):
+            layer = layers[i]
+            media = layer.get("mediaType", "")
+            if media.endswith("+zstd"):
+                raise ContainerdError(
+                    f"zstd layer {layer['digest']} unsupported")
+            path = self.store.blob_path(layer["digest"])
+            raw = open(path, "rb")
+            src = gzip.GzipFile(fileobj=raw) \
+                if media.endswith(("+gzip", ".gzip")) else raw
+            try:
+                with tarfile.open(fileobj=src, mode="r|*") as ltf:
+                    yield ltf
+            finally:
+                src.close()
+                if src is not raw:
+                    raw.close()
+
+        secret_files = self._walk_missing_layers(
+            diff_ids, blob_ids, created_by, missing, open_layer,
+            layer_digests=[ld["digest"] for ld in layers])
+
+        metadata = T.Metadata(
+            image_id=image_id,
+            diff_ids=diff_ids,
+            repo_tags=[name],
+            image_config=config,
+        )
+        if missing_artifact:
+            self._put_artifact_info(artifact_id, config)
+        return ArtifactReference(
+            name=self.image, type=T.ArtifactType.CONTAINER_IMAGE,
+            id=artifact_id, blob_ids=blob_ids, image_metadata=metadata,
+            secret_files=secret_files)

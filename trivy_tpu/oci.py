@@ -145,19 +145,27 @@ class RegistryClient:
             raise OCIError(f"{url}: {e.reason}") from None
 
     def _ecr_basic(self, host: str):
-        """Per-host ECR basic credentials, refreshed before the 12h
-        token lifetime runs out; None for non-ECR hosts — static creds
-        never leak across hosts and expired tokens re-fetch."""
+        """Per-host cloud-registry basic credentials (ECR, GCR/Artifact
+        Registry, ACR — reference pkg/fanal/image/registry/*), cached
+        and refreshed before each provider's token lifetime runs out;
+        None for unrecognized hosts — static creds never leak across
+        hosts and expired tokens re-fetch."""
         import time
         cached = self._ecr_creds.get(host)
         if cached is not None and time.time() < cached[2]:
-            return cached[0], cached[1]
-        creds = ecr_credentials(host)
-        if creds is None:
-            return None
-        self._ecr_creds[host] = (creds[0], creds[1],
-                                 time.time() + 11 * 3600)
-        return creds
+            return cached[0] and (cached[0], cached[1]) or None
+        for fetch, ttl_s in ((ecr_credentials, 11 * 3600),
+                             (gcr_credentials, 50 * 60),
+                             (acr_credentials, 60 * 60)):
+            creds = fetch(host)
+            if creds is not None:
+                self._ecr_creds[host] = (creds[0], creds[1],
+                                         time.time() + ttl_s)
+                return creds
+        # negative-cache misses briefly: each miss may have cost OAuth
+        # POSTs + a metadata-server probe, and _request asks per fetch
+        self._ecr_creds[host] = ("", "", time.time() + 5 * 60)
+        return None
 
     def _fetch_token(self, challenge: str) -> str:
         """WWW-Authenticate: Bearer realm=...,service=...,scope=... →
@@ -399,3 +407,125 @@ def ecr_credentials(host: str) -> "tuple[str, str] | None":
         return user, password
     except (ValueError, KeyError, IndexError):
         return None
+
+
+def _post_form(url: str, fields: dict, timeout: float = 10.0):
+    """POST form-encoded; → decoded JSON or None on any failure."""
+    data = urllib.parse.urlencode(fields).encode()
+    req = urllib.request.Request(url, data=data, headers={
+        "Content-Type": "application/x-www-form-urlencoded"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.URLError, ValueError, OSError):
+        return None
+
+
+def gcr_credentials(host: str) -> "tuple[str, str] | None":
+    """Google Container/Artifact Registry auth helper (reference
+    fanal/image/registry/google/google.go: gcr.io + docker.pkg.dev
+    domains). Resolution order, all plain HTTP (no RSA signing):
+
+      1. $CLOUDSDK_AUTH_ACCESS_TOKEN / $GOOGLE_OAUTH_ACCESS_TOKEN
+      2. gcloud application-default credentials (authorized_user JSON
+         with a refresh token -> oauth2 token endpoint)
+      3. the GCE metadata server's default service-account token
+
+    -> ("oauth2accesstoken", access_token) or None."""
+    if not (host == "gcr.io" or host.endswith(".gcr.io")
+            or host.endswith("docker.pkg.dev")):
+        return None
+    for var in ("CLOUDSDK_AUTH_ACCESS_TOKEN",
+                "GOOGLE_OAUTH_ACCESS_TOKEN"):
+        tok = os.environ.get(var, "")
+        if tok:
+            return "oauth2accesstoken", tok
+    # application-default credentials (refresh-token flow only; a
+    # service_account key needs RS256 JWT signing, which has no
+    # stdlib implementation -- use an access token for those)
+    adc = os.environ.get("GOOGLE_APPLICATION_CREDENTIALS", "") or \
+        os.path.join(os.path.expanduser("~"), ".config", "gcloud",
+                     "application_default_credentials.json")
+    if os.path.exists(adc):
+        try:
+            with open(adc) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+        if doc.get("type") == "authorized_user" and \
+                doc.get("refresh_token"):
+            token_url = os.environ.get(
+                "TRIVY_TPU_GOOGLE_TOKEN_URL",
+                "https://oauth2.googleapis.com/token")
+            out = _post_form(token_url, {
+                "grant_type": "refresh_token",
+                "client_id": doc.get("client_id", ""),
+                "client_secret": doc.get("client_secret", ""),
+                "refresh_token": doc["refresh_token"],
+            })
+            if out and out.get("access_token"):
+                return "oauth2accesstoken", out["access_token"]
+    # GCE metadata server (only when explicitly pointed at one, or on
+    # a GCE host where the magic hostname resolves)
+    meta = os.environ.get(
+        "TRIVY_TPU_GCE_METADATA",
+        "http://metadata.google.internal")
+    req = urllib.request.Request(
+        meta + "/computeMetadata/v1/instance/service-accounts/"
+               "default/token",
+        headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=2.0) as resp:
+            out = json.loads(resp.read())
+        if out.get("access_token"):
+            return "oauth2accesstoken", out["access_token"]
+    except (urllib.error.URLError, ValueError, OSError):
+        pass
+    return None
+
+
+# the fixed ACR OAuth2 client id every docker login to ACR uses
+_ACR_USER = "00000000-0000-0000-0000-000000000000"
+
+
+def acr_credentials(host: str) -> "tuple[str, str] | None":
+    """Azure Container Registry auth helper (reference
+    fanal/image/registry/azure/azure.go): an AAD access token (client
+    credentials from $AZURE_CLIENT_ID/$AZURE_CLIENT_SECRET/
+    $AZURE_TENANT_ID, or $AZURE_ACCESS_TOKEN directly) is exchanged at
+    the registry's /oauth2/exchange for an ACR refresh token, used as
+    the basic-auth password under the fixed null-GUID username."""
+    if not host.endswith("azurecr.io"):
+        return None
+    tenant = os.environ.get("AZURE_TENANT_ID", "")
+    if not tenant:
+        return None
+    aad_token = os.environ.get("AZURE_ACCESS_TOKEN", "")
+    if not aad_token:
+        client_id = os.environ.get("AZURE_CLIENT_ID", "")
+        client_secret = os.environ.get("AZURE_CLIENT_SECRET", "")
+        if not (client_id and client_secret):
+            return None
+        login = os.environ.get("TRIVY_TPU_AZURE_LOGIN_ENDPOINT",
+                               "https://login.microsoftonline.com")
+        out = _post_form(f"{login}/{tenant}/oauth2/v2.0/token", {
+            "grant_type": "client_credentials",
+            "client_id": client_id,
+            "client_secret": client_secret,
+            "scope": "https://management.azure.com/.default",
+        })
+        if not out or not out.get("access_token"):
+            return None
+        aad_token = out["access_token"]
+    exchange = os.environ.get(
+        "TRIVY_TPU_ACR_EXCHANGE_ENDPOINT",
+        f"https://{host}") + "/oauth2/exchange"
+    out = _post_form(exchange, {
+        "grant_type": "access_token",
+        "service": host,
+        "tenant": tenant,
+        "access_token": aad_token,
+    })
+    if not out or not out.get("refresh_token"):
+        return None
+    return _ACR_USER, out["refresh_token"]
