@@ -1,0 +1,236 @@
+"""Node-collector analog: infra CIS checks + node component vulns
+(reference pkg/k8s/commands/cluster.go --components infra,
+pkg/k8s/scanner/scanner.go NodeInfo handling)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from trivy_tpu.db.table import RawAdvisory, build_table
+from trivy_tpu.fanal.cache import MemoryCache
+from trivy_tpu.k8s import KubeClient
+from trivy_tpu.k8s.kubeconfig import KubeConfig
+from trivy_tpu.k8s.nodes import (collect_node_info, node_vuln_apps,
+                                 scan_infra, scan_node_infra,
+                                 scan_node_vulns)
+from trivy_tpu.scanner import LocalScanner
+
+WORKER_INFO = {
+    "apiVersion": "v1", "kind": "NodeInfo", "type": "worker",
+    "info": {
+        "kubeletConfFilePermissions": {"values": [644]},      # FAIL
+        "kubeletConfFileOwnership": {"values": ["root:root"]},
+        "kubeletAnonymousAuthArgumentSet": {"values": ["true"]},  # FAIL
+        "kubeletAuthorizationModeArgumentSet": {"values": ["Webhook"]},
+        "kubeletClientCaFileArgumentSet":
+            {"values": ["/etc/kubernetes/pki/ca.crt"]},
+        "kubeletReadOnlyPortArgumentSet": {"values": ["0"]},
+        "kubeletHostnameOverrideArgumentSet": {"values": []},
+    },
+}
+
+MASTER_INFO = {
+    "apiVersion": "v1", "kind": "NodeInfo", "type": "master",
+    "info": {
+        "kubeAPIServerSpecFilePermission": {"values": [600]},
+        "kubeEtcdDataDirectoryPermission": {"values": [755]},  # FAIL
+        "kubePKIKeyFilePermissions": {"values": [600]},
+    },
+}
+
+
+class TestInfraChecks:
+    def test_worker_failures_and_passes(self):
+        res = scan_node_infra(WORKER_INFO, "node-1")
+        assert res.target == "node-1"
+        assert res.clazz == "config"
+        ids = {m.id for m in res.misconfigurations}
+        assert ids == {"AVD-KCV-0073", "AVD-KCV-0075"}
+        assert all(m.status == "FAIL"
+                   for m in res.misconfigurations)
+        # passes counted, inapplicable keys skipped entirely
+        assert res.misconf_summary.successes == 5
+        assert res.misconf_summary.failures == 2
+
+    def test_master_file_permissions(self):
+        res = scan_node_infra(MASTER_INFO, "cp-1")
+        ids = {m.id for m in res.misconfigurations}
+        assert ids == {"AVD-KCV-0056"}
+        assert res.misconf_summary.successes == 2
+
+    def test_empty_info_yields_empty_result(self):
+        res = scan_node_infra({"info": {}}, "n")
+        assert res.misconfigurations == []
+        assert res.misconf_summary.failures == 0
+
+
+NODE_DOC = {
+    "metadata": {"name": "node-1", "labels": {"pool": "default"}},
+    "status": {"nodeInfo": {
+        "kubeletVersion": "v1.28.2",
+        "containerRuntimeVersion": "containerd://1.6.2",
+    }},
+}
+
+
+class TestNodeVulns:
+    def _scanner(self):
+        advs = [
+            RawAdvisory(source="k8s::Official Kubernetes",
+                        ecosystem="k8s", pkg_name="k8s.io/kubelet",
+                        vuln_id="CVE-2023-2728",
+                        vulnerable_ranges="<1.28.3",
+                        patched_versions="1.28.3"),
+            RawAdvisory(source="go::GitLab Advisory Database",
+                        ecosystem="go",
+                        pkg_name="github.com/containerd/containerd",
+                        vuln_id="CVE-2023-25153",
+                        vulnerable_ranges="<1.6.18",
+                        patched_versions="1.6.18"),
+        ]
+        details = {
+            "CVE-2023-2728": {"Title": "kubelet bypass",
+                              "Severity": "HIGH"},
+            "CVE-2023-25153": {"Title": "containerd OCI importer DoS",
+                               "Severity": "MEDIUM"},
+        }
+        return LocalScanner(MemoryCache(), build_table(advs, details))
+
+    def test_apps_from_node_doc(self):
+        apps = node_vuln_apps(NODE_DOC)
+        assert [(a.type, a.packages[0].name, a.packages[0].version)
+                for a in apps] == [
+            ("kubernetes", "k8s.io/kubelet", "1.28.2"),
+            ("gobinary", "github.com/containerd/containerd", "1.6.2")]
+
+    def test_batched_node_vuln_scan(self):
+        results = scan_node_vulns([NODE_DOC], self._scanner())
+        cves = {v.vulnerability_id for r in results
+                for v in r.vulnerabilities}
+        assert cves == {"CVE-2023-2728", "CVE-2023-25153"}
+        assert all(r.target == "node-1" for r in results)
+
+    def test_patched_node_clean(self):
+        doc = {"metadata": {"name": "n2"},
+               "status": {"nodeInfo": {
+                   "kubeletVersion": "v1.28.3",
+                   "containerRuntimeVersion": "containerd://1.6.18"}}}
+        assert scan_node_vulns([doc], self._scanner()) == []
+
+
+class _FakeCluster:
+    """Stateful fake API server: Job POST spawns a Succeeded pod whose
+    logs are the canned node-collector output; DELETE removes it."""
+
+    def __init__(self, node_infos: dict):
+        outer = self
+        self.jobs = {}
+        self.deleted = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, doc, raw=None):
+                body = raw if raw is not None else \
+                    json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path
+                if path == "/api/v1/nodes":
+                    self._send({"items": [
+                        {"metadata": {"name": n,
+                                      "labels": {"pool": n}},
+                         "status": {"nodeInfo": {}}}
+                        for n in node_infos]})
+                elif path.startswith("/api/v1/namespaces/") and \
+                        "/pods?" in path:
+                    sel = path.split("labelSelector=")[1]
+                    job = sel.split("%3D")[-1].split("=")[-1]
+                    node = job[len("node-collector-"):]
+                    if job in outer.jobs:
+                        self._send({"items": [{
+                            "metadata": {"name": f"{job}-pod"},
+                            "status": {"phase": "Succeeded"},
+                        }]})
+                    else:
+                        self._send({"items": []})
+                elif path.endswith("/log"):
+                    pod = path.split("/pods/")[1].split("/")[0]
+                    node = pod[len("node-collector-"):-len("-pod")]
+                    self._send(None, raw=json.dumps(
+                        node_infos[node]).encode())
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length))
+                outer.jobs[body["metadata"]["name"]] = body
+                self._send(body)
+
+            def do_DELETE(self):
+                name = self.path.split("/jobs/")[1].split("?")[0]
+                outer.deleted.append(name)
+                outer.jobs.pop(name, None)
+                self._send({})
+
+        self._srv = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._srv.server_port}"
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self._srv.shutdown()
+
+
+class TestCollectorE2E:
+    def test_collect_and_scan_infra(self):
+        fake = _FakeCluster({"node-1": WORKER_INFO,
+                             "cp-1": MASTER_INFO})
+        try:
+            client = KubeClient(KubeConfig(server=fake.url,
+                                           token="tok"))
+            info = collect_node_info(client, "node-1",
+                                     poll_interval=0.01)
+            assert info["type"] == "worker"
+            # the job was cleaned up afterwards
+            assert "node-collector-node-1" in fake.deleted
+
+            results = scan_infra(client, scanners=("misconfig",),
+                                 namespace="trivy-temp")
+            by_target = {r.target: r for r in results}
+            assert set(by_target) == {"node-1", "cp-1"}
+            assert {m.id for m in
+                    by_target["cp-1"].misconfigurations} == \
+                {"AVD-KCV-0056"}
+        finally:
+            fake.close()
+
+    def test_exclude_nodes(self):
+        fake = _FakeCluster({"node-1": WORKER_INFO})
+        try:
+            client = KubeClient(KubeConfig(server=fake.url,
+                                           token="tok"))
+            results = scan_infra(client, scanners=("misconfig",),
+                                 exclude_labels={"pool": "node-1"})
+            assert results == []
+            assert fake.jobs == {}
+        finally:
+            fake.close()
+
+    def test_job_manifest_shape(self):
+        from trivy_tpu.k8s.nodes import _job_manifest
+        m = _job_manifest("n1", "trivy-temp", "img:1", "node-collector-n1")
+        spec = m["spec"]["template"]["spec"]
+        assert spec["nodeName"] == "n1"
+        assert spec["hostPID"] is True
+        mounts = {v["hostPath"]["path"] for v in spec["volumes"]}
+        assert "/etc/kubernetes" in mounts
+        assert "/var/lib/kubelet" in mounts

@@ -1,0 +1,166 @@
+"""Unpackaged-executable Rekor handler + executable analyzer
+(reference pkg/fanal/handler/unpackaged/, analyzer/executable/)."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu import types as T
+from trivy_tpu.fanal.analyzers import AnalysisResult, AnalyzerGroup
+from trivy_tpu.fanal.handlers import (UnpackagedHandler,
+                                      configure_post_handlers,
+                                      post_handle)
+
+GOBINARY_CDX = {
+    "bomFormat": "CycloneDX", "specVersion": "1.5",
+    "components": [
+        {"bom-ref": "app1", "type": "application", "name": "whatever",
+         "properties": [{"name": "aquasecurity:trivy:Type",
+                         "value": "gobinary"}]},
+        {"bom-ref": "lib1", "type": "library",
+         "name": "github.com/spf13/cobra", "version": "1.7.0",
+         "purl": "pkg:golang/github.com/spf13/cobra@1.7.0"},
+    ],
+    "dependencies": [{"ref": "app1", "dependsOn": ["lib1"]}],
+}
+
+ENTRY_ID = "2" * 16 + "b" * 64
+
+
+def _envelope(predicate):
+    st = {
+        "_type": "https://in-toto.io/Statement/v0.1",
+        "predicateType": "https://cyclonedx.org/bom",
+        "subject": [], "predicate": predicate,
+    }
+    return {
+        "payloadType": "application/vnd.in-toto+json",
+        "payload": base64.b64encode(json.dumps(st).encode()).decode(),
+        "signatures": [{"keyid": "", "sig": "ZmFrZQ=="}],
+    }
+
+
+class _FakeRekor(BaseHTTPRequestHandler):
+    hits: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        ln = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(ln))
+        if self.path == "/api/v1/index/retrieve":
+            _FakeRekor.hits.append(req.get("hash", ""))
+            body = json.dumps([ENTRY_ID]).encode()
+        elif self.path == "/api/v1/log/entries/retrieve":
+            att = base64.b64encode(json.dumps(
+                _envelope(GOBINARY_CDX)).encode()).decode()
+            body = json.dumps([
+                {ENTRY_ID: {"attestation": {"data": att},
+                            "body": "..."}}]).encode()
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def rekor_url():
+    _FakeRekor.hits = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeRekor)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+    configure_post_handlers(rekor_url="")
+
+
+ELF = b"\x7fELF" + b"\x00" * 64
+
+
+class TestExecutableAnalyzer:
+    def _group(self):
+        return AnalyzerGroup(enabled=("executable",))
+
+    def test_digest_collected_for_elf(self):
+        from trivy_tpu.fanal.analyzers.executable import \
+            ExecutableAnalyzer
+        a = ExecutableAnalyzer()
+        assert a.required("usr/local/bin/app")
+        assert not a.required("etc/config.yaml")
+        res = a.analyze("usr/local/bin/app", ELF)
+        assert list(res.digests) == ["usr/local/bin/app"]
+        assert res.digests["usr/local/bin/app"].startswith("sha256:")
+        # non-binaries are skipped even when name-gated
+        assert a.analyze("usr/bin/script", b"#!/bin/sh\n") is None
+
+    def test_opt_in(self):
+        on = AnalyzerGroup(enabled=("executable",))
+        off = AnalyzerGroup()
+        assert any(a.name == "executable" for a in on.analyzers)
+        assert not any(a.name == "executable" for a in off.analyzers)
+
+
+class TestUnpackagedHandler:
+    def test_rekor_sbom_attached(self, rekor_url):
+        configure_post_handlers(rekor_url=rekor_url)
+        result = AnalysisResult(
+            digests={"usr/local/bin/app": "sha256:" + "ab" * 32})
+        blob = T.BlobInfo()
+        post_handle(result, blob)
+        assert len(blob.applications) == 1
+        app = blob.applications[0]
+        # the binary's path replaces the SBOM's own name
+        assert app.file_path == "usr/local/bin/app"
+        assert app.type == "gobinary"
+        assert [(p.name, p.version) for p in app.packages] == \
+            [("github.com/spf13/cobra", "1.7.0")]
+
+    def test_system_files_skipped(self, rekor_url):
+        configure_post_handlers(rekor_url=rekor_url)
+        result = AnalysisResult(
+            digests={"usr/bin/dpkg-owned": "sha256:" + "cd" * 32},
+            system_installed_files=["usr/bin/dpkg-owned"])
+        blob = T.BlobInfo()
+        post_handle(result, blob)
+        assert blob.applications == []
+        assert _FakeRekor.hits == []
+
+    def test_inert_without_rekor_url(self):
+        configure_post_handlers(rekor_url="")
+        result = AnalysisResult(
+            digests={"usr/local/bin/app": "sha256:" + "ab" * 32})
+        blob = T.BlobInfo()
+        post_handle(result, blob)
+        assert blob.applications == []
+
+    def test_handler_registered(self):
+        assert UnpackagedHandler.rekor_url == ""
+
+
+def test_cdx_dependency_attachment_is_order_independent():
+    """Libraries listed before their owning application component must
+    still attach through the dependency graph (CycloneDX imposes no
+    component ordering)."""
+    from trivy_tpu.sbom.cyclonedx import decode_cyclonedx
+    doc = {
+        "bomFormat": "CycloneDX", "specVersion": "1.5",
+        "components": [
+            {"bom-ref": "lib1", "type": "library", "name": "lodash",
+             "version": "4.17.20", "purl": "pkg:npm/lodash@4.17.20"},
+            {"bom-ref": "app1", "type": "application",
+             "name": "app/package-lock.json",
+             "properties": [{"name": "aquasecurity:trivy:Type",
+                             "value": "npm"}]},
+        ],
+        "dependencies": [{"ref": "app1", "dependsOn": ["lib1"]}],
+    }
+    d = decode_cyclonedx(doc)
+    assert [(a.type, a.file_path, [p.name for p in a.packages])
+            for a in d.applications] == \
+        [("npm", "app/package-lock.json", ["lodash"])]

@@ -125,9 +125,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rekor-url", default="https://rekor.sigstore.dev")
     p.add_argument("--platform", default="",
                    help="os/arch for registry pulls (default linux/amd64)")
-    p.add_argument("--image-src", default="docker,podman,remote",
+    p.add_argument("--image-src",
+                   default="docker,containerd,podman,remote",
                    help="image source fallback order "
-                        "(docker,podman,remote)")
+                        "(docker,containerd,podman,remote)")
     _add_scan_flags(p)
 
     for name, aliases in (("filesystem", ["fs"]), ("rootfs", [])):
@@ -200,6 +201,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--format", "-f", default="table",
                    choices=["table", "json", "cyclonedx"])
     p.add_argument("--compliance", default="")
+    p.add_argument("--components", default="workload,infra",
+                   help="comma-separated: workload,infra (infra runs "
+                        "the node collector; reference cluster.go:31)")
+    p.add_argument("--node-collector-namespace", default="trivy-temp")
+    p.add_argument("--node-collector-imageref", default="")
+    p.add_argument("--exclude-nodes", default="",
+                   help="comma-separated label=value pairs; matching "
+                        "nodes skip the collector")
     p.add_argument("--output", "-o", default="")
     p.add_argument("--exit-code", type=int, default=0)
 
@@ -697,8 +706,18 @@ def _rel_globs(globs, root: str) -> tuple:
 
 def _analyzer_group(args, disabled=(), enabled=()):
     """Build an AnalyzerGroup honoring --file-patterns on every target
-    kind (the reference binds the flag globally, run.go:648-692)."""
+    kind (the reference binds the flag globally, run.go:648-692).
+    --sbom-sources rekor additionally enables the executable-digest
+    analyzer and arms the unpackaged Rekor post-handler (run.go's
+    TypeExecutable / unpackaged gating)."""
     from .fanal.analyzers import AnalyzerGroup
+    from .fanal.handlers import configure_post_handlers
+    if "rekor" in getattr(args, "sbom_sources", ""):
+        enabled = tuple(enabled) + ("executable",)
+        configure_post_handlers(
+            rekor_url=getattr(args, "rekor_url", ""))
+    else:
+        configure_post_handlers(rekor_url="")
     try:
         return AnalyzerGroup(
             disabled=disabled, enabled=enabled,
@@ -811,13 +830,18 @@ def cmd_k8s(args) -> int:
             out.write("\n")
             return 0
         scanners = normalize_scanners(args.scanners)
+        components = [c.strip() for c in
+                      getattr(args, "components",
+                              "workload,infra").split(",") if c.strip()]
         results = []
-        if "misconfig" in scanners:
+        if "misconfig" in scanners and "workload" in components:
             results += scan_cluster(client,
                                     args.namespace or cfg.namespace)
+        scanner = None
         if "vuln" in scanners or "secret" in scanners:
             from .fanal.cache import MemoryCache
             from .k8s.scanner import scan_cluster_vulns
+            from .scanner import LocalScanner
             table = _load_table_args(args) if "vuln" in scanners \
                 else build_table([])
             sec_scanner, _sec_cfg = _secret_scanner(args, scanners)
@@ -825,15 +849,34 @@ def cmd_k8s(args) -> int:
             # scan_cluster_vulns would waste the image pulls already
             # made and surface as a raw ValueError
             _analyzer_group(args)
-            results += scan_cluster_vulns(
-                client, MemoryCache(), table,
-                namespace=args.namespace or cfg.namespace,
-                scanners=[s for s in scanners if s != "misconfig"],
-                list_all_packages=args.list_all_pkgs,
-                secret_scanner=sec_scanner,
-                secret_config_path=_sec_cfg,
-                file_patterns=tuple(
-                    getattr(args, "file_patterns", ()) or ()))
+            k8s_cache = MemoryCache()
+            scanner = LocalScanner(k8s_cache, table)
+            if "workload" in components:
+                results += scan_cluster_vulns(
+                    client, k8s_cache, table,
+                    namespace=args.namespace or cfg.namespace,
+                    scanners=[s for s in scanners if s != "misconfig"],
+                    list_all_packages=args.list_all_pkgs,
+                    secret_scanner=sec_scanner,
+                    secret_config_path=_sec_cfg,
+                    file_patterns=tuple(
+                        getattr(args, "file_patterns", ()) or ()),
+                    scanner=scanner)
+        if "infra" in components and \
+                ("misconfig" in scanners or
+                 ("vuln" in scanners and scanner is not None)):
+            from .k8s.nodes import scan_infra
+            exclude = dict(
+                pair.split("=", 1)
+                for pair in getattr(args, "exclude_nodes", "").split(",")
+                if "=" in pair)
+            results += scan_infra(
+                client, scanner=scanner,
+                namespace=getattr(args, "node_collector_namespace",
+                                  "trivy-temp"),
+                image=getattr(args, "node_collector_imageref", ""),
+                exclude_labels=exclude,
+                scanners=tuple(scanners))
         if args.compliance:
             from .compliance import (build_compliance_report, get_spec,
                                      write_compliance)

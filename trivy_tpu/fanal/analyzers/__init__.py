@@ -29,6 +29,9 @@ class AnalysisResult:
     system_installed_files: list = field(default_factory=list)
     build_info: object = None  # Red Hat content sets / nvr+arch
     custom_resources: list = field(default_factory=list)  # module output
+    # path → sha256 digest of unpackaged executables; consumed by the
+    # unpackaged-Rekor post-handler (reference AnalysisResult.Digests)
+    digests: dict = field(default_factory=dict)
 
     def merge(self, other: "AnalysisResult"):
         if other is None:
@@ -47,6 +50,7 @@ class AnalysisResult:
         self.licenses.extend(other.licenses)
         self.system_installed_files.extend(other.system_installed_files)
         self.custom_resources.extend(other.custom_resources)
+        self.digests.update(other.digests)
         if other.build_info is not None:
             if self.build_info is None:
                 self.build_info = other.build_info
@@ -117,15 +121,15 @@ def set_module_analyzers(mods: list) -> None:
 
 
 def _ensure_loaded():
-    from . import (apk, binaries, dpkg, license_file,  # noqa: F401
-                   lockfiles, lockfiles_extra, misconf, os_release,
-                   python, redhat, rpm, sbom)
+    from . import (apk, binaries, dpkg, executable,  # noqa: F401
+                   license_file, lockfiles, lockfiles_extra, misconf,
+                   os_release, python, redhat, rpm, sbom)
 
 
 # analyzers that are opt-in everywhere (reference: license scanning is
 # behind --license-full); excluded from EVERY AnalyzerGroup unless the
 # caller lists them in `enabled`
-OPTIN_ANALYZERS = ("license-file",)
+OPTIN_ANALYZERS = ("license-file", "executable")
 
 
 class AnalyzerGroup:

@@ -148,15 +148,14 @@ class ContainerdStore:
 
 
 def _select_platform(entries: list[dict], platform: str) -> dict:
-    want_os, _, want_arch = platform.partition("/")
-    for e in entries:
-        p = e.get("platform") or {}
-        if p.get("os") == want_os and \
-                p.get("architecture") == want_arch:
-            return e
-    # a silent wrong-platform fallback would report another arch's
-    # vulnerabilities (same contract as oci.RegistryClient)
-    raise ContainerdError(f"no manifest for platform {platform}")
+    """Same selection contract as the registry source — strict match,
+    platform-less single-manifest entries acceptable, never a silent
+    wrong-platform fallback."""
+    from ..oci import OCIError, RegistryClient
+    try:
+        return RegistryClient._select_platform(entries, platform)
+    except OCIError as e:
+        raise ContainerdError(str(e)) from None
 
 
 class ContainerdArtifact(_ImageInspectMixin):

@@ -68,3 +68,62 @@ class SystemFileFilterHandler:
                 continue
             apps.append(app)
         blob.applications = apps
+
+
+@register_post_handler
+class UnpackagedHandler:
+    """Rekor SBOM lookup for unpackaged executables (reference
+    pkg/fanal/handler/unpackaged/unpackaged.go): every binary digest
+    the executable analyzer collected — minus files owned by the OS
+    package manager — is searched in the transparency log; a found
+    SBOM attestation contributes its application under the binary's
+    path.  Inert until configure_post_handlers() sets a Rekor URL
+    (the runner does so only for --sbom-sources rekor, mirroring
+    run.go's TypeExecutable gating)."""
+
+    name = "unpackaged"
+    version = 1
+    priority = 50
+    rekor_url = ""
+
+    def handle(self, result: AnalysisResult, blob: T.BlobInfo) -> None:
+        if not self.rekor_url or not result.digests:
+            return
+        from ..log import logger
+        from ..rekor import RekorError, fetch_sbom_statement
+        from ..sbom.io import decode_cyclonedx, decode_spdx, \
+            detect_format
+        system = set(result.system_installed_files)
+        for path in sorted(result.digests):
+            if path in system or "/" + path in system:
+                continue
+            try:
+                st = fetch_sbom_statement(self.rekor_url,
+                                          result.digests[path])
+            except RekorError as e:
+                logger.warning("rekor lookup for %s: %s", path, e)
+                continue
+            if st is None:
+                continue
+            doc = st.sbom_document()
+            if not isinstance(doc, dict):
+                continue
+            try:
+                fmt = detect_format(doc)
+                detail = decode_cyclonedx(doc) if fmt == "cyclonedx" \
+                    else decode_spdx(doc)
+            except (ValueError, KeyError):
+                continue
+            if detail.applications:
+                logger.info("found SBOM attestation in Rekor: %s",
+                            path)
+                app = detail.applications[0]
+                app.file_path = path
+                blob.applications.append(app)
+
+
+def configure_post_handlers(rekor_url: str = "") -> None:
+    """Process-wide handler options, set by the runner per invocation
+    (the reference builds handlers from artifact.Option the same way,
+    handler.go PostHandlerInit)."""
+    UnpackagedHandler.rekor_url = rekor_url

@@ -47,21 +47,7 @@ class KubeClient:
             self._ctx = ctx
 
     def get(self, path: str):
-        url = self.cfg.server.rstrip("/") + "/" + path.lstrip("/")
-        req = urllib.request.Request(url)
-        if self.cfg.token:
-            req.add_header("Authorization", f"Bearer {self.cfg.token}")
-        req.add_header("Accept", "application/json")
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=self.timeout,
-                    context=self._ctx) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            raise KubeError(f"GET {path}: HTTP {e.code}",
-                            code=e.code) from e
-        except (urllib.error.URLError, OSError) as e:
-            raise KubeError(f"GET {path}: {e}") from e
+        return self._request("GET", path)
 
     def version(self) -> dict:
         return self.get("/version")
@@ -87,3 +73,52 @@ class KubeClient:
                 "v1" if prefix == "api/v1" else
                 prefix.split("/", 1)[1])
         return items
+
+    # ---- write ops + logs (node-collector jobs) ----------------------
+
+    def _request(self, method: str, path: str, body=None,
+                 raw: bool = False):
+        url = self.cfg.server.rstrip("/") + "/" + path.lstrip("/")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        if self.cfg.token:
+            req.add_header("Authorization", f"Bearer {self.cfg.token}")
+        req.add_header("Accept", "*/*" if raw else "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout,
+                    context=self._ctx) as resp:
+                out = resp.read()
+                if raw:
+                    return out.decode("utf-8", errors="replace")
+                return json.loads(out) if out else {}
+        except urllib.error.HTTPError as e:
+            raise KubeError(f"{method} {path}: HTTP {e.code}",
+                            code=e.code) from e
+        except (urllib.error.URLError, OSError) as e:
+            raise KubeError(f"{method} {path}: {e}") from e
+
+    def create(self, prefix: str, namespace: str, plural: str,
+               body: dict) -> dict:
+        return self._request(
+            "POST", f"/{prefix}/namespaces/{namespace}/{plural}", body)
+
+    def delete(self, prefix: str, namespace: str, plural: str,
+               name: str) -> None:
+        self._request(
+            "DELETE",
+            f"/{prefix}/namespaces/{namespace}/{plural}/{name}"
+            "?propagationPolicy=Background")
+
+    def pods_by_label(self, namespace: str, selector: str) -> list[dict]:
+        import urllib.parse as _p
+        return self.get(
+            f"/api/v1/namespaces/{namespace}/pods"
+            f"?labelSelector={_p.quote(selector)}").get("items", [])
+
+    def pod_logs(self, namespace: str, name: str) -> str:
+        return self._request(
+            "GET", f"/api/v1/namespaces/{namespace}/pods/{name}/log",
+            raw=True)

@@ -109,8 +109,8 @@ def scan_cluster_vulns(client: KubeClient, cache, table,
                        list_all_packages: bool = False,
                        secret_scanner=None,
                        secret_config_path: str = "trivy-secret.yaml",
-                       file_patterns: tuple = ()
-                       ) -> list[T.Result]:
+                       file_patterns: tuple = (),
+                       scanner=None) -> list[T.Result]:
     """Workload-image vulnerability scanning (reference
     pkg/k8s/scanner/scanner.go:104-121,163-175).
 
@@ -162,7 +162,9 @@ def scan_cluster_vulns(client: KubeClient, cache, table,
             _os.unlink(tmp.name)
 
     ok_images = [img for img in images if img in refs]
-    scanner = LocalScanner(cache, table)
+    # a caller-provided scanner (built over the same cache) shares one
+    # table upload across the workload sweep and the node-vuln scan
+    scanner = scanner or LocalScanner(cache, table)
     opts = T.ScanOptions(scanners=tuple(scanners),
                          list_all_packages=list_all_packages)
     scanned = scanner.scan_many(

@@ -153,7 +153,10 @@ class RegistryClient:
         import time
         cached = self._ecr_creds.get(host)
         if cached is not None and time.time() < cached[2]:
-            return cached[0] and (cached[0], cached[1]) or None
+            # ("", "", expiry) is the negative-cache sentinel
+            if not cached[0]:
+                return None
+            return cached[0], cached[1]
         for fetch, ttl_s in ((ecr_credentials, 11 * 3600),
                              (gcr_credentials, 50 * 60),
                              (acr_credentials, 60 * 60)):

@@ -36,6 +36,27 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
     if meta_comp:
         components.append(meta_comp)
 
+    # pre-create application entries and dependency edges BEFORE the
+    # component loop: CycloneDX imposes no component ordering, so a
+    # library may precede (or be the metadata.component's sibling of)
+    # the application that owns it via the dependency graph
+    # (reference unmarshal.go walks the BOM graph; libraries reached
+    # from an application belong to it, not to a purl-class aggregate)
+    for comp in components:
+        if comp.get("type") == "application" and not comp.get("purl"):
+            app_type = _props(comp).get("Type", "")
+            if app_type:
+                app = T.Application(type=app_type,
+                                    file_path=comp.get("name", ""))
+                apps[comp.get("bom-ref", comp.get("name", ""))] = app
+                explicit_apps.append(app)
+    owner_of: dict[str, str] = {}
+    for dep in doc.get("dependencies") or []:
+        ref = dep.get("ref")
+        if ref in apps:
+            for child in dep.get("dependsOn") or []:
+                owner_of.setdefault(child, ref)
+
     for comp in components:
         ctype = comp.get("type", "")
         props = _props(comp)
@@ -45,13 +66,7 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
                              name=comp.get("version", ""))
             continue
         if ctype == "application" and not comp.get("purl"):
-            app_type = props.get("Type", "")
-            path = comp.get("name", "")
-            if app_type:
-                app = T.Application(type=app_type, file_path=path)
-                apps[comp.get("bom-ref", path)] = app
-                explicit_apps.append(app)
-            continue
+            continue  # already created in the prescan
         if ctype not in ("library", "application", "platform"):
             continue
         if ctype == "platform" and not comp.get("purl"):
@@ -108,6 +123,10 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
             pkg.id = props.get("PkgID") or f"{pkg.name}@{pkg.version}"
             path = props.get("FilePath", "")
             app_type = ptype or "unknown"
+            owner = owner_of.get(comp.get("bom-ref"))
+            if owner is not None and owner in apps and not path:
+                apps[owner].packages.append(pkg)
+                continue
             if not path and purl:
                 # a library with no file path and no application link
                 # aggregates by its PURL class, not its PkgType prop
