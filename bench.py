@@ -48,7 +48,8 @@ N_PKG_NAMES = 30_000
 N_IMAGES = 2048
 PKGS_PER_IMAGE = 80
 BASELINE_IMAGES = 256  # large enough to preserve the Zipf-skew density
-BATCH_IMAGES = 256
+BATCH_IMAGES = 512   # sweet spot on-chip: dispatch latency dominates
+                     # below this, assemble cache pressure above it
 SOURCE = "alpine 3.19"
 SKEW_PKG = "linux-lts"
 SKEW_ROWS = 4000

@@ -43,6 +43,70 @@ CALLER_IDENTITY = """<GetCallerIdentityResponse>
 </GetCallerIdentityResponse>"""
 
 
+
+DESCRIBE_INSTANCES = """<?xml version="1.0"?>
+<DescribeInstancesResponse>
+  <reservationSet><item><instancesSet><item>
+    <instanceId>i-0abc</instanceId>
+    <metadataOptions><httpTokens>optional</httpTokens>
+      <httpEndpoint>enabled</httpEndpoint></metadataOptions>
+  </item></instancesSet></item></reservationSet>
+</DescribeInstancesResponse>"""
+
+DESCRIBE_VOLUMES = """<?xml version="1.0"?>
+<DescribeVolumesResponse>
+  <volumeSet><item>
+    <volumeId>vol-1</volumeId><encrypted>false</encrypted>
+  </item></volumeSet>
+</DescribeVolumesResponse>"""
+
+DESCRIBE_DBS = """<?xml version="1.0"?>
+<DescribeDBInstancesResponse><DescribeDBInstancesResult>
+  <DBInstances><DBInstance>
+    <DBInstanceIdentifier>maindb</DBInstanceIdentifier>
+    <StorageEncrypted>false</StorageEncrypted>
+    <BackupRetentionPeriod>0</BackupRetentionPeriod>
+    <PubliclyAccessible>true</PubliclyAccessible>
+  </DBInstance></DBInstances>
+</DescribeDBInstancesResult></DescribeDBInstancesResponse>"""
+
+TRAILS_JSON = json.dumps({"trailList": [{
+    "Name": "main-trail", "IsMultiRegionTrail": False,
+    "LogFileValidationEnabled": False}]})
+
+EFS_JSON = json.dumps({"FileSystems": [
+    {"FileSystemId": "fs-1", "Encrypted": False}]})
+
+DESCRIBE_LBS = """<?xml version="1.0"?>
+<DescribeLoadBalancersResponse><DescribeLoadBalancersResult>
+  <LoadBalancers><member>
+    <LoadBalancerName>public-alb</LoadBalancerName>
+    <LoadBalancerArn>arn:aws:elb:lb/1</LoadBalancerArn>
+    <Scheme>internet-facing</Scheme><Type>application</Type>
+  </member></LoadBalancers>
+</DescribeLoadBalancersResult></DescribeLoadBalancersResponse>"""
+
+LB_ATTRS = """<?xml version="1.0"?>
+<DescribeLoadBalancerAttributesResponse>
+<DescribeLoadBalancerAttributesResult><Attributes>
+  <member><Key>routing.http.drop_invalid_header_fields.enabled</Key>
+  <Value>false</Value></member>
+</Attributes></DescribeLoadBalancerAttributesResult>
+</DescribeLoadBalancerAttributesResponse>"""
+
+LIST_POLICIES = """<?xml version="1.0"?>
+<ListPoliciesResponse><ListPoliciesResult><Policies><member>
+  <PolicyName>too-broad</PolicyName>
+  <Arn>arn:aws:iam::1:policy/too-broad</Arn>
+  <DefaultVersionId>v2</DefaultVersionId>
+</member></Policies></ListPoliciesResult></ListPoliciesResponse>"""
+
+POLICY_VERSION = """<?xml version="1.0"?>
+<GetPolicyVersionResponse><GetPolicyVersionResult><PolicyVersion>
+  <Document>%7B%22Statement%22%3A%5B%7B%22Effect%22%3A%22Allow%22%2C%22Action%22%3A%22%2A%22%2C%22Resource%22%3A%22%2A%22%7D%5D%7D</Document>
+</PolicyVersion></GetPolicyVersionResult></GetPolicyVersionResponse>"""
+
+
 class FakeAWS(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
@@ -71,13 +135,32 @@ class FakeAWS(BaseHTTPRequestHandler):
             return self._reply("<Error/>", 404)
         if "acl" in self.path:
             return self._reply(PUBLIC_ACL)
+        if "file-systems" in self.path:
+            return self._reply(EFS_JSON)
         return self._reply("<Error/>", 404)
 
     def do_POST(self):
         ln = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(ln).decode()
+        target = self.headers.get("X-Amz-Target", "")
+        if "DescribeTrails" in target:
+            return self._reply(TRAILS_JSON)
         if "DescribeSecurityGroups" in body:
             return self._reply(DESCRIBE_SGS)
+        if "DescribeInstances" in body:
+            return self._reply(DESCRIBE_INSTANCES)
+        if "DescribeVolumes" in body:
+            return self._reply(DESCRIBE_VOLUMES)
+        if "DescribeDBInstances" in body:
+            return self._reply(DESCRIBE_DBS)
+        if "DescribeLoadBalancerAttributes" in body:
+            return self._reply(LB_ATTRS)
+        if "DescribeLoadBalancers" in body:
+            return self._reply(DESCRIBE_LBS)
+        if "ListPolicies" in body:
+            return self._reply(LIST_POLICIES)
+        if "GetPolicyVersion" in body:
+            return self._reply(POLICY_VERSION)
         if "GetCallerIdentity" in body:
             return self._reply(CALLER_IDENTITY)
         return self._reply("<Error/>", 400)
@@ -156,3 +239,29 @@ def test_cli_aws_json(fake_aws, tmp_path, capsys):
     mcs = [m for r in out.get("Results", [])
            for m in r.get("Misconfigurations", [])]
     assert any(m["ID"] == "AVD-AWS-0107" for m in mcs)
+
+
+def test_scan_account_breadth(fake_aws, tmp_path):
+    """The expanded service walkers (reference pkg/cloud/aws coverage):
+    rds/ebs/cloudtrail/efs/elb/iam state evaluated by the shared
+    AVD-AWS checks."""
+    results, account = scan_account(
+        ["ec2", "ebs", "rds", "cloudtrail", "efs", "elb", "iam"],
+        endpoint=fake_aws, cache_dir=str(tmp_path), update_cache=True)
+    ids = {m.id for r in results for m in r.misconfigurations}
+    for want in (
+            "AVD-AWS-0028",   # instance without IMDSv2 tokens
+            "AVD-AWS-0026",   # unencrypted EBS volume
+            "AVD-AWS-0080",   # RDS unencrypted
+            "AVD-AWS-0077",   # RDS no backups
+            "AVD-AWS-0180",   # RDS public
+            "AVD-AWS-0014",   # trail not multi-region
+            "AVD-AWS-0016",   # trail without validation
+            "AVD-AWS-0037",   # EFS unencrypted
+            "AVD-AWS-0052",   # ALB keeps invalid headers
+            "AVD-AWS-0057",   # IAM wildcards
+    ):
+        assert want in ids, want
+    svc_targets = {r.target for r in results}
+    assert any(":rds:" in t for t in svc_targets)
+    assert any(":iam:" in t for t in svc_targets)

@@ -234,3 +234,14 @@ class TestCollectorE2E:
         mounts = {v["hostPath"]["path"] for v in spec["volumes"]}
         assert "/etc/kubernetes" in mounts
         assert "/var/lib/kubelet" in mounts
+
+
+def test_perm_check_uses_bitmask_not_numeric_compare():
+    """Mode 577 (group/other rwx) is numerically below 600 but far less
+    restrictive — it must FAIL the permission checks."""
+    res = scan_node_infra({"info": {
+        "kubeletConfFilePermissions": {"values": [577]}}}, "n")
+    assert [m.id for m in res.misconfigurations] == ["AVD-KCV-0073"]
+    res = scan_node_infra({"info": {
+        "kubeletConfFilePermissions": {"values": [400]}}}, "n")
+    assert res.misconfigurations == []

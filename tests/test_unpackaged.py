@@ -164,3 +164,37 @@ def test_cdx_dependency_attachment_is_order_independent():
     assert [(a.type, a.file_path, [p.name for p in a.packages])
             for a in d.applications] == \
         [("npm", "app/package-lock.json", ["lodash"])]
+
+
+def test_executable_required_allows_dotted_names():
+    from trivy_tpu.fanal.analyzers.executable import ExecutableAnalyzer
+    a = ExecutableAnalyzer()
+    assert a.required("usr/local/bin/python3.11")
+    assert a.required("usr/local/bin/kustomize_v5.0.1")
+    assert not a.required("etc/app.yaml")
+    assert not a.required("README.md")
+
+
+def test_cdx_transitive_dependencies_attach_to_app():
+    from trivy_tpu.sbom.cyclonedx import decode_cyclonedx
+    doc = {
+        "bomFormat": "CycloneDX", "specVersion": "1.5",
+        "components": [
+            {"bom-ref": "app1", "type": "application",
+             "name": "app/go.bin",
+             "properties": [{"name": "aquasecurity:trivy:Type",
+                             "value": "gobinary"}]},
+            {"bom-ref": "lib1", "type": "library", "name": "direct",
+             "version": "1.0", "purl": "pkg:golang/direct@1.0"},
+            {"bom-ref": "lib2", "type": "library", "name": "transitive",
+             "version": "2.0", "purl": "pkg:golang/transitive@2.0"},
+        ],
+        "dependencies": [
+            {"ref": "app1", "dependsOn": ["lib1"]},
+            {"ref": "lib1", "dependsOn": ["lib2"]},
+        ],
+    }
+    d = decode_cyclonedx(doc)
+    assert [(a.file_path, sorted(p.name for p in a.packages))
+            for a in d.applications] == \
+        [("app/go.bin", ["direct", "transitive"])]

@@ -236,3 +236,83 @@ def test_unsupported_filesystem_errors(tmp_path):
     from trivy_tpu.fanal.analyzers import AnalyzerGroup
     with pytest.raises(VMError, match="no supported filesystem"):
         walk_vm(FileDevice(str(img)), AnalyzerGroup())
+
+
+def _wrap_vmdk(tmp_path, fs_img):
+    """Monolithic-sparse VMDK of the raw fs image (64 KiB grains;
+    all-zero grains left unallocated like real VMDKs)."""
+    data = fs_img.read_bytes()
+    grain_bytes = 128 * SECTOR
+    capacity = (len(data) + SECTOR - 1) // SECTOR
+    n_grains = (capacity + 127) // 128
+    num_gtes = 512
+    n_gts = (n_grains + num_gtes - 1) // num_gtes
+    # layout: header (1 sector) | GD | GTs | grains
+    gd_off = 1
+    gd_sectors = (4 * n_gts + SECTOR - 1) // SECTOR
+    gt_off = gd_off + gd_sectors
+    gt_sectors_each = (4 * num_gtes) // SECTOR
+    data_off = gt_off + n_gts * gt_sectors_each
+    gd = [gt_off + i * gt_sectors_each for i in range(n_gts)]
+    gts = [[0] * num_gtes for _ in range(n_gts)]
+    grains = []
+    next_sector = data_off
+    for g in range(n_grains):
+        chunk = data[g * grain_bytes:(g + 1) * grain_bytes]
+        if not chunk.strip(b"\x00"):
+            continue  # unallocated
+        chunk = chunk.ljust(grain_bytes, b"\x00")
+        gts[g // num_gtes][g % num_gtes] = next_sector
+        grains.append(chunk)
+        next_sector += 128
+    hdr = b"KDMV" + struct.pack(
+        "<IIQQQQIQQQ", 1, 3, capacity, 128, 0, 0, num_gtes,
+        0, gd_off, data_off)
+    hdr = hdr.ljust(SECTOR, b"\x00")
+    out = tmp_path / "disk.vmdk"
+    with open(out, "wb") as f:
+        f.write(hdr)
+        gd_raw = struct.pack(f"<{n_gts}I", *gd)
+        f.write(gd_raw.ljust(gd_sectors * SECTOR, b"\x00"))
+        for gt in gts:
+            f.write(struct.pack(f"<{num_gtes}I", *gt))
+        for chunk in grains:
+            f.write(chunk)
+    return out
+
+
+def test_vmdk_sparse_image(tmp_path):
+    """VMDK monolithic-sparse wrapping (reference go-disk vmdk
+    support): same findings as the raw image."""
+    report = _scan(_wrap_vmdk(tmp_path, _mkfs(tmp_path)), tmp_path)
+    _assert_full_findings(report)
+
+
+def test_vmdk_device_zero_grains(tmp_path):
+    """Unallocated grains read back as zeros."""
+    from trivy_tpu.fanal.vm import VMDKDevice
+    img = tmp_path / "sparse.img"
+    data = bytearray(1 << 20)
+    data[0:4] = b"TEST"
+    data[(1 << 20) - 131072:(1 << 20) - 131072 + 4] = b"TAIL"
+    img.write_bytes(bytes(data))
+    vmdk = _wrap_vmdk(tmp_path, img)
+    dev = VMDKDevice(str(vmdk))
+    assert dev.size == 1 << 20
+    assert dev.read(0, 4) == b"TEST"
+    assert dev.read((1 << 20) - 131072, 4) == b"TAIL"
+    # middle grains were all-zero -> unallocated -> zeros
+    assert dev.read(1 << 19, 16) == b"\x00" * 16
+    dev.close()
+
+
+def test_vmdk_compressed_rejected(tmp_path):
+    """streamOptimized (compressed) VMDKs must be refused, not
+    misread as raw grains."""
+    from trivy_tpu.fanal.vm import VMDKDevice, VMError
+    hdr = b"KDMV" + struct.pack(
+        "<IIQQQQIQQQ", 1, 3 | 0x10000, 2048, 128, 0, 0, 512, 0, 1, 9)
+    img = tmp_path / "stream.vmdk"
+    img.write_bytes(hdr.ljust(512, b"\x00"))
+    with pytest.raises(VMError, match="streamOptimized"):
+        VMDKDevice(str(img))

@@ -217,7 +217,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--endpoint", default="",
                    help="API endpoint override (e.g. LocalStack)")
     p.add_argument("--services", default="",
-                   help="comma-separated services (s3,ec2); default all")
+                   help="comma-separated services (s3,ec2,ebs,rds,"
+                        "cloudtrail,efs,elb,iam); default all")
     p.add_argument("--account", default="")
     p.add_argument("--update-cache", action="store_true")
     p.add_argument("--max-cache-age", default="24h",

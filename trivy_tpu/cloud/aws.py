@@ -8,7 +8,9 @@ the same checks the terraform/cloudformation scanners use, which is
 exactly how the reference reuses its iac rules over live accounts
 (pkg/cloud/aws/scanner/scanner.go:29).
 
-Services covered: s3, ec2 (security groups), sts (account discovery).
+Services covered: s3, ec2 (security groups + instances), ebs, rds,
+cloudtrail, efs, elb (v2), iam (customer-managed policies), and sts
+(account discovery).
 """
 
 from __future__ import annotations
@@ -26,7 +28,8 @@ from ..iac.core import build_misconf
 from ..log import logger
 from .sigv4 import sign
 
-SUPPORTED_SERVICES = ["s3", "ec2"]
+SUPPORTED_SERVICES = ["s3", "ec2", "ebs", "rds", "cloudtrail",
+                      "efs", "elb", "iam"]
 CACHE_VERSION = 1
 
 
@@ -158,13 +161,8 @@ def walk_s3(client: AWSClient) -> list[CloudResource]:
 
 def walk_ec2(client: AWSClient) -> list[CloudResource]:
     out = []
-    body = urllib.parse.urlencode({
-        "Action": "DescribeSecurityGroups",
-        "Version": "2016-11-15"}).encode()
-    doc = _xml(client.request(
-        "ec2", method="POST", body=body,
-        headers={"content-type":
-                 "application/x-www-form-urlencoded; charset=utf-8"}))
+    doc = _query_api(client, "ec2", "DescribeSecurityGroups",
+                     "2016-11-15")
     for item in doc.findall(".//securityGroupInfo/item"):
         name = _txt(item, "groupName")
         r = CloudResource("aws_security_group", name)
@@ -191,21 +189,169 @@ def walk_ec2(client: AWSClient) -> list[CloudResource]:
     return out
 
 
+def _query_api(client: AWSClient, service: str, action: str,
+               version: str, extra: dict | None = None) -> ET.Element:
+    """AWS query-protocol POST (ec2/rds/elbv2/iam style) → XML root."""
+    fields = {"Action": action, "Version": version}
+    fields.update(extra or {})
+    body = urllib.parse.urlencode(fields).encode()
+    return _xml(client.request(
+        service, method="POST", body=body,
+        headers={"content-type":
+                 "application/x-www-form-urlencoded; charset=utf-8"}))
+
+
+def walk_ec2_instances(client: AWSClient) -> list[CloudResource]:
+    """DescribeInstances → aws_instance state (IMDSv2, root/EBS
+    encryption feed the shared AVD-AWS checks)."""
+    out = []
+    doc = _query_api(client, "ec2", "DescribeInstances", "2016-11-15")
+    for item in doc.findall(".//reservationSet/item/instancesSet/item"):
+        iid = _txt(item, "instanceId")
+        r = CloudResource("aws_instance", iid)
+        mo = item.find("metadataOptions")
+        if mo is not None:
+            r.attrs["metadata_options"] = Attr({
+                "http_tokens": _txt(mo, "httpTokens", "optional"),
+                "http_endpoint": _txt(mo, "httpEndpoint", "enabled"),
+            })
+        out.append(r)
+    return out
+
+
+def walk_ebs(client: AWSClient) -> list[CloudResource]:
+    out = []
+    doc = _query_api(client, "ec2", "DescribeVolumes", "2016-11-15")
+    for item in doc.findall(".//volumeSet/item"):
+        r = CloudResource("aws_ebs_volume", _txt(item, "volumeId"))
+        r.attrs["encrypted"] = Attr(_txt(item, "encrypted") == "true")
+        out.append(r)
+    return out
+
+
+def walk_rds(client: AWSClient) -> list[CloudResource]:
+    out = []
+    doc = _query_api(client, "rds", "DescribeDBInstances", "2014-10-31")
+    for item in doc.findall(".//DBInstances/DBInstance"):
+        name = _txt(item, "DBInstanceIdentifier")
+        r = CloudResource("aws_db_instance", name)
+        r.attrs["storage_encrypted"] = Attr(
+            _txt(item, "StorageEncrypted") == "true")
+        r.attrs["backup_retention_period"] = Attr(
+            int(_txt(item, "BackupRetentionPeriod", "0") or 0))
+        r.attrs["publicly_accessible"] = Attr(
+            _txt(item, "PubliclyAccessible") == "true")
+        if _txt(item, "ReadReplicaSourceDBInstanceIdentifier"):
+            r.attrs["replicate_source_db"] = Attr(True)
+        out.append(r)
+    return out
+
+
+def walk_cloudtrail(client: AWSClient) -> list[CloudResource]:
+    """JSON API (x-amz-json-1.1): DescribeTrails."""
+    raw = client.request(
+        "cloudtrail", method="POST", body=b"{}",
+        headers={"Content-Type": "application/x-amz-json-1.1",
+                 "X-Amz-Target":
+                     "com.amazonaws.cloudtrail.v20131101."
+                     "CloudTrail_20131101.DescribeTrails"})
+    out = []
+    for t in json.loads(raw).get("trailList", []):
+        r = CloudResource("aws_cloudtrail", t.get("Name", ""))
+        r.attrs["is_multi_region_trail"] = Attr(
+            bool(t.get("IsMultiRegionTrail")))
+        r.attrs["enable_log_file_validation"] = Attr(
+            bool(t.get("LogFileValidationEnabled")))
+        if t.get("KmsKeyId"):
+            r.attrs["kms_key_id"] = Attr(t["KmsKeyId"])
+        out.append(r)
+    return out
+
+
+def walk_efs(client: AWSClient) -> list[CloudResource]:
+    """REST API: GET /2015-02-01/file-systems."""
+    raw = client.request("elasticfilesystem",
+                         path="/2015-02-01/file-systems")
+    out = []
+    for fs in json.loads(raw).get("FileSystems", []):
+        r = CloudResource("aws_efs_file_system",
+                          fs.get("FileSystemId", ""))
+        r.attrs["encrypted"] = Attr(bool(fs.get("Encrypted")))
+        out.append(r)
+    return out
+
+
+def walk_elb(client: AWSClient) -> list[CloudResource]:
+    out = []
+    doc = _query_api(client, "elasticloadbalancing",
+                     "DescribeLoadBalancers", "2015-12-01")
+    for item in doc.findall(".//LoadBalancers/member"):
+        name = _txt(item, "LoadBalancerName")
+        arn = _txt(item, "LoadBalancerArn")
+        r = CloudResource("aws_lb", name)
+        r.attrs["internal"] = Attr(
+            _txt(item, "Scheme") == "internal")
+        r.attrs["load_balancer_type"] = Attr(
+            _txt(item, "Type", "application"))
+        try:
+            attrs = _query_api(
+                client, "elasticloadbalancing",
+                "DescribeLoadBalancerAttributes", "2015-12-01",
+                {"LoadBalancerArn": arn})
+            for a in attrs.findall(".//Attributes/member"):
+                if _txt(a, "Key") == \
+                        "routing.http.drop_invalid_header_fields.enabled":
+                    r.attrs["drop_invalid_header_fields"] = Attr(
+                        _txt(a, "Value") == "true")
+        except AWSError:
+            pass
+        out.append(r)
+    return out
+
+
+def walk_iam(client: AWSClient) -> list[CloudResource]:
+    """Customer-managed policies: ListPolicies(Scope=Local) +
+    GetPolicyVersion → policy documents for the wildcard check."""
+    out = []
+    doc = _query_api(client, "iam", "ListPolicies", "2010-05-08",
+                     {"Scope": "Local"})
+    for item in doc.findall(".//Policies/member"):
+        arn = _txt(item, "Arn")
+        name = _txt(item, "PolicyName")
+        version = _txt(item, "DefaultVersionId", "v1")
+        r = CloudResource("aws_iam_policy", name)
+        try:
+            vdoc = _query_api(client, "iam", "GetPolicyVersion",
+                              "2010-05-08",
+                              {"PolicyArn": arn, "VersionId": version})
+            enc = _txt(vdoc, ".//Document")
+            if enc:
+                r.attrs["policy_document"] = Attr(
+                    urllib.parse.unquote(enc))
+        except AWSError:
+            pass
+        out.append(r)
+    return out
+
+
+def _walk_ec2_all(client: AWSClient) -> list[CloudResource]:
+    """ec2 service = security groups + instances."""
+    return walk_ec2(client) + walk_ec2_instances(client)
+
+
 def get_account_id(client: AWSClient) -> str:
-    body = urllib.parse.urlencode({
-        "Action": "GetCallerIdentity", "Version": "2011-06-15"}).encode()
     try:
-        doc = _xml(client.request(
-            "sts", method="POST", body=body,
-            headers={"content-type":
-                     "application/x-www-form-urlencoded; "
-                     "charset=utf-8"}))
+        doc = _query_api(client, "sts", "GetCallerIdentity",
+                         "2011-06-15")
         return _txt(doc, ".//Account", "unknown")
     except AWSError:
         return "unknown"
 
 
-WALKERS = {"s3": walk_s3, "ec2": walk_ec2}
+WALKERS = {"s3": walk_s3, "ec2": _walk_ec2_all, "ebs": walk_ebs,
+           "rds": walk_rds, "cloudtrail": walk_cloudtrail,
+           "efs": walk_efs, "elb": walk_elb, "iam": walk_iam}
+
 
 
 # ---- account-state cache (pkg/cloud/aws/cache) ------------------------

@@ -21,12 +21,24 @@ class ExecutableAnalyzer(Analyzer):
     name = "executable"
     version = 1
 
+    # extensions that are never native executables; everything else
+    # (including dotted names like python3.11) gets magic-sniffed
+    _SKIP_EXT = frozenset((
+        "txt", "md", "json", "yaml", "yml", "xml", "html", "css",
+        "js", "ts", "py", "rb", "sh", "pl", "php", "go", "rs", "c",
+        "h", "cpp", "java", "conf", "cfg", "toml", "ini", "env",
+        "pem", "crt", "key", "pub", "png", "jpg", "jpeg", "gif",
+        "svg", "ico", "gz", "bz2", "xz", "zip", "tar", "tgz", "jar",
+        "log", "lock", "sum", "mod", "sql", "csv", "proto"))
+
     def required(self, path: str, size: int = -1) -> bool:
-        # executables rarely carry extensions; cheap name gate here,
-        # magic sniffed in analyze (reference gates on the executable
-        # file mode, which tar/fs walks don't always preserve)
+        # cheap pre-filter only — the ELF/Mach-O/PE magic check in
+        # analyze() is the real gate (the reference gates on the
+        # executable file mode, which tar/fs walks don't always
+        # preserve)
         base = path.rsplit("/", 1)[-1]
-        return "." not in base and size != 0
+        ext = base.rsplit(".", 1)[-1].lower() if "." in base else ""
+        return size != 0 and ext not in self._SKIP_EXT
 
     def analyze(self, path: str,
                 content: bytes) -> Optional[AnalysisResult]:

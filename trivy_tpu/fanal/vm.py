@@ -11,8 +11,11 @@ device abstraction so local files and EBS snapshots (direct APIs:
 ListSnapshotBlocks/GetSnapshotBlock over sigv4) share the walker —
 the reference's ebs:snap-… source (walker/vm.go:195, artifact/vm/ebs.go).
 
+Virtual-disk wrapping: VMware monolithic-sparse VMDK extents are
+mapped grain-by-grain (the reference's go-disk stack does the same);
 xfs/btrfs partitions are skipped with a warning (the reference's
-go-disk stack covers xfs; ours does not yet).
+go-xfs-filesystem covers xfs; no testable fixture exists in this
+environment to validate a reimplementation against).
 """
 
 from __future__ import annotations
@@ -358,8 +361,83 @@ def walk_vm(dev, group, collect_secrets: bool = False,
     return scan
 
 
+class VMDKDevice:
+    """VMware monolithic-sparse VMDK as a block device (reference
+    disk stack: masahiro331/go-vmdk-parser via go-disk). The sparse
+    extent maps the virtual disk in grains (typically 64 KiB) through
+    a grain directory -> grain table hierarchy; entry 0 means an
+    unallocated (zero) grain."""
+
+    MAGIC = b"KDMV"
+
+    def __init__(self, path: str):
+        self._f = open(path, "rb")
+        hdr = self._f.read(512)
+        if hdr[:4] != self.MAGIC:
+            self._f.close()
+            raise VMError("not a sparse VMDK")
+        import struct
+        (_ver, flags, capacity, grain_size, _desc_off, _desc_sz,
+         num_gtes, _rgd_off, gd_off) = struct.unpack_from(
+            "<IIQQQQIQQ", hdr, 4)
+        if flags & 0x10000:
+            # streamOptimized: grains are deflate-compressed behind
+            # markers; reading them as raw sectors produces garbage
+            self._f.close()
+            raise VMError("compressed (streamOptimized) VMDK "
+                          "unsupported; convert to monolithic sparse")
+        self.size = capacity * 512
+        self._grain_bytes = grain_size * 512
+        self._num_gtes = num_gtes
+        self._f.seek(gd_off * 512)
+        n_grains = -(-capacity // grain_size)
+        n_gts = -(-n_grains // num_gtes)
+        gd_raw = self._f.read(4 * n_gts)
+        self._gd = struct.unpack(f"<{n_gts}I", gd_raw)
+        self._gt_cache: dict[int, tuple] = {}
+
+    def _grain_offset(self, grain: int) -> int:
+        """-> file offset of the grain's data, or 0 if unallocated."""
+        import struct
+        gd_idx, gt_idx = divmod(grain, self._num_gtes)
+        if gd_idx >= len(self._gd) or self._gd[gd_idx] == 0:
+            return 0
+        gt = self._gt_cache.get(gd_idx)
+        if gt is None:
+            self._f.seek(self._gd[gd_idx] * 512)
+            gt = struct.unpack(
+                f"<{self._num_gtes}I",
+                self._f.read(4 * self._num_gtes))
+            self._gt_cache[gd_idx] = gt
+        return gt[gt_idx] * 512
+
+    def read(self, offset: int, size: int) -> bytes:
+        out = bytearray()
+        end = min(offset + size, self.size)
+        while offset < end:
+            grain, within = divmod(offset, self._grain_bytes)
+            n = min(end - offset, self._grain_bytes - within)
+            data_off = self._grain_offset(grain)
+            if data_off == 0:
+                out += b"\x00" * n
+            else:
+                self._f.seek(data_off + within)
+                chunk = self._f.read(n)
+                out += chunk + b"\x00" * (n - len(chunk))
+            offset += n
+        return bytes(out)
+
+    def close(self):
+        self._f.close()
+
+
 def open_device(target: str):
-    """'ebs:snap-…' → EBSDevice; anything else → local file."""
+    """'ebs:snap-…' → EBSDevice; *.vmdk sparse extents → VMDKDevice;
+    anything else → raw local file."""
     if target.startswith("ebs:"):
         return EBSDevice(target[len("ebs:"):])
+    with open(target, "rb") as f:
+        magic = f.read(4)
+    if magic == VMDKDevice.MAGIC:
+        return VMDKDevice(target)
     return FileDevice(target)

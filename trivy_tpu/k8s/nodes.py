@@ -168,11 +168,22 @@ def collect_node_info(client: KubeClient, node_name: str,
                     raise KubeError(
                         f"node-collector output unparseable on "
                         f"{node_name}")
+            # a Failed pod alone is not terminal: backoffLimit permits
+            # a retry — only the Job's own Failed condition is final
             failed = [p for p in pods
                       if p.get("status", {}).get("phase") == "Failed"]
             if failed:
-                raise KubeError(
-                    f"node-collector failed on {node_name}")
+                try:
+                    job = client.get(
+                        f"/apis/batch/v1/namespaces/{namespace}"
+                        f"/jobs/{job_name}")
+                except KubeError:
+                    job = {}
+                conds = job.get("status", {}).get("conditions", [])
+                if any(c.get("type") == "Failed"
+                       and c.get("status") == "True" for c in conds):
+                    raise KubeError(
+                        f"node-collector failed on {node_name}")
             if time.monotonic() > deadline:
                 raise KubeError(
                     f"node-collector timed out on {node_name}")
@@ -196,12 +207,15 @@ def _eval_check(kind, expected, values):
     v = values[0]
     if kind == "perm":
         # the collector reports octal permissions as decimal-looking
-        # values (600 means 0o600), whether int or string
+        # values (600 means 0o600), whether int or string. Restrictive
+        # means NO permission bit outside the allowed mask — a numeric
+        # <= compare would pass modes like 577 (world-writable) against
+        # 600 (383 < 384)
         try:
             have = int(str(v), 8)
         except (ValueError, TypeError):
             return None
-        return have <= expected
+        return (have & ~expected) == 0
     if kind == "owner":
         return v == expected
     if kind == "arg":

@@ -50,12 +50,20 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
                                     file_path=comp.get("name", ""))
                 apps[comp.get("bom-ref", comp.get("name", ""))] = app
                 explicit_apps.append(app)
-    owner_of: dict[str, str] = {}
+    # transitive closure: libraries reached through other libraries
+    # still belong to the application at the root of their chain
+    edges: dict[str, list] = {}
     for dep in doc.get("dependencies") or []:
-        ref = dep.get("ref")
-        if ref in apps:
-            for child in dep.get("dependsOn") or []:
-                owner_of.setdefault(child, ref)
+        edges[dep.get("ref")] = list(dep.get("dependsOn") or [])
+    owner_of: dict[str, str] = {}
+    for root in (r for r in apps if r in edges):
+        stack = list(edges[root])
+        while stack:
+            child = stack.pop()
+            if child in owner_of or child in apps:
+                continue
+            owner_of[child] = root
+            stack.extend(edges.get(child, []))
 
     for comp in components:
         ctype = comp.get("type", "")
