@@ -265,3 +265,33 @@ def test_scan_account_breadth(fake_aws, tmp_path):
     svc_targets = {r.target for r in results}
     assert any(":rds:" in t for t in svc_targets)
     assert any(":iam:" in t for t in svc_targets)
+
+
+def test_paged_query_follows_tokens():
+    """Walkers must follow pagination tokens — dropping page 2 would
+    cache partial account state as complete."""
+    from trivy_tpu.cloud.aws import _paged_query
+
+    class StubClient:
+        def __init__(self):
+            self.calls = []
+
+        def request(self, service, method="GET", path="/", query=None,
+                    body=b"", headers=None):
+            self.calls.append(body.decode())
+            if b"Marker=page2" in body:
+                return (b"<R><Policies><member><PolicyName>p2"
+                        b"</PolicyName></member></Policies></R>")
+            return (b"<R><Policies><member><PolicyName>p1"
+                    b"</PolicyName></member></Policies>"
+                    b"<Marker>page2</Marker></R>")
+
+    stub = StubClient()
+    names = []
+    for doc in _paged_query(stub, "iam", "ListPolicies", "2010-05-08",
+                            req_token="Marker",
+                            resp_paths=(".//Marker",)):
+        names += [m.text for m in doc.findall(".//PolicyName")]
+    assert names == ["p1", "p2"]
+    assert len(stub.calls) == 2
+    assert "Marker=page2" in stub.calls[1]

@@ -1,0 +1,139 @@
+"""Helm chart failure-set parity against the reference's integration
+goldens (integration/repo_test.go helm cases).
+
+Full byte-parity needs the complete ~139-check KSV bundle with exact
+per-kind selector semantics (success COUNTS depend on every check we
+haven't implemented); what IS provable with the implemented subset is
+that every failing check the reference reports on these charts also
+fails here, per rendered file, with no extra failures from the checks
+both sides share. The goldens are byte-identical vendored copies."""
+
+import json
+import os
+
+import pytest
+
+from trivy_tpu.iac.helm import (load_chart_dir, load_chart_tgz,
+                                scan_rendered_chart)
+
+HERE = os.path.dirname(__file__)
+GOLDEN = os.path.join(HERE, "golden")
+INPUTS = os.path.join(GOLDEN, "inputs")
+
+
+def _chart_files(root):
+    out = {}
+    for dirpath, _dirs, names in os.walk(root):
+        for n in names:
+            p = os.path.join(dirpath, n)
+            rel = os.path.relpath(p, root)
+            with open(p, "rb") as f:
+                out[rel.replace(os.sep, "/")] = f.read()
+    return out
+
+
+def _golden_failures(name):
+    with open(os.path.join(GOLDEN, name)) as f:
+        doc = json.load(f)
+    out = {}
+    for r in doc.get("Results", []):
+        ids = sorted(m["ID"] for m in r.get("Misconfigurations") or [])
+        out[r["Target"]] = ids
+    return out
+
+
+def _our_failures(records, target_map=None):
+    out = {}
+    for rec in records:
+        target = rec.file_path
+        if target_map:
+            target = target_map(target)
+        out.setdefault(target, [])
+        out[target] += [m.id for m in rec.failures]
+    return {t: sorted(ids) for t, ids in out.items()}
+
+
+def _assert_failure_parity(golden, ours):
+    assert set(ours) <= set(golden), \
+        f"extra targets: {set(ours) - set(golden)}"
+    for target, want_ids in golden.items():
+        got = ours.get(target, [])
+        # every reference failure must fire here too
+        assert got == want_ids, (target, got, want_ids)
+
+
+def test_helm_testchart_failure_parity():
+    files = _chart_files(os.path.join(INPUTS, "helm_testchart"))
+    chart = load_chart_dir(files)
+    records = scan_rendered_chart(chart)
+    ours = _our_failures(records)
+    golden = _golden_failures("helm_testchart.json.golden")
+    _assert_failure_parity(golden, ours)
+
+
+def test_helm_testchart_overridden_set():
+    files = _chart_files(os.path.join(INPUTS, "helm_testchart"))
+    chart = load_chart_dir(files)
+    records = scan_rendered_chart(
+        chart, values_override={"securityContext": {"runAsUser": 0}})
+    ours = _our_failures(records)
+    golden = _golden_failures("helm_testchart.overridden.json.golden")
+    _assert_failure_parity(golden, ours)
+
+
+def test_helm_testchart_overridden_values_file():
+    import yaml
+
+    from trivy_tpu.iac.helm import set_helm_overrides
+    files = _chart_files(os.path.join(INPUTS, "helm_testchart"))
+    chart = load_chart_dir(files)
+    set_helm_overrides(values_files=[
+        os.path.join(INPUTS, "helm_values", "values.yaml")])
+    try:
+        records = scan_rendered_chart(chart)
+    finally:
+        set_helm_overrides()
+    ours = _our_failures(records)
+    golden = _golden_failures("helm_testchart.overridden.json.golden")
+    _assert_failure_parity(golden, ours)
+
+
+def test_helm_tgz_failure_parity():
+    with open(os.path.join(INPUTS, "helm", "testchart.tar.gz"),
+              "rb") as f:
+        chart = load_chart_tgz(f.read())
+    records = scan_rendered_chart(chart)
+    # golden targets look like "testchart.tar.gz:templates/pod.yaml"
+    ours = _our_failures(
+        records, target_map=lambda t: f"testchart.tar.gz:{t}")
+    golden = _golden_failures("helm.json.golden")
+    _assert_failure_parity(golden, ours)
+
+
+def test_helm_badname_failure_parity():
+    files = _chart_files(os.path.join(INPUTS, "helm_badname"))
+    chart = load_chart_dir(files)
+    records = scan_rendered_chart(chart)
+    ours = _our_failures(records)
+    golden = _golden_failures("helm_badname.json.golden")
+    _assert_failure_parity(golden, ours)
+
+
+def test_dockerfile_failure_parity():
+    from trivy_tpu.misconf.dockerfile import scan_dockerfile
+    with open(os.path.join(INPUTS, "dockerfile", "Dockerfile"),
+              "rb") as f:
+        failures, _succ = scan_dockerfile("Dockerfile", f.read())
+    golden = _golden_failures("dockerfile.json.golden")
+    assert sorted(m.id for m in failures) == golden["Dockerfile"]
+
+
+def test_dockerfile_file_pattern_failure_parity():
+    """--file-patterns routes non-standard names into the dockerfile
+    scanner (reference dockerfile_file_pattern.json.golden)."""
+    from trivy_tpu.misconf.dockerfile import scan_dockerfile
+    with open(os.path.join(INPUTS, "dockerfile_file_pattern",
+                           "Customfile"), "rb") as f:
+        failures, _succ = scan_dockerfile("Customfile", f.read())
+    golden = _golden_failures("dockerfile_file_pattern.json.golden")
+    assert sorted(m.id for m in failures) == golden["Customfile"]

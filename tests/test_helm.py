@@ -102,7 +102,7 @@ def test_scan_chart_produces_k8s_findings():
     assert len(records) == 1
     rec = records[0]
     assert rec.file_type == "helm"
-    assert rec.file_path == "testchart/templates/deployment.yaml"
+    assert rec.file_path == "templates/deployment.yaml"
     ids = {f.id for f in rec.failures}
     # rendered deployment has no runAsNonRoot etc. → KSV findings
     assert "KSV012" in ids

@@ -161,8 +161,14 @@ def walk_s3(client: AWSClient) -> list[CloudResource]:
 
 def walk_ec2(client: AWSClient) -> list[CloudResource]:
     out = []
-    doc = _query_api(client, "ec2", "DescribeSecurityGroups",
-                     "2016-11-15")
+    for doc in _paged_query(client, "ec2", "DescribeSecurityGroups",
+                            "2016-11-15"):
+        out += _parse_sgs(doc)
+    return out
+
+
+def _parse_sgs(doc) -> list[CloudResource]:
+    out = []
     for item in doc.findall(".//securityGroupInfo/item"):
         name = _txt(item, "groupName")
         r = CloudResource("aws_security_group", name)
@@ -201,11 +207,42 @@ def _query_api(client: AWSClient, service: str, action: str,
                  "application/x-www-form-urlencoded; charset=utf-8"}))
 
 
+_MAX_PAGES = 100
+
+
+def _paged_query(client: AWSClient, service: str, action: str,
+                 version: str, extra: dict | None = None,
+                 req_token: str = "NextToken",
+                 resp_paths: tuple = (".//nextToken",)):
+    """Yield every page of a query-protocol listing. Resources beyond
+    the first page would otherwise be silently dropped — and then
+    cached as complete account state for the TTL."""
+    fields = dict(extra or {})
+    for _ in range(_MAX_PAGES):
+        doc = _query_api(client, service, action, version, fields)
+        yield doc
+        token = ""
+        for p in resp_paths:
+            token = _txt(doc, p)
+            if token:
+                break
+        if not token:
+            return
+        fields[req_token] = token
+
+
 def walk_ec2_instances(client: AWSClient) -> list[CloudResource]:
     """DescribeInstances → aws_instance state (IMDSv2, root/EBS
     encryption feed the shared AVD-AWS checks)."""
     out = []
-    doc = _query_api(client, "ec2", "DescribeInstances", "2016-11-15")
+    for doc in _paged_query(client, "ec2", "DescribeInstances",
+                            "2016-11-15"):
+        out += _parse_instances(doc)
+    return out
+
+
+def _parse_instances(doc) -> list[CloudResource]:
+    out = []
     for item in doc.findall(".//reservationSet/item/instancesSet/item"):
         iid = _txt(item, "instanceId")
         r = CloudResource("aws_instance", iid)
@@ -221,7 +258,14 @@ def walk_ec2_instances(client: AWSClient) -> list[CloudResource]:
 
 def walk_ebs(client: AWSClient) -> list[CloudResource]:
     out = []
-    doc = _query_api(client, "ec2", "DescribeVolumes", "2016-11-15")
+    for doc in _paged_query(client, "ec2", "DescribeVolumes",
+                            "2016-11-15"):
+        out += _parse_volumes(doc)
+    return out
+
+
+def _parse_volumes(doc) -> list[CloudResource]:
+    out = []
     for item in doc.findall(".//volumeSet/item"):
         r = CloudResource("aws_ebs_volume", _txt(item, "volumeId"))
         r.attrs["encrypted"] = Attr(_txt(item, "encrypted") == "true")
@@ -231,7 +275,15 @@ def walk_ebs(client: AWSClient) -> list[CloudResource]:
 
 def walk_rds(client: AWSClient) -> list[CloudResource]:
     out = []
-    doc = _query_api(client, "rds", "DescribeDBInstances", "2014-10-31")
+    for doc in _paged_query(client, "rds", "DescribeDBInstances",
+                            "2014-10-31", req_token="Marker",
+                            resp_paths=(".//Marker",)):
+        out += _parse_dbs(doc)
+    return out
+
+
+def _parse_dbs(doc) -> list[CloudResource]:
+    out = []
     for item in doc.findall(".//DBInstances/DBInstance"):
         name = _txt(item, "DBInstanceIdentifier")
         r = CloudResource("aws_db_instance", name)
@@ -269,22 +321,38 @@ def walk_cloudtrail(client: AWSClient) -> list[CloudResource]:
 
 
 def walk_efs(client: AWSClient) -> list[CloudResource]:
-    """REST API: GET /2015-02-01/file-systems."""
-    raw = client.request("elasticfilesystem",
-                         path="/2015-02-01/file-systems")
+    """REST API: GET /2015-02-01/file-systems (Marker-paginated)."""
     out = []
-    for fs in json.loads(raw).get("FileSystems", []):
-        r = CloudResource("aws_efs_file_system",
-                          fs.get("FileSystemId", ""))
-        r.attrs["encrypted"] = Attr(bool(fs.get("Encrypted")))
-        out.append(r)
+    query = {}
+    for _ in range(_MAX_PAGES):
+        raw = client.request("elasticfilesystem",
+                             path="/2015-02-01/file-systems",
+                             query=query)
+        body = json.loads(raw)
+        for fs in body.get("FileSystems", []):
+            r = CloudResource("aws_efs_file_system",
+                              fs.get("FileSystemId", ""))
+            r.attrs["encrypted"] = Attr(bool(fs.get("Encrypted")))
+            out.append(r)
+        marker = body.get("NextMarker")
+        if not marker:
+            break
+        query = {"Marker": marker}
     return out
 
 
 def walk_elb(client: AWSClient) -> list[CloudResource]:
     out = []
-    doc = _query_api(client, "elasticloadbalancing",
-                     "DescribeLoadBalancers", "2015-12-01")
+    for doc in _paged_query(client, "elasticloadbalancing",
+                            "DescribeLoadBalancers", "2015-12-01",
+                            req_token="Marker",
+                            resp_paths=(".//NextMarker",)):
+        out += _parse_lbs(client, doc)
+    return out
+
+
+def _parse_lbs(client: AWSClient, doc) -> list[CloudResource]:
+    out = []
     for item in doc.findall(".//LoadBalancers/member"):
         name = _txt(item, "LoadBalancerName")
         arn = _txt(item, "LoadBalancerArn")
@@ -313,8 +381,16 @@ def walk_iam(client: AWSClient) -> list[CloudResource]:
     """Customer-managed policies: ListPolicies(Scope=Local) +
     GetPolicyVersion → policy documents for the wildcard check."""
     out = []
-    doc = _query_api(client, "iam", "ListPolicies", "2010-05-08",
-                     {"Scope": "Local"})
+    for doc in _paged_query(client, "iam", "ListPolicies",
+                            "2010-05-08", {"Scope": "Local"},
+                            req_token="Marker",
+                            resp_paths=(".//Marker",)):
+        out += _parse_policies(client, doc)
+    return out
+
+
+def _parse_policies(client: AWSClient, doc) -> list[CloudResource]:
+    out = []
     for item in doc.findall(".//Policies/member"):
         arn = _txt(item, "Arn")
         name = _txt(item, "PolicyName")

@@ -371,30 +371,41 @@ class VMDKDevice:
     MAGIC = b"KDMV"
 
     def __init__(self, path: str):
-        self._f = open(path, "rb")
-        hdr = self._f.read(512)
-        if hdr[:4] != self.MAGIC:
-            self._f.close()
-            raise VMError("not a sparse VMDK")
         import struct
-        (_ver, flags, capacity, grain_size, _desc_off, _desc_sz,
-         num_gtes, _rgd_off, gd_off) = struct.unpack_from(
-            "<IIQQQQIQQ", hdr, 4)
-        if flags & 0x10000:
-            # streamOptimized: grains are deflate-compressed behind
-            # markers; reading them as raw sectors produces garbage
+        self._f = open(path, "rb")
+        try:
+            hdr = self._f.read(512)
+            if hdr[:4] != self.MAGIC:
+                raise VMError("not a sparse VMDK")
+            try:
+                (_ver, flags, capacity, grain_size, _desc_off,
+                 _desc_sz, num_gtes, _rgd_off, gd_off) = \
+                    struct.unpack_from("<IIQQQQIQQ", hdr, 4)
+            except struct.error as e:
+                raise VMError(f"truncated VMDK header: {e}") from None
+            if flags & 0x10000:
+                # streamOptimized: grains are deflate-compressed
+                # behind markers; raw-sector reads produce garbage
+                raise VMError("compressed (streamOptimized) VMDK "
+                              "unsupported; convert to monolithic "
+                              "sparse")
+            if grain_size <= 0 or num_gtes <= 0 or capacity <= 0:
+                raise VMError("malformed VMDK header "
+                              "(zero grain/table geometry)")
+            self.size = capacity * 512
+            self._grain_bytes = grain_size * 512
+            self._num_gtes = num_gtes
+            self._f.seek(gd_off * 512)
+            n_grains = -(-capacity // grain_size)
+            n_gts = -(-n_grains // num_gtes)
+            gd_raw = self._f.read(4 * n_gts)
+            if len(gd_raw) < 4 * n_gts:
+                raise VMError("truncated VMDK grain directory")
+            self._gd = struct.unpack(f"<{n_gts}I", gd_raw)
+            self._gt_cache: dict[int, tuple] = {}
+        except BaseException:
             self._f.close()
-            raise VMError("compressed (streamOptimized) VMDK "
-                          "unsupported; convert to monolithic sparse")
-        self.size = capacity * 512
-        self._grain_bytes = grain_size * 512
-        self._num_gtes = num_gtes
-        self._f.seek(gd_off * 512)
-        n_grains = -(-capacity // grain_size)
-        n_gts = -(-n_grains // num_gtes)
-        gd_raw = self._f.read(4 * n_gts)
-        self._gd = struct.unpack(f"<{n_gts}I", gd_raw)
-        self._gt_cache: dict[int, tuple] = {}
+            raise
 
     def _grain_offset(self, grain: int) -> int:
         """-> file offset of the grain's data, or 0 if unallocated."""

@@ -500,8 +500,13 @@ def scan_rendered_chart(chart: Chart,
             continue
         for f in failures:
             f.type = "helm"
+        # report chart-root-relative paths, the way the reference's
+        # helm scanner does (helm_testchart.json.golden targets are
+        # "templates/deployment.yaml", not "<chartname>/templates/…")
+        rel = rpath[len(chart.name) + 1:] \
+            if rpath.startswith(chart.name + "/") else rpath
         records.append(T.Misconfiguration(
-            file_type="helm", file_path=prefix + rpath,
+            file_type="helm", file_path=prefix + rel,
             successes=successes, failures=failures))
     return records
 

@@ -387,21 +387,90 @@ def _seccomp(ctx):
                    f" 'RuntimeDefault'", _rng(c, "securityContext", crng))
 
 
-@_k("KSV104", "Seccomp profile unconfined", "MEDIUM",
-    "Seccomp profile must not be explicitly set to 'Unconfined'.",
-    "Do not set seccomp profile to 'Unconfined'.")
-def _seccomp_unconfined(ctx):
-    scopes = [(ctx.spec.get("securityContext"), ctx.spec, "securityContext")]
-    scopes += [(_sec_ctx(c), c, "securityContext")
-               for c, _ in ctx.containers]
-    for sc, holder, key in scopes:
-        if not isinstance(sc, dict):
+@_k("KSV104", "Seccomp policies disabled", "MEDIUM",
+    "A program inside the container can bypass the Seccomp profile "
+    "protection policies.",
+    "Specify a seccomp profile (and never 'Unconfined') for every "
+    "container.")
+def _seccomp_disabled(ctx):
+    """Fires when a container's EFFECTIVE seccomp profile (container
+    securityContext, falling back to the pod's) is absent or
+    Unconfined — the reference golden fires this on charts with no
+    seccomp configuration at all (helm_testchart.json.golden)."""
+    pod_sc = ctx.spec.get("securityContext")
+    pod_type = ""
+    if isinstance(pod_sc, dict):
+        prof = pod_sc.get("seccompProfile")
+        if isinstance(prof, dict):
+            pod_type = str(prof.get("type", ""))
+    for c, crng in ctx.containers:
+        prof = _sec_ctx(c).get("seccompProfile")
+        ctype = str(prof.get("type", "")) if isinstance(prof, dict) \
+            else ""
+        eff = ctype or pod_type
+        if not eff or eff == "Unconfined":
+            yield (f"Container '{_cname(c)}' of {ctx.kind} "
+                   f"'{ctx.name}' should specify a seccomp profile",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV105", "Containers must not set runAsUser to 0", "LOW",
+    "Containers should be forbidden from running with a root UID.",
+    "Set 'securityContext.runAsUser' to a non-zero integer.")
+def _run_as_root_uid(ctx):
+    pod_sc = ctx.spec.get("securityContext")
+    pod_uid = pod_sc.get("runAsUser") if isinstance(pod_sc, dict) \
+        else None
+    for c, crng in ctx.containers:
+        uid = _sec_ctx(c).get("runAsUser", pod_uid)
+        if uid == 0:
+            yield ("securityContext.runAsUser should be set to a "
+                   "value greater than 0",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV106", "Container capabilities must only include "
+    "NET_BIND_SERVICE", "LOW",
+    "Containers must drop ALL capabilities, and are only permitted to "
+    "add back the NET_BIND_SERVICE capability.",
+    "Set 'securityContext.capabilities.drop' to ['ALL'] and only add "
+    "'NET_BIND_SERVICE' back if needed.")
+def _caps_net_bind_only(ctx):
+    for c, crng in ctx.containers:
+        caps = _sec_ctx(c).get("capabilities")
+        caps = caps if isinstance(caps, dict) else {}
+        drop = caps.get("drop") or []
+        add = caps.get("add") or []
+        if not (isinstance(drop, list) and
+                any(str(d).upper() == "ALL" for d in drop)):
+            yield ("container should drop all",
+                   _rng(c, "securityContext", crng))
+        if isinstance(add, list) and any(
+                str(a).upper() != "NET_BIND_SERVICE" for a in add):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} "
+                   f"'{ctx.name}' should only add the "
+                   f"'NET_BIND_SERVICE' capability",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV117", "Prevent binding to privileged ports", "HIGH",
+    "Privileged ports (below 1024) require escalated privileges to "
+    "bind, and binding them in containers suggests running with more "
+    "privilege than needed.",
+    "Use container ports of 1024 or above.")
+def _privileged_ports(ctx):
+    for c, crng in ctx.containers:
+        ports = c.get("ports")
+        if not isinstance(ports, list):
             continue
-        prof = sc.get("seccompProfile")
-        if isinstance(prof, dict) and \
-                str(prof.get("type", "")) == "Unconfined":
-            yield (f"{ctx.kind} '{ctx.name}' should not set seccomp "
-                   f"profile to 'Unconfined'", value_range(holder, key))
+        for p in ports:
+            port = p.get("containerPort") if isinstance(p, dict) \
+                else None
+            if isinstance(port, int) and 0 < port < 1024:
+                yield (f"{ctx.kind.lower()} {ctx.name} should not set "
+                       f"spec.template.spec.containers.ports."
+                       f"containerPort to {port}",
+                       _rng(c, "ports", crng))
 
 
 @_k("KSV002", "Default AppArmor profile not set", "MEDIUM",
@@ -480,7 +549,10 @@ def _root_gid(ctx):
     "mounted by setting automountServiceAccountToken: false.",
     "Set 'spec.automountServiceAccountToken' to false.")
 def _sa_token(ctx):
-    if ctx.spec.get("automountServiceAccountToken") is not False:
+    # fires only on an EXPLICIT true: the reference's rego leaves the
+    # unset default alone (helm_testchart.json.golden evaluates this
+    # check as a success on a chart that never sets it)
+    if ctx.spec.get("automountServiceAccountToken") is True:
         yield (f"{ctx.kind} '{ctx.name}' should set "
                f"'spec.automountServiceAccountToken' to false",
                value_range(ctx.spec, "automountServiceAccountToken",
