@@ -353,3 +353,57 @@ class TestAnalyzerRouting:
         mc = res.misconfigurations[0]
         assert mc.file_type == "kubernetes"
         assert any(f.id == "KSV017" for f in mc.failures)
+
+
+def test_ksv_breadth_round4():
+    """Round-4 KSV additions: host-surface, sysctl, namespace, and the
+    RBAC (Role/ClusterRole) family."""
+    from trivy_tpu.iac.kubernetes import scan_kubernetes
+    text = b"""\
+apiVersion: v1
+kind: Pod
+metadata:
+  name: risky
+  namespace: kube-system
+spec:
+  hostAliases:
+    - ip: "1.2.3.4"
+      hostnames: ["evil"]
+  securityContext:
+    sysctls:
+      - name: kernel.msgmax
+        value: "65536"
+  volumes:
+    - name: sock
+      hostPath:
+        path: /var/run/docker.sock
+  containers:
+    - name: app
+      image: nginx:1.2
+      ports:
+        - containerPort: 8080
+          hostPort: 80
+      securityContext:
+        procMount: Unmasked
+        capabilities:
+          add: ["SYS_ADMIN"]
+          drop: ["ALL"]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata:
+  name: too-mighty
+rules:
+  - apiGroups: [""]
+    resources: ["secrets"]
+    verbs: ["get", "list"]
+  - apiGroups: ["*"]
+    resources: ["*"]
+    verbs: ["*", "impersonate"]
+"""
+    failures, _succ = scan_kubernetes("pod.yaml", text)
+    ids = {f.id for f in failures}
+    for want in ("KSV005", "KSV006", "KSV007", "KSV024", "KSV026",
+                 "KSV027", "KSV037", "KSV041", "KSV044", "KSV045",
+                 "KSV047"):
+        assert want in ids, want

@@ -100,3 +100,78 @@ class TestEndToEnd:
         assert mc.failures[0].id == failures[0].id
         assert mc.failures[0].cause_metadata.start_line == \
             failures[0].cause_metadata.start_line
+
+
+def test_ds_breadth_checks():
+    """The round-4 DS additions (stage-aware multi-instruction rules,
+    package-manager hygiene, deprecations)."""
+    from trivy_tpu.misconf.dockerfile import scan_dockerfile
+    content = b"""\
+FROM alpine:3.17 AS build
+COPY --from=build /src /dst
+ENTRYPOINT ["a"]
+ENTRYPOINT ["b"]
+FROM ubuntu:22.04 AS build
+MAINTAINER someone@example.com
+EXPOSE 99999
+WORKDIR app
+RUN sudo make install
+RUN yum install -y vim
+RUN apt-get install curl
+RUN wget http://x
+RUN curl http://y
+COPY a b c
+CMD ["x"]
+CMD ["y"]
+HEALTHCHECK CMD true
+HEALTHCHECK CMD false
+USER app
+"""
+    failures, _ = scan_dockerfile("Dockerfile", content)
+    ids = {m.id for m in failures}
+    for want in ("DS006", "DS007", "DS008", "DS009", "DS010", "DS011",
+                 "DS012", "DS014", "DS015", "DS016", "DS021", "DS022",
+                 "DS023", "DS029"):
+        assert want in ids, want
+    # stage-aware: one ENTRYPOINT/CMD per stage is fine
+    failures2, _ = scan_dockerfile("Dockerfile", b"""\
+FROM alpine:3.17 AS a
+ENTRYPOINT ["x"]
+CMD ["y"]
+FROM alpine:3.17 AS b
+ENTRYPOINT ["x"]
+CMD ["y"]
+USER app
+HEALTHCHECK CMD true
+""")
+    ids2 = {m.id for m in failures2}
+    assert "DS007" not in ids2 and "DS016" not in ids2
+    assert "DS012" not in ids2  # distinct aliases... a vs b
+
+
+def test_ds_review_regressions():
+    """FROM flags keep their alias; exec-form COPY parses; per-stage
+    wget/curl and HEALTHCHECK counting."""
+    from trivy_tpu.misconf.dockerfile import scan_dockerfile
+    failures, _ = scan_dockerfile("Dockerfile", b"""\
+FROM --platform=linux/amd64 alpine:3.17 AS build
+COPY --from=build /a /b
+USER app
+HEALTHCHECK CMD true
+""")
+    assert "DS006" in {m.id for m in failures}
+
+    failures2, _ = scan_dockerfile("Dockerfile", b"""\
+FROM alpine:3.17 AS one
+RUN wget http://x
+HEALTHCHECK CMD true
+FROM alpine:3.17 AS two
+RUN curl http://y
+COPY ["a", "b", "dst/"]
+USER app
+HEALTHCHECK CMD true
+""")
+    ids = {m.id for m in failures2}
+    assert "DS014" not in ids   # one tool per stage
+    assert "DS023" not in ids   # one HEALTHCHECK per stage
+    assert "DS011" not in ids   # exec-form dest ends with /

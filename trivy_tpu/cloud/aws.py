@@ -229,6 +229,10 @@ def _paged_query(client: AWSClient, service: str, action: str,
         if not token:
             return
         fields[req_token] = token
+    # a silent stop here would cache a truncated listing as complete
+    logger.warning("aws %s %s: pagination stopped after %d pages; "
+                   "listing may be incomplete", service, action,
+                   _MAX_PAGES)
 
 
 def walk_ec2_instances(client: AWSClient) -> list[CloudResource]:
@@ -338,6 +342,9 @@ def walk_efs(client: AWSClient) -> list[CloudResource]:
         if not marker:
             break
         query = {"Marker": marker}
+    else:
+        logger.warning("aws efs: pagination stopped after %d pages; "
+                       "listing may be incomplete", _MAX_PAGES)
     return out
 
 

@@ -560,6 +560,184 @@ def _sa_token(ctx):
                            if isinstance(ctx.spec, PosDict) else (0, 0)))
 
 
+@_k("KSV005", "SYS_ADMIN capability added", "HIGH",
+    "SYS_ADMIN gives the container full administration operations on "
+    "the host.",
+    "Remove 'SYS_ADMIN' from 'securityContext.capabilities.add'.")
+def _sys_admin(ctx):
+    for c, crng in ctx.containers:
+        caps = _sec_ctx(c).get("capabilities")
+        add = caps.get("add") if isinstance(caps, dict) else None
+        if isinstance(add, list) and any(
+                str(a).upper() == "SYS_ADMIN" for a in add):
+            yield (f"Container '{_cname(c)}' of {ctx.kind} "
+                   f"'{ctx.name}' should not include 'SYS_ADMIN' in "
+                   f"'securityContext.capabilities.add'",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV006", "hostPath volume mounted with docker.sock", "HIGH",
+    "Mounting docker.sock gives the container full control of the "
+    "host's container runtime.",
+    "Do not mount '/var/run/docker.sock'.")
+def _docker_sock(ctx):
+    vols = ctx.spec.get("volumes")
+    if not isinstance(vols, list):
+        return
+    for v in vols:
+        hp = v.get("hostPath") if isinstance(v, dict) else None
+        path = hp.get("path") if isinstance(hp, dict) else ""
+        if path == "/var/run/docker.sock":
+            yield (f"{ctx.kind} '{ctx.name}' should not mount "
+                   f"'/var/run/docker.sock'",
+                   value_range(ctx.spec, "volumes"))
+
+
+@_k("KSV007", "hostAliases is set", "LOW",
+    "Managing /etc/hosts via hostAliases can redirect traffic to "
+    "malicious hosts.",
+    "Do not set 'spec.hostAliases'.")
+def _host_aliases(ctx):
+    if ctx.spec.get("hostAliases") is not None:
+        yield (f"{ctx.kind} '{ctx.name}' should not set "
+               f"'spec.hostAliases'",
+               value_range(ctx.spec, "hostAliases"))
+
+
+@_k("KSV024", "Access to host ports", "HIGH",
+    "hostPort binds the container to the node's network identity.",
+    "Do not set 'containers[].ports[].hostPort'.")
+def _host_ports(ctx):
+    for c, crng in ctx.containers:
+        ports = c.get("ports")
+        if not isinstance(ports, list):
+            continue
+        for p in ports:
+            if isinstance(p, dict) and p.get("hostPort") is not None:
+                yield (f"Container '{_cname(c)}' of {ctx.kind} "
+                       f"'{ctx.name}' should not set 'hostPort'",
+                       _rng(c, "ports", crng))
+
+
+_SAFE_SYSCTLS = {
+    "kernel.shm_rmid_forced", "net.ipv4.ip_local_port_range",
+    "net.ipv4.ip_unprivileged_port_start", "net.ipv4.tcp_syncookies",
+    "net.ipv4.ping_group_range",
+}
+
+
+@_k("KSV026", "Unsafe sysctl options set", "MEDIUM",
+    "Only a small allowlist of sysctls is considered safe to set from "
+    "a pod.",
+    "Remove unsafe entries from 'securityContext.sysctls'.")
+def _unsafe_sysctls(ctx):
+    sc = ctx.spec.get("securityContext")
+    sysctls = sc.get("sysctls") if isinstance(sc, dict) else None
+    if not isinstance(sysctls, list):
+        return
+    for s in sysctls:
+        name = s.get("name") if isinstance(s, dict) else None
+        if name and name not in _SAFE_SYSCTLS:
+            yield (f"{ctx.kind} '{ctx.name}' should not set unsafe "
+                   f"sysctl '{name}'",
+                   value_range(ctx.spec, "securityContext"))
+
+
+@_k("KSV027", "Non-default /proc mask set", "MEDIUM",
+    "Changing procMount from the default exposes host information to "
+    "the container.",
+    "Do not set 'securityContext.procMount'.")
+def _proc_mount(ctx):
+    for c, crng in ctx.containers:
+        pm = _sec_ctx(c).get("procMount")
+        if pm is not None and str(pm) != "Default":
+            yield (f"Container '{_cname(c)}' of {ctx.kind} "
+                   f"'{ctx.name}' should not set "
+                   f"'securityContext.procMount'",
+                   _rng(c, "securityContext", crng))
+
+
+@_k("KSV037", "Workload deployed in default or kube-system namespace",
+    "MEDIUM",
+    "Deploying user workloads into kube-system blurs the boundary "
+    "with cluster-control components.",
+    "Deploy workloads into a dedicated namespace.")
+def _system_namespace(ctx):
+    if ctx.kind not in _WORKLOAD_KINDS:
+        return  # RBAC objects in kube-system are normal
+    md = ctx.doc.get("metadata")
+    ns = md.get("namespace") if isinstance(md, dict) else ""
+    if ns == "kube-system":
+        yield (f"{ctx.kind} '{ctx.name}' should not be deployed in "
+               f"the 'kube-system' namespace",
+               value_range(md, "namespace") if isinstance(md, PosDict)
+               else (0, 0))
+
+
+# --- RBAC checks (Role / ClusterRole documents) ----------------------
+
+def _rbac_rules(ctx):
+    if ctx.kind not in ("Role", "ClusterRole"):
+        return []
+    rules = ctx.doc.get("rules")
+    return [r for r in rules if isinstance(r, dict)] \
+        if isinstance(rules, list) else []
+
+
+def _rule_rng(ctx):
+    return value_range(ctx.doc, "rules") \
+        if isinstance(ctx.doc, PosDict) else (0, 0)
+
+
+@_k("KSV041", "Manage secrets", "CRITICAL",
+    "Roles able to read secrets can exfiltrate every credential in "
+    "their scope.",
+    "Remove 'secrets' from the role's resources, or narrow the "
+    "verbs.")
+def _rbac_secrets(ctx):
+    for rule in _rbac_rules(ctx):
+        resources = rule.get("resources") or []
+        verbs = rule.get("verbs") or []
+        if "secrets" in resources and any(
+                v in ("get", "list", "watch", "*") for v in verbs):
+            yield (f"{ctx.kind} '{ctx.name}' should not have access "
+                   f"to resource 'secrets'", _rule_rng(ctx))
+
+
+@_k("KSV044", "No wildcard verb roles", "CRITICAL",
+    "A '*' verb grants every action on the rule's resources.",
+    "List the needed verbs explicitly.")
+def _rbac_wildcard_verbs(ctx):
+    for rule in _rbac_rules(ctx):
+        if "*" in (rule.get("verbs") or []):
+            yield (f"{ctx.kind} '{ctx.name}' should not use wildcard "
+                   f"verbs", _rule_rng(ctx))
+
+
+@_k("KSV045", "No wildcard resource roles", "CRITICAL",
+    "A '*' resource grants the rule's verbs on every resource kind.",
+    "List the needed resources explicitly.")
+def _rbac_wildcard_resources(ctx):
+    for rule in _rbac_rules(ctx):
+        if "*" in (rule.get("resources") or []):
+            yield (f"{ctx.kind} '{ctx.name}' should not use wildcard "
+                   f"resources", _rule_rng(ctx))
+
+
+@_k("KSV047", "Privilege escalation verbs", "HIGH",
+    "The escalate, bind and impersonate verbs allow privilege "
+    "escalation through the RBAC system itself.",
+    "Remove 'escalate', 'bind' and 'impersonate' verbs.")
+def _rbac_escalation(ctx):
+    for rule in _rbac_rules(ctx):
+        bad = {"escalate", "bind", "impersonate"} & \
+            set(rule.get("verbs") or [])
+        if bad:
+            yield (f"{ctx.kind} '{ctx.name}' should not grant "
+                   f"privilege-escalation verbs "
+                   f"({', '.join(sorted(bad))})", _rule_rng(ctx))
+
+
 @_k("KSV103", "HostProcess container defined", "HIGH",
     "Windows pods offer the ability to run HostProcess containers "
     "which enables privileged access to the Windows node.",
@@ -601,10 +779,19 @@ def scan_kubernetes(path: str, content: bytes, lines=None,
         subdocs = items if doc.get("kind") == "List" and \
             isinstance(items, list) else [doc]
         for d in subdocs:
-            if isinstance(d, dict) and d.get("kind") in _WORKLOAD_KINDS:
+            if not isinstance(d, dict):
+                continue
+            kind = d.get("kind")
+            if kind in _WORKLOAD_KINDS:
                 ctx = _Ctx(d)
                 if isinstance(ctx.spec, dict):
                     contexts.append(ctx)
+            elif kind in ("Role", "ClusterRole"):
+                # RBAC documents: pod-spec checks no-op on the empty
+                # spec; the KSV041/044/045/047 family gates on kind
+                ctx = _Ctx(d)
+                ctx.spec = {}
+                contexts.append(ctx)
     if not contexts:
         return [], 0
 

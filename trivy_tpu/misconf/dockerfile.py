@@ -6,6 +6,7 @@ and severities follow the published AVD DS-series so findings line up."""
 
 from __future__ import annotations
 
+import json
 import re
 from dataclasses import dataclass, field
 
@@ -188,6 +189,264 @@ def _healthcheck(insts):
         first = insts[0] if insts else None
         if first is not None:
             yield None, "Add HEALTHCHECK instruction in your Dockerfile"
+
+
+def _stages(insts):
+    """Split instructions into build stages at each FROM."""
+    stages, cur = [], []
+    for inst in insts:
+        if inst.cmd == "FROM" and cur:
+            stages.append(cur)
+            cur = []
+        cur.append(inst)
+    if cur:
+        stages.append(cur)
+    return stages
+
+
+def _from_alias(inst) -> str:
+    # skip flag tokens: FROM --platform=linux/amd64 img AS name
+    parts = [p for p in inst.args.split() if not p.startswith("--")]
+    if len(parts) >= 3 and parts[1].upper() == "AS":
+        return parts[2].lower()
+    return ""
+
+
+@_mk("DS006", "COPY '--from' references current FROM alias", "CRITICAL",
+     "COPY '--from' should point to a previous build stage, not the "
+     "stage it is defined in.",
+     "Point the COPY '--from' to a previous stage or external image")
+def _copy_from_self(insts):
+    for stage in _stages(insts):
+        alias = _from_alias(stage[0]) if stage and             stage[0].cmd == "FROM" else ""
+        if not alias:
+            continue
+        for inst in stage:
+            if inst.cmd != "COPY":
+                continue
+            m = re.search(r"--from=(\S+)", inst.args)
+            if m and m.group(1).lower() == alias:
+                yield inst, (f"'COPY --from' should not mention its "
+                             f"own FROM alias '{alias}'")
+
+
+@_mk("DS007", "Multiple ENTRYPOINT instructions listed", "CRITICAL",
+     "There can only be one ENTRYPOINT instruction in a Dockerfile; "
+     "only the last one takes effect.",
+     "Remove the extra ENTRYPOINT instructions")
+def _multi_entrypoint(insts):
+    for stage in _stages(insts):
+        eps = [i for i in stage if i.cmd == "ENTRYPOINT"]
+        for inst in eps[1:]:
+            yield inst, ("There are 2 or more ENTRYPOINT instructions "
+                         "in this stage; only the last one takes "
+                         "effect")
+
+
+@_mk("DS008", "Exposed port out of range", "CRITICAL",
+     "Exposed ports must be in the 0-65535 range.",
+     "Use a port number inside 0-65535")
+def _port_range(insts):
+    for inst in insts:
+        if inst.cmd != "EXPOSE":
+            continue
+        for port in inst.args.split():
+            num = port.split("/")[0]
+            if num.isdigit() and not 0 <= int(num) <= 65535:
+                yield inst, (f"'EXPOSE' instruction should use port "
+                             f"numbers in 0-65535 range ({num})")
+
+
+@_mk("DS009", "WORKDIR path not absolute", "HIGH",
+     "For clarity and reliability, always use absolute paths in "
+     "WORKDIR.",
+     "Use an absolute path in the WORKDIR instruction")
+def _workdir_relative(insts):
+    for inst in insts:
+        if inst.cmd != "WORKDIR":
+            continue
+        p = inst.args.strip().strip("'\"")
+        if p and not p.startswith(("/", "$", "C:", "c:")):
+            yield inst, (f"WORKDIR path '{p}' should be absolute")
+
+
+@_mk("DS010", "RUN using 'sudo'", "CRITICAL",
+     "Avoid using 'sudo' in RUN instructions: it has unpredictable "
+     "TTY and signal-forwarding behavior.",
+     "Do not use 'sudo' in RUN instructions")
+def _run_sudo(insts):
+    for inst in insts:
+        if inst.cmd == "RUN" and re.search(r"\bsudo\b", inst.args):
+            yield inst, "Using 'sudo' in Dockerfile should be avoided"
+
+
+@_mk("DS011", "COPY with multiple sources needs a directory "
+     "destination", "CRITICAL",
+     "When copying multiple sources, the destination must be a "
+     "directory (end with '/').",
+     "End the COPY destination with '/'")
+def _copy_dest_dir(insts):
+    for inst in insts:
+        if inst.cmd != "COPY":
+            continue
+        raw = inst.args.strip()
+        if raw.startswith("["):
+            # JSON (exec) form: parse the array for the real tokens
+            try:
+                parsed = json.loads(raw)
+            except ValueError:
+                continue
+            args = [str(a) for a in parsed] \
+                if isinstance(parsed, list) else []
+        else:
+            args = [a for a in raw.split() if not a.startswith("--")]
+        if len(args) > 2 and not args[-1].endswith(("/", "\\")):
+            yield inst, (f"COPY with more than two arguments requires "
+                         f"the last argument to end with '/'")
+
+
+@_mk("DS012", "Duplicate FROM alias", "CRITICAL",
+     "Build-stage aliases must be unique.",
+     "Rename the duplicated stage alias")
+def _dup_alias(insts):
+    seen = {}
+    for inst in insts:
+        if inst.cmd != "FROM":
+            continue
+        alias = _from_alias(inst)
+        if not alias:
+            continue
+        if alias in seen:
+            yield inst, (f"Duplicate aliases '{alias}' are defined in "
+                         f"multiple FROM instructions")
+        seen[alias] = inst
+
+
+@_mk("DS014", "RUN using 'wget' and 'curl' together", "LOW",
+     "Using both tools doubles the image dependencies; pick one.",
+     "Use either 'wget' or 'curl', not both")
+def _wget_and_curl(insts):
+    # stages build independent images: only flag a stage using both
+    for stage in _stages(insts):
+        has = {"wget": False, "curl": False}
+        for inst in stage:
+            if inst.cmd != "RUN":
+                continue
+            for tool in has:
+                if re.search(rf"(^|[\s;&|]){tool}\b", inst.args):
+                    has[tool] = True
+        if has["wget"] and has["curl"]:
+            for inst in stage:
+                if inst.cmd == "RUN" and \
+                        re.search(r"(^|[\s;&|])curl\b", inst.args):
+                    yield inst, ("Shouldn't use both curl and wget")
+                    break
+
+
+def _clean_missing_check(id_, install_re, clean_phrase):
+    """yum/dnf/zypper share one body: install without a cache clean in
+    the same RUN statement."""
+    @_mk(id_, f"'{clean_phrase}' missing", "HIGH",
+         "Cached package data should be cleaned after installation to "
+         "reduce image size.",
+         f"Add '{clean_phrase}' to the same RUN statement")
+    def check(insts):
+        for inst in insts:
+            if inst.cmd == "RUN" and \
+                    re.search(install_re, inst.args) and \
+                    clean_phrase not in inst.args:
+                yield inst, (f"'{clean_phrase}' is missed: "
+                             f"'{inst.args}'")
+    return check
+
+
+_clean_missing_check("DS015", r"\byum\s+(-\S+\s+)*install\b",
+                     "yum clean all")
+
+
+@_mk("DS016", "Multiple CMD instructions listed", "HIGH",
+     "There can only be one CMD instruction in a Dockerfile; only the "
+     "last one takes effect.",
+     "Remove the extra CMD instructions")
+def _multi_cmd(insts):
+    for stage in _stages(insts):
+        cmds = [i for i in stage if i.cmd == "CMD"]
+        for inst in cmds[1:]:
+            yield inst, ("There are 2 or more CMD instructions in this "
+                         "stage; only the last one takes effect")
+
+
+_clean_missing_check("DS019", r"\bdnf\s+(-\S+\s+)*install\b",
+                     "dnf clean all")
+_clean_missing_check("DS020", r"\bzypper\s+(-\S+\s+)*(install|in)\b",
+                     "zypper clean")
+
+
+@_mk("DS021", "'apt-get install' without '-y'", "HIGH",
+     "Without '-y' the build may hang on a confirmation prompt.",
+     "Add '-y' (or '--yes') to 'apt-get install'")
+def _apt_yes(insts):
+    for inst in insts:
+        if inst.cmd != "RUN":
+            continue
+        for m in re.finditer(r"apt-get\s+(?:-\S+\s+)*install\b[^&|;]*",
+                             inst.args):
+            seg = m.group(0)
+            if not re.search(r"(^|\s)(-y|--yes|--assume-yes|-qq)\b",
+                             seg):
+                yield inst, (f"'-y' flag is missed: '{seg.strip()}'")
+
+
+@_mk("DS022", "MAINTAINER is deprecated", "LOW",
+     "MAINTAINER has been deprecated since Docker 1.13.0.",
+     "Use LABEL maintainer=... instead")
+def _maintainer(insts):
+    for inst in insts:
+        if inst.cmd == "MAINTAINER":
+            yield inst, (f"MAINTAINER should not be used: 'MAINTAINER "
+                         f"{inst.args}'")
+
+
+@_mk("DS023", "Multiple HEALTHCHECK instructions listed", "CRITICAL",
+     "Only one HEALTHCHECK instruction may be present; only the last "
+     "one takes effect.",
+     "Remove the extra HEALTHCHECK instructions")
+def _multi_healthcheck(insts):
+    for stage in _stages(insts):
+        hcs = [i for i in stage if i.cmd == "HEALTHCHECK"]
+        for inst in hcs[1:]:
+            yield inst, ("There are 2 or more HEALTHCHECK "
+                         "instructions in this stage; only the last "
+                         "one takes effect")
+
+
+@_mk("DS024", "'apt-get dist-upgrade' used", "HIGH",
+     "Full distribution upgrades inside a container defeat image "
+     "reproducibility.",
+     "Remove 'apt-get dist-upgrade'")
+def _dist_upgrade(insts):
+    for inst in insts:
+        if inst.cmd == "RUN" and \
+                re.search(r"\bapt-get\s+(-\S+\s+)*dist-upgrade\b",
+                          inst.args):
+            yield inst, ("'apt-get dist-upgrade' should not be used in "
+                         "Dockerfile")
+
+
+@_mk("DS029", "'apt-get install' without '--no-install-recommends'",
+     "HIGH",
+     "Skipping recommended packages keeps images small.",
+     "Add '--no-install-recommends' to 'apt-get install'")
+def _apt_no_recommends(insts):
+    for inst in insts:
+        if inst.cmd != "RUN":
+            continue
+        for m in re.finditer(r"apt-get\s+(?:-\S+\s+)*install\b[^&|;]*",
+                             inst.args):
+            seg = m.group(0)
+            if "--no-install-recommends" not in seg:
+                yield inst, (f"'--no-install-recommends' is missed: "
+                             f"'{seg.strip()}'")
 
 
 def scan_dockerfile(path: str, content: bytes,
