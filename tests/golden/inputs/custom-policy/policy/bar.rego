@@ -1,0 +1,5 @@
+package user.bar
+
+deny[res] {
+	res := "something bad: bar"
+}

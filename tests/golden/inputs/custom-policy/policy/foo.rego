@@ -1,0 +1,5 @@
+package user.foo
+
+deny[res] {
+	res := "something bad: foo"
+}

@@ -1,0 +1,8 @@
+package namespace.exceptions
+
+import data.namespaces
+
+exception[ns] {
+	ns := data.namespaces[_]
+	startswith(ns, "builtin")
+}

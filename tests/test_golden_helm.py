@@ -137,3 +137,110 @@ def test_dockerfile_file_pattern_failure_parity():
         failures, _succ = scan_dockerfile("Customfile", f.read())
     golden = _golden_failures("dockerfile_file_pattern.json.golden")
     assert sorted(m.id for m in failures) == golden["Customfile"]
+
+
+# --- custom rego policies + exceptions -------------------------------
+
+def _scan_with_policies(input_dir, policy_dir, namespaces=None):
+    from trivy_tpu.fanal.analyzers import AnalyzerGroup
+    from trivy_tpu.misconf import set_custom_checks
+    set_custom_checks([policy_dir], namespaces=namespaces)
+    try:
+        group = AnalyzerGroup()
+        a = next(x for x in group.analyzers if x.name == "misconf")
+        with open(os.path.join(input_dir, "Dockerfile"), "rb") as f:
+            res = a.analyze("Dockerfile", f.read())
+    finally:
+        set_custom_checks([])
+    assert res is not None
+    return res.misconfigurations[0]
+
+
+def test_custom_policy_failure_parity():
+    """Custom user rego checks over a Dockerfile (reference
+    dockerfile-custom-policies.json.golden): both user-namespace deny
+    rules fire as ID N/A alongside the builtin checks."""
+    mc = _scan_with_policies(
+        os.path.join(INPUTS, "custom-policy"),
+        os.path.join(INPUTS, "custom-policy", "policy"),
+        namespaces=["user"])
+    golden = _golden_failures("dockerfile-custom-policies.json.golden")
+    got = sorted((m.id, m.message) for m in mc.failures
+                 if m.namespace.startswith("user."))
+    assert got == [("N/A", "something bad: bar"),
+                   ("N/A", "something bad: foo")]
+    # the full failing-ID set (builtin + custom) matches the golden
+    assert sorted(m.id for m in mc.failures) == \
+        golden["Dockerfile"]
+
+
+def test_namespace_exception_moves_builtins():
+    """namespace.exceptions excepting every builtin.* namespace: zero
+    failures, zero successes, every evaluated check an Exception
+    (reference dockerfile-namespace-exception.json.golden)."""
+    from trivy_tpu.misconf.dockerfile import CHECKS
+    mc = _scan_with_policies(
+        os.path.join(INPUTS, "namespace-exception"),
+        os.path.join(INPUTS, "namespace-exception", "policy"))
+    assert mc.failures == []
+    assert mc.successes == 0
+    assert mc.exceptions == len(CHECKS)
+
+
+def test_rule_exception_matches_reference():
+    """The rule-level exception fixture (reference
+    dockerfile-rule-exception.json.golden): the golden still reports
+    DS002 — the exception's Value-list shape doesn't match — and ours
+    must agree."""
+    mc = _scan_with_policies(
+        os.path.join(INPUTS, "rule-exception"),
+        os.path.join(INPUTS, "rule-exception", "policy"))
+    golden = _golden_failures("dockerfile-rule-exception.json.golden")
+    assert sorted(m.id for m in mc.failures) == golden["Dockerfile"]
+
+
+def test_rule_exception_suffix_semantics(tmp_path):
+    """Reference exceptions.go isRuleIgnored: the exception yields
+    rule-name SUFFIX lists; a non-matching suffix must not except the
+    check, a matching (or empty) one must."""
+    p = tmp_path / "policy"
+    p.mkdir()
+    (p / "exc.rego").write_text(
+        'package builtin.dockerfile.DS002\n\n'
+        'exception[rules] {\n'
+        '\trules := ["nosuchrule"]\n'
+        '}\n')
+    mc = _scan_with_policies(os.path.join(INPUTS, "rule-exception"),
+                             str(p))
+    assert "DS002" in {m.id for m in mc.failures}   # suffix mismatch
+
+    (p / "exc.rego").write_text(
+        'package builtin.dockerfile.DS002\n\n'
+        'exception[rules] {\n'
+        '\trules := [""]\n'
+        '}\n')
+    mc = _scan_with_policies(os.path.join(INPUTS, "rule-exception"),
+                             str(p))
+    assert "DS002" not in {m.id for m in mc.failures}
+    assert mc.exceptions == 1
+
+
+def test_namespace_exception_covers_custom_checks(tmp_path):
+    """Reference scanner.go runs isIgnored for every namespace, user
+    namespaces included."""
+    p = tmp_path / "policy"
+    p.mkdir()
+    (p / "check.rego").write_text(
+        'package user.foo\n\ndeny[res] {\n\tres := "bad"\n}\n')
+    (p / "exc.rego").write_text(
+        'package namespace.exceptions\n\n'
+        'import data.namespaces\n\n'
+        'exception[ns] {\n'
+        '\tns := data.namespaces[_]\n'
+        '\tstartswith(ns, "user")\n'
+        '}\n')
+    mc = _scan_with_policies(os.path.join(INPUTS, "custom-policy"),
+                             str(p), namespaces=["user"])
+    assert not any(m.namespace.startswith("user.")
+                   for m in mc.failures)
+    assert mc.exceptions >= 1

@@ -325,7 +325,8 @@ deny[msg] {
            "spec": {"containers": [
                {"name": "app",
                 "securityContext": {"privileged": True}}]}}
-    failures, successes = s.scan_docs("kubernetes", "pod.yaml", [doc])
+    failures, successes, _exc = s.scan_docs("kubernetes", "pod.yaml",
+                                            [doc])
     assert len(failures) == 1
     f = failures[0]
     assert f.id == "USR-001"
@@ -333,11 +334,12 @@ deny[msg] {
     assert "app is privileged" in f.message
     # clean doc → success
     doc2 = {"kind": "Pod", "spec": {"containers": [{"name": "a"}]}}
-    failures2, successes2 = s.scan_docs("kubernetes", "p.yaml", [doc2])
+    failures2, successes2, _exc2 = s.scan_docs("kubernetes", "p.yaml",
+                                               [doc2])
     assert not failures2
     assert successes2 == 1
     # selector excludes dockerfile inputs
-    f3, s3 = s.scan_docs("dockerfile", "Dockerfile", [{"x": 1}])
+    f3, s3, _e3 = s.scan_docs("dockerfile", "Dockerfile", [{"x": 1}])
     assert not f3 and s3 == 0
 
 
@@ -352,7 +354,8 @@ warn[msg] {
 }
 """)
     s = RegoChecksScanner.from_paths([str(tmp_path)])
-    failures, _ = s.scan_docs("yaml", "deploy.yaml", [{"replicas": 1}])
+    failures, _, _ = s.scan_docs("yaml", "deploy.yaml",
+                                 [{"replicas": 1}])
     assert len(failures) == 1
     assert failures[0].message == "too few replicas"
 
@@ -399,7 +402,7 @@ deny[msg] {
 }
 """)
     s = RegoChecksScanner.from_paths([str(tmp_path)])
-    failures, _ = s.scan_docs("yaml", "x.yaml",
+    failures, _, _ = s.scan_docs("yaml", "x.yaml",
                               [{"a": True, "b": True}])
     assert sorted(f.message for f in failures) == ["a bad", "b bad"]
 

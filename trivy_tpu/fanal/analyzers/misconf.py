@@ -26,14 +26,20 @@ class MisconfAnalyzer(Analyzer):
         return detect_file_type(path) != ""
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
-        from ...misconf import custom_checks_scanner, run_custom_checks
+        from ...misconf import (apply_exceptions, custom_checks_scanner,
+                                run_custom_checks)
         ftype, docs = sniff(path, content)
         failures: list = []
         successes = 0
+        exceptions = 0
         scanner = FILE_TYPES.get(ftype)
         if scanner is not None:
             failures, successes = scanner(path, content, docs=docs)
         if custom_checks_scanner() is not None:
+            if ftype:
+                # rego exceptions apply to the builtin results
+                failures, successes, exceptions = apply_exceptions(
+                    ftype, path, content, docs, failures, successes)
             eff_type = ftype
             if not eff_type:
                 base = path.lower()
@@ -44,16 +50,19 @@ class MisconfAnalyzer(Analyzer):
                 elif base.endswith(".toml"):
                     eff_type = "toml"
             if eff_type:
-                cf, cs = run_custom_checks(eff_type, path, content, docs)
+                cf, cs, ce = run_custom_checks(eff_type, path, content,
+                                               docs)
                 failures = failures + cf
                 successes += cs
+                exceptions += ce
                 ftype = ftype or eff_type
-        if not failures and not successes:
+        if not failures and not successes and not exceptions:
             return None
         result = AnalysisResult()
         result.misconfigurations = [T.Misconfiguration(
             file_type=ftype, file_path=path,
-            successes=successes, failures=failures)]
+            successes=successes, exceptions=exceptions,
+            failures=failures)]
         return result
 
 

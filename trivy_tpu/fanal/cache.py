@@ -201,6 +201,7 @@ def _misconf_from_json(j: dict) -> T.Misconfiguration:
         file_type=j.get("FileType", ""),
         file_path=j.get("FilePath", ""),
         successes=j.get("Successes", 0),
+        exceptions=j.get("Exceptions", 0),
         failures=[_detected_misconf_from_json(f)
                   for f in j.get("Failures", [])],
     )

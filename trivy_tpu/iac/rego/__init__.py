@@ -202,14 +202,89 @@ class RegoChecksScanner:
             if m.package and m.package[0] in self.namespaces:
                 yield m
 
+    def has_exceptions(self) -> bool:
+        return any(
+            m.package == ("namespace", "exceptions") or
+            any(r.name == "exception" for r in m.rules)
+            for m in self.all_modules)
+
+    def _exc_interp(self, all_namespaces):
+        """Interpreter with `data.namespaces` bound to every evaluated
+        check namespace (the document the reference's scanner supplies
+        to namespace-exception policies)."""
+        key = tuple(sorted(all_namespaces))
+        cache = getattr(self, "_exc_interps", None)
+        if cache is None:
+            cache = self._exc_interps = {}
+        if key not in cache:
+            cache[key] = Interpreter(
+                self.all_modules,
+                data={**self.interp.base_data,
+                      "namespaces": list(key)})
+        return cache[key]
+
+    def is_namespace_ignored(self, namespace: str, input_doc,
+                             all_namespaces) -> bool:
+        """`data.namespace.exceptions.exception[_] == <ns>` evaluated
+        with the input (reference exceptions.go isNamespaceIgnored)."""
+        if not any(m.package == ("namespace", "exceptions")
+                   for m in self.all_modules):
+            return False
+        try:
+            val = self._exc_interp(all_namespaces).query(
+                "namespace.exceptions.exception", input_doc=input_doc)
+        except Exception:
+            return False
+        items = val.to_list() if isinstance(val, RSet) else \
+            val if isinstance(val, list) else []
+        return namespace in {str(x) for x in items}
+
+    def is_rule_ignored(self, namespace: str, rule_name: str,
+                        input_doc) -> bool:
+        """`endswith(<ruleName>, data.<ns>.exception[_][_])` with the
+        input (reference exceptions.go isRuleIgnored): the exception
+        rule yields LISTS of rule-name suffixes; '' matches every
+        rule."""
+        pkg = tuple(namespace.split("."))
+        if not any(m.package == pkg and
+                   any(r.name == "exception" for r in m.rules)
+                   for m in self.all_modules):
+            return False
+        try:
+            val = self.interp.query(namespace + ".exception",
+                                    input_doc=input_doc)
+        except Exception:
+            return False
+        if val is UNDEF or val in (False, None):
+            return False
+        groups = val.to_list() if isinstance(val, RSet) else \
+            val if isinstance(val, list) else [val]
+        for group in groups:
+            suffixes = group if isinstance(group, (list, tuple)) \
+                else [group]
+            for s in suffixes:
+                if isinstance(s, str) and rule_name.endswith(s):
+                    return True
+        return False
+
+    def is_ignored(self, namespace: str, rule_name: str, input_doc,
+                   all_namespaces) -> bool:
+        return self.is_namespace_ignored(
+            namespace, input_doc, all_namespaces) or \
+            self.is_rule_ignored(namespace, rule_name, input_doc)
+
     def scan_docs(self, file_type: str, path: str, docs,
-                  text: str = ""):
+                  text: str = "", extra_namespaces=None):
         """Evaluate every applicable module × enforced rule × doc.
 
         docs: list of parsed documents (each a plain JSON-like value).
-        → (failures, successes) in the shared misconf shape."""
+        extra_namespaces: full namespace universe for data.namespaces
+        (builtin + custom) when the caller knows it.
+        → (failures, successes, exceptions) in the shared misconf
+        shape."""
         failures: list[T.DetectedMisconfiguration] = []
         successes = 0
+        exceptions = 0
         src_lines = text.splitlines() if text else []
         ignores = ignored_ids_by_line(text) if text else {}
         seen_pkgs = set()
@@ -233,18 +308,32 @@ class RegoChecksScanner:
                 namespace=".".join(mod.package))
             rule_names = [n for n in self.interp.rule_names(mod.package)
                           if _enforced(n)]
+            ns = ".".join(mod.package)
+            all_ns = extra_namespaces or \
+                sorted(".".join(m.package)
+                       for m in self.check_modules())
             module_failed = False
+            module_excepted = False
             for doc in docs:
                 for rname in rule_names:
+                    # rego exceptions run for every namespace, custom
+                    # ones included (reference scanner.go isIgnored)
+                    if self.has_exceptions() and \
+                            self.is_ignored(ns, rname, doc, all_ns):
+                        module_excepted = True
+                        continue
                     for msg, rng in self._apply_rule(mod, rname, doc):
                         if is_ignored(ignores, check, rng[0]):
                             continue
                         module_failed = True
                         failures.append(build_misconf(
                             check, file_type, msg, rng, src_lines))
-            if not module_failed and rule_names:
-                successes += 1
-        return failures, successes
+            if rule_names and not module_failed:
+                if module_excepted:
+                    exceptions += 1
+                else:
+                    successes += 1
+        return failures, successes, exceptions
 
     def _package_metadata(self, mod: Module) -> StaticMetadata:
         """Metadata for a package: the annotated module wins when several

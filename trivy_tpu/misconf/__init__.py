@@ -135,9 +135,9 @@ def dockerfile_rego_input(content: bytes) -> dict:
 
 
 def run_custom_checks(ftype: str, path: str, content: bytes, docs):
-    """→ (failures, successes) from user rego checks, or ([], 0)."""
+    """→ (failures, successes, exceptions) from user rego checks."""
     if _custom_scanner is None:
-        return [], 0
+        return [], 0, 0
     text = content.decode(errors="replace")
     if ftype == "dockerfile":
         inputs = [dockerfile_rego_input(content)]
@@ -146,8 +146,13 @@ def run_custom_checks(ftype: str, path: str, content: bytes, docs):
     else:
         inputs = _parse_plain_docs(path, text)
     if not inputs:
-        return [], 0
-    return _custom_scanner.scan_docs(ftype, path, inputs, text)
+        return [], 0, 0
+    builtin = _builtin_namespaces(ftype) or []
+    custom = sorted(".".join(m.package)
+                    for m in _custom_scanner.check_modules())
+    return _custom_scanner.scan_docs(
+        ftype, path, inputs, text,
+        extra_namespaces=sorted(set(builtin) | set(custom)))
 
 
 def _parse_plain_docs(path: str, text: str):
@@ -180,3 +185,54 @@ def detect_file_type(path: str) -> str:
     if base.endswith(".toml") and _custom_scanner is not None:
         return "candidate"
     return ""
+
+
+def _builtin_namespaces(ftype: str):
+    """Every check namespace a file type's builtin scanner evaluates,
+    or None when the scanner doesn't have per-check accounting."""
+    if ftype == "dockerfile":
+        from .dockerfile import CHECKS
+        return [f"builtin.dockerfile.{c.id}" for c in CHECKS]
+    if ftype == "kubernetes":
+        from ..iac.kubernetes import CHECKS
+        return [c.namespace for c in CHECKS]
+    return None
+
+
+def apply_exceptions(ftype: str, path: str, content: bytes, docs,
+                     failures, successes):
+    """Rego exceptions over BUILTIN results (reference
+    pkg/iac/rego/exceptions.go: `namespace.exceptions.exception[_] ==
+    ns` and `endswith(rule, data.<ns>.exception[_][_])`, both
+    input-aware). Native checks correspond to the reference's `deny`
+    rules, so the rule-name tested is "deny". → (failures, successes,
+    exceptions)."""
+    scanner = custom_checks_scanner()
+    if scanner is None or not scanner.has_exceptions():
+        return failures, successes, 0
+    if ftype == "dockerfile":
+        input_docs = [dockerfile_rego_input(content)]
+    else:
+        input_docs = [d for d in (docs or []) if d is not None]
+    names = _builtin_namespaces(ftype)
+    if names is None:
+        # no per-check registry: except whole failing checks only
+        universe = sorted({f.namespace for f in failures})
+        excepted = {
+            ns for ns in universe
+            if any(scanner.is_ignored(ns, "deny", doc, universe)
+                   for doc in input_docs)}
+        kept = [f for f in failures if f.namespace not in excepted]
+        return kept, successes, len(excepted)
+    excepted = set()
+    for ns in names:
+        if any(scanner.is_ignored(ns, "deny", doc, names)
+               for doc in input_docs):
+            excepted.add(ns)
+    if not excepted:
+        return failures, successes, 0
+    kept = [f for f in failures if f.namespace not in excepted]
+    kept_failed_ns = {f.namespace for f in kept}
+    exceptions = len(excepted)
+    successes = max(len(names) - exceptions - len(kept_failed_ns), 0)
+    return kept, successes, exceptions

@@ -147,14 +147,17 @@ class LocalScanner:
                 "config" in options.scanners:  # raw "config" kept for
             # callers bypassing cli.normalize_scanners (server RPC)
             for mc in detail.misconfigurations:
-                if not mc.failures and not mc.successes:
+                if not mc.failures and not mc.successes and \
+                        not mc.exceptions:
                     continue
                 results.append(T.Result(
                     target=mc.file_path,
                     clazz=T.ResultClass.CONFIG,
                     type=mc.file_type,
                     misconf_summary=T.MisconfSummary(
-                        successes=mc.successes, failures=len(mc.failures)),
+                        successes=mc.successes,
+                        failures=len(mc.failures),
+                        exceptions=mc.exceptions),
                     misconfigurations=sorted(
                         mc.failures, key=lambda f: (f.id, f.message)),
                 ))

@@ -113,6 +113,7 @@ def proto_blob_to_json(b: dict) -> dict:
         "FileType": m.get("file_type", ""),
         "FilePath": m.get("file_path", ""),
         "Successes": len(m.get("successes", [])),
+        "Exceptions": len(m.get("exceptions", [])),
         "Failures": [_misconf_result_json(m, r)
                      for r in m.get("failures", [])],
     } for m in b.get("misconfigurations", [])]

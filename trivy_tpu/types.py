@@ -306,6 +306,7 @@ class Misconfiguration(JsonMixin):
     file_type: str = ""
     file_path: str = ""
     successes: int = 0
+    exceptions: int = 0
     failures: list = field(default_factory=list)  # [DetectedMisconfiguration]
     layer: "Layer" = field(default_factory=lambda: Layer())
 
@@ -495,7 +496,8 @@ class DetectedMisconfiguration(JsonMixin):
 class MisconfSummary(JsonMixin):
     successes: int = 0
     failures: int = 0
-    _keep_zero = ("successes", "failures")
+    exceptions: int = 0
+    _keep_zero = ("successes", "failures", "exceptions")
 
 
 @dataclass
@@ -514,8 +516,16 @@ class Result(JsonMixin):
     _keep_zero = ("target",)
 
     def is_empty(self) -> bool:
-        return not (self.packages or self.vulnerabilities or self.misconfigurations
-                    or self.secrets or self.licenses or self.custom_resources)
+        # a config result whose checks were all excepted (or passed)
+        # still carries its summary, like the reference's
+        # misconfsToResults (local/scan.go:214-258)
+        has_summary = self.misconf_summary is not None and (
+            self.misconf_summary.successes
+            or self.misconf_summary.exceptions)
+        return not (self.packages or self.vulnerabilities
+                    or self.misconfigurations or self.secrets
+                    or self.licenses or self.custom_resources
+                    or has_summary)
 
 
 @dataclass
