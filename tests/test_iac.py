@@ -407,3 +407,47 @@ rules:
                  "KSV027", "KSV037", "KSV041", "KSV044", "KSV045",
                  "KSV047"):
         assert want in ids, want
+
+
+def test_ksv_rbac_round4_batch2():
+    from trivy_tpu.iac.kubernetes import scan_kubernetes
+    text = b"""\
+apiVersion: rbac.authorization.k8s.io/v1
+kind: Role
+metadata:
+  name: ops
+rules:
+  - apiGroups: [""]
+    resources: ["pods/log"]
+    verbs: ["delete"]
+  - apiGroups: [""]
+    resources: ["groups"]
+    verbs: ["impersonate"]
+  - apiGroups: [""]
+    resources: ["configmaps"]
+    verbs: ["update"]
+  - apiGroups: [""]
+    resources: ["pods/exec"]
+    verbs: ["create"]
+  - apiGroups: ["networking.k8s.io"]
+    resources: ["networkpolicies"]
+    verbs: ["delete"]
+"""
+    failures, _ = scan_kubernetes("role.yaml", text)
+    ids = {f.id for f in failures}
+    for want in ("KSV042", "KSV043", "KSV049", "KSV053", "KSV056"):
+        assert want in ids, want
+    # read-only role stays clean
+    failures2, _ = scan_kubernetes("role.yaml", b"""\
+apiVersion: rbac.authorization.k8s.io/v1
+kind: Role
+metadata:
+  name: reader
+rules:
+  - apiGroups: [""]
+    resources: ["configmaps", "services"]
+    verbs: ["get", "list"]
+""")
+    ids2 = {f.id for f in failures2}
+    assert not ids2 & {"KSV042", "KSV043", "KSV049", "KSV053",
+                       "KSV056"}

@@ -724,6 +724,72 @@ def _rbac_wildcard_resources(ctx):
                    f"resources", _rule_rng(ctx))
 
 
+_MODIFY_VERBS = {"create", "update", "patch", "delete",
+                 "deletecollection", "*"}
+
+
+@_k("KSV042", "Delete pod logs", "MEDIUM",
+    "The ability to delete pod logs lets an attacker cover their "
+    "tracks.",
+    "Remove delete verbs on the pods/log resource.")
+def _rbac_pod_logs(ctx):
+    for rule in _rbac_rules(ctx):
+        if "pods/log" in (rule.get("resources") or []) and \
+                {"delete", "deletecollection", "*"} & \
+                set(rule.get("verbs") or []):
+            yield (f"{ctx.kind} '{ctx.name}' should not allow deleting "
+                   f"pod logs", _rule_rng(ctx))
+
+
+@_k("KSV043", "Impersonate privileged groups", "CRITICAL",
+    "Impersonating privileged groups grants their full privileges.",
+    "Remove the impersonate verb on groups.")
+def _rbac_impersonate_groups(ctx):
+    for rule in _rbac_rules(ctx):
+        if "groups" in (rule.get("resources") or []) and \
+                "impersonate" in (rule.get("verbs") or []):
+            yield (f"{ctx.kind} '{ctx.name}' should not allow "
+                   f"impersonating groups", _rule_rng(ctx))
+
+
+@_k("KSV049", "Manage configmaps", "MEDIUM",
+    "Some workloads store sensitive data in configmaps; write access "
+    "allows tampering with application behavior.",
+    "Narrow configmap verbs to read-only.")
+def _rbac_configmaps(ctx):
+    for rule in _rbac_rules(ctx):
+        if "configmaps" in (rule.get("resources") or []) and \
+                _MODIFY_VERBS & set(rule.get("verbs") or []):
+            yield (f"{ctx.kind} '{ctx.name}' should not allow managing "
+                   f"configmaps", _rule_rng(ctx))
+
+
+@_k("KSV053", "Getting shell on pods", "HIGH",
+    "The pods/exec resource with create lets a role open a shell in "
+    "any pod in scope.",
+    "Remove create on pods/exec.")
+def _rbac_pod_exec(ctx):
+    for rule in _rbac_rules(ctx):
+        if "pods/exec" in (rule.get("resources") or []) and \
+                {"create", "*"} & set(rule.get("verbs") or []):
+            yield (f"{ctx.kind} '{ctx.name}' should not allow getting "
+                   f"a shell on pods", _rule_rng(ctx))
+
+
+@_k("KSV056", "Manage Kubernetes networking resources", "HIGH",
+    "Write access to services/ingresses/network policies lets a role "
+    "redirect cluster traffic.",
+    "Narrow networking resource verbs to read-only.")
+def _rbac_networking(ctx):
+    netres = {"services", "endpoints", "endpointslices", "ingresses",
+              "networkpolicies"}
+    for rule in _rbac_rules(ctx):
+        if netres & set(rule.get("resources") or []) and \
+                _MODIFY_VERBS & set(rule.get("verbs") or []):
+            yield (f"{ctx.kind} '{ctx.name}' should not allow managing "
+                   f"networking resources", _rule_rng(ctx))
+
+
 @_k("KSV047", "Privilege escalation verbs", "HIGH",
     "The escalate, bind and impersonate verbs allow privilege "
     "escalation through the RBAC system itself.",

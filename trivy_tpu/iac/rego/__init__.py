@@ -288,6 +288,11 @@ class RegoChecksScanner:
         src_lines = text.splitlines() if text else []
         ignores = ignored_ids_by_line(text) if text else {}
         seen_pkgs = set()
+        # loop-invariants: both scan every module, hoist out of the
+        # per-doc-per-rule evaluation
+        check_exceptions = self.has_exceptions()
+        all_ns = extra_namespaces or \
+            sorted(".".join(m.package) for m in self.check_modules())
         for mod in self.check_modules():
             # one evaluation per package: rules merge across modules
             # sharing a package (OPA compiles them into one document)
@@ -309,16 +314,13 @@ class RegoChecksScanner:
             rule_names = [n for n in self.interp.rule_names(mod.package)
                           if _enforced(n)]
             ns = ".".join(mod.package)
-            all_ns = extra_namespaces or \
-                sorted(".".join(m.package)
-                       for m in self.check_modules())
             module_failed = False
             module_excepted = False
             for doc in docs:
                 for rname in rule_names:
                     # rego exceptions run for every namespace, custom
                     # ones included (reference scanner.go isIgnored)
-                    if self.has_exceptions() and \
+                    if check_exceptions and \
                             self.is_ignored(ns, rname, doc, all_ns):
                         module_excepted = True
                         continue

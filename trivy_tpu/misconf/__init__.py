@@ -210,23 +210,34 @@ def apply_exceptions(ftype: str, path: str, content: bytes, docs,
     scanner = custom_checks_scanner()
     if scanner is None or not scanner.has_exceptions():
         return failures, successes, 0
+    if not failures and not successes:
+        # the builtin scanner evaluated nothing for this file (e.g. a
+        # kubernetes file with no workload/RBAC documents): there is
+        # nothing to except
+        return failures, successes, 0
     if ftype == "dockerfile":
         input_docs = [dockerfile_rego_input(content)]
     else:
         input_docs = [d for d in (docs or []) if d is not None]
     names = _builtin_namespaces(ftype)
+    custom_ns = sorted(".".join(m.package)
+                       for m in scanner.check_modules())
     if names is None:
         # no per-check registry: except whole failing checks only
-        universe = sorted({f.namespace for f in failures})
+        universe = sorted({f.namespace for f in failures}
+                          | set(custom_ns))
         excepted = {
-            ns for ns in universe
+            ns for ns in {f.namespace for f in failures}
             if any(scanner.is_ignored(ns, "deny", doc, universe)
                    for doc in input_docs)}
         kept = [f for f in failures if f.namespace not in excepted]
         return kept, successes, len(excepted)
+    # one namespace universe for builtin AND custom passes, like the
+    # reference's single data.namespaces document
+    universe = sorted(set(names) | set(custom_ns))
     excepted = set()
     for ns in names:
-        if any(scanner.is_ignored(ns, "deny", doc, names)
+        if any(scanner.is_ignored(ns, "deny", doc, universe)
                for doc in input_docs):
             excepted.add(ns)
     if not excepted:

@@ -45,6 +45,10 @@ _SEV_ORDER = {s: i for i, s in enumerate(T.SEVERITIES)}
 def to_table(report: T.Report) -> str:
     lines = []
     for res in report.results:
+        if res.misconfigurations or res.misconf_summary is not None:
+            _misconf_table(res, lines)
+        if res.licenses:
+            _license_table(res, lines)
         if not (res.vulnerabilities or res.secrets):
             continue
         counts = Counter(v.severity for v in res.vulnerabilities)
@@ -72,6 +76,59 @@ def to_table(report: T.Report) -> str:
             lines.append(f"{finding.severity}: {finding.title} "
                          f"(line {finding.start_line})")
     return "\n".join(lines) + "\n"
+
+
+def _misconf_table(res: T.Result, lines: list) -> None:
+    """Misconfiguration section (reference pkg/report/table/
+    misconfig.go:55-65): the Tests summary line, then one block per
+    failure."""
+    s = res.misconf_summary or T.MisconfSummary()
+    title = f"{res.target} ({res.type})"
+    lines.append("")
+    lines.append(title)
+    lines.append("=" * len(title))
+    lines.append(f"Tests: {s.successes + s.failures + s.exceptions} "
+                 f"(SUCCESSES: {s.successes}, FAILURES: {s.failures}, "
+                 f"EXCEPTIONS: {s.exceptions})")
+    counts = Counter(m.severity for m in res.misconfigurations)
+    summary = ", ".join(f"{sev}: {counts.get(sev, 0)}"
+                        for sev in reversed(T.SEVERITIES)
+                        if counts.get(sev))
+    lines.append(f"Failures: {len(res.misconfigurations)}"
+                 + (f" ({summary})" if summary else ""))
+    lines.append("")
+    for m in sorted(res.misconfigurations,
+                    key=lambda m: -_SEV_ORDER.get(m.severity, 0)):
+        head = f"{m.severity}: {m.title} ({m.id})"
+        lines.append(head)
+        lines.append("-" * len(head))
+        if m.message:
+            lines.append(m.message)
+        if m.primary_url:
+            lines.append(f"See {m.primary_url}")
+        cm = m.cause_metadata
+        if cm is not None and cm.start_line:
+            lines.append(f" {res.target}:{cm.start_line}"
+                         + (f"-{cm.end_line}"
+                            if cm.end_line and cm.end_line != cm.start_line
+                            else ""))
+            for cl in (cm.code.lines if cm.code else [])[:10]:
+                lines.append(f"  {cl.number:>4} {cl.content}")
+        lines.append("")
+
+
+def _license_table(res: T.Result, lines: list) -> None:
+    title = f"{res.target} (license)"
+    lines.append("")
+    lines.append(title)
+    lines.append("=" * len(title))
+    for lic in res.licenses:
+        name = getattr(lic, "name", "")
+        sev = getattr(lic, "severity", "")
+        pkg = getattr(lic, "pkg_name", "") or \
+            getattr(lic, "file_path", "")
+        lines.append(f"{sev}: {pkg}: {name}")
+    lines.append("")
 
 
 def report_from_json(j: dict) -> T.Report:
@@ -103,6 +160,44 @@ def report_from_json(j: dict) -> T.Report:
                 severity=sj.get("Severity", ""), title=sj.get("Title", ""),
                 start_line=sj.get("StartLine", 0),
                 end_line=sj.get("EndLine", 0), match=sj.get("Match", "")))
+        ms = rj.get("MisconfSummary")
+        if isinstance(ms, dict):
+            res.misconf_summary = T.MisconfSummary(
+                successes=ms.get("Successes", 0),
+                failures=ms.get("Failures", 0),
+                exceptions=ms.get("Exceptions", 0))
+        for mj in rj.get("Misconfigurations", []):
+            m = T.DetectedMisconfiguration(
+                type=mj.get("Type", ""), id=mj.get("ID", ""),
+                avd_id=mj.get("AVDID", ""), title=mj.get("Title", ""),
+                description=mj.get("Description", ""),
+                message=mj.get("Message", ""),
+                namespace=mj.get("Namespace", ""),
+                resolution=mj.get("Resolution", ""),
+                severity=mj.get("Severity", "UNKNOWN"),
+                primary_url=mj.get("PrimaryURL", ""),
+                status=mj.get("Status", ""))
+            cm = mj.get("CauseMetadata")
+            if isinstance(cm, dict):
+                code = cm.get("Code") or {}
+                m.cause_metadata = T.CauseMetadata(
+                    provider=cm.get("Provider", ""),
+                    service=cm.get("Service", ""),
+                    start_line=cm.get("StartLine", 0),
+                    end_line=cm.get("EndLine", 0),
+                    code=T.Code(lines=[
+                        T.CodeLine(number=cl.get("Number", 0),
+                                   content=cl.get("Content", ""))
+                        for cl in code.get("Lines") or []]))
+            res.misconfigurations.append(m)
+        for lj in rj.get("Licenses", []):
+            res.licenses.append(T.DetectedLicense(
+                severity=lj.get("Severity", ""),
+                category=lj.get("Category", ""),
+                pkg_name=lj.get("PkgName", ""),
+                file_path=lj.get("FilePath", ""),
+                name=lj.get("Name", ""),
+                confidence=lj.get("Confidence", 0)))
         results.append(res)
     meta = j.get("Metadata") or {}
     os_j = meta.get("OS") or {}
