@@ -451,3 +451,39 @@ rules:
     ids2 = {f.id for f in failures2}
     assert not ids2 & {"KSV042", "KSV043", "KSV049", "KSV053",
                        "KSV056"}
+
+
+def test_ksv110_and_116():
+    from trivy_tpu.iac.kubernetes import scan_kubernetes
+    text = b"""\
+apiVersion: v1
+kind: Pod
+metadata:
+  name: p
+  namespace: default
+spec:
+  securityContext:
+    runAsGroup: 0
+    supplementalGroups: [0]
+  containers:
+    - name: app
+      image: nginx:1.2
+      securityContext:
+        runAsGroup: 0
+"""
+    failures, _ = scan_kubernetes("p.yaml", text)
+    ids = [f.id for f in failures]
+    assert "KSV110" in ids
+    assert ids.count("KSV116") == 2   # pod-level + container-level
+    # no explicit namespace → KSV110 silent (helm golden behavior)
+    failures2, _ = scan_kubernetes("p.yaml", b"""\
+apiVersion: v1
+kind: Pod
+metadata:
+  name: p
+spec:
+  containers:
+    - name: app
+      image: nginx:1.2
+""")
+    assert "KSV110" not in {f.id for f in failures2}

@@ -14,7 +14,7 @@ from trivy_tpu.db import build_table
 from trivy_tpu.db.fixtures import load_fixture_files
 from trivy_tpu.detect.engine import BatchDetector, PkgQuery
 from trivy_tpu.parallel.mesh import (MeshDetector, make_mesh,
-                                     partition_pairs, shard_table)
+                                     partition_queries, shard_table)
 
 FIXTURES = sorted(glob.glob(
     os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")))
@@ -89,19 +89,16 @@ def test_sharded_join_skewed_buckets(table):
 
 
 def test_partition_pairs_covers_all(table):
-    st = shard_table(table, 4)
+    """Every global pair appears exactly once across the mesh cells
+    (CSR descriptors expand to the same pair set the host built)."""
+    from trivy_tpu.detect.engine import BatchDetector
     det = BatchDetector(table)
     prep = det._prepare(_queries())
-    part = partition_pairs(st, prep.pair_row, prep.pair_ver,
-                           prep.n_pairs, dp=2)
-    # every real pair appears exactly once across the partition
-    assert int(part.valid.sum()) == prep.n_pairs
-    assert sorted(part.perm[part.valid].tolist()) == \
-        list(range(prep.n_pairs))
-    # localized rows stay inside their shard's real length
-    for s in range(st.row_offset.shape[0]):
-        v = part.valid[:, s]
-        assert (part.pair_row[:, s][v] < st.row_len[s]).all()
+    st = shard_table(table, 2)
+    part = partition_queries(st, prep.q_start, prep.q_count,
+                             prep.q_ver, dp=3)
+    got = np.sort(part.perm[part.valid])
+    assert np.array_equal(got, np.arange(prep.n_pairs))
 
 
 def test_shard_table_bucket_boundaries(table):
@@ -229,3 +226,41 @@ def test_ingest_queue_propagates_errors(table):
             fut.result(timeout=10)
     finally:
         q.close()
+
+
+def test_partition_queries_covers_all_pairs(table):
+    from trivy_tpu.detect.engine import BatchDetector
+    from trivy_tpu.parallel.mesh import partition_queries
+    det = BatchDetector(table)
+    prep = det._prepare(_queries())
+    st = shard_table(table, 2)
+    part = partition_queries(st, prep.q_start, prep.q_count,
+                             prep.q_ver, dp=3)
+    # every global pair index appears exactly once in the valid region
+    got = np.sort(part.perm[part.valid])
+    assert np.array_equal(got, np.arange(prep.n_pairs))
+    # totals match the valid mask
+    assert part.valid.sum() == prep.n_pairs
+    assert int(part.total.sum()) == prep.n_pairs
+
+
+def test_partition_queries_splits_skewed_bucket(table):
+    """One dominant bucket must SPLIT across the dp axis: max device
+    load stays within a fair share, not the whole bucket (the old
+    query-granularity routing stacked it on one device)."""
+    from trivy_tpu.parallel.mesh import partition_queries
+    st = shard_table(table, 1)
+    # synthetic: one 1000-pair bucket + three 1-pair buckets
+    q_start = np.array([0, 1000, 1001, 1002], np.int32)
+    q_count = np.array([1000, 1, 1, 1], np.int32)
+    q_ver = np.zeros(4, np.int32)
+    dp = 4
+    part = partition_queries(st, q_start, q_count, q_ver, dp=dp)
+    loads = part.total[:, 0]
+    n_pairs = int(q_count.sum())
+    fair = -(-n_pairs // dp)
+    assert loads.sum() == n_pairs
+    assert loads.max() <= fair + 1
+    # coverage is still exact after splitting
+    got = np.sort(part.perm[part.valid])
+    assert np.array_equal(got, np.arange(n_pairs))

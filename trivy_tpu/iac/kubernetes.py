@@ -524,6 +524,15 @@ def _volume_types(ctx):
                    else (0, 0))
 
 
+def _has_root_gid(sc) -> bool:
+    """Shared by KSV029 and its PSS twin KSV116 (the upstream bundle
+    carries both)."""
+    return isinstance(sc, dict) and (
+        sc.get("runAsGroup") == 0 or sc.get("fsGroup") == 0 or
+        (isinstance(sc.get("supplementalGroups"), list) and
+         0 in sc["supplementalGroups"]))
+
+
 @_k("KSV029", "A root primary or supplementary GID set", "LOW",
     "Containers should be forbidden from running with a root primary "
     "or supplementary GID.",
@@ -535,11 +544,7 @@ def _root_gid(ctx):
     scopes += [(_sec_ctx(c), c, "securityContext")
                for c, _ in ctx.containers]
     for sc, holder, key in scopes:
-        if not isinstance(sc, dict):
-            continue
-        if sc.get("runAsGroup") == 0 or sc.get("fsGroup") == 0 or \
-                (isinstance(sc.get("supplementalGroups"), list) and
-                 0 in sc["supplementalGroups"]):
+        if _has_root_gid(sc):
             yield (f"{ctx.kind} '{ctx.name}' should not set a root "
                    f"group ID", value_range(holder, key))
 
@@ -672,6 +677,41 @@ def _system_namespace(ctx):
                f"the 'kube-system' namespace",
                value_range(md, "namespace") if isinstance(md, PosDict)
                else (0, 0))
+
+
+@_k("KSV110", "Workloads in the default namespace", "LOW",
+    "Checks whether a workload runs in the default namespace, which "
+    "offers no isolation boundary.",
+    "Create and use a dedicated namespace.")
+def _default_namespace(ctx):
+    if ctx.kind not in _WORKLOAD_KINDS:
+        return
+    md = ctx.doc.get("metadata")
+    ns = md.get("namespace") if isinstance(md, dict) else None
+    # only an EXPLICIT default namespace fires — rendered manifests
+    # with no namespace field pass (the helm goldens confirm the
+    # reference bundle behaves this way)
+    if ns == "default":
+        yield (f"{ctx.kind} '{ctx.name}' should not be set with "
+               f"'default' namespace",
+               value_range(md, "namespace")
+               if isinstance(md, PosDict) else (0, 0))
+
+
+@_k("KSV116", "Runs with a root primary or supplementary GID", "LOW",
+    "Containers should be forbidden from running with a root primary "
+    "or supplementary GID.",
+    "Set securityContext gid fields to non-zero values.")
+def _root_gid_pss(ctx):
+    if _has_root_gid(ctx.spec.get("securityContext")):
+        yield (f"{ctx.kind} '{ctx.name}' should not run with a root "
+               f"primary or supplementary GID",
+               value_range(ctx.spec, "securityContext"))
+    for c, crng in ctx.containers:
+        if _sec_ctx(c).get("runAsGroup") == 0:
+            yield (f"Container '{_cname(c)}' of {ctx.kind} "
+                   f"'{ctx.name}' should not run with a root GID",
+                   _rng(c, "securityContext", crng))
 
 
 # --- RBAC checks (Role / ClusterRole documents) ----------------------

@@ -4,6 +4,6 @@ reference's goroutine fan-out (pkg/parallel/pipeline.go) per SURVEY.md
 `db` (the framework's tensor-parallel axis), secret byte-chunks shard
 over `dp` as the sequence axis."""
 
-from .mesh import (MeshDetector, PairPartition,  # noqa: F401
-                   ShardedTable, make_mesh, partition_pairs,
-                   shard_table, sharded_pair_join)
+from .mesh import (MeshDetector, QueryPartition,  # noqa: F401
+                   ShardedTable, make_mesh, partition_queries,
+                   shard_table, sharded_csr_join)

@@ -12,11 +12,14 @@ errgroup pipelines + client/server sharding) with a 2-D
                HBM).
 
 Table shards are split at bucket boundaries (no hash bucket straddles a
-shard), so every candidate pair's advisory row lives in exactly one
-shard; the host routes each pair to its shard and splits each shard's
-pairs dp ways. No collectives are needed inside the step — each device
-evaluates its local pairs against its local table slice, and the output
-spec reassembles the bits.
+shard), so every query's whole bucket lives in exactly one shard; the
+host routes per-QUERY CSR descriptors (bucket start, count, version
+row) to their shard, splitting oversized buckets so pair work
+LPT-balances across dp, and each device expands its own candidate-pair
+list on-chip — multi-chip transfer stays O(queries), matching the
+single-chip csr_pair_join. No collectives are needed inside the step:
+each device evaluates local pairs against its local table slice, and
+the output spec reassembles the bits.
 
 Everything runs under one jit(shard_map(...)).
 """
@@ -100,86 +103,6 @@ def shard_table(table: AdvisoryTable, n_shards: int) -> ShardedTable:
     )
 
 
-@dataclass
-class PairPartition:
-    """Candidate pairs routed to (dp, db) devices, plus the permutation
-    to scatter device bits back into the caller's pair order."""
-    pair_row: np.ndarray  # int32[DP, S, T_loc] shard-local advisory rows
-    pair_ver: np.ndarray  # int32[DP, S, T_loc]
-    valid: np.ndarray     # bool [DP, S, T_loc]
-    perm: np.ndarray      # int64[DP, S, T_loc] original pair index (0 pad)
-
-
-def partition_pairs(st: ShardedTable, pair_row: np.ndarray,
-                    pair_ver: np.ndarray, n_pairs: int, dp: int,
-                    floor: int = 128) -> PairPartition:
-    """Route global candidate pairs to their table shard and balance each
-    shard's pairs across the dp axis."""
-    s_count = st.row_offset.shape[0]
-    rows = pair_row[:n_pairs].astype(np.int64)
-    vers = pair_ver[:n_pairs]
-    shard = np.searchsorted(st.row_offset, rows, side="right") - 1
-    chunks = {}
-    t_loc = floor
-    for s in range(s_count):
-        idx_s = np.nonzero(shard == s)[0]
-        parts = np.array_split(idx_s, dp)
-        chunks[s] = parts
-        for p in parts:
-            t_loc = max(t_loc, _next_pow2(p.size, floor))
-    prow = np.zeros((dp, s_count, t_loc), np.int32)
-    pver = np.zeros((dp, s_count, t_loc), np.int32)
-    valid = np.zeros((dp, s_count, t_loc), bool)
-    perm = np.zeros((dp, s_count, t_loc), np.int64)
-    for s in range(s_count):
-        for d, idx in enumerate(chunks[s]):
-            k = idx.size
-            if not k:
-                continue
-            prow[d, s, :k] = rows[idx] - st.row_offset[s]
-            pver[d, s, :k] = vers[idx]
-            valid[d, s, :k] = True
-            perm[d, s, :k] = idx
-    return PairPartition(prow, pver, valid, perm)
-
-
-@functools.partial(jax.jit, static_argnames=("mesh",))
-def _sharded_pair_join(mesh, adv_lo, adv_hi, adv_flags, ver_tok,
-                       prow, pver, pvalid):
-    def local(adv_lo, adv_hi, adv_flags, ver_tok, prow, pver, pvalid):
-        # inside: adv_* [1, A_pad, ...] (this db shard),
-        # pairs [1, 1, T_loc]; ver_tok replicated — mark varying so the
-        # gathers type-check under shard_map.
-        ver_tok = jax.lax.pcast(ver_tok, ("dp", "db"), to="varying")
-        bits = J._pair_core(adv_lo[0], adv_hi[0], adv_flags[0], ver_tok,
-                            prow[0, 0], pver[0, 0], pvalid[0, 0])
-        return bits[None, None]
-
-    f = shard_map(
-        local, mesh=mesh,
-        in_specs=(P("db"), P("db"), P("db"), P(),
-                  P("dp", "db"), P("dp", "db"), P("dp", "db")),
-        out_specs=P("dp", "db"),
-    )
-    return f(adv_lo, adv_hi, adv_flags, ver_tok, prow, pver, pvalid)
-
-
-def sharded_pair_join(mesh: Mesh, st, ver_tok, part: PairPartition,
-                      n_pairs: int) -> np.ndarray:
-    """Run the pair join across the mesh; → int8[n_pairs] report bits in
-    the caller's original pair order. `st` arrays and `ver_tok` may be
-    host numpy or already-uploaded device arrays."""
-    bits = jax.device_get(_sharded_pair_join(
-        mesh, jnp.asarray(st.lo_tok), jnp.asarray(st.hi_tok),
-        jnp.asarray(st.flags), jnp.asarray(ver_tok),
-        jax.device_put(part.pair_row), jax.device_put(part.pair_ver),
-        jax.device_put(part.valid)))
-    out = np.zeros(n_pairs, np.int8)
-    v = part.valid
-    out[part.perm[v]] = bits[v]
-    return out
-
-
 def sharded_prefix_scan(mesh: Mesh, kw_word4, kw_mask4,
                         chunks: np.ndarray, n_words: int) -> np.ndarray:
     """Secret keyword prefilter sharded over EVERY mesh device: chunk
@@ -238,11 +161,142 @@ class MeshDetector:
         prep = inner._prepare(queries)
         if prep is None or prep.n_pairs == 0:
             return []
-        part = partition_pairs(self.st, prep.pair_row, prep.pair_ver,
-                               prep.n_pairs, self.dp)
+        # CSR descriptors ship (O(queries) transfer); each device
+        # expands its own pair list, like the single-chip path
+        part = partition_queries(self.st, prep.q_start, prep.q_count,
+                                 prep.q_ver, self.dp)
         # the inner detector's cached device pool (re-shipped only on
         # growth) doubles as the replicated mesh operand
-        bits = sharded_pair_join(self.mesh, self._st_dev,
-                                 inner._ver_device(prep.u_pad), part,
-                                 prep.n_pairs)
+        bits = sharded_csr_join(self.mesh, self._st_dev,
+                                inner._ver_device(prep.u_pad), part,
+                                prep.n_pairs)
         return inner._assemble(prep, bits)
+
+
+# ---- CSR query partitioning (transfer O(queries), like the
+# single-chip csr_pair_join) ------------------------------------------
+
+@dataclass
+class QueryPartition:
+    """Queries routed to (dp, db) devices as CSR descriptors. Every
+    query's whole bucket lives in ONE db shard (shards split at bucket
+    boundaries), so routing is per query and the devices expand their
+    own pair lists — multi-chip transfer stays O(queries), matching
+    the single-chip csr_pair_join design."""
+    q_start: np.ndarray   # int32[DP, S, Q_loc] shard-LOCAL bucket start
+    q_count: np.ndarray   # int32[DP, S, Q_loc]
+    q_ver: np.ndarray     # int32[DP, S, Q_loc]
+    total: np.ndarray     # int32[DP, S] true pair count per cell
+    perm: np.ndarray      # int64[DP, S, T_loc] global pair index
+    valid: np.ndarray     # bool [DP, S, T_loc]
+    t_loc: int            # static per-cell pair capacity
+
+
+def partition_queries(st: ShardedTable, q_start: np.ndarray,
+                      q_count: np.ndarray, q_ver: np.ndarray,
+                      dp: int, floor: int = 128,
+                      q_floor: int = 64) -> QueryPartition:
+    """Route queries (global bucket starts/counts) to their table shard
+    and LPT-balance each shard's work across dp by PAIR count.
+
+    A CSR descriptor is just (start, count, version), so an oversized
+    bucket splits into several descriptors with adjusted starts — the
+    real trivy-db's skew (one bucket with thousands of rows) spreads
+    across the dp axis instead of stacking one device."""
+    nz = q_count > 0
+    starts = q_start[nz].astype(np.int64)
+    counts = q_count[nz].astype(np.int64)
+    vers = q_ver[nz]
+    # global pair offsets follow _prepare's expansion order
+    g_off = np.zeros(starts.size + 1, np.int64)
+    np.cumsum(counts, out=g_off[1:])
+    shard = np.searchsorted(st.row_offset, starts, side="right") - 1
+    s_count = st.row_offset.shape[0]
+    # work items: (shard-local start, count, ver, global pair offset);
+    # buckets larger than the per-device fair share split into chunks
+    assign: dict[tuple, list] = {}
+    for s in range(s_count):
+        idx_s = np.nonzero(shard == s)[0]
+        shard_pairs = int(counts[idx_s].sum())
+        cap = max(-(-shard_pairs // dp), 1)
+        items = []
+        for qi in idx_s:
+            local_start = int(starts[qi] - st.row_offset[s])
+            remaining = int(counts[qi])
+            off = 0
+            while remaining > 0:
+                k = min(remaining, cap)
+                items.append((local_start + off, k, int(vers[qi]),
+                              int(g_off[qi]) + off))
+                off += k
+                remaining -= k
+        # LPT: biggest items first onto the least-loaded dp slot
+        items.sort(key=lambda it: -it[1])
+        loads = [0] * dp
+        cells = [[] for _ in range(dp)]
+        for it in items:
+            d = loads.index(min(loads))
+            cells[d].append(it)
+            loads[d] += it[1]
+        for d in range(dp):
+            assign[(d, s)] = cells[d]
+    q_loc = q_floor
+    t_loc = floor
+    for cell in assign.values():
+        q_loc = max(q_loc, _next_pow2(len(cell), q_floor))
+        pairs = sum(it[1] for it in cell)
+        t_loc = max(t_loc, _next_pow2(pairs, floor))
+    qs = np.zeros((dp, s_count, q_loc), np.int32)
+    qc = np.zeros((dp, s_count, q_loc), np.int32)
+    qv = np.zeros((dp, s_count, q_loc), np.int32)
+    total = np.zeros((dp, s_count), np.int32)
+    perm = np.zeros((dp, s_count, t_loc), np.int64)
+    valid = np.zeros((dp, s_count, t_loc), bool)
+    for (d, s), cell in assign.items():
+        off = 0
+        for i, (lstart, k, ver, goff) in enumerate(cell):
+            qs[d, s, i] = lstart
+            qc[d, s, i] = k
+            qv[d, s, i] = ver
+            perm[d, s, off:off + k] = np.arange(goff, goff + k)
+            valid[d, s, off:off + k] = True
+            off += k
+        total[d, s] = off
+    return QueryPartition(qs, qc, qv, total, perm, valid, t_loc)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "t_pad"))
+def _sharded_csr_join(mesh, adv_lo, adv_hi, adv_flags, ver_tok,
+                      qs, qc, qv, total, t_pad):
+    def local(adv_lo, adv_hi, adv_flags, ver_tok, qs, qc, qv, total):
+        ver_tok = jax.lax.pcast(ver_tok, ("dp", "db"), to="varying")
+        bits = J._csr_core(adv_lo[0], adv_hi[0], adv_flags[0], ver_tok,
+                           qs[0, 0], qc[0, 0], qv[0, 0], total[0, 0],
+                           t_pad)
+        return bits[None, None]
+
+    f = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("db"), P("db"), P("db"), P(),
+                  P("dp", "db"), P("dp", "db"), P("dp", "db"),
+                  P("dp", "db")),
+        out_specs=P("dp", "db"),
+    )
+    return f(adv_lo, adv_hi, adv_flags, ver_tok, qs, qc, qv, total)
+
+
+def sharded_csr_join(mesh: Mesh, st, ver_tok, part: QueryPartition,
+                     n_pairs: int) -> np.ndarray:
+    """CSR variant of sharded_pair_join: ships [DP, S, Q_loc]
+    descriptors, devices expand pairs locally. → int8[n_pairs] bits in
+    the caller's original pair order."""
+    bits = jax.device_get(_sharded_csr_join(
+        mesh, jnp.asarray(st.lo_tok), jnp.asarray(st.hi_tok),
+        jnp.asarray(st.flags), jnp.asarray(ver_tok),
+        jax.device_put(part.q_start), jax.device_put(part.q_count),
+        jax.device_put(part.q_ver), jax.device_put(part.total),
+        part.t_loc))
+    out = np.zeros(n_pairs, np.int8)
+    v = part.valid
+    out[part.perm[v]] = bits[v]
+    return out
