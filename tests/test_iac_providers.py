@@ -673,3 +673,172 @@ def test_no_duplicate_check_ids():
            AWS_CHECKS + AZURE_CHECKS + GCP_CHECKS + EXTRA_CHECKS]
     dupes = {i for i in ids if ids.count(i) > 1}
     assert not dupes, dupes
+
+
+def test_aws_breadth_round4():
+    """Round-4 AWS service checks: EKS/ECR/KMS/SQS/SNS/DynamoDB/
+    CloudFront/Redshift/ElastiCache/Lambda."""
+    ids = _ids({"main.tf": """
+resource "aws_eks_cluster" "c" {
+  name = "c"
+}
+
+resource "aws_ecr_repository" "r" {
+  name                 = "r"
+  image_tag_mutability = "MUTABLE"
+}
+
+resource "aws_kms_key" "k" {
+  description = "k"
+}
+
+resource "aws_sqs_queue" "q" {
+  name = "q"
+}
+
+resource "aws_sns_topic" "t" {
+  name = "t"
+}
+
+resource "aws_dynamodb_table" "d" {
+  name = "d"
+}
+
+resource "aws_cloudfront_distribution" "cf" {
+  enabled = true
+  default_cache_behavior {
+    viewer_protocol_policy = "allow-all"
+  }
+}
+
+resource "aws_redshift_cluster" "rs" {
+  cluster_identifier = "rs"
+}
+
+resource "aws_elasticache_replication_group" "ec" {
+  replication_group_id = "ec"
+}
+
+resource "aws_lambda_function" "f" {
+  function_name = "f"
+}
+"""})
+    for want in ("AVD-AWS-0038", "AVD-AWS-0039", "AVD-AWS-0040",
+                 "AVD-AWS-0030", "AVD-AWS-0031", "AVD-AWS-0065",
+                 "AVD-AWS-0096", "AVD-AWS-0095", "AVD-AWS-0024",
+                 "AVD-AWS-0025", "AVD-AWS-0010", "AVD-AWS-0012",
+                 "AVD-AWS-0013", "AVD-AWS-0083", "AVD-AWS-0084",
+                 "AVD-AWS-0045", "AVD-AWS-0046", "AVD-AWS-0066"):
+        assert want in ids, want
+
+
+def test_aws_breadth_clean_configs_pass():
+    ids = _ids({"main.tf": """
+resource "aws_eks_cluster" "c" {
+  name                      = "c"
+  enabled_cluster_log_types = ["api", "audit"]
+  encryption_config {
+    resources = ["secrets"]
+  }
+  vpc_config {
+    endpoint_public_access = false
+  }
+}
+
+resource "aws_ecr_repository" "r" {
+  name                 = "r"
+  image_tag_mutability = "IMMUTABLE"
+  image_scanning_configuration {
+    scan_on_push = true
+  }
+}
+
+resource "aws_kms_key" "sign" {
+  key_usage = "SIGN_VERIFY"
+}
+
+resource "aws_sqs_queue" "q" {
+  name                    = "q"
+  sqs_managed_sse_enabled = true
+}
+
+resource "aws_cloudfront_distribution" "cf" {
+  enabled = true
+  logging_config {
+    bucket = "logs"
+  }
+  default_cache_behavior {
+    viewer_protocol_policy = "redirect-to-https"
+  }
+  viewer_certificate {
+    minimum_protocol_version = "TLSv1.2_2021"
+  }
+}
+
+resource "aws_lambda_function" "f" {
+  function_name = "f"
+  tracing_config {
+    mode = "Active"
+  }
+}
+"""})
+    for not_want in ("AVD-AWS-0038", "AVD-AWS-0039", "AVD-AWS-0040",
+                     "AVD-AWS-0030", "AVD-AWS-0031", "AVD-AWS-0065",
+                     "AVD-AWS-0096", "AVD-AWS-0010", "AVD-AWS-0012",
+                     "AVD-AWS-0013", "AVD-AWS-0066"):
+        assert not_want not in ids, not_want
+
+
+def test_aws_breadth_unknowns_never_fire():
+    """Unresolved variables must not fire the round-4 service checks
+    (unknown-passes convention)."""
+    ids = _ids({"main.tf": """
+variable "key" {}
+variable "logs" {}
+
+resource "aws_sns_topic" "t" {
+  kms_master_key_id = var.key
+}
+
+resource "aws_sqs_queue" "q" {
+  kms_master_key_id = var.key
+}
+
+resource "aws_eks_cluster" "c" {
+  name                      = "c"
+  enabled_cluster_log_types = var.logs
+  encryption_config {
+    resources = ["secrets"]
+  }
+  vpc_config {
+    endpoint_public_access = false
+  }
+}
+
+resource "aws_ecr_repository" "r" {
+  name                 = "r"
+  image_tag_mutability = var.key
+  image_scanning_configuration {
+    scan_on_push = true
+  }
+}
+
+resource "aws_lambda_function" "f" {
+  function_name = "f"
+  tracing_config {
+    mode = var.key
+  }
+}
+"""})
+    assert not ids & {"AVD-AWS-0095", "AVD-AWS-0096", "AVD-AWS-0038",
+                      "AVD-AWS-0031", "AVD-AWS-0066"}
+
+
+def test_eks_audit_log_type_required():
+    ids = _ids({"main.tf": """
+resource "aws_eks_cluster" "c" {
+  name                      = "c"
+  enabled_cluster_log_types = ["api"]
+}
+"""})
+    assert "AVD-AWS-0038" in ids  # audit missing from the list

@@ -88,19 +88,6 @@ def test_sharded_join_skewed_buckets(table):
     assert sharded == single
 
 
-def test_partition_pairs_covers_all(table):
-    """Every global pair appears exactly once across the mesh cells
-    (CSR descriptors expand to the same pair set the host built)."""
-    from trivy_tpu.detect.engine import BatchDetector
-    det = BatchDetector(table)
-    prep = det._prepare(_queries())
-    st = shard_table(table, 2)
-    part = partition_queries(st, prep.q_start, prep.q_count,
-                             prep.q_ver, dp=3)
-    got = np.sort(part.perm[part.valid])
-    assert np.array_equal(got, np.arange(prep.n_pairs))
-
-
 def test_shard_table_bucket_boundaries(table):
     st = shard_table(table, 4)
     h64 = table.hash_u64

@@ -494,6 +494,228 @@ def _iam_wildcards(resources):
                             break
 
 
+# --- round-4 breadth: EKS / ECR / KMS / queues / caches / CDN -------
+
+@_aws("AVD-AWS-0038", "EKS clusters should have control plane audit "
+      "logging enabled", "MEDIUM", "eks",
+      "Audit logs record API requests to the cluster control plane.",
+      "Enable all control-plane log types in enabled_cluster_log_types")
+def _eks_logging(resources):
+    for r in _of(resources, "aws_eks_cluster"):
+        if r.unknown("enabled_log_types"):
+            continue
+        logs = r.get("enabled_log_types") or []
+        if "audit" not in logs:
+            yield (f"EKS cluster '{r.name}' has control plane audit "
+                   f"logging disabled.", r.rng)
+
+
+@_aws("AVD-AWS-0039", "EKS clusters should have secrets encryption "
+      "enabled", "HIGH", "eks",
+      "Secrets encryption protects Kubernetes secrets with a KMS key.",
+      "Add an encryption_config block with a KMS key.")
+def _eks_secrets(resources):
+    for r in _of(resources, "aws_eks_cluster"):
+        if _falsy(r.val("secrets_encrypted")):
+            yield (f"EKS cluster '{r.name}' does not encrypt secrets.",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0040", "EKS cluster endpoint should not be publicly "
+      "accessible", "CRITICAL", "eks",
+      "A public API endpoint exposes the control plane to the "
+      "internet.",
+      "Set endpoint_public_access = false or restrict the CIDRs.")
+def _eks_public(resources):
+    for r in _of(resources, "aws_eks_cluster"):
+        if _truthy(r.val("endpoint_public_access")) and \
+                "0.0.0.0/0" in (r.get("public_access_cidrs") or
+                                ["0.0.0.0/0"]):
+            yield (f"EKS cluster '{r.name}' has a publicly accessible "
+                   f"API endpoint.", r.attr_rng("endpoint_public_access"))
+
+
+@_aws("AVD-AWS-0030", "ECR repositories should have image scanning "
+      "enabled", "HIGH", "ecr",
+      "Scan on push surfaces vulnerabilities before images deploy.",
+      "Set image_scanning_configuration.scan_on_push = true.")
+def _ecr_scanning(resources):
+    for r in _of(resources, "aws_ecr_repository"):
+        if _falsy(r.val("scan_on_push")):
+            yield (f"ECR repository '{r.name}' does not scan images on "
+                   f"push.", r.attr_rng("scan_on_push"))
+
+
+@_aws("AVD-AWS-0031", "ECR repositories should have immutable tags",
+      "HIGH", "ecr",
+      "Mutable tags allow silently replacing a deployed image.",
+      "Set image_tag_mutability = IMMUTABLE.")
+def _ecr_immutable(resources):
+    for r in _of(resources, "aws_ecr_repository"):
+        if r.unknown("image_tag_mutability"):
+            continue
+        if r.get("image_tag_mutability", "MUTABLE") != "IMMUTABLE":
+            yield (f"ECR repository '{r.name}' allows mutable tags.",
+                   r.attr_rng("image_tag_mutability"))
+
+
+@_aws("AVD-AWS-0065", "KMS keys should have rotation enabled", "MEDIUM",
+      "kms",
+      "Rotation bounds the blast radius of a compromised key.",
+      "Set enable_key_rotation = true.")
+def _kms_rotation(resources):
+    for r in _of(resources, "aws_kms_key"):
+        if r.get("key_usage", "ENCRYPT_DECRYPT") != "ENCRYPT_DECRYPT":
+            continue  # signing keys cannot rotate
+        if _falsy(r.val("enable_key_rotation")):
+            yield (f"KMS key '{r.name}' does not have rotation "
+                   f"enabled.", r.attr_rng("enable_key_rotation"))
+
+
+@_aws("AVD-AWS-0096", "SQS queues should be encrypted", "HIGH", "sqs",
+      "Queue messages may carry sensitive payloads.",
+      "Set kms_master_key_id or sqs_managed_sse_enabled = true.")
+def _sqs_encryption(resources):
+    for r in _of(resources, "aws_sqs_queue"):
+        if r.unknown("kms_master_key_id"):
+            continue
+        if not r.get("kms_master_key_id") and \
+                _falsy(r.val("sqs_managed_sse_enabled")):
+            yield (f"SQS queue '{r.name}' is not encrypted.", r.rng)
+
+
+@_aws("AVD-AWS-0095", "SNS topics should be encrypted", "HIGH", "sns",
+      "Topic messages may carry sensitive payloads.",
+      "Set kms_master_key_id.")
+def _sns_encryption(resources):
+    for r in _of(resources, "aws_sns_topic"):
+        if r.unknown("kms_master_key_id"):
+            continue
+        if not r.get("kms_master_key_id"):
+            yield (f"SNS topic '{r.name}' is not encrypted.", r.rng)
+
+
+@_aws("AVD-AWS-0024", "DynamoDB tables should have point-in-time "
+      "recovery", "MEDIUM", "dynamodb",
+      "PITR protects table data against accidental writes/deletes.",
+      "Add a point_in_time_recovery block with enabled = true.")
+def _dynamo_pitr(resources):
+    for r in _of(resources, "aws_dynamodb_table"):
+        if _falsy(r.val("pitr_enabled")):
+            yield (f"DynamoDB table '{r.name}' does not have "
+                   f"point-in-time recovery.", r.rng)
+
+
+@_aws("AVD-AWS-0025", "DynamoDB tables should use customer-managed KMS "
+      "keys", "LOW", "dynamodb",
+      "Customer-managed keys allow rotation and revocation control.",
+      "Add server_side_encryption with a kms_key_arn.")
+def _dynamo_cmk(resources):
+    for r in _of(resources, "aws_dynamodb_table"):
+        if r.unknown("sse_kms_key"):
+            continue
+        if not r.get("sse_kms_key"):
+            yield (f"DynamoDB table '{r.name}' is not encrypted with a "
+                   f"customer-managed key.", r.rng)
+
+
+@_aws("AVD-AWS-0010", "CloudFront distributions should have logging "
+      "enabled", "MEDIUM", "cloudfront",
+      "Access logs are the audit trail for content delivery.",
+      "Add a logging_config block.")
+def _cf_logging(resources):
+    for r in _of(resources, "aws_cloudfront_distribution"):
+        if _falsy(r.val("logging_enabled")):
+            yield (f"CloudFront distribution '{r.name}' does not have "
+                   f"logging enabled.", r.rng)
+
+
+@_aws("AVD-AWS-0012", "CloudFront distributions should enforce HTTPS",
+      "HIGH", "cloudfront",
+      "allow-all viewer protocol policy serves content over plain "
+      "HTTP.",
+      "Set viewer_protocol_policy to redirect-to-https or https-only.")
+def _cf_https(resources):
+    for r in _of(resources, "aws_cloudfront_distribution"):
+        for vp in r.get("viewer_policies", []):
+            if vp.get("policy") == "allow-all":
+                yield (f"CloudFront distribution '{r.name}' allows "
+                       f"plain HTTP.", vp.get("rng", r.rng))
+
+
+@_aws("AVD-AWS-0013", "CloudFront distributions should use a secure "
+      "TLS policy", "HIGH", "cloudfront",
+      "Old TLS protocol versions have known weaknesses.",
+      "Set minimum_protocol_version to TLSv1.2_2021.")
+def _cf_tls(resources):
+    for r in _of(resources, "aws_cloudfront_distribution"):
+        if r.unknown("minimum_protocol_version"):
+            continue
+        v = r.get("minimum_protocol_version", "TLSv1")
+        if v not in ("TLSv1.2_2021",):
+            yield (f"CloudFront distribution '{r.name}' allows TLS "
+                   f"below the TLSv1.2_2021 policy.", r.rng)
+
+
+@_aws("AVD-AWS-0083", "Redshift clusters should be encrypted", "HIGH",
+      "redshift",
+      "Warehouse data at rest should be encrypted.",
+      "Set encrypted = true with a KMS key.")
+def _redshift_encrypted(resources):
+    for r in _of(resources, "aws_redshift_cluster"):
+        if _falsy(r.val("encrypted")):
+            yield (f"Redshift cluster '{r.name}' is not encrypted.",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0084", "Redshift clusters should be deployed in a VPC",
+      "HIGH", "redshift",
+      "EC2-Classic deployment bypasses VPC network controls.",
+      "Set cluster_subnet_group_name.")
+def _redshift_vpc(resources):
+    for r in _of(resources, "aws_redshift_cluster"):
+        if r.unknown("subnet_group"):
+            continue
+        if not r.get("subnet_group"):
+            yield (f"Redshift cluster '{r.name}' is not deployed in a "
+                   f"VPC.", r.rng)
+
+
+@_aws("AVD-AWS-0045", "ElastiCache replication groups should be "
+      "encrypted at rest", "HIGH", "elasticache",
+      "Cache contents may include session and credential data.",
+      "Set at_rest_encryption_enabled = true.")
+def _elasticache_rest(resources):
+    for r in _of(resources, "aws_elasticache_replication_group"):
+        if _falsy(r.val("at_rest_encryption_enabled")):
+            yield (f"ElastiCache replication group '{r.name}' is not "
+                   f"encrypted at rest.", r.rng)
+
+
+@_aws("AVD-AWS-0046", "ElastiCache replication groups should encrypt "
+      "traffic in transit", "HIGH", "elasticache",
+      "Unencrypted cache traffic exposes payloads on the network.",
+      "Set transit_encryption_enabled = true.")
+def _elasticache_transit(resources):
+    for r in _of(resources, "aws_elasticache_replication_group"):
+        if _falsy(r.val("transit_encryption_enabled")):
+            yield (f"ElastiCache replication group '{r.name}' does not "
+                   f"encrypt traffic in transit.", r.rng)
+
+
+@_aws("AVD-AWS-0066", "Lambda functions should have tracing enabled",
+      "LOW", "lambda",
+      "X-Ray tracing aids incident analysis of function behavior.",
+      "Set tracing_config.mode to Active.")
+def _lambda_tracing(resources):
+    for r in _of(resources, "aws_lambda_function"):
+        if r.unknown("tracing_mode"):
+            continue
+        if r.get("tracing_mode", "PassThrough") != "Active":
+            yield (f"Lambda function '{r.name}' does not have tracing "
+                   f"enabled.", r.rng)
+
+
 def run_aws_checks(resources, file_type, text):
     """→ (failures, successes) for adapted AWS resources."""
     from .core import run_checks

@@ -16,7 +16,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from .. import types as T
-from .cloud import (AWS_CHECKS, UNKNOWN, Attr, CloudResource, Unknown)
+from .cloud import (AWS_CHECKS, UNKNOWN, Attr, CloudResource,
+                    Unknown, block_attr)
 from .core import build_misconf, ignored_ids_by_line, is_ignored
 from .hcl import Block, HclError, Ref, Scope, evaluate, parse
 
@@ -279,6 +280,106 @@ def adapt_terraform(module: TfModule) -> list[CloudResource]:
         elif t in ("aws_iam_policy", "aws_iam_role_policy",
                    "aws_iam_user_policy", "aws_iam_group_policy"):
             _a(res, "policy", cr, "policy_document")
+            out.append(cr)
+
+        elif t == "aws_eks_cluster":
+            logs = res.value("enabled_cluster_log_types")
+            if not isinstance(logs, (list, Unknown)):
+                logs = []
+            cr.attrs["enabled_log_types"] = Attr(
+                logs, res.rng("enabled_cluster_log_types"))
+            cr.attrs["secrets_encrypted"] = Attr(
+                bool(res.blocks("encryption_config")))
+            pub, cidrs = True, []
+            p_rng = cr.rng
+            for b in res.blocks("vpc_config"):
+                pub, p_rng = block_attr(module, b,
+                                           "endpoint_public_access",
+                                           True)
+                c, _ = block_attr(module, b, "public_access_cidrs",
+                                     None)
+                if isinstance(c, list):
+                    cidrs = [x for x in c if isinstance(x, str)]
+            cr.attrs["endpoint_public_access"] = Attr(pub, p_rng)
+            if cidrs:
+                cr.attrs["public_access_cidrs"] = Attr(cidrs)
+            out.append(cr)
+
+        elif t == "aws_ecr_repository":
+            scan = False
+            s_rng = cr.rng
+            for b in res.blocks("image_scanning_configuration"):
+                scan, s_rng = block_attr(module, b, "scan_on_push",
+                                            False)
+            cr.attrs["scan_on_push"] = Attr(scan, s_rng)
+            _a(res, "image_tag_mutability", cr)
+            out.append(cr)
+
+        elif t == "aws_kms_key":
+            _a(res, "enable_key_rotation", cr)
+            _a(res, "key_usage", cr)
+            out.append(cr)
+
+        elif t == "aws_sqs_queue":
+            _a(res, "kms_master_key_id", cr)
+            _a(res, "sqs_managed_sse_enabled", cr)
+            out.append(cr)
+
+        elif t == "aws_sns_topic":
+            _a(res, "kms_master_key_id", cr)
+            out.append(cr)
+
+        elif t == "aws_dynamodb_table":
+            pitr = False
+            for b in res.blocks("point_in_time_recovery"):
+                pitr, _ = block_attr(module, b, "enabled", False)
+            cr.attrs["pitr_enabled"] = Attr(pitr)
+            kms = ""
+            for b in res.blocks("server_side_encryption"):
+                kms, _ = block_attr(module, b, "kms_key_arn", "")
+            cr.attrs["sse_kms_key"] = Attr(kms)
+            out.append(cr)
+
+        elif t == "aws_cloudfront_distribution":
+            cr.attrs["logging_enabled"] = Attr(
+                bool(res.blocks("logging_config")))
+            policies = []
+            for btype in ("default_cache_behavior",
+                          "ordered_cache_behavior"):
+                for b in res.blocks(btype):
+                    vp, rng = block_attr(module, b,
+                                            "viewer_protocol_policy",
+                                            "")
+                    if isinstance(vp, str) and vp:
+                        policies.append({"policy": vp, "rng": rng})
+            cr.attrs["viewer_policies"] = Attr(policies)
+            mpv = "TLSv1"
+            for b in res.blocks("viewer_certificate"):
+                default_cert, _ = block_attr(
+                    module, b, "cloudfront_default_certificate", False)
+                mpv, _ = block_attr(module, b,
+                                    "minimum_protocol_version",
+                                    "TLSv1")
+                if default_cert is True:
+                    mpv = "TLSv1"   # default cert caps the policy
+            cr.attrs["minimum_protocol_version"] = Attr(mpv)
+            out.append(cr)
+
+        elif t == "aws_redshift_cluster":
+            _a(res, "encrypted", cr)
+            _a(res, "cluster_subnet_group_name", cr, "subnet_group")
+            out.append(cr)
+
+        elif t == "aws_elasticache_replication_group":
+            _a(res, "at_rest_encryption_enabled", cr)
+            _a(res, "transit_encryption_enabled", cr)
+            out.append(cr)
+
+        elif t == "aws_lambda_function":
+            mode = "PassThrough"
+            for b in res.blocks("tracing_config"):
+                mode, _ = block_attr(module, b, "mode", "PassThrough")
+            cr.attrs["tracing_mode"] = Attr(mode)
             out.append(cr)
 
     # second pass: companion resources joined to their parent
