@@ -842,3 +842,113 @@ resource "aws_eks_cluster" "c" {
 }
 """})
     assert "AVD-AWS-0038" in ids  # audit missing from the list
+
+
+def test_azurerm_terraform_resources_reach_azure_checks():
+    """azurerm_* terraform modules run the same AZURE_CHECKS the ARM
+    scanner uses (previously terraform azurerm was unscanned)."""
+    ids = _ids({"main.tf": """
+resource "azurerm_storage_account" "sa" {
+  name                      = "sa"
+  enable_https_traffic_only = false
+  min_tls_version           = "TLS1_0"
+}
+
+resource "azurerm_network_security_rule" "r" {
+  name                  = "r"
+  access                = "Allow"
+  direction             = "Inbound"
+  source_address_prefix = "0.0.0.0/0"
+  destination_port_range = "22"
+}
+
+resource "azurerm_key_vault" "kv" {
+  name                     = "kv"
+  purge_protection_enabled = false
+  network_acls {
+    default_action = "Allow"
+  }
+}
+
+resource "azurerm_linux_virtual_machine" "vm" {
+  name                            = "vm"
+  disable_password_authentication = false
+}
+
+resource "azurerm_kubernetes_cluster" "aks" {
+  name                              = "aks"
+  role_based_access_control_enabled = false
+}
+"""})
+    assert any(i.startswith("AVD-AZU") for i in ids)
+    for want in ("AVD-AZU-0008",    # https traffic only
+                 "AVD-AZU-0011",    # TLS policy
+                 "AVD-AZU-0016",    # purge protection
+                 "AVD-AZU-0013",    # key vault network acls
+                 "AVD-AZU-0039",    # vm password auth
+                 "AVD-AZU-0042",    # AKS RBAC
+                 "AVD-AZU-0050"):   # SSH from internet
+        assert want in ids, want
+
+
+def test_azurerm_clean_config_passes():
+    ids = _ids({"main.tf": """
+resource "azurerm_storage_account" "sa" {
+  name                      = "sa"
+  enable_https_traffic_only = true
+  min_tls_version           = "TLS1_2"
+}
+
+resource "azurerm_kubernetes_cluster" "aks" {
+  name = "aks"
+  role_based_access_control {
+    enabled = true
+  }
+}
+"""})
+    assert not ids & {"AVD-AZU-0008", "AVD-AZU-0011", "AVD-AZU-0042"}
+
+
+def test_azurerm_and_eks_unknown_regressions():
+    """Review regressions: Unknown NSG lists neither crash nor fire;
+    EKS encryption must cover 'secrets'; unresolved public CIDRs and
+    log elements never fire; azurerm false-by-default fields fire when
+    omitted."""
+    ids = _ids({"main.tf": """
+variable "prefixes" {}
+variable "extra" {}
+
+resource "azurerm_network_security_rule" "r" {
+  name                    = "r"
+  access                  = "Allow"
+  direction               = "Inbound"
+  source_address_prefixes = var.prefixes
+  destination_port_range  = "22"
+}
+
+resource "aws_eks_cluster" "c" {
+  name                      = "c"
+  enabled_cluster_log_types = ["api", var.extra]
+  encryption_config {
+    resources = ["none"]
+  }
+  vpc_config {
+    endpoint_public_access = true
+    public_access_cidrs    = [var.extra]
+  }
+}
+
+resource "azurerm_key_vault" "kv" {
+  name = "kv"
+}
+
+resource "azurerm_app_service" "app" {
+  name = "app"
+}
+"""})
+    assert "AVD-AZU-0050" not in ids   # unknown prefixes: no crash/fire
+    assert "AVD-AWS-0039" in ids       # encryption_config without secrets
+    assert "AVD-AWS-0040" not in ids   # unresolved CIDR list
+    assert "AVD-AWS-0038" not in ids   # unresolved log element
+    assert "AVD-AZU-0016" in ids       # purge protection default off
+    assert "AVD-AZU-0002" in ids       # https_only default off

@@ -1003,3 +1003,85 @@ def is_arm_template(doc) -> bool:
     return isinstance(doc, dict) and (
         "deploymentTemplate.json" in str(doc.get("$schema", "")) or
         ("resources" in doc and "contentVersion" in doc))
+
+
+# ---- terraform azurerm adapter --------------------------------------
+
+_TF_AZURE_KINDS = {
+    "azurerm_storage_account", "azurerm_storage_container",
+    "azurerm_network_security_rule", "azurerm_key_vault",
+    "azurerm_key_vault_secret", "azurerm_postgresql_server",
+    "azurerm_mssql_server", "azurerm_sql_firewall_rule",
+    "azurerm_app_service", "azurerm_linux_virtual_machine",
+    "azurerm_kubernetes_cluster",
+}
+
+
+def adapt_azurerm(module) -> list:
+    """Terraform azurerm_* resources → the same CloudResource shapes
+    the ARM-template adapter produces (the AZURE_CHECKS read terraform
+    argument names — the ARM adapter normalizes TO them, reference
+    pkg/iac/adapters/{arm,terraform}/azure share one provider
+    model)."""
+    from .cloud import Attr, CloudResource, block_attr
+
+    out = []
+    for res in module.resources:
+        t = res.type
+        if t not in _TF_AZURE_KINDS:
+            continue
+        cr = CloudResource(t, res.name, rng=res.rng(), path=res.path)
+        for key, (value, rng) in res.attrs.items():
+            cr.attrs[key] = Attr(value, rng)
+        if t == "azurerm_network_security_rule":
+            # singular argument variants normalize to the plural lists
+            for single, plural in (
+                    ("source_address_prefix",
+                     "source_address_prefixes"),
+                    ("destination_address_prefix",
+                     "destination_address_prefixes"),
+                    ("destination_port_range",
+                     "destination_port_ranges")):
+                if plural not in cr.attrs and single in cr.attrs:
+                    a = cr.attrs[single]
+                    cr.attrs[plural] = Attr([a.value], a.rng)
+            # the NSG checks iterate these lists (the ARM adapter
+            # pre-sanitizes); Unknown values/elements must neither
+            # crash nor fire
+            from .cloud import Unknown as _Unk
+            for key in ("source_address_prefixes",
+                        "destination_address_prefixes",
+                        "destination_port_ranges"):
+                a = cr.attrs.get(key)
+                if a is None:
+                    continue
+                if isinstance(a.value, _Unk):
+                    cr.attrs[key] = Attr([], a.rng)
+                elif isinstance(a.value, list):
+                    cr.attrs[key] = Attr(
+                        [x for x in a.value
+                         if isinstance(x, (str, int))], a.rng)
+        elif t == "azurerm_key_vault":
+            for b in res.blocks("network_acls"):
+                v, rng = block_attr(module, b, "default_action", "")
+                cr.attrs["network_acls_default_action"] = Attr(v, rng)
+            # terraform default: purge protection off
+            if "purge_protection_enabled" not in cr.attrs:
+                cr.attrs["purge_protection_enabled"] = Attr(False)
+        elif t == "azurerm_app_service":
+            # terraform default: https_only off
+            if "https_only" not in cr.attrs:
+                cr.attrs["https_only"] = Attr(False)
+        elif t == "azurerm_linux_virtual_machine":
+            # terraform default: password auth DISABLED unless set
+            if "disable_password_authentication" not in cr.attrs:
+                cr.attrs["disable_password_authentication"] = \
+                    Attr(True)
+        elif t == "azurerm_kubernetes_cluster":
+            # legacy nested block form: role_based_access_control {}
+            for b in res.blocks("role_based_access_control"):
+                v, rng = block_attr(module, b, "enabled", True)
+                cr.attrs["role_based_access_control_enabled"] = \
+                    Attr(v, rng)
+        out.append(cr)
+    return out

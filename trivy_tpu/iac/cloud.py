@@ -505,6 +505,8 @@ def _eks_logging(resources):
         if r.unknown("enabled_log_types"):
             continue
         logs = r.get("enabled_log_types") or []
+        if any(not isinstance(x, str) for x in logs):
+            continue   # an unresolved element could be "audit"
         if "audit" not in logs:
             yield (f"EKS cluster '{r.name}' has control plane audit "
                    f"logging disabled.", r.rng)
@@ -528,6 +530,8 @@ def _eks_secrets(resources):
       "Set endpoint_public_access = false or restrict the CIDRs.")
 def _eks_public(resources):
     for r in _of(resources, "aws_eks_cluster"):
+        if r.unknown("public_access_cidrs"):
+            continue
         if _truthy(r.val("endpoint_public_access")) and \
                 "0.0.0.0/0" in (r.get("public_access_cidrs") or
                                 ["0.0.0.0/0"]):

@@ -288,20 +288,31 @@ def adapt_terraform(module: TfModule) -> list[CloudResource]:
                 logs = []
             cr.attrs["enabled_log_types"] = Attr(
                 logs, res.rng("enabled_cluster_log_types"))
-            cr.attrs["secrets_encrypted"] = Attr(
-                bool(res.blocks("encryption_config")))
-            pub, cidrs = True, []
+            encrypted = False
+            for b in res.blocks("encryption_config"):
+                enc_res, _ = block_attr(module, b, "resources", None)
+                if isinstance(enc_res, Unknown):
+                    encrypted = UNKNOWN
+                elif isinstance(enc_res, list) and \
+                        "secrets" in enc_res:
+                    encrypted = True
+            cr.attrs["secrets_encrypted"] = Attr(encrypted)
+            pub, cidrs = True, None
             p_rng = cr.rng
             for b in res.blocks("vpc_config"):
                 pub, p_rng = block_attr(module, b,
-                                           "endpoint_public_access",
-                                           True)
+                                        "endpoint_public_access",
+                                        True)
                 c, _ = block_attr(module, b, "public_access_cidrs",
-                                     None)
-                if isinstance(c, list):
+                                  None)
+                if isinstance(c, Unknown) or (
+                        isinstance(c, list) and
+                        any(not isinstance(x, str) for x in c)):
+                    cidrs = UNKNOWN   # unresolved: must not fire
+                elif isinstance(c, list):
                     cidrs = [x for x in c if isinstance(x, str)]
             cr.attrs["endpoint_public_access"] = Attr(pub, p_rng)
-            if cidrs:
+            if cidrs is not None:
                 cr.attrs["public_access_cidrs"] = Attr(cidrs)
             out.append(cr)
 
@@ -461,9 +472,11 @@ def _tf_providers():
     provider's checks run (and count successes) only when the module
     actually uses that provider — absent state passes trivially, the
     way the reference's rego sees empty input documents."""
+    from .azure import AZURE_CHECKS, adapt_azurerm
     from .gcp import GCP_CHECKS, adapt_google
     from .providers_extra import EXTRA_CHECKS, adapt_extra
     return [(adapt_terraform, AWS_CHECKS),
+            (adapt_azurerm, AZURE_CHECKS),
             (adapt_google, GCP_CHECKS),
             (adapt_extra, EXTRA_CHECKS)]
 
