@@ -952,3 +952,130 @@ resource "azurerm_app_service" "app" {
     assert "AVD-AWS-0038" not in ids   # unresolved log element
     assert "AVD-AZU-0016" in ids       # purge protection default off
     assert "AVD-AZU-0002" in ids       # https_only default off
+
+
+def test_cloudformation_round4_aws_types():
+    """The round-4 AWS service checks fire from CloudFormation
+    templates too (dialect parity with terraform)."""
+    from trivy_tpu.iac.cloudformation import scan_cloudformation
+    template = b"""
+Resources:
+  Cluster:
+    Type: AWS::EKS::Cluster
+    Properties:
+      Name: prod
+  Repo:
+    Type: AWS::ECR::Repository
+    Properties:
+      ImageTagMutability: MUTABLE
+  Key:
+    Type: AWS::KMS::Key
+    Properties:
+      Description: k
+  Queue:
+    Type: AWS::SQS::Queue
+    Properties:
+      QueueName: q
+  Table:
+    Type: AWS::DynamoDB::Table
+    Properties:
+      TableName: t
+  Fn:
+    Type: AWS::Lambda::Function
+    Properties:
+      FunctionName: f
+"""
+    failures, _ = scan_cloudformation("stack.yaml", template)
+    ids = {f.id for f in failures}
+    for want in ("AVD-AWS-0038", "AVD-AWS-0031", "AVD-AWS-0065",
+                 "AVD-AWS-0096", "AVD-AWS-0024", "AVD-AWS-0066"):
+        assert want in ids, want
+
+    clean = b"""
+Resources:
+  Cluster:
+    Type: AWS::EKS::Cluster
+    Properties:
+      Logging:
+        ClusterLogging:
+          EnabledTypes:
+            - Type: audit
+      EncryptionConfig:
+        - Resources: [secrets]
+      ResourcesVpcConfig:
+        EndpointPublicAccess: false
+  Repo:
+    Type: AWS::ECR::Repository
+    Properties:
+      ImageTagMutability: IMMUTABLE
+      ImageScanningConfiguration:
+        ScanOnPush: true
+  Key:
+    Type: AWS::KMS::Key
+    Properties:
+      EnableKeyRotation: true
+"""
+    failures2, _ = scan_cloudformation("stack.yaml", clean)
+    ids2 = {f.id for f in failures2}
+    assert not ids2 & {"AVD-AWS-0038", "AVD-AWS-0039", "AVD-AWS-0040",
+                       "AVD-AWS-0030", "AVD-AWS-0031", "AVD-AWS-0065"}
+
+
+def test_cloudformation_unknowns_and_defaults():
+    """CFN review regressions: unresolved intrinsics never fire, string
+    booleans are honored, and a bare EKS cluster is public by AWS
+    default."""
+    from trivy_tpu.iac.cloudformation import scan_cloudformation
+    parameterized = b"""
+Parameters:
+  Cfg:
+    Type: String
+Resources:
+  Cluster:
+    Type: AWS::EKS::Cluster
+    Properties:
+      Logging: !Ref Cfg
+      EncryptionConfig: !Ref Cfg
+      ResourcesVpcConfig: !Ref Cfg
+  Table:
+    Type: AWS::DynamoDB::Table
+    Properties:
+      PointInTimeRecoverySpecification: !Ref Cfg
+      SSESpecification: !Ref Cfg
+  Fn:
+    Type: AWS::Lambda::Function
+    Properties:
+      TracingConfig: !Ref Cfg
+"""
+    failures, _ = scan_cloudformation("stack.yaml", parameterized)
+    ids = {f.id for f in failures}
+    assert not ids & {"AVD-AWS-0038", "AVD-AWS-0039", "AVD-AWS-0040",
+                      "AVD-AWS-0024", "AVD-AWS-0025", "AVD-AWS-0066"}
+
+    string_bools = b"""
+Resources:
+  Repo:
+    Type: AWS::ECR::Repository
+    Properties:
+      ImageScanningConfiguration:
+        ScanOnPush: "false"
+  Table:
+    Type: AWS::DynamoDB::Table
+    Properties:
+      PointInTimeRecoverySpecification:
+        PointInTimeRecoveryEnabled: "false"
+"""
+    failures2, _ = scan_cloudformation("stack.yaml", string_bools)
+    ids2 = {f.id for f in failures2}
+    assert "AVD-AWS-0030" in ids2
+    assert "AVD-AWS-0024" in ids2
+
+    bare_cluster = b"""
+Resources:
+  Cluster:
+    Type: AWS::EKS::Cluster
+    Properties:
+      Name: prod
+"""
+    failures3, _ = scan_cloudformation("stack.yaml", bare_cluster)
+    assert "AVD-AWS-0040" in {f.id for f in failures3}

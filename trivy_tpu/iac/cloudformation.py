@@ -275,6 +275,128 @@ def adapt_cloudformation(template) -> list[CloudResource]:
             put(r, "policy_document", attr("PolicyDocument"))
             out.append(r)
 
+        elif rtype == "AWS::EKS::Cluster":
+            r = CloudResource("aws_eks_cluster", logical_id,
+                              rng=res_rng)
+            logging = _resolve(props.get("Logging"), params)
+            if isinstance(logging, Unknown):
+                r.attrs["enabled_log_types"] = Attr(UNKNOWN)
+            elif isinstance(logging, dict):
+                types = []
+                cl = logging.get("ClusterLogging")
+                if isinstance(cl, dict):
+                    for entry in cl.get("EnabledTypes") or []:
+                        if isinstance(entry, dict):
+                            # unresolved Type stays non-str so the
+                            # audit check's element guard skips it
+                            types.append(entry.get("Type"))
+                r.attrs["enabled_log_types"] = Attr(types)
+            enc = _resolve(props.get("EncryptionConfig"), params)
+            if isinstance(enc, Unknown):
+                r.attrs["secrets_encrypted"] = Attr(UNKNOWN)
+            elif isinstance(enc, list):
+                encrypted = any(
+                    isinstance(e, dict) and
+                    "SECRETS" in [str(x).upper() for x in
+                                  (e.get("Resources") or [])]
+                    for e in enc)
+                r.attrs["secrets_encrypted"] = Attr(encrypted)
+            vpc = _resolve(props.get("ResourcesVpcConfig"), params)
+            # AWS default: the endpoint is public
+            pub = True
+            if isinstance(vpc, Unknown):
+                pub = UNKNOWN
+            elif isinstance(vpc, dict):
+                pub = vpc.get("EndpointPublicAccess", True)
+                cidrs = vpc.get("PublicAccessCidrs")
+                if isinstance(cidrs, Unknown) or (
+                        isinstance(cidrs, list) and
+                        any(not isinstance(c, str) for c in cidrs)):
+                    r.attrs["public_access_cidrs"] = Attr(UNKNOWN)
+                elif isinstance(cidrs, list):
+                    r.attrs["public_access_cidrs"] = Attr(cidrs)
+            r.attrs["endpoint_public_access"] = Attr(pub)
+            out.append(r)
+
+        elif rtype == "AWS::ECR::Repository":
+            r = CloudResource("aws_ecr_repository", logical_id,
+                              rng=res_rng)
+            scan_cfg = _resolve(props.get("ImageScanningConfiguration"),
+                                params)
+            if isinstance(scan_cfg, Unknown):
+                r.attrs["scan_on_push"] = Attr(UNKNOWN)
+            elif isinstance(scan_cfg, dict):
+                # raw value: _truthy/_falsy handle string booleans
+                r.attrs["scan_on_push"] = Attr(
+                    scan_cfg.get("ScanOnPush"))
+            put(r, "image_tag_mutability", attr("ImageTagMutability"))
+            out.append(r)
+
+        elif rtype == "AWS::KMS::Key":
+            r = CloudResource("aws_kms_key", logical_id, rng=res_rng)
+            put(r, "enable_key_rotation", attr("EnableKeyRotation"))
+            put(r, "key_usage", attr("KeyUsage"))
+            out.append(r)
+
+        elif rtype == "AWS::SQS::Queue":
+            r = CloudResource("aws_sqs_queue", logical_id, rng=res_rng)
+            put(r, "kms_master_key_id", attr("KmsMasterKeyId"))
+            put(r, "sqs_managed_sse_enabled", attr("SqsManagedSseEnabled"))
+            out.append(r)
+
+        elif rtype == "AWS::SNS::Topic":
+            r = CloudResource("aws_sns_topic", logical_id, rng=res_rng)
+            put(r, "kms_master_key_id", attr("KmsMasterKeyId"))
+            out.append(r)
+
+        elif rtype == "AWS::DynamoDB::Table":
+            r = CloudResource("aws_dynamodb_table", logical_id,
+                              rng=res_rng)
+            pitr = _resolve(
+                props.get("PointInTimeRecoverySpecification"), params)
+            if isinstance(pitr, Unknown):
+                r.attrs["pitr_enabled"] = Attr(UNKNOWN)
+            else:
+                r.attrs["pitr_enabled"] = Attr(
+                    pitr.get("PointInTimeRecoveryEnabled")
+                    if isinstance(pitr, dict) else False)
+            sse = _resolve(props.get("SSESpecification"), params)
+            if isinstance(sse, Unknown):
+                r.attrs["sse_kms_key"] = Attr(UNKNOWN)
+            else:
+                r.attrs["sse_kms_key"] = Attr(
+                    sse.get("KMSMasterKeyId", "")
+                    if isinstance(sse, dict) else "")
+            out.append(r)
+
+        elif rtype == "AWS::Redshift::Cluster":
+            r = CloudResource("aws_redshift_cluster", logical_id,
+                              rng=res_rng)
+            put(r, "encrypted", attr("Encrypted"))
+            put(r, "subnet_group", attr("ClusterSubnetGroupName"))
+            out.append(r)
+
+        elif rtype == "AWS::ElastiCache::ReplicationGroup":
+            r = CloudResource("aws_elasticache_replication_group",
+                              logical_id, rng=res_rng)
+            put(r, "at_rest_encryption_enabled",
+                attr("AtRestEncryptionEnabled"))
+            put(r, "transit_encryption_enabled",
+                attr("TransitEncryptionEnabled"))
+            out.append(r)
+
+        elif rtype == "AWS::Lambda::Function":
+            r = CloudResource("aws_lambda_function", logical_id,
+                              rng=res_rng)
+            tracing = _resolve(props.get("TracingConfig"), params)
+            if isinstance(tracing, Unknown):
+                r.attrs["tracing_mode"] = Attr(UNKNOWN)
+            else:
+                r.attrs["tracing_mode"] = Attr(
+                    tracing.get("Mode", "PassThrough")
+                    if isinstance(tracing, dict) else "PassThrough")
+            out.append(r)
+
     return out
 
 
