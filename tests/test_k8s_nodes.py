@@ -153,7 +153,6 @@ class _FakeCluster:
                         "/pods?" in path:
                     sel = path.split("labelSelector=")[1]
                     job = sel.split("%3D")[-1].split("=")[-1]
-                    node = job[len("node-collector-"):]
                     if job in outer.jobs:
                         self._send({"items": [{
                             "metadata": {"name": f"{job}-pod"},
@@ -163,7 +162,8 @@ class _FakeCluster:
                         self._send({"items": []})
                 elif path.endswith("/log"):
                     pod = path.split("/pods/")[1].split("/")[0]
-                    node = pod[len("node-collector-"):-len("-pod")]
+                    node = pod[len("node-collector-"):-len("-pod")] \
+                        .rsplit("-", 1)[0]
                     self._send(None, raw=json.dumps(
                         node_infos[node]).encode())
                 else:
@@ -201,7 +201,8 @@ class TestCollectorE2E:
                                      poll_interval=0.01)
             assert info["type"] == "worker"
             # the job was cleaned up afterwards
-            assert "node-collector-node-1" in fake.deleted
+            assert any(d.startswith("node-collector-node-1")
+                       for d in fake.deleted)
 
             results = scan_infra(client, scanners=("misconfig",),
                                  namespace="trivy-temp")
@@ -245,3 +246,14 @@ def test_perm_check_uses_bitmask_not_numeric_compare():
     res = scan_node_infra({"info": {
         "kubeletConfFilePermissions": {"values": [400]}}}, "n")
     assert res.misconfigurations == []
+
+
+class TestJobName:
+    def test_unique_for_shared_long_prefixes(self):
+        from trivy_tpu.k8s.nodes import _job_name
+        prefix = "ip-10-0-0-1.very-long-zone-name.compute.internal"
+        a = _job_name(prefix + ".a")
+        b = _job_name(prefix + ".b")
+        assert a != b
+        assert len(a) <= 63 and len(b) <= 63
+        assert a.startswith("node-collector-")

@@ -416,9 +416,10 @@ class VMDKDevice:
         gt = self._gt_cache.get(gd_idx)
         if gt is None:
             self._f.seek(self._gd[gd_idx] * 512)
-            gt = struct.unpack(
-                f"<{self._num_gtes}I",
-                self._f.read(4 * self._num_gtes))
+            data = self._f.read(4 * self._num_gtes)
+            if len(data) != 4 * self._num_gtes:
+                raise VMError("truncated VMDK grain table")
+            gt = struct.unpack(f"<{self._num_gtes}I", data)
             self._gt_cache[gd_idx] = gt
         return gt[gt_idx] * 512
 

@@ -106,7 +106,8 @@ def _job_manifest(node_name: str, namespace: str, image: str,
             "name": job_name,
             "namespace": namespace,
             "labels": {"app": "trivy-tpu-node-collector",
-                       "trivy-tpu.collector/node": node_name},
+                       "trivy-tpu.collector/node":
+                           _node_label(node_name)},
         },
         "spec": {
             "backoffLimit": 1,
@@ -141,6 +142,27 @@ def _job_manifest(node_name: str, namespace: str, image: str,
     }
 
 
+def _node_label(node_name: str) -> str:
+    """Label-value-safe node identifier: label values cap at 63 chars,
+    so long FQDN node names get the same truncate+digest treatment as
+    the Job name. The authoritative node is spec.nodeName."""
+    if len(node_name) <= 63:
+        return node_name
+    import hashlib
+    digest = hashlib.sha1(node_name.encode()).hexdigest()[:8]
+    return node_name[:54].rstrip("-.") + "-" + digest
+
+
+def _job_name(node_name: str) -> str:
+    """Collector Job name: truncated to the 63-char DNS label limit,
+    with a sha1[:8] digest of the full node name appended so long
+    cloud FQDN nodes sharing a prefix never collide."""
+    import hashlib
+    digest = hashlib.sha1(node_name.encode()).hexdigest()[:8]
+    return (f"node-collector-{node_name}"[:53].rstrip("-.")
+            + "-" + digest)
+
+
 def collect_node_info(client: KubeClient, node_name: str,
                       namespace: str = "trivy-temp",
                       image: str = DEFAULT_COLLECTOR_IMAGE,
@@ -148,7 +170,7 @@ def collect_node_info(client: KubeClient, node_name: str,
                       poll_interval: float = 2.0,
                       tolerations=None) -> dict:
     """Run the collector Job on one node; → the parsed NodeInfo doc."""
-    job_name = f"node-collector-{node_name}"[:62].rstrip("-")
+    job_name = _job_name(node_name)
     client.create("apis/batch/v1", namespace, "jobs",
                   _job_manifest(node_name, namespace, image, job_name,
                                 tolerations))

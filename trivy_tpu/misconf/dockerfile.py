@@ -218,7 +218,8 @@ def _from_alias(inst) -> str:
      "Point the COPY '--from' to a previous stage or external image")
 def _copy_from_self(insts):
     for stage in _stages(insts):
-        alias = _from_alias(stage[0]) if stage and             stage[0].cmd == "FROM" else ""
+        alias = (_from_alias(stage[0])
+                 if stage and stage[0].cmd == "FROM" else "")
         if not alias:
             continue
         for inst in stage:

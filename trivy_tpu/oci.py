@@ -424,6 +424,39 @@ def _post_form(url: str, fields: dict, timeout: float = 10.0):
         return None
 
 
+_ON_GCE: "bool | None" = None
+_ON_GCE_RETRY_AT = 0.0
+_ON_GCE_LOCK = None
+
+
+def _on_gce() -> bool:
+    """Process-wide GCE detection: can we open a TCP connection to the
+    metadata host? The probe uses the same 2s timeout as the token
+    request itself, so a slow-but-working endpoint is never classed as
+    absent. A positive answer is cached forever; a negative one only
+    for 5 minutes — a transient boot-time failure on a real GCE host
+    must not permanently disable metadata auth."""
+    global _ON_GCE, _ON_GCE_RETRY_AT, _ON_GCE_LOCK
+    import threading
+    import time
+    if _ON_GCE_LOCK is None:
+        _ON_GCE_LOCK = threading.Lock()
+    with _ON_GCE_LOCK:
+        if _ON_GCE is True:
+            return True
+        if _ON_GCE is False and time.monotonic() < _ON_GCE_RETRY_AT:
+            return False
+        import socket
+        try:
+            socket.create_connection(
+                ("metadata.google.internal", 80), timeout=2.0).close()
+            _ON_GCE = True
+        except OSError:
+            _ON_GCE = False
+            _ON_GCE_RETRY_AT = time.monotonic() + 5 * 60
+        return _ON_GCE
+
+
 def gcr_credentials(host: str) -> "tuple[str, str] | None":
     """Google Container/Artifact Registry auth helper (reference
     fanal/image/registry/google/google.go: gcr.io + docker.pkg.dev
@@ -469,10 +502,14 @@ def gcr_credentials(host: str) -> "tuple[str, str] | None":
             if out and out.get("access_token"):
                 return "oauth2accesstoken", out["access_token"]
     # GCE metadata server (only when explicitly pointed at one, or on
-    # a GCE host where the magic hostname resolves)
-    meta = os.environ.get(
-        "TRIVY_TPU_GCE_METADATA",
-        "http://metadata.google.internal")
+    # a GCE host where the magic hostname resolves) — detection is a
+    # one-time process-wide probe so off-GCE scans of public gcr.io
+    # images never stall on repeated multi-second DNS/connect timeouts
+    meta = os.environ.get("TRIVY_TPU_GCE_METADATA", "")
+    if not meta:
+        if not _on_gce():
+            return None
+        meta = "http://metadata.google.internal"
     req = urllib.request.Request(
         meta + "/computeMetadata/v1/instance/service-accounts/"
                "default/token",

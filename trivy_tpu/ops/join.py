@@ -92,9 +92,9 @@ def _csr_core(adv_lo_tok, adv_hi_tok, adv_flags, ver_tok,
     The host's expansion (np.repeat in detect.engine._prepare) stays for
     hit assembly, but shipping it is ~T_pad*9 bytes per batch — an order
     of magnitude more transfer than the [Q] descriptors, and transfer is
-    the scan bottleneck on a tunneled chip.  Expansion here is two O(T)
-    primitives: scatter segment marks at each query's end offset, then
-    cumsum to recover the owning query per pair slot.
+    the scan bottleneck on a tunneled chip.  Expansion here is a binary
+    search of each pair slot against the cumulative bucket offsets to
+    recover its owning query (log2(Q) vectorized gather steps).
 
     q_start: int32[Q] first advisory row of each query's bucket
     q_count: int32[Q] bucket length (>0; empty queries pre-filtered)

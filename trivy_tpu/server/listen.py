@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import __version__, types as T
@@ -41,6 +42,15 @@ class ServerState:
         self.token = token
         self._lock = threading.Lock()
         self._scanner = LocalScanner(self.cache, table)
+        self._inflight = 0
+
+    def request_started(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def request_finished(self) -> None:
+        with self._lock:
+            self._inflight -= 1
 
     @property
     def scanner(self) -> LocalScanner:
@@ -54,13 +64,28 @@ class ServerState:
         # the swapped-in table's object graph (~1M small objects for a
         # full trivy-db) is immutable; freezing it out of the cyclic
         # collector keeps gen2 passes from stalling in-flight scans.
-        # unfreeze first: the PREVIOUS swap's frozen set (old table,
-        # old request state) must rejoin the collector or every swap
-        # would leak one table's worth of uncollectable objects
+        # unfreeze first: the PREVIOUS swap's frozen set must rejoin
+        # the collector or every swap would leak one table's worth of
+        # uncollectable objects. Freeze only in a quiescent window —
+        # freezing while requests are in flight would pin their
+        # transient buffers (and any cyclic garbage among them)
+        # forever if no later swap unfreezes them.
         import gc
         gc.unfreeze()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    # collect inside the window: requests that finish
+                    # during the wait leave cyclic garbage that must
+                    # die before freeze pins the survivors
+                    gc.collect()
+                    gc.freeze()
+                    return
+            time.sleep(0.01)
         gc.collect()
-        gc.freeze()
+        # never went quiescent: skip the freeze; gen2 passes just get
+        # slower until the next swap — correctness is unaffected
 
 
 def _result_to_json(res: T.Result) -> dict:
@@ -86,6 +111,14 @@ class Handler(BaseHTTPRequestHandler):
         self._json(code, {"code": twirp_code, "msg": msg})
 
     def do_GET(self):
+        st = self.state
+        st.request_started()
+        try:
+            self._do_get()
+        finally:
+            st.request_finished()
+
+    def _do_get(self):
         if self.path == "/healthz":
             body = b"ok"
             self.send_response(200)
@@ -135,6 +168,13 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         st = self.state
+        st.request_started()
+        try:
+            self._do_post(st)
+        finally:
+            st.request_finished()
+
+    def _do_post(self, st):
         if st.token and self.headers.get(TOKEN_HEADER) != st.token:
             return self._twirp_error(401, "unauthenticated", "invalid token")
         ctype = (self.headers.get("Content-Type") or "").split(";")[0]
