@@ -142,25 +142,27 @@ def _job_manifest(node_name: str, namespace: str, image: str,
     }
 
 
+def _truncate_digest(name: str, max_len: int = 63) -> str:
+    """Fit a name into a DNS-label/label-value budget: truncate and
+    append a sha1[:8] digest of the FULL name so long cloud FQDNs
+    sharing a prefix never collide."""
+    import hashlib
+    digest = hashlib.sha1(name.encode()).hexdigest()[:8]
+    return name[:max_len - 9].rstrip("-.") + "-" + digest
+
+
 def _node_label(node_name: str) -> str:
-    """Label-value-safe node identifier: label values cap at 63 chars,
-    so long FQDN node names get the same truncate+digest treatment as
-    the Job name. The authoritative node is spec.nodeName."""
+    """Label-value-safe node identifier (63-char cap). The
+    authoritative node is spec.nodeName."""
     if len(node_name) <= 63:
         return node_name
-    import hashlib
-    digest = hashlib.sha1(node_name.encode()).hexdigest()[:8]
-    return node_name[:54].rstrip("-.") + "-" + digest
+    return _truncate_digest(node_name)
 
 
 def _job_name(node_name: str) -> str:
-    """Collector Job name: truncated to the 63-char DNS label limit,
-    with a sha1[:8] digest of the full node name appended so long
-    cloud FQDN nodes sharing a prefix never collide."""
-    import hashlib
-    digest = hashlib.sha1(node_name.encode()).hexdigest()[:8]
-    return (f"node-collector-{node_name}"[:53].rstrip("-.")
-            + "-" + digest)
+    """Collector Job name, unique per node within the DNS label
+    limit."""
+    return _truncate_digest(f"node-collector-{node_name}")
 
 
 def collect_node_info(client: KubeClient, node_name: str,

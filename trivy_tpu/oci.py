@@ -27,6 +27,7 @@ import json
 import os
 import re
 import tarfile
+import threading
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -426,35 +427,38 @@ def _post_form(url: str, fields: dict, timeout: float = 10.0):
 
 _ON_GCE: "bool | None" = None
 _ON_GCE_RETRY_AT = 0.0
-_ON_GCE_LOCK = None
+_ON_GCE_LOCK = threading.Lock()
 
 
 def _on_gce() -> bool:
     """Process-wide GCE detection: can we open a TCP connection to the
-    metadata host? The probe uses the same 2s timeout as the token
-    request itself, so a slow-but-working endpoint is never classed as
-    absent. A positive answer is cached forever; a negative one only
-    for 5 minutes — a transient boot-time failure on a real GCE host
-    must not permanently disable metadata auth."""
-    global _ON_GCE, _ON_GCE_RETRY_AT, _ON_GCE_LOCK
-    import threading
+    metadata host? (The 2s connect timeout does not bound DNS
+    resolution, so the probe runs OUTSIDE the lock — a slow resolver
+    only stalls probing threads, never every credential lookup.) A
+    positive answer is cached forever; a negative one only for 5
+    minutes — a transient boot-time failure on a real GCE host must
+    not permanently disable metadata auth."""
+    global _ON_GCE, _ON_GCE_RETRY_AT
     import time
-    if _ON_GCE_LOCK is None:
-        _ON_GCE_LOCK = threading.Lock()
     with _ON_GCE_LOCK:
         if _ON_GCE is True:
             return True
         if _ON_GCE is False and time.monotonic() < _ON_GCE_RETRY_AT:
             return False
-        import socket
-        try:
-            socket.create_connection(
-                ("metadata.google.internal", 80), timeout=2.0).close()
-            _ON_GCE = True
-        except OSError:
-            _ON_GCE = False
+    import socket
+    try:
+        socket.create_connection(
+            ("metadata.google.internal", 80), timeout=2.0).close()
+        ok = True
+    except OSError:
+        ok = False
+    with _ON_GCE_LOCK:
+        # don't let a racing failed probe clobber a success
+        if ok or _ON_GCE is not True:
+            _ON_GCE = ok
+        if not ok:
             _ON_GCE_RETRY_AT = time.monotonic() + 5 * 60
-        return _ON_GCE
+    return ok
 
 
 def gcr_credentials(host: str) -> "tuple[str, str] | None":

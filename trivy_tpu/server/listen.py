@@ -72,18 +72,22 @@ class ServerState:
         # forever if no later swap unfreezes them.
         import gc
         gc.unfreeze()
+        # the expensive full collect over the just-unfrozen table graph
+        # runs OUTSIDE the lock — request_started/finished must never
+        # block behind a multi-hundred-ms gen2 pass (healthz probes!)
+        gc.collect()
         deadline = time.monotonic() + 2.0
         while time.monotonic() < deadline:
             with self._lock:
                 if self._inflight == 0:
-                    # collect inside the window: requests that finish
-                    # during the wait leave cyclic garbage that must
-                    # die before freeze pins the survivors
-                    gc.collect()
+                    # young-gen sweep inside the window: requests that
+                    # finished during the wait leave fresh cyclic
+                    # garbage that must die before freeze pins it;
+                    # gen-1 collects are cheap enough to hold the lock
+                    gc.collect(1)
                     gc.freeze()
                     return
             time.sleep(0.01)
-        gc.collect()
         # never went quiescent: skip the freeze; gen2 passes just get
         # slower until the next swap — correctness is unaffected
 
