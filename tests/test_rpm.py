@@ -176,3 +176,48 @@ class TestRpmDrivers:
         pkg = T.Package(name="openssl-libs", version="3.0.1",
                         release="47.el9_1", epoch=1, arch="x86_64")
         assert self.scan(detector, "rocky", "9.1", [pkg]) == []
+
+
+class TestRedHatHitMerge:
+    """_finish_redhat merge (reference redhat.go:148-179): fixed hits
+    take the max fixed version and union vendor ids; unfixed hits never
+    overwrite fixed ones."""
+
+    def _finish(self, hits):
+        from trivy_tpu.detect.ospkg import OspkgScanner
+        from trivy_tpu import types as T
+        scanner = OspkgScanner.__new__(OspkgScanner)
+        os_info = T.OS(family="redhat", name="8.7")
+        return scanner._finish_redhat(hits, os_info, None)
+
+    def test_fixed_hits_merge_vendor_ids_and_max_fix(self):
+        from trivy_tpu.detect.engine import Hit, PkgQuery
+        from trivy_tpu import types as T
+        pkg = T.Package(name="openssl", version="1.0.0")
+        q = PkgQuery(source="Red Hat", ecosystem="redhat",
+                     name="openssl", version="1.0.0", ref=pkg)
+        hits = [
+            Hit(q, "CVE-2024-1", "1:1.0.2-3", "fixed", "HIGH", None,
+                ("RHSA-2024:0001",)),
+            Hit(q, "CVE-2024-1", "1:1.0.9-1", "fixed", "HIGH", None,
+                ("RHSA-2024:0002",)),
+        ]
+        vulns, eosl = self._finish(hits)
+        assert len(vulns) == 1
+        assert vulns[0].fixed_version == "1:1.0.9-1"
+        assert vulns[0].vendor_ids == ["RHSA-2024:0001",
+                                       "RHSA-2024:0002"]
+
+    def test_unfixed_never_overwrites_fixed(self):
+        from trivy_tpu.detect.engine import Hit, PkgQuery
+        from trivy_tpu import types as T
+        pkg = T.Package(name="zlib", version="1.0.0")
+        q = PkgQuery(source="Red Hat", ecosystem="redhat",
+                     name="zlib", version="1.0.0", ref=pkg)
+        hits = [
+            Hit(q, "CVE-2024-2", "2.0", "fixed", "LOW", None, ()),
+            Hit(q, "CVE-2024-2", "", "affected", "LOW", None, ()),
+        ]
+        vulns, _ = self._finish(hits)
+        assert len(vulns) == 1
+        assert vulns[0].fixed_version == "2.0"

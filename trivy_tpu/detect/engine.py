@@ -21,7 +21,7 @@ The reference evaluates the same predicate one package at a time
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import numpy as np
 
@@ -29,6 +29,8 @@ from .. import version as V
 from ..db.table import AdvisoryTable
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
+
+
 
 
 @dataclass(slots=True)
@@ -42,8 +44,11 @@ class PkgQuery:
     ref: Any = None  # caller's package object
 
 
-@dataclass(slots=True)
-class Hit:
+class Hit(NamedTuple):
+    """One detected (package, advisory-group) match. A NamedTuple, not
+    a dataclass: dense batches assemble ~100k of these per 512-image
+    batch and tuple.__new__ via map() is ~3× cheaper than a dataclass
+    __init__ — construction was the assembly hot spot."""
     query: PkgQuery
     vuln_id: str
     fixed_version: str
@@ -91,6 +96,13 @@ class BatchDetector:
         self._lock = threading.Lock()
         self._g_arrays = None
         self._g_arrays_len = -1
+        self._g_cols = None
+        self._g_cols_len = -1
+        # single background thread for result fetches (detect_many);
+        # created eagerly — lazy init would race across server threads
+        from concurrent.futures import ThreadPoolExecutor
+        self._get_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="detect-get")
 
     # ---- memo pools ---------------------------------------------------
 
@@ -171,10 +183,17 @@ class BatchDetector:
         t = self.table
         usable: list[tuple[PkgQuery, bool]] = []
         ver_rows: list[int] = []
+        # warm-pool fast path: one dict probe per query, no method
+        # call — registry sweeps hit the memo >99% of the time and the
+        # per-query call overhead was a third of prepare
+        ver_idx = self._ver_idx
+        ver_exact = self._ver_exact
         for q in queries:
-            vi = self._ver_index(q.ecosystem, q.version)
+            vi = ver_idx.get((q.ecosystem, q.version), -1)
+            if vi == -1:
+                vi = self._ver_index(q.ecosystem, q.version)
             if vi is not None:
-                usable.append((q, self._ver_exact[vi]))
+                usable.append((q, ver_exact[vi]))
                 ver_rows.append(vi)
         if not usable:
             return None
@@ -208,6 +227,10 @@ class BatchDetector:
         q_start[:nz.size] = start[nz]
         q_count = np.zeros(q_pad, np.int32)
         q_count[:nz.size] = counts_nz
+        # the device CSR expansion (ops/join._csr_core) scatters one
+        # segment mark per nonzero bucket: an interior zero count
+        # would silently shift every later segment
+        assert counts_nz.min() > 0
         q_ver = np.zeros(q_pad, np.int32)
         q_ver[:nz.size] = ver_arr[nz]
         return _Prepared(usable, pair_q, row_p, ver_p, n_pairs,
@@ -259,10 +282,18 @@ class BatchDetector:
         t0 = time.perf_counter()
         # device_get, not np.asarray: asarray falls into the generic
         # __array__ element path on accelerator arrays (~500x slower
-        # for the 512KB bit vectors); device_get is one memcpy
-        out = [[] if fut is None
-               else self._assemble(prep, jax.device_get(fut))
-               for prep, fut in zip(prepped, futures)]
+        # for the 512KB bit vectors); device_get is one memcpy.
+        # Gets run on one background thread so batch N+1's result
+        # streams over the link while batch N assembles (measured
+        # ~12% over serial gets; an on-device concat + single fetch
+        # measured WORSE — it barriers all batches' compute before
+        # the first byte moves).
+        get_futs = [None if fut is None
+                    else self._get_pool.submit(jax.device_get, fut)
+                    for fut in futures]
+        out = [[] if gf is None
+               else self._assemble(prep, gf.result())
+               for prep, gf in zip(prepped, get_futs)]
         METRICS.inc("trivy_tpu_detect_wait_assemble_seconds_total",
                     time.perf_counter() - t0)
         METRICS.inc("trivy_tpu_detect_hits_total",
@@ -284,18 +315,24 @@ class BatchDetector:
         neg = (flags & J.NEGATIVE) != 0
         inexact = (b & J.NEEDS_RECHECK) != 0
 
-        # group-by (pkg query, advisory group) in numpy
+        # group-by (pkg query, advisory group) in numpy. Pairs come out
+        # of the CSR expansion already sorted by (query, group): pair_q
+        # is non-decreasing, rows within a bucket walk it in order, and
+        # the table's stable hash lexsort keeps a bucket's rows in
+        # group-append order — so segment boundaries fall out of one
+        # diff, no argsort. (Guarded: a future table layout that broke
+        # the invariant would silently corrupt polarity folding.)
         key = qidx.astype(np.int64) * (len(t.groups) + 1) + gids
-        order = np.argsort(key, kind="stable")
-        key_s = key[order]
-        uniq, seg_start = np.unique(key_s, return_index=True)
-        pos_any = np.zeros(uniq.shape[0], bool)
-        neg_any = np.zeros(uniq.shape[0], bool)
-        inex_any = np.zeros(uniq.shape[0], bool)
-        seg = np.searchsorted(uniq, key_s)
-        np.logical_or.at(pos_any, seg, sat[order] & ~neg[order])
-        np.logical_or.at(neg_any, seg, sat[order] & neg[order])
-        np.logical_or.at(inex_any, seg, inexact[order])
+        if key.size > 1 and not np.all(key[1:] >= key[:-1]):
+            order = np.argsort(key, kind="stable")
+            key, sat, neg, inexact = \
+                key[order], sat[order], neg[order], inexact[order]
+        seg_start = np.flatnonzero(
+            np.concatenate(([True], key[1:] != key[:-1])))
+        uniq = key[seg_start]
+        pos_any = np.maximum.reduceat(sat & ~neg, seg_start)
+        neg_any = np.maximum.reduceat(sat & neg, seg_start)
+        inex_any = np.maximum.reduceat(inexact, seg_start)
 
         pkg_of = (uniq // (len(t.groups) + 1)).astype(np.int64)
         gid_of = (uniq % (len(t.groups) + 1)).astype(np.int64)
@@ -321,14 +358,23 @@ class BatchDetector:
 
         usable = prep.usable
         groups = t.groups
-        hits: list[Hit] = [
-            Hit(query=usable[i][0], vuln_id=g.vuln_id,
-                fixed_version=g.fixed_version, status=g.status,
-                severity=g.severity, data_source=g.data_source,
-                vendor_ids=g.vendor_ids)
-            for i, g in ((int(pkg_of[u]), groups[int(gid_of[u])])
-                         for u in np.nonzero(fast)[0])
-        ]
+        # fast path: all columns are fancy-indexed object arrays;
+        # construction goes through the C slot tuple.__new__ directly
+        # (namedtuple's Python-level __new__ costs ~1 µs/frame and was
+        # the single largest assembly item at ~100k hits/batch)
+        from itertools import repeat
+        g_vuln, g_fix, g_status, g_sev, g_ds, g_vids = \
+            self._group_cols()
+        q_obj = np.empty(len(usable), dtype=object)
+        q_obj[:] = [q for q, _ in usable]
+        fsel = np.nonzero(fast)[0]
+        gsel = gid_of[fsel]
+        psel = pkg_of[fsel]
+        hits: list[Hit] = list(map(tuple.__new__, repeat(Hit), zip(
+            q_obj[psel].tolist(), g_vuln[gsel].tolist(),
+            g_fix[gsel].tolist(), g_status[gsel].tolist(),
+            g_sev[gsel].tolist(), g_ds[gsel].tolist(),
+            g_vids[gsel].tolist())))
         for u in np.nonzero(slow)[0]:
             i = int(pkg_of[u])
             g = groups[int(gid_of[u])]
@@ -369,6 +415,29 @@ class BatchDetector:
                     self._g_arrays = arrays
                     self._g_arrays_len = len(gs)
         return self._g_arrays
+
+    def _group_cols(self):
+        """Cached columnar group attributes for fast-path Hit
+        construction (vuln_id, fixed_version, status, severity,
+        data_source, vendor_ids as object arrays)."""
+        if self._g_cols is None or \
+                self._g_cols_len != len(self.table.groups):
+            with self._lock:
+                if self._g_cols is None or \
+                        self._g_cols_len != len(self.table.groups):
+                    gs = self.table.groups
+                    n = len(gs)
+
+                    def col(attr):
+                        a = np.empty(n, dtype=object)
+                        a[:] = [getattr(g, attr) for g in gs]
+                        return a
+                    self._g_cols = tuple(
+                        col(a) for a in ("vuln_id", "fixed_version",
+                                         "status", "severity",
+                                         "data_source", "vendor_ids"))
+                    self._g_cols_len = n
+        return self._g_cols
 
     def _exact_eval(self, g, q: PkgQuery) -> tuple[bool, bool]:
         """Host fallback: evaluate the group's intervals with the exact

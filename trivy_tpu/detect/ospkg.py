@@ -381,14 +381,17 @@ class OspkgScanner:
             if prev is None or prev.fixed_version == "":
                 merged[k] = h
                 continue
-            prev.vendor_ids = tuple(dict.fromkeys(
+            # Hit is an immutable NamedTuple — merge via _replace
+            vids = tuple(dict.fromkeys(
                 prev.vendor_ids + h.vendor_ids))
+            fixed = prev.fixed_version
             try:
-                if V.compare("redhat", prev.fixed_version,
-                             h.fixed_version) < 0:
-                    prev.fixed_version = h.fixed_version
+                if V.compare("redhat", fixed, h.fixed_version) < 0:
+                    fixed = h.fixed_version
             except (ValueError, KeyError):
                 pass
+            merged[k] = prev._replace(vendor_ids=vids,
+                                      fixed_version=fixed)
 
         vulns = []
         for h in merged.values():
