@@ -3,7 +3,10 @@
 import hashlib
 import io
 import json
+import sqlite3
+import struct
 import tarfile
+import tempfile
 
 
 def make_layer(files: dict[str, bytes]) -> bytes:
@@ -109,3 +112,62 @@ License: BSD-3-Clause
 
 Flask body text.
 """
+
+
+# ---- rpm database builders (shared by test_rpm and the golden-image
+# gate): hand-constructed rpm header blobs, the inverse of the
+# header-image parser in fanal/analyzers/rpm.py ----
+
+def _rpm_tags():
+    from trivy_tpu.fanal.analyzers import rpm as rpm_mod
+    return rpm_mod
+
+
+def build_header(tags: dict) -> bytes:
+    """tags: {tag: (type, value)} → rpm header image."""
+    entries = []
+    store = b""
+    for tag, (typ, value) in sorted(tags.items()):
+        if typ == 6:  # string
+            off = len(store)
+            store += value.encode() + b"\x00"
+            cnt = 1
+        elif typ == 4:  # int32
+            while len(store) % 4:
+                store += b"\x00"
+            off = len(store)
+            store += struct.pack(">i", value)
+            cnt = 1
+        else:
+            raise NotImplementedError(typ)
+        entries.append(struct.pack(">iiii", tag, typ, off, cnt))
+    blob = struct.pack(">ii", len(entries), len(store))
+    return blob + b"".join(entries) + store
+
+
+def build_rpmdb(pkgs: list[dict]) -> bytes:
+    with tempfile.NamedTemporaryFile(suffix=".sqlite") as f:
+        conn = sqlite3.connect(f.name)
+        conn.execute("CREATE TABLE Packages (hnum INTEGER PRIMARY KEY, "
+                     "blob BLOB NOT NULL)")
+        for i, p in enumerate(pkgs):
+            tags = {
+                _rpm_tags().TAG_NAME: (6, p["name"]),
+                _rpm_tags().TAG_VERSION: (6, p["version"]),
+                _rpm_tags().TAG_RELEASE: (6, p["release"]),
+                _rpm_tags().TAG_ARCH: (6, p.get("arch", "x86_64")),
+            }
+            if "epoch" in p:
+                tags[_rpm_tags().TAG_EPOCH] = (4, p["epoch"])
+            if "sourcerpm" in p:
+                tags[_rpm_tags().TAG_SOURCERPM] = (6, p["sourcerpm"])
+            if "license" in p:
+                tags[_rpm_tags().TAG_LICENSE] = (6, p["license"])
+            conn.execute("INSERT INTO Packages VALUES (?, ?)",
+                         (i + 1, build_header(tags)))
+        conn.commit()
+        conn.close()
+        f.seek(0)
+        return open(f.name, "rb").read()
+
+

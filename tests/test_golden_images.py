@@ -1,0 +1,318 @@
+"""OS-image golden gate: synthesize per-distro image tarballs whose
+package sets match the reference's integration goldens, scan them
+against the reference's OWN advisory fixtures, and assert exact
+detected-CVE parity (reference integration/standalone_tar_test.go,
+goldens at integration/testdata/*.json.golden).
+
+The reference ships only goldens + the advisory YAML (the image
+tarballs are downloaded at test time there); here each image is
+reconstructed from the golden's vulnerable-package list — the
+detection-relevant content — plus clean decoys that must stay clean.
+Source/origin package names are derived from the advisory buckets."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from helpers import build_rpmdb, make_image
+from trivy_tpu import types as T
+from trivy_tpu.db import build_table
+from trivy_tpu.db.fixtures import load_fixture_files
+from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+from trivy_tpu.fanal.cache import MemoryCache
+from trivy_tpu.scanner import LocalScanner
+
+REF = os.environ.get("TRIVY_REFERENCE_DIR", "/root/reference")
+TD = os.path.join(REF, "integration", "testdata")
+DB = os.path.join(TD, "fixtures", "db")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(TD), reason="reference testdata not present")
+
+# golden name → (release files, package-db format)
+#   fmt: apk | dpkg | rpm
+SPECS = {
+    "alpine-310": {
+        "fmt": "apk",
+        "files": {"etc/alpine-release": b"3.10.2\n"},
+    },
+    "alpine-39": {
+        "fmt": "apk",
+        "files": {"etc/alpine-release": b"3.9.4\n"},
+    },
+    "debian-buster": {
+        "fmt": "dpkg",
+        "files": {"etc/debian_version": b"10.1\n",
+                  "etc/os-release": b'ID=debian\nVERSION_ID="10"\n'},
+    },
+    "debian-stretch": {
+        "fmt": "dpkg",
+        "files": {"etc/debian_version": b"9.9\n",
+                  "etc/os-release": b'ID=debian\nVERSION_ID="9"\n'},
+    },
+    "ubuntu-1804": {
+        "fmt": "dpkg",
+        "files": {"etc/lsb-release":
+                  b"DISTRIB_ID=Ubuntu\nDISTRIB_RELEASE=18.04\n"},
+    },
+    "centos-7": {
+        "fmt": "rpm",
+        "files": {"etc/centos-release":
+                  b"CentOS Linux release 7.6.1810 (Core)\n"},
+    },
+    "centos-6": {
+        "fmt": "rpm",
+        "files": {"etc/centos-release":
+                  b"CentOS release 6.10 (Final)\n"},
+    },
+    "almalinux-8": {
+        "fmt": "rpm",
+        "files": {"etc/redhat-release":
+                  b"AlmaLinux release 8.5 (Arctic Sphynx)\n"},
+    },
+    "rockylinux-8": {
+        "fmt": "rpm",
+        "files": {"etc/redhat-release":
+                  b"Rocky Linux release 8.5 (Green Obsidian)\n"},
+    },
+    "oraclelinux-8": {
+        "fmt": "rpm",
+        # real Oracle images ship BOTH release files; the RHEL one
+        # must lose (reference OS.Merge redhat-overwrite rule)
+        "files": {"etc/oracle-release":
+                  b"Oracle Linux Server release 8.0\n",
+                  "etc/redhat-release":
+                  b"Red Hat Enterprise Linux release 8.0\n"},
+    },
+    "amazon-2": {
+        "fmt": "rpm",
+        "files": {"etc/system-release":
+                  b"Amazon Linux release 2 (Karoo)\n"},
+    },
+    "amazon-1": {
+        "fmt": "rpm",
+        "files": {"etc/system-release":
+                  b"Amazon Linux AMI release 2018.03\n"},
+    },
+    "photon-30": {
+        "fmt": "rpm",
+        "files": {"etc/os-release":
+                  b'ID=photon\nVERSION_ID=3.0\n'},
+    },
+    "opensuse-leap-151": {
+        "fmt": "rpm",
+        "files": {"etc/os-release":
+                  b'ID=opensuse-leap\nVERSION_ID="15.1"\n'},
+    },
+    "ubi-7": {
+        "fmt": "rpm",
+        "files": {"etc/redhat-release":
+                  b"Red Hat Enterprise Linux Server release 7.7 "
+                  b"(Maipo)\n"},
+    },
+    "mariner-1.0": {
+        "fmt": "rpmmanifest",
+        "files": {"etc/mariner-release":
+                  b"CBL-Mariner 1.0.20220122\n"},
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def table():
+    advisories, details, sources = load_fixture_files(
+        sorted(glob.glob(os.path.join(DB, "*.yaml"))))
+    aux = {}
+    if "Red Hat CPE" in sources:  # centos/rhel content-set scoping
+        aux["Red Hat CPE"] = sources["Red Hat CPE"]
+    return build_table(advisories, details, aux=aux)
+
+
+def _golden_vulns(name):
+    doc = json.load(open(os.path.join(TD, f"{name}.json.golden")))
+    out = []
+    for r in doc.get("Results") or []:
+        if r.get("Class") != "os-pkgs":
+            continue
+        out.extend(r.get("Vulnerabilities") or [])
+    return doc, out
+
+
+def _bucket_map():
+    """(family yaml) → {cve: set of package buckets}. Scans every
+    release bucket of every OS fixture file once."""
+    import yaml
+    m: dict[str, set] = {}
+    for p in glob.glob(os.path.join(DB, "*.yaml")):
+        if os.path.basename(p) in ("vulnerability.yaml",
+                                   "data-source.yaml", "cpe.yaml"):
+            continue
+        docs = yaml.safe_load(open(p)) or []
+        for top in docs:
+            for pkg in top.get("pairs") or []:
+                if "bucket" not in pkg:
+                    continue
+                for adv in pkg.get("pairs") or []:
+                    if "key" not in adv:
+                        continue
+                    m.setdefault(adv["key"], set()).add(pkg["bucket"])
+                    # redhat-style: RHSA key with per-entry CVE lists
+                    val = adv.get("value") or {}
+                    for e in val.get("Entries") or []:
+                        for c in e.get("Cves") or []:
+                            if c.get("ID"):
+                                m.setdefault(c["ID"], set()).add(
+                                    pkg["bucket"])
+    return m
+
+
+_BUCKETS = None
+
+
+def _src_of(pkg_name: str, cve: str) -> str:
+    """Origin/source package for a golden (pkg, cve): the advisory
+    bucket — itself when the binary name is a bucket for that CVE,
+    otherwise the unique bucket carrying it."""
+    global _BUCKETS
+    if _BUCKETS is None:
+        _BUCKETS = _bucket_map()
+    buckets = _BUCKETS.get(cve, set())
+    if pkg_name in buckets:
+        return pkg_name
+    if len(buckets) == 1:
+        return next(iter(buckets))
+    for b in buckets:  # libidn2-0 → libidn2 style prefixes
+        if pkg_name.startswith(b):
+            return b
+    raise AssertionError(
+        f"cannot derive source package for {pkg_name}/{cve}: {buckets}")
+
+
+def _split_evr(ver: str):
+    epoch = 0
+    if ":" in ver:
+        e, ver = ver.split(":", 1)
+        epoch = int(e)
+    v, _, r = ver.rpartition("-")
+    return epoch, v, r
+
+
+def _pkg_db(fmt: str, vulns) -> dict[str, bytes]:
+    """Synthesize the package database holding each golden package once
+    plus a clean decoy package that must produce no findings."""
+    pkgs = {}
+    for v in vulns:
+        key = v["PkgName"]
+        pkgs[key] = (v["PkgName"], v["InstalledVersion"],
+                     _src_of(v["PkgName"], v["VulnerabilityID"]))
+    if fmt == "apk":
+        blocks = []
+        for name, ver, src in pkgs.values():
+            blocks.append(f"P:{name}\nV:{ver}\nA:x86_64\no:{src}\n"
+                          f"L:MIT\n")
+        blocks.append("P:decoy-clean\nV:1.0-r0\nA:x86_64\n"
+                      "o:decoy-clean\nL:MIT\n")
+        return {"lib/apk/db/installed":
+                "\n".join(blocks).encode() + b"\n"}
+    if fmt == "dpkg":
+        blocks = []
+        for name, ver, src in pkgs.values():
+            src_line = f"Source: {src}\n" if src != name else ""
+            blocks.append(
+                f"Package: {name}\nStatus: install ok installed\n"
+                f"{src_line}Version: {ver}\nArchitecture: amd64\n")
+        blocks.append("Package: decoy-clean\n"
+                      "Status: install ok installed\n"
+                      "Version: 1.0-1\nArchitecture: amd64\n")
+        return {"var/lib/dpkg/status":
+                "\n".join(blocks).encode() + b"\n"}
+    if fmt == "rpm":
+        rows = []
+        for name, ver, src in pkgs.values():
+            epoch, v_, r_ = _split_evr(ver)
+            row = {"name": name, "version": v_, "release": r_,
+                   "arch": "x86_64",
+                   "sourcerpm": f"{src}-{v_}-{r_}.src.rpm"}
+            if epoch:
+                row["epoch"] = epoch
+            rows.append(row)
+        rows.append({"name": "decoy-clean", "version": "1.0",
+                     "release": "1", "arch": "x86_64",
+                     "sourcerpm": "decoy-clean-1.0-1.src.rpm"})
+        return {"var/lib/rpm/rpmdb.sqlite": build_rpmdb(rows)}
+    if fmt == "rpmmanifest":
+        lines = []
+        for name, ver, src in pkgs.values():
+            epoch, v_, r_ = _split_evr(ver)
+            lines.append(
+                f"{name}\t{v_}-{r_}\t{epoch or 0}\t0\tVMware\t(none)"
+                f"\t100\tx86_64\t0\t{src}-{v_}-{r_}.src.rpm")
+        lines.append("decoy-clean\t1.0-1\t0\t0\tVMware\t(none)\t100"
+                     "\tx86_64\t0\tdecoy-clean-1.0-1.src.rpm")
+        return {"var/lib/rpmmanifest/container-manifest-2":
+                ("\n".join(lines) + "\n").encode()}
+    raise AssertionError(fmt)
+
+
+def _scan(tmp_path, files, table, now=None):
+    path = str(tmp_path / "img.tar")
+    make_image(path, [files])
+    cache = MemoryCache()
+    art = ImageArchiveArtifact(path, cache, scanners=("vuln",))
+    ref = art.inspect()
+    scanner = LocalScanner(cache, table)
+    results, os_info = scanner.scan(
+        ref.name, ref.id, ref.blob_ids,
+        T.ScanOptions(scanners=("vuln",)), now=now)
+    return results, os_info
+
+
+def _tuples(vulns, with_severity=True):
+    out = set()
+    for v in vulns:
+        t = (v["PkgName"], v["VulnerabilityID"],
+             v["InstalledVersion"], v.get("FixedVersion") or "",
+             v.get("Status") or "")
+        if with_severity:
+            t += (v.get("Severity") or "",)
+        out.add(t)
+    return out
+
+
+def _our_tuples(results, with_severity=True):
+    out = set()
+    for r in results:
+        for v in r.vulnerabilities:
+            t = (v.pkg_name, v.vulnerability_id, v.installed_version,
+                 v.fixed_version or "", v.status or "")
+            if with_severity:
+                t += (v.vulnerability.severity or "",)
+            out.add(t)
+    return out
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_golden_image_cve_parity(name, table, tmp_path):
+    spec = SPECS[name]
+    doc, vulns = _golden_vulns(name)
+    files = dict(spec["files"])
+    files.update(_pkg_db(spec["fmt"], vulns))
+    # scan "as of" the golden's creation: stream selection (ubuntu
+    # ESM fallover) and EOSL flags are time-dependent, and the
+    # reference goldens were pinned years ago
+    import datetime as dt
+    now = dt.datetime.fromisoformat(
+        doc["CreatedAt"].replace("Z", "+00:00"))
+    results, os_info = _scan(tmp_path, files, table, now=now)
+
+    want_os = (doc["Metadata"]["OS"]["Family"],
+               doc["Metadata"]["OS"]["Name"])
+    assert (os_info.family, os_info.name) == want_os
+
+    want = _tuples(vulns)
+    got = _our_tuples(results)
+    assert got == want, (
+        f"{name}: missing={sorted(want - got)} "
+        f"extra={sorted(got - want)}")

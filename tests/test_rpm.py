@@ -6,9 +6,6 @@ reference's go-rpmdb fixtures."""
 
 import glob
 import os
-import sqlite3
-import struct
-import tempfile
 
 import pytest
 
@@ -19,57 +16,10 @@ from trivy_tpu.detect import BatchDetector
 from trivy_tpu.detect.ospkg import OspkgScanner
 from trivy_tpu.fanal.analyzers import AnalysisResult, AnalyzerGroup
 from trivy_tpu.fanal.analyzers import rpm as rpm_mod
+from helpers import build_header, build_rpmdb  # noqa: F401
 
 FIXTURES = sorted(glob.glob(
     os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")))
-
-
-def build_header(tags: dict) -> bytes:
-    """tags: {tag: (type, value)} → rpm header image."""
-    entries = []
-    store = b""
-    for tag, (typ, value) in sorted(tags.items()):
-        if typ == 6:  # string
-            off = len(store)
-            store += value.encode() + b"\x00"
-            cnt = 1
-        elif typ == 4:  # int32
-            while len(store) % 4:
-                store += b"\x00"
-            off = len(store)
-            store += struct.pack(">i", value)
-            cnt = 1
-        else:
-            raise NotImplementedError(typ)
-        entries.append(struct.pack(">iiii", tag, typ, off, cnt))
-    blob = struct.pack(">ii", len(entries), len(store))
-    return blob + b"".join(entries) + store
-
-
-def build_rpmdb(pkgs: list[dict]) -> bytes:
-    with tempfile.NamedTemporaryFile(suffix=".sqlite") as f:
-        conn = sqlite3.connect(f.name)
-        conn.execute("CREATE TABLE Packages (hnum INTEGER PRIMARY KEY, "
-                     "blob BLOB NOT NULL)")
-        for i, p in enumerate(pkgs):
-            tags = {
-                rpm_mod.TAG_NAME: (6, p["name"]),
-                rpm_mod.TAG_VERSION: (6, p["version"]),
-                rpm_mod.TAG_RELEASE: (6, p["release"]),
-                rpm_mod.TAG_ARCH: (6, p.get("arch", "x86_64")),
-            }
-            if "epoch" in p:
-                tags[rpm_mod.TAG_EPOCH] = (4, p["epoch"])
-            if "sourcerpm" in p:
-                tags[rpm_mod.TAG_SOURCERPM] = (6, p["sourcerpm"])
-            if "license" in p:
-                tags[rpm_mod.TAG_LICENSE] = (6, p["license"])
-            conn.execute("INSERT INTO Packages VALUES (?, ?)",
-                         (i + 1, build_header(tags)))
-        conn.commit()
-        conn.close()
-        f.seek(0)
-        return open(f.name, "rb").read()
 
 
 RPM_PKGS = [

@@ -45,8 +45,8 @@ _BUCKET_PREFIXES = [
     ("oracle linux", "oracle"),
     ("photon os", "photon"),
     ("cbl-mariner", "cbl-mariner"),
-    ("opensuse leap", "opensuse-leap"),
-    ("opensuse tumbleweed", "opensuse-tumbleweed"),
+    ("opensuse leap", "opensuse.leap"),
+    ("opensuse tumbleweed", "opensuse.tumbleweed"),
     ("suse linux enterprise", "suse linux enterprise server"),
     ("red hat", "redhat"),
 ]

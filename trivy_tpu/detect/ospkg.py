@@ -95,6 +95,17 @@ class FamilyDriver:
     eol_key: Callable[[str], str] = staticmethod(lambda v: v)
     use_src: bool = True        # join on SrcName (False: binary pkg name)
     arch_aware: bool = False    # advisories scoped per-arch (Rocky/Alma)
+    # drivers that round-trip the advisory's FixedVersion through
+    # go-rpm-version String() — which omits an explicit epoch 0 —
+    # before reporting (alma.go:71, rocky.go:71, mariner.go:68,
+    # redhat.go:163; oracle/photon/suse/amazon report it raw)
+    strip_zero_epoch: bool = False
+
+
+def _strip_zero_epoch(ver: str) -> str:
+    """go-rpm-version String() omits an explicit epoch 0 — '0:1.2-3'
+    prints as '1.2-3'."""
+    return ver[2:] if ver.startswith("0:") else ver
 
 
 def _alpine_stream(os_ver: str, repo: Optional[T.Repository]) -> str:
@@ -183,12 +194,14 @@ DRIVERS: dict[str, FamilyDriver] = {
         family="rocky", ecosystem="rocky",
         stream=lambda v, r: major(v),
         bucket=lambda s: f"rocky {s}",
-        eol=ROCKY_EOL, eol_key=major, use_src=False, arch_aware=True),
+        eol=ROCKY_EOL, eol_key=major, use_src=False, arch_aware=True,
+        strip_zero_epoch=True),
     "alma": FamilyDriver(
         family="alma", ecosystem="alma",
         stream=lambda v, r: major(v),
         bucket=lambda s: f"alma {s}",
-        eol=ALMA_EOL, eol_key=major, use_src=False, arch_aware=True),
+        eol=ALMA_EOL, eol_key=major, use_src=False, arch_aware=True,
+        strip_zero_epoch=True),
     "photon": FamilyDriver(
         family="photon", ecosystem="photon",
         stream=lambda v, r: v,
@@ -198,10 +211,10 @@ DRIVERS: dict[str, FamilyDriver] = {
         family="cbl-mariner", ecosystem="cbl-mariner",
         stream=lambda v, r: minor(v),
         bucket=lambda s: f"CBL-Mariner {s}",
-        eol_key=minor),
+        eol_key=minor, strip_zero_epoch=True),
     # suse.go joins on the BINARY package name (suse.go:99)
-    "opensuse-leap": FamilyDriver(
-        family="opensuse-leap", ecosystem="opensuse-leap",
+    "opensuse.leap": FamilyDriver(
+        family="opensuse.leap", ecosystem="opensuse.leap",
         stream=lambda v, r: v,
         bucket=lambda s: f"openSUSE Leap {s}", use_src=False,
         eol=SUSE_OPENSUSE_EOL),
@@ -402,7 +415,7 @@ class OspkgScanner:
                 pkg_id=pkg.id, pkg_name=pkg.name,
                 pkg_identifier=pkg.identifier,
                 installed_version=pkg.format_version(),
-                fixed_version=h.fixed_version,
+                fixed_version=_strip_zero_epoch(h.fixed_version),
                 status=h.status, layer=pkg.layer,
                 data_source=T.DataSource(**h.data_source)
                 if h.data_source else None,
@@ -432,7 +445,8 @@ class OspkgScanner:
             pkg_name=pkg.name,
             pkg_identifier=pkg.identifier,
             installed_version=pkg.format_version(),
-            fixed_version=h.fixed_version,
+            fixed_version=_strip_zero_epoch(h.fixed_version)
+            if driver.strip_zero_epoch else h.fixed_version,
             status=h.status,
             layer=pkg.layer,
             data_source=T.DataSource(**h.data_source) if h.data_source else None,

@@ -31,11 +31,19 @@ _FAMILY = {
 
 @register
 class RedHatBaseAnalyzer(Analyzer):
+    """One analyzer for the whole redhat-base family: the reference
+    registers a separate analyzer per release file (redhatbase/
+    {redhatbase,centos,alma,rocky,oracle,fedora}.go) but all share the
+    same "<distro> release <version>" parse; the distro word in the
+    file decides the family either way."""
     name = "redhatbase"
-    version = 1
+    version = 2  # v2: centos/alma/rocky/oracle/fedora release files
+    paths = ("etc/redhat-release", "etc/centos-release",
+             "etc/almalinux-release", "etc/rocky-release",
+             "etc/oracle-release", "etc/fedora-release")
 
     def required(self, path: str, size: int = -1) -> bool:
-        return path == "etc/redhat-release"
+        return path in self.paths
 
     def analyze(self, path: str, content: bytes) -> Optional[AnalysisResult]:
         for line in content.decode(errors="replace").splitlines():

@@ -32,8 +32,8 @@ class OSFamily:
     FEDORA = "fedora"
     SUSE = "suse"  # family umbrella; concrete: opensuse/sles
     OPENSUSE = "opensuse"
-    OPENSUSE_LEAP = "opensuse-leap"
-    OPENSUSE_TUMBLEWEED = "opensuse-tumbleweed"
+    OPENSUSE_LEAP = "opensuse.leap"
+    OPENSUSE_TUMBLEWEED = "opensuse.tumbleweed"
     SLES = "suse linux enterprise server"
     PHOTON = "photon"
     WOLFI = "wolfi"
@@ -157,13 +157,22 @@ class OS(JsonMixin):
         return self.family != ""
 
     def merge(self, other: "OS") -> None:
-        """Later layers override (reference fanal/types MergeElements semantics)."""
+        """Reference OS.Merge (pkg/fanal/types/artifact.go:30-55):
+        a previously detected family is KEPT unless it is redhat or
+        debian — Oracle ships /etc/redhat-release (detected as RHEL by
+        mistake) and Ubuntu ships debian files, so only those two get
+        overwritten by a later, more specific detection."""
         if not other.detected:
             return
-        # Keep richer family names like the reference's OS.Merge
-        # (pkg/fanal/types/artifact.go): a later-detected OS wins.
-        self.family = other.family or self.family
-        self.name = other.name or self.name
+        if self.family in (OSFamily.REDHAT, OSFamily.DEBIAN):
+            self.family = other.family
+            self.name = other.name
+            self.extended = other.extended
+            return
+        if not self.family:
+            self.family = other.family
+        if not self.name:
+            self.name = other.name
         self.extended = self.extended or other.extended
 
 

@@ -37,8 +37,8 @@ ECOSYSTEM_SCHEME = {
     "debian": "deb", "ubuntu": "deb",
     "redhat": "rpm", "centos": "rpm", "rocky": "rpm", "alma": "rpm",
     "oracle": "rpm", "amazon": "rpm", "fedora": "rpm",
-    "suse": "rpm", "opensuse": "rpm", "opensuse-leap": "rpm",
-    "opensuse-tumbleweed": "rpm", "suse linux enterprise server": "rpm",
+    "suse": "rpm", "opensuse": "rpm", "opensuse.leap": "rpm",
+    "opensuse.tumbleweed": "rpm", "suse linux enterprise server": "rpm",
     "photon": "rpm", "cbl-mariner": "rpm", "azurelinux": "rpm",
     # language ecosystems (pkg/detector/library/driver.go:25-95)
     "npm": "semver", "yarn": "semver", "pnpm": "semver",
