@@ -1,0 +1,109 @@
+"""SPDX license expression parsing — mirrors the reference's
+pkg/licensing/expression parser_test.go / expression_test.go cases."""
+
+import pytest
+
+from trivy_tpu.license_expr import (CompoundExpr, ParseError,
+                                    SimpleExpr, normalize,
+                                    normalize_for_spdx,
+                                    normalize_pkg_licenses, parse)
+
+
+class TestParse:
+    def test_single_license(self):
+        e = parse("Public Domain")
+        assert e == SimpleExpr("Public Domain")
+        assert e.render() == "Public Domain"
+
+    def test_tag_value_license(self):
+        s = "DocumentRef-spdx-tool-1.2:LicenseRef-MIT-Style-2"
+        e = parse(s)
+        assert e == SimpleExpr(s)
+        assert e.render() == s
+
+    def test_symbols_trailing_plus(self):
+        e = parse("Public ._-+")
+        assert e == SimpleExpr("Public ._-", has_plus=True)
+        assert e.render() == "Public ._-+"
+
+    def test_interior_plus_stays(self):
+        # '+' not at a word boundary stays inside the word
+        e = parse("A+B")
+        assert e == SimpleExpr("A+B")
+
+    def test_multi_licenses(self):
+        e = parse("Public Domain AND ( GPLv2+ or AFL ) AND "
+                  "LGPLv2+ with distribution exceptions")
+        assert e.render() == ("Public Domain AND (GPLv2+ or AFL) AND "
+                              "LGPLv2+ with distribution exceptions")
+        assert isinstance(e, CompoundExpr)
+        assert e.right.left == SimpleExpr("LGPLv2", has_plus=True)
+        assert e.right.right == SimpleExpr("distribution exceptions")
+
+    def test_nested_licenses(self):
+        e = parse("Public Domain AND ( GPLv2+ or AFL AND "
+                  "( CC0 or LGPL1.0) )")
+        assert e.render() == ("Public Domain AND (GPLv2+ or AFL AND "
+                              "(CC0 or LGPL1.0))")
+
+    def test_unclosed_paren_errors(self):
+        with pytest.raises(ParseError):
+            parse("Public Domain AND ( GPLv2+ ")
+
+    def test_with_binds_tighter_than_and(self):
+        e = parse("A WITH exc AND B")
+        assert e.conj_lit == "AND"
+        assert e.left.render() == "A WITH exc"
+
+    def test_with_right_assoc(self):
+        e = parse("A WITH B WITH C")
+        assert e.right.render() == "B WITH C"
+
+
+class TestNormalize:
+    def test_versioned_only_or_later(self):
+        assert parse("GPL-2.0").render() == "GPL-2.0-only"
+        assert parse("GPL-2.0+").render() == "GPL-2.0-or-later"
+        assert parse("MIT+").render() == "MIT+"
+
+    def test_normalize_uppercases_conjunctions(self):
+        assert normalize("MIT or BSD-3-Clause") == \
+            "MIT OR BSD-3-Clause"
+
+    def test_normalize_applies_fns(self):
+        assert normalize("The MIT License",
+                         lambda s: {"The MIT License": "MIT"}
+                         .get(s, s)) == "MIT"
+
+    def test_normalize_for_spdx(self):
+        assert normalize_for_spdx("Public Domain") == "Public-Domain"
+        assert normalize_for_spdx("A:B c") == "A:B-c"
+
+
+class TestPkgLicenses:
+    def test_with_dash_expansion(self):
+        out = normalize_pkg_licenses(
+            ["GPL-3.0-with-autoconf-exception"])
+        assert "WITH" in out
+
+    def test_joined_and(self):
+        out = normalize_pkg_licenses(["MIT", "Apache-2.0"])
+        assert out == "MIT AND Apache-2.0"
+
+    def test_empty(self):
+        assert normalize_pkg_licenses([]) == ""
+
+    def test_gnu_naming_through_pipeline(self):
+        out = normalize_pkg_licenses(["GPL-2.0"])
+        assert out == "GPL-2.0-only"
+
+
+class TestPlusTable:
+    def test_plus_table_entries_reachable(self):
+        # 'lgplv2+' maps via the normalize table (more specific than
+        # bare lgplv2 + or-later suffixing)
+        out = normalize_pkg_licenses(["LGPLv2+"])
+        assert out == "LGPL-2.1-or-later"
+
+    def test_spdx_ascii_only(self):
+        assert normalize_for_spdx("Café 1.0") == "Caf--1.0"

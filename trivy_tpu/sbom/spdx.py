@@ -5,6 +5,7 @@ from __future__ import annotations
 import hashlib
 
 from .. import types as T
+from ..license_expr import normalize_pkg_licenses
 from ..purl import purl_for_package
 
 
@@ -52,7 +53,7 @@ def encode_spdx(report: T.Report, app_version: str = "dev") -> dict:
         for pkg in res.packages:
             pid = _spdx_id(
                 "Package", f"{res.target}/{pkg.name}@{pkg.version}")
-            lic = " AND ".join(pkg.licenses) or "NOASSERTION"
+            lic = normalize_pkg_licenses(pkg.licenses) or "NOASSERTION"
             entry = {
                 "name": pkg.name,
                 "SPDXID": pid,
