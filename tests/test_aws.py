@@ -34,6 +34,14 @@ DESCRIBE_SGS = """<?xml version="1.0"?>
       <fromPort>22</fromPort><toPort>22</toPort>
       <ipRanges><item><cidrIp>0.0.0.0/0</cidrIp></item></ipRanges>
     </item></ipPermissions>
+  </item><item>
+    <groupName>default</groupName>
+    <groupDescription>default VPC security group</groupDescription>
+    <ipPermissions><item>
+      <fromPort>443</fromPort><toPort>443</toPort>
+      <ipRanges><item><cidrIp>10.0.0.0/8</cidrIp>
+      <description>internal</description></item></ipRanges>
+    </item></ipPermissions>
   </item></securityGroupInfo>
 </DescribeSecurityGroupsResponse>"""
 
@@ -107,6 +115,128 @@ POLICY_VERSION = """<?xml version="1.0"?>
 </PolicyVersion></GetPolicyVersionResult></GetPolicyVersionResponse>"""
 
 
+DESCRIBE_VPCS = """<?xml version="1.0"?>
+<DescribeVpcsResponse>
+  <vpcSet><item>
+    <vpcId>vpc-1</vpcId><isDefault>true</isDefault>
+  </item></vpcSet>
+</DescribeVpcsResponse>"""
+
+DESCRIBE_FLOW_LOGS = """<?xml version="1.0"?>
+<DescribeFlowLogsResponse><flowLogSet/></DescribeFlowLogsResponse>"""
+
+PASSWORD_POLICY = """<GetAccountPasswordPolicyResponse>
+<GetAccountPasswordPolicyResult><PasswordPolicy>
+  <MinimumPasswordLength>6</MinimumPasswordLength>
+  <RequireSymbols>false</RequireSymbols>
+  <RequireNumbers>false</RequireNumbers>
+  <RequireUppercaseCharacters>false</RequireUppercaseCharacters>
+  <RequireLowercaseCharacters>false</RequireLowercaseCharacters>
+  <MaxPasswordAge>400</MaxPasswordAge>
+  <PasswordReusePrevention>1</PasswordReusePrevention>
+</PasswordPolicy></GetAccountPasswordPolicyResult>
+</GetAccountPasswordPolicyResponse>"""
+
+ACCOUNT_SUMMARY = """<GetAccountSummaryResponse>
+<GetAccountSummaryResult><SummaryMap>
+  <entry><key>AccountAccessKeysPresent</key><value>1</value></entry>
+  <entry><key>AccountMFAEnabled</key><value>0</value></entry>
+</SummaryMap></GetAccountSummaryResult></GetAccountSummaryResponse>"""
+
+LIST_USERS = """<ListUsersResponse><ListUsersResult><Users><member>
+  <UserName>stale-admin</UserName>
+  <PasswordLastUsed>2020-01-01T00:00:00Z</PasswordLastUsed>
+</member></Users></ListUsersResult></ListUsersResponse>"""
+
+LOGIN_PROFILE = """<GetLoginProfileResponse><GetLoginProfileResult>
+<LoginProfile><UserName>stale-admin</UserName></LoginProfile>
+</GetLoginProfileResult></GetLoginProfileResponse>"""
+
+MFA_EMPTY = """<ListMFADevicesResponse><ListMFADevicesResult>
+<MFADevices/></ListMFADevicesResult></ListMFADevicesResponse>"""
+
+ACCESS_KEYS = """<ListAccessKeysResponse><ListAccessKeysResult>
+<AccessKeyMetadata><member>
+  <AccessKeyId>AKIAOLD</AccessKeyId><Status>Active</Status>
+  <CreateDate>2020-01-01T00:00:00Z</CreateDate>
+</member></AccessKeyMetadata>
+</ListAccessKeysResult></ListAccessKeysResponse>"""
+
+KEY_LAST_USED = """<GetAccessKeyLastUsedResponse>
+<GetAccessKeyLastUsedResult><AccessKeyLastUsed>
+  <LastUsedDate>2020-06-01T00:00:00Z</LastUsedDate>
+</AccessKeyLastUsed></GetAccessKeyLastUsedResult>
+</GetAccessKeyLastUsedResponse>"""
+
+ATTACHED_POLICIES = """<ListAttachedUserPoliciesResponse>
+<ListAttachedUserPoliciesResult><AttachedPolicies><member>
+  <PolicyName>AdministratorAccess</PolicyName>
+</member></AttachedPolicies></ListAttachedUserPoliciesResult>
+</ListAttachedUserPoliciesResponse>"""
+
+CF_LIST = """<DistributionList><Items><DistributionSummary>
+  <Id>DIST1</Id>
+  <ViewerCertificate><MinimumProtocolVersion>TLSv1
+  </MinimumProtocolVersion></ViewerCertificate>
+  <DefaultCacheBehavior><ViewerProtocolPolicy>allow-all
+  </ViewerProtocolPolicy></DefaultCacheBehavior>
+</DistributionSummary></Items>
+<IsTruncated>false</IsTruncated></DistributionList>"""
+
+CF_CONFIG = """<DistributionConfig><Logging><Enabled>false</Enabled>
+</Logging></DistributionConfig>"""
+
+EKS_CLUSTERS = json.dumps({"clusters": ["prod"]})
+EKS_CLUSTER = json.dumps({"cluster": {
+    "name": "prod",
+    "logging": {"clusterLogging": [
+        {"types": ["api"], "enabled": True}]},
+    "resourcesVpcConfig": {"endpointPublicAccess": True,
+                           "publicAccessCidrs": ["0.0.0.0/0"]}}})
+
+LAMBDA_FNS = json.dumps({"Functions": [
+    {"FunctionName": "fn1", "TracingConfig": {"Mode": "PassThrough"}}]})
+
+APIGW_APIS = json.dumps({"item": [{"id": "api1", "name": "shop"}]})
+APIGW_STAGES = json.dumps({"item": [
+    {"stageName": "prod", "tracingEnabled": False}]})
+
+LIST_TOPICS = """<ListTopicsResponse><ListTopicsResult><Topics><member>
+  <TopicArn>arn:aws:sns:us-east-1:1:alerts</TopicArn>
+</member></Topics></ListTopicsResult></ListTopicsResponse>"""
+
+TOPIC_ATTRS = """<GetTopicAttributesResponse>
+<GetTopicAttributesResult><Attributes/>
+</GetTopicAttributesResult></GetTopicAttributesResponse>"""
+
+LIST_QUEUES = """<ListQueuesResponse><ListQueuesResult>
+  <QueueUrl>https://sqs.us-east-1.amazonaws.com/1/jobs</QueueUrl>
+</ListQueuesResult></ListQueuesResponse>"""
+
+QUEUE_ATTRS = """<GetQueueAttributesResponse>
+<GetQueueAttributesResult>
+  <Attribute><Name>SqsManagedSseEnabled</Name><Value>false</Value>
+  </Attribute>
+</GetQueueAttributesResult></GetQueueAttributesResponse>"""
+
+ELASTICACHE = """<DescribeReplicationGroupsResponse>
+<DescribeReplicationGroupsResult><ReplicationGroups>
+  <ReplicationGroup>
+    <ReplicationGroupId>sessions</ReplicationGroupId>
+    <AtRestEncryptionEnabled>false</AtRestEncryptionEnabled>
+    <TransitEncryptionEnabled>false</TransitEncryptionEnabled>
+  </ReplicationGroup>
+</ReplicationGroups></DescribeReplicationGroupsResult>
+</DescribeReplicationGroupsResponse>"""
+
+REDSHIFT = """<DescribeClustersResponse><DescribeClustersResult>
+<Clusters><Cluster>
+  <ClusterIdentifier>dw1</ClusterIdentifier>
+  <Encrypted>false</Encrypted>
+</Cluster></Clusters></DescribeClustersResult>
+</DescribeClustersResponse>"""
+
+
 class FakeAWS(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
@@ -123,8 +253,23 @@ class FakeAWS(BaseHTTPRequestHandler):
         if "AWS4-HMAC-SHA256" not in \
                 (self.headers.get("Authorization") or ""):
             return self._reply("<Error>unsigned</Error>", 403)
-        if self.path == "/":
+        path = self.path.split("?")[0]
+        if path == "/":
             return self._reply(LIST_BUCKETS)
+        if path == "/2020-05-31/distribution":
+            return self._reply(CF_LIST)
+        if path.startswith("/2020-05-31/distribution/"):
+            return self._reply(CF_CONFIG)
+        if path == "/clusters":
+            return self._reply(EKS_CLUSTERS)
+        if path.startswith("/clusters/"):
+            return self._reply(EKS_CLUSTER)
+        if path == "/2015-03-31/functions/":
+            return self._reply(LAMBDA_FNS)
+        if path == "/restapis":
+            return self._reply(APIGW_APIS)
+        if path.endswith("/stages"):
+            return self._reply(APIGW_STAGES)
         if "versioning" in self.path:
             return self._reply(EMPTY_VERSIONING)
         if "logging" in self.path:
@@ -139,30 +284,78 @@ class FakeAWS(BaseHTTPRequestHandler):
             return self._reply(EFS_JSON)
         return self._reply("<Error/>", 404)
 
+    _JSON_TARGETS = {
+        "DescribeTrails": TRAILS_JSON,
+        "ListTables": json.dumps({"TableNames": ["orders"]}),
+        "DescribeTable": json.dumps({"Table": {}}),
+        "DescribeContinuousBackups": json.dumps(
+            {"ContinuousBackupsDescription":
+             {"PointInTimeRecoveryDescription":
+              {"PointInTimeRecoveryStatus": "DISABLED"}}}),
+        "DescribeRepositories": json.dumps({"repositories": [
+            {"repositoryName": "app",
+             "imageScanningConfiguration": {"scanOnPush": False},
+             "imageTagMutability": "MUTABLE"}]}),
+        "ListClusters": json.dumps(
+            {"clusterArns": ["arn:aws:ecs:1:cluster/main"]}),
+        "DescribeClusters": json.dumps({"clusters": [
+            {"clusterName": "main", "settings": [
+                {"name": "containerInsights", "value": "disabled"}]}]}),
+        "ListKeys": json.dumps(
+            {"Keys": [{"KeyId": "key-1"}], "Truncated": False}),
+        "DescribeKey": json.dumps({"KeyMetadata": {
+            "KeyId": "key-1", "KeyManager": "CUSTOMER",
+            "KeyUsage": "ENCRYPT_DECRYPT"}}),
+        "GetKeyRotationStatus": json.dumps(
+            {"KeyRotationEnabled": False}),
+    }
+
+    _QUERY_ACTIONS = {
+        "DescribeSecurityGroups": DESCRIBE_SGS,
+        "DescribeInstances": DESCRIBE_INSTANCES,
+        "DescribeVolumes": DESCRIBE_VOLUMES,
+        "DescribeVpcs": DESCRIBE_VPCS,
+        "DescribeFlowLogs": DESCRIBE_FLOW_LOGS,
+        "DescribeDBInstances": DESCRIBE_DBS,
+        "DescribeLoadBalancerAttributes": LB_ATTRS,
+        "DescribeLoadBalancers": DESCRIBE_LBS,
+        "ListPolicies": LIST_POLICIES,
+        "GetPolicyVersion": POLICY_VERSION,
+        "GetCallerIdentity": CALLER_IDENTITY,
+        "GetAccountPasswordPolicy": PASSWORD_POLICY,
+        "GetAccountSummary": ACCOUNT_SUMMARY,
+        "ListUsers": LIST_USERS,
+        "GetLoginProfile": LOGIN_PROFILE,
+        "ListMFADevices": MFA_EMPTY,
+        "ListAccessKeys": ACCESS_KEYS,
+        "GetAccessKeyLastUsed": KEY_LAST_USED,
+        "ListAttachedUserPolicies": ATTACHED_POLICIES,
+        "ListTopics": LIST_TOPICS,
+        "GetTopicAttributes": TOPIC_ATTRS,
+        "ListQueues": LIST_QUEUES,
+        "GetQueueAttributes": QUEUE_ATTRS,
+        "DescribeReplicationGroups": ELASTICACHE,
+        "DescribeClusters": REDSHIFT,
+    }
+
     def do_POST(self):
         ln = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(ln).decode()
         target = self.headers.get("X-Amz-Target", "")
-        if "DescribeTrails" in target:
-            return self._reply(TRAILS_JSON)
-        if "DescribeSecurityGroups" in body:
-            return self._reply(DESCRIBE_SGS)
-        if "DescribeInstances" in body:
-            return self._reply(DESCRIBE_INSTANCES)
-        if "DescribeVolumes" in body:
-            return self._reply(DESCRIBE_VOLUMES)
-        if "DescribeDBInstances" in body:
-            return self._reply(DESCRIBE_DBS)
-        if "DescribeLoadBalancerAttributes" in body:
-            return self._reply(LB_ATTRS)
-        if "DescribeLoadBalancers" in body:
-            return self._reply(DESCRIBE_LBS)
-        if "ListPolicies" in body:
-            return self._reply(LIST_POLICIES)
-        if "GetPolicyVersion" in body:
-            return self._reply(POLICY_VERSION)
-        if "GetCallerIdentity" in body:
-            return self._reply(CALLER_IDENTITY)
+        if target:
+            action = target.rsplit(".", 1)[-1]
+            if action in self._JSON_TARGETS:
+                return self._reply(self._JSON_TARGETS[action])
+            return self._reply("{}", 400)
+        # query protocol: longest action name wins (DescribeLoad-
+        # BalancerAttributes vs DescribeLoadBalancers)
+        best = ""
+        for action in self._QUERY_ACTIONS:
+            if f"Action={action}&" in body + "&" and \
+                    len(action) > len(best):
+                best = action
+        if best:
+            return self._reply(self._QUERY_ACTIONS[best])
         return self._reply("<Error/>", 400)
 
 
@@ -219,7 +412,7 @@ def test_account_cache_roundtrip(fake_aws, tmp_path):
 
 def test_unsupported_service(fake_aws, tmp_path):
     with pytest.raises(AWSError):
-        scan_account(["lambda"], endpoint=fake_aws,
+        scan_account(["nosuchservice"], endpoint=fake_aws,
                      cache_dir=str(tmp_path))
 
 
@@ -243,10 +436,12 @@ def test_cli_aws_json(fake_aws, tmp_path, capsys):
 
 def test_scan_account_breadth(fake_aws, tmp_path):
     """The expanded service walkers (reference pkg/cloud/aws coverage):
-    rds/ebs/cloudtrail/efs/elb/iam state evaluated by the shared
-    AVD-AWS checks."""
+    every supported service's state evaluated by the shared AVD-AWS
+    checks."""
+    from trivy_tpu.cloud.aws import SUPPORTED_SERVICES
+    assert len(SUPPORTED_SERVICES) >= 20
     results, account = scan_account(
-        ["ec2", "ebs", "rds", "cloudtrail", "efs", "elb", "iam"],
+        list(SUPPORTED_SERVICES),
         endpoint=fake_aws, cache_dir=str(tmp_path), update_cache=True)
     ids = {m.id for r in results for m in r.misconfigurations}
     for want in (
@@ -257,9 +452,41 @@ def test_scan_account_breadth(fake_aws, tmp_path):
             "AVD-AWS-0180",   # RDS public
             "AVD-AWS-0014",   # trail not multi-region
             "AVD-AWS-0016",   # trail without validation
+            "AVD-AWS-0162",   # trail not wired to CloudWatch
             "AVD-AWS-0037",   # EFS unencrypted
             "AVD-AWS-0052",   # ALB keeps invalid headers
             "AVD-AWS-0057",   # IAM wildcards
+            "AVD-AWS-0178",   # VPC without flow logs
+            "AVD-AWS-0173",   # default SG has rules
+            "AVD-AWS-0063",   # weak password minimum length
+            "AVD-AWS-0062",   # password max age > 90
+            "AVD-AWS-0056",   # password reuse allowed
+            "AVD-AWS-0141",   # root access keys
+            "AVD-AWS-0142",   # root without MFA
+            "AVD-AWS-0143",   # user-attached policies
+            "AVD-AWS-0144",   # stale credentials
+            "AVD-AWS-0145",   # console user without MFA
+            "AVD-AWS-0146",   # old access keys
+            "AVD-AWS-0010",   # cloudfront no logging
+            "AVD-AWS-0012",   # cloudfront allow-all
+            "AVD-AWS-0013",   # cloudfront weak TLS
+            "AVD-AWS-0024",   # dynamodb no PITR
+            "AVD-AWS-0025",   # dynamodb no CMK
+            "AVD-AWS-0030",   # ecr no scan on push
+            "AVD-AWS-0031",   # ecr mutable tags
+            "AVD-AWS-0034",   # ecs no container insights
+            "AVD-AWS-0038",   # eks no audit logs
+            "AVD-AWS-0039",   # eks secrets unencrypted
+            "AVD-AWS-0040",   # eks public endpoint
+            "AVD-AWS-0065",   # kms rotation off
+            "AVD-AWS-0066",   # lambda no tracing
+            "AVD-AWS-0095",   # sns unencrypted
+            "AVD-AWS-0096",   # sqs unencrypted
+            "AVD-AWS-0045",   # elasticache at-rest
+            "AVD-AWS-0046",   # elasticache transit
+            "AVD-AWS-0083",   # redshift unencrypted
+            "AVD-AWS-0084",   # redshift outside VPC
+            "AVD-AWS-0001",   # apigw stage without access logs
     ):
         assert want in ids, want
     svc_targets = {r.target for r in results}
@@ -295,3 +522,57 @@ def test_paged_query_follows_tokens():
     assert names == ["p1", "p2"]
     assert len(stub.calls) == 2
     assert "Marker=page2" in stub.calls[1]
+
+
+def test_throttled_request_retries(monkeypatch, tmp_path):
+    """429/Throttling responses retry instead of failing the walk."""
+    import threading as _t
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    calls = {"n": 0}
+
+    class Throttling(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            calls["n"] += 1
+            if calls["n"] == 1:
+                body = b"<Error><Code>Throttling</Code></Error>"
+                self.send_response(400)
+            else:
+                body = CALLER_IDENTITY.encode()
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIATEST")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Throttling)
+    _t.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        from trivy_tpu.cloud.aws import get_account_id
+        client = AWSClient(
+            endpoint=f"http://127.0.0.1:{httpd.server_address[1]}")
+        assert get_account_id(client) == "123456789012"
+        assert calls["n"] == 2
+    finally:
+        httpd.shutdown()
+
+
+def test_aws_compliance_cis12(fake_aws, tmp_path, capsys):
+    """aws-cis-1.2 runs over live-account scan results."""
+    from trivy_tpu import cli
+    cli.main(["aws", "--endpoint", fake_aws, "--format", "json",
+              "--cache-dir", str(tmp_path), "--update-cache",
+              "--compliance", "aws-cis-1.2", "--report", "all"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["ID"] == "aws-cis-1.2"
+    by_id = {c["ID"]: c for c in out["Results"]}
+    assert by_id["1.13"]["Findings"]          # root MFA failure
+    assert by_id["1.12"]["Findings"]          # root access keys
+    assert by_id["4.3"]["Findings"]           # default SG has rules
+    assert by_id["1.9"]["Findings"]           # weak min length
+    assert by_id["1.1"]["Status"] == "MANUAL"

@@ -218,7 +218,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="API endpoint override (e.g. LocalStack)")
     p.add_argument("--services", default="",
                    help="comma-separated services (s3,ec2,ebs,rds,"
-                        "cloudtrail,efs,elb,iam); default all")
+                        "cloudtrail,efs,elb,iam,cloudfront,dynamodb,"
+                        "ecr,ecs,eks,kms,lambda,sns,sqs,elasticache,"
+                        "redshift,api-gateway); default all")
     p.add_argument("--account", default="")
     p.add_argument("--update-cache", action="store_true")
     p.add_argument("--max-cache-age", default="24h",

@@ -8,9 +8,11 @@ the same checks the terraform/cloudformation scanners use, which is
 exactly how the reference reuses its iac rules over live accounts
 (pkg/cloud/aws/scanner/scanner.go:29).
 
-Services covered: s3, ec2 (security groups + instances), ebs, rds,
-cloudtrail, efs, elb (v2), iam (customer-managed policies), and sts
-(account discovery).
+Services covered: s3, ec2 (security groups, instances, VPC flow
+logs), ebs, rds, cloudtrail, efs, elb (v2), iam (customer-managed
+policies + account password policy, root summary, per-user credential
+hygiene), cloudfront, dynamodb, ecr, ecs, eks, kms, lambda, sns, sqs,
+elasticache, redshift, api-gateway, and sts (account discovery).
 """
 
 from __future__ import annotations
@@ -29,8 +31,12 @@ from ..log import logger
 from .sigv4 import sign
 
 SUPPORTED_SERVICES = ["s3", "ec2", "ebs", "rds", "cloudtrail",
-                      "efs", "elb", "iam"]
-CACHE_VERSION = 1
+                      "efs", "elb", "iam", "cloudfront", "dynamodb",
+                      "ecr", "ecs", "eks", "kms", "lambda", "sns",
+                      "sqs", "elasticache", "redshift", "api-gateway"]
+# v2: cloudtrail carries cloud_watch_logs_group_arn; ec2 emits
+# aws_vpc + security-group is_default — older caches must not load
+CACHE_VERSION = 2
 
 
 class AWSError(Exception):
@@ -74,18 +80,31 @@ class AWSClient:
                       self.session_token)
         qs = urllib.parse.urlencode(sorted(query.items()))
         full = f"{url}{path}" + (f"?{qs}" if qs else "")
-        req = urllib.request.Request(full, data=body or None,
-                                     method=method, headers=signed)
-        try:
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout) as r:
-                return r.read()
-        except urllib.error.HTTPError as e:
-            raise AWSError(
-                f"{service} {path}: HTTP {e.code}: "
-                f"{e.read()[:200]!r}") from e
-        except Exception as e:
-            raise AWSError(f"{service} request failed: {e}") from e
+        # throttling / transient server errors retry with backoff the
+        # way the reference's SDK does — an account walk hitting rate
+        # limits must not cache partial state
+        last: Exception | None = None
+        for attempt in range(3):
+            req = urllib.request.Request(full, data=body or None,
+                                         method=method, headers=signed)
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                detail = e.read()[:200]
+                last = AWSError(
+                    f"{service} {path}: HTTP {e.code}: {detail!r}")
+                last.__cause__ = e
+                if e.code not in (429, 500, 502, 503) and \
+                        b"Throttling" not in detail:
+                    raise last
+            except Exception as e:
+                raise AWSError(
+                    f"{service} request failed: {e}") from e
+            if attempt < 2:
+                time.sleep(0.2 * (attempt + 1))
+        raise last
 
 
 def _xml(data: bytes) -> ET.Element:
@@ -99,7 +118,9 @@ def _xml(data: bytes) -> ET.Element:
 
 def _txt(el, path, default=""):
     found = el.find(path)
-    return found.text if found is not None and found.text else default
+    if found is None or not found.text:
+        return default
+    return found.text.strip() or default
 
 
 # ---- service walkers → CloudResource state ---------------------------
@@ -172,6 +193,7 @@ def _parse_sgs(doc) -> list[CloudResource]:
     for item in doc.findall(".//securityGroupInfo/item"):
         name = _txt(item, "groupName")
         r = CloudResource("aws_security_group", name)
+        r.attrs["is_default"] = Attr(name == "default")
         r.attrs["description"] = Attr(_txt(item, "groupDescription"))
         ingress = []
         for perm in item.findall("ipPermissions/item"):
@@ -320,6 +342,8 @@ def walk_cloudtrail(client: AWSClient) -> list[CloudResource]:
             bool(t.get("LogFileValidationEnabled")))
         if t.get("KmsKeyId"):
             r.attrs["kms_key_id"] = Attr(t["KmsKeyId"])
+        r.attrs["cloud_watch_logs_group_arn"] = Attr(
+            t.get("CloudWatchLogsLogGroupArn", ""))
         out.append(r)
     return out
 
@@ -385,14 +409,148 @@ def _parse_lbs(client: AWSClient, doc) -> list[CloudResource]:
 
 
 def walk_iam(client: AWSClient) -> list[CloudResource]:
-    """Customer-managed policies: ListPolicies(Scope=Local) +
-    GetPolicyVersion → policy documents for the wildcard check."""
+    """Customer-managed policies (wildcard check) + account password
+    policy, root-account summary, and per-user credential hygiene
+    (CIS 1.x controls)."""
     out = []
     for doc in _paged_query(client, "iam", "ListPolicies",
                             "2010-05-08", {"Scope": "Local"},
                             req_token="Marker",
                             resp_paths=(".//Marker",)):
         out += _parse_policies(client, doc)
+    out += _walk_iam_password_policy(client)
+    out += _walk_iam_root(client)
+    out += _walk_iam_users(client)
+    return out
+
+
+def _walk_iam_password_policy(client: AWSClient) -> list[CloudResource]:
+    r = CloudResource("aws_iam_password_policy", "account")
+    try:
+        doc = _query_api(client, "iam", "GetAccountPasswordPolicy",
+                         "2010-05-08")
+    except AWSError as e:
+        if "NoSuchEntity" in str(e):
+            # no policy set at all: every requirement check fires
+            r.attrs["reuse_prevention"] = Attr(0)
+            r.attrs["require_lowercase"] = Attr(False)
+            r.attrs["require_numbers"] = Attr(False)
+            r.attrs["require_symbols"] = Attr(False)
+            r.attrs["require_uppercase"] = Attr(False)
+            r.attrs["max_age_days"] = Attr(0)
+            r.attrs["minimum_length"] = Attr(0)
+            return [r]
+        raise
+    p = doc.find(".//PasswordPolicy")
+    if p is None:
+        return []
+    r.attrs["reuse_prevention"] = Attr(
+        int(_txt(p, "PasswordReusePrevention", "0") or 0))
+    r.attrs["require_lowercase"] = Attr(
+        _txt(p, "RequireLowercaseCharacters") == "true")
+    r.attrs["require_numbers"] = Attr(
+        _txt(p, "RequireNumbers") == "true")
+    r.attrs["require_symbols"] = Attr(
+        _txt(p, "RequireSymbols") == "true")
+    r.attrs["require_uppercase"] = Attr(
+        _txt(p, "RequireUppercaseCharacters") == "true")
+    r.attrs["max_age_days"] = Attr(
+        int(_txt(p, "MaxPasswordAge", "0") or 0))
+    r.attrs["minimum_length"] = Attr(
+        int(_txt(p, "MinimumPasswordLength", "0") or 0))
+    return [r]
+
+
+def _walk_iam_root(client: AWSClient) -> list[CloudResource]:
+    try:
+        doc = _query_api(client, "iam", "GetAccountSummary",
+                         "2010-05-08")
+    except AWSError:
+        return []
+    summary = {}
+    for e in doc.findall(".//SummaryMap/entry"):
+        summary[_txt(e, "key")] = int(_txt(e, "value", "0") or 0)
+    r = CloudResource("aws_iam_root", "root")
+    r.attrs["access_keys_present"] = Attr(
+        summary.get("AccountAccessKeysPresent", 0) > 0)
+    r.attrs["mfa_enabled"] = Attr(
+        summary.get("AccountMFAEnabled", 0) > 0)
+    return [r]
+
+
+def _days_since(iso: str) -> int | None:
+    import datetime as dt
+    if not iso:
+        return None
+    try:
+        then = dt.datetime.fromisoformat(iso.replace("Z", "+00:00"))
+    except ValueError:
+        return None
+    now = dt.datetime.now(dt.timezone.utc)
+    return max(0, int((now - then).total_seconds() // 86400))
+
+
+def _walk_iam_users(client: AWSClient) -> list[CloudResource]:
+    out = []
+    for doc in _paged_query(client, "iam", "ListUsers", "2010-05-08",
+                            req_token="Marker",
+                            resp_paths=(".//Marker",)):
+        for u in doc.findall(".//Users/member"):
+            name = _txt(u, "UserName")
+            r = CloudResource("aws_iam_user", name)
+            pw_used = _days_since(_txt(u, "PasswordLastUsed"))
+            if pw_used is not None:
+                r.attrs["password_last_used_days"] = Attr(pw_used)
+            try:
+                _query_api(client, "iam", "GetLoginProfile",
+                           "2010-05-08", {"UserName": name})
+                r.attrs["has_console_password"] = Attr(True)
+            except AWSError as e:
+                if "NoSuchEntity" in str(e):
+                    r.attrs["has_console_password"] = Attr(False)
+            try:
+                mfa = _query_api(client, "iam", "ListMFADevices",
+                                 "2010-05-08", {"UserName": name})
+                r.attrs["mfa_active"] = Attr(
+                    mfa.find(".//MFADevices/member") is not None)
+            except AWSError:
+                pass
+            try:
+                keys = _query_api(client, "iam", "ListAccessKeys",
+                                  "2010-05-08", {"UserName": name})
+                ages, unused = [], []
+                for k in keys.findall(
+                        ".//AccessKeyMetadata/member"):
+                    if _txt(k, "Status") != "Active":
+                        continue
+                    age = _days_since(_txt(k, "CreateDate"))
+                    if age is not None:
+                        ages.append(age)
+                    kid = _txt(k, "AccessKeyId")
+                    try:
+                        lu = _query_api(
+                            client, "iam", "GetAccessKeyLastUsed",
+                            "2010-05-08", {"AccessKeyId": kid})
+                        d = _days_since(_txt(
+                            lu, ".//AccessKeyLastUsed/LastUsedDate"))
+                        unused.append(d if d is not None
+                                      else (age or 0))
+                    except AWSError:
+                        pass
+                r.attrs["access_key_ages_days"] = Attr(ages)
+                r.attrs["key_unused_days"] = Attr(unused)
+            except AWSError:
+                pass
+            try:
+                att = _query_api(client, "iam",
+                                 "ListAttachedUserPolicies",
+                                 "2010-05-08", {"UserName": name})
+                r.attrs["attached_policies"] = Attr([
+                    _txt(m, "PolicyName") for m in att.findall(
+                        ".//AttachedPolicies/member")])
+            except AWSError:
+                pass
+            out.append(r)
     return out
 
 
@@ -417,9 +575,393 @@ def _parse_policies(client: AWSClient, doc) -> list[CloudResource]:
     return out
 
 
+def _json_api(client: AWSClient, service: str, target: str,
+              payload: dict, version: str = "1.1") -> dict:
+    """AWS JSON-protocol POST (cloudtrail/dynamodb/ecr/kms/ecs
+    style)."""
+    raw = client.request(
+        service, method="POST",
+        body=json.dumps(payload).encode(),
+        headers={"Content-Type": f"application/x-amz-json-{version}",
+                 "X-Amz-Target": target})
+    return json.loads(raw or b"{}")
+
+
+def walk_cloudfront(client: AWSClient) -> list[CloudResource]:
+    """REST XML: ListDistributions + per-distribution config."""
+    out = []
+    marker = ""
+    for _ in range(_MAX_PAGES):
+        query = {"Marker": marker} if marker else {}
+        doc = _xml(client.request("cloudfront",
+                                  path="/2020-05-31/distribution",
+                                  query=query))
+        for item in doc.findall(".//DistributionSummary"):
+            did = _txt(item, "Id")
+            r = CloudResource("aws_cloudfront_distribution", did)
+            r.attrs["minimum_protocol_version"] = Attr(_txt(
+                item, ".//ViewerCertificate/MinimumProtocolVersion",
+                "TLSv1"))
+            policies = []
+            for beh in ([item.find("DefaultCacheBehavior")]
+                        + item.findall(".//CacheBehaviors/Items"
+                                       "/CacheBehavior")):
+                if beh is not None:
+                    policies.append({"policy": _txt(
+                        beh, "ViewerProtocolPolicy", "allow-all")})
+            r.attrs["viewer_policies"] = Attr(policies)
+            try:
+                cfg = _xml(client.request(
+                    "cloudfront",
+                    path=f"/2020-05-31/distribution/{did}/config"))
+                r.attrs["logging_enabled"] = Attr(
+                    _txt(cfg, ".//Logging/Enabled") == "true")
+            except AWSError:
+                pass
+            out.append(r)
+        if _txt(doc, ".//IsTruncated") != "true":
+            break
+        marker = _txt(doc, ".//NextMarker")
+        if not marker:
+            break
+    else:
+        logger.warning("aws cloudfront: pagination stopped after %d "
+                       "pages; listing may be incomplete", _MAX_PAGES)
+    return out
+
+
+def walk_dynamodb(client: AWSClient) -> list[CloudResource]:
+    """JSON 1.0: ListTables → DescribeTable + ContinuousBackups."""
+    out = []
+    start = {}
+    tgt = "DynamoDB_20120810"
+    for _ in range(_MAX_PAGES):
+        body = _json_api(client, "dynamodb", f"{tgt}.ListTables",
+                         start, version="1.0")
+        for name in body.get("TableNames", []):
+            r = CloudResource("aws_dynamodb_table", name)
+            try:
+                t = _json_api(client, "dynamodb",
+                              f"{tgt}.DescribeTable",
+                              {"TableName": name}, version="1.0")
+                sse = (t.get("Table") or {}).get("SSEDescription") or {}
+                r.attrs["sse_kms_key"] = Attr(
+                    sse.get("KMSMasterKeyArn", ""))
+            except AWSError:
+                pass
+            try:
+                b = _json_api(client, "dynamodb",
+                              f"{tgt}.DescribeContinuousBackups",
+                              {"TableName": name}, version="1.0")
+                pitr = ((b.get("ContinuousBackupsDescription") or {})
+                        .get("PointInTimeRecoveryDescription") or {})
+                r.attrs["pitr_enabled"] = Attr(
+                    pitr.get("PointInTimeRecoveryStatus") == "ENABLED")
+            except AWSError:
+                pass
+            out.append(r)
+        last = body.get("LastEvaluatedTableName")
+        if not last:
+            break
+        start = {"ExclusiveStartTableName": last}
+    else:
+        logger.warning("aws dynamodb: pagination stopped after %d "
+                       "pages; listing may be incomplete", _MAX_PAGES)
+    return out
+
+
+def walk_ecr(client: AWSClient) -> list[CloudResource]:
+    out = []
+    payload: dict = {}
+    tgt = "AmazonEC2ContainerRegistry_V20150921.DescribeRepositories"
+    for _ in range(_MAX_PAGES):
+        body = _json_api(client, "ecr", tgt, payload)
+        for repo in body.get("repositories", []):
+            r = CloudResource("aws_ecr_repository",
+                              repo.get("repositoryName", ""))
+            scan = repo.get("imageScanningConfiguration") or {}
+            r.attrs["scan_on_push"] = Attr(bool(scan.get("scanOnPush")))
+            r.attrs["image_tag_mutability"] = Attr(
+                repo.get("imageTagMutability", "MUTABLE"))
+            out.append(r)
+        token = body.get("nextToken")
+        if not token:
+            break
+        payload = {"nextToken": token}
+    else:
+        logger.warning("aws ecr: pagination stopped after %d pages; "
+                       "listing may be incomplete", _MAX_PAGES)
+    return out
+
+
+def walk_ecs(client: AWSClient) -> list[CloudResource]:
+    out = []
+    payload: dict = {}
+    ns = "AmazonEC2ContainerServiceV20141113"
+    for _ in range(_MAX_PAGES):
+        body = _json_api(client, "ecs", f"{ns}.ListClusters", payload)
+        arns = body.get("clusterArns", [])
+        if arns:
+            desc = _json_api(
+                client, "ecs", f"{ns}.DescribeClusters",
+                {"clusters": arns, "include": ["SETTINGS"]})
+            for c in desc.get("clusters", []):
+                r = CloudResource("aws_ecs_cluster",
+                                  c.get("clusterName", ""))
+                ci = next((s.get("value") for s in
+                           c.get("settings", [])
+                           if s.get("name") == "containerInsights"),
+                          "disabled")
+                r.attrs["container_insights"] = Attr(ci == "enabled")
+                out.append(r)
+        token = body.get("nextToken")
+        if not token:
+            break
+        payload = {"nextToken": token}
+    else:
+        logger.warning("aws ecs: pagination stopped after %d pages; "
+                       "listing may be incomplete", _MAX_PAGES)
+    return out
+
+
+def walk_eks(client: AWSClient) -> list[CloudResource]:
+    """REST JSON: GET /clusters + GET /clusters/{name}."""
+    out = []
+    query: dict = {}
+    for _ in range(_MAX_PAGES):
+        body = json.loads(client.request("eks", path="/clusters",
+                                         query=query))
+        for name in body.get("clusters", []):
+            r = CloudResource("aws_eks_cluster", name)
+            try:
+                c = json.loads(client.request(
+                    "eks", path=f"/clusters/{name}")).get("cluster", {})
+            except AWSError:
+                out.append(r)
+                continue
+            types_on = [t for lg in (c.get("logging") or {})
+                        .get("clusterLogging", [])
+                        if lg.get("enabled")
+                        for t in lg.get("types", [])]
+            r.attrs["enabled_log_types"] = Attr(types_on)
+            r.attrs["secrets_encrypted"] = Attr(
+                bool(c.get("encryptionConfig")))
+            vpc = c.get("resourcesVpcConfig") or {}
+            r.attrs["endpoint_public_access"] = Attr(
+                bool(vpc.get("endpointPublicAccess", True)))
+            r.attrs["public_access_cidrs"] = Attr(
+                vpc.get("publicAccessCidrs") or ["0.0.0.0/0"])
+            out.append(r)
+        token = body.get("nextToken")
+        if not token:
+            break
+        query = {"nextToken": token}
+    else:
+        logger.warning("aws eks: pagination stopped after %d pages; "
+                       "listing may be incomplete", _MAX_PAGES)
+    return out
+
+
+def walk_kms(client: AWSClient) -> list[CloudResource]:
+    """JSON 1.1 TrentService: customer-managed keys + rotation."""
+    out = []
+    payload: dict = {}
+    for _ in range(_MAX_PAGES):
+        body = _json_api(client, "kms", "TrentService.ListKeys",
+                         payload)
+        for k in body.get("Keys", []):
+            kid = k.get("KeyId", "")
+            try:
+                meta = _json_api(client, "kms",
+                                 "TrentService.DescribeKey",
+                                 {"KeyId": kid}).get("KeyMetadata", {})
+            except AWSError:
+                continue
+            if meta.get("KeyManager") != "CUSTOMER":
+                continue  # AWS-managed keys rotate automatically
+            r = CloudResource("aws_kms_key", kid)
+            r.attrs["key_usage"] = Attr(
+                meta.get("KeyUsage", "ENCRYPT_DECRYPT"))
+            try:
+                rot = _json_api(client, "kms",
+                                "TrentService.GetKeyRotationStatus",
+                                {"KeyId": kid})
+                r.attrs["enable_key_rotation"] = Attr(
+                    bool(rot.get("KeyRotationEnabled")))
+            except AWSError:
+                pass
+            out.append(r)
+        if not body.get("Truncated"):
+            break
+        payload = {"Marker": body.get("NextMarker", "")}
+    else:
+        logger.warning("aws kms: pagination stopped after %d pages; "
+                       "listing may be incomplete", _MAX_PAGES)
+    return out
+
+
+def walk_lambda(client: AWSClient) -> list[CloudResource]:
+    out = []
+    query: dict = {}
+    for _ in range(_MAX_PAGES):
+        body = json.loads(client.request(
+            "lambda", path="/2015-03-31/functions/", query=query))
+        for fn in body.get("Functions", []):
+            r = CloudResource("aws_lambda_function",
+                              fn.get("FunctionName", ""))
+            r.attrs["tracing_mode"] = Attr(
+                (fn.get("TracingConfig") or {})
+                .get("Mode", "PassThrough"))
+            out.append(r)
+        marker = body.get("NextMarker")
+        if not marker:
+            break
+        query = {"Marker": marker}
+    else:
+        logger.warning("aws lambda: pagination stopped after %d "
+                       "pages; listing may be incomplete", _MAX_PAGES)
+    return out
+
+
+def walk_sns(client: AWSClient) -> list[CloudResource]:
+    out = []
+    for doc in _paged_query(client, "sns", "ListTopics", "2010-03-31",
+                            resp_paths=(".//NextToken",)):
+        for t in doc.findall(".//Topics/member"):
+            arn = _txt(t, "TopicArn")
+            r = CloudResource("aws_sns_topic",
+                              arn.rsplit(":", 1)[-1] or arn)
+            try:
+                attrs = _query_api(client, "sns", "GetTopicAttributes",
+                                   "2010-03-31", {"TopicArn": arn})
+                for e in attrs.findall(".//Attributes/entry"):
+                    if _txt(e, "key") == "KmsMasterKeyId":
+                        r.attrs["kms_master_key_id"] = Attr(
+                            _txt(e, "value"))
+            except AWSError:
+                pass
+            out.append(r)
+    return out
+
+
+def walk_sqs(client: AWSClient) -> list[CloudResource]:
+    out = []
+    for doc in _paged_query(client, "sqs", "ListQueues", "2012-11-05",
+                            resp_paths=(".//NextToken",)):
+        for q in doc.findall(".//QueueUrl"):
+            url = q.text or ""
+            name = url.rstrip("/").rsplit("/", 1)[-1]
+            r = CloudResource("aws_sqs_queue", name)
+            try:
+                attrs = _query_api(
+                    client, "sqs", "GetQueueAttributes", "2012-11-05",
+                    {"QueueUrl": url, "AttributeName.1": "All"})
+                for e in attrs.findall(".//Attribute"):
+                    k, v = _txt(e, "Name"), _txt(e, "Value")
+                    if k == "KmsMasterKeyId":
+                        r.attrs["kms_master_key_id"] = Attr(v)
+                    elif k == "SqsManagedSseEnabled":
+                        r.attrs["sqs_managed_sse_enabled"] = Attr(
+                            v == "true")
+            except AWSError:
+                pass
+            out.append(r)
+    return out
+
+
+def walk_elasticache(client: AWSClient) -> list[CloudResource]:
+    out = []
+    for doc in _paged_query(client, "elasticache",
+                            "DescribeReplicationGroups", "2015-02-02",
+                            req_token="Marker",
+                            resp_paths=(".//Marker",)):
+        for g in doc.findall(".//ReplicationGroups/ReplicationGroup"):
+            r = CloudResource("aws_elasticache_replication_group",
+                              _txt(g, "ReplicationGroupId"))
+            r.attrs["at_rest_encryption_enabled"] = Attr(
+                _txt(g, "AtRestEncryptionEnabled") == "true")
+            r.attrs["transit_encryption_enabled"] = Attr(
+                _txt(g, "TransitEncryptionEnabled") == "true")
+            out.append(r)
+    return out
+
+
+def walk_redshift(client: AWSClient) -> list[CloudResource]:
+    out = []
+    for doc in _paged_query(client, "redshift", "DescribeClusters",
+                            "2012-12-01", req_token="Marker",
+                            resp_paths=(".//Marker",)):
+        for c in doc.findall(".//Clusters/Cluster"):
+            r = CloudResource("aws_redshift_cluster",
+                              _txt(c, "ClusterIdentifier"))
+            r.attrs["encrypted"] = Attr(_txt(c, "Encrypted") == "true")
+            r.attrs["subnet_group"] = Attr(
+                _txt(c, "ClusterSubnetGroupName"))
+            out.append(r)
+    return out
+
+
+def walk_apigateway(client: AWSClient) -> list[CloudResource]:
+    """REST JSON: GET /restapis + per-API stages."""
+    out = []
+    query: dict = {}
+    for _ in range(_MAX_PAGES):
+        body = json.loads(client.request("apigateway",
+                                         path="/restapis",
+                                         query=query))
+        for api in body.get("item", []):
+            api_id = api.get("id", "")
+            try:
+                stages = json.loads(client.request(
+                    "apigateway",
+                    path=f"/restapis/{api_id}/stages"))
+            except AWSError:
+                continue
+            for st in stages.get("item", []):
+                name = f"{api.get('name', api_id)}/" \
+                       f"{st.get('stageName', '')}"
+                r = CloudResource("aws_api_gateway_stage", name)
+                r.attrs["access_log_arn"] = Attr(
+                    (st.get("accessLogSettings") or {})
+                    .get("destinationArn", ""))
+                r.attrs["xray_tracing_enabled"] = Attr(
+                    bool(st.get("tracingEnabled")))
+                out.append(r)
+        pos = body.get("position")
+        if not pos:
+            break
+        query = {"position": pos}
+    else:
+        logger.warning("aws api-gateway: pagination stopped after %d "
+                       "pages; listing may be incomplete", _MAX_PAGES)
+    return out
+
+
+def walk_vpcs(client: AWSClient) -> list[CloudResource]:
+    """DescribeVpcs + DescribeFlowLogs → per-VPC flow-log state."""
+    logged = set()
+    for doc in _paged_query(client, "ec2", "DescribeFlowLogs",
+                            "2016-11-15"):
+        for fl in doc.findall(".//flowLogSet/item"):
+            logged.add(_txt(fl, "resourceId"))
+    out = []
+    for doc in _paged_query(client, "ec2", "DescribeVpcs",
+                            "2016-11-15"):
+        for v in doc.findall(".//vpcSet/item"):
+            vid = _txt(v, "vpcId")
+            r = CloudResource("aws_vpc", vid)
+            r.attrs["is_default"] = Attr(
+                _txt(v, "isDefault") == "true")
+            r.attrs["flow_logs_enabled"] = Attr(vid in logged)
+            out.append(r)
+    return out
+
+
 def _walk_ec2_all(client: AWSClient) -> list[CloudResource]:
-    """ec2 service = security groups + instances."""
-    return walk_ec2(client) + walk_ec2_instances(client)
+    """ec2 service = security groups + instances + VPC flow-log
+    state."""
+    return walk_ec2(client) + walk_ec2_instances(client) + \
+        walk_vpcs(client)
 
 
 def get_account_id(client: AWSClient) -> str:
@@ -433,7 +975,12 @@ def get_account_id(client: AWSClient) -> str:
 
 WALKERS = {"s3": walk_s3, "ec2": _walk_ec2_all, "ebs": walk_ebs,
            "rds": walk_rds, "cloudtrail": walk_cloudtrail,
-           "efs": walk_efs, "elb": walk_elb, "iam": walk_iam}
+           "efs": walk_efs, "elb": walk_elb, "iam": walk_iam,
+           "cloudfront": walk_cloudfront, "dynamodb": walk_dynamodb,
+           "ecr": walk_ecr, "ecs": walk_ecs, "eks": walk_eks,
+           "kms": walk_kms, "lambda": walk_lambda, "sns": walk_sns,
+           "sqs": walk_sqs, "elasticache": walk_elasticache,
+           "redshift": walk_redshift, "api-gateway": walk_apigateway}
 
 
 

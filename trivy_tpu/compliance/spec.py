@@ -295,9 +295,106 @@ _AWS_CIS_14 = Spec(
                 severity="HIGH", checks=["AVD-AWS-0107"]),
     ])
 
+_AWS_CIS_12 = Spec(
+    id="aws-cis-1.2", title="AWS CIS Foundations v1.2",
+    description="AWS CIS Foundations",
+    version="1.2",
+    related_resources=["https://www.cisecurity.org/benchmark/"
+                       "amazon_web_services"],
+    controls=[
+        # 1. Identity and Access Management
+        Control("1.1", "Avoid the use of the root account",
+                severity="LOW", default_status="MANUAL"),
+        Control("1.2", "Ensure MFA is enabled for all IAM users with "
+                "a console password",
+                severity="HIGH", checks=["AVD-AWS-0145"]),
+        Control("1.3", "Ensure credentials unused for 90 days or "
+                "greater are disabled",
+                severity="MEDIUM", checks=["AVD-AWS-0144"]),
+        Control("1.4", "Ensure access keys are rotated every 90 days "
+                "or less",
+                severity="MEDIUM", checks=["AVD-AWS-0146"]),
+        Control("1.5", "Ensure IAM password policy requires at least "
+                "one uppercase letter",
+                severity="MEDIUM", checks=["AVD-AWS-0061"]),
+        Control("1.6", "Ensure IAM password policy requires at least "
+                "one lowercase letter",
+                severity="MEDIUM", checks=["AVD-AWS-0058"]),
+        Control("1.7", "Ensure IAM password policy requires at least "
+                "one symbol",
+                severity="MEDIUM", checks=["AVD-AWS-0060"]),
+        Control("1.8", "Ensure IAM password policy requires at least "
+                "one number",
+                severity="MEDIUM", checks=["AVD-AWS-0059"]),
+        Control("1.9", "Ensure IAM password policy requires a minimum "
+                "length of 14 or greater",
+                severity="MEDIUM", checks=["AVD-AWS-0063"]),
+        Control("1.10", "Ensure IAM password policy prevents password "
+                "reuse",
+                severity="MEDIUM", checks=["AVD-AWS-0056"]),
+        Control("1.11", "Ensure IAM password policy expires passwords "
+                "within 90 days or less",
+                severity="MEDIUM", checks=["AVD-AWS-0062"]),
+        Control("1.12", "Ensure no root account access key exists",
+                severity="CRITICAL", checks=["AVD-AWS-0141"]),
+        Control("1.13", "Ensure MFA is enabled for the root account",
+                severity="CRITICAL", checks=["AVD-AWS-0142"]),
+        Control("1.14", "Ensure hardware MFA is enabled for the root "
+                "account",
+                severity="CRITICAL", default_status="MANUAL"),
+        Control("1.16", "Ensure IAM policies are attached only to "
+                "groups or roles",
+                severity="LOW", checks=["AVD-AWS-0143"]),
+        # 2. Logging
+        Control("2.1", "Ensure CloudTrail is enabled in all regions",
+                severity="MEDIUM", checks=["AVD-AWS-0014"]),
+        Control("2.2", "Ensure CloudTrail log file validation is "
+                "enabled",
+                severity="MEDIUM", checks=["AVD-AWS-0016"]),
+        Control("2.3", "Ensure the S3 bucket used to store CloudTrail "
+                "logs is not publicly accessible",
+                severity="CRITICAL",
+                checks=["AVD-AWS-0086", "AVD-AWS-0087"]),
+        Control("2.4", "Ensure CloudTrail trails are integrated with "
+                "CloudWatch Logs",
+                severity="LOW", checks=["AVD-AWS-0162"]),
+        Control("2.6", "Ensure S3 bucket access logging is enabled on "
+                "the CloudTrail S3 bucket",
+                severity="LOW", checks=["AVD-AWS-0089"]),
+        Control("2.7", "Ensure CloudTrail logs are encrypted at rest "
+                "using KMS CMKs",
+                severity="HIGH", checks=["AVD-AWS-0015"]),
+        Control("2.8", "Ensure rotation for customer created CMKs is "
+                "enabled",
+                severity="MEDIUM", checks=["AVD-AWS-0065"]),
+        Control("2.9", "Ensure VPC flow logging is enabled in all "
+                "VPCs",
+                severity="MEDIUM", checks=["AVD-AWS-0178"]),
+        # 3. Monitoring (metric filters require account inspection)
+        Control("3.1", "Ensure a log metric filter and alarm exist "
+                "for unauthorized API calls",
+                severity="LOW", default_status="MANUAL"),
+        Control("3.2", "Ensure a log metric filter and alarm exist "
+                "for console sign-in without MFA",
+                severity="LOW", default_status="MANUAL"),
+        Control("3.3", "Ensure a log metric filter and alarm exist "
+                "for usage of root account",
+                severity="LOW", default_status="MANUAL"),
+        # 4. Networking
+        Control("4.1", "Ensure no security groups allow ingress from "
+                "0.0.0.0/0 to port 22",
+                severity="HIGH", checks=["AVD-AWS-0107"]),
+        Control("4.2", "Ensure no security groups allow ingress from "
+                "0.0.0.0/0 to port 3389",
+                severity="HIGH", checks=["AVD-AWS-0107"]),
+        Control("4.3", "Ensure the default security group of every "
+                "VPC restricts all traffic",
+                severity="LOW", checks=["AVD-AWS-0173"]),
+    ])
+
 SPECS = {s.id: s for s in (_K8S_CIS, _K8S_NSA, _K8S_PSS_BASELINE,
                            _K8S_PSS_RESTRICTED, _DOCKER_CIS,
-                           _AWS_CIS_14)}
+                           _AWS_CIS_12, _AWS_CIS_14)}
 
 
 def get_spec(name: str) -> Spec:

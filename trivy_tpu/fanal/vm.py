@@ -320,6 +320,15 @@ class Ext4:
 
 # ---- walker integration ------------------------------------------------
 
+def _is_lvm(dev, off: int) -> bool:
+    """LVM physical volume signature: 'LABELONE' in the second 512-byte
+    sector (reference walker/vm.go detectLVM:195-211)."""
+    try:
+        return dev.read(off + 512, 8) == b"LABELONE"
+    except Exception:
+        return False
+
+
 def walk_vm(dev, group, collect_secrets: bool = False,
             secret_config_path: str = "trivy-secret.yaml"):
     """Walk every ext4 filesystem on the device through the analyzer
@@ -331,6 +340,13 @@ def walk_vm(dev, group, collect_secrets: bool = False,
     parts = partitions(dev) or [(0, getattr(dev, "size", 0))]
     found_fs = False
     for off, _length in parts:
+        if _is_lvm(dev, off):
+            # parity with reference walker/vm.go:85-93: LVM physical
+            # volumes are detected and skipped with a loud log rather
+            # than misread as a filesystem
+            logger.error("LVM is not supported, skipping partition "
+                         "at %d", off)
+            continue
         try:
             fs = Ext4(dev, off)
         except VMError:

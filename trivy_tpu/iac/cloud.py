@@ -720,6 +720,233 @@ def _lambda_tracing(resources):
                    f"enabled.", r.rng)
 
 
+@_aws("AVD-AWS-0034", "ECS clusters should have container insights "
+      "enabled", "LOW", "ecs",
+      "Container insights surface resource and failure telemetry.",
+      "Enable the containerInsights cluster setting.")
+def _ecs_insights(resources):
+    for r in _of(resources, "aws_ecs_cluster"):
+        if r.unknown("container_insights"):
+            continue
+        if not _truthy(r.val("container_insights")):
+            yield (f"ECS cluster '{r.name}' does not have container "
+                   f"insights enabled.", r.rng)
+
+
+@_aws("AVD-AWS-0001", "API Gateway stages should have access logging "
+      "enabled", "MEDIUM", "api-gateway",
+      "Stage access logs are the audit trail for API traffic.",
+      "Configure access_log_settings on every stage.")
+def _apigw_logging(resources):
+    for r in _of(resources, "aws_api_gateway_stage"):
+        if r.unknown("access_log_arn"):
+            continue
+        if not r.get("access_log_arn"):
+            yield (f"API Gateway stage '{r.name}' does not have "
+                   f"access logging enabled.", r.rng)
+
+
+@_aws("AVD-AWS-0162", "CloudTrail trails should be integrated with "
+      "CloudWatch Logs", "LOW", "cloudtrail",
+      "CloudWatch integration enables near-real-time alerting on "
+      "trail events.",
+      "Set cloud_watch_logs_group_arn on the trail.")
+def _trail_cloudwatch(resources):
+    for r in _of(resources, "aws_cloudtrail"):
+        if r.unknown("cloud_watch_logs_group_arn"):
+            continue
+        if not r.get("cloud_watch_logs_group_arn"):
+            yield (f"Trail '{r.name}' is not integrated with "
+                   f"CloudWatch Logs.", r.rng)
+
+
+@_aws("AVD-AWS-0178", "VPCs should have flow logging enabled", "MEDIUM",
+      "ec2",
+      "Flow logs capture IP traffic metadata for forensics.",
+      "Create a flow log for every VPC.")
+def _vpc_flow_logs(resources):
+    for r in _of(resources, "aws_vpc"):
+        if _falsy(r.val("flow_logs_enabled")):
+            yield (f"VPC '{r.name}' does not have flow logs enabled.",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0173", "Default VPC security groups should restrict "
+      "all traffic", "LOW", "ec2",
+      "Rules on the default security group invite accidental "
+      "exposure.",
+      "Remove all rules from default security groups.")
+def _default_sg(resources):
+    for r in _of(resources, "aws_security_group"):
+        if not _truthy(r.val("is_default")):
+            continue  # set by the live walker / default-SG adapters
+        if r.unknown("ingress") or r.unknown("egress"):
+            continue
+        if r.get("ingress") or r.get("egress"):
+            yield ("Default security group has rules attached.",
+                   r.rng)
+
+
+# --- IAM account hygiene (CIS 1.x; reference trivy-aws iam checks) ---
+
+def _pwpolicy(resources):
+    for r in _of(resources, "aws_iam_password_policy"):
+        yield r
+
+
+@_aws("AVD-AWS-0056", "IAM password policy should prevent password "
+      "reuse", "MEDIUM", "iam",
+      "Reused passwords extend the life of a compromised credential.",
+      "Set password_reuse_prevention to 5 or more.")
+def _iam_pw_reuse(resources):
+    for r in _pwpolicy(resources):
+        if r.unknown("reuse_prevention"):
+            continue
+        if int(r.get("reuse_prevention") or 0) < 5:
+            yield ("Password policy allows reusing recent passwords.",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0058", "IAM password policy should require lowercase "
+      "characters", "MEDIUM", "iam", "", "Require lowercase letters.")
+def _iam_pw_lower(resources):
+    for r in _pwpolicy(resources):
+        if _falsy(r.val("require_lowercase")):
+            yield ("Password policy does not require lowercase "
+                   "characters.", r.rng)
+
+
+@_aws("AVD-AWS-0059", "IAM password policy should require numbers",
+      "MEDIUM", "iam", "", "Require numeric characters.")
+def _iam_pw_numbers(resources):
+    for r in _pwpolicy(resources):
+        if _falsy(r.val("require_numbers")):
+            yield ("Password policy does not require numbers.", r.rng)
+
+
+@_aws("AVD-AWS-0060", "IAM password policy should require symbols",
+      "MEDIUM", "iam", "", "Require symbol characters.")
+def _iam_pw_symbols(resources):
+    for r in _pwpolicy(resources):
+        if _falsy(r.val("require_symbols")):
+            yield ("Password policy does not require symbols.", r.rng)
+
+
+@_aws("AVD-AWS-0061", "IAM password policy should require uppercase "
+      "characters", "MEDIUM", "iam", "", "Require uppercase letters.")
+def _iam_pw_upper(resources):
+    for r in _pwpolicy(resources):
+        if _falsy(r.val("require_uppercase")):
+            yield ("Password policy does not require uppercase "
+                   "characters.", r.rng)
+
+
+@_aws("AVD-AWS-0062", "IAM password policy should expire passwords "
+      "within 90 days", "MEDIUM", "iam", "",
+      "Set max_password_age to 90 or less.")
+def _iam_pw_age(resources):
+    for r in _pwpolicy(resources):
+        if r.unknown("max_age_days"):
+            continue
+        age = r.get("max_age_days")
+        if not age or int(age) > 90:
+            yield ("Password policy does not expire passwords within "
+                   "90 days.", r.rng)
+
+
+@_aws("AVD-AWS-0063", "IAM password policy should require a minimum "
+      "length of 14", "MEDIUM", "iam", "",
+      "Set minimum_password_length to 14 or more.")
+def _iam_pw_length(resources):
+    for r in _pwpolicy(resources):
+        if r.unknown("minimum_length"):
+            continue
+        if int(r.get("minimum_length") or 0) < 14:
+            yield ("Password policy minimum length is below 14.",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0141", "The root account should have no access keys",
+      "CRITICAL", "iam",
+      "Root access keys grant unrestricted, unauditable API access.",
+      "Delete all root access keys.")
+def _iam_root_keys(resources):
+    for r in _of(resources, "aws_iam_root"):
+        if _truthy(r.val("access_keys_present")):
+            yield ("The root account has active access keys.", r.rng)
+
+
+@_aws("AVD-AWS-0142", "The root account should have MFA enabled",
+      "CRITICAL", "iam",
+      "A compromised root password alone must not grant access.",
+      "Enable (hardware) MFA on the root account.")
+def _iam_root_mfa(resources):
+    for r in _of(resources, "aws_iam_root"):
+        if r.unknown("mfa_enabled"):
+            continue
+        if _falsy(r.val("mfa_enabled")):
+            yield ("The root account does not have MFA enabled.",
+                   r.rng)
+
+
+@_aws("AVD-AWS-0143", "IAM policies should be attached to groups or "
+      "roles, not users", "LOW", "iam",
+      "Per-user policies sprawl and escape review.",
+      "Attach policies to groups/roles and add users to groups.")
+def _iam_user_policies(resources):
+    for r in _of(resources, "aws_iam_user"):
+        if r.unknown("attached_policies"):
+            continue
+        if r.get("attached_policies"):
+            yield (f"IAM user '{r.name}' has directly attached "
+                   f"policies.", r.rng)
+
+
+@_aws("AVD-AWS-0144", "Credentials unused for 90 days should be "
+      "disabled", "MEDIUM", "iam",
+      "Stale credentials widen the attack surface silently.",
+      "Disable or remove unused passwords and access keys.")
+def _iam_unused_creds(resources):
+    for r in _of(resources, "aws_iam_user"):
+        pw_days = r.get("password_last_used_days")
+        if _truthy(r.val("has_console_password")) and \
+                pw_days is not None and int(pw_days) > 90:
+            yield (f"IAM user '{r.name}' has a console password "
+                   f"unused for more than 90 days.", r.rng)
+        for age in (r.get("key_unused_days") or []):
+            if isinstance(age, int) and age > 90:
+                yield (f"IAM user '{r.name}' has an access key unused "
+                       f"for more than 90 days.", r.rng)
+                break
+
+
+@_aws("AVD-AWS-0145", "IAM users with console passwords should have "
+      "MFA", "HIGH", "iam",
+      "Console access without MFA is one phish away from takeover.",
+      "Enable MFA for every console user.")
+def _iam_user_mfa(resources):
+    for r in _of(resources, "aws_iam_user"):
+        if r.unknown("mfa_active"):
+            continue
+        if _truthy(r.val("has_console_password")) and \
+                _falsy(r.val("mfa_active")):
+            yield (f"IAM user '{r.name}' has console access without "
+                   f"MFA.", r.rng)
+
+
+@_aws("AVD-AWS-0146", "Access keys should be rotated every 90 days",
+      "MEDIUM", "iam",
+      "Long-lived keys accumulate exposure.",
+      "Rotate access keys at least every 90 days.")
+def _iam_key_rotation(resources):
+    for r in _of(resources, "aws_iam_user"):
+        for age in (r.get("access_key_ages_days") or []):
+            if isinstance(age, int) and age > 90:
+                yield (f"IAM user '{r.name}' has an access key older "
+                       f"than 90 days.", r.rng)
+                break
+
+
 def run_aws_checks(resources, file_type, text):
     """→ (failures, successes) for adapted AWS resources."""
     from .core import run_checks

@@ -248,6 +248,8 @@ def adapt_cloudformation(template) -> list[CloudResource]:
             put(r, "enable_log_file_validation",
                 attr("EnableLogFileValidation"))
             put(r, "kms_key_id", attr("KMSKeyId"))
+            put(r, "cloud_watch_logs_group_arn",
+                attr("CloudWatchLogsLogGroupArn"))
             out.append(r)
 
         elif rtype == "AWS::ElasticLoadBalancingV2::LoadBalancer":

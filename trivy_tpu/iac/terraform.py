@@ -268,6 +268,7 @@ def adapt_terraform(module: TfModule) -> list[CloudResource]:
             _a(res, "is_multi_region_trail", cr)
             _a(res, "enable_log_file_validation", cr)
             _a(res, "kms_key_id", cr)
+            _a(res, "cloud_watch_logs_group_arn", cr)
             out.append(cr)
 
         elif t in ("aws_lb", "aws_alb"):
