@@ -88,15 +88,44 @@ def test_sharded_join_skewed_buckets(table):
     assert sharded == single
 
 
-def test_shard_table_bucket_boundaries(table):
+def test_shard_table_strided_layout(table):
+    """Round-robin sharding: shard s holds global rows r % S == s at
+    local index r // S — so any bucket interval spreads across every
+    shard (the mega-bucket balance property)."""
     st = shard_table(table, 4)
-    h64 = table.hash_u64
-    # no hash bucket may span two shards
-    for s in range(st.row_offset.shape[0] - 1):
-        end = st.row_offset[s] + st.row_len[s]
-        if st.row_len[s] == 0 or end >= h64.shape[0]:
-            continue
-        assert h64[end - 1] != h64[end]
+    for s in range(4):
+        want = table.flags[s::4]
+        assert np.array_equal(st.flags[s][:want.shape[0]], want)
+        assert st.row_len[s] == want.shape[0]
+
+
+def test_partition_balances_mega_bucket_across_shards():
+    """A bucket carrying ~95% of pair volume must spread across BOTH
+    mesh axes: per-device load stays within 1.25x the mean."""
+    from trivy_tpu.db.table import RawAdvisory, build_table
+    from trivy_tpu.parallel.mesh import partition_queries
+    raw = [RawAdvisory(source="s", ecosystem="alpine",
+                       pkg_name="mega", vuln_id=f"CVE-1-{j}",
+                       fixed_version="9.9")
+           for j in range(512)]
+    raw += [RawAdvisory(source="s", ecosystem="alpine",
+                        pkg_name=f"p{i}", vuln_id=f"CVE-2-{i}",
+                        fixed_version="9.9") for i in range(64)]
+    t = build_table(raw)
+    st = shard_table(t, 2)
+    from trivy_tpu.detect.engine import BatchDetector, PkgQuery
+    qs = [PkgQuery(source="s", ecosystem="alpine", name="mega",
+                   version="1.0")] * 20
+    qs += [PkgQuery(source="s", ecosystem="alpine", name=f"p{i % 64}",
+                    version="1.0") for i in range(100)]
+    prep = BatchDetector(t)._prepare(qs)
+    part = partition_queries(st, prep.q_start, prep.q_count,
+                             prep.q_ver, dp=4)
+    loads = part.total.reshape(-1).astype(float)
+    assert loads.sum() == prep.n_pairs
+    assert loads.max() / loads.mean() <= 1.25
+    got = np.sort(part.perm[part.valid])
+    assert np.array_equal(got, np.arange(prep.n_pairs))
 
 
 def test_mesh_shapes():

@@ -6,20 +6,22 @@ errgroup pipelines + client/server sharding) with a 2-D
 
   axis "dp"  — data parallel over the candidate-pair batch (each pair is
                one (package, advisory-row) predicate evaluation);
-  axis "db"  — the advisory table sharded by contiguous hash range (the
-               framework's tensor-parallel dimension; SURVEY.md §5 "TP
-               over the DB dimension" for tables larger than one chip's
-               HBM).
+  axis "db"  — the advisory table sharded round-robin by row residue
+               (the framework's tensor-parallel dimension; SURVEY.md §5
+               "TP over the DB dimension" for tables larger than one
+               chip's HBM).
 
-Table shards are split at bucket boundaries (no hash bucket straddles a
-shard), so every query's whole bucket lives in exactly one shard; the
-host routes per-QUERY CSR descriptors (bucket start, count, version
-row) to their shard, splitting oversized buckets so pair work
-LPT-balances across dp, and each device expands its own candidate-pair
-list on-chip — multi-chip transfer stays O(queries), matching the
-single-chip csr_pair_join. No collectives are needed inside the step:
-each device evaluates local pairs against its local table slice, and
-the output spec reassembles the bits.
+Table shard s holds global rows r with r % S == s at local index
+r // S, so any bucket interval maps to a contiguous LOCAL range on
+every shard — a mega bucket (the real trivy-db's `linux`) spreads its
+pair volume over the whole db axis by construction instead of stacking
+one shard. The host routes per-QUERY CSR descriptor pieces (≤S per
+query), splitting oversized pieces so pair work LPT-balances across
+dp, and each device expands its own candidate-pair list on-chip —
+multi-chip transfer stays O(queries·S), matching the single-chip
+csr_pair_join up to the small db factor. No collectives are needed
+inside the step: each device evaluates local pairs against its local
+table slice, and the strided perm reassembles the bits.
 
 Everything runs under one jit(shard_map(...)).
 """
@@ -63,43 +65,42 @@ class ShardedTable:
     lo_tok: np.ndarray
     hi_tok: np.ndarray
     flags: np.ndarray
-    row_offset: np.ndarray  # int64[S]: global row index of each shard start
+    row_offset: np.ndarray  # int64[S]: shard residue ids (0..S-1)
     row_len: np.ndarray     # int64[S]: real (unpadded) rows per shard
 
 
 def shard_table(table: AdvisoryTable, n_shards: int) -> ShardedTable:
-    a = len(table)
-    h = table.hash
-    # choose split points at bucket boundaries (hash change points)
-    bounds = [0]
-    target = max(1, a // n_shards)
-    i = target
-    for _ in range(n_shards - 1):
-        i = min(i, a)
-        while 0 < i < a and (h[i] == h[i - 1]).all():
-            i += 1  # advance to a bucket boundary
-        bounds.append(min(i, a))
-        i += target
-    bounds.append(a)
-    starts = bounds[:-1]
-    ends = bounds[1:]
-    pad = max((e - s) for s, e in zip(starts, ends)) if a else 1
+    """Round-robin (strided) row sharding: shard s holds global rows
+    r with r % S == s at local index r // S.
 
-    def _piece(arr, s, e, fill):
+    Any contiguous global interval — a query's bucket — then maps to
+    a CONTIGUOUS local range on every shard, so per-query work spreads
+    ~evenly across the db axis no matter how skewed the bucket sizes
+    are. Contiguous range-sharding measured a 30:1 per-device pair
+    imbalance at 100k queries against a `linux`-style mega bucket
+    (95% of pair volume landing in one shard); strided sharding makes
+    that workload balance by construction."""
+    a = len(table)
+    lens = [max(0, (a - s + n_shards - 1) // n_shards)
+            for s in range(n_shards)]
+    pad = max(lens) if a else 1
+
+    def _piece(arr, s, fill):
         out = np.full((pad,) + arr.shape[1:], fill, dtype=arr.dtype)
-        out[:e - s] = arr[s:e]
+        part = arr[s::n_shards]
+        out[:part.shape[0]] = part
         return out
 
     return ShardedTable(
-        lo_tok=np.stack([_piece(table.lo_tok, s, e, 1) for s, e in
-                         zip(starts, ends)]),
-        hi_tok=np.stack([_piece(table.hi_tok, s, e, 1) for s, e in
-                         zip(starts, ends)]),
-        flags=np.stack([_piece(table.flags, s, e, 0) for s, e in
-                        zip(starts, ends)]),
-        row_offset=np.asarray(starts, dtype=np.int64),
-        row_len=np.asarray([e - s for s, e in zip(starts, ends)],
-                           dtype=np.int64),
+        lo_tok=np.stack([_piece(table.lo_tok, s, 1)
+                         for s in range(n_shards)]),
+        hi_tok=np.stack([_piece(table.hi_tok, s, 1)
+                         for s in range(n_shards)]),
+        flags=np.stack([_piece(table.flags, s, 0)
+                        for s in range(n_shards)]),
+        # residue ids; kept for shape compatibility and diagnostics
+        row_offset=np.arange(n_shards, dtype=np.int64),
+        row_len=np.asarray(lens, dtype=np.int64),
     )
 
 
@@ -178,10 +179,11 @@ class MeshDetector:
 
 @dataclass
 class QueryPartition:
-    """Queries routed to (dp, db) devices as CSR descriptors. Every
-    query's whole bucket lives in ONE db shard (shards split at bucket
-    boundaries), so routing is per query and the devices expand their
-    own pair lists — multi-chip transfer stays O(queries), matching
+    """Queries routed to (dp, db) devices as CSR descriptors. Strided
+    table sharding gives every db shard a contiguous local slice of
+    each query's bucket, so routing emits ≤S descriptors per query and
+    the devices expand their own pair lists — multi-chip transfer
+    stays O(queries · S), matching
     the single-chip csr_pair_join design."""
     q_start: np.ndarray   # int32[DP, S, Q_loc] shard-LOCAL bucket start
     q_count: np.ndarray   # int32[DP, S, Q_loc]
@@ -210,24 +212,38 @@ def partition_queries(st: ShardedTable, q_start: np.ndarray,
     # global pair offsets follow _prepare's expansion order
     g_off = np.zeros(starts.size + 1, np.int64)
     np.cumsum(counts, out=g_off[1:])
-    shard = np.searchsorted(st.row_offset, starts, side="right") - 1
     s_count = st.row_offset.shape[0]
+    # strided sharding (shard_table): shard s holds global rows with
+    # r % S == s at local index r // S, so a query's interval [a, b)
+    # lands on shard s as the CONTIGUOUS local range starting at
+    # r0 // S with ceil((b - r0) / S) rows, r0 = first row ≥ a with
+    # the right residue. The piece's pairs map back to global offsets
+    # base + (r0 - a) + j*S — perm carries that stride
+    pieces: list[list] = []
+    ends = starts + counts
+    bases = g_off[:-1]
+    for s in range(s_count):
+        r0 = starts + ((s - starts) % s_count)
+        m = r0 < ends
+        cnt = (ends[m] - r0[m] + s_count - 1) // s_count
+        pieces.append(list(zip(
+            (r0[m] // s_count).tolist(), cnt.tolist(),
+            vers[m].tolist(), (bases[m] + (r0[m] - starts[m]))
+            .tolist())))
     # work items: (shard-local start, count, ver, global pair offset);
     # buckets larger than the per-device fair share split into chunks
     assign: dict[tuple, list] = {}
     for s in range(s_count):
-        idx_s = np.nonzero(shard == s)[0]
-        shard_pairs = int(counts[idx_s].sum())
+        shard_pairs = sum(p[1] for p in pieces[s])
         cap = max(-(-shard_pairs // dp), 1)
         items = []
-        for qi in idx_s:
-            local_start = int(starts[qi] - st.row_offset[s])
-            remaining = int(counts[qi])
+        for local_start, cnt, ver, goff in pieces[s]:
+            remaining = cnt
             off = 0
             while remaining > 0:
                 k = min(remaining, cap)
-                items.append((local_start + off, k, int(vers[qi]),
-                              int(g_off[qi]) + off))
+                items.append((local_start + off, k, ver,
+                              goff + off * s_count))
                 off += k
                 remaining -= k
         # LPT: biggest items first onto the least-loaded dp slot
@@ -258,7 +274,9 @@ def partition_queries(st: ShardedTable, q_start: np.ndarray,
             qs[d, s, i] = lstart
             qc[d, s, i] = k
             qv[d, s, i] = ver
-            perm[d, s, off:off + k] = np.arange(goff, goff + k)
+            # strided global pair offsets (see piece construction)
+            perm[d, s, off:off + k] = np.arange(
+                goff, goff + k * s_count, s_count)
             valid[d, s, off:off + k] = True
             off += k
         total[d, s] = off
