@@ -1079,3 +1079,190 @@ Resources:
 """
     failures3, _ = scan_cloudformation("stack.yaml", bare_cluster)
     assert "AVD-AWS-0040" in {f.id for f in failures3}
+
+
+# --- AWS: round-5 check additions -----------------------------------
+
+def test_cloudwatch_log_group_cmk():
+    ids = _ids({"main.tf": """
+resource "aws_cloudwatch_log_group" "lg" {
+  name = "app"
+}
+"""})
+    assert "AVD-AWS-0017" in ids
+    ids = _ids({"main.tf": """
+resource "aws_cloudwatch_log_group" "lg" {
+  name       = "app"
+  kms_key_id = "arn:aws:kms:us-east-1:1:key/k"
+}
+"""})
+    assert "AVD-AWS-0017" not in ids
+
+
+def test_ecs_task_definition_plaintext_secret():
+    ids = _ids({"main.tf": """
+resource "aws_ecs_task_definition" "t" {
+  family                = "app"
+  container_definitions = <<EOT
+[{"name": "web", "environment": [
+  {"name": "DB_PASSWORD", "value": "hunter2"}]}]
+EOT
+}
+"""})
+    assert "AVD-AWS-0036" in ids
+    ids = _ids({"main.tf": """
+resource "aws_ecs_task_definition" "t" {
+  family                = "app"
+  container_definitions = <<EOT
+[{"name": "web", "environment": [
+  {"name": "LOG_LEVEL", "value": "info"}]}]
+EOT
+}
+"""})
+    assert "AVD-AWS-0036" not in ids
+
+
+def test_ecs_cluster_container_insights():
+    ids = _ids({"main.tf": """
+resource "aws_ecs_cluster" "c" {
+  name = "main"
+}
+"""})
+    assert "AVD-AWS-0034" in ids
+    ids = _ids({"main.tf": """
+resource "aws_ecs_cluster" "c" {
+  name = "main"
+  setting {
+    name  = "containerInsights"
+    value = "enabled"
+  }
+}
+"""})
+    assert "AVD-AWS-0034" not in ids
+
+
+def test_lb_listener_plain_http():
+    ids = _ids({"main.tf": """
+resource "aws_lb_listener" "l" {
+  protocol = "HTTP"
+  default_action {
+    type = "forward"
+  }
+}
+"""})
+    assert "AVD-AWS-0054" in ids
+    # redirect to HTTPS is the sanctioned HTTP listener
+    ids = _ids({"main.tf": """
+resource "aws_lb_listener" "l" {
+  protocol = "HTTP"
+  default_action {
+    type = "redirect"
+    redirect {
+      protocol = "HTTPS"
+      status_code = "HTTP_301"
+    }
+  }
+}
+"""})
+    assert "AVD-AWS-0054" not in ids
+
+
+def test_s3_encryption_customer_key():
+    ids = _ids({"main.tf": """
+resource "aws_s3_bucket" "b" {
+  bucket = "data"
+  server_side_encryption_configuration {
+    rule {
+      apply_server_side_encryption_by_default {
+        sse_algorithm = "AES256"
+      }
+    }
+  }
+}
+"""})
+    assert "AVD-AWS-0132" in ids
+    ids = _ids({"main.tf": """
+resource "aws_s3_bucket" "b" {
+  bucket = "data"
+  server_side_encryption_configuration {
+    rule {
+      apply_server_side_encryption_by_default {
+        sse_algorithm     = "aws:kms"
+        kms_master_key_id = "arn:aws:kms:us-east-1:1:key/k"
+      }
+    }
+  }
+}
+"""})
+    assert "AVD-AWS-0132" not in ids
+
+
+def test_ecr_repository_cmk():
+    ids = _ids({"main.tf": """
+resource "aws_ecr_repository" "r" {
+  name = "app"
+  image_tag_mutability = "IMMUTABLE"
+  image_scanning_configuration {
+    scan_on_push = true
+  }
+}
+"""})
+    assert "AVD-AWS-0033" in ids
+    ids = _ids({"main.tf": """
+resource "aws_ecr_repository" "r" {
+  name = "app"
+  image_tag_mutability = "IMMUTABLE"
+  image_scanning_configuration {
+    scan_on_push = true
+  }
+  encryption_configuration {
+    encryption_type = "KMS"
+    kms_key         = "arn:aws:kms:us-east-1:1:key/k"
+  }
+}
+"""})
+    assert "AVD-AWS-0033" not in ids
+
+
+def test_lb_listener_unknown_action_never_fires():
+    # unresolvable redirect/action values must not fire (or crash)
+    ids = _ids({"main.tf": """
+variable "p" {}
+resource "aws_lb_listener" "l" {
+  protocol = "HTTP"
+  default_action {
+    type = "redirect"
+    redirect {
+      protocol = var.p
+    }
+  }
+}
+"""})
+    assert "AVD-AWS-0054" not in ids
+    ids = _ids({"main.tf": """
+variable "t" {}
+resource "aws_lb_listener" "l" {
+  protocol = "HTTP"
+  default_action {
+    type = var.t
+  }
+}
+"""})
+    assert "AVD-AWS-0054" not in ids
+
+
+def test_s3_cmk_standalone_sse_resource():
+    ids = _ids({"main.tf": """
+resource "aws_s3_bucket" "b" {
+  bucket = "data"
+}
+resource "aws_s3_bucket_server_side_encryption_configuration" "e" {
+  bucket = aws_s3_bucket.b.id
+  rule {
+    apply_server_side_encryption_by_default {
+      sse_algorithm = "AES256"
+    }
+  }
+}
+"""})
+    assert "AVD-AWS-0132" in ids

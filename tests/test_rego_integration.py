@@ -129,3 +129,47 @@ ignore {
 def teardown_module(module):
     from trivy_tpu.misconf import set_custom_checks
     set_custom_checks(None)
+
+
+def test_custom_check_toml_and_universal(tmp_path, capsys):
+    """The reference's toml + universal scanners
+    (pkg/iac/scanners/{toml,universal}): custom rego runs over parsed
+    TOML/JSON/YAML documents in one mixed tree, alongside the builtin
+    dialect scanners."""
+    checks = tmp_path / "checks"
+    checks.mkdir()
+    (checks / "t.rego").write_text("""\
+# METADATA
+# title: debug mode enabled
+# custom:
+#   id: USR-0300
+#   severity: HIGH
+package user.debugmode
+
+deny[msg] {
+    input.server.debug == true
+    msg := "server debug mode must be disabled"
+}
+""")
+    target = tmp_path / "t"
+    target.mkdir()
+    (target / "config.toml").write_text(
+        "[server]\ndebug = true\nport = 8080\n")
+    (target / "config.json").write_text(
+        '{"server": {"debug": true}}')
+    (target / "app.yaml").write_text("server:\n  debug: true\n")
+    # a dockerfile in the same tree still hits the builtin scanner
+    (target / "Dockerfile").write_text("FROM ubuntu:latest\n")
+    code, out = run_cli(
+        ["fs", "--scanners", "misconfig", "--format", "json",
+         "--db", FIXGLOB, "--config-check", str(checks),
+         str(target)], capsys)
+    rep = json.loads(out)
+    by_file = {}
+    for r in rep.get("Results", []):
+        for m in r.get("Misconfigurations", []):
+            by_file.setdefault(r["Target"], set()).add(m["ID"])
+    assert "USR-0300" in by_file.get("config.toml", set())
+    assert "USR-0300" in by_file.get("config.json", set())
+    assert "USR-0300" in by_file.get("app.yaml", set())
+    assert any("DS" in i for i in by_file.get("Dockerfile", set()))

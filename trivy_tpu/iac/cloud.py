@@ -12,6 +12,7 @@ avd.aquasec.com) so findings line up with the reference."""
 from __future__ import annotations
 
 import json
+import re
 from dataclasses import dataclass, field
 
 from .core import Check
@@ -718,6 +719,116 @@ def _lambda_tracing(resources):
         if r.get("tracing_mode", "PassThrough") != "Active":
             yield (f"Lambda function '{r.name}' does not have tracing "
                    f"enabled.", r.rng)
+
+
+@_aws("AVD-AWS-0017", "CloudWatch log groups should be encrypted with "
+      "a customer-managed key", "LOW", "cloudwatch",
+      "CloudWatch log data may contain sensitive information.",
+      "Set kms_key_id on the log group.")
+def _cloudwatch_cmk(resources):
+    for r in _of(resources, "aws_cloudwatch_log_group"):
+        if r.unknown("kms_key_id"):
+            continue
+        if not r.get("kms_key_id"):
+            yield (f"Log group '{r.name}' is not encrypted with a "
+                   f"customer-managed key.", r.rng)
+
+
+_SECRET_ENV_RE = re.compile(
+    r"(?i)(secret|password|passwd|token|api_?key|"
+    r"access_?key(_?id)?|private_?key|credential)")
+
+
+def _looks_secret_env(name: str) -> bool:
+    return bool(_SECRET_ENV_RE.search(name))
+
+
+@_aws("AVD-AWS-0036", "ECS task definitions should not hold plaintext "
+      "secrets", "CRITICAL", "ecs",
+      "Environment variables in task definitions are visible to "
+      "anyone with read access to the task definition.",
+      "Use SSM/Secrets Manager references instead of plaintext "
+      "values.")
+def _ecs_plain_secrets(resources):
+    for r in _of(resources, "aws_ecs_task_definition"):
+        if r.unknown("container_definitions"):
+            continue
+        raw = r.get("container_definitions")
+        try:
+            defs = json.loads(raw) if isinstance(raw, str) else raw
+        except (TypeError, ValueError):
+            continue
+        for cdef in defs or []:
+            if not isinstance(cdef, dict):
+                continue
+            for env in cdef.get("environment") or []:
+                if isinstance(env, dict) and \
+                        _looks_secret_env(str(env.get("name", ""))) \
+                        and env.get("value"):
+                    yield (f"Task definition '{r.name}' holds a "
+                           f"plaintext secret in environment variable "
+                           f"'{env.get('name')}'.",
+                           r.attr_rng("container_definitions"))
+
+
+@_aws("AVD-AWS-0054", "Load balancer listeners should not use plain "
+      "HTTP", "CRITICAL", "elb",
+      "Plain HTTP listeners expose traffic on the network.",
+      "Use HTTPS (or redirect HTTP to HTTPS) on ALB listeners.")
+def _elb_http_listener(resources):
+    for r in _of(resources, "aws_lb_listener"):
+        if r.unknown("protocol"):
+            continue
+        if r.get("protocol", "HTTP") != "HTTP":
+            continue
+        action = r.get("default_action") or {}
+        atype = action.get("type")
+        rproto = action.get("redirect_protocol")
+        if isinstance(atype, Unknown) or isinstance(rproto, Unknown):
+            continue  # unresolvable action: never fire
+        if atype == "redirect" and str(rproto or "").upper() == "HTTPS":
+            continue
+        yield (f"Listener '{r.name}' uses plain HTTP.", r.rng)
+
+
+@_aws("AVD-AWS-0132", "S3 encryption should use a customer-managed "
+      "key", "HIGH", "s3",
+      "CMKs give rotation and revocation control over bucket data.",
+      "Set a KMS key in the bucket's server-side encryption "
+      "configuration.")
+def _s3_cmk(resources):
+    for r in _of(resources, "aws_s3_bucket"):
+        if r.unknown("sse_kms_key_id") or r.unknown("sse_algorithm"):
+            continue
+        if not _truthy(r.val("encryption_enabled")):
+            continue  # AVD-AWS-0088 already covers no encryption
+        # fire only when the adapter SAW the encryption config: an
+        # explicit default-encryption rule without a KMS key, or a
+        # live-walked algorithm that isn't aws:kms — a bare
+        # "encryption on" marker stays silent
+        explicit_no_key = ("sse_kms_key_id" in r.attrs
+                           and not r.get("sse_kms_key_id"))
+        algo = r.get("sse_algorithm")
+        non_kms_algo = algo is not None and \
+            "kms" not in str(algo).lower()
+        if explicit_no_key or non_kms_algo:
+            yield (f"Bucket '{r.name}' does not use a "
+                   f"customer-managed key for encryption.", r.rng)
+
+
+@_aws("AVD-AWS-0033", "ECR repositories should be encrypted with a "
+      "customer-managed key", "LOW", "ecr",
+      "Image layers may embed proprietary code and secrets.",
+      "Set encryption_configuration with encryption_type = KMS.")
+def _ecr_cmk(resources):
+    for r in _of(resources, "aws_ecr_repository"):
+        if r.unknown("encryption_type"):
+            continue
+        if r.attrs.get("encryption_type") is None:
+            continue  # live walker doesn't fetch it; IaC adapters do
+        if r.get("encryption_type", "AES256") != "KMS":
+            yield (f"ECR repository '{r.name}' is not encrypted with "
+                   f"a customer-managed key.", r.rng)
 
 
 @_aws("AVD-AWS-0034", "ECS clusters should have container insights "

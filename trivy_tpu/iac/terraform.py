@@ -160,6 +160,26 @@ def _a(res: TfResource, key, out: CloudResource, name=None):
         out.attrs[name or key] = Attr(v, rng)
 
 
+def _sse_kms_key(module, sse_block):
+    """kms_master_key_id from an inline
+    server_side_encryption_configuration → rule →
+    apply_server_side_encryption_by_default chain."""
+    return _sse_kms_key_from_rules(
+        module, [b for b in sse_block.body.blocks
+                 if b.type == "rule"])
+
+
+def _sse_kms_key_from_rules(module, rule_blocks):
+    for b in rule_blocks:
+        for db in b.body.blocks:
+            if db.type == "apply_server_side_encryption_by_default":
+                attrs = module.eval_block_attrs(db)
+                if "kms_master_key_id" in attrs:
+                    return attrs["kms_master_key_id"][0]
+                return ""  # explicit default-encryption, no CMK
+    return None
+
+
 def _block_val(module, res, btype, key):
     """First nested block's attr value, e.g. versioning.enabled."""
     for b in res.blocks(btype):
@@ -206,6 +226,10 @@ def adapt_terraform(module: TfModule) -> list[CloudResource]:
                 b = res.blocks("server_side_encryption_configuration")[0]
                 cr.attrs["encryption_enabled"] = Attr(
                     True, (b.start, b.end))
+                kms = _sse_kms_key(module, b)
+                if kms is not None:
+                    cr.attrs["sse_kms_key_id"] = Attr(
+                        kms, (b.start, b.end))
             if res.blocks("logging"):
                 b = res.blocks("logging")[0]
                 cr.attrs["logging_enabled"] = Attr(True,
@@ -325,6 +349,55 @@ def adapt_terraform(module: TfModule) -> list[CloudResource]:
                                             False)
             cr.attrs["scan_on_push"] = Attr(scan, s_rng)
             _a(res, "image_tag_mutability", cr)
+            enc, e_rng = _block_val(module, res,
+                                    "encryption_configuration",
+                                    "encryption_type")
+            cr.attrs["encryption_type"] = Attr(
+                enc if enc is not None else "AES256",
+                e_rng or cr.rng)
+            out.append(cr)
+
+        elif t == "aws_cloudwatch_log_group":
+            _a(res, "kms_key_id", cr)
+            out.append(cr)
+
+        elif t == "aws_ecs_task_definition":
+            _a(res, "container_definitions", cr)
+            out.append(cr)
+
+        elif t == "aws_ecs_cluster":
+            ci, c_rng = None, cr.rng
+            for b in res.blocks("setting"):
+                attrs = module.eval_block_attrs(b)
+                if attrs.get("name", (None, None))[0] == \
+                        "containerInsights":
+                    v = attrs.get("value", (None, None))[0]
+                    ci = v if isinstance(v, Unknown) else \
+                        (v == "enabled")
+                    c_rng = (b.start, b.end)
+            if ci is not None:
+                cr.attrs["container_insights"] = Attr(ci, c_rng)
+            else:
+                cr.attrs["container_insights"] = Attr(False, cr.rng)
+            out.append(cr)
+
+        elif t == "aws_lb_listener":
+            _a(res, "protocol", cr)
+            action = {}
+            a_rng = cr.rng
+            for b in res.blocks("default_action"):
+                attrs = module.eval_block_attrs(b)
+                action["type"] = attrs.get("type", (None, None))[0]
+                a_rng = (b.start, b.end)
+                for rb in b.body.blocks:
+                    if rb.type == "redirect":
+                        rattrs = module.eval_block_attrs(rb)
+                        # keep Unknown as Unknown — the check must
+                        # not fire on unresolvable values
+                        action["redirect_protocol"] = rattrs.get(
+                            "protocol", ("", None))[0]
+            if action:
+                cr.attrs["default_action"] = Attr(action, a_rng)
             out.append(cr)
 
         elif t == "aws_kms_key":
@@ -416,6 +489,11 @@ def adapt_terraform(module: TfModule) -> list[CloudResource]:
             if parent is not None:
                 parent.attrs["encryption_enabled"] = Attr(
                     True, res.rng())
+                kms = _sse_kms_key_from_rules(module,
+                                              res.blocks("rule"))
+                if kms is not None:
+                    parent.attrs["sse_kms_key_id"] = Attr(
+                        kms, res.rng())
         elif t == "aws_s3_bucket_versioning":
             target = _ref_target(res.raw.get("bucket"), "aws_s3_bucket")
             parent = buckets.get(target)
