@@ -196,3 +196,58 @@ def test_bolt_reader_rejects_truncated_file(tmp_path):
     with pytest.raises((BoltError, ValueError, OSError)):
         with BoltDB(trunc) as db:
             list(db.buckets())
+
+
+REF_DB = os.environ.get(
+    "TRIVY_REFERENCE_DIR", "/root/reference") + \
+    "/integration/testdata/fixtures/db"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DB),
+                    reason="reference fixtures not present")
+def test_reference_corpus_flatten_npz_scan(tmp_path):
+    """Production flatten path over a MULTI-SOURCE merged bolt built
+    from the reference's full integration fixture corpus (14 OS +
+    language sources incl. Red Hat CPE maps): bolt → flatten_db →
+    .npz cache roundtrip → detection produces the same hits as the
+    YAML-loaded table."""
+    import glob as _glob
+
+    from trivy_tpu import types as T
+    from trivy_tpu.db.download import flatten_db
+    from trivy_tpu.detect import BatchDetector
+    from trivy_tpu.detect.ospkg import OspkgScanner
+
+    docs = []
+    for p in sorted(_glob.glob(os.path.join(REF_DB, "*.yaml"))):
+        docs.extend(_load_yaml_docs(p))
+    bolt = str(tmp_path / "trivy.db")
+    write_bolt(bolt, _docs_to_tree(docs))
+
+    table, stats = flatten_db(bolt)
+    assert stats["cached"] is False
+    assert stats["rows"] > 50
+    assert "Red Hat CPE" in (table.aux or {})
+
+    # second call must come from the npz cache, identically
+    table2, stats2 = flatten_db(bolt)
+    assert stats2["cached"] is True
+    assert _canonical(table) == _canonical(table2)
+    assert (table2.aux or {}).get("Red Hat CPE") == \
+        table.aux.get("Red Hat CPE")
+
+    # the flattened table detects like the YAML-loaded one: scan one
+    # known-vulnerable package set from the golden corpus
+    advs, details, sources = load_fixture_docs(docs)
+    table_yaml = build_table(advs, details,
+                             aux={"Red Hat CPE":
+                                  sources.get("Red Hat CPE")})
+
+    pkg = T.Package(name="libcrypto1.1", src_name="openssl",
+                    version="1.1.1c", release="r0")
+    os_info = T.OS(family="alpine", name="3.10.2")
+    for t in (table2, table_yaml):
+        scanner = OspkgScanner(BatchDetector(t))
+        vulns, _ = scanner.scan(os_info, None, [pkg])
+        assert {v.vulnerability_id for v in vulns} >= {
+            "CVE-2019-1549", "CVE-2019-1551"}

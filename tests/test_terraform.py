@@ -291,3 +291,94 @@ class TestPostAnalyzerWiring:
         assert any(m.file_type == "terraform" and
                    any(f.avd_id == "AVD-AWS-0092" for f in m.failures)
                    for m in mcs)
+
+
+class TestForExpressionsAndSplats:
+    """Round 5: for-expressions and splats evaluate over known values
+    instead of silently passing as Unknown (the reference evaluates
+    these via hashicorp/hcl)."""
+
+    def _eval(self, src, attr="out"):
+        from trivy_tpu.iac.hcl import Scope, evaluate, parse
+        body = parse(src)
+        scope = Scope()
+        # resolve locals in declaration order
+        for blk in body.blocks:
+            if blk.type == "locals":
+                for a in blk.body.attrs:
+                    scope.locals[a.name] = evaluate(a.expr, scope)
+        for a in body.attrs:
+            if a.name == attr:
+                return evaluate(a.expr, scope)
+        raise AssertionError("attr not found")
+
+    def test_list_for(self):
+        assert self._eval(
+            'out = [for x in [1, 2, 3] : x * 2]') == [2, 4, 6]
+
+    def test_list_for_with_filter(self):
+        assert self._eval(
+            'out = [for x in [1, 2, 3, 4] : x if x % 2 == 0]') == [2, 4]
+
+    def test_map_for(self):
+        got = self._eval(
+            'out = {for k, v in {a = 1, b = 2} : upper(k) => v + 1}')
+        assert got == {"A": 2, "B": 3}
+
+    def test_for_over_unknown_is_unknown(self):
+        from trivy_tpu.iac.hcl import Unknown
+        got = self._eval('out = [for x in var.xs : x]')
+        assert isinstance(got, Unknown)
+
+    def test_splat_attr(self):
+        got = self._eval("""
+locals {
+  users = [{name = "a"}, {name = "b"}]
+}
+out = local.users[*].name
+""")
+        assert got == ["a", "b"]
+
+    def test_splat_on_scalar_wraps(self):
+        got = self._eval("""
+locals {
+  one = {name = "solo"}
+}
+out = local.one[*].name
+""")
+        assert got == ["solo"]
+
+    def test_for_in_check_path(self):
+        # a real check consumes a for-built value: ingress CIDRs
+        from trivy_tpu.iac.terraform import scan_terraform_module
+        per_file = scan_terraform_module({"main.tf": """
+locals {
+  nets = ["0.0.0.0/0"]
+}
+resource "aws_security_group" "sg" {
+  description = "sg"
+  ingress {
+    description = "wide open"
+    from_port   = 22
+    to_port     = 22
+    cidr_blocks = [for n in local.nets : n]
+  }
+}
+"""})
+        ids = {m.id for fails, _ in per_file.values() for m in fails}
+        assert "AVD-AWS-0107" in ids
+
+    def test_for_grouping_mode_is_unknown(self):
+        from trivy_tpu.iac.hcl import Unknown
+        got = self._eval(
+            'out = {for s in ["a", "b", "a"] : s => s...}')
+        assert isinstance(got, Unknown)
+
+    def test_splat_on_null_is_empty(self):
+        got = self._eval("""
+locals {
+  maybe = null
+}
+out = local.maybe[*]
+""")
+        assert got == []

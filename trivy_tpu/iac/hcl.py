@@ -3,11 +3,12 @@
 The reference embeds hashicorp/hcl and a 6.2k-LoC terraform scanner
 (pkg/iac/scanners/terraform, pkg/iac/terraform value model); this is a
 native subset sized for misconfiguration scanning: blocks, attributes,
-the full operator grammar, string templates, heredocs, and the commonly
-used function library.  Anything outside the subset (for-expressions,
-splats, unresolved references) evaluates to Unknown, which checks treat
-as passing — the same stance the reference takes for values it cannot
-know before `terraform apply`.
+the full operator grammar, string templates, heredocs, for-expressions,
+splats, and the commonly used function library.  Anything outside the
+subset (`...` grouping mode, template directives, unresolved
+references) evaluates to Unknown, which checks treat as passing — the
+same stance the reference takes for values it cannot know before
+`terraform apply`.
 """
 
 from __future__ import annotations
@@ -265,7 +266,18 @@ class MapE:
 
 
 class Unsupported:
-    """for-expressions etc. — evaluates to Unknown."""
+    """out-of-subset constructs — evaluate to Unknown."""
+
+
+@dataclass
+class ForE:
+    """[for v in coll : body if cond] / {for k, v in coll : key =>
+    value if cond} (no `...` grouping — that parses to Unsupported)."""
+    names: list      # [value_name] or [key_name, value_name]
+    coll: object
+    key: object      # None for list comprehension
+    body: object
+    cond: object     # optional filter
 
 
 # --- parser ---------------------------------------------------------
@@ -274,6 +286,7 @@ class Parser:
     def __init__(self, toks: list[Tok]):
         self.toks = toks
         self.i = 0
+        self._saw_ellipsis = False  # grouping-mode detection
 
     def peek(self, skip_nl=False) -> Tok:
         j = self.i
@@ -397,10 +410,13 @@ class Parser:
                 self.next()
                 if self.peek().kind == "punct" and \
                         self.peek().value == ".":
-                    # "..." varargs expansion in a call: f(xs...)
+                    # "..." — call varargs expansion OR for-expression
+                    # grouping mode; record it so _parse_for can fall
+                    # back to Unsupported (the dots are consumed here)
                     while self.peek().kind == "punct" and \
                             self.peek().value == ".":
                         self.next()
+                    self._saw_ellipsis = True
                     return x
                 nt = self.next()
                 if nt.kind == "ident":
@@ -475,8 +491,8 @@ class Parser:
         if t.kind == "punct" and t.value == "[":
             first = self.peek(skip_nl=True)
             if first.kind == "ident" and first.value == "for":
-                self._skip_until_close("[", "]")
-                return Unsupported()
+                self.next(skip_nl=True)
+                return self._parse_for("]")
             items = []
             while True:
                 nt = self.peek(skip_nl=True)
@@ -491,8 +507,8 @@ class Parser:
         if t.kind == "punct" and t.value == "{":
             first = self.peek(skip_nl=True)
             if first.kind == "ident" and first.value == "for":
-                self._skip_until_close("{", "}")
-                return Unsupported()
+                self.next(skip_nl=True)
+                return self._parse_for("}")
             items = []
             while True:
                 nt = self.peek(skip_nl=True)
@@ -519,6 +535,57 @@ class Parser:
                     self.next(skip_nl=True)
             return MapE(items)
         raise HclError(f"unexpected token {t.value!r} (line {t.line})")
+
+    def _parse_for(self, close_c):
+        """After the consumed `for` keyword. `...` grouping mode falls
+        back to Unsupported (skip to the closing bracket)."""
+        open_c = "[" if close_c == "]" else "{"
+        names = []
+        t = self.next(skip_nl=True)
+        if t.kind != "ident":
+            raise HclError(f"bad for-expression (line {t.line})")
+        names.append(t.value)
+        if self.peek(skip_nl=True).value == ",":
+            self.next(skip_nl=True)
+            t = self.next(skip_nl=True)
+            if t.kind != "ident":
+                raise HclError(f"bad for-expression (line {t.line})")
+            names.append(t.value)
+        t = self.next(skip_nl=True)
+        if not (t.kind == "ident" and t.value == "in"):
+            raise HclError(f"expected 'in' (line {t.line})")
+        coll = self.parse_expr()
+        t = self.next(skip_nl=True)
+        if not (t.kind == "punct" and t.value == ":"):
+            raise HclError(f"expected ':' (line {t.line})")
+        key = None
+        saw = self._saw_ellipsis
+        self._saw_ellipsis = False
+        body = self.parse_expr()
+        if close_c == "}":
+            t = self.next(skip_nl=True)
+            if not (t.kind == "punct" and t.value == "=>"):
+                raise HclError(f"expected '=>' (line {t.line})")
+            key = body
+            self._saw_ellipsis = False
+            body = self.parse_expr()
+        grouping = self._saw_ellipsis
+        self._saw_ellipsis = saw
+        cond = None
+        nt = self.peek(skip_nl=True)
+        if grouping or (nt.kind == "punct" and nt.value == "."):
+            # value grouping `...` — out of subset (parse_postfix
+            # consumed the dots while parsing the value expression)
+            if not (nt.kind == "punct" and nt.value == close_c):
+                self._skip_until_close(open_c, close_c)
+            else:
+                self.next(skip_nl=True)
+            return Unsupported()
+        if nt.kind == "ident" and nt.value == "if":
+            self.next(skip_nl=True)
+            cond = self.parse_expr()
+        self.expect("punct", close_c, skip_nl=True)
+        return ForE(names, coll, key, body, cond)
 
     def _skip_until_close(self, open_c, close_c):
         depth = 1
@@ -560,9 +627,17 @@ class Scope:
         self.variables = variables or {}
         self.locals = locals_ or {}
         self.resolver = resolver  # fn(chain) → value for resource refs
+        self.bindings: dict = {}  # for-expression loop variables
+
+    def child(self, bindings: dict) -> "Scope":
+        s = Scope(self.variables, self.locals, self.resolver)
+        s.bindings = {**self.bindings, **bindings}
+        return s
 
     def resolve(self, chain):
         head = chain[0]
+        if head in self.bindings:
+            return _walk_chain(self.bindings[head], chain[1:], self)
         if head == "var":
             if len(chain) >= 2 and isinstance(chain[1], str):
                 base = self.variables.get(chain[1], UNKNOWN)
@@ -579,7 +654,7 @@ class Scope:
 
 
 def _walk_chain(value, rest, scope):
-    for part in rest:
+    for i, part in enumerate(rest):
         if _is_unknown(value):
             return UNKNOWN
         if isinstance(part, str):
@@ -589,7 +664,15 @@ def _walk_chain(value, rest, scope):
                 return UNKNOWN
         elif isinstance(part, IndexOp):
             if part.expr is SPLAT:
-                return UNKNOWN
+                # full splat: map the REMAINING chain over each
+                # element (hcl: null splats to an empty tuple, any
+                # other non-list value wraps to [value])
+                rest2 = rest[i + 1:]
+                if value is None:
+                    return []
+                if not isinstance(value, (list, tuple)):
+                    value = [value]
+                return [_walk_chain(v, rest2, scope) for v in value]
             idx = evaluate(part.expr, scope)
             if _is_unknown(idx):
                 return UNKNOWN
@@ -606,6 +689,39 @@ def _walk_chain(value, rest, scope):
 def evaluate(node, scope: Scope):
     if isinstance(node, Lit):
         return node.value
+    if isinstance(node, ForE):
+        coll = evaluate(node.coll, scope)
+        if _is_unknown(coll):
+            return UNKNOWN
+        if isinstance(coll, dict):
+            pairs = list(coll.items())
+        elif isinstance(coll, (list, tuple)):
+            pairs = list(enumerate(coll))
+        else:
+            return UNKNOWN
+        out_list: list = []
+        out_map: dict = {}
+        for k, v in pairs:
+            if len(node.names) == 2:
+                child = scope.child({node.names[0]: k,
+                                     node.names[1]: v})
+            else:
+                child = scope.child({node.names[0]: v})
+            if node.cond is not None:
+                c = evaluate(node.cond, child)
+                if _is_unknown(c):
+                    return UNKNOWN  # filter unknowable → whole result
+                if not c:
+                    continue
+            val = evaluate(node.body, child)
+            if node.key is None:
+                out_list.append(val)
+            else:
+                kk = evaluate(node.key, child)
+                if _is_unknown(kk):
+                    return UNKNOWN
+                out_map[kk] = val
+        return out_map if node.key is not None else out_list
     if isinstance(node, Tmpl):
         out = []
         for p in node.parts:
