@@ -316,3 +316,62 @@ def test_golden_image_cve_parity(name, table, tmp_path):
     assert got == want, (
         f"{name}: missing={sorted(want - got)} "
         f"extra={sorted(got - want)}")
+
+
+def test_golden_sarif_parity(table, tmp_path):
+    """SARIF output for the alpine-310 golden matches the reference's
+    .sarif.golden structurally (rules incl. security-severity, help
+    templates, results, locations) — tool identity excepted."""
+    import datetime as dt
+    import io
+
+    from trivy_tpu.report import build_report
+    from trivy_tpu.report.writer import write_report
+
+    name = "alpine-310"
+    doc, vulns = _golden_vulns(name)
+    files = dict(SPECS[name]["files"])
+    files.update(_pkg_db(SPECS[name]["fmt"], vulns))
+    path = str(tmp_path / "img.tar")
+    make_image(path, [files])
+    cache = MemoryCache()
+    art = ImageArchiveArtifact(path, cache, scanners=("vuln",))
+    ref = art.inspect()
+    scanner = LocalScanner(cache, table)
+    now = dt.datetime.fromisoformat(
+        doc["CreatedAt"].replace("Z", "+00:00"))
+    # scan under the reference's artifact name so URIs line up
+    results, os_info = scanner.scan(
+        doc["ArtifactName"], ref.id, ref.blob_ids,
+        T.ScanOptions(scanners=("vuln",)), now=now)
+    rep = build_report(doc["ArtifactName"], "container_image",
+                       results, os_info,
+                       metadata=ref.image_metadata or T.Metadata(),
+                       created_at=doc["CreatedAt"])
+    buf = io.StringIO()
+    write_report(rep, "sarif", buf)
+    ours = json.loads(buf.getvalue())
+    golden = json.load(open(os.path.join(TD, f"{name}.sarif.golden")))
+
+    g_rules = {r["id"]: r for run in golden["runs"]
+               for r in run["tool"]["driver"]["rules"]}
+    o_rules = {r["id"]: r for run in ours["runs"]
+               for r in run["tool"]["driver"]["rules"]}
+    assert sorted(g_rules) == sorted(o_rules)
+    for rid, g in g_rules.items():
+        o = o_rules[rid]
+        for k in ("name", "shortDescription", "fullDescription",
+                  "defaultConfiguration", "helpUri", "help"):
+            assert o.get(k) == g.get(k), (rid, k)
+        assert o["properties"]["security-severity"] == \
+            g["properties"]["security-severity"], rid
+        assert o["properties"]["tags"] == g["properties"]["tags"], rid
+
+    def res_key(r):
+        return (r["ruleId"], r["level"], r["message"]["text"],
+                json.dumps(r["locations"], sort_keys=True))
+    g_res = sorted(res_key(r) for run in golden["runs"]
+                   for r in run["results"])
+    o_res = sorted(res_key(r) for run in ours["runs"]
+                   for r in run["results"])
+    assert g_res == o_res

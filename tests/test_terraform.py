@@ -382,3 +382,17 @@ locals {
 out = local.maybe[*]
 """)
         assert got == []
+
+    def test_for_map_stringifies_keys(self):
+        got = self._eval(
+            'out = {for i, v in ["a", "b"] : i => v}')
+        assert got == {"0": "a", "1": "b"}
+
+    def test_for_map_unhashable_key_is_unknown(self):
+        from trivy_tpu.iac.hcl import Unknown
+        got = self._eval('out = {for v in [["a"]] : v => 1}')
+        assert isinstance(got, Unknown)
+
+    def test_list_for_with_call_varargs(self):
+        got = self._eval('out = [for l in [[1, 2], [3]] : max(l...)]')
+        assert got == [2, 3]

@@ -569,7 +569,9 @@ class Parser:
             key = body
             self._saw_ellipsis = False
             body = self.parse_expr()
-        grouping = self._saw_ellipsis
+        # grouping mode only exists in map-fors; a list-for body may
+        # legitimately contain a call-varargs `...` (f(xs...))
+        grouping = self._saw_ellipsis and close_c == "}"
         self._saw_ellipsis = saw
         cond = None
         nt = self.peek(skip_nl=True)
@@ -720,7 +722,9 @@ def evaluate(node, scope: Scope):
                 kk = evaluate(node.key, child)
                 if _is_unknown(kk):
                     return UNKNOWN
-                out_map[kk] = val
+                if isinstance(kk, (list, tuple, dict)):
+                    return UNKNOWN  # HCL rejects non-scalar keys
+                out_map[_to_str(kk)] = val  # HCL map keys: strings
         return out_map if node.key is not None else out_list
     if isinstance(node, Tmpl):
         out = []
