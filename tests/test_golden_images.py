@@ -375,3 +375,62 @@ def test_golden_sarif_parity(table, tmp_path):
     o_res = sorted(res_key(r) for run in ours["runs"]
                    for r in run["results"])
     assert g_res == o_res
+
+
+@pytest.mark.parametrize("tpl,golden_suffix", [
+    ("junit.tpl", "junit.golden"),
+    ("gitlab.tpl", "gitlab.golden"),
+    ("gitlab-codequality.tpl", "gitlab-codequality.golden"),
+    ("asff.tpl", "asff.golden"),
+    ("html.tpl", "html.golden"),
+])
+def test_golden_contrib_templates(table, tmp_path, tpl, golden_suffix,
+                                  monkeypatch):
+    """The reference's PUBLIC contrib templates (read from the
+    reference tree, not copied) rendered through our go-template
+    interpreter over the alpine-310 golden scan must match the
+    reference's template goldens byte-for-byte."""
+    import datetime as dt
+    import io
+
+    from trivy_tpu.report import build_report
+    from trivy_tpu.report.writer import write_report
+
+    tpl_path = os.path.join(REF, "contrib", tpl)
+    if not os.path.exists(tpl_path):
+        pytest.skip("template not present")
+    name = "alpine-310"
+    # the reference's template goldens were rendered under a pinned
+    # clock (its tests inject clock.Now); pin ours the same way
+    monkeypatch.setenv("TRIVY_TPU_NOW", "2021-08-25T12:20:30Z")
+    monkeypatch.setenv("AWS_REGION", "test-region")
+    monkeypatch.setenv("AWS_ACCOUNT_ID", "123456789012")
+    doc, vulns = _golden_vulns(name)
+    files = dict(SPECS[name]["files"])
+    files.update(_pkg_db(SPECS[name]["fmt"], vulns))
+    path = str(tmp_path / "img.tar")
+    make_image(path, [files])
+    cache = MemoryCache()
+    art = ImageArchiveArtifact(path, cache, scanners=("vuln",))
+    ref = art.inspect()
+    scanner = LocalScanner(cache, table)
+    now = dt.datetime.fromisoformat(
+        doc["CreatedAt"].replace("Z", "+00:00"))
+    results, os_info = scanner.scan(
+        doc["ArtifactName"], ref.id, ref.blob_ids,
+        T.ScanOptions(scanners=("vuln",)), now=now)
+    rep = build_report(doc["ArtifactName"], "container_image",
+                       results, os_info,
+                       metadata=ref.image_metadata or T.Metadata(),
+                       created_at=doc["CreatedAt"])
+    buf = io.StringIO()
+    write_report(rep, "template", buf, template="@" + tpl_path)
+    got = buf.getvalue()
+    want = open(os.path.join(TD, f"{name}.{golden_suffix}")).read()
+    # the reference's pinned clock carries nanoseconds Python cannot
+    # represent; normalize sub-second digits in rendered timestamps
+    import re as _re
+    frac = _re.compile(r"(12:20:30)(\.\d+)?")
+    got = frac.sub(r"\1", got)
+    want = frac.sub(r"\1", want)
+    assert got == want

@@ -393,6 +393,15 @@ def _truthy(v):
 def _go_str(v):
     if v is None:
         return "<no value>"
+    if isinstance(v, _dt.datetime):
+        # Go time.Time default String(): fractional seconds only when
+        # nonzero, numeric offset + zone name
+        frac = f".{v.microsecond:06d}".rstrip("0") if v.microsecond \
+            else ""
+        off = v.strftime("%z") or "+0000"
+        tz = v.tzname() or "UTC"
+        return v.strftime("%Y-%m-%d %H:%M:%S") + frac + \
+            f" {off} {tz}"
     if v is True:
         return "true"
     if v is False:
@@ -579,6 +588,36 @@ def _num(v):
         return 0
 
 
+def _substr(start, end, s):
+    """sprig substring: negative start means 'from the beginning',
+    negative end means 'to the end' — NOT Python's negative
+    indexing."""
+    s = _go_str(s)
+    start, end = int(start), int(end)
+    if start < 0:
+        return s[:end] if end >= 0 else s
+    if end < 0:
+        return s[start:]
+    return s[start:end]
+
+
+def _now():
+    """sprig `now`; TRIVY_TPU_NOW (ISO-8601) pins the clock for
+    reproducible reports, the way the reference's tests inject a fixed
+    clock.Now."""
+    pinned = os.environ.get("TRIVY_TPU_NOW", "")
+    if pinned:
+        try:
+            return _dt.datetime.fromisoformat(
+                pinned.replace("Z", "+00:00"))
+        except ValueError as e:
+            # a silently ignored pin would make a "reproducible"
+            # report drift on every run
+            raise TemplateError(
+                f"unparseable TRIVY_TPU_NOW {pinned!r}") from e
+    return _dt.datetime.now().astimezone()
+
+
 def _builtin_funcs():
     return {
         "eq": lambda a, *bs: any(a == b for b in bs),
@@ -634,7 +673,8 @@ def _builtin_funcs():
             _go_str(s).encode()).hexdigest(),
         "env": lambda name: os.environ.get(name, ""),
         "getEnv": lambda name: os.environ.get(name, ""),
-        "now": lambda: _dt.datetime.now().astimezone(),
+        "now": _now,
+        "substr": _substr,
         "date": _go_date,
         "toJson": lambda v: json.dumps(v, ensure_ascii=False),
         "dict": lambda *a: {a[i]: a[i + 1] for i in range(0, len(a), 2)},
