@@ -256,7 +256,7 @@ def _pkg_db(fmt: str, vulns) -> dict[str, bytes]:
     raise AssertionError(fmt)
 
 
-def _scan(tmp_path, files, table, now=None):
+def _scan(tmp_path, files, table, now=None, artifact_name=""):
     path = str(tmp_path / "img.tar")
     make_image(path, [files])
     cache = MemoryCache()
@@ -264,7 +264,7 @@ def _scan(tmp_path, files, table, now=None):
     ref = art.inspect()
     scanner = LocalScanner(cache, table)
     results, os_info = scanner.scan(
-        ref.name, ref.id, ref.blob_ids,
+        artifact_name or ref.name, ref.id, ref.blob_ids,
         T.ScanOptions(scanners=("vuln",)), now=now)
     return results, os_info
 
@@ -332,21 +332,14 @@ def test_golden_sarif_parity(table, tmp_path):
     doc, vulns = _golden_vulns(name)
     files = dict(SPECS[name]["files"])
     files.update(_pkg_db(SPECS[name]["fmt"], vulns))
-    path = str(tmp_path / "img.tar")
-    make_image(path, [files])
-    cache = MemoryCache()
-    art = ImageArchiveArtifact(path, cache, scanners=("vuln",))
-    ref = art.inspect()
-    scanner = LocalScanner(cache, table)
     now = dt.datetime.fromisoformat(
         doc["CreatedAt"].replace("Z", "+00:00"))
     # scan under the reference's artifact name so URIs line up
-    results, os_info = scanner.scan(
-        doc["ArtifactName"], ref.id, ref.blob_ids,
-        T.ScanOptions(scanners=("vuln",)), now=now)
+    results, os_info = _scan(tmp_path, files, table, now=now,
+                             artifact_name=doc["ArtifactName"])
     rep = build_report(doc["ArtifactName"], "container_image",
                        results, os_info,
-                       metadata=ref.image_metadata or T.Metadata(),
+                       metadata=T.Metadata(),
                        created_at=doc["CreatedAt"])
     buf = io.StringIO()
     write_report(rep, "sarif", buf)
@@ -401,30 +394,24 @@ def test_golden_contrib_templates(table, tmp_path, tpl, golden_suffix,
         pytest.skip("template not present")
     name = "alpine-310"
     # the reference's template goldens were rendered under a pinned
-    # clock (its tests inject clock.Now); pin ours the same way
-    monkeypatch.setenv("TRIVY_TPU_NOW", "2021-08-25T12:20:30Z")
+    # clock (its tests inject clock.Now); write_template(now=...)
+    # pins ours the same way below
     monkeypatch.setenv("AWS_REGION", "test-region")
     monkeypatch.setenv("AWS_ACCOUNT_ID", "123456789012")
     doc, vulns = _golden_vulns(name)
     files = dict(SPECS[name]["files"])
     files.update(_pkg_db(SPECS[name]["fmt"], vulns))
-    path = str(tmp_path / "img.tar")
-    make_image(path, [files])
-    cache = MemoryCache()
-    art = ImageArchiveArtifact(path, cache, scanners=("vuln",))
-    ref = art.inspect()
-    scanner = LocalScanner(cache, table)
     now = dt.datetime.fromisoformat(
         doc["CreatedAt"].replace("Z", "+00:00"))
-    results, os_info = scanner.scan(
-        doc["ArtifactName"], ref.id, ref.blob_ids,
-        T.ScanOptions(scanners=("vuln",)), now=now)
+    results, os_info = _scan(tmp_path, files, table, now=now,
+                             artifact_name=doc["ArtifactName"])
     rep = build_report(doc["ArtifactName"], "container_image",
                        results, os_info,
-                       metadata=ref.image_metadata or T.Metadata(),
+                       metadata=T.Metadata(),
                        created_at=doc["CreatedAt"])
     buf = io.StringIO()
-    write_report(rep, "template", buf, template="@" + tpl_path)
+    from trivy_tpu.report.template import write_template
+    write_template(rep, "@" + tpl_path, buf, now=now)
     got = buf.getvalue()
     want = open(os.path.join(TD, f"{name}.{golden_suffix}")).read()
     # the reference's pinned clock carries nanoseconds Python cannot
@@ -434,3 +421,54 @@ def test_golden_contrib_templates(table, tmp_path, tpl, golden_suffix,
     got = frac.sub(r"\1", got)
     want = frac.sub(r"\1", want)
     assert got == want
+
+
+# filter-variant goldens: same base image, reference CLI flags applied
+# through result/filter.py (reference standalone_tar_test.go args)
+_FILTER_VARIANTS = {
+    "alpine-39-high-critical": {
+        "base": "alpine-39",
+        "severities": ["HIGH", "CRITICAL"], "ignore_unfixed": True},
+    "alpine-39-ignore-cveids": {
+        "base": "alpine-39",
+        "ignore_ids": ["CVE-2019-1549", "CVE-2019-14697"]},
+    "debian-buster-ignore-unfixed": {
+        "base": "debian-buster", "ignore_unfixed": True},
+    "ubuntu-1804-ignore-unfixed": {
+        "base": "ubuntu-1804", "ignore_unfixed": True},
+    "centos-7-ignore-unfixed": {
+        "base": "centos-7", "ignore_unfixed": True},
+    "centos-7-medium": {
+        "base": "centos-7", "severities": ["MEDIUM"],
+        "ignore_unfixed": True},
+}
+
+
+@pytest.mark.parametrize("name", sorted(_FILTER_VARIANTS))
+def test_golden_filter_variants(name, table, tmp_path):
+    import datetime as dt
+
+    from trivy_tpu.result.filter import FilterOptions, filter_results
+    from trivy_tpu.result.ignore import parse_ignore_file
+
+    spec = _FILTER_VARIANTS[name]
+    base = spec["base"]
+    base_doc, base_vulns = _golden_vulns(base)
+    files = dict(SPECS[base]["files"])
+    files.update(_pkg_db(SPECS[base]["fmt"], base_vulns))
+    now = dt.datetime.fromisoformat(
+        base_doc["CreatedAt"].replace("Z", "+00:00"))
+    results, _ = _scan(tmp_path, files, table, now=now)
+
+    ignore_file = None
+    if spec.get("ignore_ids"):
+        p = tmp_path / ".trivyignore"
+        p.write_text("\n".join(spec["ignore_ids"]) + "\n")
+        ignore_file = parse_ignore_file(str(p))
+    results = filter_results(results, FilterOptions(
+        severities=spec.get("severities", list(T.SEVERITIES)),
+        ignore_unfixed=spec.get("ignore_unfixed", False),
+        ignore_file=ignore_file))
+
+    doc, want_vulns = _golden_vulns(name)
+    assert _our_tuples(results) == _tuples(want_vulns), name

@@ -400,6 +400,8 @@ def _go_str(v):
             else ""
         off = v.strftime("%z") or "+0000"
         tz = v.tzname() or "UTC"
+        if tz.startswith(("UTC+", "UTC-")):
+            tz = off  # Go repeats the numeric offset for fixed zones
         return v.strftime("%Y-%m-%d %H:%M:%S") + frac + \
             f" {off} {tz}"
     if v is True:
@@ -601,23 +603,6 @@ def _substr(start, end, s):
     return s[start:end]
 
 
-def _now():
-    """sprig `now`; TRIVY_TPU_NOW (ISO-8601) pins the clock for
-    reproducible reports, the way the reference's tests inject a fixed
-    clock.Now."""
-    pinned = os.environ.get("TRIVY_TPU_NOW", "")
-    if pinned:
-        try:
-            return _dt.datetime.fromisoformat(
-                pinned.replace("Z", "+00:00"))
-        except ValueError as e:
-            # a silently ignored pin would make a "reproducible"
-            # report drift on every run
-            raise TemplateError(
-                f"unparseable TRIVY_TPU_NOW {pinned!r}") from e
-    return _dt.datetime.now().astimezone()
-
-
 def _builtin_funcs():
     return {
         "eq": lambda a, *bs: any(a == b for b in bs),
@@ -673,7 +658,8 @@ def _builtin_funcs():
             _go_str(s).encode()).hexdigest(),
         "env": lambda name: os.environ.get(name, ""),
         "getEnv": lambda name: os.environ.get(name, ""),
-        "now": _now,
+        # a pinned clock is injected via write_template(now=...)
+        "now": lambda: _dt.datetime.now().astimezone(),
         "substr": _substr,
         "date": _go_date,
         "toJson": lambda v: json.dumps(v, ensure_ascii=False),
