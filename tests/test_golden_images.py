@@ -117,6 +117,12 @@ SPECS = {
         "files": {"etc/mariner-release":
                   b"CBL-Mariner 1.0.20220122\n"},
     },
+    # distroless: dpkg status.d per-package files, no status DB
+    "distroless-base": {
+        "fmt": "dpkg-status.d",
+        "files": {"etc/debian_version": b"9.9\n",
+                  "etc/os-release": b'ID=debian\nVERSION_ID="9"\n'},
+    },
 }
 
 
@@ -216,16 +222,16 @@ def _pkg_db(fmt: str, vulns) -> dict[str, bytes]:
                       "o:decoy-clean\nL:MIT\n")
         return {"lib/apk/db/installed":
                 "\n".join(blocks).encode() + b"\n"}
+    def dpkg_stanza(name, ver, src, status=True):
+        src_line = f"Source: {src}\n" if src != name else ""
+        status_line = "Status: install ok installed\n" if status else ""
+        return (f"Package: {name}\n{status_line}{src_line}"
+                f"Version: {ver}\nArchitecture: amd64\n")
+
     if fmt == "dpkg":
-        blocks = []
-        for name, ver, src in pkgs.values():
-            src_line = f"Source: {src}\n" if src != name else ""
-            blocks.append(
-                f"Package: {name}\nStatus: install ok installed\n"
-                f"{src_line}Version: {ver}\nArchitecture: amd64\n")
-        blocks.append("Package: decoy-clean\n"
-                      "Status: install ok installed\n"
-                      "Version: 1.0-1\nArchitecture: amd64\n")
+        blocks = [dpkg_stanza(n, v, s) for n, v, s in pkgs.values()]
+        blocks.append(dpkg_stanza("decoy-clean", "1.0-1",
+                                  "decoy-clean"))
         return {"var/lib/dpkg/status":
                 "\n".join(blocks).encode() + b"\n"}
     if fmt == "rpm":
@@ -242,6 +248,14 @@ def _pkg_db(fmt: str, vulns) -> dict[str, bytes]:
                      "release": "1", "arch": "x86_64",
                      "sourcerpm": "decoy-clean-1.0-1.src.rpm"})
         return {"var/lib/rpm/rpmdb.sqlite": build_rpmdb(rows)}
+    if fmt == "dpkg-status.d":
+        out = {f"var/lib/dpkg/status.d/{n}":
+               dpkg_stanza(n, v, s, status=False).encode()
+               for n, v, s in pkgs.values()}
+        out["var/lib/dpkg/status.d/decoy-clean"] = dpkg_stanza(
+            "decoy-clean", "1.0-1", "decoy-clean",
+            status=False).encode()
+        return out
     if fmt == "rpmmanifest":
         lines = []
         for name, ver, src in pkgs.values():
