@@ -1,0 +1,74 @@
+"""apk installed-DB analyzer fidelity (reference
+pkg/fanal/analyzer/pkg/apk/apk.go): provides-map dependency
+resolution, duplicate-stanza dedup, trimRequirement semantics."""
+
+from trivy_tpu.fanal.analyzers import AnalysisResult, AnalyzerGroup
+
+
+def _parse(content: bytes):
+    group = AnalyzerGroup()
+    result = AnalysisResult()
+    group.analyze_file("lib/apk/db/installed", content, result)
+    return result.package_infos[0].packages
+
+
+def test_deps_resolve_to_package_ids():
+    pkgs = _parse(b"""\
+P:musl
+V:1.1.22-r3
+A:x86_64
+p:so:libc.musl-x86_64.so.1=1
+
+P:busybox
+V:1.30.1-r2
+A:x86_64
+D:so:libc.musl-x86_64.so.1 missing-pkg
+""")
+    by_name = {p.name: p for p in pkgs}
+    assert by_name["busybox"].depends_on == ["musl@1.1.22-r3"]
+
+
+def test_version_constraints_trimmed_not_tilde():
+    pkgs = _parse(b"""\
+P:musl
+V:1.1.22-r3
+A:x86_64
+
+P:app
+V:1.0-r0
+A:x86_64
+D:musl>=1.1 other~1.2
+""")
+    by_name = {p.name: p for p in pkgs}
+    # '>=' trims and resolves; '~' stays intact and never resolves
+    # (apk.go trimRequirement only cuts at <>=)
+    assert by_name["app"].depends_on == ["musl@1.1.22-r3"]
+
+
+def test_duplicate_stanzas_first_wins():
+    pkgs = _parse(b"""\
+P:musl
+V:1.1.22-r3
+A:x86_64
+
+P:musl
+V:9.9.9-r0
+A:x86_64
+""")
+    assert [(p.name, p.version) for p in pkgs] == [
+        ("musl", "1.1.22-r3")]
+
+
+def test_negative_deps_dropped():
+    pkgs = _parse(b"""\
+P:musl
+V:1.1.22-r3
+A:x86_64
+
+P:app
+V:1.0-r0
+A:x86_64
+D:!uclibc-utils musl
+""")
+    by_name = {p.name: p for p in pkgs}
+    assert by_name["app"].depends_on == ["musl@1.1.22-r3"]

@@ -486,3 +486,55 @@ def test_golden_filter_variants(name, table, tmp_path):
 
     doc, want_vulns = _golden_vulns(name)
     assert _our_tuples(results) == _tuples(want_vulns), name
+
+
+def test_golden_github_sbom(table, tmp_path, monkeypatch):
+    """GitHub dependency-snapshot output vs the reference's
+    .gsbom.golden: the full alpine-310 package set with purls and
+    name@version dependency edges is reconstructed from the golden's
+    own resolved map, scanned, and re-emitted byte-identically."""
+    import datetime as dt
+    import urllib.parse
+
+    from trivy_tpu.report import build_report
+    from trivy_tpu.report.github import to_github
+
+    golden = json.load(open(os.path.join(TD, "alpine-310.gsbom.golden")))
+    resolved = list(golden["manifests"].values())[0]["resolved"]
+    entries = []
+    for pname, info in resolved.items():
+        ver = urllib.parse.unquote(
+            info["package_url"].split("@", 1)[1].split("?")[0])
+        deps = [d.split("@")[0] for d in info.get("dependencies", [])]
+        e = f"P:{pname}\nV:{ver}\nA:x86_64\no:{pname}\n"
+        if deps:
+            e += "D:" + " ".join(deps) + "\n"
+        entries.append(e)
+    files = {"etc/alpine-release": b"3.10.2\n",
+             "lib/apk/db/installed":
+             ("\n".join(entries) + "\n").encode()}
+
+    monkeypatch.setenv("GITHUB_REF", golden["ref"])
+    monkeypatch.setenv("GITHUB_SHA", golden["sha"])
+    workflow, job = golden["job"]["correlator"].rsplit("_", 1)
+    monkeypatch.setenv("GITHUB_WORKFLOW", workflow)
+    monkeypatch.setenv("GITHUB_JOB", job)
+    monkeypatch.setenv("GITHUB_RUN_ID", golden["job"]["id"])
+
+    doc, _ = _golden_vulns("alpine-310")
+    path = str(tmp_path / "img.tar")
+    make_image(path, [files])
+    cache = MemoryCache()
+    art = ImageArchiveArtifact(path, cache, scanners=("vuln",))
+    ref = art.inspect()
+    scanner = LocalScanner(cache, table)
+    results, os_info = scanner.scan(
+        doc["ArtifactName"], ref.id, ref.blob_ids,
+        T.ScanOptions(scanners=("vuln",), list_all_packages=True),
+        now=dt.datetime.fromisoformat(
+            doc["CreatedAt"].replace("Z", "+00:00")))
+    rep = build_report(doc["ArtifactName"], "container_image",
+                       results, os_info, metadata=T.Metadata(),
+                       created_at=golden["scanned"])
+    ours = to_github(rep)
+    assert ours == golden

@@ -48,8 +48,11 @@ class ApkAnalyzer(Analyzer):
                 pkg.maintainer = val
             elif key == "D":
                 pkg.depends_on = [
-                    _strip_constraint(d) for d in val.split()
+                    _trim_requirement(d) for d in val.split()
                     if not d.startswith("!")]
+            elif key == "p":
+                pkg._provides = [_trim_requirement(p)
+                                 for p in val.split()]
             elif key == "F":
                 cur_dir = val
             elif key == "R":
@@ -59,6 +62,25 @@ class ApkAnalyzer(Analyzer):
         self._flush(pkg, pkgs)
         if not pkgs:
             return None
+        # duplicate stanzas dedupe by name, first wins (apk.go
+        # uniquePkgs)
+        seen: set[str] = set()
+        uniq: list[T.Package] = []
+        for p in pkgs:
+            if p.name not in seen:
+                seen.add(p.name)
+                uniq.append(p)
+        pkgs = uniq
+        # deps resolve through the provides map to package IDs
+        # (apk.go consolidateDependencies); unresolvable deps drop
+        provides: dict[str, str] = {}
+        for p in pkgs:
+            provides[p.name] = p.id
+            for prov in getattr(p, "_provides", None) or ():
+                provides[prov] = p.id
+        for p in pkgs:
+            p.depends_on = sorted({
+                provides[d] for d in p.depends_on if d in provides})
         sysfiles = [f for p in pkgs for f in p.installed_files]
         return AnalysisResult(
             package_infos=[T.PackageInfo(file_path=path, packages=pkgs)],
@@ -75,12 +97,15 @@ class ApkAnalyzer(Analyzer):
             pkgs.append(pkg)
 
 
-def _strip_constraint(dep: str) -> str:
-    for op in ("><", ">=", "<=", "=", ">", "<", "~"):
-        if op in dep:
-            dep = dep.split(op[0], 1)[0]
-            break
-    return dep.split(":", 1)[-1] if dep.startswith("so:") else dep
+def _trim_requirement(dep: str) -> str:
+    """apk.go trimRequirement: strip version constraints ('<', '>',
+    '=' only — a '~' fuzzy token stays intact and simply never
+    resolves), KEEP the so:/cmd:/pc: prefix (it is the provides-map
+    key)."""
+    for i, c in enumerate(dep):
+        if c in "><=":
+            return dep[:i]
+    return dep
 
 
 def _parse_license(val: str) -> list[str]:

@@ -69,7 +69,8 @@ def to_github(report: T.Report, version: str = "dev",
 
         resolved = {}
         for pkg in result.packages:
-            p = purl_for_package(result.type, pkg)
+            p = pkg.identifier.purl or \
+                purl_for_package(result.type, pkg)
             entry = {}
             if p:
                 entry["package_url"] = p
