@@ -538,3 +538,43 @@ def test_golden_github_sbom(table, tmp_path, monkeypatch):
                        created_at=golden["scanned"])
     ours = to_github(rep)
     assert ours == golden
+
+
+def test_golden_registry_path(table, tmp_path):
+    """alpine-310-registry.json.golden: the same CVE set through the
+    STREAMED registry artifact (reference integration/registry_test.go)
+    instead of the archive path."""
+    import datetime as dt
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from fake_registry import FakeRegistry, tar_of
+
+    name = "alpine-310"
+    doc, vulns = _golden_vulns(name)
+    files = dict(SPECS[name]["files"])
+    files.update(_pkg_db(SPECS[name]["fmt"], vulns))
+    layer = tar_of(files)
+    config = {"architecture": "amd64", "os": "linux",
+              "rootfs": {"type": "layers",
+                         "diff_ids": ["sha256:" + "0" * 64]},
+              "history": [{"created_by": "ADD rootfs"}]}
+    reg = FakeRegistry()
+    base = reg.start()
+    try:
+        reg.put_image("library/alpine", "3.10", [layer], config)
+        from trivy_tpu.fanal.artifact import RegistryArtifact
+        cache = MemoryCache()
+        art = RegistryArtifact(f"{base}/library/alpine:3.10", cache,
+                               scanners=("vuln",))
+        ref = art.inspect()
+        scanner = LocalScanner(cache, table)
+        now = dt.datetime.fromisoformat(
+            doc["CreatedAt"].replace("Z", "+00:00"))
+        results, os_info = scanner.scan(
+            ref.name, ref.id, ref.blob_ids,
+            T.ScanOptions(scanners=("vuln",)), now=now)
+    finally:
+        reg.stop()
+    assert (os_info.family, os_info.name) == ("alpine", "3.10.2")
+    _, want_vulns = _golden_vulns("alpine-310-registry")
+    assert _our_tuples(results) == _tuples(want_vulns)
