@@ -136,11 +136,12 @@ def table():
     return build_table(advisories, details, aux=aux)
 
 
-def _golden_vulns(name):
+def _golden_vulns(name, clazz="os-pkgs"):
+    """(doc, vulns) of a golden; clazz=None collects every class."""
     doc = json.load(open(os.path.join(TD, f"{name}.json.golden")))
     out = []
     for r in doc.get("Results") or []:
-        if r.get("Class") != "os-pkgs":
+        if clazz is not None and r.get("Class") != clazz:
             continue
         out.extend(r.get("Vulnerabilities") or [])
     return doc, out
@@ -578,3 +579,62 @@ def test_golden_registry_path(table, tmp_path):
     assert (os_info.family, os_info.name) == ("alpine", "3.10.2")
     _, want_vulns = _golden_vulns("alpine-310-registry")
     assert _our_tuples(results) == _tuples(want_vulns)
+
+
+def test_golden_busybox_with_lockfile(table, tmp_path):
+    """busybox-with-lockfile.json.golden: no OS, one Cargo.lock —
+    lang-pkgs detection parity."""
+    import datetime as dt
+
+    doc, want_vulns = _golden_vulns("busybox-with-lockfile",
+                                    clazz=None)
+    files = {"app/Cargo.lock": b"""\
+[[package]]
+name = "ammonia"
+version = "1.9.0"
+source = "registry+https://github.com/rust-lang/crates.io-index"
+
+[[package]]
+name = "app"
+version = "0.1.0"
+dependencies = ["ammonia"]
+"""}
+    now = dt.datetime.fromisoformat(
+        doc["CreatedAt"].replace("Z", "+00:00"))
+    results, _ = _scan(tmp_path, files, table, now=now)
+    assert _our_tuples(results) == _tuples(want_vulns)
+
+
+def test_golden_fluentd_gems(table, tmp_path):
+    """fluentd-gems.json.golden: debian OS packages + an installed
+    gemspec in one image — mixed-class detection parity."""
+    import datetime as dt
+
+    doc, want_vulns = _golden_vulns("fluentd-gems", clazz=None)
+    gemspec = b"""\
+# -*- encoding: utf-8 -*-
+Gem::Specification.new do |s|
+  s.name = "activesupport".freeze
+  s.version = "6.0.2.1"
+  s.licenses = ["MIT".freeze]
+end
+"""
+    files = {
+        "etc/os-release": b'ID=debian\nVERSION_ID="10"\n',
+        "etc/debian_version": b"10.2\n",
+        "var/lib/dpkg/status": (
+            b"Package: libidn2-0\nStatus: install ok installed\n"
+            b"Source: libidn2\nVersion: 2.0.5-1\n"
+            b"Architecture: amd64\n"),
+        "var/lib/gems/2.5.0/specifications/"
+        "activesupport-6.0.2.1.gemspec": gemspec,
+    }
+    now = dt.datetime.fromisoformat(
+        doc["CreatedAt"].replace("Z", "+00:00"))
+    results, os_info = _scan(tmp_path, files, table, now=now)
+    assert (os_info.family, os_info.name) == ("debian", "10.2")
+    assert _our_tuples(results) == _tuples(want_vulns)
+    # class/target split matches the reference's result grouping
+    by_class = {r.clazz: r.target for r in results
+                if r.vulnerabilities}
+    assert by_class.get("lang-pkgs") == "Ruby"

@@ -309,11 +309,17 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
 
 
 def _first_fixed(adv: RawAdvisory) -> str:
-    """Language advisories format PatchedVersions as the report
-    FixedVersion, comma-joined (reference pkg/detector/library/driver.go
-    createFixedVersions)."""
+    """Language advisories format PatchedVersions — RAW specs, comma-
+    joined and uniq'd — as the report FixedVersion; with no patched
+    list, the '< x' upper bounds of the vulnerable ranges stand in
+    (reference pkg/detector/library/driver.go createFixedVersions)."""
     if adv.patched_versions:
-        vers = [t.strip().lstrip(">=<~^ ")
-                for t in adv.patched_versions.split("||")]
-        return ", ".join(v for v in vers if v)
-    return ""
+        vers = [t.strip() for t in adv.patched_versions.split("||")]
+        return ", ".join(dict.fromkeys(v for v in vers if v))
+    out = []
+    for version in (adv.vulnerable_ranges or "").split("||"):
+        for spec in version.split(","):
+            spec = spec.strip()
+            if spec.startswith("<") and not spec.startswith("<="):
+                out.append(spec[1:].strip())
+    return ", ".join(dict.fromkeys(out))
