@@ -638,3 +638,53 @@ end
     by_class = {r.clazz: r.target for r in results
                 if r.vulnerabilities}
     assert by_class.get("lang-pkgs") == "Ruby"
+
+
+def test_golden_vm_image(table, tmp_path):
+    """amazonlinux2-gp2-x86-vm.json.golden: the VM disk-image artifact
+    path (MBR + ext4 walk) produces the reference's CVE set."""
+    import datetime as dt
+    import shutil
+    import struct
+    import subprocess
+
+    mkfs = shutil.which("mkfs.ext4") or "/usr/sbin/mkfs.ext4"
+    if not os.path.exists(mkfs):
+        pytest.skip("mkfs.ext4 unavailable")
+    from trivy_tpu.fanal.artifact import VMArtifact
+
+    doc, want_vulns = _golden_vulns("amazonlinux2-gp2-x86-vm")
+    root = tmp_path / "rootfs"
+    os.makedirs(root / "etc")
+    os.makedirs(root / "var/lib/rpm")
+    (root / "etc/system-release").write_bytes(
+        b"Amazon Linux release 2 (Karoo)\n")
+    (root / "var/lib/rpm/rpmdb.sqlite").write_bytes(
+        _pkg_db("rpm", want_vulns)["var/lib/rpm/rpmdb.sqlite"])
+    img = tmp_path / "fs.img"
+    with open(img, "wb") as f:
+        f.truncate(16 << 20)
+    subprocess.run([mkfs, "-q", "-F", "-d", str(root), str(img)],
+                   check=True, capture_output=True)
+    # one-partition MBR wrap (reference scans a partitioned disk)
+    SECTOR = 512
+    fs = img.read_bytes()
+    mbr = bytearray(2048 * SECTOR)
+    entry = struct.pack("<8B II", 0, 0, 0, 0, 0x83, 0, 0, 0,
+                        2048, len(fs) // SECTOR)
+    mbr[446:462] = entry
+    mbr[510:512] = b"\x55\xaa"
+    disk = tmp_path / "disk.img"
+    disk.write_bytes(bytes(mbr) + fs)
+
+    cache = MemoryCache()
+    art = VMArtifact(str(disk), cache, scanners=("vuln",))
+    ref = art.inspect()
+    scanner = LocalScanner(cache, table)
+    now = dt.datetime.fromisoformat(
+        doc["CreatedAt"].replace("Z", "+00:00"))
+    results, os_info = scanner.scan(
+        "disk.img", ref.id, ref.blob_ids,
+        T.ScanOptions(scanners=("vuln",)), now=now)
+    assert (os_info.family, os_info.name) == ("amazon", "2 (Karoo)")
+    assert _our_tuples(results) == _tuples(want_vulns)
