@@ -278,6 +278,71 @@ def bench_secrets_device():
 
 SERVER_IMAGES = 1000
 SERVER_CLIENTS = 16
+ARCHIVE_IMAGES = 200
+
+
+def bench_archive_e2e(table):
+    """BASELINE config-1 shape: wall-clock through the FULL archive
+    pipeline — docker-save tar → layer walk → analyzers → cache →
+    applier → detect → report JSON — on realistic small OS images
+    (distinct alpine package sets per image)."""
+    import io
+    import sys as _sys
+    import tempfile
+
+    _sys.path.insert(0, os.path.join(REPO, "tests"))
+    from helpers import make_image
+
+    import numpy as np
+    from trivy_tpu import types as Ty
+    from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+    from trivy_tpu.fanal.cache import MemoryCache
+    from trivy_tpu.report import build_report, to_json
+    from trivy_tpu.scanner import LocalScanner
+
+    rng = np.random.default_rng(13)
+    installed_pool = synth_versions(rng, major_lo=4, major_hi=9)
+
+    def installed_db(i):
+        names = rng.integers(0, N_PKG_NAMES, 40)
+        vers = rng.integers(0, len(installed_pool), 40)
+        blocks = []
+        for n, v in zip(names, vers):
+            blocks.append(f"P:pkg{n:05d}\nV:{installed_pool[int(v)]}\n"
+                          f"A:x86_64\no:pkg{n:05d}\nL:MIT\n")
+        return ("\n".join(blocks) + "\n").encode()
+
+    os_release = (b'NAME="Alpine Linux"\nID=alpine\n'
+                  b'VERSION_ID=3.19.1\n')
+
+    def scan_one(path):
+        cache = MemoryCache()
+        art = ImageArchiveArtifact(path, cache, scanners=("vuln",))
+        ref = art.inspect()
+        scanner = LocalScanner(cache, table)
+        results, os_info = scanner.scan(
+            ref.name, ref.id, ref.blob_ids,
+            Ty.ScanOptions(scanners=("vuln",)))
+        rep = build_report(ref.name, "container_image", results,
+                           os_info, metadata=Ty.Metadata())
+        out = io.StringIO()
+        out.write(to_json(rep))
+        return sum(len(r.vulnerabilities) for r in results)
+
+    with tempfile.TemporaryDirectory() as td:
+        paths = []
+        for i in range(ARCHIVE_IMAGES):
+            p = os.path.join(td, f"img{i}.tar")
+            make_image(p, [{
+                "etc/os-release": os_release,
+                "lib/apk/db/installed": installed_db(i),
+            }])
+            paths.append(p)
+        scan_one(paths[0])  # warm compile
+        t0 = time.perf_counter()
+        hits = sum(scan_one(p) for p in paths[1:])
+        dt = time.perf_counter() - t0
+    return (ARCHIVE_IMAGES - 1) / dt, hits
 
 
 def bench_server(table):
@@ -635,6 +700,11 @@ def main():
             result["server_backend"] = "cpu"
         except Exception as e:  # never sink the bench line
             diag.append(f"server bench failed: {e}")
+        try:
+            arch_ips, _arch_hits = bench_archive_e2e(table)
+            result["images_per_sec_archive_e2e"] = round(arch_ips, 1)
+        except Exception as e:
+            diag.append(f"archive e2e bench failed: {e}")
 
         dev = None
         dev_source = "live"
