@@ -26,7 +26,10 @@ strict superset — no false negatives.
 Files are packed into fixed [B, L] uint8 chunk tensors with an overlap of
 max keyword length - 1 so boundary-straddling keywords are still seen.
 Regex confirmation of gated (file, rule) pairs runs host-side for exact
-parity (SURVEY.md §7 step 6).
+parity (SURVEY.md §7 step 6). On TPU backends the jnp prefix_scan here
+is superseded by the Pallas kernel in ops/prefilter_pallas.py (single
+VMEM pass over all keywords); this module remains the CPU/mesh path
+and the shared bank/packing layer.
 """
 
 from __future__ import annotations
