@@ -688,3 +688,56 @@ def test_golden_vm_image(table, tmp_path):
         T.ScanOptions(scanners=("vuln",)), now=now)
     assert (os_info.family, os_info.name) == ("amazon", "2 (Karoo)")
     assert _our_tuples(results) == _tuples(want_vulns)
+
+
+@pytest.mark.parametrize("skip_kind", ["dirs", "files"])
+def test_golden_skip_variants(table, tmp_path, skip_kind):
+    """alpine-39-skip.json.golden (both the skip-dirs and skip-files
+    reference cases): skipping /etc during the LAYER walk removes OS
+    detection; packages without an OS report Family 'none' and no
+    os-pkgs results (reference local/scan.go:66-71)."""
+    import datetime as dt
+
+    base = "alpine-39"
+    _, base_vulns = _golden_vulns(base)
+    files = dict(SPECS[base]["files"])
+    files.update(_pkg_db(SPECS[base]["fmt"], base_vulns))
+    path = str(tmp_path / "img.tar")
+    make_image(path, [files])
+    cache = MemoryCache()
+    kw = {"skip_dirs": ("/etc",)} if skip_kind == "dirs" else \
+         {"skip_files": ("/etc/alpine-release", "/etc/os-release")}
+    art = ImageArchiveArtifact(path, cache, scanners=("vuln",), **kw)
+    ref = art.inspect()
+    scanner = LocalScanner(cache, table)
+    results, os_info = scanner.scan(
+        ref.name, ref.id, ref.blob_ids,
+        T.ScanOptions(scanners=("vuln",)))
+    golden = json.load(open(os.path.join(
+        TD, "alpine-39-skip.json.golden")))
+    assert golden["Metadata"]["OS"] == {"Family": "none", "Name": ""}
+    assert os_info.family == "none"
+    assert not any(r.vulnerabilities for r in results)
+
+    # and the unskipped scan of the SAME image stays cached separately
+    art2 = ImageArchiveArtifact(path, cache, scanners=("vuln",))
+    ref2 = art2.inspect()
+    assert ref2.blob_ids != ref.blob_ids
+    results2, os2 = scanner.scan(
+        ref2.name, ref2.id, ref2.blob_ids,
+        T.ScanOptions(scanners=("vuln",)),
+        now=dt.datetime(2021, 8, 25, tzinfo=dt.timezone.utc))
+    assert os2.family == "alpine"
+    assert any(r.vulnerabilities for r in results2)
+
+
+def test_skip_match_semantics():
+    """Reference doublestar semantics: '*' never crosses '/', '**'
+    does; dot-prefixed root files stay matchable."""
+    from trivy_tpu.fanal.walker import normalize_skip_globs, skip_match
+    globs = normalize_skip_globs(["/*.lock", "/.dockerenv",
+                                  "vendor/**"])
+    assert skip_match("Gemfile.lock", globs)
+    assert not skip_match("app/Gemfile.lock", globs)   # '*' stops at /
+    assert skip_match(".dockerenv", globs)
+    assert skip_match("vendor/a/b/c.txt", globs)       # '**' crosses

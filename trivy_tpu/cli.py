@@ -560,7 +560,9 @@ def cmd_image(args) -> int:
                 args.image_name, cache, scanners=scanners, group=group,
                 secret_scanner=sec_scanner, secret_config_path=sec_cfg,
                 platform=getattr(args, "platform", "") or "linux/amd64",
-                client=remote_client)
+                client=remote_client,
+                skip_files=tuple(getattr(args, "skip_files", []) or ()),
+                skip_dirs=tuple(getattr(args, "skip_dirs", []) or ()))
             art._manifest = remote_manifest
         elif containerd_store is not None:
             from .fanal.containerd import ContainerdArtifact
@@ -568,13 +570,17 @@ def cmd_image(args) -> int:
                 args.image_name, cache, scanners=scanners, group=group,
                 secret_scanner=sec_scanner, secret_config_path=sec_cfg,
                 platform=getattr(args, "platform", "") or "linux/amd64",
-                store=containerd_store)
+                store=containerd_store,
+                skip_files=tuple(getattr(args, "skip_files", []) or ()),
+                skip_dirs=tuple(getattr(args, "skip_dirs", []) or ()))
             art._target = containerd_target
         else:
             art = ImageArchiveArtifact(
                 input_path, cache, scanners=scanners, group=group,
                 secret_scanner=sec_scanner,
-                secret_config_path=sec_cfg)
+                secret_config_path=sec_cfg,
+                skip_files=tuple(getattr(args, "skip_files", []) or ()),
+                skip_dirs=tuple(getattr(args, "skip_dirs", []) or ()))
         ref = None
         if "rekor" in getattr(args, "sbom_sources", ""):
             # remote-SBOM shortcut: a published SBOM attestation replaces

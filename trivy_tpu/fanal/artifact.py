@@ -49,6 +49,15 @@ class _ImageInspectMixin:
     def _image_keys(self, image_id: str, diff_ids: list):
         versions = self.group.versions()
         opts = {"scanners": sorted(self.scanners)}
+        # skip filters change blob content → they are part of the key
+        # (reference artifact option hashing)
+        from .walker import normalize_skip_globs
+        sf = normalize_skip_globs(getattr(self, "skip_files", ()))
+        sd = normalize_skip_globs(getattr(self, "skip_dir_globs", ()))
+        if sf:
+            opts["skip_files"] = sorted(sf)
+        if sd:
+            opts["skip_dirs"] = sorted(sd)
         from ..misconf import custom_checks_fingerprint
         fp = custom_checks_fingerprint()
         if fp:
@@ -69,7 +78,9 @@ class _ImageInspectMixin:
             with open_layer(i) as layer_tf:
                 scan = walk_layer_tar(
                     layer_tf, self.group, collect_secrets=want_secrets,
-                    secret_config_path=self.secret_config_path)
+                    secret_config_path=self.secret_config_path,
+                    skip_files=getattr(self, "skip_files", ()),
+                    skip_dir_globs=getattr(self, "skip_dir_globs", ()))
             bi = blob_info(scan, diff_id=diff_id, created_by=cb)
             if layer_digests:
                 bi.digest = layer_digests[i]
@@ -94,13 +105,16 @@ class ImageArchiveArtifact(_ImageInspectMixin):
 
     def __init__(self, path: str, cache, group: Optional[AnalyzerGroup] = None,
                  scanners: tuple = ("vuln",), secret_scanner=None,
-                 secret_config_path: str = DEFAULT_SECRET_CONFIG):
+                 secret_config_path: str = DEFAULT_SECRET_CONFIG,
+                 skip_files: tuple = (), skip_dirs: tuple = ()):
         self.path = path
         self.cache = cache
         self.group = group or AnalyzerGroup()
         self.scanners = scanners
         self.secret_scanner = secret_scanner
         self.secret_config_path = secret_config_path
+        self.skip_files = tuple(skip_files)
+        self.skip_dir_globs = tuple(skip_dirs)
         if "secret" in scanners and secret_scanner is None:
             from ..secret import SecretScanner
             self.secret_scanner = SecretScanner()
@@ -321,7 +335,8 @@ class RegistryArtifact(_ImageInspectMixin):
                  group: Optional[AnalyzerGroup] = None,
                  scanners: tuple = ("vuln",), secret_scanner=None,
                  secret_config_path: str = DEFAULT_SECRET_CONFIG,
-                 platform: str = "linux/amd64", client=None):
+                 platform: str = "linux/amd64", client=None,
+                 skip_files: tuple = (), skip_dirs: tuple = ()):
         from ..oci import default_client, parse_ref
         self.image = image
         self.ref = parse_ref(image)
@@ -332,6 +347,8 @@ class RegistryArtifact(_ImageInspectMixin):
         self.scanners = scanners
         self.secret_scanner = secret_scanner
         self.secret_config_path = secret_config_path
+        self.skip_files = tuple(skip_files)
+        self.skip_dir_globs = tuple(skip_dirs)
         if "secret" in scanners and secret_scanner is None:
             from ..secret import SecretScanner
             self.secret_scanner = SecretScanner()

@@ -165,7 +165,8 @@ class ContainerdArtifact(_ImageInspectMixin):
                  scanners: tuple = ("vuln",), secret_scanner=None,
                  secret_config_path: str = "trivy-secret.yaml",
                  platform: str = "linux/amd64",
-                 store: ContainerdStore | None = None):
+                 store: ContainerdStore | None = None,
+                 skip_files: tuple = (), skip_dirs: tuple = ()):
         from .analyzers import AnalyzerGroup
         self.image = image
         self.store = store or ContainerdStore()
@@ -175,6 +176,8 @@ class ContainerdArtifact(_ImageInspectMixin):
         self.scanners = scanners
         self.secret_scanner = secret_scanner
         self.secret_config_path = secret_config_path
+        self.skip_files = tuple(skip_files)
+        self.skip_dir_globs = tuple(skip_dirs)
         if "secret" in scanners and secret_scanner is None:
             from ..secret import SecretScanner
             self.secret_scanner = SecretScanner()

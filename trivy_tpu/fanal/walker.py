@@ -65,14 +65,34 @@ class BlobScan:
     post_files: dict = field(default_factory=dict)    # path -> bytes
 
 
+def _parent_dirs(path: str):
+    parts = path.split("/")[:-1]
+    for i in range(1, len(parts) + 1):
+        yield "/".join(parts[:i])
+
+
 def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
                    collect_secrets: bool = False,
-                   secret_config_path: str = DEFAULT_SECRET_CONFIG
-                   ) -> BlobScan:
+                   secret_config_path: str = DEFAULT_SECRET_CONFIG,
+                   skip_files: tuple = (),
+                   skip_dir_globs: tuple = ()) -> BlobScan:
+    # --skip-files/--skip-dirs apply to image layers too (reference
+    # walker.go CleanSkipPaths: leading '/' stripped, compared against
+    # the walked relative path with doublestar semantics)
+    skip_files = normalize_skip_globs(skip_files)
+    skip_dir_globs = normalize_skip_globs(skip_dir_globs)
     scan = BlobScan(result=AnalysisResult())
     for member in tf:
-        path = member.name.lstrip("./").lstrip("/")
-        if not path:
+        path = _norm_rel(member.name)
+        if path.startswith("/"):
+            path = path[1:]
+        if not path or path == ".":
+            continue
+        if skip_files and skip_match(path, skip_files):
+            continue
+        if skip_dir_globs and any(
+                skip_match(d, skip_dir_globs)
+                for d in _parent_dirs(path)):
             continue
         dirname, base = os.path.split(path)
         if base == OPAQUE_MARKER:
@@ -110,6 +130,44 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
     return scan
 
 
+def normalize_skip_globs(globs) -> tuple:
+    """CleanSkipPaths: strip leading '/' so absolute-style flags match
+    the walked relative paths."""
+    return tuple(g.lstrip("/") for g in globs or ())
+
+
+def skip_match(rel: str, globs: tuple) -> bool:
+    """Reference doublestar semantics (utils.SkipPath): `*`/`?` never
+    cross a path separator, `**` matches any number of segments."""
+    return any(_skip_re(g).match(rel) is not None for g in globs)
+
+
+_SKIP_RE_CACHE: dict = {}
+
+
+def _skip_re(glob: str):
+    rx = _SKIP_RE_CACHE.get(glob)
+    if rx is None:
+        import re as _re
+        out = []
+        i, n = 0, len(glob)
+        while i < n:
+            c = glob[i]
+            if c == "*":
+                if glob.startswith("**", i):
+                    out.append(".*")
+                    i += 2
+                    continue
+                out.append("[^/]*")
+            elif c == "?":
+                out.append("[^/]")
+            else:
+                out.append(_re.escape(c))
+            i += 1
+        rx = _SKIP_RE_CACHE[glob] = _re.compile("".join(out) + r"\Z")
+    return rx
+
+
 def _norm_rel(path: str) -> str:
     """strip one leading './' exactly (lstrip would eat leading dots
     of dot-prefixed names like .cache)."""
@@ -129,8 +187,9 @@ def walk_fs(root: str, group: AnalyzerGroup,
     sorted path order so output is deterministic either way."""
     scan = BlobScan(result=AnalysisResult())
     root = os.path.abspath(root)
+    skip_files = normalize_skip_globs(skip_files)
+    skip_dir_globs = normalize_skip_globs(skip_dir_globs)
     candidates: list[tuple[str, str, bool, bool, bool]] = []
-    import fnmatch
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d not in skip_dirs]
         reldir = os.path.relpath(dirpath, root).replace(os.sep, "/")
@@ -138,14 +197,12 @@ def walk_fs(root: str, group: AnalyzerGroup,
             # --skip-dirs matches walked relative paths (walker.go)
             dirnames[:] = [
                 d for d in dirnames
-                if not any(fnmatch.fnmatch(
-                    _norm_rel(f"{reldir}/{d}"), g)
-                    for g in skip_dir_globs)]
+                if not skip_match(_norm_rel(f"{reldir}/{d}"),
+                                  skip_dir_globs)]
         for fn in sorted(filenames):
             full = os.path.join(dirpath, fn)
             rel = os.path.relpath(full, root).replace(os.sep, "/")
-            if skip_files and any(fnmatch.fnmatch(rel, g)
-                                  for g in skip_files):
+            if skip_files and skip_match(rel, skip_files):
                 continue
             try:
                 size = os.path.getsize(full)

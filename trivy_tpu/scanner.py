@@ -59,6 +59,10 @@ class LocalScanner:
                                    f"(artifact {artifact_id})")
                 blobs.append(blob)
             detail = apply_layers(blobs)
+            # OS-independent packages without a detected OS report
+            # Family "none" (reference local/scan.go:66-71)
+            if not detail.os.detected and detail.packages:
+                detail.os = T.OS(family=T.OSFamily.NONE)
             # dev dependencies are removed unless --include-dev-deps
             # (reference local/scan.go:109-111 excludeDevDeps)
             if not options.include_dev_deps:

@@ -20,6 +20,7 @@ from typing import Any, Optional
 # --- enums (string constants, reference pkg/fanal/types/const.go) ---
 
 class OSFamily:
+    NONE = "none"  # packages without a detected OS (scan.go:70)
     ALPINE = "alpine"
     DEBIAN = "debian"
     UBUNTU = "ubuntu"
