@@ -173,3 +173,79 @@ deny[msg] {
     assert "USR-0300" in by_file.get("config.json", set())
     assert "USR-0300" in by_file.get("app.yaml", set())
     assert any("DS" in i for i in by_file.get("Dockerfile", set()))
+
+
+REF_REPO = os.environ.get(
+    "TRIVY_REFERENCE_DIR", "/root/reference") + \
+    "/integration/testdata/fixtures/repo"
+
+
+def _misconf(out):
+    rep = json.loads(out)
+    res = [r for r in rep.get("Results", [])
+           if r.get("Class") == "config"]
+    assert res, "no config result"
+    return res[0]
+
+
+def test_reference_custom_policy_fixture(capsys):
+    """The reference's custom-policy integration fixture (repo_test.go
+    'dockerfile with custom policies'): both user namespaces fire
+    alongside the passing builtin checks."""
+    import pytest
+    if not os.path.isdir(REF_REPO + "/custom-policy"):
+        pytest.skip("reference fixtures not present")
+    code, out = run_cli(
+        ["fs", "--scanners", "misconfig", "--format", "json",
+         "--db", FIXGLOB,
+         "--config-check", REF_REPO + "/custom-policy/policy",
+         "--check-namespaces", "user",
+         REF_REPO + "/custom-policy"], capsys)
+    r = _misconf(out)
+    msgs = {(m.get("Namespace"), m["Message"], m["Status"])
+            for m in r.get("Misconfigurations") or []}
+    assert ("user.bar", "something bad: bar", "FAIL") in msgs
+    assert ("user.foo", "something bad: foo", "FAIL") in msgs
+    # builtin checks all pass on this fixture (golden: 27 successes
+    # for the reference's 27-check set; ours counts its own set)
+    assert r["MisconfSummary"]["Failures"] == 2
+    assert r["MisconfSummary"]["Successes"] > 20
+
+
+def test_reference_rule_exception_fixture(capsys):
+    """repo_test.go 'dockerfile with rule exception': the DS002
+    exception's input condition does NOT match the fixture, so DS002
+    still fails (golden: 1 failure)."""
+    import pytest
+    if not os.path.isdir(REF_REPO + "/rule-exception"):
+        pytest.skip("reference fixtures not present")
+    code, out = run_cli(
+        ["fs", "--scanners", "misconfig", "--format", "json",
+         "--db", FIXGLOB,
+         "--config-check", REF_REPO + "/rule-exception/policy",
+         REF_REPO + "/rule-exception"], capsys)
+    r = _misconf(out)
+    fails = [m for m in r.get("Misconfigurations") or []
+             if m["Status"] == "FAIL"]
+    assert [m["ID"] for m in fails] == ["DS002"]
+    assert r["MisconfSummary"]["Failures"] == 1
+    assert r["MisconfSummary"]["Exceptions"] == 0
+
+
+def test_reference_namespace_exception_fixture(capsys):
+    """repo_test.go 'dockerfile with namespace exception': every
+    builtin namespace is excepted (golden: 0 successes, 0 failures,
+    27 exceptions for the reference's set; ours excepts its whole
+    set)."""
+    import pytest
+    if not os.path.isdir(REF_REPO + "/namespace-exception"):
+        pytest.skip("reference fixtures not present")
+    code, out = run_cli(
+        ["fs", "--scanners", "misconfig", "--format", "json",
+         "--db", FIXGLOB,
+         "--config-check", REF_REPO + "/namespace-exception/policy",
+         REF_REPO + "/namespace-exception"], capsys)
+    r = _misconf(out)
+    assert r["MisconfSummary"]["Failures"] == 0
+    assert r["MisconfSummary"]["Successes"] == 0
+    assert r["MisconfSummary"]["Exceptions"] > 20
