@@ -8,7 +8,7 @@ flattened once at load time into hash-sorted arrays (SURVEY.md §7 step 2):
     hash[A, 2]      fnv1a64(source + "\\0" + pkg_name) as (hi, lo) int32
     lo_tok[A, K]    lower-bound version tokens
     hi_tok[A, K]    upper-bound version tokens
-    flags[A]        interval shape + polarity + inexact bits (ops.join)
+    flags[A]        interval shape + polarity + inexact bits (ops.constants)
     group[A]        advisory group id (one advisory may span several rows)
 
 plus host-side metadata per group (vuln id, package name for collision
@@ -25,7 +25,7 @@ from typing import Optional
 import numpy as np
 
 from .. import version as V
-from ..ops import join as J
+from ..ops import constants as C
 from ..ops.hashing import key_hash, split_u64
 from .constraints import ConstraintError, Interval, parse_constraint
 
@@ -250,17 +250,17 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
                 if not raw_fallback:
                     # OS-style: catch-all row, host recheck over g.rows
                     g.rows = [(p, v) for p, v in intervals]
-                    rows_out = [(pad_row, pad_row, J.INEXACT)]
+                    rows_out = [(pad_row, pad_row, C.INEXACT)]
                 break
             flags = 0
             if iv.lo:
-                flags |= J.HAS_LO | (J.LO_INCL if iv.lo_incl else 0)
+                flags |= C.HAS_LO | (C.LO_INCL if iv.lo_incl else 0)
             if iv.hi:
-                flags |= J.HAS_HI | (J.HI_INCL if iv.hi_incl else 0)
+                flags |= C.HAS_HI | (C.HI_INCL if iv.hi_incl else 0)
             if not (lo_exact and hi_exact):
-                flags |= J.INEXACT
+                flags |= C.INEXACT
             if not positive:
-                flags |= J.NEGATIVE
+                flags |= C.NEGATIVE
             rows_out.append((lo_tok if lo_tok is not None else pad_row,
                              hi_tok if hi_tok is not None else pad_row,
                              flags))
@@ -274,7 +274,7 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
                            adv.unaffected_versions)
         if raw_fallback:
             g.rows = []
-            rows_out = [(pad_row, pad_row, J.INEXACT)]
+            rows_out = [(pad_row, pad_row, C.INEXACT)]
         for lo_tok, hi_tok, flags in rows_out:
             hash_vals.append(h)
             lo_rows.append(lo_tok)

@@ -1,0 +1,60 @@
+"""Single source of truth for the device-visible representation contract.
+
+The columnar advisory table (db/table.py) and the device join
+(ops/join.py) communicate through int32 flag words and an int8 report
+word. Both sides used to carry their own copies of the bit values with
+a "must match" comment; now every producer and consumer imports them
+from here, and graftlint (trivy_tpu/analysis) rejects any module that
+redefines one of these names with an integer literal.
+
+Machine-readable schema: TABLE_SCHEMA describes the dtypes/ranks of the
+columnar arrays exactly as ops/join.py gathers them. The analysis
+cross-checker builds a fixture table and verifies both sides against
+this dict, so a drift between db.flatten and the join's gathers fails
+CI instead of silently mis-matching advisories.
+"""
+
+from __future__ import annotations
+
+# ---- interval flag bits (int32 `flags` column; one word per advisory
+# row, produced by db.table.build_table, consumed by ops.join._pair_core)
+HAS_LO = 1        # row has a lower bound (lo_tok is meaningful)
+LO_INCL = 2       # lower bound is inclusive (>=, not >)
+HAS_HI = 4        # row has an upper bound (hi_tok is meaningful)
+HI_INCL = 8       # upper bound is inclusive (<=, not <)
+INEXACT = 16      # token encoding lossy — host must re-check with the
+                  # exact comparator before reporting
+NEGATIVE = 32     # row describes a patched/unaffected range, not a
+                  # vulnerable one (subtracted at assembly)
+
+FLAG_BITS = {
+    "HAS_LO": HAS_LO, "LO_INCL": LO_INCL, "HAS_HI": HAS_HI,
+    "HI_INCL": HI_INCL, "INEXACT": INEXACT, "NEGATIVE": NEGATIVE,
+}
+FLAG_MASK = HAS_LO | LO_INCL | HAS_HI | HI_INCL | INEXACT | NEGATIVE
+
+# ---- report bits (int8 per candidate pair, returned by the join)
+SATISFIED = 1       # interval predicate holds for this pair
+NEEDS_RECHECK = 2   # INEXACT row: treat as candidate, re-check on host
+
+REPORT_BITS = {"SATISFIED": SATISFIED, "NEEDS_RECHECK": NEEDS_RECHECK}
+
+# Every name above is a contract constant: graftlint's flag-drift rule
+# (TPU103) flags any other module under trivy_tpu/ that binds one of
+# these names to an integer literal instead of importing it.
+CONTRACT_CONSTANT_NAMES = frozenset(FLAG_BITS) | frozenset(REPORT_BITS)
+
+# ---- columnar table schema, as consumed by ops.join's gathers:
+#   name -> (dtype, rank). K is the version-token key width
+# (trivy_tpu.version.KEY_WIDTH); A is the row count.
+TABLE_SCHEMA = {
+    "hash": ("int32", 2),     # [A, 2] biased (hi, lo) fnv1a64 halves
+    "lo_tok": ("int32", 2),   # [A, K] lower-bound version tokens
+    "hi_tok": ("int32", 2),   # [A, K] upper-bound version tokens
+    "flags": ("int32", 1),    # [A]    FLAG_BITS words
+    "group": ("int32", 1),    # [A]    advisory group id
+}
+
+# dtype of the join's per-pair report word (the int32→int8 packing in
+# _pair_core is the single narrowing the jaxpr contracts allow)
+REPORT_DTYPE = "int8"

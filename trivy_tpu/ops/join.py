@@ -35,18 +35,12 @@ import jax
 import jax.numpy as jnp
 
 from .compare import lex_eq, lex_less
-
-# flag bits (must match db.flatten)
-HAS_LO = 1
-LO_INCL = 2
-HAS_HI = 4
-HI_INCL = 8
-INEXACT = 16
-NEGATIVE = 32  # row describes a patched/unaffected range, not a vulnerable one
-
-# report bits returned per pair
-SATISFIED = 1
-NEEDS_RECHECK = 2
+# flag/report bits live in ops.constants (shared with db.table's
+# flatten); re-exported here for the existing `join as J` import sites
+from .constants import (  # noqa: F401  (re-export)
+    HAS_HI, HAS_LO, HI_INCL, INEXACT, LO_INCL, NEEDS_RECHECK, NEGATIVE,
+    SATISFIED,
+)
 
 
 def _pair_core(adv_lo_tok, adv_hi_tok, adv_flags,
