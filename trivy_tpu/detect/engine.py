@@ -116,7 +116,8 @@ class BatchDetector:
         except (ValueError, KeyError):
             # Reference skips packages whose installed version doesn't
             # parse (alpine.go:96-100 logs debug and continues).
-            self._ver_idx[ck] = None
+            with self._lock:
+                self._ver_idx[ck] = None
             return None
         from ..db.constraints import _NPM_ECOS, _has_prerelease
         if eco in _NPM_ECOS and _has_prerelease(ver):
@@ -146,8 +147,9 @@ class BatchDetector:
             from ..native import fnv1a64_batch
             hv = fnv1a64_batch(
                 [s.encode() + b"\x00" + n.encode() for s, n in cold])
-            for ck, h in zip(cold, hv):
-                cache[ck] = int(h)
+            with self._lock:
+                for ck, h in zip(cold, hv):
+                    cache[ck] = int(h)
         return np.fromiter((cache[ck] for ck in keys),
                            dtype=np.uint64, count=len(keys))
 
