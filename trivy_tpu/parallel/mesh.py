@@ -284,10 +284,14 @@ def partition_queries(st: ShardedTable, q_start: np.ndarray,
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "t_pad"))
-def _sharded_csr_join(mesh, adv_lo, adv_hi, adv_flags, ver_tok,
-                      qs, qc, qv, total, t_pad):
+def _sharded_csr_join(mesh: Mesh, adv_lo, adv_hi, adv_flags, ver_tok,
+                      qs, qc, qv, total, t_pad: int):
     def local(adv_lo, adv_hi, adv_flags, ver_tok, qs, qc, qv, total):
-        ver_tok = jax.lax.pcast(ver_tok, ("dp", "db"), to="varying")
+        if hasattr(jax.lax, "pcast"):
+            # newer jax tracks varying-manual-axes (VMA): the
+            # replicated version pool must be cast to varying before
+            # it meets the per-device descriptors
+            ver_tok = jax.lax.pcast(ver_tok, ("dp", "db"), to="varying")
         bits = J._csr_core(adv_lo[0], adv_hi[0], adv_flags[0], ver_tok,
                            qs[0, 0], qc[0, 0], qv[0, 0], total[0, 0],
                            t_pad)
