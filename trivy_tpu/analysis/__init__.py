@@ -1,0 +1,56 @@
+"""graftlint: static analysis for the TPU hot-path invariants.
+
+The scan server's correctness-critical contracts — no host syncs inside
+jitted cores, stable dtypes across the db→join boundary, bounded
+lowering of the hot kernels — live in code review and docstrings unless
+something checks them. This package checks them, at CI time, with two
+engines plus a cross-checker:
+
+* **Engine 1 — AST lint** (`astlint.py`): walks every module under
+  `trivy_tpu/` and enforces syntactic invariants on device code
+  (functions that are jit-wrapped, pallas kernels, or `_*_core` by
+  convention) and on lock discipline in the threaded server modules.
+
+* **Engine 2 — jaxpr contracts** (`jaxpr_check.py`): traces the jitted
+  entry points under canonical abstract shapes (no device needed; the
+  Pallas kernel traces in interpret mode) and asserts the
+  machine-readable contracts in `contracts/*.json`: input/output
+  dtypes, an exact allowlist of `convert_element_type` pairs (the
+  int32→int8 report packing is the only narrowing the join may do), no
+  host callbacks, and a primitive-count budget so an accidental O(K)
+  unroll regresses loudly.
+
+* **Cross-checker** (`crosscheck.py`): builds a fixture advisory table
+  and verifies the columnar schema produced by `db/table.py` against
+  the gathers `ops/join.py` performs, both sides pinned to the shared
+  constants in `trivy_tpu/ops/constants.py`.
+
+Run it as ``python -m trivy_tpu.analysis`` (exit 1 on findings,
+``--json`` for machine output, ``--baseline FILE`` to suppress known
+findings explicitly). `tests/test_lint.py` runs it in tier-1 and
+asserts the tree is clean. The rule registry is in `registry.py`; see
+ARCHITECTURE.md ("Static analysis") for how to add a rule.
+"""
+
+from __future__ import annotations
+
+from .registry import Finding, RULES, rules_for_engine  # noqa: F401
+# importing the engines registers their rules (they import jax lazily,
+# so this stays cheap); without this, --list-rules in a fresh process
+# would see an empty registry
+from . import astlint, crosscheck, jaxpr_check  # noqa: E402,F401
+
+
+def run_all(root: str | None = None) -> list[Finding]:
+    """Run graftlint. With no `root`, all three engines run over the
+    installed trivy_tpu tree. With an explicit `root`, only the AST
+    engine runs over that tree — the jaxpr contracts and the schema
+    cross-check are properties of the installed package, not of an
+    arbitrary directory, and tracing them would both cost seconds and
+    report findings from outside the requested root."""
+    findings: list[Finding] = []
+    findings += astlint.run(root)
+    if root is None:
+        findings += jaxpr_check.run()
+        findings += crosscheck.run()
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,92 @@
+"""``python -m trivy_tpu.analysis`` — run graftlint.
+
+Exit codes: 0 clean (or every finding suppressed by the baseline),
+1 findings, 2 internal error. ``--json`` emits machine output for CI;
+``--baseline FILE`` suppresses the fingerprints listed there (each
+with a mandatory reason — suppression is explicit, never silent);
+``--update-goldens`` re-traces and rewrites the golden jaxpr
+snapshots; ``--list-rules`` prints the registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m trivy_tpu.analysis",
+        description="graftlint: TPU hot-path invariant checker "
+                    "(AST lint + jaxpr contracts + db/join cross-check)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="JSON baseline of explicitly suppressed "
+                         "finding fingerprints")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule registry and exit")
+    ap.add_argument("--update-goldens", action="store_true",
+                    help="rewrite the golden jaxpr snapshots from the "
+                         "current lowering")
+    ap.add_argument("--root", metavar="DIR", default=None,
+                    help="run ONLY the AST engine over this tree "
+                         "(default: all engines over the installed "
+                         "trivy_tpu tree)")
+    args = ap.parse_args(argv)
+
+    # keep the checker off any real accelerator: tracing is host-only
+    # and must not grab a TPU from a scan server's pool
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from . import run_all
+    from .registry import RULES, apply_baseline, load_baseline
+
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{rule.id}  [{rule.engine}]  {rule.name}")
+            for line in rule.doc.splitlines():
+                print(f"    {line}")
+        return 0
+
+    if args.update_goldens:
+        from .jaxpr_check import update_goldens
+        for path in update_goldens():
+            print(f"wrote {path}")
+        return 0
+
+    findings = run_all(args.root)
+    suppressed_hits = []
+    if args.baseline:
+        try:
+            suppressed = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"bad baseline {args.baseline}: {e}", file=sys.stderr)
+            return 2
+        findings, suppressed_hits = apply_baseline(findings, suppressed)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "suppressed": [f.to_json() for f in suppressed_hits],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if suppressed_hits:
+            print(f"({len(suppressed_hits)} finding(s) suppressed by "
+                  f"baseline)")
+        if findings:
+            print(f"{len(findings)} finding(s)")
+        else:
+            print("graftlint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `… --list-rules | head`
+        sys.exit(0)

@@ -1,0 +1,142 @@
+"""Cross-checker: db/table.py's columnar flatten vs ops/join.py's
+gathers, both pinned to trivy_tpu/ops/constants.py.
+
+The join gathers `lo_tok[pair_row]`, `hi_tok[pair_row]`,
+`flags[pair_row]` and masks with the flag bits; the flatten produces
+those arrays. Nothing in Python's type system connects the two — this
+check does, at CI time, by building a small fixture table through the
+real `build_table` and verifying:
+
+  * every array matches `constants.TABLE_SCHEMA` (dtype and rank);
+  * flag/report bit values are distinct powers of two and the flag
+    words the flatten actually emitted stay inside `FLAG_MASK`;
+  * the join's traced report dtype equals `constants.REPORT_DTYPE`;
+  * both sides' module sources bind the contract names by importing
+    the constants module (not by local literals — that part is
+    TPU103's job; here we check the import edge exists at all).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .registry import Finding, register
+
+_REL = os.path.join("trivy_tpu", "analysis", "crosscheck.py")
+
+
+def _fixture_table():
+    from ..db.table import RawAdvisory, build_table
+    raws = [
+        RawAdvisory(source="alpine 3.9", ecosystem="alpine",
+                    pkg_name="musl", vuln_id="CVE-2019-0001",
+                    fixed_version="1.1.20-r5",
+                    affected_version="1.1.20-r0"),
+        RawAdvisory(source="pip::", ecosystem="pip", pkg_name="flask",
+                    vuln_id="CVE-2019-0002",
+                    vulnerable_ranges=">=0.12, <1.0 || >=1.0, <1.0.1",
+                    patched_versions="1.0.1"),
+        RawAdvisory(source="alpine 3.9", ecosystem="alpine",
+                    pkg_name="openssl", vuln_id="CVE-2019-0003",
+                    fixed_version=""),
+    ]
+    return build_table(raws)
+
+
+@register("XCHK301", "db-join-schema", "xcheck")
+def check_schema() -> list[Finding]:
+    """Build a fixture table through db.table.build_table and verify
+    its arrays, the flag-bit algebra, and the join's report dtype
+    against ops.constants."""
+    import numpy as np
+
+    from ..ops import constants as C
+    findings: list[Finding] = []
+    table = _fixture_table()
+
+    for name, (dtype, rank) in C.TABLE_SCHEMA.items():
+        arr = getattr(table, name, None)
+        if arr is None:
+            findings.append(Finding(
+                "XCHK301", _REL, 0,
+                f"AdvisoryTable has no '{name}' array (TABLE_SCHEMA "
+                f"drift)", name))
+            continue
+        if str(arr.dtype) != dtype:
+            findings.append(Finding(
+                "XCHK301", _REL, 0,
+                f"table.{name} dtype {arr.dtype} != schema {dtype}",
+                name))
+        if arr.ndim != rank:
+            findings.append(Finding(
+                "XCHK301", _REL, 0,
+                f"table.{name} rank {arr.ndim} != schema {rank}", name))
+
+    # bit algebra: flags and report bits each distinct powers of two
+    for label, bits in (("FLAG_BITS", C.FLAG_BITS),
+                        ("REPORT_BITS", C.REPORT_BITS)):
+        seen = 0
+        for bname, val in bits.items():
+            if val <= 0 or val & (val - 1):
+                findings.append(Finding(
+                    "XCHK301", _REL, 0,
+                    f"{label}.{bname} = {val} is not a power of two",
+                    bname))
+            if seen & val:
+                findings.append(Finding(
+                    "XCHK301", _REL, 0,
+                    f"{label}.{bname} overlaps another bit", bname))
+            seen |= val
+    if len(table) and int(np.bitwise_or.reduce(table.flags)) \
+            & ~C.FLAG_MASK:
+        findings.append(Finding(
+            "XCHK301", _REL, 0,
+            "build_table emitted flag bits outside constants.FLAG_MASK",
+            "flags"))
+
+    # the join's report dtype under the schema's dtypes
+    import jax
+    from ..ops.join import pair_join
+    K = table.lo_tok.shape[1]
+    S = jax.ShapeDtypeStruct
+    i32 = np.dtype("int32")
+    closed = jax.make_jaxpr(pair_join)(
+        S((4, K), i32), S((4, K), i32), S((4,), i32), S((2, K), i32),
+        S((8,), i32), S((8,), i32), S((8,), np.dtype(bool)))
+    out = [str(v.aval.dtype) for v in closed.jaxpr.outvars]
+    if out != [C.REPORT_DTYPE]:
+        findings.append(Finding(
+            "XCHK301", _REL, 0,
+            f"pair_join report dtype {out} != constants.REPORT_DTYPE "
+            f"'{C.REPORT_DTYPE}'", "report"))
+
+    # import edge: both sides must import ops.constants
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in (os.path.join("ops", "join.py"),
+                os.path.join("db", "table.py")):
+        path = os.path.join(pkg_root, rel)
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        imports_constants = any(
+            (isinstance(n, ast.ImportFrom)
+             and (n.module or "").endswith("constants"))
+            or (isinstance(n, ast.ImportFrom)
+                and any(a.name == "constants" for a in n.names))
+            or (isinstance(n, ast.Import)
+                and any(a.name.endswith("constants") for a in n.names))
+            for n in ast.walk(tree))
+        if not imports_constants:
+            findings.append(Finding(
+                "XCHK301", os.path.join("trivy_tpu", rel), 0,
+                "module does not import trivy_tpu.ops.constants — the "
+                "flag contract is not single-sourced", rel))
+    return findings
+
+
+def run() -> list[Finding]:
+    from .registry import rules_for_engine
+    findings: list[Finding] = []
+    for rule in rules_for_engine("xcheck"):
+        findings.extend(rule.func())
+    return findings

@@ -1,0 +1,96 @@
+"""Rule registry and the Finding model shared by every engine.
+
+A rule is a named, documented check. AST rules receive one parsed
+module at a time (`ModuleInfo` from astlint) and yield findings; jaxpr
+and cross-check rules run once per invocation. Registration is by
+decorator so adding a rule is: write a function, decorate it, done —
+`python -m trivy_tpu.analysis --list-rules` picks it up from here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str       # rule id, e.g. "TPU101"
+    path: str       # repo-relative path ("" for trace-level findings)
+    line: int       # 1-based; 0 when not anchored to a line
+    message: str
+    context: str = ""   # enclosing function/class (stable across edits)
+
+    def fingerprint(self) -> str:
+        """Line-independent identity used by --baseline suppression:
+        a moved-but-unchanged finding stays suppressed, a new or
+        reworded one does not."""
+        raw = "|".join((self.rule, self.path, self.context, self.message))
+        return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.path else "<trace>"
+        return f"{loc}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message, "context": self.context,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    engine: str            # "ast" | "jaxpr" | "xcheck"
+    doc: str
+    func: Callable = field(compare=False)
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register(rule_id: str, name: str, engine: str):
+    """Decorator: register `func` as rule `rule_id`. The function's
+    docstring becomes the rule's documentation."""
+    def wrap(func):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = Rule(rule_id, name, engine,
+                              (func.__doc__ or "").strip(), func)
+        return func
+    return wrap
+
+
+def rules_for_engine(engine: str) -> list[Rule]:
+    return [r for r in RULES.values() if r.engine == engine]
+
+
+def load_baseline(path: str) -> set[str]:
+    """A baseline file is JSON: {"suppressions": [{"fingerprint": ...,
+    "reason": ...}, ...]}. Only the fingerprints matter to the gate;
+    the reason field forces suppressions to be explicit in review."""
+    import json
+    with open(path) as f:
+        data = json.load(f)
+    out = set()
+    for entry in data.get("suppressions", []):
+        fp = entry.get("fingerprint")
+        if not fp or not entry.get("reason"):
+            raise ValueError(
+                "baseline entries need both 'fingerprint' and 'reason'")
+        out.add(fp)
+    return out
+
+
+def apply_baseline(findings: Iterable[Finding],
+                   suppressed: set[str]) -> tuple[list[Finding],
+                                                  list[Finding]]:
+    """→ (active, suppressed_hits)."""
+    active, hits = [], []
+    for f in findings:
+        (hits if f.fingerprint() in suppressed else active).append(f)
+    return active, hits
