@@ -1,0 +1,108 @@
+"""Property-style tests for ops/compare.py — the lexicographic
+primitives the join's interval predicate (and therefore the jaxpr
+dtype contracts) are built on.
+
+The reference model is Python tuple comparison over the same int
+sequences; the device functions must agree on padded token vectors,
+including the cases the encoding actually produces: equal prefixes of
+different effective length, zero-padding ties, and a single-token
+difference at the last position."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from trivy_tpu.ops.compare import lex_eq, lex_leq, lex_less
+
+K = 8
+
+
+def _vec(*toks):
+    out = np.zeros(K, dtype=np.int32)
+    out[:len(toks)] = toks
+    return out
+
+
+def _ref_less(a, b):
+    return tuple(a.tolist()) < tuple(b.tolist())
+
+
+CASES = [
+    # equal prefixes, one continues (padding is part of the order)
+    (_vec(1, 2, 3), _vec(1, 2, 3, 1)),
+    # zero-padding tie: identical after padding
+    (_vec(5, 0, 0), _vec(5)),
+    # single-token difference at the LAST position
+    (_vec(9, 9, 9, 9, 9, 9, 9, 1), _vec(9, 9, 9, 9, 9, 9, 9, 2)),
+    # difference at the first position dominates everything after
+    (_vec(1, 100, 100), _vec(2, -100, -100)),
+    # negative zones (gem alpha segments sort below numeric zero)
+    (_vec(-3, 1), _vec(-3, 2)),
+    (_vec(-3, 1), _vec(0)),
+    # full-width identical
+    (_vec(*range(1, K + 1)), _vec(*range(1, K + 1))),
+]
+
+
+@pytest.mark.parametrize("a,b", CASES)
+def test_pairwise_matches_tuple_order(a, b):
+    for x, y in ((a, b), (b, a)):
+        assert bool(lex_less(x, y)) == _ref_less(x, y)
+        assert bool(lex_eq(x, y)) == (tuple(x) == tuple(y))
+        assert bool(lex_leq(x, y)) == (tuple(x.tolist())
+                                       <= tuple(y.tolist()))
+
+
+def test_property_random_vectors_agree_with_tuple_order():
+    rng = np.random.default_rng(20260803)
+    # small token alphabet forces many shared prefixes and exact ties
+    mats = rng.integers(-2, 3, size=(2, 400, K)).astype(np.int32)
+    a, b = mats
+    # force a block of exact ties and a block of last-token-only diffs
+    a[:50] = b[:50]
+    a[50:90] = b[50:90]
+    a[50:90, K - 1] = b[50:90, K - 1] + 1
+    less = np.asarray(lex_less(a, b))
+    eq = np.asarray(lex_eq(a, b))
+    leq = np.asarray(lex_leq(a, b))
+    for i in range(a.shape[0]):
+        ta, tb = tuple(a[i].tolist()), tuple(b[i].tolist())
+        assert bool(less[i]) == (ta < tb), (ta, tb)
+        assert bool(eq[i]) == (ta == tb), (ta, tb)
+        assert bool(leq[i]) == (ta <= tb), (ta, tb)
+
+
+def test_trichotomy_and_consistency():
+    rng = np.random.default_rng(7)
+    a = rng.integers(-2, 3, size=(200, K)).astype(np.int32)
+    b = rng.integers(-2, 3, size=(200, K)).astype(np.int32)
+    less = np.asarray(lex_less(a, b))
+    more = np.asarray(lex_less(b, a))
+    eq = np.asarray(lex_eq(a, b))
+    leq = np.asarray(lex_leq(a, b))
+    # exactly one of <, >, == holds
+    assert np.all(less.astype(int) + more.astype(int)
+                  + eq.astype(int) == 1)
+    # <= is the complement of >
+    assert np.all(leq == ~more)
+
+
+def test_dtype_contract():
+    """The jaxpr contracts depend on this exact dtype behavior: int32
+    in, bool out, with the only converts being the bool→int32 cumsum
+    carrier inside lex_less/lex_leq."""
+    a = jnp.asarray(_vec(1, 2))
+    b = jnp.asarray(_vec(1, 3))
+    assert a.dtype == jnp.int32
+    for fn in (lex_less, lex_eq, lex_leq):
+        out = fn(a, b)
+        assert out.dtype == jnp.bool_
+        assert out.shape == ()
+
+
+def test_batched_shapes():
+    a = np.zeros((4, 5, K), np.int32)
+    b = np.ones((4, 5, K), np.int32)
+    assert np.asarray(lex_less(a, b)).shape == (4, 5)
+    assert np.asarray(lex_eq(a, a)).all()

@@ -1,0 +1,457 @@
+"""Tier-1 gate for graftlint (trivy_tpu/analysis): the tree must be
+clean, seeded violations must be caught with file:line findings, the
+jaxpr contracts must hold, and the baseline mechanism must suppress
+only what it is explicitly told to."""
+
+import json
+import os
+import sys
+
+from trivy_tpu import analysis
+from trivy_tpu.analysis import astlint, crosscheck, jaxpr_check
+from trivy_tpu.analysis.__main__ import main as cli_main
+from trivy_tpu.analysis.registry import (
+    RULES, apply_baseline, load_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the tree is clean (the actual CI gate)
+
+def test_tree_is_clean():
+    findings = analysis.run_all()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_main_clean_output(tmp_path, capsys):
+    """Clean-path CLI formatting/exit code, against a tiny clean tree
+    (the full three-engine clean sweep is covered once by
+    test_tree_is_clean and end-to-end by the subprocess test)."""
+    pkg = tmp_path / "cleanpkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("X = 1\n")
+    assert cli_main(["--root", str(pkg), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["findings"] == [] and out["suppressed"] == []
+
+
+# ---------------------------------------------------------------------------
+# engine 1: seeded violations on fixture snippets
+
+def _lint(path, src):
+    return astlint.lint_source(path, src)
+
+
+def test_host_sync_in_core_detected():
+    src = (
+        "import jax\n"
+        "def _pair_core(x, y):\n"
+        "    n = int(x[0])\n"
+        "    return x.item() + n\n"
+        "pair = jax.jit(_pair_core)\n"
+    )
+    fs = _lint("trivy_tpu/ops/fixture.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU101", 3),
+                                             ("TPU101", 4)]
+    # findings carry file:line for CI output
+    assert fs[0].render().startswith("trivy_tpu/ops/fixture.py:3:")
+
+
+def test_shape_access_is_not_a_host_sync():
+    src = (
+        "import jax\n"
+        "def _ok_core(x, t_pad: int):\n"
+        "    n = int(x.shape[0])\n"
+        "    m = len(x)\n"
+        "    return x[:t_pad]\n"
+        "j = jax.jit(_ok_core, static_argnums=(1,))\n"
+    )
+    assert _lint("trivy_tpu/ops/fixture.py", src) == []
+
+
+def test_numpy_call_in_device_code_detected():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "def _np_core(x):\n"
+        "    return np.sum(x)\n"
+        "j = jax.jit(_np_core)\n"
+    )
+    fs = _lint("trivy_tpu/ops/fixture.py", src)
+    assert [f.rule for f in fs] == ["TPU101"]
+    assert "np.sum" in fs[0].message
+
+
+def test_data_dependent_control_flow_detected():
+    src = (
+        "import jax\n"
+        "def _branch_core(x, t_pad: int):\n"
+        "    if x[0] > 0:\n"
+        "        return x\n"
+        "    for v in x:\n"
+        "        pass\n"
+        "    if t_pad > 4:\n"          # static: not flagged
+        "        return x\n"
+        "    return x\n"
+        "j = jax.jit(_branch_core, static_argnums=(1,))\n"
+    )
+    fs = _lint("trivy_tpu/ops/fixture.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU102", 3),
+                                             ("TPU102", 5)]
+
+
+def test_flag_constant_drift_detected():
+    # the acceptance-criteria case: a drifted copy of a flag bit in
+    # db/table.py must produce a finding
+    src = "HAS_LO = 2\nNEEDS_RECHECK = 8\nUNRELATED = 7\n"
+    fs = _lint("trivy_tpu/db/table.py", src)
+    assert [f.rule for f in fs] == ["TPU103", "TPU103"]
+    assert "HAS_LO" in fs[0].message
+
+
+def test_flag_drift_via_tuple_unpack_detected():
+    src = "SATISFIED, NEEDS_RECHECK = 1, 2\n"
+    fs = _lint("trivy_tpu/db/table.py", src)
+    assert sorted(f.context for f in fs) == ["NEEDS_RECHECK",
+                                            "SATISFIED"]
+    assert {f.rule for f in fs} == {"TPU103"}
+
+
+def test_constants_module_itself_is_exempt():
+    src = "HAS_LO = 1\n"
+    assert _lint("trivy_tpu/ops/constants.py", src) == []
+
+
+def test_static_argument_hygiene():
+    src = (
+        "import functools, jax\n"
+        "@functools.partial(jax.jit, static_argnames=('cfg',))\n"
+        "def f(x, cfg):\n"
+        "    return x\n"
+    )
+    fs = _lint("trivy_tpu/ops/fixture.py", src)
+    assert [f.rule for f in fs] == ["TPU104"]
+
+    src_ok = src.replace("cfg):", "cfg: int):")
+    assert _lint("trivy_tpu/ops/fixture.py", src_ok) == []
+
+    src_nonlit = (
+        "import jax\n"
+        "S = (1,)\n"
+        "def g(x, t):\n"
+        "    return x\n"
+        "j = jax.jit(g, static_argnums=S)\n"
+    )
+    fs = _lint("trivy_tpu/ops/fixture.py", src_nonlit)
+    assert [f.rule for f in fs] == ["TPU104"]
+    assert "literal" in fs[0].message
+
+
+def test_debug_in_device_code_detected():
+    src = (
+        "import jax\n"
+        "def _dbg_core(x):\n"
+        "    jax.debug.print('x={}', x)\n"
+        "    return x\n"
+    )
+    fs = _lint("trivy_tpu/ops/fixture.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU105", 3)]
+
+
+def test_pallas_kernel_is_device_code():
+    src = (
+        "from jax.experimental import pallas as pl\n"
+        "def my_kern(x_ref, o_ref):\n"
+        "    print('trace')\n"
+        "def launch(x):\n"
+        "    return pl.pallas_call(my_kern, grid=(1,))(x)\n"
+    )
+    fs = _lint("trivy_tpu/ops/fixture.py", src)
+    assert [f.rule for f in fs] == ["TPU105"]
+
+
+def test_lock_hygiene_detected_including_alias():
+    src = (
+        "import threading\n"
+        "class Reg:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._vals = {}\n"
+        "    def bad(self, k):\n"
+        "        self._vals[k] = 1\n"
+        "    def bad_alias(self, k):\n"
+        "        v = self._vals\n"
+        "        v.update({k: 2})\n"
+        "    def good(self, k):\n"
+        "        with self._lock:\n"
+        "            self._vals[k] = 3\n"
+    )
+    fs = _lint("trivy_tpu/server/fixture.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7),
+                                             ("TPU106", 10)]
+    # out of the scoped modules: same class, no finding
+    assert _lint("trivy_tpu/iac/fixture.py", src) == []
+
+
+def test_lock_hygiene_catches_value_position_mutators():
+    src = (
+        "import threading\n"
+        "class Srv:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._vals = {}\n"
+        "    def consumed(self, k):\n"
+        "        return self._vals.pop(k)\n"      # mutator in a return
+        "    def in_test(self, k):\n"
+        "        if self._vals.pop(k):\n"         # mutator in a branch
+        "            return 1\n"
+        "    def nested(self, k):\n"
+        "        def helper():\n"
+        "            self._vals[k] = 1\n"         # closure, outside lock
+        "        return helper\n"
+    )
+    fs = _lint("trivy_tpu/server/fixture.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7),
+                                             ("TPU106", 9),
+                                             ("TPU106", 13)]
+
+
+def test_seeded_violation_in_real_pair_core():
+    """The acceptance-criteria demo: an int() on a traced value seeded
+    into the REAL _pair_core source produces a file:line finding."""
+    with open(os.path.join(REPO, "trivy_tpu", "ops", "join.py")) as f:
+        src = f.read()
+    marker = "    flags = adv_flags[pair_row]"
+    assert marker in src
+    seeded = src.replace(
+        marker, "    bad = int(adv_flags[0])\n" + marker)
+    fs = _lint("trivy_tpu/ops/join.py", seeded)
+    assert [f.rule for f in fs] == ["TPU101"]
+    assert fs[0].context == "_pair_core"
+    assert fs[0].line == seeded[:seeded.index("bad = int")].count("\n") + 1
+
+
+# ---------------------------------------------------------------------------
+# engine 2: jaxpr contracts
+
+def _contract(name):
+    with open(os.path.join(REPO, "trivy_tpu", "analysis", "contracts",
+                           name)) as f:
+        return json.load(f)
+
+
+def test_contracts_hold_on_tree():
+    assert jaxpr_check.run() == []
+
+
+def test_primitive_budget_catches_unroll():
+    c = _contract("csr_pair_join.json")
+    c["max_primitives"] = 1
+    c.pop("golden", None)
+    fs = jaxpr_check.check_contract("csr_pair_join.json", c)
+    assert [f.rule for f in fs] == ["JAX204"]
+
+
+def test_unexpected_convert_is_a_finding():
+    c = _contract("pair_join.json")
+    c["allowed_converts"] = [["bool", "int32"]]  # drop the int8 packing
+    fs = jaxpr_check.check_contract("pair_join.json", c)
+    assert {f.rule for f in fs} == {"JAX202"}
+    assert any("bool→int8" in f.message for f in fs)
+
+
+def test_output_dtype_drift_is_a_finding():
+    c = _contract("pair_join.json")
+    c["out_dtypes"] = ["int32"]
+    fs = jaxpr_check.check_contract("pair_join.json", c)
+    assert [f.rule for f in fs] == ["JAX201"]
+
+
+def test_trace_failure_is_reported_not_raised():
+    c = _contract("pair_join.json")
+    c["args"] = c["args"][:2]  # wrong arity
+    fs = jaxpr_check.check_contract("pair_join.json", c)
+    assert [f.rule for f in fs] == ["JAX205"]
+
+
+def test_golden_jaxpr_diff_detected(tmp_path, monkeypatch):
+    src_dir = os.path.join(REPO, "trivy_tpu", "analysis", "contracts")
+    golden = tmp_path / "csr_pair_join.jaxpr.txt"
+    with open(os.path.join(src_dir, "csr_pair_join.jaxpr.txt")) as f:
+        lines = f.read().splitlines()
+    lines[5] = lines[5] + "  # drifted"
+    golden.write_text("\n".join(lines) + "\n")
+    c = _contract("csr_pair_join.json")
+    monkeypatch.setattr(jaxpr_check, "CONTRACTS_DIR", str(tmp_path))
+    fs = jaxpr_check.check_contract("csr_pair_join.json", c)
+    assert [f.rule for f in fs] == ["JAX206"]
+    assert fs[0].line == 6
+
+
+def test_iter_eqns_sees_inside_cond_branches():
+    """The host-callback ban must see through lax.cond: its sub-jaxprs
+    live in a tuple param ('branches'), not a bare ClosedJaxpr."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return jax.lax.cond(x[0] > 0,
+                            lambda v: jnp.sum(v).astype(jnp.float32),
+                            lambda v: jnp.float32(0.0), x)
+
+    closed = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((4,), jnp.int32))
+    prims = {e.primitive.name for e in jaxpr_check._iter_eqns(
+        closed.jaxpr)}
+    assert "cond" in prims
+    # reduce_sum only exists inside the true branch
+    assert "reduce_sum" in prims
+
+
+def test_golden_snapshots_are_current():
+    """The checked-in pretty-printed jaxprs match the live lowering —
+    a hot-path change must regenerate them (and show up in review)."""
+    for name in ("csr_pair_join.json", "prefilter_pallas.json"):
+        c = _contract(name)
+        closed = jaxpr_check.trace_contract(c)
+        text = jaxpr_check.normalize_jaxpr_text(str(closed))
+        with open(os.path.join(REPO, "trivy_tpu", "analysis",
+                               "contracts", c["golden"])) as f:
+            assert f.read() == text, (
+                f"{c['golden']} is stale: run "
+                f"python -m trivy_tpu.analysis --update-goldens")
+
+
+# ---------------------------------------------------------------------------
+# cross-checker
+
+def test_crosscheck_clean():
+    assert crosscheck.run() == []
+
+
+def test_crosscheck_catches_report_bit_overlap(monkeypatch):
+    from trivy_tpu.ops import constants as C
+    monkeypatch.setattr(C, "REPORT_BITS",
+                        {"SATISFIED": 1, "NEEDS_RECHECK": 1})
+    fs = crosscheck.check_schema()
+    assert any("overlaps" in f.message for f in fs)
+
+
+def test_crosscheck_catches_schema_drift(monkeypatch):
+    from trivy_tpu.ops import constants as C
+    drifted = dict(C.TABLE_SCHEMA, flags=("int8", 1))
+    monkeypatch.setattr(C, "TABLE_SCHEMA", drifted)
+    fs = crosscheck.check_schema()
+    assert any("table.flags dtype" in f.message for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, --json, --baseline
+
+def _seed_bad_tree(tmp_path):
+    pkg = tmp_path / "badpkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import jax\n"
+        "def _bad_core(x):\n"
+        "    return int(x[0])\n"
+        "j = jax.jit(_bad_core)\n"
+    )
+    return str(pkg)
+
+
+def test_cli_nonzero_on_findings(tmp_path, capsys):
+    root = _seed_bad_tree(tmp_path)
+    assert cli_main(["--root", root]) == 1
+    out = capsys.readouterr().out
+    assert "TPU101" in out and "mod.py:3" in out
+
+
+def test_cli_json_output(tmp_path, capsys):
+    root = _seed_bad_tree(tmp_path)
+    assert cli_main(["--root", root, "--json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["findings"][0]["rule"] == "TPU101"
+    assert data["findings"][0]["line"] == 3
+    assert data["findings"][0]["fingerprint"]
+
+
+def test_cli_baseline_suppresses_explicitly(tmp_path, capsys):
+    root = _seed_bad_tree(tmp_path)
+    cli_main(["--root", root, "--json"])
+    fp = json.loads(capsys.readouterr().out)["findings"][0]["fingerprint"]
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"suppressions": [
+        {"fingerprint": fp, "reason": "known: fixture for the docs"},
+    ]}))
+    assert cli_main(["--root", root, "--baseline", str(baseline)]) == 0
+    out = capsys.readouterr().out
+    assert "suppressed" in out
+
+    # a reason is mandatory — silent suppression is rejected
+    baseline.write_text(json.dumps({"suppressions": [
+        {"fingerprint": fp},
+    ]}))
+    assert cli_main(["--root", root,
+                     "--baseline", str(baseline)]) == 2
+
+
+def test_baseline_fingerprint_is_line_independent(tmp_path):
+    root = _seed_bad_tree(tmp_path)
+    f1 = astlint.run(root)[0]
+    # same finding, shifted by a comment line above
+    (tmp_path / "badpkg" / "mod.py").write_text(
+        "# moved\nimport jax\n"
+        "def _bad_core(x):\n"
+        "    return int(x[0])\n"
+        "j = jax.jit(_bad_core)\n"
+    )
+    f2 = astlint.run(root)[0]
+    assert f1.line != f2.line
+    assert f1.fingerprint() == f2.fingerprint()
+    active, hits = apply_baseline([f2], {f1.fingerprint()})
+    assert active == [] and len(hits) == 1
+
+
+def test_list_rules_covers_all_engines(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("TPU101", "TPU102", "TPU103", "TPU104", "TPU105",
+                "TPU106", "JAX201", "JAX204", "JAX206", "XCHK301"):
+        assert rid in out
+    assert set(RULES) >= {"TPU101", "XCHK301"}
+
+
+def test_cli_subprocess_end_to_end(tmp_path):
+    """The real `python -m trivy_tpu.analysis --json` invocation —
+    the tier-1 registration of the CLI gate (pays one fresh jax
+    import, ~8s, within the <10s tier-1 budget)."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.analysis", "--json"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["findings"] == []
+
+
+def test_list_rules_in_fresh_process():
+    """The registry must populate on package import — a fresh
+    `--list-rules` process (no prior engine imports) sees every rule.
+    Cheap: this path never imports jax."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for rid in ("TPU100", "TPU106", "JAX201", "XCHK301"):
+        assert rid in proc.stdout
+
+
+def test_load_baseline_roundtrip(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"suppressions": [
+        {"fingerprint": "abc123", "reason": "r"}]}))
+    assert load_baseline(str(p)) == {"abc123"}
