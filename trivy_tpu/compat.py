@@ -1,0 +1,13 @@
+"""Stdlib compatibility shims.
+
+tomllib landed in the stdlib in Python 3.11; on 3.10 the identical
+library is available as `tomli` (tomllib IS tomli, vendored). Import
+it from here so every TOML call site works on both.
+"""
+
+from __future__ import annotations
+
+try:
+    import tomllib  # noqa: F401  (re-export)
+except ModuleNotFoundError:  # Python < 3.11
+    import tomli as tomllib  # noqa: F401
