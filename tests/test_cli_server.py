@@ -143,7 +143,13 @@ class TestServer:
 
     def test_healthz_version(self, server):
         import urllib.request
-        assert urllib.request.urlopen(server + "/healthz").read() == b"ok"
+        # probes that ask for text/plain keep the byte-exact fast path
+        req = urllib.request.Request(server + "/healthz",
+                                     headers={"Accept": "text/plain"})
+        assert urllib.request.urlopen(req).read() == b"ok"
+        # default is the device-backend status as JSON (graftscope)
+        h = json.loads(urllib.request.urlopen(server + "/healthz").read())
+        assert h["status"] == "ok" and "device" in h
         v = json.loads(urllib.request.urlopen(server + "/version").read())
         assert "Version" in v
 
@@ -232,6 +238,11 @@ class TestMetrics:
 
             body = urllib.request.urlopen(base + "/metrics").read().decode()
             assert "# TYPE trivy_tpu_scans_total counter" in body
+            # tier-1 gate: the live payload must survive the strict
+            # exposition parser (tests/helpers.py) — a malformed series
+            # fails here, not in the production scraper
+            from helpers import parse_exposition
+            parse_exposition(body)
             import re as _re
 
             def val(name):

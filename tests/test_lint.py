@@ -218,6 +218,61 @@ def test_lock_hygiene_catches_value_position_mutators():
                                              ("TPU106", 13)]
 
 
+def test_instrumentation_in_device_code_detected():
+    src = (
+        "import time, jax\n"
+        "from trivy_tpu.metrics import METRICS\n"
+        "from trivy_tpu.obs import span\n"
+        "def _timed_core(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    with span('detect.inner'):\n"
+        "        y = x + 1\n"
+        "    METRICS.inc('trivy_tpu_oops_total')\n"
+        "    METRICS.observe('trivy_tpu_oops_seconds',\n"
+        "                    time.perf_counter() - t0)\n"
+        "    return y\n"
+        "j = jax.jit(_timed_core)\n"
+    )
+    fs = _lint("trivy_tpu/ops/fixture.py", src)
+    assert all(f.rule == "TPU107" for f in fs)
+    # perf_counter x2, span entry, METRICS.inc, METRICS.observe
+    assert [f.line for f in fs] == [5, 6, 8, 9, 10]
+    assert all(f.context == "_timed_core" for f in fs)
+
+
+def test_instrumentation_on_host_side_is_fine():
+    src = (
+        "import time, jax\n"
+        "from trivy_tpu.metrics import METRICS\n"
+        "from trivy_tpu.obs import span\n"
+        "def _ok_core(x):\n"
+        "    return x + 1\n"
+        "j = jax.jit(_ok_core)\n"
+        "def host_wrapper(x):\n"         # host orchestration: allowed
+        "    t0 = time.perf_counter()\n"
+        "    with span('detect.dispatch'):\n"
+        "        y = j(x)\n"
+        "    METRICS.observe('trivy_tpu_x_seconds',\n"
+        "                    time.perf_counter() - t0)\n"
+        "    return y\n"
+    )
+    assert _lint("trivy_tpu/ops/fixture.py", src) == []
+
+
+def test_regex_match_span_is_not_a_trace_span():
+    # m.span() (re.Match) in device code must not trip the span ban;
+    # it is caught by nothing here (host-ish API, but not TPU107's
+    # target) — the rule keys on the bare/obs-qualified name only
+    src = (
+        "import jax\n"
+        "def _m_core(x, m: tuple):\n"
+        "    s, e = m\n"
+        "    return x[s:e]\n"
+        "j = jax.jit(_m_core, static_argnums=(1,))\n"
+    )
+    assert _lint("trivy_tpu/ops/fixture.py", src) == []
+
+
 def test_seeded_violation_in_real_pair_core():
     """The acceptance-criteria demo: an int() on a traced value seeded
     into the REAL _pair_core source produces a file:line finding."""

@@ -48,6 +48,7 @@ _MUTATORS = {
 _LOCK_SCOPE = (
     os.path.join("trivy_tpu", "server") + os.sep,
     os.path.join("trivy_tpu", "metrics.py"),
+    os.path.join("trivy_tpu", "obs") + os.sep,
     os.path.join("trivy_tpu", "detect", "engine.py"),
     os.path.join("trivy_tpu", "parallel", "multihost.py"),
 )
@@ -455,6 +456,42 @@ def rule_debug(info: ModuleInfo):
                 yield Finding(
                     "TPU105", info.relpath, node.lineno,
                     f"{fname}() left in device code", _ctx(dev))
+
+
+@register("TPU107", "instrumentation-in-device-code", "ast")
+def rule_instrumentation(info: ModuleInfo):
+    """Observability belongs to the host orchestration layer. Inside
+    jitted cores and pallas kernels, clock reads (`time.perf_counter()`
+    and friends), graftscope span entry (`span(...)` / `obs.span` /
+    `trace.span`), and `METRICS.<anything>()` calls are forbidden:
+    under jit tracing they run ONCE at trace time — timing the trace
+    and counting compilations, not executions — and silently vanish
+    from the compiled program, so the instrumentation lies."""
+    clock_names = {"perf_counter", "process_time", "monotonic", "time",
+                   "perf_counter_ns", "monotonic_ns", "time_ns"}
+    for dev in info.device_fns:
+        for node, _traced in _device_walk(dev):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            head, _, tail = fname.rpartition(".")
+            if head == "time" and tail in clock_names:
+                yield Finding(
+                    "TPU107", info.relpath, node.lineno,
+                    f"{fname}() in device code measures trace time, "
+                    f"not device time", _ctx(dev))
+            elif fname in ("span", "obs.span", "trace.span"):
+                yield Finding(
+                    "TPU107", info.relpath, node.lineno,
+                    f"{fname}() span entered inside device code "
+                    f"(instrument the host call site instead)",
+                    _ctx(dev))
+            elif head in ("METRICS", "metrics.METRICS") and tail:
+                yield Finding(
+                    "TPU107", info.relpath, node.lineno,
+                    f"{fname}() inside device code runs once at trace "
+                    f"time — move it to the host orchestration",
+                    _ctx(dev))
 
 
 @register("TPU106", "lock-hygiene", "ast")

@@ -62,9 +62,14 @@ def _add_scan_flags(p: argparse.ArgumentParser):
                    help="glob of files to skip (repeatable)")
     p.add_argument("--skip-dirs", action="append", default=[],
                    help="glob of directories to skip (repeatable)")
-    p.add_argument("--trace", action="store_true",
+    p.add_argument("--trace", default="", metavar="FILE",
+                   help="write a graftscope Chrome trace-event JSON of "
+                        "the scan pipeline (walker, host prep, device "
+                        "dispatch/wait, assembly) to FILE; open in "
+                        "Perfetto or chrome://tracing")
+    p.add_argument("--rego-trace", action="store_true",
                    help="print rego rule-evaluation traces to stderr "
-                        "(reference --trace)")
+                        "(the reference's --trace)")
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace of the scan to "
                         "this directory (TensorBoard format)")
@@ -175,6 +180,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--token", default="")
     p.add_argument("--cache-backend", default="fs",
                    help="fs | redis://host:port[/db]")
+    p.add_argument("--trace", default="", metavar="FILE",
+                   help="record graftscope spans for the server's "
+                        "lifetime; dump Chrome trace-event JSON to "
+                        "FILE on shutdown")
 
     p = sub.add_parser("k8s", aliases=["kubernetes"],
                        help="scan a kubernetes cluster")
@@ -418,7 +427,7 @@ def _configure_javadb(args) -> None:
 def _configure_misconf(args) -> None:
     """Install user rego checks before analysis runs (reference wires
     PolicyPaths through misconf.ScannerOption at initScannerConfig)."""
-    if getattr(args, "trace", False):
+    if getattr(args, "rego_trace", False):
         from .iac.rego import set_rego_trace
 
         def _sink(event, rule_path, depth):
@@ -820,7 +829,8 @@ def cmd_server(args) -> int:
     host, _, port = args.listen.rpartition(":")
     serve(host or "0.0.0.0", int(port), table, cache_dir=args.cache_dir,
           token=args.token,
-          cache_backend=getattr(args, "cache_backend", "fs"))
+          cache_backend=getattr(args, "cache_backend", "fs"),
+          trace_path=getattr(args, "trace", ""))
     return 0
 
 
@@ -1058,6 +1068,26 @@ def main(argv=None) -> int:
     if args.command not in ("version", "plugin", "module"):
         from .module import load_modules
         load_modules()
+    # graftscope pipeline tracing: recording must start BEFORE the
+    # command runs so artifact inspection (the fanal walker) is in the
+    # trace, not just the scan phase; the server command manages its
+    # own recording lifetime in serve()
+    trace_path = getattr(args, "trace", "") \
+        if args.command != "server" else ""
+    if trace_path:
+        from .obs import COLLECTOR, write_chrome_trace
+        COLLECTOR.enable()
+        try:
+            return _run_command(args)
+        finally:
+            COLLECTOR.disable()
+            write_chrome_trace(trace_path)
+            print(f"graftscope trace written to {trace_path}",
+                  file=sys.stderr)
+    return _run_command(args)
+
+
+def _run_command(args) -> int:
     cmd = args.command
     if cmd == "version":
         print(f"trivy-tpu {__version__}")

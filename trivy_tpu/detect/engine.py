@@ -20,6 +20,7 @@ The reference evaluates the same predicate one package at a time
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, NamedTuple, Optional
 
@@ -27,6 +28,8 @@ import numpy as np
 
 from .. import version as V
 from ..db.table import AdvisoryTable
+from ..metrics import METRICS
+from ..obs import note_dispatch, recording, span
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
 
@@ -182,6 +185,20 @@ class BatchDetector:
     # ---- batch pipeline -----------------------------------------------
 
     def _prepare(self, queries: list[PkgQuery]) -> Optional[_Prepared]:
+        """Instrumented shell around _prepare_impl: one graftscope span
+        per batch, plus the batch-occupancy histogram (real pairs ÷
+        padded dispatch rows — the padding-waste signal)."""
+        with span("detect.prepare", queries=len(queries)) as sp:
+            prep = self._prepare_impl(queries)
+            if prep is not None and prep.n_pairs:
+                t_pad = int(prep.pair_row.shape[0])
+                sp.attrs.update(n_pairs=prep.n_pairs, t_pad=t_pad,
+                                pad_waste=t_pad - prep.n_pairs)
+                METRICS.observe("trivy_tpu_batch_occupancy_ratio",
+                                prep.n_pairs / t_pad)
+            return prep
+
+    def _prepare_impl(self, queries: list[PkgQuery]) -> Optional[_Prepared]:
         t = self.table
         usable: list[tuple[PkgQuery, bool]] = []
         ver_rows: list[int] = []
@@ -240,6 +257,15 @@ class BatchDetector:
                          q_start=q_start, q_count=q_count, q_ver=q_ver)
 
     def _dispatch(self, prep: _Prepared):
+        """Instrumented shell around _dispatch_impl: spans the (async)
+        launch and stamps the backend view /healthz serves."""
+        with span("detect.dispatch", n_pairs=prep.n_pairs,
+                  t_pad=int(prep.pair_row.shape[0])):
+            out = self._dispatch_impl(prep)
+        note_dispatch()
+        return out
+
+    def _dispatch_impl(self, prep: _Prepared):
         """Launch the pair join; returns the device array (async).
 
         Ships only the [Q]-sized CSR descriptors; the device expands
@@ -266,22 +292,30 @@ class BatchDetector:
         is pulled back, overlapping host prep, device compute, and
         transfers (replaces the reference's worker-pool overlap,
         pkg/parallel/pipeline.go)."""
-        import time
-
-        from ..metrics import METRICS
         if len(self.table) == 0:
             return [[] for _ in batches]
         prepped = [self._prepare(qs) if qs else None for qs in batches]
         futures = [None if p is None or p.n_pairs == 0
                    else self._dispatch(p) for p in prepped]
-        METRICS.inc("trivy_tpu_detect_batches_total",
-                    sum(1 for f in futures if f is not None))
+        n_active = sum(1 for f in futures if f is not None)
+        METRICS.inc("trivy_tpu_detect_batches_total", n_active)
         METRICS.inc("trivy_tpu_detect_queries_total",
                     sum(len(qs) for qs in batches))
         METRICS.inc("trivy_tpu_detect_pairs_total",
                     sum(p.n_pairs for p in prepped if p is not None))
         import jax
+        if recording() and n_active:
+            # tracing-only fence: block until every dispatched join has
+            # executed, so XLA compile+execute lands in THIS span and
+            # the device-wait spans below read as pure result transfer.
+            # Skipped when not tracing — the fence would serialize the
+            # dispatch/transfer overlap the pipeline exists for.
+            with span("detect.device_fence", batches=n_active):
+                jax.block_until_ready(
+                    [f for f in futures if f is not None])
         t0 = time.perf_counter()
+        METRICS.gauge_add("trivy_tpu_dispatch_depth", float(n_active))
+        in_flight = n_active
         # device_get, not np.asarray: asarray falls into the generic
         # __array__ element path on accelerator arrays (~500x slower
         # for the 512KB bit vectors); device_get is one memcpy.
@@ -293,9 +327,27 @@ class BatchDetector:
         get_futs = [None if fut is None
                     else self._get_pool.submit(jax.device_get, fut)
                     for fut in futures]
-        out = [[] if gf is None
-               else self._assemble(prep, gf.result())
-               for prep, gf in zip(prepped, get_futs)]
+        out = []
+        try:
+            for prep, gf in zip(prepped, get_futs):
+                if gf is None:
+                    out.append([])
+                    continue
+                with span("detect.device_wait", n_pairs=prep.n_pairs):
+                    t_get = time.perf_counter()
+                    bits = gf.result()
+                    METRICS.observe(
+                        "trivy_tpu_device_get_stall_seconds",
+                        time.perf_counter() - t_get)
+                METRICS.gauge_add("trivy_tpu_dispatch_depth", -1.0)
+                in_flight -= 1
+                out.append(self._assemble(prep, bits))
+        finally:
+            # a batch that raises (device error mid-loop) must not
+            # leave the in-flight gauge ratcheted up forever
+            if in_flight:
+                METRICS.gauge_add("trivy_tpu_dispatch_depth",
+                                  float(-in_flight))
         METRICS.inc("trivy_tpu_detect_wait_assemble_seconds_total",
                     time.perf_counter() - t0)
         METRICS.inc("trivy_tpu_detect_hits_total",
@@ -303,6 +355,14 @@ class BatchDetector:
         return out
 
     def _assemble(self, prep: _Prepared, bits: np.ndarray) -> list[Hit]:
+        """Instrumented shell around _assemble_impl."""
+        with span("detect.assemble", n_pairs=prep.n_pairs) as sp:
+            hits = self._assemble_impl(prep, bits)
+            sp.attrs["hits"] = len(hits)
+            return hits
+
+    def _assemble_impl(self, prep: _Prepared,
+                       bits: np.ndarray) -> list[Hit]:
         t = self.table
         bits = bits[:prep.n_pairs]
         keep = np.nonzero(bits)[0]

@@ -10,6 +10,7 @@ to its origin layer — the FIRST layer that contained the same package
 from __future__ import annotations
 
 from .. import types as T
+from ..obs import span
 
 
 def _delete_path(store: dict, path: str):
@@ -19,6 +20,14 @@ def _delete_path(store: dict, path: str):
 
 
 def apply_layers(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
+    with span("fanal.apply_layers", blobs=len(blobs)) as sp:
+        detail = _apply_layers_impl(blobs)
+        sp.attrs.update(packages=len(detail.packages),
+                        applications=len(detail.applications))
+        return detail
+
+
+def _apply_layers_impl(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
     detail = T.ArtifactDetail()
     pkg_files: dict[str, tuple[T.PackageInfo, T.Layer]] = {}
     app_files: dict[str, tuple[T.Application, T.Layer]] = {}

@@ -13,6 +13,7 @@ import tarfile
 from dataclasses import dataclass, field
 
 from .. import types as T
+from ..obs import span
 from .analyzers import AnalysisResult, AnalyzerGroup
 
 WH_PREFIX = ".wh."
@@ -76,6 +77,20 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
                    secret_config_path: str = DEFAULT_SECRET_CONFIG,
                    skip_files: tuple = (),
                    skip_dir_globs: tuple = ()) -> BlobScan:
+    with span("fanal.walk_tar") as sp:
+        scan = _walk_layer_tar_impl(
+            tf, group, collect_secrets, secret_config_path,
+            skip_files, skip_dir_globs)
+        sp.attrs.update(secret_files=len(scan.secret_files),
+                        post_files=len(scan.post_files))
+        return scan
+
+
+def _walk_layer_tar_impl(tf: tarfile.TarFile, group: AnalyzerGroup,
+                         collect_secrets: bool,
+                         secret_config_path: str,
+                         skip_files: tuple,
+                         skip_dir_globs: tuple) -> BlobScan:
     # --skip-files/--skip-dirs apply to image layers too (reference
     # walker.go CleanSkipPaths: leading '/' stripped, compared against
     # the walked relative path with doublestar semantics)
@@ -185,6 +200,20 @@ def walk_fs(root: str, group: AnalyzerGroup,
     reads and analyzes candidate files on a thread pool (reference
     walker/fs.go:73-80 --parallel); per-file results merge back in
     sorted path order so output is deterministic either way."""
+    with span("fanal.walk_fs", parallel=parallel) as sp:
+        scan = _walk_fs_impl(root, group, collect_secrets, skip_dirs,
+                             secret_config_path, parallel,
+                             file_checksum, skip_files, skip_dir_globs)
+        sp.attrs.update(secret_files=len(scan.secret_files),
+                        post_files=len(scan.post_files))
+        return scan
+
+
+def _walk_fs_impl(root: str, group: AnalyzerGroup,
+                  collect_secrets: bool, skip_dirs: tuple,
+                  secret_config_path: str, parallel: int,
+                  file_checksum: bool, skip_files: tuple,
+                  skip_dir_globs: tuple) -> BlobScan:
     scan = BlobScan(result=AnalysisResult())
     root = os.path.abspath(root)
     skip_files = normalize_skip_globs(skip_files)

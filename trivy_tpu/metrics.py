@@ -3,55 +3,186 @@
 The reference exposes no metrics endpoint; its observability is logs.
 For a long-lived scan server sharding work over a device mesh, the
 operational questions are different — is the device busy, how big are
-the batches, how many candidate pairs per dispatch — so the server
-publishes counters in the Prometheus text exposition format at
-/metrics (server/listen.py), fed from the detect and secret engines.
+the batches, how much of each padded dispatch is real work, where do
+requests stall — so the server publishes counters, gauges, and
+histograms in the Prometheus text exposition format 0.0.4 at /metrics
+(server/listen.py), fed from the detect and secret engines and the
+RPC handlers.
 
-Counters only (monotonic); gauges derive host-side from rate() in the
-scraper. Thread-safe: the detect engine is shared across server handler
-threads.
+Histograms use static bucket edges declared up front (declare()) so
+series never change shape between scrapes; gauges cover in-flight
+state (dispatch depth) the scraper cannot derive from rate().
+Thread-safe: the detect engine is shared across server handler
+threads, so every mutation happens under the lock.
+
+The metric catalog — every series name, type, and help string — lives
+at the bottom of this module; graftlint's lock-hygiene rule (TPU106)
+covers this file and TPU107 keeps METRICS calls out of device code.
 """
 
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left
+
+# default histogram edges: latency-shaped, seconds
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._values: dict[tuple[str, tuple], float] = {}
+        self._values: dict[tuple[str, tuple], float] = {}   # counters
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._hist: dict[tuple[str, tuple], list] = {}      # bucket counts
+        self._hist_sum: dict[tuple[str, tuple], float] = {}
+        self._buckets: dict[str, tuple] = {}                # static edges
+        self._help: dict[str, str] = {}
+        self._types: dict[str, str] = {}
+
+    # ---- declaration --------------------------------------------------
+
+    def declare(self, name: str, kind: str, help_text: str = "",
+                buckets: tuple | None = None) -> None:
+        """Register a series' type, # HELP text, and (for histograms)
+        its static bucket edges. Declaration is optional for counters
+        and gauges; histograms observed without one get
+        DEFAULT_BUCKETS."""
+        if kind not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"unknown metric type {kind!r}")
+        with self._lock:
+            self._types[name] = kind
+            if help_text:
+                self._help[name] = help_text
+            if kind == "histogram":
+                edges = tuple(buckets) if buckets else DEFAULT_BUCKETS
+                if list(edges) != sorted(edges):
+                    raise ValueError(f"{name}: bucket edges not sorted")
+                if self._buckets.get(name) not in (None, edges):
+                    # re-declaring with different edges resets the
+                    # series: rows sized for the old edges would render
+                    # mis-bucketed counts (or crash at +Inf)
+                    for key in [k for k in self._hist if k[0] == name]:
+                        self._hist.pop(key)
+                        self._hist_sum.pop(key, None)
+                self._buckets[name] = edges
+
+    # ---- writes -------------------------------------------------------
 
     def inc(self, name: str, value: float = 1.0, **labels):
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
 
+    def set_gauge(self, name: str, value: float, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._types.setdefault(name, "gauge")
+            self._gauges[key] = float(value)
+
+    def gauge_add(self, name: str, delta: float, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._types.setdefault(name, "gauge")
+            self._gauges[key] = self._gauges.get(key, 0.0) + delta
+
+    def observe(self, name: str, value: float, **labels):
+        """Record one histogram observation (bucket edges are the
+        static ones from declare(), else DEFAULT_BUCKETS)."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._types.setdefault(name, "histogram")
+            edges = self._buckets.get(name)
+            if edges is None:
+                edges = self._buckets[name] = DEFAULT_BUCKETS
+            row = self._hist.get(key)
+            if row is None:
+                row = self._hist[key] = [0] * (len(edges) + 1)
+            # le is an inclusive upper bound: first edge >= value
+            row[bisect_left(edges, value)] += 1
+            self._hist_sum[key] = self._hist_sum.get(key, 0.0) + value
+
+    # ---- reads --------------------------------------------------------
+
     def get(self, name: str, **labels) -> float:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
+            if key in self._gauges:
+                return self._gauges[key]
             return self._values.get(key, 0.0)
+
+    def hist_get(self, name: str, **labels) -> tuple[list, float, int]:
+        """→ (bucket_counts, sum, count) for one histogram series."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            row = list(self._hist.get(key) or ())
+            total = self._hist_sum.get(key, 0.0)
+        return row, total, sum(row)
 
     def reset(self):
         with self._lock:
             self._values.clear()
+            self._gauges.clear()
+            self._hist.clear()
+            self._hist_sum.clear()
+
+    # ---- exposition ---------------------------------------------------
 
     def render(self) -> str:
         """Prometheus text exposition format 0.0.4."""
         with self._lock:
-            items = sorted(self._values.items())
-        out = []
-        last_name = None
-        for (name, labels), value in items:
-            if name != last_name:
-                out.append(f"# TYPE {name} counter")
-                last_name = name
-            if labels:
-                lbl = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
-                out.append(f"{name}{{{lbl}}} {_fmt(value)}")
-            else:
-                out.append(f"{name} {_fmt(value)}")
+            values = sorted(self._values.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._hist.items())
+            hist_sum = dict(self._hist_sum)
+            buckets = dict(self._buckets)
+            helps = dict(self._help)
+            types = dict(self._types)
+
+        families: dict[str, list] = {}
+        for (name, labels), value in values:
+            families.setdefault(name, []).append(("c", labels, value))
+        for (name, labels), value in gauges:
+            families.setdefault(name, []).append(("g", labels, value))
+        for (name, labels), row in hists:
+            families.setdefault(name, []).append(("h", labels, row))
+
+        out: list[str] = []
+        for name in sorted(families):
+            kind = types.get(name) or (
+                "histogram" if families[name][0][0] == "h" else
+                "gauge" if families[name][0][0] == "g" else "counter")
+            if name in helps:
+                out.append(f"# HELP {name} {_escape_help(helps[name])}")
+            out.append(f"# TYPE {name} {kind}")
+            for tag, labels, value in families[name]:
+                if tag != "h":
+                    out.append(
+                        f"{name}{_labelstr(labels)} {_fmt(value)}")
+                    continue
+                edges = buckets[name]
+                cum = 0
+                for edge, n in zip(edges, value):
+                    cum += n
+                    out.append(
+                        f"{name}_bucket"
+                        f"{_labelstr(labels, le=_fmt(edge))} {cum}")
+                cum += value[len(edges)]
+                out.append(f"{name}_bucket"
+                           f"{_labelstr(labels, le='+Inf')} {cum}")
+                key = (name, labels)
+                out.append(f"{name}_sum{_labelstr(labels)} "
+                           f"{_fmt(hist_sum.get(key, 0.0))}")
+                out.append(f"{name}_count{_labelstr(labels)} {cum}")
         return "\n".join(out) + "\n" if out else ""
+
+
+def _labelstr(labels: tuple, le: str | None = None) -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in labels]
+    if le is not None:
+        parts.append(f'le="{le}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
 
 
 def _escape(v) -> str:
@@ -60,8 +191,57 @@ def _escape(v) -> str:
         .replace("\n", r"\n")
 
 
+def _escape_help(v: str) -> str:
+    """HELP text escapes only backslash and newline."""
+    return v.replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _fmt(v: float) -> str:
     return str(int(v)) if v == int(v) else repr(v)
 
 
 METRICS = Registry()
+
+# ---------------------------------------------------------------------------
+# metric catalog: every series the pipeline emits, with static buckets
+
+METRICS.declare("trivy_tpu_scans_total", "counter",
+                "Scan RPCs served.")
+METRICS.declare("trivy_tpu_scan_seconds_total", "counter",
+                "Total wall time spent serving Scan RPCs.")
+METRICS.declare(
+    "trivy_tpu_scan_latency_seconds", "histogram",
+    "End-to-end latency of one Scan RPC (walker output to response).",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0))
+METRICS.declare("trivy_tpu_detect_batches_total", "counter",
+                "Query batches dispatched to the device join.")
+METRICS.declare("trivy_tpu_detect_queries_total", "counter",
+                "Package queries entering the detect engine.")
+METRICS.declare("trivy_tpu_detect_pairs_total", "counter",
+                "Candidate (package, advisory) pairs joined on device.")
+METRICS.declare("trivy_tpu_detect_hits_total", "counter",
+                "Detected (package, advisory-group) matches.")
+METRICS.declare("trivy_tpu_detect_wait_assemble_seconds_total",
+                "counter",
+                "Wall time in device-result wait plus host assembly.")
+METRICS.declare(
+    "trivy_tpu_batch_occupancy_ratio", "histogram",
+    "Real candidate pairs / padded dispatch rows, per device batch "
+    "(1.0 = no padding waste).",
+    buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0))
+METRICS.declare(
+    "trivy_tpu_device_get_stall_seconds", "histogram",
+    "Time the host blocked fetching one dispatched batch result "
+    "(compile + execute + transfer not yet overlapped away).",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0))
+METRICS.declare("trivy_tpu_dispatch_depth", "gauge",
+                "Device dispatches currently in flight (dispatched, "
+                "result not yet fetched).")
+METRICS.declare("trivy_tpu_secret_files_total", "counter",
+                "Files through the secret scanner.")
+METRICS.declare("trivy_tpu_secret_bytes_total", "counter",
+                "Bytes through the secret scanner.")
+METRICS.declare("trivy_tpu_secret_findings_total", "counter",
+                "Confirmed secret findings.")

@@ -1,0 +1,21 @@
+"""graftscope — tracing, device-phase timing, and backend status.
+
+`trace` is the span/tracer core (context-local spans, trace-id
+propagation, Chrome trace-event export); `device` is the cached
+backend view /healthz serves. Metrics live in `trivy_tpu.metrics`
+(the registry predates this package and is imported everywhere).
+
+See ARCHITECTURE.md "Observability (graftscope)" for the span
+taxonomy and how to add a span.
+"""
+
+from .device import device_status, note_dispatch
+from .trace import (COLLECTOR, add_attr, chrome_trace, current_trace_id,
+                    ensure_trace, new_trace, recording, span,
+                    write_chrome_trace)
+
+__all__ = [
+    "COLLECTOR", "add_attr", "chrome_trace", "current_trace_id",
+    "device_status", "ensure_trace", "new_trace", "note_dispatch",
+    "recording", "span", "write_chrome_trace",
+]

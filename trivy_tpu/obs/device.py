@@ -1,0 +1,59 @@
+"""Device backend status for /healthz.
+
+The health endpoint must never block behind a dead backend init, so it
+never touches jax itself: the detect engine calls note_dispatch() on
+its (already-jax-initialized) dispatch path, which caches the backend
+identity once and stamps the last-successful-dispatch time; healthz
+reads the cached view. Before the first dispatch the platform reports
+"uninitialized" — an honest answer for a server that has not yet run
+device work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+_lock = threading.Lock()
+_state = {
+    "platform": "",
+    "device_count": 0,
+    "last_dispatch_unix": 0.0,
+}
+
+
+def note_dispatch() -> None:
+    """Record a successful device dispatch (called from the detect
+    engine's dispatch path, where jax is already live)."""
+    if not _state["platform"]:
+        try:
+            import jax
+            devs = jax.devices()
+            platform = getattr(devs[0], "platform", "") or "unknown"
+            count = len(devs)
+        except Exception:  # backend probe must never sink a dispatch
+            platform, count = "unknown", 0
+        with _lock:
+            if not _state["platform"]:
+                _state["platform"] = platform
+                _state["device_count"] = count
+    with _lock:
+        _state["last_dispatch_unix"] = time.time()
+
+
+def device_status() -> dict:
+    """→ {platform, device_count, last_dispatch_age_s} for /healthz."""
+    with _lock:
+        snap = dict(_state)
+    last = snap.pop("last_dispatch_unix")
+    snap["platform"] = snap["platform"] or "uninitialized"
+    snap["last_dispatch_age_s"] = (
+        round(time.time() - last, 3) if last else None)
+    return snap
+
+
+def _reset_for_tests() -> None:
+    with _lock:
+        _state["platform"] = ""
+        _state["device_count"] = 0
+        _state["last_dispatch_unix"] = 0.0

@@ -1,0 +1,268 @@
+"""graftscope: context-local pipeline tracing.
+
+The reference's observability is logs, because its unit of work is one
+CLI run; a batched RPC service needs to answer "where did this 400ms
+scan go — walker, host prep, XLA compile, device execute, or hit
+assembly?" per request. This module provides the span primitive the
+whole pipeline is instrumented with:
+
+    with span("detect.prepare", queries=len(qs)) as sp:
+        ...
+        sp.attrs["n_pairs"] = prep.n_pairs
+
+Spans carry a trace id (stamped per scan / per RPC, propagated from
+client to server via the X-Trivy-Trace-Id header), a span id, their
+parent span id (contextvar nesting — correct across server handler
+threads), wall + process time, and free-form attributes. Finished
+spans land in the process-wide COLLECTOR only while recording is
+enabled (`--trace FILE` on the CLI, the server's --trace flag, or
+bench.py's phase breakdown); when disabled span() early-outs after
+one flag check, yielding a shared no-op span — no ids, no clock
+reads, no contextvar traffic.
+
+Export is Chrome trace-event JSON ("X" complete events, microsecond
+timestamps), loadable in Perfetto / chrome://tracing.
+
+Instrumentation never goes INSIDE device code — under jit tracing a
+span would time the trace, not the device, and a clock read lowers to
+nothing. graftlint rule TPU107 enforces this.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+
+# active span (for parent linkage) and active trace id; contextvars so
+# each server handler thread / asyncio task nests independently
+_SPAN: contextvars.ContextVar = contextvars.ContextVar(
+    "trivy_tpu_span", default=None)
+_TRACE: contextvars.ContextVar = contextvars.ContextVar(
+    "trivy_tpu_trace", default="")
+
+
+def _new_id(nbytes: int = 8) -> str:
+    return uuid.uuid4().hex[:2 * nbytes]
+
+
+class Span:
+    """One finished (or in-flight) timed region."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "wall_start", "start", "dur", "cpu", "thread_id")
+
+    def __init__(self, name: str, trace_id: str, parent_id: str,
+                 attrs: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.wall_start = 0.0   # time.time() at enter
+        self.start = 0.0        # perf_counter at enter
+        self.dur = 0.0          # perf_counter seconds
+        self.cpu = 0.0          # process_time seconds
+        self.thread_id = 0
+
+
+class Collector:
+    """Process-wide sink for finished spans (bounded, thread-safe).
+
+    Shared across server handler threads — every mutation of the span
+    buffer happens under the lock (graftlint TPU106 covers this
+    module)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._enabled = False
+        self._limit = 200_000
+        self._dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, limit: int | None = None) -> None:
+        with self._lock:
+            self._spans = []
+            self._dropped = 0
+            if limit is not None:
+                self._limit = limit
+            self._enabled = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self._enabled = False
+
+    def record(self, s: Span) -> None:
+        if not self._enabled:
+            return
+        with self._lock:
+            if len(self._spans) >= self._limit:
+                self._dropped += 1
+                return
+            self._spans.append(s)
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def snapshot(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def drain(self) -> list[Span]:
+        with self._lock:
+            out = self._spans
+            self._spans = []
+            return out
+
+    def phase_totals(self) -> dict[str, dict]:
+        """Aggregate recorded spans by name → {count, total_ms,
+        cpu_ms} (bench.py's per-phase breakdown)."""
+        out: dict[str, dict] = {}
+        for s in self.snapshot():
+            agg = out.setdefault(s.name,
+                                 {"count": 0, "total_ms": 0.0,
+                                  "cpu_ms": 0.0})
+            agg["count"] += 1
+            agg["total_ms"] += s.dur * 1e3
+            agg["cpu_ms"] += s.cpu * 1e3
+        for agg in out.values():
+            agg["total_ms"] = round(agg["total_ms"], 3)
+            agg["cpu_ms"] = round(agg["cpu_ms"], 3)
+        return out
+
+
+COLLECTOR = Collector()
+
+
+def recording() -> bool:
+    return COLLECTOR.enabled
+
+
+def current_trace_id() -> str:
+    return _TRACE.get()
+
+
+@contextlib.contextmanager
+def new_trace(trace_id: str | None = None):
+    """Set a fresh trace id for the enclosed work (per-RPC stamp)."""
+    tid = trace_id or _new_id(16)
+    tok = _TRACE.set(tid)
+    try:
+        yield tid
+    finally:
+        _TRACE.reset(tok)
+
+
+@contextlib.contextmanager
+def ensure_trace(trace_id: str | None = None):
+    """Reuse the active trace id, or start one if none is active —
+    the per-scan stamp (scanner.scan_many) that must not clobber a
+    server-stamped per-RPC id."""
+    cur = _TRACE.get()
+    if cur and trace_id is None:
+        yield cur
+        return
+    with new_trace(trace_id) as tid:
+        yield tid
+
+
+# shared sink for disabled tracing: callers may still write attrs into
+# it (overwritten freely, read by nobody) without any per-span cost
+_NOOP_SPAN = Span("", "", "", {})
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Time a region; nests via contextvars. Yields the Span so callers
+    can attach attributes discovered mid-flight (`sp.attrs[...] = x`).
+    When recording is off this is one flag check and a shared no-op
+    span — cheap enough for per-batch hot-path call sites."""
+    if not COLLECTOR.enabled:
+        yield _NOOP_SPAN
+        return
+    parent = _SPAN.get()
+    s = Span(name, _TRACE.get(),
+             parent.span_id if parent is not None else "", dict(attrs))
+    s.thread_id = threading.get_ident()
+    s.wall_start = time.time()
+    s.cpu = time.process_time()
+    s.start = time.perf_counter()
+    tok = _SPAN.set(s)
+    try:
+        yield s
+    finally:
+        s.dur = time.perf_counter() - s.start
+        s.cpu = time.process_time() - s.cpu
+        _SPAN.reset(tok)
+        COLLECTOR.record(s)
+
+
+def add_attr(**attrs) -> None:
+    """Attach attributes to the innermost active span (no-op outside
+    any span)."""
+    s = _SPAN.get()
+    if s is not None:
+        s.attrs.update(attrs)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export (Perfetto / chrome://tracing)
+
+def chrome_trace(spans: list[Span] | None = None,
+                 dropped: int | None = None) -> dict:
+    """→ the Chrome trace-event JSON document for `spans` (default: the
+    COLLECTOR's current buffer). "X" complete events; ts/dur in
+    microseconds relative to the earliest span; span/trace/parent ids
+    and attributes ride in `args`. Truncation is never silent: spans
+    dropped at the collector's limit surface as a trailing instant
+    event ("graftscope.dropped_spans")."""
+    if spans is None:
+        spans = COLLECTOR.snapshot()
+    if dropped is None:
+        dropped = COLLECTOR.dropped
+    base = min((s.start for s in spans), default=0.0)
+    pid = os.getpid()
+    events = []
+    for s in spans:
+        events.append({
+            "name": s.name,
+            "cat": "graftscope",
+            "ph": "X",
+            "ts": round((s.start - base) * 1e6, 3),
+            "dur": round(s.dur * 1e6, 3),
+            "pid": pid,
+            "tid": s.thread_id,
+            "args": {
+                "trace_id": s.trace_id,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "cpu_ms": round(s.cpu * 1e3, 3),
+                **s.attrs,
+            },
+        })
+    if dropped:
+        end = max((e["ts"] + e["dur"] for e in events), default=0.0)
+        events.append({
+            "name": "graftscope.dropped_spans", "cat": "graftscope",
+            "ph": "i", "s": "g", "ts": end, "pid": pid, "tid": 0,
+            "args": {"dropped": dropped},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str,
+                       spans: list[Span] | None = None) -> None:
+    doc = chrome_trace(spans)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)

@@ -19,6 +19,7 @@ from .detect.fill import fill_info
 from .detect.langpkg import LangpkgScanner
 from .detect.ospkg import OspkgScanner
 from .fanal.applier import apply_layers
+from .obs import ensure_trace, span
 
 
 class LocalScanner:
@@ -48,86 +49,104 @@ class LocalScanner:
         targets — the cross-image batching the k8s cluster sweep uses
         where the reference loops runner.ScanImage per image
         (pkg/k8s/scanner/scanner.go:163-175)."""
+        # one trace per scan call (unless the server already stamped a
+        # per-RPC id): every span and log line below carries it
+        with ensure_trace(), span("scan", targets=len(items)):
+            return self._scan_many_traced(items, options, now)
+
+    def _scan_many_traced(self, items, options, now):
         options = options or T.ScanOptions()
         details = []
-        for target, artifact_id, blob_ids in items:
-            blobs = []
-            for bid in blob_ids:
-                blob = self.cache.get_blob(bid)
-                if blob is None:
-                    raise KeyError(f"missing blob {bid} in cache "
-                                   f"(artifact {artifact_id})")
-                blobs.append(blob)
-            detail = apply_layers(blobs)
-            # OS-independent packages without a detected OS report
-            # Family "none" (reference local/scan.go:66-71)
-            if not detail.os.detected and detail.packages:
-                detail.os = T.OS(family=T.OSFamily.NONE)
-            # dev dependencies are removed unless --include-dev-deps
-            # (reference local/scan.go:109-111 excludeDevDeps)
-            if not options.include_dev_deps:
-                for app in detail.applications:
-                    app.packages = [p for p in app.packages if not p.dev]
-            details.append(detail)
+        with span("scan.apply_layers", targets=len(items)):
+            for target, artifact_id, blob_ids in items:
+                blobs = []
+                for bid in blob_ids:
+                    blob = self.cache.get_blob(bid)
+                    if blob is None:
+                        raise KeyError(f"missing blob {bid} in cache "
+                                       f"(artifact {artifact_id})")
+                    blobs.append(blob)
+                detail = apply_layers(blobs)
+                # OS-independent packages without a detected OS report
+                # Family "none" (reference local/scan.go:66-71)
+                if not detail.os.detected and detail.packages:
+                    detail.os = T.OS(family=T.OSFamily.NONE)
+                # dev dependencies are removed unless --include-dev-deps
+                # (reference local/scan.go:109-111 excludeDevDeps)
+                if not options.include_dev_deps:
+                    for app in detail.applications:
+                        app.packages = [p for p in app.packages
+                                        if not p.dev]
+                details.append(detail)
 
         # phase 1: build every query batch (host)
         units = []    # (item_idx, "os" | app, finish)
         batches = []
-        if T.Scanner.VULN in options.scanners:
-            for idx, detail in enumerate(details):
-                if detail.os.detected and "os" in options.pkg_types:
-                    qs, fin = self.ospkg.prepare(
-                        detail.os, detail.repository, detail.packages,
-                        now=now)
-                    if fin is not None:  # family supported
-                        units.append((idx, "os", fin))
-                        batches.append(qs)
-                if "library" in options.pkg_types:
-                    for app in sorted(detail.applications,
-                                      key=lambda a: (a.file_path, a.type)):
-                        qs, fin = self.langpkg.prepare_app(app)
-                        units.append((idx, app, fin))
-                        batches.append(qs)
+        with span("scan.build_queries") as sp:
+            if T.Scanner.VULN in options.scanners:
+                for idx, detail in enumerate(details):
+                    if detail.os.detected and "os" in options.pkg_types:
+                        qs, fin = self.ospkg.prepare(
+                            detail.os, detail.repository,
+                            detail.packages, now=now)
+                        if fin is not None:  # family supported
+                            units.append((idx, "os", fin))
+                            batches.append(qs)
+                    if "library" in options.pkg_types:
+                        for app in sorted(detail.applications,
+                                          key=lambda a: (a.file_path,
+                                                         a.type)):
+                            qs, fin = self.langpkg.prepare_app(app)
+                            units.append((idx, app, fin))
+                            batches.append(qs)
+            sp.attrs.update(batches=len(batches),
+                            queries=sum(len(b) for b in batches))
 
         # phase 2: one pipelined dispatch across all targets (device)
-        hit_lists = self.detector.detect_many(batches) if batches else []
+        if batches:
+            with span("scan.detect", batches=len(batches)):
+                hit_lists = self.detector.detect_many(batches)
+        else:
+            hit_lists = []
 
         # phase 3: assemble per-target results (host)
-        vuln_results: dict[int, list[T.Result]] = {}
-        for (idx, unit, finish), hits in zip(units, hit_lists):
-            target = items[idx][0]
-            detail = details[idx]
-            if unit == "os":
-                vulns, eosl = finish(hits)
-                if eosl:
-                    detail.os.eosl = True
-                # a supported, detected OS always yields a result —
-                # even with zero packages (ospkg/scan.go:42-69)
-                keep = True
-                res = self._vuln_result(
-                    vulns,
-                    target=f"{target} ({detail.os.family} "
-                           f"{detail.os.name})",
-                    clazz=T.ResultClass.OS_PKGS, rtype=detail.os.family,
-                    packages=detail.packages, options=options)
-            else:
-                app = unit
-                vulns = finish(hits)
-                keep = bool(vulns) or options.list_all_packages
-                res = self._vuln_result(
-                    vulns,
-                    target=app.file_path or
-                    PKG_TARGETS.get(app.type, app.type),
-                    clazz=T.ResultClass.LANG_PKGS, rtype=app.type,
-                    packages=app.packages, options=options)
-            if keep:
-                vuln_results.setdefault(idx, []).append(res)
+        with span("scan.assemble_results"):
+            vuln_results: dict[int, list[T.Result]] = {}
+            for (idx, unit, finish), hits in zip(units, hit_lists):
+                target = items[idx][0]
+                detail = details[idx]
+                if unit == "os":
+                    vulns, eosl = finish(hits)
+                    if eosl:
+                        detail.os.eosl = True
+                    # a supported, detected OS always yields a result —
+                    # even with zero packages (ospkg/scan.go:42-69)
+                    keep = True
+                    res = self._vuln_result(
+                        vulns,
+                        target=f"{target} ({detail.os.family} "
+                               f"{detail.os.name})",
+                        clazz=T.ResultClass.OS_PKGS,
+                        rtype=detail.os.family,
+                        packages=detail.packages, options=options)
+                else:
+                    app = unit
+                    vulns = finish(hits)
+                    keep = bool(vulns) or options.list_all_packages
+                    res = self._vuln_result(
+                        vulns,
+                        target=app.file_path or
+                        PKG_TARGETS.get(app.type, app.type),
+                        clazz=T.ResultClass.LANG_PKGS, rtype=app.type,
+                        packages=app.packages, options=options)
+                if keep:
+                    vuln_results.setdefault(idx, []).append(res)
 
-        return [
-            self._finish_item(items[idx][0], details[idx],
-                              vuln_results.get(idx, []), options)
-            for idx in range(len(items))
-        ]
+            return [
+                self._finish_item(items[idx][0], details[idx],
+                                  vuln_results.get(idx, []), options)
+                for idx in range(len(items))
+            ]
 
     def _vuln_result(self, vulns, target: str, clazz, rtype,
                      packages, options: T.ScanOptions) -> T.Result:

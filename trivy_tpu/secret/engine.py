@@ -17,6 +17,7 @@ from typing import Optional
 import numpy as np
 
 from .. import types as T
+from ..obs import span
 from ..ops import ac
 from .rules import BUILTIN_RULES, GLOBAL_ALLOW_RULES, Rule
 
@@ -226,13 +227,19 @@ class SecretScanner:
         findings omitted)."""
         from ..metrics import METRICS
         contents = [c for _, c in files]
-        masks = self._keyword_masks(contents)
+        with span("secret.prefilter", files=len(files),
+                  bytes=sum(len(c) for c in contents)) as sp:
+            masks = self._keyword_masks(contents)
+            sp.attrs["candidates"] = sum(len(m) for m in masks)
         results = []
-        for (path, content), rule_idx in zip(files, masks):
-            rule_idx = set(rule_idx) | set(self._no_keyword_rules)
-            sec = self.scan_file(path, content, candidate_rules=rule_idx)
-            if sec.findings:
-                results.append(sec)
+        with span("secret.confirm", files=len(files)) as sp:
+            for (path, content), rule_idx in zip(files, masks):
+                rule_idx = set(rule_idx) | set(self._no_keyword_rules)
+                sec = self.scan_file(path, content,
+                                     candidate_rules=rule_idx)
+                if sec.findings:
+                    results.append(sec)
+            sp.attrs["findings"] = sum(len(s.findings) for s in results)
         METRICS.inc("trivy_tpu_secret_files_total", len(files))
         METRICS.inc("trivy_tpu_secret_bytes_total",
                     sum(len(c) for c in contents))

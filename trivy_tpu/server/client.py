@@ -11,8 +11,9 @@ import urllib.error
 import urllib.request
 
 from .. import types as T
+from ..obs import current_trace_id, ensure_trace, span
 from ..report.writer import report_from_json
-from .listen import TOKEN_HEADER
+from .listen import TOKEN_HEADER, TRACE_HEADER
 
 RETRIES = 3
 
@@ -32,11 +33,15 @@ class _Base:
     def _call(self, service: str, method: str, payload: dict) -> dict:
         url = f"{self.base_url}/twirp/{service}/{method}"
         body = json.dumps(payload).encode()
+        # forward the active graftscope trace id so client and server
+        # spans/logs correlate (the server mints one when absent)
+        tid = current_trace_id()
         last = None
         for attempt in range(RETRIES):
             req = urllib.request.Request(
                 url, data=body, method="POST",
                 headers={"Content-Type": "application/json",
+                         **({TRACE_HEADER: tid} if tid else {}),
                          **({TOKEN_HEADER: self.token} if self.token else {})})
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
@@ -89,6 +94,10 @@ class RemoteScanner(_Base):
     def scan(self, target: str, artifact_id: str, blob_ids: list,
              options: T.ScanOptions | None = None):
         options = options or T.ScanOptions()
+        with ensure_trace(), span("client.scan", target=target):
+            return self._scan(target, artifact_id, blob_ids, options)
+
+    def _scan(self, target, artifact_id, blob_ids, options):
         r = self._call(self.SERVICE, "Scan", {
             "target": target,
             "artifact_id": artifact_id,

@@ -23,9 +23,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import __version__, types as T
 from ..fanal.cache import FSCache, blob_from_json
+from ..log import get as _get_logger
+from ..obs import device_status, new_trace, span
 from ..scanner import LocalScanner
 
 TOKEN_HEADER = "Trivy-Token"
+# per-RPC trace id: honored when the client sends one, generated
+# otherwise; echoed on every response and stamped on every span and
+# log line the request produces (graftscope propagation)
+TRACE_HEADER = "X-Trivy-Trace-Id"
+
+_log = _get_logger("server")
 
 
 class ServerState:
@@ -99,6 +107,7 @@ def _result_to_json(res: T.Result) -> dict:
 class Handler(BaseHTTPRequestHandler):
     state: ServerState = None  # set by serve()
     protocol_version = "HTTP/1.1"
+    _trace_id = ""  # per-request; set by do_POST before dispatch
 
     def log_message(self, *args):
         pass
@@ -108,6 +117,8 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self._trace_id:
+            self.send_header(TRACE_HEADER, self._trace_id)
         self.end_headers()
         self.wfile.write(body)
 
@@ -116,6 +127,10 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         st = self.state
+        # clear any trace id a previous POST on this keep-alive
+        # connection stamped on the handler instance — a health probe
+        # must not echo an unrelated scan's id
+        self._trace_id = ""
         st.request_started()
         try:
             self._do_get()
@@ -124,12 +139,22 @@ class Handler(BaseHTTPRequestHandler):
 
     def _do_get(self):
         if self.path == "/healthz":
-            body = b"ok"
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            # plain `ok` stays the fast path for probes that ask for
+            # it (kubelet-style `Accept: text/plain`); everything else
+            # gets the device-backend status as JSON. Neither path
+            # touches jax — the status is the cached view the detect
+            # engine stamps on its dispatch path (obs.device).
+            accept = self.headers.get("Accept") or ""
+            if "text/plain" in accept:
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(200, {"status": "ok",
+                                 "device": device_status()})
         elif self.path == "/version":
             self._json(200, {"Version": __version__})
         elif self.path == "/metrics":
@@ -150,6 +175,8 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/protobuf")
         self.send_header("Content-Length", str(len(body)))
+        if self._trace_id:
+            self.send_header(TRACE_HEADER, self._trace_id)
         self.end_headers()
         self.wfile.write(body)
 
@@ -173,8 +200,14 @@ class Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         st = self.state
         st.request_started()
+        # per-RPC trace stamp: reuse the client's id when forwarded,
+        # mint one otherwise; every span/log line below inherits it
+        tid = self.headers.get(TRACE_HEADER) or ""
         try:
-            self._do_post(st)
+            with new_trace(tid or None) as tid:
+                self._trace_id = tid
+                with span("server.rpc", route=self.path):
+                    self._do_post(st)
         finally:
             st.request_finished()
 
@@ -243,9 +276,12 @@ class Handler(BaseHTTPRequestHandler):
         results, os_info = self.state.scanner.scan(
             req.get("target", ""), req.get("artifact_id", ""),
             req.get("blob_ids") or [], opts)
+        elapsed = time.perf_counter() - t0
         METRICS.inc("trivy_tpu_scans_total")
-        METRICS.inc("trivy_tpu_scan_seconds_total",
-                    time.perf_counter() - t0)
+        METRICS.inc("trivy_tpu_scan_seconds_total", elapsed)
+        METRICS.observe("trivy_tpu_scan_latency_seconds", elapsed)
+        _log.debug("scan %s: %d results in %.1fms",
+                   req.get("target", ""), len(results), elapsed * 1e3)
         if self._is_proto:
             from .convert import results_to_proto
             return self._proto(200, results_to_proto(results, os_info),
@@ -259,7 +295,13 @@ class Handler(BaseHTTPRequestHandler):
 
 def serve(host: str, port: int, table, cache_dir: str, token: str = "",
           ready_event: threading.Event | None = None,
-          cache_backend: str = "fs"):
+          cache_backend: str = "fs", trace_path: str = ""):
+    """`trace_path` arms graftscope recording for the server's
+    lifetime and dumps the Chrome trace-event JSON there on shutdown
+    (the CLI's `server --trace FILE`)."""
+    if trace_path:
+        from ..obs import COLLECTOR
+        COLLECTOR.enable()
     Handler.state = ServerState(table, cache_dir, token, cache_backend)
     httpd = ThreadingHTTPServer((host, port), Handler)
     if ready_event is not None:
@@ -268,6 +310,11 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
         httpd.serve_forever()
     finally:
         httpd.server_close()
+        if trace_path:
+            from ..obs import COLLECTOR, write_chrome_trace
+            COLLECTOR.disable()
+            write_chrome_trace(trace_path)
+            _log.warning("graftscope trace written to %s", trace_path)
     return httpd
 
 
