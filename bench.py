@@ -320,9 +320,14 @@ def bench_archive_e2e(table):
         art = ImageArchiveArtifact(path, cache, scanners=("vuln",))
         ref = art.inspect()
         scanner = LocalScanner(cache, table)
-        results, os_info = scanner.scan(
-            ref.name, ref.id, ref.blob_ids,
-            Ty.ScanOptions(scanners=("vuln",)))
+        try:
+            results, os_info = scanner.scan(
+                ref.name, ref.id, ref.blob_ids,
+                Ty.ScanOptions(scanners=("vuln",)))
+        finally:
+            # one scanner per image: without close() the engine's
+            # idle executor threads accumulate across the whole run
+            scanner.close()
         rep = build_report(ref.name, "container_image", results,
                            os_info, metadata=Ty.Metadata())
         out = io.StringIO()
@@ -345,12 +350,15 @@ def bench_archive_e2e(table):
     return (ARCHIVE_IMAGES - 1) / dt, hits
 
 
-def bench_server(table):
+def bench_server(table, clients=SERVER_CLIENTS, images=SERVER_IMAGES,
+                 detect_opts=None, warm=32):
     """BASELINE config-3 shape: images/s through the FULL server path —
     HTTP PutBlob + Scan per image (RPC codec, cache, applier, detect,
-    assembly) against an in-process scan server, 16 concurrent clients
-    the way a registry sweep drives the reference's client/server mode
-    (reference pkg/rpc + server.ScanServer)."""
+    assembly) against an in-process scan server, `clients` concurrent
+    clients the way a registry sweep drives the reference's
+    client/server mode (reference pkg/rpc + server.ScanServer).
+    `detect_opts` (SchedOptions) configures detectd — None keeps the
+    server default (coalescing on)."""
     import tempfile
     import urllib.request
     from concurrent.futures import ThreadPoolExecutor
@@ -361,7 +369,7 @@ def bench_server(table):
     rng = np.random.default_rng(9)
     installed_pool = synth_versions(rng, major_lo=4, major_hi=9)
     blobs = []
-    for i in range(SERVER_IMAGES):
+    for i in range(images):
         names = rng.integers(0, N_PKG_NAMES, PKGS_PER_IMAGE)
         pkgs = [{"Name": f"pkg{n:05d}",
                  "Version": installed_pool[int(v)],
@@ -378,7 +386,8 @@ def bench_server(table):
 
     with tempfile.TemporaryDirectory() as cache_dir:
         httpd, _state = serve_background("127.0.0.1", 0, table,
-                                         cache_dir)
+                                         cache_dir,
+                                         detect_opts=detect_opts)
         port = httpd.server_address[1]
         base = f"http://127.0.0.1:{port}"
 
@@ -401,22 +410,85 @@ def bench_server(table):
             return sum(len(r.get("Vulnerabilities") or [])
                        for r in out.get("results", []))
 
-        warm = 32
         try:
             # serial warmup first: per-request shapes land in a few
-            # pow2 pair buckets, and 16 clients racing the first
+            # ladder pair buckets, and 16 clients racing the first
             # compiles of each bucket stalls the whole pool
             for i in range(warm):
                 scan_one(i)
-            with ThreadPoolExecutor(SERVER_CLIENTS) as pool:
+            with ThreadPoolExecutor(clients) as pool:
                 t0 = time.perf_counter()
-                hits = sum(pool.map(scan_one,
-                                    range(warm, SERVER_IMAGES)))
+                hits = sum(pool.map(scan_one, range(warm, images)))
                 dt = time.perf_counter() - t0
         finally:
             httpd.shutdown()
             httpd.server_close()
-    return (SERVER_IMAGES - warm) / dt, hits
+            _state.close()
+    return (images - warm) / dt, hits
+
+
+SERVER_CONC_IMAGES = 320
+SERVER_CONC_CLIENTS = (1, 4, 16)
+
+
+def _occupancy_snapshot():
+    from trivy_tpu.metrics import METRICS
+    _row, total, count = METRICS.hist_get(
+        "trivy_tpu_batch_occupancy_ratio")
+    return total, count
+
+
+def bench_server_concurrency(table):
+    """detectd acceptance scenario: the server path swept over
+    c ∈ {1, 4, 16} concurrent clients with the coalescing scheduler on,
+    plus the c=16 point with per-request dispatch (scheduler disabled —
+    the pre-detectd path), each with the mean per-dispatch occupancy
+    over the point's own dispatches. `coalesce_speedup_c16` is the
+    headline: images/s at c=16 coalesced ÷ uncoalesced.
+
+    Coalesced points run with --detect-warmup semantics (the bucket
+    ladder pre-compiled at server boot): merged dispatches land on
+    rungs the per-request serial warmup never visits, and paying
+    those XLA compiles mid-measurement charges a boot cost to the
+    steady state (measured 2x distortion on a cold first point)."""
+    from trivy_tpu.detect.sched import SchedOptions
+
+    coalesced = SchedOptions(warmup=True, warmup_max_pairs=1 << 15)
+
+    def point(clients, detect_opts):
+        from trivy_tpu.metrics import METRICS
+        s0, n0 = _occupancy_snapshot()
+        b0 = METRICS.get("trivy_tpu_detect_batches_total")
+        ips, hits = bench_server(table, clients=clients,
+                                 images=SERVER_CONC_IMAGES,
+                                 detect_opts=detect_opts, warm=16)
+        s1, n1 = _occupancy_snapshot()
+        b1 = METRICS.get("trivy_tpu_detect_batches_total")
+        occ = (s1 - s0) / (n1 - n0) if n1 > n0 else None
+        return {"ips": round(ips, 1), "hits": hits,
+                "occ": round(occ, 4) if occ is not None else None,
+                # device dispatches per image: the coalescing effect
+                # itself, independent of how host-bound the backend is
+                "dpi": round((b1 - b0) / SERVER_CONC_IMAGES, 3)}
+
+    out = {}
+    hits_ref = None
+    for c in SERVER_CONC_CLIENTS:
+        p = point(c, coalesced)
+        out[f"c{c}"] = p["ips"]
+        out[f"c{c}_mean_occupancy"] = p["occ"]
+        out[f"c{c}_dispatches_per_image"] = p["dpi"]
+        hits_ref = p["hits"] if hits_ref is None else hits_ref
+        if p["hits"] != hits_ref:
+            out["parity_ok"] = False
+    pu = point(16, SchedOptions(enabled=False))
+    out["c16_uncoalesced"] = pu["ips"]
+    out["c16_uncoalesced_mean_occupancy"] = pu["occ"]
+    out["c16_uncoalesced_dispatches_per_image"] = pu["dpi"]
+    out.setdefault("parity_ok", pu["hits"] == hits_ref)
+    if pu["ips"]:
+        out["coalesce_speedup_c16"] = round(out["c16"] / pu["ips"], 2)
+    return out
 
 
 def bench_secrets_host():
@@ -484,6 +556,10 @@ def device_child_main():
         server_ips, server_hits = bench_server(table)
     except Exception:
         server_ips, server_hits = 0.0, -1
+    try:
+        server_conc = bench_server_concurrency(table)
+    except Exception:
+        server_conc = None
 
     import jax
     payload = {
@@ -499,6 +575,7 @@ def device_child_main():
         "secrets_scan_device_mb_s": secrets_scan_mbs,
         "images_per_sec_server": server_ips,
         "server_hits": server_hits,
+        "server_concurrency": server_conc,
         "device": str(jax.devices()[0]),
         "build_s": build_s,
         "scan_s": dev_s,
@@ -565,10 +642,11 @@ def _run_device_child(env):
 def _workload_fingerprint() -> str:
     """Artifacts are only comparable to this process's CPU points when
     the seeded workload parameters match."""
-    return (f"v3|imgs={N_IMAGES}|base={BASELINE_IMAGES}"
+    return (f"v4|imgs={N_IMAGES}|base={BASELINE_IMAGES}"
             f"|batch={BATCH_IMAGES}|pkgs={N_PKG_NAMES}"
             f"|skew={SKEW_ROWS}/{SKEW_IMAGE_FRAC}"
-            f"|srv={SERVER_IMAGES}/{SERVER_CLIENTS}")
+            f"|srv={SERVER_IMAGES}/{SERVER_CLIENTS}"
+            f"|conc={SERVER_CONC_IMAGES}")
 
 
 def _save_device_artifact(payload: dict):
@@ -719,6 +797,13 @@ def main():
         except Exception as e:  # never sink the bench line
             diag.append(f"server bench failed: {e}")
         try:
+            # detectd acceptance sweep (c ∈ {1,4,16} + uncoalesced
+            # c=16); the device child's sweep overrides when present
+            result["server_concurrency"] = bench_server_concurrency(
+                table)
+        except Exception as e:
+            diag.append(f"server_concurrency bench failed: {e}")
+        try:
             arch_ips, _arch_hits = bench_archive_e2e(table)
             result["images_per_sec_archive_e2e"] = round(arch_ips, 1)
         except Exception as e:
@@ -750,6 +835,8 @@ def main():
                 result["images_per_sec_server"] = round(
                     dev["images_per_sec_server"], 1)
                 result["server_backend"] = "device"
+            if dev.get("server_concurrency"):
+                result["server_concurrency"] = dev["server_concurrency"]
             result["host_prep_ms"] = round(dev["host_prep_ms"], 1)
             result["device_ms"] = round(dev["device_ms"], 1)
             result["assemble_ms"] = round(dev["assemble_ms"], 1)
@@ -789,5 +876,11 @@ if __name__ == "__main__":
         device_child_main()
     elif "--opportunistic" in sys.argv:
         sys.exit(opportunistic_main())
+    elif "--server-concurrency" in sys.argv:
+        # standalone detectd sweep (current backend; pin
+        # JAX_PLATFORMS=cpu for a chip-free run)
+        _table, _det, _imgs = build_workload()
+        print(json.dumps(
+            {"server_concurrency": bench_server_concurrency(_table)}))
     else:
         sys.exit(main())

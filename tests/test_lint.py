@@ -259,6 +259,41 @@ def test_instrumentation_on_host_side_is_fine():
     assert _lint("trivy_tpu/ops/fixture.py", src) == []
 
 
+def test_sched_is_in_lock_hygiene_scope():
+    """detectd (detect/sched.py) is shared across server handler
+    threads and the dispatcher — TPU106 must cover it."""
+    src = (
+        "import threading\n"
+        "class Sched:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._pending = []\n"
+        "    def bad(self, req):\n"
+        "        self._pending.append(req)\n"
+        "    def good(self, req):\n"
+        "        with self._lock:\n"
+        "            self._pending.append(req)\n"
+    )
+    fs = _lint("trivy_tpu/detect/sched.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+    # outside the scoped modules the same class is not checked
+    assert _lint("trivy_tpu/report/fixture.py", src) == []
+
+
+def test_sched_no_clocks_in_device_code():
+    """TPU107 covers jitted cores wherever they appear — a timed core
+    sneaking into detect/sched.py must be caught."""
+    src = (
+        "import time, jax\n"
+        "def _sched_core(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    return x + t0\n"
+        "j = jax.jit(_sched_core)\n"
+    )
+    fs = _lint("trivy_tpu/detect/sched.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU107", 3)]
+
+
 def test_regex_match_span_is_not_a_trace_span():
     # m.span() (re.Match) in device code must not trip the span ban;
     # it is caught by nothing here (host-ish API, but not TPU107's

@@ -50,6 +50,7 @@ _LOCK_SCOPE = (
     os.path.join("trivy_tpu", "metrics.py"),
     os.path.join("trivy_tpu", "obs") + os.sep,
     os.path.join("trivy_tpu", "detect", "engine.py"),
+    os.path.join("trivy_tpu", "detect", "sched.py"),
     os.path.join("trivy_tpu", "parallel", "multihost.py"),
 )
 

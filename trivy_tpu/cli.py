@@ -184,6 +184,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="record graftscope spans for the server's "
                         "lifetime; dump Chrome trace-event JSON to "
                         "FILE on shutdown")
+    p.add_argument("--detect-coalesce-wait-ms", type=float, default=2.0,
+                   help="detectd: how long a pending request waits for "
+                        "co-dispatchers before its device join goes "
+                        "out alone (0 merges only what is already "
+                        "queued; bounds the single-request latency "
+                        "cost of coalescing)")
+    p.add_argument("--detect-max-inflight-pairs", type=int,
+                   default=1 << 22,
+                   help="detectd: padded candidate pairs allowed in "
+                        "flight on the device before dispatch "
+                        "backpressure kicks in")
+    p.add_argument("--detect-warmup", action="store_true",
+                   help="pre-compile the join's pair-bucket ladder at "
+                        "boot so steady-state traffic never pays an "
+                        "XLA compile mid-request")
 
     p = sub.add_parser("k8s", aliases=["kubernetes"],
                        help="scan a kubernetes cluster")
@@ -819,6 +834,7 @@ def cmd_convert(args) -> int:
 
 
 def cmd_server(args) -> int:
+    from .detect.sched import SchedOptions
     from .parallel.multihost import maybe_init_distributed, process_info
     from .server.listen import serve
     if maybe_init_distributed():
@@ -827,10 +843,16 @@ def cmd_server(args) -> int:
         logger.info("joined multi-host job: process %d/%d", idx, count)
     table = _load_table_args(args)
     host, _, port = args.listen.rpartition(":")
+    opts = SchedOptions(
+        coalesce_wait_ms=getattr(args, "detect_coalesce_wait_ms", 2.0),
+        max_pairs_in_flight=getattr(args, "detect_max_inflight_pairs",
+                                    1 << 22),
+        warmup=getattr(args, "detect_warmup", False))
     serve(host or "0.0.0.0", int(port), table, cache_dir=args.cache_dir,
           token=args.token,
           cache_backend=getattr(args, "cache_backend", "fs"),
-          trace_path=getattr(args, "trace", ""))
+          trace_path=getattr(args, "trace", ""),
+          detect_opts=opts)
     return 0
 
 

@@ -4,3 +4,4 @@ Replaces the reference's pkg/detector/{ospkg,library} per-package loops
 with one device program over the whole package batch."""
 
 from .engine import BatchDetector, PkgQuery  # noqa: F401
+from .sched import DispatchScheduler, SchedOptions  # noqa: F401

@@ -20,7 +20,9 @@ The reference evaluates the same predicate one package at a time
 
 from __future__ import annotations
 
+import contextvars
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, NamedTuple, Optional
 
@@ -30,6 +32,7 @@ from .. import version as V
 from ..db.table import AdvisoryTable
 from ..metrics import METRICS
 from ..obs import note_dispatch, recording, span
+from ..ops import bucket_ladder, bucket_size
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
 
@@ -76,13 +79,27 @@ class _Prepared:
     q_start: np.ndarray = None   # int32[Q_pad] bucket start per query
     q_count: np.ndarray = None   # int32[Q_pad] bucket length per query
     q_ver: np.ndarray = None     # int32[Q_pad] version row per query
+    n_queries: int = 0    # real (nonzero-bucket) queries in q_* arrays;
+    # rows beyond are zero-count padding — a coalesced dispatch
+    # (dispatch_merged) concatenates only the real prefixes, because an
+    # interior zero count would shift every later CSR segment
 
 
 class BatchDetector:
-    def __init__(self, table: AdvisoryTable, pair_floor: int = 256):
+    def __init__(self, table: AdvisoryTable, pair_floor: int = 256,
+                 pair_growth: float = 2.0,
+                 max_pairs_in_flight: int = 1 << 22,
+                 assemble_workers: int = 2):
         import threading
         self.table = table
         self.pair_floor = pair_floor
+        # geometric bucket ladder for padded dispatch shapes; 2.0 with
+        # a pow2 floor reproduces the legacy next_pow2 policy exactly
+        self.pair_growth = pair_growth
+        # pipeline backpressure: detect_many stops issuing dispatches
+        # once this many padded pairs are in flight (bounds device
+        # memory and keeps one giant scan from starving coalescing)
+        self.max_pairs_in_flight = max_pairs_in_flight
         kw = table.lo_tok.shape[1] if len(table) else V.KEY_WIDTH
         # version pool: unique (eco, version) → row in _ver_mat
         self._ver_idx: dict[tuple[str, str], int] = {}
@@ -101,11 +118,35 @@ class BatchDetector:
         self._g_arrays_len = -1
         self._g_cols = None
         self._g_cols_len = -1
-        # single background thread for result fetches (detect_many);
+        # dispatch shapes already seen by this process: a new key means
+        # an XLA compile (the recompile counter the bucket ladder and
+        # warmup exist to bound)
+        self._seen_shapes: set = set()
+        self._closed = False
+        # single background thread for result fetches (detect_many and
+        # the scheduler share it — one thread keeps transfers ordered);
         # created eagerly — lazy init would race across server threads
         from concurrent.futures import ThreadPoolExecutor
         self._get_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="detect-get")
+        # small worker pool for hit assembly, so batch N assembles
+        # while batch N+1's result streams over the link
+        self._asm_pool = ThreadPoolExecutor(
+            max_workers=assemble_workers,
+            thread_name_prefix="detect-asm")
+
+    def close(self) -> None:
+        """Join the engine's worker threads. Idempotent; the engine is
+        unusable afterwards. Every owner that replaces a detector
+        (ServerState.swap_table, server shutdown) must call this — the
+        executors' threads are non-daemon and otherwise live until
+        interpreter exit."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._get_pool.shutdown(wait=True)
+        self._asm_pool.shutdown(wait=True)
 
     # ---- memo pools ---------------------------------------------------
 
@@ -186,16 +227,15 @@ class BatchDetector:
 
     def _prepare(self, queries: list[PkgQuery]) -> Optional[_Prepared]:
         """Instrumented shell around _prepare_impl: one graftscope span
-        per batch, plus the batch-occupancy histogram (real pairs ÷
-        padded dispatch rows — the padding-waste signal)."""
+        per batch. (The batch-occupancy histogram moved to the dispatch
+        path — occupancy is a per-DISPATCH property, and a coalesced
+        dispatch merges several prepared batches.)"""
         with span("detect.prepare", queries=len(queries)) as sp:
             prep = self._prepare_impl(queries)
             if prep is not None and prep.n_pairs:
                 t_pad = int(prep.pair_row.shape[0])
                 sp.attrs.update(n_pairs=prep.n_pairs, t_pad=t_pad,
                                 pad_waste=t_pad - prep.n_pairs)
-                METRICS.observe("trivy_tpu_batch_occupancy_ratio",
-                                prep.n_pairs / t_pad)
             return prep
 
     def _prepare_impl(self, queries: list[PkgQuery]) -> Optional[_Prepared]:
@@ -234,14 +274,14 @@ class BatchDetector:
                     - np.repeat(offsets[:-1], counts_nz)
                     + np.repeat(start[nz], counts_nz)).astype(np.int32)
         ver_arr = np.asarray(ver_rows, np.int32)
-        t_pad = _next_pow2(n_pairs, self.pair_floor)
+        t_pad = bucket_size(n_pairs, self.pair_floor, self.pair_growth)
         row_p = np.zeros(t_pad, np.int32)
         row_p[:n_pairs] = pair_row
         ver_p = np.zeros(t_pad, np.int32)
         ver_p[:n_pairs] = ver_arr[pair_q]
         # CSR descriptors (padded with empty buckets; the device clamps
         # the tail segment so padding never contributes valid pairs)
-        q_pad = _next_pow2(nz.size, 64)
+        q_pad = bucket_size(nz.size, 64, self.pair_growth, align=64)
         q_start = np.zeros(q_pad, np.int32)
         q_start[:nz.size] = start[nz]
         q_count = np.zeros(q_pad, np.int32)
@@ -254,7 +294,8 @@ class BatchDetector:
         q_ver[:nz.size] = ver_arr[nz]
         return _Prepared(usable, pair_q, row_p, ver_p, n_pairs,
                          _next_pow2(self._ver_count),
-                         q_start=q_start, q_count=q_count, q_ver=q_ver)
+                         q_start=q_start, q_count=q_count, q_ver=q_ver,
+                         n_queries=int(nz.size))
 
     def _dispatch(self, prep: _Prepared):
         """Instrumented shell around _dispatch_impl: spans the (async)
@@ -265,6 +306,45 @@ class BatchDetector:
         note_dispatch()
         return out
 
+    def _account_dispatch(self, n_pairs: int, t_pad: int, q_pad: int,
+                          u_rows: int, warm: bool = False) -> None:
+        """Per-DISPATCH metrics: one occupancy observation and one
+        batch count per device launch (a coalesced dispatch covering N
+        requests is still ONE dispatch), plus the compile counter — a
+        (t_pad, q_pad, ver-pool rows, table size) key this process has
+        not dispatched before is a new XLA program. Warmup dispatches
+        count compiles (they ARE compiles — pre-paid ones) but are
+        excluded from the traffic series."""
+        key = (t_pad, q_pad, u_rows, len(self.table))
+        with self._lock:
+            new_shape = key not in self._seen_shapes
+            if new_shape:
+                self._seen_shapes.add(key)
+        if new_shape:
+            METRICS.inc("trivy_tpu_detect_compiles_total")
+        if warm:
+            return
+        METRICS.inc("trivy_tpu_detect_batches_total")
+        if t_pad:
+            METRICS.observe("trivy_tpu_batch_occupancy_ratio",
+                            n_pairs / t_pad)
+
+    def _launch(self, q_start: np.ndarray, q_count: np.ndarray,
+                q_ver: np.ndarray, total: int, t_pad: int, u_pad: int,
+                warm: bool = False):
+        """Ship CSR descriptors and launch the join (async)."""
+        import jax
+        adv_lo, adv_hi, adv_flags = self.table.device_arrays()
+        ver_dev = self._ver_device(u_pad)
+        self._account_dispatch(total, t_pad, int(q_start.shape[0]),
+                               int(ver_dev.shape[0]), warm=warm)
+        return J.csr_pair_join(
+            adv_lo, adv_hi, adv_flags, ver_dev,
+            jax.device_put(q_start),
+            jax.device_put(q_count),
+            jax.device_put(q_ver),
+            np.int32(total), t_pad)
+
     def _dispatch_impl(self, prep: _Prepared):
         """Launch the pair join; returns the device array (async).
 
@@ -273,57 +353,205 @@ class BatchDetector:
         Shipping the host expansion instead costs ~9 bytes x T_pad per
         batch, which dominates scan time over a slow host<->device
         link."""
+        return self._launch(prep.q_start, prep.q_count, prep.q_ver,
+                            prep.n_pairs, int(prep.pair_row.shape[0]),
+                            prep.u_pad)
+
+    def dispatch_merged(self, preps: list[_Prepared]):
+        """ONE device dispatch covering several prepared batches — the
+        coalescing primitive detectd (detect/sched.py) is built on.
+
+        The CSR expansion treats concatenated descriptors exactly like
+        one bigger batch: only the real (nonzero-count) prefix of each
+        prep's q_* arrays is copied, because an interior zero-count
+        query would shift every later segment (ops/join._csr_core).
+        Each prep's pairs land contiguously in the merged bit vector,
+        so the per-batch result slice is [off, off + n_pairs) and the
+        ordinary _assemble over it is bit-identical to an uncoalesced
+        dispatch by construction — the predicate is elementwise.
+
+        Returns (device bits, per-prep bit offsets, t_pad)."""
+        total = sum(p.n_pairs for p in preps)
+        q_n = sum(p.n_queries for p in preps)
+        t_pad = bucket_size(total, self.pair_floor, self.pair_growth)
+        q_pad = bucket_size(q_n, 64, self.pair_growth, align=64)
+        q_start = np.zeros(q_pad, np.int32)
+        q_count = np.zeros(q_pad, np.int32)
+        q_ver = np.zeros(q_pad, np.int32)
+        offsets = []
+        pos = off = 0
+        for p in preps:
+            k = p.n_queries
+            q_start[pos:pos + k] = p.q_start[:k]
+            q_count[pos:pos + k] = p.q_count[:k]
+            q_ver[pos:pos + k] = p.q_ver[:k]
+            offsets.append(off)
+            pos += k
+            off += p.n_pairs
+        # the shared version pool only grows; the max of the preps'
+        # snapshots and the current count covers every pair_ver row
+        u_pad = max(_next_pow2(self._ver_count),
+                    max(p.u_pad for p in preps))
+        with span("detect.dispatch", n_pairs=total, t_pad=t_pad,
+                  merged=len(preps)):
+            out = self._launch(q_start, q_count, q_ver, total, t_pad,
+                               u_pad)
+        note_dispatch()
+        return out, offsets, t_pad
+
+    def warmup(self, max_pairs: int = 1 << 18) -> int:
+        """Pre-compile the join across the pair-bucket ladder (server
+        --detect-warmup): one empty dispatch per rung, so steady-state
+        traffic reuses cached XLA programs instead of paying a compile
+        on the first batch of each new size. Bounds — not eliminates —
+        recompiles: the version pool's growth and query-count buckets
+        can still introduce new shapes. Returns the rung count."""
+        if len(self.table) == 0:
+            return 0
         import jax
-        adv_lo, adv_hi, adv_flags = self.table.device_arrays()
-        return J.csr_pair_join(
-            adv_lo, adv_hi, adv_flags,
-            self._ver_device(prep.u_pad),
-            jax.device_put(prep.q_start),
-            jax.device_put(prep.q_count),
-            jax.device_put(prep.q_ver),
-            np.int32(prep.n_pairs),
-            prep.pair_row.shape[0])
+        rungs = bucket_ladder(max_pairs, self.pair_floor,
+                              self.pair_growth)
+        u_pad = _next_pow2(max(self._ver_count, 1))
+        done = []
+        for t_pad in rungs:
+            # representative query bucket: real workloads average a few
+            # pairs per nonzero query, so warm the q_pad rung that a
+            # t_pad-sized dispatch most often arrives with
+            q_pad = bucket_size(max(t_pad // 8, 1), 64,
+                                self.pair_growth, align=64)
+            z = np.zeros(q_pad, np.int32)
+            done.append(self._launch(z, z, z, 0, t_pad, u_pad,
+                                     warm=True))
+        jax.block_until_ready(done)
+        return len(rungs)
 
     def detect(self, queries: list[PkgQuery]) -> list[Hit]:
         return self.detect_many([queries])[0]
 
     def detect_many(self, batches: list[list[PkgQuery]]) -> list[list[Hit]]:
-        """Pipelined variant: all batches are dispatched before any result
-        is pulled back, overlapping host prep, device compute, and
-        transfers (replaces the reference's worker-pool overlap,
-        pkg/parallel/pipeline.go)."""
+        """Run every batch through the staged pipeline
+        prep → dispatch → fetch → assemble.
+
+        Each batch's dispatch is issued the moment its prep lands (the
+        device no longer idles through the whole host-prep phase), the
+        fetch streams on the shared get thread, and assembly runs on
+        the small worker pool overlapped with later batches' transfers.
+        In-flight dispatches are bounded by max_pairs_in_flight.
+
+        Under graftscope recording the legacy staged-but-serialized
+        path runs instead: it fences the device between phases so
+        compile/execute/transfer are attributable to their spans —
+        tracing trades the overlap for attribution (bench.py records
+        phase breakdowns on an untimed pass for the same reason)."""
         if len(self.table) == 0:
             return [[] for _ in batches]
+        if recording():
+            return self._detect_many_traced(batches)
+        return self._detect_many_pipelined(batches)
+
+    def _detect_many_pipelined(self,
+                               batches: list[list[PkgQuery]]
+                               ) -> list[list[Hit]]:
+        import jax
+        out: list = [[] for _ in batches]
+        window: deque = deque()   # (idx, prep, get_future) in order
+        asm_futs: list = []       # (idx, assemble future)
+        state = {"pairs": 0, "wait_s": 0.0}
+        n_queries = n_pairs_total = 0
+
+        def drain_one():
+            idx, prep, gf = window.popleft()
+            t_get = time.perf_counter()
+            try:
+                bits = gf.result()
+            finally:
+                # decrement even when the fetch raises — the entry is
+                # already popped, so the outer cleanup can't see it
+                METRICS.gauge_add("trivy_tpu_dispatch_depth", -1.0)
+                state["pairs"] -= int(prep.pair_row.shape[0])
+            now = time.perf_counter()
+            METRICS.observe("trivy_tpu_device_get_stall_seconds",
+                            now - t_get)
+            state["wait_s"] += now - t_get
+            # copy_context: the assemble worker inherits this thread's
+            # trace id / span parentage (graftscope is contextvar-based)
+            ctx = contextvars.copy_context()
+            asm_futs.append((idx, self._asm_pool.submit(
+                ctx.run, self._assemble, prep, bits)))
+
+        try:
+            for idx, qs in enumerate(batches):
+                if not qs:
+                    continue
+                n_queries += len(qs)
+                prep = self._prepare(qs)
+                if prep is None or prep.n_pairs == 0:
+                    continue
+                n_pairs_total += prep.n_pairs
+                t_pad = int(prep.pair_row.shape[0])
+                # backpressure: block on the oldest fetch until the
+                # pair budget admits this dispatch
+                while window and \
+                        state["pairs"] + t_pad > self.max_pairs_in_flight:
+                    drain_one()
+                dev = self._dispatch(prep)
+                METRICS.gauge_add("trivy_tpu_dispatch_depth", 1.0)
+                state["pairs"] += t_pad
+                # device_get, not np.asarray: asarray falls into the
+                # generic __array__ element path on accelerator arrays
+                # (~500x slower for the 512KB bit vectors); device_get
+                # is one memcpy, on the get thread so batch N+1's
+                # result streams while batch N assembles
+                window.append((idx, prep,
+                               self._get_pool.submit(jax.device_get,
+                                                     dev)))
+                # opportunistic: hand finished fetches to assembly
+                # without blocking the prep of the next batch
+                while window and window[0][2].done():
+                    drain_one()
+            while window:
+                drain_one()
+        finally:
+            # a batch that raises mid-loop must not leave the in-flight
+            # gauge ratcheted up forever
+            for _ in range(len(window)):
+                METRICS.gauge_add("trivy_tpu_dispatch_depth", -1.0)
+        t_join = time.perf_counter()
+        for idx, f in asm_futs:
+            out[idx] = f.result()
+        METRICS.inc("trivy_tpu_detect_queries_total", n_queries)
+        METRICS.inc("trivy_tpu_detect_pairs_total", n_pairs_total)
+        METRICS.inc("trivy_tpu_detect_wait_assemble_seconds_total",
+                    state["wait_s"] + time.perf_counter() - t_join)
+        METRICS.inc("trivy_tpu_detect_hits_total",
+                    sum(len(h) for h in out))
+        return out
+
+    def _detect_many_traced(self,
+                            batches: list[list[PkgQuery]]
+                            ) -> list[list[Hit]]:
+        """Legacy staged path, kept for graftscope recording: all preps,
+        then all dispatches, a device fence, then serialized
+        fetch+assemble — every phase lands in its own span."""
         prepped = [self._prepare(qs) if qs else None for qs in batches]
         futures = [None if p is None or p.n_pairs == 0
                    else self._dispatch(p) for p in prepped]
         n_active = sum(1 for f in futures if f is not None)
-        METRICS.inc("trivy_tpu_detect_batches_total", n_active)
         METRICS.inc("trivy_tpu_detect_queries_total",
                     sum(len(qs) for qs in batches))
         METRICS.inc("trivy_tpu_detect_pairs_total",
                     sum(p.n_pairs for p in prepped if p is not None))
         import jax
-        if recording() and n_active:
-            # tracing-only fence: block until every dispatched join has
+        if n_active:
+            # tracing fence: block until every dispatched join has
             # executed, so XLA compile+execute lands in THIS span and
-            # the device-wait spans below read as pure result transfer.
-            # Skipped when not tracing — the fence would serialize the
-            # dispatch/transfer overlap the pipeline exists for.
+            # the device-wait spans below read as pure result transfer
             with span("detect.device_fence", batches=n_active):
                 jax.block_until_ready(
                     [f for f in futures if f is not None])
         t0 = time.perf_counter()
         METRICS.gauge_add("trivy_tpu_dispatch_depth", float(n_active))
         in_flight = n_active
-        # device_get, not np.asarray: asarray falls into the generic
-        # __array__ element path on accelerator arrays (~500x slower
-        # for the 512KB bit vectors); device_get is one memcpy.
-        # Gets run on one background thread so batch N+1's result
-        # streams over the link while batch N assembles (measured
-        # ~12% over serial gets; an on-device concat + single fetch
-        # measured WORSE — it barriers all batches' compute before
-        # the first byte moves).
         get_futs = [None if fut is None
                     else self._get_pool.submit(jax.device_get, fut)
                     for fut in futures]
@@ -343,8 +571,6 @@ class BatchDetector:
                 in_flight -= 1
                 out.append(self._assemble(prep, bits))
         finally:
-            # a batch that raises (device error mid-loop) must not
-            # leave the in-flight gauge ratcheted up forever
             if in_flight:
                 METRICS.gauge_add("trivy_tpu_dispatch_depth",
                                   float(-in_flight))

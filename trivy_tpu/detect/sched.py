@@ -1,0 +1,330 @@
+"""detectd — the shared continuous-batching dispatch scheduler.
+
+Every per-request path pays the same tax: a 16-client registry sweep
+runs 16 concurrent `detect_many` calls, each dispatching its own
+pow2-padded join (BENCH_r05: 57.3 images/s through the server vs 82.7
+local on the same backend — the gap is almost entirely dispatch
+overhead and padding waste multiplied by request count). detectd
+closes it the way inference servers do, with continuous batching:
+
+  handler threads   prep their request's query batches (host work
+                    parallelizes across RPC threads) and enqueue the
+                    prepared CSR descriptors;
+  dispatcher thread wakes on the first pending request, sweeps
+                    everything already queued, holds the window open
+                    for up to `coalesce_wait_ms` only while the device
+                    is busy, concatenates the prepared descriptors,
+                    and issues ONE device join per gathered chunk
+                    (BatchDetector.dispatch_merged) under a
+                    `max_pairs_in_flight` in-flight bound;
+  get thread        streams each merged result back (the detector's
+                    fetch thread — one thread keeps transfers ordered);
+  handler threads   wake with their contiguous bits slice and run the
+                    ordinary per-batch assembly themselves — assembly
+                    parallelism stays per-request (a shared assemble
+                    pool here measured SLOWER than the per-request
+                    path at c=16: it funneled the most host-expensive
+                    stage through two workers).
+
+Correctness falls out of the merge point: coalescing happens at the
+*prepared-CSR* level, so each batch keeps its own `_Prepared` (pair
+expansion, usable-query list) and its bits slice is exactly what an
+uncoalesced dispatch would have produced — the join predicate is
+elementwise, so results are bit-identical, ordering included
+(tests/test_sched.py hammers this).
+
+Latency policy: with an idle device a request dispatches immediately
+(no added latency at c=1 beyond one queue hop); while a dispatch is in
+flight, arrivals gather for at most `coalesce_wait_ms` — so the merge
+window rides on top of device time the request would have waited out
+anyway, and `coalesce_wait_ms` stays the hard bound on single-request
+regression.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass
+
+from ..metrics import METRICS
+from .engine import BatchDetector, Hit, PkgQuery
+
+
+@dataclass
+class SchedOptions:
+    """detectd knobs (server flags --detect-coalesce-wait-ms,
+    --detect-max-inflight-pairs, --detect-warmup)."""
+    coalesce_wait_ms: float = 2.0     # max wait gathering co-dispatchers
+    max_pairs_in_flight: int = 1 << 22  # padded-pair in-flight bound
+    warmup: bool = False              # pre-compile the bucket ladder
+    warmup_max_pairs: int = 1 << 18   # top rung the warmup compiles
+    enabled: bool = True              # False → per-request dispatch
+
+
+class _Request:
+    """One submitted detect_many call. The future resolves to the
+    per-slot list once every slot has its bits slice; empty slots
+    resolve to [] and dispatched slots to (prep, bits) — the CALLER
+    assembles its own slices (see the module docstring's latency
+    note)."""
+
+    __slots__ = ("future", "results", "slots", "n_pairs", "_lock",
+                 "_remaining")
+
+    def __init__(self, n_slots: int):
+        self.future: Future = Future()
+        self.results: list = [None] * n_slots
+        self.slots: list = []       # (slot_idx, _Prepared), n_pairs > 0
+        self.n_pairs = 0
+        self._lock = threading.Lock()
+        self._remaining = 0
+
+    def arm(self) -> None:
+        with self._lock:
+            self._remaining = len(self.slots)
+
+    def complete(self, slot: int, part) -> None:
+        with self._lock:
+            self.results[slot] = part
+            self._remaining -= 1
+            done = self._remaining == 0
+        if done:
+            try:
+                self.future.set_result(self.results)
+            except InvalidStateError:
+                pass  # lost the race with fail()
+
+    def fail(self, exc: BaseException) -> None:
+        try:
+            self.future.set_exception(exc)
+        except InvalidStateError:
+            pass  # first error wins
+
+
+class DispatchScheduler:
+    """detectd: merges concurrent requests' prepared batches into
+    shared device dispatches. One instance per LocalScanner (the server
+    shares that scanner across handler threads)."""
+
+    def __init__(self, detector: BatchDetector,
+                 opts: SchedOptions | None = None):
+        self.detector = detector
+        self.opts = opts or SchedOptions()
+        self._queue: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._inflight_pairs = 0
+        self._closed = False
+        # daemon: an unclosed scheduler must not block interpreter
+        # exit; close() still joins it for a clean shutdown
+        self._thread = threading.Thread(
+            target=self._run, name="detectd-dispatch", daemon=True)
+        self._thread.start()
+
+    # ---- submission ---------------------------------------------------
+
+    def submit(self, batches: list[list[PkgQuery]]) -> Future:
+        """Prep every batch on the CALLING thread (host prep scales
+        with handler threads; the dispatcher only merges + launches)
+        and enqueue; resolves to detect_many-shaped results."""
+        det = self.detector
+        req = _Request(len(batches))
+        n_queries = 0
+        if len(det.table):
+            for i, qs in enumerate(batches):
+                if not qs:
+                    req.results[i] = []
+                    continue
+                n_queries += len(qs)
+                prep = det._prepare(qs)
+                if prep is None or prep.n_pairs == 0:
+                    req.results[i] = []
+                    continue
+                req.slots.append((i, prep))
+                req.n_pairs += prep.n_pairs
+        else:
+            for i in range(len(batches)):
+                req.results[i] = []
+        req.arm()
+        METRICS.inc("trivy_tpu_detect_queries_total", n_queries)
+        METRICS.inc("trivy_tpu_detect_pairs_total", req.n_pairs)
+        if not req.slots:
+            req.future.set_result(req.results)
+            return req.future
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("DispatchScheduler is closed")
+            # enqueue under the lock: close() flips _closed before its
+            # sentinel, so every accepted request precedes the sentinel
+            self._queue.put(req)
+        return req.future
+
+    def detect_many(self, batches: list[list[PkgQuery]]
+                    ) -> list[list[Hit]]:
+        parts = self.submit(batches).result()
+        # assemble HERE, on the requesting thread: the most
+        # host-expensive stage keeps the same per-request parallelism
+        # as the uncoalesced path (concurrent RPC handlers assemble
+        # concurrently) while the dispatches stay merged
+        out = []
+        for part in parts:
+            if isinstance(part, tuple):
+                prep, bits = part
+                out.append(self.detector._assemble(prep, bits))
+            else:
+                out.append(part)
+        METRICS.inc("trivy_tpu_detect_hits_total",
+                    sum(len(h) for h in out))
+        return out
+
+    def detect(self, queries: list[PkgQuery]) -> list[Hit]:
+        return self.detect_many([queries])[0]
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def close(self) -> None:
+        """Flush pending requests, stop the dispatcher, and wait for
+        in-flight work to settle. Idempotent; the scheduler rejects
+        submissions afterwards. (The detector's fetch/assemble pools
+        are owned by the detector — BatchDetector.close() joins them.)"""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._queue.put(None)
+        self._thread.join()
+        # dispatched work completes on the detector's pools; wait so
+        # close() guarantees no scheduler-driven work is still running
+        with self._cv:
+            self._cv.wait_for(lambda: self._inflight_pairs == 0,
+                              timeout=60.0)
+
+    # ---- dispatcher ---------------------------------------------------
+
+    def _run(self) -> None:
+        import jax  # noqa: F401 — fail fast off the request path
+        opts = self.opts
+        stop = False
+        while not stop:
+            try:
+                item = self._queue.get(timeout=0.5)
+            except queue_mod.Empty:
+                continue
+            if item is None:
+                break
+            pending = [item]
+            pairs = item.n_pairs
+            # sweep everything already queued (free coalescing), then
+            # hold the window open — but ONLY while a dispatch is in
+            # flight: with an idle device, waiting would trade latency
+            # for nothing, while a busy device makes the wait free
+            # (the request would be queued behind it anyway)
+            deadline = time.monotonic() + opts.coalesce_wait_ms / 1e3
+            while pairs < opts.max_pairs_in_flight:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue_mod.Empty:
+                    with self._cv:
+                        busy = self._inflight_pairs > 0
+                    timeout = deadline - time.monotonic()
+                    if not busy or timeout <= 0:
+                        break
+                    try:
+                        nxt = self._queue.get(
+                            timeout=min(timeout,
+                                        opts.coalesce_wait_ms / 4e3))
+                    except queue_mod.Empty:
+                        continue
+                if nxt is None:
+                    stop = True
+                    break
+                pending.append(nxt)
+                pairs += nxt.n_pairs
+            METRICS.observe("trivy_tpu_detect_queue_depth",
+                            float(len(pending)))
+            try:
+                self._dispatch_round(pending)
+            except BaseException as e:  # noqa: BLE001 — detectd must
+                # survive any one round; the affected requests fail
+                for req in pending:
+                    req.fail(e)
+        # flush anything enqueued before the sentinel
+        while True:
+            try:
+                left = self._queue.get_nowait()
+            except queue_mod.Empty:
+                break
+            if left is None:
+                continue
+            try:
+                self._dispatch_round([left])
+            except BaseException as e:  # noqa: BLE001
+                left.fail(e)
+
+    def _dispatch_round(self, pending: list[_Request]) -> None:
+        """Chunk the gathered slots under the pair budget and issue one
+        merged dispatch per chunk."""
+        import jax
+        budget = self.opts.max_pairs_in_flight
+        chunk: list = []   # (req, slot_idx, prep)
+        chunk_pairs = 0
+
+        def flush():
+            if not chunk:
+                return
+            # backpressure: admit this dispatch only when the in-flight
+            # padded pairs leave room (a chunk bigger than the whole
+            # budget still goes — alone — once the device drains)
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._inflight_pairs == 0
+                    or self._inflight_pairs + chunk_pairs <= budget,
+                    timeout=30.0)
+            preps = [p for _, _, p in chunk]
+            n_req = len({id(r) for r, _, _ in chunk})
+            dev, offsets, t_pad = \
+                self.detector.dispatch_merged(preps)
+            METRICS.observe("trivy_tpu_detect_coalesce_size",
+                            float(n_req))
+            METRICS.gauge_add("trivy_tpu_dispatch_depth", 1.0)
+            with self._cv:
+                self._inflight_pairs += t_pad
+            gf = self.detector._get_pool.submit(jax.device_get, dev)
+            items = list(chunk)
+            gf.add_done_callback(
+                lambda fut: self._on_fetched(fut, items, offsets,
+                                             t_pad))
+
+        for req, (slot, prep) in ((r, s) for r in pending
+                                  for s in r.slots):
+            if chunk and chunk_pairs + prep.n_pairs > budget:
+                flush()
+                chunk, chunk_pairs = [], 0
+            chunk.append((req, slot, prep))
+            chunk_pairs += prep.n_pairs
+            if chunk_pairs >= budget:
+                flush()
+                chunk, chunk_pairs = [], 0
+        flush()
+
+    # ---- fetch callback (runs on the get thread) ----------------------
+
+    def _on_fetched(self, fut, items: list, offsets: list,
+                    t_pad: int) -> None:
+        with self._cv:
+            self._inflight_pairs -= t_pad
+            self._cv.notify_all()
+        METRICS.gauge_add("trivy_tpu_dispatch_depth", -1.0)
+        try:
+            bits = fut.result()
+        except BaseException as e:  # noqa: BLE001 — device/transfer
+            for req, _, _ in items:
+                req.fail(e)
+            return
+        # hand each request its contiguous slice; the waiting handler
+        # thread assembles it (DispatchScheduler.detect_many)
+        for (req, slot, prep), off in zip(items, offsets):
+            req.complete(slot, (prep, bits[off:off + prep.n_pairs]))

@@ -42,6 +42,9 @@ CONFIG_PATHS = {
     "pkg_types": "pkg-types",
     "config_check": "misconfiguration.check-paths",
     "check_namespaces": "misconfiguration.namespaces",
+    "detect_coalesce_wait_ms": "detect.coalesce-wait-ms",
+    "detect_max_inflight_pairs": "detect.max-inflight-pairs",
+    "detect_warmup": "detect.warmup",
 }
 
 _TRUE = {"1", "t", "true", "yes", "on"}
@@ -104,6 +107,16 @@ def _coerce(action: argparse.Action, raw: Any, origin: str) -> Any:
         except (TypeError, ValueError):
             raise ConfigError(
                 f"{origin}: invalid integer {raw!r} for "
+                f"--{_flag_name(action)}")
+    if action.type is float or isinstance(action.default, float):
+        # float-typed flags (--detect-coalesce-wait-ms) resolved from
+        # env/config used to fall through to str() and blow up argless
+        # downstream — coerce like int flags do
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            raise ConfigError(
+                f"{origin}: invalid number {raw!r} for "
                 f"--{_flag_name(action)}")
     return str(raw)
 

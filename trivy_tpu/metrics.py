@@ -239,6 +239,21 @@ METRICS.declare(
 METRICS.declare("trivy_tpu_dispatch_depth", "gauge",
                 "Device dispatches currently in flight (dispatched, "
                 "result not yet fetched).")
+METRICS.declare(
+    "trivy_tpu_detect_coalesce_size", "histogram",
+    "Concurrent requests merged into one detectd device dispatch "
+    "(1 = no coalescing happened for that dispatch).",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
+METRICS.declare(
+    "trivy_tpu_detect_queue_depth", "histogram",
+    "Requests pending in the detectd queue when the dispatcher "
+    "gathered a round.",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
+METRICS.declare(
+    "trivy_tpu_detect_compiles_total", "counter",
+    "Distinct join dispatch shapes seen by this process — each one "
+    "is an XLA compilation (the bucket ladder and --detect-warmup "
+    "exist to bound this).")
 METRICS.declare("trivy_tpu_secret_files_total", "counter",
                 "Files through the secret scanner.")
 METRICS.declare("trivy_tpu_secret_bytes_total", "counter",

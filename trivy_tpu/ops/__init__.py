@@ -3,9 +3,39 @@ candidate-pair advisory join, and the secret keyword prefilter."""
 
 
 def next_pow2(n: int, floor: int = 128) -> int:
-    """Smallest power of two ≥ max(n, floor) — the shared padding-bucket
-    policy that bounds recompilation across batch shapes."""
+    """Smallest power of two ≥ max(n, floor) — the legacy padding-bucket
+    policy (equivalent to bucket_size with growth=2 and a pow2 floor)."""
     b = floor
     while b < n:
         b *= 2
     return b
+
+
+def bucket_size(n: int, floor: int = 128, growth: float = 2.0,
+                align: int = 128) -> int:
+    """Smallest rung of the geometric bucket ladder ≥ max(n, floor).
+
+    The shared padding policy for dispatch shapes: every padded
+    dimension lands on a rung of `floor * growth^k` (rounded up to a
+    multiple of `align`, the TPU lane width), so the number of distinct
+    XLA programs a serving process compiles is logarithmic in the
+    largest batch it ever sees. growth=2.0 with a pow2 floor reproduces
+    next_pow2 exactly; a smaller growth (e.g. 1.5) trades more compiled
+    shapes for less padding waste per dispatch."""
+    if growth <= 1.0:
+        raise ValueError(f"bucket growth must be > 1.0, got {growth}")
+    b = int(floor)
+    while b < n:
+        nxt = (int(b * growth) + align - 1) // align * align
+        b = max(nxt, b + align)
+    return b
+
+
+def bucket_ladder(max_n: int, floor: int = 128, growth: float = 2.0,
+                  align: int = 128) -> list:
+    """Every rung of the bucket ladder from `floor` up to the first
+    rung ≥ max_n — the shape set a warmup pass pre-compiles."""
+    rungs = [int(floor)]
+    while rungs[-1] < max_n:
+        rungs.append(bucket_size(rungs[-1] + 1, floor, growth, align))
+    return rungs

@@ -155,6 +155,10 @@ class MeshDetector:
             row_offset=self.st.row_offset, row_len=self.st.row_len)
         self._inner = BatchDetector(table)
 
+    def close(self) -> None:
+        """Join the inner engine's worker threads (idempotent)."""
+        self._inner.close()
+
     def detect(self, queries) -> list:
         inner = self._inner
         if len(inner.table) == 0 or not queries:
@@ -168,8 +172,17 @@ class MeshDetector:
                                  prep.q_ver, self.dp)
         # the inner detector's cached device pool (re-shipped only on
         # growth) doubles as the replicated mesh operand
-        bits = sharded_csr_join(self.mesh, self._st_dev,
-                                inner._ver_device(prep.u_pad), part,
+        ver_dev = inner._ver_device(prep.u_pad)
+        # per-dispatch accounting (occupancy vs the mesh's total padded
+        # cell capacity, batch/compile counters) — the mesh path
+        # launches its own join and would otherwise go dark on the
+        # series the single-chip dispatch path emits
+        t_total = int(part.t_loc) * int(part.valid.shape[0]) \
+            * int(part.valid.shape[1])
+        inner._account_dispatch(prep.n_pairs, t_total,
+                                int(part.q_start.shape[-1]),
+                                int(ver_dev.shape[0]))
+        bits = sharded_csr_join(self.mesh, self._st_dev, ver_dev, part,
                                 prep.n_pairs)
         return inner._assemble(prep, bits)
 

@@ -12,6 +12,8 @@ from __future__ import annotations
 import datetime as dt
 from typing import Optional
 
+from typing import TYPE_CHECKING
+
 from . import types as T
 from .db.table import AdvisoryTable
 from .detect.engine import BatchDetector
@@ -19,16 +21,39 @@ from .detect.fill import fill_info
 from .detect.langpkg import LangpkgScanner
 from .detect.ospkg import OspkgScanner
 from .fanal.applier import apply_layers
-from .obs import ensure_trace, span
+from .obs import ensure_trace, recording, span
+
+if TYPE_CHECKING:
+    from .detect.sched import SchedOptions
 
 
 class LocalScanner:
-    def __init__(self, cache, table: AdvisoryTable):
+    def __init__(self, cache, table: AdvisoryTable,
+                 sched: "SchedOptions | None" = None):
         self.cache = cache
         self.table = table
         self.detector = BatchDetector(table)
+        # detectd: when the owner passes SchedOptions (the scan server
+        # does by default), detection routes through the shared
+        # coalescing scheduler so concurrent requests merge into
+        # shared device dispatches
+        self.sched = None
+        if sched is not None and sched.enabled:
+            from .detect.sched import DispatchScheduler
+            self.sched = DispatchScheduler(self.detector, sched)
+            if sched.warmup:
+                self.detector.warmup(sched.warmup_max_pairs)
         self.ospkg = OspkgScanner(self.detector)
         self.langpkg = LangpkgScanner(self.detector)
+
+    def close(self) -> None:
+        """Join detectd and the detector's worker threads (idempotent).
+        Owners that replace or retire a scanner (ServerState.swap_table,
+        server shutdown) must call this — the pools' threads are
+        non-daemon."""
+        if self.sched is not None:
+            self.sched.close()
+        self.detector.close()
 
     def scan(self, target: str, artifact_id: str, blob_ids: list[str],
              options: Optional[T.ScanOptions] = None,
@@ -102,10 +127,17 @@ class LocalScanner:
             sp.attrs.update(batches=len(batches),
                             queries=sum(len(b) for b in batches))
 
-        # phase 2: one pipelined dispatch across all targets (device)
+        # phase 2: one pipelined dispatch across all targets (device).
+        # Server mode routes through detectd so concurrent requests
+        # coalesce; under graftscope recording the direct path runs
+        # instead — its fenced stages keep phase attribution exact
+        # (the scheduler's threads would scatter the spans).
         if batches:
             with span("scan.detect", batches=len(batches)):
-                hit_lists = self.detector.detect_many(batches)
+                if self.sched is not None and not recording():
+                    hit_lists = self.sched.detect_many(batches)
+                else:
+                    hit_lists = self.detector.detect_many(batches)
         else:
             hit_lists = []
 

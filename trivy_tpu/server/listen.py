@@ -38,7 +38,8 @@ _log = _get_logger("server")
 
 class ServerState:
     def __init__(self, table, cache_dir: str, token: str = "",
-                 cache_backend: str = "fs"):
+                 cache_backend: str = "fs", detect_opts=None):
+        from ..detect.sched import SchedOptions
         if cache_backend.startswith("redis://"):
             from ..fanal.redis_cache import RedisCache
             self.cache = RedisCache(cache_backend)
@@ -49,26 +50,69 @@ class ServerState:
             self.cache = FSCache(cache_dir)
         self.token = token
         self._lock = threading.Lock()
-        self._scanner = LocalScanner(self.cache, table)
+        # server mode runs detectd by default: concurrent RPCs'
+        # prepared batches coalesce into shared device dispatches
+        # (detect/sched.py; --detect-* flags tune or disable it)
+        self.detect_opts = detect_opts if detect_opts is not None \
+            else SchedOptions()
+        self._scanner = LocalScanner(self.cache, table,
+                                     sched=self.detect_opts)
         self._inflight = 0
+        self._closed = False
+        # scanner generations: a request started under generation g
+        # may hold that generation's scanner for its whole lifetime, so
+        # a swapped-out scanner is closeable exactly when its
+        # generation's active count drains — not on the GLOBAL count,
+        # which under sustained traffic never reaches zero
+        self._gen = 0
+        self._gen_active = {0: 0}
 
-    def request_started(self) -> None:
+    def request_started(self) -> int:
+        """→ the scanner generation this request runs under; pass it
+        back to request_finished."""
         with self._lock:
             self._inflight += 1
+            self._gen_active[self._gen] += 1
+            return self._gen
 
-    def request_finished(self) -> None:
+    def request_finished(self, gen: int | None = None) -> None:
         with self._lock:
             self._inflight -= 1
+            g = self._gen if gen is None else gen
+            self._gen_active[g] -= 1
+            if g != self._gen and not self._gen_active[g]:
+                del self._gen_active[g]
 
     @property
     def scanner(self) -> LocalScanner:
         with self._lock:
             return self._scanner
 
+    def close(self) -> None:
+        """Server shutdown: join the scanner's detectd + engine worker
+        threads (idempotent)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            scanner = self._scanner
+        scanner.close()
+
     def swap_table(self, table) -> None:
         """DB hot swap (reference listen.go dbWorker)."""
+        # build (and, with --detect-warmup, XLA-warm) the new scanner
+        # OUTSIDE the lock: construction can take seconds and every
+        # handler blocks on request_started behind this lock
+        new_scanner = LocalScanner(self.cache, table,
+                                   sched=self.detect_opts)
         with self._lock:
-            self._scanner = LocalScanner(self.cache, table)
+            old_scanner = self._scanner
+            old_gen = self._gen
+            self._gen += 1
+            self._gen_active.setdefault(self._gen, 0)
+            if not self._gen_active[old_gen]:
+                del self._gen_active[old_gen]
+            self._scanner = new_scanner
         # the swapped-in table's object graph (~1M small objects for a
         # full trivy-db) is immutable; freezing it out of the cyclic
         # collector keeps gen2 passes from stalling in-flight scans.
@@ -85,19 +129,54 @@ class ServerState:
         # block behind a multi-hundred-ms gen2 pass (healthz probes!)
         gc.collect()
         deadline = time.monotonic() + 2.0
-        while time.monotonic() < deadline:
+        froze = False
+        while time.monotonic() < deadline and \
+                not (froze and old_scanner is None):
             with self._lock:
-                if self._inflight == 0:
+                drained = not self._gen_active.get(old_gen)
+                if not froze and self._inflight == 0:
                     # young-gen sweep inside the window: requests that
                     # finished during the wait leave fresh cyclic
                     # garbage that must die before freeze pins it;
                     # gen-1 collects are cheap enough to hold the lock
                     gc.collect(1)
                     gc.freeze()
-                    return
-            time.sleep(0.01)
-        # never went quiescent: skip the freeze; gen2 passes just get
-        # slower until the next swap — correctness is unaffected
+                    froze = True
+            if drained and old_scanner is not None:
+                # no request started before the swap is still running:
+                # nothing can hold the old scanner, so its executors
+                # join without breaking an in-flight detect (the
+                # pre-close() leak: every swap stranded the old
+                # engine's threads forever)
+                old_scanner.close()
+                old_scanner = None
+            if not (froze and old_scanner is None):
+                time.sleep(0.01)
+        # old generation still busy (a long scan straddles the swap):
+        # retire its scanner from a waiter thread once its LAST request
+        # drains — never force-close, that would yank the executors out
+        # from under the running detect. An un-frozen swap just means
+        # gen2 GC passes stay slower until the next swap.
+        if old_scanner is not None:
+            self._close_when_idle(old_scanner, old_gen)
+
+    def _close_when_idle(self, scanner: LocalScanner,
+                         gen: int) -> None:
+        def waiter():
+            deadline = time.monotonic() + 600.0
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._gen_active.get(gen):
+                        break
+                time.sleep(0.05)
+            else:
+                _log.warning(
+                    "swap: generation %d still busy after 600s; "
+                    "leaking its scanner workers", gen)
+                return
+            scanner.close()
+        threading.Thread(target=waiter, name="swap-close",
+                         daemon=True).start()
 
 
 def _result_to_json(res: T.Result) -> dict:
@@ -131,11 +210,11 @@ class Handler(BaseHTTPRequestHandler):
         # connection stamped on the handler instance — a health probe
         # must not echo an unrelated scan's id
         self._trace_id = ""
-        st.request_started()
+        gen = st.request_started()
         try:
             self._do_get()
         finally:
-            st.request_finished()
+            st.request_finished(gen)
 
     def _do_get(self):
         if self.path == "/healthz":
@@ -199,7 +278,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         st = self.state
-        st.request_started()
+        gen = st.request_started()
         # per-RPC trace stamp: reuse the client's id when forwarded,
         # mint one otherwise; every span/log line below inherits it
         tid = self.headers.get(TRACE_HEADER) or ""
@@ -209,7 +288,7 @@ class Handler(BaseHTTPRequestHandler):
                 with span("server.rpc", route=self.path):
                     self._do_post(st)
         finally:
-            st.request_finished()
+            st.request_finished(gen)
 
     def _do_post(self, st):
         if st.token and self.headers.get(TOKEN_HEADER) != st.token:
@@ -295,14 +374,18 @@ class Handler(BaseHTTPRequestHandler):
 
 def serve(host: str, port: int, table, cache_dir: str, token: str = "",
           ready_event: threading.Event | None = None,
-          cache_backend: str = "fs", trace_path: str = ""):
+          cache_backend: str = "fs", trace_path: str = "",
+          detect_opts=None):
     """`trace_path` arms graftscope recording for the server's
     lifetime and dumps the Chrome trace-event JSON there on shutdown
-    (the CLI's `server --trace FILE`)."""
+    (the CLI's `server --trace FILE`). `detect_opts` (SchedOptions)
+    tunes detectd — coalesce wait, in-flight pair bound, warmup."""
     if trace_path:
         from ..obs import COLLECTOR
         COLLECTOR.enable()
-    Handler.state = ServerState(table, cache_dir, token, cache_backend)
+    state = ServerState(table, cache_dir, token, cache_backend,
+                        detect_opts=detect_opts)
+    Handler.state = state
     httpd = ThreadingHTTPServer((host, port), Handler)
     if ready_event is not None:
         ready_event.set()
@@ -310,6 +393,7 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
         httpd.serve_forever()
     finally:
         httpd.server_close()
+        state.close()
         if trace_path:
             from ..obs import COLLECTOR, write_chrome_trace
             COLLECTOR.disable()
@@ -319,9 +403,12 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
 
 
 def serve_background(host: str, port: int, table, cache_dir: str,
-                     token: str = ""):
-    """Start in a daemon thread; returns (httpd, state) once listening."""
-    Handler.state = ServerState(table, cache_dir, token)
+                     token: str = "", detect_opts=None):
+    """Start in a daemon thread; returns (httpd, state) once listening.
+    Callers own shutdown: `httpd.shutdown()` then `state.close()` (the
+    detect engine's worker threads are non-daemon)."""
+    Handler.state = ServerState(table, cache_dir, token,
+                                detect_opts=detect_opts)
     httpd = ThreadingHTTPServer((host, port), Handler)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
