@@ -491,6 +491,69 @@ def bench_server_concurrency(table):
     return out
 
 
+DEGRADED_IMAGES = 192   # subset: the python-side host join bounds this
+
+
+def bench_degraded_mode(table, images):
+    """graftguard scenario: (a) host-fallback join throughput with the
+    breaker forced open vs the device path on the same subset, and
+    (b) p99 per-image detect latency under flaky(0.05) dispatch faults
+    (each flake costs one breaker round-trip plus a host recompute —
+    the tail a production SLO would feel). Hit parity across all three
+    passes is recorded: degraded mode must never change findings."""
+    from trivy_tpu.detect.engine import BatchDetector
+    from trivy_tpu.resilience import FAILPOINTS, GUARD
+
+    sub = images[:DEGRADED_IMAGES]
+    det = BatchDetector(table)
+    try:
+        run_device(det, sub)   # warm compiles out of the timed pass
+        t0 = time.perf_counter()
+        hits_dev = run_device(det, sub)
+        dev_s = time.perf_counter() - t0
+
+        # force degraded mode and HOLD it: with the default 5 s reset
+        # window a half-open probe would flip the pass back onto the
+        # healthy device mid-measurement and overstate host throughput
+        GUARD.configure(reset_timeout_s=3600.0)
+        GUARD.breaker.trip()
+        t0 = time.perf_counter()
+        hits_host = run_device(det, sub)
+        host_s = time.perf_counter() - t0
+        GUARD.breaker.reset()
+
+        # seeded 5% dispatch flakes; short reset window so the breaker
+        # exercises open→half-open→closed repeatedly during the sweep
+        GUARD.configure(reset_timeout_s=0.05)
+        FAILPOINTS.set("detect.dispatch", "flaky", 0.05, seed=9)
+        lats = []
+        hits_flaky = 0
+        for img in sub:
+            t1 = time.perf_counter()
+            hits_flaky += sum(len(h) for h in det.detect_many([img]))
+            lats.append(time.perf_counter() - t1)
+        lats.sort()
+        p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+        return {
+            "device_ips": round(len(sub) / dev_s, 2),
+            "host_fallback_ips": round(len(sub) / host_s, 2),
+            "fallback_slowdown": round(host_s / dev_s, 2),
+            "flaky05_p99_ms": round(p99 * 1e3, 2),
+            "flaky05_mean_ms": round(
+                sum(lats) / len(lats) * 1e3, 2),
+            "parity_ok": bool(hits_host == hits_dev
+                              and hits_flaky == hits_dev),
+        }
+    finally:
+        # an exception mid-scenario must not leave global fault
+        # injection armed (or the breaker held open) for every
+        # subsequent bench in this process
+        FAILPOINTS.configure("")
+        GUARD.breaker.reset()
+        GUARD.configure(reset_timeout_s=5.0)
+        det.close()
+
+
 def bench_secrets_host():
     """Host bytes.find gate over the same corpus/keywords (MB/s), and
     the full host-only scan_files pipeline for the same corpus."""
@@ -560,6 +623,10 @@ def device_child_main():
         server_conc = bench_server_concurrency(table)
     except Exception:
         server_conc = None
+    try:
+        degraded = bench_degraded_mode(table, images)
+    except Exception:
+        degraded = None
 
     import jax
     payload = {
@@ -576,6 +643,7 @@ def device_child_main():
         "images_per_sec_server": server_ips,
         "server_hits": server_hits,
         "server_concurrency": server_conc,
+        "degraded_mode": degraded,
         "device": str(jax.devices()[0]),
         "build_s": build_s,
         "scan_s": dev_s,
@@ -804,6 +872,14 @@ def main():
         except Exception as e:
             diag.append(f"server_concurrency bench failed: {e}")
         try:
+            # graftguard degraded-mode scenario (host fallback vs
+            # device, p99 under flaky dispatch faults); the device
+            # child's numbers override when present
+            result["degraded_mode"] = bench_degraded_mode(table,
+                                                          images)
+        except Exception as e:
+            diag.append(f"degraded_mode bench failed: {e}")
+        try:
             arch_ips, _arch_hits = bench_archive_e2e(table)
             result["images_per_sec_archive_e2e"] = round(arch_ips, 1)
         except Exception as e:
@@ -837,6 +913,8 @@ def main():
                 result["server_backend"] = "device"
             if dev.get("server_concurrency"):
                 result["server_concurrency"] = dev["server_concurrency"]
+            if dev.get("degraded_mode"):
+                result["degraded_mode"] = dev["degraded_mode"]
             result["host_prep_ms"] = round(dev["host_prep_ms"], 1)
             result["device_ms"] = round(dev["device_ms"], 1)
             result["assemble_ms"] = round(dev["assemble_ms"], 1)

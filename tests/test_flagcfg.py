@@ -37,6 +37,22 @@ def test_env_overrides_default():
     assert args.exit_code == 3
 
 
+def test_append_flag_env_keeps_paren_commas():
+    """graftguard failpoint specs use a paren form with an interior
+    comma — `flaky(0.05,7)` — which the env/config comma-split for
+    append flags must NOT cut in half."""
+    args = _resolve(
+        ["server", "--db", "x"],
+        env={"TRIVY_FAILPOINT":
+             "rpc.scan=flaky(0.05,7),db.download=error"})
+    assert args.failpoint == ["rpc.scan=flaky(0.05,7)",
+                              "db.download=error"]
+    # round-trip through the failpoint grammar itself
+    from trivy_tpu.resilience.failpoints import parse_spec
+    specs = parse_spec(";".join(args.failpoint))
+    assert specs["rpc.scan"].arg == 0.05
+
+
 def test_config_file_overrides_default(tmp_path):
     args = _resolve(
         ["repo", "x"], tmp_path=tmp_path,

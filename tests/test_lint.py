@@ -294,6 +294,98 @@ def test_sched_no_clocks_in_device_code():
     assert [(f.rule, f.line) for f in fs] == [("TPU107", 3)]
 
 
+def test_resilience_in_device_code_detected():
+    """TPU108: failpoint probes, breaker reads, and deadline clocks in
+    a jitted core run once at trace time — all three shapes must be
+    caught (the TPU107 pattern extended to graftguard)."""
+    src = (
+        "import jax\n"
+        "from trivy_tpu.resilience import (Deadline, FAILPOINTS,\n"
+        "                                  GUARD, failpoint)\n"
+        "def _guarded_core(x):\n"
+        "    failpoint('detect.dispatch')\n"
+        "    FAILPOINTS.fire('detect.device_get')\n"
+        "    if GUARD.allow_device():\n"
+        "        x = x + 1\n"
+        "    deadline = Deadline(1.0)\n"
+        "    return x + deadline.remaining()\n"
+        "j = jax.jit(_guarded_core)\n"
+    )
+    fs = _lint("trivy_tpu/ops/fixture.py", src)
+    assert all(f.rule == "TPU108" for f in fs)
+    # failpoint, FAILPOINTS.fire, GUARD.allow_device, Deadline(),
+    # deadline.remaining() — the clock-read ban keys on deadline-NAMED
+    # values, like TPU107 keys on names
+    assert [f.line for f in fs] == [5, 6, 7, 9, 10]
+    assert all(f.context == "_guarded_core" for f in fs)
+
+
+def test_resilience_on_host_side_is_fine():
+    src = (
+        "import jax\n"
+        "from trivy_tpu.resilience import GUARD, failpoint\n"
+        "def _plain_core(x):\n"
+        "    return x + 1\n"
+        "j = jax.jit(_plain_core)\n"
+        "def host_wrapper(x):\n"          # host orchestration: allowed
+        "    if not GUARD.allow_device():\n"
+        "        return None\n"
+        "    failpoint('detect.dispatch')\n"
+        "    with GUARD.watch('detect.dispatch'):\n"
+        "        return j(x)\n"
+    )
+    assert _lint("trivy_tpu/ops/fixture.py", src) == []
+
+
+def test_breaker_method_on_breaker_named_value_detected():
+    src = (
+        "import jax\n"
+        "def _b_core(x, my_breaker: tuple):\n"
+        "    my_breaker.record_failure()\n"
+        "    return x\n"
+        "j = jax.jit(_b_core, static_argnums=(1,))\n"
+    )
+    fs = _lint("trivy_tpu/detect/fixture.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU108", 3)]
+
+
+def test_sched_failpoint_in_device_code_detected():
+    """TPU108 covers jitted cores wherever they appear — a failpoint
+    sneaking into a detect/sched.py core must be caught."""
+    src = (
+        "import jax\n"
+        "from trivy_tpu.resilience import failpoint\n"
+        "def _sched_core(x):\n"
+        "    failpoint('detect.dispatch')\n"
+        "    return x + 1\n"
+        "j = jax.jit(_sched_core)\n"
+    )
+    fs = _lint("trivy_tpu/detect/sched.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU108", 4)]
+
+
+def test_resilience_registry_in_lock_hygiene_scope():
+    """Satellite: the failpoint registry (trivy_tpu/resilience/) is
+    shared across handler threads and the watchdog — TPU106 must
+    cover it."""
+    src = (
+        "import threading\n"
+        "class Registry:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._specs = {}\n"
+        "    def bad(self, site, spec):\n"
+        "        self._specs[site] = spec\n"
+        "    def good(self, site, spec):\n"
+        "        with self._lock:\n"
+        "            self._specs[site] = spec\n"
+    )
+    fs = _lint("trivy_tpu/resilience/failpoints.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+    # outside the scoped modules the same class is not checked
+    assert _lint("trivy_tpu/report/fixture.py", src) == []
+
+
 def test_regex_match_span_is_not_a_trace_span():
     # m.span() (re.Match) in device code must not trip the span ban;
     # it is caught by nothing here (host-ish API, but not TPU107's
@@ -508,7 +600,8 @@ def test_list_rules_covers_all_engines(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("TPU101", "TPU102", "TPU103", "TPU104", "TPU105",
-                "TPU106", "JAX201", "JAX204", "JAX206", "XCHK301"):
+                "TPU106", "TPU107", "TPU108", "JAX201", "JAX204",
+                "JAX206", "XCHK301"):
         assert rid in out
     assert set(RULES) >= {"TPU101", "XCHK301"}
 

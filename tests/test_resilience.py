@@ -1,0 +1,1042 @@
+"""graftguard (trivy_tpu/resilience/) tier-1 gate — the chaos suite.
+
+Covers: the failpoint registry (spec grammar, seeded flaky streams);
+the RetryPolicy (full jitter bounds, budget cap, Retry-After floors)
+and its three edges (RPC client, trivy-db download, OCI registry); the
+circuit breaker state machine; host-fallback join bit-identity against
+the device path; chaos equivalence — under every failpoint mode the
+scan results are hit-for-hit identical to an unfaulted run (reusing
+the test_sched hammer harness); the acceptance scenario — a hang
+injected mid-load at c=8 trips the watchdog, everything completes via
+host fallback, and a half-open probe restores the device path; and
+admission control — 429/503 + Retry-After, deadline-bounded queueing,
+/healthz + /metrics exposure.
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trivy_tpu.db import build_table
+from trivy_tpu.db.fixtures import load_fixture_files
+from trivy_tpu.detect import (
+    BatchDetector, DispatchScheduler, PkgQuery, SchedOptions,
+)
+from trivy_tpu.metrics import METRICS
+from trivy_tpu.resilience import (
+    FAILPOINTS, GUARD, AdmissionOptions, AdmissionQueue, CircuitBreaker,
+    Deadline, FailpointError, RetryPolicy, Shed, failpoint, retry_on,
+)
+from trivy_tpu.resilience.failpoints import parse_spec
+from trivy_tpu.resilience.hostjoin import (
+    host_csr_pair_join, host_pair_join,
+)
+
+from helpers import parse_exposition
+from test_sched import FIXTURES, _rand_requests
+
+
+@pytest.fixture(scope="module")
+def table():
+    advisories, details, _ = load_fixture_files(FIXTURES)
+    t = build_table(advisories, details)
+    assert len(t) > 0
+    return t
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard():
+    """Every test starts and ends with no armed failpoints and a
+    closed breaker (GUARD is process-global, like METRICS)."""
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+    GUARD.configure(dispatch_timeout_s=120.0, fail_threshold=3,
+                    reset_timeout_s=5.0)
+    yield
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+    GUARD.configure(dispatch_timeout_s=120.0, fail_threshold=3,
+                    reset_timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# failpoint registry
+
+
+class TestFailpoints:
+    def test_spec_grammar_both_forms(self):
+        specs = parse_spec("detect.dispatch=hang:100;"
+                           "rpc.scan=flaky(0.05,7),db.download=error")
+        assert specs["detect.dispatch"].mode == "hang"
+        assert specs["detect.dispatch"].arg == 100.0
+        assert specs["rpc.scan"].mode == "flaky"
+        assert specs["rpc.scan"].arg == 0.05
+        assert specs["db.download"].mode == "error"
+
+    def test_spec_rejects_unknown_site_and_mode(self):
+        with pytest.raises(ValueError, match="unknown failpoint site"):
+            parse_spec("detect.dispach=error")
+        with pytest.raises(ValueError, match="unknown failpoint mode"):
+            parse_spec("detect.dispatch=explode")
+        with pytest.raises(ValueError, match="needs a millisecond"):
+            parse_spec("detect.dispatch=hang")
+        with pytest.raises(ValueError, match="probability"):
+            parse_spec("rpc.scan=flaky:7")
+
+    def test_error_mode_fires_and_clear_disarms(self):
+        FAILPOINTS.set("rpc.scan", "error")
+        with pytest.raises(FailpointError):
+            failpoint("rpc.scan")
+        failpoint("detect.dispatch")  # other sites unaffected
+        FAILPOINTS.clear("rpc.scan")
+        failpoint("rpc.scan")
+
+    def test_slow_mode_sleeps(self):
+        FAILPOINTS.set("detect.device_get", "slow", 30.0)
+        t0 = time.perf_counter()
+        failpoint("detect.device_get")
+        assert time.perf_counter() - t0 >= 0.025
+
+    def test_spec_from_sources_precedence(self):
+        from trivy_tpu.resilience.failpoints import spec_from_sources
+        # explicit flag values win over the global env var
+        assert spec_from_sources(
+            ["rpc.scan=error"],
+            env={"TRIVY_TPU_FAILPOINTS": "db.download=error"}) \
+            == "rpc.scan=error"
+        assert spec_from_sources(
+            [], env={"TRIVY_TPU_FAILPOINTS": "db.download=error"}) \
+            == "db.download=error"
+        assert spec_from_sources([], env={}) == ""
+        # both sources round-trip through the grammar
+        assert "db.download" in parse_spec(spec_from_sources(
+            [], env={"TRIVY_TPU_FAILPOINTS": "db.download=error"}))
+
+    def test_flaky_is_seeded_and_deterministic(self):
+        def draw(seed):
+            FAILPOINTS.set("rpc.scan", "flaky", 0.5, seed=seed)
+            fired = []
+            for _ in range(50):
+                try:
+                    failpoint("rpc.scan")
+                    fired.append(False)
+                except FailpointError:
+                    fired.append(True)
+            return fired
+
+        a, b = draw(3), draw(3)
+        assert a == b                 # same seed → same fault stream
+        assert any(a) and not all(a)  # actually flaky
+        assert draw(4) != a           # seed matters
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+
+
+class TestRetryPolicy:
+    def test_full_jitter_bounds(self):
+        p = RetryPolicy(attempts=5, base_delay_s=0.1, max_delay_s=0.5)
+        rng = random.Random(1)
+        for attempt in range(6):
+            for _ in range(100):
+                d = p.delay(attempt, rng)
+                assert 0.0 <= d <= min(0.5, 0.1 * 2 ** attempt)
+
+    def test_retries_then_raises(self):
+        p = RetryPolicy(attempts=3, base_delay_s=0.001, max_delay_s=0.002)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise OSError("boom")
+
+        with pytest.raises(OSError):
+            p.call(fn, should_retry=retry_on(OSError), sleep=lambda s: None)
+        assert len(calls) == 3
+
+    def test_non_retryable_raises_immediately(self):
+        p = RetryPolicy(attempts=5)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("nope")
+
+        with pytest.raises(ValueError):
+            p.call(fn, should_retry=retry_on(OSError), sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_budget_caps_total_sleep(self):
+        p = RetryPolicy(attempts=10, base_delay_s=1.0, max_delay_s=1.0,
+                        budget_s=2.5)
+        slept = []
+
+        def fn():
+            raise OSError("down")
+
+        class AlwaysOne:
+            @staticmethod
+            def uniform(a, b):
+                return 1.0
+
+        with pytest.raises(OSError):
+            p.call(fn, should_retry=retry_on(OSError),
+                   sleep=slept.append, rng=AlwaysOne)
+        # 1s per retry, budget 2.5s → exactly two sleeps then give up
+        assert slept == [1.0, 1.0]
+
+    def test_retry_after_floor_is_honored(self):
+        p = RetryPolicy(attempts=2, base_delay_s=0.001,
+                        max_delay_s=0.002, budget_s=10.0)
+        slept = []
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("shed")
+            return "ok"
+
+        assert p.call(fn, should_retry=lambda e: 3.0,
+                      sleep=slept.append) == "ok"
+        assert slept and slept[0] >= 3.0
+
+    def test_success_passes_through(self):
+        assert RetryPolicy().call(lambda: 42,
+                                  should_retry=retry_on(OSError)) == 42
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+
+
+class TestCircuitBreaker:
+    def test_closed_open_halfopen_closed(self):
+        clock = [0.0]
+        b = CircuitBreaker(fail_threshold=3, reset_timeout_s=10.0,
+                           clock=lambda: clock[0])
+        assert b.state_name() == "closed" and b.allow()
+        b.record_failure()
+        b.record_failure()
+        assert b.state_name() == "closed"
+        b.record_failure()
+        assert b.state_name() == "open"
+        assert not b.allow()              # open rejects
+        clock[0] = 9.9
+        assert not b.allow()              # still inside the window
+        clock[0] = 10.1
+        assert b.allow()                  # half-open probe admitted
+        assert b.state_name() == "half_open"
+        assert not b.allow()              # only ONE probe
+        b.record_success()
+        assert b.state_name() == "closed"
+        assert b.allow()
+
+    def test_halfopen_failure_reopens(self):
+        clock = [0.0]
+        b = CircuitBreaker(fail_threshold=1, reset_timeout_s=5.0,
+                           clock=lambda: clock[0])
+        b.record_failure()
+        assert b.state_name() == "open"
+        clock[0] = 6.0
+        assert b.allow()
+        b.record_failure()                # probe failed
+        assert b.state_name() == "open"
+        clock[0] = 10.9
+        assert not b.allow()              # window restarted at 6.0
+        clock[0] = 11.1
+        assert b.allow()
+
+    def test_trip_opens_immediately(self):
+        b = CircuitBreaker(fail_threshold=100)
+        b.trip()
+        assert b.state_name() == "open"
+
+    def test_success_resets_failure_count(self):
+        b = CircuitBreaker(fail_threshold=2)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state_name() == "closed"
+
+    def test_remove_recovery_matches_fresh_bound_method(self):
+        """Each `obj.method` access builds a NEW bound-method object;
+        remove_recovery must match by equality, or a closed server's
+        listener (and everything it retains) stays registered on the
+        process-global breaker forever."""
+        clock = [0.0]
+        b = CircuitBreaker(fail_threshold=1, reset_timeout_s=1.0,
+                           clock=lambda: clock[0])
+
+        class Owner:
+            fired = 0
+
+            def cb(self):
+                Owner.fired += 1
+
+        o = Owner()
+        b.on_recovery(o.cb)       # one bound-method object
+        b.remove_recovery(o.cb)   # a DIFFERENT bound-method object
+        b.record_failure()
+        clock[0] = 2.0
+        assert b.allow()
+        b.record_success()        # recovery: removed listener silent
+        assert Owner.fired == 0
+
+    def test_recovery_listener_fires_on_close(self):
+        clock = [0.0]
+        b = CircuitBreaker(fail_threshold=1, reset_timeout_s=1.0,
+                           clock=lambda: clock[0])
+        fired = []
+        b.on_recovery(lambda: fired.append(1))
+        b.record_failure()
+        clock[0] = 2.0
+        assert b.allow()
+        b.record_success()
+        assert fired == [1]
+        b.remove_recovery(b._listeners)   # no-op: not registered
+        assert b.state_name() == "closed"
+
+
+# ---------------------------------------------------------------------------
+# host fallback join: bit identity with the device path
+
+
+class TestHostJoinIdentity:
+    def test_csr_join_bits_identical_to_device(self, table):
+        import jax
+        det = BatchDetector(table)
+        try:
+            preps = [det._prepare(req[0])
+                     for req in _rand_requests(23, 10)]
+            preps = [p for p in preps if p is not None and p.n_pairs]
+            assert preps
+            ver = det.ver_snapshot()
+            for p in preps:
+                dev_bits = jax.device_get(det._dispatch(p))
+                host_bits = host_csr_pair_join(
+                    table.lo_tok, table.hi_tok, table.flags, ver,
+                    p.q_start, p.q_count, p.q_ver, p.n_pairs,
+                    int(p.pair_row.shape[0]))
+                assert (host_bits[:p.n_pairs]
+                        == dev_bits[:p.n_pairs]).all()
+        finally:
+            det.close()
+
+    def test_pair_join_matches_csr_expansion(self, table):
+        det = BatchDetector(table)
+        try:
+            p = next(det._prepare(req[0])
+                     for req in _rand_requests(29, 10)
+                     if det._prepare(req[0]) is not None)
+            ver = det.ver_snapshot()
+            n = p.n_pairs
+            flat = host_pair_join(
+                table.lo_tok, table.hi_tok, table.flags, ver,
+                p.pair_row[:n], p.pair_ver[:n], np.ones(n, bool))
+            csr = host_csr_pair_join(
+                table.lo_tok, table.hi_tok, table.flags, ver,
+                p.q_start, p.q_count, p.q_ver, n,
+                int(p.pair_row.shape[0]))
+            assert (csr[:n] == flat).all()
+        finally:
+            det.close()
+
+    def test_open_breaker_detect_is_hit_identical(self, table):
+        """The engine-level degraded mode: with the breaker open the
+        whole detect pipeline (prep → host join → assemble) produces
+        the same hits as the device path."""
+        requests = _rand_requests(31, 8)
+        det = BatchDetector(table)
+        expected = [det.detect_many(b) for b in requests]
+        det.close()
+        GUARD.breaker.trip()
+        b0 = METRICS.get("trivy_tpu_detect_batches_total")
+        f0 = METRICS.get("trivy_tpu_fallback_joins_total")
+        det = BatchDetector(table)
+        got = [det.detect_many(b) for b in requests]
+        det.close()
+        assert got == expected
+        # no device dispatch was accounted; the host fallback was
+        assert METRICS.get("trivy_tpu_detect_batches_total") == b0
+        assert METRICS.get("trivy_tpu_fallback_joins_total") > f0
+
+
+# ---------------------------------------------------------------------------
+# chaos: every failpoint mode, results identical to the unfaulted run
+
+
+def _hammer(table, requests, opts=None, threads=6):
+    det = BatchDetector(table)
+    sched = DispatchScheduler(det, opts or SchedOptions(
+        coalesce_wait_ms=5.0))
+    results: list = [None] * len(requests)
+    errors: list = []
+
+    def worker(ids):
+        try:
+            for i in ids:
+                results[i] = sched.detect_many(requests[i])
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(
+        target=worker, args=(range(k, len(requests), threads),))
+        for k in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    sched.close()
+    det.close()
+    return results, errors
+
+
+class TestChaosEquivalence:
+    @pytest.fixture(scope="class")
+    def expected(self, table):
+        requests = _rand_requests(41, 24)
+        det = BatchDetector(table)
+        exp = [det.detect_many(b) for b in requests]
+        det.close()
+        return requests, exp
+
+    @pytest.mark.parametrize("site,mode,arg", [
+        ("detect.dispatch", "error", 0.0),
+        ("detect.dispatch", "flaky", 0.3),
+        ("detect.dispatch", "slow", 10.0),
+        ("detect.device_get", "error", 0.0),
+        ("detect.device_get", "flaky", 0.3),
+    ])
+    def test_mode_is_hit_identical(self, table, expected, site, mode,
+                                   arg):
+        requests, exp = expected
+        GUARD.configure(dispatch_timeout_s=30.0, fail_threshold=3,
+                        reset_timeout_s=0.05)
+        FAILPOINTS.set(site, mode, arg, seed=11)
+        results, errors = _hammer(table, requests)
+        assert not errors
+        assert results == exp
+
+    def test_hang_mode_trips_watchdog_and_stays_identical(
+            self, table, expected):
+        requests, exp = expected
+        GUARD.configure(dispatch_timeout_s=0.02, fail_threshold=3,
+                        reset_timeout_s=60.0)
+        trips0 = METRICS.get("trivy_tpu_device_watchdog_trips_total")
+        FAILPOINTS.set("detect.dispatch", "hang", 80.0)
+        results, errors = _hammer(table, requests)
+        assert not errors
+        assert results == exp
+        assert METRICS.get("trivy_tpu_device_watchdog_trips_total") \
+            > trips0
+        assert GUARD.breaker.state_name() == "open"
+
+
+class TestAcceptance:
+    def test_hang_midload_c8_fallback_then_probe_restores(self, table):
+        """The ISSUE acceptance scenario: detect.dispatch=hang(100)
+        injected mid-load at c=8 → the watchdog trips the breaker,
+        in-flight and subsequent requests complete via host fallback
+        bit-identically, and after the failpoint clears a half-open
+        probe restores the device path."""
+        requests = _rand_requests(47, 32)
+        serial = BatchDetector(table)
+        expected = [serial.detect_many(b) for b in requests]
+        serial.close()
+
+        GUARD.configure(dispatch_timeout_s=0.02, fail_threshold=3,
+                        reset_timeout_s=0.15)
+        det = BatchDetector(table)
+        sched = DispatchScheduler(det, SchedOptions(coalesce_wait_ms=3.0))
+        results: list = [None] * len(requests)
+        errors: list = []
+        started = threading.Event()
+
+        def worker(ids):
+            try:
+                for i in ids:
+                    results[i] = sched.detect_many(requests[i])
+                    started.set()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(
+            target=worker, args=(range(k, len(requests), 8),))
+            for k in range(8)]
+        for t in ts:
+            t.start()
+        # inject the hang MID-LOAD: after at least one request landed
+        assert started.wait(30.0)
+        FAILPOINTS.set("detect.dispatch", "hang", 100.0)
+        for t in ts:
+            t.join()
+        assert not errors
+        # 1) everything completed, hit-for-hit identical
+        assert results == expected
+        # 2) the watchdog tripped the breaker
+        assert GUARD.breaker.status()["opens_total"] >= 1
+        assert METRICS.get("trivy_tpu_fallback_joins_total") > 0
+
+        # 3) clear the failpoint; after the reset window a half-open
+        # probe must restore the device path
+        FAILPOINTS.configure("")
+        time.sleep(0.2)   # > reset_timeout_s
+        b0 = METRICS.get("trivy_tpu_detect_batches_total")
+        probe = sched.detect_many(requests[0])
+        assert probe == expected[0]
+        assert GUARD.breaker.state_name() == "closed"
+        # the probe ran on the DEVICE path (batches accounted again)
+        assert METRICS.get("trivy_tpu_detect_batches_total") > b0
+        sched.close()
+        det.close()
+
+
+class TestAsyncProbeResolution:
+    def test_probe_resolves_at_fetch_not_dispatch(self, table):
+        """A device that ACCEPTS dispatches but fails at the result
+        fetch must never close a half-open probe at dispatch time:
+        the launch watch records no success (the dispatch is async),
+        so the probe resolves at the fetch — here as a failure, and
+        the breaker must end OPEN, not flap closed and fire the
+        recovery rebuild against a broken device."""
+        requests = _rand_requests(61, 2)
+        det0 = BatchDetector(table)
+        expected = [det0.detect_many(b) for b in requests]
+        det0.close()
+        # threshold 3: were dispatch-time success still recorded, the
+        # probe would close the breaker and the single fetch failure
+        # afterwards (1 < 3) would leave it CLOSED — the flap this
+        # guards against
+        GUARD.configure(fail_threshold=3, reset_timeout_s=0.01)
+        FAILPOINTS.set("detect.device_get", "error")
+        GUARD.breaker.trip()
+        time.sleep(0.02)
+        det = BatchDetector(table)
+        try:
+            got = det.detect_many(requests[1])   # the half-open probe
+            assert got == expected[1]            # fetch fallback bits
+            assert GUARD.breaker.state_name() == "open"
+        finally:
+            det.close()
+
+
+class TestDeadBackend:
+    def test_dead_upload_does_not_wedge_halfopen(self, table,
+                                                 monkeypatch):
+        """A backend so dead that even the table UPLOAD raises must
+        still resolve every half-open probe: the upload happens inside
+        the watch, so each probe failure is recorded and the next
+        reset window admits a fresh probe — the breaker never wedges
+        with `_probing` stuck, and recovery works once the backend
+        returns."""
+        requests = _rand_requests(59, 3)
+        det0 = BatchDetector(table)
+        expected = [det0.detect_many(b) for b in requests]
+        det0.close()
+
+        dead = {"on": True}
+        real = type(table).device_arrays
+
+        def arrays(self):
+            if dead["on"]:
+                raise RuntimeError("backend dead")
+            return real(self)
+
+        monkeypatch.setattr(table, "device_arrays",
+                            arrays.__get__(table))
+        GUARD.configure(fail_threshold=1, reset_timeout_s=0.01)
+        det = BatchDetector(table)
+        try:
+            got = [det.detect_many(b) for b in requests]
+            assert got == expected          # host fallback throughout
+            assert GUARD.breaker.state_name() == "open"
+            # several probe windows: each probe must FAIL and resolve,
+            # not hang the breaker in half-open
+            for _ in range(3):
+                time.sleep(0.02)
+                assert det.detect_many(requests[0]) == expected[0]
+                assert GUARD.breaker.state_name() == "open"
+            # backend comes back: the next probe restores the device
+            dead["on"] = False
+            time.sleep(0.02)
+            assert det.detect_many(requests[0]) == expected[0]
+            assert GUARD.breaker.state_name() == "closed"
+        finally:
+            det.close()
+
+
+class TestOtherSites:
+    def test_compile_failpoint_falls_back_identically(self, table):
+        """detect.compile fires only on NEW dispatch shapes — a fresh
+        detector's first dispatch hits it, falls back to the host, and
+        the results are unchanged."""
+        requests = _rand_requests(53, 4)
+        det = BatchDetector(table)
+        expected = [det.detect_many(b) for b in requests]
+        det.close()
+        FAILPOINTS.set("detect.compile", "error")
+        f0 = METRICS.get("trivy_tpu_fallback_joins_total")
+        det = BatchDetector(table)   # fresh _seen_shapes → new shapes
+        got = [det.detect_many(b) for b in requests]
+        det.close()
+        assert got == expected
+        assert METRICS.get("trivy_tpu_fallback_joins_total") > f0
+
+    def test_cache_backend_failpoint_fires_in_fscache(self, tmp_path):
+        from trivy_tpu.fanal.cache import FSCache
+        cache = FSCache(str(tmp_path / "c"))
+        cache.put_artifact("a1", {"x": 1})
+        FAILPOINTS.set("cache.backend", "error")
+        with pytest.raises(FailpointError):
+            cache.get_artifact("a1")
+        with pytest.raises(FailpointError):
+            cache.put_blob("b1", None)
+        with pytest.raises(FailpointError):
+            cache.missing_blobs("a1", ["b1"])
+        FAILPOINTS.configure("")
+        assert cache.get_artifact("a1") == {"x": 1}
+
+
+# ---------------------------------------------------------------------------
+# admission control
+
+
+class TestAdmissionQueue:
+    def test_unbounded_mode_admits_everything(self):
+        q = AdmissionQueue(AdmissionOptions(max_active=0))
+        for _ in range(64):
+            q.admit()
+        assert q.snapshot()["active"] == 64
+
+    def test_overflow_sheds_429_with_retry_hint(self):
+        q = AdmissionQueue(AdmissionOptions(max_active=1, max_queue=0,
+                                            queue_timeout_ms=50.0))
+        q.admit()
+        shed0 = METRICS.get("trivy_tpu_requests_shed_total")
+        with pytest.raises(Shed) as ei:
+            q.admit()
+        assert ei.value.http_code == 429
+        assert ei.value.retry_after_s >= 1.0
+        assert METRICS.get("trivy_tpu_requests_shed_total") == shed0 + 1
+        q.release()
+        q.admit()  # slot freed → admitted again
+
+    def test_queue_wait_bounded_by_deadline(self):
+        q = AdmissionQueue(AdmissionOptions(max_active=1, max_queue=4,
+                                            queue_timeout_ms=5000.0))
+        q.admit()
+        t0 = time.perf_counter()
+        with pytest.raises(Shed) as ei:
+            q.admit(Deadline(0.05))
+        waited = time.perf_counter() - t0
+        assert waited < 1.0            # nowhere near the 5 s budget
+        assert "deadline" in ei.value.reason
+        q.release()
+
+    def test_queue_wait_bounded_by_budget(self):
+        q = AdmissionQueue(AdmissionOptions(max_active=1, max_queue=4,
+                                            queue_timeout_ms=40.0))
+        q.admit()
+        t0 = time.perf_counter()
+        with pytest.raises(Shed):
+            q.admit()
+        assert time.perf_counter() - t0 < 1.0
+        q.release()
+
+    def test_open_breaker_sheds_503(self):
+        b = CircuitBreaker(fail_threshold=1)
+        b.record_failure()
+        q = AdmissionQueue(AdmissionOptions(max_active=1, max_queue=0),
+                           breaker=b)
+        q.admit()
+        with pytest.raises(Shed) as ei:
+            q.admit()
+        assert ei.value.http_code == 503
+        # open breaker: retry hint covers the reset window
+        assert ei.value.retry_after_s >= b.reset_timeout_s
+
+    def test_queued_request_admitted_when_slot_frees(self):
+        q = AdmissionQueue(AdmissionOptions(max_active=1, max_queue=4,
+                                            queue_timeout_ms=5000.0))
+        q.admit()
+        got = []
+
+        def waiter():
+            q.admit()
+            got.append(1)
+            q.release()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        assert not got                 # parked behind the slot
+        q.release()
+        t.join(5.0)
+        assert got == [1]
+
+
+# ---------------------------------------------------------------------------
+# server integration: sheds over HTTP, healthz, /metrics
+
+
+@pytest.fixture()
+def small_server(table, tmp_path):
+    import socket
+
+    from trivy_tpu.server.listen import serve_background
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    httpd, state = serve_background(
+        "127.0.0.1", port, table, cache_dir=str(tmp_path / "cache"),
+        admission=AdmissionOptions(max_active=1, max_queue=0,
+                                   queue_timeout_ms=200.0))
+    yield f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+    httpd.server_close()
+    state.close()
+
+
+def _post_scan(base, deadline_ms=None, timeout=30.0):
+    headers = {"Content-Type": "application/json"}
+    if deadline_ms is not None:
+        headers["X-Trivy-Deadline-Ms"] = str(deadline_ms)
+    req = urllib.request.Request(
+        base + "/twirp/trivy.scanner.v1.Scanner/Scan",
+        data=json.dumps({"target": "t", "artifact_id": "a",
+                         "blob_ids": []}).encode(),
+        headers=headers, method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+class TestServerShedding:
+    def test_overflow_returns_429_with_retry_after(self, small_server):
+        # occupy the single slot with a server-side hang
+        FAILPOINTS.set("rpc.scan", "hang", 600.0)
+        first_done = []
+
+        def slow():
+            with _post_scan(small_server) as r:
+                first_done.append(r.status)
+
+        t = threading.Thread(target=slow)
+        t.start()
+        time.sleep(0.1)   # let the slow scan claim the slot
+        t0 = time.perf_counter()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            with _post_scan(small_server, deadline_ms=100):
+                pass
+        elapsed = time.perf_counter() - t0
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = json.loads(ei.value.read())
+        assert body["code"] == "resource_exhausted"
+        # max_queue=0: shed immediately, long before any deadline
+        assert elapsed < 2.0
+        t.join(10.0)
+        assert first_done == [200]
+
+    def test_healthz_exposes_resilience(self, small_server):
+        doc = json.loads(urllib.request.urlopen(
+            small_server + "/healthz").read())
+        res = doc["resilience"]
+        assert res["breaker"]["state"] == "closed"
+        assert "watchdog_last_probe_age_s" in res
+        assert res["admission"]["max_active"] == 1
+        assert "fallback_joins_total" in res
+        assert "requests_shed_total" in res
+
+    def test_metrics_expose_breaker_and_shed_series(self, small_server):
+        # shed one request so the counter family materializes
+        FAILPOINTS.set("rpc.scan", "hang", 400.0)
+        t = threading.Thread(target=lambda: _post_scan(
+            small_server).close())
+        t.start()
+        time.sleep(0.1)
+        with pytest.raises(urllib.error.HTTPError):
+            with _post_scan(small_server):
+                pass
+        t.join(10.0)
+        body = urllib.request.urlopen(
+            small_server + "/metrics").read().decode()
+        fams = parse_exposition(body)
+        assert fams["trivy_tpu_detect_breaker_state"]["type"] == "gauge"
+        assert fams["trivy_tpu_detect_breaker_state"]["samples"][0][2] \
+            == 0.0
+        shed = fams["trivy_tpu_requests_shed_total"]
+        assert shed["type"] == "counter"
+        assert shed["samples"][0][2] >= 1
+
+
+class TestServerRecoverySwap:
+    def test_breaker_recovery_rebuilds_scanner_via_swap(self, table,
+                                                        tmp_path):
+        from trivy_tpu.server.listen import ServerState
+        state = ServerState(table, str(tmp_path / "c"))
+        old = state.scanner
+        try:
+            GUARD.breaker.trip()
+            # half-open probe succeeds → recovery listener swaps
+            GUARD.configure(reset_timeout_s=0.0)
+            assert GUARD.allow_device()
+            GUARD.record_success()
+            for _ in range(200):
+                if state.scanner is not old:
+                    break
+                time.sleep(0.05)
+            assert state.scanner is not old
+            # the generation-drain machinery retires the old engine
+            for _ in range(200):
+                if old.detector._closed:
+                    break
+                time.sleep(0.05)
+            assert old.detector._closed
+        finally:
+            state.close()
+
+    def test_closed_state_does_not_swap_on_recovery(self, table,
+                                                    tmp_path):
+        from trivy_tpu.server.listen import ServerState
+        state = ServerState(table, str(tmp_path / "c2"))
+        state.close()
+        GUARD.breaker.trip()
+        GUARD.configure(reset_timeout_s=0.0)
+        assert GUARD.allow_device()
+        GUARD.record_success()   # listener was unregistered by close()
+
+
+# ---------------------------------------------------------------------------
+# retry edges: RPC client, db download, OCI registry
+
+
+class _FakeResp:
+    def __init__(self, body=b"{}"):
+        self._body = body
+        self.status = 200
+
+    def read(self):
+        return self._body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class TestClientRetry:
+    def _client(self, monkeypatch, fail_times, exc_factory):
+        from trivy_tpu.server import client as client_mod
+        calls = []
+
+        def fake_urlopen(req, timeout=None):
+            calls.append(req)
+            if len(calls) <= fail_times:
+                raise exc_factory()
+            return _FakeResp(b'{"ok": true}')
+
+        monkeypatch.setattr(client_mod.urllib.request, "urlopen",
+                            fake_urlopen)
+        c = client_mod.RemoteCache(
+            "http://127.0.0.1:1", retry=RetryPolicy(
+                attempts=3, base_delay_s=0.001, max_delay_s=0.002,
+                budget_s=5.0))
+        return c, calls
+
+    def test_urlerror_retries_then_succeeds(self, monkeypatch):
+        c, calls = self._client(
+            monkeypatch, 2,
+            lambda: urllib.error.URLError("connection refused"))
+        out = c._call(c.SERVICE, "MissingBlobs", {})
+        assert out == {"ok": True}
+        assert len(calls) == 3
+
+    def test_urlerror_exhausts_to_twirp_unavailable(self, monkeypatch):
+        from trivy_tpu.server.client import TwirpError
+        c, calls = self._client(
+            monkeypatch, 99,
+            lambda: urllib.error.URLError("connection refused"))
+        with pytest.raises(TwirpError) as ei:
+            c._call(c.SERVICE, "MissingBlobs", {})
+        assert ei.value.code == "unavailable"
+        assert len(calls) == 3
+
+    def test_429_retries_honoring_retry_after(self, monkeypatch):
+        import email.message
+
+        def make_429():
+            hdrs = email.message.Message()
+            hdrs["Retry-After"] = "0"
+            return urllib.error.HTTPError(
+                "http://x", 429, "Too Many Requests", hdrs, None)
+
+        c, calls = self._client(monkeypatch, 1, make_429)
+        out = c._call(c.SERVICE, "MissingBlobs", {})
+        assert out == {"ok": True}
+        assert len(calls) == 2
+
+    def test_client_stamps_deadline_header(self, monkeypatch):
+        c, calls = self._client(monkeypatch, 0, None)
+        c.timeout = 7.0
+        c._call(c.SERVICE, "MissingBlobs", {})
+        assert calls[0].get_header("X-trivy-deadline-ms") == "7000"
+
+    def test_400_is_terminal(self, monkeypatch):
+        import email.message
+
+        from trivy_tpu.server.client import TwirpError
+
+        def make_400():
+            return urllib.error.HTTPError(
+                "http://x", 400, "Bad Request",
+                email.message.Message(),
+                __import__("io").BytesIO(
+                    b'{"code": "malformed", "msg": "bad body"}'))
+
+        c, calls = self._client(monkeypatch, 99, make_400)
+        with pytest.raises(TwirpError) as ei:
+            c._call(c.SERVICE, "MissingBlobs", {})
+        assert ei.value.code == "malformed"
+        assert len(calls) == 1
+
+
+class TestDownloadRetry:
+    def _tar_gz(self):
+        import gzip
+        import io
+        import tarfile
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            for name, data in (("trivy.db", b"boltbytes"),
+                               ("metadata.json",
+                                b'{"Version": 2}')):
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        return gzip.compress(buf.getvalue())
+
+    def test_download_retries_transient_ocierror(self, monkeypatch,
+                                                 tmp_path):
+        from trivy_tpu.db import download as dl
+        from trivy_tpu.oci import OCIError
+        monkeypatch.setattr(dl, "DOWNLOAD_RETRY", RetryPolicy(
+            attempts=3, base_delay_s=0.001, max_delay_s=0.002,
+            budget_s=5.0))
+        blob = self._tar_gz()
+        calls = []
+
+        class FlakyClient:
+            def download_artifact_layer(self, ref, mt):
+                calls.append(1)
+                if len(calls) < 3:
+                    raise OCIError("reset by peer")
+                return blob
+
+        path = dl.download_db(str(tmp_path), client=FlakyClient())
+        assert len(calls) == 3
+        with open(path, "rb") as f:
+            assert f.read() == b"boltbytes"
+
+    def test_download_failpoint_exhausts_to_dberror(self, monkeypatch,
+                                                    tmp_path):
+        from trivy_tpu.db import download as dl
+        monkeypatch.setattr(dl, "DOWNLOAD_RETRY", RetryPolicy(
+            attempts=2, base_delay_s=0.001, max_delay_s=0.002,
+            budget_s=5.0))
+        FAILPOINTS.set("db.download", "error")
+
+        class NeverClient:
+            def download_artifact_layer(self, ref, mt):
+                raise AssertionError("failpoint fires first")
+
+        with pytest.raises(dl.DBError, match="failpoint db.download"):
+            dl.download_db(str(tmp_path), client=NeverClient())
+
+
+class TestOCIRetry:
+    def test_request_retries_urlerror(self, monkeypatch):
+        from trivy_tpu import oci
+        monkeypatch.setattr(oci, "_TRANSIENT_RETRY", RetryPolicy(
+            attempts=3, base_delay_s=0.001, max_delay_s=0.002,
+            budget_s=5.0))
+        calls = []
+
+        def fake_urlopen(req, timeout=None):
+            calls.append(req)
+            if len(calls) < 3:
+                raise urllib.error.URLError("reset")
+            return _FakeResp(b"{}")
+
+        monkeypatch.setattr(oci.urllib.request, "urlopen", fake_urlopen)
+        client = oci.RegistryClient()
+        ref = oci.parse_ref("example.com/repo:tag")
+        resp = client._request("https://example.com/v2/x", {}, ref)
+        assert resp.read() == b"{}"
+        assert len(calls) == 3
+
+    def test_request_does_not_retry_404(self, monkeypatch):
+        import email.message
+
+        from trivy_tpu import oci
+        monkeypatch.setattr(oci, "_TRANSIENT_RETRY", RetryPolicy(
+            attempts=3, base_delay_s=0.001, max_delay_s=0.002,
+            budget_s=5.0))
+        calls = []
+
+        def fake_urlopen(req, timeout=None):
+            calls.append(req)
+            raise urllib.error.HTTPError(
+                "http://x", 404, "nf", email.message.Message(),
+                __import__("io").BytesIO(b"no"))
+
+        monkeypatch.setattr(oci.urllib.request, "urlopen", fake_urlopen)
+        client = oci.RegistryClient()
+        ref = oci.parse_ref("example.com/repo:tag")
+        with pytest.raises(oci.OCIError):
+            client._request("https://example.com/v2/x", {}, ref)
+        assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end degraded scan over the synthetic golden image
+
+
+class TestDegradedScanIdentity:
+    def test_open_breaker_scan_results_identical(self, table, tmp_path):
+        """Full pipeline (image → walker → detect → results) with the
+        breaker open must produce the SAME findings as the device
+        path — degraded means slower, never different."""
+        from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+        from trivy_tpu.fanal.cache import FSCache
+        from trivy_tpu.scanner import LocalScanner
+
+        from helpers import (ALPINE_OS_RELEASE, APK_INSTALLED,
+                             make_image)
+        img = str(tmp_path / "img.tar")
+        make_image(img, [{
+            "etc/os-release": ALPINE_OS_RELEASE,
+            "lib/apk/db/installed": APK_INSTALLED,
+        }])
+        cache = FSCache(str(tmp_path / "cache"))
+        ref = ImageArchiveArtifact(img, cache).inspect()
+
+        scanner = LocalScanner(cache, table)
+        want, os_want = scanner.scan(ref.name, ref.id, ref.blob_ids)
+        scanner.close()
+        assert any(r.vulnerabilities for r in want)
+
+        GUARD.breaker.trip()
+        scanner = LocalScanner(cache, table)
+        got, os_got = scanner.scan(ref.name, ref.id, ref.blob_ids)
+        scanner.close()
+        assert GUARD.breaker.state_name() == "open"
+        assert os_got == os_want
+        assert got == want

@@ -52,6 +52,9 @@ _LOCK_SCOPE = (
     os.path.join("trivy_tpu", "detect", "engine.py"),
     os.path.join("trivy_tpu", "detect", "sched.py"),
     os.path.join("trivy_tpu", "parallel", "multihost.py"),
+    # graftguard: the failpoint registry and breaker are hit from
+    # every handler thread plus the watchdog
+    os.path.join("trivy_tpu", "resilience") + os.sep,
 )
 
 
@@ -493,6 +496,58 @@ def rule_instrumentation(info: ModuleInfo):
                     f"{fname}() inside device code runs once at trace "
                     f"time — move it to the host orchestration",
                     _ctx(dev))
+
+
+@register("TPU108", "resilience-in-device-code", "ast")
+def rule_resilience(info: ModuleInfo):
+    """graftguard belongs to the host orchestration layer, like
+    graftscope (TPU107). Inside jitted cores and pallas kernels,
+    failpoint probes (`failpoint(...)` / `FAILPOINTS.fire(...)`),
+    breaker reads (`GUARD.*`, `.allow()` / `.record_success()` /
+    `.record_failure()` / `.trip()` on anything breaker-named), and
+    deadline clocks (`Deadline(...)`, `.remaining()` / `.expired()` on
+    deadline-named values) are forbidden: under jit tracing they run
+    ONCE at trace time — arming a fault or reading a breaker during
+    compilation, never during execution — and vanish from the compiled
+    program, so the fault injection and supervision silently lie."""
+    breaker_methods = {"allow", "allow_device", "record_success",
+                       "record_failure", "trip"}
+    deadline_methods = {"remaining", "expired"}
+    for dev in info.device_fns:
+        for node, _traced in _device_walk(dev):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            head = fname.split(".", 1)[0]
+            _, _, tail = fname.rpartition(".")
+            if fname in ("failpoint", "resilience.failpoint",
+                         "failpoints.failpoint") \
+                    or (head == "FAILPOINTS" and tail):
+                yield Finding(
+                    "TPU108", info.relpath, node.lineno,
+                    f"failpoint probe {fname}() in device code fires "
+                    f"once at trace time, not per execution", _ctx(dev))
+            elif head == "GUARD" and tail:
+                yield Finding(
+                    "TPU108", info.relpath, node.lineno,
+                    f"breaker/supervisor call {fname}() in device code "
+                    f"reads host state at trace time — supervise the "
+                    f"host call site instead", _ctx(dev))
+            elif tail in breaker_methods and "breaker" in head.lower():
+                yield Finding(
+                    "TPU108", info.relpath, node.lineno,
+                    f"breaker call {fname}() in device code runs once "
+                    f"at trace time", _ctx(dev))
+            elif fname == "Deadline" or fname.endswith(".Deadline"):
+                yield Finding(
+                    "TPU108", info.relpath, node.lineno,
+                    "Deadline() in device code captures the trace-time "
+                    "clock", _ctx(dev))
+            elif tail in deadline_methods and "deadline" in head.lower():
+                yield Finding(
+                    "TPU108", info.relpath, node.lineno,
+                    f"deadline clock {fname}() in device code reads "
+                    f"trace time, not request time", _ctx(dev))
 
 
 @register("TPU106", "lock-hygiene", "ast")

@@ -195,6 +195,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="detectd: padded candidate pairs allowed in "
                         "flight on the device before dispatch "
                         "backpressure kicks in")
+    p.add_argument("--failpoint", action="append", default=[],
+                   metavar="SITE=MODE[:ARG]",
+                   help="graftguard fault injection: arm a failpoint "
+                        "(modes error, hang:MS, slow:MS, flaky:P[:SEED]"
+                        "; repeatable; also TRIVY_TPU_FAILPOINTS)")
+    p.add_argument("--detect-dispatch-timeout-ms", type=float,
+                   default=120000.0,
+                   help="graftguard watchdog deadline around every "
+                        "device dispatch/get; expiry trips the "
+                        "breaker and the request completes on the "
+                        "host fallback (default 120000)")
+    p.add_argument("--breaker-fail-threshold", type=int, default=3,
+                   help="consecutive device failures that open the "
+                        "breaker (watchdog timeouts open it "
+                        "immediately; default 3)")
+    p.add_argument("--breaker-reset-ms", type=float, default=5000.0,
+                   help="open-breaker window before a half-open probe "
+                        "may try the device again (default 5000)")
+    p.add_argument("--admit-max-active", type=int, default=0,
+                   help="max concurrent Scan RPCs; 0 = unbounded "
+                        "(admission control off)")
+    p.add_argument("--admit-max-queue", type=int, default=16,
+                   help="Scan RPCs allowed to wait beyond "
+                        "--admit-max-active before shedding with "
+                        "429 + Retry-After (default 16)")
+    p.add_argument("--admit-queue-ms", type=float, default=1000.0,
+                   help="max time one Scan may wait in the admission "
+                        "queue (bounded further by the request's "
+                        "X-Trivy-Deadline-Ms; default 1000)")
     p.add_argument("--detect-warmup", action="store_true",
                    help="pre-compile the join's pair-bucket ladder at "
                         "boot so steady-state traffic never pays an "
@@ -836,11 +865,30 @@ def cmd_convert(args) -> int:
 def cmd_server(args) -> int:
     from .detect.sched import SchedOptions
     from .parallel.multihost import maybe_init_distributed, process_info
+    from .resilience import FAILPOINTS, GUARD, AdmissionOptions
     from .server.listen import serve
     if maybe_init_distributed():
         from .log import logger
         idx, count = process_info()
         logger.info("joined multi-host job: process %d/%d", idx, count)
+    # graftguard: arm failpoints (--failpoint / TRIVY_FAILPOINT /
+    # trivy.yaml beat the global TRIVY_TPU_FAILPOINTS) and configure
+    # the device watchdog + breaker before any device work
+    from .resilience.failpoints import spec_from_sources
+    try:
+        FAILPOINTS.configure(
+            spec_from_sources(getattr(args, "failpoint", [])))
+    except ValueError as e:
+        raise SystemExit(str(e))
+    GUARD.configure(
+        dispatch_timeout_s=getattr(
+            args, "detect_dispatch_timeout_ms", 120000.0) / 1e3,
+        fail_threshold=getattr(args, "breaker_fail_threshold", 3),
+        reset_timeout_s=getattr(args, "breaker_reset_ms", 5000.0) / 1e3)
+    admission = AdmissionOptions(
+        max_active=getattr(args, "admit_max_active", 0),
+        max_queue=getattr(args, "admit_max_queue", 16),
+        queue_timeout_ms=getattr(args, "admit_queue_ms", 1000.0))
     table = _load_table_args(args)
     host, _, port = args.listen.rpartition(":")
     opts = SchedOptions(
@@ -852,7 +900,7 @@ def cmd_server(args) -> int:
           token=args.token,
           cache_backend=getattr(args, "cache_backend", "fs"),
           trace_path=getattr(args, "trace", ""),
-          detect_opts=opts)
+          detect_opts=opts, admission=admission)
     return 0
 
 

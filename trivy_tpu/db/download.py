@@ -85,17 +85,40 @@ def needs_update(cache_dir: str, skip: bool = False,
     return True
 
 
+# whole-artifact retry for the trivy-db pull (graftguard shared
+# policy): a TCP reset 200 MB into the layer used to throw the whole
+# scan — oci.py retries individual HTTP calls underneath, this covers
+# mid-stream failures that surface as one OCIError
+DOWNLOAD_RETRY = None  # lazily built; resilience import stays optional
+
+
+def _download_retry():
+    global DOWNLOAD_RETRY
+    if DOWNLOAD_RETRY is None:
+        from ..resilience import RetryPolicy
+        DOWNLOAD_RETRY = RetryPolicy(attempts=3, base_delay_s=1.0,
+                                     max_delay_s=10.0, budget_s=60.0)
+    return DOWNLOAD_RETRY
+
+
 def download_db(cache_dir: str, repository: str = DEFAULT_REPO,
                 client=None) -> str:
     """Pull the trivy-db OCI artifact into <cache>/db → trivy.db path."""
     from ..oci import (MT_TRIVY_DB, OCIError, default_client, parse_ref,
                        untar_gz_members)
+    from ..resilience import FailpointError, failpoint, retry_on
     client = client or default_client()
     ref = parse_ref(repository)
+
+    def pull():
+        failpoint("db.download")
+        return client.download_artifact_layer(ref, MT_TRIVY_DB)
+
     try:
-        blob = client.download_artifact_layer(ref, MT_TRIVY_DB)
+        blob = _download_retry().call(
+            pull, should_retry=retry_on(OCIError, FailpointError))
         members = untar_gz_members(blob)
-    except OCIError as e:
+    except (OCIError, FailpointError) as e:
         raise DBError(f"trivy-db download from {ref} failed: {e}") from None
     if "trivy.db" not in members:
         raise DBError(f"{ref}: layer does not contain trivy.db "

@@ -30,11 +30,16 @@ import numpy as np
 
 from .. import version as V
 from ..db.table import AdvisoryTable
+from ..log import get as _get_logger
 from ..metrics import METRICS
 from ..obs import note_dispatch, recording, span
 from ..ops import bucket_ladder, bucket_size
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
+from ..resilience import GUARD, DeviceError, failpoint
+from ..resilience.hostjoin import host_csr_pair_join, host_pair_join
+
+_log = _get_logger("detect")
 
 
 
@@ -306,15 +311,12 @@ class BatchDetector:
         note_dispatch()
         return out
 
-    def _account_dispatch(self, n_pairs: int, t_pad: int, q_pad: int,
-                          u_rows: int, warm: bool = False) -> None:
-        """Per-DISPATCH metrics: one occupancy observation and one
-        batch count per device launch (a coalesced dispatch covering N
-        requests is still ONE dispatch), plus the compile counter — a
-        (t_pad, q_pad, ver-pool rows, table size) key this process has
-        not dispatched before is a new XLA program. Warmup dispatches
-        count compiles (they ARE compiles — pre-paid ones) but are
-        excluded from the traffic series."""
+    def _note_shape(self, t_pad: int, q_pad: int, u_rows: int) -> bool:
+        """Compile accounting: a (t_pad, q_pad, ver-pool rows, table
+        size) key this process has not dispatched before is a new XLA
+        program. → whether the shape is new (the detect.compile
+        failpoint keys off it). Runs BEFORE the launch — the compile
+        happens whether or not the dispatch then fails."""
         key = (t_pad, q_pad, u_rows, len(self.table))
         with self._lock:
             new_shape = key not in self._seen_shapes
@@ -322,6 +324,17 @@ class BatchDetector:
                 self._seen_shapes.add(key)
         if new_shape:
             METRICS.inc("trivy_tpu_detect_compiles_total")
+        return new_shape
+
+    def _account_traffic(self, n_pairs: int, t_pad: int,
+                         warm: bool = False) -> None:
+        """Per-DISPATCH traffic metrics: one occupancy observation and
+        one batch count per device launch (a coalesced dispatch
+        covering N requests is still ONE dispatch). Called AFTER the
+        launch is accepted, so failed dispatches that fell back to the
+        host never inflate the device series (they count in
+        trivy_tpu_fallback_joins_total instead, per the metric help).
+        Warmup dispatches are compiles, not traffic — excluded."""
         if warm:
             return
         METRICS.inc("trivy_tpu_detect_batches_total")
@@ -329,21 +342,126 @@ class BatchDetector:
             METRICS.observe("trivy_tpu_batch_occupancy_ratio",
                             n_pairs / t_pad)
 
+    def _host_join_csr(self, q_start: np.ndarray, q_count: np.ndarray,
+                       q_ver: np.ndarray, total: int,
+                       t_pad: int) -> np.ndarray:
+        """Host fallback for a CSR launch: the NumPy reference join
+        over the same descriptors (graftguard degraded mode). Returns
+        the int8[t_pad] bit vector a device fetch would have — callers
+        downstream (device_get, _assemble, the scheduler's slicing)
+        cannot tell the difference, and the bits are identical by the
+        hostjoin contract."""
+        METRICS.inc("trivy_tpu_fallback_joins_total")
+        ver = self.ver_snapshot()
+        t = self.table
+        return host_csr_pair_join(t.lo_tok, t.hi_tok, t.flags, ver,
+                                  q_start, q_count, q_ver, total, t_pad)
+
+    def _host_bits(self, prep: _Prepared) -> np.ndarray:
+        """Host fallback from an already-expanded prep (used when the
+        device accepted the dispatch but the FETCH failed: the pair
+        expansion is still on the host, so recompute locally)."""
+        METRICS.inc("trivy_tpu_fallback_joins_total")
+        ver = self.ver_snapshot()
+        t = self.table
+        t_pad = int(prep.pair_row.shape[0])
+        bits = np.zeros(t_pad, np.int8)
+        n = prep.n_pairs
+        bits[:n] = host_pair_join(
+            t.lo_tok, t.hi_tok, t.flags, ver,
+            prep.pair_row[:n], prep.pair_ver[:n], np.ones(n, bool))
+        return bits
+
     def _launch(self, q_start: np.ndarray, q_count: np.ndarray,
                 q_ver: np.ndarray, total: int, t_pad: int, u_pad: int,
                 warm: bool = False):
-        """Ship CSR descriptors and launch the join (async)."""
+        """Ship CSR descriptors and launch the join (async).
+
+        graftguard supervision: with the breaker open the device is
+        never touched — the NumPy host join runs instead and its bits
+        flow through the unchanged downstream (jax.device_get is a
+        no-op on host arrays). Otherwise the dispatch runs under a
+        watchdog deadline; a backend error or deadline expiry counts
+        against the breaker and THIS launch falls back to the host, so
+        the request completes either way with identical bits."""
+        if not GUARD.allow_device():
+            return self._host_join_csr(q_start, q_count, q_ver, total,
+                                       t_pad)
         import jax
-        adv_lo, adv_hi, adv_flags = self.table.device_arrays()
-        ver_dev = self._ver_device(u_pad)
-        self._account_dispatch(total, t_pad, int(q_start.shape[0]),
-                               int(ver_dev.shape[0]), warm=warm)
-        return J.csr_pair_join(
-            adv_lo, adv_hi, adv_flags, ver_dev,
-            jax.device_put(q_start),
-            jax.device_put(q_count),
-            jax.device_put(q_ver),
-            np.int32(total), t_pad)
+        try:
+            # the table/version-pool uploads live INSIDE the watch: on
+            # a dead backend device_put is exactly where the failure
+            # surfaces, and an unrecorded probe failure would wedge
+            # the breaker in half-open forever (no probe ever resolves).
+            # record_success=False: the launch is ASYNC — execution
+            # success is only proven at the paired fetch
+            # (_fetch_bits), which carries the success-recording watch
+            with GUARD.watch("detect.dispatch", record_success=False):
+                adv_lo, adv_hi, adv_flags = self.table.device_arrays()
+                ver_dev = self._ver_device(u_pad)
+                if self._note_shape(t_pad, int(q_start.shape[0]),
+                                    int(ver_dev.shape[0])):
+                    failpoint("detect.compile")
+                failpoint("detect.dispatch")
+                out = J.csr_pair_join(
+                    adv_lo, adv_hi, adv_flags, ver_dev,
+                    jax.device_put(q_start),
+                    jax.device_put(q_count),
+                    jax.device_put(q_ver),
+                    np.int32(total), t_pad)
+                self._account_traffic(total, t_pad, warm=warm)
+                return out
+        except DeviceError:
+            # logged with the chained traceback: the first
+            # fail_threshold-1 failures would otherwise be invisible,
+            # and 'breaker opened after 3 failures' alone cannot tell a
+            # code bug inside the watch from a real device outage
+            _log.warning("device launch failed; host-fallback join",
+                         exc_info=True)
+            return self._host_join_csr(q_start, q_count, q_ver, total,
+                                       t_pad)
+
+    # ---- supervised result fetch (graftguard) -------------------------
+
+    def _fetch_bits(self, dev) -> np.ndarray:
+        """Device→host fetch under watchdog supervision. Host-fallback
+        results (plain ndarrays from _host_join_csr) pass through
+        without touching the device or the failpoints. Raises
+        DeviceError/DeviceTimeout on a failed or wedged fetch."""
+        if isinstance(dev, np.ndarray):
+            return dev
+        import jax
+        with GUARD.watch("detect.device_get"):
+            failpoint("detect.device_get")
+            return jax.device_get(dev)
+
+    def _fetch_or_fallback(self, prep: _Prepared, dev) -> np.ndarray:
+        """Fetch one prep's bits; on a supervised failure recompute
+        them on the host from the prep's own pair expansion — the
+        request completes with identical bits either way."""
+        try:
+            return self._fetch_bits(dev)
+        except DeviceError:
+            _log.warning("device fetch failed; host-fallback join",
+                         exc_info=True)
+            return self._host_bits(prep)
+
+    def fetch_merged(self, dev, preps: list, offsets: list,
+                     t_pad: int) -> np.ndarray:
+        """Fetch a merged (coalesced) dispatch's bits; on a supervised
+        failure rebuild the merged bit vector from each prep's host
+        join so every coalesced request still completes."""
+        try:
+            return self._fetch_bits(dev)
+        except DeviceError:
+            _log.warning("merged device fetch failed; rebuilding %d "
+                         "request slices on the host", len(preps),
+                         exc_info=True)
+            bits = np.zeros(t_pad, np.int8)
+            for p, off in zip(preps, offsets):
+                bits[off:off + p.n_pairs] = \
+                    self._host_bits(p)[:p.n_pairs]
+            return bits
 
     def _dispatch_impl(self, prep: _Prepared):
         """Launch the pair join; returns the device array (async).
@@ -452,7 +570,6 @@ class BatchDetector:
     def _detect_many_pipelined(self,
                                batches: list[list[PkgQuery]]
                                ) -> list[list[Hit]]:
-        import jax
         out: list = [[] for _ in batches]
         window: deque = deque()   # (idx, prep, get_future) in order
         asm_futs: list = []       # (idx, assemble future)
@@ -501,10 +618,13 @@ class BatchDetector:
                 # generic __array__ element path on accelerator arrays
                 # (~500x slower for the 512KB bit vectors); device_get
                 # is one memcpy, on the get thread so batch N+1's
-                # result streams while batch N assembles
+                # result streams while batch N assembles. The fetch is
+                # graftguard-supervised: a wedged/failed get falls back
+                # to the host join instead of sinking the batch
                 window.append((idx, prep,
-                               self._get_pool.submit(jax.device_get,
-                                                     dev)))
+                               self._get_pool.submit(
+                                   self._fetch_or_fallback, prep,
+                                   dev)))
                 # opportunistic: hand finished fetches to assembly
                 # without blocking the prep of the next batch
                 while window and window[0][2].done():
@@ -553,8 +673,9 @@ class BatchDetector:
         METRICS.gauge_add("trivy_tpu_dispatch_depth", float(n_active))
         in_flight = n_active
         get_futs = [None if fut is None
-                    else self._get_pool.submit(jax.device_get, fut)
-                    for fut in futures]
+                    else self._get_pool.submit(self._fetch_or_fallback,
+                                               prep, fut)
+                    for prep, fut in zip(prepped, futures)]
         out = []
         try:
             for prep, gf in zip(prepped, get_futs):

@@ -267,7 +267,6 @@ class DispatchScheduler:
     def _dispatch_round(self, pending: list[_Request]) -> None:
         """Chunk the gathered slots under the pair budget and issue one
         merged dispatch per chunk."""
-        import jax
         budget = self.opts.max_pairs_in_flight
         chunk: list = []   # (req, slot_idx, prep)
         chunk_pairs = 0
@@ -292,7 +291,12 @@ class DispatchScheduler:
             METRICS.gauge_add("trivy_tpu_dispatch_depth", 1.0)
             with self._cv:
                 self._inflight_pairs += t_pad
-            gf = self.detector._get_pool.submit(jax.device_get, dev)
+            # graftguard-supervised fetch: a wedged/failed transfer
+            # rebuilds the merged bits from each prep's host join, so
+            # every coalesced request behind one bad dispatch still
+            # completes (bit-identically)
+            gf = self.detector._get_pool.submit(
+                self.detector.fetch_merged, dev, preps, offsets, t_pad)
             items = list(chunk)
             gf.add_done_callback(
                 lambda fut: self._on_fetched(fut, items, offsets,

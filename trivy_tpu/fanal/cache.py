@@ -55,7 +55,11 @@ class MemoryCache:
 
 class FSCache(MemoryCache):
     """JSON-file-per-key store under <root>/fanal/ (the reference keeps a
-    bbolt file with artifact/blob buckets, cache/fs.go:22-40)."""
+    bbolt file with artifact/blob buckets, cache/fs.go:22-40).
+
+    Every IO method fires the graftguard `cache.backend` failpoint —
+    the chaos suite's stand-in for a full disk, a yanked volume, or
+    (for the Redis/S3 backends sharing this surface) a dead remote."""
 
     def __init__(self, root: str):
         super().__init__()
@@ -67,20 +71,29 @@ class FSCache(MemoryCache):
         return os.path.join(self.root, bucket,
                             key.replace(":", "_") + ".json")
 
+    @staticmethod
+    def _failpoint():
+        from ..resilience import failpoint
+        failpoint("cache.backend")
+
     def missing_blobs(self, artifact_id, blob_ids):
+        self._failpoint()
         missing = [b for b in blob_ids
                    if not os.path.exists(self._path("blob", b))]
         return not os.path.exists(self._path("artifact", artifact_id)), missing
 
     def put_artifact(self, artifact_id, info):
+        self._failpoint()
         with open(self._path("artifact", artifact_id), "w") as f:
             json.dump(info, f)
 
     def put_blob(self, blob_id, blob):
+        self._failpoint()
         with open(self._path("blob", blob_id), "w") as f:
             json.dump(blob.to_json(), f)
 
     def get_artifact(self, artifact_id):
+        self._failpoint()
         p = self._path("artifact", artifact_id)
         if not os.path.exists(p):
             return None
@@ -88,6 +101,7 @@ class FSCache(MemoryCache):
             return json.load(f)
 
     def get_blob(self, blob_id):
+        self._failpoint()
         p = self._path("blob", blob_id)
         if not os.path.exists(p):
             return None

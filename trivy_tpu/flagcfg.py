@@ -45,10 +45,40 @@ CONFIG_PATHS = {
     "detect_coalesce_wait_ms": "detect.coalesce-wait-ms",
     "detect_max_inflight_pairs": "detect.max-inflight-pairs",
     "detect_warmup": "detect.warmup",
+    # graftguard (resilience.*): watchdog, breaker, admission,
+    # failpoints
+    "detect_dispatch_timeout_ms": "resilience.dispatch-timeout-ms",
+    "breaker_fail_threshold": "resilience.breaker-fail-threshold",
+    "breaker_reset_ms": "resilience.breaker-reset-ms",
+    "admit_max_active": "resilience.admit-max-active",
+    "admit_max_queue": "resilience.admit-max-queue",
+    "admit_queue_ms": "resilience.admit-queue-ms",
+    "failpoint": "resilience.failpoints",
 }
 
 _TRUE = {"1", "t", "true", "yes", "on"}
 _FALSE = {"0", "f", "false", "no", "off"}
+
+
+def split_commas(raw: str) -> list[str]:
+    """Split a comma-joined value, ignoring commas inside parentheses
+    — `--failpoint rpc.scan=flaky(0.05,7)` is ONE value (the failpoint
+    grammar's paren form), not two. The single splitter shared by the
+    append-flag coercion here and resilience.failpoints.parse_spec, so
+    env-sourced flags and direct specs can never parse differently."""
+    out, cur, depth = [], [], 0
+    for ch in raw:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(depth - 1, 0)
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
 
 
 class ConfigError(SystemExit):
@@ -97,7 +127,7 @@ def _coerce(action: argparse.Action, raw: Any, origin: str) -> Any:
     if isinstance(action, argparse._AppendAction):
         if isinstance(raw, list):
             return [str(v) for v in raw]
-        return [s.strip() for s in str(raw).split(",") if s.strip()]
+        return [s.strip() for s in split_commas(str(raw)) if s.strip()]
     if isinstance(raw, list):  # YAML list for a comma-joined flag
         raw = ",".join(str(v) for v in raw)
     if action.type is int or isinstance(action.default, int) and \

@@ -215,7 +215,9 @@ METRICS.declare(
     buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
              10.0, 30.0))
 METRICS.declare("trivy_tpu_detect_batches_total", "counter",
-                "Query batches dispatched to the device join.")
+                "Query batches dispatched to the device join (device "
+                "dispatches only — degraded-mode traffic counts in "
+                "trivy_tpu_fallback_joins_total instead).")
 METRICS.declare("trivy_tpu_detect_queries_total", "counter",
                 "Package queries entering the detect engine.")
 METRICS.declare("trivy_tpu_detect_pairs_total", "counter",
@@ -254,6 +256,23 @@ METRICS.declare(
     "Distinct join dispatch shapes seen by this process — each one "
     "is an XLA compilation (the bucket ladder and --detect-warmup "
     "exist to bound this).")
+METRICS.declare(
+    "trivy_tpu_detect_breaker_state", "gauge",
+    "graftguard device circuit breaker: 0 closed, 1 open, 2 half-open.")
+METRICS.declare(
+    "trivy_tpu_fallback_joins_total", "counter",
+    "Joins served by the NumPy host fallback executor instead of the "
+    "device (open breaker, or recovery after a supervised failure).")
+METRICS.declare(
+    "trivy_tpu_requests_shed_total", "counter",
+    "Scan RPCs rejected by admission control (429/503 + Retry-After).")
+METRICS.declare(
+    "trivy_tpu_device_watchdog_trips_total", "counter",
+    "Supervised device calls that outlived their watchdog deadline "
+    "(each trip opens the breaker).")
+METRICS.declare(
+    "trivy_tpu_admission_queue_depth", "gauge",
+    "Scan RPCs currently waiting in the admission queue.")
 METRICS.declare("trivy_tpu_secret_files_total", "counter",
                 "Files through the secret scanner.")
 METRICS.declare("trivy_tpu_secret_bytes_total", "counter",

@@ -51,6 +51,28 @@ class OCIError(RuntimeError):
     pass
 
 
+# graftguard shared retry policy for registry HTTP (this module had no
+# retries at all before — one TCP reset sank the whole pull). 401s are
+# excluded: the bearer-token challenge flow below handles those. Built
+# lazily so importing oci alone does not pull in the resilience
+# package (and its watchdog thread) — parity with db/download.py.
+_TRANSIENT_RETRY = None
+_RETRYABLE_HTTP = (429, 500, 502, 503, 504)
+_transient_http = None
+
+
+def _transient_retry():
+    global _TRANSIENT_RETRY, _transient_http
+    if _transient_http is None:
+        from .resilience.retry import http_should_retry
+        _transient_http = http_should_retry(_RETRYABLE_HTTP)
+    if _TRANSIENT_RETRY is None:
+        from .resilience import RetryPolicy
+        _TRANSIENT_RETRY = RetryPolicy(attempts=3, base_delay_s=0.3,
+                                       max_delay_s=3.0, budget_s=20.0)
+    return _TRANSIENT_RETRY
+
+
 @dataclass
 class ImageRef:
     host: str
@@ -116,18 +138,25 @@ class RegistryClient:
 
     def _request(self, url: str, headers: dict, ref: ImageRef,
                  _retried: bool = False):
-        req = urllib.request.Request(url, headers=headers)
         tok = self._tokens.get((ref.host, ref.repository))
         basic = (self.username, self.password) if self.username else \
             self._ecr_basic(ref.host)
-        if tok:
-            req.add_header("Authorization", f"Bearer {tok}")
-        elif basic is not None:
-            cred = base64.b64encode(
-                f"{basic[0]}:{basic[1]}".encode()).decode()
-            req.add_header("Authorization", f"Basic {cred}")
-        try:
+
+        def attempt():
+            # a fresh Request per try: urllib Request objects are not
+            # safely reusable after a failed open
+            req = urllib.request.Request(url, headers=headers)
+            if tok:
+                req.add_header("Authorization", f"Bearer {tok}")
+            elif basic is not None:
+                cred = base64.b64encode(
+                    f"{basic[0]}:{basic[1]}".encode()).decode()
+                req.add_header("Authorization", f"Basic {cred}")
             return urllib.request.urlopen(req, timeout=self.timeout)
+
+        try:
+            return _transient_retry().call(attempt,
+                                           should_retry=_transient_http)
         except urllib.error.HTTPError as e:
             if e.code == 401 and not _retried:
                 # no token yet, or the cached token expired mid-pull

@@ -160,30 +160,55 @@ class MeshDetector:
         self._inner.close()
 
     def detect(self, queries) -> list:
+        from ..resilience import GUARD, DeviceError, failpoint
         inner = self._inner
         if len(inner.table) == 0 or not queries:
             return []
         prep = inner._prepare(queries)
         if prep is None or prep.n_pairs == 0:
             return []
+        # graftguard: an open breaker skips the mesh entirely — the
+        # prep's host-side pair expansion feeds the NumPy reference
+        # join, bit-identical to the sharded path
+        if not GUARD.allow_device():
+            return inner._assemble(prep, inner._host_bits(prep))
         # CSR descriptors ship (O(queries) transfer); each device
         # expands its own pair list, like the single-chip path
         part = partition_queries(self.st, prep.q_start, prep.q_count,
                                  prep.q_ver, self.dp)
-        # the inner detector's cached device pool (re-shipped only on
-        # growth) doubles as the replicated mesh operand
-        ver_dev = inner._ver_device(prep.u_pad)
-        # per-dispatch accounting (occupancy vs the mesh's total padded
-        # cell capacity, batch/compile counters) — the mesh path
-        # launches its own join and would otherwise go dark on the
-        # series the single-chip dispatch path emits
-        t_total = int(part.t_loc) * int(part.valid.shape[0]) \
-            * int(part.valid.shape[1])
-        inner._account_dispatch(prep.n_pairs, t_total,
-                                int(part.q_start.shape[-1]),
-                                int(ver_dev.shape[0]))
-        bits = sharded_csr_join(self.mesh, self._st_dev, ver_dev, part,
-                                prep.n_pairs)
+        try:
+            # version-pool upload inside the watch: a dead backend
+            # fails right there, and the probe outcome must be
+            # recorded or the breaker wedges half-open. Unlike the
+            # single-chip launch, sharded_csr_join fetches its result
+            # synchronously, so a clean exit here IS execution success
+            # (record_success stays on)
+            with GUARD.watch("detect.dispatch"):
+                failpoint("detect.dispatch")
+                # the inner detector's cached device pool (re-shipped
+                # only on growth) doubles as the replicated mesh
+                # operand
+                ver_dev = inner._ver_device(prep.u_pad)
+                # per-dispatch accounting (occupancy vs the mesh's
+                # total padded cell capacity, batch/compile counters)
+                # — the mesh path launches its own join and would
+                # otherwise go dark on the series the single-chip
+                # dispatch path emits; traffic counts only after the
+                # join actually completed
+                t_total = int(part.t_loc) * int(part.valid.shape[0]) \
+                    * int(part.valid.shape[1])
+                inner._note_shape(t_total,
+                                  int(part.q_start.shape[-1]),
+                                  int(ver_dev.shape[0]))
+                bits = sharded_csr_join(self.mesh, self._st_dev,
+                                        ver_dev, part, prep.n_pairs)
+                inner._account_traffic(prep.n_pairs, t_total)
+        except DeviceError:
+            from ..log import get as _get_logger
+            _get_logger("mesh").warning(
+                "sharded join failed; host-fallback join",
+                exc_info=True)
+            bits = inner._host_bits(prep)
         return inner._assemble(prep, bits)
 
 

@@ -1,0 +1,34 @@
+"""graftguard — fault injection, device supervision, and graceful
+degradation for the scan service.
+
+Four parts, layered on the serving spine (see ARCHITECTURE.md "Fault
+tolerance (graftguard)"):
+
+  failpoints  named, deterministic fault-injection sites
+              (TRIVY_TPU_FAILPOINTS / --failpoint) — the substrate the
+              chaos suite drives everything below with;
+  breaker     device watchdog + circuit breaker (GUARD): deadlines
+              armed around every device dispatch/get, closed → open →
+              half-open recovery, swap_table-driven detector rebuild;
+  hostjoin    NumPy reference executor for pair_join/csr_pair_join —
+              the bit-identical host path the engine serves from while
+              the breaker is open;
+  admission   bounded deadline-aware scan queue: 429+Retry-After on
+              overflow, 503 while the open-breaker fallback is
+              saturated — plus RetryPolicy, the shared full-jitter
+              budget-capped client retry policy.
+"""
+
+from .admission import AdmissionOptions, AdmissionQueue, Shed
+from .breaker import (CircuitBreaker, Deadline, DeviceError,
+                      DeviceGuard, DeviceTimeout, GUARD)
+from .failpoints import (FAILPOINTS, FailpointError, FailpointRegistry,
+                         SITES, failpoint)
+from .retry import RetryPolicy, retry_on
+
+__all__ = [
+    "AdmissionOptions", "AdmissionQueue", "CircuitBreaker", "Deadline",
+    "DeviceError", "DeviceGuard", "DeviceTimeout", "FAILPOINTS",
+    "FailpointError", "FailpointRegistry", "GUARD", "RetryPolicy",
+    "SITES", "Shed", "failpoint", "retry_on",
+]

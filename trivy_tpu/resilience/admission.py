@@ -1,0 +1,142 @@
+"""graftguard admission control: a bounded, deadline-aware scan queue.
+
+ThreadingHTTPServer gives every connection a thread, so without
+admission the server's concurrency bound is "however many sockets the
+OS accepts" — under overload every request gets slower together until
+clients time out anyway, having cost a full scan each. Admission makes
+overload explicit and cheap:
+
+  * at most `max_active` Scan RPCs run concurrently (0 = unbounded);
+  * at most `max_queue` more may wait, each for at most
+    min(queue budget, its own deadline) — a handler thread is never
+    parked past the point its client has given up;
+  * everything else is shed immediately: HTTP 429 + Retry-After on
+    plain overflow, 503 + Retry-After when the device breaker is open
+    (the host-fallback path is saturated — retrying sooner than the
+    breaker's reset window buys nothing).
+
+Per-request deadlines ride in on `X-Trivy-Deadline-Ms` (the client
+stamps its own timeout); requests without one use the queue budget
+alone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..metrics import METRICS
+from .breaker import Deadline
+
+
+@dataclass
+class AdmissionOptions:
+    """Server knobs (--admit-* flags; resilience.* config paths)."""
+    max_active: int = 0        # concurrent scans; 0 = unbounded
+    max_queue: int = 16        # waiters beyond max_active
+    queue_timeout_ms: float = 1000.0   # max queue wait per request
+
+
+class Shed(Exception):
+    """Request rejected by admission. `http_code` is 429 (overflow /
+    queue timeout) or 503 (open-breaker saturation); `retry_after_s`
+    feeds the Retry-After header."""
+
+    def __init__(self, reason: str, http_code: int,
+                 retry_after_s: float):
+        super().__init__(reason)
+        self.reason = reason
+        self.http_code = http_code
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionQueue:
+    """Bounded admission for the Scan route. One instance per
+    ServerState; release() must be called for every successful
+    admit() (the handler's finally does)."""
+
+    def __init__(self, opts: AdmissionOptions | None = None,
+                 breaker=None):
+        self.opts = opts or AdmissionOptions()
+        # breaker consulted for the shed code: open breaker ⇒ the
+        # fallback path is the bottleneck ⇒ 503, not 429
+        self._breaker = breaker
+        self._cv = threading.Condition()
+        self._active = 0
+        self._queued = 0
+
+    # ---- introspection -------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {"active": self._active, "queued": self._queued,
+                    "max_active": self.opts.max_active,
+                    "max_queue": self.opts.max_queue}
+
+    # ---- admission -----------------------------------------------------
+
+    def _retry_after(self) -> float:
+        """Hint for shed clients: the queue budget (our best estimate
+        of when a slot frees), or the breaker's reset window when the
+        device is down — retrying before the probe can run is futile."""
+        hint = self.opts.queue_timeout_ms / 1e3
+        if self._breaker is not None and self._breaker.state != 0:
+            hint = max(hint, self._breaker.reset_timeout_s)
+        return max(1.0, hint)
+
+    def _shed(self, reason: str) -> Shed:
+        code = 503 if (self._breaker is not None
+                       and self._breaker.state != 0) else 429
+        METRICS.inc("trivy_tpu_requests_shed_total")
+        return Shed(reason, code, self._retry_after())
+
+    def admit(self, deadline: Deadline | None = None) -> None:
+        """Block until a slot frees (within budget and deadline) or
+        raise Shed. Callers MUST pair with release()."""
+        opts = self.opts
+        with self._cv:
+            if opts.max_active <= 0:
+                self._active += 1
+                return
+            if self._active < opts.max_active:
+                self._active += 1
+                return
+            if self._queued >= opts.max_queue:
+                raise self._shed("queue overflow")
+            budget = Deadline(opts.queue_timeout_ms / 1e3)
+            self._queued += 1
+            METRICS.set_gauge("trivy_tpu_admission_queue_depth",
+                              float(self._queued))
+            try:
+                while self._active >= opts.max_active:
+                    left = budget.remaining()
+                    if deadline is not None:
+                        left = min(left, deadline.remaining())
+                    if left <= 0:
+                        raise self._shed(
+                            "deadline exceeded in queue"
+                            if deadline is not None
+                            and deadline.expired()
+                            else "queue wait budget exhausted")
+                    self._cv.wait(timeout=left)
+                # a slot freed — but if the CLIENT's deadline lapsed
+                # while we were parked, admitting now runs a full scan
+                # for a caller that already gave up; shed instead (the
+                # slot stays free for the notify_all-woken others)
+                if deadline is not None and deadline.expired():
+                    raise self._shed("deadline exceeded in queue")
+                self._active += 1
+            finally:
+                self._queued -= 1
+                METRICS.set_gauge("trivy_tpu_admission_queue_depth",
+                                  float(self._queued))
+
+    def release(self) -> None:
+        with self._cv:
+            self._active -= 1
+            # notify_all, not notify: a woken waiter may SHED (its own
+            # deadline lapsed) without consuming the slot — a single
+            # notify would then be lost while other waiters sleep out
+            # their full budget next to a free slot
+            self._cv.notify_all()

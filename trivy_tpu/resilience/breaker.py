@@ -1,0 +1,402 @@
+"""graftguard device supervision: watchdog, circuit breaker, deadlines.
+
+The detect hot path trusts the device unconditionally today: a wedged
+dispatch hangs the request that issued it — and, through detectd's
+coalescing, every request merged behind it — and a dead backend turns
+each scan into a hang-until-timeout. This module makes the device an
+*optional* dependency:
+
+  Deadline        a monotonic countdown (`remaining()` / `expired()`)
+                  shared by the watchdog and the admission queue.
+  CircuitBreaker  closed → open → half-open. Backend errors count
+                  toward a threshold; watchdog timeouts trip the
+                  breaker immediately (`trip()`). While open, every
+                  device entry point routes to the host fallback
+                  (resilience.hostjoin) — same bits, slower. After
+                  `reset_timeout_s` ONE caller is admitted as the
+                  half-open probe; its success closes the breaker
+                  (and fires the recovery listeners — the server
+                  rebuilds the detector through swap_table's
+                  generation drain), its failure re-opens.
+  DeviceGuard     the process-wide supervisor (GUARD). `watch(site)`
+                  arms a deadline token around a device dispatch/get;
+                  a daemon watchdog thread sweeps armed tokens and
+                  trips the breaker when one expires, so OTHER
+                  requests fail over while the stuck call is still
+                  stuck. The stuck call itself is never force-killed:
+                  when it returns, its expired token converts the
+                  result to DeviceTimeout and the caller recomputes on
+                  the host — in-flight requests complete, bit-identical.
+
+Everything here is host-side orchestration; graftlint's TPU108 keeps
+failpoint probes, breaker reads, and deadline clocks out of device
+code (they would run once at trace time and lie).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..log import get as _get_logger
+from ..metrics import METRICS
+
+_log = _get_logger("resilience")
+
+CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+_STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half_open"}
+
+
+class DeviceError(RuntimeError):
+    """A supervised device call failed (backend error or injected
+    fault). Callers route to the host fallback."""
+
+
+class DeviceTimeout(DeviceError):
+    """A supervised device call outlived its watchdog deadline."""
+
+
+class Deadline:
+    """Monotonic countdown. Immutable after construction; `None`
+    seconds means 'no deadline' (never expires)."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, seconds: float | None,
+                 _now: float | None = None):
+        now = time.monotonic() if _now is None else _now
+        self.at = None if seconds is None else now + seconds
+
+    def remaining(self) -> float:
+        if self.at is None:
+            return float("inf")
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.at is not None and time.monotonic() >= self.at
+
+
+class CircuitBreaker:
+    """Thread-safe closed/open/half-open breaker. Instantiable for
+    tests (injectable clock); production shares GUARD.breaker."""
+
+    def __init__(self, fail_threshold: int = 3,
+                 reset_timeout_s: float = 5.0, clock=time.monotonic,
+                 name: str = "detect", gauge: str | None = None):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.name = name
+        self.fail_threshold = fail_threshold
+        self.reset_timeout_s = reset_timeout_s
+        # the exported state gauge is opt-in: only the process-wide
+        # GUARD breaker owns the metric — instantiable breakers (tests,
+        # future per-backend breakers) must not fight over one series
+        self.gauge = gauge
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self._opens_total = 0
+        self._listeners: list = []   # called on half-open → closed
+        if gauge:
+            METRICS.set_gauge(gauge, 0.0)
+
+    # ---- state ---------------------------------------------------------
+
+    def _set_state(self, state: int) -> None:
+        # callers hold self._lock
+        if state == self._state:
+            return
+        self._state = state
+        if state == OPEN:
+            self._opened_at = self._clock()
+            self._opens_total += 1
+        if self.gauge:
+            METRICS.set_gauge(self.gauge, float(state))
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def state_name(self) -> str:
+        return _STATE_NAMES[self.state]
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "state": _STATE_NAMES[self._state],
+                "failures": self._failures,
+                "opens_total": self._opens_total,
+                "open_age_s": (round(self._clock() - self._opened_at, 3)
+                               if self._state != CLOSED else None),
+            }
+
+    # ---- decisions -----------------------------------------------------
+
+    def allow(self) -> bool:
+        """May this caller use the device? While open, returns True for
+        exactly one caller per reset window — the half-open probe."""
+        if self._state == CLOSED:      # lock-free fast path
+            return True
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN and \
+                    self._clock() - self._opened_at \
+                    >= self.reset_timeout_s:
+                self._set_state(HALF_OPEN)
+                self._probing = True
+                _log.warning("breaker %s: half-open probe admitted",
+                             self.name)
+                return True
+            if self._state == HALF_OPEN and not self._probing:
+                # previous probe resolved (failed → OPEN would have
+                # been set); admit a fresh one
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._set_state(CLOSED)
+                self._failures = 0
+                self._probing = False
+                listeners = list(self._listeners)
+                _log.warning("breaker %s: probe succeeded, closed "
+                             "(device path restored)", self.name)
+            else:
+                self._failures = 0
+                return
+        for cb in listeners:
+            try:
+                cb()
+            except Exception:   # a listener must never sink the caller
+                _log.exception("breaker recovery listener failed")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._probing = False
+                self._set_state(OPEN)
+                _log.warning("breaker %s: probe failed, re-opened",
+                             self.name)
+                return
+            self._failures += 1
+            if self._state == CLOSED and \
+                    self._failures >= self.fail_threshold:
+                self._set_state(OPEN)
+                _log.warning("breaker %s: opened after %d failures",
+                             self.name, self._failures)
+
+    def trip(self) -> None:
+        """Open immediately (watchdog timeout: one wedged dispatch is
+        disqualifying, no threshold)."""
+        with self._lock:
+            self._probing = False
+            if self._state != OPEN:
+                self._set_state(OPEN)
+                _log.warning("breaker %s: tripped open", self.name)
+
+    def on_recovery(self, cb) -> None:
+        with self._lock:
+            self._listeners.append(cb)
+
+    def remove_recovery(self, cb) -> None:
+        with self._lock:
+            # equality, not identity: callers pass bound methods, and
+            # each `self._recover` attribute access builds a NEW bound
+            # method object — identity would never match and every
+            # closed server would stay registered (and retained) on
+            # the process-global breaker forever
+            self._listeners = [x for x in self._listeners if x != cb]
+
+    def reset(self) -> None:
+        """Force-close and forget history (tests, operator action)."""
+        with self._lock:
+            self._set_state(CLOSED)
+            self._failures = 0
+            self._probing = False
+
+
+class _WatchToken:
+    __slots__ = ("site", "deadline", "expired")
+
+    def __init__(self, site: str, deadline: Deadline):
+        self.site = site
+        self.deadline = deadline
+        self.expired = False
+
+
+class _Watch:
+    """Context manager returned by DeviceGuard.watch()."""
+
+    __slots__ = ("_guard", "_tok", "_record_success")
+
+    def __init__(self, guard: "DeviceGuard", tok: _WatchToken,
+                 record_success: bool):
+        self._guard = guard
+        self._tok = tok
+        self._record_success = record_success
+
+    def __enter__(self) -> _WatchToken:
+        return self._tok
+
+    def __exit__(self, etype, exc, tb) -> bool:
+        self._guard._disarm(self._tok)
+        if exc is not None:
+            if not isinstance(exc, Exception):
+                # KeyboardInterrupt/SystemExit must propagate untouched
+                # (wrapping them into DeviceError would make the host
+                # fallback swallow a Ctrl-C), and they say nothing
+                # about device health — no breaker accounting
+                return False
+            self._guard.breaker.record_failure()
+            raise DeviceError(
+                f"{self._tok.site}: {type(exc).__name__}: {exc}") \
+                from exc
+        if self._tok.expired:
+            # the watchdog already tripped the breaker; surface the
+            # timeout to THIS caller so it recomputes on the host
+            raise DeviceTimeout(
+                f"{self._tok.site}: exceeded watchdog deadline")
+        if self._record_success:
+            self._guard.breaker.record_success()
+        return False
+
+
+class DeviceGuard:
+    """Process-wide supervisor: breaker + watchdog + armed tokens.
+    One instance (GUARD) is shared the way METRICS is — the breaker
+    must survive detector rebuilds (swap_table replaces the engine,
+    not the device's health)."""
+
+    def __init__(self):
+        # a Condition (with its embedded lock) rather than a bare Lock:
+        # the watchdog sleeps on it and arm/disarm wake it
+        self._cv = threading.Condition()
+        self.breaker = CircuitBreaker(
+            gauge="trivy_tpu_detect_breaker_state")
+        self.dispatch_timeout_s = 120.0   # generous: compiles are slow
+        self._tokens: list[_WatchToken] = []
+        self._last_sweep = 0.0
+        self._next_wake = 0.0   # when the watchdog's current wait ends
+        # started eagerly (not on first watch): tests that snapshot
+        # the thread set must see the watchdog from import time, and a
+        # daemon sleeping 250 ms between sweeps costs nothing
+        self._thread = threading.Thread(
+            target=self._run, name="graftguard-watchdog", daemon=True)
+        self._thread.start()
+
+    def configure(self, dispatch_timeout_s: float | None = None,
+                  fail_threshold: int | None = None,
+                  reset_timeout_s: float | None = None) -> None:
+        if dispatch_timeout_s is not None:
+            self.dispatch_timeout_s = dispatch_timeout_s
+        if fail_threshold is not None:
+            self.breaker.fail_threshold = fail_threshold
+        if reset_timeout_s is not None:
+            self.breaker.reset_timeout_s = reset_timeout_s
+
+    # ---- hot-path surface ---------------------------------------------
+
+    def allow_device(self) -> bool:
+        return self.breaker.allow()
+
+    def record_success(self) -> None:
+        self.breaker.record_success()
+
+    def record_failure(self) -> None:
+        self.breaker.record_failure()
+
+    def watch(self, site: str, timeout_s: float | None = None,
+              record_success: bool = True) -> _Watch:
+        """Supervise one device call: arms a watchdog deadline; exit
+        converts exceptions to DeviceError (counting a breaker
+        failure), expiry to DeviceTimeout, and clean returns to a
+        breaker success.
+
+        Pass `record_success=False` around an ASYNC launch whose real
+        outcome surfaces later (a jax dispatch returns before the
+        program executes): a clean exit then records nothing, and the
+        breaker closes only when the paired result FETCH completes —
+        otherwise a half-open probe against a device that accepts
+        dispatches but wedges at execution would 'succeed', close the
+        breaker, and fire the expensive recovery rebuild every reset
+        window. Failures and watchdog expiries are always recorded."""
+        tok = _WatchToken(
+            site, Deadline(timeout_s if timeout_s is not None
+                           else self.dispatch_timeout_s))
+        with self._cv:
+            self._tokens.append(tok)
+            # wake the watchdog only when this deadline lands before
+            # its already-scheduled wakeup — with the default 120 s
+            # deadline vs a ≤250 ms sweep cadence that is never, so
+            # the join hot path pays no per-dispatch thread wakeup
+            if tok.deadline.at is not None \
+                    and tok.deadline.at < self._next_wake:
+                self._cv.notify()
+        return _Watch(self, tok, record_success)
+
+    def _disarm(self, tok: _WatchToken) -> None:
+        with self._cv:
+            self._tokens = [t for t in self._tokens if t is not tok]
+
+    # ---- watchdog ------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                now = time.monotonic()
+                self._last_sweep = now
+                expired = [t for t in self._tokens
+                           if not t.expired and t.deadline.expired()]
+                for t in expired:
+                    t.expired = True
+                nearest = min(
+                    (t.deadline.remaining() for t in self._tokens
+                     if not t.expired), default=None)
+            for t in expired:
+                METRICS.inc("trivy_tpu_device_watchdog_trips_total")
+                _log.warning("watchdog: %s outlived its deadline; "
+                             "tripping breaker", t.site)
+                self.breaker.trip()
+            with self._cv:
+                wait = 0.25 if nearest is None \
+                    else max(min(nearest, 0.25), 0.001)
+                self._next_wake = time.monotonic() + wait
+                self._cv.wait(timeout=wait)
+
+    # ---- introspection -------------------------------------------------
+
+    def status(self) -> dict:
+        """→ /healthz `resilience` payload."""
+        from .failpoints import FAILPOINTS
+        with self._cv:
+            armed = len(self._tokens)
+            last = self._last_sweep
+        out = {
+            "breaker": self.breaker.status(),
+            "watchdog_armed": armed,
+            "watchdog_last_probe_age_s": (
+                round(time.monotonic() - last, 3) if last else None),
+            "dispatch_timeout_ms": round(
+                self.dispatch_timeout_s * 1e3, 1),
+            "fallback_joins_total": int(
+                METRICS.get("trivy_tpu_fallback_joins_total")),
+            "requests_shed_total": int(
+                METRICS.get("trivy_tpu_requests_shed_total")),
+        }
+        fps = FAILPOINTS.active()
+        if fps:
+            out["failpoints"] = fps
+        return out
+
+    def reset_for_tests(self) -> None:
+        self.breaker.reset()
+        with self._cv:
+            self._tokens = []
+
+
+GUARD = DeviceGuard()

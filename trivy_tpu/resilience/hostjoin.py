@@ -1,0 +1,101 @@
+"""Host (NumPy) reference implementation of the advisory join.
+
+This is the graceful-degradation executor graftguard routes to while
+the device breaker is open, and the oracle the chaos suite compares
+the device path against. It mirrors `ops/join.py` exactly:
+
+  * `host_pair_join` is `_pair_core` — the interval predicate over a
+    flat candidate-pair list;
+  * `host_csr_pair_join` is `_csr_core` — CSR (bucket start, count,
+    version) descriptors expanded to the pair list first.
+
+Bit-identity is a hard contract, not best effort: downstream security
+tasks consume scan results as ground truth (PAPERS.md, *Revisiting
+Third-Party Library Detection*), so a degraded server must produce
+the same findings, only slower. The flag/report bit layout comes from
+`ops.constants` — the same single source the device kernel and
+db.flatten use, cross-checked by graftlint (TPU103 constant-drift and
+the XCHK db↔join schema contracts), so the three implementations
+cannot silently diverge.
+
+Everything here is plain NumPy — importable and runnable with no jax
+backend at all, which is the point.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.constants import (
+    HAS_HI, HAS_LO, HI_INCL, INEXACT, LO_INCL,
+)
+
+
+def _lex_less(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """a < b lexicographically over the token axis (ops.compare
+    semantics: decide at the first differing position)."""
+    neq = a != b
+    seen = np.cumsum(neq, axis=-1)
+    first = neq & (seen == 1)
+    return np.any(first & (a < b), axis=-1)
+
+
+def _lex_eq(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.all(a == b, axis=-1)
+
+
+def host_pair_join(adv_lo_tok: np.ndarray, adv_hi_tok: np.ndarray,
+                   adv_flags: np.ndarray, ver_tok: np.ndarray,
+                   pair_row: np.ndarray, pair_ver: np.ndarray,
+                   pair_valid: np.ndarray) -> np.ndarray:
+    """NumPy mirror of ops.join._pair_core → int8[T] report bits
+    (SATISFIED | NEEDS_RECHECK), zero where pair_valid is False."""
+    flags = adv_flags[pair_row]
+    lo_t = adv_lo_tok[pair_row]
+    hi_t = adv_hi_tok[pair_row]
+    inst = ver_tok[pair_ver]
+
+    has_lo = (flags & HAS_LO) != 0
+    lo_incl = (flags & LO_INCL) != 0
+    has_hi = (flags & HAS_HI) != 0
+    hi_incl = (flags & HI_INCL) != 0
+
+    ok_lo = (~has_lo) | _lex_less(lo_t, inst) \
+        | (lo_incl & _lex_eq(lo_t, inst))
+    ok_hi = (~has_hi) | _lex_less(inst, hi_t) \
+        | (hi_incl & _lex_eq(inst, hi_t))
+    satisfied = pair_valid & ok_lo & ok_hi
+    inexact = pair_valid & ((flags & INEXACT) != 0)
+    return (satisfied.astype(np.int8)
+            | (inexact.astype(np.int8) << 1))
+
+
+def host_csr_pair_join(adv_lo_tok: np.ndarray, adv_hi_tok: np.ndarray,
+                       adv_flags: np.ndarray, ver_tok: np.ndarray,
+                       q_start: np.ndarray, q_count: np.ndarray,
+                       q_ver: np.ndarray, total: int,
+                       t_pad: int) -> np.ndarray:
+    """NumPy mirror of ops.join._csr_core: expand the per-query CSR
+    descriptors to the flat pair list (np.repeat — the same expansion
+    _prepare builds host-side) and evaluate. → int8[t_pad]."""
+    total = int(total)
+    out = np.zeros(t_pad, np.int8)
+    if total == 0:
+        return out
+    counts = q_count.astype(np.int64)
+    nz = np.nonzero(counts)[0]
+    counts_nz = counts[nz]
+    offsets = np.zeros(nz.size + 1, np.int64)
+    np.cumsum(counts_nz, out=offsets[1:])
+    n_pairs = int(offsets[-1])
+    # the device relies on padding queries having zero counts; the sum
+    # of real counts IS the true pair total
+    assert n_pairs == total, (n_pairs, total)
+    pair_row = (np.arange(n_pairs, dtype=np.int64)
+                - np.repeat(offsets[:-1], counts_nz)
+                + np.repeat(q_start[nz].astype(np.int64), counts_nz))
+    pair_ver = np.repeat(q_ver[nz], counts_nz)
+    valid = np.ones(n_pairs, bool)
+    out[:n_pairs] = host_pair_join(adv_lo_tok, adv_hi_tok, adv_flags,
+                                   ver_tok, pair_row, pair_ver, valid)
+    return out

@@ -1,0 +1,96 @@
+"""graftguard RetryPolicy: one retry/backoff policy object for every
+network edge.
+
+Before this module each edge had its own story: server/client.py
+carried a bespoke fixed-backoff loop, and db/download.py + oci.py had
+no retries at all — one TCP reset into a 300 MB trivy-db pull threw
+the whole scan. Now the three share one policy shape:
+
+  * full jitter (AWS-style): sleep ~ U(0, min(max_delay, base·2^n)) —
+    decorrelated, so a thundering herd of clients re-spreads itself;
+  * budget-capped: total sleep across attempts never exceeds
+    `budget_s`, so retries cannot silently multiply a caller's
+    deadline (the admission queue's Retry-After hints are honored up
+    to the same budget);
+  * injectable rng/sleep so the chaos suite asserts the exact delay
+    sequence deterministically.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Immutable policy; share one instance per edge."""
+
+    attempts: int = 3          # total tries (1 = no retries)
+    base_delay_s: float = 0.2
+    max_delay_s: float = 5.0
+    budget_s: float = 30.0     # cap on cumulative sleep
+
+    def delay(self, attempt: int, rng=None) -> float:
+        """Full-jitter delay before retry number `attempt` (0-based)."""
+        rng = rng if rng is not None else random
+        return rng.uniform(
+            0.0, min(self.max_delay_s,
+                     self.base_delay_s * (2.0 ** attempt)))
+
+    def call(self, fn, *, should_retry, sleep=time.sleep, rng=None,
+             on_retry=None):
+        """Run `fn()` with retries.
+
+        `should_retry(exc)` → None to re-raise, or a minimum delay in
+        seconds (0.0 for "policy decides"; a server's Retry-After hint
+        goes here and is honored up to the budget). `on_retry(exc,
+        attempt, delay)` is an optional observer (logging)."""
+        spent = 0.0
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except BaseException as e:
+                floor = should_retry(e)
+                if floor is None or attempt + 1 >= self.attempts:
+                    raise
+                d = max(float(floor), self.delay(attempt, rng))
+                if spent + d > self.budget_s:
+                    raise
+                if on_retry is not None:
+                    on_retry(e, attempt, d)
+                sleep(d)
+                spent += d
+                attempt += 1
+
+
+def retry_on(*exc_types):
+    """→ a should_retry that retries (with policy-chosen delay) on the
+    given exception types and nothing else."""
+    def should_retry(e):
+        return 0.0 if isinstance(e, exc_types) else None
+    return should_retry
+
+
+def http_should_retry(codes):
+    """→ a should_retry for urllib edges, shared by the RPC client and
+    the OCI registry so Retry-After parsing lives in exactly one
+    place: connection errors (URLError) retry with the policy's
+    jitter; HTTPErrors with a code in `codes` retry no sooner than
+    their Retry-After header; everything else is terminal."""
+    import urllib.error
+
+    def should_retry(e):
+        if isinstance(e, urllib.error.HTTPError):
+            if e.code in codes:
+                try:
+                    return float(e.headers.get("Retry-After") or 0.0)
+                except ValueError:
+                    return 0.0
+            return None
+        if isinstance(e, urllib.error.URLError):
+            return 0.0
+        return None
+    return should_retry

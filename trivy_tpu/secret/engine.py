@@ -75,15 +75,35 @@ class SecretScanner:
     # --- device prefilter ---
 
     def _keyword_masks(self, files: list[bytes]) -> list[set[int]]:
-        """→ per-file set of rule indices whose keywords appear."""
+        """→ per-file set of rule indices whose keywords appear.
+
+        graftguard: the device prefilter shares the detect breaker —
+        while it is open the host scan runs directly (same candidate
+        sets, the prefilter is exact either way), and device failures
+        here count toward opening it. The whole device pass runs under
+        GUARD.watch: its dispatch+gets are synchronous, so a clean
+        exit is real execution success, a wedge arms the watchdog
+        (trips the breaker for everyone else), and errors are recorded
+        exactly once by the watch."""
+        from ..resilience import GUARD, DeviceError
         if self._bank is None:
             return [set() for _ in files]
         if self.use_device and \
-                sum(len(f) for f in files) >= SMALL_BATCH_BYTES:
+                sum(len(f) for f in files) >= SMALL_BATCH_BYTES and \
+                GUARD.allow_device():
             try:
-                return self._keyword_masks_device(files)
-            except Exception:  # device unavailable: host fallback
-                pass
+                with GUARD.watch("detect.device_get"):
+                    return self._keyword_masks_device(files)
+            except DeviceError:
+                # logged, not just swallowed: a DETERMINISTIC host-side
+                # bug landing here would open the shared breaker after
+                # fail_threshold scans, and the operator needs the
+                # traceback to tell it apart from a real device outage
+                from ..log import get as _get_logger
+                _get_logger("secret").warning(
+                    "device keyword prefilter failed; falling back to "
+                    "host scan (counted against the detect breaker)",
+                    exc_info=True)
         return self._keyword_masks_host(files)
 
     def _keyword_masks_host(self, files: list[bytes]) -> list[set[int]]:

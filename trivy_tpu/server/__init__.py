@@ -1,3 +1,18 @@
 """Client/server mode (reference rpc/ + pkg/rpc): a Twirp-shaped HTTP
 boundary between analysis (client side) and batched TPU detection
-(server side)."""
+(server side).
+
+The wire-header names live HERE, not in listen.py: the client must be
+importable without dragging in the server stack (listen → scanner →
+detect engine → graftguard watchdog thread) — a remote-scan client
+process has no device to supervise."""
+
+TOKEN_HEADER = "Trivy-Token"
+# per-RPC trace id: honored when the client sends one, generated
+# otherwise; echoed on every response and stamped on every span and
+# log line the request produces (graftscope propagation)
+TRACE_HEADER = "X-Trivy-Trace-Id"
+# graftguard per-request deadline: milliseconds the client is willing
+# to wait, queue time included — the admission queue never parks a
+# handler thread past it (the client stamps its own timeout here)
+DEADLINE_HEADER = "X-Trivy-Deadline-Ms"

@@ -1,21 +1,49 @@
 """Remote scan client (reference pkg/rpc/client + pkg/cache/remote.go):
 the client analyzes locally, pushes blobs to the server's cache, and
 asks the server — which owns the device-resident advisory table — to
-detect. Retries transient failures like pkg/rpc/retry.go."""
+detect. Transient failures retry through the shared graftguard
+RetryPolicy (full jitter, budget-capped — resilience/retry.py replaced
+the bespoke fixed-backoff loop this module used to carry); 429/503
+sheds from the server's admission queue are retried honoring their
+Retry-After hint. Each RPC carries an X-Trivy-Deadline-Ms stamp of the
+client's own timeout so the server never queues it past that."""
 
 from __future__ import annotations
 
 import json
-import time
 import urllib.error
 import urllib.request
 
 from .. import types as T
 from ..obs import current_trace_id, ensure_trace, span
 from ..report.writer import report_from_json
-from .listen import TOKEN_HEADER, TRACE_HEADER
+from . import DEADLINE_HEADER, TOKEN_HEADER, TRACE_HEADER
 
-RETRIES = 3
+# one policy shape for every RPC; _Base accepts an override for tests.
+# Built lazily (like oci.py / db/download.py): a pure client process
+# has no device to supervise, and a module-level resilience import
+# would spawn the GUARD watchdog thread as a side effect
+DEFAULT_RETRY = None
+_retry_after_hint = None
+
+
+def _retry_hint():
+    global _retry_after_hint
+    if _retry_after_hint is None:
+        from ..resilience.retry import http_should_retry
+        # admission sheds (429/503) retry honoring the server's
+        # Retry-After; other HTTP errors are terminal Twirp responses
+        _retry_after_hint = http_should_retry((429, 503))
+    return _retry_after_hint
+
+
+def _default_retry():
+    global DEFAULT_RETRY
+    if DEFAULT_RETRY is None:
+        from ..resilience import RetryPolicy
+        DEFAULT_RETRY = RetryPolicy(attempts=3, base_delay_s=0.2,
+                                    max_delay_s=2.0, budget_s=15.0)
+    return DEFAULT_RETRY
 
 
 class TwirpError(RuntimeError):
@@ -25,10 +53,12 @@ class TwirpError(RuntimeError):
 
 
 class _Base:
-    def __init__(self, base_url: str, token: str = "", timeout: float = 60):
+    def __init__(self, base_url: str, token: str = "", timeout: float = 60,
+                 retry=None):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self.retry = retry  # None → the shared lazy DEFAULT_RETRY
 
     def _call(self, service: str, method: str, payload: dict) -> dict:
         url = f"{self.base_url}/twirp/{service}/{method}"
@@ -36,28 +66,32 @@ class _Base:
         # forward the active graftscope trace id so client and server
         # spans/logs correlate (the server mints one when absent)
         tid = current_trace_id()
-        last = None
-        for attempt in range(RETRIES):
-            req = urllib.request.Request(
-                url, data=body, method="POST",
-                headers={"Content-Type": "application/json",
-                         **({TRACE_HEADER: tid} if tid else {}),
-                         **({TOKEN_HEADER: self.token} if self.token else {})})
+        headers = {
+            "Content-Type": "application/json",
+            DEADLINE_HEADER: str(int(self.timeout * 1e3)),
+            **({TRACE_HEADER: tid} if tid else {}),
+            **({TOKEN_HEADER: self.token} if self.token else {}),
+        }
+
+        def attempt() -> dict:
+            req = urllib.request.Request(url, data=body, method="POST",
+                                         headers=headers)
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read() or b"{}")
+
+        policy = self.retry or _default_retry()
+        try:
+            return policy.call(attempt, should_retry=_retry_hint())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    return json.loads(r.read() or b"{}")
-            except urllib.error.HTTPError as e:
-                detail = e.read().decode(errors="replace")
-                try:
-                    j = json.loads(detail)
-                    raise TwirpError(j.get("code", str(e.code)),
-                                     j.get("msg", detail)) from None
-                except (ValueError, json.JSONDecodeError):
-                    raise TwirpError(str(e.code), detail) from None
-            except urllib.error.URLError as e:
-                last = e
-                time.sleep(0.2 * (attempt + 1))
-        raise TwirpError("unavailable", str(last))
+                j = json.loads(detail)
+                raise TwirpError(j.get("code", str(e.code)),
+                                 j.get("msg", detail)) from None
+            except (ValueError, json.JSONDecodeError):
+                raise TwirpError(str(e.code), detail) from None
+        except urllib.error.URLError as e:
+            raise TwirpError("unavailable", str(e)) from None
 
 
 class RemoteCache(_Base):

@@ -25,20 +25,21 @@ from .. import __version__, types as T
 from ..fanal.cache import FSCache, blob_from_json
 from ..log import get as _get_logger
 from ..obs import device_status, new_trace, span
+from ..resilience import (AdmissionQueue, Deadline, GUARD, Shed,
+                          failpoint)
 from ..scanner import LocalScanner
-
-TOKEN_HEADER = "Trivy-Token"
-# per-RPC trace id: honored when the client sends one, generated
-# otherwise; echoed on every response and stamped on every span and
-# log line the request produces (graftscope propagation)
-TRACE_HEADER = "X-Trivy-Trace-Id"
+# wire-header names live in the package __init__ so the CLIENT can
+# import them without pulling in this module's server stack;
+# re-exported here for the existing `listen.TOKEN_HEADER` readers
+from . import DEADLINE_HEADER, TOKEN_HEADER, TRACE_HEADER  # noqa: F401
 
 _log = _get_logger("server")
 
 
 class ServerState:
     def __init__(self, table, cache_dir: str, token: str = "",
-                 cache_backend: str = "fs", detect_opts=None):
+                 cache_backend: str = "fs", detect_opts=None,
+                 admission=None):
         from ..detect.sched import SchedOptions
         if cache_backend.startswith("redis://"):
             from ..fanal.redis_cache import RedisCache
@@ -55,6 +56,12 @@ class ServerState:
         # (detect/sched.py; --detect-* flags tune or disable it)
         self.detect_opts = detect_opts if detect_opts is not None \
             else SchedOptions()
+        # graftguard admission: bounded deadline-aware Scan queue
+        # (--admit-* flags; unbounded by default). The breaker reference
+        # picks the shed code — 503 while the device is down, 429 else
+        self.admission = AdmissionQueue(admission,
+                                        breaker=GUARD.breaker)
+        self._table = table
         self._scanner = LocalScanner(self.cache, table,
                                      sched=self.detect_opts)
         self._inflight = 0
@@ -66,6 +73,29 @@ class ServerState:
         # which under sustained traffic never reaches zero
         self._gen = 0
         self._gen_active = {0: 0}
+        # breaker recovery (half-open probe succeeded): rebuild the
+        # detector through the swap_table generation drain — a fresh
+        # engine re-ships its device arrays onto the recovered backend
+        # and no in-flight request is ever force-killed. The rebuild
+        # runs on its own thread: listeners fire from whatever thread
+        # recorded the probe's success, which must not absorb a
+        # multi-second scanner build
+        GUARD.breaker.on_recovery(self._recover)
+
+    def _recover(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+        _log.warning("graftguard: device recovered; rebuilding "
+                     "detector via swap_table")
+        threading.Thread(target=self._recover_swap,
+                         name="graftguard-recover", daemon=True).start()
+
+    def _recover_swap(self) -> None:
+        try:
+            self.swap_table(self._table)
+        except Exception:
+            _log.exception("graftguard: recovery swap failed")
 
     def request_started(self) -> int:
         """→ the scanner generation this request runs under; pass it
@@ -96,6 +126,7 @@ class ServerState:
                 return
             self._closed = True
             scanner = self._scanner
+        GUARD.breaker.remove_recovery(self._recover)
         scanner.close()
 
     def swap_table(self, table) -> None:
@@ -113,6 +144,7 @@ class ServerState:
             if not self._gen_active[old_gen]:
                 del self._gen_active[old_gen]
             self._scanner = new_scanner
+            self._table = table
         # the swapped-in table's object graph (~1M small objects for a
         # full trivy-db) is immutable; freezing it out of the cyclic
         # collector keeps gen2 passes from stalling in-flight scans.
@@ -232,8 +264,16 @@ class Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
             else:
-                self._json(200, {"status": "ok",
-                                 "device": device_status()})
+                self._json(200, {
+                    "status": "ok",
+                    "device": device_status(),
+                    # graftguard: breaker state, watchdog last-probe
+                    # age, shed/fallback counters, admission snapshot
+                    "resilience": {
+                        **GUARD.status(),
+                        "admission": self.state.admission.snapshot(),
+                    },
+                })
         elif self.path == "/version":
             self._json(200, {"Version": __version__})
         elif self.path == "/metrics":
@@ -313,7 +353,7 @@ class Handler(BaseHTTPRequestHandler):
 
         try:
             if route == "/twirp/trivy.scanner.v1.Scanner/Scan":
-                return self._scan(req)
+                return self._scan_admitted(req)
             if route == "/twirp/trivy.cache.v1.Cache/PutArtifact":
                 st.cache.put_artifact(req.get("artifact_id", ""),
                                       req.get("artifact_info") or {})
@@ -340,6 +380,49 @@ class Handler(BaseHTTPRequestHandler):
             return self._twirp_error(400, "invalid_argument", str(e))
         except Exception as e:  # noqa: BLE001 — server must not die
             return self._twirp_error(500, "internal", f"{type(e).__name__}: {e}")
+
+    def _shed_response(self, s: Shed):
+        """429/503 + Retry-After: the admission queue rejected the
+        scan. Twirp-style JSON body so clients surface a reason."""
+        body = json.dumps({
+            "code": "resource_exhausted" if s.http_code == 429
+            else "unavailable",
+            "msg": f"scan shed: {s.reason}",
+        }).encode()
+        self.send_response(s.http_code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After",
+                         str(max(1, int(s.retry_after_s + 0.999))))
+        self.send_header("Content-Length", str(len(body)))
+        if self._trace_id:
+            self.send_header(TRACE_HEADER, self._trace_id)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _scan_admitted(self, req: dict):
+        """Scan behind graftguard admission: bounded concurrency,
+        bounded queue time, per-request deadline from
+        X-Trivy-Deadline-Ms — a handler thread is never parked past
+        the point its client has given up."""
+        st = self.state
+        deadline = None
+        hdr = self.headers.get(DEADLINE_HEADER)
+        if hdr:
+            try:
+                deadline = Deadline(max(float(hdr), 0.0) / 1e3)
+            except ValueError:
+                deadline = None  # unparseable header: no deadline
+        try:
+            st.admission.admit(deadline)
+        except Shed as s:
+            _log.warning("scan shed (%s): %d Retry-After=%ds",
+                         s.reason, s.http_code, int(s.retry_after_s))
+            return self._shed_response(s)
+        try:
+            failpoint("rpc.scan")
+            return self._scan(req)
+        finally:
+            st.admission.release()
 
     def _scan(self, req: dict):
         import time
@@ -375,16 +458,17 @@ class Handler(BaseHTTPRequestHandler):
 def serve(host: str, port: int, table, cache_dir: str, token: str = "",
           ready_event: threading.Event | None = None,
           cache_backend: str = "fs", trace_path: str = "",
-          detect_opts=None):
+          detect_opts=None, admission=None):
     """`trace_path` arms graftscope recording for the server's
     lifetime and dumps the Chrome trace-event JSON there on shutdown
     (the CLI's `server --trace FILE`). `detect_opts` (SchedOptions)
-    tunes detectd — coalesce wait, in-flight pair bound, warmup."""
+    tunes detectd — coalesce wait, in-flight pair bound, warmup;
+    `admission` (AdmissionOptions) bounds the graftguard scan queue."""
     if trace_path:
         from ..obs import COLLECTOR
         COLLECTOR.enable()
     state = ServerState(table, cache_dir, token, cache_backend,
-                        detect_opts=detect_opts)
+                        detect_opts=detect_opts, admission=admission)
     Handler.state = state
     httpd = ThreadingHTTPServer((host, port), Handler)
     if ready_event is not None:
@@ -403,12 +487,14 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
 
 
 def serve_background(host: str, port: int, table, cache_dir: str,
-                     token: str = "", detect_opts=None):
+                     token: str = "", detect_opts=None,
+                     admission=None):
     """Start in a daemon thread; returns (httpd, state) once listening.
     Callers own shutdown: `httpd.shutdown()` then `state.close()` (the
     detect engine's worker threads are non-daemon)."""
     Handler.state = ServerState(table, cache_dir, token,
-                                detect_opts=detect_opts)
+                                detect_opts=detect_opts,
+                                admission=admission)
     httpd = ThreadingHTTPServer((host, port), Handler)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
