@@ -554,6 +554,57 @@ def bench_degraded_mode(table, images):
         det.close()
 
 
+MESH_DEGRADED_IMAGES = 192   # subset: mesh joins gather synchronously
+
+
+def bench_mesh_degraded(table, images):
+    """meshguard scenario: detect throughput on the full N-device mesh
+    vs the shrunk N-1 mesh (one fault domain lost and re-meshed, the
+    steady state after a shrink rebuild) — the cost of losing one chip
+    should be ~1/N of throughput, not the cliff down to the host
+    fallback. Hit parity across both meshes and the single-chip path
+    is recorded: a shrunk mesh must never change findings."""
+    import jax
+
+    from trivy_tpu.detect.engine import BatchDetector
+    from trivy_tpu.parallel.mesh import MeshDetector, mesh_from_devices
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None   # nothing to shrink on a single-device backend
+    n = min(len(devs), 4)
+    db_pref = 2 if n % 2 == 0 else 1
+    sub = images[:MESH_DEGRADED_IMAGES]
+
+    single = BatchDetector(table)
+    try:
+        hits_ref = run_device(single, sub)
+    finally:
+        single.close()
+
+    def point(k):
+        det = MeshDetector(table, mesh_from_devices(devs[:k], db_pref))
+        try:
+            run_device(det, sub)   # warm the partition compiles
+            t0 = time.perf_counter()
+            hits = run_device(det, sub)
+            return len(sub) / (time.perf_counter() - t0), hits
+        finally:
+            det.close()
+
+    full_ips, full_hits = point(n)
+    deg_ips, deg_hits = point(n - 1)
+    return {
+        "devices": n,
+        "full_ips": round(full_ips, 2),
+        "degraded_ips": round(deg_ips, 2),
+        "degraded_slowdown": round(full_ips / deg_ips, 3)
+        if deg_ips else None,
+        "parity_ok": bool(full_hits == hits_ref
+                          and deg_hits == hits_ref),
+    }
+
+
 def bench_secrets_host():
     """Host bytes.find gate over the same corpus/keywords (MB/s), and
     the full host-only scan_files pipeline for the same corpus."""
@@ -627,6 +678,10 @@ def device_child_main():
         degraded = bench_degraded_mode(table, images)
     except Exception:
         degraded = None
+    try:
+        mesh_degraded = bench_mesh_degraded(table, images)
+    except Exception:
+        mesh_degraded = None
 
     import jax
     payload = {
@@ -644,6 +699,7 @@ def device_child_main():
         "server_hits": server_hits,
         "server_concurrency": server_conc,
         "degraded_mode": degraded,
+        "mesh_degraded": mesh_degraded,
         "device": str(jax.devices()[0]),
         "build_s": build_s,
         "scan_s": dev_s,
@@ -880,6 +936,14 @@ def main():
         except Exception as e:
             diag.append(f"degraded_mode bench failed: {e}")
         try:
+            # meshguard shrink scenario (ips at N vs N-1 devices): the
+            # orchestrator is pinned to the 1-device CPU backend, so
+            # this CPU point is usually None — the device child's
+            # multi-chip numbers override when the chip is reachable
+            result["mesh_degraded"] = bench_mesh_degraded(table, images)
+        except Exception as e:
+            diag.append(f"mesh_degraded bench failed: {e}")
+        try:
             arch_ips, _arch_hits = bench_archive_e2e(table)
             result["images_per_sec_archive_e2e"] = round(arch_ips, 1)
         except Exception as e:
@@ -915,6 +979,8 @@ def main():
                 result["server_concurrency"] = dev["server_concurrency"]
             if dev.get("degraded_mode"):
                 result["degraded_mode"] = dev["degraded_mode"]
+            if dev.get("mesh_degraded"):
+                result["mesh_degraded"] = dev["mesh_degraded"]
             result["host_prep_ms"] = round(dev["host_prep_ms"], 1)
             result["device_ms"] = round(dev["device_ms"], 1)
             result["assemble_ms"] = round(dev["assemble_ms"], 1)

@@ -364,6 +364,66 @@ def test_sched_failpoint_in_device_code_detected():
     assert [(f.rule, f.line) for f in fs] == [("TPU108", 4)]
 
 
+def test_parallel_rebuild_code_in_lock_hygiene_scope():
+    """Satellite (PR 5): the whole parallel/ package — the meshguard
+    rebuild/coordinator surface and the ingest queue are shared across
+    handler threads, the dispatcher, and the maintenance thread — is
+    in TPU106 scope."""
+    src = (
+        "import threading\n"
+        "class Rebuilder:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._lost = []\n"
+        "    def bad(self, dev):\n"
+        "        self._lost.append(dev)\n"
+        "    def good(self, dev):\n"
+        "        with self._lock:\n"
+        "            self._lost.append(dev)\n"
+    )
+    fs = _lint("trivy_tpu/parallel/mesh.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+    # outside the scoped modules the same class is not checked
+    assert _lint("trivy_tpu/report/fixture.py", src) == []
+
+
+def test_shard_map_body_is_device_code_for_tpu108():
+    """Satellite (PR 5): a failpoint probe or breaker read inside a
+    shard_map body runs once at trace time, exactly like in a jitted
+    core — TPU108 must see inside the mesh path's collective
+    launches."""
+    src = (
+        "from jax.experimental.shard_map import shard_map\n"
+        "from trivy_tpu.resilience import GUARD, failpoint\n"
+        "def _mesh_local(x):\n"
+        "    failpoint('detect.mesh:0')\n"
+        "    if GUARD.allow_device():\n"
+        "        x = x + 1\n"
+        "    return x\n"
+        "f = shard_map(_mesh_local, mesh=None, in_specs=(),\n"
+        "              out_specs=())\n"
+    )
+    fs = _lint("trivy_tpu/parallel/mesh.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU108", 4),
+                                              ("TPU108", 5)]
+    assert all(f.context == "_mesh_local" for f in fs)
+
+
+def test_shard_map_body_clock_is_tpu107():
+    """TPU107 rides the same shard_map device-fn detection: a clock
+    read inside the per-device local function measures trace time."""
+    src = (
+        "import time\n"
+        "from jax import shard_map\n"
+        "def local(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    return x + t0\n"
+        "f = shard_map(local, mesh=None, in_specs=(), out_specs=())\n"
+    )
+    fs = _lint("trivy_tpu/parallel/mesh.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU107", 4)]
+
+
 def test_resilience_registry_in_lock_hygiene_scope():
     """Satellite: the failpoint registry (trivy_tpu/resilience/) is
     shared across handler threads and the watchdog — TPU106 must

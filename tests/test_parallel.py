@@ -3,7 +3,11 @@ pair join must be bit-identical to the single-device path, for every mesh
 factorization."""
 
 import glob
+import json
 import os
+import threading
+import time
+import urllib.request
 
 import numpy as np
 import pytest
@@ -13,8 +17,17 @@ import jax
 from trivy_tpu.db import build_table
 from trivy_tpu.db.fixtures import load_fixture_files
 from trivy_tpu.detect.engine import BatchDetector, PkgQuery
-from trivy_tpu.parallel.mesh import (MeshDetector, make_mesh,
+from trivy_tpu.detect.sched import SchedOptions
+from trivy_tpu.metrics import METRICS
+from trivy_tpu.parallel.mesh import (MeshDetector, best_db_shards,
+                                     make_mesh, mesh_from_devices,
                                      partition_queries, shard_table)
+from trivy_tpu.resilience import (FAILPOINTS, GUARD, MeshGuard,
+                                  MeshGuardOptions, mesh_site)
+from trivy_tpu.resilience.failpoints import parse_spec
+
+from helpers import parse_exposition
+from test_sched import _rand_requests
 
 FIXTURES = sorted(glob.glob(
     os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")))
@@ -280,3 +293,627 @@ def test_partition_queries_splits_skewed_bucket(table):
     # coverage is still exact after splitting
     got = np.sort(part.perm[part.valid])
     assert np.array_equal(got, np.arange(n_pairs))
+
+
+# ---- meshguard: per-device fault domains, shrink/grow, crash-safe
+# persistent state (PR 5) -------------------------------------------------
+
+def _fast_opts(**kw):
+    """MeshGuardOptions tuned for test speed: 20 ms per-device
+    watchdog, 10 ms maintenance cadence, 50 ms open→half-open window."""
+    base = dict(min_devices=1, rebuild_cooldown_ms=1.0,
+                probe_timeout_ms=20.0, probe_interval_ms=10.0,
+                fail_threshold=3, reset_timeout_ms=50.0)
+    base.update(kw)
+    return MeshGuardOptions(**base)
+
+
+@pytest.fixture()
+def _clean_guard():
+    """Meshguard tests share the process-global FAILPOINTS/GUARD the
+    way the graftguard chaos suite does — reset around each test."""
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+    yield
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+
+
+def test_best_db_shards_largest_valid_factorization():
+    assert best_db_shards(8, 2) == 2
+    assert best_db_shards(7, 2) == 1     # prime survivor count → db=1
+    assert best_db_shards(6, 4) == 3     # largest divisor ≤ preference
+    assert best_db_shards(3, 2) == 1     # the 4→3 shrink case
+    assert best_db_shards(4, 8) == 4     # preference above n clamps
+    with pytest.raises(ValueError):
+        best_db_shards(0, 2)
+
+
+def test_mesh_from_devices_keeps_every_survivor():
+    devs = jax.devices()
+    for n in (3, 5, 6, 8):
+        m = mesh_from_devices(devs[:n], 2)
+        assert m.devices.size == n
+        assert m.axis_names == ("dp", "db")
+
+
+def test_mesh_failpoint_site_family():
+    specs = parse_spec("detect.mesh:3=hang:50;detect.dispatch=error")
+    assert set(specs) == {"detect.mesh:3", "detect.dispatch"}
+    with pytest.raises(ValueError):
+        parse_spec("detect.meshx:3=error")   # unknown family
+    with pytest.raises(ValueError):
+        parse_spec("detect.mesh=error")      # family needs an instance
+
+
+@pytest.mark.parametrize("db_shards", [1, 2])
+def test_sharded_join_matches_single_after_shrink(table, db_shards):
+    """The 3-survivor mesh (the 4-device mesh minus one lost domain)
+    must stay bit-identical to the single-chip join — the strided-perm
+    reassembly guarantees it once the partition is rebuilt."""
+    devs = jax.devices()[:4]
+    survivors = [d for d in devs if d.id != devs[2].id]
+    mesh = mesh_from_devices(survivors, db_shards)
+    single = BatchDetector(table)
+    shrunk = MeshDetector(table, mesh)
+    try:
+        qs = _queries()
+        assert shrunk.detect(qs) == single.detect(qs)
+    finally:
+        shrunk.close()
+        single.close()
+
+
+def test_scheduler_routes_over_mesh(table, _clean_guard):
+    """detectd's coalesced dispatches through a MeshDetector must be
+    hit-for-hit identical (order included) to serial single-chip
+    detect_many — the dispatch-routing surface the swap drain relies
+    on."""
+    from trivy_tpu.detect.sched import DispatchScheduler
+    requests = _rand_requests(59, 16)
+    serial = BatchDetector(table)
+    expected = [serial.detect_many(b) for b in requests]
+    serial.close()
+
+    det = MeshDetector(table, make_mesh(4, db_shards=2))
+    sched = DispatchScheduler(det, SchedOptions(coalesce_wait_ms=3.0))
+    results: list = [None] * len(requests)
+    errors: list = []
+
+    def worker(ids):
+        try:
+            for i in ids:
+                results[i] = sched.detect_many(requests[i])
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(
+        target=worker, args=(range(k, len(requests), 6),))
+        for k in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    sched.close()
+    det.close()
+    assert not errors
+    assert results == expected
+
+
+class TestMeshguardDomains:
+    @pytest.fixture(autouse=True)
+    def _clean(self, _clean_guard):
+        yield
+
+    def test_hang_trips_only_its_domain(self, table):
+        mesh = make_mesh(4, db_shards=2)
+        guard = MeshGuard([int(d.id) for d in mesh.devices.flat],
+                          _fast_opts())
+        det = MeshDetector(table, mesh, guard=guard)
+        single = BatchDetector(table)
+        try:
+            qs = _queries()
+            want = single.detect(qs)
+            assert det.detect(qs) == want
+            victim = det.device_ids[1]
+            FAILPOINTS.set(mesh_site(victim), "hang", 100.0)
+            fb0 = METRICS.get("trivy_tpu_fallback_joins_total")
+            # the faulted dispatch is attributed to the device, served
+            # host-side, and stays bit-identical
+            assert det.detect(qs) == want
+            assert METRICS.get("trivy_tpu_fallback_joins_total") > fb0
+            assert guard.lost_ids() == [victim]
+            # open, or mid-readmission-probe (the armed hang keeps
+            # failing the probe, flapping open ↔ half-open) — never
+            # closed while the fault is armed
+            assert guard.registry.get(victim).state_name() != "closed"
+            # the backend breaker (and every other domain) never moved
+            assert GUARD.breaker.state_name() == "closed"
+            for other in det.device_ids:
+                if other != victim:
+                    assert guard.registry.get(other).state_name() \
+                        == "closed"
+            # pre-swap drain window: the mesh still contains the lost
+            # device, so dispatches skip straight to the host join
+            # (no re-probe, no second 100 ms stall charged per scan)
+            assert det.detect(qs) == want
+        finally:
+            guard.close()
+            det.close()
+            single.close()
+
+    def test_error_mode_respects_per_device_threshold(self, table):
+        mesh = make_mesh(2, db_shards=1)
+        guard = MeshGuard([int(d.id) for d in mesh.devices.flat],
+                          _fast_opts(fail_threshold=2,
+                                     reset_timeout_ms=60000.0))
+        det = MeshDetector(table, mesh, guard=guard)
+        single = BatchDetector(table)
+        try:
+            qs = _queries()
+            want = single.detect(qs)
+            victim = det.device_ids[0]
+            FAILPOINTS.set(mesh_site(victim), "error")
+            # first error: domain noise below the threshold — host
+            # fallback for this dispatch, device NOT lost
+            assert det.detect(qs) == want
+            assert guard.lost_ids() == []
+            # second error crosses the threshold: breaker opens, lost
+            assert det.detect(qs) == want
+            assert guard.lost_ids() == [victim]
+        finally:
+            guard.close()
+            det.close()
+            single.close()
+
+    def test_shrink_then_grow_rebuild_callbacks(self):
+        ids = [10, 11, 12, 13]
+        guard = MeshGuard(ids, _fast_opts())
+        calls: list = []
+        grown = threading.Event()
+
+        def cb(active, reason):
+            calls.append((tuple(active), reason))
+            if reason == "grow":
+                grown.set()
+
+        try:
+            guard.on_rebuild(cb)
+            guard.device_failed(12)
+            # shrink fires with the survivors; the (healthy) device is
+            # then readmitted by the probe loop → grow restores all 4
+            assert grown.wait(10.0)
+            assert calls[0] == ((10, 11, 13), "shrink")
+            assert calls[-1] == ((10, 11, 12, 13), "grow")
+            assert guard.lost_ids() == []
+            st = guard.status()
+            assert st["rebuilds"]["shrink"] >= 1
+            assert st["rebuilds"]["grow"] >= 1
+        finally:
+            guard.close()
+
+    def test_min_devices_floor_degrades_to_host_join(self, table):
+        guard = MeshGuard([20, 21], _fast_opts(min_devices=2))
+        calls: list = []
+        shrunk = threading.Event()
+
+        def cb(active, reason):
+            calls.append((tuple(active), reason))
+            shrunk.set()
+
+        single = BatchDetector(table)
+        det = None
+        try:
+            guard.on_rebuild(cb)
+            # hold the domain down so readmission can't race the assert
+            FAILPOINTS.set(mesh_site(21), "error")
+            guard.device_failed(21)
+            assert shrunk.wait(10.0)
+            # 1 survivor < min_devices=2 → the rebuild degrades to the
+            # host join (empty device set), not a 1-device mesh
+            assert calls[0] == ((), "shrink")
+            assert METRICS.get("trivy_tpu_mesh_devices") == 0.0
+            # the host-only detector serves identical hits
+            det = MeshDetector(table, None, guard=guard)
+            qs = _queries()
+            assert det.detect(qs) == single.detect(qs)
+        finally:
+            guard.close()
+            if det is not None:
+                det.close()
+            single.close()
+
+
+class TestMeshguardAcceptance:
+    @pytest.fixture(autouse=True)
+    def _clean(self, _clean_guard):
+        yield
+
+    def test_hang_midload_c8_shrink_drain_grow(self, table, tmp_path):
+        """The ISSUE acceptance scenario: at c=8 mid-load, hang(100)
+        on one of 4 fake mesh devices trips only that device's domain;
+        the server swaps to a 3-device mesh through the swap_table
+        generation drain with ZERO failed requests and bit-identical
+        results; a successful probe grows back to 4."""
+        from trivy_tpu.server.listen import MeshOptions, ServerState
+
+        requests = _rand_requests(53, 32)
+        serial = BatchDetector(table)
+        expected = [serial.detect_many(b) for b in requests]
+        serial.close()
+
+        state = ServerState(
+            table, str(tmp_path),
+            detect_opts=SchedOptions(coalesce_wait_ms=3.0),
+            mesh_opts=MeshOptions(devices=4, db_shards=2,
+                                  min_devices=1,
+                                  rebuild_cooldown_ms=1.0,
+                                  probe_timeout_ms=20.0))
+        # fast maintenance cadence + readmission window for the test
+        state.mesh_guard.opts.probe_interval_ms = 10.0
+        state.mesh_guard.registry.reset_timeout_s = 0.05
+        victim = state.mesh_guard.all_ids[2]
+
+        results: list = [None] * len(requests)
+        errors: list = []
+        started = threading.Event()
+
+        def one_request(i):
+            # the handler protocol: a request runs under the scanner
+            # generation it started with (the swap drain contract)
+            gen = state.request_started()
+            try:
+                return state.scanner.sched.detect_many(requests[i])
+            finally:
+                state.request_finished(gen)
+
+        def worker(ids):
+            try:
+                for i in ids:
+                    results[i] = one_request(i)
+                    started.set()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(
+            target=worker, args=(range(k, len(requests), 8),))
+            for k in range(8)]
+        try:
+            for t in ts:
+                t.start()
+            # inject the hang MID-LOAD, after at least one request
+            assert started.wait(30.0)
+            FAILPOINTS.set(mesh_site(victim), "hang", 100.0)
+            for t in ts:
+                t.join()
+            # 1) zero failed requests, every result bit-identical —
+            # straddling scans drained on the old mesh, later ones
+            # landed on the shrunk one or the transient host fallback
+            assert not errors
+            assert results == expected
+            # 2) only the victim's domain tripped; the backend breaker
+            # (and with it the global host-fallback mode) stayed closed
+            assert GUARD.breaker.state_name() == "closed"
+
+            # 3) the shrink rebuild swapped in the 3-device survivor
+            # mesh via the generation drain
+            # the swap installs the new scanner early, but the rebuild
+            # only COUNTS once the callback (incl. the ≤2 s generation
+            # drain) returns — poll for both
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                det = state.scanner.detector
+                if isinstance(det, MeshDetector) and det.mesh \
+                        is not None and det.mesh.devices.size == 3 \
+                        and state.mesh_guard.status()["rebuilds"][
+                            "shrink"] >= 1:
+                    break
+                time.sleep(0.02)
+            det = state.scanner.detector
+            assert isinstance(det, MeshDetector)
+            assert det.mesh is not None and det.mesh.devices.size == 3
+            assert victim not in det.device_ids
+            assert state.mesh_guard.status()["rebuilds"]["shrink"] >= 1
+            assert METRICS.get("trivy_tpu_mesh_devices") == 3.0
+            # post-shrink traffic serves from the survivor mesh,
+            # still identical
+            assert one_request(0) == expected[0]
+
+            # 4) clear the fault: the readmission probe closes the
+            # domain and the grow rebuild restores the full mesh
+            FAILPOINTS.configure("")
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                det = state.scanner.detector
+                if isinstance(det, MeshDetector) and det.mesh \
+                        is not None and det.mesh.devices.size == 4 \
+                        and state.mesh_guard.status()["rebuilds"][
+                            "grow"] >= 1:
+                    break
+                time.sleep(0.02)
+            det = state.scanner.detector
+            assert det.mesh is not None and det.mesh.devices.size == 4
+            assert victim in det.device_ids
+            assert state.mesh_guard.lost_ids() == []
+            assert state.mesh_guard.status()["rebuilds"]["grow"] >= 1
+            assert METRICS.get("trivy_tpu_mesh_devices") == 4.0
+            assert one_request(1) == expected[1]
+        finally:
+            FAILPOINTS.configure("")
+            state.close()
+
+
+def test_mesh_healthz_and_metrics_exposed(table, tmp_path,
+                                          _clean_guard):
+    """/healthz carries the meshguard block (per-device breakers, lost
+    set, rebuild counters) and /metrics passes the strict exposition
+    gate with the mesh series."""
+    from trivy_tpu.server.listen import MeshOptions, serve_background
+
+    httpd, state = serve_background(
+        "127.0.0.1", 0, table, str(tmp_path),
+        mesh_opts=MeshOptions(devices=4, db_shards=2))
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        # materialize one per-device breaker series
+        state.mesh_guard.registry.get(state.mesh_guard.all_ids[0])
+        with urllib.request.urlopen(base + "/healthz") as r:
+            hz = json.loads(r.read())
+        mesh = hz["resilience"]["mesh"]
+        assert mesh["devices"] == 4 and mesh["active"] == 4
+        assert mesh["lost"] == []
+        assert mesh["rebuilds"] == {"shrink": 0, "grow": 0}
+        dev0 = str(state.mesh_guard.all_ids[0])
+        assert mesh["breakers"][dev0]["state"] == "closed"
+
+        with urllib.request.urlopen(base + "/metrics") as r:
+            fams = parse_exposition(r.read().decode())
+        devices = fams["trivy_tpu_mesh_devices"]
+        assert devices["type"] == "gauge"
+        assert devices["samples"][0][2] == 4.0
+        breaker = fams["trivy_tpu_mesh_breaker_state"]
+        assert breaker["type"] == "gauge"
+        assert any(labels.get("device") == dev0 and value == 0.0
+                   for _n, labels, value in breaker["samples"])
+    finally:
+        httpd.shutdown()
+        state.close()
+
+
+# ---- crash-safe persistent state (FSCache + flatten memo) ---------------
+
+class TestFSCacheCrashSafety:
+    def _blob(self):
+        from trivy_tpu.fanal.cache import blob_from_json
+        return blob_from_json({"SchemaVersion": 2,
+                               "OS": {"Family": "alpine",
+                                      "Name": "3.17"}})
+
+    def test_kill_between_temp_write_and_replace_is_a_miss(
+            self, tmp_path, monkeypatch):
+        """A crash after the temp write but before os.replace must
+        leave NO entry under the final name — the next read is a clean
+        miss, never a truncated-JSON parse error."""
+        import os as _os
+
+        from trivy_tpu.fanal.cache import FSCache
+        cache = FSCache(str(tmp_path))
+        real_replace = _os.replace
+        monkeypatch.setattr(
+            _os, "replace",
+            lambda *a: (_ for _ in ()).throw(RuntimeError("killed")))
+        with pytest.raises(RuntimeError):
+            cache.put_blob("sha256:b1", self._blob())
+        with pytest.raises(RuntimeError):
+            cache.put_artifact("sha256:a1", {"SchemaVersion": 2})
+        monkeypatch.setattr(_os, "replace", real_replace)
+        assert cache.get_blob("sha256:b1") is None
+        assert cache.get_artifact("sha256:a1") is None
+        _missing_artifact, missing = cache.missing_blobs(
+            "sha256:a1", ["sha256:b1"])
+        assert missing == ["sha256:b1"]   # the client re-uploads
+        # a clean retry lands normally
+        cache.put_blob("sha256:b1", self._blob())
+        got = cache.get_blob("sha256:b1")
+        assert got is not None and got.os.family == "alpine"
+
+    def test_corrupt_entry_is_quarantined_not_fatal(self, tmp_path):
+        """Pre-existing corruption (truncated JSON from a pre-fix
+        crash, disk damage) is quarantined to *.corrupt and served as
+        a miss — not a JSONDecodeError on every future scan."""
+        from trivy_tpu.fanal.cache import FSCache
+        cache = FSCache(str(tmp_path))
+        cache.put_blob("sha256:b1", self._blob())
+        p = cache._path("blob", "sha256:b1")
+        with open(p, "w") as f:
+            f.write('{"SchemaVersion": 2, "OS": {"Fam')   # truncated
+        assert cache.get_blob("sha256:b1") is None
+        assert not os.path.exists(p)
+        assert os.path.exists(p + ".corrupt")
+        assert cache.get_blob("sha256:b1") is None   # stays a miss
+        # artifacts quarantine the same way
+        cache.put_artifact("sha256:a1", {"ok": True})
+        pa = cache._path("artifact", "sha256:a1")
+        with open(pa, "w") as f:
+            f.write("not json at all")
+        assert cache.get_artifact("sha256:a1") is None
+        assert os.path.exists(pa + ".corrupt")
+
+
+class TestFlattenCrashSafety:
+    @pytest.fixture()
+    def fake_bolt(self, tmp_path, monkeypatch):
+        """A stand-in trivy.db: flatten_db hashes the file's bytes and
+        hands them to load_boltdb, which we point at the fixture
+        corpus — the memo/stamp machinery under test is identical."""
+        advisories, details, _src = load_fixture_files(FIXTURES)
+        bolt = tmp_path / "trivy.db"
+        bolt.write_bytes(b"fake-boltdb-content")
+        import trivy_tpu.db.boltdb as boltdb
+        monkeypatch.setattr(boltdb, "load_boltdb",
+                            lambda p: (advisories, details, {}))
+        return str(bolt)
+
+    def test_crash_mid_save_never_pairs_stamp_with_partial_npz(
+            self, fake_bolt, monkeypatch):
+        from trivy_tpu.db.download import flatten_db
+        from trivy_tpu.db.table import AdvisoryTable
+        real_save = AdvisoryTable.save
+
+        def crashing_save(self, path):
+            with open(path + ".tmp.npz", "wb") as f:
+                f.write(b"partial bytes")      # temp written ...
+            raise RuntimeError("killed mid-save")   # ... kill before replace
+
+        monkeypatch.setattr(AdvisoryTable, "save", crashing_save)
+        with pytest.raises(RuntimeError):
+            flatten_db(fake_bolt)
+        # neither a partial npz under the final name nor a stamp that
+        # would vouch for one
+        assert not os.path.exists(fake_bolt + ".npz")
+        assert not os.path.exists(fake_bolt + ".npz.src")
+        # the retry after restart flattens cleanly and the memo works
+        monkeypatch.setattr(AdvisoryTable, "save", real_save)
+        t1, stats1 = flatten_db(fake_bolt)
+        assert stats1["cached"] is False and len(t1) > 0
+        t2, stats2 = flatten_db(fake_bolt)
+        assert stats2["cached"] is True and len(t2) == len(t1)
+
+    def test_corrupt_npz_with_matching_stamp_reflattens(
+            self, fake_bolt):
+        from trivy_tpu.db.download import flatten_db
+        t1, _ = flatten_db(fake_bolt)
+        npz = fake_bolt + ".npz"
+        with open(npz, "wb") as f:
+            f.write(b"garbage, not a zip")
+        # the stamp still matches, but ensure_db must fall back to a
+        # re-flatten instead of crashing on the corrupt memo forever
+        t2, stats = flatten_db(fake_bolt)
+        assert stats["cached"] is False
+        assert len(t2) == len(t1)
+        assert os.path.exists(npz + ".corrupt")
+        # and the rebuilt memo is good again
+        _t3, stats3 = flatten_db(fake_bolt)
+        assert stats3["cached"] is True
+
+
+class TestMeshguardRebuildRobustness:
+    @pytest.fixture(autouse=True)
+    def _clean(self, _clean_guard):
+        yield
+
+    def test_failed_rebuild_callback_is_retried(self):
+        """A transient swap failure must re-schedule the rebuild (the
+        stale mesh would otherwise serve host-only forever) and must
+        NOT count in the rebuild metrics — a failed rebuild never
+        reports a healthy shrunk mesh."""
+        guard = MeshGuard([30, 31, 32, 33], _fast_opts())
+        calls: list = []
+        done = threading.Event()
+
+        def flaky_cb(active, reason):
+            calls.append((tuple(active), reason))
+            if len(calls) == 1:
+                raise RuntimeError("transient swap failure")
+            done.set()
+
+        try:
+            # hold the lost domain down so no grow interleaves
+            FAILPOINTS.set(mesh_site(32), "error")
+            guard.on_rebuild(flaky_cb)
+            guard.device_failed(32)
+            assert done.wait(10.0)
+            assert calls[0] == ((30, 31, 33), "shrink")   # failed try
+            assert calls[1] == ((30, 31, 33), "shrink")   # the retry
+            # only the SUCCESSFUL rebuild counted
+            assert guard.status()["rebuilds"]["shrink"] == 1
+        finally:
+            guard.close()
+
+    def test_swap_after_close_discards_new_scanner(self, table,
+                                                   tmp_path):
+        """swap_table racing close() must not install (and strand) a
+        never-closed scanner — the rebuild's swap aborts cleanly."""
+        from trivy_tpu.server.listen import MeshOptions, ServerState
+        state = ServerState(table, str(tmp_path),
+                            detect_opts=SchedOptions(),
+                            mesh_opts=MeshOptions(devices=2))
+        state.close()
+        before = state._scanner
+        gen_before = state._gen
+        state.swap_table(table)    # must abort, not install
+        assert state._scanner is before
+        assert state._gen == gen_before
+
+    def test_real_collective_failure_attributes_to_device(self, table):
+        """A collective launch failure (no mesh-site failpoint — the
+        backend-level detect.dispatch fault, standing in for a real
+        XLA error) must trigger attribution probes that expel exactly
+        the chip whose real probe op fails — the fault domains engage
+        for real faults, not just the chaos substrate."""
+        mesh = make_mesh(4, db_shards=2)
+        ids = [int(d.id) for d in mesh.devices.flat]
+        victim = ids[2]
+
+        def probe(dev_id):
+            if dev_id == victim:
+                raise RuntimeError("dead chip")
+
+        guard = MeshGuard(ids, _fast_opts(), probe=probe)
+        det = MeshDetector(table, mesh, guard=guard)
+        single = BatchDetector(table)
+        try:
+            qs = _queries()
+            want = single.detect(qs)
+            FAILPOINTS.set("detect.dispatch", "error")
+            # the faulted dispatch completes host-side, identical
+            assert det.detect(qs) == want
+            # ... and the maintenance thread's attribution probes
+            # expel exactly the dead chip
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline \
+                    and guard.lost_ids() != [victim]:
+                time.sleep(0.01)
+            assert guard.lost_ids() == [victim]
+            for other in ids:
+                if other != victim:
+                    assert guard.registry.get(other).state_name() \
+                        == "closed"
+        finally:
+            FAILPOINTS.configure("")
+            guard.close()
+            det.close()
+            single.close()
+
+    def test_wedged_probe_does_not_freeze_maintenance(self):
+        """A probe op that never returns (a truly hung chip) must be
+        abandoned on its disposable thread — pending rebuilds for
+        OTHER devices still execute and close() returns."""
+        hung = threading.Event()
+
+        def probe(dev_id):
+            if dev_id == 41:
+                hung.wait(30.0)   # "never" returns (within the test)
+
+        guard = MeshGuard([40, 41, 42, 43],
+                          _fast_opts(probe_timeout_ms=30.0),
+                          probe=probe)
+        calls: list = []
+        rebuilt = threading.Event()
+
+        def cb(active, reason):
+            calls.append((tuple(active), reason))
+            rebuilt.set()
+
+        try:
+            guard.on_rebuild(cb)
+            # collective failure: attribution probes all 4 devices;
+            # device 41's probe wedges and must be abandoned, the
+            # shrink for it must still fire
+            guard.request_attribution()
+            assert rebuilt.wait(10.0)
+            assert calls[0] == ((40, 42, 43), "shrink")
+            assert guard.lost_ids() == [41]
+        finally:
+            guard.close()
+            hung.set()

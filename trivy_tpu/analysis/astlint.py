@@ -51,7 +51,10 @@ _LOCK_SCOPE = (
     os.path.join("trivy_tpu", "obs") + os.sep,
     os.path.join("trivy_tpu", "detect", "engine.py"),
     os.path.join("trivy_tpu", "detect", "sched.py"),
-    os.path.join("trivy_tpu", "parallel", "multihost.py"),
+    # the whole parallel/ package: the ingest queue AND the meshguard
+    # rebuild/coordinator surface are shared across handler threads,
+    # the dispatcher, and the maintenance thread
+    os.path.join("trivy_tpu", "parallel") + os.sep,
     # graftguard: the failpoint registry and breaker are hit from
     # every handler thread plus the watchdog
     os.path.join("trivy_tpu", "resilience") + os.sep,
@@ -62,7 +65,7 @@ _LOCK_SCOPE = (
 class DeviceFn:
     node: ast.FunctionDef
     statics: set[str]
-    reason: str     # "jit" | "pallas" | "core-name"
+    reason: str     # "jit" | "pallas" | "core-name" | "shard_map"
 
 
 @dataclass
@@ -219,6 +222,18 @@ def scan_module(relpath: str, source: str) -> ModuleInfo | None:
             fn = defs.get(node.args[0].id)
             if fn is not None:
                 add(fn, set(), "pallas")
+
+    # shard_map bodies: the per-device local function is device code
+    # exactly like a jitted core — failpoint probes, breaker reads, and
+    # clocks in there run once at trace time (TPU107/TPU108 must see
+    # inside the mesh path's collective launches)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _dotted(node.func).split(".")[-1] == "shard_map" \
+                and node.args and isinstance(node.args[0], ast.Name):
+            fn = defs.get(node.args[0].id)
+            if fn is not None:
+                add(fn, set(), "shard_map")
 
     # naming convention: _*_core / _kernel*
     for name, fn in defs.items():

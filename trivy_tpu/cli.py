@@ -228,6 +228,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pre-compile the join's pair-bucket ladder at "
                         "boot so steady-state traffic never pays an "
                         "XLA compile mid-request")
+    p.add_argument("--mesh-devices", type=int, default=0,
+                   help="shard the detect join over a dp×db mesh of N "
+                        "devices with meshguard per-device fault "
+                        "domains (-1 = all devices; 0 = single-chip "
+                        "path, the default)")
+    p.add_argument("--mesh-db-shards", type=int, default=1,
+                   help="preferred advisory-table shard width on the "
+                        "mesh's db axis (a shrink rebuild re-fits it "
+                        "to the largest valid factorization of the "
+                        "survivor count)")
+    p.add_argument("--mesh-min-devices", type=int, default=1,
+                   help="meshguard: survivors below this degrade to "
+                        "the NumPy host join instead of flapping "
+                        "through ever-smaller meshes (default 1)")
+    p.add_argument("--mesh-rebuild-cooldown-ms", type=float,
+                   default=1000.0,
+                   help="meshguard: minimum window between mesh "
+                        "rebuilds (shrink or grow) — bounds rebuild "
+                        "flapping under correlated faults "
+                        "(default 1000)")
+    p.add_argument("--mesh-probe-timeout-ms", type=float,
+                   default=5000.0,
+                   help="meshguard: per-device watchdog deadline for "
+                        "domain probes and readmission probes; expiry "
+                        "trips only that device's breaker "
+                        "(default 5000)")
 
     p = sub.add_parser("k8s", aliases=["kubernetes"],
                        help="scan a kubernetes cluster")
@@ -896,11 +922,22 @@ def cmd_server(args) -> int:
         max_pairs_in_flight=getattr(args, "detect_max_inflight_pairs",
                                     1 << 22),
         warmup=getattr(args, "detect_warmup", False))
+    # meshguard: shard detection over a device mesh with per-device
+    # fault domains (shrink on loss, grow on readmission)
+    from .server.listen import MeshOptions
+    mesh_opts = MeshOptions(
+        devices=getattr(args, "mesh_devices", 0),
+        db_shards=getattr(args, "mesh_db_shards", 1),
+        min_devices=getattr(args, "mesh_min_devices", 1),
+        rebuild_cooldown_ms=getattr(args, "mesh_rebuild_cooldown_ms",
+                                    1000.0),
+        probe_timeout_ms=getattr(args, "mesh_probe_timeout_ms",
+                                 5000.0))
     serve(host or "0.0.0.0", int(port), table, cache_dir=args.cache_dir,
           token=args.token,
           cache_backend=getattr(args, "cache_backend", "fs"),
           trace_path=getattr(args, "trace", ""),
-          detect_opts=opts, admission=admission)
+          detect_opts=opts, admission=admission, mesh_opts=mesh_opts)
     return 0
 
 

@@ -155,10 +155,27 @@ def flatten_db(bolt_path: str, npz_path: Optional[str] = None,
     stamp_path = npz_path + ".src"
     if os.path.exists(npz_path) and os.path.exists(stamp_path):
         with open(stamp_path) as f:
-            if f.read().strip() == digest:
-                from .table import AdvisoryTable
-                t0 = time.time()
+            stamp_ok = f.read().strip() == digest
+        if stamp_ok:
+            from .table import AdvisoryTable
+            t0 = time.time()
+            try:
                 table = AdvisoryTable.load(npz_path)
+            except Exception:
+                # a corrupt/truncated memo (pre-atomic-save residue,
+                # disk damage) must degrade to a re-flatten, not crash
+                # every future ensure_db; quarantine it for forensics
+                from ..log import get as _get_logger
+                quarantine = npz_path + ".corrupt"
+                try:
+                    os.replace(npz_path, quarantine)
+                except OSError:
+                    pass
+                _get_logger("db").warning(
+                    "corrupt flatten memo %s (quarantined to %s); "
+                    "re-flattening %s", npz_path, quarantine,
+                    bolt_path, exc_info=True)
+            else:
                 return table, {"flatten_s": 0.0,
                                "load_s": round(time.time() - t0, 2),
                                "rows": len(table), "cached": True}
@@ -169,9 +186,14 @@ def flatten_db(bolt_path: str, npz_path: Optional[str] = None,
                         aux={"Red Hat CPE": sources["Red Hat CPE"]}
                         if "Red Hat CPE" in sources else None)
     t2 = time.time()
+    # table.save is write-temp + os.replace, and the stamp lands (also
+    # atomically) only AFTER the replace succeeded — a crash anywhere
+    # in between can never pair a partial .npz with a matching stamp
     table.save(npz_path)
-    with open(stamp_path, "w") as f:
+    tmp_stamp = stamp_path + ".tmp"
+    with open(tmp_stamp, "w") as f:
         f.write(digest)
+    os.replace(tmp_stamp, stamp_path)
     stats = {
         "walk_s": round(t1 - t0, 2),
         "build_s": round(t2 - t1, 2),

@@ -132,8 +132,36 @@ class AdvisoryTable:
         return self._device
 
     def save(self, path: str):
+        # write-temp + os.replace: a crash mid-save must never leave a
+        # truncated .npz under the final name (flatten_db pairs the
+        # memo with a content-hash stamp written only after this
+        # replace succeeds). The temp name is UNIQUE per writer
+        # (mkstemp): two processes flattening into a shared cache dir
+        # must never interleave into one temp file and publish garbage
+        # under a matching stamp. np.savez writes to the open file
+        # object, so its append-.npz filename rule never applies.
+        import os
+        import tempfile
+        dest = path if path.endswith(".npz") else path + ".npz"
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(dest) or ".",
+            prefix=os.path.basename(dest) + ".tmp.")
+        f = os.fdopen(fd, "wb")
+        try:
+            self._savez(f)
+        except BaseException:
+            f.close()
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass   # a crash leaves a stray tmp, never a bad memo
+            raise
+        f.close()
+        os.replace(tmp, dest)
+
+    def _savez(self, f) -> None:
         np.savez_compressed(
-            path,
+            f,
             hash=self.hash, lo_tok=self.lo_tok, hi_tok=self.hi_tok,
             flags=self.flags, group=self.group,
             meta=np.frombuffer(json.dumps({

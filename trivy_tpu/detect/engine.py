@@ -446,6 +446,17 @@ class BatchDetector:
                          exc_info=True)
             return self._host_bits(prep)
 
+    def _host_bits_merged(self, preps: list, offsets: list,
+                          t_pad: int) -> np.ndarray:
+        """Rebuild a merged dispatch's bit vector from each prep's
+        host join (shared by the single-chip fetch fallback and the
+        mesh launch fallback — the offset math must match
+        _merge_descriptors in exactly one place)."""
+        bits = np.zeros(t_pad, np.int8)
+        for p, off in zip(preps, offsets):
+            bits[off:off + p.n_pairs] = self._host_bits(p)[:p.n_pairs]
+        return bits
+
     def fetch_merged(self, dev, preps: list, offsets: list,
                      t_pad: int) -> np.ndarray:
         """Fetch a merged (coalesced) dispatch's bits; on a supervised
@@ -457,11 +468,7 @@ class BatchDetector:
             _log.warning("merged device fetch failed; rebuilding %d "
                          "request slices on the host", len(preps),
                          exc_info=True)
-            bits = np.zeros(t_pad, np.int8)
-            for p, off in zip(preps, offsets):
-                bits[off:off + p.n_pairs] = \
-                    self._host_bits(p)[:p.n_pairs]
-            return bits
+            return self._host_bits_merged(preps, offsets, t_pad)
 
     def _dispatch_impl(self, prep: _Prepared):
         """Launch the pair join; returns the device array (async).
@@ -489,6 +496,20 @@ class BatchDetector:
         dispatch by construction — the predicate is elementwise.
 
         Returns (device bits, per-prep bit offsets, t_pad)."""
+        q_start, q_count, q_ver, offsets, total, t_pad, u_pad = \
+            self._merge_descriptors(preps)
+        with span("detect.dispatch", n_pairs=total, t_pad=t_pad,
+                  merged=len(preps)):
+            out = self._launch(q_start, q_count, q_ver, total, t_pad,
+                               u_pad)
+        note_dispatch()
+        return out, offsets, t_pad
+
+    def _merge_descriptors(self, preps: list[_Prepared]):
+        """Concatenate several preps' real CSR prefixes into one
+        descriptor set (shared by dispatch_merged and the mesh
+        detector's merged dispatch). → (q_start, q_count, q_ver,
+        offsets, total, t_pad, u_pad)."""
         total = sum(p.n_pairs for p in preps)
         q_n = sum(p.n_queries for p in preps)
         t_pad = bucket_size(total, self.pair_floor, self.pair_growth)
@@ -510,12 +531,7 @@ class BatchDetector:
         # snapshots and the current count covers every pair_ver row
         u_pad = max(_next_pow2(self._ver_count),
                     max(p.u_pad for p in preps))
-        with span("detect.dispatch", n_pairs=total, t_pad=t_pad,
-                  merged=len(preps)):
-            out = self._launch(q_start, q_count, q_ver, total, t_pad,
-                               u_pad)
-        note_dispatch()
-        return out, offsets, t_pad
+        return q_start, q_count, q_ver, offsets, total, t_pad, u_pad
 
     def warmup(self, max_pairs: int = 1 << 18) -> int:
         """Pre-compile the join across the pair-bucket ladder (server

@@ -107,7 +107,15 @@ class _Request:
 class DispatchScheduler:
     """detectd: merges concurrent requests' prepared batches into
     shared device dispatches. One instance per LocalScanner (the server
-    shares that scanner across handler threads)."""
+    shares that scanner across handler threads).
+
+    `detector` is a BatchDetector OR anything exposing its dispatch
+    surface (`table`/`_prepare`/`dispatch_merged`/`fetch_merged`/
+    `_get_pool`/`_assemble`) — the mesh path plugs a
+    parallel.MeshDetector in here, so coalesced dispatches route over
+    the (possibly shrunk) device mesh unchanged and a meshguard swap
+    only ever replaces the detector behind the scheduler's back via
+    the generation drain, never the scheduler protocol."""
 
     def __init__(self, detector: BatchDetector,
                  opts: SchedOptions | None = None):

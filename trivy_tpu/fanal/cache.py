@@ -57,6 +57,13 @@ class FSCache(MemoryCache):
     """JSON-file-per-key store under <root>/fanal/ (the reference keeps a
     bbolt file with artifact/blob buckets, cache/fs.go:22-40).
 
+    Crash safety (the bbolt-transaction property cache/fs.go gets for
+    free): writes land on a temp path and `os.replace` in — a kill
+    mid-put leaves a stray `.tmp`, never a truncated entry — and reads
+    that hit a corrupt/truncated entry anyway (pre-fix residue, disk
+    damage) QUARANTINE it (rename to `*.corrupt`, log, miss) instead
+    of raising JSONDecodeError on every future scan of that key.
+
     Every IO method fires the graftguard `cache.backend` failpoint —
     the chaos suite's stand-in for a full disk, a yanked volume, or
     (for the Redis/S3 backends sharing this surface) a dead remote."""
@@ -76,6 +83,49 @@ class FSCache(MemoryCache):
         from ..resilience import failpoint
         failpoint("cache.backend")
 
+    def _write_atomic(self, path: str, payload: dict) -> None:
+        # same pattern as db/download.py's trivy.db write — the entry
+        # appears under its final name only after a complete write —
+        # but with a UNIQUE temp name per writer: two handler threads
+        # putting the same key concurrently must never interleave into
+        # one temp file and publish a truncated entry
+        import tempfile
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path),
+            prefix=os.path.basename(path) + ".tmp.")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass   # a crash leaves a stray tmp, never a bad entry
+            raise
+
+    def _read_json(self, path: str):
+        """→ decoded JSON, or None (miss) after quarantining a
+        corrupt/truncated entry."""
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None   # plain miss (or a racing reader quarantined)
+        except OSError:
+            return None   # unreadable entry: serve a miss, keep scanning
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError):
+            from ..log import get as _get_logger
+            quarantine = path + ".corrupt"
+            try:
+                os.replace(path, quarantine)
+            except OSError:
+                pass   # racing reader already moved it; still a miss
+            _get_logger("fanal.cache").warning(
+                "quarantined corrupt cache entry %s → %s "
+                "(serving a miss)", path, quarantine)
+            return None
+
     def missing_blobs(self, artifact_id, blob_ids):
         self._failpoint()
         missing = [b for b in blob_ids
@@ -84,29 +134,20 @@ class FSCache(MemoryCache):
 
     def put_artifact(self, artifact_id, info):
         self._failpoint()
-        with open(self._path("artifact", artifact_id), "w") as f:
-            json.dump(info, f)
+        self._write_atomic(self._path("artifact", artifact_id), info)
 
     def put_blob(self, blob_id, blob):
         self._failpoint()
-        with open(self._path("blob", blob_id), "w") as f:
-            json.dump(blob.to_json(), f)
+        self._write_atomic(self._path("blob", blob_id), blob.to_json())
 
     def get_artifact(self, artifact_id):
         self._failpoint()
-        p = self._path("artifact", artifact_id)
-        if not os.path.exists(p):
-            return None
-        with open(p) as f:
-            return json.load(f)
+        return self._read_json(self._path("artifact", artifact_id))
 
     def get_blob(self, blob_id):
         self._failpoint()
-        p = self._path("blob", blob_id)
-        if not os.path.exists(p):
-            return None
-        with open(p) as f:
-            return blob_from_json(json.load(f))
+        j = self._read_json(self._path("blob", blob_id))
+        return blob_from_json(j) if j is not None else None
 
     def clear(self):
         import shutil

@@ -54,6 +54,12 @@ CONFIG_PATHS = {
     "admit_max_queue": "resilience.admit-max-queue",
     "admit_queue_ms": "resilience.admit-queue-ms",
     "failpoint": "resilience.failpoints",
+    # meshguard (mesh.*): device mesh + per-device fault domains
+    "mesh_devices": "mesh.devices",
+    "mesh_db_shards": "mesh.db-shards",
+    "mesh_min_devices": "mesh.min-devices",
+    "mesh_rebuild_cooldown_ms": "mesh.rebuild-cooldown-ms",
+    "mesh_probe_timeout_ms": "mesh.probe-timeout-ms",
 }
 
 _TRUE = {"1", "t", "true", "yes", "on"}

@@ -273,6 +273,23 @@ METRICS.declare(
 METRICS.declare(
     "trivy_tpu_admission_queue_depth", "gauge",
     "Scan RPCs currently waiting in the admission queue.")
+METRICS.declare(
+    "trivy_tpu_mesh_devices", "gauge",
+    "Devices in the active detect mesh (0 = mesh degraded to the "
+    "host join; single-chip deployments never set this series).")
+METRICS.declare(
+    "trivy_tpu_mesh_breaker_state", "gauge",
+    "meshguard per-device fault domain: 0 closed, 1 open, 2 half-open "
+    "(one series per mesh device id).")
+METRICS.declare(
+    "trivy_tpu_mesh_rebuilds_total", "counter",
+    "Mesh rebuilds through the swap_table generation drain "
+    "(reason=\"shrink\" on device loss, reason=\"grow\" on "
+    "readmission).")
+METRICS.declare(
+    "trivy_tpu_mesh_device_lost_total", "counter",
+    "Mesh devices expelled from their fault domain (watchdog trip or "
+    "breaker threshold).")
 METRICS.declare("trivy_tpu_secret_files_total", "counter",
                 "Files through the secret scanner.")
 METRICS.declare("trivy_tpu_secret_bytes_total", "counter",

@@ -5,5 +5,6 @@ reference's goroutine fan-out (pkg/parallel/pipeline.go) per SURVEY.md
 over `dp` as the sequence axis."""
 
 from .mesh import (MeshDetector, QueryPartition,  # noqa: F401
-                   ShardedTable, make_mesh, partition_queries,
-                   shard_table, sharded_csr_join)
+                   ShardedTable, best_db_shards, make_mesh,
+                   mesh_from_devices, partition_queries, shard_table,
+                   sharded_csr_join)

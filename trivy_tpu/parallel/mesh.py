@@ -59,6 +59,34 @@ def make_mesh(n_devices: int | None = None, db_shards: int = 1,
     return Mesh(dev_array, axis_names=("dp", "db"))
 
 
+def best_db_shards(n_devices: int, db_pref: int) -> int:
+    """Largest valid db width for an n-device mesh: the biggest
+    divisor of n_devices that is ≤ the preferred shard count. The
+    meshguard shrink path uses this to keep ALL survivors in the mesh
+    (dp×db must tile them exactly) while staying as close to the
+    configured db sharding as the survivor count allows — e.g. losing
+    one device of a 4×(db=2) mesh re-meshes the 3 survivors as
+    dp3×db1."""
+    if n_devices <= 0:
+        raise ValueError("best_db_shards: no devices")
+    for db in range(min(max(db_pref, 1), n_devices), 0, -1):
+        if n_devices % db == 0:
+            return db
+    return 1
+
+
+def mesh_from_devices(devices, db_shards: int = 1) -> Mesh:
+    """dp×db mesh over exactly these devices, with the largest valid
+    factorization for the preferred db width (meshguard shrink/grow
+    rebuilds hand this the survivor list)."""
+    n = len(devices)
+    if n == 0:
+        raise ValueError("mesh_from_devices: no devices")
+    db = best_db_shards(n, db_shards)
+    dev_array = np.asarray(list(devices)).reshape(n // db, db)
+    return Mesh(dev_array, axis_names=("dp", "db"))
+
+
 @dataclass
 class ShardedTable:
     """Advisory arrays with a leading shard axis [S, A_pad, ...]."""
@@ -137,14 +165,44 @@ def sharded_prefix_scan(mesh: Mesh, kw_word4, kw_mask4,
 
 class MeshDetector:
     """BatchDetector whose device step runs sharded over a mesh — the
-    server-side scale-out path (SURVEY.md §2.7 P4)."""
+    server-side scale-out path (SURVEY.md §2.7 P4).
 
-    def __init__(self, table: AdvisoryTable, mesh: Mesh,
-                 db_shards: int | None = None):
+    Exposes the scheduler surface (`_prepare`/`dispatch_merged`/
+    `fetch_merged`/`_assemble`/`_get_pool`/`detect_many`) so detectd
+    (detect/sched.py) routes coalesced dispatches through the mesh
+    unchanged, and the server's swap_table generation drain can swap
+    a shrunk/grown MeshDetector exactly like a single-chip one.
+
+    meshguard (per-device fault domains): pass `guard` (a
+    resilience.MeshGuard over this mesh's device ids) and every
+    dispatch probes each active device's `detect.mesh:<id>` site under
+    that device's own watchdog/breaker. A faulted domain serves THIS
+    dispatch from the bit-identical host join and schedules a shrink
+    rebuild; the mesh keeps serving from the survivors once the owner
+    swaps it. `mesh=None` is the zero-survivor degraded mode: every
+    dispatch is the host join until a readmission grows the mesh back.
+    """
+
+    def __init__(self, table: AdvisoryTable, mesh: Mesh | None,
+                 db_shards: int | None = None, guard=None):
         from ..detect.engine import BatchDetector
         self.mesh = mesh
+        self.table = table
+        self.guard = guard
+        self._inner = BatchDetector(table)
+        if mesh is None:
+            # host-only degraded mode (meshguard: survivors below
+            # --mesh-min-devices): no shard, no upload, no device ids
+            self.dp = 0
+            self.st = None
+            self._st_dev = None
+            self.device_ids = []
+            return
         self.dp = mesh.devices.shape[0]
         db = db_shards if db_shards is not None else mesh.devices.shape[1]
+        # re-shard the advisory table for THIS mesh's db width — the
+        # meshguard rebuild path gets table re-sharding for free by
+        # constructing a fresh detector over the survivor mesh
         self.st = shard_table(table, db)
         # upload the sharded table once; every detect() reuses the
         # device copies (table.device_arrays() analog for the mesh path)
@@ -153,29 +211,101 @@ class MeshDetector:
             hi_tok=jax.device_put(self.st.hi_tok),
             flags=jax.device_put(self.st.flags),
             row_offset=self.st.row_offset, row_len=self.st.row_len)
-        self._inner = BatchDetector(table)
+        self.device_ids = [int(d.id) for d in mesh.devices.flat]
 
     def close(self) -> None:
         """Join the inner engine's worker threads (idempotent)."""
         self._inner.close()
 
-    def detect(self, queries) -> list:
+    # ---- scheduler surface (detectd routes through these) --------------
+
+    @property
+    def _get_pool(self):
+        return self._inner._get_pool
+
+    def _prepare(self, queries):
+        return self._inner._prepare(queries)
+
+    def _assemble(self, prep, bits):
+        return self._inner._assemble(prep, bits)
+
+    def fetch_merged(self, dev, preps, offsets, t_pad):
+        # mesh joins are synchronous: `dev` is already host bits and
+        # passes straight through the inner fetch
+        return self._inner.fetch_merged(dev, preps, offsets, t_pad)
+
+    def warmup(self, max_pairs: int = 1 << 18) -> int:
+        """No-op: mesh dispatch shapes depend on the per-cell pair
+        partition, which the host-side LPT balancing decides per batch
+        — there is no fixed ladder to pre-compile."""
+        return 0
+
+    def dispatch_merged(self, preps):
+        """ONE mesh dispatch covering several prepared batches (the
+        detectd coalescing primitive, mesh edition). Concatenated CSR
+        descriptors partition and join exactly like one bigger batch,
+        so each prep's slice is bit-identical to its solo dispatch.
+        Returns (bits, per-prep offsets, t_pad) — bits are host-side
+        already (sharded_csr_join fetches synchronously)."""
+        from ..obs import note_dispatch, span
+        inner = self._inner
+        q_start, q_count, q_ver, offsets, total, t_pad, u_pad = \
+            inner._merge_descriptors(preps)
+
+        def host_fallback():
+            return inner._host_bits_merged(preps, offsets, t_pad)
+
+        with span("detect.dispatch", n_pairs=total, t_pad=t_pad,
+                  merged=len(preps)):
+            bits = self._launch_mesh(q_start, q_count, q_ver, total,
+                                     t_pad, u_pad, host_fallback)
+        note_dispatch()
+        return bits, offsets, t_pad
+
+    # ---- supervised mesh launch ----------------------------------------
+
+    def _launch_mesh(self, q_start, q_count, q_ver, total: int,
+                     t_pad: int, u_pad: int, host_fallback):
+        """Partition the descriptors over the mesh and run the sharded
+        join under graftguard + meshguard supervision. → int8[t_pad]
+        host bits (identical whichever path served them).
+
+        Fault-domain order: (1) host-only/zero-survivor mode, the open
+        backend breaker, and a mesh that still contains a lost device
+        (the pre-swap drain window) all serve from the host join
+        without touching a device; (2) per-device domain probes run
+        OUTSIDE the backend watch, so a wedged device trips only its
+        own breaker; (3) the collective launch runs under the backend
+        `detect.dispatch` watch — a whole-launch failure names no
+        single chip."""
+        from ..log import get as _get_logger
         from ..resilience import GUARD, DeviceError, failpoint
         inner = self._inner
-        if len(inner.table) == 0 or not queries:
-            return []
-        prep = inner._prepare(queries)
-        if prep is None or prep.n_pairs == 0:
-            return []
-        # graftguard: an open breaker skips the mesh entirely — the
-        # prep's host-side pair expansion feeds the NumPy reference
-        # join, bit-identical to the sharded path
+        if self.mesh is None or \
+                (self.guard is not None
+                 and self.guard.any_lost(self.device_ids)):
+            return host_fallback()
+        # domain probes BEFORE consulting the backend breaker: a
+        # MeshDomainError exit charges only the device's own breaker
+        # and must never happen between allow_device() admitting the
+        # backend's half-open probe and the watch that resolves it —
+        # an unresolved admitted probe wedges the breaker half-open
+        # forever (the PR 4 dead-backend lesson)
+        if self.guard is not None:
+            try:
+                self.guard.check(self.device_ids)
+            except DeviceError:
+                _get_logger("mesh").warning(
+                    "mesh domain probe failed; host-fallback join",
+                    exc_info=True)
+                return host_fallback()
+        part = partition_queries(self.st, q_start, q_count, q_ver,
+                                 self.dp)
+        # allow_device() LAST, immediately before the watch: when it
+        # admits the half-open probe, the watch's exit is guaranteed
+        # to record the probe's outcome (success, error, or timeout)
         if not GUARD.allow_device():
-            return inner._assemble(prep, inner._host_bits(prep))
-        # CSR descriptors ship (O(queries) transfer); each device
-        # expands its own pair list, like the single-chip path
-        part = partition_queries(self.st, prep.q_start, prep.q_count,
-                                 prep.q_ver, self.dp)
+            return host_fallback()
         try:
             # version-pool upload inside the watch: a dead backend
             # fails right there, and the probe outcome must be
@@ -188,7 +318,7 @@ class MeshDetector:
                 # the inner detector's cached device pool (re-shipped
                 # only on growth) doubles as the replicated mesh
                 # operand
-                ver_dev = inner._ver_device(prep.u_pad)
+                ver_dev = inner._ver_device(u_pad)
                 # per-dispatch accounting (occupancy vs the mesh's
                 # total padded cell capacity, batch/compile counters)
                 # — the mesh path launches its own join and would
@@ -201,15 +331,63 @@ class MeshDetector:
                                   int(part.q_start.shape[-1]),
                                   int(ver_dev.shape[0]))
                 bits = sharded_csr_join(self.mesh, self._st_dev,
-                                        ver_dev, part, prep.n_pairs)
-                inner._account_traffic(prep.n_pairs, t_total)
+                                        ver_dev, part, total)
+                inner._account_traffic(total, t_total)
         except DeviceError:
-            from ..log import get as _get_logger
             _get_logger("mesh").warning(
                 "sharded join failed; host-fallback join",
                 exc_info=True)
-            bits = inner._host_bits(prep)
-        return inner._assemble(prep, bits)
+            # a COLLECTIVE failure names no chip — ask the coordinator
+            # to run per-device attribution probes off the hot path,
+            # so a real (non-injected) dead device still gets expelled
+            # and the mesh shrinks instead of riding the backend
+            # breaker into full host fallback. (Domain-probe faults
+            # attributed themselves in the check() handler above.)
+            if self.guard is not None:
+                self.guard.request_attribution()
+            return host_fallback()
+        out = np.zeros(t_pad, np.int8)
+        out[:total] = bits
+        return out
+
+    def _bits(self, prep) -> np.ndarray:
+        inner = self._inner
+        return self._launch_mesh(
+            prep.q_start, prep.q_count, prep.q_ver, prep.n_pairs,
+            int(prep.pair_row.shape[0]), prep.u_pad,
+            lambda: inner._host_bits(prep))
+
+    # ---- direct detection ----------------------------------------------
+
+    def detect_many(self, batches) -> list:
+        """Per-batch prep → sharded join → assemble. The mesh join is
+        synchronous (its result gather IS the fetch), so there is no
+        async window to pipeline — the server gets its overlap from
+        detectd coalescing on top of this surface instead."""
+        from ..metrics import METRICS
+        inner = self._inner
+        out = []
+        n_queries = n_pairs = n_hits = 0
+        for qs in batches:
+            if not qs or len(inner.table) == 0:
+                out.append([])
+                continue
+            n_queries += len(qs)
+            prep = inner._prepare(qs)
+            if prep is None or prep.n_pairs == 0:
+                out.append([])
+                continue
+            n_pairs += prep.n_pairs
+            hits = inner._assemble(prep, self._bits(prep))
+            n_hits += len(hits)
+            out.append(hits)
+        METRICS.inc("trivy_tpu_detect_queries_total", n_queries)
+        METRICS.inc("trivy_tpu_detect_pairs_total", n_pairs)
+        METRICS.inc("trivy_tpu_detect_hits_total", n_hits)
+        return out
+
+    def detect(self, queries) -> list:
+        return self.detect_many([queries])[0]
 
 
 # ---- CSR query partitioning (transfer O(queries), like the

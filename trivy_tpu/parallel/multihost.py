@@ -69,12 +69,14 @@ def process_info() -> tuple[int, int]:
 
 def global_mesh(db_shards: int = 1):
     """dp×db mesh over every device of every host in the job (falls
-    back to the local devices when not distributed)."""
+    back to the local devices when not distributed). The db width is
+    fitted to the largest valid factorization of the job's device
+    count (meshguard's survivor-mesh rule) — a 12-process job asking
+    for db=8 gets db=6, not a startup crash."""
     import jax
 
-    from .mesh import make_mesh
-    return make_mesh(len(jax.devices()), db_shards=db_shards,
-                     devices=jax.devices())
+    from .mesh import mesh_from_devices
+    return mesh_from_devices(jax.devices(), db_shards=db_shards)
 
 
 class IngestQueue:

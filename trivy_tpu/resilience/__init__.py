@@ -16,7 +16,12 @@ tolerance (graftguard)"):
   admission   bounded deadline-aware scan queue: 429+Retry-After on
               overflow, 503 while the open-breaker fallback is
               saturated — plus RetryPolicy, the shared full-jitter
-              budget-capped client retry policy.
+              budget-capped client retry policy;
+  meshguard   per-device fault domains for the mesh detect path: a
+              breaker registry keyed by device id, a rebuild
+              coordinator that shrinks the mesh to the survivors on
+              device loss (and grows it back on readmission) instead
+              of dropping the whole backend to the host fallback.
 """
 
 from .admission import AdmissionOptions, AdmissionQueue, Shed
@@ -24,11 +29,15 @@ from .breaker import (CircuitBreaker, Deadline, DeviceError,
                       DeviceGuard, DeviceTimeout, GUARD)
 from .failpoints import (FAILPOINTS, FailpointError, FailpointRegistry,
                          SITES, failpoint)
+from .meshguard import (BreakerRegistry, MeshDomainError, MeshGuard,
+                        MeshGuardOptions, mesh_site)
 from .retry import RetryPolicy, retry_on
 
 __all__ = [
-    "AdmissionOptions", "AdmissionQueue", "CircuitBreaker", "Deadline",
-    "DeviceError", "DeviceGuard", "DeviceTimeout", "FAILPOINTS",
-    "FailpointError", "FailpointRegistry", "GUARD", "RetryPolicy",
-    "SITES", "Shed", "failpoint", "retry_on",
+    "AdmissionOptions", "AdmissionQueue", "BreakerRegistry",
+    "CircuitBreaker", "Deadline", "DeviceError", "DeviceGuard",
+    "DeviceTimeout", "FAILPOINTS", "FailpointError",
+    "FailpointRegistry", "GUARD", "MeshDomainError", "MeshGuard",
+    "MeshGuardOptions", "RetryPolicy", "SITES", "Shed", "failpoint",
+    "mesh_site", "retry_on",
 ]

@@ -82,16 +82,20 @@ class CircuitBreaker:
 
     def __init__(self, fail_threshold: int = 3,
                  reset_timeout_s: float = 5.0, clock=time.monotonic,
-                 name: str = "detect", gauge: str | None = None):
+                 name: str = "detect", gauge: str | None = None,
+                 gauge_labels: dict | None = None):
         self._lock = threading.Lock()
         self._clock = clock
         self.name = name
         self.fail_threshold = fail_threshold
         self.reset_timeout_s = reset_timeout_s
         # the exported state gauge is opt-in: only the process-wide
-        # GUARD breaker owns the metric — instantiable breakers (tests,
-        # future per-backend breakers) must not fight over one series
+        # GUARD breaker and the meshguard per-device registry own
+        # metric series — other instantiable breakers (tests) must not
+        # fight over one series. gauge_labels distinguishes the
+        # per-device series (device="<id>").
         self.gauge = gauge
+        self._gauge_labels = dict(gauge_labels or {})
         self._state = CLOSED
         self._failures = 0
         self._opened_at = 0.0
@@ -99,7 +103,7 @@ class CircuitBreaker:
         self._opens_total = 0
         self._listeners: list = []   # called on half-open → closed
         if gauge:
-            METRICS.set_gauge(gauge, 0.0)
+            METRICS.set_gauge(gauge, 0.0, **self._gauge_labels)
 
     # ---- state ---------------------------------------------------------
 
@@ -112,7 +116,8 @@ class CircuitBreaker:
             self._opened_at = self._clock()
             self._opens_total += 1
         if self.gauge:
-            METRICS.set_gauge(self.gauge, float(state))
+            METRICS.set_gauge(self.gauge, float(state),
+                              **self._gauge_labels)
 
     @property
     def state(self) -> int:
@@ -221,12 +226,18 @@ class CircuitBreaker:
 
 
 class _WatchToken:
-    __slots__ = ("site", "deadline", "expired")
+    __slots__ = ("site", "deadline", "expired", "breaker")
 
-    def __init__(self, site: str, deadline: Deadline):
+    def __init__(self, site: str, deadline: Deadline,
+                 breaker: CircuitBreaker):
         self.site = site
         self.deadline = deadline
         self.expired = False
+        # the breaker this watch charges: GUARD.breaker for backend-
+        # level sites, a meshguard per-device breaker for the
+        # detect.mesh:<id> site family — expiry must trip the DEVICE's
+        # domain, not the whole backend
+        self.breaker = breaker
 
 
 class _Watch:
@@ -252,7 +263,7 @@ class _Watch:
                 # fallback swallow a Ctrl-C), and they say nothing
                 # about device health — no breaker accounting
                 return False
-            self._guard.breaker.record_failure()
+            self._tok.breaker.record_failure()
             raise DeviceError(
                 f"{self._tok.site}: {type(exc).__name__}: {exc}") \
                 from exc
@@ -262,7 +273,7 @@ class _Watch:
             raise DeviceTimeout(
                 f"{self._tok.site}: exceeded watchdog deadline")
         if self._record_success:
-            self._guard.breaker.record_success()
+            self._tok.breaker.record_success()
         return False
 
 
@@ -311,7 +322,8 @@ class DeviceGuard:
         self.breaker.record_failure()
 
     def watch(self, site: str, timeout_s: float | None = None,
-              record_success: bool = True) -> _Watch:
+              record_success: bool = True,
+              breaker: CircuitBreaker | None = None) -> _Watch:
         """Supervise one device call: arms a watchdog deadline; exit
         converts exceptions to DeviceError (counting a breaker
         failure), expiry to DeviceTimeout, and clean returns to a
@@ -324,10 +336,16 @@ class DeviceGuard:
         otherwise a half-open probe against a device that accepts
         dispatches but wedges at execution would 'succeed', close the
         breaker, and fire the expensive recovery rebuild every reset
-        window. Failures and watchdog expiries are always recorded."""
+        window. Failures and watchdog expiries are always recorded.
+
+        Pass `breaker` to charge a breaker other than the process-wide
+        backend one — meshguard's per-device fault domains supervise
+        each `detect.mesh:<id>` site against that device's own breaker,
+        so one wedged chip never opens the backend breaker."""
         tok = _WatchToken(
             site, Deadline(timeout_s if timeout_s is not None
-                           else self.dispatch_timeout_s))
+                           else self.dispatch_timeout_s),
+            breaker if breaker is not None else self.breaker)
         with self._cv:
             self._tokens.append(tok)
             # wake the watchdog only when this deadline lands before
@@ -361,7 +379,10 @@ class DeviceGuard:
                 METRICS.inc("trivy_tpu_device_watchdog_trips_total")
                 _log.warning("watchdog: %s outlived its deadline; "
                              "tripping breaker", t.site)
-                self.breaker.trip()
+                # each token carries its own breaker: a detect.mesh:<id>
+                # expiry trips that device's fault domain, everything
+                # else trips the backend breaker
+                t.breaker.trip()
             with self._cv:
                 wait = 0.25 if nearest is None \
                     else max(min(nearest, 0.25), 0.001)

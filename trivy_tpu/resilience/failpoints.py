@@ -46,11 +46,29 @@ SITES = (
     "db.download",        # db/download.py OCI artifact pull
 )
 
+# site FAMILIES: a family member is `<family>:<instance>` (e.g.
+# `detect.mesh:2` = mesh device 2's fault domain, probed by
+# parallel/mesh.py + resilience/meshguard.py). Families keep the
+# catalog closed — the instance part is open (device ids come from the
+# runtime) but the family must be compiled in.
+FAMILIES = (
+    "detect.mesh",        # meshguard per-device domain probes
+)
+
 MODES = ("error", "hang", "slow", "flaky")
 
 _SPEC_RE = re.compile(
-    r"^(?P<site>[a-z_.]+)=(?P<mode>[a-z]+)"
+    r"^(?P<site>[a-z_.]+(?::[a-z0-9_]+)?)=(?P<mode>[a-z]+)"
     r"(?:[:(](?P<arg>[0-9.]+)(?:[:,](?P<seed>\d+))?\)?)?$")
+
+
+def known_site(site: str) -> bool:
+    """Exact catalog members, plus `<family>:<instance>` members of the
+    compiled-in families."""
+    if site in SITES:
+        return True
+    fam, sep, inst = site.partition(":")
+    return bool(sep) and bool(inst) and fam in FAMILIES
 
 
 class FailpointError(RuntimeError):
@@ -89,9 +107,11 @@ def parse_spec(text: str) -> dict[str, _Spec]:
             raise ValueError(f"bad failpoint spec {raw!r} "
                              f"(want site=mode[:arg[:seed]])")
         site, mode = m.group("site"), m.group("mode")
-        if site not in SITES:
-            raise ValueError(f"unknown failpoint site {site!r} "
-                             f"(known: {', '.join(SITES)})")
+        if not known_site(site):
+            raise ValueError(
+                f"unknown failpoint site {site!r} "
+                f"(known: {', '.join(SITES)}; families: "
+                f"{', '.join(f + ':<id>' for f in FAMILIES)})")
         if mode not in MODES:
             raise ValueError(f"unknown failpoint mode {mode!r} "
                              f"(known: {', '.join(MODES)})")
@@ -116,6 +136,7 @@ class FailpointRegistry:
         # lock-free fast-path flag: plain bool read is atomic in
         # CPython; set only under the lock
         self._armed = False
+        self._armed_sites: frozenset = frozenset()
 
     def configure(self, text: str) -> None:
         """Replace the armed set from a spec string ('' clears)."""
@@ -123,10 +144,11 @@ class FailpointRegistry:
         with self._lock:
             self._specs = specs
             self._armed = bool(specs)
+            self._armed_sites = frozenset(specs)
 
     def set(self, site: str, mode: str, arg: float = 0.0,
             seed: int = 0) -> None:
-        if site not in SITES:
+        if not known_site(site):
             raise ValueError(f"unknown failpoint site {site!r}")
         if mode not in MODES:
             raise ValueError(f"unknown failpoint mode {mode!r}")
@@ -134,6 +156,7 @@ class FailpointRegistry:
             self._specs = dict(self._specs)
             self._specs[site] = _Spec(mode, arg, random.Random(seed))
             self._armed = True
+            self._armed_sites = frozenset(self._specs)
 
     def clear(self, site: str | None = None) -> None:
         with self._lock:
@@ -143,6 +166,24 @@ class FailpointRegistry:
                 self._specs = {k: v for k, v in self._specs.items()
                                if k != site}
             self._armed = bool(self._specs)
+            self._armed_sites = frozenset(self._specs)
+
+    @property
+    def armed(self) -> bool:
+        """Anything armed at all? Lock-free (plain bool read) — the
+        meshguard domain-probe loop skips its per-device watches
+        entirely when nothing is armed, keeping the mesh hot path at
+        one attribute read like every other disarmed site."""
+        return self._armed
+
+    @property
+    def armed_sites(self) -> frozenset:
+        """Immutable snapshot of the armed site names (lock-free plain
+        attribute read). meshguard probes ONLY devices whose
+        `detect.mesh:<id>` site appears here — arming an unrelated
+        failpoint (e.g. cache.backend) costs the mesh hot path
+        nothing."""
+        return self._armed_sites
 
     def active(self) -> dict[str, str]:
         """→ {site: 'mode(arg)'} snapshot for /healthz and logs."""

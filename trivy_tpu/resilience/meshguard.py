@@ -1,0 +1,430 @@
+"""meshguard — per-device fault domains for the mesh detect path.
+
+graftguard (breaker.py) supervises the device backend as ONE fault
+domain: a wedged chip trips the global breaker and every request drops
+to the NumPy host fallback, throwing away all the healthy devices a
+dp×db mesh was built from. meshguard splits that domain per device:
+
+  BreakerRegistry  one CircuitBreaker per mesh device, keyed by device
+                   id and exported as the labelled
+                   `trivy_tpu_mesh_breaker_state{device="<id>"}` gauge.
+                   A domain probe failure or watchdog expiry charges
+                   THAT device's breaker — the backend breaker (and
+                   with it the host fallback for everyone) stays
+                   closed.
+  MeshGuard        the rebuild coordinator. The mesh dispatch path
+                   calls `check(ids)` before each launch: every active
+                   device's `detect.mesh:<id>` failpoint site is probed
+                   under its own `GUARD.watch` (the per-device
+                   watchdog). A fault marks the device LOST once its
+                   breaker leaves closed, and schedules a SHRINK
+                   rebuild — the owner's callback re-meshes the
+                   survivors, re-shards the table, and swaps the
+                   detector through the existing swap_table generation
+                   drain (in-flight scans finish on the old mesh). A
+                   maintenance thread debounces rebuilds
+                   (`rebuild_cooldown_ms`) and runs the readmission
+                   loop: once a lost device's breaker admits its
+                   half-open probe, a successful probe (failpoint site
+                   plus the owner-supplied real device op) readmits the
+                   device and schedules a GROW rebuild through the same
+                   machinery. Below `min_devices` survivors the rebuild
+                   degrades to the host join (empty device set) instead
+                   of flapping through ever-smaller meshes.
+
+Attribution: the per-device sites cover the domain-probe phase of
+each dispatch (and the readmission probes) directly. The collective
+shard_map launch runs under the backend-level `detect.dispatch`
+watch — a whole-launch failure names no single chip — so the launch
+path additionally calls `request_attribution()` and the maintenance
+thread probes every active device (real per-device ops on disposable
+bounded threads); exactly the chips that fail or wedge their probe
+are expelled. Everything here is host orchestration; graftlint's
+TPU108 keeps the probes and breaker reads out of shard_map bodies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..log import get as _get_logger
+from ..metrics import METRICS
+from .breaker import CLOSED, CircuitBreaker, DeviceError, GUARD
+from .failpoints import FAILPOINTS, failpoint
+
+_log = _get_logger("meshguard")
+
+MESH_SITE_FAMILY = "detect.mesh"
+
+
+def mesh_site(dev_id) -> str:
+    """The failpoint/watch site for one device's fault domain."""
+    return f"{MESH_SITE_FAMILY}:{dev_id}"
+
+
+class MeshDomainError(DeviceError):
+    """A supervised per-device domain probe failed: the fault is
+    attributed to `device_id`, not the backend."""
+
+    def __init__(self, device_id, msg: str):
+        super().__init__(f"{mesh_site(device_id)}: {msg}")
+        self.device_id = device_id
+
+
+class BreakerRegistry:
+    """Per-site circuit breakers, lazily created. Each breaker exports
+    the labelled mesh-breaker gauge so /metrics shows every device's
+    domain state (0 closed, 1 open, 2 half-open)."""
+
+    def __init__(self, fail_threshold: int = 3,
+                 reset_timeout_s: float = 5.0):
+        self._lock = threading.Lock()
+        self._breakers: dict = {}
+        self.fail_threshold = fail_threshold
+        self.reset_timeout_s = reset_timeout_s
+
+    def get(self, key) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                br = CircuitBreaker(
+                    fail_threshold=self.fail_threshold,
+                    reset_timeout_s=self.reset_timeout_s,
+                    name=mesh_site(key),
+                    gauge="trivy_tpu_mesh_breaker_state",
+                    gauge_labels={"device": str(key)})
+                self._breakers[key] = br
+        return br
+
+    def status(self) -> dict:
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {str(k): br.status() for k, br in sorted(
+            breakers.items(), key=lambda kv: str(kv[0]))}
+
+    def reset_all(self) -> None:
+        with self._lock:
+            breakers = list(self._breakers.values())
+        for br in breakers:
+            br.reset()
+
+
+@dataclass
+class MeshGuardOptions:
+    """meshguard knobs (server flags --mesh-min-devices,
+    --mesh-rebuild-cooldown-ms, --mesh-probe-timeout-ms)."""
+    min_devices: int = 1              # survivors below this → host join
+    rebuild_cooldown_ms: float = 1000.0   # debounce between rebuilds
+    probe_timeout_ms: float = 5000.0  # per-device watchdog deadline
+    probe_interval_ms: float = 100.0  # maintenance/readmission cadence
+    fail_threshold: int = 3           # per-device breaker threshold
+    reset_timeout_ms: float = 1000.0  # per-device open→half-open window
+
+
+class MeshGuard:
+    """Rebuild coordinator over a set of device fault domains.
+
+    Owners register a rebuild callback `(active_ids, reason)` — called
+    from the maintenance thread with the surviving device ids (empty =
+    degrade to the host join) and "shrink" or "grow". The callback may
+    take seconds (it builds and swaps a scanner); it never runs on the
+    request path."""
+
+    def __init__(self, device_ids, opts: MeshGuardOptions | None = None,
+                 probe=None):
+        self.all_ids = list(device_ids)
+        self.opts = opts or MeshGuardOptions()
+        self.registry = BreakerRegistry(
+            fail_threshold=self.opts.fail_threshold,
+            reset_timeout_s=self.opts.reset_timeout_ms / 1e3)
+        # Condition (not a bare Lock): the maintenance thread sleeps on
+        # it and device_failed/close wake it for a prompt rebuild
+        self._cv = threading.Condition()
+        self._lost: set = set()
+        self._pending: str | None = None   # scheduled rebuild reason
+        self._attributing = False  # a collective failure asked "who?"
+        self._last_rebuild = float("-inf")
+        self._rebuild_cb = None
+        self._probe = probe       # owner's real per-device op, or None
+        self._rebuilds = {"shrink": 0, "grow": 0}
+        self._closed = False
+        METRICS.set_gauge("trivy_tpu_mesh_devices",
+                          float(len(self.all_ids)))
+        self._thread = threading.Thread(
+            target=self._run, name="meshguard-maintain", daemon=True)
+        self._thread.start()
+
+    # ---- hot-path surface ---------------------------------------------
+
+    def check(self, device_ids=None) -> None:
+        """Per-dispatch domain probes: fire each active device's
+        `detect.mesh:<id>` failpoint under that device's own watch.
+        Only devices whose site is actually ARMED pay a watch — with
+        nothing armed (or only unrelated sites armed) this is one
+        attribute read. Raises MeshDomainError on the first faulted
+        device (after marking it lost when its breaker left closed) —
+        the caller serves THIS dispatch from the host join while the
+        rebuild swaps the mesh."""
+        armed = FAILPOINTS.armed_sites
+        if not armed:
+            return
+        lost = None
+        for dev_id in (self.all_ids if device_ids is None
+                       else device_ids):
+            site = mesh_site(dev_id)
+            if site not in armed:
+                continue
+            if lost is None:
+                with self._cv:
+                    lost = set(self._lost)
+            if dev_id in lost:
+                continue
+            br = self.registry.get(dev_id)
+            try:
+                with GUARD.watch(
+                        site,
+                        timeout_s=self.opts.probe_timeout_ms / 1e3,
+                        breaker=br):
+                    failpoint(site)
+            except DeviceError as e:
+                # transient errors below the threshold stay in-domain
+                # noise; once the breaker leaves closed (threshold or
+                # watchdog trip) the device is lost and the mesh shrinks
+                if br.state != CLOSED:
+                    self.device_failed(dev_id)
+                raise MeshDomainError(dev_id, str(e)) from e
+
+    def any_lost(self, device_ids) -> bool:
+        """Does this mesh still include a lost device? (The pre-swap
+        window: serve from the host join instead of re-probing a dead
+        domain on every dispatch.)"""
+        with self._cv:
+            if not self._lost:
+                return False
+            return any(i in self._lost for i in device_ids)
+
+    # ---- state transitions --------------------------------------------
+
+    def request_attribution(self) -> None:
+        """A COLLECTIVE launch failed (the backend-level watch saw a
+        DeviceError the shard_map launch can't pin on one chip):
+        schedule per-device attribution probes on the maintenance
+        thread. Each active device gets the owner's real probe op
+        under its own watch — exactly the chips that fail or wedge
+        their probe are expelled, so a real (non-injected) device
+        fault engages the fault domains too, not just the chaos
+        substrate. Called from the request path: O(1), never probes
+        inline."""
+        with self._cv:
+            if self._closed or self._attributing:
+                return
+            self._attributing = True
+            self._cv.notify()
+
+    def _attribute(self) -> None:
+        with self._cv:
+            if not self._attributing:
+                return
+            self._attributing = False
+            active = [i for i in self.all_ids if i not in self._lost]
+            probe = self._probe
+        _log.warning("meshguard: attributing collective launch "
+                     "failure across %d devices", len(active))
+        for dev_id in active:
+            br = self.registry.get(dev_id)
+            site = mesh_site(dev_id)
+            try:
+                with GUARD.watch(
+                        site,
+                        timeout_s=self.opts.probe_timeout_ms / 1e3,
+                        breaker=br):
+                    self._probe_bounded(probe, dev_id, site)
+            except DeviceError:
+                _log.warning("meshguard: attribution probe failed for "
+                             "device %s", dev_id, exc_info=True)
+                self.device_failed(dev_id)
+
+    def _probe_bounded(self, probe, dev_id, site) -> None:
+        """Run one device's probe — its failpoint site AND the owner's
+        real device op — on a DISPOSABLE daemon thread, bounded by the
+        probe timeout: a truly wedged chip (or a hang-mode chaos
+        drill) must never absorb the single maintenance thread, which
+        would freeze every pending rebuild and readmission. On timeout
+        the wedged thread is abandoned (daemon) and the probe counts
+        as failed — the surrounding watch converts the raise to a
+        DeviceError on the device's own breaker."""
+        outcome: list = []
+
+        def run():
+            try:
+                failpoint(site)
+                if probe is not None:
+                    probe(dev_id)
+                outcome.append(None)
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                outcome.append(e)
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"meshguard-probe-{dev_id}")
+        t.start()
+        t.join(timeout=self.opts.probe_timeout_ms / 1e3)
+        if t.is_alive():
+            raise RuntimeError(f"device {dev_id} probe wedged past "
+                               f"{self.opts.probe_timeout_ms:g} ms")
+        if outcome and outcome[0] is not None:
+            raise outcome[0]
+
+    def device_failed(self, dev_id) -> None:
+        """Mark one device lost and schedule a shrink rebuild."""
+        with self._cv:
+            if dev_id not in self.all_ids or dev_id in self._lost:
+                return
+            self._lost.add(dev_id)
+            # shrink wins over a pending grow — the survivor set is
+            # computed fresh at rebuild time either way
+            self._pending = "shrink"
+            self._cv.notify()
+        METRICS.inc("trivy_tpu_mesh_device_lost_total")
+        _log.warning("meshguard: device %s lost; shrink rebuild "
+                     "scheduled", dev_id)
+
+    def on_rebuild(self, cb) -> None:
+        with self._cv:
+            self._rebuild_cb = cb
+            if self._pending:
+                self._cv.notify()
+
+    def active_ids(self) -> list:
+        with self._cv:
+            return [i for i in self.all_ids if i not in self._lost]
+
+    def lost_ids(self) -> list:
+        with self._cv:
+            return sorted(self._lost)
+
+    # ---- maintenance thread -------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                self._cv.wait(
+                    timeout=self.opts.probe_interval_ms / 1e3)
+                if self._closed:
+                    return
+            try:
+                self._tick()
+            except Exception:   # the coordinator must never die
+                _log.exception("meshguard maintenance tick failed")
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        cb = reason = survivors = None
+        with self._cv:
+            due = (now - self._last_rebuild) * 1e3 \
+                >= self.opts.rebuild_cooldown_ms
+            if self._pending is not None and self._rebuild_cb \
+                    is not None and due:
+                reason = self._pending
+                self._pending = None
+                # stamped even if the callback then fails: the RETRY
+                # also waits out the cooldown (anti-flap)
+                self._last_rebuild = now
+                cb = self._rebuild_cb
+                survivors = [i for i in self.all_ids
+                             if i not in self._lost]
+        if cb is not None:
+            active = survivors if len(survivors) \
+                >= max(self.opts.min_devices, 1) else []
+            _log.warning(
+                "meshguard: %s rebuild → %d/%d devices%s", reason,
+                len(active), len(self.all_ids),
+                "" if active or not survivors
+                else f" (survivors {len(survivors)} < min_devices "
+                     f"{self.opts.min_devices}: host join)")
+            try:
+                cb(active, reason)
+            except Exception:
+                _log.exception("meshguard rebuild callback failed; "
+                               "retrying after the cooldown")
+                # re-schedule so a transient swap failure can never
+                # strand the stale mesh (and its any_lost host-only
+                # window) forever; counters/gauge stay untouched — a
+                # failed rebuild must not report a healthy shrunk mesh
+                with self._cv:
+                    if self._pending is None:
+                        self._pending = reason
+                return
+            # success accounting only
+            with self._cv:
+                self._rebuilds[reason] += 1
+            METRICS.inc("trivy_tpu_mesh_rebuilds_total", reason=reason)
+            METRICS.set_gauge("trivy_tpu_mesh_devices",
+                              float(len(active)))
+        self._attribute()
+        self._probe_lost()
+
+    def _probe_lost(self) -> None:
+        """Readmission: once a lost device's breaker admits the
+        half-open probe, run the failpoint site plus the owner's real
+        device op under its watch. Success closes the breaker and
+        schedules a grow rebuild; failure re-opens for another reset
+        window."""
+        with self._cv:
+            lost = sorted(self._lost)
+            probe = self._probe
+        for dev_id in lost:
+            br = self.registry.get(dev_id)
+            if not br.allow():
+                continue   # still inside the open window
+            site = mesh_site(dev_id)
+            try:
+                with GUARD.watch(
+                        site,
+                        timeout_s=self.opts.probe_timeout_ms / 1e3,
+                        breaker=br):
+                    # bounded: a still-wedged chip (or hang-mode
+                    # failpoint) abandons its probe thread instead of
+                    # freezing the maintenance loop
+                    self._probe_bounded(probe, dev_id, site)
+            except DeviceError:
+                _log.warning("meshguard: device %s probe failed; "
+                             "domain stays open", dev_id, exc_info=True)
+                continue
+            with self._cv:
+                self._lost.discard(dev_id)
+                if self._pending is None:
+                    self._pending = "grow"
+                self._cv.notify()
+            _log.warning("meshguard: device %s readmitted; grow "
+                         "rebuild scheduled", dev_id)
+
+    # ---- introspection / lifecycle ------------------------------------
+
+    def status(self) -> dict:
+        """→ /healthz `resilience.mesh` payload."""
+        with self._cv:
+            lost = sorted(self._lost)
+            rebuilds = dict(self._rebuilds)
+            pending = self._pending
+        return {
+            "devices": len(self.all_ids),
+            "active": len(self.all_ids) - len(lost),
+            "lost": [str(i) for i in lost],
+            "min_devices": self.opts.min_devices,
+            "rebuild_cooldown_ms": self.opts.rebuild_cooldown_ms,
+            "rebuilds": rebuilds,
+            "pending_rebuild": pending,
+            "breakers": self.registry.status(),
+        }
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)

@@ -29,10 +29,22 @@ if TYPE_CHECKING:
 
 class LocalScanner:
     def __init__(self, cache, table: AdvisoryTable,
-                 sched: "SchedOptions | None" = None):
+                 sched: "SchedOptions | None" = None,
+                 mesh=None, mesh_guard=None):
         self.cache = cache
         self.table = table
-        self.detector = BatchDetector(table)
+        # mesh mode (server --mesh-devices): the detect step shards
+        # over a dp×db device mesh, supervised per-device by meshguard.
+        # `mesh="host"` is the zero-survivor degraded detector — same
+        # surface, every join host-side — so the meshguard grow path
+        # can swap a real mesh back in through the same drain.
+        if mesh is not None:
+            from .parallel.mesh import MeshDetector
+            self.detector = MeshDetector(
+                table, None if mesh == "host" else mesh,
+                guard=mesh_guard)
+        else:
+            self.detector = BatchDetector(table)
         # detectd: when the owner passes SchedOptions (the scan server
         # does by default), detection routes through the shared
         # coalescing scheduler so concurrent requests merge into

@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import __version__, types as T
@@ -36,10 +37,22 @@ from . import DEADLINE_HEADER, TOKEN_HEADER, TRACE_HEADER  # noqa: F401
 _log = _get_logger("server")
 
 
+@dataclass
+class MeshOptions:
+    """Mesh-mode knobs (server flags --mesh-devices, --mesh-db-shards,
+    --mesh-min-devices, --mesh-rebuild-cooldown-ms,
+    --mesh-probe-timeout-ms). devices=0 keeps the single-chip path."""
+    devices: int = 0          # mesh size; 0 = single-chip detect path
+    db_shards: int = 1        # preferred db width (shrink re-fits it)
+    min_devices: int = 1      # survivors below this → host join
+    rebuild_cooldown_ms: float = 1000.0
+    probe_timeout_ms: float = 5000.0
+
+
 class ServerState:
     def __init__(self, table, cache_dir: str, token: str = "",
                  cache_backend: str = "fs", detect_opts=None,
-                 admission=None):
+                 admission=None, mesh_opts: MeshOptions | None = None):
         from ..detect.sched import SchedOptions
         if cache_backend.startswith("redis://"):
             from ..fanal.redis_cache import RedisCache
@@ -62,8 +75,37 @@ class ServerState:
         self.admission = AdmissionQueue(admission,
                                         breaker=GUARD.breaker)
         self._table = table
+        # meshguard: mesh mode shards the detect join over a device
+        # mesh with per-device fault domains. Device loss shrinks the
+        # mesh to the survivors (grow on readmission) through the
+        # swap_table generation drain below, instead of dropping the
+        # whole backend to the host fallback.
+        self.mesh_guard = None
+        self._mesh = None
+        self._mesh_devices = []
+        self._mesh_db_shards = 1
+        if mesh_opts is not None and mesh_opts.devices:
+            import jax
+
+            from ..parallel.mesh import mesh_from_devices
+            from ..resilience import MeshGuard, MeshGuardOptions
+            n = mesh_opts.devices
+            devs = jax.devices()
+            self._mesh_devices = list(devs if n < 0 else devs[:n])
+            self._mesh_db_shards = mesh_opts.db_shards
+            self._mesh = mesh_from_devices(self._mesh_devices,
+                                           mesh_opts.db_shards)
+            self.mesh_guard = MeshGuard(
+                [int(d.id) for d in self._mesh_devices],
+                MeshGuardOptions(
+                    min_devices=mesh_opts.min_devices,
+                    rebuild_cooldown_ms=mesh_opts.rebuild_cooldown_ms,
+                    probe_timeout_ms=mesh_opts.probe_timeout_ms),
+                probe=self._mesh_probe)
         self._scanner = LocalScanner(self.cache, table,
-                                     sched=self.detect_opts)
+                                     sched=self.detect_opts,
+                                     mesh=self._mesh,
+                                     mesh_guard=self.mesh_guard)
         self._inflight = 0
         self._closed = False
         # scanner generations: a request started under generation g
@@ -81,6 +123,45 @@ class ServerState:
         # recorded the probe's success, which must not absorb a
         # multi-second scanner build
         GUARD.breaker.on_recovery(self._recover)
+        # meshguard rebuilds ride the same drain (they run on the
+        # coordinator's maintenance thread, already off the hot path)
+        if self.mesh_guard is not None:
+            self.mesh_guard.on_rebuild(self._mesh_rebuild)
+
+    def _mesh_probe(self, dev_id) -> None:
+        """Readmission probe body (meshguard runs it under the
+        device's own watch, after its failpoint site): one real tiny
+        op on the lost device — a dead chip fails or wedges right
+        here, a recovered one completes and closes its domain."""
+        import jax
+        import numpy as np
+        dev = next(d for d in self._mesh_devices
+                   if int(d.id) == int(dev_id))
+        jax.device_put(np.zeros(8, np.int32), dev).block_until_ready()
+
+    def _mesh_rebuild(self, active_ids, reason: str) -> None:
+        """meshguard rebuild callback: re-mesh the survivors (largest
+        valid dp×db factorization), re-shard the table, and swap the
+        detector through the generation drain — in-flight scans finish
+        on the old mesh while new requests land on the rebuilt one.
+        Zero survivors swaps in the host-join degraded detector."""
+        with self._lock:
+            if self._closed:
+                return
+        from ..parallel.mesh import mesh_from_devices
+        ids = {int(i) for i in active_ids}
+        devs = [d for d in self._mesh_devices if int(d.id) in ids]
+        mesh = mesh_from_devices(devs, self._mesh_db_shards) \
+            if devs else "host"
+        _log.warning("meshguard: %s rebuild → swapping %s-device mesh "
+                     "via generation drain", reason,
+                     len(devs) if devs else "host-join (0)")
+        try:
+            # _KEEP_TABLE: a DB hot swap racing this rebuild must not
+            # be reverted to a snapshotted (stale) advisory table
+            self.swap_table(ServerState._KEEP_TABLE, mesh=mesh)
+        except Exception:
+            _log.exception("meshguard: %s rebuild swap failed", reason)
 
     def _recover(self) -> None:
         with self._lock:
@@ -93,7 +174,9 @@ class ServerState:
 
     def _recover_swap(self) -> None:
         try:
-            self.swap_table(self._table)
+            # rebuild with whatever table/mesh are CURRENT at install
+            # time — a hot swap racing the recovery must not be undone
+            self.swap_table(ServerState._KEEP_TABLE)
         except Exception:
             _log.exception("graftguard: recovery swap failed")
 
@@ -127,24 +210,69 @@ class ServerState:
             self._closed = True
             scanner = self._scanner
         GUARD.breaker.remove_recovery(self._recover)
+        if self.mesh_guard is not None:
+            self.mesh_guard.close()
         scanner.close()
 
-    def swap_table(self, table) -> None:
-        """DB hot swap (reference listen.go dbWorker)."""
-        # build (and, with --detect-warmup, XLA-warm) the new scanner
-        # OUTSIDE the lock: construction can take seconds and every
-        # handler blocks on request_started behind this lock
-        new_scanner = LocalScanner(self.cache, table,
-                                   sched=self.detect_opts)
-        with self._lock:
-            old_scanner = self._scanner
-            old_gen = self._gen
-            self._gen += 1
-            self._gen_active.setdefault(self._gen, 0)
-            if not self._gen_active[old_gen]:
-                del self._gen_active[old_gen]
-            self._scanner = new_scanner
-            self._table = table
+    # "keep the current value" sentinels: a DB hot swap keeps the
+    # mesh, a meshguard rebuild / breaker recovery keeps the table —
+    # each must re-read the CURRENT other half at build time AND
+    # re-check it at install time, or a swap racing a rebuild would
+    # silently resurrect the half its caller never meant to change
+    # (stale mesh with a lost device, or a stale advisory table)
+    _KEEP_MESH = object()
+    _KEEP_TABLE = object()
+
+    def swap_table(self, table, mesh=_KEEP_MESH) -> None:
+        """DB hot swap (reference listen.go dbWorker). Also the
+        meshguard shrink/grow path: `mesh` swaps the detect mesh under
+        the same generation drain."""
+        keep_mesh = mesh is ServerState._KEEP_MESH
+        keep_table = table is ServerState._KEEP_TABLE
+        while True:
+            with self._lock:
+                build_mesh = self._mesh if keep_mesh else mesh
+                build_table = self._table if keep_table else table
+            # build (and, with --detect-warmup, XLA-warm) the new
+            # scanner OUTSIDE the lock: construction can take seconds
+            # and every handler blocks on request_started behind it
+            new_scanner = LocalScanner(self.cache, build_table,
+                                       sched=self.detect_opts,
+                                       mesh=build_mesh,
+                                       mesh_guard=self.mesh_guard)
+            with self._lock:
+                # close() may have run while the scanner was building
+                # (a meshguard rebuild races server shutdown):
+                # installing now would strand a never-closed scanner
+                # whose non-daemon workers hang process exit
+                if self._closed:
+                    outcome = "aborted"
+                elif (keep_mesh and self._mesh is not build_mesh) or \
+                        (keep_table and self._table
+                         is not build_table):
+                    # a concurrent swap changed the kept half
+                    # mid-build: installing the snapshot would undo it
+                    outcome = "stale"
+                else:
+                    outcome = "installed"
+                    old_scanner = self._scanner
+                    old_gen = self._gen
+                    self._gen += 1
+                    self._gen_active.setdefault(self._gen, 0)
+                    if not self._gen_active[old_gen]:
+                        del self._gen_active[old_gen]
+                    self._scanner = new_scanner
+                    self._table = build_table
+                    self._mesh = build_mesh
+            if outcome == "aborted":
+                new_scanner.close()
+                return
+            if outcome == "stale":
+                _log.warning("swap: mesh/table changed during scanner "
+                             "build; rebuilding against fresh state")
+                new_scanner.close()
+                continue
+            break
         # the swapped-in table's object graph (~1M small objects for a
         # full trivy-db) is immutable; freezing it out of the cyclic
         # collector keeps gen2 passes from stalling in-flight scans.
@@ -264,15 +392,20 @@ class Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
             else:
+                resilience = {
+                    **GUARD.status(),
+                    "admission": self.state.admission.snapshot(),
+                }
+                # meshguard: per-device breaker states, lost set, and
+                # the shrink/grow rebuild counters
+                if self.state.mesh_guard is not None:
+                    resilience["mesh"] = self.state.mesh_guard.status()
                 self._json(200, {
                     "status": "ok",
                     "device": device_status(),
                     # graftguard: breaker state, watchdog last-probe
                     # age, shed/fallback counters, admission snapshot
-                    "resilience": {
-                        **GUARD.status(),
-                        "admission": self.state.admission.snapshot(),
-                    },
+                    "resilience": resilience,
                 })
         elif self.path == "/version":
             self._json(200, {"Version": __version__})
@@ -458,17 +591,20 @@ class Handler(BaseHTTPRequestHandler):
 def serve(host: str, port: int, table, cache_dir: str, token: str = "",
           ready_event: threading.Event | None = None,
           cache_backend: str = "fs", trace_path: str = "",
-          detect_opts=None, admission=None):
+          detect_opts=None, admission=None, mesh_opts=None):
     """`trace_path` arms graftscope recording for the server's
     lifetime and dumps the Chrome trace-event JSON there on shutdown
     (the CLI's `server --trace FILE`). `detect_opts` (SchedOptions)
     tunes detectd — coalesce wait, in-flight pair bound, warmup;
-    `admission` (AdmissionOptions) bounds the graftguard scan queue."""
+    `admission` (AdmissionOptions) bounds the graftguard scan queue;
+    `mesh_opts` (MeshOptions) shards detection over a device mesh with
+    meshguard per-device fault domains."""
     if trace_path:
         from ..obs import COLLECTOR
         COLLECTOR.enable()
     state = ServerState(table, cache_dir, token, cache_backend,
-                        detect_opts=detect_opts, admission=admission)
+                        detect_opts=detect_opts, admission=admission,
+                        mesh_opts=mesh_opts)
     Handler.state = state
     httpd = ThreadingHTTPServer((host, port), Handler)
     if ready_event is not None:
@@ -488,13 +624,14 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
 
 def serve_background(host: str, port: int, table, cache_dir: str,
                      token: str = "", detect_opts=None,
-                     admission=None):
+                     admission=None, mesh_opts=None):
     """Start in a daemon thread; returns (httpd, state) once listening.
     Callers own shutdown: `httpd.shutdown()` then `state.close()` (the
     detect engine's worker threads are non-daemon)."""
     Handler.state = ServerState(table, cache_dir, token,
                                 detect_opts=detect_opts,
-                                admission=admission)
+                                admission=admission,
+                                mesh_opts=mesh_opts)
     httpd = ThreadingHTTPServer((host, port), Handler)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
