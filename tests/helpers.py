@@ -1,16 +1,139 @@
 """Test helpers: build synthetic docker-save image tarballs in memory,
-plus a strict Prometheus text-exposition parser (the tier-1 gate that
-keeps /metrics scrapeable)."""
+a strict Prometheus text-exposition parser (the tier-1 gate that keeps
+/metrics scrapeable), and an in-process fake Redis (the shared cache
+backend the fleet tests and bench drive without a real server)."""
 
 import hashlib
 import io
 import json
 import math
 import re
+import socket
 import sqlite3
 import struct
 import tarfile
 import tempfile
+import threading
+
+
+# ---- in-process fake Redis (RESP2) -----------------------------------
+
+class FakeRedis:
+    """Tiny RESP2 server: SET/GET/EXISTS/DEL/RENAME/SCAN/AUTH/SELECT.
+    The reference tests use testcontainers; this fake speaks enough
+    protocol for RedisCache (integration/client_server_test.go
+    setupRedis) and doubles as the shared fleet backend in
+    tests/test_fleet.py and bench.py's server_fleet scenario."""
+
+    def __init__(self, password=""):
+        self.data = {}
+        self.password = password
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(16)
+        self.port = self.sock.getsockname()[1]
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        buf = b""
+        authed = not self.password
+        while True:
+            try:
+                chunk = conn.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while True:
+                cmd, buf2 = self._parse(buf)
+                if cmd is None:
+                    break
+                buf = buf2
+                reply, authed = self._dispatch(cmd, authed)
+                try:
+                    conn.sendall(reply)
+                except OSError:
+                    return
+
+    @staticmethod
+    def _parse(buf):
+        if not buf.startswith(b"*"):
+            return None, buf
+        try:
+            head, rest = buf.split(b"\r\n", 1)
+            n = int(head[1:])
+            args = []
+            for _ in range(n):
+                if not rest.startswith(b"$"):
+                    return None, buf
+                lhead, rest2 = rest.split(b"\r\n", 1)
+                ln = int(lhead[1:])
+                if len(rest2) < ln + 2:
+                    return None, buf
+                args.append(rest2[:ln])
+                rest = rest2[ln + 2:]
+            return args, rest
+        except (ValueError, IndexError):
+            return None, buf
+
+    def _dispatch(self, args, authed):
+        cmd = args[0].decode().upper()
+        if cmd == "AUTH":
+            if args[1].decode() == self.password:
+                return b"+OK\r\n", True
+            return b"-ERR invalid password\r\n", authed
+        if not authed:
+            return b"-NOAUTH Authentication required.\r\n", authed
+        if cmd == "SELECT":
+            return b"+OK\r\n", authed
+        if cmd == "SET":
+            self.data[args[1]] = args[2]
+            return b"+OK\r\n", authed
+        if cmd == "GET":
+            v = self.data.get(args[1])
+            if v is None:
+                return b"$-1\r\n", authed
+            return b"$%d\r\n%s\r\n" % (len(v), v), authed
+        if cmd == "EXISTS":
+            return b":%d\r\n" % (1 if args[1] in self.data else 0), \
+                authed
+        if cmd == "DEL":
+            n = 1 if self.data.pop(args[1], None) is not None else 0
+            return b":%d\r\n" % n, authed
+        if cmd == "RENAME":
+            v = self.data.pop(args[1], None)
+            if v is None:
+                return b"-ERR no such key\r\n", authed
+            self.data[args[2]] = v
+            return b"+OK\r\n", authed
+        if cmd == "SCAN":
+            import fnmatch
+            pat = b"*"
+            for i, a in enumerate(args):
+                if a.upper() == b"MATCH":
+                    pat = args[i + 1]
+            keys = [k for k in self.data
+                    if fnmatch.fnmatch(k.decode(), pat.decode())]
+            out = b"*2\r\n$1\r\n0\r\n*%d\r\n" % len(keys)
+            for k in keys:
+                out += b"$%d\r\n%s\r\n" % (len(k), k)
+            return out, authed
+        return b"-ERR unknown command\r\n", authed
+
+    def close(self):
+        self.sock.close()
 
 
 # ---- strict Prometheus text exposition format 0.0.4 parser ----------

@@ -1,0 +1,689 @@
+"""graftfleet tier-1 gate: consistent-hash ring properties, the scan
+router's failover/readmission behavior over real HTTP replicas, shared
+cache-backend coherence (a layer analyzed by replica A is a hit on
+replica B), deadline propagation, chaos via the rpc.route failpoint,
+and the fleet /metrics series under the strict exposition parser."""
+
+import glob
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from helpers import FakeRedis, parse_exposition
+from trivy_tpu.db import build_table
+from trivy_tpu.db.fixtures import load_fixture_files
+from trivy_tpu.fleet import (HashRing, ReplicaOptions, RouterOptions,
+                             serve_router_background)
+from trivy_tpu.metrics import METRICS
+from trivy_tpu.resilience import RetryPolicy
+from trivy_tpu.server.listen import serve_background
+
+FIXGLOB = os.path.join(os.path.dirname(__file__), "fixtures", "db",
+                       "*.yaml")
+
+
+@pytest.fixture(scope="module")
+def table():
+    advisories, details, _ = load_fixture_files(
+        sorted(glob.glob(FIXGLOB)))
+    return build_table(advisories, details)
+
+
+# ---------------------------------------------------------------------------
+# ring properties (sha256 placement → every assertion is deterministic)
+
+def _keys(n):
+    return [f"sha256:{i:064x}" for i in range(n)]
+
+
+class TestHashRing:
+    def test_balance_is_bounded(self):
+        ring = HashRing([f"http://r{i}" for i in range(4)], vnodes=128)
+        shares: dict = {}
+        for k in _keys(20000):
+            o = ring.node_for(k)
+            shares[o] = shares.get(o, 0) + 1
+        assert len(shares) == 4
+        assert max(shares.values()) / min(shares.values()) < 1.5
+
+    def test_loss_remaps_only_the_lost_replicas_keys(self):
+        nodes = [f"http://r{i}" for i in range(4)]
+        ring = HashRing(nodes, vnodes=64)
+        before = {k: ring.node_for(k) for k in _keys(8000)}
+        ring.remove("http://r2")
+        moved = 0
+        for k, owner in before.items():
+            now = ring.node_for(k)
+            if owner == "http://r2":
+                moved += 1
+                assert now != "http://r2"
+            else:
+                assert now == owner, f"{k} moved {owner} → {now}"
+        # the lost quarter's keys spread over the survivors
+        assert 0.15 < moved / len(before) < 0.40
+
+    def test_join_only_steals_keys_for_the_new_replica(self):
+        nodes = [f"http://r{i}" for i in range(3)]
+        ring = HashRing(nodes, vnodes=64)
+        before = {k: ring.node_for(k) for k in _keys(8000)}
+        ring.add("http://r3")
+        stolen = 0
+        for k, owner in before.items():
+            now = ring.node_for(k)
+            if now != owner:
+                stolen += 1
+                assert now == "http://r3"
+        assert 0.10 < stolen / len(before) < 0.40
+
+    def test_successors_start_at_owner_and_cover_all(self):
+        nodes = [f"http://r{i}" for i in range(4)]
+        ring = HashRing(nodes, vnodes=32)
+        for k in _keys(50):
+            succ = ring.successors(k)
+            assert succ[0] == ring.node_for(k)
+            assert sorted(succ) == sorted(nodes)
+            assert len(set(succ)) == len(succ)
+
+    def test_empty_ring(self):
+        ring = HashRing([])
+        assert ring.successors("k") == []
+        with pytest.raises(LookupError):
+            ring.node_for("k")
+
+    def test_vnode_validation(self):
+        with pytest.raises(ValueError):
+            HashRing(["a"], vnodes=0)
+
+
+# ---------------------------------------------------------------------------
+# fleet harness: real replicas + router in-process, shared fake redis
+
+PKGS = [
+    {"Name": "libcrypto3", "Version": "3.0.7-r0",
+     "SrcName": "openssl", "SrcVersion": "3.0.7-r0"},
+    {"Name": "musl", "Version": "1.2.3-r4",
+     "SrcName": "musl", "SrcVersion": "1.2.3-r4"},
+    {"Name": "zlib", "Version": "1.2.13-r0",
+     "SrcName": "zlib", "SrcVersion": "1.2.13-r0"},
+]
+
+
+def blob_doc(i: int) -> dict:
+    return {
+        "SchemaVersion": 2, "DiffID": f"sha256:{i:064x}",
+        "OS": {"Family": "alpine", "Name": "3.17.3"},
+        "PackageInfos": [{"FilePath": "lib/apk/db/installed",
+                          "Packages": PKGS}],
+    }
+
+
+def post(base, route, doc, timeout=60, headers=None):
+    req = urllib.request.Request(
+        base + route, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def put_blob(base, i):
+    post(base, "/twirp/trivy.cache.v1.Cache/PutBlob",
+         {"diff_id": blob_doc(i)["DiffID"], "blob_info": blob_doc(i)})
+
+
+def scan(base, i, timeout=60, headers=None):
+    diff = blob_doc(i)["DiffID"]
+    return post(base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                {"target": f"img{i}", "artifact_id": diff,
+                 "blob_ids": [diff],
+                 "options": {"scanners": ["vuln"]}},
+                timeout=timeout, headers=headers)
+
+
+def fast_router_opts(**replica_kw) -> RouterOptions:
+    return RouterOptions(
+        retry=RetryPolicy(attempts=2, base_delay_s=0.01,
+                          max_delay_s=0.05, budget_s=2.0),
+        replica=ReplicaOptions(
+            **{"fail_threshold": 2, "reset_timeout_ms": 300.0,
+               "probe_interval_ms": 50.0, "probe_timeout_ms": 1000.0,
+               **replica_kw}))
+
+
+class Fleet:
+    """N serve_background replicas sharing one FakeRedis, behind an
+    in-process router."""
+
+    def __init__(self, table, n=2, opts=None):
+        self.fake = FakeRedis()
+        self.cache_url = f"redis://127.0.0.1:{self.fake.port}"
+        self.table = table
+        self.replicas: dict[str, tuple] = {}   # url → (httpd, state)
+        urls = [self.start_replica() for _ in range(n)]
+        self.router, self.state = serve_router_background(
+            "127.0.0.1", 0, urls, opts or fast_router_opts())
+        self.url = f"http://127.0.0.1:{self.router.server_address[1]}"
+
+    def start_replica(self, port=0) -> str:
+        httpd, state = serve_background(
+            "127.0.0.1", port, self.table, cache_dir="",
+            cache_backend=self.cache_url)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        self.replicas[url] = (httpd, state)
+        return url
+
+    def kill_replica(self, url: str) -> int:
+        httpd, state = self.replicas.pop(url)
+        port = httpd.server_address[1]
+        httpd.shutdown()
+        httpd.server_close()
+        state.close()
+        return port
+
+    def close(self):
+        self.router.shutdown()
+        self.router.server_close()
+        self.state.close()
+        for url in list(self.replicas):
+            self.kill_replica(url)
+        self.fake.close()
+
+
+@pytest.fixture()
+def fleet(table):
+    f = Fleet(table)
+    yield f
+    f.close()
+
+
+def _canon(resp: dict) -> str:
+    return json.dumps(resp, sort_keys=True)
+
+
+class TestRouterScan:
+    def test_scan_through_router_matches_direct(self, fleet):
+        put_blob(fleet.url, 1)
+        via_router = scan(fleet.url, 1)
+        ids = {v["VulnerabilityID"]
+               for r in via_router.get("results", [])
+               for v in r.get("Vulnerabilities", [])}
+        assert "CVE-2023-0286" in ids
+        # the same RPC straight at each replica returns identical
+        # bytes-for-bytes JSON: routing is invisible to results
+        for replica in fleet.replicas:
+            assert _canon(scan(replica, 1)) == _canon(via_router)
+
+    def test_unknown_route_and_bad_body(self, fleet):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(fleet.url, "/twirp/trivy.nope.v1.X/Y", {})
+        assert e.value.code == 404
+        req = urllib.request.Request(
+            fleet.url + "/twirp/trivy.scanner.v1.Scanner/Scan",
+            data=b"{not json", method="POST",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+
+    def test_healthz_version_metrics(self, fleet):
+        h = json.loads(urllib.request.urlopen(
+            fleet.url + "/healthz", timeout=10).read())
+        assert h["status"] == "ok"
+        assert sorted(h["fleet"]["ring"]["replicas"]) == \
+            sorted(fleet.replicas)
+        assert h["fleet"]["lost"] == []
+        req = urllib.request.Request(fleet.url + "/healthz",
+                                     headers={"Accept": "text/plain"})
+        assert urllib.request.urlopen(req, timeout=10).read() == b"ok"
+        v = json.loads(urllib.request.urlopen(
+            fleet.url + "/version", timeout=10).read())
+        assert "Version" in v
+        body = urllib.request.urlopen(
+            fleet.url + "/metrics", timeout=10).read().decode()
+        parse_exposition(body)
+
+    def test_binary_twirp_roundtrip(self, fleet):
+        """The router keys binary-encoded RPCs too (decode_msg on the
+        shared ROUTE_DESCRIPTORS), and relays the proto response."""
+        from trivy_tpu.server.protowire import decode_msg, encode_msg
+        put_blob(fleet.url, 3)
+        diff = blob_doc(3)["DiffID"]
+        body = encode_msg({"artifact_id": diff, "blob_ids": [diff]},
+                          "MissingBlobsRequest")
+        req = urllib.request.Request(
+            fleet.url + "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+            data=body, method="POST",
+            headers={"Content-Type": "application/protobuf"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers.get("Content-Type") == \
+                "application/protobuf"
+            reply = decode_msg(r.read(), "MissingBlobsResponse")
+        assert not reply.get("missing_blob_ids")
+
+
+class TestSharedCache:
+    def test_layer_analyzed_once_is_a_hit_on_every_replica(self, fleet):
+        """The acceptance scenario: push + scan through the router
+        (lands on the key's owner), then every OTHER replica sees the
+        blob as cached — no re-push, scans work anywhere."""
+        put_blob(fleet.url, 11)
+        diff = blob_doc(11)["DiffID"]
+        hits0 = METRICS.get("trivy_tpu_fleet_cache_hits_total",
+                            backend="redis")
+        baseline = _canon(scan(fleet.url, 11))
+        for replica in fleet.replicas:
+            missing = post(
+                replica, "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+                {"artifact_id": diff, "blob_ids": [diff]})
+            assert not missing.get("missing_blob_ids")
+            assert _canon(scan(replica, 11)) == baseline
+        assert METRICS.get("trivy_tpu_fleet_cache_hits_total",
+                           backend="redis") > hits0
+
+    def test_corrupt_shared_entry_heals_through_the_client_flow(
+            self, fleet):
+        """A corrupt entry quarantines to a miss on read; the
+        missing_blobs → re-push → scan flow then heals the key
+        (mirrors the FSCache tests from PR 5, one backend up)."""
+        put_blob(fleet.url, 12)
+        diff = blob_doc(12)["DiffID"]
+        baseline = _canon(scan(fleet.url, 12))
+        key = f"fanal::blob::{diff}".encode()
+        fleet.fake.data[key] = b"{truncated"
+        # scan now answers 400 invalid_argument server-side (the blob
+        # is a clean miss, the KeyError path) — the router relays the
+        # replica's answer terminally rather than retrying a scan
+        # that cannot succeed anywhere
+        with pytest.raises(urllib.error.HTTPError) as e:
+            scan(fleet.url, 12)
+        assert e.value.code == 400
+        assert key not in fleet.fake.data   # quarantined
+        # the client flow: missing_blobs reports the gap, re-push heals
+        missing = post(fleet.url,
+                       "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+                       {"artifact_id": diff, "blob_ids": [diff]})
+        assert missing.get("missing_blob_ids") == [diff]
+        put_blob(fleet.url, 12)
+        assert _canon(scan(fleet.url, 12)) == baseline
+
+
+class TestFailover:
+    def test_killed_replica_mid_load_zero_failures_bit_identical(
+            self, fleet):
+        """ISSUE acceptance: kill one replica mid-load at c=8 → zero
+        failed requests, results bit-identical to the unfaulted run,
+        and the dead replica's domain opens."""
+        n = 32
+        for i in range(n):
+            put_blob(fleet.url, i)
+        baseline = {i: _canon(scan(fleet.url, i)) for i in range(n)}
+        victim = next(iter(fleet.replicas))
+        failures = []
+        done = threading.Event()
+
+        def scan_one(i):
+            if i == 8:
+                fleet.kill_replica(victim)
+                done.set()
+            try:
+                return i, _canon(scan(fleet.url, i, timeout=30))
+            except Exception as e:  # noqa: BLE001 — counted below
+                failures.append((i, e))
+                return i, None
+
+        with ThreadPoolExecutor(8) as pool:
+            results = dict(pool.map(scan_one, range(n)))
+        assert failures == [], failures
+        assert done.is_set()
+        for i in range(n):
+            assert results[i] == baseline[i], f"img{i} drifted"
+        status = fleet.state.supervisor.status()
+        assert victim in status["lost"]
+        assert METRICS.get("trivy_tpu_fleet_failovers_total") > 0
+
+    def test_readmission_after_restart(self, fleet):
+        """A killed replica's /healthz probe readmits it once it comes
+        back on the same port — its ring arcs (never removed) snap
+        back to it."""
+        victim = next(iter(fleet.replicas))
+        port = None
+        # drive the victim lost: kill it, then scan keys it owns
+        for i in range(100):
+            if fleet.state.ring.node_for(blob_doc(i)["DiffID"]) \
+                    == victim:
+                put_blob(fleet.url, i)
+                port = port if port is not None \
+                    else fleet.kill_replica(victim)
+                scan(fleet.url, i)   # fails over; charges the domain
+                if victim in fleet.state.supervisor.lost():
+                    break
+        assert victim in fleet.state.supervisor.lost()
+        # restart on the same port → probe loop readmits
+        fleet.start_replica(port)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if victim not in fleet.state.supervisor.lost():
+                break
+            time.sleep(0.05)
+        assert victim not in fleet.state.supervisor.lost()
+        assert fleet.state.supervisor.status()["readmissions"] >= 1
+
+    def test_rpc_route_chaos_flaky_forwards_all_succeed(self, fleet):
+        """Seeded rpc.route flakes exercise failover on every shape of
+        request; results stay bit-identical and no request fails (the
+        breaker threshold is set above the drill's fault budget)."""
+        from trivy_tpu.resilience import FAILPOINTS
+        for i in range(10):
+            put_blob(fleet.url, i)
+        baseline = {i: _canon(scan(fleet.url, i)) for i in range(10)}
+        fleet.state.supervisor.registry.fail_threshold = 10_000
+        for br in [fleet.state.supervisor.registry.get(r)
+                   for r in fleet.replicas]:
+            br.fail_threshold = 10_000
+        # deep retry budget: a seeded 30% flake on every forward must
+        # be absorbed by failover + re-walks, never surfaced
+        fleet.state.opts.retry = RetryPolicy(
+            attempts=6, base_delay_s=0.005, max_delay_s=0.02,
+            budget_s=2.0)
+        FAILPOINTS.set("rpc.route", "flaky", 0.3, seed=7)
+        try:
+            for i in range(10):
+                assert _canon(scan(fleet.url, i)) == baseline[i]
+        finally:
+            FAILPOINTS.clear()
+        assert fleet.state.supervisor.lost() == []
+
+
+# ---------------------------------------------------------------------------
+# stub replicas: admission sheds, hangs, deadlines
+
+class StubReplica:
+    """Answers every POST with a canned behavior; /healthz is always
+    healthy (the supervisor's probe target)."""
+
+    def __init__(self, code=200, body=b"{}", retry_after=None,
+                 delay_s=0.0):
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                out = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def do_POST(self):
+                stub.hits += 1
+                length = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(length)
+                stub.deadlines.append(
+                    self.headers.get("X-Trivy-Deadline-Ms"))
+                if stub.delay_s:
+                    time.sleep(stub.delay_s)
+                self.send_response(stub.code)
+                self.send_header("Content-Type", "application/json")
+                if stub.retry_after is not None:
+                    self.send_header("Retry-After", stub.retry_after)
+                self.send_header("Content-Length", str(len(stub.body)))
+                self.end_headers()
+                self.wfile.write(stub.body)
+
+        self.code, self.body = code, body
+        self.retry_after, self.delay_s = retry_after, delay_s
+        self.hits = 0
+        self.deadlines: list = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _key_owned_by(ring, owner):
+    for i in range(100_000):
+        k = f"sha256:{i:064x}"
+        if ring.node_for(k) == owner:
+            return k
+    raise AssertionError("no key found")
+
+
+class TestShedsAndDeadlines:
+    def test_shed_replica_fails_over_without_breaker_charge(self):
+        shed = StubReplica(code=429, retry_after="1")
+        ok = StubReplica(code=200, body=b'{"ok": true}')
+        router, state = serve_router_background(
+            "127.0.0.1", 0, [shed.url, ok.url], fast_router_opts())
+        base = f"http://127.0.0.1:{router.server_address[1]}"
+        try:
+            key = _key_owned_by(state.ring, shed.url)
+            out = post(base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                       {"artifact_id": key, "blob_ids": [key]})
+            assert out == {"ok": True}
+            assert shed.hits == 1 and ok.hits == 1
+            # a shed is not a fault: the busy replica stays closed
+            st = state.supervisor.status()["replicas"][shed.url]
+            assert st["state"] == "closed" and not st["lost"]
+            assert state.supervisor.lost() == []
+        finally:
+            router.shutdown()
+            router.server_close()
+            state.close()
+            shed.close()
+            ok.close()
+
+    def test_all_shed_relays_least_loaded_shed(self):
+        s1 = StubReplica(code=503, retry_after="5",
+                         body=b'{"code": "unavailable"}')
+        s2 = StubReplica(code=429, retry_after="2",
+                         body=b'{"code": "resource_exhausted"}')
+        opts = fast_router_opts()
+        opts.retry = RetryPolicy(attempts=1, base_delay_s=0.01,
+                                 max_delay_s=0.02, budget_s=0.1)
+        router, state = serve_router_background(
+            "127.0.0.1", 0, [s1.url, s2.url], opts)
+        base = f"http://127.0.0.1:{router.server_address[1]}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post(base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                     {"artifact_id": "sha256:0"})
+            # the smaller Retry-After (429, 2s) wins the relay
+            assert e.value.code == 429
+            assert e.value.headers.get("Retry-After") == "2"
+        finally:
+            router.shutdown()
+            router.server_close()
+            state.close()
+            s1.close()
+            s2.close()
+
+    def test_deadline_bounds_forward_and_is_restamped(self):
+        """The router re-stamps the REMAINING budget and returns 504
+        once it is exhausted — a hanging replica cannot hold the
+        request past the client's deadline (modulo one socket tick)."""
+        hang = StubReplica(code=200, delay_s=1.0)
+        router, state = serve_router_background(
+            "127.0.0.1", 0, [hang.url], fast_router_opts())
+        base = f"http://127.0.0.1:{router.server_address[1]}"
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post(base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                     {"artifact_id": "sha256:0"}, timeout=10,
+                     headers={"X-Trivy-Deadline-Ms": "200"})
+            elapsed = time.monotonic() - t0
+            assert e.value.code == 504
+            assert json.loads(e.value.read())["code"] == \
+                "deadline_exceeded"
+            assert elapsed < 0.9   # never waited out the 1 s hang
+            # the forwarded stamp was the REMAINING budget (≤ 200ms)
+            assert hang.deadlines and \
+                all(float(d) <= 200 for d in hang.deadlines if d)
+        finally:
+            router.shutdown()
+            router.server_close()
+            state.close()
+            hang.close()
+
+    def test_wedged_owner_fails_over_within_deadline(self):
+        """A hanging owner burns only its forward slice: the failover
+        still answers inside the client's budget."""
+        hang = StubReplica(code=200, delay_s=5.0)
+        ok = StubReplica(code=200, body=b'{"ok": true}')
+        opts = fast_router_opts()
+        opts.replica_timeout_s = 0.2   # forward bound << deadline
+        router, state = serve_router_background(
+            "127.0.0.1", 0, [hang.url, ok.url], opts)
+        base = f"http://127.0.0.1:{router.server_address[1]}"
+        try:
+            key = _key_owned_by(state.ring, hang.url)
+            t0 = time.monotonic()
+            out = post(base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                       {"artifact_id": key}, timeout=10,
+                       headers={"X-Trivy-Deadline-Ms": "5000"})
+            assert out == {"ok": True}
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            router.shutdown()
+            router.server_close()
+            state.close()
+            hang.close()
+            ok.close()
+
+    def test_4xx_is_relayed_terminally(self):
+        bad = StubReplica(code=401,
+                          body=b'{"code": "unauthenticated"}')
+        ok = StubReplica(code=200, body=b'{"ok": true}')
+        router, state = serve_router_background(
+            "127.0.0.1", 0, [bad.url, ok.url], fast_router_opts())
+        base = f"http://127.0.0.1:{router.server_address[1]}"
+        try:
+            key = _key_owned_by(state.ring, bad.url)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post(base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                     {"artifact_id": key})
+            assert e.value.code == 401
+            assert ok.hits == 0   # no failover on a client error
+        finally:
+            router.shutdown()
+            router.server_close()
+            state.close()
+            bad.close()
+            ok.close()
+
+
+# ---------------------------------------------------------------------------
+# client fleet awareness + metrics + failpoint grammar
+
+class TestClientFleetAwareness:
+    def test_client_walks_past_dead_base(self):
+        from trivy_tpu.server.client import RemoteCache
+        ok = StubReplica(
+            code=200,
+            body=json.dumps({"missing_artifact": True,
+                             "missing_blob_ids": ["b"]}).encode())
+        # a dead port first: the client fails over and remembers
+        dead = "http://127.0.0.1:9"
+        cache = RemoteCache(
+            f"{dead},{ok.url}",
+            retry=RetryPolicy(attempts=1, base_delay_s=0.01,
+                              max_delay_s=0.02, budget_s=0.2))
+        try:
+            missing_artifact, missing = cache.missing_blobs("a", ["b"])
+            assert missing_artifact and missing == ["b"]
+            assert cache.base_url == ok.url   # promoted
+            cache.missing_blobs("a", ["b"])
+            assert ok.hits == 2
+        finally:
+            ok.close()
+
+    def test_non_object_json_error_body_is_still_twirp(self):
+        """A proxy answering with valid-but-non-object JSON (`"busy"`)
+        must surface as TwirpError, never AttributeError."""
+        from trivy_tpu.server.client import RemoteCache, TwirpError
+        stub = StubReplica(code=500, body=b'"busy"')
+        cache = RemoteCache(
+            stub.url,
+            retry=RetryPolicy(attempts=1, base_delay_s=0.01,
+                              max_delay_s=0.02, budget_s=0.2))
+        try:
+            with pytest.raises(TwirpError) as e:
+                cache.missing_blobs("a", ["b"])
+            assert e.value.code == "500"
+        finally:
+            stub.close()
+
+    def test_all_bases_dead_raises_unavailable(self):
+        from trivy_tpu.server.client import RemoteCache, TwirpError
+        cache = RemoteCache(
+            "http://127.0.0.1:9,http://127.0.0.1:10",
+            retry=RetryPolicy(attempts=1, base_delay_s=0.01,
+                              max_delay_s=0.02, budget_s=0.2))
+        with pytest.raises(TwirpError) as e:
+            cache.missing_blobs("a", ["b"])
+        assert e.value.code == "unavailable"
+
+
+class TestFleetMetrics:
+    def test_fleet_series_under_strict_exposition(self, fleet):
+        put_blob(fleet.url, 5)
+        scan(fleet.url, 5)
+        body = urllib.request.urlopen(
+            fleet.url + "/metrics", timeout=10).read().decode()
+        families = parse_exposition(body)
+        # one replica-state gauge series per replica URL, from boot
+        state = families["trivy_tpu_fleet_replica_state"]
+        assert state["type"] == "gauge"
+        labelled = {labels.get("replica")
+                    for _, labels, _ in state["samples"]}
+        assert set(fleet.replicas) <= labelled
+        hits = families["trivy_tpu_fleet_cache_hits_total"]
+        assert any(labels.get("backend") == "redis"
+                   for _, labels, _ in hits["samples"])
+        lat = families["trivy_tpu_fleet_router_latency_seconds"]
+        assert lat["type"] == "histogram"
+        count = sum(v for n, _, v in lat["samples"]
+                    if n.endswith("_count"))
+        assert count >= 2   # the PutBlob and the Scan
+
+
+class TestFailpointGrammar:
+    def test_fleet_sites_parse(self):
+        from trivy_tpu.resilience.failpoints import parse_spec
+        specs = parse_spec("rpc.route=error;cache.redis=flaky:0.5:3,"
+                           "cache.s3=hang:10")
+        assert set(specs) == {"rpc.route", "cache.redis", "cache.s3"}
+        assert specs["cache.redis"].mode == "flaky"
+        assert specs["cache.s3"].arg == 10.0
+
+    def test_unknown_site_still_rejected(self):
+        from trivy_tpu.resilience.failpoints import parse_spec
+        with pytest.raises(ValueError):
+            parse_spec("cache.memcached=error")
+
+
+class TestOpenCache:
+    def test_selection(self, tmp_path):
+        from trivy_tpu.fanal.cache import (FSCache, MemoryCache,
+                                           open_cache)
+        assert isinstance(open_cache("memory"), MemoryCache)
+        assert isinstance(open_cache("fs", str(tmp_path)), FSCache)
+        assert isinstance(open_cache("", str(tmp_path)), FSCache)
+        with pytest.raises(ValueError):
+            open_cache("memcached://x")

@@ -424,6 +424,60 @@ def test_shard_map_body_clock_is_tpu107():
     assert [(f.rule, f.line) for f in fs] == [("TPU107", 4)]
 
 
+def test_fleet_in_lock_hygiene_scope():
+    """Satellite (PR 6): trivy_tpu/fleet/ — the ring and replica
+    supervisor are shared across router handler threads and the
+    readmission loop — is in TPU106 scope."""
+    src = (
+        "import threading\n"
+        "class Ring:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._points = []\n"
+        "    def bad(self, p):\n"
+        "        self._points.append(p)\n"
+        "    def good(self, p):\n"
+        "        with self._lock:\n"
+        "            self._points.append(p)\n"
+    )
+    fs = _lint("trivy_tpu/fleet/ring.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+    # outside the scoped modules the same class is not checked
+    assert _lint("trivy_tpu/report/fixture.py", src) == []
+
+
+def test_fleet_clock_in_device_code_detected():
+    """Satellite (PR 6): TPU107 covers jitted cores wherever they
+    appear — a timed core sneaking into fleet/ must be caught."""
+    src = (
+        "import time, jax\n"
+        "def _route_core(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    return x + t0\n"
+        "j = jax.jit(_route_core)\n"
+    )
+    fs = _lint("trivy_tpu/fleet/router.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU107", 3)]
+
+
+def test_fleet_failpoint_in_device_code_detected():
+    """Satellite (PR 6): TPU108 — a failpoint probe or breaker read in
+    a jitted core inside fleet/ must be caught."""
+    src = (
+        "import jax\n"
+        "from trivy_tpu.resilience import GUARD, failpoint\n"
+        "def _fleet_core(x):\n"
+        "    failpoint('rpc.route')\n"
+        "    if GUARD.allow_device():\n"
+        "        x = x + 1\n"
+        "    return x\n"
+        "j = jax.jit(_fleet_core)\n"
+    )
+    fs = _lint("trivy_tpu/fleet/supervisor.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU108", 4),
+                                              ("TPU108", 5)]
+
+
 def test_resilience_registry_in_lock_hygiene_scope():
     """Satellite: the failpoint registry (trivy_tpu/resilience/) is
     shared across handler threads and the watchdog — TPU106 must

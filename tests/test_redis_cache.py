@@ -1,122 +1,15 @@
 """Redis cache backend against an in-process fake redis (the reference
-tests use testcontainers; our fake speaks enough RESP2 —
+tests use testcontainers; the fake — tests/helpers.py FakeRedis, shared
+with the fleet tests and bench — speaks enough RESP2 —
 integration/client_server_test.go setupRedis)."""
 
-import socket
 import threading
 
 import pytest
 
+from helpers import FakeRedis
 from trivy_tpu import types as T
 from trivy_tpu.fanal.redis_cache import RedisCache, RespClient
-
-
-class FakeRedis:
-    """Tiny RESP2 server: SET/GET/EXISTS/DEL/SCAN/AUTH/SELECT/EX."""
-
-    def __init__(self, password=""):
-        self.data = {}
-        self.password = password
-        self.sock = socket.socket()
-        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind(("127.0.0.1", 0))
-        self.sock.listen(4)
-        self.port = self.sock.getsockname()[1]
-        self.thread = threading.Thread(target=self._serve, daemon=True)
-        self.thread.start()
-
-    def _serve(self):
-        while True:
-            try:
-                conn, _ = self.sock.accept()
-            except OSError:
-                return
-            threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
-
-    def _handle(self, conn):
-        buf = b""
-        authed = not self.password
-        while True:
-            try:
-                chunk = conn.recv(65536)
-            except OSError:
-                return
-            if not chunk:
-                return
-            buf += chunk
-            while True:
-                cmd, buf2 = self._parse(buf)
-                if cmd is None:
-                    break
-                buf = buf2
-                reply, authed = self._dispatch(cmd, authed)
-                try:
-                    conn.sendall(reply)
-                except OSError:
-                    return
-
-    @staticmethod
-    def _parse(buf):
-        if not buf.startswith(b"*"):
-            return None, buf
-        try:
-            head, rest = buf.split(b"\r\n", 1)
-            n = int(head[1:])
-            args = []
-            for _ in range(n):
-                if not rest.startswith(b"$"):
-                    return None, buf
-                lhead, rest2 = rest.split(b"\r\n", 1)
-                ln = int(lhead[1:])
-                if len(rest2) < ln + 2:
-                    return None, buf
-                args.append(rest2[:ln])
-                rest = rest2[ln + 2:]
-            return args, rest
-        except (ValueError, IndexError):
-            return None, buf
-
-    def _dispatch(self, args, authed):
-        cmd = args[0].decode().upper()
-        if cmd == "AUTH":
-            if args[1].decode() == self.password:
-                return b"+OK\r\n", True
-            return b"-ERR invalid password\r\n", authed
-        if not authed:
-            return b"-NOAUTH Authentication required.\r\n", authed
-        if cmd == "SELECT":
-            return b"+OK\r\n", authed
-        if cmd == "SET":
-            self.data[args[1]] = args[2]
-            return b"+OK\r\n", authed
-        if cmd == "GET":
-            v = self.data.get(args[1])
-            if v is None:
-                return b"$-1\r\n", authed
-            return b"$%d\r\n%s\r\n" % (len(v), v), authed
-        if cmd == "EXISTS":
-            return b":%d\r\n" % (1 if args[1] in self.data else 0), \
-                authed
-        if cmd == "DEL":
-            n = 1 if self.data.pop(args[1], None) is not None else 0
-            return b":%d\r\n" % n, authed
-        if cmd == "SCAN":
-            import fnmatch
-            pat = b"*"
-            for i, a in enumerate(args):
-                if a.upper() == b"MATCH":
-                    pat = args[i + 1]
-            keys = [k for k in self.data
-                    if fnmatch.fnmatch(k.decode(), pat.decode())]
-            out = b"*2\r\n$1\r\n0\r\n*%d\r\n" % len(keys)
-            for k in keys:
-                out += b"$%d\r\n%s\r\n" % (len(k), k)
-            return out, authed
-        return b"-ERR unknown command\r\n", authed
-
-    def close(self):
-        self.sock.close()
 
 
 @pytest.fixture()
@@ -183,3 +76,67 @@ def test_fs_scan_with_redis_cache(fake, tmp_path):
     blob = cache.get_blob(ref.blob_ids[0])
     assert blob is not None
     assert blob.applications
+
+
+def test_corrupt_entry_quarantines_to_a_miss(fake):
+    """The FSCache contract from PR 5 on the shared backend: a corrupt
+    blob entry serves a miss (never raises), and the bytes move under
+    fanal::corrupt:: so every future read misses cleanly too."""
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    cache.put_blob("blob1", T.BlobInfo(diff_id="sha256:abc"))
+    fake.data[b"fanal::blob::blob1"] = b"{truncated by a bad writ"
+    assert cache.get_blob("blob1") is None
+    assert b"fanal::blob::blob1" not in fake.data
+    assert fake.data[b"fanal::corrupt::blob::blob1"].startswith(
+        b"{truncated")
+    # quarantined = a plain miss from now on; a re-put heals the key
+    assert cache.get_blob("blob1") is None
+    cache.put_blob("blob1", T.BlobInfo(diff_id="sha256:abc"))
+    assert cache.get_blob("blob1").diff_id == "sha256:abc"
+
+
+def test_corrupt_artifact_also_quarantines(fake):
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    fake.data[b"fanal::artifact::a1"] = b"\xff\xfenot json"
+    assert cache.get_artifact("a1") is None
+    assert b"fanal::corrupt::artifact::a1" in fake.data
+
+
+def test_concurrent_round_trips_do_not_interleave(fake):
+    """Server handler threads share one RESP connection; the client
+    lock must keep 8 threads' frames from interleaving."""
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    errors = []
+
+    def worker(i):
+        try:
+            for j in range(25):
+                cache.put_artifact(f"a{i}", {"i": i, "j": j})
+                got = cache.get_artifact(f"a{i}")
+                assert got["i"] == i
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+
+
+def test_cache_redis_failpoint_fires(fake):
+    from trivy_tpu.resilience import FAILPOINTS, FailpointError
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    FAILPOINTS.set("cache.redis", "error")
+    try:
+        with pytest.raises(FailpointError):
+            cache.get_blob("blob1")
+        with pytest.raises(FailpointError):
+            cache.put_artifact("a", {})
+        with pytest.raises(FailpointError):
+            cache.missing_blobs("a", ["b"])
+    finally:
+        FAILPOINTS.clear()
+    assert cache.get_blob("blob1") is None

@@ -41,6 +41,10 @@ def fake_s3(monkeypatch):
         def do_HEAD(self):
             self._reply(200 if self.path in objects else 404)
 
+        def do_DELETE(self):
+            objects.pop(self.path, None)
+            self._reply(204)
+
         def log_message(self, *a):
             pass
 
@@ -102,3 +106,40 @@ def test_scan_through_s3_cache(fake_s3, tmp_path):
 def test_invalid_url_rejected():
     with pytest.raises(S3CacheError):
         S3Cache("http://not-s3")
+
+
+def test_corrupt_entry_quarantines_to_a_miss(fake_s3):
+    """PR 5's FSCache contract on the object store: a corrupt blob
+    serves a miss, the bytes move under fanal/corrupt/ for forensics,
+    and the original key is deleted so every replica misses cleanly."""
+    url, objects = fake_s3
+    cache = S3Cache(url)
+    blob = T.BlobInfo(schema_version=2)
+    cache.put_blob("sha256:bad", blob)
+    key = next(k for k in objects if k.endswith("fanal/blob/sha256:bad"))
+    objects[key] = b"{not json at all"
+    assert cache.get_blob("sha256:bad") is None
+    assert key not in objects
+    qkey = key.replace("fanal/blob/", "fanal/corrupt/blob/")
+    assert objects[qkey] == b"{not json at all"
+    # future reads are plain misses; a re-put heals the key
+    assert cache.get_blob("sha256:bad") is None
+    cache.put_blob("sha256:bad", blob)
+    assert cache.get_blob("sha256:bad") is not None
+
+
+def test_cache_s3_failpoint_fires(fake_s3):
+    from trivy_tpu.resilience import FAILPOINTS, FailpointError
+    url, _ = fake_s3
+    cache = S3Cache(url)
+    FAILPOINTS.set("cache.s3", "error")
+    try:
+        with pytest.raises(FailpointError):
+            cache.get_blob("sha256:x")
+        with pytest.raises(FailpointError):
+            cache.put_artifact("a", {})
+        with pytest.raises(FailpointError):
+            cache.missing_blobs("a", ["b"])
+    finally:
+        FAILPOINTS.clear()
+    assert cache.get_blob("sha256:x") is None

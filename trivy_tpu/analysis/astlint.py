@@ -58,6 +58,9 @@ _LOCK_SCOPE = (
     # graftguard: the failpoint registry and breaker are hit from
     # every handler thread plus the watchdog
     os.path.join("trivy_tpu", "resilience") + os.sep,
+    # graftfleet: the ring and replica supervisor are shared across
+    # router handler threads and the readmission loop
+    os.path.join("trivy_tpu", "fleet") + os.sep,
 )
 
 

@@ -104,7 +104,8 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--ignore-policy", default="",
                    help="OPA rego file deciding per-finding suppression")
     p.add_argument("--cache-backend", default="fs",
-                   help="fs | memory | redis://host:port[/db]")
+                   help="fs | memory | redis://host:port[/db] | "
+                        "s3://bucket[/prefix]?region=..[&endpoint=..]")
     p.add_argument("--java-db", default="",
                    help="prebuilt trivy-java.db (sha1→GAV); defaults to "
                         "<cache-dir>/javadb/trivy-java.db when present")
@@ -179,7 +180,9 @@ def build_parser() -> argparse.ArgumentParser:
                                         "trivy-tpu"))
     p.add_argument("--token", default="")
     p.add_argument("--cache-backend", default="fs",
-                   help="fs | redis://host:port[/db]")
+                   help="fs | memory | redis://host:port[/db] | "
+                        "s3://bucket[/prefix] — point every replica "
+                        "of a fleet at one shared redis/s3 URL")
     p.add_argument("--trace", default="", metavar="FILE",
                    help="record graftscope spans for the server's "
                         "lifetime; dump Chrome trace-event JSON to "
@@ -254,6 +257,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "domain probes and readmission probes; expiry "
                         "trips only that device's breaker "
                         "(default 5000)")
+
+    p = sub.add_parser("router",
+                       help="run the graftfleet scan router in front "
+                            "of N server replicas")
+    p.add_argument("--listen", default="0.0.0.0:4953")
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="URL", dest="replicas",
+                   help="server replica base URL (repeatable; "
+                        "required at least once)")
+    p.add_argument("--ring-vnodes", type=int, default=64,
+                   help="virtual nodes per replica on the consistent-"
+                        "hash ring (more = smoother balance, default "
+                        "64)")
+    p.add_argument("--replica-timeout-ms", type=float, default=60000.0,
+                   help="per-forward socket bound (further bounded by "
+                        "the client's X-Trivy-Deadline-Ms)")
+    p.add_argument("--replica-fail-threshold", type=int, default=3,
+                   help="routed-RPC failures that open one replica's "
+                        "fault domain (default 3)")
+    p.add_argument("--replica-reset-ms", type=float, default=2000.0,
+                   help="open-domain window before a /healthz "
+                        "readmission probe may try the replica again "
+                        "(default 2000)")
+    p.add_argument("--replica-probe-interval-ms", type=float,
+                   default=200.0,
+                   help="readmission loop cadence (default 200)")
+    p.add_argument("--replica-probe-timeout-ms", type=float,
+                   default=2000.0,
+                   help="/healthz probe bound (default 2000)")
+    p.add_argument("--route-retries", type=int, default=3,
+                   help="ring re-walks when every replica sheds or "
+                        "fails (RetryPolicy attempts, default 3)")
+    p.add_argument("--failpoint", action="append", default=[],
+                   metavar="SITE=MODE[:ARG]",
+                   help="graftguard fault injection (rpc.route drills "
+                        "the failover path; also TRIVY_TPU_FAILPOINTS)")
 
     p = sub.add_parser("k8s", aliases=["kubernetes"],
                        help="scan a kubernetes cluster")
@@ -525,19 +564,14 @@ def _configure_misconf(args) -> None:
 
 def _open_cache(args):
     """Cache backend selection (reference initCache run.go:344:
-    fs / redis / memory)."""
-    backend = getattr(args, "cache_backend", "fs")
-    if backend.startswith("redis://"):
-        from .fanal.redis_cache import RedisCache
-        return RedisCache(backend)
-    if backend.startswith("s3://"):
-        from .fanal.s3_cache import S3Cache
-        return S3Cache(backend)
-    if backend == "memory":
-        from .fanal.cache import MemoryCache
-        return MemoryCache()
-    from .fanal.cache import FSCache
-    return FSCache(args.cache_dir)
+    fs / redis / s3 / memory) — one resolution path shared with the
+    server (fanal.cache.open_cache)."""
+    from .fanal.cache import open_cache
+    try:
+        return open_cache(getattr(args, "cache_backend", "fs"),
+                          args.cache_dir)
+    except ValueError as e:
+        raise SystemExit(f"--cache-backend: {e}") from None
 
 
 def cmd_image(args) -> int:
@@ -915,6 +949,14 @@ def cmd_server(args) -> int:
         max_active=getattr(args, "admit_max_active", 0),
         max_queue=getattr(args, "admit_max_queue", 16),
         queue_timeout_ms=getattr(args, "admit_queue_ms", 1000.0))
+    # validate the backend spelling BEFORE the (slow) table load, and
+    # as a clean CLI error instead of ServerState's raw ValueError
+    from .fanal.cache import known_backend
+    backend = getattr(args, "cache_backend", "fs")
+    if not known_backend(backend):
+        raise SystemExit(f"--cache-backend: unknown cache backend "
+                         f"{backend!r} (fs | memory | redis://... | "
+                         f"s3://...)")
     table = _load_table_args(args)
     host, _, port = args.listen.rpartition(":")
     opts = SchedOptions(
@@ -938,6 +980,40 @@ def cmd_server(args) -> int:
           cache_backend=getattr(args, "cache_backend", "fs"),
           trace_path=getattr(args, "trace", ""),
           detect_opts=opts, admission=admission, mesh_opts=mesh_opts)
+    return 0
+
+
+def cmd_router(args) -> int:
+    """graftfleet scan router: consistent-hash artifacts across N
+    server replicas with per-replica fault domains. Clients point at
+    the router exactly as they would at one server."""
+    from .fleet import ReplicaOptions, RouterOptions, serve_router
+    from .resilience import FAILPOINTS, RetryPolicy
+    from .resilience.failpoints import spec_from_sources
+    if not args.replicas:
+        raise SystemExit("router needs at least one --replica URL")
+    try:
+        FAILPOINTS.configure(
+            spec_from_sources(getattr(args, "failpoint", [])))
+    except ValueError as e:
+        raise SystemExit(str(e))
+    opts = RouterOptions(
+        vnodes=getattr(args, "ring_vnodes", 64),
+        replica_timeout_s=getattr(args, "replica_timeout_ms",
+                                  60000.0) / 1e3,
+        retry=RetryPolicy(
+            attempts=max(1, getattr(args, "route_retries", 3)),
+            base_delay_s=0.05, max_delay_s=1.0, budget_s=10.0),
+        replica=ReplicaOptions(
+            fail_threshold=getattr(args, "replica_fail_threshold", 3),
+            reset_timeout_ms=getattr(args, "replica_reset_ms", 2000.0),
+            probe_interval_ms=getattr(args,
+                                      "replica_probe_interval_ms",
+                                      200.0),
+            probe_timeout_ms=getattr(args, "replica_probe_timeout_ms",
+                                     2000.0)))
+    host, _, port = args.listen.rpartition(":")
+    serve_router(host or "0.0.0.0", int(port), args.replicas, opts)
     return 0
 
 
@@ -1156,9 +1232,9 @@ def main(argv=None) -> int:
     if argv:
         from . import plugin as _plugin
         known = {"image", "filesystem", "fs", "rootfs", "repository",
-                 "repo", "sbom", "vm", "convert", "server", "k8s",
-                 "kubernetes", "aws", "version", "plugin", "module",
-                 "-h", "--help", "--version"}
+                 "repo", "sbom", "vm", "convert", "server", "router",
+                 "k8s", "kubernetes", "aws", "version", "plugin",
+                 "module", "-h", "--help", "--version"}
         if argv[0] not in known and _plugin.exists(argv[0]):
             return _plugin.run(argv[0], argv[1:])
     if argv and argv[0] == "--generate-default-config":
@@ -1211,6 +1287,8 @@ def _run_command(args) -> int:
         return cmd_convert(args)
     if cmd == "server":
         return cmd_server(args)
+    if cmd == "router":
+        return cmd_router(args)
     if cmd in ("k8s", "kubernetes"):
         return cmd_k8s(args)
     if cmd == "aws":

@@ -18,6 +18,38 @@ from .. import types as T
 SCHEMA_VERSION = 2
 
 
+def known_backend(backend: str) -> bool:
+    """Is `backend` a spelling open_cache accepts? (The CLI validates
+    before the slow table load so a typo fails in milliseconds.)"""
+    return backend in ("", "fs", "memory") \
+        or backend.startswith(("redis://", "s3://"))
+
+
+def open_cache(backend: str, cache_dir: str = ""):
+    """Backend selection (reference initCache, run.go:344), shared by
+    the CLI, the server, and the fleet bench so the `--cache-backend`
+    spelling resolves in exactly one place:
+
+        fs (default)          FSCache under <cache_dir>
+        memory                MemoryCache (tests, ephemeral scans)
+        redis://host:port/db  shared fleet backend (redis_cache)
+        s3://bucket/prefix    shared fleet backend (s3_cache)
+    """
+    if backend.startswith("redis://"):
+        from .redis_cache import RedisCache
+        return RedisCache(backend)
+    if backend.startswith("s3://"):
+        from .s3_cache import S3Cache
+        return S3Cache(backend)
+    if backend == "memory":
+        return MemoryCache()
+    if backend in ("", "fs"):
+        return FSCache(cache_dir)
+    # keep known_backend above in sync with the accepted spellings
+    raise ValueError(f"unknown cache backend {backend!r} "
+                     "(fs | memory | redis://... | s3://...)")
+
+
 def cache_key(base_id: str, analyzer_versions: dict,
               options: Optional[dict] = None) -> str:
     h = hashlib.sha256()
@@ -147,7 +179,11 @@ class FSCache(MemoryCache):
     def get_blob(self, blob_id):
         self._failpoint()
         j = self._read_json(self._path("blob", blob_id))
-        return blob_from_json(j) if j is not None else None
+        if j is None:
+            return None
+        from ..metrics import METRICS
+        METRICS.inc("trivy_tpu_fleet_cache_hits_total", backend="fs")
+        return blob_from_json(j)
 
     def clear(self):
         import shutil

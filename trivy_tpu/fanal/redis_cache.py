@@ -4,7 +4,24 @@ A dependency-free RESP2 client over a TCP socket implements the same
 key scheme as the reference (`fanal::artifact::<id>`,
 `fanal::blob::<id>`, JSON values, optional TTL). The shared Redis
 instance is the coordination plane for client/server fleets —
-SURVEY.md §2.7 P4.
+SURVEY.md §2.7 P4 and the graftfleet serving tier: every replica
+points at the same URL, so a layer analyzed by one replica is a cache
+hit on all of them.
+
+Fleet-production semantics (the FSCache contract from PR 5):
+
+  * puts are atomic — a RESP SET lands whole or not at all, the
+    Redis-side analogue of FSCache's write-then-rename;
+  * a corrupt entry (bad JSON from a buggy writer or a truncating
+    proxy) QUARANTINES on read: the key is RENAMEd under
+    `fanal::corrupt::` (kept for forensics), the read serves a miss,
+    and the layer is re-analyzed — never a JSONDecodeError on every
+    future scan of that key;
+  * every IO method fires the `cache.redis` failpoint, the chaos
+    stand-in for a dead or partitioned shared backend;
+  * the RESP client serializes command round-trips under a lock —
+    server handler threads share one connection, and interleaved
+    writes would corrupt the protocol stream.
 
 URL format: redis://[:password@]host:port[/db].
 """
@@ -13,11 +30,17 @@ from __future__ import annotations
 
 import json
 import socket
+import threading
 from typing import Optional
+
 from urllib.parse import urlparse
 
 from .. import types as T
+from ..log import get as _get_logger
+from ..metrics import METRICS
 from .cache import blob_from_json
+
+_log = _get_logger("fanal.cache.redis")
 
 PREFIX = "fanal"
 
@@ -27,10 +50,13 @@ class RedisError(Exception):
 
 
 class RespClient:
-    """Minimal RESP2 protocol client (SET/GET/EXISTS/DEL/AUTH/SELECT)."""
+    """Minimal RESP2 protocol client (SET/GET/EXISTS/DEL/RENAME/AUTH/
+    SELECT). One in-flight command at a time: round-trips run under a
+    lock so concurrent handler threads never interleave frames."""
 
     def __init__(self, host: str, port: int, password: str = "",
                  db: int = 0, timeout: float = 10.0):
+        self._lock = threading.Lock()
         self.sock = socket.create_connection((host, port),
                                              timeout=timeout)
         self.buf = b""
@@ -51,8 +77,9 @@ class RespClient:
             if isinstance(a, str):
                 a = a.encode()
             out.append(b"$%d\r\n%s\r\n" % (len(a), a))
-        self.sock.sendall(b"".join(out))
-        return self._read_reply()
+        with self._lock:
+            self.sock.sendall(b"".join(out))
+            return self._read_reply()
 
     def _read_line(self) -> bytes:
         while b"\r\n" not in self.buf:
@@ -113,6 +140,11 @@ class RedisCache:
         self.client.close()
 
     @staticmethod
+    def _failpoint():
+        from ..resilience import failpoint
+        failpoint("cache.redis")
+
+    @staticmethod
     def _akey(artifact_id: str) -> str:
         return f"{PREFIX}::artifact::{artifact_id}"
 
@@ -127,23 +159,55 @@ class RedisCache:
         else:
             self.client.command("SET", key, data)
 
+    def _get_json(self, key: str) -> Optional[dict]:
+        """→ decoded JSON, or None (miss) after quarantining a corrupt
+        entry — the RENAME keeps the bytes for forensics while every
+        replica sharing this backend sees a clean miss."""
+        raw = self.client.command("GET", key)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError):
+            quarantine = key.replace(f"{PREFIX}::",
+                                     f"{PREFIX}::corrupt::", 1)
+            try:
+                # read→rename is not atomic: a concurrent re-put
+                # between them gets its fresh value renamed away —
+                # the same TOCTOU window FSCache's quarantine accepts,
+                # and self-healing (next read misses, re-analyzes,
+                # re-puts); closing it needs server-side scripting
+                # this dependency-free client deliberately avoids
+                self.client.command("RENAME", key, quarantine)
+            except RedisError:
+                pass   # a racing reader already quarantined it
+            _log.warning("quarantined corrupt cache entry %s → %s "
+                         "(serving a miss)", key, quarantine)
+            return None
+
     def put_artifact(self, artifact_id: str, info: dict):
+        self._failpoint()
         self._set(self._akey(artifact_id), info)
 
     def put_blob(self, blob_id: str, blob: T.BlobInfo):
+        self._failpoint()
         self._set(self._bkey(blob_id), blob.to_json())
 
     def get_artifact(self, artifact_id: str) -> Optional[dict]:
-        raw = self.client.command("GET", self._akey(artifact_id))
-        return json.loads(raw) if raw is not None else None
+        self._failpoint()
+        return self._get_json(self._akey(artifact_id))
 
     def get_blob(self, blob_id: str) -> Optional[T.BlobInfo]:
-        raw = self.client.command("GET", self._bkey(blob_id))
-        return blob_from_json(json.loads(raw)) if raw is not None \
-            else None
+        self._failpoint()
+        j = self._get_json(self._bkey(blob_id))
+        if j is None:
+            return None
+        METRICS.inc("trivy_tpu_fleet_cache_hits_total", backend="redis")
+        return blob_from_json(j)
 
     def missing_blobs(self, artifact_id: str, blob_ids: list[str]
                       ) -> tuple[bool, list[str]]:
+        self._failpoint()
         missing = [b for b in blob_ids
                    if not self.client.command("EXISTS", self._bkey(b))]
         missing_artifact = not self.client.command(
@@ -151,6 +215,7 @@ class RedisCache:
         return missing_artifact, missing
 
     def delete_blobs(self, blob_ids: list[str]):
+        self._failpoint()
         for b in blob_ids:
             self.client.command("DEL", self._bkey(b))
 

@@ -9,6 +9,18 @@ with HEAD. Speaks the S3 REST API through the existing sigv4 signer
 
 A custom ``endpoint`` supports MinIO/localstack and the fake server in
 tests.
+
+Fleet-production semantics (the FSCache contract from PR 5):
+
+  * puts are atomic — an S3 PUT is a conditional whole-object write:
+    the key serves either the previous body or the complete new one,
+    never a truncation (the object-store analogue of FSCache's
+    write-then-rename);
+  * a corrupt entry QUARANTINES on read: the raw bytes are copied to
+    ``fanal/corrupt/...`` (forensics), the original is deleted
+    best-effort, and the read serves a miss so the layer re-analyzes;
+  * every IO method fires the ``cache.s3`` failpoint, the chaos
+    stand-in for a dead or partitioned shared backend.
 """
 
 from __future__ import annotations
@@ -20,10 +32,15 @@ from typing import Optional
 
 from .. import types as T
 from ..cloud.aws import AWSClient, AWSError
+from ..log import get as _get_logger
+from ..metrics import METRICS
 from .cache import blob_from_json
+
+_log = _get_logger("fanal.cache.s3")
 
 ARTIFACT_DIR = "fanal/artifact"
 BLOB_DIR = "fanal/blob"
+CORRUPT_DIR = "fanal/corrupt"
 
 
 class S3CacheError(Exception):
@@ -48,6 +65,11 @@ class S3Cache:
         except AWSError as e:
             raise S3CacheError(str(e)) from None
 
+    @staticmethod
+    def _failpoint():
+        from ..resilience import failpoint
+        failpoint("cache.s3")
+
     def _key(self, kind: str, ident: str) -> str:
         # raw path — the sigv4 signer canonical-encodes it exactly once
         # (pre-quoting here would double-encode and break the signature
@@ -57,6 +79,7 @@ class S3Cache:
         return "/" + self.bucket + "/" + "/".join(parts)
 
     def _put(self, kind: str, ident: str, doc: dict):
+        self._failpoint()
         body = json.dumps(doc, sort_keys=True).encode()
         try:
             self.client.request("s3", "PUT", self._key(kind, ident),
@@ -65,6 +88,7 @@ class S3Cache:
             raise S3CacheError(f"put {kind}/{ident}: {e}") from None
 
     def _get(self, kind: str, ident: str) -> Optional[dict]:
+        self._failpoint()
         try:
             raw = self.client.request("s3", "GET",
                                       self._key(kind, ident))
@@ -74,10 +98,29 @@ class S3Cache:
             raise S3CacheError(f"get {kind}/{ident}: {e}") from None
         try:
             return json.loads(raw)
-        except json.JSONDecodeError:
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError):
+            self._quarantine(kind, ident, raw)
             return None
 
+    def _quarantine(self, kind: str, ident: str, raw: bytes) -> None:
+        """Move a corrupt entry out of the read path: keep the bytes
+        under fanal/corrupt/ for forensics, delete the original so
+        every replica sharing this bucket sees a clean miss. Both
+        writes are best-effort — a failed quarantine still serves the
+        miss (the next reader retries the move)."""
+        quarantine = self._key(
+            CORRUPT_DIR, f"{kind.rsplit('/', 1)[-1]}/{ident}")
+        try:
+            self.client.request("s3", "PUT", quarantine, body=raw)
+            self.client.request("s3", "DELETE",
+                                self._key(kind, ident))
+        except AWSError:
+            pass
+        _log.warning("quarantined corrupt cache entry %s/%s → %s "
+                     "(serving a miss)", kind, ident, quarantine)
+
     def _exists(self, kind: str, ident: str) -> bool:
+        self._failpoint()
         try:
             self.client.request("s3", "HEAD", self._key(kind, ident))
             return True
@@ -99,7 +142,10 @@ class S3Cache:
 
     def get_blob(self, blob_id: str) -> Optional[T.BlobInfo]:
         doc = self._get(BLOB_DIR, blob_id)
-        return blob_from_json(doc) if doc is not None else None
+        if doc is None:
+            return None
+        METRICS.inc("trivy_tpu_fleet_cache_hits_total", backend="s3")
+        return blob_from_json(doc)
 
     def missing_blobs(self, artifact_id: str,
                       blob_ids: list[str]) -> tuple[bool, list[str]]:

@@ -60,6 +60,16 @@ CONFIG_PATHS = {
     "mesh_min_devices": "mesh.min-devices",
     "mesh_rebuild_cooldown_ms": "mesh.rebuild-cooldown-ms",
     "mesh_probe_timeout_ms": "mesh.probe-timeout-ms",
+    # graftfleet (fleet.* / cache.*): scan router + shared backends
+    "cache_backend": "cache.backend",
+    "replicas": "fleet.replicas",
+    "ring_vnodes": "fleet.ring-vnodes",
+    "replica_timeout_ms": "fleet.replica-timeout-ms",
+    "replica_fail_threshold": "fleet.replica-fail-threshold",
+    "replica_reset_ms": "fleet.replica-reset-ms",
+    "replica_probe_interval_ms": "fleet.replica-probe-interval-ms",
+    "replica_probe_timeout_ms": "fleet.replica-probe-timeout-ms",
+    "route_retries": "fleet.route-retries",
 }
 
 _TRUE = {"1", "t", "true", "yes", "on"}

@@ -1,0 +1,37 @@
+"""graftfleet — a horizontal serving tier: scan router, shared cache
+backends, and replica fault domains.
+
+Everything below the fleet scales *up* (one process, one mesh); this
+package scales *out*. Three parts, layered on the serving spine (see
+ARCHITECTURE.md "Serving tier (graftfleet)"):
+
+  ring        consistent-hash ring with virtual nodes: artifacts map
+              to replicas by key hash, and losing a replica remaps
+              ONLY that replica's keys (its arc spreads over the
+              survivors) instead of reshuffling the world;
+  supervisor  per-replica fault domains — one CircuitBreaker per
+              replica (resilience.BreakerRegistry, meshguard's
+              pattern one level up), /healthz probe readmission once
+              a lost replica's breaker admits the half-open probe;
+  router      the Twirp front end clients point at unchanged: routes
+              each RPC to the owning replica, fails over along the
+              ring on replica faults, honors 429/503 + Retry-After
+              admission sheds via the shared RetryPolicy, and
+              propagates X-Trivy-Deadline-Ms so no retry ever
+              outlives the client's budget.
+
+The router is stateless by design: replicas share per-layer analysis
+through a common cache backend (fanal redis/s3 behind the FSCache
+interface), so a layer analyzed by one replica is a cache hit on all
+of them and a failover Scan finds its blobs wherever it lands.
+"""
+
+from .ring import HashRing
+from .router import (RouterOptions, RouterState, serve_router,
+                     serve_router_background)
+from .supervisor import ReplicaOptions, ReplicaSet
+
+__all__ = [
+    "HashRing", "ReplicaOptions", "ReplicaSet", "RouterOptions",
+    "RouterState", "serve_router", "serve_router_background",
+]

@@ -1,0 +1,356 @@
+"""Scan router: the Twirp front end of a replica fleet.
+
+Clients point at the router UNCHANGED — it speaks the same
+`/twirp/trivy.{scanner,cache}.v1.*` routes (both JSON and binary
+encodings), plus `/healthz`, `/version`, and `/metrics`. Each RPC is
+keyed by its artifact (artifact_id, or diff_id for PutBlob) and
+forwarded to the consistent-hash ring's owner; on a replica fault the
+request fails over along `ring.successors(key)` while the replica's
+own fault domain (supervisor.ReplicaSet) opens and background
+`/healthz` probes readmit it.
+
+Policy, per request:
+
+  * 2xx              relay; the replica's breaker records a success.
+  * 429/503          an admission shed from PR 4's queue — the replica
+                     is healthy but busy, so its breaker is NOT
+                     charged; the router tries the ring's next
+                     replica, and when every replica sheds it sleeps
+                     a RetryPolicy delay floored at the smallest
+                     Retry-After before re-walking, up to the
+                     retry budget.
+  * other 4xx        the client's error, relayed terminally (the
+                     replica answered; its breaker records a success).
+  * 5xx / conn error charge the replica's fault domain, fail over.
+  * deadline         X-Trivy-Deadline-Ms is re-stamped with the
+                     REMAINING budget on every forward, each forward's
+                     socket timeout is bounded by it, and no failover
+                     or backoff sleep ever starts past it — an
+                     exhausted budget returns 504 immediately.
+
+The router holds no scan state: replicas share layer analysis through
+a common cache backend (fanal redis/s3), so a failover Scan finds its
+blobs wherever it lands.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import __version__
+from ..log import get as _get_logger
+from ..metrics import METRICS
+from ..resilience import Deadline, FailpointError, RetryPolicy, failpoint
+from ..server import (DEADLINE_HEADER, ROUTE_DESCRIPTORS, TOKEN_HEADER,
+                      TRACE_HEADER)
+from .ring import HashRing
+from .supervisor import ReplicaOptions, ReplicaSet
+
+_log = _get_logger("fleet.router")
+
+# request headers forwarded verbatim to the replica (the deadline
+# header is re-stamped with the remaining budget instead)
+_FORWARD_HEADERS = ("Content-Type", TOKEN_HEADER, TRACE_HEADER)
+# replica response headers relayed back to the client
+_RELAY_HEADERS = ("Content-Type", "Retry-After", TRACE_HEADER)
+
+
+@dataclass
+class RouterOptions:
+    """Router knobs (CLI `router` flags)."""
+    vnodes: int = 64                  # ring points per replica
+    replica_timeout_s: float = 60.0   # per-forward socket bound
+    retry: RetryPolicy = field(default_factory=lambda: RetryPolicy(
+        attempts=3, base_delay_s=0.05, max_delay_s=1.0, budget_s=10.0))
+    replica: ReplicaOptions = field(default_factory=ReplicaOptions)
+
+
+class _Unrouted(RuntimeError):
+    """One full ring walk produced no relayable response. `shed` holds
+    the best 429/503 to relay if the retry budget runs out; `floor` is
+    the smallest Retry-After seen (0.0 when no replica shed)."""
+
+    def __init__(self, floor: float, shed=None):
+        super().__init__(f"no replica answered (floor={floor:g}s)")
+        self.floor = floor
+        self.shed = shed
+
+
+class RouterState:
+    def __init__(self, replicas, opts: RouterOptions | None = None,
+                 probe=None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.opts = opts or RouterOptions()
+        self.replicas = [r.rstrip("/") for r in replicas]
+        self.ring = HashRing(self.replicas, vnodes=self.opts.vnodes)
+        self.supervisor = ReplicaSet(self.replicas, self.opts.replica,
+                                     probe=probe)
+
+    def status(self) -> dict:
+        """→ /healthz payload."""
+        return {
+            "status": "ok",
+            "fleet": {
+                "ring": {"replicas": self.ring.nodes(),
+                         "vnodes": self.ring.vnodes},
+                **self.supervisor.status(),
+                "failovers_total": int(
+                    METRICS.get("trivy_tpu_fleet_failovers_total")),
+            },
+        }
+
+    def close(self) -> None:
+        self.supervisor.close()
+
+
+def route_key(path: str, req: dict) -> str:
+    """The ring key for one decoded request: the artifact when the
+    RPC names one, the blob otherwise — so an artifact's MissingBlobs,
+    PutArtifact, and Scan all land on the same replica (its per-layer
+    work stays local even without a shared backend), and PutBlob
+    spreads by layer digest."""
+    return req.get("artifact_id") or req.get("diff_id") \
+        or req.get("target") or path
+
+
+class RouterHandler(BaseHTTPRequestHandler):
+    state: RouterState = None  # set by serve_router()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    # ---- plumbing ------------------------------------------------------
+
+    def _send(self, code: int, body: bytes, headers: dict) -> None:
+        self.send_response(code)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, payload: dict) -> None:
+        self._send(code, json.dumps(payload).encode(),
+                   {"Content-Type": "application/json"})
+
+    def _relay(self, resp) -> None:
+        code, headers, body = resp
+        out = {k: headers[k] for k in _RELAY_HEADERS if headers.get(k)}
+        self._send(code, body, out)
+
+    # ---- GET surface ---------------------------------------------------
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            if "text/plain" in (self.headers.get("Accept") or ""):
+                self._send(200, b"ok", {"Content-Type": "text/plain"})
+            else:
+                self._json(200, self.state.status())
+        elif self.path == "/version":
+            self._json(200, {"Version": __version__})
+        elif self.path == "/metrics":
+            self._send(200, METRICS.render().encode(),
+                       {"Content-Type": "text/plain; version=0.0.4"})
+        else:
+            self._json(404, {"code": "not_found", "msg": self.path})
+
+    # ---- POST surface --------------------------------------------------
+
+    def do_POST(self):
+        t0 = time.perf_counter()
+        try:
+            self._do_post()
+        finally:
+            METRICS.observe("trivy_tpu_fleet_router_latency_seconds",
+                            time.perf_counter() - t0)
+
+    def _do_post(self):
+        desc = ROUTE_DESCRIPTORS.get(self.path)
+        if desc is None:
+            return self._json(404, {"code": "bad_route",
+                                    "msg": self.path})
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            ctype = (self.headers.get("Content-Type") or "") \
+                .split(";")[0]
+            if ctype in ("application/protobuf",
+                         "application/x-protobuf"):
+                from ..server.protowire import decode_msg
+                req = decode_msg(body, desc)
+            else:
+                req = json.loads(body or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return self._json(400, {"code": "malformed",
+                                    "msg": "bad body"})
+
+        hdr = self.headers.get(DEADLINE_HEADER)
+        deadline = Deadline(None)
+        if hdr:
+            try:
+                deadline = Deadline(max(float(hdr), 0.0) / 1e3)
+            except ValueError:
+                pass   # unparseable header: no deadline
+        fwd = {k: self.headers[k] for k in _FORWARD_HEADERS
+               if self.headers.get(k)}
+        resp = self._route(route_key(self.path, req), body, fwd,
+                           deadline)
+        self._relay(resp)
+
+    def _route(self, key: str, body: bytes, fwd_headers: dict,
+               deadline: Deadline):
+        """→ (status, headers, body) to relay. Walks the ring's
+        failover order under the RetryPolicy; every decision is
+        bounded by the client's deadline."""
+        st = self.state
+        # forwards beyond a request's first are failovers, counted
+        # across retry rounds (the counter the bench scenario reads)
+        forwards = [0]
+
+        def attempt():
+            return self._walk_ring(key, body, fwd_headers, deadline,
+                                   forwards)
+
+        def should_retry(e):
+            if isinstance(e, _Unrouted) \
+                    and deadline.remaining() > e.floor:
+                return e.floor
+            return None
+
+        try:
+            return st.opts.retry.call(attempt,
+                                      should_retry=should_retry)
+        except _Unrouted as e:
+            if deadline.expired():
+                return self._deadline_response()
+            if e.shed is not None:
+                # every replica shed: relay the least-loaded shed
+                # (smallest Retry-After) so the client backs off the
+                # way single-server admission control taught it to
+                return e.shed
+            reset_s = st.opts.replica.reset_timeout_ms / 1e3
+            return (503, {"Content-Type": "application/json",
+                          "Retry-After": str(max(1, int(reset_s + 0.999)))},
+                    json.dumps({"code": "unavailable",
+                                "msg": "no replica available"}).encode())
+
+    def _deadline_response(self):
+        return (504, {"Content-Type": "application/json"},
+                json.dumps({"code": "deadline_exceeded",
+                            "msg": "client deadline exhausted before "
+                                   "a replica answered"}).encode())
+
+    def _walk_ring(self, key, body, fwd_headers, deadline, forwards):
+        """One pass over the failover order. Returns a relayable
+        response or raises _Unrouted."""
+        st = self.state
+        shed = None
+        shed_floor = float("inf")
+        successors = st.ring.successors(key)
+        owner = successors[0] if successors else None
+        for replica in successors:
+            if not st.supervisor.available(replica):
+                continue
+            remaining = deadline.remaining()
+            if remaining <= 0:
+                return self._deadline_response()
+            forwards[0] += 1
+            # a failover = any forward past the ring owner — an
+            # earlier replica faulted/shed this request, OR the owner
+            # itself is a lost domain being walked past
+            if forwards[0] > 1 or replica != owner:
+                METRICS.inc("trivy_tpu_fleet_failovers_total")
+            try:
+                failpoint("rpc.route")
+                resp = self._forward(replica, body, fwd_headers,
+                                     timeout=min(
+                                         st.opts.replica_timeout_s,
+                                         remaining), deadline=deadline)
+            except urllib.error.HTTPError as e:
+                resp_body = e.read()
+                headers = {k: e.headers[k] for k in _RELAY_HEADERS
+                           if e.headers.get(k)}
+                if e.code in (429, 503):
+                    # admission shed: healthy-but-busy, not a fault —
+                    # remember the least-loaded shed and keep walking
+                    try:
+                        ra = float(e.headers.get("Retry-After") or 1.0)
+                    except ValueError:
+                        ra = 1.0
+                    if ra < shed_floor:
+                        shed_floor = ra
+                        shed = (e.code, headers, resp_body)
+                    continue
+                if 400 <= e.code < 500:
+                    # the replica answered; the CLIENT is wrong —
+                    # terminal relay, no failover, domain healthy
+                    st.supervisor.record_success(replica)
+                    return (e.code, headers, resp_body)
+                st.supervisor.record_failure(replica)
+                _log.warning("fleet: replica %s returned %d; failing "
+                             "over", replica, e.code)
+                continue
+            except (urllib.error.URLError, OSError,
+                    FailpointError) as e:
+                st.supervisor.record_failure(replica)
+                _log.warning("fleet: replica %s unreachable (%s); "
+                             "failing over", replica, e)
+                continue
+            st.supervisor.record_success(replica)
+            return resp
+        raise _Unrouted(0.0 if shed is None else shed_floor, shed)
+
+    def _forward(self, replica: str, body: bytes, fwd_headers: dict,
+                 timeout: float, deadline: Deadline):
+        headers = dict(fwd_headers)
+        if deadline.at is not None:
+            # re-stamp the REMAINING budget: the replica's admission
+            # queue must never park this request past what the client
+            # has left, not what it originally had
+            headers[DEADLINE_HEADER] = str(
+                max(int(deadline.remaining() * 1e3), 1))
+        req = urllib.request.Request(replica + self.path, data=body,
+                                     headers=headers, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.headers, r.read()
+
+
+def serve_router(host: str, port: int, replicas,
+                 opts: RouterOptions | None = None,
+                 ready_event: threading.Event | None = None):
+    """Run the router in the foreground (CLI `router` command)."""
+    state = RouterState(replicas, opts)
+    # per-server subclass (the listen.py pattern): a router and its
+    # replicas coexist in one process in tests/bench
+    handler = type("RouterHandler", (RouterHandler,), {"state": state})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        state.close()
+    return httpd
+
+
+def serve_router_background(host: str, port: int, replicas,
+                            opts: RouterOptions | None = None,
+                            probe=None):
+    """Start in a daemon thread; returns (httpd, state) once
+    listening. Callers own shutdown: `httpd.shutdown()` then
+    `state.close()`."""
+    state = RouterState(replicas, opts, probe=probe)
+    handler = type("RouterHandler", (RouterHandler,), {"state": state})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, state

@@ -1,0 +1,170 @@
+"""Replica fault domains — meshguard's pattern one level up.
+
+Each server replica gets its own CircuitBreaker (BreakerRegistry keyed
+by replica URL, exported as the labelled
+`trivy_tpu_fleet_replica_state{replica="<url>"}` gauge). A routed RPC
+that fails charges THAT replica's breaker; once the breaker leaves
+closed the replica is LOST and the router walks the ring past it. A
+maintenance thread runs readmission: once a lost replica's breaker
+admits its half-open probe, a successful `/healthz` round-trip closes
+the breaker and the replica rejoins the ring's ownership — its keys
+snap back (the ring never forgot them), caches still warm.
+
+Unlike meshguard there is no rebuild to coordinate: the ring is
+immutable and replicas are stateless against the shared cache backend,
+so losing one is pure routing. That keeps this supervisor a strict
+subset of the mesh one — breakers, a lost set, and a probe loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.request
+from dataclasses import dataclass
+
+from ..log import get as _get_logger
+from ..resilience.breaker import CLOSED
+from ..resilience.meshguard import BreakerRegistry
+
+_log = _get_logger("fleet")
+
+
+def replica_site(replica: str) -> str:
+    """Breaker/log name for one replica's fault domain."""
+    return f"fleet.replica:{replica}"
+
+
+def healthz_probe(replica: str, timeout_s: float) -> None:
+    """Default readmission probe: one `/healthz` round-trip (the plain
+    `ok` fast path). Any non-2xx or connection error raises."""
+    req = urllib.request.Request(replica.rstrip("/") + "/healthz",
+                                 headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        if r.status != 200:
+            raise RuntimeError(f"healthz returned {r.status}")
+
+
+@dataclass
+class ReplicaOptions:
+    """Replica fault-domain knobs (router flags --replica-fail-threshold,
+    --replica-reset-ms, --replica-probe-interval-ms,
+    --replica-probe-timeout-ms)."""
+    fail_threshold: int = 3           # errors that open a replica domain
+    reset_timeout_ms: float = 2000.0  # open → half-open probe window
+    probe_interval_ms: float = 200.0  # readmission loop cadence
+    probe_timeout_ms: float = 2000.0  # /healthz probe bound
+
+
+class ReplicaSet:
+    """Breaker registry + readmission loop over a set of replicas.
+
+    `probe(replica)` (injectable for tests) defaults to the /healthz
+    round-trip; it runs only on the maintenance thread, never on the
+    request path."""
+
+    def __init__(self, replicas, opts: ReplicaOptions | None = None,
+                 probe=None):
+        self.replicas = list(replicas)
+        self.opts = opts or ReplicaOptions()
+        self.registry = BreakerRegistry(
+            fail_threshold=self.opts.fail_threshold,
+            reset_timeout_s=self.opts.reset_timeout_ms / 1e3,
+            gauge="trivy_tpu_fleet_replica_state",
+            label="replica", name_fn=replica_site)
+        self._lock = threading.Lock()
+        self._lost: set[str] = set()
+        self._readmissions = 0
+        self._probe = probe
+        self._stop = threading.Event()
+        # eager breaker creation: every replica's state series exists
+        # from boot, so a scrape sees the full fleet, not just the
+        # replicas that have already faulted
+        for r in self.replicas:
+            self.registry.get(r)
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-readmit", daemon=True)
+        self._thread.start()
+
+    # ---- hot-path surface ---------------------------------------------
+
+    def available(self, replica: str) -> bool:
+        """May the router forward to this replica? Lost domains wait
+        for the probe loop — live traffic is never the half-open
+        probe (a request-sized probe against a sick replica would
+        burn a client's deadline on supervision)."""
+        with self._lock:
+            return replica not in self._lost
+
+    def record_failure(self, replica: str) -> None:
+        """Charge one routed-RPC failure to the replica's domain; once
+        its breaker leaves closed the replica is lost."""
+        br = self.registry.get(replica)
+        br.record_failure()
+        if br.state != CLOSED:
+            with self._lock:
+                if replica in self._lost or replica not in self.replicas:
+                    return
+                self._lost.add(replica)
+            _log.warning("fleet: replica %s lost; routing past it "
+                         "until a probe readmits", replica)
+
+    def record_success(self, replica: str) -> None:
+        self.registry.get(replica).record_success()
+
+    def lost(self) -> list[str]:
+        with self._lock:
+            return sorted(self._lost)
+
+    # ---- readmission loop ---------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.opts.probe_interval_ms / 1e3):
+            try:
+                self._probe_lost()
+            except Exception:   # the supervisor must never die
+                _log.exception("fleet readmission tick failed")
+
+    def _probe_lost(self) -> None:
+        with self._lock:
+            lost = sorted(self._lost)
+        for replica in lost:
+            br = self.registry.get(replica)
+            if not br.allow():
+                continue   # still inside the open window
+            try:
+                if self._probe is not None:
+                    self._probe(replica)
+                else:
+                    healthz_probe(replica,
+                                  self.opts.probe_timeout_ms / 1e3)
+            except Exception:
+                _log.warning("fleet: replica %s probe failed; domain "
+                             "stays open", replica, exc_info=True)
+                br.record_failure()
+                continue
+            br.record_success()
+            with self._lock:
+                self._lost.discard(replica)
+                self._readmissions += 1
+            _log.warning("fleet: replica %s readmitted", replica)
+
+    # ---- introspection / lifecycle ------------------------------------
+
+    def status(self) -> dict:
+        """→ router /healthz `fleet.replicas` payload."""
+        with self._lock:
+            lost = set(self._lost)
+            readmissions = self._readmissions
+        return {
+            "replicas": {
+                r: {**self.registry.get(r).status(),
+                    "lost": r in lost}
+                for r in self.replicas
+            },
+            "lost": sorted(lost),
+            "readmissions": readmissions,
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

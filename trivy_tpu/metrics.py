@@ -290,6 +290,26 @@ METRICS.declare(
     "trivy_tpu_mesh_device_lost_total", "counter",
     "Mesh devices expelled from their fault domain (watchdog trip or "
     "breaker threshold).")
+METRICS.declare(
+    "trivy_tpu_fleet_replica_state", "gauge",
+    "graftfleet per-replica fault domain: 0 closed, 1 open, 2 "
+    "half-open (one series per replica URL).")
+METRICS.declare(
+    "trivy_tpu_fleet_failovers_total", "counter",
+    "Forwards past a request's ring owner: an earlier replica in the "
+    "walk faulted or shed, or the owner is a lost domain (counted "
+    "per forward, so a sustained outage keeps counting).")
+METRICS.declare(
+    "trivy_tpu_fleet_cache_hits_total", "counter",
+    "Layer-cache blob hits by backend (backend=\"fs\"/\"redis\"/"
+    "\"s3\") — on a shared backend, a hit may be serving another "
+    "replica's analysis.")
+METRICS.declare(
+    "trivy_tpu_fleet_router_latency_seconds", "histogram",
+    "End-to-end router request latency (receive to relay, failovers "
+    "and backoff included).",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
 METRICS.declare("trivy_tpu_secret_files_total", "counter",
                 "Files through the secret scanner.")
 METRICS.declare("trivy_tpu_secret_bytes_total", "counter",

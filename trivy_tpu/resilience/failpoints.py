@@ -42,7 +42,10 @@ SITES = (
     "detect.device_get",  # detect/engine.py _fetch_bits (result fetch)
     "detect.compile",     # detect/engine.py _launch, new-shape compiles
     "cache.backend",      # fanal/cache.py FSCache blob/artifact IO
+    "cache.redis",        # fanal/redis_cache.py shared-backend IO
+    "cache.s3",           # fanal/s3_cache.py shared-backend IO
     "rpc.scan",           # server/listen.py Scan handler
+    "rpc.route",          # fleet/router.py per-replica forward
     "db.download",        # db/download.py OCI artifact pull
 )
 
@@ -57,8 +60,10 @@ FAMILIES = (
 
 MODES = ("error", "hang", "slow", "flaky")
 
+# site part allows digits after the first letter (`cache.s3`); the
+# closed catalog (known_site) still rejects typos at parse time
 _SPEC_RE = re.compile(
-    r"^(?P<site>[a-z_.]+(?::[a-z0-9_]+)?)=(?P<mode>[a-z]+)"
+    r"^(?P<site>[a-z][a-z0-9_.]*(?::[a-z0-9_]+)?)=(?P<mode>[a-z]+)"
     r"(?:[:(](?P<arg>[0-9.]+)(?:[:,](?P<seed>\d+))?\)?)?$")
 
 

@@ -75,15 +75,25 @@ class MeshDomainError(DeviceError):
 
 class BreakerRegistry:
     """Per-site circuit breakers, lazily created. Each breaker exports
-    the labelled mesh-breaker gauge so /metrics shows every device's
-    domain state (0 closed, 1 open, 2 half-open)."""
+    a labelled state gauge so /metrics shows every domain's state
+    (0 closed, 1 open, 2 half-open).
+
+    The default shape is meshguard's (`detect.mesh:<id>` names, the
+    mesh-breaker gauge labelled by device id); graftfleet reuses the
+    registry one level up with its own gauge/label (`replica="<url>"`)
+    — same per-domain accounting, per replica instead of per chip."""
 
     def __init__(self, fail_threshold: int = 3,
-                 reset_timeout_s: float = 5.0):
+                 reset_timeout_s: float = 5.0,
+                 gauge: str = "trivy_tpu_mesh_breaker_state",
+                 label: str = "device", name_fn=None):
         self._lock = threading.Lock()
         self._breakers: dict = {}
         self.fail_threshold = fail_threshold
         self.reset_timeout_s = reset_timeout_s
+        self.gauge = gauge
+        self.label = label
+        self._name_fn = name_fn if name_fn is not None else mesh_site
 
     def get(self, key) -> CircuitBreaker:
         with self._lock:
@@ -92,9 +102,9 @@ class BreakerRegistry:
                 br = CircuitBreaker(
                     fail_threshold=self.fail_threshold,
                     reset_timeout_s=self.reset_timeout_s,
-                    name=mesh_site(key),
-                    gauge="trivy_tpu_mesh_breaker_state",
-                    gauge_labels={"device": str(key)})
+                    name=self._name_fn(key),
+                    gauge=self.gauge,
+                    gauge_labels={self.label: str(key)})
                 self._breakers[key] = br
         return br
 

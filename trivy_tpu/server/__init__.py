@@ -16,3 +16,14 @@ TRACE_HEADER = "X-Trivy-Trace-Id"
 # to wait, queue time included — the admission queue never parks a
 # handler thread past it (the client stamps its own timeout here)
 DEADLINE_HEADER = "X-Trivy-Deadline-Ms"
+
+# request-message descriptor per Twirp route (binary encoding) —
+# shared by the server handler and the graftfleet router, which must
+# stay importable without the server stack (listen → scanner → jax)
+ROUTE_DESCRIPTORS = {
+    "/twirp/trivy.scanner.v1.Scanner/Scan": "ScanRequest",
+    "/twirp/trivy.cache.v1.Cache/PutArtifact": "PutArtifactRequest",
+    "/twirp/trivy.cache.v1.Cache/PutBlob": "PutBlobRequest",
+    "/twirp/trivy.cache.v1.Cache/MissingBlobs": "MissingBlobsRequest",
+    "/twirp/trivy.cache.v1.Cache/DeleteBlobs": "DeleteBlobsRequest",
+}

@@ -55,13 +55,27 @@ class TwirpError(RuntimeError):
 class _Base:
     def __init__(self, base_url: str, token: str = "", timeout: float = 60,
                  retry=None):
-        self.base_url = base_url.rstrip("/")
+        # fleet awareness: a comma-separated URL list fails over
+        # client-side — point at several routers (or at the replicas
+        # directly in a routerless deployment) and the client walks
+        # past an unreachable one, remembering the base that answered
+        # so steady-state traffic doesn't re-probe a dead endpoint
+        self.bases = [u.strip().rstrip("/")
+                      for u in base_url.split(",") if u.strip()]
+        if not self.bases:
+            raise ValueError("empty server url")
+        self._base_idx = 0
         self.token = token
         self.timeout = timeout
         self.retry = retry  # None → the shared lazy DEFAULT_RETRY
 
+    @property
+    def base_url(self) -> str:
+        """The currently-preferred endpoint (first of `bases` until a
+        failover promotes another)."""
+        return self.bases[self._base_idx % len(self.bases)]
+
     def _call(self, service: str, method: str, payload: dict) -> dict:
-        url = f"{self.base_url}/twirp/{service}/{method}"
         body = json.dumps(payload).encode()
         # forward the active graftscope trace id so client and server
         # spans/logs correlate (the server mints one when absent)
@@ -72,20 +86,45 @@ class _Base:
             **({TRACE_HEADER: tid} if tid else {}),
             **({TOKEN_HEADER: self.token} if self.token else {}),
         }
+        policy = self.retry or _default_retry()
 
         def attempt() -> dict:
-            req = urllib.request.Request(url, data=body, method="POST",
-                                         headers=headers)
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                return json.loads(r.read() or b"{}")
+            # one pass over the base list: a connection error moves to
+            # the NEXT base immediately (failover before backoff — a
+            # dead endpoint must cost one connect, not a retry
+            # budget); only a whole failed walk is retried by the
+            # policy. HTTPErrors propagate: the endpoint answered, so
+            # 429/503 retry per the hint and the rest are terminal.
+            last: Exception | None = None
+            for hop in range(len(self.bases)):
+                idx = (self._base_idx + hop) % len(self.bases)
+                url = f"{self.bases[idx]}/twirp/{service}/{method}"
+                req = urllib.request.Request(url, data=body,
+                                             method="POST",
+                                             headers=headers)
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=self.timeout) as r:
+                        result = json.loads(r.read() or b"{}")
+                except urllib.error.HTTPError:
+                    raise
+                except urllib.error.URLError as e:
+                    last = e
+                    continue   # unreachable: try the next base
+                self._base_idx = idx
+                return result
+            raise last
 
-        policy = self.retry or _default_retry()
         try:
             return policy.call(attempt, should_retry=_retry_hint())
         except urllib.error.HTTPError as e:
+            # the endpoint ANSWERED: a Twirp error is terminal, not a
+            # reason to re-run a scan against another base
             detail = e.read().decode(errors="replace")
             try:
                 j = json.loads(detail)
+                if not isinstance(j, dict):   # valid-but-non-object
+                    raise ValueError("non-object error body")
                 raise TwirpError(j.get("code", str(e.code)),
                                  j.get("msg", detail)) from None
             except (ValueError, json.JSONDecodeError):

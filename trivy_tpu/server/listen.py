@@ -23,7 +23,7 @@ from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import __version__, types as T
-from ..fanal.cache import FSCache, blob_from_json
+from ..fanal.cache import blob_from_json
 from ..log import get as _get_logger
 from ..obs import device_status, new_trace, span
 from ..resilience import (AdmissionQueue, Deadline, GUARD, Shed,
@@ -32,7 +32,8 @@ from ..scanner import LocalScanner
 # wire-header names live in the package __init__ so the CLIENT can
 # import them without pulling in this module's server stack;
 # re-exported here for the existing `listen.TOKEN_HEADER` readers
-from . import DEADLINE_HEADER, TOKEN_HEADER, TRACE_HEADER  # noqa: F401
+from . import (DEADLINE_HEADER, ROUTE_DESCRIPTORS,  # noqa: F401
+               TOKEN_HEADER, TRACE_HEADER)
 
 _log = _get_logger("server")
 
@@ -54,14 +55,11 @@ class ServerState:
                  cache_backend: str = "fs", detect_opts=None,
                  admission=None, mesh_opts: MeshOptions | None = None):
         from ..detect.sched import SchedOptions
-        if cache_backend.startswith("redis://"):
-            from ..fanal.redis_cache import RedisCache
-            self.cache = RedisCache(cache_backend)
-        elif cache_backend.startswith("s3://"):
-            from ..fanal.s3_cache import S3Cache
-            self.cache = S3Cache(cache_backend)
-        else:
-            self.cache = FSCache(cache_dir)
+        from ..fanal.cache import open_cache
+        # one backend-selection path (fanal.cache.open_cache) shared
+        # with the CLI: fs | memory | redis:// | s3:// — the shared
+        # backends are what make a replica fleet cache-coherent
+        self.cache = open_cache(cache_backend, cache_dir)
         self.token = token
         self._lock = threading.Lock()
         # server mode runs detectd by default: concurrent RPCs'
@@ -439,15 +437,10 @@ class Handler(BaseHTTPRequestHandler):
             return self._proto(200, payload, desc)
         return self._json(200, payload)
 
-    # request-message descriptor per route (binary Twirp)
-    _ROUTES = {
-        "/twirp/trivy.scanner.v1.Scanner/Scan": "ScanRequest",
-        "/twirp/trivy.cache.v1.Cache/PutArtifact": "PutArtifactRequest",
-        "/twirp/trivy.cache.v1.Cache/PutBlob": "PutBlobRequest",
-        "/twirp/trivy.cache.v1.Cache/MissingBlobs":
-            "MissingBlobsRequest",
-        "/twirp/trivy.cache.v1.Cache/DeleteBlobs": "DeleteBlobsRequest",
-    }
+    # request-message descriptor per route (binary Twirp); the map
+    # itself lives in the package __init__ so the fleet router shares
+    # it without importing this module's server stack
+    _ROUTES = ROUTE_DESCRIPTORS
 
     def do_POST(self):
         st = self.state
@@ -605,8 +598,11 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
     state = ServerState(table, cache_dir, token, cache_backend,
                         detect_opts=detect_opts, admission=admission,
                         mesh_opts=mesh_opts)
-    Handler.state = state
-    httpd = ThreadingHTTPServer((host, port), Handler)
+    # per-server Handler subclass: `state` must not live on the shared
+    # base class, or two in-process replicas (the fleet tests/bench)
+    # would serve each other's caches and scanners
+    handler = type("Handler", (Handler,), {"state": state})
+    httpd = ThreadingHTTPServer((host, port), handler)
     if ready_event is not None:
         ready_event.set()
     try:
@@ -623,16 +619,20 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
 
 
 def serve_background(host: str, port: int, table, cache_dir: str,
-                     token: str = "", detect_opts=None,
-                     admission=None, mesh_opts=None):
+                     token: str = "", cache_backend: str = "fs",
+                     detect_opts=None, admission=None, mesh_opts=None):
     """Start in a daemon thread; returns (httpd, state) once listening.
     Callers own shutdown: `httpd.shutdown()` then `state.close()` (the
-    detect engine's worker threads are non-daemon)."""
-    Handler.state = ServerState(table, cache_dir, token,
-                                detect_opts=detect_opts,
-                                admission=admission,
-                                mesh_opts=mesh_opts)
-    httpd = ThreadingHTTPServer((host, port), Handler)
+    detect engine's worker threads are non-daemon). `cache_backend`
+    picks the fanal cache (fs | memory | redis:// | s3://) — fleet
+    tests and the bench point several replicas at one shared
+    redis/s3 URL."""
+    state = ServerState(table, cache_dir, token, cache_backend,
+                        detect_opts=detect_opts,
+                        admission=admission,
+                        mesh_opts=mesh_opts)
+    handler = type("Handler", (Handler,), {"state": state})
+    httpd = ThreadingHTTPServer((host, port), handler)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
-    return httpd, Handler.state
+    return httpd, state
