@@ -347,7 +347,37 @@ def bench_archive_e2e(table):
         t0 = time.perf_counter()
         hits = sum(scan_one(p) for p in paths[1:])
         dt = time.perf_counter() - t0
-    return (ARCHIVE_IMAGES - 1) / dt, hits
+        # graftwatch attribution pass (UNTIMED — recording arms the
+        # detect engine's fence): a subset re-scan under the collector
+        # yields the walker/analyzer/applier split ROADMAP item 1's
+        # fanal-pipeline rebuild will be judged against
+        from trivy_tpu.obs import COLLECTOR
+        attr_paths = paths[:16]
+        COLLECTOR.enable()
+        try:
+            for p in attr_paths:
+                scan_one(p)
+            phase = COLLECTOR.phase_totals()
+        finally:
+            COLLECTOR.disable()
+
+    def ms(name):
+        return phase.get(name, {}).get("total_ms", 0.0)
+
+    analyzer_ms = ms("fanal.analyze")
+    breakdown = {
+        # walker = tar enumeration + file reads, net of the analyzer
+        # dispatches nested inside the walk spans
+        "walker_ms": round(max(ms("fanal.walk_tar") - analyzer_ms, 0.0),
+                           3),
+        "analyzer_ms": round(analyzer_ms, 3),
+        "applier_ms": round(ms("fanal.apply_layers"), 3),
+        "cache_check_ms": round(ms("fanal.cache_check"), 3),
+        "detect_ms": round(ms("scan.detect"), 3),
+        "assemble_results_ms": round(ms("scan.assemble_results"), 3),
+        "images": len(attr_paths),
+    }
+    return (ARCHIVE_IMAGES - 1) / dt, hits, breakdown
 
 
 def bench_server(table, clients=SERVER_CLIENTS, images=SERVER_IMAGES,
@@ -1155,8 +1185,11 @@ def main():
         except Exception as e:
             diag.append(f"server_fleet bench failed: {e}")
         try:
-            arch_ips, _arch_hits = bench_archive_e2e(table)
+            arch_ips, _arch_hits, arch_phase = bench_archive_e2e(table)
             result["images_per_sec_archive_e2e"] = round(arch_ips, 1)
+            # the walker/analyzer/applier attribution baseline the
+            # fanal-pipeline rebuild (ROADMAP item 1) is judged against
+            result["archive_phase_ms"] = arch_phase
         except Exception as e:
             diag.append(f"archive e2e bench failed: {e}")
 

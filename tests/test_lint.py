@@ -752,3 +752,101 @@ def test_load_baseline_roundtrip(tmp_path):
     p.write_text(json.dumps({"suppressions": [
         {"fingerprint": "abc123", "reason": "r"}]}))
     assert load_baseline(str(p)) == {"abc123"}
+
+
+# ---------------------------------------------------------------------------
+# TPU109 — metric hygiene (graftwatch satellite)
+
+def _metric_catalog():
+    from trivy_tpu.analysis import metrics_catalog as mc
+    return mc.load_catalog(
+        'from trivy_tpu.metrics import METRICS\n'
+        'METRICS.declare("t_scans_total", "counter", "Scans.")\n'
+        'METRICS.declare("t_lat_seconds", "histogram", "Latency.",\n'
+        '                buckets=(0.1, 1.0))\n'
+        'METRICS.declare("t_depth", "gauge", "Depth.")\n')
+
+
+def test_tpu109_catalog_loader_parses_declares():
+    cat = _metric_catalog()
+    assert {n: s.kind for n, s in cat.items()} == {
+        "t_scans_total": "counter", "t_lat_seconds": "histogram",
+        "t_depth": "gauge"}
+    assert cat["t_scans_total"].help == "Scans."
+
+
+def test_tpu109_undeclared_series_detected():
+    from trivy_tpu.analysis.metrics_catalog import lint_metric_calls
+    src = (
+        "from ..metrics import METRICS\n"
+        "def f():\n"
+        "    METRICS.inc('t_scans_total')\n"        # declared: ok
+        "    METRICS.inc('t_typo_total')\n"         # undeclared
+        "    METRICS.observe('t_nope_seconds', 1)\n"  # undeclared
+    )
+    fs = list(lint_metric_calls("trivy_tpu/x.py", src,
+                                _metric_catalog()))
+    assert [(f.rule, f.line) for f in fs] == [("TPU109", 4),
+                                              ("TPU109", 5)]
+    assert "not declared" in fs[0].message
+
+
+def test_tpu109_method_type_mismatch_detected():
+    from trivy_tpu.analysis.metrics_catalog import lint_metric_calls
+    src = (
+        "from ..metrics import METRICS\n"
+        "METRICS.inc('t_lat_seconds')\n"        # histogram via inc
+        "METRICS.observe('t_depth', 2.0)\n"     # gauge via observe
+        "METRICS.set_gauge('t_scans_total', 1)\n"  # counter via gauge
+        "METRICS.gauge_add('t_depth', 1)\n"     # ok
+        "METRICS.observe('t_lat_seconds', 1)\n"  # ok
+        "METRICS.get('t_depth')\n"              # read of declared: ok
+    )
+    fs = list(lint_metric_calls("trivy_tpu/x.py", src,
+                                _metric_catalog()))
+    assert [(f.rule, f.line) for f in fs] == [("TPU109", 2),
+                                              ("TPU109", 3),
+                                              ("TPU109", 4)]
+    assert "declares histogram" in fs[0].message
+
+
+def test_tpu109_dynamic_names_and_other_objects_skipped():
+    from trivy_tpu.analysis.metrics_catalog import lint_metric_calls
+    src = (
+        "from ..metrics import METRICS, Registry\n"
+        "r = Registry()\n"
+        "def f(name):\n"
+        "    METRICS.inc(name)\n"            # dynamic: out of reach
+        "    METRICS.set_gauge(f'{name}_x', 1)\n"  # dynamic
+        "    r.inc('t_not_in_catalog')\n"    # not the METRICS object
+    )
+    assert list(lint_metric_calls("trivy_tpu/x.py", src,
+                                  _metric_catalog())) == []
+
+
+def test_tpu109_real_catalog_is_complete_and_tree_conforms():
+    """The real metrics.py catalog must declare every series with a
+    literal type and help, and every literal call site under
+    trivy_tpu/ must conform (the rule also runs inside
+    test_tree_is_clean; this pins the engine specifically)."""
+    from trivy_tpu.analysis.metrics_catalog import (check_metric_hygiene,
+                                                    load_catalog)
+    cat = load_catalog()
+    assert len(cat) >= 25
+    assert all(s.kind in ("counter", "gauge", "histogram")
+               for s in cat.values())
+    assert all(s.help for s in cat.values())
+    assert check_metric_hygiene() == []
+
+
+def test_metrics_reference_in_architecture_is_current():
+    """The ARCHITECTURE.md metrics table is GENERATED from the
+    catalog: drift fails tier-1, exactly like a golden."""
+    from trivy_tpu.analysis import metrics_catalog as mc
+    with open(os.path.join(REPO, "ARCHITECTURE.md")) as f:
+        doc = f.read()
+    assert mc.DOC_BEGIN in doc and mc.DOC_END in doc
+    block = doc.split(mc.DOC_BEGIN, 1)[1].split(mc.DOC_END, 1)[0]
+    assert block.strip() == mc.render_markdown().strip(), (
+        "ARCHITECTURE.md metrics catalog drifted; regenerate with "
+        "trivy_tpu.analysis.metrics_catalog.render_markdown()")

@@ -39,6 +39,7 @@ from .registry import Finding, RULES, rules_for_engine  # noqa: F401
 # so this stays cheap); without this, --list-rules in a fresh process
 # would see an empty registry
 from . import astlint, crosscheck, jaxpr_check  # noqa: E402,F401
+from . import metrics_catalog  # noqa: E402,F401 — registers TPU109
 
 
 def run_all(root: str | None = None) -> list[Finding]:

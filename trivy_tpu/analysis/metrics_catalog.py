@@ -1,0 +1,181 @@
+"""TPU109 — metric hygiene: call sites must match the single catalog.
+
+trivy_tpu/metrics.py ends with the metric catalog: every series the
+pipeline emits, declared once with its name, type, and # HELP text.
+Nothing connected that catalog to the call sites until now — a typo'd
+series name silently creates a second family, an `inc()` against a
+histogram renders an unscrapeable exposition, and an undeclared series
+ships with no HELP and default buckets. This engine closes the loop:
+
+  * the catalog is parsed from metrics.py's AST (literal
+    `METRICS.declare(name, kind, help)` calls at module level);
+  * every `METRICS.<write>()` call site under trivy_tpu/ with a
+    literal series name must name a declared series, and the method
+    must match the declared type (inc → counter, observe → histogram,
+    set_gauge/gauge_add → gauge); reads (get/hist_get) must at least
+    name a declared series. Dynamic names (a variable, an f-string)
+    are out of static reach and skipped — the strict exposition parser
+    still gates their runtime shape in tier-1.
+
+The catalog doubles as the source of the generated metrics reference
+in ARCHITECTURE.md: `render_markdown()` emits the table between the
+`<!-- metrics-catalog:begin/end -->` markers, and a tier-1 test fails
+when the doc block drifts from the code (tests/test_lint.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+from .registry import Finding, register
+
+_REL = os.path.join("trivy_tpu", "metrics.py")
+
+# METRICS method → the declared type it may write to (None = read,
+# any declared type is fine)
+WRITE_METHODS = {
+    "inc": "counter",
+    "observe": "histogram",
+    "set_gauge": "gauge",
+    "gauge_add": "gauge",
+}
+READ_METHODS = ("get", "hist_get")
+
+
+@dataclass(frozen=True)
+class Series:
+    name: str
+    kind: str
+    help: str
+
+
+def metrics_source_path() -> str:
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(pkg_root, "metrics.py")
+
+
+def load_catalog(source: str | None = None) -> dict[str, Series]:
+    """Parse the catalog out of metrics.py (or the given source):
+    every literal `METRICS.declare(...)` call."""
+    if source is None:
+        with open(metrics_source_path(), encoding="utf-8") as f:
+            source = f.read()
+    catalog: dict[str, Series] = {}
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "declare"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "METRICS"):
+            continue
+        args = list(node.args)
+        kw = {k.arg: k.value for k in node.keywords}
+        name_node = args[0] if args else kw.get("name")
+        kind_node = args[1] if len(args) > 1 else kw.get("kind")
+        help_node = args[2] if len(args) > 2 else kw.get("help_text")
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            continue
+        kind = kind_node.value if isinstance(kind_node, ast.Constant) \
+            else ""
+        help_text = ""
+        if isinstance(help_node, ast.Constant):
+            help_text = str(help_node.value)
+        elif isinstance(help_node, ast.BinOp):
+            # implicit string concatenation parses as Constant; a
+            # non-literal help is unusual — keep it empty
+            help_text = ""
+        catalog[name_node.value] = Series(name_node.value, str(kind),
+                                          help_text)
+    return catalog
+
+
+def lint_metric_calls(relpath: str, source: str,
+                      catalog: dict[str, Series]):
+    """Yield TPU109 findings for one module's METRICS call sites."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return   # TPU100's problem, not ours
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "METRICS"):
+            continue
+        method = node.func.attr
+        if method not in WRITE_METHODS and method not in READ_METHODS:
+            continue
+        if not node.args:
+            continue
+        name_node = node.args[0]
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            continue   # dynamic name: out of static reach
+        name = name_node.value
+        series = catalog.get(name)
+        if series is None:
+            yield Finding(
+                "TPU109", relpath, node.lineno,
+                f"METRICS.{method}({name!r}): series not declared in "
+                f"the metrics.py catalog (name, type, help)", name)
+            continue
+        want = WRITE_METHODS.get(method)
+        if want is not None and series.kind != want:
+            yield Finding(
+                "TPU109", relpath, node.lineno,
+                f"METRICS.{method}({name!r}) writes a {want}, but the "
+                f"catalog declares {series.kind}", name)
+
+
+@register("TPU109", "metric-hygiene", "xcheck")
+def check_metric_hygiene() -> list[Finding]:
+    """Every METRICS series must be declared once in the metrics.py
+    catalog (name, type, help), and every literal call site under
+    trivy_tpu/ must name a declared series with a type-matching
+    method. The catalog is also the source of ARCHITECTURE.md's
+    generated metrics reference."""
+    from .astlint import iter_python_files
+    findings: list[Finding] = []
+    catalog = load_catalog()
+    # declarations themselves must be complete: a type-less or
+    # help-less declaration defeats the point of a catalog
+    for s in catalog.values():
+        if s.kind not in ("counter", "gauge", "histogram"):
+            findings.append(Finding(
+                "TPU109", _REL, 0,
+                f"catalog entry {s.name!r} has no literal type", s.name))
+        if not s.help:
+            findings.append(Finding(
+                "TPU109", _REL, 0,
+                f"catalog entry {s.name!r} has no help text", s.name))
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(pkg_root)
+    for path in iter_python_files(pkg_root):
+        rel = os.path.relpath(path, repo)
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_metric_calls(rel, source, catalog))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# generated metrics reference (ARCHITECTURE.md)
+
+DOC_BEGIN = "<!-- metrics-catalog:begin (generated by " \
+    "trivy_tpu.analysis.metrics_catalog — do not edit by hand) -->"
+DOC_END = "<!-- metrics-catalog:end -->"
+
+
+def render_markdown(catalog: dict[str, Series] | None = None) -> str:
+    """→ the markdown table for ARCHITECTURE.md, catalog-ordered."""
+    if catalog is None:
+        catalog = load_catalog()
+    lines = ["| series | type | help |", "|---|---|---|"]
+    for s in catalog.values():   # declaration order (py3.7+ dicts)
+        help_text = " ".join(s.help.split())
+        lines.append(f"| `{s.name}` | {s.kind} | {help_text} |")
+    return "\n".join(lines)

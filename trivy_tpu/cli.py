@@ -111,6 +111,34 @@ def _add_scan_flags(p: argparse.ArgumentParser):
                         "<cache-dir>/javadb/trivy-java.db when present")
 
 
+def _add_watch_flags(p: argparse.ArgumentParser):
+    """graftwatch knobs shared by the server and the router."""
+    p.add_argument("--incident-dir", default="",
+                   help="flight-recorder incident snapshots land here "
+                        "(default: $TRIVY_TPU_INCIDENT_DIR or "
+                        "<tmp>/trivy-tpu-incidents); a breaker "
+                        "opening or an injected fault auto-captures "
+                        "one, listed at /debug/incidents")
+    p.add_argument("--slow-trace-ms", type=float, default=1000.0,
+                   help="flight recorder pins traces whose root span "
+                        "exceeds this, so slow requests survive ring "
+                        "churn (default 1000)")
+    p.add_argument("--slo-latency-ms", type=float, default=2000.0,
+                   help="graftwatch SLO: the scan-latency threshold "
+                        "the p99 objective is declared against "
+                        "(default 2000)")
+
+
+def _configure_watch(args) -> None:
+    """Apply the graftwatch flags to the process singletons."""
+    from .obs import RECORDER, SLO
+    RECORDER.configure(
+        incident_dir=getattr(args, "incident_dir", "") or None,
+        slow_trace_ms=getattr(args, "slow_trace_ms", None))
+    SLO.configure(
+        latency_threshold_ms=getattr(args, "slo_latency_ms", None))
+
+
 def build_parser() -> argparse.ArgumentParser:
     # allow_abbrev=False: the env/config flag binding decides CLI
     # explicitness by exact option match (flagcfg._explicit), so
@@ -257,6 +285,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "domain probes and readmission probes; expiry "
                         "trips only that device's breaker "
                         "(default 5000)")
+    _add_watch_flags(p)
 
     p = sub.add_parser("router",
                        help="run the graftfleet scan router in front "
@@ -293,6 +322,16 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SITE=MODE[:ARG]",
                    help="graftguard fault injection (rpc.route drills "
                         "the failover path; also TRIVY_TPU_FAILPOINTS)")
+    p.add_argument("--trace", default="", metavar="FILE",
+                   help="graftwatch: on shutdown, pull every "
+                        "replica's /debug/traces fragment and write "
+                        "ONE assembled Chrome/Perfetto trace of the "
+                        "whole fleet to FILE")
+    p.add_argument("--token", default="",
+                   help="Trivy-Token gating the router's /debug "
+                        "surface (the scan routes relay the client's "
+                        "token for the replicas to enforce)")
+    _add_watch_flags(p)
 
     p = sub.add_parser("k8s", aliases=["kubernetes"],
                        help="scan a kubernetes cluster")
@@ -949,6 +988,8 @@ def cmd_server(args) -> int:
         max_active=getattr(args, "admit_max_active", 0),
         max_queue=getattr(args, "admit_max_queue", 16),
         queue_timeout_ms=getattr(args, "admit_queue_ms", 1000.0))
+    # graftwatch: incident dir, slow-trace pinning, SLO thresholds
+    _configure_watch(args)
     # validate the backend spelling BEFORE the (slow) table load, and
     # as a clean CLI error instead of ServerState's raw ValueError
     from .fanal.cache import known_backend
@@ -997,10 +1038,12 @@ def cmd_router(args) -> int:
             spec_from_sources(getattr(args, "failpoint", [])))
     except ValueError as e:
         raise SystemExit(str(e))
+    _configure_watch(args)
     opts = RouterOptions(
         vnodes=getattr(args, "ring_vnodes", 64),
         replica_timeout_s=getattr(args, "replica_timeout_ms",
                                   60000.0) / 1e3,
+        token=getattr(args, "token", ""),
         retry=RetryPolicy(
             attempts=max(1, getattr(args, "route_retries", 3)),
             base_delay_s=0.05, max_delay_s=1.0, budget_s=10.0),
@@ -1013,7 +1056,8 @@ def cmd_router(args) -> int:
             probe_timeout_ms=getattr(args, "replica_probe_timeout_ms",
                                      2000.0)))
     host, _, port = args.listen.rpartition(":")
-    serve_router(host or "0.0.0.0", int(port), args.replicas, opts)
+    serve_router(host or "0.0.0.0", int(port), args.replicas, opts,
+                 trace_path=getattr(args, "trace", ""))
     return 0
 
 
@@ -1254,9 +1298,10 @@ def main(argv=None) -> int:
     # graftscope pipeline tracing: recording must start BEFORE the
     # command runs so artifact inspection (the fanal walker) is in the
     # trace, not just the scan phase; the server command manages its
-    # own recording lifetime in serve()
+    # own recording lifetime in serve(), and the router's --trace is
+    # the graftwatch FLEET dump (cmd_router/serve_router own it)
     trace_path = getattr(args, "trace", "") \
-        if args.command != "server" else ""
+        if args.command not in ("server", "router") else ""
     if trace_path:
         from .obs import COLLECTOR, write_chrome_trace
         COLLECTOR.enable()

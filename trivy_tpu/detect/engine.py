@@ -32,7 +32,7 @@ from .. import version as V
 from ..db.table import AdvisoryTable
 from ..log import get as _get_logger
 from ..metrics import METRICS
-from ..obs import note_dispatch, recording, span
+from ..obs import SLO, note_dispatch, recording, span
 from ..ops import bucket_ladder, bucket_size
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
@@ -338,6 +338,7 @@ class BatchDetector:
         if warm:
             return
         METRICS.inc("trivy_tpu_detect_batches_total")
+        SLO.observe_join(True)
         if t_pad:
             METRICS.observe("trivy_tpu_batch_occupancy_ratio",
                             n_pairs / t_pad)
@@ -352,25 +353,39 @@ class BatchDetector:
         cannot tell the difference, and the bits are identical by the
         hostjoin contract."""
         METRICS.inc("trivy_tpu_fallback_joins_total")
-        ver = self.ver_snapshot()
-        t = self.table
-        return host_csr_pair_join(t.lo_tok, t.hi_tok, t.flags, ver,
-                                  q_start, q_count, q_ver, total, t_pad)
+        SLO.observe_join(False)
+        # the fallback join is a first-class trace phase (graftwatch):
+        # a degraded-mode scan's time must be attributable, and the
+        # incident drill asserts the fallback is VISIBLE in the
+        # assembled trace, not inferred from a counter
+        with span("detect.host_join", n_pairs=total, t_pad=t_pad):
+            ver = self.ver_snapshot()
+            t = self.table
+            return host_csr_pair_join(t.lo_tok, t.hi_tok, t.flags,
+                                      ver, q_start, q_count, q_ver,
+                                      total, t_pad)
 
     def _host_bits(self, prep: _Prepared) -> np.ndarray:
         """Host fallback from an already-expanded prep (used when the
         device accepted the dispatch but the FETCH failed: the pair
-        expansion is still on the host, so recompute locally)."""
+        expansion is still on the host, so recompute locally).
+
+        SLO accounting lives with the CALLERS, not here: a merged
+        rebuild invokes this once per prep, but the device_serving
+        objective counts one bad event per DISPATCH resolution — the
+        per-prep counting would overstate a single fetch failure by
+        the coalesce factor and fire false burn-rate pages."""
         METRICS.inc("trivy_tpu_fallback_joins_total")
-        ver = self.ver_snapshot()
-        t = self.table
-        t_pad = int(prep.pair_row.shape[0])
-        bits = np.zeros(t_pad, np.int8)
-        n = prep.n_pairs
-        bits[:n] = host_pair_join(
-            t.lo_tok, t.hi_tok, t.flags, ver,
-            prep.pair_row[:n], prep.pair_ver[:n], np.ones(n, bool))
-        return bits
+        with span("detect.host_join", n_pairs=prep.n_pairs):
+            ver = self.ver_snapshot()
+            t = self.table
+            t_pad = int(prep.pair_row.shape[0])
+            bits = np.zeros(t_pad, np.int8)
+            n = prep.n_pairs
+            bits[:n] = host_pair_join(
+                t.lo_tok, t.hi_tok, t.flags, ver,
+                prep.pair_row[:n], prep.pair_ver[:n], np.ones(n, bool))
+            return bits
 
     def _launch(self, q_start: np.ndarray, q_count: np.ndarray,
                 q_ver: np.ndarray, total: int, t_pad: int, u_pad: int,
@@ -444,6 +459,9 @@ class BatchDetector:
         except DeviceError:
             _log.warning("device fetch failed; host-fallback join",
                          exc_info=True)
+            # one bad device_serving event per dispatch RESOLUTION
+            # (the launch already recorded its optimistic good)
+            SLO.observe_join(False)
             return self._host_bits(prep)
 
     def _host_bits_merged(self, preps: list, offsets: list,
@@ -468,6 +486,10 @@ class BatchDetector:
             _log.warning("merged device fetch failed; rebuilding %d "
                          "request slices on the host", len(preps),
                          exc_info=True)
+            # ONE bad device_serving event for the whole merged
+            # dispatch — the per-prep host rebuild below must not
+            # multiply a single fetch failure by the coalesce factor
+            SLO.observe_join(False)
             return self._host_bits_merged(preps, offsets, t_pad)
 
     def _dispatch_impl(self, prep: _Prepared):
@@ -636,11 +658,15 @@ class BatchDetector:
                 # is one memcpy, on the get thread so batch N+1's
                 # result streams while batch N assembles. The fetch is
                 # graftguard-supervised: a wedged/failed get falls back
-                # to the host join instead of sinking the batch
+                # to the host join instead of sinking the batch.
+                # copy_context: the get thread inherits this request's
+                # trace id, so a fetch-failure fallback logs and spans
+                # under the trace it serves, not as an orphan
+                getctx = contextvars.copy_context()
                 window.append((idx, prep,
                                self._get_pool.submit(
-                                   self._fetch_or_fallback, prep,
-                                   dev)))
+                                   getctx.run, self._fetch_or_fallback,
+                                   prep, dev)))
                 # opportunistic: hand finished fetches to assembly
                 # without blocking the prep of the next batch
                 while window and window[0][2].done():
@@ -689,8 +715,9 @@ class BatchDetector:
         METRICS.gauge_add("trivy_tpu_dispatch_depth", float(n_active))
         in_flight = n_active
         get_futs = [None if fut is None
-                    else self._get_pool.submit(self._fetch_or_fallback,
-                                               prep, fut)
+                    else self._get_pool.submit(
+                        contextvars.copy_context().run,
+                        self._fetch_or_fallback, prep, fut)
                     for prep, fut in zip(prepped, futures)]
         out = []
         try:

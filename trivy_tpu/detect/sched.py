@@ -43,6 +43,7 @@ regression.
 
 from __future__ import annotations
 
+import contextvars
 import queue as queue_mod
 import threading
 import time
@@ -50,6 +51,7 @@ from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass
 
 from ..metrics import METRICS
+from ..obs import current_trace_id, span
 from .engine import BatchDetector, Hit, PkgQuery
 
 
@@ -72,7 +74,7 @@ class _Request:
     note)."""
 
     __slots__ = ("future", "results", "slots", "n_pairs", "_lock",
-                 "_remaining")
+                 "_remaining", "ctx", "trace_id")
 
     def __init__(self, n_slots: int):
         self.future: Future = Future()
@@ -81,6 +83,13 @@ class _Request:
         self.n_pairs = 0
         self._lock = threading.Lock()
         self._remaining = 0
+        # graftwatch: the submitting request's context (trace id, span
+        # parentage). The dispatcher thread runs the merged dispatch
+        # under ONE request's context — so its spans join a real trace
+        # instead of orphaning — and every merged trace id rides the
+        # dispatch span's attrs for cross-request attribution
+        self.ctx = contextvars.copy_context()
+        self.trace_id = current_trace_id()
 
     def arm(self) -> None:
         with self._lock:
@@ -292,8 +301,25 @@ class DispatchScheduler:
                     timeout=30.0)
             preps = [p for _, _, p in chunk]
             n_req = len({id(r) for r, _, _ in chunk})
-            dev, offsets, t_pad = \
-                self.detector.dispatch_merged(preps)
+            # run the merged dispatch under the FIRST request's
+            # captured context: its spans join that request's trace
+            # (the dispatcher thread has none of its own) and the
+            # detectd.round span lists every merged trace id, so any
+            # coalesced request's trace can find the shared dispatch.
+            # Fresh copies per use — a Context can't be entered twice
+            # concurrently, and the fetch below runs on another thread
+            req0 = chunk[0][0]
+            tids = sorted({r.trace_id for r, _, _ in chunk
+                           if r.trace_id})
+            dispatch_ctx = req0.ctx.run(contextvars.copy_context)
+            fetch_ctx = req0.ctx.run(contextvars.copy_context)
+
+            def _dispatch():
+                with span("detectd.round", merged=n_req,
+                          trace_ids=",".join(tids[:16])):
+                    return self.detector.dispatch_merged(preps)
+
+            dev, offsets, t_pad = dispatch_ctx.run(_dispatch)
             METRICS.observe("trivy_tpu_detect_coalesce_size",
                             float(n_req))
             METRICS.gauge_add("trivy_tpu_dispatch_depth", 1.0)
@@ -304,7 +330,8 @@ class DispatchScheduler:
             # every coalesced request behind one bad dispatch still
             # completes (bit-identically)
             gf = self.detector._get_pool.submit(
-                self.detector.fetch_merged, dev, preps, offsets, t_pad)
+                fetch_ctx.run, self.detector.fetch_merged, dev, preps,
+                offsets, t_pad)
             items = list(chunk)
             gf.add_done_callback(
                 lambda fut: self._on_fetched(fut, items, offsets,

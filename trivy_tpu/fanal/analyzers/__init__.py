@@ -185,15 +185,26 @@ class AnalyzerGroup:
 
     def analyze_file(self, path: str, content: bytes,
                      result: AnalysisResult) -> None:
+        # graftwatch attribution: one span per analyzer DISPATCH (an
+        # analyzer that actually ran on this file), not per candidate
+        # — required() gates keep the span count proportional to real
+        # work, and bench.py's archive breakdown aggregates these into
+        # the analyzer_ms phase the fanal-pipeline rebuild (ROADMAP 1)
+        # will be judged against
+        from ...obs import span
         for a in self.analyzers:
             if self._wants(a, path, len(content)):
-                r = a.analyze(path, content)
+                with span("fanal.analyze", analyzer=a.name,
+                          path=path, bytes=len(content)):
+                    r = a.analyze(path, content)
                 if r is not None:
                     result.merge(r)
         for m in _MODULE_ANALYZERS:
             if m.required(path):
                 try:
-                    data = m.analyze(path, content)
+                    with span("fanal.analyze",
+                              analyzer=f"module:{m.name}", path=path):
+                        data = m.analyze(path, content)
                 except Exception:
                     continue
                 if data:
@@ -205,11 +216,14 @@ class AnalyzerGroup:
                      result: AnalysisResult) -> None:
         if not files:
             return
+        from ...obs import span
         for a in self.post_analyzers:
             subset = {p: c for p, c in files.items()
                       if self._wants(a, p, -1)}
             if subset:
-                r = a.post_analyze(subset)
+                with span("fanal.analyze", analyzer=a.name,
+                          post=True, files=len(subset)):
+                    r = a.post_analyze(subset)
                 if r is not None:
                     result.merge(r)
 

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .. import types as T
+from ..obs import span
 from .analyzers import AnalyzerGroup
 from .cache import cache_key
 from .walker import DEFAULT_SECRET_CONFIG, blob_info, walk_fs, walk_layer_tar
@@ -65,6 +66,18 @@ class _ImageInspectMixin:
         return (cache_key(image_id, versions, opts),
                 [cache_key(d, versions, opts) for d in diff_ids])
 
+    def _missing_blobs(self, artifact_id: str, blob_ids: list):
+        """Cache check with attribution: layer-cache hits short-circuit
+        the walk entirely, which at production traffic is the
+        difference between re-analyzing a base image and skipping it —
+        the span makes that decision visible per artifact."""
+        with span("fanal.cache_check", blobs=len(blob_ids)) as sp:
+            missing_artifact, missing = self.cache.missing_blobs(
+                artifact_id, blob_ids)
+            sp.attrs.update(hits=len(blob_ids) - len(missing),
+                            misses=len(missing))
+            return missing_artifact, missing
+
     def _walk_missing_layers(self, diff_ids, blob_ids, created_by,
                              missing, open_layer,
                              layer_digests=None) -> dict:
@@ -75,20 +88,31 @@ class _ImageInspectMixin:
                 zip(diff_ids, blob_ids, created_by)):
             if blob_id not in missing:
                 continue
-            with open_layer(i) as layer_tf:
-                scan = walk_layer_tar(
-                    layer_tf, self.group, collect_secrets=want_secrets,
-                    secret_config_path=self.secret_config_path,
-                    skip_files=getattr(self, "skip_files", ()),
-                    skip_dir_globs=getattr(self, "skip_dir_globs", ()))
-            bi = blob_info(scan, diff_id=diff_id, created_by=cb)
-            if layer_digests:
-                bi.digest = layer_digests[i]
-            if want_secrets and scan.secret_files:
-                secret_files[blob_id] = scan.secret_files
-                bi.secrets = self.secret_scanner.scan_files(
-                    scan.secret_files)
-            self.cache.put_blob(blob_id, bi)
+            # one span per LAYER walk: the archive-e2e breakdown needs
+            # per-layer attribution (layer sizes are wildly skewed in
+            # real images — one fat layer dominates the walk)
+            with span("fanal.layer_walk", layer=i,
+                      diff_id=diff_id) as sp:
+                with open_layer(i) as layer_tf:
+                    scan = walk_layer_tar(
+                        layer_tf, self.group,
+                        collect_secrets=want_secrets,
+                        secret_config_path=self.secret_config_path,
+                        skip_files=getattr(self, "skip_files", ()),
+                        skip_dir_globs=getattr(self, "skip_dir_globs",
+                                               ()))
+                bi = blob_info(scan, diff_id=diff_id, created_by=cb)
+                sp.attrs.update(
+                    packages=sum(len(p.packages)
+                                 for p in bi.package_infos),
+                    applications=len(bi.applications))
+                if layer_digests:
+                    bi.digest = layer_digests[i]
+                if want_secrets and scan.secret_files:
+                    secret_files[blob_id] = scan.secret_files
+                    bi.secrets = self.secret_scanner.scan_files(
+                        scan.secret_files)
+                self.cache.put_blob(blob_id, bi)
         return secret_files
 
     def _put_artifact_info(self, artifact_id: str, config: dict):
@@ -157,7 +181,7 @@ class ImageArchiveArtifact(_ImageInspectMixin):
         image_id = "sha256:" + hashlib.sha256(
             json.dumps(config, sort_keys=True).encode()).hexdigest()
         artifact_id, blob_ids = self._image_keys(image_id, diff_ids)
-        missing_artifact, missing = self.cache.missing_blobs(
+        missing_artifact, missing = self._missing_blobs(
             artifact_id, blob_ids)
 
         @contextlib.contextmanager
@@ -201,7 +225,7 @@ class ImageArchiveArtifact(_ImageInspectMixin):
         created_by = self._created_by(config, diff_ids)
         image_id = manifest["config"]["digest"]
         artifact_id, blob_ids = self._image_keys(image_id, diff_ids)
-        missing_artifact, missing = self.cache.missing_blobs(
+        missing_artifact, missing = self._missing_blobs(
             artifact_id, blob_ids)
         layer_digests = [ld["digest"] for ld in manifest["layers"]]
 
@@ -374,7 +398,7 @@ class RegistryArtifact(_ImageInspectMixin):
         created_by = self._created_by(config, diff_ids)
         image_id = man["config"]["digest"]
         artifact_id, blob_ids = self._image_keys(image_id, diff_ids)
-        missing_artifact, missing = self.cache.missing_blobs(
+        missing_artifact, missing = self._missing_blobs(
             artifact_id, blob_ids)
         layer_digests = [ld["digest"] for ld in layers]
 

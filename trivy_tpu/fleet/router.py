@@ -46,8 +46,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .. import __version__
 from ..log import get as _get_logger
 from ..metrics import METRICS
+from ..obs import (RECORDER, current_span_id, current_trace_id,
+                   new_trace, span)
+from ..obs.recorder import (debug_incidents_payload,
+                            debug_traces_payload)
 from ..resilience import Deadline, FailpointError, RetryPolicy, failpoint
-from ..server import (DEADLINE_HEADER, ROUTE_DESCRIPTORS, TOKEN_HEADER,
+from ..server import (DEADLINE_HEADER, PARENT_SPAN_HEADER,
+                      REPLICA_HEADER, ROUTE_DESCRIPTORS, TOKEN_HEADER,
                       TRACE_HEADER)
 from .ring import HashRing
 from .supervisor import ReplicaOptions, ReplicaSet
@@ -55,8 +60,9 @@ from .supervisor import ReplicaOptions, ReplicaSet
 _log = _get_logger("fleet.router")
 
 # request headers forwarded verbatim to the replica (the deadline
-# header is re-stamped with the remaining budget instead)
-_FORWARD_HEADERS = ("Content-Type", TOKEN_HEADER, TRACE_HEADER)
+# header is re-stamped with the remaining budget, and the trace /
+# parent-span headers are stamped per forward from the active span)
+_FORWARD_HEADERS = ("Content-Type", TOKEN_HEADER)
 # replica response headers relayed back to the client
 _RELAY_HEADERS = ("Content-Type", "Retry-After", TRACE_HEADER)
 
@@ -66,6 +72,10 @@ class RouterOptions:
     """Router knobs (CLI `router` flags)."""
     vnodes: int = 64                  # ring points per replica
     replica_timeout_s: float = 60.0   # per-forward socket bound
+    # gates the /debug surface (trace buffers carry scan detail); POST
+    # bodies are relayed with the client's Trivy-Token for the
+    # REPLICAS to enforce — the router itself only guards its buffers
+    token: str = ""
     retry: RetryPolicy = field(default_factory=lambda: RetryPolicy(
         attempts=3, base_delay_s=0.05, max_delay_s=1.0, budget_s=10.0))
     replica: ReplicaOptions = field(default_factory=ReplicaOptions)
@@ -123,6 +133,7 @@ def route_key(path: str, req: dict) -> str:
 class RouterHandler(BaseHTTPRequestHandler):
     state: RouterState = None  # set by serve_router()
     protocol_version = "HTTP/1.1"
+    _trace_id = ""  # per-request; set by do_POST before dispatch
 
     def log_message(self, *args):
         pass
@@ -133,6 +144,11 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         for k, v in headers.items():
             self.send_header(k, v)
+        if self._trace_id and TRACE_HEADER not in headers:
+            # the id is echoed END TO END: router-generated responses
+            # (shed relays, 504s, errors) carry it just like relays,
+            # so a client can always hand support one id to chase
+            self.send_header(TRACE_HEADER, self._trace_id)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -142,14 +158,28 @@ class RouterHandler(BaseHTTPRequestHandler):
                    {"Content-Type": "application/json"})
 
     def _relay(self, resp) -> None:
-        code, headers, body = resp
+        code, headers, body, replica = resp
         out = {k: headers[k] for k in _RELAY_HEADERS if headers.get(k)}
+        if replica:
+            # which replica actually answered — failovers make the
+            # ring owner a guess; debugging needs the fact
+            out[REPLICA_HEADER] = replica
         self._send(code, body, out)
 
     # ---- GET surface ---------------------------------------------------
 
     def do_GET(self):
-        if self.path == "/healthz":
+        self._trace_id = ""  # never echo a previous POST's id
+        if self.path.startswith(("/debug/traces", "/debug/incidents")):
+            token = self.state.opts.token
+            if token and self.headers.get(TOKEN_HEADER) != token:
+                return self._json(401, {"code": "unauthenticated",
+                                        "msg": "invalid token"})
+            if self.path.startswith("/debug/traces"):
+                self._json(200, debug_traces_payload(self.path))
+            else:
+                self._json(200, debug_incidents_payload())
+        elif self.path == "/healthz":
             if "text/plain" in (self.headers.get("Accept") or ""):
                 self._send(200, b"ok", {"Content-Type": "text/plain"})
             else:
@@ -166,8 +196,16 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         t0 = time.perf_counter()
+        # the router MINTS the trace id when the client sent none, so
+        # a routed scan is traceable even from untraced clients; every
+        # forward re-stamps it (plus the per-hop parent span id)
+        tid = self.headers.get(TRACE_HEADER) or ""
+        parent = self.headers.get(PARENT_SPAN_HEADER) or ""
         try:
-            self._do_post()
+            with new_trace(tid or None, parent_id=parent or None) as tid:
+                self._trace_id = tid
+                with span("router.rpc", route=self.path):
+                    self._do_post()
         finally:
             METRICS.observe("trivy_tpu_fleet_router_latency_seconds",
                             time.perf_counter() - t0)
@@ -207,8 +245,8 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     def _route(self, key: str, body: bytes, fwd_headers: dict,
                deadline: Deadline):
-        """→ (status, headers, body) to relay. Walks the ring's
-        failover order under the RetryPolicy; every decision is
+        """→ (status, headers, body, replica) to relay. Walks the
+        ring's failover order under the RetryPolicy; every decision is
         bounded by the client's deadline."""
         st = self.state
         # forwards beyond a request's first are failovers, counted
@@ -240,13 +278,15 @@ class RouterHandler(BaseHTTPRequestHandler):
             return (503, {"Content-Type": "application/json",
                           "Retry-After": str(max(1, int(reset_s + 0.999)))},
                     json.dumps({"code": "unavailable",
-                                "msg": "no replica available"}).encode())
+                                "msg": "no replica available"}).encode(),
+                    None)
 
     def _deadline_response(self):
         return (504, {"Content-Type": "application/json"},
                 json.dumps({"code": "deadline_exceeded",
                             "msg": "client deadline exhausted before "
-                                   "a replica answered"}).encode())
+                                   "a replica answered"}).encode(),
+                None)
 
     def _walk_ring(self, key, body, fwd_headers, deadline, forwards):
         """One pass over the failover order. Returns a relayable
@@ -266,51 +306,77 @@ class RouterHandler(BaseHTTPRequestHandler):
             # a failover = any forward past the ring owner — an
             # earlier replica faulted/shed this request, OR the owner
             # itself is a lost domain being walked past
-            if forwards[0] > 1 or replica != owner:
+            failover = forwards[0] > 1 or replica != owner
+            if failover:
                 METRICS.inc("trivy_tpu_fleet_failovers_total")
-            try:
-                failpoint("rpc.route")
-                resp = self._forward(replica, body, fwd_headers,
-                                     timeout=min(
-                                         st.opts.replica_timeout_s,
-                                         remaining), deadline=deadline)
-            except urllib.error.HTTPError as e:
-                resp_body = e.read()
-                headers = {k: e.headers[k] for k in _RELAY_HEADERS
-                           if e.headers.get(k)}
-                if e.code in (429, 503):
-                    # admission shed: healthy-but-busy, not a fault —
-                    # remember the least-loaded shed and keep walking
-                    try:
-                        ra = float(e.headers.get("Retry-After") or 1.0)
-                    except ValueError:
-                        ra = 1.0
-                    if ra < shed_floor:
-                        shed_floor = ra
-                        shed = (e.code, headers, resp_body)
+                # tail-based retention: a trace that failed over is a
+                # trace worth keeping past ring churn
+                RECORDER.note_event("fleet_failover",
+                                    trace_id=current_trace_id(),
+                                    replica=replica, hop=forwards[0])
+            # one span per HOP (not per request): each forward's span
+            # id rides X-Trivy-Parent-Span, so the replica fragment
+            # that answered hangs under the hop that reached it and a
+            # failover reads as sibling forward spans in the assembly
+            with span("router.forward", replica=replica,
+                      hop=forwards[0], failover=failover) as sp:
+                try:
+                    failpoint("rpc.route")
+                    resp = self._forward(
+                        replica, body, fwd_headers,
+                        timeout=min(st.opts.replica_timeout_s,
+                                    remaining), deadline=deadline)
+                except urllib.error.HTTPError as e:
+                    resp_body = e.read()
+                    headers = {k: e.headers[k] for k in _RELAY_HEADERS
+                               if e.headers.get(k)}
+                    sp.attrs["status"] = e.code
+                    if e.code in (429, 503):
+                        # admission shed: healthy-but-busy, not a
+                        # fault — remember the least-loaded shed and
+                        # keep walking
+                        try:
+                            ra = float(e.headers.get("Retry-After")
+                                       or 1.0)
+                        except ValueError:
+                            ra = 1.0
+                        if ra < shed_floor:
+                            shed_floor = ra
+                            shed = (e.code, headers, resp_body,
+                                    replica)
+                        continue
+                    if 400 <= e.code < 500:
+                        # the replica answered; the CLIENT is wrong —
+                        # terminal relay, no failover, domain healthy
+                        st.supervisor.record_success(replica)
+                        return (e.code, headers, resp_body, replica)
+                    sp.attrs["error"] = f"http {e.code}"
+                    st.supervisor.record_failure(replica)
+                    _log.warning("fleet: replica %s returned %d; "
+                                 "failing over", replica, e.code)
                     continue
-                if 400 <= e.code < 500:
-                    # the replica answered; the CLIENT is wrong —
-                    # terminal relay, no failover, domain healthy
-                    st.supervisor.record_success(replica)
-                    return (e.code, headers, resp_body)
-                st.supervisor.record_failure(replica)
-                _log.warning("fleet: replica %s returned %d; failing "
-                             "over", replica, e.code)
-                continue
-            except (urllib.error.URLError, OSError,
-                    FailpointError) as e:
-                st.supervisor.record_failure(replica)
-                _log.warning("fleet: replica %s unreachable (%s); "
-                             "failing over", replica, e)
-                continue
-            st.supervisor.record_success(replica)
-            return resp
+                except (urllib.error.URLError, OSError,
+                        FailpointError) as e:
+                    sp.attrs["error"] = str(e)
+                    st.supervisor.record_failure(replica)
+                    _log.warning("fleet: replica %s unreachable (%s); "
+                                 "failing over", replica, e)
+                    continue
+                sp.attrs["status"] = resp[0]
+                st.supervisor.record_success(replica)
+                return resp + (replica,)
         raise _Unrouted(0.0 if shed is None else shed_floor, shed)
 
     def _forward(self, replica: str, body: bytes, fwd_headers: dict,
                  timeout: float, deadline: Deadline):
         headers = dict(fwd_headers)
+        # trace propagation per hop: the router's (possibly minted)
+        # trace id plus THIS hop's forward-span id as the remote
+        # parent — replica spans were orphaned fragments before this
+        headers[TRACE_HEADER] = current_trace_id()
+        psid = current_span_id()
+        if psid:
+            headers[PARENT_SPAN_HEADER] = psid
         if deadline.at is not None:
             # re-stamp the REMAINING budget: the replica's admission
             # queue must never park this request past what the client
@@ -323,10 +389,25 @@ class RouterHandler(BaseHTTPRequestHandler):
             return r.status, r.headers, r.read()
 
 
+def dump_fleet_trace(state: RouterState, path: str) -> None:
+    """`router --trace FILE`: pull every replica's /debug/traces
+    fragment, add the router's own recorder buffer, and write ONE
+    assembled Chrome/Perfetto document — the whole fleet's recent
+    span history, cross-process edges stitched."""
+    from ..obs import collect as obs_collect
+    fragments = [{"url": "router",
+                  "spans": RECORDER.spans()}]
+    fragments += obs_collect.fetch_fragments(state.replicas)
+    obs_collect.write_trace(path, obs_collect.assemble(fragments))
+    _log.warning("graftwatch fleet trace written to %s", path)
+
+
 def serve_router(host: str, port: int, replicas,
                  opts: RouterOptions | None = None,
-                 ready_event: threading.Event | None = None):
-    """Run the router in the foreground (CLI `router` command)."""
+                 ready_event: threading.Event | None = None,
+                 trace_path: str = ""):
+    """Run the router in the foreground (CLI `router` command).
+    `trace_path` dumps the assembled fleet trace on shutdown."""
     state = RouterState(replicas, opts)
     # per-server subclass (the listen.py pattern): a router and its
     # replicas coexist in one process in tests/bench
@@ -337,6 +418,13 @@ def serve_router(host: str, port: int, replicas,
     try:
         httpd.serve_forever()
     finally:
+        if trace_path:
+            # pull fragments BEFORE closing: shutdown must not race
+            # the replicas' own teardown out of the trace
+            try:
+                dump_fleet_trace(state, trace_path)
+            except Exception:
+                _log.exception("fleet trace dump failed")
         httpd.server_close()
         state.close()
     return httpd

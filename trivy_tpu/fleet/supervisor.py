@@ -107,6 +107,14 @@ class ReplicaSet:
                 self._lost.add(replica)
             _log.warning("fleet: replica %s lost; routing past it "
                          "until a probe readmits", replica)
+            try:
+                from ..obs.recorder import RECORDER
+                # pins the routed request's trace (record_failure runs
+                # on the router handler thread, context intact)
+                RECORDER.note_event("fleet_replica_lost",
+                                    replica=replica)
+            except Exception:
+                _log.exception("fleet event note failed")
 
     def record_success(self, replica: str) -> None:
         self.registry.get(replica).record_success()

@@ -38,6 +38,28 @@ class TraceContextFilter(logging.Filter):
         return True
 
 
+class RecorderHandler(logging.Handler):
+    """Feed every record into the graftwatch flight recorder's log
+    ring (bounded, always-on) so an incident snapshot carries the
+    recent log tail next to the recent spans. The import is lazy and
+    guarded: log.py is imported everywhere, including processes that
+    never touch obs, and a recorder failure must never sink a log
+    call."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            from .obs.recorder import RECORDER
+            RECORDER.record_log({
+                "ts_unix": round(record.created, 6),
+                "level": record.levelname,
+                "logger": record.name,
+                "msg": record.getMessage(),
+                "trace_id": getattr(record, "trace_id", "-"),
+            })
+        except Exception:  # noqa: BLE001 — never raise out of logging
+            pass
+
+
 class JsonFormatter(logging.Formatter):
     """One JSON object per line: ts, level, logger, msg, trace_id."""
 
@@ -72,6 +94,11 @@ def configure(stream=None, fmt: str | None = None) -> logging.Handler:
     for old in list(_root.handlers):
         _root.removeHandler(old)
     _root.addHandler(h)
+    # the flight-recorder tap rides alongside whatever stream handler
+    # is installed: reconfiguring output must not silence the ring
+    rh = RecorderHandler()
+    rh.addFilter(TraceContextFilter())
+    _root.addHandler(rh)
     return h
 
 

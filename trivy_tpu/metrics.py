@@ -310,6 +310,22 @@ METRICS.declare(
     "and backoff included).",
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5, 5.0, 10.0, 30.0))
+METRICS.declare(
+    "trivy_tpu_slo_burn_rate", "gauge",
+    "graftwatch SLO engine: error-budget burn rate per objective and "
+    "sliding window (1.0 = burning exactly at the budget-exhausting "
+    "rate; labels objective=\"scan_latency_p99\"/\"scan_errors\"/"
+    "\"device_serving\", window=\"<seconds>s\").")
+METRICS.declare(
+    "trivy_tpu_device_serving_ratio", "gauge",
+    "Fraction of join dispatches served by the device path (vs the "
+    "NumPy host fallback) over the SLO engine's short window (1.0 "
+    "when no joins ran).")
+METRICS.declare(
+    "trivy_tpu_incidents_total", "counter",
+    "Flight-recorder incident snapshots written (reason=\"breaker_"
+    "open\"/\"failpoint\"/\"manual\"; cooldown-limited, so a fault "
+    "storm counts once per window).")
 METRICS.declare("trivy_tpu_secret_files_total", "counter",
                 "Files through the secret scanner.")
 METRICS.declare("trivy_tpu_secret_bytes_total", "counter",

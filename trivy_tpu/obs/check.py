@@ -1,0 +1,206 @@
+"""``python -m trivy_tpu.obs.check`` — offline graftwatch validator.
+
+Incident files and assembled trace dumps are the artifacts an operator
+ships around (bug reports, postmortems) and the artifacts tooling
+ingests — a malformed one discovered at read time is evidence lost.
+This validator checks them offline, with no server running:
+
+  * schema: incident files must carry the trivy-tpu-incident/1 shape
+    (reason, captured_unix, spans/logs/pinned); trace dumps must be
+    Chrome trace-event documents whose "X" events carry the graftscope
+    span args (span_id/trace_id/parent_id) with numeric ts/dur;
+  * span-edge acyclicity: parent pointers must form a forest — a
+    cycle (possible only through id collision or a corrupted merge)
+    would hang any consumer that walks parents;
+  * id discipline: duplicate span ids inside one document are flagged
+    (the collect assembler dedupes; a file that still has duplicates
+    was built wrong).
+
+Wired into tier-1 alongside graftlint (tests/test_graftwatch.py runs
+it over freshly produced incidents and trace dumps, plus corrupted
+variants). Exit 0 clean, 1 findings, 2 unreadable input.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def _walk_parents(span_id: str, parents: dict[str, str]) -> str | None:
+    """Follow parent pointers from span_id; → an error string on a
+    cycle, None when the chain terminates."""
+    seen = {span_id}
+    cur = parents.get(span_id, "")
+    steps = 0
+    while cur:
+        if cur in seen:
+            return (f"span {span_id}: parent chain cycles back "
+                    f"through {cur}")
+        seen.add(cur)
+        cur = parents.get(cur, "")
+        steps += 1
+        if steps > len(parents) + 1:
+            return f"span {span_id}: parent chain does not terminate"
+    return None
+
+
+def _check_span_set(spans: list[dict], where: str) -> list[str]:
+    """Shared span-list validation: required fields, types, duplicate
+    ids, parent acyclicity."""
+    problems: list[str] = []
+    parents: dict[str, str] = {}
+    for i, s in enumerate(spans):
+        if not isinstance(s, dict):
+            problems.append(f"{where}[{i}]: not an object")
+            continue
+        sid = s.get("span_id")
+        if not sid or not isinstance(sid, str):
+            problems.append(f"{where}[{i}]: missing span_id")
+            continue
+        if not isinstance(s.get("name"), str) or not s.get("name"):
+            problems.append(f"{where}[{i}] ({sid}): missing name")
+        for field in ("ts_unix", "dur_ms"):
+            v = s.get(field)
+            if not isinstance(v, (int, float)) or v < 0:
+                problems.append(
+                    f"{where}[{i}] ({sid}): bad {field} {v!r}")
+        if sid in parents:
+            problems.append(f"{where}: duplicate span id {sid}")
+            continue
+        parents[sid] = s.get("parent_id") or ""
+    for sid in parents:
+        err = _walk_parents(sid, parents)
+        if err:
+            problems.append(f"{where}: {err}")
+    return problems
+
+
+def check_incident(doc: dict) -> list[str]:
+    """Validate one incident document (recorder.FlightRecorder.SCHEMA)."""
+    problems: list[str] = []
+    if doc.get("schema") != "trivy-tpu-incident/1":
+        problems.append(f"unknown incident schema {doc.get('schema')!r}")
+    if not isinstance(doc.get("reason"), str) or not doc.get("reason"):
+        problems.append("missing reason")
+    if not isinstance(doc.get("captured_unix"), (int, float)):
+        problems.append("missing captured_unix")
+    for field in ("spans", "logs", "events"):
+        if not isinstance(doc.get(field), list):
+            problems.append(f"missing {field} list")
+    if not isinstance(doc.get("pinned"), dict):
+        problems.append("missing pinned map")
+    if isinstance(doc.get("spans"), list):
+        problems += _check_span_set(doc["spans"], "spans")
+    if isinstance(doc.get("pinned"), dict):
+        for tid, entry in doc["pinned"].items():
+            if not isinstance(entry, dict) \
+                    or not isinstance(entry.get("spans"), list):
+                problems.append(f"pinned[{tid}]: malformed entry")
+                continue
+            problems += _check_span_set(entry["spans"],
+                                        f"pinned[{tid}]")
+            for s in entry["spans"]:
+                if isinstance(s, dict) and \
+                        s.get("trace_id") not in ("", tid):
+                    problems.append(
+                        f"pinned[{tid}]: span {s.get('span_id')} "
+                        f"belongs to trace {s.get('trace_id')}")
+    if isinstance(doc.get("logs"), list):
+        for i, rec in enumerate(doc["logs"]):
+            if not isinstance(rec, dict) or "msg" not in rec:
+                problems.append(f"logs[{i}]: malformed record")
+    return problems
+
+
+def check_trace(doc: dict) -> list[str]:
+    """Validate one Chrome trace-event document (graftscope export or
+    collect.assemble output)."""
+    problems: list[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing traceEvents list"]
+    parents: dict[str, str] = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"traceEvents[{i}]: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M"):
+            problems.append(f"traceEvents[{i}]: unknown phase {ph!r}")
+            continue
+        if ph != "X":
+            continue   # instants/metadata carry no span identity
+        missing = [k for k in ("name", "ts", "dur", "pid", "tid",
+                               "args") if k not in ev]
+        if missing:
+            problems.append(
+                f"traceEvents[{i}]: missing {', '.join(missing)}")
+            continue
+        for field in ("ts", "dur"):
+            if not isinstance(ev[field], (int, float)) \
+                    or ev[field] < 0:
+                problems.append(
+                    f"traceEvents[{i}]: bad {field} {ev[field]!r}")
+        args = ev["args"]
+        sid = args.get("span_id") if isinstance(args, dict) else None
+        if not sid:
+            problems.append(f"traceEvents[{i}]: args.span_id missing")
+            continue
+        if sid in parents:
+            problems.append(f"duplicate span id {sid}")
+            continue
+        parents[sid] = args.get("parent_id") or ""
+    for sid in parents:
+        err = _walk_parents(sid, parents)
+        if err:
+            problems.append(err)
+    return problems
+
+
+def check_file(path: str) -> list[str]:
+    """Validate one file, auto-detecting its kind by content."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"unreadable: {e}"]
+    if not isinstance(doc, dict):
+        return ["top level is not an object"]
+    if "traceEvents" in doc:
+        return check_trace(doc)
+    if "schema" in doc or "reason" in doc:
+        return check_incident(doc)
+    return ["neither a trace dump (traceEvents) nor an incident file "
+            "(schema/reason)"]
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m trivy_tpu.obs.check",
+        description="validate graftwatch incident files and trace "
+                    "dumps offline (schema + span-edge acyclicity)")
+    ap.add_argument("paths", nargs="+", metavar="FILE")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-file OK lines")
+    args = ap.parse_args(argv)
+    bad = unreadable = 0
+    for path in args.paths:
+        problems = check_file(path)
+        if not problems:
+            if not args.quiet:
+                print(f"{path}: OK")
+            continue
+        if problems[0].startswith("unreadable:"):
+            unreadable += 1
+        bad += 1
+        for p in problems:
+            print(f"{path}: {p}")
+    if unreadable:
+        return 2
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""graftwatch trace assembly: one trace across router + replicas.
+
+A scan routed through the graftfleet router produces span fragments in
+three places — the router process, the replica that served it, and
+(on failover) the replicas that refused it. Each process exposes its
+flight-recorder buffer at `/debug/traces?trace_id=`; this module pulls
+those fragments and assembles ONE Chrome/Perfetto trace-event document
+spanning router → replica → detect → device, failover hops included.
+
+Cross-process rules:
+
+  * fragments are deduped by span id (in-process test fleets share one
+    recorder, and a retry may surface the same span twice);
+  * parent edges stitch via the X-Trivy-Parent-Span header: a
+    fragment's root span carries the forwarding span's id as its
+    parent, so the assembled tree is connected without any clock
+    agreement between processes;
+  * timestamps use each span's WALL clock (ts_unix) — perf_counter
+    bases are process-local and meaningless across machines — offset
+    to the earliest span in the document;
+  * every source process gets its own Chrome pid plus a
+    process_name metadata event naming its URL.
+
+`discover(router_url)` reads the router's /healthz to find the
+replica set, so `python -m trivy_tpu.obs.collect --router URL
+--trace-id ID -o FILE` (and `router --trace FILE` on shutdown) need
+only the router address.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+
+
+def fetch_fragment(base_url: str, trace_id: str | None = None,
+                   timeout: float = 5.0) -> dict:
+    """GET one process's /debug/traces buffer. Raises on transport
+    errors — callers decide whether a missing fragment is fatal (a
+    replica that died mid-incident is exactly when you want the other
+    fragments anyway)."""
+    url = base_url.rstrip("/") + "/debug/traces"
+    if trace_id:
+        url += "?" + urllib.parse.urlencode({"trace_id": trace_id})
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def fetch_fragments(base_urls, trace_id: str | None = None,
+                    timeout: float = 5.0) -> list[dict]:
+    """Fetch from every URL, skipping unreachable processes (their
+    absence is recorded as an empty fragment with an `error`)."""
+    out = []
+    for url in base_urls:
+        try:
+            frag = fetch_fragment(url, trace_id, timeout)
+        except Exception as e:  # noqa: BLE001 — best-effort sweep
+            out.append({"url": url, "spans": [], "error": str(e)})
+            continue
+        frag["url"] = url
+        out.append(frag)
+    return out
+
+
+def discover(router_url: str, timeout: float = 5.0) -> list[str]:
+    """→ [router_url, replica...] from the router's /healthz fleet
+    block."""
+    with urllib.request.urlopen(
+            router_url.rstrip("/") + "/healthz", timeout=timeout) as r:
+        doc = json.loads(r.read())
+    replicas = ((doc.get("fleet") or {}).get("ring") or {}) \
+        .get("replicas") or []
+    return [router_url.rstrip("/")] + list(replicas)
+
+
+def assemble(fragments: list[dict]) -> dict:
+    """→ one Chrome trace-event document over every fragment's spans,
+    deduped by span id; each source gets its own pid + process_name
+    metadata row."""
+    events = []
+    seen: set = set()
+    base = None
+    for frag in fragments:
+        for s in frag.get("spans") or ():
+            if s["span_id"] in seen:
+                continue
+            ts = float(s.get("ts_unix") or 0.0)
+            if base is None or ts < base:
+                base = ts
+    base = base or 0.0
+    for pid, frag in enumerate(fragments, start=1):
+        url = frag.get("url") or f"process-{pid}"
+        added = False
+        for s in frag.get("spans") or ():
+            if s["span_id"] in seen:
+                continue
+            seen.add(s["span_id"])
+            added = True
+            events.append({
+                "name": s["name"],
+                "cat": "graftwatch",
+                "ph": "X",
+                "ts": round((float(s.get("ts_unix") or 0.0) - base)
+                            * 1e6, 3),
+                "dur": round(float(s.get("dur_ms") or 0.0) * 1e3, 3),
+                "pid": pid,
+                "tid": s.get("thread_id", 0),
+                "args": {
+                    "trace_id": s.get("trace_id", ""),
+                    "span_id": s["span_id"],
+                    "parent_id": s.get("parent_id", ""),
+                    "cpu_ms": s.get("cpu_ms", 0.0),
+                    **(s.get("attrs") or {}),
+                },
+            })
+        if added:
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "tid": 0, "args": {"name": url},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def collect_trace(router_url: str, trace_id: str | None = None,
+                  timeout: float = 5.0, urls=None) -> dict:
+    """Discover the fleet behind `router_url` (or use explicit
+    `urls`), fetch every fragment, and assemble one document."""
+    if urls is None:
+        urls = discover(router_url, timeout)
+    return assemble(fetch_fragments(urls, trace_id, timeout))
+
+
+def write_trace(path: str, doc: dict) -> None:
+    import os
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m trivy_tpu.obs.collect",
+        description="assemble one Chrome/Perfetto trace across a "
+                    "graftfleet router and its replicas")
+    ap.add_argument("--router", required=True,
+                    help="router base URL (replicas discovered via "
+                         "its /healthz)")
+    ap.add_argument("--trace-id", default="",
+                    help="assemble one trace (default: every span "
+                         "still in the fleet's flight recorders)")
+    ap.add_argument("--url", action="append", default=[],
+                    help="extra process URL to pull a fragment from "
+                         "(repeatable)")
+    ap.add_argument("-o", "--output", required=True,
+                    help="output trace file (Perfetto / "
+                         "chrome://tracing)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    urls = discover(args.router, args.timeout) + list(args.url)
+    doc = collect_trace(args.router, args.trace_id or None,
+                        args.timeout, urls=urls)
+    write_trace(args.output, doc)
+    print(f"{len(doc['traceEvents'])} events from {len(urls)} "
+          f"processes → {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

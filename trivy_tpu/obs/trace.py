@@ -13,12 +13,19 @@ whole pipeline is instrumented with:
 Spans carry a trace id (stamped per scan / per RPC, propagated from
 client to server via the X-Trivy-Trace-Id header), a span id, their
 parent span id (contextvar nesting — correct across server handler
-threads), wall + process time, and free-form attributes. Finished
-spans land in the process-wide COLLECTOR only while recording is
-enabled (`--trace FILE` on the CLI, the server's --trace flag, or
-bench.py's phase breakdown); when disabled span() early-outs after
-one flag check, yielding a shared no-op span — no ids, no clock
-reads, no contextvar traffic.
+threads; a remote parent forwarded via the X-Trivy-Parent-Span header
+links fragments across processes), wall + process time, and free-form
+attributes.
+
+Two sinks receive finished spans (graftwatch):
+
+  * the always-on flight recorder (obs/recorder.py) — a bounded
+    lock-free ring every span lands in, serving /debug/traces and
+    incident capture; its per-span cost is one counter bump and one
+    slot store;
+  * the COLLECTOR, only while recording is enabled (`--trace FILE` on
+    the CLI, the server's --trace flag, or bench.py's phase
+    breakdown) — the opt-in complete-trace dump.
 
 Export is Chrome trace-event JSON ("X" complete events, microsecond
 timestamps), loadable in Perfetto / chrome://tracing.
@@ -38,12 +45,19 @@ import threading
 import time
 import uuid
 
+from .recorder import RECORDER
+
 # active span (for parent linkage) and active trace id; contextvars so
 # each server handler thread / asyncio task nests independently
 _SPAN: contextvars.ContextVar = contextvars.ContextVar(
     "trivy_tpu_span", default=None)
 _TRACE: contextvars.ContextVar = contextvars.ContextVar(
     "trivy_tpu_trace", default="")
+# remote parent span id (X-Trivy-Parent-Span): adopted by the first
+# span opened under it with no LOCAL parent, so a server fragment's
+# root span links to the router/client span that forwarded the RPC
+_REMOTE_PARENT: contextvars.ContextVar = contextvars.ContextVar(
+    "trivy_tpu_remote_parent", default="")
 
 
 def _new_id(nbytes: int = 8) -> str:
@@ -151,14 +165,28 @@ def current_trace_id() -> str:
     return _TRACE.get()
 
 
+def current_span_id() -> str:
+    """Span id of the innermost active span ('' outside any span) —
+    what the client/router forward as X-Trivy-Parent-Span."""
+    s = _SPAN.get()
+    return s.span_id if s is not None else ""
+
+
 @contextlib.contextmanager
-def new_trace(trace_id: str | None = None):
-    """Set a fresh trace id for the enclosed work (per-RPC stamp)."""
+def new_trace(trace_id: str | None = None,
+              parent_id: str | None = None):
+    """Set a fresh trace id for the enclosed work (per-RPC stamp).
+    `parent_id` installs a REMOTE parent span id: the first span
+    opened inside (with no local parent) adopts it, stitching this
+    process's fragment under the caller's forwarding span."""
     tid = trace_id or _new_id(16)
     tok = _TRACE.set(tid)
+    ptok = _REMOTE_PARENT.set(parent_id) if parent_id else None
     try:
         yield tid
     finally:
+        if ptok is not None:
+            _REMOTE_PARENT.reset(ptok)
         _TRACE.reset(tok)
 
 
@@ -175,23 +203,18 @@ def ensure_trace(trace_id: str | None = None):
         yield tid
 
 
-# shared sink for disabled tracing: callers may still write attrs into
-# it (overwritten freely, read by nobody) without any per-span cost
-_NOOP_SPAN = Span("", "", "", {})
-
-
 @contextlib.contextmanager
 def span(name: str, **attrs):
     """Time a region; nests via contextvars. Yields the Span so callers
     can attach attributes discovered mid-flight (`sp.attrs[...] = x`).
-    When recording is off this is one flag check and a shared no-op
-    span — cheap enough for per-batch hot-path call sites."""
-    if not COLLECTOR.enabled:
-        yield _NOOP_SPAN
-        return
+    Every finished span lands in the always-on flight recorder's ring
+    (graftwatch); the COLLECTOR additionally keeps it only while
+    recording is enabled. A span with no local parent adopts the
+    remote parent id installed by new_trace(parent_id=...)."""
     parent = _SPAN.get()
     s = Span(name, _TRACE.get(),
-             parent.span_id if parent is not None else "", dict(attrs))
+             parent.span_id if parent is not None
+             else _REMOTE_PARENT.get(), dict(attrs))
     s.thread_id = threading.get_ident()
     s.wall_start = time.time()
     s.cpu = time.process_time()
@@ -203,6 +226,7 @@ def span(name: str, **attrs):
         s.dur = time.perf_counter() - s.start
         s.cpu = time.process_time() - s.cpu
         _SPAN.reset(tok)
+        RECORDER.record_span(s)
         COLLECTOR.record(s)
 
 

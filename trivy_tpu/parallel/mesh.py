@@ -279,8 +279,19 @@ class MeshDetector:
         `detect.dispatch` watch — a whole-launch failure names no
         single chip."""
         from ..log import get as _get_logger
+        from ..obs import SLO
         from ..resilience import GUARD, DeviceError, failpoint
         inner = self._inner
+        raw_fallback = host_fallback
+
+        def host_fallback():
+            # one bad device_serving event per mesh DISPATCH served
+            # host-side (the inner _host_bits* helpers intentionally
+            # do not observe — a merged rebuild would multiply one
+            # fault by the coalesce factor)
+            SLO.observe_join(False)
+            return raw_fallback()
+
         if self.mesh is None or \
                 (self.guard is not None
                  and self.guard.any_lost(self.device_ids)):

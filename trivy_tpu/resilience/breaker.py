@@ -35,6 +35,7 @@ code (they would run once at trace time and lie).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -181,28 +182,50 @@ class CircuitBreaker:
                 _log.exception("breaker recovery listener failed")
 
     def record_failure(self) -> None:
+        opened = False
         with self._lock:
             if self._state == HALF_OPEN:
                 self._probing = False
                 self._set_state(OPEN)
+                opened = True
                 _log.warning("breaker %s: probe failed, re-opened",
                              self.name)
-                return
-            self._failures += 1
-            if self._state == CLOSED and \
-                    self._failures >= self.fail_threshold:
-                self._set_state(OPEN)
-                _log.warning("breaker %s: opened after %d failures",
-                             self.name, self._failures)
+            else:
+                self._failures += 1
+                if self._state == CLOSED and \
+                        self._failures >= self.fail_threshold:
+                    self._set_state(OPEN)
+                    opened = True
+                    _log.warning("breaker %s: opened after %d "
+                                 "failures", self.name, self._failures)
+        if opened:
+            self._note_open()
 
     def trip(self) -> None:
         """Open immediately (watchdog timeout: one wedged dispatch is
         disqualifying, no threshold)."""
+        opened = False
         with self._lock:
             self._probing = False
             if self._state != OPEN:
                 self._set_state(OPEN)
+                opened = True
                 _log.warning("breaker %s: tripped open", self.name)
+        if opened:
+            self._note_open()
+
+    def _note_open(self) -> None:
+        """graftwatch incident hook, called OUTSIDE the breaker lock
+        (it snapshots the flight-recorder ring to disk): any breaker
+        opening — backend, mesh device, or fleet replica domain —
+        pins the active trace and auto-captures a cooldown-limited
+        incident file."""
+        try:
+            from ..obs.recorder import RECORDER
+            RECORDER.note_event("breaker_open", incident=True,
+                                breaker=self.name)
+        except Exception:   # observability must never sink the caller
+            _log.exception("breaker incident capture failed")
 
     def on_recovery(self, cb) -> None:
         with self._lock:
@@ -226,7 +249,7 @@ class CircuitBreaker:
 
 
 class _WatchToken:
-    __slots__ = ("site", "deadline", "expired", "breaker")
+    __slots__ = ("site", "deadline", "expired", "breaker", "trace_id")
 
     def __init__(self, site: str, deadline: Deadline,
                  breaker: CircuitBreaker):
@@ -238,6 +261,14 @@ class _WatchToken:
         # detect.mesh:<id> site family — expiry must trip the DEVICE's
         # domain, not the whole backend
         self.breaker = breaker
+        # the trace the supervised call belongs to: the watchdog
+        # thread has no request context, so trip-time logs/pins read
+        # the id captured when the watch was armed
+        try:
+            from ..obs.trace import current_trace_id
+            self.trace_id = current_trace_id()
+        except Exception:
+            self.trace_id = ""
 
 
 class _Watch:
@@ -377,12 +408,27 @@ class DeviceGuard:
                      if not t.expired), default=None)
             for t in expired:
                 METRICS.inc("trivy_tpu_device_watchdog_trips_total")
-                _log.warning("watchdog: %s outlived its deadline; "
-                             "tripping breaker", t.site)
-                # each token carries its own breaker: a detect.mesh:<id>
-                # expiry trips that device's fault domain, everything
-                # else trips the backend breaker
-                t.breaker.trip()
+                # trip-path attribution (graftwatch): the sweep runs on
+                # the watchdog thread, so re-enter the wedged call's
+                # trace context — the log line carries ITS id, and the
+                # recorder pins that trace past ring churn
+                with contextlib.ExitStack() as stack:
+                    if t.trace_id:
+                        from ..obs.trace import new_trace
+                        stack.enter_context(new_trace(t.trace_id))
+                    _log.warning("watchdog: %s outlived its deadline; "
+                                 "tripping breaker", t.site)
+                    try:
+                        from ..obs.recorder import RECORDER
+                        RECORDER.note_event("watchdog_trip",
+                                            trace_id=t.trace_id,
+                                            site=t.site)
+                    except Exception:
+                        _log.exception("watchdog event note failed")
+                    # each token carries its own breaker: a
+                    # detect.mesh:<id> expiry trips that device's
+                    # fault domain, everything else trips the backend
+                    t.breaker.trip()
             with self._cv:
                 wait = 0.25 if nearest is None \
                     else max(min(nearest, 0.25), 0.001)

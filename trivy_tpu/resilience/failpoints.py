@@ -212,9 +212,26 @@ class FailpointRegistry:
         if spec is None:
             return
         if spec.mode == "error" or flake:
+            _note_fault(site, spec.mode)
             raise FailpointError(site)
         if spec.mode in ("hang", "slow"):
+            if spec.mode == "hang":
+                # a hang is watchdog-trip material; slow-mode
+                # degradation below the deadline is not incident-worthy
+                _note_fault(site, "hang")
             time.sleep(spec.arg / 1e3)
+
+
+def _note_fault(site: str, mode: str) -> None:
+    """graftwatch hook: an injected fault that actually FIRED pins the
+    active trace and auto-captures a (cooldown-limited) incident —
+    the chaos drill's artifacts look exactly like a real outage's."""
+    try:
+        from ..obs.recorder import RECORDER
+        RECORDER.note_event("failpoint", incident=True, site=site,
+                            mode=mode)
+    except Exception:  # noqa: BLE001 — observability never sinks a site
+        pass
 
 
 FAILPOINTS = FailpointRegistry()

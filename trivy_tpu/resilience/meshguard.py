@@ -154,6 +154,7 @@ class MeshGuard:
         self._cv = threading.Condition()
         self._lost: set = set()
         self._pending: str | None = None   # scheduled rebuild reason
+        self._fault_trace = ""    # trace that saw the triggering loss
         self._attributing = False  # a collective failure asked "who?"
         self._last_rebuild = float("-inf")
         self._rebuild_cb = None
@@ -288,6 +289,8 @@ class MeshGuard:
 
     def device_failed(self, dev_id) -> None:
         """Mark one device lost and schedule a shrink rebuild."""
+        from ..obs.trace import current_trace_id
+        tid = current_trace_id()
         with self._cv:
             if dev_id not in self.all_ids or dev_id in self._lost:
                 return
@@ -295,10 +298,20 @@ class MeshGuard:
             # shrink wins over a pending grow — the survivor set is
             # computed fresh at rebuild time either way
             self._pending = "shrink"
+            # the trace that SAW the loss: the rebuild runs later on
+            # the maintenance thread, whose log lines re-enter this
+            # context so operators can join loss → rebuild by one id
+            self._fault_trace = tid
             self._cv.notify()
         METRICS.inc("trivy_tpu_mesh_device_lost_total")
         _log.warning("meshguard: device %s lost; shrink rebuild "
                      "scheduled", dev_id)
+        try:
+            from ..obs.recorder import RECORDER
+            RECORDER.note_event("mesh_device_lost", trace_id=tid,
+                                device=str(dev_id))
+        except Exception:
+            _log.exception("meshguard event note failed")
 
     def on_rebuild(self, cb) -> None:
         with self._cv:
@@ -333,6 +346,7 @@ class MeshGuard:
     def _tick(self) -> None:
         now = time.monotonic()
         cb = reason = survivors = None
+        fault_trace = ""
         with self._cv:
             due = (now - self._last_rebuild) * 1e3 \
                 >= self.opts.rebuild_cooldown_ms
@@ -346,28 +360,56 @@ class MeshGuard:
                 cb = self._rebuild_cb
                 survivors = [i for i in self.all_ids
                              if i not in self._lost]
+                # consume the triggering trace: a later unrelated
+                # rebuild (a grow, hours after readmission) must not
+                # re-enter — and re-pin — a long-finished trace
+                fault_trace = self._fault_trace
+                self._fault_trace = ""
         if cb is not None:
             active = survivors if len(survivors) \
                 >= max(self.opts.min_devices, 1) else []
-            _log.warning(
-                "meshguard: %s rebuild → %d/%d devices%s", reason,
-                len(active), len(self.all_ids),
-                "" if active or not survivors
-                else f" (survivors {len(survivors)} < min_devices "
-                     f"{self.opts.min_devices}: host join)")
-            try:
-                cb(active, reason)
-            except Exception:
-                _log.exception("meshguard rebuild callback failed; "
-                               "retrying after the cooldown")
-                # re-schedule so a transient swap failure can never
-                # strand the stale mesh (and its any_lost host-only
-                # window) forever; counters/gauge stay untouched — a
-                # failed rebuild must not report a healthy shrunk mesh
-                with self._cv:
-                    if self._pending is None:
-                        self._pending = reason
-                return
+            # the rebuild runs on the maintenance thread; re-enter the
+            # trace that saw the triggering device loss so every
+            # rebuild log line joins the incident by id (graftwatch —
+            # log sites that used to sit outside any span context)
+            import contextlib as _ctxlib
+
+            from ..obs.trace import new_trace
+            with _ctxlib.ExitStack() as stack:
+                if fault_trace:
+                    stack.enter_context(new_trace(fault_trace))
+                _log.warning(
+                    "meshguard: %s rebuild → %d/%d devices%s", reason,
+                    len(active), len(self.all_ids),
+                    "" if active or not survivors
+                    else f" (survivors {len(survivors)} < min_devices "
+                         f"{self.opts.min_devices}: host join)")
+                try:
+                    from ..obs.recorder import RECORDER
+                    RECORDER.note_event("mesh_rebuild",
+                                        trace_id=fault_trace,
+                                        reason=reason,
+                                        active=len(active))
+                except Exception:
+                    _log.exception("meshguard event note failed")
+                try:
+                    cb(active, reason)
+                except Exception:
+                    _log.exception("meshguard rebuild callback "
+                                   "failed; retrying after the "
+                                   "cooldown")
+                    # re-schedule so a transient swap failure can
+                    # never strand the stale mesh (and its any_lost
+                    # host-only window) forever; counters/gauge stay
+                    # untouched — a failed rebuild must not report a
+                    # healthy shrunk mesh
+                    with self._cv:
+                        if self._pending is None:
+                            self._pending = reason
+                        # the retry still belongs to the incident
+                        if not self._fault_trace:
+                            self._fault_trace = fault_trace
+                    return
             # success accounting only
             with self._cv:
                 self._rebuilds[reason] += 1

@@ -12,6 +12,14 @@ TOKEN_HEADER = "Trivy-Token"
 # otherwise; echoed on every response and stamped on every span and
 # log line the request produces (graftscope propagation)
 TRACE_HEADER = "X-Trivy-Trace-Id"
+# graftwatch cross-process parentage: the forwarding span's id (the
+# client's client.scan, or the router's per-hop router.forward), so
+# the receiver's root span links under it and obs.collect can stitch
+# one tree across processes with no shared clock
+PARENT_SPAN_HEADER = "X-Trivy-Parent-Span"
+# stamped by the router on relayed responses: which replica actually
+# answered (failovers make the ring owner a guess, not an answer)
+REPLICA_HEADER = "X-Trivy-Replica"
 # graftguard per-request deadline: milliseconds the client is willing
 # to wait, queue time included — the admission queue never parks a
 # handler thread past it (the client stamps its own timeout here)

@@ -15,9 +15,10 @@ import urllib.error
 import urllib.request
 
 from .. import types as T
-from ..obs import current_trace_id, ensure_trace, span
+from ..obs import current_span_id, current_trace_id, ensure_trace, span
 from ..report.writer import report_from_json
-from . import DEADLINE_HEADER, TOKEN_HEADER, TRACE_HEADER
+from . import (DEADLINE_HEADER, PARENT_SPAN_HEADER, TOKEN_HEADER,
+               TRACE_HEADER)
 
 # one policy shape for every RPC; _Base accepts an override for tests.
 # Built lazily (like oci.py / db/download.py): a pure client process
@@ -78,12 +79,16 @@ class _Base:
     def _call(self, service: str, method: str, payload: dict) -> dict:
         body = json.dumps(payload).encode()
         # forward the active graftscope trace id so client and server
-        # spans/logs correlate (the server mints one when absent)
+        # spans/logs correlate (the server mints one when absent), and
+        # the active span id so the server fragment's root parents
+        # under this call (graftwatch cross-process assembly)
         tid = current_trace_id()
+        psid = current_span_id()
         headers = {
             "Content-Type": "application/json",
             DEADLINE_HEADER: str(int(self.timeout * 1e3)),
             **({TRACE_HEADER: tid} if tid else {}),
+            **({PARENT_SPAN_HEADER: psid} if tid and psid else {}),
             **({TOKEN_HEADER: self.token} if self.token else {}),
         }
         policy = self.retry or _default_retry()

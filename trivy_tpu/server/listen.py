@@ -25,15 +25,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .. import __version__, types as T
 from ..fanal.cache import blob_from_json
 from ..log import get as _get_logger
-from ..obs import device_status, new_trace, span
+from ..obs import SLO, device_status, new_trace, span
+from ..obs.recorder import (debug_incidents_payload,
+                            debug_traces_payload)
 from ..resilience import (AdmissionQueue, Deadline, GUARD, Shed,
                           failpoint)
 from ..scanner import LocalScanner
 # wire-header names live in the package __init__ so the CLIENT can
 # import them without pulling in this module's server stack;
 # re-exported here for the existing `listen.TOKEN_HEADER` readers
-from . import (DEADLINE_HEADER, ROUTE_DESCRIPTORS,  # noqa: F401
-               TOKEN_HEADER, TRACE_HEADER)
+from . import (DEADLINE_HEADER, PARENT_SPAN_HEADER,  # noqa: F401
+               ROUTE_DESCRIPTORS, TOKEN_HEADER, TRACE_HEADER)
 
 _log = _get_logger("server")
 
@@ -375,6 +377,18 @@ class Handler(BaseHTTPRequestHandler):
             st.request_finished(gen)
 
     def _do_get(self):
+        if self.path.startswith(("/debug/traces", "/debug/incidents")):
+            # unlike /healthz//metrics (liveness/scrape surfaces), the
+            # debug buffers carry scan detail — file paths in analyzer
+            # spans, other tenants' trace ids — so a configured token
+            # gates them exactly like the POST surface
+            if self.state.token and \
+                    self.headers.get(TOKEN_HEADER) != self.state.token:
+                return self._twirp_error(401, "unauthenticated",
+                                         "invalid token")
+            if self.path.startswith("/debug/traces"):
+                return self._json(200, debug_traces_payload(self.path))
+            return self._json(200, debug_incidents_payload())
         if self.path == "/healthz":
             # plain `ok` stays the fast path for probes that ask for
             # it (kubelet-style `Accept: text/plain`); everything else
@@ -404,11 +418,18 @@ class Handler(BaseHTTPRequestHandler):
                     # graftguard: breaker state, watchdog last-probe
                     # age, shed/fallback counters, admission snapshot
                     "resilience": resilience,
+                    # graftwatch: per-objective burn rates over the
+                    # sliding windows (export() also refreshes the
+                    # burn-rate gauges, so /healthz and /metrics agree)
+                    "slo": SLO.export(),
                 })
         elif self.path == "/version":
             self._json(200, {"Version": __version__})
         elif self.path == "/metrics":
             from ..metrics import METRICS
+            # burn-rate gauges are window functions of the SLO event
+            # store — recompute at scrape time so they are current
+            SLO.export()
             body = METRICS.render().encode()
             self.send_response(200)
             self.send_header("Content-Type",
@@ -446,10 +467,14 @@ class Handler(BaseHTTPRequestHandler):
         st = self.state
         gen = st.request_started()
         # per-RPC trace stamp: reuse the client's id when forwarded,
-        # mint one otherwise; every span/log line below inherits it
+        # mint one otherwise; every span/log line below inherits it.
+        # The forwarded parent-span id (router hop or client span)
+        # parents this fragment's root, so obs.collect stitches one
+        # tree across processes
         tid = self.headers.get(TRACE_HEADER) or ""
+        parent = self.headers.get(PARENT_SPAN_HEADER) or ""
         try:
-            with new_trace(tid or None) as tid:
+            with new_trace(tid or None, parent_id=parent or None) as tid:
                 self._trace_id = tid
                 with span("server.rpc", route=self.path):
                     self._do_post(st)
@@ -543,10 +568,19 @@ class Handler(BaseHTTPRequestHandler):
         except Shed as s:
             _log.warning("scan shed (%s): %d Retry-After=%ds",
                          s.reason, s.http_code, int(s.retry_after_s))
+            # shed-aware SLO accounting: a 429/503 is load the
+            # deployment refused on purpose — availability's
+            # denominator grows, its error count does not
+            SLO.observe_scan(0.0, "shed")
             return self._shed_response(s)
         try:
             failpoint("rpc.scan")
             return self._scan(req)
+        except KeyError:
+            raise   # 400 invalid_argument: the client's error
+        except Exception:
+            SLO.observe_scan(0.0, "error")
+            raise
         finally:
             st.admission.release()
 
@@ -568,6 +602,7 @@ class Handler(BaseHTTPRequestHandler):
         METRICS.inc("trivy_tpu_scans_total")
         METRICS.inc("trivy_tpu_scan_seconds_total", elapsed)
         METRICS.observe("trivy_tpu_scan_latency_seconds", elapsed)
+        SLO.observe_scan(elapsed, "ok")
         _log.debug("scan %s: %d results in %.1fms",
                    req.get("target", ""), len(results), elapsed * 1e3)
         if self._is_proto:
