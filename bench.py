@@ -884,6 +884,10 @@ def device_child_main():
         server_fleet = bench_server_fleet(table)
     except Exception:
         server_fleet = None
+    try:
+        chaos_storm = bench_chaos_storm()
+    except Exception:
+        chaos_storm = None
 
     import jax
     payload = {
@@ -903,11 +907,51 @@ def device_child_main():
         "degraded_mode": degraded,
         "mesh_degraded": mesh_degraded,
         "server_fleet": server_fleet,
+        "chaos_storm": chaos_storm,
         "device": str(jax.devices()[0]),
         "build_s": build_s,
         "scan_s": dev_s,
     }
     print(json.dumps(payload))
+
+
+def bench_chaos_storm():
+    """graftstorm scenario: one standard seeded multi-fault schedule
+    (dispatch hang + device-get flakes + a DB hot swap overlapping at
+    c=8) against a single-server topology, reporting p99 latency and
+    shed rate UNDER compound chaos plus whether every invariant probe
+    (no lost requests, oracle bit-identity, breaker liveness, thread
+    hygiene, strict /metrics) held. Uses the storm engine's own small
+    table — the scenario measures the resilience stack, not the join."""
+    from trivy_tpu.resilience.storm import (Schedule, StormEvent,
+                                            StormOptions, run_storm,
+                                            storm_table)
+    schedule = Schedule(seed=2026, topology="single",
+                        horizon_ms=1200.0, events=[
+                            StormEvent(at_ms=100.0,
+                                       site="detect.dispatch",
+                                       mode="hang", arg=150.0,
+                                       dur_ms=500.0),
+                            StormEvent(at_ms=250.0,
+                                       site="detect.device_get",
+                                       mode="flaky", arg=0.3, seed=5,
+                                       dur_ms=600.0),
+                            StormEvent(at_ms=400.0,
+                                       kind="swap_table"),
+                        ])
+    opts = StormOptions(requests=32, concurrency=8,
+                        admit_max_active=8, admit_max_queue=8)
+    t0 = time.perf_counter()
+    report = run_storm(schedule, opts, table=storm_table())
+    n = max(len(report.outcomes), 1)
+    return {
+        "invariants_ok": report.ok,
+        "violations": sorted(report.violations),
+        "p99_ms": round(report.p99_ms(), 2),
+        "shed_rate": round(report.sheds() / n, 3),
+        "requests": len(report.outcomes),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
 
 
 class _ProbeFailed(RuntimeError):
@@ -916,42 +960,54 @@ class _ProbeFailed(RuntimeError):
 
 def _probe_backend(env):
     """Bounded probe: can a fresh process initialize a real accelerator
-    backend? → (device string or None, attempts made). JAX silently
-    falls back to CPU when no accelerator runtime exists — that counts
-    as terminal-unavailable (the CPU points are already measured
-    in-process, and retrying a deterministic outcome wastes the
-    window).
+    backend? → (device string or None, attempts made, per-attempt
+    log). JAX silently falls back to CPU when no accelerator runtime
+    exists — that counts as terminal-unavailable (the CPU points are
+    already measured in-process, and retrying a deterministic outcome
+    wastes the window).
 
     The probe child runs under the shared graftguard RetryPolicy with
     a per-attempt subprocess timeout — r02/r03/r05 lost the TPU to
     probe flakiness, exactly the fault class a fleet absorbs — and the
-    attempt count is surfaced (`probe_attempts` in the JSON tail)
-    instead of a silent CPU fallback."""
+    attempt count, per-attempt timings, and terminal failure reason
+    are all surfaced in the JSON tail (rounds 2/3/5 lost the device
+    number with nothing but a stderr line to explain why)."""
     from trivy_tpu.resilience.retry import RetryPolicy
     code = ("import jax; d = jax.devices()[0]; "
             "print(d.platform + '|' + str(d))")
     attempts = [0]
+    attempt_log = []
 
     def attempt():
         i = attempts[0]
         attempts[0] += 1
         tmo = PROBE_TIMEOUTS[min(i, len(PROBE_TIMEOUTS) - 1)]
+        t0 = time.time()
+        entry = {"attempt": i + 1, "timeout_s": tmo}
+        attempt_log.append(entry)
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code], env=env, timeout=tmo,
                 capture_output=True, text=True)
         except subprocess.TimeoutExpired:
+            entry["elapsed_s"] = round(time.time() - t0, 1)
+            entry["outcome"] = "timeout"
             print(f"# probe attempt {i + 1} timed out after {tmo}s",
                   file=sys.stderr)
             raise _ProbeFailed(f"timeout after {tmo}s") from None
+        entry["elapsed_s"] = round(time.time() - t0, 1)
         if r.returncode == 0 and r.stdout.strip():
             platform, _, name = \
                 r.stdout.strip().splitlines()[-1].partition("|")
             if platform == "cpu":
+                entry["outcome"] = "cpu_only"
                 print("# probe found only CPU devices — treating "
                       "accelerator as unavailable", file=sys.stderr)
                 return None   # terminal: no accelerator runtime
+            entry["outcome"] = "ok"
             return name
+        entry["outcome"] = f"rc={r.returncode}"
+        entry["stderr_tail"] = r.stderr.strip()[-200:]
         print(f"# probe attempt {i + 1} rc={r.returncode}: "
               f"{r.stderr.strip()[-200:]}", file=sys.stderr)
         raise _ProbeFailed(f"rc={r.returncode}")
@@ -967,7 +1023,7 @@ def _probe_backend(env):
             else None)
     except _ProbeFailed:
         name = None
-    return name, attempts[0]
+    return name, attempts[0], attempt_log
 
 
 def _run_device_child(env):
@@ -1019,16 +1075,29 @@ def _save_device_artifact(payload: dict):
     os.replace(tmp, DEVICE_ARTIFACT)
 
 
-def _load_device_artifact(max_age_s: float = 24 * 3600):
+def _load_device_artifact(max_age_s: float = 24 * 3600,
+                          allow_stale_workload: bool = False):
     """Reject artifacts from another round (too old) or another
-    workload definition — stale numbers are worse than none."""
+    workload definition — stale numbers are worse than none.
+
+    `allow_stale_workload` relaxes the fingerprint gate ONE notch:
+    an artifact whose probe contract (the `vN|` version prefix)
+    matches but whose workload parameters drifted is returned anyway —
+    the DEVICE identity and rough throughput are still real even if
+    hit counts are not comparable. Callers must mark the result
+    `device_number_stale` (rounds 2/3/5 lost the device number
+    entirely over a parameter tweak)."""
     try:
         with open(DEVICE_ARTIFACT) as f:
             payload = json.load(f)
         if not payload.get("images_per_sec"):
             return None
-        if payload.get("workload") != _workload_fingerprint():
-            return None
+        want = _workload_fingerprint()
+        have = str(payload.get("workload") or "")
+        if have != want:
+            same_contract = have.split("|", 1)[0] == want.split("|", 1)[0]
+            if not (allow_stale_workload and same_contract):
+                return None
         age = time.time() - float(payload.get("probed_at_unix", 0))
         if age > max_age_s:
             return None
@@ -1185,6 +1254,13 @@ def main():
         except Exception as e:
             diag.append(f"server_fleet bench failed: {e}")
         try:
+            # graftstorm scenario: p99 + shed rate under a standard
+            # compound chaos schedule, invariant verdict included; the
+            # device child's numbers override when present
+            result["chaos_storm"] = bench_chaos_storm()
+        except Exception as e:
+            diag.append(f"chaos_storm bench failed: {e}")
+        try:
             arch_ips, _arch_hits, arch_phase = bench_archive_e2e(table)
             result["images_per_sec_archive_e2e"] = round(arch_ips, 1)
             # the walker/analyzer/applier attribution baseline the
@@ -1195,10 +1271,24 @@ def main():
 
         dev = None
         dev_source = "live"
-        probed, probe_attempts = _probe_backend(child_env)
+        dev_stale = False
+        probed, probe_attempts, probe_log = _probe_backend(child_env)
         # surfaced, not silent: how hard the probe had to work before
         # the device point was taken (or given up on)
         result["probe_attempts"] = probe_attempts
+        if probed is None:
+            # terminal probe failure: say WHY, with per-attempt
+            # timings, in the JSON tail itself — not just stderr
+            outcomes = [e.get("outcome", "?") for e in probe_log]
+            if all(o == "timeout" for o in outcomes):
+                reason = (f"all {len(outcomes)} probe attempts "
+                          f"timed out")
+            elif "cpu_only" in outcomes:
+                reason = "no accelerator runtime (CPU-only backend)"
+            else:
+                reason = "probe child failed: " + ",".join(outcomes)
+            result["probe_failure_reason"] = reason
+            result["probe_attempt_timings"] = probe_log
         if probed is not None:
             dev = _run_device_child(child_env)
         if dev is None:
@@ -1210,6 +1300,19 @@ def main():
                 result["device_probed_at"] = dev.get("probed_at", "")
                 diag.append(f"device point from {DEVICE_ARTIFACT} "
                             f"({dev.get('probed_at')})")
+        if dev is None:
+            # last resort: an artifact whose workload PARAMETERS
+            # drifted but whose probe contract matches still carries a
+            # real device number — marked stale, hit counts never
+            # compared (rounds 2/3/5 dropped the number silently here)
+            dev = _load_device_artifact(allow_stale_workload=True)
+            if dev is not None:
+                dev_source = "opportunistic_probe"
+                dev_stale = True
+                result["device_number_stale"] = True
+                result["device_probed_at"] = dev.get("probed_at", "")
+                diag.append(f"STALE-workload device point from "
+                            f"{DEVICE_ARTIFACT} ({dev.get('probed_at')})")
         if dev is not None:
             result["device_source"] = dev_source
             result["value"] = round(dev["images_per_sec"], 2)
@@ -1231,6 +1334,8 @@ def main():
                 result["mesh_degraded"] = dev["mesh_degraded"]
             if dev.get("server_fleet"):
                 result["server_fleet"] = dev["server_fleet"]
+            if dev.get("chaos_storm"):
+                result["chaos_storm"] = dev["chaos_storm"]
             result["host_prep_ms"] = round(dev["host_prep_ms"], 1)
             result["device_ms"] = round(dev["device_ms"], 1)
             result["assemble_ms"] = round(dev["assemble_ms"], 1)
@@ -1239,10 +1344,15 @@ def main():
                 result["phase_ms"] = dev["phase_ms"]
             # parity across the three paths, recorded rather than fatal
             # (the workload is seeded, so a cached artifact's hit counts
-            # are comparable to this process's CPU hit counts)
-            result["parity_ok"] = bool(
-                dev["dev_hits"] == np_hits and dev["sub_hits"] == base_hits)
-            diag.append(f"hits={dev['dev_hits']} scan_s={dev['scan_s']:.2f}")
+            # are comparable to this process's CPU hit counts — UNLESS
+            # the artifact is from a drifted workload, where comparing
+            # would report false corruption)
+            if not dev_stale:
+                result["parity_ok"] = bool(
+                    dev["dev_hits"] == np_hits
+                    and dev["sub_hits"] == base_hits)
+                diag.append(f"hits={dev['dev_hits']} "
+                            f"scan_s={dev['scan_s']:.2f}")
         else:
             # degraded: report the best CPU point as the headline value
             result["value"] = round(N_IMAGES / numpy_s, 2)

@@ -20,3 +20,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; the wide chaos sweeps opt out
+    config.addinivalue_line(
+        "markers", "slow: wide sweeps excluded from the tier-1 gate")

@@ -850,3 +850,59 @@ def test_metrics_reference_in_architecture_is_current():
     assert block.strip() == mc.render_markdown().strip(), (
         "ARCHITECTURE.md metrics catalog drifted; regenerate with "
         "trivy_tpu.analysis.metrics_catalog.render_markdown()")
+
+
+def test_storm_is_in_lock_hygiene_scope():
+    """Satellite (PR 8): graftstorm (resilience/storm.py) — the
+    schedule driver, load workers, and invariant collectors share
+    state across threads — is in TPU106 scope like the rest of
+    resilience/."""
+    src = (
+        "import threading\n"
+        "class Driver:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._actions = []\n"
+        "    def bad(self, a):\n"
+        "        self._actions.append(a)\n"
+        "    def good(self, a):\n"
+        "        with self._lock:\n"
+        "            self._actions.append(a)\n"
+    )
+    fs = _lint("trivy_tpu/resilience/storm.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+
+
+def test_storm_no_clocks_or_metrics_in_device_code():
+    """Satellite (PR 8): TPU107 — a timed/metered core sneaking into
+    storm helper code must be caught (storm is host-side by charter)."""
+    src = (
+        "import time, jax\n"
+        "from trivy_tpu.metrics import METRICS\n"
+        "def _storm_core(x):\n"
+        "    METRICS.inc('trivy_tpu_oops_total')\n"
+        "    return x + time.perf_counter()\n"
+        "j = jax.jit(_storm_core)\n"
+    )
+    fs = _lint("trivy_tpu/resilience/storm.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU107", 4),
+                                              ("TPU107", 5)]
+
+
+def test_storm_no_failpoints_in_device_code():
+    """Satellite (PR 8): TPU108 — a failpoint probe or breaker read in
+    a jitted core inside storm code fires the resilience-in-device-code
+    rule."""
+    src = (
+        "import jax\n"
+        "from trivy_tpu.resilience import GUARD, failpoint\n"
+        "def _storm_core(x):\n"
+        "    failpoint('detect.dispatch')\n"
+        "    if GUARD.allow_device():\n"
+        "        x = x + 1\n"
+        "    return x\n"
+        "j = jax.jit(_storm_core)\n"
+    )
+    fs = _lint("trivy_tpu/resilience/storm.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU108", 4),
+                                              ("TPU108", 5)]

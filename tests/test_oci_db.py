@@ -164,3 +164,83 @@ def test_blob_digest_verified(tmp_path):
             client.blob(parse_ref(f"{base}/a/b:1"), digest)
     finally:
         reg.stop()
+
+
+class TestBlobDigestVerification:
+    """Satellite (PR 8): the pulled trivy-db blob's sha256 is checked
+    against the OCI MANIFEST digest before the atomic install — a
+    corrupt-but-complete body quarantines + retries instead of
+    installing."""
+
+    @staticmethod
+    def _good_layer():
+        meta = json.dumps({"Version": SCHEMA_VERSION}).encode()
+        return tar_gz_of({"trivy.db": b"boltbytes",
+                          "metadata.json": meta})
+
+    def _client(self, good, bad_pulls):
+        import hashlib
+
+        class Client:
+            def __init__(self):
+                self.pulls = 0
+
+            def manifest(self, ref):
+                digest = "sha256:" + hashlib.sha256(good).hexdigest()
+                return {"layers": [{"mediaType": MT_TRIVY_DB,
+                                    "digest": digest,
+                                    "size": len(good)}]}
+
+            def blob(self, ref, digest, verify=True):
+                assert verify is False  # download.py owns the check
+                self.pulls += 1
+                if self.pulls <= bad_pulls:
+                    return good[:-4] + b"XXXX"   # complete but corrupt
+                return good
+
+        return Client()
+
+    def test_corrupt_body_never_installs(self, tmp_path, monkeypatch):
+        from trivy_tpu.db import download as dl
+        from trivy_tpu.resilience import RetryPolicy
+        monkeypatch.setattr(dl, "DOWNLOAD_RETRY", RetryPolicy(
+            attempts=2, base_delay_s=0.001, max_delay_s=0.002,
+            budget_s=5.0))
+        cache = str(tmp_path / "cache")
+        client = self._client(self._good_layer(), bad_pulls=99)
+        with pytest.raises(DBError, match="digest mismatch"):
+            download_db(cache, client=client)
+        assert not os.path.exists(db_path(cache))
+        qdir = os.path.join(cache, "db", "quarantine")
+        assert os.path.isdir(qdir) and os.listdir(qdir)
+
+    def test_transient_corruption_heals_under_retry(self, tmp_path,
+                                                    monkeypatch):
+        from trivy_tpu.db import download as dl
+        from trivy_tpu.resilience import RetryPolicy
+        monkeypatch.setattr(dl, "DOWNLOAD_RETRY", RetryPolicy(
+            attempts=3, base_delay_s=0.001, max_delay_s=0.002,
+            budget_s=5.0))
+        cache = str(tmp_path / "cache")
+        client = self._client(self._good_layer(), bad_pulls=1)
+        p = download_db(cache, client=client)
+        assert client.pulls == 2
+        with open(p, "rb") as f:
+            assert f.read() == b"boltbytes"
+        # the corrupt first body is kept for forensics
+        qdir = os.path.join(cache, "db", "quarantine")
+        assert os.path.isdir(qdir) and os.listdir(qdir)
+
+    def test_legacy_client_without_manifest_still_works(
+            self, tmp_path):
+        """Clients exposing only download_artifact_layer (the pre-PR 8
+        interface) install unverified, as before."""
+
+        class Legacy:
+            def download_artifact_layer(self, ref, mt):
+                return TestBlobDigestVerification._good_layer()
+
+        cache = str(tmp_path / "cache")
+        p = download_db(cache, client=Legacy())
+        with open(p, "rb") as f:
+            assert f.read() == b"boltbytes"

@@ -140,3 +140,46 @@ def test_cache_redis_failpoint_fires(fake):
     finally:
         FAILPOINTS.clear()
     assert cache.get_blob("blob1") is None
+
+
+def test_quarantine_is_conditional_on_the_corrupt_value(fake):
+    """The PR 6 TOCTOU, closed: a re-put that lands between the
+    corrupt GET and the quarantine RENAME must keep its fresh value —
+    rename_if_value re-reads and compares under the client lock, so
+    the racing writer's entry is never renamed away."""
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    fresh = T.BlobInfo(diff_id="sha256:f",
+                       os=T.OS(family="alpine", name="3.17.3"))
+    key = b"fanal::blob::race"
+    fake.data[key] = b"{truncated"
+    real_rename = cache.client.rename_if_value
+
+    def interleaved(k, expected, dest):
+        # the interleaving: a re-put lands AFTER the corrupt read,
+        # BEFORE the quarantine decision
+        cache.put_blob("race", fresh)
+        return real_rename(k, expected, dest)
+
+    cache.client.rename_if_value = interleaved
+    try:
+        # the corrupt read serves a miss, but the racing writer's
+        # fresh value survives, un-renamed
+        assert cache.get_blob("race") is None
+        assert key in fake.data
+        assert b"fanal::corrupt::blob::race" not in fake.data
+        got = cache.get_blob("race")
+        assert got is not None and got.os.family == "alpine"
+    finally:
+        cache.client.rename_if_value = real_rename
+
+
+def test_quarantine_still_fires_without_a_race(fake):
+    """No interleaving writer: the corrupt entry is renamed to the
+    corrupt prefix exactly as before."""
+    cache = RedisCache(f"redis://127.0.0.1:{fake.port}")
+    key = b"fanal::blob::plain"
+    fake.data[key] = b"{truncated"
+    assert cache.get_blob("plain") is None
+    assert key not in fake.data
+    assert fake.data.get(b"fanal::corrupt::blob::plain") \
+        == b"{truncated"

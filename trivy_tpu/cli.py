@@ -285,6 +285,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "domain probes and readmission probes; expiry "
                         "trips only that device's breaker "
                         "(default 5000)")
+    p.add_argument("--drain-grace-ms", type=float, default=10000.0,
+                   help="SIGTERM/SIGINT graceful drain: stop "
+                        "admitting (503 + Retry-After), let in-flight "
+                        "requests finish for up to this long, then "
+                        "close (default 10000)")
     _add_watch_flags(p)
 
     p = sub.add_parser("router",
@@ -331,6 +336,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Trivy-Token gating the router's /debug "
                         "surface (the scan routes relay the client's "
                         "token for the replicas to enforce)")
+    p.add_argument("--drain-grace-ms", type=float, default=10000.0,
+                   help="SIGTERM/SIGINT graceful drain: stop "
+                        "admitting (503 + Retry-After), let in-flight "
+                        "forwards finish for up to this long, then "
+                        "close (default 10000)")
     _add_watch_flags(p)
 
     p = sub.add_parser("k8s", aliases=["kubernetes"],
@@ -1020,7 +1030,9 @@ def cmd_server(args) -> int:
           token=args.token,
           cache_backend=getattr(args, "cache_backend", "fs"),
           trace_path=getattr(args, "trace", ""),
-          detect_opts=opts, admission=admission, mesh_opts=mesh_opts)
+          detect_opts=opts, admission=admission, mesh_opts=mesh_opts,
+          drain_grace_s=getattr(args, "drain_grace_ms",
+                                10000.0) / 1e3)
     return 0
 
 
@@ -1057,7 +1069,9 @@ def cmd_router(args) -> int:
                                      2000.0)))
     host, _, port = args.listen.rpartition(":")
     serve_router(host or "0.0.0.0", int(port), args.replicas, opts,
-                 trace_path=getattr(args, "trace", ""))
+                 trace_path=getattr(args, "trace", ""),
+                 drain_grace_s=getattr(args, "drain_grace_ms",
+                                       10000.0) / 1e3)
     return 0
 
 

@@ -101,18 +101,69 @@ def _download_retry():
     return DOWNLOAD_RETRY
 
 
+def _quarantine_blob(cache_dir: str, blob: bytes, want: str,
+                     got: str) -> None:
+    """Keep a digest-mismatched body for forensics instead of
+    installing it — a truncating proxy or poisoned mirror should leave
+    evidence, not a corrupt advisory DB under a fresh metadata.json."""
+    from ..log import get as _get_logger
+    qdir = os.path.join(db_dir(cache_dir), "quarantine")
+    path = os.path.join(qdir, f"trivy-db-{got.split(':')[-1][:16]}.blob")
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except OSError:
+        path = "(quarantine write failed)"
+    _get_logger("db").warning(
+        "trivy-db blob digest mismatch: manifest says %s, body is %s; "
+        "quarantined to %s and retrying", want, got, path)
+
+
 def download_db(cache_dir: str, repository: str = DEFAULT_REPO,
                 client=None) -> str:
-    """Pull the trivy-db OCI artifact into <cache>/db → trivy.db path."""
+    """Pull the trivy-db OCI artifact into <cache>/db → trivy.db path.
+
+    The pulled blob's sha256 is verified against the OCI MANIFEST
+    digest before the atomic install — a corrupt-but-complete body
+    (truncating proxy, bit rot on a mirror) used to install fine and
+    poison every scan until the next update window. A mismatch
+    quarantines the body and retries under the shared RetryPolicy.
+    Clients that only expose `download_artifact_layer` (tests, exotic
+    mirrors) skip the manifest walk and install unverified, as
+    before."""
+    import hashlib as _hashlib
+
     from ..oci import (MT_TRIVY_DB, OCIError, default_client, parse_ref,
                        untar_gz_members)
     from ..resilience import FailpointError, failpoint, retry_on
     client = client or default_client()
     ref = parse_ref(repository)
+    verifiable = hasattr(client, "manifest") and hasattr(client, "blob")
 
     def pull():
         failpoint("db.download")
-        return client.download_artifact_layer(ref, MT_TRIVY_DB)
+        if not verifiable:
+            return client.download_artifact_layer(ref, MT_TRIVY_DB)
+        man = client.manifest(ref)
+        layer = next((ly for ly in man.get("layers", [])
+                      if ly.get("mediaType") == MT_TRIVY_DB), None)
+        if layer is None:
+            raise OCIError(f"{ref}: no layer with media type "
+                           f"{MT_TRIVY_DB}")
+        digest = str(layer.get("digest") or "")
+        # fetch WITHOUT the client's own check so the mismatch path is
+        # ours: quarantine + retry instead of a bare error
+        body = client.blob(ref, digest, verify=False)
+        if digest.startswith("sha256:"):
+            actual = "sha256:" + _hashlib.sha256(body).hexdigest()
+            if actual != digest:
+                _quarantine_blob(cache_dir, body, digest, actual)
+                raise OCIError(f"{ref}: blob digest mismatch "
+                               f"(manifest {digest}, body {actual})")
+        return body
 
     try:
         blob = _download_retry().call(

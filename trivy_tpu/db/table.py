@@ -18,6 +18,7 @@ verification, report strings) and a vulnerability-detail dict for FillInfo
 
 from __future__ import annotations
 
+import hashlib
 import json
 from dataclasses import dataclass, field
 from typing import Optional
@@ -97,6 +98,7 @@ class AdvisoryTable:
         self.sources = sorted({g.source for g in groups})
         self._device = None
         self._hash_u64 = None
+        self._digest: Optional[str] = None
 
     def sources_for_prefix(self, prefix: str) -> list[str]:
         """Buckets matching an ecosystem prefix — the columnar equivalent of
@@ -119,6 +121,31 @@ class AdvisoryTable:
                 np.uint64)
             self._hash_u64 = (hi << np.uint64(32)) | lo
         return self._hash_u64
+
+    def content_digest(self) -> str:
+        """Deterministic digest of the flattened table — the fleet's
+        `db_version` identity (/healthz, X-Trivy-DB-Version). Two
+        replicas answering with different digests can produce
+        different scan results for the same artifact, which silently
+        breaks the bit-identity guarantee the fleet kill drill relies
+        on; the router counts that skew. Covers everything that feeds
+        a result: the join arrays, the per-group report metadata, and
+        the FillInfo detail dict. Computed once, cached (a hot-swapped
+        table is a NEW object, so the cache can never go stale)."""
+        if self._digest is None:
+            h = hashlib.sha256()
+            for arr in (self.hash, self.lo_tok, self.hi_tok,
+                        self.flags, self.group):
+                h.update(str(arr.shape).encode())
+                h.update(np.ascontiguousarray(arr).tobytes())
+            for g in self.groups:
+                h.update(f"{g.source}|{g.pkg_name}|{g.vuln_id}|"
+                         f"{g.fixed_version}|{g.status}|{g.severity}|"
+                         f"{g.raw_specs}\n".encode())
+            h.update(json.dumps(self.details, sort_keys=True).encode())
+            h.update(json.dumps(self.aux, sort_keys=True).encode())
+            self._digest = "sha256:" + h.hexdigest()
+        return self._digest
 
     def device_arrays(self):
         """device_put once, reuse across batches (double-buffer swap point

@@ -34,7 +34,13 @@ def open_cache(backend: str, cache_dir: str = ""):
         memory                MemoryCache (tests, ephemeral scans)
         redis://host:port/db  shared fleet backend (redis_cache)
         s3://bucket/prefix    shared fleet backend (s3_cache)
+
+    An already-open cache OBJECT passes through unchanged — in-process
+    fleets (graftstorm's fleet topology, tests) share one MemoryCache
+    across N replicas without a socket in the loop.
     """
+    if not isinstance(backend, str):
+        return backend
     if backend.startswith("redis://"):
         from .redis_cache import RedisCache
         return RedisCache(backend)

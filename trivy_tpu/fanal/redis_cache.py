@@ -71,15 +71,37 @@ class RespClient:
         except OSError:
             pass
 
-    def command(self, *args):
+    def _command_locked(self, *args):
+        """One round-trip; caller holds self._lock."""
         out = [b"*%d\r\n" % len(args)]
         for a in args:
             if isinstance(a, str):
                 a = a.encode()
             out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+        self.sock.sendall(b"".join(out))
+        return self._read_reply()
+
+    def command(self, *args):
         with self._lock:
-            self.sock.sendall(b"".join(out))
-            return self._read_reply()
+            return self._command_locked(*args)
+
+    def rename_if_value(self, key: str, expected: bytes,
+                        dest: str) -> bool:
+        """RENAME key → dest only if its value still equals `expected`
+        — read-compare-rename as ONE critical section under the client
+        lock, so a racing re-put from another handler thread on this
+        connection can never have its fresh value renamed away (the
+        quarantine TOCTOU documented in PR 6). A writer on a DIFFERENT
+        connection can still race between the GET and the RENAME;
+        closing that needs server-side scripting this dependency-free
+        client deliberately avoids — and the window is self-healing
+        (next read misses, re-analyzes, re-puts)."""
+        with self._lock:
+            cur = self._command_locked("GET", key)
+            if cur != expected:
+                return False
+            self._command_locked("RENAME", key, dest)
+            return True
 
     def _read_line(self) -> bytes:
         while b"\r\n" not in self.buf:
@@ -171,18 +193,24 @@ class RedisCache:
         except (json.JSONDecodeError, UnicodeDecodeError, ValueError):
             quarantine = key.replace(f"{PREFIX}::",
                                      f"{PREFIX}::corrupt::", 1)
+            renamed = False
             try:
-                # read→rename is not atomic: a concurrent re-put
-                # between them gets its fresh value renamed away —
-                # the same TOCTOU window FSCache's quarantine accepts,
-                # and self-healing (next read misses, re-analyzes,
-                # re-puts); closing it needs server-side scripting
-                # this dependency-free client deliberately avoids
-                self.client.command("RENAME", key, quarantine)
+                # conditional quarantine: RENAME only while the value
+                # is still the corrupt bytes we just read (one
+                # read-compare-rename critical section under the
+                # client lock) — a re-put that raced in keeps its
+                # fresh value, and this read still serves a miss
+                renamed = self.client.rename_if_value(
+                    key, raw, quarantine)
             except RedisError:
                 pass   # a racing reader already quarantined it
-            _log.warning("quarantined corrupt cache entry %s → %s "
-                         "(serving a miss)", key, quarantine)
+            if renamed:
+                _log.warning("quarantined corrupt cache entry %s → %s "
+                             "(serving a miss)", key, quarantine)
+            else:
+                _log.warning("corrupt cache entry %s was re-put while "
+                             "quarantining; left in place (serving a "
+                             "miss)", key)
             return None
 
     def put_artifact(self, artifact_id: str, info: dict):

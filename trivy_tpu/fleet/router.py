@@ -51,9 +51,9 @@ from ..obs import (RECORDER, current_span_id, current_trace_id,
 from ..obs.recorder import (debug_incidents_payload,
                             debug_traces_payload)
 from ..resilience import Deadline, FailpointError, RetryPolicy, failpoint
-from ..server import (DEADLINE_HEADER, PARENT_SPAN_HEADER,
-                      REPLICA_HEADER, ROUTE_DESCRIPTORS, TOKEN_HEADER,
-                      TRACE_HEADER)
+from ..server import (DB_VERSION_HEADER, DEADLINE_HEADER,
+                      PARENT_SPAN_HEADER, REPLICA_HEADER,
+                      ROUTE_DESCRIPTORS, TOKEN_HEADER, TRACE_HEADER)
 from .ring import HashRing
 from .supervisor import ReplicaOptions, ReplicaSet
 
@@ -63,8 +63,11 @@ _log = _get_logger("fleet.router")
 # header is re-stamped with the remaining budget, and the trace /
 # parent-span headers are stamped per forward from the active span)
 _FORWARD_HEADERS = ("Content-Type", TOKEN_HEADER)
-# replica response headers relayed back to the client
-_RELAY_HEADERS = ("Content-Type", "Retry-After", TRACE_HEADER)
+# replica response headers relayed back to the client (db version
+# included: the client sees WHICH advisory DB answered, and the router
+# reads the same header to count mid-rollout version skew)
+_RELAY_HEADERS = ("Content-Type", "Retry-After", TRACE_HEADER,
+                  DB_VERSION_HEADER)
 
 
 @dataclass
@@ -100,17 +103,87 @@ class RouterState:
         self.opts = opts or RouterOptions()
         self.replicas = [r.rstrip("/") for r in replicas]
         self.ring = HashRing(self.replicas, vnodes=self.opts.vnodes)
-        self.supervisor = ReplicaSet(self.replicas, self.opts.replica,
-                                     probe=probe)
+        self._lock = threading.Lock()
+        # last advertised advisory-DB digest per replica (forward
+        # relays + readmission probes feed this; disagreement = a
+        # mid-rollout fleet whose failovers are not bit-identical)
+        self._db_versions: dict[str, str] = {}
+        self._draining = False
+        self._inflight = 0
+        self.supervisor = ReplicaSet(
+            self.replicas, self.opts.replica, probe=probe,
+            db_version_cb=self.note_db_version)
+
+    # ---- advisory-DB identity -----------------------------------------
+
+    def note_db_version(self, replica: str, version: str) -> None:
+        """Record one replica's advertised db_version (from a relayed
+        Scan response or a readmission probe); warn + count when the
+        fleet now disagrees. Counted per observed CHANGE, not per
+        request, so a sustained skew is one increment per flip."""
+        if not version:
+            return
+        with self._lock:
+            if self._db_versions.get(replica) == version:
+                return
+            self._db_versions[replica] = version
+            skewed = len(set(self._db_versions.values())) > 1
+            snap = dict(self._db_versions)
+        if skewed:
+            METRICS.inc("trivy_tpu_fleet_db_version_skew_total")
+            _log.warning(
+                "fleet: advisory-DB version skew — replicas disagree "
+                "(%s); failovers are NOT bit-identical until the "
+                "rollout converges",
+                ", ".join(f"{r}={v[:19]}" for r, v in sorted(
+                    snap.items())))
+
+    def db_versions(self) -> dict:
+        with self._lock:
+            return dict(self._db_versions)
+
+    # ---- graceful drain ------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        with self._lock:
+            self._draining = True
+
+    def request_started(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def request_finished(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def drain(self, timeout_s: float) -> bool:
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while True:
+            with self._lock:
+                if self._inflight == 0:
+                    return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
 
     def status(self) -> dict:
         """→ /healthz payload."""
         return {
-            "status": "ok",
+            "status": "draining" if self._draining else "ok",
             "fleet": {
                 "ring": {"replicas": self.ring.nodes(),
                          "vnodes": self.ring.vnodes},
                 **self.supervisor.status(),
+                "db_versions": self.db_versions(),
                 "failovers_total": int(
                     METRICS.get("trivy_tpu_fleet_failovers_total")),
             },
@@ -196,17 +269,42 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         t0 = time.perf_counter()
-        # the router MINTS the trace id when the client sent none, so
-        # a routed scan is traceable even from untraced clients; every
-        # forward re-stamps it (plus the per-hop parent span id)
-        tid = self.headers.get(TRACE_HEADER) or ""
-        parent = self.headers.get(PARENT_SPAN_HEADER) or ""
+        st = self.state
+        # count in-flight BEFORE the draining check: a request that
+        # slipped past the check as the signal landed must still hold
+        # the drain open until its forward completes — check-then-count
+        # would let shutdown proceed under it
+        st.request_started()
         try:
+            if st.draining:
+                # graceful drain: stop admitting; in-flight forwards
+                # keep running to completion below. Drain the unread
+                # request body first — replying with it still in the
+                # socket buffer would corrupt this keep-alive
+                # connection's next request.
+                length = int(self.headers.get("Content-Length",
+                                              "0") or 0)
+                if length:
+                    self.rfile.read(length)
+                reset_s = st.opts.replica.reset_timeout_ms / 1e3
+                return self._send(
+                    503, json.dumps({"code": "unavailable",
+                                     "msg": "router draining"}
+                                    ).encode(),
+                    {"Content-Type": "application/json",
+                     "Retry-After": str(max(1, int(reset_s + 0.999)))})
+            # the router MINTS the trace id when the client sent none,
+            # so a routed scan is traceable even from untraced clients;
+            # every forward re-stamps it (plus the per-hop parent span
+            # id)
+            tid = self.headers.get(TRACE_HEADER) or ""
+            parent = self.headers.get(PARENT_SPAN_HEADER) or ""
             with new_trace(tid or None, parent_id=parent or None) as tid:
                 self._trace_id = tid
                 with span("router.rpc", route=self.path):
                     self._do_post()
         finally:
+            st.request_finished()
             METRICS.observe("trivy_tpu_fleet_router_latency_seconds",
                             time.perf_counter() - t0)
 
@@ -349,6 +447,9 @@ class RouterHandler(BaseHTTPRequestHandler):
                         # the replica answered; the CLIENT is wrong —
                         # terminal relay, no failover, domain healthy
                         st.supervisor.record_success(replica)
+                        st.note_db_version(
+                            replica,
+                            e.headers.get(DB_VERSION_HEADER) or "")
                         return (e.code, headers, resp_body, replica)
                     sp.attrs["error"] = f"http {e.code}"
                     st.supervisor.record_failure(replica)
@@ -364,6 +465,11 @@ class RouterHandler(BaseHTTPRequestHandler):
                     continue
                 sp.attrs["status"] = resp[0]
                 st.supervisor.record_success(replica)
+                # skew watch: which advisory DB answered this forward
+                # (failover hops included — a failover onto a replica
+                # running a different DB is exactly the hazard)
+                st.note_db_version(
+                    replica, resp[1].get(DB_VERSION_HEADER) or "")
                 return resp + (replica,)
         raise _Unrouted(0.0 if shed is None else shed_floor, shed)
 
@@ -402,17 +508,46 @@ def dump_fleet_trace(state: RouterState, path: str) -> None:
     _log.warning("graftwatch fleet trace written to %s", path)
 
 
+def drain_router_then_shutdown(httpd, state: RouterState,
+                               grace_s: float = 10.0) -> None:
+    """Graceful router shutdown: stop admitting (503 + Retry-After),
+    let in-flight forwards finish (bounded), then stop the accept
+    loop. serve_router wires SIGTERM/SIGINT here."""
+    _log.warning("router drain: admission stopped; waiting up to "
+                 "%.1fs for %d in-flight request(s)", grace_s,
+                 state.inflight)
+    state.begin_drain()
+    if not state.drain(grace_s):
+        _log.warning("router drain: grace period expired with %d "
+                     "request(s) still in flight; shutting down "
+                     "anyway", state.inflight)
+    httpd.shutdown()
+
+
 def serve_router(host: str, port: int, replicas,
                  opts: RouterOptions | None = None,
                  ready_event: threading.Event | None = None,
-                 trace_path: str = ""):
+                 trace_path: str = "", drain_grace_s: float = 10.0):
     """Run the router in the foreground (CLI `router` command).
-    `trace_path` dumps the assembled fleet trace on shutdown."""
+    `trace_path` dumps the assembled fleet trace on shutdown;
+    `drain_grace_s` bounds the SIGTERM/SIGINT graceful drain."""
     state = RouterState(replicas, opts)
     # per-server subclass (the listen.py pattern): a router and its
     # replicas coexist in one process in tests/bench
     handler = type("RouterHandler", (RouterHandler,), {"state": state})
     httpd = ThreadingHTTPServer((host, port), handler)
+    import signal
+
+    def _on_signal(signum, frame):
+        threading.Thread(target=drain_router_then_shutdown,
+                         args=(httpd, state, drain_grace_s),
+                         name="router-drain", daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+    except ValueError:
+        pass   # not the main thread
     if ready_event is not None:
         ready_event.set()
     try:

@@ -34,14 +34,23 @@ def replica_site(replica: str) -> str:
     return f"fleet.replica:{replica}"
 
 
-def healthz_probe(replica: str, timeout_s: float) -> None:
-    """Default readmission probe: one `/healthz` round-trip (the plain
-    `ok` fast path). Any non-2xx or connection error raises."""
-    req = urllib.request.Request(replica.rstrip("/") + "/healthz",
-                                 headers={"Accept": "text/plain"})
+def healthz_probe(replica: str, timeout_s: float) -> str:
+    """Default readmission probe: one `/healthz` round-trip. Any
+    non-2xx or connection error raises. → the replica's advertised
+    advisory-DB version ('' when absent) so the router's skew watch
+    sees a readmitted replica's DB BEFORE traffic lands on it — a
+    replica restarted mid-rollout may come back serving a different
+    database than the fleet."""
+    req = urllib.request.Request(replica.rstrip("/") + "/healthz")
     with urllib.request.urlopen(req, timeout=timeout_s) as r:
         if r.status != 200:
             raise RuntimeError(f"healthz returned {r.status}")
+        body = r.read()
+    try:
+        import json
+        return str(json.loads(body).get("db_version") or "")
+    except (ValueError, AttributeError):
+        return ""   # plain `ok` or a foreign payload: no version
 
 
 @dataclass
@@ -63,9 +72,12 @@ class ReplicaSet:
     request path."""
 
     def __init__(self, replicas, opts: ReplicaOptions | None = None,
-                 probe=None):
+                 probe=None, db_version_cb=None):
         self.replicas = list(replicas)
         self.opts = opts or ReplicaOptions()
+        # router hook: a successful readmission probe reports the
+        # replica's advertised db_version here (skew accounting)
+        self._db_version_cb = db_version_cb
         self.registry = BreakerRegistry(
             fail_threshold=self.opts.fail_threshold,
             reset_timeout_s=self.opts.reset_timeout_ms / 1e3,
@@ -141,10 +153,10 @@ class ReplicaSet:
                 continue   # still inside the open window
             try:
                 if self._probe is not None:
-                    self._probe(replica)
+                    version = self._probe(replica)
                 else:
-                    healthz_probe(replica,
-                                  self.opts.probe_timeout_ms / 1e3)
+                    version = healthz_probe(
+                        replica, self.opts.probe_timeout_ms / 1e3)
             except Exception:
                 _log.warning("fleet: replica %s probe failed; domain "
                              "stays open", replica, exc_info=True)
@@ -155,6 +167,11 @@ class ReplicaSet:
                 self._lost.discard(replica)
                 self._readmissions += 1
             _log.warning("fleet: replica %s readmitted", replica)
+            if version and self._db_version_cb is not None:
+                try:
+                    self._db_version_cb(replica, str(version))
+                except Exception:
+                    _log.exception("fleet: db-version note failed")
 
     # ---- introspection / lifecycle ------------------------------------
 

@@ -300,6 +300,12 @@ METRICS.declare(
     "walk faulted or shed, or the owner is a lost domain (counted "
     "per forward, so a sustained outage keeps counting).")
 METRICS.declare(
+    "trivy_tpu_fleet_db_version_skew_total", "counter",
+    "Observed advisory-DB version changes that left the fleet's "
+    "replicas disagreeing (relayed X-Trivy-DB-Version headers and "
+    "readmission probes feed it) — while nonzero-rate, failovers are "
+    "not bit-identical.")
+METRICS.declare(
     "trivy_tpu_fleet_cache_hits_total", "counter",
     "Layer-cache blob hits by backend (backend=\"fs\"/\"redis\"/"
     "\"s3\") — on a shared backend, a hit may be serving another "

@@ -14,7 +14,10 @@ This validator checks them offline, with no server running:
     would hang any consumer that walks parents;
   * id discipline: duplicate span ids inside one document are flagged
     (the collect assembler dedupes; a file that still has duplicates
-    was built wrong).
+    was built wrong);
+  * graftstorm replay artifacts (trivy-tpu-storm-replay/1): the
+    schedule grammar and load parameters `--replay` needs, plus the
+    embedded incident document when one was captured.
 
 Wired into tier-1 alongside graftlint (tests/test_graftwatch.py runs
 it over freshly produced incidents and trace dumps, plus corrupted
@@ -157,6 +160,58 @@ def check_trace(doc: dict) -> list[str]:
     return problems
 
 
+def check_storm_replay(doc: dict) -> list[str]:
+    """Validate one graftstorm failing-schedule replay artifact
+    (resilience.storm.REPLAY_SCHEMA): the schedule grammar, the load
+    parameters `--replay` needs to reproduce the run, and — when an
+    incident was captured with it — the embedded incident document."""
+    problems: list[str] = []
+    sched = doc.get("schedule")
+    if not isinstance(sched, dict):
+        problems.append("missing schedule object")
+    else:
+        for field in ("seed", "topology", "horizon_ms"):
+            if field not in sched:
+                problems.append(f"schedule: missing {field}")
+        events = sched.get("events")
+        if not isinstance(events, list):
+            problems.append("schedule: missing events list")
+        else:
+            for i, ev in enumerate(events):
+                if not isinstance(ev, dict):
+                    problems.append(f"events[{i}]: not an object")
+                    continue
+                kind = ev.get("kind", "failpoint")
+                if kind not in ("failpoint", "kill_replica",
+                                "swap_table"):
+                    problems.append(
+                        f"events[{i}]: unknown kind {kind!r}")
+                if not isinstance(ev.get("at_ms"), (int, float)) \
+                        or ev["at_ms"] < 0:
+                    problems.append(
+                        f"events[{i}]: bad at_ms {ev.get('at_ms')!r}")
+                if kind == "failpoint" and not ev.get("site"):
+                    problems.append(f"events[{i}]: failpoint without "
+                                    f"a site")
+    load = doc.get("load")
+    if not isinstance(load, dict):
+        problems.append("missing load object")
+    else:
+        for field in ("requests", "concurrency", "load_seed"):
+            if not isinstance(load.get(field), int):
+                problems.append(f"load: missing {field}")
+    if not isinstance(doc.get("violations"), dict):
+        problems.append("missing violations map")
+    incident = doc.get("incident")
+    if incident is not None:
+        if not isinstance(incident, dict):
+            problems.append("incident is not an object")
+        else:
+            problems += [f"incident: {p}"
+                         for p in check_incident(incident)]
+    return problems
+
+
 def check_file(path: str) -> list[str]:
     """Validate one file, auto-detecting its kind by content."""
     try:
@@ -168,10 +223,12 @@ def check_file(path: str) -> list[str]:
         return ["top level is not an object"]
     if "traceEvents" in doc:
         return check_trace(doc)
+    if doc.get("schema", "").startswith("trivy-tpu-storm-replay"):
+        return check_storm_replay(doc)
     if "schema" in doc or "reason" in doc:
         return check_incident(doc)
-    return ["neither a trace dump (traceEvents) nor an incident file "
-            "(schema/reason)"]
+    return ["neither a trace dump (traceEvents), an incident file "
+            "(schema/reason), nor a storm replay artifact"]
 
 
 def main(argv=None) -> int:

@@ -21,7 +21,12 @@ tolerance (graftguard)"):
               breaker registry keyed by device id, a rebuild
               coordinator that shrinks the mesh to the survivors on
               device loss (and grows it back on readmission) instead
-              of dropping the whole backend to the host fallback.
+              of dropping the whole backend to the host fallback;
+  storm       graftstorm (imported lazily — `python -m
+              trivy_tpu.resilience.storm`): seeded multi-fault chaos
+              schedules over the real in-process topology, a
+              fleet-wide invariant engine, and delta-debugging of
+              failing schedules down to replayable artifacts.
 """
 
 from .admission import AdmissionOptions, AdmissionQueue, Shed

@@ -1,0 +1,1195 @@
+"""graftstorm — seeded multi-fault chaos schedules, a fleet-wide
+invariant engine, and failing-schedule minimization.
+
+PRs 4–7 each shipped hand-written, single-fault chaos drills. This
+module replaces them with one engine in the FoundationDB/Jepsen
+simulation-testing tradition, layered on the closed failpoint catalog:
+
+  schedules    a seeded generator samples a timeline of fault events —
+               failpoint arm/disarm (site, mode, timing, duration,
+               overlap, including `detect.mesh:<slot>` family
+               instances), replica kill/restart, and DB hot swaps —
+               all derived from ONE integer seed, so any run is
+               replayable byte-for-byte (same seed ⇒ same schedule,
+               JSON-identical).
+  harness      a runner stands up the real in-process topology
+               (single server, mesh server, or router + N replicas via
+               serve_background / serve_router_background), runs an
+               unfaulted ORACLE pass, then drives a seeded concurrent
+               scan load over HTTP while a driver thread executes the
+               schedule against the live process.
+  invariants   a registry of probes evaluated after the run: every
+               request completed or was shed with a WELL-FORMED
+               429/503/504 (none lost), completed results are
+               bit-identical to the oracle, every breaker returns to
+               closed after the faults clear (liveness), no surviving
+               non-daemon threads, /metrics stays strict-exposition-
+               parseable with shed-aware accounting, and a breaker
+               opening produced a graftwatch incident file.
+  minimization on invariant failure, the schedule is delta-debugged
+               (drop events, then shorten windows) down to a minimal
+               failing schedule, written with the captured incident as
+               a replayable artifact (`--replay FILE` re-runs it;
+               `python -m trivy_tpu.obs.check` validates it offline).
+
+CLI:  python -m trivy_tpu.resilience.storm \
+          --seed N --rounds K --topology {single,mesh,fleet}
+
+Everything here is host-side orchestration (graftlint TPU106 lock
+hygiene applies; TPU107/TPU108 keep the probes out of device code).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import asdict, dataclass, field, replace
+
+from ..log import get as _get_logger
+from ..metrics import METRICS
+from .breaker import GUARD
+from .failpoints import FAILPOINTS
+
+_log = _get_logger("resilience.storm")
+
+TOPOLOGIES = ("single", "mesh", "fleet")
+REPLAY_SCHEMA = "trivy-tpu-storm-replay/1"
+
+# fault menu per topology: ONLY faults the resilience stack is designed
+# to absorb (host fallback, mesh shrink, router failover). rpc.scan
+# error/flaky surface as 500s to a directly-connected client by design,
+# so they are fleet-only — the router never relays a 5xx.
+_SINGLE_FAULTS = (
+    ("detect.dispatch", "error"), ("detect.dispatch", "hang"),
+    ("detect.dispatch", "slow"), ("detect.dispatch", "flaky"),
+    ("detect.device_get", "error"), ("detect.device_get", "flaky"),
+    ("detect.compile", "error"), ("rpc.scan", "slow"),
+)
+_MESH_FAULTS = (
+    ("detect.mesh", "error"), ("detect.mesh", "hang"),
+    ("detect.mesh", "flaky"),
+)
+_FLEET_FAULTS = (
+    ("rpc.route", "error"), ("rpc.route", "flaky"),
+    ("rpc.route", "slow"), ("rpc.scan", "error"),
+    ("rpc.scan", "flaky"),
+)
+
+
+# ---------------------------------------------------------------------------
+# schedule grammar
+
+
+@dataclass
+class StormEvent:
+    """One timeline entry. `at_ms` is the offset from load start;
+    `dur_ms` bounds the armed window (0 = until the schedule ends).
+
+    kinds:
+      failpoint     arm `site=mode(arg[,seed])` at at_ms, clear at
+                    at_ms+dur_ms. A `detect.mesh:<slot>` site names a
+                    mesh SLOT (0-based position in the boot mesh); the
+                    runner maps it to the actual device id, so the
+                    schedule stays runtime-independent.
+      kill_replica  shut replica `replica` down at at_ms, restart it on
+                    the same port at at_ms+dur_ms (fleet only).
+      swap_table    trigger a DB hot swap through the generation drain
+                    on replica `replica` (0 outside fleet).
+    """
+    at_ms: float
+    kind: str = "failpoint"
+    site: str = ""
+    mode: str = ""
+    arg: float = 0.0
+    seed: int = 0
+    dur_ms: float = 0.0
+    replica: int = 0
+
+    def label(self) -> str:
+        if self.kind == "failpoint":
+            arg = "" if self.mode == "error" else f":{self.arg:g}"
+            return (f"{self.site}={self.mode}{arg}"
+                    f"@{self.at_ms:g}+{self.dur_ms:g}ms")
+        return f"{self.kind}[{self.replica}]@{self.at_ms:g}ms"
+
+
+@dataclass
+class Schedule:
+    seed: int
+    topology: str
+    horizon_ms: float
+    events: list[StormEvent] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"seed": self.seed, "topology": self.topology,
+                "horizon_ms": self.horizon_ms,
+                "events": [asdict(e) for e in self.events]}
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "Schedule":
+        return cls(int(doc["seed"]), str(doc["topology"]),
+                   float(doc["horizon_ms"]),
+                   [StormEvent(**e) for e in doc.get("events", [])])
+
+
+def generate_schedule(seed: int, topology: str, n_events: int = 4,
+                      horizon_ms: float = 1500.0, mesh_devices: int = 4,
+                      replicas: int = 3,
+                      watchdog_ms: float = 50.0) -> Schedule:
+    """Sample one fault timeline from `seed`. Deterministic: the same
+    (seed, topology, knobs) always yields a JSON-identical schedule.
+    Windows overlap by construction (starts land in the first 60% of
+    the horizon, durations span 25–60% of it)."""
+    if topology not in TOPOLOGIES:
+        raise ValueError(f"unknown topology {topology!r} "
+                         f"(known: {', '.join(TOPOLOGIES)})")
+    rng = random.Random(seed)
+    menu: list[tuple[str, str]] = list(_SINGLE_FAULTS)
+    kinds = ["failpoint"] * 3 + ["swap_table"]
+    if topology == "mesh":
+        menu += list(_MESH_FAULTS) * 2     # mesh domains get airtime
+    if topology == "fleet":
+        menu += list(_FLEET_FAULTS)
+        kinds += ["kill_replica"] * 2
+    events: list[StormEvent] = []
+    used_sites: set[str] = set()
+    for _ in range(max(int(n_events), 1)):
+        at = rng.uniform(0.0, horizon_ms * 0.6)
+        dur = rng.uniform(horizon_ms * 0.25, horizon_ms * 0.6)
+        kind = rng.choice(kinds)
+        if kind == "kill_replica":
+            events.append(StormEvent(
+                at_ms=round(at, 1), kind="kill_replica",
+                dur_ms=round(dur, 1),
+                replica=rng.randrange(max(replicas, 1))))
+            continue
+        if kind == "swap_table":
+            events.append(StormEvent(
+                at_ms=round(at, 1), kind="swap_table",
+                replica=rng.randrange(max(replicas, 1))
+                if topology == "fleet" else 0))
+            continue
+        # one spec per site at a time: overlapping arms on one site
+        # would overwrite each other and confuse minimization
+        for _attempt in range(8):
+            site, mode = menu[rng.randrange(len(menu))]
+            if site == "detect.mesh":
+                site = f"detect.mesh:{rng.randrange(max(mesh_devices, 1))}"
+            if site not in used_sites:
+                break
+        if site in used_sites:
+            continue
+        used_sites.add(site)
+        arg, spec_seed = 0.0, 0
+        if mode == "hang":
+            # must outlive the watchdog deadline to be a hang at all
+            arg = round(rng.uniform(watchdog_ms * 2.2,
+                                    watchdog_ms * 4.0), 1)
+        elif mode == "slow":
+            arg = round(rng.uniform(5.0, 25.0), 1)
+        elif mode == "flaky":
+            arg = round(rng.uniform(0.1, 0.4), 3)
+            spec_seed = rng.randrange(1 << 16)
+        events.append(StormEvent(
+            at_ms=round(at, 1), site=site, mode=mode, arg=arg,
+            seed=spec_seed, dur_ms=round(dur, 1)))
+    events.sort(key=lambda e: (e.at_ms, e.kind, e.site, e.replica))
+    return Schedule(seed, topology, horizon_ms, events)
+
+
+# ---------------------------------------------------------------------------
+# seeded workload: a self-contained advisory table + scan request docs
+
+
+def storm_table(n_pkgs: int = 16, seed: int = 604):
+    """Small deterministic AdvisoryTable so the engine needs no
+    fixture files: every package gets 1–3 alpine-style advisories with
+    seeded fixed-version bounds."""
+    from ..db.table import RawAdvisory, build_table
+    rng = random.Random(seed)
+    raw, details = [], {}
+    for i in range(n_pkgs):
+        name = f"storm-pkg-{i}"
+        for j in range(rng.randrange(1, 4)):
+            vid = f"CVE-2026-{i:03d}{j}"
+            raw.append(RawAdvisory(
+                source="alpine 3.17", ecosystem="alpine",
+                pkg_name=name, vuln_id=vid,
+                fixed_version=f"{1 + j}.{rng.randrange(10)}.0-r0",
+                severity=rng.choice(("LOW", "MEDIUM", "HIGH"))))
+            details[vid] = {"Title": f"storm planted bug {vid}",
+                            "Severity": "HIGH"}
+    return build_table(raw, details)
+
+
+def request_doc(load_seed: int, idx: int, n_pkgs: int = 16) -> dict:
+    """The idx-th scan request of a seeded load: a blob document whose
+    DiffID doubles as the artifact id (PutBlob and Scan key to the
+    same ring owner, the test_fleet convention)."""
+    rng = random.Random((load_seed << 20) ^ idx)
+    diff = "sha256:" + hashlib.sha256(
+        f"storm|{load_seed}|{idx}".encode()).hexdigest()
+    pkgs = []
+    for _ in range(rng.randrange(1, 7)):
+        k = rng.randrange(n_pkgs)
+        ver = f"{rng.randrange(1, 4)}.{rng.randrange(10)}.0-r0"
+        pkgs.append({"Name": f"storm-pkg-{k}", "Version": ver,
+                     "SrcName": f"storm-pkg-{k}", "SrcVersion": ver})
+    return {
+        "SchemaVersion": 2, "DiffID": diff,
+        "OS": {"Family": "alpine", "Name": "3.17.3"},
+        "PackageInfos": [{"FilePath": "lib/apk/db/installed",
+                          "Packages": pkgs}],
+    }
+
+
+# ---------------------------------------------------------------------------
+# options, outcomes, report
+
+
+@dataclass
+class StormOptions:
+    """Runner knobs (CLI flags of the same names)."""
+    requests: int = 24
+    concurrency: int = 8
+    load_seed: int = 0          # 0 = derived from the schedule seed
+    replicas: int = 3           # fleet width
+    mesh_devices: int = 4
+    mesh_db_shards: int = 2
+    watchdog_ms: float = 50.0   # graftguard dispatch deadline
+    breaker_reset_ms: float = 150.0
+    admit_max_active: int = 0   # 0 = unbounded (no admission sheds)
+    admit_max_queue: int = 8
+    settle_s: float = 8.0       # post-schedule liveness window
+    request_timeout_s: float = 30.0
+    artifact_dir: str = ""      # incident/replay dir ("" = tmpdir)
+
+
+@dataclass
+class Outcome:
+    idx: int
+    status: str          # "ok" | "shed" | "lost"
+    code: int = 0
+    digest: str = ""
+    latency_ms: float = 0.0
+    detail: str = ""
+    well_formed: bool = True
+
+    def key(self) -> tuple:
+        return (self.idx, self.status, self.code, self.digest)
+
+
+@dataclass
+class StormReport:
+    schedule: Schedule
+    outcomes: list[Outcome]
+    oracle: dict[int, str]
+    violations: dict[str, list[str]]
+    incident_dir: str = ""
+    duration_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def sheds(self) -> int:
+        return sum(1 for o in self.outcomes if o.status == "shed")
+
+    def p99_ms(self) -> float:
+        lats = sorted(o.latency_ms for o in self.outcomes
+                      if o.status == "ok")
+        if not lats:
+            return 0.0
+        return lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+
+    def summary(self) -> dict:
+        return {
+            "seed": self.schedule.seed,
+            "topology": self.schedule.topology,
+            "events": [e.label() for e in self.schedule.events],
+            "requests": len(self.outcomes),
+            "ok": self.ok,
+            "sheds": self.sheds(),
+            "p99_ms": round(self.p99_ms(), 2),
+            "violations": self.violations,
+            "duration_s": round(self.duration_s, 2),
+        }
+
+
+def canonical_digest(doc: dict) -> str:
+    return hashlib.sha256(json.dumps(
+        doc, sort_keys=True, separators=(",", ":")).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# topologies
+
+
+def _post(base: str, route: str, doc: dict, timeout: float,
+          headers: dict | None = None):
+    """→ (status, headers, parsed-json body). Raises on transport
+    errors; HTTP error statuses are returned, not raised."""
+    req = urllib.request.Request(
+        base + route, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            parsed = json.loads(body) if body else {}
+        except json.JSONDecodeError:
+            parsed = {"_raw": body.decode(errors="replace")[:200]}
+        return e.code, dict(e.headers), parsed
+
+
+class _Topology:
+    """Common surface the runner drives: a scan URL, schedule-event
+    application, metrics endpoints, and teardown."""
+
+    kind = ""
+
+    def __init__(self, table, opts: StormOptions):
+        self.table = table
+        self.opts = opts
+
+    # the base URL scans go to (router for fleet, server otherwise)
+    url: str = ""
+
+    def metrics_urls(self) -> list[str]:
+        return [self.url]
+
+    def server_states(self) -> list:
+        raise NotImplementedError
+
+    def apply(self, ev: StormEvent) -> None:
+        """Arm one schedule event against the live topology."""
+        if ev.kind == "failpoint":
+            site = self.resolve_site(ev.site)
+            if site:
+                FAILPOINTS.set(site, ev.mode, ev.arg, seed=ev.seed)
+        elif ev.kind == "swap_table":
+            self.swap(ev.replica)
+        elif ev.kind == "kill_replica":
+            self.kill(ev.replica)
+
+    def revert(self, ev: StormEvent) -> None:
+        """Disarm one event at the end of its window."""
+        if ev.kind == "failpoint":
+            site = self.resolve_site(ev.site)
+            if site:
+                FAILPOINTS.clear(site)
+        elif ev.kind == "kill_replica":
+            self.restart(ev.replica)
+
+    def resolve_site(self, site: str) -> str:
+        """Map `detect.mesh:<slot>` to the runtime device id;
+        passthrough otherwise. '' drops the event (site not
+        applicable to this topology instance)."""
+        return site
+
+    def swap(self, replica: int) -> None:
+        states = self.server_states()
+        if states:
+            states[replica % len(states)].swap_table(self.table)
+
+    def kill(self, replica: int) -> None:
+        pass
+
+    def restart(self, replica: int) -> None:
+        pass
+
+    def settled(self) -> list[str]:
+        """→ [] once every breaker/fault-domain is closed again."""
+        problems = []
+        if GUARD.breaker.state_name() != "closed":
+            problems.append(
+                f"backend breaker {GUARD.breaker.state_name()}")
+        return problems
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class SingleTopology(_Topology):
+    kind = "single"
+
+    def __init__(self, table, opts: StormOptions, mesh_opts=None):
+        super().__init__(table, opts)
+        from ..resilience import AdmissionOptions
+        from ..server.listen import serve_background
+        admission = AdmissionOptions(
+            max_active=opts.admit_max_active,
+            max_queue=opts.admit_max_queue)
+        self.httpd, self.state = serve_background(
+            "127.0.0.1", 0, table, cache_dir="",
+            cache_backend="memory", admission=admission,
+            mesh_opts=mesh_opts)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def server_states(self):
+        return [self.state]
+
+    def resolve_site(self, site: str) -> str:
+        return "" if site.startswith("detect.mesh:") else site
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.state.close()
+
+
+class MeshTopology(SingleTopology):
+    kind = "mesh"
+
+    def __init__(self, table, opts: StormOptions):
+        from ..server.listen import MeshOptions
+        super().__init__(table, opts, mesh_opts=MeshOptions(
+            devices=opts.mesh_devices, db_shards=opts.mesh_db_shards,
+            min_devices=1, rebuild_cooldown_ms=20.0,
+            # the per-device watch deadline: a schedule's mesh hang
+            # (arg > 2× watchdog_ms by construction) must TRIP the
+            # domain, not read as mere slowness
+            probe_timeout_ms=opts.watchdog_ms))
+        # fast readmission so the liveness invariant settles in-window
+        self.state.mesh_guard.opts.probe_interval_ms = 20.0
+        self.state.mesh_guard.registry.reset_timeout_s = \
+            opts.breaker_reset_ms / 1e3
+
+    def resolve_site(self, site: str) -> str:
+        if site.startswith("detect.mesh:"):
+            slot = int(site.split(":", 1)[1])
+            ids = self.state.mesh_guard.all_ids
+            from .meshguard import mesh_site
+            return mesh_site(ids[slot % len(ids)])
+        return site
+
+    def settled(self) -> list[str]:
+        problems = super().settled()
+        guard = self.state.mesh_guard
+        lost = guard.lost_ids()
+        if lost:
+            problems.append(f"mesh devices still lost: {lost}")
+        for dev, st in guard.status()["breakers"].items():
+            if st["state"] != "closed":
+                problems.append(f"mesh device {dev} breaker "
+                                f"{st['state']}")
+        return problems
+
+
+class FleetTopology(_Topology):
+    kind = "fleet"
+
+    def __init__(self, table, opts: StormOptions):
+        from ..fanal.cache import MemoryCache
+        from ..fleet import (ReplicaOptions, RouterOptions,
+                             serve_router_background)
+        from ..resilience import RetryPolicy
+        self.table = table
+        self.opts = opts
+        # one shared in-process cache: a failover Scan finds its blobs
+        # wherever it lands (the graftfleet redis/s3 contract, without
+        # a socket in the loop)
+        self.shared_cache = MemoryCache()
+        self.replicas: list = []     # slot → (httpd, state, url) | None
+        self.ports: list[int] = []
+        for _ in range(opts.replicas):
+            self.replicas.append(None)
+            self.ports.append(0)
+        for slot in range(opts.replicas):
+            self._start(slot)
+        urls = [entry[2] for entry in self.replicas]
+        self.router, self.router_state = serve_router_background(
+            "127.0.0.1", 0, urls,
+            RouterOptions(
+                retry=RetryPolicy(attempts=4, base_delay_s=0.01,
+                                  max_delay_s=0.05, budget_s=5.0),
+                replica=ReplicaOptions(
+                    fail_threshold=2,
+                    reset_timeout_ms=opts.breaker_reset_ms,
+                    probe_interval_ms=50.0,
+                    probe_timeout_ms=2000.0)))
+        self.url = f"http://127.0.0.1:{self.router.server_address[1]}"
+
+    def _start(self, slot: int) -> None:
+        from ..resilience import AdmissionOptions
+        from ..server.listen import serve_background
+        httpd, state = serve_background(
+            "127.0.0.1", self.ports[slot], self.table, cache_dir="",
+            cache_backend=self.shared_cache,
+            admission=AdmissionOptions(
+                max_active=self.opts.admit_max_active,
+                max_queue=self.opts.admit_max_queue))
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        self.replicas[slot] = (httpd, state, url)
+        self.ports[slot] = httpd.server_address[1]
+
+    def server_states(self):
+        return [entry[1] for entry in self.replicas
+                if entry is not None]
+
+    def metrics_urls(self) -> list[str]:
+        return [self.url] + [entry[2] for entry in self.replicas
+                             if entry is not None]
+
+    def resolve_site(self, site: str) -> str:
+        return "" if site.startswith("detect.mesh:") else site
+
+    def swap(self, replica: int) -> None:
+        entry = self.replicas[replica % len(self.replicas)]
+        if entry is not None:
+            entry[1].swap_table(self.table)
+
+    def kill(self, replica: int) -> None:
+        slot = replica % len(self.replicas)
+        entry = self.replicas[slot]
+        if entry is None:
+            return
+        httpd, state, _url = entry
+        self.replicas[slot] = None
+        httpd.shutdown()
+        httpd.server_close()
+        state.close()
+
+    def restart(self, replica: int) -> None:
+        slot = replica % len(self.replicas)
+        if self.replicas[slot] is None:
+            self._start(slot)
+
+    def settled(self) -> list[str]:
+        problems = super().settled()
+        lost = self.router_state.supervisor.lost()
+        if lost:
+            problems.append(f"replicas still lost: {lost}")
+        return problems
+
+    def close(self) -> None:
+        self.router.shutdown()
+        self.router.server_close()
+        self.router_state.close()
+        for slot in range(len(self.replicas)):
+            self.kill(slot)
+
+
+def build_topology(table, schedule: Schedule,
+                   opts: StormOptions) -> _Topology:
+    if schedule.topology == "single":
+        return SingleTopology(table, opts)
+    if schedule.topology == "mesh":
+        return MeshTopology(table, opts)
+    if schedule.topology == "fleet":
+        return FleetTopology(table, opts)
+    raise ValueError(f"unknown topology {schedule.topology!r}")
+
+
+# ---------------------------------------------------------------------------
+# strict exposition check — ONE definition of "strict", shared with the
+# tier-1 gate (tests/helpers.py re-exports the same parser)
+
+
+def check_exposition(text: str) -> list[str]:
+    """Validate one /metrics payload under the strict exposition
+    parser (obs.exposition: TYPE-before-sample, label escaping,
+    histogram cumulativity, +Inf == _count); → [] when clean."""
+    from ..obs.exposition import parse_exposition
+    try:
+        parse_exposition(text)
+    except ValueError as e:
+        return [str(e)]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# invariant registry
+
+INVARIANTS: dict = {}
+
+
+def invariant(name: str):
+    def deco(fn):
+        INVARIANTS[name] = fn
+        return fn
+    return deco
+
+
+@dataclass
+class RunContext:
+    """Everything the invariant probes see after one run."""
+    schedule: Schedule
+    opts: StormOptions
+    outcomes: list[Outcome]
+    oracle: dict[int, str]
+    settle_problems: list[str]
+    leaked_threads: list[str]
+    metrics: dict[str, str]            # url → /metrics text
+    shed_counter_delta: float
+    breaker_opens: int                 # breaker_open events in-window
+    incident_files: list[str]
+    incident_dir: str
+
+
+@invariant("no_lost_requests")
+def _inv_lost(ctx: RunContext) -> list[str]:
+    out = []
+    for o in ctx.outcomes:
+        if o.status == "lost":
+            out.append(f"request {o.idx}: {o.code or 'conn'} "
+                       f"{o.detail}")
+        elif o.status == "shed" and not o.well_formed:
+            out.append(f"request {o.idx}: malformed shed "
+                       f"({o.code}: {o.detail})")
+    return out
+
+
+@invariant("bit_identity")
+def _inv_identity(ctx: RunContext) -> list[str]:
+    out = []
+    for o in ctx.outcomes:
+        if o.status != "ok":
+            continue
+        want = ctx.oracle.get(o.idx)
+        if want is not None and o.digest != want:
+            out.append(f"request {o.idx}: result drifted from the "
+                       f"unfaulted oracle")
+    return out
+
+
+@invariant("breakers_reclose")
+def _inv_liveness(ctx: RunContext) -> list[str]:
+    return list(ctx.settle_problems)
+
+
+@invariant("no_leaked_threads")
+def _inv_threads(ctx: RunContext) -> list[str]:
+    return [f"surviving non-daemon thread {n}"
+            for n in ctx.leaked_threads]
+
+
+@invariant("metrics_wellformed")
+def _inv_metrics(ctx: RunContext) -> list[str]:
+    out = []
+    for url, text in ctx.metrics.items():
+        if text is None:
+            out.append(f"{url}/metrics unreachable after the run")
+            continue
+        for p in check_exposition(text):
+            out.append(f"{url}: {p}")
+    # shed-aware accounting: sheds a directly-connected client saw
+    # must show up in the server's shed counter (fleet sheds may be
+    # router-minted, so only the direct topologies assert the delta)
+    client_sheds = sum(1 for o in ctx.outcomes if o.status == "shed")
+    if ctx.schedule.topology != "fleet" and client_sheds \
+            and ctx.shed_counter_delta <= 0:
+        out.append(f"{client_sheds} client-visible sheds but "
+                   f"trivy_tpu_requests_shed_total never moved")
+    return out
+
+
+@invariant("incident_on_breaker_open")
+def _inv_incident(ctx: RunContext) -> list[str]:
+    if ctx.breaker_opens and not ctx.incident_files:
+        return [f"{ctx.breaker_opens} breaker opening(s) but no "
+                f"incident file in {ctx.incident_dir}"]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# the runner
+
+
+def _nondaemon_threads() -> dict[int, str]:
+    return {t.ident: t.name for t in threading.enumerate()
+            if not t.daemon and t.ident is not None}
+
+
+class _ScheduleDriver(threading.Thread):
+    """Executes arm/revert actions at their offsets from the shared
+    epoch `t0` (the load workers pace their requests against the same
+    epoch, so schedule windows genuinely overlap the traffic). When
+    the load drains early, `flush()` runs every remaining action
+    immediately (a kill without its restart would fail the liveness
+    probe for no interesting reason)."""
+
+    def __init__(self, topo: _Topology, schedule: Schedule,
+                 t0: float):
+        super().__init__(name="storm-driver", daemon=True)
+        actions: list[tuple[float, int, StormEvent, str]] = []
+        for n, ev in enumerate(schedule.events):
+            actions.append((ev.at_ms, n, ev, "apply"))
+            if ev.kind == "kill_replica" or (
+                    ev.kind == "failpoint" and ev.dur_ms > 0):
+                end = ev.at_ms + (ev.dur_ms or schedule.horizon_ms)
+                actions.append((end, n, ev, "revert"))
+        actions.sort(key=lambda a: (a[0], a[1]))
+        self._actions = actions
+        self._topo = topo
+        self._cursor = 0
+        self._cv = threading.Condition()
+        self._flushed = False
+        self.t0 = t0
+
+    def run(self) -> None:
+        while True:
+            with self._cv:
+                if self._cursor >= len(self._actions):
+                    return
+                at_ms, _, ev, op = self._actions[self._cursor]
+                if self._flushed:
+                    wait = 0.0
+                else:
+                    wait = at_ms / 1e3 - (time.monotonic() - self.t0)
+                if wait > 0:
+                    self._cv.wait(timeout=min(wait, 0.05))
+                    continue
+                self._cursor += 1
+            self._fire(ev, op)
+
+    def _fire(self, ev: StormEvent, op: str) -> None:
+        _log.info("storm: %s %s", op, ev.label())
+        try:
+            if op == "apply":
+                self._topo.apply(ev)
+            else:
+                self._topo.revert(ev)
+        except Exception:
+            _log.exception("storm: %s %s failed", op, ev.label())
+
+    def flush(self) -> None:
+        with self._cv:
+            self._flushed = True
+            self._cv.notify()
+        self.join(timeout=30.0)
+
+
+def _classify(idx: int, code: int, headers: dict, body,
+              latency_ms: float) -> Outcome:
+    if 200 <= code < 300:
+        return Outcome(idx, "ok", code, canonical_digest(body),
+                       latency_ms)
+    if code in (429, 503):
+        well = True
+        detail = ""
+        try:
+            ra = float(headers.get("Retry-After") or "")
+            if ra < 1.0:
+                well, detail = False, f"Retry-After {ra} < 1"
+        except ValueError:
+            well, detail = False, "missing/unparseable Retry-After"
+        if not isinstance(body, dict) or body.get("code") not in (
+                "resource_exhausted", "unavailable"):
+            well, detail = False, f"bad shed body {body!r}"[:120]
+        return Outcome(idx, "shed", code, latency_ms=latency_ms,
+                       detail=detail, well_formed=well)
+    if code == 504:
+        well = isinstance(body, dict) and \
+            body.get("code") == "deadline_exceeded"
+        return Outcome(idx, "shed", code, latency_ms=latency_ms,
+                       detail="" if well else f"bad 504 body {body!r}",
+                       well_formed=well)
+    return Outcome(idx, "lost", code, latency_ms=latency_ms,
+                   detail=str(body)[:160])
+
+
+def _scan_once(url: str, doc: dict, timeout: float) -> Outcome:
+    diff = doc["DiffID"]
+    t0 = time.perf_counter()
+    try:
+        code, headers, body = _post(
+            url, "/twirp/trivy.scanner.v1.Scanner/Scan",
+            {"target": diff[:19], "artifact_id": diff,
+             "blob_ids": [diff], "options": {"scanners": ["vuln"]}},
+            timeout=timeout,
+            headers={"X-Trivy-Deadline-Ms": str(int(timeout * 1e3))})
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return Outcome(-1, "lost",
+                       latency_ms=(time.perf_counter() - t0) * 1e3,
+                       detail=f"{type(e).__name__}: {e}"[:160])
+    return _classify(-1, code, headers, body,
+                     (time.perf_counter() - t0) * 1e3)
+
+
+def run_storm(schedule: Schedule, opts: StormOptions | None = None,
+              table=None, oracle: dict[int, str] | None = None
+              ) -> StormReport:
+    """Stand up the topology, run the oracle pass (unless given), push
+    the blobs, drive the concurrent load while the schedule executes,
+    settle, tear down, evaluate every invariant probe."""
+    opts = opts or StormOptions()
+    if table is None:
+        table = storm_table()
+    load_seed = opts.load_seed or schedule.seed
+    docs = [request_doc(load_seed, i) for i in range(opts.requests)]
+
+    # per-run incident capture (the invariant needs to see THIS run's
+    # files); RECORDER is process-global, so save/restore its config
+    from ..obs.recorder import RECORDER
+    run_dir = tempfile.mkdtemp(
+        prefix=f"storm-{schedule.topology}-{schedule.seed}-",
+        dir=opts.artifact_dir or None)
+    saved = (RECORDER.incident_dir, RECORDER.incident_cooldown_s)
+    saved_guard = (GUARD.dispatch_timeout_s,
+                   GUARD.breaker.fail_threshold,
+                   GUARD.breaker.reset_timeout_s)
+    RECORDER.configure(incident_dir=run_dir, incident_cooldown_s=0.05)
+    FAILPOINTS.configure("")
+    GUARD.breaker.reset()
+    GUARD.configure(dispatch_timeout_s=opts.watchdog_ms / 1e3,
+                    fail_threshold=3,
+                    reset_timeout_s=opts.breaker_reset_ms / 1e3)
+    baseline_threads = _nondaemon_threads()
+    shed0 = METRICS.get("trivy_tpu_requests_shed_total")
+    events0 = len(RECORDER.events())
+    t_run0 = time.perf_counter()
+
+    topo = build_topology(table, schedule, opts)
+    try:
+        # blobs first (faults start with the load, not the setup)
+        for doc in docs:
+            code, _, body = _post(
+                topo.url, "/twirp/trivy.cache.v1.Cache/PutBlob",
+                {"diff_id": doc["DiffID"], "blob_info": doc},
+                timeout=opts.request_timeout_s)
+            if code != 200:
+                raise RuntimeError(f"storm setup: PutBlob → {code} "
+                                   f"{body}")
+        if oracle is None:
+            oracle = {}
+            for i, doc in enumerate(docs):
+                o = _scan_once(topo.url, doc, opts.request_timeout_s)
+                if o.status != "ok":
+                    raise RuntimeError(
+                        f"storm oracle pass failed on request {i}: "
+                        f"{o.status} {o.code} {o.detail}")
+                oracle[i] = o.digest
+
+        # the storm pass: concurrent load + schedule driver, all paced
+        # against one epoch. Requests spread across ~85% of the
+        # horizon so the schedule's windows overlap real traffic —
+        # warm-compile runs would otherwise drain the whole load
+        # before the first event fires, and the storm would test
+        # nothing. Pacing is a deterministic function of the request
+        # index (replay keeps the same arrival plan).
+        outcomes: list = [None] * len(docs)
+        t0 = time.monotonic() + 0.02
+        span_s = schedule.horizon_ms * 0.85 / 1e3
+        driver = _ScheduleDriver(topo, schedule, t0)
+
+        def worker(ids):
+            for i in ids:
+                delay = t0 + (i / max(len(docs), 1)) * span_s \
+                    - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    o = _scan_once(topo.url, docs[i],
+                                   opts.request_timeout_s)
+                except Exception as e:  # noqa: BLE001 — a surprise
+                    # (e.g. a 200 with a truncated body) is exactly a
+                    # lost request; the invariant engine must REPORT
+                    # it, not die on a None outcome
+                    o = Outcome(i, "lost",
+                                detail=f"{type(e).__name__}: {e}"[:160])
+                o.idx = i
+                outcomes[i] = o
+
+        threads = [threading.Thread(
+            target=worker, name=f"storm-load-{k}",
+            args=(range(k, len(docs), opts.concurrency),))
+            for k in range(opts.concurrency)]
+        driver.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        driver.flush()
+        FAILPOINTS.configure("")   # safety net past driver bugs
+
+        # settle: faults cleared — every breaker must find its way
+        # back to closed (liveness). Serial probe scans admit the
+        # half-open device probe; mesh/fleet readmission loops run on
+        # their own maintenance threads.
+        settle_deadline = time.monotonic() + opts.settle_s
+        time.sleep(opts.breaker_reset_ms / 1e3)
+        settle_problems = topo.settled()
+        while settle_problems and time.monotonic() < settle_deadline:
+            _scan_once(topo.url, docs[0], opts.request_timeout_s)
+            time.sleep(0.05)
+            settle_problems = topo.settled()
+
+        metrics: dict = {}
+        for url in topo.metrics_urls():
+            try:
+                with urllib.request.urlopen(url + "/metrics",
+                                            timeout=10) as r:
+                    metrics[url] = r.read().decode()
+            except (urllib.error.URLError, OSError):
+                metrics[url] = None
+    finally:
+        try:
+            topo.close()
+        finally:
+            FAILPOINTS.configure("")
+            GUARD.configure(dispatch_timeout_s=saved_guard[0],
+                            fail_threshold=saved_guard[1],
+                            reset_timeout_s=saved_guard[2])
+            GUARD.breaker.reset()
+            RECORDER.configure(incident_dir=saved[0],
+                               incident_cooldown_s=saved[1])
+
+    # leaked threads: everything the run created must be gone
+    leak_deadline = time.monotonic() + 10.0
+    leaked = {}
+    while time.monotonic() < leak_deadline:
+        leaked = {i: n for i, n in _nondaemon_threads().items()
+                  if i not in baseline_threads}
+        if not leaked:
+            break
+        time.sleep(0.05)
+
+    breaker_opens = sum(
+        1 for ev in RECORDER.events()[events0:]
+        if ev.get("kind") == "breaker_open")
+    try:
+        incident_files = sorted(
+            n for n in os.listdir(run_dir) if n.endswith(".json"))
+    except OSError:
+        incident_files = []
+
+    ctx = RunContext(
+        schedule=schedule, opts=opts, outcomes=outcomes,
+        oracle=oracle, settle_problems=settle_problems,
+        leaked_threads=sorted(leaked.values()), metrics=metrics,
+        shed_counter_delta=METRICS.get(
+            "trivy_tpu_requests_shed_total") - shed0,
+        breaker_opens=breaker_opens, incident_files=incident_files,
+        incident_dir=run_dir)
+    violations = {}
+    for name, probe in INVARIANTS.items():
+        msgs = probe(ctx)
+        if msgs:
+            violations[name] = msgs
+    return StormReport(schedule=schedule, outcomes=outcomes,
+                       oracle=oracle, violations=violations,
+                       incident_dir=run_dir,
+                       duration_s=time.perf_counter() - t_run0)
+
+
+# ---------------------------------------------------------------------------
+# minimization: delta-debug a failing schedule
+
+
+def minimize_schedule(schedule: Schedule, opts: StormOptions,
+                      table=None, oracle: dict[int, str] | None = None,
+                      max_trials: int = 24
+                      ) -> tuple[Schedule, StormReport, int]:
+    """Shrink a failing schedule to a minimal one that still fails:
+    greedy event drops to a fixpoint, then window halving. → (minimal
+    schedule, its failing report, trials spent). The caller supplies
+    the oracle so trials never re-run the unfaulted pass."""
+    if table is None:
+        table = storm_table()
+    trials = 0
+    last_fail: StormReport | None = None
+
+    def fails(evts: list[StormEvent]) -> bool:
+        nonlocal trials, last_fail
+        if trials >= max_trials:
+            return False
+        trials += 1
+        rep = run_storm(replace(schedule, events=evts), opts,
+                        table=table, oracle=oracle)
+        if not rep.ok:
+            last_fail = rep
+        return not rep.ok
+
+    events = list(schedule.events)
+    i = 0
+    while i < len(events) and len(events) > 1:
+        cand = events[:i] + events[i + 1:]
+        if fails(cand):
+            events = cand        # dropped; retry the same position
+        else:
+            i += 1
+    for i, ev in enumerate(list(events)):
+        while ev.dur_ms >= 100.0 and trials < max_trials:
+            shorter = replace(ev, dur_ms=round(ev.dur_ms / 2, 1))
+            if fails(events[:i] + [shorter] + events[i + 1:]):
+                ev = shorter
+                events[i] = ev
+            else:
+                break
+    minimal = replace(schedule, events=events)
+    if last_fail is None or last_fail.schedule.events != events:
+        # re-run the exact minimal schedule so the report matches it
+        last_fail = run_storm(minimal, opts, table=table,
+                              oracle=oracle)
+    return minimal, last_fail, trials
+
+
+# ---------------------------------------------------------------------------
+# replay artifacts
+
+
+def write_replay(path: str, schedule: Schedule, opts: StormOptions,
+                 report: StormReport, minimized: bool) -> str:
+    """Write the replayable failing-schedule artifact: schedule, load
+    parameters, violations, and the newest captured incident (obs.check
+    validates the whole document offline)."""
+    incident = None
+    for name in reversed(sorted(
+            os.listdir(report.incident_dir))
+            if os.path.isdir(report.incident_dir) else []):
+        if name.endswith(".json"):
+            try:
+                with open(os.path.join(report.incident_dir, name)) as f:
+                    incident = json.load(f)
+                break
+            except (OSError, json.JSONDecodeError):
+                continue
+    doc = {
+        "schema": REPLAY_SCHEMA,
+        "schedule": schedule.to_json(),
+        "load": {
+            "requests": opts.requests,
+            "concurrency": opts.concurrency,
+            "load_seed": opts.load_seed or schedule.seed,
+            "admit_max_active": opts.admit_max_active,
+            "admit_max_queue": opts.admit_max_queue,
+            "watchdog_ms": opts.watchdog_ms,
+            "breaker_reset_ms": opts.breaker_reset_ms,
+            "replicas": opts.replicas,
+            "mesh_devices": opts.mesh_devices,
+        },
+        "violations": report.violations,
+        "minimized": minimized,
+        "incident": incident,
+    }
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_replay(path: str) -> tuple[Schedule, StormOptions]:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != REPLAY_SCHEMA:
+        raise ValueError(f"{path}: not a storm replay artifact "
+                         f"(schema {doc.get('schema')!r})")
+    schedule = Schedule.from_json(doc["schedule"])
+    load = doc.get("load", {})
+    opts = StormOptions(
+        requests=int(load.get("requests", 24)),
+        concurrency=int(load.get("concurrency", 8)),
+        load_seed=int(load.get("load_seed", 0)),
+        admit_max_active=int(load.get("admit_max_active", 0)),
+        admit_max_queue=int(load.get("admit_max_queue", 8)),
+        watchdog_ms=float(load.get("watchdog_ms", 50.0)),
+        breaker_reset_ms=float(load.get("breaker_reset_ms", 150.0)),
+        replicas=int(load.get("replicas", 3)),
+        mesh_devices=int(load.get("mesh_devices", 4)))
+    return schedule, opts
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m trivy_tpu.resilience.storm",
+        description="graftstorm: seeded multi-fault chaos schedules "
+                    "against the in-process scan topology, with an "
+                    "invariant engine and failing-schedule "
+                    "minimization")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--rounds", type=int, default=1,
+                    help="schedules to run (round r uses seed+r)")
+    ap.add_argument("--topology", choices=TOPOLOGIES, default="single")
+    ap.add_argument("--events", type=int, default=4,
+                    help="fault events per schedule")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--mesh-devices", type=int, default=4)
+    ap.add_argument("--admit-max-active", type=int, default=0)
+    ap.add_argument("--artifact-dir", default="",
+                    help="where failing-schedule replay artifacts and "
+                         "incident snapshots land (default: a tmpdir)")
+    ap.add_argument("--replay", default="", metavar="FILE",
+                    help="re-run a previously written failing-schedule "
+                         "artifact instead of generating schedules")
+    ap.add_argument("--no-minimize", action="store_true",
+                    help="on failure, skip delta-debugging the "
+                         "schedule down to a minimal one")
+    ap.add_argument("--virtual-devices", type=int, default=0,
+                    help="force N virtual CPU devices before jax "
+                         "loads (mesh topology without a real "
+                         "multi-chip backend)")
+    args = ap.parse_args(argv)
+
+    if args.virtual_devices:
+        import sys
+        if "jax" not in sys.modules:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{args.virtual_devices}").strip()
+
+    table = storm_table()
+    if args.replay:
+        schedule, opts = load_replay(args.replay)
+        if args.artifact_dir:
+            opts.artifact_dir = args.artifact_dir
+        report = run_storm(schedule, opts, table=table)
+        print(json.dumps(report.summary()))
+        return 0 if report.ok else 1
+
+    opts = StormOptions(
+        requests=args.requests, concurrency=args.concurrency,
+        replicas=args.replicas, mesh_devices=args.mesh_devices,
+        admit_max_active=args.admit_max_active,
+        artifact_dir=args.artifact_dir)
+    for r in range(args.rounds):
+        seed = args.seed + r
+        schedule = generate_schedule(
+            seed, args.topology, n_events=args.events,
+            mesh_devices=args.mesh_devices, replicas=args.replicas,
+            watchdog_ms=opts.watchdog_ms)
+        report = run_storm(schedule, opts, table=table)
+        print(json.dumps(report.summary()))
+        if report.ok:
+            continue
+        if not args.no_minimize:
+            minimal, report, trials = minimize_schedule(
+                schedule, opts, table=table, oracle=report.oracle)
+            print(json.dumps({"minimized": minimal.to_json(),
+                              "trials": trials,
+                              "violations": report.violations}))
+            schedule = minimal
+        out = os.path.join(
+            args.artifact_dir or report.incident_dir,
+            f"storm-replay-{args.topology}-{seed}.json")
+        write_replay(out, schedule, opts, report,
+                     minimized=not args.no_minimize)
+        print(json.dumps({"replay_artifact": out}))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -24,6 +24,12 @@ REPLICA_HEADER = "X-Trivy-Replica"
 # to wait, queue time included — the admission queue never parks a
 # handler thread past it (the client stamps its own timeout here)
 DEADLINE_HEADER = "X-Trivy-Deadline-Ms"
+# advisory-DB version identity: the serving AdvisoryTable's content
+# digest (table.content_digest), stamped on every Scan response and
+# exposed in /healthz — the router compares it across replicas and
+# counts trivy_tpu_fleet_db_version_skew_total when a mid-rollout
+# fleet answers from different databases
+DB_VERSION_HEADER = "X-Trivy-DB-Version"
 
 # request-message descriptor per Twirp route (binary encoding) —
 # shared by the server handler and the graftfleet router, which must

@@ -34,8 +34,9 @@ from ..scanner import LocalScanner
 # wire-header names live in the package __init__ so the CLIENT can
 # import them without pulling in this module's server stack;
 # re-exported here for the existing `listen.TOKEN_HEADER` readers
-from . import (DEADLINE_HEADER, PARENT_SPAN_HEADER,  # noqa: F401
-               ROUTE_DESCRIPTORS, TOKEN_HEADER, TRACE_HEADER)
+from . import (DB_VERSION_HEADER, DEADLINE_HEADER,  # noqa: F401
+               PARENT_SPAN_HEADER, ROUTE_DESCRIPTORS, TOKEN_HEADER,
+               TRACE_HEADER)
 
 _log = _get_logger("server")
 
@@ -75,6 +76,18 @@ class ServerState:
         self.admission = AdmissionQueue(admission,
                                         breaker=GUARD.breaker)
         self._table = table
+        # advisory-DB version identity: the serving table's content
+        # digest, stamped on every Scan response and in /healthz so a
+        # mid-rollout fleet's skew is observable (the router counts
+        # disagreements). Plain str attribute — handler reads need no
+        # lock; swap_table re-stamps it when a new table installs.
+        self.db_version = table.content_digest()
+        # graceful drain (SIGTERM/SIGINT): once draining, Scan sheds
+        # 503 + Retry-After while in-flight requests finish through
+        # the generation drain — a restart mid-load completes what the
+        # admission queue holds instead of dropping it
+        self._draining = False
+        self.drain_retry_after_s = 5.0
         # meshguard: mesh mode shards the detect join over a device
         # mesh with per-device fault domains. Device loss shrinks the
         # mesh to the survivors (grow on readmission) through the
@@ -201,6 +214,45 @@ class ServerState:
         with self._lock:
             return self._scanner
 
+    def scanner_with_version(self) -> "tuple[LocalScanner, str]":
+        """Scanner AND the digest of the table it serves, captured
+        under one lock hold — a hot swap landing mid-scan must not
+        stamp the NEW table's version on a result the OLD table
+        produced (the router's skew accounting trusts the header)."""
+        with self._lock:
+            return self._scanner, self.db_version
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self, retry_after_s: float | None = None) -> None:
+        """Stop admitting Scans: subsequent requests shed 503 +
+        Retry-After while in-flight ones keep running."""
+        with self._lock:
+            if retry_after_s is not None:
+                self.drain_retry_after_s = retry_after_s
+            self._draining = True
+
+    def drain(self, timeout_s: float) -> bool:
+        """Wait (bounded) for every in-flight request to finish — the
+        same generation counts the swap drain trusts. → True when the
+        server went quiescent, False when the deadline expired with
+        requests still running."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while True:
+            with self._lock:
+                if self._inflight == 0:
+                    return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
     def close(self) -> None:
         """Server shutdown: join the scanner's detectd + engine worker
         threads (idempotent)."""
@@ -240,6 +292,9 @@ class ServerState:
                                        sched=self.detect_opts,
                                        mesh=build_mesh,
                                        mesh_guard=self.mesh_guard)
+            # digest outside the lock too (first computation walks the
+            # whole table); cached on the table object afterwards
+            new_version = build_table.content_digest()
             with self._lock:
                 # close() may have run while the scanner was building
                 # (a meshguard rebuild races server shutdown):
@@ -264,6 +319,7 @@ class ServerState:
                     self._scanner = new_scanner
                     self._table = build_table
                     self._mesh = build_mesh
+                    self.db_version = new_version
             if outcome == "aborted":
                 new_scanner.close()
                 return
@@ -347,6 +403,7 @@ class Handler(BaseHTTPRequestHandler):
     state: ServerState = None  # set by serve()
     protocol_version = "HTTP/1.1"
     _trace_id = ""  # per-request; set by do_POST before dispatch
+    _db_version = ""  # stamped on Scan responses only (X-Trivy-DB-Version)
 
     def log_message(self, *args):
         pass
@@ -358,6 +415,8 @@ class Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         if self._trace_id:
             self.send_header(TRACE_HEADER, self._trace_id)
+        if self._db_version:
+            self.send_header(DB_VERSION_HEADER, self._db_version)
         self.end_headers()
         self.wfile.write(body)
 
@@ -370,6 +429,7 @@ class Handler(BaseHTTPRequestHandler):
         # connection stamped on the handler instance — a health probe
         # must not echo an unrelated scan's id
         self._trace_id = ""
+        self._db_version = ""
         gen = st.request_started()
         try:
             self._do_get()
@@ -413,7 +473,12 @@ class Handler(BaseHTTPRequestHandler):
                 if self.state.mesh_guard is not None:
                     resilience["mesh"] = self.state.mesh_guard.status()
                 self._json(200, {
-                    "status": "ok",
+                    "status": "draining" if self.state.draining
+                    else "ok",
+                    # advisory-DB identity: replicas of one fleet must
+                    # agree, or bit-identical failover is a lie — the
+                    # router's probe reads this field
+                    "db_version": self.state.db_version,
                     "device": device_status(),
                     # graftguard: breaker state, watchdog last-probe
                     # age, shed/fallback counters, admission snapshot
@@ -448,6 +513,8 @@ class Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         if self._trace_id:
             self.send_header(TRACE_HEADER, self._trace_id)
+        if self._db_version:
+            self.send_header(DB_VERSION_HEADER, self._db_version)
         self.end_headers()
         self.wfile.write(body)
 
@@ -465,6 +532,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         st = self.state
+        self._db_version = ""
         gen = st.request_started()
         # per-RPC trace stamp: reuse the client's id when forwarded,
         # mint one otherwise; every span/log line below inherits it.
@@ -556,6 +624,17 @@ class Handler(BaseHTTPRequestHandler):
         X-Trivy-Deadline-Ms — a handler thread is never parked past
         the point its client has given up."""
         st = self.state
+        if st.draining:
+            # graceful drain: no NEW scans once the shutdown signal
+            # landed — shed exactly like admission overload so clients
+            # back off to another replica (or retry after the restart)
+            from ..metrics import METRICS
+            s = Shed("server draining", 503, st.drain_retry_after_s)
+            METRICS.inc("trivy_tpu_requests_shed_total")
+            SLO.observe_scan(0.0, "shed")
+            _log.warning("scan shed (draining): 503 Retry-After=%ds",
+                         int(s.retry_after_s))
+            return self._shed_response(s)
         deadline = None
         hdr = self.headers.get(DEADLINE_HEADER)
         if hdr:
@@ -595,7 +674,11 @@ class Handler(BaseHTTPRequestHandler):
             list_all_packages=bool(opts_j.get("list_all_packages")),
         )
         t0 = time.perf_counter()
-        results, os_info = self.state.scanner.scan(
+        # scanner + db version captured together: the header must name
+        # the table that produced THIS answer, even when a hot swap
+        # lands mid-scan (the reply helpers stamp it)
+        scanner, self._db_version = self.state.scanner_with_version()
+        results, os_info = scanner.scan(
             req.get("target", ""), req.get("artifact_id", ""),
             req.get("blob_ids") or [], opts)
         elapsed = time.perf_counter() - t0
@@ -616,17 +699,57 @@ class Handler(BaseHTTPRequestHandler):
         })
 
 
+def drain_then_shutdown(httpd, state: ServerState,
+                        grace_s: float = 10.0) -> None:
+    """Graceful shutdown: stop admitting Scans (503 + Retry-After),
+    wait (bounded) for in-flight requests to finish through the
+    generation counts, then stop the accept loop. serve() wires
+    SIGTERM/SIGINT here — a restart mid-load completes what the
+    admission queue holds instead of dropping it. Runs off the signal
+    handler on its own thread; callers under test drive it directly."""
+    _log.warning("drain: admission stopped; waiting up to %.1fs for "
+                 "%d in-flight request(s)", grace_s, state.inflight)
+    state.begin_drain()
+    if not state.drain(grace_s):
+        _log.warning("drain: grace period expired with %d request(s) "
+                     "still in flight; shutting down anyway",
+                     state.inflight)
+    httpd.shutdown()
+
+
+def install_drain_handlers(httpd, state, grace_s: float) -> bool:
+    """SIGTERM/SIGINT → graceful drain (main thread only — background
+    servers in tests own their shutdown). → True when installed."""
+    import signal
+
+    def _on_signal(signum, frame):
+        # the handler must return immediately; the drain wait runs on
+        # its own thread and ends by stopping the accept loop
+        threading.Thread(target=drain_then_shutdown,
+                         args=(httpd, state, grace_s),
+                         name="graceful-drain", daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+        return True
+    except ValueError:
+        return False   # not the main thread
+
+
 def serve(host: str, port: int, table, cache_dir: str, token: str = "",
           ready_event: threading.Event | None = None,
           cache_backend: str = "fs", trace_path: str = "",
-          detect_opts=None, admission=None, mesh_opts=None):
+          detect_opts=None, admission=None, mesh_opts=None,
+          drain_grace_s: float = 10.0):
     """`trace_path` arms graftscope recording for the server's
     lifetime and dumps the Chrome trace-event JSON there on shutdown
     (the CLI's `server --trace FILE`). `detect_opts` (SchedOptions)
     tunes detectd — coalesce wait, in-flight pair bound, warmup;
     `admission` (AdmissionOptions) bounds the graftguard scan queue;
     `mesh_opts` (MeshOptions) shards detection over a device mesh with
-    meshguard per-device fault domains."""
+    meshguard per-device fault domains; `drain_grace_s` bounds the
+    SIGTERM/SIGINT graceful drain (--drain-grace-ms)."""
     if trace_path:
         from ..obs import COLLECTOR
         COLLECTOR.enable()
@@ -638,6 +761,7 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
     # would serve each other's caches and scanners
     handler = type("Handler", (Handler,), {"state": state})
     httpd = ThreadingHTTPServer((host, port), handler)
+    install_drain_handlers(httpd, state, drain_grace_s)
     if ready_event is not None:
         ready_event.set()
     try:
