@@ -162,37 +162,22 @@ def make_layer(files: dict[str, bytes]) -> bytes:
 def make_image(path: str, layers: list[dict[str, bytes]],
                repo_tags=("test/image:latest",),
                created_by=None) -> list[str]:
-    """Write a docker-save tarball; returns layer diff_ids."""
+    """Write a docker-save tarball; returns layer diff_ids.
+
+    The layout itself lives in fanal.fixtures.write_docker_archive —
+    one implementation for the whole repo (config_sort_keys=False
+    keeps the insertion-order config bytes this helper has always
+    produced, so image/config ids in existing tests are unchanged)."""
+    from trivy_tpu.fanal.fixtures import write_docker_archive
+
     layer_blobs = [make_layer(files) for files in layers]
     diff_ids = ["sha256:" + hashlib.sha256(b).hexdigest()
                 for b in layer_blobs]
-    config = {
-        "architecture": "amd64",
-        "os": "linux",
-        "rootfs": {"type": "layers", "diff_ids": diff_ids},
-        "history": [{"created_by": (created_by[i] if created_by else
-                                    f"layer-{i}")}
-                    for i in range(len(layers))],
-    }
-    config_bytes = json.dumps(config).encode()
-    config_name = hashlib.sha256(config_bytes).hexdigest() + ".json"
-    manifest = [{
-        "Config": config_name,
-        "RepoTags": list(repo_tags),
-        "Layers": [f"layer{i}/layer.tar" for i in range(len(layers))],
-    }]
-    with tarfile.open(path, "w") as tf:
-        mb = json.dumps(manifest).encode()
-        ti = tarfile.TarInfo("manifest.json")
-        ti.size = len(mb)
-        tf.addfile(ti, io.BytesIO(mb))
-        ti = tarfile.TarInfo(config_name)
-        ti.size = len(config_bytes)
-        tf.addfile(ti, io.BytesIO(config_bytes))
-        for i, blob in enumerate(layer_blobs):
-            ti = tarfile.TarInfo(f"layer{i}/layer.tar")
-            ti.size = len(blob)
-            tf.addfile(ti, io.BytesIO(blob))
+    write_docker_archive(
+        path, layer_blobs, diff_ids, repo_tags=repo_tags,
+        created_by=(list(created_by) if created_by else
+                    [f"layer-{i}" for i in range(len(layers))]),
+        config_sort_keys=False)
     return diff_ids
 
 

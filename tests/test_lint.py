@@ -906,3 +906,70 @@ def test_storm_no_failpoints_in_device_code():
     fs = _lint("trivy_tpu/resilience/storm.py", src)
     assert [(f.rule, f.line) for f in fs] == [("TPU108", 4),
                                               ("TPU108", 5)]
+
+
+def test_fanald_pipeline_in_lock_hygiene_scope():
+    """Satellite (PR 9): fanald (fanal/pipeline.py) — the ingest
+    supervisor, byte budget, and per-layer state are shared across
+    walker threads, the analyzer pool, and the watchdog — is in
+    TPU106 scope."""
+    src = (
+        "import threading\n"
+        "class Budget:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._counters = {}\n"
+        "    def bad(self, k):\n"
+        "        self._counters[k] = 1\n"
+        "    def good(self, k):\n"
+        "        with self._lock:\n"
+        "            self._counters[k] = 1\n"
+    )
+    fs = _lint("trivy_tpu/fanal/pipeline.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+    # the rest of fanal/ stays out of the lock-hygiene scope (the
+    # serial walker and analyzers are single-threaded per call)
+    assert _lint("trivy_tpu/fanal/walker.py", src) == []
+
+
+def test_fanald_no_clocks_in_device_code():
+    """Satellite (PR 9): TPU107 — a timed core sneaking into fanald
+    (host-side by charter) must be caught."""
+    src = (
+        "import time, jax\n"
+        "def _walk_core(x):\n"
+        "    return x + time.perf_counter()\n"
+        "j = jax.jit(_walk_core)\n"
+    )
+    fs = _lint("trivy_tpu/fanal/pipeline.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU107", 3)]
+
+
+def test_fanald_no_failpoints_in_device_code():
+    """Satellite (PR 9): TPU108 — the fanal.walk/fanal.analyze
+    failpoint probes and ingest breaker reads belong on the host side
+    of fanald; inside a jitted core they run once at trace time."""
+    src = (
+        "import jax\n"
+        "from trivy_tpu.resilience import failpoint\n"
+        "def _walk_core(x):\n"
+        "    failpoint('fanal.walk')\n"
+        "    return x\n"
+        "j = jax.jit(_walk_core)\n"
+    )
+    fs = _lint("trivy_tpu/fanal/pipeline.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU108", 4)]
+
+
+def test_fanal_failpoint_sites_in_catalog():
+    """Satellite (PR 9): the fanal.walk / fanal.analyze sites parse
+    under the spec grammar and are schedulable."""
+    from trivy_tpu.resilience.failpoints import parse_spec
+    specs = parse_spec("fanal.walk=hang:100;fanal.analyze=flaky:0.2:7")
+    assert set(specs) == {"fanal.walk", "fanal.analyze"}
+    try:
+        parse_spec("fanal.wlak=error")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("typo'd fanal site must fail at parse")

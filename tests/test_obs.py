@@ -488,8 +488,9 @@ class TestCliTrace:
         assert {"scan", "detect.prepare", "detect.dispatch",
                 "detect.device_wait", "detect.assemble"} <= names
         # recording starts before artifact inspection, so the walker
-        # phase is in the trace too (the README's promise)
-        assert "fanal.walk_tar" in names
+        # phase is in the trace too (the README's promise) — per-layer
+        # fanald walk spans since the pipeline rebuild
+        assert "fanal.layer_walk" in names
         tids = {e["args"]["trace_id"] for e in doc["traceEvents"]
                 if e["name"].startswith(("scan", "detect"))}
         assert len(tids) == 1 and "" not in tids

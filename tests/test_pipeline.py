@@ -1,0 +1,733 @@
+"""fanald — the supervised streaming ingest pipeline (fanal/pipeline.py).
+
+Covers the tentpole contracts:
+  - bit-identity with the serial parity-oracle walker on well-formed
+    images (property-style, seeded);
+  - hostile-artifact containment: decompression bomb, truncated gzip,
+    member-count flood, lying member sizes, link cycles, and
+    path-traversal member names each yield a DETERMINISTIC annotated
+    partial result with bounded memory and no hang — never an
+    exception;
+  - budgets bind mid-stream (ratio guard, layer/file byte caps,
+    member cap, deadline);
+  - per-stage ingest fault domains: a hang-mode fanal.walk fault trips
+    the walk breaker, open breakers degrade instantly, the half-open
+    probe re-closes;
+  - partial results cache only under salted ids (canonical key stays
+    missing → rescans re-walk) and surface in the scan report;
+  - /healthz + /metrics observability for all of the above;
+  - the graftstorm ingest topology: the acceptance chaos drill
+    (hang-mode walk fault + truncated layer + bomb at c=8) completes
+    with zero 5xx, annotated partials, and re-closed breakers, from
+    both an explicit and a seeded schedule.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+import threading
+
+import pytest
+
+from helpers import ALPINE_OS_RELEASE, APK_INSTALLED, make_image
+from trivy_tpu.fanal.analyzers import AnalyzerGroup
+from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+from trivy_tpu.fanal.cache import MemoryCache
+from trivy_tpu.fanal.pipeline import (INGEST, IngestOptions,
+                                      partial_blob_id)
+from trivy_tpu.fanal.walker import _norm_rel
+from trivy_tpu.resilience import FAILPOINTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_ingest_state():
+    FAILPOINTS.configure("")
+    INGEST.reset_for_tests()
+    yield
+    FAILPOINTS.configure("")
+    INGEST.configure(fail_threshold=3, reset_timeout_s=5.0)
+    INGEST.reset_for_tests()
+
+
+def _gz(data: bytes, level: int = 6) -> bytes:
+    buf = io.BytesIO()
+    with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0,
+                       compresslevel=level) as f:
+        f.write(data)
+    return buf.getvalue()
+
+
+def _tar(entries) -> bytes:
+    """entries: list of (TarInfo, content | None)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for ti, content in entries:
+            tf.addfile(ti, io.BytesIO(content)
+                       if content is not None else None)
+    return buf.getvalue()
+
+
+def _file(name: str, content: bytes) -> tuple:
+    ti = tarfile.TarInfo(name)
+    ti.size = len(content)
+    return ti, content
+
+
+def _image_from_blobs(path: str, blobs: list[bytes],
+                      diff_ids: list[str]) -> None:
+    """docker-save archive from pre-built (possibly hostile) layer
+    blobs."""
+    config = {"architecture": "amd64", "os": "linux",
+              "rootfs": {"type": "layers", "diff_ids": diff_ids},
+              "history": [{"created_by": f"l{i}"}
+                          for i in range(len(diff_ids))]}
+    cb = json.dumps(config).encode()
+    cn = hashlib.sha256(cb).hexdigest() + ".json"
+    manifest = [{"Config": cn, "RepoTags": ["test/hostile:1"],
+                 "Layers": [f"layer{i}/layer.tar"
+                            for i in range(len(blobs))]}]
+    with tarfile.open(path, "w") as tf:
+        for name, data in [("manifest.json",
+                            json.dumps(manifest).encode()),
+                           (cn, cb)] + \
+                [(f"layer{i}/layer.tar", b)
+                 for i, b in enumerate(blobs)]:
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+
+
+def _diff(tar_bytes: bytes) -> str:
+    return "sha256:" + hashlib.sha256(tar_bytes).hexdigest()
+
+
+def _inspect(path, ingest=None, scanners=("vuln",)):
+    cache = MemoryCache()
+    art = ImageArchiveArtifact(path, cache, scanners=scanners,
+                               ingest=ingest)
+    ref = art.inspect()
+    return ref, cache
+
+
+def _blob_docs(cache, ref):
+    return [cache.blobs[b] for b in ref.blob_ids]
+
+
+# ---------------------------------------------------------------------------
+# satellite: hostile member names
+
+
+class TestNormRel:
+    def test_dot_prefix_stripped_once(self):
+        assert _norm_rel("./etc/os-release") == "etc/os-release"
+        # dot-prefixed basenames survive (never lstrip)
+        assert _norm_rel(".cache") == ".cache"
+        assert _norm_rel("./.cache") == ".cache"
+
+    def test_absolute_treated_archive_relative(self):
+        assert _norm_rel("/etc/shadow") == "etc/shadow"
+        assert _norm_rel("//etc//shadow") == "etc/shadow"
+
+    def test_traversal_rejected(self):
+        assert _norm_rel("../etc/passwd") == ""
+        assert _norm_rel("a/../../b") == ""
+        assert _norm_rel("a/b/..") == ""
+        assert _norm_rel("..") == ""
+        assert _norm_rel("/..") == ""
+
+    def test_inner_dot_segments_collapse(self):
+        assert _norm_rel("a/./b") == "a/b"
+        assert _norm_rel("a//b") == "a/b"
+        assert _norm_rel(".") == ""
+
+    def test_hostile_whiteout_never_escapes(self, tmp_path):
+        """A `..`-named whiteout must not register a deletion outside
+        the walked tree (it could wipe unrelated paths in the
+        applier's squash stores)."""
+        layer = _tar([
+            _file("etc/os-release", ALPINE_OS_RELEASE),
+            _file("../.wh.etc", b""),
+            _file("/.wh..wh..opq", b""),
+        ])
+        p = str(tmp_path / "img.tar")
+        _image_from_blobs(p, [layer], [_diff(layer)])
+        for ingest in (IngestOptions(), IngestOptions(enabled=False)):
+            ref, cache = _inspect(p, ingest)
+            blob = cache.blobs[ref.blob_ids[0]]
+            assert not blob.get("WhiteoutFiles")
+            # the root-level opaque marker IS archive-relative (empty
+            # dirname) — but the ../-named whiteout is dropped
+            assert blob.get("OS", {}).get("Family") == "alpine"
+
+
+# ---------------------------------------------------------------------------
+# parity: pipeline ≡ serial walker, bit for bit
+
+
+class TestParity:
+    def _rand_image(self, path, seed):
+        import random
+        rng = random.Random(seed)
+        layers = []
+        for li in range(rng.randrange(1, 5)):
+            files = {"etc/os-release": ALPINE_OS_RELEASE} \
+                if li == 0 else {}
+            files["lib/apk/db/installed"] = APK_INSTALLED
+            for fi in range(rng.randrange(0, 6)):
+                files[f"data/l{li}/f{fi}.bin"] = \
+                    bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(0, 512)))
+            if rng.random() < 0.4:
+                files[f"gone/.wh.f{li}"] = b""
+            if rng.random() < 0.3:
+                files[f"opq{li}/.wh..wh..opq"] = b""
+            layers.append(files)
+        make_image(path, layers)
+
+    @pytest.mark.parametrize("seed", [1, 2, 3, 4])
+    def test_pipeline_bit_identical_to_serial(self, tmp_path, seed):
+        p = str(tmp_path / f"img{seed}.tar")
+        self._rand_image(p, seed)
+        ref_s, cache_s = _inspect(p, IngestOptions(enabled=False))
+        ref_p, cache_p = _inspect(p, IngestOptions())
+        assert ref_p.blob_ids == ref_s.blob_ids
+        assert json.dumps(cache_p.blobs, sort_keys=True) == \
+            json.dumps(cache_s.blobs, sort_keys=True)
+
+    def test_parity_with_secrets_and_skips(self, tmp_path):
+        p = str(tmp_path / "img.tar")
+        make_image(p, [
+            {"etc/os-release": ALPINE_OS_RELEASE,
+             "app/config.txt": b"aws_secret_access_key = "
+                               b"AKIAIOSFODNN7EXAMPLEKEYVALUE123456\n",
+             "skipme/inner.txt": b"x" * 64,
+             "lib/apk/db/installed": APK_INSTALLED},
+        ])
+        kw = dict(scanners=("vuln", "secret"),
+                  skip_dirs=("skipme",))
+        out = []
+        for ingest in (IngestOptions(enabled=False), IngestOptions()):
+            cache = MemoryCache()
+            art = ImageArchiveArtifact(p, cache, ingest=ingest, **kw)
+            ref = art.inspect()
+            out.append((ref.blob_ids,
+                        json.dumps(cache.blobs, sort_keys=True),
+                        {k: v for k, v in ref.secret_files.items()}))
+        assert out[0][0] == out[1][0]
+        assert out[0][1] == out[1][1]
+        assert out[0][2] == out[1][2]
+
+    def test_analyze_batch_matches_analyze_file(self):
+        from trivy_tpu.fanal.analyzers import AnalysisResult
+        group = AnalyzerGroup()
+        files = [
+            ("lib/apk/db/installed", APK_INSTALLED),
+            ("etc/os-release", ALPINE_OS_RELEASE),
+            ("nothing/wanted.xyz", b"\0\1\2"),
+            ("requirements.txt", b"flask==1.0\n"),
+        ]
+        batch = group.analyze_batch(files)
+        merged_batch = AnalysisResult()
+        for r in batch:
+            if r is not None:
+                merged_batch.merge(r)
+        merged_serial = AnalysisResult()
+        for path, content in files:
+            group.analyze_file(path, content, merged_serial)
+        as_json = lambda r: json.dumps({  # noqa: E731
+            "os": r.os.to_json() if r.os else None,
+            "pi": [p.to_json() for p in r.package_infos],
+            "apps": [a.to_json() for a in r.applications],
+        }, sort_keys=True)
+        assert as_json(merged_batch) == as_json(merged_serial)
+
+
+# ---------------------------------------------------------------------------
+# hostile-artifact corpus: deterministic partials, bounded memory
+
+
+def _tight_opts(**kw):
+    base = dict(walkers=2, analyzers=2, max_file_bytes=1 << 20,
+                max_layer_bytes=1 << 20, max_members=500,
+                layer_deadline_ms=5000.0, max_inflight_bytes=2 << 20,
+                max_ratio=50.0, ratio_floor=64 << 10)
+    base.update(kw)
+    return IngestOptions(**base)
+
+
+class TestHostileCorpus:
+    def _scan_twice(self, path, opts):
+        ref1, cache1 = _inspect(path, opts)
+        ref2, cache2 = _inspect(path, opts)
+        assert ref1.blob_ids == ref2.blob_ids, \
+            "partial results must be deterministic"
+        assert json.dumps(cache1.blobs, sort_keys=True) == \
+            json.dumps(cache2.blobs, sort_keys=True)
+        return ref1, cache1
+
+    def _errors(self, cache, ref):
+        out = []
+        for doc in _blob_docs(cache, ref):
+            out.extend(doc.get("IngestErrors") or [])
+        return out
+
+    def test_decompression_bomb_trips_ratio_guard(self, tmp_path):
+        ok_layer = _tar([_file("etc/os-release", ALPINE_OS_RELEASE)])
+        bomb_tar = _tar([_file("boom/zeros.bin", b"\0" * (32 << 20))])
+        p = str(tmp_path / "bomb.tar")
+        _image_from_blobs(p, [_gz(ok_layer), _gz(bomb_tar)],
+                          [_diff(ok_layer), _diff(bomb_tar)])
+        opts = _tight_opts()
+        ref, cache = self._scan_twice(p, opts)
+        errs = self._errors(cache, ref)
+        assert any(e["Kind"] in ("bomb", "budget.layer_bytes")
+                   for e in errs), errs
+        # the bomb layer is partial; the clean layer is complete
+        docs = _blob_docs(cache, ref)
+        assert not docs[0].get("IngestErrors")
+        assert docs[1].get("IngestErrors")
+        # bounded memory: the spool stops within one chunk of the cap,
+        # nowhere near the 32 MiB the bomb wanted to expand to
+        from trivy_tpu.fanal.pipeline import LayerStream
+        assert opts.max_layer_bytes + LayerStream.CHUNK < 8 << 20
+
+    def test_truncated_gzip_layer_contained(self, tmp_path):
+        ok_layer = _tar([_file("etc/os-release", ALPINE_OS_RELEASE)])
+        apk_layer = _tar([_file("lib/apk/db/installed",
+                                APK_INSTALLED)])
+        blob = _gz(apk_layer)
+        p = str(tmp_path / "trunc.tar")
+        _image_from_blobs(p, [_gz(ok_layer), blob[:len(blob) // 2]],
+                          [_diff(ok_layer), _diff(apk_layer)])
+        ref, cache = self._scan_twice(p, _tight_opts())
+        errs = self._errors(cache, ref)
+        assert any(e["Kind"] in ("layer_error", "open_error")
+                   for e in errs), errs
+        # the OS layer still analyzed — partial-result degradation,
+        # not all-or-nothing
+        assert _blob_docs(cache, ref)[0]["OS"]["Family"] == "alpine"
+
+    def test_member_flood_trips_member_budget(self, tmp_path):
+        flood = _tar([_file(f"d/f{i:05d}", b"") for i in range(2000)])
+        p = str(tmp_path / "flood.tar")
+        _image_from_blobs(p, [_gz(flood)], [_diff(flood)])
+        ref, cache = self._scan_twice(
+            p, _tight_opts(max_members=100, max_ratio=1e9))
+        errs = self._errors(cache, ref)
+        assert any(e["Kind"] == "budget.members" for e in errs), errs
+
+    @pytest.mark.slow
+    def test_64k_member_tar_bounded(self, tmp_path):
+        flood = _tar([_file(f"d/f{i:06d}", b"") for i in range(65536)])
+        p = str(tmp_path / "flood64k.tar")
+        _image_from_blobs(p, [_gz(flood, level=1)], [_diff(flood)])
+        # layer/ratio caps raised so the MEMBER budget is what binds
+        # (64k empty members spool ~64 MiB of highly-compressible
+        # tar headers)
+        ref, cache = _inspect(p, _tight_opts(
+            max_members=1000, max_layer_bytes=256 << 20,
+            max_ratio=1e9))
+        errs = self._errors(cache, ref)
+        assert any(e["Kind"] == "budget.members" for e in errs), errs
+
+    def test_lying_member_size_contained(self, tmp_path):
+        # header claims 4096 bytes, data stream ends after 16: the tar
+        # is structurally truncated — the walk must degrade, not raise
+        ti = tarfile.TarInfo("lib/apk/db/installed")
+        ti.size = 4096
+        hdr = ti.tobuf()
+        lying = hdr + b"P:x\nV:1\n" + b"\0" * 8   # no proper framing
+        p = str(tmp_path / "liar.tar")
+        _image_from_blobs(p, [_gz(lying)], [_diff(lying)])
+        ref, cache = self._scan_twice(p, _tight_opts())
+        errs = self._errors(cache, ref)
+        assert errs, "lying sizes must yield an annotated partial"
+
+    def test_link_cycles_no_hang_no_crash(self, tmp_path):
+        a = tarfile.TarInfo("cycle/a")
+        a.type = tarfile.SYMTYPE
+        a.linkname = "b"
+        b = tarfile.TarInfo("cycle/b")
+        b.type = tarfile.SYMTYPE
+        b.linkname = "a"
+        hard = tarfile.TarInfo("etc/os-release")
+        hard.type = tarfile.LNKTYPE
+        hard.linkname = "cycle/a"   # hardlink into the symlink cycle
+        layer = _tar([(a, None), (b, None), (hard, None),
+                      _file("lib/apk/db/installed", APK_INSTALLED)])
+        p = str(tmp_path / "cycles.tar")
+        _image_from_blobs(p, [_gz(layer)], [_diff(layer)])
+        ref, cache = self._scan_twice(p, _tight_opts())
+        doc = _blob_docs(cache, ref)[0]
+        # the regular file still analyzed
+        assert doc.get("PackageInfos")
+        # the cyclic link annotated, not fatal
+        assert any(e["Kind"] == "link_error"
+                   for e in doc.get("IngestErrors") or [])
+
+    def test_oversized_file_skipped_with_annotation(self, tmp_path):
+        # INCOMPRESSIBLE filler: the per-FILE budget must be what
+        # binds, not the decompression-ratio guard
+        import random
+        filler = random.Random(7).randbytes(2 << 20)
+        big = _tar([_file("lib/apk/db/installed",
+                          APK_INSTALLED + filler)])
+        p = str(tmp_path / "big.tar")
+        _image_from_blobs(p, [_gz(big)], [_diff(big)])
+        ref, cache = self._scan_twice(
+            p, _tight_opts(max_file_bytes=1 << 10,
+                           max_layer_bytes=8 << 20))
+        errs = self._errors(cache, ref)
+        assert any(e["Kind"] == "budget.file_bytes" and
+                   e["Path"] == "lib/apk/db/installed"
+                   for e in errs), errs
+
+    def test_inflight_budget_bounds_memory(self, tmp_path):
+        from trivy_tpu.fanal.pipeline import (IngestPipeline,
+                                              LayerTask,
+                                              archive_member_stream)
+        files = {f"lib/apk/f{i}": b"x" * (64 << 10) for i in range(8)}
+        files["lib/apk/db/installed"] = APK_INSTALLED
+        p = str(tmp_path / "mem.tar")
+        make_image(p, [files, files, files])
+        opts = _tight_opts(max_inflight_bytes=128 << 10,
+                           max_layer_bytes=8 << 20,
+                           max_file_bytes=1 << 20)
+        group = AnalyzerGroup()
+        pipe = IngestPipeline(group, opts)
+        try:
+            with tarfile.open(p) as tf:
+                names = [n for n in tf.getnames()
+                         if n.endswith("layer.tar")]
+            tasks = [LayerTask(
+                idx=i, diff_id=f"sha256:{i}", blob_id=f"b{i}",
+                created_by="",
+                open_stream=(lambda n=n: archive_member_stream(p, n)))
+                for i, n in enumerate(names)]
+            scans = pipe.run(tasks)
+            assert all(not s.partial for s in scans.values()), [
+                s.errors for s in scans.values()]
+            # the analysis-window high-water never pierced the budget
+            assert pipe.budget.high_water <= opts.max_inflight_bytes
+            # spool buffers are window-bounded too: charged spool
+            # bytes never exceed the shared window (one overdraft
+            # layer may run uncharged past it, itself capped by
+            # max_layer_bytes — total ≤ window + layer cap + chunk)
+            assert pipe.spool.high_water <= opts.max_inflight_bytes
+        finally:
+            pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# fault domains: breakers, failpoints, degradation
+
+
+class TestIngestBreakers:
+    def _clean_image(self, tmp_path):
+        p = str(tmp_path / "ok.tar")
+        make_image(p, [{"etc/os-release": ALPINE_OS_RELEASE,
+                        "lib/apk/db/installed": APK_INSTALLED}])
+        return p
+
+    def test_walk_hang_trips_breaker_and_recloses(self, tmp_path):
+        p = self._clean_image(tmp_path)
+        INGEST.configure(fail_threshold=3, reset_timeout_s=5.0)
+        opts = _tight_opts(layer_deadline_ms=60.0)
+        FAILPOINTS.set("fanal.walk", "hang", 400.0)
+        try:
+            ref, cache = _inspect(p, opts)
+        finally:
+            FAILPOINTS.clear("fanal.walk")
+        doc = _blob_docs(cache, ref)[0]
+        kinds = {e["Kind"] for e in doc["IngestErrors"]}
+        assert "timeout" in kinds, doc["IngestErrors"]
+        assert INGEST.breaker("walk").state_name() == "open"
+        # while open: instant annotated degradation, no walking
+        ref2, cache2 = _inspect(p, opts)
+        kinds2 = {e["Kind"]
+                  for e in _blob_docs(cache2, ref2)[0]["IngestErrors"]}
+        assert "breaker_open" in kinds2
+        # after the reset window the probe walk re-closes the stage
+        import time
+        INGEST.configure(reset_timeout_s=0.05)
+        time.sleep(0.1)
+        ref3, cache3 = _inspect(p, opts)
+        assert not _blob_docs(cache3, ref3)[0].get("IngestErrors")
+        assert INGEST.breaker("walk").state_name() == "closed"
+
+    def test_walk_error_fault_annotated(self, tmp_path):
+        p = self._clean_image(tmp_path)
+        FAILPOINTS.set("fanal.walk", "error")
+        try:
+            ref, cache = _inspect(p, _tight_opts())
+        finally:
+            FAILPOINTS.clear("fanal.walk")
+        errs = _blob_docs(cache, ref)[0]["IngestErrors"]
+        assert any(e["Kind"] == "error" and
+                   "FailpointError" in e.get("Detail", "")
+                   for e in errs), errs
+
+    def test_closed_pool_race_never_charges_walk_breaker(self,
+                                                         tmp_path):
+        """close() racing surviving walkers (another layer's
+        scan-fatal integrity failure tears the pipeline down): the
+        shut-down analyzer pool's RuntimeError must surface as a
+        no-charge cooperative stop — an annotated partial, zero walk
+        breaker failures, and the batch's byte-budget charge
+        released."""
+        from trivy_tpu.fanal.pipeline import (IngestPipeline,
+                                              LayerTask,
+                                              archive_member_stream)
+        p = self._clean_image(tmp_path)
+        pipe = IngestPipeline(AnalyzerGroup(),
+                              _tight_opts(batch_files=1))
+        pipe._an_pool.shutdown(wait=False)   # simulate the race
+        with tarfile.open(p) as tf:
+            names = [n for n in tf.getnames()
+                     if n.endswith("layer.tar")]
+        tasks = [LayerTask(
+            idx=i, diff_id=f"sha256:{i}", blob_id=f"b{i}",
+            created_by="",
+            open_stream=(lambda n=n: archive_member_stream(p, n)))
+            for i, n in enumerate(names)]
+        try:
+            scans = pipe.run(tasks)
+        finally:
+            pipe.close()
+        assert all(s.partial for s in scans.values())
+        assert any(e["Kind"] == "cancelled"
+                   for s in scans.values() for e in s.errors), [
+                       s.errors for s in scans.values()]
+        br = INGEST.breaker("walk")
+        assert br.state_name() == "closed"
+        assert br.status()["failures"] == 0
+        assert pipe.budget._bytes == 0 and pipe.budget._items == 0
+
+    def test_spool_waiter_takes_freed_window_not_deadline_trip(self):
+        """A walker parked behind the overdraft token must re-check
+        plain window capacity: when another layer's release frees
+        room, the waiter proceeds — it must NOT stay blocked until
+        its deadline converts a well-formed layer into a spurious
+        partial."""
+        import time
+        from trivy_tpu.fanal.pipeline import (Deadline, _LayerState,
+                                              _SpoolWindow)
+        w = _SpoolWindow(100)
+        full, od, waiter = (_LayerState() for _ in range(3))
+        w.charge(full, 100, Deadline(1.0))   # fills the window
+        w.charge(od, 50, Deadline(1.0))      # takes the overdraft token
+        assert od.spool_overdraft
+        threading.Timer(0.15, w.release, args=(full,)).start()
+        t0 = time.monotonic()
+        w.charge(waiter, 60, Deadline(5.0))  # must NOT trip
+        assert time.monotonic() - t0 < 2.0
+        assert waiter.spool_budgeted == 60 and not waiter.spool_overdraft
+
+    def test_wedged_pool_abandons_all_layers_in_one_grace(self):
+        """A fully wedged walker pool must abandon EVERY remaining
+        layer after one zero-progress grace window — not serially,
+        one grace per layer (20 wedged layers used to take 20×grace
+        ≈ an hour at default budgets before degrading)."""
+        import time
+        from trivy_tpu.fanal.pipeline import IngestPipeline, LayerTask
+        release = threading.Event()
+
+        @contextlib.contextmanager
+        def _blocked_open():
+            release.wait(20.0)   # wedged until the test frees it
+            yield None           # never reached in-wedge
+        opts = _tight_opts(walkers=1, layer_deadline_ms=50.0,
+                           abandon_grace_s=0.3)
+        pipe = IngestPipeline(AnalyzerGroup(), opts)
+        grace = opts.watch_timeout_s() + opts.abandon_grace_s
+        try:
+            tasks = [LayerTask(idx=i, diff_id=f"sha256:{i}",
+                               blob_id=f"b{i}", created_by="",
+                               open_stream=_blocked_open)
+                     for i in range(6)]
+            t0 = time.monotonic()
+            scans = pipe.run(tasks)
+            elapsed = time.monotonic() - t0
+        finally:
+            release.set()
+            pipe.close()
+        assert len(scans) == 6
+        assert all(s.partial for s in scans.values())
+        assert all(any(e["Kind"] == "wedged" for e in s.errors)
+                   for s in scans.values()), [
+                       s.errors for s in scans.values()]
+        # one shared grace window, not 6 serialized ones
+        assert elapsed < grace * 3, \
+            f"abandon took {elapsed:.2f}s (grace={grace:.2f}s)"
+
+    def test_analyze_fault_partial_not_fatal(self, tmp_path):
+        p = self._clean_image(tmp_path)
+        FAILPOINTS.set("fanal.analyze", "error")
+        try:
+            ref, cache = _inspect(p, _tight_opts())
+        finally:
+            FAILPOINTS.clear("fanal.analyze")
+        doc = _blob_docs(cache, ref)[0]
+        assert any(e["Stage"] == "analyze"
+                   for e in doc["IngestErrors"]), doc["IngestErrors"]
+
+    def test_partial_blobs_salted_never_poison_cache(self, tmp_path):
+        p = self._clean_image(tmp_path)
+        FAILPOINTS.set("fanal.walk", "error")
+        try:
+            ref, cache = _inspect(p, _tight_opts())
+        finally:
+            FAILPOINTS.clear("fanal.walk")
+        # canonical ids all missing; the partial landed under the salt
+        missing_artifact, missing = cache.missing_blobs(
+            ref.id, [partial_blob_id("x", [])])
+        assert _blob_docs(cache, ref)  # addressable for THIS scan
+        ref2, cache2 = _inspect(p, _tight_opts())   # fault cleared
+        assert ref2.blob_ids != ref.blob_ids
+        assert not _blob_docs(cache2, ref2)[0].get("IngestErrors")
+
+    def test_report_surfaces_ingest_degradations(self, tmp_path):
+        from trivy_tpu import types as T
+        from trivy_tpu.db.table import build_table
+        from trivy_tpu.scanner import LocalScanner
+        p = self._clean_image(tmp_path)
+        FAILPOINTS.set("fanal.walk", "error")
+        try:
+            ref, cache = _inspect(p, _tight_opts())
+        finally:
+            FAILPOINTS.clear("fanal.walk")
+        scanner = LocalScanner(cache, build_table([]))
+        try:
+            results, _os = scanner.scan(
+                ref.name, ref.id, ref.blob_ids,
+                T.ScanOptions(scanners=("vuln",)))
+        finally:
+            scanner.close()
+        ing = [r for r in results if r.clazz == T.ResultClass.INGEST]
+        assert len(ing) == 1
+        assert ing[0].ingest_errors
+        body = json.dumps([r.to_json() for r in results])
+        assert "IngestErrors" in body
+
+    def test_metrics_and_healthz_expose_ingest(self, tmp_path):
+        from trivy_tpu.metrics import METRICS
+        from trivy_tpu.obs.exposition import parse_exposition
+        p = self._clean_image(tmp_path)
+        before = METRICS.get("trivy_tpu_ingest_partial_scans_total")
+        FAILPOINTS.set("fanal.walk", "error")
+        try:
+            _inspect(p, _tight_opts())
+        finally:
+            FAILPOINTS.clear("fanal.walk")
+        assert METRICS.get("trivy_tpu_ingest_partial_scans_total") \
+            > before
+        parse_exposition(METRICS.render())
+        st = INGEST.status()
+        assert st["partial_scans_total"] >= 1
+        assert set(st["breakers"]) == {"walk", "analyze"}
+
+
+def test_cli_ingest_flag_defaults_match_dataclass():
+    """The --ingest-* argparse defaults must mirror the IngestOptions
+    dataclass defaults: cli._ingest_options passes only flags the
+    subcommand defines, so a drifted argparse default would silently
+    give flagged subcommands a different budget than documented."""
+    import argparse
+
+    from trivy_tpu import cli as cli_mod
+
+    parser = cli_mod.build_parser()
+    sub = next(a for a in parser._actions
+               if isinstance(a, argparse._SubParsersAction))
+    image = sub.choices["image"]
+    defaults = IngestOptions()
+    for field in cli_mod._INGEST_FLAG_FIELDS:
+        assert image.get_default("ingest_" + field) == \
+            getattr(defaults, field), field
+
+
+# ---------------------------------------------------------------------------
+# graftstorm: the ingest chaos drill
+
+
+class TestIngestStorm:
+    def test_schedule_generation_deterministic(self):
+        from trivy_tpu.resilience.storm import generate_schedule
+        a = generate_schedule(11, "ingest", n_events=5)
+        b = generate_schedule(11, "ingest", n_events=5)
+        assert a.to_json() == b.to_json()
+        kinds = {e.kind for s in range(6)
+                 for e in generate_schedule(s, "ingest",
+                                            n_events=6).events}
+        assert "hostile_layer" in kinds
+        sites = {e.site for s in range(8)
+                 for e in generate_schedule(s, "ingest",
+                                            n_events=6).events
+                 if e.kind == "failpoint"}
+        assert sites & {"fanal.walk", "fanal.analyze"}
+
+    def test_hostile_variants_round_trip_replay(self, tmp_path):
+        from trivy_tpu.resilience.storm import Schedule, StormEvent
+        sched = Schedule(seed=5, topology="ingest", horizon_ms=100.0,
+                         events=[StormEvent(
+                             at_ms=1.0, kind="hostile_layer",
+                             variant="bomb", dur_ms=50.0)])
+        doc = sched.to_json()
+        back = Schedule.from_json(json.loads(json.dumps(doc)))
+        assert back.events[0].variant == "bomb"
+        assert back.events[0].label().startswith(
+            "hostile_layer(bomb)")
+
+    def test_acceptance_drill_explicit_schedule(self):
+        """ISSUE acceptance: at c=8, hang-mode fanal.walk + a
+        truncated layer + a decompression bomb → zero 5xx, every
+        affected scan a deterministic annotated partial, all ingest
+        breakers re-closed after the faults clear."""
+        from trivy_tpu.resilience.storm import (Schedule, StormEvent,
+                                                StormOptions,
+                                                run_storm)
+        sched = Schedule(seed=77, topology="ingest",
+                         horizon_ms=1200.0, events=[
+                             StormEvent(at_ms=50.0, site="fanal.walk",
+                                        mode="hang", arg=500.0,
+                                        dur_ms=400.0),
+                             StormEvent(at_ms=250.0,
+                                        kind="hostile_layer",
+                                        variant="truncated",
+                                        dur_ms=300.0),
+                             StormEvent(at_ms=600.0,
+                                        kind="hostile_layer",
+                                        variant="bomb",
+                                        dur_ms=300.0),
+                         ])
+        rep = run_storm(sched, StormOptions(requests=12,
+                                            concurrency=8,
+                                            settle_s=10.0))
+        assert rep.ok, rep.violations
+        # no 5xx anywhere: every outcome is ok or a well-formed shed
+        assert all(o.status in ("ok", "shed") for o in rep.outcomes)
+        # hostile-window scans degraded to annotated partials
+        hostile = [o for o in rep.outcomes if "variant=" in o.detail]
+        assert hostile and all(o.partial for o in hostile)
+        # breakers re-closed (the breakers_reclose invariant passed,
+        # which includes the ingest stages via IngestTopology.settled)
+        assert INGEST.breaker("walk").state_name() == "closed"
+        assert INGEST.breaker("analyze").state_name() == "closed"
+
+    def test_acceptance_drill_seeded_schedule(self):
+        """The same drill from graftstorm's seeded generator — the
+        invariant engine must pass an arbitrary ingest schedule."""
+        from trivy_tpu.resilience.storm import (StormOptions,
+                                                generate_schedule,
+                                                run_storm)
+        sched = generate_schedule(3, "ingest", n_events=4)
+        rep = run_storm(sched, StormOptions(requests=10,
+                                            concurrency=8,
+                                            settle_s=10.0))
+        assert rep.ok, (sched.to_json(), rep.violations)

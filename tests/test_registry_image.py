@@ -257,3 +257,89 @@ class TestRegistryStreaming:
             assert not cache.blobs
         finally:
             reg.stop()
+
+    def test_partial_layer_drainable_tail_still_verified(self):
+        """A mid-stream budget stop whose remaining tail fits the
+        drain budget (bounded_drain reaches EOF) must still enforce
+        the manifest digest: tampered bytes never cache, even when
+        the walk already degraded to a partial."""
+        from trivy_tpu.fanal.artifact import RegistryArtifact
+        from trivy_tpu.fanal.cache import MemoryCache
+        from trivy_tpu.fanal.pipeline import IngestOptions
+        from trivy_tpu.oci import OCIError
+        # 100 KiB of zeros: the 32 KiB layer cap trips mid-spool
+        # (partial), but the COMPRESSED tail is a few hundred bytes —
+        # well inside the drain budget, so verify() still runs
+        layer = tar_of({"pad.bin": b"\0" * (100 << 10)})
+        config = {"architecture": "amd64", "os": "linux",
+                  "rootfs": {"type": "layers",
+                             "diff_ids": ["sha256:" + "2" * 64]}}
+        reg = FakeRegistry()
+        base = reg.start()
+        reg.put_image("library/tail", "1", [layer], config)
+        try:
+            for digest, data in list(reg.blobs.items()):
+                if data[:2] == b"\x1f\x8b":   # the gzipped layer blob
+                    reg.blobs[digest] = data + b"CORRUPT"
+            cache = MemoryCache()
+            art = RegistryArtifact(
+                f"{base}/library/tail:1", cache,
+                client=RegistryClient(),
+                ingest=IngestOptions(max_layer_bytes=32 << 10))
+            with pytest.raises(OCIError, match="digest mismatch"):
+                art.inspect()
+            assert not cache.blobs
+        finally:
+            reg.stop()
+
+    def test_partial_layer_huge_tail_skips_verify_bounded(self):
+        """A mid-stream budget stop with a tail far past the drain
+        budget must NOT wedge the walker hashing bytes it will never
+        use: verify is skipped, the layer lands as a deterministic
+        annotated partial under its salted id (never canonical), and
+        inspect() completes instead of raising."""
+        import random
+
+        from trivy_tpu.fanal.artifact import RegistryArtifact
+        from trivy_tpu.fanal.cache import MemoryCache
+        from trivy_tpu.fanal.pipeline import IngestOptions
+        # 2 MiB of seeded random bytes: incompressible, so after the
+        # 32 KiB cap trips the UNREAD compressed tail is ~2 MiB —
+        # orders of magnitude past the drain budget (= the layer cap)
+        blob = random.Random(42).randbytes(2 << 20)
+        layer = tar_of({"big.bin": blob})
+        config = {"architecture": "amd64", "os": "linux",
+                  "rootfs": {"type": "layers",
+                             "diff_ids": ["sha256:" + "3" * 64]}}
+        reg = FakeRegistry()
+        base = reg.start()
+        reg.put_image("library/bigtail", "1", [layer], config)
+        try:
+            # corrupt the layer blob: if verify() RAN it would raise —
+            # the bounded drain must skip it for this tail instead
+            for digest, data in list(reg.blobs.items()):
+                if data[:2] == b"\x1f\x8b":
+                    reg.blobs[digest] = data + b"CORRUPT"
+            cache = MemoryCache()
+            art = RegistryArtifact(
+                f"{base}/library/bigtail:1", cache,
+                client=RegistryClient(),
+                ingest=IngestOptions(max_layer_bytes=32 << 10))
+            ref = art.inspect()   # no OCIError: degraded, not failed
+            bi = cache.get_blob(ref.blob_ids[0])
+            assert any(e.get("Kind") == "budget.layer_bytes"
+                       for e in bi.ingest_errors)
+            # cached ONLY under the salted partial id: a fresh scan's
+            # missing-blobs diff re-walks the canonical key
+            art2 = RegistryArtifact(
+                f"{base}/library/bigtail:1", MemoryCache(),
+                client=RegistryClient(),
+                ingest=IngestOptions(max_layer_bytes=32 << 10))
+            man = art2.manifest()
+            image_id = man["config"]["digest"]
+            _, canonical = art2._image_keys(
+                image_id, ["sha256:" + "3" * 64])
+            assert canonical[0] not in cache.blobs
+            assert ref.blob_ids[0] != canonical[0]
+        finally:
+            reg.stop()

@@ -61,6 +61,10 @@ _LOCK_SCOPE = (
     # graftfleet: the ring and replica supervisor are shared across
     # router handler threads and the readmission loop
     os.path.join("trivy_tpu", "fleet") + os.sep,
+    # fanald: the ingest supervisor, byte budget, and pipeline state
+    # are shared across walker threads, the analyzer pool, and the
+    # watchdog
+    os.path.join("trivy_tpu", "fanal", "pipeline.py"),
 )
 
 

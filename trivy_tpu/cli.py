@@ -109,6 +109,42 @@ def _add_scan_flags(p: argparse.ArgumentParser):
     p.add_argument("--java-db", default="",
                    help="prebuilt trivy-java.db (sha1→GAV); defaults to "
                         "<cache-dir>/javadb/trivy-java.db when present")
+    # fanald — the supervised streaming ingest pipeline (image
+    # sources). Budgets bind AS the layer tar streams; exceeding one
+    # yields an annotated partial result, never a crash.
+    p.add_argument("--ingest-serial", action="store_true",
+                   help="disable the fanald ingest pipeline and walk "
+                        "layers through the serial parity-oracle "
+                        "walker (bit-identical on well-formed inputs, "
+                        "no budgets, no containment)")
+    p.add_argument("--ingest-walkers", type=int, default=0,
+                   help="concurrent per-layer walkers (0 = auto: one "
+                        "per core, max 8)")
+    p.add_argument("--ingest-analyzers", type=int, default=0,
+                   help="analyzer pool width for batched dispatch "
+                        "(0 = auto)")
+    p.add_argument("--ingest-max-file-bytes", type=int,
+                   default=128 << 20,
+                   help="per-file content cap; larger members are "
+                        "skipped with an annotation (default 128MiB)")
+    p.add_argument("--ingest-max-layer-bytes", type=int,
+                   default=2 << 30,
+                   help="per-layer decompressed byte cap, enforced "
+                        "mid-stream (decompression bombs stop here, "
+                        "never buffered; default 2GiB)")
+    p.add_argument("--ingest-max-members", type=int, default=200000,
+                   help="per-layer tar member cap (default 200000)")
+    p.add_argument("--ingest-layer-deadline-ms", type=float,
+                   default=120000.0,
+                   help="per-layer walk deadline; a wedged parse "
+                        "trips the ingest walk breaker and the layer "
+                        "degrades to an annotated partial "
+                        "(default 120000)")
+    p.add_argument("--ingest-max-inflight-bytes", type=int,
+                   default=256 << 20,
+                   help="pipeline-wide cap on file content in the "
+                        "analysis window — walkers block (bounded) "
+                        "before reading past it (default 256MiB)")
 
 
 def _add_watch_flags(p: argparse.ArgumentParser):
@@ -611,6 +647,30 @@ def _configure_misconf(args) -> None:
                           namespaces=ns)
 
 
+_INGEST_FLAG_FIELDS = ("walkers", "analyzers", "max_file_bytes",
+                       "max_layer_bytes", "max_members",
+                       "layer_deadline_ms", "max_inflight_bytes")
+
+
+def _ingest_options(args):
+    """Build fanald IngestOptions from the --ingest-* flags and
+    install them as the process default (registry/daemon sources that
+    construct artifacts elsewhere read the default). Flags a
+    subcommand doesn't define fall back to the IngestOptions dataclass
+    defaults — the argparse defaults mirror them, gated by
+    test_pipeline's flag-default drift test."""
+    from .fanal.pipeline import IngestOptions, set_default_ingest
+    kw = {}
+    for field in _INGEST_FLAG_FIELDS:
+        v = getattr(args, "ingest_" + field, None)
+        if v is not None:
+            kw[field] = v
+    opts = IngestOptions(
+        enabled=not getattr(args, "ingest_serial", False), **kw)
+    set_default_ingest(opts)
+    return opts
+
+
 def _open_cache(args):
     """Cache backend selection (reference initCache run.go:344:
     fs / redis / s3 / memory) — one resolution path shared with the
@@ -708,6 +768,7 @@ def cmd_image(args) -> int:
                 "image acquisition failed: " + "; ".join(errors))
     try:
         cache = _open_cache(args)
+        ingest = _ingest_options(args)
         scanners = normalize_scanners(args.scanners)
         from .fanal.analyzers import AnalyzerGroup
         # image scans disable lockfile analyzers (run.go:167-169)
@@ -724,7 +785,8 @@ def cmd_image(args) -> int:
                 platform=getattr(args, "platform", "") or "linux/amd64",
                 client=remote_client,
                 skip_files=tuple(getattr(args, "skip_files", []) or ()),
-                skip_dirs=tuple(getattr(args, "skip_dirs", []) or ()))
+                skip_dirs=tuple(getattr(args, "skip_dirs", []) or ()),
+                ingest=ingest)
             art._manifest = remote_manifest
         elif containerd_store is not None:
             from .fanal.containerd import ContainerdArtifact
@@ -742,7 +804,8 @@ def cmd_image(args) -> int:
                 secret_scanner=sec_scanner,
                 secret_config_path=sec_cfg,
                 skip_files=tuple(getattr(args, "skip_files", []) or ()),
-                skip_dirs=tuple(getattr(args, "skip_dirs", []) or ()))
+                skip_dirs=tuple(getattr(args, "skip_dirs", []) or ()),
+                ingest=ingest)
         ref = None
         if "rekor" in getattr(args, "sbom_sources", ""):
             # remote-SBOM shortcut: a published SBOM attestation replaces

@@ -84,6 +84,11 @@ def _apply_layers_impl(blobs: list[T.BlobInfo]) -> T.ArtifactDetail:
     for blob in blobs:
         detail.custom_resources.extend(blob.custom_resources)
         detail.licenses.extend(blob.licenses)
+        # fanald degradation annotations squash additively in layer
+        # order: a partial layer's errors survive into the final
+        # detail (and from there into the report) — a later complete
+        # layer cannot mask an earlier degraded one
+        detail.ingest_errors.extend(blob.ingest_errors)
 
     detail.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
     _fill_identifiers(detail)

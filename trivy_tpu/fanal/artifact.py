@@ -78,10 +78,26 @@ class _ImageInspectMixin:
                             misses=len(missing))
             return missing_artifact, missing
 
+    def _ingest_options(self):
+        from .pipeline import default_ingest
+        return getattr(self, "ingest", None) or default_ingest()
+
     def _walk_missing_layers(self, diff_ids, blob_ids, created_by,
                              missing, open_layer,
-                             layer_digests=None) -> dict:
-        """open_layer(i) → context manager yielding a layer tarfile."""
+                             layer_digests=None,
+                             stream_open=None) -> dict:
+        """open_layer(i) → context manager yielding a layer tarfile
+        (the serial parity-oracle path). When the fanald pipeline is
+        enabled and the source provides `stream_open(i)` — a
+        THREAD-SAFE context manager yielding a pipeline.LayerStream —
+        missing layers walk concurrently through the supervised
+        pipeline instead. `blob_ids` is edited in place for partial
+        layers (see _walk_missing_pipelined)."""
+        ingest = self._ingest_options()
+        if ingest.enabled and stream_open is not None:
+            return self._walk_missing_pipelined(
+                ingest, diff_ids, blob_ids, created_by, missing,
+                stream_open, layer_digests)
         secret_files: dict = {}
         want_secrets = "secret" in self.scanners
         for i, (diff_id, blob_id, cb) in enumerate(
@@ -115,6 +131,67 @@ class _ImageInspectMixin:
                 self.cache.put_blob(blob_id, bi)
         return secret_files
 
+    def _walk_missing_pipelined(self, ingest, diff_ids, blob_ids,
+                                created_by, missing, stream_open,
+                                layer_digests) -> dict:
+        """fanald: walk every missing layer through the supervised
+        streaming pipeline. Complete layers cache under their
+        canonical blob id exactly like the serial path; a PARTIAL
+        layer caches only under a deterministic salted id
+        (pipeline.partial_blob_id) and `blob_ids` is rewritten in
+        place to point at it — the canonical key stays missing, so the
+        next scan re-walks instead of serving the degraded result
+        forever."""
+        from .pipeline import (IngestPipeline, LayerTask,
+                               partial_blob_id)
+        want_secrets = "secret" in self.scanners
+        tasks = []
+        for i, (diff_id, blob_id, cb) in enumerate(
+                zip(diff_ids, blob_ids, created_by)):
+            if blob_id not in missing:
+                continue
+            tasks.append(LayerTask(
+                idx=i, diff_id=diff_id, blob_id=blob_id,
+                created_by=cb,
+                open_stream=(lambda i=i: stream_open(i))))
+        if not tasks:
+            return {}
+        secret_files: dict = {}
+        pipe = IngestPipeline(
+            self.group, ingest, collect_secrets=want_secrets,
+            secret_config_path=self.secret_config_path,
+            skip_files=getattr(self, "skip_files", ()),
+            skip_dir_globs=getattr(self, "skip_dir_globs", ()))
+        from .pipeline import IngestIntegrityError
+        try:
+            with span("fanal.pipeline", layers=len(tasks)) as sp:
+                scans = pipe.run(tasks)
+                sp.attrs.update(partial=sum(
+                    1 for s in scans.values() if s.partial))
+        except IngestIntegrityError as e:
+            # surface the original failure (OCIError digest mismatch)
+            # exactly like the serial path; nothing was cached
+            raise (e.__cause__ or e) from None
+        finally:
+            pipe.close()
+        # finalize in layer order (deterministic output + cache puts)
+        for t in tasks:
+            scan = scans[t.idx]
+            bi = blob_info(scan, diff_id=t.diff_id,
+                           created_by=t.created_by)
+            if layer_digests:
+                bi.digest = layer_digests[t.idx]
+            blob_id = t.blob_id
+            if scan.partial:
+                blob_id = partial_blob_id(t.blob_id, bi.ingest_errors)
+                blob_ids[t.idx] = blob_id
+            if want_secrets and scan.secret_files:
+                secret_files[blob_id] = scan.secret_files
+                bi.secrets = self.secret_scanner.scan_files(
+                    scan.secret_files)
+            self.cache.put_blob(blob_id, bi)
+        return secret_files
+
     def _put_artifact_info(self, artifact_id: str, config: dict):
         self.cache.put_artifact(artifact_id, {
             "SchemaVersion": 2,
@@ -130,7 +207,8 @@ class ImageArchiveArtifact(_ImageInspectMixin):
     def __init__(self, path: str, cache, group: Optional[AnalyzerGroup] = None,
                  scanners: tuple = ("vuln",), secret_scanner=None,
                  secret_config_path: str = DEFAULT_SECRET_CONFIG,
-                 skip_files: tuple = (), skip_dirs: tuple = ()):
+                 skip_files: tuple = (), skip_dirs: tuple = (),
+                 ingest=None):
         self.path = path
         self.cache = cache
         self.group = group or AnalyzerGroup()
@@ -139,6 +217,8 @@ class ImageArchiveArtifact(_ImageInspectMixin):
         self.secret_config_path = secret_config_path
         self.skip_files = tuple(skip_files)
         self.skip_dir_globs = tuple(skip_dirs)
+        # fanald knobs (pipeline.IngestOptions); None = process default
+        self.ingest = ingest
         if "secret" in scanners and secret_scanner is None:
             from ..secret import SecretScanner
             self.secret_scanner = SecretScanner()
@@ -192,8 +272,16 @@ class ImageArchiveArtifact(_ImageInspectMixin):
             with tarfile.open(fileobj=io.BytesIO(data)) as layer_tf:
                 yield layer_tf
 
+        def stream_open(i):
+            # fanald: own outer handle per call (thread-safe); the
+            # compressed blob streams straight off the archive, and
+            # the decompressed spool is budget-bounded
+            from .pipeline import archive_member_stream
+            return archive_member_stream(self.path, layer_paths[i])
+
         secret_files = self._walk_missing_layers(
-            diff_ids, blob_ids, created_by, missing, open_layer)
+            diff_ids, blob_ids, created_by, missing, open_layer,
+            stream_open=stream_open)
 
         metadata = T.Metadata(
             image_id=image_id,
@@ -237,9 +325,14 @@ class ImageArchiveArtifact(_ImageInspectMixin):
             with tarfile.open(fileobj=io.BytesIO(data)) as layer_tf:
                 yield layer_tf
 
+        def stream_open(i):
+            from .pipeline import archive_member_stream
+            return archive_member_stream(
+                self.path, _blob_path(layer_digests[i]))
+
         secret_files = self._walk_missing_layers(
             diff_ids, blob_ids, created_by, missing, open_layer,
-            layer_digests=layer_digests)
+            layer_digests=layer_digests, stream_open=stream_open)
 
         metadata = T.Metadata(image_id=image_id, diff_ids=diff_ids,
                               image_config=config)
@@ -360,7 +453,8 @@ class RegistryArtifact(_ImageInspectMixin):
                  scanners: tuple = ("vuln",), secret_scanner=None,
                  secret_config_path: str = DEFAULT_SECRET_CONFIG,
                  platform: str = "linux/amd64", client=None,
-                 skip_files: tuple = (), skip_dirs: tuple = ()):
+                 skip_files: tuple = (), skip_dirs: tuple = (),
+                 ingest=None):
         from ..oci import default_client, parse_ref
         self.image = image
         self.ref = parse_ref(image)
@@ -373,6 +467,7 @@ class RegistryArtifact(_ImageInspectMixin):
         self.secret_config_path = secret_config_path
         self.skip_files = tuple(skip_files)
         self.skip_dir_globs = tuple(skip_dirs)
+        self.ingest = ingest
         if "secret" in scanners and secret_scanner is None:
             from ..secret import SecretScanner
             self.secret_scanner = SecretScanner()
@@ -415,9 +510,40 @@ class RegistryArtifact(_ImageInspectMixin):
                 # corrupted/tampered blob must never populate the cache
                 stream.verify()
 
+        @contextlib.contextmanager
+        def stream_open(i):
+            # fanald: each call is its own registry connection, so
+            # concurrent layer walkers stream independently. verify()
+            # drains the remainder in bounded chunks AFTER a clean
+            # walk; a digest mismatch is the one failure the pipeline
+            # must NOT degrade around (tampered bytes never cache),
+            # so it is wrapped as IngestIntegrityError and re-raised
+            # by _walk_missing_pipelined as the original OCIError.
+            from .pipeline import (IngestIntegrityError, bounded_drain,
+                                   layer_tar_stream)
+            with self.client.blob_stream(self.ref,
+                                         layers[i]["digest"]) as stream:
+                with layer_tar_stream(stream) as ls:
+                    yield ls
+                if not ls.fully_spooled and not bounded_drain(stream,
+                                                              ls):
+                    # mid-stream budget/parse stop with a tail too
+                    # big/slow to hash within the layer's own budgets:
+                    # draining it anyway would wedge the walker past
+                    # the watchdog and trip the SHARED walk breaker —
+                    # one hostile layer degrading every tenant. The
+                    # layer is already a partial, which caches only
+                    # under its salted id, never canonically, so
+                    # nothing unverified becomes authoritative.
+                    return
+                try:
+                    stream.verify()
+                except Exception as e:
+                    raise IngestIntegrityError(str(e)) from e
+
         secret_files = self._walk_missing_layers(
             diff_ids, blob_ids, created_by, missing, open_layer,
-            layer_digests=layer_digests)
+            layer_digests=layer_digests, stream_open=stream_open)
 
         metadata = T.Metadata(
             image_id=image_id,

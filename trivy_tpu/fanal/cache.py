@@ -290,6 +290,10 @@ def blob_from_json(j: dict) -> T.BlobInfo:
             nvr=j["BuildInfo"].get("Nvr", ""),
             arch=j["BuildInfo"].get("Arch", ""))
         if j.get("BuildInfo") else None,
+        # fanald partial-scan annotations survive the cache/RPC
+        # round-trip so a server scanning relayed partial blobs can
+        # still surface WHICH stage degraded them
+        ingest_errors=j.get("IngestErrors", []),
     )
 
 

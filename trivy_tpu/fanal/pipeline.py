@@ -1,0 +1,1226 @@
+"""fanald — the supervised streaming ingest pipeline (ROADMAP item 1).
+
+The serial walker (`walker.walk_layer_tar`) is correct but fragile: it
+walks layers one at a time, buffers each compressed layer whole before
+looking at it, reads every wanted member into unbounded memory, and
+trusts attacker-supplied tar metadata — one decompression bomb,
+truncated gzip stream, or million-member layer wedges or OOMs a server
+that graftguard/meshguard otherwise keep alive through chip loss and
+replica kills. fanald replaces that loop for image sources with a
+supervised pipeline:
+
+  walkers     concurrent per-layer walkers (bounded pool) stream each
+              layer tar straight off its source — own outer archive
+              handle or registry socket, gzip decoded incrementally —
+              the compressed blob is never copied whole and the
+              decompressed spool is bounded (shared window plus one
+              overdraft layer, each layer ≤ --ingest-max-layer-bytes);
+  budgets     enforced AS THE TAR STREAMS, never buffer-then-check:
+              per-file and per-layer byte caps, a member-count cap, a
+              per-layer deadline, and a decompression-ratio guard all
+              bind at read granularity (the counting reader under the
+              tar trips them mid-stream);
+  backpressure a pipeline-wide byte+item budget caps total in-flight
+              file content regardless of layer shape — a walker blocks
+              (deadline-bounded) before reading past it;
+  analyzers   batched dispatch through a bounded pool: one pass per
+              file-kind over many files (AnalyzerGroup.analyze_batch,
+              detectd's coalescing pattern), per-item results merged
+              back in member order so output is bit-identical to the
+              serial walker on well-formed inputs (property-tested;
+              the serial walker stays in-tree as the parity oracle);
+  supervision every stage runs under GUARD.watch against its own
+              ingest breaker (INGEST, one fault domain per stage) —
+              a wedged parse trips the `walk` breaker instead of
+              hanging the scan, and while a breaker is open new work
+              for that stage degrades instantly instead of queueing
+              behind the fault;
+  degradation a layer that exceeds budget / errors / times out yields
+              a deterministic partial BlobScan carrying structured
+              per-stage annotations (ingest_error dicts) surfaced in
+              the report and /healthz — never an exception, never a
+              500. Partial layers are cached only under a salted
+              partial id (partial_blob_id) so the canonical cache key
+              stays missing and the next scan re-walks.
+
+Failpoint sites `fanal.walk` / `fanal.analyze` make every failure mode
+above schedulable by graftstorm alongside chip loss and replica kills.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import contextvars
+import gzip
+import hashlib
+import io
+import json
+import tarfile
+import threading
+import zlib
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import wait as _fut_wait
+from dataclasses import dataclass
+
+from ..log import get as _get_logger
+from ..metrics import METRICS
+from ..obs import span
+from ..resilience import (GUARD, BreakerRegistry, DeviceError,
+                          DeviceTimeout, failpoint)
+from ..resilience.breaker import Deadline
+from .analyzers import AnalysisResult, AnalyzerGroup
+from .walker import (DEFAULT_SECRET_CONFIG, BlobScan, classify_member,
+                     looks_binary, normalize_skip_globs)
+
+_log = _get_logger("fanal.pipeline")
+
+WALK_SITE = "fanal.walk"
+ANALYZE_SITE = "fanal.analyze"
+
+
+@dataclass
+class IngestOptions:
+    """fanald knobs (scan flags of the same names, `--ingest-*`).
+
+    The defaults are sized for real images: big enough that no
+    well-formed layer ever trips them (parity with the serial oracle),
+    small enough that a hostile input is bounded. `enabled=False`
+    routes ingest through the serial parity-oracle walker."""
+    enabled: bool = True
+    walkers: int = 0              # per-layer walkers; 0 = auto (cores)
+    analyzers: int = 0            # analyzer pool width; 0 = auto
+    batch_files: int = 32         # files per analyzer dispatch
+    batch_bytes: int = 4 << 20    # bytes per analyzer dispatch
+    max_file_bytes: int = 128 << 20     # per-file content cap
+    max_layer_bytes: int = 2 << 30      # per-layer decompressed cap
+    max_members: int = 200_000          # per-layer member-count cap
+    layer_deadline_ms: float = 120_000.0
+    max_inflight_bytes: int = 256 << 20  # pipeline-wide content budget
+    max_inflight_items: int = 2048
+    max_ratio: float = 200.0      # decompression-bomb ratio guard
+    ratio_floor: int = 1 << 20    # ratio guard arms past this output
+    # extra patience past the watch deadline before a zero-progress
+    # pool is declared wedged and its remaining work abandoned (not a
+    # CLI flag: the watch deadline is the tunable; this only absorbs
+    # scheduler jitter)
+    abandon_grace_s: float = 5.0
+
+    def n_walkers(self) -> int:
+        """0 = auto: one walker per core up to 8 — layer inflation
+        releases the GIL, the Python walk bookkeeping does not, so
+        over-threading a small host just thrashes."""
+        import os
+        return int(self.walkers) or min(os.cpu_count() or 2, 8)
+
+    def n_analyzers(self) -> int:
+        return int(self.analyzers) or max(self.n_walkers() // 2, 2)
+
+    def watch_timeout_s(self) -> float:
+        """The GUARD.watch deadline for one stage unit of work: the
+        cooperative layer deadline plus a grace margin, so an
+        overrunning-but-progressing layer stops itself (budget
+        annotation, no breaker charge) while a WEDGED one — blocked in
+        a read, asleep in a failpoint — trips the watchdog."""
+        dl = self.layer_deadline_ms / 1e3
+        return dl + max(0.05, dl * 0.5)
+
+
+# process-default options (the CLI's --ingest-* flags land here; the
+# artifacts read it when not handed explicit IngestOptions)
+_DEFAULT_INGEST = IngestOptions()
+
+
+def set_default_ingest(opts: IngestOptions) -> None:
+    global _DEFAULT_INGEST
+    _DEFAULT_INGEST = opts
+
+
+def default_ingest() -> IngestOptions:
+    return _DEFAULT_INGEST
+
+
+def ingest_error(stage: str, kind: str, detail: str = "",
+                 layer: int | None = None, path: str = "") -> dict:
+    """One structured per-stage degradation annotation. PascalCase
+    keys so the dict rides BlobInfo/Result JSON verbatim (cache
+    round-trip, report output, PutBlob relay)."""
+    err = {"Stage": stage, "Kind": kind}
+    if detail:
+        err["Detail"] = detail
+    if layer is not None:
+        err["Layer"] = int(layer)
+    if path:
+        err["Path"] = path
+    return err
+
+
+def partial_blob_id(blob_id: str, errors: list) -> str:
+    """Deterministic salted cache key for a PARTIAL layer result: the
+    canonical blob id never maps to a degraded BlobInfo, so the next
+    scan's MissingBlobs diff re-walks the layer instead of serving the
+    partial forever — while THIS scan (and its PutBlob relay to a
+    server) still has an addressable blob to read."""
+    h = hashlib.sha256()
+    h.update(b"ingest-partial|")
+    h.update(blob_id.encode())
+    h.update(json.dumps(errors, sort_keys=True,
+                        separators=(",", ":")).encode())
+    return "sha256:" + h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# supervision: one fault domain per ingest stage
+
+
+class IngestSupervisor:
+    """Process-wide ingest fault domains + counters (the /healthz
+    `resilience.ingest` block). One CircuitBreaker per stage — `walk`
+    and `analyze` — charged through GUARD.watch exactly like the
+    device and mesh domains: a watchdog expiry trips the stage's
+    breaker immediately, errors count toward its threshold, and while
+    a breaker is open new work for that stage yields an annotated
+    partial instantly (the half-open probe is the first unit of work
+    the reset window admits; its success re-closes the stage)."""
+
+    STAGES = ("walk", "analyze")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.registry = BreakerRegistry(
+            fail_threshold=3, reset_timeout_s=5.0,
+            gauge="trivy_tpu_ingest_breaker_state", label="stage",
+            name_fn=lambda k: f"ingest.{k}")
+        self._counters = {"partial_scans": 0, "budget_trips": 0,
+                          "layers_walked": 0}
+        self._busy_walkers = 0
+
+    def breaker(self, stage: str):
+        return self.registry.get(stage)
+
+    def note(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[counter] += n
+
+    def walker_busy(self, delta: int) -> None:
+        with self._lock:
+            self._busy_walkers += delta
+            busy = self._busy_walkers
+        METRICS.set_gauge("trivy_tpu_ingest_walker_busy", float(busy))
+
+    def configure(self, fail_threshold: int | None = None,
+                  reset_timeout_s: float | None = None) -> None:
+        self.registry.configure(fail_threshold=fail_threshold,
+                                reset_timeout_s=reset_timeout_s)
+
+    def status(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            busy = self._busy_walkers
+        return {
+            "breakers": {s: self.breaker(s).status()
+                         for s in self.STAGES},
+            "partial_scans_total": counters["partial_scans"],
+            "budget_trips_total": counters["budget_trips"],
+            "layers_walked_total": counters["layers_walked"],
+            "busy_walkers": busy,
+        }
+
+    def settled(self) -> list[str]:
+        """→ [] once every ingest breaker is closed again (the storm
+        liveness probe for the ingest topology)."""
+        out = []
+        for s in self.STAGES:
+            name = self.breaker(s).state_name()
+            if name != "closed":
+                out.append(f"ingest {s} breaker {name}")
+        return out
+
+    def reset_for_tests(self) -> None:
+        for s in self.STAGES:
+            self.breaker(s).reset()
+        with self._lock:
+            self._counters = {k: 0 for k in self._counters}
+            self._busy_walkers = 0
+
+
+INGEST = IngestSupervisor()
+
+
+# ---------------------------------------------------------------------------
+# budgets
+
+
+class IngestIntegrityError(RuntimeError):
+    """A layer failed content-integrity verification (registry blob
+    digest mismatch after the walk). The ONE failure fanald does NOT
+    degrade around: tampered bytes must neither be cached nor scanned
+    — it propagates out of the pipeline exactly like the serial
+    path's OCIError (the artifact re-raises the wrapped original)."""
+
+
+class _PoolClosed(Exception):
+    """The pipeline is tearing down (pipe.close() raced this walker —
+    e.g. another layer's scan-fatal integrity failure aborted the
+    run): a cooperative stop. Never a stage fault (no breaker
+    charge), never a budget trip (not the input's doing either)."""
+
+
+class IngestBudgetTrip(Exception):
+    """A cooperative budget/deadline stop: the layer ends as a
+    deterministic partial. Distinct from watchdog/backend failures —
+    budget trips never charge a breaker (they are the INPUT's fault,
+    not the stage's)."""
+
+    def __init__(self, kind: str, detail: str):
+        super().__init__(detail)
+        self.kind = kind
+        self.detail = detail
+
+
+class _ByteBudget:
+    """Pipeline-wide in-flight content budget (bytes AND items): a
+    walker acquires a file's bytes BEFORE reading them and the
+    analyzer stage releases them when its batch resolves, so the total
+    analysis-window content is capped regardless of layer shape.
+    Retained post/secret content is bounded separately by the
+    per-layer byte cap. `high_water` is the provable bound the
+    property tests assert."""
+
+    def __init__(self, max_bytes: int, max_items: int):
+        self._cv = threading.Condition()
+        self.max_bytes = max(int(max_bytes), 1)
+        self.max_items = max(int(max_items), 1)
+        self._bytes = 0
+        self._items = 0
+        self.high_water = 0
+
+    def acquire(self, n: int, deadline: Deadline) -> bool:
+        """Block until `n` bytes fit (backpressure); → False when the
+        deadline expires first (the caller annotates + stops)."""
+        n = min(int(n), self.max_bytes)
+        with self._cv:
+            while self._bytes + n > self.max_bytes \
+                    or self._items + 1 > self.max_items:
+                left = deadline.remaining()
+                if left <= 0:
+                    return False
+                self._cv.wait(timeout=min(left, 0.05))
+            self._bytes += n
+            self._items += 1
+            if self._bytes > self.high_water:
+                self.high_water = self._bytes
+            by = self._bytes
+        METRICS.set_gauge("trivy_tpu_ingest_inflight_bytes", float(by))
+        return True
+
+    def release(self, n: int) -> None:
+        n = min(int(n), self.max_bytes)
+        with self._cv:
+            self._bytes -= n
+            self._items -= 1
+            by = self._bytes
+            self._cv.notify_all()
+        METRICS.set_gauge("trivy_tpu_ingest_inflight_bytes", float(by))
+
+
+class _SpoolWindow:
+    """Shared cap on DECOMPRESSED layer bytes held in spool buffers
+    across all walkers, with a single-overdraft progress guarantee:
+    when the window is full, exactly ONE walker at a time may keep
+    spooling past it (its layer is still capped by max_layer_bytes) —
+    so concurrent big layers serialize instead of either OOMing the
+    host (walkers × max_layer_bytes) or deadlocking against each
+    other. Total spool memory ≤ window + one layer + one chunk."""
+
+    def __init__(self, max_bytes: int):
+        self._cv = threading.Condition()
+        self.max_bytes = max(int(max_bytes), 1)
+        self._bytes = 0
+        self._overdraft_held = False
+        self.high_water = 0
+
+    def charge(self, st, n: int, deadline: Deadline) -> None:
+        """Account `n` more spooled bytes for layer state `st`;
+        blocks (deadline-bounded) for the overdraft token when the
+        shared window is full."""
+        with self._cv:
+            if not st.spool_overdraft and \
+                    self._bytes + n <= self.max_bytes:
+                self._bytes += n
+                st.spool_budgeted += n
+                if self._bytes > self.high_water:
+                    self.high_water = self._bytes
+                return
+            while not st.spool_overdraft:
+                # re-check the window fit FIRST: another layer's
+                # release may have freed room while we waited — a
+                # waiter parked behind the overdraft token must not
+                # stay blocked (and eventually trip its deadline on
+                # well-formed input) when plain window capacity opened
+                if self._bytes + n <= self.max_bytes:
+                    self._bytes += n
+                    st.spool_budgeted += n
+                    if self._bytes > self.high_water:
+                        self.high_water = self._bytes
+                    return
+                if not self._overdraft_held:
+                    self._overdraft_held = True
+                    st.spool_overdraft = True
+                    break
+                left = deadline.remaining()
+                if left <= 0:
+                    raise IngestBudgetTrip(
+                        "deadline",
+                        "spool backpressure wait exceeded the layer "
+                        "deadline (shared spool window saturated)")
+                self._cv.wait(timeout=min(left, 0.05))
+            # overdraft holder: uncharged past the window, bounded by
+            # the per-layer cap
+
+    def release(self, st) -> None:
+        with self._cv:
+            self._bytes -= st.spool_budgeted
+            st.spool_budgeted = 0
+            if st.spool_overdraft:
+                self._overdraft_held = False
+                st.spool_overdraft = False
+            self._cv.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# streaming layer opens
+
+
+class _ChainReader:
+    """Serve a sniffed prefix, then the underlying stream."""
+
+    def __init__(self, head: bytes, raw):
+        self._head = head
+        self._raw = raw
+
+    def read(self, n: int = -1):
+        if self._head:
+            if n is None or n < 0 or n >= len(self._head):
+                out, self._head = self._head, b""
+                if n is not None and n >= 0:
+                    n -= len(out)
+                    if n == 0:
+                        return out
+                rest = self._raw.read(n if n is not None and n >= 0
+                                      else -1)
+                return out + rest
+            out, self._head = self._head[:n], self._head[n:]
+            return out
+        return self._raw.read(n)
+
+
+class _CountingReader:
+    """Byte counter with an optional hard limit and per-chunk trip
+    callback. This is where the stream budgets BIND: the spool loop
+    cannot move a single chunk past the limit, so a decompression
+    bomb is stopped mid-stream — never buffered whole, never checked
+    after the fact. Used two ways: wrapping a file object (`read`)
+    or as a bare counter the inflate loop feeds (`note`)."""
+
+    def __init__(self, raw=None, limit: int | None = None, trip=None):
+        self.raw = raw
+        self.count = 0
+        self.limit = limit
+        self.trip = trip    # callable() raising IngestBudgetTrip
+
+    def note(self, n: int) -> None:
+        # count FIRST, then run the trip callback (ratio/deadline),
+        # then the hard limit: the ratio guard must see the chunk it
+        # is judging, and a bomb should trip as a BOMB, not as the
+        # layer-bytes cap it also happens to blow through
+        self.count += n
+        if self.trip is not None:
+            self.trip()
+        if self.limit is not None and self.count > self.limit:
+            raise IngestBudgetTrip(
+                "budget.layer_bytes",
+                f"layer stream exceeded {self.limit} decompressed "
+                f"bytes (--ingest-max-layer-bytes)")
+
+    def read(self, n: int = -1):
+        b = self.raw.read(n)
+        self.note(len(b))
+        return b
+
+
+class _ChunkListReader(io.RawIOBase):
+    """Seekable zero-copy reader over the spooled chunk list: the
+    layer is served to tarfile exactly as the inflate loop produced
+    it — no join, no BytesIO growth re-copies, no second whole-layer
+    buffer, so the spool-window charge (the chunk bytes themselves)
+    IS the spool's memory footprint."""
+
+    def __init__(self, chunks: list):
+        self._chunks = chunks
+        self._offsets = [0]
+        for c in chunks:
+            self._offsets.append(self._offsets[-1] + len(c))
+        self._size = self._offsets[-1]
+        self._pos = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def seek(self, offset: int, whence: int = io.SEEK_SET) -> int:
+        if whence == io.SEEK_SET:
+            pos = offset
+        elif whence == io.SEEK_CUR:
+            pos = self._pos + offset
+        elif whence == io.SEEK_END:
+            pos = self._size + offset
+        else:
+            raise ValueError(f"invalid whence {whence}")
+        if pos < 0:
+            raise ValueError("negative seek position")
+        self._pos = pos
+        return pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def readinto(self, b) -> int:
+        if self._pos >= self._size:
+            return 0
+        view = memoryview(b)
+        i = bisect.bisect_right(self._offsets, self._pos) - 1
+        n, pos = 0, self._pos
+        while n < len(view) and i < len(self._chunks):
+            c = self._chunks[i]
+            start = pos - self._offsets[i]
+            take = min(len(c) - start, len(view) - n)
+            view[n:n + take] = memoryview(c)[start:start + take]
+            n += take
+            pos += take
+            i += 1
+        self._pos = pos
+        return n
+
+
+class LayerStream:
+    """A layer blob on its way into a tar reader, with counted bytes:
+    `c_in` counts compressed bytes off the source (None for
+    uncompressed layers), `c_out` counts decompressed bytes — their
+    ratio is the decompression-bomb guard.
+
+    The caller arms `c_out.limit` / `c_out.trip`, then calls
+    `spool()`: the decompressed stream is pulled through the counting
+    reader in LARGE chunks (budgets and deadline bind at chunk
+    granularity, mid-stream — a bomb stops within one chunk of the
+    cap, holding at most `limit + chunk` bytes) into a chunk list
+    served zero-copy through a seekable reader, and `tar` opens over
+    that. Chunked spooling keeps the inflate loop in C (one-shot-
+    decompress speed) where a byte-granular stream-mode tarfile would
+    grind through thousands of small Python reads — measured 4-10×
+    slower per layer; the chunk list beats a growing BytesIO (whose
+    resize re-copies made it ~35% of the spool) and gzip.GzipFile
+    (per-read Python crc32 bookkeeping, ~40% of a layer walk)."""
+
+    CHUNK = 4 << 20          # decompressed bytes per budget check
+    # compressed bytes per source read: small enough that a normal
+    # layer (ratio ≲ 16) inflates under CHUNK in one call — a bigger
+    # read would leave most of the input in unconsumed_tail, and
+    # re-feeding that tail each iteration is quadratic memcpy churn
+    IN_CHUNK = 256 << 10
+
+    def __init__(self, c_in, c_out, gz: bool):
+        self.c_in = c_in
+        self.c_out = c_out
+        self._gz = gz
+        self.charge = None   # callable(nbytes): spool-window account
+        self.tar: tarfile.TarFile | None = None
+        self._buf: "io.BufferedReader | None" = None
+        # True once spool() consumed the compressed stream to EOF —
+        # the registry stream_open's digest verify() keys off this: a
+        # mid-stream budget trip leaves an arbitrarily large tail,
+        # and draining it just to hash would wedge the walker past
+        # the watchdog (partial layers never cache canonically, so
+        # skipping their verify forfeits nothing the salted cache
+        # id doesn't already mark)
+        self.fully_spooled = False
+
+    def spool(self) -> tarfile.TarFile:
+        parts: list = []
+        if self._gz:
+            # zlib with the gzip wrapper (wbits=31): header + CRC
+            # handled in C. max_length bounds each inflate call, so a
+            # bomb cannot expand more than CHUNK past the budget
+            # check even from one IN_CHUNK of compressed input.
+            d = zlib.decompressobj(31)
+            tail = b""
+            while True:
+                comp = tail if tail else self.c_in.read(self.IN_CHUNK)
+                if not comp:
+                    if not d.eof:
+                        raise EOFError(
+                            "Compressed file ended before the "
+                            "end-of-stream marker was reached")
+                    break
+                data = d.decompress(comp, self.CHUNK)
+                tail = d.unconsumed_tail
+                if data:
+                    self.c_out.note(len(data))
+                    if self.charge is not None:
+                        self.charge(len(data))
+                    parts.append(data)
+                if d.eof:
+                    # concatenated gzip members restart the inflater;
+                    # bare trailing padding ends the stream
+                    rest = d.unused_data.lstrip(b"\0")
+                    if not rest:
+                        break
+                    d = zlib.decompressobj(31)
+                    tail = rest
+        else:
+            while True:
+                data = self.c_out.read(self.CHUNK)
+                if not data:
+                    break
+                if self.charge is not None:
+                    self.charge(len(data))
+                parts.append(data)
+        self.fully_spooled = True
+        self._buf = io.BufferedReader(_ChunkListReader(parts),
+                                      buffer_size=64 << 10)
+        self.tar = tarfile.open(fileobj=self._buf)
+        return self.tar
+
+    def close(self) -> None:
+        with contextlib.suppress(Exception):
+            if self.tar is not None:
+                self.tar.close()
+
+
+@contextlib.contextmanager
+def layer_tar_stream(raw):
+    """Wrap a (possibly gzipped, sniffed by magic) layer blob stream
+    in counting readers; the caller arms budgets then spool()s."""
+    head = raw.read(2)
+    src = _ChainReader(head, raw)
+    if head[:2] == b"\x1f\x8b":
+        ls = LayerStream(_CountingReader(src), _CountingReader(),
+                         gz=True)
+    else:
+        ls = LayerStream(None, _CountingReader(src), gz=False)
+    try:
+        yield ls
+    finally:
+        ls.close()
+
+
+def bounded_drain(stream, ls) -> bool:
+    """Best-effort drain of a partially-walked blob's tail so its
+    digest can still be verified: reads through `stream` (which
+    hashes as it reads) up to `ls.drain_limit` bytes while
+    `ls.drain_deadline` holds. → True when EOF was reached (the
+    digest is checkable — e.g. a small corrupt tail); → False when
+    the tail is too big or too slow to hash within the layer's own
+    budgets — the caller skips verification rather than wedging the
+    walker past the watchdog (the layer is already a partial, which
+    caches only under its salted id, never canonically)."""
+    limit = int(getattr(ls, "drain_limit", 0) or (64 << 20))
+    deadline = getattr(ls, "drain_deadline", None)
+    drained = 0
+    while True:
+        if deadline is not None and deadline.expired():
+            return False
+        chunk = stream.read(min(1 << 20, limit - drained + 1))
+        if not chunk:
+            return True
+        drained += len(chunk)
+        if drained > limit:
+            return False
+
+
+@contextlib.contextmanager
+def archive_member_stream(archive_path: str, member_name: str):
+    """Thread-safe layer open for tarball archives: each call opens
+    its OWN outer handle, so concurrent per-layer walkers never share
+    a seeking file object — and the COMPRESSED blob is never copied
+    whole (the serial path's extract-then-decompress); the
+    decompressed spool stays bounded by the shared window plus the
+    per-layer cap."""
+    with tarfile.open(archive_path) as otf:
+        raw = otf.extractfile(member_name)
+        if raw is None:
+            raise FileNotFoundError(
+                f"{archive_path}: no such member {member_name}")
+        with layer_tar_stream(raw) as ls:
+            yield ls
+
+
+# ---------------------------------------------------------------------------
+# the pipeline
+
+
+@dataclass
+class LayerTask:
+    idx: int
+    diff_id: str
+    blob_id: str
+    created_by: str
+    open_stream: object   # () -> context manager yielding LayerStream
+
+
+class _LayerState:
+    """One layer's in-walk aggregation. Touched only by that layer's
+    walker thread; the analyzer pool communicates back exclusively
+    through the futures in `pending`."""
+
+    def __init__(self):
+        self.seq = 0
+        self.members = 0
+        self.layer_bytes = 0
+        self.post: dict = {}       # seq -> (path, content)
+        self.secrets: list = []    # (seq, path, content)
+        self.pending: list = []    # (first_seq, Future, batch items)
+        self.spool_budgeted = 0    # bytes charged to the spool window
+        self.spool_overdraft = False
+        self.integrity_error = None   # IngestIntegrityError to re-raise
+
+
+# input-shaped failures: contained as partial results WITHOUT charging
+# the walk breaker — one tenant's hostile layer must not degrade the
+# ingest stage for everyone else. Anything outside this set (and every
+# injected FailpointError) goes through the watch and charges it.
+# Deliberately NOT a bare OSError: a failing local disk (EIO) mid-walk
+# is a stage fault the supervision must see, not a hostile input —
+# only gzip.BadGzipFile (an OSError subclass the decoder raises for
+# malformed streams) is input-shaped.
+_HOSTILE_INPUT_ERRORS = (tarfile.TarError, gzip.BadGzipFile, EOFError,
+                         UnicodeError, ValueError, zlib.error)
+# at layer OPEN, missing/misnamed members are the (attacker-supplied)
+# manifest's fault too
+_HOSTILE_OPEN_ERRORS = _HOSTILE_INPUT_ERRORS + (FileNotFoundError,
+                                                KeyError)
+
+
+class IngestPipeline:
+    """One pipelined walk over an image's missing layers: a bounded
+    walker pool streams layers concurrently, feeding a bounded
+    analyzer pool through the byte/item budget; each layer resolves to
+    a BlobScan that is either complete (bit-identical to the serial
+    walker) or a deterministic annotated partial."""
+
+    def __init__(self, group: AnalyzerGroup, opts: IngestOptions,
+                 collect_secrets: bool = False,
+                 secret_config_path: str = DEFAULT_SECRET_CONFIG,
+                 skip_files: tuple = (), skip_dir_globs: tuple = ()):
+        self.group = group
+        self.opts = opts
+        self.collect_secrets = collect_secrets
+        self.secret_config_path = secret_config_path
+        self.skip_files = normalize_skip_globs(skip_files)
+        self.skip_dir_globs = normalize_skip_globs(skip_dir_globs)
+        self.budget = _ByteBudget(opts.max_inflight_bytes,
+                                  opts.max_inflight_items)
+        # spool buffers share their own window (same size knob): total
+        # spool memory ≤ max_inflight_bytes + one overdraft layer
+        self.spool = _SpoolWindow(opts.max_inflight_bytes)
+        self._walk_pool = ThreadPoolExecutor(
+            opts.n_walkers(), thread_name_prefix="fanald-walk")
+        self._an_pool = ThreadPoolExecutor(
+            opts.n_analyzers(), thread_name_prefix="fanald-analyze")
+        # monotonic liveness signal for run()'s abandon rule: bumped
+        # on every resolved analyzer batch, so a layer legitimately
+        # draining many batches in _collect (its walk done, its
+        # future still unresolved) reads as progress, not a wedge
+        self._progress_lock = threading.Lock()
+        self._progress = 0
+
+    def _note_progress(self) -> None:
+        with self._progress_lock:
+            self._progress += 1
+
+    def _progress_mark(self) -> int:
+        with self._progress_lock:
+            return self._progress
+
+    def close(self) -> None:
+        # wait=False: a wedged walker (hang fault, stuck read) must
+        # not block the scan that already degraded around it
+        self._walk_pool.shutdown(wait=False)
+        self._an_pool.shutdown(wait=False)
+
+    # ---- orchestration -------------------------------------------------
+
+    def run(self, tasks: list[LayerTask]) -> dict[int, BlobScan]:
+        """→ {layer idx: BlobScan}. Never raises for per-layer
+        failures: every failure mode lands as an annotated partial.
+
+        The abandon rule is progress-aware: patience (`grace`, one
+        layer's watch deadline + margin — a LEGIT layer cannot run
+        longer, its cooperative deadline stops it first) resets on
+        every completed layer, so a deep image draining through a
+        small pool is never abandoned mid-drain; a full grace window
+        with ZERO completions means the whole walker pool is wedged —
+        every remaining layer is abandoned AT ONCE (queued ones cancel
+        clean), not serially one grace each."""
+        futs = []
+        for t in tasks:
+            # each walker inherits the caller's context (trace id,
+            # active span) on its own Context copy
+            ctx = contextvars.copy_context()
+            futs.append((t, self._walk_pool.submit(
+                ctx.run, self._walk_layer, t)))
+        grace = self.opts.watch_timeout_s() + self.opts.abandon_grace_s
+        by_fut = {fut: t for t, fut in futs}
+        out: dict[int, BlobScan] = {}
+        pending = set(by_fut)
+        last_progress = self._progress_mark()
+        while pending:
+            done, pending = _fut_wait(pending, timeout=grace,
+                                      return_when=FIRST_COMPLETED)
+            if not done:
+                cur = self._progress_mark()
+                if cur != last_progress:
+                    # no layer RESOLVED, but analyzer batches are
+                    # still landing — a layer draining its batches in
+                    # _collect is alive, not wedged
+                    last_progress = cur
+                    continue
+                for fut in pending:
+                    fut.cancel()
+                    t = by_fut[fut]
+                    out[t.idx] = self._partial(
+                        t, "walk", "wedged",
+                        f"walker pool made no progress for "
+                        f"{grace:.0f}s; layer abandoned")
+                pending = set()
+                break
+            for fut in done:
+                t = by_fut[fut]
+                try:
+                    out[t.idx] = fut.result()
+                except IngestIntegrityError:
+                    raise   # tampered content: never degrade or cache
+                except Exception as e:  # noqa: BLE001 — never a 500
+                    _log.exception("fanald: layer %d walk raised",
+                                   t.idx)
+                    out[t.idx] = self._partial(
+                        t, "walk", "internal",
+                        f"{type(e).__name__}: {e}")
+        # count partials HERE, once per scan actually returned — an
+        # abandoned wedged walker that finishes later must not
+        # double-count its layer
+        for t, _fut in futs:
+            if out[t.idx].partial:
+                INGEST.note("partial_scans")
+                METRICS.inc("trivy_tpu_ingest_partial_scans_total")
+        return out
+
+    def _partial(self, task: LayerTask, stage: str, kind: str,
+                 detail: str) -> BlobScan:
+        scan = BlobScan(result=AnalysisResult())
+        scan.errors.append(ingest_error(stage, kind, detail,
+                                        layer=task.idx))
+        scan.partial = True
+        return scan
+
+    # ---- walk stage ----------------------------------------------------
+
+    def _walk_layer(self, task: LayerTask) -> BlobScan:
+        opts = self.opts
+        scan = BlobScan(result=AnalysisResult())
+        br = INGEST.breaker("walk")
+        if not br.allow():
+            # open stage domain: degrade instantly instead of queueing
+            # a doomed walk behind the fault (half-open admits the
+            # probe walk through this same gate)
+            scan.errors.append(ingest_error(
+                "walk", "breaker_open",
+                "ingest walk breaker open; layer skipped",
+                layer=task.idx))
+        else:
+            INGEST.walker_busy(+1)
+            st = _LayerState()
+            deadline = Deadline(opts.layer_deadline_ms / 1e3)
+            try:
+                with span("fanal.layer_walk", layer=task.idx,
+                          diff_id=task.diff_id, pipelined=True) as sp:
+                    try:
+                        with GUARD.watch(
+                                WALK_SITE,
+                                timeout_s=opts.watch_timeout_s(),
+                                breaker=br) as tok:
+                            failpoint(WALK_SITE)
+                            self._stream_layer(task, scan, st,
+                                               deadline, tok)
+                    except DeviceTimeout:
+                        scan.errors.append(ingest_error(
+                            "walk", "timeout",
+                            "layer walk outlived the ingest watchdog "
+                            "deadline", layer=task.idx))
+                    except DeviceError as e:
+                        cause = e.__cause__ or e
+                        if isinstance(cause, IngestIntegrityError):
+                            # plain re-raise: `from` would clobber the
+                            # wrapped original the artifact surfaces
+                            raise cause
+                        scan.errors.append(ingest_error(
+                            "walk", "error",
+                            f"{type(cause).__name__}: {cause}",
+                            layer=task.idx))
+                    # the spooled chunk buffers died with the layer
+                    # stream — return their window charge BEFORE the
+                    # (potentially long) analyzer drain in _collect,
+                    # so peer walkers don't block on phantom bytes
+                    # (release is idempotent; the finally's call is a
+                    # no-op after this)
+                    self.spool.release(st)
+                    self._collect(task, scan, st)
+                    sp.attrs.update(partial=bool(scan.errors),
+                                    members=st.members,
+                                    read_bytes=st.layer_bytes)
+            finally:
+                INGEST.walker_busy(-1)
+                self.spool.release(st)
+            if st.integrity_error is not None:
+                # digest mismatch surfaced OUTSIDE the watch: it must
+                # propagate (tampered bytes never cache) WITHOUT
+                # charging the walk breaker — content integrity is the
+                # input's fault, not the stage's
+                raise st.integrity_error
+            # counted only when the layer actually streamed — a
+            # breaker-open skip must not read as walk throughput on
+            # /healthz exactly while the walk stage is dead
+            INGEST.note("layers_walked")
+        if scan.errors:
+            scan.partial = True
+        return scan
+
+    def _stream_layer(self, task: LayerTask, scan: BlobScan,
+                      st: _LayerState, deadline: Deadline, tok) -> None:
+        try:
+            cm = task.open_stream()
+        except Exception as e:  # noqa: BLE001 — contained as partial
+            scan.errors.append(ingest_error(
+                "walk", "open_error", f"{type(e).__name__}: {e}",
+                layer=task.idx))
+            return
+        try:
+            self._stream_layer_inner(task, scan, st, deadline, tok,
+                                     cm)
+        except IngestIntegrityError as e:
+            # caught HERE, inside the watch but before its exit, so a
+            # digest mismatch never charges the walk breaker —
+            # _walk_layer re-raises it after the watch closes
+            st.integrity_error = e
+
+    def _stream_layer_inner(self, task: LayerTask, scan: BlobScan,
+                            st: _LayerState, deadline: Deadline, tok,
+                            cm) -> None:
+        opts = self.opts
+        batch: list = []
+        batch_bytes = 0
+        with contextlib.ExitStack() as stack:
+            try:
+                ls = stack.enter_context(cm)
+            except _HOSTILE_OPEN_ERRORS as e:
+                scan.errors.append(ingest_error(
+                    "walk", "open_error", f"{type(e).__name__}: {e}",
+                    layer=task.idx))
+                return
+            ls.c_out.limit = opts.max_layer_bytes
+            ls.charge = lambda n: self.spool.charge(st, n, deadline)
+
+            def _trip_check():
+                if deadline.expired() or tok.expired:
+                    raise IngestBudgetTrip(
+                        "deadline", "layer deadline expired "
+                        "mid-stream (--ingest-layer-deadline-ms)")
+                if ls.c_in is not None \
+                        and ls.c_out.count > opts.ratio_floor \
+                        and ls.c_out.count > opts.max_ratio * \
+                        max(ls.c_in.count, 1):
+                    raise IngestBudgetTrip(
+                        "bomb",
+                        f"decompression ratio "
+                        f"{ls.c_out.count / max(ls.c_in.count, 1):.0f}"
+                        f" exceeds {opts.max_ratio:g} "
+                        f"(decompression-bomb guard)")
+
+            ls.c_out.trip = _trip_check
+            # the registry stream_open's post-walk digest drain
+            # (bounded_drain) binds to this layer's own budgets
+            ls.drain_deadline = deadline
+            ls.drain_limit = opts.max_layer_bytes
+            try:
+                for member in ls.spool():
+                    if tok.expired:
+                        # the watchdog already tripped; bail out so
+                        # the watch surfaces DeviceTimeout
+                        break
+                    if deadline.expired():
+                        raise IngestBudgetTrip(
+                            "deadline", "layer deadline expired "
+                            "(--ingest-layer-deadline-ms)")
+                    st.members += 1
+                    if st.members > opts.max_members:
+                        raise IngestBudgetTrip(
+                            "budget.members",
+                            f"layer exceeds {opts.max_members} "
+                            f"members (--ingest-max-members)")
+                    kind, path, wants3 = classify_member(
+                        member, self.group, self.collect_secrets,
+                        self.secret_config_path, self.skip_files,
+                        self.skip_dir_globs)
+                    if kind == "opaque":
+                        scan.opaque_dirs.append(path)
+                        continue
+                    if kind == "whiteout":
+                        scan.whiteout_files.append(path)
+                        continue
+                    if kind != "file":
+                        continue
+                    size = member.size
+                    if size > opts.max_file_bytes:
+                        scan.errors.append(ingest_error(
+                            "walk", "budget.file_bytes",
+                            f"{size} bytes exceeds "
+                            f"--ingest-max-file-bytes "
+                            f"({opts.max_file_bytes}); file skipped",
+                            layer=task.idx, path=path))
+                        self._note_trip("budget.file_bytes")
+                        continue
+                    if st.layer_bytes + size > opts.max_layer_bytes:
+                        raise IngestBudgetTrip(
+                            "budget.layer_bytes",
+                            f"layer content exceeds "
+                            f"{opts.max_layer_bytes} bytes "
+                            f"(--ingest-max-layer-bytes)")
+                    try:
+                        f = ls.tar.extractfile(member)
+                    except tarfile.StreamError:
+                        # hardlink target unreachable in stream-mode
+                        # sources (serial-walker parity): the target
+                        # analyzes under its own member
+                        continue
+                    except (KeyError, RecursionError):
+                        # hostile links: a target that never existed,
+                        # or a symlink/hardlink CYCLE (tarfile's
+                        # link-target resolution recurses forever on
+                        # those) — annotate, skip, keep walking
+                        scan.errors.append(ingest_error(
+                            "walk", "link_error",
+                            "unresolvable or cyclic link target",
+                            layer=task.idx, path=path))
+                        continue
+                    if f is None:
+                        continue
+                    if not self.budget.acquire(size, deadline):
+                        raise IngestBudgetTrip(
+                            "deadline",
+                            "backpressure wait exceeded the layer "
+                            "deadline (pipeline byte budget "
+                            "saturated)")
+                    try:
+                        content = f.read()
+                    except BaseException:
+                        self.budget.release(size)
+                        raise
+                    st.layer_bytes += len(content)
+                    wants, wants_post, wants_secret = wants3
+                    seq = st.seq
+                    st.seq += 1
+                    if wants_post:
+                        st.post[seq] = (path, content)
+                    if wants_secret and not looks_binary(content):
+                        st.secrets.append((seq, path, content))
+                    if wants:
+                        batch.append((seq, path, content, size))
+                        batch_bytes += size
+                        if len(batch) >= opts.batch_files or \
+                                batch_bytes >= opts.batch_bytes:
+                            self._submit_batch(task, st, batch)
+                            batch, batch_bytes = [], 0
+                    else:
+                        # retained-only (post/secret) or nothing: the
+                        # analysis window is over; retained bytes stay
+                        # bounded by the per-layer cap
+                        self.budget.release(size)
+            except IngestBudgetTrip as trip:
+                scan.errors.append(ingest_error(
+                    "walk", trip.kind, trip.detail, layer=task.idx))
+                self._note_trip(trip.kind)
+            except _HOSTILE_INPUT_ERRORS as e:
+                # hostile/corrupt INPUT (truncated gzip, lying member
+                # sizes, malformed headers): a deterministic partial,
+                # no breaker charge
+                scan.errors.append(ingest_error(
+                    "walk", "layer_error",
+                    f"{type(e).__name__}: {e}", layer=task.idx))
+            except _PoolClosed:
+                scan.errors.append(ingest_error(
+                    "walk", "cancelled",
+                    "pipeline shutting down; layer walk stopped",
+                    layer=task.idx))
+            finally:
+                if batch:
+                    try:
+                        self._submit_batch(task, st, batch)
+                    except _PoolClosed:
+                        scan.errors.append(ingest_error(
+                            "walk", "cancelled",
+                            "pipeline shutting down; final analyzer "
+                            "batch dropped", layer=task.idx))
+
+    def _note_trip(self, kind: str) -> None:
+        INGEST.note("budget_trips")
+        METRICS.inc("trivy_tpu_ingest_budget_trips_total", kind=kind)
+
+    # ---- analyze stage -------------------------------------------------
+
+    def _submit_batch(self, task: LayerTask, st: _LayerState,
+                      batch: list) -> None:
+        ctx = contextvars.copy_context()
+        items = list(batch)
+        # depth counts from SUBMIT (queued batches are backlog too —
+        # the gauge exists to surface an analyzer pool falling behind
+        # the walkers); _analyze_batch's finally takes it back down
+        METRICS.gauge_add("trivy_tpu_ingest_analyze_depth", 1.0)
+        try:
+            fut = self._an_pool.submit(ctx.run, self._analyze_batch,
+                                       task, items)
+        except RuntimeError as e:
+            # "cannot schedule new futures after shutdown": close()
+            # raced this walker. The batch will never run, so ITS
+            # finally can't release the byte budget — release here,
+            # and surface a no-charge cooperative stop
+            METRICS.gauge_add("trivy_tpu_ingest_analyze_depth", -1.0)
+            for _seq, _p, _c, sz in items:
+                self.budget.release(sz)
+            # the caller's loop resets `batch` only AFTER a successful
+            # submit; empty it here so its finally can't resubmit (and
+            # double-release) the items we just paid back
+            batch.clear()
+            raise _PoolClosed(str(e)) from e
+        except BaseException:
+            METRICS.gauge_add("trivy_tpu_ingest_analyze_depth", -1.0)
+            raise
+        st.pending.append((batch[0][0], fut, items))
+
+    def _analyze_batch(self, task: LayerTask, items: list):
+        """→ ({seq: AnalysisResult}, [ingest_error]). Runs on the
+        analyzer pool under the `analyze` fault domain; releases the
+        byte budget for every item whatever happens."""
+        br = INGEST.breaker("analyze")
+        results: dict = {}
+        errors: list = []
+        try:
+            if not br.allow():
+                errors.append(ingest_error(
+                    "analyze", "breaker_open",
+                    f"{len(items)} file(s) skipped: ingest analyze "
+                    f"breaker open", layer=task.idx))
+                return results, errors
+
+            def on_error(analyzer: str, path: str, exc: Exception):
+                errors.append(ingest_error(
+                    "analyze", "analyzer_error",
+                    f"{analyzer}: {type(exc).__name__}: {exc}",
+                    layer=task.idx, path=path))
+
+            try:
+                with GUARD.watch(ANALYZE_SITE,
+                                 timeout_s=self.opts.watch_timeout_s(),
+                                 breaker=br):
+                    failpoint(ANALYZE_SITE)
+                    rs = self.group.analyze_batch(
+                        [(p, c) for _seq, p, c, _sz in items],
+                        on_error=on_error)
+                for (seq, _p, _c, _sz), r in zip(items, rs):
+                    if r is not None:
+                        results[seq] = r
+            except DeviceTimeout:
+                errors.append(ingest_error(
+                    "analyze", "timeout",
+                    f"analyzer batch ({len(items)} files) outlived "
+                    f"the ingest watchdog deadline", layer=task.idx))
+            except DeviceError as e:
+                cause = e.__cause__ or e
+                errors.append(ingest_error(
+                    "analyze", "error",
+                    f"{type(cause).__name__}: {cause}",
+                    layer=task.idx))
+            return results, errors
+        finally:
+            METRICS.gauge_add("trivy_tpu_ingest_analyze_depth", -1.0)
+            for _seq, _p, _c, sz in items:
+                self.budget.release(sz)
+            self._note_progress()
+
+    # ---- layer finalize ------------------------------------------------
+
+    def _collect(self, task: LayerTask, scan: BlobScan,
+                 st: _LayerState) -> None:
+        """Merge the layer's analyzer batches back IN MEMBER ORDER —
+        batches resolve concurrently, but per-seq merging makes the
+        final BlobScan bit-identical to the serial walker's
+        member-order merge (AnalysisResult.merge is associative over
+        the per-file grouping analyze_batch preserves)."""
+        results_by_seq: dict = {}
+        batch_errs: list = []
+        grace = self.opts.watch_timeout_s() + self.opts.abandon_grace_s
+        # progress-aware wait, same rule as run(): patience resets on
+        # every resolved batch; a full grace window with zero progress
+        # means the analyzer pool is wedged — drop every unresolved
+        # batch at once, not serially one grace each
+        by_fut = {fut: (first_seq, items)
+                  for first_seq, fut, items in st.pending}
+        pending = set(by_fut)
+        while pending:
+            done, pending = _fut_wait(pending, timeout=grace,
+                                      return_when=FIRST_COMPLETED)
+            if not done:
+                for fut in pending:
+                    first_seq, items = by_fut[fut]
+                    if fut.cancel():
+                        # a cancelled batch never runs _analyze_batch,
+                        # so ITS finally can't release the byte budget
+                        # or the depth gauge — do it here; a RUNNING
+                        # wedged batch keeps its charge until it wakes
+                        # and releases itself
+                        METRICS.gauge_add(
+                            "trivy_tpu_ingest_analyze_depth", -1.0)
+                        for _seq, _p, _c, sz in items:
+                            self.budget.release(sz)
+                    batch_errs.append((first_seq, [ingest_error(
+                        "analyze", "wedged",
+                        f"analyzer pool made no progress for "
+                        f"{grace:.0f}s; batch dropped",
+                        layer=task.idx)]))
+                break
+            for fut in done:
+                first_seq, _items = by_fut[fut]
+                try:
+                    rs, errs = fut.result()
+                except Exception as e:  # noqa: BLE001 — not a 500
+                    rs, errs = {}, [ingest_error(
+                        "analyze", "internal",
+                        f"{type(e).__name__}: {e}", layer=task.idx)]
+                results_by_seq.update(rs)
+                if errs:
+                    batch_errs.append((first_seq, errs))
+        for seq in sorted(results_by_seq):
+            scan.result.merge(results_by_seq[seq])
+        for _first, errs in sorted(batch_errs, key=lambda t: t[0]):
+            scan.errors.extend(errs)
+        scan.post_files = {p: c for _seq, (p, c)
+                           in sorted(st.post.items())}
+        scan.secret_files = [(p, c) for _seq, p, c
+                             in sorted(st.secrets)]
+        try:
+            self.group.post_analyze(scan.post_files, scan.result)
+        except Exception as e:  # noqa: BLE001 — hostile post content
+            scan.errors.append(ingest_error(
+                "analyze", "post_analyze_error",
+                f"{type(e).__name__}: {e}", layer=task.idx))

@@ -58,12 +58,20 @@ def looks_binary(content: bytes) -> bool:
 
 @dataclass
 class BlobScan:
-    """Result of walking one blob (layer or filesystem snapshot)."""
+    """Result of walking one blob (layer or filesystem snapshot).
+
+    `errors`/`partial` are the fanald degradation surface: a layer
+    that exceeded an ingest budget, errored, or timed out carries
+    structured per-stage annotations (see pipeline.ingest_error) and
+    is marked partial — it is still a usable BlobScan, just an
+    incomplete one. The serial walker never sets either."""
     result: AnalysisResult
     whiteout_files: list = field(default_factory=list)
     opaque_dirs: list = field(default_factory=list)
     secret_files: list = field(default_factory=list)  # [(path, bytes)]
     post_files: dict = field(default_factory=dict)    # path -> bytes
+    errors: list = field(default_factory=list)        # [ingest_error dict]
+    partial: bool = False
 
 
 def _parent_dirs(path: str):
@@ -86,6 +94,41 @@ def walk_layer_tar(tf: tarfile.TarFile, group: AnalyzerGroup,
         return scan
 
 
+def classify_member(member, group: AnalyzerGroup, collect_secrets: bool,
+                    secret_config_path: str, skip_files: tuple,
+                    skip_dir_globs: tuple):
+    """One tar member's routing decision, shared verbatim by the
+    serial walker and the fanald pipeline (pipeline.py) so the two
+    paths cannot drift: → (kind, path, wants) where kind is one of
+    'skip' | 'opaque' | 'whiteout' | 'file', and wants (file only) is
+    the (analyze, post, secret) triple. Globs must already be
+    normalized (normalize_skip_globs)."""
+    path = _norm_rel(member.name)
+    if not path or path == ".":
+        return ("skip", "", None)
+    if skip_files and skip_match(path, skip_files):
+        return ("skip", path, None)
+    if skip_dir_globs and any(
+            skip_match(d, skip_dir_globs)
+            for d in _parent_dirs(path)):
+        return ("skip", path, None)
+    dirname, base = os.path.split(path)
+    if base == OPAQUE_MARKER:
+        return ("opaque", dirname, None)
+    if base.startswith(WH_PREFIX):
+        return ("whiteout",
+                os.path.join(dirname, base[len(WH_PREFIX):]), None)
+    if not (member.isfile() or member.islnk()):
+        return ("skip", path, None)
+    wants = group.required(path, member.size)
+    wants_post = group.post_required(path, member.size)
+    wants_secret = collect_secrets and secret_candidate(
+        path, member.size, secret_config_path)
+    if not (wants or wants_post or wants_secret):
+        return ("skip", path, None)
+    return ("file", path, (wants, wants_post, wants_secret))
+
+
 def _walk_layer_tar_impl(tf: tarfile.TarFile, group: AnalyzerGroup,
                          collect_secrets: bool,
                          secret_config_path: str,
@@ -98,32 +141,16 @@ def _walk_layer_tar_impl(tf: tarfile.TarFile, group: AnalyzerGroup,
     skip_dir_globs = normalize_skip_globs(skip_dir_globs)
     scan = BlobScan(result=AnalysisResult())
     for member in tf:
-        path = _norm_rel(member.name)
-        if path.startswith("/"):
-            path = path[1:]
-        if not path or path == ".":
+        kind, path, wants3 = classify_member(
+            member, group, collect_secrets, secret_config_path,
+            skip_files, skip_dir_globs)
+        if kind == "opaque":
+            scan.opaque_dirs.append(path)
             continue
-        if skip_files and skip_match(path, skip_files):
+        if kind == "whiteout":
+            scan.whiteout_files.append(path)
             continue
-        if skip_dir_globs and any(
-                skip_match(d, skip_dir_globs)
-                for d in _parent_dirs(path)):
-            continue
-        dirname, base = os.path.split(path)
-        if base == OPAQUE_MARKER:
-            scan.opaque_dirs.append(dirname)
-            continue
-        if base.startswith(WH_PREFIX):
-            scan.whiteout_files.append(os.path.join(dirname,
-                                                    base[len(WH_PREFIX):]))
-            continue
-        if not (member.isfile() or member.islnk()):
-            continue
-        wants = group.required(path, member.size)
-        wants_post = group.post_required(path, member.size)
-        wants_secret = collect_secrets and secret_candidate(
-            path, member.size, secret_config_path)
-        if not (wants or wants_post or wants_secret):
+        if kind != "file":
             continue
         try:
             f = tf.extractfile(member)
@@ -135,6 +162,7 @@ def _walk_layer_tar_impl(tf: tarfile.TarFile, group: AnalyzerGroup,
         if f is None:
             continue
         content = f.read()
+        wants, wants_post, wants_secret = wants3
         if wants:
             group.analyze_file(path, content, scan.result)
         if wants_post:
@@ -184,9 +212,29 @@ def _skip_re(glob: str):
 
 
 def _norm_rel(path: str) -> str:
-    """strip one leading './' exactly (lstrip would eat leading dots
-    of dot-prefixed names like .cache)."""
-    return path[2:] if path.startswith("./") else path
+    """Normalize a (possibly attacker-supplied) member name to a safe
+    relative path. Layer tars are hostile input: a member named
+    `../../etc/passwd` or `/etc/shadow` must never escape the walked
+    root nor confuse whiteout/opaque application in applier.py (a
+    `..`-carrying whiteout would delete paths OUTSIDE the shadowed
+    subtree from the squash stores). Rules:
+
+      - one leading './' stripped (never lstrip — that would eat the
+        leading dots of names like `.cache`), leading '/'s stripped
+        (absolute-style names are treated as archive-relative, the
+        tarfile convention);
+      - empty and '.' segments collapse (`a//b`, `a/./b` → `a/b`);
+      - ANY `..` segment rejects the whole name ('' → caller skips).
+    """
+    if path.startswith("./"):
+        path = path[2:]
+    path = path.lstrip("/")
+    if not path:
+        return ""
+    parts = [p for p in path.split("/") if p not in ("", ".")]
+    if not parts or ".." in parts:
+        return ""
+    return "/".join(parts)
 
 
 def walk_fs(root: str, group: AnalyzerGroup,
@@ -309,6 +357,10 @@ def blob_info(scan: BlobScan, diff_id: str = "",
         licenses=r.licenses,
         custom_resources=r.custom_resources,
         build_info=r.build_info,
+        # fanald degradation annotations ride the BlobInfo (and its
+        # JSON round-trip) so the report and the server can surface
+        # exactly which stage degraded this layer and why
+        ingest_errors=list(scan.errors),
     )
     from .handlers import post_handle
     post_handle(r, bi)

@@ -70,6 +70,15 @@ CONFIG_PATHS = {
     "replica_probe_interval_ms": "fleet.replica-probe-interval-ms",
     "replica_probe_timeout_ms": "fleet.replica-probe-timeout-ms",
     "route_retries": "fleet.route-retries",
+    # fanald (ingest.*): supervised streaming ingest budgets
+    "ingest_serial": "ingest.serial",
+    "ingest_walkers": "ingest.walkers",
+    "ingest_analyzers": "ingest.analyzers",
+    "ingest_max_file_bytes": "ingest.max-file-bytes",
+    "ingest_max_layer_bytes": "ingest.max-layer-bytes",
+    "ingest_max_members": "ingest.max-members",
+    "ingest_layer_deadline_ms": "ingest.layer-deadline-ms",
+    "ingest_max_inflight_bytes": "ingest.max-inflight-bytes",
 }
 
 _TRUE = {"1", "t", "true", "yes", "on"}

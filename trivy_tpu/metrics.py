@@ -332,6 +332,34 @@ METRICS.declare(
     "Flight-recorder incident snapshots written (reason=\"breaker_"
     "open\"/\"failpoint\"/\"manual\"; cooldown-limited, so a fault "
     "storm counts once per window).")
+METRICS.declare(
+    "trivy_tpu_ingest_breaker_state", "gauge",
+    "fanald per-stage ingest fault domain: 0 closed, 1 open, 2 "
+    "half-open (one series per stage, stage=\"walk\"/\"analyze\").")
+METRICS.declare(
+    "trivy_tpu_ingest_partial_scans_total", "counter",
+    "Layer walks the fanald pipeline degraded to an annotated "
+    "partial BlobScan (budget trip, hostile input, stage timeout, or "
+    "open ingest breaker) — partials cache only under salted ids, so "
+    "the next scan re-walks.")
+METRICS.declare(
+    "trivy_tpu_ingest_budget_trips_total", "counter",
+    "fanald ingest budgets tripped while a layer streamed "
+    "(kind=\"budget.file_bytes\"/\"budget.layer_bytes\"/"
+    "\"budget.members\"/\"deadline\"/\"bomb\").")
+METRICS.declare(
+    "trivy_tpu_ingest_inflight_bytes", "gauge",
+    "File content currently in the fanald analysis window (read but "
+    "not yet analyzed) — bounded by --ingest budgets via walker "
+    "backpressure.")
+METRICS.declare(
+    "trivy_tpu_ingest_walker_busy", "gauge",
+    "fanald layer walkers currently streaming a layer (walker-pool "
+    "occupancy).")
+METRICS.declare(
+    "trivy_tpu_ingest_analyze_depth", "gauge",
+    "fanald analyzer batches currently dispatched or queued on the "
+    "analyzer pool.")
 METRICS.declare("trivy_tpu_secret_files_total", "counter",
                 "Files through the secret scanner.")
 METRICS.declare("trivy_tpu_secret_bytes_total", "counter",

@@ -183,9 +183,15 @@ def check_storm_replay(doc: dict) -> list[str]:
                     continue
                 kind = ev.get("kind", "failpoint")
                 if kind not in ("failpoint", "kill_replica",
-                                "swap_table"):
+                                "swap_table", "hostile_layer"):
                     problems.append(
                         f"events[{i}]: unknown kind {kind!r}")
+                if kind == "hostile_layer" and \
+                        ev.get("variant") not in ("truncated",
+                                                  "bomb"):
+                    problems.append(
+                        f"events[{i}]: hostile_layer with unknown "
+                        f"variant {ev.get('variant')!r}")
                 if not isinstance(ev.get("at_ms"), (int, float)) \
                         or ev["at_ms"] < 0:
                     problems.append(

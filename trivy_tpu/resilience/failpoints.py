@@ -47,6 +47,8 @@ SITES = (
     "rpc.scan",           # server/listen.py Scan handler
     "rpc.route",          # fleet/router.py per-replica forward
     "db.download",        # db/download.py OCI artifact pull
+    "fanal.walk",         # fanal/pipeline.py per-layer walker stage
+    "fanal.analyze",      # fanal/pipeline.py analyzer-batch stage
 )
 
 # site FAMILIES: a family member is `<family>:<instance>` (e.g.

@@ -108,6 +108,24 @@ class BreakerRegistry:
                 self._breakers[key] = br
         return br
 
+    def configure(self, fail_threshold: int | None = None,
+                  reset_timeout_s: float | None = None) -> None:
+        """Re-tune the registry's defaults AND every already-created
+        breaker (chaos harnesses re-tune the shared process registries
+        between runs; new-only defaults would leave the lazily-created
+        domains on stale windows)."""
+        with self._lock:
+            if fail_threshold is not None:
+                self.fail_threshold = fail_threshold
+            if reset_timeout_s is not None:
+                self.reset_timeout_s = reset_timeout_s
+            breakers = list(self._breakers.values())
+        for br in breakers:
+            if fail_threshold is not None:
+                br.fail_threshold = fail_threshold
+            if reset_timeout_s is not None:
+                br.reset_timeout_s = reset_timeout_s
+
     def status(self) -> dict:
         with self._lock:
             breakers = dict(self._breakers)

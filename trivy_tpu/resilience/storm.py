@@ -59,7 +59,7 @@ from .failpoints import FAILPOINTS
 
 _log = _get_logger("resilience.storm")
 
-TOPOLOGIES = ("single", "mesh", "fleet")
+TOPOLOGIES = ("single", "mesh", "fleet", "ingest")
 REPLAY_SCHEMA = "trivy-tpu-storm-replay/1"
 
 # fault menu per topology: ONLY faults the resilience stack is designed
@@ -81,6 +81,16 @@ _FLEET_FAULTS = (
     ("rpc.route", "slow"), ("rpc.scan", "error"),
     ("rpc.scan", "flaky"),
 )
+# fanald ingest faults (ingest topology only): the pipeline absorbs
+# every one as an annotated partial result — plus the hostile_layer
+# event kind, which swaps the load to a corrupt/bomb artifact variant
+_INGEST_FAULTS = (
+    ("fanal.walk", "error"), ("fanal.walk", "hang"),
+    ("fanal.walk", "flaky"),
+    ("fanal.analyze", "error"), ("fanal.analyze", "hang"),
+    ("fanal.analyze", "flaky"),
+)
+HOSTILE_VARIANTS = ("truncated", "bomb")
 
 
 # ---------------------------------------------------------------------------
@@ -102,6 +112,10 @@ class StormEvent:
                     the same port at at_ms+dur_ms (fleet only).
       swap_table    trigger a DB hot swap through the generation drain
                     on replica `replica` (0 outside fleet).
+      hostile_layer (ingest only) scans issued in the window use the
+                    `variant` hostile artifact (truncated gzip layer
+                    or decompression bomb) instead of the clean one —
+                    the fanald containment drill.
     """
     at_ms: float
     kind: str = "failpoint"
@@ -111,11 +125,15 @@ class StormEvent:
     seed: int = 0
     dur_ms: float = 0.0
     replica: int = 0
+    variant: str = ""
 
     def label(self) -> str:
         if self.kind == "failpoint":
             arg = "" if self.mode == "error" else f":{self.arg:g}"
             return (f"{self.site}={self.mode}{arg}"
+                    f"@{self.at_ms:g}+{self.dur_ms:g}ms")
+        if self.kind == "hostile_layer":
+            return (f"hostile_layer({self.variant})"
                     f"@{self.at_ms:g}+{self.dur_ms:g}ms")
         return f"{self.kind}[{self.replica}]@{self.at_ms:g}ms"
 
@@ -158,12 +176,26 @@ def generate_schedule(seed: int, topology: str, n_events: int = 4,
     if topology == "fleet":
         menu += list(_FLEET_FAULTS)
         kinds += ["kill_replica"] * 2
+    if topology == "ingest":
+        # ingest drills the fanald pipeline: stage faults plus
+        # hostile-artifact windows; the device-side menu is replaced
+        # (the load is dominated by client-side walks, not joins)
+        menu = list(_INGEST_FAULTS) * 2 + [("rpc.scan", "slow")]
+        kinds = ["failpoint"] * 3 + ["hostile_layer"] * 2 + \
+            ["swap_table"]
     events: list[StormEvent] = []
     used_sites: set[str] = set()
     for _ in range(max(int(n_events), 1)):
         at = rng.uniform(0.0, horizon_ms * 0.6)
         dur = rng.uniform(horizon_ms * 0.25, horizon_ms * 0.6)
         kind = rng.choice(kinds)
+        if kind == "hostile_layer":
+            events.append(StormEvent(
+                at_ms=round(at, 1), kind="hostile_layer",
+                dur_ms=round(dur, 1),
+                variant=HOSTILE_VARIANTS[
+                    rng.randrange(len(HOSTILE_VARIANTS))]))
+            continue
         if kind == "kill_replica":
             events.append(StormEvent(
                 at_ms=round(at, 1), kind="kill_replica",
@@ -189,9 +221,14 @@ def generate_schedule(seed: int, topology: str, n_events: int = 4,
         used_sites.add(site)
         arg, spec_seed = 0.0, 0
         if mode == "hang":
-            # must outlive the watchdog deadline to be a hang at all
-            arg = round(rng.uniform(watchdog_ms * 2.2,
-                                    watchdog_ms * 4.0), 1)
+            # must outlive the watchdog deadline to be a hang at all.
+            # fanald sites watch with the (longer) ingest layer
+            # deadline + grace, so their hangs scale further out —
+            # the trip must be deterministic, never a near-miss
+            mult = (8.0, 12.0) if site.startswith("fanal.") \
+                else (2.2, 4.0)
+            arg = round(rng.uniform(watchdog_ms * mult[0],
+                                    watchdog_ms * mult[1]), 1)
         elif mode == "slow":
             arg = round(rng.uniform(5.0, 25.0), 1)
         elif mode == "flaky":
@@ -281,6 +318,10 @@ class Outcome:
     latency_ms: float = 0.0
     detail: str = ""
     well_formed: bool = True
+    # fanald: the response carried ingest degradation annotations
+    # (a deterministic partial result) — excluded from the oracle
+    # bit-identity probe, held to the annotation contract instead
+    partial: bool = False
 
     def key(self) -> tuple:
         return (self.idx, self.status, self.code, self.digest)
@@ -364,12 +405,25 @@ class _Topology:
 
     # the base URL scans go to (router for fleet, server otherwise)
     url: str = ""
+    # run_storm pre-pushes the seeded blob docs (PutBlob) when True;
+    # the ingest topology pushes per-request instead (its blobs come
+    # out of the fanald walk, not the seeded docs)
+    push_blobs: bool = True
 
     def metrics_urls(self) -> list[str]:
         return [self.url]
 
     def server_states(self) -> list:
         raise NotImplementedError
+
+    def do_request(self, idx: int, doc: dict,
+                   timeout: float) -> Outcome:
+        """Issue the idx-th load request. The default is one Scan RPC
+        over the pre-pushed blob; the ingest topology overrides with
+        the full client-side walk → PutBlob → Scan flow."""
+        o = _scan_once(self.url, doc, timeout)
+        o.idx = idx
+        return o
 
     def apply(self, ev: StormEvent) -> None:
         """Arm one schedule event against the live topology."""
@@ -381,6 +435,8 @@ class _Topology:
             self.swap(ev.replica)
         elif ev.kind == "kill_replica":
             self.kill(ev.replica)
+        elif ev.kind == "hostile_layer":
+            self.push_hostile(ev.variant)
 
     def revert(self, ev: StormEvent) -> None:
         """Disarm one event at the end of its window."""
@@ -390,6 +446,14 @@ class _Topology:
                 FAILPOINTS.clear(site)
         elif ev.kind == "kill_replica":
             self.restart(ev.replica)
+        elif ev.kind == "hostile_layer":
+            self.pop_hostile(ev.variant)
+
+    def push_hostile(self, variant: str) -> None:
+        pass
+
+    def pop_hostile(self, variant: str) -> None:
+        pass
 
     def resolve_site(self, site: str) -> str:
         """Map `detect.mesh:<slot>` to the runtime device id;
@@ -580,6 +644,170 @@ class FleetTopology(_Topology):
             self.kill(slot)
 
 
+class IngestTopology(SingleTopology):
+    """fanald containment drill: every load request runs the FULL
+    client-side archive flow — ImageArchiveArtifact through the
+    supervised pipeline (small budgets), blob push, Scan RPC — against
+    one in-process server. Schedule faults hit the pipeline's
+    `fanal.walk`/`fanal.analyze` sites; `hostile_layer` windows swap
+    the scanned artifact for a truncated-gzip or decompression-bomb
+    variant. The contract under drill: zero 5xx, every affected scan a
+    deterministic ANNOTATED partial, ingest breakers re-closed once
+    the faults clear."""
+
+    kind = "ingest"
+    push_blobs = False
+
+    def __init__(self, table, opts: StormOptions, load_seed: int = 0):
+        super().__init__(table, opts)
+        from ..fanal.pipeline import IngestOptions
+        w = opts.watchdog_ms
+        # budgets sized against the drill fixtures: the bomb variant
+        # (zeros expanding ~1000×) must trip the ratio guard, hang
+        # faults (≥ 8× watchdog by schedule construction) must outlive
+        # the walk watch (deadline + 50% grace)
+        self.ingest_opts = IngestOptions(
+            walkers=2, analyzers=2,
+            max_file_bytes=1 << 20, max_layer_bytes=1 << 20,
+            max_members=5000, layer_deadline_ms=w * 4.0,
+            max_inflight_bytes=4 << 20, max_ratio=50.0,
+            ratio_floor=64 << 10)
+        # LIFO of armed hostile windows: overlapping windows must not
+        # clobber each other (the earlier window's revert would
+        # otherwise clear a later, still-armed one). Mutated only by
+        # the single schedule-driver thread; workers read it.
+        self._hostile_stack: list = []
+        self._fixture_dir = tempfile.mkdtemp(prefix="storm-ingest-")
+        self._paths: dict = {}
+        from ..fanal.fixtures import gz_bytes, sha256_hex, tar_bytes
+        # the bomb layer is idx-independent; build its blob once
+        bomb_tar = tar_bytes({"filler/zeros.bin": b"\0" * (4 << 20)})
+        self._bomb = (gz_bytes(bomb_tar), sha256_hex(bomb_tar))
+        for i in range(opts.requests):
+            doc = request_doc(load_seed, i)
+            for variant in ("clean",) + HOSTILE_VARIANTS:
+                p = os.path.join(self._fixture_dir,
+                                 f"img-{i}-{variant}.tar")
+                build_ingest_archive(p, doc, variant, self._bomb)
+                self._paths[(i, variant)] = p
+
+    def push_hostile(self, variant: str) -> None:
+        self._hostile_stack.append(variant)
+
+    def pop_hostile(self, variant: str) -> None:
+        stack = list(self._hostile_stack)
+        if variant in stack:
+            stack.reverse()
+            stack.remove(variant)
+            stack.reverse()
+            self._hostile_stack = stack
+
+    def do_request(self, idx: int, doc: dict,
+                   timeout: float) -> Outcome:
+        from ..fanal.artifact import ImageArchiveArtifact
+        from ..fanal.cache import MemoryCache
+        stack = self._hostile_stack
+        variant = stack[-1] if stack else "clean"
+        path = self._paths.get((idx, variant)) \
+            or self._paths[(idx, "clean")]
+        cache = MemoryCache()
+        t0 = time.perf_counter()
+        try:
+            art = ImageArchiveArtifact(path, cache,
+                                       scanners=("vuln",),
+                                       ingest=self.ingest_opts)
+            ref = art.inspect()
+        except Exception as e:  # noqa: BLE001 — containment breach
+            return Outcome(idx, "lost",
+                           detail=f"ingest raised "
+                                  f"{type(e).__name__}: {e}"[:160])
+        partial = any((cache.blobs.get(b) or {}).get("IngestErrors")
+                      for b in ref.blob_ids)
+        try:
+            for b in ref.blob_ids:
+                code, _, body = _post(
+                    self.url, "/twirp/trivy.cache.v1.Cache/PutBlob",
+                    {"diff_id": b, "blob_info": cache.blobs[b]},
+                    timeout=timeout)
+                if code != 200:
+                    return _classify(idx, code, {}, body,
+                                     (time.perf_counter() - t0) * 1e3)
+            code, headers, body = _post(
+                self.url, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                {"target": f"ingest-{idx}", "artifact_id": ref.id,
+                 "blob_ids": ref.blob_ids,
+                 "options": {"scanners": ["vuln"]}},
+                timeout=timeout,
+                headers={"X-Trivy-Deadline-Ms":
+                         str(int(timeout * 1e3))})
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            return Outcome(idx, "lost",
+                           latency_ms=(time.perf_counter() - t0) * 1e3,
+                           detail=f"{type(e).__name__}: {e}"[:160])
+        o = _classify(idx, code, headers, body,
+                      (time.perf_counter() - t0) * 1e3)
+        o.idx = idx
+        o.partial = partial
+        if variant != "clean":
+            o.detail = (o.detail + f" variant={variant}").strip()
+            if o.status == "ok" and not o.partial:
+                # a hostile artifact MUST degrade annotated — a clean-
+                # looking result off a truncated/bomb layer means the
+                # containment silently under-reported
+                o.well_formed = False
+                o.detail = (f"hostile variant {variant} yielded no "
+                            f"ingest annotation")
+        return o
+
+    def settled(self) -> list[str]:
+        problems = super().settled()
+        from ..fanal.pipeline import INGEST
+        problems.extend(INGEST.settled())
+        return problems
+
+    def close(self) -> None:
+        super().close()
+        import shutil
+        shutil.rmtree(self._fixture_dir, ignore_errors=True)
+
+
+def build_ingest_archive(path: str, doc: dict, variant: str,
+                         bomb: tuple | None = None) -> None:
+    """Write one docker-save archive for the ingest drill (layout via
+    the shared `fanal.fixtures` builders): an alpine os-release layer,
+    an apk-db layer carrying the request doc's storm-pkg set, and a
+    padding layer. Variants:
+
+      clean      well-formed, 3 gzipped layers
+      truncated  the apk layer's gzip blob cut mid-stream (the walk
+                 hits EOFError → deterministic `layer_error` partial)
+      bomb       an extra layer of highly-compressible zeros that
+                 trips the decompression-ratio guard mid-stream
+    """
+    from ..fanal.fixtures import (gz_bytes, sha256_hex, tar_bytes,
+                                  write_docker_archive)
+    pkgs = doc["PackageInfos"][0]["Packages"]
+    blocks = [f"P:{p['Name']}\nV:{p['Version']}\nA:x86_64\n"
+              f"o:{p['Name']}\nL:MIT\n" for p in pkgs]
+    apk_db = ("\n".join(blocks) + "\n").encode()
+    os_release = (b'NAME="Alpine Linux"\nID=alpine\n'
+                  b'VERSION_ID=3.17.3\n')
+    layer_tars = [
+        tar_bytes({"etc/os-release": os_release}),
+        tar_bytes({"lib/apk/db/installed": apk_db}),
+        tar_bytes({"usr/share/doc/pad.txt": b"pad " * 256}),
+    ]
+    blobs = [gz_bytes(t) for t in layer_tars]
+    diff_ids = ["sha256:" + sha256_hex(t) for t in layer_tars]
+    if variant == "truncated":
+        blobs[1] = blobs[1][:max(len(blobs[1]) // 2, 20)]
+    elif variant == "bomb" and bomb is not None:
+        blobs.append(bomb[0])
+        diff_ids.append("sha256:" + bomb[1])
+    write_docker_archive(path, blobs, diff_ids,
+                         repo_tag=f"storm/ingest:{variant}")
+
+
 def build_topology(table, schedule: Schedule,
                    opts: StormOptions) -> _Topology:
     if schedule.topology == "single":
@@ -588,6 +816,10 @@ def build_topology(table, schedule: Schedule,
         return MeshTopology(table, opts)
     if schedule.topology == "fleet":
         return FleetTopology(table, opts)
+    if schedule.topology == "ingest":
+        return IngestTopology(table, opts,
+                              load_seed=opts.load_seed
+                              or schedule.seed)
     raise ValueError(f"unknown topology {schedule.topology!r}")
 
 
@@ -647,6 +879,10 @@ def _inv_lost(ctx: RunContext) -> list[str]:
         elif o.status == "shed" and not o.well_formed:
             out.append(f"request {o.idx}: malformed shed "
                        f"({o.code}: {o.detail})")
+        elif o.status == "ok" and not o.well_formed:
+            # ingest drill: a hostile artifact that produced a
+            # clean-looking 200 silently under-reported
+            out.append(f"request {o.idx}: {o.detail}")
     return out
 
 
@@ -654,7 +890,10 @@ def _inv_lost(ctx: RunContext) -> list[str]:
 def _inv_identity(ctx: RunContext) -> list[str]:
     out = []
     for o in ctx.outcomes:
-        if o.status != "ok":
+        if o.status != "ok" or o.partial:
+            # annotated partials are the fanald degradation contract,
+            # not drift — no_lost_requests holds them to annotation
+            # well-formedness instead
             continue
         want = ctx.oracle.get(o.idx)
         if want is not None and o.digest != want:
@@ -725,7 +964,7 @@ class _ScheduleDriver(threading.Thread):
         actions: list[tuple[float, int, StormEvent, str]] = []
         for n, ev in enumerate(schedule.events):
             actions.append((ev.at_ms, n, ev, "apply"))
-            if ev.kind == "kill_replica" or (
+            if ev.kind in ("kill_replica", "hostile_layer") or (
                     ev.kind == "failpoint" and ev.dur_ms > 0):
                 end = ev.at_ms + (ev.dur_ms or schedule.horizon_ms)
                 actions.append((end, n, ev, "revert"))
@@ -845,6 +1084,14 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
     GUARD.configure(dispatch_timeout_s=opts.watchdog_ms / 1e3,
                     fail_threshold=3,
                     reset_timeout_s=opts.breaker_reset_ms / 1e3)
+    # fanald ingest domains share the run's fast reset window (and are
+    # force-closed around the run like the backend breaker)
+    from ..fanal.pipeline import INGEST
+    saved_ingest = (INGEST.registry.fail_threshold,
+                    INGEST.registry.reset_timeout_s)
+    INGEST.configure(fail_threshold=3,
+                     reset_timeout_s=opts.breaker_reset_ms / 1e3)
+    INGEST.reset_for_tests()
     baseline_threads = _nondaemon_threads()
     shed0 = METRICS.get("trivy_tpu_requests_shed_total")
     events0 = len(RECORDER.events())
@@ -853,18 +1100,19 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
     topo = build_topology(table, schedule, opts)
     try:
         # blobs first (faults start with the load, not the setup)
-        for doc in docs:
-            code, _, body = _post(
-                topo.url, "/twirp/trivy.cache.v1.Cache/PutBlob",
-                {"diff_id": doc["DiffID"], "blob_info": doc},
-                timeout=opts.request_timeout_s)
-            if code != 200:
-                raise RuntimeError(f"storm setup: PutBlob → {code} "
-                                   f"{body}")
+        if topo.push_blobs:
+            for doc in docs:
+                code, _, body = _post(
+                    topo.url, "/twirp/trivy.cache.v1.Cache/PutBlob",
+                    {"diff_id": doc["DiffID"], "blob_info": doc},
+                    timeout=opts.request_timeout_s)
+                if code != 200:
+                    raise RuntimeError(f"storm setup: PutBlob → "
+                                       f"{code} {body}")
         if oracle is None:
             oracle = {}
             for i, doc in enumerate(docs):
-                o = _scan_once(topo.url, doc, opts.request_timeout_s)
+                o = topo.do_request(i, doc, opts.request_timeout_s)
                 if o.status != "ok":
                     raise RuntimeError(
                         f"storm oracle pass failed on request {i}: "
@@ -890,8 +1138,8 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
                 if delay > 0:
                     time.sleep(delay)
                 try:
-                    o = _scan_once(topo.url, docs[i],
-                                   opts.request_timeout_s)
+                    o = topo.do_request(i, docs[i],
+                                        opts.request_timeout_s)
                 except Exception as e:  # noqa: BLE001 — a surprise
                     # (e.g. a 200 with a truncated body) is exactly a
                     # lost request; the invariant engine must REPORT
@@ -921,7 +1169,7 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
         time.sleep(opts.breaker_reset_ms / 1e3)
         settle_problems = topo.settled()
         while settle_problems and time.monotonic() < settle_deadline:
-            _scan_once(topo.url, docs[0], opts.request_timeout_s)
+            topo.do_request(0, docs[0], opts.request_timeout_s)
             time.sleep(0.05)
             settle_problems = topo.settled()
 
@@ -942,6 +1190,9 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
                             fail_threshold=saved_guard[1],
                             reset_timeout_s=saved_guard[2])
             GUARD.breaker.reset()
+            INGEST.configure(fail_threshold=saved_ingest[0],
+                             reset_timeout_s=saved_ingest[1])
+            INGEST.reset_for_tests()
             RECORDER.configure(incident_dir=saved[0],
                                incident_cooldown_s=saved[1])
 

@@ -210,6 +210,19 @@ class LocalScanner:
                      ) -> tuple[list[T.Result], T.OS]:
         os_info = detail.os
 
+        # fanald partial-result degradation: a layer the ingest
+        # pipeline had to degrade (budget trip, hostile input, stage
+        # timeout) carries structured annotations — surface them as a
+        # dedicated result so the report says WHAT is missing and why
+        # instead of silently under-reporting (same contract /healthz
+        # exposes process-wide)
+        if detail.ingest_errors:
+            results.append(T.Result(
+                target="Ingest Degradations",
+                clazz=T.ResultClass.INGEST,
+                ingest_errors=list(detail.ingest_errors),
+            ))
+
         if T.Scanner.MISCONF in options.scanners or \
                 "config" in options.scanners:  # raw "config" kept for
             # callers bypassing cli.normalize_scanners (server RPC)

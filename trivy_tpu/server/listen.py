@@ -464,9 +464,16 @@ class Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
             else:
+                from ..fanal.pipeline import INGEST
                 resilience = {
                     **GUARD.status(),
                     "admission": self.state.admission.snapshot(),
+                    # fanald: per-stage ingest breaker states, partial-
+                    # scan and budget-trip counters — the degradation
+                    # contract's observable face (a scan that returned
+                    # an annotated partial shows up here, never as a
+                    # 5xx)
+                    "ingest": INGEST.status(),
                 }
                 # meshguard: per-device breaker states, lost set, and
                 # the shrink/grow rebuild counters

@@ -50,6 +50,7 @@ class ResultClass:
     LICENSE = "license"
     LICENSE_FILE = "license-file"
     CUSTOM = "custom"
+    INGEST = "ingest"  # fanald degradation annotations (partial scans)
 
 
 class ArtifactType:
@@ -349,7 +350,13 @@ class BlobInfo(JsonMixin):
     licenses: list = field(default_factory=list)
     custom_resources: list = field(default_factory=list)
     build_info: Optional[BuildInfo] = None
-    _json_names = {"diff_id": "DiffID", "os": "OS"}
+    # fanald (fanal/pipeline.py) per-stage degradation annotations; a
+    # non-empty list marks this blob a PARTIAL analysis (cached only
+    # under a salted partial id, surfaced in the report)
+    ingest_errors: list = field(default_factory=list)
+    _json_names = {"diff_id": "DiffID", "os": "OS",
+                   "ingest_errors": "IngestErrors"}
+    _json_raw = ("ingest_errors",)
 
 
 @dataclass
@@ -373,7 +380,11 @@ class ArtifactDetail(JsonMixin):
     secrets: list = field(default_factory=list)       # [Secret]
     licenses: list = field(default_factory=list)
     custom_resources: list = field(default_factory=list)
-    _json_names = {"os": "OS"}
+    # fanald annotations squashed across layers (applier.py) — the
+    # scanner surfaces them as one ResultClass.INGEST result
+    ingest_errors: list = field(default_factory=list)
+    _json_names = {"os": "OS", "ingest_errors": "IngestErrors"}
+    _json_raw = ("ingest_errors",)
 
 
 # --- db / vulnerability types (trivy-db pkg/types) ---
@@ -522,7 +533,10 @@ class Result(JsonMixin):
     secrets: list = field(default_factory=list)
     licenses: list = field(default_factory=list)
     custom_resources: list = field(default_factory=list)
-    _json_names = {"clazz": "Class"}
+    # fanald degradation annotations (ResultClass.INGEST results)
+    ingest_errors: list = field(default_factory=list)
+    _json_names = {"clazz": "Class", "ingest_errors": "IngestErrors"}
+    _json_raw = ("ingest_errors",)
     _keep_zero = ("target",)
 
     def is_empty(self) -> bool:
@@ -535,7 +549,7 @@ class Result(JsonMixin):
         return not (self.packages or self.vulnerabilities
                     or self.misconfigurations or self.secrets
                     or self.licenses or self.custom_resources
-                    or has_summary)
+                    or self.ingest_errors or has_summary)
 
 
 @dataclass
