@@ -148,9 +148,22 @@ def run_device(detector, images):
 
 def split_timings(detector, images):
     """Non-overlapped single-batch pass → (host_prep_s, device_s,
-    assemble_s, n_pairs)."""
+    assemble_s, assemble_compact_s, n_pairs, transfer_bytes).
+
+    Both assemble numbers keep the legacy timing boundary — they
+    INCLUDE the device→host fetch (BENCH_r04's assemble_ms was
+    device_get + host nonzero + assembly, and the fetch is exactly
+    what compaction shrinks, so excluding it would overstate nothing
+    but compare nothing): assemble_s is the dense path (full padded
+    bit vector fetched, host nonzero), assemble_compact_s the compact
+    path (O(hits) triple fetched, index lookups). transfer_bytes is
+    the actual device→host bytes this dispatch moved per path, read
+    back from the transfer counter so the overflow fallback is
+    visible."""
     import jax
-    import numpy as np
+    from trivy_tpu.detect.engine import _PendingCompact
+    from trivy_tpu.metrics import METRICS
+    from trivy_tpu.resilience.hostjoin import CompactBits
     qs = batches_of(images)[0]
     t0 = time.perf_counter()
     prep = detector._prepare(qs)
@@ -158,9 +171,35 @@ def split_timings(detector, images):
     out = detector._dispatch(prep)
     jax.block_until_ready(out)
     t2 = time.perf_counter()
-    detector._assemble(prep, jax.device_get(out))
-    t3 = time.perf_counter()
-    return t1 - t0, t2 - t1, t3 - t2, prep.n_pairs
+    b_compact = METRICS.get("trivy_tpu_detect_transfer_bytes_total",
+                            path="compact")
+    b_dense = METRICS.get("trivy_tpu_detect_transfer_bytes_total",
+                          path="dense")
+    bits = detector._fetch_bits(out)
+    transfer = {
+        "compact": METRICS.get("trivy_tpu_detect_transfer_bytes_total",
+                               path="compact") - b_compact,
+        "dense": METRICS.get("trivy_tpu_detect_transfer_bytes_total",
+                             path="dense") - b_dense,
+    }
+    if isinstance(bits, CompactBits):
+        detector._assemble(prep, bits)
+        asm_compact_s = time.perf_counter() - t2
+        # dense baseline over the same dispatch: fetch the dense bits
+        # retained on device (a real transfer, not a host rebuild) so
+        # the two numbers share the r04 boundary
+        t3 = time.perf_counter()
+        dense_bits = (jax.device_get(out.dense)
+                      if isinstance(out, _PendingCompact)
+                      else bits.dense())
+        detector._assemble(prep, dense_bits)
+        asm_s = time.perf_counter() - t3
+    else:
+        asm_compact_s = None
+        detector._assemble(prep, bits)
+        asm_s = time.perf_counter() - t2
+    return (t1 - t0, t2 - t1, asm_s, asm_compact_s, prep.n_pairs,
+            transfer)
 
 
 def run_numpy_cpu(table, detector, images):
@@ -916,7 +955,8 @@ def device_child_main():
     dev_hits = run_device(detector, images)
     dev_s = time.time() - t1
 
-    host_s, device_s, asm_s, n_pairs = split_timings(detector, images)
+    host_s, device_s, asm_s, asm_compact_s, n_pairs, transfer = \
+        split_timings(detector, images)
     # per-phase graftscope breakdown from an untimed subset pass:
     # recording arms the detect engine's device fence, which serializes
     # the dispatch/transfer overlap — never record during the TIMED
@@ -968,6 +1008,9 @@ def device_child_main():
         "host_prep_ms": host_s * 1e3,
         "device_ms": device_s * 1e3,
         "assemble_ms": asm_s * 1e3,
+        "assemble_ms_compact": None if asm_compact_s is None
+        else asm_compact_s * 1e3,
+        "transfer_bytes_per_dispatch": transfer,
         "n_pairs": int(n_pairs),
         "phase_ms": phase_ms,
         "secrets_device_mb_s": secrets_mbs,
@@ -1422,6 +1465,12 @@ def main():
             result["host_prep_ms"] = round(dev["host_prep_ms"], 1)
             result["device_ms"] = round(dev["device_ms"], 1)
             result["assemble_ms"] = round(dev["assemble_ms"], 1)
+            if dev.get("assemble_ms_compact") is not None:
+                result["assemble_ms_compact"] = round(
+                    dev["assemble_ms_compact"], 1)
+            if dev.get("transfer_bytes_per_dispatch"):
+                result["transfer_bytes_per_dispatch"] = \
+                    dev["transfer_bytes_per_dispatch"]
             result["n_pairs"] = dev["n_pairs"]
             if dev.get("phase_ms"):
                 result["phase_ms"] = dev["phase_ms"]

@@ -1,0 +1,448 @@
+"""Device-side hit compaction gate (tier-1): the compacted join path
+must be bit-identical — hit for hit, order included — to the dense
+path through every layer it crosses: the kernel + NumPy mirror, the
+engine pipeline, detectd's coalesced merged dispatches, the mesh's
+per-cell compaction, and the graftguard host fallback. Overflow
+boundaries (n_hits == capacity, capacity + 1) are first-class cases:
+the checked dense fallback is what makes compaction safe to ship.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from trivy_tpu.db.table import RawAdvisory, build_table
+from trivy_tpu.detect.engine import (
+    BatchDetector, PkgQuery, _PendingCompact, slice_bits,
+)
+from trivy_tpu.detect.sched import DispatchScheduler, SchedOptions
+from trivy_tpu.metrics import METRICS
+from trivy_tpu.ops import join as J
+from trivy_tpu.resilience import FAILPOINTS, GUARD
+from trivy_tpu.resilience.hostjoin import (
+    CompactBits, host_compact, host_csr_pair_join,
+    host_csr_pair_join_compact,
+)
+
+SOURCE = "alpine 3.17"
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard():
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+    yield
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def table():
+    """64 packages × 4 advisory rows each, all fixed at 5.0-r0: a
+    query at 1.0-r0 hits its whole bucket, 9.0-r0 misses it — hit
+    density is exactly the fraction of low-version queries."""
+    raw = [RawAdvisory(source=SOURCE, ecosystem="alpine",
+                       pkg_name=f"pkg{i:03d}",
+                       vuln_id=f"CVE-7-{i:03d}-{j}",
+                       fixed_version="5.0-r0")
+           for i in range(64) for j in range(4)]
+    t = build_table(raw)
+    assert len(t) == 64 * 4
+    return t
+
+
+def _queries(rng, n, hit_frac):
+    out = []
+    for k in range(n):
+        hit = rng.random() < hit_frac
+        out.append(PkgQuery(
+            source=SOURCE, ecosystem="alpine",
+            name=f"pkg{int(rng.integers(0, 64)):03d}",
+            version="1.0-r0" if hit else "9.0-r0", ref=k))
+    return out
+
+
+def _compact_detector(table, **kw):
+    """Detector with the hit floor/alignment shrunk so compaction
+    engages at this test scale (production floors are TPU lane-sized
+    and only engage past ~1k-pair dispatches)."""
+    kw.setdefault("hit_floor", 8)
+    kw.setdefault("hit_align", 8)
+    return BatchDetector(table, **kw)
+
+
+# ---------------------------------------------------------------------------
+# kernel ↔ NumPy mirror parity (the XCHK lock on resilience/hostjoin)
+
+
+class TestKernelMirrorParity:
+    def _prep(self, table, rng, n=400, hit_frac=0.1):
+        det = BatchDetector(table, compact=False)
+        try:
+            return det._prepare(_queries(rng, n, hit_frac)), \
+                det.ver_snapshot()
+        finally:
+            det.close()
+
+    @pytest.mark.parametrize("hit_frac", [0.0, 0.02, 0.5, 1.0])
+    def test_device_equals_mirror_across_densities(self, table,
+                                                   hit_frac):
+        rng = np.random.default_rng(41)
+        prep, ver = self._prep(table, rng, hit_frac=hit_frac)
+        t_pad = int(prep.pair_row.shape[0])
+        for h_cap in (8, 64, 256, t_pad):
+            dev = jax.device_get(J.csr_pair_join_compact(
+                table.lo_tok, table.hi_tok, table.flags, ver,
+                prep.q_start, prep.q_count, prep.q_ver,
+                np.int32(prep.n_pairs), t_pad, h_cap))
+            host = host_csr_pair_join_compact(
+                table.lo_tok, table.hi_tok, table.flags, ver,
+                prep.q_start, prep.q_count, prep.q_ver,
+                prep.n_pairs, t_pad, h_cap)
+            for got, want in zip(dev, host):
+                assert np.array_equal(np.asarray(got),
+                                      np.asarray(want))
+
+    def test_overflow_boundary_exact(self, table):
+        """n_hits == capacity keeps every hit; capacity+1 truncates to
+        the first h_cap — identically on device and mirror, and the
+        reported n_hits is the TRUE count either way."""
+        rng = np.random.default_rng(43)
+        prep, ver = self._prep(table, rng, hit_frac=0.3)
+        t_pad = int(prep.pair_row.shape[0])
+        dense = host_csr_pair_join(
+            table.lo_tok, table.hi_tok, table.flags, ver,
+            prep.q_start, prep.q_count, prep.q_ver, prep.n_pairs,
+            t_pad)
+        n_true = int((dense != 0).sum())
+        assert n_true > 2
+        for h_cap in (n_true, n_true - 1, n_true + 1):
+            dev = jax.device_get(J.csr_pair_join_compact(
+                table.lo_tok, table.hi_tok, table.flags, ver,
+                prep.q_start, prep.q_count, prep.q_ver,
+                np.int32(prep.n_pairs), t_pad, h_cap))
+            host = host_csr_pair_join_compact(
+                table.lo_tok, table.hi_tok, table.flags, ver,
+                prep.q_start, prep.q_count, prep.q_ver,
+                prep.n_pairs, t_pad, h_cap)
+            for got, want in zip(dev, host):
+                assert np.array_equal(np.asarray(got),
+                                      np.asarray(want))
+            assert int(dev[2]) == n_true
+            # within capacity, the triple reconstructs the dense bits
+            if h_cap >= n_true:
+                cb = CompactBits(np.asarray(dev[0])[:n_true],
+                                 np.asarray(dev[1])[:n_true], t_pad)
+                assert np.array_equal(cb.dense(), dense)
+
+    def test_host_compact_properties(self):
+        rng = np.random.default_rng(5)
+        bits = (rng.random(512) < 0.07).astype(np.int8) * 3
+        idx, vals, n = host_compact(bits, 64)
+        assert n == int((bits != 0).sum())
+        k = min(n, 64)
+        assert np.all(np.diff(idx[:k]) > 0)       # strictly ascending
+        assert np.all(vals[:k] != 0)
+        assert np.all(idx[k:] == 0) and np.all(vals[k:] == 0)
+
+
+# ---------------------------------------------------------------------------
+# CompactBits slice recovery (the detectd merged-dispatch contract)
+
+
+def test_slice_bits_matches_dense_slicing():
+    rng = np.random.default_rng(7)
+    dense = np.where(rng.random(2048) < 0.05,
+                     rng.integers(1, 4, 2048), 0).astype(np.int8)
+    keep = np.nonzero(dense)[0].astype(np.int32)
+    cb = CompactBits(keep, dense[keep], 2048)
+    offs = [0, 1, 100, 511, 2000]
+    for off in offs:
+        for n in (1, 17, 500, 2048 - off):
+            if off + n > 2048:   # windows never run past the dispatch
+                continue
+            got = slice_bits(cb, off, n)
+            assert isinstance(got, CompactBits)
+            assert np.array_equal(got.dense(), dense[off:off + n])
+            assert np.array_equal(slice_bits(dense, off, n),
+                                  dense[off:off + n])
+
+
+# ---------------------------------------------------------------------------
+# engine: compact ≡ dense, hit for hit, order included
+
+
+class TestEngineParity:
+    @pytest.mark.parametrize("hit_frac", [0.0, 0.01, 0.2, 1.0])
+    def test_density_sweep(self, table, hit_frac):
+        rng = np.random.default_rng(11)
+        batches = [_queries(rng, 600, hit_frac),
+                   _queries(rng, 40, hit_frac), []]
+        dense = BatchDetector(table, compact=False)
+        expected = dense.detect_many(batches)
+        dense.close()
+        det = _compact_detector(table)
+        b0 = METRICS.get("trivy_tpu_detect_transfer_bytes_total",
+                         path="compact")
+        got = det.detect_many(batches)
+        det.close()
+        assert got == expected
+        # the big batch must actually have taken the compact path
+        assert METRICS.get("trivy_tpu_detect_transfer_bytes_total",
+                           path="compact") > b0
+
+    def test_overflow_falls_back_dense_and_stays_identical(self, table):
+        """Hits past the buffer capacity: the dispatch re-fetches the
+        dense bits (counted on the dense path), occupancy lands >1.0,
+        and results don't change by a bit."""
+        rng = np.random.default_rng(13)
+        batches = [_queries(rng, 600, 0.9)]   # ~2160 hits
+        dense = BatchDetector(table, compact=False)
+        expected = dense.detect_many(batches)
+        dense.close()
+        det = _compact_detector(table)
+        t_pad = 4096   # 2400 pairs land on the 4096 rung
+        h_cap = det._hit_capacity(t_pad)
+        assert 0 < h_cap < 2000   # guaranteed overflow at 90% density
+        d0 = METRICS.get("trivy_tpu_detect_transfer_bytes_total",
+                         path="dense")
+        row0, _, cnt0 = METRICS.hist_get("trivy_tpu_detect_hit_occupancy")
+        got = det.detect_many(batches)
+        assert got == expected
+        assert METRICS.get("trivy_tpu_detect_transfer_bytes_total",
+                           path="dense") > d0
+        row1, _, cnt1 = METRICS.hist_get("trivy_tpu_detect_hit_occupancy")
+        assert cnt1 > cnt0
+        # the overflow observation lives above the 2.0 edge (+Inf)
+        assert row1[-1] > (row0[-1] if row0 else 0)
+        # the budget doubled for the next dispatch
+        assert det._hit_budget > 1.0 / 32
+        det.close()
+
+    def test_budget_adaptation_shrinks_on_sparse_streak(self, table):
+        det = _compact_detector(table)
+        det._note_hits(300, 128)            # overflow → double
+        assert det._hit_budget == 1.0 / 16
+        for _ in range(8):                  # 8 near-empty buffers
+            det._note_hits(1, 128)
+        assert det._hit_budget == 1.0 / 32  # halved once
+        det.close()
+
+    def test_prepared_carries_verification_columns(self, table):
+        rng = np.random.default_rng(17)
+        det = _compact_detector(table)
+        prep = det._prepare(_queries(rng, 50, 0.5))
+        assert prep.q_name is not None
+        assert [q.name for q, _ in prep.usable] == list(prep.q_name)
+        assert [q.source for q, _ in prep.usable] == list(prep.q_source)
+        assert [e for _, e in prep.usable] == list(prep.q_exact)
+        assert [q for q, _ in prep.usable] == list(prep.q_obj)
+        det.close()
+
+    def test_warmup_precompiles_hit_rungs(self, table):
+        det = _compact_detector(table)
+        det.warmup(1 << 12)
+        # every warmed pair rung big enough for compaction also warmed
+        # compact programs: the policy rung AND the next one up
+        compact_shapes = {(k[0], k[4]) for k in det._seen_shapes
+                          if k[4] > 0}
+        assert compact_shapes
+        budget = det._hit_budget
+        for t_pad, _ in compact_shapes:
+            caps = {c for c in (det._hit_capacity(t_pad, budget),
+                                det._hit_capacity(t_pad, budget * 2))
+                    if c}
+            assert caps <= {h for t, h in compact_shapes if t == t_pad}
+        det.close()
+
+    def test_merged_dispatch_slices_identical_to_solo(self, table):
+        """The coalescing primitive under compaction: each prep's
+        recovered slice of a merged dispatch equals its solo dispatch
+        result, bit for bit."""
+        rng = np.random.default_rng(19)
+        det = _compact_detector(table)
+        preps = [det._prepare(_queries(rng, 300, 0.05))
+                 for _ in range(4)]
+        preps = [p for p in preps if p is not None and p.n_pairs]
+        assert len(preps) >= 2
+        dev, offsets, t_pad = det.dispatch_merged(preps)
+        bits = det.fetch_merged(dev, preps, offsets, t_pad)
+        for p, off in zip(preps, offsets):
+            merged_slice = slice_bits(bits, off, p.n_pairs)
+            solo = det._fetch_bits(det._dispatch(p))
+            if isinstance(merged_slice, CompactBits):
+                merged_dense = merged_slice.dense()
+            else:
+                merged_dense = merged_slice[:p.n_pairs]
+            if isinstance(solo, CompactBits):
+                solo_dense = solo.dense()[:p.n_pairs]
+            else:
+                solo_dense = solo[:p.n_pairs]
+            assert np.array_equal(merged_dense[:p.n_pairs], solo_dense)
+        det.close()
+
+
+# ---------------------------------------------------------------------------
+# detectd: coalesced c=8 hammer over the compact path
+
+
+def test_sched_hammer_compact_equals_serial_dense(table):
+    rng = np.random.default_rng(23)
+    fracs = [0.0, 0.02, 0.1, 0.5, 0.9]
+    requests = [[_queries(rng, 300, fracs[i % len(fracs)]),
+                 _queries(rng, 30, 0.2)] for i in range(16)]
+    serial = BatchDetector(table, compact=False)
+    expected = [serial.detect_many(b) for b in requests]
+    serial.close()
+
+    det = _compact_detector(table)
+    sched = DispatchScheduler(det, SchedOptions(coalesce_wait_ms=5.0))
+    results: list = [None] * len(requests)
+    errors: list = []
+
+    def worker(ids):
+        try:
+            for i in ids:
+                results[i] = sched.detect_many(requests[i])
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(
+        target=worker, args=(range(k, len(requests), 8),))
+        for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sched.close()
+    det.close()
+    assert not errors
+    assert results == expected
+
+
+# ---------------------------------------------------------------------------
+# mesh: per-cell compaction + host concat
+
+
+class TestMeshParity:
+    @pytest.mark.parametrize("hit_frac", [0.0, 0.05, 0.9])
+    def test_mesh_equals_dense_engine(self, table, hit_frac):
+        from trivy_tpu.parallel.mesh import MeshDetector, make_mesh
+        rng = np.random.default_rng(29)
+        batches = [_queries(rng, 800, hit_frac)]
+        dense = BatchDetector(table, compact=False)
+        expected = dense.detect_many(batches)
+        dense.close()
+        det = MeshDetector(table, make_mesh(4, db_shards=2),
+                           db_shards=2, hit_floor=8, hit_align=8)
+        got = det.detect_many(batches)
+        det.close()
+        assert got == expected
+
+    def test_mesh_coalesced_through_scheduler(self, table):
+        from trivy_tpu.parallel.mesh import MeshDetector, make_mesh
+        rng = np.random.default_rng(31)
+        requests = [[_queries(rng, 400, 0.1)] for _ in range(6)]
+        serial = BatchDetector(table, compact=False)
+        expected = [serial.detect_many(b) for b in requests]
+        serial.close()
+        det = MeshDetector(table, make_mesh(4, db_shards=2),
+                           db_shards=2, hit_floor=8, hit_align=8)
+        sched = DispatchScheduler(det,
+                                  SchedOptions(coalesce_wait_ms=5.0))
+        got = [sched.detect_many(b) for b in requests]
+        sched.close()
+        det.close()
+        assert got == expected
+
+
+# ---------------------------------------------------------------------------
+# graftguard: host fallback emits the same compacted results
+
+
+class TestHostFallbackParity:
+    def test_open_breaker_compact_identical(self, table):
+        rng = np.random.default_rng(37)
+        batches = [_queries(rng, 500, 0.05)]
+        dense = BatchDetector(table, compact=False)
+        expected = dense.detect_many(batches)
+        dense.close()
+        GUARD.breaker.trip()
+        f0 = METRICS.get("trivy_tpu_fallback_joins_total")
+        det = _compact_detector(table)
+        got = det.detect_many(batches)
+        det.close()
+        assert got == expected
+        assert METRICS.get("trivy_tpu_fallback_joins_total") > f0
+
+    def test_open_breaker_compact_overflow_identical(self, table):
+        """The mirror's overflow rule matches the device policy: past
+        capacity the host fallback serves the dense vector."""
+        rng = np.random.default_rng(38)
+        batches = [_queries(rng, 500, 0.95)]
+        dense = BatchDetector(table, compact=False)
+        expected = dense.detect_many(batches)
+        dense.close()
+        GUARD.breaker.trip()
+        det = _compact_detector(table)
+        got = det.detect_many(batches)
+        det.close()
+        assert got == expected
+
+    def test_fetch_failure_falls_back_identical(self, table):
+        """detect.device_get error mid-compact-fetch: the per-prep
+        host rebuild serves dense bits and results do not change."""
+        rng = np.random.default_rng(39)
+        batches = [_queries(rng, 500, 0.05)]
+        dense = BatchDetector(table, compact=False)
+        expected = dense.detect_many(batches)
+        dense.close()
+        FAILPOINTS.set("detect.device_get", "error")
+        det = _compact_detector(table)
+        got = det.detect_many(batches)
+        det.close()
+        assert got == expected
+
+
+# ---------------------------------------------------------------------------
+# metrics: new series render under the strict exposition parser
+
+
+def test_transfer_and_occupancy_series_strictly_well_formed(table):
+    from tests.helpers import parse_exposition
+    rng = np.random.default_rng(47)
+    det = _compact_detector(table)
+    det.detect_many([_queries(rng, 600, 0.02)])   # compact
+    det.detect_many([_queries(rng, 600, 0.95)])   # overflow → dense
+    det.close()
+    families = parse_exposition(METRICS.render())
+    transfer = families["trivy_tpu_detect_transfer_bytes_total"]
+    paths = {labels.get("path") for _, labels, _ in transfer["samples"]}
+    assert {"compact", "dense"} <= paths
+    occ = families["trivy_tpu_detect_hit_occupancy"]
+    assert occ["type"] == "histogram"
+    assert any(v > 0 for _, _, v in occ["samples"])
+
+
+# ---------------------------------------------------------------------------
+# dispatch representation sanity
+
+
+def test_compact_dispatch_returns_pending_handle(table):
+    rng = np.random.default_rng(53)
+    det = _compact_detector(table)
+    prep = det._prepare(_queries(rng, 600, 0.02))
+    out = det._dispatch(prep)
+    assert isinstance(out, _PendingCompact)
+    assert out.h_cap == det._hit_capacity(int(prep.pair_row.shape[0]))
+    bits = det._fetch_bits(out)
+    assert isinstance(bits, CompactBits)
+    # hit indices are ascending, nonzero-valued, in range
+    assert np.all(np.diff(bits.pair_idx) > 0)
+    assert np.all(bits.bits != 0)
+    assert bits.pair_idx.size == 0 or \
+        int(bits.pair_idx[-1]) < prep.n_pairs
+    det.close()

@@ -586,6 +586,37 @@ def test_golden_jaxpr_diff_detected(tmp_path, monkeypatch):
     assert fs[0].line == 6
 
 
+def test_compact_contract_budget_catches_unroll():
+    c = _contract("csr_pair_join_compact.json")
+    c["max_primitives"] = 10
+    c.pop("golden", None)
+    fs = jaxpr_check.check_contract("csr_pair_join_compact.json", c)
+    assert [f.rule for f in fs] == ["JAX204"]
+
+
+def test_compact_contract_forbidden_primitive_sees_epilogue():
+    """The no-sort ban must actually see the compaction epilogue's
+    primitives: forbidding cumsum (which the epilogue's prefix scan
+    lowers to) proves a sort would be caught the same way."""
+    c = _contract("csr_pair_join_compact.json")
+    c["forbidden_primitives"] = ["cumsum"]
+    c.pop("golden", None)
+    fs = jaxpr_check.check_contract("csr_pair_join_compact.json", c)
+    assert fs and {f.rule for f in fs} == {"JAX203"}
+    assert any("cumsum" in f.message for f in fs)
+
+
+def test_compact_contract_convert_allowlist_enforced():
+    c = _contract("csr_pair_join_compact.json")
+    c["allowed_converts"] = [["bool", "int8"], ["int32", "int32"]]
+    c.pop("golden", None)
+    fs = jaxpr_check.check_contract("csr_pair_join_compact.json", c)
+    # the epilogue's mask widening (bool→int32 for the prefix scan)
+    # is no longer allowlisted
+    assert fs and {f.rule for f in fs} == {"JAX202"}
+    assert any("bool→int32" in f.message for f in fs)
+
+
 def test_iter_eqns_sees_inside_cond_branches():
     """The host-callback ban must see through lax.cond: its sub-jaxprs
     live in a tuple param ('branches'), not a bare ClosedJaxpr."""
@@ -608,7 +639,8 @@ def test_iter_eqns_sees_inside_cond_branches():
 def test_golden_snapshots_are_current():
     """The checked-in pretty-printed jaxprs match the live lowering —
     a hot-path change must regenerate them (and show up in review)."""
-    for name in ("csr_pair_join.json", "prefilter_pallas.json"):
+    for name in ("csr_pair_join.json", "csr_pair_join_compact.json",
+                 "prefilter_pallas.json"):
         c = _contract(name)
         closed = jaxpr_check.trace_contract(c)
         text = jaxpr_check.normalize_jaxpr_text(str(closed))

@@ -155,7 +155,6 @@ class TestAcceptance:
         rpc.route flakes and a dispatch hang at c=8 — failovers absorb
         everything, the restarted replica is readmitted, and every
         invariant probe passes."""
-        fail0 = METRICS.get("trivy_tpu_fleet_failovers_total")
         sched = Schedule(seed=103, topology="fleet",
                          horizon_ms=1200.0, events=[
                              StormEvent(at_ms=50.0,
@@ -169,10 +168,19 @@ class TestAcceptance:
                                         mode="hang", arg=150.0,
                                         dur_ms=300.0),
                          ])
-        report = run_storm(sched, StormOptions(
-            requests=20, concurrency=8, replicas=2), table=table)
-        assert report.ok, report.violations
-        assert METRICS.get("trivy_tpu_fleet_failovers_total") > fail0
+        # the failover-count observation is wall-clock coupled: under
+        # heavy suite load the paced requests can slip entirely past
+        # the kill window, so allow one re-run for THAT side-assert —
+        # the invariant verdict must hold on every attempt
+        for attempt in range(2):
+            fail0 = METRICS.get("trivy_tpu_fleet_failovers_total")
+            report = run_storm(sched, StormOptions(
+                requests=20, concurrency=8, replicas=2), table=table)
+            assert report.ok, report.violations
+            if METRICS.get("trivy_tpu_fleet_failovers_total") > fail0:
+                break
+        else:
+            raise AssertionError("no failover observed in 2 drills")
 
     def test_generated_schedule_smoke(self, table):
         """A generator-sampled schedule (fixed seed) passes end to end

@@ -37,9 +37,54 @@ from ..ops import bucket_ladder, bucket_size
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
 from ..resilience import GUARD, DeviceError, failpoint
-from ..resilience.hostjoin import host_csr_pair_join, host_pair_join
+from ..resilience.hostjoin import (
+    CompactBits, host_csr_pair_join, host_csr_pair_join_compact,
+    host_pair_join,
+)
 
 _log = _get_logger("detect")
+
+# hit-budget bounds for the compaction epilogue: the fraction of a
+# dispatch's padded pairs the hit buffer is sized for, adapted by
+# powers of two from observed occupancy so the (pair-rung × hit-rung)
+# shape set stays bounded (every distinct pair is one XLA compile).
+# Budgets ≥ 1/8 are the dense regime (a 5-byte hit slot can't beat the
+# 1-byte dense fetch past t_pad/8); MAX sits one doubling above it as
+# hysteresis, and the dense-streak recovery in _hit_capacity walks the
+# budget back down so a transient hit-dense burst can't disable
+# compaction for the rest of the process
+_HIT_BUDGET_INIT = 1.0 / 32
+_HIT_BUDGET_MIN = 1.0 / 1024
+_HIT_BUDGET_MAX = 0.25
+# consecutive <25%-full hit buffers before the budget halves — one
+# quiet dispatch must not shrink the buffer under bursty hit rates —
+# and, symmetrically, consecutive budget-disabled dense dispatches
+# before a halving retries compaction
+_HIT_LOW_STREAK = 8
+
+
+class _PendingCompact(NamedTuple):
+    """One in-flight compacted dispatch: device refs for the O(hits)
+    hit buffers plus the dense bits, which stay ON DEVICE and are
+    fetched only when n_hits overflowed the buffer (the checked
+    fallback that keeps results bit-identical by construction)."""
+    hit_idx: Any
+    hit_bits: Any
+    n_hits: Any
+    dense: Any
+    h_cap: int
+    t_pad: int
+
+
+def slice_bits(bits, off: int, n: int):
+    """One request's [off, off+n) window of a merged dispatch result:
+    dense ndarray bits slice directly; compacted bits recover the
+    window with one searchsorted over the sorted hit indices
+    (CompactBits.slice) — still bit-identical to serial by
+    construction, detectd's merged-dispatch contract."""
+    if isinstance(bits, CompactBits):
+        return bits.slice(off, n)
+    return bits[off:off + n]
 
 
 
@@ -88,19 +133,38 @@ class _Prepared:
     # rows beyond are zero-count padding — a coalesced dispatch
     # (dispatch_merged) concatenates only the real prefixes, because an
     # interior zero count would shift every later CSR segment
+    # per-prep verification columns, built ONCE here: _assemble used to
+    # rebuild these object arrays from `usable` on every call —
+    # including merged-dispatch re-assembles of the same prep
+    q_name: np.ndarray = None    # object[len(usable)] join names
+    q_source: np.ndarray = None  # object[len(usable)] advisory sources
+    q_exact: np.ndarray = None   # bool[len(usable)] exact-version keys
+    q_obj: np.ndarray = None     # object[len(usable)] the PkgQuery objs
 
 
 class BatchDetector:
     def __init__(self, table: AdvisoryTable, pair_floor: int = 256,
                  pair_growth: float = 2.0,
                  max_pairs_in_flight: int = 1 << 22,
-                 assemble_workers: int = 2):
+                 assemble_workers: int = 2, compact: bool = True,
+                 hit_floor: int = 128, hit_align: int = 128):
         import threading
         self.table = table
         self.pair_floor = pair_floor
         # geometric bucket ladder for padded dispatch shapes; 2.0 with
         # a pow2 floor reproduces the legacy next_pow2 policy exactly
         self.pair_growth = pair_growth
+        # device-side hit compaction: dispatches big enough for the
+        # hit buffer to beat the dense fetch ship only (pair_idx,
+        # bits) hit pairs + a count back to the host (O(hits), not
+        # O(padded pairs)); the buffer capacity is a bucket-ladder
+        # rung of t_pad × _hit_budget
+        self.compact = compact
+        self.hit_floor = hit_floor
+        self.hit_align = hit_align      # TPU lane width; tests shrink it
+        self._hit_budget = _HIT_BUDGET_INIT
+        self._hit_low_streak = 0
+        self._hit_dense_streak = 0
         # pipeline backpressure: detect_many stops issuing dispatches
         # once this many padded pairs are in flight (bounds device
         # memory and keeps one giant scan from starving coalescing)
@@ -297,10 +361,20 @@ class BatchDetector:
         assert counts_nz.min() > 0
         q_ver = np.zeros(q_pad, np.int32)
         q_ver[:nz.size] = ver_arr[nz]
+        # verification columns, built once per prep (not per assemble:
+        # a coalesced dispatch re-assembles the same prep under load)
+        q_name = np.array([q.name for q, _ in usable], dtype=object)
+        q_source = np.array([q.source for q, _ in usable], dtype=object)
+        q_exact = np.fromiter((e for _, e in usable), bool,
+                              count=len(usable))
+        q_obj = np.empty(len(usable), dtype=object)
+        q_obj[:] = [q for q, _ in usable]
         return _Prepared(usable, pair_q, row_p, ver_p, n_pairs,
                          _next_pow2(self._ver_count),
                          q_start=q_start, q_count=q_count, q_ver=q_ver,
-                         n_queries=int(nz.size))
+                         n_queries=int(nz.size),
+                         q_name=q_name, q_source=q_source,
+                         q_exact=q_exact, q_obj=q_obj)
 
     def _dispatch(self, prep: _Prepared):
         """Instrumented shell around _dispatch_impl: spans the (async)
@@ -311,13 +385,17 @@ class BatchDetector:
         note_dispatch()
         return out
 
-    def _note_shape(self, t_pad: int, q_pad: int, u_rows: int) -> bool:
+    def _note_shape(self, t_pad: int, q_pad: int, u_rows: int,
+                    h_cap: int = 0) -> bool:
         """Compile accounting: a (t_pad, q_pad, ver-pool rows, table
-        size) key this process has not dispatched before is a new XLA
-        program. → whether the shape is new (the detect.compile
-        failpoint keys off it). Runs BEFORE the launch — the compile
-        happens whether or not the dispatch then fails."""
-        key = (t_pad, q_pad, u_rows, len(self.table))
+        size, hit capacity) key this process has not dispatched before
+        is a new XLA program — the hit-buffer rung is a static shape
+        too, so a compact dispatch whose capacity rung moved counts as
+        a fresh compile (h_cap=0 is the dense program). → whether the
+        shape is new (the detect.compile failpoint keys off it). Runs
+        BEFORE the launch — the compile happens whether or not the
+        dispatch then fails."""
+        key = (t_pad, q_pad, u_rows, len(self.table), h_cap)
         with self._lock:
             new_shape = key not in self._seen_shapes
             if new_shape:
@@ -325,6 +403,75 @@ class BatchDetector:
         if new_shape:
             METRICS.inc("trivy_tpu_detect_compiles_total")
         return new_shape
+
+    def _hit_capacity(self, t_pad: int,
+                      budget: float | None = None) -> int:
+        """Hit-buffer rung for a t_pad-pair dispatch: the bucket-ladder
+        rung covering t_pad × hit-budget (lane-aligned, floored).
+        Returns 0 — dispatch dense — when compaction is off or the
+        buffer could not beat the dense fetch anyway (a hit slot costs
+        5 bytes vs 1 for a dense pair, so past t_pad/8 the compact
+        transfer stops winning; small dispatches stay dense).
+
+        Dense-regime recovery: _note_hits only fires on COMPACT
+        fetches, so a budget pushed into the dense regime by an
+        overflow burst would otherwise stay there forever (no compact
+        dispatch ever observes the sparse occupancy that halves it).
+        When the budget — not the dispatch geometry — is what keeps a
+        dispatch dense, a streak counter walks the budget back down
+        after _HIT_LOW_STREAK dense dispatches, so compaction is
+        retried once the burst passes (at worst one overflow per
+        streak window while the workload is genuinely hit-dense)."""
+        if not self.compact:
+            return 0
+        adapt = budget is None
+        if adapt:
+            with self._lock:
+                budget = self._hit_budget
+        cap = bucket_size(max(int(t_pad * budget), self.hit_floor),
+                          self.hit_floor, self.pair_growth,
+                          align=self.hit_align)
+        if cap * 8 < t_pad:
+            if adapt:
+                with self._lock:
+                    self._hit_dense_streak = 0
+            return cap
+        # dense at this budget; count toward recovery only when a
+        # smaller budget COULD engage at this t_pad (the floor rung
+        # wins), i.e. the budget is the reason, not the geometry
+        floor_cap = bucket_size(self.hit_floor, self.hit_floor,
+                                self.pair_growth, align=self.hit_align)
+        if adapt and budget > _HIT_BUDGET_MIN and floor_cap * 8 < t_pad:
+            with self._lock:
+                self._hit_dense_streak += 1
+                if self._hit_dense_streak >= _HIT_LOW_STREAK:
+                    self._hit_budget = max(self._hit_budget / 2,
+                                           _HIT_BUDGET_MIN)
+                    self._hit_dense_streak = 0
+        return 0
+
+    def _note_hits(self, n_hits: int, h_cap: int) -> None:
+        """Adapt the hit budget from observed buffer occupancy, in
+        powers of two so the compiled shape set stays bounded: an
+        overflow (the dispatch fell back to the dense fetch) doubles
+        it immediately; a sustained streak of <25%-full buffers halves
+        it. Every compacted dispatch lands one occupancy observation —
+        the overflow-fallback rate is the histogram's >1.0 mass."""
+        METRICS.observe("trivy_tpu_detect_hit_occupancy",
+                        n_hits / h_cap)
+        with self._lock:
+            if n_hits > h_cap:
+                self._hit_budget = min(self._hit_budget * 2,
+                                       _HIT_BUDGET_MAX)
+                self._hit_low_streak = 0
+            elif n_hits * 4 <= h_cap:
+                self._hit_low_streak += 1
+                if self._hit_low_streak >= _HIT_LOW_STREAK:
+                    self._hit_budget = max(self._hit_budget / 2,
+                                           _HIT_BUDGET_MIN)
+                    self._hit_low_streak = 0
+            else:
+                self._hit_low_streak = 0
 
     def _account_traffic(self, n_pairs: int, t_pad: int,
                          warm: bool = False) -> None:
@@ -345,13 +492,17 @@ class BatchDetector:
 
     def _host_join_csr(self, q_start: np.ndarray, q_count: np.ndarray,
                        q_ver: np.ndarray, total: int,
-                       t_pad: int) -> np.ndarray:
+                       t_pad: int, h_cap: int = 0):
         """Host fallback for a CSR launch: the NumPy reference join
-        over the same descriptors (graftguard degraded mode). Returns
-        the int8[t_pad] bit vector a device fetch would have — callers
-        downstream (device_get, _assemble, the scheduler's slicing)
-        cannot tell the difference, and the bits are identical by the
-        hostjoin contract."""
+        over the same descriptors (graftguard degraded mode). With
+        compaction off (h_cap=0) returns the int8[t_pad] bit vector a
+        dense fetch would have; with it on, the NumPy compaction
+        mirror emits the same CompactBits a compacted fetch would —
+        either way callers downstream (_fetch_bits pass-through,
+        _assemble, the scheduler's slice recovery) cannot tell the
+        difference, and the bits are identical by the hostjoin
+        contract. The overflow rule mirrors the device path exactly:
+        n_hits past capacity serves the dense vector."""
         METRICS.inc("trivy_tpu_fallback_joins_total")
         SLO.observe_join(False)
         # the fallback join is a first-class trace phase (graftwatch):
@@ -361,6 +512,15 @@ class BatchDetector:
         with span("detect.host_join", n_pairs=total, t_pad=t_pad):
             ver = self.ver_snapshot()
             t = self.table
+            if h_cap:
+                hit_idx, hit_bits, n_hits, bits = \
+                    host_csr_pair_join_compact(
+                        t.lo_tok, t.hi_tok, t.flags, ver, q_start,
+                        q_count, q_ver, total, t_pad, h_cap)
+                if n_hits <= h_cap:
+                    return CompactBits(hit_idx[:n_hits],
+                                       hit_bits[:n_hits], t_pad)
+                return bits
             return host_csr_pair_join(t.lo_tok, t.hi_tok, t.flags,
                                       ver, q_start, q_count, q_ver,
                                       total, t_pad)
@@ -389,8 +549,14 @@ class BatchDetector:
 
     def _launch(self, q_start: np.ndarray, q_count: np.ndarray,
                 q_ver: np.ndarray, total: int, t_pad: int, u_pad: int,
-                warm: bool = False):
+                warm: bool = False, h_cap: int | None = None):
         """Ship CSR descriptors and launch the join (async).
+
+        Compaction: when the hit-capacity policy engages (h_cap > 0),
+        the compact kernel runs instead and the return value is a
+        _PendingCompact — device refs for the O(hits) hit buffers plus
+        the dense bits the overflow path fetches. Callers resolve
+        either shape through _fetch_bits.
 
         graftguard supervision: with the breaker open the device is
         never touched — the NumPy host join runs instead and its bits
@@ -399,9 +565,11 @@ class BatchDetector:
         watchdog deadline; a backend error or deadline expiry counts
         against the breaker and THIS launch falls back to the host, so
         the request completes either way with identical bits."""
+        if h_cap is None:
+            h_cap = self._hit_capacity(t_pad)
         if not GUARD.allow_device():
             return self._host_join_csr(q_start, q_count, q_ver, total,
-                                       t_pad)
+                                       t_pad, h_cap)
         import jax
         try:
             # the table/version-pool uploads live INSIDE the watch: on
@@ -415,15 +583,21 @@ class BatchDetector:
                 adv_lo, adv_hi, adv_flags = self.table.device_arrays()
                 ver_dev = self._ver_device(u_pad)
                 if self._note_shape(t_pad, int(q_start.shape[0]),
-                                    int(ver_dev.shape[0])):
+                                    int(ver_dev.shape[0]), h_cap):
                     failpoint("detect.compile")
                 failpoint("detect.dispatch")
-                out = J.csr_pair_join(
-                    adv_lo, adv_hi, adv_flags, ver_dev,
-                    jax.device_put(q_start),
-                    jax.device_put(q_count),
-                    jax.device_put(q_ver),
-                    np.int32(total), t_pad)
+                args = (adv_lo, adv_hi, adv_flags, ver_dev,
+                        jax.device_put(q_start),
+                        jax.device_put(q_count),
+                        jax.device_put(q_ver),
+                        np.int32(total))
+                if h_cap:
+                    hit_idx, hit_bits, n_hits, dense = \
+                        J.csr_pair_join_compact(*args, t_pad, h_cap)
+                    out = _PendingCompact(hit_idx, hit_bits, n_hits,
+                                          dense, h_cap, t_pad)
+                else:
+                    out = J.csr_pair_join(*args, t_pad)
                 self._account_traffic(total, t_pad, warm=warm)
                 return out
         except DeviceError:
@@ -434,21 +608,48 @@ class BatchDetector:
             _log.warning("device launch failed; host-fallback join",
                          exc_info=True)
             return self._host_join_csr(q_start, q_count, q_ver, total,
-                                       t_pad)
+                                       t_pad, h_cap)
 
     # ---- supervised result fetch (graftguard) -------------------------
 
-    def _fetch_bits(self, dev) -> np.ndarray:
+    def _fetch_bits(self, dev):
         """Device→host fetch under watchdog supervision. Host-fallback
-        results (plain ndarrays from _host_join_csr) pass through
-        without touching the device or the failpoints. Raises
-        DeviceError/DeviceTimeout on a failed or wedged fetch."""
-        if isinstance(dev, np.ndarray):
+        results (ndarrays / CompactBits from _host_join_csr) pass
+        through without touching the device or the failpoints. A
+        _PendingCompact fetches only the O(hits) hit buffers; the
+        checked overflow path (n_hits > capacity) additionally fetches
+        the dense bits retained on device, so results stay
+        bit-identical by construction. Raises DeviceError/
+        DeviceTimeout on a failed or wedged fetch."""
+        if isinstance(dev, (np.ndarray, CompactBits)):
             return dev
         import jax
+        if isinstance(dev, _PendingCompact):
+            with GUARD.watch("detect.device_get"):
+                failpoint("detect.device_get")
+                hit_idx, hit_bits, n_hits = jax.device_get(
+                    (dev.hit_idx, dev.hit_bits, dev.n_hits))
+            n = int(n_hits)
+            self._note_hits(n, dev.h_cap)
+            METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
+                        float(hit_idx.nbytes + hit_bits.nbytes
+                              + n_hits.nbytes), path="compact")
+            if n > dev.h_cap:
+                # overflow: the buffer holds only a prefix of the
+                # hits — this dispatch pays the dense fetch instead
+                # (the budget already doubled for the next one)
+                with GUARD.watch("detect.device_get"):
+                    bits = jax.device_get(dev.dense)
+                METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
+                            float(bits.nbytes), path="dense")
+                return bits
+            return CompactBits(hit_idx[:n], hit_bits[:n], dev.t_pad)
         with GUARD.watch("detect.device_get"):
             failpoint("detect.device_get")
-            return jax.device_get(dev)
+            out = jax.device_get(dev)
+        METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
+                    float(out.nbytes), path="dense")
+        return out
 
     def _fetch_or_fallback(self, prep: _Prepared, dev) -> np.ndarray:
         """Fetch one prep's bits; on a supervised failure recompute
@@ -559,15 +760,23 @@ class BatchDetector:
         """Pre-compile the join across the pair-bucket ladder (server
         --detect-warmup): one empty dispatch per rung, so steady-state
         traffic reuses cached XLA programs instead of paying a compile
-        on the first batch of each new size. Bounds — not eliminates —
-        recompiles: the version pool's growth and query-count buckets
-        can still introduce new shapes. Returns the rung count."""
+        on the first batch of each new size. With compaction on, each
+        pair rung also pre-compiles its (pair-rung × hit-rung) compact
+        programs: the policy capacity at the current budget, plus the
+        rungs one budget-doubling up AND one halving down — the first
+        shapes an occupancy adaptation in either direction (overflow,
+        or the sparse-streak halving real-image traffic hits) would
+        otherwise pay a first-request compile for. Bounds — not eliminates — recompiles: the version
+        pool's growth and query-count buckets can still introduce new
+        shapes. Returns the rung count."""
         if len(self.table) == 0:
             return 0
         import jax
         rungs = bucket_ladder(max_pairs, self.pair_floor,
                               self.pair_growth)
         u_pad = _next_pow2(max(self._ver_count, 1))
+        with self._lock:
+            budget = self._hit_budget
         done = []
         for t_pad in rungs:
             # representative query bucket: real workloads average a few
@@ -576,8 +785,17 @@ class BatchDetector:
             q_pad = bucket_size(max(t_pad // 8, 1), 64,
                                 self.pair_growth, align=64)
             z = np.zeros(q_pad, np.int32)
+            # policy h_cap (or dense when compaction can't win here)
             done.append(self._launch(z, z, z, 0, t_pad, u_pad,
                                      warm=True))
+            here = self._hit_capacity(t_pad, budget=budget)
+            warmed = {here}
+            for adapted in (budget * 2, budget / 2):
+                nxt = self._hit_capacity(t_pad, budget=adapted)
+                if nxt and nxt not in warmed:
+                    warmed.add(nxt)
+                    done.append(self._launch(z, z, z, 0, t_pad, u_pad,
+                                             warm=True, h_cap=nxt))
         jax.block_until_ready(done)
         return len(rungs)
 
@@ -752,15 +970,23 @@ class BatchDetector:
             return hits
 
     def _assemble_impl(self, prep: _Prepared,
-                       bits: np.ndarray) -> list[Hit]:
+                       bits) -> list[Hit]:
         t = self.table
-        bits = bits[:prep.n_pairs]
-        keep = np.nonzero(bits)[0]
+        if isinstance(bits, CompactBits):
+            # compacted result: the hit indices ARE the keep set —
+            # assembly is direct index lookups into the prep's pair
+            # expansion, with no dense materialization and no host
+            # nonzero (the r04 assemble hot spot)
+            keep = bits.pair_idx
+            b = bits.bits
+        else:
+            bits = bits[:prep.n_pairs]
+            keep = np.nonzero(bits)[0]
+            b = bits[keep]
         if keep.size == 0:
             return []
         rows = prep.pair_row[keep]
         qidx = prep.pair_q[keep]
-        b = bits[keep]
         gids = t.group[rows]
         flags = t.flags[rows]
         sat = (b & J.SATISFIED) != 0
@@ -795,13 +1021,12 @@ class BatchDetector:
         # (arch/CPE) or inexact pairs take the slow per-item path.
         # On dense workloads (~45k reported groups per 256-image batch)
         # this is the difference between the assembly dominating the
-        # device time and not.
+        # device time and not. The per-prep columns were built once in
+        # _prepare — a merged dispatch re-assembles the same prep.
         g_name, g_source, g_scoped = self._group_arrays()
-        q_name = np.array([q.name for q, _ in prep.usable], dtype=object)
-        q_source = np.array([q.source for q, _ in prep.usable],
-                            dtype=object)
-        q_exact = np.fromiter((e for _, e in prep.usable), bool,
-                              count=len(prep.usable))
+        q_name = prep.q_name
+        q_source = prep.q_source
+        q_exact = prep.q_exact
 
         ok = (g_name[gid_of] == q_name[pkg_of]) & \
             (g_source[gid_of] == q_source[pkg_of])
@@ -817,8 +1042,7 @@ class BatchDetector:
         from itertools import repeat
         g_vuln, g_fix, g_status, g_sev, g_ds, g_vids = \
             self._group_cols()
-        q_obj = np.empty(len(usable), dtype=object)
-        q_obj[:] = [q for q, _ in usable]
+        q_obj = prep.q_obj
         fsel = np.nonzero(fast)[0]
         gsel = gid_of[fsel]
         psel = pkg_of[fsel]

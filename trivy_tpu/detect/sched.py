@@ -52,7 +52,7 @@ from dataclasses import dataclass
 
 from ..metrics import METRICS
 from ..obs import current_trace_id, span
-from .engine import BatchDetector, Hit, PkgQuery
+from .engine import BatchDetector, Hit, PkgQuery, slice_bits
 
 
 @dataclass
@@ -363,7 +363,10 @@ class DispatchScheduler:
             for req, _, _ in items:
                 req.fail(e)
             return
-        # hand each request its contiguous slice; the waiting handler
-        # thread assembles it (DispatchScheduler.detect_many)
+        # hand each request its contiguous slice (dense) or recover it
+        # from the compacted hit indices with one searchsorted
+        # (slice_bits); the waiting handler thread assembles it
+        # (DispatchScheduler.detect_many)
         for (req, slot, prep), off in zip(items, offsets):
-            req.complete(slot, (prep, bits[off:off + prep.n_pairs]))
+            req.complete(slot,
+                         (prep, slice_bits(bits, off, prep.n_pairs)))

@@ -252,6 +252,18 @@ METRICS.declare(
     "gathered a round.",
     buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
 METRICS.declare(
+    "trivy_tpu_detect_transfer_bytes_total", "counter",
+    "Join result bytes fetched device→host, by result path "
+    "(path=\"compact\" O(hits) hit buffers, path=\"dense\" full "
+    "padded bit vectors; an overflow fallback counts its wasted "
+    "compact fetch AND the dense one).")
+METRICS.declare(
+    "trivy_tpu_detect_hit_occupancy", "histogram",
+    "Hits per compacted dispatch ÷ hit-buffer capacity (mass above "
+    "1.0 is the overflow-fallback rate — those dispatches re-fetched "
+    "the dense bits).",
+    buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0, 2.0))
+METRICS.declare(
     "trivy_tpu_detect_compiles_total", "counter",
     "Distinct join dispatch shapes seen by this process — each one "
     "is an XLA compilation (the bucket ladder and --detect-warmup "

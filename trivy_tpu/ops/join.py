@@ -119,3 +119,53 @@ def _csr_core(adv_lo_tok, adv_hi_tok, adv_flags, ver_tok,
 
 
 csr_pair_join = jax.jit(_csr_core, static_argnums=(8,))
+
+
+def _compact_core(bits, h_cap: int):
+    """Compaction epilogue: squeeze the nonzero entries of a dense
+    int8[T] report vector into a lane-aligned hit buffer.
+
+    Real-image buckets are overwhelmingly misses, so the dense vector
+    is an O(padded pairs) transfer for an O(hits) answer. An exclusive
+    prefix-scan over the nonzero mask assigns every hit its output
+    slot, and one scatter emits (pair index, bits) pairs — the same
+    compact-before-verify move ATVHunter/LibAM make on candidate-match
+    sets. Misses scatter to slot h_cap, which is out of range and
+    dropped; hits beyond capacity land nowhere either (their slots are
+    ≥ h_cap), so an overflowing dispatch still yields a valid PREFIX
+    of the hit list plus an n_hits count the host checks against
+    capacity before trusting the buffer. No sort, no host callback —
+    cumsum and scatter are the cheap primitives on TPU.
+
+    bits:  int8[T] report bits (0 = miss)
+    h_cap: static hit-buffer capacity
+
+    Returns (hit_idx int32[h_cap] ascending, hit_bits int8[h_cap],
+    n_hits int32[] — the TRUE hit count, which may exceed h_cap).
+    """
+    t_pad = bits.shape[0]
+    mask = bits != 0
+    m32 = mask.astype(jnp.int32)
+    csum = jnp.cumsum(m32)
+    n_hits = csum[-1]
+    pos = csum - m32                       # exclusive scan: slot per hit
+    dest = jnp.where(mask, pos, h_cap)     # misses land out of range
+    idx = jnp.arange(t_pad, dtype=jnp.int32)
+    hit_idx = jnp.zeros(h_cap, jnp.int32).at[dest].set(idx, mode="drop")
+    hit_bits = jnp.zeros(h_cap, jnp.int8).at[dest].set(bits, mode="drop")
+    return hit_idx, hit_bits, n_hits
+
+
+def _csr_compact_core(adv_lo_tok, adv_hi_tok, adv_flags, ver_tok,
+                      q_start, q_count, q_ver, total, t_pad: int,
+                      h_cap: int):
+    """csr_pair_join with the compaction epilogue fused in: the dense
+    bits stay ON DEVICE (returned last, fetched only when the hit
+    buffer overflowed) and the host fetches the O(hits) triple."""
+    bits = _csr_core(adv_lo_tok, adv_hi_tok, adv_flags, ver_tok,
+                     q_start, q_count, q_ver, total, t_pad)
+    hit_idx, hit_bits, n_hits = _compact_core(bits, h_cap)
+    return hit_idx, hit_bits, n_hits, bits
+
+
+csr_pair_join_compact = jax.jit(_csr_compact_core, static_argnums=(8, 9))

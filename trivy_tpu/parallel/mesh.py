@@ -40,6 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..db.table import AdvisoryTable
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
+from ..resilience.hostjoin import CompactBits
 
 try:  # jax ≥ 0.8 exports shard_map at top level
     from jax import shard_map
@@ -184,12 +185,18 @@ class MeshDetector:
     """
 
     def __init__(self, table: AdvisoryTable, mesh: Mesh | None,
-                 db_shards: int | None = None, guard=None):
+                 db_shards: int | None = None, guard=None,
+                 compact: bool = True, hit_floor: int = 128,
+                 hit_align: int = 128):
         from ..detect.engine import BatchDetector
         self.mesh = mesh
         self.table = table
         self.guard = guard
-        self._inner = BatchDetector(table)
+        # compaction knobs ride the inner engine: its hit-capacity
+        # policy sizes the PER-CELL hit buffers here too
+        self._inner = BatchDetector(table, compact=compact,
+                                    hit_floor=hit_floor,
+                                    hit_align=hit_align)
         if mesh is None:
             # host-only degraded mode (meshguard: survivors below
             # --mesh-min-devices): no shard, no upload, no device ids
@@ -338,11 +345,20 @@ class MeshDetector:
                 # join actually completed
                 t_total = int(part.t_loc) * int(part.valid.shape[0]) \
                     * int(part.valid.shape[1])
+                # per-CELL hit buffers, sized by the inner engine's
+                # hit-capacity policy over the cell pair capacity (the
+                # hit rung is part of the compiled shape)
+                h_loc = inner._hit_capacity(part.t_loc)
                 inner._note_shape(t_total,
                                   int(part.q_start.shape[-1]),
-                                  int(ver_dev.shape[0]))
-                bits = sharded_csr_join(self.mesh, self._st_dev,
-                                        ver_dev, part, total)
+                                  int(ver_dev.shape[0]), h_loc)
+                if h_loc:
+                    bits, max_cell_hits = sharded_csr_join_compact(
+                        self.mesh, self._st_dev, ver_dev, part,
+                        total, h_loc)
+                else:
+                    bits = sharded_csr_join(self.mesh, self._st_dev,
+                                            ver_dev, part, total)
                 inner._account_traffic(total, t_total)
         except DeviceError:
             _get_logger("mesh").warning(
@@ -357,6 +373,15 @@ class MeshDetector:
             if self.guard is not None:
                 self.guard.request_attribution()
             return host_fallback()
+        if h_loc:
+            # adapt the shared hit budget on the WORST cell — overflow
+            # is per-cell, so the fullest buffer decides the next rung
+            inner._note_hits(max_cell_hits, h_loc)
+        if isinstance(bits, CompactBits):
+            # hits already in global pair order; extend the logical
+            # dense length to the padded dispatch size downstream
+            # slicing expects
+            return CompactBits(bits.pair_idx, bits.bits, t_pad)
         out = np.zeros(t_pad, np.int8)
         out[:total] = bits
         return out
@@ -539,13 +564,101 @@ def sharded_csr_join(mesh: Mesh, st, ver_tok, part: QueryPartition,
     """CSR variant of sharded_pair_join: ships [DP, S, Q_loc]
     descriptors, devices expand pairs locally. → int8[n_pairs] bits in
     the caller's original pair order."""
+    from ..metrics import METRICS
     bits = jax.device_get(_sharded_csr_join(
         mesh, jnp.asarray(st.lo_tok), jnp.asarray(st.hi_tok),
         jnp.asarray(st.flags), jnp.asarray(ver_tok),
         jax.device_put(part.q_start), jax.device_put(part.q_count),
         jax.device_put(part.q_ver), jax.device_put(part.total),
         part.t_loc))
+    METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
+                float(bits.nbytes), path="dense")
     out = np.zeros(n_pairs, np.int8)
     v = part.valid
     out[part.perm[v]] = bits[v]
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "t_pad", "h_cap"))
+def _sharded_csr_join_compact(mesh: Mesh, adv_lo, adv_hi, adv_flags,
+                              ver_tok, qs, qc, qv, total, t_pad: int,
+                              h_cap: int):
+    def local(adv_lo, adv_hi, adv_flags, ver_tok, qs, qc, qv, total):
+        if hasattr(jax.lax, "pcast"):
+            ver_tok = jax.lax.pcast(ver_tok, ("dp", "db"), to="varying")
+        bits = J._csr_core(adv_lo[0], adv_hi[0], adv_flags[0], ver_tok,
+                           qs[0, 0], qc[0, 0], qv[0, 0], total[0, 0],
+                           t_pad)
+        # per-cell compaction epilogue: each device emits only ITS
+        # hits; the dense cell bits stay on device for the checked
+        # overflow fetch
+        hit_idx, hit_bits, n_hits = J._compact_core(bits, h_cap)
+        return (hit_idx[None, None], hit_bits[None, None],
+                n_hits[None, None], bits[None, None])
+
+    f = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("db"), P("db"), P("db"), P(),
+                  P("dp", "db"), P("dp", "db"), P("dp", "db"),
+                  P("dp", "db")),
+        out_specs=(P("dp", "db"), P("dp", "db"), P("dp", "db"),
+                   P("dp", "db")),
+    )
+    return f(adv_lo, adv_hi, adv_flags, ver_tok, qs, qc, qv, total)
+
+
+def sharded_csr_join_compact(mesh: Mesh, st, ver_tok,
+                             part: QueryPartition, n_pairs: int,
+                             h_cap: int):
+    """Compact variant of sharded_csr_join: each mesh cell emits only
+    its (local hit position, bits) list plus a count — the
+    device→host transfer is O(cells × hit capacity), not O(cells ×
+    t_loc). The host maps cell-local hit positions through part.perm
+    to global pair indices and concatenates the shard hit lists into
+    one CompactBits in ascending pair order. Any cell overflowing its
+    buffer falls back to the dense fetch for the WHOLE dispatch (the
+    cell bits stayed on device), so results are bit-identical by
+    construction either way.
+
+    → (CompactBits | dense int8[n_pairs], max per-cell hit count)."""
+    from ..metrics import METRICS
+    out = _sharded_csr_join_compact(
+        mesh, jnp.asarray(st.lo_tok), jnp.asarray(st.hi_tok),
+        jnp.asarray(st.flags), jnp.asarray(ver_tok),
+        jax.device_put(part.q_start), jax.device_put(part.q_count),
+        jax.device_put(part.q_ver), jax.device_put(part.total),
+        part.t_loc, h_cap)
+    hit_idx, hit_bits, n_hits = jax.device_get(out[:3])
+    METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
+                float(hit_idx.nbytes + hit_bits.nbytes + n_hits.nbytes),
+                path="compact")
+    max_hits = int(n_hits.max(initial=0))
+    if max_hits > h_cap:
+        bits = jax.device_get(out[3])
+        METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
+                    float(bits.nbytes), path="dense")
+        dense = np.zeros(n_pairs, np.int8)
+        v = part.valid
+        dense[part.perm[v]] = bits[v]
+        return dense, max_hits
+    gidx: list = []
+    gbits: list = []
+    dp, s_count = n_hits.shape
+    for d in range(dp):
+        for s in range(s_count):
+            k = int(n_hits[d, s])
+            if not k:
+                continue
+            gidx.append(part.perm[d, s][hit_idx[d, s, :k]])
+            gbits.append(hit_bits[d, s, :k])
+    if not gidx:
+        return CompactBits(np.zeros(0, np.int32),
+                           np.zeros(0, np.int8), n_pairs), max_hits
+    gi = np.concatenate(gidx)
+    gb = np.concatenate(gbits)
+    # strided perm interleaves the cells' global indices — restore the
+    # caller's ascending pair order (host-side; the device epilogue
+    # stays sort-free)
+    order = np.argsort(gi, kind="stable")
+    return CompactBits(gi[order].astype(np.int32), gb[order],
+                       n_pairs), max_hits

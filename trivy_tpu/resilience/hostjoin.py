@@ -24,11 +24,60 @@ backend at all, which is the point.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import numpy as np
 
 from ..ops.constants import (
     HAS_HI, HAS_LO, HI_INCL, INEXACT, LO_INCL,
 )
+
+
+class CompactBits(NamedTuple):
+    """Compacted join result: the nonzero entries of a dense int8 bits
+    vector as (ascending pair index, bits), plus the dense length they
+    stand in for. This is the O(hits) device→host representation the
+    compaction epilogue emits (ops/join._compact_core) and the shape
+    every downstream consumer — assembly, the detectd slice recovery,
+    the mesh concat — indexes into directly, with no host `nonzero`.
+
+    Defined here (NumPy-only, no jax import) so the host fallback
+    executor can emit the identical triple while fully degraded."""
+
+    pair_idx: np.ndarray   # int32[n_hits], strictly increasing
+    bits: np.ndarray       # int8[n_hits], all nonzero
+    n_pairs: int           # logical dense length (t_pad or slice len)
+
+    def slice(self, off: int, n: int) -> "CompactBits":
+        """The [off, off+n) window of the dense vector this stands in
+        for — one searchsorted over the sorted hit indices (the
+        detectd merged-dispatch slice recovery)."""
+        lo, hi = np.searchsorted(self.pair_idx, (off, off + n))
+        return CompactBits(self.pair_idx[lo:hi] - np.int32(off),
+                           self.bits[lo:hi], n)
+
+    def dense(self) -> np.ndarray:
+        """Materialize the dense int8[n_pairs] vector (tests, bench —
+        never the hot path)."""
+        out = np.zeros(self.n_pairs, np.int8)
+        out[self.pair_idx] = self.bits
+        return out
+
+
+def host_compact(bits: np.ndarray, h_cap: int):
+    """NumPy mirror of ops.join._compact_core over a dense bit vector:
+    → (hit_idx int32[h_cap], hit_bits int8[h_cap], n_hits int). The
+    buffers are zero-padded past the hits, and an overflow (n_hits >
+    h_cap) keeps exactly the first h_cap hits — bit-for-bit what the
+    device scatter's dropped out-of-range slots leave behind."""
+    keep = np.nonzero(bits)[0]
+    n = int(keep.size)
+    hit_idx = np.zeros(h_cap, np.int32)
+    hit_bits = np.zeros(h_cap, np.int8)
+    k = min(n, h_cap)
+    hit_idx[:k] = keep[:k]
+    hit_bits[:k] = bits[keep[:k]]
+    return hit_idx, hit_bits, n
 
 
 def _lex_less(a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -99,3 +148,22 @@ def host_csr_pair_join(adv_lo_tok: np.ndarray, adv_hi_tok: np.ndarray,
     out[:n_pairs] = host_pair_join(adv_lo_tok, adv_hi_tok, adv_flags,
                                    ver_tok, pair_row, pair_ver, valid)
     return out
+
+
+def host_csr_pair_join_compact(adv_lo_tok: np.ndarray,
+                               adv_hi_tok: np.ndarray,
+                               adv_flags: np.ndarray,
+                               ver_tok: np.ndarray,
+                               q_start: np.ndarray, q_count: np.ndarray,
+                               q_ver: np.ndarray, total: int,
+                               t_pad: int, h_cap: int):
+    """NumPy mirror of ops.join._csr_compact_core — the CSR join plus
+    the compaction epilogue, emitting the same (hit_idx, hit_bits,
+    n_hits, dense_bits) quadruple as the device kernel (XCHK: the
+    parity tests in tests/test_compact.py hold the two byte-for-byte
+    identical, overflow truncation included)."""
+    bits = host_csr_pair_join(adv_lo_tok, adv_hi_tok, adv_flags,
+                              ver_tok, q_start, q_count, q_ver,
+                              total, t_pad)
+    hit_idx, hit_bits, n_hits = host_compact(bits, h_cap)
+    return hit_idx, hit_bits, n_hits, bits
