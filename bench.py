@@ -907,6 +907,226 @@ def bench_server_fleet(table):
     }
 
 
+DEDUP_IMAGES = 24       # images sharing ONE fat base layer
+DEDUP_THIN_PKGS = 8     # per-image thin-layer pip packages
+DEDUP_CLIENTS = 8
+DEDUP_WARM = 1          # image 0 scans first → base memo entry exists
+
+
+def _dedup_tables():
+    """Self-contained advisory pair for the rolling-swap drill: same
+    package namespace, different seeded bounds → different content
+    digests AND different results."""
+    import numpy as np
+    from trivy_tpu.db.table import RawAdvisory, build_table
+
+    def one(seed):
+        rng = np.random.default_rng(seed)
+        raw, details = [], {}
+        for i in range(64):
+            vid = f"CVE-2026-B{i:03d}"
+            raw.append(RawAdvisory(
+                source="alpine 3.19", ecosystem="alpine",
+                pkg_name=f"base-pkg-{i}", vuln_id=vid,
+                fixed_version=f"{1 + int(rng.integers(0, 4))}."
+                              f"{int(rng.integers(0, 10))}.0-r0"))
+            details[vid] = {"Title": f"dedup {vid}", "Severity": "HIGH"}
+        for i in range(32):
+            vid = f"CVE-2026-T{i:03d}"
+            lim = f"{1 + int(rng.integers(0, 4))}.{int(rng.integers(0, 10))}.0"
+            raw.append(RawAdvisory(
+                source="pip::Python", ecosystem="pip",
+                pkg_name=f"pip-lib-{i}", vuln_id=vid,
+                vulnerable_ranges=f"<{lim}", patched_versions=lim))
+            details[vid] = {"Title": f"dedup {vid}", "Severity": "LOW"}
+        return build_table(raw, details)
+
+    return one(21), one(22)
+
+
+def bench_fleet_dedup():
+    """graftmemo scenario: N replicas sharing one layer cache AND one
+    detection-result memo behind the router, scanning DEDUP_IMAGES
+    images built on ONE common fat base layer (plus a per-image thin
+    pip layer). Reports:
+
+      * aggregate ips at 1 vs N replicas (`scaling`) with the
+        realistic base-layer overlap;
+      * memo economics — hit rate over the timed pass, and the base
+        layer's (stores, hits): the tentpole claim is stores == 1
+        (detected once fleet-wide) with hits ≈ every later scan;
+      * the rolling DB swap — mid-load every replica hot-swaps to a
+        different advisory table (kicking redetectd); p99 across the
+        swap window, zero failures, and every response's
+        X-Trivy-DB-Version consistent with one of the two tables.
+    """
+    import hashlib
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from trivy_tpu.fanal.cache import MemoryCache
+    from trivy_tpu.fleet import (MemoryMemo, ReplicaOptions,
+                                 RouterOptions,
+                                 serve_router_background)
+    from trivy_tpu.metrics import METRICS
+    from trivy_tpu.resilience import RetryPolicy
+    from trivy_tpu.server.listen import serve_background
+
+    table, table2 = _dedup_tables()
+    base_blob = {
+        "SchemaVersion": 2, "DiffID": f"sha256:{0xba5e:064x}",
+        "OS": {"Family": "alpine", "Name": "3.19.1"},
+        "PackageInfos": [{"FilePath": "lib/apk/db/installed",
+                          "Packages": [
+                              {"Name": f"base-pkg-{i}",
+                               "Version": f"{1 + i % 3}.2.0-r0",
+                               "SrcName": f"base-pkg-{i}",
+                               "SrcVersion": f"{1 + i % 3}.2.0-r0"}
+                              for i in range(64)]}],
+    }
+    thin_blobs = []
+    for i in range(DEDUP_IMAGES):
+        thin_blobs.append({
+            "SchemaVersion": 2, "DiffID": f"sha256:{0x7f1a0000 + i:064x}",
+            "Applications": [{
+                "Type": "pip", "FilePath": f"app{i}/requirements.txt",
+                "Packages": [
+                    {"Name": f"pip-lib-{(i * 3 + j) % 32}",
+                     "Version": f"{1 + j % 3}.{i % 10}.0"}
+                    for j in range(DEDUP_THIN_PKGS)]}],
+        })
+
+    def post(base, route, doc):
+        req = urllib.request.Request(
+            base + route, data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return r.status, dict(r.headers), r.read()
+
+    def run_point(n_replicas, rolling_swap=False):
+        shared_cache, shared_memo = MemoryCache(), MemoryMemo()
+        replicas = []
+        for _ in range(n_replicas):
+            httpd, state = serve_background(
+                "127.0.0.1", 0, table, cache_dir="",
+                cache_backend=shared_cache, memo_backend=shared_memo)
+            replicas.append((httpd, state))
+        router, rstate = serve_router_background(
+            "127.0.0.1", 0,
+            [f"http://127.0.0.1:{h.server_address[1]}"
+             for h, _ in replicas],
+            RouterOptions(
+                retry=RetryPolicy(attempts=3, base_delay_s=0.05,
+                                  max_delay_s=0.5, budget_s=10.0),
+                replica=ReplicaOptions(fail_threshold=2,
+                                       reset_timeout_ms=500.0,
+                                       probe_interval_ms=100.0)))
+        base = f"http://127.0.0.1:{router.server_address[1]}"
+        failed, lat_ms, versions = [], [], set()
+
+        def scan_one(i):
+            t0 = time.perf_counter()
+            try:
+                art = f"dedup-img-{i}"
+                for blob in (base_blob, thin_blobs[i]):
+                    post(base, "/twirp/trivy.cache.v1.Cache/PutBlob",
+                         {"diff_id": blob["DiffID"],
+                          "blob_info": blob})
+                code, headers, raw = post(
+                    base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                    {"target": art, "artifact_id": art,
+                     "blob_ids": [base_blob["DiffID"],
+                                  thin_blobs[i]["DiffID"]],
+                     "options": {"scanners": ["vuln"]}})
+                versions.add(headers.get("X-Trivy-DB-Version") or "")
+                return hashlib.sha256(raw).hexdigest()
+            except Exception as e:  # noqa: BLE001 — counted
+                failed.append((i, f"{type(e).__name__}: {e}"))
+                return None
+            finally:
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+        try:
+            for i in range(DEDUP_WARM):
+                scan_one(i)
+            lat_ms.clear()
+            failed.clear()   # a warm-pass failure is not the timed
+            # window's failure (it does leave the fleet cold, which
+            # the hit-rate/store numbers then show honestly)
+            # snapshot AFTER the warm pass: its lookups are misses by
+            # design (it exists to seed the base entry) and must not
+            # deflate the timed pass's reported hit rate
+            h0 = METRICS.get("trivy_tpu_memo_hits_total",
+                             backend="memory")
+            m0 = METRICS.get("trivy_tpu_memo_misses_total",
+                             backend="memory")
+            swapper = None
+            if rolling_swap:
+                def roll():
+                    time.sleep(0.05)
+                    for _httpd, state in replicas:
+                        state.swap_table(table2)
+                        time.sleep(0.02)
+                import threading
+                swapper = threading.Thread(target=roll,
+                                           name="dedup-roll")
+                swapper.start()
+            with ThreadPoolExecutor(DEDUP_CLIENTS) as pool:
+                t0 = time.perf_counter()
+                list(pool.map(scan_one,
+                              range(DEDUP_WARM, DEDUP_IMAGES)))
+                dt = time.perf_counter() - t0
+            if swapper is not None:
+                swapper.join()
+            hits = METRICS.get("trivy_tpu_memo_hits_total",
+                               backend="memory") - h0
+            misses = METRICS.get("trivy_tpu_memo_misses_total",
+                                 backend="memory") - m0
+            base_stats = shared_memo.key_stats(
+                base_blob["DiffID"], table.content_digest())
+        finally:
+            router.shutdown()
+            router.server_close()
+            rstate.close()
+            for httpd, state in replicas:
+                httpd.shutdown()
+                httpd.server_close()
+                state.close()
+        lats = sorted(lat_ms)
+        p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] \
+            if lats else 0.0
+        return {
+            "ips": (DEDUP_IMAGES - DEDUP_WARM) / dt,
+            "failed": failed,
+            "memo_hit_rate": round(hits / (hits + misses), 3)
+            if hits + misses else None,
+            "base_layer": base_stats,
+            "p99_ms": round(p99, 1),
+            "versions_seen": len(versions - {""}),
+        }
+
+    one = run_point(1)
+    many = run_point(FLEET_REPLICAS)
+    swap = run_point(FLEET_REPLICAS, rolling_swap=True)
+    return {
+        "replicas": FLEET_REPLICAS,
+        "images": DEDUP_IMAGES,
+        "ips_1_replica": round(one["ips"], 1),
+        f"ips_{FLEET_REPLICAS}_replicas": round(many["ips"], 1),
+        "scaling": round(many["ips"] / one["ips"], 2)
+        if one["ips"] else None,
+        "memo_hit_rate": many["memo_hit_rate"],
+        "base_layer_stores": many["base_layer"]["stores"],
+        "base_layer_hits": many["base_layer"]["hits"],
+        "rolling_swap": {
+            "p99_ms": swap["p99_ms"],
+            "failed_requests": len(swap["failed"]),
+            "versions_seen": swap["versions_seen"],
+        },
+    }
+
+
 def bench_secrets_host():
     """Host bytes.find gate over the same corpus/keywords (MB/s), and
     the full host-only scan_files pipeline for the same corpus."""
@@ -990,6 +1210,11 @@ def device_child_main():
     except Exception:
         server_fleet = None
     try:
+        # graftmemo scenario: shared-memo dedup + rolling DB swap
+        fleet_dedup = bench_fleet_dedup()
+    except Exception:
+        fleet_dedup = None
+    try:
         chaos_storm = bench_chaos_storm()
     except Exception:
         chaos_storm = None
@@ -1021,6 +1246,7 @@ def device_child_main():
         "degraded_mode": degraded,
         "mesh_degraded": mesh_degraded,
         "server_fleet": server_fleet,
+        "fleet_dedup": fleet_dedup,
         "chaos_storm": chaos_storm,
         "archive_e2e": archive_e2e,
         "device": str(jax.devices()[0]),
@@ -1369,6 +1595,14 @@ def main():
         except Exception as e:
             diag.append(f"server_fleet bench failed: {e}")
         try:
+            # graftmemo scenario (aggregate ips at 1 vs N replicas
+            # with shared base-layer overlap, memo hit rate, p99
+            # through a rolling DB swap); the device child's numbers
+            # override
+            result["fleet_dedup"] = bench_fleet_dedup()
+        except Exception as e:
+            diag.append(f"fleet_dedup bench failed: {e}")
+        try:
             # graftstorm scenario: p99 + shed rate under a standard
             # compound chaos schedule, invariant verdict included; the
             # device child's numbers override when present
@@ -1452,6 +1686,8 @@ def main():
                 result["mesh_degraded"] = dev["mesh_degraded"]
             if dev.get("server_fleet"):
                 result["server_fleet"] = dev["server_fleet"]
+            if dev.get("fleet_dedup"):
+                result["fleet_dedup"] = dev["fleet_dedup"]
             if dev.get("chaos_storm"):
                 result["chaos_storm"] = dev["chaos_storm"]
             if dev.get("archive_e2e"):

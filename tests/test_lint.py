@@ -1005,3 +1005,59 @@ def test_fanal_failpoint_sites_in_catalog():
         pass
     else:
         raise AssertionError("typo'd fanal site must fail at parse")
+
+
+def test_graftmemo_store_in_lock_hygiene_scope():
+    """Satellite (PR 11): fleet/memo.py — one MemoStore is shared
+    across server handler threads and the redetectd sweep (known-blob
+    registry, per-key stats) — rides the fleet/ TPU106 scope."""
+    src = (
+        "import threading\n"
+        "class Store:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._known = {}\n"
+        "    def bad(self, k):\n"
+        "        self._known[k] = None\n"
+        "    def good(self, k):\n"
+        "        with self._lock:\n"
+        "            self._known[k] = None\n"
+    )
+    fs = _lint("trivy_tpu/fleet/memo.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+
+
+def test_redetectd_in_lock_hygiene_scope():
+    """Satellite (PR 11): detect/redetect.py — the sweep daemon's
+    status/thread handoff is shared between handler threads
+    (swap_table → schedule), the sweep thread, and the drain path —
+    is in TPU106 scope; unscoped modules stay out."""
+    src = (
+        "import threading\n"
+        "class Daemon:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._status = {}\n"
+        "    def bad(self):\n"
+        "        self._status['phase'] = 'idle'\n"
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            self._status['phase'] = 'idle'\n"
+    )
+    fs = _lint("trivy_tpu/detect/redetect.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+    assert _lint("trivy_tpu/report/fixture.py", src) == []
+
+
+def test_memo_failpoint_sites_in_catalog():
+    """Satellite (PR 11): the memo.get / memo.put sites parse under
+    the spec grammar and are schedulable."""
+    from trivy_tpu.resilience.failpoints import parse_spec
+    specs = parse_spec("memo.get=error;memo.put=flaky:0.3:11")
+    assert set(specs) == {"memo.get", "memo.put"}
+    try:
+        parse_spec("memo.gte=error")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("typo'd memo site must fail at parse")

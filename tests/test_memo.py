@@ -1,0 +1,789 @@
+"""graftmemo tier-1 gate: content-addressed detection-result
+memoization (fleet/memo.py), the redetectd incremental re-detect
+daemon (detect/redetect.py), the delta-flatten satellite
+(db/table.py FlattenMemo), and the fleet acceptance drill — a
+4-replica fleet with a shared memo detects a common base layer ONCE
+fleet-wide, then survives a rolling DB hot swap with bit-identical,
+version-consistent responses and a quiet skew counter."""
+
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from trivy_tpu import types as T
+from trivy_tpu.db.table import FlattenMemo, RawAdvisory, build_table
+from trivy_tpu.fanal.cache import MemoryCache, blob_from_json
+from trivy_tpu.fleet.memo import (FSMemo, MemoryMemo, decode_hits,
+                                  encode_hits, open_memo,
+                                  query_digest)
+from trivy_tpu.metrics import METRICS
+from trivy_tpu.resilience import FAILPOINTS, GUARD
+from trivy_tpu.resilience.storm import _post, canonical_digest
+from trivy_tpu.scanner import LocalScanner
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard():
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+    yield
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+
+
+def memo_table(seed: int = 0):
+    """alpine base-layer advisories + pip thin-layer advisories; the
+    seed perturbs every bound so two seeds give different content
+    digests AND different scan results."""
+    raw, details = [], {}
+    for i in range(24):
+        vid = f"CVE-2026-B{i:03d}"
+        raw.append(RawAdvisory(
+            source="alpine 3.17", ecosystem="alpine",
+            pkg_name=f"base-pkg-{i}", vuln_id=vid,
+            fixed_version=f"{1 + (i + seed) % 4}.{(i + seed) % 10}"
+                          f".0-r0"))
+        details[vid] = {"Title": f"planted {vid}", "Severity": "HIGH"}
+    for i in range(12):
+        vid = f"CVE-2026-T{i:03d}"
+        lim = f"{1 + (i + seed) % 4}.{(i + seed) % 10}.0"
+        raw.append(RawAdvisory(
+            source="pip::Python", ecosystem="pip",
+            pkg_name=f"pip-lib-{i}", vuln_id=vid,
+            vulnerable_ranges=f"<{lim}", patched_versions=lim))
+        details[vid] = {"Title": f"planted {vid}", "Severity": "LOW"}
+    return build_table(raw, details)
+
+
+BASE_DIFF = "sha256:" + "ba5e" * 16
+
+
+def base_blob_doc():
+    return {
+        "SchemaVersion": 2, "DiffID": BASE_DIFF,
+        "OS": {"Family": "alpine", "Name": "3.17.3"},
+        "PackageInfos": [{"FilePath": "lib/apk/db/installed",
+                          "Packages": [
+                              {"Name": f"base-pkg-{i}",
+                               "Version": f"{1 + i % 3}.2.0-r0",
+                               "SrcName": f"base-pkg-{i}",
+                               "SrcVersion": f"{1 + i % 3}.2.0-r0"}
+                              for i in range(24)]}],
+    }
+
+
+def thin_blob_doc(i: int):
+    return {
+        "SchemaVersion": 2, "DiffID": f"sha256:{0x7f1a0000 + i:064x}",
+        "Applications": [{
+            "Type": "pip", "FilePath": f"app{i}/requirements.txt",
+            "Packages": [{"Name": f"pip-lib-{(i * 3 + j) % 12}",
+                          "Version": f"{1 + j % 3}.{i % 10}.0"}
+                         for j in range(4)]}],
+    }
+
+
+def put_blobs(cache, *docs):
+    for d in docs:
+        cache.put_blob(d["DiffID"], blob_from_json(d))
+
+
+def results_json(results):
+    return json.dumps([r.to_json() for r in results[0]],
+                      sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# store + session units
+
+
+class TestMemoStore:
+    def test_open_memo_spellings(self, tmp_path):
+        assert open_memo("") is None
+        assert open_memo("off") is None
+        assert isinstance(open_memo("memory"), MemoryMemo)
+        assert isinstance(open_memo("fs", str(tmp_path)), FSMemo)
+        m = MemoryMemo()
+        assert open_memo(m) is m   # object passthrough
+        with pytest.raises(ValueError):
+            open_memo("bolt://nope")
+
+    def test_fs_corrupt_entry_quarantines_then_heals(self, tmp_path):
+        import os
+        memo = FSMemo(str(tmp_path))
+        unit = {"q": "d" * 64, "hits": [[0, "CVE-1", "1.0", "", "",
+                                         None, []]]}
+        assert memo.put_units("sha256:b1", "v1", {"os": unit}) == 1
+        assert memo.get_entry("sha256:b1", "v1")["units"]["os"] == unit
+        # corrupt the entry on disk: the next read must quarantine it
+        # and serve a miss — never raise on every future scan
+        (path,) = [os.path.join(memo.root, n)
+                   for n in os.listdir(memo.root)
+                   if n.endswith(".json")]
+        with open(path, "w") as f:
+            f.write("{truncated")
+        assert memo.get_entry("sha256:b1", "v1") is None
+        assert any(n.endswith(".corrupt")
+                   for n in os.listdir(memo.root))
+        # heal: a fresh put re-creates the entry and reads serve again
+        assert memo.put_units("sha256:b1", "v1", {"os": unit}) == 1
+        assert memo.get_entry("sha256:b1", "v1")["units"]["os"] == unit
+
+    def test_fs_reseeds_known_blobs_on_restart(self, tmp_path):
+        memo = FSMemo(str(tmp_path))
+        memo.put_units("sha256:b7", "v1", {"os": {"q": "x",
+                                                  "hits": []}})
+        again = FSMemo(str(tmp_path))
+        assert again.known_blobs() == ["sha256:b7"]
+
+    def test_backend_fault_degrades_never_raises(self):
+        memo = MemoryMemo()
+        memo.put_units("sha256:b1", "v1", {"os": {"q": "x",
+                                                  "hits": []}})
+        FAILPOINTS.configure("memo.get=error;memo.put=error")
+        try:
+            assert memo.get_entry("sha256:b1", "v1") is None
+            assert memo.put_units("sha256:b1", "v1",
+                                  {"u": {"q": "y", "hits": []}}) == 0
+        finally:
+            FAILPOINTS.configure("")
+        # faults cleared: the original entry is intact
+        assert "os" in memo.get_entry("sha256:b1", "v1")["units"]
+
+    def test_hit_round_trip_is_exact(self):
+        from trivy_tpu.detect.engine import Hit, PkgQuery
+        qs = [PkgQuery(source="alpine 3.17", ecosystem="alpine",
+                       name=f"p{i}", version="1.0-r0", ref=object())
+              for i in range(3)]
+        hits = [Hit(query=qs[2], vuln_id="CVE-9",
+                    fixed_version="2.0-r0", status="fixed",
+                    severity="HIGH",
+                    data_source={"ID": "alpine", "Name": "x"},
+                    vendor_ids=("V-1", "V-2"))]
+        doc = encode_hits(qs, hits)
+        back = decode_hits(qs, json.loads(json.dumps(doc)))
+        assert back == hits
+        assert back[0].query is qs[2]       # fresh ref identity
+        assert isinstance(back[0].vendor_ids, tuple)
+        # corrupt-but-parseable entries are a MISS, never a wrong
+        # result: a negative index would silently wrap to the END of
+        # the batch and attribute the hit to the wrong package
+        bad = json.loads(json.dumps(doc))
+        bad[0][0] = -1
+        assert decode_hits(qs, bad) is None
+        bad[0][0] = len(qs)
+        assert decode_hits(qs, bad) is None
+        bad[0][0] = "0"
+        assert decode_hits(qs, bad) is None
+        # a foreign query object is refused, not mis-indexed
+        alien = Hit(query=PkgQuery("s", "alpine", "q", "1"),
+                    vuln_id="x", fixed_version="", status="",
+                    severity="", data_source=None, vendor_ids=())
+        assert encode_hits(qs, [alien]) is None
+
+    def test_query_digest_orders_and_scopes(self):
+        from trivy_tpu.detect.engine import PkgQuery
+
+        def q(**kw):
+            base = dict(source="s", ecosystem="alpine", name="n",
+                        version="1")
+            base.update(kw)
+            return PkgQuery(**base)
+
+        a = [q(name="a"), q(name="b")]
+        assert query_digest(a) == query_digest(
+            [q(name="a"), q(name="b")])
+        assert query_digest(a) != query_digest(
+            [q(name="b"), q(name="a")])   # order is significant
+        assert query_digest([q()]) != query_digest([q(arch="x86_64")])
+        assert query_digest([q()]) != query_digest(
+            [q(cpe_indices=frozenset({3}))])
+
+
+# ---------------------------------------------------------------------------
+# scan-path semantics (LocalScanner + memo, no HTTP in the loop)
+
+
+class TestScanPathMemo:
+    def scan(self, scanner, blob_docs):
+        ids = [d["DiffID"] for d in blob_docs]
+        return scanner.scan_many([("img", ids[0], ids)],
+                                 T.ScanOptions())[0]
+
+    def test_memo_hit_bit_identity_vs_cold_detect(self):
+        table = memo_table()
+        cache, memo = MemoryCache(), MemoryMemo()
+        docs = [base_blob_doc(), thin_blob_doc(0)]
+        put_blobs(cache, *docs)
+        warm = LocalScanner(cache, table, memo=memo)
+        cold = LocalScanner(cache, table)
+        try:
+            first = results_json(self.scan(warm, docs))
+            v = table.content_digest()
+            assert memo.key_stats(BASE_DIFF, v)["stores"] >= 1
+            hits0 = memo.key_stats(BASE_DIFF, v)["hits"]
+            replay = results_json(self.scan(warm, docs))
+            assert memo.key_stats(BASE_DIFF, v)["hits"] > hits0
+            reference = results_json(self.scan(cold, docs))
+            assert first == reference
+            assert replay == reference      # bit identity on replay
+        finally:
+            warm.close()
+            cold.close()
+
+    def test_db_version_isolation_old_entries_never_served(self):
+        t1, t2 = memo_table(0), memo_table(5)
+        cache, memo = MemoryCache(), MemoryMemo()
+        docs = [base_blob_doc(), thin_blob_doc(0)]
+        put_blobs(cache, *docs)
+        s1 = LocalScanner(cache, t1, memo=memo)
+        s2 = LocalScanner(cache, t2, memo=memo)   # post-swap scanner
+        cold2 = LocalScanner(cache, t2)
+        try:
+            r1 = results_json(self.scan(s1, docs))
+            # the new-version scanner must NOT see v1 entries: its
+            # first scan is a miss (0 hits under v2) and its results
+            # match the cold new-table oracle, not the old results
+            r2 = results_json(self.scan(s2, docs))
+            v2 = t2.content_digest()
+            assert memo.key_stats(BASE_DIFF, v2)["hits"] == 0
+            assert memo.key_stats(BASE_DIFF, v2)["stores"] >= 1
+            assert r2 == results_json(self.scan(cold2, docs))
+            assert r2 != r1
+        finally:
+            s1.close()
+            s2.close()
+            cold2.close()
+
+    def test_partial_blobs_are_never_memoized(self):
+        table = memo_table()
+        cache, memo = MemoryCache(), MemoryMemo()
+        partial = base_blob_doc()
+        partial["IngestErrors"] = [{"Stage": "walk", "Kind": "budget",
+                                    "Detail": "tripped"}]
+        put_blobs(cache, partial)
+        scanner = LocalScanner(cache, table, memo=memo)
+        try:
+            s0 = METRICS.get("trivy_tpu_memo_stores_total",
+                             backend="memory")
+            self.scan(scanner, [partial])
+            self.scan(scanner, [partial])
+            v = table.content_digest()
+            assert memo.key_stats(BASE_DIFF, v) == {"hits": 0,
+                                                    "stores": 0}
+            assert METRICS.get("trivy_tpu_memo_stores_total",
+                               backend="memory") == s0
+            assert memo.known_blobs() == []
+        finally:
+            scanner.close()
+
+    def test_cross_blob_unit_is_not_attributed(self):
+        """An aggregated python-pkg unit spanning TWO thin layers is
+        unattributable — it detects live every time (correct, just
+        unmemoized), while single-blob units still memoize."""
+        table = memo_table()
+        cache, memo = MemoryCache(), MemoryMemo()
+        t1, t2 = thin_blob_doc(1), thin_blob_doc(2)
+        for d, path in ((t1, "a"), (t2, "b")):
+            d["Applications"][0]["Type"] = "python-pkg"
+            d["Applications"][0]["FilePath"] = path
+        docs = [base_blob_doc(), t1, t2]
+        put_blobs(cache, *docs)
+        scanner = LocalScanner(cache, table, memo=memo)
+        try:
+            self.scan(scanner, docs)
+            v = table.content_digest()
+            # base (os unit) memoized; neither thin blob got an entry
+            # for the merged python-pkg aggregate
+            assert memo.key_stats(BASE_DIFF, v)["stores"] == 1
+            for d in (t1, t2):
+                assert memo.key_stats(d["DiffID"], v)["stores"] == 0
+        finally:
+            scanner.close()
+
+    def test_memo_faults_fall_back_to_live_detect(self):
+        table = memo_table()
+        cache, memo = MemoryCache(), MemoryMemo()
+        docs = [base_blob_doc(), thin_blob_doc(0)]
+        put_blobs(cache, *docs)
+        scanner = LocalScanner(cache, table, memo=memo)
+        cold = LocalScanner(cache, table)
+        try:
+            want = results_json(self.scan(cold, docs))
+            FAILPOINTS.configure("memo.get=error;memo.put=error")
+            assert results_json(self.scan(scanner, docs)) == want
+            FAILPOINTS.configure("")
+            # backend back: the next scan stores, the one after hits
+            assert results_json(self.scan(scanner, docs)) == want
+            assert results_json(self.scan(scanner, docs)) == want
+            v = table.content_digest()
+            assert memo.key_stats(BASE_DIFF, v)["hits"] >= 1
+        finally:
+            scanner.close()
+            cold.close()
+
+
+# ---------------------------------------------------------------------------
+# redetectd
+
+
+class TestRedetectd:
+    def _server(self, table, memo, **kw):
+        from trivy_tpu.server.listen import serve_background
+        return serve_background("127.0.0.1", 0, table, cache_dir="",
+                                cache_backend="memory",
+                                memo_backend=memo, **kw)
+
+    def _push_and_scan(self, base, doc, timeout=30):
+        _post(base, "/twirp/trivy.cache.v1.Cache/PutBlob",
+              {"diff_id": doc["DiffID"], "blob_info": doc}, timeout)
+        return _post(base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                     {"target": "t", "artifact_id": doc["DiffID"],
+                      "blob_ids": [doc["DiffID"]],
+                      "options": {"scanners": ["vuln"]}}, timeout)
+
+    def _wait_sweep(self, state, timeout=15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = state.redetect.status()
+            if st["phase"] in ("done", "cancelled", "failed"):
+                return st
+            time.sleep(0.02)
+        return state.redetect.status()
+
+    def test_sweep_under_live_load_completes_zero_sheds(self):
+        """c=8 live load through bounded admission WHILE redetectd
+        sweeps a hot-swapped table: the sweep yields, every live scan
+        completes (zero sheds), and the sweep finishes."""
+        from trivy_tpu.resilience import AdmissionOptions
+        t1, t2 = memo_table(0), memo_table(5)
+        memo = MemoryMemo()
+        httpd, state = self._server(
+            t1, memo, admission=AdmissionOptions(
+                max_active=2, max_queue=64,
+                queue_timeout_ms=30000.0))
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        docs = [base_blob_doc()] + [thin_blob_doc(i)
+                                    for i in range(11)]
+        try:
+            for d in docs:      # warm pass populates the memo
+                code, _, _ = self._push_and_scan(base, d)
+                assert code == 200
+            shed0 = METRICS.get("trivy_tpu_requests_shed_total")
+            state.swap_table(t2)    # kicks the sweep
+
+            codes = []
+
+            def worker(ids):
+                for i in ids:
+                    code, _, _ = self._push_and_scan(base, docs[i])
+                    codes.append(code)
+
+            threads = [threading.Thread(target=worker,
+                                        args=(range(k, len(docs), 8),))
+                       for k in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert codes and all(c == 200 for c in codes)
+            assert METRICS.get("trivy_tpu_requests_shed_total") \
+                == shed0
+            st = self._wait_sweep(state)
+            assert st["phase"] == "done"
+            assert st["done"] == st["total"] == len(docs)
+            assert st["db_version"] == t2.content_digest()
+            # the sweep's entries serve post-swap scans as hits
+            h0 = METRICS.get("trivy_tpu_memo_hits_total",
+                             backend="memory")
+            code, headers, _ = self._push_and_scan(base, docs[0])
+            assert code == 200
+            assert headers.get("X-Trivy-DB-Version") == \
+                t2.content_digest()
+            assert METRICS.get("trivy_tpu_memo_hits_total",
+                               backend="memory") > h0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+
+    def test_drain_cancels_sweep_cleanly_no_leaked_threads(self):
+        t1, t2 = memo_table(0), memo_table(5)
+        memo = MemoryMemo()
+        baseline = {t.ident for t in threading.enumerate()
+                    if not t.daemon}
+        httpd, state = self._server(t1, memo)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            for i in range(10):
+                code, _, _ = self._push_and_scan(base,
+                                                 thin_blob_doc(i))
+                assert code == 200
+            # slow memo reads stretch the sweep so the drain provably
+            # lands mid-flight
+            FAILPOINTS.configure("memo.get=slow:80")
+            state.swap_table(t2)
+            time.sleep(0.1)
+            assert state.redetect.status()["phase"] in ("pending",
+                                                        "sweeping")
+            state.begin_drain()     # must cancel the sweep
+            st = self._wait_sweep(state, timeout=10.0)
+            assert st["phase"] in ("cancelled", "done")
+            t = state.redetect._thread
+            if t is not None:
+                t.join(timeout=10.0)
+                assert not t.is_alive()
+        finally:
+            FAILPOINTS.configure("")
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            leaked = [t.name for t in threading.enumerate()
+                      if not t.daemon and t.ident not in baseline]
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not leaked, f"leaked non-daemon threads: {leaked}"
+
+    def test_sweep_faults_never_charge_the_backend_breaker(self):
+        """The sweep is blameless: replays whose dispatches wedge
+        past the watchdog (hang-mode detect.dispatch under a tight
+        deadline) still time out and degrade, but the backend breaker
+        live traffic depends on stays CLOSED and opens_total never
+        moves — background work must not open a shared domain."""
+        t1, t2 = memo_table(0), memo_table(5)
+        memo = MemoryMemo()
+        httpd, state = self._server(t1, memo)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            for i in range(4):
+                code, _, _ = self._push_and_scan(base,
+                                                 thin_blob_doc(i))
+                assert code == 200
+            opens0 = GUARD.breaker.status()["opens_total"]
+            GUARD.configure(dispatch_timeout_s=0.03)
+            FAILPOINTS.configure("detect.dispatch=hang:120")
+            state.swap_table(t2)
+            st = self._wait_sweep(state)
+            assert st["phase"] == "done"
+            status = GUARD.breaker.status()
+            assert status["state"] == "closed"
+            assert status["opens_total"] == opens0
+        finally:
+            FAILPOINTS.configure("")
+            GUARD.configure(dispatch_timeout_s=120.0)
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+
+    def test_stale_schedule_target_is_ignored(self):
+        """Racing version-changing swaps deliver schedule() calls out
+        of order: an OLDER swap's late schedule() must not preempt
+        the sweep toward the version actually being served (the
+        replacement would instantly abort as stale, leaving no sweep
+        toward the live version)."""
+        t1, t2 = memo_table(0), memo_table(5)
+        memo = MemoryMemo()
+        httpd, state = self._server(t1, memo)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            for i in range(3):
+                self._push_and_scan(base, thin_blob_doc(i))
+            state.swap_table(t2)
+            st = self._wait_sweep(state)
+            assert st["db_version"] == t2.content_digest()
+            sweeps = st["sweeps"]
+            state.redetect.schedule(t1.content_digest())  # stale
+            st = state.redetect.status()
+            assert st["db_version"] == t2.content_digest()
+            assert st["sweeps"] == sweeps
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+
+    def test_blameless_never_consumes_the_halfopen_probe(self):
+        """A blameless caller asking for the device while the breaker
+        is recovering must be refused WITHOUT consuming the half-open
+        probe slot — a background replay's unrecorded success would
+        otherwise latch the breaker half-open against live traffic
+        forever."""
+        reset0 = GUARD.breaker.reset_timeout_s
+        try:
+            GUARD.configure(reset_timeout_s=0.05)
+            GUARD.breaker.trip()
+            time.sleep(0.08)
+            with GUARD.blameless():
+                assert GUARD.allow_device() is False
+            # the probe slot is still free: live traffic probes and
+            # re-closes
+            assert GUARD.breaker.allow() is True
+            GUARD.record_success()
+            assert GUARD.breaker.status()["state"] == "closed"
+            # while closed, blameless callers get the device normally
+            with GUARD.blameless():
+                assert GUARD.allow_device() is True
+        finally:
+            GUARD.configure(reset_timeout_s=reset0)
+            GUARD.reset_for_tests()
+
+    def test_newer_swap_preempts_running_sweep(self):
+        t1, t2, t3 = memo_table(0), memo_table(5), memo_table(9)
+        memo = MemoryMemo()
+        httpd, state = self._server(t1, memo)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            for i in range(8):
+                self._push_and_scan(base, thin_blob_doc(i))
+            FAILPOINTS.configure("memo.get=slow:60")
+            state.swap_table(t2)
+            time.sleep(0.05)
+            FAILPOINTS.configure("")
+            state.swap_table(t3)     # preempts the t2 sweep
+            st = self._wait_sweep(state)
+            assert st["phase"] == "done"
+            assert st["db_version"] == t3.content_digest()
+            assert st["sweeps"] == 2
+        finally:
+            FAILPOINTS.configure("")
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill (tier-1): shared-memo fleet + rolling DB swap
+
+
+class TestFleetDedupDrill:
+    REPLICAS = 4
+    IMAGES = 8
+
+    def _fleet(self, table, shared_cache, shared_memo):
+        from trivy_tpu.fleet import (ReplicaOptions, RouterOptions,
+                                     serve_router_background)
+        from trivy_tpu.resilience import RetryPolicy
+        from trivy_tpu.server.listen import serve_background
+        replicas = []
+        for _ in range(self.REPLICAS):
+            httpd, state = serve_background(
+                "127.0.0.1", 0, table, cache_dir="",
+                cache_backend=shared_cache, memo_backend=shared_memo)
+            replicas.append((httpd, state))
+        router, rstate = serve_router_background(
+            "127.0.0.1", 0,
+            [f"http://127.0.0.1:{h.server_address[1]}"
+             for h, _ in replicas],
+            RouterOptions(
+                retry=RetryPolicy(attempts=4, base_delay_s=0.01,
+                                  max_delay_s=0.05, budget_s=5.0),
+                replica=ReplicaOptions(fail_threshold=2,
+                                       reset_timeout_ms=200.0,
+                                       probe_interval_ms=50.0)))
+        return replicas, router, rstate
+
+    def _scan(self, base, i, docs):
+        art = f"dedup-img-{i}"
+        for d in docs:
+            _post(base, "/twirp/trivy.cache.v1.Cache/PutBlob",
+                  {"diff_id": d["DiffID"], "blob_info": d}, 30)
+        return _post(base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                     {"target": art, "artifact_id": art,
+                      "blob_ids": [d["DiffID"] for d in docs],
+                      "options": {"scanners": ["vuln"]}}, 30)
+
+    def _cold_oracle(self, table, images):
+        """Digests from a fresh memo-less single server — the
+        bit-identity reference for BOTH db versions."""
+        from trivy_tpu.server.listen import serve_background
+        httpd, state = serve_background("127.0.0.1", 0, table,
+                                        cache_dir="",
+                                        cache_backend="memory")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            out = {}
+            for i, docs in images.items():
+                code, _, body = self._scan(base, i, docs)
+                assert code == 200
+                out[i] = canonical_digest(body)
+            return out
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+
+    def test_acceptance_drill(self):
+        t1, t2 = memo_table(0), memo_table(5)
+        v1, v2 = t1.content_digest(), t2.content_digest()
+        base_doc = base_blob_doc()
+        images = {i: [base_doc, thin_blob_doc(i)]
+                  for i in range(self.IMAGES)}
+        oracle1 = self._cold_oracle(t1, images)
+        oracle2 = self._cold_oracle(t2, images)
+        assert oracle1 != oracle2    # the swap must be discriminating
+
+        shared_cache, shared_memo = MemoryCache(), MemoryMemo()
+        replicas, router, rstate = self._fleet(t1, shared_cache,
+                                               shared_memo)
+        base = f"http://127.0.0.1:{router.server_address[1]}"
+        try:
+            # phase 1 — 8 images on one common base layer. Image 0
+            # scans first (publishing the base entry); the remaining 7
+            # fan out across 4 replicas concurrently.
+            code, headers, body = self._scan(base, 0, images[0])
+            assert code == 200
+            assert canonical_digest(body) == oracle1[0]
+
+            outcomes = {}
+
+            def scan_one(i):
+                c, h, b = self._scan(base, i, images[i])
+                outcomes[i] = (c, h.get("X-Trivy-DB-Version"),
+                               canonical_digest(b))
+
+            with ThreadPoolExecutor(self.IMAGES - 1) as pool:
+                list(pool.map(scan_one, range(1, self.IMAGES)))
+            for i in range(1, self.IMAGES):
+                c, ver, dig = outcomes[i]
+                assert c == 200 and ver == v1
+                assert dig == oracle1[i], f"image {i} drifted"
+
+            # the base layer's detect ran ONCE fleet-wide
+            stats = shared_memo.key_stats(BASE_DIFF, v1)
+            assert stats["stores"] == 1
+            assert stats["hits"] >= self.REPLICAS - 1
+
+            # phase 2 — rolling DB hot swap mid-load: background load
+            # keeps flowing while every replica swaps to t2 in turn
+            # (each swap kicks its redetectd sweep).
+            mixed = []
+            stop = threading.Event()
+
+            def load():
+                i = 0
+                while not stop.is_set():
+                    idx = 1 + i % (self.IMAGES - 1)
+                    c, h, b = self._scan(base, idx, images[idx])
+                    mixed.append((idx, c,
+                                  h.get("X-Trivy-DB-Version"),
+                                  canonical_digest(b)))
+                    i += 1
+
+            workers = [threading.Thread(target=load)
+                       for _ in range(4)]
+            for w in workers:
+                w.start()
+            for _httpd, state in replicas:
+                state.swap_table(t2)
+                time.sleep(0.05)
+            time.sleep(0.2)
+            stop.set()
+            for w in workers:
+                w.join()
+
+            # every in-flight and subsequent response is bit-identical
+            # to the oracle its OWN X-Trivy-DB-Version names — no
+            # response ever mixes old-version hits with the new header
+            assert mixed
+            for idx, c, ver, dig in mixed:
+                assert c == 200
+                if ver == v2:
+                    assert dig == oracle2[idx], \
+                        f"image {idx}: v2 header, non-v2 result"
+                else:
+                    assert ver == v1
+                    assert dig == oracle1[idx], \
+                        f"image {idx}: v1 header, non-v1 result"
+
+            # fully rolled: subsequent scans serve v2 bit-identically
+            for i in range(self.IMAGES):
+                c, h, b = self._scan(base, i, images[i])
+                assert c == 200
+                assert h.get("X-Trivy-DB-Version") == v2
+                assert canonical_digest(b) == oracle2[i]
+
+            # the skew counter is QUIET after settle: the view has
+            # converged, further traffic must not count skew
+            skew0 = METRICS.family_sum(
+                "trivy_tpu_fleet_db_version_skew_total")
+            for i in range(self.IMAGES):
+                self._scan(base, i, images[i])
+            assert METRICS.family_sum(
+                "trivy_tpu_fleet_db_version_skew_total") == skew0
+            versions = rstate.db_versions()
+            assert set(versions.values()) == {v2}
+
+            # rolling-upgrade observability: every replica's /healthz
+            # names the previous version and the swap time
+            for httpd, _state in replicas:
+                h = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{httpd.server_address[1]}"
+                    f"/healthz", timeout=10).read())
+                assert h["db_version"] == v2
+                assert h["db_previous_version"] == v1
+                assert h["db_swapped_at"]
+                assert h["memo"]["backend"] == "memory"
+        finally:
+            router.shutdown()
+            router.server_close()
+            rstate.close()
+            for httpd, state in replicas:
+                httpd.shutdown()
+                httpd.server_close()
+                state.close()
+
+
+# ---------------------------------------------------------------------------
+# delta-flatten (db/table.py FlattenMemo)
+
+
+class TestDeltaFlatten:
+    def _raw(self, bump: int = 0):
+        return [
+            RawAdvisory(source="alpine 3.17", ecosystem="alpine",
+                        pkg_name="keep-pkg", vuln_id="CVE-KEEP",
+                        fixed_version="1.2.3-r0"),
+            RawAdvisory(source="pip::Python", ecosystem="pip",
+                        pkg_name="churn-lib", vuln_id="CVE-CHURN",
+                        vulnerable_ranges=f"<2.{bump}.0",
+                        patched_versions=f"2.{bump}.0"),
+        ]
+
+    def test_two_group_delta_reflattens_only_the_changed_group(self):
+        memo = FlattenMemo()
+        t1 = build_table(self._raw(0), memo=memo)
+        assert (memo.hits, memo.misses) == (0, 2)
+        # daily pull: one group changed, one untouched
+        t2 = build_table(self._raw(1), memo=memo)
+        assert (memo.hits, memo.misses) == (1, 3)
+        # identical to a memo-less flatten, group for group
+        fresh = build_table(self._raw(1))
+        assert t2.content_digest() == fresh.content_digest()
+        assert t2.content_digest() != t1.content_digest()
+        # groups are NOT aliased across builds (mutating one table's
+        # group must never corrupt another's)
+        t3 = build_table(self._raw(1), memo=memo)
+        g2 = next(g for g in t2.groups if g.vuln_id == "CVE-KEEP")
+        g3 = next(g for g in t3.groups if g.vuln_id == "CVE-KEEP")
+        assert g2 is not g3 and g2.rows is not g3.rows
+
+    def test_unchanged_rebuild_is_all_hits_and_identical(self):
+        memo = FlattenMemo()
+        a = build_table(self._raw(0), memo=memo)
+        b = build_table(self._raw(0), memo=memo)
+        assert memo.hits == 2 and memo.misses == 2
+        assert a.content_digest() == b.content_digest()
+
+    def test_bounded_memo_skips_caching_when_full(self):
+        memo = FlattenMemo(max_entries=1)
+        build_table(self._raw(0), memo=memo)
+        build_table(self._raw(0), memo=memo)
+        # one segment cached (hit), one recomputed each build — and
+        # the results stay correct either way
+        assert memo.hits == 1 and memo.misses == 3

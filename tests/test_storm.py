@@ -182,6 +182,28 @@ class TestAcceptance:
         else:
             raise AssertionError("no failover observed in 2 drills")
 
+    def test_fleet_db_swap_memo_faults_c8(self, table):
+        """ISSUE acceptance (graftmemo): a rolling DB upgrade under
+        load on a shared-memo fleet, with the memo backend faulted
+        through the swap window — memo.get/memo.put failures must
+        degrade to plain re-detects (never a 5xx, never a
+        stale-version result), every response must match the oracle
+        its own X-Trivy-DB-Version names, and the skew counter must
+        go quiet once the roll converges (the db_swap_converged
+        invariant)."""
+        sched = Schedule(seed=104, topology="fleet",
+                         horizon_ms=1200.0, events=[
+                             StormEvent(at_ms=40.0, site="memo.get",
+                                        mode="error", dur_ms=600.0),
+                             StormEvent(at_ms=60.0, site="memo.put",
+                                        mode="flaky", arg=0.4,
+                                        seed=11, dur_ms=600.0),
+                             StormEvent(at_ms=200.0, kind="db_swap"),
+                         ])
+        report = run_storm(sched, StormOptions(
+            requests=20, concurrency=8, replicas=2), table=table)
+        assert report.ok, report.violations
+
     def test_generated_schedule_smoke(self, table):
         """A generator-sampled schedule (fixed seed) passes end to end
         — the seeded path the CLI runs in tier-1."""
@@ -558,7 +580,7 @@ class TestDBVersionIdentity:
         router, rstate = serve_router_background(
             "127.0.0.1", 0, [s[2] for s in servers])
         base = f"http://127.0.0.1:{router.server_address[1]}"
-        skew0 = METRICS.get("trivy_tpu_fleet_db_version_skew_total")
+        skew0 = METRICS.family_sum("trivy_tpu_fleet_db_version_skew_total")
         try:
             # one scan keyed to each replica's arc of the ring
             hit = set()
@@ -575,7 +597,7 @@ class TestDBVersionIdentity:
                 if len(hit) == 2:
                     break
             assert len(hit) == 2
-            assert METRICS.get(
+            assert METRICS.family_sum(
                 "trivy_tpu_fleet_db_version_skew_total") > skew0
             versions = rstate.db_versions()
             assert len(set(versions.values())) == 2
@@ -593,20 +615,20 @@ class TestDBVersionIdentity:
 
     def test_agreeing_fleet_never_counts_skew(self, table):
         from trivy_tpu.fleet.router import RouterState
-        skew0 = METRICS.get("trivy_tpu_fleet_db_version_skew_total")
+        skew0 = METRICS.family_sum("trivy_tpu_fleet_db_version_skew_total")
         st = RouterState(["http://a", "http://b"])
         try:
             st.note_db_version("http://a", "sha256:same")
             st.note_db_version("http://b", "sha256:same")
             st.note_db_version("http://a", "sha256:same")
-            assert METRICS.get(
+            assert METRICS.family_sum(
                 "trivy_tpu_fleet_db_version_skew_total") == skew0
             # a rollout flip counts ONCE per observed change
             st.note_db_version("http://b", "sha256:new")
-            assert METRICS.get(
+            assert METRICS.family_sum(
                 "trivy_tpu_fleet_db_version_skew_total") == skew0 + 1
             st.note_db_version("http://b", "sha256:new")
-            assert METRICS.get(
+            assert METRICS.family_sum(
                 "trivy_tpu_fleet_db_version_skew_total") == skew0 + 1
         finally:
             st.close()

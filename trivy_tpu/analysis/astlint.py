@@ -58,9 +58,15 @@ _LOCK_SCOPE = (
     # graftguard: the failpoint registry and breaker are hit from
     # every handler thread plus the watchdog
     os.path.join("trivy_tpu", "resilience") + os.sep,
-    # graftfleet: the ring and replica supervisor are shared across
-    # router handler threads and the readmission loop
+    # graftfleet: the ring, replica supervisor, AND the graftmemo
+    # result store (fleet/memo.py — one MemoStore is shared across
+    # server handler threads and the redetectd sweep) are all
+    # cross-thread state
     os.path.join("trivy_tpu", "fleet") + os.sep,
+    # redetectd: the sweep daemon's status/thread handoff is shared
+    # between handler threads (swap_table/schedule), the sweep
+    # thread, and the drain path
+    os.path.join("trivy_tpu", "detect", "redetect.py"),
     # fanald: the ingest supervisor, byte budget, and pipeline state
     # are shared across walker threads, the analyzer pool, and the
     # watchdog

@@ -326,6 +326,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "admitting (503 + Retry-After), let in-flight "
                         "requests finish for up to this long, then "
                         "close (default 10000)")
+    p.add_argument("--memo-backend", default="off",
+                   help="graftmemo detection-result memo: off "
+                        "(default) | fs | memory | "
+                        "redis://host:port[/db] | s3://bucket/prefix "
+                        "— a shared backend dedupes detect work "
+                        "across the whole fleet, keyed by (blob "
+                        "digest, db_version)")
+    p.add_argument("--redetect-concurrency", type=int, default=2,
+                   help="redetectd: blobs replayed in parallel by the "
+                        "post-swap background sweep (0 disables the "
+                        "daemon; the sweep always yields to queued "
+                        "live traffic; default 2)")
     _add_watch_flags(p)
 
     p = sub.add_parser("router",
@@ -1071,6 +1083,12 @@ def cmd_server(args) -> int:
         raise SystemExit(f"--cache-backend: unknown cache backend "
                          f"{backend!r} (fs | memory | redis://... | "
                          f"s3://...)")
+    from .fleet.memo import known_backend as known_memo_backend
+    memo_backend = getattr(args, "memo_backend", "off")
+    if not known_memo_backend(memo_backend):
+        raise SystemExit(f"--memo-backend: unknown memo backend "
+                         f"{memo_backend!r} (off | fs | memory | "
+                         f"redis://... | s3://...)")
     table = _load_table_args(args)
     host, _, port = args.listen.rpartition(":")
     opts = SchedOptions(
@@ -1089,13 +1107,21 @@ def cmd_server(args) -> int:
                                     1000.0),
         probe_timeout_ms=getattr(args, "mesh_probe_timeout_ms",
                                  5000.0))
+    # graftmemo + redetectd: result memoization keyed by (blob digest,
+    # db_version), with the post-swap background re-detect sweep
+    from .detect.redetect import RedetectOptions
+    redetect_conc = getattr(args, "redetect_concurrency", 2)
+    redetect_opts = RedetectOptions(
+        enabled=redetect_conc > 0,
+        concurrency=max(redetect_conc, 1))
     serve(host or "0.0.0.0", int(port), table, cache_dir=args.cache_dir,
           token=args.token,
           cache_backend=getattr(args, "cache_backend", "fs"),
           trace_path=getattr(args, "trace", ""),
           detect_opts=opts, admission=admission, mesh_opts=mesh_opts,
           drain_grace_s=getattr(args, "drain_grace_ms",
-                                10000.0) / 1e3)
+                                10000.0) / 1e3,
+          memo_backend=memo_backend, redetect_opts=redetect_opts)
     return 0
 
 

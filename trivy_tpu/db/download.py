@@ -190,6 +190,20 @@ def download_db(cache_dir: str, repository: str = DEFAULT_REPO,
     return db_path(cache_dir)
 
 
+# process-lifetime delta-flatten memo (db.table.FlattenMemo): the
+# second flatten in one process (a daily pull hot-swapped into a
+# long-lived server) re-encodes only changed advisories
+_FLATTEN_MEMO = None
+
+
+def _flatten_memo():
+    global _FLATTEN_MEMO
+    if _FLATTEN_MEMO is None:
+        from .table import FlattenMemo
+        _FLATTEN_MEMO = FlattenMemo()
+    return _FLATTEN_MEMO
+
+
 def flatten_db(bolt_path: str, npz_path: Optional[str] = None,
                verbose: bool = False):
     """trivy.db → AdvisoryTable, memoized as an .npz keyed by the bolt
@@ -233,9 +247,13 @@ def flatten_db(bolt_path: str, npz_path: Optional[str] = None,
     t0 = time.time()
     advisories, details, sources = load_boltdb(bolt_path)
     t1 = time.time()
+    # delta-flatten: a long-lived process (the server's daily DB pull
+    # → swap_table path) re-flattens only the advisories whose content
+    # changed; the first flatten populates the memo
     table = build_table(advisories, details,
                         aux={"Red Hat CPE": sources["Red Hat CPE"]}
-                        if "Red Hat CPE" in sources else None)
+                        if "Red Hat CPE" in sources else None,
+                        memo=_flatten_memo())
     t2 = time.time()
     # table.save is write-temp + os.replace, and the stamp lands (also
     # atomically) only AFTER the replace succeeded — a crash anywhere

@@ -248,10 +248,143 @@ def _encode_bound(ecosystem: str, v: Optional[str]):
     return k.tokens, k.exact
 
 
+def _flatten_advisory(adv: RawAdvisory, key_width: int,
+                      pad_row: np.ndarray):
+    """Flatten ONE advisory → (group, rows_out). The expensive part of
+    build_table (constraint parsing + version-token encoding), pure in
+    the advisory's content — which is what makes it delta-memoizable
+    (FlattenMemo)."""
+    g = AdvisoryGroup(
+        source=adv.source, ecosystem=adv.ecosystem,
+        pkg_name=adv.pkg_name, vuln_id=adv.vuln_id,
+        fixed_version=adv.fixed_version or _first_fixed(adv),
+        status=adv.status, severity=adv.severity,
+        data_source=adv.data_source, vendor_ids=adv.vendor_ids,
+        arches=adv.arches, cpe_indices=adv.cpe_indices,
+    )
+    intervals: list[tuple[bool, Interval]] = []
+    raw_fallback = False
+    if adv.vulnerable_ranges:
+        try:
+            for iv in parse_constraint(adv.vulnerable_ranges):
+                intervals.append((True, iv))
+            for spec in (adv.patched_versions,
+                         adv.unaffected_versions):
+                if spec:
+                    for iv in parse_constraint(spec):
+                        intervals.append((False, iv))
+        except ConstraintError:
+            # grammar not interval-representable (caret/tilde/!=/
+            # wildcards/empty member): one catch-all row, exact
+            # host evaluation of the raw spec per pair — NEVER a
+            # silent drop or mangled parse
+            raw_fallback = True
+    else:
+        # OS-style: [affected, fixed) — unfixed when fixed_version == ""
+        intervals.append((True, Interval(
+            lo=adv.affected_version or None, lo_incl=True,
+            hi=adv.fixed_version or None, hi_incl=False)))
+
+    rows_out: list[tuple[np.ndarray, np.ndarray, int]] = []
+    for positive, iv in ([] if raw_fallback else intervals):
+        lo_tok, lo_exact = _encode_bound(adv.ecosystem, iv.lo)
+        hi_tok, hi_exact = _encode_bound(adv.ecosystem, iv.hi)
+        if (iv.lo and lo_tok is None) or (iv.hi and hi_tok is None):
+            # bound string parsed but isn't token-encodable: the
+            # whole advisory goes through the exact host path
+            raw_fallback = bool(adv.vulnerable_ranges)
+            if not raw_fallback:
+                # OS-style: catch-all row, host recheck over g.rows
+                g.rows = [(p, v) for p, v in intervals]
+                rows_out = [(pad_row, pad_row, C.INEXACT)]
+            break
+        flags = 0
+        if iv.lo:
+            flags |= C.HAS_LO | (C.LO_INCL if iv.lo_incl else 0)
+        if iv.hi:
+            flags |= C.HAS_HI | (C.HI_INCL if iv.hi_incl else 0)
+        if not (lo_exact and hi_exact):
+            flags |= C.INEXACT
+        if not positive:
+            flags |= C.NEGATIVE
+        rows_out.append((lo_tok if lo_tok is not None else pad_row,
+                         hi_tok if hi_tok is not None else pad_row,
+                         flags))
+        g.rows.append((positive, iv))
+    if adv.vulnerable_ranges:
+        # language advisories always carry their raw constraint
+        # strings: host rechecks (inexact tokens, npm prerelease
+        # queries) evaluate the reference's IsVulnerable semantics
+        # directly instead of the interval approximation
+        g.raw_specs = (adv.vulnerable_ranges, adv.patched_versions,
+                       adv.unaffected_versions)
+    if raw_fallback:
+        g.rows = []
+        rows_out = [(pad_row, pad_row, C.INEXACT)]
+    return g, rows_out
+
+
+def _adv_content_key(adv: RawAdvisory, key_width: int) -> tuple:
+    """Content identity of one advisory for the flatten memo: every
+    field _flatten_advisory reads, plus the token width."""
+    return (adv.source, adv.ecosystem, adv.pkg_name, adv.vuln_id,
+            adv.fixed_version, adv.affected_version,
+            adv.vulnerable_ranges, adv.patched_versions,
+            adv.unaffected_versions, adv.status, adv.severity,
+            json.dumps(adv.data_source, sort_keys=True)
+            if adv.data_source else "",
+            tuple(adv.vendor_ids), tuple(adv.arches),
+            tuple(adv.cpe_indices), key_width)
+
+
+class FlattenMemo:
+    """Delta-flatten cache: per-advisory flatten segments keyed by
+    advisory content, so a daily trivy-db pull re-flattens only the
+    advisories that actually changed (a typical daily delta is <1% of
+    ~1M advisories; the sort/stack tail still runs over everything,
+    but the parse+encode body — the dominant cost — is skipped for
+    every unchanged group). Segments are reused across builds: each
+    reuse hands out a FRESH AdvisoryGroup (rows list copied) so two
+    tables never alias mutable group state, while the encoded token
+    arrays are shared read-only (build_table copies them into the
+    final columns via np.stack). Thread-safe; bounded — once full, new
+    segments simply aren't cached (no eviction scan on the hot path).
+    """
+
+    def __init__(self, max_entries: int = 1 << 21):
+        import threading
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._segments: dict[tuple, tuple] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def flatten(self, adv: RawAdvisory, key_width: int,
+                pad_row: np.ndarray):
+        key = _adv_content_key(adv, key_width)
+        with self._lock:
+            seg = self._segments.get(key)
+            if seg is not None:
+                self.hits += 1
+        if seg is None:
+            seg = _flatten_advisory(adv, key_width, pad_row)
+            with self._lock:
+                self.misses += 1
+                if len(self._segments) < self.max_entries:
+                    self._segments[key] = seg
+        g0, rows_out = seg
+        import dataclasses
+        return dataclasses.replace(g0, rows=list(g0.rows)), rows_out
+
+
 def build_table(raw: list[RawAdvisory], details: dict | None = None,
                 key_width: int = KEY_WIDTH,
-                aux: dict | None = None) -> AdvisoryTable:
-    """Flatten raw advisories into the sorted columnar table."""
+                aux: dict | None = None,
+                memo: FlattenMemo | None = None) -> AdvisoryTable:
+    """Flatten raw advisories into the sorted columnar table. With
+    `memo`, unchanged advisories reuse their cached flatten segments
+    (delta-flatten); the result is identical either way, and the
+    atomic save semantics (AdvisoryTable.save) are untouched."""
     hash_vals: list[int] = []
     lo_rows: list[np.ndarray] = []
     hi_rows: list[np.ndarray] = []
@@ -261,75 +394,12 @@ def build_table(raw: list[RawAdvisory], details: dict | None = None,
     pad_row = np.full(key_width, 1, dtype=np.int32)  # PAD
 
     for adv in raw:
-        g = AdvisoryGroup(
-            source=adv.source, ecosystem=adv.ecosystem,
-            pkg_name=adv.pkg_name, vuln_id=adv.vuln_id,
-            fixed_version=adv.fixed_version or _first_fixed(adv),
-            status=adv.status, severity=adv.severity,
-            data_source=adv.data_source, vendor_ids=adv.vendor_ids,
-            arches=adv.arches, cpe_indices=adv.cpe_indices,
-        )
-        gid = len(groups)
-        intervals: list[tuple[bool, Interval]] = []
-        raw_fallback = False
-        if adv.vulnerable_ranges:
-            try:
-                for iv in parse_constraint(adv.vulnerable_ranges):
-                    intervals.append((True, iv))
-                for spec in (adv.patched_versions,
-                             adv.unaffected_versions):
-                    if spec:
-                        for iv in parse_constraint(spec):
-                            intervals.append((False, iv))
-            except ConstraintError:
-                # grammar not interval-representable (caret/tilde/!=/
-                # wildcards/empty member): one catch-all row, exact
-                # host evaluation of the raw spec per pair — NEVER a
-                # silent drop or mangled parse
-                raw_fallback = True
+        if memo is not None:
+            g, rows_out = memo.flatten(adv, key_width, pad_row)
         else:
-            # OS-style: [affected, fixed) — unfixed when fixed_version == ""
-            intervals.append((True, Interval(
-                lo=adv.affected_version or None, lo_incl=True,
-                hi=adv.fixed_version or None, hi_incl=False)))
-
+            g, rows_out = _flatten_advisory(adv, key_width, pad_row)
+        gid = len(groups)
         h = key_hash(adv.source, adv.pkg_name)
-        rows_out: list[tuple[np.ndarray, np.ndarray, int]] = []
-        for positive, iv in ([] if raw_fallback else intervals):
-            lo_tok, lo_exact = _encode_bound(adv.ecosystem, iv.lo)
-            hi_tok, hi_exact = _encode_bound(adv.ecosystem, iv.hi)
-            if (iv.lo and lo_tok is None) or (iv.hi and hi_tok is None):
-                # bound string parsed but isn't token-encodable: the
-                # whole advisory goes through the exact host path
-                raw_fallback = bool(adv.vulnerable_ranges)
-                if not raw_fallback:
-                    # OS-style: catch-all row, host recheck over g.rows
-                    g.rows = [(p, v) for p, v in intervals]
-                    rows_out = [(pad_row, pad_row, C.INEXACT)]
-                break
-            flags = 0
-            if iv.lo:
-                flags |= C.HAS_LO | (C.LO_INCL if iv.lo_incl else 0)
-            if iv.hi:
-                flags |= C.HAS_HI | (C.HI_INCL if iv.hi_incl else 0)
-            if not (lo_exact and hi_exact):
-                flags |= C.INEXACT
-            if not positive:
-                flags |= C.NEGATIVE
-            rows_out.append((lo_tok if lo_tok is not None else pad_row,
-                             hi_tok if hi_tok is not None else pad_row,
-                             flags))
-            g.rows.append((positive, iv))
-        if adv.vulnerable_ranges:
-            # language advisories always carry their raw constraint
-            # strings: host rechecks (inexact tokens, npm prerelease
-            # queries) evaluate the reference's IsVulnerable semantics
-            # directly instead of the interval approximation
-            g.raw_specs = (adv.vulnerable_ranges, adv.patched_versions,
-                           adv.unaffected_versions)
-        if raw_fallback:
-            g.rows = []
-            rows_out = [(pad_row, pad_row, C.INEXACT)]
         for lo_tok, hi_tok, flags in rows_out:
             hash_vals.append(h)
             lo_rows.append(lo_tok)

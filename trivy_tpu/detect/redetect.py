@@ -1,0 +1,273 @@
+"""redetectd — the incremental re-detect daemon behind graftmemo.
+
+A DB hot swap used to silently stale the whole fleet: every memoized
+detection result keyed to the old db_version stops being addressed,
+and the first user to rescan each blob pays a cold detect. redetectd
+closes that window from the server side: when swap_table installs a
+table with a NEW content digest, it enqueues a background sweep that
+replays the memo's known BlobInfos through the pure detect path
+(apply_layers → query prep → join — no fanal cost) and publishes
+fresh entries under the new db_version, ideally before the next user
+request arrives.
+
+The sweep is a guest, not a tenant:
+
+  * admission-aware — between blobs it reads the AdmissionQueue
+    snapshot and parks while live traffic is queued (or the active
+    bound is saturated), so it never competes with a user request for
+    a device dispatch window it could have yielded;
+  * supervised but blameless — a blob that fails to replay is counted
+    and skipped; memo faults degrade inside the store (memo.get /
+    memo.put failpoints) and the sweep never charges a breaker for
+    its own faults;
+  * preemptible — a newer swap, a drain, or server close cancels the
+    running sweep between blobs; the sweep aborts itself when it
+    observes the serving db_version moved under it (its entries would
+    be stale-keyed otherwise — they'd never be SERVED, the key
+    includes the version, but the work would be wasted).
+
+Progress is surfaced in /healthz (`memo.sweep`: phase, blobs
+done/total, target db_version) and the `trivy_tpu_redetect_*` series.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import types as T
+from ..log import get as _get_logger
+from ..metrics import METRICS
+from ..obs import span
+
+_log = _get_logger("detect.redetect")
+
+
+@dataclass
+class RedetectOptions:
+    """Server knobs (--redetect-* flags; memo.* config paths)."""
+    enabled: bool = True
+    concurrency: int = 2          # blobs replayed in parallel
+    yield_sleep_ms: float = 20.0  # park interval while traffic waits
+    join_timeout_s: float = 30.0  # cancel/close bound on the sweep
+
+
+class RedetectDaemon:
+    """One per ServerState. `scanner_fn` returns the CURRENT
+    (scanner, db_version) pair under the server lock — the same
+    atomic view the Scan handler stamps responses from."""
+
+    def __init__(self, memo, cache, admission, scanner_fn,
+                 opts: Optional[RedetectOptions] = None, track=None):
+        self.memo = memo
+        self.cache = cache
+        self.admission = admission
+        self.scanner_fn = scanner_fn
+        # (request_started, request_finished) — replays register in
+        # the server's generation tracking exactly like Scan handlers
+        # (register FIRST, then acquire the scanner), so a concurrent
+        # swap_table's drain sees the replay and cannot close its
+        # scanner out from under a mid-flight dispatch
+        self.track = track
+        self.opts = opts or RedetectOptions()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._closed = False
+        self._status = {"phase": "idle", "done": 0, "total": 0,
+                        "db_version": "", "sweeps": 0}
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def schedule(self, db_version: str) -> None:
+        """Kick a sweep toward `db_version`, preempting any running
+        one (only the newest version's entries matter)."""
+        if not self.opts.enabled:
+            return
+        # racing version-changing swaps deliver schedule() calls out
+        # of order: an OLDER swap's late schedule() must not preempt
+        # the sweep toward the version actually being served — the
+        # replacement would instantly abort as stale, leaving NO
+        # sweep toward the live version (the exact window this
+        # daemon exists to close). The serving version is the only
+        # target worth sweeping toward; stand down on mismatch.
+        try:
+            _, cur = self.scanner_fn()
+        except Exception:  # noqa: BLE001 — closing server; moot
+            return
+        if cur != db_version:
+            _log.warning("redetectd: ignoring stale sweep target "
+                         "%.19s... (serving %.19s...)",
+                         db_version, cur)
+            return
+        with self._lock:
+            if self._closed:
+                return
+            old_stop, old_thread = self._stop, self._thread
+            old_stop.set()
+            stop = self._stop = threading.Event()
+            self._status = {"phase": "pending", "done": 0, "total": 0,
+                            "db_version": db_version,
+                            "sweeps": self._status["sweeps"] + 1}
+            t = self._thread = threading.Thread(
+                target=self._sweep, name="redetectd-sweep",
+                args=(db_version, stop, old_thread), daemon=True)
+        t.start()
+
+    def cancel(self) -> None:
+        """Stop the running sweep (drain/SIGTERM cooperation) and wait
+        for it to unwind — bounded, so a wedged replay can't hold the
+        drain hostage."""
+        with self._lock:
+            self._stop.set()
+            t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=self.opts.join_timeout_s)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        self.cancel()
+
+    def status(self) -> dict:
+        with self._lock:
+            return dict(self._status)
+
+    def _set_status(self, **kw) -> None:
+        with self._lock:
+            self._status.update(kw)
+
+    # ---- the sweep -----------------------------------------------------
+
+    def _yield_to_traffic(self, stop: threading.Event) -> None:
+        """Park while live traffic is waiting: the sweep's dispatches
+        ride the same detectd/device path as user scans, so it backs
+        off whenever the admission queue shows queued requests (or a
+        bounded active set at capacity)."""
+        while not stop.is_set():
+            snap = self.admission.snapshot()
+            busy = snap["queued"] > 0 or (
+                snap["max_active"] > 0
+                and snap["active"] >= snap["max_active"])
+            if not busy:
+                return
+            stop.wait(self.opts.yield_sleep_ms / 1e3)
+
+    def _sweep(self, version: str, stop: threading.Event,
+               predecessor: Optional[threading.Thread]) -> None:
+        # one sweep at a time: the superseded sweep stops between
+        # blobs; waiting here keeps "done/total" in /healthz coherent
+        # and bounds the process to one background replay set
+        if predecessor is not None and predecessor.is_alive():
+            predecessor.join(timeout=self.opts.join_timeout_s)
+        if stop.is_set():
+            self._finish(stop, version, "cancelled")
+            return
+        blobs = self.memo.known_blobs()
+        METRICS.inc("trivy_tpu_redetect_sweeps_total")
+        METRICS.set_gauge("trivy_tpu_redetect_active", 1.0)
+        self._set_status(phase="sweeping", done=0, total=len(blobs),
+                         db_version=version)
+        _log.warning("redetectd: sweeping %d memoized blob(s) onto "
+                     "db_version %.19s...", len(blobs), version)
+        done = 0
+        try:
+            from concurrent.futures import ThreadPoolExecutor
+            workers = max(int(self.opts.concurrency), 1)
+            with ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="redetectd") as pool:
+                pending: list = []
+                for blob_id in blobs:
+                    if stop.is_set():
+                        break
+                    self._yield_to_traffic(stop)
+                    if stop.is_set():
+                        break
+                    pending.append(pool.submit(
+                        self._replay_one, blob_id, version, stop))
+                    while len(pending) >= workers:
+                        done += self._harvest(pending.pop(0), stop,
+                                              version)
+                for f in pending:
+                    done += self._harvest(f, stop, version)
+        except Exception:  # noqa: BLE001 — the daemon must not die
+            _log.exception("redetectd: sweep toward %.19s... failed",
+                           version)
+            self._finish(stop, version, "failed", done)
+            return
+        self._finish(
+            stop, version,
+            "cancelled" if stop.is_set() else "done", done)
+
+    def _harvest(self, future, stop, version) -> int:
+        outcome = future.result()
+        METRICS.inc("trivy_tpu_redetect_blobs_total", outcome=outcome)
+        if outcome == "stale":
+            # the serving version moved under the sweep: a newer
+            # schedule() owns the fresh target — stand down
+            stop.set()
+        with self._lock:
+            if self._status.get("db_version") == version:
+                self._status["done"] += 1
+        return 1
+
+    def _finish(self, stop, version, phase, done: int = 0) -> None:
+        with self._lock:
+            mine = self._status.get("db_version") == version
+            if mine:
+                self._status["phase"] = phase
+            running = self._thread is not None \
+                and self._thread is threading.current_thread()
+        if mine or running:
+            METRICS.set_gauge("trivy_tpu_redetect_active", 0.0)
+        if phase != "pending":
+            _log.warning("redetectd: sweep toward %.19s... %s "
+                         "(%d blob(s) visited)", version, phase, done)
+
+    def _replay_one(self, blob_id: str, version: str,
+                    stop: threading.Event) -> str:
+        """Replay one cached BlobInfo through the pure detect path,
+        publishing its memo entry under `version` as a side effect of
+        the (memo-enabled) scan. → outcome label."""
+        if stop.is_set():
+            return "cancelled"
+        try:
+            # skip blobs another replica already refreshed — the whole
+            # point of a shared memo is doing this work once
+            if self.memo.get_entry(blob_id, version):
+                return "fresh"
+            blob = self.cache.get_blob(blob_id)
+            if blob is None:
+                return "missing"
+            if blob.ingest_errors:
+                return "partial"   # annotated partials never memoize
+            # register BEFORE acquiring the scanner (the Scan
+            # handlers' order): a racing swap_table drains this
+            # generation before closing its scanner, so the replay's
+            # dispatch can never run on a closed engine
+            gen = self.track[0]() if self.track else None
+            try:
+                scanner, cur = self.scanner_fn()
+                if cur != version:
+                    return "stale"
+                from ..resilience import GUARD
+                with span("redetect.replay", blob=blob_id[:19]), \
+                        GUARD.blameless():
+                    # blameless: the replay's dispatches still time
+                    # out and degrade, but a slow/wedged sweep can
+                    # never open the detect breaker live traffic
+                    # depends on (and it runs the direct engine path,
+                    # never a merged live detectd dispatch)
+                    scanner.scan_many(
+                        [(blob_id, blob_id, [blob_id])],
+                        T.ScanOptions())
+            finally:
+                if gen is not None:
+                    self.track[1](gen)
+            return "refreshed"
+        except Exception as e:  # noqa: BLE001 — count, never charge
+            _log.warning("redetectd: replay of %.19s... failed "
+                         "(%s: %s)", blob_id, type(e).__name__, e)
+            return "failed"

@@ -121,7 +121,8 @@ class FSCache(MemoryCache):
         from ..resilience import failpoint
         failpoint("cache.backend")
 
-    def _write_atomic(self, path: str, payload: dict) -> None:
+    @staticmethod
+    def _write_atomic(path: str, payload: dict) -> None:
         # same pattern as db/download.py's trivy.db write — the entry
         # appears under its final name only after a complete write —
         # but with a UNIQUE temp name per writer: two handler threads
@@ -142,9 +143,11 @@ class FSCache(MemoryCache):
                 pass   # a crash leaves a stray tmp, never a bad entry
             raise
 
-    def _read_json(self, path: str):
+    @staticmethod
+    def _read_json(path: str):
         """→ decoded JSON, or None (miss) after quarantining a
-        corrupt/truncated entry."""
+        corrupt/truncated entry. Static: graftmemo's FSMemo shares
+        this exact crash-safety contract (and this exact code)."""
         try:
             with open(path) as f:
                 return json.load(f)

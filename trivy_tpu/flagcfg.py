@@ -79,6 +79,9 @@ CONFIG_PATHS = {
     "ingest_max_members": "ingest.max-members",
     "ingest_layer_deadline_ms": "ingest.layer-deadline-ms",
     "ingest_max_inflight_bytes": "ingest.max-inflight-bytes",
+    # graftmemo (memo.*): detection-result memoization + redetectd
+    "memo_backend": "memo.backend",
+    "redetect_concurrency": "memo.redetect-concurrency",
 }
 
 _TRUE = {"1", "t", "true", "yes", "on"}

@@ -24,14 +24,23 @@ The router is stateless by design: replicas share per-layer analysis
 through a common cache backend (fanal redis/s3 behind the FSCache
 interface), so a layer analyzed by one replica is a cache hit on all
 of them and a failover Scan finds its blobs wherever it lands.
+
+graftmemo (memo.py) extends the same sharing to detection RESULTS:
+a content-addressed memo keyed by (blob digest, db_version) means a
+layer detected by any replica is detected once per DB version
+fleet-wide — the first subsystem that makes the fleet cheaper as it
+scales, not merely faster. Its re-detect daemon lives in
+detect/redetect.py (it is a detect-path consumer, not a fleet one).
 """
 
+from .memo import FSMemo, MemoryMemo, MemoStore, open_memo
 from .ring import HashRing
 from .router import (RouterOptions, RouterState, serve_router,
                      serve_router_background)
 from .supervisor import ReplicaOptions, ReplicaSet
 
 __all__ = [
-    "HashRing", "ReplicaOptions", "ReplicaSet", "RouterOptions",
-    "RouterState", "serve_router", "serve_router_background",
+    "FSMemo", "HashRing", "MemoStore", "MemoryMemo", "open_memo",
+    "ReplicaOptions", "ReplicaSet", "RouterOptions", "RouterState",
+    "serve_router", "serve_router_background",
 ]

@@ -1,0 +1,525 @@
+"""graftmemo — content-addressed detection-result memoization.
+
+At fleet scale most scan traffic is duplicate work: images share base
+layers, and a trivy-db pull only changes the answer for (blob, db)
+pairs whose inputs actually changed. The fanal cache (PR 6) already
+dedupes layer *analysis* fleet-wide; this tier dedupes the *detect*
+step — the device join — the same way:
+
+  key      (blob cache id, advisory-table content digest). The blob id
+           is already content+analyzer-version addressed
+           (fanal.cache.cache_key), and the db_version is
+           AdvisoryTable.content_digest() (PR 8), so an entry can
+           never be served across a DB hot swap: old-version entries
+           simply stop being addressed.
+  value    per scan UNIT (the OS query batch, or one application's
+           query batch) the list of detected hits, each serialized as
+           (query index, advisory-group report fields). Hits are
+           stored pre-`finish`: replay rebuilds engine Hit tuples
+           against the CURRENT scan's fresh PkgQuery objects, so layer
+           attribution, FillInfo, sorting — everything downstream —
+           runs exactly as it would after a live device join. Bit
+           identity holds by construction, not by hope.
+  guard    every unit entry carries a digest of its canonical query
+           batch (source, ecosystem, name, version, arch, cpe scope,
+           in order). Replay requires an exact digest match, so unit
+           attribution (below) only has to be SAFE, never clever — a
+           wrong attribution can only cause a miss, never a wrong
+           result.
+
+Attribution: a unit is memoizable under blob B iff everything that
+feeds its queries traces to B alone — for an application unit, every
+package's origin layer is B; for the OS unit, every merged package,
+the OS detection, and the repository hint all come from B. Partial
+(fanald-annotated) blobs are never memoized: their salted cache ids
+churn by design and their content is a degradation, not the layer.
+
+Backends mirror fanal.cache.open_cache — fs (default), memory,
+redis://, s3:// — with the same crash-safe atomic writes and
+corrupt-entry quarantine semantics (PR 5/6), and the same
+already-open-object passthrough so an in-process fleet shares one
+MemoryMemo across N replicas. A memo backend fault (the `memo.get` /
+`memo.put` failpoints, a dead redis, a full disk) degrades to a plain
+re-detect — never a 5xx, never a stale-version result.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Optional
+
+from ..log import get as _get_logger
+from ..metrics import METRICS
+
+_log = _get_logger("fleet.memo")
+
+MEMO_SCHEMA = 1
+
+
+def known_backend(backend: str) -> bool:
+    """Is `backend` a spelling open_memo accepts? ("" / "off" =
+    disabled; the rest mirrors fanal.cache.known_backend.)"""
+    return backend in ("", "off", "fs", "memory") \
+        or backend.startswith(("redis://", "s3://"))
+
+
+def open_memo(backend, cache_dir: str = ""):
+    """Backend selection for the result memo, mirroring
+    fanal.cache.open_cache. "" or "off" → None (memoization
+    disabled); an already-open memo OBJECT passes through unchanged
+    (in-process fleets share one MemoryMemo across replicas)."""
+    if not isinstance(backend, str):
+        return backend
+    if backend in ("", "off"):
+        return None
+    if backend.startswith("redis://"):
+        return RedisMemo(backend)
+    if backend.startswith("s3://"):
+        return S3Memo(backend)
+    if backend == "memory":
+        return MemoryMemo()
+    if backend == "fs":
+        return FSMemo(cache_dir)
+    raise ValueError(f"unknown memo backend {backend!r} "
+                     "(off | fs | memory | redis://... | s3://...)")
+
+
+def entry_key(blob_id: str, db_version: str) -> str:
+    """One flat key per (blob, db_version) — filesystem/redis/s3 safe."""
+    h = hashlib.sha256(f"{blob_id}|{db_version}".encode()).hexdigest()
+    return f"memo-{h}"
+
+
+def query_digest(queries) -> str:
+    """Canonical digest of one unit's query batch. Covers everything
+    the join + assembly read from a query (source bucket, version
+    scheme, join name, version string, arch scope, CPE scope) in
+    batch order — so replay is valid iff the stored hits answer
+    EXACTLY this batch."""
+    doc = [[q.source, q.ecosystem, q.name, q.version, q.arch,
+            sorted(q.cpe_indices)] for q in queries]
+    return hashlib.sha256(json.dumps(
+        doc, separators=(",", ":")).encode()).hexdigest()
+
+
+def encode_hits(queries, hits) -> Optional[list]:
+    """Serialize engine Hits for one unit: (query index, group report
+    fields). → None when a hit's query is not in the batch (defensive;
+    the engine only ever reports input queries)."""
+    index = {id(q): i for i, q in enumerate(queries)}
+    out = []
+    for h in hits:
+        qi = index.get(id(h.query))
+        if qi is None:
+            return None
+        out.append([qi, h.vuln_id, h.fixed_version, h.status,
+                    h.severity, h.data_source, list(h.vendor_ids)])
+    return out
+
+
+def decode_hits(queries, doc: list):
+    """Rebuild Hit tuples against THIS scan's fresh query objects.
+    → None when the stored document doesn't line up (treated as a
+    miss by the caller)."""
+    from ..detect.engine import Hit
+    hits = []
+    try:
+        for qi, vuln_id, fixed, status, severity, ds, vids in doc:
+            if not isinstance(qi, int) or qi < 0:
+                # a negative index would silently wrap to the END of
+                # the batch (valid Python!) and attribute the hit to
+                # the wrong package — corrupt-but-parseable entries
+                # must be a MISS, never a wrong result
+                return None
+            hits.append(Hit(
+                query=queries[qi], vuln_id=vuln_id,
+                fixed_version=fixed, status=status, severity=severity,
+                data_source=ds, vendor_ids=tuple(vids)))
+    except (IndexError, TypeError, ValueError):
+        return None
+    return hits
+
+
+# ---------------------------------------------------------------------------
+# unit attribution
+
+
+def unit_key(unit) -> str:
+    """Stable name for one scan unit inside a blob's entry."""
+    if unit == "os":
+        return "os"
+    return f"app:{unit.type}:{unit.file_path}"
+
+
+def blob_index(blobs, blob_ids) -> dict:
+    """diff_id → blob cache id, for blobs eligible for memoization
+    (complete, diff-identified, unambiguous). Partial blobs (fanald
+    annotations) are excluded here, which excludes every unit that
+    touches them."""
+    out: dict = {}
+    for blob, bid in zip(blobs, blob_ids):
+        if not blob.diff_id or blob.ingest_errors:
+            continue
+        if blob.diff_id in out:
+            out[blob.diff_id] = None   # ambiguous: two blobs, one diff
+        else:
+            out[blob.diff_id] = bid
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def unit_blob(unit, detail, blobs, index: dict) -> Optional[str]:
+    """→ the blob cache id this unit is fully attributable to, or
+    None (run the plain detect path). Conservative by design: the
+    query-digest guard makes a missed attribution cost a memo miss,
+    never a wrong result."""
+    if unit == "os":
+        pkg_diffs = {p.layer.diff_id for p in detail.packages}
+        os_diffs = {b.diff_id for b in blobs if b.os.detected}
+        repo_diffs = {b.diff_id for b in blobs
+                      if b.repository is not None}
+        cands = pkg_diffs or os_diffs
+        if len(cands) != 1:
+            return None
+        (diff,) = cands
+        if os_diffs != {diff} or not repo_diffs <= {diff}:
+            return None
+        return index.get(diff)
+    diffs = {p.layer.diff_id for p in unit.packages}
+    if len(diffs) != 1:
+        return None
+    (diff,) = diffs
+    return index.get(diff)
+
+
+# ---------------------------------------------------------------------------
+# the store
+
+
+class MemoStore:
+    """Shared surface over one KV backend: entry read/merge-write with
+    failpoint-gated degradation, per-key stats, and the known-blob
+    registry redetectd sweeps. Subclasses implement `_read`/`_write`
+    (and may override `_known_seed` to recover ids from a persistent
+    backend). Thread-safe: one store is shared across server handler
+    threads and the redetectd sweep."""
+
+    backend = "memory"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # blob ids this process has stored or served — the redetectd
+        # sweep's working set (a restarted replica re-learns it from
+        # traffic; fs backends also re-seed from the entry dir)
+        self._known: dict[str, None] = {}
+        # per-(blob, db_version) hit/store counts: the acceptance
+        # drill's probe ("the base layer's detect ran once fleet-wide")
+        self._key_stats: dict[tuple, dict] = {}
+
+    # -- backend contract ------------------------------------------------
+
+    def _read(self, key: str):
+        raise NotImplementedError
+
+    def _write(self, key: str, doc: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    # -- failpoint-gated, degrading IO ----------------------------------
+
+    @staticmethod
+    def _failpoint(site: str) -> None:
+        from ..resilience import failpoint
+        failpoint(site)
+
+    def get_entry(self, blob_id: str, db_version: str
+                  ) -> Optional[dict]:
+        """→ the (blob, db_version) entry document, or None. A backend
+        fault is a miss — the scan re-detects; it must never 5xx or
+        serve another version's entry."""
+        try:
+            self._failpoint("memo.get")
+            doc = self._read(entry_key(blob_id, db_version))
+        except Exception as e:  # noqa: BLE001 — degrade, never fail
+            _log.warning("memo get degraded to a miss (%s: %s)",
+                         type(e).__name__, e)
+            return None
+        if doc is None:
+            return None
+        if doc.get("schema") != MEMO_SCHEMA \
+                or doc.get("db_version") != db_version:
+            return None   # foreign schema / hash collision paranoia
+        with self._lock:
+            self._known.setdefault(blob_id, None)
+        return doc
+
+    def put_units(self, blob_id: str, db_version: str,
+                  units: dict[str, dict]) -> int:
+        """Merge `units` into the (blob, db_version) entry
+        (read-modify-write; concurrent writers last-win per entry,
+        which is safe because unit values are deterministic functions
+        of the key). → units actually written (0 on a degraded
+        backend)."""
+        if not units:
+            return 0
+        try:
+            self._failpoint("memo.put")
+            key = entry_key(blob_id, db_version)
+            doc = self._read(key)
+            if not isinstance(doc, dict) \
+                    or doc.get("schema") != MEMO_SCHEMA \
+                    or doc.get("db_version") != db_version:
+                doc = {"schema": MEMO_SCHEMA, "blob_id": blob_id,
+                       "db_version": db_version, "units": {}}
+            fresh = {k: v for k, v in units.items()
+                     if doc["units"].get(k) != v}
+            if not fresh:
+                return 0
+            doc["units"].update(fresh)
+            self._write(key, doc)
+        except Exception as e:  # noqa: BLE001 — degrade, never fail
+            _log.warning("memo put dropped (%s: %s)",
+                         type(e).__name__, e)
+            return 0
+        n = len(fresh)
+        with self._lock:
+            self._known.setdefault(blob_id, None)
+            st = self._key_stats.setdefault(
+                (blob_id, db_version), {"hits": 0, "stores": 0})
+            st["stores"] += n
+        METRICS.inc("trivy_tpu_memo_stores_total", n,
+                    backend=self.backend)
+        return n
+
+    # -- accounting (MemoSession calls these per unit) -------------------
+
+    def note_hit(self, blob_id: str, db_version: str) -> None:
+        with self._lock:
+            st = self._key_stats.setdefault(
+                (blob_id, db_version), {"hits": 0, "stores": 0})
+            st["hits"] += 1
+        METRICS.inc("trivy_tpu_memo_hits_total", backend=self.backend)
+
+    def note_miss(self) -> None:
+        METRICS.inc("trivy_tpu_memo_misses_total",
+                    backend=self.backend)
+
+    def key_stats(self, blob_id: str, db_version: str) -> dict:
+        with self._lock:
+            return dict(self._key_stats.get(
+                (blob_id, db_version)) or {"hits": 0, "stores": 0})
+
+    # -- redetectd surface ----------------------------------------------
+
+    def known_blobs(self) -> list[str]:
+        with self._lock:
+            return list(self._known)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"backend": self.backend,
+                    "known_blobs": len(self._known)}
+
+
+class MemoryMemo(MemoStore):
+    """In-process backend: tests, ephemeral scans, and the in-process
+    fleet topologies (one object shared across N replicas)."""
+
+    backend = "memory"
+
+    def __init__(self):
+        super().__init__()
+        self._docs: dict[str, str] = {}
+
+    def _read(self, key: str):
+        with self._lock:
+            raw = self._docs.get(key)
+        return None if raw is None else json.loads(raw)
+
+    def _write(self, key: str, doc: dict) -> None:
+        raw = json.dumps(doc)
+        with self._lock:
+            self._docs[key] = raw
+
+
+class FSMemo(MemoStore):
+    """JSON-file-per-entry store under <root>/memo/ with the FSCache
+    crash-safety contract — literally: reads and writes go through
+    FSCache's `_read_json` (corrupt-entry quarantine to *.corrupt,
+    miss on any fault) and `_write_atomic` (unique-temp-name atomic
+    writes; a kill mid-put leaves a stray .tmp, never a truncated
+    entry)."""
+
+    backend = "fs"
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.root = os.path.join(root or ".", "memo")
+        os.makedirs(self.root, exist_ok=True)
+        # the known-blob registry re-seeds LAZILY from surviving
+        # entries (first known_blobs() call — i.e. the first sweep),
+        # so a restarted replica's sweep still covers yesterday's
+        # working set WITHOUT serve() paying an O(total memo bytes)
+        # startup scan just to recover blob ids
+        self._seeded = False
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key + ".json")
+
+    def _read(self, key: str):
+        from ..fanal.cache import FSCache
+        return FSCache._read_json(self._path(key))
+
+    def _write(self, key: str, doc: dict) -> None:
+        from ..fanal.cache import FSCache
+        FSCache._write_atomic(self._path(key), doc)
+
+    def known_blobs(self) -> list[str]:
+        with self._lock:
+            seeded, self._seeded = self._seeded, True
+        if not seeded:
+            from ..fanal.cache import FSCache
+            for name in sorted(os.listdir(self.root)):
+                if not name.endswith(".json"):
+                    continue
+                doc = FSCache._read_json(
+                    os.path.join(self.root, name))
+                if isinstance(doc, dict) and doc.get("blob_id"):
+                    with self._lock:
+                        self._known.setdefault(doc["blob_id"], None)
+        return super().known_blobs()
+
+
+class RedisMemo(MemoStore):
+    """Shared fleet backend over the fanal RespClient. Entries live
+    under their own `memo::` prefix so fanal's Clear/scan never
+    touches them; corrupt entries quarantine with the PR 8
+    read-compare-rename so a racing re-put keeps its fresh value."""
+
+    backend = "redis"
+
+    def __init__(self, url: str):
+        super().__init__()
+        from urllib.parse import urlparse
+
+        from ..fanal.redis_cache import RespClient
+        u = urlparse(url)
+        db = 0
+        if u.path and u.path.strip("/").isdigit():
+            db = int(u.path.strip("/"))
+        self.client = RespClient(u.hostname or "localhost",
+                                 u.port or 6379,
+                                 password=u.password or "", db=db)
+
+    def close(self) -> None:
+        self.client.close()
+
+    @staticmethod
+    def _rkey(key: str) -> str:
+        return f"memo::{key}"
+
+    def _read(self, key: str):
+        raw = self.client.command("GET", self._rkey(key))
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError):
+            from ..fanal.redis_cache import RedisError
+            quarantine = f"memo::corrupt::{key}"
+            try:
+                self.client.rename_if_value(self._rkey(key), raw,
+                                            quarantine)
+            except RedisError:
+                pass
+            _log.warning("quarantined corrupt memo entry %s "
+                         "(serving a miss)", key)
+            return None
+
+    def _write(self, key: str, doc: dict) -> None:
+        self.client.command("SET", self._rkey(key), json.dumps(doc))
+
+
+class S3Memo(MemoStore):
+    """Shared fleet backend over the fanal S3 client; entries live
+    under a `memo/` key prefix next to fanal's."""
+
+    backend = "s3"
+
+    def __init__(self, url: str):
+        super().__init__()
+        from ..fanal.s3_cache import S3Cache
+        self._s3 = S3Cache(url)
+
+    def _read(self, key: str):
+        return self._s3._get("memo", key)
+
+    def _write(self, key: str, doc: dict) -> None:
+        self._s3._put("memo", key, doc)
+
+
+# ---------------------------------------------------------------------------
+# per-scan session (the scanner drives this)
+
+
+class MemoSession:
+    """One scan_many call's memo view: entry reads are cached per
+    blob, replays are resolved per unit, and stores are batched into
+    one merge-write per blob at flush()."""
+
+    def __init__(self, memo: MemoStore, db_version: str):
+        self.memo = memo
+        self.db_version = db_version
+        self._entries: dict[str, Optional[dict]] = {}
+        self._stores: dict[str, dict[str, dict]] = {}
+        self.replays = 0
+
+    def _entry(self, blob_id: str) -> Optional[dict]:
+        if blob_id not in self._entries:
+            self._entries[blob_id] = self.memo.get_entry(
+                blob_id, self.db_version)
+        return self._entries[blob_id]
+
+    def consult(self, unit, queries, detail, blobs, blob_ids):
+        """→ (hits | None, store_token | None). hits non-None means
+        the unit replays from the memo (skip its dispatch);
+        store_token non-None means the unit is attributable and its
+        live result should be recorded via record()."""
+        if not queries:
+            return None, None
+        bid = unit_blob(unit, detail, blobs,
+                        blob_index(blobs, blob_ids))
+        if bid is None:
+            return None, None
+        ukey = unit_key(unit)
+        qd = query_digest(queries)
+        entry = self._entry(bid)
+        stored = (entry or {}).get("units", {}).get(ukey)
+        if stored is not None and stored.get("q") == qd:
+            hits = decode_hits(queries, stored.get("hits") or [])
+            if hits is not None:
+                self.memo.note_hit(bid, self.db_version)
+                self.replays += 1
+                return hits, None
+        self.memo.note_miss()
+        return None, (bid, ukey, qd, queries)
+
+    def record(self, token, hits) -> None:
+        """Queue one live unit result for the flush merge-write."""
+        bid, ukey, qd, queries = token
+        doc = encode_hits(queries, hits)
+        if doc is None:
+            return
+        self._stores.setdefault(bid, {})[ukey] = {"q": qd,
+                                                  "hits": doc}
+
+    def flush(self) -> int:
+        n = 0
+        for bid, units in self._stores.items():
+            n += self.memo.put_units(bid, self.db_version, units)
+        self._stores.clear()
+        return n

@@ -130,7 +130,14 @@ class RouterState:
             skewed = len(set(self._db_versions.values())) > 1
             snap = dict(self._db_versions)
         if skewed:
-            METRICS.inc("trivy_tpu_fleet_db_version_skew_total")
+            # label with WHICH versions disagree (sorted short
+            # digests): a rolling upgrade reads as one transient pair,
+            # a split brain as the same pair climbing forever — the
+            # unlabeled rate alone cannot tell them apart
+            METRICS.inc(
+                "trivy_tpu_fleet_db_version_skew_total",
+                versions="|".join(sorted(
+                    v[:19] for v in set(snap.values()))))
             _log.warning(
                 "fleet: advisory-DB version skew — replicas disagree "
                 "(%s); failovers are NOT bit-identical until the "

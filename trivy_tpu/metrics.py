@@ -112,6 +112,14 @@ class Registry:
                 return self._gauges[key]
             return self._values.get(key, 0.0)
 
+    def family_sum(self, name: str) -> float:
+        """Sum one counter family across every label set — the read
+        the fleet skew/memo probes want ("did ANY labeled series
+        move"), which a labeled `get` cannot answer."""
+        with self._lock:
+            return sum(v for (n, _labels), v in self._values.items()
+                       if n == name)
+
     def hist_get(self, name: str, **labels) -> tuple[list, float, int]:
         """→ (bucket_counts, sum, count) for one histogram series."""
         key = (name, tuple(sorted(labels.items())))
@@ -316,7 +324,10 @@ METRICS.declare(
     "Observed advisory-DB version changes that left the fleet's "
     "replicas disagreeing (relayed X-Trivy-DB-Version headers and "
     "readmission probes feed it) — while nonzero-rate, failovers are "
-    "not bit-identical.")
+    "not bit-identical. The versions label names the disagreeing "
+    "digests (sorted, truncated, |-joined), so a rolling upgrade's "
+    "transient skew is distinguishable from a split-brain pair that "
+    "never converges.")
 METRICS.declare(
     "trivy_tpu_fleet_cache_hits_total", "counter",
     "Layer-cache blob hits by backend (backend=\"fs\"/\"redis\"/"
@@ -372,6 +383,34 @@ METRICS.declare(
     "trivy_tpu_ingest_analyze_depth", "gauge",
     "fanald analyzer batches currently dispatched or queued on the "
     "analyzer pool.")
+METRICS.declare(
+    "trivy_tpu_memo_hits_total", "counter",
+    "graftmemo detection-result memo: scan units (one OS or "
+    "application query batch) served from a memoized (blob digest, "
+    "db_version) entry instead of a device detect, by backend "
+    "(backend=\"fs\"/\"memory\"/\"redis\"/\"s3\").")
+METRICS.declare(
+    "trivy_tpu_memo_misses_total", "counter",
+    "graftmemo lookups for an attributable scan unit that found no "
+    "matching entry (cold blob, new db_version, query drift, or a "
+    "degraded memo backend) — the unit ran the plain detect path.")
+METRICS.declare(
+    "trivy_tpu_memo_stores_total", "counter",
+    "graftmemo unit results published to the memo after a plain "
+    "detect (partial/annotated blobs are never stored).")
+METRICS.declare(
+    "trivy_tpu_redetect_sweeps_total", "counter",
+    "redetectd background sweeps started (one per DB hot swap that "
+    "changed the advisory-table digest).")
+METRICS.declare(
+    "trivy_tpu_redetect_blobs_total", "counter",
+    "Blobs visited by redetectd sweeps, by outcome "
+    "(outcome=\"refreshed\"/\"fresh\"/\"missing\"/\"partial\"/"
+    "\"stale\"/\"cancelled\"/\"failed\").")
+METRICS.declare(
+    "trivy_tpu_redetect_active", "gauge",
+    "redetectd sweep state: 1 while a background re-detect sweep is "
+    "running, 0 otherwise.")
 METRICS.declare("trivy_tpu_secret_files_total", "counter",
                 "Files through the secret scanner.")
 METRICS.declare("trivy_tpu_secret_bytes_total", "counter",

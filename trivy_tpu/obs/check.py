@@ -183,7 +183,8 @@ def check_storm_replay(doc: dict) -> list[str]:
                     continue
                 kind = ev.get("kind", "failpoint")
                 if kind not in ("failpoint", "kill_replica",
-                                "swap_table", "hostile_layer"):
+                                "swap_table", "db_swap",
+                                "hostile_layer"):
                     problems.append(
                         f"events[{i}]: unknown kind {kind!r}")
                 if kind == "hostile_layer" and \

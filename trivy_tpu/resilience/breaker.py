@@ -249,13 +249,19 @@ class CircuitBreaker:
 
 
 class _WatchToken:
-    __slots__ = ("site", "deadline", "expired", "breaker", "trace_id")
+    __slots__ = ("site", "deadline", "expired", "breaker", "trace_id",
+                 "blameless")
 
     def __init__(self, site: str, deadline: Deadline,
-                 breaker: CircuitBreaker):
+                 breaker: CircuitBreaker, blameless: bool = False):
         self.site = site
         self.deadline = deadline
         self.expired = False
+        # a blameless watch (GUARD.blameless(): redetectd's background
+        # replays) still expires — the CALLER gets its DeviceTimeout
+        # and degrades — but never charges the breaker: background
+        # work must not open a domain that live traffic depends on
+        self.blameless = blameless
         # the breaker this watch charges: GUARD.breaker for backend-
         # level sites, a meshguard per-device breaker for the
         # detect.mesh:<id> site family — expiry must trip the DEVICE's
@@ -294,16 +300,21 @@ class _Watch:
                 # fallback swallow a Ctrl-C), and they say nothing
                 # about device health — no breaker accounting
                 return False
-            self._tok.breaker.record_failure()
+            if not self._tok.blameless:
+                self._tok.breaker.record_failure()
             raise DeviceError(
                 f"{self._tok.site}: {type(exc).__name__}: {exc}") \
                 from exc
         if self._tok.expired:
-            # the watchdog already tripped the breaker; surface the
-            # timeout to THIS caller so it recomputes on the host
+            # the watchdog already tripped the breaker (unless the
+            # watch was blameless); surface the timeout to THIS caller
+            # so it recomputes on the host
             raise DeviceTimeout(
                 f"{self._tok.site}: exceeded watchdog deadline")
-        if self._record_success:
+        if self._record_success and not self._tok.blameless:
+            # blameless successes record nothing either: a half-open
+            # breaker must re-close on LIVE evidence, not on a
+            # background replay's luck
             self._tok.breaker.record_success()
         return False
 
@@ -322,6 +333,9 @@ class DeviceGuard:
             gauge="trivy_tpu_detect_breaker_state")
         self.dispatch_timeout_s = 120.0   # generous: compiles are slow
         self._tokens: list[_WatchToken] = []
+        # thread-local blameless depth: watches armed by a thread
+        # inside GUARD.blameless() never charge a breaker
+        self._blameless = threading.local()
         self._last_sweep = 0.0
         self._next_wake = 0.0   # when the watchdog's current wait ends
         # started eagerly (not on first watch): tests that snapshot
@@ -343,7 +357,34 @@ class DeviceGuard:
 
     # ---- hot-path surface ---------------------------------------------
 
+    @contextlib.contextmanager
+    def blameless(self):
+        """Mark every watch armed by THIS thread inside the block as
+        blameless: deadlines still expire (the caller gets its
+        DeviceTimeout and degrades) but nothing is charged to any
+        breaker — success, failure, or watchdog trip. For supervised
+        BACKGROUND work (redetectd's replay sweeps) whose faults must
+        never open a domain live traffic depends on."""
+        depth = getattr(self._blameless, "depth", 0)
+        self._blameless.depth = depth + 1
+        try:
+            yield
+        finally:
+            self._blameless.depth = depth
+
+    def blameless_active(self) -> bool:
+        return getattr(self._blameless, "depth", 0) > 0
+
     def allow_device(self) -> bool:
+        # blameless work gets the device only while the breaker is
+        # fully closed — a read, never allow(): a background replay
+        # must not consume the half-open probe slot (its success
+        # records nothing, so the probe would never resolve and the
+        # breaker would latch half-open against LIVE traffic) nor
+        # advance open→half-open. Degraded blameless work host-joins,
+        # which is bit-identical anyway.
+        if self.blameless_active():
+            return self.breaker.state_name() == "closed"
         return self.breaker.allow()
 
     def record_success(self) -> None:
@@ -376,7 +417,8 @@ class DeviceGuard:
         tok = _WatchToken(
             site, Deadline(timeout_s if timeout_s is not None
                            else self.dispatch_timeout_s),
-            breaker if breaker is not None else self.breaker)
+            breaker if breaker is not None else self.breaker,
+            blameless=self.blameless_active())
         with self._cv:
             self._tokens.append(tok)
             # wake the watchdog only when this deadline lands before
@@ -417,7 +459,9 @@ class DeviceGuard:
                         from ..obs.trace import new_trace
                         stack.enter_context(new_trace(t.trace_id))
                     _log.warning("watchdog: %s outlived its deadline; "
-                                 "tripping breaker", t.site)
+                                 "%s", t.site,
+                                 "blameless — breaker not charged"
+                                 if t.blameless else "tripping breaker")
                     try:
                         from ..obs.recorder import RECORDER
                         RECORDER.note_event("watchdog_trip",
@@ -428,7 +472,11 @@ class DeviceGuard:
                     # each token carries its own breaker: a
                     # detect.mesh:<id> expiry trips that device's
                     # fault domain, everything else trips the backend
-                    t.breaker.trip()
+                    # — unless the watch is blameless (a background
+                    # replay's wedge says nothing live traffic should
+                    # pay for; the caller still gets DeviceTimeout)
+                    if not t.blameless:
+                        t.breaker.trip()
             with self._cv:
                 wait = 0.25 if nearest is None \
                     else max(min(nearest, 0.25), 0.001)

@@ -49,6 +49,8 @@ SITES = (
     "db.download",        # db/download.py OCI artifact pull
     "fanal.walk",         # fanal/pipeline.py per-layer walker stage
     "fanal.analyze",      # fanal/pipeline.py analyzer-batch stage
+    "memo.get",           # fleet/memo.py result-memo reads (graftmemo)
+    "memo.put",           # fleet/memo.py result-memo writes
 )
 
 # site FAMILIES: a family member is `<family>:<instance>` (e.g.

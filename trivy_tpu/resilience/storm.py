@@ -54,6 +54,7 @@ from dataclasses import asdict, dataclass, field, replace
 
 from ..log import get as _get_logger
 from ..metrics import METRICS
+from ..server import DB_VERSION_HEADER
 from .breaker import GUARD
 from .failpoints import FAILPOINTS
 
@@ -80,6 +81,14 @@ _FLEET_FAULTS = (
     ("rpc.route", "error"), ("rpc.route", "flaky"),
     ("rpc.route", "slow"), ("rpc.scan", "error"),
     ("rpc.scan", "flaky"),
+)
+# graftmemo faults (fleet topology, where the shared result memo
+# lives): a memo backend down must degrade to a plain re-detect —
+# never a 5xx, never a stale-version result (the bit-identity and
+# db_swap invariants would both catch the latter)
+_MEMO_FAULTS = (
+    ("memo.get", "error"), ("memo.get", "flaky"),
+    ("memo.put", "error"), ("memo.put", "flaky"),
 )
 # fanald ingest faults (ingest topology only): the pipeline absorbs
 # every one as an annotated partial result — plus the hostile_layer
@@ -111,7 +120,15 @@ class StormEvent:
       kill_replica  shut replica `replica` down at at_ms, restart it on
                     the same port at at_ms+dur_ms (fleet only).
       swap_table    trigger a DB hot swap through the generation drain
-                    on replica `replica` (0 outside fleet).
+                    on replica `replica` (0 outside fleet). Same table
+                    content — the drill is the drain, not the data.
+      db_swap       rolling advisory-DB UPGRADE: every server state
+                    hot-swaps to the alternate table (different
+                    content digest) in slot order while load flows —
+                    redetectd sweeps the shared memo, responses must
+                    match whichever oracle their X-Trivy-DB-Version
+                    names, and the router's skew counter must go
+                    quiet once the roll converges.
       hostile_layer (ingest only) scans issued in the window use the
                     `variant` hostile artifact (truncated gzip layer
                     or decompression bomb) instead of the clean one —
@@ -174,8 +191,8 @@ def generate_schedule(seed: int, topology: str, n_events: int = 4,
     if topology == "mesh":
         menu += list(_MESH_FAULTS) * 2     # mesh domains get airtime
     if topology == "fleet":
-        menu += list(_FLEET_FAULTS)
-        kinds += ["kill_replica"] * 2
+        menu += list(_FLEET_FAULTS) + list(_MEMO_FAULTS)
+        kinds += ["kill_replica"] * 2 + ["db_swap"]
     if topology == "ingest":
         # ingest drills the fanald pipeline: stage faults plus
         # hostile-artifact windows; the device-side menu is replaced
@@ -207,6 +224,10 @@ def generate_schedule(seed: int, topology: str, n_events: int = 4,
                 at_ms=round(at, 1), kind="swap_table",
                 replica=rng.randrange(max(replicas, 1))
                 if topology == "fleet" else 0))
+            continue
+        if kind == "db_swap":
+            events.append(StormEvent(at_ms=round(at, 1),
+                                     kind="db_swap"))
             continue
         # one spec per site at a time: overlapping arms on one site
         # would overwrite each other and confuse minimization
@@ -266,6 +287,14 @@ def storm_table(n_pkgs: int = 16, seed: int = 604):
     return build_table(raw, details)
 
 
+def alt_storm_table():
+    """The db_swap event's upgrade target: same package namespace,
+    different seeded advisory bounds — a DIFFERENT content digest
+    whose scan results genuinely differ from storm_table()'s, so the
+    post-swap oracle actually discriminates."""
+    return storm_table(seed=605)
+
+
 def request_doc(load_seed: int, idx: int, n_pkgs: int = 16) -> dict:
     """The idx-th scan request of a seeded load: a blob document whose
     DiffID doubles as the artifact id (PutBlob and Scan key to the
@@ -322,6 +351,11 @@ class Outcome:
     # (a deterministic partial result) — excluded from the oracle
     # bit-identity probe, held to the annotation contract instead
     partial: bool = False
+    # the X-Trivy-DB-Version the answering replica stamped: under a
+    # db_swap schedule, the digest must match the ORACLE THIS HEADER
+    # NAMES (a v2-stamped response carrying v1 hits is exactly the
+    # mixing the memo's version keying forbids)
+    db_version: str = ""
 
     def key(self) -> tuple:
         return (self.idx, self.status, self.code, self.digest)
@@ -402,6 +436,10 @@ class _Topology:
     def __init__(self, table, opts: StormOptions):
         self.table = table
         self.opts = opts
+        # the db_swap event's upgrade target (a different content
+        # digest); run_storm computes the post-swap oracle against it
+        self.table2 = alt_storm_table()
+        self.db_swapped = False
 
     # the base URL scans go to (router for fleet, server otherwise)
     url: str = ""
@@ -433,6 +471,8 @@ class _Topology:
                 FAILPOINTS.set(site, ev.mode, ev.arg, seed=ev.seed)
         elif ev.kind == "swap_table":
             self.swap(ev.replica)
+        elif ev.kind == "db_swap":
+            self.db_swap()
         elif ev.kind == "kill_replica":
             self.kill(ev.replica)
         elif ev.kind == "hostile_layer":
@@ -465,6 +505,15 @@ class _Topology:
         states = self.server_states()
         if states:
             states[replica % len(states)].swap_table(self.table)
+
+    def db_swap(self) -> None:
+        """Rolling DB upgrade under load: every live server state
+        hot-swaps to the alternate table in slot order (each swap
+        triggers that replica's redetectd sweep when a memo is
+        wired)."""
+        self.db_swapped = True
+        for st in self.server_states():
+            st.swap_table(self.table2)
 
     def kill(self, replica: int) -> None:
         pass
@@ -557,13 +606,21 @@ class FleetTopology(_Topology):
         from ..fanal.cache import MemoryCache
         from ..fleet import (ReplicaOptions, RouterOptions,
                              serve_router_background)
+        from ..fleet.memo import MemoryMemo
         from ..resilience import RetryPolicy
-        self.table = table
-        self.opts = opts
+        super().__init__(table, opts)
+        # rolling db_swap: restarts must come back on whatever table
+        # the fleet is CURRENTLY rolling toward, not the boot table
+        self.active_table = table
         # one shared in-process cache: a failover Scan finds its blobs
         # wherever it lands (the graftfleet redis/s3 contract, without
         # a socket in the loop)
         self.shared_cache = MemoryCache()
+        # one shared result memo: the graftmemo contract under chaos —
+        # a layer detected by any replica is a memo hit on all of
+        # them, per db_version; memo.get/memo.put faults must degrade
+        # to plain re-detects
+        self.shared_memo = MemoryMemo()
         self.replicas: list = []     # slot → (httpd, state, url) | None
         self.ports: list[int] = []
         for _ in range(opts.replicas):
@@ -588,8 +645,10 @@ class FleetTopology(_Topology):
         from ..resilience import AdmissionOptions
         from ..server.listen import serve_background
         httpd, state = serve_background(
-            "127.0.0.1", self.ports[slot], self.table, cache_dir="",
+            "127.0.0.1", self.ports[slot], self.active_table,
+            cache_dir="",
             cache_backend=self.shared_cache,
+            memo_backend=self.shared_memo,
             admission=AdmissionOptions(
                 max_active=self.opts.admit_max_active,
                 max_queue=self.opts.admit_max_queue))
@@ -611,7 +670,14 @@ class FleetTopology(_Topology):
     def swap(self, replica: int) -> None:
         entry = self.replicas[replica % len(self.replicas)]
         if entry is not None:
-            entry[1].swap_table(self.table)
+            entry[1].swap_table(self.active_table)
+
+    def db_swap(self) -> None:
+        self.db_swapped = True
+        self.active_table = self.table2
+        for entry in self.replicas:
+            if entry is not None:
+                entry[1].swap_table(self.table2)
 
     def kill(self, replica: int) -> None:
         slot = replica % len(self.replicas)
@@ -867,6 +933,16 @@ class RunContext:
     breaker_opens: int                 # breaker_open events in-window
     incident_files: list[str]
     incident_dir: str
+    # db_swap: the rolling-upgrade probes. `oracle2` is the post-swap
+    # oracle (None when the schedule never swapped); v1/v2 are the
+    # before/after table digests; skew_settle_delta counts skew
+    # increments observed AFTER the fleet's version view converged
+    db_swap: bool = False
+    oracle2: "dict[int, str] | None" = None
+    v1: str = ""
+    v2: str = ""
+    skew_settle_delta: float = 0.0
+    requests: int = 0
 
 
 @invariant("no_lost_requests")
@@ -895,10 +971,51 @@ def _inv_identity(ctx: RunContext) -> list[str]:
             # not drift — no_lost_requests holds them to annotation
             # well-formedness instead
             continue
+        if ctx.db_swap:
+            # rolling upgrade: a response must match the oracle its
+            # OWN X-Trivy-DB-Version names — old hits under the new
+            # header (or vice versa) is version mixing, exactly what
+            # the memo's (blob, db_version) keying forbids
+            if o.db_version == ctx.v2:
+                want = (ctx.oracle2 or {}).get(o.idx)
+                if want is not None and o.digest != want:
+                    out.append(f"request {o.idx}: v2-stamped result "
+                               f"drifted from the post-swap oracle")
+            elif o.db_version == ctx.v1:
+                want = ctx.oracle.get(o.idx)
+                if want is not None and o.digest != want:
+                    out.append(f"request {o.idx}: v1-stamped result "
+                               f"drifted from the pre-swap oracle")
+            else:
+                out.append(f"request {o.idx}: unknown "
+                           f"X-Trivy-DB-Version "
+                           f"{o.db_version[:19]!r}")
+            continue
         want = ctx.oracle.get(o.idx)
         if want is not None and o.digest != want:
             out.append(f"request {o.idx}: result drifted from the "
                        f"unfaulted oracle")
+    return out
+
+
+@invariant("db_swap_converged")
+def _inv_db_swap(ctx: RunContext) -> list[str]:
+    """db_swap schedules only: after settle the fleet must be fully
+    on the new table (complete post-swap oracle) and the skew counter
+    quiet — a rolling upgrade that never converges is the split-brain
+    the version identity machinery exists to catch."""
+    if not ctx.db_swap:
+        return []
+    out = []
+    if ctx.oracle2 is None or len(ctx.oracle2) < ctx.requests:
+        missing = ctx.requests - len(ctx.oracle2 or {})
+        out.append(f"post-swap oracle incomplete: {missing} "
+                   f"request(s) failed against the settled, "
+                   f"fully-rolled topology")
+    if ctx.skew_settle_delta > 0:
+        out.append(f"db-version skew counter moved "
+                   f"{ctx.skew_settle_delta:g} time(s) after settle "
+                   f"— the rolling swap never converged")
     return out
 
 
@@ -1013,7 +1130,9 @@ def _classify(idx: int, code: int, headers: dict, body,
               latency_ms: float) -> Outcome:
     if 200 <= code < 300:
         return Outcome(idx, "ok", code, canonical_digest(body),
-                       latency_ms)
+                       latency_ms,
+                       db_version=headers.get(DB_VERSION_HEADER)
+                       or "")
     if code in (429, 503):
         well = True
         detail = ""
@@ -1165,13 +1284,57 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
         # back to closed (liveness). Serial probe scans admit the
         # half-open device probe; mesh/fleet readmission loops run on
         # their own maintenance threads.
+        # the chaos-tuned watchdog (50 ms — hang faults must trip
+        # fast) is wrong for settle: a solo probe's dispatch can
+        # legitimately pay a cold-shape compile or post-fallback CPU
+        # contention, and tripping the breaker on THAT defeats the
+        # very probes that prove liveness. Faults are cleared; settle
+        # asks "does the device path recover", not "is it fast" — so
+        # probe under the caller's original deadline.
+        GUARD.configure(dispatch_timeout_s=saved_guard[0])
         settle_deadline = time.monotonic() + opts.settle_s
         time.sleep(opts.breaker_reset_ms / 1e3)
         settle_problems = topo.settled()
+        probe_n = 0
         while settle_problems and time.monotonic() < settle_deadline:
-            topo.do_request(0, docs[0], opts.request_timeout_s)
+            # probe with docs[0]'s CONTENT under a fresh DiffID: a
+            # shared-memo topology serves the original doc as a memo
+            # hit (no device dispatch at all), which can never admit
+            # the half-open probe. Same content = warm shape; new
+            # blob id = guaranteed memo miss = a real dispatch.
+            probe_n += 1
+            probe = dict(docs[0])
+            probe["DiffID"] = f"sha256:{0x5e771e0000 + probe_n:064x}"
+            if topo.push_blobs:
+                _post(topo.url,
+                      "/twirp/trivy.cache.v1.Cache/PutBlob",
+                      {"diff_id": probe["DiffID"],
+                       "blob_info": probe},
+                      timeout=opts.request_timeout_s)
+            topo.do_request(0, probe, opts.request_timeout_s)
             time.sleep(0.05)
             settle_problems = topo.settled()
+
+        # db_swap epilogue: (a) the post-swap oracle — a settled,
+        # fully-rolled topology must answer every request cleanly
+        # under the new table (this pass also converges the router's
+        # per-replica version view); (b) the skew counter must then be
+        # QUIET across a second full pass — any further movement means
+        # the roll never converged
+        oracle2 = None
+        skew_settle_delta = 0.0
+        if topo.db_swapped:
+            oracle2 = {}
+            for i, doc in enumerate(docs):
+                o = topo.do_request(i, doc, opts.request_timeout_s)
+                if o.status == "ok":
+                    oracle2[i] = o.digest
+            skew0 = METRICS.family_sum(
+                "trivy_tpu_fleet_db_version_skew_total")
+            for i, doc in enumerate(docs):
+                topo.do_request(i, doc, opts.request_timeout_s)
+            skew_settle_delta = METRICS.family_sum(
+                "trivy_tpu_fleet_db_version_skew_total") - skew0
 
         metrics: dict = {}
         for url in topo.metrics_urls():
@@ -1222,7 +1385,12 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
         shed_counter_delta=METRICS.get(
             "trivy_tpu_requests_shed_total") - shed0,
         breaker_opens=breaker_opens, incident_files=incident_files,
-        incident_dir=run_dir)
+        incident_dir=run_dir,
+        db_swap=topo.db_swapped, oracle2=oracle2,
+        v1=table.content_digest(),
+        v2=topo.table2.content_digest(),
+        skew_settle_delta=skew_settle_delta,
+        requests=len(docs))
     violations = {}
     for name, probe in INVARIANTS.items():
         msgs = probe(ctx)

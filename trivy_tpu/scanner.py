@@ -30,9 +30,15 @@ if TYPE_CHECKING:
 class LocalScanner:
     def __init__(self, cache, table: AdvisoryTable,
                  sched: "SchedOptions | None" = None,
-                 mesh=None, mesh_guard=None):
+                 mesh=None, mesh_guard=None, memo=None):
         self.cache = cache
         self.table = table
+        # graftmemo: content-addressed detection-result memo (an open
+        # fleet.memo.MemoStore, shared across replicas on a common
+        # backend). Per scan unit, a (blob digest, db_version) entry
+        # replays the stored hits instead of dispatching the device
+        # join; misses detect normally and publish their result.
+        self.memo = memo
         # mesh mode (server --mesh-devices): the detect step shards
         # over a dp×db device mesh, supervised per-device by meshguard.
         # `mesh="host"` is the zero-survivor degraded detector — same
@@ -94,6 +100,8 @@ class LocalScanner:
     def _scan_many_traced(self, items, options, now):
         options = options or T.ScanOptions()
         details = []
+        item_blobs = []   # per item: the fetched BlobInfos (graftmemo
+        # attribution reads them; order matches the item's blob_ids)
         with span("scan.apply_layers", targets=len(items)):
             for target, artifact_id, blob_ids in items:
                 blobs = []
@@ -115,6 +123,7 @@ class LocalScanner:
                         app.packages = [p for p in app.packages
                                         if not p.dev]
                 details.append(detail)
+                item_blobs.append(blobs)
 
         # phase 1: build every query batch (host)
         units = []    # (item_idx, "os" | app, finish)
@@ -139,19 +148,57 @@ class LocalScanner:
             sp.attrs.update(batches=len(batches),
                             queries=sum(len(b) for b in batches))
 
-        # phase 2: one pipelined dispatch across all targets (device).
-        # Server mode routes through detectd so concurrent requests
-        # coalesce; under graftscope recording the direct path runs
-        # instead — its fenced stages keep phase attribution exact
-        # (the scheduler's threads would scatter the spans).
-        if batches:
-            with span("scan.detect", batches=len(batches)):
-                if self.sched is not None and not recording():
-                    hit_lists = self.sched.detect_many(batches)
+        # graftmemo: per unit, an attributable (blob digest,
+        # db_version) entry whose query digest matches replays its
+        # stored hits — the device join runs only for the live
+        # remainder, and live results publish back so the next scan
+        # (on any replica sharing the backend) hits. A degraded memo
+        # backend silently falls back to a full live dispatch.
+        session = None
+        replayed: dict[int, list] = {}
+        store_tokens: dict[int, tuple] = {}
+        if self.memo is not None and units:
+            from .fleet.memo import MemoSession
+            session = MemoSession(self.memo,
+                                  self.table.content_digest())
+            with span("scan.memo", units=len(units)) as sp:
+                for u_i, ((idx, unit, _fin), qs) in enumerate(
+                        zip(units, batches)):
+                    hits, token = session.consult(
+                        unit, qs, details[idx], item_blobs[idx],
+                        items[idx][2])
+                    if hits is not None:
+                        replayed[u_i] = hits
+                    elif token is not None:
+                        store_tokens[u_i] = token
+                sp.attrs.update(replayed=len(replayed))
+
+        # phase 2: one pipelined dispatch across all live targets
+        # (device). Server mode routes through detectd so concurrent
+        # requests coalesce; under graftscope recording the direct
+        # path runs instead — its fenced stages keep phase attribution
+        # exact (the scheduler's threads would scatter the spans).
+        hit_lists: list = [replayed.get(i) for i in range(len(batches))]
+        live = [i for i in range(len(batches)) if i not in replayed]
+        if live:
+            from .resilience import GUARD
+            live_batches = [batches[i] for i in live]
+            with span("scan.detect", batches=len(live_batches)):
+                # a blameless caller (redetectd's background replay)
+                # takes the direct path too: merging its queries into
+                # a live detectd dispatch would make live traffic
+                # share fate — and breaker charges — with guest work
+                if self.sched is not None and not recording() \
+                        and not GUARD.blameless_active():
+                    live_hits = self.sched.detect_many(live_batches)
                 else:
-                    hit_lists = self.detector.detect_many(batches)
-        else:
-            hit_lists = []
+                    live_hits = self.detector.detect_many(live_batches)
+            for u_i, hits in zip(live, live_hits):
+                hit_lists[u_i] = hits
+        if session is not None:
+            for u_i, token in store_tokens.items():
+                session.record(token, hit_lists[u_i])
+            session.flush()
 
         # phase 3: assemble per-target results (host)
         with span("scan.assemble_results"):

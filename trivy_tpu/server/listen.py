@@ -56,13 +56,19 @@ class MeshOptions:
 class ServerState:
     def __init__(self, table, cache_dir: str, token: str = "",
                  cache_backend: str = "fs", detect_opts=None,
-                 admission=None, mesh_opts: MeshOptions | None = None):
+                 admission=None, mesh_opts: MeshOptions | None = None,
+                 memo_backend="", redetect_opts=None):
         from ..detect.sched import SchedOptions
         from ..fanal.cache import open_cache
         # one backend-selection path (fanal.cache.open_cache) shared
         # with the CLI: fs | memory | redis:// | s3:// — the shared
         # backends are what make a replica fleet cache-coherent
         self.cache = open_cache(cache_backend, cache_dir)
+        # graftmemo: content-addressed detection-result memo (same
+        # backend grammar; "" = disabled). On a shared backend a blob
+        # detected by any replica is a memo hit on all of them.
+        from ..fleet.memo import open_memo
+        self.memo = open_memo(memo_backend, cache_dir)
         self.token = token
         self._lock = threading.Lock()
         # server mode runs detectd by default: concurrent RPCs'
@@ -82,6 +88,13 @@ class ServerState:
         # disagreements). Plain str attribute — handler reads need no
         # lock; swap_table re-stamps it when a new table installs.
         self.db_version = table.content_digest()
+        # rolling-upgrade observability: the version this replica
+        # served BEFORE its last hot swap, and when the swap landed —
+        # /healthz surfaces both so an operator can tell which side of
+        # a rolling fleet upgrade each replica is on (the skew counter
+        # says the fleet disagrees; these say who moved, and when)
+        self.db_previous_version = ""
+        self.db_swapped_at = ""
         # graceful drain (SIGTERM/SIGINT): once draining, Scan sheds
         # 503 + Retry-After while in-flight requests finish through
         # the generation drain — a restart mid-load completes what the
@@ -118,7 +131,19 @@ class ServerState:
         self._scanner = LocalScanner(self.cache, table,
                                      sched=self.detect_opts,
                                      mesh=self._mesh,
-                                     mesh_guard=self.mesh_guard)
+                                     mesh_guard=self.mesh_guard,
+                                     memo=self.memo)
+        # redetectd: on a DB hot swap, sweep the memo's known blobs
+        # through the pure detect path in the background so fresh
+        # entries exist under the new db_version before users rescan
+        self.redetect = None
+        if self.memo is not None:
+            from ..detect.redetect import RedetectDaemon
+            self.redetect = RedetectDaemon(
+                self.memo, self.cache, self.admission,
+                self.scanner_with_version, redetect_opts,
+                track=(self.request_started,
+                       self.request_finished))
         self._inflight = 0
         self._closed = False
         # scanner generations: a request started under generation g
@@ -238,6 +263,11 @@ class ServerState:
             if retry_after_s is not None:
                 self.drain_retry_after_s = retry_after_s
             self._draining = True
+        # a draining replica is leaving: its redetect sweep is work
+        # for a process that won't serve the result — cancel it so the
+        # drain window belongs entirely to in-flight user requests
+        if self.redetect is not None:
+            self.redetect.cancel()
 
     def drain(self, timeout_s: float) -> bool:
         """Wait (bounded) for every in-flight request to finish — the
@@ -262,6 +292,8 @@ class ServerState:
             self._closed = True
             scanner = self._scanner
         GUARD.breaker.remove_recovery(self._recover)
+        if self.redetect is not None:
+            self.redetect.close()
         if self.mesh_guard is not None:
             self.mesh_guard.close()
         scanner.close()
@@ -291,7 +323,8 @@ class ServerState:
             new_scanner = LocalScanner(self.cache, build_table,
                                        sched=self.detect_opts,
                                        mesh=build_mesh,
-                                       mesh_guard=self.mesh_guard)
+                                       mesh_guard=self.mesh_guard,
+                                       memo=self.memo)
             # digest outside the lock too (first computation walks the
             # whole table); cached on the table object afterwards
             new_version = build_table.content_digest()
@@ -319,6 +352,14 @@ class ServerState:
                     self._scanner = new_scanner
                     self._table = build_table
                     self._mesh = build_mesh
+                    version_changed = new_version != self.db_version
+                    if version_changed:
+                        # rolling-upgrade breadcrumbs for /healthz:
+                        # what this replica served before, and when
+                        # the swap landed
+                        self.db_previous_version = self.db_version
+                        self.db_swapped_at = time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
                     self.db_version = new_version
             if outcome == "aborted":
                 new_scanner.close()
@@ -329,6 +370,22 @@ class ServerState:
                 new_scanner.close()
                 continue
             break
+        # redetectd: a swap that changed the advisory-DB identity
+        # kicks the background memo sweep — fresh entries publish
+        # under the new db_version while old-version entries simply
+        # stop being addressed (a mesh rebuild / breaker recovery
+        # keeps the table, so it never sweeps)
+        if version_changed and self.redetect is not None \
+                and not self._draining:
+            self.redetect.schedule(new_version)
+            # begin_drain may have raced in between the check and the
+            # schedule — its cancel would have found no sweep to stop.
+            # Re-check and cancel so a draining replica never runs a
+            # fresh sweep against its own in-flight user requests.
+            with self._lock:
+                draining = self._draining
+            if draining:
+                self.redetect.cancel()
         # the swapped-in table's object graph (~1M small objects for a
         # full trivy-db) is immutable; freezing it out of the cyclic
         # collector keeps gen2 passes from stalling in-flight scans.
@@ -479,13 +536,18 @@ class Handler(BaseHTTPRequestHandler):
                 # the shrink/grow rebuild counters
                 if self.state.mesh_guard is not None:
                     resilience["mesh"] = self.state.mesh_guard.status()
-                self._json(200, {
+                payload = {
                     "status": "draining" if self.state.draining
                     else "ok",
                     # advisory-DB identity: replicas of one fleet must
                     # agree, or bit-identical failover is a lie — the
-                    # router's probe reads this field
+                    # router's probe reads this field. The previous
+                    # version + swap timestamp make a rolling upgrade
+                    # observable per replica.
                     "db_version": self.state.db_version,
+                    "db_previous_version":
+                        self.state.db_previous_version,
+                    "db_swapped_at": self.state.db_swapped_at,
                     "device": device_status(),
                     # graftguard: breaker state, watchdog last-probe
                     # age, shed/fallback counters, admission snapshot
@@ -494,7 +556,16 @@ class Handler(BaseHTTPRequestHandler):
                     # sliding windows (export() also refreshes the
                     # burn-rate gauges, so /healthz and /metrics agree)
                     "slo": SLO.export(),
-                })
+                }
+                # graftmemo: backend + known-blob count, and the
+                # redetectd sweep's progress (phase, done/total,
+                # target db_version)
+                if self.state.memo is not None:
+                    memo = self.state.memo.status()
+                    if self.state.redetect is not None:
+                        memo["sweep"] = self.state.redetect.status()
+                    payload["memo"] = memo
+                self._json(200, payload)
         elif self.path == "/version":
             self._json(200, {"Version": __version__})
         elif self.path == "/metrics":
@@ -748,7 +819,8 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
           ready_event: threading.Event | None = None,
           cache_backend: str = "fs", trace_path: str = "",
           detect_opts=None, admission=None, mesh_opts=None,
-          drain_grace_s: float = 10.0):
+          drain_grace_s: float = 10.0, memo_backend="",
+          redetect_opts=None):
     """`trace_path` arms graftscope recording for the server's
     lifetime and dumps the Chrome trace-event JSON there on shutdown
     (the CLI's `server --trace FILE`). `detect_opts` (SchedOptions)
@@ -762,7 +834,8 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
         COLLECTOR.enable()
     state = ServerState(table, cache_dir, token, cache_backend,
                         detect_opts=detect_opts, admission=admission,
-                        mesh_opts=mesh_opts)
+                        mesh_opts=mesh_opts, memo_backend=memo_backend,
+                        redetect_opts=redetect_opts)
     # per-server Handler subclass: `state` must not live on the shared
     # base class, or two in-process replicas (the fleet tests/bench)
     # would serve each other's caches and scanners
@@ -786,7 +859,8 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
 
 def serve_background(host: str, port: int, table, cache_dir: str,
                      token: str = "", cache_backend: str = "fs",
-                     detect_opts=None, admission=None, mesh_opts=None):
+                     detect_opts=None, admission=None, mesh_opts=None,
+                     memo_backend="", redetect_opts=None):
     """Start in a daemon thread; returns (httpd, state) once listening.
     Callers own shutdown: `httpd.shutdown()` then `state.close()` (the
     detect engine's worker threads are non-daemon). `cache_backend`
@@ -796,7 +870,9 @@ def serve_background(host: str, port: int, table, cache_dir: str,
     state = ServerState(table, cache_dir, token, cache_backend,
                         detect_opts=detect_opts,
                         admission=admission,
-                        mesh_opts=mesh_opts)
+                        mesh_opts=mesh_opts,
+                        memo_backend=memo_backend,
+                        redetect_opts=redetect_opts)
     handler = type("Handler", (Handler,), {"state": state})
     httpd = ThreadingHTTPServer((host, port), handler)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
