@@ -269,8 +269,13 @@ def run_python_loop(table, images):
     return hits
 
 
-def _secret_corpus():
-    """64 files × 1 MiB: half of each file is a shared base (container
+SECRET_FILES = 64
+SECRET_FILE_BYTES = 1 << 20
+SECRET_LAYERS = 8   # coalesced-ingest shape: files grouped per layer
+
+
+def _secret_corpus(n_files=SECRET_FILES, file_bytes=SECRET_FILE_BYTES):
+    """n_files files: half of each file is a shared base (container
     layers repeat blocks across images — the chunk dedup must see SOME
     redundancy, but not a degenerate all-duplicates corpus that would
     reduce the device metric to hashing speed), half is per-file
@@ -278,9 +283,9 @@ def _secret_corpus():
     import numpy as np
     rng = np.random.default_rng(3)
     corpus = []
-    half = 1 << 19
+    half = file_bytes // 2
     base = rng.integers(32, 127, size=half, dtype=np.uint8).tobytes()
-    for i in range(64):
+    for i in range(n_files):
         uniq = rng.integers(32, 127, size=half, dtype=np.uint8) \
             .tobytes()
         body = bytearray(base + uniq)
@@ -291,28 +296,78 @@ def _secret_corpus():
     return corpus
 
 
-def bench_secrets_device():
-    """Secret scan device throughputs (MB/s), one warm pass.
+def bench_secrets_device(n_files=SECRET_FILES,
+                         file_bytes=SECRET_FILE_BYTES):
+    """Secrets engine v2 scenario: coalesced-ingest device throughput
+    plus the per-phase split, one warm pass.
 
-    Two numbers: the keyword GATE alone (the device counterpart of
-    `bench_secrets_host`'s bytes.find loop — reference
-    pkg/fanal/secret/scanner.go:363-371), and the full scan_files
-    pipeline (gate + per-rule regex confirmation, which the reference
-    also runs host-side after its gate)."""
+    The corpus is grouped into SECRET_LAYERS batches and scanned
+    through `scan_files_many` — the exact entry fanald's pipelined
+    layer walk uses, so the measured launch IS the coalesced path
+    (many layers, one device prefilter). Returns a dict:
+
+      secret_mbps_device       keyword-gate MB/s (pack + dispatch +
+                               exact-bitmask decode; the device
+                               counterpart of `bench_secrets_host`'s
+                               bytes.find loop, scanner.go:363-371)
+      secret_scan_mbps_device  full scan_files_many MB/s (gate + the
+                               regex-only host confirm stage)
+      secret_phase_ms          {pack, dedup_dispatch_decode, confirm}
+                               — the gate's host packing cost vs the
+                               rest of the gate (content-dedup blake2b
+                               hashing is HOST work and lives in this
+                               bucket with the device dispatch+decode
+                               — the split is pack vs gate-remainder,
+                               not host vs device) vs the regex tail
+      secret_prefilter_path    which engine served the gate
+                               ("pallas" | "jnp" | "host")
+    """
+    from trivy_tpu.metrics import METRICS
+    from trivy_tpu.ops import ac
     from trivy_tpu.secret.engine import SecretScanner
-    corpus = _secret_corpus()
+    corpus = _secret_corpus(n_files, file_bytes)
     contents = [c for _, c in corpus]
-    scanner = SecretScanner()
+    per_layer = max(1, len(corpus) // SECRET_LAYERS)
+    layers = [corpus[i:i + per_layer]
+              for i in range(0, len(corpus), per_layer)]
+    # small_batch_bytes=0: this scenario MEASURES the device path (the
+    # host path has its own bench) — without it a scaled-down corpus
+    # sitting at the production 2 MiB floor would silently flip the
+    # whole measurement to bytes.find on any size drift
+    scanner = SecretScanner(small_batch_bytes=0)
     total_mb = sum(len(c) for _, c in corpus) / 1e6
+    bank = scanner._bank
     # warmup compiles every chunk-batch shape the timed run will use
-    scanner.scan_files(corpus)
+    scanner.scan_files_many(layers)
     t0 = time.perf_counter()
-    scanner._keyword_masks_device(contents)
+    ac.pack_chunks(contents, 16384, bank.max_kw_len - 1)
+    pack_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    scanner._keyword_masks(contents)
     gate_s = time.perf_counter() - t0
+    path_counts = {
+        p: METRICS.get("trivy_tpu_secret_prefilter_path_total", path=p)
+        for p in ("pallas", "jnp", "host")}
     t0 = time.perf_counter()
-    scanner.scan_files(corpus)
+    scanner.scan_files_many(layers)
     scan_s = time.perf_counter() - t0
-    return total_mb / gate_s, total_mb / scan_s
+    path_after = {
+        p: METRICS.get("trivy_tpu_secret_prefilter_path_total", path=p)
+        for p in ("pallas", "jnp", "host")}
+    served = next((p for p in ("pallas", "jnp", "host")
+                   if path_after[p] > path_counts[p]), "host")
+    return {
+        "secret_mbps_device": round(total_mb / gate_s, 1),
+        "secret_scan_mbps_device": round(total_mb / scan_s, 1),
+        "secret_phase_ms": {
+            "pack": round(pack_s * 1e3, 1),
+            "dedup_dispatch_decode": round(
+                max(gate_s - pack_s, 0.0) * 1e3, 1),
+            "confirm": round(max(scan_s - gate_s, 0.0) * 1e3, 1),
+        },
+        "secret_prefilter_path": served,
+        "secret_corpus_mb": round(total_mb, 1),
+    }
 
 
 SERVER_IMAGES = 1000
@@ -1127,12 +1182,13 @@ def bench_fleet_dedup():
     }
 
 
-def bench_secrets_host():
+def bench_secrets_host(n_files=SECRET_FILES,
+                       file_bytes=SECRET_FILE_BYTES):
     """Host bytes.find gate over the same corpus/keywords (MB/s), and
     the full host-only scan_files pipeline for the same corpus."""
     from trivy_tpu.secret.engine import SecretScanner
     from trivy_tpu.secret.rules import BUILTIN_RULES
-    corpus = _secret_corpus()
+    corpus = _secret_corpus(n_files, file_bytes)
     total_mb = sum(len(c) for _, c in corpus) / 1e6
     keywords = sorted({kw.lower().encode() for r in BUILTIN_RULES
                        for kw in r.keywords})
@@ -1186,7 +1242,7 @@ def device_child_main():
     sub_hits = run_device(detector, images[:BASELINE_IMAGES])
     phase_ms = COLLECTOR.phase_totals()
     COLLECTOR.disable()
-    secrets_mbs, secrets_scan_mbs = bench_secrets_device()
+    secrets = bench_secrets_device()
     try:
         # never sink the already-measured device payload on a server
         # bench failure (timeout, port bind, HTTP error)
@@ -1238,8 +1294,9 @@ def device_child_main():
         "transfer_bytes_per_dispatch": transfer,
         "n_pairs": int(n_pairs),
         "phase_ms": phase_ms,
-        "secrets_device_mb_s": secrets_mbs,
-        "secrets_scan_device_mb_s": secrets_scan_mbs,
+        "secrets": secrets,
+        "secrets_device_mb_s": secrets["secret_mbps_device"],
+        "secrets_scan_device_mb_s": secrets["secret_scan_mbps_device"],
         "images_per_sec_server": server_ips,
         "server_hits": server_hits,
         "server_concurrency": server_conc,
@@ -1545,6 +1602,30 @@ def main():
         host_gate_mbs, host_scan_mbs = bench_secrets_host()
         result["secrets_host_find_mb_s"] = round(host_gate_mbs, 1)
         result["secrets_scan_host_mb_s"] = round(host_scan_mbs, 1)
+        result["secret_mbps_host"] = round(host_gate_mbs, 1)
+        try:
+            # secrets v2 coalesced scenario on the CPU jax backend
+            # (scaled-down corpus — the jnp shift-or on a CPU host is
+            # a parity/containment path, not a throughput claim); the
+            # device child's full-corpus numbers override when the
+            # chip answers
+            result["secrets"] = bench_secrets_device(
+                n_files=8, file_bytes=256 << 10)
+            result["secrets"]["secret_backend"] = "cpu"
+            result["secret_mbps_device"] = \
+                result["secrets"]["secret_mbps_device"]
+            # matched-corpus host gate for the ratio: per-launch fixed
+            # costs amortize very differently over 2 MB vs 64 MB, so
+            # dividing by the full-corpus host number would skew the
+            # speedup on chip-less runs (the device child measures
+            # both sides on the full corpus, so ITS ratio uses the
+            # headline secret_mbps_host)
+            small_host_mbs, _ = bench_secrets_host(
+                n_files=8, file_bytes=256 << 10)
+            result["secret_device_speedup"] = round(
+                result["secret_mbps_device"] / small_host_mbs, 2)
+        except Exception as e:
+            diag.append(f"secrets bench failed: {e}")
 
         # server path end to end (BASELINE config 3): RPC + cache +
         # applier + detect + assembly on the CPU backend here; the
@@ -1674,6 +1755,18 @@ def main():
                 dev["secrets_device_mb_s"], 1)
             result["secrets_scan_device_mb_s"] = round(
                 dev.get("secrets_scan_device_mb_s", 0.0), 1)
+            if dev.get("secrets"):
+                # secrets v2: chip-in-the-loop coalesced numbers
+                # override the CPU-backend pass; the speedup target
+                # (≥ 10× host, ISSUE 12) reads straight off this key
+                result["secrets"] = dev["secrets"]
+                result["secrets"]["secret_backend"] = "device"
+                result["secret_mbps_device"] = \
+                    dev["secrets"]["secret_mbps_device"]
+                if result.get("secret_mbps_host"):
+                    result["secret_device_speedup"] = round(
+                        result["secret_mbps_device"]
+                        / result["secret_mbps_host"], 2)
             if dev.get("images_per_sec_server"):
                 result["images_per_sec_server"] = round(
                     dev["images_per_sec_server"], 1)

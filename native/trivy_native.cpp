@@ -49,7 +49,14 @@ void lower_pack_chunks(const uint8_t* data, int64_t len, int32_t chunk_len,
     if (stride < 1) stride = 1;
     int32_t count = 0;
     for (int64_t off = 0; off < len && count < max_chunks; off += stride) {
-        if (off > 0 && len - off <= overlap) break;  // covered by previous
+        // Skip the final stride only when the previous chunk really
+        // covers the remaining tail: it spans [off - stride, off -
+        // stride + chunk_len), which reaches chunk_len - stride past
+        // `off` — equal to `overlap` only while the stride is
+        // unclamped. The old `len - off <= overlap` test dropped the
+        // uncovered tail of multi-chunk files when overlap >=
+        // chunk_len clamped the stride to 1.
+        if (off > 0 && len - off <= chunk_len - stride) break;
         int64_t piece = len - off;
         if (piece > chunk_len) piece = chunk_len;
         uint8_t* dst = out + static_cast<int64_t>(count) * chunk_len;

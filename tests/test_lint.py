@@ -636,11 +636,55 @@ def test_iter_eqns_sees_inside_cond_branches():
     assert "reduce_sum" in prims
 
 
+def test_shiftor_contract_budget_catches_unroll():
+    """The secret kernel's 128-column × state_words static unroll is
+    intentional; the budget catches an accidental second one (or a
+    per-keyword Python loop sneaking in)."""
+    c = _contract("secret_shiftor.json")
+    c["max_primitives"] = 100
+    c.pop("golden", None)
+    fs = jaxpr_check.check_contract("secret_shiftor.json", c)
+    assert [f.rule for f in fs] == ["JAX204"]
+
+
+def test_shiftor_contract_convert_allowlist_enforced():
+    c = _contract("secret_shiftor.json")
+    c["allowed_converts"] = [
+        p for p in c["allowed_converts"] if p != ["bool", "int32"]]
+    c.pop("golden", None)
+    fs = jaxpr_check.check_contract("secret_shiftor.json", c)
+    # the kernel's per-word equality fold (bool→int32 for the Mosaic-
+    # safe AND chain) is no longer allowlisted
+    assert fs and {f.rule for f in fs} == {"JAX202"}
+    assert any("bool→int32" in f.message for f in fs)
+
+
+def test_shiftor_contract_dtype_surface_enforced():
+    c = _contract("secret_shiftor.json")
+    c["out_dtypes"] = ["uint32"]
+    c.pop("golden", None)
+    fs = jaxpr_check.check_contract("secret_shiftor.json", c)
+    assert any(f.rule == "JAX201" for f in fs)
+
+
+def test_shiftor_contract_host_callback_ban_sees_kernel():
+    """The host-callback ban must see INSIDE the pallas_call lowering:
+    forbidding a primitive the kernel genuinely uses (broadcast_in_dim,
+    the column→lane fan-out) proves an io_callback would be caught the
+    same way."""
+    c = _contract("secret_shiftor.json")
+    c["forbidden_primitives"] = ["broadcast_in_dim"]
+    c.pop("golden", None)
+    fs = jaxpr_check.check_contract("secret_shiftor.json", c)
+    assert fs and {f.rule for f in fs} == {"JAX203"}
+    assert any("broadcast_in_dim" in f.message for f in fs)
+
+
 def test_golden_snapshots_are_current():
     """The checked-in pretty-printed jaxprs match the live lowering —
     a hot-path change must regenerate them (and show up in review)."""
     for name in ("csr_pair_join.json", "csr_pair_join_compact.json",
-                 "prefilter_pallas.json"):
+                 "secret_shiftor.json"):
         c = _contract(name)
         closed = jaxpr_check.trace_contract(c)
         text = jaxpr_check.normalize_jaxpr_text(str(closed))
@@ -1005,6 +1049,20 @@ def test_fanal_failpoint_sites_in_catalog():
         pass
     else:
         raise AssertionError("typo'd fanal site must fail at parse")
+
+
+def test_secret_prefilter_failpoint_site_in_catalog():
+    """Satellite (PR 12): the secret.prefilter site parses under the
+    spec grammar and is schedulable by storm's ingest menu."""
+    from trivy_tpu.resilience.failpoints import parse_spec
+    specs = parse_spec("secret.prefilter=hang:100")
+    assert set(specs) == {"secret.prefilter"}
+    try:
+        parse_spec("secret.prefliter=error")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("typo'd secret site must fail at parse")
 
 
 def test_graftmemo_store_in_lock_hygiene_scope():

@@ -154,9 +154,9 @@ def test_graft_entry_importable():
     assert len(out) == 2
 
 
-# ---- meshed secret prefilter (SURVEY §2.7 P2) --------------------------
+# ---- meshed secret engine (SURVEY §2.7 P2) -----------------------------
 
-def test_sharded_prefix_scan_matches_host():
+def test_sharded_shiftor_scan_matches_host():
     from trivy_tpu.secret.engine import SecretScanner
 
     mesh = make_mesh(8, db_shards=2)
@@ -171,28 +171,30 @@ def test_sharded_prefix_scan_matches_host():
     host = SecretScanner(use_device=False)
     # the device path directly: _keyword_masks would mask a broken
     # sharded scan behind its host fallback
-    assert meshed._keyword_masks_device(files) == \
-        host._keyword_masks_host(files)
+    masks, path = meshed._keyword_masks_device(files)
+    assert path == "jnp"   # the mesh shards the jnp shift-or scan
+    assert masks == host._keyword_masks_host(files)
 
 
-def test_sharded_prefix_scan_row_padding():
+def test_sharded_shiftor_scan_row_padding():
     """Row counts not divisible by the device count are padded and
     sliced back exactly."""
     from trivy_tpu.ops import ac
-    from trivy_tpu.parallel.mesh import sharded_prefix_scan
+    from trivy_tpu.parallel.mesh import sharded_shiftor_scan
 
     mesh = make_mesh(8, db_shards=1)
-    bank = ac.build_literal_bank([b"akia", b"ghp_"])
+    bank = ac.build_literal_bank([b"akia", b"secret_key_base"])
     rng = np.random.default_rng(0)
     chunks = rng.integers(97, 123, size=(13, 256), dtype=np.uint8)
     chunks[3, 10:14] = np.frombuffer(b"akia", np.uint8)
-    got = sharded_prefix_scan(mesh, bank.kw_word4, bank.kw_mask4,
-                              chunks, n_words=bank.words)
-    single = np.asarray(ac.prefix_scan(
-        bank.kw_word4, bank.kw_mask4, chunks, n_words=bank.words))
+    chunks[7, 40:55] = np.frombuffer(b"secret_key_base", np.uint8)
+    got = sharded_shiftor_scan(mesh, bank.kw_words, bank.kw_masks,
+                               chunks, n_words=bank.words)
+    single = np.asarray(ac.shiftor_scan(
+        bank.kw_words, bank.kw_masks, chunks, n_words=bank.words))
     assert got.shape == single.shape
     assert (got == single).all()
-    assert got[3].any()
+    assert got[3].any() and got[7].any()
 
 
 # ---- multi-host plumbing ----------------------------------------------

@@ -671,6 +671,12 @@ class TestIngestStorm:
                                             n_events=6).events
                  if e.kind == "failpoint"}
         assert sites & {"fanal.walk", "fanal.analyze"}
+        # the secrets lane is on the menu (ISSUE 12)
+        all_sites = {e.site for s in range(32)
+                     for e in generate_schedule(s, "ingest",
+                                                n_events=6).events
+                     if e.kind == "failpoint"}
+        assert "secret.prefilter" in all_sites
 
     def test_hostile_variants_round_trip_replay(self, tmp_path):
         from trivy_tpu.resilience.storm import Schedule, StormEvent
@@ -719,6 +725,44 @@ class TestIngestStorm:
         # which includes the ingest stages via IngestTopology.settled)
         assert INGEST.breaker("walk").state_name() == "closed"
         assert INGEST.breaker("analyze").state_name() == "closed"
+
+    def test_secrets_lane_prefilter_hang_drill(self):
+        """ISSUE 12 satellite: a hang-mode `secret.prefilter` fault at
+        c=8 — every request in the window waits out the wedged device
+        launch, the watchdog trips the shared detect breaker, the scan
+        degrades to the HOST keyword engine, and the response is
+        bit-identical to the unfaulted oracle (both engines are exact,
+        so the bit_identity invariant is the finding-for-finding
+        assertion). The breaker re-closes once the fault clears."""
+        from trivy_tpu.metrics import METRICS
+        from trivy_tpu.resilience import GUARD
+        from trivy_tpu.resilience.storm import (Schedule, StormEvent,
+                                                StormOptions,
+                                                run_storm)
+        host0 = METRICS.get("trivy_tpu_secret_prefilter_path_total",
+                            path="host")
+        sched = Schedule(seed=78, topology="ingest",
+                         horizon_ms=1200.0, events=[
+                             StormEvent(at_ms=20.0,
+                                        site="secret.prefilter",
+                                        mode="hang", arg=150.0,
+                                        dur_ms=800.0),
+                         ])
+        rep = run_storm(sched, StormOptions(requests=12,
+                                            concurrency=8,
+                                            settle_s=10.0))
+        assert rep.ok, rep.violations
+        # nothing lost, nothing shed — and bit_identity (every digest
+        # == the oracle's) held, which run_storm already enforced
+        assert all(o.status == "ok" for o in rep.outcomes)
+        # the window genuinely forced host fallbacks
+        host1 = METRICS.get("trivy_tpu_secret_prefilter_path_total",
+                            path="host")
+        assert host1 > host0
+        # every scan carried the planted findings: the oracle pass is
+        # device-served, the fault window host-served — identical
+        # digests prove finding-for-finding parity
+        assert GUARD.breaker.state_name() == "closed"
 
     def test_acceptance_drill_seeded_schedule(self):
         """The same drill from graftstorm's seeded generator — the

@@ -101,15 +101,16 @@ class TestRules:
             ["github-oauth", "github-pat"]
 
 
-class TestPrefixScan:
+class TestShiftorScan:
     @staticmethod
     def _scan(bank, chunks):
-        return np.asarray(ac.prefix_scan(
-            bank.kw_word4, bank.kw_mask4, chunks, n_words=bank.words))
+        return np.asarray(ac.shiftor_scan(
+            bank.kw_words, bank.kw_masks, chunks, n_words=bank.words))
 
     def test_build_and_scan(self):
         bank = ac.build_literal_bank([b"AKIA", b"ghp_", b"key"])
         assert bank.n_keywords == 3
+        assert bank.state_words == 1
         chunks, owner = ac.pack_chunks(
             [b"my ghp_ token", b"nothing here", b"AKIA and KEY"], 64, 8)
         masks = self._scan(bank, chunks)
@@ -129,16 +130,17 @@ class TestPrefixScan:
         masks = self._scan(bank, chunks)
         assert (masks != 0).any()
 
-    def test_prefix_superset_never_misses(self):
-        """The device mask is a superset filter on the 4-byte prefix: a
-        prefix-only occurrence may set the bit (host confirms), but a
-        full occurrence must always set it."""
+    def test_full_keyword_match_is_exact(self):
+        """v2 verifies FULL keywords on device: a shared-prefix near
+        miss must NOT set the bit (v1's 4-byte superset filter did,
+        and re-confirmed on host)."""
         bank = ac.build_literal_bank([b"heroku", b"key"])
+        assert bank.state_words == 2
         chunks, _ = ac.pack_chunks(
             [b"has herok-prefix only: herox", b"real heroku here"], 64, 8)
         masks = self._scan(bank, chunks)
-        assert int(masks[0, 0]) & 0b01 == 0b01  # prefix "hero" → candidate
-        assert int(masks[1, 0]) & 0b01 == 0b01  # true occurrence
+        assert int(masks[0, 0]) & 0b01 == 0      # prefix only: no bit
+        assert int(masks[1, 0]) & 0b01 == 0b01   # true occurrence
 
     def test_word_boundary_bit_33(self):
         """More than 32 keywords → second mask word used correctly."""
@@ -149,9 +151,9 @@ class TestPrefixScan:
         acc = 0
         for w in range(masks.shape[1]):
             acc |= (int(masks[0, w]) & 0xFFFFFFFF) << (32 * w)
-        # all 40 keywords share the 4-byte prefix "uniq" → all candidates;
-        # bit 37 must be among them (exactness restored by host confirm)
-        assert acc & (1 << 37)
+        # exact engine: bit 37 and ONLY bit 37 despite all 40 keywords
+        # sharing the 4-byte prefix "uniq"
+        assert acc == (1 << 37)
 
     def test_device_prefilter_equals_host(self, device_scanner, scanner):
         files = [

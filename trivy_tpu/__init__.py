@@ -9,8 +9,8 @@ Capability-parity rebuild of Trivy (reference: fwereade/trivy, mounted at
   version-range comparison over all (package, advisory) pairs
   (`trivy_tpu.ops.join`), jit-compiled and sharded over a
   `jax.sharding.Mesh`,
-- secret scanning runs a device Aho-Corasick keyword prefilter over
-  chunked byte tensors (`trivy_tpu.ops.ac`) with host-side regex
+- secret scanning runs an exact device shift-or multi-keyword match
+  over chunked byte tensors (`trivy_tpu.ops.ac`) with host-side regex
   confirmation for exact parity with the reference rule semantics,
 - artifact acquisition / parsing / report assembly stay on the host
   (`trivy_tpu.fanal`, `trivy_tpu.report`).

@@ -175,6 +175,7 @@ class _ImageInspectMixin:
         finally:
             pipe.close()
         # finalize in layer order (deterministic output + cache puts)
+        finalized = []
         for t in tasks:
             scan = scans[t.idx]
             bi = blob_info(scan, diff_id=t.diff_id,
@@ -185,10 +186,24 @@ class _ImageInspectMixin:
             if scan.partial:
                 blob_id = partial_blob_id(t.blob_id, bi.ingest_errors)
                 blob_ids[t.idx] = blob_id
-            if want_secrets and scan.secret_files:
+            finalized.append((scan, bi, blob_id))
+        # coalesced secrets lane: every missing layer's secret files go
+        # through ONE scan_files_many call — one device prefilter
+        # launch for the whole image (detectd's coalescing move),
+        # where the per-layer calls this replaces rarely crossed the
+        # engine's small-batch floor. Per-layer results come back in
+        # layer order, bit-identical to per-layer scan_files calls by
+        # construction.
+        with_secrets = [f for f in finalized
+                        if want_secrets and f[0].secret_files]
+        if with_secrets:
+            per_layer = self.secret_scanner.scan_files_many(
+                [scan.secret_files for scan, _bi, _b in with_secrets])
+            for (scan, bi, blob_id), secs in zip(with_secrets,
+                                                 per_layer):
                 secret_files[blob_id] = scan.secret_files
-                bi.secrets = self.secret_scanner.scan_files(
-                    scan.secret_files)
+                bi.secrets = secs
+        for _scan, bi, blob_id in finalized:
             self.cache.put_blob(blob_id, bi)
         return secret_files
 

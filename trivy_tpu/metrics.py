@@ -417,3 +417,21 @@ METRICS.declare("trivy_tpu_secret_bytes_total", "counter",
                 "Bytes through the secret scanner.")
 METRICS.declare("trivy_tpu_secret_findings_total", "counter",
                 "Confirmed secret findings.")
+METRICS.declare(
+    "trivy_tpu_secret_prefilter_path_total", "counter",
+    "Keyword-prefilter launches by the path that actually served them "
+    "(path=\"pallas\"/\"jnp\"/\"host\"): pallas = the TPU shift-or "
+    "kernel, jnp = ac.shiftor_scan (CPU, mesh, or a logged pallas "
+    "downgrade), host = small batches, open-breaker fallback, and "
+    "device errors.")
+METRICS.declare(
+    "trivy_tpu_secret_scan_bytes_total", "counter",
+    "Bytes through the keyword prefilter, by serving path "
+    "(path=\"pallas\"/\"jnp\"/\"host\") — the MB/s numerator for each "
+    "lane of the secrets engine.")
+METRICS.declare(
+    "trivy_tpu_secret_candidate_precision", "histogram",
+    "Per scan batch: keyword-gated (file, rule) candidates that the "
+    "rule regex then confirmed with a finding, divided by candidates "
+    "flagged — the regex yield of the exact keyword gate.",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0))

@@ -1,35 +1,44 @@
-"""Keyword prefilter on device: position-parallel packed-prefix matching.
+"""Secrets engine v2: exact multi-pattern keyword matching on device.
 
 The reference gates each of its 86 secret rules on a bytes.Contains
 keyword check before running the rule regex
 (pkg/fanal/secret/scanner.go:363-371) — that prefilter is the bulk of the
 scan cost over a filesystem. Keywords are fixed strings, so no DFA is
-needed; and because a regex confirmation runs host-side anyway, the
-device check may be a *superset* filter as long as it never misses:
+needed. Engine v1 tested only each keyword's packed 4-byte PREFIX on
+device (a superset filter) and re-confirmed every candidate with a host
+substring pass; v2 verifies FULL keywords on device with a bit-parallel
+shift-or (bitap) formulation, so the device output is the EXACT
+per-chunk keyword bitmask and the host stage shrinks to "run the regex
+for gated rules" — nothing is re-scanned.
 
-  device: pack every byte position's next 4 bytes into one uint32 word
-          (three shift-ors — w4[p] = b[p] | b[p+1]<<8 | ...), then for
-          each keyword test `(w4 ^ prefix4) & mask == 0` — ONE [B, L]
-          int32 compare per keyword per position, reduced to a per-chunk
-          keyword bitmask. Keywords shorter than 4 bytes mask the tail.
-  host:   the few flagged (chunk, keyword) candidates are confirmed with
-          an exact substring check before any rule regex runs, so parity
-          with the reference's bytes.Contains gate is exact.
+The shift-or recurrence per pattern j is S ← ((S << 1) | 1) & B[c]; a
+match fires when bit m_j-1 of S sets. Two transforms make it
+TPU-shaped:
 
-A full-keyword device match (shifted-equality over max-keyword-length
-planes) was measured 25-50× slower on TPU: per-byte-offset lane-unaligned
-slices of a [B, 16384] tensor are relayout-bound, while the prefix word
-is three aligned shifts amortized over all keywords. A keyword occurrence
-always implies its 4-byte-prefix word occurs, so the device mask is a
-strict superset — no false negatives.
+  * radix-2^32 alphabet: instead of a per-byte B[c] table gather (a
+    256-way gather per position — hostile to the VPU), each byte
+    position p carries the packed little-endian word of its next 4
+    bytes (w4[p], three shift-ors), and a pattern's state advances 4
+    bytes per word compare: `(w4[p + 4w] ^ word_w) & mask_w == 0`.
+    Keywords shorter than 4(w+1) bytes mask the tail of word w;
+    words fully past the keyword have mask 0 (always true).
+  * position parallelism: because the state width (max keyword length,
+    25 for the builtin bank) never exceeds one chunk, the recurrence
+    unrolls completely — pattern j matches ENDING AT p iff every one of
+    its ceil(m_j/4) word compares holds starting at p-m_j+1 — so all
+    positions evaluate simultaneously instead of marching one byte at a
+    time. Pattern states live on the 128-lane axis (one lane per
+    keyword, ≤128 like the v1 bank); the multi-WORD state extends the
+    v1 single-prefix-word layout to `state_words` planes.
 
-Files are packed into fixed [B, L] uint8 chunk tensors with an overlap of
-max keyword length - 1 so boundary-straddling keywords are still seen.
-Regex confirmation of gated (file, rule) pairs runs host-side for exact
-parity (SURVEY.md §7 step 6). On TPU backends the jnp prefix_scan here
-is superseded by the Pallas kernel in ops/prefilter_pallas.py (single
-VMEM pass over all keywords); this module remains the CPU/mesh path
-and the shared bank/packing layer.
+Files are packed into fixed [B, L] uint8 chunk tensors with an overlap
+of max keyword length - 1, so every occurrence lies wholly inside some
+row and the per-row verdicts are exact for the whole file. The jnp
+`shiftor_scan` here is the CPU and mesh path; on TPU backends the
+Pallas kernel in ops/shiftor_pallas.py does the same compares out of
+VMEM in a single HBM pass. The host engine (`bytes.find` per keyword)
+remains the graftguard fallback and the parity oracle — device ≡ host
+finding-for-finding is gated in tier-1.
 """
 
 from __future__ import annotations
@@ -52,57 +61,97 @@ def lower_bytes(data: bytes) -> np.ndarray:
 
 @dataclass
 class LiteralBank:
-    """Keyword literals (matched lowercased) + packed 4-byte prefixes."""
-    kw_bytes: list          # [Nk] lowercased keyword bytes (host confirm)
-    kw_word4: np.ndarray    # uint32[Nk] first ≤4 bytes, little-endian
-    kw_mask4: np.ndarray    # uint32[Nk] byte mask (short keywords)
+    """Keyword literals (matched lowercased) + packed word planes.
+
+    The multi-word arrays are the full shift-or state — word w of
+    keyword k covers its bytes 4w..4w+3 (v1 carried only word 0, the
+    4-byte prefix, and was therefore a superset filter)."""
+    kw_bytes: list          # [Nk] lowercased keyword bytes (host path)
+    kw_words: np.ndarray    # uint32[W, Nk] packed 4-byte words
+    kw_masks: np.ndarray    # uint32[W, Nk] per-word byte masks
     n_keywords: int
     max_kw_len: int
 
     @property
     def words(self) -> int:
+        """Output bitmask words: 32 keyword bits per int32."""
         return max(1, (self.n_keywords + 31) // 32)
+
+    @property
+    def state_words(self) -> int:
+        """Shift-or state words per keyword: ceil(max_kw_len / 4)."""
+        return self.kw_words.shape[0]
 
 
 def build_literal_bank(keywords: list[bytes]) -> LiteralBank:
     kws = [bytes(_LOWER[np.frombuffer(k, np.uint8)]) for k in keywords]
     n = len(kws)
-    w4 = np.zeros(n, dtype=np.uint32)
-    m4 = np.zeros(n, dtype=np.uint32)
+    max_len = max((len(k) for k in kws), default=1)
+    n_state = max(1, (max_len + 3) // 4)
+    words = np.zeros((n_state, n), dtype=np.uint32)
+    masks = np.zeros((n_state, n), dtype=np.uint32)
     for i, k in enumerate(kws):
-        p = k[:4]
-        w4[i] = int.from_bytes(p.ljust(4, b"\0"), "little")
-        m4[i] = (1 << (8 * len(p))) - 1 if len(p) < 4 else 0xFFFFFFFF
-    return LiteralBank(kw_bytes=kws, kw_word4=w4, kw_mask4=m4,
-                       n_keywords=n,
-                       max_kw_len=max((len(k) for k in kws), default=1))
+        for w in range(n_state):
+            p = k[4 * w:4 * w + 4]
+            if not p:
+                break  # word fully past the keyword: mask 0 = always true
+            words[w, i] = int.from_bytes(p.ljust(4, b"\0"), "little")
+            masks[w, i] = (1 << (8 * len(p))) - 1 if len(p) < 4 \
+                else 0xFFFFFFFF
+    return LiteralBank(kw_bytes=kws, kw_words=words, kw_masks=masks,
+                       n_keywords=n, max_kw_len=max_len)
 
 
 @functools.partial(jax.jit, static_argnames=("n_words",))
-def prefix_scan(kw_word4, kw_mask4, chunks, *, n_words: int):
-    """chunks: uint8[B, L] (lowercased) → int32[B, W] candidate keyword
-    bitmask — bit k set iff keyword k's packed prefix occurs somewhere in
-    the chunk (superset of true occurrence; host confirms)."""
+def shiftor_scan(kw_words, kw_masks, chunks, *, n_words: int):
+    """chunks: uint8[B, L] (lowercased) → int32[B, W] EXACT keyword
+    bitmask — bit k set iff keyword k occurs somewhere in the chunk.
+
+    Flattened lax.scan over (keyword, state word) pairs: the carry
+    holds the per-position running AND of word compares (`match`,
+    reset at each keyword's word 0) and the accumulated output
+    bitmask. The shifted word plane for state word w is a
+    dynamic_slice of the single padded w4 plane at byte offset 4w —
+    dynamic on purpose: a static slice per word would be hoisted out
+    of the scan as W materialized [B, L] planes (state_words × the
+    input in live memory); the in-loop slice keeps the working set at
+    two [B, L] planes regardless of keyword length."""
     b, length = chunks.shape
+    n_state, n_kw = kw_words.shape
     c = chunks.astype(jnp.uint32)
     pad = jnp.pad(c, ((0, 0), (0, 4)))
     w4 = (pad[:, :length]
           | (pad[:, 1:length + 1] << 8)
           | (pad[:, 2:length + 2] << 16)
           | (pad[:, 3:length + 3] << 24))                  # [B, L]
+    # zero-pad so the shifted slice at offset 4w exists for every w;
+    # keywords never contain NULs the mask keeps, so padding cannot
+    # create a false positive
+    w4p = jnp.pad(w4, ((0, 0), (0, 4 * n_state)))
 
-    def step(acc, kw):
-        word, mask, ki = kw
-        hit = jnp.any(((w4 ^ word) & mask) == 0, axis=-1)  # [B]
+    steps = n_kw * n_state
+    ki = jnp.repeat(jnp.arange(n_kw, dtype=jnp.int32), n_state)
+    wi = jnp.tile(jnp.arange(n_state, dtype=jnp.int32), n_kw)
+    xs = (kw_words.T.reshape(-1), kw_masks.T.reshape(-1), ki, wi)
+
+    def step(carry, x):
+        match, acc = carry
+        word, mask, k, w = x
+        plane = jax.lax.dynamic_slice(w4p, (0, 4 * w), (b, length))
+        eq = ((plane ^ word) & mask) == 0                  # [B, L]
+        match = jnp.where(w == 0, eq, match & eq)
+        hit = jnp.any(match, axis=-1)                      # [B]
         bit = jnp.where(
-            jnp.arange(n_words, dtype=jnp.int32) == ki // 32,
-            jnp.int32(1) << (ki % 32), jnp.int32(0))       # [W]
-        return acc | jnp.where(hit[:, None], bit[None, :], 0), None
+            jnp.arange(n_words, dtype=jnp.int32) == k // 32,
+            jnp.int32(1) << (k % 32), jnp.int32(0))        # [W]
+        # fold the keyword's verdict in only after its LAST word
+        take = (w == n_state - 1) & hit[:, None]
+        acc = acc | jnp.where(take, bit[None, :], 0)
+        return (match, acc), None
 
-    init = jnp.zeros((b, n_words), dtype=jnp.int32)
-    ks = (kw_word4, kw_mask4,
-          jnp.arange(kw_word4.shape[0], dtype=jnp.int32))
-    acc, _ = jax.lax.scan(step, init, ks)
+    init = (jnp.zeros((b, length), dtype=bool),
+            jnp.zeros((b, n_words), dtype=jnp.int32))
+    (_, acc), _ = jax.lax.scan(step, init, xs, length=steps)
     return acc
 
 
@@ -135,9 +184,16 @@ def _pack_one_py(data: bytes, chunk_len: int, overlap: int) -> np.ndarray:
     arr = lower_bytes(data)
     rows = []
     for off in range(0, len(arr), stride):
-        piece = arr[off:off + chunk_len]
-        if off > 0 and len(piece) <= overlap:
+        # skip the final stride only when the previous chunk really
+        # covers the whole remaining tail. The previous chunk spans
+        # [off - stride, off - stride + chunk_len); when the stride is
+        # clamped (overlap ≥ chunk_len) that reaches only chunk_len -
+        # stride past `off`, NOT `overlap` past it — the old
+        # `len(piece) <= overlap` test dropped the uncovered tail of
+        # any multi-chunk file in that regime.
+        if off > 0 and len(arr) - off <= chunk_len - stride:
             break  # fully covered by the previous chunk
+        piece = arr[off:off + chunk_len]
         row = np.zeros(chunk_len, dtype=np.uint8)
         row[:len(piece)] = piece
         rows.append(row)

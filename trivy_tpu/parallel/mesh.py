@@ -133,14 +133,17 @@ def shard_table(table: AdvisoryTable, n_shards: int) -> ShardedTable:
     )
 
 
-def sharded_prefix_scan(mesh: Mesh, kw_word4, kw_mask4,
-                        chunks: np.ndarray, n_words: int) -> np.ndarray:
-    """Secret keyword prefilter sharded over EVERY mesh device: chunk
-    rows split across the flattened dp×db axes, the (tiny) keyword bank
-    replicated. The scan is embarrassingly parallel over rows, so GSPMD
-    partitions the already-jitted ac.prefix_scan from the input
-    shardings alone — no collectives, no shard_map. → int32[rows,
-    n_words] candidate masks in row order (SURVEY.md §2.7 P2)."""
+def sharded_shiftor_scan(mesh: Mesh, kw_words, kw_masks,
+                         chunks: np.ndarray, n_words: int) -> np.ndarray:
+    """Secret keyword engine sharded over EVERY mesh device: chunk
+    rows split across the flattened dp×db axes, the (tiny) multi-word
+    keyword bank replicated. The exact shift-or scan is embarrassingly
+    parallel over rows, so GSPMD partitions the already-jitted
+    ac.shiftor_scan from the input shardings alone — no collectives,
+    no shard_map — and the secrets lane rides the same mesh (and
+    meshguard fault domains, via the engine's breaker-guarded watch)
+    as the advisory join. → int32[rows, n_words] exact keyword
+    bitmasks in row order (SURVEY.md §2.7 P2)."""
     from jax.sharding import NamedSharding
 
     from ..ops import ac
@@ -153,12 +156,12 @@ def sharded_prefix_scan(mesh: Mesh, kw_word4, kw_mask4,
         chunks = padded
     row_sharded = NamedSharding(mesh, P(("dp", "db")))
     replicated = NamedSharding(mesh, P())
-    if isinstance(kw_word4, np.ndarray):  # callers may pre-replicate
-        kw_word4 = jax.device_put(kw_word4, replicated)
-    if isinstance(kw_mask4, np.ndarray):
-        kw_mask4 = jax.device_put(kw_mask4, replicated)
-    out = ac.prefix_scan(
-        kw_word4, kw_mask4, jax.device_put(chunks, row_sharded),
+    if isinstance(kw_words, np.ndarray):  # callers may pre-replicate
+        kw_words = jax.device_put(kw_words, replicated)
+    if isinstance(kw_masks, np.ndarray):
+        kw_masks = jax.device_put(kw_masks, replicated)
+    out = ac.shiftor_scan(
+        kw_words, kw_masks, jax.device_put(chunks, row_sharded),
         n_words=n_words)
     # lazy slice: stays on device so per-piece calls keep pipelining
     return out[:rows]

@@ -49,6 +49,7 @@ SITES = (
     "db.download",        # db/download.py OCI artifact pull
     "fanal.walk",         # fanal/pipeline.py per-layer walker stage
     "fanal.analyze",      # fanal/pipeline.py analyzer-batch stage
+    "secret.prefilter",   # secret/engine.py device keyword engine
     "memo.get",           # fleet/memo.py result-memo reads (graftmemo)
     "memo.put",           # fleet/memo.py result-memo writes
 )

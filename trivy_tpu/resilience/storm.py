@@ -92,12 +92,19 @@ _MEMO_FAULTS = (
 )
 # fanald ingest faults (ingest topology only): the pipeline absorbs
 # every one as an annotated partial result — plus the hostile_layer
-# event kind, which swaps the load to a corrupt/bomb artifact variant
+# event kind, which swaps the load to a corrupt/bomb artifact variant,
+# and the secrets lane: the ingest fixtures carry real tokens scanned
+# through the DEVICE keyword engine (small-batch floor forced to 0),
+# and a secret.prefilter fault must degrade that scan to the host
+# engine bit-identically (the exact-match contract both paths share)
+# with the shared detect breaker re-closing after settle
 _INGEST_FAULTS = (
     ("fanal.walk", "error"), ("fanal.walk", "hang"),
     ("fanal.walk", "flaky"),
     ("fanal.analyze", "error"), ("fanal.analyze", "hang"),
     ("fanal.analyze", "flaky"),
+    ("secret.prefilter", "error"), ("secret.prefilter", "hang"),
+    ("secret.prefilter", "flaky"),
 )
 HOSTILE_VARIANTS = ("truncated", "bomb")
 
@@ -738,6 +745,30 @@ class IngestTopology(SingleTopology):
             max_members=5000, layer_deadline_ms=w * 4.0,
             max_inflight_bytes=4 << 20, max_ratio=50.0,
             ratio_floor=64 << 10)
+        # ONE shared secret scanner with the small-batch floor forced
+        # to 0: every request's token file goes through the DEVICE
+        # keyword engine, so an armed `secret.prefilter` failpoint
+        # genuinely fires (per-layer fixture bytes never cross the
+        # production 2 MiB floor) and degrades to the host engine
+        # bit-identically. Shared on purpose — concurrent scans reuse
+        # one bank and one jit cache, like a server process would.
+        # The bank is cut to the two rules the fixture plants: the
+        # drill needs the device path, the failpoint, and host parity
+        # — not all 86 rules — and the full bank's jnp scan on a CPU
+        # test host (~0.7 s/launch) would outlive the chaos-tuned
+        # watchdog on EVERY scan, turning the whole run into breaker
+        # churn with nothing armed.
+        from ..secret import SecretScanner
+        from ..secret.rules import BUILTIN_RULES
+        self.secret_scanner = SecretScanner(
+            rules=[r for r in BUILTIN_RULES
+                   if r.id in ("github-pat", "aws-access-key-id")],
+            small_batch_bytes=0)
+        # absorb the one-time jit compile OUTSIDE any watch: the first
+        # request's prefilter would otherwise spend seconds compiling
+        # under the 50 ms chaos watchdog and trip the shared breaker
+        # before the schedule even starts
+        self.secret_scanner._keyword_masks_device([b"warmup " * 8])
         # LIFO of armed hostile windows: overlapping windows must not
         # clobber each other (the earlier window's revert would
         # otherwise clear a later, still-armed one). Mutated only by
@@ -780,7 +811,8 @@ class IngestTopology(SingleTopology):
         t0 = time.perf_counter()
         try:
             art = ImageArchiveArtifact(path, cache,
-                                       scanners=("vuln",),
+                                       scanners=("vuln", "secret"),
+                                       secret_scanner=self.secret_scanner,
                                        ingest=self.ingest_opts)
             ref = art.inspect()
         except Exception as e:  # noqa: BLE001 — containment breach
@@ -802,7 +834,7 @@ class IngestTopology(SingleTopology):
                 self.url, "/twirp/trivy.scanner.v1.Scanner/Scan",
                 {"target": f"ingest-{idx}", "artifact_id": ref.id,
                  "blob_ids": ref.blob_ids,
-                 "options": {"scanners": ["vuln"]}},
+                 "options": {"scanners": ["vuln", "secret"]}},
                 timeout=timeout,
                 headers={"X-Trivy-Deadline-Ms":
                          str(int(timeout * 1e3))})
@@ -858,9 +890,19 @@ def build_ingest_archive(path: str, doc: dict, variant: str,
     apk_db = ("\n".join(blocks) + "\n").encode()
     os_release = (b'NAME="Alpine Linux"\nID=alpine\n'
                   b'VERSION_ID=3.17.3\n')
+    # the secrets lane: a per-request token file (the doc's pkg set
+    # salts the content so per-request responses differ) scanned
+    # through the DEVICE keyword engine by IngestTopology's shared
+    # small_batch_bytes=0 scanner — the `secret.prefilter` fault
+    # window degrades exactly this scan
+    secret_cfg = (
+        f"# storm secrets lane ({pkgs[0]['Name']})\n"
+        f"github_token = ghp_{'a' * 36}\n"
+        f"aws_access_key_id = \"AKIA{'Z' * 16}\" \n").encode()
     layer_tars = [
         tar_bytes({"etc/os-release": os_release}),
-        tar_bytes({"lib/apk/db/installed": apk_db}),
+        tar_bytes({"lib/apk/db/installed": apk_db,
+                   "app/config.txt": secret_cfg}),
         tar_bytes({"usr/share/doc/pad.txt": b"pad " * 256}),
     ]
     blobs = [gz_bytes(t) for t in layer_tars]

@@ -1,5 +1,5 @@
-"""Secret scanning: device Aho-Corasick keyword prefilter + host regex
-confirmation with the reference's rule semantics."""
+"""Secret scanning: exact device shift-or keyword matching + host
+regex confirmation with the reference's rule semantics."""
 
 from .engine import SecretScanner  # noqa: F401
 from .rules import BUILTIN_RULES  # noqa: F401

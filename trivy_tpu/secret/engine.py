@@ -1,28 +1,55 @@
-"""SecretScanner: batched keyword prefilter on device, exact rule
-confirmation on host.
+"""SecretScanner v2: exact multi-pattern keyword matching on device,
+regex confirmation on host.
 
 Parity contract with the reference scanner (pkg/fanal/secret/scanner.go
 Scan:341-418): per file — global allow paths, per-rule path gates, keyword
-prefilter (here: one device Aho-Corasick pass over all files × all rules
+prefilter (here: one device shift-or pass over all files × all rules
 instead of bytes.Contains per rule per file), regex locations with optional
 secret-group submatch, allow regexes, exclude blocks, censoring, line/
 context extraction (findLocation:447-504), finding sort.
+
+Engine v1 ran a 4-byte-prefix SUPERSET filter on device and re-confirmed
+every candidate with a host substring pass; v2's device bitmask is exact
+(ops/ac.py has the shift-or derivation), so the host stage is "run the
+regex for gated rules" and nothing else. Three prefilter paths, counted
+by `trivy_tpu_secret_prefilter_path_total{path=}`:
+
+  pallas  the ops/shiftor_pallas VMEM kernel (TPU backends)
+  jnp     ops/ac.shiftor_scan — the CPU path, and the mesh path via
+          parallel.mesh.sharded_shiftor_scan (chunk rows sharded over
+          every device, so the secrets lane rides meshguard's fault
+          domains exactly like the join). Known cost: the meshed lane
+          pays the jnp scan's n_keywords × state_words HBM passes per
+          shard — dispatching the Pallas kernel per shard under
+          shard_map is the open follow-up, deferred until a live-TPU
+          round can validate it
+  host    bytes.find per keyword — small batches (the device cannot
+          amortize dispatch latency under `small_batch_bytes`), the
+          graftguard fallback while the detect breaker is open, and
+          the parity oracle tier-1 gates the device paths against
+
+`scan_files_many` is the coalesced entry: fanald's pipelined layer walk
+hands EVERY missing layer's secret files to one call, so one device
+launch serves many concurrent layers the way detectd coalesces joins —
+per-layer calls rarely cross the small-batch floor, coalesced ones do.
 """
 
 from __future__ import annotations
 
 import re
+import threading
 from typing import Optional
 
 import numpy as np
 
 from .. import types as T
+from ..metrics import METRICS
 from ..obs import span
 from ..ops import ac
 from .rules import BUILTIN_RULES, GLOBAL_ALLOW_RULES, Rule
 
 CHUNK_LEN = 16384
-# Max chunk rows per prefix_scan call. Large on purpose: the dominant
+# Max chunk rows per shiftor_scan call. Large on purpose: the dominant
 # cost of a device call is per-call (tunnel/dispatch) latency, so rows
 # are batched up to 4096 (64 MiB of chunk bytes) and padded to a power
 # of two so each bucket shape compiles exactly once.
@@ -38,7 +65,8 @@ class SecretScanner:
                  allow_rules: Optional[list] = None,
                  use_device: bool = True,
                  exclude_regexes: Optional[list] = None,
-                 mesh=None):
+                 mesh=None,
+                 small_batch_bytes: Optional[int] = None):
         self.rules = rules if rules is not None else BUILTIN_RULES
         self.global_allow = (allow_rules if allow_rules is not None
                              else GLOBAL_ALLOW_RULES)
@@ -46,8 +74,12 @@ class SecretScanner:
         self.global_exclude = exclude_regexes or []
         self.use_device = use_device
         # when set, the keyword prefilter shards chunk rows over every
-        # device of the dp×db mesh (parallel.mesh.sharded_prefix_scan)
+        # device of the dp×db mesh (parallel.mesh.sharded_shiftor_scan)
         self.mesh = mesh
+        # instance knob so coalesced callers (storm drills, bench) can
+        # force the device path on small fixtures
+        self.small_batch_bytes = SMALL_BATCH_BYTES \
+            if small_batch_bytes is None else small_batch_bytes
         # keyword → rule bitset mapping for the shared automaton
         self._keywords: list[bytes] = []
         self._kw_rules: list[list[int]] = []
@@ -68,6 +100,7 @@ class SecretScanner:
             if self._keywords else None
         self._device_arrays = None
         self._pallas_arrays = None
+        self._pallas_lock = threading.Lock()
         # tri-state: None = untried, True = compiled fine, False =
         # failed once (don't pay the compile attempt again)
         self._pallas_ok: Optional[bool] = None
@@ -75,25 +108,32 @@ class SecretScanner:
     # --- device prefilter ---
 
     def _keyword_masks(self, files: list[bytes]) -> list[set[int]]:
-        """→ per-file set of rule indices whose keywords appear.
+        """→ per-file set of rule indices whose keywords appear (exact:
+        the device bitmask IS keyword presence, no host re-confirm).
 
         graftguard: the device prefilter shares the detect breaker —
-        while it is open the host scan runs directly (same candidate
-        sets, the prefilter is exact either way), and device failures
-        here count toward opening it. The whole device pass runs under
+        while it is open the host scan runs directly (identical rule
+        sets, both paths are exact), and device failures here count
+        toward opening it. The whole device pass runs under
         GUARD.watch: its dispatch+gets are synchronous, so a clean
         exit is real execution success, a wedge arms the watchdog
         (trips the breaker for everyone else), and errors are recorded
-        exactly once by the watch."""
+        exactly once by the watch. The `secret.prefilter` failpoint
+        fires inside the watch, so chaos drills exercise exactly the
+        degradation a real device fault takes."""
         from ..resilience import GUARD, DeviceError
+        from ..resilience.failpoints import failpoint
         if self._bank is None:
             return [set() for _ in files]
-        if self.use_device and \
-                sum(len(f) for f in files) >= SMALL_BATCH_BYTES and \
+        total = sum(len(f) for f in files)
+        if self.use_device and total >= self.small_batch_bytes and \
                 GUARD.allow_device():
             try:
                 with GUARD.watch("detect.device_get"):
-                    return self._keyword_masks_device(files)
+                    failpoint("secret.prefilter")
+                    out, path = self._keyword_masks_device(files)
+                self._note_path(path, total)
+                return out
             except DeviceError:
                 # logged, not just swallowed: a DETERMINISTIC host-side
                 # bug landing here would open the shared breaker after
@@ -104,7 +144,14 @@ class SecretScanner:
                     "device keyword prefilter failed; falling back to "
                     "host scan (counted against the detect breaker)",
                     exc_info=True)
+        self._note_path("host", total)
         return self._keyword_masks_host(files)
+
+    @staticmethod
+    def _note_path(path: str, n_bytes: int) -> None:
+        METRICS.inc("trivy_tpu_secret_prefilter_path_total", path=path)
+        METRICS.inc("trivy_tpu_secret_scan_bytes_total", n_bytes,
+                    path=path)
 
     def _keyword_masks_host(self, files: list[bytes]) -> list[set[int]]:
         out = []
@@ -117,26 +164,33 @@ class SecretScanner:
             out.append(hit)
         return out
 
-    def _keyword_masks_device(self, files: list[bytes]) -> list[set[int]]:
+    def _keyword_masks_device(self, files: list[bytes]
+                              ) -> tuple[list[set[int]], str]:
+        """→ (per-file exact rule sets, the path that served the
+        launch: \"pallas\" | \"jnp\"). The path rides the return value,
+        not instance state — one scanner serves many request threads
+        (the storm topology and any server process), and a shared
+        last-path attribute would mislabel the per-path counters under
+        concurrency."""
         import jax
         bank = self._bank
         overlap = bank.max_kw_len - 1
         chunks, owner = ac.pack_chunks(files, CHUNK_LEN, overlap)
         out: list[set[int]] = [set() for _ in files]
         if chunks.shape[0] == 0:
-            return out
+            return out, "jnp"
         if self._device_arrays is None:
             if self.mesh is not None:
                 # replicate the (tiny) bank across the mesh once
                 from jax.sharding import NamedSharding, PartitionSpec
                 rep = NamedSharding(self.mesh, PartitionSpec())
                 self._device_arrays = (
-                    jax.device_put(bank.kw_word4, rep),
-                    jax.device_put(bank.kw_mask4, rep))
+                    jax.device_put(bank.kw_words, rep),
+                    jax.device_put(bank.kw_masks, rep))
             else:
-                self._device_arrays = (jax.device_put(bank.kw_word4),
-                                       jax.device_put(bank.kw_mask4))
-        kw_word4, kw_mask4 = self._device_arrays
+                self._device_arrays = (jax.device_put(bank.kw_words),
+                                       jax.device_put(bank.kw_masks))
+        kw_words, kw_masks = self._device_arrays
         # content-addressed dedup: container filesystems repeat whole
         # blocks across files/layers (vendored code, copied configs,
         # near-identical images), and the host→device link is the scan
@@ -171,21 +225,25 @@ class SecretScanner:
             # device_put, not jnp.asarray — the latter is an order of
             # magnitude slower for large host arrays on remote backends
             if self.mesh is not None:
-                from ..parallel.mesh import sharded_prefix_scan
-                futures.append(sharded_prefix_scan(
-                    self.mesh, kw_word4, kw_mask4, piece,
+                from ..parallel.mesh import sharded_shiftor_scan
+                futures.append(sharded_shiftor_scan(
+                    self.mesh, kw_words, kw_masks, piece,
                     n_words=bank.words))
             elif use_pallas:
                 try:
                     futures.append(self._pallas_scan(piece))
                 except Exception:
-                    self._pallas_ok = use_pallas = False
-                    futures.append(ac.prefix_scan(
-                        kw_word4, kw_mask4, jax.device_put(piece),
+                    # a silent downgrade here used to cost every later
+                    # scan its kernel with no signal — now it logs once
+                    # and shows up as path="jnp" in the path counter
+                    self._note_pallas_failure()
+                    use_pallas = False
+                    futures.append(ac.shiftor_scan(
+                        kw_words, kw_masks, jax.device_put(piece),
                         n_words=bank.words))
             else:
-                futures.append(ac.prefix_scan(
-                    kw_word4, kw_mask4, jax.device_put(piece),
+                futures.append(ac.shiftor_scan(
+                    kw_words, kw_masks, jax.device_put(piece),
                     n_words=bank.words))
         try:
             masks = np.concatenate(
@@ -194,77 +252,112 @@ class SecretScanner:
         except Exception:
             # async pallas failures surface here, not at dispatch —
             # record them so later batches skip straight to the
-            # lax.scan path instead of re-failing every scan
+            # shiftor_scan path instead of re-failing every scan
             if use_pallas:
-                self._pallas_ok = False
+                self._note_pallas_failure()
             raise
         if use_pallas:
-            self._pallas_ok = True
-        # confirm the (rare) device candidates exactly: the device tests
-        # only the packed 4-byte keyword prefix, so confirm the full
-        # keyword in the chunk's (lowercased, overlap-including) bytes
-        # before gating any rule — parity with bytes.Contains. Bit
-        # decode is vectorized (unpackbits + nonzero): the per-word
-        # Python bit loop was ~1 s on a 64 MiB corpus.
+            # monotonic, under the lock: never re-arm a failed kernel.
+            # A scan that dispatched via pallas BEFORE a concurrent
+            # thread recorded a deterministic failure must not flip
+            # the flag back and re-pay the failing compile (and the
+            # downgrade log) on every later scan — only None→True.
+            with self._pallas_lock:
+                if self._pallas_ok is None:
+                    self._pallas_ok = True
+        # decode the EXACT bitmask: a set bit means the full keyword
+        # occurs in that chunk row, so file hits are direct unions —
+        # the v1 substring re-confirm is gone. Bit decode is
+        # vectorized (unpackbits + nonzero): the per-word Python bit
+        # loop was ~1 s on a 64 MiB corpus.
         u8 = np.ascontiguousarray(
             masks.astype(np.uint32)).view(np.uint8)
         bits = np.unpackbits(u8, axis=1, bitorder="little")
-        cand_ci, cand_ki = np.nonzero(bits[:, :bank.n_keywords])
+        hit_ci, hit_ki = np.nonzero(bits[:, :bank.n_keywords])
         owner_l = owner.tolist()
-        confirmed: set[tuple[int, int]] = set()
-        row_cache: dict[int, bytes] = {}
-        for ci, ki in zip(cand_ci.tolist(), cand_ki.tolist()):
-            fi = owner_l[ci]
-            ck = (fi, ki)
-            if ck in confirmed:
-                continue
-            row_bytes = row_cache.get(ci)
-            if row_bytes is None:
-                row_bytes = row_cache[ci] = chunks[ci].tobytes()
-            if bank.kw_bytes[ki] in row_bytes:
-                confirmed.add(ck)
-                out[fi].update(self._kw_rules[ki])
-        return out
+        for ci, ki in zip(hit_ci.tolist(), hit_ki.tolist()):
+            out[owner_l[ci]].update(self._kw_rules[ki])
+        return out, ("pallas" if use_pallas else "jnp")
+
+    def _note_pallas_failure(self) -> None:
+        with self._pallas_lock:
+            self._pallas_ok = False
+        from ..log import get as _get_logger
+        _get_logger("secret").warning(
+            "pallas shiftor kernel failed; this process downgrades the "
+            "secret prefilter to the jnp scan (path=\"jnp\" in "
+            "trivy_tpu_secret_prefilter_path_total)", exc_info=True)
 
     def _pallas_scan(self, piece: np.ndarray):
         """One padded [B, CHUNK_LEN] batch through the Pallas TPU
-        kernel (ops.prefilter_pallas) — single-VMEM-pass keyword
-        matching, ~16× the lax.scan path on a v5e."""
+        kernel (ops.shiftor_pallas) — single-VMEM-pass exact keyword
+        matching; the jnp scan re-reads HBM once per (keyword, state
+        word)."""
         import jax
 
-        from ..ops import prefilter_pallas as pp
+        from ..ops import shiftor_pallas as sp
         if self._pallas_arrays is None:
             self._pallas_arrays = tuple(
-                jax.device_put(a) for a in pp.pack_bank(self._bank))
+                jax.device_put(a) for a in sp.pack_bank(self._bank))
         kww, kwm, bit = self._pallas_arrays
-        return pp.prefilter(kww, kwm, bit, jax.device_put(piece),
-                            n_words=self._bank.words)
+        return sp.shiftor(kww, kwm, bit, jax.device_put(piece),
+                          n_words=self._bank.words)
 
     # --- host confirmation (exact reference semantics) ---
 
     def scan_files(self, files: list[tuple[str, bytes]]) -> list[T.Secret]:
         """files: [(path, content)] → per-file Secret results (empty
         findings omitted)."""
-        from ..metrics import METRICS
+        return self.scan_files_many([files])[0]
+
+    def scan_files_many(self, batches: list[list[tuple[str, bytes]]]
+                        ) -> list[list[T.Secret]]:
+        """Coalesced entry: ONE keyword-prefilter launch over every
+        batch's files (fanald hands each missing layer as one batch),
+        then per-file regex confirmation. Results are per batch, in
+        batch/file order — bit-identical to per-batch scan_files calls
+        by construction (the prefilter is exact either way; only the
+        device launch is shared)."""
+        files = [fc for batch in batches for fc in batch]
         contents = [c for _, c in files]
         with span("secret.prefilter", files=len(files),
+                  batches=len(batches),
                   bytes=sum(len(c) for c in contents)) as sp:
             masks = self._keyword_masks(contents)
-            sp.attrs["candidates"] = sum(len(m) for m in masks)
-        results = []
+            flagged = sum(len(m) for m in masks)
+            sp.attrs["candidates"] = flagged
+        results: list[list[T.Secret]] = []
+        confirmed = 0
+        it = iter(zip(files, masks))
         with span("secret.confirm", files=len(files)) as sp:
-            for (path, content), rule_idx in zip(files, masks):
-                rule_idx = set(rule_idx) | set(self._no_keyword_rules)
-                sec = self.scan_file(path, content,
-                                     candidate_rules=rule_idx)
-                if sec.findings:
-                    results.append(sec)
-            sp.attrs["findings"] = sum(len(s.findings) for s in results)
+            for batch in batches:
+                out = []
+                for _ in batch:
+                    (path, content), rule_idx = next(it)
+                    gated = set(rule_idx)
+                    sec = self.scan_file(
+                        path, content,
+                        candidate_rules=gated
+                        | set(self._no_keyword_rules))
+                    if sec.findings:
+                        out.append(sec)
+                        hit_ids = {f.rule_id for f in sec.findings}
+                        confirmed += sum(
+                            1 for ri in gated
+                            if self.rules[ri].id in hit_ids)
+                results.append(out)
+            sp.attrs["findings"] = sum(len(s.findings)
+                                       for out in results for s in out)
+        if flagged:
+            # regex yield of the keyword gate: how many gated
+            # (file, rule) candidates actually produced a finding
+            METRICS.observe("trivy_tpu_secret_candidate_precision",
+                            confirmed / flagged)
         METRICS.inc("trivy_tpu_secret_files_total", len(files))
         METRICS.inc("trivy_tpu_secret_bytes_total",
                     sum(len(c) for c in contents))
         METRICS.inc("trivy_tpu_secret_findings_total",
-                    sum(len(s.findings) for s in results))
+                    sum(len(s.findings) for out in results for s in out))
         return results
 
     def scan_file(self, path: str, content: bytes,
