@@ -326,6 +326,7 @@ def bench_secrets_device(n_files=SECRET_FILES,
     from trivy_tpu.ops import ac
     from trivy_tpu.secret.engine import SecretScanner
     corpus = _secret_corpus(n_files, file_bytes)
+    prof0 = _graftprof_snapshot()
     contents = [c for _, c in corpus]
     per_layer = max(1, len(corpus) // SECRET_LAYERS)
     layers = [corpus[i:i + per_layer]
@@ -367,6 +368,10 @@ def bench_secrets_device(n_files=SECRET_FILES,
         },
         "secret_prefilter_path": served,
         "secret_corpus_mb": round(total_mb, 1),
+        # the dispatch ledger's aggregate over this scenario's own
+        # launches (waste ratio, compile count/ms, bytes moved) —
+        # perfcheck-consumable device attribution per round
+        "graftprof": _graftprof_delta(prof0),
     }
 
 
@@ -405,6 +410,7 @@ def bench_archive_e2e(table):
 
     rng = np.random.default_rng(13)
     installed_pool = synth_versions(rng, major_lo=4, major_hi=9)
+    prof0 = _graftprof_snapshot()
 
     def installed_db(i):
         names = rng.integers(0, N_PKG_NAMES, 40)
@@ -525,6 +531,7 @@ def bench_archive_e2e(table):
     ips = (ARCHIVE_IMAGES - 1) / dt
     ips_serial = (ARCHIVE_IMAGES - 1) / dt_serial
     return {
+        "graftprof": _graftprof_delta(prof0),
         "images_per_sec_archive_e2e": round(ips, 2),
         "images_per_sec_archive_serial": round(ips_serial, 2),
         "archive_pipeline_speedup": round(ips / max(ips_serial, 1e-9),
@@ -625,6 +632,41 @@ def _occupancy_snapshot():
     _row, total, count = METRICS.hist_get(
         "trivy_tpu_batch_occupancy_ratio")
     return total, count
+
+
+def _graftprof_snapshot():
+    from trivy_tpu.obs.perf import LEDGER
+    return LEDGER.aggregate()
+
+
+def _graftprof_delta(before):
+    """graftprof ledger aggregate covering ONE scenario: the counter
+    deltas since `before` (= _graftprof_snapshot() at scenario start),
+    with the waste ratio recomputed over just this window's rows —
+    the per-scenario block perfcheck diffs across bench rounds."""
+    after = _graftprof_snapshot()
+
+    def diff(a, b):
+        out = {}
+        for k, v in a.items():
+            if isinstance(v, dict):
+                out[k] = diff(v, b.get(k) if isinstance(b.get(k), dict)
+                              else {})
+            elif isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                ov = b.get(k)
+                out[k] = round(v - ov, 3) \
+                    if isinstance(ov, (int, float)) else v
+        return out
+
+    d = diff(after, before)
+    real = d.get("real_rows") or 0
+    padded = d.get("padded_rows") or 0
+    d["padding_waste_ratio"] = round(1.0 - real / padded, 4) \
+        if padded else None
+    # shape-set size is a level, not a counter — report the current one
+    d["distinct_shapes"] = after.get("distinct_shapes")
+    return d
 
 
 def bench_server_concurrency(table):
@@ -940,6 +982,7 @@ def bench_server_fleet(table):
         return {"ips": ips, "digests": digests, "failed": failed,
                 "failovers": int(failovers), "readmitted": readmitted}
 
+    prof0 = _graftprof_snapshot()
     one = run_point(1)
     many = run_point(FLEET_REPLICAS)
     drill = run_point(FLEET_REPLICAS, kill=True)
@@ -948,6 +991,7 @@ def bench_server_fleet(table):
                  and all(drill["digests"].get(i) == baseline.get(i)
                          for i in range(FLEET_IMAGES)))
     return {
+        "graftprof": _graftprof_delta(prof0),
         "replicas": FLEET_REPLICAS,
         "ips_1_replica": round(one["ips"], 1),
         f"ips_{FLEET_REPLICAS}_replicas": round(many["ips"], 1),
@@ -1309,6 +1353,9 @@ def device_child_main():
         "device": str(jax.devices()[0]),
         "build_s": build_s,
         "scan_s": dev_s,
+        # chip-in-the-loop dispatch-ledger aggregate — the graftprof
+        # block the round's baselines (and perfcheck diffs) read
+        "graftprof": _graftprof_snapshot(),
     }
     print(json.dumps(payload))
 
@@ -1702,6 +1749,11 @@ def main():
         except Exception as e:
             diag.append(f"archive e2e bench failed: {e}")
 
+        # graftprof: the whole CPU pass's dispatch-ledger aggregate
+        # (waste ratio, compile count/ms, bytes moved) — the device
+        # child's ledger overrides when the chip answers
+        result["graftprof"] = _graftprof_snapshot()
+
         dev = None
         dev_source = "live"
         dev_stale = False
@@ -1783,6 +1835,8 @@ def main():
                 result["fleet_dedup"] = dev["fleet_dedup"]
             if dev.get("chaos_storm"):
                 result["chaos_storm"] = dev["chaos_storm"]
+            if dev.get("graftprof"):
+                result["graftprof"] = dev["graftprof"]
             if dev.get("archive_e2e"):
                 # chip-in-the-loop archive headline overrides the
                 # CPU-backend pass

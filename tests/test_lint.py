@@ -1119,3 +1119,84 @@ def test_memo_failpoint_sites_in_catalog():
         pass
     else:
         raise AssertionError("typo'd memo site must fail at parse")
+
+
+def test_obs_perf_in_lock_hygiene_scope():
+    """Satellite (PR 13): graftprof (obs/perf.py) — one LEDGER/PROF
+    is shared across every handler thread, the detectd dispatcher,
+    and the auto-capture thread — rides obs/'s TPU106 scope."""
+    src = (
+        "import threading\n"
+        "class Ledger:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._shapes = {}\n"
+        "    def bad(self, k):\n"
+        "        self._shapes[k] = 1\n"
+        "    def good(self, k):\n"
+        "        with self._lock:\n"
+        "            self._shapes[k] = 1\n"
+    )
+    fs = _lint("trivy_tpu/obs/perf.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+    assert _lint("trivy_tpu/report/fixture.py", src) == []
+
+
+def test_obs_perf_no_clocks_or_metrics_in_device_code():
+    """Satellite (PR 13): TPU107 — graftprof is host orchestration by
+    charter; a ledger note's clock read or METRICS write inside a
+    jitted core would time the trace and count compilations, so a
+    seeded violation in obs/perf.py must be caught."""
+    src = (
+        "import time, jax\n"
+        "from trivy_tpu.metrics import METRICS\n"
+        "def _ledger_core(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    METRICS.observe('trivy_tpu_device_compile_ms', t0)\n"
+        "    return x + 1\n"
+        "j = jax.jit(_ledger_core)\n"
+    )
+    fs = _lint("trivy_tpu/obs/perf.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU107", 4),
+                                              ("TPU107", 5)]
+
+
+def test_obs_perf_no_resilience_in_device_code():
+    """Satellite (PR 13): TPU108 — the profiler's admission/breaker
+    reads stay on the host; a seeded GUARD/failpoint use inside a
+    jitted core in obs/perf.py must be caught."""
+    src = (
+        "import jax\n"
+        "from trivy_tpu.resilience import GUARD, failpoint\n"
+        "def _prof_core(x):\n"
+        "    failpoint('profile.capture')\n"
+        "    if GUARD.allow_device():\n"
+        "        x = x + 1\n"
+        "    return x\n"
+        "j = jax.jit(_prof_core)\n"
+    )
+    fs = _lint("trivy_tpu/obs/perf.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU108", 4),
+                                              ("TPU108", 5)]
+
+
+def test_device_series_in_catalog():
+    """Satellite (PR 13): every trivy_tpu_device_* series graftprof
+    emits is declared in the metrics.py catalog with type + help —
+    TPU109 closes the loop from call site to catalog."""
+    from trivy_tpu.analysis.metrics_catalog import load_catalog
+    cat = load_catalog()
+    want = {
+        "trivy_tpu_device_dispatches_total": "counter",
+        "trivy_tpu_device_padding_waste_ratio": "histogram",
+        "trivy_tpu_device_compile_ms": "histogram",
+        "trivy_tpu_device_transfer_bytes_total": "counter",
+        "trivy_tpu_device_hit_budget_adaptations_total": "counter",
+        "trivy_tpu_device_hbm_bytes": "gauge",
+        "trivy_tpu_device_resident_bytes": "gauge",
+        "trivy_tpu_profile_captures_total": "counter",
+    }
+    for name, kind in want.items():
+        assert name in cat, name
+        assert cat[name].kind == kind
+        assert cat[name].help

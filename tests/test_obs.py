@@ -382,7 +382,8 @@ class TestPropagation:
             obs_server + "/healthz").read())
         assert doc["status"] == "ok"
         assert set(doc["device"]) == {"platform", "device_count",
-                                      "last_dispatch_age_s"}
+                                      "last_dispatch_age_s",
+                                      "memory"}
         # after a scan the dispatch stamp is fresh and the backend
         # identity is resolved
         _push_and_scan(obs_server, tmp_path)

@@ -133,7 +133,6 @@ class TestAcceptance:
         a dispatch slowdown at c=8 — the victim's domain trips (device
         lost counted), the mesh shrinks and grows back, and every
         invariant probe passes."""
-        lost0 = METRICS.get("trivy_tpu_mesh_device_lost_total")
         sched = Schedule(seed=102, topology="mesh",
                          horizon_ms=1000.0, events=[
                              StormEvent(at_ms=60.0,
@@ -145,10 +144,22 @@ class TestAcceptance:
                                         mode="slow", arg=10.0,
                                         dur_ms=400.0),
                          ])
-        report = run_storm(sched, StormOptions(
-            requests=16, concurrency=8), table=table)
-        assert report.ok, report.violations
-        assert METRICS.get("trivy_tpu_mesh_device_lost_total") > lost0
+        # the device-lost observation is wall-clock coupled (like the
+        # fleet drill's failover count below): under heavy suite load
+        # the paced dispatches can slip past the domain-fault window —
+        # the drill's dispatches then fail on the BACKEND watchdog and
+        # the attribution probes rightly find every CPU device healthy.
+        # Allow one re-run for THAT side-assert; the invariant verdict
+        # must hold on every attempt.
+        for attempt in range(2):
+            lost0 = METRICS.get("trivy_tpu_mesh_device_lost_total")
+            report = run_storm(sched, StormOptions(
+                requests=16, concurrency=8), table=table)
+            assert report.ok, report.violations
+            if METRICS.get("trivy_tpu_mesh_device_lost_total") > lost0:
+                break
+        else:
+            raise AssertionError("no mesh device lost in 2 drills")
 
     def test_fleet_replica_kill_c8(self, table):
         """ISSUE acceptance (fleet): a replica kill overlapping seeded

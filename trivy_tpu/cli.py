@@ -163,16 +163,29 @@ def _add_watch_flags(p: argparse.ArgumentParser):
                    help="graftwatch SLO: the scan-latency threshold "
                         "the p99 objective is declared against "
                         "(default 2000)")
+    p.add_argument("--profile-auto-burn", type=float, default=0.0,
+                   help="graftprof: short-window SLO burn rate at/"
+                        "above which one live profile is auto-"
+                        "captured into the incident dir (cooldown-"
+                        "limited; 0 disables, the default)")
+    p.add_argument("--profile-cooldown-s", type=float, default=30.0,
+                   help="graftprof: minimum window between live "
+                        "profile captures (/debug/profile and the "
+                        "SLO auto-trigger share it; default 30)")
 
 
 def _configure_watch(args) -> None:
-    """Apply the graftwatch flags to the process singletons."""
-    from .obs import RECORDER, SLO
+    """Apply the graftwatch + graftprof flags to the process
+    singletons."""
+    from .obs import PROF, RECORDER, SLO
     RECORDER.configure(
         incident_dir=getattr(args, "incident_dir", "") or None,
         slow_trace_ms=getattr(args, "slow_trace_ms", None))
     SLO.configure(
         latency_threshold_ms=getattr(args, "slo_latency_ms", None))
+    PROF.configure(
+        cooldown_s=getattr(args, "profile_cooldown_s", None),
+        auto_burn_threshold=getattr(args, "profile_auto_burn", None))
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -532,14 +545,13 @@ def normalize_scanners(spec: str) -> tuple:
 def _scan_common(args, ref, cache, artifact_type: str) -> int:
     profile_dir = getattr(args, "profile_dir", "")
     if profile_dir:
-        # device-level tracing for the whole detect phase (reference
-        # has no device profiler; SURVEY §5 tracing row)
-        import jax
-        jax.profiler.start_trace(profile_dir)
-        try:
+        # device-level tracing for the whole detect phase, through
+        # graftprof's shared capture (one-at-a-time exclusivity with
+        # the server's /debug/profile plumbing — same start/stop
+        # path, no bespoke profiler block here)
+        from .obs.perf import PROF
+        with PROF.capture_dir(profile_dir):
             return _scan_common_inner(args, ref, cache, artifact_type)
-        finally:
-            jax.profiler.stop_trace()
     return _scan_common_inner(args, ref, cache, artifact_type)
 
 

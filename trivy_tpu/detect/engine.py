@@ -33,6 +33,7 @@ from ..db.table import AdvisoryTable
 from ..log import get as _get_logger
 from ..metrics import METRICS
 from ..obs import SLO, note_dispatch, recording, span
+from ..obs.perf import LEDGER, table_resident_bytes
 from ..ops import bucket_ladder, bucket_size
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
@@ -74,6 +75,7 @@ class _PendingCompact(NamedTuple):
     dense: Any
     h_cap: int
     t_pad: int
+    site: str = "detect"   # graftprof ledger attribution for the fetch
 
 
 def slice_bits(bits, off: int, n: int):
@@ -203,6 +205,11 @@ class BatchDetector:
         self._asm_pool = ThreadPoolExecutor(
             max_workers=assemble_workers,
             thread_name_prefix="detect-asm")
+        # graftprof memory telemetry: the table's columnar footprint,
+        # re-stamped on every detector build (so a DB hot swap's
+        # growth toward the HBM cliff is visible in /healthz)
+        LEDGER.note_resident("advisory_table",
+                             table_resident_bytes(table))
 
     def close(self) -> None:
         """Join the engine's worker threads. Idempotent; the engine is
@@ -287,9 +294,10 @@ class BatchDetector:
             if self._ver_dev is None \
                     or self._ver_dev_rows < self._ver_count \
                     or self._ver_dev.shape[0] < u_pad:
-                self._ver_dev = jax.device_put(
-                    self._ver_snapshot_locked(u_pad))
+                snap = self._ver_snapshot_locked(u_pad)
+                self._ver_dev = jax.device_put(snap)
                 self._ver_dev_rows = self._ver_count
+                LEDGER.note_resident("version_pool", snap.nbytes)
             return self._ver_dev
 
     # ---- batch pipeline -----------------------------------------------
@@ -442,36 +450,49 @@ class BatchDetector:
         floor_cap = bucket_size(self.hit_floor, self.hit_floor,
                                 self.pair_growth, align=self.hit_align)
         if adapt and budget > _HIT_BUDGET_MIN and floor_cap * 8 < t_pad:
+            adapted = False
             with self._lock:
                 self._hit_dense_streak += 1
                 if self._hit_dense_streak >= _HIT_LOW_STREAK:
                     self._hit_budget = max(self._hit_budget / 2,
                                            _HIT_BUDGET_MIN)
                     self._hit_dense_streak = 0
+                    adapted = True
+            if adapted:
+                LEDGER.note_budget_adapt("down")
         return 0
 
-    def _note_hits(self, n_hits: int, h_cap: int) -> None:
+    def _note_hits(self, n_hits: int, h_cap: int,
+                   site: str = "detect", t_pad: int = 0) -> None:
         """Adapt the hit budget from observed buffer occupancy, in
         powers of two so the compiled shape set stays bounded: an
         overflow (the dispatch fell back to the dense fetch) doubles
         it immediately; a sustained streak of <25%-full buffers halves
         it. Every compacted dispatch lands one occupancy observation —
-        the overflow-fallback rate is the histogram's >1.0 mass."""
+        the overflow-fallback rate is the histogram's >1.0 mass.
+        `site`/`t_pad` attribute the fill fraction and any adaptation
+        to the graftprof ledger's shape row."""
         METRICS.observe("trivy_tpu_detect_hit_occupancy",
                         n_hits / h_cap)
+        LEDGER.note_hits(site, t_pad, h_cap, n_hits)
+        adapted = None
         with self._lock:
             if n_hits > h_cap:
                 self._hit_budget = min(self._hit_budget * 2,
                                        _HIT_BUDGET_MAX)
                 self._hit_low_streak = 0
+                adapted = "up"
             elif n_hits * 4 <= h_cap:
                 self._hit_low_streak += 1
                 if self._hit_low_streak >= _HIT_LOW_STREAK:
                     self._hit_budget = max(self._hit_budget / 2,
                                            _HIT_BUDGET_MIN)
                     self._hit_low_streak = 0
+                    adapted = "down"
             else:
                 self._hit_low_streak = 0
+        if adapted:
+            LEDGER.note_budget_adapt(adapted)
 
     def _account_traffic(self, n_pairs: int, t_pad: int,
                          warm: bool = False) -> None:
@@ -549,8 +570,15 @@ class BatchDetector:
 
     def _launch(self, q_start: np.ndarray, q_count: np.ndarray,
                 q_ver: np.ndarray, total: int, t_pad: int, u_pad: int,
-                warm: bool = False, h_cap: int | None = None):
+                warm: bool = False, h_cap: int | None = None,
+                site: str = "detect"):
         """Ship CSR descriptors and launch the join (async).
+
+        graftprof: `site` attributes the dispatch in the ledger
+        ("detect" per-request, "detectd" via dispatch_merged); a
+        launch issued under GUARD.blameless() — a redetectd sweep
+        replay — re-tags itself "redetect" so background refresh
+        traffic never muddies the live-occupancy story.
 
         Compaction: when the hit-capacity policy engages (h_cap > 0),
         the compact kernel runs instead and the return value is a
@@ -567,6 +595,8 @@ class BatchDetector:
         the request completes either way with identical bits."""
         if h_cap is None:
             h_cap = self._hit_capacity(t_pad)
+        if GUARD.blameless_active():
+            site = "redetect"
         if not GUARD.allow_device():
             return self._host_join_csr(q_start, q_count, q_ver, total,
                                        t_pad, h_cap)
@@ -582,8 +612,11 @@ class BatchDetector:
             with GUARD.watch("detect.dispatch", record_success=False):
                 adv_lo, adv_hi, adv_flags = self.table.device_arrays()
                 ver_dev = self._ver_device(u_pad)
-                if self._note_shape(t_pad, int(q_start.shape[0]),
-                                    int(ver_dev.shape[0]), h_cap):
+                new_shape = self._note_shape(t_pad,
+                                             int(q_start.shape[0]),
+                                             int(ver_dev.shape[0]),
+                                             h_cap)
+                if new_shape:
                     failpoint("detect.compile")
                 failpoint("detect.dispatch")
                 args = (adv_lo, adv_hi, adv_flags, ver_dev,
@@ -591,14 +624,34 @@ class BatchDetector:
                         jax.device_put(q_count),
                         jax.device_put(q_ver),
                         np.int32(total))
-                if h_cap:
-                    hit_idx, hit_bits, n_hits, dense = \
-                        J.csr_pair_join_compact(*args, t_pad, h_cap)
-                    out = _PendingCompact(hit_idx, hit_bits, n_hits,
-                                          dense, h_cap, t_pad)
+
+                def _kernel():
+                    if h_cap:
+                        hit_idx, hit_bits, n_hits, dense = \
+                            J.csr_pair_join_compact(*args, t_pad, h_cap)
+                        return _PendingCompact(hit_idx, hit_bits,
+                                               n_hits, dense, h_cap,
+                                               t_pad, site)
+                    return J.csr_pair_join(*args, t_pad)
+
+                if new_shape:
+                    # a first-of-shape launch pays trace+lower+compile
+                    # synchronously inside this call (dispatch itself
+                    # is async and cheap): time it, span it so a
+                    # mid-measurement compile shows up in Perfetto,
+                    # and ledger it under the warmup/traffic phase
+                    with span("detect.compile", t_pad=t_pad,
+                              h_cap=h_cap, warm=warm):
+                        t0 = time.perf_counter()
+                        out = _kernel()
+                        compile_ms = (time.perf_counter() - t0) * 1e3
+                    LEDGER.note_compile(site, t_pad, h_cap,
+                                        compile_ms, warm=warm)
                 else:
-                    out = J.csr_pair_join(*args, t_pad)
+                    out = _kernel()
                 self._account_traffic(total, t_pad, warm=warm)
+                LEDGER.note_dispatch(site, total, t_pad, h_cap,
+                                     warm=warm)
                 return out
         except DeviceError:
             # logged with the chained traceback: the first
@@ -630,10 +683,13 @@ class BatchDetector:
                 hit_idx, hit_bits, n_hits = jax.device_get(
                     (dev.hit_idx, dev.hit_bits, dev.n_hits))
             n = int(n_hits)
-            self._note_hits(n, dev.h_cap)
+            self._note_hits(n, dev.h_cap, site=dev.site,
+                            t_pad=dev.t_pad)
+            compact_bytes = float(hit_idx.nbytes + hit_bits.nbytes
+                                  + n_hits.nbytes)
             METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
-                        float(hit_idx.nbytes + hit_bits.nbytes
-                              + n_hits.nbytes), path="compact")
+                        compact_bytes, path="compact")
+            LEDGER.note_transfer("compact", compact_bytes)
             if n > dev.h_cap:
                 # overflow: the buffer holds only a prefix of the
                 # hits — this dispatch pays the dense fetch instead
@@ -642,6 +698,10 @@ class BatchDetector:
                     bits = jax.device_get(dev.dense)
                 METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
                             float(bits.nbytes), path="dense")
+                # ledger path "overflow": same bytes as a dense fetch,
+                # but distinguishable — this transfer was paid ON TOP
+                # of the wasted compact one
+                LEDGER.note_transfer("overflow", float(bits.nbytes))
                 return bits
             return CompactBits(hit_idx[:n], hit_bits[:n], dev.t_pad)
         with GUARD.watch("detect.device_get"):
@@ -649,6 +709,7 @@ class BatchDetector:
             out = jax.device_get(dev)
         METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
                     float(out.nbytes), path="dense")
+        LEDGER.note_transfer("dense", float(out.nbytes))
         return out
 
     def _fetch_or_fallback(self, prep: _Prepared, dev) -> np.ndarray:
@@ -723,8 +784,11 @@ class BatchDetector:
             self._merge_descriptors(preps)
         with span("detect.dispatch", n_pairs=total, t_pad=t_pad,
                   merged=len(preps)):
+            # site="detectd": a merged dispatch is ONE ledger row, so
+            # the per-site sums reconcile with the batch counter
+            # without double-counting the coalesced requests
             out = self._launch(q_start, q_count, q_ver, total, t_pad,
-                               u_pad)
+                               u_pad, site="detectd")
         note_dispatch()
         return out, offsets, t_pad
 

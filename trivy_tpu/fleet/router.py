@@ -250,13 +250,24 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         self._trace_id = ""  # never echo a previous POST's id
-        if self.path.startswith(("/debug/traces", "/debug/incidents")):
+        if self.path.startswith(("/debug/traces", "/debug/incidents",
+                                 "/debug/perf", "/debug/profile")):
             token = self.state.opts.token
             if token and self.headers.get(TOKEN_HEADER) != token:
                 return self._json(401, {"code": "unauthenticated",
                                         "msg": "invalid token"})
             if self.path.startswith("/debug/traces"):
                 self._json(200, debug_traces_payload(self.path))
+            elif self.path.startswith("/debug/perf"):
+                # the router dispatches nothing itself; its ledger is
+                # usually empty but the surface is uniform — tooling
+                # asks every process the same question
+                from ..obs.perf import debug_perf_payload
+                self._json(200, debug_perf_payload())
+            elif self.path.startswith("/debug/profile"):
+                from ..obs.perf import debug_profile_payload
+                code, payload = debug_profile_payload(self.path)
+                self._json(code, payload)
             else:
                 self._json(200, debug_incidents_payload())
         elif self.path == "/healthz":

@@ -411,6 +411,65 @@ METRICS.declare(
     "trivy_tpu_redetect_active", "gauge",
     "redetectd sweep state: 1 while a background re-detect sweep is "
     "running, 0 otherwise.")
+METRICS.declare(
+    "trivy_tpu_device_dispatches_total", "counter",
+    "graftprof dispatch ledger: accepted device launches by site "
+    "(site=\"detect\" single-chip engine, \"detectd\" merged "
+    "coalesced dispatches, \"mesh\" sharded mesh launches, "
+    "\"secret\" the shift-or secrets engine, \"redetect\" blameless "
+    "redetectd sweep replays). Warmup launches are compiles, not "
+    "traffic, and are excluded.")
+METRICS.declare(
+    "trivy_tpu_device_padding_waste_ratio", "histogram",
+    "Padding waste per device dispatch by launch site: (padded rows "
+    "- real rows) / padded rows (0.0 = perfectly full dispatch; the "
+    "complement of occupancy, ledger-attributed per site).",
+    buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+             0.95, 1.0))
+METRICS.declare(
+    "trivy_tpu_device_compile_ms", "histogram",
+    "First-dispatch-of-shape compile wall time in milliseconds, by "
+    "phase (phase=\"warmup\" pre-compiles from warmup()/--detect-"
+    "warmup, phase=\"traffic\" compiles paid by a live request — "
+    "the ones a latency page cares about; each lands under a "
+    "detect.compile span so it shows up in Perfetto too).",
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+             1000.0, 2500.0, 5000.0, 15000.0, 60000.0))
+METRICS.declare(
+    "trivy_tpu_device_transfer_bytes_total", "counter",
+    "graftprof ledger: device->host result bytes by path "
+    "(path=\"compact\" O(hits) hit buffers, path=\"dense\" full "
+    "padded vectors, path=\"overflow\" the dense re-fetch a hit-"
+    "buffer overflow pays on top of its wasted compact fetch) — "
+    "unlike trivy_tpu_detect_transfer_bytes_total this series "
+    "separates the overflow re-fetch and covers every ledger site.")
+METRICS.declare(
+    "trivy_tpu_device_hit_budget_adaptations_total", "counter",
+    "Hit-buffer budget adaptations in the compaction epilogue "
+    "(direction=\"up\" an overflow doubled the budget, "
+    "direction=\"down\" a sustained sparse streak halved it) — "
+    "sustained flapping means the workload's hit density is bimodal "
+    "and the streak window needs retuning.")
+METRICS.declare(
+    "trivy_tpu_device_hbm_bytes", "gauge",
+    "Backend memory stats per device (kind=\"in_use\"/\"limit\"/"
+    "\"peak\"), sampled (throttled) on the dispatch path; backends "
+    "without memory_stats (CPU) never set this series.")
+METRICS.declare(
+    "trivy_tpu_device_resident_bytes", "gauge",
+    "Host-resident footprint of the big scan structures "
+    "(component=\"advisory_table\" columnar arrays, "
+    "\"version_pool\" the encoded version matrix, \"secret_bank\" "
+    "the shift-or word/mask planes) — the table-growth-toward-the-"
+    "HBM-cliff early warning /healthz surfaces.")
+METRICS.declare(
+    "trivy_tpu_profile_captures_total", "counter",
+    "graftprof live profiler captures (reason=\"manual\" the "
+    "/debug/profile endpoint, \"slo_burn\" the SLO auto-trigger, "
+    "\"cli\" a --profile-dir scan; anything else clamps to "
+    "\"other\" so operator-supplied reasons cannot mint unbounded "
+    "series) — one-at-a-time and cooldown-limited, so this counts "
+    "windows, not requests.")
 METRICS.declare("trivy_tpu_secret_files_total", "counter",
                 "Files through the secret scanner.")
 METRICS.declare("trivy_tpu_secret_bytes_total", "counter",

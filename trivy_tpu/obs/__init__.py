@@ -18,8 +18,19 @@ export) and `device` the cached backend view /healthz serves. v2
             fragments from the router + every replica and stitches
             one Chrome/Perfetto document via forwarded parent-span
             ids (X-Trivy-Parent-Span).
-  check     offline validator for incident files and trace dumps
-            (`python -m trivy_tpu.obs.check`), wired into tier-1.
+  check     offline validator for incident files, trace dumps, and
+            profile manifests (`python -m trivy_tpu.obs.check`),
+            wired into tier-1.
+
+v3 ("graftprof") adds the device-performance layer:
+
+  perf      the dispatch ledger (per-shape padded-vs-real rows,
+            compile wall time, device→host bytes, hit-buffer fill),
+            HBM/resident-memory telemetry, and the live jax.profiler
+            capture behind /debug/profile (operator-requested or SLO
+            burn-triggered).
+  perfcheck the noise-aware bench-tail regression gate
+            (`python -m trivy_tpu.obs.perfcheck OLD.json NEW.json`).
 
 Metrics live in `trivy_tpu.metrics` (the registry predates this
 package and is imported everywhere). See ARCHITECTURE.md "Fleet
@@ -28,6 +39,7 @@ and SLO definitions.
 """
 
 from .device import device_status, note_dispatch
+from .perf import LEDGER, PROF
 from .recorder import RECORDER
 from .slo import SLO
 from .trace import (COLLECTOR, add_attr, chrome_trace, current_span_id,
@@ -35,8 +47,8 @@ from .trace import (COLLECTOR, add_attr, chrome_trace, current_span_id,
                     recording, span, write_chrome_trace)
 
 __all__ = [
-    "COLLECTOR", "RECORDER", "SLO", "add_attr", "chrome_trace",
-    "current_span_id", "current_trace_id", "device_status",
-    "ensure_trace", "new_trace", "note_dispatch", "recording", "span",
-    "write_chrome_trace",
+    "COLLECTOR", "LEDGER", "PROF", "RECORDER", "SLO", "add_attr",
+    "chrome_trace", "current_span_id", "current_trace_id",
+    "device_status", "ensure_trace", "new_trace", "note_dispatch",
+    "recording", "span", "write_chrome_trace",
 ]

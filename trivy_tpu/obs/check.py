@@ -17,7 +17,10 @@ This validator checks them offline, with no server running:
     was built wrong);
   * graftstorm replay artifacts (trivy-tpu-storm-replay/1): the
     schedule grammar and load parameters `--replay` needs, plus the
-    embedded incident document when one was captured.
+    embedded incident document when one was captured;
+  * graftprof live-capture manifests (trivy-tpu-profile/1): the
+    reason/timing fields and a non-empty artifact file list — an
+    empty capture is a profile that profiled nothing.
 
 Wired into tier-1 alongside graftlint (tests/test_graftwatch.py runs
 it over freshly produced incidents and trace dumps, plus corrupted
@@ -219,6 +222,33 @@ def check_storm_replay(doc: dict) -> list[str]:
     return problems
 
 
+def check_profile(doc: dict) -> list[str]:
+    """Validate one graftprof live-capture manifest
+    (trivy-tpu-profile/1, written next to the jax.profiler artifact
+    dir by obs.perf.Profiler.capture)."""
+    problems: list[str] = []
+    if doc.get("schema") != "trivy-tpu-profile/1":
+        problems.append(f"unknown profile schema {doc.get('schema')!r}")
+    if not isinstance(doc.get("reason"), str) or not doc.get("reason"):
+        problems.append("missing reason")
+    for field in ("requested_ms", "duration_ms", "started_unix"):
+        v = doc.get(field)
+        if not isinstance(v, (int, float)) or v < 0:
+            problems.append(f"bad {field} {v!r}")
+    if not isinstance(doc.get("artifact_dir"), str) \
+            or not doc.get("artifact_dir"):
+        problems.append("missing artifact_dir")
+    files = doc.get("files")
+    if not isinstance(files, list) \
+            or not all(isinstance(f, str) for f in files):
+        problems.append("missing files list")
+    elif not files:
+        # a capture that produced no artifact files profiled nothing —
+        # the operator shipped an empty directory
+        problems.append("capture produced no profile artifacts")
+    return problems
+
+
 def check_file(path: str) -> list[str]:
     """Validate one file, auto-detecting its kind by content."""
     try:
@@ -232,10 +262,13 @@ def check_file(path: str) -> list[str]:
         return check_trace(doc)
     if doc.get("schema", "").startswith("trivy-tpu-storm-replay"):
         return check_storm_replay(doc)
+    if doc.get("schema", "").startswith("trivy-tpu-profile"):
+        return check_profile(doc)
     if "schema" in doc or "reason" in doc:
         return check_incident(doc)
     return ["neither a trace dump (traceEvents), an incident file "
-            "(schema/reason), nor a storm replay artifact"]
+            "(schema/reason), a profile manifest, nor a storm replay "
+            "artifact"]
 
 
 def main(argv=None) -> int:

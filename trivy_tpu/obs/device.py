@@ -39,16 +39,26 @@ def note_dispatch() -> None:
                 _state["device_count"] = count
     with _lock:
         _state["last_dispatch_unix"] = time.time()
+    # graftprof memory telemetry rides the same contract: jax is live
+    # HERE (we just dispatched), so the throttled backend memory-stats
+    # sample happens now and /healthz only ever reads the cached view
+    from .perf import LEDGER
+    LEDGER.sample_memory()
 
 
 def device_status() -> dict:
-    """→ {platform, device_count, last_dispatch_age_s} for /healthz."""
+    """→ {platform, device_count, last_dispatch_age_s, memory} for
+    /healthz. The memory block is graftprof's cached view (HBM
+    watermarks sampled on the dispatch path + host-resident component
+    bytes) — like everything here, it never touches jax."""
+    from .perf import LEDGER
     with _lock:
         snap = dict(_state)
     last = snap.pop("last_dispatch_unix")
     snap["platform"] = snap["platform"] or "uninitialized"
     snap["last_dispatch_age_s"] = (
         round(time.time() - last, 3) if last else None)
+    snap["memory"] = LEDGER.memory_status()
     return snap
 
 

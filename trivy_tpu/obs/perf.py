@@ -1,0 +1,593 @@
+"""graftprof — device-side performance telemetry.
+
+graftwatch (PR 7) made the *request* path observable; the *device*
+path stayed a black box: nothing attributed XLA compile time, padding
+waste, hit-buffer occupancy drift, or HBM residency, and a bench
+regression was indistinguishable from bench noise. graftprof closes
+that gap with three pieces that every device entry point feeds:
+
+  ledger    the dispatch ledger (LEDGER): every launch site — the
+            single-chip engine, detectd merged dispatches, mesh
+            cells, the shift-or secrets engine, redetectd sweeps —
+            records per-dispatch padded-vs-real rows, device→host
+            bytes by result path (compact / dense / the overflow
+            re-fetch), hit-buffer fill and budget adaptations, and
+            first-dispatch-of-shape compile wall time. Exported as
+            trivy_tpu_device_* series under the strict exposition
+            parser and summarized per shape at the token-gated
+            /debug/perf.
+  memory    HBM/host watermark gauges sampled (throttled) from the
+            backend's memory stats on the dispatch path — never from
+            /healthz, which must not block behind a dead backend —
+            plus resident-bytes accounting for the big host-side
+            structures (advisory table, secret rule bank, version
+            pool, memo store), so table growth toward the HBM cliff
+            is visible before it kills a swap.
+  profiler  on-demand live capture (PROF): /debug/profile?ms=N runs
+            a jax.profiler trace against live traffic (token-gated,
+            one-at-a-time, cooldown-limited) and writes the artifact
+            plus a trivy-tpu-profile/1 manifest into the incident
+            dir; an SLO burn-rate threshold can auto-trigger one
+            capture, tying graftwatch paging to an actionable
+            profile. The CLI's --profile-dir rides the same
+            exclusivity (capture_dir).
+
+The perf-regression gate lives next door in obs/perfcheck.py.
+Lock discipline (graftlint TPU106 covers obs/): every mutation of
+shared ledger/profiler state happens under the instance lock; ledger
+notes never go inside device code (TPU107/TPU108 — clocks and METRICS
+under jit trace once and lie).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import re
+import threading
+import time
+
+from ..metrics import METRICS
+
+_SLUG_RE = re.compile(r"[^a-zA-Z0-9_.-]+")
+
+
+def _new_row() -> dict:
+    return {
+        "dispatches": 0, "warm_dispatches": 0,
+        "real_rows": 0, "padded_rows": 0, "waste_bytes": 0,
+        "compiles": 0, "compile_ms": 0.0,
+        "hit_fill_sum": 0.0, "hit_fill_n": 0, "overflows": 0,
+    }
+
+
+class DispatchLedger:
+    """Process-wide per-shape dispatch accounting (LEDGER, shared like
+    METRICS). Shape key = (site, padded rows, hit capacity): each key
+    is one compiled XLA program family, so the /debug/perf table reads
+    as "what programs does this process run, how often, how wasteful".
+
+    row_bytes scales the waste accounting to the site's row size: a
+    detect pair costs one dense-bit byte, a secrets chunk row costs
+    its full chunk length — so waste_bytes is comparable across
+    sites."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shapes: dict[tuple, dict] = {}
+        self._transfers: dict[str, int] = {}
+        self._adapt = {"up": 0, "down": 0}
+        self._resident: dict[str, int] = {}
+        self._mem: dict[str, dict] = {}
+        self._mem_last = 0.0
+        self._mem_peak = 0
+        self.mem_sample_interval_s = 5.0
+
+    # ---- dispatch accounting ------------------------------------------
+
+    def note_dispatch(self, site: str, real: int, padded: int,
+                      h_cap: int = 0, row_bytes: int = 1,
+                      warm: bool = False) -> None:
+        """One accepted device launch: `real` real rows inside a
+        `padded`-row dispatch. Warmup dispatches are compiles, not
+        traffic — counted separately so occupancy means what it
+        says."""
+        waste = max(padded - real, 0) * row_bytes
+        with self._lock:
+            row = self._shapes.setdefault((site, padded, h_cap),
+                                          _new_row())
+            if warm:
+                row["warm_dispatches"] += 1
+            else:
+                row["dispatches"] += 1
+                row["real_rows"] += real
+                row["padded_rows"] += padded
+                row["waste_bytes"] += waste
+        if not warm:
+            METRICS.inc("trivy_tpu_device_dispatches_total", site=site)
+            if padded:
+                METRICS.observe("trivy_tpu_device_padding_waste_ratio",
+                                (padded - real) / padded, site=site)
+
+    def note_compile(self, site: str, padded: int, h_cap: int,
+                     ms: float, warm: bool = False) -> None:
+        """First-dispatch-of-shape compile wall time (the launch call
+        that traced + lowered + compiled the new shape). The phase
+        label keeps warmup compiles distinguishable from the
+        mid-traffic ones a latency page cares about."""
+        with self._lock:
+            row = self._shapes.setdefault((site, padded, h_cap),
+                                          _new_row())
+            row["compiles"] += 1
+            row["compile_ms"] += ms
+        METRICS.observe("trivy_tpu_device_compile_ms", ms,
+                        phase="warmup" if warm else "traffic")
+
+    def note_transfer(self, path: str, nbytes: float) -> None:
+        """Device→host result bytes by path: "compact" (O(hits) hit
+        buffers), "dense" (full padded vectors), "overflow" (the dense
+        re-fetch a hit-buffer overflow pays on top of its wasted
+        compact fetch)."""
+        with self._lock:
+            self._transfers[path] = \
+                self._transfers.get(path, 0) + int(nbytes)
+        METRICS.inc("trivy_tpu_device_transfer_bytes_total",
+                    float(nbytes), path=path)
+
+    def note_hits(self, site: str, padded: int, h_cap: int,
+                  n_hits: int) -> None:
+        """Hit-buffer fill fraction for one compacted dispatch (>1.0
+        = overflow: that dispatch fell back to the dense fetch)."""
+        if h_cap <= 0:
+            return
+        with self._lock:
+            row = self._shapes.setdefault((site, padded, h_cap),
+                                          _new_row())
+            row["hit_fill_sum"] += n_hits / h_cap
+            row["hit_fill_n"] += 1
+            if n_hits > h_cap:
+                row["overflows"] += 1
+
+    def note_budget_adapt(self, direction: str) -> None:
+        """One hit-budget adaptation ("up" on overflow, "down" on a
+        sustained sparse streak)."""
+        with self._lock:
+            self._adapt[direction] = self._adapt.get(direction, 0) + 1
+        METRICS.inc("trivy_tpu_device_hit_budget_adaptations_total",
+                    direction=direction)
+
+    # ---- memory telemetry ---------------------------------------------
+
+    def note_resident(self, component: str, nbytes: int) -> None:
+        """Host-resident bytes of one big structure (advisory_table,
+        secret_bank, version_pool, memo). Idempotent per component —
+        callers re-stamp on growth/swap."""
+        with self._lock:
+            self._resident[component] = int(nbytes)
+        METRICS.set_gauge("trivy_tpu_device_resident_bytes",
+                          float(nbytes), component=component)
+
+    def sample_memory(self, force: bool = False) -> None:
+        """Throttled backend memory-stats sample. Called from the
+        dispatch path (obs.device.note_dispatch) where jax is already
+        live — /healthz only ever reads the cached view, so a dead
+        backend can never block a probe. Backends without memory_stats
+        (CPU) simply leave the view empty."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and \
+                    now - self._mem_last < self.mem_sample_interval_s:
+                return
+            self._mem_last = now
+        stats: dict[str, dict] = {}
+        try:
+            import jax
+            for d in jax.local_devices():
+                fn = getattr(d, "memory_stats", None)
+                ms = fn() if callable(fn) else None
+                if not ms:
+                    continue
+                in_use = int(ms.get("bytes_in_use", 0))
+                limit = int(ms.get("bytes_limit", 0)
+                            or ms.get("bytes_reservable_limit", 0))
+                peak = int(ms.get("peak_bytes_in_use", 0))
+                stats[str(d.id)] = {
+                    "platform": getattr(d, "platform", "") or "unknown",
+                    "bytes_in_use": in_use,
+                    "bytes_limit": limit,
+                    "peak_bytes_in_use": peak,
+                }
+                METRICS.set_gauge("trivy_tpu_device_hbm_bytes",
+                                  float(in_use), device=str(d.id),
+                                  kind="in_use")
+                if limit:
+                    METRICS.set_gauge("trivy_tpu_device_hbm_bytes",
+                                      float(limit), device=str(d.id),
+                                      kind="limit")
+                if peak:
+                    METRICS.set_gauge("trivy_tpu_device_hbm_bytes",
+                                      float(peak), device=str(d.id),
+                                      kind="peak")
+        except Exception:
+            return  # a memory probe must never sink a dispatch
+        if stats:
+            peak_total = sum(s["peak_bytes_in_use"] or s["bytes_in_use"]
+                             for s in stats.values())
+            with self._lock:
+                self._mem = stats
+                self._mem_peak = max(self._mem_peak, peak_total)
+
+    def memory_status(self) -> dict:
+        """→ the /healthz `device.memory` block: the cached backend
+        view plus host-resident components. Pure cache reads — never
+        touches jax."""
+        with self._lock:
+            return {
+                "backends": {k: dict(v) for k, v in self._mem.items()},
+                "watermark_bytes": self._mem_peak,
+                "resident_bytes": dict(self._resident),
+            }
+
+    # ---- reads ---------------------------------------------------------
+
+    def shape_table(self) -> list[dict]:
+        """→ the /debug/perf per-shape rows, sorted by site then
+        size."""
+        with self._lock:
+            snap = {k: dict(v) for k, v in self._shapes.items()}
+        rows = []
+        for (site, padded, h_cap), r in sorted(snap.items()):
+            rows.append({
+                "site": site, "t_pad": padded, "h_cap": h_cap,
+                "dispatches": r["dispatches"],
+                "warm_dispatches": r["warm_dispatches"],
+                "compiles": r["compiles"],
+                "compile_ms": round(r["compile_ms"], 3),
+                "mean_occupancy": round(
+                    r["real_rows"] / r["padded_rows"], 4)
+                if r["padded_rows"] else None,
+                "waste_bytes": r["waste_bytes"],
+                "mean_hit_fill": round(
+                    r["hit_fill_sum"] / r["hit_fill_n"], 4)
+                if r["hit_fill_n"] else None,
+                "overflows": r["overflows"],
+            })
+        return rows
+
+    def aggregate(self) -> dict:
+        """→ the ledger's process totals — the bench-tail /
+        device-child `graftprof` block perfcheck consumes."""
+        with self._lock:
+            shapes = [dict(v) for v in self._shapes.values()]
+            transfers = dict(self._transfers)
+            adapt = dict(self._adapt)
+        real = sum(r["real_rows"] for r in shapes)
+        padded = sum(r["padded_rows"] for r in shapes)
+        return {
+            "dispatches": sum(r["dispatches"] for r in shapes),
+            "warm_dispatches": sum(r["warm_dispatches"]
+                                   for r in shapes),
+            "distinct_shapes": len(shapes),
+            # raw row sums ride along so a scenario DELTA can
+            # recompute the ratio over just its own dispatches
+            "real_rows": real,
+            "padded_rows": padded,
+            "padding_waste_ratio": round(1.0 - real / padded, 4)
+            if padded else None,
+            "waste_bytes": sum(r["waste_bytes"] for r in shapes),
+            "compiles": sum(r["compiles"] for r in shapes),
+            "compile_ms": round(sum(r["compile_ms"] for r in shapes),
+                                3),
+            "overflows": sum(r["overflows"] for r in shapes),
+            "transfer_bytes": transfers,
+            "budget_adaptations": adapt,
+        }
+
+    def site_dispatches(self) -> dict[str, int]:
+        """→ {site: non-warm dispatch count} — the reconciliation read
+        the acceptance drill sums against trivy_tpu_detect_* counts."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for (site, _padded, _h), r in self._shapes.items():
+                out[site] = out.get(site, 0) + r["dispatches"]
+        return out
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._shapes = {}
+            self._transfers = {}
+            self._adapt = {"up": 0, "down": 0}
+            self._resident = {}
+            self._mem = {}
+            self._mem_last = 0.0
+            self._mem_peak = 0
+
+
+LEDGER = DispatchLedger()
+
+
+# ---------------------------------------------------------------------------
+# resident-bytes helpers (called once per structure build, not hot)
+
+def ndarray_bytes(*arrays) -> int:
+    """Sum .nbytes over whatever numpy/jax arrays the caller has; non-
+    arrays are skipped (duck-typed so callers never import numpy just
+    to account)."""
+    total = 0
+    for a in arrays:
+        nb = getattr(a, "nbytes", None)
+        if isinstance(nb, (int, float)):
+            total += int(nb)
+    return total
+
+
+def table_resident_bytes(table) -> int:
+    """Columnar footprint of one AdvisoryTable (the device-shippable
+    arrays; the Python group objects are the GC-frozen long tail and
+    not what the HBM cliff cares about)."""
+    return ndarray_bytes(*(getattr(table, name, None)
+                           for name in ("lo_tok", "hi_tok", "flags",
+                                        "hash_u64", "group")))
+
+
+# ---------------------------------------------------------------------------
+# live profiler capture
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already running (one-at-a-time by design: two
+    concurrent jax.profiler traces corrupt each other)."""
+
+
+class ProfilerCooldown(RuntimeError):
+    """Inside the cooldown window after the previous capture."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(f"profiler cooling down; retry in "
+                         f"{retry_after_s:.1f}s")
+        self.retry_after_s = retry_after_s
+
+
+class Profiler:
+    """On-demand jax.profiler capture against live traffic (PROF,
+    process singleton). One capture at a time; operator captures are
+    cooldown-limited so a curl loop cannot turn the serving process
+    into a profiling appliance; artifacts (the TensorBoard trace dir
+    plus a trivy-tpu-profile/1 manifest obs.check validates) land in
+    the flight recorder's incident dir, where incident tooling already
+    looks."""
+
+    SCHEMA = "trivy-tpu-profile/1"
+    MAX_MS = 60_000.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = False
+        self._last_end = 0.0          # monotonic; 0 = never captured
+        self._seq = itertools.count()
+        self.cooldown_s = 30.0
+        # SLO auto-trigger: short-window burn rate at/above this
+        # starts one capture (0 = off); auto captures share the
+        # cooldown so a sustained burn yields one profile per window
+        self.auto_burn_threshold = 0.0
+        self.auto_capture_ms = 2000.0
+
+    def configure(self, cooldown_s: float | None = None,
+                  auto_burn_threshold: float | None = None,
+                  auto_capture_ms: float | None = None) -> None:
+        with self._lock:
+            if cooldown_s is not None:
+                self.cooldown_s = float(cooldown_s)
+            if auto_burn_threshold is not None:
+                self.auto_burn_threshold = float(auto_burn_threshold)
+            if auto_capture_ms is not None:
+                self.auto_capture_ms = float(auto_capture_ms)
+
+    def _admit(self, force: bool) -> None:
+        with self._lock:
+            if self._active:
+                raise ProfilerBusy("a profile capture is already "
+                                   "running")
+            now = time.monotonic()
+            if not force and self._last_end and \
+                    now - self._last_end < self.cooldown_s:
+                raise ProfilerCooldown(
+                    self.cooldown_s - (now - self._last_end))
+            self._active = True
+
+    def _release(self) -> None:
+        with self._lock:
+            self._active = False
+            self._last_end = time.monotonic()
+
+    def capture(self, ms: float, reason: str = "manual",
+                force: bool = False) -> dict:
+        """Blocking capture of `ms` milliseconds of live device
+        traffic. → the manifest document (schema trivy-tpu-profile/1,
+        manifest path under `manifest`). Raises ProfilerBusy /
+        ProfilerCooldown when not admitted."""
+        ms = min(max(float(ms), 1.0), self.MAX_MS)
+        self._admit(force)
+        try:
+            from .recorder import RECORDER
+            started_unix = time.time()
+            slug = _SLUG_RE.sub("-", reason)[:48] or "manual"
+            name = "profile-{}-{}-{}".format(
+                time.strftime("%Y%m%dT%H%M%S",
+                              time.gmtime(started_unix)),
+                slug, next(self._seq))
+            out_dir = os.path.join(RECORDER.incident_dir, name)
+            os.makedirs(out_dir, exist_ok=True)
+            import jax
+            t0 = time.perf_counter()
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(ms / 1e3)
+            finally:
+                jax.profiler.stop_trace()
+            duration_ms = (time.perf_counter() - t0) * 1e3
+            files = []
+            for root, _dirs, names in os.walk(out_dir):
+                for n in names:
+                    files.append(os.path.relpath(
+                        os.path.join(root, n), out_dir))
+            doc = {
+                "schema": self.SCHEMA,
+                "reason": reason,
+                "requested_ms": ms,
+                "duration_ms": round(duration_ms, 1),
+                "started_unix": round(started_unix, 3),
+                "artifact_dir": out_dir,
+                "files": sorted(files),
+                "pid": os.getpid(),
+            }
+            manifest = out_dir + ".json"
+            tmp = manifest + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, manifest)
+            doc["manifest"] = manifest
+            # metric label clamped to the documented closed set: the
+            # free-form reason (operator-supplied via ?reason=) lives
+            # in the manifest only — unbounded label values would mint
+            # permanent series in the registry
+            label = reason.split(":", 1)[0]
+            if label not in ("manual", "slo_burn", "cli"):
+                label = "other"
+            METRICS.inc("trivy_tpu_profile_captures_total",
+                        reason=label)
+            return doc
+        finally:
+            self._release()
+
+    @contextlib.contextmanager
+    def capture_dir(self, out_dir: str):
+        """The CLI --profile-dir path: profile the enclosed work into
+        a caller-chosen directory under the same one-at-a-time
+        exclusivity (no cooldown — an operator-requested CLI run is
+        never rate-limited)."""
+        self._admit(force=True)
+        try:
+            import jax
+            jax.profiler.start_trace(out_dir)
+            try:
+                yield out_dir
+            finally:
+                jax.profiler.stop_trace()
+                METRICS.inc("trivy_tpu_profile_captures_total",
+                            reason="cli")
+        finally:
+            # a failed start_trace must release the one-at-a-time
+            # slot, or the profiler is wedged busy for the process
+            self._release()
+
+    # ---- SLO auto-trigger ---------------------------------------------
+
+    def observe_burn(self, rates: dict) -> None:
+        """Called by SLO.export() with the burn_rates() document: when
+        any objective's SHORT-window burn rate is at/above the
+        configured threshold, start one background capture (cooldown-
+        limited) so the page that burn rate fires comes with an
+        actionable profile attached."""
+        thr = self.auto_burn_threshold
+        if not thr:
+            return
+        worst = None
+        for name, doc in rates.items():
+            windows = doc.get("windows") or {}
+            if not windows:
+                continue
+            short = min(windows, key=lambda w: int(w.rstrip("s")))
+            burn = windows[short].get("burn_rate", 0.0)
+            if burn >= thr and (worst is None or burn > worst[1]):
+                worst = (name, burn)
+        if worst is None:
+            return
+        with self._lock:
+            if self._active:
+                return
+            now = time.monotonic()
+            if self._last_end and \
+                    now - self._last_end < self.cooldown_s:
+                return
+        threading.Thread(target=self._auto_capture, args=worst,
+                         name="graftprof-auto", daemon=True).start()
+
+    def _auto_capture(self, objective: str, burn: float) -> None:
+        from ..log import get as _get_logger
+        log = _get_logger("perf")
+        try:
+            doc = self.capture(self.auto_capture_ms,
+                               reason=f"slo_burn:{objective}")
+        except (ProfilerBusy, ProfilerCooldown):
+            return  # lost the admit race — one capture is plenty
+        except Exception:
+            log.exception("auto profile capture failed")
+            return
+        log.warning("SLO burn %.2f on %s auto-captured a device "
+                    "profile: %s", burn, objective, doc["manifest"])
+        from .recorder import RECORDER
+        RECORDER.note_event("profile.auto", objective=objective,
+                            burn=round(burn, 3),
+                            artifact=doc["manifest"])
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._active = False
+            self._last_end = 0.0
+            self.cooldown_s = 30.0
+            self.auto_burn_threshold = 0.0
+            self.auto_capture_ms = 2000.0
+
+
+PROF = Profiler()
+
+
+# ---------------------------------------------------------------------------
+# /debug HTTP payloads — shared by the scan server and the fleet
+# router, like recorder.debug_traces_payload
+
+def debug_perf_payload() -> dict:
+    """Payload for GET /debug/perf: the per-shape dispatch-ledger
+    table, process totals, and the memory view."""
+    return {
+        "pid": os.getpid(),
+        "shapes": LEDGER.shape_table(),
+        "totals": LEDGER.aggregate(),
+        "memory": LEDGER.memory_status(),
+    }
+
+
+def debug_profile_payload(path: str) -> tuple[int, dict]:
+    """Handle GET /debug/profile?ms=N[&reason=...]: run one blocking
+    capture against live traffic. → (http_code, json_payload); 409
+    while another capture runs, 429 + retry_after_s inside the
+    cooldown (the endpoint is already token-gated by the caller)."""
+    import math
+    import urllib.parse
+    q = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+    try:
+        ms = float((q.get("ms") or ["500"])[0])
+    except ValueError:
+        return 400, {"code": "invalid_argument",
+                     "msg": "ms must be a number"}
+    # NaN fails BOTH range comparisons — without the isfinite check it
+    # would slip through, start a capture, blow up in time.sleep, and
+    # burn the cooldown window on a 500
+    if not math.isfinite(ms) or ms <= 0 or ms > Profiler.MAX_MS:
+        return 400, {"code": "invalid_argument",
+                     "msg": f"ms must be in (0, {int(Profiler.MAX_MS)}]"}
+    reason = (q.get("reason") or ["manual"])[0]
+    try:
+        doc = PROF.capture(ms, reason=reason)
+    except ProfilerBusy as e:
+        return 409, {"code": "already_exists", "msg": str(e)}
+    except ProfilerCooldown as e:
+        return 429, {"code": "resource_exhausted", "msg": str(e),
+                     "retry_after_s": round(e.retry_after_s, 1)}
+    except Exception as e:  # noqa: BLE001 — a broken profiler must
+        # surface as a clean 500, never kill the handler thread
+        return 500, {"code": "internal",
+                     "msg": f"{type(e).__name__}: {e}"}
+    return 200, doc

@@ -176,6 +176,12 @@ class SLOEngine:
         dev = rates["device_serving"]["windows"][short]
         ratio = 1.0 - dev["bad_ratio"] if dev["total"] else 1.0
         METRICS.set_gauge("trivy_tpu_device_serving_ratio", ratio)
+        # graftprof auto-trigger: a short-window burn past the
+        # configured threshold starts one background profile capture
+        # (cooldown-limited), so the page this export feeds arrives
+        # with an actionable device trace attached
+        from .perf import PROF
+        PROF.observe_burn(rates)
         return rates
 
     def reset_for_tests(self) -> None:

@@ -288,8 +288,11 @@ class MeshDetector:
         own breaker; (3) the collective launch runs under the backend
         `detect.dispatch` watch — a whole-launch failure names no
         single chip."""
+        import time
+
         from ..log import get as _get_logger
-        from ..obs import SLO
+        from ..obs import SLO, span
+        from ..obs.perf import LEDGER
         from ..resilience import GUARD, DeviceError, failpoint
         inner = self._inner
         raw_fallback = host_fallback
@@ -352,17 +355,39 @@ class MeshDetector:
                 # hit-capacity policy over the cell pair capacity (the
                 # hit rung is part of the compiled shape)
                 h_loc = inner._hit_capacity(part.t_loc)
-                inner._note_shape(t_total,
-                                  int(part.q_start.shape[-1]),
-                                  int(ver_dev.shape[0]), h_loc)
-                if h_loc:
-                    bits, max_cell_hits = sharded_csr_join_compact(
-                        self.mesh, self._st_dev, ver_dev, part,
-                        total, h_loc)
+                # same ledger contract as the single-chip _launch: a
+                # blameless caller (redetectd sweep replay) re-tags
+                # itself so background refresh never muddies the live
+                # mesh-occupancy story
+                site = "redetect" if GUARD.blameless_active() \
+                    else "mesh"
+                new_shape = inner._note_shape(
+                    t_total, int(part.q_start.shape[-1]),
+                    int(ver_dev.shape[0]), h_loc)
+
+                def _join():
+                    if h_loc:
+                        return sharded_csr_join_compact(
+                            self.mesh, self._st_dev, ver_dev, part,
+                            total, h_loc)
+                    return sharded_csr_join(self.mesh, self._st_dev,
+                                            ver_dev, part, total), 0
+                if new_shape:
+                    # graftprof: the sharded join fetches synchronously,
+                    # so a first-of-shape call's wall time is
+                    # compile + one execution — the honest upper bound
+                    # on what a mid-traffic mesh compile costs a request
+                    with span("detect.compile", t_pad=t_total,
+                              h_cap=h_loc, mesh=True):
+                        t0 = time.perf_counter()
+                        bits, max_cell_hits = _join()
+                        compile_ms = (time.perf_counter() - t0) * 1e3
+                    LEDGER.note_compile(site, t_total, h_loc,
+                                        compile_ms)
                 else:
-                    bits = sharded_csr_join(self.mesh, self._st_dev,
-                                            ver_dev, part, total)
+                    bits, max_cell_hits = _join()
                 inner._account_traffic(total, t_total)
+                LEDGER.note_dispatch(site, total, t_total, h_loc)
         except DeviceError:
             _get_logger("mesh").warning(
                 "sharded join failed; host-fallback join",
@@ -379,12 +404,17 @@ class MeshDetector:
         if h_loc:
             # adapt the shared hit budget on the WORST cell — overflow
             # is per-cell, so the fullest buffer decides the next rung
-            inner._note_hits(max_cell_hits, h_loc)
+            inner._note_hits(max_cell_hits, h_loc, site=site,
+                             t_pad=t_total)
         if isinstance(bits, CompactBits):
+            LEDGER.note_transfer("compact",
+                                 float(bits.pair_idx.nbytes
+                                       + bits.bits.nbytes))
             # hits already in global pair order; extend the logical
             # dense length to the padded dispatch size downstream
             # slicing expects
             return CompactBits(bits.pair_idx, bits.bits, t_pad)
+        LEDGER.note_transfer("dense", float(bits.nbytes))
         out = np.zeros(t_pad, np.int8)
         out[:total] = bits
         return out

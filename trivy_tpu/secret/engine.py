@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -104,6 +105,15 @@ class SecretScanner:
         # tri-state: None = untried, True = compiled fine, False =
         # failed once (don't pay the compile attempt again)
         self._pallas_ok: Optional[bool] = None
+        # graftprof: shapes this scanner has dispatched (a new
+        # (rows, chunk_len) bucket is a fresh compile) + the bank's
+        # host-resident footprint
+        self._seen_shapes: set = set()
+        if self._bank is not None:
+            from ..obs.perf import LEDGER, ndarray_bytes
+            LEDGER.note_resident(
+                "secret_bank", ndarray_bytes(self._bank.kw_words,
+                                             self._bank.kw_masks))
 
     # --- device prefilter ---
 
@@ -214,14 +224,33 @@ class SecretScanner:
         from ..ops import next_pow2
         use_pallas = (self.mesh is None and self._pallas_ok is not False
                       and bank.n_keywords <= 128 and _tpu_backend())
+        from ..obs.perf import LEDGER
+        from ..resilience import GUARD
+        # ledger contract: blameless background work (a redetectd-style
+        # sweep) re-tags its launches so it never muddies the live
+        # occupancy story
+        site = "redetect" if GUARD.blameless_active() else "secret"
         futures = []
         for off in range(0, uniq.shape[0], DEVICE_ROWS):
             piece = uniq[off:off + DEVICE_ROWS]
-            b = next_pow2(piece.shape[0], floor=64)
-            if piece.shape[0] < b:
-                pad = np.zeros((b, piece.shape[1]), np.uint8)
-                pad[:piece.shape[0]] = piece
+            real_rows = int(piece.shape[0])
+            row_len = int(piece.shape[1])
+            b = next_pow2(real_rows, floor=64)
+            if real_rows < b:
+                pad = np.zeros((b, row_len), np.uint8)
+                pad[:real_rows] = piece
                 piece = pad
+            # graftprof: a (rows, chunk_len, path) bucket this scanner
+            # has not dispatched is a fresh trace+compile — the
+            # dispatch call below pays it synchronously, so its wall
+            # time is the compile estimate the ledger records
+            shape_key = (b, row_len, use_pallas,
+                         self.mesh is not None)
+            with self._pallas_lock:
+                new_shape = shape_key not in self._seen_shapes
+                if new_shape:
+                    self._seen_shapes.add(shape_key)
+            t0 = time.perf_counter()
             # device_put, not jnp.asarray — the latter is an order of
             # magnitude slower for large host arrays on remote backends
             if self.mesh is not None:
@@ -238,6 +267,14 @@ class SecretScanner:
                     # and shows up as path="jnp" in the path counter
                     self._note_pallas_failure()
                     use_pallas = False
+                    # the jnp shape this fallback compiles is ALSO
+                    # seen now — without this, the next chunk of the
+                    # same geometry re-keys (use_pallas=False), reads
+                    # as a fresh "compile", and lands a near-zero
+                    # sample in the compile_ms histogram
+                    with self._pallas_lock:
+                        self._seen_shapes.add(
+                            (b, row_len, False, self.mesh is not None))
                     futures.append(ac.shiftor_scan(
                         kw_words, kw_masks, jax.device_put(piece),
                         n_words=bank.words))
@@ -245,10 +282,20 @@ class SecretScanner:
                 futures.append(ac.shiftor_scan(
                     kw_words, kw_masks, jax.device_put(piece),
                     n_words=bank.words))
+            if new_shape:
+                LEDGER.note_compile(
+                    site, b, 0,
+                    (time.perf_counter() - t0) * 1e3)
+            LEDGER.note_dispatch(site, real_rows, b,
+                                 row_bytes=row_len)
         try:
+            fetched = []
+            for f in futures:
+                arr = jax.device_get(f)
+                LEDGER.note_transfer("dense", float(arr.nbytes))
+                fetched.append(arr)
             masks = np.concatenate(
-                [jax.device_get(f) for f in futures],
-                axis=0)[:uniq.shape[0]][remap]
+                fetched, axis=0)[:uniq.shape[0]][remap]
         except Exception:
             # async pallas failures surface here, not at dispatch —
             # record them so later batches skip straight to the

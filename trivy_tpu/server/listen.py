@@ -26,6 +26,7 @@ from .. import __version__, types as T
 from ..fanal.cache import blob_from_json
 from ..log import get as _get_logger
 from ..obs import SLO, device_status, new_trace, span
+from ..obs.perf import debug_perf_payload, debug_profile_payload
 from ..obs.recorder import (debug_incidents_payload,
                             debug_traces_payload)
 from ..resilience import (AdmissionQueue, Deadline, GUARD, Shed,
@@ -494,17 +495,25 @@ class Handler(BaseHTTPRequestHandler):
             st.request_finished(gen)
 
     def _do_get(self):
-        if self.path.startswith(("/debug/traces", "/debug/incidents")):
+        if self.path.startswith(("/debug/traces", "/debug/incidents",
+                                 "/debug/perf", "/debug/profile")):
             # unlike /healthz//metrics (liveness/scrape surfaces), the
             # debug buffers carry scan detail — file paths in analyzer
             # spans, other tenants' trace ids — so a configured token
-            # gates them exactly like the POST surface
+            # gates them exactly like the POST surface; /debug/profile
+            # additionally COSTS (it runs the profiler against live
+            # traffic), which is exactly what a token should gate
             if self.state.token and \
                     self.headers.get(TOKEN_HEADER) != self.state.token:
                 return self._twirp_error(401, "unauthenticated",
                                          "invalid token")
             if self.path.startswith("/debug/traces"):
                 return self._json(200, debug_traces_payload(self.path))
+            if self.path.startswith("/debug/perf"):
+                return self._json(200, debug_perf_payload())
+            if self.path.startswith("/debug/profile"):
+                code, payload = debug_profile_payload(self.path)
+                return self._json(code, payload)
             return self._json(200, debug_incidents_payload())
         if self.path == "/healthz":
             # plain `ok` stays the fast path for probes that ask for
