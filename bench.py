@@ -836,6 +836,114 @@ def bench_mesh_degraded(table, images):
     }
 
 
+TABLE_SWEEP_POINTS = (("small", 2000), ("mid", 8000), ("big", 32000))
+TABLE_SWEEP_IMAGES = 48
+TABLE_SWEEP_PKGS = 40
+
+
+def bench_table_sweep():
+    """graftstream scenario (ROADMAP item 4): scan ips vs table_rows
+    sweeping past the per-device budget cliff. The budget is pinned so
+    the SMALL table fits resident while mid/big exceed it (4× and 16×
+    the small footprint) — exactly the larger-than-HBM regime the
+    shard-streaming detector exists for. Per point: resident-path ips,
+    streamed-path ips (double-buffered slice walk, slice count from
+    the budget), hit parity between the two (bit-identity is the hard
+    contract), and the shard_upload ledger's stall/bytes for the
+    streamed pass — upload_stall after the first pass ≈ 0 is the
+    overlap working. Flat keys so perfcheck diffs each leaf across
+    rounds."""
+    import numpy as np
+
+    from trivy_tpu.db.table import RawAdvisory, build_table
+    from trivy_tpu.detect.engine import BatchDetector, PkgQuery
+    from trivy_tpu.obs.perf import LEDGER
+    from trivy_tpu.parallel.stream import (StreamingDetector,
+                                           StreamOptions, plan_slices)
+
+    rng = np.random.default_rng(29)
+    fixed_pool = synth_versions(rng, n=500, major_lo=0, major_hi=6)
+    inst_pool = synth_versions(rng, n=500, major_lo=4, major_hi=9)
+
+    def synth_table(n_rows):
+        raw = [RawAdvisory(
+            source=SOURCE, ecosystem="alpine",
+            pkg_name=f"sweep{i:06d}",
+            vuln_id=f"CVE-2025-{i:06d}",
+            fixed_version=fixed_pool[i % len(fixed_pool)])
+            for i in range(n_rows)]
+        return build_table(raw)
+
+    def workload(n_rows, seed):
+        r = np.random.default_rng(seed)
+        return [[PkgQuery(source=SOURCE, ecosystem="alpine",
+                          name=f"sweep{int(k):06d}",
+                          version=inst_pool[int(v)])
+                 for k, v in zip(
+                     r.integers(0, n_rows, TABLE_SWEEP_PKGS),
+                     r.integers(0, len(inst_pool),
+                                TABLE_SWEEP_PKGS))]
+                for _ in range(TABLE_SWEEP_IMAGES)]
+
+    out = {}
+    budget_mb = None
+    for label, n_rows in TABLE_SWEEP_POINTS:
+        table = synth_table(n_rows)
+        if budget_mb is None:
+            # resident slice pair ≤ budget ⇒ the small table stays
+            # resident (dev ≤ budget/2); mid/big cross the cliff
+            budget_mb = table.device_nbytes() * 2.2 / (1 << 20)
+            out["budget_mb"] = round(budget_mb, 3)
+        batches = workload(n_rows, 1000 + n_rows)
+        out[f"{label}_rows"] = len(table)
+
+        resident = BatchDetector(table)
+        try:
+            resident.detect_many(batches)          # warm compiles
+            t0 = time.perf_counter()
+            hits_res = sum(len(h) for h in
+                           resident.detect_many(batches))
+            res_s = time.perf_counter() - t0
+        finally:
+            resident.close()
+        out[f"{label}_resident_ips"] = round(
+            TABLE_SWEEP_IMAGES / res_s, 2)
+
+        opts = StreamOptions(device_budget_mb=budget_mb)
+        bounds = plan_slices(table, opts)
+        if bounds is None:
+            # below the cliff: the streamed config runs resident
+            out[f"{label}_slices"] = 0
+            continue
+        streamed = StreamingDetector(table, opts, bounds=bounds)
+        out[f"{label}_slices"] = streamed.n_slices
+        try:
+            streamed.detect_many(batches)          # warm + first pass
+            up0 = dict(LEDGER.shard_upload_stats().get("stream", {}))
+            t0 = time.perf_counter()
+            hits_str = sum(len(h) for h in
+                           streamed.detect_many(batches))
+            str_s = time.perf_counter() - t0
+            up1 = LEDGER.shard_upload_stats().get("stream", {})
+        finally:
+            streamed.close()
+        out[f"{label}_streamed_ips"] = round(
+            TABLE_SWEEP_IMAGES / str_s, 2)
+        out[f"{label}_stream_slowdown"] = round(
+            out[f"{label}_resident_ips"]
+            / out[f"{label}_streamed_ips"], 3) \
+            if out[f"{label}_streamed_ips"] else None
+        out[f"{label}_parity_ok"] = bool(hits_res == hits_str)
+        out[f"{label}_upload_stall_ms"] = round(
+            up1.get("stall_ms", 0.0) - up0.get("stall_ms", 0.0), 2)
+        out[f"{label}_upload_mb"] = round(
+            (up1.get("bytes", 0) - up0.get("bytes", 0)) / (1 << 20),
+            2)
+        out[f"{label}_cold_waits"] = \
+            up1.get("cold_waits", 0) - up0.get("cold_waits", 0)
+    return out
+
+
 FLEET_REPLICAS = 2
 FLEET_IMAGES = 192
 FLEET_CLIENTS = 8
@@ -1306,6 +1414,12 @@ def device_child_main():
     except Exception:
         mesh_degraded = None
     try:
+        # graftstream sweep with the chip in the loop: real transfer
+        # overlap numbers (the CPU orchestrator's are structural only)
+        table_sweep = bench_table_sweep()
+    except Exception:
+        table_sweep = None
+    try:
         server_fleet = bench_server_fleet(table)
     except Exception:
         server_fleet = None
@@ -1346,6 +1460,7 @@ def device_child_main():
         "server_concurrency": server_conc,
         "degraded_mode": degraded,
         "mesh_degraded": mesh_degraded,
+        "table_sweep": table_sweep,
         "server_fleet": server_fleet,
         "fleet_dedup": fleet_dedup,
         "chaos_storm": chaos_storm,
@@ -1716,6 +1831,15 @@ def main():
         except Exception as e:
             diag.append(f"mesh_degraded bench failed: {e}")
         try:
+            # graftstream scenario (scan ips vs table_rows past the
+            # per-device budget cliff: streamed vs resident, parity,
+            # upload stall from the shard_upload ledger) on the CPU
+            # backend; the device child's numbers override so the
+            # first post-r05 device round lands a streaming baseline
+            result["table_sweep"] = bench_table_sweep()
+        except Exception as e:
+            diag.append(f"table_sweep bench failed: {e}")
+        try:
             # graftfleet scenario (aggregate ips at 1 vs N replicas
             # through the router, kill drill, readmission) on the CPU
             # backend; the device child's numbers override
@@ -1829,6 +1953,11 @@ def main():
                 result["degraded_mode"] = dev["degraded_mode"]
             if dev.get("mesh_degraded"):
                 result["mesh_degraded"] = dev["mesh_degraded"]
+            if dev.get("table_sweep"):
+                # graftstream: chip-in-the-loop streamed-vs-resident
+                # sweep overrides (real transfer overlap, not the CPU
+                # backend's structural pass)
+                result["table_sweep"] = dev["table_sweep"]
             if dev.get("server_fleet"):
                 result["server_fleet"] = dev["server_fleet"]
             if dev.get("fleet_dedup"):

@@ -919,3 +919,239 @@ class TestMeshguardRebuildRobustness:
         finally:
             guard.close()
             hung.set()
+
+
+# ---- host-level fault domains (graftstream PR) ------------------------
+
+class TestHostFaultDomains:
+    """meshguard host_of: a dead host (all its devices' domains
+    tripping inside the host-loss window) costs ONE debounced shrink
+    re-factorizing dp×db over the survivors, never N serial
+    single-chip rebuilds; readmission grows back through the same
+    probe path."""
+
+    @pytest.fixture(autouse=True)
+    def _clean(self, _clean_guard):
+        yield
+
+    def test_host_loss_is_one_refactorized_rebuild(self):
+        ids = [30, 31, 32, 33]
+        host_of = {30: 0, 31: 0, 32: 1, 33: 1}
+        guard = MeshGuard(ids, _fast_opts(fail_threshold=1,
+                                          host_loss_window_ms=400.0),
+                          host_of=host_of)
+        calls: list = []
+        grown = threading.Event()
+
+        def cb(active, reason):
+            calls.append((tuple(active), reason))
+            if reason == "grow" and len(active) == 4:
+                grown.set()
+
+        lost0 = METRICS.get("trivy_tpu_mesh_host_lost_total")
+        try:
+            guard.on_rebuild(cb)
+            # host 0 dies: both its domains error (threshold 1); the
+            # dispatch path reports the FIRST device, the suspect
+            # probes expel its sibling, and the hold collapses the two
+            # losses into one rebuild
+            FAILPOINTS.set(mesh_site(30), "error")
+            FAILPOINTS.set(mesh_site(31), "error")
+            with pytest.raises(Exception):
+                guard.check(ids)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and \
+                    sorted(guard.lost_ids()) != [30, 31]:
+                time.sleep(0.01)
+            assert sorted(guard.lost_ids()) == [30, 31]
+            # wait for the (single) shrink to land
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not calls:
+                time.sleep(0.01)
+            shrinks = [c for c in calls if c[1] == "shrink"]
+            assert shrinks == [((32, 33), "shrink")]
+            assert METRICS.get("trivy_tpu_mesh_host_lost_total") \
+                == lost0 + 1
+            st = guard.status()
+            assert st["hosts"]["0"] == {"devices": 2, "lost": 2}
+            assert st["hosts_lost"] == ["0"]
+            # the survivor set re-factorizes dp×db (the owner callback
+            # calls mesh_from_devices/best_db_shards; with 2 survivors
+            # and db_pref 2 that is dp1×db2, not a crash)
+            assert best_db_shards(2, 2) == 2
+            # recovery: clear the faults, the probe path readmits both
+            # devices and a grow restores the full mesh
+            FAILPOINTS.configure("")
+            assert grown.wait(10.0)
+            assert guard.lost_ids() == []
+            assert guard.status()["hosts_lost"] == []
+        finally:
+            FAILPOINTS.configure("")
+            guard.close()
+
+    def test_partial_host_loss_probes_siblings_then_shrinks(self):
+        """A genuine single-chip loss on a multi-chip host: the shrink
+        is HELD while the sibling probes run (the sibling might be
+        dying too); a healthy sibling resolves the probe, releases the
+        hold, and ONE shrink fires on just the victim — the sibling is
+        never expelled."""
+        ids = [50, 51]
+        guard = MeshGuard(ids, _fast_opts(fail_threshold=1,
+                                          host_loss_window_ms=300.0),
+                          host_of={50: 0, 51: 0})
+        calls: list = []
+        rebuilt = threading.Event()
+
+        def cb(active, reason):
+            calls.append((tuple(active), reason))
+            rebuilt.set()
+
+        try:
+            guard.on_rebuild(cb)
+            # the fault stays armed: device 50 keeps failing its
+            # readmission probes and stays lost
+            FAILPOINTS.set(mesh_site(50), "error")
+            guard.device_failed(50)
+            assert rebuilt.wait(10.0)
+            assert calls[0] == ((51,), "shrink")
+            # the healthy sibling was never expelled
+            assert guard.lost_ids() == [50]
+            assert guard.status()["hosts_lost"] == []
+            assert [c for c in calls if c[1] == "shrink"] == \
+                [((51,), "shrink")]
+        finally:
+            FAILPOINTS.configure("")
+            guard.close()
+
+    def test_hold_covers_slow_sibling_probes(self):
+        """The default-config trap: the host-loss window (250 ms) is
+        far shorter than a wedged sibling's probe deadline. The hold
+        must stretch to cover in-flight sibling probes, so a hung host
+        still coalesces into ONE shrink even when window <
+        probe_timeout."""
+        ids = [70, 71]
+        # window 50 ms << probe timeout 400 ms: sibling 71's hang-mode
+        # probe resolves (as a failure) only at 400 ms — long after
+        # the nominal window
+        guard = MeshGuard(ids, _fast_opts(fail_threshold=1,
+                                          probe_timeout_ms=400.0,
+                                          host_loss_window_ms=50.0),
+                          host_of={70: 0, 71: 0})
+        calls: list = []
+        rebuilt = threading.Event()
+
+        def cb(active, reason):
+            calls.append((tuple(active), reason))
+            rebuilt.set()
+
+        try:
+            guard.on_rebuild(cb)
+            FAILPOINTS.set(mesh_site(70), "error")
+            FAILPOINTS.set(mesh_site(71), "hang", 2000.0)
+            guard.device_failed(70)
+            assert rebuilt.wait(15.0)
+            # ONE shrink, with BOTH of the host's devices already
+            # expelled — not shrink(71 survives) then a second shrink
+            shrinks = [c for c in calls if c[1] == "shrink"]
+            assert shrinks == [((), "shrink")]
+            assert sorted(guard.lost_ids()) == [70, 71]
+            assert guard.status()["hosts_lost"] == ["0"]
+        finally:
+            FAILPOINTS.configure("")
+            guard.close()
+
+    def test_no_host_map_keeps_prompt_shrink(self):
+        """Without host_of (single-host meshes), a device loss shrinks
+        promptly — no host-loss hold."""
+        guard = MeshGuard([60, 61], _fast_opts())
+        calls: list = []
+        rebuilt = threading.Event()
+
+        def cb(active, reason):
+            calls.append((tuple(active), reason, time.monotonic()))
+            rebuilt.set()
+
+        t0 = time.monotonic()
+        try:
+            guard.on_rebuild(cb)
+            guard.device_failed(60)
+            assert rebuilt.wait(10.0)
+            assert calls[0][:2] == ((61,), "shrink")
+            assert calls[0][2] - t0 < 0.2
+        finally:
+            guard.close()
+
+
+def test_host_assignments_synthetic_and_real():
+    from trivy_tpu.parallel.multihost import host_assignments
+    devs = jax.devices()
+    real = host_assignments(devs)
+    # the virtual CPU platform is one process: every device maps to
+    # host 0 (ServerState then disables host domains — < 2 hosts)
+    assert set(real.values()) == {0}
+    synth = host_assignments(devs, synthetic_hosts=2)
+    assert set(synth.values()) == {0, 1}
+    # contiguous equal blocks, in device order
+    hosts_in_order = [synth[int(d.id)] for d in devs]
+    assert hosts_in_order == sorted(hosts_in_order)
+    assert hosts_in_order.count(0) == hosts_in_order.count(1)
+
+
+# ---- multi-host plumbing, part 2 (ROADMAP item 4 caveat) --------------
+
+def test_maybe_init_distributed_partial_config_raises():
+    """A partial env set is a config error naming the missing keys —
+    never a silent single-host fallback (a worker defaulting to rank 0
+    would fight the real coordinator)."""
+    from trivy_tpu.parallel import multihost
+    with pytest.raises(RuntimeError) as ei:
+        multihost.maybe_init_distributed(
+            env={"TRIVY_TPU_DIST_COORDINATOR": "host:1234"})
+    assert "TRIVY_TPU_DIST_NPROC" in str(ei.value)
+    assert "TRIVY_TPU_DIST_PROC_ID" in str(ei.value)
+    with pytest.raises(RuntimeError):
+        multihost.maybe_init_distributed(
+            env={"TRIVY_TPU_DIST_NPROC": "2",
+                 "TRIVY_TPU_DIST_PROC_ID": "1"})
+
+
+def test_maybe_init_distributed_full_config_initializes(monkeypatch):
+    from trivy_tpu.parallel import multihost
+    calls = []
+
+    class FakeDistributed:
+        @staticmethod
+        def initialize(coordinator_address, num_processes, process_id):
+            calls.append((coordinator_address, num_processes,
+                          process_id))
+
+    monkeypatch.setattr(jax, "distributed", FakeDistributed)
+    monkeypatch.setattr(multihost, "_initialized", False)
+    env = {"TRIVY_TPU_DIST_COORDINATOR": "10.0.0.1:8476",
+           "TRIVY_TPU_DIST_NPROC": "4",
+           "TRIVY_TPU_DIST_PROC_ID": "2"}
+    try:
+        assert multihost.maybe_init_distributed(env=env) is True
+        assert calls == [("10.0.0.1:8476", 4, 2)]
+        # idempotent: a second call joins without re-initializing
+        assert multihost.maybe_init_distributed(env=env) is True
+        assert len(calls) == 1
+    finally:
+        multihost._initialized = False
+
+
+@pytest.mark.parametrize("db_pref", [1, 2, 3, 4, 5, 8, 16])
+def test_global_mesh_factorization_properties(db_pref):
+    """global_mesh fits db to the largest valid factorization of the
+    job's device count: dp×db tiles every device, db divides the
+    count, db ≤ the preference, and no larger divisor ≤ pref exists."""
+    from trivy_tpu.parallel.multihost import global_mesh
+    n = len(jax.devices())
+    mesh = global_mesh(db_shards=db_pref)
+    dp, db = mesh.devices.shape
+    assert dp * db == n
+    assert n % db == 0
+    assert db <= max(db_pref, 1)
+    assert not any(n % d == 0 and db < d <= db_pref
+                   for d in range(1, n + 1))
+    assert mesh.axis_names == ("dp", "db")

@@ -1,0 +1,562 @@
+"""graftstream tier-1 gate (trivy_tpu/parallel/stream.py): slice
+planning math, CSR hash-range routing properties, the ISSUE acceptance
+scenario — a table ≥ 4× the per-device budget scanned end-to-end with
+hits bit-identical to the unstreamed single-shot join on the device
+AND host-fallback paths, with the shard_upload ledger showing
+double-buffer overlap (upload stall ≈ 0 after the first slice pass) —
+plus detectd coalescing over the streamed detector, the streamed mesh
+path, and the strict-exposition gate on the new series."""
+
+import glob
+import os
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from trivy_tpu.db import build_table
+from trivy_tpu.db.fixtures import load_fixture_files
+from trivy_tpu.detect.engine import BatchDetector, PkgQuery
+from trivy_tpu.detect.sched import DispatchScheduler, SchedOptions
+from trivy_tpu.metrics import METRICS
+from trivy_tpu.obs.perf import LEDGER
+from trivy_tpu.parallel.mesh import MeshDetector, make_mesh
+from trivy_tpu.parallel.stream import (
+    SliceCache, StreamingDetector, StreamOptions, clip_descriptors,
+    merge_slice_bits, plan_slices, slice_bounds,
+)
+from trivy_tpu.resilience import FAILPOINTS, GUARD
+from trivy_tpu.resilience.hostjoin import CompactBits
+from trivy_tpu.resilience.storm import storm_table
+
+from helpers import parse_exposition
+
+FIXTURES = sorted(glob.glob(
+    os.path.join(os.path.dirname(__file__), "fixtures", "db", "*.yaml")))
+
+
+@pytest.fixture(scope="module")
+def table():
+    advisories, details, _ = load_fixture_files(FIXTURES)
+    return build_table(advisories, details)
+
+
+@pytest.fixture(scope="module")
+def big_table():
+    # a few hundred rows — big relative to the tiny budgets the tests
+    # configure, fast to build
+    return storm_table(n_pkgs=96)
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard():
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+    yield
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+
+
+def _storm_queries(seed: int, n: int, n_pkgs: int = 96):
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        k = rng.randrange(n_pkgs + 8)   # some empty-bucket names
+        ver = f"{rng.randrange(1, 4)}.{rng.randrange(10)}.0-r0"
+        out.append(PkgQuery(source="alpine 3.17", ecosystem="alpine",
+                            name=f"storm-pkg-{k}", version=ver))
+    return out
+
+
+def _keys(hits):
+    return [(h.query.name, h.query.version, h.vuln_id) for h in hits]
+
+
+# ---------------------------------------------------------------------------
+# slice planning
+
+
+class TestPlanning:
+    def test_explicit_slice_count(self, big_table):
+        bounds = plan_slices(big_table, StreamOptions(slices=5))
+        assert bounds is not None and bounds.size == 6
+        assert bounds[0] == 0 and bounds[-1] == len(big_table)
+        assert (np.diff(bounds) > 0).all()
+
+    def test_budget_math_double_buffer(self, big_table):
+        # budget B with resident=2 sizes each slice ≤ B/2
+        dev = big_table.device_nbytes()
+        budget_mb = dev / (4 * (1 << 20))   # table = 4× budget
+        bounds = plan_slices(big_table,
+                             StreamOptions(device_budget_mb=budget_mb))
+        assert bounds is not None
+        n = bounds.size - 1
+        assert n >= 8   # ceil(dev / (budget/2)) = 8 slices
+        row_bytes = dev / len(big_table)
+        assert np.diff(bounds).max() * row_bytes <= \
+            budget_mb * (1 << 20) / 2 + row_bytes
+
+    def test_within_budget_never_engages(self, big_table):
+        huge = big_table.device_nbytes() * 4 / (1 << 20)
+        assert plan_slices(big_table,
+                           StreamOptions(device_budget_mb=huge)) is None
+
+    def test_no_budget_source_never_engages(self, big_table):
+        # CPU backends expose no memory limit, so the auto hbm budget
+        # resolves to nothing and streaming stays off
+        assert plan_slices(big_table, StreamOptions()) is None
+        assert plan_slices(big_table, None) is None
+
+    def test_slice_bounds_cover(self):
+        for rows, n in ((7, 3), (128, 5), (10, 10), (3, 1)):
+            b = slice_bounds(rows, n)
+            assert b[0] == 0 and b[-1] == rows and b.size == n + 1
+            assert (np.diff(b) >= 0).all()
+
+    def test_table_byte_accounting(self, big_table):
+        cols = big_table.nbytes_by_column()
+        for name in ("hash", "lo_tok", "hi_tok", "flags", "group"):
+            assert cols[name] > 0
+        assert big_table.nbytes() == sum(cols.values())
+        assert big_table.device_nbytes() == \
+            cols["lo_tok"] + cols["hi_tok"] + cols["flags"]
+
+
+# ---------------------------------------------------------------------------
+# CSR hash-range routing
+
+
+class TestRouting:
+    def test_clip_is_a_partition_of_global_pairs(self):
+        rng = np.random.default_rng(11)
+        for trial in range(20):
+            n_rows = int(rng.integers(10, 400))
+            bounds = slice_bounds(n_rows, int(rng.integers(2, 9)))
+            q = int(rng.integers(1, 30))
+            starts = rng.integers(0, n_rows, q)
+            counts = rng.integers(0, 12, q)
+            counts = np.minimum(counts, n_rows - starts)
+            vers = rng.integers(0, 50, q).astype(np.int32)
+            total = int(counts.sum())
+            plans = clip_descriptors(bounds, starts.astype(np.int32),
+                                     counts.astype(np.int32), vers)
+            gmaps = [p.gmap for p in plans]
+            allg = np.concatenate(gmaps) if gmaps else \
+                np.zeros(0, np.int64)
+            # every global pair lands in exactly one slice
+            assert sorted(allg.tolist()) == list(range(total))
+            for p in plans:
+                assert p.total == p.gmap.size == int(p.q_count.sum())
+                r0, r1 = bounds[p.idx], bounds[p.idx + 1]
+                assert (p.q_start >= 0).all()
+                assert (p.q_start + p.q_count <= r1 - r0).all()
+
+    def test_most_dispatches_touch_few_slices(self):
+        # a query whose bucket sits inside one slice routes to exactly
+        # that slice — the 1–2-slices-per-dispatch property
+        bounds = slice_bounds(100, 4)   # [0,25,50,75,100]
+        plans = clip_descriptors(
+            bounds, np.array([30, 40], np.int32),
+            np.array([5, 3], np.int32), np.array([0, 1], np.int32))
+        assert [p.idx for p in plans] == [1]
+
+    def test_merge_all_compact_matches_dense(self):
+        rng = np.random.default_rng(5)
+        bounds = slice_bounds(60, 3)
+        starts = np.array([0, 22, 41, 55], np.int32)
+        counts = np.array([10, 25, 10, 5], np.int32)
+        vers = np.zeros(4, np.int32)
+        total = int(counts.sum())
+        dense_global = rng.integers(0, 3, total).astype(np.int8)
+        plans = clip_descriptors(bounds, starts, counts, vers)
+        results_d, results_c = [], []
+        for p in plans:
+            local = dense_global[p.gmap]
+            keep = np.nonzero(local)[0]
+            results_d.append((p, np.concatenate(
+                [local, np.zeros(7, np.int8)])))   # padded dense
+            results_c.append((p, CompactBits(
+                keep.astype(np.int32), local[keep], p.total)))
+        got_d = merge_slice_bits(results_d, total)
+        got_c = merge_slice_bits(results_c, total)
+        assert (got_d == dense_global).all()
+        assert isinstance(got_c, CompactBits)
+        assert (got_c.dense() == dense_global).all()
+        # strictly ascending global hit order (slice_bits contract)
+        assert (np.diff(got_c.pair_idx) > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: ≥ 4× budget, bit-identical, overlapped
+
+
+class TestAcceptance:
+    def _streamed(self, big_table, **kw):
+        dev = big_table.device_nbytes()
+        budget_mb = dev / (4 * (1 << 20))   # table = 4× the budget
+        opts = StreamOptions(device_budget_mb=budget_mb)
+        sd = StreamingDetector(big_table, opts, **kw)
+        assert sd.n_slices >= 8
+        return sd
+
+    def test_4x_budget_bit_identity_device_path(self, big_table):
+        """A table 4× the per-device budget scans end-to-end with hits
+        bit-identical (order included) to the unstreamed single-shot
+        join."""
+        sd = self._streamed(big_table)
+        bd = BatchDetector(big_table)
+        batches = [_storm_queries(s, 48) for s in range(8)]
+        try:
+            expect = bd.detect_many(batches)
+            got = sd.detect_many(batches)
+            assert [_keys(h) for h in got] == \
+                [_keys(h) for h in expect]
+            assert sum(len(h) for h in expect) > 0
+        finally:
+            sd.close()
+            bd.close()
+
+    def test_4x_budget_bit_identity_host_fallback(self, big_table):
+        """Open breaker ⇒ the streamed detector serves the host join
+        over the FULL table, bit-identically (the graftguard
+        contract is unchanged by streaming)."""
+        sd = self._streamed(big_table)
+        bd = BatchDetector(big_table)
+        batches = [_storm_queries(s, 32) for s in range(4)]
+        try:
+            expect = bd.detect_many(batches)
+            GUARD.configure(fail_threshold=1, reset_timeout_s=60.0)
+            FAILPOINTS.set("detect.dispatch", "error")
+            fb0 = METRICS.get("trivy_tpu_fallback_joins_total")
+            got = sd.detect_many(batches)
+            assert [_keys(h) for h in got] == \
+                [_keys(h) for h in expect]
+            assert METRICS.get("trivy_tpu_fallback_joins_total") > fb0
+            # the first dispatch errored and opened the breaker
+            # (threshold 1); later dispatches never touch the device
+            assert GUARD.breaker.state_name() == "open"
+        finally:
+            FAILPOINTS.configure("")
+            GUARD.reset_for_tests()
+            sd.close()
+            bd.close()
+
+    def test_double_buffer_overlap_in_upload_ledger(self, big_table):
+        """The steady-state double-buffer property, asserted from the
+        shard_upload ledger rows: after the first slice pass, every
+        make-resident wait hits a PREFETCHED upload — per-dispatch
+        upload stall ≈ 0 (exactly one cold wait in the whole run,
+        thanks to the walk-tail prefetch)."""
+        LEDGER.reset_for_tests()
+        sd = self._streamed(big_table)
+        try:
+            batches = [_storm_queries(s, 64) for s in range(6)]
+            sd.detect_many(batches)
+            stats = LEDGER.shard_upload_stats()["stream"]
+            assert stats["bytes"] > 0
+            assert stats["waits"] >= sd.n_slices
+            # the overlap property: only the very first wait of the
+            # run uploaded cold; every later slice was already in
+            # flight (prefetched) when its turn came
+            assert stats["cold_waits"] == 1
+            assert stats["prefetched"] == stats["uploads"] - 1
+            assert stats["stall_ms"] >= stats["cold_stall_ms"] >= 0
+            # the transfer ledger carries the host→device path
+            agg = LEDGER.aggregate()
+            assert agg["transfer_bytes"]["shard_upload"] == \
+                stats["bytes"]
+            assert agg["shard_uploads"]["stream"] == stats
+        finally:
+            sd.close()
+
+    def test_upload_series_under_strict_exposition(self, big_table):
+        sd = self._streamed(big_table)
+        try:
+            sd.detect_many([_storm_queries(1, 32)])
+        finally:
+            sd.close()
+        families = parse_exposition(METRICS.render())
+        transfer = families["trivy_tpu_device_transfer_bytes_total"]
+        upload = [v for _n, labels, v in transfer["samples"]
+                  if labels.get("path") == "shard_upload"]
+        assert upload and upload[0] > 0
+        stall = families["trivy_tpu_device_upload_stall_ms"]
+        counts = [v for n, _labels, v in stall["samples"]
+                  if n.endswith("_count")]
+        assert counts and counts[0] > 0
+
+    def test_streamed_compact_and_overflow_identity(self, big_table):
+        """Hit compaction rides the slice walk: small hit buffers
+        (forced by hit_floor/hit_align) overflow on hit-dense slices
+        and the checked dense re-fetch keeps results bit-identical."""
+        dev = big_table.device_nbytes()
+        opts = StreamOptions(device_budget_mb=dev / (4 * (1 << 20)))
+        sd = StreamingDetector(big_table, opts, hit_floor=8,
+                               hit_align=8)
+        bd = BatchDetector(big_table)
+        # low installed versions ⇒ almost every pair satisfied ⇒
+        # hit-dense ⇒ the tiny buffers overflow
+        dense = [[PkgQuery(source="alpine 3.17", ecosystem="alpine",
+                           name=f"storm-pkg-{k}", version="1.0.0-r0")
+                  for k in range(96)]]
+        sparse = [_storm_queries(9, 64)]
+        try:
+            for batches in (dense, sparse):
+                expect = bd.detect_many(batches)
+                got = sd.detect_many(batches)
+                assert [_keys(h) for h in got] == \
+                    [_keys(h) for h in expect]
+        finally:
+            sd.close()
+            bd.close()
+
+    def test_warmup_pretouches_resident_pair(self, big_table):
+        LEDGER.reset_for_tests()
+        sd = self._streamed(big_table)
+        try:
+            sd.warmup()
+            stats = LEDGER.shard_upload_stats()["stream"]
+            assert stats["uploads"] == 2
+            assert stats["prefetched"] == 2
+        finally:
+            sd.close()
+
+
+# ---------------------------------------------------------------------------
+# detectd over the streamed detector
+
+
+class TestDetectdOverStream:
+    def test_coalesced_equals_serial_and_walks_once(self, big_table):
+        """c=6 hammer through DispatchScheduler(StreamingDetector):
+        results hit-for-hit identical to serial, and a coalesced chunk
+        walks the slices ONCE — upload waits scale with dispatch
+        rounds, not request count."""
+        requests = [[_storm_queries(100 + r * 3 + b, 24)
+                     for b in range(2)] for r in range(12)]
+        serial = BatchDetector(big_table)
+        expected = [serial.detect_many(b) for b in requests]
+        serial.close()
+
+        dev = big_table.device_nbytes()
+        sd = StreamingDetector(
+            big_table,
+            StreamOptions(device_budget_mb=dev / (4 * (1 << 20))))
+        LEDGER.reset_for_tests()
+        sched = DispatchScheduler(sd, SchedOptions(coalesce_wait_ms=5.0))
+        results: list = [None] * len(requests)
+        errors: list = []
+
+        def worker(ids):
+            try:
+                for i in ids:
+                    results[i] = sched.detect_many(requests[i])
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(
+            target=worker, args=(range(k, len(requests), 6),))
+            for k in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rounds = METRICS.get("trivy_tpu_detect_batches_total")
+        sched.close()
+        sd.close()
+        assert not errors
+        got = [[_keys(h) for h in r] for r in results]
+        want = [[_keys(h) for h in r] for r in expected]
+        assert got == want
+        stats = LEDGER.shard_upload_stats()["stream"]
+        # waits are per (dispatch round × touched slice): merged
+        # chunks walk the resident set once, so waits can never reach
+        # requests × slices
+        assert stats["waits"] <= rounds * sd.n_slices + sd.n_slices
+        assert stats["waits"] < len(requests) * sd.n_slices
+
+
+# ---------------------------------------------------------------------------
+# the streamed mesh path
+
+
+class TestMeshStream:
+    @pytest.mark.parametrize("db_shards", [1, 2])
+    def test_mesh_stream_parity(self, big_table, db_shards):
+        mesh = make_mesh(8, db_shards=db_shards)
+        md = MeshDetector(big_table, mesh, db_shards=db_shards,
+                          stream=StreamOptions(slices=4))
+        assert md._stream_bounds is not None
+        bd = BatchDetector(big_table)
+        batches = [_storm_queries(50 + s, 40) for s in range(5)]
+        try:
+            expect = bd.detect_many(batches)
+            got = md.detect_many(batches)
+            assert [_keys(h) for h in got] == \
+                [_keys(h) for h in expect]
+        finally:
+            md.close()
+            bd.close()
+
+    def test_mesh_within_budget_stays_resident(self, big_table):
+        mesh = make_mesh(8, db_shards=2)
+        huge = big_table.device_nbytes() * 8 / (1 << 20)
+        md = MeshDetector(big_table, mesh, db_shards=2,
+                          stream=StreamOptions(device_budget_mb=huge))
+        try:
+            assert md._stream_bounds is None
+            assert md._st_dev is not None
+        finally:
+            md.close()
+
+    def test_mesh_stream_upload_ledger(self, big_table):
+        LEDGER.reset_for_tests()
+        mesh = make_mesh(8, db_shards=2)
+        md = MeshDetector(big_table, mesh, db_shards=2,
+                          stream=StreamOptions(slices=4))
+        try:
+            md.detect_many([_storm_queries(s, 48) for s in range(4)])
+            stats = LEDGER.shard_upload_stats()["mesh"]
+            assert stats["bytes"] > 0
+            assert stats["cold_waits"] <= 1
+        finally:
+            md.close()
+
+
+# ---------------------------------------------------------------------------
+# SliceCache unit behavior
+
+
+class TestSliceCache:
+    def test_lru_eviction_keeps_capacity(self):
+        uploads = []
+
+        def up(k):
+            uploads.append(k)
+            return (np.zeros(4),), 32
+
+        c = SliceCache(up, capacity=2, site="stream")
+        for k in (0, 1, 2, 3):
+            c.get(k)
+        assert len(c.resident()) == 2
+        assert set(c.resident()) == {2, 3}
+        assert uploads == [0, 1, 2, 3]
+        c.get(2)            # hit: no new upload
+        assert uploads == [0, 1, 2, 3]
+
+    def test_failed_upload_is_not_cached(self):
+        calls = []
+
+        def up(k):
+            calls.append(k)
+            if len(calls) == 1:
+                raise RuntimeError("transient")
+            return (np.zeros(2),), 16
+
+        c = SliceCache(up, capacity=2, site="stream")
+        c.prefetch(0)            # swallowed, logged
+        assert c.resident() == []
+        c.get(0)                 # retried cold, succeeds
+        assert c.resident() == [0]
+        assert calls == [0, 0]
+
+    def test_concurrent_get_uploads_once(self):
+        import time as _t
+        n = [0]
+
+        def up(k):
+            n[0] += 1
+            _t.sleep(0.02)
+            return (np.zeros(2),), 16
+
+        c = SliceCache(up, capacity=2, site="stream")
+        threads = [threading.Thread(target=c.get, args=(7,))
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert n[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# scanner / server wiring
+
+
+class TestWiring:
+    def test_local_scanner_picks_streaming_detector(self, big_table):
+        from trivy_tpu.fanal.cache import MemoryCache
+        from trivy_tpu.scanner import LocalScanner
+        s = LocalScanner(MemoryCache(), big_table,
+                         stream=StreamOptions(slices=3))
+        try:
+            assert isinstance(s.detector, StreamingDetector)
+            assert s.detector.n_slices == 3
+        finally:
+            s.close()
+        # within budget → plain BatchDetector
+        s2 = LocalScanner(MemoryCache(), big_table,
+                          stream=StreamOptions())
+        try:
+            assert isinstance(s2.detector, BatchDetector)
+        finally:
+            s2.close()
+
+    def test_server_streams_and_debug_perf_shows_uploads(
+            self, big_table, tmp_path):
+        import json as _json
+        import urllib.request
+
+        from trivy_tpu.resilience.storm import request_doc
+        from trivy_tpu.server.listen import MeshOptions, \
+            serve_background
+        LEDGER.reset_for_tests()
+        httpd, state = serve_background(
+            "127.0.0.1", 0, big_table, cache_dir=str(tmp_path),
+            cache_backend="memory",
+            mesh_opts=MeshOptions(table_stream_slices=4))
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            assert isinstance(state.scanner.detector,
+                              StreamingDetector)
+            doc = request_doc(77, 0, n_pkgs=16)
+            body = _json.dumps({
+                "diff_id": doc["DiffID"],
+                "blob_info": doc}).encode()
+            req = urllib.request.Request(
+                base + "/twirp/trivy.cache.v1.Cache/PutBlob",
+                data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10).read()
+            req = urllib.request.Request(
+                base + "/twirp/trivy.scanner.v1.Scanner/Scan",
+                data=_json.dumps({
+                    "target": "t", "artifact_id": doc["DiffID"],
+                    "blob_ids": [doc["DiffID"]]}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = _json.loads(
+                urllib.request.urlopen(req, timeout=30).read())
+            assert "results" in resp
+            perf = _json.loads(urllib.request.urlopen(
+                base + "/debug/perf", timeout=10).read())
+            assert "shard_uploads" in perf["totals"]
+            assert perf["totals"]["shard_uploads"]["stream"][
+                "uploads"] > 0
+            # per-column resident breakdown reached the memory view
+            resident = perf["memory"]["resident_bytes"]
+            assert resident["advisory_table.lo_tok"] > 0
+            assert resident["advisory_table"] == \
+                sum(v for k, v in resident.items()
+                    if k.startswith("advisory_table."))
+            health = _json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=10).read())
+            assert "advisory_table.lo_tok" in \
+                health["device"]["memory"]["resident_bytes"]
+            # the stream view: slice plan + resident set
+            assert health["stream"]["slices"] == 4
+            assert len(health["stream"]["resident"]) <= 2
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()

@@ -334,6 +334,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "domain probes and readmission probes; expiry "
                         "trips only that device's breaker "
                         "(default 5000)")
+    p.add_argument("--mesh-hosts", type=int, default=0,
+                   help="meshguard host fault domains: 0 = map "
+                        "devices to their real process_index (multi-"
+                        "host jobs); N > 1 = synthetic contiguous "
+                        "host blocks for drills. Domains engage only "
+                        "when ≥ 2 hosts result")
+    p.add_argument("--mesh-host-loss-window-ms", type=float,
+                   default=250.0,
+                   help="meshguard: after one device of a multi-"
+                        "device host trips, hold the shrink this long "
+                        "for its siblings — a dead host then costs "
+                        "ONE re-factorized dp×db rebuild instead of "
+                        "N serial single-chip shrinks (default 250)")
+    p.add_argument("--table-device-budget-mb", type=float, default=0.0,
+                   help="graftstream: per-device byte budget for "
+                        "resident advisory slices; a table exceeding "
+                        "it streams through a double-buffered slice "
+                        "pair with uploads overlapped against "
+                        "compute. 0 = auto from the device's memory "
+                        "limit (graftprof hbm view; CPU backends "
+                        "never auto-engage)")
+    p.add_argument("--table-stream-slices", type=int, default=0,
+                   help="graftstream: force the advisory table to "
+                        "stream through N hash-range slices "
+                        "regardless of the byte budget (0 = size "
+                        "from --table-device-budget-mb)")
     p.add_argument("--drain-grace-ms", type=float, default=10000.0,
                    help="SIGTERM/SIGINT graceful drain: stop "
                         "admitting (503 + Retry-After), let in-flight "
@@ -1118,7 +1144,13 @@ def cmd_server(args) -> int:
         rebuild_cooldown_ms=getattr(args, "mesh_rebuild_cooldown_ms",
                                     1000.0),
         probe_timeout_ms=getattr(args, "mesh_probe_timeout_ms",
-                                 5000.0))
+                                 5000.0),
+        hosts=getattr(args, "mesh_hosts", 0),
+        host_loss_window_ms=getattr(args, "mesh_host_loss_window_ms",
+                                    250.0),
+        table_device_budget_mb=getattr(args, "table_device_budget_mb",
+                                       0.0),
+        table_stream_slices=getattr(args, "table_stream_slices", 0))
     # graftmemo + redetectd: result memoization keyed by (blob digest,
     # db_version), with the post-swap background re-detect sweep
     from .detect.redetect import RedetectOptions

@@ -122,6 +122,34 @@ class AdvisoryTable:
             self._hash_u64 = (hi << np.uint64(32)) | lo
         return self._hash_u64
 
+    def nbytes_by_column(self) -> dict:
+        """Per-column byte accounting of the flattened table — the
+        graftstream slice planner's sizing input and graftprof's
+        per-component `resident_bytes` breakdown (/healthz
+        `device.memory`). Keys are the column names; `hash_u64` only
+        appears once the lazy lookup view has been built."""
+        cols = {
+            "hash": self.hash, "lo_tok": self.lo_tok,
+            "hi_tok": self.hi_tok, "flags": self.flags,
+            "group": self.group,
+        }
+        if self._hash_u64 is not None:
+            cols["hash_u64"] = self._hash_u64
+        return {name: int(arr.nbytes) for name, arr in cols.items()}
+
+    def nbytes(self) -> int:
+        """Total columnar footprint (host-resident arrays; the Python
+        group objects are the GC-frozen long tail and not what the
+        HBM cliff cares about)."""
+        return sum(self.nbytes_by_column().values())
+
+    def device_nbytes(self) -> int:
+        """Bytes `device_arrays()` ships per device — what the
+        streaming planner budgets against (hashes stay host-side; the
+        device only ever sees version tokens and flags)."""
+        return int(self.lo_tok.nbytes + self.hi_tok.nbytes
+                   + self.flags.nbytes)
+
     def content_digest(self) -> str:
         """Deterministic digest of the flattened table — the fleet's
         `db_version` identity (/healthz, X-Trivy-DB-Version). Two

@@ -33,7 +33,7 @@ from ..db.table import AdvisoryTable
 from ..log import get as _get_logger
 from ..metrics import METRICS
 from ..obs import SLO, note_dispatch, recording, span
-from ..obs.perf import LEDGER, table_resident_bytes
+from ..obs.perf import LEDGER, stamp_table_resident
 from ..ops import bucket_ladder, bucket_size
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
@@ -205,11 +205,12 @@ class BatchDetector:
         self._asm_pool = ThreadPoolExecutor(
             max_workers=assemble_workers,
             thread_name_prefix="detect-asm")
-        # graftprof memory telemetry: the table's columnar footprint,
-        # re-stamped on every detector build (so a DB hot swap's
-        # growth toward the HBM cliff is visible in /healthz)
-        LEDGER.note_resident("advisory_table",
-                             table_resident_bytes(table))
+        # graftprof memory telemetry: the table's columnar footprint —
+        # whole-table AND per-column (AdvisoryTable.nbytes_by_column)
+        # — re-stamped on every detector build (so a DB hot swap's
+        # growth toward the HBM cliff is visible in /healthz, column
+        # by column)
+        stamp_table_resident(table)
 
     def close(self) -> None:
         """Join the engine's worker threads. Idempotent; the engine is

@@ -60,6 +60,12 @@ CONFIG_PATHS = {
     "mesh_min_devices": "mesh.min-devices",
     "mesh_rebuild_cooldown_ms": "mesh.rebuild-cooldown-ms",
     "mesh_probe_timeout_ms": "mesh.probe-timeout-ms",
+    "mesh_hosts": "mesh.hosts",
+    "mesh_host_loss_window_ms": "mesh.host-loss-window-ms",
+    # graftstream (larger-than-device advisory tables) rides the
+    # mesh.* config section — it is the mesh data dimension made real
+    "table_device_budget_mb": "mesh.table-device-budget-mb",
+    "table_stream_slices": "mesh.table-stream-slices",
     # graftfleet (fleet.* / cache.*): scan router + shared backends
     "cache_backend": "cache.backend",
     "replicas": "fleet.replicas",

@@ -311,6 +311,12 @@ METRICS.declare(
     "Mesh devices expelled from their fault domain (watchdog trip or "
     "breaker threshold).")
 METRICS.declare(
+    "trivy_tpu_mesh_host_lost_total", "counter",
+    "Whole hosts lost from the mesh: every device sharing one host "
+    "fault domain tripped inside the host-loss window, collapsing N "
+    "single-chip shrinks into ONE debounced dp×db re-factorization "
+    "over the survivors.")
+METRICS.declare(
     "trivy_tpu_fleet_replica_state", "gauge",
     "graftfleet per-replica fault domain: 0 closed, 1 open, 2 "
     "half-open (one series per replica URL).")
@@ -416,9 +422,10 @@ METRICS.declare(
     "graftprof dispatch ledger: accepted device launches by site "
     "(site=\"detect\" single-chip engine, \"detectd\" merged "
     "coalesced dispatches, \"mesh\" sharded mesh launches, "
-    "\"secret\" the shift-or secrets engine, \"redetect\" blameless "
-    "redetectd sweep replays). Warmup launches are compiles, not "
-    "traffic, and are excluded.")
+    "\"stream\" per-slice graftstream launches, \"secret\" the "
+    "shift-or secrets engine, \"redetect\" blameless redetectd sweep "
+    "replays). Warmup launches are compiles, not traffic, and are "
+    "excluded.")
 METRICS.declare(
     "trivy_tpu_device_padding_waste_ratio", "histogram",
     "Padding waste per device dispatch by launch site: (padded rows "
@@ -437,12 +444,14 @@ METRICS.declare(
              1000.0, 2500.0, 5000.0, 15000.0, 60000.0))
 METRICS.declare(
     "trivy_tpu_device_transfer_bytes_total", "counter",
-    "graftprof ledger: device->host result bytes by path "
+    "graftprof ledger: device link bytes by path "
     "(path=\"compact\" O(hits) hit buffers, path=\"dense\" full "
     "padded vectors, path=\"overflow\" the dense re-fetch a hit-"
-    "buffer overflow pays on top of its wasted compact fetch) — "
-    "unlike trivy_tpu_detect_transfer_bytes_total this series "
-    "separates the overflow re-fetch and covers every ledger site.")
+    "buffer overflow pays on top of its wasted compact fetch — all "
+    "device->host; path=\"shard_upload\" graftstream host->device "
+    "advisory-slice uploads) — unlike "
+    "trivy_tpu_detect_transfer_bytes_total this series separates the "
+    "overflow re-fetch and covers every ledger site.")
 METRICS.declare(
     "trivy_tpu_device_hit_budget_adaptations_total", "counter",
     "Hit-buffer budget adaptations in the compaction epilogue "
@@ -458,10 +467,22 @@ METRICS.declare(
 METRICS.declare(
     "trivy_tpu_device_resident_bytes", "gauge",
     "Host-resident footprint of the big scan structures "
-    "(component=\"advisory_table\" columnar arrays, "
-    "\"version_pool\" the encoded version matrix, \"secret_bank\" "
-    "the shift-or word/mask planes) — the table-growth-toward-the-"
-    "HBM-cliff early warning /healthz surfaces.")
+    "(component=\"advisory_table\" columnar arrays plus its "
+    "per-column \"advisory_table.<col>\" breakdown, "
+    "\"advisory_slice_resident\" the graftstream double-buffered "
+    "device slice pair, \"version_pool\" the encoded version matrix, "
+    "\"secret_bank\" the shift-or word/mask planes) — the "
+    "table-growth-toward-the-HBM-cliff early warning /healthz "
+    "surfaces.")
+METRICS.declare(
+    "trivy_tpu_device_upload_stall_ms", "histogram",
+    "graftstream: time one dispatch blocked making an advisory slice "
+    "device-resident. Double buffering prefetches the next slice "
+    "during the previous slice's compute, so steady-state stalls "
+    "sit in the lowest bucket; mass above it means transfer is "
+    "outrunning compute (shrink the slice count or grow the budget).",
+    buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+             1000.0))
 METRICS.declare(
     "trivy_tpu_profile_captures_total", "counter",
     "graftprof live profiler captures (reason=\"manual\" the "

@@ -187,7 +187,7 @@ def check_storm_replay(doc: dict) -> list[str]:
                 kind = ev.get("kind", "failpoint")
                 if kind not in ("failpoint", "kill_replica",
                                 "swap_table", "db_swap",
-                                "hostile_layer"):
+                                "hostile_layer", "host_loss"):
                     problems.append(
                         f"events[{i}]: unknown kind {kind!r}")
                 if kind == "hostile_layer" and \

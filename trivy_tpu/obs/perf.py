@@ -63,6 +63,14 @@ def _new_row() -> dict:
     }
 
 
+def _new_upload_row() -> dict:
+    return {
+        "uploads": 0, "prefetched": 0, "bytes": 0,
+        "waits": 0, "cold_waits": 0,
+        "stall_ms": 0.0, "cold_stall_ms": 0.0,
+    }
+
+
 class DispatchLedger:
     """Process-wide per-shape dispatch accounting (LEDGER, shared like
     METRICS). Shape key = (site, padded rows, hit capacity): each key
@@ -80,6 +88,7 @@ class DispatchLedger:
         self._transfers: dict[str, int] = {}
         self._adapt = {"up": 0, "down": 0}
         self._resident: dict[str, int] = {}
+        self._uploads: dict[str, dict] = {}
         self._mem: dict[str, dict] = {}
         self._mem_last = 0.0
         self._mem_peak = 0
@@ -149,6 +158,46 @@ class DispatchLedger:
             row["hit_fill_n"] += 1
             if n_hits > h_cap:
                 row["overflows"] += 1
+
+    def note_shard_upload(self, site: str, nbytes: int,
+                          prefetched: bool) -> None:
+        """One host→device advisory-slice upload (graftstream).
+        `prefetched` means the double buffer shipped it AHEAD of need,
+        overlapped with the previous slice's compute; a non-prefetched
+        upload ran inside a dispatch's wait (the cold path). Counts in
+        the transfer ledger under path="shard_upload" so streaming
+        overhead shows at /debug/perf next to the result fetches."""
+        with self._lock:
+            row = self._uploads.setdefault(site, _new_upload_row())
+            row["uploads"] += 1
+            row["bytes"] += int(nbytes)
+            if prefetched:
+                row["prefetched"] += 1
+        self.note_transfer("shard_upload", float(nbytes))
+
+    def note_shard_wait(self, site: str, stall_ms: float,
+                        cold: bool) -> None:
+        """Time one dispatch spent blocked making a slice resident.
+        Steady-state double buffering means stalls ≈ 0 after the first
+        slice of a walk — the overlap property the streaming tests
+        assert from these rows. `cold` = the upload itself ran inside
+        this wait (nothing had prefetched the slice)."""
+        with self._lock:
+            row = self._uploads.setdefault(site, _new_upload_row())
+            row["waits"] += 1
+            row["stall_ms"] += stall_ms
+            if cold:
+                row["cold_waits"] += 1
+                row["cold_stall_ms"] += stall_ms
+        METRICS.observe("trivy_tpu_device_upload_stall_ms", stall_ms)
+
+    def shard_upload_stats(self) -> dict:
+        """→ {site: upload/stall aggregates} — the graftstream
+        overlap view (/debug/perf `shard_uploads`, bench table_sweep,
+        and the tier-1 double-buffer assertion)."""
+        with self._lock:
+            return {site: dict(row)
+                    for site, row in self._uploads.items()}
 
     def note_budget_adapt(self, direction: str) -> None:
         """One hit-budget adaptation ("up" on overflow, "down" on a
@@ -263,6 +312,8 @@ class DispatchLedger:
             shapes = [dict(v) for v in self._shapes.values()]
             transfers = dict(self._transfers)
             adapt = dict(self._adapt)
+            uploads = {site: dict(row)
+                       for site, row in self._uploads.items()}
         real = sum(r["real_rows"] for r in shapes)
         padded = sum(r["padded_rows"] for r in shapes)
         return {
@@ -283,6 +334,9 @@ class DispatchLedger:
             "overflows": sum(r["overflows"] for r in shapes),
             "transfer_bytes": transfers,
             "budget_adaptations": adapt,
+            # graftstream: host→device slice-upload overlap aggregates
+            # (uploads/prefetched/stall_ms per site)
+            "shard_uploads": uploads,
         }
 
     def site_dispatches(self) -> dict[str, int]:
@@ -300,6 +354,7 @@ class DispatchLedger:
             self._transfers = {}
             self._adapt = {"up": 0, "down": 0}
             self._resident = {}
+            self._uploads = {}
             self._mem = {}
             self._mem_last = 0.0
             self._mem_peak = 0
@@ -330,6 +385,25 @@ def table_resident_bytes(table) -> int:
     return ndarray_bytes(*(getattr(table, name, None)
                            for name in ("lo_tok", "hi_tok", "flags",
                                         "hash_u64", "group")))
+
+
+def stamp_table_resident(table) -> int:
+    """Stamp one AdvisoryTable's footprint into the resident-bytes
+    view: the whole-table figure PLUS the per-column breakdown
+    (`advisory_table.lo_tok`, …) the graftstream slice planner budgets
+    from — the build sites used to stamp only the total, so /healthz
+    could not say WHICH column was marching toward the HBM cliff."""
+    cols = getattr(table, "nbytes_by_column", None)
+    if not callable(cols):
+        total = table_resident_bytes(table)
+        LEDGER.note_resident("advisory_table", total)
+        return total
+    breakdown = cols()
+    total = sum(breakdown.values())
+    LEDGER.note_resident("advisory_table", total)
+    for name, nb in breakdown.items():
+        LEDGER.note_resident(f"advisory_table.{name}", nb)
+    return total
 
 
 # ---------------------------------------------------------------------------

@@ -67,6 +67,25 @@ def process_info() -> tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 
+def host_assignments(devices, synthetic_hosts: int = 0) -> dict:
+    """device id → host fault-domain id (meshguard's `host_of` map).
+
+    Devices sharing a host fail together — a dead host takes all of
+    its chips at once, and meshguard should answer with ONE debounced
+    dp×db re-factorization over the survivors, not N serial
+    single-chip shrinks. Real multi-host jobs read each device's
+    `process_index`; `synthetic_hosts` > 1 overrides with contiguous
+    equal blocks so drills (storm's host_loss event, tier-1 tests) can
+    exercise host loss on a single-process virtual platform."""
+    devs = list(devices)
+    n = len(devs)
+    if synthetic_hosts > 1 and n:
+        return {int(d.id): i * synthetic_hosts // n
+                for i, d in enumerate(devs)}
+    return {int(d.id): int(getattr(d, "process_index", 0) or 0)
+            for d in devs}
+
+
 def global_mesh(db_shards: int = 1):
     """dp×db mesh over every device of every host in the job (falls
     back to the local devices when not distributed). The db width is

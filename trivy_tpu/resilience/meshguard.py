@@ -32,6 +32,16 @@ dp×db mesh was built from. meshguard splits that domain per device:
                    degrades to the host join (empty device set) instead
                    of flapping through ever-smaller meshes.
 
+Host fault domains: devices share hosts (`host_of`, from
+parallel.multihost.host_assignments), and a dead host takes every one
+of its chips at once. Losing one device of a multi-device host HOLDS
+the shrink for `host_loss_window_ms` so the sibling domains' trips
+coalesce — a `host_loss` (all of one host's domains tripping inside
+the window) costs ONE debounced rebuild that re-factorizes dp×db over
+the survivors (`best_db_shards`/`mesh_from_devices` in the owner's
+callback), never N serial single-chip rebuilds. Readmission grows back
+per device through the same swap drain.
+
 Attribution: the per-device sites cover the domain-probe phase of
 each dispatch (and the readmission probes) directly. The collective
 shard_map launch runs under the backend-level `detect.dispatch`
@@ -142,13 +152,25 @@ class BreakerRegistry:
 @dataclass
 class MeshGuardOptions:
     """meshguard knobs (server flags --mesh-min-devices,
-    --mesh-rebuild-cooldown-ms, --mesh-probe-timeout-ms)."""
+    --mesh-rebuild-cooldown-ms, --mesh-probe-timeout-ms,
+    --mesh-host-loss-window-ms)."""
     min_devices: int = 1              # survivors below this → host join
     rebuild_cooldown_ms: float = 1000.0   # debounce between rebuilds
     probe_timeout_ms: float = 5000.0  # per-device watchdog deadline
     probe_interval_ms: float = 100.0  # maintenance/readmission cadence
     fail_threshold: int = 3           # per-device breaker threshold
     reset_timeout_ms: float = 1000.0  # per-device open→half-open window
+    # host fault domains (host_of): when a device of a multi-device
+    # host trips, hold the shrink for its siblings' domains to trip
+    # too — a dying host then costs ONE re-factorized rebuild over the
+    # survivors instead of N serial single-chip shrinks. The hold is
+    # released the moment the sibling probes RESOLVE (healthy siblings
+    # answer fast, so a genuine single-chip loss shrinks promptly; a
+    # wedged sibling's probe extends the hold past its own watchdog
+    # deadline — this window is the floor, not the whole story), and
+    # expiring with the host only partially lost shrinks on whatever
+    # is lost by then
+    host_loss_window_ms: float = 250.0
 
 
 class MeshGuard:
@@ -161,9 +183,14 @@ class MeshGuard:
     request path."""
 
     def __init__(self, device_ids, opts: MeshGuardOptions | None = None,
-                 probe=None):
+                 probe=None, host_of: dict | None = None):
         self.all_ids = list(device_ids)
         self.opts = opts or MeshGuardOptions()
+        # host fault domains: device id → host id (devices sharing a
+        # host fail together — parallel.multihost.host_assignments).
+        # None/empty = every device is its own blast radius, the
+        # pre-host behavior.
+        self.host_of = dict(host_of) if host_of else {}
         self.registry = BreakerRegistry(
             fail_threshold=self.opts.fail_threshold,
             reset_timeout_s=self.opts.reset_timeout_ms / 1e3)
@@ -172,6 +199,18 @@ class MeshGuard:
         self._cv = threading.Condition()
         self._lost: set = set()
         self._pending: str | None = None   # scheduled rebuild reason
+        # host-loss debounce: a pending shrink is HELD until this
+        # monotonic instant while a partially-lost host's sibling
+        # domains are still tripping (0 = no hold)
+        self._hold_until = 0.0
+        self._hosts_lost: set = set()      # fully-lost hosts (status)
+        # hosts with a fresh partial loss: the maintenance thread
+        # probes their remaining devices (a dead host's siblings are
+        # usually seconds from tripping anyway, but dispatches stop
+        # probing domains the moment any_lost() turns the mesh
+        # host-side — without these probes the siblings would only
+        # trip one rebuild at a time)
+        self._suspects: set = set()
         self._fault_trace = ""    # trace that saw the triggering loss
         self._attributing = False  # a collective failure asked "who?"
         self._last_rebuild = float("-inf")
@@ -306,9 +345,19 @@ class MeshGuard:
             raise outcome[0]
 
     def device_failed(self, dev_id) -> None:
-        """Mark one device lost and schedule a shrink rebuild."""
+        """Mark one device lost and schedule a shrink rebuild.
+
+        Host fault domains (host_of): losing one device of a
+        multi-device host HOLDS the shrink for `host_loss_window_ms`,
+        because its siblings are usually about to trip too (a dead
+        host takes all its chips at once) — when the last sibling
+        lands, the hold clears and ONE rebuild re-factorizes dp×db
+        over the survivors. The window expiring first shrinks on
+        whatever is lost by then."""
         from ..obs.trace import current_trace_id
         tid = current_trace_id()
+        host = self.host_of.get(dev_id)
+        host_lost = False
         with self._cv:
             if dev_id not in self.all_ids or dev_id in self._lost:
                 return
@@ -320,6 +369,21 @@ class MeshGuard:
             # the maintenance thread, whose log lines re-enter this
             # context so operators can join loss → rebuild by one id
             self._fault_trace = tid
+            if host is not None:
+                peers = [i for i in self.all_ids
+                         if self.host_of.get(i) == host]
+                if all(i in self._lost for i in peers):
+                    # the whole host is down: stop holding — the ONE
+                    # debounced rebuild can go now
+                    host_lost = True
+                    self._hosts_lost.add(host)
+                    self._hold_until = 0.0
+                elif len(peers) > 1:
+                    self._hold_until = max(
+                        self._hold_until,
+                        time.monotonic()
+                        + self.opts.host_loss_window_ms / 1e3)
+                    self._suspects.add(host)
             self._cv.notify()
         METRICS.inc("trivy_tpu_mesh_device_lost_total")
         _log.warning("meshguard: device %s lost; shrink rebuild "
@@ -330,6 +394,17 @@ class MeshGuard:
                                 device=str(dev_id))
         except Exception:
             _log.exception("meshguard event note failed")
+        if host_lost:
+            METRICS.inc("trivy_tpu_mesh_host_lost_total")
+            _log.warning("meshguard: host %s fully lost (every device "
+                         "sharing it tripped); one re-factorized "
+                         "shrink rebuild scheduled", host)
+            try:
+                from ..obs.recorder import RECORDER
+                RECORDER.note_event("host_loss", trace_id=tid,
+                                    host=str(host))
+            except Exception:
+                _log.exception("meshguard event note failed")
 
     def on_rebuild(self, cb) -> None:
         with self._cv:
@@ -366,8 +441,13 @@ class MeshGuard:
         cb = reason = survivors = None
         fault_trace = ""
         with self._cv:
+            # a host-loss hold defers the shrink while a partially-
+            # lost host's sibling domains are still tripping, so the
+            # whole host costs one rebuild (device_failed clears the
+            # hold the moment the last sibling lands)
             due = (now - self._last_rebuild) * 1e3 \
-                >= self.opts.rebuild_cooldown_ms
+                >= self.opts.rebuild_cooldown_ms \
+                and now >= self._hold_until
             if self._pending is not None and self._rebuild_cb \
                     is not None and due:
                 reason = self._pending
@@ -435,7 +515,64 @@ class MeshGuard:
             METRICS.set_gauge("trivy_tpu_mesh_devices",
                               float(len(active)))
         self._attribute()
+        self._probe_suspect_hosts()
         self._probe_lost()
+
+    def _probe_suspect_hosts(self) -> None:
+        """A device of a multi-device host just tripped: probe its
+        still-active siblings NOW (bounded, on the maintenance
+        thread), because dispatches stopped probing domains the moment
+        any_lost() turned the mesh host-side. A sibling that fails or
+        wedges its probe is expelled immediately (_attribute
+        semantics) — when the last one lands, device_failed clears the
+        host-loss hold and the ONE re-factorized rebuild goes."""
+        with self._cv:
+            if not self._suspects:
+                return
+            suspects = set(self._suspects)
+            self._suspects.clear()
+            active = [i for i in self.all_ids
+                      if i not in self._lost
+                      and self.host_of.get(i) in suspects]
+            probe = self._probe
+            # the hold must cover the probes themselves: each wedged
+            # sibling costs up to probe_timeout (serially), which can
+            # dwarf the configured window — a 250 ms hold expiring
+            # under a 5 s probe deadline would fire shrink #1 mid-
+            # attribution and hand back exactly the N-serial-rebuild
+            # behavior host domains exist to prevent
+            if active:
+                self._hold_until = max(
+                    self._hold_until,
+                    time.monotonic()
+                    + len(active) * self.opts.probe_timeout_ms / 1e3
+                    + self.opts.probe_interval_ms / 1e3)
+        if active:
+            _log.warning("meshguard: probing %d sibling device(s) of "
+                         "partially-lost host(s) %s", len(active),
+                         sorted(str(h) for h in suspects))
+        for dev_id in active:
+            br = self.registry.get(dev_id)
+            site = mesh_site(dev_id)
+            try:
+                with GUARD.watch(
+                        site,
+                        timeout_s=self.opts.probe_timeout_ms / 1e3,
+                        breaker=br):
+                    self._probe_bounded(probe, dev_id, site)
+            except DeviceError:
+                _log.warning("meshguard: sibling probe failed for "
+                             "device %s", dev_id, exc_info=True)
+                self.device_failed(dev_id)
+        # every suspect's siblings just resolved one way or the other
+        # — nothing is left to coalesce, so release the hold instead
+        # of deferring a now-settled shrink for the window's remainder
+        # (a sibling that FAILED re-added its host to the suspect set,
+        # which keeps the hold for the next round instead)
+        with self._cv:
+            if not self._suspects:
+                self._hold_until = 0.0
+                self._cv.notify()
 
     def _probe_lost(self) -> None:
         """Readmission: once a lost device's breaker admits the
@@ -466,6 +603,9 @@ class MeshGuard:
                 continue
             with self._cv:
                 self._lost.discard(dev_id)
+                host = self.host_of.get(dev_id)
+                if host is not None:
+                    self._hosts_lost.discard(host)
                 if self._pending is None:
                     self._pending = "grow"
                 self._cv.notify()
@@ -480,7 +620,8 @@ class MeshGuard:
             lost = sorted(self._lost)
             rebuilds = dict(self._rebuilds)
             pending = self._pending
-        return {
+            hosts_lost = sorted(self._hosts_lost)
+        out = {
             "devices": len(self.all_ids),
             "active": len(self.all_ids) - len(lost),
             "lost": [str(i) for i in lost],
@@ -490,6 +631,18 @@ class MeshGuard:
             "pending_rebuild": pending,
             "breakers": self.registry.status(),
         }
+        if self.host_of:
+            lost_set = set(lost)
+            hosts: dict = {}
+            for dev, h in self.host_of.items():
+                row = hosts.setdefault(str(h), {"devices": 0,
+                                                "lost": 0})
+                row["devices"] += 1
+                if dev in lost_set:
+                    row["lost"] += 1
+            out["hosts"] = hosts
+            out["hosts_lost"] = [str(h) for h in hosts_lost]
+        return out
 
     def close(self) -> None:
         with self._cv:

@@ -140,6 +140,13 @@ class StormEvent:
                     `variant` hostile artifact (truncated gzip layer
                     or decompression bomb) instead of the clean one —
                     the fanald containment drill.
+      host_loss     (mesh only) every `detect.mesh:<slot>` sharing
+                    synthetic host `host` arms a hang-mode fault for
+                    the window — the whole host dies at once. The
+                    invariant beyond the usual set: meshguard answers
+                    with ONE debounced shrink that re-factorizes
+                    dp×db over the survivors, and the probe path
+                    readmits the host after the window.
     """
     at_ms: float
     kind: str = "failpoint"
@@ -150,6 +157,7 @@ class StormEvent:
     dur_ms: float = 0.0
     replica: int = 0
     variant: str = ""
+    host: int = 0
 
     def label(self) -> str:
         if self.kind == "failpoint":
@@ -158,6 +166,9 @@ class StormEvent:
                     f"@{self.at_ms:g}+{self.dur_ms:g}ms")
         if self.kind == "hostile_layer":
             return (f"hostile_layer({self.variant})"
+                    f"@{self.at_ms:g}+{self.dur_ms:g}ms")
+        if self.kind == "host_loss":
+            return (f"host_loss(host={self.host})"
                     f"@{self.at_ms:g}+{self.dur_ms:g}ms")
         return f"{self.kind}[{self.replica}]@{self.at_ms:g}ms"
 
@@ -184,7 +195,8 @@ class Schedule:
 def generate_schedule(seed: int, topology: str, n_events: int = 4,
                       horizon_ms: float = 1500.0, mesh_devices: int = 4,
                       replicas: int = 3,
-                      watchdog_ms: float = 50.0) -> Schedule:
+                      watchdog_ms: float = 50.0,
+                      mesh_hosts: int = 2) -> Schedule:
     """Sample one fault timeline from `seed`. Deterministic: the same
     (seed, topology, knobs) always yields a JSON-identical schedule.
     Windows overlap by construction (starts land in the first 60% of
@@ -197,6 +209,7 @@ def generate_schedule(seed: int, topology: str, n_events: int = 4,
     kinds = ["failpoint"] * 3 + ["swap_table"]
     if topology == "mesh":
         menu += list(_MESH_FAULTS) * 2     # mesh domains get airtime
+        kinds += ["host_loss"]             # whole-host fault domains
     if topology == "fleet":
         menu += list(_FLEET_FAULTS) + list(_MEMO_FAULTS)
         kinds += ["kill_replica"] * 2 + ["db_swap"]
@@ -219,6 +232,17 @@ def generate_schedule(seed: int, topology: str, n_events: int = 4,
                 dur_ms=round(dur, 1),
                 variant=HOSTILE_VARIANTS[
                     rng.randrange(len(HOSTILE_VARIANTS))]))
+            continue
+        if kind == "host_loss":
+            # hang mode on every slot of the host: a watchdog trip is
+            # the deterministic loss signal (error mode would need
+            # fail_threshold repeats per device)
+            events.append(StormEvent(
+                at_ms=round(at, 1), kind="host_loss", mode="hang",
+                arg=round(rng.uniform(watchdog_ms * 2.2,
+                                      watchdog_ms * 4.0), 1),
+                dur_ms=round(dur, 1),
+                host=rng.randrange(max(mesh_hosts, 1))))
             continue
         if kind == "kill_replica":
             events.append(StormEvent(
@@ -336,6 +360,7 @@ class StormOptions:
     replicas: int = 3           # fleet width
     mesh_devices: int = 4
     mesh_db_shards: int = 2
+    mesh_hosts: int = 2         # synthetic host fault domains (mesh)
     watchdog_ms: float = 50.0   # graftguard dispatch deadline
     breaker_reset_ms: float = 150.0
     admit_max_active: int = 0   # 0 = unbounded (no admission sheds)
@@ -484,6 +509,10 @@ class _Topology:
             self.kill(ev.replica)
         elif ev.kind == "hostile_layer":
             self.push_hostile(ev.variant)
+        elif ev.kind == "host_loss":
+            for site in self.host_sites(ev.host):
+                FAILPOINTS.set(site, ev.mode or "hang",
+                               ev.arg, seed=ev.seed)
 
     def revert(self, ev: StormEvent) -> None:
         """Disarm one event at the end of its window."""
@@ -495,6 +524,14 @@ class _Topology:
             self.restart(ev.replica)
         elif ev.kind == "hostile_layer":
             self.pop_hostile(ev.variant)
+        elif ev.kind == "host_loss":
+            for site in self.host_sites(ev.host):
+                FAILPOINTS.clear(site)
+
+    def host_sites(self, host: int) -> list[str]:
+        """→ the `detect.mesh:<id>` sites of every device on synthetic
+        host `host` ([] outside the mesh topology — the event drops)."""
+        return []
 
     def push_hostile(self, variant: str) -> None:
         pass
@@ -579,7 +616,13 @@ class MeshTopology(SingleTopology):
             # the per-device watch deadline: a schedule's mesh hang
             # (arg > 2× watchdog_ms by construction) must TRIP the
             # domain, not read as mere slowness
-            probe_timeout_ms=opts.watchdog_ms))
+            probe_timeout_ms=opts.watchdog_ms,
+            # synthetic host fault domains: devices split into
+            # contiguous host blocks so host_loss events can kill a
+            # whole host's worth of domains at once, with a window
+            # short enough that the ONE debounced rebuild lands
+            # inside the schedule horizon
+            hosts=opts.mesh_hosts, host_loss_window_ms=100.0))
         # fast readmission so the liveness invariant settles in-window
         self.state.mesh_guard.opts.probe_interval_ms = 20.0
         self.state.mesh_guard.registry.reset_timeout_s = \
@@ -592,6 +635,16 @@ class MeshTopology(SingleTopology):
             from .meshguard import mesh_site
             return mesh_site(ids[slot % len(ids)])
         return site
+
+    def host_sites(self, host: int) -> list[str]:
+        """Slots sharing synthetic host `host` (the contiguous-block
+        rule of parallel.multihost.host_assignments), mapped to their
+        runtime device sites."""
+        n = max(self.opts.mesh_devices, 1)
+        hosts = max(self.opts.mesh_hosts, 1)
+        return [self.resolve_site(f"detect.mesh:{slot}")
+                for slot in range(n)
+                if slot * hosts // n == host % hosts]
 
     def settled(self) -> list[str]:
         problems = super().settled()
@@ -1123,7 +1176,8 @@ class _ScheduleDriver(threading.Thread):
         actions: list[tuple[float, int, StormEvent, str]] = []
         for n, ev in enumerate(schedule.events):
             actions.append((ev.at_ms, n, ev, "apply"))
-            if ev.kind in ("kill_replica", "hostile_layer") or (
+            if ev.kind in ("kill_replica", "hostile_layer",
+                           "host_loss") or (
                     ev.kind == "failpoint" and ev.dur_ms > 0):
                 end = ev.at_ms + (ev.dur_ms or schedule.horizon_ms)
                 actions.append((end, n, ev, "revert"))
@@ -1529,6 +1583,7 @@ def write_replay(path: str, schedule: Schedule, opts: StormOptions,
             "breaker_reset_ms": opts.breaker_reset_ms,
             "replicas": opts.replicas,
             "mesh_devices": opts.mesh_devices,
+            "mesh_hosts": opts.mesh_hosts,
         },
         "violations": report.violations,
         "minimized": minimized,
@@ -1559,7 +1614,8 @@ def load_replay(path: str) -> tuple[Schedule, StormOptions]:
         watchdog_ms=float(load.get("watchdog_ms", 50.0)),
         breaker_reset_ms=float(load.get("breaker_reset_ms", 150.0)),
         replicas=int(load.get("replicas", 3)),
-        mesh_devices=int(load.get("mesh_devices", 4)))
+        mesh_devices=int(load.get("mesh_devices", 4)),
+        mesh_hosts=int(load.get("mesh_hosts", 2)))
     return schedule, opts
 
 
@@ -1585,6 +1641,10 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--mesh-devices", type=int, default=4)
+    ap.add_argument("--mesh-hosts", type=int, default=2,
+                    help="synthetic host fault domains on the mesh "
+                         "topology (host_loss events kill one host's "
+                         "worth of device domains at once)")
     ap.add_argument("--admit-max-active", type=int, default=0)
     ap.add_argument("--artifact-dir", default="",
                     help="where failing-schedule replay artifacts and "
@@ -1622,6 +1682,7 @@ def main(argv=None) -> int:
     opts = StormOptions(
         requests=args.requests, concurrency=args.concurrency,
         replicas=args.replicas, mesh_devices=args.mesh_devices,
+        mesh_hosts=args.mesh_hosts,
         admit_max_active=args.admit_max_active,
         artifact_dir=args.artifact_dir)
     for r in range(args.rounds):
@@ -1629,7 +1690,8 @@ def main(argv=None) -> int:
         schedule = generate_schedule(
             seed, args.topology, n_events=args.events,
             mesh_devices=args.mesh_devices, replicas=args.replicas,
-            watchdog_ms=opts.watchdog_ms)
+            watchdog_ms=opts.watchdog_ms,
+            mesh_hosts=args.mesh_hosts)
         report = run_storm(schedule, opts, table=table)
         print(json.dumps(report.summary()))
         if report.ok:

@@ -46,12 +46,28 @@ _log = _get_logger("server")
 class MeshOptions:
     """Mesh-mode knobs (server flags --mesh-devices, --mesh-db-shards,
     --mesh-min-devices, --mesh-rebuild-cooldown-ms,
-    --mesh-probe-timeout-ms). devices=0 keeps the single-chip path."""
+    --mesh-probe-timeout-ms, --mesh-hosts,
+    --mesh-host-loss-window-ms, --table-device-budget-mb,
+    --table-stream-slices). devices=0 keeps the single-chip path;
+    the table-streaming knobs apply there too."""
     devices: int = 0          # mesh size; 0 = single-chip detect path
     db_shards: int = 1        # preferred db width (shrink re-fits it)
     min_devices: int = 1      # survivors below this → host join
     rebuild_cooldown_ms: float = 1000.0
     probe_timeout_ms: float = 5000.0
+    # host fault domains: 0 = real per-device process_index (multi-
+    # host jobs); > 1 = synthetic contiguous blocks for drills on a
+    # single-process platform. Domains only engage when the mapping
+    # actually spans ≥ 2 hosts — a single-host mesh keeps the plain
+    # per-chip behavior.
+    hosts: int = 0
+    host_loss_window_ms: float = 250.0
+    # graftstream: stream the advisory table through a double-buffered
+    # resident slice pair once its per-device footprint exceeds the
+    # budget (0 = auto off the graftprof hbm_bytes view; slices > 0
+    # forces a slice count)
+    table_device_budget_mb: float = 0.0
+    table_stream_slices: int = 0
 
 
 class ServerState:
@@ -111,10 +127,23 @@ class ServerState:
         self._mesh = None
         self._mesh_devices = []
         self._mesh_db_shards = 1
+        # graftstream: when mesh_opts carries streaming knobs (or just
+        # defaults — the auto budget comes off graftprof's hbm view),
+        # every detector this state builds may stream the advisory
+        # table through a double-buffered resident slice pair instead
+        # of holding it device-whole. plan_slices() decides per table;
+        # a table within budget keeps the resident path unchanged.
+        self.stream_opts = None
+        if mesh_opts is not None:
+            from ..parallel.stream import StreamOptions
+            self.stream_opts = StreamOptions(
+                device_budget_mb=mesh_opts.table_device_budget_mb,
+                slices=mesh_opts.table_stream_slices)
         if mesh_opts is not None and mesh_opts.devices:
             import jax
 
             from ..parallel.mesh import mesh_from_devices
+            from ..parallel.multihost import host_assignments
             from ..resilience import MeshGuard, MeshGuardOptions
             n = mesh_opts.devices
             devs = jax.devices()
@@ -122,18 +151,27 @@ class ServerState:
             self._mesh_db_shards = mesh_opts.db_shards
             self._mesh = mesh_from_devices(self._mesh_devices,
                                            mesh_opts.db_shards)
+            # host fault domains engage only when the mapping spans
+            # ≥ 2 hosts — a single-host mesh must keep the prompt
+            # per-chip shrink (no host-loss hold on every loss)
+            host_of = host_assignments(self._mesh_devices,
+                                       synthetic_hosts=mesh_opts.hosts)
+            if len(set(host_of.values())) < 2:
+                host_of = None
             self.mesh_guard = MeshGuard(
                 [int(d.id) for d in self._mesh_devices],
                 MeshGuardOptions(
                     min_devices=mesh_opts.min_devices,
                     rebuild_cooldown_ms=mesh_opts.rebuild_cooldown_ms,
-                    probe_timeout_ms=mesh_opts.probe_timeout_ms),
-                probe=self._mesh_probe)
+                    probe_timeout_ms=mesh_opts.probe_timeout_ms,
+                    host_loss_window_ms=mesh_opts.host_loss_window_ms),
+                probe=self._mesh_probe, host_of=host_of)
         self._scanner = LocalScanner(self.cache, table,
                                      sched=self.detect_opts,
                                      mesh=self._mesh,
                                      mesh_guard=self.mesh_guard,
-                                     memo=self.memo)
+                                     memo=self.memo,
+                                     stream=self.stream_opts)
         # redetectd: on a DB hot swap, sweep the memo's known blobs
         # through the pure detect path in the background so fresh
         # entries exist under the new db_version before users rescan
@@ -325,7 +363,8 @@ class ServerState:
                                        sched=self.detect_opts,
                                        mesh=build_mesh,
                                        mesh_guard=self.mesh_guard,
-                                       memo=self.memo)
+                                       memo=self.memo,
+                                       stream=self.stream_opts)
             # digest outside the lock too (first computation walks the
             # whole table); cached on the table object afterwards
             new_version = build_table.content_digest()
@@ -566,6 +605,14 @@ class Handler(BaseHTTPRequestHandler):
                     # burn-rate gauges, so /healthz and /metrics agree)
                     "slo": SLO.export(),
                 }
+                # graftstream: slice plan + resident set when the
+                # serving detector streams its advisory table (the
+                # single-chip StreamingDetector exposes status();
+                # resident detectors have nothing to report)
+                stream_status = getattr(
+                    self.state.scanner.detector, "status", None)
+                if callable(stream_status):
+                    payload["stream"] = stream_status()
                 # graftmemo: backend + known-blob count, and the
                 # redetectd sweep's progress (phase, done/total,
                 # target db_version)
