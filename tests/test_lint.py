@@ -191,8 +191,11 @@ def test_lock_hygiene_detected_including_alias():
     fs = _lint("trivy_tpu/server/fixture.py", src)
     assert [(f.rule, f.line) for f in fs] == [("TPU106", 7),
                                              ("TPU106", 10)]
-    # out of the scoped modules: same class, no finding
-    assert _lint("trivy_tpu/iac/fixture.py", src) == []
+    # v2: the whole tree is in scope — the same class is checked
+    # anywhere it lives (the _LOCK_SCOPE path list is gone)
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/iac/fixture.py", src)] == [("TPU106", 7),
+                                                        ("TPU106", 10)]
 
 
 def test_lock_hygiene_catches_value_position_mutators():
@@ -276,8 +279,10 @@ def test_sched_is_in_lock_hygiene_scope():
     )
     fs = _lint("trivy_tpu/detect/sched.py", src)
     assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
-    # outside the scoped modules the same class is not checked
-    assert _lint("trivy_tpu/report/fixture.py", src) == []
+    # v2: whole-tree scope — the same class is checked anywhere
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/report/fixture.py", src)] \
+        == [("TPU106", 7)]
 
 
 def test_sched_no_clocks_in_device_code():
@@ -383,8 +388,10 @@ def test_parallel_rebuild_code_in_lock_hygiene_scope():
     )
     fs = _lint("trivy_tpu/parallel/mesh.py", src)
     assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
-    # outside the scoped modules the same class is not checked
-    assert _lint("trivy_tpu/report/fixture.py", src) == []
+    # v2: whole-tree scope — the same class is checked anywhere
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/report/fixture.py", src)] \
+        == [("TPU106", 7)]
 
 
 def test_shard_map_body_is_device_code_for_tpu108():
@@ -442,8 +449,10 @@ def test_fleet_in_lock_hygiene_scope():
     )
     fs = _lint("trivy_tpu/fleet/ring.py", src)
     assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
-    # outside the scoped modules the same class is not checked
-    assert _lint("trivy_tpu/report/fixture.py", src) == []
+    # v2: whole-tree scope — the same class is checked anywhere
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/report/fixture.py", src)] \
+        == [("TPU106", 7)]
 
 
 def test_fleet_clock_in_device_code_detected():
@@ -496,8 +505,10 @@ def test_resilience_registry_in_lock_hygiene_scope():
     )
     fs = _lint("trivy_tpu/resilience/failpoints.py", src)
     assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
-    # outside the scoped modules the same class is not checked
-    assert _lint("trivy_tpu/report/fixture.py", src) == []
+    # v2: whole-tree scope — the same class is checked anywhere
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/report/fixture.py", src)] \
+        == [("TPU106", 7)]
 
 
 def test_regex_match_span_is_not_a_trace_span():
@@ -1003,9 +1014,10 @@ def test_fanald_pipeline_in_lock_hygiene_scope():
     )
     fs = _lint("trivy_tpu/fanal/pipeline.py", src)
     assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
-    # the rest of fanal/ stays out of the lock-hygiene scope (the
-    # serial walker and analyzers are single-threaded per call)
-    assert _lint("trivy_tpu/fanal/walker.py", src) == []
+    # v2: the rest of fanal/ is checked too — whole-tree scope
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/fanal/walker.py", src)] \
+        == [("TPU106", 7)]
 
 
 def test_fanald_no_clocks_in_device_code():
@@ -1089,7 +1101,7 @@ def test_redetectd_in_lock_hygiene_scope():
     """Satellite (PR 11): detect/redetect.py — the sweep daemon's
     status/thread handoff is shared between handler threads
     (swap_table → schedule), the sweep thread, and the drain path —
-    is in TPU106 scope; unscoped modules stay out."""
+    is in TPU106 scope (v2: like everything else)."""
     src = (
         "import threading\n"
         "class Daemon:\n"
@@ -1104,7 +1116,10 @@ def test_redetectd_in_lock_hygiene_scope():
     )
     fs = _lint("trivy_tpu/detect/redetect.py", src)
     assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
-    assert _lint("trivy_tpu/report/fixture.py", src) == []
+    # v2: whole-tree scope — the same class is checked anywhere
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/report/fixture.py", src)] \
+        == [("TPU106", 7)]
 
 
 def test_memo_failpoint_sites_in_catalog():
@@ -1139,7 +1154,10 @@ def test_obs_perf_in_lock_hygiene_scope():
     )
     fs = _lint("trivy_tpu/obs/perf.py", src)
     assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
-    assert _lint("trivy_tpu/report/fixture.py", src) == []
+    # v2: whole-tree scope — the same class is checked anywhere
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/report/fixture.py", src)] \
+        == [("TPU106", 7)]
 
 
 def test_obs_perf_no_clocks_or_metrics_in_device_code():
@@ -1200,3 +1218,539 @@ def test_device_series_in_catalog():
         assert name in cat, name
         assert cat[name].kind == kind
         assert cat[name].help
+
+
+# ---------------------------------------------------------------------------
+# graftlint v2: concurrency engine (TPU110-113), planted fixtures
+
+
+def _conc_tree(tmp_path, files):
+    """Write a fixture package and run the concurrency engine over it.
+    No lockgraph gate: a fixture tree has no checked-in artifact."""
+    from trivy_tpu.analysis import concurrency
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(exist_ok=True)
+    for name, src in files.items():
+        p = pkg / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return concurrency.run(root=str(pkg))
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    """Two methods acquiring the same two locks in opposite order is a
+    real deadlock: TPU110 names the cycle and both acquisition sites."""
+    src = (
+        "import threading\n"
+        "\n"
+        "class Pair:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "\n"
+        "    def forward(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                return 1\n"
+        "\n"
+        "    def backward(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                return 2\n"
+    )
+    fs = _conc_tree(tmp_path, {"pair.py": src})
+    cyc = [f for f in fs if f.rule == "TPU110"
+           and "lock-order cycle" in f.message]
+    assert len(cyc) == 1, "\n".join(f.render() for f in fs)
+    assert "Pair._a" in cyc[0].message and "Pair._b" in cyc[0].message
+    assert "forward" in cyc[0].message and "backward" in cyc[0].message
+
+
+def test_double_acquire_detected(tmp_path):
+    """Re-entering a non-reentrant Lock self-deadlocks: both the
+    direct nested `with` and the one-level interprocedural case
+    (method under the lock calls a self-method that takes it again).
+    The RLock twin of the interprocedural case is legal and clean."""
+    direct = (
+        "import threading\n"
+        "MU = threading.Lock()\n"
+        "\n"
+        "def grab():\n"
+        "    with MU:\n"
+        "        with MU:\n"
+        "            return 1\n"
+    )
+    inter = (
+        "import threading\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "\n"
+        "    def put(self):\n"
+        "        with self._mu:\n"
+        "            self._flush()\n"
+        "\n"
+        "    def _flush(self):\n"
+        "        with self._mu:\n"
+        "            pass\n"
+    )
+    fs = _conc_tree(tmp_path, {"direct.py": direct, "inter.py": inter})
+    got = sorted((os.path.basename(f.path), f.line) for f in fs
+                 if f.rule == "TPU110")
+    assert got == [("direct.py", 6), ("inter.py", 9)], \
+        "\n".join(f.render() for f in fs)
+    assert any("interprocedural self-deadlock" in f.message for f in fs)
+    fs_rlock = _conc_tree(tmp_path, {
+        "direct.py": "X = 1\n",
+        "inter.py": inter.replace("threading.Lock()",
+                                  "threading.RLock()")})
+    assert fs_rlock == [], "\n".join(f.render() for f in fs_rlock)
+
+
+def test_blocking_under_lock_detected(tmp_path):
+    """TPU111: a sleep under a held lock directly, and blocking work
+    one self-call away (reported at the call site, where the lock is
+    actually held)."""
+    src = (
+        "import threading\n"
+        "import time\n"
+        "import urllib.request\n"
+        "\n"
+        "class Slow:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "\n"
+        "    def nap(self):\n"
+        "        with self._mu:\n"
+        "            time.sleep(0.1)\n"
+        "\n"
+        "    def fetch(self):\n"
+        "        with self._mu:\n"
+        "            self._pull()\n"
+        "\n"
+        "    def _pull(self):\n"
+        "        urllib.request.urlopen('http://db')\n"
+    )
+    fs = _conc_tree(tmp_path, {"slow.py": src})
+    got = sorted((f.rule, f.line) for f in fs)
+    assert got == [("TPU111", 11), ("TPU111", 15)], \
+        "\n".join(f.render() for f in fs)
+    assert any("time.sleep" in f.message for f in fs)
+    assert any("self._pull()" in f.message and "HTTP request" in f.message
+               for f in fs)
+
+
+def test_blocking_waiver_suppresses_in_place(tmp_path):
+    """A reasoned `# lint: allow(TPU111)` pragma on the blocking line
+    waives it; the concurrency engine emits no TPU116 hygiene noise of
+    its own (that stays with the AST engine, once per pragma)."""
+    src = (
+        "import threading\n"
+        "import time\n"
+        "\n"
+        "class Slow:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "\n"
+        "    def nap(self):\n"
+        "        with self._mu:\n"
+        "            # lint: allow(TPU111) reason=bounded 100ms backoff\n"
+        "            time.sleep(0.1)\n"
+    )
+    assert _conc_tree(tmp_path, {"slow.py": src}) == []
+
+
+def test_condvar_hygiene_detected(tmp_path):
+    """TPU113: a bare cv.wait() outside a while-predicate loop, and a
+    notify() without holding the owning lock; the canonical
+    while-loop wait stays clean (Condition.wait releasing its own
+    lock is not 'blocking under a lock')."""
+    src = (
+        "import threading\n"
+        "\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self._items = []\n"
+        "\n"
+        "    def bad_wait(self):\n"
+        "        with self._cv:\n"
+        "            if not self._items:\n"
+        "                self._cv.wait()\n"
+        "            return self._items.pop()\n"
+        "\n"
+        "    def good_wait(self):\n"
+        "        with self._cv:\n"
+        "            while not self._items:\n"
+        "                self._cv.wait()\n"
+        "            return self._items.pop()\n"
+        "\n"
+        "    def bad_notify(self, item):\n"
+        "        self._items.append(item)\n"
+        "        self._cv.notify()\n"
+    )
+    fs = _conc_tree(tmp_path, {"q.py": src})
+    got = sorted((f.rule, f.line) for f in fs)
+    assert got == [("TPU113", 11), ("TPU113", 22)], \
+        "\n".join(f.render() for f in fs)
+
+
+def test_leaked_executor_and_thread_detected(tmp_path):
+    """TPU112 class leg: an owned executor with no shutdown() and an
+    owned thread with no join() reachable from any close/stop/drain
+    path; the same class with a real close() is clean."""
+    leaky = (
+        "import threading\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "\n"
+        "class Leaky:\n"
+        "    def __init__(self):\n"
+        "        self._pool = ThreadPoolExecutor(max_workers=2)\n"
+        "        self._worker = threading.Thread(target=self._run)\n"
+        "        self._worker.start()\n"
+        "\n"
+        "    def _run(self):\n"
+        "        pass\n"
+        "\n"
+        "    def close(self):\n"
+        "        pass\n"
+    )
+    fs = _conc_tree(tmp_path, {"leaky.py": leaky})
+    got = sorted((f.rule, f.line) for f in fs)
+    assert got == [("TPU112", 6), ("TPU112", 7)], \
+        "\n".join(f.render() for f in fs)
+    assert any("no shutdown() reachable" in f.message for f in fs)
+    assert any("no join() reachable" in f.message for f in fs)
+    fixed = leaky.replace(
+        "    def close(self):\n        pass\n",
+        "    def close(self):\n"
+        "        self._pool.shutdown()\n"
+        "        self._worker.join()\n")
+    assert _conc_tree(tmp_path, {"leaky.py": fixed}) == []
+
+
+def test_local_and_fire_and_forget_thread_leaks(tmp_path):
+    """TPU112 local leg: a local thread that is neither joined nor
+    escapes the function, and the bare `Thread(...).start()`
+    fire-and-forget form; handing the thread out (return/arg/attr)
+    is an escape, not a leak."""
+    src = (
+        "import threading\n"
+        "\n"
+        "def leak(job):\n"
+        "    t = threading.Thread(target=job)\n"
+        "    t.start()\n"
+        "\n"
+        "def fire(job):\n"
+        "    threading.Thread(target=job).start()\n"
+        "\n"
+        "def handed(job, sink):\n"
+        "    t = threading.Thread(target=job)\n"
+        "    t.start()\n"
+        "    sink.append(t)\n"
+        "\n"
+        "def joined(job):\n"
+        "    t = threading.Thread(target=job)\n"
+        "    t.start()\n"
+        "    t.join()\n"
+    )
+    fs = _conc_tree(tmp_path, {"spawn.py": src})
+    got = sorted((f.rule, f.line) for f in fs)
+    assert got == [("TPU112", 4), ("TPU112", 8)], \
+        "\n".join(f.render() for f in fs)
+    assert any("fire-and-forget" in f.message for f in fs)
+
+
+def test_listener_without_remove_detected(tmp_path):
+    """TPU112 listener leg: registering a bound method on an external
+    object with no remove counterpart on the close path leaks the
+    subscriber (meshguard/recovery-listener shape); the symmetric
+    register/remove pair is clean."""
+    leaky = (
+        "class Sub:\n"
+        "    def __init__(self, bus):\n"
+        "        self._bus = bus\n"
+        "        bus.on_status(self._tick)\n"
+        "\n"
+        "    def _tick(self, ev):\n"
+        "        pass\n"
+        "\n"
+        "    def close(self):\n"
+        "        pass\n"
+    )
+    fs = _conc_tree(tmp_path, {"sub.py": leaky})
+    got = [(f.rule, f.line) for f in fs]
+    assert got == [("TPU112", 4)], "\n".join(f.render() for f in fs)
+    assert "remove_status()" in fs[0].message
+    fixed = leaky.replace(
+        "    def close(self):\n        pass\n",
+        "    def close(self):\n"
+        "        self._bus.remove_status(self._tick)\n")
+    assert _conc_tree(tmp_path, {"sub.py": fixed}) == []
+
+
+def test_lockgraph_staleness_gate(tmp_path):
+    """The checked-in lockgraph artifact is a golden: missing →
+    finding, current → clean, edge set changed → stale finding until
+    --update-lockgraph rewrites it."""
+    from trivy_tpu.analysis import concurrency
+    src = (
+        "import threading\n"
+        "\n"
+        "class Ordered:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "\n"
+        "    def step(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                return 1\n"
+    )
+    pkg = tmp_path / "gpkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(src)
+    art = tmp_path / "lockgraph.json"
+
+    fs = concurrency.run(root=str(pkg), lockgraph_path=str(art))
+    assert [f.rule for f in fs] == ["TPU110"]
+    assert "missing" in fs[0].message
+
+    concurrency.update_lockgraph(root=str(pkg), path=str(art))
+    graph = json.loads(art.read_text())
+    assert graph["schema"] == "trivy-tpu-lockgraph/1"
+    assert len(graph["edges"]) == 1
+    assert graph["edges"][0]["held"].endswith("Ordered._a")
+    assert graph["edges"][0]["acquires"].endswith("Ordered._b")
+    assert concurrency.run(root=str(pkg),
+                           lockgraph_path=str(art)) == []
+
+    (pkg / "mod.py").write_text(src.replace(
+        "        self._b = threading.Lock()\n",
+        "        self._b = threading.Lock()\n"
+        "        self._c = threading.Lock()\n") + (
+        "\n"
+        "    def hop(self):\n"
+        "        with self._b:\n"
+        "            with self._c:\n"
+        "                return 2\n"))
+    fs = concurrency.run(root=str(pkg), lockgraph_path=str(art))
+    assert [f.rule for f in fs] == ["TPU110"]
+    assert "stale" in fs[0].message
+
+
+def test_tree_lockgraph_artifact_exists():
+    """The real artifact is checked in next to the engine (its
+    currency against the tree is asserted by test_tree_is_clean)."""
+    from trivy_tpu.analysis import concurrency
+    with open(concurrency.LOCKGRAPH_PATH) as f:
+        graph = json.load(f)
+    assert graph["schema"] == "trivy-tpu-lockgraph/1"
+    assert len(graph["locks"]) >= 20
+
+
+def test_lock_scope_allowlist_is_gone():
+    """v2 acceptance: the v1 `_LOCK_SCOPE` module allowlist is deleted
+    — every rule runs whole-tree, intent is expressed by pragma."""
+    assert not hasattr(astlint, "_LOCK_SCOPE")
+
+
+# ---------------------------------------------------------------------------
+# waiver grammar (TPU116)
+
+
+def test_waiver_with_reason_suppresses():
+    """A reasoned pragma on (or directly above) the flagged line
+    suppresses exactly the named rules, nothing else."""
+    src = (
+        "import threading\n"
+        "\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cache = {}\n"
+        "\n"
+        "    def put(self, k, v):\n"
+        "        # lint: allow(TPU106) reason=rebuilt under query lock\n"
+        "        self._cache[k] = v\n"
+    )
+    assert _lint("trivy_tpu/iac/fixture.py", src) == []
+
+
+def test_waiver_without_reason_is_hygiene_finding():
+    """A reason-less pragma suppresses NOTHING and is itself flagged
+    (TPU116): silent waivers are how allowlists rot."""
+    src = (
+        "import threading\n"
+        "\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cache = {}\n"
+        "\n"
+        "    def put(self, k, v):\n"
+        "        # lint: allow(TPU106)\n"
+        "        self._cache[k] = v\n"
+    )
+    fs = _lint("trivy_tpu/iac/fixture.py", src)
+    got = sorted((f.rule, f.line) for f in fs)
+    assert got == [("TPU106", 10), ("TPU116", 9)], \
+        "\n".join(f.render() for f in fs)
+    assert "reason=" in [f for f in fs if f.rule == "TPU116"][0].message
+
+
+# ---------------------------------------------------------------------------
+# cross-checks: contract coverage (TPU114) + failpoint catalog (TPU115)
+
+
+def test_jit_entry_discovery_forms():
+    """TPU114's discovery sees all three jit-entry spellings:
+    decorator, partial-decorator, and assignment."""
+    from trivy_tpu.analysis import contract_coverage as cc
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def fused_scan(x):\n"
+        "    return x\n"
+        "\n"
+        "@functools.partial(jax.jit, static_argnames=('n',))\n"
+        "def windowed(x, n=1):\n"
+        "    return x\n"
+        "\n"
+        "def _core(x):\n"
+        "    return x\n"
+        "\n"
+        "packed = jax.jit(_core)\n"
+    )
+    got = cc.jit_entries("trivy_tpu/ops/fix.py", src)
+    assert got == [("fused_scan", 5), ("windowed", 9), ("packed", 15)]
+
+
+def test_contract_coverage_seed_violation(monkeypatch):
+    """With the contract set emptied, every real kernel entry under
+    ops/ and parallel/ is flagged — the mesh-static entries stay
+    quiet because their waivers are in the source, not the contracts."""
+    from trivy_tpu.analysis import contract_coverage as cc
+    assert cc.check_contract_coverage() == []
+    monkeypatch.setattr(cc, "load_contracts", lambda: [])
+    fs = cc.check_contract_coverage()
+    assert fs, "emptied contract set must un-cover the kernel entries"
+    assert all(f.rule == "TPU114" for f in fs)
+    specs = {f.context for f in fs}
+    assert any(s.startswith("trivy_tpu.ops.") for s in specs)
+    assert "trivy_tpu.ops.ac:shiftor_scan" in specs
+
+
+def test_failpoint_probe_discovery_forms():
+    """TPU115's probe scan sees failpoint()/._failpoint()/
+    FAILPOINTS.fire()/GUARD.watch(), resolves module-level string
+    constants, and skips dynamic sites (validated at arm time)."""
+    from trivy_tpu.analysis import failpoint_catalog as fc
+    src = (
+        'WALK_SITE = "fanal.walk"\n'
+        "\n"
+        "class H:\n"
+        "    def scan(self, site):\n"
+        '        failpoint("detect.dispatch")\n'
+        '        self._failpoint("rpc.scan")\n'
+        "        FAILPOINTS.fire(WALK_SITE)\n"
+        '        _GUARD.watch("detect.mesh:0")\n'
+        "        failpoint(site)\n"
+    )
+    got = fc.probe_sites("x.py", src)
+    assert got == [("detect.dispatch", 5), ("rpc.scan", 6),
+                   ("fanal.walk", 7), ("detect.mesh:0", 8)]
+    menu = fc.storm_menu_entries(
+        '_X_FAULTS = (("rpc.scan", "error"), ("detect.mesh", "hang"))\n')
+    assert menu == [("rpc.scan", "error", 1), ("detect.mesh", "hang", 1)]
+
+
+def test_failpoint_catalog_seed_violation(monkeypatch):
+    """Shrinking the catalog makes the real tree's rpc.scan probe an
+    unknown site, and a grafted-in entry nobody probes is flagged as
+    dead — both ends of the closed-catalog invariant."""
+    from trivy_tpu.analysis import failpoint_catalog as fc
+    from trivy_tpu.resilience import failpoints
+    assert fc.check_failpoint_catalog() == []
+    trimmed = tuple(s for s in failpoints.SITES
+                    if s != "rpc.scan") + ("zombie.site",)
+    monkeypatch.setattr(failpoints, "SITES", trimmed)
+    fs = fc.check_failpoint_catalog()
+    assert all(f.rule == "TPU115" for f in fs)
+    assert any("rpc.scan" in f.message and "not in the failpoint"
+               in f.message for f in fs), \
+        "\n".join(f.render() for f in fs)
+    assert any("zombie.site" in f.message and "dead entry" in f.message
+               for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# CLI: SARIF output + generated rule reference
+
+
+def test_sarif_output(tmp_path):
+    """--sarif writes a SARIF 2.1.0 doc: rule metadata from the
+    registry, one result per finding with a stable partialFingerprint
+    (CI annotation format; exit code still reflects the findings)."""
+    src = (
+        "import threading\n"
+        "import time\n"
+        "\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "\n"
+        "    def nap(self):\n"
+        "        with self._mu:\n"
+        "            time.sleep(1)\n"
+    )
+    pkg = tmp_path / "spkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(src)
+    out = tmp_path / "out.sarif"
+    assert cli_main(["--root", str(pkg), "--sarif", str(out),
+                     "--json"]) == 1
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    results = run["results"]
+    assert [r["ruleId"] for r in results] == ["TPU111"]
+    assert results[0]["locations"][0]["physicalLocation"][
+        "region"]["startLine"] == 10
+    assert results[0]["partialFingerprints"]["graftlint/v1"]
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == ["TPU111"]
+
+
+def test_rules_reference_in_architecture_is_current():
+    """The ARCHITECTURE.md rule-reference table is GENERATED from the
+    registry (--update-docs): drift fails tier-1, exactly like the
+    metrics table."""
+    from trivy_tpu.analysis import registry
+    with open(os.path.join(REPO, "ARCHITECTURE.md")) as f:
+        doc = f.read()
+    assert registry.RULES_DOC_BEGIN in doc
+    assert registry.RULES_DOC_END in doc
+    block = doc.split(registry.RULES_DOC_BEGIN)[1]
+    block = block.split(registry.RULES_DOC_END)[0]
+    assert block.strip("\n") == \
+        registry.render_rules_markdown().strip("\n")
+    for rid in ("TPU110", "TPU111", "TPU112", "TPU113",
+                "TPU114", "TPU115", "TPU116"):
+        assert f"`{rid}`" in block, rid
+
+
+def test_full_tree_pass_wall_clock_budget():
+    """The source-level engines (AST + concurrency, whole tree) must
+    stay cheap enough to run on every tier-1 invocation — the v2
+    interprocedural pass cannot cost what the jaxpr traces cost."""
+    import time
+    from trivy_tpu.analysis import concurrency
+    t0 = time.monotonic()
+    astlint.run(None)
+    concurrency.run(None)
+    assert time.monotonic() - t0 < 30.0

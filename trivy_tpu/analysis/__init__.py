@@ -20,16 +20,30 @@ engines plus a cross-checker:
   host callbacks, and a primitive-count budget so an accidental O(K)
   unroll regresses loudly.
 
-* **Cross-checker** (`crosscheck.py`): builds a fixture advisory table
-  and verifies the columnar schema produced by `db/table.py` against
-  the gathers `ops/join.py` performs, both sides pinned to the shared
-  constants in `trivy_tpu/ops/constants.py`.
+* **Engine 3 — concurrency** (`concurrency.py`, graftlint v2): builds
+  per-function summaries of locks acquired/held (through one level of
+  `self.method()` calls) over the WHOLE tree, assembles the global
+  held→acquired lock-order graph (checked-in as `lockgraph.json` with
+  a staleness gate), and flags deadlock cycles and double-acquires
+  (TPU110), blocking calls under a lock (TPU111), leaked threads/
+  executors/listeners (TPU112), and condition-variable misuse
+  (TPU113). Intentional violations are waived IN PLACE with
+  `# lint: allow(RULE) reason=...` pragmas (`waivers.py`) — the v1
+  `_LOCK_SCOPE` path list is gone.
+
+* **Cross-checkers** (`crosscheck.py`, `metrics_catalog.py`,
+  `contract_coverage.py`, `failpoint_catalog.py`): fixture-table
+  schema vs the `ops/join.py` gathers (XCHK301), the metrics catalog
+  vs call sites (TPU109), jitted-entry contract coverage (TPU114),
+  and failpoint probe strings vs the closed site catalog and storm
+  menus (TPU115).
 
 Run it as ``python -m trivy_tpu.analysis`` (exit 1 on findings,
-``--json`` for machine output, ``--baseline FILE`` to suppress known
-findings explicitly). `tests/test_lint.py` runs it in tier-1 and
-asserts the tree is clean. The rule registry is in `registry.py`; see
-ARCHITECTURE.md ("Static analysis") for how to add a rule.
+``--json`` / ``--sarif OUT`` for machine output, ``--baseline FILE``
+to suppress known findings explicitly). `tests/test_lint.py` runs it
+in tier-1 and asserts the tree is clean. The rule registry is in
+`registry.py`; see ARCHITECTURE.md ("Static analysis") for how to add
+a rule.
 """
 
 from __future__ import annotations
@@ -40,17 +54,24 @@ from .registry import Finding, RULES, rules_for_engine  # noqa: F401
 # would see an empty registry
 from . import astlint, crosscheck, jaxpr_check  # noqa: E402,F401
 from . import metrics_catalog  # noqa: E402,F401 — registers TPU109
+from . import concurrency  # noqa: E402,F401 — registers TPU110-113
+from . import waivers  # noqa: E402,F401 — registers TPU116
+from . import contract_coverage  # noqa: E402,F401 — registers TPU114
+from . import failpoint_catalog  # noqa: E402,F401 — registers TPU115
 
 
 def run_all(root: str | None = None) -> list[Finding]:
-    """Run graftlint. With no `root`, all three engines run over the
-    installed trivy_tpu tree. With an explicit `root`, only the AST
-    engine runs over that tree — the jaxpr contracts and the schema
-    cross-check are properties of the installed package, not of an
-    arbitrary directory, and tracing them would both cost seconds and
-    report findings from outside the requested root."""
+    """Run graftlint. With no `root`, every engine runs over the
+    installed trivy_tpu tree. With an explicit `root`, only the
+    source-level engines (AST + concurrency) run over that tree — the
+    jaxpr contracts and the cross-checks are properties of the
+    installed package, not of an arbitrary directory, and tracing
+    them would both cost seconds and report findings from outside the
+    requested root. (The lockgraph staleness gate likewise only
+    applies to the installed tree.)"""
     findings: list[Finding] = []
     findings += astlint.run(root)
+    findings += concurrency.run(root)
     if root is None:
         findings += jaxpr_check.run()
         findings += crosscheck.run()

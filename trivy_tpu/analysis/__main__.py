@@ -1,18 +1,95 @@
 """``python -m trivy_tpu.analysis`` — run graftlint.
 
 Exit codes: 0 clean (or every finding suppressed by the baseline),
-1 findings, 2 internal error. ``--json`` emits machine output for CI;
+1 findings, 2 internal error. ``--json`` emits machine output;
+``--sarif OUT.json`` writes SARIF 2.1.0 for CI annotation;
 ``--baseline FILE`` suppresses the fingerprints listed there (each
 with a mandatory reason — suppression is explicit, never silent);
 ``--update-goldens`` re-traces and rewrites the golden jaxpr
-snapshots; ``--list-rules`` prints the registry.
+snapshots; ``--update-lockgraph`` rewrites the checked-in lock-order
+graph artifact; ``--update-docs`` regenerates the generated blocks in
+ARCHITECTURE.md (metrics catalog + rule reference);
+``--list-rules`` prints the registry.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+
+def _sarif_doc(findings, suppressed_hits) -> dict:
+    """Minimal SARIF 2.1.0: one run, rule metadata from the registry,
+    one result per finding (line 0 → 1; SARIF regions are 1-based)."""
+    from .registry import RULES
+    seen_rules = sorted({f.rule for f in findings}
+                        | {f.rule for f in suppressed_hits})
+    rules = []
+    for rid in seen_rules:
+        r = RULES.get(rid)
+        rules.append({
+            "id": rid,
+            "shortDescription": {"text": r.name if r else rid},
+            "fullDescription": {"text": r.doc if r else ""},
+        })
+
+    def result(f, suppressed: bool) -> dict:
+        out = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": f.path.replace(os.sep, "/")},
+                "region": {"startLine": max(f.line, 1)},
+            }}],
+            "partialFingerprints": {"graftlint/v1": f.fingerprint()},
+        }
+        if suppressed:
+            out["suppressions"] = [{"kind": "external"}]
+        return out
+
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "graftlint",
+                                "informationUri":
+                                    "ARCHITECTURE.md#static-analysis",
+                                "rules": rules}},
+            "results": [result(f, False) for f in findings]
+            + [result(f, True) for f in suppressed_hits],
+        }],
+    }
+
+
+def _replace_block(doc: str, begin: str, end: str, body: str) -> str:
+    head, _, rest = doc.partition(begin)
+    _, _, tail = rest.partition(end)
+    return f"{head}{begin}\n{body}{end}{tail}"
+
+
+def update_docs() -> list[str]:
+    """Rewrite the generated blocks in ARCHITECTURE.md: the metrics
+    catalog table and the graftlint rule reference. → paths written."""
+    from . import metrics_catalog as mc
+    from .registry import (RULES_DOC_BEGIN, RULES_DOC_END,
+                           render_rules_markdown)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "ARCHITECTURE.md")
+    with open(path, encoding="utf-8") as f:
+        doc = f.read()
+    for begin, end, body in (
+            (mc.DOC_BEGIN, mc.DOC_END, mc.render_markdown()),
+            (RULES_DOC_BEGIN, RULES_DOC_END, render_rules_markdown())):
+        if begin not in doc or end not in doc:
+            raise SystemExit(f"marker {begin!r} not found in {path}")
+        doc = _replace_block(doc, begin, end, body)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(doc)
+    return [path]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -30,6 +107,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--update-goldens", action="store_true",
                     help="rewrite the golden jaxpr snapshots from the "
                          "current lowering")
+    ap.add_argument("--update-lockgraph", action="store_true",
+                    help="rewrite analysis/lockgraph.json from the "
+                         "current lock-order edge set")
+    ap.add_argument("--update-docs", action="store_true",
+                    help="regenerate the generated ARCHITECTURE.md "
+                         "blocks (metrics catalog + rule reference)")
+    ap.add_argument("--sarif", metavar="OUT",
+                    help="also write findings as SARIF 2.1.0 to OUT "
+                         "for CI annotation")
     ap.add_argument("--root", metavar="DIR", default=None,
                     help="run ONLY the AST engine over this tree "
                          "(default: all engines over the installed "
@@ -57,6 +143,16 @@ def main(argv: list[str] | None = None) -> int:
             print(f"wrote {path}")
         return 0
 
+    if args.update_lockgraph:
+        from .concurrency import update_lockgraph
+        print(f"wrote {update_lockgraph()}")
+        return 0
+
+    if args.update_docs:
+        for path in update_docs():
+            print(f"wrote {path}")
+        return 0
+
     findings = run_all(args.root)
     suppressed_hits = []
     if args.baseline:
@@ -66,6 +162,12 @@ def main(argv: list[str] | None = None) -> int:
             print(f"bad baseline {args.baseline}: {e}", file=sys.stderr)
             return 2
         findings, suppressed_hits = apply_baseline(findings, suppressed)
+
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(_sarif_doc(findings, suppressed_hits), f,
+                      indent=2)
+            f.write("\n")
 
     if args.as_json:
         print(json.dumps({

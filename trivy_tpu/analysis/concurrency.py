@@ -1,0 +1,1141 @@
+"""Engine 4: whole-program concurrency analysis (graftlint v2).
+
+The stack is a dense concurrent system — server handler threads, the
+detectd dispatcher, graftguard's watchdog, meshguard's maintenance
+loop, fanald's walker/analyzer pools, redetectd's sweeper — and every
+hand-found concurrency bug since graftguard has had one of four
+shapes: blocking work done under a lock, a leaked thread/executor/
+listener, a lost wakeup, or a lock-order inversion between two
+subsystems' maintenance paths. This engine checks those shapes
+mechanically, over the whole tree, with function summaries that see
+one level of `self.method()` calls:
+
+* **TPU110 — lock-order graph.** Every `with self._lock:` (and module-
+  level lock) acquisition is summarized per function; acquiring B
+  while holding A adds a held→acquired edge A→B. The global edge
+  graph is written to `lockgraph.json` next to this package and gated
+  for staleness like the jaxpr goldens — a new edge shows up in
+  review as an artifact diff, not silently. Cycles in the graph
+  (A→B→A across any call chains) and a non-reentrant double-acquire
+  reachable through one level of self-calls are findings.
+
+* **TPU111 — blocking under a lock.** Device dispatch/`device_get`/
+  `block_until_ready`, socket/HTTP/file IO, `time.sleep`,
+  `Thread.join`, `Future.result`, `Event.wait`, executor `shutdown`,
+  and subprocess launches are classified as blocking; reaching one
+  while a lock is held (directly or through one self-call) serializes
+  every other thread on that lock behind the slow operation.
+  `Condition.wait` on the lock you hold is exempt — it releases.
+
+* **TPU112 — lifecycle/leak.** A `threading.Thread` or
+  `ThreadPoolExecutor` stored on `self` must have a `join`/`shutdown`
+  reachable from an owning close path (`close`/`shutdown`/`stop`/
+  `drain`/`join`/`__exit__`/`__del__`, through self-calls); a local
+  one must be joined/shut down, stored, or escape the function; a
+  listener registered on an external object (`X.on_recovery(cb)`,
+  `X.add_listener(cb)`) needs the matching `remove_*` reachable from
+  a close path. The static mirror of storm's `no_leaked_threads`
+  invariant.
+
+* **TPU113 — condition-variable hygiene.** A bare `cv.wait()` must sit
+  inside a `while` predicate loop (a lone `if`+`wait` is a lost-wakeup
+  bug — PR 4's admission queue shipped one); `cv.notify()`/
+  `notify_all()` must run while holding the cv's lock, or the wakeup
+  can race the waiter's predicate check.
+
+Intentional violations are suppressed in place with
+`# lint: allow(TPU11x) reason=...` pragmas (waivers.py) — never with
+path lists.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+from . import waivers
+from .registry import Finding, register
+
+LOCKGRAPH_PATH = os.path.join(os.path.dirname(__file__),
+                              "lockgraph.json")
+LOCKGRAPH_SCHEMA = "trivy-tpu-lockgraph/1"
+
+# method names that anchor an owning close/drain path (match is by
+# word: "stop_and_join" counts via "stop"/"join")
+_CLOSE_ROOTS = ("close", "shutdown", "stop", "drain", "join",
+                "terminate", "abort", "__exit__", "__del__")
+
+# call names blocking wherever they appear
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep()",
+    "jax.device_get": "jax.device_get (device sync)",
+    "jax.device_put": "jax.device_put (host→device transfer)",
+    "jax.block_until_ready": "jax.block_until_ready (device sync)",
+    "urllib.request.urlopen": "HTTP request",
+    "socket.create_connection": "socket connect",
+    "subprocess.run": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.Popen": "subprocess",
+}
+_BLOCKING_BUILTINS = {"open": "file IO (open)"}
+# attribute-call names blocking regardless of receiver
+_BLOCKING_METHODS = {
+    "block_until_ready": "device sync (.block_until_ready)",
+    "result": "Future.result()",
+    "serve_forever": "socket accept loop",
+    "getresponse": "HTTP response read",
+    "urlopen": "HTTP request",
+    "accept": "socket accept",
+    "recv": "socket read",
+    "dispatch_merged": "device dispatch",
+    "fetch_merged": "device fetch",
+}
+
+_THREADY = ("Thread", "Timer")
+_POOLY = ("ThreadPoolExecutor", "ProcessPoolExecutor")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _ctor_leaf(call: ast.Call) -> str:
+    return _dotted(call.func).rsplit(".", 1)[-1]
+
+
+@dataclass(frozen=True)
+class LockDecl:
+    node_id: str        # "relpath:Class._lock" | "relpath:NAME"
+    kind: str           # "lock" | "rlock" | "condition"
+    owner: str          # "Class" or "" for module level
+    attr: str
+
+
+@dataclass
+class Acquire:
+    lock: str                       # node id
+    line: int
+    held: tuple[str, ...]           # node ids held at this acquire
+
+
+@dataclass
+class Blocking:
+    desc: str
+    line: int
+    held: tuple[str, ...]
+    waived: bool
+
+
+@dataclass
+class SelfCall:
+    callee: str
+    line: int
+    held: tuple[str, ...]
+
+
+@dataclass
+class FuncSummary:
+    qualname: str                   # "Class.method" | "func"
+    relpath: str
+    line: int
+    acquires: list[Acquire] = field(default_factory=list)
+    blockings: list[Blocking] = field(default_factory=list)
+    self_calls: list[SelfCall] = field(default_factory=list)
+    cleans: set[str] = field(default_factory=set)    # attrs joined/shut
+    removes: set[str] = field(default_factory=set)   # remove_* leaves
+
+
+@dataclass
+class ClassSummary:
+    relpath: str
+    name: str
+    line: int
+    locks: dict[str, LockDecl] = field(default_factory=dict)
+    cv_alias: dict[str, str] = field(default_factory=dict)  # cv→lock attr
+    threads: dict[str, int] = field(default_factory=dict)   # attr→line
+    pools: dict[str, int] = field(default_factory=dict)
+    events: set[str] = field(default_factory=set)
+    registrations: list[tuple[str, int]] = field(default_factory=list)
+    methods: dict[str, FuncSummary] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleSummary:
+    relpath: str
+    classes: dict[str, ClassSummary] = field(default_factory=dict)
+    functions: dict[str, FuncSummary] = field(default_factory=dict)
+    module_locks: dict[str, LockDecl] = field(default_factory=dict)
+    findings: list[Finding] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# summarization
+
+
+def _lock_kind(call: ast.Call) -> str | None:
+    leaf = _ctor_leaf(call)
+    return {"Lock": "lock", "RLock": "rlock",
+            "Condition": "condition"}.get(leaf)
+
+
+def _remove_counterpart(reg_name: str) -> str:
+    """on_recovery→remove_recovery, add_listener→remove_listener,
+    subscribe→unsubscribe."""
+    if reg_name.startswith("on_"):
+        return "remove_" + reg_name[3:]
+    if reg_name.startswith("add_"):
+        return "remove_" + reg_name[4:]
+    if reg_name == "subscribe":
+        return "unsubscribe"
+    return "remove_" + reg_name
+
+
+def _is_registration(call: ast.Call) -> str | None:
+    """A listener registration on an EXTERNAL object: `X.on_<e>(cb)` /
+    `X.add_<e>(cb)` where X is not self and cb references self (a bound
+    method or self itself) — registering somebody else's callback is
+    their lifecycle problem, not ours."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    name = call.func.attr
+    listenery = (name.startswith("on_") or name == "subscribe"
+                 or (name.startswith("add_")
+                     and any(w in name for w in
+                             ("listener", "watcher", "observer",
+                              "subscriber"))))
+    if not listenery:
+        return None
+    if isinstance(call.func.value, ast.Name) \
+            and call.func.value.id == "self":
+        return None
+    refs_self = any(
+        isinstance(n, ast.Name) and n.id == "self"
+        for a in call.args + [k.value for k in call.keywords]
+        for n in ast.walk(a))
+    return name if refs_self else None
+
+
+class _FuncWalker:
+    """Statement walk of one function body tracking the held-lock
+    stack, local lock/thread/event aliases, and the TPU111/112/113
+    events. Flow-insensitive beyond `with` nesting: `.acquire()` is
+    recorded as an ordering edge but not as held state."""
+
+    def __init__(self, mod: ModuleSummary, cls: ClassSummary | None,
+                 fn: ast.FunctionDef, qualname: str,
+                 waived: dict[tuple[str, int], waivers.Waiver]):
+        self.mod = mod
+        self.cls = cls
+        self.fn = fn
+        self.out = FuncSummary(qualname, mod.relpath, fn.lineno)
+        self.waived = waived
+        # local name → lock node id (aliases of lock-bearing exprs)
+        self.lock_alias: dict[str, str] = {}
+        # local name → self attr it aliases (t = self._thread)
+        self.attr_alias: dict[str, str] = {}
+        # local name → "thread" | "pool" | "event" | "thread_list"
+        self.local_types: dict[str, str] = {}
+        self.escaped: set[str] = set()        # locals that escape
+        self.joined: set[str] = set()         # locals joined/shutdown
+        self.ctor_lines: dict[str, tuple[str, int]] = {}  # local ctors
+        self.bare_ctors: list[tuple[str, int]] = []
+        self._param_types()
+
+    # -- resolution helpers --------------------------------------------
+
+    def _param_types(self):
+        a = self.fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            ann = p.annotation
+            if ann is None:
+                continue
+            # unwrap `X | None` and string annotations
+            names = {n.rsplit(".", 1)[-1]
+                     for n in _ann_names(ann)}
+            if names & set(_THREADY):
+                self.local_types[p.arg] = "thread"
+            elif names & set(_POOLY):
+                self.local_types[p.arg] = "pool"
+            elif "Event" in names:
+                self.local_types[p.arg] = "event"
+
+    def _lock_node(self, expr: ast.AST) -> str | None:
+        """Resolve an expression to a lock node id (through the class's
+        cv aliasing and local aliases)."""
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            attr = self.cls.cv_alias.get(attr, attr)
+            decl = self.cls.locks.get(attr)
+            return decl.node_id if decl else None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.lock_alias:
+                return self.lock_alias[expr.id]
+            decl = self.mod.module_locks.get(expr.id)
+            return decl.node_id if decl else None
+        return None
+
+    def _cv_lock_node(self, expr: ast.AST) -> str | None:
+        """Lock node for a condition-variable receiver, None if the
+        receiver is not a known cv."""
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            decl = self.cls.locks.get(attr)
+            if decl is not None and decl.kind == "condition":
+                aliased = self.cls.cv_alias.get(attr, attr)
+                target = self.cls.locks.get(aliased)
+                return (target or decl).node_id
+        if isinstance(expr, ast.Name):
+            decl = self.mod.module_locks.get(expr.id)
+            if decl is not None and decl.kind == "condition":
+                return decl.node_id
+        return None
+
+    def _receiver_type(self, expr: ast.AST) -> str | None:
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            if attr in self.cls.threads:
+                return "thread"
+            if attr in self.cls.pools:
+                return "pool"
+            if attr in self.cls.events:
+                return "event"
+            return None
+        if isinstance(expr, ast.Name):
+            return self.local_types.get(expr.id)
+        return None
+
+    def _is_waived(self, rule: str, line: int) -> bool:
+        return (rule, line) in self.waived
+
+    def _note_blocking(self, desc: str, line: int,
+                       held: tuple[str, ...]):
+        self.out.blockings.append(
+            Blocking(desc, line, held, self._is_waived("TPU111", line)))
+
+    # -- the walk ------------------------------------------------------
+
+    def walk(self) -> FuncSummary:
+        self._visit(self.fn.body, ())
+        # local thread/pool leak verdicts (TPU112)
+        for name, (kind, line) in self.ctor_lines.items():
+            if name in self.joined or name in self.escaped:
+                continue
+            if self._is_waived("TPU112", line):
+                continue
+            what = "thread" if kind == "thread" else "executor"
+            self.mod.findings.append(Finding(
+                "TPU112", self.mod.relpath, line,
+                f"local {what} '{name}' in {self.out.qualname}() is "
+                f"never joined/shut down and does not escape — leaked "
+                f"on every call", self.out.qualname))
+        for kind, line in self.bare_ctors:
+            if self._is_waived("TPU112", line):
+                continue
+            self.mod.findings.append(Finding(
+                "TPU112", self.mod.relpath, line,
+                f"unreferenced {kind} constructed in "
+                f"{self.out.qualname}() can never be joined "
+                f"(fire-and-forget leak)", self.out.qualname))
+        return self.out
+
+    def _visit(self, stmts, held: tuple[str, ...],
+               in_while: bool = False):
+        for st in stmts:
+            self._statement(st, held, in_while)
+
+    def _statement(self, st: ast.stmt, held: tuple[str, ...],
+                   in_while: bool):
+        self._track_locals(st)
+        for expr in _header_exprs(st):
+            for call in _calls_in(expr):
+                self._call(call, st, held, in_while)
+        if isinstance(st, ast.With):
+            newly = []
+            for item in st.items:
+                node = self._lock_node(item.context_expr)
+                if node is not None:
+                    self._acquire(node, st.lineno, held + tuple(newly))
+                    newly.append(node)
+                elif isinstance(item.context_expr, ast.Call):
+                    # `with ThreadPoolExecutor(...) as ex:` manages
+                    # its own shutdown
+                    if _ctor_leaf(item.context_expr) in _POOLY \
+                            and item.optional_vars is not None \
+                            and isinstance(item.optional_vars, ast.Name):
+                        self.joined.add(item.optional_vars.id)
+                        self.local_types[item.optional_vars.id] = "pool"
+                        self.ctor_lines.pop(item.optional_vars.id, None)
+            self._visit(st.body, held + tuple(newly), in_while)
+        elif isinstance(st, ast.While):
+            self._visit(st.body, held, True)
+            self._visit(st.orelse, held, in_while)
+        elif isinstance(st, ast.For):
+            self._visit(st.body, held, in_while)
+            self._visit(st.orelse, held, in_while)
+        elif isinstance(st, ast.If):
+            self._visit(st.body, held, in_while)
+            self._visit(st.orelse, held, in_while)
+        elif isinstance(st, ast.Try):
+            self._visit(st.body, held, in_while)
+            for h in st.handlers:
+                self._visit(h.body, held, in_while)
+            self._visit(st.orelse, held, in_while)
+            self._visit(st.finalbody, held, in_while)
+        elif isinstance(st, ast.Match):
+            for case in st.cases:
+                self._visit(case.body, held, in_while)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a helper defined here inherits the lock state of its
+            # definition site (same heuristic as TPU106): it usually
+            # runs where it is defined or on a pool the enclosing
+            # function waits on
+            self._visit(st.body, held, in_while)
+
+    def _track_locals(self, st: ast.stmt):
+        # a local that escapes through `return t` is the caller's to
+        # join, not a leak here
+        if isinstance(st, ast.Return) and st.value is not None:
+            vals = st.value.elts if isinstance(
+                st.value, ast.Tuple) else [st.value]
+            for v in vals:
+                if isinstance(v, ast.Name):
+                    self.escaped.add(v.id)
+            return
+        if not isinstance(st, ast.Assign):
+            return
+        value = st.value
+        names = [t.id for t in st.targets if isinstance(t, ast.Name)]
+        self_attrs = [a for a in
+                      (_self_attr(t) for t in st.targets) if a]
+        # storing a tracked local anywhere non-local (self attr,
+        # container slot) is an escape
+        if isinstance(value, ast.Name) and value.id in self.ctor_lines:
+            if self_attrs or any(
+                    isinstance(t, (ast.Subscript, ast.Attribute))
+                    for t in st.targets):
+                self.escaped.add(value.id)
+        if isinstance(value, ast.Call):
+            leaf = _ctor_leaf(value)
+            if leaf in _THREADY or leaf in _POOLY:
+                kind = "thread" if leaf in _THREADY else "pool"
+                for n in names:
+                    self.local_types[n] = kind
+                    if not self_attrs:
+                        self.ctor_lines[n] = (kind, st.lineno)
+                    else:
+                        # `t = self._thread = Thread(...)`: owned by
+                        # the class (class-level TPU112 covers it);
+                        # joining the local credits the attr
+                        self.attr_alias[n] = self_attrs[0]
+            elif leaf == "Event":
+                for n in names:
+                    self.local_types[n] = "event"
+        # alias of a lock-bearing expression
+        if len(names) == 1:
+            node = self._lock_node(value)
+            if node is not None:
+                self.lock_alias[names[0]] = node
+            src = _self_attr(value)
+            if src is not None and self.cls is not None:
+                if src in self.cls.threads:
+                    self.local_types[names[0]] = "thread"
+                    self.attr_alias[names[0]] = src
+                elif src in self.cls.pools:
+                    self.local_types[names[0]] = "pool"
+                    self.attr_alias[names[0]] = src
+        # list of threads: threads = [Thread(...) ...]
+        if len(names) == 1 and isinstance(
+                value, (ast.List, ast.ListComp)):
+            ctors = [c for c in ast.walk(value)
+                     if isinstance(c, ast.Call)
+                     and _ctor_leaf(c) in _THREADY]
+            if ctors:
+                self.local_types[names[0]] = "thread_list"
+        # for-loop var over a thread list is thread-typed: handled in
+        # _call via receiver list lookups (join inside `for t in ts`)
+
+    def _call(self, call: ast.Call, st: ast.stmt,
+              held: tuple[str, ...], in_while: bool):
+        line = call.lineno
+        fname = _dotted(call.func)
+
+        # escapes: locals passed as arguments (appended, registered,
+        # submitted) no longer leak locally
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            if isinstance(a, ast.Name) and a.id in self.ctor_lines:
+                self.escaped.add(a.id)
+
+        # bare fire-and-forget ctor: Thread(...).start()
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Call):
+            leaf = _ctor_leaf(call.func.value)
+            if leaf in _THREADY and call.func.attr == "start":
+                self.bare_ctors.append(("thread", line))
+
+        if isinstance(call.func, ast.Attribute):
+            recv = call.func.value
+            meth = call.func.attr
+
+            # registrations (TPU112 listener leg)
+            reg = _is_registration(call)
+            if reg is not None and self.cls is not None:
+                self.cls.registrations.append((reg, line))
+            if meth.startswith("remove_") or meth == "unsubscribe":
+                self.out.removes.add(meth)
+
+            # lock ops through .acquire()
+            if meth == "acquire":
+                node = self._lock_node(recv)
+                if node is not None:
+                    self._acquire(node, line, held)
+                    return
+
+            # cleanups (TPU112)
+            if meth in ("join", "shutdown", "cancel"):
+                attr = _self_attr(recv)
+                if attr is not None:
+                    self.out.cleans.add(attr)
+                elif isinstance(recv, ast.Name):
+                    self.joined.add(recv.id)
+                    if recv.id in self.attr_alias:
+                        self.out.cleans.add(self.attr_alias[recv.id])
+
+            # blocking classification (TPU111)
+            desc = None
+            rtype = self._receiver_type(recv)
+            if meth in _BLOCKING_METHODS:
+                desc = _BLOCKING_METHODS[meth]
+            elif meth == "join":
+                if rtype == "thread" or _thready_name(recv) \
+                        or _has_timeout_kw(call):
+                    desc = "Thread.join()"
+            elif meth == "shutdown" \
+                    and (rtype == "pool" or _pooly_name(recv)) \
+                    and not _wait_false(call):
+                desc = "executor shutdown (waits for workers)"
+            elif meth in ("wait", "wait_for"):
+                cv_lock = self._cv_lock_node(recv)
+                if cv_lock is not None:
+                    # Condition.wait releases the held lock — only
+                    # blocking when a DIFFERENT lock stays held
+                    others = tuple(h for h in held if h != cv_lock)
+                    if others:
+                        self._note_blocking(
+                            f"Condition.wait on {cv_lock.split(':')[-1]}"
+                            f" while another lock is held", line, others)
+                    if meth == "wait" and not in_while \
+                            and not self._is_waived("TPU113", line):
+                        self.mod.findings.append(Finding(
+                            "TPU113", self.mod.relpath, line,
+                            "bare cv.wait() outside a while-predicate "
+                            "loop — spurious/lost wakeups break the "
+                            "wait condition", self.out.qualname))
+                    return
+                if rtype == "event" or _eventy_name(recv):
+                    desc = "Event.wait()"
+                elif held:
+                    desc = f".{meth}() on a non-Condition receiver"
+            elif meth in ("notify", "notify_all"):
+                cv_lock = self._cv_lock_node(recv)
+                if cv_lock is not None and cv_lock not in held \
+                        and not self._under_with_lock(st, cv_lock) \
+                        and not self._is_waived("TPU113", line):
+                    self.mod.findings.append(Finding(
+                        "TPU113", self.mod.relpath, line,
+                        f"cv.{meth}() without holding the owning lock "
+                        f"— the wakeup can race the waiter's predicate",
+                        self.out.qualname))
+                return
+            if desc is not None:
+                self._note_blocking(desc, line, held)
+                return
+
+        if fname in _BLOCKING_DOTTED:
+            self._note_blocking(_BLOCKING_DOTTED[fname], line, held)
+        elif fname in _BLOCKING_BUILTINS:
+            self._note_blocking(_BLOCKING_BUILTINS[fname], line, held)
+        elif fname.rpartition(".")[2] == "sleep" \
+                and fname.partition(".")[0] in ("time", ""):
+            if fname == "sleep":
+                self._note_blocking("sleep()", line, held)
+        elif isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self":
+            self.out.self_calls.append(
+                SelfCall(call.func.attr, line, held))
+
+    def _under_with_lock(self, st: ast.stmt, node: str) -> bool:
+        # `notify` legality when the held tuple missed it (e.g. the
+        # statement IS the with header) — conservative: only the held
+        # tuple counts; kept as a hook for future flow tracking
+        return False
+
+    def _acquire(self, node: str, line: int, held: tuple[str, ...]):
+        self.out.acquires.append(Acquire(node, line, held))
+
+
+def _ann_names(ann: ast.AST) -> list[str]:
+    """Dotted names inside an annotation (handles `X | None`,
+    `Optional[X]`, string annotations)."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return []
+    out = []
+    for n in ast.walk(ann):
+        d = _dotted(n)
+        if d:
+            out.append(d)
+    return out
+
+
+def _thready_name(recv: ast.AST) -> bool:
+    name = _dotted(recv).rsplit(".", 1)[-1].lower()
+    return ("thread" in name or "worker" in name
+            or name in ("t", "th", "predecessor", "sweeper", "watchdog"))
+
+
+def _pooly_name(recv: ast.AST) -> bool:
+    name = _dotted(recv).rsplit(".", 1)[-1].lower()
+    return "pool" in name or "executor" in name or name == "ex"
+
+
+def _eventy_name(recv: ast.AST) -> bool:
+    name = _dotted(recv).rsplit(".", 1)[-1].lower()
+    return ("event" in name or "stop" in name or "ready" in name
+            or "done" in name)
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    return any(k.arg == "timeout" for k in call.keywords)
+
+
+def _wait_false(call: ast.Call) -> bool:
+    return any(k.arg == "wait" and isinstance(k.value, ast.Constant)
+               and k.value.value is False for k in call.keywords)
+
+
+def _header_exprs(st: ast.stmt) -> list[ast.expr]:
+    """Expressions evaluated by the statement header itself (compound
+    bodies are visited with their own lock state)."""
+    if isinstance(st, ast.Assign):
+        return [st.value]
+    if isinstance(st, (ast.AugAssign, ast.AnnAssign, ast.Return)):
+        return [st.value] if st.value is not None else []
+    if isinstance(st, ast.Expr):
+        return [st.value]
+    if isinstance(st, (ast.If, ast.While)):
+        return [st.test]
+    if isinstance(st, ast.For):
+        return [st.iter]
+    if isinstance(st, ast.With):
+        return [i.context_expr for i in st.items]
+    if isinstance(st, ast.Raise):
+        return [e for e in (st.exc, st.cause) if e is not None]
+    if isinstance(st, ast.Assert):
+        return [e for e in (st.test, st.msg) if e is not None]
+    if isinstance(st, ast.Match):
+        return [st.subject]
+    return []
+
+
+def _calls_in(expr: ast.AST):
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+# ---------------------------------------------------------------------------
+# module summarization
+
+
+def summarize_module(relpath: str, source: str) -> ModuleSummary | None:
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError:
+        return None
+    mod = ModuleSummary(relpath)
+    waived = waivers.waived_lines(source)
+
+    # module-level locks
+    for st in tree.body:
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
+            kind = _lock_kind(st.value)
+            if kind is None:
+                continue
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    mod.module_locks[t.id] = LockDecl(
+                        f"{relpath}:{t.id}", kind, "", t.id)
+
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            _summarize_class(mod, node, waived)
+        elif isinstance(node, ast.FunctionDef):
+            w = _FuncWalker(mod, None, node, node.name, waived)
+            mod.functions[node.name] = w.walk()
+    return mod
+
+
+def _summarize_class(mod: ModuleSummary, cls: ast.ClassDef,
+                     waived: dict) -> None:
+    cs = ClassSummary(mod.relpath, cls.name, cls.lineno)
+    methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)]
+
+    # pass 1: lock/cv/thread/pool/event attributes from any method
+    for m in methods:
+        for node in ast.walk(m):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            self_attrs = [a for a in
+                          (_self_attr(t) for t in node.targets) if a]
+            if not self_attrs:
+                continue
+            kind = _lock_kind(node.value)
+            leaf = _ctor_leaf(node.value)
+            for attr in self_attrs:
+                if kind is not None:
+                    cs.locks[attr] = LockDecl(
+                        f"{mod.relpath}:{cls.name}.{attr}", kind,
+                        cls.name, attr)
+                    if kind == "condition" and node.value.args:
+                        src = _self_attr(node.value.args[0])
+                        if src is not None:
+                            cs.cv_alias[attr] = src
+                elif leaf in _THREADY:
+                    cs.threads[attr] = node.lineno
+                elif leaf in _POOLY:
+                    cs.pools[attr] = node.lineno
+                elif leaf == "Event":
+                    cs.events.add(attr)
+
+    # pass 2: per-method event walk
+    for m in methods:
+        w = _FuncWalker(mod, cs, m, f"{cls.name}.{m.name}", waived)
+        cs.methods[m.name] = w.walk()
+
+    mod.classes[cls.name] = cs
+
+    # TPU112: owned threads/pools need a cleanup reachable from a
+    # close path (self-call transitive closure from close-named
+    # methods)
+    close_reach = _close_reachable(cs)
+    cleaned: set[str] = set()
+    removed: set[str] = set()
+    for mname in close_reach:
+        ms = cs.methods.get(mname)
+        if ms is not None:
+            cleaned |= ms.cleans
+            removed |= ms.removes
+    for attr, line in sorted(cs.threads.items()):
+        if attr in cleaned or ("TPU112", line) in waived:
+            continue
+        mod.findings.append(Finding(
+            "TPU112", mod.relpath, line,
+            f"thread '{cls.name}.{attr}' has no join() reachable from "
+            f"a close/stop/drain path — leaked on shutdown",
+            f"{cls.name}"))
+    for attr, line in sorted(cs.pools.items()):
+        if attr in cleaned or ("TPU112", line) in waived:
+            continue
+        mod.findings.append(Finding(
+            "TPU112", mod.relpath, line,
+            f"executor '{cls.name}.{attr}' has no shutdown() reachable "
+            f"from a close/stop/drain path — worker threads leak",
+            f"{cls.name}"))
+    for reg, line in cs.registrations:
+        want = _remove_counterpart(reg)
+        if want in removed or ("TPU112", line) in waived:
+            continue
+        mod.findings.append(Finding(
+            "TPU112", mod.relpath, line,
+            f"listener registered via {reg}() but no {want}() is "
+            f"reachable from a close/stop/drain path — the callback "
+            f"(and its object) leak on the registree",
+            f"{cls.name}"))
+
+
+def _is_close_name(name: str) -> bool:
+    return any(root in name for root in _CLOSE_ROOTS)
+
+
+def _close_reachable(cs: ClassSummary) -> set[str]:
+    """Method names reachable (via self-calls, any depth) from a
+    close-named method."""
+    seen = {m for m in cs.methods if _is_close_name(m)}
+    frontier = list(seen)
+    while frontier:
+        ms = cs.methods.get(frontier.pop())
+        if ms is None:
+            continue
+        for sc in ms.self_calls:
+            if sc.callee in cs.methods and sc.callee not in seen:
+                seen.add(sc.callee)
+                frontier.append(sc.callee)
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# whole-program analysis
+
+
+@dataclass(frozen=True)
+class Edge:
+    held: str
+    acquires: str
+    via: str       # "relpath:qualname"
+
+
+def _lock_decls(mods: list[ModuleSummary]) -> dict[str, LockDecl]:
+    decls: dict[str, LockDecl] = {}
+    for mod in mods:
+        for d in mod.module_locks.values():
+            decls[d.node_id] = d
+        for cs in mod.classes.values():
+            for d in cs.locks.values():
+                decls[d.node_id] = d
+    return decls
+
+
+def analyze(mods: list[ModuleSummary]) -> tuple[list[Finding],
+                                                list[Edge]]:
+    """Interprocedural pass: assemble the lock-order edge set, lift
+    blocking events through one level of self-calls, detect cycles and
+    cross-call double-acquires."""
+    findings: list[Finding] = []
+    for mod in mods:
+        findings.extend(mod.findings)
+    decls = _lock_decls(mods)
+    edges: set[Edge] = set()
+
+    def summaries():
+        for mod in mods:
+            for fs in mod.functions.values():
+                yield mod, None, fs
+            for cs in mod.classes.values():
+                for fs in cs.methods.values():
+                    yield mod, cs, fs
+
+    # intraprocedural edges + direct double-acquire + direct blocking
+    for mod, cs, fs in summaries():
+        via = f"{mod.relpath}:{fs.qualname}"
+        for acq in fs.acquires:
+            for h in acq.held:
+                if h == acq.lock:
+                    if decls.get(h) and decls[h].kind == "lock":
+                        findings.append(Finding(
+                            "TPU110", mod.relpath, acq.line,
+                            f"double-acquire of non-reentrant "
+                            f"{_short(h)} (self-deadlock)",
+                            fs.qualname))
+                else:
+                    edges.add(Edge(h, acq.lock, via))
+        for b in fs.blockings:
+            if b.held and not b.waived:
+                findings.append(Finding(
+                    "TPU111", mod.relpath, b.line,
+                    f"blocking call ({b.desc}) while holding "
+                    f"{_held_str(b.held)}", fs.qualname))
+
+    # one level of self-calls: caller's held set meets callee's
+    # acquires/blockings
+    for mod, cs, fs in summaries():
+        if cs is None:
+            continue
+        via = f"{mod.relpath}:{fs.qualname}"
+        for sc in fs.self_calls:
+            callee = cs.methods.get(sc.callee)
+            if callee is None or not sc.held:
+                continue
+            for acq in callee.acquires:
+                # callee's entry holds nothing of its own here; the
+                # caller's held set is the context
+                for h in sc.held:
+                    if h == acq.lock:
+                        d = decls.get(h)
+                        if d is not None and d.kind == "lock":
+                            findings.append(Finding(
+                                "TPU110", mod.relpath, sc.line,
+                                f"self.{sc.callee}() re-acquires "
+                                f"non-reentrant {_short(h)} already "
+                                f"held here (interprocedural "
+                                f"self-deadlock)", fs.qualname))
+                    else:
+                        edges.add(Edge(h, acq.lock,
+                                       f"{via}->{sc.callee}"))
+            for b in callee.blockings:
+                if b.held or b.waived:
+                    continue   # reported (or waived) in the callee
+                if waivers_covers_call(mod, fs, sc):
+                    continue
+                findings.append(Finding(
+                    "TPU111", mod.relpath, sc.line,
+                    f"self.{sc.callee}() does blocking work "
+                    f"({b.desc} at line {b.line}) while "
+                    f"{_held_str(sc.held)} is held here", fs.qualname))
+
+    # cycles: Tarjan SCC over the edge graph
+    findings.extend(_cycle_findings(edges))
+    return findings, sorted(edges,
+                            key=lambda e: (e.held, e.acquires, e.via))
+
+
+def waivers_covers_call(mod: ModuleSummary, fs: FuncSummary,
+                        sc: SelfCall) -> bool:
+    """Interprocedural TPU111 findings anchor at the call site; the
+    pragma check for that line happens here (summaries carry only the
+    callee-side waiver bits)."""
+    src = _SOURCE_CACHE.get(mod.relpath)
+    if src is None:
+        return False
+    return ("TPU111", sc.line) in waivers.waived_lines(src)
+
+
+def _short(node_id: str) -> str:
+    return node_id.rsplit(":", 1)[-1]
+
+
+def _held_str(held: tuple[str, ...]) -> str:
+    return " + ".join(_short(h) for h in held)
+
+
+def _cycle_findings(edges: set[Edge]) -> list[Finding]:
+    graph: dict[str, set[str]] = {}
+    via: dict[tuple[str, str], str] = {}
+    for e in edges:
+        graph.setdefault(e.held, set()).add(e.acquires)
+        graph.setdefault(e.acquires, set())
+        via.setdefault((e.held, e.acquires), e.via)
+
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    onstack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        # iterative Tarjan (the graph is small, but recursion depth
+        # should not depend on lock count)
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    findings = []
+    for scc in sccs:
+        if len(scc) < 2:
+            continue
+        cyc = sorted(scc)
+        sites = sorted({via[(a, b)] for a in scc for b in graph[a]
+                        if b in scc and (a, b) in via})
+        findings.append(Finding(
+            "TPU110", "", 0,
+            f"lock-order cycle (potential deadlock): "
+            f"{' -> '.join(_short(c) for c in cyc)} -> "
+            f"{_short(cyc[0])} via {', '.join(sites)}",
+            "lockgraph"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lockgraph artifact
+
+
+def build_lockgraph(mods: list[ModuleSummary],
+                    edges: list[Edge]) -> dict:
+    decls = _lock_decls(mods)
+    locks = [{"id": d.node_id, "kind": d.kind, "owner": d.owner}
+             for d in sorted(decls.values(), key=lambda d: d.node_id)]
+    merged: dict[tuple[str, str], list[str]] = {}
+    for e in edges:
+        merged.setdefault((e.held, e.acquires), []).append(e.via)
+    edge_list = [{"held": h, "acquires": a, "via": sorted(set(v))}
+                 for (h, a), v in sorted(merged.items())]
+    return {"schema": LOCKGRAPH_SCHEMA, "locks": locks,
+            "edges": edge_list}
+
+
+def write_lockgraph(graph: dict, path: str = LOCKGRAPH_PATH) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(graph, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def check_lockgraph_stale(graph: dict,
+                          path: str = LOCKGRAPH_PATH) -> list[Finding]:
+    rel = os.path.join("trivy_tpu", "analysis",
+                       os.path.basename(path))
+    if not os.path.exists(path):
+        return [Finding(
+            "TPU110", rel, 0,
+            "lockgraph.json missing — run python -m trivy_tpu.analysis "
+            "--update-lockgraph", "lockgraph")]
+    try:
+        with open(path, encoding="utf-8") as f:
+            have = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        have = None
+    if have != graph:
+        return [Finding(
+            "TPU110", rel, 0,
+            "lockgraph.json is stale — the held→acquired edge set "
+            "changed; review the diff, then --update-lockgraph",
+            "lockgraph")]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+_SOURCE_CACHE: dict[str, str] = {}
+
+
+def summarize_tree(root: str | None = None) -> list[ModuleSummary]:
+    from .astlint import iter_python_files
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(root)
+    mods = []
+    _SOURCE_CACHE.clear()
+    for path in iter_python_files(root):
+        rel = os.path.relpath(path, repo)
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        _SOURCE_CACHE[rel] = source
+        mod = summarize_module(rel, source)
+        if mod is not None:
+            mods.append(mod)
+    return mods
+
+
+def run(root: str | None = None,
+        lockgraph_path: str | None = None) -> list[Finding]:
+    """Whole-tree concurrency pass. The lockgraph staleness gate runs
+    only for the installed tree (root=None) — a fixture tree has no
+    checked-in artifact."""
+    check_artifact = root is None
+    mods = summarize_tree(root)
+    findings, edges = analyze(mods)
+    # final waiver pass: TPU110 double-acquire/interprocedural findings
+    # anchor at source lines too, so pragmas cover every conc rule
+    # uniformly (TPU116 emission stays with the AST engine)
+    for rel, source in _SOURCE_CACHE.items():
+        findings = waivers.apply(rel, source, findings,
+                                 emit_hygiene=False)
+    if check_artifact or lockgraph_path is not None:
+        graph = build_lockgraph(mods, edges)
+        findings += check_lockgraph_stale(
+            graph, lockgraph_path or LOCKGRAPH_PATH)
+    return findings
+
+
+def update_lockgraph(root: str | None = None,
+                     path: str = LOCKGRAPH_PATH) -> str:
+    mods = summarize_tree(root)
+    _, edges = analyze(mods)
+    return write_lockgraph(build_lockgraph(mods, edges), path)
+
+
+# ---------------------------------------------------------------------------
+# registry entries (the engine reports through run(); these document
+# the ids for --list-rules, like TPU100/JAX202-206)
+
+
+@register("TPU110", "lock-order-graph", "conc")
+def _doc_lockorder(*_a):
+    """Held→acquired lock-order edges are summarized per function
+    (through one level of self-calls), assembled into a global graph,
+    and checked for cycles (potential deadlock) and non-reentrant
+    double-acquires. The graph is a checked-in artifact
+    (lockgraph.json) with a staleness gate, so a new edge shows up in
+    review like a jaxpr golden."""
+    return []
+
+
+@register("TPU111", "blocking-under-lock", "conc")
+def _doc_blocking(*_a):
+    """Blocking calls (device dispatch/fetch, socket/HTTP/file IO,
+    time.sleep, Thread.join, Future.result, Event.wait, executor
+    shutdown, subprocess) reached while a lock is held — directly or
+    through one self-call — serialize every thread on that lock behind
+    the slow operation. Condition.wait on the held lock is exempt (it
+    releases). Waive intentional cases with
+    `# lint: allow(TPU111) reason=...`."""
+    return []
+
+
+@register("TPU112", "lifecycle-leak", "conc")
+def _doc_lifecycle(*_a):
+    """Every thread/executor construction needs a join/shutdown
+    reachable from an owning close/stop/drain path (self-attrs) or in
+    scope (locals, unless they escape); listeners registered on
+    external objects need their remove_* on a close path. The static
+    mirror of storm's no_leaked_threads invariant."""
+    return []
+
+
+@register("TPU113", "condvar-hygiene", "conc")
+def _doc_condvar(*_a):
+    """Bare cv.wait() must sit inside a while-predicate loop (lost/
+    spurious wakeups), and cv.notify()/notify_all() must run while
+    holding the cv's lock."""
+    return []
+
+

@@ -1,0 +1,109 @@
+"""Xcheck: every jitted kernel entry has a jaxpr contract (TPU114).
+
+The jaxpr engine only checks entries that HAVE a contract — before
+this rule, a new `@jax.jit` kernel under `ops/` or `parallel/` could
+ship with no trace coverage at all, and nothing would notice
+(secret_shiftor and csr_pair_join_compact got contracts by hand
+because review remembered; that does not scale). This rule closes the
+loop: it discovers every jitted entry point in the kernel packages —
+decorator form (`@jax.jit`, `@functools.partial(jax.jit, ...)`) and
+assignment form (`pair_join = jax.jit(_pair_core)`) — and requires
+each to be named by some `contracts/*.json` `entry`, or carry an
+inline `# lint: allow(TPU114) reason=...` waiver on its def/assign
+line (e.g. a mesh-static entry whose `Mesh` argument the contract
+grammar cannot express).
+
+Only `ops/` and `parallel/` are scanned: those are the kernel
+packages; jit use elsewhere is glue over already-contracted entries.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from . import waivers
+from .jaxpr_check import load_contracts
+from .registry import Finding, register
+
+# the kernel packages: every jitted entry here is a hot-path lowering
+_KERNEL_DIRS = ("ops", "parallel")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    """`jax.jit(...)` or `[functools.]partial(jax.jit, ...)`."""
+    name = _dotted(call.func)
+    if name.rsplit(".", 1)[-1] == "jit":
+        return True
+    if name.rsplit(".", 1)[-1] == "partial" and call.args:
+        return _dotted(call.args[0]).rsplit(".", 1)[-1] == "jit"
+    return False
+
+
+def jit_entries(relpath: str, source: str) -> list[tuple[str, int]]:
+    """(entry attr name, line) for every module-level jitted entry."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError:
+        return []
+    out: list[tuple[str, int]] = []
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                jitted = (isinstance(dec, ast.Call) and _is_jit_call(dec)) \
+                    or _dotted(dec).rsplit(".", 1)[-1] == "jit"
+                if jitted:
+                    out.append((node.name, node.lineno))
+                    break
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and _is_jit_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.append((t.id, node.lineno))
+    return out
+
+
+@register("TPU114", "contract-coverage", "xcheck")
+def check_contract_coverage() -> list[Finding]:
+    """Every jitted entry under ops/ and parallel/ is named by a
+    contract's `entry`, or carries a reasoned TPU114 waiver."""
+    from .astlint import iter_python_files
+    covered = {c["entry"] for _, c in load_contracts()}
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(pkg_root)
+    findings: list[Finding] = []
+    for sub in _KERNEL_DIRS:
+        root = os.path.join(pkg_root, sub)
+        if not os.path.isdir(root):
+            continue
+        for path in iter_python_files(root):
+            rel = os.path.relpath(path, repo)
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            modname = rel[:-3].replace(os.sep, ".")
+            waived = waivers.waived_lines(source)
+            for attr, line in jit_entries(rel, source):
+                spec = f"{modname}:{attr}"
+                if spec in covered:
+                    continue
+                if ("TPU114", line) in waived:
+                    continue
+                findings.append(Finding(
+                    "TPU114", rel, line,
+                    f"jitted entry {spec} has no analysis/contracts/"
+                    f"*.json contract — a kernel cannot ship untraced "
+                    f"(add a contract or a reasoned TPU114 waiver)",
+                    spec))
+    return findings

@@ -1,0 +1,171 @@
+"""Xcheck: failpoint probe sites vs the closed catalog (TPU115).
+
+graftguard's failpoint catalog (`resilience/failpoints.py` SITES +
+FAMILIES) is closed so a typo'd `--failpoint` spec fails loudly at
+parse time — but nothing checked the OTHER side: a typo'd probe
+string compiled into the tree (`failpoint("detect.dispach")`) would
+never fire, silently un-covering a chaos surface, and a site removed
+from a code path would leave a dead catalog entry that specs can still
+arm to no effect. This is the metrics-catalog pattern (TPU109) applied
+to fault sites; three checks, all static:
+
+  * every literal probe string in the tree — `failpoint("...")`,
+    `self._failpoint("...")`, `FAILPOINTS.fire("...")`,
+    `GUARD.watch("...")`, including module-level constants like
+    fanal's `WALK_SITE` — must satisfy `known_site()`;
+  * every storm topology-menu entry (`_*_FAULTS` tuples in
+    `resilience/storm.py`) must name a cataloged site (bare family
+    names are legal — storm instantiates `detect.mesh:<id>` at
+    runtime) and a known mode;
+  * every `SITES` entry must be probed by at least one literal site
+    in the tree — a dead catalog entry is a chaos surface that
+    silently stopped existing.
+
+Dynamic probes (`failpoint(site)` in meshguard's per-device loop) are
+skipped: the variable site is validated at arm time by `known_site`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from . import waivers
+from .registry import Finding, register
+
+_PROBE_FUNCS = ("failpoint", "_failpoint")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _module_str_consts(tree: ast.Module) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for st in tree.body:
+        if isinstance(st, ast.Assign) \
+                and isinstance(st.value, ast.Constant) \
+                and isinstance(st.value.value, str):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = st.value.value
+    return out
+
+
+def probe_sites(relpath: str, source: str) -> list[tuple[str, int]]:
+    """(site string, line) for every statically-resolvable probe in
+    one module: failpoint()/._failpoint() calls, FAILPOINTS.fire(),
+    GUARD.watch() — literal args plus module-level str constants."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError:
+        return []
+    consts = _module_str_consts(tree)
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fname = _dotted(node.func)
+        leaf = fname.rsplit(".", 1)[-1]
+        is_probe = (
+            leaf in _PROBE_FUNCS
+            or (leaf == "fire" and fname.rsplit(".", 2)[-2:-1]
+                == ["FAILPOINTS"])
+            or (leaf == "watch" and "GUARD" in fname))
+        if not is_probe:
+            continue
+        arg = node.args[0]
+        site = None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            site = arg.value
+        elif isinstance(arg, ast.Name) and arg.id in consts:
+            site = consts[arg.id]
+        if site is not None:
+            out.append((site, node.lineno))
+    return out
+
+
+def storm_menu_entries(source: str) -> list[tuple[str, str, int]]:
+    """(site, mode, line) from every module-level `_*_FAULTS` tuple."""
+    tree = ast.parse(source)
+    out: list[tuple[str, str, int]] = []
+    for st in tree.body:
+        if not isinstance(st, ast.Assign) \
+                or not isinstance(st.value, (ast.Tuple, ast.List)):
+            continue
+        names = [t.id for t in st.targets if isinstance(t, ast.Name)]
+        if not any(n.endswith("_FAULTS") for n in names):
+            continue
+        for el in st.value.elts:
+            if isinstance(el, (ast.Tuple, ast.List)) \
+                    and len(el.elts) == 2 \
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in el.elts):
+                out.append((el.elts[0].value, el.elts[1].value,
+                            el.lineno))
+    return out
+
+
+@register("TPU115", "failpoint-catalog", "xcheck")
+def check_failpoint_catalog() -> list[Finding]:
+    """Probe strings ⊆ catalog; storm menus ⊆ catalog × modes; catalog
+    ⊆ probed sites (no dead entries)."""
+    from ..resilience.failpoints import FAMILIES, MODES, SITES, \
+        known_site
+    from .astlint import iter_python_files
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(pkg_root)
+    findings: list[Finding] = []
+    probed: set[str] = set()
+    storm_rel = os.path.join("trivy_tpu", "resilience", "storm.py")
+    catalog_rel = os.path.join("trivy_tpu", "resilience",
+                               "failpoints.py")
+
+    for path in iter_python_files(pkg_root):
+        rel = os.path.relpath(path, repo)
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        file_findings: list[Finding] = []
+        for site, line in probe_sites(rel, source):
+            probed.add(site)
+            if not known_site(site):
+                file_findings.append(Finding(
+                    "TPU115", rel, line,
+                    f"probe site {site!r} is not in the failpoint "
+                    f"catalog (SITES/FAMILIES) — it can never be "
+                    f"armed and silently un-covers a chaos surface",
+                    site))
+        if rel == storm_rel:
+            for site, mode, line in storm_menu_entries(source):
+                fam = site.partition(":")[0]
+                if not (known_site(site) or site in FAMILIES
+                        or fam in FAMILIES):
+                    file_findings.append(Finding(
+                        "TPU115", rel, line,
+                        f"storm menu fault site {site!r} is not in "
+                        f"the failpoint catalog", site))
+                if mode not in MODES:
+                    file_findings.append(Finding(
+                        "TPU115", rel, line,
+                        f"storm menu mode {mode!r} is not a failpoint "
+                        f"mode ({', '.join(MODES)})", f"{site}={mode}"))
+        if file_findings:
+            findings.extend(waivers.apply(rel, source, file_findings,
+                                          emit_hygiene=False))
+
+    for site in SITES:
+        if site not in probed:
+            findings.append(Finding(
+                "TPU115", catalog_rel, 0,
+                f"catalog site {site!r} is probed nowhere in the tree "
+                f"— a dead entry that specs can arm to no effect",
+                site))
+    return findings

@@ -1,0 +1,109 @@
+"""Inline pragma waivers: `# lint: allow(TPU111) reason=...`.
+
+graftlint v1 had two suppression channels: the `--baseline` file
+(fingerprint + mandatory reason) and the `_LOCK_SCOPE` path list that
+gated TPU106 to hand-picked modules. The path list was a silent scope
+hole — a module left off the list was not "clean", it was *unchecked*,
+and nothing in review showed the difference. v2 deletes it: every rule
+runs over the whole tree, and an intentional violation is suppressed
+where it lives, in the source, with a reason that survives `git blame`:
+
+    self._specs[site] = spec  # lint: allow(TPU106) reason=armed under
+                              # the registry lock by every caller
+
+Grammar (one pragma per comment; the comment may share the line with
+code or sit on the line directly above the flagged statement):
+
+    # lint: allow(RULE[,RULE...]) reason=<free text to end of line>
+
+A waiver with no reason does not suppress anything — it *is* a finding
+(TPU116), exactly like a baseline entry without a reason is rejected.
+The rule list is exact ids, not globs: a waiver names what it hides.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from .registry import Finding
+
+_PRAGMA_RE = re.compile(
+    r"#\s*lint:\s*allow\(\s*(?P<rules>[A-Z]+[0-9]+"
+    r"(?:\s*,\s*[A-Z]+[0-9]+)*)\s*\)"
+    r"(?:\s+reason=(?P<reason>.*\S))?")
+
+
+@dataclass(frozen=True)
+class Waiver:
+    line: int              # 1-based line the pragma sits on
+    rules: frozenset[str]  # rule ids it suppresses
+    reason: str            # "" = invalid (TPU116)
+
+
+def scan(source: str) -> list[Waiver]:
+    """All pragmas in one module's source, in line order."""
+    out = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(text)
+        if m is None:
+            continue
+        rules = frozenset(r.strip() for r in m.group("rules").split(","))
+        out.append(Waiver(i, rules, (m.group("reason") or "").strip()))
+    return out
+
+
+def waived_lines(source: str) -> dict[tuple[str, int], Waiver]:
+    """→ {(rule, covered_line): waiver} — a pragma covers its own line
+    and the line below it (comment-above form). Reason-less pragmas
+    cover nothing."""
+    cover: dict[tuple[str, int], Waiver] = {}
+    for w in scan(source):
+        if not w.reason:
+            continue
+        for rule in w.rules:
+            cover[(rule, w.line)] = w
+            cover[(rule, w.line + 1)] = w
+    return cover
+
+
+def apply(relpath: str, source: str, findings: list[Finding],
+          emit_hygiene: bool = True) -> list[Finding]:
+    """Drop findings suppressed by a pragma on (or directly above)
+    their line; append a TPU116 finding for every reason-less pragma.
+    Findings for other files pass through untouched. The concurrency
+    engine calls with emit_hygiene=False — TPU116 is emitted exactly
+    once, by the AST engine, which sees every file every run."""
+    cover = waived_lines(source)
+    out = []
+    for f in findings:
+        if f.path == relpath and (f.rule, f.line) in cover:
+            continue
+        out.append(f)
+    if emit_hygiene:
+        for w in scan(source):
+            if not w.reason:
+                out.append(Finding(
+                    "TPU116", relpath, w.line,
+                    f"waiver for {', '.join(sorted(w.rules))} has no "
+                    f"reason= — suppression must say why (like "
+                    f"--baseline)", ",".join(sorted(w.rules))))
+    return out
+
+
+def is_waived(relpath: str, source: str, finding: Finding) -> bool:
+    """One-finding form of `apply` for engines that filter inline."""
+    return (finding.path == relpath
+            and (finding.rule, finding.line) in waived_lines(source))
+
+
+from .registry import register  # noqa: E402  (registry entry below)
+
+
+@register("TPU116", "waiver-hygiene", "ast")
+def _doc_waiver_hygiene(*_a):
+    """An inline `# lint: allow(...)` pragma without `reason=` is
+    itself a finding — suppression is explicit and justified, exactly
+    like --baseline entries. Emitted by waivers.apply during the AST
+    pass."""
+    return []

@@ -296,6 +296,7 @@ class BatchDetector:
                     or self._ver_dev_rows < self._ver_count \
                     or self._ver_dev.shape[0] < u_pad:
                 snap = self._ver_snapshot_locked(u_pad)
+                # lint: allow(TPU111) reason=re-upload happens only when the pool outgrew the last transfer; the cached array and its row count must stay coherent under the lock
                 self._ver_dev = jax.device_put(snap)
                 self._ver_dev_rows = self._ver_count
                 LEDGER.note_resident("version_pool", snap.nbytes)

@@ -557,6 +557,7 @@ def serve_router(host: str, port: int, replicas,
     import signal
 
     def _on_signal(signum, frame):
+        # lint: allow(TPU112) reason=signal-time drain thread; the process is exiting and the drain ends by stopping the accept loop the main thread sits in
         threading.Thread(target=drain_router_then_shutdown,
                          args=(httpd, state, drain_grace_s),
                          name="router-drain", daemon=True).start()
@@ -592,6 +593,7 @@ def serve_router_background(host: str, port: int, replicas,
     state = RouterState(replicas, opts, probe=probe)
     handler = type("RouterHandler", (RouterHandler,), {"state": state})
     httpd = ThreadingHTTPServer((host, port), handler)
+    # lint: allow(TPU112) reason=serve loop exits when the caller runs httpd.shutdown() (documented caller-owned shutdown contract)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return httpd, state

@@ -137,6 +137,7 @@ class Interpreter:
                 self.trace("exit", ".".join(pkg + (name,)), self._depth)
 
     def _eval_rule_inner(self, key, pkg: tuple, name: str):
+        # lint: allow(TPU106) reason=runs under the query lock taken by Interpreter.query — an interprocedural hold the intraprocedural rule cannot see
         self.rule_cache[key] = UNDEF  # cycle guard
         defs = []
         for m in self.pkg_index.get(pkg, []):
@@ -148,6 +149,7 @@ class Interpreter:
         if any(r.args is not None for _, r in defs):
             fn = _UserFunction(self, [(m, r) for m, r in defs
                                       if r.args is not None])
+            # lint: allow(TPU106) reason=runs under the query lock taken by Interpreter.query — an interprocedural hold the intraprocedural rule cannot see
             self.rule_cache[key] = fn
             return fn
 
@@ -186,6 +188,7 @@ class Interpreter:
                     break
             if result is UNDEF:
                 result = default_val
+        # lint: allow(TPU106) reason=runs under the query lock taken by Interpreter.query — an interprocedural hold the intraprocedural rule cannot see
         self.rule_cache[key] = result
         return result
 
@@ -225,12 +228,14 @@ class Interpreter:
                             tgt[0] == "ref" and tgt[1] == ("var", "input")
                             and not tgt[2]):
                         for v, env in self.eval_term(val_t, env, mod):
+                            # lint: allow(TPU106) reason=runs under the query lock taken by Interpreter.query — an interprocedural hold the intraprocedural rule cannot see
                             self.input = v
                             break
                     # `with input.x as v` partial override
                     elif tgt[0] == "ref" and tgt[1] == ("var", "input"):
                         base = copy.deepcopy(self.input) \
                             if isinstance(self.input, (dict, list)) else {}
+                        # lint: allow(TPU106) reason=runs under the query lock taken by Interpreter.query — an interprocedural hold the intraprocedural rule cannot see
                         self.input = _override_path(
                             base, tgt[2], val_t, self, env, mod)
                     elif tgt[0] == "ref" and tgt[1] == ("var", "data"):
@@ -243,10 +248,13 @@ class Interpreter:
                 # materialize while the override is active; rule results
                 # computed under `with` must not leak into the cache
                 saved_cache = self.rule_cache
+                # lint: allow(TPU106) reason=runs under the query lock taken by Interpreter.query — an interprocedural hold the intraprocedural rule cannot see
                 self.rule_cache = {}
                 solutions = list(self._eval_one(node, env, mod))
+                # lint: allow(TPU106) reason=runs under the query lock taken by Interpreter.query — an interprocedural hold the intraprocedural rule cannot see
                 self.rule_cache = saved_cache
             finally:
+                # lint: allow(TPU106) reason=runs under the query lock taken by Interpreter.query — an interprocedural hold the intraprocedural rule cannot see
                 self.input = saved
                 self.base_data = saved_data
             for e2 in solutions:

@@ -585,6 +585,7 @@ class Profiler:
             if self._last_end and \
                     now - self._last_end < self.cooldown_s:
                 return
+        # lint: allow(TPU112) reason=one-shot capture bounded by auto_capture_ms; the busy/cooldown gates in capture() serialize overlapping fires
         threading.Thread(target=self._auto_capture, args=worst,
                          name="graftprof-auto", daemon=True).start()
 

@@ -341,6 +341,7 @@ class DeviceGuard:
         # started eagerly (not on first watch): tests that snapshot
         # the thread set must see the watchdog from import time, and a
         # daemon sleeping 250 ms between sweeps costs nothing
+        # lint: allow(TPU112) reason=process-lifetime watchdog daemon started at import by design; storm's no_leaked_threads baseline snapshots it
         self._thread = threading.Thread(
             target=self._run, name="graftguard-watchdog", daemon=True)
         self._thread.start()

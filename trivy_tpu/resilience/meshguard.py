@@ -412,6 +412,13 @@ class MeshGuard:
             if self._pending:
                 self._cv.notify()
 
+    def remove_rebuild(self, cb) -> None:
+        """Unregister a rebuild listener (server close path — a guard
+        shared across swaps must not call into a closed ServerState)."""
+        with self._cv:
+            if self._rebuild_cb is cb:
+                self._rebuild_cb = None
+
     def active_ids(self) -> list:
         with self._cv:
             return [i for i in self.all_ids if i not in self._lost]

@@ -127,6 +127,9 @@ class ServerState:
         self._mesh = None
         self._mesh_devices = []
         self._mesh_db_shards = 1
+        # the latest breaker-recovery rebuild thread; close() joins it
+        # (bounded) so a recovery swap can't outlive the server
+        self._recover_thread: threading.Thread | None = None
         # graftstream: when mesh_opts carries streaming knobs (or just
         # defaults — the auto budget comes off graftprof's hbm view),
         # every detector this state builds may stream the advisory
@@ -246,8 +249,11 @@ class ServerState:
                 return
         _log.warning("graftguard: device recovered; rebuilding "
                      "detector via swap_table")
-        threading.Thread(target=self._recover_swap,
-                         name="graftguard-recover", daemon=True).start()
+        t = threading.Thread(target=self._recover_swap,
+                             name="graftguard-recover", daemon=True)
+        with self._lock:
+            self._recover_thread = t
+        t.start()
 
     def _recover_swap(self) -> None:
         try:
@@ -334,7 +340,11 @@ class ServerState:
         if self.redetect is not None:
             self.redetect.close()
         if self.mesh_guard is not None:
+            self.mesh_guard.remove_rebuild(self._mesh_rebuild)
             self.mesh_guard.close()
+        t = self._recover_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10.0)
         scanner.close()
 
     # "keep the current value" sentinels: a DB hot swap keeps the
@@ -488,6 +498,7 @@ class ServerState:
                     "leaking its scanner workers", gen)
                 return
             scanner.close()
+        # lint: allow(TPU112) reason=detached by design so the swap path never blocks; the waiter self-bounds at 600s and then deliberately leaks the busy generation
         threading.Thread(target=waiter, name="swap-close",
                          daemon=True).start()
 
@@ -859,6 +870,7 @@ def install_drain_handlers(httpd, state, grace_s: float) -> bool:
     def _on_signal(signum, frame):
         # the handler must return immediately; the drain wait runs on
         # its own thread and ends by stopping the accept loop
+        # lint: allow(TPU112) reason=signal-time drain thread; the process is exiting and the drain ends by stopping the accept loop the main thread sits in
         threading.Thread(target=drain_then_shutdown,
                          args=(httpd, state, grace_s),
                          name="graceful-drain", daemon=True).start()
@@ -931,6 +943,7 @@ def serve_background(host: str, port: int, table, cache_dir: str,
                         redetect_opts=redetect_opts)
     handler = type("Handler", (Handler,), {"state": state})
     httpd = ThreadingHTTPServer((host, port), handler)
+    # lint: allow(TPU112) reason=serve loop exits when the caller runs httpd.shutdown() (documented caller-owned shutdown contract)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return httpd, state
