@@ -410,7 +410,7 @@ class StubReplica:
     healthy (the supervisor's probe target)."""
 
     def __init__(self, code=200, body=b"{}", retry_after=None,
-                 delay_s=0.0):
+                 delay_s=0.0, extra_headers=None):
         stub = self
 
         class H(BaseHTTPRequestHandler):
@@ -438,12 +438,15 @@ class StubReplica:
                 self.send_header("Content-Type", "application/json")
                 if stub.retry_after is not None:
                     self.send_header("Retry-After", stub.retry_after)
+                for k, v in stub.extra_headers.items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(stub.body)))
                 self.end_headers()
                 self.wfile.write(stub.body)
 
         self.code, self.body = code, body
         self.retry_after, self.delay_s = retry_after, delay_s
+        self.extra_headers = dict(extra_headers or {})
         self.hits = 0
         self.deadlines: list = []
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
@@ -586,6 +589,158 @@ class TestShedsAndDeadlines:
             state.close()
             bad.close()
             ok.close()
+
+
+# ---------------------------------------------------------------------------
+# graftcost: one merged X-Trivy-Cost across failover hops
+
+
+class TestCostHeaderAggregation:
+    def test_failover_merges_hop_costs_exactly_once(self):
+        """A shed hop and the hop that served both returned cost
+        headers: the client must see ONE X-Trivy-Cost covering both
+        hops exactly once (summed, hops=2), and the router's fleet
+        aggregator must fold the merged doc once under the final
+        outcome."""
+        from trivy_tpu.obs import cost
+        shed_doc = {"tenant": "acme", "queue_ms": 7.0,
+                    "service_ms": 1.0, "device_ms": 0,
+                    "transfer_bytes": 0, "host_ms": 0,
+                    "avoided_ms": 0, "hops": 1}
+        ok_doc = {"tenant": "acme", "queue_ms": 2.0,
+                  "service_ms": 5.0, "device_ms": 3.5,
+                  "transfer_bytes": 128, "host_ms": 0,
+                  "avoided_ms": 0, "hops": 1}
+        shed = StubReplica(
+            code=429, retry_after="1",
+            extra_headers={"X-Trivy-Cost": json.dumps(shed_doc)})
+        ok = StubReplica(
+            code=200, body=b'{"ok": true}',
+            extra_headers={"X-Trivy-Cost": json.dumps(ok_doc)})
+        router, state = serve_router_background(
+            "127.0.0.1", 0, [shed.url, ok.url], fast_router_opts())
+        base = f"http://127.0.0.1:{router.server_address[1]}"
+        try:
+            key = _key_owned_by(state.ring, shed.url)
+            req = urllib.request.Request(
+                base + "/twirp/trivy.scanner.v1.Scanner/Scan",
+                data=json.dumps({"artifact_id": key,
+                                 "blob_ids": [key]}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read()) == {"ok": True}
+                raws = r.headers.get_all("X-Trivy-Cost")
+            assert shed.hits == 1 and ok.hits == 1
+            assert raws is not None and len(raws) == 1
+            merged = cost.parse_cost_header(raws[0])
+            assert merged["tenant"] == "acme"
+            assert merged["hops"] == 2
+            assert merged["queue_ms"] == pytest.approx(9.0)
+            assert merged["service_ms"] == pytest.approx(6.0)
+            assert merged["device_ms"] == pytest.approx(3.5)
+            assert merged["transfer_bytes"] == 128
+            # the fleet aggregator folded the merged doc ONCE, under
+            # the final 2xx outcome
+            row = state.costs.table(include_system_live=False)["acme"]
+            assert row["scans"] == {"ok": 1}
+            assert row["device_ms"] == pytest.approx(3.5)
+            assert row["queue_ms"] == pytest.approx(9.0)
+            # the router /debug/costs surface is the fleet scope
+            doc = json.loads(urllib.request.urlopen(
+                base + "/debug/costs", timeout=10).read())
+            assert doc["scope"] == "fleet"
+            assert doc["tenants"]["acme"]["scans"] == {"ok": 1}
+        finally:
+            router.shutdown()
+            router.server_close()
+            state.close()
+            shed.close()
+            ok.close()
+
+    def test_terminal_shed_still_bills_the_hop(self):
+        """Even an all-shed walk relays the hops' summed cost header
+        with the shed outcome folded fleet-side."""
+        from trivy_tpu.obs import cost
+        doc = {"tenant": "busy", "queue_ms": 4.0, "service_ms": 0.5,
+               "device_ms": 0, "transfer_bytes": 0, "host_ms": 0,
+               "avoided_ms": 0, "hops": 1}
+        s1 = StubReplica(
+            code=429, retry_after="2",
+            body=b'{"code": "resource_exhausted"}',
+            extra_headers={"X-Trivy-Cost": json.dumps(doc)})
+        opts = fast_router_opts()
+        opts.retry = RetryPolicy(attempts=1, base_delay_s=0.01,
+                                 max_delay_s=0.02, budget_s=0.1)
+        router, state = serve_router_background(
+            "127.0.0.1", 0, [s1.url], opts)
+        base = f"http://127.0.0.1:{router.server_address[1]}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post(base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                     {"artifact_id": "sha256:0"})
+            assert e.value.code == 429
+            merged = cost.parse_cost_header(
+                e.value.headers.get("X-Trivy-Cost") or "")
+            assert merged is not None
+            assert merged["tenant"] == "busy"
+            assert merged["queue_ms"] == pytest.approx(4.0)
+            row = state.costs.table(
+                include_system_live=False)["busy"]
+            assert row["scans"] == {"shed": 1}
+        finally:
+            router.shutdown()
+            router.server_close()
+            state.close()
+            s1.close()
+
+
+# ---------------------------------------------------------------------------
+# skew-counter cardinality: rolling swaps must not mint N series
+
+
+class TestSkewLabelCardinality:
+    def test_rolling_swaps_fold_into_other(self):
+        """N distinct version pairs must NOT mint N scrape series:
+        past the label budget the `versions` label folds into
+        "other", while the full pair still reaches the flight
+        recorder on every flip."""
+        from trivy_tpu.fleet.router import (_SKEW_LABEL_BUDGET,
+                                            RouterState)
+        from trivy_tpu.obs import RECORDER
+
+        def label_values():
+            with METRICS._lock:
+                return {dict(labels).get("versions")
+                        for (name, labels) in METRICS._values
+                        if name ==
+                        "trivy_tpu_fleet_db_version_skew_total"}
+
+        before = label_values()
+        skew0 = METRICS.family_sum(
+            "trivy_tpu_fleet_db_version_skew_total")
+        st = RouterState(["http://a", "http://b"])
+        try:
+            st.note_db_version("http://a", "sha256:" + "a" * 60)
+            for i in range(30):
+                st.note_db_version(
+                    "http://b", f"sha256:roll{i:04d}" + "0" * 48)
+        finally:
+            st.close()
+        # every flip counted...
+        assert METRICS.family_sum(
+            "trivy_tpu_fleet_db_version_skew_total") == skew0 + 30
+        # ...but 30 swaps minted at most budget+1 new label values
+        new = label_values() - before
+        assert len(new) <= _SKEW_LABEL_BUDGET + 1
+        assert "other" in label_values()
+        # the recorder kept every full pair (nothing folded there)
+        evs = [e for e in RECORDER.events()
+               if e.get("kind") == "fleet_db_version_skew"
+               and "sha256:roll" in e.get("versions", "")]
+        assert len(evs) == 30
+        assert len({e["versions"] for e in evs}) == 30
+        assert all("|" in e["versions"] for e in evs)
 
 
 # ---------------------------------------------------------------------------

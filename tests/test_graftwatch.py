@@ -502,6 +502,13 @@ class TestIncidentDrill:
         live_hop = next(e for e in forwards if e["args"]["hop"] == 2)
         assert "error" in dead_hop["args"]
         assert live_hop["args"]["failover"] is True
+        # graftcost: the hop that served carries the billed cost doc's
+        # headline numbers as span attrs; the dead hop returned no
+        # response, so it has nothing to bill
+        assert live_hop["args"]["cost_tenant"] == "default"
+        assert live_hop["args"]["cost_device_ms"] >= 0
+        assert "cost_queue_ms" in live_hop["args"]
+        assert "cost_tenant" not in dead_hop["args"]
         assert any(e["name"] == "detect.host_join" for e in events)
         # the dump validates offline, and the failover pinned the trace
         dump = tmp_path / "routed.trace.json"

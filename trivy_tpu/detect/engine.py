@@ -33,6 +33,7 @@ from ..db.table import AdvisoryTable
 from ..log import get as _get_logger
 from ..metrics import METRICS
 from ..obs import SLO, note_dispatch, recording, span
+from ..obs import cost as _cost
 from ..obs.perf import LEDGER, stamp_table_resident
 from ..ops import bucket_ladder, bucket_size
 from ..ops import join as J
@@ -532,21 +533,27 @@ class BatchDetector:
         # a degraded-mode scan's time must be attributable, and the
         # incident drill asserts the fallback is VISIBLE in the
         # assembled trace, not inferred from a counter
-        with span("detect.host_join", n_pairs=total, t_pad=t_pad):
-            ver = self.ver_snapshot()
-            t = self.table
-            if h_cap:
-                hit_idx, hit_bits, n_hits, bits = \
-                    host_csr_pair_join_compact(
-                        t.lo_tok, t.hi_tok, t.flags, ver, q_start,
-                        q_count, q_ver, total, t_pad, h_cap)
-                if n_hits <= h_cap:
-                    return CompactBits(hit_idx[:n_hits],
-                                       hit_bits[:n_hits], t_pad)
-                return bits
-            return host_csr_pair_join(t.lo_tok, t.hi_tok, t.flags,
-                                      ver, q_start, q_count, q_ver,
-                                      total, t_pad)
+        t0 = time.perf_counter()
+        try:
+            with span("detect.host_join", n_pairs=total, t_pad=t_pad):
+                ver = self.ver_snapshot()
+                t = self.table
+                if h_cap:
+                    hit_idx, hit_bits, n_hits, bits = \
+                        host_csr_pair_join_compact(
+                            t.lo_tok, t.hi_tok, t.flags, ver, q_start,
+                            q_count, q_ver, total, t_pad, h_cap)
+                    if n_hits <= h_cap:
+                        return CompactBits(hit_idx[:n_hits],
+                                           hit_bits[:n_hits], t_pad)
+                    return bits
+                return host_csr_pair_join(t.lo_tok, t.hi_tok, t.flags,
+                                          ver, q_start, q_count, q_ver,
+                                          total, t_pad)
+        finally:
+            # graftcost: degraded-mode joins bill host CPU ms (not
+            # device ms), apportioned like the dispatch they replaced
+            _cost.charge_host_ms((time.perf_counter() - t0) * 1e3)
 
     def _host_bits(self, prep: _Prepared) -> np.ndarray:
         """Host fallback from an already-expanded prep (used when the
@@ -559,6 +566,7 @@ class BatchDetector:
         per-prep counting would overstate a single fetch failure by
         the coalesce factor and fire false burn-rate pages."""
         METRICS.inc("trivy_tpu_fallback_joins_total")
+        t0 = time.perf_counter()
         with span("detect.host_join", n_pairs=prep.n_pairs):
             ver = self.ver_snapshot()
             t = self.table
@@ -568,7 +576,8 @@ class BatchDetector:
             bits[:n] = host_pair_join(
                 t.lo_tok, t.hi_tok, t.flags, ver,
                 prep.pair_row[:n], prep.pair_ver[:n], np.ones(n, bool))
-            return bits
+        _cost.charge_host_ms((time.perf_counter() - t0) * 1e3)
+        return bits
 
     def _launch(self, q_start: np.ndarray, q_count: np.ndarray,
                 q_ver: np.ndarray, total: int, t_pad: int, u_pad: int,
@@ -604,6 +613,7 @@ class BatchDetector:
                                        t_pad, h_cap)
         import jax
         try:
+            t_watch = time.perf_counter()
             # the table/version-pool uploads live INSIDE the watch: on
             # a dead backend device_put is exactly where the failure
             # surfaces, and an unrecorded probe failure would wedge
@@ -654,7 +664,15 @@ class BatchDetector:
                 self._account_traffic(total, t_pad, warm=warm)
                 LEDGER.note_dispatch(site, total, t_pad, h_cap,
                                      warm=warm)
-                return out
+            # graftcost: the supervised launch region (uploads +
+            # trace/compile + dispatch enqueue) is device-path wall
+            # ms, apportioned by the context's share vector. Warm and
+            # first-of-shape launches skip the EWMA feed — a compile's
+            # ms-per-row is not an exchange rate
+            _cost.charge_device_ms(
+                site, (time.perf_counter() - t_watch) * 1e3,
+                real_rows=0 if (warm or new_shape) else total)
+            return out
         except DeviceError:
             # logged with the chained traceback: the first
             # fail_threshold-1 failures would otherwise be invisible,
@@ -680,10 +698,15 @@ class BatchDetector:
             return dev
         import jax
         if isinstance(dev, _PendingCompact):
+            t0 = time.perf_counter()
             with GUARD.watch("detect.device_get"):
                 failpoint("detect.device_get")
                 hit_idx, hit_bits, n_hits = jax.device_get(
                     (dev.hit_idx, dev.hit_bits, dev.n_hits))
+            # the fetch is the launch's sync point: its wall time is
+            # compute + transfer, billed to the same site/shares
+            _cost.charge_device_ms(
+                dev.site, (time.perf_counter() - t0) * 1e3)
             n = int(n_hits)
             self._note_hits(n, dev.h_cap, site=dev.site,
                             t_pad=dev.t_pad)
@@ -691,27 +714,33 @@ class BatchDetector:
                                   + n_hits.nbytes)
             METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
                         compact_bytes, path="compact")
-            LEDGER.note_transfer("compact", compact_bytes)
+            _cost.ledgered_transfer("compact", compact_bytes)
             if n > dev.h_cap:
                 # overflow: the buffer holds only a prefix of the
                 # hits — this dispatch pays the dense fetch instead
                 # (the budget already doubled for the next one)
+                t0 = time.perf_counter()
                 with GUARD.watch("detect.device_get"):
                     bits = jax.device_get(dev.dense)
+                _cost.charge_device_ms(
+                    dev.site, (time.perf_counter() - t0) * 1e3)
                 METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
                             float(bits.nbytes), path="dense")
                 # ledger path "overflow": same bytes as a dense fetch,
                 # but distinguishable — this transfer was paid ON TOP
                 # of the wasted compact one
-                LEDGER.note_transfer("overflow", float(bits.nbytes))
+                _cost.ledgered_transfer("overflow", float(bits.nbytes))
                 return bits
             return CompactBits(hit_idx[:n], hit_bits[:n], dev.t_pad)
+        t0 = time.perf_counter()
         with GUARD.watch("detect.device_get"):
             failpoint("detect.device_get")
             out = jax.device_get(dev)
+        _cost.charge_device_ms("detect",
+                               (time.perf_counter() - t0) * 1e3)
         METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
                     float(out.nbytes), path="dense")
-        LEDGER.note_transfer("dense", float(out.nbytes))
+        _cost.ledgered_transfer("dense", float(out.nbytes))
         return out
 
     def _fetch_or_fallback(self, prep: _Prepared, dev) -> np.ndarray:

@@ -51,6 +51,7 @@ from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass
 
 from ..metrics import METRICS
+from ..obs import cost as _cost
 from ..obs import current_trace_id, span
 from .engine import BatchDetector, Hit, PkgQuery, slice_bits
 
@@ -74,7 +75,8 @@ class _Request:
     note)."""
 
     __slots__ = ("future", "results", "slots", "n_pairs", "_lock",
-                 "_remaining", "ctx", "trace_id")
+                 "_remaining", "ctx", "trace_id", "cost", "t_submit",
+                 "queue_charged")
 
     def __init__(self, n_slots: int):
         self.future: Future = Future()
@@ -90,6 +92,14 @@ class _Request:
         # dispatch span's attrs for cross-request attribution
         self.ctx = contextvars.copy_context()
         self.trace_id = current_trace_id()
+        # graftcost: the submitting request's ledger (None outside a
+        # request → the merged dispatch bills that share to SYSTEM);
+        # submit→first-dispatch wall time is the coalesce-window
+        # queue-ms charge, taken once per request even when its slots
+        # split across chunks
+        self.cost = _cost.active()
+        self.t_submit = time.perf_counter()
+        self.queue_charged = False
 
     def arm(self) -> None:
         with self._lock:
@@ -313,6 +323,28 @@ class DispatchScheduler:
                            if r.trace_id})
             dispatch_ctx = req0.ctx.run(contextvars.copy_context)
             fetch_ctx = req0.ctx.run(contextvars.copy_context)
+            # graftcost: time parked between submit and first dispatch
+            # is queue ms (charged once per request), and the merged
+            # launch's device ms / transfer bytes apportion pro-rata
+            # by each request's real pair share — install the share
+            # vector into BOTH contexts the round runs under
+            # (Context.run mutations persist in the Context object)
+            now = time.perf_counter()
+            per_req: dict[int, int] = {}
+            for r, _, p in chunk:
+                per_req[id(r)] = per_req.get(id(r), 0) + p.n_pairs
+                if not r.queue_charged:
+                    r.queue_charged = True
+                    _cost.charge_queue_ms((now - r.t_submit) * 1e3,
+                                          ledger=r.cost)
+            seen: set[int] = set()
+            shares = []
+            for r, _, _p in chunk:
+                if id(r) not in seen:
+                    seen.add(id(r))
+                    shares.append((r.cost, per_req[id(r)]))
+            dispatch_ctx.run(_cost.install_shares, shares)
+            fetch_ctx.run(_cost.install_shares, shares)
 
             def _dispatch():
                 with span("detectd.round", merged=n_req,

@@ -58,6 +58,7 @@ import io
 import json
 import tarfile
 import threading
+import time
 import zlib
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
 from concurrent.futures import wait as _fut_wait
@@ -65,6 +66,7 @@ from dataclasses import dataclass
 
 from ..log import get as _get_logger
 from ..metrics import METRICS
+from ..obs import cost as _cost
 from ..obs import span
 from ..resilience import (GUARD, BreakerRegistry, DeviceError,
                           DeviceTimeout, failpoint)
@@ -843,6 +845,7 @@ class IngestPipeline:
             INGEST.walker_busy(+1)
             st = _LayerState()
             deadline = Deadline(opts.layer_deadline_ms / 1e3)
+            t_walk = time.perf_counter()
             try:
                 with span("fanal.layer_walk", layer=task.idx,
                           diff_id=task.diff_id, pipelined=True) as sp:
@@ -883,6 +886,13 @@ class IngestPipeline:
             finally:
                 INGEST.walker_busy(-1)
                 self.spool.release(st)
+                # graftcost: one layer's ingest bill — decompressed
+                # bytes actually read plus the walker's wall ms
+                # (context-propagated, so it lands on the requesting
+                # tenant's ledger)
+                _cost.charge_ingest(
+                    float(st.layer_bytes),
+                    (time.perf_counter() - t_walk) * 1e3)
             if st.integrity_error is not None:
                 # digest mismatch surfaced OUTSIDE the watch: it must
                 # propagate (tampered bytes never cache) WITHOUT
@@ -1115,6 +1125,7 @@ class IngestPipeline:
         br = INGEST.breaker("analyze")
         results: dict = {}
         errors: list = []
+        t_batch = time.perf_counter()
         try:
             if not br.allow():
                 errors.append(ingest_error(
@@ -1156,6 +1167,10 @@ class IngestPipeline:
             METRICS.gauge_add("trivy_tpu_ingest_analyze_depth", -1.0)
             for _seq, _p, _c, sz in items:
                 self.budget.release(sz)
+            # analyzer wall ms joins the same per-tenant ingest bill
+            # as the walker's (bytes were charged at the walk)
+            _cost.charge_ingest(
+                0.0, (time.perf_counter() - t_batch) * 1e3)
             self._note_progress()
 
     # ---- layer finalize ------------------------------------------------

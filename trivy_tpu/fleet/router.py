@@ -48,12 +48,14 @@ from ..log import get as _get_logger
 from ..metrics import METRICS
 from ..obs import (RECORDER, current_span_id, current_trace_id,
                    new_trace, span)
+from ..obs import cost as _cost
 from ..obs.recorder import (debug_incidents_payload,
                             debug_traces_payload)
 from ..resilience import Deadline, FailpointError, RetryPolicy, failpoint
-from ..server import (DB_VERSION_HEADER, DEADLINE_HEADER,
+from ..server import (COST_HEADER, DB_VERSION_HEADER, DEADLINE_HEADER,
                       PARENT_SPAN_HEADER, REPLICA_HEADER,
-                      ROUTE_DESCRIPTORS, TOKEN_HEADER, TRACE_HEADER)
+                      ROUTE_DESCRIPTORS, TENANT_HEADER, TOKEN_HEADER,
+                      TRACE_HEADER)
 from .ring import HashRing
 from .supervisor import ReplicaOptions, ReplicaSet
 
@@ -61,13 +63,25 @@ _log = _get_logger("fleet.router")
 
 # request headers forwarded verbatim to the replica (the deadline
 # header is re-stamped with the remaining budget, and the trace /
-# parent-span headers are stamped per forward from the active span)
-_FORWARD_HEADERS = ("Content-Type", TOKEN_HEADER)
+# parent-span headers are stamped per forward from the active span);
+# tenant identity rides every hop so each replica's cost ledger and
+# tenant series attribute to the ORIGINAL caller, not to the router
+_FORWARD_HEADERS = ("Content-Type", TOKEN_HEADER, TENANT_HEADER)
 # replica response headers relayed back to the client (db version
 # included: the client sees WHICH advisory DB answered, and the router
-# reads the same header to count mid-rollout version skew)
+# reads the same header to count mid-rollout version skew). The
+# replica's X-Trivy-Cost is deliberately NOT here: the router collects
+# every hop's cost doc — failed and shed hops included — and stamps
+# ONE merged header, so a failover's client still sees the whole bill
+# exactly once
 _RELAY_HEADERS = ("Content-Type", "Retry-After", TRACE_HEADER,
                   DB_VERSION_HEADER)
+
+# bounded cardinality for the db-version-skew counter's `versions`
+# label (the PR 13 profile-reason clamp): the first K distinct
+# version pairs get their own series, later pairs fold into "other" —
+# the full pair still lands in the warn log and the incident recorder
+_SKEW_LABEL_BUDGET = 8
 
 
 @dataclass
@@ -108,6 +122,13 @@ class RouterState:
         # relays + readmission probes feed this; disagreement = a
         # mid-rollout fleet whose failovers are not bit-identical)
         self._db_versions: dict[str, str] = {}
+        # graftcost: the router's OWN tenant aggregator, fed from the
+        # cost headers the replicas relay — a separate instance from
+        # the process-global TENANTS so an in-process fleet (tests,
+        # bench) never double-counts a scan the replica already settled
+        self.costs = _cost.TenantAggregator()
+        # skew-label clamp state (see _SKEW_LABEL_BUDGET)
+        self._skew_labels: set[str] = set()
         self._draining = False
         self._inflight = 0
         self.supervisor = ReplicaSet(
@@ -133,11 +154,24 @@ class RouterState:
             # label with WHICH versions disagree (sorted short
             # digests): a rolling upgrade reads as one transient pair,
             # a split brain as the same pair climbing forever — the
-            # unlabeled rate alone cannot tell them apart
-            METRICS.inc(
-                "trivy_tpu_fleet_db_version_skew_total",
-                versions="|".join(sorted(
-                    v[:19] for v in set(snap.values()))))
+            # unlabeled rate alone cannot tell them apart. The label
+            # set is CLAMPED: a fleet churning through N rolling swaps
+            # must not mint N scrape series (unbounded cardinality),
+            # so pairs past the budget fold into "other" while the
+            # full pair always reaches the log + incident recorder
+            pair = "|".join(sorted(
+                v[:19] for v in set(snap.values())))
+            with self._lock:
+                if pair in self._skew_labels or \
+                        len(self._skew_labels) < _SKEW_LABEL_BUDGET:
+                    self._skew_labels.add(pair)
+                    label = pair
+                else:
+                    label = "other"
+            METRICS.inc("trivy_tpu_fleet_db_version_skew_total",
+                        versions=label)
+            RECORDER.note_event("fleet_db_version_skew",
+                                replica=replica, versions=pair)
             _log.warning(
                 "fleet: advisory-DB version skew — replicas disagree "
                 "(%s); failovers are NOT bit-identical until the "
@@ -193,6 +227,10 @@ class RouterState:
                 "db_versions": self.db_versions(),
                 "failovers_total": int(
                     METRICS.get("trivy_tpu_fleet_failovers_total")),
+                # graftcost fleet view: per-tenant scan counts and
+                # cost split summed from relayed X-Trivy-Cost headers
+                "tenants": self.costs.healthz_block(
+                    include_system_live=False),
             },
         }
 
@@ -237,13 +275,19 @@ class RouterHandler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(payload).encode(),
                    {"Content-Type": "application/json"})
 
-    def _relay(self, resp) -> None:
+    def _relay(self, resp, cost_doc: dict | None = None) -> None:
         code, headers, body, replica = resp
         out = {k: headers[k] for k in _RELAY_HEADERS if headers.get(k)}
         if replica:
             # which replica actually answered — failovers make the
             # ring owner a guess; debugging needs the fact
             out[REPLICA_HEADER] = replica
+        if cost_doc is not None:
+            # ONE merged cost header per request: every hop's doc
+            # (failed and shed forwards included) summed, never the
+            # final replica's alone and never the same hop twice
+            out[COST_HEADER] = json.dumps(cost_doc,
+                                          separators=(",", ":"))
         self._send(code, body, out)
 
     # ---- GET surface ---------------------------------------------------
@@ -251,11 +295,23 @@ class RouterHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         self._trace_id = ""  # never echo a previous POST's id
         if self.path.startswith(("/debug/traces", "/debug/incidents",
-                                 "/debug/perf", "/debug/profile")):
+                                 "/debug/perf", "/debug/profile",
+                                 "/debug/costs")):
             token = self.state.opts.token
             if token and self.headers.get(TOKEN_HEADER) != token:
                 return self._json(401, {"code": "unauthenticated",
                                         "msg": "invalid token"})
+            if self.path.startswith("/debug/costs"):
+                # fleet-wide tenant attribution, built purely from the
+                # cost headers the replicas relayed — no conservation
+                # block (the router dispatches nothing; reconciliation
+                # lives on each replica's own /debug/costs)
+                return self._json(200, {
+                    "schema": _cost.COSTS_SCHEMA,
+                    "scope": "fleet",
+                    "tenants": self.state.costs.table(
+                        include_system_live=False),
+                })
             if self.path.startswith("/debug/traces"):
                 self._json(200, debug_traces_payload(self.path))
             elif self.path.startswith("/debug/perf"):
@@ -355,12 +411,27 @@ class RouterHandler(BaseHTTPRequestHandler):
                 pass   # unparseable header: no deadline
         fwd = {k: self.headers[k] for k in _FORWARD_HEADERS
                if self.headers.get(k)}
+        # per-hop cost docs accumulate across failover hops AND retry
+        # rounds — each forward that did work (served, shed, errored
+        # with a ledger) appends exactly one doc
+        hop_costs: list[dict] = []
         resp = self._route(route_key(self.path, req), body, fwd,
-                           deadline)
-        self._relay(resp)
+                           deadline, hop_costs)
+        doc = None
+        if hop_costs:
+            doc = _cost.merge_cost_docs(hop_costs)
+            st = self.state
+            code = resp[0]
+            outcome = ("ok" if code < 400
+                       else "shed" if code in (429, 503) else "error")
+            # fleet-wide attribution from relayed headers only (no
+            # re-export of the tenant series — the replicas already
+            # settled these scans into their own metrics)
+            st.costs.fold_doc(doc, outcome=outcome)
+        self._relay(resp, cost_doc=doc)
 
     def _route(self, key: str, body: bytes, fwd_headers: dict,
-               deadline: Deadline):
+               deadline: Deadline, hop_costs: list | None = None):
         """→ (status, headers, body, replica) to relay. Walks the
         ring's failover order under the RetryPolicy; every decision is
         bounded by the client's deadline."""
@@ -368,10 +439,12 @@ class RouterHandler(BaseHTTPRequestHandler):
         # forwards beyond a request's first are failovers, counted
         # across retry rounds (the counter the bench scenario reads)
         forwards = [0]
+        if hop_costs is None:
+            hop_costs = []
 
         def attempt():
             return self._walk_ring(key, body, fwd_headers, deadline,
-                                   forwards)
+                                   forwards, hop_costs)
 
         def should_retry(e):
             if isinstance(e, _Unrouted) \
@@ -404,9 +477,22 @@ class RouterHandler(BaseHTTPRequestHandler):
                                    "a replica answered"}).encode(),
                 None)
 
-    def _walk_ring(self, key, body, fwd_headers, deadline, forwards):
+    def _walk_ring(self, key, body, fwd_headers, deadline, forwards,
+                   hop_costs):
         """One pass over the failover order. Returns a relayable
         response or raises _Unrouted."""
+
+        def _note_cost(sp, raw) -> None:
+            doc = _cost.parse_cost_header(raw or "")
+            if doc is not None:
+                hop_costs.append(doc)
+                # cost attrs on the hop span: the assembled routed
+                # trace (the golden-fixture drill) shows what each
+                # hop billed, failed and shed hops included
+                sp.attrs["cost_tenant"] = doc.get("tenant", "default")
+                sp.attrs["cost_device_ms"] = doc.get("device_ms", 0)
+                sp.attrs["cost_queue_ms"] = doc.get("queue_ms", 0)
+
         st = self.state
         shed = None
         shed_floor = float("inf")
@@ -446,6 +532,10 @@ class RouterHandler(BaseHTTPRequestHandler):
                     resp_body = e.read()
                     headers = {k: e.headers[k] for k in _RELAY_HEADERS
                                if e.headers.get(k)}
+                    # a shed or failed hop still billed its tenant
+                    # (queue ms, partial work) — its cost doc joins
+                    # the merged header like any serving hop's
+                    _note_cost(sp, e.headers.get(COST_HEADER))
                     sp.attrs["status"] = e.code
                     if e.code in (429, 503):
                         # admission shed: healthy-but-busy, not a
@@ -482,6 +572,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                                  "failing over", replica, e)
                     continue
                 sp.attrs["status"] = resp[0]
+                _note_cost(sp, resp[1].get(COST_HEADER))
                 st.supervisor.record_success(replica)
                 # skew watch: which advisory DB answered this forward
                 # (failover hops included — a failover onto a replica

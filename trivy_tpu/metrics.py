@@ -333,7 +333,10 @@ METRICS.declare(
     "not bit-identical. The versions label names the disagreeing "
     "digests (sorted, truncated, |-joined), so a rolling upgrade's "
     "transient skew is distinguishable from a split-brain pair that "
-    "never converges.")
+    "never converges. Label cardinality is CLAMPED (top-K pairs + "
+    "\"other\"): a fleet churning through N swaps mints at most K+1 "
+    "series; the full pair always reaches the warn log and the "
+    "incident recorder.")
 METRICS.declare(
     "trivy_tpu_fleet_cache_hits_total", "counter",
     "Layer-cache blob hits by backend (backend=\"fs\"/\"redis\"/"
@@ -350,7 +353,9 @@ METRICS.declare(
     "graftwatch SLO engine: error-budget burn rate per objective and "
     "sliding window (1.0 = burning exactly at the budget-exhausting "
     "rate; labels objective=\"scan_latency_p99\"/\"scan_errors\"/"
-    "\"device_serving\", window=\"<seconds>s\").")
+    "\"device_serving\", window=\"<seconds>s\"; graftcost adds "
+    "tenant-labeled scan_latency_p99 series for the clamped top-K "
+    "tenants).")
 METRICS.declare(
     "trivy_tpu_device_serving_ratio", "gauge",
     "Fraction of join dispatches served by the device path (vs the "
@@ -515,3 +520,31 @@ METRICS.declare(
     "rule regex then confirmed with a finding, divided by candidates "
     "flagged — the regex yield of the exact keyword gate.",
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0))
+METRICS.declare(
+    "trivy_tpu_tenant_device_ms_total", "counter",
+    "graftcost: device wall ms attributed per tenant (merged "
+    "dispatches apportion pro-rata by real pair share; "
+    "tenant=\"system\" absorbs warmup, blameless redetect, and probe "
+    "work; label space is top-K-plus-\"other\" clamped).")
+METRICS.declare(
+    "trivy_tpu_tenant_transfer_bytes_total", "counter",
+    "graftcost: conserved device->host result bytes "
+    "(compact/dense/overflow paths) attributed per tenant — "
+    "reconciles with trivy_tpu_device_transfer_bytes_total under the "
+    "cost-conservation contract.")
+METRICS.declare(
+    "trivy_tpu_tenant_queue_ms", "histogram",
+    "graftcost: per-request queue ms by tenant (admission-queue wait "
+    "plus detectd coalesce-window wait) — time a request was PARKED, "
+    "distinct from service ms.",
+    buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+             500.0, 1000.0, 5000.0))
+METRICS.declare(
+    "trivy_tpu_tenant_scans_total", "counter",
+    "graftcost: settled Scan RPCs by tenant and outcome "
+    "(outcome=\"ok\"/\"error\"/\"shed\").")
+METRICS.declare(
+    "trivy_tpu_tenant_work_avoided_ms_total", "counter",
+    "graftcost: estimated device ms the memo/cache layer saved per "
+    "tenant (replayed units priced at the EWMA device-ms-per-row "
+    "exchange rate; an estimate — excluded from conservation).")

@@ -20,7 +20,11 @@ This validator checks them offline, with no server running:
     embedded incident document when one was captured;
   * graftprof live-capture manifests (trivy-tpu-profile/1): the
     reason/timing fields and a non-empty artifact file list — an
-    empty capture is a profile that profiled nothing.
+    empty capture is a profile that profiled nothing;
+  * graftcost documents (trivy-tpu-costs/1): /debug/costs bodies and
+    the merged fleet doc `obs.collect --costs` assembles — the tenant
+    table's numeric totals, the scans outcome map, and the
+    conservation block's ledger/attributed/ok triples.
 
 Wired into tier-1 alongside graftlint (tests/test_graftwatch.py runs
 it over freshly produced incidents and trace dumps, plus corrupted
@@ -210,6 +214,14 @@ def check_storm_replay(doc: dict) -> list[str]:
         for field in ("requests", "concurrency", "load_seed"):
             if not isinstance(load.get(field), int):
                 problems.append(f"load: missing {field}")
+        # tenants is optional (older replays predate the graftcost
+        # tenant-mix knob); when present it must be a positive int or
+        # --replay cannot reproduce the recorded tenant round-robin
+        if "tenants" in load and (
+                not isinstance(load["tenants"], int)
+                or load["tenants"] < 1):
+            problems.append(
+                f"load: bad tenants {load['tenants']!r}")
     if not isinstance(doc.get("violations"), dict):
         problems.append("missing violations map")
     incident = doc.get("incident")
@@ -249,6 +261,72 @@ def check_profile(doc: dict) -> list[str]:
     return problems
 
 
+def check_costs(doc: dict) -> list[str]:
+    """Validate one graftcost document (trivy-tpu-costs/1): a server's
+    /debug/costs body, the router's fleet-scope table, or the merged
+    fleet doc `obs.collect --costs` assembles. The tenant table is the
+    contract: every row carries the numeric totals fields plus a scans
+    outcome map; the optional conservation block carries the
+    ledger/attributed/ok triple per axis."""
+    problems: list[str] = []
+    if doc.get("schema") != "trivy-tpu-costs/1":
+        problems.append(f"unknown costs schema {doc.get('schema')!r}")
+    tenants = doc.get("tenants")
+    if not isinstance(tenants, dict):
+        problems.append("missing tenants table")
+    else:
+        for tenant, row in tenants.items():
+            if not isinstance(row, dict):
+                problems.append(f"tenants[{tenant}]: not an object")
+                continue
+            for field in ("queue_ms", "service_ms", "device_ms",
+                          "transfer_bytes", "host_ms", "ingest_bytes",
+                          "ingest_ms", "secret_bytes", "avoided_ms"):
+                v = row.get(field)
+                if not isinstance(v, (int, float)) or v < 0:
+                    problems.append(
+                        f"tenants[{tenant}]: bad {field} {v!r}")
+            scans = row.get("scans")
+            if not isinstance(scans, dict) or not all(
+                    isinstance(n, int) for n in scans.values()):
+                problems.append(
+                    f"tenants[{tenant}]: malformed scans map")
+    conservation = doc.get("conservation")
+    if conservation is not None:
+        if not isinstance(conservation, dict):
+            problems.append("conservation is not an object")
+        else:
+            for axis in ("device_ms", "transfer_bytes"):
+                rec = conservation.get(axis)
+                if not isinstance(rec, dict):
+                    problems.append(f"conservation: missing {axis}")
+                    continue
+                for field in ("ledger", "attributed"):
+                    if not isinstance(rec.get(field), (int, float)):
+                        problems.append(
+                            f"conservation[{axis}]: bad {field} "
+                            f"{rec.get(field)!r}")
+                if not isinstance(rec.get("ok"), bool):
+                    problems.append(
+                        f"conservation[{axis}]: missing ok verdict")
+    # fleet-merged docs carry per-source fragments; each must itself
+    # be a costs doc (or an unreachable-process error stub)
+    sources = doc.get("sources")
+    if sources is not None:
+        if not isinstance(sources, list):
+            problems.append("sources is not a list")
+        else:
+            for i, frag in enumerate(sources):
+                if not isinstance(frag, dict):
+                    problems.append(f"sources[{i}]: not an object")
+                    continue
+                if frag.get("error"):
+                    continue   # unreachable process, recorded as such
+                problems += [f"sources[{i}]: {p}"
+                             for p in check_costs(frag)]
+    return problems
+
+
 def check_file(path: str) -> list[str]:
     """Validate one file, auto-detecting its kind by content."""
     try:
@@ -264,6 +342,8 @@ def check_file(path: str) -> list[str]:
         return check_storm_replay(doc)
     if doc.get("schema", "").startswith("trivy-tpu-profile"):
         return check_profile(doc)
+    if doc.get("schema", "").startswith("trivy-tpu-costs"):
+        return check_costs(doc)
     if "schema" in doc or "reason" in doc:
         return check_incident(doc)
     return ["neither a trace dump (traceEvents), an incident file "

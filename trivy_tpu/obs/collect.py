@@ -25,6 +25,16 @@ Cross-process rules:
 replica set, so `python -m trivy_tpu.obs.collect --router URL
 --trace-id ID -o FILE` (and `router --trace FILE` on shutdown) need
 only the router address.
+
+`--costs` switches the sweep to graftcost: it pulls every process's
+token-gated /debug/costs, sums the REPLICA tenant tables into one
+fleet-wide trivy-tpu-costs/1 document (the router's own fleet-scope
+table is kept as a source fragment but excluded from the merge — it
+aggregates the same relayed headers the replicas attributed locally,
+and summing both would double-count), and folds the replicas'
+conservation blocks into one fleet verdict. `--perf` additionally
+embeds each process's /debug/perf dispatch-ledger fragment (implies
+`--costs`). `obs.check` validates the result offline.
 """
 
 from __future__ import annotations
@@ -131,6 +141,97 @@ def collect_trace(router_url: str, trace_id: str | None = None,
     return assemble(fetch_fragments(urls, trace_id, timeout))
 
 
+def fetch_debug(base_url: str, endpoint: str, token: str = "",
+                timeout: float = 5.0) -> dict:
+    """GET one process's token-gated /debug/<endpoint> payload."""
+    req = urllib.request.Request(
+        base_url.rstrip("/") + "/debug/" + endpoint,
+        headers={"Trivy-Token": token} if token else {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _merge_tenant_tables(tables: list[dict]) -> dict:
+    """Sum per-tenant totals rows across replica tables: numeric
+    fields add, scans outcome maps add per outcome."""
+    out: dict = {}
+    for table in tables:
+        for tenant, row in table.items():
+            if not isinstance(row, dict):
+                continue
+            dst = out.setdefault(tenant, {"scans": {}})
+            for field, v in row.items():
+                if field == "scans":
+                    for outcome, n in (v or {}).items():
+                        dst["scans"][outcome] = \
+                            dst["scans"].get(outcome, 0) + int(n)
+                elif isinstance(v, (int, float)):
+                    dst[field] = round(dst.get(field, 0) + v, 3)
+    return out
+
+
+def collect_costs(router_url: str, token: str = "",
+                  timeout: float = 5.0, urls=None,
+                  with_perf: bool = False) -> dict:
+    """Discover the fleet behind `router_url` (or use explicit
+    `urls`), pull every /debug/costs, and assemble one fleet-wide
+    trivy-tpu-costs/1 document. Replica tenant tables merge; the
+    router's fleet-scope table stays a source fragment only (it
+    re-aggregates the replicas' relayed headers). Conservation folds
+    across replica fragments: sums per axis, verdict ANDed — one
+    leaking replica fails the fleet."""
+    if urls is None:
+        urls = discover(router_url, timeout)
+    sources: list[dict] = []
+    replica_tables: list[dict] = []
+    cons_sum: dict = {}
+    cons_seen = False
+    for url in urls:
+        try:
+            frag = fetch_debug(url, "costs", token, timeout)
+        except Exception as e:  # noqa: BLE001 — best-effort sweep
+            sources.append({"url": url, "error": str(e)})
+            continue
+        frag["url"] = url
+        sources.append(frag)
+        if frag.get("scope") == "fleet":
+            continue   # the router re-aggregates replica headers
+        if isinstance(frag.get("tenants"), dict):
+            replica_tables.append(frag["tenants"])
+        cons = frag.get("conservation")
+        if isinstance(cons, dict):
+            cons_seen = True
+            for axis in ("device_ms", "transfer_bytes"):
+                rec = cons.get(axis) or {}
+                dst = cons_sum.setdefault(
+                    axis, {"ledger": 0, "attributed": 0, "ok": True})
+                dst["ledger"] = round(
+                    dst["ledger"] + rec.get("ledger", 0), 3)
+                dst["attributed"] = round(
+                    dst["attributed"] + rec.get("attributed", 0), 3)
+                dst["ok"] = bool(dst["ok"] and rec.get("ok", False))
+    doc = {
+        "schema": "trivy-tpu-costs/1",
+        "scope": "fleet-merged",
+        "tenants": _merge_tenant_tables(replica_tables),
+        "sources": sources,
+    }
+    if cons_seen:
+        doc["conservation"] = cons_sum
+    if with_perf:
+        perf = []
+        for url in urls:
+            try:
+                frag = fetch_debug(url, "perf", token, timeout)
+            except Exception as e:  # noqa: BLE001
+                perf.append({"url": url, "error": str(e)})
+                continue
+            frag["url"] = url
+            perf.append(frag)
+        doc["perf"] = perf
+    return doc
+
+
 def write_trace(path: str, doc: dict) -> None:
     import os
     tmp = path + ".tmp"
@@ -158,8 +259,25 @@ def main(argv=None) -> int:
                     help="output trace file (Perfetto / "
                          "chrome://tracing)")
     ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--costs", action="store_true",
+                    help="assemble one fleet-wide trivy-tpu-costs/1 "
+                         "document from every process's /debug/costs "
+                         "instead of a trace")
+    ap.add_argument("--perf", action="store_true",
+                    help="embed each process's /debug/perf fragment "
+                         "in the costs document (implies --costs)")
+    ap.add_argument("--token", default="",
+                    help="Trivy-Token for the token-gated /debug "
+                         "endpoints (--costs/--perf)")
     args = ap.parse_args(argv)
     urls = discover(args.router, args.timeout) + list(args.url)
+    if args.costs or args.perf:
+        doc = collect_costs(args.router, args.token, args.timeout,
+                            urls=urls, with_perf=args.perf)
+        write_trace(args.output, doc)
+        print(f"{len(doc['tenants'])} tenants from "
+              f"{len(doc['sources'])} processes → {args.output}")
+        return 0
     doc = collect_trace(args.router, args.trace_id or None,
                         args.timeout, urls=urls)
     write_trace(args.output, doc)

@@ -86,6 +86,7 @@ class DispatchLedger:
         self._lock = threading.Lock()
         self._shapes: dict[tuple, dict] = {}
         self._transfers: dict[str, int] = {}
+        self._device_ms: dict[str, float] = {}
         self._adapt = {"up": 0, "down": 0}
         self._resident: dict[str, int] = {}
         self._uploads: dict[str, dict] = {}
@@ -133,6 +134,15 @@ class DispatchLedger:
             row["compile_ms"] += ms
         METRICS.observe("trivy_tpu_device_compile_ms", ms,
                         phase="warmup" if warm else "traffic")
+
+    def note_device_ms(self, site: str, ms: float) -> None:
+        """Wall ms one launch+sync spent on the device path, by site.
+        Written by obs.cost.charge_device_ms from the SAME measurement
+        it apportions to tenants — the two sides of the graftcost
+        conservation contract come from one clock read."""
+        with self._lock:
+            self._device_ms[site] = \
+                self._device_ms.get(site, 0.0) + float(ms)
 
     def note_transfer(self, path: str, nbytes: float) -> None:
         """Device→host result bytes by path: "compact" (O(hits) hit
@@ -311,6 +321,7 @@ class DispatchLedger:
         with self._lock:
             shapes = [dict(v) for v in self._shapes.values()]
             transfers = dict(self._transfers)
+            device_ms = dict(self._device_ms)
             adapt = dict(self._adapt)
             uploads = {site: dict(row)
                        for site, row in self._uploads.items()}
@@ -333,6 +344,10 @@ class DispatchLedger:
                                 3),
             "overflows": sum(r["overflows"] for r in shapes),
             "transfer_bytes": transfers,
+            # graftcost: per-site device wall ms (launch+sync), the
+            # ledger side of the cost-conservation reconciliation
+            "device_ms": {k: round(v, 3) for k, v in device_ms.items()},
+            "device_ms_total": round(sum(device_ms.values()), 3),
             "budget_adaptations": adapt,
             # graftstream: host→device slice-upload overlap aggregates
             # (uploads/prefetched/stall_ms per site)
@@ -352,6 +367,7 @@ class DispatchLedger:
         with self._lock:
             self._shapes = {}
             self._transfers = {}
+            self._device_ms = {}
             self._adapt = {"up": 0, "down": 0}
             self._resident = {}
             self._uploads = {}

@@ -18,7 +18,11 @@ becomes a baseline the fleet can actually hold:
     ``*throughput*``, ``*speedup*``, ``*ips*``, ``*hit_rate*``) must
     not drop, latency/cost-shaped ones (``*_ms``, ``*_s``, ``*p99*``,
     ``*bytes*``, ``*waste*``, ``*compile*``, ``*shed*``, ``*failed*``)
-    must not rise; unclassifiable names are reported but never gate;
+    must not rise; unclassifiable names are reported but never gate.
+    A few leaves carry a HARD cap gated on the new value alone
+    (``cost_overhead_pct`` < 2 — graftcost attribution must stay
+    nearly free), because relative compare against a near-zero
+    healthy baseline pages on jitter;
   * noise awareness: a leaf that is a LIST of numbers is a repeat
     spread — the comparison uses medians and widens the bound by
     k·MAD/|median| (median absolute deviation, robust to one bad
@@ -45,8 +49,16 @@ import math
 _HIGHER = ("per_sec", "mbps", "mb_s", "throughput", "speedup",
            "hit_rate", "ips", "occupancy")
 _LOWER_FRAGMENTS = ("p99", "p50", "latency", "waste", "shed", "lost",
-                    "failed", "compile", "overflow", "stall")
+                    "failed", "compile", "overflow", "stall",
+                    "overhead")
 _LOWER_SUFFIXES = ("_ms", "_s", "_seconds", "_bytes")
+
+# hard ceilings, gated on the NEW value alone: a percentage that must
+# simply stay small (graftcost's attribution overhead) has a near-zero
+# healthy baseline, and relative compare against near-zero turns every
+# jitter into a page — these leaves skip the relative gate and fail
+# only when the fresh round exceeds the cap
+_ABS_CAPS = {"cost_overhead_pct": 2.0}
 
 
 class SchemaError(ValueError):
@@ -150,11 +162,25 @@ def compare(old: dict, new: dict, threshold: float = 0.10,
     the per-scenario repeat spread widens the bound, never narrows
     it."""
     regressions, improvements, unclassified, missing = [], [], [], []
+    capped = []
     checked = 0
+    # cap pass over NEW: gate capped leaves on their absolute ceiling,
+    # even when the metric has no baseline yet (a fresh scenario's
+    # first round must still respect the cap)
+    for path in sorted(new):
+        cap = _ABS_CAPS.get(path.rsplit(".", 1)[-1].lower())
+        if cap is None:
+            continue
+        nv, _ = _value_and_noise(new[path])
+        checked += 1
+        if nv > cap:
+            capped.append({"metric": path, "value": nv, "cap": cap})
     for path in sorted(old):
         if path not in new:
             missing.append(path)
             continue
+        if path.rsplit(".", 1)[-1].lower() in _ABS_CAPS:
+            continue   # gated by the cap pass, not relative drift
         d = direction(path)
         ov, onoise = _value_and_noise(old[path])
         nv, nnoise = _value_and_noise(new[path])
@@ -178,8 +204,8 @@ def compare(old: dict, new: dict, threshold: float = 0.10,
         elif rel < -bound:
             improvements.append(entry)
     return {"regressions": regressions, "improvements": improvements,
-            "unclassified": unclassified, "missing": missing,
-            "checked": checked}
+            "capped": capped, "unclassified": unclassified,
+            "missing": missing, "checked": checked}
 
 
 def load_allowlist(allow_args: list[str],
@@ -263,6 +289,15 @@ def main(argv=None) -> int:
             failed.append(r)
             print(f"REGRESS  {r['metric']}: {r['old']} -> {r['new']} "
                   f"({r['change']:+.1%}, bound {r['bound']:.1%})")
+    for r in report["capped"]:
+        reason = allow.get(r["metric"])
+        if reason is not None:
+            print(f"ALLOWED  {r['metric']}: {r['value']} over cap "
+                  f"{r['cap']} — {reason}")
+        else:
+            failed.append(r)
+            print(f"REGRESS  {r['metric']}: {r['value']} exceeds "
+                  f"hard cap {r['cap']}")
     if not args.quiet:
         for r in report["improvements"]:
             print(f"improve  {r['metric']}: {r['old']} -> {r['new']} "
@@ -270,9 +305,10 @@ def main(argv=None) -> int:
         for path in report["missing"]:
             print(f"missing  {path}: present in OLD, absent in NEW "
                   f"(scenario skipped?)")
+        flagged = len(report["regressions"]) + len(report["capped"])
         print(f"perfcheck: {report['checked']} metrics checked, "
               f"{len(failed)} regression(s), "
-              f"{len(report['regressions']) - len(failed)} allowed, "
+              f"{flagged - len(failed)} allowed, "
               f"{len(report['improvements'])} improvement(s)")
     return 1 if failed else 0
 

@@ -80,6 +80,11 @@ class SLOEngine:
         # per-objective deque of (ts, good: bool); pruned past the
         # longest window on every observe
         self._events = {name: deque() for name in self.objectives}
+        # graftcost: per-tenant scan_latency_p99 event deques, keyed
+        # by the TenantAggregator's CLAMPED label (top-K + "other"),
+        # so the tenant-labeled burn gauges share the cardinality
+        # bound of every other tenant series
+        self._tenant_events: dict[str, deque] = {}
 
     def configure(self, latency_threshold_ms: float | None = None,
                   windows=None, targets: dict | None = None,
@@ -111,15 +116,27 @@ class SLOEngine:
             while ev and ev[0][0] < now - horizon:
                 ev.popleft()
 
-    def observe_scan(self, latency_s: float, outcome: str) -> None:
+    def observe_scan(self, latency_s: float, outcome: str,
+                     tenant: str | None = None) -> None:
         """One Scan RPC: outcome 'ok' | 'error' | 'shed'. Sheds are
         load, not errors — they count toward availability's
         denominator as good and are excluded from the latency
-        objective entirely (a refused scan has no latency)."""
+        objective entirely (a refused scan has no latency). `tenant`
+        (already clamped by the caller) additionally lands the
+        latency event in that tenant's burn-rate window."""
         if outcome != "shed":
-            self._observe("scan_latency_p99",
-                          outcome == "ok"
-                          and latency_s <= self.latency_threshold_s)
+            good = (outcome == "ok"
+                    and latency_s <= self.latency_threshold_s)
+            self._observe("scan_latency_p99", good)
+            if tenant:
+                horizon = max(self.windows)
+                with self._lock:
+                    now = self._clock()
+                    ev = self._tenant_events.setdefault(tenant,
+                                                        deque())
+                    ev.append((now, good))
+                    while ev and ev[0][0] < now - horizon:
+                        ev.popleft()
         self._observe("scan_errors", outcome != "error")
 
     def observe_join(self, device: bool) -> None:
@@ -162,6 +179,30 @@ class SLOEngine:
                              "windows": windows}
             return out
 
+    def tenant_burn_rates(self) -> dict:
+        """→ {tenant: {window: burn_rate}} for the scan_latency_p99
+        objective — per-tenant error-budget burn over the same
+        windows, keyed by clamped tenant label."""
+        obj = self.objectives["scan_latency_p99"]
+        budget = 1.0 - obj.target
+        with self._lock:
+            now = self._clock()
+            out: dict = {}
+            for tenant, ev in self._tenant_events.items():
+                windows = {}
+                for w in self.windows:
+                    total = bad = 0
+                    for ts, good in ev:
+                        if ts >= now - w:
+                            total += 1
+                            if not good:
+                                bad += 1
+                    ratio = bad / total if total else 0.0
+                    burn = ratio / budget if budget > 0 else 0.0
+                    windows[f"{int(w)}s"] = round(burn, 4)
+                out[tenant] = windows
+            return out
+
     def export(self) -> dict:
         """Recompute and publish the burn-rate gauges (and the
         device-serving ratio over the short window); returns the
@@ -172,6 +213,14 @@ class SLOEngine:
                 METRICS.set_gauge("trivy_tpu_slo_burn_rate",
                                   w["burn_rate"], objective=name,
                                   window=wname)
+        # graftcost: tenant-labeled latency burn (cardinality already
+        # clamped at observe time — labels are TenantAggregator
+        # output, never raw header values)
+        for tenant, windows in self.tenant_burn_rates().items():
+            for wname, burn in windows.items():
+                METRICS.set_gauge("trivy_tpu_slo_burn_rate", burn,
+                                  objective="scan_latency_p99",
+                                  window=wname, tenant=tenant)
         short = f"{int(min(self.windows))}s"
         dev = rates["device_serving"]["windows"][short]
         ratio = 1.0 - dev["bad_ratio"] if dev["total"] else 1.0
@@ -188,6 +237,7 @@ class SLOEngine:
         with self._lock:
             for ev in self._events.values():
                 ev.clear()
+            self._tenant_events = {}
 
 
 SLO = SLOEngine()

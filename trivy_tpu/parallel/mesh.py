@@ -388,7 +388,7 @@ class MeshDetector:
         single chip."""
         from ..log import get as _get_logger
         from ..obs import SLO
-        from ..obs.perf import LEDGER
+        from ..obs import cost as _cost
         from ..resilience import GUARD, DeviceError, failpoint
         inner = self._inner
         raw_fallback = host_fallback
@@ -527,14 +527,14 @@ class MeshDetector:
             inner._note_hits(max_cell_hits, h_loc, site=site,
                              t_pad=t_total)
         if isinstance(bits, CompactBits):
-            LEDGER.note_transfer("compact",
-                                 float(bits.pair_idx.nbytes
-                                       + bits.bits.nbytes))
+            _cost.ledgered_transfer("compact",
+                                    float(bits.pair_idx.nbytes
+                                          + bits.bits.nbytes))
             # hits already in global pair order; extend the logical
             # dense length to the padded dispatch size downstream
             # slicing expects
             return CompactBits(bits.pair_idx, bits.bits, t_pad)
-        LEDGER.note_transfer("dense", float(bits.nbytes))
+        _cost.ledgered_transfer("dense", float(bits.nbytes))
         out = np.zeros(t_pad, np.int8)
         out[:total] = bits
         return out
@@ -549,7 +549,7 @@ class MeshDetector:
         resident dispatch, and the slice results concat-merge into one
         global result bit-identical to the unstreamed join.
         → (merged bits, [(max cell hits, h_cap, t_total)] notes)."""
-        from ..obs.perf import LEDGER
+        from ..obs import cost as _cost
         from .stream import ledgered_sync_join, merge_slice_bits
         inner = self._inner
         results: list = []
@@ -580,12 +580,12 @@ class MeshDetector:
             if h_loc:
                 hit_notes.append((max_hits, h_loc, t_total))
             if isinstance(bits_k, CompactBits):
-                LEDGER.note_transfer("compact",
-                                     float(bits_k.pair_idx.nbytes
-                                           + bits_k.bits.nbytes))
+                _cost.ledgered_transfer("compact",
+                                        float(bits_k.pair_idx.nbytes
+                                              + bits_k.bits.nbytes))
             else:
-                LEDGER.note_transfer("dense",
-                                     float(np.asarray(bits_k).nbytes))
+                _cost.ledgered_transfer(
+                    "dense", float(np.asarray(bits_k).nbytes))
             results.append((plan, bits_k))
         # tail prefetch: the next dispatch over the same hash span
         # starts back at the walk's first slice — ship it into the

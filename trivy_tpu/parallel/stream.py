@@ -55,6 +55,7 @@ import numpy as np
 from ..log import get as _get_logger
 from ..metrics import METRICS
 from ..obs import SLO, note_dispatch, span
+from ..obs import cost as _cost
 from ..obs.perf import LEDGER
 from ..resilience import GUARD, DeviceError, failpoint
 from ..resilience.hostjoin import CompactBits
@@ -244,8 +245,15 @@ def ledgered_sync_join(inner, run, site: str, real: int, t_total: int,
     dispatch row. One implementation so the ledger contract cannot
     drift between the three launch shapes (the PR 13 blameless re-tag
     fix had to patch two hand-synced copies). `run()` performs the
-    launch + fetch and its return value passes through."""
+    launch + fetch and its return value passes through.
+
+    graftcost rides the same seam: a synchronous site's `run()` wall
+    time IS its device ms (launch + compute + fetch in one call), so
+    one clock read feeds the shape ledger and the per-tenant
+    apportionment — the conservation contract, in the one place all
+    three launch shapes share."""
     new_shape = inner._note_shape(t_total, q_pad, u_rows, h_cap)
+    t_run = time.perf_counter()
     if new_shape:
         failpoint("detect.compile")
         with span("detect.compile", t_pad=t_total, h_cap=h_cap,
@@ -256,6 +264,8 @@ def ledgered_sync_join(inner, run, site: str, real: int, t_total: int,
         LEDGER.note_compile(site, t_total, h_cap, compile_ms)
     else:
         out = run()
+    _cost.charge_device_ms(site, (time.perf_counter() - t_run) * 1e3,
+                           real_rows=0 if new_shape else real)
     LEDGER.note_dispatch(site, real, t_total, h_cap)
     return out
 
@@ -631,7 +641,7 @@ class StreamingDetector:
                                + n_hits.nbytes)
                 METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
                             nbytes, path="compact")
-                LEDGER.note_transfer("compact", nbytes)
+                _cost.ledgered_transfer("compact", nbytes)
                 if n > h_cap:
                     # checked overflow: the dense bits stayed on
                     # device — this slice pays the dense fetch and the
@@ -640,13 +650,14 @@ class StreamingDetector:
                     METRICS.inc(
                         "trivy_tpu_detect_transfer_bytes_total",
                         float(bits.nbytes), path="dense")
-                    LEDGER.note_transfer("overflow", float(bits.nbytes))
+                    _cost.ledgered_transfer("overflow",
+                                            float(bits.nbytes))
                     return bits
                 return CompactBits(hit_idx[:n], hit_bits[:n], t_pad_k)
             bits = jax.device_get(J.csr_pair_join(*args, t_pad_k))
             METRICS.inc("trivy_tpu_detect_transfer_bytes_total",
                         float(bits.nbytes), path="dense")
-            LEDGER.note_transfer("dense", float(bits.nbytes))
+            _cost.ledgered_transfer("dense", float(bits.nbytes))
             return bits
 
         return ledgered_sync_join(inner, _run, site, plan.total,

@@ -54,7 +54,7 @@ from dataclasses import asdict, dataclass, field, replace
 
 from ..log import get as _get_logger
 from ..metrics import METRICS
-from ..server import DB_VERSION_HEADER
+from ..server import DB_VERSION_HEADER, TENANT_HEADER
 from .breaker import GUARD
 from .failpoints import FAILPOINTS
 
@@ -368,6 +368,11 @@ class StormOptions:
     settle_s: float = 8.0       # post-schedule liveness window
     request_timeout_s: float = 30.0
     artifact_dir: str = ""      # incident/replay dir ("" = tmpdir)
+    # graftcost: distinct tenants the load round-robins through via
+    # X-Trivy-Tenant (request idx % tenants). 1 = untenanted load
+    # (everything lands in "default"); the tenant mix is recorded in
+    # replay artifacts so a failing schedule replays the same mix
+    tenants: int = 1
 
 
 @dataclass
@@ -435,6 +440,15 @@ def canonical_digest(doc: dict) -> str:
         doc, sort_keys=True, separators=(",", ":")).encode()).hexdigest()
 
 
+def tenant_for(opts: StormOptions, idx: int) -> str:
+    """The idx-th load request's tenant id ("" = no header →
+    "default"): a deterministic round-robin over `opts.tenants`
+    synthetic tenants, so replays keep the same mix."""
+    if opts.tenants <= 1:
+        return ""
+    return f"storm-t{idx % opts.tenants}"
+
+
 # ---------------------------------------------------------------------------
 # topologies
 
@@ -486,12 +500,12 @@ class _Topology:
     def server_states(self) -> list:
         raise NotImplementedError
 
-    def do_request(self, idx: int, doc: dict,
-                   timeout: float) -> Outcome:
+    def do_request(self, idx: int, doc: dict, timeout: float,
+                   tenant: str = "") -> Outcome:
         """Issue the idx-th load request. The default is one Scan RPC
         over the pre-pushed blob; the ingest topology overrides with
         the full client-side walk → PutBlob → Scan flow."""
-        o = _scan_once(self.url, doc, timeout)
+        o = _scan_once(self.url, doc, timeout, tenant=tenant)
         o.idx = idx
         return o
 
@@ -852,8 +866,8 @@ class IngestTopology(SingleTopology):
             stack.reverse()
             self._hostile_stack = stack
 
-    def do_request(self, idx: int, doc: dict,
-                   timeout: float) -> Outcome:
+    def do_request(self, idx: int, doc: dict, timeout: float,
+                   tenant: str = "") -> Outcome:
         from ..fanal.artifact import ImageArchiveArtifact
         from ..fanal.cache import MemoryCache
         stack = self._hostile_stack
@@ -890,7 +904,9 @@ class IngestTopology(SingleTopology):
                  "options": {"scanners": ["vuln", "secret"]}},
                 timeout=timeout,
                 headers={"X-Trivy-Deadline-Ms":
-                         str(int(timeout * 1e3))})
+                         str(int(timeout * 1e3)),
+                         **({TENANT_HEADER: tenant}
+                            if tenant else {})})
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             return Outcome(idx, "lost",
                            latency_ms=(time.perf_counter() - t0) * 1e3,
@@ -1038,6 +1054,11 @@ class RunContext:
     v2: str = ""
     skew_settle_delta: float = 0.0
     requests: int = 0
+    # graftcost conservation: this run's DELTAS of the graftprof
+    # ledger totals vs the tenant-attributed totals (ledger/attributed
+    # per axis, plus the reconciliation verdicts) — filled after
+    # teardown, when every handler thread has settled its ledger
+    cost_conservation: dict = field(default_factory=dict)
 
 
 @invariant("no_lost_requests")
@@ -1153,6 +1174,24 @@ def _inv_incident(ctx: RunContext) -> list[str]:
     return []
 
 
+@invariant("cost_conservation")
+def _inv_cost(ctx: RunContext) -> list[str]:
+    """graftcost headline: across the whole run — faults, failovers,
+    sheds, warmup and all — the device ms and conserved transfer
+    bytes the graftprof ledger measured must equal what the tenant
+    rows (plus the SYSTEM tenant) were charged. A leak means work
+    nobody was billed for; an excess means double-counting."""
+    out = []
+    for axis in ("device_ms", "transfer_bytes"):
+        rec = ctx.cost_conservation.get(axis)
+        if rec and not rec.get("ok"):
+            out.append(
+                f"{axis}: ledger moved {rec['ledger']:g} but "
+                f"attribution moved {rec['attributed']:g} "
+                f"(leak or double count)")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the runner
 
@@ -1160,6 +1199,55 @@ def _inv_incident(ctx: RunContext) -> list[str]:
 def _nondaemon_threads() -> dict[int, str]:
     return {t.ident: t.name for t in threading.enumerate()
             if not t.daemon and t.ident is not None}
+
+
+def _cost_totals() -> dict:
+    """Current absolute totals of both conservation sides: the
+    graftprof ledger (measured) and the tenant attribution (charged).
+    run_storm snapshots before the run and diffs after teardown, so
+    the cost_conservation invariant sees only THIS run's movement."""
+    from ..obs import cost as _cost
+    from ..obs.perf import LEDGER
+    agg = LEDGER.aggregate()
+    att = _cost.TENANTS.totals()
+    return {
+        "ledger_ms": float(agg.get("device_ms_total", 0.0)),
+        "ledger_bytes": float(sum(
+            int(agg.get("transfer_bytes", {}).get(p, 0))
+            for p in _cost.CONSERVED_TRANSFER_PATHS)),
+        "att_ms": att["device_ms"],
+        "att_bytes": att["transfer_bytes"],
+    }
+
+
+def _conservation_deltas(base: dict, timeout_s: float = 2.0) -> dict:
+    """→ the run's {device_ms, transfer_bytes} conservation record.
+    Handler threads settle their ledgers right after the response is
+    written, so attribution can trail the last response by a beat —
+    poll until both axes reconcile (or the timeout makes the
+    discrepancy the invariant's problem)."""
+    def _ok(a: float, b: float, abs_tol: float) -> bool:
+        return abs(a - b) <= max(abs_tol, 0.01 * max(a, b))
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        cur = _cost_totals()
+        d_lms = cur["ledger_ms"] - base["ledger_ms"]
+        d_ams = cur["att_ms"] - base["att_ms"]
+        d_lb = cur["ledger_bytes"] - base["ledger_bytes"]
+        d_ab = cur["att_bytes"] - base["att_bytes"]
+        ok_ms = _ok(d_lms, d_ams, 0.5)
+        ok_b = _ok(d_lb, d_ab, 4096.0)
+        if (ok_ms and ok_b) or time.monotonic() >= deadline:
+            return {
+                "device_ms": {"ledger": round(d_lms, 3),
+                              "attributed": round(d_ams, 3),
+                              "ok": ok_ms},
+                "transfer_bytes": {"ledger": int(d_lb),
+                                   "attributed": int(d_ab),
+                                   "ok": ok_b},
+            }
+        time.sleep(0.02)
 
 
 class _ScheduleDriver(threading.Thread):
@@ -1253,7 +1341,8 @@ def _classify(idx: int, code: int, headers: dict, body,
                    detail=str(body)[:160])
 
 
-def _scan_once(url: str, doc: dict, timeout: float) -> Outcome:
+def _scan_once(url: str, doc: dict, timeout: float,
+               tenant: str = "") -> Outcome:
     diff = doc["DiffID"]
     t0 = time.perf_counter()
     try:
@@ -1262,7 +1351,8 @@ def _scan_once(url: str, doc: dict, timeout: float) -> Outcome:
             {"target": diff[:19], "artifact_id": diff,
              "blob_ids": [diff], "options": {"scanners": ["vuln"]}},
             timeout=timeout,
-            headers={"X-Trivy-Deadline-Ms": str(int(timeout * 1e3))})
+            headers={"X-Trivy-Deadline-Ms": str(int(timeout * 1e3)),
+                     **({TENANT_HEADER: tenant} if tenant else {})})
     except (urllib.error.URLError, OSError, TimeoutError) as e:
         return Outcome(-1, "lost",
                        latency_ms=(time.perf_counter() - t0) * 1e3,
@@ -1310,6 +1400,7 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
     baseline_threads = _nondaemon_threads()
     shed0 = METRICS.get("trivy_tpu_requests_shed_total")
     events0 = len(RECORDER.events())
+    cost0 = _cost_totals()
     t_run0 = time.perf_counter()
 
     topo = build_topology(table, schedule, opts)
@@ -1354,7 +1445,8 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
                     time.sleep(delay)
                 try:
                     o = topo.do_request(i, docs[i],
-                                        opts.request_timeout_s)
+                                        opts.request_timeout_s,
+                                        tenant=tenant_for(opts, i))
                 except Exception as e:  # noqa: BLE001 — a surprise
                     # (e.g. a 200 with a truncated body) is exactly a
                     # lost request; the invariant engine must REPORT
@@ -1455,6 +1547,11 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
             RECORDER.configure(incident_dir=saved[0],
                                incident_cooldown_s=saved[1])
 
+    # conservation read AFTER teardown: every handler thread has
+    # settled, warmup/probe work has landed in SYSTEM — the two sides
+    # must now agree for this run's deltas
+    cost_deltas = _conservation_deltas(cost0)
+
     # leaked threads: everything the run created must be gone
     leak_deadline = time.monotonic() + 10.0
     leaked = {}
@@ -1486,7 +1583,8 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
         v1=table.content_digest(),
         v2=topo.table2.content_digest(),
         skew_settle_delta=skew_settle_delta,
-        requests=len(docs))
+        requests=len(docs),
+        cost_conservation=cost_deltas)
     violations = {}
     for name, probe in INVARIANTS.items():
         msgs = probe(ctx)
@@ -1584,6 +1682,7 @@ def write_replay(path: str, schedule: Schedule, opts: StormOptions,
             "replicas": opts.replicas,
             "mesh_devices": opts.mesh_devices,
             "mesh_hosts": opts.mesh_hosts,
+            "tenants": opts.tenants,
         },
         "violations": report.violations,
         "minimized": minimized,
@@ -1615,7 +1714,8 @@ def load_replay(path: str) -> tuple[Schedule, StormOptions]:
         breaker_reset_ms=float(load.get("breaker_reset_ms", 150.0)),
         replicas=int(load.get("replicas", 3)),
         mesh_devices=int(load.get("mesh_devices", 4)),
-        mesh_hosts=int(load.get("mesh_hosts", 2)))
+        mesh_hosts=int(load.get("mesh_hosts", 2)),
+        tenants=int(load.get("tenants", 1)))
     return schedule, opts
 
 
@@ -1646,6 +1746,10 @@ def main(argv=None) -> int:
                          "topology (host_loss events kill one host's "
                          "worth of device domains at once)")
     ap.add_argument("--admit-max-active", type=int, default=0)
+    ap.add_argument("--tenants", type=int, default=1,
+                    help="distinct X-Trivy-Tenant ids the load "
+                         "round-robins through (graftcost tenant mix; "
+                         "1 = untenanted)")
     ap.add_argument("--artifact-dir", default="",
                     help="where failing-schedule replay artifacts and "
                          "incident snapshots land (default: a tmpdir)")
@@ -1684,7 +1788,7 @@ def main(argv=None) -> int:
         replicas=args.replicas, mesh_devices=args.mesh_devices,
         mesh_hosts=args.mesh_hosts,
         admit_max_active=args.admit_max_active,
-        artifact_dir=args.artifact_dir)
+        artifact_dir=args.artifact_dir, tenants=args.tenants)
     for r in range(args.rounds):
         seed = args.seed + r
         schedule = generate_schedule(

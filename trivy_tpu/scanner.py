@@ -21,6 +21,7 @@ from .detect.fill import fill_info
 from .detect.langpkg import LangpkgScanner
 from .detect.ospkg import OspkgScanner
 from .fanal.applier import apply_layers
+from .obs import cost as _cost
 from .obs import ensure_trace, recording, span
 
 if TYPE_CHECKING:
@@ -186,6 +187,13 @@ class LocalScanner:
                     elif token is not None:
                         store_tokens[u_i] = token
                 sp.attrs.update(replayed=len(replayed))
+                # graftcost: memo replays are work AVOIDED — priced
+                # per replayed unit's query count at the EWMA device
+                # exchange rate (an estimate, kept out of the
+                # conservation sums) and credited to this tenant
+                if replayed:
+                    _cost.note_work_avoided(
+                        sum(len(batches[i]) for i in replayed))
 
         # phase 2: one pipelined dispatch across all live targets
         # (device). Server mode routes through detectd so concurrent

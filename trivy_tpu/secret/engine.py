@@ -45,6 +45,7 @@ import numpy as np
 
 from .. import types as T
 from ..metrics import METRICS
+from ..obs import cost as _cost
 from ..obs import span
 from ..ops import ac
 from .rules import BUILTIN_RULES, GLOBAL_ALLOW_RULES, Rule
@@ -162,6 +163,9 @@ class SecretScanner:
         METRICS.inc("trivy_tpu_secret_prefilter_path_total", path=path)
         METRICS.inc("trivy_tpu_secret_scan_bytes_total", n_bytes,
                     path=path)
+        # graftcost: scanned bytes billed to the requesting tenant by
+        # the serving path that actually ran them
+        _cost.charge_secret_bytes(path, float(n_bytes))
 
     def _keyword_masks_host(self, files: list[bytes]) -> list[set[int]]:
         out = []
@@ -288,11 +292,18 @@ class SecretScanner:
                     (time.perf_counter() - t0) * 1e3)
             LEDGER.note_dispatch(site, real_rows, b,
                                  row_bytes=row_len)
+            # graftcost: the dispatch call's wall time (compile
+            # included on a fresh shape) is this piece's device ms
+            _cost.charge_device_ms(site,
+                                   (time.perf_counter() - t0) * 1e3)
         try:
             fetched = []
             for f in futures:
+                t_get = time.perf_counter()
                 arr = jax.device_get(f)
-                LEDGER.note_transfer("dense", float(arr.nbytes))
+                _cost.charge_device_ms(
+                    site, (time.perf_counter() - t_get) * 1e3)
+                _cost.ledgered_transfer("dense", float(arr.nbytes))
                 fetched.append(arr)
             masks = np.concatenate(
                 fetched, axis=0)[:uniq.shape[0]][remap]

@@ -30,6 +30,17 @@ DEADLINE_HEADER = "X-Trivy-Deadline-Ms"
 # counts trivy_tpu_fleet_db_version_skew_total when a mid-rollout
 # fleet answers from different databases
 DB_VERSION_HEADER = "X-Trivy-DB-Version"
+# graftcost tenant identity: who this scan is billed to (client
+# --tenant; the router forwards it verbatim; absent = "default").
+# The FULL id always rides this header and the cost response — only
+# the metric label space is clamped to top-K-plus-"other"
+TENANT_HEADER = "X-Trivy-Tenant"
+# graftcost per-request cost split: compact JSON (tenant, queue_ms,
+# service_ms, device_ms, transfer_bytes, host_ms, avoided_ms, hops)
+# stamped on every Scan response; the router sums it across failover
+# hops so the client sees ONE document covering everything its
+# request cost, wherever it ran
+COST_HEADER = "X-Trivy-Cost"
 
 # request-message descriptor per Twirp route (binary encoding) —
 # shared by the server handler and the graftfleet router, which must

@@ -17,8 +17,8 @@ import urllib.request
 from .. import types as T
 from ..obs import current_span_id, current_trace_id, ensure_trace, span
 from ..report.writer import report_from_json
-from . import (DEADLINE_HEADER, PARENT_SPAN_HEADER, TOKEN_HEADER,
-               TRACE_HEADER)
+from . import (COST_HEADER, DEADLINE_HEADER, PARENT_SPAN_HEADER,
+               TENANT_HEADER, TOKEN_HEADER, TRACE_HEADER)
 
 # one policy shape for every RPC; _Base accepts an override for tests.
 # Built lazily (like oci.py / db/download.py): a pure client process
@@ -55,7 +55,7 @@ class TwirpError(RuntimeError):
 
 class _Base:
     def __init__(self, base_url: str, token: str = "", timeout: float = 60,
-                 retry=None):
+                 retry=None, tenant: str = ""):
         # fleet awareness: a comma-separated URL list fails over
         # client-side — point at several routers (or at the replicas
         # directly in a routerless deployment) and the client walks
@@ -69,6 +69,14 @@ class _Base:
         self.token = token
         self.timeout = timeout
         self.retry = retry  # None → the shared lazy DEFAULT_RETRY
+        # graftcost tenant identity (--tenant): stamped on every RPC
+        # as X-Trivy-Tenant; the router relays it per hop and the
+        # replica's cost ledger attributes under it. Empty → the
+        # server's "default" tenant. The LAST response's parsed
+        # X-Trivy-Cost doc (merged across failover hops when a router
+        # answered) is kept for callers that want the bill.
+        self.tenant = tenant
+        self.last_cost: dict | None = None
 
     @property
     def base_url(self) -> str:
@@ -90,6 +98,7 @@ class _Base:
             **({TRACE_HEADER: tid} if tid else {}),
             **({PARENT_SPAN_HEADER: psid} if tid and psid else {}),
             **({TOKEN_HEADER: self.token} if self.token else {}),
+            **({TENANT_HEADER: self.tenant} if self.tenant else {}),
         }
         policy = self.retry or _default_retry()
 
@@ -111,12 +120,17 @@ class _Base:
                     with urllib.request.urlopen(
                             req, timeout=self.timeout) as r:
                         result = json.loads(r.read() or b"{}")
+                        hdrs = getattr(r, "headers", None)
+                        raw_cost = hdrs.get(COST_HEADER) if hdrs else None
                 except urllib.error.HTTPError:
                     raise
                 except urllib.error.URLError as e:
                     last = e
                     continue   # unreachable: try the next base
                 self._base_idx = idx
+                if raw_cost:
+                    from ..obs.cost import parse_cost_header
+                    self.last_cost = parse_cost_header(raw_cost)
                 return result
             raise last
 

@@ -26,6 +26,7 @@ from .. import __version__, types as T
 from ..fanal.cache import blob_from_json
 from ..log import get as _get_logger
 from ..obs import SLO, device_status, new_trace, span
+from ..obs import cost as _cost
 from ..obs.perf import debug_perf_payload, debug_profile_payload
 from ..obs.recorder import (debug_incidents_payload,
                             debug_traces_payload)
@@ -35,9 +36,9 @@ from ..scanner import LocalScanner
 # wire-header names live in the package __init__ so the CLIENT can
 # import them without pulling in this module's server stack;
 # re-exported here for the existing `listen.TOKEN_HEADER` readers
-from . import (DB_VERSION_HEADER, DEADLINE_HEADER,  # noqa: F401
-               PARENT_SPAN_HEADER, ROUTE_DESCRIPTORS, TOKEN_HEADER,
-               TRACE_HEADER)
+from . import (COST_HEADER, DB_VERSION_HEADER,  # noqa: F401
+               DEADLINE_HEADER, PARENT_SPAN_HEADER, ROUTE_DESCRIPTORS,
+               TENANT_HEADER, TOKEN_HEADER, TRACE_HEADER)
 
 _log = _get_logger("server")
 
@@ -525,6 +526,12 @@ class Handler(BaseHTTPRequestHandler):
             self.send_header(TRACE_HEADER, self._trace_id)
         if self._db_version:
             self.send_header(DB_VERSION_HEADER, self._db_version)
+        # graftcost: the per-request cost split rides every response
+        # produced inside a request ledger (do_POST installs one);
+        # GET surfaces have no ledger and stamp nothing
+        led = _cost.active()
+        if led is not None:
+            self.send_header(COST_HEADER, led.header_json())
         self.end_headers()
         self.wfile.write(body)
 
@@ -546,7 +553,8 @@ class Handler(BaseHTTPRequestHandler):
 
     def _do_get(self):
         if self.path.startswith(("/debug/traces", "/debug/incidents",
-                                 "/debug/perf", "/debug/profile")):
+                                 "/debug/perf", "/debug/profile",
+                                 "/debug/costs")):
             # unlike /healthz//metrics (liveness/scrape surfaces), the
             # debug buffers carry scan detail — file paths in analyzer
             # spans, other tenants' trace ids — so a configured token
@@ -564,6 +572,11 @@ class Handler(BaseHTTPRequestHandler):
             if self.path.startswith("/debug/profile"):
                 code, payload = debug_profile_payload(self.path)
                 return self._json(code, payload)
+            if self.path.startswith("/debug/costs"):
+                # graftcost: per-tenant totals + the conservation
+                # reconciliation (replica-local; the fleet router
+                # serves the fleet-wide variant from relayed headers)
+                return self._json(200, _cost.debug_costs_payload())
             return self._json(200, debug_incidents_payload())
         if self.path == "/healthz":
             # plain `ok` stays the fast path for probes that ask for
@@ -615,6 +628,9 @@ class Handler(BaseHTTPRequestHandler):
                     # sliding windows (export() also refreshes the
                     # burn-rate gauges, so /healthz and /metrics agree)
                     "slo": SLO.export(),
+                    # graftcost: per-tenant scan counts + headline cost
+                    # split (bounded rows — the top-K clamp already ran)
+                    "tenants": _cost.TENANTS.healthz_block(),
                 }
                 # graftstream: slice plan + resident set when the
                 # serving detector streams its advisory table (the
@@ -660,6 +676,9 @@ class Handler(BaseHTTPRequestHandler):
             self.send_header(TRACE_HEADER, self._trace_id)
         if self._db_version:
             self.send_header(DB_VERSION_HEADER, self._db_version)
+        led = _cost.active()
+        if led is not None:
+            self.send_header(COST_HEADER, led.header_json())
         self.end_headers()
         self.wfile.write(body)
 
@@ -686,11 +705,23 @@ class Handler(BaseHTTPRequestHandler):
         # tree across processes
         tid = self.headers.get(TRACE_HEADER) or ""
         parent = self.headers.get(PARENT_SPAN_HEADER) or ""
+        # graftcost: one request-scoped ledger per RPC, keyed by the
+        # relayed tenant header (client --tenant; router forwards it;
+        # absent → "default"). Every seam below — admission queue,
+        # detectd apportionment, fanald ingest, secrets, memo — charges
+        # this ledger through the contextvar; settle folds it into the
+        # tenant aggregate once the response is on the wire
+        tenant = self.headers.get(TENANT_HEADER) or "default"
         try:
             with new_trace(tid or None, parent_id=parent or None) as tid:
                 self._trace_id = tid
-                with span("server.rpc", route=self.path):
-                    self._do_post(st)
+                with _cost.request_ledger(tenant) as led:
+                    try:
+                        with span("server.rpc", route=self.path,
+                                  tenant=tenant):
+                            self._do_post(st)
+                    finally:
+                        _cost.TENANTS.settle(led, led.outcome)
         finally:
             st.request_finished(gen)
 
@@ -760,6 +791,12 @@ class Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         if self._trace_id:
             self.send_header(TRACE_HEADER, self._trace_id)
+        # a shed response still tells the tenant what it cost them:
+        # pure queue ms (the router's fleet aggregator sums it across
+        # the failover hops that eventually served the scan)
+        led = _cost.active()
+        if led is not None:
+            self.send_header(COST_HEADER, led.header_json())
         self.end_headers()
         self.wfile.write(body)
 
@@ -777,6 +814,9 @@ class Handler(BaseHTTPRequestHandler):
             s = Shed("server draining", 503, st.drain_retry_after_s)
             METRICS.inc("trivy_tpu_requests_shed_total")
             SLO.observe_scan(0.0, "shed")
+            led = _cost.active()
+            if led is not None:
+                led.outcome = "shed"
             _log.warning("scan shed (draining): 503 Retry-After=%ds",
                          int(s.retry_after_s))
             return self._shed_response(s)
@@ -787,9 +827,19 @@ class Handler(BaseHTTPRequestHandler):
                 deadline = Deadline(max(float(hdr), 0.0) / 1e3)
             except ValueError:
                 deadline = None  # unparseable header: no deadline
+        led = _cost.active()
+        # graftcost: time parked in the admission queue is queue ms —
+        # kept distinct from service ms so a tenant whose scans are
+        # QUEUED reads differently from one whose scans are SLOW.
+        # Charged on the shed path too (the wait really happened)
+        t_adm = time.perf_counter()
         try:
             st.admission.admit(deadline)
         except Shed as s:
+            _cost.charge_queue_ms(
+                (time.perf_counter() - t_adm) * 1e3, ledger=led)
+            if led is not None:
+                led.outcome = "shed"
             _log.warning("scan shed (%s): %d Retry-After=%ds",
                          s.reason, s.http_code, int(s.retry_after_s))
             # shed-aware SLO accounting: a 429/503 is load the
@@ -797,13 +847,20 @@ class Handler(BaseHTTPRequestHandler):
             # denominator grows, its error count does not
             SLO.observe_scan(0.0, "shed")
             return self._shed_response(s)
+        _cost.charge_queue_ms((time.perf_counter() - t_adm) * 1e3,
+                              ledger=led)
         try:
             failpoint("rpc.scan")
             return self._scan(req)
         except KeyError:
             raise   # 400 invalid_argument: the client's error
         except Exception:
-            SLO.observe_scan(0.0, "error")
+            if led is not None:
+                led.outcome = "error"
+            SLO.observe_scan(
+                0.0, "error",
+                tenant=_cost.TENANTS.resolve(led.tenant)
+                if led is not None else None)
             raise
         finally:
             st.admission.release()
@@ -830,7 +887,15 @@ class Handler(BaseHTTPRequestHandler):
         METRICS.inc("trivy_tpu_scans_total")
         METRICS.inc("trivy_tpu_scan_seconds_total", elapsed)
         METRICS.observe("trivy_tpu_scan_latency_seconds", elapsed)
-        SLO.observe_scan(elapsed, "ok")
+        led = _cost.active()
+        if led is not None:
+            led.outcome = "ok"
+        # per-tenant burn window keyed by the CLAMPED label — raw
+        # header values never become metric labels
+        SLO.observe_scan(
+            elapsed, "ok",
+            tenant=_cost.TENANTS.resolve(led.tenant)
+            if led is not None else None)
         _log.debug("scan %s: %d results in %.1fms",
                    req.get("target", ""), len(results), elapsed * 1e3)
         if self._is_proto:
