@@ -369,6 +369,63 @@ def test_sched_failpoint_in_device_code_detected():
     assert [(f.rule, f.line) for f in fs] == [("TPU108", 4)]
 
 
+def test_feed_staging_state_in_lock_hygiene_scope():
+    """Satellite (PR 18): graftfeed's staged-upload bookkeeping is
+    shared between handler threads and the dispatcher — TPU106 must
+    cover detect/feed.py like the rest of the detect package."""
+    src = (
+        "import threading\n"
+        "class Stager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._staged = {}\n"
+        "    def bad(self, k, s):\n"
+        "        self._staged[k] = s\n"
+        "    def good(self, k, s):\n"
+        "        with self._lock:\n"
+        "            self._staged[k] = s\n"
+    )
+    fs = _lint("trivy_tpu/detect/feed.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 7)]
+
+
+def test_feed_no_clocks_in_device_code():
+    """Satellite (PR 18): the scatter-back must stay host-side — a
+    stall clock leaking into a jitted expand core in detect/feed.py
+    is TPU107 material."""
+    src = (
+        "import time, jax\n"
+        "def _expand_core(bits_u, take):\n"
+        "    t0 = time.perf_counter()\n"
+        "    return bits_u[take] + t0\n"
+        "j = jax.jit(_expand_core)\n"
+    )
+    fs = _lint("trivy_tpu/detect/feed.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU107", 3)]
+
+
+def test_feed_upload_failpoint_in_device_code_detected():
+    """Satellite (PR 18): the detect.query_upload / stream.prefetch
+    probes are HOST call sites; one traced into a jitted core would
+    fire once at trace time — TPU108 must catch it in both new
+    homes."""
+    src = (
+        "import jax\n"
+        "from trivy_tpu.resilience import failpoint\n"
+        "def _upload_core(cols):\n"
+        "    failpoint('detect.query_upload')\n"
+        "    return cols\n"
+        "j = jax.jit(_upload_core)\n"
+    )
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/detect/feed.py", src)] \
+        == [("TPU108", 4)]
+    src2 = src.replace("detect.query_upload", "stream.prefetch")
+    assert [(f.rule, f.line) for f in
+            _lint("trivy_tpu/parallel/stream.py", src2)] \
+        == [("TPU108", 4)]
+
+
 def test_parallel_rebuild_code_in_lock_hygiene_scope():
     """Satellite (PR 5): the whole parallel/ package — the meshguard
     rebuild/coordinator surface and the ingest queue are shared across

@@ -165,7 +165,9 @@ class TestEquivalence:
         assert len(preps) >= 2
         dev, offsets, t_pad = det.dispatch_merged(preps)
         assert t_pad >= sum(p.n_pairs for p in preps)
-        bits = jax.device_get(dev)
+        # fetch through the contract path: a deduped merged dispatch
+        # resolves its unique-space result + scatter-back here
+        bits = det.fetch_merged(dev, preps, offsets, t_pad)
         for p, off in zip(preps, offsets):
             solo = jax.device_get(det._dispatch(p))[:p.n_pairs]
             assert (bits[off:off + p.n_pairs] == solo).all()

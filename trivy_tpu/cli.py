@@ -308,6 +308,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pre-compile the join's pair-bucket ladder at "
                         "boot so steady-state traffic never pays an "
                         "XLA compile mid-request")
+    p.add_argument("--detect-dedup", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="graftfeed: collapse duplicate query triples "
+                        "across coalesced requests into one unique-"
+                        "query device dispatch (the host scatter-back "
+                        "keeps every request's bits identical); "
+                        "--no-detect-dedup dispatches every real pair")
+    p.add_argument("--stream-prefetch", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="graftfeed: while a dispatch computes, warm "
+                        "the advisory slices the QUEUED requests' "
+                        "bucket ranges will touch (streamed tables "
+                        "only; advisory — a failed prefetch costs one "
+                        "cold upload); --no-stream-prefetch disables")
     p.add_argument("--mesh-devices", type=int, default=0,
                    help="shard the detect join over a dp×db mesh of N "
                         "devices with meshguard per-device fault "
@@ -1133,7 +1147,9 @@ def cmd_server(args) -> int:
         coalesce_wait_ms=getattr(args, "detect_coalesce_wait_ms", 2.0),
         max_pairs_in_flight=getattr(args, "detect_max_inflight_pairs",
                                     1 << 22),
-        warmup=getattr(args, "detect_warmup", False))
+        warmup=getattr(args, "detect_warmup", False),
+        dedup=getattr(args, "detect_dedup", True),
+        prefetch=getattr(args, "stream_prefetch", True))
     # meshguard: shard detection over a device mesh with per-device
     # fault domains (shrink on loss, grow on readmission)
     from .server.listen import MeshOptions
@@ -1150,7 +1166,8 @@ def cmd_server(args) -> int:
                                     250.0),
         table_device_budget_mb=getattr(args, "table_device_budget_mb",
                                        0.0),
-        table_stream_slices=getattr(args, "table_stream_slices", 0))
+        table_stream_slices=getattr(args, "table_stream_slices", 0),
+        stream_prefetch=getattr(args, "stream_prefetch", True))
     # graftmemo + redetectd: result memoization keyed by (blob digest,
     # db_version), with the post-swap background re-detect sweep
     from .detect.redetect import RedetectOptions

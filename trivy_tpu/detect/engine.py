@@ -43,6 +43,7 @@ from ..resilience.hostjoin import (
     CompactBits, host_csr_pair_join, host_csr_pair_join_compact,
     host_pair_join,
 )
+from . import feed as _feed
 
 _log = _get_logger("detect")
 
@@ -77,6 +78,17 @@ class _PendingCompact(NamedTuple):
     h_cap: int
     t_pad: int
     site: str = "detect"   # graftprof ledger attribution for the fetch
+
+
+class _StagedMerged(NamedTuple):
+    """One stage_merged result: the merged descriptors, the resolved
+    dedup plan, the launch-shaped (possibly unique-collapsed) columns,
+    and their staged device upload — everything dispatch_merged needs
+    to replay the stage without recomputing or re-uploading."""
+    merged: tuple
+    plan: Any
+    launch: tuple
+    queries: Any   # feed.StagedQueries
 
 
 def slice_bits(bits, off: int, n: int):
@@ -150,10 +162,16 @@ class BatchDetector:
                  pair_growth: float = 2.0,
                  max_pairs_in_flight: int = 1 << 22,
                  assemble_workers: int = 2, compact: bool = True,
-                 hit_floor: int = 128, hit_align: int = 128):
+                 hit_floor: int = 128, hit_align: int = 128,
+                 dedup: bool = True):
         import threading
         self.table = table
         self.pair_floor = pair_floor
+        # graftfeed: collapse duplicate query triples in merged
+        # dispatches (detect/feed.py); also the capability marker
+        # detectd keys on — a detector without the attribute gets the
+        # legacy dispatch_merged(preps) call
+        self.dedup = dedup
         # geometric bucket ladder for padded dispatch shapes; 2.0 with
         # a pow2 floor reproduces the legacy next_pow2 policy exactly
         self.pair_growth = pair_growth
@@ -582,8 +600,17 @@ class BatchDetector:
     def _launch(self, q_start: np.ndarray, q_count: np.ndarray,
                 q_ver: np.ndarray, total: int, t_pad: int, u_pad: int,
                 warm: bool = False, h_cap: int | None = None,
-                site: str = "detect"):
+                site: str = "detect",
+                staged: _feed.StagedQueries | None = None):
         """Ship CSR descriptors and launch the join (async).
+
+        graftfeed: `staged` carries a pre-issued query-column upload
+        (detectd stages dispatch i+1's columns while dispatch i
+        computes); the launch then only waits for residency — the
+        steady-state query_upload stall ≈ 0. Without one, the columns
+        upload inline (the cold path, ledgered as such). A staging
+        failure was already supervised and breaker-charged at stage
+        time, so it degrades straight to the host join here.
 
         graftprof: `site` attributes the dispatch in the ledger
         ("detect" per-request, "detectd" via dispatch_merged); a
@@ -608,6 +635,11 @@ class BatchDetector:
             h_cap = self._hit_capacity(t_pad)
         if GUARD.blameless_active():
             site = "redetect"
+        if staged is not None and staged.error is not None:
+            _log.warning("staged query upload had failed; "
+                         "host-fallback join")
+            return self._host_join_csr(q_start, q_count, q_ver, total,
+                                       t_pad, h_cap)
         if not GUARD.allow_device():
             return self._host_join_csr(q_start, q_count, q_ver, total,
                                        t_pad, h_cap)
@@ -631,11 +663,22 @@ class BatchDetector:
                 if new_shape:
                     failpoint("detect.compile")
                 failpoint("detect.dispatch")
+                if staged is not None and staged.refs is not None:
+                    qs_dev, qc_dev, qv_dev = staged.take()
+                else:
+                    # cold: the upload runs inside the launch window
+                    # (and, per-request, inside the dispatch watch, so
+                    # a wedged one trips the same watchdog). device_put
+                    # is async on real accelerators — the measured
+                    # stall is issue time; the kernel pays residency
+                    t_up = time.perf_counter()
+                    qs_dev, qc_dev, qv_dev = _feed.upload_queries(
+                        q_start, q_count, q_ver, prefetched=False)
+                    LEDGER.note_shard_wait(
+                        "query_upload",
+                        (time.perf_counter() - t_up) * 1e3, cold=True)
                 args = (adv_lo, adv_hi, adv_flags, ver_dev,
-                        jax.device_put(q_start),
-                        jax.device_put(q_count),
-                        jax.device_put(q_ver),
-                        np.int32(total))
+                        qs_dev, qc_dev, qv_dev, np.int32(total))
 
                 def _kernel():
                     if h_cap:
@@ -772,7 +815,26 @@ class BatchDetector:
                      t_pad: int) -> np.ndarray:
         """Fetch a merged (coalesced) dispatch's bits; on a supervised
         failure rebuild the merged bit vector from each prep's host
-        join so every coalesced request still completes."""
+        join so every coalesced request still completes.
+
+        graftfeed: a deduped dispatch (PendingExpand) fetches the
+        unique-space result and scatters it back through the plan's
+        index map; its fetch-failure rebuild runs the host join over
+        the SAME unique descriptor set (then scatters identically) —
+        the hostjoin contract survives dedup by construction."""
+        if isinstance(dev, _feed.PendingExpand):
+            try:
+                bits_u = self._fetch_bits(dev.dev)
+            except DeviceError:
+                # _host_join_csr counts the one bad device_serving
+                # event itself (unlike the per-prep rebuild below)
+                _log.warning(
+                    "merged device fetch failed; rebuilding the "
+                    "unique-query join on the host", exc_info=True)
+                ls, lc, lv, l_total, l_tpad = dev.launch
+                bits_u = self._host_join_csr(ls, lc, lv, l_total,
+                                             l_tpad, h_cap=0)
+            return _feed.expand_bits(dev.plan, bits_u, t_pad)
         try:
             return self._fetch_bits(dev)
         except DeviceError:
@@ -797,7 +859,38 @@ class BatchDetector:
                             prep.n_pairs, int(prep.pair_row.shape[0]),
                             prep.u_pad)
 
-    def dispatch_merged(self, preps: list[_Prepared]):
+    def _plan_and_launch_args(self, preps: list[_Prepared], plan):
+        """Resolve the dedup plan and the launch-shaped descriptor set
+        for one merged dispatch (shared by stage_merged and
+        dispatch_merged so the two can never disagree on what ships).
+        → (merged tuple, plan | None, (q_start, q_count, q_ver,
+        total, t_pad) actually launched)."""
+        merged = self._merge_descriptors(preps)
+        q_start, q_count, q_ver, _offsets, total, t_pad, _u_pad = \
+            merged
+        if plan is _feed.PLAN_AUTO:
+            plan = _feed.plan_merged(
+                q_start, q_count, q_ver,
+                [p.n_queries for p in preps]) if self.dedup else None
+        if plan is not None:
+            launch = _feed.padded_unique(plan, self.pair_floor,
+                                         self.pair_growth)
+        else:
+            launch = (q_start, q_count, q_ver, total, t_pad)
+        return merged, plan, launch
+
+    def stage_merged(self, preps: list[_Prepared], plan=_feed.PLAN_AUTO):
+        """graftfeed: merge + dedup-plan + pre-upload the query
+        columns for a FUTURE dispatch_merged. detectd calls this
+        before parking on backpressure, so dispatch i+1's H2D
+        transfer rides dispatch i's device time; the result hands
+        back into dispatch_merged(staged=...)."""
+        merged, plan, launch = self._plan_and_launch_args(preps, plan)
+        queries = _feed.stage_queries(launch[0], launch[1], launch[2])
+        return _StagedMerged(merged, plan, launch, queries)
+
+    def dispatch_merged(self, preps: list[_Prepared],
+                        plan=_feed.PLAN_AUTO, staged=None):
         """ONE device dispatch covering several prepared batches — the
         coalescing primitive detectd (detect/sched.py) is built on.
 
@@ -810,17 +903,41 @@ class BatchDetector:
         ordinary _assemble over it is bit-identical to an uncoalesced
         dispatch by construction — the predicate is elementwise.
 
-        Returns (device bits, per-prep bit offsets, t_pad)."""
-        q_start, q_count, q_ver, offsets, total, t_pad, u_pad = \
-            self._merge_descriptors(preps)
+        graftfeed: with a dedup `plan` (PLAN_AUTO computes one when
+        self.dedup), the join dispatches over the collapsed
+        unique-query descriptors only and the fetch scatters the bits
+        back through the plan's host-side index map — same contract,
+        fewer real pairs. `staged` replays a stage_merged result (the
+        double-buffered query upload); its merge/plan are reused
+        verbatim.
+
+        Returns (device bits, per-prep bit offsets, t_pad) — t_pad and
+        the offsets stay in FULL merged pair space either way (the
+        scheduler's in-flight accounting and slicing are dedup-blind)."""
+        if staged is not None:
+            merged, plan, launch = \
+                staged.merged, staged.plan, staged.launch
+            queries = staged.queries
+        else:
+            merged, plan, launch = self._plan_and_launch_args(preps,
+                                                              plan)
+            queries = None
+        _qs, _qc, _qv, offsets, total, t_pad, u_pad = merged
+        ls, lc, lv, l_total, l_tpad = launch
+        if self.dedup or plan is not None:
+            _feed.note_dedup_ratio(l_total if plan is not None
+                                   else total, total)
         with span("detect.dispatch", n_pairs=total, t_pad=t_pad,
-                  merged=len(preps)):
+                  merged=len(preps), deduped=plan is not None):
             # site="detectd": a merged dispatch is ONE ledger row, so
             # the per-site sums reconcile with the batch counter
             # without double-counting the coalesced requests
-            out = self._launch(q_start, q_count, q_ver, total, t_pad,
-                               u_pad, site="detectd")
+            out = self._launch(ls, lc, lv, l_total, l_tpad,
+                               u_pad, site="detectd", staged=queries)
         note_dispatch()
+        if plan is not None:
+            out = _feed.PendingExpand(out, plan,
+                                      (ls, lc, lv, l_total, l_tpad))
         return out, offsets, t_pad
 
     def _merge_descriptors(self, preps: list[_Prepared]):

@@ -50,21 +50,32 @@ import time
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass
 
+import numpy as np
+
+from ..log import get as _get_logger
 from ..metrics import METRICS
 from ..obs import cost as _cost
 from ..obs import current_trace_id, span
+from . import feed as _feed
 from .engine import BatchDetector, Hit, PkgQuery, slice_bits
+
+_log = _get_logger("sched")
 
 
 @dataclass
 class SchedOptions:
     """detectd knobs (server flags --detect-coalesce-wait-ms,
-    --detect-max-inflight-pairs, --detect-warmup)."""
+    --detect-max-inflight-pairs, --detect-warmup, --detect-dedup,
+    --stream-prefetch)."""
     coalesce_wait_ms: float = 2.0     # max wait gathering co-dispatchers
     max_pairs_in_flight: int = 1 << 22  # padded-pair in-flight bound
     warmup: bool = False              # pre-compile the bucket ladder
     warmup_max_pairs: int = 1 << 18   # top rung the warmup compiles
     enabled: bool = True              # False → per-request dispatch
+    dedup: bool = True                # graftfeed: collapse duplicate
+    #                                   query triples across the merge
+    prefetch: bool = True             # graftfeed: warm the next
+    #                                   dispatch's advisory slices
 
 
 class _Request:
@@ -145,6 +156,11 @@ class DispatchScheduler:
         self._cv = threading.Condition(self._lock)
         self._inflight_pairs = 0
         self._closed = False
+        # graftfeed prefetch peek: requests enqueued but not yet
+        # swept by the dispatcher, so a round that just dispatched can
+        # warm the advisory slices the NEXT round will touch. Guarded
+        # by self._lock; entries leave when the dispatcher dequeues
+        self._pending_reg: dict[int, _Request] = {}
         # daemon: an unclosed scheduler must not block interpreter
         # exit; close() still joins it for a clean shutdown
         self._thread = threading.Thread(
@@ -186,6 +202,7 @@ class DispatchScheduler:
                 raise RuntimeError("DispatchScheduler is closed")
             # enqueue under the lock: close() flips _closed before its
             # sentinel, so every accepted request precedes the sentinel
+            self._pending_reg[id(req)] = req
             self._queue.put(req)
         return req.future
 
@@ -242,6 +259,8 @@ class DispatchScheduler:
                 continue
             if item is None:
                 break
+            with self._lock:
+                self._pending_reg.pop(id(item), None)
             pending = [item]
             pairs = item.n_pairs
             # sweep everything already queued (free coalescing), then
@@ -268,6 +287,8 @@ class DispatchScheduler:
                 if nxt is None:
                     stop = True
                     break
+                with self._lock:
+                    self._pending_reg.pop(id(nxt), None)
                 pending.append(nxt)
                 pairs += nxt.n_pairs
             METRICS.observe("trivy_tpu_detect_queue_depth",
@@ -278,6 +299,8 @@ class DispatchScheduler:
                 # survive any one round; the affected requests fail
                 for req in pending:
                     req.fail(e)
+            if opts.prefetch:
+                self._prefetch_pending()
         # flush anything enqueued before the sentinel
         while True:
             try:
@@ -286,10 +309,43 @@ class DispatchScheduler:
                 break
             if left is None:
                 continue
+            with self._lock:
+                self._pending_reg.pop(id(left), None)
             try:
                 self._dispatch_round([left])
             except BaseException as e:  # noqa: BLE001
                 left.fail(e)
+
+    def _prefetch_pending(self) -> None:
+        """graftfeed slice prefetch: peek the requests still queued
+        behind the round that just dispatched and ask a streaming
+        detector to warm the advisory slices their bucket ranges will
+        touch. Advisory only — any failure costs at most a cold upload
+        on the next dispatch, never correctness — so every error is
+        swallowed here (the failpoint drill in tests/test_feed.py
+        leans on that)."""
+        pf = getattr(self.detector, "prefetch_ranges", None)
+        if pf is None:
+            return
+        with self._lock:
+            reqs = list(self._pending_reg.values())[:8]
+        if not reqs:
+            return
+        starts: list[np.ndarray] = []
+        counts: list[np.ndarray] = []
+        for r in reqs:
+            for _slot, p in r.slots:
+                k = p.n_queries
+                if k:
+                    starts.append(p.q_start[:k])
+                    counts.append(p.q_count[:k])
+        if not starts:
+            return
+        try:
+            pf(np.concatenate(starts), np.concatenate(counts))
+        except BaseException:  # noqa: BLE001 — latency-only path
+            _log.warning("pending-slice prefetch failed; the next "
+                         "dispatch uploads cold", exc_info=True)
 
     def _dispatch_round(self, pending: list[_Request]) -> None:
         """Chunk the gathered slots under the pair budget and issue one
@@ -301,6 +357,25 @@ class DispatchScheduler:
         def flush():
             if not chunk:
                 return
+            preps = [p for _, _, p in chunk]
+            det = self.detector
+            # graftfeed: merge + dedup-plan + stage the query upload
+            # BEFORE parking on backpressure — while a prior dispatch
+            # still owns the device, its compute time hides this
+            # chunk's H2D transfer (the input-path mirror of
+            # graftstream's shard double-buffering). Detectors without
+            # the graftfeed surface (test fakes, older shims) take the
+            # bare dispatch_merged path unchanged
+            dedup_on = self.opts.dedup and getattr(det, "dedup", False)
+            stage = getattr(det, "stage_merged", None)
+            staged = plan = None
+            if stage is not None:
+                staged = (stage(preps) if dedup_on
+                          else stage(preps, plan=None))
+                plan = staged.plan
+            elif hasattr(det, "dedup"):
+                plan = (_feed.plan_from_preps(preps) if dedup_on
+                        else None)
             # backpressure: admit this dispatch only when the in-flight
             # padded pairs leave room (a chunk bigger than the whole
             # budget still goes — alone — once the device drains)
@@ -309,7 +384,6 @@ class DispatchScheduler:
                     lambda: self._inflight_pairs == 0
                     or self._inflight_pairs + chunk_pairs <= budget,
                     timeout=30.0)
-            preps = [p for _, _, p in chunk]
             n_req = len({id(r) for r, _, _ in chunk})
             # run the merged dispatch under the FIRST request's
             # captured context: its spans join that request's trace
@@ -330,9 +404,22 @@ class DispatchScheduler:
             # vector into BOTH contexts the round runs under
             # (Context.run mutations persist in the Context object)
             now = time.perf_counter()
+            # graftcost x graftfeed: when a dedup plan collapsed
+            # duplicate triples, each request's share weight is its
+            # UNIQUE pair count (the pairs the device actually ran for
+            # it) and its collapsed duplicates are billed as
+            # work_avoided — priced by the device-ms-per-row EWMA, so
+            # a tenant riding another tenant's base layer shows the
+            # ride in avoided_ms instead of inflating device_ms
             per_req: dict[int, int] = {}
-            for r, _, p in chunk:
-                per_req[id(r)] = per_req.get(id(r), 0) + p.n_pairs
+            avoided: dict[int, int] = {}
+            for k, (r, _, p) in enumerate(chunk):
+                w = (int(plan.unique_by_prep[k]) if plan is not None
+                     else p.n_pairs)
+                per_req[id(r)] = per_req.get(id(r), 0) + w
+                if plan is not None:
+                    avoided[id(r)] = (avoided.get(id(r), 0)
+                                      + int(plan.collapsed_by_prep[k]))
                 if not r.queue_charged:
                     r.queue_charged = True
                     _cost.charge_queue_ms((now - r.t_submit) * 1e3,
@@ -343,13 +430,21 @@ class DispatchScheduler:
                 if id(r) not in seen:
                     seen.add(id(r))
                     shares.append((r.cost, per_req[id(r)]))
+                    av = avoided.get(id(r), 0)
+                    if av > 0:
+                        _cost.note_work_avoided(av, ledger=r.cost)
             dispatch_ctx.run(_cost.install_shares, shares)
             fetch_ctx.run(_cost.install_shares, shares)
 
             def _dispatch():
                 with span("detectd.round", merged=n_req,
                           trace_ids=",".join(tids[:16])):
-                    return self.detector.dispatch_merged(preps)
+                    if staged is not None:
+                        return det.dispatch_merged(preps,
+                                                   staged=staged)
+                    if hasattr(det, "dedup"):
+                        return det.dispatch_merged(preps, plan=plan)
+                    return det.dispatch_merged(preps)
 
             dev, offsets, t_pad = dispatch_ctx.run(_dispatch)
             METRICS.observe("trivy_tpu_detect_coalesce_size",

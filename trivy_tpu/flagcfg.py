@@ -45,6 +45,9 @@ CONFIG_PATHS = {
     "detect_coalesce_wait_ms": "detect.coalesce-wait-ms",
     "detect_max_inflight_pairs": "detect.max-inflight-pairs",
     "detect_warmup": "detect.warmup",
+    # graftfeed (input path): cross-request dedup + slice prefetch
+    "detect_dedup": "detect.dedup",
+    "stream_prefetch": "mesh.stream-prefetch",
     # graftguard (resilience.*): watchdog, breaker, admission,
     # failpoints
     "detect_dispatch_timeout_ms": "resilience.dispatch-timeout-ms",
@@ -148,7 +151,8 @@ def _explicit(action: argparse.Action, argv: list[str]) -> bool:
 def _coerce(action: argparse.Action, raw: Any, origin: str) -> Any:
     """Convert an env string / YAML value to the action's value type."""
     if isinstance(action, (argparse._StoreTrueAction,
-                           argparse._StoreFalseAction)):
+                           argparse._StoreFalseAction,
+                           argparse.BooleanOptionalAction)):
         if isinstance(raw, bool):
             return raw
         s = str(raw).strip().lower()

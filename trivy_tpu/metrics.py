@@ -454,9 +454,20 @@ METRICS.declare(
     "padded vectors, path=\"overflow\" the dense re-fetch a hit-"
     "buffer overflow pays on top of its wasted compact fetch — all "
     "device->host; path=\"shard_upload\" graftstream host->device "
-    "advisory-slice uploads) — unlike "
+    "advisory-slice uploads; path=\"query_upload\" graftfeed "
+    "host->device CSR query-column uploads) — unlike "
     "trivy_tpu_detect_transfer_bytes_total this series separates the "
     "overflow re-fetch and covers every ledger site.")
+METRICS.declare(
+    "trivy_tpu_detect_dedup_ratio", "histogram",
+    "graftfeed: unique pairs / real pairs per merged dispatch (1.0 = "
+    "no duplicate query triples collapsed; fleet traffic sharing fat "
+    "base layers should pile mass well below 0.5). Observed per "
+    "dispatch_merged whenever dedup is enabled, including "
+    "duplicate-free rounds, so the distribution says how duplicated "
+    "admitted traffic actually is.",
+    buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+             1.0))
 METRICS.declare(
     "trivy_tpu_device_hit_budget_adaptations_total", "counter",
     "Hit-buffer budget adaptations in the compaction epilogue "
@@ -481,11 +492,13 @@ METRICS.declare(
     "surfaces.")
 METRICS.declare(
     "trivy_tpu_device_upload_stall_ms", "histogram",
-    "graftstream: time one dispatch blocked making an advisory slice "
-    "device-resident. Double buffering prefetches the next slice "
-    "during the previous slice's compute, so steady-state stalls "
-    "sit in the lowest bucket; mass above it means transfer is "
-    "outrunning compute (shrink the slice count or grow the budget).",
+    "graftstream/graftfeed: time one dispatch blocked making an "
+    "advisory slice (or, for the query_upload ledger rows, its CSR "
+    "query columns) device-resident. Double buffering prefetches the "
+    "next upload during the previous dispatch's compute, so "
+    "steady-state stalls sit in the lowest bucket; mass above it "
+    "means transfer is outrunning compute (shrink the slice count or "
+    "grow the budget).",
     buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
              1000.0))
 METRICS.declare(

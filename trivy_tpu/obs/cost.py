@@ -316,15 +316,26 @@ def charge_secret_bytes(path: str, nbytes: float) -> None:
     _apportion(f"secret_bytes.{path}", nbytes)
 
 
-def note_work_avoided(units: int) -> None:
-    """Memo/cache replay: `units` detect units served without a
-    dispatch. Priced in ms via the EWMA exchange rate — an estimate,
-    surfaced as avoided_ms and excluded from conservation."""
-    if units <= 0:
+def note_work_avoided(units: int,
+                      ledger: CostLedger | None = None) -> None:
+    """Memo/cache replay — and graftfeed's merged-dispatch dedup:
+    `units` detect units (pairs) served without dispatching. Priced in
+    ms via the EWMA exchange rate — an estimate, surfaced as
+    avoided_ms and excluded from conservation. Pass `ledger` to bill a
+    specific request directly (detectd credits each coalesced
+    request's collapsed duplicates from the dispatcher thread, where
+    no request context is installed — the charge_queue_ms idiom);
+    without one the current context's shares/ledger/SYSTEM chain
+    applies."""
+    if units <= 0 or not _ENABLED:
         return
     ms = units * _EWMA.rate()
-    if ms > 0:
-        _apportion("avoided_ms", ms)
+    if ms <= 0:
+        return
+    if ledger is not None:
+        ledger.charge("avoided_ms", ms)
+        return
+    _apportion("avoided_ms", ms)
 
 
 @contextlib.contextmanager

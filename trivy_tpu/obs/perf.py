@@ -170,20 +170,23 @@ class DispatchLedger:
                 row["overflows"] += 1
 
     def note_shard_upload(self, site: str, nbytes: int,
-                          prefetched: bool) -> None:
-        """One host→device advisory-slice upload (graftstream).
+                          prefetched: bool,
+                          path: str = "shard_upload") -> None:
+        """One host→device upload (graftstream advisory slices;
+        graftfeed query columns with site/path "query_upload").
         `prefetched` means the double buffer shipped it AHEAD of need,
-        overlapped with the previous slice's compute; a non-prefetched
-        upload ran inside a dispatch's wait (the cold path). Counts in
-        the transfer ledger under path="shard_upload" so streaming
-        overhead shows at /debug/perf next to the result fetches."""
+        overlapped with the previous slice's (or dispatch's) compute;
+        a non-prefetched upload ran inside a dispatch's wait (the cold
+        path). Counts in the transfer ledger under `path` so streaming
+        and input-feed overhead show at /debug/perf next to the result
+        fetches."""
         with self._lock:
             row = self._uploads.setdefault(site, _new_upload_row())
             row["uploads"] += 1
             row["bytes"] += int(nbytes)
             if prefetched:
                 row["prefetched"] += 1
-        self.note_transfer("shard_upload", float(nbytes))
+        self.note_transfer(path, float(nbytes))
 
     def note_shard_wait(self, site: str, stall_ms: float,
                         cold: bool) -> None:

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..db.table import AdvisoryTable
+from ..detect import feed as _feed
 from ..ops import join as J
 from ..ops import next_pow2 as _next_pow2
 from ..resilience.hostjoin import CompactBits
@@ -214,6 +215,12 @@ class MeshDetector:
         self._inner = BatchDetector(table, compact=compact,
                                     hit_floor=hit_floor,
                                     hit_align=hit_align)
+        # graftfeed capability marker (detectd keys on it): merged
+        # dispatches accept a dedup plan and partition only the
+        # UNIQUE query set over the mesh
+        self.dedup = self._inner.dedup
+        self._stream_prefetch = bool(stream is not None
+                                     and stream.prefetch)
         # graftstream (stream=StreamOptions): when the PER-DEVICE
         # share of the sharded table (whole device footprint ÷ db
         # width) exceeds the budget, the table streams through a
@@ -348,32 +355,70 @@ class MeshDetector:
                 self._slice_cache.prefetch(k)
         return 0
 
-    def dispatch_merged(self, preps):
+    def prefetch_ranges(self, q_start, q_count) -> list[int]:
+        """graftfeed admission-aware prefetch, mesh edition: warm the
+        stream slices detectd's queued-request peek says the NEXT
+        dispatch will touch. No-op on a resident (unstreamed) mesh —
+        the whole table is already device-side. → issued slice
+        indices."""
+        if self._slice_cache is None or not self._stream_prefetch:
+            return []
+        from .stream import touched_slices
+        resident = set(self._slice_cache.resident())
+        issued: list[int] = []
+        for k in touched_slices(self._stream_bounds, q_start,
+                                q_count):
+            if k in resident:
+                continue
+            self._slice_cache.prefetch(k)
+            issued.append(k)
+            if len(issued) >= self._slice_cache.capacity:
+                break
+        return issued
+
+    def dispatch_merged(self, preps, plan=_feed.PLAN_AUTO):
         """ONE mesh dispatch covering several prepared batches (the
         detectd coalescing primitive, mesh edition). Concatenated CSR
         descriptors partition and join exactly like one bigger batch,
         so each prep's slice is bit-identical to its solo dispatch.
-        Returns (bits, per-prep offsets, t_pad) — bits are host-side
-        already (sharded_csr_join fetches synchronously)."""
+        With dedup engaged (graftfeed), only the UNIQUE query triples
+        partition over the mesh and the host scatter-back restores
+        the full merged pair space. Returns (bits, per-prep offsets,
+        t_pad) in FULL merged space — bits are host-side already
+        (sharded_csr_join fetches synchronously)."""
         from ..obs import note_dispatch, span
         inner = self._inner
-        q_start, q_count, q_ver, offsets, total, t_pad, u_pad = \
-            inner._merge_descriptors(preps)
+        merged, plan, launch = inner._plan_and_launch_args(preps, plan)
+        _qs, _qc, _qv, offsets, total, t_pad, u_pad = merged
+        ls, lc, lv, l_total, l_tpad = launch
 
-        def host_fallback():
-            return inner._host_bits_merged(preps, offsets, t_pad)
+        if plan is not None:
+            def host_fallback():
+                # same unique set as the device partition (h_cap=0:
+                # dense unique-space bits; expand_bits handles either)
+                return inner._host_join_csr(ls, lc, lv, l_total,
+                                            l_tpad, h_cap=0)
+        else:
+            def host_fallback():
+                return inner._host_bits_merged(preps, offsets, t_pad)
 
+        if self.dedup or plan is not None:
+            _feed.note_dedup_ratio(l_total, total)
         with span("detect.dispatch", n_pairs=total, t_pad=t_pad,
-                  merged=len(preps)):
-            bits = self._launch_mesh(q_start, q_count, q_ver, total,
-                                     t_pad, u_pad, host_fallback)
+                  merged=len(preps), deduped=plan is not None):
+            bits = self._launch_mesh(
+                ls, lc, lv, l_total, l_tpad, u_pad, host_fallback,
+                fallback_counts_slo=plan is not None)
+            if plan is not None:
+                bits = _feed.expand_bits(plan, bits, t_pad)
         note_dispatch()
         return bits, offsets, t_pad
 
     # ---- supervised mesh launch ----------------------------------------
 
     def _launch_mesh(self, q_start, q_count, q_ver, total: int,
-                     t_pad: int, u_pad: int, host_fallback):
+                     t_pad: int, u_pad: int, host_fallback,
+                     fallback_counts_slo: bool = False):
         """Partition the descriptors over the mesh and run the sharded
         join under graftguard + meshguard supervision. → int8[t_pad]
         host bits (identical whichever path served them).
@@ -397,8 +442,10 @@ class MeshDetector:
             # one bad device_serving event per mesh DISPATCH served
             # host-side (the inner _host_bits* helpers intentionally
             # do not observe — a merged rebuild would multiply one
-            # fault by the coalesce factor)
-            SLO.observe_join(False)
+            # fault by the coalesce factor; _host_join_csr counts its
+            # own, hence fallback_counts_slo)
+            if not fallback_counts_slo:
+                SLO.observe_join(False)
             return raw_fallback()
 
         if self.mesh is None or \

@@ -52,6 +52,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..detect import feed as _feed
 from ..log import get as _get_logger
 from ..metrics import METRICS
 from ..obs import SLO, note_dispatch, span
@@ -79,6 +80,10 @@ class StreamOptions:
     # (leaves headroom for the version pool, dispatch operands, and the
     # transient third slice while an eviction's buffers drain)
     resident: int = 2              # double buffer: slices kept uploaded
+    prefetch: bool = True          # graftfeed: honor admission-aware
+    # prefetch_ranges() peeks from detectd (warm the slices the NEXT
+    # dispatch's bucket ranges will touch); the in-walk double-buffer
+    # prefetch is unconditional — it is the streaming design itself
 
 
 def hbm_budget_bytes(fraction: float) -> int:
@@ -199,6 +204,30 @@ def clip_descriptors(bounds: np.ndarray, q_start: np.ndarray,
             q_count=cnt.astype(np.int32), q_ver=vers[m],
             total=total, gmap=gmap))
     return plans
+
+
+def touched_slices(bounds: np.ndarray, q_start: np.ndarray,
+                   q_count: np.ndarray) -> list[int]:
+    """Which hash-range slices would a dispatch over these CSR
+    descriptors touch? The interval math is clip_descriptors' —
+    per-query searchsorted of the bucket interval into the slice
+    bounds — without materializing any SlicePlan, so detectd's
+    admission peek (graftfeed prefetch) can ask cheaply for requests
+    it has NOT merged yet. → ascending slice indices."""
+    nz = q_count > 0
+    if not nz.any():
+        return []
+    starts = q_start[nz].astype(np.int64)
+    ends = starts + q_count[nz].astype(np.int64)
+    n = int(bounds.size - 1)
+    lo = np.clip(np.searchsorted(bounds, starts, "right") - 1,
+                 0, n - 1)
+    hi = np.clip(np.searchsorted(bounds, ends - 1, "right") - 1,
+                 0, n - 1)
+    mark = np.zeros(n, bool)
+    for a, b in np.unique(np.stack([lo, hi], axis=1), axis=0):
+        mark[int(a):int(b) + 1] = True
+    return [int(k) for k in np.nonzero(mark)[0]]
 
 
 def merge_slice_bits(results: list, t_pad: int):
@@ -350,6 +379,16 @@ class SliceCache:
         """Issue slice k's upload without waiting (the double-buffer
         overlap: called while the PREVIOUS slice's join computes). A
         failed prefetch only logs — the paying get() retries it."""
+        try:
+            # fired BEFORE _admit, so a tripped prefetch leaves no
+            # entry behind: the paying get() later re-admits and
+            # uploads cold — the fault costs one un-overlapped upload
+            # (latency), never a wedged or wrong entry (correctness)
+            failpoint("stream.prefetch")
+        except BaseException:  # noqa: BLE001 — latency-only by design
+            _log.warning("slice %d prefetch failpoint tripped; the "
+                         "dispatch uploads it cold", k)
+            return
         e, owner = self._admit(k)
         if not owner:
             return
@@ -421,6 +460,10 @@ class StreamingDetector:
         self._inner = BatchDetector(table, compact=compact,
                                     hit_floor=hit_floor,
                                     hit_align=hit_align)
+        # graftfeed capability marker (detectd keys on it): merged
+        # dispatches accept a dedup plan and walk the slices over the
+        # UNIQUE query set only
+        self.dedup = self._inner.dedup
         self.bounds = bounds if bounds is not None \
             else plan_slices(table, self.opts)
         if self.bounds is None:
@@ -517,37 +560,82 @@ class StreamingDetector:
             self._cache.prefetch(k)
         return 0
 
-    def dispatch_merged(self, preps):
+    def prefetch_ranges(self, q_start: np.ndarray,
+                        q_count: np.ndarray) -> list[int]:
+        """graftfeed admission-aware prefetch: detectd peeks the
+        requests still queued behind the round it just dispatched and
+        hands their (unmerged) bucket ranges here; warm the slices
+        that NEXT dispatch will touch while the device is busy.
+        Advisory — failures cost a cold upload, never correctness.
+        → the slice indices actually issued."""
+        if not self.opts.prefetch:
+            return []
+        resident = set(self._cache.resident())
+        issued: list[int] = []
+        for k in touched_slices(self.bounds, q_start, q_count):
+            if k in resident:
+                continue
+            self._cache.prefetch(k)
+            issued.append(k)
+            # never churn more than one resident set's worth — a peek
+            # spanning the whole table must not evict what the CURRENT
+            # walk still needs
+            if len(issued) >= self._cache.capacity:
+                break
+        return issued
+
+    def dispatch_merged(self, preps, plan=_feed.PLAN_AUTO):
         """ONE logical dispatch covering several prepared batches: the
         merged CSR descriptors walk the touched slices once, so N
         coalesced requests pay ONE pass over the resident set instead
         of N (the detectd coalescing contract, stream edition).
-        Returns (bits, per-prep offsets, t_pad); bits are host-side
-        already (the slice walk fetches synchronously)."""
+        With dedup engaged (graftfeed), the walk covers only the
+        UNIQUE query triples and the host scatter-back expands the
+        result to the full merged pair space — bit-identical by the
+        plan's construction. Returns (bits, per-prep offsets, t_pad)
+        in FULL merged space; bits are host-side already (the slice
+        walk fetches synchronously)."""
         inner = self._inner
-        q_start, q_count, q_ver, offsets, total, t_pad, u_pad = \
-            inner._merge_descriptors(preps)
+        merged, plan, launch = inner._plan_and_launch_args(preps, plan)
+        _qs, _qc, _qv, offsets, total, t_pad, u_pad = merged
+        ls, lc, lv, l_total, l_tpad = launch
 
-        def host_fallback():
-            return inner._host_bits_merged(preps, offsets, t_pad)
+        if plan is not None:
+            def host_fallback():
+                # same unique set as the device walk (h_cap=0: dense
+                # bits — expand_bits handles either, this is simplest)
+                return inner._host_join_csr(ls, lc, lv, l_total,
+                                            l_tpad, h_cap=0)
+        else:
+            def host_fallback():
+                return inner._host_bits_merged(preps, offsets, t_pad)
 
+        if self.dedup or plan is not None:
+            _feed.note_dedup_ratio(l_total, total)
         with span("detect.dispatch", n_pairs=total, t_pad=t_pad,
-                  merged=len(preps)):
-            bits = self._launch_stream(q_start, q_count, q_ver, total,
-                                       t_pad, u_pad, host_fallback)
+                  merged=len(preps), deduped=plan is not None):
+            bits = self._launch_stream(
+                ls, lc, lv, l_total, l_tpad, u_pad, host_fallback,
+                fallback_counts_slo=plan is not None)
+            if plan is not None:
+                bits = _feed.expand_bits(plan, bits, t_pad)
         note_dispatch()
         return bits, offsets, t_pad
 
     # ---- the supervised slice walk -------------------------------------
 
     def _launch_stream(self, q_start, q_count, q_ver, total: int,
-                       t_pad: int, u_pad: int, host_fallback):
+                       t_pad: int, u_pad: int, host_fallback,
+                       fallback_counts_slo: bool = False):
         """Walk the touched slices under graftguard supervision.
         → int8[t_pad] or CompactBits host bits, identical whichever
         path served them. The whole walk runs under ONE
         `detect.dispatch` watch: an open breaker never touches a
         device, and any launch/fetch failure or watchdog trip falls
-        back to the host join over the FULL table."""
+        back to the host join over the FULL table.
+        `fallback_counts_slo`: the fallback observes its own (single)
+        device_serving event — _host_join_csr does — so don't count a
+        second one here."""
         from ..ops import bucket_size
         from ..ops import join as J
         inner = self._inner
@@ -556,7 +644,8 @@ class StreamingDetector:
         def host_fallback():
             # one bad device_serving event per DISPATCH served
             # host-side (never per prep — the coalesce-factor lesson)
-            SLO.observe_join(False)
+            if not fallback_counts_slo:
+                SLO.observe_join(False)
             return raw_fallback()
 
         if total == 0:

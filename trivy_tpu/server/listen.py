@@ -69,6 +69,10 @@ class MeshOptions:
     # forces a slice count)
     table_device_budget_mb: float = 0.0
     table_stream_slices: int = 0
+    # graftfeed: admission-aware slice prefetch — detectd peeks its
+    # queue and warms the slices the next dispatch will touch
+    # (--stream-prefetch / --no-stream-prefetch)
+    stream_prefetch: bool = True
 
 
 class ServerState:
@@ -142,7 +146,8 @@ class ServerState:
             from ..parallel.stream import StreamOptions
             self.stream_opts = StreamOptions(
                 device_budget_mb=mesh_opts.table_device_budget_mb,
-                slices=mesh_opts.table_stream_slices)
+                slices=mesh_opts.table_stream_slices,
+                prefetch=mesh_opts.stream_prefetch)
         if mesh_opts is not None and mesh_opts.devices:
             import jax
 
