@@ -1687,6 +1687,17 @@ def device_child_main():
         archive_e2e = bench_archive_e2e(table)
     except Exception:
         archive_e2e = None
+    try:
+        # graftbom: SBOM pure-detect ingress with the chip in the
+        # detect tail
+        sbom_ingest = bench_sbom_ingest(
+            table, (archive_e2e or {}).get("images_per_sec_archive_e2e"))
+    except Exception:
+        sbom_ingest = None
+    try:
+        lib_version = bench_lib_version()
+    except Exception:
+        lib_version = None
 
     import jax
     payload = {
@@ -1714,6 +1725,8 @@ def device_child_main():
         "fleet_dedup": fleet_dedup,
         "chaos_storm": chaos_storm,
         "archive_e2e": archive_e2e,
+        "sbom_ingest": sbom_ingest,
+        "lib_version": lib_version,
         "device": str(jax.devices()[0]),
         "build_s": build_s,
         "scan_s": dev_s,
@@ -1760,6 +1773,222 @@ def bench_chaos_storm():
         "shed_rate": round(report.sheds() / n, 3),
         "requests": len(report.outcomes),
         "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+SBOM_DOCS = 32
+SBOM_PKGS_PER_DOC = 60
+SBOM_DUP_SCANS = 16
+SBOM_CONCURRENCY = 8
+
+
+def bench_sbom_ingest(table, archive_ips=None):
+    """graftbom scenario: SBOM documents as pure-detect workloads.
+    The document IS the inventory, so a ScanSBOM request skips the
+    whole fanal walk — the scenario measures docs/s through the RPC
+    (decode + detect + report), p99 at c=8, and the memo economics
+    the content-addressed blob identity buys: N duplicate documents
+    against a memo-wired server must store once and hit N-1 times.
+    `archive_ips` (the archive-e2e headline, when that scenario ran)
+    anchors the pure-detect-vs-archive ratio in the same tail."""
+    import base64
+    import threading
+    import urllib.request
+
+    import numpy as np
+    from trivy_tpu.metrics import METRICS
+    from trivy_tpu.server.listen import serve_background
+
+    rng = np.random.default_rng(29)
+    pool = synth_versions(rng, major_lo=4, major_hi=9)
+
+    def doc_bytes(i):
+        names = rng.integers(0, N_PKG_NAMES, SBOM_PKGS_PER_DOC)
+        vers = rng.integers(0, len(pool), SBOM_PKGS_PER_DOC)
+        comps = []
+        for n, v in zip(names, vers):
+            name, ver = f"pkg{int(n):05d}", pool[int(v)]
+            purl = f"pkg:apk/alpine/{name}@{ver}?distro=3.19.1"
+            comps.append({
+                "type": "library", "bom-ref": purl,
+                "name": name, "version": ver, "purl": purl,
+                "properties": [
+                    {"name": "aquasecurity:trivy:PkgType",
+                     "value": "alpine"},
+                    {"name": "aquasecurity:trivy:SrcName",
+                     "value": name},
+                    {"name": "aquasecurity:trivy:SrcVersion",
+                     "value": ver},
+                ]})
+        return json.dumps({
+            "bomFormat": "CycloneDX", "specVersion": "1.5",
+            "serialNumber": f"urn:uuid:bench-sbom-{i}", "version": 1,
+            "metadata": {"component": {
+                "type": "operating-system", "name": "alpine",
+                "version": "3.19.1",
+                "properties": [{"name": "aquasecurity:trivy:Type",
+                                "value": "alpine"}]}},
+            "components": comps,
+        }, sort_keys=True).encode()
+
+    docs = [doc_bytes(i) for i in range(SBOM_DOCS)]
+
+    def scan(url, raw, timeout=120):
+        body = json.dumps({
+            "target": "bench-sbom", "artifact_id": "",
+            "kind": "cyclonedx",
+            "document": base64.b64encode(raw).decode(),
+            "options": {"scanners": ["vuln"]}}).encode()
+        req = urllib.request.Request(
+            url + "/twirp/trivy.scanner.v1.Scanner/ScanSBOM",
+            data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    # phase 1 — throughput + tail latency, memo OFF: every scan pays
+    # the full decode + detect path (the pure-detect number, not the
+    # memo's)
+    httpd, state = serve_background("127.0.0.1", 0, table,
+                                    cache_dir="",
+                                    cache_backend="memory")
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        hits = 0
+        for d in docs:   # warm: every pair-capacity bucket compiles
+            scan(url, d)
+        t0 = time.perf_counter()
+        for d in docs:
+            r = scan(url, d)
+            hits += sum(len(res.get("Vulnerabilities") or [])
+                        for res in r.get("results") or [])
+        dt = time.perf_counter() - t0
+        docs_per_sec = SBOM_DOCS / dt
+
+        lat_ms: list = []
+        lat_lock = threading.Lock()
+
+        def worker(ids):
+            for i in ids:
+                t = time.perf_counter()
+                scan(url, docs[i % SBOM_DOCS])
+                ms = (time.perf_counter() - t) * 1e3
+                with lat_lock:
+                    lat_ms.append(ms)
+
+        threads = [threading.Thread(
+            target=worker,
+            args=(range(k, SBOM_DOCS * 2, SBOM_CONCURRENCY),))
+            for k in range(SBOM_CONCURRENCY)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        lat_ms.sort()
+        p99 = lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        state.close()
+
+    # phase 2 — duplicate-document economics, memo ON: the blob is
+    # keyed by document digest, so the N-1 re-scans never re-detect
+    httpd, state = serve_background("127.0.0.1", 0, table,
+                                    cache_dir="",
+                                    cache_backend="memory",
+                                    memo_backend="memory")
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        # memo counters are labeled by backend — read the family sum
+        # ("did ANY labeled series move"), like the fleet skew probes
+        h0 = METRICS.family_sum("trivy_tpu_memo_hits_total")
+        s0 = METRICS.family_sum("trivy_tpu_memo_stores_total")
+        for _ in range(SBOM_DUP_SCANS):
+            scan(url, docs[0])
+        memo_hits = METRICS.family_sum("trivy_tpu_memo_hits_total") - h0
+        memo_stores = (METRICS.family_sum("trivy_tpu_memo_stores_total")
+                       - s0)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        state.close()
+
+    out = {
+        "sbom_docs_per_sec": round(docs_per_sec, 2),
+        "sbom_p99_ms": round(p99, 2),
+        "sbom_hits": hits,
+        "sbom_memo_hit_rate": round(memo_hits / SBOM_DUP_SCANS, 3),
+        "sbom_memo_stores": memo_stores,
+        "docs": SBOM_DOCS,
+        "concurrency": SBOM_CONCURRENCY,
+    }
+    if archive_ips:
+        # how much the walk-free ingress buys over the archive path
+        # on comparable inventories (docs/s ÷ images/s)
+        out["sbom_vs_archive_e2e"] = round(
+            docs_per_sec / archive_ips, 2)
+    return out
+
+
+LIB_CORPUS_LIBS = 400
+LIB_VERSIONS_PER_LIB = 12
+LIB_OBSERVATIONS = 4096
+LIB_REPEATS = 5
+
+
+def bench_lib_version():
+    """graftbom second half: batched library-version confirmation.
+    A fingerprint corpus flattens through LibraryIndex into the
+    TABLE_SCHEMA arrays, observations dispatch through the UNCHANGED
+    BatchDetector path, and the NumPy mirror must agree hit-for-hit
+    on a subset (parity recorded, not fatal)."""
+    import numpy as np
+    from trivy_tpu.detect.engine import BatchDetector
+    from trivy_tpu.detect.libscan import (LibraryFingerprint,
+                                          LibraryIndex,
+                                          LibraryObservation)
+
+    rng = np.random.default_rng(31)
+    fps = []
+    for li in range(LIB_CORPUS_LIBS):
+        for vi in range(LIB_VERSIONS_PER_LIB):
+            fps.append(LibraryFingerprint(
+                corpus="bench-corpus", library=f"lib{li:04d}",
+                version=f"{vi % 4}.{vi}.{int(rng.integers(0, 10))}",
+                token=f"tok-{li:04d}-{vi}"))
+    t0 = time.perf_counter()
+    index = LibraryIndex.build(fps)
+    build_s = time.perf_counter() - t0
+
+    obs = []
+    for k in range(LIB_OBSERVATIONS):
+        f = fps[int(rng.integers(0, len(fps)))]
+        lying = rng.random() < 0.3
+        # half the lying versions are valid-but-wrong, half do not
+        # even tokenize (both must confirm nothing — the latter via
+        # the unparseable-skip both paths share)
+        ver = f.version if not lying \
+            else ("9.9.9" if k % 2 else f"{f.version}.junk")
+        obs.append(LibraryObservation(
+            corpus=f.corpus, token=f.token, declared_version=ver,
+            ref=k))
+    detector = BatchDetector(index.table)
+    try:
+        confirmed = index.detect(detector, obs)   # warm/compile
+        t1 = time.perf_counter()
+        for _ in range(LIB_REPEATS):
+            confirmed = index.detect(detector, obs)
+        dt = time.perf_counter() - t1
+        sub = obs[:256]
+        parity = index.oracle(sub) == index.detect(detector, sub)
+    finally:
+        detector.close()
+    return {
+        "lib_fingerprints_per_sec": round(
+            LIB_OBSERVATIONS * LIB_REPEATS / dt, 1),
+        "lib_index_build_ms": round(build_s * 1e3, 1),
+        "lib_corpus_rows": len(fps),
+        "lib_confirmed": len(confirmed),
+        "lib_oracle_parity": bool(parity),
     }
 
 
@@ -2121,6 +2350,25 @@ def main():
             result["archive_e2e"] = arch
         except Exception as e:
             diag.append(f"archive e2e bench failed: {e}")
+        try:
+            # graftbom scenario: SBOM pure-detect ingress (docs/s, p99
+            # at c=8, duplicate-doc memo economics) on the CPU
+            # backend; the device child's numbers override
+            sb = bench_sbom_ingest(
+                table, result.get("images_per_sec_archive_e2e"))
+            result["sbom_ingest"] = sb
+            result["sbom_docs_per_sec"] = sb["sbom_docs_per_sec"]
+        except Exception as e:
+            diag.append(f"sbom_ingest bench failed: {e}")
+        try:
+            # graftbom library-version confirmation through the
+            # unchanged BatchDetector path, NumPy-parity recorded
+            lv = bench_lib_version()
+            result["lib_version"] = lv
+            result["lib_fingerprints_per_sec"] = \
+                lv["lib_fingerprints_per_sec"]
+        except Exception as e:
+            diag.append(f"lib_version bench failed: {e}")
 
         # graftprof: the whole CPU pass's dispatch-ledger aggregate
         # (waste ratio, compile count/ms, bytes moved) — the device
@@ -2223,6 +2471,14 @@ def main():
                     dev["archive_e2e"]["images_per_sec_archive_e2e"]
                 result["archive_phase_ms"] = \
                     dev["archive_e2e"]["archive_phase_ms"]
+            if dev.get("sbom_ingest"):
+                result["sbom_ingest"] = dev["sbom_ingest"]
+                result["sbom_docs_per_sec"] = \
+                    dev["sbom_ingest"]["sbom_docs_per_sec"]
+            if dev.get("lib_version"):
+                result["lib_version"] = dev["lib_version"]
+                result["lib_fingerprints_per_sec"] = \
+                    dev["lib_version"]["lib_fingerprints_per_sec"]
             result["host_prep_ms"] = round(dev["host_prep_ms"], 1)
             result["device_ms"] = round(dev["device_ms"], 1)
             result["assemble_ms"] = round(dev["assemble_ms"], 1)

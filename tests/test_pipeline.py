@@ -630,7 +630,7 @@ class TestIngestBreakers:
         parse_exposition(METRICS.render())
         st = INGEST.status()
         assert st["partial_scans_total"] >= 1
-        assert set(st["breakers"]) == {"walk", "analyze"}
+        assert set(st["breakers"]) == {"walk", "analyze", "parse"}
 
 
 def test_cli_ingest_flag_defaults_match_dataclass():

@@ -1,0 +1,727 @@
+"""graftbom tier-1 gate: SBOM documents as first-class artifacts —
+decode round-trips (CycloneDX/SPDX, per-package-class version schema),
+hostile-input containment (deterministic annotated partials, never an
+exception, never a breaker charge for the input's fault), cross-path
+identity (archive scan == SBOM scan, device AND host fallback), memo
+economics (N duplicates → 1 store, N−1 hits; DB swap re-detects via
+redetectd), the ScanSBOM server route, the storm sbom lane, and the
+LibraryIndex ↔ NumPy-oracle parity of batched library-version
+detection."""
+
+import base64
+import json
+import time
+
+import pytest
+
+from helpers import ALPINE_OS_RELEASE, APK_INSTALLED, make_image
+from trivy_tpu import types as T
+from trivy_tpu.db.table import RawAdvisory, build_table
+from trivy_tpu.fanal.cache import MemoryCache, cache_key
+from trivy_tpu.fanal.pipeline import INGEST
+from trivy_tpu.metrics import METRICS
+from trivy_tpu.resilience import FAILPOINTS, GUARD
+from trivy_tpu.sbom.artifact import (DECODER_VERSIONS, PARSE_SITE,
+                                     SBOMArtifact, SBOMOptions,
+                                     doc_digest, json_depth)
+from trivy_tpu.sbom.cyclonedx import (decode_cyclonedx,
+                                      encode_cyclonedx)
+from trivy_tpu.sbom.spdx import decode_spdx, encode_spdx
+from trivy_tpu.scanner import LocalScanner
+
+PROP = "aquasecurity:trivy:"
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+    INGEST.reset_for_tests()
+    INGEST.configure(fail_threshold=3, reset_timeout_s=5.0)
+    yield
+    FAILPOINTS.configure("")
+    GUARD.reset_for_tests()
+    INGEST.reset_for_tests()
+    INGEST.configure(fail_threshold=3, reset_timeout_s=5.0)
+
+
+@pytest.fixture(scope="module")
+def table():
+    """Advisories matching the APK_INSTALLED fixture packages, so the
+    archive path and the SBOM path detect the same planted CVEs."""
+    raw, details = [], {}
+    for name, fixed in (("musl", "1.2.4-r0"),
+                        ("zlib", "1.2.14-r0"),
+                        ("libcrypto3", "3.0.8-r0")):
+        vid = f"CVE-2026-{name.upper()}"
+        raw.append(RawAdvisory(
+            source="alpine 3.17", ecosystem="alpine", pkg_name=name,
+            vuln_id=vid, fixed_version=fixed))
+        details[vid] = {"Title": f"planted {vid}",
+                        "Severity": "HIGH"}
+    return build_table(raw, details)
+
+
+def comp(name, version, ptype="alpine", distro="3.17.3", **extra):
+    purl = f"pkg:apk/alpine/{name}@{version}?distro={distro}"
+    c = {"type": "library", "bom-ref": extra.pop("bom_ref", purl),
+         "name": name, "version": version, "purl": purl,
+         "properties": [
+             {"name": PROP + "PkgType", "value": ptype},
+             {"name": PROP + "SrcName",
+              "value": extra.pop("src_name", name)},
+             {"name": PROP + "SrcVersion",
+              "value": extra.pop("src_version", version)}]}
+    c.update(extra)
+    return c
+
+
+def cdx_doc(components, os_name="alpine", os_version="3.17.3"):
+    return {
+        "bomFormat": "CycloneDX", "specVersion": "1.5",
+        "serialNumber": "urn:uuid:test-sbom", "version": 1,
+        "metadata": {"component": {
+            "type": "operating-system", "name": os_name,
+            "version": os_version,
+            "properties": [{"name": PROP + "Type",
+                            "value": os_name}]}},
+        "components": components,
+    }
+
+
+def doc_bytes(doc):
+    return json.dumps(doc, sort_keys=True).encode()
+
+
+# ---------------------------------------------------------------------------
+# content addressing
+
+
+class TestDocIdentity:
+    def test_digest_is_stable_and_content_keyed(self):
+        raw = doc_bytes(cdx_doc([comp("musl", "1.2.3-r4")]))
+        assert doc_digest(raw) == doc_digest(raw)
+        assert doc_digest(raw) != doc_digest(raw + b" ")
+        assert doc_digest(raw).startswith("sha256:")
+
+    def test_from_doc_is_key_order_independent(self):
+        a = {"bomFormat": "CycloneDX", "specVersion": "1.5",
+             "components": []}
+        b = {"components": [], "specVersion": "1.5",
+             "bomFormat": "CycloneDX"}
+        ra = SBOMArtifact.from_doc(a, MemoryCache())
+        rb = SBOMArtifact.from_doc(b, MemoryCache())
+        assert ra.digest == rb.digest
+
+    def test_duplicate_documents_share_one_blob(self, table):
+        cache = MemoryCache()
+        raw = doc_bytes(cdx_doc([comp("musl", "1.2.3-r4")]))
+        r1 = SBOMArtifact(raw, cache).inspect()
+        r2 = SBOMArtifact(raw, cache).inspect()
+        assert r1.id == r2.id == cache_key(doc_digest(raw),
+                                           DECODER_VERSIONS, {})
+        blob = cache.get_blob(r1.id)
+        assert blob.diff_id == doc_digest(raw)
+        assert not blob.ingest_errors
+
+    def test_json_depth_is_iterative_and_capped(self):
+        deep = {"a": 1}
+        for _ in range(5000):   # would blow a recursive walker
+            deep = {"d": deep}
+        assert json_depth(deep, 50) > 50
+        assert json_depth({"a": [1, {"b": 2}]}, 50) == 4
+
+
+# ---------------------------------------------------------------------------
+# decode: per-package-class version schema + lying-data tolerance
+
+
+class TestCycloneDXDecode:
+    def test_apk_class_keeps_joined_version(self):
+        d = decode_cyclonedx(cdx_doc([comp("musl", "1.2.3-r4")]))
+        (pkg,) = d.packages
+        # the apk analyzer keeps "ver-rN" whole with release empty
+        assert (pkg.version, pkg.release, pkg.epoch) == \
+            ("1.2.3-r4", "", 0)
+        assert d.os.family == "alpine" and d.os.name == "3.17.3"
+
+    def test_rpm_class_splits_epoch_version_release(self):
+        c = {"type": "library", "bom-ref": "r1", "name": "bash",
+             "version": "1:5.1.8-6.el9",
+             "purl": "pkg:rpm/centos/bash@5.1.8-6.el9?epoch=1",
+             "properties": [
+                 {"name": PROP + "PkgType", "value": "centos"},
+                 {"name": PROP + "SrcName", "value": "bash"},
+                 {"name": PROP + "SrcVersion",
+                  "value": "1:5.1.8-6.el9"}]}
+        d = decode_cyclonedx(cdx_doc([c], os_name="centos",
+                                     os_version="8"))
+        (pkg,) = d.packages
+        assert (pkg.epoch, pkg.version, pkg.release) == \
+            (1, "5.1.8", "6.el9")
+        assert (pkg.src_epoch, pkg.src_version, pkg.src_release) == \
+            (1, "5.1.8", "6.el9")
+
+    def test_deb_class_respects_pkg_release_property(self):
+        c = {"type": "library", "bom-ref": "d1", "name": "libc6",
+             "version": "2.31-13+deb11u5",
+             "purl": "pkg:deb/debian/libc6@2.31-13%2Bdeb11u5",
+             "properties": [
+                 {"name": PROP + "PkgType", "value": "debian"},
+                 {"name": PROP + "PkgRelease",
+                  "value": "13+deb11u5"}]}
+        d = decode_cyclonedx(cdx_doc([c], os_name="debian",
+                                     os_version="11"))
+        (pkg,) = d.packages
+        assert (pkg.version, pkg.release) == ("2.31", "13+deb11u5")
+
+    def test_duplicate_bom_refs_decode_once(self):
+        c1 = comp("musl", "1.2.3-r4", bom_ref="dup")
+        c2 = comp("musl", "9.9.9-r0", bom_ref="dup")
+        d = decode_cyclonedx(cdx_doc([c1, c2]))
+        assert len(d.packages) == 1
+        assert d.packages[0].version == "1.2.3-r4"   # first wins
+
+    def test_lying_epoch_property_degrades_to_zero(self):
+        c = comp("musl", "1.2.3-r4")
+        c["properties"].append({"name": PROP + "SrcEpoch",
+                                "value": "not-a-number"})
+        d = decode_cyclonedx(cdx_doc([c]))
+        assert d.packages[0].src_epoch == 0
+
+    def test_purl_qualifiers_canonicalized(self):
+        c = comp("musl", "1.2.3-r4")
+        c["purl"] = ("pkg:apk/alpine/musl@1.2.3-r4"
+                     "?distro=3.17.3&arch=x86_64")
+        d = decode_cyclonedx(cdx_doc([c]))
+        assert d.packages[0].identifier.purl == \
+            ("pkg:apk/alpine/musl@1.2.3-r4"
+             "?arch=x86_64&distro=3.17.3")
+        assert d.packages[0].arch == "x86_64"
+
+
+class TestRoundTrips:
+    def _archive_report(self, tmp_path, table):
+        from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+        from trivy_tpu.report.writer import build_report
+        img = str(tmp_path / "img.tar")
+        make_image(img, [{
+            "etc/os-release": ALPINE_OS_RELEASE,
+            "lib/apk/db/installed": APK_INSTALLED,
+        }])
+        cache = MemoryCache()
+        ref = ImageArchiveArtifact(img, cache).inspect()
+        scanner = LocalScanner(cache, table)
+        try:
+            results, os_info = scanner.scan(
+                ref.name, ref.id, ref.blob_ids,
+                T.ScanOptions(scanners=("vuln",),
+                              list_all_packages=True))
+        finally:
+            scanner.close()
+        return build_report(ref.name, "container_image", results,
+                            os_info), results, os_info
+
+    def test_cyclonedx_round_trip_preserves_analyzer_schema(
+            self, tmp_path, table):
+        report, _, os_info = self._archive_report(tmp_path, table)
+        doc = encode_cyclonedx(report)
+        d = decode_cyclonedx(doc)
+        assert (d.os.family, d.os.name) == (os_info.family,
+                                            os_info.name)
+        want = {(p.name, p.version, p.release, p.src_name,
+                 p.src_version)
+                for r in report.results
+                if r.clazz == T.ResultClass.OS_PKGS
+                for p in r.packages}
+        got = {(p.name, p.version, p.release, p.src_name,
+                p.src_version) for p in d.packages}
+        assert got == want and want
+
+    def test_cyclonedx_round_trip_preserves_trivy_properties(self):
+        pkg = T.Package(name="musl", version="1.2.3-r4",
+                        src_name="musl-src", src_version="1.2.3-r4",
+                        id="musl@1.2.3-r4", licenses=["MIT"])
+        res = T.Result(target="img (alpine 3.17.3)",
+                       clazz=T.ResultClass.OS_PKGS, type="alpine",
+                       packages=[pkg])
+        from trivy_tpu.report.writer import build_report
+        rep = build_report(
+            "img", "container_image", [res],
+            T.OS(family="alpine", name="3.17.3"))
+        d = decode_cyclonedx(encode_cyclonedx(rep))
+        (got,) = d.packages
+        assert got.id == "musl@1.2.3-r4"
+        assert got.src_name == "musl-src"
+        assert got.src_version == "1.2.3-r4"
+        assert got.licenses == ["MIT"]
+
+    def test_spdx_round_trip_lang_packages(self):
+        pkg = T.Package(name="flask", version="2.2.2",
+                        id="flask@2.2.2")
+        res = T.Result(target="requirements.txt",
+                       clazz=T.ResultClass.LANG_PKGS, type="pip",
+                       packages=[pkg])
+        from trivy_tpu.report.writer import build_report
+        rep = build_report("app", "filesystem", [res])
+        d = decode_spdx(encode_spdx(rep))
+        pkgs = [p for a in d.applications for p in a.packages]
+        assert [(p.name, p.version) for p in pkgs] == \
+            [("flask", "2.2.2")]
+
+
+# ---------------------------------------------------------------------------
+# hostile-input containment (the fanald tradition)
+
+
+class TestHostileContainment:
+    def _inspect(self, raw, opts=None, cache=None):
+        cache = cache if cache is not None else MemoryCache()
+        ref = SBOMArtifact(raw, cache, opts=opts).inspect()
+        return ref, cache.get_blob(ref.id)
+
+    @pytest.mark.parametrize("raw,kind", [
+        (b"not json at all {", "malformed"),
+        (b"\xff\xfe garbage bytes", "encoding"),
+        (b"[1, 2, 3]", "malformed"),
+        (b'{"bomFormat": "CycloneDX"', "malformed"),
+    ])
+    def test_malformed_is_annotated_partial_never_raise(self, raw,
+                                                        kind):
+        ref, blob = self._inspect(raw)
+        assert blob is not None
+        kinds = {e["Kind"] for e in blob.ingest_errors}
+        assert kind in kinds
+        assert all(e["Stage"] == PARSE_SITE
+                   for e in blob.ingest_errors)
+        # the canonical key stays missing: a later healthy decode
+        # never collides with the partial
+        canonical = cache_key(doc_digest(raw), DECODER_VERSIONS, {})
+        assert ref.id != canonical
+
+    def test_partial_id_is_deterministic(self):
+        raw = b"not json at all {"
+        r1, _ = self._inspect(raw)
+        r2, _ = self._inspect(raw)
+        assert r1.id == r2.id
+
+    def test_unknown_format_annotated(self):
+        ref, blob = self._inspect(b'{"hello": "world"}')
+        assert any(e["Kind"] == "format"
+                   for e in blob.ingest_errors)
+
+    def test_byte_budget_trips(self):
+        opts = SBOMOptions(max_doc_bytes=64)
+        raw = doc_bytes(cdx_doc([comp("musl", "1.2.3-r4")]))
+        _, blob = self._inspect(raw, opts=opts)
+        assert any(e["Kind"] == "budget.doc_bytes"
+                   for e in blob.ingest_errors)
+
+    def test_depth_bomb_trips_budget(self):
+        inner: dict = {"x": 1}
+        for _ in range(64):
+            inner = {"n": inner}
+        doc = cdx_doc([])
+        doc["metadata"]["deep"] = inner
+        _, blob = self._inspect(doc_bytes(doc),
+                                opts=SBOMOptions(max_depth=16))
+        assert any(e["Kind"] == "budget.depth"
+                   for e in blob.ingest_errors)
+
+    def test_component_bomb_clamps_to_deterministic_prefix(self):
+        comps = [comp(f"p{i}", "1.0.0-r0", bom_ref=f"#{i}")
+                 for i in range(40)]
+        _, blob = self._inspect(doc_bytes(cdx_doc(comps)),
+                                opts=SBOMOptions(max_components=8))
+        assert any(e["Kind"] == "budget.components"
+                   for e in blob.ingest_errors)
+        n = sum(len(pi.packages) for pi in blob.package_infos)
+        assert n == 8
+        assert [p.name for pi in blob.package_infos
+                for p in pi.packages] == [f"p{i}" for i in range(8)]
+
+    def test_lying_component_shapes_are_contained(self):
+        doc = cdx_doc([42, "nope", comp("musl", "1.2.3-r4")])
+        ref, blob = self._inspect(doc_bytes(doc))
+        # either a contained decode_error partial or a tolerant skip —
+        # never an exception out of inspect()
+        assert ref is not None and blob is not None
+
+    def test_input_faults_never_charge_the_parse_breaker(self):
+        cache = MemoryCache()
+        for _ in range(6):   # over the 3-failure threshold
+            SBOMArtifact(b"not json {", cache).inspect()
+        assert INGEST.breaker("parse").state_name() == "closed"
+
+
+class TestParseSupervision:
+    def test_failpoint_error_charges_breaker_then_recloses(self):
+        INGEST.configure(fail_threshold=2, reset_timeout_s=0.05)
+        FAILPOINTS.configure(f"{PARSE_SITE}=error")
+        cache = MemoryCache()
+        raw = doc_bytes(cdx_doc([comp("musl", "1.2.3-r4")]))
+        for _ in range(2):
+            ref = SBOMArtifact(raw, cache).inspect()
+            blob = cache.get_blob(ref.id)
+            assert any(e["Kind"] == "error"
+                       for e in blob.ingest_errors)
+        assert INGEST.breaker("parse").state_name() == "open"
+        # open breaker: instant annotated degrade, no decode attempt
+        ref = SBOMArtifact(raw, cache).inspect()
+        blob = cache.get_blob(ref.id)
+        assert any(e["Kind"] == "breaker_open"
+                   for e in blob.ingest_errors)
+        # reset window + healthy probe → the stage re-closes
+        FAILPOINTS.configure("")
+        time.sleep(0.08)
+        ref = SBOMArtifact(raw, cache).inspect()
+        assert not cache.get_blob(ref.id).ingest_errors
+        assert INGEST.breaker("parse").state_name() == "closed"
+
+    def test_hang_trips_watchdog_to_timeout_annotation(self):
+        INGEST.configure(fail_threshold=3, reset_timeout_s=5.0)
+        FAILPOINTS.configure(f"{PARSE_SITE}=hang:500")
+        cache = MemoryCache()
+        raw = doc_bytes(cdx_doc([comp("musl", "1.2.3-r4")]))
+        opts = SBOMOptions(parse_deadline_ms=40.0)
+        ref = SBOMArtifact(raw, cache, opts=opts).inspect()
+        blob = cache.get_blob(ref.id)
+        assert any(e["Kind"] == "timeout"
+                   for e in blob.ingest_errors)
+
+
+# ---------------------------------------------------------------------------
+# cross-path identity: archive scan == SBOM scan (acceptance)
+
+
+def vuln_key(results):
+    return {(v.vulnerability_id, v.pkg_name, v.installed_version,
+             v.fixed_version)
+            for r in results for v in r.vulnerabilities}
+
+
+class TestCrossPathIdentity:
+    def _sbom_scan(self, raw, table, cache=None):
+        cache = cache if cache is not None else MemoryCache()
+        ref = SBOMArtifact(raw, cache).inspect()
+        scanner = LocalScanner(cache, table)
+        try:
+            results, os_info = scanner.scan(
+                ref.name, ref.id, ref.blob_ids,
+                T.ScanOptions(scanners=("vuln",)))
+        finally:
+            scanner.close()
+        return results, os_info
+
+    def test_archive_and_sbom_paths_detect_identically(
+            self, tmp_path, table, monkeypatch):
+        from trivy_tpu.fanal.artifact import ImageArchiveArtifact
+        from trivy_tpu.report.writer import build_report
+        img = str(tmp_path / "img.tar")
+        make_image(img, [{
+            "etc/os-release": ALPINE_OS_RELEASE,
+            "lib/apk/db/installed": APK_INSTALLED,
+        }])
+        cache = MemoryCache()
+        ref = ImageArchiveArtifact(img, cache).inspect()
+        scanner = LocalScanner(cache, table)
+        try:
+            want, os_want = scanner.scan(
+                ref.name, ref.id, ref.blob_ids,
+                T.ScanOptions(scanners=("vuln",),
+                              list_all_packages=True))
+        finally:
+            scanner.close()
+        assert vuln_key(want)   # the fixture plants CVEs
+
+        monkeypatch.setenv("TRIVY_TPU_FAKE_UUID",
+                           "3ff14136-e09f-4df9-80ea-%012d")
+        monkeypatch.setenv("TRIVY_TPU_FAKE_NOW",
+                           "2021-08-25T12:20:30Z")
+        report = build_report(ref.name, "container_image", want,
+                              os_want)
+        raw = doc_bytes(encode_cyclonedx(report))
+
+        got, os_got = self._sbom_scan(raw, table)
+        assert (os_got.family, os_got.name) == (os_want.family,
+                                                os_want.name)
+        assert vuln_key(got) == vuln_key(want)
+
+        # host-fallback path (open device breaker): identical again
+        GUARD.breaker.trip()
+        degraded, _ = self._sbom_scan(raw, table)
+        assert GUARD.breaker.state_name() == "open"
+        assert vuln_key(degraded) == vuln_key(want)
+
+
+# ---------------------------------------------------------------------------
+# memo economics + redetectd (acceptance)
+
+
+class TestSBOMMemo:
+    def test_duplicates_are_one_store_n_minus_one_hits(self, table):
+        from trivy_tpu.fleet.memo import MemoryMemo
+        cache = MemoryCache()
+        memo = MemoryMemo()
+        raw = doc_bytes(cdx_doc(
+            [comp("musl", "1.2.3-r4"), comp("zlib", "1.2.13-r0")]))
+        ref = SBOMArtifact(raw, cache).inspect()
+        scanner = LocalScanner(cache, table, memo=memo)
+        n = 4
+        try:
+            baseline = None
+            for _ in range(n):
+                results, _ = scanner.scan(
+                    ref.name, ref.id, ref.blob_ids,
+                    T.ScanOptions(scanners=("vuln",)))
+                key = vuln_key(results)
+                assert baseline is None or key == baseline
+                baseline = key
+            assert baseline   # replays carry the planted CVEs
+        finally:
+            scanner.close()
+        stats = memo.key_stats(ref.id, table.content_digest())
+        assert stats["stores"] == 1
+        assert stats["hits"] == n - 1
+
+    def test_db_swap_redetects_via_sweep_then_hits(self, table):
+        from trivy_tpu.resilience.storm import _post
+        from trivy_tpu.server.listen import serve_background
+        raw2, details2 = [RawAdvisory(
+            source="alpine 3.17", ecosystem="alpine",
+            pkg_name="musl", vuln_id="CVE-2027-NEW",
+            fixed_version="1.3.0-r0")], \
+            {"CVE-2027-NEW": {"Title": "post-swap", "Severity": "LOW"}}
+        table2 = build_table(raw2, details2)
+        httpd, state = serve_background(
+            "127.0.0.1", 0, table, cache_dir="",
+            cache_backend="memory", memo_backend="memory")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        raw = doc_bytes(cdx_doc([comp("musl", "1.2.3-r4")]))
+        body = {"target": "t", "kind": "cyclonedx",
+                "artifact_id": doc_digest(raw),
+                "document": base64.b64encode(raw).decode(),
+                "options": {"scanners": ["vuln"]}}
+        route = "/twirp/trivy.scanner.v1.Scanner/ScanSBOM"
+        try:
+            code, _, _ = _post(base, route, body, 30)
+            assert code == 200    # seeds the memo's known-blob set
+            state.swap_table(table2)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                st = state.redetect.status()
+                if st["phase"] in ("done", "cancelled", "failed"):
+                    break
+                time.sleep(0.02)
+            assert st["phase"] == "done"
+            assert st["db_version"] == table2.content_digest()
+            # the sweep's fresh entry serves the post-swap scan
+            h0 = METRICS.get("trivy_tpu_memo_hits_total",
+                             backend="memory")
+            code, headers, resp = _post(base, route, body, 30)
+            assert code == 200
+            assert headers.get("X-Trivy-DB-Version") == \
+                table2.content_digest()
+            vids = {v["VulnerabilityID"]
+                    for r in resp.get("results") or []
+                    for v in r.get("Vulnerabilities") or []}
+            assert vids == {"CVE-2027-NEW"}
+            assert METRICS.get("trivy_tpu_memo_hits_total",
+                               backend="memory") > h0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+
+
+# ---------------------------------------------------------------------------
+# ScanSBOM route + client
+
+
+class TestScanSBOMServer:
+    def test_client_scan_sbom_end_to_end(self, table):
+        from trivy_tpu.server.client import RemoteScanner
+        from trivy_tpu.server.listen import serve_background
+        httpd, state = serve_background("127.0.0.1", 0, table,
+                                        cache_dir="",
+                                        cache_backend="memory")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        raw = doc_bytes(cdx_doc([comp("musl", "1.2.3-r4")]))
+        try:
+            client = RemoteScanner(base)
+            results, os_info = client.scan_sbom("img.cdx", raw)
+            assert (os_info.family, os_info.name) == ("alpine",
+                                                      "3.17.3")
+            assert {v.vulnerability_id
+                    for r in results
+                    for v in r.vulnerabilities} == \
+                {"CVE-2026-MUSL"}
+            # SBOM results carry the doc digest as the memo identity
+            layers = {v.layer.diff_id for r in results
+                      for v in r.vulnerabilities}
+            assert layers == {doc_digest(raw)}
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+
+    def test_hostile_document_is_200_annotated_never_5xx(self, table):
+        from trivy_tpu.resilience.storm import _post
+        from trivy_tpu.server.listen import serve_background
+        httpd, state = serve_background("127.0.0.1", 0, table,
+                                        cache_dir="",
+                                        cache_backend="memory")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        route = "/twirp/trivy.scanner.v1.Scanner/ScanSBOM"
+        try:
+            for raw in (b"not json {",
+                        doc_bytes(cdx_doc([comp("m", "1")]))[:40]):
+                code, _, resp = _post(base, route, {
+                    "target": "bad", "kind": "cyclonedx",
+                    "document": base64.b64encode(raw).decode(),
+                    "options": {"scanners": ["vuln"]}}, 30)
+                assert code == 200
+                classes = {r.get("Class")
+                           for r in resp.get("results") or []}
+                assert "ingest" in classes
+            assert INGEST.breaker("parse").state_name() == "closed"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+
+
+# ---------------------------------------------------------------------------
+# storm: the sbom ingest lane
+
+
+class TestStormSBOMLane:
+    def test_ingest_fault_menu_sites_are_cataloged(self):
+        from trivy_tpu.resilience.failpoints import known_site
+        from trivy_tpu.resilience.storm import _INGEST_FAULTS
+        sbom = [(s, m) for s, m in _INGEST_FAULTS
+                if s == PARSE_SITE]
+        assert {m for _, m in sbom} == {"error", "hang", "flaky"}
+        for site, _mode in _INGEST_FAULTS:
+            assert known_site(site), site
+
+    def test_parse_hang_drill_c8_watchdog_trips_breaker_recloses(
+            self):
+        from trivy_tpu.resilience.storm import (Schedule, StormEvent,
+                                                StormOptions,
+                                                run_storm)
+        sched = Schedule(seed=219, topology="ingest",
+                         horizon_ms=1500.0, events=[
+            StormEvent(at_ms=100.0, kind="failpoint",
+                       site=PARSE_SITE, mode="hang", arg=550,
+                       dur_ms=700.0),
+            StormEvent(at_ms=300.0, kind="hostile_layer",
+                       variant="truncated", dur_ms=400.0),
+        ])
+        rep = run_storm(sched, StormOptions(
+            requests=12, concurrency=8, watchdog_ms=50.0,
+            breaker_reset_ms=150.0))
+        assert rep.ok, rep.violations
+        # the odd-indexed lane went through ScanSBOM; every outcome
+        # settled (run_storm's probes also checked breaker re-close
+        # and bit-identity per lane)
+        sbom_lane = [o for o in rep.outcomes if o.idx % 2]
+        assert sbom_lane
+        assert all(o.status in ("ok", "shed") for o in sbom_lane)
+
+
+# ---------------------------------------------------------------------------
+# LibraryIndex: batched library-version detection (acceptance)
+
+
+def lib_corpus(n_libs=40, n_vers=4):
+    from trivy_tpu.detect.libscan import LibraryFingerprint
+    fps = []
+    for li in range(n_libs):
+        for vi in range(n_vers):
+            ver = f"{vi}.{li % 7}.{(li * vi) % 5}"
+            fps.append(LibraryFingerprint(
+                corpus="test-corpus", library=f"lib{li:03d}",
+                version=ver, token=f"tok-{li:03d}-{vi}"))
+    return fps
+
+
+class TestLibraryIndex:
+    def test_build_is_order_independent_and_deduped(self):
+        from trivy_tpu.detect.libscan import LibraryIndex
+        fps = lib_corpus()
+        a = LibraryIndex.build(fps)
+        b = LibraryIndex.build(list(reversed(fps)) + fps[:5])
+        assert a.content_digest() == b.content_digest()
+        assert a.fingerprints == b.fingerprints
+
+    def test_digest_is_salted_against_cve_tables(self):
+        from trivy_tpu.detect.libscan import LibraryIndex
+        idx = LibraryIndex.build(lib_corpus())
+        assert idx.content_digest() != idx.table.content_digest()
+
+    def test_queries_skip_unversioned_observations(self):
+        from trivy_tpu.detect.libscan import (LibraryIndex,
+                                              LibraryObservation)
+        idx = LibraryIndex.build(lib_corpus())
+        obs = [LibraryObservation("test-corpus", "tok-000-1",
+                                  "1.0.0"),
+               LibraryObservation("test-corpus", "tok-000-2", "")]
+        qs = idx.queries(obs)
+        assert len(qs) == 1
+        assert qs[0].ref is obs[0]
+
+    def test_detect_matches_numpy_oracle_hit_for_hit(self):
+        from trivy_tpu.detect.engine import BatchDetector
+        from trivy_tpu.detect.libscan import (LibraryIndex,
+                                              LibraryObservation)
+        fps = lib_corpus()
+        idx = LibraryIndex.build(fps)
+        obs = []
+        for k, f in enumerate(fps[:120]):
+            if k % 3 == 0:
+                ver = f.version              # honest declaration
+            elif k % 3 == 1:
+                ver = "9.9.9"                # lying but parseable
+            else:
+                ver = f"{f.version}.junk"    # unparseable → skipped
+            obs.append(LibraryObservation(f.corpus, f.token, ver,
+                                          ref=k))
+        det = BatchDetector(idx.table)
+        try:
+            got = idx.detect(det, obs)
+        finally:
+            det.close()
+        want = idx.oracle(obs)
+        assert {o.ref for o in got} == {o.ref for o in want}
+        for o in want:
+            assert got[o] == want[o]
+        # honest declarations confirm their own (library, version)
+        honest = [o for o in obs if o.ref % 3 == 0]
+        assert honest and all(o in want for o in honest)
+        # lying/unparseable declarations never confirm
+        assert all(o not in want for o in obs if o.ref % 3)
+
+    def test_flatten_failpoint_fails_loudly(self):
+        from trivy_tpu.detect.libscan import FLATTEN_SITE, LibraryIndex
+        FAILPOINTS.configure(f"{FLATTEN_SITE}=error")
+        with pytest.raises(Exception):
+            LibraryIndex.build(lib_corpus(4, 2))
+
+
+# ---------------------------------------------------------------------------
+# perfcheck knows the new bench keys' good directions
+
+
+class TestPerfcheckDirections:
+    @pytest.mark.parametrize("path,want", [
+        ("sbom_docs_per_sec", "higher"),
+        ("sbom_p99_ms", "lower"),
+        ("sbom_memo_hit_rate", "higher"),
+        ("lib_fingerprints_per_sec", "higher"),
+        ("lib_version.lib_index_build_ms", "lower"),
+        ("sbom_ingest.sbom_p99_ms", "lower"),
+    ])
+    def test_direction(self, path, want):
+        from trivy_tpu.obs.perfcheck import direction
+        assert direction(path) == want

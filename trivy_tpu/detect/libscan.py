@@ -1,0 +1,224 @@
+"""graftbom LibraryIndex: batched library-version detection on the
+unchanged advisory join engine.
+
+ATVHunter and LibAM (PAPERS.md) both reduce third-party-library
+detection to the same shape as CVE matching: a corpus maps fingerprint
+tokens (per-version build signatures) to (library, version) pairs, and
+an observed binary's tokens are looked up against it. That lookup IS
+the hash-sorted columnar join this repo already runs for advisories —
+so a fingerprint corpus flattens into the `AdvisoryTable` array schema
+(`TABLE_SCHEMA`-compatible hash-sorted columns) and version detection
+dispatches through `BatchDetector`, detectd coalescing,
+`csr_pair_join_compact`, and the host-join fallback with ZERO new
+device code.
+
+Encoding:
+
+  bucket (source)   `libfp::<corpus>` — prefix-scannable like the
+                    language ecosystems' `pip::` buckets, and disjoint
+                    from every advisory source so a LibraryIndex can
+                    share a process with a CVE table without key
+                    collisions.
+  pkg_name          the fingerprint token (the join key the hash
+                    columns sort on).
+  vuln_id           `<library>@<version>` — a "hit" identifies one
+                    concrete library version containing the token.
+  constraint        `>=v, <=v` — the exact-version interval, always
+                    token-encodable, so corpus rows never take the
+                    raw-spec host path.
+
+A query carries the DECLARED version (from a purl or lockfile): a hit
+confirms the declaration, an observation whose tokens hit only OTHER
+versions exposes a lying purl. The NumPy mirror (`oracle`) recomputes
+the same hits from first principles for parity tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import version as V
+from ..db.table import AdvisoryTable, RawAdvisory, build_table
+from ..metrics import METRICS
+from ..obs.perf import LEDGER
+from ..resilience import failpoint
+
+FLATTEN_SITE = "libscan.flatten"
+
+# version scheme for corpus versions; "semver" covers the java/native
+# library corpora the fingerprint literature targets
+LIB_ECOSYSTEM = "semver"
+
+SOURCE_PREFIX = "libfp::"
+
+
+@dataclass(frozen=True)
+class LibraryFingerprint:
+    """One corpus record: `token` (a per-version build signature —
+    class-signature hash, export-table digest, ...) observed in
+    `library` at exactly `version`."""
+    corpus: str
+    library: str
+    version: str
+    token: str
+
+
+@dataclass(frozen=True)
+class LibraryObservation:
+    """One observed token with the version its container DECLARES
+    (purl / lockfile / SBOM component). `ref` rides through to the
+    hits untouched, like PkgQuery.ref."""
+    corpus: str
+    token: str
+    declared_version: str
+    ref: object = None
+
+
+def corpus_source(corpus: str) -> str:
+    return SOURCE_PREFIX + corpus
+
+
+class LibraryIndex:
+    """A fingerprint corpus flattened into AdvisoryTable arrays.
+
+    `build()` is the only flatten path (failpoint `libscan.flatten`:
+    a poisoned corpus build must fail loudly at load time, not serve
+    half a corpus); everything after construction is the unchanged
+    detect machinery."""
+
+    def __init__(self, table: AdvisoryTable,
+                 fingerprints: tuple[LibraryFingerprint, ...]):
+        self.table = table
+        self.fingerprints = fingerprints
+
+    @classmethod
+    def build(cls, fingerprints, key_width: int = V.KEY_WIDTH,
+              memo=None) -> "LibraryIndex":
+        failpoint(FLATTEN_SITE)
+        # dedup, deterministic order: corpus rows have no inherent
+        # order and the table digest must not depend on feed order
+        fps = tuple(sorted(set(fingerprints),
+                           key=lambda f: (f.corpus, f.token,
+                                          f.library, f.version)))
+        raw = [RawAdvisory(
+            source=corpus_source(f.corpus),
+            ecosystem=LIB_ECOSYSTEM,
+            pkg_name=f.token,
+            vuln_id=f"{f.library}@{f.version}",
+            vulnerable_ranges=f">={f.version}, <={f.version}",
+            status="identified",
+            data_source={"ID": "libfp", "Name": f.corpus},
+        ) for f in fps]
+        table = build_table(raw, details={}, key_width=key_width,
+                            memo=memo)
+        METRICS.inc("trivy_tpu_libscan_fingerprints_total",
+                    float(len(fps)))
+        nbytes = int(table.hash.nbytes + table.lo_tok.nbytes
+                     + table.hi_tok.nbytes + table.flags.nbytes
+                     + table.group.nbytes)
+        LEDGER.note_resident("library_index", nbytes)
+        return cls(table, fps)
+
+    def content_digest(self) -> str:
+        """Salted table digest: a LibraryIndex and a CVE table built
+        from coincidentally identical arrays must not memo-collide."""
+        h = hashlib.sha256(b"libfp|")
+        h.update(self.table.content_digest().encode())
+        return "sha256:" + h.hexdigest()
+
+    def corpora(self) -> list[str]:
+        return sorted({f.corpus for f in self.fingerprints})
+
+    # ---- the detect-path bridge ----------------------------------------
+
+    def queries(self, observations) -> list:
+        """Observations → plain PkgQuery rows for BatchDetector /
+        detectd. Unversioned observations are skipped (nothing to
+        verify; the caller sees them absent from the hit map)."""
+        from .engine import PkgQuery
+        out = []
+        for obs in observations:
+            if not obs.declared_version:
+                continue
+            out.append(PkgQuery(
+                source=corpus_source(obs.corpus),
+                ecosystem=LIB_ECOSYSTEM,
+                name=obs.token,
+                version=obs.declared_version,
+                ref=obs))
+        METRICS.inc("trivy_tpu_libscan_queries_total",
+                    float(len(out)))
+        return out
+
+    @staticmethod
+    def confirmations(hits) -> dict:
+        """Hits → {observation: sorted [(library, version)]}: the
+        library versions whose fingerprint sets are consistent with
+        each observation's token + declared version. (Observations
+        are the keys — frozen dataclasses, hashable as long as their
+        `ref` payload is.)"""
+        out: dict = {}
+        for h in hits:
+            lib, _, ver = h.vuln_id.rpartition("@")
+            out.setdefault(h.query.ref, []).append((lib, ver))
+        return {k: sorted(set(v)) for k, v in out.items()}
+
+    def detect(self, detector, observations) -> dict:
+        """One batched round trip: observations → queries → the
+        detector (device path, coalesced detectd, or host fallback —
+        whatever the caller wired) → confirmation map."""
+        hits = detector.detect(self.queries(observations))
+        return self.confirmations(hits)
+
+    # ---- NumPy mirror ---------------------------------------------------
+
+    def oracle(self, observations) -> dict:
+        """Brute-force NumPy mirror of `detect`: encode every corpus
+        version and every declared version with the SAME tokenizer the
+        table used, and confirm by exact token-vector equality (with
+        the host comparator as the inexact-encoding fallback, exactly
+        the table's own recheck semantics)."""
+        width = self.table.lo_tok.shape[1]
+        enc: dict = {}
+
+        def key(ver: str):
+            if ver not in enc:
+                try:
+                    enc[ver] = V.encode_version(LIB_ECOSYSTEM, ver,
+                                                width)
+                except (ValueError, KeyError):
+                    # unparseable declared version → no hit, mirroring
+                    # the engine's skip (engine.py _ver_index, the
+                    # reference's alpine.go:96-100 debug-and-continue)
+                    enc[ver] = None
+            return enc[ver]
+
+        by_token: dict = {}
+        for f in self.fingerprints:
+            by_token.setdefault((f.corpus, f.token), []).append(f)
+        out: dict = {}
+        for obs in observations:
+            if not obs.declared_version:
+                continue
+            qk = key(obs.declared_version)
+            if qk is None:
+                continue
+            pairs = []
+            for f in by_token.get((obs.corpus, obs.token), ()):
+                fk = key(f.version)
+                if fk is None:
+                    continue
+                if qk.exact and fk.exact:
+                    same = bool(np.array_equal(qk.tokens, fk.tokens))
+                else:
+                    same = V.compare(LIB_ECOSYSTEM,
+                                     obs.declared_version,
+                                     f.version) == 0
+                if same:
+                    pairs.append((f.library, f.version))
+            if pairs:
+                out[obs] = sorted(set(pairs))
+        return out

@@ -177,15 +177,16 @@ def partial_blob_id(blob_id: str, errors: list) -> str:
 
 class IngestSupervisor:
     """Process-wide ingest fault domains + counters (the /healthz
-    `resilience.ingest` block). One CircuitBreaker per stage — `walk`
-    and `analyze` — charged through GUARD.watch exactly like the
+    `resilience.ingest` block). One CircuitBreaker per stage — `walk`,
+    `analyze`, and graftbom's `parse` — charged through GUARD.watch
+    exactly like the
     device and mesh domains: a watchdog expiry trips the stage's
     breaker immediately, errors count toward its threshold, and while
     a breaker is open new work for that stage yields an annotated
     partial instantly (the half-open probe is the first unit of work
     the reset window admits; its success re-closes the stage)."""
 
-    STAGES = ("walk", "analyze")
+    STAGES = ("walk", "analyze", "parse")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -194,7 +195,7 @@ class IngestSupervisor:
             gauge="trivy_tpu_ingest_breaker_state", label="stage",
             name_fn=lambda k: f"ingest.{k}")
         self._counters = {"partial_scans": 0, "budget_trips": 0,
-                          "layers_walked": 0}
+                          "layers_walked": 0, "docs_parsed": 0}
         self._busy_walkers = 0
 
     def breaker(self, stage: str):
@@ -225,6 +226,7 @@ class IngestSupervisor:
             "partial_scans_total": counters["partial_scans"],
             "budget_trips_total": counters["budget_trips"],
             "layers_walked_total": counters["layers_walked"],
+            "docs_parsed_total": counters["docs_parsed"],
             "busy_walkers": busy,
         }
 

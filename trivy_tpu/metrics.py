@@ -369,7 +369,8 @@ METRICS.declare(
 METRICS.declare(
     "trivy_tpu_ingest_breaker_state", "gauge",
     "fanald per-stage ingest fault domain: 0 closed, 1 open, 2 "
-    "half-open (one series per stage, stage=\"walk\"/\"analyze\").")
+    "half-open (one series per stage, stage=\"walk\"/\"analyze\"/"
+    "\"parse\" — \"parse\" is graftbom's SBOM decode stage).")
 METRICS.declare(
     "trivy_tpu_ingest_partial_scans_total", "counter",
     "Layer walks the fanald pipeline degraded to an annotated "
@@ -394,6 +395,32 @@ METRICS.declare(
     "trivy_tpu_ingest_analyze_depth", "gauge",
     "fanald analyzer batches currently dispatched or queued on the "
     "analyzer pool.")
+METRICS.declare(
+    "trivy_tpu_sbom_docs_total", "counter",
+    "SBOM documents decoded by graftbom (SBOMArtifact.inspect), by "
+    "detected format (format=\"cyclonedx\"/\"spdx\"/\"spdx-json\"/"
+    "\"unknown\" when detection never ran).")
+METRICS.declare(
+    "trivy_tpu_sbom_parse_seconds_total", "counter",
+    "Wall time in the supervised SBOM decode stage (the same "
+    "measurement billed to tenants as sbom_parse_ms).")
+METRICS.declare(
+    "trivy_tpu_sbom_components_total", "counter",
+    "Packages decoded out of SBOM documents into BlobInfo inventory "
+    "(OS package_infos plus application packages).")
+METRICS.declare(
+    "trivy_tpu_sbom_partial_total", "counter",
+    "SBOM decodes degraded to an annotated partial (malformed "
+    "document, budget trip, parse timeout, or open parse breaker) — "
+    "cached only under salted ids, like fanald layer partials.")
+METRICS.declare(
+    "trivy_tpu_libscan_fingerprints_total", "counter",
+    "Library-fingerprint corpus records flattened into a "
+    "LibraryIndex advisory table (graftbom library workload).")
+METRICS.declare(
+    "trivy_tpu_libscan_queries_total", "counter",
+    "Library-version observations turned into detect queries against "
+    "a LibraryIndex.")
 METRICS.declare(
     "trivy_tpu_memo_hits_total", "counter",
     "graftmemo detection-result memo: scan units (one OS or "

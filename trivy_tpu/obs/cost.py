@@ -89,7 +89,8 @@ CONSERVED_TRANSFER_PATHS = ("compact", "dense", "overflow")
 # numeric ledger fields a request accumulates; secret bytes use a
 # "secret_bytes.<path>" key per serving path (device / host)
 _CORE_FIELDS = ("queue_ms", "device_ms", "transfer_bytes", "host_ms",
-                "ingest_bytes", "ingest_ms", "avoided_ms")
+                "ingest_bytes", "ingest_ms", "sbom_parse_ms",
+                "avoided_ms")
 
 
 class CostLedger:
@@ -154,7 +155,7 @@ class CostLedger:
             "avoided_ms": round(v.get("avoided_ms", 0.0), 3),
             "hops": 1,
         }
-        for opt in ("ingest_bytes", "ingest_ms"):
+        for opt in ("ingest_bytes", "ingest_ms", "sbom_parse_ms"):
             if v.get(opt, 0.0) > 0:
                 doc[opt] = round(v[opt], 3)
         sb = sum(val for k, val in v.items()
@@ -310,6 +311,14 @@ def charge_ingest(nbytes: float, ms: float) -> None:
     _apportion("ingest_ms", ms)
 
 
+def charge_sbom_parse(ms: float) -> None:
+    """graftbom document decode wall ms. SBOM scans never bill fanal
+    bytes — the document IS the inventory — so parse time is its own
+    field rather than riding ingest_ms, keeping the archive-vs-SBOM
+    cost split legible in /debug/costs."""
+    _apportion("sbom_parse_ms", ms)
+
+
 def charge_secret_bytes(path: str, nbytes: float) -> None:
     """Secrets-engine scanned bytes by serving path ("device" /
     "host")."""
@@ -364,6 +373,7 @@ def _new_tenant_row() -> dict:
     return {"scans": {}, "queue_ms": 0.0, "service_ms": 0.0,
             "device_ms": 0.0, "transfer_bytes": 0.0, "host_ms": 0.0,
             "ingest_bytes": 0.0, "ingest_ms": 0.0,
+            "sbom_parse_ms": 0.0,
             "secret_bytes": 0.0, "avoided_ms": 0.0}
 
 
@@ -411,7 +421,8 @@ class TenantAggregator:
             row = self._rows.setdefault(label, _new_tenant_row())
             for field in ("queue_ms", "service_ms", "device_ms",
                           "transfer_bytes", "host_ms", "ingest_bytes",
-                          "ingest_ms", "secret_bytes", "avoided_ms"):
+                          "ingest_ms", "sbom_parse_ms",
+                          "secret_bytes", "avoided_ms"):
                 row[field] += float(doc.get(field, 0.0))
             if outcome:
                 row["scans"][outcome] = \

@@ -153,6 +153,9 @@ def report_from_json(j: dict) -> T.Report:
             )
             v.vulnerability.severity = vj.get("Severity", "UNKNOWN")
             v.vulnerability.title = vj.get("Title", "")
+            lj = vj.get("Layer") or {}
+            v.layer = T.Layer(digest=lj.get("Digest", ""),
+                              diff_id=lj.get("DiffID", ""))
             res.vulnerabilities.append(v)
         for sj in rj.get("Secrets", []):
             res.secrets.append(T.SecretFinding(

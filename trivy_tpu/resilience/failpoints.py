@@ -56,6 +56,10 @@ SITES = (
     "secret.prefilter",   # secret/engine.py device keyword engine
     "memo.get",           # fleet/memo.py result-memo reads (graftmemo)
     "memo.put",           # fleet/memo.py result-memo writes
+    "sbom.parse",         # sbom/artifact.py SBOMArtifact.inspect
+    #                       (graftbom document decode stage)
+    "libscan.flatten",    # detect/libscan.py LibraryIndex.build
+    #                       (fingerprint-corpus table flattening)
 )
 
 # site FAMILIES: a family member is `<family>:<instance>` (e.g.

@@ -104,7 +104,12 @@ _MEMO_FAULTS = (
 # through the DEVICE keyword engine (small-batch floor forced to 0),
 # and a secret.prefilter fault must degrade that scan to the host
 # engine bit-identically (the exact-match contract both paths share)
-# with the shared detect breaker re-closing after settle
+# with the shared detect breaker re-closing after settle.
+# The graftbom sbom lane (odd request indices ride the ScanSBOM RPC)
+# adds the server-side supervised document decode: sbom.parse faults
+# must land as annotated partials on the parse stage — same contract,
+# different ingress — and hostile windows swap the DOCUMENT for a
+# truncated/component-bomb variant instead of the layer archive
 _INGEST_FAULTS = (
     ("fanal.walk", "error"), ("fanal.walk", "hang"),
     ("fanal.walk", "flaky"),
@@ -112,6 +117,8 @@ _INGEST_FAULTS = (
     ("fanal.analyze", "flaky"),
     ("secret.prefilter", "error"), ("secret.prefilter", "hang"),
     ("secret.prefilter", "flaky"),
+    ("sbom.parse", "error"), ("sbom.parse", "hang"),
+    ("sbom.parse", "flaky"),
 )
 HOSTILE_VARIANTS = ("truncated", "bomb")
 
@@ -281,11 +288,13 @@ def generate_schedule(seed: int, topology: str, n_events: int = 4,
         arg, spec_seed = 0.0, 0
         if mode == "hang":
             # must outlive the watchdog deadline to be a hang at all.
-            # fanald sites watch with the (longer) ingest layer
-            # deadline + grace, so their hangs scale further out —
-            # the trip must be deterministic, never a near-miss
+            # fanald sites (and the graftbom parse stage, which
+            # watches with the same chaos-scaled deadline) watch with
+            # the (longer) ingest layer deadline + grace, so their
+            # hangs scale further out — the trip must be
+            # deterministic, never a near-miss
             mult = (8.0, 12.0) if site.startswith("fanal.") \
-                else (2.2, 4.0)
+                or site == "sbom.parse" else (2.2, 4.0)
             arg = round(rng.uniform(watchdog_ms * mult[0],
                                     watchdog_ms * mult[1]), 1)
         elif mode == "slow":
@@ -352,6 +361,70 @@ def request_doc(load_seed: int, idx: int, n_pkgs: int = 16) -> dict:
         "PackageInfos": [{"FilePath": "lib/apk/db/installed",
                           "Packages": pkgs}],
     }
+
+
+def request_sbom_doc(load_seed: int, idx: int,
+                     n_pkgs: int = 16) -> bytes:
+    """The idx-th request's inventory as a CycloneDX document (the
+    graftbom lane of the ingest drill): the SAME seeded package set
+    request_doc() would put in a blob, exported the way
+    encode_cyclonedx writes alpine packages — so an sbom-lane scan
+    detects against the same advisories the archive lane would."""
+    blob = request_doc(load_seed, idx, n_pkgs)
+    comps = []
+    for p in blob["PackageInfos"][0]["Packages"]:
+        purl = (f"pkg:apk/alpine/{p['Name']}@{p['Version']}"
+                f"?distro=3.17.3")
+        comps.append({
+            "type": "library",
+            "bom-ref": purl,
+            "name": p["Name"], "version": p["Version"],
+            "purl": purl,
+            "properties": [
+                {"name": "aquasecurity:trivy:PkgType",
+                 "value": "alpine"},
+                {"name": "aquasecurity:trivy:SrcName",
+                 "value": p["SrcName"]},
+                {"name": "aquasecurity:trivy:SrcVersion",
+                 "value": p["SrcVersion"]},
+            ],
+        })
+    doc = {
+        "bomFormat": "CycloneDX", "specVersion": "1.5",
+        "serialNumber": f"urn:uuid:storm-sbom-{load_seed}-{idx}",
+        "version": 1,
+        "metadata": {"component": {
+            "type": "operating-system", "name": "alpine",
+            "version": "3.17.3",
+            "properties": [{"name": "aquasecurity:trivy:Type",
+                            "value": "alpine"}]}},
+        "components": comps,
+    }
+    return json.dumps(doc, sort_keys=True).encode()
+
+
+def build_sbom_document(load_seed: int, idx: int, variant: str,
+                        max_components: int = 64) -> bytes:
+    """clean | truncated (mid-token JSON cut → deterministic
+    `malformed` annotation) | bomb (component-count flood past the
+    drill's budget → clamped prefix decode + `budget.components`)."""
+    raw = request_sbom_doc(load_seed, idx)
+    if variant == "clean":
+        return raw
+    if variant == "truncated":
+        return raw[:48]
+    doc = json.loads(raw)
+    base = doc["components"]
+    flood = []
+    k = 0
+    while len(flood) <= max_components * 8:
+        for c in base:
+            c2 = dict(c)
+            c2["bom-ref"] = f"{c['bom-ref']}#{k}"
+            k += 1
+            flood.append(c2)
+    doc["components"] = flood
+    return json.dumps(doc, sort_keys=True).encode()
 
 
 # ---------------------------------------------------------------------------
@@ -601,7 +674,8 @@ class _Topology:
 class SingleTopology(_Topology):
     kind = "single"
 
-    def __init__(self, table, opts: StormOptions, mesh_opts=None):
+    def __init__(self, table, opts: StormOptions, mesh_opts=None,
+                 sbom_opts=None):
         super().__init__(table, opts)
         from ..resilience import AdmissionOptions
         from ..server.listen import serve_background
@@ -611,7 +685,7 @@ class SingleTopology(_Topology):
         self.httpd, self.state = serve_background(
             "127.0.0.1", 0, table, cache_dir="",
             cache_backend="memory", admission=admission,
-            mesh_opts=mesh_opts)
+            mesh_opts=mesh_opts, sbom_opts=sbom_opts)
         self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
 
     def server_states(self):
@@ -792,23 +866,38 @@ class FleetTopology(_Topology):
 
 
 class IngestTopology(SingleTopology):
-    """fanald containment drill: every load request runs the FULL
-    client-side archive flow — ImageArchiveArtifact through the
+    """fanald containment drill: even-indexed load requests run the
+    FULL client-side archive flow — ImageArchiveArtifact through the
     supervised pipeline (small budgets), blob push, Scan RPC — against
-    one in-process server. Schedule faults hit the pipeline's
-    `fanal.walk`/`fanal.analyze` sites; `hostile_layer` windows swap
-    the scanned artifact for a truncated-gzip or decompression-bomb
-    variant. The contract under drill: zero 5xx, every affected scan a
-    deterministic ANNOTATED partial, ingest breakers re-closed once
-    the faults clear."""
+    one in-process server; odd-indexed requests ride the graftbom lane
+    (the same seeded inventory as a CycloneDX document through the
+    ScanSBOM RPC, decoded server-side under the supervised parse
+    stage). Schedule faults hit the pipeline's
+    `fanal.walk`/`fanal.analyze` sites and the sbom lane's
+    `sbom.parse`; `hostile_layer` windows swap the archive for a
+    truncated-gzip or decompression-bomb variant and the sbom
+    document for a truncated-JSON or component-bomb one. The contract
+    under drill: zero 5xx, every affected scan a deterministic
+    ANNOTATED partial, ingest breakers re-closed once the faults
+    clear."""
 
     kind = "ingest"
     push_blobs = False
+    sbom_lane = True
 
     def __init__(self, table, opts: StormOptions, load_seed: int = 0):
-        super().__init__(table, opts)
-        from ..fanal.pipeline import IngestOptions
+        from ..sbom.artifact import SBOMOptions
         w = opts.watchdog_ms
+        # graftbom lane budgets, chaos-scaled like the ingest budgets
+        # below: the parse watch (deadline + 50% grace) must lose to a
+        # schedule hang (≥ 8× watchdog by construction) and the bomb
+        # document (~8× the component cap) must trip the count budget
+        self._sbom_cap = 64
+        self.sbom_opts = SBOMOptions(
+            max_doc_bytes=1 << 20, max_components=self._sbom_cap,
+            parse_deadline_ms=w * 4.0)
+        super().__init__(table, opts, sbom_opts=self.sbom_opts)
+        from ..fanal.pipeline import IngestOptions
         # budgets sized against the drill fixtures: the bomb variant
         # (zeros expanding ~1000×) must trip the ratio guard, hang
         # faults (≥ 8× watchdog by schedule construction) must outlive
@@ -861,6 +950,14 @@ class IngestTopology(SingleTopology):
                                  f"img-{i}-{variant}.tar")
                 build_ingest_archive(p, doc, variant, self._bomb)
                 self._paths[(i, variant)] = p
+        # graftbom lane documents (odd request indices): the same
+        # seeded inventories as CycloneDX bytes, with hostile-window
+        # variants swapping the DOCUMENT rather than the layer archive
+        self._sbom_docs = {
+            (i, variant): build_sbom_document(
+                load_seed, i, variant, self._sbom_cap)
+            for i in range(1, opts.requests, 2)
+            for variant in ("clean",) + HOSTILE_VARIANTS}
 
     def push_hostile(self, variant: str) -> None:
         self._hostile_stack.append(variant)
@@ -879,6 +976,8 @@ class IngestTopology(SingleTopology):
         from ..fanal.cache import MemoryCache
         stack = self._hostile_stack
         variant = stack[-1] if stack else "clean"
+        if idx % 2:
+            return self._do_sbom_request(idx, timeout, tenant, variant)
         path = self._paths.get((idx, variant)) \
             or self._paths[(idx, "clean")]
         cache = MemoryCache()
@@ -931,6 +1030,53 @@ class IngestTopology(SingleTopology):
                 o.well_formed = False
                 o.detail = (f"hostile variant {variant} yielded no "
                             f"ingest annotation")
+        return o
+
+    def _do_sbom_request(self, idx: int, timeout: float, tenant: str,
+                         variant: str) -> Outcome:
+        """The graftbom lane: ship the (possibly hostile) document
+        through the ScanSBOM RPC — the server runs the supervised
+        decode, so sbom.parse faults and document bombs land on ITS
+        parse stage. Same containment contract as the archive lane:
+        zero 5xx, hostile input always an annotated partial."""
+        import base64
+
+        from ..sbom.artifact import doc_digest
+        raw = self._sbom_docs[(idx, variant)]
+        t0 = time.perf_counter()
+        try:
+            code, headers, body = _post(
+                self.url,
+                "/twirp/trivy.scanner.v1.Scanner/ScanSBOM",
+                {"target": f"sbom-{idx}",
+                 "artifact_id": doc_digest(raw),
+                 "kind": "cyclonedx",
+                 "document": base64.b64encode(raw).decode(),
+                 "options": {"scanners": ["vuln"]}},
+                timeout=timeout,
+                headers={"X-Trivy-Deadline-Ms":
+                         str(int(timeout * 1e3)),
+                         **({TENANT_HEADER: tenant}
+                            if tenant else {})})
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            return Outcome(idx, "lost",
+                           latency_ms=(time.perf_counter() - t0) * 1e3,
+                           detail=f"{type(e).__name__}: {e}"[:160])
+        o = _classify(idx, code, headers, body,
+                      (time.perf_counter() - t0) * 1e3)
+        o.idx = idx
+        # the parse stage's degradations surface as the report's
+        # "ingest" result (the same shape the archive lane's partial
+        # blobs produce server-side)
+        o.partial = isinstance(body, dict) and any(
+            r.get("Class") == "ingest"
+            for r in body.get("results") or [])
+        if variant != "clean":
+            o.detail = (o.detail + f" variant={variant}").strip()
+            if o.status == "ok" and not o.partial:
+                o.well_formed = False
+                o.detail = (f"hostile sbom variant {variant} yielded "
+                            f"no parse annotation")
         return o
 
     def settled(self) -> list[str]:
@@ -1507,6 +1653,11 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
                        "blob_info": probe},
                       timeout=opts.request_timeout_s)
             topo.do_request(0, probe, opts.request_timeout_s)
+            if getattr(topo, "sbom_lane", False):
+                # the parse stage's half-open probe only admits
+                # through a ScanSBOM decode — the archive probe above
+                # never touches the sbom lane's breaker
+                topo.do_request(1, probe, opts.request_timeout_s)
             time.sleep(0.05)
             settle_problems = topo.settled()
 

@@ -1,0 +1,291 @@
+"""graftbom: SBOM documents as first-class scan artifacts.
+
+An SBOM scan is the cheapest path to the join engine: the document IS
+the package inventory, so there is no fanal walk, no layer streams, no
+analyzer pool — just one supervised decode into a `BlobInfo` and the
+unchanged detect path behind it. The contract mirrors the archive
+artifacts exactly where it matters:
+
+  content address   ONE blob keyed by the document digest (sha256 of
+                    the raw bytes) + the decoder version — the same
+                    cache_key discipline as analyzer versions, so a
+                    decoder fix re-keys every SBOM blob instead of
+                    serving stale decodes.
+  memo identity     `blob.diff_id` = the document digest. fanal's
+                    apply_layers stamps it onto every package, so
+                    graftmemo's unit attribution, the fleet's shared
+                    memo, and redetectd's rolling-DB sweeps treat an
+                    SBOM blob exactly like a layer: N duplicate
+                    documents → 1 store, N−1 hits, per db_version.
+  containment       the fanald tradition: malformed JSON, unknown
+                    formats, lying component data, byte/count/depth
+                    budget trips → a deterministic annotated partial
+                    (IngestErrors) under a SALTED id (partial_blob_id)
+                    so the canonical key stays missing — never an
+                    exception out of inspect(), never a 5xx, and never
+                    a breaker charge for the input's fault. Only infra
+                    faults — a wedged decode (watchdog) or an injected
+                    `sbom.parse` failpoint — charge the ingest `parse`
+                    stage breaker.
+  cost              parse wall ms bills the requesting tenant as
+                    `sbom_parse_ms` (no fanal bytes); detect shares
+                    ride the existing detectd apportioning unchanged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from dataclasses import dataclass
+
+from .. import types as T
+from ..fanal.cache import cache_key
+from ..fanal.pipeline import INGEST, ingest_error, partial_blob_id
+from ..metrics import METRICS
+from ..obs import cost as _cost
+from ..resilience import GUARD, DeviceError, DeviceTimeout, failpoint
+
+PARSE_SITE = "sbom.parse"
+
+# decoder-version analog of fanal's analyzer versions: bumping this
+# re-keys every cached SBOM blob (v2 = the cross-path identity fixes:
+# epoch-prefix parsing + distro-family purl-type mapping)
+DECODER_VERSIONS = {"sbom": 2}
+
+
+@dataclass
+class SBOMOptions:
+    """Hostile-input budgets + the parse watchdog. Defaults sized so
+    no real-world document trips them while a crafted one is bounded."""
+    max_doc_bytes: int = 64 << 20     # raw document byte budget
+    max_components: int = 100_000     # component/package count budget
+    max_depth: int = 200              # JSON nesting budget
+    parse_deadline_ms: float = 30_000.0
+
+    def watch_timeout_s(self) -> float:
+        dl = self.parse_deadline_ms / 1e3
+        return dl + max(0.05, dl * 0.5)
+
+
+_DEFAULT_OPTS = SBOMOptions()
+
+
+def doc_digest(raw: bytes) -> str:
+    """The SBOM content address: sha256 of the raw document bytes —
+    NOT of the decoded blob, so duplicate documents dedup before any
+    parsing happens and the fleet router's artifact-id affinity lands
+    duplicates on the same replica's memo."""
+    return "sha256:" + hashlib.sha256(raw).hexdigest()
+
+
+def json_depth(doc, limit: int) -> int:
+    """Iterative nesting depth, capped at `limit`+1 (a crafted
+    1e6-deep document must not cost a full walk — or a recursion)."""
+    deepest = 0
+    stack = [(doc, 1)]
+    while stack:
+        node, d = stack.pop()
+        if d > deepest:
+            deepest = d
+        if d > limit:
+            return d
+        if isinstance(node, dict):
+            stack.extend((v, d + 1) for v in node.values())
+        elif isinstance(node, list):
+            stack.extend((v, d + 1) for v in node)
+    return deepest
+
+
+class SBOMArtifact:
+    """One SBOM document → one content-addressed blob + artifact.
+
+    `inspect()` never raises: every failure mode is a deterministic
+    annotated partial in the fanald tradition. Mirrors the
+    _SingleBlobArtifact shape (fanal/artifact.py) without subclassing
+    it — there is no filesystem walk to share."""
+
+    def __init__(self, raw: bytes, cache, name: str = "",
+                 opts: SBOMOptions | None = None):
+        self.raw = raw
+        self.cache = cache
+        self.name = name
+        self.opts = opts or _DEFAULT_OPTS
+        self.digest = doc_digest(raw)
+        self.format = ""          # set by decode: cyclonedx | spdx
+
+    @classmethod
+    def from_doc(cls, doc: dict, cache, name: str = "",
+                 opts: SBOMOptions | None = None) -> "SBOMArtifact":
+        """For callers holding an already-parsed document (the rekor
+        attestation path): the content address is the canonical JSON
+        serialization — stable across key order."""
+        raw = json.dumps(doc, sort_keys=True,
+                         separators=(",", ":")).encode()
+        return cls(raw, cache, name=name, opts=opts)
+
+    # ---- decode stage (contained) --------------------------------------
+
+    def _parse_doc(self, errors: list) -> dict | None:
+        """Raw bytes → document dict, or None with the failure
+        annotated. Input faults land here — inside the containment,
+        outside any breaker charge."""
+        opts = self.opts
+        if len(self.raw) > opts.max_doc_bytes:
+            INGEST.note("budget_trips")
+            errors.append(ingest_error(
+                PARSE_SITE, "budget.doc_bytes",
+                f"document is {len(self.raw)} bytes "
+                f"(budget {opts.max_doc_bytes})"))
+            return None
+        try:
+            text = self.raw.decode("utf-8", errors="strict")
+        except UnicodeDecodeError as e:
+            errors.append(ingest_error(PARSE_SITE, "encoding",
+                                       f"not UTF-8: {e}"))
+            return None
+        try:
+            doc = json.loads(text)
+        except RecursionError:
+            INGEST.note("budget_trips")
+            errors.append(ingest_error(
+                PARSE_SITE, "budget.depth",
+                "document nesting exceeded the parser's limit"))
+            return None
+        except json.JSONDecodeError as e:
+            if "SPDXVersion:" in text:
+                from .spdx import parse_tag_value
+                try:
+                    return parse_tag_value(text)
+                except Exception as e2:  # noqa: BLE001 — contained
+                    errors.append(ingest_error(
+                        PARSE_SITE, "malformed",
+                        f"SPDX tag-value: {type(e2).__name__}: {e2}"))
+                    return None
+            errors.append(ingest_error(
+                PARSE_SITE, "malformed",
+                f"not JSON (line {e.lineno}): {e.msg}"))
+            return None
+        if not isinstance(doc, dict):
+            errors.append(ingest_error(
+                PARSE_SITE, "malformed",
+                f"top-level {type(doc).__name__}, want object"))
+            return None
+        if json_depth(doc, opts.max_depth) > opts.max_depth:
+            INGEST.note("budget_trips")
+            errors.append(ingest_error(
+                PARSE_SITE, "budget.depth",
+                f"document nesting exceeds {opts.max_depth} levels"))
+            return None
+        return doc
+
+    def _clamp_components(self, doc: dict, errors: list) -> dict:
+        """Component-bomb budget: decode a DETERMINISTIC prefix and
+        annotate, instead of walking an unbounded list."""
+        cap = self.opts.max_components
+        for field in ("components", "packages"):
+            items = doc.get(field)
+            if isinstance(items, list) and len(items) > cap:
+                INGEST.note("budget_trips")
+                errors.append(ingest_error(
+                    PARSE_SITE, "budget.components",
+                    f"{len(items)} {field} (budget {cap}); "
+                    f"first {cap} decoded"))
+                doc = dict(doc)
+                doc[field] = items[:cap]
+        return doc
+
+    def _decode(self, errors: list) -> T.BlobInfo:
+        """Document bytes → BlobInfo; every input fault is an
+        annotation, never an exception."""
+        from .cyclonedx import decode_cyclonedx
+        from .io import detect_format, unwrap_attestation
+        from .spdx import decode_spdx
+
+        doc = self._parse_doc(errors)
+        if doc is None:
+            return T.BlobInfo()
+        try:
+            doc = unwrap_attestation(doc)
+            self.format = detect_format(doc)
+        except ValueError as e:
+            errors.append(ingest_error(PARSE_SITE, "format", str(e)))
+            return T.BlobInfo()
+        doc = self._clamp_components(doc, errors)
+        try:
+            detail = (decode_cyclonedx(doc)
+                      if self.format == "cyclonedx"
+                      else decode_spdx(doc))
+        except Exception as e:  # noqa: BLE001 — lying document data
+            errors.append(ingest_error(
+                PARSE_SITE, "decode_error",
+                f"{type(e).__name__}: {e}"))
+            return T.BlobInfo()
+        return T.BlobInfo(
+            os=detail.os,
+            package_infos=[T.PackageInfo(packages=detail.packages)]
+            if detail.packages else [],
+            applications=detail.applications)
+
+    # ---- the artifact contract -----------------------------------------
+
+    def inspect(self):
+        """→ ArtifactReference. Never raises; a degraded decode
+        caches under a salted partial id with its annotations."""
+        from ..fanal.artifact import ArtifactReference
+
+        errors: list = []
+        blob = T.BlobInfo()
+        t0 = time.perf_counter()
+        br = INGEST.breaker("parse")
+        if not br.allow():
+            # open stage domain: degrade instantly (half-open admits
+            # the probe decode through this same gate)
+            errors.append(ingest_error(
+                PARSE_SITE, "breaker_open",
+                "sbom parse breaker open; document skipped"))
+        else:
+            try:
+                with GUARD.watch(PARSE_SITE,
+                                 timeout_s=self.opts.watch_timeout_s(),
+                                 breaker=br):
+                    failpoint(PARSE_SITE)
+                    blob = self._decode(errors)
+            except DeviceTimeout:
+                errors.append(ingest_error(
+                    PARSE_SITE, "timeout",
+                    "document decode outlived the parse watchdog "
+                    "deadline"))
+            except DeviceError as e:
+                cause = e.__cause__ or e
+                errors.append(ingest_error(
+                    PARSE_SITE, "error",
+                    f"{type(cause).__name__}: {cause}"))
+            INGEST.note("docs_parsed")
+        ms = (time.perf_counter() - t0) * 1e3
+        _cost.charge_sbom_parse(ms)
+        METRICS.inc("trivy_tpu_sbom_docs_total",
+                    format=self.format or "unknown")
+        METRICS.inc("trivy_tpu_sbom_parse_seconds_total", ms / 1e3)
+        n_pkgs = sum(len(pi.packages) for pi in blob.package_infos) \
+            + sum(len(app.packages) for app in blob.applications)
+        METRICS.inc("trivy_tpu_sbom_components_total", float(n_pkgs))
+
+        # the memo identity: the document digest plays the layer
+        # diff_id, so apply_layers stamps it per package and graftmemo
+        # attributes every unit to this one blob
+        blob.diff_id = self.digest
+        if errors:
+            blob.ingest_errors = errors
+        blob_id = cache_key(self.digest, DECODER_VERSIONS, {})
+        if errors:
+            INGEST.note("partial_scans")
+            METRICS.inc("trivy_tpu_sbom_partial_total")
+            blob_id = partial_blob_id(blob_id, errors)
+        self.cache.put_blob(blob_id, blob)
+        self.cache.put_artifact(blob_id, {"SchemaVersion": 2})
+        atype = (T.ArtifactType.SPDX if self.format.startswith("spdx")
+                 else T.ArtifactType.CYCLONEDX)
+        return ArtifactReference(
+            name=self.name or self.digest, type=atype,
+            id=blob_id, blob_ids=[blob_id])

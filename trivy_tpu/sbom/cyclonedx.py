@@ -24,12 +24,31 @@ def _props(component: dict) -> dict:
     return out
 
 
+def _int0(v) -> int:
+    """Lying-data tolerance: a non-numeric epoch property degrades to
+    0 instead of sinking the whole document decode."""
+    try:
+        return int(v or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _split_epoch(version: str) -> tuple[int, str]:
+    """'1:2.3-4' → (1, '2.3-4'): rpm/deb full version strings (what
+    format_version() emits) carry the epoch as an 'N:' prefix."""
+    head, sep, rest = version.partition(":")
+    if sep and head.isdigit():
+        return int(head), rest
+    return 0, version
+
+
 def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
     detail = T.ArtifactDetail()
     apps: dict[str, T.Application] = {}
     explicit_apps: list[T.Application] = []
     os_pkgs: list[T.Package] = []
     os_type = ""
+    seen_refs: set[str] = set()
 
     components = list(doc.get("components", []))
     meta_comp = (doc.get("metadata") or {}).get("component")
@@ -80,6 +99,15 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
         if ctype == "platform" and not comp.get("purl"):
             continue  # KBOM nodes/groupings without package identity
         purl = comp.get("purl", "")
+        # duplicate BOM refs decode ONCE (bom-refs must be unique per
+        # spec; hostile or sloppy generators repeat them — the first
+        # occurrence wins, deterministically, instead of double-
+        # counting the package)
+        dkey = comp.get("bom-ref") or \
+            f"{purl}|{comp.get('name', '')}|{comp.get('version', '')}"
+        if dkey in seen_refs:
+            continue
+        seen_refs.add(dkey)
         purl_type, purl_quals = _purl_parts(purl)
         pkg = T.Package(
             name=comp.get("name", ""),
@@ -87,11 +115,11 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
             src_name=props.get("SrcName", ""),
             src_version=props.get("SrcVersion", ""),
             src_release=props.get("SrcRelease", ""),
-            src_epoch=int(props.get("SrcEpoch", "0") or 0),
+            src_epoch=_int0(props.get("SrcEpoch")),
             release=props.get("PkgRelease", ""),
             file_path=props.get("FilePath", ""),
             arch=purl_quals.get("arch", ""),
-            epoch=int(purl_quals.get("epoch", "0") or 0),
+            epoch=_int0(purl_quals.get("epoch")),
             identifier=T.PkgIdentifier(purl=_canonical_purl(purl),
                                        bom_ref=comp.get("bom-ref", "")),
         )
@@ -117,14 +145,32 @@ def decode_cyclonedx(doc: dict) -> T.ArtifactDetail:
             # PkgID carries the FULL version string (before any
             # version-release split)
             pkg.id = props.get("PkgID") or f"{pkg.name}@{pkg.version}"
-            if ptype in ("rpm", "deb", "apk") and "-" in pkg.version \
-                    and not pkg.release:
-                # OS purl versions are version-release joined
-                pkg.version, pkg.release = pkg.version.rsplit("-", 1)
-            if ptype in ("rpm", "deb", "apk") and \
-                    "-" in pkg.src_version and not pkg.src_release:
-                pkg.src_version, pkg.src_release = \
-                    pkg.src_version.rsplit("-", 1)
+            # reconstruct the ANALYZER field schema per package class,
+            # not per literal purl type: trivy-encoded BOMs stamp
+            # PkgType with the distro family ("alpine", "centos", ...)
+            # and their component versions are format_version() output
+            # — epoch:version-release joined. apk-class packages keep
+            # the full "ver-rN" string in `version` with an empty
+            # release, exactly like fanal/analyzers/apk.py
+            cls = _OS_TYPE_CLASS.get(ptype, "")
+            if cls in ("rpm", "deb"):
+                epoch, pkg.version = _split_epoch(pkg.version)
+                pkg.epoch = pkg.epoch or epoch
+                if pkg.release and pkg.version.endswith(
+                        "-" + pkg.release):
+                    # PkgRelease property + format_version() joined
+                    # component version: strip the duplicate
+                    pkg.version = \
+                        pkg.version[:-len(pkg.release) - 1]
+                elif "-" in pkg.version and not pkg.release:
+                    pkg.version, pkg.release = \
+                        pkg.version.rsplit("-", 1)
+                s_epoch, pkg.src_version = \
+                    _split_epoch(pkg.src_version)
+                pkg.src_epoch = pkg.src_epoch or s_epoch
+                if "-" in pkg.src_version and not pkg.src_release:
+                    pkg.src_version, pkg.src_release = \
+                        pkg.src_version.rsplit("-", 1)
             os_type = os_type or ptype
             os_pkgs.append(pkg)
         else:
@@ -196,6 +242,20 @@ OS_PKG_TYPES = {"alpine", "apk", "deb", "debian", "ubuntu", "redhat",
                 "centos", "rocky", "alma", "amazon", "oracle", "fedora",
                 "suse", "opensuse", "photon", "wolfi", "chainguard",
                 "cbl-mariner", "dpkg", "rpm"}
+
+# OS package type → analyzer field class: which version-string schema
+# the decoded Package must be reconstructed into so the detect queries
+# come out bit-identical to the archive path's analyzer output
+# (rpm/deb analyzers split epoch/version/release into fields; the apk
+# analyzer keeps the full "ver-rN" string with release empty)
+_OS_TYPE_CLASS = {
+    "apk": "apk", "alpine": "apk", "wolfi": "apk", "chainguard": "apk",
+    "deb": "deb", "dpkg": "deb", "debian": "deb", "ubuntu": "deb",
+    "rpm": "rpm", "redhat": "rpm", "centos": "rpm", "rocky": "rpm",
+    "alma": "rpm", "amazon": "rpm", "oracle": "rpm", "fedora": "rpm",
+    "suse": "rpm", "opensuse": "rpm", "photon": "rpm",
+    "cbl-mariner": "rpm",
+}
 
 
 def _fake_uuid_counter():
@@ -344,12 +404,21 @@ def encode_cyclonedx(report: T.Report, app_version: str = "dev") -> dict:
                               "value": pkg.id})
             props.append({"name": PROP_PREFIX + "PkgType",
                           "value": res.type})
+            if pkg.release:
+                props.append({"name": PROP_PREFIX + "PkgRelease",
+                              "value": pkg.release})
             if pkg.src_name:
                 props.append({"name": PROP_PREFIX + "SrcName",
                               "value": pkg.src_name})
             if pkg.src_version:
                 props.append({"name": PROP_PREFIX + "SrcVersion",
                               "value": pkg.src_version})
+            if pkg.src_release:
+                props.append({"name": PROP_PREFIX + "SrcRelease",
+                              "value": pkg.src_release})
+            if pkg.src_epoch:
+                props.append({"name": PROP_PREFIX + "SrcEpoch",
+                              "value": str(pkg.src_epoch)})
             comp["properties"] = sorted(props, key=lambda p: p["name"])
             deps[parent].append(ref)
             edges = sorted(

@@ -3,13 +3,17 @@ DetectFormat:111 and pkg/fanal/artifact/sbom/sbom.go)."""
 
 from __future__ import annotations
 
-import hashlib
 import json
 
 from .. import types as T
-from ..fanal.cache import cache_key
 from .cyclonedx import decode_cyclonedx, encode_cyclonedx
 from .spdx import decode_spdx, encode_spdx
+
+__all__ = [
+    "decode_cyclonedx", "decode_sbom_doc", "decode_sbom_file",
+    "decode_spdx", "detect_format", "encode_cyclonedx", "encode_spdx",
+    "unwrap_attestation", "write_sbom",
+]
 
 
 def detect_format(doc: dict) -> str:
@@ -36,49 +40,24 @@ def unwrap_attestation(doc: dict) -> dict:
     return doc
 
 
-def decode_sbom_file(path: str, cache):
+def decode_sbom_file(path: str, cache, opts=None):
     """→ ArtifactReference whose single blob carries the decoded detail.
     Accepts JSON documents (CycloneDX/SPDX, optionally attestation-
-    wrapped) and SPDX tag-value text (FormatSPDXTV, sbom.go:111)."""
-    with open(path) as f:
-        text = f.read()
-    try:
-        doc = json.loads(text)
-    except json.JSONDecodeError:
-        if "SPDXVersion:" in text:
-            from .spdx import parse_tag_value
-            doc = parse_tag_value(text)
-        else:
-            raise ValueError(
-                f"{path}: neither JSON SBOM nor SPDX tag-value")
-    return decode_sbom_doc(doc, cache, name=path)
+    wrapped) and SPDX tag-value text (FormatSPDXTV, sbom.go:111).
+    Never raises on document content: a hostile or malformed file
+    yields an annotated partial (graftbom containment)."""
+    from .artifact import SBOMArtifact
+    with open(path, "rb") as f:
+        raw = f.read()
+    return SBOMArtifact(raw, cache, name=path, opts=opts).inspect()
 
 
 def decode_sbom_doc(doc: dict, cache, name: str = ""):
     """Decode an (optionally attestation-wrapped) SBOM document into a
-    cached blob → ArtifactReference."""
-    from ..fanal.artifact import ArtifactReference
-
-    doc = unwrap_attestation(doc)
-    fmt = detect_format(doc)
-    detail = decode_cyclonedx(doc) if fmt == "cyclonedx" else decode_spdx(doc)
-
-    blob = T.BlobInfo(
-        os=detail.os,
-        package_infos=[T.PackageInfo(packages=detail.packages)]
-        if detail.packages else [],
-        applications=detail.applications,
-    )
-    content_id = "sha256:" + hashlib.sha256(
-        json.dumps(blob.to_json(), sort_keys=True).encode()).hexdigest()
-    blob_id = cache_key(content_id, {"sbom": 1}, {})
-    cache.put_blob(blob_id, blob)
-    cache.put_artifact(blob_id, {"SchemaVersion": 2})
-    return ArtifactReference(
-        name=name,
-        type=(T.ArtifactType.CYCLONEDX if fmt == "cyclonedx"
-              else T.ArtifactType.SPDX),
-        id=blob_id, blob_ids=[blob_id])
+    cached blob → ArtifactReference (the rekor/attestation ingress;
+    file and RPC ingress hand raw bytes to SBOMArtifact directly)."""
+    from .artifact import SBOMArtifact
+    return SBOMArtifact.from_doc(doc, cache, name=name).inspect()
 
 
 def write_sbom(report: T.Report, fmt: str, out,

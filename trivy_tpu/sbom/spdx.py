@@ -174,7 +174,7 @@ def decode_spdx(doc: dict) -> T.ArtifactDetail:
     OperatingSystem package → OS, Application packages → app
     groupings via CONTAINS relationships, library packages built from
     their purl external refs with PkgID attribution."""
-    from .cyclonedx import OS_PKG_TYPES, _PURL_TO_TYPE
+    from .cyclonedx import _OS_TYPE_CLASS, _PURL_TO_TYPE, OS_PKG_TYPES
 
     detail = T.ArtifactDetail()
     apps: dict[str, T.Application] = {}
@@ -211,7 +211,12 @@ def decode_spdx(doc: dict) -> T.ArtifactDetail:
             pkg.licenses = [lic]
         if ptype in OS_PKG_TYPES:
             pkg.id = attrs.get("PkgID") or f"{pkg.name}@{pkg.version}"
-            if "-" in pkg.version and not pkg.release:
+            # analyzer field schema per package class (see cyclonedx
+            # _OS_TYPE_CLASS): rpm/deb purl versions are
+            # version-release joined and must split back into fields;
+            # apk keeps the full "ver-rN" string with release empty
+            if _OS_TYPE_CLASS.get(ptype) in ("rpm", "deb") and \
+                    "-" in pkg.version and not pkg.release:
                 pkg.version, pkg.release = pkg.version.rsplit("-", 1)
             pkg.src_name = pkg.src_name or pkg.name
             os_pkgs.append(pkg)

@@ -47,6 +47,9 @@ COST_HEADER = "X-Trivy-Cost"
 # stay importable without the server stack (listen → scanner → jax)
 ROUTE_DESCRIPTORS = {
     "/twirp/trivy.scanner.v1.Scanner/Scan": "ScanRequest",
+    # graftbom: the document rides the request body; the server runs
+    # the supervised decode and the unchanged detect path behind it
+    "/twirp/trivy.scanner.v1.Scanner/ScanSBOM": "ScanSBOMRequest",
     "/twirp/trivy.cache.v1.Cache/PutArtifact": "PutArtifactRequest",
     "/twirp/trivy.cache.v1.Cache/PutBlob": "PutBlobRequest",
     "/twirp/trivy.cache.v1.Cache/MissingBlobs": "MissingBlobsRequest",

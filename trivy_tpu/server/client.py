@@ -194,12 +194,47 @@ class RemoteScanner(_Base):
             "target": target,
             "artifact_id": artifact_id,
             "blob_ids": blob_ids,
-            "options": {
-                "scanners": list(options.scanners),
-                "vuln_type": list(options.pkg_types),
-                "list_all_packages": options.list_all_packages,
-            },
+            "options": self._options_json(options),
         })
+        return self._decode_response(r)
+
+    def scan_sbom(self, target: str, raw: bytes,
+                  options: T.ScanOptions | None = None):
+        """graftbom client half: ship the raw document, let the server
+        run the supervised decode against ITS cache + memo. The client
+        stamps the artifact kind (a cheap local sniff — the server
+        re-detects authoritatively) and the document digest as
+        artifact_id, which is what the fleet router keys affinity on:
+        duplicate documents land on the same replica's memo."""
+        import base64
+
+        from ..sbom.artifact import doc_digest
+        options = options or T.ScanOptions()
+        kind = ""
+        if b'"bomFormat"' in raw and b"CycloneDX" in raw:
+            kind = "cyclonedx"
+        elif b"spdxVersion" in raw or b"SPDXVersion:" in raw:
+            kind = "spdx"
+        with ensure_trace(), span("client.scan_sbom", target=target,
+                                  kind=kind):
+            r = self._call(self.SERVICE, "ScanSBOM", {
+                "target": target,
+                "artifact_id": doc_digest(raw),
+                "kind": kind,
+                "document": base64.b64encode(raw).decode(),
+                "options": self._options_json(options),
+            })
+            return self._decode_response(r)
+
+    @staticmethod
+    def _options_json(options) -> dict:
+        return {
+            "scanners": list(options.scanners),
+            "vuln_type": list(options.pkg_types),
+            "list_all_packages": options.list_all_packages,
+        }
+
+    def _decode_response(self, r: dict):
         os_j = r.get("os") or {}
         os_info = T.OS(family=os_j.get("family", ""),
                        name=os_j.get("name", ""),

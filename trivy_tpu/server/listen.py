@@ -79,7 +79,7 @@ class ServerState:
     def __init__(self, table, cache_dir: str, token: str = "",
                  cache_backend: str = "fs", detect_opts=None,
                  admission=None, mesh_opts: MeshOptions | None = None,
-                 memo_backend="", redetect_opts=None):
+                 memo_backend="", redetect_opts=None, sbom_opts=None):
         from ..detect.sched import SchedOptions
         from ..fanal.cache import open_cache
         # one backend-selection path (fanal.cache.open_cache) shared
@@ -98,6 +98,10 @@ class ServerState:
         # (detect/sched.py; --detect-* flags tune or disable it)
         self.detect_opts = detect_opts if detect_opts is not None \
             else SchedOptions()
+        # graftbom: parse budgets/deadline for ScanSBOM document
+        # decodes (SBOMOptions; None → defaults). Chaos drills tighten
+        # the parse deadline the way they tighten ingest budgets.
+        self.sbom_opts = sbom_opts
         # graftguard admission: bounded deadline-aware Scan queue
         # (--admit-* flags; unbounded by default). The breaker reference
         # picks the shed code — 503 while the device is down, 429 else
@@ -754,6 +758,8 @@ class Handler(BaseHTTPRequestHandler):
         try:
             if route == "/twirp/trivy.scanner.v1.Scanner/Scan":
                 return self._scan_admitted(req)
+            if route == "/twirp/trivy.scanner.v1.Scanner/ScanSBOM":
+                return self._scan_admitted(req, sbom=True)
             if route == "/twirp/trivy.cache.v1.Cache/PutArtifact":
                 st.cache.put_artifact(req.get("artifact_id", ""),
                                       req.get("artifact_info") or {})
@@ -805,11 +811,14 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _scan_admitted(self, req: dict):
+    def _scan_admitted(self, req: dict, sbom: bool = False):
         """Scan behind graftguard admission: bounded concurrency,
         bounded queue time, per-request deadline from
         X-Trivy-Deadline-Ms — a handler thread is never parked past
-        the point its client has given up."""
+        the point its client has given up. ScanSBOM (`sbom=True`)
+        shares every seam — admission, shed accounting, rpc.scan
+        failpoint, SLO, cost settle — and differs only in the decode
+        step ahead of the Scan tail."""
         st = self.state
         if st.draining:
             # graceful drain: no NEW scans once the shutdown signal
@@ -856,6 +865,8 @@ class Handler(BaseHTTPRequestHandler):
                               ledger=led)
         try:
             failpoint("rpc.scan")
+            if sbom:
+                return self._scan_sbom(req)
             return self._scan(req)
         except KeyError:
             raise   # 400 invalid_argument: the client's error
@@ -869,6 +880,35 @@ class Handler(BaseHTTPRequestHandler):
             raise
         finally:
             st.admission.release()
+
+    def _scan_sbom(self, req: dict):
+        """graftbom ingress: one supervised decode into a content-
+        addressed blob, then the UNCHANGED Scan tail. inspect() never
+        raises for the document's fault — hostile input lands as an
+        annotated partial result, not a 5xx, and not a breaker
+        charge. The client-stamped artifact_id only steered router
+        affinity; the blob identity is the server-computed document
+        digest either way (the two agree for honest clients)."""
+        import base64
+
+        from ..sbom.artifact import SBOMArtifact
+        raw = req.get("document") or b""
+        if isinstance(raw, str):
+            # JSON-mode bodies carry the document base64-encoded;
+            # fall back to literal text for hand-rolled callers
+            try:
+                raw = base64.b64decode(raw, validate=True)
+            except (ValueError, TypeError):
+                raw = raw.encode()
+        ref = SBOMArtifact(raw, self.state.cache,
+                           name=req.get("target", ""),
+                           opts=self.state.sbom_opts).inspect()
+        return self._scan({
+            "target": req.get("target", "") or ref.name,
+            "artifact_id": ref.id,
+            "blob_ids": ref.blob_ids,
+            "options": req.get("options") or {},
+        })
 
     def _scan(self, req: dict):
         import time
@@ -958,7 +998,7 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
           cache_backend: str = "fs", trace_path: str = "",
           detect_opts=None, admission=None, mesh_opts=None,
           drain_grace_s: float = 10.0, memo_backend="",
-          redetect_opts=None):
+          redetect_opts=None, sbom_opts=None):
     """`trace_path` arms graftscope recording for the server's
     lifetime and dumps the Chrome trace-event JSON there on shutdown
     (the CLI's `server --trace FILE`). `detect_opts` (SchedOptions)
@@ -973,7 +1013,8 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
     state = ServerState(table, cache_dir, token, cache_backend,
                         detect_opts=detect_opts, admission=admission,
                         mesh_opts=mesh_opts, memo_backend=memo_backend,
-                        redetect_opts=redetect_opts)
+                        redetect_opts=redetect_opts,
+                        sbom_opts=sbom_opts)
     # per-server Handler subclass: `state` must not live on the shared
     # base class, or two in-process replicas (the fleet tests/bench)
     # would serve each other's caches and scanners
@@ -998,7 +1039,8 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
 def serve_background(host: str, port: int, table, cache_dir: str,
                      token: str = "", cache_backend: str = "fs",
                      detect_opts=None, admission=None, mesh_opts=None,
-                     memo_backend="", redetect_opts=None):
+                     memo_backend="", redetect_opts=None,
+                     sbom_opts=None):
     """Start in a daemon thread; returns (httpd, state) once listening.
     Callers own shutdown: `httpd.shutdown()` then `state.close()` (the
     detect engine's worker threads are non-daemon). `cache_backend`
@@ -1010,7 +1052,8 @@ def serve_background(host: str, port: int, table, cache_dir: str,
                         admission=admission,
                         mesh_opts=mesh_opts,
                         memo_backend=memo_backend,
-                        redetect_opts=redetect_opts)
+                        redetect_opts=redetect_opts,
+                        sbom_opts=sbom_opts)
     handler = type("Handler", (Handler,), {"state": state})
     httpd = ThreadingHTTPServer((host, port), handler)
     # lint: allow(TPU112) reason=serve loop exits when the caller runs httpd.shutdown() (documented caller-owned shutdown contract)

@@ -507,6 +507,16 @@ REGISTRY: dict[str, dict[int, F]] = {
         4: F("license_categories", "map", F("v", "msg", "Licenses")),
         5: F("include_dev_deps", "bool"),
     },
+    # graftbom SBOM ingress (repo extension — no reference .proto):
+    # the raw document bytes travel in-band; artifact_id carries the
+    # client-stamped document digest so the fleet router's affinity
+    # lands duplicate documents on the same replica's memo, and kind
+    # carries the client's format sniff ("cyclonedx"/"spdx"/"")
+    "ScanSBOMRequest": {
+        1: F("target", "string"), 2: F("artifact_id", "string"),
+        3: F("kind", "string"), 4: F("document", "bytes"),
+        5: _m("options", "ScanOptions"),
+    },
     "ScanResponse": {1: _m("os", "OS"),
                      3: _m("results", "ScanResult", True)},
     "ScanResult": {
