@@ -1682,6 +1682,11 @@ def device_child_main():
     except Exception:
         chaos_storm = None
     try:
+        # graftfair: adversarial-tenant isolation drill
+        tenant_qos = bench_tenant_qos()
+    except Exception:
+        tenant_qos = None
+    try:
         # fanald headline scenario on the device backend (walks are
         # host-side; the detect tail runs on the chip here)
         archive_e2e = bench_archive_e2e(table)
@@ -1724,6 +1729,7 @@ def device_child_main():
         "server_fleet": server_fleet,
         "fleet_dedup": fleet_dedup,
         "chaos_storm": chaos_storm,
+        "tenant_qos": tenant_qos,
         "archive_e2e": archive_e2e,
         "sbom_ingest": sbom_ingest,
         "lib_version": lib_version,
@@ -1772,6 +1778,52 @@ def bench_chaos_storm():
         "p99_ms": round(report.p99_ms(), 2),
         "shed_rate": round(report.sheds() / n, 3),
         "requests": len(report.outcomes),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+def bench_tenant_qos():
+    """graftfair scenario: the adversarial-tenant drill as a bench
+    tail — one flooding tenant (20 simultaneous requests) against
+    trickling victims at c=8, per-tenant quotas armed. Reports the
+    victim p99 ratio vs a flood-free run of the same skeleton (the
+    isolation headline: must stay near 1.0, hard-bounded at 3.0 by
+    the storm invariant), the victim shed count (must stay 0 — quota
+    pressure lands on the flooder only), and the flood's own shed
+    rate + whether every overflow shed was a well-formed 429 with a
+    finite Retry-After. Storm engine's own table: this measures the
+    QoS stack, not the join."""
+    from trivy_tpu.resilience.storm import (Schedule, StormEvent,
+                                            StormOptions, run_storm,
+                                            storm_table)
+    table = storm_table()
+    opts = StormOptions(requests=16, concurrency=8, tenants=2,
+                        admit_tenant_max_active=4,
+                        admit_tenant_max_queue=2)
+    t0 = time.perf_counter()
+    solo = run_storm(Schedule(seed=909, topology="single",
+                              horizon_ms=900.0, events=[]),
+                     opts, table=table)
+    flooded = run_storm(
+        Schedule(seed=909, topology="single", horizon_ms=900.0,
+                 events=[StormEvent(at_ms=80.0,
+                                    kind="adversarial_tenant",
+                                    arg=20.0)]),
+        opts, table=table)
+    flood = flooded.flood_outcomes
+    flood_sheds = [o for o in flood if o.status == "shed"]
+    solo_p99 = max(solo.p99_ms(), 1e-3)
+    return {
+        "invariants_ok": flooded.ok and solo.ok,
+        "violations": sorted(flooded.violations),
+        "victim_p99_ms": round(flooded.p99_ms(), 2),
+        "victim_p99_ratio": round(flooded.p99_ms() / solo_p99, 2),
+        "victim_sheds": flooded.sheds(),
+        "flood_requests": len(flood),
+        "flood_shed_rate": round(len(flood_sheds)
+                                 / max(1, len(flood)), 3),
+        "flood_429_well_formed": all(
+            o.code == 429 and o.well_formed for o in flood_sheds),
         "wall_s": round(time.perf_counter() - t0, 2),
     }
 
@@ -2340,6 +2392,13 @@ def main():
         except Exception as e:
             diag.append(f"chaos_storm bench failed: {e}")
         try:
+            # graftfair scenario: victim p99 ratio + flood shed rate
+            # under one flooding tenant with quotas armed; the device
+            # child's numbers override when present
+            result["tenant_qos"] = bench_tenant_qos()
+        except Exception as e:
+            diag.append(f"tenant_qos bench failed: {e}")
+        try:
             arch = bench_archive_e2e(table)
             # HEADLINE metric (ROADMAP item 1): archive e2e through
             # the fanald pipeline, with the serial parity-oracle pass,
@@ -2461,6 +2520,8 @@ def main():
                 result["fleet_dedup"] = dev["fleet_dedup"]
             if dev.get("chaos_storm"):
                 result["chaos_storm"] = dev["chaos_storm"]
+            if dev.get("tenant_qos"):
+                result["tenant_qos"] = dev["tenant_qos"]
             if dev.get("graftprof"):
                 result["graftprof"] = dev["graftprof"]
             if dev.get("archive_e2e"):

@@ -227,6 +227,35 @@ class TestTenantAggregator:
                             "queue_ms", "avoided_ms"}
 
 
+class TestHostileTenantClamp:
+    """graftfair cardinality containment: a hostile client minting
+    tenant ids cannot mint unbounded label/state cardinality — the
+    syntactic clamp (normalize_tenant) plus the aggregator's top-K
+    fold keep the label space bounded no matter what the header
+    says."""
+
+    def test_normalize_clamps_length_and_control_chars(self):
+        assert cost.normalize_tenant(None) == "default"
+        assert cost.normalize_tenant("") == "default"
+        assert cost.normalize_tenant("  ") == "default"
+        assert cost.normalize_tenant("team-a") == "team-a"
+        # exposition-format injection: newlines can never reach a
+        # metric label or a log line as line breaks
+        assert "\n" not in cost.normalize_tenant("evil\ntenant 1")
+        assert "\r" not in cost.normalize_tenant("evil\r\nx")
+        assert len(cost.normalize_tenant("x" * 100_000)) <= 64
+
+    def test_ten_thousand_hostile_names_stay_bounded(self):
+        agg = cost.TenantAggregator(top_k=8)
+        labels = {
+            agg.resolve(cost.normalize_tenant(f"hostile-{i:05d}\n"))
+            for i in range(10_000)}
+        # 8 minted rows + "other"; reserved rows aren't consumed here
+        assert len(labels) <= 9
+        assert "other" in labels
+        assert len(agg.labels()) <= 8 + 1 + len(cost.TenantAggregator.RESERVED)
+
+
 # ---------------------------------------------------------------------------
 # attribution on/off: the bench A/B baseline switch
 

@@ -595,6 +595,45 @@ class TestShedsAndDeadlines:
 # graftcost: one merged X-Trivy-Cost across failover hops
 
 
+class TestTenantFailover:
+    def test_tenant_identity_survives_failover(self, fleet):
+        """graftfair: X-Trivy-Tenant rides _FORWARD_HEADERS through
+        every failover hop. After a replica dies, requests retried on
+        the survivors are billed to the SAME tenant — never silently
+        re-homed to "default" — and the router's fleet table folds
+        them under that tenant."""
+        from trivy_tpu.obs import cost
+        cost.TENANTS.reset_for_tests()   # deterministic label budget
+        n = 6
+        for i in range(n):
+            put_blob(fleet.url, i)
+        baseline = {i: _canon(scan(fleet.url, i)) for i in range(n)}
+        f0 = METRICS.get("trivy_tpu_fleet_failovers_total")
+        fleet.kill_replica(next(iter(fleet.replicas)))
+        for i in range(n):
+            diff = blob_doc(i)["DiffID"]
+            req = urllib.request.Request(
+                fleet.url + "/twirp/trivy.scanner.v1.Scanner/Scan",
+                data=json.dumps(
+                    {"target": f"img{i}", "artifact_id": diff,
+                     "blob_ids": [diff],
+                     "options": {"scanners": ["vuln"]}}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Trivy-Tenant": "team-fo"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert _canon(json.loads(r.read())) == baseline[i]
+                doc = cost.parse_cost_header(
+                    r.headers.get("X-Trivy-Cost"))
+            assert doc["tenant"] == "team-fo", \
+                f"img{i} billed to {doc['tenant']!r} after failover"
+        # the dead replica's keys really did fail over
+        assert METRICS.get("trivy_tpu_fleet_failovers_total") > f0
+        row = fleet.state.costs.table(
+            include_system_live=False)["team-fo"]
+        assert row["scans"] == {"ok": n}
+
+
 class TestCostHeaderAggregation:
     def test_failover_merges_hop_costs_exactly_once(self):
         """A shed hop and the hop that served both returned cost

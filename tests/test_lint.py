@@ -1811,3 +1811,96 @@ def test_full_tree_pass_wall_clock_budget():
     astlint.run(None)
     concurrency.run(None)
     assert time.monotonic() - t0 < 30.0
+
+
+# graftfair: seed-violation regressions — the lint rules must keep
+# firing on the exact concurrency shapes the multi-tenant QoS code
+# introduces (per-tenant state dicts, the fair-queue lock + DRR sweep,
+# and the admission condition-variable), so a future refactor of those
+# subsystems cannot silently fall out of lint scope.
+
+
+def test_fair_tenant_state_mutation_outside_lock_detected():
+    """TPU106 on the AdmissionQueue/DispatchScheduler shape: per-tenant
+    quota dicts guarded by self._lock, with one mutation planted
+    outside the lock (the exact bug class graftfair's fold-to-'other'
+    path would hit)."""
+    src = (
+        "import threading\n"
+        "class Quota:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._tenants = {}\n"
+        "        self._deficit = {}\n"
+        "    def shed(self, t):\n"
+        "        self._tenants.pop(t, None)\n"
+        "    def admit(self, t):\n"
+        "        with self._lock:\n"
+        "            self._tenants[t] = 1\n"
+        "            self._deficit[t] = 0.0\n"
+    )
+    fs = _lint("trivy_tpu/resilience/fixture.py", src)
+    assert [(f.rule, f.line) for f in fs] == [("TPU106", 8)]
+
+
+def test_fair_sweep_lock_order_cycle_detected(tmp_path):
+    """TPU110 on the graftfair sweep shape: a dispatcher that takes
+    the fair-queue lock then a tenant-state lock, and a quota updater
+    that nests them the other way round — the deadlock the 'all
+    _locked helpers require self._lock' contract in detect/sched.py
+    exists to prevent."""
+    src = (
+        "import threading\n"
+        "\n"
+        "class Sweep:\n"
+        "    def __init__(self):\n"
+        "        self._fair_lock = threading.Lock()\n"
+        "        self._tenant_lock = threading.Lock()\n"
+        "\n"
+        "    def take_round(self):\n"
+        "        with self._fair_lock:\n"
+        "            with self._tenant_lock:\n"
+        "                return 1\n"
+        "\n"
+        "    def update_quota(self):\n"
+        "        with self._tenant_lock:\n"
+        "            with self._fair_lock:\n"
+        "                return 2\n"
+    )
+    fs = _conc_tree(tmp_path, {"sweep.py": src})
+    cyc = [f for f in fs if f.rule == "TPU110"
+           and "lock-order cycle" in f.message]
+    assert len(cyc) == 1, "\n".join(f.render() for f in fs)
+    assert "Sweep._fair_lock" in cyc[0].message
+    assert "Sweep._tenant_lock" in cyc[0].message
+
+
+def test_fair_admission_wait_without_predicate_detected(tmp_path):
+    """TPU113 on the admission cv shape: the per-tenant admit path
+    waiting on the condition with `if` instead of the canonical
+    `while` predicate loop (spurious wakeups would admit a tenant past
+    its active cap); the real while-loop twin stays clean."""
+    src = (
+        "import threading\n"
+        "\n"
+        "class Admit:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self._active = 0\n"
+        "        self._cap = 2\n"
+        "\n"
+        "    def bad_admit(self):\n"
+        "        with self._cv:\n"
+        "            if self._active >= self._cap:\n"
+        "                self._cv.wait()\n"
+        "            self._active += 1\n"
+        "\n"
+        "    def good_admit(self):\n"
+        "        with self._cv:\n"
+        "            while self._active >= self._cap:\n"
+        "                self._cv.wait()\n"
+        "            self._active += 1\n"
+    )
+    fs = _conc_tree(tmp_path, {"admit.py": src})
+    got = [(f.rule, f.line) for f in fs]
+    assert got == [("TPU113", 12)], "\n".join(f.render() for f in fs)

@@ -411,6 +411,52 @@ class TestRedetectd:
             httpd.server_close()
             state.close()
 
+    def test_sweep_is_quota_exempt_under_brutal_tenant_limits(self):
+        """graftfair: redetectd's blameless sweep is system work — it
+        must complete even when per-tenant quotas are armed at levels
+        that would strangle any client tenant (rate 0.001/s, one
+        active slot), and it must never register a tenant-QoS shed."""
+        from trivy_tpu.resilience import AdmissionOptions
+        t1, t2 = memo_table(0), memo_table(5)
+        memo = MemoryMemo()
+        httpd, state = self._server(
+            t1, memo, admission=AdmissionOptions(
+                max_active=2, max_queue=64,
+                queue_timeout_ms=30000.0,
+                tenant_max_active=1, tenant_max_queue=1,
+                tenant_rate=0.001, tenant_burst=1.0))
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        docs = [base_blob_doc()] + [thin_blob_doc(i) for i in range(5)]
+        hdr = {"X-Trivy-Tenant": "system"}   # exempt warm-up traffic
+        try:
+            for d in docs:      # warm pass populates the memo
+                _post(base, "/twirp/trivy.cache.v1.Cache/PutBlob",
+                      {"diff_id": d["DiffID"], "blob_info": d}, 30,
+                      headers=hdr)
+                code, _, _ = _post(
+                    base, "/twirp/trivy.scanner.v1.Scanner/Scan",
+                    {"target": "t", "artifact_id": d["DiffID"],
+                     "blob_ids": [d["DiffID"]],
+                     "options": {"scanners": ["vuln"]}}, 30,
+                    headers=hdr)
+                assert code == 200
+            shed0 = METRICS.get("trivy_tpu_requests_shed_total")
+            qos0 = METRICS.get("trivy_tpu_tenant_qos_sheds_total",
+                               tenant="system", reason="rate")
+            state.swap_table(t2)    # kicks the sweep
+            st = self._wait_sweep(state)
+            assert st["phase"] == "done"
+            assert st["done"] == st["total"] == len(docs)
+            assert st["db_version"] == t2.content_digest()
+            # no shed anywhere: the sweep never entered the quota path
+            assert METRICS.get("trivy_tpu_requests_shed_total") == shed0
+            assert METRICS.get("trivy_tpu_tenant_qos_sheds_total",
+                               tenant="system", reason="rate") == qos0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            state.close()
+
     def test_drain_cancels_sweep_cleanly_no_leaked_threads(self):
         t1, t2 = memo_table(0), memo_table(5)
         memo = MemoryMemo()

@@ -684,6 +684,166 @@ class TestAdmissionQueue:
 
 
 # ---------------------------------------------------------------------------
+# graftfair: per-tenant admission quotas
+
+
+class TestTenantQuotas:
+    """Unit coverage for the --admit-tenant-* quota layer: caps,
+    token-bucket rate, drain-rate-derived Retry-After, state-size
+    bounds, exemptions, and the fail-closed quota failpoint. Buckets
+    and drain history use the injectable clock — no sleeps."""
+
+    def test_quotas_disarmed_by_default(self):
+        opts = AdmissionOptions()
+        assert not opts.tenant_quotas_on()
+        q = AdmissionQueue(opts)
+        for _ in range(8):
+            q.admit(tenant="noisy")
+        snap = q.snapshot()
+        assert "tenant_quotas" not in snap
+        assert snap["active"] == 8
+
+    def test_tenant_active_cap_isolates_other_tenants(self):
+        q = AdmissionQueue(AdmissionOptions(
+            tenant_max_active=1, queue_timeout_ms=40.0))
+        q.admit(tenant="flood")
+        with pytest.raises(Shed) as ei:
+            q.admit(tenant="flood")    # own cap → queue → budget shed
+        assert ei.value.http_code == 429
+        assert ei.value.retry_after_s >= 1.0
+        # the other tenant's slots are untouched by the flood
+        q.admit(tenant="victim")
+        q.release(tenant="victim")
+        q.release(tenant="flood")
+
+    def test_tenant_queue_overflow_sheds_immediately(self):
+        q = AdmissionQueue(AdmissionOptions(
+            tenant_max_active=1, tenant_max_queue=1,
+            queue_timeout_ms=5000.0))
+        q.admit(tenant="flood")
+        parked = threading.Thread(
+            target=lambda: (q.admit(tenant="flood"),
+                            q.release(tenant="flood")))
+        parked.start()
+        for _ in range(100):           # wait for the waiter to queue
+            if q.snapshot()["tenants"]["flood"]["queued"]:
+                break
+            time.sleep(0.01)
+        t0 = time.perf_counter()
+        with pytest.raises(Shed) as ei:
+            q.admit(tenant="flood")    # queue share full → immediate
+        assert time.perf_counter() - t0 < 1.0
+        assert "tenant queue overflow" in ei.value.reason
+        assert ei.value.http_code == 429
+        q.release(tenant="flood")
+        parked.join(5.0)
+
+    def test_rate_limit_retry_after_is_tenant_bucket_refill(self):
+        t = [100.0]
+        q = AdmissionQueue(AdmissionOptions(
+            tenant_rate=0.2, tenant_burst=1.0), clock=lambda: t[0])
+        q.admit(tenant="a")            # takes the only token
+        with pytest.raises(Shed) as ei:
+            q.admit(tenant="a")
+        assert ei.value.http_code == 429
+        # next token is 1/0.2 = 5 s out — the hint is THIS tenant's
+        # refill, not global congestion
+        assert ei.value.retry_after_s == pytest.approx(5.0)
+        q.admit(tenant="b")            # b's bucket is its own
+        t[0] += 10.0                   # refill re-earns the token
+        q.admit(tenant="a")
+
+    def test_rate_retry_after_floored_at_one_second(self):
+        t = [100.0]
+        q = AdmissionQueue(AdmissionOptions(
+            tenant_rate=10.0, tenant_burst=1.0), clock=lambda: t[0])
+        q.admit(tenant="a")
+        with pytest.raises(Shed) as ei:
+            q.admit(tenant="a")        # refill is 0.1 s out
+        assert ei.value.retry_after_s == 1.0
+
+    def test_system_and_untenanted_bypass_quotas(self):
+        q = AdmissionQueue(AdmissionOptions(
+            tenant_max_active=1, tenant_rate=0.001))
+        for _ in range(4):
+            q.admit(tenant="system")   # blameless/probe/warmup work
+            q.admit(tenant=None)
+        assert q.snapshot()["tenants"] == {}   # no rows minted
+
+    def test_retry_after_empty_history_falls_back_to_budget(self):
+        t = [100.0]
+        q = AdmissionQueue(AdmissionOptions(queue_timeout_ms=3000.0),
+                           clock=lambda: t[0])
+        assert q._drain_rate() == 0.0  # no completions yet
+        assert q._retry_after() == 3.0
+
+    def test_retry_after_tracks_observed_drain_rate(self):
+        t = [100.0]
+        q = AdmissionQueue(AdmissionOptions(queue_timeout_ms=1000.0),
+                           clock=lambda: t[0])
+        for _ in range(11):
+            q.admit()
+        for i in range(11):
+            t[0] = 100.0 + i * 0.5     # a completion every 500 ms
+            q.release()
+        assert q._drain_rate() == pytest.approx(2.0)
+        q._queued = 9                  # 9 ahead at 2/s → 5 s hint
+        assert q._retry_after() == pytest.approx(5.0)
+
+    def test_retry_after_burst_history_single_clock_tick(self):
+        t = [100.0]
+        q = AdmissionQueue(AdmissionOptions(),
+                           clock=lambda: t[0])
+        for _ in range(5):
+            q.admit()
+        for _ in range(5):
+            q.release()                # all inside one clock tick
+        assert q._drain_rate() > 0.0   # guarded span, no div-by-zero
+        assert q._retry_after() >= 1.0
+
+    def test_quota_state_bounded_overflow_folds_to_other(self):
+        q = AdmissionQueue(AdmissionOptions(tenant_max_queue=10_000))
+        for i in range(200):
+            q.admit(tenant=f"hostile-{i}")
+        tenants = q.snapshot()["tenants"]
+        # 64 distinct rows + the shared fold bucket — raw names can
+        # never mint unbounded state even past the aggregator clamp
+        assert len(tenants) == 65
+        assert "other" in tenants
+        assert tenants["other"]["active"] == 200 - 64
+
+    def test_reserved_tenants_never_starved_by_a_flood(self):
+        """The reserved labels ("default", "system", "other") must
+        always be able to make progress while a flooding tenant sits
+        at its caps: quotas are per-tenant, so one tenant's exhausted
+        bucket never walls off anyone else's slots."""
+        q = AdmissionQueue(AdmissionOptions(
+            tenant_max_active=1, tenant_rate=1000.0,
+            queue_timeout_ms=40.0))
+        q.admit(tenant="flood")        # flood pinned at its cap
+        for label in ("default", "system", "other"):
+            for _ in range(3):         # repeatedly, not just once
+                q.admit(tenant=label)
+                q.release(tenant=label)
+        q.release(tenant="flood")
+
+    def test_quota_failpoint_fails_closed_as_429(self):
+        q = AdmissionQueue(AdmissionOptions(tenant_max_active=8))
+        FAILPOINTS.set("admission.quota", "error")
+        try:
+            with pytest.raises(Shed) as ei:
+                q.admit(tenant="x")
+            assert ei.value.http_code == 429
+            assert ei.value.retry_after_s >= 1.0
+            assert "quota fault" in ei.value.reason
+            # exempt work never crosses the quota path, fault or not
+            q.admit(tenant="system")
+            q.release(tenant="system")
+        finally:
+            FAILPOINTS.clear("admission.quota")
+
+
+# ---------------------------------------------------------------------------
 # server integration: sheds over HTTP, healthz, /metrics
 
 

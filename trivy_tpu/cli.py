@@ -145,6 +145,19 @@ def _add_scan_flags(p: argparse.ArgumentParser):
                    help="pipeline-wide cap on file content in the "
                         "analysis window — walkers block (bounded) "
                         "before reading past it (default 256MiB)")
+    p.add_argument("--ingest-tenant-walker-share", type=float,
+                   default=1.0,
+                   help="graftfair: max fraction of the walker pool "
+                        "one tenant may hold concurrently (1.0 = "
+                        "off). Overflow degrades that tenant's OWN "
+                        "scans to annotated partials; untenanted and "
+                        "system work are exempt")
+    p.add_argument("--ingest-tenant-byte-share", type=float,
+                   default=1.0,
+                   help="graftfair: max fraction of the in-flight "
+                        "byte window one tenant may hold (1.0 = "
+                        "off); same degradation contract as the "
+                        "walker share")
 
 
 def _add_watch_flags(p: argparse.ArgumentParser):
@@ -304,6 +317,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max time one Scan may wait in the admission "
                         "queue (bounded further by the request's "
                         "X-Trivy-Deadline-Ms; default 1000)")
+    p.add_argument("--admit-tenant-max-active", type=int, default=0,
+                   help="graftfair: max concurrent Scans per tenant "
+                        "(X-Trivy-Tenant); 0 = no per-tenant active "
+                        "cap. Overflow sheds 429 with a tenant-"
+                        "derived Retry-After; 'system' and untenanted "
+                        "work are exempt")
+    p.add_argument("--admit-tenant-max-queue", type=int, default=0,
+                   help="graftfair: max queued waiters per tenant "
+                        "beyond its active cap (0 = no per-tenant "
+                        "queue cap); the global queue always keeps "
+                        "headroom reserved for other tenants")
+    p.add_argument("--admit-tenant-rate", type=float, default=0.0,
+                   help="graftfair: sustained admits/s per tenant "
+                        "(token bucket, burst 2x; 0 = no rate limit). "
+                        "Rate sheds answer 429 with the bucket's own "
+                        "refill time as Retry-After")
+    p.add_argument("--ingest-tenant-walker-share", type=float,
+                   default=1.0,
+                   help="graftfair: max fraction of the fanald walker "
+                        "pool one tenant's PutBlob walks may hold "
+                        "concurrently (1.0 = off); overflow degrades "
+                        "that tenant's own scans to annotated "
+                        "partials")
+    p.add_argument("--ingest-tenant-byte-share", type=float,
+                   default=1.0,
+                   help="graftfair: max fraction of the in-flight "
+                        "ingest byte window one tenant may hold "
+                        "(1.0 = off); same degradation contract as "
+                        "the walker share")
     p.add_argument("--detect-warmup", action="store_true",
                    help="pre-compile the join's pair-bucket ladder at "
                         "boot so steady-state traffic never pays an "
@@ -322,6 +364,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "bucket ranges will touch (streamed tables "
                         "only; advisory — a failed prefetch costs one "
                         "cold upload); --no-stream-prefetch disables")
+    p.add_argument("--detect-tenant-max-share", type=float,
+                   default=1.0,
+                   help="graftfair: max fraction of one merged-"
+                        "dispatch round's pair budget a single tenant "
+                        "may fill while other tenants have work "
+                        "queued (deficit round-robin; 1.0 = off). "
+                        "Results stay bit-identical — only dispatch "
+                        "order changes")
     p.add_argument("--mesh-devices", type=int, default=0,
                    help="shard the detect join over a dp×db mesh of N "
                         "devices with meshguard per-device fault "
@@ -713,7 +763,8 @@ def _configure_misconf(args) -> None:
 
 _INGEST_FLAG_FIELDS = ("walkers", "analyzers", "max_file_bytes",
                        "max_layer_bytes", "max_members",
-                       "layer_deadline_ms", "max_inflight_bytes")
+                       "layer_deadline_ms", "max_inflight_bytes",
+                       "tenant_walker_share", "tenant_byte_share")
 
 
 def _ingest_options(args):
@@ -1124,7 +1175,14 @@ def cmd_server(args) -> int:
     admission = AdmissionOptions(
         max_active=getattr(args, "admit_max_active", 0),
         max_queue=getattr(args, "admit_max_queue", 16),
-        queue_timeout_ms=getattr(args, "admit_queue_ms", 1000.0))
+        queue_timeout_ms=getattr(args, "admit_queue_ms", 1000.0),
+        tenant_max_active=getattr(args, "admit_tenant_max_active", 0),
+        tenant_max_queue=getattr(args, "admit_tenant_max_queue", 0),
+        tenant_rate=getattr(args, "admit_tenant_rate", 0.0))
+    # graftfair: install the server's ingest defaults so PutBlob-driven
+    # fanald walks honor the per-tenant shares (the fields the server
+    # parser doesn't define fall back to the dataclass defaults)
+    _ingest_options(args)
     # graftwatch: incident dir, slow-trace pinning, SLO thresholds
     _configure_watch(args)
     # validate the backend spelling BEFORE the (slow) table load, and
@@ -1149,7 +1207,9 @@ def cmd_server(args) -> int:
                                     1 << 22),
         warmup=getattr(args, "detect_warmup", False),
         dedup=getattr(args, "detect_dedup", True),
-        prefetch=getattr(args, "stream_prefetch", True))
+        prefetch=getattr(args, "stream_prefetch", True),
+        tenant_max_share=getattr(args, "detect_tenant_max_share",
+                                 1.0))
     # meshguard: shard detection over a device mesh with per-device
     # fault domains (shrink on loss, grow on readmission)
     from .server.listen import MeshOptions

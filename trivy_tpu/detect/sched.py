@@ -47,6 +47,7 @@ import contextvars
 import queue as queue_mod
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass
 
@@ -66,7 +67,7 @@ _log = _get_logger("sched")
 class SchedOptions:
     """detectd knobs (server flags --detect-coalesce-wait-ms,
     --detect-max-inflight-pairs, --detect-warmup, --detect-dedup,
-    --stream-prefetch)."""
+    --stream-prefetch, --detect-tenant-max-share)."""
     coalesce_wait_ms: float = 2.0     # max wait gathering co-dispatchers
     max_pairs_in_flight: int = 1 << 22  # padded-pair in-flight bound
     warmup: bool = False              # pre-compile the bucket ladder
@@ -76,6 +77,10 @@ class SchedOptions:
     #                                   query triples across the merge
     prefetch: bool = True             # graftfeed: warm the next
     #                                   dispatch's advisory slices
+    tenant_max_share: float = 1.0     # graftfair: max fraction of a
+    #                                   merged round's pair budget one
+    #                                   tenant may fill while other
+    #                                   tenants are pending (1.0 = off)
 
 
 class _Request:
@@ -87,7 +92,7 @@ class _Request:
 
     __slots__ = ("future", "results", "slots", "n_pairs", "_lock",
                  "_remaining", "ctx", "trace_id", "cost", "t_submit",
-                 "queue_charged")
+                 "queue_charged", "tenant")
 
     def __init__(self, n_slots: int):
         self.future: Future = Future()
@@ -111,6 +116,11 @@ class _Request:
         self.cost = _cost.active()
         self.t_submit = time.perf_counter()
         self.queue_charged = False
+        # graftfair: the fair-queue key — the aggregator-CLAMPED
+        # tenant label (bounded top-K + "other"), "system" when no
+        # request ledger is installed (warmup, blameless redetect)
+        self.tenant = (_cost.TENANTS.resolve(self.cost.tenant)
+                       if self.cost is not None else "system")
 
     def arm(self) -> None:
         with self._lock:
@@ -156,11 +166,18 @@ class DispatchScheduler:
         self._cv = threading.Condition(self._lock)
         self._inflight_pairs = 0
         self._closed = False
-        # graftfeed prefetch peek: requests enqueued but not yet
-        # swept by the dispatcher, so a round that just dispatched can
-        # warm the advisory slices the NEXT round will touch. Guarded
-        # by self._lock; entries leave when the dispatcher dequeues
-        self._pending_reg: dict[int, _Request] = {}
+        # graftfair: per-tenant pending queues drained by deficit
+        # round-robin on real pair count. submit() registers a request
+        # here (under self._lock) BEFORE putting its wake token on
+        # self._queue; the dispatcher pops rounds via _fair_take.
+        # Tenant labels are aggregator-clamped, so the dicts stay
+        # bounded at top-K + reserved. graftfeed's prefetch peeks the
+        # same structure in drain order (not insertion order), so it
+        # warms the NEXT round's slices even under a tenant flood
+        self._fair: dict[str, deque] = {}
+        self._rr: deque[str] = deque()       # tenant rotation order
+        self._deficit: dict[str, float] = {}  # DRR deficit counters
+        self._fair_pairs = 0                  # total pending pairs
         # daemon: an unclosed scheduler must not block interpreter
         # exit; close() still joins it for a clean shutdown
         self._thread = threading.Thread(
@@ -201,8 +218,11 @@ class DispatchScheduler:
             if self._closed:
                 raise RuntimeError("DispatchScheduler is closed")
             # enqueue under the lock: close() flips _closed before its
-            # sentinel, so every accepted request precedes the sentinel
-            self._pending_reg[id(req)] = req
+            # sentinel, so every accepted request precedes the sentinel.
+            # The fair queues are the registry of record; the queue
+            # item is only a wake token (the dispatcher pops rounds
+            # from the fair structure, not from the token stream)
+            self._fair_put_locked(req)
             self._queue.put(req)
         return req.future
 
@@ -248,51 +268,176 @@ class DispatchScheduler:
 
     # ---- dispatcher ---------------------------------------------------
 
+    # ---- graftfair fair queues (all _locked helpers require
+    # self._lock; NEVER call them while also needing self._cv — the
+    # condition shares the same lock) -----------------------------------
+
+    def _fair_put_locked(self, req: _Request) -> None:
+        dq = self._fair.get(req.tenant)
+        if dq is None:
+            # lint: allow(TPU106) reason=caller holds self._lock — the _locked-helper contract is an interprocedural hold the intraprocedural rule cannot see
+            dq = self._fair[req.tenant] = deque()
+            # lint: allow(TPU106) reason=caller holds self._lock — the _locked-helper contract is an interprocedural hold the intraprocedural rule cannot see
+            self._deficit[req.tenant] = 0.0
+            self._rr.append(req.tenant)
+        dq.append(req)
+        self._fair_pairs += req.n_pairs
+
+    def _fair_take_locked(self, budget: int) -> list[_Request]:
+        """One deficit-round-robin sweep over the per-tenant queues →
+        the round's requests in drain order. Each tenant's turn banks
+        one quantum of pair credit and drains whole requests against
+        it; with more than one tenant pending, no tenant may fill more
+        than tenant_max_share of the round's pair budget — the rest of
+        its queue waits for the next round (bounded share, not
+        starvation: a solo tenant always gets the whole window)."""
+        active = [t for t in self._rr if self._fair.get(t)]
+        if not active:
+            return []
+        share = self.opts.tenant_max_share
+        cap = (budget if len(active) <= 1 or share >= 1.0
+               else max(1, int(budget * share)))
+        quantum = max(1, budget // max(1, len(active)))
+        taken: list[_Request] = []
+        taken_by: dict[str, int] = {}
+        total = 0
+        progress = True
+        while progress and total < budget:
+            progress = False
+            for label in list(self._rr):
+                dq = self._fair.get(label)
+                if not dq:
+                    # idle queues bank no credit (classic DRR reset)
+                    # lint: allow(TPU106) reason=caller holds self._lock — the _locked-helper contract is an interprocedural hold the intraprocedural rule cannot see
+                    self._deficit[label] = 0.0
+                    continue
+                # lint: allow(TPU106) reason=caller holds self._lock — the _locked-helper contract is an interprocedural hold the intraprocedural rule cannot see
+                self._deficit[label] += quantum
+                while dq and total < budget:
+                    head = dq[0]
+                    w = max(1, head.n_pairs)
+                    if taken_by.get(label, 0) + w > cap and taken:
+                        break   # share spent — next tenant
+                    if w > self._deficit[label] and taken:
+                        break   # credit spent — next tenant
+                    dq.popleft()
+                    self._fair_pairs -= head.n_pairs
+                    # lint: allow(TPU106) reason=caller holds self._lock — the _locked-helper contract is an interprocedural hold the intraprocedural rule cannot see
+                    self._deficit[label] = max(
+                        0.0, self._deficit[label] - w)
+                    taken.append(head)
+                    taken_by[label] = taken_by.get(label, 0) + w
+                    total += w
+                    progress = True
+        if not taken:
+            # forced progress: an oversize head larger than any credit
+            # this sweep could bank still dispatches (alone)
+            for label in list(self._rr):
+                dq = self._fair.get(label)
+                if dq:
+                    head = dq.popleft()
+                    self._fair_pairs -= head.n_pairs
+                    # lint: allow(TPU106) reason=caller holds self._lock — the _locked-helper contract is an interprocedural hold the intraprocedural rule cannot see
+                    self._deficit[label] = 0.0
+                    taken.append(head)
+                    break
+        # rotate so the next round's sweep starts one tenant later —
+        # ties don't always break toward the same queue
+        if self._rr:
+            self._rr.rotate(-1)
+        return taken
+
+    def _peek_fair_locked(self, k: int) -> list[_Request]:
+        """First ≤k requests in the fair sweep's drain order (one per
+        tenant per lap, round-robin) WITHOUT popping — the prefetch
+        peek. Approximates _fair_take_locked's interleave without
+        consuming deficits."""
+        out: list[_Request] = []
+        lap = 0
+        while len(out) < k:
+            advanced = False
+            for label in self._rr:
+                dq = self._fair.get(label)
+                if dq is not None and lap < len(dq):
+                    out.append(dq[lap])
+                    advanced = True
+                    if len(out) >= k:
+                        break
+            if not advanced:
+                break
+            lap += 1
+        return out
+
+    def _drain_tokens(self) -> bool:
+        """Consume every wake token already queued (their requests are
+        in the fair structure). → True when the close() sentinel was
+        seen."""
+        saw_stop = False
+        while True:
+            try:
+                tok = self._queue.get_nowait()
+            except queue_mod.Empty:
+                return saw_stop
+            if tok is None:
+                saw_stop = True
+
     def _run(self) -> None:
         import jax  # noqa: F401 — fail fast off the request path
         opts = self.opts
-        stop = False
-        while not stop:
-            try:
-                item = self._queue.get(timeout=0.5)
-            except queue_mod.Empty:
-                continue
-            if item is None:
-                break
+        stopping = False
+        while True:
             with self._lock:
-                self._pending_reg.pop(id(item), None)
-            pending = [item]
-            pairs = item.n_pairs
+                idle = self._fair_pairs == 0 and not any(
+                    self._fair.values())
+            if idle:
+                if stopping:
+                    break
+                try:
+                    tok = self._queue.get(timeout=0.5)
+                except queue_mod.Empty:
+                    continue
+                if tok is None:
+                    # drain-then-exit: every accepted request precedes
+                    # the sentinel (submit registers under the lock),
+                    # so loop once more to flush any residue
+                    stopping = True
+                    continue
             # sweep everything already queued (free coalescing), then
             # hold the window open — but ONLY while a dispatch is in
             # flight: with an idle device, waiting would trade latency
             # for nothing, while a busy device makes the wait free
             # (the request would be queued behind it anyway)
             deadline = time.monotonic() + opts.coalesce_wait_ms / 1e3
-            while pairs < opts.max_pairs_in_flight:
-                try:
-                    nxt = self._queue.get_nowait()
-                except queue_mod.Empty:
-                    with self._cv:
-                        busy = self._inflight_pairs > 0
-                    timeout = deadline - time.monotonic()
-                    if not busy or timeout <= 0:
-                        break
-                    try:
-                        nxt = self._queue.get(
-                            timeout=min(timeout,
-                                        opts.coalesce_wait_ms / 4e3))
-                    except queue_mod.Empty:
-                        continue
-                if nxt is None:
-                    stop = True
-                    break
+            while not stopping:
+                stopping |= self._drain_tokens()
                 with self._lock:
-                    self._pending_reg.pop(id(nxt), None)
-                pending.append(nxt)
-                pairs += nxt.n_pairs
+                    pairs = self._fair_pairs
+                if stopping or pairs >= opts.max_pairs_in_flight:
+                    break
+                with self._cv:
+                    busy = self._inflight_pairs > 0
+                timeout = deadline - time.monotonic()
+                if not busy or timeout <= 0:
+                    break
+                try:
+                    tok = self._queue.get(
+                        timeout=min(timeout,
+                                    opts.coalesce_wait_ms / 4e3))
+                except queue_mod.Empty:
+                    continue
+                if tok is None:
+                    stopping = True
+            # graftfair: pop the round in deficit-round-robin order —
+            # a flooding tenant's surplus stays queued (and visible to
+            # the prefetch peek) instead of monopolizing the window
+            with self._lock:
+                pending = self._fair_take_locked(
+                    opts.max_pairs_in_flight)
+            if not pending:
+                continue
             METRICS.observe("trivy_tpu_detect_queue_depth",
                             float(len(pending)))
+            self._observe_dispatch_share(pending)
             try:
                 self._dispatch_round(pending)
             except BaseException as e:  # noqa: BLE001 — detectd must
@@ -301,34 +446,36 @@ class DispatchScheduler:
                     req.fail(e)
             if opts.prefetch:
                 self._prefetch_pending()
-        # flush anything enqueued before the sentinel
-        while True:
-            try:
-                left = self._queue.get_nowait()
-            except queue_mod.Empty:
-                break
-            if left is None:
-                continue
-            with self._lock:
-                self._pending_reg.pop(id(left), None)
-            try:
-                self._dispatch_round([left])
-            except BaseException as e:  # noqa: BLE001
-                left.fail(e)
+
+    def _observe_dispatch_share(self, pending: list[_Request]) -> None:
+        """Per merged round: each participating tenant's fraction of
+        the round's real pairs (the fair sweep bounds the max at
+        tenant_max_share when tenants compete)."""
+        total = sum(r.n_pairs for r in pending)
+        if total <= 0:
+            return
+        by_tenant: dict[str, int] = {}
+        for r in pending:
+            by_tenant[r.tenant] = by_tenant.get(r.tenant, 0) + r.n_pairs
+        for label, pairs in by_tenant.items():
+            METRICS.observe("trivy_tpu_tenant_qos_dispatch_share",
+                            pairs / total, tenant=label)
 
     def _prefetch_pending(self) -> None:
         """graftfeed slice prefetch: peek the requests still queued
         behind the round that just dispatched and ask a streaming
         detector to warm the advisory slices their bucket ranges will
-        touch. Advisory only — any failure costs at most a cold upload
-        on the next dispatch, never correctness — so every error is
-        swallowed here (the failpoint drill in tests/test_feed.py
-        leans on that)."""
+        touch. The peek follows the FAIR sweep's drain order (round-
+        robin across tenants), so under a tenant flood it warms the
+        next dispatch's slices, not the flood's backlog. Advisory only
+        — any failure costs at most a cold upload on the next
+        dispatch, never correctness — so every error is swallowed here
+        (the failpoint drill in tests/test_feed.py leans on that)."""
         pf = getattr(self.detector, "prefetch_ranges", None)
         if pf is None:
             return
         with self._lock:
-            reqs = list(self._pending_reg.values())[:8]
+            reqs = self._peek_fair_locked(8)
         if not reqs:
             return
         starts: list[np.ndarray] = []

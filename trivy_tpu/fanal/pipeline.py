@@ -107,6 +107,13 @@ class IngestOptions:
     # CLI flag: the watch deadline is the tunable; this only absorbs
     # scheduler jitter)
     abandon_grace_s: float = 5.0
+    # graftfair (--ingest-tenant-walker-share/--ingest-tenant-byte-
+    # share): max fraction of the walker pool / in-flight byte budget
+    # one tenant may hold concurrently (1.0 = off). Overflow degrades
+    # the OWNER's layers to annotated partials — never a neighbor's.
+    # Untenanted work (local scans, system) is exempt
+    tenant_walker_share: float = 1.0
+    tenant_byte_share: float = 1.0
 
     def n_walkers(self) -> int:
         """0 = auto: one walker per core up to 8 — layer inflation
@@ -282,6 +289,17 @@ class IngestBudgetTrip(Exception):
         self.detail = detail
 
 
+def _qos_tenant():
+    """graftfair: the aggregator-CLAMPED tenant label for the CURRENT
+    context, or None when the work is untenanted or system (local
+    scans, warmup, blameless redetect) — exempt from tenant shares."""
+    led = _cost.active()
+    if led is None:
+        return None
+    label = _cost.TENANTS.resolve(led.tenant)
+    return None if label == "system" else label
+
+
 class _ByteBudget:
     """Pipeline-wide in-flight content budget (bytes AND items): a
     walker acquires a file's bytes BEFORE reading them and the
@@ -289,29 +307,55 @@ class _ByteBudget:
     analysis-window content is capped regardless of layer shape.
     Retained post/secret content is bounded separately by the
     per-layer byte cap. `high_water` is the provable bound the
-    property tests assert."""
+    property tests assert.
 
-    def __init__(self, max_bytes: int, max_items: int):
+    graftfair (`tenant_share` < 1.0): one tenant may hold at most that
+    fraction of the byte window; its overflow waits out its OWN layer
+    deadline (→ its own annotated partial) while other tenants'
+    acquires keep landing. The tenant is resolved from the calling
+    context — acquire and every release path run under the same
+    request context, so charges pair up without plumbing."""
+
+    def __init__(self, max_bytes: int, max_items: int,
+                 tenant_share: float = 1.0):
         self._cv = threading.Condition()
         self.max_bytes = max(int(max_bytes), 1)
         self.max_items = max(int(max_items), 1)
+        share = float(tenant_share)
+        self.tenant_cap = (max(1, int(self.max_bytes * share))
+                           if 0.0 < share < 1.0 else 0)   # 0 = off
         self._bytes = 0
         self._items = 0
+        self._t_bytes: dict[str, int] = {}
         self.high_water = 0
+
+    def _tenant(self):
+        # contextvar + aggregator lookups only when the share is armed
+        return _qos_tenant() if self.tenant_cap > 0 else None
 
     def acquire(self, n: int, deadline: Deadline) -> bool:
         """Block until `n` bytes fit (backpressure); → False when the
         deadline expires first (the caller annotates + stops)."""
         n = min(int(n), self.max_bytes)
+        tenant = self._tenant()
+        cap = self.tenant_cap if tenant is not None else 0
+        if cap:
+            # a single file larger than the tenant window still
+            # progresses (alone), mirroring the global clamp above
+            n = min(n, cap)
         with self._cv:
-            while self._bytes + n > self.max_bytes \
-                    or self._items + 1 > self.max_items:
+            while (self._bytes + n > self.max_bytes
+                   or self._items + 1 > self.max_items
+                   or (cap and self._t_bytes.get(tenant, 0) + n > cap)):
                 left = deadline.remaining()
                 if left <= 0:
                     return False
                 self._cv.wait(timeout=min(left, 0.05))
             self._bytes += n
             self._items += 1
+            if cap:
+                self._t_bytes[tenant] = (self._t_bytes.get(tenant, 0)
+                                         + n)
             if self._bytes > self.high_water:
                 self.high_water = self._bytes
             by = self._bytes
@@ -320,9 +364,19 @@ class _ByteBudget:
 
     def release(self, n: int) -> None:
         n = min(int(n), self.max_bytes)
+        tenant = self._tenant()
+        cap = self.tenant_cap if tenant is not None else 0
+        if cap:
+            n = min(n, cap)
         with self._cv:
             self._bytes -= n
             self._items -= 1
+            if cap:
+                cur = self._t_bytes.get(tenant, 0) - n
+                if cur > 0:
+                    self._t_bytes[tenant] = cur
+                else:
+                    self._t_bytes.pop(tenant, None)
             by = self._bytes
             self._cv.notify_all()
         METRICS.set_gauge("trivy_tpu_ingest_inflight_bytes", float(by))
@@ -725,7 +779,14 @@ class IngestPipeline:
         self.skip_files = normalize_skip_globs(skip_files)
         self.skip_dir_globs = normalize_skip_globs(skip_dir_globs)
         self.budget = _ByteBudget(opts.max_inflight_bytes,
-                                  opts.max_inflight_items)
+                                  opts.max_inflight_items,
+                                  tenant_share=opts.tenant_byte_share)
+        # graftfair walker-slot shares: per-tenant count of layers
+        # occupying (or queued for) the walker pool; run() gates
+        # submission on it so a flooding tenant serializes its OWN
+        # layers instead of filling the pool
+        self._wcv = threading.Condition()
+        self._wbusy: dict[str, int] = {}
         # spool buffers share their own window (same size knob): total
         # spool memory ≤ max_inflight_bytes + one overdraft layer
         self.spool = _SpoolWindow(opts.max_inflight_bytes)
@@ -769,15 +830,41 @@ class IngestPipeline:
         every remaining layer is abandoned AT ONCE (queued ones cancel
         clean), not serially one grace each."""
         futs = []
+        out: dict[int, BlobScan] = {}
+        # graftfair: when the walker-share knob is armed and this scan
+        # is tenanted, gate each submission on the tenant's slot share.
+        # The wait happens HERE, on the requesting tenant's own handler
+        # thread — its scan serializes, nobody else's does — and a wait
+        # that outlives the layer deadline degrades to the same
+        # annotated partial as any other budget trip
+        share = self.opts.tenant_walker_share
+        tenant = _qos_tenant() if 0.0 < share < 1.0 else None
+        wcap = (max(1, int(self.opts.n_walkers() * share))
+                if tenant is not None else 0)
         for t in tasks:
+            if wcap:
+                slot_dl = Deadline(self.opts.layer_deadline_ms / 1e3)
+                if not self._acquire_walker_slot(tenant, wcap,
+                                                 slot_dl):
+                    out[t.idx] = self._partial(
+                        t, "walk", "tenant_budget",
+                        "tenant walker-slot share saturated past the "
+                        "layer deadline; layer abandoned")
+                    self._note_trip("tenant.walker_share")
+                    continue
             # each walker inherits the caller's context (trace id,
             # active span) on its own Context copy
             ctx = contextvars.copy_context()
-            futs.append((t, self._walk_pool.submit(
-                ctx.run, self._walk_layer, t)))
+            fut = self._walk_pool.submit(ctx.run, self._walk_layer, t)
+            if wcap:
+                # done-callbacks fire for cancelled futures too, so an
+                # abandoned layer still returns its slot
+                fut.add_done_callback(
+                    lambda _f, _t=tenant: self._release_walker_slot(
+                        _t))
+            futs.append((t, fut))
         grace = self.opts.watch_timeout_s() + self.opts.abandon_grace_s
         by_fut = {fut: t for t, fut in futs}
-        out: dict[int, BlobScan] = {}
         pending = set(by_fut)
         last_progress = self._progress_mark()
         while pending:
@@ -814,12 +901,36 @@ class IngestPipeline:
                         f"{type(e).__name__}: {e}")
         # count partials HERE, once per scan actually returned — an
         # abandoned wedged walker that finishes later must not
-        # double-count its layer
-        for t, _fut in futs:
+        # double-count its layer (tasks covers the slot-share skips
+        # that never reached the pool, too)
+        for t in tasks:
             if out[t.idx].partial:
                 INGEST.note("partial_scans")
                 METRICS.inc("trivy_tpu_ingest_partial_scans_total")
         return out
+
+    def _acquire_walker_slot(self, tenant: str, cap: int,
+                             deadline: Deadline) -> bool:
+        with self._wcv:
+            while self._wbusy.get(tenant, 0) >= cap:
+                left = deadline.remaining()
+                if left <= 0:
+                    return False
+                self._wcv.wait(timeout=min(left, 0.05))
+            # lint: allow(TPU106) reason=held via self._wcv — the Condition owns this state's lock; TPU106 only models bare Lock/RLock attributes
+            self._wbusy[tenant] = self._wbusy.get(tenant, 0) + 1
+            return True
+
+    def _release_walker_slot(self, tenant: str) -> None:
+        with self._wcv:
+            cur = self._wbusy.get(tenant, 0) - 1
+            if cur > 0:
+                # lint: allow(TPU106) reason=held via self._wcv — the Condition owns this state's lock; TPU106 only models bare Lock/RLock attributes
+                self._wbusy[tenant] = cur
+            else:
+                # lint: allow(TPU106) reason=held via self._wcv — the Condition owns this state's lock; TPU106 only models bare Lock/RLock attributes
+                self._wbusy.pop(tenant, None)
+            self._wcv.notify_all()
 
     def _partial(self, task: LayerTask, stage: str, kind: str,
                  detail: str) -> BlobScan:

@@ -61,6 +61,14 @@ from .supervisor import ReplicaOptions, ReplicaSet
 
 _log = _get_logger("fleet.router")
 
+
+class _RouterServer(ThreadingHTTPServer):
+    # graftfair: same accept-backlog rationale as listen.ScanServer
+    # (defined locally — the router never imports the server stack):
+    # a tenant burst must reach the admission/quota layer and earn a
+    # well-formed 429, not die as a kernel RST in the default-5 backlog
+    request_queue_size = 128
+
 # request headers forwarded verbatim to the replica (the deadline
 # header is re-stamped with the remaining budget, and the trace /
 # parent-span headers are stamped per forward from the active span);
@@ -644,7 +652,7 @@ def serve_router(host: str, port: int, replicas,
     # per-server subclass (the listen.py pattern): a router and its
     # replicas coexist in one process in tests/bench
     handler = type("RouterHandler", (RouterHandler,), {"state": state})
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = _RouterServer((host, port), handler)
     import signal
 
     def _on_signal(signum, frame):
@@ -683,7 +691,7 @@ def serve_router_background(host: str, port: int, replicas,
     `state.close()`."""
     state = RouterState(replicas, opts, probe=probe)
     handler = type("RouterHandler", (RouterHandler,), {"state": state})
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = _RouterServer((host, port), handler)
     # lint: allow(TPU112) reason=serve loop exits when the caller runs httpd.shutdown() (documented caller-owned shutdown contract)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()

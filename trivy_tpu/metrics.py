@@ -588,3 +588,21 @@ METRICS.declare(
     "graftcost: estimated device ms the memo/cache layer saved per "
     "tenant (replayed units priced at the EWMA device-ms-per-row "
     "exchange rate; an estimate — excluded from conservation).")
+METRICS.declare(
+    "trivy_tpu_tenant_qos_sheds_total", "counter",
+    "graftfair: admission sheds charged to a tenant's quota "
+    "(reason=\"queue_overflow\"/\"tenant_queue\"/\"tenant_rate\"/"
+    "\"deadline\"/\"budget\"/\"quota_fault\"; tenant labels are "
+    "top-K-plus-\"other\" clamped).")
+METRICS.declare(
+    "trivy_tpu_tenant_qos_quota_depth", "gauge",
+    "graftfair: queued requests currently held against each tenant's "
+    "quota — the per-tenant slice of "
+    "trivy_tpu_admission_queue_depth.")
+METRICS.declare(
+    "trivy_tpu_tenant_qos_dispatch_share", "histogram",
+    "graftfair: per merged detectd dispatch, each participating "
+    "tenant's fraction of the round's real pairs — the fair sweep "
+    "bounds the max at --detect-tenant-max-share when more than one "
+    "tenant is pending.",
+    buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0))

@@ -191,9 +191,18 @@ def check_storm_replay(doc: dict) -> list[str]:
                 kind = ev.get("kind", "failpoint")
                 if kind not in ("failpoint", "kill_replica",
                                 "swap_table", "db_swap",
-                                "hostile_layer", "host_loss"):
+                                "hostile_layer", "host_loss",
+                                "adversarial_tenant"):
                     problems.append(
                         f"events[{i}]: unknown kind {kind!r}")
+                if kind == "adversarial_tenant" and (
+                        not isinstance(ev.get("arg"), (int, float))
+                        or ev["arg"] < 1):
+                    # arg is the flood's burst size; a replay with a
+                    # zero-request flood reproduces nothing
+                    problems.append(
+                        f"events[{i}]: adversarial_tenant with bad "
+                        f"flood size {ev.get('arg')!r}")
                 if kind == "hostile_layer" and \
                         ev.get("variant") not in ("truncated",
                                                   "bomb"):
@@ -222,6 +231,16 @@ def check_storm_replay(doc: dict) -> list[str]:
                 or load["tenants"] < 1):
             problems.append(
                 f"load: bad tenants {load['tenants']!r}")
+        # graftfair tenant-quota knobs: optional (older replays
+        # predate them); when present they must be non-negative
+        # numbers or --replay arms different quotas than the run
+        for field in ("admit_tenant_max_active",
+                      "admit_tenant_max_queue", "admit_tenant_rate"):
+            if field in load and (
+                    not isinstance(load[field], (int, float))
+                    or load[field] < 0):
+                problems.append(
+                    f"load: bad {field} {load[field]!r}")
     if not isinstance(doc.get("violations"), dict):
         problems.append("missing violations map")
     incident = doc.get("incident")

@@ -369,6 +369,28 @@ def request_ledger(tenant: str):
 # ---------------------------------------------------------------------------
 # tenant aggregation (top-K + "other" cardinality clamp)
 
+_TENANT_MAX_LEN = 64
+
+
+def normalize_tenant(raw: str | None) -> str:
+    """Syntactic clamp for hostile tenant ids. X-Trivy-Tenant is
+    attacker-controlled: an oversized value is truncated to
+    _TENANT_MAX_LEN chars, control / non-printable characters are
+    squashed to "_", and an empty or all-junk value falls back to
+    "default". This runs at the server door BEFORE the id can mint
+    quota state, a ledger, or a metric label. Cardinality bombs (10k
+    *distinct* well-formed names) are the next layer's job: quota
+    buckets and metric labels key on TENANTS.resolve(), whose top-K
+    clamp folds the long tail into "other"."""
+    if not raw:
+        return "default"
+    cleaned = "".join(
+        ch if ch.isprintable() else "_"
+        for ch in raw[:_TENANT_MAX_LEN])
+    cleaned = cleaned.strip()
+    return cleaned or "default"
+
+
 def _new_tenant_row() -> dict:
     return {"scans": {}, "queue_ms": 0.0, "service_ms": 0.0,
             "device_ms": 0.0, "transfer_bytes": 0.0, "host_ms": 0.0,

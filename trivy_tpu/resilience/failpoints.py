@@ -50,6 +50,9 @@ SITES = (
     "cache.s3",           # fanal/s3_cache.py shared-backend IO
     "rpc.scan",           # server/listen.py Scan handler
     "rpc.route",          # fleet/router.py per-replica forward
+    "admission.quota",    # resilience/admission.py quota bookkeeping
+    #                       (graftfair; fails CLOSED — injected faults
+    #                       become well-formed 429 sheds, never 500s)
     "db.download",        # db/download.py OCI artifact pull
     "fanal.walk",         # fanal/pipeline.py per-layer walker stage
     "fanal.analyze",      # fanal/pipeline.py analyzer-batch stage

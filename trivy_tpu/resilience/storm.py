@@ -161,6 +161,16 @@ class StormEvent:
                     with ONE debounced shrink that re-factorizes
                     dp×db over the survivors, and the probe path
                     readmits the host after the window.
+      adversarial_tenant
+                    (any topology) at at_ms one hostile tenant
+                    ("storm-adv") bursts `arg` extra requests at the
+                    topology, all at once, while the paced victim
+                    load flows. The runner arms per-tenant admission
+                    quotas (graftfair) so the invariant beyond the
+                    usual set — tenant_isolation — can hold: victims
+                    never shed, flood overflow sheds are well-formed
+                    429s with finite Retry-After, and every result
+                    that does complete stays bit-identical.
     """
     at_ms: float
     kind: str = "failpoint"
@@ -184,6 +194,9 @@ class StormEvent:
         if self.kind == "host_loss":
             return (f"host_loss(host={self.host})"
                     f"@{self.at_ms:g}+{self.dur_ms:g}ms")
+        if self.kind == "adversarial_tenant":
+            return (f"adversarial_tenant(n={self.arg:g})"
+                    f"@{self.at_ms:g}ms")
         return f"{self.kind}[{self.replica}]@{self.at_ms:g}ms"
 
 
@@ -234,12 +247,27 @@ def generate_schedule(seed: int, topology: str, n_events: int = 4,
         menu = list(_INGEST_FAULTS) * 2 + [("rpc.scan", "slow")]
         kinds = ["failpoint"] * 3 + ["hostile_layer"] * 2 + \
             ["swap_table"]
+    # graftfair: every topology can draw one adversarial-tenant flood
+    # (at most one per schedule — a second flood tenant adds noise,
+    # not coverage, and doubles the run's extra request volume)
+    kinds = kinds + ["adversarial_tenant"]
     events: list[StormEvent] = []
     used_sites: set[str] = set()
     for _ in range(max(int(n_events), 1)):
         at = rng.uniform(0.0, horizon_ms * 0.6)
         dur = rng.uniform(horizon_ms * 0.25, horizon_ms * 0.6)
         kind = rng.choice(kinds)
+        if kind == "adversarial_tenant":
+            if any(e.kind == "adversarial_tenant" for e in events):
+                continue
+            # flood size: ingest requests are full client-side walks
+            # (each one orders of magnitude heavier than a Scan RPC),
+            # so its bursts stay small
+            lo, hi = (4, 8) if topology == "ingest" else (8, 16)
+            events.append(StormEvent(
+                at_ms=round(at, 1), kind="adversarial_tenant",
+                arg=float(rng.randrange(lo, hi + 1))))
+            continue
         if kind == "hostile_layer":
             events.append(StormEvent(
                 at_ms=round(at, 1), kind="hostile_layer",
@@ -445,6 +473,15 @@ class StormOptions:
     breaker_reset_ms: float = 150.0
     admit_max_active: int = 0   # 0 = unbounded (no admission sheds)
     admit_max_queue: int = 8
+    # graftfair per-tenant quotas (0/0.0 = disarmed). When a schedule
+    # carries an adversarial_tenant event and none of these are set,
+    # run_storm derives victim-safe defaults: tenant_max_active =
+    # concurrency (victims run ≤1 in-flight per worker, so they can
+    # NEVER trip their own cap — zero victim sheds is structural),
+    # tenant_max_queue small (the flood's burst overflows as 429s)
+    admit_tenant_max_active: int = 0
+    admit_tenant_max_queue: int = 0
+    admit_tenant_rate: float = 0.0
     settle_s: float = 8.0       # post-schedule liveness window
     request_timeout_s: float = 30.0
     artifact_dir: str = ""      # incident/replay dir ("" = tmpdir)
@@ -486,6 +523,9 @@ class StormReport:
     violations: dict[str, list[str]]
     incident_dir: str = ""
     duration_s: float = 0.0
+    # adversarial_tenant schedules: the flood's own outcomes, kept
+    # separate from the victim load's (see RunContext.flood_outcomes)
+    flood_outcomes: list[Outcome] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -502,7 +542,7 @@ class StormReport:
         return lats[min(len(lats) - 1, int(len(lats) * 0.99))]
 
     def summary(self) -> dict:
-        return {
+        out = {
             "seed": self.schedule.seed,
             "topology": self.schedule.topology,
             "events": [e.label() for e in self.schedule.events],
@@ -513,6 +553,12 @@ class StormReport:
             "violations": self.violations,
             "duration_s": round(self.duration_s, 2),
         }
+        if self.flood_outcomes:
+            out["flood"] = {
+                "requests": len(self.flood_outcomes),
+                "sheds": sum(1 for o in self.flood_outcomes
+                             if o.status == "shed")}
+        return out
 
 
 def canonical_digest(doc: dict) -> str:
@@ -607,6 +653,12 @@ class _Topology:
             for site in self.host_sites(ev.host):
                 FAILPOINTS.set(site, ev.mode or "hang",
                                ev.arg, seed=ev.seed)
+        elif ev.kind == "adversarial_tenant":
+            # the flood is traffic, not topology state: run_storm's
+            # load phase spawns the burst workers against the same
+            # epoch (they need the request docs and the outcome
+            # collection, which live there) — nothing to arm here
+            pass
 
     def revert(self, ev: StormEvent) -> None:
         """Disarm one event at the end of its window."""
@@ -681,7 +733,10 @@ class SingleTopology(_Topology):
         from ..server.listen import serve_background
         admission = AdmissionOptions(
             max_active=opts.admit_max_active,
-            max_queue=opts.admit_max_queue)
+            max_queue=opts.admit_max_queue,
+            tenant_max_active=opts.admit_tenant_max_active,
+            tenant_max_queue=opts.admit_tenant_max_queue,
+            tenant_rate=opts.admit_tenant_rate)
         self.httpd, self.state = serve_background(
             "127.0.0.1", 0, table, cache_dir="",
             cache_backend="memory", admission=admission,
@@ -806,7 +861,10 @@ class FleetTopology(_Topology):
             memo_backend=self.shared_memo,
             admission=AdmissionOptions(
                 max_active=self.opts.admit_max_active,
-                max_queue=self.opts.admit_max_queue))
+                max_queue=self.opts.admit_max_queue,
+                tenant_max_active=self.opts.admit_tenant_max_active,
+                tenant_max_queue=self.opts.admit_tenant_max_queue,
+                tenant_rate=self.opts.admit_tenant_rate))
         url = f"http://127.0.0.1:{httpd.server_address[1]}"
         self.replicas[slot] = (httpd, state, url)
         self.ports[slot] = httpd.server_address[1]
@@ -1212,6 +1270,14 @@ class RunContext:
     # per axis, plus the reconciliation verdicts) — filled after
     # teardown, when every handler thread has settled its ledger
     cost_conservation: dict = field(default_factory=dict)
+    # graftfair adversarial_tenant: the flood's own outcomes (kept out
+    # of `outcomes` — the victim invariants must see ONLY the paced
+    # load) and the oracle pass's per-request latencies, the victim
+    # p99's solo baseline ({} when the oracle was passed in, e.g.
+    # minimization trials — the latency probe is then vacuous)
+    adversarial: bool = False
+    flood_outcomes: list = field(default_factory=list)
+    oracle_lat: dict = field(default_factory=dict)
 
 
 @invariant("no_lost_requests")
@@ -1325,6 +1391,63 @@ def _inv_incident(ctx: RunContext) -> list[str]:
         return [f"{ctx.breaker_opens} breaker opening(s) but no "
                 f"incident file in {ctx.incident_dir}"]
     return []
+
+
+@invariant("tenant_isolation")
+def _inv_tenant_isolation(ctx: RunContext) -> list[str]:
+    """adversarial_tenant schedules only (vacuous otherwise): one
+    hostile tenant's burst must not degrade anyone else. Victims
+    (the paced load) never shed — the flood tenant's quota caps, not
+    victim starvation, absorb the burst; every flood overflow is a
+    well-formed 429 the flooder can back off on; and whatever DOES
+    complete — victim or flood — stays bit-identical (bit_identity
+    covers the victims; the flood's completions are held to the same
+    oracle here). When the flood is the schedule's ONLY event, the
+    victim p99 must stay within 3x the solo (oracle-pass) baseline —
+    under combined fault windows the latency bound belongs to the
+    faults, not the flood, so it is skipped."""
+    if not ctx.adversarial:
+        return []
+    out = []
+    for o in ctx.outcomes:
+        if o is not None and o.status == "shed":
+            out.append(f"victim request {o.idx} shed ({o.code}) "
+                       f"under the tenant flood")
+    others = [e for e in ctx.schedule.events
+              if e.kind != "adversarial_tenant"]
+    lats = sorted(o.latency_ms for o in ctx.outcomes
+                  if o is not None and o.status == "ok")
+    base = sorted(ctx.oracle_lat.values())
+    if not others and lats and base:
+        p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+        b99 = base[min(len(base) - 1, int(len(base) * 0.99))]
+        # 3x the solo baseline, floored: a sub-ms baseline would turn
+        # ordinary CI scheduler jitter into a violation
+        bound = max(3.0 * b99, 300.0)
+        if p99 > bound:
+            out.append(f"victim p99 {p99:.0f}ms exceeds {bound:.0f}ms "
+                       f"(3x solo baseline {b99:.0f}ms)")
+    for o in ctx.flood_outcomes:
+        if o.status == "shed":
+            if not o.well_formed:
+                out.append(f"flood request {o.idx}: malformed shed "
+                           f"({o.code}: {o.detail})")
+            elif o.code != 429 and not ctx.breaker_opens:
+                out.append(f"flood request {o.idx}: {o.code} shed "
+                           f"with no breaker opening — quota "
+                           f"overflow must be a 429")
+        elif o.status == "ok":
+            if o.partial:
+                continue
+            want = ctx.oracle.get(o.idx)
+            if want is not None and o.digest != want \
+                    and not ctx.db_swap:
+                out.append(f"flood request {o.idx}: completed result "
+                           f"drifted from the unfaulted oracle")
+        else:
+            out.append(f"flood request {o.idx}: "
+                       f"{o.code or 'conn'} {o.detail}")
+    return out
 
 
 @invariant("cost_conservation")
@@ -1523,6 +1646,21 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
     opts = opts or StormOptions()
     if table is None:
         table = storm_table()
+    # graftfair: an adversarial_tenant schedule needs armed per-tenant
+    # quotas to mean anything. When the caller set none, derive
+    # victim-safe defaults — active cap = concurrency (each victim
+    # worker holds ≤1 request in flight, so victims structurally
+    # cannot trip their own tenant cap even when a fault window
+    # stalls them) and a small queue cap the burst overflows past
+    adv_events = [ev for ev in schedule.events
+                  if ev.kind == "adversarial_tenant"]
+    if adv_events and not (opts.admit_tenant_max_active
+                           or opts.admit_tenant_max_queue
+                           or opts.admit_tenant_rate):
+        opts = replace(
+            opts,
+            admit_tenant_max_active=max(2, opts.concurrency),
+            admit_tenant_max_queue=max(1, opts.concurrency // 4))
     load_seed = opts.load_seed or schedule.seed
     docs = [request_doc(load_seed, i) for i in range(opts.requests)]
 
@@ -1568,6 +1706,7 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
                 if code != 200:
                     raise RuntimeError(f"storm setup: PutBlob → "
                                        f"{code} {body}")
+        oracle_lat: dict[int, float] = {}
         if oracle is None:
             oracle = {}
             for i, doc in enumerate(docs):
@@ -1577,6 +1716,9 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
                         f"storm oracle pass failed on request {i}: "
                         f"{o.status} {o.code} {o.detail}")
                 oracle[i] = o.digest
+                # the serial unfaulted pass doubles as the victim
+                # latency baseline for tenant_isolation
+                oracle_lat[i] = o.latency_ms
 
         # the storm pass: concurrent load + schedule driver, all paced
         # against one epoch. Requests spread across ~85% of the
@@ -1613,11 +1755,48 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
             target=worker, name=f"storm-load-{k}",
             args=(range(k, len(docs), opts.concurrency),))
             for k in range(opts.concurrency)]
+
+        # adversarial_tenant floods: one thread per flood request, all
+        # released at the event's offset against the shared epoch —
+        # the sharpest burst the hostile tenant can mount. Flood
+        # outcomes are collected separately: the victim invariants
+        # must never see them, tenant_isolation holds them to the
+        # well-formed-429 + bit-identity contract.
+        flood_outcomes: list[Outcome] = []
+        flood_lock = threading.Lock()
+
+        def flood_worker(ev: StormEvent, j: int) -> None:
+            delay = t0 + ev.at_ms / 1e3 - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            base = j % len(docs)
+            try:
+                o = topo.do_request(base, docs[base],
+                                    opts.request_timeout_s,
+                                    tenant="storm-adv")
+            except Exception as e:  # noqa: BLE001 — same contract as
+                # the victim workers: surprises become reportable
+                # lost outcomes, never a dead thread
+                o = Outcome(base, "lost",
+                            detail=f"{type(e).__name__}: {e}"[:160])
+            o.idx = base
+            with flood_lock:
+                flood_outcomes.append(o)
+
+        flood_threads = [
+            threading.Thread(target=flood_worker,
+                             name=f"storm-flood-{n}-{j}",
+                             args=(ev, j), daemon=True)
+            for n, ev in enumerate(adv_events)
+            for j in range(max(1, int(ev.arg)))]
+
         driver.start()
-        for t in threads:
+        for t in threads + flood_threads:
             t.start()
         for t in threads:
             t.join()
+        for t in flood_threads:
+            t.join(timeout=opts.request_timeout_s + 5.0)
         driver.flush()
         FAILPOINTS.configure("")   # safety net past driver bugs
 
@@ -1742,7 +1921,10 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
         v2=topo.table2.content_digest(),
         skew_settle_delta=skew_settle_delta,
         requests=len(docs),
-        cost_conservation=cost_deltas)
+        cost_conservation=cost_deltas,
+        adversarial=bool(adv_events),
+        flood_outcomes=flood_outcomes,
+        oracle_lat=oracle_lat)
     violations = {}
     for name, probe in INVARIANTS.items():
         msgs = probe(ctx)
@@ -1751,7 +1933,8 @@ def run_storm(schedule: Schedule, opts: StormOptions | None = None,
     return StormReport(schedule=schedule, outcomes=outcomes,
                        oracle=oracle, violations=violations,
                        incident_dir=run_dir,
-                       duration_s=time.perf_counter() - t_run0)
+                       duration_s=time.perf_counter() - t_run0,
+                       flood_outcomes=flood_outcomes)
 
 
 # ---------------------------------------------------------------------------
@@ -1835,6 +2018,9 @@ def write_replay(path: str, schedule: Schedule, opts: StormOptions,
             "load_seed": opts.load_seed or schedule.seed,
             "admit_max_active": opts.admit_max_active,
             "admit_max_queue": opts.admit_max_queue,
+            "admit_tenant_max_active": opts.admit_tenant_max_active,
+            "admit_tenant_max_queue": opts.admit_tenant_max_queue,
+            "admit_tenant_rate": opts.admit_tenant_rate,
             "watchdog_ms": opts.watchdog_ms,
             "breaker_reset_ms": opts.breaker_reset_ms,
             "replicas": opts.replicas,
@@ -1868,6 +2054,11 @@ def load_replay(path: str) -> tuple[Schedule, StormOptions]:
         load_seed=int(load.get("load_seed", 0)),
         admit_max_active=int(load.get("admit_max_active", 0)),
         admit_max_queue=int(load.get("admit_max_queue", 8)),
+        admit_tenant_max_active=int(
+            load.get("admit_tenant_max_active", 0)),
+        admit_tenant_max_queue=int(
+            load.get("admit_tenant_max_queue", 0)),
+        admit_tenant_rate=float(load.get("admit_tenant_rate", 0.0)),
         watchdog_ms=float(load.get("watchdog_ms", 50.0)),
         breaker_reset_ms=float(load.get("breaker_reset_ms", 150.0)),
         replicas=int(load.get("replicas", 3)),
@@ -1904,6 +2095,15 @@ def main(argv=None) -> int:
                          "topology (host_loss events kill one host's "
                          "worth of device domains at once)")
     ap.add_argument("--admit-max-active", type=int, default=0)
+    ap.add_argument("--admit-tenant-max-active", type=int, default=0,
+                    help="graftfair per-tenant active cap (0 = "
+                         "disarmed; adversarial_tenant schedules "
+                         "derive victim-safe defaults when none of "
+                         "the tenant quota flags are set)")
+    ap.add_argument("--admit-tenant-max-queue", type=int, default=0)
+    ap.add_argument("--admit-tenant-rate", type=float, default=0.0,
+                    help="per-tenant admission rate (req/s token "
+                         "bucket; 0 = disarmed)")
     ap.add_argument("--tenants", type=int, default=1,
                     help="distinct X-Trivy-Tenant ids the load "
                          "round-robins through (graftcost tenant mix; "
@@ -1946,6 +2146,9 @@ def main(argv=None) -> int:
         replicas=args.replicas, mesh_devices=args.mesh_devices,
         mesh_hosts=args.mesh_hosts,
         admit_max_active=args.admit_max_active,
+        admit_tenant_max_active=args.admit_tenant_max_active,
+        admit_tenant_max_queue=args.admit_tenant_max_queue,
+        admit_tenant_rate=args.admit_tenant_rate,
         artifact_dir=args.artifact_dir, tenants=args.tenants)
     for r in range(args.rounds):
         seed = args.seed + r

@@ -517,6 +517,14 @@ def _result_to_json(res: T.Result) -> dict:
     return res.to_json()
 
 
+class ScanServer(ThreadingHTTPServer):
+    # graftfair: the TCP accept backlog must exceed any burst the
+    # admission layer is meant to judge — with the http.server default
+    # (5), a flooding tenant's connections die as kernel RSTs before
+    # the quota layer can mint its well-formed 429 + Retry-After
+    request_queue_size = 128
+
+
 class Handler(BaseHTTPRequestHandler):
     state: ServerState = None  # set by serve()
     protocol_version = "HTTP/1.1"
@@ -638,8 +646,20 @@ class Handler(BaseHTTPRequestHandler):
                     # burn-rate gauges, so /healthz and /metrics agree)
                     "slo": SLO.export(),
                     # graftcost: per-tenant scan counts + headline cost
-                    # split (bounded rows — the top-K clamp already ran)
-                    "tenants": _cost.TENANTS.healthz_block(),
+                    # split (bounded rows — the top-K clamp already
+                    # ran). graftfair adds the `qos` view: per-tenant
+                    # admission quota state and tenant-labelled SLO
+                    # burn rates, bounded by the same clamp
+                    "tenants": {
+                        **_cost.TENANTS.healthz_block(),
+                        "qos": {
+                            "quotas": resilience["admission"].get(
+                                "tenant_quotas"),
+                            "admission": resilience["admission"].get(
+                                "tenants", {}),
+                            "burn_rates": SLO.tenant_burn_rates(),
+                        },
+                    },
                 }
                 # graftstream: slice plan + resident set when the
                 # serving detector streams its advisory table (the
@@ -719,8 +739,11 @@ class Handler(BaseHTTPRequestHandler):
         # absent → "default"). Every seam below — admission queue,
         # detectd apportionment, fanald ingest, secrets, memo — charges
         # this ledger through the contextvar; settle folds it into the
-        # tenant aggregate once the response is on the wire
-        tenant = self.headers.get(TENANT_HEADER) or "default"
+        # tenant aggregate once the response is on the wire.
+        # graftfair: the raw header is attacker-controlled, so it is
+        # syntactically clamped HERE — before it can mint a ledger,
+        # quota state, or a metric label anywhere downstream
+        tenant = _cost.normalize_tenant(self.headers.get(TENANT_HEADER))
         try:
             with new_trace(tid or None, parent_id=parent or None) as tid:
                 self._trace_id = tid
@@ -827,8 +850,11 @@ class Handler(BaseHTTPRequestHandler):
             from ..metrics import METRICS
             s = Shed("server draining", 503, st.drain_retry_after_s)
             METRICS.inc("trivy_tpu_requests_shed_total")
-            SLO.observe_scan(0.0, "shed")
             led = _cost.active()
+            SLO.observe_scan(
+                0.0, "shed",
+                tenant=_cost.TENANTS.resolve(led.tenant)
+                if led is not None else None)
             if led is not None:
                 led.outcome = "shed"
             _log.warning("scan shed (draining): 503 Retry-After=%ds",
@@ -842,13 +868,19 @@ class Handler(BaseHTTPRequestHandler):
             except ValueError:
                 deadline = None  # unparseable header: no deadline
         led = _cost.active()
+        # graftfair: quota state keys on the CLAMPED aggregator label,
+        # never the raw header — a cardinality bomb of distinct names
+        # folds into "other" and shares ONE bucket. System work (no
+        # ledger installed) passes tenant=None and is quota-exempt
+        qlabel = (_cost.TENANTS.resolve(led.tenant)
+                  if led is not None else None)
         # graftcost: time parked in the admission queue is queue ms —
         # kept distinct from service ms so a tenant whose scans are
         # QUEUED reads differently from one whose scans are SLOW.
         # Charged on the shed path too (the wait really happened)
         t_adm = time.perf_counter()
         try:
-            st.admission.admit(deadline)
+            st.admission.admit(deadline, tenant=qlabel)
         except Shed as s:
             _cost.charge_queue_ms(
                 (time.perf_counter() - t_adm) * 1e3, ledger=led)
@@ -859,7 +891,7 @@ class Handler(BaseHTTPRequestHandler):
             # shed-aware SLO accounting: a 429/503 is load the
             # deployment refused on purpose — availability's
             # denominator grows, its error count does not
-            SLO.observe_scan(0.0, "shed")
+            SLO.observe_scan(0.0, "shed", tenant=qlabel)
             return self._shed_response(s)
         _cost.charge_queue_ms((time.perf_counter() - t_adm) * 1e3,
                               ledger=led)
@@ -879,7 +911,7 @@ class Handler(BaseHTTPRequestHandler):
                 if led is not None else None)
             raise
         finally:
-            st.admission.release()
+            st.admission.release(tenant=qlabel)
 
     def _scan_sbom(self, req: dict):
         """graftbom ingress: one supervised decode into a content-
@@ -1019,7 +1051,7 @@ def serve(host: str, port: int, table, cache_dir: str, token: str = "",
     # base class, or two in-process replicas (the fleet tests/bench)
     # would serve each other's caches and scanners
     handler = type("Handler", (Handler,), {"state": state})
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = ScanServer((host, port), handler)
     install_drain_handlers(httpd, state, drain_grace_s)
     if ready_event is not None:
         ready_event.set()
@@ -1055,7 +1087,7 @@ def serve_background(host: str, port: int, table, cache_dir: str,
                         redetect_opts=redetect_opts,
                         sbom_opts=sbom_opts)
     handler = type("Handler", (Handler,), {"state": state})
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = ScanServer((host, port), handler)
     # lint: allow(TPU112) reason=serve loop exits when the caller runs httpd.shutdown() (documented caller-owned shutdown contract)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
